@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding
+tests exercise real multi-device semantics without TPU hardware (the driver
+separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Must run before jax is imported anywhere."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,126 @@
+"""Foundation-layer tests (ref test strategy: veles/tests/test_config.py,
+test_mutable.py, prng tests — SURVEY.md §4)."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.config import Config, root
+from veles_tpu.mutable import Bool, link
+from veles_tpu.registry import MappedRegistry, UnitRegistry
+
+
+class TestConfig:
+    def test_autovivify(self):
+        cfg = Config("test")
+        cfg.a.b.c = 42
+        assert cfg.a.b.c == 42
+        assert cfg.as_dict() == {"a": {"b": {"c": 42}}}
+
+    def test_update_deep_merge(self):
+        cfg = Config("test")
+        cfg.x.y = 1
+        cfg.x.z = 2
+        cfg.update({"x": {"z": 3, "w": 4}, "v": 5})
+        assert cfg.x.y == 1 and cfg.x.z == 3 and cfg.x.w == 4 and cfg.v == 5
+
+    def test_get_does_not_vivify(self):
+        cfg = Config("test")
+        assert cfg.get("nope", 7) == 7
+        assert "nope" not in cfg
+
+    def test_root_defaults(self):
+        assert root.common.engine.precision.accum == "float32"
+        assert isinstance(root.common.dirs.cache, str)
+
+
+class TestBool:
+    def test_assign_and_truth(self):
+        b = Bool()
+        assert not b
+        b <<= True
+        assert b
+
+    def test_lazy_expression_tracks_sources(self):
+        a, b = Bool(True), Bool(False)
+        gate = a & ~b
+        assert gate
+        b <<= True            # flips the derived gate without rebuilding it
+        assert not gate
+        a <<= False
+        assert not (a | b) == False  # noqa: E712 — (a|b) is True since b True
+
+    def test_derived_not_assignable(self):
+        a = Bool(True)
+        gate = ~a
+        with pytest.raises(ValueError):
+            gate <<= True
+
+    def test_xor(self):
+        a, b = Bool(True), Bool(True)
+        assert not (a ^ b)
+        b <<= False
+        assert a ^ b
+
+
+class TestLink:
+    def test_linkable_attribute_forwarding(self):
+        class Src:
+            val = 10
+
+        class Dst:
+            pass
+
+        s, d = Src(), Dst()
+        link(d, "val", s)
+        assert d.val == 10
+        d.val = 20
+        assert s.val == 20
+
+
+class TestRegistry:
+    def test_unit_registry_records_subclasses(self):
+        class Probe(metaclass=UnitRegistry):
+            pass
+
+        assert Probe in UnitRegistry.units
+        assert UnitRegistry.find("Probe") is Probe
+
+    def test_mapped_registry(self):
+        class Family(metaclass=MappedRegistry):
+            mapping = {}
+
+        class Impl(Family):
+            MAPPING = "impl"
+
+        assert Family["impl"] is Impl
+        assert "impl" in Family
+
+
+class TestPrng:
+    def test_streams_reproducible(self):
+        g1 = prng.RandomGenerator("t", seed=7)
+        g2 = prng.RandomGenerator("t", seed=7)
+        assert numpy.array_equal(g1.permutation(100), g2.permutation(100))
+        k1, k2 = g1.key(), g2.key()
+        import jax
+        assert jax.random.key_data(k1).tolist() == \
+            jax.random.key_data(k2).tolist()
+
+    def test_streams_differ_by_name(self):
+        a = prng.RandomGenerator("a", seed=None)
+        b = prng.RandomGenerator("b", seed=None)
+        assert not numpy.array_equal(a.permutation(100), b.permutation(100))
+
+    def test_state_resume_mid_stream(self):
+        g = prng.RandomGenerator("t", seed=3)
+        g.permutation(10)
+        saved = pickle.dumps(g)
+        expect = g.permutation(10)
+        g2 = pickle.loads(saved)
+        assert numpy.array_equal(g2.permutation(10), expect)
+
+    def test_global_registry(self):
+        assert prng.get("loader") is prng.get("loader")

@@ -1,0 +1,221 @@
+"""Unit-graph runtime tests (ref: veles/tests/test_units.py,
+test_workflow.py:52-312 — graph iteration, linking, gates, loop
+semantics)."""
+
+import pytest
+
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import MissingDemands, TrivialUnit, Unit
+from veles_tpu.workflow import Workflow
+
+
+class Recorder(Unit):
+    """Appends its name to a shared trace on each run."""
+
+    def __init__(self, workflow, trace, **kwargs):
+        super(Recorder, self).__init__(workflow, **kwargs)
+        self.trace = trace
+
+    def run(self):
+        self.trace.append(self.name)
+
+
+def build_linear(n=3):
+    wf = Workflow(name="linear")
+    trace = []
+    units = [Recorder(wf, trace, name="u%d" % i) for i in range(n)]
+    units[0].link_from(wf.start_point)
+    for a, b in zip(units, units[1:]):
+        b.link_from(a)
+    wf.end_point.link_from(units[-1])
+    return wf, trace, units
+
+
+class TestControlFlow:
+    def test_linear_chain_runs_in_order(self):
+        wf, trace, _ = build_linear()
+        wf.initialize()
+        wf.run()
+        assert trace == ["u0", "u1", "u2"]
+
+    def test_diamond_waits_for_all_predecessors(self):
+        wf = Workflow(name="diamond")
+        trace = []
+        a = Recorder(wf, trace, name="a")
+        b = Recorder(wf, trace, name="b")
+        c = Recorder(wf, trace, name="c")
+        d = Recorder(wf, trace, name="d")
+        a.link_from(wf.start_point)
+        b.link_from(a)
+        c.link_from(a)
+        d.link_from(b, c)
+        wf.end_point.link_from(d)
+        wf.initialize()
+        wf.run()
+        assert trace[0] == "a" and trace[-1] == "d"
+        assert set(trace[1:3]) == {"b", "c"}
+        assert trace.count("d") == 1
+
+    def test_gate_block_stops_propagation(self):
+        wf, trace, units = build_linear()
+        units[1].gate_block <<= True
+        wf.initialize()
+        wf.run()
+        assert trace == ["u0"]
+        assert not bool(wf.stopped)  # blocked path never reached end_point
+
+    def test_gate_skip_propagates_without_running(self):
+        wf, trace, units = build_linear()
+        units[1].gate_skip <<= True
+        wf.initialize()
+        wf.run()
+        assert trace == ["u0", "u2"]
+
+    def test_repeater_loop_until_decision(self):
+        """The canonical hot loop: repeater -> body -> decision; decision
+        blocks the loop and opens end_point after N iterations
+        (ref workflow run loop, SURVEY §3.1)."""
+        wf = Workflow(name="loop")
+        trace = []
+        rpt = Repeater(wf)
+        body = Recorder(wf, trace, name="body")
+        complete = Bool(False)
+
+        class Decision(Unit):
+            def run(self):
+                if len(trace) >= 5:
+                    complete.set(True)
+
+        dec = Decision(wf)
+        rpt.link_from(wf.start_point)
+        body.link_from(rpt)
+        dec.link_from(body)
+        rpt.link_from(dec)
+        rpt.gate_block = complete
+        wf.end_point.link_from(dec)
+        wf.end_point.gate_block = ~complete
+        wf.initialize()
+        wf.run()
+        assert trace == ["body"] * 5
+        assert bool(wf.stopped)
+
+    def test_external_stop(self):
+        wf = Workflow(name="stoppable")
+        trace = []
+        rpt = Repeater(wf)
+
+        class Stopper(Unit):
+            def run(self):
+                trace.append("x")
+                if len(trace) >= 3:
+                    self.workflow.stop()
+
+        s = Stopper(wf)
+        rpt.link_from(wf.start_point)
+        s.link_from(rpt)
+        rpt.link_from(s)
+        wf.initialize()
+        wf.run()
+        assert len(trace) == 3
+
+
+class TestDataLinks:
+    def test_link_attrs_forwarding(self):
+        wf = Workflow(name="attrs")
+        src = TrivialUnit(wf, name="src")
+        dst = TrivialUnit(wf, name="dst")
+        src.output = 42
+        dst.link_attrs(src, ("input", "output"))
+        assert dst.input == 42
+        src.output = 43
+        assert dst.input == 43
+
+    def test_link_attrs_one_way_write_raises(self):
+        wf = Workflow(name="attrs")
+        src = TrivialUnit(wf, name="src")
+        dst = TrivialUnit(wf, name="dst")
+        src.v = 1
+        dst.link_attrs(src, "v")
+        with pytest.raises(AttributeError):
+            dst.v = 9
+
+    def test_link_attrs_two_way(self):
+        wf = Workflow(name="attrs")
+        src = TrivialUnit(wf, name="src")
+        dst = TrivialUnit(wf, name="dst")
+        src.v = 1
+        dst.link_attrs(src, "v", two_way=True)
+        dst.v = 9
+        assert src.v == 9
+
+
+class TestDemand:
+    def test_demand_satisfied_after_linking(self):
+        wf = Workflow(name="demand")
+
+        class Consumer(Unit):
+            def __init__(self, workflow, **kw):
+                super(Consumer, self).__init__(workflow, **kw)
+                self.demand("minibatch")
+
+        src = TrivialUnit(wf, name="src")
+        con = Consumer(wf, name="con")
+        con.link_from(src)
+        src.link_from(wf.start_point)
+        with pytest.raises(MissingDemands):
+            con.verify_demands()
+        src.out = 5
+        con.link_attrs(src, ("minibatch", "out"))
+        wf.end_point.link_from(con)
+        wf.initialize()  # no raise
+
+    def test_initialize_requeues_until_producer_sets_attr(self):
+        """Producer initialize() sets the attribute consumer demands; consumer
+        appears earlier in insertion order — requeue must resolve it
+        (ref workflow.py partial re-init queue)."""
+        wf = Workflow(name="requeue")
+
+        class Producer(Unit):
+            def initialize(self, **kwargs):
+                self.out = 123
+
+        class Consumer(Unit):
+            def __init__(self, workflow, **kw):
+                super(Consumer, self).__init__(workflow, **kw)
+                self.demand("inp")
+
+        con = Consumer(wf, name="con")
+        pro = Producer(wf, name="pro")
+        con.link_attrs(pro, ("inp", "out"))
+        pro.link_from(wf.start_point)
+        con.link_from(pro)
+        wf.end_point.link_from(con)
+        wf.initialize()
+        assert con.inp == 123
+
+
+class TestWorkflowContainer:
+    def test_getitem_by_name_and_index(self):
+        wf, _, units = build_linear()
+        assert wf["u1"] is units[1]
+        assert wf[wf.units.index(units[2])] is units[2]
+
+    def test_stats_and_graph(self):
+        wf, _, _ = build_linear()
+        wf.initialize()
+        wf.run()
+        dot = wf.generate_graph()
+        assert "digraph" in dot and "u1" in dot
+        rows = wf.print_stats()
+        assert rows
+
+    def test_gather_results(self):
+        wf, _, units = build_linear()
+
+        class Metric(TrivialUnit):
+            def get_metric_values(self):
+                return {"acc": 0.9}
+
+        Metric(wf, name="m")
+        assert wf.gather_results() == {"acc": 0.9}

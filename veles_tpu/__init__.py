@@ -1,0 +1,29 @@
+"""veles_tpu — a TPU-native deep-learning platform with the capability surface of
+Samsung Veles (reference: /root/reference, see SURVEY.md).
+
+A model is a Workflow: a directed graph of Units connected by control links (who
+runs after whom, gated by lazy ``Bool`` conditions) and data links (shared
+attributes).  Unlike the reference — which dispatches one OpenCL/CUDA kernel per
+unit per iteration from a thread pool — the hot loop here is *staged*: the
+forward/backward/update chain of a workflow is compiled into a single jitted XLA
+step function over a ``jax.sharding.Mesh``, so the same workflow runs standalone
+on one chip or SPMD data/tensor-parallel across a pod (the reference's ZeroMQ
+master–slave role, `veles/server.py`/`client.py`, is played by ICI collectives).
+
+Public surface (mirrors reference layers, SURVEY.md §1):
+  veles_tpu.config     — auto-vivifying ``root`` config tree   (ref veles/config.py)
+  veles_tpu.logger     — class-scoped logging + event records  (ref veles/logger.py)
+  veles_tpu.mutable    — lazy Bool gates, LinkableAttribute    (ref veles/mutable.py)
+  veles_tpu.registry   — unit/mapped registries                (ref veles/unit_registry.py)
+  veles_tpu.prng       — reproducible named key streams        (ref veles/prng/)
+  veles_tpu.units      — Unit, control/data links, gates       (ref veles/units.py)
+  veles_tpu.workflow   — Workflow container + staging compiler (ref veles/workflow.py)
+  veles_tpu.ops        — pure-jax compute library              (ref ocl/*.cl, cuda/*.cu, znicz)
+  veles_tpu.models     — layers, GD, StandardWorkflow, Kohonen (ref veles/znicz docs)
+  veles_tpu.loader     — minibatch serving state machine       (ref veles/loader/)
+  veles_tpu.parallel   — mesh, sharding rules, collectives     (ref veles/server.py+client.py)
+  veles_tpu.services   — snapshotter, results, plotting, REST  (ref veles/snapshotter.py etc.)
+"""
+
+__version__ = "0.1.0"
+__root__ = __name__

@@ -1,0 +1,153 @@
+"""Auto-vivifying configuration tree (ref: veles/config.py:60-308).
+
+``root`` is a process-global :class:`Config` tree.  Reading a missing attribute
+vivifies a child node, so workflows can write ``root.mnist.learning_rate = 0.1``
+without declaring the path first.  Layered overrides mirror the reference:
+defaults (this module) < site config < per-run config file < CLI ``--config-list``
+statements — later layers win via :meth:`Config.update`.
+
+Differences from the reference, by design:
+  * precision is expressed as a dtype *policy* (compute/accum/param dtypes) —
+    the reference's Kahan/multipartial ``precision_level`` (veles/config.py:246)
+    maps onto "accumulate in f32 over bf16 inputs" on TPU;
+  * engine.backend defaults to whatever ``jax.devices()`` provides.
+"""
+
+import os
+import pprint
+
+
+class Config(object):
+    """One node of the configuration tree."""
+
+    def __init__(self, path):
+        self.__dict__["_path_"] = path
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (self._path_, name))
+        self.__dict__[name] = child
+        return child
+
+    def __setattr__(self, name, value):
+        self.__dict__[name] = value
+
+    def __delattr__(self, name):
+        del self.__dict__[name]
+
+    def __contains__(self, name):
+        return name in self.__dict__
+
+    def __iter__(self):
+        for k, v in sorted(self.__dict__.items()):
+            if k != "_path_":
+                yield k, v
+
+    def update(self, value):
+        """Deep-merge a dict (or another Config) into this node.
+
+        Mirrors ref veles/config.py:90-116: nested dicts recurse, everything
+        else overwrites the leaf.
+        """
+        if isinstance(value, Config):
+            value = value.as_dict()
+        if not isinstance(value, dict):
+            raise TypeError(
+                "Config.update() takes a dict, got %s" % type(value))
+        for k, v in value.items():
+            if isinstance(v, dict):
+                node = self.__dict__.get(k)
+                if not isinstance(node, Config):
+                    # widening a scalar leaf into a subtree: vivify fresh node
+                    node = Config("%s.%s" % (self._path_, k))
+                    self.__dict__[k] = node
+                node.update(v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def get(self, name, default=None):
+        """Return the attribute if it was explicitly set, else ``default``.
+
+        Unlike plain attribute access this never vivifies a node.
+        """
+        v = self.__dict__.get(name, default)
+        return default if isinstance(v, Config) and not v.as_dict() else v
+
+    def as_dict(self):
+        out = {}
+        for k, v in self:
+            out[k] = v.as_dict() if isinstance(v, Config) else v
+        return out
+
+    def print_(self, indent=0, stream=None):
+        """Pretty-print the subtree (ref veles/config.py:128-149)."""
+        import sys
+        stream = stream or sys.stdout
+        stream.write("%s:\n" % self._path_)
+        pprint.pprint(self.as_dict(), stream=stream)
+
+    def __repr__(self):
+        return "<Config %s: %s>" % (self._path_, self.as_dict())
+
+
+#: The global configuration tree (ref veles/config.py:152).
+root = Config("root")
+
+
+def get(cfg, default=None):
+    """Resolve a config leaf: unset Config nodes collapse to ``default``."""
+    if isinstance(cfg, Config):
+        return default
+    return cfg
+
+
+def _default_dirs():
+    base = os.environ.get("VELES_TPU_HOME",
+                          os.path.join(os.path.expanduser("~"), ".veles_tpu"))
+    return {
+        "base": base,
+        "cache": os.path.join(base, "cache"),
+        "snapshots": os.path.join(base, "snapshots"),
+        "datasets": os.environ.get("VELES_TPU_DATA",
+                                   os.path.join(base, "datasets")),
+    }
+
+
+# Defaults (ref veles/config.py:178-291).
+root.common.update({
+    "dirs": _default_dirs(),
+    "engine": {
+        # "tpu" | "cpu" | "auto": mesh construction consults this
+        "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
+        # dtype policy replacing the reference's precision_type/precision_level
+        "precision": {
+            "compute": "bfloat16",   # MXU-native multiplies
+            "accum": "float32",      # accumulation / loss / optimizer math
+            "param": "float32",      # master weights
+        },
+        # precision_level parity knob: 0 => compute dtype as-is,
+        # 1/2 => force float32 compute (Kahan/multipartial equivalent on TPU)
+        "precision_level": 0,
+    },
+    "random_seed": 1234,
+    "timings": False,
+    "trace": {"run": False},
+    "snapshot": {"interval": 1, "min_interval_seconds": 0, "codec": "gz"},
+    "web": {"host": "0.0.0.0", "port": 8090},
+})
+
+
+def apply_site_config():
+    """Site override chain (ref veles/config.py:294-308): import
+    ``veles_tpu_site_config`` if present and call its ``update(root)``."""
+    try:
+        import veles_tpu_site_config  # noqa: F401
+    except ImportError:
+        return
+    if hasattr(veles_tpu_site_config, "update"):
+        veles_tpu_site_config.update(root)
+
+
+apply_site_config()

@@ -1,0 +1,144 @@
+"""Class-scoped logging with colored console output and structured event
+records (ref: veles/logger.py:59-331).
+
+Every framework object mixes in :class:`Logger` and gets a per-class logger
+plus :meth:`Logger.event` — structured begin/end/single trace records used for
+the event timeline (the reference shipped them to MongoDB `veles.events`,
+logger.py:264-289; here they go to an in-process ring buffer and optionally a
+JSON-lines file, browsable by the web-status service)."""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+
+class TerminalFormatter(logging.Formatter):
+    """ANSI color formatter (ref veles/logger.py:123-160)."""
+
+    COLORS = {
+        logging.DEBUG: "\033[1;37m",
+        logging.INFO: "\033[1;32m",
+        logging.WARNING: "\033[1;33m",
+        logging.ERROR: "\033[1;31m",
+        logging.CRITICAL: "\033[1;35m",
+    }
+    RESET = "\033[0m"
+
+    def __init__(self, colorize=None):
+        super(TerminalFormatter, self).__init__(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S")
+        if colorize is None:
+            colorize = hasattr(sys.stdout, "isatty") and sys.stdout.isatty()
+        self._colorize = colorize
+
+    def format(self, record):
+        msg = super(TerminalFormatter, self).format(record)
+        if self._colorize:
+            color = self.COLORS.get(record.levelno)
+            if color:
+                msg = color + msg + self.RESET
+        return msg
+
+
+class EventStore(object):
+    """Ring buffer + optional JSONL sink for structured trace events."""
+
+    def __init__(self, capacity=65536):
+        self._events = []
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._sink = None
+
+    def open_sink(self, path):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._sink = open(path, "a")
+
+    def add(self, event):
+        with self._lock:
+            self._events.append(event)
+            if len(self._events) > self._capacity:
+                del self._events[:self._capacity // 2]
+            if self._sink is not None:
+                self._sink.write(json.dumps(event) + "\n")
+                self._sink.flush()
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+
+#: process-global event store (the reference used one Mongo session per run)
+events = EventStore()
+
+_setup_done = False
+
+
+def setup_logging(level=logging.INFO, filename=None):
+    """Install the console handler once (ref veles/logger.py:86-121)."""
+    global _setup_done
+    rootlog = logging.getLogger()
+    if not _setup_done:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(TerminalFormatter())
+        rootlog.addHandler(handler)
+        _setup_done = True
+    rootlog.setLevel(level)
+    if filename:
+        path = os.path.abspath(filename)
+        for h in rootlog.handlers:
+            if isinstance(h, logging.FileHandler) and h.baseFilename == path:
+                return  # already attached — don't duplicate lines
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fh = logging.FileHandler(path)
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+        rootlog.addHandler(fh)
+
+
+class Logger(object):
+    """Mixin giving every object a class-scoped logger (ref logger.py:59)."""
+
+    def __init__(self, **kwargs):
+        super(Logger, self).__init__()
+        self._logger_ = logging.getLogger(type(self).__name__)
+
+    @property
+    def logger(self):
+        if not hasattr(self, "_logger_"):
+            self._logger_ = logging.getLogger(type(self).__name__)
+        return self._logger_
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg="", *args):
+        self.logger.exception(msg, *args)
+
+    def event(self, name, etype, **info):
+        """Record a structured trace event (ref veles/logger.py:264-289).
+
+        :param etype: "begin" | "end" | "single"
+        """
+        if etype not in ("begin", "end", "single"):
+            raise ValueError("etype must be begin/end/single, got %r" % etype)
+        record = {"name": name, "cat": type(self).__name__, "type": etype,
+                  "time": time.time()}
+        record.update(info)
+        events.add(record)
+        return record

@@ -1,0 +1,89 @@
+"""Lazy boolean expression graph used for unit gates (ref: veles/mutable.py).
+
+``Bool`` (ref mutable.py:44) is a mutable truth cell that composes lazily:
+``gate = a & ~b`` builds an expression over *live* references to ``a`` and
+``b``, so flipping either source later changes the gate's truth.  Units use
+these for ``gate_block`` / ``gate_skip`` and Decision wiring — all host-side
+control, never traced into XLA."""
+
+
+class Bool(object):
+    __slots__ = ("_value", "_expr", "_name")
+
+    def __init__(self, value=False, _expr=None, _name=None):
+        self._value = bool(value)
+        self._expr = _expr       # callable() -> bool, for derived Bools
+        self._name = _name
+
+    # -- assignment ----------------------------------------------------------
+    def __ilshift__(self, value):
+        """``b <<= True`` — assign a new truth value (ref mutable.py:100)."""
+        if self._expr is not None:
+            raise ValueError("cannot assign to a derived Bool (%s)" % self)
+        self._value = bool(value)
+        return self
+
+    def set(self, value):
+        self.__ilshift__(value)
+
+    # -- evaluation ----------------------------------------------------------
+    def __bool__(self):
+        if self._expr is not None:
+            return self._expr()
+        return self._value
+
+    __nonzero__ = __bool__
+
+    # -- lazy composition ----------------------------------------------------
+    def __and__(self, other):
+        return Bool(_expr=lambda: bool(self) and bool(other), _name="&")
+
+    def __or__(self, other):
+        return Bool(_expr=lambda: bool(self) or bool(other), _name="|")
+
+    def __xor__(self, other):
+        return Bool(_expr=lambda: bool(self) != bool(other), _name="^")
+
+    def __invert__(self):
+        return Bool(_expr=lambda: not bool(self), _name="~")
+
+    def __repr__(self):
+        kind = "derived(%s)" % self._name if self._expr else "value"
+        return "<Bool %s = %s>" % (kind, bool(self))
+
+
+class LinkableAttribute(object):
+    """Descriptor that forwards an attribute to another object's attribute
+    (ref mutable.py:219-353).  ``link(dst, "a", src, "b")`` makes ``dst.a``
+    read/write ``src.b``.  Unit.link_attrs builds on the same mechanism via
+    its own per-instance table; this class serves plain objects."""
+
+    def __init__(self, src, src_attr, two_way=True):
+        self._src = src
+        self._src_attr = src_attr
+        self._two_way = two_way
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return getattr(self._src, self._src_attr)
+
+    def __set__(self, obj, value):
+        if not self._two_way:
+            raise AttributeError(
+                "one-way linked attribute -> %s.%s is read-only"
+                % (type(self._src).__name__, self._src_attr))
+        setattr(self._src, self._src_attr, value)
+
+
+def link(dst, dst_attr, src, src_attr=None, two_way=True):
+    """Install a LinkableAttribute on ``type(dst)`` under ``dst_attr``
+    forwarding to ``src.src_attr`` (ref mutable.py:353).  The descriptor is
+    installed on a per-instance shadow subclass so other instances of the
+    class are unaffected."""
+    src_attr = src_attr or dst_attr
+    cls = type(dst)
+    if not getattr(cls, "_linkable_shadow_", False):
+        cls = type(cls.__name__, (cls,), {"_linkable_shadow_": True})
+        dst.__class__ = cls
+    setattr(cls, dst_attr, LinkableAttribute(src, src_attr, two_way))

@@ -1,0 +1,46 @@
+"""Plumbing units closing and terminating the control graph
+(ref: veles/plumbing.py:17-92)."""
+
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class StartPoint(Unit):
+    """Workflow entry node (ref plumbing.py:44)."""
+
+    def run(self):
+        pass
+
+
+class EndPoint(Unit):
+    """Workflow exit node — running it finishes the workflow
+    (ref plumbing.py:60)."""
+
+    def run(self):
+        self.workflow.on_workflow_finished()
+
+
+class Repeater(Unit):
+    """Loop closer: fires as soon as any predecessor fires
+    (``ignores_gate``), re-entering the hot loop (ref plumbing.py:17)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Repeater, self).__init__(workflow, **kwargs)
+        self.ignores_gate = Bool(True)
+
+
+class FireStarter(Unit):
+    """Resets the ``stopped`` flag of attached units so a finished workflow
+    segment can run again (ref plumbing.py:92)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FireStarter, self).__init__(workflow, **kwargs)
+        self.units_to_fire = []
+
+    def run(self):
+        for unit in self.units_to_fire:
+            stopped = getattr(unit, "stopped", None)
+            if isinstance(stopped, Bool):
+                stopped.set(False)
+            elif stopped is not None:
+                unit.stopped = False

@@ -1,0 +1,119 @@
+"""Reproducible randomness as named key streams (ref: veles/prng/).
+
+The reference enforces bit-reproducibility by globally intercepting
+``numpy.random`` and snapshotting generator state around every draw
+(random_generator.py:49-61).  The TPU-idiomatic equivalent is *counter-based
+key derivation*: each named stream owns a master ``jax.random`` key; every
+draw folds in a monotonically increasing counter, so a stream's entire future
+is determined by ``(seed, counter)`` — two words that pickle into snapshots
+and restore mid-epoch (ref Pickleable RandomGenerator, SURVEY §5 checkpoint
+notes).  Host-side shuffling gets a numpy ``Generator`` seeded from the same
+words.
+
+Usage::
+
+    g = prng.get("loader")        # global registry, like ref prng.get(key)
+    k = g.key()                   # fresh jax key, advances the counter
+    perm = g.numpy().permutation(n)  # host-side draw, advances the counter
+"""
+
+import hashlib
+
+import jax
+import numpy as np
+
+from veles_tpu.config import root
+
+
+class RandomGenerator(object):
+    """One named reproducible stream (ref prng/random_generator.py:64)."""
+
+    def __init__(self, name, seed=None):
+        self.name = name
+        self.seed(seed)
+
+    def seed(self, seed=None):
+        if seed is None:
+            base = root.common.get("random_seed", 1234)
+            # stable per-name offset so streams differ but derive from one seed
+            h = int(hashlib.sha1(self.name.encode()).hexdigest()[:8], 16)
+            seed = (int(base) ^ h) & 0x7FFFFFFF
+        self._seed = int(seed)
+        self._counter = 0
+
+    # -- state (pickled into snapshots) --------------------------------------
+    @property
+    def state(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    @state.setter
+    def state(self, value):
+        self._seed = int(value["seed"])
+        self._counter = int(value["counter"])
+
+    def __getstate__(self):
+        return {"name": self.name, "state": self.state}
+
+    def __setstate__(self, d):
+        self.name = d["name"]
+        self.state = d["state"]
+
+    # -- draws ----------------------------------------------------------------
+    def key(self):
+        """Next jax PRNG key; deterministic in (seed, counter)."""
+        self._counter += 1
+        return jax.random.fold_in(jax.random.key(self._seed), self._counter)
+
+    def numpy(self):
+        """A numpy Generator for the next host-side draw.  Each call returns
+        a *fresh* generator keyed by the advanced counter, so host draws are
+        replayable from (seed, counter) exactly like device draws."""
+        self._counter += 1
+        return np.random.default_rng((self._seed, self._counter))
+
+    def permutation(self, n):
+        return self.numpy().permutation(n)
+
+    def randint(self, low, high, size=None):
+        return self.numpy().integers(low, high, size=size)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self.numpy().normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self.numpy().uniform(low, high, size)
+
+    def fill_normal(self, shape, scale, dtype=np.float32):
+        return self.numpy().normal(0.0, scale, shape).astype(dtype)
+
+    def fill_uniform(self, shape, amp, dtype=np.float32):
+        return self.numpy().uniform(-amp, amp, shape).astype(dtype)
+
+
+_streams = {}
+
+
+def get(name="default"):
+    """Global stream registry (ref prng/random_generator.py ``get(key)``)."""
+    g = _streams.get(name)
+    if g is None:
+        g = _streams[name] = RandomGenerator(name)
+    return g
+
+
+def seed_all(seed):
+    """Reset the base seed and re-seed every existing stream — the CLI
+    ``--random-seed`` entry point (ref __main__.py:483 _seed_random)."""
+    root.common.random_seed = int(seed)
+    for g in _streams.values():
+        g.seed()
+
+
+def states():
+    """Snapshot all stream states (for the Snapshotter)."""
+    return {name: g.state for name, g in _streams.items()}
+
+
+def restore_states(saved):
+    for name, st in saved.items():
+        get(name).state = st

@@ -1,0 +1,51 @@
+"""Class registries (ref: veles/unit_registry.py:51-178, veles/normalization.py:110).
+
+``UnitRegistry`` is a metaclass recording every concrete Unit subclass for
+introspection, the CLI frontend, and the forge/model-zoo.  ``MappedRegistry``
+adds a MAPPING-name → class dictionary used by loaders, normalizers,
+snapshot codecs, and publishers."""
+
+
+class UnitRegistry(type):
+    """Metaclass keeping the set of all registered unit classes
+    (ref unit_registry.py:51)."""
+
+    units = set()
+
+    def __init__(cls, name, bases, clsdict):
+        super(UnitRegistry, cls).__init__(name, bases, clsdict)
+        if not clsdict.get("hide_from_registry", False):
+            UnitRegistry.units.add(cls)
+
+    @staticmethod
+    def find(name):
+        for cls in UnitRegistry.units:
+            if cls.__name__ == name:
+                return cls
+        raise KeyError("no registered unit class named %r" % name)
+
+
+class MappedRegistry(type):
+    """Metaclass building a name→class map per registry family
+    (ref unit_registry.py:178).  Subclass families set ``MAPPING = "name"``
+    on each concrete class; the family root carries ``mapping = {}``."""
+
+    def __init__(cls, name, bases, clsdict):
+        super(MappedRegistry, cls).__init__(name, bases, clsdict)
+        mapping = None
+        for base in cls.__mro__:
+            if "mapping" in base.__dict__:
+                mapping = base.__dict__["mapping"]
+                break
+        if mapping is None:
+            cls.mapping = {}
+            return
+        key = clsdict.get("MAPPING")
+        if key:
+            mapping[key] = cls
+
+    def __getitem__(cls, key):
+        return cls.mapping[key]
+
+    def __contains__(cls, key):
+        return key in cls.mapping

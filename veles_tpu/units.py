@@ -1,0 +1,192 @@
+"""Unit — a node in the workflow control/data graph (ref: veles/units.py).
+
+A Unit mirrors the reference's contract: control links (``link_from``) decide
+*when* it runs, gated by lazy :class:`~veles_tpu.mutable.Bool` conditions
+(``gate_block`` / ``gate_skip`` / ``ignores_gate``, ref units.py:139-141);
+data links (``link_attrs``, ref units.py:638) forward attributes from producer
+units; ``demand()`` (ref units.py:682) declares attributes that must be
+present before ``initialize()``.
+
+Execution-model departure from the reference (deliberate, TPU-first): the
+reference fans every ``run_dependent`` out to a Twisted thread pool
+(units.py:485-505) because each unit dispatches its own device kernel.  Here
+the per-iteration compute of a workflow is staged into a single jitted XLA
+step (see :mod:`veles_tpu.workflow`), so the host graph walk is cheap and runs
+on a single-threaded queue scheduler — which deletes the reference's whole
+locking surface (``_run_lock_``/``_gate_lock_``/deadlock watchdog,
+SURVEY.md §5 "race detection")."""
+
+import time
+
+from veles_tpu.logger import Logger
+from veles_tpu.mutable import Bool
+from veles_tpu.registry import UnitRegistry
+
+
+class Unit(Logger, metaclass=UnitRegistry):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(Unit, self).__init__(**kwargs)
+        self.name = kwargs.get("name", type(self).__name__)
+        #: control links: predecessor Unit -> fired flag (plain bool)
+        self.links_from = {}
+        #: control links: successor Units (set)
+        self.links_to = set()
+        self.gate_block = Bool(False)   # don't run, don't propagate
+        self.gate_skip = Bool(False)    # don't run, do propagate
+        #: run as soon as ANY predecessor fires (Repeater-style loop closers)
+        self.ignores_gate = Bool(False)
+        self._linked_attrs_ = {}
+        self._demanded_ = set()
+        self._initialized = False
+        self.run_count = 0
+        self.run_time = 0.0
+        self.view_group = kwargs.get("view_group", "PLUMBING")
+        self.workflow = workflow
+        if workflow is not None:
+            workflow.add_ref(self)
+
+    # ------------------------------------------------------------------ repr
+    def __repr__(self):
+        return "<%s %r>" % (type(self).__name__, self.name)
+
+    # -------------------------------------------------------- control links
+    def link_from(self, *units):
+        """Run after ``units`` (all of them, unless ``ignores_gate``).
+        Ref units.py:554."""
+        for u in units:
+            self.links_from[u] = False
+            u.links_to.add(self)
+        return self
+
+    def unlink_from(self, *units):
+        for u in units:
+            self.links_from.pop(u, None)
+            u.links_to.discard(self)
+        return self
+
+    def unlink_all(self):
+        for u in list(self.links_from):
+            self.unlink_from(u)
+        for u in list(self.links_to):
+            u.unlink_from(self)
+        return self
+
+    def open_gate(self, src):
+        """Mark the control link from ``src`` fired; return True when the
+        gate opens (all links fired, or ``ignores_gate``).  Ref units.py:524."""
+        if src in self.links_from:
+            self.links_from[src] = True
+        if bool(self.ignores_gate):
+            return True
+        if all(self.links_from.values()):
+            return True
+        return False
+
+    def reset_gate(self):
+        for u in self.links_from:
+            self.links_from[u] = False
+
+    # ----------------------------------------------------------- data links
+    def link_attrs(self, other, *names, two_way=False):
+        """Forward attributes from ``other`` (ref units.py:638).
+
+        Each name is either ``"attr"`` (same name both sides) or a tuple
+        ``("my_name", "other_name")``.  Reads of ``self.my_name`` resolve to
+        ``other.other_name`` live; writes raise unless ``two_way``."""
+        for name in names:
+            if isinstance(name, tuple):
+                mine, theirs = name
+            else:
+                mine = theirs = name
+            if mine in self.__dict__:
+                del self.__dict__[mine]
+            self._linked_attrs_[mine] = (other, theirs, two_way)
+        return self
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        links = self.__dict__.get("_linked_attrs_")
+        if links and name in links:
+            src, theirs, _ = links[name]
+            return getattr(src, theirs)
+        raise AttributeError("%s has no attribute %r" % (self, name))
+
+    def __setattr__(self, name, value):
+        links = self.__dict__.get("_linked_attrs_")
+        if links and name in links:
+            src, theirs, two_way = links[name]
+            if not two_way:
+                raise AttributeError(
+                    "%r is linked one-way from %s.%s" % (name, src, theirs))
+            setattr(src, theirs, value)
+            return
+        object.__setattr__(self, name, value)
+
+    # --------------------------------------------------------------- demand
+    def demand(self, *names):
+        """Declare attributes that must be non-None before initialize()
+        (ref units.py:682)."""
+        self._demanded_.update(names)
+
+    def verify_demands(self):
+        """A demand is satisfied by an established data link (the producer may
+        only materialize the value at run time — the Loader pattern) or by a
+        non-None attribute."""
+        missing = [n for n in self._demanded_
+                   if n not in self._linked_attrs_
+                   and getattr(self, n, None) is None]
+        if missing:
+            raise MissingDemands(self, missing)
+
+    # ------------------------------------------------------------ lifecycle
+    def initialize(self, **kwargs):
+        """Override; called in dependency order by Workflow.initialize."""
+        pass
+
+    def run(self):
+        """Override; one hot-loop step."""
+        pass
+
+    def stop(self):
+        """Called on workflow stop for units holding external resources."""
+        pass
+
+    # called by the Workflow scheduler
+    def _initialize_wrapped(self, **kwargs):
+        self.verify_demands()
+        result = self.initialize(**kwargs)
+        self._initialized = True
+        return result
+
+    def _run_wrapped(self):
+        t0 = time.perf_counter()
+        self.run()
+        dt = time.perf_counter() - t0
+        self.run_count += 1
+        self.run_time += dt
+        return dt
+
+
+class MissingDemands(AttributeError):
+    """Raised when demanded attributes are absent — Workflow.initialize
+    treats it as "requeue this unit" (ref workflow.py:299-345)."""
+
+    def __init__(self, unit, names):
+        super(MissingDemands, self).__init__(
+            "%s demands unset attributes: %s" % (unit, ", ".join(names)))
+        self.unit = unit
+        self.names = names
+
+
+class TrivialUnit(Unit):
+    """Concrete no-op Unit (ref units.py:917)."""
+
+    def run(self):
+        pass
+
+
+class Container(Unit):
+    """A Unit that contains other Units (Workflow base, ref units.py:925)."""
+    hide_from_registry = True

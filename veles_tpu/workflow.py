@@ -1,0 +1,200 @@
+"""Workflow — a Unit container and the host-side scheduler (ref: veles/workflow.py).
+
+Keeps the reference's semantics — units, control links, gates, dependency-
+ordered initialization with partial re-init requeue (ref workflow.py:299-345),
+Repeater-closed hot loop, EndPoint → ``on_workflow_finished`` (ref :347-365),
+per-unit run statistics (ref :763-821), result gathering (ref :823-845) —
+on a single-threaded queue scheduler instead of a Twisted thread pool.
+
+The TPU performance story does NOT come from this graph walk: subclasses
+(e.g. :class:`veles_tpu.models.standard_workflow.StandardWorkflow`) *stage*
+the repeater cycle's compute into one jitted step function, so one scheduler
+iteration costs one XLA dispatch regardless of how many logical units the
+loop contains."""
+
+import collections
+import json
+import time
+
+from veles_tpu.logger import Logger
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import EndPoint, StartPoint
+from veles_tpu.units import Container, MissingDemands, Unit
+
+
+class NoMoreJobs(Exception):
+    """Ref workflow.py:78."""
+
+
+class Workflow(Container):
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super(Workflow, self).__init__(workflow, **kwargs)
+        self._units = []
+        self._by_name = collections.defaultdict(list)
+        self.stopped = Bool(False)
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self._run_time_ = 0.0
+        self.result_file = kwargs.get("result_file")
+
+    # --------------------------------------------------------------- container
+    def add_ref(self, unit):
+        """Register a child unit (ref workflow.py:398)."""
+        if unit is self:
+            return
+        self._units.append(unit)
+        self._by_name[unit.name].append(unit)
+
+    def del_ref(self, unit):
+        if unit in self._units:
+            self._units.remove(unit)
+            self._by_name[unit.name].remove(unit)
+
+    @property
+    def units(self):
+        return list(self._units)
+
+    def __iter__(self):
+        return iter(self._units)
+
+    def __len__(self):
+        return len(self._units)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._units[key]
+        hits = self._by_name.get(key, [])
+        if not hits:
+            raise KeyError(key)
+        return hits[0] if len(hits) == 1 else hits
+
+    # ------------------------------------------------------------- initialize
+    def initialize(self, **kwargs):
+        """Initialize all units in control-dependency order, requeueing units
+        whose ``demand()``-ed attributes are not linked yet
+        (ref workflow.py:299-345)."""
+        order = self._dependency_order()
+        pending = collections.deque(order)
+        passes_without_progress = 0
+        while pending:
+            if passes_without_progress > len(pending):
+                unit = pending[0]
+                unit.verify_demands()  # raises the informative MissingDemands
+                raise RuntimeError("initialize() deadlock at %s" % unit)
+            unit = pending.popleft()
+            try:
+                unit._initialize_wrapped(**kwargs)
+                passes_without_progress = 0
+            except MissingDemands:
+                pending.append(unit)
+                passes_without_progress += 1
+        self._initialized = True
+
+    def _dependency_order(self):
+        """BFS from start_point over control links, then any unreached units
+        in insertion order."""
+        seen = []
+        seen_set = set()
+        queue = collections.deque([self.start_point])
+        while queue:
+            unit = queue.popleft()
+            if unit in seen_set:
+                continue
+            seen.append(unit)
+            seen_set.add(unit)
+            for dst in unit.links_to:
+                if dst not in seen_set:
+                    queue.append(dst)
+        for unit in self._units:
+            if unit not in seen_set:
+                seen.append(unit)
+                seen_set.add(unit)
+        return seen
+
+    # -------------------------------------------------------------------- run
+    def run(self):
+        """Drive the control graph from start_point until EndPoint fires or
+        ``stopped`` is raised externally (ref workflow.py:347-365)."""
+        if not self._initialized:
+            raise RuntimeError("run() before initialize()")
+        self.stopped <<= False
+        for unit in self._units:
+            unit.reset_gate()  # clear stale pulses from a stopped prior run
+        t0 = time.perf_counter()
+        self.event("workflow", "begin")
+        queue = collections.deque([self.start_point])
+        queued = {self.start_point}
+        while queue and not bool(self.stopped):
+            unit = queue.popleft()
+            queued.discard(unit)
+            if bool(unit.gate_block):
+                unit.reset_gate()
+                continue
+            if not bool(unit.gate_skip):
+                unit._run_wrapped()
+            unit.reset_gate()
+            if bool(self.stopped):
+                break
+            for dst in unit.links_to:
+                if dst.open_gate(unit) and dst not in queued:
+                    queue.append(dst)
+                    queued.add(dst)
+        self._run_time_ += time.perf_counter() - t0
+        self.event("workflow", "end")
+        for unit in self._units:
+            unit.stop()
+        if self.result_file:
+            self.write_results(self.result_file)
+
+    def on_workflow_finished(self):
+        """EndPoint callback (ref workflow.py:373)."""
+        self.stopped <<= True
+
+    def stop(self):
+        self.stopped <<= True
+
+    # ------------------------------------------------------------------ stats
+    def print_stats(self, top=5):
+        """Top-N unit run-time table + scheduler efficiency
+        (ref workflow.py:763-821)."""
+        rows = sorted(((u.run_time, u.run_count, u.name) for u in self._units),
+                      reverse=True)[:top]
+        total = sum(u.run_time for u in self._units)
+        self.info("---- unit run-time stats (total %.3fs, wall %.3fs) ----",
+                  total, self._run_time_)
+        for rt, rc, name in rows:
+            if rc:
+                self.info("%-30s %8d runs %10.3fs (%6.2f%%)",
+                          name, rc, rt, 100.0 * rt / max(total, 1e-9))
+        return rows
+
+    # ---------------------------------------------------------------- results
+    def gather_results(self):
+        """Collect metrics from every unit exposing ``get_metric_values()``
+        (IResultProvider, ref workflow.py:823-845)."""
+        results = {}
+        for unit in self._units:
+            getter = getattr(unit, "get_metric_values", None)
+            if getter is not None:
+                results.update(getter())
+        return results
+
+    def write_results(self, path):
+        with open(path, "w") as f:
+            json.dump(self.gather_results(), f, indent=2, default=str)
+
+    # ------------------------------------------------------------------ graph
+    def generate_graph(self):
+        """DOT text of the control graph (ref workflow.py:624)."""
+        lines = ["digraph %s {" % self.name.replace(" ", "_")]
+        ids = {u: "u%d" % i for i, u in enumerate(self._units)}
+        for u, uid in ids.items():
+            lines.append('  %s [label="%s"];' % (uid, u.name))
+        for u in self._units:
+            for dst in u.links_to:
+                if dst in ids:
+                    lines.append("  %s -> %s;" % (ids[u], ids[dst]))
+        lines.append("}")
+        return "\n".join(lines)
