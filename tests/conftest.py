@@ -7,10 +7,20 @@ Must run before jax is imported anywhere."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the session env pins JAX_PLATFORMS to the TPU plugin,
+# but the unit-test suite must run on the virtual 8-device CPU platform
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pytest plugins (jaxtyping) import jax before this conftest, freezing the
+# env snapshot — override through the live config as well (safe while
+# backends are uninitialized)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

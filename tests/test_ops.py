@@ -1,0 +1,242 @@
+"""Golden numeric tests for the ops library — the TPU equivalent of the
+reference's kernel-vs-numpy golden tests (AcceleratedTest pattern,
+SURVEY.md §4): every op is checked against a straightforward numpy
+re-implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.ops import (activations, conv, dropout, linear, losses, lrn,
+                           misc, pooling)
+from veles_tpu.ops.policy import Policy
+
+F32 = Policy(compute=jnp.float32)  # exact-compare policy for golden tests
+RNG = np.random.default_rng(42)
+
+
+class TestLinear:
+    def test_forward_matches_numpy(self):
+        x = RNG.normal(size=(8, 20)).astype(np.float32)
+        w = RNG.normal(size=(20, 10)).astype(np.float32)
+        b = RNG.normal(size=(10,)).astype(np.float32)
+        got = linear.forward({"weights": jnp.array(w), "bias": jnp.array(b)},
+                             jnp.array(x), F32)
+        np.testing.assert_allclose(np.asarray(got), x @ w + b, rtol=1e-5)
+
+    def test_flattens_nd_input(self):
+        x = RNG.normal(size=(4, 5, 5, 2)).astype(np.float32)
+        w = RNG.normal(size=(50, 3)).astype(np.float32)
+        got = linear.forward({"weights": jnp.array(w)}, jnp.array(x), F32)
+        np.testing.assert_allclose(
+            np.asarray(got), x.reshape(4, -1) @ w, rtol=1e-5)
+
+    def test_bf16_policy_accumulates_f32(self):
+        x = jnp.ones((4, 256))
+        w = jnp.ones((256, 8)) * 0.01
+        got = linear.forward({"weights": w}, x, Policy())
+        assert got.dtype == jnp.float32
+        # 256 * 0.01 = 2.56; pure-bf16 accumulation would lose ~1% here
+        np.testing.assert_allclose(np.asarray(got), 2.56, rtol=2e-2)
+
+    def test_init_params(self):
+        p = linear.init_params(prng.RandomGenerator("t", 0), 100, 10)
+        assert p["weights"].shape == (100, 10)
+        assert np.abs(p["weights"]).max() <= 0.1 + 1e-6  # 1/sqrt(100)
+        assert p["bias"].shape == (10,)
+
+
+class TestActivations:
+    def test_scaled_tanh(self):
+        x = np.linspace(-3, 3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(activations.tanh(jnp.array(x))),
+            1.7159 * np.tanh(0.6666 * x), rtol=1e-6)
+
+    def test_veles_relu_is_softplus(self):
+        x = np.array([-5.0, 0.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(activations.relu(jnp.array(x))),
+            np.log1p(np.exp(x)), rtol=1e-5)
+
+    def test_strict_relu(self):
+        x = np.array([-1.0, 2.0], np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(activations.strict_relu(jnp.array(x))), [0.0, 2.0])
+
+    def test_log_is_asinh(self):
+        x = np.array([-2.0, 0.5], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(activations.log(jnp.array(x))), np.arcsinh(x),
+            rtol=1e-6)
+
+    def test_sincos_alternates(self):
+        x = RNG.normal(size=(2, 6)).astype(np.float32)
+        got = np.asarray(activations.sincos(jnp.array(x)))
+        np.testing.assert_allclose(got[:, 0::2], np.sin(x[:, 0::2]), rtol=1e-6)
+        np.testing.assert_allclose(got[:, 1::2], np.cos(x[:, 1::2]), rtol=1e-6)
+
+    def test_registry_complete(self):
+        for name in ("linear", "tanh", "sigmoid", "relu", "strict_relu",
+                     "log", "tanhlog", "sincos"):
+            assert name in activations.ACTIVATIONS
+
+
+class TestConv:
+    def test_valid_conv_matches_manual(self):
+        x = RNG.normal(size=(2, 5, 5, 3)).astype(np.float32)
+        k = RNG.normal(size=(3, 3, 3, 4)).astype(np.float32)
+        got = np.asarray(conv.forward({"weights": jnp.array(k)},
+                                      jnp.array(x), policy=F32))
+        assert got.shape == (2, 3, 3, 4)
+        # manual correlation at output (0,0)
+        want00 = np.einsum("hwc,hwck->k", x[0, :3, :3, :], k)
+        np.testing.assert_allclose(got[0, 0, 0], want00, rtol=1e-4)
+
+    def test_explicit_padding_tuple(self):
+        x = jnp.ones((1, 4, 4, 1))
+        k = jnp.ones((3, 3, 1, 1))
+        y = conv.forward({"weights": k}, x, padding=(1, 1, 1, 1), policy=F32)
+        assert y.shape == (1, 4, 4, 1)
+
+    def test_deconv_inverts_shape(self):
+        x = jnp.ones((1, 4, 4, 2))
+        k = jnp.ones((2, 2, 2, 3))
+        y = conv.forward({"weights": k}, x, stride=(2, 2), policy=F32)
+        assert y.shape == (1, 2, 2, 3)
+        back = conv.deconv_forward(
+            {"weights": jnp.ones((2, 2, 3, 2))}, y, stride=(2, 2),
+            policy=F32)
+        assert back.shape == (1, 4, 4, 2)
+
+
+class TestPooling:
+    x = RNG.normal(size=(2, 4, 4, 3)).astype(np.float32)
+
+    def test_max_pool(self):
+        got = np.asarray(pooling.max_pool(jnp.array(self.x), 2, 2))
+        want = self.x.reshape(2, 2, 2, 2, 2, 3).max(axis=(2, 4))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_avg_pool(self):
+        got = np.asarray(pooling.avg_pool(jnp.array(self.x), 2, 2))
+        want = self.x.reshape(2, 2, 2, 2, 2, 3).mean(axis=(2, 4))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_max_abs_keeps_sign(self):
+        x = np.array([[[[-5.0], [1.0]], [[2.0], [3.0]]]], np.float32)
+        got = np.asarray(pooling.max_abs_pool(jnp.array(x), 2, 2))
+        assert got[0, 0, 0, 0] == -5.0
+
+    def test_stochastic_pool_picks_window_elements(self):
+        key = jax.random.key(0)
+        xs = jnp.array(np.abs(self.x))
+        got = np.asarray(pooling.stochastic_pool(xs, 2, 2, key))
+        # every output must be an element of its window
+        patches = np.abs(self.x).reshape(2, 2, 2, 2, 2, 3)
+        for n in range(2):
+            for i in range(2):
+                for j in range(2):
+                    for c in range(3):
+                        window = patches[n, i, :, j, :, c].ravel()
+                        assert got[n, i, j, c] in window
+
+    def test_stochastic_pool_reproducible(self):
+        key = jax.random.key(7)
+        a = pooling.stochastic_pool(jnp.array(self.x), 2, 2, key,
+                                    absolute=True)
+        b = pooling.stochastic_pool(jnp.array(self.x), 2, 2, key,
+                                    absolute=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stochastic_infer_is_weighted_mean(self):
+        x = np.array([[[[1.0], [3.0]], [[0.0], [0.0]]]], np.float32)
+        got = np.asarray(pooling.stochastic_pool_infer(jnp.array(x), 2, 2))
+        np.testing.assert_allclose(got[0, 0, 0, 0], (1 + 9) / 4.0)
+
+    def test_depool_upsamples(self):
+        y = np.asarray(pooling.depool(jnp.array(self.x), 2, 2))
+        assert y.shape == (2, 8, 8, 3)
+        assert (y[:, ::2, ::2] == self.x).all()
+
+
+class TestLRN:
+    def test_identity_when_alpha_zero(self):
+        x = jnp.array(RNG.normal(size=(1, 2, 2, 8)).astype(np.float32))
+        got = lrn.forward(x, alpha=0.0, beta=0.75, n=3, k=1.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-6)
+
+    def test_matches_manual_window_sum(self):
+        x = RNG.normal(size=(1, 1, 1, 6)).astype(np.float32)
+        got = np.asarray(lrn.forward(jnp.array(x), alpha=0.1, beta=0.5,
+                                     n=3, k=2.0))
+        sq = x[0, 0, 0] ** 2
+        padded = np.concatenate([[0.0], sq, [0.0]])
+        ssum = np.array([padded[i:i + 3].sum() for i in range(6)])
+        want = x[0, 0, 0] * (2.0 + 0.1 * ssum) ** -0.5
+        np.testing.assert_allclose(got[0, 0, 0], want, rtol=1e-5)
+
+
+class TestDropout:
+    def test_train_scales_and_zeroes(self):
+        x = jnp.ones((1000,))
+        y = np.asarray(dropout.forward(x, jax.random.key(0), 0.5))
+        kept = y != 0
+        assert 0.4 < kept.mean() < 0.6
+        np.testing.assert_allclose(y[kept], 2.0)
+
+    def test_reproducible(self):
+        x = jnp.ones((100,))
+        a = dropout.forward(x, jax.random.key(3), 0.3)
+        b = dropout.forward(x, jax.random.key(3), 0.3)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestLosses:
+    def test_softmax_xent_metrics(self):
+        logits = jnp.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+        labels = jnp.array([0, 0])
+        out = losses.softmax_cross_entropy(logits, labels)
+        assert int(out["n_errors"]) == 1
+        assert np.asarray(out["confusion"])[0, 1] == 1
+        assert out["loss"] > 0
+
+    def test_softmax_xent_gradient_flows(self):
+        def loss_fn(w):
+            logits = jnp.array([[1.0, 2.0]]) * w
+            return losses.softmax_cross_entropy(logits,
+                                                jnp.array([0]))["loss"]
+        g = jax.grad(loss_fn)(1.0)
+        assert np.isfinite(float(g)) and float(g) != 0
+
+    def test_mse(self):
+        out = losses.mse(jnp.array([[1.0, 2.0]]), jnp.array([[0.0, 0.0]]))
+        np.testing.assert_allclose(float(out["loss"]), 5.0)
+        np.testing.assert_allclose(float(out["max_err"]), 2.0)
+
+
+class TestMisc:
+    def test_cut(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y = misc.cut(x, 1, 1, 2, 2)
+        assert y.shape == (1, 2, 2, 1)
+        assert float(y[0, 0, 0, 0]) == 5.0
+
+    def test_channel_split_merge_roundtrip(self):
+        x = jnp.array(RNG.normal(size=(2, 3, 3, 4)).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(misc.channel_merge(misc.channel_split(x))),
+            np.asarray(x))
+
+    def test_zero_fill(self):
+        w = jnp.ones((3, 3))
+        mask = jnp.eye(3)
+        np.testing.assert_array_equal(np.asarray(misc.zero_fill(w, mask)),
+                                      np.eye(3))
+
+    def test_input_join(self):
+        a = jnp.ones((2, 3))
+        b = jnp.zeros((2, 2, 2))
+        assert misc.input_join(a, b).shape == (2, 7)

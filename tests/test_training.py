@@ -1,0 +1,157 @@
+"""End-to-end training tests — the round-1 correctness gate
+(SURVEY.md §7 step 4: MLP reaches low validation error, bit-reproducible
+across runs with a fixed seed).
+
+MNIST itself is not available offline; sklearn's bundled digits dataset
+(1797 8×8 images, 10 classes) exercises the identical workflow shape."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+
+
+def digits_data():
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    return x, y
+
+
+def make_workflow(max_epochs=25, seed=1234, snapshotter_config=None):
+    prng.seed_all(seed)
+    x, y = digits_data()
+    loader = FullBatchLoader(
+        None, data=x, labels=y, minibatch_size=100,
+        class_lengths=[0, 297, 1500])
+    return StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 60,
+             "learning_rate": 0.1, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.1, "gradient_moment": 0.9},
+        ],
+        loader=loader,
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snapshotter_config,
+        name="digits-mlp")
+
+
+class TestDigitsMLP:
+    def test_trains_to_low_validation_error(self):
+        wf = make_workflow()
+        wf.initialize()
+        wf.run()
+        val = wf.decision.best_metric
+        assert val is not None and val < 0.08, \
+            "validation error %.3f not < 8%%" % val
+
+    def test_bit_reproducible_with_fixed_seed(self):
+        def run():
+            wf = make_workflow(max_epochs=3, seed=77)
+            wf.initialize()
+            wf.run()
+            return (wf.decision.best_metric,
+                    np.asarray(wf.trainer.params[
+                        wf.trainer.layers[0].name]["weights"]))
+
+        m1, w1 = run()
+        m2, w2 = run()
+        assert m1 == m2
+        np.testing.assert_array_equal(w1, w2)
+
+    def test_forward_fn_serves_probabilities(self):
+        wf = make_workflow(max_epochs=5)
+        wf.initialize()
+        wf.run()
+        fwd = wf.forward_fn()
+        x, y = digits_data()
+        probs = np.asarray(fwd(wf.trainer.params, x[:32]))
+        assert probs.shape == (32, 10)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+        acc = (probs.argmax(axis=1) == y[:32]).mean()
+        assert acc > 0.8
+
+
+class TestSnapshotResume:
+    def test_snapshot_and_resume_continue_training(self, tmp_path):
+        cfg = {"directory": str(tmp_path), "interval": 1, "prefix": "dig"}
+        wf = make_workflow(max_epochs=2, snapshotter_config=cfg)
+        wf.initialize()
+        wf.run()
+        snap_path = wf.snapshotter.destination
+        assert snap_path is not None
+
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        snap = SnapshotterBase.import_(snap_path)
+        assert snap["epoch"] == 2
+
+        wf2 = make_workflow(max_epochs=4, snapshotter_config=cfg)
+        wf2.initialize()
+        wf2.restore(snap)
+        assert wf2.loader.epoch_number == 2
+        wf2.run()
+        assert wf2.loader.epoch_number == 4
+        assert wf2.decision.best_metric < 0.2
+
+    def test_current_symlink(self, tmp_path):
+        cfg = {"directory": str(tmp_path), "interval": 1, "prefix": "dig"}
+        wf = make_workflow(max_epochs=1, snapshotter_config=cfg)
+        wf.initialize()
+        wf.run()
+        import os
+        cur = os.path.join(str(tmp_path), "dig_current")
+        assert os.path.islink(cur)
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        snap = SnapshotterBase.import_(cur)
+        assert "params" in snap and "prng" in snap
+
+
+class TestAutoencoderMSE:
+    def test_mse_autoencoder_reduces_rmse(self):
+        prng.seed_all(5)
+        x, _ = digits_data()
+        loader = FullBatchLoader(
+            None, data=x, minibatch_size=100,
+            class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+                {"type": "all2all", "output_sample_shape": 64,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+            ],
+            loader=loader, loss="mse",
+            decision_config={"max_epochs": 20},
+            name="digits-ae")
+        wf.initialize()
+        wf.run()
+        assert wf.decision.best_metric < 0.25   # per-element RMSE
+
+
+class TestConvWorkflow:
+    def test_small_convnet_trains(self):
+        prng.seed_all(9)
+        x, y = digits_data()
+        x_img = x.reshape(-1, 8, 8, 1)
+        loader = FullBatchLoader(
+            None, data=x_img, labels=y, minibatch_size=100,
+            class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[
+                {"type": "conv_strict_relu", "n_kernels": 8, "kx": 3,
+                 "ky": 3, "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+            ],
+            loader=loader,
+            decision_config={"max_epochs": 25},
+            name="digits-conv")
+        wf.initialize()
+        wf.run()
+        assert wf.decision.best_metric < 0.08

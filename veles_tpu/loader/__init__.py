@@ -1,0 +1,7 @@
+"""Data pipeline (ref: veles/loader/ — SURVEY.md §2.5)."""
+
+from veles_tpu.loader.base import (CLASS_NAMES, TEST, TRAIN, VALID, Loader)
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+__all__ = ["Loader", "FullBatchLoader", "TEST", "VALID", "TRAIN",
+           "CLASS_NAMES"]

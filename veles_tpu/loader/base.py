@@ -1,0 +1,157 @@
+"""Loader — the minibatch-serving state machine (ref: veles/loader/base.py).
+
+Keeps the reference's semantics: three sample classes TEST/VALID/TRAIN
+(ref base.py:72-80), a global offset that walks test → valid → train every
+epoch, per-epoch reshuffle of the train span (ref :711), and the
+``last_minibatch`` / ``epoch_ended`` flags that drive Decision gates
+(ref :862-879).
+
+TPU-first differences: minibatches are *fixed shape* — the trailing partial
+minibatch is padded and a validity mask is emitted instead of shrinking the
+batch (XLA wants static shapes); the loader only produces **indices** (the
+gather happens inside the jitted step against the HBM-resident dataset,
+exactly how the reference's master serves indices to slaves,
+ref base.py:631)."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.mutable import Bool
+from veles_tpu.registry import MappedRegistry
+from veles_tpu.units import Unit, UnitRegistry
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class LoaderRegistry(UnitRegistry, MappedRegistry):
+    """Name → loader class (ref UserLoaderRegistry, loader/base.py:83)."""
+
+
+class Loader(Unit, metaclass=LoaderRegistry):
+    mapping = {}
+
+    def __init__(self, workflow, **kwargs):
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.minibatch_size = kwargs.get("minibatch_size", 100)
+        #: [n_test, n_valid, n_train]
+        self.class_lengths = [0, 0, 0]
+        self.epoch_number = 0
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = Bool(False)
+        #: True when the served minibatch was the final one of its class —
+        #: Decision reads that class's accumulated stats on this signal
+        self.class_ended = Bool(False)
+        self.minibatch_class = TRAIN
+        self.minibatch_indices = None    # int32 [minibatch_size], -1 = pad
+        self.minibatch_valid = None      # float32 [minibatch_size] mask
+        self.minibatch_offset = 0
+        self.shuffle_enabled = kwargs.get("shuffle", True)
+        self.prng = prng.get(kwargs.get("prng_name", "loader"))
+        self._order = None
+
+    # -- to be provided by subclasses ---------------------------------------
+    def load_data(self):
+        """Populate class_lengths (+ dataset payload).  Ref ILoader."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Optional post-load hook (device placement etc.)."""
+
+    # -- derived sizes -------------------------------------------------------
+    @property
+    def total_samples(self):
+        return int(sum(self.class_lengths))
+
+    @property
+    def class_offsets(self):
+        ofs, out = 0, []
+        for ln in self.class_lengths:
+            ofs += int(ln)
+            out.append(ofs)
+        return out  # cumulative end offsets per class
+
+    def class_of_offset(self, offset):
+        for cls, end in enumerate(self.class_offsets):
+            if offset < end:
+                return cls
+        raise ValueError("offset %d beyond dataset" % offset)
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, **kwargs):
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s loaded an empty dataset" % self)
+        if self.minibatch_size > max(self.class_lengths):
+            self.minibatch_size = int(max(self.class_lengths))
+        self.create_minibatch_data()
+        self._reset_order()
+        self.minibatch_offset = 0
+        self.epoch_number = 0
+        self.debug("dataset: %s samples %s",
+                   self.total_samples,
+                   dict(zip(CLASS_NAMES, self.class_lengths)))
+
+    def _reset_order(self):
+        """Identity order for test/valid; reshuffled train span
+        (ref base.py:711 shuffle per epoch)."""
+        order = np.arange(self.total_samples, dtype=np.int32)
+        n_train = self.class_lengths[TRAIN]
+        if self.shuffle_enabled and n_train:
+            start = self.class_offsets[VALID]
+            order[start:] = start + self.prng.permutation(n_train).astype(
+                np.int32)
+        self._order = order
+
+    # -- the hot-loop step ---------------------------------------------------
+    def run(self):
+        """Serve the next minibatch's indices (ref serve_next_minibatch,
+        base.py:726)."""
+        if bool(self.epoch_ended):
+            self.epoch_ended <<= False
+        if bool(self.last_minibatch):
+            self.last_minibatch <<= False
+        if bool(self.class_ended):
+            self.class_ended <<= False
+        offset = self.minibatch_offset
+        cls = self.class_of_offset(offset)
+        end_of_class = self.class_offsets[cls]
+        count = min(self.minibatch_size, end_of_class - offset)
+
+        idx = np.full((self.minibatch_size,), -1, np.int32)
+        idx[:count] = self._order[offset:offset + count]
+        valid = np.zeros((self.minibatch_size,), np.float32)
+        valid[:count] = 1.0
+
+        self.minibatch_class = cls
+        self.minibatch_indices = idx
+        self.minibatch_valid = valid
+        self.minibatch_offset = offset + count
+
+        if self.minibatch_offset >= end_of_class:
+            self.class_ended <<= True
+        if self.minibatch_offset >= self.total_samples:
+            self.last_minibatch <<= True
+            self.epoch_ended <<= True
+            self.epoch_number += 1
+            self.minibatch_offset = 0
+            self._reset_order()
+        self.event("minibatch", "single", cls=CLASS_NAMES[cls],
+                   offset=offset, count=count)
+
+    # -- snapshot state (ref loader position pickled into snapshots) --------
+    @property
+    def state(self):
+        return {"epoch_number": self.epoch_number,
+                "minibatch_offset": self.minibatch_offset,
+                "order": None if self._order is None else self._order.copy()}
+
+    @state.setter
+    def state(self, st):
+        self.epoch_number = st["epoch_number"]
+        self.minibatch_offset = st["minibatch_offset"]
+        if st["order"] is not None:
+            self._order = st["order"].copy()
+
+    def get_metric_values(self):
+        return {"epochs": self.epoch_number}

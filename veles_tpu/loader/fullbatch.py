@@ -1,0 +1,73 @@
+"""FullBatchLoader — whole dataset resident in HBM
+(ref: veles/loader/fullbatch.py:79; gather kernel ocl/fullbatch_loader.cl).
+
+The reference gathered minibatches on-device with a custom kernel
+(`fill_minibatch_data_labels`); here the gather is a ``jnp.take`` *inside*
+the jitted train step, so it fuses with the first layer and the dataset
+never leaves HBM.  Subclasses (or callers) provide numpy arrays; this class
+places them on device once at initialize."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID, Loader
+
+
+class FullBatchLoader(Loader):
+    MAPPING = "full_batch"
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchLoader, self).__init__(workflow, **kwargs)
+        #: numpy source arrays, set by subclass load_data() or kwargs
+        self.original_data = kwargs.get("data")
+        self.original_labels = kwargs.get("labels")
+        self.original_targets = kwargs.get("targets")  # for MSE workflows
+        self._kw_class_lengths = kwargs.get("class_lengths")
+        #: device-resident dataset (jax arrays)
+        self.data = None
+        self.labels = None
+        self.targets = None
+        self.on_device = kwargs.get("on_device", True)
+
+    def load_data(self):
+        if self.original_data is None:
+            raise ValueError("FullBatchLoader needs data= or a subclass "
+                             "overriding load_data()")
+        n = len(self.original_data)
+        if self._kw_class_lengths is not None:
+            self.class_lengths = list(self._kw_class_lengths)
+        elif self.class_lengths == [0, 0, 0]:
+            # default split: 10% validation, rest train (ref loaders often
+            # get explicit validation_ratio; keep a sane default)
+            n_valid = max(1, n // 10)
+            self.class_lengths = [0, n_valid, n - n_valid]
+        if sum(self.class_lengths) != n:
+            raise ValueError("class_lengths %s != %d samples"
+                             % (self.class_lengths, n))
+
+    def create_minibatch_data(self):
+        """One host→device transfer for the whole dataset (ref fullbatch
+        on-device residency, fullbatch.py:164-242)."""
+        if not self.on_device:
+            self.data = np.asarray(self.original_data)
+            self.labels = (None if self.original_labels is None
+                           else np.asarray(self.original_labels))
+            self.targets = (None if self.original_targets is None
+                            else np.asarray(self.original_targets))
+            return
+        self.data = jnp.asarray(self.original_data)
+        if self.original_labels is not None:
+            self.labels = jnp.asarray(np.asarray(self.original_labels)
+                                      .astype(np.int32))
+        if self.original_targets is not None:
+            self.targets = jnp.asarray(self.original_targets)
+
+    @staticmethod
+    def gather(dataset, indices):
+        """Minibatch gather, used inside jitted steps: pad indices (-1)
+        clamp to row 0 — their loss contribution is masked out by
+        ``minibatch_valid``.  (TPU equivalent of
+        ocl/fullbatch_loader.cl:1-50.)"""
+        safe = jnp.maximum(indices, 0)
+        return jnp.take(dataset, safe, axis=0)

@@ -1,0 +1,11 @@
+"""Model layer (ref: the Znicz plugin, SURVEY.md §2.9).
+
+``StandardWorkflow`` is the declarative builder (``layers=[{...}]``) that
+stages forward + evaluator + GD into jitted train/eval steps; ``layers``
+holds the layer-type registry; ``optimizer`` the GD update rules;
+``decision`` the stop-condition unit."""
+
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.layers import LAYER_TYPES, make_layer
+
+__all__ = ["StandardWorkflow", "LAYER_TYPES", "make_layer"]

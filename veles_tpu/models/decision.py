@@ -1,0 +1,116 @@
+"""Decision units — stop conditions and per-epoch bookkeeping
+(ref Znicz DecisionGD / DecisionMSE, SURVEY.md §2.9 "Infrastructure").
+
+Reads the trainer's *device-resident* epoch accumulators only when the
+loader signals ``last_minibatch`` (one host sync per class sweep, not per
+step), tracks the best validation metric, and raises ``complete`` when
+training should stop: ``fail_iterations`` epochs without improvement, or
+``max_epochs`` reached."""
+
+import numpy as np
+
+from veles_tpu.loader.base import CLASS_NAMES, TRAIN, VALID
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class DecisionBase(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(DecisionBase, self).__init__(workflow, **kwargs)
+        self.fail_iterations = kwargs.get("fail_iterations", 100)
+        self.max_epochs = kwargs.get("max_epochs", None)
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.demand("loader", "trainer")
+        self.epoch_metrics = [None, None, None]   # per class
+        self.best_metric = None
+        self.best_epoch = -1
+        self.best_params = None
+        self.epochs_since_improvement = 0
+
+    # metric = "smaller is better" scalar; subclasses extract it
+    def extract_metric(self, stats):
+        raise NotImplementedError
+
+    def run(self):
+        loader = self.loader
+        if not bool(loader.class_ended):
+            return
+        cls = loader.minibatch_class
+        stats = self.trainer.read_class_stats(cls)   # host sync point
+        self.epoch_metrics[cls] = stats
+        if not bool(loader.epoch_ended):
+            return
+        # epoch boundary: decide on validation (fall back to train) metric
+        watch_cls = VALID if loader.class_lengths[VALID] else TRAIN
+        watched = self.epoch_metrics[watch_cls]
+        metric = self.extract_metric(watched) if watched else None
+        self.improved <<= (metric is not None and
+                           (self.best_metric is None or
+                            metric < self.best_metric))
+        if bool(self.improved):
+            self.best_metric = metric
+            self.best_epoch = loader.epoch_number
+            self.epochs_since_improvement = 0
+            self.on_improved()
+        else:
+            self.epochs_since_improvement += 1
+        self._log_epoch(loader)
+        if self.epochs_since_improvement >= self.fail_iterations:
+            self.complete <<= True
+        if (self.max_epochs is not None and
+                loader.epoch_number >= self.max_epochs):
+            self.complete <<= True
+        self.trainer.reset_epoch_stats()
+
+    def on_improved(self):
+        """Hook: e.g. remember best params for the snapshotter."""
+        self.best_params = self.trainer.host_params()
+
+    def _log_epoch(self, loader):
+        parts = []
+        for cls in (TRAIN, VALID):
+            st = self.epoch_metrics[cls]
+            if st:
+                parts.append("%s %s" % (CLASS_NAMES[cls],
+                                        self.format_stats(st)))
+        self.info("epoch %d: %s%s", loader.epoch_number, "; ".join(parts),
+                  " *" if bool(self.improved) else "")
+
+    def format_stats(self, stats):
+        return str(stats)
+
+    def get_metric_values(self):
+        return {"best_metric": self.best_metric,
+                "best_epoch": self.best_epoch,
+                "epoch_metrics": {
+                    CLASS_NAMES[c]: self.epoch_metrics[c]
+                    for c in range(3) if self.epoch_metrics[c]}}
+
+
+class DecisionGD(DecisionBase):
+    """Classification: watches validation error % (ref DecisionGD)."""
+
+    def extract_metric(self, stats):
+        return stats["n_errors"] / max(stats["count"], 1)
+
+    def format_stats(self, stats):
+        return "err %.2f%% (%d/%d) loss %.4f" % (
+            100.0 * stats["n_errors"] / max(stats["count"], 1),
+            stats["n_errors"], stats["count"], stats["loss"])
+
+
+class DecisionMSE(DecisionBase):
+    """Regression/autoencoder: watches validation per-element RMSE
+    (ref DecisionMSE)."""
+
+    def _rmse(self, stats):
+        n_feat = getattr(self.trainer, "output_features", 1)
+        return float(np.sqrt(stats["loss"] /
+                             (max(stats["count"], 1) * n_feat)))
+
+    def extract_metric(self, stats):
+        return self._rmse(stats)
+
+    def format_stats(self, stats):
+        return "rmse %.4f" % self._rmse(stats)

@@ -1,0 +1,282 @@
+"""Layer descriptors — the Znicz layer-type registry
+(ref docs/source/manualrst_veles_workflow_creation.rst:107-150 and the unit
+inventory in manualrst_veles_workflow_parameters.rst:467-504).
+
+A layer descriptor is pure configuration + three pure functions:
+``setup(input_shape)`` infers the static output shape, ``init_params(rng)``
+builds the parameter pytree, ``apply(params, x, train, key)`` is the traced
+forward.  StandardWorkflow composes them into one jitted step — layers are
+*not* units; the per-layer Forward units exist only as introspection
+handles.
+
+Config dicts accept both the reference's flat style
+(``{"type": "all2all_tanh", "output_sample_shape": 100, "learning_rate":
+0.1}``) and its newer split style (``{"type": ..., "->": {forward params},
+"<-": {gd params}}``)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops import activations, conv, dropout, linear, lrn, misc, pooling
+from veles_tpu.ops.policy import default_policy
+
+
+def _flatten_config(cfg):
+    out = dict(cfg)
+    for split_key in ("->", "<-"):
+        sub = out.pop(split_key, None)
+        if sub:
+            out.update(sub)
+    return out
+
+
+class Layer(object):
+    """Base descriptor.  Subclasses set TYPES = {registry names}."""
+
+    TYPES = ()
+    needs_rng = False      # dropout / stochastic pooling want a key
+    has_params = False
+
+    def __init__(self, cfg):
+        cfg = _flatten_config(cfg)
+        self.type = cfg["type"]
+        self.cfg = cfg
+        self.name = cfg.get("name", self.type)
+        # per-layer GD hyperparameters (ref Znicz GD unit kwargs); None
+        # falls back to workflow-level defaults in the optimizer
+        self.gd = {k: cfg[k] for k in
+                   ("learning_rate", "learning_rate_bias", "weights_decay",
+                    "weights_decay_bias", "l1_vs_l2", "gradient_moment",
+                    "gradient_moment_bias") if k in cfg}
+        self.input_shape = None
+        self.output_shape = None
+        self.policy = default_policy()
+
+    def setup(self, input_shape):
+        self.input_shape = tuple(input_shape)
+        self.output_shape = self._infer(self.input_shape)
+        return self.output_shape
+
+    def _infer(self, input_shape):
+        return input_shape
+
+    def init_params(self, rng):
+        return {}
+
+    def apply(self, params, x, train=False, key=None):
+        raise NotImplementedError
+
+    def _activation(self):
+        # longest suffix first: "_strict_relu" must not match "_relu"
+        for suffix in sorted(activations.ACTIVATIONS, key=len, reverse=True):
+            if self.type.endswith("_" + suffix):
+                return activations.ACTIVATIONS[suffix]
+        return activations.ACTIVATIONS["linear"]
+
+
+class All2All(Layer):
+    """Dense family (ref Znicz All2All*, SURVEY §2.9 "Dense").  ``softmax``
+    maps here too: it emits logits; the softmax lives in the evaluator and
+    in the serve-time head."""
+
+    TYPES = ("all2all", "all2all_tanh", "all2all_sigmoid", "all2all_relu",
+             "all2all_strict_relu", "softmax")
+    has_params = True
+
+    def _infer(self, input_shape):
+        oss = self.cfg["output_sample_shape"]
+        self.n_in = int(math.prod(input_shape))
+        if isinstance(oss, int):
+            return (oss,)
+        return tuple(oss)
+
+    def init_params(self, rng):
+        n_out = int(math.prod(self.output_shape))
+        return linear.init_params(
+            rng, self.n_in, n_out, bias=self.cfg.get("include_bias", True),
+            weights_stddev=self.cfg.get("weights_stddev"),
+            dtype=self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        y = linear.forward(params, x, self.policy)
+        y = self._activation()(y)
+        return y.reshape((x.shape[0],) + self.output_shape)
+
+
+class Conv(Layer):
+    """Conv family (ref Znicz Conv*).  NHWC; ``sliding``=(sy, sx) stride;
+    ``padding``=(top, left, bottom, right) explicit pixels."""
+
+    TYPES = ("conv", "conv_tanh", "conv_sigmoid", "conv_relu",
+             "conv_strict_relu")
+    has_params = True
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        self.kx = int(self.cfg["kx"])
+        self.ky = int(self.cfg["ky"])
+        self.n_kernels = int(self.cfg["n_kernels"])
+        self.stride = tuple(self.cfg.get("sliding", (1, 1)))
+        self.padding = tuple(self.cfg.get("padding", (0, 0, 0, 0)))
+        pt, pl, pb, pr = self.padding
+        ho = (h + pt + pb - self.ky) // self.stride[0] + 1
+        wo = (w + pl + pr - self.kx) // self.stride[1] + 1
+        self.n_channels = c
+        return (ho, wo, self.n_kernels)
+
+    def init_params(self, rng):
+        return conv.init_params(
+            rng, self.kx, self.ky, self.n_channels, self.n_kernels,
+            bias=self.cfg.get("include_bias", True),
+            weights_stddev=self.cfg.get("weights_stddev"),
+            dtype=self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        y = conv.forward(params, x, self.stride, self.padding, self.policy)
+        return self._activation()(y)
+
+
+class Deconv(Layer):
+    """Transposed conv (ref Znicz Deconv — conv-autoencoder decoder)."""
+
+    TYPES = ("deconv", "deconv_tanh", "deconv_sigmoid", "deconv_relu")
+    has_params = True
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        self.kx = int(self.cfg["kx"])
+        self.ky = int(self.cfg["ky"])
+        self.n_kernels = int(self.cfg["n_kernels"])
+        self.stride = tuple(self.cfg.get("sliding", (1, 1)))
+        self.n_channels = c
+        ho = (h - 1) * self.stride[0] + self.ky
+        wo = (w - 1) * self.stride[1] + self.kx
+        return (ho, wo, self.n_kernels)
+
+    def init_params(self, rng):
+        return conv.init_params(
+            rng, self.kx, self.ky, self.n_channels, self.n_kernels,
+            bias=self.cfg.get("include_bias", True),
+            weights_stddev=self.cfg.get("weights_stddev"),
+            dtype=self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        y = conv.deconv_forward(params, x, self.stride, "VALID", self.policy)
+        return self._activation()(y)
+
+
+class Pooling(Layer):
+    TYPES = ("max_pooling", "avg_pooling", "maxabs_pooling",
+             "stochastic_pooling", "stochastic_abs_pooling")
+
+    @property
+    def needs_rng(self):
+        return self.type.startswith("stochastic")
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        self.kx = int(self.cfg["kx"])
+        self.ky = int(self.cfg["ky"])
+        self.stride = tuple(self.cfg.get("sliding", (self.ky, self.kx)))
+        ho = (h - self.ky) // self.stride[0] + 1
+        wo = (w - self.kx) // self.stride[1] + 1
+        return (ho, wo, c)
+
+    def apply(self, params, x, train=False, key=None):
+        if self.type == "max_pooling":
+            return pooling.max_pool(x, self.ky, self.kx, self.stride)
+        if self.type == "avg_pooling":
+            return pooling.avg_pool(x, self.ky, self.kx, self.stride)
+        if self.type == "maxabs_pooling":
+            return pooling.max_abs_pool(x, self.ky, self.kx, self.stride)
+        absolute = self.type == "stochastic_abs_pooling"
+        if train:
+            return pooling.stochastic_pool(x, self.ky, self.kx, key,
+                                           self.stride, absolute)
+        return pooling.stochastic_pool_infer(x, self.ky, self.kx,
+                                             self.stride, absolute)
+
+
+class Depooling(Layer):
+    TYPES = ("depooling",)
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        self.kx = int(self.cfg["kx"])
+        self.ky = int(self.cfg["ky"])
+        return (h * self.ky, w * self.kx, c)
+
+    def apply(self, params, x, train=False, key=None):
+        return pooling.depool(x, self.ky, self.kx)
+
+
+class LRN(Layer):
+    """Local response normalization, the "norm" layer type."""
+
+    TYPES = ("norm",)
+
+    def apply(self, params, x, train=False, key=None):
+        return lrn.forward(x, self.cfg.get("alpha", 1e-4),
+                           self.cfg.get("beta", 0.75),
+                           self.cfg.get("n", 15), self.cfg.get("k", 2.0))
+
+
+class Dropout(Layer):
+    TYPES = ("dropout",)
+    needs_rng = True
+
+    def apply(self, params, x, train=False, key=None):
+        if not train:
+            return x
+        return dropout.forward(x, key, self.cfg.get("dropout_ratio", 0.5))
+
+
+class Activation(Layer):
+    """Standalone activation units (ref Znicz activation.*)."""
+
+    TYPES = tuple("activation_" + n for n in activations.ACTIVATIONS)
+
+    def apply(self, params, x, train=False, key=None):
+        name = self.type[len("activation_"):]
+        return activations.ACTIVATIONS[name](x)
+
+
+class Cutter(Layer):
+    TYPES = ("cutter",)
+
+    def _infer(self, input_shape):
+        self.oy, self.ox = self.cfg.get("offset", (0, 0))
+        self.h, self.w = self.cfg["size"]
+        return (self.h, self.w, input_shape[2])
+
+    def apply(self, params, x, train=False, key=None):
+        return misc.cut(x, self.oy, self.ox, self.h, self.w)
+
+
+class ZeroFiller(Layer):
+    """Weight-mask regularizer: masks the *previous* parametric layer's
+    weights after every update (ref Znicz ZeroFiller).  Carries no forward
+    compute."""
+
+    TYPES = ("zerofiller",)
+
+    def apply(self, params, x, train=False, key=None):
+        return x
+
+
+LAYER_TYPES = {}
+for _cls in (All2All, Conv, Deconv, Pooling, Depooling, LRN, Dropout,
+             Activation, Cutter, ZeroFiller):
+    for _t in _cls.TYPES:
+        LAYER_TYPES[_t] = _cls
+
+
+def make_layer(cfg):
+    cfg_flat = _flatten_config(cfg)
+    t = cfg_flat["type"]
+    if t not in LAYER_TYPES:
+        raise KeyError("unknown layer type %r (known: %s)"
+                       % (t, ", ".join(sorted(LAYER_TYPES))))
+    return LAYER_TYPES[t](cfg)

@@ -1,0 +1,211 @@
+"""Staged NN units (replaces the reference's per-unit kernel dispatch).
+
+In the reference every forward/GD unit launched its own kernel per
+iteration (AcceleratedUnit.execute_kernel, SURVEY.md §3.3).  Here
+:class:`StagedTrainer` *stages* the whole forward → loss → backward →
+update chain into two jitted functions (train step, eval step) built once
+at initialize.  Per iteration the host moves only a [minibatch_size] index
+vector to the device; metrics accumulate in device-resident per-class
+accumulators, read back exactly once per class sweep by the Decision unit —
+the hot loop never blocks on device→host sync.
+
+Per-layer ``Forward`` units still exist as introspection/export handles
+(weights live in the trainer's param pytree; they expose views), keeping the
+reference's unit-graph UX without its dispatch cost."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models import optimizer
+from veles_tpu.ops import losses
+from veles_tpu.units import Unit
+
+
+class Forward(Unit):
+    """Introspection handle for one layer (ref Znicz forward units).  Its
+    run() is a no-op — compute happens inside the staged step."""
+
+    def __init__(self, workflow, layer, trainer, **kwargs):
+        kwargs.setdefault("name", layer.name)
+        super(Forward, self).__init__(workflow, **kwargs)
+        self.layer = layer
+        self._trainer = trainer
+        self.view_group = "WORKER"
+
+    @property
+    def weights(self):
+        p = self._trainer.params.get(self.layer.name)
+        return None if p is None else p.get("weights")
+
+    @property
+    def bias(self):
+        p = self._trainer.params.get(self.layer.name)
+        return None if p is None else p.get("bias")
+
+    @property
+    def output_shape(self):
+        return self.layer.output_shape
+
+
+class StagedTrainer(Unit):
+    """Runs the staged train/eval step for the current minibatch.
+
+    Demands (data links from the loader): ``minibatch_indices``,
+    ``minibatch_valid``, ``minibatch_class``."""
+
+    def __init__(self, workflow, layers, loss="softmax", gd_defaults=None,
+                 **kwargs):
+        super(StagedTrainer, self).__init__(workflow, **kwargs)
+        self.layers = layers
+        self.loss = loss
+        self.gd_defaults = gd_defaults or {}
+        self.demand("loader")
+        self.params = {}
+        self.velocity = {}
+        self.class_stats = [None, None, None]  # device accumulators
+        self._step_counter = 0
+        self.train_only_classes = (TRAIN,)
+        self.view_group = "TRAINER"
+
+    # ------------------------------------------------------------ building
+    def initialize(self, **kwargs):
+        loader = self.loader
+        sample_shape = tuple(loader.data.shape[1:])  # no host transfer
+        shape = sample_shape
+        rng = prng.get("weights")
+        hypers = {}
+        for i, layer in enumerate(self.layers):
+            layer.name = "l%02d_%s" % (i, layer.type)
+            shape = layer.setup(shape)
+            if layer.has_params:
+                self.params[layer.name] = {
+                    k: jnp.asarray(v)
+                    for k, v in layer.init_params(rng).items()}
+                hypers[layer.name] = optimizer.resolve_hyper(
+                    layer.gd, self.gd_defaults)
+        self.velocity = optimizer.init_state(self.params)
+        self._hypers = hypers
+        self.output_features = int(np.prod(shape))
+        self._base_key = jax.random.key(
+            int(prng.get("trainer")._seed))
+        self.reset_epoch_stats()
+        self._build_steps()
+
+    def _forward(self, params, x, train, key):
+        for i, layer in enumerate(self.layers):
+            lkey = (jax.random.fold_in(key, i)
+                    if (train and layer.needs_rng) else None)
+            x = layer.apply(params.get(layer.name), x, train=train, key=lkey)
+        return x
+
+    def _loss_and_stats(self, params, data, labels, targets, idx, valid,
+                        train, key):
+        x = FullBatchLoader.gather(data, idx)
+        out = self._forward(params, x, train, key)
+        if self.loss == "softmax":
+            lbl = FullBatchLoader.gather(labels, idx)
+            loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
+                out, lbl, valid)
+            n_features = 1
+        else:  # mse
+            tgt = FullBatchLoader.gather(targets, idx)
+            loss_sum, n_valid, n_features = losses.masked_mse(
+                out, tgt, valid)
+            err_sum = jnp.asarray(0.0)
+        # optimized loss is per-element mean (keeps lr scale comparable
+        # across output widths); stats carry the raw sum for epoch metrics
+        denom = jnp.maximum(n_valid, 1.0) * n_features
+        return loss_sum / denom, {"loss": loss_sum, "n_errors": err_sum,
+                                  "count": n_valid}
+
+    def _build_steps(self):
+        loader = self.loader
+        labels = (loader.labels if loader.labels is not None
+                  else jnp.zeros((loader.total_samples,), jnp.int32))
+        targets = loader.targets
+        if self.loss == "mse" and targets is None:
+            targets = loader.data   # autoencoder: reconstruct the input
+        hypers = self._hypers
+
+        def train_step(params, velocity, acc, data, labels, targets, idx,
+                       valid, step):
+            key = jax.random.fold_in(self._base_key, step)
+
+            def loss_fn(p):
+                loss, stats = self._loss_and_stats(
+                    p, data, labels, targets, idx, valid, True, key)
+                return loss, stats
+
+            grads, stats = jax.grad(loss_fn, has_aux=True)(params)
+            params, velocity = optimizer.update(params, grads, velocity,
+                                                hypers)
+            acc = jax.tree_util.tree_map(jnp.add, acc, stats)
+            return params, velocity, acc
+
+        def eval_step(params, acc, data, labels, targets, idx, valid):
+            _, stats = self._loss_and_stats(
+                params, data, labels, targets, idx, valid, False,
+                jax.random.key(0))
+            return jax.tree_util.tree_map(jnp.add, acc, stats)
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+        self._labels_dev = labels
+        self._targets_dev = (targets if targets is not None
+                             else jnp.zeros((1,), jnp.float32))
+
+    # ------------------------------------------------------------- hot loop
+    def run(self):
+        loader = self.loader
+        cls = loader.minibatch_class
+        idx = jnp.asarray(loader.minibatch_indices)
+        valid = jnp.asarray(loader.minibatch_valid)
+        if cls in self.train_only_classes:
+            self._step_counter += 1
+            self.params, self.velocity, self.class_stats[cls] = \
+                self._train_step(self.params, self.velocity,
+                                 self.class_stats[cls], loader.data,
+                                 self._labels_dev, self._targets_dev, idx,
+                                 valid, self._step_counter)
+        else:
+            self.class_stats[cls] = self._eval_step(
+                self.params, self.class_stats[cls], loader.data,
+                self._labels_dev, self._targets_dev, idx, valid)
+
+    # ------------------------------------------------------------- metrics
+    def _zero_stats(self):
+        return {"loss": jnp.zeros(()), "n_errors": jnp.zeros(()),
+                "count": jnp.zeros(())}
+
+    def reset_epoch_stats(self):
+        self.class_stats = [self._zero_stats() for _ in range(3)]
+
+    def read_class_stats(self, cls):
+        """Device→host sync — called once per class sweep by Decision."""
+        st = jax.device_get(self.class_stats[cls])
+        return {"loss": float(st["loss"]),
+                "n_errors": int(st["n_errors"]),
+                "count": int(st["count"])}
+
+    # ---------------------------------------------------------- inspection
+    def host_params(self):
+        return jax.device_get(self.params)
+
+    def load_params(self, host_params, host_velocity=None):
+        self.params = jax.tree_util.tree_map(jnp.asarray, host_params)
+        if host_velocity is not None:
+            self.velocity = jax.tree_util.tree_map(jnp.asarray,
+                                                   host_velocity)
+
+    def forward_fn(self):
+        """Jitted serve-time forward (softmax applied for classifiers)."""
+        def fwd(params, x):
+            out = self._forward(params, x, False, jax.random.key(0))
+            if self.loss == "softmax":
+                out = jax.nn.softmax(out.astype(jnp.float32))
+            return out
+        return jax.jit(fwd)

@@ -1,0 +1,77 @@
+"""Gradient-descent update rules (ref Znicz GradientDescent family,
+SURVEY.md §2.9 — GD/GDTanh/GDSoftmax etc. collapse into ``jax.grad`` over
+the staged loss; what remains of them is the *update rule* with the
+reference's hyperparameter surface: per-layer learning_rate / weights_decay
+/ l1_vs_l2 mixing / gradient_moment (momentum), with separate bias values).
+
+The update matches Veles GD semantics:
+    reg     = (1 - l1_vs_l2) * w + l1_vs_l2 * sign(w)
+    v       = gradient_moment * v - lr * (grad + weights_decay * reg)
+    w      += v
+"""
+
+import jax
+import jax.numpy as jnp
+
+DEFAULTS = {
+    "learning_rate": 0.01,
+    "learning_rate_bias": None,      # None -> same as learning_rate
+    "weights_decay": 0.0,
+    "weights_decay_bias": None,
+    "l1_vs_l2": 0.0,                 # 0 = pure L2, 1 = pure L1
+    "gradient_moment": 0.0,
+    "gradient_moment_bias": None,
+}
+
+
+def resolve_hyper(layer_gd, workflow_gd=None):
+    """Merge per-layer GD kwargs over workflow defaults over DEFAULTS, and
+    resolve the *_bias fallbacks."""
+    h = dict(DEFAULTS)
+    if workflow_gd:
+        h.update({k: v for k, v in workflow_gd.items() if k in DEFAULTS})
+    h.update({k: v for k, v in layer_gd.items() if k in DEFAULTS})
+    for k in ("learning_rate", "weights_decay", "gradient_moment"):
+        if h[k + "_bias"] is None:
+            h[k + "_bias"] = h[k]
+    return h
+
+
+def init_state(params):
+    """Momentum velocity pytree, zeros like params."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def _update_leaf(w, g, v, lr, wd, l1, moment):
+    reg = (1.0 - l1) * w + l1 * jnp.sign(w)
+    v_new = moment * v - lr * (g + wd * reg)
+    return w + v_new, v_new
+
+
+def update_layer(params, grads, velocity, hyper, lr_scale=1.0):
+    """Apply the GD rule to one layer's param dict ({'weights', 'bias'?})."""
+    new_p, new_v = {}, {}
+    for name in params:
+        bias = name == "bias"
+        w, g, v = params[name], grads[name], velocity[name]
+        p2, v2 = _update_leaf(
+            w, g.astype(w.dtype), v,
+            lr_scale * (hyper["learning_rate_bias"] if bias
+                        else hyper["learning_rate"]),
+            hyper["weights_decay_bias"] if bias else hyper["weights_decay"],
+            hyper["l1_vs_l2"],
+            hyper["gradient_moment_bias"] if bias
+            else hyper["gradient_moment"])
+        new_p[name], new_v[name] = p2, v2
+    return new_p, new_v
+
+
+def update(params, grads, velocity, hypers, lr_scale=1.0):
+    """Whole-model update.  ``params`` is {layer_name: {param: array}};
+    ``hypers`` is {layer_name: resolved hyper dict}."""
+    new_params, new_vel = {}, {}
+    for lname in params:
+        new_params[lname], new_vel[lname] = update_layer(
+            params[lname], grads[lname], velocity[lname], hypers[lname],
+            lr_scale)
+    return new_params, new_vel

@@ -1,0 +1,89 @@
+"""StandardWorkflow — the declarative model builder
+(ref: docs/source/manualrst_veles_workflow_creation.rst:107-150; Znicz
+StandardWorkflow with its link_repeater/link_loader/link_forwards/
+link_evaluator/link_decision/link_snapshotter/link_gds/link_loop steps).
+
+Given ``layers=[{...}]`` and a loader, it wires the canonical hot loop
+
+    start → repeater → loader → trainer → decision → [snapshotter] → repeater
+                                             └→ end_point (gated on complete)
+
+where ``trainer`` is the :class:`~veles_tpu.models.nn_units.StagedTrainer`
+holding the whole forward/backward/update chain as jitted XLA steps — the
+reference's per-layer forward and GD units appear as introspection
+``Forward`` handles only."""
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.models.decision import DecisionGD, DecisionMSE
+from veles_tpu.models.layers import make_layer
+from veles_tpu.models.nn_units import Forward, StagedTrainer
+from veles_tpu.plumbing import Repeater
+from veles_tpu.services.snapshotter import TrainingSnapshotter
+from veles_tpu.workflow import Workflow
+
+
+class StandardWorkflow(Workflow):
+    def __init__(self, workflow=None, layers=None, loader=None,
+                 loss="softmax", decision_config=None, snapshotter_config=None,
+                 gd_defaults=None, **kwargs):
+        super(StandardWorkflow, self).__init__(workflow, **kwargs)
+        if not layers:
+            raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
+        self.layer_configs = layers
+        self.loss = loss
+
+        self.repeater = Repeater(self)
+        self.loader = self._make_loader(loader)
+        self.trainer = StagedTrainer(self, [make_layer(c) for c in layers],
+                                     loss=loss, gd_defaults=gd_defaults)
+        self.trainer.loader = self.loader
+        self.forwards = [Forward(self, lay, self.trainer)
+                         for lay in self.trainer.layers]
+
+        decision_cls = DecisionGD if loss == "softmax" else DecisionMSE
+        self.decision = decision_cls(self, **(decision_config or {}))
+        self.decision.loader = self.loader
+        self.decision.trainer = self.trainer
+
+        # control graph (ref link_* steps)
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.decision.link_from(self.trainer)
+        tail = self.decision
+        if snapshotter_config is not None:
+            self.snapshotter = TrainingSnapshotter(self,
+                                                   **snapshotter_config)
+            self.snapshotter.trainer = self.trainer
+            self.snapshotter.loader = self.loader
+            self.snapshotter.decision = self.decision
+            self.snapshotter.link_from(self.decision)
+            self.snapshotter.gate_skip = ~self.loader.epoch_ended
+            tail = self.snapshotter
+        else:
+            self.snapshotter = None
+        self.repeater.link_from(tail)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(tail)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def _make_loader(self, loader):
+        if isinstance(loader, Loader):
+            if loader.workflow is not self:
+                self.add_ref(loader)
+                loader.workflow = self
+            return loader
+        if isinstance(loader, dict):
+            cfg = dict(loader)
+            name = cfg.pop("name")
+            return Loader.mapping[name](self, **cfg)
+        raise TypeError("loader must be a Loader instance or "
+                        "{'name': ..., **kwargs} dict")
+
+    # ------------------------------------------------------------- serving
+    def forward_fn(self):
+        """Jitted inference function (params, x) -> probabilities/output."""
+        return self.trainer.forward_fn()
+
+    def restore(self, snapshot):
+        TrainingSnapshotter.restore(self, snapshot)

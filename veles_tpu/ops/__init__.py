@@ -1,0 +1,19 @@
+"""Pure-JAX compute library — the TPU-native replacement for the reference's
+kernel library (`ocl/*.cl`, `cuda/*.cu`) and the Znicz unit math
+(SURVEY.md §2.4, §2.9).
+
+Every function here is jittable, shape-static, and dtype-policy aware:
+matmuls/convs run in the policy's compute dtype (bfloat16 on the MXU) with
+float32 accumulation — the TPU equivalent of the reference's Kahan /
+multipartial ``PRECISION_LEVEL`` compensated summation
+(`ocl/matrix_multiplication_subsum.cl:36-62`)."""
+
+from veles_tpu.ops.policy import Policy, default_policy
+from veles_tpu.ops import (activations, conv, dropout, linear, losses, lrn,
+                           misc, pooling)
+
+__all__ = [
+    "Policy", "default_policy",
+    "activations", "conv", "dropout", "linear", "losses", "lrn", "misc",
+    "pooling",
+]

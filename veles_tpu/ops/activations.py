@@ -1,0 +1,73 @@
+"""Activation functions with the reference's exact formulas
+(Znicz activation units, SURVEY.md §2.9 "Activations").
+
+Note the Veles quirks preserved for parity:
+  * ``All2AllTanh`` is the scaled LeCun tanh 1.7159·tanh(0.6666·x);
+  * Veles ``RELU`` is the *smooth* log(1+exp(x)) (softplus);
+    ``StrictRELU`` is max(0, x).
+All are elementwise — XLA fuses them into the preceding matmul/conv, so
+they cost no extra HBM round-trip."""
+
+import jax
+import jax.numpy as jnp
+
+
+def tanh(x):
+    """Scaled LeCun tanh (Veles All2AllTanh / ConvTanh forward)."""
+    return 1.7159 * jnp.tanh(0.6666 * x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def relu(x):
+    """Veles 'RELU' = softplus (smooth), numerically-stable form."""
+    return jax.nn.softplus(x)
+
+
+def strict_relu(x):
+    """Veles 'StrictRELU' = the usual max(0, x)."""
+    return jnp.maximum(x, 0.0)
+
+
+def log(x):
+    """Veles ActivationLog: log(x + sqrt(x^2 + 1)) = asinh(x)."""
+    return jnp.arcsinh(x)
+
+
+def tanhlog(x):
+    """Veles ActivationTanhLog: scaled tanh below a threshold, log above —
+    keeps gradients alive for large |x|."""
+    d = 3.0
+    a = 0.242528761112
+    b = 305.459953195
+    tan = 1.7159 * jnp.tanh(x * 0.6666)
+    lg = jnp.log(jnp.abs(x) * b + 1.0) * a * jnp.sign(x)
+    return jnp.where(jnp.abs(x) <= d, tan, lg)
+
+
+def sincos(x):
+    """Veles ActivationSinCos: even indices -> sin, odd -> cos."""
+    flat = x.reshape(x.shape[0], -1)
+    idx = jnp.arange(flat.shape[1])
+    out = jnp.where(idx % 2 == 0, jnp.sin(flat), jnp.cos(flat))
+    return out.reshape(x.shape)
+
+
+def mul(x, y):
+    """Veles ActivationMul: elementwise product of two inputs."""
+    return x * y
+
+
+#: name → fn registry used by StandardWorkflow layer-type suffixes
+ACTIVATIONS = {
+    "linear": lambda x: x,
+    "tanh": tanh,
+    "sigmoid": sigmoid,
+    "relu": relu,
+    "strict_relu": strict_relu,
+    "log": log,
+    "tanhlog": tanhlog,
+    "sincos": sincos,
+}

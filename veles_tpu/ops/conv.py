@@ -1,0 +1,65 @@
+"""Convolution / deconvolution ops (ref Znicz Conv*/Deconv units,
+SURVEY.md §2.9 "Conv").
+
+Layout is NHWC with HWIO kernels — the TPU-preferred layout (channels on the
+lane dimension feeds the MXU directly).  The reference unrolled conv into its
+tiled matmul kernel; XLA's conv emitter owns that on TPU."""
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from veles_tpu.ops.policy import Policy
+
+DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def forward(params, x, stride=(1, 1), padding="VALID", policy=Policy()):
+    """Conv forward: x [N,H,W,C] * kernel [kh,kw,C,K] + bias [K].
+
+    ``padding`` accepts "VALID"/"SAME" or the reference's explicit
+    (pad_top, pad_left, pad_bottom, pad_right) tuple."""
+    pad = _padding(padding)
+    # no preferred_element_type: its VJP rejects mixed dtypes; the MXU still
+    # accumulates bf16 products in f32 internally, we upcast the result
+    y = lax.conv_general_dilated(
+        policy.cast_in(x), policy.cast_in(params["weights"]),
+        window_strides=stride, padding=pad, dimension_numbers=DIMS)
+    y = y.astype(policy.accum)
+    if "bias" in params:
+        y = y + params["bias"].astype(policy.accum)
+    return y
+
+
+def deconv_forward(params, x, stride=(1, 1), padding="VALID",
+                   policy=Policy()):
+    """Deconv (transposed conv) forward (ref Znicz Deconv; the decoder half
+    of conv autoencoders)."""
+    pad = _padding(padding)
+    y = lax.conv_transpose(
+        policy.cast_in(x), policy.cast_in(params["weights"]),
+        strides=stride, padding=pad, dimension_numbers=DIMS)
+    y = y.astype(policy.accum)
+    if "bias" in params:
+        y = y + params["bias"].astype(policy.accum)
+    return y
+
+
+def _padding(padding):
+    if isinstance(padding, str):
+        return padding
+    pt, pl, pb, pr = padding
+    return ((pt, pb), (pl, pr))
+
+
+def init_params(rng, kx, ky, n_channels, n_kernels, bias=True,
+                weights_stddev=None, dtype=jnp.float32):
+    """Filler matching the dense default: uniform [-s, s],
+    s = 1/sqrt(fan_in)."""
+    fan_in = kx * ky * n_channels
+    s = weights_stddev if weights_stddev is not None else fan_in ** -0.5
+    params = {"weights":
+              rng.fill_uniform((ky, kx, n_channels, n_kernels), s)
+              .astype(dtype)}
+    if bias:
+        params["bias"] = jnp.zeros((n_kernels,), dtype)
+    return params

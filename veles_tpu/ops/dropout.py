@@ -1,0 +1,14 @@
+"""Dropout (ref Znicz DropoutForward/Backward).
+
+Inverted dropout: train-time mask scaled by 1/(1-p) so inference is the
+identity.  The key comes from the unit's named PRNG stream, keeping runs
+bit-reproducible (ref reproducibility contract, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def forward(x, key, dropout_ratio=0.5):
+    keep = 1.0 - dropout_ratio
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

@@ -1,0 +1,39 @@
+"""Dense (All2All) ops — the TPU equivalent of the reference's tiled matmul
+kernel family (`ocl/matrix_multiplication*.cl`, `ocl/gemm.cl`) and the Znicz
+All2All forward/backward units (SURVEY.md §2.9 "Dense").
+
+The reference hand-tiled gemm with autotuned BLOCK_SIZE per device
+(`veles/backends.py:672-731`); XLA owns that job on TPU — these are plain
+``jnp.dot`` calls shaped for the MXU with float32 accumulation."""
+
+import jax.numpy as jnp
+
+from veles_tpu.ops.policy import Policy
+
+
+def matmul(a, b, policy=Policy()):
+    """MXU matmul with compute-dtype inputs and accum-dtype output — the
+    gemm primitive (ref ocl/gemm.cl signature αAB+βC collapses to XLA)."""
+    return jnp.dot(policy.cast_in(a), policy.cast_in(b),
+                   preferred_element_type=policy.accum)
+
+
+def forward(params, x, policy=Policy()):
+    """All2All forward: y = x W + b (ref Znicz all2all; weights stored
+    (in, out) so the batch dim rides the MXU rows)."""
+    y = matmul(x.reshape(x.shape[0], -1), params["weights"], policy)
+    if "bias" in params:
+        y = y + params["bias"].astype(policy.accum)
+    return y
+
+
+def init_params(rng, n_in, n_out, bias=True, weights_stddev=None,
+                dtype=jnp.float32):
+    """Weight filler matching the reference's default scheme: uniform in
+    [-s, s] with s = 1/sqrt(n_in) unless overridden (Znicz
+    ``weights_stddev`` parameter)."""
+    s = weights_stddev if weights_stddev is not None else n_in ** -0.5
+    params = {"weights": rng.fill_uniform((n_in, n_out), s).astype(dtype)}
+    if bias:
+        params["bias"] = jnp.zeros((n_out,), dtype)
+    return params

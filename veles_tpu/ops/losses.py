@@ -1,0 +1,57 @@
+"""Evaluators (ref Znicz EvaluatorSoftmax / EvaluatorMSE, SURVEY.md §2.9).
+
+The reference's evaluators produce ``err_output`` consumed by hand-written
+GD backward units; here the loss scalar feeds ``jax.grad`` and the metric
+outputs (n_errors, confusion matrix, max-err) feed the Decision unit."""
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, n_classes=None):
+    """Mean NLL over the batch + error count + confusion matrix.
+
+    :param labels: int class ids [N]
+    :returns: dict(loss, n_errors, confusion, predictions)
+    """
+    n_classes = n_classes or logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    pred = jnp.argmax(logits, axis=-1)
+    errors = (pred != labels).astype(jnp.int32)
+    confusion = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+        labels, pred].add(1)
+    return {"loss": nll.mean(), "n_errors": errors.sum(),
+            "confusion": confusion, "predictions": pred}
+
+
+def mse(output, target):
+    """Mean squared error + per-batch RMSE metrics (EvaluatorMSE)."""
+    diff = (output - target).astype(jnp.float32).reshape(output.shape[0], -1)
+    se = jnp.sum(diff * diff, axis=1)
+    return {"loss": se.mean(),
+            "rmse": jnp.sqrt(jnp.mean(diff * diff)),
+            "max_err": jnp.max(jnp.abs(diff))}
+
+
+def masked_softmax_xent(logits, labels, valid):
+    """Masked-batch softmax cross-entropy sums, for fixed-shape minibatches
+    with padded tails (``valid`` is the loader's 0/1 mask).
+
+    :returns: (nll_sum, err_sum, n_valid) — all float32 scalars.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    pred = jnp.argmax(logits, axis=-1)
+    err_sum = jnp.sum((pred != labels).astype(jnp.float32) * valid)
+    return jnp.sum(nll * valid), err_sum, jnp.sum(valid)
+
+
+def masked_mse(output, target, valid):
+    """Masked-batch summed squared error.
+
+    :returns: (se_sum, n_valid, n_features).
+    """
+    diff = (output - target).astype(jnp.float32).reshape(output.shape[0], -1)
+    se = jnp.sum(diff * diff, axis=1)
+    return jnp.sum(se * valid), jnp.sum(valid), diff.shape[1]

@@ -1,0 +1,17 @@
+"""Local response normalization across channels (ref Znicz
+LRNormalizerForward/Backward, the "norm" layer type — AlexNet-style).
+
+y = x / (k + alpha * sum_{j in [c-n/2, c+n/2]} x_j^2) ** beta
+
+Defaults match the Veles unit (alpha=1e-4, beta=0.75, n=15, k=2)."""
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def forward(x, alpha=1e-4, beta=0.75, n=15, k=2.0):
+    sq = x * x
+    # sliding-window sum over the channel axis with SAME padding
+    window = (1, 1, 1, n)
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), "SAME")
+    return x * (k + alpha * ssum) ** (-beta)

@@ -1,0 +1,32 @@
+"""Topology helper ops (ref Znicz Cutter, ChannelSplitter/ChannelMerger,
+ZeroFiller — SURVEY.md §2.9 "Regularization/topology")."""
+
+import jax.numpy as jnp
+
+
+def cut(x, offset_y, offset_x, height, width):
+    """Cutter: static crop of an NHWC tensor (GDCutter is jax.grad's job)."""
+    return x[:, offset_y:offset_y + height, offset_x:offset_x + width, :]
+
+
+def channel_split(x):
+    """ChannelSplitter: NHWC -> list of per-channel NHW1 tensors."""
+    return [x[..., c:c + 1] for c in range(x.shape[-1])]
+
+
+def channel_merge(channels):
+    """ChannelMerger: inverse of channel_split."""
+    return jnp.concatenate(channels, axis=-1)
+
+
+def zero_fill(weights, mask):
+    """ZeroFiller: force a fixed sparsity pattern on a weight tensor; applied
+    after every update so masked weights stay exactly zero."""
+    return weights * mask
+
+
+def input_join(*tensors):
+    """InputJoiner: concat along the feature axis (ref veles/input_joiner.py
+    + ocl/join.jcl — on TPU a plain concatenate XLA fuses)."""
+    flat = [t.reshape(t.shape[0], -1) for t in tensors]
+    return jnp.concatenate(flat, axis=1)

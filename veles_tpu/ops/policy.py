@@ -1,0 +1,40 @@
+"""Precision policy (replaces ref precision_type/precision_level,
+veles/config.py:246-248).
+
+The reference offered double/float plus software-compensated summation
+(Kahan=level 1, multipartial=level 2) inside its hand-written matmul kernels.
+On TPU the idiomatic mapping is a dtype policy: inputs/weights in a compute
+dtype (bfloat16 → MXU-native), accumulation and optimizer math in float32
+(``preferred_element_type``), master params in float32.  ``precision_level``
+>= 1 forces float32 compute — the "more precise, slower" knob with the same
+contract as the reference's levels."""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from veles_tpu.config import root
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    compute: jnp.dtype = jnp.bfloat16
+    accum: jnp.dtype = jnp.float32
+    param: jnp.dtype = jnp.float32
+
+    def cast_in(self, x):
+        return x.astype(self.compute)
+
+    def cast_out(self, x):
+        return x.astype(self.accum)
+
+
+def default_policy():
+    prec = root.common.engine.precision
+    level = root.common.engine.get("precision_level", 0)
+    compute = jnp.dtype(prec.get("compute", "bfloat16"))
+    if level >= 1:
+        compute = jnp.dtype("float32")
+    return Policy(compute=compute,
+                  accum=jnp.dtype(prec.get("accum", "float32")),
+                  param=jnp.dtype(prec.get("param", "float32")))

@@ -1,0 +1,88 @@
+"""Pooling ops (ref Znicz Max/Avg/MaxAbs/Stochastic pooling units,
+SURVEY.md §2.9 "Pooling").  NHWC layout.
+
+MaxAbsPooling keeps the *signed* value of the max-|x| element (Veles
+semantics for tanh-centered activations).  Stochastic pooling draws the
+kept element with probability proportional to |activation| (train-time
+regularizer); its RNG is a jax key threaded from the unit's named stream,
+so runs stay bit-reproducible."""
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def _window(kx, ky, stride):
+    sy, sx = stride if stride is not None else (ky, kx)
+    return (1, ky, kx, 1), (1, sy, sx, 1)
+
+
+def max_pool(x, ky, kx, stride=None, padding="VALID"):
+    dims, strides = _window(kx, ky, stride)
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+
+
+def avg_pool(x, ky, kx, stride=None, padding="VALID"):
+    dims, strides = _window(kx, ky, stride)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    ones = jnp.ones_like(x)
+    n = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return s / n
+
+
+def max_abs_pool(x, ky, kx, stride=None, padding="VALID"):
+    """Signed value of the element with the largest |x| in each window."""
+    dims, strides = _window(kx, ky, stride)
+
+    def pick(a, b):
+        return lax.select(lax.abs(a) >= lax.abs(b), a, b)
+
+    return lax.reduce_window(x, 0.0, pick, dims, strides, padding)
+
+
+def _patches(x, ky, kx, stride):
+    """[N,Ho,Wo,ky*kx,C] view of pooling windows (general stride)."""
+    n, h, w, c = x.shape
+    sy, sx = stride if stride is not None else (ky, kx)
+    ho = (h - ky) // sy + 1
+    wo = (w - kx) // sx + 1
+    idx_y = jnp.arange(ho)[:, None] * sy + jnp.arange(ky)[None, :]
+    idx_x = jnp.arange(wo)[:, None] * sx + jnp.arange(kx)[None, :]
+    # gather rows then cols; shapes [N,Ho,ky,W,C] -> [N,Ho,ky,Wo,kx,C]
+    g = x[:, idx_y.reshape(-1), :, :].reshape(n, ho, ky, w, c)
+    g = g[:, :, :, idx_x.reshape(-1), :].reshape(n, ho, ky, wo, kx, c)
+    return g.transpose(0, 1, 3, 2, 4, 5).reshape(n, ho, wo, ky * kx, c)
+
+
+def stochastic_pool(x, ky, kx, key, stride=None, absolute=False):
+    """Zeiler-style stochastic pooling: sample one element per window with
+    p ∝ activation (or |activation| for the Abs variant).  All-zero windows
+    yield 0 (matching max-pool of zeros)."""
+    p = _patches(x, ky, kx, stride)          # [N,Ho,Wo,K,C]
+    mag = jnp.abs(p) if absolute else jnp.maximum(p, 0.0)
+    total = mag.sum(axis=3, keepdims=True)
+    probs = jnp.where(total > 0, mag / jnp.where(total > 0, total, 1.0), 0.0)
+    # categorical over the window axis, per (n, ho, wo, c)
+    logits = jnp.where(probs > 0, jnp.log(probs), -jnp.inf)
+    logits = jnp.moveaxis(logits, 3, -1)      # [N,Ho,Wo,C,K]
+    choice = jax.random.categorical(key, logits, axis=-1)  # [N,Ho,Wo,C]
+    gathered = jnp.take_along_axis(
+        jnp.moveaxis(p, 3, -1), choice[..., None], axis=-1)[..., 0]
+    any_mass = jnp.moveaxis(total, 3, -1)[..., 0] > 0
+    return jnp.where(any_mass, gathered, 0.0)
+
+
+def stochastic_pool_infer(x, ky, kx, stride=None, absolute=False):
+    """Inference-time stochastic pooling = probability-weighted average
+    (Zeiler §3; what StochasticPooling computes when not training)."""
+    p = _patches(x, ky, kx, stride)
+    mag = jnp.abs(p) if absolute else jnp.maximum(p, 0.0)
+    total = mag.sum(axis=3, keepdims=True)
+    w = jnp.where(total > 0, mag / jnp.where(total > 0, total, 1.0), 0.0)
+    return (p * w).sum(axis=3)
+
+
+def depool(x, ky, kx):
+    """Depooling: nearest-neighbor upsample by the window size (ref Znicz
+    Depooling — decoder half of pooled autoencoders)."""
+    return jnp.repeat(jnp.repeat(x, ky, axis=1), kx, axis=2)

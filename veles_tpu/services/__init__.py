@@ -1,0 +1,2 @@
+"""Services layer (ref SURVEY.md §2.7): snapshotter, result providers,
+plotting, web status, RESTful serving, package export."""
