@@ -1,0 +1,131 @@
+"""Benchmark — prints ONE JSON line on stdout.
+
+Headline metric: the reference's own DeviceBenchmark methodology
+(square 3001×3001 f32 gemm, 3 timed repeats — ref
+veles/accelerated_units.py:706-824, veles/backends.py:672-731), which the
+reference ships a measured number for: 0.1642 s/multiply ≈ 329 GFLOP/s on a
+GeForce GTX TITAN (devices/device_infos.json, BASELINE.md).  vs_baseline is
+our GFLOP/s over that 329.
+
+Secondary numbers (stderr, informational): MNIST-shape MLP train-step time
+and AlexNet train samples/sec/chip on synthetic data."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _block(x):
+    import jax
+    jax.block_until_ready(x)
+
+
+def bench_gemm(n=3001, iters=20):
+    """Chained-matmul loop *inside one jit dispatch* (lax.scan): measures
+    device compute the way the reference's kernel timer did, immune to the
+    per-dispatch overhead of the TPU tunnel and to result caching (each
+    multiply consumes the previous one's output).
+
+    precision="highest" = true f32 accumulation, matching the reference's
+    PRECISION_LEVEL 0 float math (not bf16 passes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = jnp.asarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
+
+    def body(y, _):
+        y = jnp.dot(y, a, precision="highest")
+        y = y / jnp.max(jnp.abs(y))   # keep values finite across the chain
+        return y, None
+
+    f = jax.jit(lambda y: lax.scan(body, y, None, length=iters)[0])
+    _block(f(a))   # compile + warmup
+    t0 = time.perf_counter()
+    _block(f(a))
+    dt = (time.perf_counter() - t0) / iters
+    gflops = 2.0 * n * n * n / dt / 1e9
+    return dt, gflops
+
+
+def bench_mlp_step():
+    """MNIST 784-100-10 step time (BASELINE 'MNIST MLP step time')."""
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import mnist_mlp
+
+    prng.seed_all(3)
+    x = np.random.RandomState(0).rand(2000, 784).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 2000).astype(np.int32)
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                             class_lengths=[0, 0, 2000])
+    wf = StandardWorkflow(layers=mnist_mlp(), loader=loader,
+                          decision_config={"max_epochs": 1}, name="bench-mlp")
+    wf.initialize()
+    wf.loader.run()
+    wf.trainer.run()          # compile
+    _block(wf.trainer.class_stats[2]["loss"])
+    t0 = time.perf_counter()
+    steps = 50
+    for _ in range(steps):
+        wf.loader.run()
+        wf.trainer.run()
+    _block(wf.trainer.class_stats[2]["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_alexnet(batch=64, steps=10):
+    """AlexNet train samples/sec/chip on synthetic 227×227×3 data."""
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import alexnet
+
+    prng.seed_all(4)
+    n = batch * 2
+    x = np.random.RandomState(0).rand(n, 227, 227, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 1000, n).astype(np.int32)
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=batch,
+                             class_lengths=[0, 0, n])
+    wf = StandardWorkflow(layers=alexnet(), loader=loader,
+                          decision_config={"max_epochs": 1000},
+                          name="bench-alexnet")
+    wf.initialize()
+    wf.loader.run()
+    wf.trainer.run()          # compile
+    _block(wf.trainer.class_stats[2]["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        wf.loader.run()
+        wf.trainer.run()
+    _block(wf.trainer.class_stats[2]["loss"])
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    dt, gflops = bench_gemm()
+    print("gemm 3001^2 f32(highest): %.4f s/multiply, %.1f GFLOP/s"
+          % (dt, gflops), file=sys.stderr)
+    try:
+        step = bench_mlp_step()
+        print("mnist mlp 784-100-10 step: %.3f ms" % (step * 1e3),
+              file=sys.stderr)
+        sps = bench_alexnet()
+        print("alexnet synthetic: %.1f samples/sec/chip" % sps,
+              file=sys.stderr)
+    except Exception as e:  # secondary benches must not kill the headline
+        print("secondary bench failed: %r" % e, file=sys.stderr)
+    print(json.dumps({
+        "metric": "gemm_3001x3001_f32_gflops",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / 329.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

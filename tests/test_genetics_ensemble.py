@@ -1,0 +1,123 @@
+"""Genetics + ensemble meta-workflow tests (ref SURVEY §2.8; the
+reference's genetics tests optimized a synthetic function before touching
+real workflows)."""
+
+import numpy as np
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.ensemble import EnsembleTester, EnsembleTrainer
+from veles_tpu.genetics import GeneticsOptimizer, Range
+from veles_tpu.genetics.core import (Chromosome, Population, apply_genes,
+                                     extract_ranges)
+
+
+class TestRanges:
+    cfg = {"lr": Range(0.001, 0.1), "layers": {"hidden": Range(10, 100, int)},
+           "fixed": "keep"}
+
+    def test_extract(self):
+        paths = extract_ranges(self.cfg)
+        assert {p for p, _ in paths} == {("lr",), ("layers", "hidden")}
+
+    def test_apply_genes_decodes(self):
+        genes = {("lr",): 0.5, ("layers", "hidden"): 1.0}
+        out = apply_genes(self.cfg, genes)
+        assert abs(out["lr"] - 0.0505) < 1e-9
+        assert out["layers"]["hidden"] == 100
+        assert out["fixed"] == "keep"
+
+    def test_int_range_rounds(self):
+        assert Range(0, 10, int).decode(0.449) == 4
+
+
+class TestPopulation:
+    def test_evolution_improves_sphere(self):
+        """Maximize -|x - 0.7|² over 5 genes."""
+        prng.seed_all(21)
+        pop = Population(24, 5)
+
+        def fitness(c):
+            return -float(((c.values - 0.7) ** 2).sum())
+
+        for c in pop.chromosomes:
+            c.fitness = fitness(c)
+        first_best = pop.best.fitness
+        for _ in range(15):
+            pop.evolve()
+            for c in pop.chromosomes:
+                if c.fitness is None:
+                    c.fitness = fitness(c)
+        assert pop.best.fitness > first_best
+        assert pop.best.fitness > -0.05
+
+    def test_selection_modes(self):
+        prng.seed_all(3)
+        for sel in ("roulette", "tournament"):
+            pop = Population(8, 3, selection=sel)
+            for i, c in enumerate(pop.chromosomes):
+                c.fitness = float(i)
+            assert isinstance(pop._select(), Chromosome)
+
+    def test_crossover_modes(self):
+        prng.seed_all(4)
+        for cx in ("uniform", "single_point", "blend"):
+            pop = Population(4, 6, crossover=cx)
+            a, b = pop.chromosomes[:2]
+            child = pop._cross(a, b)
+            assert child.values.shape == (6,)
+            assert (child.values >= 0).all() and (child.values <= 1).all()
+
+
+class TestGeneticsOptimizer:
+    def test_optimizes_quadratic_config(self):
+        prng.seed_all(5)
+        cfg = {"a": Range(-2.0, 2.0), "b": Range(-2.0, 2.0)}
+        opt = GeneticsOptimizer(
+            cfg, lambda c: -(c["a"] - 1.0) ** 2 - (c["b"] + 0.5) ** 2,
+            size=16, generations=12)
+        best = opt.run()
+        assert abs(best["a"] - 1.0) < 0.4
+        assert abs(best["b"] + 0.5) < 0.4
+        assert opt.history[-1] >= opt.history[0]
+
+
+class TestEnsemble:
+    def test_ensemble_beats_or_matches_worst_member(self):
+        """Tiny logistic members on digits: ensemble averaging should not
+        be worse than the worst individual member."""
+        prng.seed_all(8)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        y = d.target.astype(np.int32)
+        x_tr, y_tr, x_te, y_te = x[:1400], y[:1400], x[1400:], y[1400:]
+
+        def softmax_fit(xs, ys, epochs=40, lr=0.5, seed=0):
+            g = np.random.default_rng(seed)
+            w = g.normal(0, 0.01, (64, 10)).astype(np.float32)
+            for _ in range(epochs):
+                logits = xs @ w
+                p = np.exp(logits - logits.max(1, keepdims=True))
+                p /= p.sum(1, keepdims=True)
+                onehot = np.eye(10, dtype=np.float32)[ys]
+                w -= lr * xs.T @ (p - onehot) / len(xs)
+            return w
+
+        def build(i, subset):
+            w = softmax_fit(x_tr[subset], y_tr[subset], seed=i)
+            return w, {"member": i}
+
+        trainer = EnsembleTrainer(build, len(x_tr), n_models=5,
+                                  train_ratio=0.6)
+        models = trainer.run()
+        member_errs = []
+        fns = []
+        for w in models:
+            fn = (lambda w: lambda xs: xs @ w)(w)
+            fns.append(fn)
+            member_errs.append(
+                float((np.asarray(fn(x_te)).argmax(1) != y_te).mean()))
+        tester = EnsembleTester(fns)
+        ens_err = tester.error_rate(x_te, y_te)
+        assert ens_err <= max(member_errs) + 1e-9
+        assert ens_err < 0.15

@@ -1,0 +1,114 @@
+"""Kohonen SOM + normalizer registry tests (ref SOM algorithm docs and
+veles/normalization.py behavior)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.normalization import (NormalizerBase, make_normalizer)
+from veles_tpu.models.kohonen import KohonenWorkflow, grid_coords, winners
+
+
+class TestNormalizers:
+    data = (np.arange(12, dtype=np.float32).reshape(3, 4) * 20)
+
+    def test_registry_complete(self):
+        for name in ("none", "linear", "range_linear", "exp", "mean_disp",
+                     "external_mean", "pointwise"):
+            assert name in NormalizerBase.mapping, name
+
+    def test_linear_per_sample_range(self):
+        out = make_normalizer("linear").normalize(self.data)
+        np.testing.assert_allclose(out.min(axis=1), -1.0)
+        np.testing.assert_allclose(out.max(axis=1), 1.0)
+
+    def test_range_linear_roundtrip(self):
+        n = make_normalizer("range_linear", source_range=(0, 255),
+                            target_range=(-1, 1))
+        x = np.array([0.0, 127.5, 255.0], np.float32)
+        out = n.normalize(x)
+        np.testing.assert_allclose(out, [-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(n.denormalize(out), x, atol=1e-5)
+
+    def test_mean_disp(self):
+        n = make_normalizer("mean_disp")
+        n.analyze(self.data)
+        out = n.normalize(self.data)
+        np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+        assert np.abs(out).max() <= 1.0 + 1e-5
+
+    def test_pointwise_spans_unit_interval(self):
+        n = make_normalizer("pointwise")
+        n.analyze(self.data)
+        out = n.normalize(self.data)
+        np.testing.assert_allclose(out.min(axis=0), -1.0, atol=1e-6)
+        np.testing.assert_allclose(out.max(axis=0), 1.0, atol=1e-6)
+
+    def test_external_mean(self):
+        mean = np.full((4,), 10.0, np.float32)
+        n = make_normalizer("external_mean", mean_source=mean)
+        out = n.normalize(self.data)
+        np.testing.assert_allclose(out, self.data - 10.0)
+
+    def test_state_pickles(self):
+        import pickle
+        n = make_normalizer("pointwise")
+        n.analyze(self.data)
+        st = pickle.dumps(n.state)
+        n2 = make_normalizer("pointwise")
+        n2.state = pickle.loads(st)
+        np.testing.assert_array_equal(n2.normalize(self.data),
+                                      n.normalize(self.data))
+
+
+class TestKohonen:
+    def test_winner_search_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(25, 8)).astype(np.float32)
+        x = rng.normal(size=(10, 8)).astype(np.float32)
+        got = np.asarray(winners(w, x))
+        want = np.argmin(((x[:, None, :] - w[None]) ** 2).sum(-1), axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_grid_coords(self):
+        c = np.asarray(grid_coords(3, 2))
+        assert c.shape == (6, 2)
+        np.testing.assert_array_equal(c[0], [0, 0])
+        np.testing.assert_array_equal(c[-1], [2, 1])
+
+    def test_som_organizes_digits(self):
+        """Train an 6x6 SOM on digits; quantization error must drop
+        substantially and the map must use many distinct neurons."""
+        prng.seed_all(11)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                 class_lengths=[0, 0, len(x)],
+                                 name="som-loader")
+        wf = KohonenWorkflow(loader=loader, sx=6, sy=6, n_epochs=8,
+                             name="som")
+        wf.initialize()
+        qe0 = wf.trainer.quantization_error(x)
+        wf.run()
+        qe1 = wf.trainer.quantization_error(x)
+        assert qe1 < 0.6 * qe0, (qe0, qe1)
+        used = len(set(np.asarray(wf.trainer.assign(x)).tolist()))
+        assert used >= 18   # at least half the 36 neurons in use
+
+    def test_som_reproducible(self):
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)[:500]
+
+        def run():
+            prng.seed_all(3)
+            loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                     class_lengths=[0, 0, len(x)])
+            wf = KohonenWorkflow(loader=loader, sx=4, sy=4, n_epochs=3,
+                                 name="som-r")
+            wf.initialize()
+            wf.run()
+            return wf.trainer.host_weights()
+
+        np.testing.assert_array_equal(run(), run())

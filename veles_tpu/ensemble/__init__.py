@@ -1,0 +1,5 @@
+"""Ensemble training/testing (ref: veles/ensemble/ — SURVEY §2.8)."""
+
+from veles_tpu.ensemble.workflows import EnsembleTrainer, EnsembleTester
+
+__all__ = ["EnsembleTrainer", "EnsembleTester"]

@@ -1,0 +1,73 @@
+"""Ensembles (ref: veles/ensemble/model_workflow.py:137,
+test_workflow.py:102 — ``--ensemble-train N:ratio`` trains N instances on
+random train subsets with per-model seeds; ``--ensemble-test`` aggregates
+their predictions).
+
+Host-level orchestration, like the reference (each instance is a full
+training run); results aggregate as JSON-able dicts and test-time
+prediction averages."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+
+
+class EnsembleTrainer(Logger):
+    """Train N model instances on random train-subsets.
+
+    :param build: callable(instance_index, train_indices) returning a
+        2-tuple ``(model, result_dict)`` — ``model`` is whatever the caller
+        wants collected (e.g. a predict function or a trained workflow),
+        ``result_dict`` is JSON-able metadata aggregated into results.
+    :param n_models: N; ``train_ratio``: fraction of train set per model.
+    """
+
+    def __init__(self, build, n_train_samples, n_models=4, train_ratio=0.8,
+                 rng_name="ensemble"):
+        super(EnsembleTrainer, self).__init__()
+        self.build = build
+        self.n_models = n_models
+        self.train_ratio = train_ratio
+        self.n_train_samples = n_train_samples
+        self.rng = prng.get(rng_name)
+        self.models = []
+        self.results = []
+
+    def run(self):
+        n_sub = max(1, int(self.n_train_samples * self.train_ratio))
+        for i in range(self.n_models):
+            subset = np.sort(
+                self.rng.numpy().choice(self.n_train_samples, n_sub,
+                                        replace=False).astype(np.int64))
+            self.info("training ensemble member %d/%d on %d samples",
+                      i + 1, self.n_models, n_sub)
+            model, result = self.build(i, subset)
+            self.models.append(model)
+            self.results.append(result)
+        return self.models
+
+    def get_metric_values(self):
+        return {"ensemble": self.results}
+
+
+class EnsembleTester(Logger):
+    """Aggregate member predictions: mean of per-model probability outputs
+    (ref EnsembleTestWorkflow result averaging)."""
+
+    def __init__(self, predict_fns):
+        super(EnsembleTester, self).__init__()
+        self.predict_fns = list(predict_fns)
+        if not self.predict_fns:
+            raise ValueError("EnsembleTester needs at least one member")
+
+    def predict(self, x):
+        probs = None
+        for fn in self.predict_fns:
+            p = np.asarray(fn(x))
+            probs = p if probs is None else probs + p
+        return probs / len(self.predict_fns)
+
+    def error_rate(self, x, labels):
+        pred = self.predict(x).argmax(axis=1)
+        return float((pred != np.asarray(labels)).mean())

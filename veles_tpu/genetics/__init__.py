@@ -1,0 +1,6 @@
+"""Genetic hyperparameter optimization (ref: veles/genetics/ — SURVEY §2.8)."""
+
+from veles_tpu.genetics.core import Chromosome, Population, Range
+from veles_tpu.genetics.optimizer import GeneticsOptimizer
+
+__all__ = ["Range", "Chromosome", "Population", "GeneticsOptimizer"]

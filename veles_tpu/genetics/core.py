@@ -1,0 +1,156 @@
+"""GA core: Range-tagged config leaves, chromosomes, population evolution
+(ref: veles/genetics/core.py — float chromosomes, roulette/tournament
+selection, crossover + mutation pipelines `core.py:371-430`).
+
+Kept from the reference: ``Range`` wrappers make any config leaf tunable;
+selection = roulette or tournament; crossover = uniform / single-point /
+blend (arithmetic); mutation = gaussian jitter / uniform reset; elitism.
+Dropped: gray-code binary chromosomes (the float encoding dominates in the
+reference's own defaults); process forking (fitness evaluation is a
+callable — the CLI wires it to a full training run)."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+
+
+class Range(object):
+    """Marks a tunable config value: Range(min, max) or
+    Range(min, max, int) (ref genetics/config.py)."""
+
+    def __init__(self, min_value, max_value, vtype=float):
+        self.min = min_value
+        self.max = max_value
+        self.vtype = vtype
+
+    def decode(self, unit_value):
+        v = self.min + (self.max - self.min) * float(unit_value)
+        return int(round(v)) if self.vtype is int else v
+
+    def __repr__(self):
+        return "Range(%s, %s, %s)" % (self.min, self.max,
+                                      self.vtype.__name__)
+
+
+def extract_ranges(config, path=()):
+    """Walk a nested dict; yield (path, Range) for every tunable leaf."""
+    out = []
+    for k, v in config.items():
+        if isinstance(v, Range):
+            out.append((path + (k,), v))
+        elif isinstance(v, dict):
+            out.extend(extract_ranges(v, path + (k,)))
+    return out
+
+
+def apply_genes(config, genes):
+    """Return a deep copy of ``config`` with Range leaves replaced by the
+    decoded gene values.  ``genes`` is {path tuple: unit float}."""
+    return _apply(config, genes, ())
+
+
+def _apply(config, genes, path):
+    out = {}
+    for k, v in config.items():
+        p = path + (k,)
+        if isinstance(v, Range):
+            out[k] = v.decode(genes[p])
+        elif isinstance(v, dict):
+            out[k] = _apply(v, genes, p)
+        else:
+            out[k] = v
+    return out
+
+
+class Chromosome(object):
+    """Unit-interval float vector + fitness (ref core.py:133)."""
+
+    def __init__(self, values):
+        self.values = np.clip(np.asarray(values, np.float64), 0.0, 1.0)
+        self.fitness = None
+
+    def config_for(self, config, paths):
+        genes = {p: self.values[i] for i, (p, _) in enumerate(paths)}
+        return _apply(config, genes, ())
+
+
+class Population(Logger):
+    """Evolving population (ref core.py:371-430)."""
+
+    def __init__(self, size, n_genes, selection="roulette",
+                 crossover="uniform", mutation_rate=0.1,
+                 mutation_sigma=0.15, elite=1, rng_name="genetics"):
+        super(Population, self).__init__()
+        self.size = size
+        self.n_genes = n_genes
+        self.selection = selection
+        self.crossover = crossover
+        self.mutation_rate = mutation_rate
+        self.mutation_sigma = mutation_sigma
+        self.elite = elite
+        self.rng = prng.get(rng_name)
+        self.generation = 0
+        self.chromosomes = [
+            Chromosome(self.rng.uniform(size=n_genes))
+            for _ in range(size)]
+
+    @property
+    def best(self):
+        scored = [c for c in self.chromosomes if c.fitness is not None]
+        return max(scored, key=lambda c: c.fitness) if scored else None
+
+    # -- selection ----------------------------------------------------------
+    def _select(self):
+        g = self.rng.numpy()
+        fits = np.array([c.fitness for c in self.chromosomes], np.float64)
+        if self.selection == "tournament":
+            k = max(2, self.size // 5)
+            idx = g.choice(self.size, size=k, replace=False)
+            return self.chromosomes[idx[np.argmax(fits[idx])]]
+        # roulette on rank (robust to negative/flat fitness)
+        order = np.argsort(fits)
+        ranks = np.empty(self.size)
+        ranks[order] = np.arange(1, self.size + 1)
+        p = ranks / ranks.sum()
+        return self.chromosomes[g.choice(self.size, p=p)]
+
+    # -- crossover ----------------------------------------------------------
+    def _cross(self, a, b):
+        g = self.rng.numpy()
+        if self.crossover == "single_point":
+            cut = g.integers(1, self.n_genes) if self.n_genes > 1 else 0
+            child = np.concatenate([a.values[:cut], b.values[cut:]])
+        elif self.crossover == "blend":
+            w = g.uniform(size=self.n_genes)
+            child = w * a.values + (1 - w) * b.values
+        else:  # uniform
+            mask = g.uniform(size=self.n_genes) < 0.5
+            child = np.where(mask, a.values, b.values)
+        return Chromosome(child)
+
+    def _mutate(self, c):
+        g = self.rng.numpy()
+        mask = g.uniform(size=self.n_genes) < self.mutation_rate
+        jitter = g.normal(0, self.mutation_sigma, self.n_genes)
+        c.values = np.clip(np.where(mask, c.values + jitter, c.values),
+                           0.0, 1.0)
+        return c
+
+    # -- one generation -----------------------------------------------------
+    def evolve(self):
+        """Build the next generation (requires all fitnesses set)."""
+        if any(c.fitness is None for c in self.chromosomes):
+            raise ValueError("evolve() before all fitnesses evaluated")
+        elites = sorted(self.chromosomes,
+                        key=lambda c: -c.fitness)[:self.elite]
+        nxt = []
+        for src in elites:
+            copy = Chromosome(src.values.copy())
+            copy.fitness = src.fitness   # elites keep their own score
+            nxt.append(copy)
+        while len(nxt) < self.size:
+            child = self._mutate(self._cross(self._select(), self._select()))
+            nxt.append(child)
+        self.chromosomes = nxt
+        self.generation += 1

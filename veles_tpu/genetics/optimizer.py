@@ -1,0 +1,57 @@
+"""GeneticsOptimizer — evolve Range-tagged workflow configs
+(ref: veles/genetics/optimization_workflow.py:70-298; the reference's
+``--optimize size[:generations]`` CLI forked a full veles process per
+fitness evaluation and distributed them over slaves; here evaluation is a
+callable — typically "build StandardWorkflow from config, train, return
+-validation_error" — run sequentially or handed to any executor)."""
+
+from veles_tpu.genetics.core import Population, extract_ranges
+from veles_tpu.logger import Logger
+
+
+class GeneticsOptimizer(Logger):
+    """Evolve a config dict whose tunable leaves are ``Range`` objects.
+
+    :param config: nested dict with Range leaves
+    :param evaluate: callable(config_dict) -> fitness (higher is better)
+    :param size: population size; ``generations``: how many to run
+    """
+
+    def __init__(self, config, evaluate, size=20, generations=10,
+                 executor_map=None, **population_kwargs):
+        super(GeneticsOptimizer, self).__init__()
+        self.config = config
+        self.paths = extract_ranges(config)
+        if not self.paths:
+            raise ValueError("config has no Range leaves to optimize")
+        self.evaluate = evaluate
+        self.generations = generations
+        #: optional parallel map(fn, iterable) — defaults to builtin map
+        self.executor_map = executor_map or (lambda f, xs: list(map(f, xs)))
+        self.population = Population(size, len(self.paths),
+                                     **population_kwargs)
+        self.history = []
+
+    def run(self):
+        for gen in range(self.generations):
+            todo = [c for c in self.population.chromosomes
+                    if c.fitness is None]
+            configs = [c.config_for(self.config, self.paths) for c in todo]
+            fits = self.executor_map(self.evaluate, configs)
+            for c, f in zip(todo, fits):
+                c.fitness = float(f)
+            best = self.population.best
+            self.history.append(best.fitness)
+            self.info("generation %d: best fitness %.6f (%s)",
+                      gen, best.fitness,
+                      {"/".join(p): r.decode(best.values[i])
+                       for i, (p, r) in enumerate(self.paths)})
+            if gen < self.generations - 1:
+                self.population.evolve()
+        return self.best_config
+
+    @property
+    def best_config(self):
+        best = self.population.best
+        return None if best is None else best.config_for(self.config,
+                                                         self.paths)

@@ -1,0 +1,190 @@
+"""Normalizer registry (ref: veles/normalization.py:110-671).
+
+Stateful two-phase contract kept from the reference: ``analyze(data)``
+accumulates dataset statistics, ``normalize(data)`` applies the transform
+(and ``denormalize`` inverts it where defined).  State is picklable into
+snapshots.  All transforms are pure numpy on the host — normalization
+happens once at dataset ingest (the normalized tensor then lives in HBM),
+not per minibatch, so there is nothing to stage on device."""
+
+import numpy as np
+
+from veles_tpu.registry import MappedRegistry
+
+
+class NormalizerRegistry(MappedRegistry):
+    """MAPPING name → normalizer class (ref NormalizerRegistry)."""
+
+
+class NormalizerBase(object, metaclass=NormalizerRegistry):
+    mapping = {}
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+    def analyze(self, data):
+        """Accumulate statistics over (a chunk of) the dataset."""
+
+    def normalize(self, data):
+        """In-place-style transform; returns the normalized array."""
+        raise NotImplementedError
+
+    def denormalize(self, data):
+        raise NotImplementedError(
+            "%s cannot denormalize" % type(self).__name__)
+
+    @property
+    def state(self):
+        return {k: v for k, v in self.__dict__.items() if k != "kwargs"}
+
+    @state.setter
+    def state(self, st):
+        self.__dict__.update(st)
+
+
+class NoneNormalizer(NormalizerBase):
+    """Identity (ref 'none')."""
+
+    MAPPING = "none"
+
+    def normalize(self, data):
+        return data
+
+    def denormalize(self, data):
+        return data
+
+
+class LinearNormalizer(NormalizerBase):
+    """Scale each *sample* into [-1, 1] by its own min/max (ref 'linear')."""
+
+    MAPPING = "linear"
+
+    def normalize(self, data):
+        flat = data.reshape(len(data), -1)
+        mn = flat.min(axis=1, keepdims=True)
+        mx = flat.max(axis=1, keepdims=True)
+        span = np.where(mx > mn, mx - mn, 1.0)
+        out = (flat - mn) * (2.0 / span) - 1.0
+        return out.reshape(data.shape).astype(np.float32)
+
+
+class RangeLinearNormalizer(NormalizerBase):
+    """Map a fixed source interval to a fixed destination interval
+    (ref 'range_linear'; defaults map [0, 255] → [-1, 1])."""
+
+    MAPPING = "range_linear"
+
+    def __init__(self, source_range=(0.0, 255.0), target_range=(-1.0, 1.0),
+                 **kwargs):
+        super(RangeLinearNormalizer, self).__init__(**kwargs)
+        self.source_range = tuple(map(float, source_range))
+        self.target_range = tuple(map(float, target_range))
+
+    def normalize(self, data):
+        s0, s1 = self.source_range
+        t0, t1 = self.target_range
+        scale = (t1 - t0) / (s1 - s0)
+        return ((data.astype(np.float32) - s0) * scale + t0)
+
+    def denormalize(self, data):
+        s0, s1 = self.source_range
+        t0, t1 = self.target_range
+        scale = (s1 - s0) / (t1 - t0)
+        return (data.astype(np.float32) - t0) * scale + s0
+
+
+class ExpNormalizer(NormalizerBase):
+    """tanh-of-exp squashing (ref 'exp'): 2/(1+exp(-x)) - 1."""
+
+    MAPPING = "exp"
+
+    def normalize(self, data):
+        x = data.astype(np.float32)
+        return (2.0 / (1.0 + np.exp(-x)) - 1.0)
+
+
+class MeanDispNormalizer(NormalizerBase):
+    """(x - mean) * reciprocal-dispersion, computed over the analyzed data
+    per feature (ref 'mean_disp' + veles/mean_disp_normalizer.py — the
+    accelerated unit collapses to one vectorized expression)."""
+
+    MAPPING = "mean_disp"
+
+    def __init__(self, **kwargs):
+        super(MeanDispNormalizer, self).__init__(**kwargs)
+        self._sum = None
+        self._min = None
+        self._max = None
+        self._count = 0
+
+    def analyze(self, data):
+        flat = data.reshape(len(data), -1).astype(np.float64)
+        if self._sum is None:
+            self._sum = flat.sum(axis=0)
+            self._min = flat.min(axis=0)
+            self._max = flat.max(axis=0)
+        else:
+            self._sum += flat.sum(axis=0)
+            self._min = np.minimum(self._min, flat.min(axis=0))
+            self._max = np.maximum(self._max, flat.max(axis=0))
+        self._count += len(flat)
+
+    def normalize(self, data):
+        if not self._count:
+            self.analyze(data)
+        mean = (self._sum / self._count).astype(np.float32)
+        disp = (self._max - self._min).astype(np.float32)
+        rdisp = np.where(disp > 0, 1.0 / np.where(disp > 0, disp, 1.0), 1.0)
+        flat = data.reshape(len(data), -1).astype(np.float32)
+        return ((flat - mean) * rdisp).reshape(data.shape)
+
+
+class ExternalMeanNormalizer(NormalizerBase):
+    """Subtract a supplied mean array (ref 'external_mean' — e.g. the
+    ImageNet channel mean file)."""
+
+    MAPPING = "external_mean"
+
+    def __init__(self, mean_source=None, **kwargs):
+        super(ExternalMeanNormalizer, self).__init__(**kwargs)
+        if mean_source is None:
+            raise ValueError("external_mean needs mean_source=array|path")
+        if isinstance(mean_source, str):
+            mean_source = np.load(mean_source)
+        self.mean = np.asarray(mean_source, np.float32)
+
+    def normalize(self, data):
+        return data.astype(np.float32) - self.mean
+
+
+class PointwiseNormalizer(NormalizerBase):
+    """Per-feature linear map fitted on analyzed data so each feature spans
+    [-1, 1] (ref 'pointwise')."""
+
+    MAPPING = "pointwise"
+
+    def __init__(self, **kwargs):
+        super(PointwiseNormalizer, self).__init__(**kwargs)
+        self._min = None
+        self._max = None
+
+    def analyze(self, data):
+        flat = data.reshape(len(data), -1)
+        if self._min is None:
+            self._min = flat.min(axis=0).astype(np.float64)
+            self._max = flat.max(axis=0).astype(np.float64)
+        else:
+            self._min = np.minimum(self._min, flat.min(axis=0))
+            self._max = np.maximum(self._max, flat.max(axis=0))
+
+    def normalize(self, data):
+        if self._min is None:
+            self.analyze(data)
+        span = np.where(self._max > self._min, self._max - self._min, 1.0)
+        flat = data.reshape(len(data), -1).astype(np.float32)
+        out = (flat - self._min) * (2.0 / span) - 1.0
+        return out.astype(np.float32).reshape(data.shape)
+
+
+def make_normalizer(name, **kwargs):
+    return NormalizerBase.mapping[name](**kwargs)

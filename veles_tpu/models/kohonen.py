@@ -1,0 +1,180 @@
+"""Kohonen self-organizing map (ref: znicz.kohonen per BASELINE.json config
+'Kohonen self-organizing map (znicz.kohonen kernels → Pallas)'; algorithm
+docs manualrst_veles_algorithms.rst:72-84).
+
+TPU formulation: the winner search is a matmul — ``argmin ||x-w||² =
+argmin (|w|² - 2 x·wᵀ)`` — so it rides the MXU; the neighborhood update is
+a ``lax.scan`` over the minibatch (SOM updates are inherently sequential
+per sample) with a Gaussian neighborhood over the 2-D neuron grid whose
+radius decays with the epoch.  The whole minibatch update is ONE jitted
+step; no per-sample host dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+def grid_coords(sx, sy):
+    """Neuron grid coordinates [n, 2] (hexagonal offset like classic SOM
+    visualizations is cosmetic; Euclidean rectangular grid here)."""
+    yy, xx = np.mgrid[0:sy, 0:sx]
+    return jnp.asarray(
+        np.stack([xx.ravel(), yy.ravel()], axis=1).astype(np.float32))
+
+
+def winners(weights, x):
+    """Batch winner search: [B] argmin indices.  |w|²-2x·wᵀ via MXU."""
+    w_sq = jnp.sum(weights * weights, axis=1)
+    scores = w_sq[None, :] - 2.0 * jnp.dot(
+        x, weights.T, preferred_element_type=jnp.float32)
+    return jnp.argmin(scores, axis=1)
+
+
+def som_minibatch_step(weights, coords, x, valid, lr, radius):
+    """Sequential SOM updates over one minibatch, staged as lax.scan."""
+
+    def body(w, inp):
+        xi, vi = inp
+        w_sq = jnp.sum(w * w, axis=1)
+        win = jnp.argmin(w_sq - 2.0 * jnp.dot(w, xi))
+        d2 = jnp.sum((coords - coords[win]) ** 2, axis=1)
+        h = jnp.exp(-d2 / (2.0 * radius * radius))
+        w = w + (vi * lr) * h[:, None] * (xi[None, :] - w)
+        return w, win
+
+    return jax.lax.scan(body, weights, (x, valid))
+
+
+class KohonenTrainer(Unit):
+    """SOM trainer unit: owns the weight grid and the jitted minibatch step
+    (plays the role of the reference's KohonenTrainer + its OpenCL kernels).
+
+    Epoch schedule: learning rate and neighborhood radius decay
+    exponentially from their initial values to ``final`` fractions over
+    ``n_epochs``."""
+
+    def __init__(self, workflow, sx=8, sy=8, n_epochs=20,
+                 learning_rate=0.5, final_learning_rate=0.01,
+                 radius=None, final_radius=1.0, **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.sx, self.sy = sx, sy
+        self.n_neurons = sx * sy
+        self.n_epochs = n_epochs
+        self.lr0 = learning_rate
+        self.lr1 = final_learning_rate
+        self.r0 = radius if radius is not None else max(sx, sy) / 2.0
+        self.r1 = final_radius
+        self.demand("loader")
+        self.weights = None
+        self.view_group = "TRAINER"
+
+    def initialize(self, **kwargs):
+        loader = self.loader
+        n_features = int(np.prod(loader.data.shape[1:]))
+        rng = prng.get("kohonen-weights")
+        self.weights = jnp.asarray(
+            rng.fill_uniform((self.n_neurons, n_features), 0.5))
+        self._coords = grid_coords(self.sx, self.sy)
+        self._step = jax.jit(som_minibatch_step)
+        self._winners = jax.jit(winners)
+
+    def _schedule(self):
+        t = min(self.loader.epoch_number / max(self.n_epochs - 1, 1), 1.0)
+        lr = self.lr0 * (self.lr1 / self.lr0) ** t
+        radius = self.r0 * (self.r1 / self.r0) ** t
+        return lr, radius
+
+    def run(self):
+        loader = self.loader
+        if loader.minibatch_class != TRAIN:
+            return
+        x = FullBatchLoader.gather(
+            loader.data, jnp.asarray(loader.minibatch_indices))
+        x = x.reshape(x.shape[0], -1)
+        valid = jnp.asarray(loader.minibatch_valid)
+        lr, radius = self._schedule()
+        self.weights, _ = self._step(self.weights, self._coords, x, valid,
+                                     lr, radius)
+
+    # -- inspection / serving -------------------------------------------------
+    def assign(self, x):
+        """Winner neuron index for each sample (KohonenForward)."""
+        return self._winners(self.weights, jnp.asarray(
+            x.reshape(len(x), -1)))
+
+    def quantization_error(self, x):
+        x = jnp.asarray(x.reshape(len(x), -1))
+        win = self._winners(self.weights, x)
+        return float(jnp.mean(jnp.linalg.norm(x - self.weights[win],
+                                              axis=1)))
+
+    def host_weights(self):
+        return np.asarray(self.weights).reshape(self.sy, self.sx, -1)
+
+    def get_metric_values(self):
+        return {"som_grid": (self.sx, self.sy)}
+
+
+class KohonenDecision(Unit):
+    """Fixed-epoch stop + quantization-error logging."""
+
+    def __init__(self, workflow, n_epochs=20, **kwargs):
+        super(KohonenDecision, self).__init__(workflow, **kwargs)
+        self.n_epochs = n_epochs
+        self.complete = Bool(False)
+        self.demand("loader", "trainer")
+        self.qe_history = []
+
+    def run(self):
+        loader = self.loader
+        if not bool(loader.epoch_ended):
+            return
+        qe = self.trainer.quantization_error(loader.data)
+        self.qe_history.append(qe)
+        self.info("epoch %d: quantization error %.4f",
+                  loader.epoch_number, qe)
+        if loader.epoch_number >= self.n_epochs:
+            self.complete <<= True
+
+    def get_metric_values(self):
+        return {"quantization_error":
+                self.qe_history[-1] if self.qe_history else None}
+
+
+class KohonenWorkflow(Workflow):
+    """start → repeater → loader → trainer → decision → loop/end."""
+
+    def __init__(self, workflow=None, loader=None, sx=8, sy=8, n_epochs=20,
+                 **kwargs):
+        super(KohonenWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.loader = loader
+        if loader.workflow is not self:
+            self.add_ref(loader)
+            loader.workflow = self
+        self.trainer = KohonenTrainer(self, sx=sx, sy=sy, n_epochs=n_epochs,
+                                      **{k: v for k, v in kwargs.items()
+                                         if k in ("learning_rate", "radius",
+                                                  "final_learning_rate",
+                                                  "final_radius")})
+        self.trainer.loader = loader
+        self.decision = KohonenDecision(self, n_epochs=n_epochs)
+        self.decision.loader = loader
+        self.decision.trainer = self.trainer
+
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.decision.link_from(self.trainer)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete

@@ -58,11 +58,15 @@ class StagedTrainer(Unit):
     ``minibatch_valid``, ``minibatch_class``."""
 
     def __init__(self, workflow, layers, loss="softmax", gd_defaults=None,
-                 **kwargs):
+                 mesh_config=None, **kwargs):
         super(StagedTrainer, self).__init__(workflow, **kwargs)
         self.layers = layers
         self.loss = loss
         self.gd_defaults = gd_defaults or {}
+        #: parallel.MeshConfig or None (single device).  With a mesh, params
+        #: shard over the model axis (tp) and the minibatch over the data
+        #: axis (dp) — XLA inserts the gradient psum over ICI.
+        self.mesh_config = mesh_config
         self.demand("loader")
         self.params = {}
         self.velocity = {}
@@ -92,6 +96,15 @@ class StagedTrainer(Unit):
         self.output_features = int(np.prod(shape))
         self._base_key = jax.random.key(
             int(prng.get("trainer")._seed))
+        if self.mesh_config is not None:
+            from veles_tpu.parallel import sharding
+            mc = self.mesh_config
+            if loader.minibatch_size % mc.data_size:
+                raise ValueError(
+                    "minibatch_size %d not divisible by data axis %d"
+                    % (loader.minibatch_size, mc.data_size))
+            self.params = sharding.shard_params(self.params, mc)
+            self.velocity = sharding.shard_params(self.velocity, mc)
         self.reset_epoch_stats()
         self._build_steps()
 
@@ -152,8 +165,28 @@ class StagedTrainer(Unit):
                 jax.random.key(0))
             return jax.tree_util.tree_map(jnp.add, acc, stats)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-        self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+        if self.mesh_config is not None:
+            from veles_tpu.parallel import sharding
+            mc = self.mesh_config
+            repl = sharding.replicated_sharding(mc)
+            p_sh = sharding.param_shardings(self.params, mc)
+            acc_sh = jax.tree_util.tree_map(lambda _: repl,
+                                            self._zero_stats())
+            self._train_step = jax.jit(
+                train_step, donate_argnums=(0, 1, 2),
+                out_shardings=(p_sh, p_sh, acc_sh))
+            self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
+                                      out_shardings=acc_sh)
+            labels = sharding.replicate(labels, mc)
+            self._data_dev = sharding.replicate(loader.data, mc)
+            if targets is loader.data:
+                targets = self._data_dev  # autoencoder: don't copy twice
+            elif targets is not None:
+                targets = sharding.replicate(targets, mc)
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+            self._data_dev = loader.data
         self._labels_dev = labels
         self._targets_dev = (targets if targets is not None
                              else jnp.zeros((1,), jnp.float32))
@@ -162,18 +195,25 @@ class StagedTrainer(Unit):
     def run(self):
         loader = self.loader
         cls = loader.minibatch_class
-        idx = jnp.asarray(loader.minibatch_indices)
-        valid = jnp.asarray(loader.minibatch_valid)
+        if self.mesh_config is not None:
+            from veles_tpu.parallel import sharding
+            idx = sharding.shard_batch(
+                jnp.asarray(loader.minibatch_indices), self.mesh_config)
+            valid = sharding.shard_batch(
+                jnp.asarray(loader.minibatch_valid), self.mesh_config)
+        else:
+            idx = jnp.asarray(loader.minibatch_indices)
+            valid = jnp.asarray(loader.minibatch_valid)
         if cls in self.train_only_classes:
             self._step_counter += 1
             self.params, self.velocity, self.class_stats[cls] = \
                 self._train_step(self.params, self.velocity,
-                                 self.class_stats[cls], loader.data,
+                                 self.class_stats[cls], self._data_dev,
                                  self._labels_dev, self._targets_dev, idx,
                                  valid, self._step_counter)
         else:
             self.class_stats[cls] = self._eval_step(
-                self.params, self.class_stats[cls], loader.data,
+                self.params, self.class_stats[cls], self._data_dev,
                 self._labels_dev, self._targets_dev, idx, valid)
 
     # ------------------------------------------------------------- metrics
@@ -200,6 +240,13 @@ class StagedTrainer(Unit):
         if host_velocity is not None:
             self.velocity = jax.tree_util.tree_map(jnp.asarray,
                                                    host_velocity)
+        if self.mesh_config is not None:
+            # re-establish the tensor-parallel placement initialize() set up
+            from veles_tpu.parallel import sharding
+            self.params = sharding.shard_params(self.params,
+                                                self.mesh_config)
+            self.velocity = sharding.shard_params(self.velocity,
+                                                  self.mesh_config)
 
     def forward_fn(self):
         """Jitted serve-time forward (softmax applied for classifiers)."""

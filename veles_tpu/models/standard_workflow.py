@@ -25,7 +25,7 @@ from veles_tpu.workflow import Workflow
 class StandardWorkflow(Workflow):
     def __init__(self, workflow=None, layers=None, loader=None,
                  loss="softmax", decision_config=None, snapshotter_config=None,
-                 gd_defaults=None, **kwargs):
+                 gd_defaults=None, mesh_config=None, **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -35,7 +35,8 @@ class StandardWorkflow(Workflow):
         self.repeater = Repeater(self)
         self.loader = self._make_loader(loader)
         self.trainer = StagedTrainer(self, [make_layer(c) for c in layers],
-                                     loss=loss, gd_defaults=gd_defaults)
+                                     loss=loss, gd_defaults=gd_defaults,
+                                     mesh_config=mesh_config)
         self.trainer.loader = self.loader
         self.forwards = [Forward(self, lay, self.trainer)
                          for lay in self.trainer.layers]

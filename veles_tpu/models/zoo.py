@@ -1,0 +1,87 @@
+"""Model zoo — layer configs for the reference's baseline workflows
+(BASELINE.md: MNIST MLP, CIFAR-10 conv, ImageNet AlexNet; ref Znicz sample
+workflows documented in manualrst_veles_algorithms.rst)."""
+
+
+def mnist_mlp(hidden=100, lr=0.03, moment=0.9):
+    """MnistSimple: 784-<hidden>-10 softmax net
+    (ref docs/source/manualrst_veles_algorithms.rst:26-33; BASELINE
+    'MNIST 784-100-10 fully-connected')."""
+    return [
+        {"type": "all2all_tanh", "output_sample_shape": hidden,
+         "learning_rate": lr, "gradient_moment": moment},
+        {"type": "softmax", "output_sample_shape": 10,
+         "learning_rate": lr, "gradient_moment": moment},
+    ]
+
+
+def cifar_conv(lr=0.001, moment=0.9, wd=0.004):
+    """cifar_caffe-style quick net for 32×32×3 inputs
+    (ref manualrst_veles_algorithms.rst:45-52: 17.21% validation error)."""
+    return [
+        {"type": "conv", "n_kernels": 32, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "gradient_moment": moment, "weights_decay": wd},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "activation_strict_relu"},
+        {"type": "conv_strict_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "gradient_moment": moment, "weights_decay": wd},
+        {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "conv_strict_relu", "n_kernels": 64, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "gradient_moment": moment, "weights_decay": wd},
+        {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "all2all", "output_sample_shape": 64,
+         "learning_rate": lr, "gradient_moment": moment,
+         "weights_decay": wd},
+        {"type": "softmax", "output_sample_shape": 10,
+         "learning_rate": lr, "gradient_moment": moment,
+         "weights_decay": wd},
+    ]
+
+
+def alexnet(n_classes=1000, lr=0.01, moment=0.9, wd=5e-4):
+    """AlexNet for 227×227×3 ImageNet (ref BASELINE 'ImageNet AlexNet';
+    Znicz imagenet workflow).  Single-tower (no grouped convs)."""
+    def conv(k, kx, pad, stride=(1, 1), **kw):
+        c = {"type": "conv_strict_relu", "n_kernels": k, "kx": kx, "ky": kx,
+             "padding": (pad,) * 4, "sliding": stride, "learning_rate": lr,
+             "gradient_moment": moment, "weights_decay": wd}
+        c.update(kw)
+        return c
+
+    return [
+        conv(96, 11, 0, stride=(4, 4)),
+        {"type": "norm", "alpha": 1e-4, "beta": 0.75, "n": 5, "k": 2.0},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        conv(256, 5, 2),
+        {"type": "norm", "alpha": 1e-4, "beta": 0.75, "n": 5, "k": 2.0},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        conv(384, 3, 1),
+        conv(384, 3, 1),
+        conv(256, 3, 1),
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "all2all_strict_relu", "output_sample_shape": 4096,
+         "learning_rate": lr, "gradient_moment": moment,
+         "weights_decay": wd},
+        {"type": "dropout", "dropout_ratio": 0.5},
+        {"type": "all2all_strict_relu", "output_sample_shape": 4096,
+         "learning_rate": lr, "gradient_moment": moment,
+         "weights_decay": wd},
+        {"type": "dropout", "dropout_ratio": 0.5},
+        {"type": "softmax", "output_sample_shape": n_classes,
+         "learning_rate": lr, "gradient_moment": moment,
+         "weights_decay": wd},
+    ]
+
+
+def mnist_autoencoder(bottleneck=16, lr=0.01, moment=0.9):
+    """MNIST-style autoencoder (ref manualrst_veles_algorithms.rst:55-70,
+    validation RMSE 0.5478)."""
+    return [
+        {"type": "all2all_tanh", "output_sample_shape": bottleneck,
+         "learning_rate": lr, "gradient_moment": moment},
+        {"type": "all2all", "output_sample_shape": 784,
+         "learning_rate": lr, "gradient_moment": moment},
+    ]

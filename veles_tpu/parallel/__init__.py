@@ -1,0 +1,16 @@
+"""Distributed execution (ref: veles/server.py, client.py, launcher.py —
+SURVEY.md §2.6).
+
+The reference's master–slave star (Twisted TCP control + ZeroMQ pickled
+gradient deltas, async parameter-server SGD over ≤100 nodes) is replaced by
+SPMD over a ``jax.sharding.Mesh``: the gradient exchange is a ``psum`` XLA
+inserts over ICI when the batch axis is sharded; the control plane is
+``jax.distributed`` over DCN for multi-host.  The reference's SharedIO shm,
+pickle compression, computing-power balancing, and elastic join all
+dissolve: arrays are HBM-resident, the pod is homogeneous, and elasticity is
+checkpoint-restart (see services.snapshotter)."""
+
+from veles_tpu.parallel.mesh import MeshConfig, make_mesh
+from veles_tpu.parallel import sharding
+
+__all__ = ["MeshConfig", "make_mesh", "sharding"]

@@ -1,0 +1,65 @@
+"""Mesh construction — the TPU replacement for the reference's device
+selection (`veles/backends.py` Device.__new__ backend dispatch) and the
+launcher's node specs (`launcher.py` -n host/0:0x3 grammar).
+
+A MeshConfig names the axes of a ``jax.sharding.Mesh``:
+  * ``data``  — batch/data parallelism (gradient psum over ICI)
+  * ``model`` — tensor parallelism (dense/conv output-channel sharding)
+Multi-host: call ``jax.distributed.initialize`` first; ``jax.devices()``
+then spans the pod and the same mesh code works unchanged."""
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}.  A size of -1 absorbs all
+    remaining devices.  Default: all devices on one ``data`` axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axes:
+        axes = {"data": len(devices)}
+    axes = dict(axes)
+    names = list(axes)
+    known = 1
+    wild = None
+    for name, size in axes.items():
+        if size == -1:
+            if wild is not None:
+                raise ValueError("only one axis may be -1")
+            wild = name
+        else:
+            known *= int(size)
+    if wild is not None:
+        if len(devices) % known:
+            raise ValueError("cannot infer %r: %d devices not divisible "
+                             "by %d" % (wild, len(devices), known))
+        axes[wild] = len(devices) // known
+        known *= axes[wild]
+    if known > len(devices):
+        raise ValueError("mesh wants %d devices, have %d"
+                         % (known, len(devices)))
+    devs = np.asarray(devices[:known]).reshape(
+        [axes[n] for n in names])
+    return Mesh(devs, tuple(names))
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Axis naming convention shared by trainer/loader/sharding rules."""
+
+    mesh: Mesh
+    data_axis: str = "data"
+    model_axis: str = "model"
+
+    @property
+    def data_size(self):
+        return (self.mesh.shape[self.data_axis]
+                if self.data_axis in self.mesh.shape else 1)
+
+    @property
+    def model_size(self):
+        return (self.mesh.shape[self.model_axis]
+                if self.model_axis in self.mesh.shape else 1)

@@ -1,0 +1,64 @@
+"""Sharding rules: which mesh axes each tensor rides
+(the "pick a mesh, annotate shardings, let XLA insert collectives" recipe).
+
+Parameter rule (tensor parallelism): dense weights (in, out) shard their
+*output* dimension over the model axis (Megatron column-parallel); conv
+kernels (kh, kw, cin, cout) shard output channels; biases follow their
+weights.  Activations are left to GSPMD propagation.  Anything whose dim
+doesn't divide the axis stays replicated — correctness never depends on
+divisibility.
+
+Data rule (data parallelism): the minibatch index/valid vectors shard over
+the data axis; the HBM-resident dataset and labels are replicated (each
+shard gathers its own rows).  With params replicated on the data axis and
+batch sharded, XLA inserts the gradient ``psum`` over ICI — the TPU-native
+equivalent of the reference's master-apply of slave gradient deltas
+(veles/workflow.py:529 apply_data_from_slave)."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def param_spec(shape, mesh_cfg):
+    """PartitionSpec for one parameter tensor under the model axis."""
+    axis = mesh_cfg.model_axis
+    size = mesh_cfg.model_size
+    if size <= 1 or not shape:
+        return P()
+    out_dim = len(shape) - 1
+    if shape[out_dim] % size == 0:
+        spec = [None] * len(shape)
+        spec[out_dim] = axis
+        return P(*spec)
+    return P()
+
+
+def shard_params(params, mesh_cfg):
+    """device_put a {layer: {name: array}} pytree with model-axis sharding."""
+    mesh = mesh_cfg.mesh
+
+    def place(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, param_spec(x.shape, mesh_cfg)))
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def param_shardings(params, mesh_cfg):
+    mesh = mesh_cfg.mesh
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, param_spec(x.shape, mesh_cfg)), params)
+
+
+def replicate(x, mesh_cfg):
+    return jax.device_put(x, NamedSharding(mesh_cfg.mesh, P()))
+
+
+def shard_batch(x, mesh_cfg):
+    """Shard the leading (minibatch) dim over the data axis."""
+    return jax.device_put(
+        x, NamedSharding(mesh_cfg.mesh, P(mesh_cfg.data_axis)))
+
+
+def replicated_sharding(mesh_cfg):
+    return NamedSharding(mesh_cfg.mesh, P())
