@@ -1,0 +1,164 @@
+// Minimal JSON parser for contents.json manifests.
+// (Plays the role of the bundled rapidjson submodule in the reference's
+// libVeles, SURVEY.md §2.10 — parses the package main file,
+// ref src/main_file_loader.cc.)
+#pragma once
+
+#include <cctype>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class Json {
+ public:
+  enum Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Type type = kNull;
+  bool bool_v = false;
+  double num_v = 0;
+  std::string str_v;
+  std::vector<Json> arr_v;
+  std::map<std::string, Json> obj_v;
+
+  static Json Parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = ParseValue(text, &pos);
+    SkipWs(text, &pos);
+    if (pos != text.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+  const Json& at(const std::string& key) const {
+    auto it = obj_v.find(key);
+    if (it == obj_v.end())
+      throw std::runtime_error("json: missing key " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const { return obj_v.count(key) > 0; }
+  const std::string& str() const { return str_v; }
+  double num() const { return num_v; }
+  int integer() const { return static_cast<int>(num_v); }
+
+ private:
+  static void SkipWs(const std::string& s, size_t* p) {
+    while (*p < s.size() && std::isspace(static_cast<unsigned char>(s[*p])))
+      ++*p;
+  }
+
+  static Json ParseValue(const std::string& s, size_t* p) {
+    SkipWs(s, p);
+    if (*p >= s.size()) throw std::runtime_error("json: eof");
+    char c = s[*p];
+    if (c == '{') return ParseObject(s, p);
+    if (c == '[') return ParseArray(s, p);
+    if (c == '"') return ParseString(s, p);
+    if (c == 't' || c == 'f') return ParseBool(s, p);
+    if (c == 'n') { Expect(s, p, "null"); return Json(); }
+    return ParseNumber(s, p);
+  }
+
+  static void Expect(const std::string& s, size_t* p, const char* lit) {
+    for (const char* q = lit; *q; ++q, ++*p)
+      if (*p >= s.size() || s[*p] != *q)
+        throw std::runtime_error(std::string("json: expected ") + lit);
+  }
+
+  static Json ParseBool(const std::string& s, size_t* p) {
+    Json v;
+    v.type = kBool;
+    if (s[*p] == 't') { Expect(s, p, "true"); v.bool_v = true; }
+    else { Expect(s, p, "false"); v.bool_v = false; }
+    return v;
+  }
+
+  static Json ParseNumber(const std::string& s, size_t* p) {
+    size_t end = *p;
+    while (end < s.size() &&
+           (std::isdigit(static_cast<unsigned char>(s[end])) ||
+            strchr("+-.eE", s[end])))
+      ++end;
+    Json v;
+    v.type = kNumber;
+    v.num_v = std::stod(s.substr(*p, end - *p));
+    *p = end;
+    return v;
+  }
+
+  static Json ParseString(const std::string& s, size_t* p) {
+    Json v;
+    v.type = kString;
+    ++*p;  // opening quote
+    while (*p < s.size() && s[*p] != '"') {
+      char c = s[(*p)++];
+      if (c == '\\' && *p < s.size()) {
+        char e = s[(*p)++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {  // \uXXXX -> keep ASCII subset, else '?'
+            if (*p + 4 > s.size())
+              throw std::runtime_error("json: bad \\u");
+            int code = std::stoi(s.substr(*p, 4), nullptr, 16);
+            *p += 4;
+            c = code < 128 ? static_cast<char>(code) : '?';
+            break;
+          }
+          default: c = e;
+        }
+      }
+      v.str_v.push_back(c);
+    }
+    if (*p >= s.size()) throw std::runtime_error("json: unterminated string");
+    ++*p;  // closing quote
+    return v;
+  }
+
+  static Json ParseArray(const std::string& s, size_t* p) {
+    Json v;
+    v.type = kArray;
+    ++*p;
+    SkipWs(s, p);
+    if (*p < s.size() && s[*p] == ']') { ++*p; return v; }
+    while (true) {
+      v.arr_v.push_back(ParseValue(s, p));
+      SkipWs(s, p);
+      if (*p >= s.size()) throw std::runtime_error("json: eof in array");
+      if (s[*p] == ',') { ++*p; continue; }
+      if (s[*p] == ']') { ++*p; break; }
+      throw std::runtime_error("json: bad array");
+    }
+    return v;
+  }
+
+  static Json ParseObject(const std::string& s, size_t* p) {
+    Json v;
+    v.type = kObject;
+    ++*p;
+    SkipWs(s, p);
+    if (*p < s.size() && s[*p] == '}') { ++*p; return v; }
+    while (true) {
+      SkipWs(s, p);
+      Json key = ParseString(s, p);
+      SkipWs(s, p);
+      if (*p >= s.size() || s[*p] != ':')
+        throw std::runtime_error("json: missing ':'");
+      ++*p;
+      v.obj_v[key.str_v] = ParseValue(s, p);
+      SkipWs(s, p);
+      if (*p >= s.size()) throw std::runtime_error("json: eof in object");
+      if (s[*p] == ',') { ++*p; continue; }
+      if (s[*p] == '}') { ++*p; break; }
+      throw std::runtime_error("json: bad object");
+    }
+    return v;
+  }
+};
+
+}  // namespace veles_native

@@ -1,0 +1,61 @@
+// Arena memory optimizer: bin-packs unit input/output buffers into one
+// arena by lifetime — the reference's standout native idea ("sliding
+// blocks to minimal height", ref libVeles src/memory_optimizer.cc,
+// src/memory_node.h; SURVEY.md §2.10).
+//
+// Each block has a [first_use, last_use] interval in execution order and a
+// byte size.  Blocks whose intervals overlap must not overlap in the
+// arena.  Greedy first-fit over size-descending blocks approximates the
+// minimal arena height.
+#pragma once
+
+#include <algorithm>
+#include <cstddef>
+#include <vector>
+
+namespace veles_native {
+
+struct MemoryBlock {
+  int first_use = 0;   // unit index producing/first reading the buffer
+  int last_use = 0;    // last unit index reading it
+  size_t size = 0;     // bytes
+  size_t offset = 0;   // assigned arena offset (output)
+};
+
+class MemoryOptimizer {
+ public:
+  // Assigns offsets; returns total arena height in bytes.
+  static size_t Optimize(std::vector<MemoryBlock>* blocks) {
+    std::vector<size_t> order(blocks->size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+    std::sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+      return (*blocks)[a].size > (*blocks)[b].size;
+    });
+    size_t height = 0;
+    std::vector<size_t> placed;
+    for (size_t oi : order) {
+      MemoryBlock& blk = (*blocks)[oi];
+      // candidate offsets: 0 and the top of every conflicting block
+      std::vector<std::pair<size_t, size_t>> conflicts;  // [off, end)
+      for (size_t pj : placed) {
+        const MemoryBlock& other = (*blocks)[pj];
+        bool live_overlap = !(blk.last_use < other.first_use ||
+                              other.last_use < blk.first_use);
+        if (live_overlap)
+          conflicts.emplace_back(other.offset, other.offset + other.size);
+      }
+      std::sort(conflicts.begin(), conflicts.end());
+      size_t off = 0;
+      for (auto& c : conflicts) {
+        if (off + blk.size <= c.first) break;  // fits in the gap
+        off = std::max(off, c.second);
+      }
+      blk.offset = off;
+      height = std::max(height, off + blk.size);
+      placed.push_back(oi);
+    }
+    return height;
+  }
+};
+
+}  // namespace veles_native

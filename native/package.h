@@ -1,0 +1,163 @@
+// Package reading: STORED-entry ZIP archive + .npy array parsing.
+// (Plays the roles of libarchive + NumpyArrayLoader in the reference's
+// libVeles — ref src/workflow_archive.cc, src/numpy_array_loader.cc.
+// Export writes ZIP_STORED so no inflate implementation is needed.)
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+// ---------------------------------------------------------------- zip ----
+class ZipReader {
+ public:
+  explicit ZipReader(const std::string& path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) throw std::runtime_error("cannot open " + path);
+    data_.assign(std::istreambuf_iterator<char>(f),
+                 std::istreambuf_iterator<char>());
+    ParseCentralDirectory();
+  }
+
+  bool has(const std::string& name) const { return entries_.count(name); }
+
+  std::string read(const std::string& name) const {
+    auto it = entries_.find(name);
+    if (it == entries_.end())
+      throw std::runtime_error("zip: no entry " + name);
+    size_t local = it->second.local_offset;
+    if (local + 30 > data_.size())
+      throw std::runtime_error("zip: bad local header");
+    if (U16(local + 8) != 0)
+      throw std::runtime_error("zip: only STORED entries supported");
+    uint16_t nlen = U16(local + 26), elen = U16(local + 28);
+    size_t start = local + 30 + nlen + elen;
+    if (start + it->second.size > data_.size())
+      throw std::runtime_error("zip: truncated entry " + name);
+    return std::string(data_.data() + start, it->second.size);
+  }
+
+  std::vector<std::string> names() const {
+    std::vector<std::string> out;
+    for (auto& kv : entries_) out.push_back(kv.first);
+    return out;
+  }
+
+ private:
+  struct Entry { size_t local_offset; size_t size; };
+
+  uint16_t U16(size_t p) const {
+    return static_cast<uint8_t>(data_[p]) |
+           (static_cast<uint8_t>(data_[p + 1]) << 8);
+  }
+  uint32_t U32(size_t p) const {
+    return static_cast<uint32_t>(U16(p)) |
+           (static_cast<uint32_t>(U16(p + 2)) << 16);
+  }
+
+  void ParseCentralDirectory() {
+    // find End Of Central Directory record (signature 0x06054b50)
+    if (data_.size() < 22) throw std::runtime_error("zip: too small");
+    size_t eocd = std::string::npos;
+    for (size_t i = data_.size() - 22; ; --i) {
+      if (U32(i) == 0x06054b50) { eocd = i; break; }
+      if (i == 0 || data_.size() - i > 22 + 65535) break;
+    }
+    if (eocd == std::string::npos)
+      throw std::runtime_error("zip: no EOCD");
+    uint16_t count = U16(eocd + 10);
+    size_t pos = U32(eocd + 16);
+    for (uint16_t i = 0; i < count; ++i) {
+      if (U32(pos) != 0x02014b50)
+        throw std::runtime_error("zip: bad central entry");
+      uint32_t size = U32(pos + 24);
+      uint16_t nlen = U16(pos + 28), elen = U16(pos + 30),
+               clen = U16(pos + 32);
+      uint32_t local = U32(pos + 42);
+      std::string name(data_.data() + pos + 46, nlen);
+      entries_[name] = Entry{local, size};
+      pos += 46 + nlen + elen + clen;
+    }
+  }
+
+  std::vector<char> data_;
+  std::map<std::string, Entry> entries_;
+};
+
+// ---------------------------------------------------------------- npy ----
+struct NpyArray {
+  std::vector<int> shape;
+  std::vector<float> data;
+
+  size_t elements() const {
+    size_t n = 1;
+    for (int d : shape) n *= static_cast<size_t>(d);
+    return n;
+  }
+};
+
+// Parses NPY format v1/v2, little-endian <f4 or <f8, C order.
+inline NpyArray ParseNpy(const std::string& bytes) {
+  if (bytes.size() < 10 || std::memcmp(bytes.data(), "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("npy: bad magic");
+  uint8_t major = bytes[6];
+  size_t hlen, hstart;
+  if (major == 1) {
+    hlen = static_cast<uint8_t>(bytes[8]) |
+           (static_cast<uint8_t>(bytes[9]) << 8);
+    hstart = 10;
+  } else {
+    if (bytes.size() < 12) throw std::runtime_error("npy: truncated");
+    hlen = static_cast<uint8_t>(bytes[8]) |
+           (static_cast<uint8_t>(bytes[9]) << 8) |
+           (static_cast<uint8_t>(bytes[10]) << 16) |
+           (static_cast<uint8_t>(bytes[11]) << 24);
+    hstart = 12;
+  }
+  std::string header = bytes.substr(hstart, hlen);
+  if (header.find("'fortran_order': True") != std::string::npos)
+    throw std::runtime_error("npy: fortran order unsupported");
+  bool f8 = header.find("<f8") != std::string::npos;
+  if (!f8 && header.find("<f4") == std::string::npos)
+    throw std::runtime_error("npy: dtype must be <f4 or <f8");
+  NpyArray arr;
+  size_t sp = header.find("'shape':");
+  size_t lp = header.find('(', sp), rp = header.find(')', lp);
+  std::string dims = header.substr(lp + 1, rp - lp - 1);
+  size_t p = 0;
+  while (p < dims.size()) {
+    while (p < dims.size() &&
+           !std::isdigit(static_cast<unsigned char>(dims[p])))
+      ++p;
+    if (p >= dims.size()) break;
+    size_t e = p;
+    while (e < dims.size() &&
+           std::isdigit(static_cast<unsigned char>(dims[e])))
+      ++e;
+    arr.shape.push_back(std::stoi(dims.substr(p, e - p)));
+    p = e;
+  }
+  size_t n = arr.elements();
+  size_t dstart = hstart + hlen;
+  size_t esize = f8 ? 8 : 4;
+  if (bytes.size() < dstart + n * esize)
+    throw std::runtime_error("npy: truncated data");
+  arr.data.resize(n);
+  if (f8) {
+    const double* src =
+        reinterpret_cast<const double*>(bytes.data() + dstart);
+    for (size_t i = 0; i < n; ++i)
+      arr.data[i] = static_cast<float>(src[i]);
+  } else {
+    std::memcpy(arr.data.data(), bytes.data() + dstart, n * 4);
+  }
+  return arr;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,382 @@
+// Native inference runtime: loads a veles_tpu package (ZIP of
+// contents.json + .npy arrays, ref Workflow.package_export
+// veles/workflow.py:864-971) and executes the forward pass on CPU.
+// Plays the role of the reference's libVeles engine (SURVEY.md §2.10):
+// package loader, unit factory, topological execute, arena memory
+// optimizer, C API for embedding.
+//
+// Build: make -C native   (produces libveles_native.so)
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "memory_optimizer.h"
+#include "package.h"
+
+namespace veles_native {
+
+struct Shape3 {  // H, W, C (or 1,1,F for flat)
+  int h = 1, w = 1, c = 1;
+  size_t elems() const {
+    return static_cast<size_t>(h) * w * c;
+  }
+};
+
+static Shape3 ToShape(const Json& arr) {
+  Shape3 s;
+  const auto& v = arr.arr_v;
+  if (v.size() == 1) { s.h = 1; s.w = 1; s.c = v[0].integer(); }
+  else if (v.size() == 2) { s.h = 1; s.w = v[0].integer(); s.c = v[1].integer(); }
+  else if (v.size() == 3) {
+    s.h = v[0].integer(); s.w = v[1].integer(); s.c = v[2].integer();
+  } else if (!v.empty()) {
+    throw std::runtime_error("unsupported shape rank");
+  }
+  return s;
+}
+
+// ------------------------------------------------------------ activations
+enum class Act { kLinear, kTanh, kSigmoid, kRelu, kStrictRelu, kLog };
+
+static Act ActOf(const std::string& type) {
+  auto ends = [&](const char* suf) {
+    size_t n = std::strlen(suf);
+    return type.size() >= n && type.compare(type.size() - n, n, suf) == 0;
+  };
+  if (ends("strict_relu")) return Act::kStrictRelu;
+  if (ends("relu")) return Act::kRelu;
+  if (ends("tanh")) return Act::kTanh;
+  if (ends("sigmoid")) return Act::kSigmoid;
+  if (ends("_log")) return Act::kLog;
+  return Act::kLinear;
+}
+
+static inline float Activate(float v, Act a) {
+  switch (a) {
+    case Act::kTanh: return 1.7159f * std::tanh(0.6666f * v);
+    case Act::kSigmoid: return 1.0f / (1.0f + std::exp(-v));
+    case Act::kRelu:  // Veles RELU = softplus
+      return v > 20.f ? v : std::log1p(std::exp(v));
+    case Act::kStrictRelu: return v > 0.f ? v : 0.f;
+    case Act::kLog: return std::asinh(v);
+    default: return v;
+  }
+}
+
+// ------------------------------------------------------------------ unit
+struct Unit {
+  std::string name, type;
+  Shape3 in, out;
+  Act act = Act::kLinear;
+  NpyArray weights, bias;
+  bool has_weights = false, has_bias = false;
+  // layer-specific config
+  int kx = 0, ky = 0, sx = 1, sy = 1;
+  int pad_t = 0, pad_l = 0, pad_b = 0, pad_r = 0;
+  float alpha = 1e-4f, beta = 0.75f, knorm = 2.0f;
+  int nwin = 15;
+  int off_y = 0, off_x = 0;
+
+  void Execute(const float* x, float* y, int batch) const;
+};
+
+static bool StartsWith(const std::string& s, const char* pre) {
+  return s.rfind(pre, 0) == 0;
+}
+
+void Unit::Execute(const float* x, float* y, int batch) const {
+  if (StartsWith(type, "all2all") || type == "softmax") {
+    int ni = static_cast<int>(in.elems()), no = static_cast<int>(out.elems());
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * ni;
+      float* yb = y + static_cast<size_t>(b) * no;
+      for (int o = 0; o < no; ++o)
+        yb[o] = has_bias ? bias.data[o] : 0.f;
+      for (int i = 0; i < ni; ++i) {      // i-major: streams W row-wise
+        float xv = xb[i];
+        const float* wrow = &weights.data[static_cast<size_t>(i) * no];
+        for (int o = 0; o < no; ++o) yb[o] += xv * wrow[o];
+      }
+      for (int o = 0; o < no; ++o) yb[o] = Activate(yb[o], act);
+    }
+  } else if (StartsWith(type, "conv")) {
+    int ci = in.c, co = out.c;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int oy = 0; oy < out.h; ++oy)
+        for (int ox = 0; ox < out.w; ++ox)
+          for (int oc = 0; oc < co; ++oc) {
+            float acc = has_bias ? bias.data[oc] : 0.f;
+            for (int fy = 0; fy < ky; ++fy) {
+              int iy = oy * sy + fy - pad_t;
+              if (iy < 0 || iy >= in.h) continue;
+              for (int fx = 0; fx < kx; ++fx) {
+                int ix = ox * sx + fx - pad_l;
+                if (ix < 0 || ix >= in.w) continue;
+                const float* xp =
+                    xb + (static_cast<size_t>(iy) * in.w + ix) * ci;
+                const float* wp = &weights.data[
+                    ((static_cast<size_t>(fy) * kx + fx) * ci) * co +
+                    oc];
+                for (int icc = 0; icc < ci; ++icc)
+                  acc += xp[icc] * wp[static_cast<size_t>(icc) * co];
+              }
+            }
+            yb[(static_cast<size_t>(oy) * out.w + ox) * co + oc] =
+                Activate(acc, act);
+          }
+    }
+  } else if (type == "max_pooling" || type == "avg_pooling" ||
+             type == "maxabs_pooling") {
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int oy = 0; oy < out.h; ++oy)
+        for (int ox = 0; ox < out.w; ++ox)
+          for (int cc = 0; cc < in.c; ++cc) {
+            float best = 0.f, sum = 0.f;
+            bool first = true;
+            int cnt = 0;
+            for (int fy = 0; fy < ky; ++fy) {
+              int iy = oy * sy + fy;
+              if (iy >= in.h) continue;
+              for (int fx = 0; fx < kx; ++fx) {
+                int ix = ox * sx + fx;
+                if (ix >= in.w) continue;
+                float v = xb[(static_cast<size_t>(iy) * in.w + ix) *
+                             in.c + cc];
+                sum += v;
+                ++cnt;
+                if (type == "max_pooling") {
+                  if (first || v > best) best = v;
+                } else {  // maxabs_pooling
+                  if (first || std::fabs(v) > std::fabs(best)) best = v;
+                }
+                first = false;
+              }
+            }
+            float r = type[0] == 'a' ? (cnt ? sum / cnt : 0.f) : best;
+            yb[(static_cast<size_t>(oy) * out.w + ox) * in.c + cc] = r;
+          }
+    }
+  } else if (type == "norm") {  // LRN across channels
+    int half = nwin / 2;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int p = 0; p < in.h * in.w; ++p) {
+        const float* xp = xb + static_cast<size_t>(p) * in.c;
+        float* yp = yb + static_cast<size_t>(p) * in.c;
+        for (int cc = 0; cc < in.c; ++cc) {
+          float ssum = 0.f;
+          for (int j = std::max(0, cc - half);
+               j <= std::min(in.c - 1, cc + half); ++j)
+            ssum += xp[j] * xp[j];
+          yp[cc] = xp[cc] * std::pow(knorm + alpha * ssum, -beta);
+        }
+      }
+    }
+  } else if (type == "cutter") {
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int oy = 0; oy < out.h; ++oy)
+        for (int ox = 0; ox < out.w; ++ox)
+          std::memcpy(
+              yb + (static_cast<size_t>(oy) * out.w + ox) * in.c,
+              xb + (static_cast<size_t>(oy + off_y) * in.w + ox + off_x) *
+                       in.c,
+              sizeof(float) * in.c);
+    }
+  } else if (type == "dropout" || StartsWith(type, "zerofiller")) {
+    std::memcpy(y, x, sizeof(float) * in.elems() * batch);  // inference no-op
+  } else if (StartsWith(type, "activation_")) {
+    Act a = ActOf(type);
+    size_t n = in.elems() * batch;
+    for (size_t i = 0; i < n; ++i) y[i] = Activate(x[i], a);
+  } else {
+    throw std::runtime_error("native runtime: unsupported unit type " +
+                             type);
+  }
+}
+
+// -------------------------------------------------------------- workflow
+class Workflow {
+ public:
+  explicit Workflow(const std::string& path) {
+    ZipReader zip(path);
+    Json manifest = Json::Parse(zip.read("contents.json"));
+    name_ = manifest.at("name").str();
+    softmax_output_ = manifest.at("loss").str() == "softmax";
+    for (const Json& ju : manifest.at("units").arr_v) {
+      Unit u;
+      u.name = ju.at("name").str();
+      u.type = ju.at("type").str();
+      u.in = ToShape(ju.at("input_shape"));
+      u.out = ToShape(ju.at("output_shape"));
+      u.act = ActOf(u.type);
+      const Json& cfg = ju.at("config");
+      auto geti = [&](const char* k, int dflt) {
+        return cfg.has(k) ? cfg.at(k).integer() : dflt;
+      };
+      u.kx = geti("kx", 0);
+      u.ky = geti("ky", 0);
+      if (cfg.has("sliding")) {
+        u.sy = cfg.at("sliding").arr_v[0].integer();
+        u.sx = cfg.at("sliding").arr_v[1].integer();
+      } else if (u.type.find("pooling") != std::string::npos) {
+        u.sy = u.ky; u.sx = u.kx;  // pooling stride defaults to the window
+      }
+      if (cfg.has("padding")) {
+        const auto& p = cfg.at("padding").arr_v;
+        u.pad_t = p[0].integer(); u.pad_l = p[1].integer();
+        u.pad_b = p[2].integer(); u.pad_r = p[3].integer();
+      }
+      if (cfg.has("alpha")) u.alpha = static_cast<float>(cfg.at("alpha").num());
+      if (cfg.has("beta")) u.beta = static_cast<float>(cfg.at("beta").num());
+      if (cfg.has("k")) u.knorm = static_cast<float>(cfg.at("k").num());
+      if (cfg.has("n")) u.nwin = cfg.at("n").integer();
+      if (cfg.has("offset")) {
+        u.off_y = cfg.at("offset").arr_v[0].integer();
+        u.off_x = cfg.at("offset").arr_v[1].integer();
+      }
+      const Json& arrays = ju.at("arrays");
+      if (arrays.has("weights")) {
+        u.weights = ParseNpy(zip.read(arrays.at("weights").str()));
+        u.has_weights = true;
+      }
+      if (arrays.has("bias")) {
+        u.bias = ParseNpy(zip.read(arrays.at("bias").str()));
+        u.has_bias = true;
+      }
+      units_.push_back(std::move(u));
+    }
+    if (units_.empty()) throw std::runtime_error("empty workflow");
+  }
+
+  size_t input_elems() const { return units_.front().in.elems(); }
+  size_t output_elems() const { return units_.back().out.elems(); }
+  size_t arena_bytes() const { return arena_bytes_; }
+  const std::vector<Unit>& units() const { return units_; }
+  const std::string& name() const { return name_; }
+
+  // Plan the arena for a given batch size (ref MemoryOptimizer::Optimize).
+  void Plan(int batch) {
+    if (batch == planned_batch_) return;
+    blocks_.clear();
+    // block i = output buffer of unit i, live from producer i to consumer
+    // i+1; block for the network input is the caller's buffer.
+    for (size_t i = 0; i < units_.size(); ++i) {
+      MemoryBlock blk;
+      blk.first_use = static_cast<int>(i);
+      blk.last_use = static_cast<int>(i + 1);
+      blk.size = units_[i].out.elems() * batch * sizeof(float);
+      blocks_.push_back(blk);
+    }
+    arena_bytes_ = MemoryOptimizer::Optimize(&blocks_);
+    arena_.resize(arena_bytes_ / sizeof(float) + 1);
+    planned_batch_ = batch;
+  }
+
+  void Infer(const float* input, int batch, float* output) {
+    Plan(batch);
+    const float* x = input;
+    for (size_t i = 0; i < units_.size(); ++i) {
+      float* y = arena_.data() + blocks_[i].offset / sizeof(float);
+      units_[i].Execute(x, y, batch);
+      x = y;
+    }
+    size_t no = output_elems();
+    std::memcpy(output, x, sizeof(float) * no * batch);
+    if (softmax_output_) {
+      for (int b = 0; b < batch; ++b) {
+        float* ob = output + static_cast<size_t>(b) * no;
+        float mx = ob[0];
+        for (size_t j = 1; j < no; ++j) mx = std::max(mx, ob[j]);
+        float sum = 0.f;
+        for (size_t j = 0; j < no; ++j) {
+          ob[j] = std::exp(ob[j] - mx);
+          sum += ob[j];
+        }
+        for (size_t j = 0; j < no; ++j) ob[j] /= sum;
+      }
+    }
+  }
+
+ private:
+  std::string name_;
+  bool softmax_output_ = false;
+  std::vector<Unit> units_;
+  std::vector<MemoryBlock> blocks_;
+  std::vector<float> arena_;
+  size_t arena_bytes_ = 0;
+  int planned_batch_ = -1;
+};
+
+}  // namespace veles_native
+
+// ------------------------------------------------------------------ C API
+extern "C" {
+
+void* veles_native_load(const char* path, char* err, int errlen) {
+  try {
+    return new veles_native::Workflow(path);
+  } catch (const std::exception& e) {
+    if (err && errlen > 0) {
+      std::strncpy(err, e.what(), errlen - 1);
+      err[errlen - 1] = '\0';
+    }
+    return nullptr;
+  }
+}
+
+int veles_native_input_size(void* h) {
+  return static_cast<int>(
+      static_cast<veles_native::Workflow*>(h)->input_elems());
+}
+
+int veles_native_output_size(void* h) {
+  return static_cast<int>(
+      static_cast<veles_native::Workflow*>(h)->output_elems());
+}
+
+int veles_native_num_units(void* h) {
+  return static_cast<int>(
+      static_cast<veles_native::Workflow*>(h)->units().size());
+}
+
+const char* veles_native_unit_name(void* h, int i) {
+  const auto& units = static_cast<veles_native::Workflow*>(h)->units();
+  if (i < 0 || i >= static_cast<int>(units.size())) return "";
+  return units[i].name.c_str();
+}
+
+long veles_native_arena_bytes(void* h, int batch) {
+  auto* wf = static_cast<veles_native::Workflow*>(h);
+  wf->Plan(batch);
+  return static_cast<long>(wf->arena_bytes());
+}
+
+int veles_native_infer(void* h, const float* input, int batch,
+                       float* output) {
+  try {
+    static_cast<veles_native::Workflow*>(h)->Infer(input, batch, output);
+    return 0;
+  } catch (const std::exception&) {
+    return -1;
+  }
+}
+
+void veles_native_free(void* h) {
+  delete static_cast<veles_native::Workflow*>(h);
+}
+
+}  // extern "C"

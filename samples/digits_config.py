@@ -1,0 +1,9 @@
+"""Config file for samples/digits_mlp.py — executed with `root` in scope
+(ref per-run config contract, veles __main__ _apply_config)."""
+
+root.digits.update({
+    "hidden": 60,
+    "learning_rate": 0.1,
+    "max_epochs": 10,
+    "minibatch_size": 100,
+})

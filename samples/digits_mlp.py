@@ -1,0 +1,33 @@
+"""Sample workflow: digits MLP (the MnistSimple-shaped baseline on the
+offline-available sklearn digits set).  Run:
+
+    python -m veles_tpu samples/digits_mlp.py samples/digits_config.py
+
+Demonstrates the reference's module contract: define run(load, main)
+(ref veles __main__ run-module contract)."""
+
+import numpy as np
+from sklearn.datasets import load_digits
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import mnist_mlp
+
+
+def run(load, main):
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    cfg = root.digits
+    loader = FullBatchLoader(
+        None, data=x, labels=y,
+        minibatch_size=cfg.get("minibatch_size", 100),
+        class_lengths=[0, 297, 1500])
+    load(StandardWorkflow,
+         layers=mnist_mlp(hidden=cfg.get("hidden", 60),
+                          lr=cfg.get("learning_rate", 0.1)),
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 10)},
+         name="digits-mlp")
+    main()

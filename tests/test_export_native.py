@@ -1,0 +1,133 @@
+"""Python→C++ package round-trip tests (ref: libVeles GoogleTest suite
+loading real exported packages, SURVEY.md §4 — 'the Python→C++ package
+contract is round-trip tested')."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.services.export import export_workflow, import_workflow
+
+HAS_GXX = shutil.which("g++") is not None
+
+
+def train_small(layers, epochs=4, img=False, seed=13):
+    prng.seed_all(seed)
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    if img:
+        x = x.reshape(-1, 8, 8, 1)
+    y = d.target.astype(np.int32)
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                             class_lengths=[0, 297, 1500])
+    wf = StandardWorkflow(layers=layers, loader=loader,
+                          decision_config={"max_epochs": epochs},
+                          name="export-test")
+    wf.initialize()
+    wf.run()
+    return wf, x
+
+
+MLP_LAYERS = [
+    {"type": "all2all_tanh", "output_sample_shape": 32,
+     "learning_rate": 0.1, "gradient_moment": 0.9},
+    {"type": "softmax", "output_sample_shape": 10,
+     "learning_rate": 0.1, "gradient_moment": 0.9},
+]
+
+CONV_LAYERS = [
+    {"type": "conv_strict_relu", "n_kernels": 6, "kx": 3, "ky": 3,
+     "padding": (1, 1, 1, 1), "learning_rate": 0.1,
+     "gradient_moment": 0.9},
+    {"type": "max_pooling", "kx": 2, "ky": 2},
+    {"type": "norm", "alpha": 1e-4, "beta": 0.75, "n": 5, "k": 2.0},
+    {"type": "softmax", "output_sample_shape": 10,
+     "learning_rate": 0.1, "gradient_moment": 0.9},
+]
+
+
+class TestExport:
+    def test_package_roundtrip_python(self, tmp_path):
+        wf, _ = train_small(MLP_LAYERS, epochs=1)
+        path = str(tmp_path / "model.zip")
+        export_workflow(wf, path)
+        manifest, arrays = import_workflow(path)
+        assert manifest["loss"] == "softmax"
+        assert len(manifest["units"]) == 2
+        w_file = manifest["units"][0]["arrays"]["weights"]
+        got = arrays[w_file]
+        want = np.asarray(
+            wf.trainer.params[wf.trainer.layers[0].name]["weights"])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.skipif(not HAS_GXX, reason="no g++ toolchain")
+class TestNativeRuntime:
+    def test_mlp_native_matches_jax(self, tmp_path):
+        from veles_tpu.services.native import NativeWorkflow
+        wf, x = train_small(MLP_LAYERS)
+        path = str(tmp_path / "mlp.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:64]))
+        got = native(x[:64])
+        # JAX computes in bf16 (policy), native in f32: ~1e-2 agreement
+        np.testing.assert_allclose(got, want, atol=1e-2)
+        np.testing.assert_array_equal(got.argmax(1), want.argmax(1))
+        assert native.unit_names[0].startswith("l00")
+        native.close()
+
+    def test_conv_native_matches_jax(self, tmp_path):
+        from veles_tpu.services.native import NativeWorkflow
+        wf, x = train_small(CONV_LAYERS, img=True, epochs=2)
+        path = str(tmp_path / "conv.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:16]))
+        got = native(x[:16].reshape(16, -1))
+        np.testing.assert_allclose(got, want, atol=1e-2)
+        np.testing.assert_array_equal(got.argmax(1), want.argmax(1))
+        native.close()
+
+    def test_arena_is_smaller_than_naive(self, tmp_path):
+        """The memory optimizer packs lifetimes: arena < sum of all
+        buffers (ref libVeles memory_optimizer 'minimal height')."""
+        from veles_tpu.services.native import NativeWorkflow
+        wf, _ = train_small(CONV_LAYERS, img=True, epochs=1)
+        path = str(tmp_path / "arena.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        batch = 8
+        naive = sum(
+            int(np.prod(lay.output_shape)) * batch * 4
+            for lay in wf.trainer.layers)
+        arena = native.arena_bytes(batch)
+        assert arena < naive
+        assert arena >= max(int(np.prod(lay.output_shape)) * batch * 4
+                            for lay in wf.trainer.layers)
+        native.close()
+
+    def test_bad_package_error(self, tmp_path):
+        from veles_tpu.services.native import NativeWorkflow
+        bad = tmp_path / "bad.zip"
+        bad.write_bytes(b"not a zip")
+        with pytest.raises(RuntimeError, match="native load failed"):
+            NativeWorkflow(str(bad))
+
+    def test_wrong_input_size_raises(self, tmp_path):
+        from veles_tpu.services.native import NativeWorkflow
+        wf, x = train_small(MLP_LAYERS, epochs=1)
+        path = str(tmp_path / "m.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        with pytest.raises(ValueError, match="input features"):
+            native(np.zeros((2, 10), np.float32))
+        native.close()

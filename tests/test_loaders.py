@@ -1,0 +1,196 @@
+"""Loader breadth tests (ref SURVEY §4: loader tests live in
+veles/tests/test_loader.py with HDF5 fixtures; streaming covered by
+test_zmq_loader.py)."""
+
+import gzip
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.formats import (HDF5Loader, MinibatchesSaver,
+                                      PickleLoader, read_minibatches)
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.image import (FullBatchImageLoader, auto_label,
+                                    decode_image, scan_files)
+from veles_tpu.loader.streaming import InteractiveLoader, ZeroMQLoader
+
+
+def make_png(path, color, size=(10, 8)):
+    from PIL import Image
+    Image.new("RGB", size, color).save(path)
+
+
+class TestImageLoader:
+    def test_scan_and_auto_label(self, tmp_path):
+        for cls, color in (("cats", (255, 0, 0)), ("dogs", (0, 255, 0))):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                make_png(str(d / ("%d.png" % i)), color)
+        files = scan_files(str(tmp_path))
+        assert len(files) == 6
+        labels, names = auto_label(files)
+        assert names == ["cats", "dogs"]
+        assert (np.bincount(labels) == [3, 3]).all()
+
+    def test_decode_resize_gray(self, tmp_path):
+        p = str(tmp_path / "img.png")
+        make_png(p, (128, 128, 128), size=(20, 14))
+        arr = decode_image(p, size=(7, 5), grayscale=True)
+        assert arr.shape == (7, 5, 1)
+        assert 0.4 < arr.mean() < 0.6
+
+    def test_fullbatch_image_loader_trains_shape(self, tmp_path):
+        for cls, color in (("a", (250, 10, 10)), ("b", (10, 250, 10))):
+            d = tmp_path / "train" / cls
+            d.mkdir(parents=True)
+            for i in range(8):
+                make_png(str(d / ("%d.png" % i)), color)
+        loader = FullBatchImageLoader(
+            None, train_paths=str(tmp_path / "train"), size=(8, 8),
+            minibatch_size=4, class_lengths=None)
+        loader.class_lengths = [0, 0, 0]
+        loader.load_data()
+        assert loader.class_lengths == [0, 0, 16]
+        assert loader.original_data.shape == (16, 8, 8, 3)
+        assert loader.label_names == ["a", "b"]
+
+
+class TestFormatLoaders:
+    def test_hdf5_loader(self, tmp_path):
+        import h5py
+        for name, n in (("train", 20), ("validation", 8)):
+            with h5py.File(str(tmp_path / (name + ".h5")), "w") as f:
+                f["data"] = np.random.rand(n, 6).astype(np.float32)
+                f["labels"] = np.arange(n, dtype=np.int32) % 3
+        loader = HDF5Loader(
+            None, files={"train": str(tmp_path / "train.h5"),
+                         "validation": str(tmp_path / "validation.h5")},
+            minibatch_size=10)
+        loader.initialize()
+        assert loader.class_lengths == [0, 8, 20]
+        assert loader.data.shape == (28, 6)
+
+    def test_pickle_loader_gz(self, tmp_path):
+        path = str(tmp_path / "train.pkl.gz")
+        with gzip.open(path, "wb") as f:
+            pickle.dump({"data": np.ones((12, 4), np.float32),
+                         "labels": np.zeros(12, np.int64)}, f)
+        loader = PickleLoader(None, files={"train": path},
+                              minibatch_size=6)
+        loader.initialize()
+        assert loader.class_lengths == [0, 0, 12]
+
+    def test_minibatches_saver_roundtrip(self, tmp_path):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = (np.arange(20) % 4).astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=8,
+                                 class_lengths=[0, 4, 16], shuffle=False)
+        loader.initialize()
+        path = str(tmp_path / "stream.sav.gz")
+        saver = MinibatchesSaver(None, path=path)
+        saver.loader = loader
+        saver.initialize()
+        served = 0
+        while True:
+            loader.run()
+            saver.run()
+            served += 1
+            if bool(loader.epoch_ended):
+                break
+        saver.stop()
+        header, records = read_minibatches(path)
+        assert header["minibatch_size"] == 8
+        assert len(records) == served
+        assert records[0]["cls"] == VALID
+        assert records[-1]["cls"] == TRAIN
+        np.testing.assert_array_equal(
+            records[0]["data"][0], x[0])
+
+
+class TestStreaming:
+    def test_interactive_loader_feeds(self):
+        loader = InteractiveLoader(None, sample_shape=(3,),
+                                   minibatch_size=4)
+        loader.initialize()
+        for i in range(2):
+            loader.feed(np.full(3, float(i)))
+        loader.run()
+        assert loader.minibatch_valid.sum() == 2
+        np.testing.assert_array_equal(loader.minibatch_data[1],
+                                      np.ones(3))
+
+    def test_zeromq_loader_receives(self):
+        import zmq
+        loader = ZeroMQLoader(None, sample_shape=(2,), minibatch_size=2)
+        loader.initialize()
+        ctx = zmq.Context.instance()
+        push = ctx.socket(zmq.PUSH)
+        push.connect(loader.endpoint)
+        push.send_pyobj(np.array([1.0, 2.0], np.float32))
+        push.send_pyobj(np.array([3.0, 4.0], np.float32))
+        loader.run()
+        assert loader.minibatch_valid.sum() == 2
+        np.testing.assert_array_equal(loader.minibatch_data,
+                                      [[1, 2], [3, 4]])
+        push.close(0)
+
+
+class TestDataCarryingIntegration:
+    def test_minibatches_loader_drives_standard_workflow(self, tmp_path):
+        """Replay stream drives real training (the integration path the
+        reference's MinibatchesLoader supported)."""
+        from veles_tpu import prng
+        from veles_tpu.loader.formats import MinibatchesLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+        prng.seed_all(41)
+        g = np.random.RandomState(0)
+        x = g.rand(200, 8).astype(np.float32)
+        y = (x.sum(1) > 4).astype(np.int32)
+        src = FullBatchLoader(None, data=x, labels=y, minibatch_size=20,
+                              class_lengths=[0, 40, 160], shuffle=False)
+        src.initialize()
+        path = str(tmp_path / "stream.sav.gz")
+        saver = MinibatchesSaver(None, path=path)
+        saver.loader = src
+        saver.initialize()
+        while True:
+            src.run()
+            saver.run()
+            if bool(src.epoch_ended):
+                break
+        saver.stop()
+
+        replay = MinibatchesLoader(None, path=path, minibatch_size=20)
+        wf = StandardWorkflow(
+            layers=[{"type": "softmax", "output_sample_shape": 2,
+                     "learning_rate": 0.3, "gradient_moment": 0.9}],
+            loader=replay, decision_config={"max_epochs": 12},
+            name="replay-train")
+        wf.initialize()
+        wf.run()
+        assert wf.decision.best_metric < 0.35
+
+    def test_interactive_loader_eval_path(self):
+        from veles_tpu import prng
+        from veles_tpu.models.nn_units import StagedTrainer
+        from veles_tpu.models.layers import make_layer
+        prng.seed_all(2)
+        loader = InteractiveLoader(None, sample_shape=(4,),
+                                   minibatch_size=2)
+        loader.initialize()
+        trainer = StagedTrainer(
+            None, [make_layer({"type": "softmax",
+                               "output_sample_shape": 3})])
+        trainer.loader = loader
+        trainer.initialize()
+        loader.feed(np.ones(4))
+        loader.feed(np.zeros(4))
+        loader.run()
+        trainer.run()   # TEST class -> eval step, no crash
+        stats = trainer.read_class_stats(TEST)
+        assert stats["count"] == 2

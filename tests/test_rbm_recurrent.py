@@ -1,0 +1,103 @@
+"""RBM + LSTM/RNN engine tests (ref SURVEY §2.9 'Other documented
+engines': RBM numpy engine, RNN/LSTM in-progress — completed here)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.rbm import RBMWorkflow
+from veles_tpu.models.standard_workflow import StandardWorkflow
+
+
+class TestRBM:
+    def test_rbm_learns_digits(self):
+        prng.seed_all(23)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                 class_lengths=[0, 0, len(x)])
+        wf = RBMWorkflow(loader=loader, n_hidden=48, n_epochs=8,
+                         learning_rate=0.3, name="rbm")
+        wf.initialize()
+        wf.run()
+        assert len(wf.rmse_history) == 8
+        assert wf.rmse_history[-1] < wf.rmse_history[0]
+        assert wf.rmse_history[-1] < 0.25
+        # hidden representation separates at least a little: reconstruction
+        # of real digits should beat reconstruction of noise
+        recon = np.asarray(wf.trainer.reconstruct(x[:200]))
+        err_real = np.sqrt(((recon - x[:200]) ** 2).mean())
+        noise = np.random.RandomState(0).rand(200, 64).astype(np.float32)
+        recon_n = np.asarray(wf.trainer.reconstruct(noise))
+        err_noise = np.sqrt(((recon_n - noise) ** 2).mean())
+        assert err_real < err_noise
+
+    def test_rbm_reproducible(self):
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)[:400]
+
+        def run():
+            prng.seed_all(7)
+            loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                     class_lengths=[0, 0, len(x)])
+            wf = RBMWorkflow(loader=loader, n_hidden=16, n_epochs=2,
+                             name="rbm-r")
+            wf.initialize()
+            wf.run()
+            return np.asarray(wf.trainer.params["weights"])
+
+        np.testing.assert_array_equal(run(), run())
+
+
+def sequence_dataset(n=1200, t=12, seed=0):
+    """Classify whether the sequence sum is positive — requires
+    integrating over time."""
+    g = np.random.RandomState(seed)
+    x = g.normal(0, 1, (n, t, 4)).astype(np.float32)
+    y = (x.sum(axis=(1, 2)) > 0).astype(np.int32)
+    return x, y
+
+
+class TestRecurrent:
+    @pytest.mark.parametrize("kind", ["lstm", "rnn_tanh"])
+    def test_sequence_classification(self, kind):
+        prng.seed_all(31)
+        x, y = sequence_dataset()
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                                 class_lengths=[0, 200, 1000])
+        wf = StandardWorkflow(
+            layers=[
+                {"type": kind, "output_sample_shape": 16,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 2,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+            ],
+            loader=loader, decision_config={"max_epochs": 15},
+            name="seq-" + kind)
+        wf.initialize()
+        wf.run()
+        assert wf.decision.best_metric < 0.15, wf.decision.best_metric
+
+    def test_return_sequences_stacking(self):
+        prng.seed_all(5)
+        x, y = sequence_dataset(400)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                                 class_lengths=[0, 100, 300], name="l2")
+        wf = StandardWorkflow(
+            layers=[
+                {"type": "lstm", "output_sample_shape": 8,
+                 "return_sequences": True, "learning_rate": 0.05,
+                 "gradient_moment": 0.9},
+                {"type": "lstm", "output_sample_shape": 8,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 2,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+            ],
+            loader=loader, decision_config={"max_epochs": 3},
+            name="seq-stack")
+        wf.initialize()
+        wf.run()
+        assert wf.trainer.layers[0].output_shape == (12, 8)
+        assert wf.decision.best_metric is not None

@@ -1,0 +1,159 @@
+"""Service-layer tests (ref SURVEY §4 'Service tests': the reference POSTs
+to a live RESTfulAPI unit and spins real servers on localhost — same
+approach here with the stdlib client)."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.services.plotting import (AccumulatingPlotter, MatrixPlotter,
+                                         bus)
+from veles_tpu.services.restful import RESTfulAPI
+from veles_tpu.services.web_status import WebStatusServer
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.read()
+
+
+class TestRESTful:
+    @pytest.fixture(scope="class")
+    def served_model(self):
+        prng.seed_all(17)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        y = d.target.astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                                 class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[{"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.2, "gradient_moment": 0.9}],
+            loader=loader, decision_config={"max_epochs": 5},
+            name="rest-model")
+        wf.initialize()
+        wf.run()
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda xx: np.asarray(fwd(params, xx)),
+                         (64,), port=0)
+        api.start()
+        yield api, x, y
+        api.stop()
+
+    def test_post_list_codec(self, served_model):
+        api, x, y = served_model
+        out = _post("http://127.0.0.1:%d/service" % api.port,
+                    {"input": x[:3].tolist()})
+        probs = np.asarray(out["result"])
+        assert probs.shape == (3, 10)
+        np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+        assert (probs.argmax(1) == y[:3]).mean() >= 2 / 3
+
+    def test_post_base64_codec(self, served_model):
+        import base64
+        api, x, _ = served_model
+        payload = {"codec": "base64",
+                   "input": base64.b64encode(x[:2].tobytes()).decode(),
+                   "shape": [2, 64]}
+        out = _post("http://127.0.0.1:%d/service" % api.port, payload)
+        assert np.asarray(out["result"]).shape == (2, 10)
+
+    def test_bad_input_returns_error_json(self, served_model):
+        api, _, _ = served_model
+        try:
+            _post("http://127.0.0.1:%d/service" % api.port,
+                  {"input": [[1.0, 2.0]]})
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+
+
+class TestWebStatus:
+    def test_dashboard_and_apis(self):
+        server = WebStatusServer(port=0)
+        server.start()
+        try:
+            base = "http://127.0.0.1:%d" % server.port
+            assert b"veles_tpu status" in _get(base + "/")
+            status = json.loads(_get(base + "/api/status"))
+            assert "workflows" in status
+            out = _post(base + "/update", {"node": "r1", "epoch": 3})
+            assert out["ok"]
+            status = json.loads(_get(base + "/api/status"))
+            assert status["remote"][-1]["update"]["epoch"] == 3
+            assert isinstance(json.loads(_get(base + "/api/events")), list)
+        finally:
+            server.stop()
+
+
+class TestPlotters:
+    def test_accumulating_plotter_writes_png(self, tmp_path):
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="plots")
+        values = iter([0.5, 0.4, 0.3])
+        p = AccumulatingPlotter(wf, source=lambda: next(values),
+                                directory=str(tmp_path), ylabel="err")
+        p.run()
+        p.run()
+        assert p.last_file and p.last_file.endswith(".png")
+        import os
+        assert os.path.getsize(p.last_file) > 500
+        assert bus.snapshot()[-1]["kind"] == "curve"
+
+    def test_matrix_plotter(self, tmp_path):
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="plots2")
+        m = np.eye(4) * 5
+        p = MatrixPlotter(wf, source=lambda: m, directory=str(tmp_path))
+        p.run()
+        import os
+        assert os.path.exists(p.last_file)
+
+
+class TestCLI:
+    def test_sample_workflow_via_cli(self, tmp_path):
+        result_file = str(tmp_path / "results.json")
+        export_file = str(tmp_path / "model.zip")
+        proc = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
+             "samples/digits_config.py", "--backend", "cpu",
+             "--random-seed", "5",
+             "--config-list", "root.digits.max_epochs=2",
+             "--result-file", result_file, "--export", export_file],
+            capture_output=True, text=True, timeout=300,
+            cwd=str(__import__("pathlib").Path(__file__).parent.parent))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        results = json.load(open(result_file))
+        assert results["epochs"] == 2
+        assert results["best_metric"] < 0.5
+        from veles_tpu.services.export import import_workflow
+        manifest, _ = import_workflow(export_file)
+        assert manifest["name"] == "digits-mlp"
+
+    def test_cli_snapshot_resume(self, tmp_path):
+        snap_dir = str(tmp_path / "snaps")
+        base = [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
+                "--backend", "cpu", "--random-seed", "5"]
+        cwd = str(__import__("pathlib").Path(__file__).parent.parent)
+        p1 = subprocess.run(
+            base + ["--config-list", "root.digits.max_epochs=2"],
+            capture_output=True, text=True, timeout=300, cwd=cwd)
+        assert p1.returncode == 0, p1.stderr[-2000:]

@@ -1,0 +1,151 @@
+"""CLI entry point (ref: veles/__main__.py — `python -m veles_tpu
+workflow.py [config.py]`).
+
+Keeps the reference's module contract (__main__.py:799-818): the workflow
+file defines ``run(load, main)`` and calls
+``load(WorkflowClass, **kwargs)`` to construct (or snapshot-resume) the
+workflow, then ``main(**kwargs)`` to initialize + run it.  Config files
+are Python executed with ``root`` in scope, mutating the global config
+tree (ref _apply_config, __main__.py:426-472); ``--config-list`` inline
+statements layer on top."""
+
+import argparse
+import json
+import runpy
+import sys
+
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.logger import setup_logging
+
+
+class Main(object):
+    def __init__(self, argv=None):
+        self.argv = argv if argv is not None else sys.argv[1:]
+        self.workflow = None
+
+    def parse(self):
+        p = argparse.ArgumentParser(
+            prog="veles_tpu",
+            description="TPU-native deep-learning platform")
+        p.add_argument("workflow", help="workflow .py file defining "
+                       "run(load, main)")
+        p.add_argument("config", nargs="?", help="config .py file "
+                       "executed with `root` in scope")
+        p.add_argument("--config-list", nargs="*", default=[],
+                       help="inline config statements, e.g. "
+                       "'root.mnist.lr=0.1'")
+        p.add_argument("--random-seed", type=int, default=None)
+        p.add_argument("--snapshot", default=None,
+                       help="resume from a snapshot file")
+        p.add_argument("--test", action="store_true",
+                       help="skip training; run forward on the loader's "
+                       "test/validation set")
+        p.add_argument("--result-file", default=None,
+                       help="write gather_results() JSON here")
+        p.add_argument("--export", default=None,
+                       help="export trained model package to this path")
+        p.add_argument("--serve", type=int, default=None, metavar="PORT",
+                       help="after training, serve the model over REST")
+        p.add_argument("--web-status", type=int, default=None,
+                       metavar="PORT", help="launch the status dashboard")
+        p.add_argument("--backend", default=None,
+                       help="cpu|tpu|<platform> override")
+        p.add_argument("--verbose", "-v", action="count", default=0)
+        return p.parse_args(self.argv)
+
+    def run(self):
+        args = self.parse()
+        import logging
+        setup_logging(logging.DEBUG if args.verbose else logging.INFO)
+        if args.backend:
+            import jax
+            jax.config.update(
+                "jax_platforms",
+                "cpu" if args.backend == "cpu" else args.backend)
+        if args.random_seed is not None:
+            prng.seed_all(args.random_seed)
+        self._apply_config(args)
+
+        web = None
+        if args.web_status is not None:
+            from veles_tpu.services.web_status import WebStatusServer
+            web = WebStatusServer(port=args.web_status)
+            web.start()
+
+        wf_globals = runpy.run_path(args.workflow, run_name="__veles__")
+        if "run" not in wf_globals:
+            raise SystemExit("%s does not define run(load, main)"
+                             % args.workflow)
+
+        def load(cls, **kwargs):
+            self.workflow = cls(**kwargs)
+            if args.snapshot:
+                from veles_tpu.services.snapshotter import SnapshotterBase
+                # initialize first so staged steps exist, then restore
+                self._pending_snapshot = SnapshotterBase.import_(
+                    args.snapshot)
+            else:
+                self._pending_snapshot = None
+            if web is not None:
+                web.register(self.workflow)
+            return self.workflow
+
+        def main(**kwargs):
+            wf = self.workflow
+            wf.initialize(**kwargs)
+            if self._pending_snapshot is not None:
+                wf.restore(self._pending_snapshot)
+            if args.test:
+                stats = wf.evaluate()
+                print(json.dumps({"test": stats}, indent=2))
+            else:
+                wf.run()
+            if args.result_file:
+                wf.write_results(args.result_file)
+            wf.print_stats()
+            return wf
+
+        wf_globals["run"](load, main)
+        wf = self.workflow
+
+        if args.export and wf is not None:
+            from veles_tpu.services.export import export_workflow
+            export_workflow(wf, args.export)
+            print("exported -> %s" % args.export)
+        if args.serve is not None and wf is not None:
+            self._serve(wf, args.serve)
+        return 0
+
+    def _apply_config(self, args):
+        if args.config:
+            scope = {"root": root}
+            with open(args.config) as f:
+                exec(compile(f.read(), args.config, "exec"), scope)
+        for stmt in args.config_list:
+            exec(stmt, {"root": root})
+
+    def _serve(self, wf, port):
+        import numpy as np
+
+        from veles_tpu.services.restful import RESTfulAPI
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
+                         wf.trainer.layers[0].input_shape, port=port)
+        api.start()
+        print("REST serving on port %d; Ctrl-C to stop" % api.port)
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            api.stop()
+
+
+def __run__():
+    sys.exit(Main().run())
+
+
+if __name__ == "__main__":
+    __run__()

@@ -31,6 +31,11 @@ class LoaderRegistry(UnitRegistry, MappedRegistry):
 class Loader(Unit, metaclass=LoaderRegistry):
     mapping = {}
 
+    #: index loaders (False) serve minibatch_indices into an HBM-resident
+    #: dataset; data-carrying loaders (True: streaming/replay) serve
+    #: minibatch_data (+ minibatch_labels) directly and set sample_shape.
+    carries_data = False
+
     def __init__(self, workflow, **kwargs):
         super(Loader, self).__init__(workflow, **kwargs)
         self.minibatch_size = kwargs.get("minibatch_size", 100)

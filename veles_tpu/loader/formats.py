@@ -1,0 +1,194 @@
+"""Format loaders (ref: veles/loader/loader_hdf5.py:48-151, pickles.py:55,
+saver.py:69,182).
+
+* HDF5Loader — datasets from .h5 files (one file per class, keys
+  ``data``/``labels`` like the reference's test fixtures test.h5/train.h5)
+* PickleLoader — (data, labels) tuples or dicts from .pkl files
+* MinibatchesSaver / MinibatchesLoader — record a served minibatch stream
+  to a compressed file and replay it later without the original pipeline
+  (ref saver.py MinibatchesSaver 'compressed minibatch stream')."""
+
+import gzip
+import os
+import pickle
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID, Loader
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.units import Unit
+
+
+class HDF5Loader(FullBatchLoader):
+    """:param files: {class_name: path} with class_name in
+    test/validation/train; each file holds ``data`` and optional
+    ``labels`` datasets."""
+
+    MAPPING = "hdf5"
+    CLASS_KEYS = {"test": TEST, "validation": VALID, "train": TRAIN}
+
+    def __init__(self, workflow, files=None, **kwargs):
+        super(HDF5Loader, self).__init__(workflow, **kwargs)
+        self.files = files or {}
+
+    def load_data(self):
+        import h5py
+        datas = [None, None, None]
+        labels = [None, None, None]
+        lengths = [0, 0, 0]
+        for key, path in self.files.items():
+            cls = self.CLASS_KEYS[key]
+            with h5py.File(path, "r") as f:
+                datas[cls] = np.asarray(f["data"], np.float32)
+                if "labels" in f:
+                    labels[cls] = np.asarray(f["labels"], np.int32)
+                lengths[cls] = len(datas[cls])
+        self.original_data, self.original_labels = _assemble(datas, labels)
+        self.class_lengths = lengths
+
+
+def _assemble(datas, labels):
+    """Concatenate per-class data; labels stay aligned with data — classes
+    without a label file get zero labels so indices never misalign."""
+    present = [(d, labels[i]) for i, d in enumerate(datas) if d is not None]
+    if not present:
+        raise ValueError("no dataset files given")
+    data = np.concatenate([d for d, _ in present])
+    if any(l is not None for _, l in present):
+        label_parts = [l if l is not None else np.zeros(len(d), np.int32)
+                       for d, l in present]
+        return data, np.concatenate(label_parts)
+    return data, None
+
+
+class PickleLoader(FullBatchLoader):
+    """Pickled dataset files: each unpickles to (data, labels) or
+    {"data": ..., "labels": ...} (ref loader/pickles.py)."""
+
+    MAPPING = "pickles"
+
+    def __init__(self, workflow, files=None, **kwargs):
+        super(PickleLoader, self).__init__(workflow, **kwargs)
+        self.files = files or {}
+
+    def load_data(self):
+        datas = [None, None, None]
+        labels = [None, None, None]
+        lengths = [0, 0, 0]
+        for key, path in self.files.items():
+            cls = HDF5Loader.CLASS_KEYS[key]
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                obj = pickle.load(f)
+            if isinstance(obj, dict):
+                d, l = obj["data"], obj.get("labels")
+            else:
+                d, l = obj[0], (obj[1] if len(obj) > 1 else None)
+            datas[cls] = np.asarray(d, np.float32)
+            if l is not None:
+                labels[cls] = np.asarray(l, np.int32)
+            lengths[cls] = len(datas[cls])
+        self.original_data, self.original_labels = _assemble(datas, labels)
+        self.class_lengths = lengths
+
+
+class MinibatchesSaver(Unit):
+    """Records the loader's served minibatch stream (indices resolved to
+    actual data) into a gzip pickle stream (ref saver.py:69)."""
+
+    def __init__(self, workflow, path="minibatches.sav.gz", **kwargs):
+        super(MinibatchesSaver, self).__init__(workflow, **kwargs)
+        self.path = path
+        self.demand("loader")
+        self._file = None
+
+    def initialize(self, **kwargs):
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)) or ".",
+                    exist_ok=True)
+        self._file = gzip.open(self.path, "wb")
+        # one device→host transfer at initialize, not per minibatch
+        self._host_data = np.asarray(self.loader.data)
+        self._host_labels = (np.asarray(self.loader.labels)
+                             if self.loader.labels is not None else None)
+        header = {
+            "minibatch_size": self.loader.minibatch_size,
+            "class_lengths": list(self.loader.class_lengths),
+            "sample_shape": tuple(self._host_data.shape[1:]),
+        }
+        pickle.dump(header, self._file)
+
+    def run(self):
+        loader = self.loader
+        safe = np.maximum(loader.minibatch_indices, 0)
+        record = {
+            "cls": loader.minibatch_class,
+            "data": self._host_data[safe],
+            "labels": (self._host_labels[safe]
+                       if self._host_labels is not None else None),
+            "valid": loader.minibatch_valid.copy(),
+        }
+        pickle.dump(record, self._file)
+
+    def stop(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def read_minibatches(path):
+    """Replay a MinibatchesSaver stream: yields (header, records)
+    (ref MinibatchesLoader, saver.py:182)."""
+    with gzip.open(path, "rb") as f:
+        header = pickle.load(f)
+        records = []
+        while True:
+            try:
+                records.append(pickle.load(f))
+            except EOFError:
+                break
+    return header, records
+
+
+class MinibatchesLoader(Loader):
+    """Serves a recorded minibatch stream in order — the dataset pipeline
+    is replaced by the replay file (ref MinibatchesLoader)."""
+
+    MAPPING = "minibatches"
+    carries_data = True
+
+    def __init__(self, workflow, path=None, **kwargs):
+        super(MinibatchesLoader, self).__init__(workflow, **kwargs)
+        self.path = path
+        self.records = []
+        self.position = 0
+
+    def load_data(self):
+        header, self.records = read_minibatches(self.path)
+        self.minibatch_size = header["minibatch_size"]
+        self.class_lengths = list(header["class_lengths"])
+        self.sample_shape = header["sample_shape"]
+        if not self.records:
+            raise ValueError("empty minibatch stream %s" % self.path)
+
+    def run(self):
+        if bool(self.epoch_ended):
+            self.epoch_ended <<= False
+        if bool(self.last_minibatch):
+            self.last_minibatch <<= False
+        if bool(self.class_ended):
+            self.class_ended <<= False
+        rec = self.records[self.position]
+        self.minibatch_class = rec["cls"]
+        self.minibatch_data = rec["data"]
+        self.minibatch_labels = rec["labels"]
+        self.minibatch_valid = rec["valid"]
+        self.minibatch_indices = None   # replay carries data directly
+        self.position += 1
+        if self.position >= len(self.records) or \
+                self.records[self.position]["cls"] != rec["cls"]:
+            self.class_ended <<= True
+        if self.position >= len(self.records):
+            self.last_minibatch <<= True
+            self.epoch_ended <<= True
+            self.epoch_number += 1
+            self.position = 0

@@ -1,0 +1,108 @@
+"""Image loaders (ref: veles/loader/{image,file_image,fullbatch_image,
+file_loader}.py — PIL decode, crop/scale, color conversion, filesystem
+scanning with wildcards and auto-labeling).
+
+All decode/transform work happens on the host at ingest; the resulting
+tensor goes to HBM once via FullBatchLoader (ref FullBatchImageLoader,
+fullbatch_image.py:56)."""
+
+import glob
+import os
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.normalization import make_normalizer
+
+
+def decode_image(path, size=None, grayscale=False, crop=None):
+    """Load one image file → float32 HWC array in [0, 1]
+    (ref ImageLoader decode/scale/crop, loader/image.py:106)."""
+    from PIL import Image
+    img = Image.open(path)
+    img = img.convert("L" if grayscale else "RGB")
+    if crop is not None:
+        left, top, w, h = crop
+        img = img.crop((left, top, left + w, top + h))
+    if size is not None:
+        img = img.resize((size[1], size[0]), Image.BILINEAR)
+    arr = np.asarray(img, np.float32) / 255.0
+    if grayscale:
+        arr = arr[:, :, None]
+    return arr
+
+
+def scan_files(patterns, extensions=(".png", ".jpg", ".jpeg", ".bmp",
+                                     ".ppm", ".gif")):
+    """Expand glob patterns into a sorted file list (ref FileLoader
+    wildcard scanning, loader/file_loader.py)."""
+    files = []
+    for pat in ([patterns] if isinstance(patterns, str) else patterns):
+        if os.path.isdir(pat):
+            pat = os.path.join(pat, "**", "*")
+        for f in glob.glob(pat, recursive=True):
+            if os.path.isfile(f) and f.lower().endswith(extensions):
+                files.append(f)
+    return sorted(files)
+
+
+def auto_label(files):
+    """Directory-name labeling (ref AutoLabelFileImageLoader,
+    file_loader.py:277): label = parent directory name."""
+    names = sorted({os.path.basename(os.path.dirname(f)) for f in files})
+    index = {n: i for i, n in enumerate(names)}
+    labels = np.array([index[os.path.basename(os.path.dirname(f))]
+                       for f in files], np.int32)
+    return labels, names
+
+
+class FullBatchImageLoader(FullBatchLoader):
+    """Scan + decode + normalize image files into an HBM-resident full
+    batch (ref FullBatchImageLoader).
+
+    :param train_paths/valid_paths/test_paths: glob patterns or dirs;
+        labels come from parent directory names unless ``labeled=False``.
+    """
+
+    MAPPING = "full_batch_image"
+
+    def __init__(self, workflow, train_paths=None, valid_paths=None,
+                 test_paths=None, size=(32, 32), grayscale=False,
+                 crop=None, normalization="none", labeled=True, **kwargs):
+        super(FullBatchImageLoader, self).__init__(workflow, **kwargs)
+        self.paths = {TRAIN: train_paths, VALID: valid_paths,
+                      TEST: test_paths}
+        self.size = size
+        self.grayscale = grayscale
+        self.crop = crop
+        self.labeled = labeled
+        self.normalizer = make_normalizer(normalization) \
+            if isinstance(normalization, str) else normalization
+        self.label_names = None
+
+    def load_data(self):
+        images, labels = [], []
+        lengths = [0, 0, 0]
+        all_files = {}
+        for cls in (TEST, VALID, TRAIN):
+            pats = self.paths[cls]
+            all_files[cls] = scan_files(pats) if pats else []
+            lengths[cls] = len(all_files[cls])
+        ordered = all_files[TEST] + all_files[VALID] + all_files[TRAIN]
+        if not ordered:
+            raise ValueError("no image files matched")
+        if self.labeled:
+            labels_arr, self.label_names = auto_label(ordered)
+        for f in ordered:
+            images.append(decode_image(f, self.size, self.grayscale,
+                                       self.crop))
+        data = np.stack(images)
+        self.normalizer.analyze(data)
+        data = self.normalizer.normalize(data).reshape(data.shape)
+        self.original_data = data
+        self.original_labels = labels_arr if self.labeled else None
+        self.class_lengths = lengths
+        self.info("loaded %d images %s, %d classes", len(ordered),
+                  data.shape[1:],
+                  len(self.label_names) if self.labeled else 0)

@@ -1,0 +1,159 @@
+"""Streaming ingestion loaders (ref: veles/zmq_loader.py:74,
+loader/interactive.py:57, downloader.py:56).
+
+* ZeroMQLoader — samples pushed over a ZeroMQ PULL socket into a queue
+* InteractiveLoader — ``feed()`` samples from a REPL / calling thread
+* Downloader — fetch + unpack a dataset archive into the data dir
+  (gracefully reports when the environment has no egress)."""
+
+import os
+import queue
+import tarfile
+import threading
+import zipfile
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.base import TEST, Loader
+from veles_tpu.units import Unit
+
+
+class QueueLoaderBase(Loader):
+    """Serves whatever samples appear on an in-process queue; each run()
+    takes up to ``minibatch_size`` pending samples (fixed shape, padded
+    with the validity mask like every loader here)."""
+
+    carries_data = True
+
+    def __init__(self, workflow, sample_shape=None, queue_size=4096,
+                 **kwargs):
+        super(QueueLoaderBase, self).__init__(workflow, **kwargs)
+        if sample_shape is None:
+            raise ValueError("%s needs sample_shape" % type(self).__name__)
+        self.sample_shape = tuple(sample_shape)
+        self.queue = queue.Queue(queue_size)
+        self.stopped_streaming = False
+
+    def load_data(self):
+        # streaming: no fixed dataset; everything is "test" class served
+        # on demand (ref ZeroMQLoader semantics).  class_lengths carries
+        # minibatch_size so the base class doesn't clamp it down.
+        self.class_lengths = [self.minibatch_size, 0, 0]
+        self.shuffle_enabled = False
+
+    def feed(self, sample):
+        self.queue.put(np.asarray(sample, np.float32))
+
+    def run(self):
+        data = np.zeros((self.minibatch_size,) + self.sample_shape,
+                        np.float32)
+        valid = np.zeros((self.minibatch_size,), np.float32)
+        got = 0
+        block = True   # wait for at least one sample
+        while got < self.minibatch_size:
+            try:
+                item = self.queue.get(block=block, timeout=30)
+            except queue.Empty:
+                break
+            if item is None:   # poison pill = end of stream
+                self.stopped_streaming = True
+                break
+            data[got] = item
+            valid[got] = 1.0
+            got += 1
+            block = False
+        self.minibatch_data = data
+        self.minibatch_valid = valid
+        self.minibatch_class = TEST
+        self.minibatch_indices = None
+
+
+class InteractiveLoader(QueueLoaderBase):
+    """feed() from the REPL (ref loader/interactive.py:57)."""
+
+    MAPPING = "interactive"
+
+
+class ZeroMQLoader(QueueLoaderBase):
+    """Receives pickled numpy samples on a ZeroMQ PULL socket
+    (ref veles/zmq_loader.py:74 — the reference's streaming ingestion)."""
+
+    MAPPING = "zeromq"
+
+    def __init__(self, workflow, endpoint="tcp://127.0.0.1:0", **kwargs):
+        super(ZeroMQLoader, self).__init__(workflow, **kwargs)
+        self.endpoint = endpoint
+        self._thread = None
+        self._ctx = None
+
+    def initialize(self, **kwargs):
+        super(ZeroMQLoader, self).initialize(**kwargs)
+        import zmq
+        self._ctx = zmq.Context.instance()
+        sock = self._ctx.socket(zmq.PULL)
+        if self.endpoint.endswith(":0"):
+            port = sock.bind_to_random_port(self.endpoint[:-2])
+            self.endpoint = "%s:%d" % (self.endpoint[:-2], port)
+        else:
+            sock.bind(self.endpoint)
+        self.info("ZeroMQLoader listening on %s", self.endpoint)
+
+        def pump():
+            while True:
+                try:
+                    obj = sock.recv_pyobj()
+                except Exception:  # noqa: BLE001 — context shut down
+                    break
+                self.queue.put(None if obj is None
+                               else np.asarray(obj, np.float32))
+                if obj is None:
+                    break
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+
+class Downloader(Unit):
+    """Fetch + unpack a dataset archive into the datasets dir
+    (ref veles/downloader.py:56).  In a zero-egress environment the fetch
+    fails with a clear message; an already-present file short-circuits."""
+
+    def __init__(self, workflow, url=None, directory=None, **kwargs):
+        super(Downloader, self).__init__(workflow, **kwargs)
+        self.url = url
+        self.directory = directory or root.common.dirs.get(
+            "datasets", "datasets")
+        self.destination = None
+
+    def initialize(self, **kwargs):
+        if not self.url:
+            raise ValueError("Downloader needs url=")
+        os.makedirs(self.directory, exist_ok=True)
+        fname = os.path.join(self.directory, os.path.basename(self.url))
+        if not os.path.exists(fname):
+            import urllib.request
+            self.info("downloading %s", self.url)
+            try:
+                urllib.request.urlretrieve(self.url, fname)
+            except Exception as e:
+                raise RuntimeError(
+                    "download failed (no network egress?): %s" % e) from e
+        self.destination = self._unpack(fname)
+
+    def _unpack(self, fname):
+        target = os.path.splitext(fname)[0]
+        if fname.endswith(".zip"):
+            with zipfile.ZipFile(fname) as zf:
+                for member in zf.namelist():   # tar-slip guard
+                    dest = os.path.realpath(os.path.join(target, member))
+                    if not dest.startswith(os.path.realpath(target)):
+                        raise ValueError("archive member escapes target: "
+                                         + member)
+                zf.extractall(target)
+            return target
+        if fname.endswith((".tar.gz", ".tgz", ".tar")):
+            with tarfile.open(fname) as tf:
+                tf.extractall(target, filter="data")
+            return target
+        return fname

@@ -78,6 +78,9 @@ class KohonenTrainer(Unit):
 
     def initialize(self, **kwargs):
         loader = self.loader
+        if loader.carries_data:
+            raise ValueError("KohonenTrainer needs an index loader with an "
+                             "HBM-resident dataset")
         n_features = int(np.prod(loader.data.shape[1:]))
         rng = prng.get("kohonen-weights")
         self.weights = jnp.asarray(

@@ -255,6 +255,41 @@ class Cutter(Layer):
         return misc.cut(x, self.oy, self.ox, self.h, self.w)
 
 
+class LSTM(Layer):
+    """LSTM layer over [T, F] samples (ref Veles RNN/LSTM engines).
+    ``output_sample_shape`` = hidden units; ``return_sequences`` keeps the
+    whole [T, H] output for stacking."""
+
+    TYPES = ("lstm", "rnn_tanh")
+    has_params = True
+
+    def _infer(self, input_shape):
+        if len(input_shape) != 2:
+            raise ValueError("%s wants [T, F] samples, got %s"
+                             % (self.type, input_shape))
+        self.n_hidden = int(self.cfg["output_sample_shape"])
+        self.return_sequences = bool(self.cfg.get("return_sequences",
+                                                  False))
+        t, f = input_shape
+        self.n_in = f
+        return ((t, self.n_hidden) if self.return_sequences
+                else (self.n_hidden,))
+
+    def init_params(self, rng):
+        from veles_tpu.ops import recurrent
+        if self.type == "lstm":
+            return recurrent.lstm_init(rng, self.n_in, self.n_hidden,
+                                       self.policy.param)
+        return recurrent.rnn_init(rng, self.n_in, self.n_hidden,
+                                  self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import recurrent
+        fn = (recurrent.lstm_forward if self.type == "lstm"
+              else recurrent.rnn_forward)
+        return fn(params, x, self.policy, self.return_sequences)
+
+
 class ZeroFiller(Layer):
     """Weight-mask regularizer: masks the *previous* parametric layer's
     weights after every update (ref Znicz ZeroFiller).  Carries no forward
@@ -268,7 +303,7 @@ class ZeroFiller(Layer):
 
 LAYER_TYPES = {}
 for _cls in (All2All, Conv, Deconv, Pooling, Depooling, LRN, Dropout,
-             Activation, Cutter, ZeroFiller):
+             Activation, Cutter, LSTM, ZeroFiller):
     for _t in _cls.TYPES:
         LAYER_TYPES[_t] = _cls
 

@@ -78,7 +78,8 @@ class StagedTrainer(Unit):
     # ------------------------------------------------------------ building
     def initialize(self, **kwargs):
         loader = self.loader
-        sample_shape = tuple(loader.data.shape[1:])  # no host transfer
+        sample_shape = (tuple(loader.sample_shape) if loader.carries_data
+                        else tuple(loader.data.shape[1:]))
         shape = sample_shape
         rng = prng.get("weights")
         hypers = {}
@@ -117,15 +118,19 @@ class StagedTrainer(Unit):
 
     def _loss_and_stats(self, params, data, labels, targets, idx, valid,
                         train, key):
-        x = FullBatchLoader.gather(data, idx)
+        """Index mode: gather the minibatch from HBM-resident arrays."""
+        return self._loss_from_batch(
+            params, FullBatchLoader.gather(data, idx),
+            FullBatchLoader.gather(labels, idx),
+            FullBatchLoader.gather(targets, idx), valid, train, key)
+
+    def _loss_from_batch(self, params, x, lbl, tgt, valid, train, key):
         out = self._forward(params, x, train, key)
         if self.loss == "softmax":
-            lbl = FullBatchLoader.gather(labels, idx)
             loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
                 out, lbl, valid)
             n_features = 1
         else:  # mse
-            tgt = FullBatchLoader.gather(targets, idx)
             loss_sum, n_valid, n_features = losses.masked_mse(
                 out, tgt, valid)
             err_sum = jnp.asarray(0.0)
@@ -136,6 +141,9 @@ class StagedTrainer(Unit):
                                   "count": n_valid}
 
     def _build_steps(self):
+        if self.loader.carries_data:
+            self._build_steps_direct()
+            return
         loader = self.loader
         labels = (loader.labels if loader.labels is not None
                   else jnp.zeros((loader.total_samples,), jnp.int32))
@@ -191,9 +199,59 @@ class StagedTrainer(Unit):
         self._targets_dev = (targets if targets is not None
                              else jnp.zeros((1,), jnp.float32))
 
+    def _build_steps_direct(self):
+        """Steps for data-carrying loaders (streaming/replay): the
+        minibatch tensor arrives from the host each step; mse reconstructs
+        the input (no separate target stream in the replay format)."""
+        if self.mesh_config is not None:
+            raise ValueError("mesh training with a streaming/replay loader "
+                             "is not supported — use an index loader")
+        hypers = self._hypers
+
+        def train_step(params, velocity, acc, x, lbl, valid, step):
+            key = jax.random.fold_in(self._base_key, step)
+
+            def loss_fn(p):
+                return self._loss_from_batch(p, x, lbl, x, valid, True, key)
+
+            grads, stats = jax.grad(loss_fn, has_aux=True)(params)
+            params, velocity = optimizer.update(params, grads, velocity,
+                                                hypers)
+            acc = jax.tree_util.tree_map(jnp.add, acc, stats)
+            return params, velocity, acc
+
+        def eval_step(params, acc, x, lbl, valid):
+            _, stats = self._loss_from_batch(params, x, lbl, x, valid,
+                                             False, jax.random.key(0))
+            return jax.tree_util.tree_map(jnp.add, acc, stats)
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+
+    def _direct_batch(self, loader):
+        x = jnp.asarray(loader.minibatch_data)
+        lbl = (jnp.asarray(loader.minibatch_labels)
+               if getattr(loader, "minibatch_labels", None) is not None
+               else jnp.zeros((x.shape[0],), jnp.int32))
+        return x, lbl
+
     # ------------------------------------------------------------- hot loop
     def run(self):
         loader = self.loader
+        if loader.carries_data:
+            cls = loader.minibatch_class
+            x, lbl = self._direct_batch(loader)
+            valid = jnp.asarray(loader.minibatch_valid)
+            if cls in self.train_only_classes:
+                self._step_counter += 1
+                self.params, self.velocity, self.class_stats[cls] = \
+                    self._train_step(self.params, self.velocity,
+                                     self.class_stats[cls], x, lbl, valid,
+                                     self._step_counter)
+            else:
+                self.class_stats[cls] = self._eval_step(
+                    self.params, self.class_stats[cls], x, lbl, valid)
+            return
         cls = loader.minibatch_class
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding

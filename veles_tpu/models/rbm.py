@@ -1,0 +1,160 @@
+"""Restricted Boltzmann Machine (ref: Veles RBM engine, numpy-based —
+manualrst_veles_algorithms.rst:96-103).
+
+Bernoulli-Bernoulli RBM trained with contrastive divergence (CD-k), the
+whole minibatch update staged as one jitted step: sampling uses
+counter-derived keys so training is bit-reproducible.  Metric:
+per-element reconstruction RMSE (matches the autoencoder metric)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+def cd_step(params, x, valid, key, lr, k=1):
+    """One CD-k minibatch update.  x in [0,1]."""
+    w, vb, hb = params["weights"], params["vbias"], params["hbias"]
+
+    def sample(p, key):
+        return jax.random.bernoulli(key, p).astype(jnp.float32)
+
+    h0_p = jax.nn.sigmoid(x @ w + hb)
+    keys = jax.random.split(key, 2 * k + 1)
+    h = sample(h0_p, keys[0])
+    v = x
+    for i in range(k):
+        v_p = jax.nn.sigmoid(h @ w.T + vb)
+        v = sample(v_p, keys[2 * i + 1])
+        h_p = jax.nn.sigmoid(v @ w + hb)
+        h = sample(h_p, keys[2 * i + 2])
+    n = jnp.maximum(valid.sum(), 1.0)
+    vm = valid[:, None]
+    pos = (x * vm).T @ h0_p
+    neg = (v * vm).T @ h_p
+    new = {
+        "weights": w + lr * (pos - neg) / n,
+        "vbias": vb + lr * jnp.sum((x - v) * vm, axis=0) / n,
+        "hbias": hb + lr * jnp.sum((h0_p - h_p) * vm, axis=0) / n,
+    }
+    recon = jax.nn.sigmoid(h0_p @ w.T + vb)
+    se = jnp.sum(((x - recon) ** 2) * vm)
+    return new, se, valid.sum()
+
+
+class RBMTrainer(Unit):
+    def __init__(self, workflow, n_hidden=64, learning_rate=0.1, cd_k=1,
+                 **kwargs):
+        super(RBMTrainer, self).__init__(workflow, **kwargs)
+        self.n_hidden = n_hidden
+        self.learning_rate = learning_rate
+        self.cd_k = cd_k
+        self.demand("loader")
+        self.params = None
+        self._step_counter = 0
+        self._se_sum = 0.0
+        self._count = 0.0
+
+    def initialize(self, **kwargs):
+        loader = self.loader
+        if loader.carries_data:
+            raise ValueError("RBMTrainer needs an index loader with an "
+                             "HBM-resident dataset")
+        n_visible = int(np.prod(loader.data.shape[1:]))
+        rng = prng.get("rbm-weights")
+        self.params = {
+            "weights": jnp.asarray(
+                rng.fill_normal((n_visible, self.n_hidden), 0.01)),
+            "vbias": jnp.zeros((n_visible,)),
+            "hbias": jnp.zeros((self.n_hidden,)),
+        }
+        self._base_key = jax.random.key(int(prng.get("rbm")._seed))
+        self._jit_step = jax.jit(
+            lambda p, x, v, s: cd_step(
+                p, x, v, jax.random.fold_in(self._base_key, s),
+                self.learning_rate, self.cd_k))
+
+    def run(self):
+        loader = self.loader
+        if loader.minibatch_class != TRAIN:
+            return
+        x = FullBatchLoader.gather(
+            loader.data, jnp.asarray(loader.minibatch_indices))
+        x = x.reshape(x.shape[0], -1)
+        valid = jnp.asarray(loader.minibatch_valid)
+        self._step_counter += 1
+        self.params, se, cnt = self._jit_step(self.params, x, valid,
+                                              self._step_counter)
+        self._se_sum += float(se)
+        self._count += float(cnt)
+
+    def epoch_rmse(self):
+        n_visible = self.params["weights"].shape[0]
+        if not self._count:
+            return None
+        rmse = float(np.sqrt(self._se_sum / (self._count * n_visible)))
+        self._se_sum = 0.0
+        self._count = 0.0
+        return rmse
+
+    # serving: hidden representation + reconstruction
+    def transform(self, x):
+        x = jnp.asarray(x.reshape(len(x), -1))
+        return jax.nn.sigmoid(x @ self.params["weights"] +
+                              self.params["hbias"])
+
+    def reconstruct(self, x):
+        h = self.transform(x)
+        return jax.nn.sigmoid(h @ self.params["weights"].T +
+                              self.params["vbias"])
+
+    def get_metric_values(self):
+        return {"rbm_hidden": self.n_hidden}
+
+
+class RBMWorkflow(Workflow):
+    def __init__(self, workflow=None, loader=None, n_hidden=64,
+                 n_epochs=10, learning_rate=0.1, cd_k=1, **kwargs):
+        super(RBMWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.loader = loader
+        if loader.workflow is not self:
+            self.add_ref(loader)
+            loader.workflow = self
+        self.trainer = RBMTrainer(self, n_hidden=n_hidden,
+                                  learning_rate=learning_rate, cd_k=cd_k)
+        self.trainer.loader = loader
+        self.n_epochs = n_epochs
+        self.complete = Bool(False)
+        self.rmse_history = []
+        wf = self
+
+        class RBMDecision(Unit):
+            def run(self):
+                loader_ = wf.loader
+                if not bool(loader_.epoch_ended):
+                    return
+                rmse = wf.trainer.epoch_rmse()
+                if rmse is not None:
+                    wf.rmse_history.append(rmse)
+                    wf.trainer.info("epoch %d: reconstruction rmse %.4f",
+                                    loader_.epoch_number, rmse)
+                if loader_.epoch_number >= wf.n_epochs:
+                    wf.complete <<= True
+
+        self.decision = RBMDecision(self)
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.decision.link_from(self.trainer)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.complete

@@ -81,6 +81,31 @@ class StandardWorkflow(Workflow):
         raise TypeError("loader must be a Loader instance or "
                         "{'name': ..., **kwargs} dict")
 
+    # ---------------------------------------------------------- evaluation
+    def evaluate(self):
+        """One full eval-only pass over every non-empty class — the
+        ``--test`` mode (ref `veles --test` reusing a trained snapshot for
+        inference, SURVEY §3.5).  Returns {class_name: stats}."""
+        from veles_tpu.loader.base import CLASS_NAMES
+        saved = self.trainer.train_only_classes
+        self.trainer.train_only_classes = ()
+        self.trainer.reset_epoch_stats()
+        loader = self.loader
+        start = loader.epoch_number
+        while loader.epoch_number == start:
+            loader.run()
+            self.trainer.run()
+        stats = {CLASS_NAMES[c]: self.trainer.read_class_stats(c)
+                 for c in range(3) if loader.class_lengths[c]}
+        self.trainer.train_only_classes = saved
+        self.test_results = stats
+        return stats
+
+    def get_metric_values(self):
+        if getattr(self, "test_results", None) is not None:
+            return {"test": self.test_results}
+        return {}
+
     # ------------------------------------------------------------- serving
     def forward_fn(self):
         """Jitted inference function (params, x) -> probabilities/output."""

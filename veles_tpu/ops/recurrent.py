@@ -1,0 +1,72 @@
+"""Recurrent ops — LSTM and simple RNN (ref: Veles RNN/LSTM support,
+'in progress' in the reference — manualrst_veles_algorithms.rst:105-112;
+completed here as first-class layer types).
+
+TPU shape: the time loop is a ``lax.scan`` whose body is one fused
+[B, in+hidden] × [in+hidden, 4·hidden] matmul — gate math rides the MXU,
+XLA pipelines the scan.  Inputs are [B, T, F]."""
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops.policy import Policy
+
+
+def lstm_init(rng, n_in, n_hidden, dtype=jnp.float32):
+    s = (n_in + n_hidden) ** -0.5
+    w = rng.fill_uniform((n_in + n_hidden, 4 * n_hidden), s)
+    b = jnp.zeros((4 * n_hidden,), dtype)
+    # forget-gate bias 1.0: standard trick to keep early gradients alive
+    b = b.at[n_hidden:2 * n_hidden].set(1.0)
+    return {"weights": jnp.asarray(w, dtype), "bias": b}
+
+
+def lstm_forward(params, x, policy=Policy(), return_sequences=False):
+    """x: [B, T, F] → [B, H] (last hidden) or [B, T, H]."""
+    w = policy.cast_in(params["weights"])
+    b = params["bias"].astype(policy.accum)
+    n_hidden = b.shape[0] // 4
+    batch = x.shape[0]
+    h0 = jnp.zeros((batch, n_hidden), policy.accum)
+    c0 = jnp.zeros((batch, n_hidden), policy.accum)
+
+    def step(carry, xt):
+        h, c = carry
+        z = jnp.dot(policy.cast_in(jnp.concatenate([xt, h], axis=1)), w,
+                    preferred_element_type=policy.accum) + b
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h_last, _), h_seq = jax.lax.scan(step, (h0, c0),
+                                      jnp.swapaxes(x, 0, 1))
+    if return_sequences:
+        return jnp.swapaxes(h_seq, 0, 1)
+    return h_last
+
+
+def rnn_init(rng, n_in, n_hidden, dtype=jnp.float32):
+    s = (n_in + n_hidden) ** -0.5
+    w = rng.fill_uniform((n_in + n_hidden, n_hidden), s)
+    return {"weights": jnp.asarray(w, dtype),
+            "bias": jnp.zeros((n_hidden,), dtype)}
+
+
+def rnn_forward(params, x, policy=Policy(), return_sequences=False):
+    """Simple tanh RNN; same shapes as lstm_forward."""
+    w = policy.cast_in(params["weights"])
+    b = params["bias"].astype(policy.accum)
+    n_hidden = b.shape[0]
+    h0 = jnp.zeros((x.shape[0], n_hidden), policy.accum)
+
+    def step(h, xt):
+        z = jnp.dot(policy.cast_in(jnp.concatenate([xt, h], axis=1)), w,
+                    preferred_element_type=policy.accum) + b
+        h = jnp.tanh(z)
+        return h, h
+
+    h_last, h_seq = jax.lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    if return_sequences:
+        return jnp.swapaxes(h_seq, 0, 1)
+    return h_last

@@ -1,0 +1,99 @@
+"""ctypes bridge to the native C++ inference runtime (ref libVeles usage:
+embedded apps link the C++ engine; here Python drives it for round-trip
+tests — the same Python↔C++ package contract the reference tested with
+libVeles/tests fixtures)."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libveles_native.so")
+
+_lib = None
+
+
+def build(force=False):
+    """Build libveles_native.so via make (g++ is in the base image)."""
+    if force and os.path.exists(_LIB_PATH):
+        os.remove(_LIB_PATH)
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.veles_native_load.restype = ctypes.c_void_p
+    lib.veles_native_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+    lib.veles_native_input_size.argtypes = [ctypes.c_void_p]
+    lib.veles_native_output_size.argtypes = [ctypes.c_void_p]
+    lib.veles_native_num_units.argtypes = [ctypes.c_void_p]
+    lib.veles_native_unit_name.restype = ctypes.c_char_p
+    lib.veles_native_unit_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.veles_native_arena_bytes.restype = ctypes.c_long
+    lib.veles_native_arena_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.veles_native_infer.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.veles_native_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeWorkflow(object):
+    """Loaded native inference engine for an exported package."""
+
+    def __init__(self, package_path):
+        lib = _load()
+        err = ctypes.create_string_buffer(512)
+        self._h = lib.veles_native_load(
+            package_path.encode(), err, len(err))
+        if not self._h:
+            raise RuntimeError("native load failed: %s"
+                               % err.value.decode())
+        self._lib = lib
+        self.input_size = lib.veles_native_input_size(self._h)
+        self.output_size = lib.veles_native_output_size(self._h)
+
+    @property
+    def unit_names(self):
+        n = self._lib.veles_native_num_units(self._h)
+        return [self._lib.veles_native_unit_name(self._h, i).decode()
+                for i in range(n)]
+
+    def arena_bytes(self, batch=1):
+        return int(self._lib.veles_native_arena_bytes(self._h, batch))
+
+    def __call__(self, x):
+        x = np.ascontiguousarray(x, np.float32).reshape(len(x), -1)
+        if x.shape[1] != self.input_size:
+            raise ValueError("expected %d input features, got %d"
+                             % (self.input_size, x.shape[1]))
+        out = np.empty((len(x), self.output_size), np.float32)
+        rc = self._lib.veles_native_infer(
+            self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(x), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc:
+            raise RuntimeError("native inference failed")
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.veles_native_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

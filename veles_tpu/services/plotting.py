@@ -1,0 +1,193 @@
+"""Plotting units (ref: veles/plotter.py, plotting_units.py:52-822,
+graphics_server.py/graphics_client.py).
+
+The reference shipped pickled Plotter objects over ZMQ pub/sub to an
+out-of-process matplotlib client.  Here plotters render headlessly (Agg)
+to PNG files in an output directory and push their payload dicts to an
+in-process ``PlotBus`` that the web-status dashboard serves — same
+decoupling (compute loop never blocks on rendering), no subprocess.
+
+Plotter library parity: accumulating (metric-vs-epoch curves), matrix
+(confusion), image (weights/samples), histogram."""
+
+import os
+import threading
+
+import numpy as np
+
+from veles_tpu.units import Unit
+
+
+class PlotBus(object):
+    """In-process pub/sub of plot payloads (ref GraphicsServer ZMQ PUB)."""
+
+    def __init__(self, capacity=256):
+        self._items = []
+        self._capacity = capacity
+        self._lock = threading.Lock()
+
+    def publish(self, payload):
+        with self._lock:
+            self._items.append(payload)
+            if len(self._items) > self._capacity:
+                del self._items[:self._capacity // 2]
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+
+bus = PlotBus()
+
+
+def _matplotlib():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+class PlotterBase(Unit):
+    """Renders every ``redraw_interval`` runs (ref Plotter redraw throttle,
+    plotter.py:147-158)."""
+
+    def __init__(self, workflow, name=None, directory=None,
+                 redraw_interval=1, **kwargs):
+        super(PlotterBase, self).__init__(workflow, name=name or
+                                          type(self).__name__, **kwargs)
+        self.directory = directory or "plots"
+        self.redraw_interval = redraw_interval
+        self._runs = 0
+        self.last_file = None
+        self.view_group = "PLOTTER"
+
+    def run(self):
+        self._runs += 1
+        if self._runs % self.redraw_interval:
+            return
+        payload = self.payload()
+        if payload is None:
+            return
+        bus.publish({"name": self.name, **payload})
+        os.makedirs(self.directory, exist_ok=True)
+        self.last_file = os.path.join(self.directory,
+                                      "%s.png" % self.name)
+        self.render(payload, self.last_file)
+
+    def payload(self):
+        """Return the JSON-able data dict to publish, or None to skip."""
+        raise NotImplementedError
+
+    def render(self, payload, path):
+        raise NotImplementedError
+
+
+class AccumulatingPlotter(PlotterBase):
+    """Curve of a scalar metric over epochs (ref plotting_units
+    AccumulatingPlotter).  Set ``source=callable`` returning the value."""
+
+    def __init__(self, workflow, source=None, ylabel="value", **kwargs):
+        super(AccumulatingPlotter, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.ylabel = ylabel
+        self.values = []
+
+    def payload(self):
+        v = self.source() if callable(self.source) else self.source
+        if v is None:
+            return None
+        self.values.append(float(v))
+        return {"kind": "curve", "values": list(self.values),
+                "ylabel": self.ylabel}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.plot(payload["values"], marker="o", markersize=3)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel(payload["ylabel"])
+        ax.grid(True, alpha=0.3)
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
+class MatrixPlotter(PlotterBase):
+    """Confusion-matrix heatmap (ref MatrixPlotter)."""
+
+    def __init__(self, workflow, source=None, **kwargs):
+        super(MatrixPlotter, self).__init__(workflow, **kwargs)
+        self.source = source
+
+    def payload(self):
+        m = self.source() if callable(self.source) else self.source
+        if m is None:
+            return None
+        return {"kind": "matrix", "matrix": np.asarray(m).tolist()}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        m = np.asarray(payload["matrix"])
+        fig, ax = plt.subplots(figsize=(5, 5))
+        im = ax.imshow(m, cmap="viridis")
+        fig.colorbar(im)
+        ax.set_xlabel("predicted")
+        ax.set_ylabel("true")
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
+class ImagePlotter(PlotterBase):
+    """Grid of images — e.g. first-layer weights (ref Weights2D/ImagePlotter)."""
+
+    def __init__(self, workflow, source=None, grid_shape=None, **kwargs):
+        super(ImagePlotter, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.grid_shape = grid_shape
+
+    def payload(self):
+        imgs = self.source() if callable(self.source) else self.source
+        if imgs is None:
+            return None
+        return {"kind": "images", "images": np.asarray(imgs).tolist()}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        imgs = np.asarray(payload["images"])
+        n = len(imgs)
+        cols = self.grid_shape[1] if self.grid_shape else \
+            int(np.ceil(np.sqrt(n)))
+        rows = int(np.ceil(n / cols))
+        fig, axes = plt.subplots(rows, cols,
+                                 figsize=(cols * 1.4, rows * 1.4))
+        for i, ax in enumerate(np.atleast_1d(axes).ravel()):
+            ax.axis("off")
+            if i < n:
+                ax.imshow(imgs[i], cmap="gray")
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
+class HistogramPlotter(PlotterBase):
+    """Histogram of a tensor (ref plotting_units histogram family)."""
+
+    def __init__(self, workflow, source=None, bins=50, **kwargs):
+        super(HistogramPlotter, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.bins = bins
+
+    def payload(self):
+        v = self.source() if callable(self.source) else self.source
+        if v is None:
+            return None
+        counts, edges = np.histogram(np.asarray(v).ravel(), bins=self.bins)
+        return {"kind": "histogram", "counts": counts.tolist(),
+                "edges": edges.tolist()}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        fig, ax = plt.subplots(figsize=(6, 4))
+        edges = np.asarray(payload["edges"])
+        ax.bar(edges[:-1], payload["counts"],
+               width=np.diff(edges), align="edge")
+        fig.savefig(path, dpi=80)
+        plt.close(fig)

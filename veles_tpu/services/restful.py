@@ -1,0 +1,94 @@
+"""RESTful serving (ref: veles/restful_api.py:54-217 + loader/restful.py).
+
+``RESTfulAPI`` wraps a trained workflow's jitted forward function behind an
+HTTP endpoint: POST JSON ``{"input": [...]}`` (nested lists or base64 —
+the reference's two codecs, restful_api.py:112-217) returns
+``{"result": [...]}``.  stdlib http.server in a daemon thread replaces the
+reference's Twisted resource — no reactor to manage."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from veles_tpu.logger import Logger
+
+
+class RESTfulAPI(Logger):
+    def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
+                 path="/service"):
+        super(RESTfulAPI, self).__init__()
+        self.forward = forward            # callable(np.ndarray) -> ndarray
+        self.input_shape = tuple(input_shape)
+        self.host, self.port, self.path = host, port, path
+        self._server = None
+        self._thread = None
+
+    # ------------------------------------------------------------- server
+    def start(self):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != api.path:
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    x = api.decode_input(req)
+                    out = np.asarray(api.forward(x))
+                    body = json.dumps({"result": out.tolist()}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # noqa: BLE001 — report to client
+                    msg = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(msg)))
+                    self.end_headers()
+                    self.wfile.write(msg)
+
+            def log_message(self, fmt, *args):
+                api.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]   # resolve port 0
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info("serving on http://%s:%d%s", self.host, self.port,
+                  self.path)
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # ------------------------------------------------------------ decoding
+    def decode_input(self, req):
+        """codec 'list' (default): nested lists; codec 'base64': raw
+        float32 little-endian bytes with explicit shape (ref restful
+        input contract)."""
+        codec = req.get("codec", "list")
+        if codec == "base64":
+            raw = base64.b64decode(req["input"])
+            shape = tuple(req.get("shape") or (-1,) + self.input_shape)
+            x = np.frombuffer(raw, dtype=np.float32).reshape(shape)
+        elif codec == "list":
+            x = np.asarray(req["input"], np.float32)
+        else:
+            raise ValueError("unknown codec %r" % codec)
+        if x.ndim == len(self.input_shape):   # single sample
+            x = x[None]
+        expect = x.shape[1:]
+        if tuple(expect) != self.input_shape and \
+                int(np.prod(expect)) != int(np.prod(self.input_shape)):
+            raise ValueError("input shape %s incompatible with %s"
+                             % (expect, self.input_shape))
+        return x.reshape((len(x),) + self.input_shape)

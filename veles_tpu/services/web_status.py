@@ -1,0 +1,118 @@
+"""Web-status dashboard (ref: veles/web_status.py:113-314 + the node.js
+frontend in web/).
+
+The reference ran a Tornado server fed by POSTs from masters, with MongoDB
+log browsing.  Here a stdlib HTTP server serves: ``/`` (HTML dashboard),
+``/api/status`` (registered workflow metrics), ``/api/events`` (the
+structured trace ring buffer from veles_tpu.logger), ``/api/plots`` (the
+PlotBus payloads), and accepts POST ``/update`` from remote runs — same
+capability surface, no external deps."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.logger import Logger, events
+from veles_tpu.services.plotting import bus
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px}</style></head>
+<body><h2>veles_tpu status</h2>
+<div id="status"></div><h3>recent events</h3><div id="events"></div>
+<script>
+async function refresh(){
+ const s=await (await fetch('/api/status')).json();
+ document.getElementById('status').innerHTML =
+  '<pre>'+JSON.stringify(s,null,2)+'</pre>';
+ const e=await (await fetch('/api/events')).json();
+ document.getElementById('events').innerHTML =
+  '<pre>'+e.slice(-30).map(x=>JSON.stringify(x)).join('\\n')+'</pre>';
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+class WebStatusServer(Logger):
+    def __init__(self, host="127.0.0.1", port=8090):
+        super(WebStatusServer, self).__init__()
+        self.host, self.port = host, port
+        self._workflows = {}
+        self._updates = []
+        self._server = None
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def register(self, workflow):
+        """Track a local workflow; its gather_results() feeds /api/status."""
+        with self._lock:
+            self._workflows[workflow.name] = workflow
+
+    def status(self):
+        out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
+        with self._lock:
+            for name, wf in self._workflows.items():
+                try:
+                    out["workflows"][name] = wf.gather_results()
+                except Exception as e:  # noqa: BLE001
+                    out["workflows"][name] = {"error": str(e)}
+        return out
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, body, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/":
+                    self._send(200, _PAGE.encode(), "text/html")
+                elif self.path == "/api/status":
+                    self._send(200, json.dumps(server.status(),
+                                               default=str).encode())
+                elif self.path == "/api/events":
+                    self._send(200, json.dumps(events.snapshot()[-200:],
+                                               default=str).encode())
+                elif self.path == "/api/plots":
+                    self._send(200, json.dumps(bus.snapshot()[-20:],
+                                               default=str).encode())
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                # remote master update (ref web_status '/update' POST)
+                if self.path != "/update":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    update = json.loads(self.rfile.read(length))
+                except ValueError:
+                    self._send(400, b'{"error": "bad json"}')
+                    return
+                with server._lock:
+                    server._updates.append(
+                        {"time": time.time(), "update": update})
+                self._send(200, b'{"ok": true}')
+
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info("web status on http://%s:%d/", self.host, self.port)
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
