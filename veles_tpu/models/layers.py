@@ -49,7 +49,9 @@ class Layer(object):
         self.gd = {k: cfg[k] for k in
                    ("learning_rate", "learning_rate_bias", "weights_decay",
                     "weights_decay_bias", "l1_vs_l2", "gradient_moment",
-                    "gradient_moment_bias") if k in cfg}
+                    "gradient_moment_bias", "solver", "adam_beta1",
+                    "adam_beta2", "epsilon", "rprop_inc", "rprop_dec",
+                    "rprop_min", "rprop_max") if k in cfg}
         self.input_shape = None
         self.output_shape = None
         self.policy = default_policy()
@@ -290,6 +292,208 @@ class LSTM(Layer):
         return fn(params, x, self.policy, self.return_sequences)
 
 
+class LayerNorm(Layer):
+    """Layer normalization over the feature axis (ops.norm)."""
+
+    TYPES = ("layer_norm",)
+    has_params = True
+
+    def init_params(self, rng):
+        from veles_tpu.ops import norm
+        return norm.layer_norm_init((self.input_shape[-1],))
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import norm
+        return norm.layer_norm(x, params["gamma"], params["beta"])
+
+
+class Embedding(Layer):
+    """Token embedding: int ids [T] → [T, d_model]."""
+
+    TYPES = ("embedding",)
+    has_params = True
+
+    def _infer(self, input_shape):
+        self.vocab = int(self.cfg["vocab_size"])
+        self.d_model = int(self.cfg["d_model"])
+        return tuple(input_shape) + (self.d_model,)
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+        std = self.cfg.get("weights_stddev") or self.d_model ** -0.5
+        table = rng.normal(0.0, std, (self.vocab, self.d_model))
+        return {"table": jnp.asarray(table, self.policy.param)}
+
+    def apply(self, params, x, train=False, key=None):
+        return jnp.take(params["table"], x.astype(jnp.int32), axis=0)
+
+
+class PositionalEncoding(Layer):
+    """Add position information to [T, F] activations: ``learned`` table
+    or fixed sinusoidal (default) — without this a pooled transformer is
+    permutation-invariant over time."""
+
+    TYPES = ("positional_encoding",)
+
+    def _infer(self, input_shape):
+        self.learned = bool(self.cfg.get("learned", False))
+        return tuple(input_shape)
+
+    @property
+    def has_params(self):
+        return self.learned
+
+    def init_params(self, rng):
+        if not self.learned:
+            return {}
+        t, f = self.input_shape
+        return {"pos": jnp.asarray(rng.normal(0.0, 0.02, (t, f)),
+                                   self.policy.param)}
+
+    def _sinusoid(self):
+        import numpy as np
+        t, f = self.input_shape
+        pos = np.arange(t)[:, None]
+        i = np.arange(f)[None, :]
+        angle = pos / np.power(10000.0, (2 * (i // 2)) / f)
+        pe = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+        return jnp.asarray(pe, jnp.float32)
+
+    def apply(self, params, x, train=False, key=None):
+        pe = params["pos"] if self.learned else self._sinusoid()
+        return x + pe.astype(x.dtype)
+
+
+class MultiHeadAttention(Layer):
+    """Self-attention over [T, F] samples (ops.attention).  ``impl``
+    selects naive / blockwise / flash (Pallas); causal via ``causal``."""
+
+    TYPES = ("multihead_attention",)
+    has_params = True
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.n_heads = int(self.cfg.get("n_heads", 8))
+        if f % self.n_heads:
+            raise ValueError("d_model %d %% n_heads %d != 0"
+                             % (f, self.n_heads))
+        return (t, f)
+
+    def init_params(self, rng):
+        from veles_tpu.ops import attention
+        return attention.mha_init(rng, self.input_shape[-1], self.n_heads,
+                                  self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import attention
+        return attention.mha_forward(
+            params, x, self.n_heads,
+            causal=bool(self.cfg.get("causal", False)),
+            impl=self.cfg.get("impl", "blockwise"), policy=self.policy)
+
+
+class TransformerBlock(Layer):
+    """Pre-LN transformer block: LN→MHA→residual, LN→MLP(gelu)→residual.
+    ``impl`` as in MultiHeadAttention; optional dropout on both branches."""
+
+    TYPES = ("transformer_block",)
+    has_params = True
+
+    @property
+    def needs_rng(self):
+        return self.cfg.get("dropout_ratio", 0.0) > 0.0
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.n_heads = int(self.cfg.get("n_heads", 8))
+        self.d_ff = int(self.cfg.get("d_ff", 4 * f))
+        return (t, f)
+
+    def init_params(self, rng):
+        from veles_tpu.ops import attention, norm
+        f = self.input_shape[-1]
+        std = f ** -0.5
+        return {
+            "ln1": norm.layer_norm_init((f,)),
+            "mha": attention.mha_init(rng, f, self.n_heads,
+                                      self.policy.param),
+            "ln2": norm.layer_norm_init((f,)),
+            "w1": jnp.asarray(rng.normal(0.0, std, (f, self.d_ff)),
+                              self.policy.param),
+            "b1": jnp.zeros((self.d_ff,), self.policy.param),
+            "w2": jnp.asarray(rng.normal(0.0, self.d_ff ** -0.5,
+                                         (self.d_ff, f)),
+                              self.policy.param),
+            "b2": jnp.zeros((f,), self.policy.param),
+        }
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import attention, norm
+        ratio = self.cfg.get("dropout_ratio", 0.0)
+        k1 = k2 = None
+        if train and ratio > 0.0 and key is not None:
+            k1, k2 = jax.random.split(key)
+        h = norm.layer_norm(x, params["ln1"]["gamma"], params["ln1"]["beta"])
+        h = attention.mha_forward(
+            params["mha"], h, self.n_heads,
+            causal=bool(self.cfg.get("causal", False)),
+            impl=self.cfg.get("impl", "blockwise"), policy=self.policy)
+        if k1 is not None:
+            h = dropout.forward(h, k1, ratio)
+        x = x + h
+        h = norm.layer_norm(x, params["ln2"]["gamma"], params["ln2"]["beta"])
+        h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
+                        + params["b1"])
+        h = linear.matmul(h, params["w2"], self.policy) + params["b2"]
+        if k2 is not None:
+            h = dropout.forward(h, k2, ratio)
+        return x + h
+
+
+class TimestepDense(Layer):
+    """Per-timestep dense over [T, F] samples: [B, T, F] → [B, T, out]
+    (the transformer projection / LM head; weight shared across time)."""
+
+    TYPES = ("timestep_dense", "timestep_dense_tanh", "timestep_dense_relu")
+    has_params = True
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.n_in = f
+        self.n_out = int(self.cfg["output_sample_shape"])
+        return (t, self.n_out)
+
+    def init_params(self, rng):
+        return linear.init_params(
+            rng, self.n_in, self.n_out,
+            bias=self.cfg.get("include_bias", True),
+            weights_stddev=self.cfg.get("weights_stddev"),
+            dtype=self.policy.param)
+
+    def apply(self, params, x, train=False, key=None):
+        y = linear.matmul(x, params["weights"], self.policy)
+        if "bias" in params:
+            y = y + params["bias"].astype(y.dtype)
+        return self._activation()(y)
+
+
+class SeqPool(Layer):
+    """Collapse the time axis: mean / max / last (classifier head input)."""
+
+    TYPES = ("seq_pool",)
+
+    def _infer(self, input_shape):
+        self.mode = self.cfg.get("mode", "mean")
+        return tuple(input_shape[1:])
+
+    def apply(self, params, x, train=False, key=None):
+        if self.mode == "mean":
+            return jnp.mean(x, axis=1)
+        if self.mode == "max":
+            return jnp.max(x, axis=1)
+        return x[:, -1]
+
+
 class ZeroFiller(Layer):
     """Weight-mask regularizer: masks the *previous* parametric layer's
     weights after every update (ref Znicz ZeroFiller).  Carries no forward
@@ -303,7 +507,9 @@ class ZeroFiller(Layer):
 
 LAYER_TYPES = {}
 for _cls in (All2All, Conv, Deconv, Pooling, Depooling, LRN, Dropout,
-             Activation, Cutter, LSTM, ZeroFiller):
+             Activation, Cutter, LSTM, ZeroFiller, LayerNorm, Embedding,
+             PositionalEncoding, MultiHeadAttention, TransformerBlock,
+             TimestepDense, SeqPool):
     for _t in _cls.TYPES:
         LAYER_TYPES[_t] = _cls
 

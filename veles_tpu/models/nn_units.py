@@ -87,9 +87,8 @@ class StagedTrainer(Unit):
             layer.name = "l%02d_%s" % (i, layer.type)
             shape = layer.setup(shape)
             if layer.has_params:
-                self.params[layer.name] = {
-                    k: jnp.asarray(v)
-                    for k, v in layer.init_params(rng).items()}
+                self.params[layer.name] = jax.tree_util.tree_map(
+                    jnp.asarray, layer.init_params(rng))
                 hypers[layer.name] = optimizer.resolve_hyper(
                     layer.gd, self.gd_defaults)
         self.velocity = optimizer.init_state(self.params)
@@ -129,6 +128,11 @@ class StagedTrainer(Unit):
         if self.loss == "softmax":
             loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
                 out, lbl, valid)
+            n_features = 1
+        elif self.loss == "lm":
+            # next-token objective: predict x[t+1] from logits at t
+            loss_sum, err_sum, n_valid = losses.masked_seq_xent(
+                out[:, :-1], lbl[:, 1:], valid)
             n_features = 1
         else:  # mse
             loss_sum, n_valid, n_features = losses.masked_mse(
@@ -178,11 +182,12 @@ class StagedTrainer(Unit):
             mc = self.mesh_config
             repl = sharding.replicated_sharding(mc)
             p_sh = sharding.param_shardings(self.params, mc)
+            v_sh = sharding.param_shardings(self.velocity, mc)
             acc_sh = jax.tree_util.tree_map(lambda _: repl,
                                             self._zero_stats())
             self._train_step = jax.jit(
                 train_step, donate_argnums=(0, 1, 2),
-                out_shardings=(p_sh, p_sh, acc_sh))
+                out_shardings=(p_sh, v_sh, acc_sh))
             self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
                                       out_shardings=acc_sh)
             labels = sharding.replicate(labels, mc)

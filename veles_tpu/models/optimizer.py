@@ -1,19 +1,31 @@
-"""Gradient-descent update rules (ref Znicz GradientDescent family,
+"""Update rules (ref Znicz GradientDescent family + RPropAll2All,
 SURVEY.md §2.9 — GD/GDTanh/GDSoftmax etc. collapse into ``jax.grad`` over
 the staged loss; what remains of them is the *update rule* with the
 reference's hyperparameter surface: per-layer learning_rate / weights_decay
 / l1_vs_l2 mixing / gradient_moment (momentum), with separate bias values).
 
-The update matches Veles GD semantics:
-    reg     = (1 - l1_vs_l2) * w + l1_vs_l2 * sign(w)
-    v       = gradient_moment * v - lr * (grad + weights_decay * reg)
-    w      += v
-"""
+Solvers, selectable per layer via ``solver``:
+
+- ``gd``      Veles GD semantics:
+                  reg = (1 - l1_vs_l2) * w + l1_vs_l2 * sign(w)
+                  v   = gradient_moment * v - lr * (grad + weights_decay*reg)
+                  w  += v
+- ``adam``    bias-corrected Adam (new capability — transformers don't
+              train well under momentum-SGD)
+- ``adagrad`` accumulated squared gradients
+- ``rprop``   sign-based resilient propagation (ref RPropAll2All):
+              per-weight step grows ×1.2 on agreeing signs, shrinks ×0.5
+              on sign flips
+
+State is {"slot1": tree, "slot2": tree, "step": scalar}: slot1 = momentum
+velocity / Adam m / RProp previous gradient; slot2 = Adam v / AdaGrad
+accumulator / RProp per-weight step."""
 
 import jax
 import jax.numpy as jnp
 
 DEFAULTS = {
+    "solver": "gd",
     "learning_rate": 0.01,
     "learning_rate_bias": None,      # None -> same as learning_rate
     "weights_decay": 0.0,
@@ -21,6 +33,13 @@ DEFAULTS = {
     "l1_vs_l2": 0.0,                 # 0 = pure L2, 1 = pure L1
     "gradient_moment": 0.0,
     "gradient_moment_bias": None,
+    "adam_beta1": 0.9,
+    "adam_beta2": 0.999,
+    "epsilon": 1e-8,
+    "rprop_inc": 1.2,
+    "rprop_dec": 0.5,
+    "rprop_min": 1e-8,
+    "rprop_max": 1.0,
 }
 
 
@@ -38,40 +57,82 @@ def resolve_hyper(layer_gd, workflow_gd=None):
 
 
 def init_state(params):
-    """Momentum velocity pytree, zeros like params."""
-    return jax.tree_util.tree_map(jnp.zeros_like, params)
+    zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)  # noqa
+    return {"slot1": zeros(), "slot2": zeros(),
+            "step": jnp.zeros((), jnp.int32)}
 
 
-def _update_leaf(w, g, v, lr, wd, l1, moment):
+def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h):
     reg = (1.0 - l1) * w + l1 * jnp.sign(w)
-    v_new = moment * v - lr * (g + wd * reg)
-    return w + v_new, v_new
+    if solver == "adam":
+        b1, b2, eps = h["adam_beta1"], h["adam_beta2"], h["epsilon"]
+        m = b1 * s1 + (1.0 - b1) * g
+        v = b2 * s2 + (1.0 - b2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1.0 - b1 ** t)
+        vhat = v / (1.0 - b2 ** t)
+        return (w - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * reg), m, v)
+    if solver == "adagrad":
+        v = s2 + g * g
+        return (w - lr * (g / (jnp.sqrt(v) + h["epsilon"]) + wd * reg),
+                s1, v)
+    if solver == "rprop":
+        # s1 = previous gradient, s2 = per-weight step (0 → lr on first use)
+        delta = jnp.where(s2 == 0.0, lr, s2)
+        agree = jnp.sign(g) * jnp.sign(s1)
+        delta = jnp.clip(
+            jnp.where(agree > 0, delta * h["rprop_inc"],
+                      jnp.where(agree < 0, delta * h["rprop_dec"], delta)),
+            h["rprop_min"], h["rprop_max"])
+        # on sign flip: skip the step and forget the gradient (iRprop-)
+        g_eff = jnp.where(agree < 0, 0.0, g)
+        return (w - jnp.sign(g_eff) * delta, g_eff, delta)
+    # plain GD + momentum
+    v_new = moment * s1 - lr * (g + wd * reg)
+    return w + v_new, v_new, s2
 
 
-def update_layer(params, grads, velocity, hyper, lr_scale=1.0):
-    """Apply the GD rule to one layer's param dict ({'weights', 'bias'?})."""
-    new_p, new_v = {}, {}
-    for name in params:
-        bias = name == "bias"
-        w, g, v = params[name], grads[name], velocity[name]
-        p2, v2 = _update_leaf(
-            w, g.astype(w.dtype), v,
+_BIAS_KEYS = frozenset(
+    {"bias", "beta", "b1", "b2", "bq", "bk", "bv", "bo"})
+
+
+def _is_bias(path):
+    """A leaf follows the *_bias hyperparameters when its dict key names a
+    known bias/shift vector (explicit allowlist — a prefix heuristic would
+    silently misclassify future params like 'base' or 'block_scale')."""
+    return str(getattr(path[-1], "key", "")) in _BIAS_KEYS
+
+
+def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0):
+    """Apply the update rule to one layer's param pytree (flat
+    {'weights', 'bias'} or nested transformer-style dicts)."""
+    solver = hyper.get("solver", "gd")
+
+    def upd(path, w, g, a, b):
+        bias = _is_bias(path)
+        return _update_leaf(
+            solver, w, g.astype(w.dtype), a, b, step,
             lr_scale * (hyper["learning_rate_bias"] if bias
                         else hyper["learning_rate"]),
             hyper["weights_decay_bias"] if bias else hyper["weights_decay"],
             hyper["l1_vs_l2"],
             hyper["gradient_moment_bias"] if bias
-            else hyper["gradient_moment"])
-        new_p[name], new_v[name] = p2, v2
-    return new_p, new_v
+            else hyper["gradient_moment"], hyper)
+
+    triples = jax.tree_util.tree_map_with_path(upd, params, grads, s1, s2)
+    is_t = lambda x: isinstance(x, tuple)  # noqa: E731
+    pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+        lambda t: t[i], triples, is_leaf=is_t)
+    return pick(0), pick(1), pick(2)
 
 
-def update(params, grads, velocity, hypers, lr_scale=1.0):
+def update(params, grads, state, hypers, lr_scale=1.0):
     """Whole-model update.  ``params`` is {layer_name: {param: array}};
     ``hypers`` is {layer_name: resolved hyper dict}."""
-    new_params, new_vel = {}, {}
+    step = state["step"] + 1
+    new_p, new_s1, new_s2 = {}, {}, {}
     for lname in params:
-        new_params[lname], new_vel[lname] = update_layer(
-            params[lname], grads[lname], velocity[lname], hypers[lname],
-            lr_scale)
-    return new_params, new_vel
+        new_p[lname], new_s1[lname], new_s2[lname] = update_layer(
+            params[lname], grads[lname], state["slot1"][lname],
+            state["slot2"][lname], step, hypers[lname], lr_scale)
+    return new_p, {"slot1": new_s1, "slot2": new_s2, "step": step}

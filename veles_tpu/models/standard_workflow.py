@@ -41,7 +41,7 @@ class StandardWorkflow(Workflow):
         self.forwards = [Forward(self, lay, self.trainer)
                          for lay in self.trainer.layers]
 
-        decision_cls = DecisionGD if loss == "softmax" else DecisionMSE
+        decision_cls = DecisionGD if loss in ("softmax", "lm") else DecisionMSE
         self.decision = decision_cls(self, **(decision_config or {}))
         self.decision.loader = self.loader
         self.decision.trainer = self.trainer

@@ -76,6 +76,50 @@ def alexnet(n_classes=1000, lr=0.01, moment=0.9, wd=5e-4):
     ]
 
 
+def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
+                           d_ff=None, lr=0.001, moment=0.9, causal=False,
+                           dropout=0.1, impl="blockwise", solver="adam"):
+    """Transformer encoder classifier over [T, F] sequence samples — new
+    capability beyond the reference (its RNN/LSTM support was 'in
+    progress', manualrst_veles_algorithms.rst:105-112; attention postdates
+    it).  ``impl`` picks the attention path (blockwise / flash=Pallas)."""
+    gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
+    layers = [dict({"type": "timestep_dense", "output_sample_shape": d_model},
+                   **gd),
+              {"type": "positional_encoding"}]
+    for _ in range(n_layers):
+        layers.append(dict({"type": "transformer_block",
+                            "n_heads": n_heads,
+                            "d_ff": d_ff or 4 * d_model,
+                            "causal": causal, "dropout_ratio": dropout,
+                            "impl": impl}, **gd))
+    layers.append(dict({"type": "layer_norm"}, **gd))
+    layers.append({"type": "seq_pool", "mode": "mean"})
+    layers.append(dict({"type": "softmax", "output_sample_shape": n_classes},
+                       **gd))
+    return layers
+
+
+def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+                   d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
+                   impl="blockwise", solver="adam"):
+    """Decoder-only causal LM over int token samples [T]."""
+    gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
+    layers = [dict({"type": "embedding", "vocab_size": vocab_size,
+                    "d_model": d_model}, **gd),
+              dict({"type": "positional_encoding", "learned": True}, **gd)]
+    for _ in range(n_layers):
+        layers.append(dict({"type": "transformer_block",
+                            "n_heads": n_heads,
+                            "d_ff": d_ff or 4 * d_model,
+                            "causal": True, "dropout_ratio": dropout,
+                            "impl": impl}, **gd))
+    layers.append(dict({"type": "layer_norm"}, **gd))
+    layers.append(dict({"type": "timestep_dense",
+                        "output_sample_shape": vocab_size}, **gd))
+    return layers
+
+
 def mnist_autoencoder(bottleneck=16, lr=0.01, moment=0.9):
     """MNIST-style autoencoder (ref manualrst_veles_algorithms.rst:55-70,
     validation RMSE 0.5478)."""

@@ -1,0 +1,192 @@
+"""Multi-head attention — naive, blockwise (flash) and Pallas paths.
+
+New capability beyond the reference (SURVEY.md §5 "Long-context /
+sequence parallelism: Absent" — the reference predates attention); the
+TPU build treats long-context as first-class.  Three implementations with
+one contract:
+
+- ``attention``          O(T²) memory reference implementation (einsum),
+                         ground truth for the tests.
+- ``blockwise_attention``online-softmax ``lax.scan`` over key/value
+                         blocks: O(T·block) memory, pure XLA, works on any
+                         backend, and is what ring attention reuses per
+                         shard (parallel.ring).
+- ``flash_attention``    Pallas TPU kernel (ops.pallas.flash), VMEM-tiled;
+                         falls back to interpret mode off-TPU.
+
+All take [B, H, T, D] and return [B, H, T, D]; softmax math in f32
+regardless of input dtype."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _scale(d, scale=None):
+    return 1.0 / math.sqrt(d) if scale is None else scale
+
+
+def attention(q, k, v, causal=False, scale=None, bias=None):
+    """Reference O(T²) attention.  q,k,v: [B, H, T, D]."""
+    *_, tq, d = q.shape
+    tk = k.shape[-2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s * _scale(d, scale)
+    if bias is not None:
+        s = s + bias
+    if causal:
+        mask = (jnp.arange(tq)[:, None] + (tk - tq)) >= jnp.arange(tk)[None]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
+                        q_offset=0, k_offset=0, carry=None, return_carry=False):
+    """Online-softmax attention scanning over key blocks.
+
+    ``q_offset``/``k_offset`` are the *global* sequence positions of the
+    local q/k shards — this is what lets ring attention apply a correct
+    causal mask across devices.  ``carry``/``return_carry`` expose the
+    (acc, max, sum) online-softmax state so partial attention over
+    different kv shards can be chained (the ring step):
+
+        carry = None
+        for each kv shard:
+            carry = blockwise_attention(..., carry=carry, return_carry=True)
+        out = finalize_attention(carry)
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[-2]
+    block_k = min(block_k, tk)
+    nk = -(-tk // block_k)
+    pad = nk * block_k - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    sc = _scale(d, scale)
+    qpos = q_offset + jnp.arange(tq)
+
+    if carry is None:
+        acc = jnp.zeros((b, h, tq, d), jnp.float32)
+        m = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+        l = jnp.zeros((b, h, tq), jnp.float32)
+    else:
+        acc, m, l = carry
+
+    kb = k.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    def step(carry, inputs):
+        acc, m, l = carry
+        ki, kblk, vblk = inputs
+        kpos = k_offset + ki * block_k + jnp.arange(block_k)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk,
+                       preferred_element_type=jnp.float32) * sc
+        valid = kpos < (k_offset + tk)          # padding mask
+        if causal:
+            valid = valid[None, :] & (qpos[:, None] >= kpos[None, :])
+            s = jnp.where(valid, s, NEG_INF)
+        else:
+            s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard: all-masked rows keep m=NEG_INF; exp(NEG_INF-NEG_INF)=1
+        # would poison l, so renormalize against a safe max
+        m_safe = jnp.maximum(m_new, -0.5 * abs(NEG_INF))
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(jnp.maximum(m, -0.5 * abs(NEG_INF)) - m_safe)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+        return (acc, m_new, l), None
+
+    (acc, m, l), _ = lax.scan(step, (acc, m, l),
+                              (jnp.arange(nk), kb, vb))
+    if return_carry:
+        return acc, m, l
+    return finalize_attention((acc, m, l)).astype(q.dtype)
+
+
+def finalize_attention(carry):
+    """Normalize the online-softmax accumulator: out = acc / l."""
+    acc, _, l = carry
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D]."""
+    from veles_tpu.ops.pallas import flash
+    return flash.flash_attention(q, k, v, causal=causal,
+                                 scale=_scale(q.shape[-1], scale),
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# multi-head attention layer math
+
+def mha_init(rng, d_model, n_heads, dtype=jnp.float32):
+    """QKV + output projection params.  ``rng`` is the framework PRNG
+    (veles_tpu.prng RandomGenerator) for reproducibility."""
+    std = 1.0 / math.sqrt(d_model)
+    def w(shape):
+        return jnp.asarray(rng.normal(0.0, std, shape), dtype)
+    return {
+        "wq": w((d_model, d_model)), "wk": w((d_model, d_model)),
+        "wv": w((d_model, d_model)), "wo": w((d_model, d_model)),
+        "bq": jnp.zeros((d_model,), dtype), "bk": jnp.zeros((d_model,), dtype),
+        "bv": jnp.zeros((d_model,), dtype), "bo": jnp.zeros((d_model,), dtype),
+    }
+
+
+def split_heads(x, n_heads):
+    b, t, dm = x.shape
+    return x.reshape(b, t, n_heads, dm // n_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x):
+    return x.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[2], -1)
+
+
+def _proj(x, w, b, policy):
+    if policy is None:
+        return x @ w + b
+    y = jnp.matmul(policy.cast_in(x), policy.cast_in(w),
+                   preferred_element_type=policy.accum)
+    return y + b.astype(policy.accum)
+
+
+def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
+                attn_fn=None, policy=None):
+    """x: [B, T, d_model] → [B, T, d_model].
+
+    ``attn_fn(q, k, v, causal)`` overrides the core attention — this is the
+    hook ring/Ulysses sequence parallelism plugs into (parallel.ring).
+    ``policy`` (ops.policy.Policy) casts the projection matmuls and the
+    attention inputs to the compute dtype (bf16 on the MXU)."""
+    cast = (lambda t: t) if policy is None else policy.cast_in
+    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
+                    n_heads)
+    k = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
+                    n_heads)
+    v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
+                    n_heads)
+    if attn_fn is None:
+        if impl == "naive":
+            attn_fn = attention
+        elif impl == "flash":
+            attn_fn = flash_attention
+        else:
+            attn_fn = blockwise_attention
+    o = attn_fn(q, k, v, causal=causal)
+    return _proj(merge_heads(o), params["wo"], params["bo"], policy)

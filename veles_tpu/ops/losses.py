@@ -47,6 +47,21 @@ def masked_softmax_xent(logits, labels, valid):
     return jnp.sum(nll * valid), err_sum, jnp.sum(valid)
 
 
+def masked_seq_xent(logits, labels, valid):
+    """Per-timestep softmax cross-entropy for language modeling:
+    logits [B, T, V], labels [B, T] int, valid [B] 0/1 sample mask.
+
+    :returns: (nll_sum, err_sum, n_valid_tokens) — float32 scalars.
+    """
+    b, t, v = logits.shape
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    pred = jnp.argmax(logits, axis=-1)
+    tok_valid = jnp.broadcast_to(valid[:, None], (b, t)).astype(jnp.float32)
+    err_sum = jnp.sum((pred != labels).astype(jnp.float32) * tok_valid)
+    return (jnp.sum(nll * tok_valid), err_sum, jnp.sum(tok_valid))
+
+
 def masked_mse(output, target, valid):
     """Masked-batch summed squared error.
 

@@ -1,0 +1,42 @@
+"""Normalization ops: layer norm, RMS norm, batch norm.
+
+The reference only ships LRN ("norm" layer, ocl/cuda kernels absent with
+Znicz); layer/RMS norm are required by the transformer stack
+(ops.attention) and are new capability beyond parity.  All reductions in
+f32 regardless of the compute dtype — matches the framework-wide policy of
+bf16 storage + f32 accumulation (ops.policy)."""
+
+import jax.numpy as jnp
+
+
+def layer_norm(x, gamma=None, beta=None, eps=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    if beta is not None:
+        y = y + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rms_norm(x, gamma=None, eps=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    y = xf * jnp.reciprocal(jnp.sqrt(ms + eps))
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def layer_norm_init(shape):
+    return {"gamma": jnp.ones(shape, jnp.float32),
+            "beta": jnp.zeros(shape, jnp.float32)}
+
+
+def batch_norm(x, mean, var, gamma, beta, eps=1e-5):
+    """Inference-mode batch norm with running statistics."""
+    xf = x.astype(jnp.float32)
+    y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps)) * gamma + beta
+    return y.astype(x.dtype)

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops (see /opt/skills/guides/pallas_guide.md).
+
+Kernels here are the hand-tiled VMEM path; every one has an XLA or numpy
+equivalent elsewhere in ops/ that serves as ground truth in the tests.
+Off-TPU the kernels run in interpret mode (``interpret=None`` auto-detects),
+so the same code is exercised by the CPU test suite."""
+
+import jax
+
+
+def autodetect_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret

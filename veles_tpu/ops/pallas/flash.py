@@ -1,0 +1,125 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Online-softmax tiling (Dao et al.) mapped to the TPU memory hierarchy:
+grid = (batch·heads, q-blocks, k-blocks) executed sequentially with the
+k dimension innermost; the f32 accumulator and the running (max, sum)
+statistics live in VMEM scratch that persists across the inner k sweep,
+so each q tile streams every k/v tile through VMEM exactly once —
+O(T·block) VMEM instead of the O(T²) score matrix.  Matmuls hit the MXU
+with f32 accumulation (``preferred_element_type``); causal q-blocks that
+are entirely above the diagonal are skipped (``@pl.when``), halving the
+work for autoregressive models."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles_tpu.ops.pallas import autodetect_interpret
+
+NEG_INF = -1e30
+_LANES = 128          # m/l scratch padded to a full lane tile
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
+            *, scale, causal, block_q, block_k, nk, tk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    # causal: skip k blocks entirely above the diagonal
+    diag_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_ok)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < tk                      # key padding
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(m_prev <= NEG_INF / 2, 0.0, corr)
+        l[:] = l[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l.shape)
+        m[:] = jnp.broadcast_to(m_new, m.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        out = acc[:] / jnp.maximum(l[:, :1], 1e-30)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    t = x.shape[axis]
+    pad = (-t) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def flash_attention(q, k, v, causal=False, scale=1.0, block_q=128,
+                    block_k=128, interpret=None):
+    """q, k, v: [B, H, T, D] → [B, H, T, D]."""
+    b, h, tq, d = q.shape
+    tk = k.shape[-2]
+    if causal and tq != tk:
+        raise ValueError("causal flash kernel assumes tq == tk")
+    block_q = min(block_q, max(tq, 8))
+    block_k = min(block_k, max(tk, 8))
+    qp = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
+    kp = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
+    vp = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
+    nq = qp.shape[1] // block_q
+    nk = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk, tk=tk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=autodetect_interpret(interpret),
+    )(qp, kp, vp)
+    return out[:, :tq].reshape(b, h, tq, d)
