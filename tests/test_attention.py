@@ -39,6 +39,22 @@ def test_flash_pallas_matches_naive(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_differentiable(causal):
+    """flash impl must be trainable: grads match the naive reference."""
+    q, k, v = _qkv(t=32, d=16)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) ** 2)
+
+    g_ref = jax.grad(loss(att.attention), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss(lambda *a, **kw: att.flash_attention(
+        *a, block_q=16, block_k=16, **kw)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_flash_pallas_padding():
     q, k, v = _qkv(t=100, d=16)
     ref = att.attention(q, k, v)

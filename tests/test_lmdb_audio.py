@@ -1,0 +1,130 @@
+"""LMDB-style KV loader (with a fake env) and audio loader tests."""
+
+import pickle
+import wave
+
+import numpy as np
+import pytest
+
+from veles_tpu.loader import TRAIN, VALID
+from veles_tpu.loader.audio import AudioLoader, read_audio, window
+from veles_tpu.loader.lmdb import LMDBLoader, decode_record
+
+
+class FakeTxn:
+    def __init__(self, records):
+        self.records = records
+
+    def cursor(self):
+        return iter(sorted(self.records.items()))
+
+    def get(self, key):
+        return self.records.get(key)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class FakeEnv:
+    def __init__(self, records):
+        self.records = records
+        self.closed = False
+
+    def begin(self):
+        return FakeTxn(self.records)
+
+    def close(self):
+        self.closed = True
+
+
+class TestLMDBLoader:
+    def test_decode_record_variants(self):
+        import io
+        buf = io.BytesIO()
+        np.save(buf, np.arange(4, dtype=np.float32))
+        d, l = decode_record(buf.getvalue())
+        np.testing.assert_array_equal(d, [0, 1, 2, 3])
+        assert l is None
+        d, l = decode_record(pickle.dumps((np.ones(3, np.float32), 7)))
+        assert l == 7
+        d, l = decode_record(np.arange(6, dtype=np.float32).tobytes(),
+                             sample_shape=(2, 3))
+        assert d.shape == (2, 3)
+
+    def test_loads_classes_from_fake_envs(self):
+        rng = np.random.RandomState(0)
+        envs = {}
+
+        def factory(path):
+            records = {b"%04d" % i: pickle.dumps(
+                (rng.rand(4).astype(np.float32), i % 3))
+                for i in range(8 if "train" in path else 4)}
+            envs[path] = FakeEnv(records)
+            return envs[path]
+
+        loader = LMDBLoader(None, dbs={"train": "train.mdb",
+                                       "validation": "val.mdb"},
+                            env_factory=factory, minibatch_size=4)
+        loader.initialize()
+        assert loader.class_lengths == [0, 4, 8]
+        assert loader.original_data.shape == (12, 4)
+        assert loader.original_labels.shape == (12,)
+        assert all(env.closed for env in envs.values())
+        loader.run()
+        assert loader.minibatch_indices.shape[0] == 4
+        got = LMDBLoader.gather(loader.data, loader.minibatch_indices)
+        assert got.shape == (4, 4)
+
+    def test_missing_lmdb_package_reports_clearly(self, tmp_path):
+        loader = LMDBLoader(None, dbs={"train": str(tmp_path)})
+        with pytest.raises(ImportError, match="lmdb"):
+            loader.initialize()
+
+
+def _write_wav(path, samples, rate=8000):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes((np.clip(samples, -1, 1) * 32767)
+                      .astype("<i2").tobytes())
+
+
+class TestAudioLoader:
+    def test_read_audio_roundtrip(self, tmp_path):
+        sig = np.sin(np.linspace(0, 40 * np.pi, 4000)).astype(np.float32)
+        _write_wav(tmp_path / "t.wav", sig)
+        data, rate = read_audio(str(tmp_path / "t.wav"))
+        assert rate == 8000
+        np.testing.assert_allclose(data, sig, atol=1e-3)
+
+    def test_window(self):
+        w = window(np.arange(10, dtype=np.float32), 4, 3)
+        assert w.shape == (3, 4)
+        np.testing.assert_array_equal(w[1], [3, 4, 5, 6])
+
+    def test_loader_frames_and_labels(self, tmp_path):
+        for name in ("a", "b"):
+            _write_wav(tmp_path / (name + ".wav"),
+                       np.random.RandomState(0).rand(2048) * 2 - 1)
+        loader = AudioLoader(
+            None,
+            files={"train": [str(tmp_path / "a.wav"),
+                             (str(tmp_path / "b.wav"), 5)],
+                   "validation": [str(tmp_path / "a.wav")]},
+            frame_size=512, minibatch_size=2)
+        loader.initialize()
+        # 2048 samples / 512 = 4 frames per file
+        assert loader.class_lengths == [0, 4, 8]
+        assert loader.original_data.shape == (12, 512)
+        # VALID block comes first in the concatenated layout
+        labels = loader.original_labels
+        assert list(labels[:4]) == [0, 0, 0, 0]
+        assert list(labels[8:]) == [5, 5, 5, 5]
+        loader.run()
+        assert loader.minibatch_indices.shape == (2,)
+        got = AudioLoader.gather(loader.data, loader.minibatch_indices)
+        assert got.shape == (2, 512)

@@ -1,0 +1,159 @@
+"""Tests for topology layers (channel split/merge, resizable all2all,
+stochastic pool-depool), InputJoiner/Avatar/Shell units, and the
+foundation helpers (NumDiff, DeviceBenchmark, Watcher)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from veles_tpu import prng  # noqa: E402
+from veles_tpu.avatar import Avatar  # noqa: E402
+from veles_tpu.benchmark import DeviceBenchmark, Watcher  # noqa: E402
+from veles_tpu.input_joiner import InputJoiner  # noqa: E402
+from veles_tpu.interaction import Shell  # noqa: E402
+from veles_tpu.models.layers import make_layer  # noqa: E402
+from veles_tpu.numpy_ext import NumDiff, interleave, roundup  # noqa: E402
+from veles_tpu.units import TrivialUnit  # noqa: E402
+
+
+class TestTopologyLayers:
+    def test_channel_split_merge_roundtrip(self):
+        split = make_layer({"type": "channel_splitter"})
+        merge = make_layer({"type": "channel_merger"})
+        shape = split.setup((4, 5, 3))
+        assert shape == (3, 4, 5, 1)
+        assert merge.setup(shape) == (4, 5, 3)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 4, 5, 3),
+                        jnp.float32)
+        y = merge.apply(None, split.apply(None, x))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+    def test_resizable_all2all_resize_preserves_overlap(self):
+        layer = make_layer({"type": "resizable_all2all",
+                            "output_sample_shape": 6})
+        layer.setup((4,))
+        prng.seed_all(5)
+        params = layer.init_params(prng.get("t"))
+        grown = layer.resize(params, 10, prng.get("t2"))
+        assert grown["weights"].shape == (4, 10)
+        assert layer.output_shape == (10,)
+        np.testing.assert_allclose(np.asarray(grown["weights"][:, :6]),
+                                   np.asarray(params["weights"]))
+        np.testing.assert_allclose(np.asarray(grown["bias"][:6]),
+                                   np.asarray(params["bias"]))
+        shrunk = layer.resize(grown, 3, prng.get("t3"))
+        assert shrunk["weights"].shape == (4, 3)
+        np.testing.assert_allclose(np.asarray(shrunk["weights"]),
+                                   np.asarray(params["weights"][:, :3]))
+
+    def test_stochastic_pool_depool_shape_and_sparsity(self):
+        layer = make_layer({"type": "stochastic_pooling_depooling",
+                            "kx": 2, "ky": 2})
+        assert layer.setup((4, 4, 2)) == (4, 4, 2)
+        x = jnp.asarray(np.random.RandomState(1).rand(3, 4, 4, 2) + 0.1,
+                        jnp.float32)
+        y = np.asarray(layer.apply(None, x, train=True,
+                                   key=jax.random.PRNGKey(0)))
+        assert y.shape == (3, 4, 4, 2)
+        # exactly one survivor per 2x2 window per channel, value from input
+        win = y.reshape(3, 2, 2, 2, 2, 2)
+        nonzero = (np.abs(win) > 0).sum(axis=(2, 4))
+        assert (nonzero == 1).all()
+        mask = np.abs(y) > 0
+        np.testing.assert_allclose(y[mask], np.asarray(x)[mask])
+        # inference is identity
+        np.testing.assert_allclose(
+            np.asarray(layer.apply(None, x, train=False)), np.asarray(x))
+
+    def test_stochastic_pool_depool_ragged_edges_zeroed(self):
+        layer = make_layer({"type": "stochastic_pooling_depooling",
+                            "kx": 2, "ky": 2})
+        assert layer.setup((5, 5, 1)) == (5, 5, 1)
+        x = jnp.ones((1, 5, 5, 1), jnp.float32)
+        y = np.asarray(layer.apply(None, x, train=True,
+                                   key=jax.random.PRNGKey(1)))
+        assert (y[:, 4, :, :] == 0).all() and (y[:, :, 4, :] == 0).all()
+
+
+class TestJoinerAvatarShell:
+    def test_input_joiner_concatenates_features(self):
+        a = TrivialUnit(None, name="a")
+        b = TrivialUnit(None, name="b")
+        a.output = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b.output = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+        joiner = InputJoiner(None)
+        joiner.link_input(a).link_input(b)
+        joiner.initialize()
+        joiner.run()
+        assert joiner.output.shape == (2, 7)
+        assert joiner.output_sample_size == 7
+        np.testing.assert_array_equal(joiner.output[0],
+                                      [0, 1, 2, 0, 1, 2, 3])
+
+    def test_input_joiner_rejects_mismatched_batch(self):
+        a = TrivialUnit(None, name="a")
+        b = TrivialUnit(None, name="b")
+        a.output = np.zeros((2, 3), np.float32)
+        b.output = np.zeros((3, 3), np.float32)
+        joiner = InputJoiner(None).link_input(a).link_input(b)
+        joiner.initialize()
+        with pytest.raises(ValueError):
+            joiner.run()
+
+    def test_avatar_clones_and_tracks(self):
+        src = TrivialUnit(None, name="src")
+        src.metric = 1.0
+        av = Avatar(None, source=src, attrs=["metric"])
+        av.initialize()
+        assert av.metric == 1.0
+        src.metric = 2.0
+        av.run()
+        assert av.metric == 2.0
+
+    def test_avatar_deep_copies(self):
+        src = TrivialUnit(None, name="src")
+        src.buf = np.zeros(3)
+        av = Avatar(None, source=src, attrs=["buf"], deep=True)
+        av.initialize()
+        src.buf[0] = 7
+        assert av.buf[0] == 0
+
+    def test_shell_injectable_console(self):
+        seen = {}
+        sh = Shell(None, console=lambda env: seen.update(env))
+        sh.run()
+        assert seen["shell"] is sh
+        assert "wf" in seen
+
+
+class TestFoundationHelpers:
+    def test_roundup_interleave(self):
+        assert roundup(5, 8) == 8
+        assert roundup(16, 8) == 16
+        out = interleave(np.array([[1, 3], [2, 4]]))
+        np.testing.assert_array_equal(out, [1, 2, 3, 4])
+
+    def test_numdiff(self):
+        d = NumDiff(threshold=1e-3)
+        d.check(np.zeros(4), np.zeros(4))
+        assert d.ok
+        d.check(np.zeros(4), np.array([0, 0, 0.01, 0]))
+        assert not d.ok and d.count == 1
+        assert d.max_index == (2,)
+        with pytest.raises(AssertionError):
+            d.assert_ok()
+
+    def test_device_benchmark(self):
+        b = DeviceBenchmark(None, size=64, repeats=1)
+        b.run()
+        assert b.seconds > 0 and b.computing_power > 0 and b.gflops > 0
+
+    def test_watcher(self):
+        keep = jnp.ones((16, 16))
+        w = Watcher()
+        per_device = w.snapshot()
+        assert all(v >= 0 for v in per_device.values())
+        assert w.peak >= keep.nbytes
+        assert isinstance(Watcher.runtime_stats(), dict)

@@ -1,0 +1,95 @@
+"""Publisher report backends + Forge model-zoo client/server round trip."""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from veles_tpu.forge import ForgeClient, ForgeServer
+from veles_tpu.publishing import Publisher
+from veles_tpu.units import TrivialUnit
+from veles_tpu.workflow import Workflow
+
+
+def _small_workflow():
+    wf = Workflow(name="pubtest")
+    u = TrivialUnit(wf, name="worker")
+    u.run_count = 3
+    u.run_time = 0.5
+    return wf
+
+
+class TestPublisher:
+    def test_markdown_html_json_reports(self, tmp_path):
+        wf = _small_workflow()
+        pub = Publisher(wf, backends=("markdown", "html", "json"),
+                        directory=str(tmp_path),
+                        description="desc here")
+        pub.run()
+        assert len(pub.written) == 3
+        md = open(os.path.join(str(tmp_path), "pubtest.md")).read()
+        assert "# pubtest" in md and "worker" in md and "desc here" in md
+        html = open(os.path.join(str(tmp_path), "pubtest.html")).read()
+        assert "<h1>pubtest</h1>" in html and "worker" in html
+        rep = json.load(open(os.path.join(str(tmp_path), "pubtest.json")))
+        assert rep["name"] == "pubtest"
+        worker = [u for u in rep["units"] if u["name"] == "worker"][0]
+        assert worker["runs"] == 3
+
+    def test_markdown_includes_metrics_and_plots(self, tmp_path):
+        wf = _small_workflow()
+        plotter = TrivialUnit(wf, name="plots")
+        plotter.written_files = [str(tmp_path / "loss.png")]
+        wf.results_hook = None
+        pub = Publisher(wf, backends=("markdown",), directory=str(tmp_path))
+        report = pub.gather()
+        assert str(tmp_path / "loss.png") in report["plots"]
+
+
+def _make_package(path):
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("contents.json", json.dumps({"units": []}))
+        zf.writestr("w.npy", np.zeros(4, np.float32).tobytes())
+    return path
+
+
+class TestForge:
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = ForgeServer(str(tmp_path / "store")).start()
+        yield srv
+        srv.stop()
+
+    def test_upload_list_fetch_roundtrip(self, server, tmp_path):
+        pkg = _make_package(str(tmp_path / "model.zip"))
+        client = ForgeClient(server.url)
+        manifest = client.upload(pkg, "mnist", "1.0", description="first")
+        assert manifest["latest"] == "1.0"
+        client.upload(pkg, "mnist", "1.1")
+        listing = client.list()
+        assert len(listing) == 1
+        assert listing[0]["latest"] == "1.1"
+        assert set(listing[0]["versions"]) == {"1.0", "1.1"}
+        details = client.details("mnist")
+        assert details["versions"]["1.0"]["description"] == "first"
+        dest, version = client.fetch("mnist", str(tmp_path / "got.zip"))
+        assert version == "1.1"
+        assert open(dest, "rb").read() == open(pkg, "rb").read()
+        dest, version = client.fetch("mnist", str(tmp_path / "got10.zip"),
+                                     version="1.0")
+        assert version == "1.0"
+
+    def test_fetch_missing_model_404(self, server, tmp_path):
+        import urllib.error
+        client = ForgeClient(server.url)
+        with pytest.raises(urllib.error.HTTPError):
+            client.fetch("nope", str(tmp_path / "x.zip"))
+
+    def test_bad_names_rejected(self, server, tmp_path):
+        import urllib.error
+        pkg = _make_package(str(tmp_path / "m.zip"))
+        client = ForgeClient(server.url)
+        with pytest.raises(urllib.error.HTTPError):
+            client.upload(pkg, "../evil", "1.0")

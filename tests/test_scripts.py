@@ -1,0 +1,95 @@
+"""Tests for the utility scripts: compare_snapshots, generate_frontend,
+bboxer."""
+
+import gzip
+import io
+import json
+import pickle
+
+import numpy as np
+
+from veles_tpu.scripts import bboxer, compare_snapshots, generate_frontend
+
+
+def _write_snapshot(path, state):
+    with gzip.open(path, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+class TestCompareSnapshots:
+    def test_identical_and_differing(self, tmp_path):
+        a = {"params": {"l0": {"weights": np.ones((2, 2)),
+                               "bias": np.zeros(2)}},
+             "epoch": 3}
+        pa = str(tmp_path / "a.pickle.gz")
+        pb = str(tmp_path / "b.pickle.gz")
+        _write_snapshot(pa, a)
+        _write_snapshot(pb, a)
+        out = io.StringIO()
+        assert compare_snapshots.compare(pa, pb, out=out) == 0
+        assert "match" in out.getvalue()
+        b = {"params": {"l0": {"weights": np.ones((2, 2)) * 1.5,
+                               "bias": np.zeros(2)}},
+             "epoch": 4}
+        _write_snapshot(pb, b)
+        out = io.StringIO()
+        assert compare_snapshots.compare(pa, pb, out=out) == 1
+        text = out.getvalue()
+        assert "weights" in text and "epoch" in text
+        assert "bias" not in text
+
+    def test_structure_mismatch_reported(self, tmp_path):
+        pa = str(tmp_path / "a.pickle.gz")
+        pb = str(tmp_path / "b.pickle.gz")
+        _write_snapshot(pa, {"x": 1})
+        _write_snapshot(pb, {"y": 1})
+        out = io.StringIO()
+        assert compare_snapshots.compare(pa, pb, out=out) == 1
+        assert "ONLY IN" in out.getvalue()
+
+
+class TestGenerateFrontend:
+    def test_writes_composer_html(self, tmp_path, capsys):
+        out = str(tmp_path / "frontend.html")
+        assert generate_frontend.main(["-o", out]) == 0
+        html = open(out).read()
+        for needle in ("random_seed", "snapshot", "config_list",
+                       "command composer", "SPEC ="):
+            assert needle in html
+
+    def test_spec_covers_cli_options(self):
+        spec = generate_frontend.describe_parser(
+            generate_frontend._main_parser())
+        dests = {s["dest"] for s in spec}
+        assert {"workflow", "config", "random_seed", "test",
+                "result_file"} <= dests
+        flags = {s["dest"] for s in spec if s["kind"] == "flag"}
+        assert "test" in flags and "verbose" in flags
+
+
+class TestBboxer:
+    def test_add_list_export_remove(self, tmp_path, capsys):
+        store = str(tmp_path / "ann.json")
+        assert bboxer.add(store, "img1.png", "cat", 1, 2, 30, 40) == 1
+        assert bboxer.add(store, "img1.png", "dog", 5, 5, 10, 10) == 2
+        assert bboxer.add(store, "img2.png", "cat", 0, 0, 3, 3) == 1
+        out = io.StringIO()
+        assert bboxer.list_boxes(store, out=out) == 3
+        assert "img1.png[1]: dog" in out.getvalue()
+        exported = str(tmp_path / "out.json")
+        assert bboxer.export(store, exported) == 3
+        data = json.load(open(exported))
+        assert data["img1.png"][0]["label"] == "cat"
+        bboxer.remove(store, "img1.png", 0)
+        out = io.StringIO()
+        assert bboxer.list_boxes(store, "img1.png", out=out) == 1
+        import pytest
+        with pytest.raises(ValueError):
+            bboxer.add(store, "img1.png", "bad", 0, 0, 0, 0)
+
+    def test_cli_main(self, tmp_path, capsys):
+        store = str(tmp_path / "ann.json")
+        assert bboxer.main(["add", store, "i.png", "cat",
+                            "1", "2", "3", "4"]) == 0
+        assert bboxer.main(["list", store]) == 0
+        assert "cat" in capsys.readouterr().out

@@ -1,0 +1,36 @@
+"""Avatar — mirrors attributes of another unit/workflow onto itself
+(ref veles/avatar.py:22: used to bridge nested workflows, e.g. expose an
+inner loader's minibatch stream to an outer workflow's units)."""
+
+import copy
+
+from veles_tpu.units import Unit
+
+
+class Avatar(Unit):
+    """Clones the listed attributes from ``source`` every run.
+
+    ``deep=True`` copies values (safe mutation isolation, the reference's
+    behavior for numpy arrays); the default forwards references, which is
+    the right thing for immutable jax Arrays.
+    """
+
+    def __init__(self, workflow, source=None, attrs=(), deep=False, **kwargs):
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.attrs = list(attrs)
+        self.deep = deep
+
+    def clone_attrs(self, *names):
+        self.attrs.extend(names)
+        return self
+
+    def initialize(self, **kwargs):
+        if self.source is None:
+            raise ValueError("Avatar needs source=")
+        self.run()   # make attrs visible to dependency-ordered init
+
+    def run(self):
+        for name in self.attrs:
+            value = getattr(self.source, name)
+            setattr(self, name, copy.deepcopy(value) if self.deep else value)

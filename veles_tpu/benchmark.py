@@ -1,0 +1,89 @@
+"""Device benchmark + memory watcher.
+
+``DeviceBenchmark`` reproduces the reference's square-matmul methodology
+(ref veles/accelerated_units.py:706-824, backends.py:672-731): time a
+size²·size² matmul, repeats best-of-N, and expose ``computing_power`` =
+1000/dt — the number the reference's master used for slave load balancing.
+
+``Watcher`` is the TPU stand-in for the reference's device-memory
+accounting (ref veles/memory.py:56-107): live-array byte census per device
+plus the runtime's own memory_stats when the backend provides them."""
+
+import time
+
+import numpy as np
+
+from veles_tpu.units import Unit
+
+
+class DeviceBenchmark(Unit):
+    def __init__(self, workflow, size=1500, repeats=3, dtype=None, **kwargs):
+        super(DeviceBenchmark, self).__init__(workflow, **kwargs)
+        self.size = size
+        self.repeats = repeats
+        self.dtype = dtype
+        self.seconds = None
+        self.computing_power = None
+        self.gflops = None
+
+    def run(self):
+        import jax
+        import jax.numpy as jnp
+
+        n = self.size
+        dtype = self.dtype or jnp.float32
+        a = jnp.asarray(np.random.RandomState(0).rand(n, n), dtype)
+        f = jax.jit(lambda x: jnp.dot(x, x, precision="highest"))
+        jax.block_until_ready(f(a))   # compile + warm
+        best = float("inf")
+        for _ in range(self.repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(a))
+            best = min(best, time.perf_counter() - t0)
+        self.seconds = best
+        self.computing_power = 1000.0 / best
+        self.gflops = 2.0 * n ** 3 / best / 1e9
+        self.info("gemm %dx%d: %.4fs  %.1f GFLOP/s  power %.1f",
+                  n, n, best, self.gflops, self.computing_power)
+
+
+class Watcher(object):
+    """Device-memory census: ``snapshot()`` -> {device: bytes of live jax
+    arrays}; ``peak`` tracks the high-water mark across snapshots."""
+
+    def __init__(self):
+        self.peak = 0
+
+    @staticmethod
+    def live_bytes():
+        import jax
+        per_device = {}
+        for arr in jax.live_arrays():
+            try:
+                nbytes = arr.nbytes
+                for shard in arr.addressable_shards:
+                    d = str(shard.device)
+                    per_device[d] = (per_device.get(d, 0)
+                                     + nbytes // max(1, len(arr.sharding.device_set)))
+            except RuntimeError:   # deleted under us
+                continue
+        return per_device
+
+    @staticmethod
+    def runtime_stats():
+        """Backend-reported stats (bytes_in_use / peak_bytes_in_use on TPU;
+        absent on CPU) — the honest HBM number when available."""
+        import jax
+        stats = {}
+        for d in jax.devices():
+            try:
+                stats[str(d)] = d.memory_stats()
+            except Exception:   # noqa: BLE001 — backend without stats
+                stats[str(d)] = None
+        return stats
+
+    def snapshot(self):
+        per_device = self.live_bytes()
+        total = sum(per_device.values())
+        self.peak = max(self.peak, total)
+        return per_device

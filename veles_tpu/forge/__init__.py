@@ -1,0 +1,10 @@
+"""Forge — model-zoo for workflow packages (ref veles/forge/: upload /
+fetch versioned packages with a manifest; forge_client.py:91,
+forge_server.py:462).  The transport is plain HTTP (stdlib http.server /
+urllib), storage is a versioned directory tree with a JSON manifest per
+model — the reference's git-backed store swapped for content hashes."""
+
+from veles_tpu.forge.client import ForgeClient
+from veles_tpu.forge.server import ForgeServer
+
+__all__ = ["ForgeClient", "ForgeServer"]

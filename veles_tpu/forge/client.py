@@ -1,0 +1,54 @@
+"""Forge client (ref veles/forge/forge_client.py:91): list / details /
+upload / fetch of workflow packages against a ForgeServer over HTTP."""
+
+import json
+import urllib.parse
+import urllib.request
+
+from veles_tpu.logger import Logger
+
+
+class ForgeClient(Logger):
+    def __init__(self, base_url, **kwargs):
+        super(ForgeClient, self).__init__(**kwargs)
+        self.base_url = base_url.rstrip("/")
+
+    def _get_json(self, path, **params):
+        url = "%s%s?%s" % (self.base_url, path,
+                           urllib.parse.urlencode(params))
+        with urllib.request.urlopen(url) as resp:
+            return json.loads(resp.read().decode())
+
+    def list(self):
+        return self._get_json("/service", query="list")
+
+    def details(self, name):
+        return self._get_json("/service", query="details", name=name)
+
+    def upload(self, package_path, name, version, description=None):
+        with open(package_path, "rb") as f:
+            data = f.read()
+        params = {"name": name, "version": version}
+        if description:
+            params["description"] = description
+        url = "%s/upload?%s" % (self.base_url, urllib.parse.urlencode(params))
+        req = urllib.request.Request(
+            url, data=data, method="POST",
+            headers={"Content-Type": "application/zip"})
+        with urllib.request.urlopen(req) as resp:
+            manifest = json.loads(resp.read().decode())
+        self.info("uploaded %s:%s (%d bytes)", name, version, len(data))
+        return manifest
+
+    def fetch(self, name, dest_path, version=None):
+        params = {"name": name}
+        if version:
+            params["version"] = version
+        url = "%s/fetch?%s" % (self.base_url, urllib.parse.urlencode(params))
+        with urllib.request.urlopen(url) as resp:
+            data = resp.read()
+            got_version = resp.headers.get("X-Forge-Version")
+        with open(dest_path, "wb") as f:
+            f.write(data)
+        self.info("fetched %s:%s → %s", name, got_version, dest_path)
+        return dest_path, got_version

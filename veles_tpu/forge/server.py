@@ -1,0 +1,171 @@
+"""Forge server (ref veles/forge/forge_server.py:462).
+
+Endpoints (all JSON unless noted):
+  GET  /service?query=list                  → [{name, versions, …}]
+  GET  /service?query=details&name=N        → manifest of one model
+  GET  /fetch?name=N[&version=V]            → package bytes (zip)
+  POST /upload?name=N&version=V[&description=…]  body = package bytes
+Storage: <root>/<name>/<version>/package.zip + <root>/<name>/manifest.json
+"""
+
+import hashlib
+import json
+import os
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.logger import Logger
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+
+class ForgeStore(object):
+    """Versioned on-disk package store with per-model manifest."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _manifest_path(self, name):
+        return os.path.join(self.directory, name, "manifest.json")
+
+    def _check_name(self, name):
+        if not name or not _NAME_RE.match(name):
+            raise ValueError("bad model/version name %r" % (name,))
+
+    def manifest(self, name):
+        self._check_name(name)
+        try:
+            with open(self._manifest_path(name)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def list(self):
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            m = self.manifest(name)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def upload(self, name, version, data, description=None):
+        self._check_name(name)
+        self._check_name(version)
+        with self._lock:
+            vdir = os.path.join(self.directory, name, version)
+            os.makedirs(vdir, exist_ok=True)
+            with open(os.path.join(vdir, "package.zip"), "wb") as f:
+                f.write(data)
+            m = self.manifest(name) or {"name": name, "versions": {},
+                                        "latest": None}
+            m["versions"][version] = {
+                "description": description,
+                "sha1": hashlib.sha1(data).hexdigest(),
+                "size": len(data),
+            }
+            m["latest"] = version
+            with open(self._manifest_path(name), "w") as f:
+                json.dump(m, f, indent=2)
+            return m
+
+    def fetch(self, name, version=None):
+        m = self.manifest(name)
+        if m is None:
+            raise KeyError("no such model %r" % name)
+        version = version or m["latest"]
+        self._check_name(version)
+        if version not in m["versions"]:
+            raise KeyError("no version %r of %r" % (version, name))
+        with open(os.path.join(self.directory, name, version,
+                               "package.zip"), "rb") as f:
+            return f.read(), version
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None   # set by ForgeServer
+
+    def log_message(self, fmt, *args):   # keep test output quiet
+        import logging
+        logging.getLogger("ForgeServer").debug("http: " + fmt % args)
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, message):
+        self._json({"error": message}, code)
+
+    def do_GET(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        try:
+            if url.path == "/service":
+                query = q.get("query", "list")
+                if query == "list":
+                    return self._json(self.store.list())
+                if query == "details":
+                    m = self.store.manifest(q["name"])
+                    if m is None:
+                        return self._error(404, "no such model")
+                    return self._json(m)
+                return self._error(400, "unknown query %r" % query)
+            if url.path == "/fetch":
+                data, version = self.store.fetch(q["name"], q.get("version"))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/zip")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Forge-Version", version)
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            return self._error(404, "unknown path")
+        except (KeyError, ValueError) as e:
+            return self._error(404 if isinstance(e, KeyError) else 400,
+                               str(e))
+
+    def do_POST(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        if url.path != "/upload":
+            return self._error(404, "unknown path")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            m = self.store.upload(q["name"], q["version"], data,
+                                  q.get("description"))
+            return self._json(m)
+        except (KeyError, ValueError) as e:
+            return self._error(400, str(e))
+
+
+class ForgeServer(Logger):
+    def __init__(self, directory, host="127.0.0.1", port=0, **kwargs):
+        super(ForgeServer, self).__init__(**kwargs)
+        self.store = ForgeStore(directory)
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = None
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.httpd.server_address[0], self.port)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info("forge server at %s", self.url)
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,44 @@
+"""InputJoiner — concatenate several producer outputs into one tensor
+(ref veles/input_joiner.py:49 + the generated concat kernel ocl/join.jcl;
+on TPU the concat is one jnp op XLA fuses into the consumer)."""
+
+import numpy as np
+
+from veles_tpu.units import Unit
+
+
+class InputJoiner(Unit):
+    """Joins N inputs along the flattened feature axis.
+
+    Producers are declared with ``link_input(unit, "attr")``; at run time
+    each input is fetched, flattened per sample, and concatenated into
+    ``self.output``.  All inputs must share the leading (sample) dimension.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self._inputs = []     # list of (producer_unit, attr_name)
+        self.output = None
+        self.output_sample_size = None
+
+    def link_input(self, unit, attr="output"):
+        self._inputs.append((unit, attr))
+        return self
+
+    def initialize(self, **kwargs):
+        if not self._inputs:
+            raise ValueError("InputJoiner has no inputs; call link_input()")
+
+    def run(self):
+        arrays = []
+        for unit, attr in self._inputs:
+            a = np.asarray(getattr(unit, attr))
+            arrays.append(a.reshape(a.shape[0], -1))
+        n = arrays[0].shape[0]
+        for (unit, attr), a in zip(self._inputs, arrays):
+            if a.shape[0] != n:
+                raise ValueError(
+                    "InputJoiner: %s.%s has %d samples, expected %d"
+                    % (unit, attr, a.shape[0], n))
+        self.output = np.concatenate(arrays, axis=1)
+        self.output_sample_size = self.output.shape[1]

@@ -1,0 +1,39 @@
+"""Interactive shell unit (ref veles/interaction.py:49) — drops into an
+IPython / code.interact REPL mid-workflow with the workflow's units in
+scope, so a running experiment can be inspected and mutated in place."""
+
+import code
+
+from veles_tpu.units import Unit
+
+
+class Shell(Unit):
+    """Opens a REPL each time it runs (gate it like any unit to control
+    when).  ``console=`` injects a replacement console callable
+    ``fn(locals_dict)`` — used by tests and by non-tty runs."""
+
+    def __init__(self, workflow, console=None, banner=None, **kwargs):
+        super(Shell, self).__init__(workflow, **kwargs)
+        self.console = console
+        self.banner = banner or (
+            "veles_tpu shell — `wf` is the workflow, `units` its units; "
+            "Ctrl-D resumes the run")
+
+    def _locals(self):
+        env = {"wf": self.workflow, "shell": self}
+        if self.workflow is not None:
+            env["units"] = list(getattr(self.workflow, "units", []))
+            for u in env["units"]:
+                env.setdefault(u.name.replace(" ", "_"), u)
+        return env
+
+    def run(self):
+        env = self._locals()
+        if self.console is not None:
+            self.console(env)
+            return
+        try:
+            from IPython import embed
+            embed(user_ns=env, banner1=self.banner)
+        except ImportError:
+            code.interact(banner=self.banner, local=env)

@@ -1,7 +1,9 @@
 """Data pipeline (ref: veles/loader/ — SURVEY.md §2.5)."""
 
+from veles_tpu.loader.audio import AudioLoader
 from veles_tpu.loader.base import (CLASS_NAMES, TEST, TRAIN, VALID, Loader)
 from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.lmdb import LMDBLoader
 
-__all__ = ["Loader", "FullBatchLoader", "TEST", "VALID", "TRAIN",
-           "CLASS_NAMES"]
+__all__ = ["Loader", "FullBatchLoader", "AudioLoader", "LMDBLoader",
+           "TEST", "VALID", "TRAIN", "CLASS_NAMES"]

@@ -1,0 +1,95 @@
+"""Audio file loaders (ref veles/loader/libsndfile*.py — libsndfile decode
+of wav/flac/ogg + windowing into fixed-size frames).
+
+Decode prefers the ``soundfile`` package (libsndfile bindings) when
+importable and falls back to the stdlib ``wave`` module for PCM WAV —
+so the loader always works in this image.  Samples are normalized to
+float32 in [-1, 1], mixed down to mono, and windowed into
+``frame_size``-sample frames with ``frame_stride`` hop."""
+
+import os
+import wave
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+CLASS_KEYS = {"test": TEST, "validation": VALID, "train": TRAIN}
+
+
+def read_audio(path):
+    """→ (float32 mono samples in [-1, 1], sample_rate)."""
+    try:
+        import soundfile
+        data, rate = soundfile.read(path, dtype="float32")
+        if data.ndim > 1:
+            data = data.mean(axis=1)
+        return np.asarray(data, np.float32), rate
+    except ImportError:
+        pass
+    with wave.open(path, "rb") as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, "u1").astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError("unsupported sample width %d in %s" % (width, path))
+    if channels > 1:
+        data = data.reshape(-1, channels).mean(axis=1)
+    return data, rate
+
+
+def window(samples, frame_size, frame_stride=None):
+    """Slice a 1-D signal into [n_frames, frame_size] windows."""
+    stride = frame_stride or frame_size
+    n = (len(samples) - frame_size) // stride + 1
+    if n <= 0:
+        return np.zeros((0, frame_size), np.float32)
+    idx = np.arange(n)[:, None] * stride + np.arange(frame_size)[None, :]
+    return samples[idx]
+
+
+class AudioLoader(FullBatchLoader):
+    """:param files: {class_name: [paths or (path, label) tuples]};
+    every file is decoded, windowed, and stacked into the full batch.
+    Labels default to the file's position in its list."""
+
+    MAPPING = "audio"
+
+    def __init__(self, workflow, files=None, frame_size=1024,
+                 frame_stride=None, **kwargs):
+        super(AudioLoader, self).__init__(workflow, **kwargs)
+        self.files = files or {}
+        self.frame_size = frame_size
+        self.frame_stride = frame_stride
+        self.sample_rates = {}
+
+    def load_data(self):
+        datas = [[], [], []]
+        labels = [[], [], []]
+        for key, entries in self.files.items():
+            cls = CLASS_KEYS[key]
+            for i, entry in enumerate(entries):
+                path, label = (entry if isinstance(entry, tuple)
+                               else (entry, i))
+                samples, rate = read_audio(path)
+                self.sample_rates[os.path.basename(path)] = rate
+                frames = window(samples, self.frame_size, self.frame_stride)
+                datas[cls].append(frames)
+                labels[cls].extend([label] * len(frames))
+        lengths = [sum(len(f) for f in datas[c]) for c in range(3)]
+        if sum(lengths) == 0:
+            raise ValueError("AudioLoader: no frames decoded")
+        self.original_data = np.concatenate(
+            [f for c in range(3) for f in datas[c]])
+        self.original_labels = np.asarray(
+            sum((labels[c] for c in range(3)), []), np.int32)
+        self.class_lengths = lengths

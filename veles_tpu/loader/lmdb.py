@@ -1,0 +1,95 @@
+"""LMDB key-value dataset loader (ref Znicz loader_lmdb.LMDBLoader,
+referenced by manualrst_veles_workflow_creation.rst:99 — the Caffe-style
+LMDB image database).
+
+The real ``lmdb`` package is optional (not in this image); the loader is
+written against the tiny subset of its API it needs (env.begin() →
+txn.cursor() iteration, txn.get), with an injectable ``env_factory`` so the
+logic is testable without the C library.  Records are either raw float32
+tensors, ``.npy`` blobs, or ``(data, label)`` pickles."""
+
+import io
+import pickle
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+CLASS_KEYS = {"test": TEST, "validation": VALID, "train": TRAIN}
+
+
+def _default_env_factory(path):
+    try:
+        import lmdb
+    except ImportError as e:
+        raise ImportError(
+            "LMDBLoader needs the 'lmdb' package (not bundled in this "
+            "image); pass env_factory= to use another KV store") from e
+    return lmdb.open(path, readonly=True, lock=False)
+
+
+def decode_record(value, sample_shape=None):
+    """value bytes → (np.ndarray data, label or None)."""
+    if value[:6] == b"\x93NUMPY":
+        return np.load(io.BytesIO(value)), None
+    if value[:2] in (b"\x80\x02", b"\x80\x03", b"\x80\x04", b"\x80\x05"):
+        obj = pickle.loads(value)
+        if isinstance(obj, tuple):
+            return np.asarray(obj[0], np.float32), obj[1]
+        return np.asarray(obj, np.float32), None
+    data = np.frombuffer(value, np.float32)
+    if sample_shape:
+        data = data.reshape(sample_shape)
+    return data, None
+
+
+class LMDBLoader(FullBatchLoader):
+    """:param dbs: {class_name: lmdb_path} (class_name in
+    test/validation/train); loads every record into the full-batch arrays.
+    """
+
+    MAPPING = "lmdb"
+
+    def __init__(self, workflow, dbs=None, sample_shape=None,
+                 env_factory=None, **kwargs):
+        super(LMDBLoader, self).__init__(workflow, **kwargs)
+        self.dbs = dbs or {}
+        self.sample_shape = sample_shape
+        self.env_factory = env_factory or _default_env_factory
+
+    def _read_db(self, path):
+        env = self.env_factory(path)
+        datas, labels = [], []
+        try:
+            with env.begin() as txn:
+                cur = txn.cursor()
+                for _key, value in cur:
+                    d, l = decode_record(value, self.sample_shape)
+                    datas.append(np.asarray(d, np.float32))
+                    labels.append(-1 if l is None else int(l))
+        finally:
+            env.close()
+        return datas, labels
+
+    def load_data(self):
+        per_class = {TEST: ([], []), VALID: ([], []), TRAIN: ([], [])}
+        for key, path in self.dbs.items():
+            cls = CLASS_KEYS[key]
+            d, l = self._read_db(path)
+            per_class[cls][0].extend(d)
+            per_class[cls][1].extend(l)
+        lengths = [len(per_class[c][0]) for c in (TEST, VALID, TRAIN)]
+        if sum(lengths) == 0:
+            raise ValueError("LMDBLoader: no records in %s" % (self.dbs,))
+        datas = [np.stack(per_class[c][0]) if per_class[c][0] else None
+                 for c in (TEST, VALID, TRAIN)]
+        all_labels = sum((per_class[c][1] for c in (TEST, VALID, TRAIN)), [])
+        self.original_data = np.concatenate(
+            [d for d in datas if d is not None])
+        if any(l >= 0 for l in all_labels):
+            self.original_labels = np.asarray(
+                [max(l, 0) for l in all_labels], np.int32)
+        else:
+            self.original_labels = None
+        self.class_lengths = lengths

@@ -27,12 +27,13 @@ class QueueLoaderBase(Loader):
     carries_data = True
 
     def __init__(self, workflow, sample_shape=None, queue_size=4096,
-                 **kwargs):
+                 drain_timeout=0.05, **kwargs):
         super(QueueLoaderBase, self).__init__(workflow, **kwargs)
         if sample_shape is None:
             raise ValueError("%s needs sample_shape" % type(self).__name__)
         self.sample_shape = tuple(sample_shape)
         self.queue = queue.Queue(queue_size)
+        self.drain_timeout = drain_timeout
         self.stopped_streaming = False
 
     def load_data(self):
@@ -50,10 +51,10 @@ class QueueLoaderBase(Loader):
                         np.float32)
         valid = np.zeros((self.minibatch_size,), np.float32)
         got = 0
-        block = True   # wait for at least one sample
-        while got < self.minibatch_size:
+        timeout = 30   # wait for at least one sample; after that only
+        while got < self.minibatch_size:   # drain in-flight deliveries
             try:
-                item = self.queue.get(block=block, timeout=30)
+                item = self.queue.get(block=True, timeout=timeout)
             except queue.Empty:
                 break
             if item is None:   # poison pill = end of stream
@@ -62,7 +63,7 @@ class QueueLoaderBase(Loader):
             data[got] = item
             valid[got] = 1.0
             got += 1
-            block = False
+            timeout = self.drain_timeout
         self.minibatch_data = data
         self.minibatch_valid = valid
         self.minibatch_class = TEST

@@ -18,6 +18,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from veles_tpu.ops import activations, conv, dropout, linear, lrn, misc, pooling
 from veles_tpu.ops.policy import default_policy
@@ -214,6 +215,86 @@ class Depooling(Layer):
         return pooling.depool(x, self.ky, self.kx)
 
 
+class StochasticPoolDepool(Layer):
+    """Fused stochastic pooling + depooling (ref Znicz
+    StochasticPoolingDepooling) — keeps one sampled element per window in
+    place, zeroes the rest; shape-preserving."""
+
+    TYPES = ("stochastic_pooling_depooling", "stochastic_abs_pooling_depooling")
+    needs_rng = True
+
+    def _infer(self, input_shape):
+        self.kx = int(self.cfg["kx"])
+        self.ky = int(self.cfg["ky"])
+        return input_shape
+
+    def apply(self, params, x, train=False, key=None):
+        if not train:
+            return x
+        absolute = "abs" in self.type
+        return pooling.stochastic_pool_depool(x, self.ky, self.kx, key,
+                                              absolute)
+
+
+class ChannelSplitter(Layer):
+    """ChannelSplitter (ref Znicz): (H, W, C) samples become (C, H, W, 1) —
+    channels move to a leading per-sample axis so downstream per-channel
+    branches can vmap/slice; ChannelMerger inverts it."""
+
+    TYPES = ("channel_splitter",)
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        return (c, h, w, 1)
+
+    def apply(self, params, x, train=False, key=None):
+        return jnp.transpose(x, (0, 3, 1, 2))[..., None]
+
+
+class ChannelMerger(Layer):
+    """Inverse of ChannelSplitter: (C, H, W, 1) -> (H, W, C)."""
+
+    TYPES = ("channel_merger",)
+
+    def _infer(self, input_shape):
+        c, h, w, _ = input_shape
+        return (h, w, c)
+
+    def apply(self, params, x, train=False, key=None):
+        return jnp.transpose(x[..., 0], (0, 2, 3, 1))
+
+
+class ResizableAll2All(All2All):
+    """All2All whose output width can change between training stages (ref
+    Znicz ResizableAll2All, used when growing autoencoder bottlenecks).
+    ``resize(params, new_output, rng)`` returns an updated parameter dict
+    preserving the overlapping weight slice; call it *between* jitted
+    stages (it changes shapes, so the next stage recompiles)."""
+
+    TYPES = ("resizable_all2all",)
+
+    def resize(self, params, new_output, rng):
+        new_out = (int(new_output) if isinstance(new_output, int)
+                   else int(math.prod(new_output)))
+        self.output_shape = ((new_output,) if isinstance(new_output, int)
+                             else tuple(new_output))
+        # keep cfg in sync so a later setup()/_infer re-derives this shape
+        self.cfg["output_sample_shape"] = new_output
+        fresh = linear.init_params(
+            rng, self.n_in, new_out, bias="bias" in params,
+            weights_stddev=self.cfg.get("weights_stddev"),
+            dtype=self.policy.param)
+        keep = min(new_out, params["weights"].shape[1])
+        w = np.array(fresh["weights"])
+        w[:, :keep] = np.asarray(params["weights"])[:, :keep]
+        fresh["weights"] = jnp.asarray(w)
+        if "bias" in params:
+            b = np.array(fresh["bias"])
+            b[:keep] = np.asarray(params["bias"])[:keep]
+            fresh["bias"] = jnp.asarray(b)
+        return fresh
+
+
 class LRN(Layer):
     """Local response normalization, the "norm" layer type."""
 
@@ -320,7 +401,9 @@ class Embedding(Layer):
 
     def init_params(self, rng):
         import jax.numpy as jnp
-        std = self.cfg.get("weights_stddev") or self.d_model ** -0.5
+        std = self.cfg.get("weights_stddev")
+        if std is None:
+            std = self.d_model ** -0.5
         table = rng.normal(0.0, std, (self.vocab, self.d_model))
         return {"table": jnp.asarray(table, self.policy.param)}
 
@@ -506,10 +589,11 @@ class ZeroFiller(Layer):
 
 
 LAYER_TYPES = {}
-for _cls in (All2All, Conv, Deconv, Pooling, Depooling, LRN, Dropout,
-             Activation, Cutter, LSTM, ZeroFiller, LayerNorm, Embedding,
-             PositionalEncoding, MultiHeadAttention, TransformerBlock,
-             TimestepDense, SeqPool):
+for _cls in (All2All, ResizableAll2All, Conv, Deconv, Pooling, Depooling,
+             StochasticPoolDepool, ChannelSplitter, ChannelMerger, LRN,
+             Dropout, Activation, Cutter, LSTM, ZeroFiller, LayerNorm,
+             Embedding, PositionalEncoding, MultiHeadAttention,
+             TransformerBlock, TimestepDense, SeqPool):
     for _t in _cls.TYPES:
         LAYER_TYPES[_t] = _cls
 

@@ -1,0 +1,63 @@
+"""Numpy helpers (ref veles/numpy_ext.py): ``roundup``, ``interleave``,
+and the ``NumDiff`` numeric-diff used by golden kernel-vs-reference tests
+(ref numpy_ext.py:116, SURVEY.md §4)."""
+
+import numpy as np
+
+
+def roundup(value, align):
+    """Round ``value`` up to a multiple of ``align`` (ref numpy_ext.roundup;
+    on TPU the natural aligns are 8/128 sublane/lane tiles)."""
+    rem = value % align
+    return value if rem == 0 else value + align - rem
+
+
+def interleave(arr):
+    """Interleave the first two axes: (2, N, ...) -> (2N, ...) with
+    alternating rows (ref numpy_ext.interleave)."""
+    a = np.asarray(arr)
+    if a.shape[0] != 2:
+        raise ValueError("interleave expects leading axis of 2")
+    out = np.empty((2 * a.shape[1],) + a.shape[2:], dtype=a.dtype)
+    out[0::2] = a[0]
+    out[1::2] = a[1]
+    return out
+
+
+class NumDiff(object):
+    """Accumulating numeric diff between two arrays (ref NumDiff
+    numpy_ext.py:116): feeds golden tests with max-abs-diff plus the
+    offending index, tolerant of bf16 quantization via ``threshold``."""
+
+    def __init__(self, threshold=1e-5):
+        self.threshold = threshold
+        self.max_diff = 0.0
+        self.max_index = None
+        self.count = 0
+        self.checked = 0
+
+    def check(self, a, b):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        if a.shape != b.shape:
+            raise ValueError("shape mismatch: %s vs %s" % (a.shape, b.shape))
+        d = np.abs(a - b)
+        idx = np.unravel_index(np.argmax(d), d.shape) if d.size else None
+        if d.size and d[idx] > self.max_diff:
+            self.max_diff = float(d[idx])
+            self.max_index = idx
+        self.count += int((d > self.threshold).sum())
+        self.checked += d.size
+        return self
+
+    @property
+    def ok(self):
+        return self.count == 0
+
+    def report(self):
+        return ("NumDiff: %d/%d elements over %.1e (max %.3e at %s)"
+                % (self.count, self.checked, self.threshold,
+                   self.max_diff, self.max_index))
+
+    def assert_ok(self):
+        assert self.ok, self.report()

@@ -83,15 +83,50 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "block_q", "block_k", "interpret"))
-def flash_attention(q, k, v, causal=False, scale=1.0, block_q=128,
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=None):
-    """q, k, v: [B, H, T, D] → [B, H, T, D]."""
+    """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
+    default as every entry point in ops.attention).
+
+    Differentiable: the forward pass is the Pallas kernel; the backward
+    pass recomputes attention with the pure-jnp online-softmax
+    (ops.attention.blockwise_attention) and differentiates through it —
+    exact gradients without materializing the T² score matrix.  (A fused
+    Pallas backward kernel is a further optimization, not a correctness
+    requirement.)"""
+    if causal and q.shape[-2] != k.shape[-2]:
+        raise ValueError("causal flash kernel assumes tq == tk")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_fn(causal, float(scale), block_q, block_k,
+                     autodetect_interpret(interpret))(q, k, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_fn(causal, scale, block_q, block_k, interpret):
+    from veles_tpu.ops import attention as att
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+    def fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: att.blockwise_attention(
+                q_, k_, v_, causal=causal, scale=scale), q, k, v)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return jax.jit(f)
+
+
+def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[-2]
-    if causal and tq != tk:
-        raise ValueError("causal flash kernel assumes tq == tk")
     block_q = min(block_q, max(tq, 8))
     block_k = min(block_k, max(tk, 8))
     qp = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
@@ -120,6 +155,6 @@ def flash_attention(q, k, v, causal=False, scale=1.0, block_q=128,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
-        interpret=autodetect_interpret(interpret),
+        interpret=interpret,
     )(qp, kp, vp)
     return out[:, :tq].reshape(b, h, tq, d)

@@ -82,6 +82,34 @@ def stochastic_pool_infer(x, ky, kx, stride=None, absolute=False):
     return (p * w).sum(axis=3)
 
 
+def stochastic_pool_depool(x, ky, kx, key, absolute=False):
+    """Fused StochasticPoolingDepooling (ref Znicz unit of the same name):
+    sample one element per non-overlapping window with p ∝ activation
+    (|activation| for the Abs flavor), keep it *in place*, zero the rest —
+    output has the input's spatial shape (trailing rows/cols that don't fill
+    a window pass through zeroed, matching VALID pooling coverage)."""
+    n, h, w, c = x.shape
+    p = _patches(x, ky, kx, (ky, kx))        # [N,Ho,Wo,K,C], K = ky*kx
+    ho, wo = p.shape[1], p.shape[2]
+    mag = jnp.abs(p) if absolute else jnp.maximum(p, 0.0)
+    total = mag.sum(axis=3, keepdims=True)
+    probs = jnp.where(total > 0, mag / jnp.where(total > 0, total, 1.0), 0.0)
+    logits = jnp.where(probs > 0, jnp.log(probs), -jnp.inf)
+    logits = jnp.moveaxis(logits, 3, -1)     # [N,Ho,Wo,C,K]
+    choice = jax.random.categorical(key, logits, axis=-1)   # [N,Ho,Wo,C]
+    onehot = jax.nn.one_hot(choice, ky * kx, axis=-1,
+                            dtype=p.dtype)   # [N,Ho,Wo,C,K]
+    any_mass = total > 0                     # [N,Ho,Wo,1,C]
+    keep = jnp.moveaxis(onehot, -1, 3) * p * any_mass       # [N,Ho,Wo,K,C]
+    # K is (ky, kx) row-major (see _patches) — invert the tiling
+    y = keep.reshape(n, ho, wo, ky, kx, c).transpose(0, 1, 3, 2, 4, 5)
+    y = y.reshape(n, ho * ky, wo * kx, c)
+    pad_h, pad_w = h - ho * ky, w - wo * kx
+    if pad_h or pad_w:
+        y = jnp.pad(y, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+    return y
+
+
 def depool(x, ky, kx):
     """Depooling: nearest-neighbor upsample by the window size (ref Znicz
     Depooling — decoder half of pooled autoencoders)."""

@@ -1,0 +1,11 @@
+"""Post-training report publishing (ref veles/publishing/ — Publisher unit
+gathering metrics + plots, with pluggable output backends
+publisher.py:57, registry.py)."""
+
+from veles_tpu.publishing.backends import (BackendRegistry, JSONBackend,
+                                           HTMLBackend, MarkdownBackend,
+                                           ReportBackend)
+from veles_tpu.publishing.publisher import Publisher
+
+__all__ = ["Publisher", "ReportBackend", "BackendRegistry",
+           "MarkdownBackend", "HTMLBackend", "JSONBackend"]

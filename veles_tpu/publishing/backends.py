@@ -1,0 +1,105 @@
+"""Report rendering backends (ref veles/publishing/registry.py + the
+markdown/jinja2/pdf/confluence backends).  Each backend renders the
+Publisher's report dict to text and declares a file extension; the jinja2
+backend upgrades the HTML output when jinja2 is importable (it is in this
+image), with a string-template fallback so the backend never disappears."""
+
+import json
+
+from veles_tpu.registry import MappedRegistry
+
+
+class BackendRegistry(MappedRegistry):
+    """MAPPING name → backend class."""
+
+
+class ReportBackend(object, metaclass=BackendRegistry):
+    EXT = ".txt"
+
+    def render(self, report):
+        raise NotImplementedError
+
+
+def _fmt_value(v):
+    if isinstance(v, float):
+        return "%.6g" % v
+    return str(v)
+
+
+class MarkdownBackend(ReportBackend):
+    MAPPING = "markdown"
+    EXT = ".md"
+
+    def render(self, report):
+        lines = ["# %s" % report.get("name", "workflow"),
+                 "", "*Generated %s*" % report.get("date", ""), ""]
+        if report.get("description"):
+            lines += [report["description"], ""]
+        metrics = report.get("metrics") or {}
+        if metrics:
+            lines += ["## Metrics", "", "| metric | value |", "|---|---|"]
+            lines += ["| %s | %s |" % (k, _fmt_value(v))
+                      for k, v in sorted(metrics.items())]
+            lines.append("")
+        units = report.get("units") or []
+        if units:
+            lines += ["## Units", "",
+                      "| unit | runs | total s |", "|---|---|---|"]
+            lines += ["| %s | %d | %.3f |" % (u["name"], u["runs"], u["time"])
+                      for u in units]
+            lines.append("")
+        plots = report.get("plots") or []
+        if plots:
+            lines += ["## Plots", ""]
+            lines += ["![%s](%s)" % (p, p) for p in plots]
+            lines.append("")
+        config = report.get("config")
+        if config:
+            lines += ["## Configuration", "", "```json",
+                      json.dumps(config, indent=2, default=str), "```", ""]
+        return "\n".join(lines)
+
+
+_HTML_TEMPLATE = """<!doctype html><html><head><meta charset="utf-8">
+<title>{{ name }}</title></head><body>
+<h1>{{ name }}</h1><p><em>Generated {{ date }}</em></p>
+{% if metrics %}<h2>Metrics</h2><table border="1">
+{% for k, v in metrics %}<tr><td>{{ k }}</td><td>{{ v }}</td></tr>{% endfor %}
+</table>{% endif %}
+{% if units %}<h2>Units</h2><table border="1">
+<tr><th>unit</th><th>runs</th><th>total s</th></tr>
+{% for u in units %}<tr><td>{{ u.name }}</td><td>{{ u.runs }}</td>
+<td>{{ '%.3f' % u.time }}</td></tr>{% endfor %}</table>{% endif %}
+{% for p in plots %}<img src="{{ p }}" alt="{{ p }}">{% endfor %}
+</body></html>"""
+
+
+class HTMLBackend(ReportBackend):
+    MAPPING = "html"
+    EXT = ".html"
+
+    def render(self, report):
+        metrics = sorted((k, _fmt_value(v))
+                         for k, v in (report.get("metrics") or {}).items())
+        ctx = dict(name=report.get("name", "workflow"),
+                   date=report.get("date", ""), metrics=metrics,
+                   units=report.get("units") or [],
+                   plots=report.get("plots") or [])
+        try:
+            import jinja2
+            return jinja2.Template(_HTML_TEMPLATE).render(**ctx)
+        except ImportError:
+            rows = "".join("<tr><td>%s</td><td>%s</td></tr>" % kv
+                           for kv in metrics)
+            return ("<!doctype html><html><body><h1>%s</h1>"
+                    "<p><em>%s</em></p><table border=\"1\">%s</table>"
+                    "</body></html>"
+                    % (ctx["name"], ctx["date"], rows))
+
+
+class JSONBackend(ReportBackend):
+    MAPPING = "json"
+    EXT = ".json"
+
+    def render(self, report):
+        return json.dumps(report, indent=2, default=str, sort_keys=True)

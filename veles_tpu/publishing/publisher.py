@@ -1,0 +1,58 @@
+"""Publisher unit (ref veles/publishing/publisher.py:57): at the end of a
+run, gathers workflow identity, metrics (IResultProvider aggregation),
+per-unit run stats, config, and any plot files emitted by plotters, and
+renders them through the selected backends."""
+
+import datetime
+import os
+
+from veles_tpu.config import root
+from veles_tpu.publishing.backends import ReportBackend
+from veles_tpu.units import Unit
+
+
+class Publisher(Unit):
+    def __init__(self, workflow, backends=("markdown",), directory=None,
+                 description=None, **kwargs):
+        super(Publisher, self).__init__(workflow, **kwargs)
+        self.backends = list(backends)
+        self.directory = directory or root.common.dirs.get("reports",
+                                                           "reports")
+        self.description = description
+        self.written = []
+
+    def gather(self):
+        wf = self.workflow
+        report = {
+            "name": getattr(wf, "name", "workflow"),
+            "date": datetime.datetime.now().isoformat(timespec="seconds"),
+            "description": self.description,
+            "metrics": wf.gather_results() if wf is not None else {},
+            "units": [], "plots": [], "config": None,
+        }
+        if wf is not None:
+            for u in wf.units:
+                report["units"].append({"name": u.name, "runs": u.run_count,
+                                        "time": u.run_time})
+                for attr in ("written_files", "saved_paths"):
+                    for p in getattr(u, attr, ()) or ():
+                        if str(p).endswith((".png", ".pdf", ".svg")):
+                            report["plots"].append(str(p))
+            cfg = getattr(wf, "config", None)
+            if cfg is not None:
+                report["config"] = (cfg.as_dict()
+                                    if hasattr(cfg, "as_dict") else cfg)
+        return report
+
+    def run(self):
+        report = self.gather()
+        os.makedirs(self.directory, exist_ok=True)
+        stem = report["name"].replace(" ", "_").replace("/", "_")
+        for name in self.backends:
+            backend = ReportBackend.mapping[name]()
+            path = os.path.join(self.directory, stem + backend.EXT)
+            text = backend.render(report)
+            with open(path, "w") as f:
+                f.write(text)
+            self.written.append(path)
+            self.info("published %s report: %s", name, path)

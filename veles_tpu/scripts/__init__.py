@@ -1,0 +1,4 @@
+"""Utility scripts (ref veles/scripts/ — SURVEY.md §2.11):
+``compare_snapshots`` (diff two checkpoints), ``generate_frontend``
+(HTML command composer generated from the CLI arg registry), ``bboxer``
+(bounding-box annotation, headless CLI here)."""

@@ -1,0 +1,106 @@
+"""Bounding-box annotation tool, headless CLI (ref veles/scripts/bboxer.py
+— the reference ships a GUI annotator; this keeps the same artifact, a
+JSON annotations file consumable by the image loaders, drivable from
+scripts/CI).
+
+Commands:
+  add <store.json> <image> <label> <x> <y> <w> <h>
+  list <store.json> [image]
+  export <store.json> <out.json>     # loader-friendly {image: [boxes]}
+  remove <store.json> <image> <index>
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(store):
+    if os.path.exists(store):
+        with open(store) as f:
+            return json.load(f)
+    return {"annotations": {}}
+
+
+def _save(store, db):
+    with open(store, "w") as f:
+        json.dump(db, f, indent=2, sort_keys=True)
+
+
+def add(store, image, label, x, y, w, h):
+    if min(w, h) <= 0:
+        raise ValueError("box must have positive size")
+    db = _load(store)
+    db["annotations"].setdefault(image, []).append(
+        {"label": label, "x": x, "y": y, "w": w, "h": h})
+    _save(store, db)
+    return len(db["annotations"][image])
+
+
+def list_boxes(store, image=None, out=None):
+    out = out if out is not None else sys.stdout
+    db = _load(store)
+    items = (db["annotations"].items() if image is None
+             else [(image, db["annotations"].get(image, []))])
+    count = 0
+    for name, boxes in sorted(items):
+        for i, b in enumerate(boxes):
+            print("%s[%d]: %s (%g,%g %gx%g)"
+                  % (name, i, b["label"], b["x"], b["y"], b["w"], b["h"]),
+                  file=out)
+            count += 1
+    return count
+
+def remove(store, image, index):
+    db = _load(store)
+    boxes = db["annotations"].get(image, [])
+    if not 0 <= index < len(boxes):
+        raise IndexError("no box %d for %s" % (index, image))
+    boxes.pop(index)
+    if not boxes:
+        db["annotations"].pop(image)
+    _save(store, db)
+
+
+def export(store, out_path):
+    db = _load(store)
+    with open(out_path, "w") as f:
+        json.dump(db["annotations"], f, indent=2, sort_keys=True)
+    return sum(len(v) for v in db["annotations"].values())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pa = sub.add_parser("add")
+    for name, typ in (("store", str), ("image", str), ("label", str),
+                      ("x", float), ("y", float), ("w", float),
+                      ("h", float)):
+        pa.add_argument(name, type=typ)
+    pl = sub.add_parser("list")
+    pl.add_argument("store")
+    pl.add_argument("image", nargs="?")
+    pe = sub.add_parser("export")
+    pe.add_argument("store")
+    pe.add_argument("output")
+    pr = sub.add_parser("remove")
+    pr.add_argument("store")
+    pr.add_argument("image")
+    pr.add_argument("index", type=int)
+    a = p.parse_args(argv)
+    if a.cmd == "add":
+        n = add(a.store, a.image, a.label, a.x, a.y, a.w, a.h)
+        print("%s: %d boxes" % (a.image, n))
+    elif a.cmd == "list":
+        list_boxes(a.store, a.image)
+    elif a.cmd == "export":
+        n = export(a.store, a.output)
+        print("exported %d boxes -> %s" % (n, a.output))
+    elif a.cmd == "remove":
+        remove(a.store, a.image, a.index)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

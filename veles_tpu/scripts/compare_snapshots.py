@@ -1,0 +1,75 @@
+"""Compare two training snapshots leaf by leaf (ref
+veles/scripts/compare_snapshots.py — used with the reproducible-RNG
+guarantee to verify bit-identical reruns, SURVEY.md §4).
+
+Usage: python -m veles_tpu.scripts.compare_snapshots A.pickle.gz B.pickle.gz
+Exit code 0 = identical within threshold, 1 = differs."""
+
+import argparse
+import sys
+
+import numpy as np
+
+from veles_tpu.numpy_ext import NumDiff
+from veles_tpu.services.snapshotter import SnapshotterBase
+
+
+def _leaves(obj, prefix=""):
+    """Flatten nested dict/list/tuple state into (path, leaf) pairs."""
+    if isinstance(obj, dict):
+        for k in sorted(obj, key=str):
+            yield from _leaves(obj[k], "%s/%s" % (prefix, k))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _leaves(v, "%s[%d]" % (prefix, i))
+    else:
+        yield prefix or "/", obj
+
+
+def compare(path_a, path_b, threshold=0.0, out=sys.stdout):
+    a = dict(_leaves(SnapshotterBase.import_(path_a)))
+    b = dict(_leaves(SnapshotterBase.import_(path_b)))
+    differs = False
+    for path in sorted(set(a) | set(b)):
+        if path not in a or path not in b:
+            print("ONLY IN %s: %s" % ("B" if path not in a else "A", path),
+                  file=out)
+            differs = True
+            continue
+        va, vb = a[path], b[path]
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            va, vb = np.asarray(va), np.asarray(vb)
+            if va.shape != vb.shape:
+                print("SHAPE %s: %s vs %s" % (path, va.shape, vb.shape),
+                      file=out)
+                differs = True
+                continue
+            if not np.issubdtype(va.dtype, np.number):
+                if not (va == vb).all():
+                    print("DIFF %s (non-numeric)" % path, file=out)
+                    differs = True
+                continue
+            d = NumDiff(threshold=threshold).check(va, vb)
+            if not d.ok:
+                print("DIFF %s: %s" % (path, d.report()), file=out)
+                differs = True
+        elif va != vb:
+            print("DIFF %s: %r vs %r" % (path, va, vb), file=out)
+            differs = True
+    if not differs:
+        print("snapshots match (threshold %g)" % threshold, file=out)
+    return 1 if differs else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("snapshot_a")
+    p.add_argument("snapshot_b")
+    p.add_argument("--threshold", type=float, default=0.0,
+                   help="max tolerated abs elementwise diff")
+    args = p.parse_args(argv)
+    return compare(args.snapshot_a, args.snapshot_b, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

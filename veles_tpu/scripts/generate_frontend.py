@@ -1,0 +1,113 @@
+"""Generate the HTML command composer from the CLI's argument registry
+(ref veles/scripts/generate_frontend.py — builds the ``--frontend``
+command-composer page from the scattered argparse registry,
+setup.py:87-92).
+
+Walks the real ``Main`` parser, emits a form with one input per option
+and a JS snippet assembling the command line live."""
+
+import argparse
+import html
+import json
+import sys
+
+from veles_tpu.__main__ import Main
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>veles_tpu command composer</title>
+<style>body{font-family:sans-serif;margin:2em}label{display:block;
+margin:.5em 0}input,select{margin-left:.5em}#cmd{background:#eee;
+padding:1em;font-family:monospace;white-space:pre-wrap}</style>
+</head><body><h1>veles_tpu command composer</h1><form id="f">
+%(fields)s</form><h2>Command</h2><div id="cmd"></div>
+<script>
+const SPEC = %(spec)s;
+function build() {
+  let cmd = ["python", "-m", "veles_tpu"];
+  for (const s of SPEC) {
+    const el = document.getElementById(s.id);
+    if (!el) continue;
+    if (s.kind === "flag") { if (el.checked) cmd.push(s.option); }
+    else if (el.value) {
+      if (s.option) cmd.push(s.option);
+      cmd.push(el.value);
+    }
+  }
+  document.getElementById("cmd").textContent = cmd.join(" ");
+}
+document.getElementById("f").addEventListener("input", build);
+build();
+</script></body></html>"""
+
+
+def describe_parser(parser):
+    """argparse parser → list of field specs (shared with the web status
+    frontend)."""
+    spec = []
+    for action in parser._actions:
+        if isinstance(action, argparse._HelpAction):
+            continue
+        kind = ("flag" if isinstance(
+            action, (argparse._StoreTrueAction, argparse._CountAction))
+            else "positional" if not action.option_strings else "value")
+        spec.append({
+            "id": "opt_" + action.dest,
+            "dest": action.dest,
+            "option": action.option_strings[0] if action.option_strings
+                      else None,
+            "kind": kind,
+            "help": action.help or "",
+            "default": (None if action.default in (None, argparse.SUPPRESS)
+                        else action.default),
+        })
+    return spec
+
+
+def render(spec):
+    fields = []
+    for s in spec:
+        label = html.escape(s["dest"])
+        title = html.escape(s["help"])
+        if s["kind"] == "flag":
+            inp = ('<input type="checkbox" id="%s">' % s["id"])
+        else:
+            default = "" if s["default"] in (None, []) else str(s["default"])
+            inp = ('<input type="text" id="%s" value="%s">'
+                   % (s["id"], html.escape(default)))
+        fields.append('<label title="%s">%s %s</label>' % (title, label, inp))
+    return _PAGE % {"fields": "\n".join(fields),
+                    "spec": json.dumps(spec)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("-o", "--output", default="frontend.html")
+    args = p.parse_args(argv)
+    main_parser = _main_parser()
+    spec = describe_parser(main_parser)
+    with open(args.output, "w") as f:
+        f.write(render(spec))
+    print("wrote %s (%d options)" % (args.output, len(spec)))
+    return 0
+
+
+def _main_parser():
+    """Re-create Main's parser (parse() builds and consumes it in one go)."""
+    m = Main([])
+    built = {}
+    orig = argparse.ArgumentParser.parse_args
+
+    def capture(self, *a, **kw):
+        built["parser"] = self
+        return argparse.Namespace()
+
+    argparse.ArgumentParser.parse_args = capture
+    try:
+        m.parse()
+    finally:
+        argparse.ArgumentParser.parse_args = orig
+    return built["parser"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
