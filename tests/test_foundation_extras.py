@@ -1,0 +1,111 @@
+"""Plugin discovery, numpy JSON, timeit, DB snapshotter, forge CLI,
+computing_power."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from veles_tpu import json_encoders, plugins, timeit2
+from veles_tpu.services.snapshotter import DBSnapshotter, SnapshotterBase
+
+
+class TestJsonEncoders:
+    def test_numpy_types(self):
+        s = json_encoders.dumps({"i": np.int64(3), "f": np.float32(0.5),
+                                 "b": np.bool_(True),
+                                 "a": np.arange(3)})
+        assert json.loads(s) == {"i": 3, "f": 0.5, "b": True, "a": [0, 1, 2]}
+
+    def test_jax_array(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        assert json.loads(json_encoders.dumps(jnp.ones(2))) == [1.0, 1.0]
+
+
+class TestTimeit:
+    def test_returns_result_and_seconds(self):
+        result, sec = timeit2.timeit(lambda a, b: a + b, 2, 3)
+        assert result == 5 and sec >= 0
+
+
+class TestPlugins:
+    def test_marker_discovery(self, tmp_path, monkeypatch):
+        pkg = tmp_path / "my_veles_plugin"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("LOADED = True\n")
+        (pkg / ".veles_tpu").write_text("")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        mods = plugins.discover(extra_paths=(str(tmp_path),))
+        assert "my_veles_plugin" in mods
+        assert mods["my_veles_plugin"].LOADED
+
+    def test_discover_idempotent(self):
+        assert plugins.discover() is plugins.discover()
+
+
+class TestDBSnapshotter:
+    def test_export_import_roundtrip(self, tmp_path):
+        class FakeTrainer:
+            velocity = {}
+            _step_counter = 7
+
+            def host_params(self):
+                return {"l0": {"weights": np.ones((2, 2))}}
+
+        class FakeLoader:
+            state = {"pos": 3}
+            epoch_number = 2
+
+        snap = DBSnapshotter.__new__(DBSnapshotter)
+        snap.dsn = str(tmp_path / "snaps.sqlite")
+        snap.prefix = "t"
+        snap.trainer = FakeTrainer()
+        snap.loader = FakeLoader()
+        snap.decision = None
+        snap._logger_ = None
+        import logging
+        snap._logger_ = logging.getLogger("test")
+        dest = snap.export()
+        assert "snaps.sqlite" in dest
+        state = DBSnapshotter.import_db(snap.dsn)
+        assert state["epoch"] == 2
+        assert state["step_counter"] == 7
+        np.testing.assert_array_equal(state["params"]["l0"]["weights"],
+                                      np.ones((2, 2)))
+        with pytest.raises(KeyError):
+            DBSnapshotter.import_db(snap.dsn, prefix="other")
+
+
+class TestForgeCLI:
+    def test_upload_list_fetch_via_cli(self, tmp_path, capsys):
+        import zipfile
+        from veles_tpu.forge import ForgeServer
+        from veles_tpu.forge.client import main as forge_main
+        pkg = str(tmp_path / "m.zip")
+        with zipfile.ZipFile(pkg, "w") as zf:
+            zf.writestr("contents.json", "{}")
+        srv = ForgeServer(str(tmp_path / "store")).start()
+        try:
+            assert forge_main(["upload", "--url", srv.url, "m", pkg,
+                               "1.0"]) == 0
+            assert forge_main(["list", "--url", srv.url]) == 0
+            out = capsys.readouterr().out
+            assert '"m"' in out
+            dest = str(tmp_path / "got.zip")
+            assert forge_main(["fetch", "--url", srv.url, "m", dest]) == 0
+            assert os.path.exists(dest)
+        finally:
+            srv.stop()
+
+
+class TestComputingPower:
+    def test_cached_power(self):
+        pytest.importorskip("jax")
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="power-test")
+        p1 = wf.computing_power()
+        assert p1 > 0
+        assert wf.computing_power() == p1   # cache hit inside 120 s

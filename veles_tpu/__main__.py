@@ -25,6 +25,11 @@ class Main(object):
         self.workflow = None
 
     def parse(self):
+        return self._build_parser().parse_args(self.argv)
+
+    def _build_parser(self):
+        """The full CLI parser (also consumed by
+        scripts.generate_frontend to emit the HTML command composer)."""
         p = argparse.ArgumentParser(
             prog="veles_tpu",
             description="TPU-native deep-learning platform")
@@ -52,7 +57,7 @@ class Main(object):
         p.add_argument("--backend", default=None,
                        help="cpu|tpu|<platform> override")
         p.add_argument("--verbose", "-v", action="count", default=0)
-        return p.parse_args(self.argv)
+        return p
 
     def run(self):
         args = self.parse()

@@ -60,11 +60,13 @@ class Watcher(object):
         per_device = {}
         for arr in jax.live_arrays():
             try:
-                nbytes = arr.nbytes
+                # per-shard bytes: replicated arrays cost full size on
+                # EVERY device, sharded ones their slice — shard.data has
+                # the honest number either way
                 for shard in arr.addressable_shards:
                     d = str(shard.device)
                     per_device[d] = (per_device.get(d, 0)
-                                     + nbytes // max(1, len(arr.sharding.device_set)))
+                                     + shard.data.nbytes)
             except RuntimeError:   # deleted under us
                 continue
         return per_device

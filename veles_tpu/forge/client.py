@@ -52,3 +52,56 @@ class ForgeClient(Logger):
             f.write(data)
         self.info("fetched %s:%s → %s", name, got_version, dest_path)
         return dest_path, got_version
+
+
+def main(argv=None):
+    """``python -m veles_tpu.forge.client`` — the `veles forge` subcommand
+    surface (ref __main__.py:230-241): list / details / upload / fetch,
+    plus `serve` to run a store."""
+    import argparse
+    p = argparse.ArgumentParser(description="veles_tpu model forge")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("list", "details", "upload", "fetch"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--url", required=True, help="forge server URL")
+        if name in ("details", "upload", "fetch"):
+            sp.add_argument("name")
+        if name == "upload":
+            sp.add_argument("package")
+            sp.add_argument("version")
+            sp.add_argument("--description")
+        if name == "fetch":
+            sp.add_argument("dest")
+            sp.add_argument("--version")
+    ps = sub.add_parser("serve")
+    ps.add_argument("directory")
+    ps.add_argument("--port", type=int, default=8088)
+    a = p.parse_args(argv)
+    import json as _json
+    if a.cmd == "serve":
+        from veles_tpu.forge.server import ForgeServer
+        srv = ForgeServer(a.directory, port=a.port).start()
+        print("forge server at %s (Ctrl-C to stop)" % srv.url)
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+    client = ForgeClient(a.url)
+    if a.cmd == "list":
+        print(_json.dumps(client.list(), indent=2))
+    elif a.cmd == "details":
+        print(_json.dumps(client.details(a.name), indent=2))
+    elif a.cmd == "upload":
+        client.upload(a.package, a.name, a.version, a.description)
+    elif a.cmd == "fetch":
+        dest, ver = client.fetch(a.name, a.dest, a.version)
+        print("%s (version %s)" % (dest, ver))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

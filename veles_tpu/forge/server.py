@@ -27,7 +27,10 @@ class ForgeStore(object):
     def __init__(self, directory):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
-        self._lock = threading.Lock()
+        # RLock: upload() holds it across manifest read-modify-write;
+        # manifest()/fetch() take it too so concurrent HTTP threads never
+        # see a torn manifest.json
+        self._lock = threading.RLock()
 
     def _manifest_path(self, name):
         return os.path.join(self.directory, name, "manifest.json")
@@ -38,15 +41,18 @@ class ForgeStore(object):
 
     def manifest(self, name):
         self._check_name(name)
-        try:
-            with open(self._manifest_path(name)) as f:
-                return json.load(f)
-        except FileNotFoundError:
-            return None
+        with self._lock:
+            try:
+                with open(self._manifest_path(name)) as f:
+                    return json.load(f)
+            except FileNotFoundError:
+                return None
 
     def list(self):
         out = []
         for name in sorted(os.listdir(self.directory)):
+            if not _NAME_RE.match(name):
+                continue   # stray entry in the store root — not a model
             m = self.manifest(name)
             if m is not None:
                 out.append(m)
@@ -73,16 +79,17 @@ class ForgeStore(object):
             return m
 
     def fetch(self, name, version=None):
-        m = self.manifest(name)
-        if m is None:
-            raise KeyError("no such model %r" % name)
-        version = version or m["latest"]
-        self._check_name(version)
-        if version not in m["versions"]:
-            raise KeyError("no version %r of %r" % (version, name))
-        with open(os.path.join(self.directory, name, version,
-                               "package.zip"), "rb") as f:
-            return f.read(), version
+        with self._lock:
+            m = self.manifest(name)
+            if m is None:
+                raise KeyError("no such model %r" % name)
+            version = version or m["latest"]
+            self._check_name(version)
+            if version not in m["versions"]:
+                raise KeyError("no version %r of %r" % (version, name))
+            with open(os.path.join(self.directory, name, version,
+                                   "package.zip"), "rb") as f:
+                return f.read(), version
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,33 @@
+"""Numpy-aware JSON encoding (ref veles/json_encoders.py)."""
+
+import json
+
+import numpy as np
+
+
+class NumpyJSONEncoder(json.JSONEncoder):
+    """Encodes numpy scalars/arrays (and jax arrays, via __array__) as
+    plain JSON numbers/lists."""
+
+    def default(self, o):
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.bool_):
+            return bool(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if hasattr(o, "__array__"):
+            return np.asarray(o).tolist()
+        return super(NumpyJSONEncoder, self).default(o)
+
+
+def dumps(obj, **kwargs):
+    kwargs.setdefault("cls", NumpyJSONEncoder)
+    return json.dumps(obj, **kwargs)
+
+
+def dump(obj, fp, **kwargs):
+    kwargs.setdefault("cls", NumpyJSONEncoder)
+    return json.dump(obj, fp, **kwargs)

@@ -1,0 +1,134 @@
+"""Launcher — run-mode detection and process lifecycle
+(ref veles/launcher.py:100: master/slave/standalone mode selection, reactor
+ownership, graphics + web-status startup, `boot()=initialize()+run()`).
+
+The reference's three modes map onto SPMD:
+
+* **standalone** — one process, one device (or a local mesh).
+* **spmd** — every host runs the *same* program; ``jax.distributed``
+  over DCN replaces the Twisted TCP control plane, and the gradient
+  exchange is the ``psum`` XLA inserts over ICI (no master/slave
+  asymmetry — "master" duties like snapshotting and dashboards fall to
+  process 0).
+
+The reference's SSH slave spawning/YARN discovery are the pod scheduler's
+job now (GKE/xmanager); what remains launcher-shaped is: initialize the
+distributed runtime, build the mesh, start host-side services on process
+0, boot the workflow, and shut everything down."""
+
+import os
+
+from veles_tpu.logger import Logger
+from veles_tpu.parallel import MeshConfig, make_mesh
+
+
+def filter_argv(argv, *flags):
+    """Drop ``flags`` (and their values for ``--flag value`` pairs) from an
+    argv list — used when respawning/forwarding commands
+    (ref launcher.py:75)."""
+    out = []
+    skip = False
+    for arg in argv:
+        if skip:
+            skip = False
+            continue
+        key = arg.split("=", 1)[0]
+        if key in flags:
+            skip = "=" not in arg
+            continue
+        out.append(arg)
+    return out
+
+
+class Launcher(Logger):
+    def __init__(self, workflow=None, mode=None, coordinator_address=None,
+                 num_processes=None, process_id=None, mesh_axes=None,
+                 web_status_port=None, graphics_endpoint=None, **kwargs):
+        super(Launcher, self).__init__(**kwargs)
+        self.workflow = workflow
+        self.coordinator_address = (coordinator_address or
+                                    os.environ.get("VELES_TPU_COORDINATOR"))
+        self.num_processes = num_processes or int(
+            os.environ.get("VELES_TPU_NUM_PROCESSES", "1"))
+        self.process_id = (process_id if process_id is not None else
+                           int(os.environ.get("VELES_TPU_PROCESS_ID", "0")))
+        if mode is None:
+            mode = ("spmd" if (self.coordinator_address or
+                               self.num_processes > 1) else "standalone")
+        self.mode = mode
+        self.mesh_axes = mesh_axes
+        self.mesh_config = None
+        self.web_status_port = web_status_port
+        self.graphics_endpoint = graphics_endpoint
+        self.web_server = None
+        self.graphics_server = None
+        self._initialized = False
+
+    # ------------------------------------------------------------ identity
+    @property
+    def is_standalone(self):
+        return self.mode == "standalone"
+
+    @property
+    def is_master(self):
+        """Process 0 owns snapshots/dashboards (ref master duties)."""
+        import jax
+        return jax.process_index() == 0
+
+    @property
+    def is_slave(self):
+        return not self.is_master
+
+    # ----------------------------------------------------------- lifecycle
+    def initialize(self, **kwargs):
+        import jax
+        if self.mode == "spmd" and self.num_processes > 1:
+            self.info("jax.distributed.initialize(%s, %d, %d)",
+                      self.coordinator_address, self.num_processes,
+                      self.process_id)
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id)
+        if self.mesh_axes:
+            self.mesh_config = MeshConfig(make_mesh(self.mesh_axes))
+        if self.is_master:
+            self._launch_services()
+        if self.workflow is not None:
+            if self.mesh_config is not None and \
+                    getattr(self.workflow, "trainer", None) is not None and \
+                    self.workflow.trainer.mesh_config is None:
+                self.workflow.trainer.mesh_config = self.mesh_config
+            self.workflow.initialize(**kwargs)
+        self._initialized = True
+
+    def _launch_services(self):
+        if self.web_status_port is not None:
+            from veles_tpu.services.web_status import WebStatusServer
+            self.web_server = WebStatusServer(port=self.web_status_port)
+            if self.workflow is not None:
+                self.web_server.register(self.workflow)
+            self.web_server.start()
+        if self.graphics_endpoint is not None:
+            from veles_tpu.services.graphics import GraphicsServer
+            self.graphics_server = GraphicsServer(
+                endpoint=self.graphics_endpoint).start()
+
+    def run(self):
+        if not self._initialized:
+            raise RuntimeError("Launcher.run() before initialize()")
+        try:
+            self.workflow.run()
+        finally:
+            self.stop()
+
+    def boot(self, **kwargs):
+        """initialize() + run() (ref launcher.py:573)."""
+        self.initialize(**kwargs)
+        self.run()
+
+    def stop(self):
+        if self.graphics_server is not None:
+            self.graphics_server.stop()
+        if self.web_server is not None:
+            self.web_server.stop()

@@ -87,9 +87,14 @@ class LMDBLoader(FullBatchLoader):
         all_labels = sum((per_class[c][1] for c in (TEST, VALID, TRAIN)), [])
         self.original_data = np.concatenate(
             [d for d in datas if d is not None])
-        if any(l >= 0 for l in all_labels):
-            self.original_labels = np.asarray(
-                [max(l, 0) for l in all_labels], np.int32)
-        else:
+        n_labeled = sum(1 for l in all_labels if l >= 0)
+        if n_labeled == 0:
             self.original_labels = None
+        elif n_labeled < len(all_labels):
+            raise ValueError(
+                "LMDBLoader: %d of %d records carry labels — mixing "
+                "labeled and unlabeled records would silently train on "
+                "wrong labels" % (n_labeled, len(all_labels)))
+        else:
+            self.original_labels = np.asarray(all_labels, np.int32)
         self.class_lengths = lengths

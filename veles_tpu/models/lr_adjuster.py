@@ -1,0 +1,83 @@
+"""Learning-rate adjusters (ref Znicz lr_adjust — the "LR adjusters"
+infrastructure units, SURVEY.md §2.9).
+
+An :class:`LRAdjuster` recomputes ``trainer.lr_scale`` — a *traced*
+multiplier on every layer's learning rate — at each epoch boundary, so
+schedule changes never trigger an XLA recompile.  Policies mirror the
+reference's (Caffe-style) set: exp, step_exp, inv, plus arbitrary
+callables."""
+
+from veles_tpu.units import Unit
+
+POLICIES = {}
+
+
+def policy(name):
+    def deco(fn):
+        POLICIES[name] = fn
+        return fn
+    return deco
+
+
+@policy("fixed")
+def fixed(epoch, **kw):
+    return 1.0
+
+
+@policy("exp")
+def exp(epoch, base=0.9, **kw):
+    """scale = base^epoch."""
+    return base ** epoch
+
+
+@policy("step_exp")
+def step_exp(epoch, base=0.1, step=10, **kw):
+    """Drop by ``base`` every ``step`` epochs (Caffe "step")."""
+    return base ** (epoch // step)
+
+
+@policy("inv")
+def inv(epoch, gamma=0.1, power=0.75, **kw):
+    """scale = (1 + gamma·epoch)^-power (Caffe "inv")."""
+    return (1.0 + gamma * epoch) ** -power
+
+
+@policy("arbitrary_step")
+def arbitrary_step(epoch, steps=(), **kw):
+    """``steps`` = [(epoch_threshold, scale), ...]; the scale of the last
+    threshold ≤ epoch wins (ref lr_adjust ArbitraryStep)."""
+    scale = 1.0
+    for threshold, s in sorted(steps):
+        if epoch >= threshold:
+            scale = s
+    return scale
+
+
+class LRAdjuster(Unit):
+    """Sets ``trainer.lr_scale`` from the schedule each time it runs; wire
+    it at the epoch boundary (StandardWorkflow gates it on epoch_ended).
+
+    ``policy`` is a name from POLICIES or a callable ``f(epoch) -> scale``.
+    """
+
+    def __init__(self, workflow, policy="fixed", **kwargs):
+        self._policy_kwargs = {k: kwargs.pop(k) for k in
+                               ("base", "step", "gamma", "power", "steps")
+                               if k in kwargs}
+        super(LRAdjuster, self).__init__(workflow, **kwargs)
+        self.policy = policy
+        self.demand("trainer", "loader")
+        self.trainer = None
+        self.loader = None
+
+    def scale_for(self, epoch):
+        if callable(self.policy):
+            return float(self.policy(epoch))
+        return float(POLICIES[self.policy](epoch, **self._policy_kwargs))
+
+    def run(self):
+        scale = self.scale_for(self.loader.epoch_number)
+        if scale != self.trainer.lr_scale:
+            self.info("lr_scale -> %.6g (epoch %d)", scale,
+                      self.loader.epoch_number)
+        self.trainer.lr_scale = scale

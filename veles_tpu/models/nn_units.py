@@ -72,6 +72,10 @@ class StagedTrainer(Unit):
         self.velocity = {}
         self.class_stats = [None, None, None]  # device accumulators
         self._step_counter = 0
+        #: multiplier on every layer's learning rate, set per epoch by an
+        #: LRAdjuster unit (ref Znicz lr_adjust); traced, so changing it
+        #: does NOT recompile the step
+        self.lr_scale = 1.0
         self.train_only_classes = (TRAIN,)
         self.view_group = "TRAINER"
 
@@ -157,7 +161,7 @@ class StagedTrainer(Unit):
         hypers = self._hypers
 
         def train_step(params, velocity, acc, data, labels, targets, idx,
-                       valid, step):
+                       valid, step, lr_scale):
             key = jax.random.fold_in(self._base_key, step)
 
             def loss_fn(p):
@@ -167,7 +171,7 @@ class StagedTrainer(Unit):
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers)
+                                                hypers, lr_scale=lr_scale)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -213,7 +217,7 @@ class StagedTrainer(Unit):
                              "is not supported — use an index loader")
         hypers = self._hypers
 
-        def train_step(params, velocity, acc, x, lbl, valid, step):
+        def train_step(params, velocity, acc, x, lbl, valid, step, lr_scale):
             key = jax.random.fold_in(self._base_key, step)
 
             def loss_fn(p):
@@ -221,7 +225,7 @@ class StagedTrainer(Unit):
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers)
+                                                hypers, lr_scale=lr_scale)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -252,7 +256,8 @@ class StagedTrainer(Unit):
                 self.params, self.velocity, self.class_stats[cls] = \
                     self._train_step(self.params, self.velocity,
                                      self.class_stats[cls], x, lbl, valid,
-                                     self._step_counter)
+                                     self._step_counter,
+                                     jnp.float32(self.lr_scale))
             else:
                 self.class_stats[cls] = self._eval_step(
                     self.params, self.class_stats[cls], x, lbl, valid)
@@ -273,7 +278,8 @@ class StagedTrainer(Unit):
                 self._train_step(self.params, self.velocity,
                                  self.class_stats[cls], self._data_dev,
                                  self._labels_dev, self._targets_dev, idx,
-                                 valid, self._step_counter)
+                                 valid, self._step_counter,
+                                 jnp.float32(self.lr_scale))
         else:
             self.class_stats[cls] = self._eval_step(
                 self.params, self.class_stats[cls], self._data_dev,

@@ -25,7 +25,8 @@ from veles_tpu.workflow import Workflow
 class StandardWorkflow(Workflow):
     def __init__(self, workflow=None, layers=None, loader=None,
                  loss="softmax", decision_config=None, snapshotter_config=None,
-                 gd_defaults=None, mesh_config=None, **kwargs):
+                 gd_defaults=None, mesh_config=None, lr_adjuster_config=None,
+                 **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -52,6 +53,16 @@ class StandardWorkflow(Workflow):
         self.trainer.link_from(self.loader)
         self.decision.link_from(self.trainer)
         tail = self.decision
+        if lr_adjuster_config is not None:
+            from veles_tpu.models.lr_adjuster import LRAdjuster
+            self.lr_adjuster = LRAdjuster(self, **lr_adjuster_config)
+            self.lr_adjuster.trainer = self.trainer
+            self.lr_adjuster.loader = self.loader
+            self.lr_adjuster.link_from(tail)
+            self.lr_adjuster.gate_skip = ~self.loader.epoch_ended
+            tail = self.lr_adjuster
+        else:
+            self.lr_adjuster = None
         if snapshotter_config is not None:
             self.snapshotter = TrainingSnapshotter(self,
                                                    **snapshotter_config)

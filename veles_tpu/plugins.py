@@ -1,0 +1,51 @@
+"""Plugin discovery (ref veles/__init__.py:294-307 — any installed package
+shipping a ``.veles`` marker file is auto-imported so its units register).
+
+Here a plugin is either (a) a package with a ``.veles_tpu`` marker file in
+its directory, or (b) an entry point in the ``veles_tpu.plugins`` group.
+Importing the module is enough — Unit subclasses self-register via the
+UnitRegistry metaclass, loaders/normalizers via their MAPPING registries."""
+
+import importlib
+import os
+import sys
+
+_loaded = None
+
+
+def discover(extra_paths=()):
+    """Import every plugin found; returns {name: module}.  Idempotent."""
+    global _loaded
+    if _loaded is not None and not extra_paths:
+        return _loaded
+    found = {}
+    # (a) marker files on sys.path package dirs
+    for base in list(sys.path) + list(extra_paths):
+        if not base or not os.path.isdir(base):
+            continue
+        try:
+            entries = os.listdir(base)
+        except OSError:
+            continue
+        for name in entries:
+            pkg_dir = os.path.join(base, name)
+            if os.path.isfile(os.path.join(pkg_dir, ".veles_tpu")):
+                found[name] = None
+    # (b) entry points
+    try:
+        from importlib import metadata
+        for ep in metadata.entry_points(group="veles_tpu.plugins"):
+            found[ep.name] = ep.value
+    except Exception:   # noqa: BLE001 — no metadata support
+        pass
+    modules = {}
+    for name, target in sorted(found.items()):
+        try:
+            modules[name] = importlib.import_module(target or name)
+        except ImportError as e:
+            import logging
+            logging.getLogger("plugins").warning(
+                "plugin %s failed to import: %s", name, e)
+    if not extra_paths:
+        _loaded = modules
+    return modules

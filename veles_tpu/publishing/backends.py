@@ -6,7 +6,19 @@ image), with a string-template fallback so the backend never disappears."""
 
 import json
 
+from veles_tpu.json_encoders import NumpyJSONEncoder
 from veles_tpu.registry import MappedRegistry
+
+
+class _ReportEncoder(NumpyJSONEncoder):
+    """Numpy/jax values as numbers; anything else stringifies rather than
+    failing the report."""
+
+    def default(self, o):
+        try:
+            return super(_ReportEncoder, self).default(o)
+        except TypeError:
+            return str(o)
 
 
 class BackendRegistry(MappedRegistry):
@@ -102,4 +114,5 @@ class JSONBackend(ReportBackend):
     EXT = ".json"
 
     def render(self, report):
-        return json.dumps(report, indent=2, default=str, sort_keys=True)
+        return json.dumps(report, indent=2, cls=_ReportEncoder,
+                          sort_keys=True)

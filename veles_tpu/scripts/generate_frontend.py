@@ -92,21 +92,8 @@ def main(argv=None):
 
 
 def _main_parser():
-    """Re-create Main's parser (parse() builds and consumes it in one go)."""
-    m = Main([])
-    built = {}
-    orig = argparse.ArgumentParser.parse_args
-
-    def capture(self, *a, **kw):
-        built["parser"] = self
-        return argparse.Namespace()
-
-    argparse.ArgumentParser.parse_args = capture
-    try:
-        m.parse()
-    finally:
-        argparse.ArgumentParser.parse_args = orig
-    return built["parser"]
+    """The real CLI parser, built but not consumed."""
+    return Main([])._build_parser()
 
 
 if __name__ == "__main__":

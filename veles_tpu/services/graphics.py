@@ -1,0 +1,154 @@
+"""Out-of-process graphics over ZeroMQ pub/sub
+(ref veles/graphics_server.py:73-163 + graphics_client.py:84 — the
+reference broadcast snappy-pickled Plotter objects over ZMQ PUB, rendered
+by a separate matplotlib process).
+
+``GraphicsServer`` bridges the in-process :data:`plotting.bus` onto a ZMQ
+PUB socket; ``GraphicsClient`` (run in any other process, or the bundled
+``python -m veles_tpu.services.graphics`` entry) subscribes and renders
+payloads to PNG via the same plotter renderers.  The compute loop never
+blocks: publishing is fire-and-forget."""
+
+import pickle
+import threading
+
+from veles_tpu.logger import Logger
+from veles_tpu.services import plotting
+
+
+class GraphicsServer(Logger):
+    """PUB side.  ``endpoint="tcp://127.0.0.1:0"`` binds a random port
+    (read the resolved one from ``.endpoint``)."""
+
+    def __init__(self, endpoint="tcp://127.0.0.1:0", bus=None, **kwargs):
+        super(GraphicsServer, self).__init__(**kwargs)
+        self.endpoint = endpoint
+        self.bus = bus if bus is not None else plotting.bus
+        self._sock = None
+        self._ctx = None
+
+    def start(self):
+        import zmq
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        if self.endpoint.endswith(":0"):
+            port = self._sock.bind_to_random_port(self.endpoint[:-2])
+            self.endpoint = "%s:%d" % (self.endpoint[:-2], port)
+        else:
+            self._sock.bind(self.endpoint)
+        self.bus.subscribe(self.publish)
+        self.info("graphics server on %s", self.endpoint)
+        return self
+
+    def publish(self, payload):
+        if self._sock is not None:
+            try:
+                self._sock.send(pickle.dumps(payload, protocol=4),
+                                flags=1)   # NOBLOCK: never stall the loop
+            except Exception:   # noqa: BLE001 — slow joiner/full HWM
+                pass
+
+    def stop(self):
+        self.bus.unsubscribe(self.publish)
+        if self._sock is not None:
+            self._sock.close(0)
+            self._sock = None
+
+
+_RENDERERS = {}
+
+
+def _renderer(kind):
+    """kind → a plotter instance whose render() understands the payload."""
+    if kind not in _RENDERERS:
+        cls = {"curve": plotting.AccumulatingPlotter,
+               "matrix": plotting.MatrixPlotter,
+               "image": plotting.ImagePlotter,
+               "histogram": plotting.HistogramPlotter}.get(kind)
+        _RENDERERS[kind] = cls(None) if cls is not None else None
+    return _RENDERERS[kind]
+
+
+class GraphicsClient(Logger):
+    """SUB side: receives payloads on a background thread; ``render_all``
+    writes the most recent payload per plot name to PNG files."""
+
+    def __init__(self, endpoint, directory="plots", **kwargs):
+        super(GraphicsClient, self).__init__(**kwargs)
+        self.endpoint = endpoint
+        self.directory = directory
+        self.latest = {}      # plot name -> payload
+        self.received = 0
+        self._thread = None
+        self._stop = False
+
+    def start(self):
+        import zmq
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.SUB)
+        sock.connect(self.endpoint)
+        sock.setsockopt(zmq.SUBSCRIBE, b"")
+
+        # the socket lives entirely on the pump thread (zmq sockets are not
+        # thread-safe); stop() only flips the flag and joins
+        def pump():
+            poller = zmq.Poller()
+            poller.register(sock, zmq.POLLIN)
+            while not self._stop:
+                try:
+                    if not poller.poll(100):
+                        continue
+                    payload = pickle.loads(sock.recv(zmq.NOBLOCK))
+                except Exception:   # noqa: BLE001 — context shut down
+                    break
+                self.latest[payload.get("name", "plot")] = payload
+                self.received += 1
+            sock.close(0)
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+        self.info("graphics client subscribed to %s", self.endpoint)
+        return self
+
+    def render_all(self):
+        import os
+        os.makedirs(self.directory, exist_ok=True)
+        written = []
+        for name, payload in list(self.latest.items()):
+            plotter = _renderer(payload.get("kind"))
+            if plotter is None:
+                continue
+            path = os.path.join(self.directory, "%s.png" % name)
+            plotter.render(payload, path)
+            written.append(path)
+        return written
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def main(argv=None):
+    """Standalone render client: subscribe and write PNGs until killed."""
+    import argparse
+    import time
+    p = argparse.ArgumentParser(description="veles_tpu graphics client")
+    p.add_argument("endpoint")
+    p.add_argument("-d", "--directory", default="plots")
+    p.add_argument("--interval", type=float, default=2.0)
+    args = p.parse_args(argv)
+    client = GraphicsClient(args.endpoint, args.directory).start()
+    try:
+        while True:
+            time.sleep(args.interval)
+            client.render_all()
+    except KeyboardInterrupt:
+        client.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -19,18 +19,34 @@ from veles_tpu.units import Unit
 
 
 class PlotBus(object):
-    """In-process pub/sub of plot payloads (ref GraphicsServer ZMQ PUB)."""
+    """In-process pub/sub of plot payloads (ref GraphicsServer ZMQ PUB).
+    ``subscribe(fn)`` fans payloads out to live listeners (the ZMQ
+    graphics server bridges them to other processes — services.graphics).
+    """
 
     def __init__(self, capacity=256):
         self._items = []
         self._capacity = capacity
         self._lock = threading.Lock()
+        self._subscribers = []
 
     def publish(self, payload):
         with self._lock:
             self._items.append(payload)
             if len(self._items) > self._capacity:
                 del self._items[:self._capacity // 2]
+            subscribers = list(self._subscribers)
+        for fn in subscribers:
+            fn(payload)
+
+    def subscribe(self, fn):
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn):
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
 
     def snapshot(self):
         with self._lock:

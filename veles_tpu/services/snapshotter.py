@@ -161,3 +161,60 @@ class TrainingSnapshotter(SnapshotterBase):
             dec.best_metric = d["best_metric"]
             dec.best_epoch = d["best_epoch"]
             dec.epochs_since_improvement = d["epochs_since_improvement"]
+
+
+class DBSnapshotter(TrainingSnapshotter):
+    """Database-backed snapshotter (ref SnapshotterToDB,
+    snapshotter.py:428-518 — the reference used ODBC; sqlite is the
+    zero-dependency stand-in, same capability: checkpoints addressable by
+    query instead of filesystem paths)."""
+
+    MAPPING = "db"
+
+    def __init__(self, workflow, dsn="snapshots.sqlite", **kwargs):
+        super(DBSnapshotter, self).__init__(workflow, **kwargs)
+        self.dsn = dsn
+
+    def _connect(self):
+        import sqlite3
+        conn = sqlite3.connect(self.dsn)
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS snapshots ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " prefix TEXT, suffix TEXT, created REAL, state BLOB)")
+        return conn
+
+    def export(self):
+        blob = pickle.dumps(self.collect(), protocol=4)
+        conn = self._connect()
+        try:
+            with conn:
+                conn.execute(
+                    "INSERT INTO snapshots (prefix, suffix, created, state)"
+                    " VALUES (?, ?, ?, ?)",
+                    (self.prefix, self.suffix(), time.time(), blob))
+        finally:
+            conn.close()
+        self.destination = "%s#%s_%s" % (self.dsn, self.prefix,
+                                         self.suffix())
+        self.info("snapshot -> %s", self.destination)
+        return self.destination
+
+    @staticmethod
+    def import_db(dsn, prefix=None):
+        """Load the most recent snapshot (optionally for one prefix)."""
+        import sqlite3
+        conn = sqlite3.connect(dsn)
+        try:
+            q = "SELECT state FROM snapshots"
+            args = ()
+            if prefix is not None:
+                q += " WHERE prefix = ?"
+                args = (prefix,)
+            q += " ORDER BY id DESC LIMIT 1"
+            row = conn.execute(q, args).fetchone()
+        finally:
+            conn.close()
+        if row is None:
+            raise KeyError("no snapshot in %s (prefix=%r)" % (dsn, prefix))
+        return pickle.loads(row[0])

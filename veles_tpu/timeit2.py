@@ -1,0 +1,16 @@
+"""timeit helper (ref veles/timeit2.py): ``timeit(fn, *args)`` →
+``(result, seconds)``; on jax outputs it blocks until ready so the number
+means device time, not dispatch time."""
+
+import time
+
+
+def timeit(fn, *args, **kwargs):
+    t0 = time.perf_counter()
+    result = fn(*args, **kwargs)
+    try:
+        import jax
+        jax.block_until_ready(result)
+    except (ImportError, TypeError):
+        pass
+    return result, time.perf_counter() - t0

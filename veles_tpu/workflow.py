@@ -171,6 +171,24 @@ class Workflow(Container):
         return rows
 
     # ---------------------------------------------------------------- results
+    def computing_power(self):
+        """Benchmarked device throughput, re-measured at most every 120 s
+        (ref AcceleratedWorkflow.computing_power,
+        accelerated_units.py:843-858 — the number the reference's master
+        used for load balancing; here it feeds observability).  A method,
+        not a property: the first call blocks on a jit compile, which must
+        never hide behind attribute access."""
+        import time as _time
+        now = _time.time()
+        cached = getattr(self, "_power_cache_", None)
+        if cached is not None and now - cached[0] < 120.0:
+            return cached[1]
+        from veles_tpu.benchmark import DeviceBenchmark
+        bench = DeviceBenchmark(None, size=512, repeats=1)
+        bench.run()
+        self._power_cache_ = (now, bench.computing_power)
+        return bench.computing_power
+
     def gather_results(self):
         """Collect metrics from every unit exposing ``get_metric_values()``
         (IResultProvider, ref workflow.py:823-845)."""
