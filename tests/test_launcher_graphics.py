@@ -33,8 +33,12 @@ def _mnistish_workflow(**kw):
 class TestFilterArgv:
     def test_drops_flag_and_value(self):
         argv = ["prog", "-l", "host:1", "--keep", "x", "--drop=5", "tail"]
-        assert filter_argv(argv, "-l", "--drop") == \
+        assert filter_argv(argv, "-l=", "--drop=") == \
             ["prog", "--keep", "x", "tail"]
+
+    def test_bare_flag_keeps_following_arg(self):
+        assert filter_argv(["prog", "-v", "train.py"], "-v") == \
+            ["prog", "train.py"]
 
 
 class TestLauncher:

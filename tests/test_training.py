@@ -155,3 +155,25 @@ class TestConvWorkflow:
         wf.initialize()
         wf.run()
         assert wf.decision.best_metric < 0.08
+
+
+class TestConvAutoencoder:
+    def test_conv_autoencoder_reduces_rmse(self):
+        from veles_tpu.models.zoo import conv_autoencoder
+        prng.seed_all(17)
+        x, _ = digits_data()
+        x_img = x.reshape(-1, 8, 8, 1)
+        loader = FullBatchLoader(
+            None, data=x_img, minibatch_size=100,
+            class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=conv_autoencoder(n_kernels=8, lr=0.02),
+            loader=loader, loss="mse",
+            decision_config={"max_epochs": 15},
+            name="digits-conv-ae")
+        wf.initialize()
+        wf.run()
+        # encoder halves the resolution through a 2x2 pool; decoder must
+        # reconstruct below the trivial all-zeros baseline RMSE
+        baseline = float(np.sqrt((x_img ** 2).mean()))
+        assert wf.decision.best_metric < 0.6 * baseline

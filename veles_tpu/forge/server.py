@@ -51,7 +51,8 @@ class ForgeStore(object):
     def list(self):
         out = []
         for name in sorted(os.listdir(self.directory)):
-            if not _NAME_RE.match(name):
+            if not _NAME_RE.match(name) or \
+                    not os.path.isdir(os.path.join(self.directory, name)):
                 continue   # stray entry in the store root — not a model
             m = self.manifest(name)
             if m is not None:

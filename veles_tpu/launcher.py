@@ -23,9 +23,12 @@ from veles_tpu.parallel import MeshConfig, make_mesh
 
 
 def filter_argv(argv, *flags):
-    """Drop ``flags`` (and their values for ``--flag value`` pairs) from an
-    argv list — used when respawning/forwarding commands
-    (ref launcher.py:75)."""
+    """Drop ``flags`` from an argv list — used when respawning/forwarding
+    commands (ref launcher.py:75).  A flag spelled with a trailing ``=``
+    (e.g. ``"-l="``) also consumes its separate value argument; a bare
+    flag name drops only the flag itself (boolean switches)."""
+    value_flags = {f[:-1] for f in flags if f.endswith("=")}
+    bare_flags = {f for f in flags if not f.endswith("=")}
     out = []
     skip = False
     for arg in argv:
@@ -33,8 +36,10 @@ def filter_argv(argv, *flags):
             skip = False
             continue
         key = arg.split("=", 1)[0]
-        if key in flags:
+        if key in value_flags:
             skip = "=" not in arg
+            continue
+        if key in bare_flags:
             continue
         out.append(arg)
     return out

@@ -447,12 +447,37 @@ class PositionalEncoding(Layer):
         return x + pe.astype(x.dtype)
 
 
+def _seq_parallel_attn_fn(layer):
+    """impl="ring"/"ulysses": core attention runs sequence-parallel over
+    the mesh's ``seq`` axis (parallel.ring — ring attention rotates k/v
+    shards over ICI; Ulysses all-to-alls to head sharding).  The trainer
+    injects ``layer.mesh`` when its mesh has a ``seq`` axis."""
+    impl = layer.cfg.get("impl", "blockwise")
+    if impl not in ("ring", "ulysses"):
+        return None
+    if getattr(layer, "mesh", None) is None or \
+            "seq" not in layer.mesh.shape:
+        raise ValueError(
+            "impl=%r needs sequence parallelism: pass the trainer a "
+            "mesh_config whose mesh has a 'seq' axis" % impl)
+    from veles_tpu.parallel import ring as seqpar
+    fn = (seqpar.ring_attention_sharded if impl == "ring"
+          else seqpar.ulysses_attention_sharded)
+    mesh = layer.mesh
+
+    def attn(q, k, v, causal=False):
+        return fn(q, k, v, mesh, causal=causal)
+    return attn
+
+
 class MultiHeadAttention(Layer):
     """Self-attention over [T, F] samples (ops.attention).  ``impl``
-    selects naive / blockwise / flash (Pallas); causal via ``causal``."""
+    selects naive / blockwise / flash (Pallas) / ring / ulysses (the
+    sequence-parallel paths); causal via ``causal``."""
 
     TYPES = ("multihead_attention",)
     has_params = True
+    mesh = None   # injected by the trainer for impl=ring/ulysses
 
     def _infer(self, input_shape):
         t, f = input_shape
@@ -472,7 +497,8 @@ class MultiHeadAttention(Layer):
         return attention.mha_forward(
             params, x, self.n_heads,
             causal=bool(self.cfg.get("causal", False)),
-            impl=self.cfg.get("impl", "blockwise"), policy=self.policy)
+            impl=self.cfg.get("impl", "blockwise"),
+            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy)
 
 
 class TransformerBlock(Layer):
@@ -481,6 +507,7 @@ class TransformerBlock(Layer):
 
     TYPES = ("transformer_block",)
     has_params = True
+    mesh = None   # injected by the trainer for impl=ring/ulysses
 
     @property
     def needs_rng(self):
@@ -520,7 +547,8 @@ class TransformerBlock(Layer):
         h = attention.mha_forward(
             params["mha"], h, self.n_heads,
             causal=bool(self.cfg.get("causal", False)),
-            impl=self.cfg.get("impl", "blockwise"), policy=self.policy)
+            impl=self.cfg.get("impl", "blockwise"),
+            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy)
         if k1 is not None:
             h = dropout.forward(h, k1, ratio)
         x = x + h

@@ -103,6 +103,12 @@ class StagedTrainer(Unit):
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding
             mc = self.mesh_config
+            if "seq" in mc.mesh.shape:
+                # sequence-parallel attention layers need the mesh to build
+                # their shard_map (impl=ring/ulysses)
+                for layer in self.layers:
+                    if hasattr(type(layer), "mesh"):
+                        layer.mesh = mc.mesh
             if loader.minibatch_size % mc.data_size:
                 raise ValueError(
                     "minibatch_size %d not divisible by data axis %d"

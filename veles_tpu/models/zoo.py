@@ -82,7 +82,8 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
     """Transformer encoder classifier over [T, F] sequence samples — new
     capability beyond the reference (its RNN/LSTM support was 'in
     progress', manualrst_veles_algorithms.rst:105-112; attention postdates
-    it).  ``impl`` picks the attention path (blockwise / flash=Pallas)."""
+    it).  ``impl`` picks the attention path: blockwise / flash (Pallas) /
+    ring / ulysses (sequence-parallel over a mesh 'seq' axis)."""
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
     layers = [dict({"type": "timestep_dense", "output_sample_shape": d_model},
                    **gd),
@@ -128,4 +129,20 @@ def mnist_autoencoder(bottleneck=16, lr=0.01, moment=0.9):
          "learning_rate": lr, "gradient_moment": moment},
         {"type": "all2all", "output_sample_shape": 784,
          "learning_rate": lr, "gradient_moment": moment},
+    ]
+
+
+def conv_autoencoder(n_kernels=8, kx=3, ky=3, lr=0.01, moment=0.9,
+                     out_channels=1):
+    """Convolutional autoencoder (ref manualrst_veles_algorithms.rst:86-94
+    "convolutional autoencoder"): conv+pool encoder, depool+deconv decoder,
+    trained with loss="mse" reconstructing the input."""
+    gd = {"learning_rate": lr, "gradient_moment": moment}
+    return [
+        dict({"type": "conv_relu", "n_kernels": n_kernels, "kx": kx,
+              "ky": ky}, **gd),
+        {"type": "max_pooling", "kx": 2, "ky": 2},
+        {"type": "depooling", "kx": 2, "ky": 2},
+        dict({"type": "deconv", "n_kernels": out_channels, "kx": kx,
+              "ky": ky}, **gd),
     ]

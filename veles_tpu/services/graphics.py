@@ -63,7 +63,7 @@ def _renderer(kind):
     if kind not in _RENDERERS:
         cls = {"curve": plotting.AccumulatingPlotter,
                "matrix": plotting.MatrixPlotter,
-               "image": plotting.ImagePlotter,
+               "images": plotting.ImagePlotter,
                "histogram": plotting.HistogramPlotter}.get(kind)
         _RENDERERS[kind] = cls(None) if cls is not None else None
     return _RENDERERS[kind]
@@ -99,10 +99,14 @@ class GraphicsClient(Logger):
                     if not poller.poll(100):
                         continue
                     payload = pickle.loads(sock.recv(zmq.NOBLOCK))
+                    if isinstance(payload, dict):
+                        self.latest[payload.get("name", "plot")] = payload
+                        self.received += 1
+                    else:
+                        self.warning("ignoring non-dict plot payload: %r",
+                                     type(payload).__name__)
                 except Exception:   # noqa: BLE001 — context shut down
                     break
-                self.latest[payload.get("name", "plot")] = payload
-                self.received += 1
             sock.close(0)
 
         self._thread = threading.Thread(target=pump, daemon=True)

@@ -4,13 +4,18 @@ means device time, not dispatch time."""
 
 import time
 
+try:   # resolved at import time — never inside the timed window
+    import jax as _jax
+except ImportError:   # pragma: no cover — jax is bundled in this image
+    _jax = None
+
 
 def timeit(fn, *args, **kwargs):
     t0 = time.perf_counter()
     result = fn(*args, **kwargs)
-    try:
-        import jax
-        jax.block_until_ready(result)
-    except (ImportError, TypeError):
-        pass
+    if _jax is not None:
+        try:
+            _jax.block_until_ready(result)
+        except TypeError:
+            pass
     return result, time.perf_counter() - t0
