@@ -1,0 +1,150 @@
+"""GPipe-style pipeline parallelism: schedule correctness vs sequential,
+gradients, the pipelined-transformer layer, and end-to-end training."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from veles_tpu.parallel import pipeline  # noqa: E402
+from veles_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _stacked_params(s=4, d=8, seed=0):
+    r = np.random.RandomState(seed)
+    return {"w": jnp.asarray(r.randn(s, d, d).astype(np.float32) * 0.5),
+            "b": jnp.asarray(r.randn(s, d).astype(np.float32) * 0.1)}
+
+
+def _sequential(params, x):
+    h, _ = jax.lax.scan(lambda h, p: (_stage_fn(p, h), None), x, params)
+    return h
+
+
+class TestPipelineSchedule:
+    @pytest.mark.parametrize("s,m", [(4, 4), (8, 2), (2, 8)])
+    def test_matches_sequential(self, s, m):
+        params = _stacked_params(s)
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 8)
+                        .astype(np.float32))
+        ref = _sequential(params, x)
+        mesh = make_mesh({"pipe": s})
+        out = pipeline.pipeline_apply_sharded(_stage_fn, params, x, mesh,
+                                              n_microbatches=m)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_microbatches(self):
+        params = _stacked_params(4)
+        x = jnp.zeros((10, 8), jnp.float32)
+        mesh = make_mesh({"pipe": 4})
+        with pytest.raises(ValueError, match="microbatch"):
+            pipeline.pipeline_apply_sharded(_stage_fn, params, x, mesh,
+                                            n_microbatches=3)
+
+    def test_gradients_match_sequential(self):
+        params = _stacked_params(4)
+        x = jnp.asarray(np.random.RandomState(2).randn(8, 8)
+                        .astype(np.float32))
+        mesh = make_mesh({"pipe": 4})
+
+        g_ref = jax.grad(lambda p: jnp.sum(_sequential(p, x) ** 2))(params)
+        g_pp = jax.grad(lambda p: jnp.sum(pipeline.pipeline_apply_sharded(
+            _stage_fn, p, x, mesh, n_microbatches=4) ** 2))(params)
+        np.testing.assert_allclose(np.asarray(g_pp["w"]),
+                                   np.asarray(g_ref["w"]),
+                                   rtol=5e-4, atol=5e-4)
+
+
+class TestPipelinedTransformerLayer:
+    def test_sharded_matches_sequential_scan(self):
+        from veles_tpu import prng
+        from veles_tpu.models.layers import make_layer
+        prng.seed_all(7)
+        cfg = {"type": "pipelined_transformer", "n_blocks": 4,
+               "n_heads": 2, "d_ff": 32, "n_microbatches": 2}
+        seq = make_layer(dict(cfg))
+        par = make_layer(dict(cfg))
+        assert seq.setup((8, 16)) == (8, 16)
+        par.setup((8, 16))
+        params = seq.init_params(prng.get("pp"))
+        x = jnp.asarray(np.random.RandomState(3).randn(4, 8, 16)
+                        .astype(np.float32))
+        ref = seq.apply(params, x)
+        par.mesh = make_mesh({"pipe": 4})
+        out = par.apply(params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestPipelinedTraining:
+    def test_trains_on_pipe_mesh(self):
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+        from veles_tpu.parallel import MeshConfig
+        prng.seed_all(55)
+        n = 16
+        x = np.random.RandomState(0).rand(2 * n, 8, 4).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 3, 2 * n).astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=8,
+                                 class_lengths=[0, n, n])
+        gd = {"learning_rate": 0.01, "gradient_moment": 0.9,
+              "solver": "adam"}
+        wf = StandardWorkflow(
+            layers=[dict({"type": "timestep_dense",
+                          "output_sample_shape": 16}, **gd),
+                    {"type": "positional_encoding"},
+                    dict({"type": "pipelined_transformer", "n_blocks": 4,
+                          "n_heads": 2, "n_microbatches": 2}, **gd),
+                    {"type": "seq_pool", "mode": "mean"},
+                    dict({"type": "softmax", "output_sample_shape": 3},
+                         **gd)],
+            loader=loader, decision_config={"max_epochs": 2},
+            mesh_config=MeshConfig(make_mesh({"data": 1, "pipe": 4})),
+            name="pp-train")
+        wf.initialize()
+        wf.run()
+        res = wf.gather_results()
+        assert res["epochs"] == 2 and res["best_metric"] is not None
+
+
+class TestParamSharding:
+    def test_pipe_and_expert_params_actually_shard(self):
+        """Each device must hold ONLY its stage / its experts (the memory
+        scaling PP/EP exist for), not a full replica."""
+        from veles_tpu import prng
+        from veles_tpu.models.layers import make_layer
+        from veles_tpu.parallel import MeshConfig, sharding
+        prng.seed_all(70)
+
+        pp = make_layer({"type": "pipelined_transformer", "n_blocks": 8,
+                         "n_heads": 2, "d_ff": 32})
+        pp.setup((8, 16))
+        params = {pp.name: pp.init_params(prng.get("x"))}
+        mc = MeshConfig(make_mesh({"data": 1, "pipe": 8}))
+        ov = {pp.name: pp.param_partition_specs(dict(mc.mesh.shape))}
+        placed = jax.tree_util.tree_map(
+            lambda x: x, sharding.shard_params(params, mc, ov))
+        w1 = placed[pp.name]["stages"]["w1"]
+        assert w1.shape[0] == 8
+        assert w1.addressable_shards[0].data.shape[0] == 1
+
+        moe_layer = make_layer({"type": "moe", "n_experts": 8,
+                                "d_ff": 32})
+        moe_layer.setup((8, 16))
+        mparams = {moe_layer.name: moe_layer.init_params(prng.get("y"))}
+        emc = MeshConfig(make_mesh({"data": 1, "expert": 8}))
+        eov = {moe_layer.name:
+               moe_layer.param_partition_specs(dict(emc.mesh.shape))}
+        eplaced = sharding.shard_params(mparams, emc, eov)
+        ew1 = eplaced[moe_layer.name]["w1"]
+        assert ew1.addressable_shards[0].data.shape[0] == 1
+        # router replicates
+        router = eplaced[moe_layer.name]["router"]
+        assert router.addressable_shards[0].data.shape == router.shape

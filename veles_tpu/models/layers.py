@@ -68,6 +68,13 @@ class Layer(object):
     def init_params(self, rng):
         return {}
 
+    def param_partition_specs(self, mesh_shape):
+        """Optional override of the default (model-axis) parameter
+        sharding rule: return a PartitionSpec applied to every param
+        leaf, or a partial dict mirroring init_params' structure.  None =
+        default rule (parallel.sharding.param_spec)."""
+        return None
+
     def apply(self, params, x, train=False, key=None):
         raise NotImplementedError
 
@@ -501,13 +508,62 @@ class MultiHeadAttention(Layer):
             attn_fn=_seq_parallel_attn_fn(self), policy=self.policy)
 
 
+class MoE(Layer):
+    """Position-wise mixture-of-experts feed-forward over [T, D] samples
+    (ops.moe — GShard/Switch dense-dispatch MoE).  With a mesh carrying an
+    ``expert`` axis (trainer-injected), experts run expert-parallel via
+    all_to_all; otherwise all experts compute locally.  The router's
+    load-balancing loss lands in ``last_aux`` and is added to the
+    training loss scaled by ``aux_weight``."""
+
+    TYPES = ("moe",)
+    has_params = True
+    mesh = None   # injected by the trainer when the mesh has 'expert'
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.n_experts = int(self.cfg.get("n_experts", 8))
+        self.d_ff = int(self.cfg.get("d_ff", 4 * f))
+        self.top_k = int(self.cfg.get("top_k", 2))
+        self.capacity_factor = float(self.cfg.get("capacity_factor", 2.0))
+        self.last_aux = None
+        return (t, f)
+
+    def init_params(self, rng):
+        from veles_tpu.ops import moe as moe_ops
+        return moe_ops.moe_init(rng, self.input_shape[-1], self.d_ff,
+                                self.n_experts, self.policy.param)
+
+    def param_partition_specs(self, mesh_shape):
+        if "expert" not in mesh_shape:
+            return None
+        from jax.sharding import PartitionSpec as P
+        e = P("expert")
+        return {"router": P(), "w1": e, "b1": e, "w2": e, "b2": e}
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import moe as moe_ops
+        if self.mesh is not None and "expert" in self.mesh.shape:
+            y, aux = moe_ops.moe_forward_sharded(
+                params, x, self.mesh, top_k=self.top_k,
+                capacity_factor=self.capacity_factor, policy=self.policy)
+        else:
+            y, aux = moe_ops.moe_forward(
+                params, x, top_k=self.top_k,
+                capacity_factor=self.capacity_factor, policy=self.policy)
+        self.last_aux = aux
+        return y
+
+
 class TransformerBlock(Layer):
     """Pre-LN transformer block: LN→MHA→residual, LN→MLP(gelu)→residual.
-    ``impl`` as in MultiHeadAttention; optional dropout on both branches."""
+    ``impl`` as in MultiHeadAttention; optional dropout on both branches.
+    ``n_experts`` > 0 swaps the dense MLP for a mixture-of-experts FFN
+    (ops.moe), expert-parallel when the mesh has an ``expert`` axis."""
 
     TYPES = ("transformer_block",)
     has_params = True
-    mesh = None   # injected by the trainer for impl=ring/ulysses
+    mesh = None   # injected by the trainer for impl=ring/ulysses / moe
 
     @property
     def needs_rng(self):
@@ -517,25 +573,48 @@ class TransformerBlock(Layer):
         t, f = input_shape
         self.n_heads = int(self.cfg.get("n_heads", 8))
         self.d_ff = int(self.cfg.get("d_ff", 4 * f))
+        self.n_experts = int(self.cfg.get("n_experts", 0))
+        self.last_aux = None
+        if self.n_experts:
+            # the FFN is a full MoE layer instance — one implementation of
+            # the dispatch/fallback logic, shared with the standalone type
+            self._moe = MoE({"type": "moe", "n_experts": self.n_experts,
+                             "d_ff": self.d_ff,
+                             "top_k": self.cfg.get("top_k", 2),
+                             "capacity_factor":
+                                 self.cfg.get("capacity_factor", 2.0)})
+            self._moe.setup(input_shape)
         return (t, f)
+
+    def param_partition_specs(self, mesh_shape):
+        if not self.n_experts:
+            return None
+        sub = self._moe.param_partition_specs(mesh_shape)
+        return None if sub is None else {"moe": sub}
 
     def init_params(self, rng):
         from veles_tpu.ops import attention, norm
         f = self.input_shape[-1]
         std = f ** -0.5
-        return {
+        params = {
             "ln1": norm.layer_norm_init((f,)),
             "mha": attention.mha_init(rng, f, self.n_heads,
                                       self.policy.param),
             "ln2": norm.layer_norm_init((f,)),
-            "w1": jnp.asarray(rng.normal(0.0, std, (f, self.d_ff)),
-                              self.policy.param),
-            "b1": jnp.zeros((self.d_ff,), self.policy.param),
-            "w2": jnp.asarray(rng.normal(0.0, self.d_ff ** -0.5,
-                                         (self.d_ff, f)),
-                              self.policy.param),
-            "b2": jnp.zeros((f,), self.policy.param),
         }
+        if self.n_experts:
+            params["moe"] = self._moe.init_params(rng)
+        else:
+            params.update({
+                "w1": jnp.asarray(rng.normal(0.0, std, (f, self.d_ff)),
+                                  self.policy.param),
+                "b1": jnp.zeros((self.d_ff,), self.policy.param),
+                "w2": jnp.asarray(rng.normal(0.0, self.d_ff ** -0.5,
+                                             (self.d_ff, f)),
+                                  self.policy.param),
+                "b2": jnp.zeros((f,), self.policy.param),
+            })
+        return params
 
     def apply(self, params, x, train=False, key=None):
         from veles_tpu.ops import attention, norm
@@ -553,12 +632,73 @@ class TransformerBlock(Layer):
             h = dropout.forward(h, k1, ratio)
         x = x + h
         h = norm.layer_norm(x, params["ln2"]["gamma"], params["ln2"]["beta"])
-        h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
-                        + params["b1"])
-        h = linear.matmul(h, params["w2"], self.policy) + params["b2"]
+        if self.n_experts:
+            self._moe.mesh = self.mesh
+            h = self._moe.apply(params["moe"], h, train=train)
+            self.last_aux = self._moe.last_aux
+            self._moe.last_aux = None
+        else:
+            h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
+                            + params["b1"])
+            h = linear.matmul(h, params["w2"], self.policy) + params["b2"]
         if k2 is not None:
             h = dropout.forward(h, k2, ratio)
         return x + h
+
+
+class PipelinedTransformer(Layer):
+    """N identical transformer blocks run as pipeline stages
+    (parallel.pipeline — GPipe microbatch schedule over the mesh's
+    ``pipe`` axis; sequential ``lax.scan`` over stages without one).
+    Stage params stack on a leading [n_blocks, ...] axis so the pipe
+    sharding is one PartitionSpec.  Dropout inside pipelined stages is
+    unsupported (keys would need per-stage plumbing); keep it in
+    surrounding layers."""
+
+    TYPES = ("pipelined_transformer",)
+    has_params = True
+    mesh = None   # injected by the trainer when the mesh has 'pipe'
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.n_blocks = int(self.cfg.get("n_blocks", 2))
+        self.n_microbatches = int(self.cfg.get("n_microbatches", 4))
+        block_cfg = {"type": "transformer_block",
+                     "n_heads": self.cfg.get("n_heads", 8),
+                     "d_ff": self.cfg.get("d_ff", 4 * f),
+                     "causal": self.cfg.get("causal", False),
+                     "impl": self.cfg.get("impl", "blockwise"),
+                     "dropout_ratio": 0.0}
+        self._block = TransformerBlock(block_cfg)
+        self._block.setup(input_shape)
+        return (t, f)
+
+    def init_params(self, rng):
+        stages = [self._block.init_params(rng)
+                  for _ in range(self.n_blocks)]
+        return {"stages": jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *stages)}
+
+    def param_partition_specs(self, mesh_shape):
+        if "pipe" not in mesh_shape:
+            return None
+        from jax.sharding import PartitionSpec as P
+        return P("pipe")   # every stacked [S, ...] leaf shards its stage
+
+    def apply(self, params, x, train=False, key=None):
+        block = self._block
+
+        def fn(p, h):
+            return block.apply(p, h, train=False, key=None)
+
+        if self.mesh is not None and "pipe" in self.mesh.shape:
+            from veles_tpu.parallel import pipeline
+            return pipeline.pipeline_apply_sharded(
+                fn, params["stages"], x, self.mesh,
+                n_microbatches=self.n_microbatches)
+        h, _ = jax.lax.scan(lambda h, p: (fn(p, h), None), x,
+                            params["stages"])
+        return h
 
 
 class TimestepDense(Layer):
@@ -620,8 +760,9 @@ LAYER_TYPES = {}
 for _cls in (All2All, ResizableAll2All, Conv, Deconv, Pooling, Depooling,
              StochasticPoolDepool, ChannelSplitter, ChannelMerger, LRN,
              Dropout, Activation, Cutter, LSTM, ZeroFiller, LayerNorm,
-             Embedding, PositionalEncoding, MultiHeadAttention,
-             TransformerBlock, TimestepDense, SeqPool):
+             Embedding, PositionalEncoding, MultiHeadAttention, MoE,
+             TransformerBlock, PipelinedTransformer, TimestepDense,
+             SeqPool):
     for _t in _cls.TYPES:
         LAYER_TYPES[_t] = _cls
 

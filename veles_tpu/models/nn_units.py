@@ -103,9 +103,10 @@ class StagedTrainer(Unit):
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding
             mc = self.mesh_config
-            if "seq" in mc.mesh.shape:
-                # sequence-parallel attention layers need the mesh to build
-                # their shard_map (impl=ring/ulysses)
+            if {"seq", "expert", "pipe"} & set(mc.mesh.shape):
+                # sequence-parallel attention (impl=ring/ulysses),
+                # expert-parallel MoE, and pipelined stages need the mesh
+                # to build their shard_map
                 for layer in self.layers:
                     if hasattr(type(layer), "mesh"):
                         layer.mesh = mc.mesh
@@ -113,8 +114,14 @@ class StagedTrainer(Unit):
                 raise ValueError(
                     "minibatch_size %d not divisible by data axis %d"
                     % (loader.minibatch_size, mc.data_size))
-            self.params = sharding.shard_params(self.params, mc)
-            self.velocity = sharding.shard_params(self.velocity, mc)
+            self._param_overrides = {
+                layer.name: ov for layer in self.layers if layer.has_params
+                for ov in [layer.param_partition_specs(
+                    dict(mc.mesh.shape))] if ov is not None}
+            self.params = sharding.shard_params(self.params, mc,
+                                                self._param_overrides)
+            self.velocity = sharding.shard_params(self.velocity, mc,
+                                                  self._param_overrides)
         self.reset_epoch_stats()
         self._build_steps()
 
@@ -135,6 +142,15 @@ class StagedTrainer(Unit):
 
     def _loss_from_batch(self, params, x, lbl, tgt, valid, train, key):
         out = self._forward(params, x, train, key)
+        # router auxiliary losses (MoE load balancing): layers stash the
+        # traced value during _forward; read it back inside the same trace
+        aux_total = 0.0
+        for layer in self.layers:
+            la = getattr(layer, "last_aux", None)
+            if la is not None:
+                aux_total = aux_total + float(
+                    layer.cfg.get("aux_weight", 0.01)) * la
+                layer.last_aux = None
         if self.loss == "softmax":
             loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
                 out, lbl, valid)
@@ -151,8 +167,9 @@ class StagedTrainer(Unit):
         # optimized loss is per-element mean (keeps lr scale comparable
         # across output widths); stats carry the raw sum for epoch metrics
         denom = jnp.maximum(n_valid, 1.0) * n_features
-        return loss_sum / denom, {"loss": loss_sum, "n_errors": err_sum,
-                                  "count": n_valid}
+        return loss_sum / denom + aux_total, {"loss": loss_sum,
+                                              "n_errors": err_sum,
+                                              "count": n_valid}
 
     def _build_steps(self):
         if self.loader.carries_data:
@@ -191,8 +208,9 @@ class StagedTrainer(Unit):
             from veles_tpu.parallel import sharding
             mc = self.mesh_config
             repl = sharding.replicated_sharding(mc)
-            p_sh = sharding.param_shardings(self.params, mc)
-            v_sh = sharding.param_shardings(self.velocity, mc)
+            overrides = getattr(self, "_param_overrides", None)
+            p_sh = sharding.param_shardings(self.params, mc, overrides)
+            v_sh = sharding.param_shardings(self.velocity, mc, overrides)
             acc_sh = jax.tree_util.tree_map(lambda _: repl,
                                             self._zero_stats())
             self._train_step = jax.jit(
@@ -316,12 +334,14 @@ class StagedTrainer(Unit):
             self.velocity = jax.tree_util.tree_map(jnp.asarray,
                                                    host_velocity)
         if self.mesh_config is not None:
-            # re-establish the tensor-parallel placement initialize() set up
+            # re-establish the parallel placement initialize() set up
             from veles_tpu.parallel import sharding
+            overrides = getattr(self, "_param_overrides", None)
             self.params = sharding.shard_params(self.params,
-                                                self.mesh_config)
+                                                self.mesh_config, overrides)
             self.velocity = sharding.shard_params(self.velocity,
-                                                  self.mesh_config)
+                                                  self.mesh_config,
+                                                  overrides)
 
     def forward_fn(self):
         """Jitted serve-time forward (softmax applied for classifiers)."""

@@ -78,7 +78,8 @@ def alexnet(n_classes=1000, lr=0.01, moment=0.9, wd=5e-4):
 
 def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
                            d_ff=None, lr=0.001, moment=0.9, causal=False,
-                           dropout=0.1, impl="blockwise", solver="adam"):
+                           dropout=0.1, impl="blockwise", solver="adam",
+                           n_experts=0):
     """Transformer encoder classifier over [T, F] sequence samples — new
     capability beyond the reference (its RNN/LSTM support was 'in
     progress', manualrst_veles_algorithms.rst:105-112; attention postdates
@@ -93,7 +94,7 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
                             "n_heads": n_heads,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": causal, "dropout_ratio": dropout,
-                            "impl": impl}, **gd))
+                            "impl": impl, "n_experts": n_experts}, **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append({"type": "seq_pool", "mode": "mean"})
     layers.append(dict({"type": "softmax", "output_sample_shape": n_classes},
@@ -103,7 +104,7 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
 
 def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
-                   impl="blockwise", solver="adam"):
+                   impl="blockwise", solver="adam", n_experts=0):
     """Decoder-only causal LM over int token samples [T]."""
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
     layers = [dict({"type": "embedding", "vocab_size": vocab_size,
@@ -114,7 +115,7 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                             "n_heads": n_heads,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": True, "dropout_ratio": dropout,
-                            "impl": impl}, **gd))
+                            "impl": impl, "n_experts": n_experts}, **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append(dict({"type": "timestep_dense",
                         "output_sample_shape": vocab_size}, **gd))

@@ -1,0 +1,76 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a
+``pipe`` mesh axis.
+
+New capability beyond the reference (SURVEY.md §2.6: PP absent there).
+Each device holds ONE stage's parameters; activations flow stage-to-stage
+with ``lax.ppermute`` (one ICI neighbor hop per tick) while microbatches
+stream through, so all stages compute concurrently after the fill phase —
+the classic GPipe schedule with bubble fraction (S-1)/(M+S-1).
+
+Constraint: every stage maps activations to the SAME shape (the
+transformer-block regime pipelining is used for); embed/head layers live
+outside the pipelined segment.  The whole schedule is a ``lax.scan``, so
+it jits, differentiates (reverse-mode re-runs the scan), and composes
+with the other mesh axes."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
+    """Inside shard_map over ``axis_name``: run the pipeline.
+
+    stage_fn(stage_params, h) -> h (same shape); ``stage_params`` are THIS
+    device's stage weights; ``x`` [B, ...] is the full batch (meaningful on
+    stage 0, replicated elsewhere).  Returns [B, ...] outputs of the last
+    stage, broadcast to every stage."""
+    s = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError("batch %d %% n_microbatches %d != 0"
+                         % (b, n_microbatches))
+    mb = b // n_microbatches
+    xs = x.reshape((n_microbatches, mb) + x.shape[1:])
+    n_ticks = n_microbatches + s - 1
+    fwd = [(i, i + 1) for i in range(s - 1)]   # no wraparound
+
+    def tick(carry, t):
+        outputs, recv = carry
+        mb_idx = jnp.clip(t, 0, n_microbatches - 1)
+        inp = jnp.where(me == 0, xs[mb_idx], recv)
+        out = stage_fn(stage_params, inp)
+        # the last stage finishes microbatch t-(s-1) at tick t
+        out_idx = jnp.clip(t - (s - 1), 0, n_microbatches - 1)
+        write = (me == s - 1) & (t >= s - 1)
+        outputs = outputs.at[out_idx].set(
+            jnp.where(write, out, outputs[out_idx]))
+        recv = lax.ppermute(out, axis_name, fwd)
+        return (outputs, recv), None
+
+    outputs = jnp.zeros_like(xs)
+    recv0 = jnp.zeros_like(xs[0])
+    (outputs, _), _ = lax.scan(tick, (outputs, recv0),
+                               jnp.arange(n_ticks))
+    # broadcast the last stage's outputs to every device
+    y = lax.psum(jnp.where(me == s - 1, outputs, 0.0), axis_name)
+    return y.reshape(x.shape)
+
+
+def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
+                           pipe_axis="pipe", n_microbatches=4):
+    """Global entry: ``stacked_params`` has a leading stage axis [S, ...]
+    on every leaf, sharded over ``pipe_axis`` so each device keeps only
+    its stage; ``x`` replicates.  jit/grad-composable."""
+    pspec = jax.tree_util.tree_map(lambda _: P(pipe_axis), stacked_params)
+
+    def fn(params, xs):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pipeline_apply(stage_fn, local, xs, pipe_axis,
+                              n_microbatches)
+
+    return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+                     check_vma=False)(stacked_params, x)

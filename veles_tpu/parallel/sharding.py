@@ -33,21 +33,70 @@ def param_spec(shape, mesh_cfg):
     return P()
 
 
-def shard_params(params, mesh_cfg):
-    """device_put a {layer: {name: array}} pytree with model-axis sharding."""
+def _safe_spec(shape, spec, mesh_cfg):
+    """Keep an override spec only where the named dims divide evenly;
+    otherwise replicate (correctness never depends on divisibility)."""
+    if spec is None:
+        return param_spec(shape, mesh_cfg)
+    entries = tuple(spec)
+    if len(entries) > len(shape):
+        return P()
+    for dim, axis in enumerate(entries):
+        if axis is None:
+            continue
+        size = mesh_cfg.mesh.shape.get(axis, 1)
+        if size > 1 and shape[dim] % size:
+            return P()
+    return spec
+
+
+def _specs_tree(tree, overrides, mesh_cfg):
+    """Spec pytree for ``tree``.  ``overrides`` maps a dict key (layer
+    name, at any nesting level — the velocity tree nests layers under
+    slot names) to either a PartitionSpec applied to every leaf below it,
+    or a partial dict mirroring the subtree (missing keys fall back to
+    the default model-axis rule)."""
+    def apply_override(sub, ov):
+        if isinstance(ov, P):
+            return jax.tree_util.tree_map(
+                lambda x: _safe_spec(x.shape, ov, mesh_cfg), sub)
+        if isinstance(ov, dict):
+            if not isinstance(sub, dict):
+                raise TypeError("override dict against non-dict params")
+            return {k: (apply_override(v, ov[k]) if k in ov
+                        and ov[k] is not None
+                        else _specs_tree(v, overrides, mesh_cfg))
+                    for k, v in sub.items()}
+        return jax.tree_util.tree_map(
+            lambda x: _safe_spec(x.shape, ov, mesh_cfg), sub)
+
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            ov = (overrides or {}).get(k)
+            out[k] = (apply_override(v, ov) if ov is not None
+                      else _specs_tree(v, overrides, mesh_cfg))
+        return out
+    return param_spec(tree.shape, mesh_cfg)
+
+
+def shard_params(params, mesh_cfg, overrides=None):
+    """device_put a {layer: {name: array}} pytree.  Default rule:
+    model-axis tensor parallelism; ``overrides`` (from
+    Layer.param_partition_specs) shard e.g. expert banks over 'expert'
+    and pipeline stages over 'pipe'."""
     mesh = mesh_cfg.mesh
-
-    def place(x):
-        return jax.device_put(
-            x, NamedSharding(mesh, param_spec(x.shape, mesh_cfg)))
-
-    return jax.tree_util.tree_map(place, params)
-
-
-def param_shardings(params, mesh_cfg):
-    mesh = mesh_cfg.mesh
+    specs = _specs_tree(params, overrides, mesh_cfg)
     return jax.tree_util.tree_map(
-        lambda x: NamedSharding(mesh, param_spec(x.shape, mesh_cfg)), params)
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def param_shardings(params, mesh_cfg, overrides=None):
+    mesh = mesh_cfg.mesh
+    specs = _specs_tree(params, overrides, mesh_cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: NamedSharding(mesh, s), params, specs)
 
 
 def replicate(x, mesh_cfg):
