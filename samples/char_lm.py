@@ -1,0 +1,37 @@
+"""Sample workflow: tiny causal character LM (transformer decoder) on a
+synthetic repeated-pattern corpus.  Demonstrates the sequence stack
+(embedding, learned positions, causal transformer blocks, loss="lm").
+
+    python -m veles_tpu samples/char_lm.py --backend cpu \
+        --config-list root.char_lm.max_epochs=3
+"""
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import transformer_lm
+
+
+def run(load, main):
+    cfg = root.char_lm
+    text = (b"the quick brown fox jumps over the lazy dog. " * 64)
+    seq = cfg.get("seq_len", 32)
+    n = len(text) // seq
+    tokens = np.frombuffer(text[:n * seq], np.uint8).reshape(n, seq)
+    tokens = tokens.astype(np.int32)
+    n_valid = max(1, n // 10)
+    loader = FullBatchLoader(
+        None, data=tokens, labels=tokens,
+        minibatch_size=cfg.get("minibatch_size", 16),
+        class_lengths=[0, n_valid, n - n_valid])
+    load(StandardWorkflow,
+         layers=transformer_lm(vocab_size=256,
+                               d_model=cfg.get("d_model", 32),
+                               n_heads=4, n_layers=2,
+                               lr=cfg.get("learning_rate", 0.003)),
+         loader=loader, loss="lm",
+         decision_config={"max_epochs": cfg.get("max_epochs", 10)},
+         name="char-lm")
+    main()

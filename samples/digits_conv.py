@@ -1,0 +1,37 @@
+"""Sample workflow: small convnet on sklearn digits (the cifar_caffe
+shape scaled to 8x8 inputs).  Run:
+
+    python -m veles_tpu samples/digits_conv.py --backend cpu \
+        --config-list root.digits_conv.max_epochs=5
+"""
+
+import numpy as np
+from sklearn.datasets import load_digits
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+
+
+def run(load, main):
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32).reshape(-1, 8, 8, 1)
+    y = d.target.astype(np.int32)
+    cfg = root.digits_conv
+    lr = cfg.get("learning_rate", 0.02)
+    gd = {"learning_rate": lr, "gradient_moment": 0.9}
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                             class_lengths=[0, 297, 1500])
+    load(StandardWorkflow,
+         layers=[
+             dict({"type": "conv_relu", "n_kernels": 16, "kx": 3,
+                   "ky": 3}, **gd),
+             {"type": "max_pooling", "kx": 2, "ky": 2},
+             dict({"type": "all2all_tanh", "output_sample_shape": 64},
+                  **gd),
+             dict({"type": "softmax", "output_sample_shape": 10}, **gd),
+         ],
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 25)},
+         name="digits-conv")
+    main()

@@ -1,0 +1,25 @@
+"""Sample workflow: Kohonen self-organizing map on sklearn digits
+(ref the reference's Kohonen engine, manualrst_veles_algorithms.rst:72-84).
+
+    python -m veles_tpu samples/digits_kohonen.py --backend cpu
+"""
+
+import numpy as np
+from sklearn.datasets import load_digits
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.kohonen import KohonenWorkflow
+
+
+def run(load, main):
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    cfg = root.digits_kohonen
+    loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                             class_lengths=[0, 0, len(x)])
+    load(KohonenWorkflow, loader=loader,
+         sx=cfg.get("sx", 8), sy=cfg.get("sy", 8),
+         n_epochs=cfg.get("n_epochs", 5),
+         name="digits-kohonen")
+    main()

@@ -1,0 +1,74 @@
+"""CLI end-to-end: the `python -m veles_tpu workflow.py config.py`
+contract, config layering, result files, and package export — run as real
+subprocesses against the shipped samples."""
+
+import json
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(args, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu"] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+class TestCLI:
+    def test_digits_mlp_sample_trains_and_writes_results(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu", "--random-seed", "5",
+                  "--config-list", "root.digits.max_epochs=2",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = json.load(open(out))
+        assert res["epochs"] == 2
+        assert res["best_metric"] is not None
+
+    def test_export_flag_writes_package(self, tmp_path):
+        pkg = str(tmp_path / "model.zip")
+        r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.digits.max_epochs=1",
+                  "--export", pkg])
+        assert r.returncode == 0, r.stderr[-2000:]
+        with zipfile.ZipFile(pkg) as zf:
+            assert "contents.json" in zf.namelist()
+
+    def test_char_lm_sample(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/char_lm.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.char_lm.max_epochs=1",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
+
+    def test_kohonen_sample(self):
+        r = _cli(["samples/digits_kohonen.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.digits_kohonen.n_epochs=1"])
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    def test_conv_sample(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/digits_conv.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.digits_conv.max_epochs=1",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
+
+    def test_missing_run_contract_errors(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        r = _cli([str(bad), "--backend", "cpu"])
+        assert r.returncode != 0
+        assert "run(load, main)" in r.stderr + r.stdout
