@@ -134,6 +134,52 @@ void Unit::Execute(const float* x, float* y, int batch) const {
                 Activate(acc, act);
           }
     }
+  } else if (StartsWith(type, "deconv")) {
+    // transposed conv, gather form over the stride-dilated input
+    // (matches lax.conv_transpose VALID: out = (in-1)*s + k)
+    int ci = in.c, co = out.c;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int oy = 0; oy < out.h; ++oy)
+        for (int ox = 0; ox < out.w; ++ox)
+          for (int oc = 0; oc < co; ++oc) {
+            float acc = has_bias ? bias.data[oc] : 0.f;
+            for (int fy = 0; fy < ky; ++fy) {
+              int ay = oy + fy - (ky - 1);
+              if (ay < 0 || ay % sy) continue;
+              int iy = ay / sy;
+              if (iy >= in.h) continue;
+              for (int fx = 0; fx < kx; ++fx) {
+                int ax = ox + fx - (kx - 1);
+                if (ax < 0 || ax % sx) continue;
+                int ix = ax / sx;
+                if (ix >= in.w) continue;
+                const float* xp =
+                    xb + (static_cast<size_t>(iy) * in.w + ix) * ci;
+                const float* wp = &weights.data[
+                    ((static_cast<size_t>(fy) * kx + fx) * ci) * co + oc];
+                for (int icc = 0; icc < ci; ++icc)
+                  acc += xp[icc] * wp[static_cast<size_t>(icc) * co];
+              }
+            }
+            yb[(static_cast<size_t>(oy) * out.w + ox) * co + oc] =
+                Activate(acc, act);
+          }
+    }
+  } else if (type == "depooling") {
+    // nearest-neighbor upsample by the window (decoder half of pooled
+    // autoencoders)
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int oy = 0; oy < out.h; ++oy)
+        for (int ox = 0; ox < out.w; ++ox)
+          std::memcpy(
+              yb + (static_cast<size_t>(oy) * out.w + ox) * in.c,
+              xb + (static_cast<size_t>(oy / ky) * in.w + ox / kx) * in.c,
+              sizeof(float) * in.c);
+    }
   } else if (type == "max_pooling" || type == "avg_pooling" ||
              type == "maxabs_pooling") {
     for (int b = 0; b < batch; ++b) {

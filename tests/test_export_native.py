@@ -131,3 +131,39 @@ class TestNativeRuntime:
         with pytest.raises(ValueError, match="input features"):
             native(np.zeros((2, 10), np.float32))
         native.close()
+
+
+AE_LAYERS = [
+    {"type": "conv_relu", "n_kernels": 4, "kx": 3, "ky": 3,
+     "learning_rate": 0.05, "gradient_moment": 0.9},
+    {"type": "max_pooling", "kx": 2, "ky": 2},
+    {"type": "depooling", "kx": 2, "ky": 2},
+    {"type": "deconv", "n_kernels": 1, "kx": 3, "ky": 3,
+     "learning_rate": 0.05, "gradient_moment": 0.9},
+]
+
+
+@pytest.mark.skipif(not HAS_GXX, reason="no g++ toolchain")
+class TestNativeDeconv:
+    def test_conv_autoencoder_native_matches_jax(self, tmp_path):
+        """The decoder half (depooling + transposed conv) must serve
+        natively — the exported conv AE round-trips."""
+        from veles_tpu.services.native import NativeWorkflow
+        prng.seed_all(19)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32).reshape(-1, 8, 8, 1)
+        loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                 class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(layers=AE_LAYERS, loader=loader, loss="mse",
+                              decision_config={"max_epochs": 2},
+                              name="ae-export")
+        wf.initialize()
+        wf.run()
+        path = str(tmp_path / "ae.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:16])).reshape(16, -1)
+        got = native(x[:16].reshape(16, -1))
+        np.testing.assert_allclose(got, want, atol=1e-2)
+        native.close()

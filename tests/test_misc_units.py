@@ -157,3 +157,42 @@ class TestFoundationHelpers:
         assert all(v >= 0 for v in per_device.values())
         assert w.peak >= keep.nbytes
         assert isinstance(Watcher.runtime_stats(), dict)
+
+
+class TestGraphSurgeryAndHttpImport:
+    def test_change_unit_relinks(self):
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="surgery")
+        a = TrivialUnit(wf, name="a")
+        b = TrivialUnit(wf, name="b")
+        c = TrivialUnit(wf, name="c")
+        b.link_from(a)
+        c.link_from(b)
+        d = TrivialUnit(wf, name="d")
+        wf.change_unit(b, d)
+        assert a in d.links_from and d in a.links_to
+        assert d in c.links_from and b not in c.links_from
+        assert not b.links_from and not b.links_to
+
+    def test_snapshot_import_over_http(self, tmp_path):
+        import gzip
+        import pickle
+        import threading
+        from functools import partial
+        from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+        from veles_tpu.services.snapshotter import SnapshotterBase
+
+        with gzip.open(tmp_path / "snap.pickle.gz", "wb") as f:
+            pickle.dump({"epoch": 9}, f)
+        handler = partial(SimpleHTTPRequestHandler,
+                          directory=str(tmp_path))
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = "http://127.0.0.1:%d/snap.pickle.gz" % \
+                httpd.server_address[1]
+            state = SnapshotterBase.import_(url)
+            assert state["epoch"] == 9
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

@@ -94,8 +94,36 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
 
     @staticmethod
     def import_(path):
-        """Load a snapshot dict from file (ref SnapshotterToFile.import_,
-        snapshotter.py:412; follows the _current symlink)."""
+        """Load a snapshot dict from a file or an http(s) URL (ref
+        SnapshotterToFile.import_ snapshotter.py:412 and the http import
+        path __main__.py:539-589; follows the _current symlink)."""
+        tmp_path = None
+        if path.startswith(("http://", "https://")):
+            import logging
+            import tempfile
+            import urllib.request
+            # snapshots are pickles — loading one executes code.  Only
+            # resume from hosts you control (the reference had the same
+            # property for its http import path).
+            logging.getLogger("Snapshotter").warning(
+                "loading remote snapshot %s — pickle import runs code; "
+                "only use trusted%s hosts", path,
+                "" if path.startswith("https://") else " (and https)")
+            base = os.path.basename(path.split("?", 1)[0])
+            suffix = base[base.find("."):] if "." in base else ".pickle"
+            with urllib.request.urlopen(path) as resp, \
+                    tempfile.NamedTemporaryFile(suffix=suffix,
+                                                delete=False) as tmp:
+                tmp.write(resp.read())
+                tmp_path = path = tmp.name
+        try:
+            return SnapshotterBase._import_file(path)
+        finally:
+            if tmp_path is not None:
+                os.unlink(tmp_path)
+
+    @staticmethod
+    def _import_file(path):
         real = os.path.realpath(path)
         for codec, (_, opener, ext) in CODECS.items():
             if real.endswith(".pickle" + ext) and (ext or

@@ -171,6 +171,24 @@ class Workflow(Container):
         return rows
 
     # ---------------------------------------------------------------- results
+    def change_unit(self, old, new):
+        """Graph surgery: splice ``new`` into ``old``'s place — control
+        links in and out move over, gates transfer (ref Workflow.change_unit
+        workflow.py:973, used to swap units in restored/derived
+        workflows)."""
+        for pred in list(old.links_from):
+            new.link_from(pred)
+            old.unlink_from(pred)
+        for succ in list(old.links_to):
+            succ.link_from(new)
+            succ.unlink_from(old)
+        new.gate_block = old.gate_block
+        new.gate_skip = old.gate_skip
+        new.ignores_gate = old.ignores_gate
+        self.del_ref(old)       # fully orphan it: no init/stats/graph
+        old.workflow = None
+        return new
+
     def computing_power(self):
         """Benchmarked device throughput, re-measured at most every 120 s
         (ref AcceleratedWorkflow.computing_power,
