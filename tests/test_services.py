@@ -157,3 +157,38 @@ class TestCLI:
             base + ["--config-list", "root.digits.max_epochs=2"],
             capture_output=True, text=True, timeout=300, cwd=cwd)
         assert p1.returncode == 0, p1.stderr[-2000:]
+
+
+class TestWebFrontendEndpoint:
+    def test_frontend_page_served(self):
+        import urllib.request
+        from veles_tpu.services.web_status import WebStatusServer
+        srv = WebStatusServer(port=0)
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/frontend" % srv.port) as r:
+                html = r.read().decode()
+            assert "command composer" in html and "random_seed" in html
+        finally:
+            srv.stop()
+
+
+class TestProfileFlag:
+    def test_cli_profile_writes_trace(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = str(tmp_path / "trace")
+        r = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
+             "--backend", "cpu", "--random-seed", "3",
+             "--config-list", "root.digits.max_epochs=1",
+             "--profile", out],
+            cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        found = [f for _, _, fs in os.walk(out) for f in fs]
+        assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))
+                   for f in found), found

@@ -56,6 +56,11 @@ class Main(object):
                        metavar="PORT", help="launch the status dashboard")
         p.add_argument("--backend", default=None,
                        help="cpu|tpu|<platform> override")
+        p.add_argument("--profile", default=None, metavar="DIR",
+                       help="capture a jax/xplane profiler trace of the "
+                       "run into DIR (view with tensorboard or xprof; "
+                       "the TPU equivalent of the reference's per-unit "
+                       "timing + event timeline)")
         p.add_argument("--verbose", "-v", action="count", default=0)
         return p
 
@@ -101,11 +106,22 @@ class Main(object):
             wf.initialize(**kwargs)
             if self._pending_snapshot is not None:
                 wf.restore(self._pending_snapshot)
-            if args.test:
-                stats = wf.evaluate()
-                print(json.dumps({"test": stats}, indent=2))
-            else:
-                wf.run()
+            profiling = False
+            if args.profile:
+                import jax
+                jax.profiler.start_trace(args.profile)
+                profiling = True
+            try:
+                if args.test:
+                    stats = wf.evaluate()
+                    print(json.dumps({"test": stats}, indent=2))
+                else:
+                    wf.run()
+            finally:
+                if profiling:
+                    import jax
+                    jax.profiler.stop_trace()
+                    print("profiler trace -> %s" % args.profile)
             if args.result_file:
                 wf.write_results(args.result_file)
             wf.print_stats()

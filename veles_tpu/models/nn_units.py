@@ -270,6 +270,14 @@ class StagedTrainer(Unit):
 
     # ------------------------------------------------------------- hot loop
     def run(self):
+        # free when no trace is active; under --profile each step shows up
+        # as a named region in the xplane timeline (ref per-unit timing,
+        # units.py:805-817 → SURVEY §5 "TPU equivalent: jax profiler")
+        with jax.profiler.StepTraceAnnotation("veles_step",
+                                              step_num=self._step_counter):
+            self._run_step()
+
+    def _run_step(self):
         loader = self.loader
         if loader.carries_data:
             cls = loader.minibatch_class

@@ -83,6 +83,12 @@ class WebStatusServer(Logger):
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
                                                default=str).encode())
+                elif self.path == "/frontend":
+                    # the command-composer page, generated live from the
+                    # CLI arg registry (ref --frontend, launcher.py:199-267)
+                    from veles_tpu.scripts import generate_frontend as gf
+                    page = gf.render(gf.describe_parser(gf._main_parser()))
+                    self._send(200, page.encode(), "text/html")
                 else:
                     self.send_error(404)
 
