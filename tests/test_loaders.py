@@ -194,3 +194,105 @@ class TestDataCarryingIntegration:
         trainer.run()   # TEST class -> eval step, no crash
         stats = trainer.read_class_stats(TEST)
         assert stats["count"] == 2
+
+
+class TestImageBreadth:
+    @staticmethod
+    def _make_images(tmp_path, per_class=3):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        files = {}
+        for cls_name in ("cats", "dogs"):
+            d = tmp_path / "train" / cls_name
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                arr = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(d / ("%d.png" % i))
+            files[cls_name] = d
+        return files
+
+    def test_decode_transforms(self, tmp_path):
+        from PIL import Image
+        from veles_tpu.loader.image import decode_image
+        arr = np.zeros((8, 8, 3), np.uint8)
+        arr[:, :4] = 255
+        p = str(tmp_path / "half.png")
+        Image.fromarray(arr).save(p)
+        plain = decode_image(p)
+        assert plain.shape == (8, 8, 3)
+        mirrored = decode_image(p, mirror=True)
+        np.testing.assert_allclose(mirrored[:, ::-1], plain)
+        rot = decode_image(p, rotation=90)
+        assert rot.shape == (8, 8, 3)
+        hsv = decode_image(p, color_space="HSV")
+        assert hsv.shape == (8, 8, 3)
+        gray = decode_image(p, color_space="L")
+        assert gray.shape == (8, 8, 1)
+
+    def test_augmentation_multiplies_train_class(self, tmp_path):
+        from veles_tpu.loader.image import FullBatchImageLoader
+        self._make_images(tmp_path)
+        loader = FullBatchImageLoader(
+            None, train_paths=str(tmp_path / "train"), size=(8, 8),
+            minibatch_size=4,
+            augment={"mirror": True, "rotations": [15]})
+        loader.initialize()
+        # 6 originals x (1 + 1 rotation) x2 mirror = 24
+        assert loader.class_lengths == [0, 0, 24]
+        assert loader.original_labels.shape == (24,)
+        assert set(loader.label_names) == {"cats", "dogs"}
+
+    def test_file_list_loader(self, tmp_path):
+        from veles_tpu.loader.image import FileListImageLoader
+        self._make_images(tmp_path)
+        lines = []
+        for label, cls_name in enumerate(("cats", "dogs")):
+            for i in range(3):
+                lines.append("train/%s/%d.png %d" % (cls_name, i, label))
+        lst = tmp_path / "train.lst"
+        lst.write_text("\n".join(lines) + "\n# comment\n")
+        loader = FileListImageLoader(
+            None, train_list=str(lst), size=(8, 8), minibatch_size=2)
+        loader.initialize()
+        assert loader.class_lengths == [0, 0, 6]
+        np.testing.assert_array_equal(loader.original_labels,
+                                      [0, 0, 0, 1, 1, 1])
+
+    def test_image_mse_loader_pairs_targets(self, tmp_path):
+        from veles_tpu.loader.image import ImageMSELoader
+        self._make_images(tmp_path)
+        # identity pairing (targets = inputs): augmented variants must get
+        # the SAME transform on both sides, so data == targets exactly
+        loader = ImageMSELoader(
+            None, train_paths=str(tmp_path / "train"),
+            target_paths=str(tmp_path / "train"), size=(8, 8),
+            minibatch_size=2, augment={"mirror": True})
+        loader.initialize()
+        assert loader.original_targets.shape == loader.original_data.shape
+        np.testing.assert_allclose(loader.original_targets,
+                                   loader.original_data)
+
+    def test_image_mse_loader_rejects_unpairable(self, tmp_path):
+        from veles_tpu.loader.image import ImageMSELoader
+        self._make_images(tmp_path)
+        with pytest.raises(ValueError, match="target_paths"):
+            ImageMSELoader(None, train_paths=str(tmp_path / "train"))
+        loader = ImageMSELoader(
+            None, train_paths=str(tmp_path / "train"),
+            target_paths=str(tmp_path / "train" / "cats"), size=(8, 8),
+            minibatch_size=2)
+        with pytest.raises(ValueError, match="1:1"):
+            loader.initialize()
+
+    def test_file_list_space_in_filename(self, tmp_path):
+        from PIL import Image
+        from veles_tpu.loader.image import FileListImageLoader
+        arr = np.zeros((4, 4, 3), np.uint8)
+        (tmp_path / "imgs").mkdir()
+        Image.fromarray(arr).save(tmp_path / "imgs" / "my image.png")
+        lst = tmp_path / "l.lst"
+        lst.write_text("imgs/my image.png 3\nimgs/my image.png\n")
+        loader = FileListImageLoader(None, train_list=str(lst),
+                                     size=(4, 4), minibatch_size=1)
+        loader.initialize()
+        np.testing.assert_array_equal(loader.original_labels, [3, 0])

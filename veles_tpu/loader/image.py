@@ -16,19 +16,28 @@ from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.loader.normalization import make_normalizer
 
 
-def decode_image(path, size=None, grayscale=False, crop=None):
+def decode_image(path, size=None, grayscale=False, crop=None,
+                 color_space="RGB", rotation=0.0, mirror=False):
     """Load one image file → float32 HWC array in [0, 1]
-    (ref ImageLoader decode/scale/crop, loader/image.py:106)."""
+    (ref ImageLoader decode/scale/crop/rotation/color conversion,
+    loader/image.py:106).
+
+    ``color_space``: "RGB", "HSV", "YCbCr", or "L"; ``rotation`` in
+    degrees (bilinear, same canvas); ``mirror`` flips horizontally."""
     from PIL import Image
     img = Image.open(path)
-    img = img.convert("L" if grayscale else "RGB")
+    img = img.convert("L" if grayscale else color_space)
     if crop is not None:
         left, top, w, h = crop
         img = img.crop((left, top, left + w, top + h))
+    if rotation:
+        img = img.rotate(rotation, Image.BILINEAR)
+    if mirror:
+        img = img.transpose(Image.FLIP_LEFT_RIGHT)
     if size is not None:
         img = img.resize((size[1], size[0]), Image.BILINEAR)
     arr = np.asarray(img, np.float32) / 255.0
-    if grayscale:
+    if arr.ndim == 2:
         arr = arr[:, :, None]
     return arr
 
@@ -69,40 +78,172 @@ class FullBatchImageLoader(FullBatchLoader):
 
     def __init__(self, workflow, train_paths=None, valid_paths=None,
                  test_paths=None, size=(32, 32), grayscale=False,
-                 crop=None, normalization="none", labeled=True, **kwargs):
+                 crop=None, color_space="RGB", normalization="none",
+                 labeled=True, augment=None, **kwargs):
         super(FullBatchImageLoader, self).__init__(workflow, **kwargs)
         self.paths = {TRAIN: train_paths, VALID: valid_paths,
                       TEST: test_paths}
         self.size = size
         self.grayscale = grayscale
         self.crop = crop
+        self.color_space = color_space
         self.labeled = labeled
+        #: {"mirror": True, "rotations": [-10, 10]} — each variant ADDS a
+        #: copy of the train class (ref the reference's crop/rotation/
+        #: mirror augmentation in ImageLoader)
+        self.augment = augment or {}
         self.normalizer = make_normalizer(normalization) \
             if isinstance(normalization, str) else normalization
         self.label_names = None
 
-    def load_data(self):
-        images, labels = [], []
-        lengths = [0, 0, 0]
+    def _decode(self, path, rotation=0.0, mirror=False):
+        return decode_image(path, self.size, self.grayscale, self.crop,
+                            self.color_space, rotation, mirror)
+
+    def _variants(self, cls):
+        """(rotation, mirror) decode variants for a class — augmentation
+        applies to TRAIN only."""
+        variants = [(0.0, False)]
+        if cls == TRAIN:
+            for rot in self.augment.get("rotations", ()):
+                variants.append((float(rot), False))
+            if self.augment.get("mirror"):
+                variants += [(rot, True) for rot, _ in list(variants)]
+        return variants
+
+    def _scan_classes(self):
         all_files = {}
         for cls in (TEST, VALID, TRAIN):
             pats = self.paths[cls]
             all_files[cls] = scan_files(pats) if pats else []
-            lengths[cls] = len(all_files[cls])
-        ordered = all_files[TEST] + all_files[VALID] + all_files[TRAIN]
-        if not ordered:
+        if not any(all_files.values()):
             raise ValueError("no image files matched")
+        return all_files
+
+    def _decode_classes(self, all_files, path_map=None):
+        """Decode every file per class with its augmentation variants.
+        ``path_map`` substitutes a paired file (targets) while keeping the
+        exact same ordering/variants as the input pass."""
+        ordered = all_files[TEST] + all_files[VALID] + all_files[TRAIN]
+        label_of = {}
         if self.labeled:
-            labels_arr, self.label_names = auto_label(ordered)
-        for f in ordered:
-            images.append(decode_image(f, self.size, self.grayscale,
-                                       self.crop))
+            base_labels, self.label_names = auto_label(ordered)
+            label_of = dict(zip(ordered, base_labels))
+        images, labels = [], []
+        lengths = [0, 0, 0]
+        for cls in (TEST, VALID, TRAIN):
+            for rot, mir in self._variants(cls):
+                for f in all_files[cls]:
+                    src = path_map[f] if path_map is not None else f
+                    images.append(self._decode(src, rot, mir))
+                    if self.labeled:
+                        labels.append(label_of[f])
+                lengths[cls] += len(all_files[cls])
+        return images, labels, lengths
+
+    def _finalize(self, images, labels, lengths):
         data = np.stack(images)
         self.normalizer.analyze(data)
         data = self.normalizer.normalize(data).reshape(data.shape)
         self.original_data = data
-        self.original_labels = labels_arr if self.labeled else None
+        self.original_labels = (np.asarray(labels, np.int32)
+                                if self.labeled and labels else None)
         self.class_lengths = lengths
-        self.info("loaded %d images %s, %d classes", len(ordered),
-                  data.shape[1:],
-                  len(self.label_names) if self.labeled else 0)
+        n_classes = (len(self.label_names) if self.label_names
+                     else len(set(labels)) if labels else 0)
+        self.info("loaded %d images %s, %d classes", len(images),
+                  data.shape[1:], n_classes)
+
+    def load_data(self):
+        images, labels, lengths = self._decode_classes(self._scan_classes())
+        self._finalize(images, labels, lengths)
+
+
+class FileListImageLoader(FullBatchImageLoader):
+    """Images from explicit list files — one ``path label`` pair per line
+    (ref FileListImageLoader, loader/file_loader.py).  Paths resolve
+    relative to the list file."""
+
+    MAPPING = "file_list_image"
+
+    def __init__(self, workflow, train_list=None, valid_list=None,
+                 test_list=None, **kwargs):
+        kwargs.setdefault("labeled", True)
+        super(FileListImageLoader, self).__init__(workflow, **kwargs)
+        self.lists = {TRAIN: train_list, VALID: valid_list,
+                      TEST: test_list}
+
+    @staticmethod
+    def _read_list(path):
+        base = os.path.dirname(os.path.abspath(path))
+        entries = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                # "path label" with label an int; a trailing token that is
+                # not an int belongs to a filename containing spaces
+                parts = line.rsplit(None, 1)
+                if len(parts) == 2:
+                    try:
+                        label = int(parts[1])
+                        fname = parts[0]
+                    except ValueError:
+                        fname, label = line, 0
+                else:
+                    fname, label = line, 0
+                if not os.path.isabs(fname):
+                    fname = os.path.join(base, fname)
+                entries.append((fname, label))
+        return entries
+
+    def load_data(self):
+        images, labels = [], []
+        lengths = [0, 0, 0]
+        for cls in (TEST, VALID, TRAIN):
+            if not self.lists[cls]:
+                continue
+            entries = self._read_list(self.lists[cls])
+            for rot, mir in self._variants(cls):
+                for fname, label in entries:
+                    images.append(self._decode(fname, rot, mir))
+                    labels.append(label)
+                lengths[cls] += len(entries)
+        if not images:
+            raise ValueError("no entries in the list files")
+        self._finalize(images, labels, lengths)
+
+
+class ImageMSELoader(FullBatchImageLoader):
+    """Paired input/target images for regression/AE training (ref
+    loader/image_mse.py).  Input file i pairs with target file i (both in
+    sorted scan order); targets decode with the SAME augmentation
+    variants and are normalized by the SAME fitted normalizer, so
+    prediction and target live in one value space
+    (``original_targets``, loss="mse")."""
+
+    MAPPING = "image_mse"
+
+    def __init__(self, workflow, target_paths=None, **kwargs):
+        kwargs.setdefault("labeled", False)
+        super(ImageMSELoader, self).__init__(workflow, **kwargs)
+        if not target_paths:
+            raise ValueError("ImageMSELoader needs target_paths=")
+        self.target_paths = target_paths
+
+    def load_data(self):
+        files = self._scan_classes()
+        inputs_flat = files[TEST] + files[VALID] + files[TRAIN]
+        target_files = scan_files(self.target_paths)
+        if len(target_files) != len(inputs_flat):
+            raise ValueError(
+                "%d target files cannot pair %d input files 1:1"
+                % (len(target_files), len(inputs_flat)))
+        path_map = dict(zip(inputs_flat, target_files))
+        images, labels, lengths = self._decode_classes(files)
+        self._finalize(images, labels, lengths)
+        t_images, _, _ = self._decode_classes(files, path_map=path_map)
+        targets = np.stack(t_images)
+        self.original_targets = self.normalizer.normalize(
+            targets).reshape(targets.shape)
