@@ -1,0 +1,92 @@
+"""HDFS text loader (ref veles/loader/hdfs_loader.py:48 — numeric text
+rows streamed from a Hadoop filesystem).
+
+Built on ``pyarrow.fs``: an ``hdfs://host:port/path`` URI uses
+HadoopFileSystem (needs libhdfs at runtime — gated with a clear error),
+anything else resolves through LocalFileSystem, which keeps the parsing
+and batching logic fully testable offline.  Rows are
+``v1<sep>v2<sep>...<sep>label`` with the label column optional."""
+
+import os
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+CLASS_KEYS = {"test": TEST, "validation": VALID, "train": TRAIN}
+
+
+def open_fs(uri):
+    """→ (pyarrow FileSystem, path)."""
+    from pyarrow import fs
+    if uri.startswith("hdfs://"):
+        rest = uri[len("hdfs://"):]
+        host, _, path = rest.partition("/")
+        hostname, _, port = host.partition(":")
+        try:
+            return (fs.HadoopFileSystem(hostname,
+                                        int(port) if port else 8020),
+                    "/" + path)
+        except Exception as e:  # noqa: BLE001 — no libhdfs in this image
+            raise RuntimeError(
+                "hdfs:// needs libhdfs available to pyarrow "
+                "(HadoopFileSystem): %s" % e) from e
+    if uri.startswith("file://"):
+        uri = uri[len("file://"):]
+    return fs.LocalFileSystem(), os.path.abspath(uri)
+
+
+def read_rows(uri, separator=None, labeled=True):
+    """Parse one text file → (data [N, F] float32, labels [N] or None)."""
+    filesystem, path = open_fs(uri)
+    with filesystem.open_input_stream(path) as f:
+        text = f.read().decode()
+    data, labels = [], []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split(separator) if separator else line.replace(
+            ",", " ").split()
+        values = [float(p) for p in parts]
+        if labeled:
+            labels.append(int(values[-1]))
+            values = values[:-1]
+        data.append(values)
+    if not data:
+        raise ValueError("no rows in %s" % uri)
+    return (np.asarray(data, np.float32),
+            np.asarray(labels, np.int32) if labeled else None)
+
+
+class HDFSTextLoader(FullBatchLoader):
+    """:param files: {class_name: uri} (class_name in
+    test/validation/train)."""
+
+    MAPPING = "hdfs_text"
+
+    def __init__(self, workflow, files=None, separator=None, labeled=True,
+                 **kwargs):
+        super(HDFSTextLoader, self).__init__(workflow, **kwargs)
+        self.files = files or {}
+        self.separator = separator
+        self.labeled = labeled
+
+    def load_data(self):
+        datas = [None, None, None]
+        labels = [None, None, None]
+        lengths = [0, 0, 0]
+        for key, uri in self.files.items():
+            cls = CLASS_KEYS[key]
+            d, l = read_rows(uri, self.separator, self.labeled)
+            datas[cls], labels[cls] = d, l
+            lengths[cls] = len(d)
+        if sum(lengths) == 0:
+            raise ValueError("HDFSTextLoader: no files given")
+        present = [c for c in (TEST, VALID, TRAIN) if datas[c] is not None]
+        self.original_data = np.concatenate([datas[c] for c in present])
+        if self.labeled:
+            self.original_labels = np.concatenate(
+                [labels[c] for c in present])
+        self.class_lengths = lengths
