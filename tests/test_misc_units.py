@@ -174,7 +174,8 @@ class TestGraphSurgeryAndHttpImport:
         assert d in c.links_from and b not in c.links_from
         assert not b.links_from and not b.links_to
 
-    def test_snapshot_import_over_http(self, tmp_path):
+    def test_snapshot_import_over_http(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("VELES_ALLOW_REMOTE_SNAPSHOT", raising=False)
         import gzip
         import pickle
         import threading
@@ -191,8 +192,19 @@ class TestGraphSurgeryAndHttpImport:
         try:
             url = "http://127.0.0.1:%d/snap.pickle.gz" % \
                 httpd.server_address[1]
-            state = SnapshotterBase.import_(url)
+            with pytest.raises(PermissionError):
+                SnapshotterBase.import_(url)   # remote needs opt-in
+            state = SnapshotterBase.import_(url, allow_remote=True)
             assert state["epoch"] == 9
+            import hashlib
+            good = hashlib.sha256(
+                (tmp_path / "snap.pickle.gz").read_bytes()).hexdigest()
+            state = SnapshotterBase.import_(url, allow_remote=True,
+                                            expected_sha256=good)
+            assert state["epoch"] == 9
+            with pytest.raises(ValueError):
+                SnapshotterBase.import_(url, allow_remote=True,
+                                        expected_sha256="0" * 64)
         finally:
             httpd.shutdown()
             httpd.server_close()

@@ -60,6 +60,19 @@ class TestExpertParallel:
         np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_ref),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_rejects_indivisible_batch_and_experts(self):
+        params, x = _setup()
+        mesh = make_mesh({"expert": 8})
+        with pytest.raises(ValueError, match="batch"):
+            moe.moe_forward_sharded(params, x[:6], mesh, top_k=2)
+        bad = dict(params)
+        for k in ("w1", "b1", "w2", "b2"):
+            bad[k] = jnp.concatenate([params[k], params[k][:1]], axis=0)
+        bad["router"] = jnp.concatenate(
+            [params["router"], params["router"][:, :1]], axis=1)
+        with pytest.raises(ValueError, match="n_experts"):
+            moe.moe_forward_sharded(bad, x, mesh, top_k=2)
+
     def test_gradients_flow_through_sharded_path(self):
         params, x = _setup()
         mesh = make_mesh({"expert": 8})

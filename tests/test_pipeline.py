@@ -39,6 +39,28 @@ class TestPipelineSchedule:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("s,pipe", [(8, 4), (8, 2), (12, 4)])
+    def test_multiple_stages_per_device(self, s, pipe):
+        """n_blocks = k * pipe_size: each device runs its k local stages
+        sequentially (regression: earlier code silently ran only the
+        first local stage)."""
+        params = _stacked_params(s)
+        x = jnp.asarray(np.random.RandomState(4).randn(8, 8)
+                        .astype(np.float32))
+        ref = _sequential(params, x)
+        mesh = make_mesh({"pipe": pipe})
+        out = pipeline.pipeline_apply_sharded(_stage_fn, params, x, mesh,
+                                              n_microbatches=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-5, atol=5e-5)
+
+    def test_rejects_indivisible_stage_count(self):
+        params = _stacked_params(6)
+        x = jnp.zeros((8, 8), jnp.float32)
+        mesh = make_mesh({"pipe": 4})
+        with pytest.raises(ValueError, match="stage dim"):
+            pipeline.pipeline_apply_sharded(_stage_fn, params, x, mesh)
+
     def test_rejects_indivisible_microbatches(self):
         params = _stacked_params(4)
         x = jnp.zeros((10, 8), jnp.float32)

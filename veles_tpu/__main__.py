@@ -43,6 +43,11 @@ class Main(object):
         p.add_argument("--random-seed", type=int, default=None)
         p.add_argument("--snapshot", default=None,
                        help="resume from a snapshot file")
+        p.add_argument("--allow-remote-snapshot", action="store_true",
+                       help="opt in to importing --snapshot from an "
+                       "http(s) URL (pickle import runs code)")
+        p.add_argument("--snapshot-sha256", default=None,
+                       help="expected sha256 of a remote --snapshot")
         p.add_argument("--test", action="store_true",
                        help="skip training; run forward on the loader's "
                        "test/validation set")
@@ -94,7 +99,9 @@ class Main(object):
                 from veles_tpu.services.snapshotter import SnapshotterBase
                 # initialize first so staged steps exist, then restore
                 self._pending_snapshot = SnapshotterBase.import_(
-                    args.snapshot)
+                    args.snapshot,
+                    allow_remote=args.allow_remote_snapshot,
+                    expected_sha256=args.snapshot_sha256)
             else:
                 self._pending_snapshot = None
             if web is not None:

@@ -217,8 +217,10 @@ class FileListImageLoader(FullBatchImageLoader):
 
 class ImageMSELoader(FullBatchImageLoader):
     """Paired input/target images for regression/AE training (ref
-    loader/image_mse.py).  Input file i pairs with target file i (both in
-    sorted scan order); targets decode with the SAME augmentation
+    loader/image_mse.py).  Inputs pair with targets by basename when that
+    is unambiguous (unique on both sides, every input basename present in
+    the target scan); otherwise both sides are paired positionally in one
+    flat sorted order.  Targets decode with the SAME augmentation
     variants and are normalized by the SAME fitted normalizer, so
     prediction and target live in one value space
     (``original_targets``, loss="mse")."""
@@ -240,7 +242,20 @@ class ImageMSELoader(FullBatchImageLoader):
             raise ValueError(
                 "%d target files cannot pair %d input files 1:1"
                 % (len(target_files), len(inputs_flat)))
-        path_map = dict(zip(inputs_flat, target_files))
+        # Pair by basename when unambiguous; otherwise pair both sides in
+        # one flat sorted order (inputs_flat is grouped per class split,
+        # whose concatenation need not match the flat sorted target scan).
+        by_name = {}
+        for t in target_files:
+            by_name.setdefault(os.path.basename(t), []).append(t)
+        input_names = [os.path.basename(f) for f in inputs_flat]
+        if (all(len(v) == 1 for v in by_name.values())
+                and len(set(input_names)) == len(input_names)
+                and all(n in by_name for n in input_names)):
+            path_map = {f: by_name[os.path.basename(f)][0]
+                        for f in inputs_flat}
+        else:
+            path_map = dict(zip(sorted(inputs_flat), target_files))
         images, labels, lengths = self._decode_classes(files)
         self._finalize(images, labels, lengths)
         t_images, _, _ = self._decode_classes(files, path_map=path_map)

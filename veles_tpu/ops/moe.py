@@ -143,6 +143,14 @@ def moe_forward_sharded(params, x, mesh, expert_axis="expert", top_k=2,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    axis_size = mesh.shape[expert_axis]
+    n_experts = params["w1"].shape[0]
+    if x.shape[0] % axis_size:
+        raise ValueError("batch %d not divisible by %s axis size %d"
+                         % (x.shape[0], expert_axis, axis_size))
+    if n_experts % axis_size:
+        raise ValueError("n_experts %d not divisible by %s axis size %d"
+                         % (n_experts, expert_axis, axis_size))
     e = P(expert_axis)
     param_specs = {"router": P(), "w1": e, "b1": e, "w2": e, "b2": e}
     xspec = P(expert_axis)          # batch dim sharded over the axis

@@ -63,13 +63,23 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
 def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
                            pipe_axis="pipe", n_microbatches=4):
     """Global entry: ``stacked_params`` has a leading stage axis [S, ...]
-    on every leaf, sharded over ``pipe_axis`` so each device keeps only
-    its stage; ``x`` replicates.  jit/grad-composable."""
+    on every leaf, sharded over ``pipe_axis``.  With S == pipe size each
+    device keeps one stage; with S == k * pipe size each device keeps k
+    consecutive stages and runs them as one scanned "superstage" (fewer
+    ICI hops, same math).  ``x`` replicates.  jit/grad-composable."""
+    pipe_size = mesh.shape[pipe_axis]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] % pipe_size:
+            raise ValueError(
+                "stacked stage dim %d not divisible by %s axis size %d"
+                % (leaf.shape[0], pipe_axis, pipe_size))
     pspec = jax.tree_util.tree_map(lambda _: P(pipe_axis), stacked_params)
 
     def fn(params, xs):
-        local = jax.tree_util.tree_map(lambda a: a[0], params)
-        return pipeline_apply(stage_fn, local, xs, pipe_axis,
+        def superstage(p, h):
+            return lax.scan(lambda hh, pk: (stage_fn(pk, hh), None),
+                            h, p)[0]
+        return pipeline_apply(superstage, params, xs, pipe_axis,
                               n_microbatches)
 
     return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),

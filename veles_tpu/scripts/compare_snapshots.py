@@ -26,9 +26,12 @@ def _leaves(obj, prefix=""):
         yield prefix or "/", obj
 
 
-def compare(path_a, path_b, threshold=0.0, out=sys.stdout):
-    a = dict(_leaves(SnapshotterBase.import_(path_a)))
-    b = dict(_leaves(SnapshotterBase.import_(path_b)))
+def compare(path_a, path_b, threshold=0.0, out=sys.stdout,
+            allow_remote=False):
+    a = dict(_leaves(SnapshotterBase.import_(path_a,
+                                             allow_remote=allow_remote)))
+    b = dict(_leaves(SnapshotterBase.import_(path_b,
+                                             allow_remote=allow_remote)))
     differs = False
     for path in sorted(set(a) | set(b)):
         if path not in a or path not in b:
@@ -67,8 +70,12 @@ def main(argv=None):
     p.add_argument("snapshot_b")
     p.add_argument("--threshold", type=float, default=0.0,
                    help="max tolerated abs elementwise diff")
+    p.add_argument("--allow-remote-snapshot", action="store_true",
+                   help="opt in to comparing http(s) snapshot URLs "
+                   "(pickle import runs code)")
     args = p.parse_args(argv)
-    return compare(args.snapshot_a, args.snapshot_b, args.threshold)
+    return compare(args.snapshot_a, args.snapshot_b, args.threshold,
+                   allow_remote=args.allow_remote_snapshot)
 
 
 if __name__ == "__main__":

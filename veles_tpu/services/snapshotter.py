@@ -93,30 +93,55 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         return path
 
     @staticmethod
-    def import_(path):
+    def import_(path, allow_remote=False, expected_sha256=None):
         """Load a snapshot dict from a file or an http(s) URL (ref
         SnapshotterToFile.import_ snapshotter.py:412 and the http import
-        path __main__.py:539-589; follows the _current symlink)."""
+        path __main__.py:539-589; follows the _current symlink).
+
+        Snapshots are pickles — loading one executes code.  Remote URLs
+        therefore require an explicit opt-in: ``allow_remote=True`` (CLI
+        ``--allow-remote-snapshot``) or ``VELES_ALLOW_REMOTE_SNAPSHOT=1``.
+        ``expected_sha256`` is verified (local or remote) before any
+        unpickling."""
+        import hashlib
         tmp_path = None
         if path.startswith(("http://", "https://")):
             import logging
             import tempfile
             import urllib.request
-            # snapshots are pickles — loading one executes code.  Only
-            # resume from hosts you control (the reference had the same
-            # property for its http import path).
+            if not (allow_remote
+                    or os.environ.get("VELES_ALLOW_REMOTE_SNAPSHOT") == "1"):
+                raise PermissionError(
+                    "remote snapshot import from %s refused: pickle import "
+                    "runs code.  Pass --allow-remote-snapshot (or set "
+                    "VELES_ALLOW_REMOTE_SNAPSHOT=1) to opt in." % path)
             logging.getLogger("Snapshotter").warning(
                 "loading remote snapshot %s — pickle import runs code; "
                 "only use trusted%s hosts", path,
                 "" if path.startswith("https://") else " (and https)")
             base = os.path.basename(path.split("?", 1)[0])
             suffix = base[base.find("."):] if "." in base else ".pickle"
-            with urllib.request.urlopen(path) as resp, \
-                    tempfile.NamedTemporaryFile(suffix=suffix,
-                                                delete=False) as tmp:
-                tmp.write(resp.read())
-                tmp_path = path = tmp.name
+            tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+            tmp_path = tmp.name
         try:
+            if tmp_path is not None:
+                with urllib.request.urlopen(path) as resp, tmp:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        tmp.write(chunk)
+                path = tmp_path
+            if expected_sha256 is not None:
+                h = hashlib.sha256()
+                with open(os.path.realpath(path), "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                digest = h.hexdigest()
+                if digest != expected_sha256.lower():
+                    raise ValueError(
+                        "snapshot sha256 mismatch: got %s, expected %s"
+                        % (digest, expected_sha256.lower()))
             return SnapshotterBase._import_file(path)
         finally:
             if tmp_path is not None:
