@@ -11,8 +11,9 @@ import zipfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _cli(args, timeout=420):
+def _cli(args, timeout=420, env_extra=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
     return subprocess.run(
         [sys.executable, "-m", "veles_tpu"] + args, cwd=REPO, env=env,
         capture_output=True, text=True, timeout=timeout)
@@ -70,3 +71,77 @@ class TestCLI:
         r = _cli([str(bad), "--backend", "cpu"])
         assert r.returncode != 0
         assert "run(load, main)" in r.stderr + r.stdout
+
+
+class TestCLIMeta:
+    """r2: the meta flags the reference's single CLI drives
+    (VERDICT #3 — ref veles/__main__.py:334-345, launcher.py:199-267)."""
+
+    def test_mesh_flag_runs_spmd(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu", "--random-seed", "5",
+                  "--mesh", "data=8",
+                  "--config-list", "root.digits.max_epochs=2",
+                  "root.digits.minibatch_size=96",
+                  "--result-file", out],
+                 env_extra={"XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=8"})
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = json.load(open(out))
+        assert res["epochs"] == 2
+        assert res["best_metric"] is not None
+
+    def test_mesh_flag_bad_spec(self):
+        r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
+                  "--mesh", "data"])
+        assert r.returncode != 0
+        assert "axis=size" in r.stderr
+
+    def test_optimize_genetics_over_range_config(self, tmp_path):
+        out = str(tmp_path / "opt.json")
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu", "--random-seed", "7",
+                  "--config-list", "root.digits.max_epochs=1",
+                  "root.digits.learning_rate=Range(0.05, 0.3)",
+                  "--optimize", "3:2", "--optimize-workers", "2",
+                  "--result-file", out], timeout=900)
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = json.load(open(out))["optimize"]
+        lr = res["best_config"]["root.digits.learning_rate"]
+        assert 0.05 <= lr <= 0.3
+        assert len(res["history"]) == 2
+        assert res["best_fitness"] > -1.0   # a real error rate, not -inf
+
+    def test_optimize_without_ranges_fails_clearly(self):
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu", "--optimize", "2:1"])
+        assert r.returncode != 0
+        assert "Range()" in r.stderr
+
+    def test_ensemble_train_then_test(self, tmp_path):
+        out = str(tmp_path / "ens.json")
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu",
+                  "--config-list", "root.digits.max_epochs=1",
+                  "--ensemble-train", "3:0.7", "--ensemble-workers", "2",
+                  "--result-file", out], timeout=900)
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = json.load(open(out))
+        assert res["n_models"] == 3
+        assert all("package" in m and os.path.exists(m["package"])
+                   for m in res["members"])
+        # members trained on distinct subsets -> distinct results
+        metrics = [m["result"]["best_metric"] for m in res["members"]]
+        assert len(set(metrics)) > 1
+
+        r2 = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                   "--backend", "cpu", "--random-seed", "5",
+                   "--config-list", "root.digits.max_epochs=1",
+                   "--ensemble-test", out], timeout=600)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        payload = json.loads(next(
+            ln for ln in r2.stdout.splitlines()
+            if ln.startswith('{"ensemble_test"')))
+        assert payload["ensemble_test"]["n_members"] == 3
+        assert 0.0 <= payload["ensemble_test"]["error"] < 0.5

@@ -61,6 +61,35 @@ class Main(object):
                        metavar="PORT", help="launch the status dashboard")
         p.add_argument("--backend", default=None,
                        help="cpu|tpu|<platform> override")
+        p.add_argument("--mesh", default=None, metavar="AXES",
+                       help="device mesh for SPMD training, e.g. "
+                       "'data=4,model=2' (-1 = all remaining devices); "
+                       "ref launcher node specs -n host/0:0x3")
+        p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                       help="jax.distributed coordinator address "
+                       "(multi-host SPMD; ref master -l flag)")
+        p.add_argument("--num-processes", type=int, default=None,
+                       help="total processes in the multi-host job")
+        p.add_argument("--process-id", type=int, default=None,
+                       help="this process's index (ref slave -m identity)")
+        p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",
+                       help="genetic hyperparameter search over Range() "
+                       "config leaves: population SIZE, GENS generations "
+                       "(ref veles --optimize, __main__.py:334-345)")
+        p.add_argument("--optimize-workers", type=int, default=1,
+                       help="concurrent fitness evaluations (each is its "
+                       "own training subprocess; >1 pins children to cpu)")
+        p.add_argument("--ensemble-train", default=None, metavar="N:RATIO",
+                       help="train N instances on random train subsets of "
+                       "RATIO (ref ensemble/model_workflow.py:137)")
+        p.add_argument("--ensemble-workers", type=int, default=1,
+                       help="concurrent member trainings (>1 pins "
+                       "children to cpu)")
+        p.add_argument("--ensemble-test", default=None,
+                       metavar="RESULTS.json",
+                       help="aggregate the members from an "
+                       "--ensemble-train results file: mean-probability "
+                       "vote on the eval set (ref --ensemble-test)")
         p.add_argument("--profile", default=None, metavar="DIR",
                        help="capture a jax/xplane profiler trace of the "
                        "run into DIR (view with tensorboard or xprof; "
@@ -81,6 +110,11 @@ class Main(object):
         if args.random_seed is not None:
             prng.seed_all(args.random_seed)
         self._apply_config(args)
+
+        if args.optimize:
+            return self._run_optimize(args)
+        if args.ensemble_train:
+            return self._run_ensemble_train(args)
 
         web = None
         if args.web_status is not None:
@@ -110,7 +144,8 @@ class Main(object):
 
         def main(**kwargs):
             wf = self.workflow
-            wf.initialize(**kwargs)
+            launcher = self._make_launcher(args, wf)
+            launcher.initialize(**kwargs)
             if self._pending_snapshot is not None:
                 wf.restore(self._pending_snapshot)
             profiling = False
@@ -122,13 +157,17 @@ class Main(object):
                 if args.test:
                     stats = wf.evaluate()
                     print(json.dumps({"test": stats}, indent=2))
+                elif args.ensemble_test:
+                    stats = self._ensemble_test(wf, args)
+                    print(json.dumps({"ensemble_test": stats}))
                 else:
-                    wf.run()
+                    launcher.run()
             finally:
                 if profiling:
                     import jax
                     jax.profiler.stop_trace()
                     print("profiler trace -> %s" % args.profile)
+                launcher.stop()
             if args.result_file:
                 wf.write_results(args.result_file)
             wf.print_stats()
@@ -146,12 +185,263 @@ class Main(object):
         return 0
 
     def _apply_config(self, args):
+        from veles_tpu.genetics.core import Range
         if args.config:
-            scope = {"root": root}
+            scope = {"root": root, "Range": Range}
             with open(args.config) as f:
                 exec(compile(f.read(), args.config, "exec"), scope)
         for stmt in args.config_list:
-            exec(stmt, {"root": root})
+            exec(stmt, {"root": root, "Range": Range})
+
+    # ------------------------------------------------------------- launcher
+    @staticmethod
+    def _parse_mesh(spec):
+        """'data=4,model=2' -> {'data': 4, 'model': 2} (ref device-spec
+        grammar backends.py:299-308 / launcher -n node specs)."""
+        if not spec:
+            return None
+        axes = {}
+        for part in spec.split(","):
+            name, _, size = part.partition("=")
+            if not size:
+                raise SystemExit("--mesh wants axis=size, got %r" % part)
+            axes[name.strip()] = int(size)
+        return axes
+
+    def _make_launcher(self, args, wf):
+        from veles_tpu.launcher import Launcher
+        self.launcher = Launcher(
+            workflow=wf, mesh_axes=self._parse_mesh(args.mesh),
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes, process_id=args.process_id)
+        return self.launcher
+
+    # -------------------------------------------------- meta: genetics / GA
+    @staticmethod
+    def _child_argv(args, extra_config, extra_flags, workers=1):
+        """argv for a child training run: rebuilt from the parsed parent
+        args (workflow/config/config-list/backend carry over; meta flags
+        do not — ref Launcher.filter_argv forwarding, launcher.py:75).
+        With concurrent workers the children are pinned to cpu HERE too:
+        a forwarded --backend would override the JAX_PLATFORMS env pin
+        inside the child and put N children on one accelerator."""
+        argv = [sys.executable, "-m", "veles_tpu", args.workflow]
+        if args.config:
+            argv.append(args.config)
+        config_list = list(args.config_list) + list(extra_config)
+        if config_list:
+            argv += ["--config-list"] + config_list
+        if workers > 1:
+            argv += ["--backend", "cpu"]
+        elif args.backend:
+            argv += ["--backend", args.backend]
+        return argv + list(extra_flags)
+
+    @staticmethod
+    def _child_env(workers):
+        import os
+        env = dict(os.environ)
+        if workers > 1:
+            # concurrent children must not fight over one accelerator
+            env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    #: watchdog for each child training run — a wedged backend must fail
+    #: the evaluation, not hang the whole GA/ensemble (same reasoning as
+    #: bench.py's per-phase watchdogs)
+    @staticmethod
+    def _child_timeout():
+        import os
+        return float(os.environ.get("VELES_TPU_CHILD_TIMEOUT", 1800))
+
+    @staticmethod
+    def _executor_map(workers):
+        """Parallel map over training *subprocesses* (one eval per process
+        — ref distributed GA fitness, genetics/optimization_workflow.py:
+        181-216; threads only marshal argv/JSON, the work is in the
+        children)."""
+        if workers <= 1:
+            return lambda f, xs: list(map(f, xs))
+
+        def pmap(f, xs):
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                return list(pool.map(f, xs))
+        return pmap
+
+    def _run_optimize(self, args):
+        """--optimize SIZE[:GENS] (ref veles/__main__.py:334-345): GA over
+        every Range() leaf in the config tree; each fitness evaluation is
+        a full training subprocess whose --result-file best_metric (lower
+        is better) becomes -fitness."""
+        import subprocess
+        import tempfile
+
+        from veles_tpu.genetics.core import extract_ranges
+        from veles_tpu.genetics.optimizer import GeneticsOptimizer
+
+        head, _, tail = args.optimize.partition(":")
+        size, generations = int(head), int(tail) if tail else 10
+        cfg = root.as_dict()
+        paths = extract_ranges(cfg)
+        if not paths:
+            raise SystemExit("--optimize: no Range() leaves in the config "
+                             "tree — tag tunables like "
+                             "root.x.lr = Range(0.01, 1.0)")
+
+        def leaf(tree, path):
+            for k in path:
+                tree = tree[k]
+            return tree
+
+        seed_flags = ([] if args.random_seed is None
+                      else ["--random-seed", str(args.random_seed)])
+
+        def evaluate(config):
+            overrides = ["root.%s=%r" % (".".join(p), leaf(config, p))
+                         for p, _ in paths]
+            with tempfile.NamedTemporaryFile("r", suffix=".json") as tmp:
+                argv = self._child_argv(
+                    args, overrides,
+                    ["--result-file", tmp.name] + seed_flags,
+                    workers=args.optimize_workers)
+                try:
+                    r = subprocess.run(
+                        argv, capture_output=True, text=True,
+                        timeout=self._child_timeout(),
+                        env=self._child_env(args.optimize_workers))
+                except subprocess.TimeoutExpired:
+                    print("[optimize] evaluation timed out",
+                          file=sys.stderr)
+                    return float("-inf")
+                if r.returncode != 0:
+                    print("[optimize] evaluation failed: %s"
+                          % r.stderr[-500:], file=sys.stderr)
+                    return float("-inf")
+                metric = json.load(open(tmp.name)).get("best_metric")
+            return float("-inf") if metric is None else -float(metric)
+
+        opt = GeneticsOptimizer(
+            cfg, evaluate, size=size, generations=generations,
+            executor_map=self._executor_map(args.optimize_workers))
+        best = opt.run()
+        if opt.population.best.fitness == float("-inf"):
+            print("--optimize: every fitness evaluation failed — no "
+                  "usable result", file=sys.stderr)
+            return 1
+        result = {
+            "optimize": {
+                "best_config": {"root." + ".".join(p): leaf(best, p)
+                                for p, _ in paths},
+                "best_fitness": opt.population.best.fitness,
+                "history": opt.history,
+            }
+        }
+        print(json.dumps(result, indent=2))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump(result, f, indent=2)
+        return 0
+
+    # ------------------------------------------------------------ ensembles
+    def _run_ensemble_train(self, args):
+        """--ensemble-train N:RATIO (ref ensemble/model_workflow.py:137):
+        N training subprocesses, each on a random train subset of RATIO
+        and its own seed, each exporting a model package; aggregated
+        results JSON feeds --ensemble-test."""
+        import os
+        import subprocess
+
+        head, _, tail = args.ensemble_train.partition(":")
+        n_models, ratio = int(head), float(tail) if tail else 0.8
+        out_file = args.result_file or "ensemble_results.json"
+        out_dir = os.path.abspath(
+            os.path.splitext(out_file)[0] + "_members")
+        os.makedirs(out_dir, exist_ok=True)
+
+        def train_member(i):
+            res = os.path.join(out_dir, "member_%02d.json" % i)
+            pkg = os.path.join(out_dir, "member_%02d.zip" % i)
+            seed = 1000 + i
+            argv = self._child_argv(
+                args,
+                ["root.common.ensemble.instance=%d" % i,
+                 "root.common.ensemble.train_ratio=%r" % ratio],
+                ["--random-seed", str(seed),
+                 "--result-file", res, "--export", pkg],
+                workers=args.ensemble_workers)
+            try:
+                r = subprocess.run(
+                    argv, capture_output=True, text=True,
+                    timeout=self._child_timeout(),
+                    env=self._child_env(args.ensemble_workers))
+            except subprocess.TimeoutExpired:
+                return {"instance": i, "seed": seed,
+                        "error": "training timed out"}
+            if r.returncode != 0:
+                return {"instance": i, "seed": seed,
+                        "error": r.stderr[-500:]}
+            member = {"instance": i, "seed": seed, "package": pkg,
+                      "train_ratio": ratio}
+            member["result"] = json.load(open(res))
+            return member
+
+        members = self._executor_map(args.ensemble_workers)(
+            train_member, range(n_models))
+        failed = [m for m in members if "error" in m]
+        result = {"members": members, "n_models": n_models,
+                  "train_ratio": ratio}
+        with open(out_file, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps({"ensemble_train": {
+            "n_models": n_models, "failed": len(failed),
+            "results_file": out_file}}, indent=2))
+        return 1 if failed else 0
+
+    def _ensemble_test(self, wf, args):
+        """Mean-probability vote over the members' exported packages on
+        the workflow's eval samples (ref EnsembleTestWorkflow averaging,
+        ensemble/test_workflow.py:102)."""
+        import numpy as np
+
+        from veles_tpu.loader.base import TEST, VALID
+        from veles_tpu.services.export import import_workflow
+
+        loader = wf.loader
+        if loader.carries_data:
+            raise SystemExit("--ensemble-test needs an index loader with "
+                             "an HBM/host-resident eval set")
+        if wf.trainer.loss not in ("softmax", "lm") or loader.labels is None:
+            raise SystemExit("--ensemble-test is a mean-probability vote — "
+                             "it needs a classification workflow with "
+                             "labels (loss=softmax)")
+        members = json.load(open(args.ensemble_test))["members"]
+        members = [m for m in members if "package" in m]
+        if not members:
+            raise SystemExit("no successfully trained members in %s"
+                             % args.ensemble_test)
+        # eval span: validation if present, else test
+        cls = VALID if loader.class_lengths[VALID] else TEST
+        start = 0 if cls == TEST else loader.class_offsets[TEST]
+        end = loader.class_offsets[cls]
+        if end == start:
+            raise SystemExit("--ensemble-test: the loader has no "
+                             "test/validation samples to vote on")
+        x = np.asarray(loader.data)[start:end]
+        labels = np.asarray(loader.labels)[start:end]
+        fwd = wf.forward_fn()
+        probs = None
+        for m in members:
+            manifest, arrays = import_workflow(m["package"])
+            params = {
+                u["name"]: {p: arrays[f] for p, f in u["arrays"].items()}
+                for u in manifest["units"] if u["arrays"]}
+            p = np.asarray(fwd(params, x))
+            probs = p if probs is None else probs + p
+        pred = (probs / len(members)).argmax(axis=1)
+        error = float((pred != labels).mean())
+        return {"n_members": len(members), "n_samples": int(end - start),
+                "error": error}
 
     def _serve(self, wf, port):
         import numpy as np

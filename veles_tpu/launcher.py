@@ -100,10 +100,27 @@ class Launcher(Logger):
         if self.is_master:
             self._launch_services()
         if self.workflow is not None:
-            if self.mesh_config is not None and \
-                    getattr(self.workflow, "trainer", None) is not None and \
-                    self.workflow.trainer.mesh_config is None:
-                self.workflow.trainer.mesh_config = self.mesh_config
+            trainer = getattr(self.workflow, "trainer", None)
+            # only trainers that understand meshes (StagedTrainer) —
+            # Kohonen/RBM trainers have no mesh_config attribute
+            if self.mesh_config is not None and trainer is not None:
+                if not hasattr(trainer, "mesh_config"):
+                    self.warning("--mesh ignored: %s does not support "
+                                 "SPMD meshes", type(trainer).__name__)
+                elif trainer.mesh_config is None:
+                    trainer.mesh_config = self.mesh_config
+            # the trainer will row-shard the dataset: the loader must not
+            # materialize a single-device replica first (the workflow
+            # constructor handles this when it got mesh_config directly;
+            # this covers the --mesh CLI path where the mesh is assigned
+            # here, before any unit initializes)
+            mc = getattr(trainer, "mesh_config", None)
+            loader = getattr(self.workflow, "loader", None)
+            if (mc is not None and loader is not None
+                    and getattr(trainer, "dataset_placement", None)
+                    == "shard" and mc.data_size > 1
+                    and getattr(loader, "on_device", None) is True):
+                loader.on_device = "defer"
             self.workflow.initialize(**kwargs)
         self._initialized = True
 
@@ -133,7 +150,11 @@ class Launcher(Logger):
         self.run()
 
     def stop(self):
+        """Idempotent — run() calls it in its finally and the CLI calls it
+        again on the way out."""
         if self.graphics_server is not None:
             self.graphics_server.stop()
+            self.graphics_server = None
         if self.web_server is not None:
             self.web_server.stop()
+            self.web_server = None

@@ -54,6 +54,10 @@ class Loader(Unit, metaclass=LoaderRegistry):
         self.shuffle_enabled = kwargs.get("shuffle", True)
         self.prng = prng.get(kwargs.get("prng_name", "loader"))
         self._order = None
+        #: optional (instance, train_ratio) — train on a per-instance
+        #: random subset.  The CLI channel is root.common.ensemble
+        #: (--ensemble-train children); this kwarg is the programmatic one.
+        self.train_subset = kwargs.get("train_subset")
 
     # -- to be provided by subclasses ---------------------------------------
     def load_data(self):
@@ -87,6 +91,7 @@ class Loader(Unit, metaclass=LoaderRegistry):
         self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s loaded an empty dataset" % self)
+        self._apply_ensemble_subset()
         if self.minibatch_size > max(self.class_lengths):
             self.minibatch_size = int(max(self.class_lengths))
         self.create_minibatch_data()
@@ -97,15 +102,50 @@ class Loader(Unit, metaclass=LoaderRegistry):
                    self.total_samples,
                    dict(zip(CLASS_NAMES, self.class_lengths)))
 
+    def _apply_ensemble_subset(self):
+        """Restrict the train span to a per-instance random subset (ref
+        ensemble members training on random train subsets,
+        veles/ensemble/model_workflow.py:137).  Source: the
+        ``train_subset=(instance, ratio)`` kwarg, else the CLI channel
+        root.common.ensemble set by --ensemble-train children.  Global
+        sample indices stay valid — only the served order shrinks."""
+        if self.train_subset is not None:
+            instance, ratio = self.train_subset
+        else:
+            from veles_tpu.config import root
+            ens = root.common.get("ensemble")
+            if ens is None:
+                return
+            cfg = ens.as_dict() if hasattr(ens, "as_dict") else dict(ens)
+            ratio = cfg.get("train_ratio", 1.0)
+            instance = cfg.get("instance", 0)
+        ratio = float(ratio)
+        instance = int(instance)
+        n_train = int(self.class_lengths[TRAIN])
+        if ratio >= 1.0 or n_train <= 1:
+            return
+        n_sub = max(1, int(n_train * ratio))
+        rs = np.random.RandomState(0xE75 + instance)
+        start = self.class_offsets[VALID]   # train span starts here
+        self._train_pool = np.sort(start + rs.choice(
+            n_train, n_sub, replace=False).astype(np.int32))
+        self.class_lengths[TRAIN] = n_sub
+        self.info("ensemble instance %d: training on %d/%d samples",
+                  instance, n_sub, n_train)
+
     def _reset_order(self):
         """Identity order for test/valid; reshuffled train span
-        (ref base.py:711 shuffle per epoch)."""
+        (ref base.py:711 shuffle per epoch).  With an ensemble subset the
+        train span draws from the instance's pool of global indices."""
         order = np.arange(self.total_samples, dtype=np.int32)
         n_train = self.class_lengths[TRAIN]
+        pool = getattr(self, "_train_pool", None)
+        start = self.class_offsets[VALID]
+        if pool is not None:
+            order[start:] = pool
         if self.shuffle_enabled and n_train:
-            start = self.class_offsets[VALID]
-            order[start:] = start + self.prng.permutation(n_train).astype(
-                np.int32)
+            perm = self.prng.permutation(n_train).astype(np.int32)
+            order[start:] = order[start:][perm]
         self._order = order
 
     # -- the hot-loop step ---------------------------------------------------

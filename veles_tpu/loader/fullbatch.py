@@ -28,7 +28,18 @@ class FullBatchLoader(Loader):
         self.data = None
         self.labels = None
         self.targets = None
+        #: True → place in HBM, falling back to "host" on OOM (ref OOM
+        #: fallback, veles/loader/fullbatch.py:164-242).
+        #: False → keep numpy arrays, still an *index* loader (units
+        #: gather from host memory — Kohonen/RBM style).
+        #: "host" → become a data-carrying loader serving gathered numpy
+        #: minibatches which the trainer streams to the device per step.
+        #: "defer" → keep numpy; a mesh trainer will row-shard the arrays
+        #: itself, so a single-device replica must never be created.
         self.on_device = kwargs.get("on_device", True)
+        if self.on_device not in (True, False, "host", "defer"):
+            raise ValueError("on_device must be True/False/'host'/'defer'")
+        self.sample_shape = None         # set in host mode
 
     def load_data(self):
         if self.original_data is None:
@@ -48,20 +59,62 @@ class FullBatchLoader(Loader):
 
     def create_minibatch_data(self):
         """One host→device transfer for the whole dataset (ref fullbatch
-        on-device residency, fullbatch.py:164-242)."""
-        if not self.on_device:
-            self.data = np.asarray(self.original_data)
-            self.labels = (None if self.original_labels is None
-                           else np.asarray(self.original_labels))
-            self.targets = (None if self.original_targets is None
-                            else np.asarray(self.original_targets))
+        on-device residency, fullbatch.py:164-242).  On device OOM the
+        loader degrades to host-streaming mode instead of dying."""
+        if self.on_device is True:
+            try:
+                self.data = jnp.asarray(self.original_data)
+                if self.original_labels is not None:
+                    self.labels = jnp.asarray(
+                        np.asarray(self.original_labels).astype(np.int32))
+                if self.original_targets is not None:
+                    self.targets = jnp.asarray(self.original_targets)
+                return
+            except Exception as e:
+                msg = str(e)
+                if not ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                        or "out of memory" in msg):
+                    raise
+                self.warning("dataset does not fit in HBM (%s) — falling "
+                             "back to host-streaming mode", msg[:120])
+                self.data = self.labels = self.targets = None
+                self._enable_host_mode()
+                return
+        if self.on_device == "host":
+            self._enable_host_mode()
             return
-        self.data = jnp.asarray(self.original_data)
+        # False / "defer": numpy arrays, still an index loader
+        self.data = np.asarray(self.original_data)
         if self.original_labels is not None:
-            self.labels = jnp.asarray(np.asarray(self.original_labels)
-                                      .astype(np.int32))
+            self.labels = np.asarray(self.original_labels).astype(np.int32)
         if self.original_targets is not None:
-            self.targets = jnp.asarray(self.original_targets)
+            self.targets = np.asarray(self.original_targets)
+
+    def _enable_host_mode(self):
+        self.carries_data = True     # instance attr shadows the class attr
+        self._host_data = np.ascontiguousarray(self.original_data)
+        self._host_labels = (None if self.original_labels is None else
+                             np.asarray(self.original_labels)
+                             .astype(np.int32))
+        self._host_targets = (None if self.original_targets is None
+                              else np.asarray(self.original_targets))
+        self.sample_shape = tuple(self._host_data.shape[1:])
+        self.minibatch_data = None
+        self.minibatch_labels = None
+        self.minibatch_targets = None
+
+    def run(self):
+        super(FullBatchLoader, self).run()
+        if not self.carries_data:
+            return
+        # host mode: gather the minibatch rows on the host (pad rows → 0;
+        # their loss contribution is masked by minibatch_valid)
+        idx = np.maximum(self.minibatch_indices, 0)
+        self.minibatch_data = self._host_data[idx]
+        if self._host_labels is not None:
+            self.minibatch_labels = self._host_labels[idx]
+        if self._host_targets is not None:
+            self.minibatch_targets = self._host_targets[idx]
 
     @staticmethod
     def gather(dataset, indices):

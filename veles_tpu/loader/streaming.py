@@ -115,6 +115,68 @@ class ZeroMQLoader(QueueLoaderBase):
         self._thread.start()
 
 
+class GeneratorLoader(Loader):
+    """Host-side input pipeline for datasets too big for HBM *or* host RAM
+    (the ImageNet path — SURVEY §7 step 6 'host-side decode with
+    double-buffered device puts').  Pulls fixed-shape minibatches from a
+    user callable ``generator(step, size) -> (data, labels)`` (labels
+    optional); decode/augment happens in the callable on the host, and
+    because the trainer's dispatch is fully async, producing batch t+1
+    overlaps the device computing step t.  Epochs are synthetic:
+    ``steps_per_epoch`` minibatches = one TRAIN epoch (drives the Decision
+    gates exactly like an index loader)."""
+
+    MAPPING = "generator"
+    carries_data = True
+
+    def __init__(self, workflow, generator=None, sample_shape=None,
+                 steps_per_epoch=100, **kwargs):
+        super(GeneratorLoader, self).__init__(workflow, **kwargs)
+        if generator is None or sample_shape is None:
+            raise ValueError("GeneratorLoader needs generator= and "
+                             "sample_shape=")
+        self.generator = generator
+        self.sample_shape = tuple(sample_shape)
+        self.steps_per_epoch = int(steps_per_epoch)
+        self.minibatch_data = None
+        self.minibatch_labels = None
+        self.minibatch_targets = None
+        self._step = 0
+
+    def load_data(self):
+        self.class_lengths = [0, 0,
+                              self.steps_per_epoch * self.minibatch_size]
+        self.shuffle_enabled = False   # ordering belongs to the generator
+
+    def run(self):
+        super(GeneratorLoader, self).run()   # epoch flags / offsets
+        out = self.generator(self._step, self.minibatch_size)
+        if isinstance(out, tuple):
+            data, labels = (out + (None,))[:2]
+        else:
+            data, labels = out, None
+        data = np.asarray(data, np.float32)
+        if data.shape != (self.minibatch_size,) + self.sample_shape:
+            raise ValueError("generator returned %s, expected %s"
+                             % (data.shape,
+                                (self.minibatch_size,) + self.sample_shape))
+        self.minibatch_data = data
+        self.minibatch_labels = (None if labels is None
+                                 else np.asarray(labels, np.int32))
+        self._step += 1
+
+    @property
+    def state(self):
+        st = super(GeneratorLoader, self).state
+        st["generator_step"] = self._step
+        return st
+
+    @state.setter
+    def state(self, st):
+        Loader.state.fset(self, st)
+        self._step = st.get("generator_step", 0)
+
+
 class Downloader(Unit):
     """Fetch + unpack a dataset archive into the datasets dir
     (ref veles/downloader.py:56).  In a zero-egress environment the fetch

@@ -58,7 +58,7 @@ class StagedTrainer(Unit):
     ``minibatch_valid``, ``minibatch_class``."""
 
     def __init__(self, workflow, layers, loss="softmax", gd_defaults=None,
-                 mesh_config=None, **kwargs):
+                 mesh_config=None, dataset_placement="shard", **kwargs):
         super(StagedTrainer, self).__init__(workflow, **kwargs)
         self.layers = layers
         self.loss = loss
@@ -67,6 +67,15 @@ class StagedTrainer(Unit):
         #: shard over the model axis (tp) and the minibatch over the data
         #: axis (dp) — XLA inserts the gradient psum over ICI.
         self.mesh_config = mesh_config
+        #: 'shard' (default): the HBM dataset rows shard over the data axis
+        #: — each device holds 1/D of the dataset, the in-step gather rides
+        #: a psum_scatter (one minibatch of ICI traffic).  'replicate':
+        #: r1 behavior, every device holds a full copy (fastest when the
+        #: dataset is small).
+        if dataset_placement not in ("shard", "replicate"):
+            raise ValueError("dataset_placement must be 'shard' or "
+                             "'replicate', got %r" % (dataset_placement,))
+        self.dataset_placement = dataset_placement
         self.demand("loader")
         self.params = {}
         self.velocity = {}
@@ -134,11 +143,13 @@ class StagedTrainer(Unit):
 
     def _loss_and_stats(self, params, data, labels, targets, idx, valid,
                         train, key):
-        """Index mode: gather the minibatch from HBM-resident arrays."""
+        """Index mode: gather the minibatch from HBM-resident arrays
+        (``_gather`` is the plain jnp.take on one device, or the
+        psum_scatter collective gather when the dataset is row-sharded)."""
+        tgt = (self._gather(targets, idx) if self.loss == "mse" else None)
         return self._loss_from_batch(
-            params, FullBatchLoader.gather(data, idx),
-            FullBatchLoader.gather(labels, idx),
-            FullBatchLoader.gather(targets, idx), valid, train, key)
+            params, self._gather(data, idx),
+            self._gather(labels, idx), tgt, valid, train, key)
 
     def _loss_from_batch(self, params, x, lbl, tgt, valid, train, key):
         out = self._forward(params, x, train, key)
@@ -204,48 +215,67 @@ class StagedTrainer(Unit):
                 jax.random.key(0))
             return jax.tree_util.tree_map(jnp.add, acc, stats)
 
+        self._jit_steps(train_step, eval_step)
+        self._gather = FullBatchLoader.gather
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding
             mc = self.mesh_config
-            repl = sharding.replicated_sharding(mc)
-            overrides = getattr(self, "_param_overrides", None)
-            p_sh = sharding.param_shardings(self.params, mc, overrides)
-            v_sh = sharding.param_shardings(self.velocity, mc, overrides)
-            acc_sh = jax.tree_util.tree_map(lambda _: repl,
-                                            self._zero_stats())
-            self._train_step = jax.jit(
-                train_step, donate_argnums=(0, 1, 2),
-                out_shardings=(p_sh, v_sh, acc_sh))
-            self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
-                                      out_shardings=acc_sh)
-            labels = sharding.replicate(labels, mc)
-            self._data_dev = sharding.replicate(loader.data, mc)
+            if self.dataset_placement == "shard" and mc.data_size > 1:
+                self._gather = sharding.make_sharded_gather(mc)
+                place = lambda x: sharding.shard_dataset(np.asarray(x), mc)
+            else:
+                place = lambda x: sharding.replicate(x, mc)
+            labels = place(labels)
+            self._data_dev = place(loader.data)
             if targets is loader.data:
                 targets = self._data_dev  # autoencoder: don't copy twice
             elif targets is not None:
-                targets = sharding.replicate(targets, mc)
+                targets = place(targets)
         else:
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-            self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
             self._data_dev = loader.data
         self._labels_dev = labels
         self._targets_dev = (targets if targets is not None
                              else jnp.zeros((1,), jnp.float32))
 
+    def _jit_steps(self, train_step, eval_step):
+        """jit the pair with donation; under a mesh, pin the output
+        shardings (params/velocity per the partition rules, stat
+        accumulators replicated) — shared by the index and data-carrying
+        builders so the two paths cannot diverge."""
+        if self.mesh_config is None:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+            return
+        from veles_tpu.parallel import sharding
+        mc = self.mesh_config
+        repl = sharding.replicated_sharding(mc)
+        overrides = getattr(self, "_param_overrides", None)
+        p_sh = sharding.param_shardings(self.params, mc, overrides)
+        v_sh = sharding.param_shardings(self.velocity, mc, overrides)
+        acc_sh = jax.tree_util.tree_map(lambda _: repl, self._zero_stats())
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2),
+                                   out_shardings=(p_sh, v_sh, acc_sh))
+        self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
+                                  out_shardings=acc_sh)
+
     def _build_steps_direct(self):
-        """Steps for data-carrying loaders (streaming/replay): the
-        minibatch tensor arrives from the host each step; mse reconstructs
-        the input (no separate target stream in the replay format)."""
-        if self.mesh_config is not None:
-            raise ValueError("mesh training with a streaming/replay loader "
-                             "is not supported — use an index loader")
+        """Steps for data-carrying loaders (streaming/replay/host-fallback):
+        the minibatch tensor arrives from the host each step.  Under a mesh
+        the arriving batch shards over the data axis (host-streaming SPMD —
+        lifts the r1 restriction); because every dispatch is async, the
+        host-side production of batch t+1 naturally overlaps the device
+        compute of step t (double buffering for free — nothing below blocks
+        until Decision reads the epoch stats).  mse uses the loader's
+        minibatch_targets when present, else reconstructs the input."""
         hypers = self._hypers
 
-        def train_step(params, velocity, acc, x, lbl, valid, step, lr_scale):
+        def train_step(params, velocity, acc, x, lbl, tgt, valid, step,
+                       lr_scale):
             key = jax.random.fold_in(self._base_key, step)
 
             def loss_fn(p):
-                return self._loss_from_batch(p, x, lbl, x, valid, True, key)
+                return self._loss_from_batch(p, x, lbl, tgt, valid, True,
+                                             key)
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(params, grads, velocity,
@@ -253,20 +283,30 @@ class StagedTrainer(Unit):
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
-        def eval_step(params, acc, x, lbl, valid):
-            _, stats = self._loss_from_batch(params, x, lbl, x, valid,
+        def eval_step(params, acc, x, lbl, tgt, valid):
+            _, stats = self._loss_from_batch(params, x, lbl, tgt, valid,
                                              False, jax.random.key(0))
             return jax.tree_util.tree_map(jnp.add, acc, stats)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-        self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+        self._jit_steps(train_step, eval_step)
 
     def _direct_batch(self, loader):
-        x = jnp.asarray(loader.minibatch_data)
-        lbl = (jnp.asarray(loader.minibatch_labels)
+        x = np.asarray(loader.minibatch_data)
+        lbl = (np.asarray(loader.minibatch_labels)
                if getattr(loader, "minibatch_labels", None) is not None
-               else jnp.zeros((x.shape[0],), jnp.int32))
-        return x, lbl
+               else np.zeros((x.shape[0],), np.int32))
+        tgt = (np.asarray(loader.minibatch_targets)
+               if getattr(loader, "minibatch_targets", None) is not None
+               else None)   # None → reuse x's device copy (one transfer)
+        if self.mesh_config is not None:
+            from veles_tpu.parallel import sharding
+            mc = self.mesh_config
+            x_dev = sharding.shard_batch(x, mc)
+            return (x_dev, sharding.shard_batch(lbl, mc),
+                    x_dev if tgt is None else sharding.shard_batch(tgt, mc))
+        x_dev = jnp.asarray(x)
+        return (x_dev, jnp.asarray(lbl),
+                x_dev if tgt is None else jnp.asarray(tgt))
 
     # ------------------------------------------------------------- hot loop
     def run(self):
@@ -281,18 +321,23 @@ class StagedTrainer(Unit):
         loader = self.loader
         if loader.carries_data:
             cls = loader.minibatch_class
-            x, lbl = self._direct_batch(loader)
-            valid = jnp.asarray(loader.minibatch_valid)
+            x, lbl, tgt = self._direct_batch(loader)
+            if self.mesh_config is not None:
+                from veles_tpu.parallel import sharding
+                valid = sharding.shard_batch(
+                    np.asarray(loader.minibatch_valid), self.mesh_config)
+            else:
+                valid = jnp.asarray(loader.minibatch_valid)
             if cls in self.train_only_classes:
                 self._step_counter += 1
                 self.params, self.velocity, self.class_stats[cls] = \
                     self._train_step(self.params, self.velocity,
-                                     self.class_stats[cls], x, lbl, valid,
-                                     self._step_counter,
+                                     self.class_stats[cls], x, lbl, tgt,
+                                     valid, self._step_counter,
                                      jnp.float32(self.lr_scale))
             else:
                 self.class_stats[cls] = self._eval_step(
-                    self.params, self.class_stats[cls], x, lbl, valid)
+                    self.params, self.class_stats[cls], x, lbl, tgt, valid)
             return
         cls = loader.minibatch_class
         if self.mesh_config is not None:

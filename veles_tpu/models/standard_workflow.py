@@ -26,7 +26,7 @@ class StandardWorkflow(Workflow):
     def __init__(self, workflow=None, layers=None, loader=None,
                  loss="softmax", decision_config=None, snapshotter_config=None,
                  gd_defaults=None, mesh_config=None, lr_adjuster_config=None,
-                 **kwargs):
+                 dataset_placement="shard", **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -35,9 +35,16 @@ class StandardWorkflow(Workflow):
 
         self.repeater = Repeater(self)
         self.loader = self._make_loader(loader)
+        if (mesh_config is not None and dataset_placement == "shard"
+                and mesh_config.data_size > 1
+                and getattr(self.loader, "on_device", None) is True):
+            # the trainer will row-shard the dataset over the data axis;
+            # a single-device replica must never be materialized first
+            self.loader.on_device = "defer"
         self.trainer = StagedTrainer(self, [make_layer(c) for c in layers],
                                      loss=loss, gd_defaults=gd_defaults,
-                                     mesh_config=mesh_config)
+                                     mesh_config=mesh_config,
+                                     dataset_placement=dataset_placement)
         self.trainer.loader = self.loader
         self.forwards = [Forward(self, lay, self.trainer)
                          for lay in self.trainer.layers]
