@@ -1,0 +1,10 @@
+"""Config for samples/cifar_conv.py (ref cifar_caffe hyperparameters)."""
+
+root.cifar.update({
+    "learning_rate": 0.001,
+    "gradient_moment": 0.9,
+    "weight_decay": 0.004,
+    "max_epochs": 60,
+    "minibatch_size": 100,
+    "normalization": "mean_disp",
+})

@@ -1,0 +1,41 @@
+"""BASELINE config 2: real-CIFAR-10 conv net (ref cifar_caffe — published
+validation error 17.21 %, train 8.31 %;
+docs/source/manualrst_veles_algorithms.rst:51).  Run:
+
+    python -m veles_tpu samples/cifar_conv.py samples/cifar_config.py
+
+Expects <datasets>/cifar-10-batches-py/ (the canonical python batches);
+zero-egress: nothing is downloaded."""
+
+from veles_tpu.config import root
+from veles_tpu.loader.datasets import cifar10_available, load_cifar10
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import cifar_conv
+
+
+def run(load, main):
+    if not cifar10_available():
+        raise SystemExit(
+            "CIFAR-10 not found under %s/cifar-10-batches-py — mount the "
+            "python batches to run this config"
+            % root.common.dirs.get("datasets", "datasets"))
+    cfg = root.cifar
+    train_x, train_y, test_x, test_y = load_cifar10()
+    import numpy as np
+    data = np.concatenate([test_x, train_x])
+    labels = np.concatenate([test_y, train_y])
+    loader = FullBatchLoader(
+        None, data=data, labels=labels,
+        minibatch_size=cfg.get("minibatch_size", 100),
+        class_lengths=[0, len(test_x), len(train_x)],
+        normalization=cfg.get("normalization", "mean_disp"))
+    load(StandardWorkflow,
+         layers=cifar_conv(lr=cfg.get("learning_rate", 0.001),
+                           moment=cfg.get("gradient_moment", 0.9),
+                           wd=cfg.get("weight_decay", 0.004)),
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 60)},
+         lr_adjuster_config=cfg.get("lr_adjuster"),
+         name="cifar-conv")
+    main()

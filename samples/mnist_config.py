@@ -1,0 +1,9 @@
+"""Config for samples/mnist_mlp.py (ref MnistSimple hyperparameters)."""
+
+root.mnist.update({
+    "hidden": 100,
+    "learning_rate": 0.03,
+    "gradient_moment": 0.9,
+    "max_epochs": 30,
+    "minibatch_size": 100,
+})

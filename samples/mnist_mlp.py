@@ -1,0 +1,40 @@
+"""BASELINE config 1: real-MNIST 784-100-10 MLP (ref MnistSimple —
+published validation error 1.48 %, train 0.00 %;
+docs/source/manualrst_veles_algorithms.rst:32).  Run:
+
+    python -m veles_tpu samples/mnist_mlp.py samples/mnist_config.py
+
+Expects the canonical idx files under <datasets>/mnist/ (gz or raw);
+zero-egress: nothing is downloaded."""
+
+from veles_tpu.config import root
+from veles_tpu.loader.datasets import load_mnist, mnist_available
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import mnist_mlp
+
+
+def run(load, main):
+    if not mnist_available():
+        raise SystemExit(
+            "MNIST not found under %s/mnist — mount the idx files "
+            "(train/t10k images+labels) to run this config"
+            % root.common.dirs.get("datasets", "datasets"))
+    cfg = root.mnist
+    train_x, train_y, test_x, test_y = load_mnist()
+    import numpy as np
+    data = np.concatenate([test_x, train_x])
+    labels = np.concatenate([test_y, train_y])
+    loader = FullBatchLoader(
+        None, data=data, labels=labels,
+        minibatch_size=cfg.get("minibatch_size", 100),
+        class_lengths=[0, len(test_x), len(train_x)])
+    load(StandardWorkflow,
+         layers=mnist_mlp(hidden=cfg.get("hidden", 100),
+                          lr=cfg.get("learning_rate", 0.03),
+                          moment=cfg.get("gradient_moment", 0.9)),
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 30)},
+         lr_adjuster_config=cfg.get("lr_adjuster"),
+         name="mnist-mlp")
+    main()

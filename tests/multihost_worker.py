@@ -1,0 +1,73 @@
+"""Worker process for the multi-host SPMD test (the TPU-era equivalent of
+the reference's in-process Server+Client network test,
+veles/tests/test_network.py:52-120): each process owns a slice of the
+devices, `jax.distributed.initialize` forms the job (the DCN control plane
+that replaces the reference's Twisted TCP), and one StandardWorkflow
+trains data-parallel over the cross-process mesh.
+
+Usage: python multihost_worker.py <coordinator> <num_processes> <process_id>
+Prints one line: ``METRICS {json}``.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    # 4 local devices per process -> 8 global over 2 processes (overwrite
+    # any inherited XLA_FLAGS — the pytest conftest forces 8 per process)
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from sklearn.datasets import load_digits
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    prng.seed_all(1234)
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)[:800]
+    y = d.target.astype(np.int32)[:800]
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=80,
+                             class_lengths=[0, 160, 640])
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": 0.1},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.1}],
+        loader=loader, decision_config={"max_epochs": 2},
+        name="multihost-digits")
+
+    launcher = Launcher(workflow=wf, coordinator_address=coordinator,
+                        num_processes=num_processes, process_id=process_id,
+                        mesh_axes={"data": -1})
+    launcher.initialize()
+    assert launcher.mode == "spmd"
+    n_devices = len(jax.devices())
+    launcher.run()
+
+    m = wf.decision.epoch_metrics[1]
+    print("METRICS " + json.dumps({
+        "process_id": process_id,
+        "process_count": jax.process_count(),
+        "n_global_devices": n_devices,
+        "is_master": launcher.is_master,
+        "loss": m["loss"],
+        "n_errors": m["n_errors"],
+        "best_metric": wf.decision.best_metric,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Preemption elasticity (VERDICT r1 #5): SIGKILL a training run
+mid-epoch, restart the same command line, and the resumed run's final
+metrics must match an uninterrupted run bit-for-bit — the TPU-era
+equivalent of the reference's slave respawn + failed-minibatch requeue
+(veles/server.py:637-655, loader/base.py:679-687) mapped onto
+checkpoint-restart."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cmd(snap_dir, result, max_epochs=20):
+    return [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
+            "samples/digits_config.py", "--backend", "cpu",
+            "--random-seed", "11",
+            "--snapshot", "auto", "--snapshot-every", "1",
+            "--config-list", "root.digits.max_epochs=%d" % max_epochs,
+            "root.common.dirs.snapshots=%r" % str(snap_dir),
+            "--result-file", result]
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONUNBUFFERED="1")
+
+    # reference: one uninterrupted run
+    res_a = str(tmp_path / "a.json")
+    r = subprocess.run(_cmd(tmp_path / "snap_a", res_a), env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    a = json.load(open(res_a))
+    assert a["epochs"] == 20
+
+    # leg 1: same command, SIGKILLed mid-epoch after the 2nd snapshot
+    res_b = str(tmp_path / "b.json")
+    p = subprocess.Popen(_cmd(tmp_path / "snap_b", res_b), env=env,
+                         cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True)
+    snapshots_seen = 0
+    for line in p.stdout:
+        if "snapshot ->" in line:
+            snapshots_seen += 1
+            if snapshots_seen == 2:
+                break
+    time.sleep(0.05)           # land inside the next epoch
+    p.kill()                   # SIGKILL — no cleanup, no final snapshot
+    p.wait()
+    assert p.returncode != 0
+    assert not os.path.exists(res_b)   # it really died before finishing
+
+    # leg 2: identical command line resumes from <prefix>_current
+    r2 = subprocess.run(_cmd(tmp_path / "snap_b", res_b), env=env,
+                        cwd=REPO, capture_output=True, text=True,
+                        timeout=420)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "[auto-resume]" in r2.stderr and "fresh start" not in r2.stderr
+    b = json.load(open(res_b))
+
+    # bit-for-bit: the resumed run converges to the identical result
+    assert b["epochs"] == a["epochs"]
+    assert b["best_metric"] == a["best_metric"]
+    assert b["best_epoch"] == a["best_epoch"]
+    assert b["epoch_metrics"] == a["epoch_metrics"]
+
+
+def test_auto_snapshot_fresh_start(tmp_path):
+    """--snapshot auto with no prior snapshot is a clean fresh start."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = str(tmp_path / "r.json")
+    r = subprocess.run(_cmd(tmp_path / "snap", res, max_epochs=1), env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "fresh start" in r.stderr
+    assert json.load(open(res))["epochs"] == 1
+    # and it left a resumable _current behind
+    assert os.path.exists(str(tmp_path / "snap" / "digits-mlp_current"))

@@ -71,8 +71,8 @@ class TestLauncher:
         with urllib.request.urlopen(
                 "http://127.0.0.1:%d/api/status" % port) as r:
             assert b"launch-web" in r.read()
-        launcher.run()   # stops services afterwards
-        assert launcher.web_server._server is None
+        launcher.run()   # stops services afterwards (idempotent stop
+        assert launcher.web_server is None   # clears the reference)
 
 
 class TestLRAdjuster:
@@ -134,6 +134,25 @@ class TestGraphics:
         assert written[0].endswith("loss.png")
         import os
         assert os.path.getsize(written[0]) > 0
+
+    def test_client_pdf_export_and_signal(self, tmp_path):
+        """r2: the reference's SIGUSR2 PDF export
+        (veles/graphics_client.py)."""
+        import os
+        import signal
+        client = GraphicsClient("tcp://127.0.0.1:1", str(tmp_path))
+        client.latest = {"w": {"name": "w", "kind": "minmax",
+                               "min": [0.0, -1.0], "mean": [1.0, 0.5],
+                               "max": [2.0, 2.5], "ylabel": "w"}}
+        written = client.render_all(fmt="pdf")
+        assert written[0].endswith("w.pdf")
+        assert open(written[0], "rb").read(4) == b"%PDF"
+        os.remove(written[0])
+        client.install_pdf_signal()
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert open(os.path.join(str(tmp_path), "w.pdf"),
+                    "rb").read(4) == b"%PDF"
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
 
     def test_plotter_feeds_subscribers(self):
         seen = []

@@ -123,7 +123,9 @@ class TestAudioLoader:
         # VALID block comes first in the concatenated layout
         labels = loader.original_labels
         assert list(labels[:4]) == [0, 0, 0, 0]
-        assert list(labels[8:]) == [5, 5, 5, 5]
+        # raw label 5 dense-maps to class index 1 (base label analysis)
+        assert list(labels[8:]) == [1, 1, 1, 1]
+        assert loader.labels_mapping == {0: 0, 5: 1}
         loader.run()
         assert loader.minibatch_indices.shape == (2,)
         got = AudioLoader.gather(loader.data, loader.minibatch_indices)

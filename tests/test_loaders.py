@@ -295,7 +295,10 @@ class TestImageBreadth:
         loader = FileListImageLoader(None, train_list=str(lst),
                                      size=(4, 4), minibatch_size=1)
         loader.initialize()
-        np.testing.assert_array_equal(loader.original_labels, [3, 0])
+        # raw labels [3, 0] dense-map to class indices via the base
+        # analysis (ref label mapping, veles/loader/base.py:755-819)
+        np.testing.assert_array_equal(loader.original_labels, [1, 0])
+        assert loader.labels_mapping == {0: 0, 3: 1}
 
 
 class TestFullBatchHostFallback:
@@ -427,3 +430,137 @@ class TestGeneratorLoader:
         loader.initialize()
         with pytest.raises(ValueError, match="expected"):
             loader.run()
+
+
+class TestDatasetAnalysis:
+    """VERDICT r1 #7: label mapping + per-class distribution analysis in
+    the Loader base (ref veles/loader/base.py:755-819)."""
+
+    def test_string_labels_map_to_dense_indices(self):
+        x = np.zeros((6, 3), np.float32)
+        y = np.array(["dog", "cat", "cat", "bird", "dog", "cat"])
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=3,
+                                 class_lengths=[0, 0, 6])
+        loader.initialize()
+        assert loader.labels_mapping == {"bird": 0, "cat": 1, "dog": 2}
+        np.testing.assert_array_equal(np.asarray(loader.labels),
+                                      [2, 1, 1, 0, 2, 1])
+        assert loader.labels.dtype == np.int32 or \
+            str(loader.labels.dtype) == "int32"
+
+    def test_sparse_int_labels_remapped(self):
+        x = np.zeros((4, 2), np.float32)
+        y = np.array([10, 500, 10, 500])
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=2,
+                                 class_lengths=[0, 0, 4])
+        loader.initialize()
+        assert loader.labels_mapping == {10: 0, 500: 1}
+        np.testing.assert_array_equal(np.asarray(loader.labels),
+                                      [0, 1, 0, 1])
+
+    def test_distribution_and_metrics(self):
+        x = np.zeros((10, 2), np.float32)
+        y = np.array([0, 1, 0, 1, 1, 0, 1, 1, 1, 1])
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=2,
+                                 class_lengths=[0, 4, 6])
+        loader.initialize()
+        d = loader.label_distribution
+        assert d["validation"] == {"0": 2, "1": 2}
+        assert d["train"] == {"0": 1, "1": 5}
+        m = loader.get_metric_values()
+        assert m["labels"]["n_classes"] == 2
+
+    def test_untrained_class_warns(self, caplog):
+        import logging
+        x = np.zeros((6, 2), np.float32)
+        y = np.array([0, 1, 2, 0, 1, 0])   # class 2 only in validation
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=3,
+                                 class_lengths=[0, 3, 3])
+        with caplog.at_level(logging.WARNING):
+            loader.initialize()
+        assert any("never seen in training" in r.message
+                   for r in caplog.records)
+
+    def test_skew_warns(self, caplog):
+        import logging
+        x = np.zeros((120, 2), np.float32)
+        y = np.array([0] * 110 + [1] * 10)
+        loader = FullBatchLoader(None, data=x, labels=y,
+                                 minibatch_size=10,
+                                 class_lengths=[0, 0, 120])
+        with caplog.at_level(logging.WARNING):
+            loader.initialize()
+        assert any("skewed class distribution" in r.message
+                   for r in caplog.records)
+
+    def test_base_normalization_fits_on_train_only(self):
+        rng = np.random.default_rng(0)
+        x = np.concatenate([np.full((4, 3), 100.0, np.float32),
+                            rng.normal(5.0, 2.0, (8, 3)).astype(np.float32)])
+        loader = FullBatchLoader(None, data=x, minibatch_size=4,
+                                 class_lengths=[0, 4, 8],
+                                 normalization="mean_disp")
+        loader.initialize()
+        got = np.asarray(loader.data)
+        # train span normalized around 0; the outlier valid span is not
+        # folded into the statistics
+        assert abs(got[4:].mean()) < 0.5
+        assert got[:4].mean() > 5.0
+
+
+class TestDatasetReaders:
+    """Offline coverage of the canonical-format readers behind the
+    accuracy gates (tests/test_accuracy_gates.py)."""
+
+    def _write_idx(self, path, arr):
+        import struct
+        with open(path, "wb") as f:
+            dtype_code = 0x08   # ubyte
+            f.write(struct.pack(">HBB", 0, dtype_code, arr.ndim))
+            f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+            f.write(arr.astype(np.uint8).tobytes())
+
+    def test_mnist_reader(self, tmp_path):
+        from veles_tpu.loader.datasets import load_mnist, mnist_available
+        d = tmp_path / "mnist"
+        d.mkdir()
+        rng = np.random.RandomState(0)
+        self._write_idx(str(d / "train-images-idx3-ubyte"),
+                        rng.randint(0, 256, (20, 28, 28)))
+        self._write_idx(str(d / "train-labels-idx1-ubyte"),
+                        rng.randint(0, 10, (20,)))
+        # gz variant for the test split
+        import gzip, struct
+        arr = rng.randint(0, 256, (5, 28, 28)).astype(np.uint8)
+        with gzip.open(str(d / "t10k-images-idx3-ubyte.gz"), "wb") as f:
+            f.write(struct.pack(">HBB", 0, 8, 3)
+                    + struct.pack(">III", *arr.shape) + arr.tobytes())
+        lab = rng.randint(0, 10, (5,)).astype(np.uint8)
+        with gzip.open(str(d / "t10k-labels-idx1-ubyte.gz"), "wb") as f:
+            f.write(struct.pack(">HBB", 0, 8, 1)
+                    + struct.pack(">I", 5) + lab.tobytes())
+        assert mnist_available(str(tmp_path))
+        tx, ty, ex, ey = load_mnist(str(tmp_path))
+        assert tx.shape == (20, 784) and tx.dtype == np.float32
+        assert tx.max() <= 1.0
+        assert ex.shape == (5, 784)
+        np.testing.assert_array_equal(ey, lab)
+
+    def test_cifar_reader(self, tmp_path):
+        import pickle as pkl
+        from veles_tpu.loader.datasets import (cifar10_available,
+                                               load_cifar10)
+        d = tmp_path / "cifar-10-batches-py"
+        d.mkdir()
+        rng = np.random.RandomState(1)
+        for name, n in [("data_batch_%d" % i, 4) for i in range(1, 6)] + \
+                [("test_batch", 2)]:
+            with open(str(d / name), "wb") as f:
+                pkl.dump({b"data": rng.randint(0, 256, (n, 3072),
+                                               dtype=np.uint8),
+                          b"labels": list(rng.randint(0, 10, n))}, f)
+        assert cifar10_available(str(tmp_path))
+        tx, ty, ex, ey = load_cifar10(str(tmp_path))
+        assert tx.shape == (20, 32, 32, 3)
+        assert ex.shape == (2, 32, 32, 3)
+        assert tx.dtype == np.float32 and tx.max() <= 1.0

@@ -177,3 +177,47 @@ class TestConvAutoencoder:
         # reconstruct below the trivial all-zeros baseline RMSE
         baseline = float(np.sqrt((x_img ** 2).mean()))
         assert wf.decision.best_metric < 0.6 * baseline
+
+
+def test_custom_registered_loss_trains():
+    """r2: the evaluator registry seam (ref pluggable evaluator units) —
+    a loss registered by name drives training with no trainer changes."""
+    import jax.numpy as jnp
+    from sklearn.datasets import load_digits
+
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.ops import losses
+
+    if "scaled_xent_test" not in losses._LOSSES:
+        @losses.register_loss("scaled_xent_test", kind="class")
+        def scaled_xent(out, lbl, tgt, valid):
+            loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
+                out, lbl, valid)
+            return 2.0 * loss_sum, err_sum, n_valid, 1
+
+    prng.seed_all(5)
+    d = load_digits()
+    x = (d.data / 16.0).astype("float32")
+    y = d.target.astype("int32")
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                             class_lengths=[0, 297, 1500])
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": 0.05},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.05}],
+        loader=loader, loss="scaled_xent_test",
+        decision_config={"max_epochs": 4}, name="custom-loss")
+    wf.initialize()
+    wf.run()
+    assert wf.decision.best_metric < 0.3
+
+
+def test_unknown_loss_name_raises():
+    import pytest as _pytest
+
+    from veles_tpu.ops.losses import get_loss
+    with _pytest.raises(KeyError, match="registered"):
+        get_loss("nope")

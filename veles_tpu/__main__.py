@@ -42,7 +42,15 @@ class Main(object):
                        "'root.mnist.lr=0.1'")
         p.add_argument("--random-seed", type=int, default=None)
         p.add_argument("--snapshot", default=None,
-                       help="resume from a snapshot file")
+                       help="resume from a snapshot file, or 'auto' to "
+                       "resolve <workflow>_current in the snapshot dir "
+                       "(fresh start when absent — the restart-on-failure "
+                       "idiom; ref _current symlink snapshotter.py:397-409)")
+        p.add_argument("--snapshot-every", type=int, default=None,
+                       metavar="N", help="checkpoint every N epochs "
+                       "(injects a snapshotter into StandardWorkflow runs; "
+                       "pairs with --snapshot auto for preemption-safe "
+                       "training)")
         p.add_argument("--allow-remote-snapshot", action="store_true",
                        help="opt in to importing --snapshot from an "
                        "http(s) URL (pickle import runs code)")
@@ -128,12 +136,22 @@ class Main(object):
                              % args.workflow)
 
         def load(cls, **kwargs):
+            if args.snapshot_every is not None:
+                from veles_tpu.models.standard_workflow import \
+                    StandardWorkflow
+                if isinstance(cls, type) and \
+                        issubclass(cls, StandardWorkflow):
+                    kwargs.setdefault("snapshotter_config",
+                                      {"interval": args.snapshot_every})
             self.workflow = cls(**kwargs)
-            if args.snapshot:
+            snapshot = args.snapshot
+            if snapshot == "auto":
+                snapshot = self._resolve_auto_snapshot(self.workflow)
+            if snapshot:
                 from veles_tpu.services.snapshotter import SnapshotterBase
                 # initialize first so staged steps exist, then restore
                 self._pending_snapshot = SnapshotterBase.import_(
-                    args.snapshot,
+                    snapshot,
                     allow_remote=args.allow_remote_snapshot,
                     expected_sha256=args.snapshot_sha256)
             else:
@@ -192,6 +210,26 @@ class Main(object):
                 exec(compile(f.read(), args.config, "exec"), scope)
         for stmt in args.config_list:
             exec(stmt, {"root": root, "Range": Range})
+
+    @staticmethod
+    def _resolve_auto_snapshot(wf):
+        """--snapshot auto: follow <prefix>_current in the snapshot dir;
+        absent → fresh start (so the same command line both starts and
+        resumes a run — ref respawn semantics, veles/server.py:637-655
+        mapped to checkpoint-restart)."""
+        import os
+        snap = getattr(wf, "snapshotter", None)
+        directory = (snap.directory if snap is not None
+                     else root.common.dirs.get("snapshots", "snapshots"))
+        prefix = snap.prefix if snap is not None else wf.name
+        current = os.path.join(directory, "%s_current" % prefix)
+        if os.path.exists(current):
+            print("[auto-resume] %s" % os.path.realpath(current),
+                  file=sys.stderr)
+            return current
+        print("[auto-resume] no %s — fresh start" % current,
+              file=sys.stderr)
+        return None
 
     # ------------------------------------------------------------- launcher
     @staticmethod
@@ -411,10 +449,11 @@ class Main(object):
         if loader.carries_data:
             raise SystemExit("--ensemble-test needs an index loader with "
                              "an HBM/host-resident eval set")
-        if wf.trainer.loss not in ("softmax", "lm") or loader.labels is None:
+        from veles_tpu.ops.losses import get_loss
+        if get_loss(wf.trainer.loss)[1] != "class" or loader.labels is None:
             raise SystemExit("--ensemble-test is a mean-probability vote — "
                              "it needs a classification workflow with "
-                             "labels (loss=softmax)")
+                             "labels")
         members = json.load(open(args.ensemble_test))["members"]
         members = [m for m in members if "package" in m]
         if not members:

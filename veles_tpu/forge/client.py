@@ -8,6 +8,42 @@ import urllib.request
 from veles_tpu.logger import Logger
 
 
+def make_thumbnail(package_path, size=128):
+    """Render a PNG preview of a model package: the first weight tensor
+    reshaped to a square grayscale tile (what the reference's forge site
+    showed per model).  Returns PNG bytes, or None when the package has
+    no arrays."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from veles_tpu.services.export import import_workflow
+
+    try:
+        manifest, arrays = import_workflow(package_path)
+    except Exception:   # not an export package — upload proceeds bare
+        return None
+    for unit in manifest["units"]:
+        fname = unit["arrays"].get("weights")
+        if fname is None:
+            continue
+        w = np.asarray(arrays[fname], np.float32)
+        flat = w.ravel()
+        side = int(np.floor(np.sqrt(flat.size)))
+        if side < 2:
+            continue
+        tile = flat[:side * side].reshape(side, side)
+        lo, hi = float(tile.min()), float(tile.max())
+        tile = (tile - lo) / (hi - lo) if hi > lo else tile * 0
+        img = Image.fromarray((tile * 255).astype(np.uint8), "L")
+        img = img.resize((size, size), Image.NEAREST)
+        buf = io.BytesIO()
+        img.save(buf, "PNG")
+        return buf.getvalue()
+    return None
+
+
 class ForgeClient(Logger):
     def __init__(self, base_url, **kwargs):
         super(ForgeClient, self).__init__(**kwargs)
@@ -25,7 +61,12 @@ class ForgeClient(Logger):
     def details(self, name):
         return self._get_json("/service", query="details", name=name)
 
-    def upload(self, package_path, name, version, description=None):
+    def upload(self, package_path, name, version, description=None,
+               thumbnail=True):
+        """Upload a package; with ``thumbnail=True`` a PNG rendered from
+        the package's first weight tensor is attached (ref forge
+        thumbnails, forge_server.py:462).  ``thumbnail`` may also be a
+        path to a ready-made PNG."""
         with open(package_path, "rb") as f:
             data = f.read()
         params = {"name": name, "version": version}
@@ -38,7 +79,38 @@ class ForgeClient(Logger):
         with urllib.request.urlopen(req) as resp:
             manifest = json.loads(resp.read().decode())
         self.info("uploaded %s:%s (%d bytes)", name, version, len(data))
+        png = None
+        if thumbnail is True:
+            png = make_thumbnail(package_path)
+        elif thumbnail:
+            with open(thumbnail, "rb") as f:
+                png = f.read()
+        if png:
+            turl = "%s/thumbnail?%s" % (self.base_url, urllib.parse.urlencode(
+                {"name": name, "version": version}))
+            treq = urllib.request.Request(
+                turl, data=png, method="POST",
+                headers={"Content-Type": "image/png"})
+            with urllib.request.urlopen(treq) as resp:
+                manifest = json.loads(resp.read().decode())
         return manifest
+
+    def history(self, name):
+        """Version lineage newest-first (the reference kept this in git;
+        here it is the manifest's parent chain)."""
+        return self._get_json("/service", query="history", name=name)
+
+    def fetch_thumbnail(self, name, dest_path, version=None):
+        params = {"name": name}
+        if version:
+            params["version"] = version
+        url = "%s/thumbnail?%s" % (self.base_url,
+                                   urllib.parse.urlencode(params))
+        with urllib.request.urlopen(url) as resp:
+            data = resp.read()
+        with open(dest_path, "wb") as f:
+            f.write(data)
+        return dest_path
 
     def fetch(self, name, dest_path, version=None):
         params = {"name": name}
@@ -61,16 +133,18 @@ def main(argv=None):
     import argparse
     p = argparse.ArgumentParser(description="veles_tpu model forge")
     sub = p.add_subparsers(dest="cmd", required=True)
-    for name in ("list", "details", "upload", "fetch"):
+    for name in ("list", "details", "history", "upload", "fetch",
+                 "thumbnail"):
         sp = sub.add_parser(name)
         sp.add_argument("--url", required=True, help="forge server URL")
-        if name in ("details", "upload", "fetch"):
+        if name in ("details", "history", "upload", "fetch", "thumbnail"):
             sp.add_argument("name")
         if name == "upload":
             sp.add_argument("package")
             sp.add_argument("version")
             sp.add_argument("--description")
-        if name == "fetch":
+            sp.add_argument("--no-thumbnail", action="store_true")
+        if name in ("fetch", "thumbnail"):
             sp.add_argument("dest")
             sp.add_argument("--version")
     ps = sub.add_parser("serve")
@@ -94,11 +168,16 @@ def main(argv=None):
         print(_json.dumps(client.list(), indent=2))
     elif a.cmd == "details":
         print(_json.dumps(client.details(a.name), indent=2))
+    elif a.cmd == "history":
+        print(_json.dumps(client.history(a.name), indent=2))
     elif a.cmd == "upload":
-        client.upload(a.package, a.name, a.version, a.description)
+        client.upload(a.package, a.name, a.version, a.description,
+                      thumbnail=not a.no_thumbnail)
     elif a.cmd == "fetch":
         dest, ver = client.fetch(a.name, a.dest, a.version)
         print("%s (version %s)" % (dest, ver))
+    elif a.cmd == "thumbnail":
+        print(client.fetch_thumbnail(a.name, a.dest, a.version))
     return 0
 
 

@@ -60,6 +60,7 @@ class ForgeStore(object):
         return out
 
     def upload(self, name, version, data, description=None):
+        import time
         self._check_name(name)
         self._check_name(version)
         with self._lock:
@@ -69,15 +70,63 @@ class ForgeStore(object):
                 f.write(data)
             m = self.manifest(name) or {"name": name, "versions": {},
                                         "latest": None}
+            # lineage: each version records its parent (the latest at
+            # upload time) — the linear history the reference kept in git
+            # (ref forge_server.py:462 git-based versioning)
             m["versions"][version] = {
                 "description": description,
                 "sha1": hashlib.sha1(data).hexdigest(),
                 "size": len(data),
+                "created": time.time(),
+                "parent": m["latest"],
             }
             m["latest"] = version
             with open(self._manifest_path(name), "w") as f:
                 json.dump(m, f, indent=2)
             return m
+
+    def put_thumbnail(self, name, version, data):
+        self._check_name(name)
+        self._check_name(version)
+        with self._lock:
+            m = self.manifest(name)
+            if m is None or version not in m["versions"]:
+                raise KeyError("no version %r of %r" % (version, name))
+            vdir = os.path.join(self.directory, name, version)
+            with open(os.path.join(vdir, "thumbnail.png"), "wb") as f:
+                f.write(data)
+            m["versions"][version]["thumbnail"] = True
+            with open(self._manifest_path(name), "w") as f:
+                json.dump(m, f, indent=2)
+            return m
+
+    def thumbnail(self, name, version=None):
+        with self._lock:
+            m = self.manifest(name)
+            if m is None:
+                raise KeyError("no such model %r" % name)
+            version = version or m["latest"]
+            self._check_name(version)
+            path = os.path.join(self.directory, name, version,
+                                "thumbnail.png")
+            if not os.path.exists(path):
+                raise KeyError("no thumbnail for %s:%s" % (name, version))
+            with open(path, "rb") as f:
+                return f.read(), version
+
+    def history(self, name):
+        """Version lineage, newest first (walks parent links)."""
+        m = self.manifest(name)
+        if m is None:
+            raise KeyError("no such model %r" % name)
+        out, version = [], m["latest"]
+        seen = set()
+        while version is not None and version not in seen:
+            seen.add(version)
+            entry = dict(m["versions"][version], version=version)
+            out.append(entry)
+            version = entry.get("parent")
+        return out
 
     def fetch(self, name, version=None):
         with self._lock:
@@ -124,7 +173,19 @@ class _Handler(BaseHTTPRequestHandler):
                     if m is None:
                         return self._error(404, "no such model")
                     return self._json(m)
+                if query == "history":
+                    return self._json(self.store.history(q["name"]))
                 return self._error(400, "unknown query %r" % query)
+            if url.path == "/thumbnail":
+                data, version = self.store.thumbnail(q["name"],
+                                                     q.get("version"))
+                self.send_response(200)
+                self.send_header("Content-Type", "image/png")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Forge-Version", version)
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if url.path == "/fetch":
                 data, version = self.store.fetch(q["name"], q.get("version"))
                 self.send_response(200)
@@ -142,13 +203,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         url = urllib.parse.urlparse(self.path)
         q = dict(urllib.parse.parse_qsl(url.query))
-        if url.path != "/upload":
+        if url.path not in ("/upload", "/thumbnail"):
             return self._error(404, "unknown path")
         try:
             length = int(self.headers.get("Content-Length", 0))
             data = self.rfile.read(length)
-            m = self.store.upload(q["name"], q["version"], data,
-                                  q.get("description"))
+            if url.path == "/upload":
+                m = self.store.upload(q["name"], q["version"], data,
+                                      q.get("description"))
+            else:
+                m = self.store.put_thumbnail(q["name"], q["version"], data)
             return self._json(m)
         except (KeyError, ValueError) as e:
             return self._error(400, str(e))

@@ -67,6 +67,16 @@ class Loader(Unit, metaclass=LoaderRegistry):
     def create_minibatch_data(self):
         """Optional post-load hook (device placement etc.)."""
 
+    def get_raw_labels(self):
+        """Per-sample label values in dataset order (any hashable type),
+        or None.  Drives the base label analysis; FullBatchLoader returns
+        its original_labels."""
+        return None
+
+    def set_mapped_labels(self, mapped):
+        """Receive int32 class indices after label mapping.  Override in
+        loaders that store labels."""
+
     # -- derived sizes -------------------------------------------------------
     @property
     def total_samples(self):
@@ -91,6 +101,7 @@ class Loader(Unit, metaclass=LoaderRegistry):
         self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s loaded an empty dataset" % self)
+        self._analyze_labels()
         self._apply_ensemble_subset()
         if self.minibatch_size > max(self.class_lengths):
             self.minibatch_size = int(max(self.class_lengths))
@@ -101,6 +112,63 @@ class Loader(Unit, metaclass=LoaderRegistry):
         self.debug("dataset: %s samples %s",
                    self.total_samples,
                    dict(zip(CLASS_NAMES, self.class_lengths)))
+
+    def _analyze_labels(self):
+        """Dataset label analysis (ref veles/loader/base.py:755-819):
+        arbitrary label values (strings, sparse ints, ...) map to dense
+        class indices; per-class counts per split are recorded and
+        validated — labels appearing in eval splits but never trained on
+        are warned about, as is heavy class skew."""
+        raw = self.get_raw_labels()
+        self.labels_mapping = None
+        self.label_distribution = None
+        if raw is None:
+            return
+        raw = np.asarray(raw)
+        if raw.ndim != 1:
+            return   # sequence/dense label tensors (LM targets, frame
+                     # labels) are not per-sample class ids
+        if len(raw) != self.total_samples:
+            raise ValueError("%d labels for %d samples"
+                             % (len(raw), self.total_samples))
+        uniques = sorted(set(raw.tolist()))
+        dense_ints = all(isinstance(u, int) for u in uniques) and \
+            uniques == list(range(len(uniques)))
+        self.labels_mapping = {u: i for i, u in enumerate(uniques)}
+        if dense_ints:
+            mapped = raw.astype(np.int32)
+        else:
+            lut = self.labels_mapping
+            mapped = np.fromiter((lut[v] for v in raw.tolist()),
+                                 np.int32, len(raw))
+            self.info("mapped %d distinct label values to class indices "
+                      "0..%d", len(uniques), len(uniques) - 1)
+        self.set_mapped_labels(mapped)
+        # per-split distribution + validation
+        dist = {}
+        spans = [(0, self.class_offsets[TEST]),
+                 (self.class_offsets[TEST], self.class_offsets[VALID]),
+                 (self.class_offsets[VALID], self.total_samples)]
+        for cls, (lo, hi) in zip(CLASS_NAMES, spans):
+            if hi > lo:
+                counts = np.bincount(mapped[lo:hi], minlength=len(uniques))
+                dist[cls] = {str(u): int(c)
+                             for u, c in zip(uniques, counts)}
+        self.label_distribution = dist
+        train = dist.get("train")
+        if train:
+            untrained = [u for u, c in train.items() if c == 0]
+            for other in ("test", "validation"):
+                leaked = [u for u in untrained
+                          if dist.get(other, {}).get(u, 0) > 0]
+                if leaked:
+                    self.warning("%s split contains classes never seen in "
+                                 "training: %s", other, leaked[:10])
+            counts = [c for c in train.values() if c]
+            if counts and max(counts) > 10 * min(counts):
+                self.warning("skewed class distribution in train: "
+                             "min %d vs max %d samples",
+                             min(counts), max(counts))
 
     def _apply_ensemble_subset(self):
         """Restrict the train span to a per-instance random subset (ref
@@ -199,4 +267,10 @@ class Loader(Unit, metaclass=LoaderRegistry):
             self._order = st["order"].copy()
 
     def get_metric_values(self):
-        return {"epochs": self.epoch_number}
+        out = {"epochs": self.epoch_number}
+        if getattr(self, "labels_mapping", None):
+            out["labels"] = {
+                "n_classes": len(self.labels_mapping),
+                "distribution": self.label_distribution,
+            }
+        return out

@@ -1,0 +1,98 @@
+"""Standard-format dataset readers for the BASELINE accuracy gates
+(ref: the MNIST/CIFAR workflows the reference publishes results for,
+docs/source/manualrst_veles_algorithms.rst:32-52).
+
+Zero-egress friendly: these only *read* the canonical on-disk formats —
+MNIST idx(.gz) files and the CIFAR-10 python batches — from the datasets
+directory; nothing is downloaded.  ``*_available`` predicates let tests
+skip-not-fail when the data is not mounted (VERDICT r1 #10)."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from veles_tpu.config import root
+
+
+def datasets_dir():
+    return root.common.dirs.get("datasets", "datasets")
+
+
+# ----------------------------------------------------------------- MNIST
+_MNIST_FILES = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+
+def _mnist_dir(directory=None):
+    return os.path.join(directory or datasets_dir(), "mnist")
+
+
+def _idx_path(directory, stem):
+    for suffix in ("", ".gz"):
+        p = os.path.join(directory, stem + suffix)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def mnist_available(directory=None):
+    d = _mnist_dir(directory)
+    return all(_idx_path(d, s) is not None for s in _MNIST_FILES)
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError("not an idx file: %s" % path)
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def load_mnist(directory=None):
+    """(train_x[60000,784] f32 0..1, train_y, test_x[10000,784], test_y)."""
+    d = _mnist_dir(directory)
+    out = []
+    for stem in _MNIST_FILES:
+        path = _idx_path(d, stem)
+        if path is None:
+            raise FileNotFoundError("missing MNIST file %s under %s"
+                                    % (stem, d))
+        arr = _read_idx(path)
+        if arr.ndim == 3:
+            arr = arr.reshape(len(arr), -1).astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.int32)
+        out.append(arr)
+    return tuple(out)
+
+
+# --------------------------------------------------------------- CIFAR-10
+def _cifar_dir(directory=None):
+    return os.path.join(directory or datasets_dir(), "cifar-10-batches-py")
+
+
+def cifar10_available(directory=None):
+    d = _cifar_dir(directory)
+    return (os.path.exists(os.path.join(d, "data_batch_1"))
+            and os.path.exists(os.path.join(d, "test_batch")))
+
+
+def load_cifar10(directory=None):
+    """(train_x[50000,32,32,3] f32 0..1, train_y, test_x, test_y)."""
+    d = _cifar_dir(directory)
+
+    def read(name):
+        with open(os.path.join(d, name), "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        x = batch[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return (x.astype(np.float32) / 255.0,
+                np.asarray(batch[b"labels"], np.int32))
+
+    xs, ys = zip(*(read("data_batch_%d" % i) for i in range(1, 6)))
+    test_x, test_y = read("test_batch")
+    return (np.concatenate(xs), np.concatenate(ys), test_x, test_y)

@@ -40,6 +40,14 @@ class FullBatchLoader(Loader):
         if self.on_device not in (True, False, "host", "defer"):
             raise ValueError("on_device must be True/False/'host'/'defer'")
         self.sample_shape = None         # set in host mode
+        #: normalizer applied to the whole dataset before placement —
+        #: fitted on the TRAIN span only (ref normalizer integration in
+        #: Loader base, veles/loader/base.py:344-349); name or instance
+        norm = kwargs.get("normalization", "none")
+        if isinstance(norm, str):
+            from veles_tpu.loader.normalization import make_normalizer
+            norm = make_normalizer(norm)
+        self.normalizer = norm
 
     def load_data(self):
         if self.original_data is None:
@@ -57,10 +65,30 @@ class FullBatchLoader(Loader):
             raise ValueError("class_lengths %s != %d samples"
                              % (self.class_lengths, n))
 
+    # -- base label analysis hooks (veles/loader/base.py:755-819) ---------
+    def get_raw_labels(self):
+        return self.original_labels
+
+    def set_mapped_labels(self, mapped):
+        self.original_labels = mapped
+
+    def _normalize_dataset(self):
+        from veles_tpu.loader.normalization import NoneNormalizer
+        if isinstance(self.normalizer, NoneNormalizer) or \
+                getattr(self, "_dataset_prenormalized", False):
+            return   # subclass (image loader) normalized during decode
+        data = np.asarray(self.original_data)
+        train_start = self.class_offsets[1]   # after test+valid spans
+        self.normalizer.analyze(data[train_start:] if
+                                train_start < len(data) else data)
+        self.original_data = self.normalizer.normalize(
+            data).reshape(data.shape)
+
     def create_minibatch_data(self):
         """One host→device transfer for the whole dataset (ref fullbatch
         on-device residency, fullbatch.py:164-242).  On device OOM the
         loader degrades to host-streaming mode instead of dying."""
+        self._normalize_dataset()
         if self.on_device is True:
             try:
                 self.data = jnp.asarray(self.original_data)

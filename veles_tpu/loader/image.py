@@ -145,6 +145,7 @@ class FullBatchImageLoader(FullBatchLoader):
         data = np.stack(images)
         self.normalizer.analyze(data)
         data = self.normalizer.normalize(data).reshape(data.shape)
+        self._dataset_prenormalized = True   # base must not re-normalize
         self.original_data = data
         self.original_labels = (np.asarray(labels, np.int32)
                                 if self.labeled and labels else None)

@@ -146,7 +146,8 @@ class StagedTrainer(Unit):
         """Index mode: gather the minibatch from HBM-resident arrays
         (``_gather`` is the plain jnp.take on one device, or the
         psum_scatter collective gather when the dataset is row-sharded)."""
-        tgt = (self._gather(targets, idx) if self.loss == "mse" else None)
+        tgt = (self._gather(targets, idx)
+               if losses.get_loss(self.loss)[1] == "regression" else None)
         return self._loss_from_batch(
             params, self._gather(data, idx),
             self._gather(labels, idx), tgt, valid, train, key)
@@ -162,19 +163,9 @@ class StagedTrainer(Unit):
                 aux_total = aux_total + float(
                     layer.cfg.get("aux_weight", 0.01)) * la
                 layer.last_aux = None
-        if self.loss == "softmax":
-            loss_sum, err_sum, n_valid = losses.masked_softmax_xent(
-                out, lbl, valid)
-            n_features = 1
-        elif self.loss == "lm":
-            # next-token objective: predict x[t+1] from logits at t
-            loss_sum, err_sum, n_valid = losses.masked_seq_xent(
-                out[:, :-1], lbl[:, 1:], valid)
-            n_features = 1
-        else:  # mse
-            loss_sum, n_valid, n_features = losses.masked_mse(
-                out, tgt, valid)
-            err_sum = jnp.asarray(0.0)
+        loss_fn, _ = losses.get_loss(self.loss)
+        loss_sum, err_sum, n_valid, n_features = loss_fn(out, lbl, tgt,
+                                                         valid)
         # optimized loss is per-element mean (keeps lr scale comparable
         # across output widths); stats carry the raw sum for epoch metrics
         denom = jnp.maximum(n_valid, 1.0) * n_features
@@ -190,7 +181,7 @@ class StagedTrainer(Unit):
         labels = (loader.labels if loader.labels is not None
                   else jnp.zeros((loader.total_samples,), jnp.int32))
         targets = loader.targets
-        if self.loss == "mse" and targets is None:
+        if losses.get_loss(self.loss)[1] == "regression" and targets is None:
             targets = loader.data   # autoencoder: reconstruct the input
         hypers = self._hypers
 

@@ -49,7 +49,9 @@ class StandardWorkflow(Workflow):
         self.forwards = [Forward(self, lay, self.trainer)
                          for lay in self.trainer.layers]
 
-        decision_cls = DecisionGD if loss in ("softmax", "lm") else DecisionMSE
+        from veles_tpu.ops.losses import get_loss
+        decision_cls = (DecisionGD if get_loss(loss)[1] == "class"
+                        else DecisionMSE)
         self.decision = decision_cls(self, **(decision_config or {}))
         self.decision.loader = self.loader
         self.decision.trainer = self.trainer

@@ -70,3 +70,55 @@ def masked_mse(output, target, valid):
     diff = (output - target).astype(jnp.float32).reshape(output.shape[0], -1)
     se = jnp.sum(diff * diff, axis=1)
     return jnp.sum(se * valid), jnp.sum(valid), diff.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# Pluggable evaluator registry (the extension seam the reference had via
+# its evaluator unit registry — new losses register by name and the
+# trainer/StandardWorkflow pick them up without modification).
+#
+# An entry is ``(fn, kind)``:
+#   fn(out, labels, targets, valid) ->
+#       (loss_sum, err_sum, n_valid, n_features)  — float32 scalars/ints
+#   kind: "class" (Decision watches the error count) or
+#         "regression" (Decision watches the loss)
+# ---------------------------------------------------------------------------
+
+_LOSSES = {}
+
+
+def register_loss(name, kind="class"):
+    """Decorator: ``@register_loss("focal")`` adds an evaluator usable as
+    ``StandardWorkflow(loss="focal")``."""
+    def deco(fn):
+        _LOSSES[name] = (fn, kind)
+        return fn
+    return deco
+
+
+def get_loss(name):
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise KeyError("unknown loss %r — registered: %s"
+                       % (name, sorted(_LOSSES))) from None
+
+
+@register_loss("softmax", kind="class")
+def _softmax_loss(out, labels, targets, valid):
+    loss_sum, err_sum, n_valid = masked_softmax_xent(out, labels, valid)
+    return loss_sum, err_sum, n_valid, 1
+
+
+@register_loss("lm", kind="class")
+def _lm_loss(out, labels, targets, valid):
+    # next-token objective: predict token t+1 from logits at t
+    loss_sum, err_sum, n_valid = masked_seq_xent(
+        out[:, :-1], labels[:, 1:], valid)
+    return loss_sum, err_sum, n_valid, 1
+
+
+@register_loss("mse", kind="regression")
+def _mse_loss(out, labels, targets, valid):
+    loss_sum, n_valid, n_features = masked_mse(out, targets, valid)
+    return loss_sum, jnp.asarray(0.0), n_valid, n_features

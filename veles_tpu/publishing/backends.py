@@ -27,6 +27,7 @@ class BackendRegistry(MappedRegistry):
 
 class ReportBackend(object, metaclass=BackendRegistry):
     EXT = ".txt"
+    BINARY = False   # render() returns str; True → bytes
 
     def render(self, report):
         raise NotImplementedError
@@ -116,3 +117,101 @@ class JSONBackend(ReportBackend):
     def render(self, report):
         return json.dumps(report, indent=2, cls=_ReportEncoder,
                           sort_keys=True)
+
+
+class PDFBackend(ReportBackend):
+    """PDF report via matplotlib's PdfPages (ref the pdf backend,
+    veles/publishing/) — page 1: title + metrics table + unit stats;
+    then one page per plot image."""
+
+    MAPPING = "pdf"
+    EXT = ".pdf"
+    BINARY = True
+
+    def render(self, report):
+        import io
+        import os
+
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        from matplotlib.backends.backend_pdf import PdfPages
+
+        buf = io.BytesIO()
+        with PdfPages(buf) as pdf:
+            fig = plt.figure(figsize=(8.27, 11.69))     # A4
+            fig.text(0.5, 0.95, report.get("name", "workflow"),
+                     ha="center", fontsize=18, weight="bold")
+            fig.text(0.5, 0.92, "Generated %s" % report.get("date", ""),
+                     ha="center", fontsize=9, style="italic")
+            y = 0.86
+            if report.get("description"):
+                fig.text(0.1, y, report["description"], fontsize=10,
+                         wrap=True)
+                y -= 0.06
+            metrics = report.get("metrics") or {}
+            if metrics:
+                fig.text(0.1, y, "Metrics", fontsize=13, weight="bold")
+                y -= 0.03
+                for k, v in sorted(metrics.items()):
+                    fig.text(0.12, y, str(k), fontsize=9)
+                    fig.text(0.55, y, _fmt_value(v)[:60], fontsize=9)
+                    y -= 0.022
+                    if y < 0.1:
+                        break
+            units = report.get("units") or []
+            if units and y > 0.2:
+                y -= 0.03
+                fig.text(0.1, y, "Units", fontsize=13, weight="bold")
+                y -= 0.03
+                for u in units:
+                    fig.text(0.12, y, u["name"], fontsize=9)
+                    fig.text(0.55, y, "%d runs, %.3f s"
+                             % (u["runs"], u["time"]), fontsize=9)
+                    y -= 0.022
+                    if y < 0.08:
+                        break
+            pdf.savefig(fig)
+            plt.close(fig)
+            for p in report.get("plots") or []:
+                if not os.path.exists(p):
+                    continue
+                img = plt.imread(p)
+                fig = plt.figure(figsize=(8.27, 11.69))
+                ax = fig.add_axes([0.05, 0.2, 0.9, 0.7])
+                ax.imshow(img)
+                ax.axis("off")
+                ax.set_title(os.path.basename(p))
+                pdf.savefig(fig)
+                plt.close(fig)
+        return buf.getvalue()
+
+
+class ConfluenceBackend(ReportBackend):
+    """Confluence wiki-markup report (ref the confluence backend,
+    veles/publishing/).  Renders the storage markup offline; posting to a
+    server is the caller's transport concern (zero-egress friendly)."""
+
+    MAPPING = "confluence"
+    EXT = ".confluence"
+
+    def render(self, report):
+        lines = ["h1. %s" % report.get("name", "workflow"),
+                 "_Generated %s_" % report.get("date", ""), ""]
+        if report.get("description"):
+            lines += [report["description"], ""]
+        metrics = report.get("metrics") or {}
+        if metrics:
+            lines += ["h2. Metrics", "||metric||value||"]
+            lines += ["|%s|%s|" % (k, _fmt_value(v))
+                      for k, v in sorted(metrics.items())]
+            lines.append("")
+        units = report.get("units") or []
+        if units:
+            lines += ["h2. Units", "||unit||runs||total s||"]
+            lines += ["|%s|%d|%.3f|" % (u["name"], u["runs"], u["time"])
+                      for u in units]
+            lines.append("")
+        for p in report.get("plots") or []:
+            lines.append("!%s!" % p)
+        return "\n".join(lines)

@@ -51,8 +51,8 @@ class Publisher(Unit):
         for name in self.backends:
             backend = ReportBackend.mapping[name]()
             path = os.path.join(self.directory, stem + backend.EXT)
-            text = backend.render(report)
-            with open(path, "w") as f:
-                f.write(text)
+            rendered = backend.render(report)
+            with open(path, "wb" if backend.BINARY else "w") as f:
+                f.write(rendered)
             self.written.append(path)
             self.info("published %s report: %s", name, path)

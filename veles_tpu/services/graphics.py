@@ -64,7 +64,9 @@ def _renderer(kind):
         cls = {"curve": plotting.AccumulatingPlotter,
                "matrix": plotting.MatrixPlotter,
                "images": plotting.ImagePlotter,
-               "histogram": plotting.HistogramPlotter}.get(kind)
+               "histogram": plotting.HistogramPlotter,
+               "multi_histogram": plotting.MultiHistogramPlotter,
+               "minmax": plotting.MinMaxPlotter}.get(kind)
         _RENDERERS[kind] = cls(None) if cls is not None else None
     return _RENDERERS[kind]
 
@@ -114,7 +116,9 @@ class GraphicsClient(Logger):
         self.info("graphics client subscribed to %s", self.endpoint)
         return self
 
-    def render_all(self):
+    def render_all(self, fmt="png"):
+        """Write the most recent payload per plot name; ``fmt="pdf"`` is
+        the reference's SIGUSR2 PDF export (graphics_client.py)."""
         import os
         os.makedirs(self.directory, exist_ok=True)
         written = []
@@ -122,10 +126,21 @@ class GraphicsClient(Logger):
             plotter = _renderer(payload.get("kind"))
             if plotter is None:
                 continue
-            path = os.path.join(self.directory, "%s.png" % name)
+            path = os.path.join(self.directory, "%s.%s" % (name, fmt))
             plotter.render(payload, path)
             written.append(path)
         return written
+
+    def install_pdf_signal(self):
+        """SIGUSR2 → export every current plot as PDF (ref
+        graphics_client PDF export via SIGUSR2).  Main thread only."""
+        import signal
+
+        def handler(signum, frame):
+            paths = self.render_all(fmt="pdf")
+            self.info("SIGUSR2: exported %d pdf plot(s)", len(paths))
+
+        signal.signal(signal.SIGUSR2, handler)
 
     def stop(self):
         self._stop = True
@@ -144,6 +159,7 @@ def main(argv=None):
     p.add_argument("--interval", type=float, default=2.0)
     args = p.parse_args(argv)
     client = GraphicsClient(args.endpoint, args.directory).start()
+    client.install_pdf_signal()   # kill -USR2 <pid> → PDF export
     try:
         while True:
             time.sleep(args.interval)

@@ -183,6 +183,90 @@ class ImagePlotter(PlotterBase):
         plt.close(fig)
 
 
+class MultiHistogramPlotter(PlotterBase):
+    """Grid of histograms, one per named tensor — e.g. every layer's
+    weights at once (ref MultiHistogram, veles/plotting_units.py)."""
+
+    def __init__(self, workflow, sources=None, bins=30, **kwargs):
+        super(MultiHistogramPlotter, self).__init__(workflow, **kwargs)
+        #: dict name → array-or-callable, or a callable returning a dict
+        self.sources = sources
+        self.bins = bins
+
+    def payload(self):
+        src = self.sources() if callable(self.sources) else self.sources
+        if not src:
+            return None
+        hists = []
+        for name in sorted(src):
+            v = src[name]() if callable(src[name]) else src[name]
+            counts, edges = np.histogram(np.asarray(v).ravel(),
+                                         bins=self.bins)
+            hists.append({"name": name, "counts": counts.tolist(),
+                          "edges": edges.tolist()})
+        return {"kind": "multi_histogram", "histograms": hists}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        hists = payload["histograms"]
+        n = len(hists)
+        cols = int(np.ceil(np.sqrt(n)))
+        rows = int(np.ceil(n / cols))
+        fig, axes = plt.subplots(rows, cols,
+                                 figsize=(cols * 3.2, rows * 2.4))
+        for i, ax in enumerate(np.atleast_1d(axes).ravel()):
+            if i >= n:
+                ax.axis("off")
+                continue
+            h = hists[i]
+            edges = np.asarray(h["edges"])
+            ax.bar(edges[:-1], h["counts"], width=np.diff(edges),
+                   align="edge")
+            ax.set_title(h["name"], fontsize=8)
+            ax.tick_params(labelsize=6)
+        fig.tight_layout()
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
+class MinMaxPlotter(PlotterBase):
+    """Envelope of a tensor over epochs: min/mean/max curves with a
+    filled band (ref the max-min accumulator plotters,
+    veles/plotting_units.py:52-822)."""
+
+    def __init__(self, workflow, source=None, ylabel="value", **kwargs):
+        super(MinMaxPlotter, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.ylabel = ylabel
+        self.mins, self.means, self.maxs = [], [], []
+
+    def payload(self):
+        v = self.source() if callable(self.source) else self.source
+        if v is None:
+            return None
+        arr = np.asarray(v).ravel()
+        self.mins.append(float(arr.min()))
+        self.means.append(float(arr.mean()))
+        self.maxs.append(float(arr.max()))
+        return {"kind": "minmax", "min": list(self.mins),
+                "mean": list(self.means), "max": list(self.maxs),
+                "ylabel": self.ylabel}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        fig, ax = plt.subplots(figsize=(6, 4))
+        xs = np.arange(len(payload["mean"]))
+        ax.fill_between(xs, payload["min"], payload["max"], alpha=0.25)
+        ax.plot(xs, payload["mean"], marker="o", markersize=3)
+        ax.plot(xs, payload["min"], linewidth=0.8)
+        ax.plot(xs, payload["max"], linewidth=0.8)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel(payload["ylabel"])
+        ax.grid(True, alpha=0.3)
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
 class HistogramPlotter(PlotterBase):
     """Histogram of a tensor (ref plotting_units histogram family)."""
 
