@@ -121,3 +121,54 @@ class TestEnsemble:
         ens_err = tester.error_rate(x_te, y_te)
         assert ens_err <= max(member_errs) + 1e-9
         assert ens_err < 0.15
+
+
+class TestGrayEncoding:
+    """r2: the reference's gray-code binary chromosomes
+    (ref veles/genetics/core.py gray encoding)."""
+
+    def test_gray_roundtrip(self):
+        from veles_tpu.genetics.core import gray_decode, gray_encode
+        vals = np.array([0.0, 0.25, 0.5, 0.75, 1.0])
+        bits = gray_encode(vals, 16)
+        np.testing.assert_allclose(gray_decode(bits), vals, atol=1e-4)
+
+    def test_adjacent_ints_differ_by_one_bit(self):
+        from veles_tpu.genetics.core import gray_encode
+        nbits = 8
+        scale = 2 ** nbits - 1
+        prev = gray_encode(np.array([0.0]), nbits)[0]
+        for i in range(1, 256):
+            cur = gray_encode(np.array([i / scale]), nbits)[0]
+            assert int(np.sum(prev != cur)) == 1, i
+            prev = cur
+
+    def test_gray_population_optimizes(self):
+        from veles_tpu import prng
+        from veles_tpu.genetics.core import Range
+        from veles_tpu.genetics.optimizer import GeneticsOptimizer
+        prng.seed_all(17)
+        config = {"x": Range(-5.0, 5.0), "y": Range(-5.0, 5.0)}
+
+        def fitness(cfg):
+            return -(cfg["x"] - 1.0) ** 2 - (cfg["y"] + 2.0) ** 2
+
+        opt = GeneticsOptimizer(config, fitness, size=24, generations=25,
+                                encoding="gray", nbits=12)
+        best = opt.run()
+        assert abs(best["x"] - 1.0) < 0.5
+        assert abs(best["y"] + 2.0) < 0.5
+        assert len(opt.stats_history) == 25
+        assert opt.stats_history[-1]["best"] >= opt.stats_history[0]["best"]
+
+    def test_early_stop_on_convergence(self):
+        from veles_tpu import prng
+        from veles_tpu.genetics.core import Range
+        from veles_tpu.genetics.optimizer import GeneticsOptimizer
+        prng.seed_all(3)
+        config = {"x": Range(0.0, 1.0)}
+        opt = GeneticsOptimizer(config, lambda cfg: 7.0, size=6,
+                                generations=50, early_stop_eps=1e-9)
+        opt.run()
+        # constant fitness -> converged after the first generation
+        assert len(opt.history) < 50

@@ -280,3 +280,25 @@ class TestNewPublishingBackends:
         assert "h1. pub2" in text
         assert "||unit||runs||total s||" in text
         assert "|trainer|5|1.250|" in text
+
+
+class TestUnitStatsPlotter:
+    def test_renders_units_and_memory(self, tmp_path):
+        import jax.numpy as jnp
+
+        from veles_tpu.services.plotting import UnitStatsPlotter
+        from veles_tpu.units import TrivialUnit
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="stats")
+        for i, t in enumerate((0.5, 0.2, 0.9)):
+            u = TrivialUnit(wf, name="unit%d" % i)
+            u.run_count = i + 1
+            u.run_time = t
+        keep = jnp.ones((64, 64))   # something live on a device
+        p = UnitStatsPlotter(wf, directory=str(tmp_path), name="ustats")
+        p.run()
+        payload = bus.snapshot()[-1]
+        assert payload["kind"] == "unit_stats"
+        assert payload["units"][0]["name"] == "unit2"   # sorted by time
+        assert os.path.getsize(p.last_file) > 1000
+        del keep

@@ -87,6 +87,10 @@ class Main(object):
         p.add_argument("--optimize-workers", type=int, default=1,
                        help="concurrent fitness evaluations (each is its "
                        "own training subprocess; >1 pins children to cpu)")
+        p.add_argument("--optimize-encoding", default="float",
+                       choices=("float", "gray"),
+                       help="chromosome encoding: float vector or the "
+                       "reference's gray-code binary genome")
         p.add_argument("--ensemble-train", default=None, metavar="N:RATIO",
                        help="train N instances on random train subsets of "
                        "RATIO (ref ensemble/model_workflow.py:137)")
@@ -361,6 +365,7 @@ class Main(object):
 
         opt = GeneticsOptimizer(
             cfg, evaluate, size=size, generations=generations,
+            encoding=args.optimize_encoding,
             executor_map=self._executor_map(args.optimize_workers))
         best = opt.run()
         if opt.population.best.fitness == float("-inf"):

@@ -86,13 +86,19 @@ class ForgeClient(Logger):
             with open(thumbnail, "rb") as f:
                 png = f.read()
         if png:
-            turl = "%s/thumbnail?%s" % (self.base_url, urllib.parse.urlencode(
-                {"name": name, "version": version}))
-            treq = urllib.request.Request(
-                turl, data=png, method="POST",
-                headers={"Content-Type": "image/png"})
-            with urllib.request.urlopen(treq) as resp:
-                manifest = json.loads(resp.read().decode())
+            # best-effort: the package upload already succeeded — a forge
+            # server without the thumbnail endpoint must not fail it
+            try:
+                turl = "%s/thumbnail?%s" % (
+                    self.base_url, urllib.parse.urlencode(
+                        {"name": name, "version": version}))
+                treq = urllib.request.Request(
+                    turl, data=png, method="POST",
+                    headers={"Content-Type": "image/png"})
+                with urllib.request.urlopen(treq) as resp:
+                    manifest = json.loads(resp.read().decode())
+            except Exception as e:   # noqa: BLE001 — old server/network
+                self.warning("thumbnail upload skipped: %s", e)
         return manifest
 
     def history(self, name):

@@ -4,10 +4,12 @@ selection, crossover + mutation pipelines `core.py:371-430`).
 
 Kept from the reference: ``Range`` wrappers make any config leaf tunable;
 selection = roulette or tournament; crossover = uniform / single-point /
-blend (arithmetic); mutation = gaussian jitter / uniform reset; elitism.
-Dropped: gray-code binary chromosomes (the float encoding dominates in the
-reference's own defaults); process forking (fitness evaluation is a
-callable — the CLI wires it to a full training run)."""
+blend (arithmetic); mutation = gaussian jitter (float) / bit flips
+(binary); elitism; **gray-code binary chromosomes**
+(``encoding="gray"`` — the reference's binary encoding, where adjacent
+values differ by one bit so a single mutation moves the phenotype
+minimally).  Dropped: process forking inside the GA (fitness evaluation
+is a callable — the CLI wires it to a full training subprocess)."""
 
 import numpy as np
 
@@ -63,11 +65,36 @@ def _apply(config, genes, path):
     return out
 
 
-class Chromosome(object):
-    """Unit-interval float vector + fitness (ref core.py:133)."""
+def gray_decode(bits):
+    """[n_genes, nbits] 0/1 gray-code bits → unit floats [n_genes]."""
+    bits = np.asarray(bits, np.uint8)
+    binary = np.bitwise_xor.accumulate(bits, axis=1)   # gray → binary
+    weights = 2.0 ** np.arange(bits.shape[1] - 1, -1, -1)
+    return (binary @ weights) / (2.0 ** bits.shape[1] - 1.0)
 
-    def __init__(self, values):
-        self.values = np.clip(np.asarray(values, np.float64), 0.0, 1.0)
+
+def gray_encode(values, nbits):
+    """Unit floats [n] → gray-code bits [n, nbits]."""
+    ints = np.round(np.clip(values, 0.0, 1.0)
+                    * (2 ** nbits - 1)).astype(np.int64)
+    gray = ints ^ (ints >> 1)
+    shifts = np.arange(nbits - 1, -1, -1)
+    return ((gray[:, None] >> shifts[None, :]) & 1).astype(np.uint8)
+
+
+class Chromosome(object):
+    """Unit-interval gene vector + fitness (ref core.py:133).  Two
+    representations: a float vector, or gray-code bits ([n_genes, nbits]
+    uint8 — the reference's binary chromosomes) from which the float
+    view is decoded."""
+
+    def __init__(self, values=None, bits=None):
+        if bits is not None:
+            self.bits = np.asarray(bits, np.uint8)
+            self.values = gray_decode(self.bits)
+        else:
+            self.bits = None
+            self.values = np.clip(np.asarray(values, np.float64), 0.0, 1.0)
         self.fitness = None
 
     def config_for(self, config, paths):
@@ -80,8 +107,11 @@ class Population(Logger):
 
     def __init__(self, size, n_genes, selection="roulette",
                  crossover="uniform", mutation_rate=0.1,
-                 mutation_sigma=0.15, elite=1, rng_name="genetics"):
+                 mutation_sigma=0.15, elite=1, encoding="float",
+                 nbits=16, rng_name="genetics"):
         super(Population, self).__init__()
+        if encoding not in ("float", "gray"):
+            raise ValueError("encoding must be 'float' or 'gray'")
         self.size = size
         self.n_genes = n_genes
         self.selection = selection
@@ -89,11 +119,19 @@ class Population(Logger):
         self.mutation_rate = mutation_rate
         self.mutation_sigma = mutation_sigma
         self.elite = elite
+        self.encoding = encoding
+        self.nbits = nbits
         self.rng = prng.get(rng_name)
         self.generation = 0
-        self.chromosomes = [
-            Chromosome(self.rng.uniform(size=n_genes))
-            for _ in range(size)]
+        if encoding == "gray":
+            g = self.rng.numpy()
+            self.chromosomes = [
+                Chromosome(bits=g.integers(0, 2, (n_genes, nbits)))
+                for _ in range(size)]
+        else:
+            self.chromosomes = [
+                Chromosome(self.rng.uniform(size=n_genes))
+                for _ in range(size)]
 
     @property
     def best(self):
@@ -117,6 +155,8 @@ class Population(Logger):
 
     # -- crossover ----------------------------------------------------------
     def _cross(self, a, b):
+        if self.encoding == "gray":
+            return self._cross_bits(a, b)
         g = self.rng.numpy()
         if self.crossover == "single_point":
             cut = g.integers(1, self.n_genes) if self.n_genes > 1 else 0
@@ -129,8 +169,28 @@ class Population(Logger):
             child = np.where(mask, a.values, b.values)
         return Chromosome(child)
 
+    def _cross_bits(self, a, b):
+        """Bit-level crossover over the flattened gray genome (ref binary
+        chromosome crossover)."""
+        g = self.rng.numpy()
+        fa, fb = a.bits.ravel(), b.bits.ravel()
+        if self.crossover == "single_point":
+            cut = g.integers(1, fa.size) if fa.size > 1 else 0
+            child = np.concatenate([fa[:cut], fb[cut:]])
+        else:  # uniform (blend has no bit analogue; uniform is closest)
+            mask = g.uniform(size=fa.size) < 0.5
+            child = np.where(mask, fa, fb)
+        return Chromosome(bits=child.reshape(self.n_genes, self.nbits))
+
     def _mutate(self, c):
         g = self.rng.numpy()
+        if self.encoding == "gray":
+            # bit flips; gray code keeps single flips phenotypically local
+            flips = g.uniform(size=c.bits.shape) < \
+                self.mutation_rate / self.nbits
+            c.bits = np.where(flips, 1 - c.bits, c.bits).astype(np.uint8)
+            c.values = gray_decode(c.bits)
+            return c
         mask = g.uniform(size=self.n_genes) < self.mutation_rate
         jitter = g.normal(0, self.mutation_sigma, self.n_genes)
         c.values = np.clip(np.where(mask, c.values + jitter, c.values),
@@ -146,7 +206,9 @@ class Population(Logger):
                         key=lambda c: -c.fitness)[:self.elite]
         nxt = []
         for src in elites:
-            copy = Chromosome(src.values.copy())
+            copy = (Chromosome(bits=src.bits.copy())
+                    if src.bits is not None
+                    else Chromosome(src.values.copy()))
             copy.fitness = src.fitness   # elites keep their own score
             nxt.append(copy)
         while len(nxt) < self.size:
@@ -154,3 +216,21 @@ class Population(Logger):
             nxt.append(child)
         self.chromosomes = nxt
         self.generation += 1
+
+    # -- diagnostics --------------------------------------------------------
+    def stats(self):
+        """Best/mean/std of the current generation's fitnesses."""
+        fits = np.array([c.fitness for c in self.chromosomes
+                         if c.fitness is not None], np.float64)
+        if not len(fits):
+            return None
+        return {"generation": self.generation, "best": float(fits.max()),
+                "mean": float(fits.mean()), "std": float(fits.std())}
+
+    def converged(self, eps=1e-6):
+        """True when the scored population's fitness spread collapsed —
+        the GA has nothing left to exploit (early-stop signal)."""
+        fits = [c.fitness for c in self.chromosomes
+                if c.fitness is not None]
+        return (len(fits) == len(self.chromosomes)
+                and float(np.std(fits)) <= eps)

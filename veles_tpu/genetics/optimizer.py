@@ -18,7 +18,8 @@ class GeneticsOptimizer(Logger):
     """
 
     def __init__(self, config, evaluate, size=20, generations=10,
-                 executor_map=None, **population_kwargs):
+                 executor_map=None, early_stop_eps=None,
+                 **population_kwargs):
         super(GeneticsOptimizer, self).__init__()
         self.config = config
         self.paths = extract_ranges(config)
@@ -28,9 +29,13 @@ class GeneticsOptimizer(Logger):
         self.generations = generations
         #: optional parallel map(fn, iterable) — defaults to builtin map
         self.executor_map = executor_map or (lambda f, xs: list(map(f, xs)))
+        #: stop early when the population's fitness spread drops below
+        #: this (None = run all generations)
+        self.early_stop_eps = early_stop_eps
         self.population = Population(size, len(self.paths),
                                      **population_kwargs)
         self.history = []
+        self.stats_history = []
 
     def run(self):
         for gen in range(self.generations):
@@ -42,10 +47,16 @@ class GeneticsOptimizer(Logger):
                 c.fitness = float(f)
             best = self.population.best
             self.history.append(best.fitness)
+            self.stats_history.append(self.population.stats())
             self.info("generation %d: best fitness %.6f (%s)",
                       gen, best.fitness,
                       {"/".join(p): r.decode(best.values[i])
                        for i, (p, r) in enumerate(self.paths)})
+            if self.early_stop_eps is not None and \
+                    self.population.converged(self.early_stop_eps):
+                self.info("population converged (std <= %g) — stopping "
+                          "after generation %d", self.early_stop_eps, gen)
+                break
             if gen < self.generations - 1:
                 self.population.evolve()
         return self.best_config

@@ -131,16 +131,14 @@ class Loader(Unit, metaclass=LoaderRegistry):
         if len(raw) != self.total_samples:
             raise ValueError("%d labels for %d samples"
                              % (len(raw), self.total_samples))
-        uniques = sorted(set(raw.tolist()))
-        dense_ints = all(isinstance(u, int) for u in uniques) and \
-            uniques == list(range(len(uniques)))
+        unique_arr, inverse = np.unique(raw, return_inverse=True)
+        uniques = unique_arr.tolist()
         self.labels_mapping = {u: i for i, u in enumerate(uniques)}
-        if dense_ints:
-            mapped = raw.astype(np.int32)
+        if (np.issubdtype(unique_arr.dtype, np.integer)
+                and uniques == list(range(len(uniques)))):
+            mapped = raw.astype(np.int32)    # already dense class ids
         else:
-            lut = self.labels_mapping
-            mapped = np.fromiter((lut[v] for v in raw.tolist()),
-                                 np.int32, len(raw))
+            mapped = inverse.astype(np.int32)
             self.info("mapped %d distinct label values to class indices "
                       "0..%d", len(uniques), len(uniques) - 1)
         self.set_mapped_labels(mapped)

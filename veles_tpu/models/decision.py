@@ -19,6 +19,14 @@ class DecisionBase(Unit):
         super(DecisionBase, self).__init__(workflow, **kwargs)
         self.fail_iterations = kwargs.get("fail_iterations", 100)
         self.max_epochs = kwargs.get("max_epochs", None)
+        #: which class's metric drives improvement/stopping: "test",
+        #: "validation", "train", or None = validation-else-train (the
+        #: reference default).  The seam for workflows that eval on the
+        #: test split (ref pluggable decision configs).
+        watch = kwargs.get("watch")
+        if watch is not None and watch not in CLASS_NAMES:
+            raise ValueError("watch must be one of %s" % (CLASS_NAMES,))
+        self.watch = watch
         self.complete = Bool(False)
         self.improved = Bool(False)
         self.demand("loader", "trainer")
@@ -41,8 +49,12 @@ class DecisionBase(Unit):
         self.epoch_metrics[cls] = stats
         if not bool(loader.epoch_ended):
             return
-        # epoch boundary: decide on validation (fall back to train) metric
-        watch_cls = VALID if loader.class_lengths[VALID] else TRAIN
+        # epoch boundary: decide on the watched class's metric
+        # (default: validation, falling back to train)
+        if self.watch is not None:
+            watch_cls = CLASS_NAMES.index(self.watch)
+        else:
+            watch_cls = VALID if loader.class_lengths[VALID] else TRAIN
         watched = self.epoch_metrics[watch_cls]
         metric = self.extract_metric(watched) if watched else None
         self.improved <<= (metric is not None and

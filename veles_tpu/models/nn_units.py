@@ -391,7 +391,9 @@ class StagedTrainer(Unit):
         """Jitted serve-time forward (softmax applied for classifiers)."""
         def fwd(params, x):
             out = self._forward(params, x, False, jax.random.key(0))
-            if self.loss == "softmax":
-                out = jax.nn.softmax(out.astype(jnp.float32))
+            if losses.get_loss(self.loss)[1] == "class":
+                # every classification loss serves probabilities (the
+                # ensemble vote and REST clients rely on it)
+                out = jax.nn.softmax(out.astype(jnp.float32), axis=-1)
             return out
         return jax.jit(fwd)

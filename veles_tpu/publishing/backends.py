@@ -132,9 +132,8 @@ class PDFBackend(ReportBackend):
         import io
         import os
 
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
+        from veles_tpu.services.plotting import _matplotlib
+        plt = _matplotlib()   # pins the Agg backend before pdf imports
         from matplotlib.backends.backend_pdf import PdfPages
 
         buf = io.BytesIO()

@@ -66,7 +66,8 @@ def _renderer(kind):
                "images": plotting.ImagePlotter,
                "histogram": plotting.HistogramPlotter,
                "multi_histogram": plotting.MultiHistogramPlotter,
-               "minmax": plotting.MinMaxPlotter}.get(kind)
+               "minmax": plotting.MinMaxPlotter,
+               "unit_stats": plotting.UnitStatsPlotter}.get(kind)
         _RENDERERS[kind] = cls(None) if cls is not None else None
     return _RENDERERS[kind]
 

@@ -267,6 +267,57 @@ class MinMaxPlotter(PlotterBase):
         plt.close(fig)
 
 
+class UnitStatsPlotter(PlotterBase):
+    """Per-unit cumulative run time plus per-device live HBM bytes — the
+    TPU-era equivalent of the reference's slave-stats plotter
+    (veles/plotting_units.py:52-822: per-slave job/time tables became
+    per-unit/per-device charts once the slaves became mesh shards)."""
+
+    def __init__(self, workflow, top=10, **kwargs):
+        super(UnitStatsPlotter, self).__init__(workflow, **kwargs)
+        self.top = top
+
+    def payload(self):
+        wf = self.workflow
+        if wf is None:
+            return None
+        units = sorted(
+            ({"name": u.name, "runs": int(getattr(u, "run_count", 0)),
+              "time": float(getattr(u, "run_time", 0.0))}
+             for u in wf.units),
+            key=lambda u: -u["time"])[:self.top]
+        from veles_tpu.benchmark import Watcher
+        try:
+            memory = {str(k): int(v)
+                      for k, v in Watcher.live_bytes().items()}
+        except Exception:   # noqa: BLE001 — backend without live arrays
+            memory = {}
+        return {"kind": "unit_stats", "units": units, "memory": memory}
+
+    def render(self, payload, path):
+        plt = _matplotlib()
+        units = payload["units"]
+        memory = payload["memory"]
+        fig, axes = plt.subplots(1, 2 if memory else 1, figsize=(9, 4))
+        axes = np.atleast_1d(axes)
+        names = [u["name"] for u in units]
+        axes[0].barh(range(len(units)), [u["time"] for u in units])
+        axes[0].set_yticks(range(len(units)), names, fontsize=7)
+        axes[0].invert_yaxis()
+        axes[0].set_xlabel("total run s")
+        if memory:
+            devs = sorted(memory)
+            axes[-1].bar(range(len(devs)),
+                         [memory[d] / 2**20 for d in devs])
+            axes[-1].set_xticks(range(len(devs)),
+                                [d[-8:] for d in devs], fontsize=7,
+                                rotation=45)
+            axes[-1].set_ylabel("live MiB")
+        fig.tight_layout()
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+
 class HistogramPlotter(PlotterBase):
     """Histogram of a tensor (ref plotting_units histogram family)."""
 

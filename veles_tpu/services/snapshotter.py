@@ -54,6 +54,18 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             "directory", root.common.dirs.get("snapshots", "snapshots"))
         self.compression = kwargs.get(
             "compression", root.common.snapshot.get("codec", "gz"))
+        #: True → the pickle+compress+write happens on a background
+        #: thread: the train loop only pays for the device→host gather
+        #: (device_get), not the disk write — checkpointing a large model
+        #: stops costing a step.  Writes are atomic (temp file + rename),
+        #: ``destination`` is only set once the file is complete, and an
+        #: atexit hook joins the in-flight write so process exit can
+        #: never truncate a checkpoint.
+        self.async_write = kwargs.get("async_write", False)
+        self._writer = None
+        if self.async_write:
+            import atexit
+            atexit.register(self.flush)
         self._epoch_counter = 0
         self._last_time = time.time()
         self.destination = None
@@ -75,13 +87,36 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self.export()
 
     def export(self):
-        opener, _, ext = CODECS[self.compression]
         os.makedirs(self.directory, exist_ok=True)
-        fname = "%s_%s.pickle%s" % (self.prefix, self.suffix(), ext)
+        fname = "%s_%s.pickle%s" % (self.prefix, self.suffix(),
+                                    CODECS[self.compression][2])
         path = os.path.join(self.directory, fname)
-        with opener(path) as f:
-            pickle.dump(self.collect(), f, protocol=4)
-        self.destination = path
+        state = self.collect()          # device→host gather happens HERE
+        if self.async_write:
+            import threading
+            self.flush()                # one in-flight write at a time
+            self._writer = threading.Thread(
+                target=self._write_logged, args=(state, path, fname),
+                daemon=True)
+            self._writer.start()
+        else:
+            self._write(state, path, fname)
+        return path
+
+    def _write_logged(self, state, path, fname):
+        try:
+            self._write(state, path, fname)
+        except Exception:   # noqa: BLE001 — must surface, not vanish
+            self.exception("async snapshot write to %s failed", path)
+
+    def _write(self, state, path, fname):
+        opener, _, _ = CODECS[self.compression]
+        # atomic: a crash mid-write leaves the previous snapshot intact
+        # and _current never points at a partial file
+        tmp = path + ".tmp"
+        with opener(tmp) as f:
+            pickle.dump(state, f, protocol=4)
+        os.replace(tmp, path)
         current = os.path.join(self.directory, "%s_current" % self.prefix)
         try:
             if os.path.islink(current) or os.path.exists(current):
@@ -89,8 +124,15 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             os.symlink(fname, current)
         except OSError:
             pass
+        self.destination = path   # only once the file is complete
         self.info("snapshot -> %s", path)
-        return path
+
+    def flush(self):
+        """Join the in-flight async write (call before reading the
+        snapshot back or at shutdown)."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
 
     @staticmethod
     def import_(path, allow_remote=False, expected_sha256=None):
