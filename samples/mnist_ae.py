@@ -1,0 +1,36 @@
+"""BASELINE config: real-MNIST autoencoder (ref — published validation
+RMSE 0.5478; docs/source/manualrst_veles_algorithms.rst:70).  Run:
+
+    python -m veles_tpu samples/mnist_ae.py
+
+Expects the canonical idx files under <datasets>/mnist/."""
+
+from veles_tpu.config import root
+from veles_tpu.loader.datasets import load_mnist, mnist_available
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import mnist_autoencoder
+
+
+def run(load, main):
+    if not mnist_available():
+        raise SystemExit(
+            "MNIST not found under %s/mnist — mount the idx files to run "
+            "this config" % root.common.dirs.get("datasets", "datasets"))
+    cfg = root.mnist_ae
+    train_x, _, test_x, _ = load_mnist()
+    import numpy as np
+    data = np.concatenate([test_x, train_x])
+    loader = FullBatchLoader(
+        None, data=data,
+        minibatch_size=cfg.get("minibatch_size", 100),
+        class_lengths=[0, len(test_x), len(train_x)])
+    load(StandardWorkflow,
+         layers=mnist_autoencoder(
+             bottleneck=cfg.get("bottleneck", 16),
+             lr=cfg.get("learning_rate", 0.01),
+             moment=cfg.get("gradient_moment", 0.9)),
+         loader=loader, loss="mse",
+         decision_config={"max_epochs": cfg.get("max_epochs", 30)},
+         name="mnist-ae")
+    main()

@@ -1,0 +1,40 @@
+"""BASELINE config 2b: STL-10 with the same conv stack as CIFAR (ref —
+published validation error 35.10 %, train 0.12 %;
+docs/source/manualrst_veles_algorithms.rst:52).  Run:
+
+    python -m veles_tpu samples/stl10_conv.py
+
+Expects <datasets>/stl10_binary/ ({train,test}_{X,y}.bin);
+zero-egress: nothing is downloaded."""
+
+from veles_tpu.config import root
+from veles_tpu.loader.datasets import load_stl10, stl10_available
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import cifar_conv
+
+
+def run(load, main):
+    if not stl10_available():
+        raise SystemExit(
+            "STL-10 not found under %s/stl10_binary — mount the binary "
+            "files to run this config"
+            % root.common.dirs.get("datasets", "datasets"))
+    cfg = root.stl10
+    train_x, train_y, test_x, test_y = load_stl10()
+    import numpy as np
+    data = np.concatenate([test_x, train_x])
+    labels = np.concatenate([test_y, train_y])
+    loader = FullBatchLoader(
+        None, data=data, labels=labels,
+        minibatch_size=cfg.get("minibatch_size", 100),
+        class_lengths=[0, len(test_x), len(train_x)],
+        normalization=cfg.get("normalization", "mean_disp"))
+    load(StandardWorkflow,
+         layers=cifar_conv(lr=cfg.get("learning_rate", 0.001),
+                           moment=cfg.get("gradient_moment", 0.9),
+                           wd=cfg.get("weight_decay", 0.004)),
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 60)},
+         name="stl10-conv")
+    main()

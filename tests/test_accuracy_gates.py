@@ -16,7 +16,8 @@ import sys
 
 import pytest
 
-from veles_tpu.loader.datasets import cifar10_available, mnist_available
+from veles_tpu.loader.datasets import (cifar10_available, mnist_available,
+                                       stl10_available)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -24,14 +25,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MNIST_GATE = 0.02
 #: published 17.21 % + margin
 CIFAR_GATE = 0.20
+#: published 35.10 % + margin
+STL10_GATE = 0.40
+#: published validation RMSE 0.5478 + margin
+MNIST_AE_GATE = 0.60
 
 
 def _run_config(workflow, config, result, extra=(), timeout=5400):
-    r = subprocess.run(
-        [sys.executable, "-m", "veles_tpu", workflow, config,
-         "--random-seed", "1234", "--result-file", result] + list(extra),
-        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
-        timeout=timeout)
+    argv = [sys.executable, "-m", "veles_tpu", workflow]
+    if config:
+        argv.append(config)
+    argv += ["--random-seed", "1234", "--result-file", result]
+    r = subprocess.run(argv + list(extra), cwd=REPO, env=dict(os.environ),
+                       capture_output=True, text=True, timeout=timeout)
     assert r.returncode == 0, r.stderr[-3000:]
     return json.load(open(result))
 
@@ -51,3 +57,20 @@ def test_cifar_conv_matches_published_row(tmp_path):
     res = _run_config("samples/cifar_conv.py", "samples/cifar_config.py",
                       str(tmp_path / "cifar.json"))
     assert res["best_metric"] <= CIFAR_GATE, res["best_metric"]
+
+
+@pytest.mark.skipif(not stl10_available(),
+                    reason="STL-10 binary files not mounted under "
+                           "datasets/")
+def test_stl10_conv_matches_published_row(tmp_path):
+    res = _run_config("samples/stl10_conv.py", None,
+                      str(tmp_path / "stl10.json"))
+    assert res["best_metric"] <= STL10_GATE, res["best_metric"]
+
+
+@pytest.mark.skipif(not mnist_available(),
+                    reason="MNIST idx files not mounted under datasets/")
+def test_mnist_autoencoder_matches_published_rmse(tmp_path):
+    res = _run_config("samples/mnist_ae.py", None,
+                      str(tmp_path / "ae.json"))
+    assert res["best_metric"] <= MNIST_AE_GATE, res["best_metric"]

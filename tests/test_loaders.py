@@ -564,3 +564,21 @@ class TestDatasetReaders:
         assert tx.shape == (20, 32, 32, 3)
         assert ex.shape == (2, 32, 32, 3)
         assert tx.dtype == np.float32 and tx.max() <= 1.0
+
+    def test_stl10_reader(self, tmp_path):
+        from veles_tpu.loader.datasets import (load_stl10,
+                                               stl10_available)
+        d = tmp_path / "stl10_binary"
+        d.mkdir()
+        rng = np.random.RandomState(2)
+        for name, n in (("train_X.bin", 3), ("test_X.bin", 2)):
+            rng.randint(0, 256, (n, 3, 96, 96),
+                        dtype=np.uint8).tofile(str(d / name))
+        for name, n in (("train_y.bin", 3), ("test_y.bin", 2)):
+            (rng.randint(0, 10, n, dtype=np.uint8) + 1).tofile(
+                str(d / name))
+        assert stl10_available(str(tmp_path))
+        tx, ty, ex, ey = load_stl10(str(tmp_path))
+        assert tx.shape == (3, 96, 96, 3) and ex.shape == (2, 96, 96, 3)
+        assert ty.min() >= 0 and ty.max() <= 9   # 1..10 → 0..9
+        assert tx.dtype == np.float32 and tx.max() <= 1.0

@@ -154,3 +154,21 @@ class TestForgeThumbnailsHistory:
         client.upload(pkg, "bare", "1.0")   # no arrays -> no thumbnail
         with pytest.raises(urllib.error.HTTPError):
             client.fetch_thumbnail("bare", str(tmp_path / "x.png"))
+
+
+class TestForgeWebIndex:
+    def test_index_lists_models(self, tmp_path):
+        srv = ForgeServer(str(tmp_path / "store")).start()
+        try:
+            pkg = _make_export_package(str(tmp_path / "m.zip"))
+            client = ForgeClient(srv.url)
+            client.upload(pkg, "mnist", "1.0", description="hello <x>")
+            import urllib.request
+            with urllib.request.urlopen(srv.url + "/") as r:
+                page = r.read().decode()
+            assert "veles_tpu model forge" in page
+            assert "mnist" in page
+            assert "hello &lt;x&gt;" in page          # escaped
+            assert "/thumbnail?name=mnist" in page
+        finally:
+            srv.stop()

@@ -302,3 +302,24 @@ class TestUnitStatsPlotter:
         assert payload["units"][0]["name"] == "unit2"   # sorted by time
         assert os.path.getsize(p.last_file) > 1000
         del keep
+
+
+class TestTracingFlags:
+    def test_event_log_and_sync_run(self, tmp_path):
+        """--event-log writes a JSONL event timeline; --sync-run runs
+        the same training with per-step device sync (ref --sync-run +
+        the Mongo event timeline)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        log = str(tmp_path / "events.jsonl")
+        r = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
+             "--backend", "cpu", "--random-seed", "3",
+             "--config-list", "root.digits.max_epochs=1",
+             "--event-log", log, "--sync-run"],
+            cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [json.loads(ln) for ln in open(log)]
+        assert len(lines) > 10
+        assert any(e["name"] == "minibatch" for e in lines)
+        assert all({"name", "cat", "type", "time"} <= set(e) for e in lines)

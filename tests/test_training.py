@@ -291,3 +291,51 @@ def test_async_snapshot_write(tmp_path):
     # the _current link points at a complete, loadable snapshot
     cur = str(tmp_path / "async-snap_current")
     assert SnapshotterBase.import_(cur)["epoch"] == snap["epoch"]
+
+
+def test_decision_watch_empty_split_rejected():
+    from sklearn.datasets import load_digits
+
+    import pytest as _pytest
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    prng.seed_all(6)
+    d = load_digits()
+    x = (d.data / 16.0).astype("float32")
+    y = d.target.astype("int32")
+    wf = StandardWorkflow(
+        layers=[{"type": "softmax", "output_sample_shape": 10}],
+        loader=FullBatchLoader(None, data=x, labels=y, minibatch_size=99,
+                               class_lengths=[0, 297, 1500]),
+        decision_config={"watch": "test"}, name="watch-empty")
+    with _pytest.raises(ValueError, match="no test samples"):
+        wf.initialize()
+
+
+def test_db_snapshotter_async(tmp_path):
+    from sklearn.datasets import load_digits
+
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.services.snapshotter import DBSnapshotter
+    prng.seed_all(9)
+    d = load_digits()
+    x = (d.data / 16.0).astype("float32")
+    y = d.target.astype("int32")
+    dsn = str(tmp_path / "snaps.sqlite")
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                             class_lengths=[0, 297, 1500])
+    wf = StandardWorkflow(
+        layers=[{"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.1}],
+        loader=loader, decision_config={"max_epochs": 2},
+        snapshotter_config={"name": "db", "dsn": dsn, "interval": 1,
+                            "async_write": True},
+        name="db-async")
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.flush()
+    snap = DBSnapshotter.import_db(dsn)
+    assert snap["epoch"] >= 1 and "params" in snap

@@ -102,6 +102,13 @@ class Main(object):
                        help="aggregate the members from an "
                        "--ensemble-train results file: mean-probability "
                        "vote on the eval set (ref --ensemble-test)")
+        p.add_argument("--event-log", default=None, metavar="PATH",
+                       help="append structured trace events as JSONL "
+                       "(ref the Mongo event timeline, logger.py:264-289)")
+        p.add_argument("--sync-run", action="store_true",
+                       help="block on the device after every trainer step "
+                       "for honest per-unit timing (ref --sync-run, "
+                       "accelerated_units.py:186-193)")
         p.add_argument("--profile", default=None, metavar="DIR",
                        help="capture a jax/xplane profiler trace of the "
                        "run into DIR (view with tensorboard or xprof; "
@@ -122,6 +129,11 @@ class Main(object):
         if args.random_seed is not None:
             prng.seed_all(args.random_seed)
         self._apply_config(args)
+        if args.event_log:
+            from veles_tpu.logger import events
+            events.open_sink(args.event_log)
+        if args.sync_run:
+            root.common.engine.sync_run = True
 
         if args.optimize:
             return self._run_optimize(args)

@@ -160,10 +160,46 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code, message):
         self._json({"error": message}, code)
 
+    def _html_index(self):
+        """Minimal forge site (ref the node.js forge web UI,
+        web/build.sh + forge_server.py:462): model table with versions,
+        descriptions and thumbnails."""
+        import html
+        rows = []
+        for m in self.store.list():
+            name = html.escape(m["name"])
+            latest = m.get("latest") or ""
+            v = m["versions"].get(latest, {})
+            thumb = ("<img src='/thumbnail?name=%s' width='48'>" % name
+                     if v.get("thumbnail") else "")
+            rows.append(
+                "<tr><td>%s</td><td>%s</td><td>%d</td><td>%s</td>"
+                "<td>%s</td><td><a href='/fetch?name=%s'>zip</a> "
+                "<a href='/service?query=history&amp;name=%s'>history</a>"
+                "</td></tr>"
+                % (thumb, name, len(m["versions"]),
+                   html.escape(str(latest)),
+                   html.escape(str(v.get("description") or "")),
+                   name, name))
+        body = ("<!doctype html><html><head><meta charset='utf-8'>"
+                "<title>veles_tpu forge</title></head><body>"
+                "<h1>veles_tpu model forge</h1>"
+                "<table border='1' cellpadding='4'>"
+                "<tr><th></th><th>model</th><th>versions</th>"
+                "<th>latest</th><th>description</th><th></th></tr>"
+                "%s</table></body></html>" % "".join(rows)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         url = urllib.parse.urlparse(self.path)
         q = dict(urllib.parse.parse_qsl(url.query))
         try:
+            if url.path in ("/", "/index.html"):
+                return self._html_index()
             if url.path == "/service":
                 query = q.get("query", "list")
                 if query == "list":

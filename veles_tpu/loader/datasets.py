@@ -96,3 +96,37 @@ def load_cifar10(directory=None):
     xs, ys = zip(*(read("data_batch_%d" % i) for i in range(1, 6)))
     test_x, test_y = read("test_batch")
     return (np.concatenate(xs), np.concatenate(ys), test_x, test_y)
+
+
+# ---------------------------------------------------------------- STL-10
+_STL_FILES = ("train_X.bin", "train_y.bin", "test_X.bin", "test_y.bin")
+
+
+def _stl_dir(directory=None):
+    return os.path.join(directory or datasets_dir(), "stl10_binary")
+
+
+def stl10_available(directory=None):
+    d = _stl_dir(directory)
+    return all(os.path.exists(os.path.join(d, f)) for f in _STL_FILES)
+
+
+def load_stl10(directory=None):
+    """(train_x[5000,96,96,3] f32 0..1, train_y 0-based, test_x, test_y).
+
+    The binary format stores each image as 3x96x96 **column-major**
+    (channels, then column-major pixels); labels are 1..10."""
+    d = _stl_dir(directory)
+
+    def read_x(name):
+        raw = np.fromfile(os.path.join(d, name), np.uint8)
+        x = raw.reshape(-1, 3, 96, 96)          # [N, C, cols, rows]
+        return (x.transpose(0, 3, 2, 1)          # → [N, rows, cols, C]
+                .astype(np.float32) / 255.0)
+
+    def read_y(name):
+        return np.fromfile(os.path.join(d, name),
+                           np.uint8).astype(np.int32) - 1
+
+    return (read_x("train_X.bin"), read_y("train_y.bin"),
+            read_x("test_X.bin"), read_y("test_y.bin"))

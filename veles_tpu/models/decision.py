@@ -40,6 +40,15 @@ class DecisionBase(Unit):
     def extract_metric(self, stats):
         raise NotImplementedError
 
+    def initialize(self, **kwargs):
+        if self.watch is not None:
+            cls = CLASS_NAMES.index(self.watch)
+            if not self.loader.class_lengths[cls]:
+                raise ValueError(
+                    "decision watches the %r split but the loader has no "
+                    "%s samples (class_lengths=%s)"
+                    % (self.watch, self.watch, self.loader.class_lengths))
+
     def run(self):
         loader = self.loader
         if not bool(loader.class_ended):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veles_tpu import prng
+from veles_tpu.config import root
 from veles_tpu.loader.base import TRAIN
 from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.models import optimizer
@@ -307,6 +308,11 @@ class StagedTrainer(Unit):
         with jax.profiler.StepTraceAnnotation("veles_step",
                                               step_num=self._step_counter):
             self._run_step()
+        if root.common.engine.get("sync_run"):
+            # honest per-unit wall time: charge the device work to THIS
+            # unit instead of the next host sync (ref --sync-run,
+            # accelerated_units.py:186-193)
+            jax.block_until_ready(self.class_stats)
 
     def _run_step(self):
         loader = self.loader

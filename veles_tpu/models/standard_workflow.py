@@ -73,8 +73,14 @@ class StandardWorkflow(Workflow):
         else:
             self.lr_adjuster = None
         if snapshotter_config is not None:
-            self.snapshotter = TrainingSnapshotter(self,
-                                                   **snapshotter_config)
+            cfg = dict(snapshotter_config)
+            kind = cfg.pop("name", None)
+            if kind is not None:   # registry routing like the loader dict
+                from veles_tpu.services.snapshotter import SnapshotterBase
+                snap_cls = SnapshotterBase.mapping[kind]
+            else:
+                snap_cls = TrainingSnapshotter
+            self.snapshotter = snap_cls(self, **cfg)
             self.snapshotter.trainer = self.trainer
             self.snapshotter.loader = self.loader
             self.snapshotter.decision = self.decision

@@ -280,20 +280,39 @@ class DBSnapshotter(TrainingSnapshotter):
         return conn
 
     def export(self):
-        blob = pickle.dumps(self.collect(), protocol=4)
+        state = self.collect()          # device→host gather on the loop
+        suffix = self.suffix()
+        dest = "%s#%s_%s" % (self.dsn, self.prefix, suffix)
+        if self.async_write:
+            import threading
+            self.flush()
+            self._writer = threading.Thread(
+                target=self._db_write_logged, args=(state, suffix, dest),
+                daemon=True)
+            self._writer.start()
+        else:
+            self._db_write(state, suffix, dest)
+        return dest
+
+    def _db_write_logged(self, state, suffix, dest):
+        try:
+            self._db_write(state, suffix, dest)
+        except Exception:   # noqa: BLE001 — must surface, not vanish
+            self.exception("async snapshot insert into %s failed", dest)
+
+    def _db_write(self, state, suffix, dest):
+        blob = pickle.dumps(state, protocol=4)
         conn = self._connect()
         try:
             with conn:
                 conn.execute(
                     "INSERT INTO snapshots (prefix, suffix, created, state)"
                     " VALUES (?, ?, ?, ?)",
-                    (self.prefix, self.suffix(), time.time(), blob))
+                    (self.prefix, suffix, time.time(), blob))
         finally:
             conn.close()
-        self.destination = "%s#%s_%s" % (self.dsn, self.prefix,
-                                         self.suffix())
-        self.info("snapshot -> %s", self.destination)
-        return self.destination
+        self.destination = dest   # only once the row is committed
+        self.info("snapshot -> %s", dest)
 
     @staticmethod
     def import_db(dsn, prefix=None):
