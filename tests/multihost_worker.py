@@ -20,6 +20,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main():
     coordinator, num_processes, process_id = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    # optional 4th arg: a snapshot dir → run tensor-parallel over a
+    # cross-process 'model' axis with a per-epoch snapshotter (proves
+    # multi-host checkpointing: params sharded across processes gather
+    # via process_allgather; only process 0 writes)
+    snap_dir = sys.argv[4] if len(sys.argv) > 4 else None
     # 4 local devices per process -> 8 global over 2 processes (overwrite
     # any inherited XLA_FLAGS — the pytest conftest forces 8 per process)
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -47,18 +52,24 @@ def main():
                 {"type": "softmax", "output_sample_shape": 10,
                  "learning_rate": 0.1}],
         loader=loader, decision_config={"max_epochs": 2},
+        snapshotter_config=(None if snap_dir is None else
+                            {"interval": 1, "directory": snap_dir}),
         name="multihost-digits")
+    if wf.snapshotter is None:
+        mesh_axes = {"data": -1}
+    else:
+        mesh_axes = {"model": -1}   # params shard ACROSS processes
 
     launcher = Launcher(workflow=wf, coordinator_address=coordinator,
                         num_processes=num_processes, process_id=process_id,
-                        mesh_axes={"data": -1})
+                        mesh_axes=mesh_axes)
     launcher.initialize()
     assert launcher.mode == "spmd"
     n_devices = len(jax.devices())
     launcher.run()
 
     m = wf.decision.epoch_metrics[1]
-    print("METRICS " + json.dumps({
+    result = {
         "process_id": process_id,
         "process_count": jax.process_count(),
         "n_global_devices": n_devices,
@@ -66,7 +77,12 @@ def main():
         "loss": m["loss"],
         "n_errors": m["n_errors"],
         "best_metric": wf.decision.best_metric,
-    }), flush=True)
+    }
+    if wf.snapshotter is not None:
+        result["snapshot"] = wf.snapshotter.destination
+        w = wf.trainer.params[wf.trainer.layers[0].name]["weights"]
+        result["weights_addressable"] = bool(w.is_fully_addressable)
+    print("METRICS " + json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

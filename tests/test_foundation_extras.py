@@ -55,6 +55,9 @@ class TestDBSnapshotter:
             def host_params(self):
                 return {"l0": {"weights": np.ones((2, 2))}}
 
+            def host_velocity(self):
+                return {}
+
         class FakeLoader:
             state = {"pos": 3}
             epoch_number = 2
@@ -62,6 +65,8 @@ class TestDBSnapshotter:
         snap = DBSnapshotter.__new__(DBSnapshotter)
         snap.dsn = str(tmp_path / "snaps.sqlite")
         snap.prefix = "t"
+        snap.async_write = False
+        snap._writer = None
         snap.trainer = FakeTrainer()
         snap.loader = FakeLoader()
         snap.decision = None

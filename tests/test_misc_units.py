@@ -2,6 +2,8 @@
 stochastic pool-depool), InputJoiner/Avatar/Shell units, and the
 foundation helpers (NumDiff, DeviceBenchmark, Watcher)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -208,3 +210,45 @@ class TestGraphSurgeryAndHttpImport:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+class TestManhole:
+    def test_attach_and_evaluate(self, tmp_path):
+        """r2: the reference's manhole — a live REPL over a unix socket
+        (code execution gated by 0600 socket perms)."""
+        import socket
+        import stat
+
+        from veles_tpu.interaction import Manhole
+        path = str(tmp_path / "mh.sock")
+        mh = Manhole(path, scope={"x": 41}).start()
+        try:
+            assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            c.connect(path)
+            c.settimeout(5)
+            f = c.makefile("rw", encoding="utf-8", newline="\n")
+
+            def read_to_prompt():
+                out = ""
+                while not out.endswith(">>> "):
+                    chunk = f.read(1)
+                    if not chunk:
+                        break
+                    out += chunk
+                return out
+
+            read_to_prompt()                 # banner
+            f.write("x + 1\n")
+            f.flush()
+            assert "42" in read_to_prompt()
+            f.write("y = 10\n")              # state persists per session
+            f.flush()
+            read_to_prompt()
+            f.write("y * 2\n")
+            f.flush()
+            assert "20" in read_to_prompt()
+            c.close()
+        finally:
+            mh.stop()
+        assert not os.path.exists(path)

@@ -102,6 +102,10 @@ class Main(object):
                        help="aggregate the members from an "
                        "--ensemble-train results file: mean-probability "
                        "vote on the eval set (ref --ensemble-test)")
+        p.add_argument("--manhole", default=None, metavar="SOCKET",
+                       help="attachable debug REPL on a unix socket "
+                       "(`socat - UNIX-CONNECT:SOCKET`; ref the bundled "
+                       "manhole, veles/external/)")
         p.add_argument("--event-log", default=None, metavar="PATH",
                        help="append structured trace events as JSONL "
                        "(ref the Mongo event timeline, logger.py:264-289)")
@@ -180,6 +184,12 @@ class Main(object):
             wf = self.workflow
             launcher = self._make_launcher(args, wf)
             launcher.initialize(**kwargs)
+            manhole = None
+            if args.manhole:
+                from veles_tpu.interaction import Manhole
+                manhole = Manhole(args.manhole,
+                                  scope={"wf": wf, "root": root,
+                                         "launcher": launcher}).start()
             if self._pending_snapshot is not None:
                 wf.restore(self._pending_snapshot)
             profiling = False
@@ -201,6 +211,8 @@ class Main(object):
                     import jax
                     jax.profiler.stop_trace()
                     print("profiler trace -> %s" % args.profile)
+                if manhole is not None:
+                    manhole.stop()
                 launcher.stop()
             if args.result_file:
                 wf.write_results(args.result_file)

@@ -1,9 +1,17 @@
 """Interactive shell unit (ref veles/interaction.py:49) — drops into an
 IPython / code.interact REPL mid-workflow with the workflow's units in
-scope, so a running experiment can be inspected and mutated in place."""
+scope, so a running experiment can be inspected and mutated in place —
+plus :class:`Manhole`, the reference's bundled debug shell over a unix
+socket (veles/external/manhole): attach a REPL to a LIVE training
+process from another terminal without stopping it."""
 
 import code
+import io
+import os
+import socket
+import threading
 
+from veles_tpu.logger import Logger
 from veles_tpu.units import Unit
 
 
@@ -37,3 +45,93 @@ class Shell(Unit):
             embed(user_ns=env, banner1=self.banner)
         except ImportError:
             code.interact(banner=self.banner, local=env)
+
+
+class Manhole(Logger):
+    """Debug REPL over a unix socket (ref veles/external/manhole —
+    activated on demand, never blocks the training loop).
+
+        manhole = Manhole("/tmp/veles.sock", scope={"wf": wf}).start()
+        # elsewhere:  socat - UNIX-CONNECT:/tmp/veles.sock
+
+    The socket is chmod 0600 (owner only — it executes code).  Each
+    connection gets its own interpreter over a shared ``scope``."""
+
+    def __init__(self, path, scope=None, **kwargs):
+        super(Manhole, self).__init__(**kwargs)
+        self.path = path
+        self.scope = dict(scope or {})
+        self._sock = None
+        self._thread = None
+        self._stop = False
+
+    def start(self):
+        if os.path.exists(self.path):
+            os.remove(self.path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # 0600 must hold from the instant the file exists — a permissive
+        # umask would otherwise open a connect window before chmod
+        saved_umask = os.umask(0o177)
+        try:
+            self._sock.bind(self.path)
+        finally:
+            os.umask(saved_umask)
+        os.chmod(self.path, 0o600)
+        self._sock.listen(1)
+        self._sock.settimeout(0.5)
+
+        def accept_loop():
+            while not self._stop:
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True).start()
+
+        self._thread = threading.Thread(target=accept_loop, daemon=True)
+        self._thread.start()
+        self.info("manhole listening on %s", self.path)
+        return self
+
+    def _serve(self, conn):
+        f = conn.makefile("rw", encoding="utf-8", newline="\n")
+        interp = code.InteractiveInterpreter(dict(self.scope))
+        out = io.StringIO()
+
+        def write(s):
+            out.write(s)
+        interp.write = write
+        try:
+            f.write("veles_tpu manhole — scope: %s\n>>> "
+                    % sorted(self.scope))
+            f.flush()
+            buf = []
+            for line in f:
+                buf.append(line.rstrip("\n"))
+                import contextlib
+                with contextlib.redirect_stdout(out), \
+                        contextlib.redirect_stderr(out):
+                    more = interp.runsource("\n".join(buf))
+                if not more:
+                    buf = []
+                f.write(out.getvalue())
+                out.truncate(0)
+                out.seek(0)
+                f.write("... " if more else ">>> ")
+                f.flush()
+        except (BrokenPipeError, ConnectionResetError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        if self._sock is not None:
+            self._sock.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if os.path.exists(self.path):
+            os.remove(self.path)

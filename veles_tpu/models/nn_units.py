@@ -376,7 +376,26 @@ class StagedTrainer(Unit):
 
     # ---------------------------------------------------------- inspection
     def host_params(self):
-        return jax.device_get(self.params)
+        """Full parameter pytree on the host.  Multi-host safe: tensors
+        sharded across processes (non-addressable shards) are gathered
+        with a process_allgather collective — EVERY process must call
+        this together (the snapshotter does; ref only-master-writes,
+        snapshotter.py:160)."""
+        return self.host_tree(self.params)
+
+    def host_velocity(self):
+        return self.host_tree(self.velocity)
+
+    @staticmethod
+    def host_tree(tree):
+        def get(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable \
+                    and not x.is_fully_replicated:
+                from jax.experimental import multihost_utils
+                return np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True))
+            return np.asarray(jax.device_get(x))
+        return jax.tree_util.tree_map(get, tree)
 
     def load_params(self, host_params, host_velocity=None):
         self.params = jax.tree_util.tree_map(jnp.asarray, host_params)

@@ -153,7 +153,10 @@ def make_sharded_gather(mesh_cfg):
 
 
 def shard_batch(x, mesh_cfg):
-    """Shard the leading (minibatch) dim over the data axis."""
+    """Shard the leading (minibatch) dim over the data axis (replicated
+    when the mesh has no data axis — e.g. a pure tensor-parallel mesh)."""
+    if mesh_cfg.data_axis not in mesh_cfg.mesh.shape:
+        return replicate(x, mesh_cfg)
     return jax.device_put(
         x, NamedSharding(mesh_cfg.mesh, P(mesh_cfg.data_axis)))
 

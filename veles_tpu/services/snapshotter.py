@@ -81,9 +81,21 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self._epoch_counter += 1
         if self.interval and self._epoch_counter % self.interval:
             return
-        if time.time() - self._last_time < self.time_interval:
+        multihost = jax.process_count() > 1
+        # the wall-clock gate is per-process and therefore NOT
+        # deterministic across hosts — skipping it under multi-host keeps
+        # every process taking the same branch into the collective
+        # gathers below (a divergent decision would deadlock allgather)
+        if not multihost and \
+                time.time() - self._last_time < self.time_interval:
             return
         self._last_time = time.time()
+        if multihost and jax.process_index() != 0:
+            # every process participates in the collective gathers inside
+            # collect(), but only process 0 writes (ref
+            # only-master-snapshots, snapshotter.py:160)
+            self.collect()
+            return
         self.export()
 
     def export(self):
@@ -92,22 +104,27 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                                     CODECS[self.compression][2])
         path = os.path.join(self.directory, fname)
         state = self.collect()          # device→host gather happens HERE
-        if self.async_write:
-            import threading
-            self.flush()                # one in-flight write at a time
-            self._writer = threading.Thread(
-                target=self._write_logged, args=(state, path, fname),
-                daemon=True)
-            self._writer.start()
-        else:
-            self._write(state, path, fname)
+        self._dispatch_write(self._write, state, path, fname)
         return path
 
-    def _write_logged(self, state, path, fname):
-        try:
-            self._write(state, path, fname)
-        except Exception:   # noqa: BLE001 — must surface, not vanish
-            self.exception("async snapshot write to %s failed", path)
+    def _dispatch_write(self, write_fn, *args):
+        """Run the (sync) write, or hand it to the single background
+        writer thread under async_write — shared by the file and db
+        backends so the async path cannot diverge."""
+        if not self.async_write:
+            write_fn(*args)
+            return
+
+        def logged():
+            try:
+                write_fn(*args)
+            except Exception:   # noqa: BLE001 — must surface, not vanish
+                self.exception("async snapshot write failed")
+
+        import threading
+        self.flush()                    # one in-flight write at a time
+        self._writer = threading.Thread(target=logged, daemon=True)
+        self._writer.start()
 
     def _write(self, state, path, fname):
         opener, _, _ = CODECS[self.compression]
@@ -217,7 +234,7 @@ class TrainingSnapshotter(SnapshotterBase):
     def collect(self):
         state = {
             "params": self.trainer.host_params(),
-            "velocity": jax.device_get(self.trainer.velocity),
+            "velocity": self.trainer.host_velocity(),
             "loader": self.loader.state,
             "prng": prng.states(),
             "epoch": self.loader.epoch_number,
@@ -283,22 +300,8 @@ class DBSnapshotter(TrainingSnapshotter):
         state = self.collect()          # device→host gather on the loop
         suffix = self.suffix()
         dest = "%s#%s_%s" % (self.dsn, self.prefix, suffix)
-        if self.async_write:
-            import threading
-            self.flush()
-            self._writer = threading.Thread(
-                target=self._db_write_logged, args=(state, suffix, dest),
-                daemon=True)
-            self._writer.start()
-        else:
-            self._db_write(state, suffix, dest)
+        self._dispatch_write(self._db_write, state, suffix, dest)
         return dest
-
-    def _db_write_logged(self, state, suffix, dest):
-        try:
-            self._db_write(state, suffix, dest)
-        except Exception:   # noqa: BLE001 — must surface, not vanish
-            self.exception("async snapshot insert into %s failed", dest)
 
     def _db_write(self, state, suffix, dest):
         blob = pickle.dumps(state, protocol=4)
