@@ -140,3 +140,26 @@ class TestKohonen:
             return wf.trainer.host_weights()
 
         np.testing.assert_array_equal(run(), run())
+
+
+class TestSOMPlotter:
+    def test_hits_and_umatrix(self, tmp_path):
+        from veles_tpu.models.kohonen import SOMPlotter
+        prng.seed_all(12)
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)[:600]
+        loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                 class_lengths=[0, 0, len(x)])
+        wf = KohonenWorkflow(loader=loader, sx=5, sy=4, n_epochs=3,
+                             name="som-plot")
+        wf.initialize()
+        wf.run()
+        path = str(tmp_path / "som.png")
+        payload = SOMPlotter.plot(wf.trainer, x, path)
+        hits = np.asarray(payload["hits"])
+        um = np.asarray(payload["umatrix"])
+        assert hits.shape == (4, 5) and um.shape == (4, 5)
+        assert hits.sum() == len(x)          # every sample lands somewhere
+        assert (um >= 0).all()
+        import os
+        assert os.path.getsize(path) > 1000

@@ -252,3 +252,30 @@ class TestManhole:
         finally:
             mh.stop()
         assert not os.path.exists(path)
+
+
+class TestThreadRouter:
+    def test_routes_only_the_session_thread(self):
+        """Manhole output capture must not hijack other threads' stdout
+        (the training loop keeps printing while a session evaluates)."""
+        import io
+        import threading
+
+        from veles_tpu.interaction import _ThreadRouter
+        orig = io.StringIO()
+        router = _ThreadRouter(orig)
+        session = io.StringIO()
+        router.write("train-before ")
+
+        def worker():
+            router.route(session)
+            router.write("session-output")
+            router.unroute()
+            router.write(" worker-after")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        router.write("train-after")
+        assert session.getvalue() == "session-output"
+        assert orig.getvalue() == "train-before  worker-aftertrain-after"

@@ -219,3 +219,46 @@ class TestWorkflowContainer:
 
         Metric(wf, name="m")
         assert wf.gather_results() == {"acc": 0.9}
+
+
+class TestWorkflowChecksum:
+    def test_stable_and_hex(self):
+        """r2: the reference's per-file version checksum
+        (veles/workflow.py:847) — identical workflows agree."""
+        from veles_tpu.units import TrivialUnit
+        from veles_tpu.workflow import Workflow
+
+        def build():
+            wf = Workflow(name="cs")
+            TrivialUnit(wf, name="a")
+            return wf
+
+        c1, c2 = build().checksum(), build().checksum()
+        assert c1 == c2
+        assert len(c1) == 40 and int(c1, 16) >= 0
+
+    def test_changes_with_unit_code(self, tmp_path):
+        import importlib.util
+        import sys
+
+        from veles_tpu.units import TrivialUnit
+        from veles_tpu.workflow import Workflow
+
+        def custom_unit(body):
+            mod_path = tmp_path / "cs_mod.py"
+            mod_path.write_text(
+                "from veles_tpu.units import TrivialUnit\n"
+                "class Custom(TrivialUnit):\n    %s\n" % body)
+            spec = importlib.util.spec_from_file_location("cs_mod",
+                                                          str(mod_path))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules["cs_mod"] = mod
+            spec.loader.exec_module(mod)
+            return mod.Custom
+
+        def digest(body):
+            wf = Workflow(name="cs2")
+            custom_unit(body)(wf, name="c")
+            return wf.checksum()
+
+        assert digest("x = 1") != digest("x = 2")

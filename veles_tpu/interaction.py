@@ -47,6 +47,60 @@ class Shell(Unit):
             code.interact(banner=self.banner, local=env)
 
 
+class _ThreadRouter(io.TextIOBase):
+    """stdout/stderr proxy that diverts writes from threads that called
+    ``route()`` while leaving every other thread's output untouched."""
+
+    def __init__(self, orig):
+        self._orig = orig
+        self._local = threading.local()
+
+    def route(self, target):
+        self._local.target = target
+
+    def unroute(self):
+        self._local.target = None
+
+    def _t(self):
+        return getattr(self._local, "target", None) or self._orig
+
+    def write(self, s):
+        return self._t().write(s)
+
+    def flush(self):
+        return self._t().flush()
+
+    def __getattr__(self, name):
+        return getattr(self._orig, name)
+
+
+_router_lock = threading.Lock()
+
+
+def _install_thread_router():
+    """Idempotently wrap sys.stdout (and stderr) in a _ThreadRouter,
+    returning the stdout router (stderr routes to the same session
+    target through its own proxy)."""
+    import sys
+    with _router_lock:
+        if not isinstance(sys.stdout, _ThreadRouter):
+            sys.stdout = _ThreadRouter(sys.stdout)
+        if not isinstance(sys.stderr, _ThreadRouter):
+            sys.stderr = _ThreadRouter(sys.stderr)
+        stdout_router, stderr_router = sys.stdout, sys.stderr
+
+    class _Pair:
+        def route(self, target):
+            stdout_router.route(target)
+            stderr_router.route(target)
+
+        def unroute(self):
+            stdout_router.unroute()
+            stderr_router.unroute()
+
+    return _Pair()
+
+
 class Manhole(Logger):
     """Debug REPL over a unix socket (ref veles/external/manhole —
     activated on demand, never blocks the training loop).
@@ -104,6 +158,10 @@ class Manhole(Logger):
         def write(s):
             out.write(s)
         interp.write = write
+        # capture prints from THIS session thread only — a process-wide
+        # redirect would hijack the training loop's own stdout (epoch
+        # logs, the CLI's contractual JSON lines) mid-evaluation
+        stdout_proxy = _install_thread_router()
         try:
             f.write("veles_tpu manhole — scope: %s\n>>> "
                     % sorted(self.scope))
@@ -111,10 +169,11 @@ class Manhole(Logger):
             buf = []
             for line in f:
                 buf.append(line.rstrip("\n"))
-                import contextlib
-                with contextlib.redirect_stdout(out), \
-                        contextlib.redirect_stderr(out):
+                stdout_proxy.route(out)
+                try:
                     more = interp.runsource("\n".join(buf))
+                finally:
+                    stdout_proxy.unroute()
                 if not more:
                     buf = []
                 f.write(out.getvalue())

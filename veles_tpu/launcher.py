@@ -97,6 +97,8 @@ class Launcher(Logger):
                 process_id=self.process_id)
         if self.mesh_axes:
             self.mesh_config = MeshConfig(make_mesh(self.mesh_axes))
+        if jax.process_count() > 1 and self.workflow is not None:
+            self._verify_checksum()
         if self.is_master:
             self._launch_services()
         if self.workflow is not None:
@@ -123,6 +125,22 @@ class Launcher(Logger):
                 loader.on_device = "defer"
             self.workflow.initialize(**kwargs)
         self._initialized = True
+
+    def _verify_checksum(self):
+        """Every process must run the same workflow code (ref the per-file
+        SHA1 handshake check, veles/workflow.py:847 + server.py:478) —
+        a silently divergent binary would produce corrupt collectives."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+        digest = np.frombuffer(
+            bytes.fromhex(self.workflow.checksum()), np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(digest))
+        if not (gathered == digest[None, :]).all():
+            raise RuntimeError(
+                "workflow checksum mismatch across processes — every "
+                "host must run identical workflow code")
+        self.debug("workflow checksum verified across %d processes",
+                   gathered.shape[0] if gathered.ndim > 1 else 1)
 
     def _launch_services(self):
         if self.web_status_port is not None:

@@ -197,6 +197,65 @@ class KohonenTrainer(Unit):
         return {"som_grid": (self.sx, self.sy)}
 
 
+class SOMPlotter(object):
+    """SOM visualizations (ref Kohonen plotters in the Znicz docs): the
+    hit histogram (winners per neuron) and the U-matrix (mean distance
+    of each neuron's weights to its grid neighbors — cluster boundaries
+    show as ridges).  Implemented as a payload/render pair compatible
+    with services.plotting.PlotterBase."""
+
+    @staticmethod
+    def payload(trainer, x):
+        win = np.asarray(trainer.assign(np.asarray(x)))
+        hits = np.bincount(win, minlength=trainer.n_neurons).reshape(
+            trainer.sy, trainer.sx)
+        w = np.asarray(trainer.weights).reshape(trainer.sy, trainer.sx, -1)
+        um = np.zeros((trainer.sy, trainer.sx))
+        counts = np.zeros((trainer.sy, trainer.sx))
+        for dy, dx in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+            shifted = np.roll(w, (dy, dx), axis=(0, 1))
+            d = np.linalg.norm(w - shifted, axis=-1)
+            valid = np.ones_like(d)
+            # roll wraps around; drop the wrapped edge contribution
+            if dy == 1:
+                valid[0, :] = 0
+            elif dy == -1:
+                valid[-1, :] = 0
+            if dx == 1:
+                valid[:, 0] = 0
+            elif dx == -1:
+                valid[:, -1] = 0
+            um += d * valid
+            counts += valid
+        # true mean over each neuron's REAL neighbors (edges have 3,
+        # corners 2 — dividing by 4 would fade border ridges)
+        um /= np.maximum(counts, 1)
+        return {"kind": "som", "hits": hits.tolist(),
+                "umatrix": um.tolist()}
+
+    @staticmethod
+    def render(payload, path):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, (a1, a2) = plt.subplots(1, 2, figsize=(8, 4))
+        im1 = a1.imshow(np.asarray(payload["hits"]), cmap="viridis")
+        a1.set_title("hits")
+        fig.colorbar(im1, ax=a1, shrink=0.8)
+        im2 = a2.imshow(np.asarray(payload["umatrix"]), cmap="bone")
+        a2.set_title("U-matrix")
+        fig.colorbar(im2, ax=a2, shrink=0.8)
+        fig.tight_layout()
+        fig.savefig(path, dpi=80)
+        plt.close(fig)
+
+    @classmethod
+    def plot(cls, trainer, x, path):
+        payload = cls.payload(trainer, x)
+        cls.render(payload, path)
+        return payload
+
+
 class KohonenDecision(Unit):
     """Fixed-epoch stop + quantization-error logging."""
 

@@ -104,7 +104,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                                     CODECS[self.compression][2])
         path = os.path.join(self.directory, fname)
         state = self.collect()          # device→host gather happens HERE
-        self._dispatch_write(self._write, state, path, fname)
+        self._dispatch_write(self._write, state, fname, path)
         return path
 
     def _dispatch_write(self, write_fn, *args):
@@ -119,14 +119,15 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             try:
                 write_fn(*args)
             except Exception:   # noqa: BLE001 — must surface, not vanish
-                self.exception("async snapshot write failed")
+                self.exception("async snapshot write to %s failed"
+                               % (args[-1],))   # path / db destination
 
         import threading
         self.flush()                    # one in-flight write at a time
         self._writer = threading.Thread(target=logged, daemon=True)
         self._writer.start()
 
-    def _write(self, state, path, fname):
+    def _write(self, state, fname, path):
         opener, _, _ = CODECS[self.compression]
         # atomic: a crash mid-write leaves the previous snapshot intact
         # and _current never points at a partial file

@@ -207,6 +207,35 @@ class Workflow(Container):
         self._power_cache_ = (now, bench.computing_power)
         return bench.computing_power
 
+    def checksum(self):
+        """SHA1 over the source files defining this workflow's unit
+        classes (ref workflow.py:847 — the per-file checksum that guarded
+        master/slave version match; the Launcher compares it across
+        processes before a multi-host run)."""
+        import hashlib
+        import inspect
+        files = set()
+        for u in self._units:
+            try:
+                f = inspect.getsourcefile(type(u))
+            except TypeError:
+                f = None
+            if f:
+                files.add(f)
+        digests = []
+        for path in files:
+            try:
+                with open(path, "rb") as f:
+                    digests.append(hashlib.sha1(f.read()).hexdigest())
+            except OSError:
+                pass
+        # combine SORTED per-file digests: path-independent, so hosts
+        # with different install prefixes but identical bytes agree
+        h = hashlib.sha1()
+        for d in sorted(digests):
+            h.update(d.encode())
+        return h.hexdigest()
+
     def gather_results(self):
         """Collect metrics from every unit exposing ``get_metric_values()``
         (IResultProvider, ref workflow.py:823-845)."""
