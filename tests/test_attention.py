@@ -117,3 +117,36 @@ def test_mha_forward_and_grad():
     g = jax.grad(lambda p: jnp.sum(
         att.mha_forward(p, x, n_heads, causal=True) ** 2))(params)
     assert jnp.all(jnp.isfinite(g["wq"]))
+
+
+def test_flash_bf16_inputs_match_f32_reference():
+    """Mixed precision: bf16 q/k/v multiply on the MXU at native rate
+    while softmax stats and the output accumulator stay f32 — results
+    must track the f32 reference within bf16 tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.attention import attention
+    from veles_tpu.ops.pallas.flash import flash_attention
+
+    key = jax.random.key(4)
+    q, k, v = (jax.random.normal(kk, (2, 2, 256, 64), jnp.float32) * 0.3
+               for kk in jax.random.split(key, 3))
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), causal=True)
+    ref = attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < 0.05
+
+
+def test_flash_mixed_dtypes_rejected():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from veles_tpu.ops.pallas.flash import flash_attention
+    key = jax.random.key(1)
+    q, k, v = (jax.random.normal(kk, (1, 1, 64, 32), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    with pytest.raises(ValueError, match="matching q/k/v dtypes"):
+        flash_attention(q, k.astype(jnp.bfloat16), v)

@@ -39,8 +39,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
 
     @pl.when(diag_ok)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # dot inputs keep their storage dtype (bf16 rides the MXU at
+        # full rate); preferred_element_type pins f32 ACCUMULATION —
+        # the standard flash-attention mixed-precision recipe
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -63,8 +66,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
         l[:] = l[:] * corr + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l.shape)
         m[:] = jnp.broadcast_to(m_new, m.shape)
+        # probabilities cast DOWN to v's dtype for the MXU; the f32
+        # running accumulator preserves precision across k blocks
         acc[:] = acc[:] * corr + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -96,6 +101,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     requirement.)"""
     if causal and q.shape[-2] != k.shape[-2]:
         raise ValueError("causal flash kernel assumes tq == tk")
+    if not (q.dtype == k.dtype == v.dtype):
+        # dot operands keep their storage dtype (MXU-native); mixed
+        # inputs must be reconciled by the caller, not silently upcast
+        raise ValueError(
+            "flash_attention needs matching q/k/v dtypes, got %s/%s/%s"
+            % (q.dtype, k.dtype, v.dtype))
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash_fn(causal, float(scale), block_q, block_k,
