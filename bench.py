@@ -112,33 +112,46 @@ def phase_gemm():
 
 
 def phase_mlp():
-    """MNIST 784-100-10 step time (BASELINE 'MNIST MLP step time')."""
+    """MNIST 784-100-10 step time (BASELINE 'MNIST MLP step time'), plus
+    the fused steps_per_dispatch=20 sweep (k minibatches per host→device
+    round trip — the dispatch-amortized number real training runs at)."""
     import numpy as np
     from veles_tpu import prng
     from veles_tpu.loader.fullbatch import FullBatchLoader
     from veles_tpu.models.standard_workflow import StandardWorkflow
     from veles_tpu.models.zoo import mnist_mlp
 
-    prng.seed_all(3)
-    x = np.random.RandomState(0).rand(2000, 784).astype(np.float32)
-    y = np.random.RandomState(1).randint(0, 10, 2000).astype(np.int32)
-    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
-                             class_lengths=[0, 0, 2000])
-    wf = StandardWorkflow(layers=mnist_mlp(), loader=loader,
-                          decision_config={"max_epochs": 1}, name="bench-mlp")
-    wf.initialize()
-    wf.loader.run()
-    wf.trainer.run()          # compile
-    _block(wf.trainer.class_stats[2]["loss"])
-    t0 = time.perf_counter()
-    steps = 50
-    for _ in range(steps):
-        wf.loader.run()
-        wf.trainer.run()
-    _block(wf.trainer.class_stats[2]["loss"])
-    step = (time.perf_counter() - t0) / steps
-    _log("mnist mlp 784-100-10 step: %.3f ms" % (step * 1e3))
-    return {"step_ms": step * 1e3}
+    def build(k):
+        prng.seed_all(3)
+        x = np.random.RandomState(0).rand(2000, 784).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 10, 2000).astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=100,
+                                 class_lengths=[0, 0, 2000])
+        wf = StandardWorkflow(layers=mnist_mlp(), loader=loader,
+                              decision_config={"max_epochs": 1},
+                              steps_per_dispatch=k, name="bench-mlp")
+        wf.initialize()
+        return wf
+
+    def measure(wf, steps=60):
+        for _ in range(steps):          # compile + warmup (covers sweep)
+            wf.loader.run()
+            wf.trainer.run()
+        wf.trainer.flush()
+        _block(wf.trainer.class_stats[2]["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            wf.loader.run()
+            wf.trainer.run()
+        wf.trainer.flush()
+        _block(wf.trainer.class_stats[2]["loss"])
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    step_ms = measure(build(1))
+    fused_ms = measure(build(20))
+    _log("mnist mlp 784-100-10 step: %.3f ms per-step, %.3f ms fused k=20"
+         % (step_ms, fused_ms))
+    return {"step_ms": step_ms, "step_fused_ms": fused_ms}
 
 
 def phase_alexnet():
@@ -250,10 +263,11 @@ def phase_kohonen():
 
     res = benchmark_som(n_samples=2048, n_features=784, sx=16, sy=16,
                         minibatch_size=512, steps=20)
-    _log("kohonen 16x16 som, batch 512, 784 feats: %.2f ms/step batched "
-         "vs %.2f scan (%.1fx), qe %.4f"
-         % (res["ms_per_step"], res["scan_ms_per_step"], res["speedup"],
-            res["quantization_error"]))
+    _log("kohonen 16x16 som, batch 512, 784 feats: %.3f ms/step batched, "
+         "%.3f fused-sweep vs %.2f scan (%.1fx / %.1fx), qe %.4f/%.4f"
+         % (res["ms_per_step"], res["sweep_ms_per_step"],
+            res["scan_ms_per_step"], res["speedup"], res["sweep_speedup"],
+            res["quantization_error"], res["sweep_quantization_error"]))
     return res
 
 
@@ -365,10 +379,14 @@ def main():
         "vs_baseline": round(gflops / BASELINE_GEMM_GFLOPS, 2),
         "gemm_bf16_gflops": round(gemm.get("bf16_gflops", 0.0), 1),
         "mlp_step_ms": round(results.get("mlp", {}).get("step_ms", 0.0), 3),
+        "mlp_step_fused_ms": round(
+            results.get("mlp", {}).get("step_fused_ms", 0.0), 3),
         "alexnet_samples_per_sec": round(
             results.get("alexnet", {}).get("samples_per_sec", 0.0), 1),
         "kohonen_ms_per_step": round(
             results.get("kohonen", {}).get("ms_per_step", 0.0), 2),
+        "kohonen_sweep_speedup": round(
+            results.get("kohonen", {}).get("sweep_speedup", 0.0), 1),
         "flash_ok": bool(results.get("flash", {}).get("ok")),
         "flash_platform": results.get("flash", {}).get("platform"),
         "ring_ok": bool(results.get("ring", {}).get("ok")),

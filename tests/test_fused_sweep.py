@@ -1,0 +1,97 @@
+"""steps_per_dispatch: the fused k-step sweep must be numerically
+equivalent to the per-step dispatch path (same ops in the same order — the
+only change is how many minibatches ride one host→device round trip)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.parallel import MeshConfig, make_mesh
+
+
+def _run(steps_per_dispatch, mesh_config=None, max_epochs=3, seed=42,
+         minibatch_size=64):
+    prng.seed_all(seed)
+    rs = np.random.RandomState(0)
+    x = rs.rand(640, 36).astype(np.float32)
+    y = rs.randint(0, 5, 640).astype(np.int32)
+    loader = FullBatchLoader(None, data=x, labels=y,
+                             minibatch_size=minibatch_size,
+                             class_lengths=[0, 128, 512])
+    wf = StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.1, "gradient_moment": 0.9},
+            {"type": "dropout", "dropout_ratio": 0.3},
+            {"type": "softmax", "output_sample_shape": 5,
+             "learning_rate": 0.1, "gradient_moment": 0.9},
+        ],
+        loader=loader,
+        decision_config={"max_epochs": max_epochs},
+        mesh_config=mesh_config,
+        steps_per_dispatch=steps_per_dispatch,
+        name="sweep-%d" % steps_per_dispatch)
+    wf.initialize()
+    wf.run()
+    params = wf.trainer.host_params()
+    stats = wf.trainer.read_class_stats(2)
+    return wf.decision.best_metric, params, stats
+
+
+class TestFusedSweep:
+    def test_matches_per_step_path(self):
+        m1, p1, s1 = _run(1)
+        m4, p4, s4 = _run(4)
+        assert s1["count"] == s4["count"]
+        assert m1 == pytest.approx(m4, abs=1e-6)
+        for name in p1:
+            for k in p1[name]:
+                np.testing.assert_allclose(
+                    p1[name][k], p4[name][k], rtol=2e-5, atol=2e-6,
+                    err_msg="%s/%s diverged" % (name, k))
+
+    def test_ragged_tail_uses_per_step_fallback(self):
+        # 512 train / 64 = 8 steps per epoch; k=3 leaves a tail of 2
+        m1, p1, _ = _run(1)
+        m3, p3, _ = _run(3)
+        assert m1 == pytest.approx(m3, abs=1e-6)
+        for name in p1:
+            for k in p1[name]:
+                np.testing.assert_allclose(p1[name][k], p3[name][k],
+                                           rtol=2e-5, atol=2e-6)
+
+    def test_under_data_parallel_mesh(self):
+        import jax
+        mc = MeshConfig(make_mesh({"data": 4}, jax.devices()[:4]))
+        m1, p1, s1 = _run(1, mesh_config=mc)
+        mk, pk, sk = _run(4, mesh_config=mc)
+        assert s1["count"] == sk["count"]
+        assert m1 == pytest.approx(mk, abs=1e-6)
+        for name in p1:
+            for k in p1[name]:
+                np.testing.assert_allclose(p1[name][k], pk[name][k],
+                                           rtol=2e-5, atol=2e-6)
+
+    def test_snapshot_resume_flushes_pending(self, tmp_path):
+        prng.seed_all(7)
+        rs = np.random.RandomState(1)
+        x = rs.rand(320, 16).astype(np.float32)
+        y = rs.randint(0, 4, 320).astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=32,
+                                 class_lengths=[0, 64, 256])
+        wf = StandardWorkflow(
+            layers=[{"type": "softmax", "output_sample_shape": 4,
+                     "learning_rate": 0.1, "gradient_moment": 0.9}],
+            loader=loader, decision_config={"max_epochs": 2},
+            snapshotter_config={"directory": str(tmp_path), "interval": 1,
+                                "prefix": "sw"},
+            steps_per_dispatch=5, name="sweep-snap")
+        wf.initialize()
+        wf.run()
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        snap = SnapshotterBase.import_(wf.snapshotter.destination)
+        assert snap["epoch"] == 2
+        # no steps may linger unapplied after the run completed
+        assert not wf.trainer._pending

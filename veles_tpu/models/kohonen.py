@@ -78,11 +78,26 @@ def som_batch_step(weights, coords, x, valid, lr, radius):
     return weights + lr * delta / jnp.maximum(den, 1e-6)[:, None], win
 
 
+def som_sweep(weights, coords, xs, valids, lr, radius):
+    """k minibatch batch-SOM steps fused into ONE dispatch (lax.scan over
+    a [k, B, F] stack) — amortizes host→device dispatch latency exactly
+    like StagedTrainer's steps_per_dispatch."""
+
+    def body(w, inp):
+        x, v = inp
+        w, _ = som_batch_step(w, coords, x, v, lr, radius)
+        return w, None
+
+    return jax.lax.scan(body, weights, (xs, valids))[0]
+
+
 def benchmark_som(n_samples=1024, n_features=64, sx=8, sy=8,
                   minibatch_size=128, steps=20, seed=0):
-    """Timing comparison of the scan (online) vs batched SOM step on
-    synthetic data.  Returns ms/step for both and the speedup — used by
-    bench.py's kohonen phase (VERDICT r1 weak #3)."""
+    """Timing comparison of the per-sample scan (online) vs batched SOM
+    step vs the fused multi-step sweep on synthetic data.  Returns ms/step
+    for each and the speedups — used by bench.py's kohonen phase
+    (VERDICT r1 weak #3: ≥10× the scan path at equal quantization
+    error)."""
     import time
 
     rs = np.random.RandomState(seed)
@@ -110,11 +125,27 @@ def benchmark_som(n_samples=1024, n_features=64, sx=8, sy=8,
 
     scan_ms, _ = run(scan_step)
     batch_ms, w_batch = run(batch_step)
+
+    # fused sweep: all `steps` minibatches in one dispatch
+    xs = jnp.stack([batches[i % len(batches)] for i in range(steps)])
+    vs = jnp.broadcast_to(valid, (steps,) + valid.shape)
+    sweep = jax.jit(som_sweep)
+    jax.block_until_ready(sweep(w0, coords, xs, vs, 0.5, 3.0))  # compile
+    t0 = time.perf_counter()
+    w_sweep = sweep(w0, coords, xs, vs, 0.5, 3.0)
+    jax.block_until_ready(w_sweep)
+    sweep_ms = (time.perf_counter() - t0) / steps * 1e3
+
     qe = float(jnp.mean(jnp.linalg.norm(
         x_all - w_batch[winners(w_batch, x_all)], axis=1)))
+    qe_sweep = float(jnp.mean(jnp.linalg.norm(
+        x_all - w_sweep[winners(w_sweep, x_all)], axis=1)))
     return {"ms_per_step": batch_ms, "scan_ms_per_step": scan_ms,
+            "sweep_ms_per_step": sweep_ms,
             "speedup": scan_ms / batch_ms if batch_ms else 0.0,
-            "impl": "batch", "quantization_error": qe}
+            "sweep_speedup": scan_ms / sweep_ms if sweep_ms else 0.0,
+            "impl": "batch", "quantization_error": qe,
+            "sweep_quantization_error": qe_sweep}
 
 
 class KohonenTrainer(Unit):

@@ -59,11 +59,24 @@ class StagedTrainer(Unit):
     ``minibatch_valid``, ``minibatch_class``."""
 
     def __init__(self, workflow, layers, loss="softmax", gd_defaults=None,
-                 mesh_config=None, dataset_placement="shard", **kwargs):
+                 mesh_config=None, dataset_placement="shard",
+                 steps_per_dispatch=1, **kwargs):
         super(StagedTrainer, self).__init__(workflow, **kwargs)
         self.layers = layers
         self.loss = loss
         self.gd_defaults = gd_defaults or {}
+        #: fuse this many minibatch steps into ONE device dispatch
+        #: (lax.scan inside the jitted sweep).  Amortizes host→device
+        #: dispatch latency — the dominant cost for small models and for
+        #: remote/tunneled TPUs — exactly k× fewer dispatches; numerics
+        #: are the same per-step ops in the same order.  Index-mode
+        #: loaders only (data-carrying loaders stream host tensors, so
+        #: the host must intervene every step anyway).
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        self._pending = []          # queued (idx, valid, step, lr) rows
+        self._pending_cls = None
         #: parallel.MeshConfig or None (single device).  With a mesh, params
         #: shard over the model axis (tp) and the minibatch over the data
         #: axis (dp) — XLA inserts the gradient psum over ICI.
@@ -208,6 +221,7 @@ class StagedTrainer(Unit):
             return jax.tree_util.tree_map(jnp.add, acc, stats)
 
         self._jit_steps(train_step, eval_step)
+        self._build_sweeps(train_step, eval_step)
         self._gather = FullBatchLoader.gather
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding
@@ -229,22 +243,75 @@ class StagedTrainer(Unit):
         self._targets_dev = (targets if targets is not None
                              else jnp.zeros((1,), jnp.float32))
 
-    def _jit_steps(self, train_step, eval_step):
-        """jit the pair with donation; under a mesh, pin the output
-        shardings (params/velocity per the partition rules, stat
-        accumulators replicated) — shared by the index and data-carrying
-        builders so the two paths cannot diverge."""
-        if self.mesh_config is None:
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-            self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+    def _build_sweeps(self, train_step, eval_step):
+        """k-step fused dispatch (steps_per_dispatch > 1, index mode):
+        one jitted lax.scan advances k minibatches per host→device round
+        trip.  The scan body IS train_step / eval_step — the exact
+        functions the per-step path jits — so the two paths cannot
+        diverge; partial groups (class change, epoch end) fall back to
+        the per-step functions, so nothing ever recompiles on a ragged
+        tail."""
+        self._sweeps = None
+        if self.steps_per_dispatch <= 1:
             return
+
+        def train_sweep(params, velocity, acc, data, labels, targets,
+                        idxs, valids, steps, lr_scales):
+            def body(carry, inp):
+                idx, valid, step, lr_s = inp
+                return train_step(*carry, data, labels, targets, idx,
+                                  valid, step, lr_s), None
+
+            (params, velocity, acc), _ = jax.lax.scan(
+                body, (params, velocity, acc),
+                (idxs, valids, steps, lr_scales))
+            return params, velocity, acc
+
+        def eval_sweep(params, acc, data, labels, targets, idxs, valids):
+            def body(a, inp):
+                idx, valid = inp
+                return eval_step(params, a, data, labels, targets, idx,
+                                 valid), None
+
+            return jax.lax.scan(body, acc, (idxs, valids))[0]
+
+        pins = self._shard_pins()
+        if pins is None:
+            self._sweeps = (
+                jax.jit(train_sweep, donate_argnums=(0, 1, 2)),
+                jax.jit(eval_sweep, donate_argnums=(1,)))
+            return
+        p_sh, v_sh, acc_sh = pins
+        self._sweeps = (
+            jax.jit(train_sweep, donate_argnums=(0, 1, 2),
+                    out_shardings=(p_sh, v_sh, acc_sh)),
+            jax.jit(eval_sweep, donate_argnums=(1,),
+                    out_shardings=acc_sh))
+
+    def _shard_pins(self):
+        """(params, velocity, acc) output shardings under a mesh (params/
+        velocity per the partition rules, stat accumulators replicated);
+        None on a single device."""
+        if self.mesh_config is None:
+            return None
         from veles_tpu.parallel import sharding
         mc = self.mesh_config
         repl = sharding.replicated_sharding(mc)
         overrides = getattr(self, "_param_overrides", None)
-        p_sh = sharding.param_shardings(self.params, mc, overrides)
-        v_sh = sharding.param_shardings(self.velocity, mc, overrides)
-        acc_sh = jax.tree_util.tree_map(lambda _: repl, self._zero_stats())
+        return (sharding.param_shardings(self.params, mc, overrides),
+                sharding.param_shardings(self.velocity, mc, overrides),
+                jax.tree_util.tree_map(lambda _: repl, self._zero_stats()))
+
+    def _jit_steps(self, train_step, eval_step):
+        """jit the pair with donation; under a mesh, pin the output
+        shardings — shared by the index and data-carrying builders (and
+        the fused sweeps) so the paths cannot diverge."""
+        pins = self._shard_pins()
+        if pins is None:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
+            return
+        p_sh, v_sh, acc_sh = pins
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2),
                                    out_shardings=(p_sh, v_sh, acc_sh))
         self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
@@ -280,6 +347,7 @@ class StagedTrainer(Unit):
                                              False, jax.random.key(0))
             return jax.tree_util.tree_map(jnp.add, acc, stats)
 
+        self._sweeps = None     # fused sweeps are index-mode only
         self._jit_steps(train_step, eval_step)
 
     def _direct_batch(self, loader):
@@ -311,7 +379,10 @@ class StagedTrainer(Unit):
         if root.common.engine.get("sync_run"):
             # honest per-unit wall time: charge the device work to THIS
             # unit instead of the next host sync (ref --sync-run,
-            # accelerated_units.py:186-193)
+            # accelerated_units.py:186-193); queued sweep steps must
+            # dispatch now or their device time would land on whichever
+            # step finally flushes
+            self.flush()
             jax.block_until_ready(self.class_stats)
 
     def _run_step(self):
@@ -337,6 +408,20 @@ class StagedTrainer(Unit):
                     self.params, self.class_stats[cls], x, lbl, tgt, valid)
             return
         cls = loader.minibatch_class
+        if self._sweeps is not None:
+            if self._pending and self._pending_cls != cls:
+                self.flush()
+            train = cls in self.train_only_classes
+            if train:
+                self._step_counter += 1
+            self._pending_cls = cls
+            self._pending.append((
+                np.array(loader.minibatch_indices),
+                np.array(loader.minibatch_valid, np.float32),
+                self._step_counter, float(self.lr_scale)))
+            if len(self._pending) >= self.steps_per_dispatch:
+                self.flush()
+            return
         if self.mesh_config is not None:
             from veles_tpu.parallel import sharding
             idx = sharding.shard_batch(
@@ -359,6 +444,65 @@ class StagedTrainer(Unit):
                 self.params, self.class_stats[cls], self._data_dev,
                 self._labels_dev, self._targets_dev, idx, valid)
 
+    # ---------------------------------------------------------- fused sweep
+    def _place_stack(self, x):
+        """Device placement for a [k, B] stacked index/valid matrix: one
+        transfer per flush instead of one per step."""
+        if self.mesh_config is None:
+            return jnp.asarray(x)
+        from veles_tpu.parallel import sharding
+        return sharding.shard_batch_stack(x, self.mesh_config)
+
+    def flush(self):
+        """Dispatch any queued minibatches (steps_per_dispatch > 1).  Full
+        k-groups ride the fused sweep; the ragged tail (class change or
+        epoch end) rides the per-step functions — both compiled once."""
+        if not self._pending:
+            return
+        cls = self._pending_cls
+        pending, self._pending = self._pending, []
+        self._pending_cls = None
+        train = cls in self.train_only_classes
+        train_sweep, eval_sweep = self._sweeps
+        k = self.steps_per_dispatch
+        i = 0
+        while len(pending) - i >= k:
+            group = pending[i:i + k]
+            i += k
+            idxs = self._place_stack(np.stack([g[0] for g in group]))
+            valids = self._place_stack(np.stack([g[1] for g in group]))
+            if train:
+                steps = jnp.asarray([g[2] for g in group], jnp.int32)
+                lrs = jnp.asarray([g[3] for g in group], jnp.float32)
+                self.params, self.velocity, self.class_stats[cls] = \
+                    train_sweep(self.params, self.velocity,
+                                self.class_stats[cls], self._data_dev,
+                                self._labels_dev, self._targets_dev,
+                                idxs, valids, steps, lrs)
+            else:
+                self.class_stats[cls] = eval_sweep(
+                    self.params, self.class_stats[cls], self._data_dev,
+                    self._labels_dev, self._targets_dev, idxs, valids)
+        for idx, valid, step, lr in pending[i:]:
+            if self.mesh_config is not None:
+                from veles_tpu.parallel import sharding
+                idx = sharding.shard_batch(jnp.asarray(idx),
+                                           self.mesh_config)
+                valid = sharding.shard_batch(jnp.asarray(valid),
+                                             self.mesh_config)
+            else:
+                idx, valid = jnp.asarray(idx), jnp.asarray(valid)
+            if train:
+                self.params, self.velocity, self.class_stats[cls] = \
+                    self._train_step(self.params, self.velocity,
+                                     self.class_stats[cls], self._data_dev,
+                                     self._labels_dev, self._targets_dev,
+                                     idx, valid, step, jnp.float32(lr))
+            else:
+                self.class_stats[cls] = self._eval_step(
+                    self.params, self.class_stats[cls], self._data_dev,
+                    self._labels_dev, self._targets_dev, idx, valid)
+
     # ------------------------------------------------------------- metrics
     def _zero_stats(self):
         return {"loss": jnp.zeros(()), "n_errors": jnp.zeros(()),
@@ -369,6 +513,7 @@ class StagedTrainer(Unit):
 
     def read_class_stats(self, cls):
         """Device→host sync — called once per class sweep by Decision."""
+        self.flush()
         st = jax.device_get(self.class_stats[cls])
         return {"loss": float(st["loss"]),
                 "n_errors": int(st["n_errors"]),
@@ -381,9 +526,11 @@ class StagedTrainer(Unit):
         with a process_allgather collective — EVERY process must call
         this together (the snapshotter does; ref only-master-writes,
         snapshotter.py:160)."""
+        self.flush()
         return self.host_tree(self.params)
 
     def host_velocity(self):
+        self.flush()
         return self.host_tree(self.velocity)
 
     @staticmethod
@@ -398,6 +545,8 @@ class StagedTrainer(Unit):
         return jax.tree_util.tree_map(get, tree)
 
     def load_params(self, host_params, host_velocity=None):
+        # queued steps would otherwise apply to the restored params
+        self._pending, self._pending_cls = [], None
         self.params = jax.tree_util.tree_map(jnp.asarray, host_params)
         if host_velocity is not None:
             self.velocity = jax.tree_util.tree_map(jnp.asarray,

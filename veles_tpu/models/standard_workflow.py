@@ -26,7 +26,7 @@ class StandardWorkflow(Workflow):
     def __init__(self, workflow=None, layers=None, loader=None,
                  loss="softmax", decision_config=None, snapshotter_config=None,
                  gd_defaults=None, mesh_config=None, lr_adjuster_config=None,
-                 dataset_placement="shard", **kwargs):
+                 dataset_placement="shard", steps_per_dispatch=1, **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -44,7 +44,8 @@ class StandardWorkflow(Workflow):
         self.trainer = StagedTrainer(self, [make_layer(c) for c in layers],
                                      loss=loss, gd_defaults=gd_defaults,
                                      mesh_config=mesh_config,
-                                     dataset_placement=dataset_placement)
+                                     dataset_placement=dataset_placement,
+                                     steps_per_dispatch=steps_per_dispatch)
         self.trainer.loader = self.loader
         self.forwards = [Forward(self, lay, self.trainer)
                          for lay in self.trainer.layers]

@@ -161,5 +161,16 @@ def shard_batch(x, mesh_cfg):
         x, NamedSharding(mesh_cfg.mesh, P(mesh_cfg.data_axis)))
 
 
+def shard_batch_stack(x, mesh_cfg):
+    """Shard dim 1 (minibatch) of a [k, B, ...] stack over the data axis —
+    the fused k-step sweep's index/valid matrices, one transfer per k
+    steps."""
+    if mesh_cfg.data_axis not in mesh_cfg.mesh.shape:
+        return replicate(x, mesh_cfg)
+    return jax.device_put(
+        x, NamedSharding(mesh_cfg.mesh,
+                         P(None, mesh_cfg.data_axis)))
+
+
 def replicated_sharding(mesh_cfg):
     return NamedSharding(mesh_cfg.mesh, P())
