@@ -33,6 +33,7 @@ PHASES = [
     ("gemm", 420),
     ("mlp", 420),
     ("alexnet", 600),
+    ("lm", 600),
     ("flash", 300),
     ("ring", 420),
     ("kohonen", 300),
@@ -184,6 +185,51 @@ def phase_alexnet():
     sps = batch * steps / (time.perf_counter() - t0)
     _log("alexnet synthetic: %.1f samples/sec/chip" % sps)
     return {"samples_per_sec": sps}
+
+
+def phase_lm():
+    """Causal transformer LM training throughput (tokens/sec/chip) — the
+    beyond-parity flagship: GPT-style decoder (25M params, T=1024, Pallas
+    flash attention, bf16 MXU compute) through the SAME StandardWorkflow
+    hot loop as every other model, with the fused k-step dispatch."""
+    import numpy as np
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+
+    prng.seed_all(5)
+    batch, seq, steps = 8, 1024, 20
+    n = batch * 4
+    toks = np.random.RandomState(0).randint(
+        0, 8192, (n, seq)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=batch,
+                             class_lengths=[0, 0, n])
+    wf = StandardWorkflow(
+        layers=transformer_lm(vocab_size=8192, d_model=512, n_heads=8,
+                              n_layers=8, dropout=0.0, impl="flash",
+                              lr=1e-3),
+        loader=loader, loss="lm",
+        decision_config={"max_epochs": 1000},
+        steps_per_dispatch=5, name="bench-lm")
+    wf.initialize()
+    for _ in range(10):          # compile + warmup (2 fused sweeps)
+        wf.loader.run()
+        wf.trainer.run()
+    wf.trainer.flush()
+    _block(wf.trainer.class_stats[2]["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        wf.loader.run()
+        wf.trainer.run()
+    wf.trainer.flush()
+    _block(wf.trainer.class_stats[2]["loss"])
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    _log("transformer lm 25M (T=1024, flash): %.0f tokens/sec/chip, "
+         "%.1f ms/step" % (tps, dt / steps * 1e3))
+    return {"tokens_per_sec": tps, "ms_per_step": dt / steps * 1e3}
 
 
 def phase_flash():
@@ -383,6 +429,8 @@ def main():
             results.get("mlp", {}).get("step_fused_ms", 0.0), 3),
         "alexnet_samples_per_sec": round(
             results.get("alexnet", {}).get("samples_per_sec", 0.0), 1),
+        "lm_tokens_per_sec": round(
+            results.get("lm", {}).get("tokens_per_sec", 0.0), 1),
         "kohonen_ms_per_step": round(
             results.get("kohonen", {}).get("ms_per_step", 0.0), 2),
         "kohonen_sweep_speedup": round(

@@ -1,0 +1,99 @@
+"""Sample workflow: ImageNet AlexNet — the BASELINE flagship config
+(BASELINE.md: "ImageNet AlexNet workflow trains end-to-end on v5e-8 at
+>= CUDA-backend samples/sec/chip"; ref the i_caffe configs the docs
+describe, manualrst_veles_algorithms.rst).
+
+The dataset never materializes in HBM or host RAM: a GeneratorLoader
+streams fixed-shape minibatches (host-side JPEG decode + resize when
+``root.imagenet.data_dir`` points at an ImageNet-style tree of
+``<class>/<image>`` files; synthetic pixels otherwise), and the trainer's
+async dispatch double-buffers batch t+1 against device step t.  Scales
+over a device mesh with ``--mesh data=8`` (the arriving batch shards over
+the data axis).
+
+    # synthetic smoke (any machine)
+    python -m veles_tpu samples/imagenet_alexnet.py --backend cpu \
+        --config-list root.imagenet.minibatch_size=8 \
+                      root.imagenet.steps_per_epoch=2 \
+                      root.imagenet.max_epochs=1
+
+    # real data, v5e-8
+    python -m veles_tpu samples/imagenet_alexnet.py --mesh data=8 \
+        --config-list root.imagenet.data_dir=\\"/data/imagenet/train\\"
+"""
+
+import os
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.streaming import GeneratorLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import alexnet
+
+SHAPE = (227, 227, 3)
+
+
+def _synthetic_generator(n_classes, seed=0):
+    def gen(step, size):
+        rs = np.random.RandomState(seed + step)
+        return (rs.rand(size, *SHAPE).astype(np.float32),
+                rs.randint(0, n_classes, size).astype(np.int32))
+    return gen
+
+
+def _imagenet_generator(data_dir, n_threads=8):
+    """Host-side decode pipeline over an ImageNet-style directory tree:
+    shuffled (path, label) stream, PIL decode + center resize to 227²,
+    scaled to [0, 1]; a thread pool overlaps per-image decodes."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from veles_tpu import prng
+
+    classes = sorted(d for d in os.listdir(data_dir)
+                     if os.path.isdir(os.path.join(data_dir, d)))
+    if not classes:
+        raise ValueError("no class subdirectories under %r" % data_dir)
+    files = [(os.path.join(data_dir, c, f), i)
+             for i, c in enumerate(classes)
+             for f in sorted(os.listdir(os.path.join(data_dir, c)))]
+    order = prng.get("imagenet-order").permutation(len(files))
+    pool = ThreadPoolExecutor(n_threads)
+
+    def decode(pair):
+        from PIL import Image
+        path, label = pair
+        with Image.open(path) as im:
+            im = im.convert("RGB").resize(SHAPE[:2])
+            return np.asarray(im, np.float32) / 255.0, label
+
+    def gen(step, size):
+        take = [files[order[(step * size + j) % len(files)]]
+                for j in range(size)]
+        out = list(pool.map(decode, take))
+        return (np.stack([d for d, _ in out]),
+                np.asarray([l for _, l in out], np.int32))
+
+    return gen, len(files), len(classes)
+
+
+def run(load, main):
+    cfg = root.imagenet
+    size = cfg.get("minibatch_size", 256)
+    data_dir = cfg.get("data_dir", None)
+    if data_dir:
+        gen, n_files, n_classes = _imagenet_generator(data_dir)
+        steps = cfg.get("steps_per_epoch", max(1, n_files // size))
+    else:
+        n_classes = cfg.get("n_classes", 1000)
+        gen = _synthetic_generator(n_classes)
+        steps = cfg.get("steps_per_epoch", 50)
+    loader = GeneratorLoader(None, generator=gen, sample_shape=SHAPE,
+                             steps_per_epoch=steps, minibatch_size=size)
+    load(StandardWorkflow,
+         layers=alexnet(n_classes=n_classes,
+                        lr=cfg.get("learning_rate", 0.01)),
+         loader=loader,
+         decision_config={"max_epochs": cfg.get("max_epochs", 90)},
+         name="imagenet-alexnet")
+    main()

@@ -31,6 +31,22 @@ class TestCLI:
         assert res["epochs"] == 2
         assert res["best_metric"] is not None
 
+    def test_steps_per_dispatch_flag_matches_per_step(self, tmp_path):
+        def run(extra):
+            out = str(tmp_path / ("res%d.json" % len(extra)))
+            r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
+                      "--random-seed", "5",
+                      "--config-list", "root.digits.max_epochs=2",
+                      "--result-file", out] + extra)
+            assert r.returncode == 0, r.stderr[-2000:]
+            return json.load(open(out))["best_metric"]
+
+        import pytest
+        # fused lax.scan is a different XLA program: ulp-level drift is
+        # legal, bitwise equality is not guaranteed
+        assert run([]) == pytest.approx(
+            run(["--steps-per-dispatch", "4"]), abs=5e-3)
+
     def test_export_flag_writes_package(self, tmp_path):
         pkg = str(tmp_path / "model.zip")
         r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
@@ -55,6 +71,41 @@ class TestCLI:
                   "--random-seed", "5",
                   "--config-list", "root.digits_kohonen.n_epochs=1"])
         assert r.returncode == 0, r.stderr[-2000:]
+
+    def test_imagenet_alexnet_sample_synthetic(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/imagenet_alexnet.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.imagenet.minibatch_size=4",
+                  "root.imagenet.steps_per_epoch=2",
+                  "root.imagenet.max_epochs=1",
+                  "root.imagenet.n_classes=10",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
+
+    def test_imagenet_alexnet_sample_from_directory(self, tmp_path):
+        from PIL import Image
+        import numpy as np
+        rs = np.random.RandomState(0)
+        for cls in ("n01", "n02"):
+            d = tmp_path / "train" / cls
+            d.mkdir(parents=True)
+            for j in range(3):
+                Image.fromarray(
+                    rs.randint(0, 255, (32, 48, 3), np.uint8)).save(
+                        str(d / ("img%d.jpg" % j)))
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/imagenet_alexnet.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list",
+                  "root.imagenet.data_dir='%s'" % (tmp_path / "train"),
+                  "root.imagenet.minibatch_size=4",
+                  "root.imagenet.steps_per_epoch=2",
+                  "root.imagenet.max_epochs=1",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
 
     def test_conv_sample(self, tmp_path):
         out = str(tmp_path / "res.json")

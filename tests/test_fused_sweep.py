@@ -36,7 +36,9 @@ def _run(steps_per_dispatch, mesh_config=None, max_epochs=3, seed=42,
     wf.initialize()
     wf.run()
     params = wf.trainer.host_params()
-    stats = wf.trainer.read_class_stats(2)
+    # Decision records each epoch's accumulated stats BEFORE resetting
+    # them — the meaningful place to catch dropped/double-counted steps
+    stats = wf.decision.epoch_metrics[2]
     return wf.decision.best_metric, params, stats
 
 
@@ -44,7 +46,7 @@ class TestFusedSweep:
     def test_matches_per_step_path(self):
         m1, p1, s1 = _run(1)
         m4, p4, s4 = _run(4)
-        assert s1["count"] == s4["count"]
+        assert s1["count"] == s4["count"] > 0
         assert m1 == pytest.approx(m4, abs=1e-6)
         for name in p1:
             for k in p1[name]:
@@ -67,7 +69,7 @@ class TestFusedSweep:
         mc = MeshConfig(make_mesh({"data": 4}, jax.devices()[:4]))
         m1, p1, s1 = _run(1, mesh_config=mc)
         mk, pk, sk = _run(4, mesh_config=mc)
-        assert s1["count"] == sk["count"]
+        assert s1["count"] == sk["count"] > 0
         assert m1 == pytest.approx(mk, abs=1e-6)
         for name in p1:
             for k in p1[name]:

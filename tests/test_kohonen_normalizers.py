@@ -124,6 +124,29 @@ class TestKohonen:
                             minibatch_size=64, steps=3)
         assert res["ms_per_step"] > 0 and res["scan_ms_per_step"] > 0
         assert res["quantization_error"] > 0
+        # the fused sweep is the same math: identical final map
+        assert (res["sweep_quantization_error"]
+                == pytest.approx(res["quantization_error"], rel=1e-5))
+
+    def test_fused_dispatch_matches_per_step(self):
+        """steps_per_dispatch: the indexed sweep must produce the same
+        map as per-step dispatch (same ops, same order)."""
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+
+        def train(k):
+            prng.seed_all(7)
+            loader = FullBatchLoader(None, data=x, minibatch_size=100,
+                                     class_lengths=[0, 0, len(x)])
+            wf = KohonenWorkflow(loader=loader, sx=5, sy=5, n_epochs=4,
+                                 steps_per_dispatch=k, name="som-k%d" % k)
+            wf.initialize()
+            wf.run()
+            assert not wf.trainer._pending
+            return wf.trainer.host_weights()
+
+        np.testing.assert_allclose(train(1), train(4), rtol=2e-5,
+                                   atol=2e-6)
 
     def test_som_reproducible(self):
         d = load_digits()

@@ -109,6 +109,13 @@ class Main(object):
         p.add_argument("--event-log", default=None, metavar="PATH",
                        help="append structured trace events as JSONL "
                        "(ref the Mongo event timeline, logger.py:264-289)")
+        p.add_argument("--steps-per-dispatch", type=int, default=None,
+                       metavar="K",
+                       help="fuse K minibatch steps into one device "
+                       "dispatch (lax.scan inside the jitted sweep) — "
+                       "amortizes host-to-device dispatch latency for "
+                       "small models and remote TPUs; numerically "
+                       "identical to per-step execution")
         p.add_argument("--sync-run", action="store_true",
                        help="block on the device after every trainer step "
                        "for honest per-unit timing (ref --sync-run, "
@@ -138,6 +145,8 @@ class Main(object):
             events.open_sink(args.event_log)
         if args.sync_run:
             root.common.engine.sync_run = True
+        if args.steps_per_dispatch is not None:
+            root.common.engine.steps_per_dispatch = args.steps_per_dispatch
 
         if args.optimize:
             return self._run_optimize(args)

@@ -91,6 +91,22 @@ def som_sweep(weights, coords, xs, valids, lr, radius):
     return jax.lax.scan(body, weights, (xs, valids))[0]
 
 
+def som_sweep_indexed(weights, coords, data, idxs, valids, lr, radius):
+    """Fused k-step sweep gathering each minibatch from the HBM-resident
+    dataset by a [k, B] index matrix — the KohonenTrainer hot path under
+    steps_per_dispatch > 1 (one host→device round trip per k steps)."""
+
+    def body(w, inp):
+        idx, v = inp
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        x = FullBatchLoader.gather(data, idx)      # pad-index safe
+        w, _ = som_batch_step(w, coords, x.reshape(idx.shape[0], -1),
+                              v, lr, radius)
+        return w, None
+
+    return jax.lax.scan(body, weights, (idxs, valids))[0]
+
+
 def benchmark_som(n_samples=1024, n_features=64, sx=8, sy=8,
                   minibatch_size=128, steps=20, seed=0):
     """Timing comparison of the per-sample scan (online) vs batched SOM
@@ -158,7 +174,8 @@ class KohonenTrainer(Unit):
 
     def __init__(self, workflow, sx=8, sy=8, n_epochs=20,
                  learning_rate=0.5, final_learning_rate=0.01,
-                 radius=None, final_radius=1.0, algorithm="batch", **kwargs):
+                 radius=None, final_radius=1.0, algorithm="batch",
+                 steps_per_dispatch=None, **kwargs):
         super(KohonenTrainer, self).__init__(workflow, **kwargs)
         if algorithm not in ("batch", "online"):
             raise ValueError("algorithm must be 'batch' or 'online'")
@@ -166,6 +183,18 @@ class KohonenTrainer(Unit):
         #: formulation); 'online' = per-sample lax.scan (exact reference
         #: online-SOM semantics, much slower)
         self.algorithm = algorithm
+        if steps_per_dispatch is None:
+            from veles_tpu.config import root
+            steps_per_dispatch = root.common.engine.get(
+                "steps_per_dispatch", 1)
+        #: fuse k minibatch updates into one dispatch (batch algorithm
+        #: only — the online scan is already one dispatch per minibatch
+        #: and its whole point is exact per-sample sequencing)
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        self._pending = []          # queued (idx, valid) host rows
+        self._pending_sched = None  # (lr, radius) the queue was built at
         self.sx, self.sy = sx, sy
         self.n_neurons = sx * sy
         self.n_epochs = n_epochs
@@ -189,6 +218,10 @@ class KohonenTrainer(Unit):
         self._coords = grid_coords(self.sx, self.sy)
         self._step = jax.jit(som_batch_step if self.algorithm == "batch"
                              else som_minibatch_step)
+        self._sweep = (jax.jit(som_sweep_indexed)
+                       if (self.algorithm == "batch"
+                           and self.steps_per_dispatch > 1) else None)
+        self._data_flat = None
         self._winners = jax.jit(winners)
 
     def _schedule(self):
@@ -201,27 +234,64 @@ class KohonenTrainer(Unit):
         loader = self.loader
         if loader.minibatch_class != TRAIN:
             return
+        sched = self._schedule()
+        if self._sweep is not None:
+            # queued fused dispatch; the schedule is constant within an
+            # epoch, so a mid-queue change (new epoch) forces a flush
+            if self._pending and self._pending_sched != sched:
+                self.flush()
+            self._pending_sched = sched
+            self._pending.append((
+                np.array(loader.minibatch_indices),
+                np.array(loader.minibatch_valid, np.float32)))
+            if len(self._pending) >= self.steps_per_dispatch:
+                self.flush()
+            return
         x = FullBatchLoader.gather(
             loader.data, jnp.asarray(loader.minibatch_indices))
         x = x.reshape(x.shape[0], -1)
         valid = jnp.asarray(loader.minibatch_valid)
-        lr, radius = self._schedule()
+        lr, radius = sched
         self.weights, _ = self._step(self.weights, self._coords, x, valid,
                                      lr, radius)
+
+    def flush(self):
+        """Dispatch queued minibatches (steps_per_dispatch > 1): full and
+        partial groups both ride the indexed sweep — scan length varies
+        only on ragged tails, so at most two compiled variants exist."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        lr, radius = self._pending_sched
+        self._pending_sched = None
+        if self._data_flat is None:
+            self._data_flat = jnp.asarray(self.loader.data).reshape(
+                self.loader.data.shape[0], -1)
+        k = self.steps_per_dispatch
+        for i in range(0, len(pending), k):
+            group = pending[i:i + k]
+            idxs = jnp.asarray(np.stack([g[0] for g in group]))
+            valids = jnp.asarray(np.stack([g[1] for g in group]))
+            self.weights = self._sweep(self.weights, self._coords,
+                                       self._data_flat, idxs, valids,
+                                       lr, radius)
 
     # -- inspection / serving -------------------------------------------------
     def assign(self, x):
         """Winner neuron index for each sample (KohonenForward)."""
+        self.flush()
         return self._winners(self.weights, jnp.asarray(
             x.reshape(len(x), -1)))
 
     def quantization_error(self, x):
+        self.flush()
         x = jnp.asarray(x.reshape(len(x), -1))
         win = self._winners(self.weights, x)
         return float(jnp.mean(jnp.linalg.norm(x - self.weights[win],
                                               axis=1)))
 
     def host_weights(self):
+        self.flush()
         return np.asarray(self.weights).reshape(self.sy, self.sx, -1)
 
     def get_metric_values(self):
@@ -329,7 +399,8 @@ class KohonenWorkflow(Workflow):
                                          if k in ("learning_rate", "radius",
                                                   "final_learning_rate",
                                                   "final_radius",
-                                                  "algorithm")})
+                                                  "algorithm",
+                                                  "steps_per_dispatch")})
         self.trainer.loader = loader
         self.decision = KohonenDecision(self, n_epochs=n_epochs)
         self.decision.loader = loader

@@ -26,7 +26,8 @@ class StandardWorkflow(Workflow):
     def __init__(self, workflow=None, layers=None, loader=None,
                  loss="softmax", decision_config=None, snapshotter_config=None,
                  gd_defaults=None, mesh_config=None, lr_adjuster_config=None,
-                 dataset_placement="shard", steps_per_dispatch=1, **kwargs):
+                 dataset_placement="shard", steps_per_dispatch=None,
+                 **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -41,6 +42,12 @@ class StandardWorkflow(Workflow):
             # the trainer will row-shard the dataset over the data axis;
             # a single-device replica must never be materialized first
             self.loader.on_device = "defer"
+        if steps_per_dispatch is None:
+            # workflow files usually leave this to the CLI / config layer
+            # (--steps-per-dispatch → root.common.engine.steps_per_dispatch)
+            from veles_tpu.config import root
+            steps_per_dispatch = root.common.engine.get(
+                "steps_per_dispatch", 1)
         self.trainer = StagedTrainer(self, [make_layer(c) for c in layers],
                                      loss=loss, gd_defaults=gd_defaults,
                                      mesh_config=mesh_config,
