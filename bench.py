@@ -252,16 +252,15 @@ def phase_flash():
     if err > 5e-3:
         raise AssertionError("flash kernel mismatch: max_err=%g" % err)
 
-    def timed(*args):
-        _block(f(*args))
-        iters = 20
+    def timed(fn, *args, iters=20):
+        _block(fn(*args))
         t0 = time.perf_counter()
         for _ in range(iters):
-            o = f(*args)
+            o = fn(*args)
         _block(o)
         return (time.perf_counter() - t0) / iters * 1e3
 
-    ms = timed(q, k, v)
+    ms = timed(f, q, k, v)
     # the mixed-precision path: bf16 MXU multiplies, f32 accumulation —
     # correctness-gated on hardware like the f32 path
     q16, k16, v16 = (x.astype(jnp.bfloat16) for x in (q, k, v))
@@ -269,11 +268,25 @@ def phase_flash():
         f(q16, k16, v16).astype(jnp.float32) - ref)))
     if err16 > 0.05:
         raise AssertionError("bf16 flash mismatch: max_err=%g" % err16)
-    ms16 = timed(q16, k16, v16)
+    ms16 = timed(f, q16, k16, v16)
+
+    # fused Pallas backward (dQ + dK/dV kernels) on hardware,
+    # correctness-gated against the naive reference gradient
+    loss_flash = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))
+    loss_ref = jax.grad(lambda q, k, v: jnp.sum(
+        attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))
+    gf = loss_flash(q, k, v)
+    gr = loss_ref(q, k, v)
+    bwd_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gf, gr))
+    if bwd_err > 5e-2:
+        raise AssertionError("fused backward mismatch: %g" % bwd_err)
+    ms_bwd = timed(loss_flash, q, k, v, iters=10)
     _log("pallas flash (4,8,1024,128) causal on %s: %.2f ms f32, "
-         "%.2f ms bf16, max_err %.2e" % (platform, ms, ms16, err))
-    return {"ms": ms, "ms_bf16": ms16, "max_err": err,
-            "platform": platform}
+         "%.2f ms bf16, bwd %.2f ms (err %.2e), max_err %.2e"
+         % (platform, ms, ms16, ms_bwd, bwd_err, err))
+    return {"ms": ms, "ms_bf16": ms16, "ms_bwd": ms_bwd,
+            "bwd_max_err": bwd_err, "max_err": err, "platform": platform}
 
 
 def phase_ring():

@@ -123,13 +123,13 @@ def finalize_attention(carry):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+                    block_k=128, interpret=None, backward="fused"):
     """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D]."""
     from veles_tpu.ops.pallas import flash
     return flash.flash_attention(q, k, v, causal=causal,
                                  scale=_scale(q.shape[-1], scale),
                                  block_q=block_q, block_k=block_k,
-                                 interpret=interpret)
+                                 interpret=interpret, backward=backward)
 
 
 # ---------------------------------------------------------------------------

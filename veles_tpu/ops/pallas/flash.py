@@ -1,14 +1,20 @@
 """Flash attention as a Pallas TPU kernel.
 
 Online-softmax tiling (Dao et al.) mapped to the TPU memory hierarchy:
-grid = (batch·heads, q-blocks, k-blocks) executed sequentially with the
-k dimension innermost; the f32 accumulator and the running (max, sum)
-statistics live in VMEM scratch that persists across the inner k sweep,
-so each q tile streams every k/v tile through VMEM exactly once —
-O(T·block) VMEM instead of the O(T²) score matrix.  Matmuls hit the MXU
-with f32 accumulation (``preferred_element_type``); causal q-blocks that
-are entirely above the diagonal are skipped (``@pl.when``), halving the
-work for autoregressive models."""
+grid = (batch·heads, q-blocks, k-blocks) executed with the k dimension
+innermost; the f32 accumulator and the running (max, sum) statistics
+live in VMEM scratch that persists across the inner k sweep, so each q
+tile streams every k/v tile through VMEM exactly once — O(T·block) VMEM
+instead of the O(T²) score matrix.  Matmuls hit the MXU with f32
+accumulation (``preferred_element_type``); causal blocks entirely
+off-diagonal are skipped (``@pl.when``), halving the work for
+autoregressive models.
+
+The backward pass is fused too (FlashAttention-2): the forward saves
+one log-sum-exp residual per q row, and two Pallas kernels produce dQ
+(k innermost) and dK/dV (q innermost) from it — no T² matrix in either
+direction.  ``backward="recompute"`` keeps the differentiate-through-
+blockwise path as a cross-check oracle."""
 
 import functools
 
@@ -23,7 +29,27 @@ NEG_INF = -1e30
 _LANES = 128          # m/l scratch padded to a full lane tile
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
+def _masked_scores(x_ref, y_ref, row_start, col_start, scale, causal, tk,
+                   rows_are_q):
+    """Scaled score tile xyᵀ with its padding+causal validity mask —
+    shared by the forward and both backward kernels so the three can
+    never desynchronize.  ``rows_are_q``: rows index queries and columns
+    keys (forward / dQ); False = the transposed dK/dV layout.  Dot
+    inputs keep their storage dtype (bf16 rides the MXU at full rate);
+    preferred_element_type pins f32 accumulation."""
+    s = jax.lax.dot_general(
+        x_ref[0], y_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    rows = row_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = col_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    k_idx, q_idx = (cols, rows) if rows_are_q else (rows, cols)
+    valid = k_idx < tk                  # key padding
+    if causal:
+        valid = valid & (q_idx >= k_idx)
+    return s, valid
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
             *, scale, causal, block_q, block_k, nk, tk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -39,22 +65,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
 
     @pl.when(diag_ok)
     def _():
-        # dot inputs keep their storage dtype (bf16 rides the MXU at
-        # full rate); preferred_element_type pins f32 ACCUMULATION —
-        # the standard flash-attention mixed-precision recipe
-        q = q_ref[0]
-        k = k_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-
-        cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = cols < tk                      # key padding
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = valid & (rows >= cols)
+        s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
+                                  ki * block_k, scale, causal, tk,
+                                  rows_are_q=True)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m[:, :1]
@@ -74,8 +87,93 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l,
 
     @pl.when(ki == nk - 1)
     def _():
-        out = acc[:] / jnp.maximum(l[:, :1], 1e-30)
+        lsum = jnp.maximum(l[:, :1], 1e-30)
+        out = acc[:] / lsum
         o_ref[0] = out.astype(o_ref.dtype)
+        # log-sum-exp of the scaled scores per q row — the only residual
+        # the fused backward needs (p = exp(s - lse) reconstructs exactly)
+        lse_ref[0] = (m[:, 0] + jnp.log(lsum[:, 0]))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   nk, tk):
+    """dQ: grid (bh, q-blocks, k-blocks), k innermost; dq accumulates in
+    f32 VMEM scratch across the k sweep.
+        p  = exp(s - lse);  dp = dO·Vᵀ;  ds = p⊙(dp - Δ)·scale
+        dq += ds·K
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    diag_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_ok)
+    def _():
+        s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
+                                  ki * block_k, scale, causal, tk,
+                                  rows_are_q=True)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        k = k_ref[0]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, nq, tk):
+    """dK, dV: grid (bh, k-blocks, q-blocks), q innermost; both
+    accumulators live in f32 VMEM scratch across the q sweep.
+        pᵀ  = exp(sᵀ - lse);     dv += pᵀ·dO
+        dpᵀ = V·dOᵀ;  dsᵀ = pᵀ⊙(dpᵀ - Δ)·scale;  dk += dsᵀ·Q
+    Padded q rows contribute nothing (their dO and Δ are zero)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks entirely above this k block see none of it
+    diag_ok = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+
+    @pl.when(diag_ok)
+    def _():
+        st, valid = _masked_scores(k_ref, q_ref, ki * block_k,
+                                   qi * block_q, scale, causal, tk,
+                                   rows_are_q=False)          # [bk, bq]
+        pt = jnp.where(valid, jnp.exp(st - lse_ref[0][None, :]), 0.0)
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(
+            v_ref[0], do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bk, bq]
+        dst = pt * (dpt - delta_ref[0][None, :]) * scale
+        q = q_ref[0]
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _pad_to(x, axis, mult):
@@ -89,16 +187,16 @@ def _pad_to(x, axis, mult):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+                    block_k=128, interpret=None, backward="fused"):
     """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
     default as every entry point in ops.attention).
 
-    Differentiable: the forward pass is the Pallas kernel; the backward
-    pass recomputes attention with the pure-jnp online-softmax
-    (ops.attention.blockwise_attention) and differentiates through it —
-    exact gradients without materializing the T² score matrix.  (A fused
-    Pallas backward kernel is a further optimization, not a correctness
-    requirement.)"""
+    Differentiable both ways: ``backward="fused"`` (default) runs the
+    Pallas dQ and dK/dV kernels against the forward's saved log-sum-exp
+    residual (the FlashAttention-2 recipe — no T² matrix, two extra
+    passes over K/V); ``backward="recompute"`` differentiates through the
+    pure-jnp online-softmax (ops.attention.blockwise_attention) instead —
+    slower, kept as the cross-check oracle for the kernel tests."""
     if causal and q.shape[-2] != k.shape[-2]:
         raise ValueError("causal flash kernel assumes tq == tk")
     if not (q.dtype == k.dtype == v.dtype):
@@ -107,25 +205,34 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         raise ValueError(
             "flash_attention needs matching q/k/v dtypes, got %s/%s/%s"
             % (q.dtype, k.dtype, v.dtype))
+    if backward not in ("fused", "recompute"):
+        raise ValueError("backward must be 'fused' or 'recompute'")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash_fn(causal, float(scale), block_q, block_k,
-                     autodetect_interpret(interpret))(q, k, v)
+                     autodetect_interpret(interpret), backward)(q, k, v)
 
 
 @functools.lru_cache(maxsize=None)
-def _flash_fn(causal, scale, block_q, block_k, interpret):
+def _flash_fn(causal, scale, block_q, block_k, interpret, backward):
     from veles_tpu.ops import attention as att
 
     @jax.custom_vjp
     def f(q, k, v):
-        return _forward(q, k, v, causal, scale, block_q, block_k, interpret)
+        out, _ = _forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+        return out
 
     def fwd(q, k, v):
-        return f(q, k, v), (q, k, v)
+        out, lse = _forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+        return out, (q, k, v, out, lse)
 
     def bwd(res, g):
-        q, k, v = res
+        q, k, v, out, lse = res
+        if backward == "fused":
+            return _backward(q, k, v, out, lse, g, causal, scale,
+                             block_q, block_k, interpret)
         _, vjp = jax.vjp(
             lambda q_, k_, v_: att.blockwise_attention(
                 q_, k_, v_, causal=causal, scale=scale), q, k, v)
@@ -135,7 +242,7 @@ def _flash_fn(causal, scale, block_q, block_k, interpret):
     return jax.jit(f)
 
 
-def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _blocks(q, k, v, block_q, block_k):
     b, h, tq, d = q.shape
     tk = k.shape[-2]
     block_q = min(block_q, max(tq, 8))
@@ -143,14 +250,28 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
     qp = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
     kp = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
     vp = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
-    nq = qp.shape[1] // block_q
-    nk = kp.shape[1] // block_k
+    return (qp, kp, vp, block_q, block_k,
+            qp.shape[1] // block_q, kp.shape[1] // block_k)
+
+
+#: bh and q/k-blocks carry no cross-iteration state (scratch resets at
+#: inner index 0) — declaring them parallel lets Mosaic re-order /
+#: parallelize them; only the innermost sweep is a sequential reduction
+_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[-2]
+    qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, block_q,
+                                                   block_k)
 
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk, tk=tk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -158,14 +279,74 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :tq].reshape(b, h, tq, d)
+    return out[:, :tq].reshape(b, h, tq, d), lse
+
+
+def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+              interpret):
+    """FlashAttention-2 backward: Δ = rowsum(dO⊙O) in plain XLA (one
+    fused elementwise+reduce), then the dQ kernel (k innermost) and the
+    dK/dV kernel (q innermost).  Gradients come back in the inputs'
+    dtype; all accumulation is f32."""
+    b, h, tq, d = q.shape
+    tk = k.shape[-2]
+    qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, block_q,
+                                                   block_k)
+    dop = _pad_to(g.reshape(b * h, tq, d).astype(q.dtype), 1, block_q)
+    delta = jnp.sum(dop.astype(jnp.float32)
+                    * _pad_to(out.reshape(b * h, tq, d), 1,
+                              block_q).astype(jnp.float32), axis=-1)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, a, 0))
+    r_spec = pl.BlockSpec((1, block_q), lambda bh, a, i: (bh, a))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk, tk=tk),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    # q innermost: swap the roles of the two block axes in the specs
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, i, 0))
+    r_spec2 = pl.BlockSpec((1, block_q), lambda bh, a, i: (bh, i))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, a, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq, tk=tk),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    return (dq[:, :tq].reshape(b, h, tq, d),
+            dk[:, :tk].reshape(b, h, tk, d),
+            dv[:, :tk].reshape(b, h, tk, d))
