@@ -170,3 +170,17 @@ class TestParamSharding:
         # router replicates
         router = eplaced[moe_layer.name]["router"]
         assert router.addressable_shards[0].data.shape == router.shape
+
+
+def test_pipelined_transformer_propagates_gqa():
+    """n_kv_heads must reach the inner TransformerBlock (not be dropped)."""
+    from veles_tpu import prng
+    from veles_tpu.models.layers import make_layer
+
+    prng.seed_all(3)
+    layer = make_layer({"type": "pipelined_transformer", "n_blocks": 2,
+                        "n_heads": 4, "n_kv_heads": 2})
+    layer.setup((8, 16))
+    params = layer.init_params(prng.get("t"))
+    wk = params["stages"]["mha"]["wk"]       # [n_blocks, d_model, d_kv]
+    assert wk.shape == (2, 16, 8), wk.shape  # 2 kv heads of dim 4

@@ -489,6 +489,7 @@ class MultiHeadAttention(Layer):
     def _infer(self, input_shape):
         t, f = input_shape
         self.n_heads = int(self.cfg.get("n_heads", 8))
+        self.n_kv_heads = int(self.cfg.get("n_kv_heads", self.n_heads))
         if f % self.n_heads:
             raise ValueError("d_model %d %% n_heads %d != 0"
                              % (f, self.n_heads))
@@ -497,7 +498,8 @@ class MultiHeadAttention(Layer):
     def init_params(self, rng):
         from veles_tpu.ops import attention
         return attention.mha_init(rng, self.input_shape[-1], self.n_heads,
-                                  self.policy.param)
+                                  self.policy.param,
+                                  n_kv_heads=self.n_kv_heads)
 
     def apply(self, params, x, train=False, key=None):
         from veles_tpu.ops import attention
@@ -505,7 +507,8 @@ class MultiHeadAttention(Layer):
             params, x, self.n_heads,
             causal=bool(self.cfg.get("causal", False)),
             impl=self.cfg.get("impl", "blockwise"),
-            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy)
+            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
+            n_kv_heads=self.n_kv_heads)
 
 
 class MoE(Layer):
@@ -572,6 +575,7 @@ class TransformerBlock(Layer):
     def _infer(self, input_shape):
         t, f = input_shape
         self.n_heads = int(self.cfg.get("n_heads", 8))
+        self.n_kv_heads = int(self.cfg.get("n_kv_heads", self.n_heads))
         self.d_ff = int(self.cfg.get("d_ff", 4 * f))
         self.n_experts = int(self.cfg.get("n_experts", 0))
         self.last_aux = None
@@ -599,7 +603,8 @@ class TransformerBlock(Layer):
         params = {
             "ln1": norm.layer_norm_init((f,)),
             "mha": attention.mha_init(rng, f, self.n_heads,
-                                      self.policy.param),
+                                      self.policy.param,
+                                      n_kv_heads=self.n_kv_heads),
             "ln2": norm.layer_norm_init((f,)),
         }
         if self.n_experts:
@@ -627,7 +632,8 @@ class TransformerBlock(Layer):
             params["mha"], h, self.n_heads,
             causal=bool(self.cfg.get("causal", False)),
             impl=self.cfg.get("impl", "blockwise"),
-            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy)
+            attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
+            n_kv_heads=self.n_kv_heads)
         if k1 is not None:
             h = dropout.forward(h, k1, ratio)
         x = x + h
@@ -665,10 +671,15 @@ class PipelinedTransformer(Layer):
         self.n_microbatches = int(self.cfg.get("n_microbatches", 4))
         block_cfg = {"type": "transformer_block",
                      "n_heads": self.cfg.get("n_heads", 8),
+                     "n_kv_heads": self.cfg.get(
+                         "n_kv_heads", self.cfg.get("n_heads", 8)),
                      "d_ff": self.cfg.get("d_ff", 4 * f),
                      "causal": self.cfg.get("causal", False),
                      "impl": self.cfg.get("impl", "blockwise"),
                      "dropout_ratio": 0.0}
+        # per-stage remat rides the whole pipelined layer: set
+        # {"remat": true} on THIS layer and the trainer checkpoints the
+        # full stage scan (stages recompute during the backward sweep)
         self._block = TransformerBlock(block_cfg)
         self._block.setup(input_shape)
         return (t, f)

@@ -152,7 +152,24 @@ class StagedTrainer(Unit):
         for i, layer in enumerate(self.layers):
             lkey = (jax.random.fold_in(key, i)
                     if (train and layer.needs_rng) else None)
-            x = layer.apply(params.get(layer.name), x, train=train, key=lkey)
+            if train and layer.cfg.get("remat"):
+                # rematerialize this layer's activations in the backward
+                # pass (jax.checkpoint) — memory for FLOPs, the standard
+                # long-context trade.  Aux values (MoE router loss) must
+                # cross the remat boundary as outputs, not side effects.
+                def fn(p, xx, kk, layer=layer):
+                    y = layer.apply(p, xx, train=True, key=kk)
+                    return y, getattr(layer, "last_aux", None)
+                # prevent_cse=False: we are always under jit (and often
+                # inside the fused sweep's lax.scan), where the CSE
+                # barriers the default inserts only cost fusion
+                x, aux = jax.checkpoint(fn, prevent_cse=False)(
+                    params.get(layer.name), x, lkey)
+                if aux is not None:
+                    layer.last_aux = aux
+            else:
+                x = layer.apply(params.get(layer.name), x, train=train,
+                                key=lkey)
         return x
 
     def _loss_and_stats(self, params, data, labels, targets, idx, valid,

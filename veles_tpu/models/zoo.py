@@ -79,7 +79,7 @@ def alexnet(n_classes=1000, lr=0.01, moment=0.9, wd=5e-4):
 def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
                            d_ff=None, lr=0.001, moment=0.9, causal=False,
                            dropout=0.1, impl="blockwise", solver="adam",
-                           n_experts=0):
+                           n_experts=0, n_kv_heads=None, remat=False):
     """Transformer encoder classifier over [T, F] sequence samples — new
     capability beyond the reference (its RNN/LSTM support was 'in
     progress', manualrst_veles_algorithms.rst:105-112; attention postdates
@@ -92,9 +92,11 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
     for _ in range(n_layers):
         layers.append(dict({"type": "transformer_block",
                             "n_heads": n_heads,
+                            "n_kv_heads": n_kv_heads or n_heads,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": causal, "dropout_ratio": dropout,
-                            "impl": impl, "n_experts": n_experts}, **gd))
+                            "impl": impl, "n_experts": n_experts,
+                            "remat": remat}, **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append({"type": "seq_pool", "mode": "mean"})
     layers.append(dict({"type": "softmax", "output_sample_shape": n_classes},
@@ -104,8 +106,12 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
 
 def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
-                   impl="blockwise", solver="adam", n_experts=0):
-    """Decoder-only causal LM over int token samples [T]."""
+                   impl="blockwise", solver="adam", n_experts=0,
+                   n_kv_heads=None, remat=False):
+    """Decoder-only causal LM over int token samples [T].
+    ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
+    rematerializes each block's activations in the backward pass
+    (jax.checkpoint — long-context memory for FLOPs)."""
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
     layers = [dict({"type": "embedding", "vocab_size": vocab_size,
                     "d_model": d_model}, **gd),
@@ -113,9 +119,11 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
     for _ in range(n_layers):
         layers.append(dict({"type": "transformer_block",
                             "n_heads": n_heads,
+                            "n_kv_heads": n_kv_heads or n_heads,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": True, "dropout_ratio": dropout,
-                            "impl": impl, "n_experts": n_experts}, **gd))
+                            "impl": impl, "n_experts": n_experts,
+                            "remat": remat}, **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append(dict({"type": "timestep_dense",
                         "output_sample_shape": vocab_size}, **gd))

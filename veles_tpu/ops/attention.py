@@ -135,17 +135,30 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
 # ---------------------------------------------------------------------------
 # multi-head attention layer math
 
-def mha_init(rng, d_model, n_heads, dtype=jnp.float32):
+def mha_init(rng, d_model, n_heads, dtype=jnp.float32, n_kv_heads=None):
     """QKV + output projection params.  ``rng`` is the framework PRNG
-    (veles_tpu.prng RandomGenerator) for reproducibility."""
+    (veles_tpu.prng RandomGenerator) for reproducibility.
+
+    ``n_kv_heads < n_heads`` = grouped-query attention (GQA): k/v project
+    to fewer heads, each shared by ``n_heads // n_kv_heads`` query heads —
+    smaller k/v projections (params + FLOPs) and a smaller KV state at
+    serve time.  (During training the forward broadcasts k/v back to
+    n_heads before the attention core, so peak activation memory there
+    matches full MHA.)"""
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    if n_heads % n_kv_heads:
+        raise ValueError("n_heads %d %% n_kv_heads %d != 0"
+                         % (n_heads, n_kv_heads))
+    d_kv = (d_model // n_heads) * n_kv_heads
     std = 1.0 / math.sqrt(d_model)
     def w(shape):
         return jnp.asarray(rng.normal(0.0, std, shape), dtype)
     return {
-        "wq": w((d_model, d_model)), "wk": w((d_model, d_model)),
-        "wv": w((d_model, d_model)), "wo": w((d_model, d_model)),
-        "bq": jnp.zeros((d_model,), dtype), "bk": jnp.zeros((d_model,), dtype),
-        "bv": jnp.zeros((d_model,), dtype), "bo": jnp.zeros((d_model,), dtype),
+        "wq": w((d_model, d_model)), "wk": w((d_model, d_kv)),
+        "wv": w((d_model, d_kv)), "wo": w((d_model, d_model)),
+        "bq": jnp.zeros((d_model,), dtype), "bk": jnp.zeros((d_kv,), dtype),
+        "bv": jnp.zeros((d_kv,), dtype), "bo": jnp.zeros((d_model,), dtype),
     }
 
 
@@ -167,20 +180,28 @@ def _proj(x, w, b, policy):
 
 
 def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
-                attn_fn=None, policy=None):
+                attn_fn=None, policy=None, n_kv_heads=None):
     """x: [B, T, d_model] → [B, T, d_model].
 
     ``attn_fn(q, k, v, causal)`` overrides the core attention — this is the
     hook ring/Ulysses sequence parallelism plugs into (parallel.ring).
     ``policy`` (ops.policy.Policy) casts the projection matmuls and the
-    attention inputs to the compute dtype (bf16 on the MXU)."""
+    attention inputs to the compute dtype (bf16 on the MXU).
+    ``n_kv_heads`` enables GQA: k/v heads broadcast to the query heads
+    before the core attention (same kernels, smaller projections)."""
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
     cast = (lambda t: t) if policy is None else policy.cast_in
     q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
                     n_heads)
     k = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
-                    n_heads)
+                    n_kv_heads)
     v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
-                    n_heads)
+                    n_kv_heads)
+    if n_kv_heads != n_heads:
+        rep = n_heads // n_kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     if attn_fn is None:
         if impl == "naive":
             attn_fn = attention
