@@ -86,6 +86,51 @@ class TestRESTful:
             assert e.code == 400
             assert "error" in json.loads(e.read())
 
+    def test_generate_without_generator_is_an_error(self, served_model):
+        api, _, _ = served_model
+        try:
+            _post("http://127.0.0.1:%d/service" % api.port,
+                  {"input": [[1, 2, 3]], "generate": {"max_new": 2}})
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_generate_endpoint_serves_lm(self):
+        from veles_tpu.models import zoo
+        from veles_tpu.models.generate import LMGenerator
+
+        prng.seed_all(23)
+        r = np.random.RandomState(3)
+        n, t, vocab = 128, 12, 11
+        toks = ((np.arange(t)[None, :] + r.randint(0, 3, n)[:, None])
+                % vocab).astype(np.int32)
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=32,
+                                 class_lengths=[0, 32, 96])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=16,
+                                      n_heads=2, n_layers=1, lr=5e-3,
+                                      dropout=0.0),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 8}, name="rest-lm")
+        wf.initialize()
+        wf.run()
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda xx: np.asarray(fwd(params, xx)), (t,),
+                         port=0,
+                         generator=LMGenerator(wf.trainer, max_len=t))
+        api.start()
+        try:
+            out = _post("http://127.0.0.1:%d/service" % api.port,
+                        {"input": toks[0, :6].tolist(),
+                         "generate": {"max_new": 4}})
+            res = np.asarray(out["result"])
+            assert res.shape == (1, 10)
+            np.testing.assert_array_equal(res[0, :6], toks[0, :6])
+        finally:
+            api.stop()
+
 
 class TestWebStatus:
     def test_dashboard_and_apis(self):

@@ -526,8 +526,19 @@ class Main(object):
         from veles_tpu.services.restful import RESTfulAPI
         fwd = wf.forward_fn()
         params = wf.trainer.params
+        generator = None
+        if any(layer.type == "transformer_block" and
+               layer.cfg.get("causal") for layer in wf.trainer.layers):
+            try:
+                from veles_tpu.models.generate import LMGenerator
+                max_len = wf.trainer.layers[0].input_shape[0] \
+                    if wf.trainer.layers[0].input_shape else 0
+                generator = LMGenerator(wf.trainer, max_len=max_len)
+            except ValueError:
+                generator = None    # not a generate-shaped stack
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
-                         wf.trainer.layers[0].input_shape, port=port)
+                         wf.trainer.layers[0].input_shape, port=port,
+                         generator=generator)
         api.start()
         print("REST serving on port %d; Ctrl-C to stop" % api.port)
         try:

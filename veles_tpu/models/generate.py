@@ -1,0 +1,163 @@
+"""Autoregressive LM generation with a KV cache (incremental decoding).
+
+Serve-time counterpart of the ``transformer_lm`` zoo stack (embedding →
+positional_encoding → transformer_block* → layer_norm → timestep_dense).
+Each step feeds ONE token through the stack against per-block KV caches
+([B, n_kv_heads, T_max, head_dim] — GQA stores only the kv heads, so its
+smaller KV state is realized here), inside a single jitted ``lax.scan``
+over positions: prefill and generation are the same loop, with the
+prompt teacher-forcing the first ``prompt_len`` positions.
+
+The reference served forward passes over REST (restful_api.py:112-217);
+generation is the transformer-era equivalent and beyond-parity."""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.ops import norm
+
+
+class LMGenerator:
+    """Build from a trained ``transformer_lm`` workflow/trainer:
+
+        gen = LMGenerator(wf.trainer, max_len=128)
+        out = gen.generate(prompt_tokens, max_new=32)        # greedy
+        out = gen.generate(prompt, max_new=32, temperature=0.8, seed=1)
+    """
+
+    def __init__(self, trainer, max_len):
+        self.params = trainer.params
+        self.max_len = int(max_len)
+        self._compiled = {}
+        layers = trainer.layers
+        by_type = {}
+        self._blocks = []
+        for layer in layers:
+            if layer.type == "transformer_block":
+                self._blocks.append(layer)
+            else:
+                by_type.setdefault(layer.type, layer)
+        for need in ("embedding", "layer_norm", "timestep_dense"):
+            if need not in by_type:
+                raise ValueError(
+                    "LMGenerator needs a transformer_lm-shaped stack "
+                    "(missing %r; got %s)" % (need,
+                                              [l.type for l in layers]))
+        if not self._blocks:
+            raise ValueError("no transformer_block layers to decode with")
+        self._embed = by_type["embedding"]
+        self._posenc = by_type.get("positional_encoding")
+        self._ln = by_type["layer_norm"]
+        self._head = by_type["timestep_dense"]
+        if self._posenc is not None and self.max_len > \
+                self._posenc.input_shape[0]:
+            raise ValueError(
+                "max_len %d exceeds the position table length %d"
+                % (self.max_len, self._posenc.input_shape[0]))
+        b0 = self._blocks[0]
+        self._head_dim = b0.input_shape[-1] // b0.n_heads
+
+    # ------------------------------------------------------------------
+    def _pos_row(self, params, pos):
+        if self._posenc is None:
+            return 0.0
+        if self._posenc.learned:
+            table = params[self._posenc.name]["pos"]
+        else:
+            table = self._posenc._sinusoid()
+        return jax.lax.dynamic_index_in_dim(table, pos, keepdims=False)
+
+    def _step(self, params, caches, tok, pos):
+        """tok [B] int32 at position ``pos`` → (logits [B, V], caches)."""
+        x = jnp.take(params[self._embed.name]["table"],
+                     tok.astype(jnp.int32), axis=0)[:, None, :]
+        x = x + self._pos_row(params, pos)
+        new_caches = []
+        for layer, (ck, cv) in zip(self._blocks, caches):
+            x, ck, cv = layer.step(params[layer.name], x, ck, cv, pos)
+            new_caches.append((ck, cv))
+        lp = params[self._ln.name]
+        x = norm.layer_norm(x, lp["gamma"], lp["beta"])
+        logits = self._head.apply(params[self._head.name], x)
+        return logits[:, 0].astype(jnp.float32), new_caches
+
+    def _init_caches(self, batch, dtype):
+        return [(jnp.zeros((batch, layer.n_kv_heads, self.max_len,
+                            self._head_dim), dtype),
+                 jnp.zeros((batch, layer.n_kv_heads, self.max_len,
+                            self._head_dim), dtype))
+                for layer in self._blocks]
+
+    def _scan_fn(self, batch, prompt_len, total, greedy):
+        # per-instance cache (NOT lru_cache: a class-level cache keyed on
+        # self would immortalize every generator and its params)
+        key_ = (batch, prompt_len, total, greedy)
+        cached = self._compiled.get(key_)
+        if cached is not None:
+            return cached
+
+        def run(params, tokens, key):
+            caches = self._init_caches(
+                batch, self.params[self._embed.name]["table"].dtype)
+
+            def body(carry, pos):
+                tokens, caches, key = carry
+                logits, caches = self._step(params, caches,
+                                            tokens[:, pos], pos)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(
+                        sub, logits).astype(jnp.int32)
+                keep = pos + 1 < prompt_len       # teacher-force prompt
+                nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, nxt[:, None], (0, pos + 1))
+                return (tokens, caches, key), logits
+
+            (tokens, _, _), logits = jax.lax.scan(
+                body, (tokens, caches, key), jnp.arange(total - 1))
+            return tokens, logits
+
+        self._compiled[key_] = jax.jit(run)
+        return self._compiled[key_]
+
+    # ------------------------------------------------------------------
+    def generate(self, prompt, max_new, temperature=0.0, seed=0):
+        """prompt [B, T0] int tokens → [B, T0 + max_new].  temperature 0
+        = greedy argmax; otherwise softmax sampling at that temperature."""
+        prompt = np.asarray(prompt, np.int32)
+        b, t0 = prompt.shape
+        total = t0 + int(max_new)
+        if total > self.max_len:
+            raise ValueError("prompt + max_new = %d exceeds max_len %d"
+                             % (total, self.max_len))
+        tokens = jnp.asarray(np.concatenate(
+            [prompt, np.zeros((b, int(max_new)), np.int32)], axis=1))
+        greedy = temperature == 0.0
+        key = jax.random.key(seed)
+        params = self.params
+        if not greedy and temperature != 1.0:
+            head = dict(params[self._head.name])
+            head["weights"] = head["weights"] / temperature
+            if "bias" in head:
+                head["bias"] = head["bias"] / temperature
+            params = dict(params, **{self._head.name: head})
+        out, _ = self._scan_fn(b, t0, total, greedy)(params, tokens, key)
+        return np.asarray(out)
+
+    def score(self, tokens):
+        """Per-position next-token logits from the incremental path
+        (teacher forcing) — [B, T-1, V]; the equivalence oracle for the
+        tests and a perplexity scorer."""
+        tokens = jnp.asarray(np.asarray(tokens, np.int32))
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError("sequence %d exceeds max_len %d"
+                             % (t, self.max_len))
+        _, logits = self._scan_fn(b, t, t, True)(
+            self.params, tokens, jax.random.key(0))
+        return np.asarray(logits).transpose(1, 0, 2)

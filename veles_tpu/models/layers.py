@@ -638,18 +638,39 @@ class TransformerBlock(Layer):
             h = dropout.forward(h, k1, ratio)
         x = x + h
         h = norm.layer_norm(x, params["ln2"]["gamma"], params["ln2"]["beta"])
-        if self.n_experts:
-            self._moe.mesh = self.mesh
-            h = self._moe.apply(params["moe"], h, train=train)
-            self.last_aux = self._moe.last_aux
-            self._moe.last_aux = None
-        else:
-            h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
-                            + params["b1"])
-            h = linear.matmul(h, params["w2"], self.policy) + params["b2"]
+        h = self._ffn(params, h, train)
         if k2 is not None:
             h = dropout.forward(h, k2, ratio)
         return x + h
+
+    def _ffn(self, params, h, train):
+        """The post-LN branch, shared by apply() and step() so training
+        and incremental decoding can never diverge.  MoE: the router aux
+        loss lands in self.last_aux only when training."""
+        if self.n_experts:
+            self._moe.mesh = self.mesh
+            h = self._moe.apply(params["moe"], h, train=train)
+            self.last_aux = self._moe.last_aux if train else None
+            self._moe.last_aux = None
+            return h
+        h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
+                        + params["b1"])
+        return linear.matmul(h, params["w2"], self.policy) + params["b2"]
+
+    def step(self, params, x, cache_k, cache_v, pos):
+        """Incremental-decoding step: x [B, 1, F] at position ``pos``
+        against the block's KV cache (models.generate).  Dropout off
+        (serve time); MoE FFN works unchanged on the single position."""
+        from veles_tpu.ops import attention, norm
+        h = norm.layer_norm(x, params["ln1"]["gamma"],
+                            params["ln1"]["beta"])
+        h, cache_k, cache_v = attention.mha_step(
+            params["mha"], h, cache_k, cache_v, pos, self.n_heads,
+            n_kv_heads=self.n_kv_heads, policy=self.policy)
+        x = x + h
+        h = norm.layer_norm(x, params["ln2"]["gamma"],
+                            params["ln2"]["beta"])
+        return x + self._ffn(params, h, train=False), cache_k, cache_v
 
 
 class PipelinedTransformer(Layer):

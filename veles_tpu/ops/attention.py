@@ -211,3 +211,42 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
             attn_fn = blockwise_attention
     o = attn_fn(q, k, v, causal=causal)
     return _proj(merge_heads(o), params["wo"], params["bo"], policy)
+
+
+def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
+             scale=None, policy=None):
+    """One incremental-decoding step with a KV cache.
+
+    x: [B, 1, d_model] (the token at position ``pos``);
+    cache_k/cache_v: [B, n_kv_heads, T_max, head_dim] — the cache stores
+    KV HEADS ONLY, so GQA's smaller KV state is realized here (the query
+    groups attend to the shared kv head without materializing copies).
+    Returns (y [B, 1, d_model], cache_k, cache_v) with position ``pos``
+    written."""
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    cast = (lambda t: t) if policy is None else policy.cast_in
+    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
+                    n_heads)                           # [B, H, 1, hd]
+    k1 = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
+                     n_kv_heads).astype(cache_k.dtype)
+    v1 = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
+                     n_kv_heads).astype(cache_v.dtype)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k1, (0, 0, pos, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v1, (0, 0, pos, 0))
+
+    b, h, _, hd = q.shape
+    g = h // n_kv_heads
+    qg = q.reshape(b, n_kv_heads, g, hd)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k,
+                   preferred_element_type=jnp.float32)
+    s = s * _scale(hd, scale)
+    t_max = cache_k.shape[2]
+    live = jnp.arange(t_max)[None, None, None, :] <= pos
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", p.astype(cache_v.dtype), cache_v,
+                   preferred_element_type=jnp.float32)
+    o = o.reshape(b, 1, h * hd).astype(x.dtype)
+    return (_proj(o, params["wo"], params["bo"], policy),
+            cache_k, cache_v)

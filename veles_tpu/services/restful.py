@@ -18,11 +18,14 @@ from veles_tpu.logger import Logger
 
 class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
-                 path="/service"):
+                 path="/service", generator=None):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
         self.host, self.port, self.path = host, port, path
+        #: models.generate.LMGenerator — enables the ``"generate"``
+        #: request form for causal-LM workflows
+        self.generator = generator
         self._server = None
         self._thread = None
 
@@ -38,8 +41,10 @@ class RESTfulAPI(Logger):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
-                    x = api.decode_input(req)
-                    out = np.asarray(api.forward(x))
+                    if "generate" in req:
+                        out = api.run_generate(req)
+                    else:
+                        out = np.asarray(api.forward(api.decode_input(req)))
                     body = json.dumps({"result": out.tolist()}).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -69,6 +74,23 @@ class RESTfulAPI(Logger):
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+
+    # ---------------------------------------------------------- generation
+    def run_generate(self, req):
+        """``{"input": [[tok, ...]], "generate": {"max_new": N,
+        "temperature": T, "seed": S}}`` → generated token matrix (causal
+        LM serving; needs ``generator=``)."""
+        if self.generator is None:
+            raise ValueError("this endpoint serves a non-LM workflow: "
+                             "no generator is attached")
+        opts = req["generate"] or {}
+        prompt = np.asarray(req["input"], np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        return self.generator.generate(
+            prompt, int(opts.get("max_new", 16)),
+            temperature=float(opts.get("temperature", 0.0)),
+            seed=int(opts.get("seed", 0)))
 
     # ------------------------------------------------------------ decoding
     def decode_input(self, req):
