@@ -15,7 +15,7 @@ from veles_tpu.models.generate import LMGenerator
 from veles_tpu.models.standard_workflow import StandardWorkflow
 
 
-def _lm_workflow(max_epochs=0, n_kv_heads=None, vocab=13, t=16, seed=31):
+def _lm_workflow(max_epochs=0, vocab=13, t=16, seed=31, **zoo_kwargs):
     prng.seed_all(seed)
     r = np.random.RandomState(5)
     n = 192
@@ -27,7 +27,7 @@ def _lm_workflow(max_epochs=0, n_kv_heads=None, vocab=13, t=16, seed=31):
     wf = StandardWorkflow(
         layers=zoo.transformer_lm(vocab_size=vocab, d_model=32, n_heads=4,
                                   n_layers=2, lr=5e-3, dropout=0.0,
-                                  n_kv_heads=n_kv_heads),
+                                  **zoo_kwargs),
         loader=loader, loss="lm",
         decision_config={"max_epochs": max(max_epochs, 1)},
         name="gen-lm")
@@ -37,15 +37,16 @@ def _lm_workflow(max_epochs=0, n_kv_heads=None, vocab=13, t=16, seed=31):
     return wf, toks
 
 
-@pytest.mark.parametrize("n_kv_heads", [None, 2])
-def test_incremental_matches_full_forward(n_kv_heads):
+@pytest.mark.parametrize("zoo_kwargs", [
+    {}, {"n_kv_heads": 2}, {"pos": "rope"}])
+def test_incremental_matches_full_forward(zoo_kwargs):
     # f32 compute for a tight oracle: under the default bf16 policy the
     # two paths group their matmuls differently, so bf16 rounding alone
     # produces ~1e-2 logit differences
     from veles_tpu.config import root
     root.common.engine.precision_level = 1
     try:
-        wf, toks = _lm_workflow(max_epochs=0, n_kv_heads=n_kv_heads)
+        wf, toks = _lm_workflow(max_epochs=0, **zoo_kwargs)
         gen = LMGenerator(wf.trainer, max_len=16)
         sample = toks[:4]
         inc = gen.score(sample)                  # [B, T-1, V]
@@ -65,7 +66,6 @@ def test_greedy_generation_continues_pattern():
     out = gen.generate(prompt, max_new=8)
     assert out.shape == (8, 16)
     np.testing.assert_array_equal(out[:, :8], prompt)  # prompt untouched
-    want = (np.arange(16)[None, :] * 2 + (prompt[:, :1] % 13)) % 13
     # the learned rule: every token advances by 2 (mod vocab)
     step_ok = ((out[:, 1:] - out[:, :-1]) % 13 == 2).mean()
     assert step_ok > 0.9, (step_ok, out[:2])
@@ -85,3 +85,14 @@ def test_rejects_overlong_prompt():
     gen = LMGenerator(wf.trainer, max_len=10)
     with pytest.raises(ValueError):
         gen.generate(toks[:2, :8], max_new=8)
+
+
+def test_one_compile_per_batch_size():
+    """Varying prompt lengths must reuse ONE compiled scan (prompt_len is
+    traced) — a REST server sees arbitrary lengths per request."""
+    wf, toks = _lm_workflow(max_epochs=0)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    gen.generate(toks[:2, :4], max_new=2)
+    gen.generate(toks[:2, :7], max_new=5)
+    gen.generate(toks[:2, :10], max_new=1)
+    assert len(gen._compiled) == 1, list(gen._compiled)

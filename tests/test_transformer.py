@@ -122,3 +122,11 @@ def test_remat_with_moe_aux_loss():
     returned through jax.checkpoint, not stashed as a side effect)."""
     wf = _train_lm(max_epochs=3, remat=True, n_experts=2)
     assert wf.decision.best_metric is not None
+
+
+def test_rope_lm_trains():
+    """Rotary position embedding: no position table, still learns."""
+    wf = _train_lm(max_epochs=12, pos="rope")
+    layer_types = [l.type for l in wf.trainer.layers]
+    assert "positional_encoding" not in layer_types
+    assert wf.decision.best_metric < 0.2, wf.decision.best_metric

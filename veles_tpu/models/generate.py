@@ -90,15 +90,18 @@ class LMGenerator:
                             self._head_dim), dtype))
                 for layer in self._blocks]
 
-    def _scan_fn(self, batch, prompt_len, total, greedy):
-        # per-instance cache (NOT lru_cache: a class-level cache keyed on
-        # self would immortalize every generator and its params)
-        key_ = (batch, prompt_len, total, greedy)
-        cached = self._compiled.get(key_)
+    def _scan_fn(self, batch, greedy):
+        """ONE compile per (batch, greedy): the scan always runs to
+        max_len - 1 with ``prompt_len`` a traced scalar (a REST server
+        sees arbitrary prompt lengths — shape-specializing on them would
+        recompile per request and cache executables forever).  Cached
+        per-instance (NOT lru_cache: a class-level cache keyed on self
+        would immortalize every generator and its params)."""
+        cached = self._compiled.get((batch, greedy))
         if cached is not None:
             return cached
 
-        def run(params, tokens, key):
+        def run(params, tokens, prompt_len, key):
             caches = self._init_caches(
                 batch, self.params[self._embed.name]["table"].dtype)
 
@@ -119,11 +122,21 @@ class LMGenerator:
                 return (tokens, caches, key), logits
 
             (tokens, _, _), logits = jax.lax.scan(
-                body, (tokens, caches, key), jnp.arange(total - 1))
+                body, (tokens, caches, key),
+                jnp.arange(self.max_len - 1))
             return tokens, logits
 
-        self._compiled[key_] = jax.jit(run)
-        return self._compiled[key_]
+        self._compiled[(batch, greedy)] = jax.jit(run)
+        return self._compiled[(batch, greedy)]
+
+    def _run(self, params, tokens_np, prompt_len, greedy, key):
+        b = tokens_np.shape[0]
+        pad = self.max_len - tokens_np.shape[1]
+        if pad:
+            tokens_np = np.concatenate(
+                [tokens_np, np.zeros((b, pad), np.int32)], axis=1)
+        return self._scan_fn(b, greedy)(
+            params, jnp.asarray(tokens_np), jnp.int32(prompt_len), key)
 
     # ------------------------------------------------------------------
     def generate(self, prompt, max_new, temperature=0.0, seed=0):
@@ -135,10 +148,7 @@ class LMGenerator:
         if total > self.max_len:
             raise ValueError("prompt + max_new = %d exceeds max_len %d"
                              % (total, self.max_len))
-        tokens = jnp.asarray(np.concatenate(
-            [prompt, np.zeros((b, int(max_new)), np.int32)], axis=1))
         greedy = temperature == 0.0
-        key = jax.random.key(seed)
         params = self.params
         if not greedy and temperature != 1.0:
             head = dict(params[self._head.name])
@@ -146,18 +156,19 @@ class LMGenerator:
             if "bias" in head:
                 head["bias"] = head["bias"] / temperature
             params = dict(params, **{self._head.name: head})
-        out, _ = self._scan_fn(b, t0, total, greedy)(params, tokens, key)
-        return np.asarray(out)
+        out, _ = self._run(params, prompt, t0, greedy,
+                           jax.random.key(seed))
+        return np.asarray(out)[:, :total]
 
     def score(self, tokens):
         """Per-position next-token logits from the incremental path
         (teacher forcing) — [B, T-1, V]; the equivalence oracle for the
         tests and a perplexity scorer."""
-        tokens = jnp.asarray(np.asarray(tokens, np.int32))
+        tokens = np.asarray(tokens, np.int32)
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError("sequence %d exceeds max_len %d"
                              % (t, self.max_len))
-        _, logits = self._scan_fn(b, t, t, True)(
-            self.params, tokens, jax.random.key(0))
-        return np.asarray(logits).transpose(1, 0, 2)
+        _, logits = self._run(self.params, tokens, t, True,
+                              jax.random.key(0))
+        return np.asarray(logits).transpose(1, 0, 2)[:, :t - 1]

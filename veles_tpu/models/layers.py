@@ -508,7 +508,8 @@ class MultiHeadAttention(Layer):
             causal=bool(self.cfg.get("causal", False)),
             impl=self.cfg.get("impl", "blockwise"),
             attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
-            n_kv_heads=self.n_kv_heads)
+            n_kv_heads=self.n_kv_heads,
+            use_rope=bool(self.cfg.get("rope", False)))
 
 
 class MoE(Layer):
@@ -633,7 +634,8 @@ class TransformerBlock(Layer):
             causal=bool(self.cfg.get("causal", False)),
             impl=self.cfg.get("impl", "blockwise"),
             attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
-            n_kv_heads=self.n_kv_heads)
+            n_kv_heads=self.n_kv_heads,
+            use_rope=bool(self.cfg.get("rope", False)))
         if k1 is not None:
             h = dropout.forward(h, k1, ratio)
         x = x + h
@@ -646,11 +648,12 @@ class TransformerBlock(Layer):
     def _ffn(self, params, h, train):
         """The post-LN branch, shared by apply() and step() so training
         and incremental decoding can never diverge.  MoE: the router aux
-        loss lands in self.last_aux only when training."""
+        loss lands in self.last_aux unconditionally — eval loss includes
+        it, same as the standalone ``moe`` layer type."""
         if self.n_experts:
             self._moe.mesh = self.mesh
             h = self._moe.apply(params["moe"], h, train=train)
-            self.last_aux = self._moe.last_aux if train else None
+            self.last_aux = self._moe.last_aux
             self._moe.last_aux = None
             return h
         h = jax.nn.gelu(linear.matmul(h, params["w1"], self.policy)
@@ -666,7 +669,8 @@ class TransformerBlock(Layer):
                             params["ln1"]["beta"])
         h, cache_k, cache_v = attention.mha_step(
             params["mha"], h, cache_k, cache_v, pos, self.n_heads,
-            n_kv_heads=self.n_kv_heads, policy=self.policy)
+            n_kv_heads=self.n_kv_heads, policy=self.policy,
+            use_rope=bool(self.cfg.get("rope", False)))
         x = x + h
         h = norm.layer_norm(x, params["ln2"]["gamma"],
                             params["ln2"]["beta"])

@@ -107,15 +107,21 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
 def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
                    impl="blockwise", solver="adam", n_experts=0,
-                   n_kv_heads=None, remat=False):
+                   n_kv_heads=None, remat=False, pos="learned"):
     """Decoder-only causal LM over int token samples [T].
     ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
     rematerializes each block's activations in the backward pass
-    (jax.checkpoint — long-context memory for FLOPs)."""
+    (jax.checkpoint — long-context memory for FLOPs); ``pos`` =
+    "learned" | "sinusoid" position table, or "rope" (rotary q/k in
+    every block, no table — extrapolates past the train length)."""
+    if pos not in ("learned", "sinusoid", "rope"):
+        raise ValueError("pos must be learned|sinusoid|rope")
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
     layers = [dict({"type": "embedding", "vocab_size": vocab_size,
-                    "d_model": d_model}, **gd),
-              dict({"type": "positional_encoding", "learned": True}, **gd)]
+                    "d_model": d_model}, **gd)]
+    if pos != "rope":
+        layers.append(dict({"type": "positional_encoding",
+                            "learned": pos == "learned"}, **gd))
     for _ in range(n_layers):
         layers.append(dict({"type": "transformer_block",
                             "n_heads": n_heads,
@@ -123,7 +129,8 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": True, "dropout_ratio": dropout,
                             "impl": impl, "n_experts": n_experts,
-                            "remat": remat}, **gd))
+                            "remat": remat, "rope": pos == "rope"},
+                           **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append(dict({"type": "timestep_dense",
                         "output_sample_shape": vocab_size}, **gd))

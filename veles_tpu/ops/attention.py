@@ -180,7 +180,8 @@ def _proj(x, w, b, policy):
 
 
 def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
-                attn_fn=None, policy=None, n_kv_heads=None):
+                attn_fn=None, policy=None, n_kv_heads=None,
+                use_rope=False):
     """x: [B, T, d_model] → [B, T, d_model].
 
     ``attn_fn(q, k, v, causal)`` overrides the core attention — this is the
@@ -188,7 +189,8 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
     ``policy`` (ops.policy.Policy) casts the projection matmuls and the
     attention inputs to the compute dtype (bf16 on the MXU).
     ``n_kv_heads`` enables GQA: k/v heads broadcast to the query heads
-    before the core attention (same kernels, smaller projections)."""
+    before the core attention (same kernels, smaller projections).
+    ``use_rope`` rotates q/k by absolute position (rope())."""
     if n_kv_heads is None:
         n_kv_heads = n_heads
     cast = (lambda t: t) if policy is None else policy.cast_in
@@ -198,6 +200,15 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
                     n_kv_heads)
     v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
                     n_kv_heads)
+    if use_rope:
+        if attn_fn is not None:
+            # ring/Ulysses shard the sequence: shard-local arange would
+            # rotate with the wrong global positions
+            raise ValueError("rope is not supported with sequence-"
+                             "parallel attention (impl=ring/ulysses)")
+        pos = jnp.arange(x.shape[1])
+        q = rope(q, pos)
+        k = rope(k, pos)
     if n_kv_heads != n_heads:
         rep = n_heads // n_kv_heads
         k = jnp.repeat(k, rep, axis=1)
@@ -214,7 +225,7 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
 
 
 def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
-             scale=None, policy=None):
+             scale=None, policy=None, use_rope=False):
     """One incremental-decoding step with a KV cache.
 
     x: [B, 1, d_model] (the token at position ``pos``);
@@ -232,6 +243,10 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
                      n_kv_heads).astype(cache_k.dtype)
     v1 = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
                      n_kv_heads).astype(cache_v.dtype)
+    if use_rope:
+        p1 = jnp.full((1,), pos, jnp.int32)
+        q = rope(q, p1)
+        k1 = rope(k1, p1).astype(cache_k.dtype)  # cache stores rotated k
     cache_k = jax.lax.dynamic_update_slice(cache_k, k1, (0, 0, pos, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v1, (0, 0, pos, 0))
 
@@ -250,3 +265,21 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
     o = o.reshape(b, 1, h * hd).astype(x.dtype)
     return (_proj(o, params["wo"], params["bo"], policy),
             cache_k, cache_v)
+
+
+def rope(x, positions, base=10000.0):
+    """Rotary position embedding (RoFormer).  x: [B, H, T, D] with D
+    even; ``positions`` [T] int — rotates consecutive (even, odd) feature
+    pairs by position-dependent angles, encoding relative offsets in the
+    q·k inner product (no position table, extrapolates past train
+    length)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, None]            # [1, 1, T, half]
+    sin = jnp.sin(angles)[None, None]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(x.shape).astype(x.dtype)
