@@ -66,6 +66,17 @@ class TestCLI:
         assert r.returncode == 0, r.stderr[-2000:]
         assert json.load(open(out))["epochs"] == 1
 
+    def test_gpt_lm_sample(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/gpt_lm.py", "--backend", "cpu",
+                  "--random-seed", "5", "--steps-per-dispatch", "4",
+                  "--config-list", "root.gpt.max_epochs=1",
+                  "root.gpt.n_layers=1", "root.gpt.d_model=32",
+                  "root.gpt.seq_len=32", "root.gpt.n_heads=4",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
+
     def test_kohonen_sample(self):
         r = _cli(["samples/digits_kohonen.py", "--backend", "cpu",
                   "--random-seed", "5",

@@ -83,6 +83,18 @@ class TestLRAdjuster:
         assert POLICIES["inv"](0) == 1.0
         assert POLICIES["arbitrary_step"](
             7, steps=[(0, 1.0), (5, 0.3), (10, 0.1)]) == 0.3
+        # warmup_cosine: linear ramp, peak after warmup, floor at total
+        wc = POLICIES["warmup_cosine"]
+        assert wc(0, warmup=4, total=20) == pytest.approx(0.25)
+        assert wc(3, warmup=4, total=20) == pytest.approx(1.0)
+        assert wc(4, warmup=4, total=20) == pytest.approx(1.0)
+        assert wc(20, warmup=4, total=20, floor=0.1) == pytest.approx(0.1)
+        assert 0.4 < wc(12, warmup=4, total=20) < 0.6
+        # the integration path: kwargs must survive the unit's whitelist
+        adj = LRAdjuster(None, policy="warmup_cosine", warmup=4,
+                         total=20, floor=0.1)
+        assert adj.scale_for(0) == pytest.approx(0.25)
+        assert adj.scale_for(20) == pytest.approx(0.1)
 
     def test_adjuster_in_workflow(self):
         wf = _mnistish_workflow(

@@ -7,6 +7,8 @@ schedule changes never trigger an XLA recompile.  Policies mirror the
 reference's (Caffe-style) set: exp, step_exp, inv, plus arbitrary
 callables."""
 
+import math
+
 from veles_tpu.units import Unit
 
 POLICIES = {}
@@ -42,6 +44,16 @@ def inv(epoch, gamma=0.1, power=0.75, **kw):
     return (1.0 + gamma * epoch) ** -power
 
 
+@policy("warmup_cosine")
+def warmup_cosine(epoch, warmup=5, total=100, floor=0.0, **kw):
+    """Linear warmup over ``warmup`` epochs then cosine decay to
+    ``floor`` at ``total`` — the standard transformer-LM schedule."""
+    if epoch < warmup:
+        return (epoch + 1) / max(warmup, 1)
+    t = min((epoch - warmup) / max(total - warmup, 1), 1.0)
+    return floor + (1.0 - floor) * 0.5 * (1.0 + math.cos(math.pi * t))
+
+
 @policy("arbitrary_step")
 def arbitrary_step(epoch, steps=(), **kw):
     """``steps`` = [(epoch_threshold, scale), ...]; the scale of the last
@@ -62,7 +74,8 @@ class LRAdjuster(Unit):
 
     def __init__(self, workflow, policy="fixed", **kwargs):
         self._policy_kwargs = {k: kwargs.pop(k) for k in
-                               ("base", "step", "gamma", "power", "steps")
+                               ("base", "step", "gamma", "power", "steps",
+                                "warmup", "total", "floor")
                                if k in kwargs}
         super(LRAdjuster, self).__init__(workflow, **kwargs)
         self.policy = policy
