@@ -1,0 +1,28 @@
+"""bench.py's one-JSON-line contract must hold even when no device ever
+answers (VERDICT r1 missing #1: the driver needs a parseable line, with
+an ``error`` field, not a stack trace or silence)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_emits_one_json_line_when_budget_exhausted(tmp_path):
+    # BENCH_BUDGET=0: the probe hits the global deadline immediately —
+    # the orchestrator must still print exactly one JSON object on
+    # stdout with the error recorded
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_BUDGET="0")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path))   # cwd without .bench_last_good.json
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "gemm_3001x3001_f32_gflops"
+    assert out["value"] == 0.0
+    assert out["error"] and "probe" in out["error"]
