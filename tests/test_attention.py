@@ -215,3 +215,38 @@ def test_flash_mixed_dtypes_rejected():
                for kk in jax.random.split(key, 3))
     with pytest.raises(ValueError, match="matching q/k/v dtypes"):
         flash_attention(q, k.astype(jnp.bfloat16), v)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_rope_with_sequence_parallel_mha(impl):
+    """RoPE rotates the GLOBAL q/k before the seq-parallel shard_map, so
+    ring/Ulysses attention under rope must match the single-device path."""
+    from veles_tpu.config import root
+    from veles_tpu.models.layers import make_layer
+    from veles_tpu import prng
+
+    # seq=4: ulysses also needs n_heads (4) divisible by the axis size
+    mesh = make_mesh({"seq": 4}, jax.devices()[:4])
+    r = np.random.RandomState(11)
+    x = jnp.asarray(r.randn(2, 16, 32).astype(np.float32))
+
+    def out_for(impl_name, with_mesh):
+        prng.seed_all(13)
+        layer = make_layer({"type": "multihead_attention", "n_heads": 4,
+                            "causal": True, "rope": True,
+                            "impl": impl_name})
+        layer.setup((16, 32))
+        if with_mesh:
+            layer.mesh = mesh
+        params = layer.init_params(prng.get("w"))
+        return np.asarray(layer.apply(params, x))
+
+    # f32 compute: the two paths group matmuls differently, so the
+    # default bf16 policy alone costs ~1e-2 of disagreement
+    root.common.engine.precision_level = 1
+    try:
+        got = out_for(impl, True)
+        want = out_for("blockwise", False)
+    finally:
+        root.common.engine.precision_level = 0
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -201,11 +201,9 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
     v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
                     n_kv_heads)
     if use_rope:
-        if attn_fn is not None:
-            # ring/Ulysses shard the sequence: shard-local arange would
-            # rotate with the wrong global positions
-            raise ValueError("rope is not supported with sequence-"
-                             "parallel attention (impl=ring/ulysses)")
+        # rotation happens on the GLOBAL [B, H, T, D] arrays, before any
+        # sequence-parallel shard_map (ring/Ulysses take global arrays
+        # and shard internally) — positions are the true 0..T-1
         pos = jnp.arange(x.shape[1])
         q = rope(q, pos)
         k = rope(k, pos)
