@@ -89,7 +89,8 @@ def run(load, main):
         gen = _synthetic_generator(n_classes)
         steps = cfg.get("steps_per_epoch", 50)
     loader = GeneratorLoader(None, generator=gen, sample_shape=SHAPE,
-                             steps_per_epoch=steps, minibatch_size=size)
+                             steps_per_epoch=steps, minibatch_size=size,
+                             prefetch=cfg.get("prefetch", 2))
     load(StandardWorkflow,
          layers=alexnet(n_classes=n_classes,
                         lr=cfg.get("learning_rate", 0.01)),

@@ -130,7 +130,7 @@ class GeneratorLoader(Loader):
     carries_data = True
 
     def __init__(self, workflow, generator=None, sample_shape=None,
-                 steps_per_epoch=100, **kwargs):
+                 steps_per_epoch=100, prefetch=0, **kwargs):
         super(GeneratorLoader, self).__init__(workflow, **kwargs)
         if generator is None or sample_shape is None:
             raise ValueError("GeneratorLoader needs generator= and "
@@ -138,19 +138,26 @@ class GeneratorLoader(Loader):
         self.generator = generator
         self.sample_shape = tuple(sample_shape)
         self.steps_per_epoch = int(steps_per_epoch)
+        #: produce up to this many batches ahead on a worker thread —
+        #: overlaps host-side decode/augment with the device step (the
+        #: async dispatch already overlaps the transfer; this overlaps
+        #: the PRODUCTION).  0 = synchronous (default).  The generator
+        #: must be thread-compatible (it is called from one worker only).
+        self.prefetch = int(prefetch)
         self.minibatch_data = None
         self.minibatch_labels = None
         self.minibatch_targets = None
         self._step = 0
+        self._pool_ = None          # underscore suffix: never pickled
+        self._pending_ = None
 
     def load_data(self):
         self.class_lengths = [0, 0,
                               self.steps_per_epoch * self.minibatch_size]
         self.shuffle_enabled = False   # ordering belongs to the generator
 
-    def run(self):
-        super(GeneratorLoader, self).run()   # epoch flags / offsets
-        out = self.generator(self._step, self.minibatch_size)
+    def _produce(self, step):
+        out = self.generator(step, self.minibatch_size)
         if isinstance(out, tuple):
             data, labels = (out + (None,))[:2]
         else:
@@ -160,21 +167,54 @@ class GeneratorLoader(Loader):
             raise ValueError("generator returned %s, expected %s"
                              % (data.shape,
                                 (self.minibatch_size,) + self.sample_shape))
-        self.minibatch_data = data
-        self.minibatch_labels = (None if labels is None
-                                 else np.asarray(labels, np.int32))
-        self._step += 1
+        return data, (None if labels is None
+                      else np.asarray(labels, np.int32))
+
+    def _next_batch(self):
+        if self.prefetch <= 0:
+            batch = self._produce(self._step)
+            self._step += 1
+            return batch
+        from concurrent.futures import ThreadPoolExecutor
+        if self._pool_ is None:
+            self._pool_ = ThreadPoolExecutor(
+                1, thread_name_prefix="loader-prefetch")
+            self._pending_ = []
+        while len(self._pending_) < self.prefetch + 1:
+            self._pending_.append(
+                self._pool_.submit(self._produce, self._step))
+            self._step += 1
+        return self._pending_.pop(0).result()
+
+    def run(self):
+        super(GeneratorLoader, self).run()   # epoch flags / offsets
+        self.minibatch_data, self.minibatch_labels = self._next_batch()
+
+    def stop(self):
+        """Release the prefetch worker (Workflow.stop calls this) — a
+        generator blocked on I/O must not hang interpreter exit."""
+        if self._pool_ is not None:
+            self._pool_.shutdown(wait=False, cancel_futures=True)
+            self._pool_ = None
+            self._pending_ = None
+        super(GeneratorLoader, self).stop()
 
     @property
     def state(self):
         st = super(GeneratorLoader, self).state
-        st["generator_step"] = self._step
+        # the resume position is the next UNCONSUMED step — submitted-
+        # but-pending prefetch batches are regenerated after restore
+        st["generator_step"] = self._step - len(self._pending_ or [])
         return st
 
     @state.setter
     def state(self, st):
         Loader.state.fset(self, st)
         self._step = st.get("generator_step", 0)
+        if self._pending_:
+            for fut in self._pending_:
+                fut.cancel()
+        self._pending_ = [] if self._pool_ is not None else None
 
 
 class Downloader(Unit):
