@@ -96,3 +96,49 @@ def test_one_compile_per_batch_size():
     gen.generate(toks[:2, :7], max_new=5)
     gen.generate(toks[:2, :10], max_new=1)
     assert len(gen._compiled) == 1, list(gen._compiled)
+
+
+def test_top_k_and_top_p_sampling():
+    """top_k=1 must equal greedy; top_p≈0 likewise; both reproducible."""
+    wf, toks = _lm_workflow(max_epochs=6)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    prompt = toks[:4, :8]
+    greedy = gen.generate(prompt, max_new=6)
+    k1 = gen.generate(prompt, max_new=6, temperature=0.9, top_k=1)
+    np.testing.assert_array_equal(greedy, k1)
+    p0 = gen.generate(prompt, max_new=6, temperature=0.9, top_p=1e-6)
+    np.testing.assert_array_equal(greedy, p0)
+    a = gen.generate(prompt, max_new=6, temperature=0.9, top_k=5,
+                     top_p=0.9, seed=4)
+    b = gen.generate(prompt, max_new=6, temperature=0.9, top_k=5,
+                     top_p=0.9, seed=4)
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError):
+        gen.generate(prompt, max_new=2, top_p=0.0)
+
+
+def test_bf16_cache_dtype():
+    wf, toks = _lm_workflow(max_epochs=4)
+    import jax.numpy as jnp
+    gen = LMGenerator(wf.trainer, max_len=16, cache_dtype=jnp.bfloat16)
+    out = gen.generate(toks[:2, :8], max_new=4)
+    assert out.shape == (2, 12)
+    # bf16 cache vs f32 cache: same greedy continuation on this easy task
+    ref = LMGenerator(wf.trainer, max_len=16).generate(toks[:2, :8],
+                                                       max_new=4)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sampling_params_do_not_recompile():
+    """top_k/top_p are traced — distinct values reuse ONE executable."""
+    wf, toks = _lm_workflow(max_epochs=0)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    for tk, tp in ((0, 1.0), (5, 0.9), (3, 0.7), (8, 0.99)):
+        gen.generate(toks[:2, :6], max_new=3, temperature=0.8,
+                     top_k=tk, top_p=tp, seed=1)
+    assert len(gen._compiled) == 1, list(gen._compiled)
+    with pytest.raises(ValueError):
+        gen.generate(toks[:2, :6], max_new=2, temperature=0.8, top_k=-1)
+    with pytest.raises(ValueError):
+        gen.generate(toks[:2, :6], max_new=2, temperature=0.8,
+                     top_k=10 ** 6)

@@ -462,6 +462,10 @@ class TestGeneratorLoader:
         fresh.state = pre.state
         fresh.run()
         assert fresh.minibatch_data[0, 0] == 4.0
+        # stop() discards pending batches AND rolls the counter back —
+        # a post-stop state read still reports the consumed position
+        pre.stop()
+        assert pre.state["generator_step"] == 4
 
 
 class TestDatasetAnalysis:

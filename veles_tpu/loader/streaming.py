@@ -192,10 +192,13 @@ class GeneratorLoader(Loader):
 
     def stop(self):
         """Release the prefetch worker (Workflow.stop calls this) — a
-        generator blocked on I/O must not hang interpreter exit."""
+        generator blocked on I/O must not hang interpreter exit.  The
+        step counter rolls back over the discarded pending batches so a
+        post-stop ``state`` read still reports the CONSUMED position."""
         if self._pool_ is not None:
             self._pool_.shutdown(wait=False, cancel_futures=True)
             self._pool_ = None
+            self._step -= len(self._pending_ or [])
             self._pending_ = None
         super(GeneratorLoader, self).stop()
 

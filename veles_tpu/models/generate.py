@@ -27,9 +27,13 @@ class LMGenerator:
         out = gen.generate(prompt, max_new=32, temperature=0.8, seed=1)
     """
 
-    def __init__(self, trainer, max_len):
+    def __init__(self, trainer, max_len, cache_dtype=None):
         self.params = trainer.params
         self.max_len = int(max_len)
+        #: KV-cache storage dtype; default follows the params.  bfloat16
+        #: halves serve-time cache memory (keys/values are MXU inputs
+        #: anyway; softmax stays f32)
+        self.cache_dtype = cache_dtype
         self._compiled = {}
         layers = trainer.layers
         by_type = {}
@@ -84,6 +88,7 @@ class LMGenerator:
         return logits[:, 0].astype(jnp.float32), new_caches
 
     def _init_caches(self, batch, dtype):
+        dtype = self.cache_dtype or dtype
         return [(jnp.zeros((batch, layer.n_kv_heads, self.max_len,
                             self._head_dim), dtype),
                  jnp.zeros((batch, layer.n_kv_heads, self.max_len,
@@ -92,16 +97,36 @@ class LMGenerator:
 
     def _scan_fn(self, batch, greedy):
         """ONE compile per (batch, greedy): the scan always runs to
-        max_len - 1 with ``prompt_len`` a traced scalar (a REST server
-        sees arbitrary prompt lengths — shape-specializing on them would
-        recompile per request and cache executables forever).  Cached
-        per-instance (NOT lru_cache: a class-level cache keyed on self
-        would immortalize every generator and its params)."""
+        max_len - 1, and prompt_len / top_k / top_p are all TRACED
+        scalars (a REST server sees arbitrary prompt lengths and
+        client-chosen sampling configs — shape- or value-specializing
+        on any of them would recompile per request and cache executables
+        forever).  Cached per-instance (NOT lru_cache: a class-level
+        cache keyed on self would immortalize every generator and its
+        params)."""
         cached = self._compiled.get((batch, greedy))
         if cached is not None:
             return cached
 
-        def run(params, tokens, prompt_len, key):
+        def sample(logits, sub, top_k, top_p):
+            # sorted-descending view serves both truncations with
+            # TRACED parameters (lax.top_k would need a static k)
+            sl = jnp.sort(logits, axis=-1)[:, ::-1]
+            kth = jnp.take(sl, jnp.clip(top_k - 1, 0,
+                                        sl.shape[-1] - 1), axis=-1)
+            k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)[:, None]
+            # nucleus: keep the smallest prefix of the distribution
+            # whose mass reaches top_p
+            ps = jax.nn.softmax(sl, axis=-1)
+            keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p
+            p_thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
+                               keepdims=True)
+            logits = jnp.where(
+                (logits >= k_thresh) & (logits >= p_thresh),
+                logits, -1e30)
+            return jax.random.categorical(sub, logits).astype(jnp.int32)
+
+        def run(params, tokens, prompt_len, key, top_k, top_p):
             caches = self._init_caches(
                 batch, self.params[self._embed.name]["table"].dtype)
 
@@ -113,8 +138,7 @@ class LMGenerator:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
                     key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(
-                        sub, logits).astype(jnp.int32)
+                    nxt = sample(logits, sub, top_k, top_p)
                 keep = pos + 1 < prompt_len       # teacher-force prompt
                 nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
                 tokens = jax.lax.dynamic_update_slice(
@@ -129,25 +153,36 @@ class LMGenerator:
         self._compiled[(batch, greedy)] = jax.jit(run)
         return self._compiled[(batch, greedy)]
 
-    def _run(self, params, tokens_np, prompt_len, greedy, key):
+    def _run(self, params, tokens_np, prompt_len, greedy, key, top_k=0,
+             top_p=1.0):
         b = tokens_np.shape[0]
         pad = self.max_len - tokens_np.shape[1]
         if pad:
             tokens_np = np.concatenate(
                 [tokens_np, np.zeros((b, pad), np.int32)], axis=1)
         return self._scan_fn(b, greedy)(
-            params, jnp.asarray(tokens_np), jnp.int32(prompt_len), key)
+            params, jnp.asarray(tokens_np), jnp.int32(prompt_len), key,
+            jnp.int32(top_k), jnp.float32(top_p))
 
     # ------------------------------------------------------------------
-    def generate(self, prompt, max_new, temperature=0.0, seed=0):
+    def generate(self, prompt, max_new, temperature=0.0, seed=0,
+                 top_k=0, top_p=1.0):
         """prompt [B, T0] int tokens → [B, T0 + max_new].  temperature 0
-        = greedy argmax; otherwise softmax sampling at that temperature."""
+        = greedy argmax; otherwise softmax sampling at that temperature,
+        optionally truncated to the ``top_k`` best tokens and/or the
+        ``top_p`` nucleus (smallest set reaching that probability
+        mass)."""
         prompt = np.asarray(prompt, np.int32)
         b, t0 = prompt.shape
         total = t0 + int(max_new)
         if total > self.max_len:
             raise ValueError("prompt + max_new = %d exceeds max_len %d"
                              % (total, self.max_len))
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1], got %r" % (top_p,))
+        if not 0 <= int(top_k) <= self._head.n_out:
+            raise ValueError("top_k must be in [0, %d], got %r"
+                             % (self._head.n_out, top_k))
         greedy = temperature == 0.0
         params = self.params
         if not greedy and temperature != 1.0:
@@ -157,7 +192,8 @@ class LMGenerator:
                 head["bias"] = head["bias"] / temperature
             params = dict(params, **{self._head.name: head})
         out, _ = self._run(params, prompt, t0, greedy,
-                           jax.random.key(seed))
+                           jax.random.key(seed), int(top_k),
+                           float(top_p))
         return np.asarray(out)[:, :total]
 
     def score(self, tokens):

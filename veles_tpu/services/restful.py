@@ -90,7 +90,9 @@ class RESTfulAPI(Logger):
         return self.generator.generate(
             prompt, int(opts.get("max_new", 16)),
             temperature=float(opts.get("temperature", 0.0)),
-            seed=int(opts.get("seed", 0)))
+            seed=int(opts.get("seed", 0)),
+            top_k=int(opts.get("top_k", 0)),
+            top_p=float(opts.get("top_p", 1.0)))
 
     # ------------------------------------------------------------ decoding
     def decode_input(self, req):
