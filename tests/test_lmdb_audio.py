@@ -130,3 +130,52 @@ class TestAudioLoader:
         assert loader.minibatch_indices.shape == (2,)
         got = AudioLoader.gather(loader.data, loader.minibatch_indices)
         assert got.shape == (2, 512)
+
+
+class TestHDFSTextLoader:
+    """The HDFS text loader through pyarrow's LocalFileSystem (file://
+    URIs exercise the exact open_fs/read_rows path a real hdfs:// takes,
+    minus the libhdfs transport)."""
+
+    def _write(self, path, rows):
+        with open(path, "w") as f:
+            f.write("# comment line\n\n")
+            for r in rows:
+                f.write(",".join(str(v) for v in r) + "\n")
+
+    def test_loads_classes_and_trains_shape(self, tmp_path):
+        from veles_tpu.loader.hdfs import HDFSTextLoader
+
+        rs = np.random.RandomState(0)
+        train = [(i * 0.5, i * 0.25, i % 3) for i in range(20)]
+        valid = [(rs.rand(), rs.rand(), i % 3) for i in range(6)]
+        self._write(tmp_path / "train.txt", train)
+        self._write(tmp_path / "valid.txt", valid)
+        loader = HDFSTextLoader(
+            None,
+            files={"train": "file://%s" % (tmp_path / "train.txt"),
+                   "validation": "file://%s" % (tmp_path / "valid.txt")},
+            minibatch_size=5)
+        loader.initialize()
+        assert loader.class_lengths == [0, 6, 20]
+        np.testing.assert_allclose(np.asarray(loader.data)[6], [0, 0])
+        assert int(np.asarray(loader.labels)[6]) == 0
+        loader.run()
+        assert loader.minibatch_class == VALID
+
+    def test_separator_and_unlabeled(self, tmp_path):
+        from veles_tpu.loader.hdfs import read_rows
+
+        with open(tmp_path / "u.txt", "w") as f:
+            f.write("1.0;2.0\n3.0;4.0\n")
+        d, l = read_rows("file://%s" % (tmp_path / "u.txt"),
+                         separator=";", labeled=False)
+        np.testing.assert_allclose(d, [[1, 2], [3, 4]])
+        assert l is None
+
+    def test_empty_raises(self, tmp_path):
+        from veles_tpu.loader.hdfs import read_rows
+
+        (tmp_path / "e.txt").write_text("# nothing\n")
+        with pytest.raises(ValueError, match="no rows"):
+            read_rows("file://%s" % (tmp_path / "e.txt"))

@@ -533,7 +533,12 @@ class Main(object):
                 from veles_tpu.models.generate import LMGenerator
                 max_len = wf.trainer.layers[0].input_shape[0] \
                     if wf.trainer.layers[0].input_shape else 0
-                generator = LMGenerator(wf.trainer, max_len=max_len)
+                # root.common.serve.cache_dtype='bfloat16' halves the
+                # serve-time KV-cache memory (docs/services.md)
+                cd = root.common.serve.get("cache_dtype", None)
+                generator = LMGenerator(
+                    wf.trainer, max_len=max_len,
+                    cache_dtype=None if cd is None else np.dtype(cd))
             except ValueError:
                 generator = None    # not a generate-shaped stack
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),

@@ -108,7 +108,7 @@ class LMGenerator:
         if cached is not None:
             return cached
 
-        def sample(logits, sub, top_k, top_p):
+        def truncate(logits, top_k, top_p):
             # sorted-descending view serves both truncations with
             # TRACED parameters (lax.top_k would need a static k)
             sl = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -121,9 +121,15 @@ class LMGenerator:
             keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p
             p_thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
                                keepdims=True)
-            logits = jnp.where(
-                (logits >= k_thresh) & (logits >= p_thresh),
-                logits, -1e30)
+            return jnp.where((logits >= k_thresh) & (logits >= p_thresh),
+                             logits, -1e30)
+
+        def sample(logits, sub, top_k, top_p):
+            # plain temperature sampling skips the O(V log V) sort
+            logits = jax.lax.cond(
+                (top_k > 0) | (top_p < 1.0),
+                lambda lg: truncate(lg, top_k, top_p),
+                lambda lg: lg, logits)
             return jax.random.categorical(sub, logits).astype(jnp.int32)
 
         def run(params, tokens, prompt_len, key, top_k, top_p):
