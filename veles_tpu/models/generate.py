@@ -202,6 +202,97 @@ class LMGenerator:
                            float(top_p))
         return np.asarray(out)[:, :total]
 
+    def _beam_fn(self, batch, beam):
+        """ONE compile per (batch, beam): scan over all max_len - 1
+        positions; the prompt prefix teacher-forces every beam
+        identically (scores pinned to 0 so beams only diverge after the
+        prompt), then each step expands beam×V continuations and keeps
+        the ``beam`` best, gathering the KV caches of the surviving
+        parents."""
+        cached = self._compiled.get(("beam", batch, beam))
+        if cached is not None:
+            return cached
+        bb = batch * beam
+
+        def run(params, tokens, prompt_len, gen_end):
+            # tokens: [batch, beam, max_len]
+            caches = self._init_caches(
+                bb, self.params[self._embed.name]["table"].dtype)
+            scores = jnp.zeros((batch, beam), jnp.float32)
+            # before any divergence only beam 0 may survive expansion,
+            # or the result would be `beam` copies of one continuation
+            scores = scores.at[:, 1:].set(-1e30)
+
+            def body(carry, pos):
+                tokens, caches, scores = carry
+                logits, caches = self._step(
+                    params, caches, tokens.reshape(bb, -1)[:, pos], pos)
+                logp = jax.nn.log_softmax(logits)        # [bb, V]
+                v = logp.shape[-1]
+                in_prompt = pos + 1 < prompt_len
+                # beams freeze inside the prompt AND once max_new tokens
+                # are out — the scan always runs to max_len, and scores
+                # must not accumulate past the requested horizon
+                frozen = in_prompt | (pos + 1 >= gen_end)
+
+                # candidate scores for every (beam, token) continuation
+                cand = scores[:, :, None] + logp.reshape(batch, beam, v)
+                flat = cand.reshape(batch, beam * v)
+                top_s, top_i = jax.lax.top_k(flat, beam)
+                parent = top_i // v                      # [batch, beam]
+                tok = (top_i % v).astype(jnp.int32)
+
+                # teacher forcing / frozen tail: every beam keeps its own
+                # row and the already-present token, at no score cost
+                keep_parent = jnp.broadcast_to(
+                    jnp.arange(beam)[None], (batch, beam))
+                parent = jnp.where(frozen, keep_parent, parent)
+                tok = jnp.where(frozen, tokens[:, :, pos + 1], tok)
+                new_scores = jnp.where(frozen, scores, top_s)
+
+                flat_parent = (parent
+                               + jnp.arange(batch)[:, None] * beam
+                               ).reshape(bb)
+                tokens = jnp.take(tokens.reshape(bb, -1), flat_parent,
+                                  axis=0).reshape(batch, beam, -1)
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, tok[:, :, None], (0, 0, pos + 1))
+                caches = [(jnp.take(ck, flat_parent, axis=0),
+                           jnp.take(cv, flat_parent, axis=0))
+                          for ck, cv in caches]
+                return (tokens, caches, new_scores), None
+
+            (tokens, _, scores), _ = jax.lax.scan(
+                body, (tokens, caches, scores),
+                jnp.arange(self.max_len - 1))
+            return tokens, scores
+
+        self._compiled[("beam", batch, beam)] = jax.jit(run)
+        return self._compiled[("beam", batch, beam)]
+
+    def beam_search(self, prompt, max_new, beam=4):
+        """Beam-search decode: prompt [B, T0] → (tokens [B, T0+max_new],
+        log-probability of the returned best beam, [B])."""
+        prompt = np.asarray(prompt, np.int32)
+        b, t0 = prompt.shape
+        total = t0 + int(max_new)
+        if total > self.max_len:
+            raise ValueError("prompt + max_new = %d exceeds max_len %d"
+                             % (total, self.max_len))
+        if not 1 <= int(beam) <= 64:
+            # bounded like top_k: beam is client-controlled over REST,
+            # and each distinct value compiles (and caches) an
+            # executable whose cache memory scales with batch*beam
+            raise ValueError("beam must be in [1, 64], got %r" % (beam,))
+        tokens = np.zeros((b, beam, self.max_len), np.int32)
+        tokens[:, :, :t0] = prompt[:, None, :]
+        out, scores = self._beam_fn(b, int(beam))(
+            self.params, jnp.asarray(tokens), jnp.int32(t0),
+            jnp.int32(total))
+        best = np.asarray(jnp.argmax(scores, axis=1))
+        out = np.asarray(out)[np.arange(b), best, :total]
+        return out, np.asarray(scores)[np.arange(b), best]
+
     def score(self, tokens):
         """Per-position next-token logits from the incremental path
         (teacher forcing) — [B, T-1, V]; the equivalence oracle for the

@@ -87,6 +87,11 @@ class RESTfulAPI(Logger):
         prompt = np.asarray(req["input"], np.int32)
         if prompt.ndim == 1:
             prompt = prompt[None]
+        beam = int(opts.get("beam", 0))
+        if beam > 1:
+            out, _ = self.generator.beam_search(
+                prompt, int(opts.get("max_new", 16)), beam=beam)
+            return out
         return self.generator.generate(
             prompt, int(opts.get("max_new", 16)),
             temperature=float(opts.get("temperature", 0.0)),
