@@ -250,3 +250,51 @@ def test_rope_with_sequence_parallel_mha(impl):
     finally:
         root.common.engine.precision_level = 0
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("t,w", [(64, 16), (64, 3), (57, 16)])
+def test_flash_sliding_window(t, w):
+    """Sliding-window causal flash: forward AND fused backward must
+    match the masked naive reference (incl. ragged padding)."""
+    q, k, v = _qkv(t=t, d=16, seed=6)
+
+    ref = att.attention(q, k, v, causal=True, window=w)
+    out = att.flash_attention(q, k, v, causal=True, window=w,
+                              block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(lambda q, k, v: att.attention(
+        q, k, v, causal=True, window=w)), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss(lambda q, k, v: att.flash_attention(
+        q, k, v, causal=True, window=w, block_q=16, block_k=16)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_window_validation():
+    q, k, v = _qkv(t=32, d=16)
+    with pytest.raises(ValueError, match="causal"):
+        att.flash_attention(q, k, v, window=8)
+    with pytest.raises(ValueError, match=">= 1"):
+        att.flash_attention(q, k, v, causal=True, window=0)
+
+
+def test_mha_window_validated_for_all_impls():
+    """window misconfigs must raise identically on every impl path."""
+    from veles_tpu import prng
+    prng.seed_all(3)
+    params = att.mha_init(prng.get("w"), 16, 2)
+    x = jnp.zeros((1, 8, 16), jnp.float32)
+    for impl in ("blockwise", "naive", "flash"):
+        with pytest.raises(ValueError, match="causal"):
+            att.mha_forward(params, x, 2, causal=False, impl=impl,
+                            window=4)
+        with pytest.raises(ValueError, match=">= 1"):
+            att.mha_forward(params, x, 2, causal=True, impl=impl,
+                            window=0)

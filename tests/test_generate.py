@@ -169,3 +169,21 @@ def test_beam_search_matches_greedy_at_beam1_and_scores_exactly():
 
     with pytest.raises(ValueError):
         gen.beam_search(prompt, max_new=6, beam=0)
+
+
+def test_incremental_matches_full_forward_window():
+    """Sliding-window stack: the KV-cache step must apply the same
+    window mask the training forward uses."""
+    from veles_tpu.config import root
+    root.common.engine.precision_level = 1
+    try:
+        wf, toks = _lm_workflow(max_epochs=0, window=5)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        inc = gen.score(toks[:4])
+        full = np.asarray(
+            jax.jit(wf.trainer._forward, static_argnums=(2,))(
+                wf.trainer.params, jnp.asarray(toks[:4]), False,
+                jax.random.key(0)), np.float32)[:, :-1]
+        np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
+    finally:
+        root.common.engine.precision_level = 0

@@ -130,3 +130,8 @@ def test_rope_lm_trains():
     layer_types = [l.type for l in wf.trainer.layers]
     assert "positional_encoding" not in layer_types
     assert wf.decision.best_metric < 0.2, wf.decision.best_metric
+
+
+def test_sliding_window_lm_trains():
+    wf = _train_lm(max_epochs=12, window=6, impl="flash")
+    assert wf.decision.best_metric < 0.2, wf.decision.best_metric

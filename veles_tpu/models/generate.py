@@ -272,7 +272,13 @@ class LMGenerator:
 
     def beam_search(self, prompt, max_new, beam=4):
         """Beam-search decode: prompt [B, T0] → (tokens [B, T0+max_new],
-        log-probability of the returned best beam, [B])."""
+        log-probability of the returned best beam, [B]).
+
+        The prefill teacher-forces all ``beam`` rows identically — beam×
+        redundant prompt compute, the price of keeping ``prompt_len``
+        traced (ONE compiled executable per (batch, beam) regardless of
+        prompt length; a batch-width prefill would need a static split
+        point and recompile per length)."""
         prompt = np.asarray(prompt, np.int32)
         b, t0 = prompt.shape
         total = t0 + int(max_new)

@@ -509,7 +509,8 @@ class MultiHeadAttention(Layer):
             impl=self.cfg.get("impl", "blockwise"),
             attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
             n_kv_heads=self.n_kv_heads,
-            use_rope=bool(self.cfg.get("rope", False)))
+            use_rope=bool(self.cfg.get("rope", False)),
+            window=self.cfg.get("window"))
 
 
 class MoE(Layer):
@@ -635,7 +636,8 @@ class TransformerBlock(Layer):
             impl=self.cfg.get("impl", "blockwise"),
             attn_fn=_seq_parallel_attn_fn(self), policy=self.policy,
             n_kv_heads=self.n_kv_heads,
-            use_rope=bool(self.cfg.get("rope", False)))
+            use_rope=bool(self.cfg.get("rope", False)),
+            window=self.cfg.get("window"))
         if k1 is not None:
             h = dropout.forward(h, k1, ratio)
         x = x + h
@@ -670,7 +672,8 @@ class TransformerBlock(Layer):
         h, cache_k, cache_v = attention.mha_step(
             params["mha"], h, cache_k, cache_v, pos, self.n_heads,
             n_kv_heads=self.n_kv_heads, policy=self.policy,
-            use_rope=bool(self.cfg.get("rope", False)))
+            use_rope=bool(self.cfg.get("rope", False)),
+            window=self.cfg.get("window"))
         x = x + h
         h = norm.layer_norm(x, params["ln2"]["gamma"],
                             params["ln2"]["beta"])

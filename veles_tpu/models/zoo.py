@@ -107,7 +107,8 @@ def transformer_classifier(n_classes=10, d_model=64, n_heads=4, n_layers=2,
 def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
                    impl="blockwise", solver="adam", n_experts=0,
-                   n_kv_heads=None, remat=False, pos="learned"):
+                   n_kv_heads=None, remat=False, pos="learned",
+                   window=None):
     """Decoder-only causal LM over int token samples [T].
     ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
     rematerializes each block's activations in the backward pass
@@ -129,7 +130,8 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                             "d_ff": d_ff or 4 * d_model,
                             "causal": True, "dropout_ratio": dropout,
                             "impl": impl, "n_experts": n_experts,
-                            "remat": remat, "rope": pos == "rope"},
+                            "remat": remat, "rope": pos == "rope",
+                            "window": window},
                            **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
     layers.append(dict({"type": "timestep_dense",

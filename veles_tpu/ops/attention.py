@@ -17,6 +17,7 @@ one contract:
 All take [B, H, T, D] and return [B, H, T, D]; softmax math in f32
 regardless of input dtype."""
 
+import functools
 import math
 
 import jax
@@ -30,8 +31,10 @@ def _scale(d, scale=None):
     return 1.0 / math.sqrt(d) if scale is None else scale
 
 
-def attention(q, k, v, causal=False, scale=None, bias=None):
-    """Reference O(T²) attention.  q,k,v: [B, H, T, D]."""
+def attention(q, k, v, causal=False, scale=None, bias=None, window=None):
+    """Reference O(T²) attention.  q,k,v: [B, H, T, D].  ``window`` (with
+    causal) keeps only the last ``window`` positions per query — the
+    sliding-window mask."""
     *_, tq, d = q.shape
     tk = k.shape[-2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -40,15 +43,22 @@ def attention(q, k, v, causal=False, scale=None, bias=None):
     if bias is not None:
         s = s + bias
     if causal:
-        mask = (jnp.arange(tq)[:, None] + (tk - tq)) >= jnp.arange(tk)[None]
+        rows = jnp.arange(tq)[:, None] + (tk - tq)
+        cols = jnp.arange(tk)[None]
+        mask = rows >= cols
+        if window is not None:
+            mask = mask & (rows - cols < window)
         s = jnp.where(mask, s, NEG_INF)
+    elif window is not None:
+        raise ValueError("window requires causal=True")
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
-                        q_offset=0, k_offset=0, carry=None, return_carry=False):
+                        q_offset=0, k_offset=0, carry=None,
+                        return_carry=False, window=None):
     """Online-softmax attention scanning over key blocks.
 
     ``q_offset``/``k_offset`` are the *global* sequence positions of the
@@ -92,6 +102,8 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
         valid = kpos < (k_offset + tk)          # padding mask
         if causal:
             valid = valid[None, :] & (qpos[:, None] >= kpos[None, :])
+            if window is not None:
+                valid = valid & (qpos[:, None] - kpos[None, :] < window)
             s = jnp.where(valid, s, NEG_INF)
         else:
             s = jnp.where(valid, s, NEG_INF)
@@ -123,13 +135,17 @@ def finalize_attention(carry):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, backward="fused"):
-    """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D]."""
+                    block_k=128, interpret=None, backward="fused",
+                    window=None):
+    """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D].
+    ``window`` = sliding-window causal attention (blocks outside the
+    band are skipped entirely — O(T·window) compute)."""
     from veles_tpu.ops.pallas import flash
     return flash.flash_attention(q, k, v, causal=causal,
                                  scale=_scale(q.shape[-1], scale),
                                  block_q=block_q, block_k=block_k,
-                                 interpret=interpret, backward=backward)
+                                 interpret=interpret, backward=backward,
+                                 window=window)
 
 
 # ---------------------------------------------------------------------------
@@ -181,7 +197,7 @@ def _proj(x, w, b, policy):
 
 def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
                 attn_fn=None, policy=None, n_kv_heads=None,
-                use_rope=False):
+                use_rope=False, window=None):
     """x: [B, T, d_model] → [B, T, d_model].
 
     ``attn_fn(q, k, v, causal)`` overrides the core attention — this is the
@@ -190,7 +206,16 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
     attention inputs to the compute dtype (bf16 on the MXU).
     ``n_kv_heads`` enables GQA: k/v heads broadcast to the query heads
     before the core attention (same kernels, smaller projections).
-    ``use_rope`` rotates q/k by absolute position (rope())."""
+    ``use_rope`` rotates q/k by absolute position (rope()).
+    ``window`` = sliding-window causal attention (all impls share the
+    q - k < window mask)."""
+    if window is not None:
+        # validated here once — the per-backend behaviors differ
+        # (flash raises, blockwise/naive would silently ignore/degrade)
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError("window must be >= 1")
     if n_kv_heads is None:
         n_kv_heads = n_heads
     cast = (lambda t: t) if policy is None else policy.cast_in
@@ -218,12 +243,17 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
             attn_fn = flash_attention
         else:
             attn_fn = blockwise_attention
+        if window is not None:
+            attn_fn = functools.partial(attn_fn, window=window)
+    elif window is not None:
+        raise ValueError("window is not supported with sequence-"
+                         "parallel attention (impl=ring/ulysses)")
     o = attn_fn(q, k, v, causal=causal)
     return _proj(merge_heads(o), params["wo"], params["bo"], policy)
 
 
 def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
-             scale=None, policy=None, use_rope=False):
+             scale=None, policy=None, use_rope=False, window=None):
     """One incremental-decoding step with a KV cache.
 
     x: [B, 1, d_model] (the token at position ``pos``);
@@ -255,7 +285,10 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
                    preferred_element_type=jnp.float32)
     s = s * _scale(hd, scale)
     t_max = cache_k.shape[2]
-    live = jnp.arange(t_max)[None, None, None, :] <= pos
+    positions = jnp.arange(t_max)[None, None, None, :]
+    live = positions <= pos
+    if window is not None:
+        live = live & (pos - positions < window)
     s = jnp.where(live, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,bktd->bkgd", p.astype(cache_v.dtype), cache_v,

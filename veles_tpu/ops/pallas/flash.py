@@ -30,13 +30,13 @@ _LANES = 128          # m/l scratch padded to a full lane tile
 
 
 def _masked_scores(x_ref, y_ref, row_start, col_start, scale, causal, tk,
-                   rows_are_q):
-    """Scaled score tile xyᵀ with its padding+causal validity mask —
-    shared by the forward and both backward kernels so the three can
-    never desynchronize.  ``rows_are_q``: rows index queries and columns
-    keys (forward / dQ); False = the transposed dK/dV layout.  Dot
-    inputs keep their storage dtype (bf16 rides the MXU at full rate);
-    preferred_element_type pins f32 accumulation."""
+                   rows_are_q, window=None):
+    """Scaled score tile xyᵀ with its padding+causal(+sliding-window)
+    validity mask — shared by the forward and both backward kernels so
+    the three can never desynchronize.  ``rows_are_q``: rows index
+    queries and columns keys (forward / dQ); False = the transposed
+    dK/dV layout.  Dot inputs keep their storage dtype (bf16 rides the
+    MXU at full rate); preferred_element_type pins f32 accumulation."""
     s = jax.lax.dot_general(
         x_ref[0], y_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -46,11 +46,27 @@ def _masked_scores(x_ref, y_ref, row_start, col_start, scale, causal, tk,
     valid = k_idx < tk                  # key padding
     if causal:
         valid = valid & (q_idx >= k_idx)
+        if window is not None:
+            valid = valid & (q_idx - k_idx < window)
     return s, valid
 
 
+def _block_live(qi, ki, block_q, block_k, causal, window):
+    """Whether a (q-block, k-block) tile intersects the causal(+window)
+    band at all — dead tiles are skipped entirely (@pl.when)."""
+    if not causal:
+        return True
+    live = ki * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        # newest key in the block must still be inside the oldest
+        # query's window:  k_max >= q_min - window + 1
+        live = live & (ki * block_k + block_k - 1
+                       >= qi * block_q - window + 1)
+    return live
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
-            *, scale, causal, block_q, block_k, nk, tk):
+            *, scale, causal, block_q, block_k, nk, tk, window):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -60,14 +76,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         m[:] = jnp.full_like(m, NEG_INF)
         l[:] = jnp.zeros_like(l)
 
-    # causal: skip k blocks entirely above the diagonal
-    diag_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    # skip tiles entirely outside the causal(+window) band
+    live = _block_live(qi, ki, block_q, block_k, causal, window)
 
-    @pl.when(diag_ok)
+    @pl.when(live)
     def _():
         s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
                                   ki * block_k, scale, causal, tk,
-                                  rows_are_q=True)
+                                  rows_are_q=True, window=window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m[:, :1]
@@ -97,7 +113,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   nk, tk):
+                   nk, tk, window):
     """dQ: grid (bh, q-blocks, k-blocks), k innermost; dq accumulates in
     f32 VMEM scratch across the k sweep.
         p  = exp(s - lse);  dp = dO·Vᵀ;  ds = p⊙(dp - Δ)·scale
@@ -110,13 +126,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    diag_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    live = _block_live(qi, ki, block_q, block_k, causal, window)
 
-    @pl.when(diag_ok)
+    @pl.when(live)
     def _():
         s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
                                   ki * block_k, scale, causal, tk,
-                                  rows_are_q=True)
+                                  rows_are_q=True, window=window)
         p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -134,7 +150,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, nq, tk):
+                    block_q, block_k, nq, tk, window):
     """dK, dV: grid (bh, k-blocks, q-blocks), q innermost; both
     accumulators live in f32 VMEM scratch across the q sweep.
         pᵀ  = exp(sᵀ - lse);     dv += pᵀ·dO
@@ -148,14 +164,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q blocks entirely above this k block see none of it
-    diag_ok = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    live = _block_live(qi, ki, block_q, block_k, causal, window)
 
-    @pl.when(diag_ok)
+    @pl.when(live)
     def _():
         st, valid = _masked_scores(k_ref, q_ref, ki * block_k,
                                    qi * block_q, scale, causal, tk,
-                                   rows_are_q=False)          # [bk, bq]
+                                   rows_are_q=False,
+                                   window=window)             # [bk, bq]
         pt = jnp.where(valid, jnp.exp(st - lse_ref[0][None, :]), 0.0)
         do = do_ref[0]
         dv_acc[:] += jax.lax.dot_general(
@@ -187,7 +203,8 @@ def _pad_to(x, axis, mult):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, backward="fused"):
+                    block_k=128, interpret=None, backward="fused",
+                    window=None):
     """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
     default as every entry point in ops.attention).
 
@@ -199,6 +216,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     slower, kept as the cross-check oracle for the kernel tests."""
     if causal and q.shape[-2] != k.shape[-2]:
         raise ValueError("causal flash kernel assumes tq == tk")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        window = int(window)
     if not (q.dtype == k.dtype == v.dtype):
         # dot operands keep their storage dtype (MXU-native); mixed
         # inputs must be reconciled by the caller, not silently upcast
@@ -210,32 +233,35 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash_fn(causal, float(scale), block_q, block_k,
-                     autodetect_interpret(interpret), backward)(q, k, v)
+                     autodetect_interpret(interpret), backward,
+                     window)(q, k, v)
 
 
 @functools.lru_cache(maxsize=None)
-def _flash_fn(causal, scale, block_q, block_k, interpret, backward):
+def _flash_fn(causal, scale, block_q, block_k, interpret, backward,
+              window=None):
     from veles_tpu.ops import attention as att
 
     @jax.custom_vjp
     def f(q, k, v):
         out, _ = _forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+                          interpret, window)
         return out
 
     def fwd(q, k, v):
         out, lse = _forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, window)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
         q, k, v, out, lse = res
         if backward == "fused":
             return _backward(q, k, v, out, lse, g, causal, scale,
-                             block_q, block_k, interpret)
+                             block_q, block_k, interpret, window)
         _, vjp = jax.vjp(
             lambda q_, k_, v_: att.blockwise_attention(
-                q_, k_, v_, causal=causal, scale=scale), q, k, v)
+                q_, k_, v_, causal=causal, scale=scale,
+                window=window), q, k, v)
         return vjp(g)
 
     f.defvjp(fwd, bwd)
@@ -261,7 +287,8 @@ _SEMANTICS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
-def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _forward(q, k, v, causal, scale, block_q, block_k, interpret,
+             window=None):
     b, h, tq, d = q.shape
     tk = k.shape[-2]
     qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, block_q,
@@ -269,7 +296,8 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, tk=tk)
+        block_q=block_q, block_k=block_k, nk=nk, tk=tk,
+        window=window)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -299,7 +327,7 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-              interpret):
+              interpret, window=None):
     """FlashAttention-2 backward: Δ = rowsum(dO⊙O) in plain XLA (one
     fused elementwise+reduce), then the dQ kernel (k innermost) and the
     dK/dV kernel (q innermost).  Gradients come back in the inputs'
@@ -319,7 +347,8 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk, tk=tk),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          tk=tk, window=window),
         grid=(b * h, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
@@ -335,7 +364,8 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     kv_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, a, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq, tk=tk),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          tk=tk, window=window),
         grid=(b * h, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[kv_spec2, kv_spec2],
