@@ -73,9 +73,12 @@ class TestCLI:
                   "--config-list", "root.gpt.max_epochs=1",
                   "root.gpt.n_layers=1", "root.gpt.d_model=32",
                   "root.gpt.seq_len=32", "root.gpt.n_heads=4",
+                  "--generate", "the quick:8",
                   "--result-file", out])
         assert r.returncode == 0, r.stderr[-2000:]
         assert json.load(open(out))["epochs"] == 1
+        # repr may single- or double-quote depending on content
+        assert "generated: " in r.stdout and "the quick" in r.stdout
 
     def test_kohonen_sample(self):
         r = _cli(["samples/digits_kohonen.py", "--backend", "cpu",

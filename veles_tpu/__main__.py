@@ -65,6 +65,11 @@ class Main(object):
                        help="export trained model package to this path")
         p.add_argument("--serve", type=int, default=None, metavar="PORT",
                        help="after training, serve the model over REST")
+        p.add_argument("--generate", default=None,
+                       metavar="PROMPT[:MAX_NEW]",
+                       help="after training a causal LM, greedily decode "
+                       "MAX_NEW (default 32) byte tokens from PROMPT and "
+                       "print the result")
         p.add_argument("--web-status", type=int, default=None,
                        metavar="PORT", help="launch the status dashboard")
         p.add_argument("--backend", default=None,
@@ -235,9 +240,51 @@ class Main(object):
             from veles_tpu.services.export import export_workflow
             export_workflow(wf, args.export)
             print("exported -> %s" % args.export)
+        if args.generate is not None and wf is not None:
+            self._generate(wf, args.generate)
         if args.serve is not None and wf is not None:
             self._serve(wf, args.serve)
         return 0
+
+    @staticmethod
+    def _make_generator(wf, min_len=0):
+        """Guarded LMGenerator construction shared by --serve and
+        --generate: None unless the workflow is a causal-LM stack."""
+        if not any(layer.type == "transformer_block" and
+                   layer.cfg.get("causal") for layer in wf.trainer.layers):
+            return None
+        from veles_tpu.models.generate import LMGenerator
+        t0 = (wf.trainer.layers[0].input_shape[0]
+              if wf.trainer.layers[0].input_shape else 0)
+        if any(l.cfg.get("rope") for l in wf.trainer.layers):
+            t0 = max(t0, min_len)    # rope has no position-table bound
+        try:
+            cd = root.common.serve.get("cache_dtype", None)
+            import numpy as np
+            return LMGenerator(wf.trainer, max_len=t0,
+                               cache_dtype=None if cd is None
+                               else np.dtype(cd))
+        except ValueError:
+            return None              # not a generate-shaped stack
+
+    def _generate(self, wf, spec):
+        """--generate 'PROMPT[:MAX_NEW]' — byte-level decode from the
+        trained causal LM, printed to stdout."""
+        prompt, _, n = spec.rpartition(":")
+        if n.strip().isdigit() and prompt:
+            max_new = int(n)
+        else:                        # no numeric suffix: all is prompt
+            prompt, max_new = spec, 32
+        toks = list(prompt.encode("utf-8"))       # true byte-level
+        gen = self._make_generator(wf, min_len=len(toks) + max_new)
+        if gen is None:
+            raise SystemExit("--generate needs a causal transformer LM "
+                             "workflow (embedding ... transformer_block "
+                             "... timestep_dense)")
+        out = gen.generate([toks], max_new=max_new)
+        print("generated: %r" % bytes(
+            t if 0 <= t < 256 else 63 for t in out[0].tolist()
+        ).decode("utf-8", errors="replace"))
 
     def _apply_config(self, args):
         from veles_tpu.genetics.core import Range
@@ -526,24 +573,11 @@ class Main(object):
         from veles_tpu.services.restful import RESTfulAPI
         fwd = wf.forward_fn()
         params = wf.trainer.params
-        generator = None
-        if any(layer.type == "transformer_block" and
-               layer.cfg.get("causal") for layer in wf.trainer.layers):
-            try:
-                from veles_tpu.models.generate import LMGenerator
-                max_len = wf.trainer.layers[0].input_shape[0] \
-                    if wf.trainer.layers[0].input_shape else 0
-                # root.common.serve.cache_dtype='bfloat16' halves the
-                # serve-time KV-cache memory (docs/services.md)
-                cd = root.common.serve.get("cache_dtype", None)
-                generator = LMGenerator(
-                    wf.trainer, max_len=max_len,
-                    cache_dtype=None if cd is None else np.dtype(cd))
-            except ValueError:
-                generator = None    # not a generate-shaped stack
+        # root.common.serve.cache_dtype='bfloat16' halves the serve-time
+        # KV-cache memory (docs/services.md)
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
                          wf.trainer.layers[0].input_shape, port=port,
-                         generator=generator)
+                         generator=self._make_generator(wf))
         api.start()
         print("REST serving on port %d; Ctrl-C to stop" % api.port)
         try:
