@@ -102,7 +102,35 @@ struct NpyArray {
   }
 };
 
-// Parses NPY format v1/v2, little-endian <f4 or <f8, C order.
+// IEEE binary16 -> float (the reference's optional fp16->fp32 load
+// transform, libVeles numpy_array_loader.cc).
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (static_cast<uint32_t>(h) & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t man = h & 0x3FFu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;                       // +-0
+    } else {                             // subnormal: renormalize
+      // value = man * 2^-24; after s left-shifts the leading bit is
+      // implicit and the exponent is 2^(-14 - s) -> biased 113 - s
+      int shift = 0;
+      while ((man & 0x400u) == 0) { man <<= 1; ++shift; }
+      man &= 0x3FFu;
+      bits = sign | ((113 - shift) << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (man << 13);   // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+// Parses NPY format v1/v2, little-endian <f2, <f4 or <f8, C order.
 inline NpyArray ParseNpy(const std::string& bytes) {
   if (bytes.size() < 10 || std::memcmp(bytes.data(), "\x93NUMPY", 6) != 0)
     throw std::runtime_error("npy: bad magic");
@@ -124,8 +152,9 @@ inline NpyArray ParseNpy(const std::string& bytes) {
   if (header.find("'fortran_order': True") != std::string::npos)
     throw std::runtime_error("npy: fortran order unsupported");
   bool f8 = header.find("<f8") != std::string::npos;
-  if (!f8 && header.find("<f4") == std::string::npos)
-    throw std::runtime_error("npy: dtype must be <f4 or <f8");
+  bool f2 = header.find("<f2") != std::string::npos;
+  if (!f8 && !f2 && header.find("<f4") == std::string::npos)
+    throw std::runtime_error("npy: dtype must be <f2, <f4 or <f8");
   NpyArray arr;
   size_t sp = header.find("'shape':");
   size_t lp = header.find('(', sp), rp = header.find(')', lp);
@@ -145,7 +174,7 @@ inline NpyArray ParseNpy(const std::string& bytes) {
   }
   size_t n = arr.elements();
   size_t dstart = hstart + hlen;
-  size_t esize = f8 ? 8 : 4;
+  size_t esize = f8 ? 8 : (f2 ? 2 : 4);
   if (bytes.size() < dstart + n * esize)
     throw std::runtime_error("npy: truncated data");
   arr.data.resize(n);
@@ -154,6 +183,10 @@ inline NpyArray ParseNpy(const std::string& bytes) {
         reinterpret_cast<const double*>(bytes.data() + dstart);
     for (size_t i = 0; i < n; ++i)
       arr.data[i] = static_cast<float>(src[i]);
+  } else if (f2) {
+    const uint16_t* src =
+        reinterpret_cast<const uint16_t*>(bytes.data() + dstart);
+    for (size_t i = 0; i < n; ++i) arr.data[i] = HalfToFloat(src[i]);
   } else {
     std::memcpy(arr.data.data(), bytes.data() + dstart, n * 4);
   }

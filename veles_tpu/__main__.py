@@ -61,6 +61,11 @@ class Main(object):
                        "test/validation set")
         p.add_argument("--result-file", default=None,
                        help="write gather_results() JSON here")
+        p.add_argument("--export-dtype", default="float32",
+                       choices=("float32", "float16"),
+                       help="weight storage dtype for --export "
+                       "(float16 halves the package; the native "
+                       "runtime widens to f32 on load)")
         p.add_argument("--export", default=None,
                        help="export trained model package to this path")
         p.add_argument("--serve", type=int, default=None, metavar="PORT",
@@ -238,7 +243,7 @@ class Main(object):
 
         if args.export and wf is not None:
             from veles_tpu.services.export import export_workflow
-            export_workflow(wf, args.export)
+            export_workflow(wf, args.export, dtype=args.export_dtype)
             print("exported -> %s" % args.export)
         if args.generate is not None and wf is not None:
             self._generate(wf, args.generate)
@@ -281,6 +286,12 @@ class Main(object):
             raise SystemExit("--generate needs a causal transformer LM "
                              "workflow (embedding ... transformer_block "
                              "... timestep_dense)")
+        if len(toks) + max_new > gen.max_len:
+            raise SystemExit(
+                "--generate: prompt (%d bytes) + MAX_NEW (%d) exceeds "
+                "the model's position limit %d — shorten one, or train "
+                "with pos='rope' (no table bound)"
+                % (len(toks), max_new, gen.max_len))
         out = gen.generate([toks], max_new=max_new)
         print("generated: %r" % bytes(
             t if 0 <= t < 256 else 63 for t in out[0].tolist()

@@ -17,14 +17,19 @@ import numpy as np
 from veles_tpu import __version__
 
 
-def export_workflow(workflow, path):
+def export_workflow(workflow, path, dtype="float32"):
     """Write a StandardWorkflow-style trained model to ``path`` (.zip).
 
     contents.json schema:
       {"name", "framework", "version", "loss", "input_shape",
        "units": [{"name", "type", "config", "input_shape", "output_shape",
                   "arrays": {"weights": "file.npy", ...}}, ...]}
-    """
+
+    ``dtype="float16"`` halves the package: weights are stored <f2 and
+    the native runtime widens them to f32 on load (the reference's
+    optional fp16→fp32 transform, libVeles numpy_array_loader.cc)."""
+    if dtype not in ("float32", "float16"):
+        raise ValueError("dtype must be float32 or float16")
     trainer = workflow.trainer
     host = trainer.host_params()
     units = []
@@ -58,7 +63,7 @@ def export_workflow(workflow, path):
         zf.writestr("contents.json", json.dumps(manifest, indent=2))
         for fname, arr in files.items():
             buf = io.BytesIO()
-            np.save(buf, np.ascontiguousarray(arr, dtype=np.float32))
+            np.save(buf, np.ascontiguousarray(arr, dtype=dtype))
             zf.writestr(fname, buf.getvalue())
     return path
 

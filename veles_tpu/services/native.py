@@ -17,12 +17,14 @@ _lib = None
 
 
 def build(force=False):
-    """Build libveles_native.so via make (g++ is in the base image)."""
+    """Build libveles_native.so via make (g++ is in the base image).
+    Always invokes make — the Makefile's header dependencies make the
+    call a no-op when the .so is current, and a rebuild when any source
+    changed (a stale committed .so must never mask source edits)."""
     if force and os.path.exists(_LIB_PATH):
         os.remove(_LIB_PATH)
-    if not os.path.exists(_LIB_PATH):
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True)
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
     return _LIB_PATH
 
 
