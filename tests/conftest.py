@@ -24,3 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def f32_precision():
+    """Force f32 compute (precision_level 1) for tests that compare two
+    computation paths tightly — under the default bf16 policy, different
+    matmul groupings alone produce ~1e-2 disagreement."""
+    from veles_tpu.config import root
+    prev = root.common.engine.get("precision_level", 0)
+    root.common.engine.precision_level = 1
+    try:
+        yield
+    finally:
+        root.common.engine.precision_level = prev

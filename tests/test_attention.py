@@ -218,10 +218,9 @@ def test_flash_mixed_dtypes_rejected():
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
-def test_rope_with_sequence_parallel_mha(impl):
+def test_rope_with_sequence_parallel_mha(impl, f32_precision):
     """RoPE rotates the GLOBAL q/k before the seq-parallel shard_map, so
     ring/Ulysses attention under rope must match the single-device path."""
-    from veles_tpu.config import root
     from veles_tpu.models.layers import make_layer
     from veles_tpu import prng
 
@@ -241,14 +240,8 @@ def test_rope_with_sequence_parallel_mha(impl):
         params = layer.init_params(prng.get("w"))
         return np.asarray(layer.apply(params, x))
 
-    # f32 compute: the two paths group matmuls differently, so the
-    # default bf16 policy alone costs ~1e-2 of disagreement
-    root.common.engine.precision_level = 1
-    try:
-        got = out_for(impl, True)
-        want = out_for("blockwise", False)
-    finally:
-        root.common.engine.precision_level = 0
+    got = out_for(impl, True)
+    want = out_for("blockwise", False)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 

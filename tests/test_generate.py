@@ -39,24 +39,16 @@ def _lm_workflow(max_epochs=0, vocab=13, t=16, seed=31, **zoo_kwargs):
 
 @pytest.mark.parametrize("zoo_kwargs", [
     {}, {"n_kv_heads": 2}, {"pos": "rope"}])
-def test_incremental_matches_full_forward(zoo_kwargs):
-    # f32 compute for a tight oracle: under the default bf16 policy the
-    # two paths group their matmuls differently, so bf16 rounding alone
-    # produces ~1e-2 logit differences
-    from veles_tpu.config import root
-    root.common.engine.precision_level = 1
-    try:
-        wf, toks = _lm_workflow(max_epochs=0, **zoo_kwargs)
-        gen = LMGenerator(wf.trainer, max_len=16)
-        sample = toks[:4]
-        inc = gen.score(sample)                  # [B, T-1, V]
-        full = np.asarray(
-            jax.jit(wf.trainer._forward, static_argnums=(2,))(
-                wf.trainer.params, jnp.asarray(sample), False,
-                jax.random.key(0)), np.float32)[:, :-1]
-        np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
-    finally:
-        root.common.engine.precision_level = 0
+def test_incremental_matches_full_forward(zoo_kwargs, f32_precision):
+    wf, toks = _lm_workflow(max_epochs=0, **zoo_kwargs)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    sample = toks[:4]
+    inc = gen.score(sample)                      # [B, T-1, V]
+    full = np.asarray(
+        jax.jit(wf.trainer._forward, static_argnums=(2,))(
+            wf.trainer.params, jnp.asarray(sample), False,
+            jax.random.key(0)), np.float32)[:, :-1]
+    np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
 
 
 def test_greedy_generation_continues_pattern():
@@ -171,19 +163,14 @@ def test_beam_search_matches_greedy_at_beam1_and_scores_exactly():
         gen.beam_search(prompt, max_new=6, beam=0)
 
 
-def test_incremental_matches_full_forward_window():
+def test_incremental_matches_full_forward_window(f32_precision):
     """Sliding-window stack: the KV-cache step must apply the same
     window mask the training forward uses."""
-    from veles_tpu.config import root
-    root.common.engine.precision_level = 1
-    try:
-        wf, toks = _lm_workflow(max_epochs=0, window=5)
-        gen = LMGenerator(wf.trainer, max_len=16)
-        inc = gen.score(toks[:4])
-        full = np.asarray(
-            jax.jit(wf.trainer._forward, static_argnums=(2,))(
-                wf.trainer.params, jnp.asarray(toks[:4]), False,
-                jax.random.key(0)), np.float32)[:, :-1]
-        np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
-    finally:
-        root.common.engine.precision_level = 0
+    wf, toks = _lm_workflow(max_epochs=0, window=5)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    inc = gen.score(toks[:4])
+    full = np.asarray(
+        jax.jit(wf.trainer._forward, static_argnums=(2,))(
+            wf.trainer.params, jnp.asarray(toks[:4]), False,
+            jax.random.key(0)), np.float32)[:, :-1]
+    np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
