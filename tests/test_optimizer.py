@@ -1,0 +1,95 @@
+"""Solver semantics (models.optimizer): each update rule against a
+hand-computed single-step oracle, plus the adam-vs-adamw decoupling."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.models import optimizer
+
+
+def _one_step(solver, w0, g, wd=0.0, lr=0.1, leaf="weights", **extra):
+    params = {"l": {leaf: jnp.asarray(w0)}}
+    grads = {"l": {leaf: jnp.asarray(g)}}
+    state = optimizer.init_state(params)
+    hyper = optimizer.resolve_hyper(
+        dict({"solver": solver, "learning_rate": lr, "weights_decay": wd},
+             **extra))
+    params, state = optimizer.update(params, grads, state, {"l": hyper})
+    return np.asarray(params["l"][leaf]), state
+
+
+def test_gd_momentum_first_step():
+    w, _ = _one_step("gd", [1.0, -2.0], [0.5, 0.5], wd=0.0)
+    np.testing.assert_allclose(w, [1.0 - 0.05, -2.0 - 0.05], rtol=1e-6)
+
+
+def test_adam_first_step_is_lr_sized():
+    # bias correction makes |step| ~= lr regardless of gradient scale
+    w, _ = _one_step("adam", [1.0], [1e-3])
+    np.testing.assert_allclose(w, [1.0 - 0.1], rtol=1e-3)
+    w2, _ = _one_step("adam", [1.0], [100.0])
+    np.testing.assert_allclose(w2, [1.0 - 0.1], rtol=1e-3)
+
+
+def test_adamw_decouples_decay():
+    # zero gradient: adamw still decays the weight by lr*wd; adam's
+    # coupled decay passes through the adaptive rescale instead
+    w_adamw, _ = _one_step("adamw", [2.0], [0.0], wd=0.01)
+    np.testing.assert_allclose(w_adamw, [2.0 - 0.1 * 0.01 * 2.0],
+                               rtol=1e-5)
+    # with gradient: adamw step = adam step (wd=0) + decay term
+    w_adam0, _ = _one_step("adam", [2.0], [0.5], wd=0.0)
+    w_w, _ = _one_step("adamw", [2.0], [0.5], wd=0.01)
+    np.testing.assert_allclose(w_w, w_adam0 - 0.1 * 0.01 * 2.0, rtol=1e-5)
+
+
+def test_adamw_exempts_bias_from_decay_by_default():
+    # zero gradient on a BIAS leaf: adamw must not decay it
+    b, _ = _one_step("adamw", [2.0], [0.0], wd=0.01, leaf="bias")
+    np.testing.assert_allclose(b, [2.0], rtol=1e-7)
+    # explicit weights_decay_bias opts back in
+    b2, _ = _one_step("adamw", [2.0], [0.0], wd=0.01, leaf="bias",
+                      weights_decay_bias=0.01)
+    np.testing.assert_allclose(b2, [2.0 - 0.1 * 0.01 * 2.0], rtol=1e-5)
+
+
+def test_unknown_solver_rejected():
+    with pytest.raises(ValueError, match="unknown solver"):
+        optimizer.resolve_hyper({"solver": "adamW"})
+
+
+def test_adagrad_shrinks_with_history():
+    w, state = _one_step("adagrad", [1.0], [1.0])
+    np.testing.assert_allclose(w, [1.0 - 0.1 * 1.0 / (1.0 + 1e-8)],
+                               rtol=1e-5)
+
+
+def test_rprop_sign_steps():
+    w, _ = _one_step("rprop", [1.0, 1.0], [0.3, -0.7])
+    np.testing.assert_allclose(w, [1.0 - 0.1, 1.0 + 0.1], rtol=1e-6)
+
+
+def test_adamw_trains_transformer():
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models import zoo
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    prng.seed_all(51)
+    r = np.random.RandomState(2)
+    toks = ((np.arange(16)[None, :] * 3 + r.randint(0, 5, 192)[:, None])
+            % 17).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=48,
+                             class_lengths=[0, 48, 144])
+    wf = StandardWorkflow(
+        layers=zoo.transformer_lm(vocab_size=17, d_model=32, n_heads=4,
+                                  n_layers=1, lr=5e-3, solver="adamw"),
+        loader=loader, loss="lm",
+        gd_defaults={"weights_decay": 0.01},
+        decision_config={"max_epochs": 15}, name="adamw-lm")
+    wf.initialize()
+    wf.run()
+    assert wf.decision.best_metric < 0.15, wf.decision.best_metric

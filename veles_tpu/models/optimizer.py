@@ -12,6 +12,12 @@ Solvers, selectable per layer via ``solver``:
                   w  += v
 - ``adam``    bias-corrected Adam (new capability — transformers don't
               train well under momentum-SGD)
+- ``adamw``   Adam with DECOUPLED weight decay (Loshchilov & Hutter):
+              weights_decay acts directly on w, outside the adaptive
+              rescaling — the standard transformer-LM recipe.  Biases /
+              norm shifts are not decayed unless weights_decay_bias is
+              set explicitly, and l1_vs_l2 does not apply (decoupled
+              decay is inherently L2-shaped)
 - ``adagrad`` accumulated squared gradients
 - ``rprop``   sign-based resilient propagation (ref RPropAll2All):
               per-weight step grows ×1.2 on agreeing signs, shrinks ×0.5
@@ -50,9 +56,16 @@ def resolve_hyper(layer_gd, workflow_gd=None):
     if workflow_gd:
         h.update({k: v for k, v in workflow_gd.items() if k in DEFAULTS})
     h.update({k: v for k, v in layer_gd.items() if k in DEFAULTS})
+    if h["solver"] not in ("gd", "adam", "adamw", "adagrad", "rprop"):
+        raise ValueError("unknown solver %r (gd|adam|adamw|adagrad|rprop)"
+                         % (h["solver"],))
     for k in ("learning_rate", "weights_decay", "gradient_moment"):
         if h[k + "_bias"] is None:
-            h[k + "_bias"] = h[k]
+            # adamw convention: biases / norm shifts are NOT decayed
+            # unless weights_decay_bias is given explicitly
+            h[k + "_bias"] = (0.0 if (k == "weights_decay"
+                                      and h["solver"] == "adamw")
+                              else h[k])
     return h
 
 
@@ -64,14 +77,19 @@ def init_state(params):
 
 def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h):
     reg = (1.0 - l1) * w + l1 * jnp.sign(w)
-    if solver == "adam":
+    if solver in ("adam", "adamw"):
         b1, b2, eps = h["adam_beta1"], h["adam_beta2"], h["epsilon"]
         m = b1 * s1 + (1.0 - b1) * g
         v = b2 * s2 + (1.0 - b2) * g * g
         t = step.astype(jnp.float32)
         mhat = m / (1.0 - b1 ** t)
         vhat = v / (1.0 - b2 ** t)
-        return (w - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * reg), m, v)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if solver == "adamw":
+            # decoupled weight decay (Loshchilov & Hutter): decay acts
+            # on the weight directly, outside the adaptive rescaling
+            return (w - lr * upd - lr * wd * w, m, v)
+        return (w - lr * (upd + wd * reg), m, v)
     if solver == "adagrad":
         v = s2 + g * g
         return (w - lr * (g / (jnp.sqrt(v) + h["epsilon"]) + wd * reg),
