@@ -157,6 +157,19 @@ class TestCLIMeta:
         assert res["epochs"] == 2
         assert res["best_metric"] is not None
 
+    def test_fsdp_flag_runs(self, tmp_path):
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
+                  "--backend", "cpu", "--random-seed", "5",
+                  "--mesh", "data=8", "--fsdp",
+                  "--config-list", "root.digits.max_epochs=2",
+                  "root.digits.minibatch_size=96",
+                  "--result-file", out],
+                 env_extra={"XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=8"})
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 2
+
     def test_mesh_flag_bad_spec(self):
         r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
                   "--mesh", "data"])

@@ -83,6 +83,10 @@ class Main(object):
                        help="device mesh for SPMD training, e.g. "
                        "'data=4,model=2' (-1 = all remaining devices); "
                        "ref launcher node specs -n host/0:0x3")
+        p.add_argument("--fsdp", action="store_true",
+                       help="fully shard parameters and optimizer state "
+                       "over the data axis (ZeRO-3 style; composes with "
+                       "--mesh model= tensor parallelism)")
         p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                        help="jax.distributed coordinator address "
                        "(multi-host SPMD; ref master -l flag)")
@@ -346,7 +350,8 @@ class Main(object):
         self.launcher = Launcher(
             workflow=wf, mesh_axes=self._parse_mesh(args.mesh),
             coordinator_address=args.coordinator,
-            num_processes=args.num_processes, process_id=args.process_id)
+            num_processes=args.num_processes, process_id=args.process_id,
+            fsdp=args.fsdp)
         return self.launcher
 
     # -------------------------------------------------- meta: genetics / GA

@@ -48,7 +48,8 @@ def filter_argv(argv, *flags):
 class Launcher(Logger):
     def __init__(self, workflow=None, mode=None, coordinator_address=None,
                  num_processes=None, process_id=None, mesh_axes=None,
-                 web_status_port=None, graphics_endpoint=None, **kwargs):
+                 web_status_port=None, graphics_endpoint=None, fsdp=False,
+                 **kwargs):
         super(Launcher, self).__init__(**kwargs)
         self.workflow = workflow
         self.coordinator_address = (coordinator_address or
@@ -62,6 +63,7 @@ class Launcher(Logger):
                                self.num_processes > 1) else "standalone")
         self.mode = mode
         self.mesh_axes = mesh_axes
+        self.fsdp = fsdp
         self.mesh_config = None
         self.web_status_port = web_status_port
         self.graphics_endpoint = graphics_endpoint
@@ -96,7 +98,11 @@ class Launcher(Logger):
                 num_processes=self.num_processes,
                 process_id=self.process_id)
         if self.mesh_axes:
-            self.mesh_config = MeshConfig(make_mesh(self.mesh_axes))
+            self.mesh_config = MeshConfig(make_mesh(self.mesh_axes),
+                                          fsdp=self.fsdp)
+        elif self.fsdp:
+            self.warning("--fsdp ignored: no --mesh given (parameters "
+                         "shard over the mesh's data axis)")
         if jax.process_count() > 1 and self.workflow is not None:
             self._verify_checksum()
         if self.is_master:

@@ -48,11 +48,17 @@ def make_mesh(axes=None, devices=None):
 
 @dataclasses.dataclass
 class MeshConfig:
-    """Axis naming convention shared by trainer/loader/sharding rules."""
+    """Axis naming convention shared by trainer/loader/sharding rules.
+
+    ``fsdp=True`` fully shards parameters (and optimizer state) over the
+    data axis in addition to data-parallel batches — ZeRO-3-style: 1/D
+    of every weight per worker, all-gather on use, reduce-scatter on
+    gradients, all inserted by GSPMD from the sharding annotations."""
 
     mesh: Mesh
     data_axis: str = "data"
     model_axis: str = "model"
+    fsdp: bool = False
 
     @property
     def data_size(self):

@@ -13,7 +13,13 @@ the data axis; the HBM-resident dataset and labels are replicated (each
 shard gathers its own rows).  With params replicated on the data axis and
 batch sharded, XLA inserts the gradient ``psum`` over ICI — the TPU-native
 equivalent of the reference's master-apply of slave gradient deltas
-(veles/workflow.py:529 apply_data_from_slave)."""
+(veles/workflow.py:529 apply_data_from_slave).
+
+FSDP rule (``MeshConfig(fsdp=True)`` / ``--fsdp``): parameters (and
+their optimizer state) additionally shard their FIRST dim over the data
+axis where it divides — ZeRO-3: 1/D of the model per worker, GSPMD
+inserts the all-gather before use and a reduce-scatter (not psum) on
+the gradients."""
 
 import jax
 import jax.numpy as jnp
@@ -21,17 +27,26 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def param_spec(shape, mesh_cfg):
-    """PartitionSpec for one parameter tensor under the model axis."""
-    axis = mesh_cfg.model_axis
-    size = mesh_cfg.model_size
-    if size <= 1 or not shape:
+    """PartitionSpec for one parameter tensor: model axis on the output
+    (last) dim — Megatron column parallelism — and, when the mesh config
+    asks for ``fsdp``, the data axis on the first dim (ZeRO-3-style fully
+    sharded params: each data-parallel worker stores 1/D of every weight
+    and its optimizer state; GSPMD inserts the all-gather before use and
+    the reduce-scatter on the gradient).  Dims that don't divide stay
+    replicated — correctness never depends on divisibility."""
+    if not shape:
         return P()
-    out_dim = len(shape) - 1
-    if shape[out_dim] % size == 0:
-        spec = [None] * len(shape)
-        spec[out_dim] = axis
-        return P(*spec)
-    return P()
+    spec = [None] * len(shape)
+    m_size = mesh_cfg.model_size
+    if m_size > 1 and shape[-1] % m_size == 0:
+        spec[-1] = mesh_cfg.model_axis
+    d_size = mesh_cfg.data_size
+    if (getattr(mesh_cfg, "fsdp", False) and d_size > 1
+            and spec[0] is None and shape[0] % d_size == 0):
+        spec[0] = mesh_cfg.data_axis
+    while spec and spec[-1] is None:    # canonical: no trailing Nones
+        spec.pop()
+    return P(*spec)
 
 
 def _safe_spec(shape, spec, mesh_cfg):
