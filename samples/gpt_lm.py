@@ -65,6 +65,7 @@ def run(load, main):
              n_experts=cfg.get("n_experts", 0),
              lr=cfg.get("learning_rate", 1e-3)),
          loader=loader, loss="lm",
+         gd_defaults={"clip_norm": cfg.get("clip_norm", 1.0)},
          decision_config={"max_epochs": cfg.get("max_epochs", 20)},
          name="gpt-lm")
     main()

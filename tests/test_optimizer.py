@@ -93,3 +93,71 @@ def test_adamw_trains_transformer():
     wf.initialize()
     wf.run()
     assert wf.decision.best_metric < 0.15, wf.decision.best_metric
+
+
+def test_clip_by_global_norm():
+    g = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([0.0])}  # norm 5
+    clipped = optimizer.clip_by_global_norm(g, 1.0)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [0.6, 0.8],
+                               rtol=1e-6)
+    # already inside the bound: untouched
+    same = optimizer.clip_by_global_norm(g, 10.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), [3.0, 4.0],
+                               rtol=1e-6)
+
+
+def test_clip_norm_applied_in_training():
+    """clip_norm in gd_defaults reaches optimizer.update: a near-zero
+    clip freezes the params; a generous clip leaves training
+    untouched."""
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    def run(gd_defaults, lr, seed=61):
+        prng.seed_all(seed)
+        r = np.random.RandomState(1)
+        x = r.rand(256, 16).astype(np.float32)
+        y = r.randint(0, 4, 256).astype(np.int32)
+        loader = FullBatchLoader(None, data=x, labels=y,
+                                 minibatch_size=64,
+                                 class_lengths=[0, 64, 192])
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                     "learning_rate": lr},
+                    {"type": "softmax", "output_sample_shape": 4,
+                     "learning_rate": lr}],
+            loader=loader, gd_defaults=gd_defaults,
+            decision_config={"max_epochs": 4}, name="clip-t")
+        wf.initialize()
+        w0 = np.array(wf.trainer.host_params()[
+            wf.trainer.layers[0].name]["weights"])
+        wf.run()
+        w1 = np.array(wf.trainer.host_params()[
+            wf.trainer.layers[0].name]["weights"])
+        return (wf.decision.epoch_metrics[2]["loss"],
+                float(np.abs(w1 - w0).max()))
+
+    _, moved = run({}, lr=0.1)
+    _, frozen = run({"clip_norm": 1e-8}, lr=0.1)
+    assert moved > 1e-3, moved               # normal training moves
+    assert frozen < 1e-6, frozen             # clipped-to-nothing doesn't
+    # generous clip on a sane run: identical result (norm never reached)
+    a, _ = run({}, lr=0.1)
+    b, _ = run({"clip_norm": 1e6}, lr=0.1)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_clip_norm_zero_means_disabled():
+    g = {"l": {"weights": jnp.asarray([3.0, 4.0])}}
+    p = {"l": {"weights": jnp.asarray([1.0, 1.0])}}
+    hy = {"l": optimizer.resolve_hyper({"learning_rate": 0.1})}
+    p0, _ = optimizer.update(p, g, optimizer.init_state(p), hy,
+                             clip_norm=0)
+    p1, _ = optimizer.update(p, g, optimizer.init_state(p), hy,
+                             clip_norm=None)
+    np.testing.assert_array_equal(np.asarray(p0["l"]["weights"]),
+                                  np.asarray(p1["l"]["weights"]))
+    with pytest.raises(ValueError, match="positive"):
+        optimizer.update(p, g, optimizer.init_state(p), hy,
+                         clip_norm=-1.0)

@@ -100,6 +100,10 @@ class Launcher(Logger):
         if self.mesh_axes:
             self.mesh_config = MeshConfig(make_mesh(self.mesh_axes),
                                           fsdp=self.fsdp)
+            if self.fsdp and self.mesh_config.data_size <= 1:
+                self.warning("--fsdp has no effect: the mesh has no "
+                             "data axis larger than 1 (got %s)",
+                             dict(self.mesh_config.mesh.shape))
         elif self.fsdp:
             self.warning("--fsdp ignored: no --mesh given (parameters "
                          "shard over the mesh's data axis)")

@@ -64,7 +64,12 @@ class StagedTrainer(Unit):
         super(StagedTrainer, self).__init__(workflow, **kwargs)
         self.layers = layers
         self.loss = loss
-        self.gd_defaults = gd_defaults or {}
+        self.gd_defaults = dict(gd_defaults or {})   # caller's dict stays
+        #: global gradient-norm clip applied to the WHOLE grad tree
+        #: before the per-layer updates (gd_defaults["clip_norm"]; a
+        #: workflow-level knob — per-layer clipping would change the
+        #: norm's meaning)
+        self.clip_norm = self.gd_defaults.pop("clip_norm", None)
         #: fuse this many minibatch steps into ONE device dispatch
         #: (lax.scan inside the jitted sweep).  Amortizes host→device
         #: dispatch latency — the dominant cost for small models and for
@@ -227,7 +232,8 @@ class StagedTrainer(Unit):
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers, lr_scale=lr_scale)
+                                                hypers, lr_scale=lr_scale,
+                                                clip_norm=self.clip_norm)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -355,7 +361,8 @@ class StagedTrainer(Unit):
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers, lr_scale=lr_scale)
+                                                hypers, lr_scale=lr_scale,
+                                                clip_norm=self.clip_norm)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 

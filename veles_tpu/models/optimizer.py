@@ -144,9 +144,27 @@ def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0):
     return pick(0), pick(1), pick(2)
 
 
-def update(params, grads, state, hypers, lr_scale=1.0):
+def clip_by_global_norm(grads, max_norm):
+    """Scale the whole gradient pytree so its global L2 norm is at most
+    ``max_norm`` (the standard transformer stabilizer).  Traced-safe."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None):
     """Whole-model update.  ``params`` is {layer_name: {param: array}};
-    ``hypers`` is {layer_name: resolved hyper dict}."""
+    ``hypers`` is {layer_name: resolved hyper dict}.  ``clip_norm``
+    rescales the FULL gradient tree to that global L2 norm first
+    (None or 0 = disabled — 0 would freeze training)."""
+    if clip_norm:
+        if clip_norm < 0:
+            raise ValueError("clip_norm must be positive, got %r"
+                             % (clip_norm,))
+        grads = clip_by_global_norm(grads, float(clip_norm))
     step = state["step"] + 1
     new_p, new_s1, new_s2 = {}, {}, {}
     for lname in params:
