@@ -189,9 +189,11 @@ def phase_alexnet():
 
 def phase_lm():
     """Causal transformer LM training throughput (tokens/sec/chip) — the
-    beyond-parity flagship: GPT-style decoder (25M params, T=1024, Pallas
-    flash attention, bf16 MXU compute) through the SAME StandardWorkflow
-    hot loop as every other model, with the fused k-step dispatch."""
+    beyond-parity flagship: GPT-style decoder (~25M params, T=1024,
+    Pallas flash attention + fused FA2 backward, RoPE, GQA, AdamW with
+    global-norm clipping, bf16 MXU compute) through the SAME
+    StandardWorkflow hot loop as every other model, with the fused
+    k-step dispatch."""
     import numpy as np
     from veles_tpu import prng
     from veles_tpu.loader.fullbatch import FullBatchLoader
@@ -208,9 +210,11 @@ def phase_lm():
                              class_lengths=[0, 0, n])
     wf = StandardWorkflow(
         layers=transformer_lm(vocab_size=8192, d_model=512, n_heads=8,
-                              n_layers=8, dropout=0.0, impl="flash",
+                              n_kv_heads=2, n_layers=8, dropout=0.0,
+                              impl="flash", pos="rope", solver="adamw",
                               lr=1e-3),
         loader=loader, loss="lm",
+        gd_defaults={"clip_norm": 1.0},
         decision_config={"max_epochs": 1000},
         steps_per_dispatch=5, name="bench-lm")
     wf.initialize()
