@@ -20,11 +20,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main():
     coordinator, num_processes, process_id = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
-    # optional 4th arg: a snapshot dir → run tensor-parallel over a
-    # cross-process 'model' axis with a per-epoch snapshotter (proves
+    # remaining args: "--fsdp" (ZeRO-3 over the cross-process data axis)
+    # and/or a snapshot dir.  A snapshot dir without --fsdp runs
+    # tensor-parallel over a cross-process 'model' axis (proves
     # multi-host checkpointing: params sharded across processes gather
-    # via process_allgather; only process 0 writes)
-    snap_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    # via process_allgather; only process 0 writes); with --fsdp the
+    # checkpoint gathers ZeRO-3 shards instead.
+    rest = sys.argv[4:]
+    fsdp = "--fsdp" in rest
+    dirs = [a for a in rest if not a.startswith("--")]
+    snap_dir = dirs[0] if dirs else None
     # 4 local devices per process -> 8 global over 2 processes (overwrite
     # any inherited XLA_FLAGS — the pytest conftest forces 8 per process)
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -55,14 +60,14 @@ def main():
         snapshotter_config=(None if snap_dir is None else
                             {"interval": 1, "directory": snap_dir}),
         name="multihost-digits")
-    if wf.snapshotter is None:
+    if fsdp or wf.snapshotter is None:
         mesh_axes = {"data": -1}
     else:
         mesh_axes = {"model": -1}   # params shard ACROSS processes
 
     launcher = Launcher(workflow=wf, coordinator_address=coordinator,
                         num_processes=num_processes, process_id=process_id,
-                        mesh_axes=mesh_axes)
+                        mesh_axes=mesh_axes, fsdp=fsdp)
     launcher.initialize()
     assert launcher.mode == "spmd"
     n_devices = len(jax.devices())
@@ -78,10 +83,12 @@ def main():
         "n_errors": m["n_errors"],
         "best_metric": wf.decision.best_metric,
     }
-    if wf.snapshotter is not None:
-        result["snapshot"] = wf.snapshotter.destination
+    if wf.snapshotter is not None or fsdp:
+        if wf.snapshotter is not None:
+            result["snapshot"] = wf.snapshotter.destination
         w = wf.trainer.params[wf.trainer.layers[0].name]["weights"]
         result["weights_addressable"] = bool(w.is_fully_addressable)
+        result["weights_spec"] = str(w.sharding.spec)
     print("METRICS " + json.dumps(result), flush=True)
 
 

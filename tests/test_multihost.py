@@ -110,3 +110,23 @@ def test_multihost_tensor_parallel_checkpoint(tmp_path):
     assert snap["epoch"] == 2
     w = snap["params"]["l00_all2all_tanh"]["weights"]
     assert w.shape == (64, 32)     # full tensor, not a local shard
+
+
+def test_multihost_fsdp_shards_params_and_checkpoints(tmp_path):
+    """ZeRO-3 over a cross-process data axis: each process holds only its
+    1/8 parameter shards (not fully addressable), metrics still match,
+    and the snapshotter gathers the shards into one checkpoint (the
+    process_allgather path ZeRO sharding makes interesting)."""
+    r0, r1 = _spawn_job(2, extra=("--fsdp", str(tmp_path)))
+    assert r0["n_global_devices"] == 8
+    assert r0["loss"] == r1["loss"]
+    assert r0["n_errors"] == r1["n_errors"]
+    for r in (r0, r1):
+        assert r["weights_addressable"] is False, r
+        assert "data" in r["weights_spec"], r["weights_spec"]
+    # only process 0 wrote; the checkpoint holds the FULL gathered params
+    assert r0["snapshot"] and os.path.exists(r0["snapshot"])
+    from veles_tpu.services.snapshotter import SnapshotterBase
+    snap = SnapshotterBase.import_(r0["snapshot"])
+    w = np.asarray(snap["params"]["l00_all2all_tanh"]["weights"])
+    assert w.shape == (64, 32)
