@@ -174,3 +174,18 @@ def test_incremental_matches_full_forward_window(f32_precision):
             wf.trainer.params, jnp.asarray(toks[:4]), False,
             jax.random.key(0)), np.float32)[:, :-1]
     np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
+
+
+def test_generation_with_tied_embeddings(f32_precision):
+    wf, toks = _lm_workflow(max_epochs=0, tie_embeddings=True)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    inc = gen.score(toks[:4])
+    full = np.asarray(
+        jax.jit(wf.trainer._forward, static_argnums=(2,))(
+            wf.trainer.params, jnp.asarray(toks[:4]), False,
+            jax.random.key(0)), np.float32)[:, :-1]
+    np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
+    # temperature sampling path (logit scaling, not weight scaling)
+    a = gen.generate(toks[:2, :6], max_new=4, temperature=0.8, seed=2)
+    b = gen.generate(toks[:2, :6], max_new=4, temperature=0.8, seed=2)
+    np.testing.assert_array_equal(a, b)

@@ -135,3 +135,26 @@ def test_rope_lm_trains():
 def test_sliding_window_lm_trains():
     wf = _train_lm(max_epochs=12, window=6, impl="flash")
     assert wf.decision.best_metric < 0.2, wf.decision.best_metric
+
+
+def test_tied_embeddings_lm():
+    """Weight tying: no separate head params, gradients reach the table
+    through both uses, and the model still learns."""
+    wf_tied = _train_lm(max_epochs=12, tie_embeddings=True)
+    wf_free = _train_lm(max_epochs=1)
+    assert wf_tied.decision.best_metric < 0.2, wf_tied.decision.best_metric
+    head_names = [l.name for l in wf_tied.trainer.layers
+                  if l.type == "tied_lm_head"]
+    assert head_names and head_names[0] not in wf_tied.trainer.params
+    n_tied = sum(np.prod(a.shape) for lp in
+                 wf_tied.trainer.host_params().values()
+                 for a in _leaves(lp))
+    n_free = sum(np.prod(a.shape) for lp in
+                 wf_free.trainer.host_params().values()
+                 for a in _leaves(lp))
+    assert n_free - n_tied >= 17 * 32    # one vocab x d_model table saved
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)

@@ -1,7 +1,8 @@
 """Autoregressive LM generation with a KV cache (incremental decoding).
 
 Serve-time counterpart of the ``transformer_lm`` zoo stack (embedding →
-positional_encoding → transformer_block* → layer_norm → timestep_dense).
+[positional_encoding] → transformer_block* → layer_norm →
+timestep_dense | tied_lm_head).
 Each step feeds ONE token through the stack against per-block KV caches
 ([B, n_kv_heads, T_max, head_dim] — GQA stores only the kv heads, so its
 smaller KV state is realized here), inside a single jitted ``lax.scan``
@@ -43,7 +44,7 @@ class LMGenerator:
                 self._blocks.append(layer)
             else:
                 by_type.setdefault(layer.type, layer)
-        for need in ("embedding", "layer_norm", "timestep_dense"):
+        for need in ("embedding", "layer_norm"):
             if need not in by_type:
                 raise ValueError(
                     "LMGenerator needs a transformer_lm-shaped stack "
@@ -54,7 +55,11 @@ class LMGenerator:
         self._embed = by_type["embedding"]
         self._posenc = by_type.get("positional_encoding")
         self._ln = by_type["layer_norm"]
-        self._head = by_type["timestep_dense"]
+        self._head = by_type.get("timestep_dense",
+                                 by_type.get("tied_lm_head"))
+        if self._head is None:
+            raise ValueError("LMGenerator needs a timestep_dense or "
+                             "tied_lm_head LM head")
         if self._posenc is not None and self.max_len > \
                 self._posenc.input_shape[0]:
             raise ValueError(
@@ -84,7 +89,9 @@ class LMGenerator:
             new_caches.append((ck, cv))
         lp = params[self._ln.name]
         x = norm.layer_norm(x, lp["gamma"], lp["beta"])
-        logits = self._head.apply(params[self._head.name], x)
+        head_p = (params if getattr(self._head, "needs_full_params",
+                                    False) else params[self._head.name])
+        logits = self._head.apply(head_p, x)
         return logits[:, 0].astype(jnp.float32), new_caches
 
     def _init_caches(self, batch, dtype):
@@ -97,8 +104,8 @@ class LMGenerator:
 
     def _scan_fn(self, batch, greedy):
         """ONE compile per (batch, greedy): the scan always runs to
-        max_len - 1, and prompt_len / top_k / top_p are all TRACED
-        scalars (a REST server sees arbitrary prompt lengths and
+        max_len - 1, and prompt_len / top_k / top_p / inv_temp are all
+        TRACED scalars (a REST server sees arbitrary prompt lengths and
         client-chosen sampling configs — shape- or value-specializing
         on any of them would recompile per request and cache executables
         forever).  Cached per-instance (NOT lru_cache: a class-level
@@ -132,7 +139,7 @@ class LMGenerator:
                 lambda lg: lg, logits)
             return jax.random.categorical(sub, logits).astype(jnp.int32)
 
-        def run(params, tokens, prompt_len, key, top_k, top_p):
+        def run(params, tokens, prompt_len, key, top_k, top_p, inv_temp):
             caches = self._init_caches(
                 batch, self.params[self._embed.name]["table"].dtype)
 
@@ -144,7 +151,7 @@ class LMGenerator:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
                     key, sub = jax.random.split(key)
-                    nxt = sample(logits, sub, top_k, top_p)
+                    nxt = sample(logits * inv_temp, sub, top_k, top_p)
                 keep = pos + 1 < prompt_len       # teacher-force prompt
                 nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
                 tokens = jax.lax.dynamic_update_slice(
@@ -160,7 +167,7 @@ class LMGenerator:
         return self._compiled[(batch, greedy)]
 
     def _run(self, params, tokens_np, prompt_len, greedy, key, top_k=0,
-             top_p=1.0):
+             top_p=1.0, inv_temp=1.0):
         b = tokens_np.shape[0]
         pad = self.max_len - tokens_np.shape[1]
         if pad:
@@ -168,7 +175,7 @@ class LMGenerator:
                 [tokens_np, np.zeros((b, pad), np.int32)], axis=1)
         return self._scan_fn(b, greedy)(
             params, jnp.asarray(tokens_np), jnp.int32(prompt_len), key,
-            jnp.int32(top_k), jnp.float32(top_p))
+            jnp.int32(top_k), jnp.float32(top_p), jnp.float32(inv_temp))
 
     # ------------------------------------------------------------------
     def generate(self, prompt, max_new, temperature=0.0, seed=0,
@@ -190,16 +197,10 @@ class LMGenerator:
             raise ValueError("top_k must be in [0, %d], got %r"
                              % (self._head.n_out, top_k))
         greedy = temperature == 0.0
-        params = self.params
-        if not greedy and temperature != 1.0:
-            head = dict(params[self._head.name])
-            head["weights"] = head["weights"] / temperature
-            if "bias" in head:
-                head["bias"] = head["bias"] / temperature
-            params = dict(params, **{self._head.name: head})
-        out, _ = self._run(params, prompt, t0, greedy,
+        out, _ = self._run(self.params, prompt, t0, greedy,
                            jax.random.key(seed), int(top_k),
-                           float(top_p))
+                           float(top_p),
+                           1.0 if greedy else 1.0 / temperature)
         return np.asarray(out)[:, :total]
 
     def _beam_fn(self, batch, beam):

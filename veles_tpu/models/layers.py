@@ -39,6 +39,9 @@ class Layer(object):
     TYPES = ()
     needs_rng = False      # dropout / stochastic pooling want a key
     has_params = False
+    #: apply() receives the WHOLE param tree instead of its own slice —
+    #: the seam weight tying uses (TiedLMHead reads the embedding table)
+    needs_full_params = False
 
     def __init__(self, cfg):
         cfg = _flatten_config(cfg)
@@ -740,6 +743,31 @@ class PipelinedTransformer(Layer):
         return h
 
 
+class TiedLMHead(Layer):
+    """LM head that reuses the embedding table transposed
+    (``tie_to`` = the embedding layer's name): logits = x @ tableᵀ.
+    Weight tying saves vocab×d_model params and regularizes; gradients
+    flow to the table through both uses."""
+
+    TYPES = ("tied_lm_head",)
+    needs_full_params = True
+
+    def _infer(self, input_shape):
+        t, f = input_shape
+        self.tie_to = self.cfg["tie_to"]
+        self.n_in = f
+        self.n_out = int(self.cfg["vocab_size"])
+        return (t, self.n_out)
+
+    def apply(self, params, x, train=False, key=None):
+        # ``params`` is the FULL tree (needs_full_params)
+        table = params[self.tie_to]["table"]        # [vocab, d_model]
+        if table.shape != (self.n_out, self.n_in):
+            raise ValueError("tied table %s does not match head (%d, %d)"
+                             % (table.shape, self.n_out, self.n_in))
+        return linear.matmul(x, table.T, self.policy)
+
+
 class TimestepDense(Layer):
     """Per-timestep dense over [T, F] samples: [B, T, F] → [B, T, out]
     (the transformer projection / LM head; weight shared across time)."""
@@ -801,7 +829,7 @@ for _cls in (All2All, ResizableAll2All, Conv, Deconv, Pooling, Depooling,
              Dropout, Activation, Cutter, LSTM, ZeroFiller, LayerNorm,
              Embedding, PositionalEncoding, MultiHeadAttention, MoE,
              TransformerBlock, PipelinedTransformer, TimestepDense,
-             SeqPool):
+             TiedLMHead, SeqPool):
     for _t in _cls.TYPES:
         LAYER_TYPES[_t] = _cls
 

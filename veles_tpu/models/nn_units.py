@@ -125,6 +125,26 @@ class StagedTrainer(Unit):
                     layer.gd, self.gd_defaults)
         self.velocity = optimizer.init_state(self.params)
         self._hypers = hypers
+        # resolve weight-tying references now that layers are named:
+        # tie_to may be a layer NAME or a layer TYPE (e.g. "embedding");
+        # a bad reference must fail here, not as a KeyError mid-trace
+        by_type = {}
+        for layer in self.layers:
+            by_type.setdefault(layer.type, layer.name)
+        for layer in self.layers:
+            tie = layer.cfg.get("tie_to")
+            if not tie:
+                continue
+            if tie not in self.params:
+                resolved = by_type.get(tie)
+                if resolved is None or resolved not in self.params:
+                    raise ValueError(
+                        "%s: tie_to=%r matches no parameterized layer "
+                        "(names: %s)" % (layer.name, tie,
+                                         sorted(self.params)))
+                layer.cfg["tie_to"] = resolved
+                if hasattr(layer, "tie_to"):
+                    layer.tie_to = resolved
         self.output_features = int(np.prod(shape))
         self._base_key = jax.random.key(
             int(prng.get("trainer")._seed))
@@ -157,6 +177,12 @@ class StagedTrainer(Unit):
         for i, layer in enumerate(self.layers):
             lkey = (jax.random.fold_in(key, i)
                     if (train and layer.needs_rng) else None)
+            if getattr(layer, "needs_full_params", False):
+                # weight tying (TiedLMHead): the layer reads another
+                # layer's params; remat would checkpoint the whole tree
+                # for no gain, so tied heads run un-remat'd
+                x = layer.apply(params, x, train=train, key=lkey)
+                continue
             if train and layer.cfg.get("remat"):
                 # rematerialize this layer's activations in the backward
                 # pass (jax.checkpoint) — memory for FLOPs, the standard

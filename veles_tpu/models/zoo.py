@@ -108,13 +108,15 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
                    impl="blockwise", solver="adam", n_experts=0,
                    n_kv_heads=None, remat=False, pos="learned",
-                   window=None):
+                   window=None, tie_embeddings=False):
     """Decoder-only causal LM over int token samples [T].
     ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
     rematerializes each block's activations in the backward pass
     (jax.checkpoint — long-context memory for FLOPs); ``pos`` =
     "learned" | "sinusoid" position table, or "rope" (rotary q/k in
-    every block, no table — extrapolates past the train length)."""
+    every block, no table — extrapolates past the train length);
+    ``tie_embeddings`` reuses the embedding table as the LM head
+    (saves vocab×d_model params)."""
     if pos not in ("learned", "sinusoid", "rope"):
         raise ValueError("pos must be learned|sinusoid|rope")
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
@@ -134,8 +136,14 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                             "window": window},
                            **gd))
     layers.append(dict({"type": "layer_norm"}, **gd))
-    layers.append(dict({"type": "timestep_dense",
-                        "output_sample_shape": vocab_size}, **gd))
+    if tie_embeddings:
+        # tie_to by TYPE — the trainer resolves it to the layer's
+        # assigned name at initialize
+        layers.append({"type": "tied_lm_head", "vocab_size": vocab_size,
+                       "tie_to": "embedding"})
+    else:
+        layers.append(dict({"type": "timestep_dense",
+                            "output_sample_shape": vocab_size}, **gd))
     return layers
 
 
