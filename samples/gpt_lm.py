@@ -64,6 +64,7 @@ def run(load, main):
              remat=bool(cfg.get("remat", False)),
              n_experts=cfg.get("n_experts", 0),
              tie_embeddings=bool(cfg.get("tie_embeddings", True)),
+             window=cfg.get("window", None),
              lr=cfg.get("learning_rate", 1e-3)),
          loader=loader, loss="lm",
          gd_defaults={"clip_norm": cfg.get("clip_norm", 1.0)},

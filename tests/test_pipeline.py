@@ -184,3 +184,30 @@ def test_pipelined_transformer_propagates_gqa():
     params = layer.init_params(prng.get("t"))
     wk = params["stages"]["mha"]["wk"]       # [n_blocks, d_model, d_kv]
     assert wk.shape == (2, 16, 8), wk.shape  # 2 kv heads of dim 4
+
+
+def test_pipelined_transformer_propagates_rope_and_window():
+    from veles_tpu import prng
+    from veles_tpu.models.layers import make_layer
+
+    prng.seed_all(4)
+    layer = make_layer({"type": "pipelined_transformer", "n_blocks": 2,
+                        "n_heads": 4, "causal": True, "rope": True,
+                        "window": 4})
+    layer.setup((8, 16))
+    assert layer._block.cfg["rope"] is True
+    assert layer._block.cfg["window"] == 4
+
+
+def test_pipelined_transformer_forwards_all_block_options():
+    """No silent whitelist: every block option (e.g. MoE config) reaches
+    the inner TransformerBlock."""
+    from veles_tpu import prng
+    from veles_tpu.models.layers import make_layer
+
+    prng.seed_all(5)
+    layer = make_layer({"type": "pipelined_transformer", "n_blocks": 2,
+                        "n_heads": 4, "n_experts": 2, "top_k": 1})
+    layer.setup((8, 16))
+    assert layer._block.n_experts == 2
+    assert layer._block._moe.top_k == 1

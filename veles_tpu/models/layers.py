@@ -700,14 +700,17 @@ class PipelinedTransformer(Layer):
         t, f = input_shape
         self.n_blocks = int(self.cfg.get("n_blocks", 2))
         self.n_microbatches = int(self.cfg.get("n_microbatches", 4))
-        block_cfg = {"type": "transformer_block",
-                     "n_heads": self.cfg.get("n_heads", 8),
-                     "n_kv_heads": self.cfg.get(
-                         "n_kv_heads", self.cfg.get("n_heads", 8)),
-                     "d_ff": self.cfg.get("d_ff", 4 * f),
-                     "causal": self.cfg.get("causal", False),
-                     "impl": self.cfg.get("impl", "blockwise"),
-                     "dropout_ratio": 0.0}
+        # forward EVERY TransformerBlock option the caller set (a
+        # hand-maintained whitelist silently dropped rope/window/
+        # n_kv_heads in past revisions); only the pipeline's own keys
+        # and the unsupported dropout are withheld
+        own = {"type", "n_blocks", "n_microbatches", "dropout_ratio",
+               "name"}
+        block_cfg = {k: v for k, v in self.cfg.items() if k not in own}
+        block_cfg.update({"type": "transformer_block",
+                          "n_heads": self.cfg.get("n_heads", 8),
+                          "d_ff": self.cfg.get("d_ff", 4 * f),
+                          "dropout_ratio": 0.0})
         # per-stage remat rides the whole pipelined layer: set
         # {"remat": true} on THIS layer and the trainer checkpoints the
         # full stage scan (stages recompute during the backward sweep)
