@@ -278,6 +278,14 @@ def test_flash_window_validation():
         att.flash_attention(q, k, v, causal=True, window=0)
 
 
+def test_blockwise_window_validation():
+    """blockwise_attention is a public entry point (the ring carry API) —
+    window without causal must raise, not silently run full attention."""
+    q, k, v = _qkv(t=32, d=16)
+    with pytest.raises(ValueError, match="causal"):
+        att.blockwise_attention(q, k, v, causal=False, window=8)
+
+
 def test_mha_window_validated_for_all_impls():
     """window misconfigs must raise identically on every impl path."""
     from veles_tpu import prng

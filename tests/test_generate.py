@@ -90,6 +90,25 @@ def test_one_compile_per_batch_size():
     assert len(gen._compiled) == 1, list(gen._compiled)
 
 
+def test_compile_cache_is_bounded_lru():
+    """Batch size is client-controlled over REST: the per-generator
+    executable cache must evict, not grow without bound."""
+    from veles_tpu.models import generate as gen_mod
+    wf, toks = _lm_workflow(max_epochs=0)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    cap = gen_mod.COMPILE_CACHE_SIZE
+    for b in range(1, cap + 2):                  # cap + 1 distinct batches
+        gen.generate(toks[:b, :4], max_new=2)
+    assert len(gen._compiled) == cap, list(gen._compiled)
+    assert (1, True) not in gen._compiled        # oldest evicted
+    # recency, not FIFO: re-hit the current-oldest key, then insert one
+    # more — the hit key must survive and the next-oldest must go
+    gen.generate(toks[:2, :4], max_new=2)
+    gen.generate(toks[: cap + 2, :4], max_new=2)
+    assert (2, True) in gen._compiled
+    assert (3, True) not in gen._compiled, list(gen._compiled)
+
+
 def test_top_k_and_top_p_sampling():
     """top_k=1 must equal greedy; top_p≈0 likewise; both reproducible."""
     wf, toks = _lm_workflow(max_epochs=6)

@@ -128,6 +128,15 @@ class TestRESTful:
             res = np.asarray(out["result"])
             assert res.shape == (1, 10)
             np.testing.assert_array_equal(res[0, :6], toks[0, :6])
+            # the natural-but-wrong shorthand gets a descriptive 400,
+            # not an opaque AttributeError
+            try:
+                _post("http://127.0.0.1:%d/service" % api.port,
+                      {"input": toks[0, :6].tolist(), "generate": True})
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "options object" in json.loads(e.read())["error"]
         finally:
             api.stop()
 

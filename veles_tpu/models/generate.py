@@ -13,11 +13,20 @@ The reference served forward passes over REST (restful_api.py:112-217);
 generation is the transformer-era equivalent and beyond-parity."""
 
 
+import collections
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from veles_tpu.ops import norm
+
+#: compiled-executable cache capacity per generator.  Batch size (number
+#: of prompt rows) and beam width are both client-controlled on the REST
+#: serving path; each distinct value compiles an executable, so the cache
+#: must be an LRU, not a grow-forever dict.
+COMPILE_CACHE_SIZE = 8
 
 
 class LMGenerator:
@@ -35,7 +44,8 @@ class LMGenerator:
         #: halves serve-time cache memory (keys/values are MXU inputs
         #: anyway; softmax stays f32)
         self.cache_dtype = cache_dtype
-        self._compiled = {}
+        self._compiled = collections.OrderedDict()
+        self._cache_lock = threading.Lock()
         layers = trainer.layers
         by_type = {}
         self._blocks = []
@@ -111,7 +121,7 @@ class LMGenerator:
         forever).  Cached per-instance (NOT lru_cache: a class-level
         cache keyed on self would immortalize every generator and its
         params)."""
-        cached = self._compiled.get((batch, greedy))
+        cached = self._cache_get((batch, greedy))
         if cached is not None:
             return cached
 
@@ -163,8 +173,23 @@ class LMGenerator:
                 jnp.arange(self.max_len - 1))
             return tokens, logits
 
-        self._compiled[(batch, greedy)] = jax.jit(run)
-        return self._compiled[(batch, greedy)]
+        return self._cache_put((batch, greedy), jax.jit(run))
+
+    def _cache_get(self, key):
+        # the REST server is threaded and shares one generator: the
+        # get/move_to_end pair must not race a concurrent eviction
+        with self._cache_lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self._compiled.move_to_end(key)
+            return fn
+
+    def _cache_put(self, key, fn):
+        with self._cache_lock:
+            self._compiled[key] = fn
+            while len(self._compiled) > COMPILE_CACHE_SIZE:
+                self._compiled.popitem(last=False)
+        return fn
 
     def _run(self, params, tokens_np, prompt_len, greedy, key, top_k=0,
              top_p=1.0, inv_temp=1.0):
@@ -210,7 +235,7 @@ class LMGenerator:
         prompt), then each step expands beam×V continuations and keeps
         the ``beam`` best, gathering the KV caches of the surviving
         parents."""
-        cached = self._compiled.get(("beam", batch, beam))
+        cached = self._cache_get(("beam", batch, beam))
         if cached is not None:
             return cached
         bb = batch * beam
@@ -268,8 +293,7 @@ class LMGenerator:
                 jnp.arange(self.max_len - 1))
             return tokens, scores
 
-        self._compiled[("beam", batch, beam)] = jax.jit(run)
-        return self._compiled[("beam", batch, beam)]
+        return self._cache_put(("beam", batch, beam), jax.jit(run))
 
     def beam_search(self, prompt, max_new, beam=4):
         """Beam-search decode: prompt [B, T0] → (tokens [B, T0+max_new],

@@ -42,6 +42,11 @@ def attention(q, k, v, causal=False, scale=None, bias=None, window=None):
     s = s * _scale(d, scale)
     if bias is not None:
         s = s + bias
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError("window must be >= 1")
     if causal:
         rows = jnp.arange(tq)[:, None] + (tk - tq)
         cols = jnp.arange(tk)[None]
@@ -49,8 +54,6 @@ def attention(q, k, v, causal=False, scale=None, bias=None, window=None):
         if window is not None:
             mask = mask & (rows - cols < window)
         s = jnp.where(mask, s, NEG_INF)
-    elif window is not None:
-        raise ValueError("window requires causal=True")
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
@@ -72,6 +75,13 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_k=512,
             carry = blockwise_attention(..., carry=carry, return_carry=True)
         out = finalize_attention(carry)
     """
+    if window is not None:
+        # match flash_attention: never silently ignore or degenerate the
+        # sliding window for direct callers of the blockwise entry point
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError("window must be >= 1")
     b, h, tq, d = q.shape
     tk = k.shape[-2]
     block_k = min(block_k, tk)
@@ -210,8 +220,8 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
     ``window`` = sliding-window causal attention (all impls share the
     q - k < window mask)."""
     if window is not None:
-        # validated here once — the per-backend behaviors differ
-        # (flash raises, blockwise/naive would silently ignore/degrade)
+        # every backend also validates this itself; kept here so the
+        # error precedes the projection matmuls
         if not causal:
             raise ValueError("window requires causal=True")
         if window < 1:

@@ -251,13 +251,18 @@ def _flash_fn(causal, scale, block_q, block_k, interpret, backward,
     def fwd(q, k, v):
         out, lse = _forward(q, k, v, causal, scale, block_q, block_k,
                             interpret, window)
-        return out, (q, k, v, out, lse)
+        # the recompute oracle only re-derives from q/k/v — saving
+        # (out, lse) there would hold an extra [B,H,T,D] + [B,H,T]
+        # activation per attention call for nothing
+        res = (q, k, v, out, lse) if backward == "fused" else (q, k, v)
+        return out, res
 
     def bwd(res, g):
-        q, k, v, out, lse = res
         if backward == "fused":
+            q, k, v, out, lse = res
             return _backward(q, k, v, out, lse, g, causal, scale,
                              block_q, block_k, interpret, window)
+        q, k, v = res
         _, vjp = jax.vjp(
             lambda q_, k_, v_: att.blockwise_attention(
                 q_, k_, v_, causal=causal, scale=scale,

@@ -62,7 +62,12 @@ class RESTfulAPI(Logger):
             def log_message(self, fmt, *args):
                 api.debug("http: " + fmt, *args)
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        class Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog of 5 resets
+            # connections under a concurrent client burst
+            request_queue_size = 128
+
+        self._server = Server((self.host, self.port), Handler)
         self.port = self._server.server_address[1]   # resolve port 0
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
@@ -83,7 +88,13 @@ class RESTfulAPI(Logger):
         if self.generator is None:
             raise ValueError("this endpoint serves a non-LM workflow: "
                              "no generator is attached")
-        opts = req["generate"] or {}
+        opts = req.get("generate")
+        if not isinstance(opts, dict):
+            # null/false/0/[] must not silently mean "generate with
+            # defaults" — only an options object selects this endpoint
+            raise ValueError(
+                "'generate' must be an options object like "
+                "{\"max_new\": 16}, got %r" % (opts,))
         prompt = np.asarray(req["input"], np.int32)
         if prompt.ndim == 1:
             prompt = prompt[None]
