@@ -27,17 +27,47 @@ import time
 
 BASELINE_GEMM_GFLOPS = 329.0   # GTX TITAN, f32, ref devices/device_infos.json
 
-#: (name, watchdog seconds).  Order matters: the headline gemm goes first so
-#: a later hang can never cost us the one number BASELINE demands.
+#: (name, watchdog seconds).  Order matters: the headline gemm goes first
+#: so a later hang can never cost us the one number BASELINE demands; the
+#: LM flagships and flash head-to-head come next (round-3 priority:
+#: MFU-credible numbers on record) — they are also the most hang-prone,
+#: so the default budget covers a full worst-case LM+flash stall while
+#: still reaching the cheap phases behind them.
 PHASES = [
     ("gemm", 420),
+    ("lm_large", 900),
+    ("lm", 600),
+    ("flash", 600),
     ("mlp", 420),
     ("alexnet", 600),
-    ("lm", 600),
-    ("flash", 300),
     ("ring", 420),
     ("kohonen", 300),
 ]
+
+
+def _causal_attn_flops(b, h, t, d):
+    """Matmul FLOPs of ONE causal attention call (qk + pv, each 2·b·h·
+    t·(t/2)·d with the triangular mask halving effective keys)."""
+    return 4 * b * h * t * t * d / 2
+
+#: detected bf16 peak by device_kind substring (TFLOP/s) — the MFU
+#: denominator.  Order matters ("v5 lite" before "v5").
+PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v5", 459.0),
+    ("v6 lite", 918.0), ("v6e", 918.0), ("v6", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+)
+
+
+def _peak_bf16():
+    """bf16 peak TFLOP/s of device 0, or 0.0 when unknown (CPU/unlisted:
+    MFU is then omitted rather than fabricated)."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return 0.0
 
 #: stderr substrings that mean "backend init flake — worth retrying"
 RETRYABLE = (
@@ -63,7 +93,7 @@ def _log(msg):
 
 def _block(x):
     import jax
-    jax.block_until_ready(x)
+    return jax.block_until_ready(x)
 
 
 def phase_gemm():
@@ -82,22 +112,28 @@ def phase_gemm():
     from jax import lax
 
     def run(n, dtype, precision, iters=20):
-        a = jnp.asarray(
-            np.random.RandomState(0).rand(n, n).astype(np.float32)
-        ).astype(dtype)
-        c = jnp.asarray(2.0 / n, dtype)
+        a = np.random.RandomState(0).rand(n, n).astype(np.float32)
+        # pre-normalize by the dominant singular value (host-side power
+        # iteration) so the chain is y <- y @ a with NO per-iter rescale
+        # op: the timed loop is pure MXU matmuls
+        v = np.random.RandomState(1).rand(n).astype(np.float32)
+        for _ in range(8):
+            v = a.T @ (a @ v)
+            v /= np.linalg.norm(v)
+        sigma = float(np.linalg.norm(a @ v))
+        a = jnp.asarray(a / sigma).astype(dtype)
 
         def body(y, _):
-            # constant rescale keeps the chain finite without a
-            # data-dependent reduction serializing against the MXU
-            return jnp.dot(y, a, precision=precision) * c, None
+            return jnp.dot(y, a, precision=precision), None
 
-        f = jax.jit(lambda y: lax.scan(body, y, None, length=iters)[0])
-        _block(f(a))                        # compile + warmup
+        f = jax.jit(lambda y: lax.scan(body, y, None, length=iters)[0],
+                    donate_argnums=(0,))
+        # the seed must not alias the captured multiplicand: f donates it
+        y = _block(f(jnp.copy(a)))         # compile + warmup
         dt = float("inf")
         for _ in range(3):                  # best of 3 (shared-chip noise)
             t0 = time.perf_counter()
-            _block(f(a))
+            y = _block(f(y))
             dt = min(dt, (time.perf_counter() - t0) / iters)
         return dt, 2.0 * n * n * n / dt / 1e9
 
@@ -107,8 +143,12 @@ def phase_gemm():
          % (dt32, gf32))
     # MXU-native: large bf16 gemm, what real TPU training runs on
     dt16, gf16 = run(8192, jnp.bfloat16, "default", iters=10)
-    _log("gemm 8192^2 bf16: %.4f s/multiply, %.1f GFLOP/s" % (dt16, gf16))
+    peak = _peak_bf16()
+    mfu = gf16 / 1e3 / peak if peak else 0.0
+    _log("gemm 8192^2 bf16: %.4f s/multiply, %.1f GFLOP/s (MFU %.1f%% of "
+         "%s TF/s peak)" % (dt16, gf16, mfu * 100, peak or "unknown"))
     return {"s_per_multiply": dt32, "gflops": gf32, "bf16_gflops": gf16,
+            "bf16_mfu": mfu, "peak_bf16_tflops": peak,
             "device": str(jax.devices()[0])}
 
 
@@ -187,13 +227,27 @@ def phase_alexnet():
     return {"samples_per_sec": sps}
 
 
-def phase_lm():
-    """Causal transformer LM training throughput (tokens/sec/chip) — the
-    beyond-parity flagship: GPT-style decoder (~25M params, T=1024,
-    Pallas flash attention + fused FA2 backward, RoPE, GQA, AdamW with
-    global-norm clipping, bf16 MXU compute) through the SAME
-    StandardWorkflow hot loop as every other model, with the fused
-    k-step dispatch."""
+def _lm_train_flops_per_token(d_model, n_layers, seq, vocab, d_ff=None,
+                              n_heads=None, n_kv_heads=None):
+    """Analytic matmul FLOPs per trained token (fwd+bwd = 3x fwd): per
+    layer q/o project 2·d² each, k/v project 2·d·d_kv each (GQA shrinks
+    d_kv = d·n_kv/n_heads), MLP 2·(2·d_ff·d), causal attention 2·T·d
+    (T/2 effective keys, qk + pv), plus the 2·d·V LM head.  Embedding
+    lookup is a gather — no FLOPs."""
+    d_ff = d_ff or 4 * d_model
+    kv_frac = ((n_kv_heads / n_heads)
+               if n_heads and n_kv_heads else 1.0)
+    per_layer = ((4 + 4 * kv_frac) * d_model ** 2
+                 + 4 * d_ff * d_model + 2 * seq * d_model)
+    return 3 * (n_layers * per_layer + 2 * d_model * vocab)
+
+
+def _run_lm(tag, zoo_kwargs, batch, seq, steps, steps_per_dispatch,
+            vocab):
+    """Shared LM-throughput harness: train ``steps`` minibatches through
+    the StandardWorkflow hot loop, report tokens/sec and model FLOPs
+    utilization against the detected chip peak."""
+    import jax
     import numpy as np
     from veles_tpu import prng
     from veles_tpu.loader.fullbatch import FullBatchLoader
@@ -201,24 +255,23 @@ def phase_lm():
     from veles_tpu.models.zoo import transformer_lm
 
     prng.seed_all(5)
-    batch, seq, steps = 8, 1024, 20
     n = batch * 4
     toks = np.random.RandomState(0).randint(
-        0, 8192, (n, seq)).astype(np.int32)
+        0, vocab, (n, seq)).astype(np.int32)
     loader = FullBatchLoader(None, data=toks, labels=toks,
                              minibatch_size=batch,
                              class_lengths=[0, 0, n])
     wf = StandardWorkflow(
-        layers=transformer_lm(vocab_size=8192, d_model=512, n_heads=8,
-                              n_kv_heads=2, n_layers=8, dropout=0.0,
-                              impl="flash", pos="rope", solver="adamw",
-                              lr=1e-3),
+        layers=transformer_lm(vocab_size=vocab, **zoo_kwargs),
         loader=loader, loss="lm",
         gd_defaults={"clip_norm": 1.0},
         decision_config={"max_epochs": 1000},
-        steps_per_dispatch=5, name="bench-lm")
+        steps_per_dispatch=steps_per_dispatch, name="bench-" + tag)
     wf.initialize()
-    for _ in range(10):          # compile + warmup (2 fused sweeps)
+    n_params = sum(int(np.prod(p.shape))
+                   for lp in wf.trainer.params.values()
+                   for p in jax.tree_util.tree_leaves(lp))
+    for _ in range(2 * steps_per_dispatch):  # compile + warmup (2 sweeps)
         wf.loader.run()
         wf.trainer.run()
     wf.trainer.flush()
@@ -231,14 +284,106 @@ def phase_lm():
     _block(wf.trainer.class_stats[2]["loss"])
     dt = time.perf_counter() - t0
     tps = batch * seq * steps / dt
-    _log("transformer lm 25M (T=1024, flash): %.0f tokens/sec/chip, "
-         "%.1f ms/step" % (tps, dt / steps * 1e3))
-    return {"tokens_per_sec": tps, "ms_per_step": dt / steps * 1e3}
+    fpt = _lm_train_flops_per_token(
+        zoo_kwargs["d_model"], zoo_kwargs["n_layers"], seq, vocab,
+        n_heads=zoo_kwargs.get("n_heads"),
+        n_kv_heads=zoo_kwargs.get("n_kv_heads"))
+    peak = _peak_bf16()
+    mfu = tps * fpt / (peak * 1e12) if peak else 0.0
+    _log("%s (%.1fM params, T=%d): %.0f tokens/sec/chip, "
+         "%.1f ms/step, MFU %.1f%%"
+         % (tag, n_params / 1e6, seq, tps, dt / steps * 1e3, mfu * 100))
+    return {"tokens_per_sec": tps, "ms_per_step": dt / steps * 1e3,
+            "mfu": mfu, "n_params": n_params,
+            "peak_bf16_tflops": peak}
+
+
+def phase_lm():
+    """Causal transformer LM training throughput (tokens/sec/chip):
+    GPT-style decoder (~25M params, T=1024, Pallas flash attention +
+    fused FA2 backward, RoPE, GQA, AdamW with global-norm clipping, bf16
+    MXU compute) through the SAME StandardWorkflow hot loop as every
+    other model, with the fused k-step dispatch."""
+    return _run_lm(
+        "lm-25M",
+        dict(d_model=512, n_heads=8, n_kv_heads=2, n_layers=8,
+             dropout=0.0, impl="flash", pos="rope", solver="adamw",
+             lr=1e-3),
+        batch=8, seq=1024, steps=20, steps_per_dispatch=5, vocab=8192)
+
+
+def phase_lm_large():
+    """The MFU-credible flagship (round-3 verdict item #4): GPT-2-small
+    class — 124M params, d=768, 12 heads, 12 layers, T=1024, vocab
+    50304 (MXU-friendly multiple of 128), tied embeddings, per-layer
+    remat, flash attention + fused backward, RoPE, AdamW + global-norm
+    clip, bf16 compute, fused 4-step dispatch.  Target: >= 40% MFU
+    single-chip."""
+    return _run_lm(
+        "lm-124M",
+        dict(d_model=768, n_heads=12, n_layers=12, dropout=0.0,
+             impl="flash", pos="rope", solver="adamw", lr=6e-4,
+             remat=True, tie_embeddings=True),
+        batch=8, seq=1024, steps=12, steps_per_dispatch=4, vocab=50304)
+
+
+def _chain_attn(attn_fn, q, k, v, iters, grad=False):
+    """True kernel-time harness: ``iters`` attention calls chained INSIDE
+    one jit dispatch (each call consumes the previous output as q — same
+    shape), so per-dispatch tunnel latency amortizes away.  The round-2
+    session proved per-dispatch timing is useless here: every config
+    measured ~4-5 ms regardless of kernel (BENCH_SESSION.md).  With
+    ``grad`` the chain feeds dQ back as the next q (fused backward
+    timing).  Returns ms per single attention call (fwd or fwd+bwd)."""
+    import jax
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    if grad:
+        # FULL backward on both contenders — dQ and dK/dV (argnums=0
+        # alone would let XLA dead-code the dK/dV matmuls and bias the
+        # head-to-head).  dQ feeds back as the next chain link; dK/dV
+        # stay live through cheap elementwise accumulators.
+        g = jax.grad(
+            lambda q_, k_, v_: attn_fn(q_, k_, v_).sum(),
+            argnums=(0, 1, 2))
+
+        def body(carry, _):
+            y, ak, av = carry
+            dq, dk, dv = g(y, k, v)
+            return (dq.astype(y.dtype), ak + dk, av + dv), None
+
+        def chain(y):
+            (y, ak, av), _ = lax.scan(
+                body, (y, jnp.zeros_like(k), jnp.zeros_like(v)), None,
+                length=iters)
+            return y, ak, av
+    else:
+        def body(y, _):
+            return attn_fn(y, k, v).astype(y.dtype), None
+
+        def chain(y):
+            return lax.scan(body, y, None, length=iters)[0]
+
+    f = jax.jit(chain, donate_argnums=(0,))
+    out = _block(f(jnp.copy(q)))           # compile + warmup
+    y = out[0] if grad else out
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = _block(f(y))
+        y = out[0] if grad else out
+        dt = min(dt, (time.perf_counter() - t0) / iters)
+    return dt * 1e3
 
 
 def phase_flash():
-    """Pallas flash-attention kernel ON HARDWARE: correctness vs the naive
-    reference plus a timing, proving the TPU-only code path executes."""
+    """Pallas flash-attention kernel ON HARDWARE: correctness vs the
+    naive reference, then chained in-jit timing (fwd f32/bf16, fused
+    bwd, T=8192 long context) HEAD-TO-HEAD against XLA's O(T²) native
+    attention — the number that decides whether the kernel earns its
+    keep (round-2 verdict item #2)."""
     import jax
     import jax.numpy as jnp
     from veles_tpu.ops.attention import attention
@@ -249,65 +394,106 @@ def phase_flash():
     b, h, t, d = 4, 8, 1024, 128
     q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32) * 0.1
                for kk in jax.random.split(key, 3))
-    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    out = f(q, k, v)
-    ref = attention(q, k, v, causal=True)
-    err = float(jnp.max(jnp.abs(out - ref)))
+    flash = lambda q, k, v: flash_attention(q, k, v, causal=True)  # noqa
+    naive = lambda q, k, v: attention(q, k, v, causal=True)        # noqa
+    ref = naive(q, k, v)
+    err = float(jnp.max(jnp.abs(jax.jit(flash)(q, k, v) - ref)))
     if err > 5e-3:
         raise AssertionError("flash kernel mismatch: max_err=%g" % err)
 
-    def timed(fn, *args, iters=20):
-        _block(fn(*args))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = fn(*args)
-        _block(o)
-        return (time.perf_counter() - t0) / iters * 1e3
+    # causal attention matmul flops for one call (qk + pv, T²/2 each)
+    flops = _causal_attn_flops(b, h, t, d)
 
-    ms = timed(f, q, k, v)
-    # the mixed-precision path: bf16 MXU multiplies, f32 accumulation —
-    # correctness-gated on hardware like the f32 path
+    def tf(ms):
+        return flops / (ms / 1e3) / 1e12 if ms else 0.0
+
+    ms = _chain_attn(flash, q, k, v, iters=20)
     q16, k16, v16 = (x.astype(jnp.bfloat16) for x in (q, k, v))
     err16 = float(jnp.max(jnp.abs(
-        f(q16, k16, v16).astype(jnp.float32) - ref)))
+        jax.jit(flash)(q16, k16, v16).astype(jnp.float32) - ref)))
     if err16 > 0.05:
         raise AssertionError("bf16 flash mismatch: max_err=%g" % err16)
-    ms16 = timed(f, q16, k16, v16)
+    ms16 = _chain_attn(flash, q16, k16, v16, iters=20)
+    ms16_xla = _chain_attn(naive, q16, k16, v16, iters=20)
 
-    # fused Pallas backward (dQ + dK/dV kernels) on hardware,
-    # correctness-gated against the naive reference gradient
-    loss_flash = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))
-    loss_ref = jax.grad(lambda q, k, v: jnp.sum(
-        attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))
-    gf = loss_flash(q, k, v)
-    gr = loss_ref(q, k, v)
+    # fused Pallas backward: correctness vs the naive gradient, then
+    # chained fwd+bwd timing vs XLA differentiating its own attention
+    gf = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+        flash(q, k, v) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        naive(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
     bwd_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gf, gr))
     if bwd_err > 5e-2:
         raise AssertionError("fused backward mismatch: %g" % bwd_err)
-    ms_bwd = timed(loss_flash, q, k, v, iters=10)
+    ms_bwd = _chain_attn(flash, q16, k16, v16, iters=10, grad=True)
+    ms_bwd_xla = _chain_attn(naive, q16, k16, v16, iters=10, grad=True)
 
-    # long-context headline: one chip, T=8192 causal bf16 forward —
+    # long-context headline: one chip, T=8192 causal bf16 —
     # the O(T·block) VMEM tiling is what makes this shape possible.
     # Real-kernel only (interpret mode would outlive the watchdog).
-    ms_long = 0.0
+    ms_long = ms_long_xla = 0.0
     if platform == "tpu":
         bl, hl, tl, dl = 1, 8, 8192, 128
         ql, kl, vl = (jax.random.normal(kk, (bl, hl, tl, dl),
                                         jnp.bfloat16) * 0.1
                       for kk in jax.random.split(jax.random.key(2), 3))
-        ms_long = timed(f, ql, kl, vl, iters=10)
-        tf_long = (4 * bl * hl * tl * tl * dl / 2
-                   / (ms_long / 1e3) / 1e12)
-        _log("flash long-context T=8192 bf16: %.2f ms "
-             "(%.1f TF/s causal-effective)" % (ms_long, tf_long))
+        ms_long = _chain_attn(flash, ql, kl, vl, iters=10)
+        fl = _causal_attn_flops(bl, hl, tl, dl)
+        try:
+            ms_long_xla = _chain_attn(naive, ql, kl, vl, iters=5)
+        except Exception as e:  # noqa: BLE001 — XLA may OOM the T² matrix
+            _log("naive XLA at T=8192 failed (%s) — flash-only number"
+                 % type(e).__name__)
+        _log("flash long-context T=8192 bf16: %.2f ms (%.1f TF/s "
+             "causal-effective) vs XLA naive %.2f ms"
+             % (ms_long, fl / (ms_long / 1e3) / 1e12, ms_long_xla))
 
-    _log("pallas flash (4,8,1024,128) causal on %s: %.2f ms f32, "
-         "%.2f ms bf16, bwd %.2f ms (err %.2e), max_err %.2e"
-         % (platform, ms, ms16, ms_bwd, bwd_err, err))
-    return {"ms": ms, "ms_bf16": ms16, "ms_bwd": ms_bwd,
-            "bwd_max_err": bwd_err, "max_err": err,
-            "ms_long_t8192": ms_long, "platform": platform}
+    _log("pallas flash (4,8,1024,128) causal on %s, chained in-jit: "
+         "fwd %.2f ms f32 | %.2f ms bf16 (%.1f TF/s) vs XLA %.2f ms | "
+         "fwd+bwd %.2f ms vs XLA %.2f ms | errs fwd %.2e bwd %.2e"
+         % (platform, ms, ms16, tf(ms16), ms16_xla, ms_bwd, ms_bwd_xla,
+            err, bwd_err))
+    return {"ms": ms, "ms_bf16": ms16, "ms_bf16_xla": ms16_xla,
+            "tf_bf16": tf(ms16), "ms_bwd": ms_bwd,
+            "ms_bwd_xla": ms_bwd_xla, "bwd_max_err": bwd_err,
+            "max_err": err, "ms_long_t8192": ms_long,
+            "ms_long_t8192_xla": ms_long_xla, "platform": platform}
+
+
+def phase_flashtune():
+    """Block-size sweep for the flash kernel with the chained in-jit
+    harness — NOT in the default phase list; run manually on hardware
+    (``python bench.py --phase flashtune``) and bake the winner into
+    flash_attention's defaults."""
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops.pallas.flash import flash_attention
+
+    key = jax.random.key(0)
+    grid = {}
+    for t in (1024, 8192):
+        b, h, d = (4, 8, 128) if t == 1024 else (1, 8, 128)
+        q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16) * 0.1
+                   for kk in jax.random.split(key, 3))
+        flops = _causal_attn_flops(b, h, t, d)
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                fn = lambda q_, k_, v_: flash_attention(  # noqa: E731
+                    q_, k_, v_, causal=True, block_q=bq, block_k=bk)
+                try:
+                    ms = _chain_attn(fn, q, k, v, iters=10)
+                    ms_bwd = _chain_attn(fn, q, k, v, iters=5, grad=True)
+                except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+                    _log("T=%d bq=%d bk=%d: failed (%s)"
+                         % (t, bq, bk, type(e).__name__))
+                    continue
+                grid["t%d_q%d_k%d" % (t, bq, bk)] = {
+                    "ms": round(ms, 3), "ms_bwd": round(ms_bwd, 3),
+                    "tf": round(flops / (ms / 1e3) / 1e12, 1)}
+                _log("T=%d bq=%-3d bk=%-3d: fwd %.3f ms (%.1f TF/s) "
+                     "fwd+bwd %.3f ms"
+                     % (t, bq, bk, ms, flops / (ms / 1e3) / 1e12, ms_bwd))
+    return grid
 
 
 def phase_ring():
@@ -429,7 +615,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--phase", help="internal: run one phase")
     parser.add_argument("--budget", type=float,
-                        default=float(os.environ.get("BENCH_BUDGET", 1500)),
+                        default=float(os.environ.get("BENCH_BUDGET", 2400)),
                         help="global wall-clock budget, seconds")
     args = parser.parse_args()
 
@@ -452,12 +638,15 @@ def main():
     if probe_err:
         errors["probe"] = probe_err
     gflops = gemm.get("gflops", 0.0)
+    flash = results.get("flash", {})
     line = {
         "metric": "gemm_3001x3001_f32_gflops",
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(gflops / BASELINE_GEMM_GFLOPS, 2),
         "gemm_bf16_gflops": round(gemm.get("bf16_gflops", 0.0), 1),
+        "gemm_bf16_mfu": round(gemm.get("bf16_mfu", 0.0), 3),
+        "peak_bf16_tflops": gemm.get("peak_bf16_tflops", 0.0),
         "mlp_step_ms": round(results.get("mlp", {}).get("step_ms", 0.0), 3),
         "mlp_step_fused_ms": round(
             results.get("mlp", {}).get("step_fused_ms", 0.0), 3),
@@ -465,12 +654,25 @@ def main():
             results.get("alexnet", {}).get("samples_per_sec", 0.0), 1),
         "lm_tokens_per_sec": round(
             results.get("lm", {}).get("tokens_per_sec", 0.0), 1),
+        "lm_mfu": round(results.get("lm", {}).get("mfu", 0.0), 3),
+        "lm_large_tokens_per_sec": round(
+            results.get("lm_large", {}).get("tokens_per_sec", 0.0), 1),
+        "lm_large_mfu": round(
+            results.get("lm_large", {}).get("mfu", 0.0), 3),
         "kohonen_ms_per_step": round(
             results.get("kohonen", {}).get("ms_per_step", 0.0), 2),
         "kohonen_sweep_speedup": round(
             results.get("kohonen", {}).get("sweep_speedup", 0.0), 1),
-        "flash_ok": bool(results.get("flash", {}).get("ok")),
-        "flash_platform": results.get("flash", {}).get("platform"),
+        "flash_ok": bool(flash.get("ok")),
+        "flash_platform": flash.get("platform"),
+        "flash_ms_bf16": round(flash.get("ms_bf16", 0.0), 3),
+        "flash_ms_bf16_xla": round(flash.get("ms_bf16_xla", 0.0), 3),
+        "flash_ms_bwd": round(flash.get("ms_bwd", 0.0), 3),
+        "flash_ms_bwd_xla": round(flash.get("ms_bwd_xla", 0.0), 3),
+        "flash_bwd_max_err": flash.get("bwd_max_err", 0.0),
+        "flash_ms_long_t8192": round(flash.get("ms_long_t8192", 0.0), 2),
+        "flash_ms_long_t8192_xla": round(
+            flash.get("ms_long_t8192_xla", 0.0), 2),
         "ring_ok": bool(results.get("ring", {}).get("ok")),
         "error": ("; ".join("%s: %s" % kv for kv in sorted(errors.items()))
                   or None),

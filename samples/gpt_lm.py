@@ -27,9 +27,27 @@ from veles_tpu.models.zoo import transformer_lm
 _SYNTHETIC = (b"the quick brown fox jumps over the lazy dog. "
               b"pack my box with five dozen liquor jugs. " * 48)
 
+#: named model presets (``root.gpt.preset``); explicit --config-list
+#: values win over the preset's entries.  "large" is the MFU-credible
+#: single-chip flagship from bench.py's lm_large phase: GPT-2-small
+#: dims, remat, flash, RoPE, AdamW + clipping, tied embeddings.
+PRESETS = {
+    "large": {"d_model": 768, "n_heads": 12, "n_kv_heads": 12,
+              "n_layers": 12, "seq_len": 1024, "minibatch_size": 8,
+              "remat": True, "solver": "adamw", "learning_rate": 6e-4},
+}
+
 
 def run(load, main):
     cfg = root.gpt
+    preset = cfg.get("preset", None)
+    if preset is not None:
+        if preset not in PRESETS:
+            raise ValueError("unknown preset %r (have: %s)"
+                             % (preset, sorted(PRESETS)))
+        for k, v in PRESETS[preset].items():
+            if k not in cfg:           # explicit config wins
+                setattr(cfg, k, v)
     path = cfg.get("text_file", None)
     if path:
         # an explicitly configured corpus that is missing must fail
@@ -39,6 +57,11 @@ def run(load, main):
     else:
         text = _SYNTHETIC
     seq = cfg.get("seq_len", 64)
+    if path is None and len(text) < 16 * seq:
+        # the built-in corpus tiles up to the configured context length
+        # (preset "large" wants T=1024); an explicit text_file stays
+        # strict — see the loud failure above
+        text = text * (16 * seq // len(text) + 1)
     n = len(text) // seq
     if n < 8:
         raise ValueError("corpus too small: %d bytes for seq_len %d"
@@ -65,6 +88,7 @@ def run(load, main):
              n_experts=cfg.get("n_experts", 0),
              tie_embeddings=bool(cfg.get("tie_embeddings", True)),
              window=cfg.get("window", None),
+             solver=cfg.get("solver", "adam"),
              lr=cfg.get("learning_rate", 1e-3)),
          loader=loader, loss="lm",
          gd_defaults={"clip_norm": cfg.get("clip_norm", 1.0)},

@@ -80,6 +80,25 @@ class TestCLI:
         # repr may single- or double-quote depending on content
         assert "generated: " in r.stdout and "the quick" in r.stdout
 
+    def test_gpt_lm_preset(self, tmp_path):
+        """preset=large applies its entries (remat/adamw survive) while
+        explicit --config-list values win over the preset's dims."""
+        out = str(tmp_path / "res.json")
+        r = _cli(["samples/gpt_lm.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.gpt.preset='large'",
+                  "root.gpt.max_epochs=1", "root.gpt.n_layers=1",
+                  "root.gpt.d_model=32", "root.gpt.seq_len=32",
+                  "root.gpt.n_heads=4", "root.gpt.n_kv_heads=4",
+                  "root.gpt.minibatch_size=16",
+                  "--result-file", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.load(open(out))["epochs"] == 1
+        r = _cli(["samples/gpt_lm.py", "--backend", "cpu",
+                  "--config-list", "root.gpt.preset='nope'"])
+        assert r.returncode != 0
+        assert "unknown preset" in r.stderr
+
     def test_kohonen_sample(self):
         r = _cli(["samples/digits_kohonen.py", "--backend", "cpu",
                   "--random-seed", "5",
