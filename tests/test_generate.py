@@ -15,7 +15,8 @@ from veles_tpu.models.generate import LMGenerator
 from veles_tpu.models.standard_workflow import StandardWorkflow
 
 
-def _lm_workflow(max_epochs=0, vocab=13, t=16, seed=31, **zoo_kwargs):
+def _lm_workflow(max_epochs=0, vocab=13, t=16, seed=31, mesh_config=None,
+                 **zoo_kwargs):
     prng.seed_all(seed)
     r = np.random.RandomState(5)
     n = 192
@@ -30,7 +31,7 @@ def _lm_workflow(max_epochs=0, vocab=13, t=16, seed=31, **zoo_kwargs):
                                   **zoo_kwargs),
         loader=loader, loss="lm",
         decision_config={"max_epochs": max(max_epochs, 1)},
-        name="gen-lm")
+        mesh_config=mesh_config, name="gen-lm")
     wf.initialize()
     if max_epochs > 0:
         wf.run()
@@ -180,6 +181,52 @@ def test_beam_search_matches_greedy_at_beam1_and_scores_exactly():
 
     with pytest.raises(ValueError):
         gen.beam_search(prompt, max_new=6, beam=0)
+
+
+def test_tensor_parallel_decode_matches_single_device(f32_precision):
+    """A model trained under a {model: 2} mesh decodes through the SAME
+    sharded params (column-parallel projections, head-sharded KV caches);
+    greedy tokens must match the single-device path and the full logits
+    must agree to numerical tolerance (the psum over the contracted
+    model axis reorders float adds)."""
+    import jax
+    from veles_tpu.parallel import MeshConfig, make_mesh
+
+    mc = MeshConfig(make_mesh({"model": 2}, jax.devices()[:2]))
+    wf, toks = _lm_workflow(max_epochs=10, mesh_config=mc,
+                            n_kv_heads=2)
+    gen_tp = LMGenerator(wf.trainer, max_len=16)        # auto: trainer mesh
+    assert gen_tp.mesh_cfg is mc
+    prompt = toks[:4, :8]
+    out_tp = gen_tp.generate(prompt, max_new=6)
+
+    # reference: identical training run without a mesh
+    wf1, _ = _lm_workflow(max_epochs=10, n_kv_heads=2)
+    gen1 = LMGenerator(wf1.trainer, max_len=16)
+    assert gen1.mesh_cfg is None
+    np.testing.assert_allclose(
+        np.asarray(wf.trainer.params["l00_embedding"]["table"]),
+        np.asarray(wf1.trainer.params["l00_embedding"]["table"]),
+        rtol=1e-4, atol=1e-5)                          # same training
+    out1 = gen1.generate(prompt, max_new=6)
+    np.testing.assert_array_equal(out_tp, out1)
+    np.testing.assert_allclose(gen_tp.score(toks[:2]), gen1.score(toks[:2]),
+                               rtol=2e-3, atol=2e-3)
+    # beam search rides the same sharded step
+    bt, bs = gen_tp.beam_search(prompt, max_new=4, beam=3)
+    b1, s1 = gen1.beam_search(prompt, max_new=4, beam=3)
+    np.testing.assert_array_equal(bt, b1)
+    np.testing.assert_allclose(bs, s1, rtol=1e-3, atol=1e-3)
+
+
+def test_tensor_parallel_decode_rejects_indivisible_kv_heads():
+    import jax
+    from veles_tpu.parallel import MeshConfig, make_mesh
+
+    mc = MeshConfig(make_mesh({"model": 4}, jax.devices()[:4]))
+    wf, _ = _lm_workflow(max_epochs=0, mesh_config=mc, n_kv_heads=2)
+    with pytest.raises(ValueError, match="divisible by the model axis"):
+        LMGenerator(wf.trainer, max_len=16)
 
 
 def test_incremental_matches_full_forward_window(f32_precision):

@@ -82,6 +82,30 @@ class TestPipelineSchedule:
                                    np.asarray(g_ref["w"]),
                                    rtol=5e-4, atol=5e-4)
 
+    def test_data_pipe_combined_matches_sequential(self):
+        """Combined {data, pipe} mesh: each data slice pipelines its own
+        batch rows (batch_axis) — outputs AND gradients must match the
+        sequential scan (round-1's stage-dropping bug was exactly a
+        combined-config class; this pins the data x pipe member)."""
+        params = _stacked_params(4)
+        x = jnp.asarray(np.random.RandomState(5).randn(16, 8)
+                        .astype(np.float32))
+        mesh = make_mesh({"data": 2, "pipe": 4})
+
+        def pp(p, xx):
+            return pipeline.pipeline_apply_sharded(
+                _stage_fn, p, xx, mesh, n_microbatches=2,
+                batch_axis="data")
+
+        ref = _sequential(params, x)
+        np.testing.assert_allclose(np.asarray(pp(params, x)),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+        g_ref = jax.grad(lambda p: jnp.sum(_sequential(p, x) ** 2))(params)
+        g_pp = jax.grad(lambda p: jnp.sum(pp(p, x) ** 2))(params)
+        np.testing.assert_allclose(np.asarray(g_pp["w"]),
+                                   np.asarray(g_ref["w"]),
+                                   rtol=5e-4, atol=5e-4)
+
 
 class TestPipelinedTransformerLayer:
     def test_sharded_matches_sequential_scan(self):
@@ -134,6 +158,50 @@ class TestPipelinedTraining:
         wf.run()
         res = wf.gather_results()
         assert res["epochs"] == 2 and res["best_metric"] is not None
+
+
+    def test_trains_on_combined_data_pipe_mesh(self):
+        """The full hot loop on {data: 2, pipe: 2}: training converges to
+        the same metrics as the meshless run (float-reorder tolerance)."""
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+        from veles_tpu.parallel import MeshConfig
+
+        def run(mesh_config):
+            prng.seed_all(55)
+            n = 16
+            x = np.random.RandomState(0).rand(2 * n, 8, 4)\
+                .astype(np.float32)
+            y = np.random.RandomState(1).randint(0, 3, 2 * n)\
+                .astype(np.int32)
+            loader = FullBatchLoader(None, data=x, labels=y,
+                                     minibatch_size=8,
+                                     class_lengths=[0, n, n])
+            gd = {"learning_rate": 0.01, "gradient_moment": 0.9,
+                  "solver": "adam"}
+            wf = StandardWorkflow(
+                layers=[dict({"type": "timestep_dense",
+                              "output_sample_shape": 16}, **gd),
+                        {"type": "positional_encoding"},
+                        dict({"type": "pipelined_transformer",
+                              "n_blocks": 4, "n_heads": 2,
+                              "n_microbatches": 2}, **gd),
+                        {"type": "seq_pool", "mode": "mean"},
+                        dict({"type": "softmax",
+                              "output_sample_shape": 3}, **gd)],
+                loader=loader, decision_config={"max_epochs": 2},
+                mesh_config=mesh_config, name="dp-pp-train")
+            wf.initialize()
+            wf.run()
+            return wf.gather_results()
+
+        res = run(MeshConfig(make_mesh({"data": 2, "pipe": 2},
+                                       jax.devices()[:4])))
+        ref = run(None)
+        assert res["epochs"] == ref["epochs"] == 2
+        assert res["best_metric"] == pytest.approx(ref["best_metric"],
+                                                   rel=1e-3)
 
 
 class TestParamSharding:

@@ -37,7 +37,8 @@ class LMGenerator:
         out = gen.generate(prompt, max_new=32, temperature=0.8, seed=1)
     """
 
-    def __init__(self, trainer, max_len, cache_dtype=None):
+    def __init__(self, trainer, max_len, cache_dtype=None,
+                 mesh_cfg="auto"):
         self.params = trainer.params
         self.max_len = int(max_len)
         #: KV-cache storage dtype; default follows the params.  bfloat16
@@ -46,6 +47,17 @@ class LMGenerator:
         self.cache_dtype = cache_dtype
         self._compiled = collections.OrderedDict()
         self._cache_lock = threading.Lock()
+        #: tensor-parallel decode: when the trainer ran under a mesh
+        #: (``mesh_cfg="auto"``) or one is passed explicitly, the decode
+        #: scan runs against the training shardings — column-parallel
+        #: projections, KV caches sharded over the kv-head dim on the
+        #: model axis, GSPMD inserting the collectives.  A model trained
+        #: with TP/FSDP serves at the size it was trained.  (The
+        #: reference only ever served single-process forward passes,
+        #: restful_api.py:112-217.)
+        if mesh_cfg == "auto":
+            mesh_cfg = getattr(trainer, "mesh_config", None)
+        self.mesh_cfg = mesh_cfg
         layers = trainer.layers
         by_type = {}
         self._blocks = []
@@ -77,6 +89,16 @@ class LMGenerator:
                 % (self.max_len, self._posenc.input_shape[0]))
         b0 = self._blocks[0]
         self._head_dim = b0.input_shape[-1] // b0.n_heads
+        if self.mesh_cfg is not None and self.mesh_cfg.model_size > 1:
+            m = self.mesh_cfg.model_size
+            for layer in self._blocks:
+                # the KV cache shards its head dim over the model axis,
+                # so every block's kv heads must divide the axis size
+                if layer.n_kv_heads % m:
+                    raise ValueError(
+                        "tensor-parallel decode needs n_kv_heads (%d) "
+                        "divisible by the model axis size (%d)"
+                        % (layer.n_kv_heads, m))
 
     # ------------------------------------------------------------------
     def _pos_row(self, params, pos):
@@ -104,12 +126,21 @@ class LMGenerator:
         logits = self._head.apply(head_p, x)
         return logits[:, 0].astype(jnp.float32), new_caches
 
+    def _cache_constraint(self, c):
+        """Pin a KV cache's head dim to the model axis under a mesh —
+        the annotation GSPMD propagates through the whole decode scan."""
+        if self.mesh_cfg is None or self.mesh_cfg.model_size <= 1:
+            return c
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.lax.with_sharding_constraint(
+            c, NamedSharding(self.mesh_cfg.mesh,
+                             P(None, self.mesh_cfg.model_axis)))
+
     def _init_caches(self, batch, dtype):
         dtype = self.cache_dtype or dtype
-        return [(jnp.zeros((batch, layer.n_kv_heads, self.max_len,
-                            self._head_dim), dtype),
-                 jnp.zeros((batch, layer.n_kv_heads, self.max_len,
-                            self._head_dim), dtype))
+        return [tuple(self._cache_constraint(
+            jnp.zeros((batch, layer.n_kv_heads, self.max_len,
+                       self._head_dim), dtype)) for _ in range(2))
                 for layer in self._blocks]
 
     def _scan_fn(self, batch, greedy):

@@ -748,9 +748,14 @@ class PipelinedTransformer(Layer):
 
         if self.mesh is not None and "pipe" in self.mesh.shape:
             from veles_tpu.parallel import pipeline
+            # combined data x pipe mesh: keep each data slice's batch
+            # rows local to its own pipeline instance
+            batch_axis = ("data" if self.mesh.shape.get("data", 1) > 1
+                          else None)
             return pipeline.pipeline_apply_sharded(
                 fn, params["stages"], x, self.mesh,
-                n_microbatches=self.n_microbatches)
+                n_microbatches=self.n_microbatches,
+                batch_axis=batch_axis)
         h, _ = jax.lax.scan(lambda h, p: (fn(p, h), None), x,
                             params["stages"])
         return h

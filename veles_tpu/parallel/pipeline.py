@@ -61,12 +61,19 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
 
 
 def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
-                           pipe_axis="pipe", n_microbatches=4):
+                           pipe_axis="pipe", n_microbatches=4,
+                           batch_axis=None):
     """Global entry: ``stacked_params`` has a leading stage axis [S, ...]
     on every leaf, sharded over ``pipe_axis``.  With S == pipe size each
     device keeps one stage; with S == k * pipe size each device keeps k
     consecutive stages and runs them as one scanned "superstage" (fewer
-    ICI hops, same math).  ``x`` replicates.  jit/grad-composable."""
+    ICI hops, same math).  jit/grad-composable.
+
+    ``batch_axis``: on a combined {data, pipe} mesh, shard x's batch dim
+    over the data axis — each data slice streams ITS OWN microbatches
+    through an independent pipeline (the ppermute hops stay within each
+    data row of the mesh); without it x replicates and the data axis
+    would redundantly recompute the full batch."""
     pipe_size = mesh.shape[pipe_axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] % pipe_size:
@@ -74,6 +81,7 @@ def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
                 "stacked stage dim %d not divisible by %s axis size %d"
                 % (leaf.shape[0], pipe_axis, pipe_size))
     pspec = jax.tree_util.tree_map(lambda _: P(pipe_axis), stacked_params)
+    xspec = P(batch_axis) if batch_axis else P()
 
     def fn(params, xs):
         def superstage(p, h):
@@ -82,5 +90,5 @@ def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
         return pipeline_apply(superstage, params, xs, pipe_axis,
                               n_microbatches)
 
-    return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
-                     check_vma=False)(stacked_params, x)
+    return shard_map(fn, mesh=mesh, in_specs=(pspec, xspec),
+                     out_specs=xspec, check_vma=False)(stacked_params, x)
