@@ -40,6 +40,7 @@ PHASES = [
     ("flash", 600),
     ("mlp", 420),
     ("alexnet", 600),
+    ("beam", 420),
     ("ring", 420),
     ("kohonen", 300),
 ]
@@ -460,6 +461,56 @@ def phase_flash():
             "ms_long_t8192_xla": ms_long_xla, "platform": platform}
 
 
+def phase_beam():
+    """Long-context beam-search decode rate (T=4096, beam=8) vs greedy —
+    the number that prices the per-step full-cache reorder documented at
+    models/generate.py (O(T²·beam) HBM traffic per decode)."""
+    import numpy as np
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.generate import LMGenerator
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+    import jax.numpy as jnp
+
+    prng.seed_all(9)
+    # BENCH_BEAM_T: CPU smoke tests shrink the context (4095 scan
+    # positions are a TPU-scale workload)
+    t_max = int(os.environ.get("BENCH_BEAM_T", 4096))
+    beam = 8
+    toks = np.random.RandomState(0).randint(
+        0, 512, (8, 32)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=4, class_lengths=[0, 0, 8])
+    wf = StandardWorkflow(
+        layers=transformer_lm(vocab_size=512, d_model=256, n_heads=8,
+                              n_kv_heads=2, n_layers=2, dropout=0.0,
+                              pos="rope", impl="flash"),
+        loader=loader, loss="lm",
+        decision_config={"max_epochs": 1}, name="bench-beam")
+    wf.initialize()
+    gen = LMGenerator(wf.trainer, max_len=t_max,
+                      cache_dtype=jnp.bfloat16)
+    prompt = toks[:1, :16]
+
+    def timed(fn):
+        fn()                              # compile + warmup
+        t0 = time.perf_counter()
+        fn()
+        # the scan always runs all t_max - 1 positions (traced lengths)
+        return (time.perf_counter() - t0) / (t_max - 1) * 1e3
+
+    ms_beam = timed(lambda: gen.beam_search(prompt, max_new=64,
+                                            beam=beam))
+    ms_greedy = timed(lambda: gen.generate(prompt, max_new=64))
+    _log("beam decode T=%d beam=%d (2L d=256 lm): %.3f ms/pos beam, "
+         "%.3f ms/pos greedy (reorder cost x%.1f)"
+         % (t_max, beam, ms_beam, ms_greedy,
+            ms_beam / ms_greedy if ms_greedy else 0.0))
+    return {"ms_per_pos_beam8": ms_beam, "ms_per_pos_greedy": ms_greedy,
+            "t": t_max}
+
+
 def phase_flashtune():
     """Block-size sweep for the flash kernel with the chained in-jit
     harness — NOT in the default phase list; run manually on hardware
@@ -673,6 +724,11 @@ def main():
         "flash_ms_long_t8192": round(flash.get("ms_long_t8192", 0.0), 2),
         "flash_ms_long_t8192_xla": round(
             flash.get("ms_long_t8192_xla", 0.0), 2),
+        # only a genuine T=4096 run may claim the headline key (a
+        # BENCH_BEAM_T-shrunken smoke must not masquerade as it)
+        "beam_ms_per_pos_t4096": round(
+            results.get("beam", {}).get("ms_per_pos_beam8", 0.0)
+            if results.get("beam", {}).get("t") == 4096 else 0.0, 3),
         "ring_ok": bool(results.get("ring", {}).get("ok")),
         "error": ("; ".join("%s: %s" % kv for kv in sorted(errors.items()))
                   or None),

@@ -210,6 +210,56 @@ class TestCLIMeta:
         assert len(res["history"]) == 2
         assert res["best_fitness"] > -1.0   # a real error rate, not -inf
 
+    def test_optimize_distributed_workers(self, tmp_path):
+        """VERDICT r2 #7: GA fitness spread over SEPARATE worker
+        processes — coordinator serves the chromosome queue (0 local
+        evaluators), two --optimize-worker processes pull and evaluate
+        concurrently, and BOTH must do real work."""
+        import socket
+        import time as _time
+
+        with socket.socket() as s:      # pick a free port up front
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out = str(tmp_path / "opt.json")
+        wf_args = ["samples/digits_mlp.py", "samples/digits_config.py",
+                   "--backend", "cpu", "--random-seed", "7",
+                   "--config-list", "root.digits.max_epochs=1",
+                   "root.digits.learning_rate=Range(0.05, 0.3)"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        coord = subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu"] + wf_args +
+            ["--optimize", "4:2",
+             "--optimize-workers", "0@127.0.0.1:%d" % port,
+             "--result-file", out],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        _time.sleep(2)                  # let the queue come up
+        workers = [subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu"] + wf_args +
+            ["--optimize-worker", "127.0.0.1:%d" % port],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for _ in range(2)]
+        try:
+            c_out, c_err = coord.communicate(timeout=900)
+            assert coord.returncode == 0, c_err[-2000:]
+            counts = []
+            for w in workers:
+                w_out, w_err = w.communicate(timeout=120)
+                assert w.returncode == 0, w_err[-2000:]
+                counts.append(json.loads(
+                    w_out.splitlines()[-1])["optimize_worker"]["evaluated"])
+            # every evaluation ran on a worker, and both workers worked
+            assert sum(counts) >= 4 and all(c >= 1 for c in counts), counts
+            res = json.load(open(out))["optimize"]
+            assert 0.05 <= res["best_config"][
+                "root.digits.learning_rate"] <= 0.3
+            assert res["best_fitness"] > -1.0
+        finally:
+            for p in [coord] + workers:
+                if p.poll() is None:
+                    p.kill()
+
     def test_optimize_without_ranges_fails_clearly(self):
         r = _cli(["samples/digits_mlp.py", "samples/digits_config.py",
                   "--backend", "cpu", "--optimize", "2:1"])

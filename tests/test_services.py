@@ -155,6 +155,15 @@ class TestWebStatus:
             status = json.loads(_get(base + "/api/status"))
             assert status["remote"][-1]["update"]["epoch"] == 3
             assert isinstance(json.loads(_get(base + "/api/events")), list)
+            # sparkline series: per-epoch metric events from the ring
+            assert b"sparkline" in _get(base + "/")
+            from veles_tpu.logger import events
+            for ep, loss in ((1, 0.8), (2, 0.5), (3, 0.3)):
+                events.add({"name": "epoch", "cat": "Decision",
+                            "type": "single", "time": 0.0, "epoch": ep,
+                            "valid_loss": loss})
+            series = json.loads(_get(base + "/api/metrics"))
+            assert series["valid_loss"] == [[1, 0.8], [2, 0.5], [3, 0.3]]
         finally:
             server.stop()
 

@@ -98,9 +98,25 @@ class Main(object):
                        help="genetic hyperparameter search over Range() "
                        "config leaves: population SIZE, GENS generations "
                        "(ref veles --optimize, __main__.py:334-345)")
-        p.add_argument("--optimize-workers", type=int, default=1,
+        p.add_argument("--optimize-workers", default="1",
                        help="concurrent fitness evaluations (each is its "
-                       "own training subprocess; >1 pins children to cpu)")
+                       "own training subprocess; >1 pins children to "
+                       "cpu).  'N@HOST:PORT' additionally serves the "
+                       "chromosome queue over HTTP so remote "
+                       "--optimize-worker processes on other hosts pull "
+                       "evaluations (N local evaluators share the queue; "
+                       "N=0 = remote-only — the run then waits for "
+                       "workers to connect).  Ref: distributed GA "
+                       "fitness, genetics/optimization_workflow.py:"
+                       "181-216")
+        p.add_argument("--optimize-worker", default=None,
+                       metavar="HOST:PORT",
+                       help="run as a fitness worker: pull chromosome "
+                       "jobs from a coordinator's --optimize-workers "
+                       "queue, train this workflow locally per job, "
+                       "post the fitness back; exits when the "
+                       "coordinator finishes (ref: the slave side of "
+                       "the GA job protocol)")
         p.add_argument("--optimize-encoding", default="float",
                        choices=("float", "gray"),
                        help="chromosome encoding: float vector or the "
@@ -162,6 +178,8 @@ class Main(object):
         if args.steps_per_dispatch is not None:
             root.common.engine.steps_per_dispatch = args.steps_per_dispatch
 
+        if args.optimize_worker:
+            return self._run_optimize_worker(args)
         if args.optimize:
             return self._run_optimize(args)
         if args.ensemble_train:
@@ -407,17 +425,74 @@ class Main(object):
                 return list(pool.map(f, xs))
         return pmap
 
+    def _evaluate_leaves(self, args, leaves, workers):
+        """ONE fitness evaluation: full training subprocess with the
+        chromosome's {dotted-path: value} overrides; --result-file
+        best_metric (lower is better) becomes -fitness.  Shared by the
+        local GA executor and the --optimize-worker loop."""
+        import subprocess
+        import tempfile
+
+        overrides = ["root.%s=%r" % (p, v) for p, v in leaves.items()]
+        seed_flags = ([] if args.random_seed is None
+                      else ["--random-seed", str(args.random_seed)])
+        with tempfile.NamedTemporaryFile("r", suffix=".json") as tmp:
+            argv = self._child_argv(
+                args, overrides,
+                ["--result-file", tmp.name] + seed_flags,
+                workers=workers)
+            try:
+                r = subprocess.run(
+                    argv, capture_output=True, text=True,
+                    timeout=self._child_timeout(),
+                    env=self._child_env(workers))
+            except subprocess.TimeoutExpired:
+                print("[optimize] evaluation timed out", file=sys.stderr)
+                return float("-inf")
+            if r.returncode != 0:
+                print("[optimize] evaluation failed: %s"
+                      % r.stderr[-500:], file=sys.stderr)
+                return float("-inf")
+            metric = json.load(open(tmp.name)).get("best_metric")
+        return float("-inf") if metric is None else -float(metric)
+
+    @staticmethod
+    def _parse_optimize_workers(spec):
+        """'N' -> (N, None); 'N@HOST:PORT' -> (N, 'HOST:PORT')."""
+        head, _, addr = str(spec).partition("@")
+        try:
+            n = int(head)
+            if addr:
+                int(addr.rpartition(":")[2])   # PORT must be numeric
+        except ValueError:
+            raise SystemExit("--optimize-workers: expected N or "
+                             "N@HOST:PORT, got %r" % (spec,))
+        return n, (addr or None)
+
+    def _run_optimize_worker(self, args):
+        """The slave side of the GA job protocol: pull chromosome jobs
+        from the coordinator's queue, train locally, post fitness."""
+        from veles_tpu.genetics.distributed import run_worker
+
+        count = run_worker(
+            args.optimize_worker,
+            lambda leaves: self._evaluate_leaves(args, leaves, workers=1))
+        print(json.dumps({"optimize_worker": {"evaluated": count}}))
+        return 0
+
     def _run_optimize(self, args):
         """--optimize SIZE[:GENS] (ref veles/__main__.py:334-345): GA over
         every Range() leaf in the config tree; each fitness evaluation is
         a full training subprocess whose --result-file best_metric (lower
-        is better) becomes -fitness."""
-        import subprocess
-        import tempfile
-
+        is better) becomes -fitness.  With --optimize-workers N@HOST:PORT
+        the evaluations additionally spread over remote --optimize-worker
+        processes (ref distributed fitness,
+        genetics/optimization_workflow.py:181-216)."""
         from veles_tpu.genetics.core import extract_ranges
         from veles_tpu.genetics.optimizer import GeneticsOptimizer
 
+        n_workers, queue_addr = self._parse_optimize_workers(
+            args.optimize_workers)
         head, _, tail = args.optimize.partition(":")
         size, generations = int(head), int(tail) if tail else 10
         cfg = root.as_dict()
@@ -432,38 +507,48 @@ class Main(object):
                 tree = tree[k]
             return tree
 
-        seed_flags = ([] if args.random_seed is None
-                      else ["--random-seed", str(args.random_seed)])
-
         def evaluate(config):
-            overrides = ["root.%s=%r" % (".".join(p), leaf(config, p))
-                         for p, _ in paths]
-            with tempfile.NamedTemporaryFile("r", suffix=".json") as tmp:
-                argv = self._child_argv(
-                    args, overrides,
-                    ["--result-file", tmp.name] + seed_flags,
-                    workers=args.optimize_workers)
-                try:
-                    r = subprocess.run(
-                        argv, capture_output=True, text=True,
-                        timeout=self._child_timeout(),
-                        env=self._child_env(args.optimize_workers))
-                except subprocess.TimeoutExpired:
-                    print("[optimize] evaluation timed out",
-                          file=sys.stderr)
-                    return float("-inf")
-                if r.returncode != 0:
-                    print("[optimize] evaluation failed: %s"
-                          % r.stderr[-500:], file=sys.stderr)
-                    return float("-inf")
-                metric = json.load(open(tmp.name)).get("best_metric")
-            return float("-inf") if metric is None else -float(metric)
+            return self._evaluate_leaves(
+                args, {".".join(p): leaf(config, p) for p, _ in paths},
+                workers=n_workers)
 
-        opt = GeneticsOptimizer(
-            cfg, evaluate, size=size, generations=generations,
-            encoding=args.optimize_encoding,
-            executor_map=self._executor_map(args.optimize_workers))
-        best = opt.run()
+        queue = None
+        executor_map = self._executor_map(n_workers)
+        if queue_addr:
+            from veles_tpu.genetics.distributed import FitnessQueue
+            host, _, port = queue_addr.rpartition(":")
+            # lease > child watchdog + margin: an evaluation that itself
+            # times out must post its -inf BEFORE the lease expires, or
+            # a second worker redundantly re-runs the doomed config
+            queue = FitnessQueue(host or "0.0.0.0", int(port or 0),
+                                 job_timeout=self._child_timeout() + 120)
+            queue.start()
+            print("[optimize] serving chromosome queue on %s:%d — "
+                  "workers join with --optimize-worker HOST:%d"
+                  % (queue.host, queue.port, queue.port),
+                  file=sys.stderr)
+
+            def executor_map(f, configs):  # noqa: F811 — queue mode
+                return queue.map(
+                    lambda leaves: self._evaluate_leaves(
+                        args, leaves, workers=max(n_workers, 1)),
+                    [{".".join(p): leaf(c, p) for p, _ in paths}
+                     for c in configs],
+                    local_workers=n_workers)
+
+        try:
+            opt = GeneticsOptimizer(
+                cfg, evaluate, size=size, generations=generations,
+                encoding=args.optimize_encoding,
+                executor_map=executor_map)
+            best = opt.run()
+        finally:
+            if queue is not None:
+                queue.shutdown()
+                # give polling workers a beat to read the done signal
+                import time as _time
+                _time.sleep(1.5)
+                queue.stop()
         if opt.population.best.fitness == float("-inf"):
             print("--optimize: every fitness evaluation failed — no "
                   "usable result", file=sys.stderr)

@@ -1,0 +1,219 @@
+"""Distributed GA fitness — a stdlib-HTTP work queue.
+
+The reference distributed genetic-optimization fitness evaluations across
+its master/slave cluster over Twisted
+(veles/genetics/optimization_workflow.py:181-216: the master forked a
+training process per chromosome and slaves pulled jobs).  The TPU-era
+equivalent keeps the shape — a coordinator owns the population, workers
+anywhere pull one chromosome at a time, train the workflow locally and
+post the fitness back — but the wire is plain HTTP/JSON on the stdlib
+server (no Twisted, no pickle on the wire; the payload is the flat
+{dotted-config-path: value} dict of Range leaves).
+
+Coordinator:  ``--optimize SIZE:GENS --optimize-workers N@HOST:PORT``
+              (N local evaluator threads pull from the SAME queue, so
+              local and remote capacity compose; N=0 = remote-only)
+Worker:       ``python -m veles_tpu <workflow> <config>
+              --optimize-worker HOST:PORT`` on any host that has the
+              workflow files (the same requirement the reference's
+              slaves had).
+
+Fault tolerance: a job leased to a worker that dies re-enters the queue
+after ``job_timeout`` (the child-training watchdog); results arriving
+twice are ignored (first one wins)."""
+
+import collections
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.logger import Logger
+
+
+class FitnessQueue(Logger):
+    """Coordinator side: job queue + HTTP endpoints.
+
+    GET  /job     -> {"id": int, "leaves": {...}} | {"idle": true}
+                     | {"done": true}
+    POST /result  body {"id": int, "fitness": float} -> {"ok": true}
+    """
+
+    def __init__(self, host="0.0.0.0", port=0, job_timeout=1800.0):
+        super(FitnessQueue, self).__init__()
+        self.host, self.port = host, int(port)
+        self.job_timeout = float(job_timeout)
+        self._lock = threading.Lock()
+        self._pending = collections.deque()
+        self._inflight = {}            # id -> (job, lease deadline)
+        self._results = {}             # id -> fitness
+        self._jobs = {}                # id -> job (for requeue)
+        self._next_id = 0
+        self._shutdown = False
+        self._server = None
+
+    # ------------------------------------------------------------ queue
+    def _take(self):
+        with self._lock:
+            if self._shutdown:
+                return {"done": True}
+            now = time.monotonic()
+            for jid, (job, deadline) in list(self._inflight.items()):
+                if now > deadline:     # worker died — re-lease
+                    del self._inflight[jid]
+                    self._pending.append(job)
+                    self.warning("job %d lease expired — requeued", jid)
+            if not self._pending:
+                return {"idle": True}
+            job = self._pending.popleft()
+            self._inflight[job["id"]] = (job,
+                                         now + self.job_timeout)
+            return job
+
+    def _post_result(self, jid, fitness):
+        with self._lock:
+            if jid not in self._jobs or jid in self._results:
+                return                 # duplicate / unknown: first wins
+            self._results[jid] = float(fitness)
+            self._inflight.pop(jid, None)
+
+    # ----------------------------------------------------------- server
+    def start(self):
+        queue = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/job":
+                    self.send_error(404)
+                    return
+                self._send(queue._take())
+
+            def do_POST(self):
+                if self.path != "/result":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length))
+                    queue._post_result(int(req["id"]),
+                                       float(req["fitness"]))
+                except (ValueError, KeyError, TypeError) as e:
+                    self.send_error(400, str(e))
+                    return
+                self._send({"ok": True})
+
+            def log_message(self, fmt, *args):
+                queue.debug("queue http: " + fmt, *args)
+
+        class Server(ThreadingHTTPServer):
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        self.info("fitness queue serving on %s:%d", self.host, self.port)
+
+    def shutdown(self):
+        """Tell pollers to exit, then stop serving (keep the socket open
+        briefly so waiting workers can read the ``done`` signal)."""
+        with self._lock:
+            self._shutdown = True
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # -------------------------------------------------------------- map
+    def map(self, evaluate_leaves, leaves_list, local_workers=0):
+        """Evaluate every leaves-dict; block until all fitnesses arrive
+        (from local threads and/or remote workers).  Returns fitnesses
+        in input order."""
+        with self._lock:
+            ids = []
+            for leaves in leaves_list:
+                job = {"id": self._next_id, "leaves": leaves}
+                self._next_id += 1
+                self._jobs[job["id"]] = job
+                self._pending.append(job)
+                ids.append(job["id"])
+
+        def local_loop():
+            while True:
+                job = self._take()
+                if "id" in job:
+                    self._post_result(job["id"],
+                                      evaluate_leaves(job["leaves"]))
+                    continue
+                if job.get("done"):
+                    return
+                # idle — but a remote lease may still expire and requeue,
+                # so stay alive until every job of THIS map resolved
+                with self._lock:
+                    if all(jid in self._results for jid in ids):
+                        return
+                time.sleep(0.2)
+
+        threads = [threading.Thread(target=local_loop, daemon=True)
+                   for _ in range(local_workers)]
+        for t in threads:
+            t.start()
+        while True:
+            with self._lock:
+                if all(jid in self._results for jid in ids):
+                    break
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+        with self._lock:
+            return [self._results[jid] for jid in ids]
+
+
+# ------------------------------------------------------------------ worker
+def run_worker(address, evaluate_leaves, poll_interval=1.0,
+               max_connect_failures=30):
+    """Worker loop: pull jobs from ``address`` ("HOST:PORT"), evaluate,
+    post fitness.  Returns the number of jobs evaluated.  Exits when the
+    coordinator signals ``done`` or goes away for
+    ``max_connect_failures`` consecutive polls."""
+    base = "http://%s" % address
+    evaluated = 0
+    failures = 0
+    while True:
+        try:
+            with urllib.request.urlopen(base + "/job", timeout=30) as r:
+                job = json.loads(r.read())
+            failures = 0
+        except OSError:
+            failures += 1
+            if failures >= max_connect_failures:
+                return evaluated       # coordinator is gone
+            time.sleep(poll_interval)
+            continue
+        if job.get("done"):
+            return evaluated
+        if "id" not in job:
+            time.sleep(poll_interval)
+            continue
+        fitness = evaluate_leaves(job["leaves"])
+        body = json.dumps({"id": job["id"],
+                           "fitness": fitness}).encode()
+        req = urllib.request.Request(
+            base + "/result", body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30).read()
+        except OSError:
+            pass                       # lease expiry will requeue it
+        evaluated += 1

@@ -7,6 +7,8 @@ step), tracks the best validation metric, and raises ``complete`` when
 training should stop: ``fail_iterations`` epochs without improvement, or
 ``max_epochs`` reached."""
 
+import math
+
 import numpy as np
 
 from veles_tpu.loader.base import CLASS_NAMES, TRAIN, VALID
@@ -90,11 +92,23 @@ class DecisionBase(Unit):
 
     def _log_epoch(self, loader):
         parts = []
+        payload = {"epoch": int(loader.epoch_number)}
         for cls in (TRAIN, VALID):
             st = self.epoch_metrics[cls]
             if st:
                 parts.append("%s %s" % (CLASS_NAMES[cls],
                                         self.format_stats(st)))
+                for k, v in st.items():
+                    try:   # numeric scalars feed the dashboard series
+                        fv = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    if math.isfinite(fv):   # NaN would poison the JSON
+                        payload[CLASS_NAMES[cls] + "_" + k] = fv
+        # structured per-epoch metric event: the web dashboard's
+        # /api/metrics sparklines read these from the event ring (ref
+        # the node.js status app's live charts, web/)
+        self.event("epoch", "single", **payload)
         self.info("epoch %d: %s%s", loader.epoch_number, "; ".join(parts),
                   " *" if bool(self.improved) else "")
 

@@ -314,6 +314,15 @@ class LMGenerator:
                                   axis=0).reshape(batch, beam, -1)
                 tokens = jax.lax.dynamic_update_slice(
                     tokens, tok[:, :, None], (0, 0, pos + 1))
+                # physical cache reorder: every step gathers the FULL
+                # [B·beam, H, T_max, D] cache along the parent rows —
+                # O(T·beam·H·D) HBM write traffic per position, so
+                # O(T²·beam·H·D) per decode: fine at beam<=8 / T<=4k
+                # (bench.py phase_beam records the T=4096 beam=8 rate);
+                # a lazy ancestry-index reorder (gather at attention
+                # time) would cut writes to O(1) per step but needs the
+                # block step API to take per-position row indices —
+                # revisit if long-context beam serving becomes hot
                 caches = [(jnp.take(ck, flat_parent, axis=0),
                            jnp.take(cv, flat_parent, axis=0))
                           for ck, cv in caches]

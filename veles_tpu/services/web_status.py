@@ -9,6 +9,7 @@ PlotBus payloads), and accepts POST ``/update`` from remote runs — same
 capability surface, no external deps."""
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -19,14 +20,34 @@ from veles_tpu.services.plotting import bus
 _PAGE = """<!DOCTYPE html>
 <html><head><title>veles_tpu status</title>
 <style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
-td,th{border:1px solid #999;padding:4px 8px}</style></head>
+td,th{border:1px solid #999;padding:4px 8px}
+.spark{display:inline-block;margin:0 1.5em .8em 0}
+.spark svg{vertical-align:middle;background:#f6f6f6}
+.spark .v{color:#06c}</style></head>
 <body><h2>veles_tpu status</h2>
-<div id="status"></div><h3>recent events</h3><div id="events"></div>
+<div id="status"></div><h3>metrics</h3><div id="metrics"></div>
+<h3>recent events</h3><div id="events"></div>
 <script>
+function sparkline(points){           // [[epoch, value], ...] -> SVG
+ const w=120, h=28, vals=points.map(p=>p[1]);
+ const lo=Math.min(...vals), hi=Math.max(...vals), span=(hi-lo)||1;
+ const xs=points.map((p,i)=>[
+  i*(w-2)/Math.max(points.length-1,1)+1,
+  h-2-(p[1]-lo)*(h-4)/span]);
+ return '<svg width="'+w+'" height="'+h+'"><polyline fill="none" '+
+  'stroke="#06c" stroke-width="1.5" points="'+
+  xs.map(q=>q[0].toFixed(1)+','+q[1].toFixed(1)).join(' ')+'"/></svg>';
+}
 async function refresh(){
  const s=await (await fetch('/api/status')).json();
  document.getElementById('status').innerHTML =
   '<pre>'+JSON.stringify(s,null,2)+'</pre>';
+ const m=await (await fetch('/api/metrics')).json();
+ document.getElementById('metrics').innerHTML =
+  Object.entries(m).map(([k,pts])=>
+   '<span class="spark">'+k+' '+sparkline(pts)+' <span class="v">'+
+   pts[pts.length-1][1].toPrecision(4)+'</span></span>').join('')
+  || '(no epoch metrics yet)';
  const e=await (await fetch('/api/events')).json();
  document.getElementById('events').innerHTML =
   '<pre>'+e.slice(-30).map(x=>JSON.stringify(x)).join('\\n')+'</pre>';
@@ -49,6 +70,25 @@ class WebStatusServer(Logger):
         """Track a local workflow; its gather_results() feeds /api/status."""
         with self._lock:
             self._workflows[workflow.name] = workflow
+
+    def metrics(self, limit=200):
+        """Per-epoch metric time series from the event ring: every
+        numeric field of an ``epoch`` event becomes
+        {series: [[epoch, value], ...]} — the dashboard's sparklines
+        (ref the node.js status app's live charts, web/)."""
+        skip = {"name", "cat", "type", "time", "epoch"}
+        series = {}
+        for ev in events.snapshot():
+            if ev.get("name") != "epoch":
+                continue
+            ep = ev.get("epoch", 0)
+            for k, v in ev.items():
+                # non-finite values would serialize as the literal NaN,
+                # which strict browser-side JSON.parse rejects
+                if (k not in skip and isinstance(v, (int, float))
+                        and math.isfinite(v)):
+                    series.setdefault(k, []).append([ep, v])
+        return {k: v[-limit:] for k, v in series.items()}
 
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
@@ -79,6 +119,9 @@ class WebStatusServer(Logger):
                                                default=str).encode())
                 elif self.path == "/api/events":
                     self._send(200, json.dumps(events.snapshot()[-200:],
+                                               default=str).encode())
+                elif self.path == "/api/metrics":
+                    self._send(200, json.dumps(server.metrics(),
                                                default=str).encode())
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
