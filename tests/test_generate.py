@@ -101,13 +101,50 @@ def test_compile_cache_is_bounded_lru():
     for b in range(1, cap + 2):                  # cap + 1 distinct batches
         gen.generate(toks[:b, :4], max_new=2)
     assert len(gen._compiled) == cap, list(gen._compiled)
-    assert (1, True) not in gen._compiled        # oldest evicted
+    assert 1 not in gen._compiled                # oldest evicted
     # recency, not FIFO: re-hit the current-oldest key, then insert one
     # more — the hit key must survive and the next-oldest must go
     gen.generate(toks[:2, :4], max_new=2)
     gen.generate(toks[: cap + 2, :4], max_new=2)
-    assert (2, True) in gen._compiled
-    assert (3, True) not in gen._compiled, list(gen._compiled)
+    assert 2 in gen._compiled
+    assert 3 not in gen._compiled, list(gen._compiled)
+
+
+def test_greedy_and_sampling_share_one_executable():
+    """greedy is a traced per-row flag now — mixed request kinds at one
+    batch size reuse a single compiled scan."""
+    wf, toks = _lm_workflow(max_epochs=0)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    gen.generate(toks[:2, :4], max_new=2)                     # greedy
+    gen.generate(toks[:2, :4], max_new=2, temperature=0.8)    # sampling
+    assert len(gen._compiled) == 1, list(gen._compiled)
+
+
+def test_generate_batch_matches_solo_calls():
+    """The serving coalescer's core invariant: a request's tokens are
+    IDENTICAL whether it ran alone or merged into any batch (per-row
+    params, per-(seed, position) sampling keys)."""
+    wf, toks = _lm_workflow(max_epochs=8)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    reqs = [
+        (toks[0, :8],  {"max_new": 6}),                        # greedy
+        (toks[1, :5],  {"max_new": 4, "temperature": 0.9,
+                        "seed": 3}),
+        (toks[2, :10], {"max_new": 3, "temperature": 0.7,
+                        "top_k": 5, "seed": 11}),
+        (toks[3, :6],  {"max_new": 8, "temperature": 1.1,
+                        "top_p": 0.8, "seed": 4}),
+    ]
+    merged = gen.generate_batch([p for p, _ in reqs],
+                                [o for _, o in reqs])
+    for (prompt, opts), got in zip(reqs, merged):
+        solo = gen.generate(prompt[None], **opts)[0]
+        np.testing.assert_array_equal(got, solo)
+    # and merging in a different order changes nothing either
+    merged2 = gen.generate_batch([p for p, _ in reqs[::-1]],
+                                 [o for _, o in reqs[::-1]])
+    for a, b in zip(merged2, merged[::-1]):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_top_k_and_top_p_sampling():

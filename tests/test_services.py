@@ -141,6 +141,85 @@ class TestRESTful:
             api.stop()
 
 
+class TestGenerateBatching:
+    def test_coalesced_requests_match_solo_and_bound_compiles(self):
+        """batch_window > 0: concurrent heterogeneous generate requests
+        merge into shared device calls, every client gets exactly the
+        tokens a solo call would have produced, and compiles stay
+        bounded to power-of-two buckets."""
+        import threading as th
+
+        from veles_tpu.models import zoo
+        from veles_tpu.models.generate import LMGenerator
+
+        prng.seed_all(29)
+        r = np.random.RandomState(3)
+        n, t, vocab = 128, 12, 11
+        toks = ((np.arange(t)[None, :] + r.randint(0, 3, n)[:, None])
+                % vocab).astype(np.int32)
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=32,
+                                 class_lengths=[0, 32, 96])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=16,
+                                      n_heads=2, n_layers=1, lr=5e-3,
+                                      dropout=0.0),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 8}, name="rest-batch-lm")
+        wf.initialize()
+        wf.run()
+        gen = LMGenerator(wf.trainer, max_len=t)
+        solo = LMGenerator(wf.trainer, max_len=t)     # oracle, unbatched
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda xx: np.asarray(fwd(params, xx)), (t,),
+                         port=0, generator=gen, batch_window=0.15)
+        api.start()
+        try:
+            reqs = [
+                {"input": toks[0, :6].tolist(),
+                 "generate": {"max_new": 4}},
+                {"input": toks[1, :4].tolist(),
+                 "generate": {"max_new": 5, "temperature": 0.9,
+                              "seed": 7}},
+                {"input": toks[2, :8].tolist(),
+                 "generate": {"max_new": 2, "temperature": 0.7,
+                              "top_k": 3, "seed": 2}},
+                {"input": toks[3, :5].tolist(),
+                 "generate": {"max_new": 6, "temperature": 1.2,
+                              "top_p": 0.9, "seed": 5}},
+                {"input": toks[4, :7].tolist(),
+                 "generate": {"max_new": 3}},
+            ]
+            results = [None] * len(reqs)
+
+            def client(i):
+                results[i] = _post(
+                    "http://127.0.0.1:%d/service" % api.port, reqs[i])
+
+            threads = [th.Thread(target=client, args=(i,))
+                       for i in range(len(reqs))]
+            for thr in threads:
+                thr.start()
+            for thr in threads:
+                thr.join()
+            for req, res in zip(reqs, results):
+                opts = req["generate"]
+                want = solo.generate(
+                    np.asarray(req["input"], np.int32)[None],
+                    max_new=opts["max_new"],
+                    temperature=opts.get("temperature", 0.0),
+                    seed=opts.get("seed", 0),
+                    top_k=opts.get("top_k", 0),
+                    top_p=opts.get("top_p", 1.0))
+                np.testing.assert_array_equal(
+                    np.asarray(res["result"]), want)
+            # power-of-two buckets only — never one compile per size
+            assert set(gen._compiled) <= {1, 2, 4, 8}, list(gen._compiled)
+        finally:
+            api.stop()
+
+
 class TestWebStatus:
     def test_dashboard_and_apis(self):
         server = WebStatusServer(port=0)

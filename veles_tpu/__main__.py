@@ -675,10 +675,17 @@ class Main(object):
         fwd = wf.forward_fn()
         params = wf.trainer.params
         # root.common.serve.cache_dtype='bfloat16' halves the serve-time
-        # KV-cache memory (docs/services.md)
+        # KV-cache memory; root.common.serve.batch_window_ms>0 coalesces
+        # concurrent generate requests into shared device calls
+        # (docs/services.md)
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
                          wf.trainer.layers[0].input_shape, port=port,
-                         generator=self._make_generator(wf))
+                         generator=self._make_generator(wf),
+                         batch_window=float(
+                             root.common.serve.get("batch_window_ms", 0))
+                         / 1e3,
+                         max_batch=int(
+                             root.common.serve.get("max_batch", 8)))
         api.start()
         print("REST serving on port %d; Ctrl-C to stop" % api.port)
         try:

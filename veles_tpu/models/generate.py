@@ -143,68 +143,85 @@ class LMGenerator:
                        self._head_dim), dtype)) for _ in range(2))
                 for layer in self._blocks]
 
-    def _scan_fn(self, batch, greedy):
-        """ONE compile per (batch, greedy): the scan always runs to
-        max_len - 1, and prompt_len / top_k / top_p / inv_temp are all
-        TRACED scalars (a REST server sees arbitrary prompt lengths and
-        client-chosen sampling configs — shape- or value-specializing
-        on any of them would recompile per request and cache executables
-        forever).  Cached per-instance (NOT lru_cache: a class-level
-        cache keyed on self would immortalize every generator and its
-        params)."""
-        cached = self._cache_get((batch, greedy))
+    def _scan_fn(self, batch):
+        """ONE compile per batch size: the scan always runs to
+        max_len - 1, and prompt_len / seed / top_k / top_p / inv_temp /
+        greedy are all TRACED per-row [B] vectors (a REST server sees
+        arbitrary prompt lengths and client-chosen sampling configs —
+        shape- or value-specializing on any of them would recompile per
+        request and cache executables forever; per-ROW parameters are
+        what lets the serving batcher coalesce heterogeneous requests
+        into one device call).  Each row's draws depend only on its own
+        (seed, position), so a request's output is invariant to which
+        batch it was coalesced into.  Cached per-instance (NOT
+        lru_cache: a class-level cache keyed on self would immortalize
+        every generator and its params)."""
+        cached = self._cache_get(batch)
         if cached is not None:
             return cached
 
         def truncate(logits, top_k, top_p):
             # sorted-descending view serves both truncations with
-            # TRACED parameters (lax.top_k would need a static k)
+            # TRACED per-row parameters (lax.top_k would need static k)
             sl = jnp.sort(logits, axis=-1)[:, ::-1]
-            kth = jnp.take(sl, jnp.clip(top_k - 1, 0,
-                                        sl.shape[-1] - 1), axis=-1)
-            k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)[:, None]
+            kth = jnp.take_along_axis(
+                sl, jnp.clip(top_k - 1, 0, sl.shape[-1] - 1)[:, None],
+                axis=-1)
+            k_thresh = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
             # nucleus: keep the smallest prefix of the distribution
             # whose mass reaches top_p
             ps = jax.nn.softmax(sl, axis=-1)
-            keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p
+            keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p[:, None]
             p_thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
                                keepdims=True)
+            # per-row escapes: a top_p=1.0 row must behave EXACTLY as if
+            # it skipped truncation (f32 cumsum can reach 1.0 early and
+            # mask real tail tokens), or coalescing would not be
+            # bit-identical to the solo run — mirrors the top_k==0 guard
+            p_thresh = jnp.where(top_p[:, None] < 1.0, p_thresh,
+                                 -jnp.inf)
             return jnp.where((logits >= k_thresh) & (logits >= p_thresh),
                              logits, -1e30)
 
-        def sample(logits, sub, top_k, top_p):
-            # plain temperature sampling skips the O(V log V) sort
-            logits = jax.lax.cond(
-                (top_k > 0) | (top_p < 1.0),
-                lambda lg: truncate(lg, top_k, top_p),
-                lambda lg: lg, logits)
-            return jax.random.categorical(sub, logits).astype(jnp.int32)
+        def sample(logits, pos, keys, top_k, top_p, inv_temp):
+            lg = logits * inv_temp[:, None]
+            # plain temperature sampling skips the O(V log V) sort when
+            # NO row asks for truncation
+            lg = jax.lax.cond(
+                jnp.any(top_k > 0) | jnp.any(top_p < 1.0),
+                lambda l: truncate(l, top_k, top_p),
+                lambda l: l, lg)
+            subs = jax.vmap(jax.random.fold_in)(
+                keys, jnp.broadcast_to(pos, (lg.shape[0],)))
+            return jax.vmap(jax.random.categorical)(
+                subs, lg).astype(jnp.int32)
 
-        def run(params, tokens, prompt_len, key, top_k, top_p, inv_temp):
+        def run(params, tokens, prompt_len, seeds, top_k, top_p,
+                inv_temp, greedy):
             caches = self._init_caches(
                 batch, self.params[self._embed.name]["table"].dtype)
+            keys = jax.vmap(jax.random.key)(seeds)
 
             def body(carry, pos):
-                tokens, caches, key = carry
+                tokens, caches = carry
                 logits, caches = self._step(params, caches,
                                             tokens[:, pos], pos)
-                if greedy:
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                else:
-                    key, sub = jax.random.split(key)
-                    nxt = sample(logits * inv_temp, sub, top_k, top_p)
+                nxt = jnp.where(
+                    greedy,
+                    jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    sample(logits, pos, keys, top_k, top_p, inv_temp))
                 keep = pos + 1 < prompt_len       # teacher-force prompt
                 nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
                 tokens = jax.lax.dynamic_update_slice(
                     tokens, nxt[:, None], (0, pos + 1))
-                return (tokens, caches, key), logits
+                return (tokens, caches), logits
 
-            (tokens, _, _), logits = jax.lax.scan(
-                body, (tokens, caches, key),
+            (tokens, _), logits = jax.lax.scan(
+                body, (tokens, caches),
                 jnp.arange(self.max_len - 1))
             return tokens, logits
 
-        return self._cache_put((batch, greedy), jax.jit(run))
+        return self._cache_put(batch, jax.jit(run))
 
     def _cache_get(self, key):
         # the REST server is threaded and shares one generator: the
@@ -222,16 +239,24 @@ class LMGenerator:
                 self._compiled.popitem(last=False)
         return fn
 
-    def _run(self, params, tokens_np, prompt_len, greedy, key, top_k=0,
-             top_p=1.0, inv_temp=1.0):
+    def _run(self, params, tokens_np, prompt_len, greedy, seeds=0,
+             top_k=0, top_p=1.0, inv_temp=1.0):
+        """All per-row knobs accept a scalar (broadcast) or a [B]
+        vector — the serving batcher passes vectors."""
         b = tokens_np.shape[0]
         pad = self.max_len - tokens_np.shape[1]
         if pad:
             tokens_np = np.concatenate(
                 [tokens_np, np.zeros((b, pad), np.int32)], axis=1)
-        return self._scan_fn(b, greedy)(
-            params, jnp.asarray(tokens_np), jnp.int32(prompt_len), key,
-            jnp.int32(top_k), jnp.float32(top_p), jnp.float32(inv_temp))
+
+        def row(x, dtype):
+            return jnp.broadcast_to(jnp.asarray(x, dtype), (b,))
+
+        return self._scan_fn(b)(
+            params, jnp.asarray(tokens_np), row(prompt_len, jnp.int32),
+            row(seeds, jnp.int32), row(top_k, jnp.int32),
+            row(top_p, jnp.float32), row(inv_temp, jnp.float32),
+            row(greedy, jnp.bool_))
 
     # ------------------------------------------------------------------
     def generate(self, prompt, max_new, temperature=0.0, seed=0,
@@ -253,11 +278,67 @@ class LMGenerator:
             raise ValueError("top_k must be in [0, %d], got %r"
                              % (self._head.n_out, top_k))
         greedy = temperature == 0.0
-        out, _ = self._run(self.params, prompt, t0, greedy,
-                           jax.random.key(seed), int(top_k),
-                           float(top_p),
+        out, _ = self._run(self.params, prompt, t0, greedy, int(seed),
+                           int(top_k), float(top_p),
                            1.0 if greedy else 1.0 / temperature)
         return np.asarray(out)[:, :total]
+
+    def validate_request(self, prompt_len, opts):
+        """Validate ONE generate request's options against this model —
+        raises ValueError; returns (t0, total, temperature, top_k,
+        top_p, seed).  The serving batcher calls this BEFORE enqueueing
+        so one bad request can never fail the batch it would have
+        coalesced into."""
+        t0 = int(prompt_len)
+        total = t0 + int(opts.get("max_new", 16))
+        if total > self.max_len:
+            raise ValueError("prompt + max_new = %d exceeds max_len %d"
+                             % (total, self.max_len))
+        temp = float(opts.get("temperature", 0.0))
+        top_p = float(opts.get("top_p", 1.0))
+        top_k = int(opts.get("top_k", 0))
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1], got %r"
+                             % (top_p,))
+        if not 0 <= top_k <= self._head.n_out:
+            raise ValueError("top_k must be in [0, %d], got %r"
+                             % (self._head.n_out, top_k))
+        return t0, total, temp, top_k, top_p, int(opts.get("seed", 0))
+
+    def generate_batch(self, prompts, opts_list):
+        """Coalesce heterogeneous generate requests into ONE device
+        call: ``prompts`` is a list of 1-D token sequences (any
+        lengths), ``opts_list`` a parallel list of per-request dicts
+        (max_new, temperature, seed, top_k, top_p).  Returns a list of
+        1-D outputs, each trimmed to its request's prompt + max_new.
+        Per-row traced parameters + per-(seed, position) sampling keys
+        make every row's result identical to a solo generate() call —
+        batching never changes anyone's output."""
+        if len(prompts) != len(opts_list):
+            raise ValueError("prompts and opts_list lengths differ")
+        b = len(prompts)
+        lens, totals = [], []
+        tk, tp, it, gr, sd = [], [], [], [], []
+        for prompt, opts in zip(prompts, opts_list):
+            t0, total, temp, top_k, top_p, seed = self.validate_request(
+                len(prompt), opts)
+            lens.append(t0)
+            totals.append(total)
+            tk.append(top_k)
+            tp.append(top_p)
+            it.append(1.0 if temp == 0.0 else 1.0 / temp)
+            gr.append(temp == 0.0)
+            sd.append(seed)
+        t_max = max(lens)
+        tokens = np.zeros((b, t_max), np.int32)
+        for i, prompt in enumerate(prompts):
+            tokens[i, :lens[i]] = np.asarray(prompt, np.int32)
+        out, _ = self._run(self.params, tokens, np.asarray(lens),
+                           np.asarray(gr), np.asarray(sd),
+                           np.asarray(tk), np.asarray(tp, np.float32),
+                           np.asarray(it, np.float32))
+        out = np.asarray(out)
+        return [out[i, :totals[i]] for i in range(b)]
 
     def _beam_fn(self, batch, beam):
         """ONE compile per (batch, beam): scan over all max_len - 1
@@ -373,6 +454,5 @@ class LMGenerator:
         if t > self.max_len:
             raise ValueError("sequence %d exceeds max_len %d"
                              % (t, self.max_len))
-        _, logits = self._run(self.params, tokens, t, True,
-                              jax.random.key(0))
+        _, logits = self._run(self.params, tokens, t, True)
         return np.asarray(logits).transpose(1, 0, 2)[:, :t - 1]

@@ -9,6 +9,7 @@ reference's Twisted resource — no reactor to manage."""
 import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -16,9 +17,98 @@ import numpy as np
 from veles_tpu.logger import Logger
 
 
+class GenerateBatcher(Logger):
+    """Serving coalescer: concurrent generate requests arriving within
+    ``window`` seconds merge into ONE device call through
+    ``LMGenerator.generate_batch`` (per-row sampling params make a
+    request's tokens invariant to which batch it lands in).  Batches pad
+    up to power-of-two row counts (clamped to ``max_batch``) so the
+    generator compiles O(log max_batch) executables instead of one per
+    observed size.
+    Modern continuous-batching-lite — the reference served strictly one
+    request per forward (restful_api.py:112-217)."""
+
+    def __init__(self, generator, window=0.01, max_batch=8):
+        super(GenerateBatcher, self).__init__()
+        self.generator = generator
+        self.window = float(window)
+        self.max_batch = int(max_batch)
+        self._lock = threading.Condition()
+        self._pending = []                # (prompt, opts, slot)
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit_async(self, prompt_row, opts):
+        """Enqueue one row; returns a slot for ``wait``."""
+        slot = {"event": threading.Event()}
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is stopped")
+            self._pending.append((list(prompt_row), dict(opts), slot))
+            self._lock.notify()
+        return slot
+
+    @staticmethod
+    def wait(slot):
+        slot["event"].wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["out"]
+
+    def submit(self, prompt_row, opts):
+        """Blocks until the coalesced batch ran; returns the 1-D
+        output."""
+        return self.wait(self.submit_async(prompt_row, opts))
+
+    def stop(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify()
+        self._thread.join(timeout=5)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._lock.wait()
+                if self._closed and not self._pending:
+                    return
+            time.sleep(self.window)       # collect the burst
+            with self._lock:
+                group = self._pending[:self.max_batch]
+                del self._pending[:len(group)]
+            if not group:
+                continue
+            prompts = [g[0] for g in group]
+            opts = [g[1] for g in group]
+            # pad to the next power of two with throwaway copies of row
+            # 0 so compile count stays O(log max_batch); never past the
+            # operator's max_batch cap (it may bound KV-cache memory)
+            bucket = 1
+            while bucket < len(group):
+                bucket *= 2
+            n_pad = min(bucket, self.max_batch) - len(group)
+            # max_new=0: a pad row must never push a full-length prompt
+            # past max_len and fail the group
+            prompts += [prompts[0]] * n_pad
+            opts += [{"max_new": 0}] * n_pad
+            try:
+                outs = self.generator.generate_batch(prompts, opts)
+            except Exception as e:  # noqa: BLE001 — deliver per request
+                for _, _, slot in group:
+                    slot["error"] = e
+                    slot["event"].set()
+                continue
+            for (_, _, slot), out in zip(group, outs):
+                slot["out"] = out
+                slot["event"].set()
+
+
 class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
-                 path="/service", generator=None):
+                 path="/service", generator=None, batch_window=0.0,
+                 max_batch=8):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -26,6 +116,12 @@ class RESTfulAPI(Logger):
         #: models.generate.LMGenerator — enables the ``"generate"``
         #: request form for causal-LM workflows
         self.generator = generator
+        #: batch_window > 0: coalesce concurrent generate requests into
+        #: one device call (GenerateBatcher)
+        self.batcher = (GenerateBatcher(generator, batch_window,
+                                        max_batch)
+                        if generator is not None and batch_window > 0
+                        else None)
         self._server = None
         self._thread = None
 
@@ -79,6 +175,8 @@ class RESTfulAPI(Logger):
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self.batcher is not None:
+            self.batcher.stop()
 
     # ---------------------------------------------------------- generation
     def run_generate(self, req):
@@ -103,6 +201,18 @@ class RESTfulAPI(Logger):
             out, _ = self.generator.beam_search(
                 prompt, int(opts.get("max_new", 16)), beam=beam)
             return out
+        if self.batcher is not None:
+            # validate THIS request up front — a bad one must 400 alone,
+            # never poison the batch it would have coalesced into
+            for row in prompt:
+                self.generator.validate_request(len(row), opts)
+            # coalesce with whatever else is in flight; a request's
+            # rows share its opts, outputs re-stack to the input shape
+            # (enqueue every row BEFORE waiting so one request's rows
+            # ride a single batch)
+            slots = [self.batcher.submit_async(row, opts)
+                     for row in prompt]
+            return np.stack([self.batcher.wait(s) for s in slots])
         return self.generator.generate(
             prompt, int(opts.get("max_new", 16)),
             temperature=float(opts.get("temperature", 0.0)),
