@@ -432,7 +432,7 @@ def phase_flash():
     # long-context headline: one chip, T=8192 causal bf16 —
     # the O(T·block) VMEM tiling is what makes this shape possible.
     # Real-kernel only (interpret mode would outlive the watchdog).
-    ms_long = ms_long_xla = 0.0
+    ms_long = ms_long_xla = ms_win = 0.0
     if platform == "tpu":
         bl, hl, tl, dl = 1, 8, 8192, 128
         ql, kl, vl = (jax.random.normal(kk, (bl, hl, tl, dl),
@@ -448,6 +448,13 @@ def phase_flash():
         _log("flash long-context T=8192 bf16: %.2f ms (%.1f TF/s "
              "causal-effective) vs XLA naive %.2f ms"
              % (ms_long, fl / (ms_long / 1e3) / 1e12, ms_long_xla))
+        # sliding window at long context: the shrunken k-grid should
+        # make this ~T/window times cheaper than full causal
+        wfn = lambda q_, k_, v_: flash_attention(  # noqa: E731
+            q_, k_, v_, causal=True, window=1024)
+        ms_win = _chain_attn(wfn, ql, kl, vl, iters=10)
+        _log("flash T=8192 window=1024 bf16: %.2f ms (%.1fx vs full "
+             "causal)" % (ms_win, ms_long / ms_win if ms_win else 0.0))
 
     _log("pallas flash (4,8,1024,128) causal on %s, chained in-jit: "
          "fwd %.2f ms f32 | %.2f ms bf16 (%.1f TF/s) vs XLA %.2f ms | "
@@ -458,7 +465,8 @@ def phase_flash():
             "tf_bf16": tf(ms16), "ms_bwd": ms_bwd,
             "ms_bwd_xla": ms_bwd_xla, "bwd_max_err": bwd_err,
             "max_err": err, "ms_long_t8192": ms_long,
-            "ms_long_t8192_xla": ms_long_xla, "platform": platform}
+            "ms_long_t8192_xla": ms_long_xla,
+            "ms_long_t8192_w1024": ms_win, "platform": platform}
 
 
 def phase_beam():

@@ -245,7 +245,14 @@ def test_rope_with_sequence_parallel_mha(impl, f32_precision):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("t,w", [(64, 16), (64, 3), (57, 16)])
+@pytest.mark.parametrize("t,w", [(64, 16), (64, 3), (57, 16),
+                                 # shrunken-grid edges: window spanning
+                                 # several blocks, window > t (span
+                                 # clamps to nk), window == block, and
+                                 # a window that overshoots past the
+                                 # last k block on tail q blocks
+                                 (128, 40), (64, 100), (64, 32),
+                                 (96, 33)])
 def test_flash_sliding_window(t, w):
     """Sliding-window causal flash: forward AND fused backward must
     match the masked naive reference (incl. ragged padding)."""

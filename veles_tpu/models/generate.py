@@ -206,10 +206,16 @@ class LMGenerator:
                 tokens, caches = carry
                 logits, caches = self._step(params, caches,
                                             tokens[:, pos], pos)
+                # an all-greedy batch (the serving default) skips the
+                # whole-vocab gumbel draw — jnp.where alone would pay it
+                smp = jax.lax.cond(
+                    jnp.any(~greedy),
+                    lambda: sample(logits, pos, keys, top_k, top_p,
+                                   inv_temp),
+                    lambda: jnp.zeros((batch,), jnp.int32))
                 nxt = jnp.where(
                     greedy,
-                    jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    sample(logits, pos, keys, top_k, top_p, inv_temp))
+                    jnp.argmax(logits, axis=-1).astype(jnp.int32), smp)
                 keep = pos + 1 < prompt_len       # teacher-force prompt
                 nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
                 tokens = jax.lax.dynamic_update_slice(
@@ -268,18 +274,13 @@ class LMGenerator:
         mass)."""
         prompt = np.asarray(prompt, np.int32)
         b, t0 = prompt.shape
-        total = t0 + int(max_new)
-        if total > self.max_len:
-            raise ValueError("prompt + max_new = %d exceeds max_len %d"
-                             % (total, self.max_len))
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError("top_p must be in (0, 1], got %r" % (top_p,))
-        if not 0 <= int(top_k) <= self._head.n_out:
-            raise ValueError("top_k must be in [0, %d], got %r"
-                             % (self._head.n_out, top_k))
+        t0, total, temperature, top_k, top_p, seed = \
+            self.validate_request(
+                t0, {"max_new": max_new, "temperature": temperature,
+                     "seed": seed, "top_k": top_k, "top_p": top_p})
         greedy = temperature == 0.0
-        out, _ = self._run(self.params, prompt, t0, greedy, int(seed),
-                           int(top_k), float(top_p),
+        out, _ = self._run(self.params, prompt, t0, greedy, seed,
+                           top_k, top_p,
                            1.0 if greedy else 1.0 / temperature)
         return np.asarray(out)[:, :total]
 
@@ -290,11 +291,17 @@ class LMGenerator:
         so one bad request can never fail the batch it would have
         coalesced into."""
         t0 = int(prompt_len)
-        total = t0 + int(opts.get("max_new", 16))
+        max_new = int(opts.get("max_new", 16))
+        if max_new < 0:
+            raise ValueError("max_new must be >= 0, got %r" % (max_new,))
+        total = t0 + max_new
         if total > self.max_len:
             raise ValueError("prompt + max_new = %d exceeds max_len %d"
                              % (total, self.max_len))
         temp = float(opts.get("temperature", 0.0))
+        if temp < 0.0:
+            raise ValueError("temperature must be >= 0, got %r"
+                             % (temp,))
         top_p = float(opts.get("top_p", 1.0))
         top_k = int(opts.get("top_k", 0))
         if not 0.0 < top_p <= 1.0:
@@ -312,8 +319,11 @@ class LMGenerator:
         (max_new, temperature, seed, top_k, top_p).  Returns a list of
         1-D outputs, each trimmed to its request's prompt + max_new.
         Per-row traced parameters + per-(seed, position) sampling keys
-        make every row's result identical to a solo generate() call —
-        batching never changes anyone's output."""
+        make each row's RANDOM DRAWS independent of what it was batched
+        with; outputs equal the solo generate() call whenever the
+        forward itself is batch-size-deterministic (exact on CPU — on
+        TPU a different batch size can tile f32 reductions differently,
+        so a near-tied argmax may flip on rare positions)."""
         if len(prompts) != len(opts_list):
             raise ValueError("prompts and opts_list lengths differ")
         b = len(prompts)

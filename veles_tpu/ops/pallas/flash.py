@@ -65,19 +65,63 @@ def _block_live(qi, ki, block_q, block_k, causal, window):
     return live
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
-            *, scale, causal, block_q, block_k, nk, tk, window):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+# --------------------------------------------------------------------------
+# Sliding-window grid shrink + causal copy elision.
+#
+# With a window, each q block's live k blocks form a STATIC-width span
+# (window/block geometry), so the inner grid axis only needs that many
+# steps instead of all T/block_k — a T=8192/window=1024 forward launches
+# ~1/7 of the tiles.  The kernel derives the true k-block index as
+# lo(qi) + kj.  Independently, for plain causal masks the dead
+# off-diagonal tiles clamp their BlockSpec index to the last live block:
+# consecutive grid steps that map to the same block elide the HBM→VMEM
+# copy, so skipped tiles stop costing bandwidth too.
+# --------------------------------------------------------------------------
 
-    @pl.when(ki == 0)
+def _k_lo(qi, block_q, block_k, window):
+    """First live k block for q block ``qi`` under a sliding window."""
+    return jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+
+
+def _k_span(block_q, block_k, window, nk):
+    """Static width of the live k-block span per q block."""
+    if window is None:
+        return nk
+    return min(nk, (block_q + window - 2) // block_k + 2)
+
+
+def _q_lo(ki, block_q, block_k):
+    """First live (causal) q block for k block ``ki``."""
+    return (ki * block_k) // block_q
+
+
+def _q_span(block_q, block_k, window, nq):
+    """Static width of the live q-block span per k block (window)."""
+    if window is None:
+        return nq
+    return min(nq, (block_k + window - 2) // block_q + 2)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
+            *, scale, causal, block_q, block_k, nk, nk_grid, tk, window):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    # with a window the inner axis walks only the live span: the true
+    # k-block index is lo(qi) + kj
+    ki = (kj if window is None
+          else _k_lo(qi, block_q, block_k, window) + kj)
+
+    @pl.when(kj == 0)
     def _():
         acc[:] = jnp.zeros_like(acc)
         m[:] = jnp.full_like(m, NEG_INF)
         l[:] = jnp.zeros_like(l)
 
-    # skip tiles entirely outside the causal(+window) band
+    # skip tiles entirely outside the causal(+window) band (and the
+    # shrunken span's overshoot past the last real k block)
     live = _block_live(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live = live & (ki < nk)
 
     @pl.when(live)
     def _():
@@ -101,7 +145,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kj == nk_grid - 1)
     def _():
         lsum = jnp.maximum(l[:, :1], 1e-30)
         out = acc[:] / lsum
@@ -113,20 +157,24 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   nk, tk, window):
-    """dQ: grid (bh, q-blocks, k-blocks), k innermost; dq accumulates in
+                   nk, nk_grid, tk, window):
+    """dQ: grid (bh, q-blocks, k-span), k innermost; dq accumulates in
     f32 VMEM scratch across the k sweep.
         p  = exp(s - lse);  dp = dO·Vᵀ;  ds = p⊙(dp - Δ)·scale
         dq += ds·K
     """
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    kj = pl.program_id(2)
+    ki = (kj if window is None
+          else _k_lo(qi, block_q, block_k, window) + kj)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = _block_live(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live = live & (ki < nk)
 
     @pl.when(live)
     def _():
@@ -143,28 +191,32 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kj == nk_grid - 1)
     def _():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, nq, tk, window):
-    """dK, dV: grid (bh, k-blocks, q-blocks), q innermost; both
+                    block_q, block_k, nq, nq_grid, tk, window):
+    """dK, dV: grid (bh, k-blocks, q-span), q innermost; both
     accumulators live in f32 VMEM scratch across the q sweep.
         pᵀ  = exp(sᵀ - lse);     dv += pᵀ·dO
         dpᵀ = V·dOᵀ;  dsᵀ = pᵀ⊙(dpᵀ - Δ)·scale;  dk += dsᵀ·Q
     Padded q rows contribute nothing (their dO and Δ are zero)."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    qj = pl.program_id(2)
+    qi = (qj if window is None
+          else _q_lo(ki, block_q, block_k) + qj)
 
-    @pl.when(qi == 0)
+    @pl.when(qj == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     live = _block_live(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live = live & (qi < nq)
 
     @pl.when(live)
     def _():
@@ -186,7 +238,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qj == nq_grid - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -292,25 +344,42 @@ _SEMANTICS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
+def _kv_index_map(block_q, block_k, causal, window, nk):
+    """BlockSpec index map for k/v on a (bh, q-block, k-inner) grid:
+    resolves the shrunken window span to true k blocks, and clamps dead
+    causal tiles onto the diagonal block so the pipeline elides their
+    HBM→VMEM copies (same index on consecutive steps = no copy)."""
+    def index_map(bh, qi, kj):
+        ki = (kj if window is None
+              else _k_lo(qi, block_q, block_k, window) + kj)
+        if causal:
+            hi = (qi * block_q + block_q - 1) // block_k
+            ki = jnp.minimum(ki, jnp.minimum(hi, nk - 1))
+        return (bh, ki, 0)
+    return index_map
+
+
 def _forward(q, k, v, causal, scale, block_q, block_k, interpret,
              window=None):
     b, h, tq, d = q.shape
     tk = k.shape[-2]
     qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, block_q,
                                                    block_k)
+    nk_grid = _k_span(block_q, block_k, window, nk) if causal else nk
 
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, tk=tk,
-        window=window)
+        block_q=block_q, block_k=block_k, nk=nk, nk_grid=nk_grid,
+        tk=tk, window=window)
 
+    kv_map = _kv_index_map(block_q, block_k, causal, window, nk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, nq, nk),
+        grid=(b * h, nq, nk_grid),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -346,15 +415,17 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                     * _pad_to(out.reshape(b * h, tq, d), 1,
                               block_q).astype(jnp.float32), axis=-1)
 
+    nk_grid = _k_span(block_q, block_k, window, nk) if causal else nk
+    kv_map = _kv_index_map(block_q, block_k, causal, window, nk)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, a, 0))
     r_spec = pl.BlockSpec((1, block_q), lambda bh, a, i: (bh, a))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_map)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk,
-                          tk=tk, window=window),
-        grid=(b * h, nq, nk),
+                          nk_grid=nk_grid, tk=tk, window=window),
+        grid=(b * h, nq, nk_grid),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
@@ -363,15 +434,30 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qp, kp, vp, dop, lse, delta)
 
-    # q innermost: swap the roles of the two block axes in the specs
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, i, 0))
-    r_spec2 = pl.BlockSpec((1, block_q), lambda bh, a, i: (bh, i))
+    # q innermost: swap the roles of the two block axes in the specs;
+    # the q/do/residual index map mirrors _kv_index_map (window span
+    # shrink + clamp of the dead below-diagonal tiles onto the first
+    # live q block for copy elision)
+    nq_grid = _q_span(block_q, block_k, window, nq) if causal else nq
+
+    def q_map3(bh, ki, qj, rank):
+        qi = (qj if window is None
+              else _q_lo(ki, block_q, block_k) + qj)
+        if causal:
+            lo = _q_lo(ki, block_q, block_k)
+            qi = jnp.minimum(jnp.maximum(qi, lo), nq - 1)
+        return (bh, qi, 0)[:rank]
+
+    q_spec2 = pl.BlockSpec((1, block_q, d),
+                           lambda bh, a, i: q_map3(bh, a, i, 3))
+    r_spec2 = pl.BlockSpec((1, block_q),
+                           lambda bh, a, i: q_map3(bh, a, i, 2))
     kv_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, a, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq,
-                          tk=tk, window=window),
-        grid=(b * h, nk, nq),
+                          nq_grid=nq_grid, tk=tk, window=window),
+        grid=(b * h, nk, nq_grid),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),

@@ -20,8 +20,9 @@ from veles_tpu.logger import Logger
 class GenerateBatcher(Logger):
     """Serving coalescer: concurrent generate requests arriving within
     ``window`` seconds merge into ONE device call through
-    ``LMGenerator.generate_batch`` (per-row sampling params make a
-    request's tokens invariant to which batch it lands in).  Batches pad
+    ``LMGenerator.generate_batch`` (per-row sampling params keep every
+    request's random draws independent of which batch it lands in — see
+    generate_batch's determinism note).  Batches pad
     up to power-of-two row counts (clamped to ``max_batch``) so the
     generator compiles O(log max_batch) executables instead of one per
     observed size.
