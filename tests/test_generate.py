@@ -266,6 +266,74 @@ def test_tensor_parallel_decode_rejects_indivisible_kv_heads():
         LMGenerator(wf.trainer, max_len=16)
 
 
+@pytest.mark.parametrize("zoo_kwargs", [
+    {}, {"n_kv_heads": 2}, {"pos": "rope"}, {"window": 24}])
+def test_chunked_prefill_matches_full_scan(zoo_kwargs, f32_precision):
+    """Long prompts route through the parallel prefill + short
+    generation scan; tokens must match the position-by-position full
+    scan exactly — greedy, sampled, and near-max_len overshoot."""
+    t = 96
+    wf, toks = _lm_workflow(max_epochs=6, t=t, **zoo_kwargs)
+    gen = LMGenerator(wf.trainer, max_len=t)
+    assert gen.prefill_min <= 48       # prompts below DO use prefill
+
+    ref = LMGenerator(wf.trainer, max_len=t)
+    ref.prefill_min = 10 ** 9          # force the full scan
+
+    prompt = toks[:4, :48]
+    for kwargs in ({}, {"temperature": 0.8, "seed": 5},
+                   {"temperature": 0.7, "top_k": 5, "seed": 2}):
+        got = gen.generate(prompt, max_new=12, **kwargs)
+        want = ref.generate(prompt, max_new=12, **kwargs)
+        np.testing.assert_array_equal(got, want)
+    assert any(isinstance(k, tuple) and k[0] == "pre"
+               for k in gen._compiled), list(gen._compiled)
+    assert all(not (isinstance(k, tuple) and k[0] == "pre")
+               for k in ref._compiled), list(ref._compiled)
+
+    # near-max_len: the power-of-two generation bucket overshoots past
+    # the last position and must clamp idempotently
+    got = gen.generate(toks[:2, :90], max_new=6)
+    want = ref.generate(toks[:2, :90], max_new=6)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunked_prefill_bf16_cache_rope_parity(f32_precision):
+    """The dtype-ordering trap: the cache must hold rope(k) computed in
+    the CACHE dtype (mha_step's ordering) on both paths, or bf16-cache
+    serving diverges between prefill and full scan."""
+    import jax.numpy as jnp
+
+    t = 96
+    wf, toks = _lm_workflow(max_epochs=6, t=t, pos="rope")
+    gen = LMGenerator(wf.trainer, max_len=t, cache_dtype=jnp.bfloat16)
+    ref = LMGenerator(wf.trainer, max_len=t, cache_dtype=jnp.bfloat16)
+    ref.prefill_min = 10 ** 9
+    for kwargs in ({}, {"temperature": 0.8, "seed": 11}):
+        got = gen.generate(toks[:3, :40], max_new=10, **kwargs)
+        want = ref.generate(toks[:3, :40], max_new=10, **kwargs)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_chunked_prefill_generate_batch_mixed_lengths(f32_precision):
+    """Mixed prompt lengths: prefill covers the common prefix, the scan
+    teacher-forces the longer prompts' tails — same tokens as the full
+    scan for every row."""
+    t = 96
+    wf, toks = _lm_workflow(max_epochs=6, t=t)
+    gen = LMGenerator(wf.trainer, max_len=t)
+    ref = LMGenerator(wf.trainer, max_len=t)
+    ref.prefill_min = 10 ** 9
+    prompts = [toks[0, :40], toks[1, :64], toks[2, :52]]
+    opts = [{"max_new": 10},
+            {"max_new": 8, "temperature": 0.9, "seed": 3},
+            {"max_new": 12, "temperature": 0.8, "top_k": 4, "seed": 9}]
+    got = gen.generate_batch(prompts, opts)
+    want = ref.generate_batch(prompts, opts)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_incremental_matches_full_forward_window(f32_precision):
     """Sliding-window stack: the KV-cache step must apply the same
     window mask the training forward uses."""

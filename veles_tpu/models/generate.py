@@ -26,7 +26,49 @@ from veles_tpu.ops import norm
 #: of prompt rows) and beam width are both client-controlled on the REST
 #: serving path; each distinct value compiles an executable, so the cache
 #: must be an LRU, not a grow-forever dict.
-COMPILE_CACHE_SIZE = 8
+COMPILE_CACHE_SIZE = 12
+
+#: shortest prompt length (tokens) at which the chunked-prefill decode
+#: path kicks in — below this the one-executable full scan wins on
+#: compile count and is cheap anyway
+PREFILL_MIN = 32
+
+
+def _truncate(logits, top_k, top_p):
+    """top-k/top-p truncation with TRACED per-row parameters (lax.top_k
+    would need a static k) over a sorted-descending view."""
+    sl = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sl, jnp.clip(top_k - 1, 0, sl.shape[-1] - 1)[:, None], axis=-1)
+    k_thresh = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
+    # nucleus: keep the smallest prefix of the distribution whose mass
+    # reaches top_p
+    ps = jax.nn.softmax(sl, axis=-1)
+    keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p[:, None]
+    p_thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
+                       keepdims=True)
+    # per-row escapes: a top_p=1.0 row must behave EXACTLY as if it
+    # skipped truncation (f32 cumsum can reach 1.0 early and mask real
+    # tail tokens), or coalescing would not be bit-identical to the
+    # solo run — mirrors the top_k==0 guard
+    p_thresh = jnp.where(top_p[:, None] < 1.0, p_thresh, -jnp.inf)
+    return jnp.where((logits >= k_thresh) & (logits >= p_thresh),
+                     logits, -1e30)
+
+
+def _sample(logits, pos, keys, top_k, top_p, inv_temp):
+    """Per-row categorical draw keyed on (row seed, position) ONLY — a
+    row's randomness never depends on what it was batched with."""
+    lg = logits * inv_temp[:, None]
+    # plain temperature sampling skips the O(V log V) sort when NO row
+    # asks for truncation
+    lg = jax.lax.cond(
+        jnp.any(top_k > 0) | jnp.any(top_p < 1.0),
+        lambda l: _truncate(l, top_k, top_p),
+        lambda l: l, lg)
+    subs = jax.vmap(jax.random.fold_in)(
+        keys, jnp.broadcast_to(pos, (lg.shape[0],)))
+    return jax.vmap(jax.random.categorical)(subs, lg).astype(jnp.int32)
 
 
 class LMGenerator:
@@ -58,6 +100,9 @@ class LMGenerator:
         if mesh_cfg == "auto":
             mesh_cfg = getattr(trainer, "mesh_config", None)
         self.mesh_cfg = mesh_cfg
+        #: per-instance prefill threshold (module default PREFILL_MIN);
+        #: tests pin it to force one path or the other
+        self.prefill_min = PREFILL_MIN
         layers = trainer.layers
         by_type = {}
         self._blocks = []
@@ -160,74 +205,146 @@ class LMGenerator:
         if cached is not None:
             return cached
 
-        def truncate(logits, top_k, top_p):
-            # sorted-descending view serves both truncations with
-            # TRACED per-row parameters (lax.top_k would need static k)
-            sl = jnp.sort(logits, axis=-1)[:, ::-1]
-            kth = jnp.take_along_axis(
-                sl, jnp.clip(top_k - 1, 0, sl.shape[-1] - 1)[:, None],
-                axis=-1)
-            k_thresh = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
-            # nucleus: keep the smallest prefix of the distribution
-            # whose mass reaches top_p
-            ps = jax.nn.softmax(sl, axis=-1)
-            keep = (jnp.cumsum(ps, axis=-1) - ps) < top_p[:, None]
-            p_thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
-                               keepdims=True)
-            # per-row escapes: a top_p=1.0 row must behave EXACTLY as if
-            # it skipped truncation (f32 cumsum can reach 1.0 early and
-            # mask real tail tokens), or coalescing would not be
-            # bit-identical to the solo run — mirrors the top_k==0 guard
-            p_thresh = jnp.where(top_p[:, None] < 1.0, p_thresh,
-                                 -jnp.inf)
-            return jnp.where((logits >= k_thresh) & (logits >= p_thresh),
-                             logits, -1e30)
-
-        def sample(logits, pos, keys, top_k, top_p, inv_temp):
-            lg = logits * inv_temp[:, None]
-            # plain temperature sampling skips the O(V log V) sort when
-            # NO row asks for truncation
-            lg = jax.lax.cond(
-                jnp.any(top_k > 0) | jnp.any(top_p < 1.0),
-                lambda l: truncate(l, top_k, top_p),
-                lambda l: l, lg)
-            subs = jax.vmap(jax.random.fold_in)(
-                keys, jnp.broadcast_to(pos, (lg.shape[0],)))
-            return jax.vmap(jax.random.categorical)(
-                subs, lg).astype(jnp.int32)
-
         def run(params, tokens, prompt_len, seeds, top_k, top_p,
                 inv_temp, greedy):
             caches = self._init_caches(
                 batch, self.params[self._embed.name]["table"].dtype)
             keys = jax.vmap(jax.random.key)(seeds)
-
-            def body(carry, pos):
-                tokens, caches = carry
-                logits, caches = self._step(params, caches,
-                                            tokens[:, pos], pos)
-                # an all-greedy batch (the serving default) skips the
-                # whole-vocab gumbel draw — jnp.where alone would pay it
-                smp = jax.lax.cond(
-                    jnp.any(~greedy),
-                    lambda: sample(logits, pos, keys, top_k, top_p,
-                                   inv_temp),
-                    lambda: jnp.zeros((batch,), jnp.int32))
-                nxt = jnp.where(
-                    greedy,
-                    jnp.argmax(logits, axis=-1).astype(jnp.int32), smp)
-                keep = pos + 1 < prompt_len       # teacher-force prompt
-                nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
-                tokens = jax.lax.dynamic_update_slice(
-                    tokens, nxt[:, None], (0, pos + 1))
-                return (tokens, caches), logits
-
+            body = self._decode_body(params, prompt_len, keys, top_k,
+                                     top_p, inv_temp, greedy, batch)
             (tokens, _), logits = jax.lax.scan(
                 body, (tokens, caches),
                 jnp.arange(self.max_len - 1))
             return tokens, logits
 
         return self._cache_put(batch, jax.jit(run))
+
+    def _decode_body(self, params, prompt_len, keys, top_k, top_p,
+                     inv_temp, greedy, batch):
+        """The per-position decode body shared by the full scan and the
+        prefilled generation scan (they must never diverge)."""
+        def body(carry, pos):
+            tokens, caches = carry
+            logits, caches = self._step(params, caches,
+                                        tokens[:, pos], pos)
+            # an all-greedy batch (the serving default) skips the
+            # whole-vocab gumbel draw — jnp.where alone would pay it
+            smp = jax.lax.cond(
+                jnp.any(~greedy),
+                lambda: _sample(logits, pos, keys, top_k, top_p,
+                                inv_temp),
+                lambda: jnp.zeros((batch,), jnp.int32))
+            nxt = jnp.where(
+                greedy,
+                jnp.argmax(logits, axis=-1).astype(jnp.int32), smp)
+            keep = pos + 1 < prompt_len       # teacher-force prompt
+            nxt = jnp.where(keep, tokens[:, pos + 1], nxt)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, pos + 1))
+            return (tokens, caches), logits
+
+        return body
+
+    def _pos_rows(self, params, tp):
+        if self._posenc is None:
+            return 0.0
+        if self._posenc.learned:
+            table = params[self._posenc.name]["pos"]
+        else:
+            table = self._posenc._sinusoid()
+        return table[:tp]
+
+    def _prefill_fn(self, batch, tp):
+        """ONE compile per (batch, prompt bucket): run the prompt chunk
+        [B, tp] through every block's parallel prefill, returning the
+        filled KV caches.  Replaces tp sequential scan steps with one
+        MXU-fed forward — the serving prefill."""
+        cached = self._cache_get(("pre", batch, tp))
+        if cached is not None:
+            return cached
+
+        def run(params, toks):
+            table = params[self._embed.name]["table"]
+            x = jnp.take(table, toks.astype(jnp.int32), axis=0)
+            x = x + self._pos_rows(params, tp)
+            caches = self._init_caches(batch, table.dtype)
+            out = []
+            for layer, (ck, cv) in zip(self._blocks, caches):
+                x, ck, cv = layer.prefill(params[layer.name], x, ck, cv)
+                out.append((ck, cv))
+            return out
+
+        return self._cache_put(("pre", batch, tp), jax.jit(run))
+
+    def _gen_fn(self, batch, length):
+        """ONE compile per (batch, generation-length bucket): the decode
+        scan over ``length`` positions starting at traced ``start``
+        (prefilled caches in, final tokens out).  Positions past
+        max_len - 2 clamp — the body is idempotent at a repeated
+        position (same inputs -> same token), so overshoot from the
+        power-of-two bucket is harmless."""
+        cached = self._cache_get(("gen", batch, length))
+        if cached is not None:
+            return cached
+
+        def run(params, caches, tokens, start, prompt_len, seeds,
+                top_k, top_p, inv_temp, greedy):
+            keys = jax.vmap(jax.random.key)(seeds)
+            body = self._decode_body(params, prompt_len, keys, top_k,
+                                     top_p, inv_temp, greedy, batch)
+
+            def body2(carry, i):
+                pos = jnp.minimum(start + i, self.max_len - 2)
+                return body(carry, pos)
+
+            (tokens, _), _ = jax.lax.scan(body2, (tokens, caches),
+                                          jnp.arange(length))
+            return tokens
+
+        return self._cache_put(("gen", batch, length), jax.jit(run))
+
+    @staticmethod
+    def _bucket(n, cap):
+        return min(1 << max(0, n - 1).bit_length(), cap)
+
+    def _decode_rows(self, tokens_np, lens, totals, greedy, seeds,
+                     top_k, top_p, inv_temp):
+        """Shared decode orchestrator (generate / generate_batch): pick
+        chunked-prefill + short generation scan when the shortest
+        prompt is long enough, else the single full scan.  Correctness
+        of padded prefill: the decode body overwrites cache row ``pos``
+        BEFORE attending to it, so prefill garbage beyond a row's
+        prompt (padding, or rows whose prompt is longer than the
+        common prefix) is rewritten before it can ever be read."""
+        b = tokens_np.shape[0]
+        pad = self.max_len - tokens_np.shape[1]
+        if pad:
+            tokens_np = np.concatenate(
+                [tokens_np, np.zeros((b, pad), np.int32)], axis=1)
+
+        def row(x, dtype):
+            return jnp.broadcast_to(jnp.asarray(x, dtype), (b,))
+
+        min_len, max_total = int(min(lens)), int(max(totals))
+        if min_len < self.prefill_min:
+            out, _ = self._run(self.params, tokens_np, lens, greedy,
+                               seeds, top_k, top_p, inv_temp)
+            return np.asarray(out)
+        tp = self._bucket(min_len, self.max_len)
+        caches = self._prefill_fn(b, tp)(
+            self.params, jnp.asarray(tokens_np[:, :tp]))
+        start = min_len - 1
+        need = max(1, max_total - 1 - start)
+        # validate_request caps max_total <= max_len, so the pow2
+        # bucket (clamped to the remaining positions) always covers need
+        length = self._bucket(need, max(1, self.max_len - 1 - start))
+        out = self._gen_fn(b, length)(
+            self.params, caches, jnp.asarray(tokens_np),
+            jnp.int32(start), row(lens, jnp.int32),
+            row(seeds, jnp.int32), row(top_k, jnp.int32),
+            row(top_p, jnp.float32), row(inv_temp, jnp.float32),
+            row(greedy, jnp.bool_))
+        return np.asarray(out)
 
     def _cache_get(self, key):
         # the REST server is threaded and shares one generator: the
@@ -279,10 +396,10 @@ class LMGenerator:
                 t0, {"max_new": max_new, "temperature": temperature,
                      "seed": seed, "top_k": top_k, "top_p": top_p})
         greedy = temperature == 0.0
-        out, _ = self._run(self.params, prompt, t0, greedy, seed,
-                           top_k, top_p,
-                           1.0 if greedy else 1.0 / temperature)
-        return np.asarray(out)[:, :total]
+        out = self._decode_rows(
+            prompt, [t0] * b, [total] * b, greedy, seed, top_k, top_p,
+            1.0 if greedy else 1.0 / temperature)
+        return out[:, :total]
 
     def validate_request(self, prompt_len, opts):
         """Validate ONE generate request's options against this model —
@@ -343,11 +460,10 @@ class LMGenerator:
         tokens = np.zeros((b, t_max), np.int32)
         for i, prompt in enumerate(prompts):
             tokens[i, :lens[i]] = np.asarray(prompt, np.int32)
-        out, _ = self._run(self.params, tokens, np.asarray(lens),
-                           np.asarray(gr), np.asarray(sd),
-                           np.asarray(tk), np.asarray(tp, np.float32),
-                           np.asarray(it, np.float32))
-        out = np.asarray(out)
+        out = self._decode_rows(
+            tokens, lens, totals, np.asarray(gr), np.asarray(sd),
+            np.asarray(tk), np.asarray(tp, np.float32),
+            np.asarray(it, np.float32))
         return [out[i, :totals[i]] for i in range(b)]
 
     def _beam_fn(self, batch, beam):

@@ -665,22 +665,45 @@ class TransformerBlock(Layer):
                         + params["b1"])
         return linear.matmul(h, params["w2"], self.policy) + params["b2"]
 
-    def step(self, params, x, cache_k, cache_v, pos):
-        """Incremental-decoding step: x [B, 1, F] at position ``pos``
-        against the block's KV cache (models.generate).  Dropout off
-        (serve time); MoE FFN works unchanged on the single position."""
-        from veles_tpu.ops import attention, norm
+    def _cached_attn_block(self, params, x, attn_call):
+        """Shared serve-time block body (step + prefill — they must
+        never diverge): LN → cached attention → residual, LN → FFN →
+        residual.  ``attn_call(h) -> (h, cache_k, cache_v)``."""
+        from veles_tpu.ops import norm
         h = norm.layer_norm(x, params["ln1"]["gamma"],
                             params["ln1"]["beta"])
-        h, cache_k, cache_v = attention.mha_step(
-            params["mha"], h, cache_k, cache_v, pos, self.n_heads,
-            n_kv_heads=self.n_kv_heads, policy=self.policy,
-            use_rope=bool(self.cfg.get("rope", False)),
-            window=self.cfg.get("window"))
+        h, cache_k, cache_v = attn_call(h)
         x = x + h
         h = norm.layer_norm(x, params["ln2"]["gamma"],
                             params["ln2"]["beta"])
         return x + self._ffn(params, h, train=False), cache_k, cache_v
+
+    def step(self, params, x, cache_k, cache_v, pos):
+        """Incremental-decoding step: x [B, 1, F] at position ``pos``
+        against the block's KV cache (models.generate).  Dropout off
+        (serve time); MoE FFN works unchanged on the single position."""
+        from veles_tpu.ops import attention
+        return self._cached_attn_block(
+            params, x,
+            lambda h: attention.mha_step(
+                params["mha"], h, cache_k, cache_v, pos, self.n_heads,
+                n_kv_heads=self.n_kv_heads, policy=self.policy,
+                use_rope=bool(self.cfg.get("rope", False)),
+                window=self.cfg.get("window")))
+
+    def prefill(self, params, x, cache_k, cache_v):
+        """Chunked prefill: the whole prompt chunk x [B, Tp, F] in one
+        parallel pass, k/v written into cache positions [0, Tp) —
+        equivalent to Tp step() calls at full-forward cost
+        (models.generate's serving prefill)."""
+        from veles_tpu.ops import attention
+        return self._cached_attn_block(
+            params, x,
+            lambda h: attention.mha_prefill(
+                params["mha"], h, cache_k, cache_v, self.n_heads,
+                n_kv_heads=self.n_kv_heads, policy=self.policy,
+                use_rope=bool(self.cfg.get("rope", False)),
+                window=self.cfg.get("window")))
 
 
 class PipelinedTransformer(Layer):

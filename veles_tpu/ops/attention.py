@@ -228,13 +228,7 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
             raise ValueError("window must be >= 1")
     if n_kv_heads is None:
         n_kv_heads = n_heads
-    cast = (lambda t: t) if policy is None else policy.cast_in
-    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
-                    n_heads)
-    k = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
-                    n_kv_heads)
-    v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
-                    n_kv_heads)
+    q, k, v = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
     if use_rope:
         # rotation happens on the GLOBAL [B, H, T, D] arrays, before any
         # sequence-parallel shard_map (ring/Ulysses take global arrays
@@ -242,10 +236,7 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
         pos = jnp.arange(x.shape[1])
         q = rope(q, pos)
         k = rope(k, pos)
-    if n_kv_heads != n_heads:
-        rep = n_heads // n_kv_heads
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    k, v = _broadcast_kv(k, v, n_heads, n_kv_heads)
     if attn_fn is None:
         if impl == "naive":
             attn_fn = attention
@@ -262,6 +253,58 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
     return _proj(merge_heads(o), params["wo"], params["bo"], policy)
 
 
+def _qkv_proj(params, x, n_heads, n_kv_heads, policy):
+    """Shared q/k/v projection + head split (mha_forward, mha_prefill,
+    mha_step all route through here so they can never drift apart)."""
+    cast = (lambda t: t) if policy is None else policy.cast_in
+    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
+                    n_heads)
+    k = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
+                    n_kv_heads)
+    v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
+                    n_kv_heads)
+    return q, k, v
+
+
+def _broadcast_kv(k, v, n_heads, n_kv_heads):
+    """GQA: broadcast kv heads up to the query heads."""
+    if n_kv_heads != n_heads:
+        rep = n_heads // n_kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+def mha_prefill(params, x, cache_k, cache_v, n_heads, n_kv_heads=None,
+                scale=None, policy=None, use_rope=False, window=None):
+    """Chunked prefill: run the WHOLE prompt chunk x [B, Tp, d_model]
+    through attention in one parallel pass (blockwise core — O(Tp·block)
+    memory) and write its k/v into cache positions [0, Tp).
+
+    Equivalent to Tp sequential mha_step calls, at full-forward cost:
+    position i attends [0, i] via the causal(+window) mask, the cache
+    stores k/v with mha_step's EXACT dtype ordering (cast to the cache
+    dtype BEFORE the rope rotation), and the in-chunk attention reads
+    the cache-dtype k/v — the same view mha_step sees.
+    Returns (y [B, Tp, d_model], cache_k, cache_v)."""
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    q, k, v = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
+    k = k.astype(cache_k.dtype)
+    v = v.astype(cache_v.dtype)
+    if use_rope:
+        pos = jnp.arange(x.shape[1])
+        q = rope(q, pos)
+        k = rope(k, pos).astype(cache_k.dtype)  # cache stores rotated k
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, 0, 0))
+    k, v = _broadcast_kv(k, v, n_heads, n_kv_heads)
+    o = blockwise_attention(q, k, v, causal=True, scale=scale,
+                            window=window)
+    return (_proj(merge_heads(o), params["wo"], params["bo"], policy),
+            cache_k, cache_v)
+
+
 def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
              scale=None, policy=None, use_rope=False, window=None):
     """One incremental-decoding step with a KV cache.
@@ -274,13 +317,9 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
     written."""
     if n_kv_heads is None:
         n_kv_heads = n_heads
-    cast = (lambda t: t) if policy is None else policy.cast_in
-    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
-                    n_heads)                           # [B, H, 1, hd]
-    k1 = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
-                     n_kv_heads).astype(cache_k.dtype)
-    v1 = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
-                     n_kv_heads).astype(cache_v.dtype)
+    q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
+    k1 = k1.astype(cache_k.dtype)                      # [B, Hkv, 1, hd]
+    v1 = v1.astype(cache_v.dtype)
     if use_rope:
         p1 = jnp.full((1,), pos, jnp.int32)
         q = rope(q, p1)
