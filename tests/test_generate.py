@@ -298,6 +298,27 @@ def test_chunked_prefill_matches_full_scan(zoo_kwargs, f32_precision):
     np.testing.assert_array_equal(got, want)
 
 
+def test_chunked_prefill_beam_search_matches_full_scan(f32_precision):
+    """Beam search with a long prompt routes through ONE batch-wide
+    prefill tiled across the beams — tokens and scores must match the
+    beam-per-position full scan exactly, incl. generating right up to
+    max_len (no overshoot headroom)."""
+    t = 96
+    wf, toks = _lm_workflow(max_epochs=6, t=t, n_kv_heads=2)
+    gen = LMGenerator(wf.trainer, max_len=t)
+    ref = LMGenerator(wf.trainer, max_len=t)
+    ref.prefill_min = 10 ** 9
+    for t0, max_new, beam in ((48, 10, 4), (40, 7, 3), (90, 6, 2)):
+        got_t, got_s = gen.beam_search(toks[:3, :t0], max_new=max_new,
+                                       beam=beam)
+        want_t, want_s = ref.beam_search(toks[:3, :t0], max_new=max_new,
+                                         beam=beam)
+        np.testing.assert_array_equal(got_t, want_t)
+        np.testing.assert_allclose(got_s, want_s, rtol=1e-6, atol=1e-6)
+    assert any(isinstance(k, tuple) and k[0] == "beamgen"
+               for k in gen._compiled), list(gen._compiled)
+
+
 def test_chunked_prefill_bf16_cache_rope_parity(f32_precision):
     """The dtype-ordering trap: the cache must hold rope(k) computed in
     the CACHE dtype (mha_step's ordering) on both paths, or bf16-cache

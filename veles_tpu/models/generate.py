@@ -307,6 +307,19 @@ class LMGenerator:
     def _bucket(n, cap):
         return min(1 << max(0, n - 1).bit_length(), cap)
 
+    def _prefill_dispatch(self, min_len, max_total):
+        """(prompt bucket, scan start, scan length) for the chunked-
+        prefill paths (greedy/sampled AND beam — one copy of the
+        invariant): validate_request caps max_total <= max_len, so the
+        pow2 length bucket, clamped to the remaining positions, always
+        covers the needed steps — and overshoot positions are frozen/
+        idempotent."""
+        tp = self._bucket(min_len, self.max_len)
+        start = min_len - 1
+        need = max(1, max_total - 1 - start)
+        length = self._bucket(need, max(1, self.max_len - 1 - start))
+        return tp, start, length
+
     def _decode_rows(self, tokens_np, lens, totals, greedy, seeds,
                      top_k, top_p, inv_temp):
         """Shared decode orchestrator (generate / generate_batch): pick
@@ -330,14 +343,9 @@ class LMGenerator:
             out, _ = self._run(self.params, tokens_np, lens, greedy,
                                seeds, top_k, top_p, inv_temp)
             return np.asarray(out)
-        tp = self._bucket(min_len, self.max_len)
+        tp, start, length = self._prefill_dispatch(min_len, max_total)
         caches = self._prefill_fn(b, tp)(
             self.params, jnp.asarray(tokens_np[:, :tp]))
-        start = min_len - 1
-        need = max(1, max_total - 1 - start)
-        # validate_request caps max_total <= max_len, so the pow2
-        # bucket (clamped to the remaining positions) always covers need
-        length = self._bucket(need, max(1, self.max_len - 1 - start))
         out = self._gen_fn(b, length)(
             self.params, caches, jnp.asarray(tokens_np),
             jnp.int32(start), row(lens, jnp.int32),
@@ -482,59 +490,9 @@ class LMGenerator:
             # tokens: [batch, beam, max_len]
             caches = self._init_caches(
                 bb, self.params[self._embed.name]["table"].dtype)
-            scores = jnp.zeros((batch, beam), jnp.float32)
-            # before any divergence only beam 0 may survive expansion,
-            # or the result would be `beam` copies of one continuation
-            scores = scores.at[:, 1:].set(-1e30)
-
-            def body(carry, pos):
-                tokens, caches, scores = carry
-                logits, caches = self._step(
-                    params, caches, tokens.reshape(bb, -1)[:, pos], pos)
-                logp = jax.nn.log_softmax(logits)        # [bb, V]
-                v = logp.shape[-1]
-                in_prompt = pos + 1 < prompt_len
-                # beams freeze inside the prompt AND once max_new tokens
-                # are out — the scan always runs to max_len, and scores
-                # must not accumulate past the requested horizon
-                frozen = in_prompt | (pos + 1 >= gen_end)
-
-                # candidate scores for every (beam, token) continuation
-                cand = scores[:, :, None] + logp.reshape(batch, beam, v)
-                flat = cand.reshape(batch, beam * v)
-                top_s, top_i = jax.lax.top_k(flat, beam)
-                parent = top_i // v                      # [batch, beam]
-                tok = (top_i % v).astype(jnp.int32)
-
-                # teacher forcing / frozen tail: every beam keeps its own
-                # row and the already-present token, at no score cost
-                keep_parent = jnp.broadcast_to(
-                    jnp.arange(beam)[None], (batch, beam))
-                parent = jnp.where(frozen, keep_parent, parent)
-                tok = jnp.where(frozen, tokens[:, :, pos + 1], tok)
-                new_scores = jnp.where(frozen, scores, top_s)
-
-                flat_parent = (parent
-                               + jnp.arange(batch)[:, None] * beam
-                               ).reshape(bb)
-                tokens = jnp.take(tokens.reshape(bb, -1), flat_parent,
-                                  axis=0).reshape(batch, beam, -1)
-                tokens = jax.lax.dynamic_update_slice(
-                    tokens, tok[:, :, None], (0, 0, pos + 1))
-                # physical cache reorder: every step gathers the FULL
-                # [B·beam, H, T_max, D] cache along the parent rows —
-                # O(T·beam·H·D) HBM write traffic per position, so
-                # O(T²·beam·H·D) per decode: fine at beam<=8 / T<=4k
-                # (bench.py phase_beam records the T=4096 beam=8 rate);
-                # a lazy ancestry-index reorder (gather at attention
-                # time) would cut writes to O(1) per step but needs the
-                # block step API to take per-position row indices —
-                # revisit if long-context beam serving becomes hot
-                caches = [(jnp.take(ck, flat_parent, axis=0),
-                           jnp.take(cv, flat_parent, axis=0))
-                          for ck, cv in caches]
-                return (tokens, caches, new_scores), None
-
+            scores = self._beam_init_scores(batch, beam)
+            body = self._beam_body(params, prompt_len, gen_end, batch,
+                                   beam)
             (tokens, _, scores), _ = jax.lax.scan(
                 body, (tokens, caches, scores),
                 jnp.arange(self.max_len - 1))
@@ -542,15 +500,111 @@ class LMGenerator:
 
         return self._cache_put(("beam", batch, beam), jax.jit(run))
 
+    @staticmethod
+    def _beam_init_scores(batch, beam):
+        # before any divergence only beam 0 may survive expansion,
+        # or the result would be `beam` copies of one continuation
+        scores = jnp.zeros((batch, beam), jnp.float32)
+        return scores.at[:, 1:].set(-1e30)
+
+    def _beam_body(self, params, prompt_len, gen_end, batch, beam):
+        """Per-position beam-expansion body shared by the full scan and
+        the prefilled beam scan.  Frozen steps (inside the prompt, past
+        ``gen_end``, or a clamped overshoot position) keep an identity
+        parent, so repeating them is a no-op — what makes power-of-two
+        length buckets safe."""
+        bb = batch * beam
+
+        def body(carry, pos):
+            tokens, caches, scores = carry
+            logits, caches = self._step(
+                params, caches, tokens.reshape(bb, -1)[:, pos], pos)
+            logp = jax.nn.log_softmax(logits)        # [bb, V]
+            v = logp.shape[-1]
+            in_prompt = pos + 1 < prompt_len
+            # beams freeze inside the prompt AND once max_new tokens
+            # are out — scores must not accumulate past the horizon
+            frozen = in_prompt | (pos + 1 >= gen_end)
+
+            # candidate scores for every (beam, token) continuation
+            cand = scores[:, :, None] + logp.reshape(batch, beam, v)
+            flat = cand.reshape(batch, beam * v)
+            top_s, top_i = jax.lax.top_k(flat, beam)
+            parent = top_i // v                      # [batch, beam]
+            tok = (top_i % v).astype(jnp.int32)
+
+            # teacher forcing / frozen tail: every beam keeps its own
+            # row and the already-present token, at no score cost
+            keep_parent = jnp.broadcast_to(
+                jnp.arange(beam)[None], (batch, beam))
+            parent = jnp.where(frozen, keep_parent, parent)
+            tok = jnp.where(frozen, tokens[:, :, pos + 1], tok)
+            new_scores = jnp.where(frozen, scores, top_s)
+
+            flat_parent = (parent
+                           + jnp.arange(batch)[:, None] * beam
+                           ).reshape(bb)
+            tokens = jnp.take(tokens.reshape(bb, -1), flat_parent,
+                              axis=0).reshape(batch, beam, -1)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, tok[:, :, None], (0, 0, pos + 1))
+            # physical cache reorder: every step gathers the FULL
+            # [B·beam, H, T_max, D] cache along the parent rows —
+            # O(T·beam·H·D) HBM write traffic per position, so
+            # O(T²·beam·H·D) per decode: fine at beam<=8 / T<=4k
+            # (bench.py phase_beam records the T=4096 beam=8 rate);
+            # a lazy ancestry-index reorder (gather at attention
+            # time) would cut writes to O(1) per step but needs the
+            # block step API to take per-position row indices —
+            # revisit if long-context beam serving becomes hot
+            caches = [(jnp.take(ck, flat_parent, axis=0),
+                       jnp.take(cv, flat_parent, axis=0))
+                      for ck, cv in caches]
+            return (tokens, caches, new_scores), None
+
+        return body
+
+    def _beam_gen_fn(self, batch, beam, length):
+        """ONE compile per (batch, beam, length bucket): beam expansion
+        over ``length`` positions from traced ``start``, against
+        prefilled BATCH caches tiled across the beams inside the jit
+        (beam rows are identical during the prompt, so one batch-wide
+        prefill serves all of them — the old path recomputed the prompt
+        beam× through the serial scan)."""
+        cached = self._cache_get(("beamgen", batch, beam, length))
+        if cached is not None:
+            return cached
+
+        def run(params, caches, tokens, start, prompt_len, gen_end):
+            caches = [(jnp.repeat(ck, beam, axis=0),
+                       jnp.repeat(cv, beam, axis=0))
+                      for ck, cv in caches]
+            scores = self._beam_init_scores(batch, beam)
+            body = self._beam_body(params, prompt_len, gen_end, batch,
+                                   beam)
+
+            def body2(carry, i):
+                pos = jnp.minimum(start + i, self.max_len - 2)
+                return body(carry, pos)
+
+            (tokens, _, scores), _ = jax.lax.scan(
+                body2, (tokens, caches, scores), jnp.arange(length))
+            return tokens, scores
+
+        return self._cache_put(("beamgen", batch, beam, length),
+                               jax.jit(run))
+
     def beam_search(self, prompt, max_new, beam=4):
         """Beam-search decode: prompt [B, T0] → (tokens [B, T0+max_new],
         log-probability of the returned best beam, [B]).
 
-        The prefill teacher-forces all ``beam`` rows identically — beam×
-        redundant prompt compute, the price of keeping ``prompt_len``
-        traced (ONE compiled executable per (batch, beam) regardless of
-        prompt length; a batch-width prefill would need a static split
-        point and recompile per length)."""
+        Short prompts (< prefill_min) run the single full scan, which
+        teacher-forces all ``beam`` rows identically — ONE executable
+        per (batch, beam) regardless of prompt length.  Long prompts
+        take the chunked-prefill path: ONE batch-wide prefill tiled
+        across the beams plus a short expansion scan, compiling per
+        ('pre', batch, prompt-bucket) and ('beamgen', batch, beam,
+        length-bucket) — all LRU-bounded."""
         prompt = np.asarray(prompt, np.int32)
         b, t0 = prompt.shape
         total = t0 + int(max_new)
@@ -564,9 +618,19 @@ class LMGenerator:
             raise ValueError("beam must be in [1, 64], got %r" % (beam,))
         tokens = np.zeros((b, beam, self.max_len), np.int32)
         tokens[:, :, :t0] = prompt[:, None, :]
-        out, scores = self._beam_fn(b, int(beam))(
-            self.params, jnp.asarray(tokens), jnp.int32(t0),
-            jnp.int32(total))
+        if t0 >= self.prefill_min:
+            # batch-wide prefill, tiled to the beams in-jit: the prompt
+            # is computed ONCE instead of beam x position-by-position
+            tp, start, length = self._prefill_dispatch(t0, total)
+            caches = self._prefill_fn(b, tp)(
+                self.params, jnp.asarray(tokens[:, 0, :tp]))
+            out, scores = self._beam_gen_fn(b, int(beam), length)(
+                self.params, caches, jnp.asarray(tokens),
+                jnp.int32(start), jnp.int32(t0), jnp.int32(total))
+        else:
+            out, scores = self._beam_fn(b, int(beam))(
+                self.params, jnp.asarray(tokens), jnp.int32(t0),
+                jnp.int32(total))
         best = np.asarray(jnp.argmax(scores, axis=1))
         out = np.asarray(out)[np.arange(b), best, :total]
         return out, np.asarray(scores)[np.arange(b), best]
