@@ -28,6 +28,7 @@ def main():
     # checkpoint gathers ZeRO-3 shards instead.
     rest = sys.argv[4:]
     fsdp = "--fsdp" in rest
+    seq = "--seq" in rest       # ring attention ACROSS processes
     dirs = [a for a in rest if not a.startswith("--")]
     snap_dir = dirs[0] if dirs else None
     # 4 local devices per process -> 8 global over 2 processes (overwrite
@@ -46,24 +47,46 @@ def main():
     from veles_tpu.models.standard_workflow import StandardWorkflow
 
     prng.seed_all(1234)
-    d = load_digits()
-    x = (d.data / 16.0).astype(np.float32)[:800]
-    y = d.target.astype(np.int32)[:800]
-    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=80,
-                             class_lengths=[0, 160, 640])
-    wf = StandardWorkflow(
-        layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
-                 "learning_rate": 0.1},
-                {"type": "softmax", "output_sample_shape": 10,
-                 "learning_rate": 0.1}],
-        loader=loader, decision_config={"max_epochs": 2},
-        snapshotter_config=(None if snap_dir is None else
-                            {"interval": 1, "directory": snap_dir}),
-        name="multihost-digits")
-    if fsdp or wf.snapshotter is None:
-        mesh_axes = {"data": -1}
+    if seq:
+        # sequence parallelism spanning processes: the ring's
+        # ppermute steps cross the process boundary at the seams
+        # (DCN on real pods)
+        from veles_tpu.models.zoo import transformer_classifier
+        xs = np.random.RandomState(0).rand(320, 16, 8)\
+            .astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 4, 320)\
+            .astype(np.int32)
+        loader = FullBatchLoader(None, data=xs, labels=ys,
+                                 minibatch_size=80,
+                                 class_lengths=[0, 80, 240])
+        wf = StandardWorkflow(
+            layers=transformer_classifier(n_classes=4, d_model=8,
+                                          n_heads=4, n_layers=1,
+                                          dropout=0.0, impl="ring",
+                                          lr=0.01),
+            loader=loader, decision_config={"max_epochs": 2},
+            name="multihost-seq")
+        mesh_axes = {"data": 1, "seq": -1}
     else:
-        mesh_axes = {"model": -1}   # params shard ACROSS processes
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)[:800]
+        y = d.target.astype(np.int32)[:800]
+        loader = FullBatchLoader(None, data=x, labels=y,
+                                 minibatch_size=80,
+                                 class_lengths=[0, 160, 640])
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
+                     "learning_rate": 0.1},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.1}],
+            loader=loader, decision_config={"max_epochs": 2},
+            snapshotter_config=(None if snap_dir is None else
+                                {"interval": 1, "directory": snap_dir}),
+            name="multihost-digits")
+        if fsdp or wf.snapshotter is None:
+            mesh_axes = {"data": -1}
+        else:
+            mesh_axes = {"model": -1}   # params shard ACROSS processes
 
     launcher = Launcher(workflow=wf, coordinator_address=coordinator,
                         num_processes=num_processes, process_id=process_id,

@@ -130,3 +130,40 @@ def test_multihost_fsdp_shards_params_and_checkpoints(tmp_path):
     snap = SnapshotterBase.import_(r0["snapshot"])
     w = np.asarray(snap["params"]["l00_all2all_tanh"]["weights"])
     assert w.shape == (64, 32)
+
+
+def test_multihost_sequence_parallel_ring_attention():
+    """Ring attention spanning BOTH processes: the 'seq' axis covers all
+    8 devices across the 2-process job, so every ppermute step sends
+    across the process boundary at the two ring seams (DCN on a real
+    pod).  Metrics must
+    bit-match across processes AND equal a single-process run of the
+    same seeded workflow on a local {seq: 8} mesh."""
+    r0, r1 = _spawn_job(2, extra=("--seq",))
+    assert r0["process_count"] == 2 and r0["n_global_devices"] == 8
+    assert r0["loss"] == r1["loss"]
+    assert r0["n_errors"] == r1["n_errors"]
+
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_classifier
+    from veles_tpu.parallel import MeshConfig, make_mesh
+
+    prng.seed_all(1234)
+    xs = np.random.RandomState(0).rand(320, 16, 8).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 4, 320).astype(np.int32)
+    loader = FullBatchLoader(None, data=xs, labels=ys, minibatch_size=80,
+                             class_lengths=[0, 80, 240])
+    wf = StandardWorkflow(
+        layers=transformer_classifier(n_classes=4, d_model=8, n_heads=4,
+                                      n_layers=1, dropout=0.0,
+                                      impl="ring", lr=0.01),
+        loader=loader, decision_config={"max_epochs": 2},
+        mesh_config=MeshConfig(make_mesh({"data": 1, "seq": 8})),
+        name="singlehost-seq")
+    wf.initialize()
+    wf.run()
+    m = wf.decision.epoch_metrics[1]
+    assert m["n_errors"] == r0["n_errors"]
+    np.testing.assert_allclose(m["loss"], r0["loss"], rtol=1e-5)
