@@ -95,6 +95,82 @@ def test_adamw_trains_transformer():
     assert wf.decision.best_metric < 0.15, wf.decision.best_metric
 
 
+def test_newton_schulz_orthogonalizes():
+    """ns(G) drives ALL singular values into a narrow band around 1
+    (the quintic coefficients trade exact orthogonality for speed —
+    they converge sv's into ~[0.7, 1.25], which is what Muon needs),
+    incl. tall inputs (transposed path) and conv-shaped leaves
+    (flattened to 2-D)."""
+    r = np.random.RandomState(0)
+    for shape in ((32, 48), (48, 32), (3, 3, 8, 16)):
+        g = jnp.asarray(r.randn(*shape).astype(np.float32))
+        u = np.asarray(optimizer.newton_schulz(g, steps=5))
+        sv_in = np.linalg.svd(np.asarray(g).reshape(-1, shape[-1]),
+                              compute_uv=False)
+        sv = np.linalg.svd(u.reshape(-1, shape[-1]), compute_uv=False)
+        assert sv_in.max() / sv_in.min() > 2          # input NOT flat
+        assert sv.min() > 0.5 and sv.max() < 1.3, (shape, sv)
+        assert sv.max() / sv.min() < 2, (shape, sv)   # spread collapsed
+
+
+def test_muon_falls_back_to_adamw_for_tables_and_biases():
+    # 1-D bias: identical to adamw (no decay by default, no NS)
+    b_m, _ = _one_step("muon", [2.0], [0.5], wd=0.01, leaf="bias")
+    b_w, _ = _one_step("adamw", [2.0], [0.5], wd=0.01, leaf="bias")
+    np.testing.assert_allclose(b_m, b_w, rtol=1e-6)
+    # embedding table (2-D, key 'table'): adamw rule, not NS
+    t = np.ones((4, 8), np.float32)
+    g = np.full((4, 8), 0.5, np.float32)
+    t_m, _ = _one_step("muon", t, g, leaf="table")
+    t_w, _ = _one_step("adamw", t, g, leaf="table")
+    np.testing.assert_allclose(t_m, t_w, rtol=1e-6)
+    # a weight matrix: NS path — update magnitude is lr-sized per
+    # element and NOT the adamw update
+    w = np.ones((8, 8), np.float32)
+    w_m, _ = _one_step("muon", w, g.reshape(8, 4).repeat(2, 1))
+    assert not np.allclose(
+        w_m, _one_step("adamw", w, g.reshape(8, 4).repeat(2, 1))[0])
+
+
+def test_per_layer_solver_knobs_reach_the_optimizer():
+    """The Layer.gd key set derives from optimizer.DEFAULTS — a
+    solver-specific knob set on a LAYER config must not be silently
+    dropped (the stale-whitelist bug class)."""
+    from veles_tpu.models.layers import make_layer
+    layer = make_layer({"type": "all2all_tanh", "output_sample_shape": 4,
+                        "solver": "muon", "muon_ns_steps": 3,
+                        "muon_momentum": 0.9, "rprop_inc": 1.1})
+    assert layer.gd["muon_ns_steps"] == 3
+    assert layer.gd["muon_momentum"] == 0.9
+    assert layer.gd["rprop_inc"] == 1.1
+    h = optimizer.resolve_hyper(layer.gd)
+    assert h["muon_ns_steps"] == 3 and h["muon_momentum"] == 0.9
+
+
+def test_muon_trains_transformer():
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models import zoo
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    prng.seed_all(51)
+    r = np.random.RandomState(2)
+    toks = ((np.arange(16)[None, :] * 3 + r.randint(0, 5, 192)[:, None])
+            % 17).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=48,
+                             class_lengths=[0, 48, 144])
+    wf = StandardWorkflow(
+        layers=zoo.transformer_lm(vocab_size=17, d_model=32, n_heads=4,
+                                  n_layers=1, lr=5e-3, solver="muon"),
+        loader=loader, loss="lm",
+        gd_defaults={"weights_decay": 0.01, "clip_norm": 1.0},
+        decision_config={"max_epochs": 15}, name="muon-lm")
+    wf.initialize()
+    wf.run()
+    assert wf.decision.best_metric < 0.15, wf.decision.best_metric
+
+
 def test_clip_by_global_norm():
     g = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([0.0])}  # norm 5
     clipped = optimizer.clip_by_global_norm(g, 1.0)

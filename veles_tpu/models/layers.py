@@ -49,13 +49,15 @@ class Layer(object):
         self.cfg = cfg
         self.name = cfg.get("name", self.type)
         # per-layer GD hyperparameters (ref Znicz GD unit kwargs); None
-        # falls back to workflow-level defaults in the optimizer
-        self.gd = {k: cfg[k] for k in
-                   ("learning_rate", "learning_rate_bias", "weights_decay",
-                    "weights_decay_bias", "l1_vs_l2", "gradient_moment",
-                    "gradient_moment_bias", "solver", "adam_beta1",
-                    "adam_beta2", "epsilon", "rprop_inc", "rprop_dec",
-                    "rprop_min", "rprop_max") if k in cfg}
+        # falls back to workflow-level defaults in the optimizer.  The
+        # key set is DERIVED from optimizer.DEFAULTS (plus the *_bias
+        # variants it resolves) so a new solver knob can never be
+        # silently dropped by a stale hand-maintained whitelist.
+        from veles_tpu.models import optimizer as _opt
+        gd_keys = set(_opt.DEFAULTS) | {
+            "learning_rate_bias", "weights_decay_bias",
+            "gradient_moment_bias"}
+        self.gd = {k: cfg[k] for k in gd_keys if k in cfg}
         self.input_shape = None
         self.output_shape = None
         self.policy = default_policy()

@@ -22,6 +22,14 @@ Solvers, selectable per layer via ``solver``:
 - ``rprop``   sign-based resilient propagation (ref RPropAll2All):
               per-weight step grows ×1.2 on agreeing signs, shrinks ×0.5
               on sign flips
+- ``muon``    momentum orthogonalized by a Newton–Schulz iteration
+              (Jordan et al. 2024) — five matmuls per matrix per step,
+              MXU-native.  Applies to >=2-D weight matrices (conv
+              kernels flatten to [fan_in, fan_out]); embedding/position
+              tables, biases and other 1-D leaves fall back to the
+              adamw rule, per the Muon recipe.  ``muon_momentum``
+              (0.95), ``muon_ns_steps`` (5), ``muon_nesterov`` (True);
+              weight decay is decoupled like adamw.
 
 State is {"slot1": tree, "slot2": tree, "step": scalar}: slot1 = momentum
 velocity / Adam m / RProp previous gradient; slot2 = Adam v / AdaGrad
@@ -46,6 +54,9 @@ DEFAULTS = {
     "rprop_dec": 0.5,
     "rprop_min": 1e-8,
     "rprop_max": 1.0,
+    "muon_momentum": 0.95,
+    "muon_ns_steps": 5,
+    "muon_nesterov": True,
 }
 
 
@@ -56,17 +67,39 @@ def resolve_hyper(layer_gd, workflow_gd=None):
     if workflow_gd:
         h.update({k: v for k, v in workflow_gd.items() if k in DEFAULTS})
     h.update({k: v for k, v in layer_gd.items() if k in DEFAULTS})
-    if h["solver"] not in ("gd", "adam", "adamw", "adagrad", "rprop"):
-        raise ValueError("unknown solver %r (gd|adam|adamw|adagrad|rprop)"
-                         % (h["solver"],))
+    if h["solver"] not in ("gd", "adam", "adamw", "adagrad", "rprop",
+                           "muon"):
+        raise ValueError(
+            "unknown solver %r (gd|adam|adamw|adagrad|rprop|muon)"
+            % (h["solver"],))
     for k in ("learning_rate", "weights_decay", "gradient_moment"):
         if h[k + "_bias"] is None:
-            # adamw convention: biases / norm shifts are NOT decayed
-            # unless weights_decay_bias is given explicitly
-            h[k + "_bias"] = (0.0 if (k == "weights_decay"
-                                      and h["solver"] == "adamw")
+            # adamw/muon convention: biases / norm shifts are NOT
+            # decayed unless weights_decay_bias is given explicitly
+            h[k + "_bias"] = (0.0 if (k == "weights_decay" and
+                                      h["solver"] in ("adamw", "muon"))
                               else h[k])
     return h
+
+
+def newton_schulz(g, steps=5, eps=1e-7):
+    """Quintic Newton–Schulz orthogonalization (Muon): drives the
+    singular values of ``g`` (flattened to [fan_in-ish, fan_out]) toward
+    1 with five matmuls per iteration — all MXU work, no SVD.  Runs in
+    f32 regardless of input dtype."""
+    a, b, c = 3.4445, -4.7750, 2.0315
+    shape = g.shape
+    x = g.reshape(-1, shape[-1]).astype(jnp.float32)
+    transposed = x.shape[0] > x.shape[1]
+    if transposed:                       # iterate on the smaller gram
+        x = x.T
+    x = x / (jnp.linalg.norm(x) + eps)
+    for _ in range(steps):
+        gram = x @ x.T
+        x = a * x + (b * gram + c * gram @ gram) @ x
+    if transposed:
+        x = x.T
+    return x.reshape(shape)
 
 
 def init_state(params):
@@ -75,8 +108,24 @@ def init_state(params):
             "step": jnp.zeros((), jnp.int32)}
 
 
-def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h):
+def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h,
+                 orthogonalize=False):
     reg = (1.0 - l1) * w + l1 * jnp.sign(w)
+    if solver == "muon":
+        if orthogonalize:
+            mu = h["muon_momentum"]
+            m = mu * s1 + g
+            u_in = mu * m + g if h["muon_nesterov"] else m
+            u = newton_schulz(u_in, steps=int(h["muon_ns_steps"]))
+            # match adamw's per-element update RMS across shapes
+            # (Jordan et al.: scale by sqrt(max(1, fan_out/fan_in)))
+            flat_rows = 1
+            for d in w.shape[:-1]:
+                flat_rows *= d
+            u = u * max(1.0, w.shape[-1] / flat_rows) ** 0.5
+            return (w - lr * u.astype(w.dtype) - lr * wd * w, m, s2)
+        # tables / biases / 1-D leaves: the adamw rule (Muon recipe)
+        solver = "adamw"
     if solver in ("adam", "adamw"):
         b1, b2, eps = h["adam_beta1"], h["adam_beta2"], h["epsilon"]
         m = b1 * s1 + (1.0 - b1) * g
@@ -128,6 +177,9 @@ def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0):
 
     def upd(path, w, g, a, b):
         bias = _is_bias(path)
+        ortho = (solver == "muon" and not bias and w.ndim >= 2
+                 and str(getattr(path[-1], "key", ""))
+                 not in ("table", "pos"))
         return _update_leaf(
             solver, w, g.astype(w.dtype), a, b, step,
             lr_scale * (hyper["learning_rate_bias"] if bias
@@ -135,7 +187,7 @@ def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0):
             hyper["weights_decay_bias"] if bias else hyper["weights_decay"],
             hyper["l1_vs_l2"],
             hyper["gradient_moment_bias"] if bias
-            else hyper["gradient_moment"], hyper)
+            else hyper["gradient_moment"], hyper, orthogonalize=ortho)
 
     triples = jax.tree_util.tree_map_with_path(upd, params, grads, s1, s2)
     is_t = lambda x: isinstance(x, tuple)  # noqa: E731
