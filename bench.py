@@ -319,13 +319,28 @@ def phase_lm_large():
     50304 (MXU-friendly multiple of 128), tied embeddings, per-layer
     remat, flash attention + fused backward, RoPE, AdamW + global-norm
     clip, bf16 compute, fused 4-step dispatch.  Target: >= 40% MFU
-    single-chip."""
-    return _run_lm(
-        "lm-124M",
-        dict(d_model=768, n_heads=12, n_layers=12, dropout=0.0,
-             impl="flash", pos="rope", solver="adamw", lr=6e-4,
-             remat=True, tie_embeddings=True),
-        batch=8, seq=1024, steps=12, steps_per_dispatch=4, vocab=50304)
+    single-chip.  Tries batch 16 first (better MXU fill) and falls
+    back to 8 if the chip can't hold it."""
+    import gc
+
+    cfg = dict(d_model=768, n_heads=12, n_layers=12, dropout=0.0,
+               impl="flash", pos="rope", solver="adamw", lr=6e-4,
+               remat=True, tie_embeddings=True)
+    try:
+        return dict(_run_lm("lm-124M", cfg, batch=16, seq=1024, steps=8,
+                            steps_per_dispatch=4, vocab=50304),
+                    batch=16)
+    except Exception as e:  # noqa: BLE001 — typically RESOURCE_EXHAUSTED
+        if "RESOURCE_EXHAUSTED" not in str(e) and \
+                "Out of memory" not in str(e):
+            raise
+        _log("lm_large batch=16 OOM — falling back to batch=8")
+    # retry OUTSIDE the except block: an in-flight exception's traceback
+    # would pin the failed attempt's device buffers during the retry
+    gc.collect()
+    return dict(_run_lm("lm-124M", cfg, batch=8, seq=1024, steps=12,
+                        steps_per_dispatch=4, vocab=50304),
+                batch=8)
 
 
 def _chain_attn(attn_fn, q, k, v, iters, grad=False):

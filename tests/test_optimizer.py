@@ -130,6 +130,19 @@ def test_muon_falls_back_to_adamw_for_tables_and_biases():
     w_m, _ = _one_step("muon", w, g.reshape(8, 4).repeat(2, 1))
     assert not np.allclose(
         w_m, _one_step("adamw", w, g.reshape(8, 4).repeat(2, 1))[0])
+    # LM/classifier head layers take the adamw rule even for 2-D
+    # weights (Muon recipe: hidden matrices only)
+    gw = g.reshape(8, 4).repeat(2, 1)
+    params = {"l05_timestep_dense": {"weights": jnp.asarray(w)}}
+    grads = {"l05_timestep_dense": {"weights": jnp.asarray(gw)}}
+    hy = {"l05_timestep_dense": optimizer.resolve_hyper(
+        {"solver": "muon", "learning_rate": 0.1})}
+    p_head, _ = optimizer.update(params, grads,
+                                 optimizer.init_state(params), hy)
+    w_aw, _ = _one_step("adamw", w, gw)
+    np.testing.assert_allclose(
+        np.asarray(p_head["l05_timestep_dense"]["weights"]), w_aw,
+        rtol=1e-6)
 
 
 def test_per_layer_solver_knobs_reach_the_optimizer():

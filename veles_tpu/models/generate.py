@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veles_tpu.ops import norm
+from veles_tpu.ops import attention, norm
 
 #: compiled-executable cache capacity per generator.  Batch size (number
 #: of prompt rows) and beam width are both client-controlled on the REST
@@ -85,7 +85,8 @@ class LMGenerator:
         self.max_len = int(max_len)
         #: KV-cache storage dtype; default follows the params.  bfloat16
         #: halves serve-time cache memory (keys/values are MXU inputs
-        #: anyway; softmax stays f32)
+        #: anyway; softmax stays f32); "int8" quarters it vs f32 via
+        #: per-position symmetric quantization (ops.attention.QuantCache)
         self.cache_dtype = cache_dtype
         self._compiled = collections.OrderedDict()
         self._cache_lock = threading.Lock()
@@ -173,19 +174,34 @@ class LMGenerator:
 
     def _cache_constraint(self, c):
         """Pin a KV cache's head dim to the model axis under a mesh —
-        the annotation GSPMD propagates through the whole decode scan."""
+        the annotation GSPMD propagates through the whole decode scan.
+        Applied leaf-wise (a QuantCache carries data + scales, both
+        [B, Hkv, T, ...])."""
         if self.mesh_cfg is None or self.mesh_cfg.model_size <= 1:
             return c
         from jax.sharding import NamedSharding, PartitionSpec as P
-        return jax.lax.with_sharding_constraint(
-            c, NamedSharding(self.mesh_cfg.mesh,
-                             P(None, self.mesh_cfg.model_axis)))
+        sh = NamedSharding(self.mesh_cfg.mesh,
+                           P(None, self.mesh_cfg.model_axis))
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, sh), c)
 
     def _init_caches(self, batch, dtype):
         dtype = self.cache_dtype or dtype
-        return [tuple(self._cache_constraint(
-            jnp.zeros((batch, layer.n_kv_heads, self.max_len,
-                       self._head_dim), dtype)) for _ in range(2))
+
+        def one(layer):
+            shape = (batch, layer.n_kv_heads, self.max_len,
+                     self._head_dim)
+            if jnp.dtype(dtype) == jnp.int8:
+                # int8 KV cache: quarter the serve-time cache memory
+                # (ops.attention.QuantCache; scales for unwritten
+                # positions are never read — decode writes before use)
+                return attention.QuantCache(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.ones(shape[:3] + (1,), jnp.float32))
+            return jnp.zeros(shape, dtype)
+
+        return [tuple(self._cache_constraint(one(layer))
+                      for _ in range(2))
                 for layer in self._blocks]
 
     def _scan_fn(self, batch):
@@ -557,9 +573,8 @@ class LMGenerator:
             # time) would cut writes to O(1) per step but needs the
             # block step API to take per-position row indices —
             # revisit if long-context beam serving becomes hot
-            caches = [(jnp.take(ck, flat_parent, axis=0),
-                       jnp.take(cv, flat_parent, axis=0))
-                      for ck, cv in caches]
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, flat_parent, axis=0), caches)
             return (tokens, caches, new_scores), None
 
         return body
@@ -576,9 +591,8 @@ class LMGenerator:
             return cached
 
         def run(params, caches, tokens, start, prompt_len, gen_end):
-            caches = [(jnp.repeat(ck, beam, axis=0),
-                       jnp.repeat(cv, beam, axis=0))
-                      for ck, cv in caches]
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, beam, axis=0), caches)
             scores = self._beam_init_scores(batch, beam)
             body = self._beam_body(params, prompt_len, gen_end, batch,
                                    beam)

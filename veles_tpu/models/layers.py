@@ -50,14 +50,11 @@ class Layer(object):
         self.name = cfg.get("name", self.type)
         # per-layer GD hyperparameters (ref Znicz GD unit kwargs); None
         # falls back to workflow-level defaults in the optimizer.  The
-        # key set is DERIVED from optimizer.DEFAULTS (plus the *_bias
-        # variants it resolves) so a new solver knob can never be
-        # silently dropped by a stale hand-maintained whitelist.
+        # key set IS optimizer.DEFAULTS (which includes the *_bias
+        # variants) so a new solver knob can never be silently dropped
+        # by a stale hand-maintained whitelist.
         from veles_tpu.models import optimizer as _opt
-        gd_keys = set(_opt.DEFAULTS) | {
-            "learning_rate_bias", "weights_decay_bias",
-            "gradient_moment_bias"}
-        self.gd = {k: cfg[k] for k in gd_keys if k in cfg}
+        self.gd = {k: cfg[k] for k in _opt.DEFAULTS if k in cfg}
         self.input_shape = None
         self.output_shape = None
         self.policy = default_policy()

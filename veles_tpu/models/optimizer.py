@@ -170,14 +170,25 @@ def _is_bias(path):
     return str(getattr(path[-1], "key", "")) in _BIAS_KEYS
 
 
-def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0):
+#: layer-name markers whose parameters take Muon's adamw fallback even
+#: when 2-D: embeddings, position tables, and the LM/classifier head —
+#: the Muon recipe orthogonalizes HIDDEN matrices only
+_MUON_FALLBACK_LAYERS = ("embedding", "positional", "timestep_dense",
+                         "tied_lm_head", "softmax")
+
+
+def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0,
+                 layer_name=""):
     """Apply the update rule to one layer's param pytree (flat
     {'weights', 'bias'} or nested transformer-style dicts)."""
     solver = hyper.get("solver", "gd")
+    muon_fallback_layer = any(m in layer_name
+                              for m in _MUON_FALLBACK_LAYERS)
 
     def upd(path, w, g, a, b):
         bias = _is_bias(path)
         ortho = (solver == "muon" and not bias and w.ndim >= 2
+                 and not muon_fallback_layer
                  and str(getattr(path[-1], "key", ""))
                  not in ("table", "pos"))
         return _update_leaf(
@@ -222,5 +233,6 @@ def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None):
     for lname in params:
         new_p[lname], new_s1[lname], new_s2[lname] = update_layer(
             params[lname], grads[lname], state["slot1"][lname],
-            state["slot2"][lname], step, hypers[lname], lr_scale)
+            state["slot2"][lname], step, hypers[lname], lr_scale,
+            layer_name=lname)
     return new_p, {"slot1": new_s1, "slot2": new_s2, "step": step}

@@ -19,12 +19,41 @@ regardless of input dtype."""
 
 import functools
 import math
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+
+class QuantCache(NamedTuple):
+    """int8-quantized KV cache: per-(batch, head, position) symmetric
+    scales over the head dim.  Quarters the serve-time cache memory vs
+    f32 (halves vs bf16) — the storage bound on long-context serving.
+    A pytree, so the decode scan / beam gathers treat it like a plain
+    array via tree_map."""
+
+    data: jnp.ndarray      # int8  [B, Hkv, T, hd]
+    scale: jnp.ndarray     # f32   [B, Hkv, T, 1]
+
+
+def quantize_kv(x):
+    """x [..., T, hd] → (int8 data, f32 scale[..., T, 1]): symmetric
+    per-position quantization over the head dim."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                        1e-8) / 127.0
+    data = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return data, scale
+
+
+def dequantize_kv(cache):
+    """QuantCache → float array (f32) — the prefill in-chunk view, so
+    prefilled positions see exactly what later decode steps will read
+    back from the quantized cache."""
+    return cache.data.astype(jnp.float32) * cache.scale
 
 
 def _scale(d, scale=None):
@@ -289,15 +318,31 @@ def mha_prefill(params, x, cache_k, cache_v, n_heads, n_kv_heads=None,
     Returns (y [B, Tp, d_model], cache_k, cache_v)."""
     if n_kv_heads is None:
         n_kv_heads = n_heads
+    quant = isinstance(cache_k, QuantCache)
     q, k, v = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
-    k = k.astype(cache_k.dtype)
-    v = v.astype(cache_v.dtype)
+    if not quant:
+        k = k.astype(cache_k.dtype)
+        v = v.astype(cache_v.dtype)
     if use_rope:
         pos = jnp.arange(x.shape[1])
         q = rope(q, pos)
-        k = rope(k, pos).astype(cache_k.dtype)  # cache stores rotated k
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, 0, 0))
+        k = (rope(k, pos) if quant
+             else rope(k, pos).astype(cache_k.dtype))
+
+    def write(cache, val):
+        if not quant:
+            return jax.lax.dynamic_update_slice(cache, val,
+                                                (0, 0, 0, 0)), val
+        d, s = quantize_kv(val)
+        new = QuantCache(
+            jax.lax.dynamic_update_slice(cache.data, d, (0, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(cache.scale, s, (0, 0, 0, 0)))
+        # the in-chunk attention must see the QUANTIZED view — exactly
+        # what later decode steps read back from the cache
+        return new, dequantize_kv(QuantCache(d, s)).astype(val.dtype)
+
+    cache_k, k = write(cache_k, k)
+    cache_v, v = write(cache_v, v)
     k, v = _broadcast_kv(k, v, n_heads, n_kv_heads)
     o = blockwise_attention(q, k, v, causal=True, scale=scale,
                             window=window)
@@ -312,36 +357,68 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
     x: [B, 1, d_model] (the token at position ``pos``);
     cache_k/cache_v: [B, n_kv_heads, T_max, head_dim] — the cache stores
     KV HEADS ONLY, so GQA's smaller KV state is realized here (the query
-    groups attend to the shared kv head without materializing copies).
+    groups attend to the shared kv head without materializing copies) —
+    or QuantCache pairs (int8 data + per-position scales; the scores
+    fold the scales in after the int8-input einsum, so no dequantized
+    [B, H, T, hd] copy ever materializes).
     Returns (y [B, 1, d_model], cache_k, cache_v) with position ``pos``
     written."""
     if n_kv_heads is None:
         n_kv_heads = n_heads
+    quant = isinstance(cache_k, QuantCache)
+    kdt = cache_k.data.dtype if quant else cache_k.dtype
     q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
-    k1 = k1.astype(cache_k.dtype)                      # [B, Hkv, 1, hd]
-    v1 = v1.astype(cache_v.dtype)
+    if not quant:
+        k1 = k1.astype(cache_k.dtype)                  # [B, Hkv, 1, hd]
+        v1 = v1.astype(cache_v.dtype)
     if use_rope:
         p1 = jnp.full((1,), pos, jnp.int32)
         q = rope(q, p1)
-        k1 = rope(k1, p1).astype(cache_k.dtype)  # cache stores rotated k
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k1, (0, 0, pos, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v1, (0, 0, pos, 0))
+        k1 = (rope(k1, p1) if quant
+              else rope(k1, p1).astype(kdt))   # cache stores rotated k
+
+    def write(cache, val):
+        if not quant:
+            return jax.lax.dynamic_update_slice(cache, val,
+                                                (0, 0, pos, 0))
+        d, s = quantize_kv(val)
+        return QuantCache(
+            jax.lax.dynamic_update_slice(cache.data, d, (0, 0, pos, 0)),
+            jax.lax.dynamic_update_slice(cache.scale, s,
+                                         (0, 0, pos, 0)))
+
+    cache_k = write(cache_k, k1)
+    cache_v = write(cache_v, v1)
 
     b, h, _, hd = q.shape
     g = h // n_kv_heads
     qg = q.reshape(b, n_kv_heads, g, hd)
-    s = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k,
-                   preferred_element_type=jnp.float32)
+    if quant:
+        s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                       cache_k.data.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+        # fold the per-position k scales in AFTER the dot
+        s = s * cache_k.scale[..., 0][:, :, None, :]
+    else:
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k,
+                       preferred_element_type=jnp.float32)
     s = s * _scale(hd, scale)
-    t_max = cache_k.shape[2]
+    t_max = (cache_k.data if quant else cache_k).shape[2]
     positions = jnp.arange(t_max)[None, None, None, :]
     live = positions <= pos
     if window is not None:
         live = live & (pos - positions < window)
     s = jnp.where(live, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkgt,bktd->bkgd", p.astype(cache_v.dtype), cache_v,
-                   preferred_element_type=jnp.float32)
+    if quant:
+        # fold the per-position v scales into the probabilities
+        pv = p * cache_v.scale[..., 0][:, :, None, :]
+        o = jnp.einsum("bkgt,bktd->bkgd", pv.astype(qg.dtype),
+                       cache_v.data.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+    else:
+        o = jnp.einsum("bkgt,bktd->bkgd", p.astype(cache_v.dtype),
+                       cache_v, preferred_element_type=jnp.float32)
     o = o.reshape(b, 1, h * hd).astype(x.dtype)
     return (_proj(o, params["wo"], params["bo"], policy),
             cache_k, cache_v)
