@@ -398,6 +398,58 @@ def test_incremental_matches_full_forward_window(f32_precision):
     np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
 
 
+def test_rolling_window_cache_bounds_memory(f32_precision):
+    """Sliding-window blocks get a ring-buffer cache of exactly
+    ``window`` slots: serve-time KV memory is O(window) no matter how
+    long the context — and generation still matches the training
+    forward's window mask (score oracle) at positions far past the
+    window."""
+    import jax.numpy as jnp
+
+    t, w = 96, 16
+    wf, toks = _lm_workflow(max_epochs=6, t=t, window=w, pos="rope")
+    gen = LMGenerator(wf.trainer, max_len=t)
+    caches = gen._init_caches(2, jnp.float32)
+    for ck, cv in caches:
+        assert ck.shape == (2, 4, w, 8), ck.shape     # w slots, not t
+    # logits match the full training forward (window mask) at every
+    # position, incl. far beyond the window
+    inc = gen.score(toks[:3])
+    full = np.asarray(
+        jax.jit(wf.trainer._forward, static_argnums=(2,))(
+            wf.trainer.params, jnp.asarray(toks[:3]), False,
+            jax.random.key(0)), np.float32)[:, :-1]
+    np.testing.assert_allclose(inc, full, rtol=2e-3, atol=2e-3)
+    # prefill path == full scan on the ring buffer, deep into the
+    # context (prompt 11x the window)
+    ref = LMGenerator(wf.trainer, max_len=t)
+    ref.prefill_min = 10 ** 9
+    for kwargs in ({}, {"temperature": 0.8, "seed": 7}):
+        np.testing.assert_array_equal(
+            gen.generate(toks[:3, :80], max_new=10, **kwargs),
+            ref.generate(toks[:3, :80], max_new=10, **kwargs))
+    # beam rides the ring too
+    bt, bs = gen.beam_search(toks[:2, :70], max_new=6, beam=3)
+    rt, rs = ref.beam_search(toks[:2, :70], max_new=6, beam=3)
+    np.testing.assert_array_equal(bt, rt)
+    np.testing.assert_allclose(bs, rs, rtol=1e-5, atol=1e-5)
+    # int8 composes with the ring (QuantCache slots)
+    gen8 = LMGenerator(wf.trainer, max_len=t, cache_dtype="int8")
+    c8 = gen8._init_caches(2, jnp.float32)
+    assert c8[0][0].data.shape == (2, 4, w, 8)
+    np.testing.assert_array_equal(
+        gen8.generate(toks[:3, :80], max_new=10),
+        gen.generate(toks[:3, :80], max_new=10))
+    # int8 + ring PREFILL == int8 + ring full scan (the in-chunk view
+    # must be the quantized one everywhere, head positions included)
+    ref8 = LMGenerator(wf.trainer, max_len=t, cache_dtype="int8")
+    ref8.prefill_min = 10 ** 9
+    for kwargs in ({}, {"temperature": 0.8, "seed": 5}):
+        np.testing.assert_array_equal(
+            gen8.generate(toks[:3, :80], max_new=10, **kwargs),
+            ref8.generate(toks[:3, :80], max_new=10, **kwargs))
+
+
 def test_generation_with_tied_embeddings(f32_precision):
     wf, toks = _lm_workflow(max_epochs=0, tie_embeddings=True)
     gen = LMGenerator(wf.trainer, max_len=16)

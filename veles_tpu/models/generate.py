@@ -135,6 +135,12 @@ class LMGenerator:
                 % (self.max_len, self._posenc.input_shape[0]))
         b0 = self._blocks[0]
         self._head_dim = b0.input_shape[-1] // b0.n_heads
+        #: sliding-window blocks with window < max_len get a ROLLING
+        #: ring-buffer cache of exactly ``window`` slots — serve-time
+        #: KV memory is O(window) regardless of context length
+        self._rolling = any(
+            (layer.cfg.get("window") or self.max_len) < self.max_len
+            for layer in self._blocks)
         if self.mesh_cfg is not None and self.mesh_cfg.model_size > 1:
             m = self.mesh_cfg.model_size
             for layer in self._blocks:
@@ -189,8 +195,9 @@ class LMGenerator:
         dtype = self.cache_dtype or dtype
 
         def one(layer):
-            shape = (batch, layer.n_kv_heads, self.max_len,
-                     self._head_dim)
+            t_cache = min(self.max_len,
+                          layer.cfg.get("window") or self.max_len)
+            shape = (batch, layer.n_kv_heads, t_cache, self._head_dim)
             if jnp.dtype(dtype) == jnp.int8:
                 # int8 KV cache: quarter the serve-time cache memory
                 # (ops.attention.QuantCache; scales for unwritten
@@ -329,9 +336,21 @@ class LMGenerator:
         invariant): validate_request caps max_total <= max_len, so the
         pow2 length bucket, clamped to the remaining positions, always
         covers the needed steps — and overshoot positions are frozen/
-        idempotent."""
-        tp = self._bucket(min_len, self.max_len)
-        start = min_len - 1
+        idempotent.
+
+        ROLLING caches round the prompt chunk DOWN (largest pow2 <=
+        min_len): a ring slot must always hold the latest position <=
+        the scan cursor, so the prefill may never write a position past
+        its own start — padding rows would poison the slot->position
+        mapping.  Linear caches round UP (padding is overwritten before
+        it can be read)."""
+        if self._rolling:
+            tp = max(1, min(1 << (min_len.bit_length() - 1),
+                            self.max_len))
+            start = tp - 1
+        else:
+            tp = self._bucket(min_len, self.max_len)
+            start = min_len - 1
         need = max(1, max_total - 1 - start)
         length = self._bucket(need, max(1, self.max_len - 1 - start))
         return tp, start, length

@@ -122,7 +122,7 @@ class StagedTrainer(Unit):
                 self.params[layer.name] = jax.tree_util.tree_map(
                     jnp.asarray, layer.init_params(rng))
                 hypers[layer.name] = optimizer.resolve_hyper(
-                    layer.gd, self.gd_defaults)
+                    layer.gd, self.gd_defaults, layer_type=layer.type)
         self.velocity = optimizer.init_state(self.params)
         self._hypers = hypers
         # resolve weight-tying references now that layers are named:

@@ -60,10 +60,14 @@ DEFAULTS = {
 }
 
 
-def resolve_hyper(layer_gd, workflow_gd=None):
+def resolve_hyper(layer_gd, workflow_gd=None, layer_type=None):
     """Merge per-layer GD kwargs over workflow defaults over DEFAULTS, and
-    resolve the *_bias fallbacks."""
+    resolve the *_bias fallbacks.  ``layer_type`` (the registry type
+    string) rides along so solver rules that depend on the layer's ROLE
+    (Muon's hidden-matrices-only orthogonalization) match exactly."""
     h = dict(DEFAULTS)
+    if layer_type is not None:
+        h["_layer_type"] = layer_type
     if workflow_gd:
         h.update({k: v for k, v in workflow_gd.items() if k in DEFAULTS})
     h.update({k: v for k, v in layer_gd.items() if k in DEFAULTS})
@@ -170,11 +174,12 @@ def _is_bias(path):
     return str(getattr(path[-1], "key", "")) in _BIAS_KEYS
 
 
-#: layer-name markers whose parameters take Muon's adamw fallback even
-#: when 2-D: embeddings, position tables, and the LM/classifier head —
-#: the Muon recipe orthogonalizes HIDDEN matrices only
-_MUON_FALLBACK_LAYERS = ("embedding", "positional", "timestep_dense",
-                         "tied_lm_head", "softmax")
+#: layer TYPES whose parameters take Muon's adamw fallback even when
+#: 2-D: embeddings, position tables, and the LM/classifier head — the
+#: Muon recipe orthogonalizes HIDDEN matrices only
+_MUON_FALLBACK_TYPES = frozenset(
+    {"embedding", "positional_encoding", "timestep_dense",
+     "tied_lm_head", "softmax"})
 
 
 def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0,
@@ -182,8 +187,12 @@ def update_layer(params, grads, s1, s2, step, hyper, lr_scale=1.0,
     """Apply the update rule to one layer's param pytree (flat
     {'weights', 'bias'} or nested transformer-style dicts)."""
     solver = hyper.get("solver", "gd")
-    muon_fallback_layer = any(m in layer_name
-                              for m in _MUON_FALLBACK_LAYERS)
+    ltype = hyper.get("_layer_type")
+    if ltype is not None:               # exact registry-type match
+        muon_fallback_layer = ltype in _MUON_FALLBACK_TYPES
+    else:                               # direct callers: name heuristic
+        muon_fallback_layer = any(m in layer_name
+                                  for m in _MUON_FALLBACK_TYPES)
 
     def upd(path, w, g, a, b):
         bias = _is_bias(path)

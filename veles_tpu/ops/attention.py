@@ -319,27 +319,61 @@ def mha_prefill(params, x, cache_k, cache_v, n_heads, n_kv_heads=None,
     if n_kv_heads is None:
         n_kv_heads = n_heads
     quant = isinstance(cache_k, QuantCache)
+    t_cache = (cache_k.data if quant else cache_k).shape[2]
+    tp = x.shape[1]
+    rolling = window is not None and t_cache == window
     q, k, v = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
     if not quant:
         k = k.astype(cache_k.dtype)
         v = v.astype(cache_v.dtype)
     if use_rope:
-        pos = jnp.arange(x.shape[1])
+        pos = jnp.arange(tp)
         q = rope(q, pos)
         k = (rope(k, pos) if quant
              else rope(k, pos).astype(cache_k.dtype))
 
-    def write(cache, val):
-        if not quant:
-            return jax.lax.dynamic_update_slice(cache, val,
-                                                (0, 0, 0, 0)), val
-        d, s = quantize_kv(val)
-        new = QuantCache(
-            jax.lax.dynamic_update_slice(cache.data, d, (0, 0, 0, 0)),
-            jax.lax.dynamic_update_slice(cache.scale, s, (0, 0, 0, 0)))
-        # the in-chunk attention must see the QUANTIZED view — exactly
-        # what later decode steps read back from the cache
-        return new, dequantize_kv(QuantCache(d, s)).astype(val.dtype)
+    if rolling:
+        # ring buffer: keep only the chunk's LAST min(tp, window)
+        # positions; each lands in its slot (pos % window) — unique
+        # slots, so the scatter has no duplicate-index hazard.  The
+        # caller guarantees every chunk position is a real prompt token
+        # (models.generate rounds the prefill chunk DOWN), so after
+        # this write slot i holds the latest position <= tp - 1.
+        keep = min(tp, window)
+        tail_pos = jnp.arange(tp - keep, tp)
+        slots = tail_pos % window
+
+        def cache_write(cache, val):
+            if not quant:
+                return (cache.at[:, :, slots, :]
+                        .set(val[:, :, tp - keep:, :]), val)
+            # quantize the WHOLE chunk for the in-chunk view (in-chunk
+            # queries attend head positions too, and the sequential
+            # path reads everything quantized — the views must match);
+            # only the tail slots are stored
+            d, s = quantize_kv(val)
+            new = QuantCache(
+                cache.data.at[:, :, slots, :]
+                .set(d[:, :, tp - keep:, :]),
+                cache.scale.at[:, :, slots, :]
+                .set(s[:, :, tp - keep:, :]))
+            return new, dequantize_kv(QuantCache(d, s)).astype(val.dtype)
+    else:
+        def cache_write(cache, val):
+            if not quant:
+                return jax.lax.dynamic_update_slice(
+                    cache, val, (0, 0, 0, 0)), val
+            d, s = quantize_kv(val)
+            new = QuantCache(
+                jax.lax.dynamic_update_slice(cache.data, d,
+                                             (0, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(cache.scale, s,
+                                             (0, 0, 0, 0)))
+            # the in-chunk attention must see the QUANTIZED view —
+            # exactly what later decode steps read back from the cache
+            return new, dequantize_kv(QuantCache(d, s)).astype(val.dtype)
+
+    write = cache_write
 
     cache_k, k = write(cache_k, k)
     cache_v, v = write(cache_v, v)
@@ -355,18 +389,29 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
     """One incremental-decoding step with a KV cache.
 
     x: [B, 1, d_model] (the token at position ``pos``);
-    cache_k/cache_v: [B, n_kv_heads, T_max, head_dim] — the cache stores
-    KV HEADS ONLY, so GQA's smaller KV state is realized here (the query
-    groups attend to the shared kv head without materializing copies) —
-    or QuantCache pairs (int8 data + per-position scales; the scores
-    fold the scales in after the int8-input einsum, so no dequantized
-    [B, H, T, hd] copy ever materializes).
+    cache_k/cache_v: [B, n_kv_heads, T_cache, head_dim] — the cache
+    stores KV HEADS ONLY, so GQA's smaller KV state is realized here
+    (the query groups attend to the shared kv head without
+    materializing copies) — or QuantCache pairs (int8 data +
+    per-position scales; the scores fold the scales in after the
+    int8-input einsum, so no dequantized [B, H, T, hd] copy ever
+    materializes).
+
+    ROLLING cache: with a sliding ``window``, T_cache == window means
+    the cache is a ring buffer — position ``pos`` lives in slot
+    ``pos % window`` and slot ``i`` holds absolute position
+    ``pos - ((pos - i) % window)`` (the latest position <= pos mapping
+    to that slot).  Serve-time memory is then O(window) regardless of
+    context length.  T_cache > window keeps the linear layout.
     Returns (y [B, 1, d_model], cache_k, cache_v) with position ``pos``
     written."""
     if n_kv_heads is None:
         n_kv_heads = n_heads
     quant = isinstance(cache_k, QuantCache)
     kdt = cache_k.data.dtype if quant else cache_k.dtype
+    t_cache = (cache_k.data if quant else cache_k).shape[2]
+    rolling = window is not None and t_cache == window
+    slot = (pos % window) if rolling else pos
     q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
     if not quant:
         k1 = k1.astype(cache_k.dtype)                  # [B, Hkv, 1, hd]
@@ -380,12 +425,13 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
     def write(cache, val):
         if not quant:
             return jax.lax.dynamic_update_slice(cache, val,
-                                                (0, 0, pos, 0))
+                                                (0, 0, slot, 0))
         d, s = quantize_kv(val)
         return QuantCache(
-            jax.lax.dynamic_update_slice(cache.data, d, (0, 0, pos, 0)),
+            jax.lax.dynamic_update_slice(cache.data, d,
+                                         (0, 0, slot, 0)),
             jax.lax.dynamic_update_slice(cache.scale, s,
-                                         (0, 0, pos, 0)))
+                                         (0, 0, slot, 0)))
 
     cache_k = write(cache_k, k1)
     cache_v = write(cache_v, v1)
@@ -403,11 +449,17 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
         s = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k,
                        preferred_element_type=jnp.float32)
     s = s * _scale(hd, scale)
-    t_max = (cache_k.data if quant else cache_k).shape[2]
-    positions = jnp.arange(t_max)[None, None, None, :]
-    live = positions <= pos
-    if window is not None:
-        live = live & (pos - positions < window)
+    if rolling:
+        # slot i holds absolute position pos - ((pos - i) % window):
+        # always inside the window by construction, live once written
+        slots = jnp.arange(window)[None, None, None, :]
+        p_slot = pos - ((pos - slots) % window)
+        live = p_slot >= 0
+    else:
+        positions = jnp.arange(t_cache)[None, None, None, :]
+        live = positions <= pos
+        if window is not None:
+            live = live & (pos - positions < window)
     s = jnp.where(live, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if quant:
