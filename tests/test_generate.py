@@ -398,6 +398,42 @@ def test_incremental_matches_full_forward_window(f32_precision):
     np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("zoo_kwargs", [
+    {}, {"n_kv_heads": 2, "pos": "rope"}])
+def test_speculative_decode_matches_greedy(zoo_kwargs, f32_precision):
+    """In-jit n-gram speculation is greedy-EXACT: identical tokens to
+    generate() for any draft width, and on this repetitive corpus the
+    round count proves multi-token acceptance actually happened."""
+    t = 96
+    wf, toks = _lm_workflow(max_epochs=8, t=t, **zoo_kwargs)
+    gen = LMGenerator(wf.trainer, max_len=t)
+    prompt = toks[:1, :48]
+    want = gen.generate(prompt, max_new=20)
+    for dk in (4, 8):
+        got = gen.generate_speculative(prompt, max_new=20, draft_k=dk)
+        np.testing.assert_array_equal(got, want)
+    assert any(isinstance(k, tuple) and k[0] == "spec"
+               for k in gen._compiled), list(gen._compiled)
+    # UNTRAINED model: argmax never reproduces the prompt, so this
+    # pins the teacher-forced tail (the bonus token must not overwrite
+    # prompt positions) and true exactness, not corpus memorization
+    wf0, toks0 = _lm_workflow(max_epochs=0, t=t, **zoo_kwargs)
+    gen0 = LMGenerator(wf0.trainer, max_len=t)
+    p0 = toks0[:1, :48]
+    got0 = gen0.generate_speculative(p0, max_new=20, draft_k=8)
+    np.testing.assert_array_equal(got0[:, :48], p0)   # prompt intact
+    np.testing.assert_array_equal(got0, gen0.generate(p0, max_new=20))
+    # fallbacks: batch > 1 and short prompts route to plain generate()
+    np.testing.assert_array_equal(
+        gen.generate_speculative(toks[:2, :48], max_new=4),
+        gen.generate(toks[:2, :48], max_new=4))
+    np.testing.assert_array_equal(
+        gen.generate_speculative(toks[:1, :8], max_new=4),
+        gen.generate(toks[:1, :8], max_new=4))
+    with pytest.raises(ValueError, match="draft_k"):
+        gen.generate_speculative(prompt, max_new=4, draft_k=1)
+
+
 def test_rolling_window_cache_bounds_memory(f32_precision):
     """Sliding-window blocks get a ring-buffer cache of exactly
     ``window`` slots: serve-time KV memory is O(window) no matter how

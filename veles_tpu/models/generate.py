@@ -153,13 +153,19 @@ class LMGenerator:
                         % (layer.n_kv_heads, m))
 
     # ------------------------------------------------------------------
-    def _pos_row(self, params, pos):
+    def _pos_table(self, params):
+        """The position table (learned weights or the sinusoid buffer);
+        None when the stack has no positional-encoding layer (rope)."""
         if self._posenc is None:
-            return 0.0
+            return None
         if self._posenc.learned:
-            table = params[self._posenc.name]["pos"]
-        else:
-            table = self._posenc._sinusoid()
+            return params[self._posenc.name]["pos"]
+        return self._posenc._sinusoid()
+
+    def _pos_row(self, params, pos):
+        table = self._pos_table(params)
+        if table is None:
+            return 0.0
         return jax.lax.dynamic_index_in_dim(table, pos, keepdims=False)
 
     def _step(self, params, caches, tok, pos):
@@ -269,13 +275,8 @@ class LMGenerator:
         return body
 
     def _pos_rows(self, params, tp):
-        if self._posenc is None:
-            return 0.0
-        if self._posenc.learned:
-            table = params[self._posenc.name]["pos"]
-        else:
-            table = self._posenc._sinusoid()
-        return table[:tp]
+        table = self._pos_table(params)
+        return 0.0 if table is None else table[:tp]
 
     def _prefill_fn(self, batch, tp):
         """ONE compile per (batch, prompt bucket): run the prompt chunk
@@ -388,6 +389,131 @@ class LMGenerator:
             row(top_p, jnp.float32), row(inv_temp, jnp.float32),
             row(greedy, jnp.bool_))
         return np.asarray(out)
+
+    def _chunk_logits(self, params, caches, toks, start):
+        """toks [1, K] at positions [start, start+K) → (logits [K, V]
+        f32, caches) — the speculative verify forward."""
+        table = params[self._embed.name]["table"]
+        x = jnp.take(table, toks.astype(jnp.int32), axis=0)
+        ptab = self._pos_table(params)
+        if ptab is not None:
+            x = x + jax.lax.dynamic_slice(
+                ptab, (start, 0), (toks.shape[1], ptab.shape[1]))
+        new_caches = []
+        for layer, (ck, cv) in zip(self._blocks, caches):
+            x, ck, cv = layer.chunk_step(params[layer.name], x, ck, cv,
+                                         start)
+            new_caches.append((ck, cv))
+        lp = params[self._ln.name]
+        x = norm.layer_norm(x, lp["gamma"], lp["beta"])
+        head_p = (params if getattr(self._head, "needs_full_params",
+                                    False) else params[self._head.name])
+        return (self._head.apply(head_p, x)[0].astype(jnp.float32),
+                new_caches)
+
+    def _spec_fn(self, draft_k):
+        """ONE compile per draft width: the whole speculative greedy
+        decode — n-gram draft, K-wide verify chunk, acceptance — inside
+        a single jitted lax.while_loop (no host round trips).  Each
+        round advances >= 1 position; drafts that copy a continuation
+        of the last bigram from earlier context verify several
+        positions per model pass."""
+        cached = self._cache_get(("spec", draft_k))
+        if cached is not None:
+            return cached
+        kk = draft_k
+        ll = self.max_len
+
+        def run(params, caches, tokens, cur0, prompt_len, total):
+            # tokens [1, max_len]; cache valid for [0, cur0)
+            idx = jnp.arange(kk)
+
+            def cond(state):
+                return state[2] < total
+
+            def body(state):
+                tokens, caches, cur = state
+                row = tokens[0]
+                # draft: copy the continuation of the most recent
+                # earlier occurrence of the last bigram; fallback =
+                # repeat from cur-1 (quality only affects speed)
+                j = jnp.arange(ll - 1)
+                last2 = jax.lax.dynamic_slice(row, (cur - 2,), (2,))
+                match = ((row[:-1] == last2[0]) & (row[1:] == last2[1])
+                         & (j + 1 < cur - 1))
+                cand = jnp.max(jnp.where(match, j, -1))
+                src = jnp.clip(jnp.where(cand >= 0, cand + 2, cur - 1),
+                               0, ll - kk)
+                draft = jax.lax.dynamic_slice(row, (src,), (kk,))
+                # prompt positions teacher-force their own tokens
+                in_prompt = (cur + idx) < prompt_len
+                cur_slice = jax.lax.dynamic_slice(row, (cur,), (kk,))
+                draft = jnp.where(in_prompt, cur_slice, draft)
+                # verify: inputs are [token at cur-1, draft[:-1]]
+                prev = jax.lax.dynamic_slice(row, (cur - 1,), (1,))
+                chunk = jnp.concatenate([prev, draft[:-1]])[None]
+                logits, caches = self._chunk_logits(
+                    params, caches, chunk, cur - 1)
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                ok = (draft == g) | in_prompt
+                # first rejection = number accepted; cap at kk-1 so the
+                # "bonus" write is always a position we HAVE logits for
+                # (if draft[kk-1] was accepted, g[kk-1] equals it)
+                a = jnp.minimum(
+                    jnp.argmin(jnp.concatenate(
+                        [ok, jnp.zeros((1,), bool)])), kk - 1)
+                # the bonus position must NEVER overwrite a
+                # teacher-forced prompt token (a lands inside the
+                # prompt tail when the whole chunk was in-prompt)
+                bonus = jnp.where(jnp.take(in_prompt, a),
+                                  jnp.take(cur_slice, a),
+                                  jnp.take(g, a))
+                newvec = jnp.where(
+                    idx < a, draft,
+                    jnp.where(idx == a, bonus, cur_slice))
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, newvec[None], (0, cur))
+                return (tokens, caches, cur + a + 1)
+
+            tokens, _, _ = jax.lax.while_loop(
+                cond, body, (tokens, caches, cur0))
+            return tokens
+
+        return self._cache_put(("spec", draft_k), jax.jit(run))
+
+    def generate_speculative(self, prompt, max_new, draft_k=8):
+        """Greedy decode with in-jit n-gram speculation: repetitive or
+        self-similar continuations verify up to ``draft_k`` positions
+        per model pass instead of one.  Exact greedy semantics — the
+        accepted tokens ARE the verify pass's own argmax.  Falls back
+        to generate() when speculation can't apply (batch > 1, short
+        prompts, rolling-window caches, no headroom for the draft
+        overshoot)."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        b, t0 = prompt.shape
+        draft_k = int(draft_k)
+        if not 2 <= draft_k <= 64:
+            raise ValueError("draft_k must be in [2, 64], got %r"
+                             % (draft_k,))
+        t0v, total, _, _, _, _ = self.validate_request(
+            t0, {"max_new": max_new, "temperature": 0.0})
+        if (b != 1 or self._rolling or t0 < max(4, self.prefill_min)
+                or total + draft_k >= self.max_len):
+            return self.generate(prompt, max_new)
+        # prefill rounds DOWN: every cache row < cur0 must hold a REAL
+        # prompt token (the verify chunk attends them before any
+        # rewrite — round-up padding would poison later chunks)
+        tp = max(2, min(1 << (t0.bit_length() - 1), self.max_len))
+        caches = self._prefill_fn(1, tp)(
+            self.params, jnp.asarray(prompt[:, :tp]))   # tp <= t0
+        tokens = np.zeros((1, self.max_len), np.int32)
+        tokens[0, :t0] = prompt[0]
+        out = self._spec_fn(draft_k)(
+            self.params, caches, jnp.asarray(tokens), jnp.int32(tp),
+            jnp.int32(t0), jnp.int32(total))
+        return np.asarray(out)[:, :total]
 
     def _cache_get(self, key):
         # the REST server is threaded and shares one generator: the

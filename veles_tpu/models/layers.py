@@ -704,6 +704,19 @@ class TransformerBlock(Layer):
                 use_rope=bool(self.cfg.get("rope", False)),
                 window=self.cfg.get("window")))
 
+    def chunk_step(self, params, x, cache_k, cache_v, start):
+        """K positions [start, start+K) in one parallel pass against
+        the existing cache — the speculative-decoding verify step
+        (equivalent to K step() calls)."""
+        from veles_tpu.ops import attention
+        return self._cached_attn_block(
+            params, x,
+            lambda h: attention.mha_chunk_step(
+                params["mha"], h, cache_k, cache_v, start, self.n_heads,
+                n_kv_heads=self.n_kv_heads, policy=self.policy,
+                use_rope=bool(self.cfg.get("rope", False)),
+                window=self.cfg.get("window")))
+
 
 class PipelinedTransformer(Layer):
     """N identical transformer blocks run as pipeline stages

@@ -384,6 +384,83 @@ def mha_prefill(params, x, cache_k, cache_v, n_heads, n_kv_heads=None,
             cache_k, cache_v)
 
 
+def mha_chunk_step(params, x, cache_k, cache_v, start, n_heads,
+                   n_kv_heads=None, scale=None, policy=None,
+                   use_rope=False, window=None):
+    """K incremental positions in ONE parallel pass against an existing
+    cache: x [B, K, d_model] holds the tokens at positions
+    [start, start + K); their k/v write into the cache and every row i
+    attends cache positions <= start + i (+ sliding window) — the
+    speculative-decoding verify step.  Linear caches only (a rolling
+    ring's slot->position map cannot tolerate the rejected-draft tail
+    this writes past the cursor).  ``start`` is traced.
+    Returns (y [B, K, d_model], cache_k, cache_v)."""
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    quant = isinstance(cache_k, QuantCache)
+    kk = x.shape[1]
+    q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
+    if not quant:
+        k1 = k1.astype(cache_k.dtype)
+        v1 = v1.astype(cache_v.dtype)
+    if use_rope:
+        pos = start + jnp.arange(kk)
+        q = rope(q, pos)
+        k1 = (rope(k1, pos) if quant
+              else rope(k1, pos).astype(cache_k.dtype))
+
+    def write(cache, val):
+        if not quant:
+            return jax.lax.dynamic_update_slice(cache, val,
+                                                (0, 0, start, 0))
+        d, s = quantize_kv(val)
+        return QuantCache(
+            jax.lax.dynamic_update_slice(cache.data, d,
+                                         (0, 0, start, 0)),
+            jax.lax.dynamic_update_slice(cache.scale, s,
+                                         (0, 0, start, 0)))
+
+    cache_k = write(cache_k, k1)
+    cache_v = write(cache_v, v1)
+
+    b, h, _, hd = q.shape
+    g = h // n_kv_heads
+    qg = q.reshape(b, n_kv_heads, g * kk, hd)   # flatten (group, K)
+    if quant:
+        s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                       cache_k.data.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+        s = s * cache_k.scale[..., 0][:, :, None, :]
+    else:
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k,
+                       preferred_element_type=jnp.float32)
+    s = s.reshape(b, n_kv_heads, g, kk, -1)
+    s = s * _scale(hd, scale)
+    t_cache = (cache_k.data if quant else cache_k).shape[2]
+    positions = jnp.arange(t_cache)[None, None, None, None, :]
+    rows = start + jnp.arange(kk)[None, None, None, :, None]
+    live = positions <= rows
+    if window is not None:
+        live = live & (rows - positions < window)
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).reshape(b, n_kv_heads, g * kk, -1)
+    if quant:
+        pv = p * cache_v.scale[..., 0][:, :, None, :]
+        o = jnp.einsum("bkgt,bktd->bkgd", pv.astype(qg.dtype),
+                       cache_v.data.astype(qg.dtype),
+                       preferred_element_type=jnp.float32)
+    else:
+        o = jnp.einsum("bkgt,bktd->bkgd", p.astype(cache_v.dtype),
+                       cache_v, preferred_element_type=jnp.float32)
+    # [b, kv, g*kk, hd] -> [b, kk, kv, g, hd] -> [b, kk, h*hd]
+    # (head index = kv*g + gi, matching split_heads/merge_heads)
+    o = jnp.transpose(o.reshape(b, n_kv_heads, g, kk, hd),
+                      (0, 3, 1, 2, 4))
+    o = o.reshape(b, kk, h * hd).astype(x.dtype)
+    return (_proj(o, params["wo"], params["bo"], policy),
+            cache_k, cache_v)
+
+
 def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
              scale=None, policy=None, use_rope=False, window=None):
     """One incremental-decoding step with a KV cache.

@@ -202,6 +202,14 @@ class RESTfulAPI(Logger):
             out, _ = self.generator.beam_search(
                 prompt, int(opts.get("max_new", 16)), beam=beam)
             return out
+        spec = int(opts.get("speculative", 0))
+        if (spec and prompt.shape[0] == 1 and self.batcher is None
+                and float(opts.get("temperature", 0.0)) == 0.0):
+            # greedy single-row requests can opt into in-jit n-gram
+            # speculation (exact greedy semantics; generate_speculative
+            # falls back itself when speculation can't apply)
+            return self.generator.generate_speculative(
+                prompt, int(opts.get("max_new", 16)), draft_k=spec)
         if self.batcher is not None:
             # validate THIS request up front — a bad one must 400 alone,
             # never poison the batch it would have coalesced into
