@@ -526,11 +526,35 @@ def phase_beam():
     ms_beam = timed(lambda: gen.beam_search(prompt, max_new=64,
                                             beam=beam))
     ms_greedy = timed(lambda: gen.generate(prompt, max_new=64))
+
+    # speculative decode on a self-similar prompt (the regime n-gram
+    # drafting exists for): wall-clock per generated token vs the plain
+    # greedy scan — both prefill the long prompt
+    rep = np.tile(np.arange(64, dtype=np.int32),
+                  t_max // 64 + 1)[None, :t_max // 2]
+    max_new = max(16, t_max // 8)
+
+    def timed_gen(fn):
+        fn()                              # compile + warmup
+        t0 = time.perf_counter()
+        fn()
+        return (time.perf_counter() - t0) / max_new * 1e3
+
+    ms_spec = timed_gen(lambda: gen.generate_speculative(
+        rep, max_new=max_new, draft_k=8))
+    ms_plain = timed_gen(lambda: gen.generate(rep, max_new=max_new))
+    # both paths prefill the prompt and decode ~max_new positions
+    # (generate()'s post-prefill scan buckets on max_new), so ms/token
+    # over max_new compares like for like
     _log("beam decode T=%d beam=%d (2L d=256 lm): %.3f ms/pos beam, "
-         "%.3f ms/pos greedy (reorder cost x%.1f)"
+         "%.3f ms/pos greedy (reorder cost x%.1f); speculative "
+         "%.3f ms/tok vs plain %.3f ms/tok (x%.1f)"
          % (t_max, beam, ms_beam, ms_greedy,
-            ms_beam / ms_greedy if ms_greedy else 0.0))
+            ms_beam / ms_greedy if ms_greedy else 0.0,
+            ms_spec, ms_plain,
+            ms_plain / ms_spec if ms_spec else 0.0))
     return {"ms_per_pos_beam8": ms_beam, "ms_per_pos_greedy": ms_greedy,
+            "ms_per_tok_spec": ms_spec, "ms_per_tok_greedy": ms_plain,
             "t": t_max}
 
 

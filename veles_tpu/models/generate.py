@@ -177,12 +177,17 @@ class LMGenerator:
         for layer, (ck, cv) in zip(self._blocks, caches):
             x, ck, cv = layer.step(params[layer.name], x, ck, cv, pos)
             new_caches.append((ck, cv))
+        logits = self._ln_head(params, x)
+        return logits[:, 0].astype(jnp.float32), new_caches
+
+    def _ln_head(self, params, x):
+        """Final LN + LM head (shared by every decode path — the
+        needs_full_params head protocol lives in exactly one place)."""
         lp = params[self._ln.name]
         x = norm.layer_norm(x, lp["gamma"], lp["beta"])
         head_p = (params if getattr(self._head, "needs_full_params",
                                     False) else params[self._head.name])
-        logits = self._head.apply(head_p, x)
-        return logits[:, 0].astype(jnp.float32), new_caches
+        return self._head.apply(head_p, x)
 
     def _cache_constraint(self, c):
         """Pin a KV cache's head dim to the model axis under a mesh —
@@ -404,11 +409,7 @@ class LMGenerator:
             x, ck, cv = layer.chunk_step(params[layer.name], x, ck, cv,
                                          start)
             new_caches.append((ck, cv))
-        lp = params[self._ln.name]
-        x = norm.layer_norm(x, lp["gamma"], lp["beta"])
-        head_p = (params if getattr(self._head, "needs_full_params",
-                                    False) else params[self._head.name])
-        return (self._head.apply(head_p, x)[0].astype(jnp.float32),
+        return (self._ln_head(params, x)[0].astype(jnp.float32),
                 new_caches)
 
     def _spec_fn(self, draft_k):
