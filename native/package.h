@@ -153,8 +153,10 @@ inline NpyArray ParseNpy(const std::string& bytes) {
     throw std::runtime_error("npy: fortran order unsupported");
   bool f8 = header.find("<f8") != std::string::npos;
   bool f2 = header.find("<f2") != std::string::npos;
-  if (!f8 && !f2 && header.find("<f4") == std::string::npos)
-    throw std::runtime_error("npy: dtype must be <f2, <f4 or <f8");
+  bool i1 = header.find("|i1") != std::string::npos ||
+            header.find("<i1") != std::string::npos;
+  if (!f8 && !f2 && !i1 && header.find("<f4") == std::string::npos)
+    throw std::runtime_error("npy: dtype must be <f2, <f4, <f8 or i1");
   NpyArray arr;
   size_t sp = header.find("'shape':");
   size_t lp = header.find('(', sp), rp = header.find(')', lp);
@@ -174,7 +176,7 @@ inline NpyArray ParseNpy(const std::string& bytes) {
   }
   size_t n = arr.elements();
   size_t dstart = hstart + hlen;
-  size_t esize = f8 ? 8 : (f2 ? 2 : 4);
+  size_t esize = f8 ? 8 : (f2 ? 2 : (i1 ? 1 : 4));
   if (bytes.size() < dstart + n * esize)
     throw std::runtime_error("npy: truncated data");
   arr.data.resize(n);
@@ -187,10 +189,27 @@ inline NpyArray ParseNpy(const std::string& bytes) {
     const uint16_t* src =
         reinterpret_cast<const uint16_t*>(bytes.data() + dstart);
     for (size_t i = 0; i < n; ++i) arr.data[i] = HalfToFloat(src[i]);
+  } else if (i1) {
+    const int8_t* src =
+        reinterpret_cast<const int8_t*>(bytes.data() + dstart);
+    for (size_t i = 0; i < n; ++i)
+      arr.data[i] = static_cast<float>(src[i]);
   } else {
     std::memcpy(arr.data.data(), bytes.data() + dstart, n * 4);
   }
   return arr;
+}
+
+// Fold per-output-channel scales (export dtype="int8": one <f4 scale
+// per last-dim column) back into a widened int8 array.
+inline void ApplyChannelScales(NpyArray& w, const NpyArray& scales) {
+  if (w.shape.empty())
+    throw std::runtime_error("scales: scalar weights unsupported");
+  size_t cols = w.shape.back();
+  if (scales.elements() != cols)
+    throw std::runtime_error("scales: length != output channels");
+  for (size_t i = 0; i < w.data.size(); ++i)
+    w.data[i] *= scales.data[i % cols];
 }
 
 }  // namespace veles_native

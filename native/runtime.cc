@@ -297,10 +297,20 @@ class Workflow {
       const Json& arrays = ju.at("arrays");
       if (arrays.has("weights")) {
         u.weights = ParseNpy(zip.read(arrays.at("weights").str()));
+        if (arrays.has("weights__scales"))   // int8 package: widen
+          ApplyChannelScales(
+              u.weights,
+              ParseNpy(zip.read(arrays.at("weights__scales").str())));
         u.has_weights = true;
       }
       if (arrays.has("bias")) {
         u.bias = ParseNpy(zip.read(arrays.at("bias").str()));
+        // forward-compat only: today's exporter keeps 1-D biases f32,
+        // so this branch is unexercised until the format quantizes them
+        if (arrays.has("bias__scales"))
+          ApplyChannelScales(
+              u.bias,
+              ParseNpy(zip.read(arrays.at("bias__scales").str())));
         u.has_bias = true;
       }
       units_.push_back(std::move(u));

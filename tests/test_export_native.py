@@ -199,8 +199,52 @@ class TestHalfPrecisionPackages:
 
     def test_bad_dtype_rejected(self, tmp_path):
         wf, _ = train_small(MLP_LAYERS, epochs=1)
-        with pytest.raises(ValueError, match="float32 or float16"):
-            export_workflow(wf, str(tmp_path / "x.zip"), dtype="int8")
+        with pytest.raises(ValueError, match="float32, float16 or int8"):
+            export_workflow(wf, str(tmp_path / "x.zip"), dtype="int4")
+
+    def test_int8_package_native_and_python(self, tmp_path):
+        """dtype='int8': ~4x smaller weight payloads (per-output-channel
+        symmetric scales); the native runtime widens <i1 via the
+        __scales companions and import_workflow dequantizes
+        transparently — consumers never see the quantization."""
+        import os
+
+        from veles_tpu.services.native import NativeWorkflow
+
+        wf, x = train_small(MLP_LAYERS)
+        p32 = str(tmp_path / "m32.zip")
+        p8 = str(tmp_path / "m8.zip")
+        export_workflow(wf, p32)
+        export_workflow(wf, p8, dtype="int8")
+        assert os.path.getsize(p8) < 0.55 * os.path.getsize(p32)
+        # the weight PAYLOAD itself quarters (manifest/bias overhead
+        # dominates this tiny model's total)
+        import zipfile
+        with zipfile.ZipFile(p32) as z32, zipfile.ZipFile(p8) as z8:
+            w32 = next(i.file_size for i in z32.infolist()
+                       if i.filename.endswith("weights.npy"))
+            w8 = next(i.file_size for i in z8.infolist()
+                      if i.filename.endswith("weights.npy")
+                      and "scales" not in i.filename)
+            assert w8 < 0.3 * w32, (w8, w32)
+
+        manifest, arrays = import_workflow(p8)
+        assert all(not p.endswith("__scales")
+                   for u in manifest["units"] for p in u["arrays"])
+        assert all(a.dtype != np.int8 for a in arrays.values())
+        w_file = manifest["units"][0]["arrays"]["weights"]
+        want_w = np.asarray(
+            wf.trainer.params[wf.trainer.layers[0].name]["weights"])
+        err = np.abs(arrays[w_file] - want_w).max()
+        assert err <= np.abs(want_w).max() / 127 + 1e-7, err
+
+        native = NativeWorkflow(p8)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:64]))
+        got = native(x[:64])
+        np.testing.assert_allclose(got, want, atol=3e-2)
+        assert (got.argmax(1) == want.argmax(1)).mean() > 0.98
+        native.close()
 
     def test_f16_subnormals_decode_exactly(self, tmp_path):
         """HalfToFloat must match numpy bit-for-bit incl. subnormals

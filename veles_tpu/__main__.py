@@ -62,10 +62,11 @@ class Main(object):
         p.add_argument("--result-file", default=None,
                        help="write gather_results() JSON here")
         p.add_argument("--export-dtype", default="float32",
-                       choices=("float32", "float16"),
+                       choices=("float32", "float16", "int8"),
                        help="weight storage dtype for --export "
-                       "(float16 halves the package; the native "
-                       "runtime widens to f32 on load)")
+                       "(float16 halves the package, int8 quarters it "
+                       "with per-channel scales; the native runtime "
+                       "widens to f32 on load)")
         p.add_argument("--export", default=None,
                        help="export trained model package to this path")
         p.add_argument("--serve", type=int, default=None, metavar="PORT",

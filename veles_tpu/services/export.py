@@ -27,9 +27,14 @@ def export_workflow(workflow, path, dtype="float32"):
 
     ``dtype="float16"`` halves the package: weights are stored <f2 and
     the native runtime widens them to f32 on load (the reference's
-    optional fp16→fp32 transform, libVeles numpy_array_loader.cc)."""
-    if dtype not in ("float32", "float16"):
-        raise ValueError("dtype must be float32 or float16")
+    optional fp16→fp32 transform, libVeles numpy_array_loader.cc).
+    ``dtype="int8"`` quarters it: >= 2-D float arrays store symmetric
+    per-output-channel int8 (``<i1`` + a ``<f4`` "<param>__scales"
+    companion, one scale per last-dim column); biases and 1-D arrays
+    stay f32.  Both the native runtime and import_workflow dequantize
+    on load."""
+    if dtype not in ("float32", "float16", "int8"):
+        raise ValueError("dtype must be float32, float16 or int8")
     trainer = workflow.trainer
     host = trainer.host_params()
     units = []
@@ -37,9 +42,21 @@ def export_workflow(workflow, path, dtype="float32"):
     for i, layer in enumerate(trainer.layers):
         arrays = {}
         for pname, arr in (host.get(layer.name) or {}).items():
+            arr = np.asarray(arr)
             fname = "%04d_%s_%s.npy" % (i, layer.name, pname)
             arrays[pname] = fname
-            files[fname] = np.asarray(arr)
+            if (dtype == "int8" and arr.ndim >= 2
+                    and np.issubdtype(arr.dtype, np.floating)):
+                scales = np.maximum(
+                    np.abs(arr).max(axis=tuple(range(arr.ndim - 1))),
+                    1e-8).astype(np.float32) / 127.0
+                files[fname] = np.clip(
+                    np.round(arr / scales), -127, 127).astype(np.int8)
+                sname = fname[:-4] + "__scales.npy"
+                arrays[pname + "__scales"] = sname
+                files[sname] = scales
+            else:
+                files[fname] = arr
         cfg = {k: v for k, v in layer.cfg.items() if _jsonable(v)}
         units.append({
             "name": layer.name,
@@ -62,8 +79,15 @@ def export_workflow(workflow, path, dtype="float32"):
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
         zf.writestr("contents.json", json.dumps(manifest, indent=2))
         for fname, arr in files.items():
+            if dtype == "int8":
+                # int8 payloads / f32 scales keep their own dtypes;
+                # un-quantized floats (biases) stay f32
+                out = (arr if arr.dtype in (np.int8, np.float32)
+                       else np.ascontiguousarray(arr, np.float32))
+            else:
+                out = np.ascontiguousarray(arr, dtype=dtype)
             buf = io.BytesIO()
-            np.save(buf, np.ascontiguousarray(arr, dtype=dtype))
+            np.save(buf, out)
             zf.writestr(fname, buf.getvalue())
     return path
 
@@ -71,13 +95,23 @@ def export_workflow(workflow, path, dtype="float32"):
 def import_workflow(path):
     """Read a package back into (manifest, {filename: array}) — the Python
     side of the round-trip test (ref libVeles tests load the same
-    fixtures)."""
+    fixtures).  int8 payloads dequantize transparently (the "__scales"
+    companions are folded in and dropped), so every consumer sees float
+    arrays regardless of the export dtype."""
     with zipfile.ZipFile(path) as zf:
         manifest = json.loads(zf.read("contents.json"))
         arrays = {}
         for unit in manifest["units"]:
             for pname, fname in unit["arrays"].items():
                 arrays[fname] = np.load(io.BytesIO(zf.read(fname)))
+        for unit in manifest["units"]:
+            ua = unit["arrays"]
+            for pname in [p for p in ua if p.endswith("__scales")]:
+                base = pname[: -len("__scales")]
+                arrays[ua[base]] = (
+                    arrays[ua[base]].astype(np.float32)
+                    * arrays.pop(ua[pname]))
+                del ua[pname]
     return manifest, arrays
 
 
