@@ -45,13 +45,13 @@ def export_workflow(workflow, path, dtype="float32"):
             arr = np.asarray(arr)
             fname = "%04d_%s_%s.npy" % (i, layer.name, pname)
             arrays[pname] = fname
-            if (dtype == "int8" and arr.ndim >= 2
-                    and np.issubdtype(arr.dtype, np.floating)):
+            if dtype == "int8" and arr.ndim >= 2 and _is_floating(arr):
+                arrf = arr.astype(np.float32)   # incl. ml_dtypes bf16
                 scales = np.maximum(
-                    np.abs(arr).max(axis=tuple(range(arr.ndim - 1))),
+                    np.abs(arrf).max(axis=tuple(range(arrf.ndim - 1))),
                     1e-8).astype(np.float32) / 127.0
                 files[fname] = np.clip(
-                    np.round(arr / scales), -127, 127).astype(np.int8)
+                    np.round(arrf / scales), -127, 127).astype(np.int8)
                 sname = fname[:-4] + "__scales.npy"
                 arrays[pname + "__scales"] = sname
                 files[sname] = scales
@@ -113,6 +113,19 @@ def import_workflow(path):
                     * arrays.pop(ua[pname]))
                 del ua[pname]
     return manifest, arrays
+
+
+def _is_floating(arr):
+    """True for numpy floats AND ml_dtypes extensions (bfloat16 params
+    from a custom precision policy have dtype kind 'V', which
+    np.issubdtype does not classify as floating)."""
+    if np.issubdtype(arr.dtype, np.floating):
+        return True
+    try:
+        import ml_dtypes
+        return arr.dtype == np.dtype(ml_dtypes.bfloat16)
+    except ImportError:      # pragma: no cover — ships with jax
+        return False
 
 
 def _jsonable(v):
