@@ -285,6 +285,39 @@ def test_flash_window_validation():
         att.flash_attention(q, k, v, causal=True, window=0)
 
 
+def test_flash_block_sizes_from_site_config():
+    """root.common.engine.flash.block_q/block_k set the kernel's default
+    tile sizes — a flashtune winner bakes in via site config, no code
+    edit (defaults stay 128 when unset)."""
+    from veles_tpu.config import root
+
+    from veles_tpu.ops.pallas import flash as flash_mod
+
+    q, k, v = _qkv(t=64, d=16)
+    ref = att.attention(q, k, v, causal=True)
+    root.common.engine.flash.block_q = 32
+    root.common.engine.flash.block_k = 16
+    flash_mod._flash_fn.cache_clear()
+    try:
+        out = att.flash_attention(q, k, v, causal=True, interpret=True)
+        # the kernel really resolved the CONFIG sizes (the lru_cache
+        # key holds the resolved block_q/block_k), via the public
+        # wrapper
+        assert flash_mod._flash_fn.cache_info().currsize == 1
+        out2 = flash_mod.flash_attention(q, k, v, causal=True,
+                                         block_q=32, block_k=16,
+                                         interpret=True)
+        # same (causal, scale, 32, 16, ...) signature -> cache HIT
+        assert flash_mod._flash_fn.cache_info().currsize == 1
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                                   rtol=0, atol=0)
+    finally:
+        del root.common.engine.flash.block_q
+        del root.common.engine.flash.block_k
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_blockwise_window_validation():
     """blockwise_attention is a public entry point (the ring carry API) —
     window without causal must raise, not silently run full attention."""

@@ -173,12 +173,14 @@ def finalize_attention(carry):
     return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, backward="fused",
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None, backward="fused",
                     window=None):
     """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D].
     ``window`` = sliding-window causal attention (blocks outside the
-    band are skipped entirely — O(T·window) compute)."""
+    band are skipped entirely — O(T·window) compute).  ``block_q``/
+    ``block_k`` default from ``root.common.engine.flash.*`` (else 128)
+    — None forwards so the kernel-side config lookup decides."""
     from veles_tpu.ops.pallas import flash
     return flash.flash_attention(q, k, v, causal=causal,
                                  scale=_scale(q.shape[-1], scale),

@@ -254,11 +254,16 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, backward="fused",
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None, backward="fused",
                     window=None):
     """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
     default as every entry point in ops.attention).
+
+    ``block_q``/``block_k`` default from
+    ``root.common.engine.flash.block_q/block_k`` (else 128) — bake a
+    ``bench.py --phase flashtune`` winner into the site config without
+    touching code.
 
     Differentiable both ways: ``backward="fused"`` (default) runs the
     Pallas dQ and dK/dV kernels against the forward's saved log-sum-exp
@@ -284,6 +289,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         raise ValueError("backward must be 'fused' or 'recompute'")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if block_q is None or block_k is None:
+        from veles_tpu.config import root
+        fcfg = root.common.engine.flash
+        if block_q is None:
+            block_q = int(fcfg.get("block_q", 128))
+        if block_k is None:
+            block_k = int(fcfg.get("block_k", 128))
     return _flash_fn(causal, float(scale), block_q, block_k,
                      autodetect_interpret(interpret), backward,
                      window)(q, k, v)
