@@ -97,6 +97,20 @@ def _block(x):
     return jax.block_until_ready(x)
 
 
+def _norm_operand(n):
+    """n x n operand pre-normalized by its dominant singular value
+    (host-side power iteration) so a y <- y @ a chain needs NO per-iter
+    rescale op: the timed loop is pure MXU matmuls."""
+    import numpy as np
+
+    a = np.random.RandomState(0).rand(n, n).astype(np.float32)
+    v = np.random.RandomState(1).rand(n).astype(np.float32)
+    for _ in range(8):
+        v = a.T @ (a @ v)
+        v /= np.linalg.norm(v)
+    return a / float(np.linalg.norm(a @ v))
+
+
 def phase_gemm():
     """Chained-matmul loop *inside one jit dispatch* (lax.scan): measures
     device compute the way the reference's kernel timer did, immune to
@@ -109,20 +123,10 @@ def phase_gemm():
     training on this hardware uses."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from jax import lax
 
     def run(n, dtype, precision, iters=20):
-        a = np.random.RandomState(0).rand(n, n).astype(np.float32)
-        # pre-normalize by the dominant singular value (host-side power
-        # iteration) so the chain is y <- y @ a with NO per-iter rescale
-        # op: the timed loop is pure MXU matmuls
-        v = np.random.RandomState(1).rand(n).astype(np.float32)
-        for _ in range(8):
-            v = a.T @ (a @ v)
-            v /= np.linalg.norm(v)
-        sigma = float(np.linalg.norm(a @ v))
-        a = jnp.asarray(a / sigma).astype(dtype)
+        a = jnp.asarray(_norm_operand(n)).astype(dtype)
 
         def body(y, _):
             return jnp.dot(y, a, precision=precision), None
@@ -151,6 +155,66 @@ def phase_gemm():
     return {"s_per_multiply": dt32, "gflops": gf32, "bf16_gflops": gf16,
             "bf16_mfu": mfu, "peak_bf16_tflops": peak,
             "device": str(jax.devices()[0])}
+
+
+def phase_gemmtune():
+    """Manual diagnostic (not in PHASES): where do the missing bf16 MFU
+    points go?  Sweeps size x iters x chain shape — serial dependence
+    (y@a), independent pairs (two live chains interleaved), and an
+    f32-output variant — so tunnel amortization, scheduling stalls and
+    output-write bandwidth can be told apart."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    peak = _peak_bf16()
+    out = {}
+
+    def measure(f, seed, iters, flops_per_iter):
+        y = _block(f(seed))
+        dt = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            y = _block(f(y))
+            dt = min(dt, (time.perf_counter() - t0) / iters)
+        return flops_per_iter / dt / 1e12
+
+    for n in (4096, 8192, 16384):
+        a = jnp.asarray(_norm_operand(n)).astype(jnp.bfloat16)
+        iters = max(10, int(3e12 / (2 * n ** 3)))   # ~3 TFLOP per dispatch
+        flops = 2.0 * n ** 3
+
+        f_ser = jax.jit(lambda y, a=a, it=iters: lax.scan(
+            lambda y, _: (jnp.dot(y, a), None), y, None, length=it)[0],
+            donate_argnums=(0,))
+        tf_ser = measure(f_ser, jnp.copy(a), iters, flops)
+
+        # two independent chains per scan step: exposes cross-matmul
+        # overlap if the serial chain is scheduling-stalled
+        f_par = jax.jit(lambda c, a=a, it=iters: lax.scan(
+            lambda c, _: ((jnp.dot(c[0], a), jnp.dot(c[1], a)), None),
+            c, None, length=it)[0], donate_argnums=(0,))
+        tf_par = measure(f_par, (jnp.copy(a), jnp.copy(a.T)), iters,
+                         2 * flops)
+
+        # f32 accumulator output (halved output-write count vs two bf16
+        # stores is NOT the point — the doubled store width is: if the
+        # serial chain is output-write bound this variant drops hardest)
+        f_f32 = jax.jit(lambda y, a=a, it=iters: lax.scan(
+            lambda y, _: (jnp.dot(y.astype(jnp.bfloat16), a,
+                                  preferred_element_type=jnp.float32),
+                          None), y, None, length=it)[0],
+            donate_argnums=(0,))
+        tf_f32 = measure(f_f32, jnp.copy(a).astype(jnp.float32), iters,
+                         flops)
+
+        out[n] = {"serial_tf": round(tf_ser, 1), "pair_tf": round(tf_par, 1),
+                  "f32out_tf": round(tf_f32, 1), "iters": iters}
+        _log("gemmtune n=%d iters=%d: serial %.1f TF/s (%.1f%%), "
+             "pairs %.1f TF/s (%.1f%%), f32-out %.1f TF/s"
+             % (n, iters, tf_ser, 100 * tf_ser / peak if peak else 0,
+                tf_par, 100 * tf_par / peak if peak else 0, tf_f32))
+    return {"peak": peak, "sweep": {str(k): v for k, v in out.items()}}
 
 
 def phase_mlp():
@@ -708,6 +772,47 @@ def _run_phase(name, timeout, deadline):
 _CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       ".bench_last_good.json")
 
+_EMPTY = (0, 0.0, False, None)
+
+#: result-key prefix → phase whose failure mode decides carry eligibility
+_KEY_PHASE = (("gemm", "gemm"), ("mlp_", "mlp"), ("alexnet_", "alexnet"),
+              ("lm_large_", "lm_large"), ("lm_", "lm"), ("flash_", "flash"),
+              ("beam_", "beam"), ("ring_", "ring"), ("kohonen_", "kohonen"),
+              ("value", "gemm"), ("vs_baseline", "gemm"))
+
+
+def _merge_cache(line, errors):
+    """Per-key last-known-good merge: a freshly measured (non-zero) value
+    always wins, and a key this run could NOT measure (tunnel died
+    mid-run: watchdog timeout, deadline, backend unavailable) keeps the
+    previous run's evidence instead of clobbering it with zero.  A phase
+    that RAN and failed (``rc=`` in its error — e.g. a kernel-mismatch
+    assertion) is a real measurement: its keys go to zero/False and must
+    NOT be papered over by stale success.  ``carried_from`` records the
+    original measurement date per carried key so mixed-date records stay
+    honest."""
+    new = {k: v for k, v in line.items() if k != "error"}
+    new["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    ran_and_failed = {p for p, e in errors.items() if "rc=" in str(e)}
+    try:
+        with open(_CACHE) as f:
+            old = json.load(f)
+    except (OSError, ValueError):
+        old = {}
+    carried = dict(old.get("carried_from", {}))
+    for k, v in old.items():
+        if k in ("measured_at", "carried_from") or v in _EMPTY:
+            continue
+        phase = next((p for pre, p in _KEY_PHASE if k.startswith(pre)), None)
+        if new.get(k) in _EMPTY and phase not in ran_and_failed:
+            new[k] = v
+            carried.setdefault(k, old.get("measured_at", "unknown"))
+        else:
+            carried.pop(k, None)
+    if carried:
+        new["carried_from"] = carried
+    return new
+
 
 def main():
     parser = argparse.ArgumentParser()
@@ -783,9 +888,7 @@ def main():
     if gemm.get("ok"):
         try:
             with open(_CACHE, "w") as f:
-                json.dump({k: v for k, v in line.items() if k != "error"}
-                          | {"measured_at": time.strftime(
-                              "%Y-%m-%d %H:%M:%S")}, f)
+                json.dump(_merge_cache(line, errors), f)
         except OSError:
             pass
     elif os.path.exists(_CACHE):

@@ -151,8 +151,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         out = acc[:] / lsum
         o_ref[0] = out.astype(o_ref.dtype)
         # log-sum-exp of the scaled scores per q row — the only residual
-        # the fused backward needs (p = exp(s - lse) reconstructs exactly)
-        lse_ref[0] = (m[:, 0] + jnp.log(lsum[:, 0]))
+        # the fused backward needs (p = exp(s - lse) reconstructs
+        # exactly).  Stored lane-broadcast (block_q, _LANES): Mosaic
+        # requires output block minors (divisible-by-8, 128), which a
+        # (1, block_q) row tile violates; the lane copies are sliced
+        # off right after the pallas_call.
+        lse_ref[0] = m[:] + jnp.log(lsum)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -181,11 +185,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
                                   ki * block_k, scale, causal, tk,
                                   rows_are_q=True, window=window)
-        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
         k = k_ref[0]
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -200,9 +204,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
                     block_q, block_k, nq, nq_grid, tk, window):
     """dK, dV: grid (bh, k-blocks, q-span), q innermost; both
-    accumulators live in f32 VMEM scratch across the q sweep.
-        pᵀ  = exp(sᵀ - lse);     dv += pᵀ·dO
-        dpᵀ = V·dOᵀ;  dsᵀ = pᵀ⊙(dpᵀ - Δ)·scale;  dk += dsᵀ·Q
+    accumulators live in f32 VMEM scratch across the q sweep.  The
+    score tile keeps the forward orientation (rows = q) so the per-row
+    lse/Δ residuals broadcast along lanes without a transpose; the
+    k-major products contract over the q rows instead:
+        p  = exp(s - lse);          dv += pᵀ·dO
+        dp = dO·Vᵀ;  ds = p⊙(dp - Δ)·scale;  dk += dsᵀ·Q
     Padded q rows contribute nothing (their dO and Δ are zero)."""
     ki = pl.program_id(1)
     qj = pl.program_id(2)
@@ -220,22 +227,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        st, valid = _masked_scores(k_ref, q_ref, ki * block_k,
-                                   qi * block_q, scale, causal, tk,
-                                   rows_are_q=False,
-                                   window=window)             # [bk, bq]
-        pt = jnp.where(valid, jnp.exp(st - lse_ref[0][None, :]), 0.0)
+        s, valid = _masked_scores(q_ref, k_ref, qi * block_q,
+                                  ki * block_k, scale, causal, tk,
+                                  rows_are_q=True,
+                                  window=window)              # [bq, bk]
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
         do = do_ref[0]
         dv_acc[:] += jax.lax.dot_general(
-            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dpt = jax.lax.dot_general(
-            v_ref[0], do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [bk, bq]
-        dst = pt * (dpt - delta_ref[0][None, :]) * scale
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
         q = q_ref[0]
         dk_acc[:] += jax.lax.dot_general(
-            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qj == nq_grid - 1)
@@ -395,11 +402,12 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
+            jax.ShapeDtypeStruct(qp.shape[:2] + (_LANES,), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -409,7 +417,8 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret,
         compiler_params=_SEMANTICS,
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :tq].reshape(b, h, tq, d), lse
+    # residual kept lean: drop the lane copies (the backward re-broadcasts)
+    return out[:, :tq].reshape(b, h, tq, d), lse[:, :, 0]
 
 
 def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
@@ -426,11 +435,18 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     delta = jnp.sum(dop.astype(jnp.float32)
                     * _pad_to(out.reshape(b * h, tq, d), 1,
                               block_q).astype(jnp.float32), axis=-1)
+    # per-row residuals enter the kernels lane-broadcast — Mosaic wants
+    # (sublane % 8, lane % 128) block minors, which (1, block_q) row
+    # tiles violate; one fused XLA broadcast each, tiny next to the
+    # kernels' K/V traffic
+    lse = jnp.broadcast_to(lse[:, :, None], lse.shape + (_LANES,))
+    delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (_LANES,))
 
     nk_grid = _k_span(block_q, block_k, window, nk) if causal else nk
     kv_map = _kv_index_map(block_q, block_k, causal, window, nk)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, a, 0))
-    r_spec = pl.BlockSpec((1, block_q), lambda bh, a, i: (bh, a))
+    r_spec = pl.BlockSpec((1, block_q, _LANES),
+                          lambda bh, a, i: (bh, a, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), kv_map)
 
     dq = pl.pallas_call(
@@ -452,18 +468,16 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     # live q block for copy elision)
     nq_grid = _q_span(block_q, block_k, window, nq) if causal else nq
 
-    def q_map3(bh, ki, qj, rank):
+    def q_map3(bh, ki, qj):
         qi = (qj if window is None
               else _q_lo(ki, block_q, block_k) + qj)
         if causal:
             lo = _q_lo(ki, block_q, block_k)
             qi = jnp.minimum(jnp.maximum(qi, lo), nq - 1)
-        return (bh, qi, 0)[:rank]
+        return (bh, qi, 0)
 
-    q_spec2 = pl.BlockSpec((1, block_q, d),
-                           lambda bh, a, i: q_map3(bh, a, i, 3))
-    r_spec2 = pl.BlockSpec((1, block_q),
-                           lambda bh, a, i: q_map3(bh, a, i, 2))
+    q_spec2 = pl.BlockSpec((1, block_q, d), q_map3)
+    r_spec2 = pl.BlockSpec((1, block_q, _LANES), q_map3)
     kv_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, a, i: (bh, a, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
