@@ -781,19 +781,21 @@ _KEY_PHASE = (("gemm", "gemm"), ("mlp_", "mlp"), ("alexnet_", "alexnet"),
               ("value", "gemm"), ("vs_baseline", "gemm"))
 
 
-def _merge_cache(line, errors):
-    """Per-key last-known-good merge: a freshly measured (non-zero) value
-    always wins, and a key this run could NOT measure (tunnel died
-    mid-run: watchdog timeout, deadline, backend unavailable) keeps the
-    previous run's evidence instead of clobbering it with zero.  A phase
-    that RAN and failed (``rc=`` in its error — e.g. a kernel-mismatch
-    assertion) is a real measurement: its keys go to zero/False and must
-    NOT be papered over by stale success.  ``carried_from`` records the
-    original measurement date per carried key so mixed-date records stay
-    honest."""
+def _merge_cache(line, results):
+    """Per-key last-known-good merge: a freshly measured value always
+    wins, and a key this run could NOT measure (tunnel died mid-run:
+    watchdog timeout, deadline, backend unavailable) keeps the previous
+    run's evidence instead of clobbering it with zero.  A phase that RAN
+    — whether it succeeded (its zeros are deliberate, e.g. the shrunken
+    beam smoke zeroing the t4096 headline) or failed on a real assertion
+    — is a real measurement: its keys must NOT be papered over by stale
+    numbers.  Only keys of phases with no result at all are carried, and
+    ``carried_from`` records the original measurement date per carried
+    key so mixed-date records stay honest."""
     new = {k: v for k, v in line.items() if k != "error"}
     new["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    ran_and_failed = {p for p, e in errors.items() if "rc=" in str(e)}
+    ran = {p for p, r in results.items()
+           if r.get("ok") or "rc=" in str(r.get("error", ""))}
     try:
         with open(_CACHE) as f:
             old = json.load(f)
@@ -804,7 +806,7 @@ def _merge_cache(line, errors):
         if k in ("measured_at", "carried_from") or v in _EMPTY:
             continue
         phase = next((p for pre, p in _KEY_PHASE if k.startswith(pre)), None)
-        if new.get(k) in _EMPTY and phase not in ran_and_failed:
+        if new.get(k) in _EMPTY and phase not in ran:
             new[k] = v
             carried.setdefault(k, old.get("measured_at", "unknown"))
         else:
@@ -888,7 +890,7 @@ def main():
     if gemm.get("ok"):
         try:
             with open(_CACHE, "w") as f:
-                json.dump(_merge_cache(line, errors), f)
+                json.dump(_merge_cache(line, results), f)
         except OSError:
             pass
     elif os.path.exists(_CACHE):

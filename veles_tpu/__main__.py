@@ -226,6 +226,20 @@ class Main(object):
             wf = self.workflow
             launcher = self._make_launcher(args, wf)
             launcher.initialize(**kwargs)
+            # graceful preemption: TPU schedulers deliver SIGTERM with a
+            # grace window before the pod goes away — checkpoint at the
+            # next cycle boundary and exit 75 (EX_TEMPFAIL) so the
+            # deploy units' auto-restart resumes via --snapshot auto
+            import signal
+            import threading
+            prev_term = None
+            if threading.current_thread() is threading.main_thread():
+                def _on_sigterm(signum, frame):
+                    print("SIGTERM: graceful preemption — checkpointing "
+                          "at the next cycle, then exit 75",
+                          file=sys.stderr, flush=True)
+                    wf.request_preempt()
+                prev_term = signal.signal(signal.SIGTERM, _on_sigterm)
             manhole = None
             if args.manhole:
                 from veles_tpu.interaction import Manhole
@@ -249,6 +263,9 @@ class Main(object):
                 else:
                     launcher.run()
             finally:
+                if prev_term is not None:
+                    import signal
+                    signal.signal(signal.SIGTERM, prev_term)
                 if profiling:
                     import jax
                     jax.profiler.stop_trace()
@@ -263,6 +280,14 @@ class Main(object):
 
         wf_globals["run"](load, main)
         wf = self.workflow
+
+        if wf is not None and getattr(wf, "preempted_", False):
+            # 75 = EX_TEMPFAIL: "try again" — the deploy systemd/k8s
+            # units restart the identical command line and
+            # --snapshot auto picks up the preemption checkpoint
+            print("preempted — exiting 75 for supervisor restart",
+                  file=sys.stderr, flush=True)
+            return 75
 
         if args.export and wf is not None:
             from veles_tpu.services.export import export_workflow

@@ -93,7 +93,11 @@ class StandardWorkflow(Workflow):
             self.snapshotter.loader = self.loader
             self.snapshotter.decision = self.decision
             self.snapshotter.link_from(self.decision)
-            self.snapshotter.gate_skip = ~self.loader.epoch_ended
+            # runs at epoch end — or at the NEXT CYCLE when preemption is
+            # requested (mid-epoch state is fully captured: loader
+            # minibatch_offset/order, trainer step counter, PRNG)
+            self.snapshotter.gate_skip = ~(self.loader.epoch_ended
+                                           | self.preempt_requested)
             tail = self.snapshotter
         else:
             self.snapshotter = None

@@ -77,26 +77,52 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
     def suffix(self):
         return "%d" % self._epoch_counter
 
+    def _preempt_agreed(self, multihost):
+        """Cross-host agreement on the workflow's preemption flag.  The
+        scheduler's SIGTERMs race against unit boundaries, so one process
+        can see the flag a cycle before another — and the snapshot path
+        below runs collective gathers, where a divergent branch deadlocks.
+        One tiny per-cycle allgather buys the agreement (single-host pays
+        nothing)."""
+        local = bool(getattr(self.workflow, "preempt_requested", False))
+        if not multihost:
+            return local
+        import numpy as np
+        from jax.experimental import multihost_utils
+        return bool(multihost_utils.process_allgather(
+            np.int32(local)).max())
+
     def run(self):
         self._epoch_counter += 1
-        if self.interval and self._epoch_counter % self.interval:
-            return
         multihost = jax.process_count() > 1
-        # the wall-clock gate is per-process and therefore NOT
-        # deterministic across hosts — skipping it under multi-host keeps
-        # every process taking the same branch into the collective
-        # gathers below (a divergent decision would deadlock allgather)
-        if not multihost and \
-                time.time() - self._last_time < self.time_interval:
-            return
+        preempt = self._preempt_agreed(multihost)
+        if not preempt:
+            if self.interval and self._epoch_counter % self.interval:
+                return
+            # the wall-clock gate is per-process and therefore NOT
+            # deterministic across hosts — skipping it under multi-host
+            # keeps every process taking the same branch into the
+            # collective gathers below (a divergent decision would
+            # deadlock allgather)
+            if not multihost and \
+                    time.time() - self._last_time < self.time_interval:
+                return
         self._last_time = time.time()
         if multihost and jax.process_index() != 0:
             # every process participates in the collective gathers inside
             # collect(), but only process 0 writes (ref
             # only-master-snapshots, snapshotter.py:160)
             self.collect()
-            return
-        self.export()
+        else:
+            self.export()
+        if preempt:
+            # never leave with a truncated checkpoint, then stop the
+            # graph — the CLI exits 75 and the supervisor restart's
+            # --snapshot auto resumes from this very file
+            self.flush()
+            self.info("preemption checkpoint complete — stopping")
+            self.workflow.preempted_ = True
+            self.workflow.stop()
 
     def export(self):
         os.makedirs(self.directory, exist_ok=True)

@@ -38,6 +38,16 @@ class Workflow(Container):
         self.end_point = EndPoint(self)
         self._run_time_ = 0.0
         self.result_file = kwargs.get("result_file")
+        #: graceful-preemption flags.  ``preempt_requested`` is a gate
+        #: Bool raised by a SIGTERM handler — StandardWorkflow composes
+        #: it into the snapshotter's gate_skip so the checkpoint happens
+        #: at the NEXT CYCLE, not the next epoch end.  The snapshotter
+        #: unit (or the run loop, when there is none) answers it and
+        #: raises ``preempted_`` once handled — the CLI turns that into
+        #: exit code 75 (EX_TEMPFAIL) so a supervisor restarts the
+        #: identical command and --snapshot auto resumes.
+        self.preempt_requested = Bool(False)
+        self.preempted_ = False
 
     # --------------------------------------------------------------- container
     def add_ref(self, unit):
@@ -127,6 +137,17 @@ class Workflow(Container):
         queue = collections.deque([self.start_point])
         queued = {self.start_point}
         while queue and not bool(self.stopped):
+            if bool(self.preempt_requested) and not self.preempted_ and \
+                    not self._graph_has_snapshotter():
+                if self._preempt_break_safe():
+                    # no snapshotter in the graph: nothing to save — stop
+                    # at this unit boundary; the supervisor restart will
+                    # resume from whatever snapshot exists (or fresh)
+                    self.warning("preemption requested with no "
+                                 "snapshotter unit — stopping without a "
+                                 "checkpoint")
+                    self.preempted_ = True
+                    break
             unit = queue.popleft()
             queued.discard(unit)
             if bool(unit.gate_block):
@@ -154,6 +175,40 @@ class Workflow(Container):
 
     def stop(self):
         self.stopped <<= True
+
+    def request_preempt(self):
+        """Ask for a graceful preemption stop: checkpoint at the next
+        consistent cycle boundary, then stop.  Signal-handler safe (one
+        Bool flip); the TPU-era mapping of the reference's slave
+        drop/respawn elasticity (server.py:637-655) onto
+        checkpoint-restart."""
+        self.preempt_requested.set(True)
+
+    def _graph_has_snapshotter(self):
+        """A snapshotter anywhere in the unit graph — not just the
+        StandardWorkflow ``self.snapshotter`` convention — answers
+        preemption itself (its gate composes ``preempt_requested``)."""
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        return any(isinstance(u, SnapshotterBase) for u in self._units)
+
+    def _preempt_break_safe(self):
+        """Unilaterally breaking the run loop is only safe single-host:
+        under multi-host the SIGTERMs race unit boundaries, and a process
+        that stops while a peer is inside a collective strands the peer
+        until the DCN timeout.  With no snapshotter unit there is no
+        agreed cycle point to rendezvous on, so multi-host falls back to
+        the scheduler's hard kill + interval-snapshot restart."""
+        import jax
+        if jax.process_count() == 1:
+            return True
+        if not getattr(self, "_preempt_multihost_warned_", False):
+            self._preempt_multihost_warned_ = True
+            self.warning(
+                "preemption requested, but a multi-host workflow without "
+                "a snapshotter unit cannot stop at an agreed point — "
+                "continuing until the scheduler's hard kill (add a "
+                "snapshotter for graceful preemption)")
+        return False
 
     # ------------------------------------------------------------------ stats
     def print_stats(self, top=5):
