@@ -243,6 +243,23 @@ class TestWebStatus:
                             "valid_loss": loss})
             series = json.loads(_get(base + "/api/metrics"))
             assert series["valid_loss"] == [[1, 0.8], [2, 0.5], [3, 0.3]]
+            # workflow graph + DOT (ref workflow SVG in status POSTs)
+            from veles_tpu.plumbing import Repeater
+            from veles_tpu.workflow import Workflow
+            wf = Workflow(name="gwf")
+            rpt = Repeater(wf)
+            rpt.link_from(wf.start_point)
+            wf.end_point.link_from(rpt)
+            server.register(wf)
+            g = json.loads(_get(base + "/api/graph"))["gwf"]
+            names = {n["name"] for n in g["nodes"]}
+            assert "Repeater" in names and len(g["edges"]) >= 2
+            assert all({"cls", "runs", "time", "share"} <= set(n)
+                       for n in g["nodes"])
+            dot = _get(base + "/api/dot").decode()
+            assert dot.startswith("digraph") and "Repeater" in dot
+            page = _get(base + "/")
+            assert b"drawGraph" in page and b"drawTimeline" in page
         finally:
             server.stop()
 

@@ -235,10 +235,16 @@ class Main(object):
             prev_term = None
             if threading.current_thread() is threading.main_thread():
                 def _on_sigterm(signum, frame):
-                    print("SIGTERM: graceful preemption — checkpointing "
-                          "at the next cycle, then exit 75",
-                          file=sys.stderr, flush=True)
+                    # flag FIRST: stderr may be mid-write when the signal
+                    # lands, and a reentrant-IO RuntimeError in print()
+                    # must not lose the preemption request
                     wf.request_preempt()
+                    try:
+                        print("SIGTERM: graceful preemption — "
+                              "checkpointing at the next cycle, then "
+                              "exit 75", file=sys.stderr, flush=True)
+                    except RuntimeError:
+                        pass
                 prev_term = signal.signal(signal.SIGTERM, _on_sigterm)
             manhole = None
             if args.manhole:

@@ -93,11 +93,14 @@ class StandardWorkflow(Workflow):
             self.snapshotter.loader = self.loader
             self.snapshotter.decision = self.decision
             self.snapshotter.link_from(self.decision)
-            # runs at epoch end — or at the NEXT CYCLE when preemption is
-            # requested (mid-epoch state is fully captured: loader
-            # minibatch_offset/order, trainer step counter, PRNG)
-            self.snapshotter.gate_skip = ~(self.loader.epoch_ended
-                                           | self.preempt_requested)
+            # the unit runs EVERY cycle; epoch-end/interval gating and
+            # the preemption answer happen inside run() (``when``), so
+            # the multi-host preemption agreement executes on all
+            # processes each cycle — a gate_skip on per-process state
+            # would desynchronize it.  Preemption therefore checkpoints
+            # at the NEXT CYCLE, mid-epoch (loader offset/order, step
+            # counter and PRNG are all captured).
+            self.snapshotter.when = self.loader.epoch_ended
             tail = self.snapshotter
         else:
             self.snapshotter = None

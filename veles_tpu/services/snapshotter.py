@@ -62,6 +62,15 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         #: atexit hook joins the in-flight write so process exit can
         #: never truncate a checkpoint.
         self.async_write = kwargs.get("async_write", False)
+        #: optional run condition (a Bool or callable) checked INSIDE
+        #: run() instead of via gate_skip: the unit must execute every
+        #: cycle so the multi-host preemption agreement below runs
+        #: unconditionally — gating it on any per-process condition
+        #: (epoch_ended, a local preempt flag) would let one process
+        #: enter the agreement collective while a peer skips the unit
+        #: and dispatches the next training step: mismatched collectives,
+        #: hung pod.  StandardWorkflow sets ``when = loader.epoch_ended``.
+        self.when = kwargs.get("when")
         self._writer = None
         if self.async_write:
             import atexit
@@ -93,9 +102,17 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             np.int32(local)).max())
 
     def run(self):
-        self._epoch_counter += 1
         multihost = jax.process_count() > 1
+        # agreement FIRST, every cycle, before any per-process gate —
+        # see the ``when`` comment in __init__
         preempt = self._preempt_agreed(multihost)
+        due = True
+        if self.when is not None:
+            due = bool(self.when() if callable(self.when) else self.when)
+        if not due and not preempt:
+            return
+        if due:
+            self._epoch_counter += 1
         if not preempt:
             if self.interval and self._epoch_counter % self.interval:
                 return

@@ -23,9 +23,14 @@ _PAGE = """<!DOCTYPE html>
 td,th{border:1px solid #999;padding:4px 8px}
 .spark{display:inline-block;margin:0 1.5em .8em 0}
 .spark svg{vertical-align:middle;background:#f6f6f6}
-.spark .v{color:#06c}</style></head>
+.spark .v{color:#06c}
+#graph svg,#timeline svg{background:#fafafa;border:1px solid #ddd}
+.node{font-size:11px}.lane{font-size:10px;fill:#555}</style></head>
 <body><h2>veles_tpu status</h2>
 <div id="status"></div><h3>metrics</h3><div id="metrics"></div>
+<h3>workflow graph <small>(nodes heat-colored by run-time share;
+<a href="/api/dot">DOT</a>)</small></h3><div id="graph"></div>
+<h3>event timeline</h3><div id="timeline"></div>
 <h3>recent events</h3><div id="events"></div>
 <script>
 function sparkline(points){           // [[epoch, value], ...] -> SVG
@@ -38,6 +43,86 @@ function sparkline(points){           // [[epoch, value], ...] -> SVG
   'stroke="#06c" stroke-width="1.5" points="'+
   xs.map(q=>q[0].toFixed(1)+','+q[1].toFixed(1)).join(' ')+'"/></svg>';
 }
+function layers(g){   // longest-path-ish layering; repeater back-edges
+ const n=g.nodes.length, adj=Array.from({length:n},()=>[]);   // ignored
+ const indeg=new Array(n).fill(0);
+ g.edges.forEach(([a,b])=>{adj[a].push(b); indeg[b]++;});
+ const layer=new Array(n).fill(-1);
+ let frontier=[]; indeg.forEach((d,i)=>{if(d===0)frontier.push(i);});
+ if(!frontier.length && n)frontier=[0];
+ frontier.forEach(i=>layer[i]=0);
+ for(let depth=1; frontier.length && depth<n+1; depth++){
+  const next=[];
+  frontier.forEach(i=>adj[i].forEach(j=>{
+   if(layer[j]<0){layer[j]=depth; next.push(j);}}));
+  frontier=next;
+ }
+ layer.forEach((l,i)=>{if(l<0)layer[i]=0;});
+ return layer;
+}
+function drawGraph(g){
+ if(!g.nodes.length)return '(no units)';
+ const layer=layers(g), cols={};
+ g.nodes.forEach((nd,i)=>{(cols[layer[i]]=cols[layer[i]]||[]).push(i);});
+ const cw=170, rh=48, bw=130, bh=30, pos={};
+ Object.entries(cols).forEach(([l,ids])=>ids.forEach((id,r)=>{
+  pos[id]=[l*cw+10, r*rh+12];}));
+ const W=(Math.max(...Object.keys(cols).map(Number))+1)*cw+20;
+ const H=Math.max(...Object.values(cols).map(c=>c.length))*rh+24;
+ let s='<svg width="'+W+'" height="'+H+'">';
+ s+='<defs><marker id="arr" markerWidth="7" markerHeight="7" refX="6" '+
+  'refY="2.5" orient="auto"><path d="M0,0 L6,2.5 L0,5 z" fill="#888"/>'+
+  '</marker></defs>';
+ g.edges.forEach(([a,b])=>{
+  const p=pos[a], q=pos[b], back=q[0]<=p[0];
+  const x1=p[0]+(back?0:bw), y1=p[1]+bh/2, x2=q[0]+(back?bw:0),
+   y2=q[1]+bh/2, bend=back?36:0;
+  s+='<path d="M'+x1+','+y1+' C'+(x1+(back?-bend:40))+','+(y1+bend)+' '+
+   (x2+(back?bend:-40))+','+(y2+bend)+' '+x2+','+y2+
+   '" fill="none" stroke="'+(back?'#c60':'#888')+
+   '" stroke-dasharray="'+(back?'4 3':'none')+'" marker-end="url(#arr)"/>';
+ });
+ g.nodes.forEach((nd,i)=>{
+  const [x,y]=pos[i], heat=Math.min(nd.share*1.6,1);
+  s+='<g class="node"><rect x="'+x+'" y="'+y+'" width="'+bw+'" height="'+
+   bh+'" rx="5" fill="rgba(255,140,0,'+heat.toFixed(3)+
+   ')" stroke="#555"><title>'+nd.cls+': '+nd.runs+' runs, '+
+   nd.time+'s ('+(nd.share*100).toFixed(1)+'%)</title></rect>'+
+   '<text x="'+(x+6)+'" y="'+(y+13)+'">'+nd.name.slice(0,19)+'</text>'+
+   '<text x="'+(x+6)+'" y="'+(y+25)+'" fill="#666">'+nd.runs+'x '+
+   nd.time.toFixed(2)+'s</text></g>';
+ });
+ return s+'</svg>';
+}
+function drawTimeline(evs){
+ const spans=[], open={}, ticks=[];
+ evs.forEach(e=>{
+  const key=e.cat+':'+e.name;
+  if(e.type==='begin')open[key]=e.time;
+  else if(e.type==='end' && open[key]!==undefined){
+   spans.push([key, open[key], e.time]); delete open[key];
+  }else if(e.type==='single')ticks.push([key, e.time]);
+ });
+ const all=spans.map(s=>s[1]).concat(spans.map(s=>s[2]),
+                                     ticks.map(t=>t[1]));
+ if(!all.length)return '(no events yet)';
+ const t0=Math.min(...all), t1=Math.max(...all), span=(t1-t0)||1;
+ const lanes=[...new Set(spans.concat(ticks).map(s=>s[0]))].slice(0,12);
+ const W=760, lh=20, X=t=>170+(t-t0)*(W-180)/span;
+ let s='<svg width="'+W+'" height="'+(lanes.length*lh+24)+'">';
+ lanes.forEach((ln,r)=>{
+  const y=r*lh+14;
+  s+='<text class="lane" x="2" y="'+(y+9)+'">'+ln.slice(0,26)+'</text>';
+  spans.filter(sp=>sp[0]===ln).forEach(sp=>{
+   s+='<rect x="'+X(sp[1])+'" y="'+y+'" width="'+
+    Math.max(X(sp[2])-X(sp[1]),1.5)+'" height="12" fill="#06c" '+
+    'opacity="0.65"><title>'+ln+' '+((sp[2]-sp[1])*1000).toFixed(1)+
+    'ms</title></rect>';});
+  ticks.filter(t=>t[0]===ln).forEach(t=>{
+   s+='<circle cx="'+X(t[1])+'" cy="'+(y+6)+'" r="2.5" fill="#c60"/>';});
+ });
+ return s+'</svg>';
+}
 async function refresh(){
  const s=await (await fetch('/api/status')).json();
  document.getElementById('status').innerHTML =
@@ -48,7 +133,12 @@ async function refresh(){
    '<span class="spark">'+k+' '+sparkline(pts)+' <span class="v">'+
    pts[pts.length-1][1].toPrecision(4)+'</span></span>').join('')
   || '(no epoch metrics yet)';
+ const g=await (await fetch('/api/graph')).json();
+ document.getElementById('graph').innerHTML =
+  Object.entries(g).map(([name,wf])=>
+   '<b>'+name+'</b><br>'+drawGraph(wf)).join('<br>') || '(no workflows)';
  const e=await (await fetch('/api/events')).json();
+ document.getElementById('timeline').innerHTML = drawTimeline(e);
  document.getElementById('events').innerHTML =
   '<pre>'+e.slice(-30).map(x=>JSON.stringify(x)).join('\\n')+'</pre>';
 }
@@ -90,6 +180,36 @@ class WebStatusServer(Logger):
                     series.setdefault(k, []).append([ep, v])
         return {k: v[-limit:] for k, v in series.items()}
 
+    def graph(self):
+        """Control-graph JSON per registered workflow: nodes carry class,
+        run count/time and run-time share (the dashboard heat-colors
+        them), edges are the control links — the live equivalent of the
+        reference's workflow SVG shipped in status POSTs
+        (launcher.py:852-885)."""
+        out = {}
+        with self._lock:
+            for name, wf in self._workflows.items():
+                units = wf.units
+                ids = {u: i for i, u in enumerate(units)}
+                total = sum(u.run_time for u in units) or 1.0
+                out[name] = {
+                    "nodes": [{"id": i, "name": u.name,
+                               "cls": type(u).__name__,
+                               "runs": u.run_count,
+                               "time": round(u.run_time, 4),
+                               "share": round(u.run_time / total, 4)}
+                              for u, i in ids.items()],
+                    "edges": [[ids[u], ids[d]] for u in units
+                              for d in u.links_to if d in ids],
+                }
+        return out
+
+    def dot(self):
+        """Concatenated DOT text of every registered workflow."""
+        with self._lock:
+            return "\n".join(wf.generate_graph()
+                             for wf in self._workflows.values())
+
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
         with self._lock:
@@ -123,6 +243,11 @@ class WebStatusServer(Logger):
                 elif self.path == "/api/metrics":
                     self._send(200, json.dumps(server.metrics(),
                                                default=str).encode())
+                elif self.path == "/api/graph":
+                    self._send(200, json.dumps(server.graph(),
+                                               default=str).encode())
+                elif self.path == "/api/dot":
+                    self._send(200, server.dot().encode(), "text/plain")
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
                                                default=str).encode())

@@ -136,10 +136,13 @@ class Workflow(Container):
         self.event("workflow", "begin")
         queue = collections.deque([self.start_point])
         queued = {self.start_point}
+        can_break = None      # no-snapshotter fallback, decided once
         while queue and not bool(self.stopped):
-            if bool(self.preempt_requested) and not self.preempted_ and \
-                    not self._graph_has_snapshotter():
-                if self._preempt_break_safe():
+            if bool(self.preempt_requested) and not self.preempted_:
+                if can_break is None:
+                    can_break = (not self._graph_has_snapshotter()
+                                 and self._preempt_break_safe())
+                if can_break:
                     # no snapshotter in the graph: nothing to save — stop
                     # at this unit boundary; the supervisor restart will
                     # resume from whatever snapshot exists (or fresh)
