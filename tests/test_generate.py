@@ -499,3 +499,66 @@ def test_generation_with_tied_embeddings(f32_precision):
     a = gen.generate(toks[:2, :6], max_new=4, temperature=0.8, seed=2)
     b = gen.generate(toks[:2, :6], max_new=4, temperature=0.8, seed=2)
     np.testing.assert_array_equal(a, b)
+
+
+class TestInt8ServingWeights:
+    """weights="int8" (ops.quant W8A8-dynamic): the serving params become
+    int8 + scales, decode still works end to end, and the quantized
+    logits track the float ones within quantization error."""
+
+    def test_quant_ops_precision(self):
+        from veles_tpu.ops import quant
+        r = np.random.RandomState(0)
+        x = jnp.asarray(r.randn(4, 32), jnp.float32)
+        w = jnp.asarray(r.randn(32, 48), jnp.float32) * 0.2
+        qw = quant.quantize_weight(w)
+        assert qw.q.dtype == jnp.int8 and qw.scale.shape == (48,)
+        y, ref = quant.int8_matmul(x, qw), x @ w
+        err = float(jnp.max(jnp.abs(y - ref)))
+        assert err < 0.05 * float(jnp.max(jnp.abs(ref))), err
+        # per-row table: gathered rows dequantize near-exactly and the
+        # transposed direction (tied head) matches x @ tableT
+        table = jnp.asarray(r.randn(13, 32), jnp.float32)
+        qt = quant.quantize_weight(table, axis=1)
+        rows = quant.take_rows(qt, jnp.asarray([0, 5, 12]))
+        np.testing.assert_allclose(np.asarray(rows),
+                                   np.asarray(table)[[0, 5, 12]],
+                                   rtol=0.02, atol=0.02)
+        yt = quant.int8_matmul_t(x, qt)
+        reft = x @ table.T
+        assert float(jnp.max(jnp.abs(yt - reft))) < \
+            0.05 * float(jnp.max(jnp.abs(reft)))
+
+    @pytest.mark.parametrize("zoo_kwargs", [
+        {"pos": "rope", "n_kv_heads": 2}, {"tie_embeddings": True}])
+    def test_int8_decode_tracks_float(self, zoo_kwargs, f32_precision):
+        wf, toks = _lm_workflow(max_epochs=8, **zoo_kwargs)
+        gen_f = LMGenerator(wf.trainer, max_len=16)
+        gen_q = LMGenerator(wf.trainer, max_len=16, weights="int8")
+        from veles_tpu.ops import quant
+        flat = jax.tree_util.tree_leaves(
+            gen_q.params, is_leaf=lambda x: isinstance(x,
+                                                       quant.QuantWeight))
+        assert any(isinstance(leaf, quant.QuantWeight) for leaf in flat)
+        # per-position scores within quantization error of the float path
+        sq = gen_q.score(toks[:4])
+        sf = gen_f.score(toks[:4])
+        scale = np.abs(sf).max()
+        assert np.max(np.abs(sq - sf)) < 0.08 * scale
+        # greedy decode runs, is deterministic, and (trained model,
+        # peaked logits) matches the float continuation
+        a = gen_q.generate(toks[:4, :8], max_new=6)
+        b = gen_q.generate(toks[:4, :8], max_new=6)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(
+            a, gen_f.generate(toks[:4, :8], max_new=6))
+
+    def test_int8_rejects_tensor_parallel_and_moe(self):
+        from veles_tpu.parallel import MeshConfig, make_mesh
+        wf, _ = _lm_workflow(max_epochs=0, n_kv_heads=2)
+        mc = MeshConfig(make_mesh({"model": 2}, jax.devices()[:2]))
+        with pytest.raises(ValueError, match="single-device"):
+            LMGenerator(wf.trainer, max_len=16, mesh_cfg=mc,
+                        weights="int8")
+        with pytest.raises(ValueError, match="int8"):
+            LMGenerator(wf.trainer, max_len=16, weights="int4")

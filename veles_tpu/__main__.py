@@ -317,14 +317,21 @@ class Main(object):
               if wf.trainer.layers[0].input_shape else 0)
         if any(l.cfg.get("rope") for l in wf.trainer.layers):
             t0 = max(t0, min_len)    # rope has no position-table bound
+        cd = root.common.serve.get("cache_dtype", None)
+        import numpy as np
+        kwargs = dict(max_len=t0, cache_dtype=None if cd is None
+                      else np.dtype(cd))
         try:
-            cd = root.common.serve.get("cache_dtype", None)
-            import numpy as np
-            return LMGenerator(wf.trainer, max_len=t0,
-                               cache_dtype=None if cd is None
-                               else np.dtype(cd))
+            gen = LMGenerator(wf.trainer, **kwargs)
         except ValueError:
             return None              # not a generate-shaped stack
+        w = root.common.serve.get("weights", None)
+        if w is not None:
+            # the stack IS generate-shaped (probed above) — a failure
+            # here is a configuration error and must surface, not
+            # silently disable generation
+            gen = LMGenerator(wf.trainer, weights=w, **kwargs)
+        return gen
 
     def _generate(self, wf, spec):
         """--generate 'PROMPT[:MAX_NEW]' — byte-level decode from the
@@ -707,9 +714,11 @@ class Main(object):
         fwd = wf.forward_fn()
         params = wf.trainer.params
         # root.common.serve.cache_dtype='bfloat16' halves the serve-time
-        # KV-cache memory; root.common.serve.batch_window_ms>0 coalesces
-        # concurrent generate requests into shared device calls
-        # (docs/services.md)
+        # KV-cache memory ('int8' quarters it);
+        # root.common.serve.weights='int8' quantizes the serving weights
+        # (W8A8-dynamic, ops.quant) for ~half the decode HBM traffic;
+        # root.common.serve.batch_window_ms>0 coalesces concurrent
+        # generate requests into shared device calls (docs/services.md)
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
                          wf.trainer.layers[0].input_shape, port=port,
                          generator=self._make_generator(wf),

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veles_tpu.ops import attention, norm
+from veles_tpu.ops import attention, norm, quant
 
 #: compiled-executable cache capacity per generator.  Batch size (number
 #: of prompt rows) and beam width are both client-controlled on the REST
@@ -80,8 +80,14 @@ class LMGenerator:
     """
 
     def __init__(self, trainer, max_len, cache_dtype=None,
-                 mesh_cfg="auto"):
+                 mesh_cfg="auto", weights=None):
         self.params = trainer.params
+        #: ``weights="int8"`` quantizes the serving copy of the params
+        #: (ops.quant W8A8-dynamic): attention/FFN/head matrices become
+        #: int8 + per-channel scales, the embedding table int8 + per-row
+        #: scales — halving decode-time weight HBM traffic vs bf16.
+        #: Training params are untouched.
+        self.weight_dtype = weights
         self.max_len = int(max_len)
         #: KV-cache storage dtype; default follows the params.  bfloat16
         #: halves serve-time cache memory (keys/values are MXU inputs
@@ -151,8 +157,45 @@ class LMGenerator:
                         "tensor-parallel decode needs n_kv_heads (%d) "
                         "divisible by the model axis size (%d)"
                         % (layer.n_kv_heads, m))
+        if self.weight_dtype is not None:
+            if self.weight_dtype != "int8":
+                raise ValueError("weights must be None or 'int8', got %r"
+                                 % (self.weight_dtype,))
+            if self.mesh_cfg is not None and self.mesh_cfg.model_size > 1:
+                # quantized copies are rebuilt host-side and would lose
+                # the training shardings the TP decode path relies on
+                raise ValueError(
+                    "int8 serving weights are single-device for now — "
+                    "drop the model-axis mesh or serve in bf16")
+            if any(layer.cfg.get("n_experts") for layer in self._blocks):
+                raise ValueError(
+                    "int8 serving weights do not cover MoE experts yet")
+            # the model/cache dtype must not shift because the weights
+            # were quantized — remember it before the table becomes a
+            # QuantWeight
+            self._float_dtype = \
+                self.params[self._embed.name]["table"].dtype
+            self.params = quant.quantize_lm_params(
+                self.params, embed_name=self._embed.name)
 
     # ------------------------------------------------------------------
+    def _embed_rows(self, params, idx):
+        """Embedding lookup — int8 serving tables (QuantWeight) gather
+        int8 rows and dequantize only those (ops.quant.take_rows)."""
+        table = params[self._embed.name]["table"]
+        if isinstance(table, quant.QuantWeight):
+            return quant.take_rows(table, idx.astype(jnp.int32))
+        return jnp.take(table, idx.astype(jnp.int32), axis=0)
+
+    def _model_dtype(self):
+        """Cache/init dtype: the embedding table's pre-quantization
+        dtype — weights="int8" must not silently shift cache precision
+        (the user opts into cache compression via cache_dtype)."""
+        table = self.params[self._embed.name]["table"]
+        if isinstance(table, quant.QuantWeight):
+            return self._float_dtype
+        return table.dtype
+
     def _pos_table(self, params):
         """The position table (learned weights or the sinusoid buffer);
         None when the stack has no positional-encoding layer (rope)."""
@@ -170,8 +213,7 @@ class LMGenerator:
 
     def _step(self, params, caches, tok, pos):
         """tok [B] int32 at position ``pos`` → (logits [B, V], caches)."""
-        x = jnp.take(params[self._embed.name]["table"],
-                     tok.astype(jnp.int32), axis=0)[:, None, :]
+        x = self._embed_rows(params, tok)[:, None, :]
         x = x + self._pos_row(params, pos)
         new_caches = []
         for layer, (ck, cv) in zip(self._blocks, caches):
@@ -241,8 +283,7 @@ class LMGenerator:
 
         def run(params, tokens, prompt_len, seeds, top_k, top_p,
                 inv_temp, greedy):
-            caches = self._init_caches(
-                batch, self.params[self._embed.name]["table"].dtype)
+            caches = self._init_caches(batch, self._model_dtype())
             keys = jax.vmap(jax.random.key)(seeds)
             body = self._decode_body(params, prompt_len, keys, top_k,
                                      top_p, inv_temp, greedy, batch)
@@ -293,10 +334,9 @@ class LMGenerator:
             return cached
 
         def run(params, toks):
-            table = params[self._embed.name]["table"]
-            x = jnp.take(table, toks.astype(jnp.int32), axis=0)
+            x = self._embed_rows(params, toks)
             x = x + self._pos_rows(params, tp)
-            caches = self._init_caches(batch, table.dtype)
+            caches = self._init_caches(batch, self._model_dtype())
             out = []
             for layer, (ck, cv) in zip(self._blocks, caches):
                 x, ck, cv = layer.prefill(params[layer.name], x, ck, cv)
@@ -398,8 +438,7 @@ class LMGenerator:
     def _chunk_logits(self, params, caches, toks, start):
         """toks [1, K] at positions [start, start+K) → (logits [K, V]
         f32, caches) — the speculative verify forward."""
-        table = params[self._embed.name]["table"]
-        x = jnp.take(table, toks.astype(jnp.int32), axis=0)
+        x = self._embed_rows(params, toks)
         ptab = self._pos_table(params)
         if ptab is not None:
             x = x + jax.lax.dynamic_slice(
@@ -650,8 +689,7 @@ class LMGenerator:
 
         def run(params, tokens, prompt_len, gen_end):
             # tokens: [batch, beam, max_len]
-            caches = self._init_caches(
-                bb, self.params[self._embed.name]["table"].dtype)
+            caches = self._init_caches(bb, self._model_dtype())
             scores = self._beam_init_scores(batch, beam)
             body = self._beam_body(params, prompt_len, gen_end, batch,
                                    beam)

@@ -815,9 +815,16 @@ class TiedLMHead(Layer):
     def apply(self, params, x, train=False, key=None):
         # ``params`` is the FULL tree (needs_full_params)
         table = params[self.tie_to]["table"]        # [vocab, d_model]
-        if table.shape != (self.n_out, self.n_in):
+        from veles_tpu.ops.quant import QuantWeight, int8_matmul_t
+        shape = table.q.shape if isinstance(table, QuantWeight) \
+            else table.shape
+        if shape != (self.n_out, self.n_in):
             raise ValueError("tied table %s does not match head (%d, %d)"
-                             % (table.shape, self.n_out, self.n_in))
+                             % (shape, self.n_out, self.n_in))
+        if isinstance(table, QuantWeight):
+            # int8 serving: the per-ROW table scales are exactly the
+            # head's per-output-channel scales (ops.quant)
+            return int8_matmul_t(x, table)
         return linear.matmul(x, table.T, self.policy)
 
 

@@ -41,12 +41,10 @@ class QuantCache(NamedTuple):
 
 def quantize_kv(x):
     """x [..., T, hd] → (int8 data, f32 scale[..., T, 1]): symmetric
-    per-position quantization over the head dim."""
-    xf = x.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
-                        1e-8) / 127.0
-    data = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-    return data, scale
+    per-position quantization over the head dim (the shared
+    ops.quant.symmetric_int8 scheme)."""
+    from veles_tpu.ops.quant import symmetric_int8
+    return symmetric_int8(x)
 
 
 def dequantize_kv(cache):
@@ -229,6 +227,11 @@ def merge_heads(x):
 
 
 def _proj(x, w, b, policy):
+    from veles_tpu.ops.quant import QuantWeight, int8_matmul
+    if isinstance(w, QuantWeight):
+        # int8 serving weights: W8A8-dynamic dot (ops.quant) — the
+        # weight stays int8 into the MXU, halving decode HBM traffic
+        return int8_matmul(x, w) + b.astype(jnp.float32)
     if policy is None:
         return x @ w + b
     y = jnp.matmul(policy.cast_in(x), policy.cast_in(w),

@@ -1,0 +1,120 @@
+"""Weight-only int8 serving quantization (W8A8-dynamic).
+
+Decode is weight-bandwidth-bound: at batch ≤ ~32 every step streams the
+whole parameter set from HBM while the MXU idles, so halving the weight
+bytes (int8 vs bf16) is worth up to 2× decode throughput on chip.  A
+naive "dequantize to bf16 inside the step" cannot deliver that — XLA
+hoists the loop-invariant convert out of the decode scan and the loop
+reads bf16 again.  The matmuls here therefore keep the weights int8 all
+the way into the dot: activations are quantized per row on the fly
+(dynamic symmetric), the MXU runs its native int8×int8 → int32 path,
+and one f32 rescale (row scale ⊗ column scale) restores the magnitude.
+
+Scheme matches the int8 export packages (services/export.py:31-56,
+consumed by the C++ runtime): symmetric, per-output-channel scales,
+round-to-nearest, clip ±127.  Embedding tables quantize PER ROW so the
+same tensor serves both directions exactly: a gathered row dequantizes
+by its own scalar, and the tied LM head (x @ tableᵀ) treats the row
+scales as output-channel scales.  (KV-cache int8 lives in
+ops.attention.QuantCache; the reference has no serving quantization at
+all — its closest analog is the fp16→fp32 load transform in
+libVeles/src/numpy_array_loader.cc.)
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantWeight(NamedTuple):
+    """int8 payload + f32 scales.  A NamedTuple, so it is a pytree and
+    flows through jit/scan/device_put untouched."""
+    q: jnp.ndarray        # int8  [n_in, n_out]   (tables: [V, d])
+    scale: jnp.ndarray    # f32   [n_out]         (tables: [V])
+
+
+def symmetric_int8(x, axis=-1, keepdims=True, eps=1e-8):
+    """THE symmetric int8 quantizer — weights, activations and the KV
+    cache (ops.attention.quantize_kv) all route through this one
+    function so the scheme can never drift between them:
+    ``scale = max(max|x|, eps) / 127`` over ``axis``, round-to-nearest,
+    clip ±127."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=axis, keepdims=True),
+                        eps) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, (scale if keepdims else jnp.squeeze(scale, axis))
+
+
+def quantize_weight(w, axis=0):
+    """Symmetric per-channel int8: scales reduced over ``axis`` (the
+    contraction dim — 0 for an [in, out] weight; 1 for a [V, d] table,
+    giving per-row scales)."""
+    q, s = symmetric_int8(jnp.asarray(w), axis=axis, keepdims=False)
+    return QuantWeight(q, s)
+
+
+def _quant_acts(x):
+    """Dynamic per-row activation quantization (the A8 half of W8A8)."""
+    return symmetric_int8(x)
+
+
+def int8_matmul(x, qw):
+    """``x @ W`` for an [in, out] QuantWeight: int8×int8 dot with int32
+    accumulation, rescaled to f32.  Output shape x.shape[:-1] + (out,)."""
+    xq, xs = _quant_acts(x)
+    y = jax.lax.dot_general(xq, qw.q, (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * xs * qw.scale
+
+
+def int8_matmul_t(x, qw):
+    """``x @ Wᵀ`` for a per-row-quantized [V, d] table — the tied-LM-head
+    direction (row scales act as output-channel scales)."""
+    xq, xs = _quant_acts(x)
+    y = jax.lax.dot_general(xq, qw.q, (((x.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * xs * qw.scale
+
+
+def take_rows(qw, idx):
+    """Embedding lookup on a per-row-quantized table: gather int8 rows,
+    dequantize only what was gathered (exact — one scalar per row)."""
+    rows = jnp.take(qw.q, idx, axis=0).astype(jnp.float32)
+    return rows * jnp.take(qw.scale, idx)[..., None]
+
+
+#: weight keys the serve path consumes through the QuantWeight-aware
+#: funnels (attention._proj, linear.matmul, TiedLMHead.apply) — an
+#: explicit allowlist so unrelated layer state can never be quantized
+#: by accident
+_MHA_KEYS = ("wq", "wk", "wv", "wo")
+_DENSE_KEYS = ("w1", "w2", "weights")
+
+
+def quantize_lm_params(params, embed_name=None):
+    """Map a trained transformer-LM param tree to the int8 serving
+    layout: attention projections and FFN/head matrices per-output-
+    channel, the embedding table (``embed_name``) per row; biases,
+    layer norms, positional tables and anything unrecognized stay
+    untouched."""
+    out = {}
+    for lname, sub in params.items():
+        if not isinstance(sub, dict):
+            out[lname] = sub
+            continue
+        new = {}
+        for k, v in sub.items():
+            if k == "mha" and isinstance(v, dict):
+                new[k] = {mk: (quantize_weight(mv)
+                               if mk in _MHA_KEYS else mv)
+                          for mk, mv in v.items()}
+            elif k == "table" and lname == embed_name:
+                new[k] = quantize_weight(v, axis=1)
+            elif k in _DENSE_KEYS and getattr(v, "ndim", 0) == 2:
+                new[k] = quantize_weight(v)
+            else:
+                new[k] = v
+        out[lname] = new
+    return out
