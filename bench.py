@@ -41,6 +41,7 @@ PHASES = [
     ("mlp", 420),
     ("alexnet", 600),
     ("beam", 420),
+    ("serve", 600),
     ("ring", 420),
     ("kohonen", 300),
 ]
@@ -622,6 +623,67 @@ def phase_beam():
             "t": t_max}
 
 
+def phase_serve():
+    """Weight-bound decode throughput: greedy ms/token on a
+    GPT-2-small-class stack (untrained — timing only), f32 weights
+    (as-trained) vs bf16 vs int8 W8A8 (root.common.serve.weights).
+    Expected shape on TPU: f32 ≈ bf16 (XLA hoists the policy's bf16
+    cast out of the decode scan, so the f32 baseline already streams
+    bf16 per step — bf16 weights save resident memory, not bandwidth);
+    int8 is the one that cuts per-step weight traffic, because the
+    int8 payload enters the dot itself."""
+    import numpy as np
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.generate import LMGenerator
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+
+    prng.seed_all(17)
+    d = int(os.environ.get("BENCH_SERVE_D", 768))        # CPU smoke: 64
+    n_layers = int(os.environ.get("BENCH_SERVE_L", 12))
+    vocab = 50304 if d >= 768 else 512
+    t_max = 512 if d >= 768 else 48
+    toks = np.random.RandomState(0).randint(
+        0, vocab, (4, 32)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=4, class_lengths=[0, 0, 4])
+    wf = StandardWorkflow(
+        layers=transformer_lm(vocab_size=vocab, d_model=d,
+                              n_heads=max(1, d // 64), n_layers=n_layers,
+                              dropout=0.0, pos="rope",
+                              tie_embeddings=True),
+        loader=loader, loss="lm", decision_config={"max_epochs": 1},
+        name="bench-serve")
+    wf.initialize()
+    prompt = toks[:1, :16]
+
+    def timed(gen):
+        gen.generate(prompt, max_new=32)           # compile + warmup
+        t0 = time.perf_counter()
+        gen.generate(prompt, max_new=32)
+        # the decode scan always runs all t_max - 1 traced positions
+        return (time.perf_counter() - t0) / (t_max - 1) * 1e3
+
+    out = {"d_model": d, "n_layers": n_layers, "t": t_max}
+    for name, w in (("f32", None), ("bf16", "bf16"), ("int8", "int8")):
+        gen = LMGenerator(wf.trainer, max_len=t_max,
+                          cache_dtype=jnp.bfloat16, weights=w)
+        out["ms_per_tok_" + name] = round(timed(gen), 4)
+        del gen
+    base = out["ms_per_tok_f32"]
+    _log("serve decode %dM-class (d=%d L=%d T=%d): f32 %.3f ms/tok, "
+         "bf16 %.3f (x%.2f), int8 %.3f (x%.2f)"
+         % (12 * d * d * n_layers // 1_000_000 if d >= 768 else 0,
+            d, n_layers, t_max, base, out["ms_per_tok_bf16"],
+            base / out["ms_per_tok_bf16"] if out["ms_per_tok_bf16"]
+            else 0.0, out["ms_per_tok_int8"],
+            base / out["ms_per_tok_int8"] if out["ms_per_tok_int8"]
+            else 0.0))
+    return out
+
+
 def phase_flashtune():
     """Block-size sweep for the flash kernel with the chained in-jit
     harness — NOT in the default phase list; run manually on hardware
@@ -777,7 +839,8 @@ _EMPTY = (0, 0.0, False, None)
 #: result-key prefix → phase whose failure mode decides carry eligibility
 _KEY_PHASE = (("gemm", "gemm"), ("mlp_", "mlp"), ("alexnet_", "alexnet"),
               ("lm_large_", "lm_large"), ("lm_", "lm"), ("flash_", "flash"),
-              ("beam_", "beam"), ("ring_", "ring"), ("kohonen_", "kohonen"),
+              ("beam_", "beam"), ("serve_", "serve"), ("ring_", "ring"),
+              ("kohonen_", "kohonen"),
               ("value", "gemm"), ("vs_baseline", "gemm"))
 
 
@@ -883,6 +946,10 @@ def main():
         "beam_ms_per_pos_t4096": round(
             results.get("beam", {}).get("ms_per_pos_beam8", 0.0)
             if results.get("beam", {}).get("t") == 4096 else 0.0, 3),
+        "serve_ms_per_tok_bf16": round(
+            results.get("serve", {}).get("ms_per_tok_bf16", 0.0), 3),
+        "serve_ms_per_tok_int8": round(
+            results.get("serve", {}).get("ms_per_tok_int8", 0.0), 3),
         "ring_ok": bool(results.get("ring", {}).get("ok")),
         "error": ("; ".join("%s: %s" % kv for kv in sorted(errors.items()))
                   or None),

@@ -59,3 +59,17 @@ def test_emits_one_json_line_when_budget_exhausted(tmp_path):
     assert out["metric"] == "gemm_3001x3001_f32_gflops"
     assert out["value"] == 0.0
     assert out["error"] and "probe" in out["error"]
+
+
+def test_serve_phase_runs_on_cpu(monkeypatch):
+    """CPU CI gate for the serve phase (f32/bf16/int8 decode timing):
+    a tiny config must produce all three timings.  No speedup assertion
+    here — CPUs have no int8 matmul unit; the ordering only means
+    something on the TPU run."""
+    monkeypatch.setenv("BENCH_SERVE_D", "64")
+    monkeypatch.setenv("BENCH_SERVE_L", "2")
+    sys.path.insert(0, REPO)
+    import bench
+    out = bench.phase_serve()
+    for k in ("ms_per_tok_f32", "ms_per_tok_bf16", "ms_per_tok_int8"):
+        assert out[k] > 0, out

@@ -553,6 +553,21 @@ class TestInt8ServingWeights:
         np.testing.assert_array_equal(
             a, gen_f.generate(toks[:4, :8], max_new=6))
 
+    def test_bf16_serving_weights(self, f32_precision):
+        """weights="bf16": the whole float tree casts down (halved
+        decode weight traffic), scores stay close, decode matches the
+        float continuation on a trained model."""
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen_f = LMGenerator(wf.trainer, max_len=16)
+        gen_h = LMGenerator(wf.trainer, max_len=16, weights="bf16")
+        table = gen_h.params[gen_h._embed.name]["table"]
+        assert table.dtype == jnp.bfloat16
+        sf, sh = gen_f.score(toks[:4]), gen_h.score(toks[:4])
+        assert np.max(np.abs(sh - sf)) < 0.05 * np.abs(sf).max()
+        np.testing.assert_array_equal(
+            gen_h.generate(toks[:4, :8], max_new=6),
+            gen_f.generate(toks[:4, :8], max_new=6))
+
     def test_int8_rejects_tensor_parallel_and_moe(self):
         from veles_tpu.parallel import MeshConfig, make_mesh
         wf, _ = _lm_workflow(max_epochs=0, n_kv_heads=2)
@@ -562,3 +577,6 @@ class TestInt8ServingWeights:
                         weights="int8")
         with pytest.raises(ValueError, match="int8"):
             LMGenerator(wf.trainer, max_len=16, weights="int4")
+        wf_moe, _ = _lm_workflow(max_epochs=0, n_experts=2)
+        with pytest.raises(ValueError, match="MoE"):
+            LMGenerator(wf_moe.trainer, max_len=16, weights="int8")

@@ -158,25 +158,42 @@ class LMGenerator:
                         "divisible by the model axis size (%d)"
                         % (layer.n_kv_heads, m))
         if self.weight_dtype is not None:
-            if self.weight_dtype != "int8":
-                raise ValueError("weights must be None or 'int8', got %r"
-                                 % (self.weight_dtype,))
-            if self.mesh_cfg is not None and self.mesh_cfg.model_size > 1:
-                # quantized copies are rebuilt host-side and would lose
-                # the training shardings the TP decode path relies on
-                raise ValueError(
-                    "int8 serving weights are single-device for now — "
-                    "drop the model-axis mesh or serve in bf16")
-            if any(layer.cfg.get("n_experts") for layer in self._blocks):
-                raise ValueError(
-                    "int8 serving weights do not cover MoE experts yet")
-            # the model/cache dtype must not shift because the weights
-            # were quantized — remember it before the table becomes a
-            # QuantWeight
-            self._float_dtype = \
-                self.params[self._embed.name]["table"].dtype
-            self.params = quant.quantize_lm_params(
-                self.params, embed_name=self._embed.name)
+            if self.weight_dtype not in ("bf16", "int8"):
+                raise ValueError("weights must be None, 'bf16' or "
+                                 "'int8', got %r" % (self.weight_dtype,))
+            if self.weight_dtype == "bf16":
+                # training params are often f32; the float decode path
+                # already streams a hoisted bf16 cast per step, so this
+                # mainly halves RESIDENT param memory (no duplicate
+                # f32 input + hoisted bf16 copy) — int8 is what cuts
+                # the per-step traffic
+                self.params = jax.tree_util.tree_map(
+                    lambda a: (a.astype(jnp.bfloat16)
+                               if hasattr(a, "dtype")
+                               and jnp.issubdtype(a.dtype, jnp.floating)
+                               else a), self.params)
+            else:                       # int8
+                if self.mesh_cfg is not None and \
+                        self.mesh_cfg.model_size > 1:
+                    # quantized copies are rebuilt host-side and would
+                    # lose the training shardings the TP decode path
+                    # relies on
+                    raise ValueError(
+                        "int8 serving weights are single-device for "
+                        "now — drop the model-axis mesh or serve in "
+                        "bf16")
+                if any(layer.cfg.get("n_experts")
+                       for layer in self._blocks):
+                    raise ValueError(
+                        "int8 serving weights do not cover MoE experts "
+                        "yet")
+                # the model/cache dtype must not shift because the
+                # weights were quantized — remember it before the table
+                # becomes a QuantWeight
+                self._float_dtype = \
+                    self.params[self._embed.name]["table"].dtype
+                self.params = quant.quantize_lm_params(
+                    self.params, embed_name=self._embed.name)
 
     # ------------------------------------------------------------------
     def _embed_rows(self, params, idx):
