@@ -274,3 +274,36 @@ class TestHalfPrecisionPackages:
         np.testing.assert_array_equal(
             stored[:len(specials), 0], specials)
         native.close()
+
+
+class TestStableHLOExport:
+    """export_stablehlo: a compiled-forward artifact (jax.export) that
+    reproduces the live forward_fn bit-for-bit, with a symbolic batch
+    dim, loadable without the model-building code."""
+
+    def test_roundtrip_matches_forward(self, tmp_path):
+        from veles_tpu.services.export import (export_stablehlo,
+                                               load_stablehlo)
+        wf, x = train_small(MLP_LAYERS, epochs=2)
+        path = str(tmp_path / "m.stablehlo.zip")
+        meta = export_stablehlo(wf, path, platforms=("cpu",))
+        assert meta["platforms"] == ["cpu"] and meta["input_shape"] == [64]
+        fn, meta2 = load_stablehlo(path)
+        assert meta2 == meta
+        live = np.asarray(wf.forward_fn()(wf.trainer.params, x[:5]))
+        np.testing.assert_allclose(np.asarray(fn(x[:5])), live,
+                                   rtol=1e-6, atol=1e-6)
+        # symbolic batch: the same artifact serves other batch sizes
+        assert np.asarray(fn(x[:3])).shape == (3, 10)
+        assert np.asarray(fn(x[:11])).shape == (11, 10)
+
+    def test_conv_stack_exports(self, tmp_path):
+        from veles_tpu.services.export import (export_stablehlo,
+                                               load_stablehlo)
+        wf, x = train_small(CONV_LAYERS, epochs=1, img=True)
+        path = str(tmp_path / "c.zip")
+        export_stablehlo(wf, path, platforms=("cpu",))
+        fn, _ = load_stablehlo(path)
+        live = np.asarray(wf.forward_fn()(wf.trainer.params, x[:4]))
+        np.testing.assert_allclose(np.asarray(fn(x[:4])), live,
+                                   rtol=1e-6, atol=1e-6)

@@ -69,6 +69,10 @@ class Main(object):
                        "widens to f32 on load)")
         p.add_argument("--export", default=None,
                        help="export trained model package to this path")
+        p.add_argument("--export-stablehlo", default=None, metavar="PATH",
+                       help="export the jitted forward as a portable "
+                       "StableHLO artifact (+params) runnable on any "
+                       "XLA backend without the model code")
         p.add_argument("--serve", type=int, default=None, metavar="PORT",
                        help="after training, serve the model over REST")
         p.add_argument("--generate", default=None,
@@ -299,6 +303,11 @@ class Main(object):
             from veles_tpu.services.export import export_workflow
             export_workflow(wf, args.export, dtype=args.export_dtype)
             print("exported -> %s" % args.export)
+        if args.export_stablehlo and wf is not None:
+            from veles_tpu.services.export import export_stablehlo
+            meta = export_stablehlo(wf, args.export_stablehlo)
+            print("stablehlo (%s) -> %s"
+                  % (",".join(meta["platforms"]), args.export_stablehlo))
         if args.generate is not None and wf is not None:
             self._generate(wf, args.generate)
         if args.serve is not None and wf is not None:

@@ -161,6 +161,10 @@ class LMGenerator:
             if self.weight_dtype not in ("bf16", "int8"):
                 raise ValueError("weights must be None, 'bf16' or "
                                  "'int8', got %r" % (self.weight_dtype,))
+            # weight compression must never shift cache/compute
+            # precision — that stays an explicit cache_dtype opt-in
+            self._float_dtype = \
+                self.params[self._embed.name]["table"].dtype
             if self.weight_dtype == "bf16":
                 # training params are often f32; the float decode path
                 # already streams a hoisted bf16 cast per step, so this
@@ -187,11 +191,6 @@ class LMGenerator:
                     raise ValueError(
                         "int8 serving weights do not cover MoE experts "
                         "yet")
-                # the model/cache dtype must not shift because the
-                # weights were quantized — remember it before the table
-                # becomes a QuantWeight
-                self._float_dtype = \
-                    self.params[self._embed.name]["table"].dtype
                 self.params = quant.quantize_lm_params(
                     self.params, embed_name=self._embed.name)
 
@@ -205,13 +204,13 @@ class LMGenerator:
         return jnp.take(table, idx.astype(jnp.int32), axis=0)
 
     def _model_dtype(self):
-        """Cache/init dtype: the embedding table's pre-quantization
-        dtype — weights="int8" must not silently shift cache precision
-        (the user opts into cache compression via cache_dtype)."""
-        table = self.params[self._embed.name]["table"]
-        if isinstance(table, quant.QuantWeight):
+        """Cache/init dtype: the embedding table's pre-compression
+        dtype — weights="bf16"/"int8" must not silently shift cache
+        precision (the user opts into cache compression via
+        cache_dtype)."""
+        if self.weight_dtype is not None:
             return self._float_dtype
-        return table.dtype
+        return self.params[self._embed.name]["table"].dtype
 
     def _pos_table(self, params):
         """The position table (learned weights or the sinusoid buffer);

@@ -115,6 +115,86 @@ def import_workflow(path):
     return manifest, arrays
 
 
+def export_stablehlo(workflow, path, platforms=None):
+    """Portable COMPILED serving artifact: the jitted forward serialized
+    as StableHLO (``jax.export``) plus the trained params, in one ZIP —
+    loadable on any machine with jax for the named platforms WITHOUT the
+    model-building Python code.  Where the ``contents.json`` package
+    (export_workflow) feeds the native C++ CPU runtime, this is the
+    XLA-native sibling: one artifact, every XLA backend.  The batch dim
+    is exported symbolically, so a single artifact serves any batch
+    size.
+
+    Package layout: ``model.stablehlo`` (versioned serialized bytes),
+    ``params.npz`` ("layer/param"-keyed), ``meta.json``."""
+    import jax
+    from jax import export as jexport
+
+    trainer = workflow.trainer
+    host = trainer.host_params()
+    in_shape = tuple(trainer.layers[0].input_shape)
+    (b,) = jexport.symbolic_shape("b")
+    x_spec = jax.ShapeDtypeStruct((b,) + in_shape, np.float32)
+    p_spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        host)
+    fwd = workflow.forward_fn()
+    default = platforms is None
+    if default:
+        platforms = ("cpu", "tpu")
+    try:
+        exp = jexport.export(fwd, platforms=list(platforms))(p_spec,
+                                                             x_spec)
+    except Exception as e:  # noqa: BLE001 — e.g. a kernel with no
+        # lowering for one platform of the DEFAULT set; an explicitly
+        # requested platform list is a contract and failures surface
+        if not default:
+            raise
+        import logging
+        logging.getLogger("Export").warning(
+            "multi-platform StableHLO export failed (%s: %s) — "
+            "retrying cpu-only", type(e).__name__, e)
+        platforms = ("cpu",)
+        exp = jexport.export(fwd, platforms=["cpu"])(p_spec, x_spec)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(host)
+    buf = io.BytesIO()
+    np.savez(buf, **{"/".join(str(k.key) for k in kpath):
+                     np.asarray(arr) for kpath, arr in flat})
+    meta = {"name": workflow.name, "framework": "veles_tpu",
+            "version": __version__, "input_shape": list(in_shape),
+            "platforms": list(platforms)}
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("model.stablehlo", exp.serialize())
+        zf.writestr("params.npz", buf.getvalue())
+        zf.writestr("meta.json", json.dumps(meta, indent=1))
+    return meta
+
+
+def load_stablehlo(path):
+    """Load an export_stablehlo package → ``(fn, meta)`` where ``fn(x)``
+    runs the exported forward with the packaged params on the current
+    default jax platform (which must be in ``meta['platforms']``)."""
+    import jax
+    from jax import export as jexport
+
+    with zipfile.ZipFile(path) as zf:
+        exp = jexport.deserialize(zf.read("model.stablehlo"))
+        meta = json.loads(zf.read("meta.json"))
+        npz = np.load(io.BytesIO(zf.read("params.npz")))
+        params = {}
+        for key in npz.files:          # "layer/.../param" → nested dicts
+            node, parts = params, key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = npz[key]
+
+    def fn(x):
+        return exp.call(params, jax.numpy.asarray(x, jax.numpy.float32))
+
+    return fn, meta
+
+
 def _is_floating(arr):
     """True for numpy floats AND ml_dtypes extensions (bfloat16 params
     from a custom precision policy have dtype kind 'V', which
