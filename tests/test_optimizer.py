@@ -250,3 +250,126 @@ def test_clip_norm_zero_means_disabled():
     with pytest.raises(ValueError, match="positive"):
         optimizer.update(p, g, optimizer.init_state(p), hy,
                          clip_norm=-1.0)
+
+
+class TestGradAccumulation:
+    """grad_accum=k: every call accumulates; each k-th applies ONE update
+    with the microbatch-mean gradient — exactly one k=1 update on the
+    mean (the per-element-mean loss makes k steps at batch B equal one
+    step at batch k*B)."""
+
+    def test_k_microsteps_equal_one_mean_update(self):
+        w0 = np.array([[1.0, -2.0], [0.5, 3.0]], np.float32)
+        g1 = np.array([[0.2, 0.4], [-0.6, 0.1]], np.float32)
+        g2 = np.array([[-0.1, 0.3], [0.2, 0.5]], np.float32)
+        hyper = {"l": optimizer.resolve_hyper(
+            {"solver": "adamw", "learning_rate": 0.1})}
+
+        params = {"l": {"weights": jnp.asarray(w0)}}
+        state = optimizer.init_state(params, grad_accum=2)
+        p1, s1 = optimizer.update(params, {"l": {"weights": jnp.asarray(g1)}},
+                                  state, hyper, grad_accum=2)
+        # first microstep: params untouched, gradient banked, no step
+        np.testing.assert_array_equal(np.asarray(p1["l"]["weights"]), w0)
+        assert int(s1["step"]) == 0 and int(s1["micro"]) == 1
+        p2, s2 = optimizer.update(p1, {"l": {"weights": jnp.asarray(g2)}},
+                                  s1, hyper, grad_accum=2)
+        assert int(s2["step"]) == 1
+        np.testing.assert_allclose(
+            np.asarray(s2["gacc"]["l"]["weights"]), 0.0)
+
+        # reference: ONE plain update on the mean gradient
+        ref_p = {"l": {"weights": jnp.asarray(w0)}}
+        ref_s = optimizer.init_state(ref_p)
+        ref_p, ref_s = optimizer.update(
+            ref_p, {"l": {"weights": jnp.asarray((g1 + g2) / 2)}},
+            ref_s, hyper)
+        np.testing.assert_allclose(np.asarray(p2["l"]["weights"]),
+                                   np.asarray(ref_p["l"]["weights"]),
+                                   rtol=1e-6)
+
+    def test_training_matches_double_batch(self):
+        """digits MLP: mb=750 + grad_accum=2 reproduces mb=1500 up to
+        float summation order (same shuffle order, per-element-mean
+        loss, no RNG layers; few updates keep associativity drift from
+        compounding through adamw's normalizer)."""
+        from sklearn.datasets import load_digits
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        y = d.target.astype(np.int32)
+
+        def run(mb, accum):
+            prng.seed_all(21)
+            loader = FullBatchLoader(None, data=x, labels=y,
+                                     minibatch_size=mb,
+                                     class_lengths=[0, 297, 1500])
+            wf = StandardWorkflow(
+                layers=[{"type": "all2all_tanh",
+                         "output_sample_shape": 24},
+                        {"type": "softmax", "output_sample_shape": 10}],
+                # momentum GD: the update is LINEAR in g, so float
+                # summation-order noise stays O(1e-7) instead of being
+                # amplified by adamw's sign-like first-step normalizer
+                loader=loader, gd_defaults={
+                    "solver": "gd", "learning_rate": 0.05,
+                    "gradient_moment": 0.9,
+                    "grad_accum_steps": accum},
+                decision_config={"max_epochs": 2}, name="accum-%d" % accum)
+            wf.initialize()
+            wf.run()
+            return wf.trainer.params
+
+        # 1500 train samples: mb=750 -> 2 microbatches = 1 update/epoch
+        pa = run(750, 2)
+        pb = run(1500, 1)
+        for lname in pa:
+            for k in pa[lname]:
+                # f32 batch-grouping summation noise through the tanh
+                # stack caps near 2e-5; a broken accumulation scale
+                # (missing /k, double update) shows at ~1e-1
+                np.testing.assert_allclose(
+                    np.asarray(pa[lname][k]), np.asarray(pb[lname][k]),
+                    rtol=1e-4, atol=3e-5)
+
+    def test_accumulation_composes_with_fused_sweep(self):
+        """steps_per_dispatch carries the accumulator through the scan:
+        fused and per-step dispatch produce BITWISE-identical params."""
+        from sklearn.datasets import load_digits
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        y = d.target.astype(np.int32)
+
+        def run(spd):
+            prng.seed_all(21)
+            loader = FullBatchLoader(None, data=x, labels=y,
+                                     minibatch_size=100,
+                                     class_lengths=[0, 297, 1500])
+            wf = StandardWorkflow(
+                layers=[{"type": "all2all_tanh",
+                         "output_sample_shape": 24},
+                        {"type": "softmax", "output_sample_shape": 10}],
+                loader=loader,
+                gd_defaults={"solver": "gd", "learning_rate": 0.05,
+                             "gradient_moment": 0.9,
+                             "grad_accum_steps": 3},
+                steps_per_dispatch=spd,
+                decision_config={"max_epochs": 2},
+                name="accum-spd%d" % spd)
+            wf.initialize()
+            wf.run()
+            return wf.trainer.params
+
+        pa, pb = run(1), run(5)
+        for ln in pa:
+            for k in pa[ln]:
+                np.testing.assert_allclose(
+                    np.asarray(pa[ln][k]), np.asarray(pb[ln][k]),
+                    rtol=1e-6)

@@ -70,6 +70,14 @@ class StagedTrainer(Unit):
         #: workflow-level knob — per-layer clipping would change the
         #: norm's meaning)
         self.clip_norm = self.gd_defaults.pop("clip_norm", None)
+        #: gradient accumulation (gd_defaults["grad_accum_steps"]): every
+        #: step's gradient joins a running sum; one optimizer update per
+        #: k microbatches with the mean — k× the effective batch without
+        #: k× the activation memory.  Composes with steps_per_dispatch
+        #: (the scan body carries the accumulator like any other state).
+        self.grad_accum = int(self.gd_defaults.pop("grad_accum_steps", 1))
+        if self.grad_accum < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
         #: fuse this many minibatch steps into ONE device dispatch
         #: (lax.scan inside the jitted sweep).  Amortizes host→device
         #: dispatch latency — the dominant cost for small models and for
@@ -123,7 +131,8 @@ class StagedTrainer(Unit):
                     jnp.asarray, layer.init_params(rng))
                 hypers[layer.name] = optimizer.resolve_hyper(
                     layer.gd, self.gd_defaults, layer_type=layer.type)
-        self.velocity = optimizer.init_state(self.params)
+        self.velocity = optimizer.init_state(self.params,
+                                             grad_accum=self.grad_accum)
         self._hypers = hypers
         # resolve weight-tying references now that layers are named:
         # tie_to may be a layer NAME or a layer TYPE (e.g. "embedding");
@@ -257,9 +266,9 @@ class StagedTrainer(Unit):
                 return loss, stats
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
-            params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers, lr_scale=lr_scale,
-                                                clip_norm=self.clip_norm)
+            params, velocity = optimizer.update(
+                params, grads, velocity, hypers, lr_scale=lr_scale,
+                clip_norm=self.clip_norm, grad_accum=self.grad_accum)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -386,9 +395,9 @@ class StagedTrainer(Unit):
                                              key)
 
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
-            params, velocity = optimizer.update(params, grads, velocity,
-                                                hypers, lr_scale=lr_scale,
-                                                clip_norm=self.clip_norm)
+            params, velocity = optimizer.update(
+                params, grads, velocity, hypers, lr_scale=lr_scale,
+                clip_norm=self.clip_norm, grad_accum=self.grad_accum)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -601,6 +610,18 @@ class StagedTrainer(Unit):
         if host_velocity is not None:
             self.velocity = jax.tree_util.tree_map(jnp.asarray,
                                                    host_velocity)
+            # reconcile accumulation state across config changes: a
+            # snapshot from a grad_accum=1 run resumes into an
+            # accumulating one with fresh (zero) accumulators, and vice
+            # versa the stale accumulator is dropped — not a KeyError
+            # mid-trace
+            if self.grad_accum > 1 and "gacc" not in self.velocity:
+                self.velocity["gacc"] = jax.tree_util.tree_map(
+                    jnp.zeros_like, self.params)
+                self.velocity["micro"] = jnp.zeros((), jnp.int32)
+            elif self.grad_accum == 1:
+                self.velocity.pop("gacc", None)
+                self.velocity.pop("micro", None)
         if self.mesh_config is not None:
             # re-establish the parallel placement initialize() set up
             from veles_tpu.parallel import sharding

@@ -106,10 +106,17 @@ def newton_schulz(g, steps=5, eps=1e-7):
     return x.reshape(shape)
 
 
-def init_state(params):
+def init_state(params, grad_accum=1):
     zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)  # noqa
-    return {"slot1": zeros(), "slot2": zeros(),
-            "step": jnp.zeros((), jnp.int32)}
+    state = {"slot1": zeros(), "slot2": zeros(),
+             "step": jnp.zeros((), jnp.int32)}
+    if grad_accum > 1:
+        # gradient accumulation: running microbatch-gradient sum + a
+        # microstep counter; ``step`` keeps counting real updates only
+        # (adam bias correction depends on it)
+        state["gacc"] = zeros()
+        state["micro"] = jnp.zeros((), jnp.int32)
+    return state
 
 
 def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h,
@@ -227,15 +234,9 @@ def clip_by_global_norm(grads, max_norm):
         lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
-def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None):
-    """Whole-model update.  ``params`` is {layer_name: {param: array}};
-    ``hypers`` is {layer_name: resolved hyper dict}.  ``clip_norm``
-    rescales the FULL gradient tree to that global L2 norm first
-    (None or 0 = disabled — 0 would freeze training)."""
+def _apply(params, grads, state, hypers, lr_scale, clip_norm):
+    """One real optimizer update (clip → per-layer rules)."""
     if clip_norm:
-        if clip_norm < 0:
-            raise ValueError("clip_norm must be positive, got %r"
-                             % (clip_norm,))
         grads = clip_by_global_norm(grads, float(clip_norm))
     step = state["step"] + 1
     new_p, new_s1, new_s2 = {}, {}, {}
@@ -245,3 +246,42 @@ def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None):
             state["slot2"][lname], step, hypers[lname], lr_scale,
             layer_name=lname)
     return new_p, {"slot1": new_s1, "slot2": new_s2, "step": step}
+
+
+def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None,
+           grad_accum=1):
+    """Whole-model update.  ``params`` is {layer_name: {param: array}};
+    ``hypers`` is {layer_name: resolved hyper dict}.  ``clip_norm``
+    rescales the FULL gradient tree to that global L2 norm first
+    (None or 0 = disabled — 0 would freeze training).
+
+    ``grad_accum=k`` > 1 turns each call into a MICROBATCH step: the
+    gradient joins a running sum and only every k-th call applies one
+    optimizer update with the mean — k× the effective batch without k×
+    the activation memory.  The mean-of-microbatch-gradients equals the
+    full-batch gradient for mean-reduced losses, so k steps at batch B
+    match one step at batch k·B exactly (clipping included: the norm is
+    taken on the mean, not per microbatch)."""
+    if clip_norm and clip_norm < 0:
+        raise ValueError("clip_norm must be positive, got %r"
+                         % (clip_norm,))
+    if grad_accum <= 1:
+        return _apply(params, grads, state, hypers, lr_scale, clip_norm)
+
+    gacc = jax.tree_util.tree_map(jnp.add, state["gacc"], grads)
+    micro = state["micro"] + 1
+    base = {"slot1": state["slot1"], "slot2": state["slot2"],
+            "step": state["step"]}
+
+    def do_update(_):
+        mean = jax.tree_util.tree_map(lambda g: g / grad_accum, gacc)
+        new_p, new_s = _apply(params, mean, base, hypers, lr_scale,
+                              clip_norm)
+        new_s["gacc"] = jax.tree_util.tree_map(jnp.zeros_like, gacc)
+        new_s["micro"] = micro
+        return new_p, new_s
+
+    def skip(_):
+        return params, dict(base, gacc=gacc, micro=micro)
+
+    return jax.lax.cond(micro % grad_accum == 0, do_update, skip, None)
