@@ -29,6 +29,12 @@ def main():
     rest = sys.argv[4:]
     fsdp = "--fsdp" in rest
     seq = "--seq" in rest       # ring attention ACROSS processes
+    # --preempt: ONLY process 0 raises the preemption flag mid-run (the
+    # staggered-SIGTERM race); the snapshotter's per-cycle agreement
+    # allgather must stop BOTH processes at the same cycle with a
+    # checkpoint — the exact divergence-deadlock scenario the agreement
+    # exists for
+    preempt = "--preempt" in rest
     dirs = [a for a in rest if not a.startswith("--")]
     snap_dir = dirs[0] if dirs else None
     # 4 local devices per process -> 8 global over 2 processes (overwrite
@@ -74,16 +80,24 @@ def main():
         loader = FullBatchLoader(None, data=x, labels=y,
                                  minibatch_size=80,
                                  class_lengths=[0, 160, 640])
+        if preempt:
+            # effectively endless run; ONLY the preemption path can
+            # write the checkpoint (interval far beyond the epochs)
+            decision_cfg = {"max_epochs": 100000}
+            snap_cfg = {"interval": 10 ** 6, "directory": snap_dir}
+        else:
+            decision_cfg = {"max_epochs": 2}
+            snap_cfg = (None if snap_dir is None else
+                        {"interval": 1, "directory": snap_dir})
         wf = StandardWorkflow(
             layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
                      "learning_rate": 0.1},
                     {"type": "softmax", "output_sample_shape": 10,
                      "learning_rate": 0.1}],
-            loader=loader, decision_config={"max_epochs": 2},
-            snapshotter_config=(None if snap_dir is None else
-                                {"interval": 1, "directory": snap_dir}),
+            loader=loader, decision_config=decision_cfg,
+            snapshotter_config=snap_cfg,
             name="multihost-digits")
-        if fsdp or wf.snapshotter is None:
+        if preempt or fsdp or wf.snapshotter is None:
             mesh_axes = {"data": -1}
         else:
             mesh_axes = {"model": -1}   # params shard ACROSS processes
@@ -94,18 +108,24 @@ def main():
     launcher.initialize()
     assert launcher.mode == "spmd"
     n_devices = len(jax.devices())
+    if preempt and process_id == 0:
+        import threading
+        threading.Timer(4.0, wf.request_preempt).start()
     launcher.run()
 
-    m = wf.decision.epoch_metrics[1]
     result = {
         "process_id": process_id,
         "process_count": jax.process_count(),
         "n_global_devices": n_devices,
         "is_master": launcher.is_master,
-        "loss": m["loss"],
-        "n_errors": m["n_errors"],
-        "best_metric": wf.decision.best_metric,
     }
+    if preempt:
+        result["preempted"] = wf.preempted_
+        result["epochs"] = wf.loader.epoch_number
+    else:
+        m = wf.decision.epoch_metrics[1]
+        result.update(loss=m["loss"], n_errors=m["n_errors"],
+                      best_metric=wf.decision.best_metric)
     if wf.snapshotter is not None or fsdp:
         if wf.snapshotter is not None:
             result["snapshot"] = wf.snapshotter.destination

@@ -167,3 +167,25 @@ def test_multihost_sequence_parallel_ring_attention():
     m = wf.decision.epoch_metrics[1]
     assert m["n_errors"] == r0["n_errors"]
     np.testing.assert_allclose(m["loss"], r0["loss"], rtol=1e-5)
+
+
+def test_multihost_preemption_agreement(tmp_path):
+    """Staggered preemption: ONLY process 0 raises the flag mid-run; the
+    snapshotter's unconditional per-cycle agreement allgather must stop
+    BOTH processes at the same cycle, with process 0 writing the
+    checkpoint — the SIGTERM-races-unit-boundaries scenario that would
+    deadlock the pod if the agreement were gated on per-process state."""
+    results = _spawn_job(2, extra=["--preempt", str(tmp_path)])
+    assert all(r["preempted"] for r in results), results
+    # far from the 100000-epoch horizon: they stopped because of the
+    # flag, not completion — and at the SAME cycle (the agreement
+    # property itself; a stale-broadcast regression would diverge here)
+    assert all(r["epochs"] < 90000 for r in results), results
+    assert results[0]["epochs"] == results[1]["epochs"], results
+    master = next(r for r in results if r["process_id"] == 0)
+    assert master.get("snapshot"), results
+    assert os.path.exists(master["snapshot"])
+    # the checkpoint is complete and loadable, not truncated
+    from veles_tpu.services.snapshotter import SnapshotterBase
+    snap = SnapshotterBase.import_(master["snapshot"])
+    assert "params" in snap and "loader" in snap
