@@ -32,6 +32,7 @@ def run(load, main):
                                n_heads=4, n_layers=2,
                                lr=cfg.get("learning_rate", 0.003)),
          loader=loader, loss="lm",
+         gd_defaults=cfg.get("gd"),
          decision_config={"max_epochs": cfg.get("max_epochs", 10)},
          name="char-lm")
     main()

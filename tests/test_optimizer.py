@@ -373,3 +373,67 @@ class TestGradAccumulation:
                 np.testing.assert_allclose(
                     np.asarray(pa[ln][k]), np.asarray(pb[ln][k]),
                     rtol=1e-6)
+
+
+class TestEMA:
+    """Polyak/EMA weight averaging (gd_defaults["ema_decay"])."""
+
+    def test_ema_tracks_hand_computed_average(self):
+        w0 = np.array([1.0, -2.0], np.float32)
+        g = np.array([0.5, 0.5], np.float32)
+        d = 0.9
+        params = {"l": {"weights": jnp.asarray(w0)}}
+        state = optimizer.init_state(params, ema_decay=d)
+        np.testing.assert_array_equal(
+            np.asarray(state["ema"]["l"]["weights"]), w0)
+        hyper = {"l": optimizer.resolve_hyper(
+            {"solver": "gd", "learning_rate": 0.1})}
+        ema = w0.copy()
+        for _ in range(3):
+            params, state = optimizer.update(
+                params, {"l": {"weights": jnp.asarray(g)}}, state, hyper,
+                ema_decay=d)
+            ema = d * ema + (1 - d) * np.asarray(params["l"]["weights"])
+        np.testing.assert_allclose(
+            np.asarray(state["ema"]["l"]["weights"]), ema, rtol=1e-6)
+
+    def test_training_exposes_ema_and_serves_it(self):
+        from sklearn.datasets import load_digits
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+
+        d = load_digits()
+        x = (d.data / 16.0).astype(np.float32)
+        y = d.target.astype(np.int32)
+        prng.seed_all(7)
+        loader = FullBatchLoader(None, data=x, labels=y,
+                                 minibatch_size=100,
+                                 class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 24},
+                    {"type": "softmax", "output_sample_shape": 10}],
+            loader=loader,
+            gd_defaults={"solver": "adamw", "learning_rate": 0.01,
+                         "ema_decay": 0.95},
+            decision_config={"max_epochs": 4}, name="ema-digits")
+        wf.initialize()
+        wf.run()
+        tr = wf.trainer
+        ema = tr.ema_params
+        assert ema is not None
+        # the average lags the live weights but is close after training
+        w_live = np.asarray(tr.params["l00_all2all_tanh"]["weights"])
+        w_ema = np.asarray(ema["l00_all2all_tanh"]["weights"])
+        assert not np.array_equal(w_live, w_ema)
+        assert np.max(np.abs(w_live - w_ema)) < 0.5
+        # serve path: EMA weights classify about as well as the live ones
+        probs = np.asarray(wf.forward_fn()(tr.serve_params(use_ema=True),
+                                           x[:297]))
+        err_ema = np.mean(np.argmax(probs, 1) != y[:297])
+        assert err_ema < 0.15, err_ema
+        # off -> loud error, not silent un-averaged serving
+        wf2_trainer_has_no_ema = tr.velocity.pop("ema")
+        with pytest.raises(ValueError, match="ema_decay"):
+            tr.serve_params(use_ema=True)
+        tr.velocity["ema"] = wf2_trainer_has_no_ema

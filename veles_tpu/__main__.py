@@ -335,11 +335,14 @@ class Main(object):
         except ValueError:
             return None              # not a generate-shaped stack
         w = root.common.serve.get("weights", None)
-        if w is not None:
+        use_ema = root.common.serve.get("use_ema", False)
+        if w is not None or use_ema:
             # the stack IS generate-shaped (probed above) — a failure
-            # here is a configuration error and must surface, not
-            # silently disable generation
-            gen = LMGenerator(wf.trainer, weights=w, **kwargs)
+            # here is a configuration error (bad weights value, EMA not
+            # tracked, TP×int8) and must surface, not silently disable
+            # generation
+            gen = LMGenerator(wf.trainer, weights=w, use_ema=use_ema,
+                              **kwargs)
         return gen
 
     def _generate(self, wf, spec):
@@ -721,7 +724,10 @@ class Main(object):
 
         from veles_tpu.services.restful import RESTfulAPI
         fwd = wf.forward_fn()
-        params = wf.trainer.params
+        # root.common.serve.use_ema=True serves the Polyak/EMA-averaged
+        # weights (train with gd_defaults={'ema_decay': ...})
+        params = wf.trainer.serve_params(
+            root.common.serve.get("use_ema", False))
         # root.common.serve.cache_dtype='bfloat16' halves the serve-time
         # KV-cache memory ('int8' quarters it);
         # root.common.serve.weights='int8' quantizes the serving weights

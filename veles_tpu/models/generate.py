@@ -80,8 +80,15 @@ class LMGenerator:
     """
 
     def __init__(self, trainer, max_len, cache_dtype=None,
-                 mesh_cfg="auto", weights=None):
-        self.params = trainer.params
+                 mesh_cfg="auto", weights=None, use_ema=False):
+        #: ``use_ema=True`` decodes with the trainer's Polyak/EMA weight
+        #: average (gd_defaults["ema_decay"]) instead of the live params.
+        #: The duck-typed fallback only applies when EMA was NOT asked
+        #: for — a use_ema request on a trainer without the API must
+        #: fail loudly, never silently serve un-averaged weights.
+        self.params = (trainer.serve_params(use_ema)
+                       if use_ema or hasattr(trainer, "serve_params")
+                       else trainer.params)
         #: ``weights="int8"`` quantizes the serving copy of the params
         #: (ops.quant W8A8-dynamic): attention/FFN/head matrices become
         #: int8 + per-channel scales, the embedding table int8 + per-row

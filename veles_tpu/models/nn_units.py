@@ -78,6 +78,13 @@ class StagedTrainer(Unit):
         self.grad_accum = int(self.gd_defaults.pop("grad_accum_steps", 1))
         if self.grad_accum < 1:
             raise ValueError("grad_accum_steps must be >= 1")
+        #: Polyak/EMA weight averaging (gd_defaults["ema_decay"], e.g.
+        #: 0.999): a decayed average of the params advances on every
+        #: real update; ``ema_params`` serves/evaluates with it
+        self.ema_decay = self.gd_defaults.pop("ema_decay", None)
+        if self.ema_decay is not None and not 0.0 < self.ema_decay < 1.0:
+            raise ValueError("ema_decay must be in (0, 1), got %r"
+                             % (self.ema_decay,))
         #: fuse this many minibatch steps into ONE device dispatch
         #: (lax.scan inside the jitted sweep).  Amortizes host→device
         #: dispatch latency — the dominant cost for small models and for
@@ -132,7 +139,8 @@ class StagedTrainer(Unit):
                 hypers[layer.name] = optimizer.resolve_hyper(
                     layer.gd, self.gd_defaults, layer_type=layer.type)
         self.velocity = optimizer.init_state(self.params,
-                                             grad_accum=self.grad_accum)
+                                             grad_accum=self.grad_accum,
+                                             ema_decay=self.ema_decay)
         self._hypers = hypers
         # resolve weight-tying references now that layers are named:
         # tie_to may be a layer NAME or a layer TYPE (e.g. "embedding");
@@ -268,7 +276,8 @@ class StagedTrainer(Unit):
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(
                 params, grads, velocity, hypers, lr_scale=lr_scale,
-                clip_norm=self.clip_norm, grad_accum=self.grad_accum)
+                clip_norm=self.clip_norm, grad_accum=self.grad_accum,
+                ema_decay=self.ema_decay)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -397,7 +406,8 @@ class StagedTrainer(Unit):
             grads, stats = jax.grad(loss_fn, has_aux=True)(params)
             params, velocity = optimizer.update(
                 params, grads, velocity, hypers, lr_scale=lr_scale,
-                clip_norm=self.clip_norm, grad_accum=self.grad_accum)
+                clip_norm=self.clip_norm, grad_accum=self.grad_accum,
+                ema_decay=self.ema_decay)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
             return params, velocity, acc
 
@@ -622,6 +632,13 @@ class StagedTrainer(Unit):
             elif self.grad_accum == 1:
                 self.velocity.pop("gacc", None)
                 self.velocity.pop("micro", None)
+            if self.ema_decay and "ema" not in self.velocity:
+                # fresh f32 average seeded from the restored params
+                # (jnp.array copies — no aliasing with donated params)
+                self.velocity["ema"] = jax.tree_util.tree_map(
+                    lambda p: jnp.array(p, jnp.float32), self.params)
+            elif not self.ema_decay:
+                self.velocity.pop("ema", None)
         if self.mesh_config is not None:
             # re-establish the parallel placement initialize() set up
             from veles_tpu.parallel import sharding
@@ -631,6 +648,25 @@ class StagedTrainer(Unit):
             self.velocity = sharding.shard_params(self.velocity,
                                                   self.mesh_config,
                                                   overrides)
+
+    @property
+    def ema_params(self):
+        """The Polyak/EMA weight average (gd_defaults["ema_decay"]), or
+        None when EMA tracking is off."""
+        return self.velocity.get("ema")
+
+    def serve_params(self, use_ema=False):
+        """The params a serve/export path should read: the live tree, or
+        the EMA average when asked (a loud error beats silently serving
+        un-averaged weights the user thought were smoothed)."""
+        if not use_ema:
+            return self.params
+        ema = self.ema_params
+        if ema is None:
+            raise ValueError(
+                "use_ema requested but EMA tracking is off — train with "
+                "gd_defaults={'ema_decay': 0.999}")
+        return ema
 
     def forward_fn(self):
         """Jitted serve-time forward (softmax applied for classifiers)."""

@@ -106,7 +106,7 @@ def newton_schulz(g, steps=5, eps=1e-7):
     return x.reshape(shape)
 
 
-def init_state(params, grad_accum=1):
+def init_state(params, grad_accum=1, ema_decay=None):
     zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)  # noqa
     state = {"slot1": zeros(), "slot2": zeros(),
              "step": jnp.zeros((), jnp.int32)}
@@ -116,6 +116,16 @@ def init_state(params, grad_accum=1):
         # (adam bias correction depends on it)
         state["gacc"] = zeros()
         state["micro"] = jnp.zeros((), jnp.int32)
+    if ema_decay:
+        # Polyak/EMA weight averaging: seeded with the initial params
+        # (no zero-bias warmup needed), advanced on every real update.
+        # Kept in f32 ALWAYS: with bf16 master params the per-step
+        # increment (1-d)·(p-e) sits below the bf16 mantissa and the
+        # average would freeze at its seed.
+        # jnp.array COPIES (asarray would alias f32 params, and the
+        # train step donates both trees — same-buffer-donated-twice)
+        state["ema"] = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, jnp.float32), params)
     return state
 
 
@@ -234,8 +244,9 @@ def clip_by_global_norm(grads, max_norm):
         lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
-def _apply(params, grads, state, hypers, lr_scale, clip_norm):
-    """One real optimizer update (clip → per-layer rules)."""
+def _apply(params, grads, state, hypers, lr_scale, clip_norm,
+           ema_decay=None):
+    """One real optimizer update (clip → per-layer rules → EMA track)."""
     if clip_norm:
         grads = clip_by_global_norm(grads, float(clip_norm))
     step = state["step"] + 1
@@ -245,11 +256,19 @@ def _apply(params, grads, state, hypers, lr_scale, clip_norm):
             params[lname], grads[lname], state["slot1"][lname],
             state["slot2"][lname], step, hypers[lname], lr_scale,
             layer_name=lname)
-    return new_p, {"slot1": new_s1, "slot2": new_s2, "step": step}
+    new_s = {"slot1": new_s1, "slot2": new_s2, "step": step}
+    if ema_decay:
+        d = float(ema_decay)
+        # f32 accumulator (see init_state) — never rounded to the param
+        # dtype, or sub-resolution increments would vanish
+        new_s["ema"] = jax.tree_util.tree_map(
+            lambda e, p: d * e + (1.0 - d) * p.astype(jnp.float32),
+            state["ema"], new_p)
+    return new_p, new_s
 
 
 def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None,
-           grad_accum=1):
+           grad_accum=1, ema_decay=None):
     """Whole-model update.  ``params`` is {layer_name: {param: array}};
     ``hypers`` is {layer_name: resolved hyper dict}.  ``clip_norm``
     rescales the FULL gradient tree to that global L2 norm first
@@ -261,22 +280,30 @@ def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None,
     the activation memory.  The mean-of-microbatch-gradients equals the
     full-batch gradient for mean-reduced losses, so k steps at batch B
     match one step at batch k·B exactly (clipping included: the norm is
-    taken on the mean, not per microbatch)."""
+    taken on the mean, not per microbatch).
+
+    ``ema_decay=d`` maintains a Polyak/EMA average of the params in
+    ``state["ema"]`` (``ema ← d·ema + (1-d)·params`` per real update) —
+    the serve/eval-time weights that average out minibatch noise."""
     if clip_norm and clip_norm < 0:
         raise ValueError("clip_norm must be positive, got %r"
                          % (clip_norm,))
     if grad_accum <= 1:
-        return _apply(params, grads, state, hypers, lr_scale, clip_norm)
+        return _apply(params, grads, state, hypers, lr_scale, clip_norm,
+                      ema_decay)
 
     gacc = jax.tree_util.tree_map(jnp.add, state["gacc"], grads)
     micro = state["micro"] + 1
-    base = {"slot1": state["slot1"], "slot2": state["slot2"],
-            "step": state["step"]}
+    base = {k: state[k] for k in ("slot1", "slot2", "step", "ema")
+            if k in state}
 
     def do_update(_):
         mean = jax.tree_util.tree_map(lambda g: g / grad_accum, gacc)
         new_p, new_s = _apply(params, mean, base, hypers, lr_scale,
-                              clip_norm)
+                              clip_norm, ema_decay)
+        if "ema" in base and "ema" not in new_s:
+            # ema tracked in state but decay off this call: carry it
+            new_s["ema"] = base["ema"]
         new_s["gacc"] = jax.tree_util.tree_map(jnp.zeros_like, gacc)
         new_s["micro"] = micro
         return new_p, new_s
