@@ -140,7 +140,8 @@ class StagedTrainer(Unit):
                     layer.gd, self.gd_defaults, layer_type=layer.type)
         self.velocity = optimizer.init_state(self.params,
                                              grad_accum=self.grad_accum,
-                                             ema_decay=self.ema_decay)
+                                             ema_decay=self.ema_decay,
+                                             hypers=hypers)
         self._hypers = hypers
         # resolve weight-tying references now that layers are named:
         # tie_to may be a layer NAME or a layer TYPE (e.g. "embedding");
@@ -632,6 +633,28 @@ class StagedTrainer(Unit):
             elif self.grad_accum == 1:
                 self.velocity.pop("gacc", None)
                 self.velocity.pop("micro", None)
+            # abstract (no allocation): only the slot SHAPES matter
+            spec = jax.eval_shape(
+                lambda: optimizer.init_state(self.params,
+                                             hypers=self._hypers))
+
+            def _shapes(t):
+                return jax.tree_util.tree_map(lambda a: a.shape, t)
+
+            if any(_shapes(self.velocity.get(s)) != _shapes(spec[s])
+                   for s in ("slot1", "slot2")):
+                # solver family changed since the snapshot (e.g.
+                # adam -> adafactor): slot shapes are incompatible —
+                # restart the moments (and the update count their bias
+                # correction depends on) rather than crash mid-trace
+                self.warning(
+                    "restored optimizer state does not match the "
+                    "configured solver's slot shapes — reinitializing "
+                    "moments and step count")
+                fresh = optimizer.init_state(self.params,
+                                             hypers=self._hypers)
+                for k in ("slot1", "slot2", "step"):
+                    self.velocity[k] = fresh[k]
             if self.ema_decay and "ema" not in self.velocity:
                 # fresh f32 average seeded from the restored params
                 # (jnp.array copies — no aliasing with donated params)

@@ -22,6 +22,16 @@ Solvers, selectable per layer via ``solver``:
 - ``rprop``   sign-based resilient propagation (ref RPropAll2All):
               per-weight step grows ×1.2 on agreeing signs, shrinks ×0.5
               on sign flips
+- ``adafactor`` Shazeer & Stern 2018: the second moment of an [n, m]
+              weight is stored FACTORED — one row vector [n] and one
+              column vector [m] instead of the full [n, m] matrix — so
+              optimizer memory for the big matrices drops from 2x the
+              params (adam m+v) to ~zero.  Momentum-free; the update is
+              RMS-clipped (``adafactor_clip``) instead of bias-corrected,
+              decay ``adafactor_decay``; weight decay decoupled like
+              adamw.  1-D leaves (biases, norms) fall back to adam.
+              State must be built by ``init_state(params, hypers=...)``
+              so the factored slots get their shapes.
 - ``muon``    momentum orthogonalized by a Newton–Schulz iteration
               (Jordan et al. 2024) — five matmuls per matrix per step,
               MXU-native.  Applies to >=2-D weight matrices (conv
@@ -34,6 +44,8 @@ Solvers, selectable per layer via ``solver``:
 State is {"slot1": tree, "slot2": tree, "step": scalar}: slot1 = momentum
 velocity / Adam m / RProp previous gradient; slot2 = Adam v / AdaGrad
 accumulator / RProp per-weight step."""
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +69,8 @@ DEFAULTS = {
     "muon_momentum": 0.95,
     "muon_ns_steps": 5,
     "muon_nesterov": True,
+    "adafactor_decay": 0.999,
+    "adafactor_clip": 1.0,
 }
 
 
@@ -72,16 +86,17 @@ def resolve_hyper(layer_gd, workflow_gd=None, layer_type=None):
         h.update({k: v for k, v in workflow_gd.items() if k in DEFAULTS})
     h.update({k: v for k, v in layer_gd.items() if k in DEFAULTS})
     if h["solver"] not in ("gd", "adam", "adamw", "adagrad", "rprop",
-                           "muon"):
+                           "muon", "adafactor"):
         raise ValueError(
-            "unknown solver %r (gd|adam|adamw|adagrad|rprop|muon)"
-            % (h["solver"],))
+            "unknown solver %r (gd|adam|adamw|adagrad|rprop|muon|"
+            "adafactor)" % (h["solver"],))
     for k in ("learning_rate", "weights_decay", "gradient_moment"):
         if h[k + "_bias"] is None:
             # adamw/muon convention: biases / norm shifts are NOT
             # decayed unless weights_decay_bias is given explicitly
             h[k + "_bias"] = (0.0 if (k == "weights_decay" and
-                                      h["solver"] in ("adamw", "muon"))
+                                      h["solver"] in ("adamw", "muon",
+                                                      "adafactor"))
                               else h[k])
     return h
 
@@ -106,15 +121,41 @@ def newton_schulz(g, steps=5, eps=1e-7):
     return x.reshape(shape)
 
 
-def init_state(params, grad_accum=1, ema_decay=None):
-    zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)  # noqa
-    state = {"slot1": zeros(), "slot2": zeros(),
+def _factored(w):
+    """Adafactor slot shapes for one leaf: >=2-D weights store row+col
+    second-moment vectors packed into ONE [rows+cols] array (slot2) and
+    no momentum (empty slot1) — the memory win; smaller leaves keep the
+    dense adam slots (they fall back to adam)."""
+    if w.ndim < 2:
+        return jnp.zeros_like(w), jnp.zeros_like(w)
+    rows = math.prod(w.shape[:-1])
+    return (jnp.zeros((0,), jnp.float32),
+            jnp.zeros((rows + w.shape[-1],), jnp.float32))
+
+
+def init_state(params, grad_accum=1, ema_decay=None, hypers=None):
+    """``hypers`` ({layer: resolved hyper dict}) lets per-layer solvers
+    pick their slot SHAPES — adafactor's factored second moments need
+    it; without it every slot is dense zeros_like."""
+    def layer_zeros(lname, sub, idx):
+        solver = (hypers or {}).get(lname, {}).get("solver")
+        if solver == "adafactor":
+            return jax.tree_util.tree_map(
+                lambda w: _factored(w)[idx], sub)
+        return jax.tree_util.tree_map(jnp.zeros_like, sub)
+
+    def zeros(idx):
+        return {ln: layer_zeros(ln, sub, idx)
+                for ln, sub in params.items()}
+
+    state = {"slot1": zeros(0), "slot2": zeros(1),
              "step": jnp.zeros((), jnp.int32)}
     if grad_accum > 1:
-        # gradient accumulation: running microbatch-gradient sum + a
-        # microstep counter; ``step`` keeps counting real updates only
-        # (adam bias correction depends on it)
-        state["gacc"] = zeros()
+        # gradient accumulation: running microbatch-gradient sum (ALWAYS
+        # dense — it accumulates gradients) + a microstep counter;
+        # ``step`` keeps counting real updates only (adam bias
+        # correction depends on it)
+        state["gacc"] = jax.tree_util.tree_map(jnp.zeros_like, params)
         state["micro"] = jnp.zeros((), jnp.int32)
     if ema_decay:
         # Polyak/EMA weight averaging: seeded with the initial params
@@ -140,13 +181,38 @@ def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h,
             u = newton_schulz(u_in, steps=int(h["muon_ns_steps"]))
             # match adamw's per-element update RMS across shapes
             # (Jordan et al.: scale by sqrt(max(1, fan_out/fan_in)))
-            flat_rows = 1
-            for d in w.shape[:-1]:
-                flat_rows *= d
+            flat_rows = math.prod(w.shape[:-1])
             u = u * max(1.0, w.shape[-1] / flat_rows) ** 0.5
             return (w - lr * u.astype(w.dtype) - lr * wd * w, m, s2)
         # tables / biases / 1-D leaves: the adamw rule (Muon recipe)
         solver = "adamw"
+    if solver == "adafactor":
+        if w.ndim >= 2:
+            rows = math.prod(w.shape[:-1])
+            cols = w.shape[-1]
+            if s2.shape != (rows + cols,):
+                raise ValueError(
+                    "adafactor state has shape %s, expected (%d,) — "
+                    "build it with init_state(params, hypers=...)"
+                    % (s2.shape, rows + cols))
+            b2 = h["adafactor_decay"]
+            g2 = jnp.square(g.astype(jnp.float32)).reshape(rows, cols) \
+                + 1e-30
+            r = b2 * s2[:rows] + (1.0 - b2) * jnp.mean(g2, axis=1)
+            c = b2 * s2[rows:] + (1.0 - b2) * jnp.mean(g2, axis=0)
+            # rank-1 reconstruction V = r·cᵀ / mean(r)  (Shazeer & Stern
+            # eq. 4: the row/col means over-count the total by mean(r))
+            v = jnp.outer(r, c) / jnp.maximum(jnp.mean(r), 1e-30)
+            u = g.astype(jnp.float32).reshape(rows, cols) \
+                / jnp.sqrt(v + h["epsilon"])
+            # update clipping replaces bias correction: cap RMS(u) at
+            # adafactor_clip so cold second moments can't blow the step
+            rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms / h["adafactor_clip"])
+            u = u.reshape(w.shape).astype(w.dtype)
+            return (w - lr * u - lr * wd * w, s1,
+                    jnp.concatenate([r, c]))
+        solver = "adam"      # biases / 1-D leaves: dense adam below
     if solver in ("adam", "adamw"):
         b1, b2, eps = h["adam_beta1"], h["adam_beta2"], h["epsilon"]
         m = b1 * s1 + (1.0 - b1) * g
