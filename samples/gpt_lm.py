@@ -91,7 +91,14 @@ def run(load, main):
              solver=cfg.get("solver", "adam"),
              lr=cfg.get("learning_rate", 1e-3)),
          loader=loader, loss="lm",
-         gd_defaults={"clip_norm": cfg.get("clip_norm", 1.0)},
+         gd_defaults={
+             "clip_norm": cfg.get("clip_norm", 1.0),
+             # k× the effective batch without k× activation memory
+             "grad_accum_steps": cfg.get("grad_accum_steps", 1),
+             # e.g. 0.999 + root.common.serve.use_ema=True to serve
+             # the Polyak average
+             **({"ema_decay": cfg.get("ema_decay")}
+                if cfg.get("ema_decay") else {})},
          decision_config={"max_epochs": cfg.get("max_epochs", 20)},
          name="gpt-lm")
     main()
