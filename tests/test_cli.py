@@ -57,6 +57,24 @@ class TestCLI:
         with zipfile.ZipFile(pkg) as zf:
             assert "contents.json" in zf.namelist()
 
+    def test_export_stablehlo_flag(self, tmp_path):
+        """--export-stablehlo writes a loadable compiled-forward
+        artifact whose predictions are valid probabilities."""
+        pkg = str(tmp_path / "model.stablehlo.zip")
+        r = _cli(["samples/digits_mlp.py", "--backend", "cpu",
+                  "--random-seed", "5",
+                  "--config-list", "root.digits.max_epochs=1",
+                  "--export-stablehlo", pkg])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "stablehlo (" in r.stdout + r.stderr
+        import numpy as np
+        from veles_tpu.services.export import load_stablehlo
+        fn, meta = load_stablehlo(pkg)
+        assert meta["input_shape"] == [64]
+        probs = np.asarray(fn(np.zeros((3, 64), np.float32)))
+        assert probs.shape == (3, 10)
+        np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-5)
+
     def test_char_lm_sample(self, tmp_path):
         out = str(tmp_path / "res.json")
         r = _cli(["samples/char_lm.py", "--backend", "cpu",
