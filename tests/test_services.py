@@ -258,6 +258,19 @@ class TestWebStatus:
                        for n in g["nodes"])
             dot = _get(base + "/api/dot").decode()
             assert dot.startswith("digraph") and "Repeater" in dot
+            # chrome-trace export: B/E pairs for begin/end, instants
+            # for singles, µs timestamps
+            from veles_tpu.logger import events as ev_ring
+            ev_ring.add({"name": "unit", "cat": "T", "type": "begin",
+                         "time": 10.0})
+            ev_ring.add({"name": "unit", "cat": "T", "type": "end",
+                         "time": 10.5, "n": 3})
+            trace = json.loads(_get(base + "/api/trace"))
+            recs = [t for t in trace["traceEvents"]
+                    if t["name"] == "unit"]
+            assert [t["ph"] for t in recs] == ["B", "E"]
+            assert recs[1]["ts"] - recs[0]["ts"] == 5e5
+            assert recs[1]["args"]["n"] == 3
             page = _get(base + "/")
             assert b"drawGraph" in page and b"drawTimeline" in page
         finally:

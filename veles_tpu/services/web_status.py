@@ -30,7 +30,9 @@ td,th{border:1px solid #999;padding:4px 8px}
 <div id="status"></div><h3>metrics</h3><div id="metrics"></div>
 <h3>workflow graph <small>(nodes heat-colored by run-time share;
 <a href="/api/dot">DOT</a>)</small></h3><div id="graph"></div>
-<h3>event timeline</h3><div id="timeline"></div>
+<h3>event timeline <small>(<a href="/api/trace">chrome trace</a> —
+load in Perfetto / chrome://tracing)</small></h3>
+<div id="timeline"></div>
 <h3>recent events</h3><div id="events"></div>
 <script>
 function sparkline(points){           // [[epoch, value], ...] -> SVG
@@ -210,6 +212,35 @@ class WebStatusServer(Logger):
             return "\n".join(wf.generate_graph()
                              for wf in self._workflows.values())
 
+    @staticmethod
+    def chrome_trace():
+        """The event ring as a Chrome trace (chrome://tracing /
+        Perfetto `trace.json`): begin/end pairs → B/E duration events,
+        singles → instant events, lanes keyed by event category — the
+        reference's Mongo event timeline as a standard tooling format."""
+        out = []
+        for ev in events.snapshot():
+            ph = {"begin": "B", "end": "E", "single": "i"}.get(
+                ev.get("type"))
+            if ph is None:
+                continue
+            rec = {"name": ev.get("name", "?"), "ph": ph,
+                   "ts": float(ev.get("time", 0.0)) * 1e6,   # µs
+                   "pid": 0, "tid": ev.get("cat", "events")}
+            if ph == "i":
+                rec["s"] = "t"
+            # finite numbers only — a NaN arg would serialize as the
+            # bare literal NaN, which strict parsers (Perfetto,
+            # JSON.parse) reject wholesale (same guard as metrics())
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("name", "cat", "type", "time")
+                     and isinstance(v, (int, float, str, bool))
+                     and (not isinstance(v, float) or math.isfinite(v))}
+            if extra:
+                rec["args"] = extra
+            out.append(rec)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
         with self._lock:
@@ -248,6 +279,9 @@ class WebStatusServer(Logger):
                                                default=str).encode())
                 elif self.path == "/api/dot":
                     self._send(200, server.dot().encode(), "text/plain")
+                elif self.path == "/api/trace":
+                    self._send(200, json.dumps(
+                        server.chrome_trace()).encode())
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
                                                default=str).encode())
