@@ -432,6 +432,12 @@ class TestEMA:
                                            x[:297]))
         err_ema = np.mean(np.argmax(probs, 1) != y[:297])
         assert err_ema < 0.15, err_ema
+        # --test path: evaluating the average works and restores the
+        # live params afterwards
+        live_before = tr.params
+        stats = wf.evaluate(use_ema=True)
+        assert tr.params is live_before
+        assert stats["validation"]["count"] == 297
         # off -> loud error, not silent un-averaged serving
         wf2_trainer_has_no_ema = tr.velocity.pop("ema")
         with pytest.raises(ValueError, match="ema_decay"):

@@ -265,7 +265,8 @@ class Main(object):
                 profiling = True
             try:
                 if args.test:
-                    stats = wf.evaluate()
+                    stats = wf.evaluate(
+                        use_ema=root.common.serve.get("use_ema", False))
                     print(json.dumps({"test": stats}, indent=2))
                 elif args.ensemble_test:
                     stats = self._ensemble_test(wf, args)

@@ -123,22 +123,37 @@ class StandardWorkflow(Workflow):
                         "{'name': ..., **kwargs} dict")
 
     # ---------------------------------------------------------- evaluation
-    def evaluate(self):
+    def evaluate(self, use_ema=False):
         """One full eval-only pass over every non-empty class — the
         ``--test`` mode (ref `veles --test` reusing a trained snapshot for
-        inference, SURVEY §3.5).  Returns {class_name: stats}."""
+        inference, SURVEY §3.5).  Returns {class_name: stats}.
+
+        ``use_ema=True`` evaluates the Polyak/EMA weight average
+        (gd_defaults["ema_decay"]) — the params swap is transient and
+        safe because no update runs in eval-only mode."""
         from veles_tpu.loader.base import CLASS_NAMES
+        # queued fused-dispatch TRAIN steps must apply as TRAINING
+        # before eval mode flips, or their updates would be silently
+        # dropped (replayed through the eval sweep)
+        self.trainer.flush()
         saved = self.trainer.train_only_classes
-        self.trainer.train_only_classes = ()
-        self.trainer.reset_epoch_stats()
-        loader = self.loader
-        start = loader.epoch_number
-        while loader.epoch_number == start:
-            loader.run()
-            self.trainer.run()
-        stats = {CLASS_NAMES[c]: self.trainer.read_class_stats(c)
-                 for c in range(3) if loader.class_lengths[c]}
-        self.trainer.train_only_classes = saved
+        live = self.trainer.params
+        try:
+            if use_ema:
+                self.trainer.params = self.trainer.serve_params(
+                    use_ema=True)
+            self.trainer.train_only_classes = ()
+            self.trainer.reset_epoch_stats()
+            loader = self.loader
+            start = loader.epoch_number
+            while loader.epoch_number == start:
+                loader.run()
+                self.trainer.run()
+            stats = {CLASS_NAMES[c]: self.trainer.read_class_stats(c)
+                     for c in range(3) if loader.class_lengths[c]}
+        finally:
+            self.trainer.params = live
+            self.trainer.train_only_classes = saved
         self.test_results = stats
         return stats
 
