@@ -25,5 +25,5 @@ Public surface (mirrors reference layers, SURVEY.md §1):
   veles_tpu.services   — snapshotter, results, plotting, REST  (ref veles/snapshotter.py etc.)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 __root__ = __name__
