@@ -95,6 +95,46 @@ class TestRESTful:
         except urllib.error.HTTPError as e:
             assert e.code == 400
 
+    def test_generate_endpoint_serves_int8_weights(self):
+        """The REST generate path decodes through int8 W8A8 serving
+        weights and returns the same greedy continuation as the float
+        generator (trained model, peaked logits)."""
+        from veles_tpu.models import zoo
+        from veles_tpu.models.generate import LMGenerator
+
+        prng.seed_all(23)
+        r = np.random.RandomState(3)
+        n, t, vocab = 128, 12, 11
+        toks = ((np.arange(t)[None, :] + r.randint(0, 3, n)[:, None])
+                % vocab).astype(np.int32)
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=32,
+                                 class_lengths=[0, 32, 96])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=16,
+                                      n_heads=2, n_layers=1, lr=5e-3,
+                                      dropout=0.0),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 8}, name="rest-lm-int8")
+        wf.initialize()
+        wf.run()
+        gen_q = LMGenerator(wf.trainer, max_len=t, weights="int8")
+        gen_f = LMGenerator(wf.trainer, max_len=t)
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda xx: np.asarray(fwd(params, xx)), (t,),
+                         port=0, generator=gen_q)
+        api.start()
+        try:
+            out = _post("http://127.0.0.1:%d/service" % api.port,
+                        {"input": toks[0, :6].tolist(),
+                         "generate": {"max_new": 4}})
+            res = np.asarray(out["result"])
+            np.testing.assert_array_equal(
+                res, gen_f.generate(toks[:1, :6], max_new=4))
+        finally:
+            api.stop()
+
     def test_generate_endpoint_serves_lm(self):
         from veles_tpu.models import zoo
         from veles_tpu.models.generate import LMGenerator
