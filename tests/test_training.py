@@ -113,6 +113,51 @@ class TestSnapshotResume:
         assert wf2.loader.epoch_number == 4
         assert wf2.decision.best_metric < 0.2
 
+    def test_warm_start_partial_restore(self, tmp_path):
+        """Fine-tuning initializer: matching layers copy over, a
+        resized head stays fresh, nothing else (loader/PRNG/moments)
+        is touched — and the warm-started model trains on."""
+        import numpy as np
+
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.services.snapshotter import TrainingSnapshotter
+
+        cfg = {"directory": str(tmp_path), "interval": 1, "prefix": "dig"}
+        wf = make_workflow(max_epochs=2, snapshotter_config=cfg)
+        wf.initialize()
+        wf.run()
+        snap = wf.snapshotter.collect()
+
+        # same trunk, DIFFERENT head width: 5 coarse classes
+        prng.seed_all(77)
+        x, y = digits_data()
+        loader = FullBatchLoader(None, data=x, labels=y // 2,
+                                 minibatch_size=100,
+                                 class_lengths=[0, 297, 1500])
+        wf2 = StandardWorkflow(
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 60,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 5,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+            ],
+            loader=loader, decision_config={"max_epochs": 3},
+            name="digits-coarse")
+        wf2.initialize()
+        head_fresh = np.asarray(
+            wf2.trainer.params["l01_softmax"]["weights"]).copy()
+        restored, skipped = TrainingSnapshotter.warm_start(wf2, snap)
+        assert restored == 2 and skipped == 2    # trunk w+b; head w+b
+        np.testing.assert_array_equal(
+            np.asarray(wf2.trainer.params["l00_all2all_tanh"]["weights"]),
+            np.asarray(snap["params"]["l00_all2all_tanh"]["weights"]))
+        np.testing.assert_array_equal(
+            np.asarray(wf2.trainer.params["l01_softmax"]["weights"]),
+            head_fresh)
+        assert wf2.loader.epoch_number == 0      # NOT an exact resume
+        wf2.run()
+        assert wf2.decision.best_metric < 0.2    # fine-tunes fine
+
     def test_current_symlink(self, tmp_path):
         cfg = {"directory": str(tmp_path), "interval": 1, "prefix": "dig"}
         wf = make_workflow(max_epochs=1, snapshotter_config=cfg)

@@ -46,6 +46,14 @@ class Main(object):
                        "resolve <workflow>_current in the snapshot dir "
                        "(fresh start when absent — the restart-on-failure "
                        "idiom; ref _current symlink snapshotter.py:397-409)")
+        p.add_argument("--warm-start", default=None, metavar="SNAPSHOT",
+                       help="fine-tuning initializer: copy params whose "
+                       "layer/param names and shapes match this snapshot "
+                       "(architecture changes tolerated — mismatches stay "
+                       "freshly initialized; optimizer/loader/PRNG state "
+                       "is NOT restored). Composes with --snapshot auto: "
+                       "a found checkpoint wins, so preemption restarts "
+                       "keep fine-tuning progress")
         p.add_argument("--snapshot-every", type=int, default=None,
                        metavar="N", help="checkpoint every N epochs "
                        "(injects a snapshotter into StandardWorkflow runs; "
@@ -165,6 +173,15 @@ class Main(object):
 
     def run(self):
         args = self.parse()
+        if args.warm_start and args.snapshot and args.snapshot != "auto":
+            # fail before any side effect (config exec, model build).
+            # --snapshot auto DOES compose: a found checkpoint wins so
+            # preemption restarts keep fine-tuning progress
+            raise SystemExit(
+                "--warm-start with an explicit --snapshot path is "
+                "ambiguous: exact resume restores everything, "
+                "warm-start only matching params (use --snapshot auto "
+                "to combine with restart-on-failure)")
         import logging
         setup_logging(logging.DEBUG if args.verbose else logging.INFO)
         if args.backend:
@@ -213,15 +230,27 @@ class Main(object):
             snapshot = args.snapshot
             if snapshot == "auto":
                 snapshot = self._resolve_auto_snapshot(self.workflow)
+            self._pending_warm_start = None
             if snapshot:
                 from veles_tpu.services.snapshotter import SnapshotterBase
-                # initialize first so staged steps exist, then restore
+                # initialize first so staged steps exist, then restore.
+                # With --warm-start + --snapshot auto, a found
+                # checkpoint WINS: the preemption-restart idiom (exit
+                # 75 → same command) must keep fine-tuning progress,
+                # not re-warm-start from the base snapshot.
                 self._pending_snapshot = SnapshotterBase.import_(
                     snapshot,
                     allow_remote=args.allow_remote_snapshot,
                     expected_sha256=args.snapshot_sha256)
             else:
                 self._pending_snapshot = None
+                if args.warm_start:
+                    from veles_tpu.services.snapshotter import \
+                        SnapshotterBase
+                    self._pending_warm_start = SnapshotterBase.import_(
+                        args.warm_start,
+                        allow_remote=args.allow_remote_snapshot,
+                        expected_sha256=args.snapshot_sha256)
             if web is not None:
                 web.register(self.workflow)
             return self.workflow
@@ -258,6 +287,10 @@ class Main(object):
                                          "launcher": launcher}).start()
             if self._pending_snapshot is not None:
                 wf.restore(self._pending_snapshot)
+            elif getattr(self, "_pending_warm_start", None) is not None:
+                # polymorphic like wf.restore — custom workflows can
+                # override their warm-start semantics
+                wf.warm_start(self._pending_warm_start)
             profiling = False
             if args.profile:
                 import jax

@@ -169,3 +169,8 @@ class StandardWorkflow(Workflow):
 
     def restore(self, snapshot):
         TrainingSnapshotter.restore(self, snapshot)
+
+    def warm_start(self, snapshot):
+        """Params-only fine-tuning initializer (see
+        TrainingSnapshotter.warm_start)."""
+        return TrainingSnapshotter.warm_start(self, snapshot)

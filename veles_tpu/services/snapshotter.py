@@ -318,6 +318,65 @@ class TrainingSnapshotter(SnapshotterBase):
             dec.best_epoch = d["best_epoch"]
             dec.epochs_since_improvement = d["epochs_since_improvement"]
 
+    @staticmethod
+    def warm_start(workflow, snapshot):
+        """Fine-tuning initializer (CLI ``--warm-start``): copy over
+        every snapshot param whose layer name, param name AND shape
+        match the freshly built model; everything else — mismatched or
+        new layers, optimizer moments, loader position, PRNG, decision
+        state — stays fresh.  The exact-resume path is ``restore``;
+        this one deliberately tolerates architecture changes (swap the
+        head, widen a layer, add blocks) and reports what it took.
+
+        :returns: (n_restored, n_skipped) leaf counts."""
+        import logging
+        import numpy as np
+
+        log = logging.getLogger("Snapshotter")
+        trainer = workflow.trainer
+        live = trainer.host_params()
+        merged = {}
+        restored = skipped = 0
+        snap_params = snapshot["params"]
+        for lname, sub in live.items():
+            src = snap_params.get(lname)
+            merged[lname] = {}
+            for pname, arr in sub.items():
+                cand = None if src is None else src.get(pname)
+                if cand is not None and \
+                        np.shape(cand) == np.shape(arr):
+                    # cast to the LIVE dtype: an f32 snapshot must not
+                    # plant f32 leaves into a bf16-master-params tree
+                    # (mixed-dtype donation/retrace errors)
+                    merged[lname][pname] = np.asarray(cand).astype(
+                        np.asarray(arr).dtype)
+                    restored += 1
+                else:
+                    merged[lname][pname] = arr
+                    skipped += 1
+                    if cand is not None:
+                        log.warning(
+                            "warm-start: %s/%s shape %s != snapshot %s "
+                            "— keeping fresh init", lname, pname,
+                            np.shape(arr), np.shape(cand))
+        dropped = sorted(set(snap_params) - set(live))
+        if dropped:
+            log.info("warm-start: snapshot layers not in this model: %s",
+                     ", ".join(dropped))
+        trainer.load_params(merged)       # moments/loader/PRNG stay fresh
+        if getattr(trainer, "ema_decay", None) and \
+                "ema" in getattr(trainer, "velocity", {}):
+            # the EMA average was seeded from the DISCARDED random init;
+            # reseed from the warm-started params or use_ema would
+            # serve near-random weights
+            import jax
+            import jax.numpy as jnp
+            trainer.velocity["ema"] = jax.tree_util.tree_map(
+                lambda p: jnp.array(p, jnp.float32), trainer.params)
+        log.info("warm-start: restored %d param leaves, kept %d fresh",
+                 restored, skipped)
+        return restored, skipped
+
 
 class DBSnapshotter(TrainingSnapshotter):
     """Database-backed snapshotter (ref SnapshotterToDB,
