@@ -283,14 +283,25 @@ def phase_alexnet():
     wf.loader.run()
     wf.trainer.run()          # compile
     _block(wf.trainer.class_stats[2]["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        wf.loader.run()
-        wf.trainer.run()
-    _block(wf.trainer.class_stats[2]["loss"])
-    sps = batch * steps / (time.perf_counter() - t0)
-    _log("alexnet synthetic: %.1f samples/sec/chip" % sps)
-    return {"samples_per_sec": sps}
+    # three back-to-back repeats: the r2→r3 "regression" (8,617 → 7,430)
+    # was a cross-session comparison with no variance band — same-session
+    # repeats make every future number interpretable (median headline,
+    # min/max band published alongside)
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            wf.loader.run()
+            wf.trainer.run()
+        _block(wf.trainer.class_stats[2]["loss"])
+        reps.append(batch * steps / (time.perf_counter() - t0))
+    sps = sorted(reps)[1]
+    _log("alexnet synthetic: %.1f samples/sec/chip "
+         "(median of 3; band %.1f-%.1f, spread %.1f%%)"
+         % (sps, min(reps), max(reps),
+            (max(reps) - min(reps)) / sps * 100))
+    return {"samples_per_sec": sps, "band_low": min(reps),
+            "band_high": max(reps)}
 
 
 def _lm_train_flops_per_token(d_model, n_layers, seq, vocab, d_ff=None,
@@ -920,6 +931,10 @@ def main():
             results.get("mlp", {}).get("step_fused_ms", 0.0), 3),
         "alexnet_samples_per_sec": round(
             results.get("alexnet", {}).get("samples_per_sec", 0.0), 1),
+        "alexnet_band_low": round(
+            results.get("alexnet", {}).get("band_low", 0.0), 1),
+        "alexnet_band_high": round(
+            results.get("alexnet", {}).get("band_high", 0.0), 1),
         "lm_tokens_per_sec": round(
             results.get("lm", {}).get("tokens_per_sec", 0.0), 1),
         "lm_mfu": round(results.get("lm", {}).get("mfu", 0.0), 3),
@@ -968,5 +983,30 @@ def main():
     print(json.dumps(line), flush=True)
 
 
+def _guarded_main():
+    """The one-JSON-line-on-stdout contract must survive even a bug in
+    the orchestrator itself (the r02 driver capture once recorded
+    ``parsed: null`` from a malformed tail).  Any uncaught exception
+    still emits a minimal, parseable fail-soft line.  Phase children
+    (``--phase``) are exempt: their parent wants the raw rc + traceback
+    to drive retry/error classification."""
+    if "--phase" in sys.argv:
+        return main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — fail-soft by contract
+        line = {"metric": "gemm_3001x3001_f32_gflops", "value": 0.0,
+                "unit": "GFLOP/s", "vs_baseline": 0.0,
+                "error": "orchestrator: %s: %s" % (type(e).__name__, e)}
+        try:
+            with open(_CACHE) as f:
+                line["last_known_good"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+        print(json.dumps(line), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    _guarded_main()

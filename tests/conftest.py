@@ -28,6 +28,43 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
+#: Modules whose every test joins the slow tier (measured on the 1-core
+#: CI box, see README "Test tiers": these are the multi-process,
+#: compile-heavy, and subprocess-CLI suites).  Individual tests elsewhere
+#: opt in with @pytest.mark.slow.  Smoke tier = `pytest -m "not slow"`.
+SLOW_MODULES = {
+    # real multi-process SPMD (jax.distributed over localhost)
+    "test_multihost.py",
+    # 8-virtual-device shard_map / pjit compile-heavy suites
+    "test_parallel.py", "test_pipeline.py",
+    "test_seq_parallel_training.py", "test_moe.py",
+    # decode/generation: many distinct jit signatures to compile
+    "test_generate.py",
+    # transformer e2e trainings: 15-54s each on the 1-core CI box
+    "test_transformer.py",
+    # end-to-end subprocess trainings (fresh jax init per test)
+    "test_cli.py", "test_genetics_ensemble.py", "test_elasticity.py",
+    # long sweeps / CD-k training loops
+    "test_fused_sweep.py", "test_rbm_recurrent.py",
+}
+
+
+#: Kept in the smoke tier despite living in a slow module — each is the
+#: cheapest end-to-end sentinel for a subsystem smoke would otherwise
+#: not touch at all.
+SMOKE_SENTINELS = {
+    "test_transformer_classifier_trains",   # transformer stack e2e
+    "test_greedy_generation_continues_pattern",  # KV-cache decode
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.basename in SLOW_MODULES \
+                and item.originalname not in SMOKE_SENTINELS \
+                and item.name not in SMOKE_SENTINELS:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def f32_precision():

@@ -139,6 +139,7 @@ def test_ring_attention(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad():
     mesh = make_mesh({"seq": 4})
     q, k, v = _qkv(b=1, h=2, t=32, d=8)

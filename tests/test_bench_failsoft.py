@@ -11,6 +11,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_kohonen_phase_runs_and_sweep_wins():
     """Keep bench.py's phase code from rotting: the kohonen phase runs
     on CPU in seconds and must show the fused sweep beating the
@@ -61,6 +62,7 @@ def test_emits_one_json_line_when_budget_exhausted(tmp_path):
     assert out["error"] and "probe" in out["error"]
 
 
+@pytest.mark.slow
 def test_serve_phase_runs_on_cpu(monkeypatch):
     """CPU CI gate for the serve phase (f32/bf16/int8 decode timing):
     a tiny config must produce all three timings.  No speedup assertion

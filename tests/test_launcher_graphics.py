@@ -136,6 +136,40 @@ class TestGraphics:
         srv.stop()
         client.stop()
 
+    def test_multicast_binds_degrade_gracefully(self):
+        """With a multicast group configured the server attempts an
+        epgm:// bind per non-blacklisted interface (ref LAN plot
+        broadcast, graphics_server.py:100-133) and the tcp endpoint
+        keeps working whether or not libzmq was built with PGM."""
+        local_bus = plotting.PlotBus()
+        srv = GraphicsServer(bus=local_bus, multicast="239.192.1.1",
+                             ifaces=["lo", "fake0"],
+                             multicast_port=15555)
+        # blacklist filtering happens before any bind attempt
+        srv._blacklist = {"fake0"}
+        assert srv._multicast_ifaces() == ["lo"]
+        srv.start()
+        try:
+            assert srv.endpoints["tcp"].startswith("tcp://")
+            import zmq
+            if zmq.has("pgm"):
+                assert srv.endpoints["epgm"] == [
+                    "epgm://lo;239.192.1.1:15555"]
+            else:
+                assert srv.endpoints["epgm"] == []   # warned, not raised
+            # the tcp path still round-trips
+            client = GraphicsClient(srv.endpoint).start()
+            time.sleep(0.3)
+            local_bus.publish({"name": "mc", "kind": "curve",
+                               "values": [1], "ylabel": "x"})
+            deadline = time.time() + 5
+            while client.received < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            assert client.received >= 1
+            client.stop()
+        finally:
+            srv.stop()
+
     def test_client_renders_png(self, tmp_path):
         client = GraphicsClient("tcp://127.0.0.1:1", str(tmp_path))
         client.latest = {"loss": {"name": "loss", "kind": "curve",

@@ -71,6 +71,7 @@ def test_rprop_sign_steps():
     np.testing.assert_allclose(w, [1.0 - 0.1, 1.0 + 0.1], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_adamw_trains_transformer():
     from veles_tpu import prng
     from veles_tpu.loader.fullbatch import FullBatchLoader
@@ -160,6 +161,7 @@ def test_per_layer_solver_knobs_reach_the_optimizer():
     assert h["muon_ns_steps"] == 3 and h["muon_momentum"] == 0.9
 
 
+@pytest.mark.slow
 def test_muon_trains_transformer():
     from veles_tpu import prng
     from veles_tpu.loader.fullbatch import FullBatchLoader
@@ -195,6 +197,7 @@ def test_clip_by_global_norm():
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_clip_norm_applied_in_training():
     """clip_norm in gd_defaults reaches optimizer.update: a near-zero
     clip freezes the params; a generous clip leaves training
@@ -490,6 +493,7 @@ class TestAdafactor:
         b_ad, _ = _one_step("adam", [2.0, -1.0], [0.5, 0.5], leaf="bias")
         np.testing.assert_allclose(b_af, b_ad, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_trains_transformer(self):
         from veles_tpu import prng
         from veles_tpu.loader.fullbatch import FullBatchLoader

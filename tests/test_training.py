@@ -6,19 +6,32 @@ MNIST itself is not available offline; sklearn's bundled digits dataset
 (1797 8×8 images, 10 classes) exercises the identical workflow shape.
 Each threshold below is the always-on proxy for a published reference
 row gated for real in tests/test_accuracy_gates.py (which runs whenever
-the datasets are mounted — ref docs/manualrst_veles_algorithms.rst):
+the datasets are mounted — ref docs/manualrst_veles_algorithms.rst).
 
-  digits MLP   < 0.20  ~ MNIST 784-100-10 MLP, published 1.48 % error
-                         (digits is 24x smaller + 1 epoch budget, so the
-                         proxy gate is an order looser)
+Margin math (round 4): every gate = worst-of-5-seeds × 1.25, measured
+by ``tools/proxy_margins.py`` on the CPU-8 test platform, seeds
+{1234, 5, 9, 17, 42} — tight enough that a real regression (a broken
+layer/GD/loader path costing more than the seed spread + 25% platform
+drift allowance) fires the gate, instead of the old generous round
+numbers that tolerated 2-4x degradation:
+
+  digits MLP   < 0.065 ~ MNIST 784-100-10 MLP, published 1.48 % error.
+                         Measured 0.0370-0.0505 (mean 0.0444);
+                         1.25 x 0.0505 = 0.063.
   digits AE    < 0.25  ~ MNIST autoencoder, published val RMSE 0.5478
-                         (per-element RMSE normalization here)
-  digits conv  < 0.08  ~ cifar_caffe conv stack, published 17.21 %
+                         (per-element RMSE here).  Measured
+                         0.1988-0.2080 (mean 0.2038); the historical
+                         0.25 gate is already TIGHTER than 1.25 x worst
+                         (0.260), so it stands at 1.20 x worst.
+  digits conv  < 0.055 ~ cifar_caffe conv stack, published 17.21 %
                          (digits conv separates far better than CIFAR —
                          the proxy checks the conv/pool/GD path, not the
-                         absolute row)
-  conv AE      < 0.6x  ~ the relative autoencoder-improves-over-identity
-                         gate (no published conv-AE row)"""
+                         absolute row).  Measured 0.0236-0.0438 (mean
+                         0.0357); 1.25 x 0.0438 = 0.0547.
+  conv AE      < 0.57x ~ the relative autoencoder-beats-trivial-zeros
+                         gate (no published conv-AE row).  Measured
+                         0.437-0.453 x baseline (mean 0.446);
+                         1.25 x 0.453 = 0.567."""
 
 import numpy as np
 import pytest
@@ -62,8 +75,9 @@ class TestDigitsMLP:
         wf.initialize()
         wf.run()
         val = wf.decision.best_metric
-        assert val is not None and val < 0.08, \
-            "validation error %.3f not < 8%%" % val
+        assert val is not None and val < 0.065, \
+            "validation error %.3f not < 6.5%% (margin math in module " \
+            "docstring)" % val
 
     def test_bit_reproducible_with_fixed_seed(self):
         def run():
@@ -190,7 +204,8 @@ class TestAutoencoderMSE:
             name="digits-ae")
         wf.initialize()
         wf.run()
-        assert wf.decision.best_metric < 0.25   # per-element RMSE
+        # per-element RMSE; gate = 1.20 x worst-of-5-seeds (docstring)
+        assert wf.decision.best_metric < 0.25, wf.decision.best_metric
 
 
 class TestConvWorkflow:
@@ -214,7 +229,7 @@ class TestConvWorkflow:
             name="digits-conv")
         wf.initialize()
         wf.run()
-        assert wf.decision.best_metric < 0.08
+        assert wf.decision.best_metric < 0.055, wf.decision.best_metric
 
 
 class TestConvAutoencoder:
@@ -234,9 +249,11 @@ class TestConvAutoencoder:
         wf.initialize()
         wf.run()
         # encoder halves the resolution through a 2x2 pool; decoder must
-        # reconstruct below the trivial all-zeros baseline RMSE
+        # reconstruct below the trivial all-zeros baseline RMSE.
+        # Gate = 1.25 x worst-of-5-seeds fraction (module docstring)
         baseline = float(np.sqrt((x_img ** 2).mean()))
-        assert wf.decision.best_metric < 0.6 * baseline
+        assert wf.decision.best_metric < 0.57 * baseline, \
+            wf.decision.best_metric / baseline
 
 
 def test_custom_registered_loss_trains():

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""lm_large MFU attribution sweep — run ON CHIP inside an uptime window.
+
+VERDICT r3 #4: the 124M flagship has a >=40% single-chip MFU target and
+has never produced a hardware number.  If the tunnel's host->device
+dispatch latency is the blocker, fusing more steps per dispatch
+(lax.scan inside the jitted sweep) amortizes it; if HBM or the MXU is
+the blocker, spd changes nothing and batch might.  This sweep separates
+those hypotheses in one run: for each (batch, steps_per_dispatch) it
+reports tokens/sec + MFU side by side.
+
+Usage (defaults are the sensible grid):
+    python tools/lm_mfu_sweep.py
+    python tools/lm_mfu_sweep.py --batch 8,16 --spd 1,4,16 --steps 8
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", default="16,8",
+                    help="comma list of batch sizes (first that fits wins "
+                    "per spd)")
+    ap.add_argument("--spd", default="1,4,8,16",
+                    help="comma list of steps_per_dispatch values")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="timed host-loop iterations per config")
+    ap.add_argument("--seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    import bench
+
+    cfg = dict(d_model=768, n_heads=12, n_layers=12, dropout=0.0,
+               impl="flash", pos="rope", solver="adamw", lr=6e-4,
+               remat=True, tie_embeddings=True)
+    rows = []
+    for spd in [int(s) for s in args.spd.split(",")]:
+        for batch in [int(b) for b in args.batch.split(",")]:
+            tag = "lm-124M[b%d,spd%d]" % (batch, spd)
+            t0 = time.monotonic()
+            try:
+                r = bench._run_lm(tag, cfg, batch=batch, seq=args.seq,
+                                  steps=args.steps,
+                                  steps_per_dispatch=spd, vocab=50304)
+            except Exception as e:  # noqa: BLE001 — OOM at this batch
+                if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                    print("%-22s OOM" % tag, flush=True)
+                    continue
+                raise
+            rows.append(dict(r, batch=batch, spd=spd,
+                             wall_s=round(time.monotonic() - t0, 1)))
+            print("%-22s %8.0f tok/s  %5.1f ms/step  MFU %5.1f%%"
+                  % (tag, r["tokens_per_sec"], r["ms_per_step"],
+                     r["mfu"] * 100), flush=True)
+            break   # first batch that fits at this spd
+    print(json.dumps({"sweep": rows}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ class Main(object):
     def __init__(self, argv=None):
         self.argv = argv if argv is not None else sys.argv[1:]
         self.workflow = None
+        self._interactive_session = None
 
     def parse(self):
         return self._build_parser().parse_args(self.argv)
@@ -145,10 +146,25 @@ class Main(object):
                        help="aggregate the members from an "
                        "--ensemble-train results file: mean-probability "
                        "vote on the eval set (ref --ensemble-test)")
+        p.add_argument("--interactive", action="store_true",
+                       help="drop into an IPython REPL (fallback: "
+                       "code.interact) after constructing the workflow; "
+                       "training runs in a background scheduler thread "
+                       "so the live workflow stays inspectable — "
+                       "wf.stop() / status() / weights(layer) from the "
+                       "prompt (ref Main(interactive=True), "
+                       "veles/__main__.py:380-394 + the reactor thread, "
+                       "launcher.py:556-562)")
         p.add_argument("--manhole", default=None, metavar="SOCKET",
                        help="attachable debug REPL on a unix socket "
                        "(`socat - UNIX-CONNECT:SOCKET`; ref the bundled "
                        "manhole, veles/external/)")
+        p.add_argument("--log-db", default=None, metavar="SQLITE",
+                       help="duplicate every log record into this "
+                       "sqlite file keyed by a per-run session id — "
+                       "the cross-run log store behind the dashboard's "
+                       "/api/logs browser (ref the Mongo log "
+                       "duplication, veles/logger.py:292-331)")
         p.add_argument("--event-log", default=None, metavar="PATH",
                        help="append structured trace events as JSONL "
                        "(ref the Mongo event timeline, logger.py:264-289)")
@@ -195,6 +211,10 @@ class Main(object):
         if args.event_log:
             from veles_tpu.logger import events
             events.open_sink(args.event_log)
+        if args.log_db:
+            from veles_tpu.logger import duplicate_log_to
+            duplicate_log_to(args.log_db)
+            root.common.web.log_db = args.log_db
         if args.sync_run:
             root.common.engine.sync_run = True
         if args.steps_per_dispatch is not None:
@@ -219,6 +239,20 @@ class Main(object):
                              % args.workflow)
 
         def load(cls, **kwargs):
+            if args.interactive:
+                # ref Main(interactive=True): names defined in an
+                # enclosing IPython session fill missing constructor
+                # kwargs (explicit kwargs win; only names the workflow
+                # class actually accepts are considered)
+                import inspect
+                try:
+                    accepted = {k for k in inspect.signature(
+                        cls.__init__).parameters if k != "self"}
+                except (TypeError, ValueError):
+                    accepted = set()
+                for k, v in self._get_interactive_locals().items():
+                    if k in accepted and k not in kwargs:
+                        kwargs[k] = v
             if args.snapshot_every is not None:
                 from veles_tpu.models.standard_workflow import \
                     StandardWorkflow
@@ -280,26 +314,50 @@ class Main(object):
                         pass
                 prev_term = signal.signal(signal.SIGTERM, _on_sigterm)
             manhole = None
-            if args.manhole:
-                from veles_tpu.interaction import Manhole
-                manhole = Manhole(args.manhole,
-                                  scope={"wf": wf, "root": root,
-                                         "launcher": launcher}).start()
-            if self._pending_snapshot is not None:
-                wf.restore(self._pending_snapshot)
-            elif getattr(self, "_pending_warm_start", None) is not None:
-                # polymorphic like wf.restore — custom workflows can
-                # override their warm-start semantics
-                wf.warm_start(self._pending_warm_start)
             profiling = False
-            if args.profile:
-                import jax
-                jax.profiler.start_trace(args.profile)
-                profiling = True
+            # the try opens HERE, directly after the handler install, so
+            # a failure anywhere below (manhole bind, snapshot restore,
+            # warm start, profiler) still restores the previous SIGTERM
+            # disposition in the finally
             try:
+                if args.manhole:
+                    from veles_tpu.interaction import Manhole
+                    manhole = Manhole(args.manhole,
+                                      scope={"wf": wf, "root": root,
+                                             "launcher": launcher}).start()
+                if self._pending_snapshot is not None:
+                    wf.restore(self._pending_snapshot)
+                elif getattr(self, "_pending_warm_start", None) is not None:
+                    # polymorphic like wf.restore — custom workflows can
+                    # override their warm-start semantics
+                    wf.warm_start(self._pending_warm_start)
+                if args.profile:
+                    import jax
+                    jax.profiler.start_trace(args.profile)
+                    profiling = True
+                if args.interactive:
+                    # REPL mode: the scheduler runs in a background
+                    # thread so the prompt stays live with the workflow
+                    # mid-training (ref the reactor thread,
+                    # launcher.py:556-562).  Cleanup is DEFERRED to the
+                    # REPL exit in run() — the finally below must not
+                    # tear the session down under the user.
+                    thread = threading.Thread(
+                        name="VelesScheduler", target=launcher.run,
+                        daemon=True)
+                    self._interactive_session = {
+                        "launcher": launcher, "thread": thread,
+                        "manhole": manhole, "prev_term": prev_term,
+                        "profiling": profiling, "args": args}
+                    thread.start()
+                    return wf
                 if args.test:
-                    stats = wf.evaluate(
-                        use_ema=root.common.serve.get("use_ema", False))
+                    if root.common.serve.get("use_ema", False):
+                        stats = wf.evaluate(use_ema=True)
+                    else:
+                        # keep the zero-argument signature working for
+                        # custom workflow classes that predate use_ema
+                        stats = wf.evaluate()
                     print(json.dumps({"test": stats}, indent=2))
                 elif args.ensemble_test:
                     stats = self._ensemble_test(wf, args)
@@ -307,16 +365,19 @@ class Main(object):
                 else:
                     launcher.run()
             finally:
-                if prev_term is not None:
-                    import signal
-                    signal.signal(signal.SIGTERM, prev_term)
-                if profiling:
-                    import jax
-                    jax.profiler.stop_trace()
-                    print("profiler trace -> %s" % args.profile)
-                if manhole is not None:
-                    manhole.stop()
-                launcher.stop()
+                if getattr(self, "_interactive_session", None) is not None:
+                    pass  # deferred: _finish_interactive at REPL exit
+                else:
+                    if prev_term is not None:
+                        import signal
+                        signal.signal(signal.SIGTERM, prev_term)
+                    if profiling:
+                        import jax
+                        jax.profiler.stop_trace()
+                        print("profiler trace -> %s" % args.profile)
+                    if manhole is not None:
+                        manhole.stop()
+                    launcher.stop()
             if args.result_file:
                 wf.write_results(args.result_file)
             wf.print_stats()
@@ -324,6 +385,12 @@ class Main(object):
 
         wf_globals["run"](load, main)
         wf = self.workflow
+
+        if self._interactive_session is not None:
+            try:
+                self._repl(load, main)
+            finally:
+                self._finish_interactive()
 
         if wf is not None and getattr(wf, "preempted_", False):
             # 75 = EX_TEMPFAIL: "try again" — the deploy systemd/k8s
@@ -347,6 +414,109 @@ class Main(object):
         if args.serve is not None and wf is not None:
             self._serve(wf, args.serve)
         return 0
+
+    @staticmethod
+    def _get_interactive_locals():
+        """Workflow-construction kwargs harvested from an enclosing
+        IPython session, if any (ref veles/__main__.py:380-394: the
+        interactive Main feeds notebook locals into load()).  Empty dict
+        outside IPython."""
+        try:
+            from IPython.core.getipython import get_ipython
+        except ImportError:
+            return {}
+        shell = get_ipython()
+        if shell is None:
+            return {}
+        return {k: v for k, v in shell.user_ns.items()
+                if k[:1] != "_" and k not in
+                ("In", "Out", "exit", "quit", "get_ipython", "open")}
+
+    def _repl(self, load, main):
+        """The --interactive prompt: the scheduler thread is already
+        running; expose the live workflow and lifecycle helpers (ref
+        Main(interactive=True) + the background reactor thread,
+        veles/__main__.py:380-394, launcher.py:556-562)."""
+        session = self._interactive_session
+        wf = self.workflow
+
+        def stop():
+            """Stop the running workflow and join the scheduler."""
+            wf.stop()
+            session["thread"].join(timeout=60)
+            print("scheduler %s" % ("stopped" if not
+                  session["thread"].is_alive() else "STILL RUNNING"))
+
+        def status():
+            """One-line liveness + progress summary."""
+            alive = session["thread"].is_alive()
+            parts = ["scheduler=%s" % ("running" if alive else "done")]
+            loader = getattr(wf, "loader", None)
+            if loader is not None:
+                parts.append("epoch=%s" % getattr(
+                    loader, "epoch_number", "?"))
+            dec = getattr(wf, "decision", None)
+            if dec is not None and getattr(dec, "min_validation_error",
+                                           None) is not None:
+                parts.append("best_err=%s" % dec.min_validation_error)
+            print("  ".join(parts))
+            return alive
+
+        def weights(layer=None):
+            """Live parameter tree: whole dict, or one layer's params
+            as numpy (safe to call mid-training — jax arrays are
+            immutable snapshots)."""
+            import numpy as np
+            params = getattr(getattr(wf, "trainer", None), "params", {})
+            if layer is None:
+                return params
+            return {k: np.asarray(v) for k, v in params[layer].items()}
+
+        ns = {"wf": wf, "root": root, "load": load, "main": main,
+              "launcher": session["launcher"], "stop": stop,
+              "status": status, "weights": weights, "veles_main": self}
+        banner = ("veles_tpu interactive — training runs in a "
+                  "background thread.\n  wf        live workflow\n"
+                  "  status()  scheduler/epoch/best-error\n"
+                  "  weights('layer')  live params as numpy\n"
+                  "  stop()    stop training and join the scheduler\n"
+                  "  root      config tree     exit to leave")
+        import os
+        if os.environ.get("VELES_PLAIN_REPL"):
+            # deterministic prompt for drivers/tests and dumb terminals
+            import code
+            code.interact(banner=banner, local=ns)
+            return
+        try:
+            from IPython.terminal.embed import InteractiveShellEmbed
+            InteractiveShellEmbed(banner1=banner)(local_ns=ns)
+        except ImportError:
+            import code
+            code.interact(banner=banner, local=ns)
+
+    def _finish_interactive(self):
+        """Deferred cleanup from main()'s skipped finally: stop the
+        workflow, join the scheduler thread, restore the SIGTERM
+        disposition, close the profiler/manhole, stop services."""
+        session, self._interactive_session = self._interactive_session, None
+        wf = self.workflow
+        if wf is not None:
+            wf.stop()
+        session["thread"].join(timeout=60)
+        if session["prev_term"] is not None:
+            import signal
+            signal.signal(signal.SIGTERM, session["prev_term"])
+        if session["profiling"]:
+            import jax
+            jax.profiler.stop_trace()
+            print("profiler trace -> %s" % session["args"].profile)
+        if session["manhole"] is not None:
+            session["manhole"].stop()
+        session["launcher"].stop()
+        if wf is not None:
+            if session["args"].result_file:
+                wf.write_results(session["args"].result_file)
+            wf.print_stats()
 
     @staticmethod
     def _make_generator(wf, min_len=0):

@@ -102,6 +102,120 @@ def setup_logging(level=logging.INFO, filename=None):
         rootlog.addHandler(fh)
 
 
+class SqliteLogHandler(logging.Handler):
+    """Cross-run log duplication — the reference's MongoLogHandler
+    (ref veles/logger.py:292-331: every record lands in a queryable
+    store keyed by session + node, feeding the cross-run log browser)
+    redesigned for a TPU pod: stdlib sqlite in WAL mode instead of a
+    Mongo deployment, so one file on shared storage collects every
+    run's logs with zero extra services.  Query via :func:`search_logs`
+    / :func:`log_sessions`, the dashboard's ``/api/logs``, or plain
+    ``sqlite3``."""
+
+    def __init__(self, path, session=None, node=None,
+                 level=logging.NOTSET):
+        super(SqliteLogHandler, self).__init__(level)
+        import sqlite3
+        self.path = os.path.abspath(path)
+        self.session = session or time.strftime("run-%Y%m%d-%H%M%S")
+        self.node = node if node is not None else os.getpid()
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # one connection guarded by a lock: log records arrive from the
+        # scheduler, service threads, and signal-adjacent paths alike
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS logs ("
+                "session TEXT, node TEXT, ts REAL, level TEXT, "
+                "logger TEXT, pathname TEXT, lineno INTEGER, "
+                "message TEXT)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS logs_session_ts "
+                "ON logs (session, ts)")
+            self._conn.commit()
+
+    def emit(self, record):
+        try:
+            msg = record.getMessage()
+            if record.exc_info:
+                msg += "\n" + self.format(record).split(msg, 1)[-1]
+            with self._lock:
+                self._conn.execute(
+                    "INSERT INTO logs VALUES (?,?,?,?,?,?,?,?)",
+                    (self.session, str(self.node), record.created,
+                     record.levelname, record.name, record.pathname,
+                     record.lineno, msg))
+                self._conn.commit()
+        except Exception:   # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+    def close(self):
+        with self._lock:
+            try:
+                self._conn.commit()
+                self._conn.close()
+            except Exception:   # noqa: BLE001
+                pass
+        super(SqliteLogHandler, self).close()
+
+
+def duplicate_log_to(path, session=None, node=None):
+    """Attach a :class:`SqliteLogHandler` to the root logger (the
+    reference's ``--log-mongo`` duplication, redesigned onto sqlite).
+    Returns the handler; its ``.session`` is the run's browse key."""
+    handler = SqliteLogHandler(path, session=session, node=node)
+    logging.getLogger().addHandler(handler)
+    return handler
+
+
+def log_sessions(path):
+    """The cross-run index: [{session, node_count, records, first, last}]
+    newest first."""
+    import sqlite3
+    conn = sqlite3.connect(path)
+    try:
+        rows = conn.execute(
+            "SELECT session, COUNT(DISTINCT node), COUNT(*), "
+            "MIN(ts), MAX(ts) FROM logs GROUP BY session "
+            "ORDER BY MIN(ts) DESC").fetchall()
+    finally:
+        conn.close()
+    return [{"session": s, "node_count": n, "records": c,
+             "first": f, "last": l} for s, n, c, f, l in rows]
+
+
+def search_logs(path, session=None, q=None, level=None, limit=500):
+    """Search across runs: substring ``q`` on the message, optional
+    session/level filters, newest first (the reference log browser's
+    query surface, ref web_status log search)."""
+    import sqlite3
+    sql = ("SELECT session, node, ts, level, logger, pathname, lineno, "
+           "message FROM logs WHERE 1=1")
+    params = []
+    if session:
+        sql += " AND session = ?"
+        params.append(session)
+    if level:
+        sql += " AND level = ?"
+        params.append(level.upper())
+    if q:
+        sql += " AND message LIKE ?"
+        params.append("%" + q + "%")
+    sql += " ORDER BY ts DESC LIMIT ?"
+    params.append(int(limit))
+    conn = sqlite3.connect(path)
+    try:
+        rows = conn.execute(sql, params).fetchall()
+    finally:
+        conn.close()
+    keys = ("session", "node", "ts", "level", "logger", "pathname",
+            "lineno", "message")
+    return [dict(zip(keys, r)) for r in rows]
+
+
 class Logger(object):
     """Mixin giving every object a class-scoped logger (ref logger.py:59)."""
 

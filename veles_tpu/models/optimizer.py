@@ -27,9 +27,12 @@ Solvers, selectable per layer via ``solver``:
               column vector [m] instead of the full [n, m] matrix — so
               optimizer memory for the big matrices drops from 2x the
               params (adam m+v) to ~zero.  Momentum-free; the update is
-              RMS-clipped (``adafactor_clip``) instead of bias-corrected,
-              decay ``adafactor_decay``; weight decay decoupled like
-              adamw.  1-D leaves (biases, norms) fall back to adam.
+              RMS-clipped (``adafactor_clip``) instead of bias-corrected;
+              decay follows the paper's increasing schedule
+              β₂ₜ = 1 − t^−0.8 (``adafactor_decay_exponent``; set it to 0
+              to use the fixed ``adafactor_decay`` instead); weight decay
+              decoupled like adamw.  1-D leaves (biases, norms) fall back
+              to adam.
               State must be built by ``init_state(params, hypers=...)``
               so the factored slots get their shapes.
 - ``muon``    momentum orthogonalized by a Newton–Schulz iteration
@@ -70,6 +73,7 @@ DEFAULTS = {
     "muon_ns_steps": 5,
     "muon_nesterov": True,
     "adafactor_decay": 0.999,
+    "adafactor_decay_exponent": 0.8,
     "adafactor_clip": 1.0,
 }
 
@@ -195,7 +199,15 @@ def _update_leaf(solver, w, g, s1, s2, step, lr, wd, l1, moment, h,
                     "adafactor state has shape %s, expected (%d,) — "
                     "build it with init_state(params, hypers=...)"
                     % (s2.shape, rows + cols))
-            b2 = h["adafactor_decay"]
+            c = h["adafactor_decay_exponent"]
+            if c:
+                # Shazeer & Stern §7.2: increasing decay β₂ₜ = 1 − t^−c
+                # (c = 0.8).  Early steps weight fresh gradients heavily,
+                # which debiases the zero-initialized factored moments
+                # without Adam-style correction terms.
+                b2 = 1.0 - step.astype(jnp.float32) ** jnp.float32(-c)
+            else:
+                b2 = h["adafactor_decay"]     # fixed decay (exponent = 0)
             g2 = jnp.square(g.astype(jnp.float32)).reshape(rows, cols) \
                 + 1e-30
             r = b2 * s2[:rows] + (1.0 - b2) * jnp.mean(g2, axis=1)
@@ -330,6 +342,11 @@ def _apply(params, grads, state, hypers, lr_scale, clip_norm,
         new_s["ema"] = jax.tree_util.tree_map(
             lambda e, p: d * e + (1.0 - d) * p.astype(jnp.float32),
             state["ema"], new_p)
+    elif "ema" in state:
+        # decay off this call but the tree tracks an EMA slot: carry it
+        # unchanged so the returned pytree structure matches the input
+        # (a structure change would break a lax.scan carry / jit cache)
+        new_s["ema"] = state["ema"]
     return new_p, new_s
 
 
@@ -367,9 +384,6 @@ def update(params, grads, state, hypers, lr_scale=1.0, clip_norm=None,
         mean = jax.tree_util.tree_map(lambda g: g / grad_accum, gacc)
         new_p, new_s = _apply(params, mean, base, hypers, lr_scale,
                               clip_norm, ema_decay)
-        if "ema" in base and "ema" not in new_s:
-            # ema tracked in state but decay off this call: carry it
-            new_s["ema"] = base["ema"]
         new_s["gacc"] = jax.tree_util.tree_map(jnp.zeros_like, gacc)
         new_s["micro"] = micro
         return new_p, new_s

@@ -18,14 +18,46 @@ from veles_tpu.services import plotting
 
 class GraphicsServer(Logger):
     """PUB side.  ``endpoint="tcp://127.0.0.1:0"`` binds a random port
-    (read the resolved one from ``.endpoint``)."""
+    (read the resolved one from ``.endpoint``).
 
-    def __init__(self, endpoint="tcp://127.0.0.1:0", bus=None, **kwargs):
+    ``multicast="239.192.1.1"`` additionally binds an ``epgm://`` (PGM
+    over UDP multicast) endpoint per non-blacklisted network interface —
+    the reference's LAN plot broadcast (ref graphics_server.py:100-133;
+    same default group address, config.py:211).  Clients on the same
+    segment subscribe without knowing the publisher's host.  PGM support
+    is optional in libzmq builds, so every epgm bind failure degrades to
+    a warning; the tcp endpoint always works.  Resolved endpoints live
+    in ``.endpoints`` ({"tcp": ..., "epgm": [...]})."""
+
+    def __init__(self, endpoint="tcp://127.0.0.1:0", bus=None,
+                 multicast=None, multicast_port=None, ifaces=None,
+                 **kwargs):
         super(GraphicsServer, self).__init__(**kwargs)
+        from veles_tpu.config import root
         self.endpoint = endpoint
         self.bus = bus if bus is not None else plotting.bus
+        g = root.common.graphics
+        self.multicast = (multicast if multicast is not None
+                          else g.get("multicast_address", None))
+        self.multicast_port = int(multicast_port if multicast_port
+                                  is not None
+                                  else g.get("multicast_port", 5555))
+        self._ifaces = ifaces
+        self._blacklist = set(g.get("blacklisted_ifaces", ()))
+        self.endpoints = {"tcp": None, "epgm": []}
         self._sock = None
         self._ctx = None
+
+    def _multicast_ifaces(self):
+        if self._ifaces is not None:
+            return [i for i in self._ifaces if i not in self._blacklist]
+        import socket
+        try:
+            names = [name for _, name in socket.if_nameindex()]
+        except OSError:
+            return []
+        return [n for n in names
+                if n not in self._blacklist and n != "lo"]
 
     def start(self):
         import zmq
@@ -36,8 +68,21 @@ class GraphicsServer(Logger):
             self.endpoint = "%s:%d" % (self.endpoint[:-2], port)
         else:
             self._sock.bind(self.endpoint)
+        self.endpoints["tcp"] = self.endpoint
+        if self.multicast:
+            for iface in self._multicast_ifaces():
+                ep = "epgm://%s;%s:%d" % (iface, self.multicast,
+                                          self.multicast_port)
+                try:
+                    self._sock.bind(ep)
+                except zmq.ZMQError as e:
+                    # libzmq without --with-pgm, or a v6/virtual iface
+                    self.warning("epgm bind failed on %s: %s", ep, e)
+                else:
+                    self.endpoints["epgm"].append(ep)
         self.bus.subscribe(self.publish)
-        self.info("graphics server on %s", self.endpoint)
+        self.info("graphics server on %s", "; ".join(
+            [self.endpoint] + self.endpoints["epgm"]))
         return self
 
     def publish(self, payload):

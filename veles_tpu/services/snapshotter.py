@@ -71,6 +71,18 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         #: and dispatches the next training step: mismatched collectives,
         #: hung pod.  StandardWorkflow sets ``when = loader.epoch_ended``.
         self.when = kwargs.get("when")
+        #: multi-host preemption agreement cadence: the allgather in
+        #: ``_preempt_agreed`` is a blocking cross-host collective, and
+        #: paying it every cycle is measurable on fast training loops.
+        #: Cycle counts advance in lockstep across hosts (SPMD), so a
+        #: modulo gate is deterministic — every process skips and runs
+        #: the agreement on the same cycles, no divergent collectives.
+        #: Worst case adds (N-1) cycles of latency before a preemption
+        #: checkpoint, negligible against any real SIGTERM grace window.
+        self.preempt_agree_every = int(
+            kwargs.get("preempt_agree_every", 4)) or 1
+        self._agree_cycle = 0
+        self._preempt_latched = False
         self._writer = None
         if self.async_write:
             import atexit
@@ -103,9 +115,21 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
 
     def run(self):
         multihost = jax.process_count() > 1
-        # agreement FIRST, every cycle, before any per-process gate —
-        # see the ``when`` comment in __init__
-        preempt = self._preempt_agreed(multihost)
+        # agreement FIRST, before any per-process gate — see the ``when``
+        # comment in __init__.  Under multi-host the collective is
+        # amortized to every N-th cycle (lockstep counter, so all hosts
+        # agree on WHICH cycles run it); between agreement cycles the
+        # local flag is ignored on every host alike, and a positive
+        # agreement latches.  Single-host reads the local flag directly
+        # every cycle — there is no collective to amortize.
+        if not multihost:
+            preempt = self._preempt_agreed(False)
+        else:
+            if not self._preempt_latched and \
+                    self._agree_cycle % self.preempt_agree_every == 0:
+                self._preempt_latched = self._preempt_agreed(True)
+            self._agree_cycle += 1
+            preempt = self._preempt_latched
         due = True
         if self.when is not None:
             due = bool(self.when() if callable(self.when) else self.when)

@@ -10,6 +10,7 @@ capability surface, no external deps."""
 
 import json
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,7 +34,53 @@ td,th{border:1px solid #999;padding:4px 8px}
 <h3>event timeline <small>(<a href="/api/trace">chrome trace</a> —
 load in Perfetto / chrome://tracing)</small></h3>
 <div id="timeline"></div>
+<h3>device profiler <small>(jax.profiler window over the live process;
+<a href="/api/profile/trace">latest trace</a> — load in
+Perfetto)</small></h3>
+<div><button onclick="capProf()">capture 3s</button>
+<span id="prof"></span></div>
+<script>
+async function capProf(){
+ const r=await (await fetch('/api/profile',{method:'POST',
+  body:JSON.stringify({seconds:3})})).json();
+ document.getElementById('prof').textContent=JSON.stringify(r);
+ setTimeout(async()=>{
+  const s=await (await fetch('/api/profile')).json();
+  document.getElementById('prof').textContent=JSON.stringify(s);},4000);
+}
+</script>
 <h3>recent events</h3><div id="events"></div>
+<h3>log browser <small>(cross-run, needs --log-db)</small></h3>
+<div><input id="logq" placeholder="substring" size="24">
+<select id="logrun"><option value="">all runs</option></select>
+<button onclick="searchLogs()">search</button></div>
+<div id="logs"></div>
+<script>
+async function loadRuns(){
+ try{
+  const r=await (await fetch('/api/logruns')).json();
+  const sel=document.getElementById('logrun');
+  (r.runs||[]).forEach(x=>{const o=document.createElement('option');
+   o.value=x.session; o.textContent=x.session+' ('+x.records+')';
+   sel.appendChild(o);});
+ }catch(e){}
+}
+function esc(s){return String(s).replace(/&/g,'&amp;')
+ .replace(/</g,'&lt;').replace(/>/g,'&gt;');}
+async function searchLogs(){
+ const q=encodeURIComponent(document.getElementById('logq').value);
+ const s=encodeURIComponent(document.getElementById('logrun').value);
+ const r=await (await fetch('/api/logs?q='+q+'&session='+s)).json();
+ // esc(): log messages are data, never markup — a logged string
+ // containing tags must render inert, not execute (stored-XSS guard)
+ document.getElementById('logs').innerHTML = r.error ?
+  '<i>'+esc(r.error)+'</i>' :
+  '<pre>'+(r.logs||[]).map(x=>esc(new Date(x.ts*1000).toISOString()+' '+
+   x.session+' '+x.level[0]+' '+x.logger+': '+x.message)).join('\\n')+
+  '</pre>';
+}
+loadRuns();
+</script>
 <script>
 function sparkline(points){           // [[epoch, value], ...] -> SVG
  const w=120, h=28, vals=points.map(p=>p[1]);
@@ -156,6 +203,7 @@ class WebStatusServer(Logger):
         self._updates = []
         self._server = None
         self._thread = None
+        self._profile = {}
         self._lock = threading.Lock()
 
     def register(self, workflow):
@@ -241,6 +289,82 @@ class WebStatusServer(Logger):
             out.append(rec)
         return {"traceEvents": out, "displayTimeUnit": "ms"}
 
+    def profile_capture(self, seconds=3.0, outdir=None):
+        """On-demand ``jax.profiler`` window over the LIVE process —
+        the step timeline of where device time actually goes (TPU ops,
+        HBM transfers, host dispatch), captured from the dashboard
+        without restarting with ``--profile``.  The capture runs on a
+        background thread; whatever the training loop executes during
+        the window lands in the trace."""
+        from veles_tpu.config import root
+        with self._lock:
+            if self._profile.get("running"):
+                return {"error": "capture already running",
+                        "dir": self._profile.get("dir")}
+            d = outdir or os.path.join(
+                root.common.dirs.get("profiles", "profiles"),
+                time.strftime("web_%Y%m%d_%H%M%S"))
+            self._profile = {"running": True, "dir": d,
+                             "seconds": float(seconds)}
+
+        def capture():
+            import jax
+            try:
+                jax.profiler.start_trace(d)
+                time.sleep(float(seconds))
+                jax.profiler.stop_trace()
+                state = {"running": False, "dir": d,
+                         "done_at": time.time()}
+            except Exception as e:   # noqa: BLE001 — surface via GET
+                state = {"running": False, "dir": d, "error": str(e)}
+            with self._lock:
+                self._profile = state
+
+        threading.Thread(target=capture, daemon=True).start()
+        return {"ok": True, "dir": d, "seconds": float(seconds)}
+
+    def profile_trace(self):
+        """The latest capture's chrome-trace JSON bytes (the profiler's
+        ``*.trace.json.gz``, decompressed — loadable in Perfetto), or
+        None when nothing has been captured."""
+        import glob
+        import gzip
+        with self._lock:
+            d = self._profile.get("dir")
+            if not d or self._profile.get("running"):
+                return None
+        paths = sorted(glob.glob(os.path.join(
+            d, "plugins", "profile", "*", "*.trace.json.gz")))
+        if not paths:
+            return None
+        with gzip.open(paths[-1], "rb") as f:
+            return f.read()
+
+    def _log_db(self):
+        from veles_tpu.config import root
+        return root.common.web.get("log_db", None)
+
+    def log_runs(self):
+        """Cross-run session index from the sqlite log store (the
+        reference's historical log browser, ref web_status.py:113-200 +
+        the Mongo duplication it reads, logger.py:292-331)."""
+        db = self._log_db()
+        if not db or not os.path.exists(db):
+            return {"error": "no log db (run with --log-db PATH)",
+                    "runs": []}
+        from veles_tpu.logger import log_sessions
+        return {"runs": log_sessions(db)}
+
+    def log_search(self, session=None, q=None, level=None, limit=200):
+        """Search records across every run in the log store."""
+        db = self._log_db()
+        if not db or not os.path.exists(db):
+            return {"error": "no log db (run with --log-db PATH)",
+                    "logs": []}
+        from veles_tpu.logger import search_logs
+        return {"logs": search_logs(db, session=session, q=q,
+                                    level=level, limit=limit)}
+
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
         with self._lock:
@@ -285,6 +409,32 @@ class WebStatusServer(Logger):
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
                                                default=str).encode())
+                elif self.path == "/api/profile":
+                    with server._lock:
+                        state = dict(server._profile)
+                    self._send(200, json.dumps(state,
+                                               default=str).encode())
+                elif self.path == "/api/profile/trace":
+                    body = server.profile_trace()
+                    if body is None:
+                        self._send(404, b'{"error": "no capture yet"}')
+                    else:
+                        self._send(200, body)
+                elif self.path.startswith("/api/logruns"):
+                    self._send(200, json.dumps(
+                        server.log_runs(), default=str).encode())
+                elif self.path.startswith("/api/logs"):
+                    from urllib.parse import parse_qs, urlsplit
+                    qs = {k: v[0] for k, v in parse_qs(
+                        urlsplit(self.path).query).items()}
+                    try:
+                        limit = min(int(qs.get("limit", 200)), 10000)
+                    except ValueError:
+                        limit = 200
+                    self._send(200, json.dumps(server.log_search(
+                        session=qs.get("session"), q=qs.get("q"),
+                        level=qs.get("level"), limit=limit),
+                        default=str).encode())
                 elif self.path == "/frontend":
                     # the command-composer page, generated live from the
                     # CLI arg registry (ref --frontend, launcher.py:199-267)
@@ -295,6 +445,25 @@ class WebStatusServer(Logger):
                     self.send_error(404)
 
             def do_POST(self):
+                if self.path == "/api/profile":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                    except ValueError:
+                        req = {}
+                    if not isinstance(req, dict):
+                        req = {}
+                    try:
+                        seconds = float(req.get("seconds", 3.0))
+                    except (TypeError, ValueError):
+                        self._send(400, b'{"error": "bad seconds"}')
+                        return
+                    # bound the window: the capture slot is singular and
+                    # profiler overhead rides the live training loop
+                    out = server.profile_capture(
+                        seconds=min(max(seconds, 0.1), 60.0))
+                    self._send(200, json.dumps(out).encode())
+                    return
                 # remote master update (ref web_status '/update' POST)
                 if self.path != "/update":
                     self.send_error(404)
