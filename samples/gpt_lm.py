@@ -84,7 +84,10 @@ def run(load, main):
              dropout=cfg.get("dropout", 0.0),
              impl=cfg.get("attention", "flash"),
              pos="rope",
-             remat=bool(cfg.get("remat", False)),
+             # pass through verbatim: "dots" selects the selective
+             # dots_saveable policy — bool() would silently turn it
+             # into full remat
+             remat=cfg.get("remat", False),
              n_experts=cfg.get("n_experts", 0),
              tie_embeddings=bool(cfg.get("tie_embeddings", True)),
              window=cfg.get("window", None),

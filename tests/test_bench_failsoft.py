@@ -62,6 +62,39 @@ def test_emits_one_json_line_when_budget_exhausted(tmp_path):
     assert out["error"] and "probe" in out["error"]
 
 
+def test_lm_large_oom_ladder(monkeypatch):
+    """The lm_large phase walks its MFU ladder — selective remat
+    ("dots") at batch 16 first, full remat, then batch 8 — stepping
+    down only on OOM and raising anything else."""
+    sys.path.insert(0, REPO)
+    import bench
+    calls = []
+
+    def fake_run_lm(tag, zoo_kwargs, batch, seq, steps,
+                    steps_per_dispatch, vocab):
+        calls.append((zoo_kwargs["remat"], batch))
+        if len(calls) < 3:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return {"tokens_per_sec": 1.0, "ms_per_step": 1.0, "mfu": 0.5,
+                "n_params": 124, "peak_bf16_tflops": 197.0}
+
+    monkeypatch.setattr(bench, "_run_lm", fake_run_lm)
+    out = bench.phase_lm_large()
+    assert calls == [("dots", 16), (True, 16), (True, 8)]
+    assert out["batch"] == 8 and out["remat"] == "True"
+    # a non-OOM failure at the first rung must propagate, not step down
+    calls.clear()
+
+    def fake_boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("Mosaic lowering failed")
+
+    monkeypatch.setattr(bench, "_run_lm", fake_boom)
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        bench.phase_lm_large()
+    assert len(calls) == 1
+
+
 @pytest.mark.slow
 def test_serve_phase_runs_on_cpu(monkeypatch):
     """CPU CI gate for the serve phase (f32/bf16/int8 decode timing):

@@ -117,6 +117,19 @@ def test_remat_matches_no_remat():
                                                 atol=1e-5), pa, pb)
 
 
+def test_remat_dots_policy_matches_no_remat():
+    """remat="dots" (dots_saveable: keep matmul outputs, recompute only
+    elementwise) must match plain training — same math, fewer saved
+    activations, none of full remat's recompute FLOPs."""
+    wf_a = _train_lm(max_epochs=4)
+    wf_b = _train_lm(max_epochs=4, remat="dots")
+    import jax
+    pa, pb = wf_a.trainer.host_params(), wf_b.trainer.host_params()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-3,
+                                                atol=1e-5), pa, pb)
+
+
 def test_remat_with_moe_aux_loss():
     """The MoE router aux loss must survive the remat boundary (it is
     returned through jax.checkpoint, not stashed as a side effect)."""

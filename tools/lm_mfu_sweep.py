@@ -30,6 +30,11 @@ def main():
                     "per spd)")
     ap.add_argument("--spd", default="1,4,8,16",
                     help="comma list of steps_per_dispatch values")
+    ap.add_argument("--remat", default="dots",
+                    choices=("dots", "true", "false"),
+                    help="remat mode (dots = dots_saveable selective "
+                    "remat — no recompute FLOPs burned, the "
+                    "MFU-preserving default)")
     ap.add_argument("--steps", type=int, default=8,
                     help="timed host-loop iterations per config")
     ap.add_argument("--seq", type=int, default=1024)
@@ -37,13 +42,15 @@ def main():
 
     import bench
 
+    remat = {"dots": "dots", "true": True, "false": False}[
+        args.remat.lower()]
     cfg = dict(d_model=768, n_heads=12, n_layers=12, dropout=0.0,
                impl="flash", pos="rope", solver="adamw", lr=6e-4,
-               remat=True, tie_embeddings=True)
+               remat=remat, tie_embeddings=True)
     rows = []
     for spd in [int(s) for s in args.spd.split(",")]:
         for batch in [int(b) for b in args.batch.split(",")]:
-            tag = "lm-124M[b%d,spd%d]" % (batch, spd)
+            tag = "lm-124M[b%d,spd%d,remat=%s]" % (batch, spd, remat)
             t0 = time.monotonic()
             try:
                 r = bench._run_lm(tag, cfg, batch=batch, seq=args.seq,
@@ -54,7 +61,7 @@ def main():
                     print("%-22s OOM" % tag, flush=True)
                     continue
                 raise
-            rows.append(dict(r, batch=batch, spd=spd,
+            rows.append(dict(r, batch=batch, spd=spd, remat=str(remat),
                              wall_s=round(time.monotonic() - t0, 1)))
             print("%-22s %8.0f tok/s  %5.1f ms/step  MFU %5.1f%%"
                   % (tag, r["tokens_per_sec"], r["ms_per_step"],

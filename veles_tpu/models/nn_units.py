@@ -206,13 +206,25 @@ class StagedTrainer(Unit):
                 # pass (jax.checkpoint) — memory for FLOPs, the standard
                 # long-context trade.  Aux values (MoE router loss) must
                 # cross the remat boundary as outputs, not side effects.
+                #
+                # remat=True recomputes EVERYTHING (max memory savings,
+                # but the recompute FLOPs don't count toward MFU);
+                # remat="dots" keeps matmul outputs and recomputes only
+                # the cheap elementwise ops (jax dots_saveable policy) —
+                # near-no-remat step time at a fraction of the activation
+                # memory, usually the right default for MXU-bound
+                # transformer training.
+                policy = (jax.checkpoint_policies.dots_saveable
+                          if layer.cfg.get("remat") == "dots" else None)
+
                 def fn(p, xx, kk, layer=layer):
                     y = layer.apply(p, xx, train=True, key=kk)
                     return y, getattr(layer, "last_aux", None)
                 # prevent_cse=False: we are always under jit (and often
                 # inside the fused sweep's lax.scan), where the CSE
                 # barriers the default inserts only cost fusion
-                x, aux = jax.checkpoint(fn, prevent_cse=False)(
+                x, aux = jax.checkpoint(fn, prevent_cse=False,
+                                        policy=policy)(
                     params.get(layer.name), x, lkey)
                 if aux is not None:
                     layer.last_aux = aux

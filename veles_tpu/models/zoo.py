@@ -112,7 +112,9 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
     """Decoder-only causal LM over int token samples [T].
     ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
     rematerializes each block's activations in the backward pass
-    (jax.checkpoint — long-context memory for FLOPs); ``pos`` =
+    (jax.checkpoint — long-context memory for FLOPs), ``remat="dots"``
+    keeps matmul outputs and recomputes only elementwise ops
+    (dots_saveable — near-no-remat step time, far less memory); ``pos`` =
     "learned" | "sinusoid" position table, or "rope" (rotary q/k in
     every block, no table — extrapolates past the train length);
     ``tie_embeddings`` reuses the embedding table as the LM head
