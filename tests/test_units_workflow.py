@@ -262,3 +262,48 @@ class TestWorkflowChecksum:
             return wf.checksum()
 
         assert digest("x = 1") != digest("x = 2")
+
+
+class TestTimingsAndStats:
+    def test_per_call_timings_flag(self, caplog):
+        """timings=True (or root.common.timings) prints per-call
+        durations (ref units.py:144-149)."""
+        import logging
+
+        from veles_tpu.config import root
+        from veles_tpu.units import TrivialUnit
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="tw")
+        u = TrivialUnit(wf, name="timed", timings=True)
+        with caplog.at_level(logging.DEBUG, logger="TrivialUnit"):
+            u._run_wrapped()
+        assert any("run #1" in r.getMessage()
+                   for r in caplog.records)
+        # global config default reaches new units
+        root.common.timings = True
+        try:
+            assert TrivialUnit(wf, name="t2").timings
+        finally:
+            root.common.timings = False
+        assert not TrivialUnit(wf, name="t3").timings
+
+    def test_print_stats_reports_efficiency_and_rss(self, caplog):
+        """print_stats: top-N table + scheduler efficiency η + peak RSS
+        (ref workflow.py:763-821, __main__.py:791-797)."""
+        import logging
+
+        from veles_tpu.plumbing import Repeater
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="sw")
+        rpt = Repeater(wf)
+        rpt.link_from(wf.start_point)
+        wf.end_point.link_from(rpt)
+        wf.initialize()
+        wf.run()
+        with caplog.at_level(logging.INFO, logger="Workflow"):
+            wf.print_stats()
+        text = " ".join(r.getMessage() for r in caplog.records)
+        assert "peak RSS" in text and "η" in text
+        import re
+        m = re.search(r"peak RSS ([0-9.]+) MiB", text)
+        assert m and float(m.group(1)) > 10.0   # a real process RSS

@@ -19,6 +19,18 @@ from veles_tpu.config import root
 from veles_tpu.logger import setup_logging
 
 
+def _death_probability(value):
+    """argparse type for --death-probability: [0, 1).  P >= 1 would
+    crash before the first unit ever runs — the supervisor drill would
+    spin forever with zero progress; negative P silently disables it."""
+    p = float(value)
+    if not 0.0 <= p < 1.0:
+        raise argparse.ArgumentTypeError(
+            "death probability must be in [0, 1) — P >= 1 dies before "
+            "any unit runs, so a restarting supervisor never progresses")
+    return p
+
+
 class Main(object):
     def __init__(self, argv=None):
         self.argv = argv if argv is not None else sys.argv[1:]
@@ -175,6 +187,13 @@ class Main(object):
                        "amortizes host-to-device dispatch latency for "
                        "small models and remote TPUs; numerically "
                        "identical to per-step execution")
+        p.add_argument("--death-probability", type=_death_probability,
+                       default=0.0, metavar="P",
+                       help="fault injection: per-unit-run probability "
+                       "of a sudden checkpoint-less process crash "
+                       "(exit 1) — drills the checkpoint-restart "
+                       "elasticity path under a restarting supervisor "
+                       "(ref --slave-death-probability)")
         p.add_argument("--sync-run", action="store_true",
                        help="block on the device after every trainer step "
                        "for honest per-unit timing (ref --sync-run, "
@@ -291,6 +310,8 @@ class Main(object):
 
         def main(**kwargs):
             wf = self.workflow
+            if args.death_probability:
+                wf.death_probability = args.death_probability
             launcher = self._make_launcher(args, wf)
             launcher.initialize(**kwargs)
             # graceful preemption: TPU schedulers deliver SIGTERM with a

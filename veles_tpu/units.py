@@ -42,6 +42,13 @@ class Unit(Logger, metaclass=UnitRegistry):
         self._initialized = False
         self.run_count = 0
         self.run_time = 0.0
+        #: per-call duration prints: per-unit ``timings=True`` kwarg or
+        #: the global ``root.common.timings`` (ref units.py:144-149)
+        if "timings" in kwargs:
+            self.timings = bool(kwargs["timings"])
+        else:
+            from veles_tpu.config import root
+            self.timings = bool(root.common.get("timings", False))
         self.view_group = kwargs.get("view_group", "PLUMBING")
         self.workflow = workflow
         if workflow is not None:
@@ -166,6 +173,10 @@ class Unit(Logger, metaclass=UnitRegistry):
         dt = time.perf_counter() - t0
         self.run_count += 1
         self.run_time += dt
+        if self.timings:
+            # per-call duration print (ref units.py:144-149: per-unit
+            # timings=True kwarg or the global root.common.timings)
+            self.debug("run #%d: %.3f ms", self.run_count, dt * 1e3)
         return dt
 
 

@@ -48,6 +48,16 @@ class Workflow(Container):
         #: identical command and --snapshot auto resumes.
         self.preempt_requested = Bool(False)
         self.preempted_ = False
+        #: fault injection (ref --slave-death-probability,
+        #: client.py:303-307: randomly crash to prove the recovery
+        #: path).  Per UNIT RUN probability of a sudden, checkpoint-less
+        #: process death (os._exit(1)) — pair with --snapshot-every /
+        #: --snapshot auto and a restarting supervisor to drill
+        #: checkpoint-restart elasticity end to end.  Uses stdlib
+        #: random, NOT the framework PRNG streams, so injection never
+        #: perturbs training reproducibility.
+        self.death_probability = float(
+            kwargs.get("death_probability", 0.0))
 
     # --------------------------------------------------------------- container
     def add_ref(self, unit):
@@ -153,6 +163,14 @@ class Workflow(Container):
                     break
             unit = queue.popleft()
             queued.discard(unit)
+            if self.death_probability:
+                import os
+                import random
+                if random.random() < self.death_probability:
+                    self.warning("fault injection: simulated crash "
+                                 "(death_probability=%.3f)",
+                                 self.death_probability)
+                    os._exit(1)
             if bool(unit.gate_block):
                 unit.reset_gate()
                 continue
@@ -215,13 +233,25 @@ class Workflow(Container):
 
     # ------------------------------------------------------------------ stats
     def print_stats(self, top=5):
-        """Top-N unit run-time table + scheduler efficiency
-        (ref workflow.py:763-821)."""
+        """Top-N unit run-time table + scheduler efficiency η
+        (unit-time / wall) + peak RSS (ref workflow.py:763-821 and the
+        exit-time RSS report, ref __main__.py:791-797)."""
         rows = sorted(((u.run_time, u.run_count, u.name) for u in self._units),
                       reverse=True)[:top]
         total = sum(u.run_time for u in self._units)
-        self.info("---- unit run-time stats (total %.3fs, wall %.3fs) ----",
-                  total, self._run_time_)
+        try:
+            import resource
+            import sys as _sys
+            # ru_maxrss: KiB on linux, BYTES on darwin
+            div = 1024.0 * 1024.0 if _sys.platform == "darwin" else 1024.0
+            rss_mib = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / div
+        except (ImportError, ValueError):
+            rss_mib = 0.0
+        self.info("---- unit run-time stats (total %.3fs, wall %.3fs, "
+                  "η %.2f, peak RSS %.1f MiB) ----",
+                  total, self._run_time_,
+                  total / max(self._run_time_, 1e-9), rss_mib)
         for rt, rc, name in rows:
             if rc:
                 self.info("%-30s %8d runs %10.3fs (%6.2f%%)",
