@@ -28,6 +28,9 @@ def main():
     # checkpoint gathers ZeRO-3 shards instead.
     rest = sys.argv[4:]
     fsdp = "--fsdp" in rest
+    # --orbax: the sharded backend — save is the collective, every
+    # process writes its own shards (all_processes_export)
+    orbax = "--orbax" in rest
     seq = "--seq" in rest       # ring attention ACROSS processes
     # --preempt: ONLY process 0 raises the preemption flag mid-run (the
     # staggered-SIGTERM race); the snapshotter's per-cycle agreement
@@ -89,6 +92,8 @@ def main():
             decision_cfg = {"max_epochs": 2}
             snap_cfg = (None if snap_dir is None else
                         {"interval": 1, "directory": snap_dir})
+            if orbax and snap_cfg is not None:
+                snap_cfg["name"] = "orbax"
         wf = StandardWorkflow(
             layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
                      "learning_rate": 0.1},

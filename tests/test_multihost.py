@@ -112,6 +112,28 @@ def test_multihost_tensor_parallel_checkpoint(tmp_path):
     assert w.shape == (64, 32)     # full tensor, not a local shard
 
 
+def test_multihost_orbax_sharded_checkpoint(tmp_path):
+    """The orbax backend under a REAL 2-process job: the save is the
+    collective (all_processes_export) — both processes enter it, each
+    writes its own cross-process shards, and the resulting directory
+    imports to the full unsharded tensors."""
+    from veles_tpu.services.snapshotter import SnapshotterBase
+
+    snap_dir = str(tmp_path / "snaps")
+    r0, r1 = _spawn_job(2, extra=(snap_dir, "--orbax"))
+    assert r0["weights_addressable"] is False   # sharded across procs
+    assert r0["loss"] == r1["loss"]
+    # BOTH processes report the checkpoint (both entered the save)
+    assert r0["snapshot"] and r0["snapshot"].endswith(".orbax")
+    assert r1["snapshot"] and r1["snapshot"].endswith(".orbax")
+    snap = SnapshotterBase.import_(
+        os.path.join(snap_dir, "multihost-digits_current"))
+    assert snap["epoch"] == 2
+    import numpy as np
+    w = np.asarray(snap["params"]["l00_all2all_tanh"]["weights"])
+    assert w.shape == (64, 32) and np.isfinite(w).all()
+
+
 def test_multihost_fsdp_shards_params_and_checkpoints(tmp_path):
     """ZeRO-3 over a cross-process data axis: each process holds only its
     1/8 parameter shards (not fully addressable), metrics still match,

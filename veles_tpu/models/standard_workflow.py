@@ -82,8 +82,13 @@ class StandardWorkflow(Workflow):
             self.lr_adjuster = None
         if snapshotter_config is not None:
             cfg = dict(snapshotter_config)
-            kind = cfg.pop("name", None)
-            if kind is not None:   # registry routing like the loader dict
+            # registry routing like the loader dict; the config-tree
+            # default makes the backend CLI-selectable, e.g.
+            # --config-list "root.common.snapshot.backend='orbax'"
+            from veles_tpu.config import root as _root
+            kind = cfg.pop("name",
+                           _root.common.snapshot.get("backend", None))
+            if kind is not None:
                 from veles_tpu.services.snapshotter import SnapshotterBase
                 snap_cls = SnapshotterBase.mapping[kind]
             else:
