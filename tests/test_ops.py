@@ -179,6 +179,44 @@ class TestLRN:
         np.testing.assert_allclose(got[0, 0, 0], want, rtol=1e-5)
 
 
+class TestGroupNorm:
+    def test_per_sample_group_statistics(self):
+        """Each (sample, group) slab normalizes to mean 0 / var 1 over
+        its spatial+intra-group elements — and samples are independent
+        (batch-size invariance, GN's defining property vs batch norm)."""
+        from veles_tpu.ops import norm
+        x = RNG.normal(size=(3, 4, 4, 8)).astype(np.float32) * 5 + 2
+        y = np.asarray(norm.group_norm(jnp.array(x), groups=2))
+        g = y.reshape(3, 4, 4, 2, 4)
+        m = g.mean(axis=(1, 2, 4))
+        v = g.var(axis=(1, 2, 4))
+        np.testing.assert_allclose(m, np.zeros((3, 2)), atol=1e-5)
+        np.testing.assert_allclose(v, np.ones((3, 2)), atol=1e-3)
+        # batch independence: sample 0 normalized alone is identical
+        y0 = np.asarray(norm.group_norm(jnp.array(x[:1]), groups=2))
+        np.testing.assert_allclose(y0[0], y[0], rtol=1e-5)
+
+    def test_groups_degrade_to_divisor_and_affine_applies(self):
+        from veles_tpu.ops import norm
+        x = RNG.normal(size=(2, 6)).astype(np.float32)   # C=6, 32→6
+        gamma = np.full(6, 2.0, np.float32)
+        beta = np.full(6, 1.0, np.float32)
+        y = np.asarray(norm.group_norm(jnp.array(x), jnp.array(gamma),
+                                       jnp.array(beta), groups=32))
+        base = np.asarray(norm.group_norm(jnp.array(x), groups=6))
+        np.testing.assert_allclose(y, base * 2.0 + 1.0, rtol=1e-5)
+
+    def test_group1_equals_layer_norm_over_sample(self):
+        from veles_tpu.ops import norm
+        x = RNG.normal(size=(2, 3, 3, 4)).astype(np.float32)
+        y = np.asarray(norm.group_norm(jnp.array(x), groups=1))
+        flat = x.reshape(2, -1)
+        want = ((flat - flat.mean(1, keepdims=True))
+                / np.sqrt(flat.var(1, keepdims=True) + 1e-5)).reshape(
+                    x.shape)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
 class TestDropout:
     def test_train_scales_and_zeroes(self):
         x = jnp.ones((1000,))

@@ -273,6 +273,34 @@ class TestConvWorkflow:
         assert wf.decision.best_metric < 0.055, wf.decision.best_metric
 
 
+class TestGroupNormConv:
+    def test_modern_conv_stack_with_group_norm_trains(self):
+        """conv → group_norm → pool → softmax (the post-LRN conv recipe;
+        GroupNorm layer is beyond the reference's registry): trains to
+        the same gate as the plain conv proxy."""
+        prng.seed_all(13)
+        x, y = digits_data()
+        x_img = x.reshape(-1, 8, 8, 1)
+        loader = FullBatchLoader(
+            None, data=x_img, labels=y, minibatch_size=100,
+            class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[
+                {"type": "conv_strict_relu", "n_kernels": 8, "kx": 3,
+                 "ky": 3, "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "group_norm", "groups": 4,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+            ],
+            loader=loader, decision_config={"max_epochs": 25},
+            name="digits-gn-conv")
+        wf.initialize()
+        wf.run()
+        assert wf.decision.best_metric < 0.055, wf.decision.best_metric
+
+
 class TestConvAutoencoder:
     def test_conv_autoencoder_reduces_rmse(self):
         from veles_tpu.models.zoo import conv_autoencoder

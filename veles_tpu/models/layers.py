@@ -397,6 +397,28 @@ class LayerNorm(Layer):
         return norm.layer_norm(x, params["gamma"], params["beta"])
 
 
+class GroupNorm(Layer):
+    """Group normalization (Wu & He 2018) over the channel axis —
+    batch-size independent, no running statistics, so it fits the
+    stateless functional layer contract where batch norm's mutable
+    running mean/var cannot.  The modern conv-stack normalizer
+    (capability beyond the reference's LRN-era registry).  The
+    effective group count is the largest divisor of C <= ``groups``
+    (default 32)."""
+
+    TYPES = ("group_norm",)
+    has_params = True
+
+    def init_params(self, rng):
+        from veles_tpu.ops import norm
+        return norm.layer_norm_init((self.input_shape[-1],))
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import norm
+        return norm.group_norm(x, params["gamma"], params["beta"],
+                               groups=self.cfg.get("groups", 32))
+
+
 class Embedding(Layer):
     """Token embedding: int ids [T] → [T, d_model]."""
 
@@ -887,6 +909,7 @@ LAYER_TYPES = {}
 for _cls in (All2All, ResizableAll2All, Conv, Deconv, Pooling, Depooling,
              StochasticPoolDepool, ChannelSplitter, ChannelMerger, LRN,
              Dropout, Activation, Cutter, LSTM, ZeroFiller, LayerNorm,
+             GroupNorm,
              Embedding, PositionalEncoding, MultiHeadAttention, MoE,
              TransformerBlock, PipelinedTransformer, TimestepDense,
              TiedLMHead, SeqPool):

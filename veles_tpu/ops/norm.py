@@ -35,6 +35,43 @@ def layer_norm_init(shape):
             "beta": jnp.zeros(shape, jnp.float32)}
 
 
+def group_norm(x, gamma=None, beta=None, groups=32, eps=1e-5):
+    """Group normalization over BATCHED input ``[B, ..., C]`` (axis 0 is
+    always the batch; pass ``x[None]`` for a single sample): channels
+    split into groups; mean/var reduce per (sample, group) over the
+    spatial dims and intra-group channels.  Batch-size independent
+    (identical train/eval behavior) — the modern conv-net normalizer
+    that needs no running statistics, so it slots into the stateless
+    functional layer contract where batch norm's mutable running
+    mean/var cannot.
+
+    The effective group count is the largest divisor of C that is
+    <= ``groups`` (channels must split evenly; e.g. C=48, groups=32
+    → 24 groups)."""
+    if x.ndim < 2:
+        raise ValueError(
+            "group_norm expects batched input [B, ..., C]; got rank %d"
+            % x.ndim)
+    c = x.shape[-1]
+    g = max(1, min(int(groups), c))
+    while c % g:        # largest divisor of C that is <= groups
+        g -= 1
+    xf = x.astype(jnp.float32)
+    xg = xf.reshape(x.shape[:-1] + (g, c // g))
+    # axis 0 is the batch; per sample, reduce EVERY dim (spatial + the
+    # intra-group channels) except the group axis itself
+    red = tuple(i for i in range(1, xg.ndim) if i != xg.ndim - 2)
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    y = ((xg - mean) * jnp.reciprocal(jnp.sqrt(var + eps))).reshape(
+        x.shape)
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    if beta is not None:
+        y = y + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
 def batch_norm(x, mean, var, gamma, beta, eps=1e-5):
     """Inference-mode batch norm with running statistics."""
     xf = x.astype(jnp.float32)
