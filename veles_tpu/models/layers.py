@@ -419,6 +419,71 @@ class GroupNorm(Layer):
                                groups=self.cfg.get("groups", 32))
 
 
+class ConvResidualBlock(Layer):
+    """Pre-activation residual conv block (He et al. 2016 "identity
+    mappings" v2, with GroupNorm standing in for batch norm so the
+    block stays stateless): gn→relu→conv3×3 → gn→relu→conv3×3, added
+    to the skip path.  ``n_kernels`` sets the output channels (default:
+    keep input channels); ``sliding`` strides the FIRST conv, and a
+    stride or channel change routes the skip through a 1×1 projection.
+    Composite like TransformerBlock — residual conv families (ResNet)
+    are capability beyond the reference's 2015-era registry."""
+
+    TYPES = ("conv_residual_block",)
+    has_params = True
+
+    def _infer(self, input_shape):
+        h, w, c = input_shape
+        self.n_kernels = int(self.cfg.get("n_kernels", c))
+        self.stride = tuple(self.cfg.get("sliding", (1, 1)))
+        # same default as the standalone group_norm layer; the op
+        # degrades to the largest divisor of C automatically
+        self.groups = int(self.cfg.get("groups", 32))
+        self.n_channels = c
+        sy, sx = self.stride
+        # both convs are 3x3 SAME (padding 1); only the first strides
+        ho = (h + 2 - 3) // sy + 1
+        wo = (w + 2 - 3) // sx + 1
+        self.needs_proj = self.stride != (1, 1) or self.n_kernels != c
+        return (ho, wo, self.n_kernels)
+
+    def init_params(self, rng):
+        from veles_tpu.ops import norm
+        c, k = self.n_channels, self.n_kernels
+        params = {
+            "gn1": norm.layer_norm_init((c,)),
+            "conv1": conv.init_params(rng, 3, 3, c, k,
+                                      dtype=self.policy.param),
+            "gn2": norm.layer_norm_init((k,)),
+            "conv2": conv.init_params(rng, 3, 3, k, k,
+                                      dtype=self.policy.param),
+        }
+        if self.needs_proj:
+            params["proj"] = conv.init_params(
+                rng, 1, 1, c, k, bias=False, dtype=self.policy.param)
+        return params
+
+    def apply(self, params, x, train=False, key=None):
+        from veles_tpu.ops import activations, norm
+        relu = activations.ACTIVATIONS["strict_relu"]
+        h = relu(norm.group_norm(x, params["gn1"]["gamma"],
+                                 params["gn1"]["beta"],
+                                 groups=self.groups))
+        h = conv.forward(params["conv1"], h, self.stride, (1, 1, 1, 1),
+                         self.policy)
+        h = relu(norm.group_norm(h, params["gn2"]["gamma"],
+                                 params["gn2"]["beta"],
+                                 groups=self.groups))
+        h = conv.forward(params["conv2"], h, (1, 1), (1, 1, 1, 1),
+                         self.policy)
+        skip = x
+        if self.needs_proj:
+            # 1x1 strided projection aligns shape AND resolution
+            skip = conv.forward(params["proj"], x, self.stride,
+                                (0, 0, 0, 0), self.policy)
+        return h + skip
+
+
 class Embedding(Layer):
     """Token embedding: int ids [T] → [T, d_model]."""
 
@@ -909,7 +974,7 @@ LAYER_TYPES = {}
 for _cls in (All2All, ResizableAll2All, Conv, Deconv, Pooling, Depooling,
              StochasticPoolDepool, ChannelSplitter, ChannelMerger, LRN,
              Dropout, Activation, Cutter, LSTM, ZeroFiller, LayerNorm,
-             GroupNorm,
+             GroupNorm, ConvResidualBlock,
              Embedding, PositionalEncoding, MultiHeadAttention, MoE,
              TransformerBlock, PipelinedTransformer, TimestepDense,
              TiedLMHead, SeqPool):

@@ -15,6 +15,38 @@ def mnist_mlp(hidden=100, lr=0.03, moment=0.9):
     ]
 
 
+def resnet_gn(n_classes=10, width=16, blocks_per_stage=2, stages=3,
+              pool=8, lr=0.05, moment=0.9, wd=1e-4):
+    """Small pre-activation ResNet with GroupNorm (He et al. v2 blocks
+    via the conv_residual_block composite; residual conv families are
+    beyond the reference's 2015-era registry).  Defaults fit 32×32
+    inputs: stem conv, ``stages`` stages of ``blocks_per_stage`` blocks
+    (channel double + stride-2 transition between stages), global
+    ``pool``×``pool`` average pool, softmax head."""
+    gd = {"learning_rate": lr, "gradient_moment": moment,
+          "weights_decay": wd}
+    layers = [dict({"type": "conv", "n_kernels": width, "kx": 3,
+                    "ky": 3, "padding": (1, 1, 1, 1)}, **gd)]
+    ch = width
+    for stage in range(stages):
+        for b in range(blocks_per_stage):
+            cfg = {"type": "conv_residual_block", "n_kernels": ch}
+            if stage > 0 and b == 0:
+                cfg["sliding"] = (2, 2)     # transition: downsample
+            layers.append(dict(cfg, **gd))
+        ch *= 2
+    layers += [
+        # He v2: pre-activation blocks emit a raw residual sum — one
+        # final norm+relu bounds the feature scale before the head
+        dict({"type": "group_norm"}, **gd),
+        {"type": "activation_strict_relu"},
+        {"type": "avg_pooling", "kx": pool, "ky": pool},
+        dict({"type": "softmax", "output_sample_shape": n_classes},
+             **gd),
+    ]
+    return layers
+
+
 def cifar_conv(lr=0.001, moment=0.9, wd=0.004):
     """cifar_caffe-style quick net for 32×32×3 inputs
     (ref manualrst_veles_algorithms.rst:45-52: 17.21% validation error)."""
