@@ -77,18 +77,111 @@ struct Unit {
   Act act = Act::kLinear;
   NpyArray weights, bias;
   bool has_weights = false, has_bias = false;
+  // composite layers (conv_residual_block) and norm affines keep their
+  // arrays by semantic name ("gn1/gamma"); int8 scales already folded
+  std::map<std::string, NpyArray> extra;
   // layer-specific config
   int kx = 0, ky = 0, sx = 1, sy = 1;
   int pad_t = 0, pad_l = 0, pad_b = 0, pad_r = 0;
   float alpha = 1e-4f, beta = 0.75f, knorm = 2.0f;
   int nwin = 15;
   int off_y = 0, off_x = 0;
+  int groups = 32;
+  // composite scratch, reused across calls (resize is a no-op at
+  // steady batch — no per-inference heap churn).  Same thread-safety
+  // contract as the workflow's shared arena: one infer at a time.
+  mutable std::vector<float> scratch_[4];
 
   void Execute(const float* x, float* y, int batch) const;
 };
 
 static bool StartsWith(const std::string& s, const char* pre) {
   return s.rfind(pre, 0) == 0;
+}
+
+// keep in sync with the branches of Unit::Execute — the loader rejects
+// anything else AT LOAD TIME so "unsupported type" surfaces with the
+// type name, not as a generic failure at first inference
+static bool TypeSupported(const std::string& t) {
+  return StartsWith(t, "all2all") || t == "softmax" ||
+         t == "conv_residual_block" || t == "group_norm" ||
+         StartsWith(t, "conv") || StartsWith(t, "deconv") ||
+         t == "depooling" || t == "max_pooling" ||
+         t == "avg_pooling" || t == "maxabs_pooling" || t == "norm" ||
+         t == "cutter" || t == "dropout" ||
+         StartsWith(t, "zerofiller") || StartsWith(t, "activation_");
+}
+
+// shared by the conv/deconv unit types and the residual composite
+static void Conv2D(const NpyArray& weights, const NpyArray* bias,
+                   const float* x, float* y, const Shape3& in,
+                   const Shape3& out, int kx, int ky, int sx, int sy,
+                   int pad_t, int pad_l, int batch, Act act) {
+  int ci = in.c, co = out.c;
+  for (int b = 0; b < batch; ++b) {
+    const float* xb = x + static_cast<size_t>(b) * in.elems();
+    float* yb = y + static_cast<size_t>(b) * out.elems();
+    for (int oy = 0; oy < out.h; ++oy)
+      for (int ox = 0; ox < out.w; ++ox)
+        for (int oc = 0; oc < co; ++oc) {
+          float acc = bias ? bias->data[oc] : 0.f;
+          for (int fy = 0; fy < ky; ++fy) {
+            int iy = oy * sy + fy - pad_t;
+            if (iy < 0 || iy >= in.h) continue;
+            for (int fx = 0; fx < kx; ++fx) {
+              int ix = ox * sx + fx - pad_l;
+              if (ix < 0 || ix >= in.w) continue;
+              const float* xp =
+                  xb + (static_cast<size_t>(iy) * in.w + ix) * ci;
+              const float* wp = &weights.data[
+                  ((static_cast<size_t>(fy) * kx + fx) * ci) * co + oc];
+              for (int icc = 0; icc < ci; ++icc)
+                acc += xp[icc] * wp[static_cast<size_t>(icc) * co];
+            }
+          }
+          yb[(static_cast<size_t>(oy) * out.w + ox) * co + oc] =
+              Activate(acc, act);
+        }
+  }
+}
+
+// group normalization over [H, W, C]: per-(sample, group) statistics
+// across spatial + intra-group channels; effective group count is the
+// largest divisor of C <= groups (matches veles_tpu.ops.norm.group_norm,
+// biased variance, eps 1e-5)
+static void GroupNormForward(const float* x, float* y, const Shape3& s,
+                             const NpyArray* gamma, const NpyArray* beta,
+                             int groups, int batch) {
+  int c = s.c;
+  int g = std::max(1, std::min(groups, c));
+  while (c % g) --g;
+  int cg = c / g;
+  size_t hw = static_cast<size_t>(s.h) * s.w;
+  for (int b = 0; b < batch; ++b) {
+    const float* xb = x + static_cast<size_t>(b) * s.elems();
+    float* yb = y + static_cast<size_t>(b) * s.elems();
+    for (int gi = 0; gi < g; ++gi) {
+      double sum = 0.0, sq = 0.0;
+      for (size_t p = 0; p < hw; ++p)
+        for (int ic = 0; ic < cg; ++ic) {
+          float v = xb[p * c + gi * cg + ic];
+          sum += v;
+          sq += static_cast<double>(v) * v;
+        }
+      double n = static_cast<double>(hw) * cg;
+      float mean = static_cast<float>(sum / n);
+      float var = static_cast<float>(sq / n - (sum / n) * (sum / n));
+      float inv = 1.f / std::sqrt(var + 1e-5f);
+      for (size_t p = 0; p < hw; ++p)
+        for (int ic = 0; ic < cg; ++ic) {
+          int ch = gi * cg + ic;
+          float v = (xb[p * c + ch] - mean) * inv;
+          if (gamma) v *= gamma->data[ch];
+          if (beta) v += beta->data[ch];
+          yb[p * c + ch] = v;
+        }
+    }
+  }
 }
 
 void Unit::Execute(const float* x, float* y, int batch) const {
@@ -106,34 +199,58 @@ void Unit::Execute(const float* x, float* y, int batch) const {
       }
       for (int o = 0; o < no; ++o) yb[o] = Activate(yb[o], act);
     }
-  } else if (StartsWith(type, "conv")) {
-    int ci = in.c, co = out.c;
-    for (int b = 0; b < batch; ++b) {
-      const float* xb = x + static_cast<size_t>(b) * in.elems();
-      float* yb = y + static_cast<size_t>(b) * out.elems();
-      for (int oy = 0; oy < out.h; ++oy)
-        for (int ox = 0; ox < out.w; ++ox)
-          for (int oc = 0; oc < co; ++oc) {
-            float acc = has_bias ? bias.data[oc] : 0.f;
-            for (int fy = 0; fy < ky; ++fy) {
-              int iy = oy * sy + fy - pad_t;
-              if (iy < 0 || iy >= in.h) continue;
-              for (int fx = 0; fx < kx; ++fx) {
-                int ix = ox * sx + fx - pad_l;
-                if (ix < 0 || ix >= in.w) continue;
-                const float* xp =
-                    xb + (static_cast<size_t>(iy) * in.w + ix) * ci;
-                const float* wp = &weights.data[
-                    ((static_cast<size_t>(fy) * kx + fx) * ci) * co +
-                    oc];
-                for (int icc = 0; icc < ci; ++icc)
-                  acc += xp[icc] * wp[static_cast<size_t>(icc) * co];
-              }
-            }
-            yb[(static_cast<size_t>(oy) * out.w + ox) * co + oc] =
-                Activate(acc, act);
-          }
+  } else if (type == "conv_residual_block") {
+    // pre-activation He v2 residual composite (matches
+    // models.layers.ConvResidualBlock): gn→relu→conv3×3(stride) →
+    // gn→relu→conv3×3 + skip (1×1 strided projection on shape change).
+    // Scratch is local — the arena only plans inter-unit buffers.
+    const NpyArray* g1g = &extra.at("gn1/gamma");
+    const NpyArray* g1b = &extra.at("gn1/beta");
+    const NpyArray* g2g = &extra.at("gn2/gamma");
+    const NpyArray* g2b = &extra.at("gn2/beta");
+    size_t n_in = in.elems() * batch, n_out = out.elems() * batch;
+    std::vector<float>& h1 = scratch_[0];
+    std::vector<float>& h2 = scratch_[1];
+    std::vector<float>& h3 = scratch_[2];
+    h1.resize(n_in);
+    h2.resize(n_out);
+    h3.resize(n_out);
+    GroupNormForward(x, h1.data(), in, g1g, g1b, groups, batch);
+    for (size_t i = 0; i < n_in; ++i)
+      h1[i] = Activate(h1[i], Act::kStrictRelu);
+    auto bias_of = [this](const char* name) -> const NpyArray* {
+      auto it = extra.find(name);
+      return it == extra.end() ? nullptr : &it->second;
+    };
+    Conv2D(extra.at("conv1/weights"), bias_of("conv1/bias"), h1.data(),
+           h2.data(), in, out, 3, 3, sx, sy, 1, 1, batch,
+           Act::kLinear);
+    GroupNormForward(h2.data(), h3.data(), out, g2g, g2b, groups,
+                     batch);
+    for (size_t i = 0; i < n_out; ++i)
+      h3[i] = Activate(h3[i], Act::kStrictRelu);
+    Conv2D(extra.at("conv2/weights"), bias_of("conv2/bias"), h3.data(),
+           y, out, out, 3, 3, 1, 1, 1, 1, batch, Act::kLinear);
+    auto proj = extra.find("proj/weights");
+    if (proj != extra.end()) {
+      std::vector<float>& sk = scratch_[3];
+      sk.resize(n_out);
+      Conv2D(proj->second, nullptr, x, sk.data(), in, out, 1, 1, sx,
+             sy, 0, 0, batch, Act::kLinear);
+      for (size_t i = 0; i < n_out; ++i) y[i] += sk[i];
+    } else {
+      for (size_t i = 0; i < n_out; ++i) y[i] += x[i];
     }
+  } else if (type == "group_norm") {
+    auto aff = [this](const char* name) -> const NpyArray* {
+      auto it = extra.find(name);
+      return it == extra.end() ? nullptr : &it->second;
+    };
+    GroupNormForward(x, y, in, aff("gamma"), aff("beta"), groups,
+                     batch);
+  } else if (StartsWith(type, "conv")) {
+    Conv2D(weights, has_bias ? &bias : nullptr, x, y, in, out, kx, ky,
+           sx, sy, pad_t, pad_l, batch, act);
   } else if (StartsWith(type, "deconv")) {
     // transposed conv, gather form over the stride-dilated input
     // (matches lax.conv_transpose VALID: out = (in-1)*s + k)
@@ -266,6 +383,11 @@ class Workflow {
       Unit u;
       u.name = ju.at("name").str();
       u.type = ju.at("type").str();
+      if (!TypeSupported(u.type))
+        throw std::runtime_error(
+            "native runtime: unsupported unit type " + u.type +
+            " (unit " + u.name + ") — package not loadable by the C++ "
+            "engine; use the StableHLO export for this model");
       u.in = ToShape(ju.at("input_shape"));
       u.out = ToShape(ju.at("output_shape"));
       u.act = ActOf(u.type);
@@ -294,6 +416,7 @@ class Workflow {
         u.off_y = cfg.at("offset").arr_v[0].integer();
         u.off_x = cfg.at("offset").arr_v[1].integer();
       }
+      if (cfg.has("groups")) u.groups = cfg.at("groups").integer();
       const Json& arrays = ju.at("arrays");
       if (arrays.has("weights")) {
         u.weights = ParseNpy(zip.read(arrays.at("weights").str()));
@@ -312,6 +435,20 @@ class Workflow {
               u.bias,
               ParseNpy(zip.read(arrays.at("bias__scales").str())));
         u.has_bias = true;
+      }
+      // everything else (composite sub-arrays like "gn1/gamma", norm
+      // affines) lands in the named map, int8 scales folded in
+      for (const auto& kv : arrays.obj_v) {
+        const std::string& an = kv.first;
+        if (an == "weights" || an == "bias") continue;
+        if (an.size() >= 8 &&
+            an.compare(an.size() - 8, 8, "__scales") == 0)
+          continue;
+        NpyArray a = ParseNpy(zip.read(kv.second.str()));
+        if (arrays.has(an + "__scales"))
+          ApplyChannelScales(
+              a, ParseNpy(zip.read(arrays.at(an + "__scales").str())));
+        u.extra[an] = std::move(a);
       }
       units_.push_back(std::move(u));
     }

@@ -67,6 +67,19 @@ class TestExport:
         np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+    def test_unflatten_inverts_flatten(self):
+        from veles_tpu.services.export import (_flatten_params,
+                                               unflatten_params)
+        tree = {"gn1": {"gamma": 1, "beta": 2},
+                "conv1": {"weights": 3, "bias": 4},
+                "weights": 5}
+        flat = _flatten_params(tree)
+        assert flat == {"gn1/gamma": 1, "gn1/beta": 2,
+                        "conv1/weights": 3, "conv1/bias": 4,
+                        "weights": 5}
+        assert unflatten_params(flat) == tree
+
+
 @pytest.mark.skipif(not HAS_GXX, reason="no g++ toolchain")
 class TestNativeRuntime:
     def test_mlp_native_matches_jax(self, tmp_path):
@@ -97,6 +110,61 @@ class TestNativeRuntime:
         np.testing.assert_array_equal(got.argmax(1), want.argmax(1))
         native.close()
 
+    def test_resnet_gn_native_matches_jax(self, tmp_path):
+        """Composite layers export with flattened array names
+        ("gn1/gamma") and the native runtime executes the full
+        pre-activation residual block — group norm, strided 3x3 convs,
+        1x1 projection skip — bit-close to the jax forward."""
+        from veles_tpu.models.zoo import resnet_gn
+        from veles_tpu.services.native import NativeWorkflow
+        wf, x = train_small(
+            resnet_gn(n_classes=10, width=8, blocks_per_stage=1,
+                      stages=2, pool=4, lr=0.05),
+            img=True, epochs=3)
+        path = str(tmp_path / "resnet.zip")
+        export_workflow(wf, path)
+        manifest, arrays = import_workflow(path)
+        rb = next(u for u in manifest["units"]
+                  if u["type"] == "conv_residual_block")
+        assert "gn1/gamma" in rb["arrays"] and "conv2/weights" in \
+            rb["arrays"]
+        native = NativeWorkflow(path)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:16]))
+        got = native(x[:16].reshape(16, -1))
+        np.testing.assert_allclose(got, want, atol=1e-2)
+        np.testing.assert_array_equal(got.argmax(1), want.argmax(1))
+        native.close()
+        # int8 package: the composite sub-arrays ("conv1/weights")
+        # quantize with per-channel scales and the native loader folds
+        # them back
+        path8 = str(tmp_path / "resnet8.zip")
+        export_workflow(wf, path8, dtype="int8")
+        native8 = NativeWorkflow(path8)
+        got8 = native8(x[:16].reshape(16, -1))
+        np.testing.assert_array_equal(got8.argmax(1), want.argmax(1))
+        native8.close()
+
+    def test_group_norm_native_matches_jax(self, tmp_path):
+        from veles_tpu.services.native import NativeWorkflow
+        layers = [
+            {"type": "conv_strict_relu", "n_kernels": 6, "kx": 3,
+             "ky": 3, "padding": (1, 1, 1, 1), "learning_rate": 0.1,
+             "gradient_moment": 0.9},
+            {"type": "group_norm", "groups": 3, "learning_rate": 0.1},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.1, "gradient_moment": 0.9},
+        ]
+        wf, x = train_small(layers, img=True, epochs=2)
+        path = str(tmp_path / "gn.zip")
+        export_workflow(wf, path)
+        native = NativeWorkflow(path)
+        fwd = wf.forward_fn()
+        want = np.asarray(fwd(wf.trainer.params, x[:16]))
+        got = native(x[:16].reshape(16, -1))
+        np.testing.assert_allclose(got, want, atol=1e-2)
+        native.close()
+
     def test_arena_is_smaller_than_naive(self, tmp_path):
         """The memory optimizer packs lifetimes: arena < sum of all
         buffers (ref libVeles memory_optimizer 'minimal height')."""
@@ -121,6 +189,29 @@ class TestNativeRuntime:
         bad.write_bytes(b"not a zip")
         with pytest.raises(RuntimeError, match="native load failed"):
             NativeWorkflow(str(bad))
+
+    def test_unsupported_type_fails_at_load_with_name(self, tmp_path):
+        """A package with a type the C++ engine lacks fails at LOAD
+        with the type named — not a generic failure at first infer."""
+        from veles_tpu.services.native import NativeWorkflow
+        import json
+        import zipfile
+        wf, _ = train_small(MLP_LAYERS, epochs=1)
+        path = str(tmp_path / "mlp.zip")
+        export_workflow(wf, path)
+        # rewrite the manifest so the loader sees an lstm unit
+        with zipfile.ZipFile(path) as zf:
+            manifest = json.loads(zf.read("contents.json"))
+            blobs = {n: zf.read(n) for n in zf.namelist()
+                     if n != "contents.json"}
+        manifest["units"][0]["type"] = "lstm"
+        bad = str(tmp_path / "lstm.zip")
+        with zipfile.ZipFile(bad, "w") as zf:
+            zf.writestr("contents.json", json.dumps(manifest))
+            for n, b in blobs.items():
+                zf.writestr(n, b)
+        with pytest.raises(RuntimeError, match="lstm"):
+            NativeWorkflow(bad)
 
     def test_wrong_input_size_raises(self, tmp_path):
         from veles_tpu.services.native import NativeWorkflow

@@ -934,8 +934,10 @@ class Main(object):
         probs = None
         for m in members:
             manifest, arrays = import_workflow(m["package"])
+            from veles_tpu.services.export import unflatten_params
             params = {
-                u["name"]: {p: arrays[f] for p, f in u["arrays"].items()}
+                u["name"]: unflatten_params(
+                    {p: arrays[f] for p, f in u["arrays"].items()})
                 for u in manifest["units"] if u["arrays"]}
             p = np.asarray(fwd(params, x))
             probs = p if probs is None else probs + p

@@ -17,6 +17,35 @@ import numpy as np
 from veles_tpu import __version__
 
 
+def unflatten_params(flat):
+    """Inverse of the export-side flattening: {"gn1/gamma": a} →
+    {"gn1": {"gamma": a}} — consumers rebuilding live param trees from
+    a package (ensemble vote, warm starts from packages) need the
+    NESTED layout composite layers' apply() indexes."""
+    out = {}
+    for key, v in flat.items():
+        node = out
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _flatten_params(sub, prefix=""):
+    """Composite layers (conv_residual_block, transformer_block) keep
+    NESTED param dicts; the package format stores one flat array map per
+    unit with "/"-joined names ("gn1/gamma")."""
+    out = {}
+    for k, v in sub.items():
+        key = "%s/%s" % (prefix, k) if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, key))
+        else:
+            out[key] = v
+    return out
+
+
 def export_workflow(workflow, path, dtype="float32"):
     """Write a StandardWorkflow-style trained model to ``path`` (.zip).
 
@@ -41,9 +70,11 @@ def export_workflow(workflow, path, dtype="float32"):
     files = {}
     for i, layer in enumerate(trainer.layers):
         arrays = {}
-        for pname, arr in (host.get(layer.name) or {}).items():
+        for pname, arr in _flatten_params(
+                host.get(layer.name) or {}).items():
             arr = np.asarray(arr)
-            fname = "%04d_%s_%s.npy" % (i, layer.name, pname)
+            fname = "%04d_%s_%s.npy" % (i, layer.name,
+                                        pname.replace("/", "_"))
             arrays[pname] = fname
             if dtype == "int8" and arr.ndim >= 2 and _is_floating(arr):
                 arrf = arr.astype(np.float32)   # incl. ml_dtypes bf16
