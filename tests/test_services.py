@@ -634,3 +634,60 @@ class TestTracingFlags:
         assert len(lines) > 10
         assert any(e["name"] == "minibatch" for e in lines)
         assert all({"name", "cat", "type", "time"} <= set(e) for e in lines)
+
+
+@pytest.mark.slow
+class TestContinuousServing:
+    def test_rest_endpoint_rides_the_continuous_engine(self):
+        """continuous_slots>0: concurrent HTTP generate requests join
+        the live slot pool and each gets its exact solo continuation
+        (the ContinuousEngine REST integration)."""
+        import threading as _threading
+
+        from veles_tpu.models import zoo
+        from veles_tpu.models.generate import LMGenerator
+
+        prng.seed_all(23)
+        r = np.random.RandomState(3)
+        n, t, vocab = 128, 12, 11
+        toks = ((np.arange(t)[None, :] + r.randint(0, 3, n)[:, None])
+                % vocab).astype(np.int32)
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=32,
+                                 class_lengths=[0, 32, 96])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=16,
+                                      n_heads=2, n_layers=1, lr=5e-3,
+                                      dropout=0.0),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 8}, name="rest-cont")
+        wf.initialize()
+        wf.run()
+        gen = LMGenerator(wf.trainer, max_len=t)
+        fwd = wf.forward_fn()
+        params = wf.trainer.params
+        api = RESTfulAPI(lambda xx: np.asarray(fwd(params, xx)), (t,),
+                         port=0, generator=gen, continuous_slots=3)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/service" % api.port
+            outs = {}
+
+            def req(i, plen, max_new):
+                outs[i] = _post(url, {
+                    "input": toks[i, :plen].tolist(),
+                    "generate": {"max_new": max_new}})["result"]
+
+            threads = [_threading.Thread(target=req, args=a) for a in
+                       ((0, 5, 4), (1, 6, 3), (2, 4, 5), (3, 5, 4))]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+            for i, plen, max_new in ((0, 5, 4), (1, 6, 3), (2, 4, 5),
+                                     (3, 5, 4)):
+                want = gen.generate(toks[i:i + 1, :plen],
+                                    max_new)[0].tolist()
+                assert outs[i][0] == want, (i, outs[i][0], want)
+        finally:
+            api.stop()

@@ -960,7 +960,9 @@ class Main(object):
         # root.common.serve.weights='int8' quantizes the serving weights
         # (W8A8-dynamic, ops.quant) for ~half the decode HBM traffic;
         # root.common.serve.batch_window_ms>0 coalesces concurrent
-        # generate requests into shared device calls (docs/services.md)
+        # generate requests into shared device calls;
+        # root.common.serve.continuous_slots>0 runs the in-flight
+        # continuous-batching engine instead (docs/services.md)
         api = RESTfulAPI(lambda x: np.asarray(fwd(params, x)),
                          wf.trainer.layers[0].input_shape, port=port,
                          generator=self._make_generator(wf),
@@ -968,7 +970,10 @@ class Main(object):
                              root.common.serve.get("batch_window_ms", 0))
                          / 1e3,
                          max_batch=int(
-                             root.common.serve.get("max_batch", 8)))
+                             root.common.serve.get("max_batch", 8)),
+                         continuous_slots=int(
+                             root.common.serve.get("continuous_slots",
+                                                   0)))
         api.start()
         print("REST serving on port %d; Ctrl-C to stop" % api.port)
         try:

@@ -867,3 +867,221 @@ class LMGenerator:
                              % (t, self.max_len))
         _, logits = self._run(self.params, tokens, t, True)
         return np.asarray(logits).transpose(1, 0, 2)[:, :t - 1]
+
+
+class ContinuousBatcher:
+    """In-flight (continuous) batching over a fixed pool of decode
+    slots: requests JOIN and LEAVE the batched decode at any step
+    instead of waiting for a whole batch to finish together — the
+    modern serving-engine admission model (capability beyond both the
+    reference and this repo's coalescing ``GenerateBatcher``, which
+    merges only same-phase requests).
+
+    Design: one jitted per-tick step, ``jax.vmap`` of the generator's
+    single-row incremental step with PER-ROW positions (each slot sits
+    at its own depth in its own KV cache; the vmapped
+    dynamic_update_slice becomes a scatter).  Admission is
+    token-by-token: a newly admitted row "prefills" by forcing its own
+    prompt tokens through the shared tick until its position passes the
+    prompt — correct by construction and admission-latency-free for the
+    pool (a chunked-prefill admission path can reuse
+    ``TransformerBlock.prefill`` later).  Inactive slots tick too
+    (uniform shapes beat recompiles); their writes stay inside their
+    own slot so they cannot disturb live rows.
+
+    Greedy and per-row temperature sampling; each row's draws depend
+    only on its own (seed, position), so outputs are invariant to
+    which slots or neighbors a request shared the pool with — the same
+    contract GenerateBatcher proves for coalescing.
+
+        cb = ContinuousBatcher(gen, slots=8)
+        rid = cb.submit([1, 2, 3], max_new=16)
+        while not cb.idle():
+            cb.tick()
+        tokens = cb.result(rid)
+    """
+
+    def __init__(self, gen, slots=8):
+        self.gen = gen
+        self.slots = int(slots)
+        B, L = self.slots, gen.max_len
+        self._tokens = jnp.zeros((B, L), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._plen = jnp.ones((B,), jnp.int32)
+        self._total = jnp.ones((B,), jnp.int32)   # plen + max_new
+        self._active = jnp.zeros((B,), jnp.bool_)
+        self._seeds = jnp.zeros((B,), jnp.int32)
+        self._inv_temp = jnp.zeros((B,), jnp.float32)  # 0 = greedy
+        self._caches = gen._init_caches(B, gen._model_dtype())
+        self._slot_req = [None] * B               # slot -> request id
+        self._queue = collections.deque()
+        self._results = {}
+        self._next_id = 0
+        self._tick_fn = None
+        self._admit_fn = None
+
+    # ------------------------------------------------------------ public
+    def submit(self, prompt, max_new, temperature=0.0, seed=0):
+        """Queue a request; returns a request id.  The request enters
+        the pool at the next tick with a free slot."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new) < 1:
+            raise ValueError("max_new must be >= 1, got %d"
+                             % int(max_new))
+        if len(prompt) + int(max_new) > self.gen.max_len:
+            raise ValueError("prompt+max_new %d exceeds max_len %d"
+                             % (len(prompt) + int(max_new),
+                                self.gen.max_len))
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, int(max_new),
+                            float(temperature), int(seed)))
+        return rid
+
+    def idle(self):
+        return not self._queue and not any(
+            r is not None for r in self._slot_req)
+
+    def result(self, rid):
+        """Completed token list (prompt + continuation), or None while
+        the request is still queued/decoding."""
+        return self._results.get(rid)
+
+    def tick(self):
+        """One engine step: admit queued requests into free slots, then
+        advance EVERY slot one token; emit and free finished rows.
+        Returns the number of active slots after the tick."""
+        while self._queue and None in self._slot_req:
+            self._admit(self._slot_req.index(None))
+        st = (self._tokens, self._pos, self._plen, self._total,
+              self._active, self._seeds, self._inv_temp, self._caches)
+        st = self._tick(st)
+        (self._tokens, self._pos, self._plen, self._total,
+         self._active, self._seeds, self._inv_temp, self._caches) = st
+        # emission: a row is done when pos+1 reached its total
+        pos = np.asarray(self._pos)
+        active = np.asarray(self._active)
+        total = np.asarray(self._total)
+        done = active & (pos + 1 >= total)
+        if done.any():
+            toks = np.asarray(self._tokens)
+            for b in np.nonzero(done)[0]:
+                rid = self._slot_req[b]
+                self._results[rid] = toks[b, :total[b]].tolist()
+                self._slot_req[b] = None
+            self._active = jnp.asarray(active & ~done)
+        return int((np.asarray(self._active)).sum())
+
+    def run_all(self):
+        """Drive until every submitted request completed."""
+        while not self.idle():
+            self.tick()
+        return self._results
+
+    # ----------------------------------------------------------- internal
+    def _admit(self, b):
+        rid, prompt, max_new, temperature, seed = self._queue.popleft()
+        if self._admit_fn is None:
+            gen = self.gen
+
+            def admit(st, b, prow, plen, total, seed, inv_temp):
+                (tokens, pos, plens, totals, active, seeds, its,
+                 caches) = st
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, prow[None], (b, 0))
+                pos = pos.at[b].set(0)
+                plens = plens.at[b].set(plen)
+                totals = totals.at[b].set(total)
+                active = active.at[b].set(True)
+                seeds = seeds.at[b].set(seed)
+                its = its.at[b].set(inv_temp)
+                # reset the slot's cache rows (stale K/V from the
+                # previous occupant must not leak into attention).
+                # Fresh single-slot values are built INSIDE the jit —
+                # zeros for data, ones for QuantCache scales, exactly
+                # _init_caches semantics — so no zero pool persists.
+                fresh = gen._init_caches(1, gen._model_dtype())
+                caches = jax.tree_util.tree_map(
+                    lambda pool, one: jax.lax.dynamic_update_slice(
+                        pool, one.astype(pool.dtype),
+                        (b,) + (0,) * (pool.ndim - 1)),
+                    caches, fresh)
+                return (tokens, pos, plens, totals, active, seeds, its,
+                        caches)
+
+            self._admit_fn = jax.jit(admit, donate_argnums=(0,))
+        prow = np.zeros((self.gen.max_len,), np.int32)
+        prow[:len(prompt)] = prompt
+        st = (self._tokens, self._pos, self._plen, self._total,
+              self._active, self._seeds, self._inv_temp, self._caches)
+        st = self._admit_fn(st, jnp.int32(b), jnp.asarray(prow),
+                            jnp.int32(len(prompt)),
+                            jnp.int32(len(prompt) + max_new),
+                            jnp.int32(seed),
+                            jnp.float32(0.0 if temperature == 0.0
+                                        else 1.0 / temperature))
+        (self._tokens, self._pos, self._plen, self._total,
+         self._active, self._seeds, self._inv_temp, self._caches) = st
+        self._slot_req[b] = rid
+
+    def _tick(self, st):
+        if self._tick_fn is None:
+            gen = self.gen
+
+            def row_step(params, caches, tok, pos):
+                # single-row view: add the batch dim the stack expects;
+                # under vmap the per-row ``pos`` scatter-writes each
+                # slot at its own depth
+                c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
+                logits, c1 = gen._step(params, c1, tok[None], pos)
+                return logits[0], jax.tree_util.tree_map(
+                    lambda a: a[0], c1)
+
+            def tick(params, st):
+                (tokens, pos, plen, total, active, seeds, inv_temp,
+                 caches) = st
+                B = tokens.shape[0]
+                rows = jnp.arange(B)
+                cur = tokens[rows, pos]
+                logits, caches = jax.vmap(
+                    row_step, in_axes=(None, 0, 0, 0))(
+                        params, caches, cur, pos)
+                greedy_tok = jnp.argmax(logits, axis=-1).astype(
+                    jnp.int32)
+
+                def draw(_):
+                    keys = jax.vmap(
+                        lambda s, p: jax.random.fold_in(
+                            jax.random.key(s), p))(seeds, pos)
+                    sampled = jax.vmap(
+                        lambda lg, k, it: jax.random.categorical(
+                            k, lg * it))(logits, keys,
+                                         inv_temp).astype(jnp.int32)
+                    return jnp.where(inv_temp > 0.0, sampled,
+                                     greedy_tok)
+
+                # all-greedy pools (the serving default) skip the
+                # whole-vocab gumbel draw entirely — same guard as
+                # _decode_body's lax.cond
+                nxt = jax.lax.cond(jnp.any(inv_temp > 0.0), draw,
+                                   lambda _: greedy_tok, None)
+                # prefilling rows force their own next prompt token
+                in_prompt = pos + 1 < plen
+                forced = tokens[rows, jnp.minimum(pos + 1,
+                                                  tokens.shape[1] - 1)]
+                nxt = jnp.where(in_prompt, forced, nxt)
+                write = active & (pos + 1 < tokens.shape[1])
+                tokens = tokens.at[rows, jnp.minimum(
+                    pos + 1, tokens.shape[1] - 1)].set(
+                    jnp.where(write, nxt, tokens[rows, jnp.minimum(
+                        pos + 1, tokens.shape[1] - 1)]))
+                pos = jnp.where(active, pos + 1, pos)
+                return (tokens, pos, plen, total, active, seeds,
+                        inv_temp, caches)
+
+            # donate the state: without aliasing, every per-token tick
+            # would copy the whole slots×layers KV-cache pool
+            self._tick_fn = jax.jit(tick, donate_argnums=(1,))
+        return self._tick_fn(self.gen.params, st)

@@ -106,10 +106,81 @@ class GenerateBatcher(Logger):
                 slot["event"].set()
 
 
+class ContinuousEngine(Logger):
+    """Background driver putting ``models.generate.ContinuousBatcher``
+    behind the REST endpoint: one engine thread ticks the slot pool
+    whenever work exists; each HTTP worker blocks on its request's
+    event and wakes the moment its row leaves the pool.  Unlike the
+    window coalescer, a request joins the CURRENT in-flight decode at
+    the next tick — no batch boundary, no window wait."""
+
+    def __init__(self, generator, slots=8):
+        super(ContinuousEngine, self).__init__()
+        from veles_tpu.models.generate import ContinuousBatcher
+        self.cb = ContinuousBatcher(generator, slots=slots)
+        self._lock = threading.Lock()      # the batcher is not thread-safe
+        self._events = {}
+        self._closed = False
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit_async(self, prompt_row, max_new, temperature=0.0,
+                     seed=0):
+        """Enqueue one row; returns a handle for ``wait`` (submit every
+        row of a request BEFORE waiting so they share the pool)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is stopped")
+            rid = self.cb.submit(list(prompt_row), max_new,
+                                 temperature=temperature, seed=seed)
+            ev = self._events[rid] = threading.Event()
+        self._wake.set()
+        return rid, ev
+
+    def wait(self, handle):
+        rid, ev = handle
+        ev.wait()
+        with self._lock:
+            del self._events[rid]
+            out = self.cb.result(rid)
+        import numpy as np
+        return np.asarray(out, np.int32)
+
+    def submit(self, prompt_row, max_new, temperature=0.0, seed=0):
+        """Block until this request's row finishes; returns the 1-D
+        prompt+continuation array."""
+        return self.wait(self.submit_async(prompt_row, max_new,
+                                           temperature=temperature,
+                                           seed=seed))
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                busy = not self.cb.idle() and not self._closed
+            if self._closed:
+                return
+            if not busy:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            with self._lock:
+                self.cb.tick()
+                for rid, ev in list(self._events.items()):
+                    if self.cb.result(rid) is not None:
+                        ev.set()
+
+    def stop(self):
+        with self._lock:
+            self._closed = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+
 class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
                  path="/service", generator=None, batch_window=0.0,
-                 max_batch=8):
+                 max_batch=8, continuous_slots=0):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -123,6 +194,13 @@ class RESTfulAPI(Logger):
                                         max_batch)
                         if generator is not None and batch_window > 0
                         else None)
+        #: continuous_slots > 0: in-flight batching — requests join the
+        #: live decode at the next tick (ContinuousEngine; greedy and
+        #: plain-temperature requests only, top_k/top_p/beam/speculative
+        #: fall through to the other paths)
+        self.engine = (ContinuousEngine(generator, continuous_slots)
+                       if generator is not None and continuous_slots > 0
+                       else None)
         self._server = None
         self._thread = None
 
@@ -178,6 +256,8 @@ class RESTfulAPI(Logger):
             self._server = None
         if self.batcher is not None:
             self.batcher.stop()
+        if self.engine is not None:
+            self.engine.stop()
 
     # ---------------------------------------------------------- generation
     def run_generate(self, req):
@@ -210,6 +290,15 @@ class RESTfulAPI(Logger):
             # falls back itself when speculation can't apply)
             return self.generator.generate_speculative(
                 prompt, int(opts.get("max_new", 16)), draft_k=spec)
+        if self.engine is not None and int(opts.get("top_k", 0)) == 0 \
+                and float(opts.get("top_p", 1.0)) >= 1.0:
+            for row in prompt:
+                self.generator.validate_request(len(row), opts)
+            handles = [self.engine.submit_async(
+                row, int(opts.get("max_new", 16)),
+                temperature=float(opts.get("temperature", 0.0)),
+                seed=int(opts.get("seed", 0))) for row in prompt]
+            return np.stack([self.engine.wait(h) for h in handles])
         if self.batcher is not None:
             # validate THIS request up front — a bad one must 400 alone,
             # never poison the batch it would have coalesced into
