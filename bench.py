@@ -726,6 +726,78 @@ def phase_serve():
     return out
 
 
+def phase_servecont():
+    """Continuous-batching serving throughput — NOT in the default
+    phase list; run manually on hardware (``python bench.py --phase
+    servecont``).  N concurrent greedy streams through one
+    ContinuousBatcher slot pool vs the same N requests decoded solo,
+    aggregate tokens/sec each way: the multi-stream utilization number
+    a serving deployment actually sees (each tick advances every slot
+    for ~one slot's weight-streaming cost)."""
+    import numpy as np
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.generate import ContinuousBatcher, LMGenerator
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+
+    prng.seed_all(17)
+    d = int(os.environ.get("BENCH_SERVE_D", 768))        # CPU smoke: 64
+    n_layers = int(os.environ.get("BENCH_SERVE_L", 12))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    vocab = 50304 if d >= 768 else 512
+    t_max = 512 if d >= 768 else 48
+    max_new = t_max // 4
+    toks = np.random.RandomState(0).randint(
+        0, vocab, (slots, 32)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=4,
+                             class_lengths=[0, 0, slots])
+    wf = StandardWorkflow(
+        layers=transformer_lm(vocab_size=vocab, d_model=d,
+                              n_heads=max(1, d // 64),
+                              n_layers=n_layers, dropout=0.0,
+                              pos="rope", tie_embeddings=True),
+        loader=loader, loss="lm", decision_config={"max_epochs": 1},
+        name="bench-servecont")
+    wf.initialize()
+    gen = LMGenerator(wf.trainer, max_len=t_max)
+
+    tpd = int(os.environ.get("BENCH_SERVE_TPD", 16))
+    # ONE batcher reused across warmup + timed runs (a fresh instance
+    # would recompile its fused tick); fuse K engine ticks per dispatch
+    # so the remote-tunnel dispatch cost amortizes exactly like the
+    # trainer's fused sweep
+    cb = ContinuousBatcher(gen, slots=slots, ticks_per_dispatch=tpd)
+
+    def run_pool():
+        for i in range(slots):
+            cb.submit(toks[i, :16].tolist(), max_new)
+        cb.run_all()
+
+    run_pool()                           # compile + warmup
+    t0 = time.perf_counter()
+    run_pool()
+    pool_s = time.perf_counter() - t0
+    pool_tps = slots * max_new / pool_s
+
+    gen.generate(toks[:1, :16], max_new)  # compile + warmup
+    t0 = time.perf_counter()
+    for i in range(slots):
+        gen.generate(toks[i:i + 1, :16], max_new)
+    solo_s = time.perf_counter() - t0
+    solo_tps = slots * max_new / solo_s
+    _log("continuous serving (%dM-class d=%d L=%d, %d streams x %d "
+         "new): pool %.0f tok/s vs solo-sequential %.0f tok/s "
+         "(x%.1f)"
+         % (12 * d * d * n_layers // 1_000_000 if d >= 768 else 0,
+            d, n_layers, slots, max_new, pool_tps, solo_tps,
+            pool_tps / solo_tps if solo_tps else 0.0))
+    return {"pool_tokens_per_sec": pool_tps,
+            "solo_tokens_per_sec": solo_tps,
+            "slots": slots, "max_new": max_new, "d_model": d}
+
+
 def phase_flashtune():
     """Block-size sweep for the flash kernel with the chained in-jit
     harness — NOT in the default phase list; run manually on hardware

@@ -583,15 +583,20 @@ class TestInt8ServingWeights:
 
 
 class TestContinuousBatching:
-    def test_staggered_requests_match_solo_greedy(self, f32_precision):
+    @pytest.mark.parametrize("ticks_per_dispatch", [1, 4])
+    def test_staggered_requests_match_solo_greedy(self, f32_precision,
+                                                  ticks_per_dispatch):
         """In-flight batching: requests submitted at DIFFERENT ticks,
         sharing the slot pool mid-decode, must produce exactly the solo
         greedy continuation — slot placement and neighbors are
-        invisible (the continuous-batching correctness contract)."""
+        invisible (the continuous-batching correctness contract), at
+        per-token admission AND with K engine ticks fused into one
+        dispatch (rows freeze in-jit at their budget)."""
         from veles_tpu.models.generate import ContinuousBatcher
         wf, toks = _lm_workflow(max_epochs=8)
         gen = LMGenerator(wf.trainer, max_len=16)
-        cb = ContinuousBatcher(gen, slots=3)
+        cb = ContinuousBatcher(gen, slots=3,
+                               ticks_per_dispatch=ticks_per_dispatch)
 
         prompts = [toks[0, :4].tolist(), toks[1, :6].tolist(),
                    toks[2, :3].tolist(), toks[3, :5].tolist()]

@@ -901,9 +901,17 @@ class ContinuousBatcher:
         tokens = cb.result(rid)
     """
 
-    def __init__(self, gen, slots=8):
+    def __init__(self, gen, slots=8, ticks_per_dispatch=1):
         self.gen = gen
         self.slots = int(slots)
+        #: fuse K engine ticks into ONE device dispatch (lax.scan over
+        #: the tick body) — the same host→device amortization as the
+        #: trainer's fused sweep.  Admission then happens at K-token
+        #: boundaries; rows that hit their budget mid-scan freeze
+        #: in-jit, so outputs stay EXACTLY the solo continuation at any
+        #: K.  K=1 is pure per-token admission; remote/tunnel devices
+        #: want K ~ 8-32.
+        self.ticks_per_dispatch = max(1, int(ticks_per_dispatch))
         B, L = self.slots, gen.max_len
         self._tokens = jnp.zeros((B, L), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
@@ -949,6 +957,11 @@ class ContinuousBatcher:
         the request is still queued/decoding."""
         return self._results.get(rid)
 
+    def pop_result(self, rid):
+        """Like ``result`` but releases the stored tokens — long-running
+        servers must not accumulate every completed request."""
+        return self._results.pop(rid, None)
+
     def tick(self):
         """One engine step: admit queued requests into free slots, then
         advance EVERY slot one token; emit and free finished rows.
@@ -960,18 +973,20 @@ class ContinuousBatcher:
         st = self._tick(st)
         (self._tokens, self._pos, self._plen, self._total,
          self._active, self._seeds, self._inv_temp, self._caches) = st
-        # emission: a row is done when pos+1 reached its total
+        # emission: completion is re-derived from slot OCCUPANCY + pos
+        # (the in-jit freeze already cleared ``active`` for rows that
+        # hit their budget mid-scan, possibly several per fused
+        # dispatch)
         pos = np.asarray(self._pos)
-        active = np.asarray(self._active)
         total = np.asarray(self._total)
-        done = active & (pos + 1 >= total)
+        occupied = np.array([r is not None for r in self._slot_req])
+        done = occupied & (pos + 1 >= total)
         if done.any():
             toks = np.asarray(self._tokens)
             for b in np.nonzero(done)[0]:
                 rid = self._slot_req[b]
                 self._results[rid] = toks[b, :total[b]].tolist()
                 self._slot_req[b] = None
-            self._active = jnp.asarray(active & ~done)
         return int((np.asarray(self._active)).sum())
 
     def run_all(self):
@@ -1078,10 +1093,20 @@ class ContinuousBatcher:
                     jnp.where(write, nxt, tokens[rows, jnp.minimum(
                         pos + 1, tokens.shape[1] - 1)]))
                 pos = jnp.where(active, pos + 1, pos)
+                # rows that just hit their budget freeze IN-JIT, so a
+                # fused multi-tick scan can't overshoot max_new (the
+                # host re-derives completion from slot occupancy)
+                active = active & (pos + 1 < total)
                 return (tokens, pos, plen, total, active, seeds,
                         inv_temp, caches)
 
+            def fused(params, st):
+                def body(carry, _):
+                    return tick(params, carry), None
+                return jax.lax.scan(body, st, None,
+                                    length=self.ticks_per_dispatch)[0]
+
             # donate the state: without aliasing, every per-token tick
             # would copy the whole slots×layers KV-cache pool
-            self._tick_fn = jax.jit(tick, donate_argnums=(1,))
+            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
         return self._tick_fn(self.gen.params, st)

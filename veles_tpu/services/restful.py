@@ -143,8 +143,12 @@ class ContinuousEngine(Logger):
         ev.wait()
         with self._lock:
             del self._events[rid]
-            out = self.cb.result(rid)
-        import numpy as np
+            # pop, don't get: a long-running server must not retain
+            # every completed request's tokens
+            out = self.cb.pop_result(rid)
+        if out is None:
+            raise RuntimeError(
+                "engine stopped before request %d completed" % rid)
         return np.asarray(out, np.int32)
 
     def submit(self, prompt_row, max_new, temperature=0.0, seed=0):
@@ -173,6 +177,10 @@ class ContinuousEngine(Logger):
     def stop(self):
         with self._lock:
             self._closed = True
+            # release every in-flight waiter: wait() sees the popped
+            # result missing and raises, instead of hanging forever
+            for ev in self._events.values():
+                ev.set()
         self._wake.set()
         self._thread.join(timeout=5)
 
@@ -291,7 +299,10 @@ class RESTfulAPI(Logger):
             return self.generator.generate_speculative(
                 prompt, int(opts.get("max_new", 16)), draft_k=spec)
         if self.engine is not None and int(opts.get("top_k", 0)) == 0 \
-                and float(opts.get("top_p", 1.0)) >= 1.0:
+                and float(opts.get("top_p", 1.0)) >= 1.0 \
+                and int(opts.get("max_new", 16)) >= 1:
+            # (max_new=0 echo/score requests fall through — the solo
+            # and coalescing paths serve them; the slot pool can't)
             for row in prompt:
                 self.generator.validate_request(len(row), opts)
             handles = [self.engine.submit_async(
