@@ -583,9 +583,11 @@ class TestInt8ServingWeights:
 
 
 class TestContinuousBatching:
-    @pytest.mark.parametrize("ticks_per_dispatch", [1, 4])
+    @pytest.mark.parametrize("ticks_per_dispatch,chunked_prefill",
+                             [(1, True), (4, True), (1, False), (4, False)])
     def test_staggered_requests_match_solo_greedy(self, f32_precision,
-                                                  ticks_per_dispatch):
+                                                  ticks_per_dispatch,
+                                                  chunked_prefill):
         """In-flight batching: requests submitted at DIFFERENT ticks,
         sharing the slot pool mid-decode, must produce exactly the solo
         greedy continuation — slot placement and neighbors are
@@ -596,7 +598,8 @@ class TestContinuousBatching:
         wf, toks = _lm_workflow(max_epochs=8)
         gen = LMGenerator(wf.trainer, max_len=16)
         cb = ContinuousBatcher(gen, slots=3,
-                               ticks_per_dispatch=ticks_per_dispatch)
+                               ticks_per_dispatch=ticks_per_dispatch,
+                               chunked_prefill=chunked_prefill)
 
         prompts = [toks[0, :4].tolist(), toks[1, :6].tolist(),
                    toks[2, :3].tolist(), toks[3, :5].tolist()]
@@ -615,6 +618,21 @@ class TestContinuousBatching:
             want = gen.generate(np.asarray([prompt], np.int32),
                                 max_new)[0].tolist()
             assert got == want, (rid, got, want)
+
+    def test_sliding_window_model_rides_the_pool(self, f32_precision):
+        """Rolling ring-buffer caches through the batcher: the prefill
+        chunk rounds DOWN (ring slots must never hold a position past
+        the cursor) and the tick's prompt-forcing finishes admission —
+        outputs still match the solo generator."""
+        from veles_tpu.models.generate import ContinuousBatcher
+        wf, toks = _lm_workflow(max_epochs=8, window=6, impl="flash")
+        gen = LMGenerator(wf.trainer, max_len=16)
+        cb = ContinuousBatcher(gen, slots=2, ticks_per_dispatch=2)
+        rids = [cb.submit(toks[i, :5].tolist(), 7) for i in range(3)]
+        cb.run_all()
+        for i, rid in enumerate(rids):
+            want = gen.generate(toks[i:i + 1, :5], 7)[0].tolist()
+            assert cb.result(rid) == want, (i, cb.result(rid), want)
 
     def test_slot_reuse_and_queueing(self, f32_precision):
         """More requests than slots: the queue drains through freed

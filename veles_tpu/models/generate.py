@@ -880,12 +880,13 @@ class ContinuousBatcher:
     Design: one jitted per-tick step, ``jax.vmap`` of the generator's
     single-row incremental step with PER-ROW positions (each slot sits
     at its own depth in its own KV cache; the vmapped
-    dynamic_update_slice becomes a scatter).  Admission is
-    token-by-token: a newly admitted row "prefills" by forcing its own
-    prompt tokens through the shared tick until its position passes the
-    prompt — correct by construction and admission-latency-free for the
-    pool (a chunked-prefill admission path can reuse
-    ``TransformerBlock.prefill`` later).  Inactive slots tick too
+    dynamic_update_slice becomes a scatter).  Admission chunk-prefills
+    by default: the new prompt fills its slot's cache in one parallel
+    pass and the row starts at the standard scan cursor
+    (``chunked_prefill=False`` falls back to forcing the prompt
+    token-by-token through the shared tick — the tick's prompt-forcing
+    also finishes whatever a rolling-window prefill chunk leaves).
+    Inactive slots tick too
     (uniform shapes beat recompiles); their writes stay inside their
     own slot so they cannot disturb live rows.
 
@@ -901,7 +902,8 @@ class ContinuousBatcher:
         tokens = cb.result(rid)
     """
 
-    def __init__(self, gen, slots=8, ticks_per_dispatch=1):
+    def __init__(self, gen, slots=8, ticks_per_dispatch=1,
+                 chunked_prefill=True):
         self.gen = gen
         self.slots = int(slots)
         #: fuse K engine ticks into ONE device dispatch (lax.scan over
@@ -912,6 +914,14 @@ class ContinuousBatcher:
         #: K.  K=1 is pure per-token admission; remote/tunnel devices
         #: want K ~ 8-32.
         self.ticks_per_dispatch = max(1, int(ticks_per_dispatch))
+        #: chunked-prefill admission: a new request's prompt fills its
+        #: slot's KV cache in ONE parallel pass (TransformerBlock.
+        #:prefill via _prefill_fn) and the row starts at the scan
+        #: cursor _prefill_dispatch prescribes — instead of consuming
+        #: one pool tick per prompt token.  The tick's prompt-forcing
+        #: still covers whatever the prefill chunk didn't (rolling
+        #: windows round the chunk DOWN).
+        self.chunked_prefill = bool(chunked_prefill)
         B, L = self.slots, gen.max_len
         self._tokens = jnp.zeros((B, L), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
@@ -998,45 +1008,61 @@ class ContinuousBatcher:
     # ----------------------------------------------------------- internal
     def _admit(self, b):
         rid, prompt, max_new, temperature, seed = self._queue.popleft()
+        gen = self.gen
+        plen = len(prompt)
         if self._admit_fn is None:
-            gen = self.gen
-
-            def admit(st, b, prow, plen, total, seed, inv_temp):
+            def admit(st, b, prow, plen, total, seed, inv_temp, pos0,
+                      cache_row):
                 (tokens, pos, plens, totals, active, seeds, its,
                  caches) = st
                 tokens = jax.lax.dynamic_update_slice(
                     tokens, prow[None], (b, 0))
-                pos = pos.at[b].set(0)
+                pos = pos.at[b].set(pos0)
                 plens = plens.at[b].set(plen)
                 totals = totals.at[b].set(total)
                 active = active.at[b].set(True)
                 seeds = seeds.at[b].set(seed)
                 its = its.at[b].set(inv_temp)
-                # reset the slot's cache rows (stale K/V from the
-                # previous occupant must not leak into attention).
-                # Fresh single-slot values are built INSIDE the jit —
-                # zeros for data, ones for QuantCache scales, exactly
-                # _init_caches semantics — so no zero pool persists.
-                fresh = gen._init_caches(1, gen._model_dtype())
+                # the [1, ...] row replaces the slot's ENTIRE cache —
+                # either freshly initialized (stale K/V from the
+                # previous occupant must not leak) or chunk-prefilled
+                # with the new prompt
                 caches = jax.tree_util.tree_map(
                     lambda pool, one: jax.lax.dynamic_update_slice(
                         pool, one.astype(pool.dtype),
                         (b,) + (0,) * (pool.ndim - 1)),
-                    caches, fresh)
+                    caches, cache_row)
                 return (tokens, pos, plens, totals, active, seeds, its,
                         caches)
 
             self._admit_fn = jax.jit(admit, donate_argnums=(0,))
+            self._fresh_fn = jax.jit(
+                lambda: gen._init_caches(1, gen._model_dtype()))
+        if self.chunked_prefill and plen >= 2:
+            # one parallel pass fills the slot's cache with the prompt;
+            # the row starts at the scan cursor the standard decode
+            # path uses (rolling windows prefill a smaller chunk and
+            # the tick's prompt-forcing finishes the remainder)
+            tp, start, _ = gen._prefill_dispatch(plen, plen + max_new)
+            chunk = np.zeros((tp,), np.int32)
+            chunk[:min(plen, tp)] = prompt[:tp]
+            cache_row = gen._prefill_fn(1, tp)(
+                gen.params, jnp.asarray(chunk[None]))
+            pos0 = start
+        else:
+            cache_row = self._fresh_fn()
+            pos0 = 0
         prow = np.zeros((self.gen.max_len,), np.int32)
-        prow[:len(prompt)] = prompt
+        prow[:plen] = prompt
         st = (self._tokens, self._pos, self._plen, self._total,
               self._active, self._seeds, self._inv_temp, self._caches)
         st = self._admit_fn(st, jnp.int32(b), jnp.asarray(prow),
-                            jnp.int32(len(prompt)),
-                            jnp.int32(len(prompt) + max_new),
+                            jnp.int32(plen),
+                            jnp.int32(plen + max_new),
                             jnp.int32(seed),
                             jnp.float32(0.0 if temperature == 0.0
-                                        else 1.0 / temperature))
+                                        else 1.0 / temperature),
+                            jnp.int32(pos0), cache_row)
         (self._tokens, self._pos, self._plen, self._total,
          self._active, self._seeds, self._inv_temp, self._caches) = st
         self._slot_req[b] = rid
