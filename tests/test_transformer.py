@@ -171,3 +171,155 @@ def test_tied_embeddings_lm():
 def _leaves(tree):
     import jax
     return jax.tree_util.tree_leaves(tree)
+
+
+class TestLoRA:
+    def test_dense_adapter_freezes_base_and_starts_at_identity(self):
+        """All2All lora_rank: output == base at init (B = 0); training
+        moves ONLY the rank-r factors — W and b stay bit-frozen."""
+        import jax
+        import jax.numpy as jnp
+
+        from veles_tpu import prng
+        from veles_tpu.models import optimizer
+        from veles_tpu.models.layers import make_layer
+        from veles_tpu.ops import linear
+        prng.seed_all(2)
+        base = make_layer({"type": "all2all_tanh",
+                           "output_sample_shape": 6})
+        base.setup((5,))
+        lora = make_layer({"type": "all2all_tanh",
+                           "output_sample_shape": 6, "lora_rank": 2})
+        lora.setup((5,))
+        prng.seed_all(7)
+        p = lora.init_params(prng.get("t"))
+        assert p["lora_a"].shape == (5, 2) and p["lora_b"].shape == (2, 6)
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 5), jnp.float32)
+        base_p = {k: v for k, v in p.items() if not k.startswith("lora")}
+        np.testing.assert_allclose(np.asarray(lora.apply(p, x)),
+                                   np.asarray(base.apply(base_p, x)),
+                                   rtol=1e-6)
+
+        def loss(params):
+            return jnp.sum(jnp.square(linear.forward(params, x)))
+
+        p = {k: jnp.asarray(v) for k, v in p.items()}
+        g = jax.grad(loss)(p)
+        assert float(jnp.abs(g["weights"]).max()) == 0.0   # frozen
+        assert float(jnp.abs(g["bias"]).max()) == 0.0
+        # at init B = 0, so only B receives gradient (dL/dA = ... · Bᵀ);
+        # once B moves, A becomes trainable too
+        assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+        assert float(jnp.abs(g["lora_a"]).max()) == 0.0
+        params2, _ = optimizer.update(
+            {"l": p}, {"l": g},
+            optimizer.init_state({"l": p}),
+            {"l": optimizer.resolve_hyper({"solver": "gd",
+                                           "learning_rate": 0.1})})
+        np.testing.assert_array_equal(np.asarray(params2["l"]["weights"]),
+                                      np.asarray(p["weights"]))
+        g2 = jax.grad(loss)(params2["l"])
+        assert float(jnp.abs(g2["lora_a"]).max()) > 0.0    # now trainable
+
+    def test_lora_fine_tune_trains_only_adapters(self):
+        """The full parameter-efficient flow: pretrain a base LM →
+        snapshot → rebuild with lora_rank + warm-start → fine-tune on a
+        SHIFTED task.  Only the q/v adapters move; every base leaf
+        stays bit-identical; the adapted model beats the frozen base on
+        the new task and still decodes through LMGenerator."""
+        import jax
+
+        from veles_tpu.models.generate import LMGenerator
+        from veles_tpu.services.snapshotter import TrainingSnapshotter
+
+        def data(shift, seed):
+            r = np.random.RandomState(seed)
+            return ((np.arange(16)[None, :] * shift
+                     + r.randint(0, 4, 192)[:, None]) % 13).astype(
+                         np.int32)
+
+        def build(toks, lora_rank, max_epochs, lr):
+            loader = FullBatchLoader(None, data=toks, labels=toks,
+                                     minibatch_size=48,
+                                     class_lengths=[0, 48, 144])
+            return StandardWorkflow(
+                layers=zoo.transformer_lm(
+                    vocab_size=13, d_model=32, n_heads=4, n_layers=1,
+                    lr=lr, dropout=0.0, lora_rank=lora_rank,
+                    solver="adam"),
+                loader=loader, loss="lm",
+                decision_config={"max_epochs": max_epochs},
+                name="lora-lm")
+
+        prng.seed_all(51)
+        base_wf = build(data(2, 5), 0, 12, 5e-3)  # base task: +2 pattern
+        base_wf.initialize()
+        base_wf.run()
+        snap = {"params": base_wf.trainer.host_params()}
+
+        # new task: +3 pattern.  Adapters need a higher lr than full
+        # fine-tuning (rank-8 q/v at lr 0.05 reaches 0% here; lr 5e-3
+        # stalls at ~53% — measured sweep in the round-4 session log)
+        prng.seed_all(52)
+        ft = build(data(3, 6), 8, 20, 0.05)
+        ft.initialize()
+        TrainingSnapshotter.warm_start(ft, snap)
+        before = jax.tree_util.tree_map(np.asarray,
+                                        ft.trainer.host_params())
+        ft.run()
+        after = jax.tree_util.tree_map(np.asarray,
+                                       ft.trainer.host_params())
+
+        moved, frozen_ok = [], True
+        for lname, sub in before.items():
+            flat_b = list(jax.tree_util.tree_leaves_with_path(sub))
+            flat_a = {jax.tree_util.keystr(pp): ll for pp, ll in
+                      jax.tree_util.tree_leaves_with_path(after[lname])}
+            for path, leaf in flat_b:
+                key = jax.tree_util.keystr(path)
+                same = np.array_equal(leaf, flat_a[key])
+                if "lora" in key:
+                    if not same:
+                        moved.append((lname, key))
+                else:
+                    frozen_ok &= same
+        assert moved, "no adapter moved"
+        assert frozen_ok, "a frozen base leaf changed"
+        # the adapted model learned the shifted pattern
+        assert ft.decision.best_metric < 0.10, ft.decision.best_metric
+        # and serves through the standard decode paths
+        gen = LMGenerator(ft.trainer, max_len=16)
+        out = gen.generate(data(3, 6)[:1, :6], 6)
+        assert out.shape == (1, 12)
+
+    def test_weight_decay_does_not_pierce_the_freeze(self):
+        """adamw's decoupled decay acts OUTSIDE the gradient, so
+        stop_gradient alone wouldn't stop it — adapted layers must zero
+        their weights_decay or 'frozen' base matrices shrink every
+        step."""
+        import jax
+
+        prng.seed_all(53)
+        toks = _lm_tokens(vocab=13, t=16)[:192] % 13
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=48,
+                                 class_lengths=[0, 48, 144])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=13, d_model=32,
+                                      n_heads=4, n_layers=1, lr=0.05,
+                                      dropout=0.0, lora_rank=4,
+                                      solver="adamw"),
+            loader=loader, loss="lm",
+            gd_defaults={"weights_decay": 0.05},
+            decision_config={"max_epochs": 3}, name="lora-wd")
+        wf.initialize()
+        before = jax.tree_util.tree_map(np.asarray,
+                                        wf.trainer.host_params())
+        wf.run()
+        after = wf.trainer.host_params()
+        blk = [n for n in before if "transformer_block" in n][0]
+        np.testing.assert_array_equal(
+            np.asarray(before[blk]["mha"]["wq"]),
+            np.asarray(after[blk]["mha"]["wq"]))
+        np.testing.assert_array_equal(
+            np.asarray(before[blk]["w1"]), np.asarray(after[blk]["w1"]))

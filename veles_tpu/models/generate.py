@@ -1011,8 +1011,8 @@ class ContinuousBatcher:
         gen = self.gen
         plen = len(prompt)
         if self._admit_fn is None:
-            def admit(st, b, prow, plen, total, seed, inv_temp, pos0,
-                      cache_row):
+            def admit_body(st, b, prow, plen, total, seed, inv_temp,
+                           pos0, cache_row):
                 (tokens, pos, plens, totals, active, seeds, its,
                  caches) = st
                 tokens = jax.lax.dynamic_update_slice(
@@ -1035,9 +1035,18 @@ class ContinuousBatcher:
                 return (tokens, pos, plens, totals, active, seeds, its,
                         caches)
 
-            self._admit_fn = jax.jit(admit, donate_argnums=(0,))
-            self._fresh_fn = jax.jit(
-                lambda: gen._init_caches(1, gen._model_dtype()))
+            def admit_fresh(st, b, prow, plen, total, seed, inv_temp):
+                # fresh values built INSIDE the jit (zeros, QuantCache
+                # scale ones) — the non-prefill path pays no extra
+                # dispatch and no host-built zero tree
+                return admit_body(st, b, prow, plen, total, seed,
+                                  inv_temp, jnp.int32(0),
+                                  gen._init_caches(1,
+                                                   gen._model_dtype()))
+
+            self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
+            self._admit_fresh_fn = jax.jit(admit_fresh,
+                                           donate_argnums=(0,))
         if self.chunked_prefill and plen >= 2:
             # one parallel pass fills the slot's cache with the prompt;
             # the row starts at the scan cursor the standard decode
@@ -1050,19 +1059,20 @@ class ContinuousBatcher:
                 gen.params, jnp.asarray(chunk[None]))
             pos0 = start
         else:
-            cache_row = self._fresh_fn()
+            cache_row = None
             pos0 = 0
         prow = np.zeros((self.gen.max_len,), np.int32)
         prow[:plen] = prompt
         st = (self._tokens, self._pos, self._plen, self._total,
               self._active, self._seeds, self._inv_temp, self._caches)
-        st = self._admit_fn(st, jnp.int32(b), jnp.asarray(prow),
-                            jnp.int32(plen),
-                            jnp.int32(plen + max_new),
-                            jnp.int32(seed),
-                            jnp.float32(0.0 if temperature == 0.0
-                                        else 1.0 / temperature),
-                            jnp.int32(pos0), cache_row)
+        args = (st, jnp.int32(b), jnp.asarray(prow), jnp.int32(plen),
+                jnp.int32(plen + max_new), jnp.int32(seed),
+                jnp.float32(0.0 if temperature == 0.0
+                            else 1.0 / temperature))
+        if cache_row is None:
+            st = self._admit_fresh_fn(*args)
+        else:
+            st = self._admit_fn(*args, jnp.int32(pos0), cache_row)
         (self._tokens, self._pos, self._plen, self._total,
          self._active, self._seeds, self._inv_temp, self._caches) = st
         self._slot_req[b] = rid

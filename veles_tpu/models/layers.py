@@ -106,10 +106,21 @@ class All2All(Layer):
 
     def init_params(self, rng):
         n_out = int(math.prod(self.output_shape))
-        return linear.init_params(
+        params = linear.init_params(
             rng, self.n_in, n_out, bias=self.cfg.get("include_bias", True),
             weights_stddev=self.cfg.get("weights_stddev"),
             dtype=self.policy.param)
+        r = int(self.cfg.get("lora_rank", 0))
+        if r > 0:
+            # LoRA: base W/b freeze (ops.linear stop_gradients them);
+            # B = 0 makes the adapted layer exactly the base at init —
+            # pair with --warm-start to fine-tune a pretrained model
+            # training only these rank-r factors
+            params["lora_a"] = jnp.asarray(
+                rng.normal(0.0, self.n_in ** -0.5, (self.n_in, r)),
+                self.policy.param)
+            params["lora_b"] = jnp.zeros((r, n_out), self.policy.param)
+        return params
 
     def apply(self, params, x, train=False, key=None):
         y = linear.forward(params, x, self.policy)
@@ -710,10 +721,41 @@ class TransformerBlock(Layer):
                                   self.policy.param),
                 "b2": jnp.zeros((f,), self.policy.param),
             })
+        r = int(self.cfg.get("lora_rank", 0))
+        if r > 0:
+            # LoRA q/v adapters (Hu et al. 2021): rank-r factors added
+            # to the attention's q and v projections; qb/vb start at
+            # ZERO so the adapted block computes exactly the base.
+            # At train time apply() freezes every base leaf — pair
+            # with --warm-start to fine-tune a pretrained checkpoint
+            # updating only ~2·2·f·r params per block.
+            d_kv = (f // self.n_heads) * self.n_kv_heads
+            params["mha"]["lora"] = {
+                "qa": jnp.asarray(rng.normal(0.0, std, (f, r)),
+                                  self.policy.param),
+                "qb": jnp.zeros((r, f), self.policy.param),
+                "va": jnp.asarray(rng.normal(0.0, std, (f, r)),
+                                  self.policy.param),
+                "vb": jnp.zeros((r, d_kv), self.policy.param),
+            }
         return params
+
+    @staticmethod
+    def _lora_freeze(params):
+        """stop_gradient every base leaf, keeping only the lora subtree
+        trainable (the standard LoRA contract)."""
+        lora = params["mha"]["lora"]
+        base = {k: ({mk: mv for mk, mv in v.items() if mk != "lora"}
+                    if k == "mha" else v)
+                for k, v in params.items()}
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, base)
+        frozen["mha"]["lora"] = lora
+        return frozen
 
     def apply(self, params, x, train=False, key=None):
         from veles_tpu.ops import attention, norm
+        if train and "lora" in params.get("mha", {}):
+            params = self._lora_freeze(params)
         ratio = self.cfg.get("dropout_ratio", 0.0)
         k1 = k2 = None
         if train and ratio > 0.0 and key is not None:

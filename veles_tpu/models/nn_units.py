@@ -138,6 +138,16 @@ class StagedTrainer(Unit):
                     jnp.asarray, layer.init_params(rng))
                 hypers[layer.name] = optimizer.resolve_hyper(
                     layer.gd, self.gd_defaults, layer_type=layer.type)
+                if int(layer.cfg.get("lora_rank", 0)) > 0:
+                    # LoRA freeze is stop_gradient on the base leaves —
+                    # but weight DECAY applies outside the gradient
+                    # (adamw's decoupled w - lr*wd*w especially), so a
+                    # configured weights_decay would silently shrink
+                    # the "frozen" base matrices every step.  Adapted
+                    # layers therefore decay nothing.
+                    hypers[layer.name] = dict(
+                        hypers[layer.name], weights_decay=0.0,
+                        weights_decay_bias=0.0)
         self.velocity = optimizer.init_state(self.params,
                                              grad_accum=self.grad_accum,
                                              ema_decay=self.ema_decay,

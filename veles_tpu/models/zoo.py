@@ -140,7 +140,7 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=None, lr=0.001, moment=0.9, dropout=0.0,
                    impl="blockwise", solver="adam", n_experts=0,
                    n_kv_heads=None, remat=False, pos="learned",
-                   window=None, tie_embeddings=False):
+                   window=None, tie_embeddings=False, lora_rank=0):
     """Decoder-only causal LM over int token samples [T].
     ``n_kv_heads`` < n_heads = grouped-query attention; ``remat=True``
     rematerializes each block's activations in the backward pass
@@ -150,15 +150,23 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
     "learned" | "sinusoid" position table, or "rope" (rotary q/k in
     every block, no table — extrapolates past the train length);
     ``tie_embeddings`` reuses the embedding table as the LM head
-    (saves vocab×d_model params)."""
+    (saves vocab×d_model params); ``lora_rank`` > 0 = parameter-
+    efficient fine-tuning: every block gains rank-r q/v adapters, the
+    blocks' base weights freeze via stop_gradient, and the
+    embedding/position/norm/head layers freeze via learning_rate 0 —
+    pair with ``--warm-start base_snapshot`` so only the adapters
+    train (Hu et al. 2021)."""
     if pos not in ("learned", "sinusoid", "rope"):
         raise ValueError("pos must be learned|sinusoid|rope")
     gd = {"learning_rate": lr, "gradient_moment": moment, "solver": solver}
+    # resolve_hyper falls learning_rate_bias back to learning_rate, so
+    # zeroing the one lr freezes weights AND biases of the outer layers
+    outer = dict(gd, learning_rate=0.0) if lora_rank else gd
     layers = [dict({"type": "embedding", "vocab_size": vocab_size,
-                    "d_model": d_model}, **gd)]
+                    "d_model": d_model}, **outer)]
     if pos != "rope":
         layers.append(dict({"type": "positional_encoding",
-                            "learned": pos == "learned"}, **gd))
+                            "learned": pos == "learned"}, **outer))
     for _ in range(n_layers):
         layers.append(dict({"type": "transformer_block",
                             "n_heads": n_heads,
@@ -167,9 +175,10 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                             "causal": True, "dropout_ratio": dropout,
                             "impl": impl, "n_experts": n_experts,
                             "remat": remat, "rope": pos == "rope",
+                            "lora_rank": lora_rank,
                             "window": window},
                            **gd))
-    layers.append(dict({"type": "layer_norm"}, **gd))
+    layers.append(dict({"type": "layer_norm"}, **outer))
     if tie_embeddings:
         # tie_to by TYPE — the trainer resolves it to the layer's
         # assigned name at initialize
@@ -177,7 +186,7 @@ def transformer_lm(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                        "tie_to": "embedding"})
     else:
         layers.append(dict({"type": "timestep_dense",
-                            "output_sample_shape": vocab_size}, **gd))
+                            "output_sample_shape": vocab_size}, **outer))
     return layers
 
 

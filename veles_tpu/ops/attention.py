@@ -289,14 +289,30 @@ def mha_forward(params, x, n_heads, causal=False, impl="blockwise",
 
 def _qkv_proj(params, x, n_heads, n_kv_heads, policy):
     """Shared q/k/v projection + head split (mha_forward, mha_prefill,
-    mha_step all route through here so they can never drift apart)."""
+    mha_step all route through here so they can never drift apart).
+
+    LoRA (Hu et al. 2021, the standard q/v recipe): an optional
+    ``params["lora"]`` sub-dict carries rank-r factors qa/qb and va/vb;
+    the effective projections become Wq + qa·qb and Wv + va·vb.  Every
+    decode path inherits the adapters through this one chokepoint.
+    (Base-weight freezing is the LAYER's job — TransformerBlock
+    stop_gradients everything but the lora subtree at train time.)"""
     cast = (lambda t: t) if policy is None else policy.cast_in
-    q = split_heads(cast(_proj(x, params["wq"], params["bq"], policy)),
-                    n_heads)
+    lora = params.get("lora")
+
+    def proj(wk_, bk_, ak_, bk2_, heads):
+        y = _proj(x, params[wk_], params[bk_], policy)
+        if lora is not None and ak_ in lora:
+            d = jnp.matmul(jnp.matmul(cast(x), cast(lora[ak_])),
+                           cast(lora[bk2_]))
+            y = y + d.astype(y.dtype)
+        return split_heads(cast(y), heads)
+
+    q = proj("wq", "bq", "qa", "qb", n_heads)
+    # k carries NO adapters (the standard q/v-only recipe) — plain base
     k = split_heads(cast(_proj(x, params["wk"], params["bk"], policy)),
                     n_kv_heads)
-    v = split_heads(cast(_proj(x, params["wv"], params["bv"], policy)),
-                    n_kv_heads)
+    v = proj("wv", "bv", "va", "vb", n_kv_heads)
     return q, k, v
 
 

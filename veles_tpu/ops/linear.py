@@ -25,8 +25,25 @@ def matmul(a, b, policy=Policy()):
 
 def forward(params, x, policy=Policy()):
     """All2All forward: y = x W + b (ref Znicz all2all; weights stored
-    (in, out) so the batch dim rides the MXU rows)."""
-    y = matmul(x.reshape(x.shape[0], -1), params["weights"], policy)
+    (in, out) so the batch dim rides the MXU rows).
+
+    LoRA (Hu et al. 2021): when the param tree carries ``lora_a``
+    [in, r] / ``lora_b`` [r, out] adapters, the effective weight is
+    W + A·B with the BASE W and b frozen via stop_gradient — training
+    touches only the rank-r factors (alpha = r convention, scale 1).
+    ``lora_b`` initializes to zero, so a freshly adapted layer computes
+    exactly the base layer."""
+    import jax
+    xf = x.reshape(x.shape[0], -1)
+    if "lora_a" in params:
+        y = matmul(xf, jax.lax.stop_gradient(params["weights"]), policy)
+        y = y + matmul(matmul(xf, params["lora_a"], policy),
+                       params["lora_b"], policy)
+        if "bias" in params:
+            y = y + jax.lax.stop_gradient(
+                params["bias"]).astype(policy.accum)
+        return y
+    y = matmul(xf, params["weights"], policy)
     if "bias" in params:
         y = y + params["bias"].astype(policy.accum)
     return y

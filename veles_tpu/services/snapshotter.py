@@ -373,6 +373,8 @@ class TrainingSnapshotter(SnapshotterBase):
         import numpy as np
 
         log = logging.getLogger("Snapshotter")
+        from veles_tpu.services.export import (_flatten_params,
+                                               unflatten_params)
         trainer = workflow.trainer
         live = trainer.host_params()
         merged = {}
@@ -380,25 +382,33 @@ class TrainingSnapshotter(SnapshotterBase):
         snap_params = snapshot["params"]
         for lname, sub in live.items():
             src = snap_params.get(lname)
-            merged[lname] = {}
-            for pname, arr in sub.items():
-                cand = None if src is None else src.get(pname)
+            # leaf-wise over "/"-joined names so NESTED trees
+            # (transformer blocks' mha/ln subtrees, residual composites,
+            # LoRA adapters) warm-start per leaf — a lora model
+            # warm-started from a base snapshot restores every base
+            # matrix and keeps its fresh adapters
+            flat_live = _flatten_params(sub)
+            flat_src = {} if src is None else _flatten_params(src)
+            out = {}
+            for pname, arr in flat_live.items():
+                cand = flat_src.get(pname)
                 if cand is not None and \
                         np.shape(cand) == np.shape(arr):
                     # cast to the LIVE dtype: an f32 snapshot must not
                     # plant f32 leaves into a bf16-master-params tree
                     # (mixed-dtype donation/retrace errors)
-                    merged[lname][pname] = np.asarray(cand).astype(
+                    out[pname] = np.asarray(cand).astype(
                         np.asarray(arr).dtype)
                     restored += 1
                 else:
-                    merged[lname][pname] = arr
+                    out[pname] = arr
                     skipped += 1
                     if cand is not None:
                         log.warning(
                             "warm-start: %s/%s shape %s != snapshot %s "
                             "— keeping fresh init", lname, pname,
                             np.shape(arr), np.shape(cand))
+            merged[lname] = unflatten_params(out)
         dropped = sorted(set(snap_params) - set(live))
         if dropped:
             log.info("warm-start: snapshot layers not in this model: %s",
