@@ -281,9 +281,11 @@ class Main(object):
                                       {"interval": args.snapshot_every})
             self.workflow = cls(**kwargs)
             snapshot = args.snapshot
-            if snapshot == "auto":
+            auto = snapshot == "auto"
+            if auto:
                 snapshot = self._resolve_auto_snapshot(self.workflow)
             self._pending_warm_start = None
+            self._pending_snapshot = None
             if snapshot:
                 from veles_tpu.services.snapshotter import SnapshotterBase
                 # initialize first so staged steps exist, then restore.
@@ -291,19 +293,32 @@ class Main(object):
                 # checkpoint WINS: the preemption-restart idiom (exit
                 # 75 → same command) must keep fine-tuning progress,
                 # not re-warm-start from the base snapshot.
-                self._pending_snapshot = SnapshotterBase.import_(
-                    snapshot,
-                    allow_remote=args.allow_remote_snapshot,
-                    expected_sha256=args.snapshot_sha256)
-            else:
-                self._pending_snapshot = None
-                if args.warm_start:
-                    from veles_tpu.services.snapshotter import \
-                        SnapshotterBase
-                    self._pending_warm_start = SnapshotterBase.import_(
-                        args.warm_start,
+                try:
+                    self._pending_snapshot = SnapshotterBase.import_(
+                        snapshot,
                         allow_remote=args.allow_remote_snapshot,
                         expected_sha256=args.snapshot_sha256)
+                except Exception as e:  # noqa: BLE001 — see below
+                    if not auto or args.snapshot_sha256:
+                        # an explicit path must fail loudly; and a
+                        # sha256 pin names ONE exact artifact — falling
+                        # back to a different (unpinned) file would
+                        # defeat the integrity gate
+                        raise
+                    # restart-on-failure must never crash-loop on a
+                    # torn checkpoint (a kill can land inside a
+                    # checkpoint commit): step back to the next-newest
+                    # complete one, else start fresh
+                    self._pending_snapshot = \
+                        self._auto_snapshot_fallback(snapshot, e)
+            if self._pending_snapshot is None and args.warm_start:
+                # no (loadable) checkpoint anywhere — the fine-tuning
+                # initializer applies exactly as on a fresh start
+                from veles_tpu.services.snapshotter import SnapshotterBase
+                self._pending_warm_start = SnapshotterBase.import_(
+                    args.warm_start,
+                    allow_remote=args.allow_remote_snapshot,
+                    expected_sha256=args.snapshot_sha256)
             if web is not None:
                 web.register(self.workflow)
             return self.workflow
@@ -603,6 +618,43 @@ class Main(object):
                 exec(compile(f.read(), args.config, "exec"), scope)
         for stmt in args.config_list:
             exec(stmt, {"root": root, "Range": Range})
+
+    @staticmethod
+    def _auto_snapshot_fallback(current, error):
+        """--snapshot auto hit a torn/unloadable checkpoint: try the
+        other snapshots of the same prefix, newest first; None (fresh
+        start) when none load.  A supervisor restart loop must converge
+        to training, never to a crash loop."""
+        import os
+
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        real = os.path.realpath(current)
+        directory = os.path.dirname(real)
+        prefix = os.path.basename(current).replace("_current", "")
+        print("[auto-resume] %s failed to load (%s) — trying older "
+              "checkpoints" % (real, error), file=sys.stderr)
+        candidates = sorted(
+            (os.path.join(directory, n) for n in os.listdir(directory)
+             # prefix + "_": the filename format is "<prefix>_<suffix>"
+             # — a bare startswith would also match a DIFFERENT
+             # workflow ("digits-mlp-big") sharing the snapshot dir
+             if n.startswith(prefix + "_")
+             and not n.endswith("_current")
+             and os.path.join(directory, n) != real),
+            key=os.path.getmtime, reverse=True)
+        for cand in candidates:
+            try:
+                snap = SnapshotterBase.import_(cand)
+            except Exception as e:  # noqa: BLE001 — keep stepping back
+                print("[auto-resume] %s also failed (%s)" % (cand, e),
+                      file=sys.stderr)
+                continue
+            print("[auto-resume] recovered from %s" % cand,
+                  file=sys.stderr)
+            return snap
+        print("[auto-resume] no loadable checkpoint — fresh start",
+              file=sys.stderr)
+        return None
 
     @staticmethod
     def _resolve_auto_snapshot(wf):

@@ -607,6 +607,19 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         return path
 
     def _finalize(self, name, path):
+        # orbax finalizes the data rename synchronously but writes the
+        # COMMIT MARKER (_CHECKPOINT_METADATA) from a background
+        # executor — restore refuses a checkpoint without it, so a
+        # crash in that window would leave _current pointing at an
+        # unloadable directory.  Wait for the marker before flipping.
+        marker = os.path.join(path, "arrays", "_CHECKPOINT_METADATA")
+        deadline = time.time() + 30.0
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.02)
+        if not os.path.exists(marker):
+            self.warning("orbax commit marker never appeared for %s — "
+                         "NOT flipping _current", path)
+            return
         if jax.process_index() == 0:
             self._flip_current(name)
         self.destination = path   # only once the commit is final
