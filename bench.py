@@ -432,6 +432,14 @@ def phase_lm_large():
     # (recompute never counts toward MFU).  Full remat at b16, then b8,
     # are the progressively-smaller-memory fallbacks.
     ladder = [("dots", 16, 8), (True, 16, 8), (True, 8, 12)]
+    try:  # the rung order is model-ranked; log the predicted MFUs
+        from tools.cost_model import predict_lm_large_ladder
+        _log("lm_large ladder predicted MFU: %s"
+             % ["%s/b%d: %.1f%%" % (r["remat"], r["batch"],
+                                    100 * r["mfu"])
+                for r in predict_lm_large_ladder()])
+    except Exception:  # noqa: BLE001 — advisory only
+        pass
     for i, (remat, batch, steps) in enumerate(ladder):
         try:
             return dict(_run_lm("lm-124M[remat=%s,b%d]" % (remat, batch),
@@ -807,30 +815,44 @@ def phase_flashtune():
     import jax.numpy as jnp
     from veles_tpu.ops.pallas.flash import flash_attention
 
+    # model-ranked order (tools/cost_model.py), OUTER loop — both T
+    # shapes of the predicted-best config are measured before the
+    # ranking descends, so a tunnel that dies mid-sweep costs the
+    # predicted-worst configs, not a whole T shape
+    try:
+        from tools.cost_model import predict_flashtune_order
+        order = [tuple(c) for c in predict_flashtune_order()]
+    except Exception:  # noqa: BLE001 — ranking is advisory
+        order = [(bq, bk) for bq in (512, 256, 128)
+                 for bk in (512, 256, 128)]
+
     key = jax.random.key(0)
-    grid = {}
+    inputs = {}
     for t in (1024, 8192):
         b, h, d = (4, 8, 128) if t == 1024 else (1, 8, 128)
-        q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16) * 0.1
-                   for kk in jax.random.split(key, 3))
-        flops = _causal_attn_flops(b, h, t, d)
-        for bq in (128, 256, 512):
-            for bk in (128, 256, 512):
-                fn = lambda q_, k_, v_: flash_attention(  # noqa: E731
-                    q_, k_, v_, causal=True, block_q=bq, block_k=bk)
-                try:
-                    ms = _chain_attn(fn, q, k, v, iters=10)
-                    ms_bwd = _chain_attn(fn, q, k, v, iters=5, grad=True)
-                except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
-                    _log("T=%d bq=%d bk=%d: failed (%s)"
-                         % (t, bq, bk, type(e).__name__))
-                    continue
-                grid["t%d_q%d_k%d" % (t, bq, bk)] = {
-                    "ms": round(ms, 3), "ms_bwd": round(ms_bwd, 3),
-                    "tf": round(flops / (ms / 1e3) / 1e12, 1)}
-                _log("T=%d bq=%-3d bk=%-3d: fwd %.3f ms (%.1f TF/s) "
-                     "fwd+bwd %.3f ms"
-                     % (t, bq, bk, ms, flops / (ms / 1e3) / 1e12, ms_bwd))
+        inputs[t] = tuple(
+            jax.random.normal(kk, (b, h, t, d), jnp.bfloat16) * 0.1
+            for kk in jax.random.split(key, 3)) + (
+                _causal_attn_flops(b, h, t, d),)
+    grid = {}
+    for bq, bk in order:
+        for t in (1024, 8192):
+            q, k, v, flops = inputs[t]
+            fn = lambda q_, k_, v_: flash_attention(  # noqa: E731
+                q_, k_, v_, causal=True, block_q=bq, block_k=bk)
+            try:
+                ms = _chain_attn(fn, q, k, v, iters=10)
+                ms_bwd = _chain_attn(fn, q, k, v, iters=5, grad=True)
+            except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+                _log("T=%d bq=%d bk=%d: failed (%s)"
+                     % (t, bq, bk, type(e).__name__))
+                continue
+            grid["t%d_q%d_k%d" % (t, bq, bk)] = {
+                "ms": round(ms, 3), "ms_bwd": round(ms_bwd, 3),
+                "tf": round(flops / (ms / 1e3) / 1e12, 1)}
+            _log("T=%d bq=%-3d bk=%-3d: fwd %.3f ms (%.1f TF/s) "
+                 "fwd+bwd %.3f ms"
+                 % (t, bq, bk, ms, flops / (ms / 1e3) / 1e12, ms_bwd))
     return grid
 
 
@@ -1074,6 +1096,14 @@ def main():
         "error": ("; ".join("%s: %s" % kv for kv in sorted(errors.items()))
                   or None),
     }
+    # predicted-vs-measured record (tools/cost_model.py): every number
+    # above has an offline roofline prediction riding alongside, so a
+    # short uptime window confirms the model instead of exploring
+    try:
+        from tools.cost_model import predictions_for_bench
+        line["predicted"] = predictions_for_bench()
+    except Exception as e:  # noqa: BLE001 — predictions are advisory
+        _log("cost model unavailable: %s" % e)
     if gemm.get("ok"):
         try:
             with open(_CACHE, "w") as f:
