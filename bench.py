@@ -53,9 +53,9 @@ PHASES = [
 
 
 def _causal_attn_flops(b, h, t, d):
-    """Matmul FLOPs of ONE causal attention call (qk + pv, each 2·b·h·
-    t·(t/2)·d with the triangular mask halving effective keys)."""
-    return 4 * b * h * t * t * d / 2
+    """Shared convention — see veles_tpu/ops/flops.py."""
+    from veles_tpu.ops.flops import causal_attn_flops
+    return causal_attn_flops(b, h, t, d)
 
 #: detected bf16 peak by device_kind substring (TFLOP/s) — the MFU
 #: denominator.  Order matters ("v5 lite" before "v5").
@@ -326,17 +326,11 @@ def phase_alexnet():
 
 def _lm_train_flops_per_token(d_model, n_layers, seq, vocab, d_ff=None,
                               n_heads=None, n_kv_heads=None):
-    """Analytic matmul FLOPs per trained token (fwd+bwd = 3x fwd): per
-    layer q/o project 2·d² each, k/v project 2·d·d_kv each (GQA shrinks
-    d_kv = d·n_kv/n_heads), MLP 2·(2·d_ff·d), causal attention 2·T·d
-    (T/2 effective keys, qk + pv), plus the 2·d·V LM head.  Embedding
-    lookup is a gather — no FLOPs."""
-    d_ff = d_ff or 4 * d_model
-    kv_frac = ((n_kv_heads / n_heads)
-               if n_heads and n_kv_heads else 1.0)
-    per_layer = ((4 + 4 * kv_frac) * d_model ** 2
-                 + 4 * d_ff * d_model + 2 * seq * d_model)
-    return 3 * (n_layers * per_layer + 2 * d_model * vocab)
+    """Shared convention — see veles_tpu/ops/flops.py."""
+    from veles_tpu.ops.flops import lm_train_flops_per_token
+    return lm_train_flops_per_token(d_model, n_layers, seq, vocab,
+                                    d_ff=d_ff, n_heads=n_heads,
+                                    n_kv_heads=n_kv_heads)
 
 
 def _run_lm(tag, zoo_kwargs, batch, seq, steps, steps_per_dispatch,
@@ -431,7 +425,9 @@ def phase_lm_large():
     # so the backward skips the recompute FLOPs that full remat burns
     # (recompute never counts toward MFU).  Full remat at b16, then b8,
     # are the progressively-smaller-memory fallbacks.
-    ladder = [("dots", 16, 8), (True, 16, 8), (True, 8, 12)]
+    from veles_tpu.ops.flops import LM_LARGE_LADDER
+    ladder = [(remat, batch, steps)
+              for remat, batch, steps, _ in LM_LARGE_LADDER]
     try:  # the rung order is model-ranked; log the predicted MFUs
         from tools.cost_model import predict_lm_large_ladder
         _log("lm_large ladder predicted MFU: %s"

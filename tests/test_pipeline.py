@@ -279,3 +279,157 @@ def test_pipelined_transformer_rejects_unsupported_options():
     with pytest.raises(ValueError, match="sequence-"):
         make_layer({"type": "pipelined_transformer", "n_blocks": 2,
                     "n_heads": 4, "impl": "ring"}).setup((8, 16))
+
+
+class Test1F1B:
+    """1F1B training schedule: grad/loss parity vs single-device
+    autodiff AND vs GPipe, uneven stages (embed→blocks→head), and the
+    O(M)→O(S) activation-memory win (compiled temp bytes)."""
+
+    D, V, T = 8, 12, 6
+
+    def _params(self, n_blocks=4, seed=0):
+        r = np.random.RandomState(seed)
+        f32 = np.float32
+        p_first = {"emb": jnp.asarray(r.randn(self.V, self.D)
+                                      .astype(f32) * 0.5)}
+        p_blocks = {"w": jnp.asarray(r.randn(n_blocks, self.D, self.D)
+                                     .astype(f32) * 0.5),
+                    "b": jnp.asarray(r.randn(n_blocks, self.D)
+                                     .astype(f32) * 0.1)}
+        p_last = {"head": jnp.asarray(r.randn(self.D, self.V)
+                                      .astype(f32) * 0.5)}
+        return p_first, p_blocks, p_last
+
+    @staticmethod
+    def _first(p, x_mb):
+        return p["emb"][x_mb]                      # int tokens -> h
+
+    @staticmethod
+    def _last(p, h, y_mb):
+        logits = h @ p["head"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        oh = jax.nn.one_hot(y_mb, logits.shape[-1])
+        return -jnp.mean(jnp.sum(logp * oh, axis=-1))
+
+    def _data(self, batch=8, seed=1):
+        r = np.random.RandomState(seed)
+        x = jnp.asarray(r.randint(0, self.V, (batch, self.T))
+                        .astype(np.int32))
+        y = jnp.asarray(r.randint(0, self.V, (batch, self.T))
+                        .astype(np.int32))
+        return x, y
+
+    def _ref_loss(self, params, x, y):
+        pf, pb, pl = params
+        h, _ = jax.lax.scan(lambda hh, pk: (_stage_fn(pk, hh), None),
+                            self._first(pf, x), pb)
+        return self._last(pl, h, y)
+
+    @pytest.mark.parametrize("pipe,m", [(4, 4), (4, 8), (2, 4), (8, 8)])
+    def test_loss_and_grads_match_single_device(self, pipe, m):
+        params = self._params(n_blocks=pipe)
+        x, y = self._data(batch=2 * m)
+        mesh = make_mesh({"pipe": pipe})
+        loss, grads = pipeline.pipeline_train_1f1b_sharded(
+            _stage_fn, self._first, self._last, params, x, y, mesh,
+            n_microbatches=m)
+        ref_loss, ref_grads = jax.value_and_grad(self._ref_loss)(
+            (params[0], params[1], params[2]), x, y)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        for g, r in zip(jax.tree_util.tree_leaves(grads),
+                        jax.tree_util.tree_leaves(ref_grads)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_block_grads_match_gpipe(self):
+        """Same blocks, same loss: 1F1B's block grads == autodiff
+        through the GPipe schedule (first/last outside the pipe)."""
+        params = self._params()
+        pf, pb, pl = params
+        x, y = self._data(batch=8)
+        mesh = make_mesh({"pipe": 4})
+
+        def gpipe_loss(pb_):
+            h = pipeline.pipeline_apply_sharded(
+                _stage_fn, pb_, self._first(pf, x), mesh,
+                n_microbatches=4)
+            return self._last(pl, h, y)
+
+        g_gpipe = jax.grad(gpipe_loss)(pb)
+        _, (_, g_blocks, _) = pipeline.pipeline_train_1f1b_sharded(
+            _stage_fn, self._first, self._last, params, x, y, mesh,
+            n_microbatches=4)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g_blocks[k]),
+                                       np.asarray(g_gpipe[k]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_multiple_blocks_per_device(self):
+        params = self._params(n_blocks=8)
+        x, y = self._data(batch=8)
+        mesh = make_mesh({"pipe": 4})           # 2 blocks per device
+        loss, grads = pipeline.pipeline_train_1f1b_sharded(
+            _stage_fn, self._first, self._last, params, x, y, mesh,
+            n_microbatches=4)
+        ref_loss, ref_grads = jax.value_and_grad(self._ref_loss)(
+            params, x, y)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads[1]["w"]), np.asarray(ref_grads[1]["w"]),
+            rtol=2e-4, atol=2e-4)
+
+    def test_data_pipe_combined(self):
+        params = self._params()
+        x, y = self._data(batch=16)
+        mesh = make_mesh({"data": 2, "pipe": 4})
+        loss, grads = pipeline.pipeline_train_1f1b_sharded(
+            _stage_fn, self._first, self._last, params, x, y, mesh,
+            n_microbatches=4, batch_axis="data")
+        ref_loss, ref_grads = jax.value_and_grad(self._ref_loss)(
+            params, x, y)
+        # each data slice averages its half-batch; mean of means ==
+        # full-batch mean here because the halves are equal-sized
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads[1]["w"]), np.asarray(ref_grads[1]["w"]),
+            rtol=2e-4, atol=2e-4)
+
+    def test_activation_memory_m_to_s(self):
+        """THE 1F1B selling point: compiled temp memory stays ~flat as
+        M grows (O(S) stash) while autodiff-through-GPipe grows with M
+        (O(M) residuals).  Fixed microbatch size, growing batch."""
+        pf, pb, pl = self._params()
+        mesh = make_mesh({"pipe": 4})
+        mbsz = 4
+
+        def temp_bytes_1f1b(m):
+            x, y = self._data(batch=mbsz * m)
+            f = jax.jit(lambda p, xx, yy:
+                        pipeline.pipeline_train_1f1b_sharded(
+                            _stage_fn, self._first, self._last, p,
+                            xx, yy, mesh, n_microbatches=m))
+            mem = f.lower((pf, pb, pl), x, y).compile().memory_analysis()
+            return mem.temp_size_in_bytes
+
+        def temp_bytes_gpipe(m):
+            x, y = self._data(batch=mbsz * m)
+
+            def loss_fn(p, xx, yy):
+                pf_, pb_, pl_ = p
+                h = pipeline.pipeline_apply_sharded(
+                    _stage_fn, pb_, self._first(pf_, xx), mesh,
+                    n_microbatches=m)
+                return self._last(pl_, h, yy)
+
+            f = jax.jit(jax.grad(loss_fn))
+            mem = f.lower((pf, pb, pl), x, y).compile().memory_analysis()
+            return mem.temp_size_in_bytes
+
+        one_small, one_big = temp_bytes_1f1b(4), temp_bytes_1f1b(32)
+        gp_small, gp_big = temp_bytes_gpipe(4), temp_bytes_gpipe(32)
+        # GPipe residuals grow ~linearly in M; the 1F1B stash does not
+        # (only the raw token/output buffers scale with batch)
+        assert gp_big / gp_small > 3.0, (gp_small, gp_big)
+        assert one_big / one_small < 2.0, (one_small, one_big)
+        assert one_big < gp_big / 2, (one_big, gp_big)

@@ -68,12 +68,17 @@ bench.py), 819 GB/s HBM.
 
 import json
 import math
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
-# the MFU / attention-FLOP conventions MUST be bench.py's own — a local
-# copy could silently diverge and make predicted-vs-measured incomparable
-from bench import _causal_attn_flops, _lm_train_flops_per_token  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+# the MFU / attention-FLOP conventions and the lm_large ladder are the
+# SAME objects bench.py uses — predicted-vs-measured stays comparable
+from veles_tpu.ops.flops import (  # noqa: E402
+    LM_LARGE_LADDER as _BENCH_LADDER, causal_attn_flops as
+    _causal_attn_flops, lm_train_flops_per_token as
+    _lm_train_flops_per_token)
 
 # ---------------------------------------------------------------------------
 # Device model (v5e unless overridden)
@@ -239,19 +244,16 @@ def predict_lm():
                        n_kv_heads=2, steps_per_dispatch=5, tied=False)
 
 
-#: lm_large remat ladder as (remat, batch, recompute_frac) — mirrors
-#: bench.phase_lm_large's rungs
-LM_LARGE_LADDER = [("dots", 16, 0.0), ("True", 16, 1.0), ("True", 8, 1.0)]
-
-
 def predict_lm_large_ladder():
-    """Predicted MFU per ladder rung.  The ranking is the pre-decided
-    uptime-window order: confirm the top rung, only descend on OOM."""
+    """Predicted MFU per ladder rung — the rungs ARE bench.py's
+    (veles_tpu/ops/flops.py:LM_LARGE_LADDER, single source of truth).
+    The ranking is the pre-decided uptime-window order: confirm the top
+    rung, only descend on OOM."""
     out = []
-    for remat, batch, rec in LM_LARGE_LADDER:
+    for remat, batch, _steps, rec in _BENCH_LADDER:
         p = _lm_predict(768, 12, 1024, 50304, batch=batch, n_heads=12,
                         recompute_frac=rec, steps_per_dispatch=4)
-        p.update(remat=remat, batch=batch)
+        p.update(remat=str(remat), batch=batch)
         out.append(p)
     return sorted(out, key=lambda r: -r["mfu"])
 

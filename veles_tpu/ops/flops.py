@@ -1,0 +1,39 @@
+"""Shared analytic FLOP conventions + the lm_large bench ladder.
+
+Single source of truth for the MFU numerator and the attention FLOP
+count, imported by BOTH ``bench.py`` (measurement) and
+``tools/cost_model.py`` (prediction) so the two can never silently
+diverge — predicted-vs-measured is only meaningful when both sides
+count the same FLOPs.  (The reference's device DB had the same
+property: one methodology produced both the stored numbers and the
+runtime estimates, ref veles/backends.py:672-731.)"""
+
+
+def causal_attn_flops(b, h, t, d):
+    """Matmul FLOPs of ONE causal attention call (qk + pv, each 2·b·h·
+    t·(t/2)·d with the triangular mask halving effective keys)."""
+    return 4 * b * h * t * t * d / 2
+
+
+def lm_train_flops_per_token(d_model, n_layers, seq, vocab, d_ff=None,
+                             n_heads=None, n_kv_heads=None):
+    """Analytic matmul FLOPs per trained token (fwd+bwd = 3x fwd): per
+    layer q/o project 2·d² each, k/v project 2·d·d_kv each (GQA shrinks
+    d_kv = d·n_kv/n_heads), MLP 2·(2·d_ff·d), causal attention 2·T·d
+    (T/2 effective keys, qk + pv), plus the 2·d·V LM head.  Embedding
+    lookup is a gather — no FLOPs."""
+    d_ff = d_ff or 4 * d_model
+    kv_frac = ((n_kv_heads / n_heads)
+               if n_heads and n_kv_heads else 1.0)
+    per_layer = ((4 + 4 * kv_frac) * d_model ** 2
+                 + 4 * d_ff * d_model + 2 * seq * d_model)
+    return 3 * (n_layers * per_layer + 2 * d_model * vocab)
+
+
+#: lm_large memory ladder, best rung first: (remat, batch, bench_steps,
+#: recompute_frac).  ``recompute_frac`` is the extra forward recomputed
+#: in the backward (full remat = 1.0; "dots" keeps matmul outputs so no
+#: matmul recompute) — bench walks the rungs on OOM, the cost model
+#: predicts each rung's MFU from the same tuple.
+LM_LARGE_LADDER = (("dots", 16, 8, 0.0), (True, 16, 8, 1.0),
+                   (True, 8, 12, 1.0))

@@ -7,11 +7,21 @@ with ``lax.ppermute`` (one ICI neighbor hop per tick) while microbatches
 stream through, so all stages compute concurrently after the fill phase —
 the classic GPipe schedule with bubble fraction (S-1)/(M+S-1).
 
-Constraint: every stage maps activations to the SAME shape (the
+GPipe constraint: every stage maps activations to the SAME shape (the
 transformer-block regime pipelining is used for); embed/head layers live
 outside the pipelined segment.  The whole schedule is a ``lax.scan``, so
 it jits, differentiates (reverse-mode re-runs the scan), and composes
-with the other mesh axes."""
+with the other mesh axes.
+
+``pipeline_train_1f1b`` lifts both GPipe limits for training: the 1F1B
+schedule (steady state: one forward + one backward sub-tick per tick)
+keeps only O(S) stashed microbatch inputs per device instead of the
+O(M) residuals reverse-mode stores through the GPipe scan, and the
+first/last stages may differ from the middle ones (``first_fn`` embeds
+int tokens, ``last_fn`` runs the head + loss), so embed→blocks→head
+pipelines end-to-end.  Backward recomputes each stage forward from the
+stashed input (``jax.vjp``) — the same FLOPs-for-memory trade as
+full remat, but scheduled so the bubble stays (S-1)/(M+S-1)."""
 
 import jax
 import jax.numpy as jnp
@@ -92,3 +102,149 @@ def pipeline_apply_sharded(stage_fn, stacked_params, x, mesh,
 
     return shard_map(fn, mesh=mesh, in_specs=(pspec, xspec),
                      out_specs=xspec, check_vma=False)(stacked_params, x)
+
+def pipeline_train_1f1b(stage_fn, first_fn, last_fn, params, x, y,
+                        axis_name, n_microbatches):
+    """Inside shard_map over ``axis_name``: one 1F1B training step.
+
+    ``params = (p_first, p_blocks, p_last)``: ``p_blocks`` is THIS
+    device's stacked block segment [k, ...] (run sequentially as a
+    superstage); ``p_first`` / ``p_last`` are replicated but *computed*
+    only on the boundary devices (``lax.cond`` keeps the untaken branch
+    off the device's critical path).  ``first_fn(p, x_mb) -> h`` maps
+    raw microbatch input (e.g. int tokens) to the inter-stage activation
+    shape; ``stage_fn(p_block, h) -> h``; ``last_fn(p, h, y_mb) ->
+    scalar mean-over-microbatch loss``.
+
+    Schedule: fwd microbatch ``f = t - me`` and bwd microbatch
+    ``j = t - 2(S-1) + me`` per tick — the last stage backpropagates a
+    microbatch the same tick its forward finishes, cotangents hop one
+    stage per tick, so device ``me`` holds at most ``2(S-1-me)``
+    stashed inputs (O(S), vs O(M) for autodiff-through-GPipe).
+    Returns ``(mean_loss, (g_first, g_blocks, g_last))``; boundary
+    grads are psum'd (every device returns the true value)."""
+    p_first, p_blocks, p_last = params
+    s = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    m = n_microbatches
+    if x.shape[0] % m:
+        raise ValueError("batch %d %% n_microbatches %d != 0"
+                         % (x.shape[0], m))
+    mb = x.shape[0] // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+    ys = y.reshape((m, mb) + y.shape[1:])
+    fwd_pairs = [(i, i + 1) for i in range(s - 1)]
+    bwd_pairs = [(i, i - 1) for i in range(1, s)]
+    n_stash = 2 * (s - 1) + 1
+    n_ticks = m + 2 * (s - 1)
+
+    def seg_fwd(pf, pb, x_mb, h_recv):
+        """This device's segment: embed on stage 0, then its blocks."""
+        h0 = lax.cond(me == 0, lambda: first_fn(pf, x_mb),
+                      lambda: h_recv)
+        return lax.scan(lambda h, pk: (stage_fn(pk, h), None), h0, pb)[0]
+
+    # probe the inter-stage activation shape without running the scan
+    h_shape = jax.eval_shape(first_fn, p_first, xs[0])
+
+    def tick(carry, t):
+        stash, recv_fwd, recv_bwd, acc, loss_sum = carry
+        gf, gb, gl = acc
+
+        # ---- forward sub-tick: microbatch f = t - me ----
+        f = t - me
+        f_ok = (f >= 0) & (f < m)
+        f_idx = jnp.clip(f, 0, m - 1)
+        h_out = seg_fwd(p_first, p_blocks, xs[f_idx], recv_fwd)
+        stash = stash.at[f_idx % n_stash].set(
+            jnp.where(f_ok, recv_fwd, stash[f_idx % n_stash]))
+
+        # ---- backward sub-tick: microbatch j = t - 2(S-1) + me ----
+        j = t - 2 * (s - 1) + me
+        j_ok = (j >= 0) & (j < m)
+        j_idx = jnp.clip(j, 0, m - 1)
+        h_in = stash[j_idx % n_stash]
+        out_j, pull = jax.vjp(
+            lambda pf, pb, hr: seg_fwd(pf, pb, xs[j_idx], hr),
+            p_first, p_blocks, h_in)
+
+        def last_cotangent():
+            loss_j, lpull = jax.vjp(
+                lambda pl, ho: last_fn(pl, ho, ys[j_idx]), p_last, out_j)
+            dpl, g_out = lpull(jnp.float32(1.0 / m))
+            return loss_j / m, dpl, g_out
+
+        def mid_cotangent():
+            zl = jax.tree_util.tree_map(jnp.zeros_like, p_last)
+            return jnp.float32(0.0), zl, recv_bwd
+
+        loss_j, dpl, g_out = lax.cond(me == s - 1, last_cotangent,
+                                      mid_cotangent)
+        dpf, dpb, dh = pull(g_out)
+
+        ok = j_ok.astype(jnp.float32)
+        gf = jax.tree_util.tree_map(lambda a, d: a + ok * d, gf, dpf)
+        gb = jax.tree_util.tree_map(lambda a, d: a + ok * d, gb, dpb)
+        gl = jax.tree_util.tree_map(lambda a, d: a + ok * d, gl, dpl)
+        loss_sum = loss_sum + jnp.where(j_ok, loss_j, 0.0)
+
+        recv_fwd = lax.ppermute(h_out, axis_name, fwd_pairs)
+        recv_bwd = lax.ppermute(dh, axis_name, bwd_pairs)
+        return (stash, recv_fwd, recv_bwd, (gf, gb, gl), loss_sum), None
+
+    zeros_like = jax.tree_util.tree_map(jnp.zeros_like, (p_first, p_blocks,
+                                                         p_last))
+    stash0 = jnp.zeros((n_stash,) + h_shape.shape, h_shape.dtype)
+    recv0 = jnp.zeros(h_shape.shape, h_shape.dtype)
+    carry0 = (stash0, recv0, recv0, zeros_like, jnp.float32(0.0))
+    (_, _, _, (gf, gb, gl), loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(n_ticks))
+
+    # boundary grads / loss live on one device each — broadcast
+    loss = lax.psum(jnp.where(me == s - 1, loss_sum, 0.0), axis_name)
+    gf = jax.tree_util.tree_map(
+        lambda g: lax.psum(jnp.where(me == 0, g, 0.0), axis_name), gf)
+    gl = jax.tree_util.tree_map(
+        lambda g: lax.psum(jnp.where(me == s - 1, g, 0.0), axis_name), gl)
+    return loss, (gf, gb, gl)
+
+
+def pipeline_train_1f1b_sharded(stage_fn, first_fn, last_fn, params, x, y,
+                                mesh, pipe_axis="pipe", n_microbatches=4,
+                                batch_axis=None):
+    """Global 1F1B entry: ``params = (p_first, p_blocks_stacked,
+    p_last)`` with the block leaves stacked [n_blocks, ...] and sharded
+    over ``pipe_axis`` (k = n_blocks / pipe_size consecutive blocks per
+    device, like ``pipeline_apply_sharded``); first/last replicated.
+    Returns ``(mean_loss, grads)`` in the params structure — block
+    grads sharded over ``pipe_axis``, ready for the optimizer.
+
+    ``batch_axis``: shard the batch dim over a data axis too; grads are
+    pmean'd and the loss averaged across data slices."""
+    p_first, p_blocks, p_last = params
+    pipe_size = mesh.shape[pipe_axis]
+    for leaf in jax.tree_util.tree_leaves(p_blocks):
+        if leaf.shape[0] % pipe_size:
+            raise ValueError(
+                "stacked stage dim %d not divisible by %s axis size %d"
+                % (leaf.shape[0], pipe_axis, pipe_size))
+    bspec = jax.tree_util.tree_map(lambda _: P(pipe_axis), p_blocks)
+    rspec_f = jax.tree_util.tree_map(lambda _: P(), p_first)
+    rspec_l = jax.tree_util.tree_map(lambda _: P(), p_last)
+    xspec = P(batch_axis) if batch_axis else P()
+
+    def fn(pf, pb, pl, xx, yy):
+        loss, (gf, gb, gl) = pipeline_train_1f1b(
+            stage_fn, first_fn, last_fn, (pf, pb, pl), xx, yy,
+            pipe_axis, n_microbatches)
+        if batch_axis:
+            loss = lax.pmean(loss, batch_axis)
+            gf, gb, gl = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, batch_axis), (gf, gb, gl))
+        return loss, (gf, gb, gl)
+
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(rspec_f, bspec, rspec_l, xspec, xspec),
+        out_specs=(P(), (rspec_f, bspec, rspec_l)),
+        check_vma=False)(p_first, p_blocks, p_last, x, y)
