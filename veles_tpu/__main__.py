@@ -251,6 +251,7 @@ class Main(object):
             from veles_tpu.services.web_status import WebStatusServer
             web = WebStatusServer(port=args.web_status)
             web.start()
+        self._web = web
 
         wf_globals = runpy.run_path(args.workflow, run_name="__veles__")
         if "run" not in wf_globals:
@@ -1027,6 +1028,10 @@ class Main(object):
                              root.common.serve.get("continuous_slots",
                                                    0)))
         api.start()
+        if getattr(self, "_web", None) is not None:
+            # the dashboard's serving panel shows the slot pool's SLO
+            # surface (queue depth, p50/p99 latency) live
+            self._web.register_serving(api)
         print("REST serving on port %d; Ctrl-C to stop" % api.port)
         try:
             import time

@@ -102,15 +102,48 @@ def setup_logging(level=logging.INFO, filename=None):
         rootlog.addHandler(fh)
 
 
+#: filesystem types where SQLite WAL is unsupported (WAL needs a
+#: coherent shared-memory file, which network filesystems don't give —
+#: sqlite.org/wal.html §"WAL does not work over a network filesystem")
+_NETWORK_FS = ("nfs", "cifs", "smb", "9p", "fuse", "lustre", "gluster",
+               "ceph", "beegfs", "gpfs", "afs", "sshfs")
+
+
+def _network_fs_type(path):
+    """Filesystem type backing ``path`` if it looks network-mounted,
+    else None (best-effort longest-prefix match over /proc/mounts)."""
+    try:
+        best, fstype = "", None
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                mnt = parts[1].rstrip("/") or "/"
+                # component boundary: /data must not claim /database
+                if (path == mnt or path.startswith(mnt + "/")
+                        or mnt == "/") and len(mnt) > len(best):
+                    best, fstype = mnt, parts[2]
+        if fstype and fstype.lower().startswith(_NETWORK_FS):
+            return fstype
+    except OSError:
+        pass
+    return None
+
+
 class SqliteLogHandler(logging.Handler):
     """Cross-run log duplication — the reference's MongoLogHandler
     (ref veles/logger.py:292-331: every record lands in a queryable
     store keyed by session + node, feeding the cross-run log browser)
-    redesigned for a TPU pod: stdlib sqlite in WAL mode instead of a
-    Mongo deployment, so one file on shared storage collects every
-    run's logs with zero extra services.  Query via :func:`search_logs`
-    / :func:`log_sessions`, the dashboard's ``/api/logs``, or plain
-    ``sqlite3``."""
+    redesigned for a TPU pod: stdlib sqlite instead of a Mongo
+    deployment, so one file on shared storage collects every run's
+    logs with zero extra services.  Local paths get WAL; paths on a
+    network filesystem (where WAL's shared-memory file is unsupported
+    and risks corruption with multiple hosts appending) fall back to
+    the rollback journal with busy-retry — the ``session``/``node``
+    columns already disambiguate writers either way.  Query via
+    :func:`search_logs` / :func:`log_sessions`, the dashboard's
+    ``/api/logs``, or plain ``sqlite3``."""
 
     def __init__(self, path, session=None, node=None,
                  level=logging.NOTSET):
@@ -125,7 +158,12 @@ class SqliteLogHandler(logging.Handler):
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
-            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA busy_timeout=5000")
+            netfs = _network_fs_type(self.path)
+            if netfs:
+                self._conn.execute("PRAGMA journal_mode=DELETE")
+            else:
+                self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS logs ("

@@ -962,6 +962,11 @@ class ContinuousBatcher:
         return not self._queue and not any(
             r is not None for r in self._slot_req)
 
+    def active_requests(self):
+        """Request ids currently holding a decode slot (admitted but
+        not yet finished) — the serving plane's admission signal."""
+        return {r for r in self._slot_req if r is not None}
+
     def result(self, rid):
         """Completed token list (prompt + continuation), or None while
         the request is still queued/decoding."""

@@ -112,14 +112,36 @@ class ContinuousEngine(Logger):
     whenever work exists; each HTTP worker blocks on its request's
     event and wakes the moment its row leaves the pool.  Unlike the
     window coalescer, a request joins the CURRENT in-flight decode at
-    the next tick — no batch boundary, no window wait."""
+    the next tick — no batch boundary, no window wait.
 
-    def __init__(self, generator, slots=8):
+    The engine thread is the ONLY caller of the (thread-unsafe)
+    batcher: HTTP workers hand requests over through an ingress deque
+    and read results back from their request record, so the device
+    dispatch in ``tick()`` runs with NO lock held — admission latency
+    stays flat no matter how long a fused dispatch takes (ADVICE r4:
+    the previous design blocked every submit for a whole
+    ticks_per_dispatch dispatch).
+
+    Every request records queue-wait (submit→admitted to a slot,
+    tick granularity) and decode time (admitted→finished), feeding
+    ``metrics()`` — per-stream tokens/s with p50/p99, the serving
+    plane's SLO surface (ref capability: per-slave stats in the web
+    status table, ref web_status.py:113-200, applied to serving)."""
+
+    def __init__(self, generator, slots=8, history=512):
         super(ContinuousEngine, self).__init__()
+        import collections
         from veles_tpu.models.generate import ContinuousBatcher
         self.cb = ContinuousBatcher(generator, slots=slots)
-        self._lock = threading.Lock()      # the batcher is not thread-safe
-        self._events = {}
+        self.max_len = generator.max_len
+        #: guards _ingress / _records / _history / counters — NEVER
+        #: held across a device dispatch
+        self._lock = threading.Lock()
+        self._ingress = collections.deque()
+        self._records = {}                 # rid -> record (cb-submitted)
+        self._history = collections.deque(maxlen=int(history))
+        self._served = 0
+        self._start_ts = time.monotonic()
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -128,28 +150,38 @@ class ContinuousEngine(Logger):
     def submit_async(self, prompt_row, max_new, temperature=0.0,
                      seed=0):
         """Enqueue one row; returns a handle for ``wait`` (submit every
-        row of a request BEFORE waiting so they share the pool)."""
+        row of a request BEFORE waiting so they share the pool).
+        Validates here so a bad request raises in the CALLER (one 400),
+        never on the engine thread.  The length checks delegate to the
+        generator's canonical validate_request; only the engine-specific
+        constraints (non-empty prompt, at least one new token — a slot
+        must decode something to ever free itself) live here."""
+        prompt = [int(t) for t in prompt_row]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new) < 1:
+            raise ValueError("max_new must be >= 1, got %d"
+                             % int(max_new))
+        self.cb.gen.validate_request(
+            len(prompt), {"max_new": int(max_new),
+                          "temperature": float(temperature)})
+        rec = {"prompt": prompt, "max_new": int(max_new),
+               "temperature": float(temperature), "seed": int(seed),
+               "event": threading.Event(), "submit_ts": time.monotonic(),
+               "admit_ts": None, "out": None, "error": None}
         with self._lock:
             if self._closed:
                 raise RuntimeError("engine is stopped")
-            rid = self.cb.submit(list(prompt_row), max_new,
-                                 temperature=temperature, seed=seed)
-            ev = self._events[rid] = threading.Event()
+            self._ingress.append(rec)
         self._wake.set()
-        return rid, ev
+        return rec
 
-    def wait(self, handle):
-        rid, ev = handle
-        ev.wait()
-        with self._lock:
-            del self._events[rid]
-            # pop, don't get: a long-running server must not retain
-            # every completed request's tokens
-            out = self.cb.pop_result(rid)
-        if out is None:
-            raise RuntimeError(
-                "engine stopped before request %d completed" % rid)
-        return np.asarray(out, np.int32)
+    @staticmethod
+    def wait(handle):
+        handle["event"].wait()
+        if handle["error"] is not None:
+            raise handle["error"]
+        return np.asarray(handle["out"], np.int32)
 
     def submit(self, prompt_row, max_new, temperature=0.0, seed=0):
         """Block until this request's row finishes; returns the 1-D
@@ -161,26 +193,126 @@ class ContinuousEngine(Logger):
     def _loop(self):
         while True:
             with self._lock:
-                busy = not self.cb.idle() and not self._closed
-            if self._closed:
-                return
-            if not busy:
+                if self._closed:
+                    return
+                new = list(self._ingress)
+                self._ingress.clear()
+            for rec in new:           # engine thread: sole cb caller
+                try:
+                    rid = self.cb.submit(rec["prompt"], rec["max_new"],
+                                         temperature=rec["temperature"],
+                                         seed=rec["seed"])
+                except Exception as e:  # noqa: BLE001 — deliver to waiter
+                    rec["error"] = e
+                    rec["event"].set()
+                    continue
+                with self._lock:
+                    if self._closed:   # stop() raced the hand-off —
+                        rec["error"] = RuntimeError(  # release the waiter
+                            "engine stopped before request completed")
+                        rec["event"].set()
+                        continue
+                    self._records[rid] = rec
+            if self.cb.idle():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            tick_start = time.monotonic()
+            self.cb.tick()            # device dispatch — NO lock held
+            now = time.monotonic()
+            active = self.cb.active_requests()
+            done = []
             with self._lock:
-                self.cb.tick()
-                for rid, ev in list(self._events.items()):
-                    if self.cb.result(rid) is not None:
-                        ev.set()
+                for rid, rec in self._records.items():
+                    admitted = rid in active or \
+                        self.cb.result(rid) is not None
+                    if rec["admit_ts"] is None and admitted:
+                        # admission happened in THIS tick's admit phase
+                        # — stamp its start, so a request that also
+                        # finishes within the tick (short max_new,
+                        # fused dispatch) records the tick's real
+                        # duration as decode time, not a 1e-9 floor
+                        rec["admit_ts"] = tick_start
+                for rid in list(self._records):
+                    out = self.cb.pop_result(rid)
+                    if out is None:
+                        continue
+                    rec = self._records.pop(rid)
+                    rec["out"] = out
+                    done.append(rec)
+                    dec = max(1e-9, now - (rec["admit_ts"] or now))
+                    n_new = len(out) - len(rec["prompt"])
+                    self._history.append({
+                        "queue_wait_ms": ((rec["admit_ts"] or now)
+                                          - rec["submit_ts"]) * 1e3,
+                        "decode_ms": dec * 1e3,
+                        "new_tokens": n_new,
+                        "tokens_per_sec": n_new / dec,
+                        "ms_per_tok": dec * 1e3 / max(1, n_new),
+                        "finish_ts": now})
+                    self._served += 1
+            for rec in done:          # wake waiters outside the lock
+                rec["event"].set()
+
+    def metrics(self):
+        """Serving-plane SLO snapshot: queue depth, in-flight rows,
+        served count, p50/p99 queue-wait and per-stream decode rate
+        over the last ``history`` completed requests."""
+        with self._lock:
+            hist = list(self._history)
+            queued = len(self._ingress) + sum(
+                1 for r in self._records.values()
+                if r["admit_ts"] is None)
+            in_flight = sum(1 for r in self._records.values()
+                            if r["admit_ts"] is not None)
+            served = self._served
+        out = {"served": served, "queued": queued,
+               "in_flight": in_flight, "slots": self.cb.slots,
+               "uptime_s": round(time.monotonic() - self._start_ts, 1)}
+
+        def pct(vals, q):
+            if not vals:
+                return 0.0
+            vals = sorted(vals)
+            return round(vals[min(len(vals) - 1,
+                                  int(q / 100.0 * len(vals)))], 3)
+
+        for key in ("queue_wait_ms", "ms_per_tok", "tokens_per_sec"):
+            vals = [h[key] for h in hist]
+            out["p50_" + key] = pct(vals, 50)
+            out["p99_" + key] = pct(vals, 99)
+        if len(hist) >= 2:
+            # pool-level throughput: all new tokens in the history
+            # window over the window's wall span (concurrent streams
+            # overlap — summing per-stream decode times would undercount)
+            span = hist[-1]["finish_ts"] - hist[0]["finish_ts"]
+            if span > 1e-9:
+                out["agg_tokens_per_sec"] = round(
+                    sum(h["new_tokens"] for h in hist[1:]) / span, 1)
+        return out
+
+    def reset_metrics(self):
+        """Clear the latency history and served counter (e.g. after a
+        warmup request whose first-dispatch compile time would pollute
+        the percentiles)."""
+        with self._lock:
+            self._history.clear()
+            self._served = 0
+            self._start_ts = time.monotonic()
 
     def stop(self):
         with self._lock:
             self._closed = True
-            # release every in-flight waiter: wait() sees the popped
-            # result missing and raises, instead of hanging forever
-            for ev in self._events.values():
-                ev.set()
+            # release every waiter: queued records error out, in-flight
+            # ones too (wait() raises instead of hanging forever)
+            pending = list(self._ingress) + list(self._records.values())
+            self._ingress.clear()
+            self._records.clear()
+        for rec in pending:
+            if rec["out"] is None and rec["error"] is None:
+                rec["error"] = RuntimeError(
+                    "engine stopped before request completed")
+            rec["event"].set()
         self._wake.set()
         self._thread.join(timeout=5)
 
@@ -217,6 +349,17 @@ class RESTfulAPI(Logger):
         api = self
 
         class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path != api.path + "/metrics":
+                    self.send_error(404)
+                    return
+                body = json.dumps(api.serving_metrics()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
                 if self.path != api.path:
                     self.send_error(404)
@@ -266,6 +409,18 @@ class RESTfulAPI(Logger):
             self.batcher.stop()
         if self.engine is not None:
             self.engine.stop()
+
+    def serving_metrics(self):
+        """GET ``{path}/metrics``: the serving plane's SLO surface —
+        ContinuousEngine latency percentiles when the slot pool is on,
+        plus which serving paths are active."""
+        out = {"paths": {
+            "continuous": self.engine is not None,
+            "coalescing": self.batcher is not None,
+            "generate": self.generator is not None}}
+        if self.engine is not None:
+            out["continuous"] = self.engine.metrics()
+        return out
 
     # ---------------------------------------------------------- generation
     def run_generate(self, req):

@@ -607,19 +607,43 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         return path
 
     def _finalize(self, name, path):
-        # orbax finalizes the data rename synchronously but writes the
-        # COMMIT MARKER (_CHECKPOINT_METADATA) from a background
-        # executor — restore refuses a checkpoint without it, so a
-        # crash in that window would leave _current pointing at an
-        # unloadable directory.  Wait for the marker before flipping.
-        marker = os.path.join(path, "arrays", "_CHECKPOINT_METADATA")
+        # orbax commits from a background executor EVEN on the "sync"
+        # Checkpointer (measured on 0.11.32: save() returns before the
+        # tmp-dir rename), so a crash in that window leaves _current
+        # pointing at a directory restore cannot load.  Gate the flip
+        # on PUBLIC APIs only — no private marker filenames that a
+        # future orbax may rename (ADVICE r4):
+        #   1. AsyncCheckpointer.wait_until_finished() drains the
+        #      commit executor when available;
+        #   2. poll until ckptr.metadata(arrays) succeeds AND
+        #      ocp.utils.is_checkpoint_finalized passes — metadata()
+        #      requires the finalized directory + readable tree
+        #      metadata, exactly what restore needs (verified on
+        #      0.11.32: once metadata() succeeds, restore succeeds;
+        #      is_checkpoint_finalized alone is necessary but NOT
+        #      sufficient — it only checks tmp-naming).
+        import orbax.checkpoint as ocp
+        ckptr = self._checkpointer()
+        if hasattr(ckptr, "wait_until_finished"):
+            ckptr.wait_until_finished()
+        arrays = os.path.join(path, "arrays")
         deadline = time.time() + 30.0
-        while not os.path.exists(marker) and time.time() < deadline:
-            time.sleep(0.02)
-        if not os.path.exists(marker):
-            self.warning("orbax commit marker never appeared for %s — "
-                         "NOT flipping _current", path)
-            return
+        while time.time() < deadline:
+            try:
+                if ocp.utils.is_checkpoint_finalized(arrays):
+                    ckptr.metadata(arrays)
+                    break
+            except Exception:  # noqa: BLE001 — not committed/visible yet
+                pass
+            time.sleep(0.05)
+        else:
+            # a silently stale _current would make supervisor restarts
+            # resume from ever-older checkpoints while training looks
+            # healthy — fail loudly instead (the previous good
+            # checkpoint stays reachable either way)
+            raise RuntimeError(
+                "orbax checkpoint %s never finalized — _current still "
+                "points at the previous snapshot" % path)
         if jax.process_index() == 0:
             self._flip_current(name)
         self.destination = path   # only once the commit is final

@@ -29,6 +29,9 @@ td,th{border:1px solid #999;padding:4px 8px}
 .node{font-size:11px}.lane{font-size:10px;fill:#555}</style></head>
 <body><h2>veles_tpu status</h2>
 <div id="status"></div><h3>metrics</h3><div id="metrics"></div>
+<h3>serving <small>(ContinuousEngine slot pool: queue depth,
+p50/p99 queue-wait and per-stream decode rate)</small></h3>
+<div id="serving">(no serving endpoint registered)</div>
 <h3>workflow graph <small>(nodes heat-colored by run-time share;
 <a href="/api/dot">DOT</a>)</small></h3><div id="graph"></div>
 <h3>event timeline <small>(<a href="/api/trace">chrome trace</a> —
@@ -176,6 +179,13 @@ async function refresh(){
  const s=await (await fetch('/api/status')).json();
  document.getElementById('status').innerHTML =
   '<pre>'+JSON.stringify(s,null,2)+'</pre>';
+ if(s.serving){
+  const rows=Object.entries(s.serving.continuous||s.serving)
+   .filter(([k,v])=>typeof v!=='object')
+   .map(([k,v])=>'<tr><td>'+k+'</td><td>'+v+'</td></tr>').join('');
+  document.getElementById('serving').innerHTML=
+   '<table>'+rows+'</table>';
+ }
  const m=await (await fetch('/api/metrics')).json();
  document.getElementById('metrics').innerHTML =
   Object.entries(m).map(([k,pts])=>
@@ -200,6 +210,7 @@ class WebStatusServer(Logger):
         super(WebStatusServer, self).__init__()
         self.host, self.port = host, port
         self._workflows = {}
+        self._serving = None
         self._updates = []
         self._server = None
         self._thread = None
@@ -210,6 +221,14 @@ class WebStatusServer(Logger):
         """Track a local workflow; its gather_results() feeds /api/status."""
         with self._lock:
             self._workflows[workflow.name] = workflow
+
+    def register_serving(self, api):
+        """Track a serving endpoint (RESTfulAPI or anything with
+        ``serving_metrics()``/``metrics()``): its latency/throughput
+        snapshot joins ``/api/status`` under ``"serving"`` and feeds
+        the dashboard's serving panel."""
+        with self._lock:
+            self._serving = api
 
     def metrics(self, limit=200):
         """Per-epoch metric time series from the event ring: every
@@ -373,6 +392,14 @@ class WebStatusServer(Logger):
                     out["workflows"][name] = wf.gather_results()
                 except Exception as e:  # noqa: BLE001
                     out["workflows"][name] = {"error": str(e)}
+            serving = self._serving
+        if serving is not None:
+            try:
+                out["serving"] = (serving.serving_metrics()
+                                  if hasattr(serving, "serving_metrics")
+                                  else serving.metrics())
+            except Exception as e:  # noqa: BLE001
+                out["serving"] = {"error": str(e)}
         return out
 
     def start(self):
