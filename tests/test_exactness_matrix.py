@@ -1,0 +1,121 @@
+"""Bit-exactness cross-check matrix (r4 verdict #5): for every zoo
+family, export RANDOM-weight models (no dataset needed — the published
+accuracy rows stay gated on real data, tests/test_accuracy_gates.py)
+and assert the three forward paths agree on identical inputs:
+
+    jax forward  ==  StableHLO artifact  ==  native C++ runtime
+
+to 1e-6 under f32 compute.  The StableHLO leg is jax.export round-trip
+(exact by construction — same XLA program); the native leg is an
+independent C++ reimplementation, so agreement there validates every
+operator's math, not just the serialization.  Families the native
+runtime deliberately rejects (transformer attention) assert the
+jax==StableHLO leg plus the loud unsupported-type load error.
+
+Smoke-tier by design: random weights, tiny shapes, no training.
+(Ref parity: libVeles's GoogleTest suite loads real exported packages,
+SURVEY.md §4 — this matrix is that contract swept across the zoo.)"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models import zoo
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.services.export import (export_stablehlo, export_workflow,
+                                       load_stablehlo)
+
+HAS_GXX = shutil.which("g++") is not None
+
+#: (family, layer-spec factory, input sample shape, loss, native?)
+FAMILIES = [
+    ("mnist_mlp", lambda: zoo.mnist_mlp(), (784,), None, True),
+    ("mnist_autoencoder", lambda: zoo.mnist_autoencoder(), (784,),
+     "mse", True),
+    # 16x16 is the smallest input whose three stride-2 pools stay
+    # non-empty (16 -> 7 -> 3 -> 1)
+    ("cifar_conv", lambda: zoo.cifar_conv(), (16, 16, 3), None, True),
+    ("conv_autoencoder", lambda: zoo.conv_autoencoder(), (8, 8, 1),
+     "mse", True),
+    ("resnet_gn", lambda: zoo.resnet_gn(n_classes=10, width=8,
+                                        blocks_per_stage=1, stages=2,
+                                        pool=4), (8, 8, 1), None, True),
+    ("transformer_classifier",
+     lambda: zoo.transformer_classifier(n_classes=4, d_model=16,
+                                        n_heads=2, n_layers=1,
+                                        dropout=0.0), (6, 5), None,
+     False),
+    ("transformer_lm",
+     lambda: zoo.transformer_lm(vocab_size=17, d_model=16, n_heads=2,
+                                n_layers=1, dropout=0.0, pos="rope"),
+     (8,), "lm", False),
+]
+
+
+def _build(name, layers, in_shape, loss):
+    """Random-weight workflow: initialize() seeds params from the PRNG;
+    the loader carries synthetic data purely to fix shapes/dtypes."""
+    prng.seed_all(101)
+    n = 8
+    r = np.random.RandomState(7)
+    if loss == "lm":
+        data = r.randint(0, 17, (n,) + in_shape).astype(np.int32)
+        labels = data
+    else:
+        data = r.rand(n, *in_shape).astype(np.float32)
+        labels = (data.reshape(n, -1)
+                  if loss == "mse" else
+                  r.randint(0, 4, n).astype(np.int32))
+    loader = FullBatchLoader(None, data=data, labels=labels,
+                             minibatch_size=n,
+                             class_lengths=[0, 0, n])
+    wf = StandardWorkflow(layers=layers, loader=loader,
+                          loss=loss or "softmax",
+                          decision_config={"max_epochs": 1},
+                          name="exact-" + name)
+    wf.initialize()
+    return wf, data
+
+
+@pytest.mark.parametrize(
+    "name,factory,in_shape,loss,native_ok",
+    FAMILIES, ids=[f[0] for f in FAMILIES])
+def test_three_way_forward_exactness(name, factory, in_shape, loss,
+                                     native_ok, tmp_path,
+                                     f32_precision):
+    wf, x = _build(name, factory(), in_shape, loss)
+    fwd = wf.forward_fn()
+    want = np.asarray(fwd(wf.trainer.params, x))
+
+    # leg 1: StableHLO artifact == live forward (every family)
+    sp = str(tmp_path / (name + ".stablehlo.zip"))
+    export_stablehlo(wf, sp, platforms=("cpu",))
+    fn, meta = load_stablehlo(sp)
+    np.testing.assert_allclose(np.asarray(fn(x)), want,
+                               rtol=1e-6, atol=1e-6,
+                               err_msg="stablehlo leg: " + name)
+
+    # leg 2: native C++ runtime == live forward (supported families)
+    if not HAS_GXX:
+        pytest.skip("no g++ toolchain")
+    pp = str(tmp_path / (name + ".zip"))
+    if native_ok:
+        from veles_tpu.services.native import NativeWorkflow
+        export_workflow(wf, pp)
+        native = NativeWorkflow(pp)
+        got = native(np.ascontiguousarray(x.reshape(len(x), -1)))
+        native.close()
+        # the native runtime emits flat rows; compare values not layout
+        np.testing.assert_allclose(got.reshape(want.shape), want,
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="native leg: " + name)
+    else:
+        # attention is deliberately outside the native runtime's
+        # operator set — the load must fail loudly, naming the type
+        from veles_tpu.services.native import NativeWorkflow
+        export_workflow(wf, pp)
+        with pytest.raises(Exception, match="unsupported unit type"):
+            NativeWorkflow(pp)

@@ -105,8 +105,10 @@ def setup_logging(level=logging.INFO, filename=None):
 #: filesystem types where SQLite WAL is unsupported (WAL needs a
 #: coherent shared-memory file, which network filesystems don't give —
 #: sqlite.org/wal.html §"WAL does not work over a network filesystem")
-_NETWORK_FS = ("nfs", "cifs", "smb", "9p", "fuse", "lustre", "gluster",
-               "ceph", "beegfs", "gpfs", "afs", "sshfs")
+#: ("fuse" alone would also catch purely-local FUSE mounts like
+#: fuseblk/ntfs-3g — only the network-backed ones belong here)
+_NETWORK_FS = ("nfs", "cifs", "smb", "9p", "fuse.sshfs", "lustre",
+               "gluster", "ceph", "beegfs", "gpfs", "afs", "sshfs")
 
 
 def _network_fs_type(path):

@@ -165,7 +165,13 @@ def export_stablehlo(workflow, path, platforms=None):
     host = trainer.host_params()
     in_shape = tuple(trainer.layers[0].input_shape)
     (b,) = jexport.symbolic_shape("b")
-    x_spec = jax.ShapeDtypeStruct((b,) + in_shape, np.float32)
+    # int-token models (LMs) export with int32 inputs; every float
+    # flavor stays float32 (jax canonicalizes f64 anyway)
+    data = getattr(workflow.loader, "_host_data", None)
+    in_dtype = (np.int32 if data is not None
+                and np.issubdtype(np.asarray(data).dtype, np.integer)
+                else np.float32)
+    x_spec = jax.ShapeDtypeStruct((b,) + in_shape, in_dtype)
     p_spec = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
         host)

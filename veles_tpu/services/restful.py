@@ -133,7 +133,6 @@ class ContinuousEngine(Logger):
         import collections
         from veles_tpu.models.generate import ContinuousBatcher
         self.cb = ContinuousBatcher(generator, slots=slots)
-        self.max_len = generator.max_len
         #: guards _ingress / _records / _history / counters — NEVER
         #: held across a device dispatch
         self._lock = threading.Lock()
@@ -268,7 +267,8 @@ class ContinuousEngine(Logger):
             served = self._served
         out = {"served": served, "queued": queued,
                "in_flight": in_flight, "slots": self.cb.slots,
-               "uptime_s": round(time.monotonic() - self._start_ts, 1)}
+               "uptime_s": round(time.monotonic() - self._start_ts, 1),
+               "agg_tokens_per_sec": 0.0}
 
         def pct(vals, q):
             if not vals:

@@ -515,12 +515,16 @@ class OrbaxSnapshotter(TrainingSnapshotter):
     MAPPING = "orbax"
     all_processes_export = True
 
-    def __init__(self, workflow, **kwargs):
+    def __init__(self, workflow, finalize_timeout=120.0, **kwargs):
         super(OrbaxSnapshotter, self).__init__(workflow, **kwargs)
         self._ckptr = None
         #: (name, path) of an async commit whose _current flip awaits
         #: the arrays finalize — see flush()
         self._pending = None
+        #: seconds to wait for orbax's background commit before the
+        #: _current flip gives up (multi-GB checkpoints on slow shared
+        #: storage need more than the old 30 s)
+        self.finalize_timeout = float(finalize_timeout)
 
     def _checkpointer(self):
         import orbax.checkpoint as ocp
@@ -627,7 +631,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         if hasattr(ckptr, "wait_until_finished"):
             ckptr.wait_until_finished()
         arrays = os.path.join(path, "arrays")
-        deadline = time.time() + 30.0
+        deadline = time.time() + self.finalize_timeout
         while time.time() < deadline:
             try:
                 if ocp.utils.is_checkpoint_finalized(arrays):
@@ -655,7 +659,14 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         if self._pending is not None:
             name, path = self._pending
             self._pending = None
-            self._finalize(name, path)
+            try:
+                self._finalize(name, path)
+            except Exception:
+                # keep the flip pending: if the caller survives the
+                # error, the next flush retries — a commit that merely
+                # outlived the timeout must not lose its _current flip
+                self._pending = (name, path)
+                raise
 
     @staticmethod
     def import_dir(path):
