@@ -30,7 +30,11 @@ def run(load, main):
          layers=transformer_lm(vocab_size=256,
                                d_model=cfg.get("d_model", 32),
                                n_heads=4, n_layers=2,
-                               lr=cfg.get("learning_rate", 0.003)),
+                               lr=cfg.get("learning_rate", 0.003),
+                               # > 0: freeze the base, train rank-r
+                               # q/v adapters (pair with --warm-start;
+                               # ship them with --export-lora)
+                               lora_rank=cfg.get("lora_rank", 0)),
          loader=loader, loss="lm",
          gd_defaults=cfg.get("gd"),
          decision_config={"max_epochs": cfg.get("max_epochs", 10)},

@@ -32,6 +32,11 @@ import pytest  # noqa: E402
 #: CI box, see README "Test tiers": these are the multi-process,
 #: compile-heavy, and subprocess-CLI suites).  Individual tests elsewhere
 #: opt in with @pytest.mark.slow.  Smoke tier = `pytest -m "not slow"`.
+#: BUDGET RULE (README "Test tiers"): the full suite must stay <= 45
+#: minutes on the 1-core CI box.  Every NEW slow module must either
+#: replace an existing one or document its wall-clock cost here, and
+#: each round's session log records a ``--durations=20`` report so
+#: creep is visible before it compounds.
 SLOW_MODULES = {
     # real multi-process SPMD (jax.distributed over localhost)
     "test_multihost.py",
@@ -46,6 +51,8 @@ SLOW_MODULES = {
     "test_cli.py", "test_genetics_ensemble.py", "test_elasticity.py",
     # long sweeps / CD-k training loops
     "test_fused_sweep.py", "test_rbm_recurrent.py",
+    # r5: two small LM trainings + REST round-trips, ~85 s total
+    "test_lora_serving.py",
 }
 
 

@@ -94,6 +94,11 @@ class Main(object):
                        help="export the jitted forward as a portable "
                        "StableHLO artifact (+params) runnable on any "
                        "XLA backend without the model code")
+        p.add_argument("--export-lora", default=None, metavar="PATH",
+                       help="export ONLY the LoRA adapters as a small "
+                       "package (adapters.npz + base-model sha256 "
+                       "lineage) — a rank-8 fine-tune of a 124M base "
+                       "ships ~MBs instead of the full model")
         p.add_argument("--serve", type=int, default=None, metavar="PORT",
                        help="after training, serve the model over REST")
         p.add_argument("--generate", default=None,
@@ -446,6 +451,11 @@ class Main(object):
             meta = export_stablehlo(wf, args.export_stablehlo)
             print("stablehlo (%s) -> %s"
                   % (",".join(meta["platforms"]), args.export_stablehlo))
+        if args.export_lora and wf is not None:
+            from veles_tpu.services.export import export_lora_adapters
+            meta = export_lora_adapters(wf, args.export_lora)
+            print("lora adapters (%s) -> %s"
+                  % (",".join(meta["layers"]), args.export_lora))
         if args.generate is not None and wf is not None:
             self._generate(wf, args.generate)
         if args.serve is not None and wf is not None:

@@ -105,10 +105,13 @@ def setup_logging(level=logging.INFO, filename=None):
 #: filesystem types where SQLite WAL is unsupported (WAL needs a
 #: coherent shared-memory file, which network filesystems don't give —
 #: sqlite.org/wal.html §"WAL does not work over a network filesystem")
-#: ("fuse" alone would also catch purely-local FUSE mounts like
-#: fuseblk/ntfs-3g — only the network-backed ones belong here)
-_NETWORK_FS = ("nfs", "cifs", "smb", "9p", "fuse.sshfs", "lustre",
-               "gluster", "ceph", "beegfs", "gpfs", "afs", "sshfs")
+#: matched against the fstype with any "fuse." prefix stripped first:
+#: network filesystems served through FUSE (fuse.glusterfs, fuse.sshfs,
+#: fuse.s3fs ...) classify by their backend, while purely-local FUSE
+#: mounts (fuseblk/ntfs-3g, encfs, bindfs) stay local
+_NETWORK_FS = ("nfs", "cifs", "smb", "9p", "lustre", "gluster",
+               "ceph", "beegfs", "gpfs", "afs", "sshfs", "s3fs",
+               "davfs", "webdav")
 
 
 def _network_fs_type(path):
@@ -126,8 +129,12 @@ def _network_fs_type(path):
                 if (path == mnt or path.startswith(mnt + "/")
                         or mnt == "/") and len(mnt) > len(best):
                     best, fstype = mnt, parts[2]
-        if fstype and fstype.lower().startswith(_NETWORK_FS):
-            return fstype
+        if fstype:
+            base = fstype.lower()
+            if base.startswith("fuse."):
+                base = base[len("fuse."):]
+            if base.startswith(_NETWORK_FS):
+                return fstype
     except OSError:
         pass
     return None

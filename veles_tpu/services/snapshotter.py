@@ -525,6 +525,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         #: _current flip gives up (multi-GB checkpoints on slow shared
         #: storage need more than the old 30 s)
         self.finalize_timeout = float(finalize_timeout)
+        self._finalize_failures = 0
 
     def _checkpointer(self):
         import orbax.checkpoint as ocp
@@ -661,11 +662,22 @@ class OrbaxSnapshotter(TrainingSnapshotter):
             self._pending = None
             try:
                 self._finalize(name, path)
+                self._finalize_failures = 0
             except Exception:
-                # keep the flip pending: if the caller survives the
-                # error, the next flush retries — a commit that merely
-                # outlived the timeout must not lose its _current flip
-                self._pending = (name, path)
+                # keep the flip pending ONCE: a commit that merely
+                # outlived the timeout retries at the next flush.  A
+                # second failure abandons it — export() flushes before
+                # every save, so a permanently-torn commit must not
+                # wedge every future checkpoint behind its timeout.
+                self._finalize_failures += 1
+                if self._finalize_failures < 2:
+                    self._pending = (name, path)
+                else:
+                    self.error("abandoning unfinalizable checkpoint %s "
+                               "after %d attempts — _current stays on "
+                               "the previous snapshot; future exports "
+                               "proceed", path, self._finalize_failures)
+                    self._finalize_failures = 0
                 raise
 
     @staticmethod
