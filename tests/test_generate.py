@@ -667,3 +667,115 @@ class TestContinuousBatching:
         want = gen.generate(toks[:1, :4], 6, temperature=0.8,
                             seed=7)[0].tolist()
         assert cb1.result(r1) == want
+
+
+class TestPagedKV:
+    """Block-table KV pool (PagedContinuousBatcher): exact parity with
+    the dense batcher, memory scaling with the pool budget instead of
+    slots x max_len, admission backpressure on pool exhaustion, and the
+    guard rails."""
+
+    def _run(self, cb, gen, toks):
+        rids = [cb.submit(toks[0, :4].tolist(), 8),
+                cb.submit(toks[1, :6].tolist(), 6,
+                          temperature=0.7, seed=11)]
+        for _ in range(3):
+            cb.tick()
+        rids.append(cb.submit(toks[2, :3].tolist(), 9))
+        cb.run_all()
+        return [cb.pop_result(r) for r in rids]
+
+    @pytest.mark.parametrize("ticks_per_dispatch", [1, 4])
+    def test_matches_dense_batcher_exactly(self, f32_precision,
+                                           ticks_per_dispatch):
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        dense = self._run(ContinuousBatcher(
+            gen, slots=3, ticks_per_dispatch=ticks_per_dispatch),
+            gen, toks)
+        paged = self._run(PagedContinuousBatcher(
+            gen, slots=3, ticks_per_dispatch=ticks_per_dispatch,
+            block=4, pool_tokens=48), gen, toks)
+        assert paged == dense
+        # and both match the solo generator (greedy rows)
+        want = gen.generate(toks[:1, :4], 8)[0].tolist()
+        assert paged[0] == want
+
+    def test_pool_backpressure_and_block_accounting(self, f32_precision):
+        """A pool too small for all requests at once still completes
+        every request (queued ones wait for freed blocks), and every
+        block returns to the free list."""
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=16)   # 4 blocks total
+        assert cb.free_blocks() == 4
+        rids = [cb.submit(toks[i, :4].tolist(), 8) for i in range(3)]
+        # 12 tokens/request = 3 blocks: only ONE fits at a time
+        cb.tick()
+        assert sum(r is not None for r in cb._slot_req) == 1
+        cb.run_all()
+        dense = ContinuousBatcher(gen, slots=3)
+        for r in rids:
+            dense.submit(toks[rids.index(r), :4].tolist(), 8)
+        dense.run_all()
+        for i, rid in enumerate(rids):
+            assert cb.pop_result(rid) == dense.pop_result(i)
+        assert cb.free_blocks() == 4          # all blocks returned
+
+    def test_pool_memory_scales_with_budget_not_slots(self,
+                                                      f32_precision):
+        wf, toks = _lm_workflow(max_epochs=0)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        dense = ContinuousBatcher(gen, slots=8)
+        paged = PagedContinuousBatcher(gen, slots=8, block=4,
+                                       pool_tokens=32)
+        db = sum(l.nbytes for l in
+                 jax.tree_util.tree_leaves(dense._caches))
+        pb = sum(l.nbytes for l in
+                 jax.tree_util.tree_leaves(paged._pool))
+        # 8 slots x 16 tokens dense vs 32-token budget (+1 dummy block)
+        assert pb <= db * (32 + 4) / (8 * 16) + 1e-9, (db, pb)
+
+    def test_guard_rails(self, f32_precision):
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        wf, _ = _lm_workflow(max_epochs=0)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        with pytest.raises(ValueError, match="block"):
+            PagedContinuousBatcher(gen, block=5)      # 16 % 5 != 0
+        wfw, _ = _lm_workflow(max_epochs=0, window=6, impl="flash")
+        genw = LMGenerator(wfw.trainer, max_len=16)
+        with pytest.raises(ValueError, match="not pageable"):
+            PagedContinuousBatcher(genw, block=4)
+
+    def test_engine_metrics_expose_free_blocks(self, f32_precision):
+        from veles_tpu.services.restful import ContinuousEngine
+        wf, toks = _lm_workflow(max_epochs=0)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        eng = ContinuousEngine(gen, slots=2, paged_block=4,
+                               pool_tokens=32)
+        try:
+            eng.submit(toks[0, :4].tolist(), 4)
+            m = eng.metrics()
+            assert m["free_kv_blocks"] == 8   # all returned post-serve
+        finally:
+            eng.stop()
+
+
+def test_paged_rejects_request_larger_than_pool(f32_precision):
+    """A request needing more blocks than the whole pool must fail at
+    submit — accepted-but-never-admittable would deadlock run_all()
+    and hang the serving engine forever."""
+    from veles_tpu.models.generate import PagedContinuousBatcher
+    wf, toks = _lm_workflow(max_epochs=0)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    cb = PagedContinuousBatcher(gen, slots=2, block=4, pool_tokens=8)
+    with pytest.raises(ValueError, match="pool only has"):
+        cb.submit(toks[0, :8].tolist(), 8)    # 4 blocks > 2-block pool
+    assert cb.idle() and cb.free_blocks() == 2

@@ -1036,7 +1036,15 @@ class Main(object):
                              root.common.serve.get("max_batch", 8)),
                          continuous_slots=int(
                              root.common.serve.get("continuous_slots",
-                                                   0)))
+                                                   0)),
+                         # root.common.serve.paged_block>0: block-table
+                         # KV pool of root.common.serve.pool_tokens —
+                         # memory scales with active tokens, admission
+                         # backpressures on pool exhaustion
+                         paged_block=int(
+                             root.common.serve.get("paged_block", 0)),
+                         pool_tokens=root.common.serve.get(
+                             "pool_tokens", None))
         api.start()
         if getattr(self, "_web", None) is not None:
             # the dashboard's serving panel shows the slot pool's SLO

@@ -981,13 +981,9 @@ class ContinuousBatcher:
         """One engine step: admit queued requests into free slots, then
         advance EVERY slot one token; emit and free finished rows.
         Returns the number of active slots after the tick."""
-        while self._queue and None in self._slot_req:
+        while self._can_admit():
             self._admit(self._slot_req.index(None))
-        st = (self._tokens, self._pos, self._plen, self._total,
-              self._active, self._seeds, self._inv_temp, self._caches)
-        st = self._tick(st)
-        (self._tokens, self._pos, self._plen, self._total,
-         self._active, self._seeds, self._inv_temp, self._caches) = st
+        self._set_state(self._tick(self._state()))
         # emission: completion is re-derived from slot OCCUPANCY + pos
         # (the in-jit freeze already cleared ``active`` for rows that
         # hit their budget mid-scan, possibly several per fused
@@ -1001,8 +997,23 @@ class ContinuousBatcher:
             for b in np.nonzero(done)[0]:
                 rid = self._slot_req[b]
                 self._results[rid] = toks[b, :total[b]].tolist()
-                self._slot_req[b] = None
+                self._release_slot(int(b))
         return int((np.asarray(self._active)).sum())
+
+    # --- subclass hooks (the paged batcher reshapes the cache state) ---
+    def _can_admit(self):
+        return bool(self._queue) and None in self._slot_req
+
+    def _release_slot(self, b):
+        self._slot_req[b] = None
+
+    def _state(self):
+        return (self._tokens, self._pos, self._plen, self._total,
+                self._active, self._seeds, self._inv_temp, self._caches)
+
+    def _set_state(self, st):
+        (self._tokens, self._pos, self._plen, self._total,
+         self._active, self._seeds, self._inv_temp, self._caches) = st
 
     def run_all(self):
         """Drive until every submitted request completed."""
@@ -1011,6 +1022,21 @@ class ContinuousBatcher:
         return self._results
 
     # ----------------------------------------------------------- internal
+    def _prefill_row(self, prompt, plen, max_new):
+        """Chunked-prefill admission: one parallel pass fills a [1, ...]
+        cache row with the prompt and returns (cache_row, start_pos);
+        the tick's prompt-forcing covers whatever the chunk didn't
+        (rolling windows prefill a smaller chunk).  (None, 0) when the
+        request prefills token-by-token through the shared tick."""
+        gen = self.gen
+        if self.chunked_prefill and plen >= 2:
+            tp, start, _ = gen._prefill_dispatch(plen, plen + max_new)
+            chunk = np.zeros((tp,), np.int32)
+            chunk[:min(plen, tp)] = prompt[:tp]
+            return gen._prefill_fn(1, tp)(
+                gen.params, jnp.asarray(chunk[None])), start
+        return None, 0
+
     def _admit(self, b):
         rid, prompt, max_new, temperature, seed = self._queue.popleft()
         gen = self.gen
@@ -1052,20 +1078,7 @@ class ContinuousBatcher:
             self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
             self._admit_fresh_fn = jax.jit(admit_fresh,
                                            donate_argnums=(0,))
-        if self.chunked_prefill and plen >= 2:
-            # one parallel pass fills the slot's cache with the prompt;
-            # the row starts at the scan cursor the standard decode
-            # path uses (rolling windows prefill a smaller chunk and
-            # the tick's prompt-forcing finishes the remainder)
-            tp, start, _ = gen._prefill_dispatch(plen, plen + max_new)
-            chunk = np.zeros((tp,), np.int32)
-            chunk[:min(plen, tp)] = prompt[:tp]
-            cache_row = gen._prefill_fn(1, tp)(
-                gen.params, jnp.asarray(chunk[None]))
-            pos0 = start
-        else:
-            cache_row = None
-            pos0 = 0
+        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
         prow = np.zeros((self.gen.max_len,), np.int32)
         prow[:plen] = prompt
         st = (self._tokens, self._pos, self._plen, self._total,
@@ -1082,72 +1095,307 @@ class ContinuousBatcher:
          self._active, self._seeds, self._inv_temp, self._caches) = st
         self._slot_req[b] = rid
 
+    def _make_core(self):
+        """The per-tick body over the 8-tuple state (dense caches in
+        slot-major layout) — shared verbatim by the dense tick and the
+        paged tick (which wraps it between a block-table gather and a
+        position write-back), so the two admission models can never
+        diverge on decode semantics."""
+        gen = self.gen
+
+        def row_step(params, caches, tok, pos):
+            # single-row view: add the batch dim the stack expects;
+            # under vmap the per-row ``pos`` scatter-writes each
+            # slot at its own depth
+            c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
+            logits, c1 = gen._step(params, c1, tok[None], pos)
+            return logits[0], jax.tree_util.tree_map(
+                lambda a: a[0], c1)
+
+        def core(params, st):
+            (tokens, pos, plen, total, active, seeds, inv_temp,
+             caches) = st
+            B = tokens.shape[0]
+            rows = jnp.arange(B)
+            cur = tokens[rows, pos]
+            logits, caches = jax.vmap(
+                row_step, in_axes=(None, 0, 0, 0))(
+                    params, caches, cur, pos)
+            greedy_tok = jnp.argmax(logits, axis=-1).astype(
+                jnp.int32)
+
+            def draw(_):
+                keys = jax.vmap(
+                    lambda s, p: jax.random.fold_in(
+                        jax.random.key(s), p))(seeds, pos)
+                sampled = jax.vmap(
+                    lambda lg, k, it: jax.random.categorical(
+                        k, lg * it))(logits, keys,
+                                     inv_temp).astype(jnp.int32)
+                return jnp.where(inv_temp > 0.0, sampled,
+                                 greedy_tok)
+
+            # all-greedy pools (the serving default) skip the
+            # whole-vocab gumbel draw entirely — same guard as
+            # _decode_body's lax.cond
+            nxt = jax.lax.cond(jnp.any(inv_temp > 0.0), draw,
+                               lambda _: greedy_tok, None)
+            # prefilling rows force their own next prompt token
+            in_prompt = pos + 1 < plen
+            forced = tokens[rows, jnp.minimum(pos + 1,
+                                              tokens.shape[1] - 1)]
+            nxt = jnp.where(in_prompt, forced, nxt)
+            write = active & (pos + 1 < tokens.shape[1])
+            tokens = tokens.at[rows, jnp.minimum(
+                pos + 1, tokens.shape[1] - 1)].set(
+                jnp.where(write, nxt, tokens[rows, jnp.minimum(
+                    pos + 1, tokens.shape[1] - 1)]))
+            pos = jnp.where(active, pos + 1, pos)
+            # rows that just hit their budget freeze IN-JIT, so a
+            # fused multi-tick scan can't overshoot max_new (the
+            # host re-derives completion from slot occupancy)
+            active = active & (pos + 1 < total)
+            return (tokens, pos, plen, total, active, seeds,
+                    inv_temp, caches)
+
+        return core
+
     def _tick(self, st):
         if self._tick_fn is None:
-            gen = self.gen
-
-            def row_step(params, caches, tok, pos):
-                # single-row view: add the batch dim the stack expects;
-                # under vmap the per-row ``pos`` scatter-writes each
-                # slot at its own depth
-                c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
-                logits, c1 = gen._step(params, c1, tok[None], pos)
-                return logits[0], jax.tree_util.tree_map(
-                    lambda a: a[0], c1)
-
-            def tick(params, st):
-                (tokens, pos, plen, total, active, seeds, inv_temp,
-                 caches) = st
-                B = tokens.shape[0]
-                rows = jnp.arange(B)
-                cur = tokens[rows, pos]
-                logits, caches = jax.vmap(
-                    row_step, in_axes=(None, 0, 0, 0))(
-                        params, caches, cur, pos)
-                greedy_tok = jnp.argmax(logits, axis=-1).astype(
-                    jnp.int32)
-
-                def draw(_):
-                    keys = jax.vmap(
-                        lambda s, p: jax.random.fold_in(
-                            jax.random.key(s), p))(seeds, pos)
-                    sampled = jax.vmap(
-                        lambda lg, k, it: jax.random.categorical(
-                            k, lg * it))(logits, keys,
-                                         inv_temp).astype(jnp.int32)
-                    return jnp.where(inv_temp > 0.0, sampled,
-                                     greedy_tok)
-
-                # all-greedy pools (the serving default) skip the
-                # whole-vocab gumbel draw entirely — same guard as
-                # _decode_body's lax.cond
-                nxt = jax.lax.cond(jnp.any(inv_temp > 0.0), draw,
-                                   lambda _: greedy_tok, None)
-                # prefilling rows force their own next prompt token
-                in_prompt = pos + 1 < plen
-                forced = tokens[rows, jnp.minimum(pos + 1,
-                                                  tokens.shape[1] - 1)]
-                nxt = jnp.where(in_prompt, forced, nxt)
-                write = active & (pos + 1 < tokens.shape[1])
-                tokens = tokens.at[rows, jnp.minimum(
-                    pos + 1, tokens.shape[1] - 1)].set(
-                    jnp.where(write, nxt, tokens[rows, jnp.minimum(
-                        pos + 1, tokens.shape[1] - 1)]))
-                pos = jnp.where(active, pos + 1, pos)
-                # rows that just hit their budget freeze IN-JIT, so a
-                # fused multi-tick scan can't overshoot max_new (the
-                # host re-derives completion from slot occupancy)
-                active = active & (pos + 1 < total)
-                return (tokens, pos, plen, total, active, seeds,
-                        inv_temp, caches)
+            core = self._make_core()
 
             def fused(params, st):
                 def body(carry, _):
-                    return tick(params, carry), None
+                    return core(params, carry), None
                 return jax.lax.scan(body, st, None,
                                     length=self.ticks_per_dispatch)[0]
 
             # donate the state: without aliasing, every per-token tick
             # would copy the whole slots×layers KV-cache pool
+            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
+        return self._tick_fn(self.gen.params, st)
+
+
+class PagedContinuousBatcher(ContinuousBatcher):
+    """Paged-KV continuous batching: slot caches live in a SHARED block
+    pool addressed through per-slot block tables, so KV memory scales
+    with the pool budget (sum of active request lengths, rounded up to
+    blocks) instead of ``slots x max_len`` — the vLLM block-table idea
+    (Kwon et al. 2023) recast for XLA's static shapes.
+
+    Layout: every dense cache leaf [B, H, T, *] becomes a pool leaf
+    [P, H, block, *] plus one shared int32 table [B, T/block]; block 0
+    is a reserved dummy all unallocated table entries point at.  A
+    request's block count is KNOWN at admission (prompt + max_new), so
+    allocation is a host-side free-list pop at admit and a push at
+    completion — no in-decode growth, and ADMISSION BACKPRESSURES on
+    pool exhaustion exactly like on slot exhaustion (a queued request
+    waits until both a slot and enough blocks free up).
+
+    The tick wraps the SAME decode core as the dense batcher: gather
+    each row's blocks into a dense [B, H, T, *] view, run the core,
+    scatter each row's newly written position back into its block.
+    The gather re-materializes the view every tick (~2x cache traffic
+    vs dense — the classic paged-attention overhead; fusing it into
+    the attention kernel is the Pallas follow-up), buying the memory
+    cap + backpressure.  Outputs are EXACTLY the dense batcher's:
+    same core, same per-row positions, same seeds.
+
+        cb = PagedContinuousBatcher(gen, slots=8, block=16,
+                                    pool_tokens=512)
+    """
+
+    def __init__(self, gen, slots=8, ticks_per_dispatch=1,
+                 chunked_prefill=True, block=16, pool_tokens=None):
+        super(PagedContinuousBatcher, self).__init__(
+            gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
+            chunked_prefill=chunked_prefill)
+        L = gen.max_len
+        if L % int(block):
+            raise ValueError("max_len %d %% block %d != 0"
+                             % (L, int(block)))
+        self.block = int(block)
+        self.max_blocks = L // self.block
+        pool_tokens = int(pool_tokens or slots * L)
+        self.pool_blocks = max(1, pool_tokens // self.block)
+        for leaf in jax.tree_util.tree_leaves(self._caches):
+            if leaf.shape[2] != L:
+                raise ValueError(
+                    "paged KV needs full-length caches; a rolling-"
+                    "window layer (cache T=%d < max_len %d) is not "
+                    "pageable" % (leaf.shape[2], L))
+
+        def to_pool(leaf):
+            # [B, H, T, *] -> [1 + P, H, block, *]; block 0 = dummy
+            shape = ((1 + self.pool_blocks, leaf.shape[1], self.block)
+                     + leaf.shape[3:])
+            return jnp.zeros(shape, leaf.dtype)
+
+        # zero-filled pool is safe for every leaf kind: QuantCache
+        # scales for unwritten positions are never read (decode writes
+        # before use, _init_caches' own invariant), and the dummy
+        # block 0 is never read at all
+        self._pool = jax.tree_util.tree_map(to_pool, self._caches)
+        self._caches = None                  # the pool replaces it
+        self._tables = jnp.zeros((slots, self.max_blocks), jnp.int32)
+        self._free = list(range(1, 1 + self.pool_blocks))
+        self._slot_blocks = {}               # slot -> [block ids]
+
+    # ------------------------------------------------------------ hooks
+    def _blocks_needed(self, plen, max_new):
+        total = plen + max_new
+        return -(-total // self.block)
+
+    def submit(self, prompt, max_new, temperature=0.0, seed=0):
+        """Reject a request larger than the ENTIRE pool up front — it
+        could never be admitted, and a forever-queued request would
+        deadlock run_all()/the serving engine."""
+        nb = self._blocks_needed(len(prompt), int(max_new))
+        if nb > self.pool_blocks:
+            raise ValueError(
+                "request needs %d KV blocks (prompt %d + max_new %d, "
+                "block %d) but the pool only has %d — raise "
+                "pool_tokens or shorten the request"
+                % (nb, len(prompt), int(max_new), self.block,
+                   self.pool_blocks))
+        return super(PagedContinuousBatcher, self).submit(
+            prompt, max_new, temperature=temperature, seed=seed)
+
+    def _can_admit(self):
+        if not self._queue or None not in self._slot_req:
+            return False
+        _, prompt, max_new, _, _ = self._queue[0]
+        return self._blocks_needed(len(prompt), max_new) <= \
+            len(self._free)
+
+    def free_blocks(self):
+        """Unallocated pool blocks — the serving plane's memory gauge."""
+        return len(self._free)
+
+    def _release_slot(self, b):
+        super(PagedContinuousBatcher, self)._release_slot(b)
+        self._free.extend(self._slot_blocks.pop(b, ()))
+        self._tables = self._tables.at[b].set(0)
+
+    def _state(self):
+        return (self._tokens, self._pos, self._plen, self._total,
+                self._active, self._seeds, self._inv_temp,
+                self._pool, self._tables)
+
+    def _set_state(self, st):
+        (self._tokens, self._pos, self._plen, self._total,
+         self._active, self._seeds, self._inv_temp,
+         self._pool, self._tables) = st
+
+    # -------------------------------------------------------- admission
+    def _admit(self, b):
+        rid, prompt, max_new, temperature, seed = self._queue.popleft()
+        plen = len(prompt)
+        nb = self._blocks_needed(plen, max_new)
+        ids = [self._free.pop() for _ in range(nb)]
+        self._slot_blocks[b] = ids
+        table_row = np.zeros((self.max_blocks,), np.int32)
+        table_row[:nb] = ids
+        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
+        if self._admit_fn is None:
+            gen = self.gen
+            bs, nbm = self.block, self.max_blocks
+
+            def admit_body(st, b, prow, plen_, total, seed_, inv_temp,
+                           trow, pos0_, crow):
+                # ONE fused dispatch, mirroring the dense admit_body
+                # (same scalar writes) + the table row and the prompt
+                # cache blocks scattered into the pool.  Dummy table
+                # entries (0) scatter into the dummy block — harmless,
+                # never read.
+                (tokens, pos, plens, totals, active, seeds, its,
+                 pool, tables) = st
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, prow[None], (b, 0))
+                pos = pos.at[b].set(pos0_)
+                plens = plens.at[b].set(plen_)
+                totals = totals.at[b].set(total)
+                active = active.at[b].set(True)
+                seeds = seeds.at[b].set(seed_)
+                its = its.at[b].set(inv_temp)
+                tables = jax.lax.dynamic_update_slice(
+                    tables, trow[None], (b, 0))
+
+                def one(pl, rw):
+                    blocks = jnp.moveaxis(
+                        rw[0].reshape((rw.shape[1], nbm, bs)
+                                      + rw.shape[3:]), 1, 0)
+                    return pl.at[trow].set(blocks.astype(pl.dtype))
+
+                pool = jax.tree_util.tree_map(one, pool, crow)
+                return (tokens, pos, plens, totals, active, seeds,
+                        its, pool, tables)
+
+            def admit_fresh(st, b, prow, plen_, total, seed_,
+                            inv_temp, trow):
+                return admit_body(st, b, prow, plen_, total, seed_,
+                                  inv_temp, trow, jnp.int32(0),
+                                  gen._init_caches(
+                                      1, gen._model_dtype()))
+
+            self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
+            self._admit_fresh_fn = jax.jit(admit_fresh,
+                                           donate_argnums=(0,))
+        prow = np.zeros((self.gen.max_len,), np.int32)
+        prow[:plen] = prompt
+        args = (self._state(), jnp.int32(b), jnp.asarray(prow),
+                jnp.int32(plen), jnp.int32(plen + max_new),
+                jnp.int32(seed),
+                jnp.float32(0.0 if temperature == 0.0
+                            else 1.0 / temperature),
+                jnp.asarray(table_row))
+        if cache_row is None:
+            st = self._admit_fresh_fn(*args)
+        else:
+            st = self._admit_fn(*args, jnp.int32(pos0), cache_row)
+        self._set_state(st)
+        self._slot_req[b] = rid
+
+    # ------------------------------------------------------------- tick
+    def _tick(self, st):
+        if self._tick_fn is None:
+            core = self._make_core()
+            bs, nbm = self.block, self.max_blocks
+
+            def gather(pool, tables):
+                def one(pl):
+                    v = pl[tables]               # [B, nb, H, bs, *]
+                    v = jnp.moveaxis(v, 2, 1)    # [B, H, nb, bs, *]
+                    return v.reshape(v.shape[:2] + (nbm * bs,)
+                                     + v.shape[4:])
+                return jax.tree_util.tree_map(one, pool)
+
+            def paged_tick(params, st):
+                (tokens, pos, plen, total, active, seeds, inv_temp,
+                 pool, tables) = st
+                views = gather(pool, tables)
+                pos0 = pos                       # write position
+                (tokens, pos, plen, total, active, seeds, inv_temp,
+                 views) = core(params, (tokens, pos, plen, total,
+                                        active, seeds, inv_temp,
+                                        views))
+                rows = jnp.arange(tokens.shape[0])
+                blk = tables[rows, pos0 // bs]
+                off = pos0 % bs
+
+                def write_back(pl, vw):
+                    vals = jax.vmap(lambda v, p: v[:, p])(vw, pos0)
+                    return pl.at[blk, :, off].set(vals.astype(pl.dtype))
+
+                pool = jax.tree_util.tree_map(write_back, pool, views)
+                return (tokens, pos, plen, total, active, seeds,
+                        inv_temp, pool, tables)
+
+            def fused(params, st):
+                def body(carry, _):
+                    return paged_tick(params, carry), None
+                return jax.lax.scan(body, st, None,
+                                    length=self.ticks_per_dispatch)[0]
+
             self._tick_fn = jax.jit(fused, donate_argnums=(1,))
         return self._tick_fn(self.gen.params, st)

@@ -128,11 +128,20 @@ class ContinuousEngine(Logger):
     plane's SLO surface (ref capability: per-slave stats in the web
     status table, ref web_status.py:113-200, applied to serving)."""
 
-    def __init__(self, generator, slots=8, history=512):
+    def __init__(self, generator, slots=8, history=512, paged_block=0,
+                 pool_tokens=None):
         super(ContinuousEngine, self).__init__()
         import collections
-        from veles_tpu.models.generate import ContinuousBatcher
-        self.cb = ContinuousBatcher(generator, slots=slots)
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        #: paged_block > 0: block-table KV pool — slot memory scales
+        #: with the pool_tokens budget, and admission backpressures on
+        #: pool exhaustion as well as slot exhaustion
+        self.cb = (PagedContinuousBatcher(generator, slots=slots,
+                                          block=paged_block,
+                                          pool_tokens=pool_tokens)
+                   if paged_block else
+                   ContinuousBatcher(generator, slots=slots))
         #: guards _ingress / _records / _history / counters — NEVER
         #: held across a device dispatch
         self._lock = threading.Lock()
@@ -140,6 +149,11 @@ class ContinuousEngine(Logger):
         self._records = {}                 # rid -> record (cb-submitted)
         self._history = collections.deque(maxlen=int(history))
         self._served = 0
+        #: free-KV-block gauge, snapshotted by the ENGINE thread after
+        #: each tick (metrics() must not touch the thread-unsafe
+        #: batcher); None on the dense batcher
+        self._kv_gauge = (self.cb.free_blocks()
+                          if hasattr(self.cb, "free_blocks") else None)
         self._start_ts = time.monotonic()
         self._closed = False
         self._wake = threading.Event()
@@ -250,6 +264,9 @@ class ContinuousEngine(Logger):
                         "ms_per_tok": dec * 1e3 / max(1, n_new),
                         "finish_ts": now})
                     self._served += 1
+            if self._kv_gauge is not None:
+                with self._lock:
+                    self._kv_gauge = self.cb.free_blocks()
             for rec in done:          # wake waiters outside the lock
                 rec["event"].set()
 
@@ -269,6 +286,8 @@ class ContinuousEngine(Logger):
                "in_flight": in_flight, "slots": self.cb.slots,
                "uptime_s": round(time.monotonic() - self._start_ts, 1),
                "agg_tokens_per_sec": 0.0}
+        if self._kv_gauge is not None:
+            out["free_kv_blocks"] = self._kv_gauge
 
         def pct(vals, q):
             if not vals:
@@ -320,7 +339,8 @@ class ContinuousEngine(Logger):
 class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
                  path="/service", generator=None, batch_window=0.0,
-                 max_batch=8, continuous_slots=0):
+                 max_batch=8, continuous_slots=0, paged_block=0,
+                 pool_tokens=None):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -338,7 +358,9 @@ class RESTfulAPI(Logger):
         #: live decode at the next tick (ContinuousEngine; greedy and
         #: plain-temperature requests only, top_k/top_p/beam/speculative
         #: fall through to the other paths)
-        self.engine = (ContinuousEngine(generator, continuous_slots)
+        self.engine = (ContinuousEngine(generator, continuous_slots,
+                                        paged_block=paged_block,
+                                        pool_tokens=pool_tokens)
                        if generator is not None and continuous_slots > 0
                        else None)
         self._server = None
