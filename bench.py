@@ -767,16 +767,27 @@ def phase_servecont():
     wf.initialize()
     gen = LMGenerator(wf.trainer, max_len=t_max)
 
+    prompt_len = 16     # shared by pool sizing AND the submit slices
     tpd = int(os.environ.get("BENCH_SERVE_TPD", 16))
     # ONE batcher reused across warmup + timed runs (a fresh instance
     # would recompile its fused tick); fuse K engine ticks per dispatch
     # so the remote-tunnel dispatch cost amortizes exactly like the
-    # trainer's fused sweep
-    cb = ContinuousBatcher(gen, slots=slots, ticks_per_dispatch=tpd)
+    # trainer's fused sweep.  BENCH_SERVE_PAGED=<block> swaps in the
+    # block-table pool (budget = exactly the workload's tokens) so the
+    # window prices the paged gather/scatter overhead vs dense.
+    paged = int(os.environ.get("BENCH_SERVE_PAGED", 0))
+    if paged:
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        need = slots * -(-(prompt_len + max_new) // paged) * paged
+        cb = PagedContinuousBatcher(gen, slots=slots,
+                                    ticks_per_dispatch=tpd,
+                                    block=paged, pool_tokens=need)
+    else:
+        cb = ContinuousBatcher(gen, slots=slots, ticks_per_dispatch=tpd)
 
     def run_pool():
         for i in range(slots):
-            cb.submit(toks[i, :16].tolist(), max_new)
+            cb.submit(toks[i, :prompt_len].tolist(), max_new)
         cb.run_all()
 
     run_pool()                           # compile + warmup
@@ -788,7 +799,7 @@ def phase_servecont():
     gen.generate(toks[:1, :16], max_new)  # compile + warmup
     t0 = time.perf_counter()
     for i in range(slots):
-        gen.generate(toks[i:i + 1, :16], max_new)
+        gen.generate(toks[i:i + 1, :prompt_len], max_new)
     solo_s = time.perf_counter() - t0
     solo_tps = slots * max_new / solo_s
     _log("continuous serving (%dM-class d=%d L=%d, %d streams x %d "
@@ -799,7 +810,8 @@ def phase_servecont():
             pool_tps / solo_tps if solo_tps else 0.0))
     return {"pool_tokens_per_sec": pool_tps,
             "solo_tokens_per_sec": solo_tps,
-            "slots": slots, "max_new": max_new, "d_model": d}
+            "slots": slots, "max_new": max_new, "d_model": d,
+            "paged_block": paged}
 
 
 def phase_flashtune():

@@ -179,3 +179,36 @@ def test_merge_at_export_drops_adapters_exactly(adapted):
     out_a = np.asarray(wf.forward_fn()(wf.trainer.params, toks))
     np.testing.assert_allclose(out_m, out_a, rtol=5e-2, atol=5e-2)
     np.testing.assert_array_equal(out_m.argmax(-1), out_a.argmax(-1))
+
+
+def test_serve_time_adapter_loading_via_config(adapted, tmp_path):
+    """root.common.serve.lora_adapters=PATH: the serve path grafts an
+    adapters package onto the (base) workflow before the generator
+    snapshots params — serving base checkpoint + MB-scale adapters
+    reproduces the adapted model exactly."""
+    from veles_tpu.__main__ import Main
+    from veles_tpu.config import root
+
+    base, wf = adapted
+    ap = str(tmp_path / "serve_adapters.zip")
+    export_lora_adapters(wf, ap)
+    # a same-base workflow with FRESH (random) adapters, as a restart
+    # from the base snapshot would produce
+    fresh = _train(zoo.transformer_lm(vocab_size=VOCAB, d_model=16,
+                                      n_heads=2, n_layers=1,
+                                      dropout=0.0, lora_rank=2),
+                   _tokens(2), "serve-fresh", epochs=1,
+                   warm=base.trainer.host_params())
+    fresh.warm_start({"params": base.trainer.host_params()})
+    prev = root.common.serve.get("lora_adapters", None)
+    root.common.serve.lora_adapters = ap
+    try:
+        gen = Main._make_generator(fresh)
+    finally:
+        root.common.serve.lora_adapters = prev
+    assert gen is not None
+    want = LMGenerator(wf.trainer, max_len=T)
+    prompt = _tokens(2)[2, :6]
+    np.testing.assert_array_equal(
+        gen.generate(prompt[None], max_new=4),
+        want.generate(prompt[None], max_new=4))

@@ -577,6 +577,22 @@ class Main(object):
               if wf.trainer.layers[0].input_shape else 0)
         if any(l.cfg.get("rope") for l in wf.trainer.layers):
             t0 = max(t0, min_len)    # rope has no position-table bound
+        # root.common.serve.lora_adapters=PATH grafts a --export-lora
+        # package onto the (warm-started base) workflow BEFORE the
+        # generator snapshots its serving params: serve a base
+        # checkpoint + a tiny adapters file instead of a full adapted
+        # model (sha256 lineage enforced; ...strict=False downgrades
+        # a cross-base mismatch to a warning)
+        adapters = root.common.serve.get("lora_adapters", None)
+        if adapters:
+            from veles_tpu.services.export import apply_lora_adapters
+            meta = apply_lora_adapters(
+                wf, adapters,
+                strict=root.common.serve.get("lora_strict", True))
+            import logging
+            logging.getLogger("Main").info(
+                "serving with LoRA adapters %s (layers: %s)",
+                adapters, ",".join(meta["layers"]))
         cd = root.common.serve.get("cache_dtype", None)
         import numpy as np
         kwargs = dict(max_len=t0, cache_dtype=None if cd is None

@@ -930,7 +930,7 @@ class ContinuousBatcher:
         self._active = jnp.zeros((B,), jnp.bool_)
         self._seeds = jnp.zeros((B,), jnp.int32)
         self._inv_temp = jnp.zeros((B,), jnp.float32)  # 0 = greedy
-        self._caches = gen._init_caches(B, gen._model_dtype())
+        self._caches = self._init_slot_caches()
         self._slot_req = [None] * B               # slot -> request id
         self._queue = collections.deque()
         self._results = {}
@@ -1001,6 +1001,12 @@ class ContinuousBatcher:
         return int((np.asarray(self._active)).sum())
 
     # --- subclass hooks (the paged batcher reshapes the cache state) ---
+    def _init_slot_caches(self):
+        """Dense slot-major KV allocation; the paged subclass returns
+        None and allocates its (smaller) pool instead — it must never
+        pay a dense-sized startup spike."""
+        return self.gen._init_caches(self.slots, self.gen._model_dtype())
+
     def _can_admit(self):
         return bool(self._queue) and None in self._slot_req
 
@@ -1218,7 +1224,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self.max_blocks = L // self.block
         pool_tokens = int(pool_tokens or slots * L)
         self.pool_blocks = max(1, pool_tokens // self.block)
-        for leaf in jax.tree_util.tree_leaves(self._caches):
+        # shapes WITHOUT allocating the dense caches (eval_shape): the
+        # whole point of paging is that dense slots x max_len may not
+        # fit, so construction must never spike to dense + pool
+        cache_shapes = jax.eval_shape(
+            lambda: gen._init_caches(slots, gen._model_dtype()))
+        for leaf in jax.tree_util.tree_leaves(cache_shapes):
             if leaf.shape[2] != L:
                 raise ValueError(
                     "paged KV needs full-length caches; a rolling-"
@@ -1235,11 +1246,13 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # scales for unwritten positions are never read (decode writes
         # before use, _init_caches' own invariant), and the dummy
         # block 0 is never read at all
-        self._pool = jax.tree_util.tree_map(to_pool, self._caches)
-        self._caches = None                  # the pool replaces it
+        self._pool = jax.tree_util.tree_map(to_pool, cache_shapes)
         self._tables = jnp.zeros((slots, self.max_blocks), jnp.int32)
         self._free = list(range(1, 1 + self.pool_blocks))
         self._slot_blocks = {}               # slot -> [block ids]
+
+    def _init_slot_caches(self):
+        return None                          # the pool replaces them
 
     # ------------------------------------------------------------ hooks
     def _blocks_needed(self, plen, max_new):
