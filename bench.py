@@ -796,7 +796,7 @@ def phase_servecont():
     pool_s = time.perf_counter() - t0
     pool_tps = slots * max_new / pool_s
 
-    gen.generate(toks[:1, :16], max_new)  # compile + warmup
+    gen.generate(toks[:1, :prompt_len], max_new)  # compile + warmup
     t0 = time.perf_counter()
     for i in range(slots):
         gen.generate(toks[i:i + 1, :prompt_len], max_new)

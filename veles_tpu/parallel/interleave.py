@@ -1,0 +1,207 @@
+"""Interleaved-1F1B schedule tables (Megatron-LM's virtual-stage
+schedule, Narayanan et al. 2021) for the lockstep-scan pipeline.
+
+With ``v`` model chunks per device the pipeline has P = S*v virtual
+stages; stage k lives on device k %% S as its chunk k // S.  Each
+device's unit order is the standard interleaved sequence (S-microbatch
+groups round-robining through its chunks), which shrinks the bubble
+from (S-1)/(M+S-1) to (S-1)/(v*M+S-1)-ish at the cost of ~v x the
+ppermute traffic.
+
+Rather than trusting a closed-form tick alignment, ``build_schedule``
+SIMULATES the execution under the lockstep constraints (one fwd and
+one bwd sub-tick per device per tick; an activation/cotangent hop
+arrives one tick after it is sent) and VERIFIES producer->consumer
+timing unit by unit, returning dense [D, T] tables the SPMD scan
+replays with ``table[me, t]`` lookups.  A schedule bug is therefore a
+loud host-side exception at build time, never silent corruption on
+the mesh."""
+
+import numpy as np
+
+
+def unit_order(s, v, m):
+    """Megatron interleaved unit order (identical on every device):
+    the i-th fwd (or bwd) unit's (chunk, microbatch).  Forward walks
+    chunks 0..v-1 in S-microbatch groups, backward walks v-1..0."""
+    fwd, bwd = [], []
+    for i in range(m * v):
+        group, r = divmod(i, s)
+        cyc, chunk = divmod(group, v)
+        fwd.append((chunk, cyc * s + r))
+        bwd.append((v - 1 - chunk, cyc * s + r))
+    return fwd, bwd
+
+
+def warmup_units(d, s, v, m):
+    """Fwd units device ``d`` runs before its first bwd (Megatron:
+    rate-matches the last stage's first backward)."""
+    return min((s - d - 1) * 2 + (v - 1) * s, m * v)
+
+
+def build_schedule(s, v, m):
+    """Simulate + verify; returns dict of [D, T] int32 tables:
+    ``fwd_chunk/fwd_mb/bwd_chunk/bwd_mb`` (-1 = idle sub-tick) and the
+    tick count T.  Raises if any unit's input would not have arrived
+    exactly by its tick (the lockstep single-buffer contract)."""
+    if m % s:
+        raise ValueError("n_microbatches %d must be a multiple of the "
+                         "pipe size %d for the interleaved schedule"
+                         % (m, s))
+    order_f, order_b = unit_order(s, v, m)
+    warm = [warmup_units(d, s, v, m) for d in range(s)]
+    # event-driven simulation: fwd_done[(k, mb)] / bwd_done -> tick
+    fwd_done, bwd_done = {}, {}
+    fi = [0] * s                    # next fwd unit index per device
+    bi = [0] * s
+    sched_f = []
+    sched_b = []
+    t = 0
+    limit = 4 * (m * v + 2 * s * v) + 16
+    while (any(i < m * v for i in fi) or any(i < m * v for i in bi)) \
+            and t < limit:
+        row_f = [(-1, -1)] * s
+        row_b = [(-1, -1)] * s
+        for d in range(s):
+            # fwd sub-tick: run the next fwd unit if its input arrived
+            if fi[d] < m * v:
+                chunk, mb = order_f[fi[d]]
+                k = chunk * s + d
+                ready = (k == 0 or fwd_done.get((k - 1, mb), t) < t)
+                # 1F1B pacing: past warmup, a fwd waits for its paired
+                # bwd slot (one fwd per bwd) — run fwd only if we have
+                # not outrun the backward stream by more than warmup
+                paced = fi[d] < warm[d] + bi[d] + 1
+                if ready and paced:
+                    row_f[d] = (chunk, mb)
+            if bi[d] < m * v:
+                chunk, mb = order_b[bi[d]]
+                k = chunk * s + d
+                last = s * v - 1
+                own_fwd = fwd_done.get((k, mb))
+                if own_fwd is None and row_f[d] == (chunk, mb):
+                    own_fwd = t      # fwd sub-tick precedes bwd in-tick
+                if k == last:
+                    ready = own_fwd is not None and own_fwd <= t
+                else:
+                    ready = (own_fwd is not None and own_fwd <= t
+                             and bwd_done.get((k + 1, mb), t) < t)
+                if ready:
+                    row_b[d] = (chunk, mb)
+        # commit the tick
+        for d in range(s):
+            if row_f[d][0] >= 0:
+                chunk, mb = row_f[d]
+                fwd_done[(chunk * s + d, mb)] = t
+                fi[d] += 1
+            if row_b[d][0] >= 0:
+                chunk, mb = row_b[d]
+                bwd_done[(chunk * s + d, mb)] = t
+                bi[d] += 1
+        sched_f.append(row_f)
+        sched_b.append(row_b)
+        t += 1
+    if t >= limit:
+        raise RuntimeError(
+            "interleaved schedule did not converge (s=%d v=%d m=%d): "
+            "fi=%s bi=%s" % (s, v, m, fi, bi))
+    # verification: every non-first stage's fwd input was produced on
+    # the PREVIOUS tick or earlier; every consumer's recv buffer holds
+    # at most the latest hop (producer sent at exactly consumer_tick-1
+    # OR the value sat in the buffer undisturbed — check no overwrite:
+    # between production+1 and consumption, the producing device sent
+    # no OTHER unit to the same consumer direction)
+    def check_stream(done, direction):
+        # recv buffers are PER CHUNK on the consumer: overwrite only
+        # matters among hops landing in the same (dst, chunk) slot
+        sends = {}        # (src, dst, dst_chunk) -> [(tick, unit)]
+        for (k, mb), tick in done.items():
+            if direction == "f" and k + 1 < s * v:
+                kc = k + 1
+                sends.setdefault((k % s, kc % s, kc // s), []).append(
+                    (tick, (k, mb)))
+            if direction == "b" and k > 0:
+                kc = k - 1
+                sends.setdefault((k % s, kc % s, kc // s), []).append(
+                    (tick, (k, mb)))
+        for slot, lst in sends.items():
+            lst.sort()
+            for (t1, u1), (t2, u2) in zip(lst, lst[1:]):
+                # u1 is read at the START of its consumer's tick tc;
+                # u2's store lands at the END of tick t2 — any t2 < tc
+                # clobbers u1 before the read (t2 == tc stores after)
+                k1, mb1 = u1
+                kc = k1 + 1 if direction == "f" else k1 - 1
+                tc = done.get((kc, mb1))
+                if tc is not None and t2 < tc:
+                    raise RuntimeError(
+                        "recv slot overwrite on %s (%s): unit %s "
+                        "(sent t=%d, consumed t=%d) clobbered by %s "
+                        "(sent t=%d)" % (slot, direction,
+                                         u1, t1, tc, u2, t2))
+        # consumption causality
+        for (k, mb), tick in done.items():
+            kc = k + 1 if direction == "f" else k - 1
+            if 0 <= kc < s * v:
+                tc = done.get((kc, mb))
+                if tc is not None and tc <= tick:
+                    raise RuntimeError(
+                        "causality violation (%s): stage %d mb %d at "
+                        "t=%d but stage %d ran at t=%d"
+                        % (direction, k, mb, tick, kc, tc))
+    check_stream(fwd_done, "f")
+    check_stream(bwd_done, "b")
+    out = {}
+    for name, sched, j in (("fwd_chunk", sched_f, 0),
+                           ("fwd_mb", sched_f, 1),
+                           ("bwd_chunk", sched_b, 0),
+                           ("bwd_mb", sched_b, 1)):
+        out[name] = np.asarray(
+            [[row[d][j] for row in sched] for d in range(s)], np.int32)
+    out["n_ticks"] = t
+
+    # recv-store tables: which PER-CHUNK slot the hop arriving at tick
+    # t (sent at t-1 on the ring) lands in, -1 = discard.  The fwd ring
+    # is d -> d+1 with s-1 -> 0 wraparound; a sender's destination
+    # stage k+1 always lives on (src+1) %% s, so the ring delivery and
+    # the stage topology agree by construction.
+    store_f = -np.ones((s, t), np.int32)
+    store_b = -np.ones((s, t), np.int32)
+    for tick in range(1, t):
+        for dst in range(s):
+            src = (dst - 1) % s
+            c_s, _ = sched_f[tick - 1][src]
+            if c_s >= 0 and c_s * s + src + 1 < s * v:
+                store_f[dst, tick] = (c_s * s + src + 1) // s
+            src = (dst + 1) % s
+            c_s, _ = sched_b[tick - 1][src]
+            if c_s >= 0 and c_s * s + src > 0:
+                store_b[dst, tick] = (c_s * s + src - 1) // s
+    out["store_f"] = store_f
+    out["store_b"] = store_b
+
+    # stash depth: max concurrently in-flight (fwd done, bwd pending)
+    # microbatches over every (device, chunk)
+    n_stash = 1
+    for d in range(s):
+        for c in range(v):
+            k = c * s + d
+            events = sorted(
+                [(fwd_done[(k, mb)], 1) for mb in range(m)]
+                + [(bwd_done[(k, mb)] + 0.5, -1) for mb in range(m)])
+            live = peak = 0
+            for _, delta in events:
+                live += delta
+                peak = max(peak, live)
+            n_stash = max(n_stash, peak + 1)
+    out["n_stash"] = n_stash
+    return out
+
+
+def bubble_fraction(s, v, m):
+    """Measured bubble of the generated schedule: idle fwd+bwd
+    sub-ticks over total sub-ticks."""
+    tab = build_schedule(s, v, m)
+    total = 2 * s * tab["n_ticks"]
+    busy = int((tab["fwd_mb"] >= 0).sum() + (tab["bwd_mb"] >= 0).sum())
+    return (total - busy) / total
