@@ -10,6 +10,8 @@ first successful probe, immediately runs
     1. ``python bench.py``                  (full default phase list)
     2. ``python bench.py --phase flashtune`` (flash block-size sweep)
     3. ``python bench.py --phase gemmtune``  (bf16 MFU attribution sweep)
+    4. ``python bench.py --phase servecont`` (continuous-batching pool)
+    5. same with ``BENCH_SERVE_PAGED=16``    (paged vs dense serving)
 
 tee-ing every byte to ``.watcher/`` and then EXITING, so a supervising
 session is woken up to analyze the numbers while the window is still open.
@@ -57,7 +59,7 @@ def probe(timeout=150):
     return False, (proc.stderr or "no output")[-200:].replace("\n", " ")
 
 
-def run_step(argv, tag, timeout):
+def run_step(argv, tag, timeout, env=None):
     """Run one bench step, tee output to .watcher/<tag>_<ts>.log."""
     stamp = datetime.datetime.now(
         datetime.timezone.utc).strftime("%Y%m%d_%H%M%S")
@@ -66,7 +68,7 @@ def run_step(argv, tag, timeout):
     t0 = time.monotonic()
     try:
         proc = subprocess.run(argv, cwd=ROOT, capture_output=True,
-                              text=True, timeout=timeout)
+                              text=True, timeout=timeout, env=env)
         out = (proc.stdout or "") + "\n--- stderr ---\n" + (proc.stderr or "")
         rc = proc.returncode
     except subprocess.TimeoutExpired as e:
@@ -109,6 +111,15 @@ def main():
                      "flashtune", timeout=1800)
             run_step([py, "bench.py", "--phase", "gemmtune"],
                      "gemmtune", timeout=1800)
+            # serving-plane phases (playbook step 5): dense pool vs
+            # solo, then the paged pool — captured automatically so a
+            # short window the operator misses still prices the
+            # block-table gather/scatter on real HBM
+            run_step([py, "bench.py", "--phase", "servecont"],
+                     "servecont", timeout=1200)
+            run_step([py, "bench.py", "--phase", "servecont"],
+                     "servecont_paged", timeout=1200,
+                     env=dict(os.environ, BENCH_SERVE_PAGED="16"))
             _log("bench sequence complete — exiting so the session wakes up")
             return 0
         _log("probe %d down: %s" % (attempt, detail))
