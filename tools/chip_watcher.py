@@ -115,8 +115,13 @@ def main():
             # solo, then the paged pool — captured automatically so a
             # short window the operator misses still prices the
             # block-table gather/scatter on real HBM
+            # the dense baseline must explicitly DROP any inherited
+            # BENCH_SERVE_PAGED, or a leftover export would turn the
+            # dense-vs-paged A/B into paged-vs-paged
             run_step([py, "bench.py", "--phase", "servecont"],
-                     "servecont", timeout=1200)
+                     "servecont", timeout=1200,
+                     env={k: v for k, v in os.environ.items()
+                          if k != "BENCH_SERVE_PAGED"})
             run_step([py, "bench.py", "--phase", "servecont"],
                      "servecont_paged", timeout=1200,
                      env=dict(os.environ, BENCH_SERVE_PAGED="16"))

@@ -211,7 +211,7 @@ def pipeline_train_1f1b(stage_fn, first_fn, last_fn, params, x, y,
 
 def pipeline_train_1f1b_sharded(stage_fn, first_fn, last_fn, params, x, y,
                                 mesh, pipe_axis="pipe", n_microbatches=4,
-                                batch_axis=None):
+                                batch_axis=None, block_specs=None):
     """Global 1F1B entry: ``params = (p_first, p_blocks_stacked,
     p_last)`` with the block leaves stacked [n_blocks, ...] and sharded
     over ``pipe_axis`` (k = n_blocks / pipe_size consecutive blocks per
@@ -220,7 +220,14 @@ def pipeline_train_1f1b_sharded(stage_fn, first_fn, last_fn, params, x, y,
     grads sharded over ``pipe_axis``, ready for the optimizer.
 
     ``batch_axis``: shard the batch dim over a data axis too; grads are
-    pmean'd and the loss averaged across data slices."""
+    pmean'd and the loss averaged across data slices.
+
+    ``block_specs``: per-leaf PartitionSpecs for the block stack when a
+    stage is ALSO tensor-parallel — e.g. ``{"w": P("pipe", None,
+    "model"), "b": P("pipe")}`` column-shards each block's matrix over
+    a ``model`` axis; ``stage_fn`` then uses the model axis's
+    collectives (all_gather/psum) exactly as a Megatron layer would,
+    and block grads come back in the same sharding."""
     p_first, p_blocks, p_last = params
     pipe_size = mesh.shape[pipe_axis]
     for leaf in jax.tree_util.tree_leaves(p_blocks):
@@ -228,7 +235,19 @@ def pipeline_train_1f1b_sharded(stage_fn, first_fn, last_fn, params, x, y,
             raise ValueError(
                 "stacked stage dim %d not divisible by %s axis size %d"
                 % (leaf.shape[0], pipe_axis, pipe_size))
-    bspec = jax.tree_util.tree_map(lambda _: P(pipe_axis), p_blocks)
+    if block_specs is not None:
+        for spec in jax.tree_util.tree_leaves(
+                block_specs, is_leaf=lambda s: isinstance(s, P)):
+            if not spec or spec[0] != pipe_axis:
+                # a spec that misses pipe on the stage dim would make
+                # shard_map replicate the FULL block stack to every
+                # device — each stage then runs the whole network:
+                # silently wrong numbers, so fail loudly instead
+                raise ValueError(
+                    "block_specs leaf %s must shard its leading "
+                    "(stage) dim over %r" % (spec, pipe_axis))
+    bspec = (block_specs if block_specs is not None else
+             jax.tree_util.tree_map(lambda _: P(pipe_axis), p_blocks))
     rspec_f = jax.tree_util.tree_map(lambda _: P(), p_first)
     rspec_l = jax.tree_util.tree_map(lambda _: P(), p_last)
     xspec = P(batch_axis) if batch_axis else P()
