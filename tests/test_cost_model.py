@@ -77,3 +77,14 @@ def test_servecont_pool_speedup_band():
     solo-sequential (CPU smoke measured 2.7x at 4 streams)."""
     s = cm.predict_servecont()
     assert 3.0 < s["pool_vs_solo"] < 8.0
+
+
+def test_pipeline_prediction_interleaving_wins():
+    """Pre-registered multi-chip prediction: interleaved 1F1B beats
+    plain on the 124M flagship, more so at larger S, and both stay
+    above the zero-bubble bound."""
+    for s, min_speedup in ((4, 1.02), (8, 1.08)):
+        p = cm.predict_pipeline_lm_large(s=s)
+        assert p["interleaved_speedup"] >= min_speedup, p
+        assert p["bubble_interleaved"] < p["bubble_plain"]
+        assert p["step_ms_interleaved"] > p["step_ms_zero_bubble_bound"]

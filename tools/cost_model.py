@@ -461,3 +461,33 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def predict_pipeline_lm_large(s=4, m=16, v=2):
+    """Multi-chip pipeline prediction for the 124M flagship: per-rung
+    step time under GPipe-autodiff, plain 1F1B, and interleaved 1F1B,
+    from the verified schedule tables (parallel.interleave) x the
+    single-chip per-chunk compute time the roofline gives.  No chip
+    pod exists to measure against yet — this is the pre-registered
+    prediction the first multi-chip window confirms."""
+    from veles_tpu.parallel.interleave import build_schedule
+
+    base = _lm_predict(768, 12, 1024, 50304, batch=m, n_heads=12,
+                       steps_per_dispatch=4)
+    # one microbatch through one chunk (1/(s*v) of the blocks), fwd
+    # only; bwd sub-ticks cost ~2x fwd
+    t_chunk_fwd = base["ms_per_step"] / 1e3 / (3 * m * v)  # per fwd unit
+    ticks_plain = (m + 2 * (s - 1)) * v      # superstage = v chunks
+    ticks_inter = build_schedule(s, v, m)["n_ticks"]
+    step_plain = ticks_plain * 3 * t_chunk_fwd
+    step_inter = ticks_inter * 3 * t_chunk_fwd
+    ideal = m * v * 3 * t_chunk_fwd          # zero-bubble bound
+    return {
+        "s": s, "m": m, "v": v,
+        "step_ms_plain_1f1b": round(step_plain * 1e3, 1),
+        "step_ms_interleaved": round(step_inter * 1e3, 1),
+        "step_ms_zero_bubble_bound": round(ideal * 1e3, 1),
+        "interleaved_speedup": round(step_plain / step_inter, 3),
+        "bubble_plain": round(1 - ideal / step_plain, 3),
+        "bubble_interleaved": round(1 - ideal / step_inter, 3),
+    }
