@@ -236,7 +236,12 @@ def _lm_predict(d_model, n_layers, seq, vocab, batch, n_heads,
                                     d_ff=d_ff, n_heads=n_heads,
                                     n_kv_heads=n_kv_heads or n_heads)
     return {"tokens_per_sec": tps, "ms_per_step": step * 1e3,
-            "mfu": tps * fpt / PEAK_BF16, "n_params": params}
+            "mfu": tps * fpt / PEAK_BF16, "n_params": params,
+            # components for composed models (pipeline prediction):
+            # pure fwd+bwd compute vs the once-per-step constants
+            "compute_ms": (fwd + bwd) * 1e3, "opt_ms": opt * 1e3,
+            "overhead_ms": (kernels * T_KERNEL + H_STEP
+                            + T_DISPATCH / steps_per_dispatch) * 1e3}
 
 
 def predict_lm():
@@ -363,6 +368,38 @@ def predict_servecont(d=768, n_layers=12, vocab=50304, slots=8,
             "pool_vs_solo": pool_tps / solo_tps}
 
 
+def predict_pipeline_lm_large(s=4, m=16, v=2):
+    """Multi-chip pipeline prediction for the 124M flagship: step time
+    under plain vs interleaved 1F1B from the verified schedule tables
+    (parallel.interleave) x the roofline per-chunk compute time, plus
+    the once-per-step constants (optimizer sweep over this chip's 1/s
+    of the params, dispatch/host overhead).  No chip pod exists to
+    measure against yet — this is the pre-registered prediction the
+    first multi-chip window confirms."""
+    from veles_tpu.parallel.interleave import build_schedule
+
+    base = _lm_predict(768, 12, 1024, 50304, batch=m, n_heads=12,
+                       steps_per_dispatch=4)
+    # one microbatch through one chunk (1/(s*v) of the blocks), fwd
+    # only — compute time only; bwd sub-ticks cost ~2x fwd
+    t_chunk_fwd = base["compute_ms"] / 1e3 / (3 * m * v * s)
+    const = (base["opt_ms"] / s + base["overhead_ms"]) / 1e3
+    ticks_plain = (m + 2 * (s - 1)) * v      # superstage = v chunks
+    ticks_inter = build_schedule(s, v, m)["n_ticks"]
+    step_plain = ticks_plain * 3 * t_chunk_fwd + const
+    step_inter = ticks_inter * 3 * t_chunk_fwd + const
+    ideal = m * v * 3 * t_chunk_fwd + const  # zero-bubble bound
+    return {
+        "s": s, "m": m, "v": v,
+        "step_ms_plain_1f1b": round(step_plain * 1e3, 1),
+        "step_ms_interleaved": round(step_inter * 1e3, 1),
+        "step_ms_zero_bubble_bound": round(ideal * 1e3, 1),
+        "interleaved_speedup": round(step_plain / step_inter, 3),
+        "bubble_plain": round(1 - ideal / step_plain, 3),
+        "bubble_interleaved": round(1 - ideal / step_inter, 3),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Postdiction + bench integration
 # ---------------------------------------------------------------------------
@@ -462,32 +499,3 @@ def main():
 if __name__ == "__main__":
     main()
 
-
-def predict_pipeline_lm_large(s=4, m=16, v=2):
-    """Multi-chip pipeline prediction for the 124M flagship: per-rung
-    step time under GPipe-autodiff, plain 1F1B, and interleaved 1F1B,
-    from the verified schedule tables (parallel.interleave) x the
-    single-chip per-chunk compute time the roofline gives.  No chip
-    pod exists to measure against yet — this is the pre-registered
-    prediction the first multi-chip window confirms."""
-    from veles_tpu.parallel.interleave import build_schedule
-
-    base = _lm_predict(768, 12, 1024, 50304, batch=m, n_heads=12,
-                       steps_per_dispatch=4)
-    # one microbatch through one chunk (1/(s*v) of the blocks), fwd
-    # only; bwd sub-ticks cost ~2x fwd
-    t_chunk_fwd = base["ms_per_step"] / 1e3 / (3 * m * v)  # per fwd unit
-    ticks_plain = (m + 2 * (s - 1)) * v      # superstage = v chunks
-    ticks_inter = build_schedule(s, v, m)["n_ticks"]
-    step_plain = ticks_plain * 3 * t_chunk_fwd
-    step_inter = ticks_inter * 3 * t_chunk_fwd
-    ideal = m * v * 3 * t_chunk_fwd          # zero-bubble bound
-    return {
-        "s": s, "m": m, "v": v,
-        "step_ms_plain_1f1b": round(step_plain * 1e3, 1),
-        "step_ms_interleaved": round(step_inter * 1e3, 1),
-        "step_ms_zero_bubble_bound": round(ideal * 1e3, 1),
-        "interleaved_speedup": round(step_plain / step_inter, 3),
-        "bubble_plain": round(1 - ideal / step_plain, 3),
-        "bubble_interleaved": round(1 - ideal / step_inter, 3),
-    }
