@@ -85,6 +85,11 @@ async function searchLogs(){
 loadRuns();
 </script>
 <script>
+function sparkSpan(k,pts){  // shared spark markup (metrics + serving)
+ return '<span class="spark">'+esc(k)+' '+sparkline(pts)+
+  ' <span class="v">'+pts[pts.length-1][1].toPrecision(4)+
+  '</span></span>';
+}
 function sparkline(points){           // [[epoch, value], ...] -> SVG
  const w=120, h=28, vals=points.map(p=>p[1]);
  const lo=Math.min(...vals), hi=Math.max(...vals), span=(hi-lo)||1;
@@ -180,17 +185,30 @@ async function refresh(){
  document.getElementById('status').innerHTML =
   '<pre>'+JSON.stringify(s,null,2)+'</pre>';
  if(s.serving){
-  const rows=Object.entries(s.serving.continuous||s.serving)
+  const c=s.serving.continuous||s.serving;
+  const rows=Object.entries(c)
    .filter(([k,v])=>typeof v!=='object')
-   .map(([k,v])=>'<tr><td>'+k+'</td><td>'+v+'</td></tr>').join('');
+   .map(([k,v])=>'<tr><td>'+esc(k)+'</td><td>'+esc(v)+'</td></tr>')
+   .join('');
+  // client-side ring buffer -> live time-series of the SLO gauges
+  // (one sample per refresh; the server only ever sends a snapshot)
+  window._srv=window._srv||{};
+  for(const k of ['agg_tokens_per_sec','queued','in_flight',
+                  'p99_queue_wait_ms']){
+   if(typeof c[k]==='number'){
+    (window._srv[k]=window._srv[k]||[]).push([0,c[k]]);
+    if(window._srv[k].length>120)window._srv[k].shift();
+   }
+  }
+  const sparks=Object.entries(window._srv)
+   .filter(([k,pts])=>pts.length>1)
+   .map(([k,pts])=>sparkSpan(k,pts)).join('');
   document.getElementById('serving').innerHTML=
-   '<table>'+rows+'</table>';
+   sparks+'<table>'+rows+'</table>';
  }
  const m=await (await fetch('/api/metrics')).json();
  document.getElementById('metrics').innerHTML =
-  Object.entries(m).map(([k,pts])=>
-   '<span class="spark">'+k+' '+sparkline(pts)+' <span class="v">'+
-   pts[pts.length-1][1].toPrecision(4)+'</span></span>').join('')
+  Object.entries(m).map(([k,pts])=>sparkSpan(k,pts)).join('')
   || '(no epoch metrics yet)';
  const g=await (await fetch('/api/graph')).json();
  document.getElementById('graph').innerHTML =
