@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Diagnose the impossible lm_large timing from the 2026-08-01 window.
+
+``BENCH`` measured lm-124M at 1.8 ms/step (MFU 3748%) — physically
+impossible (roofline floor ~62 ms/step at 197 TF/s), while gemm/alexnet
+in the same run were plausible.  The lm harness times N async fused
+dispatches and blocks ONCE on the final loss; gemm blocks after EVERY
+dispatch.  Hypothesis: on the axon tunnel backend,
+``jax.block_until_ready`` on a chained-dispatch output returns early
+(ack-on-enqueue), so only per-dispatch-blocked timing can be trusted.
+
+Experiment A — same jitted matmul chain, two timing disciplines:
+  final-block:  enqueue K dispatches, block once at the end
+  each-block:   block after every dispatch
+If final-block reports much less wall time than each-block for the
+same work, block-on-final is broken on this backend and every
+multi-dispatch timed region in bench.py must block per dispatch.
+
+Experiment B — the ground truth lm_large number: the real 124M
+flagship, timing each fused 4-step sweep with an explicit block, plus
+a loss device_get so the value itself proves the step ran.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def experiment_a():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    n, iters, k = 4096, 10, 5
+    a = jnp.asarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
+    a = a / jnp.linalg.norm(a)  # keep the chain finite
+
+    def body(y, _):
+        return jnp.dot(y, a), None
+
+    f = jax.jit(lambda y: lax.scan(body, y, None, length=iters)[0],
+                donate_argnums=(0,))
+    y = jax.block_until_ready(f(jnp.copy(a)))
+
+    t0 = time.perf_counter()
+    for _ in range(k):
+        y = f(y)
+    jax.block_until_ready(y)
+    dt_final = time.perf_counter() - t0
+
+    y = jax.block_until_ready(f(y))
+    t0 = time.perf_counter()
+    for _ in range(k):
+        y = jax.block_until_ready(f(y))
+    dt_each = time.perf_counter() - t0
+
+    flops = 2.0 * n ** 3 * iters * k
+    print("A: final-block %.1f ms (%.1f GF/s) | each-block %.1f ms "
+          "(%.1f GF/s) | ratio %.2fx"
+          % (dt_final * 1e3, flops / dt_final / 1e9,
+             dt_each * 1e3, flops / dt_each / 1e9,
+             dt_each / dt_final), flush=True)
+    return dt_each / dt_final
+
+
+def experiment_b():
+    import jax
+    from tools.profile_capture import build_flagship
+    from veles_tpu.ops.flops import lm_train_flops_per_token
+
+    wf = build_flagship(remat="dots", batch=16)
+    # compile + warmup: 2 fused sweeps, fully blocked
+    for _ in range(8):
+        wf.loader.run()
+        wf.trainer.run()
+    wf.trainer.flush()
+    jax.block_until_ready(wf.trainer.class_stats[2])
+
+    times = []
+    for rep in range(4):
+        t0 = time.perf_counter()
+        for _ in range(4):     # one fused sweep = 4 steps
+            wf.loader.run()
+            wf.trainer.run()
+        wf.trainer.flush()
+        jax.block_until_ready(wf.trainer.class_stats[2])
+        times.append(time.perf_counter() - t0)
+    loss = float(jax.device_get(wf.trainer.class_stats[2]["loss"]))
+    cnt = float(jax.device_get(wf.trainer.class_stats[2]["count"]))
+    ms_step = sorted(times)[1] / 4 * 1e3
+    tok_s = 16 * 1024 / (ms_step / 1e3)
+    fpt = lm_train_flops_per_token(768, 12, 1024, 50304, n_heads=12)
+    mfu = tok_s * fpt / 197e12
+    print("B: lm-124M per-sweep-blocked: %.1f ms/step, %.0f tok/s, "
+          "MFU %.1f%% (sweep times %s) loss/count %.3f/%.0f"
+          % (ms_step, tok_s, mfu * 100,
+             ["%.0fms" % (t * 1e3) for t in times], loss, cnt),
+          flush=True)
+
+
+def main():
+    import jax
+    print("devices:", jax.devices(), flush=True)
+    ratio = experiment_a()
+    if ratio > 3.0:
+        print("VERDICT: block-on-final is BROKEN on this backend "
+              "(ratio %.1fx) — bench must block per dispatch" % ratio,
+              flush=True)
+    else:
+        print("VERDICT: chained-dispatch blocking is sound "
+              "(ratio %.2fx)" % ratio, flush=True)
+    experiment_b()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Part 2 of the axon-tunnel timing audit: can a SINGLE dispatch +
+``block_until_ready`` be trusted (the gemm/flash in-scan pattern), or
+does only a host ``device_get`` prove completion?
+
+Pattern: one jitted scan of K matmuls, then
+  t_block   = time(block_until_ready(out))
+  t_fetch   = time(device_get(out[0,0])) right after the block
+If the block is honest, the fetch is pure RTT (~tens of ms).  If the
+block acks early, the fetch absorbs the remaining compute and
+t_fetch ~ t_compute — and every single-dispatch bench number must be
+re-measured with a fetch barrier.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    print("devices:", jax.devices(), flush=True)
+    n, iters = 8192, 10
+    a = np.random.RandomState(0).rand(n, n).astype(np.float32)
+    a /= np.linalg.norm(a)
+    a = jnp.asarray(a).astype(jnp.bfloat16)
+
+    def body(y, _):
+        return jnp.dot(y, a), None
+
+    f = jax.jit(lambda y: lax.scan(body, y, None, length=iters)[0],
+                donate_argnums=(0,))
+    y = jax.block_until_ready(f(jnp.copy(a)))
+    _ = jax.device_get(y[0, 0])          # drain any stragglers
+
+    flops = 2.0 * n ** 3 * iters
+    for rep in range(3):
+        t0 = time.perf_counter()
+        y = f(y)
+        t_enq = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(y)
+        t_blk = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        v = jax.device_get(y[0, 0])
+        t_fetch = time.perf_counter() - t0
+        print("rep%d: enqueue %6.1f ms | block %7.1f ms (%5.1f TF/s) | "
+              "fetch-after-block %7.1f ms | v=%s"
+              % (rep, t_enq * 1e3, t_blk * 1e3,
+                 flops / max(t_blk, 1e-9) / 1e12, t_fetch * 1e3, v),
+              flush=True)
+    # bare-RTT reference: fetch a tiny READY array
+    z = jax.block_until_ready(jnp.zeros((1,)))
+    t0 = time.perf_counter()
+    jax.device_get(z)
+    print("bare fetch RTT: %.1f ms" % ((time.perf_counter() - t0) * 1e3),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""One-shot on-silicon profiler capture of the flagship training step.
+
+Round-4 verdict item #7: "one jax.profiler capture around a lm_large
+fused dispatch, artifact committed, so where TPU time goes stops being
+inference."  This runs the same 124M GPT-2-small-class model as
+``bench.py --phase lm_large`` (top ladder rung first, stepping down on
+OOM), wraps a few fused dispatches in ``jax.profiler.trace``, then
+parses the chrome-trace dump into a top-ops-by-device-time table.
+
+The trace artifact (``*.trace.json.gz``, loadable in Perfetto) is
+copied under ``artifacts/`` for the repo; the summary prints to stdout
+for BENCH_SESSION.md.  Mirrors the reference's measured-evidence
+standard (its device DB is benchmark output from real silicon,
+ref ``veles/backends.py:672-731``).
+
+Usage:  python tools/profile_capture.py [--steps 3] [--outdir artifacts/profile_r05]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def build_flagship(remat="dots", batch=16):
+    """The bench lm_large flagship: 124M params, T=1024, flash attn."""
+    import numpy as np
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+
+    prng.seed_all(5)
+    vocab, seq = 50304, 1024
+    n = batch * 4
+    toks = np.random.RandomState(0).randint(
+        0, vocab, (n, seq)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=batch,
+                             class_lengths=[0, 0, n])
+    wf = StandardWorkflow(
+        layers=transformer_lm(
+            vocab_size=vocab, d_model=768, n_heads=12, n_layers=12,
+            dropout=0.0, impl="flash", pos="rope", solver="adamw",
+            lr=6e-4, tie_embeddings=True, remat=remat),
+        loader=loader, loss="lm", gd_defaults={"clip_norm": 1.0},
+        decision_config={"max_epochs": 1000},
+        steps_per_dispatch=4, name="profile-lm-124M")
+    wf.initialize()
+    return wf
+
+
+def summarize_trace(trace_path, top=18):
+    """Top device ops by total duration from the chrome-trace dump.
+
+    Groups complete events ("ph":"X") by op name within TPU lanes
+    (pids whose process_name mentions TPU / device), so host python
+    rows don't drown the device timeline."""
+    with gzip.open(trace_path, "rb") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # map pid -> process name from metadata events
+    pnames = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pnames[ev.get("pid")] = ev.get("args", {}).get("name", "")
+    device_pids = {pid for pid, name in pnames.items()
+                   if any(k in name.lower()
+                          for k in ("tpu", "device", "/device:"))}
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    span_us = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+            continue
+        dur = float(ev.get("dur", 0.0))
+        name = ev.get("name", "?")
+        tot[name] += dur
+        cnt[name] += 1
+        span_us = max(span_us, float(ev.get("ts", 0.0)) + dur)
+    rows = tot.most_common(top)
+    total = sum(tot.values())
+    lines = ["device ops by total time (%d lanes, %.1f ms device-op "
+             "time total):" % (len(device_pids), total / 1e3)]
+    for name, us in rows:
+        lines.append("  %7.2f ms  %5.1f%%  x%-5d %s"
+                     % (us / 1e3, 100.0 * us / total if total else 0.0,
+                        cnt[name], name[:90]))
+    return "\n".join(lines), {"total_device_op_ms": total / 1e3,
+                              "top": [(n, round(u / 1e3, 3))
+                                      for n, u in rows]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3,
+                    help="fused dispatches inside the trace window")
+    ap.add_argument("--outdir", default=os.path.join(
+        ROOT, "artifacts", "profile_r05"))
+    args = ap.parse_args()
+
+    import gc
+
+    import jax
+    print("devices:", jax.devices(), flush=True)
+
+    wf = None
+    for remat, batch in (("dots", 16), (True, 16), (True, 8)):
+        try:
+            wf = build_flagship(remat=remat, batch=batch)
+            # compile + warmup outside the trace window
+            for _ in range(8):
+                wf.loader.run()
+                wf.trainer.run()
+            wf.trainer.flush()
+            jax.block_until_ready(wf.trainer.class_stats[2]["loss"])
+            break
+        except Exception as e:  # noqa: BLE001 — OOM ladder
+            if "RESOURCE_EXHAUSTED" not in str(e) and \
+                    "Out of memory" not in str(e):
+                raise
+            print("remat=%s b%d OOM — next rung" % (remat, batch),
+                  flush=True)
+            wf = None
+            gc.collect()
+    if wf is None:
+        print("all ladder rungs OOM", flush=True)
+        return 1
+
+    tmpdir = os.path.join(ROOT, ".watcher", "profile_raw")
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(tmpdir):
+        for _ in range(args.steps):
+            wf.loader.run()
+            wf.trainer.run()
+        wf.trainer.flush()
+        jax.block_until_ready(wf.trainer.class_stats[2]["loss"])
+    wall = time.perf_counter() - t0
+    print("traced %d fused dispatches (4 train steps each) in %.1f ms"
+          % (args.steps, wall * 1e3), flush=True)
+
+    paths = sorted(glob.glob(os.path.join(
+        tmpdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        print("no trace.json.gz produced under", tmpdir, flush=True)
+        return 1
+    os.makedirs(args.outdir, exist_ok=True)
+    dest = os.path.join(args.outdir, "lm_large_step.trace.json.gz")
+    shutil.copy(paths[-1], dest)
+    summary, stats = summarize_trace(paths[-1])
+    print(summary, flush=True)
+    stats["wall_ms_traced"] = round(wall * 1e3, 1)
+    stats["steps_traced"] = args.steps * 4
+    with open(os.path.join(args.outdir, "summary.json"), "w") as f:
+        json.dump(stats, f, indent=1)
+    print("artifact:", os.path.relpath(dest, ROOT), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
