@@ -103,6 +103,58 @@ def _block(x):
     return jax.block_until_ready(x)
 
 
+def _fetch_sync(wf, cls=2):
+    """The only trustworthy device barrier on the tunnel backend: FETCH
+    the loss scalar.  ``block_until_ready`` acks early and untrustably
+    on this backend (tools/diag_async.py measured a 124M train step at
+    0.7 ms via block; the fetched-value truth is ~200 ms) — but the
+    VALUE of the final step's loss cannot exist before every queued
+    predecessor executed, so a device_get is transitively honest.
+    Costs one ~64 ms tunnel RTT (tools/diag_sync2.py)."""
+    import jax
+    return float(jax.device_get(wf.trainer.class_stats[cls]["loss"]))
+
+
+def _timed_steps(wf, steps, cls=2):
+    """Wall seconds for ``steps`` loader+trainer steps, fetch-synced.
+
+    The async enqueues inside the loop are free; the closing fetch
+    forces the whole dependency chain.  The returned time includes one
+    tunnel RTT — callers timing sub-100ms regions should difference
+    two calls (slope) so the constant cancels."""
+    tr = wf.trainer
+    _fetch_sync(wf, cls)                  # drain anything outstanding
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        wf.loader.run()
+        tr.run()
+    tr.flush()
+    _fetch_sync(wf, cls)
+    return time.perf_counter() - t0
+
+
+def _per_step_ms_slope(wf, steps, cls=2, reps=3):
+    """Per-step ms via two-point slope — T(2k) - T(k) over k steps —
+    so the constant fetch RTT and enqueue overheads cancel.  For
+    phases whose per-step time is comparable to the ~64 ms RTT.
+    Median of ``reps`` slope samples; callers pick ``steps`` so the
+    differenced region is well above timing jitter (>= ~200 ms).
+    A non-positive median slope means the region was jitter-dominated:
+    fail LOUDLY (the fail-soft runner reports the phase error) rather
+    than publish another physically-impossible throughput."""
+    slopes = []
+    for _ in range(reps):
+        t1 = _timed_steps(wf, steps, cls)
+        t2 = _timed_steps(wf, 2 * steps, cls)
+        slopes.append((t2 - t1) / steps * 1e3)
+    med = sorted(slopes)[len(slopes) // 2]
+    if med <= 0.0:
+        raise RuntimeError(
+            "slope timing jitter-dominated (samples %s ms/step over "
+            "%d steps) — raise `steps`" % (slopes, steps))
+    return med
+
+
 def _norm_operand(n):
     """n x n operand pre-normalized by its dominant singular value
     (host-side power iteration) so a y <- y @ a chain needs NO per-iter
@@ -260,22 +312,18 @@ def phase_mlp():
         wf.initialize()
         return wf
 
-    def measure(wf, steps=60):
-        for _ in range(steps):          # compile + warmup (covers sweep)
+    def measure(wf, steps):
+        for _ in range(60):             # compile + warmup (covers sweep)
             wf.loader.run()
             wf.trainer.run()
         wf.trainer.flush()
         _block(wf.trainer.class_stats[2]["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            wf.loader.run()
-            wf.trainer.run()
-        wf.trainer.flush()
-        _block(wf.trainer.class_stats[2]["loss"])
-        return (time.perf_counter() - t0) / steps * 1e3
+        # sub-ms steps: slope timing, the fetch RTT constant cancels;
+        # step counts sized so the differenced region clears jitter
+        return _per_step_ms_slope(wf, steps)
 
-    step_ms = measure(build(1))
-    fused_ms = measure(build(20))
+    step_ms = measure(build(1), steps=200)
+    fused_ms = measure(build(20), steps=2000)
     _log("mnist mlp 784-100-10 step: %.3f ms per-step, %.3f ms fused k=20"
          % (step_ms, fused_ms))
     return {"step_ms": step_ms, "step_fused_ms": fused_ms}
@@ -309,12 +357,8 @@ def phase_alexnet():
     # min/max band published alongside)
     reps = []
     for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            wf.loader.run()
-            wf.trainer.run()
-        _block(wf.trainer.class_stats[2]["loss"])
-        reps.append(batch * steps / (time.perf_counter() - t0))
+        # ~30 ms/step vs the ~64 ms fetch RTT: slope timing
+        reps.append(batch / _per_step_ms_slope(wf, steps) * 1e3)
     sps = sorted(reps)[1]
     _log("alexnet synthetic: %.1f samples/sec/chip "
          "(median of 3; band %.1f-%.1f, spread %.1f%%)"
@@ -367,14 +411,8 @@ def _run_lm(tag, zoo_kwargs, batch, seq, steps, steps_per_dispatch,
         wf.trainer.run()
     wf.trainer.flush()
     _block(wf.trainer.class_stats[2]["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        wf.loader.run()
-        wf.trainer.run()
-    wf.trainer.flush()
-    _block(wf.trainer.class_stats[2]["loss"])
-    dt = time.perf_counter() - t0
-    tps = batch * seq * steps / dt
+    ms_step = _per_step_ms_slope(wf, steps)
+    tps = batch * seq / ms_step * 1e3
     fpt = _lm_train_flops_per_token(
         zoo_kwargs["d_model"], zoo_kwargs["n_layers"], seq, vocab,
         n_heads=zoo_kwargs.get("n_heads"),
@@ -383,8 +421,8 @@ def _run_lm(tag, zoo_kwargs, batch, seq, steps, steps_per_dispatch,
     mfu = tps * fpt / (peak * 1e12) if peak else 0.0
     _log("%s (%.1fM params, T=%d): %.0f tokens/sec/chip, "
          "%.1f ms/step, MFU %.1f%%"
-         % (tag, n_params / 1e6, seq, tps, dt / steps * 1e3, mfu * 100))
-    return {"tokens_per_sec": tps, "ms_per_step": dt / steps * 1e3,
+         % (tag, n_params / 1e6, seq, tps, ms_step, mfu * 100))
+    return {"tokens_per_sec": tps, "ms_per_step": ms_step,
             "mfu": mfu, "n_params": n_params,
             "peak_bf16_tflops": peak}
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Flagship step-time attribution by ablation (the tunnel profiler
+exposes no per-op device timeline — artifacts/profile_r05 — so where
+the 207 ms/step goes is measured by swapping one knob at a time).
+
+Each variant: build the 124M flagship, warm up, then time fused
+4-step sweeps with the block-per-dispatch discipline diag_async.py
+established.  Prints ms/step + MFU per variant.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def measure(tag, reps=3, **kw):
+    import gc
+
+    import jax
+    from tools.profile_capture import build_flagship
+    from veles_tpu.ops.flops import lm_train_flops_per_token
+
+    import numpy as np
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+    from veles_tpu.models.zoo import transformer_lm
+
+    prng.seed_all(5)
+    vocab, seq, batch = 50304, 1024, kw.pop("batch", 16)
+    n = batch * 4
+    toks = np.random.RandomState(0).randint(
+        0, vocab, (n, seq)).astype(np.int32)
+    loader = FullBatchLoader(None, data=toks, labels=toks,
+                             minibatch_size=batch,
+                             class_lengths=[0, 0, n])
+    zoo = dict(vocab_size=vocab, d_model=768, n_heads=12, n_layers=12,
+               dropout=0.0, impl="flash", pos="rope", solver="adamw",
+               lr=6e-4, tie_embeddings=True, remat="dots")
+    zoo.update(kw)
+    wf = StandardWorkflow(
+        layers=transformer_lm(**zoo), loader=loader, loss="lm",
+        gd_defaults={"clip_norm": 1.0},
+        decision_config={"max_epochs": 1000},
+        steps_per_dispatch=4, name="abl-" + tag)
+    try:
+        wf.initialize()
+        for _ in range(8):
+            wf.loader.run()
+            wf.trainer.run()
+        wf.trainer.flush()
+        # fetch = the only honest barrier on this tunnel (bench.py
+        # _fetch_sync rationale); slope over 8-vs-16 steps cancels the
+        # ~64 ms RTT constant
+        jax.device_get(wf.trainer.class_stats[2]["loss"])
+        times = []
+        for n_sweeps in (2, 4):
+            t0 = time.perf_counter()
+            for _ in range(4 * n_sweeps):
+                wf.loader.run()
+                wf.trainer.run()
+            wf.trainer.flush()
+            jax.device_get(wf.trainer.class_stats[2]["loss"])
+            times.append(time.perf_counter() - t0)
+        ms = (times[1] - times[0]) / 8 * 1e3
+        fpt = lm_train_flops_per_token(768, 12, 1024, 50304, n_heads=12)
+        mfu = (batch * 1024 / (ms / 1e3)) * fpt / 197e12
+        loss = float(jax.device_get(wf.trainer.class_stats[2]["loss"]))
+        print("%-26s %7.1f ms/step  MFU %5.1f%%  loss %.1f"
+              % (tag, ms, mfu * 100, loss), flush=True)
+    except Exception as e:  # noqa: BLE001 — keep the sweep going
+        print("%-26s FAILED: %s" % (tag, str(e)[:120]), flush=True)
+    del wf
+    gc.collect()
+
+
+def main():
+    import jax
+    print("devices:", jax.devices(), flush=True)
+    measure("flash/dots  (baseline)")
+    measure("naive/dots", impl="naive")
+    measure("blockwise/dots", impl="blockwise")
+    measure("flash/no-remat", remat=None)
+    measure("flash/dots/b32", batch=32)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

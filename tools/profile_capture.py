@@ -78,24 +78,28 @@ def summarize_trace(trace_path, top=18):
                           for k in ("tpu", "device", "/device:"))}
     tot = collections.Counter()
     cnt = collections.Counter()
-    span_us = 0.0
+    t_lo, t_hi = float("inf"), 0.0
     for ev in events:
         if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
             continue
         dur = float(ev.get("dur", 0.0))
+        ts = float(ev.get("ts", 0.0))
         name = ev.get("name", "?")
         tot[name] += dur
         cnt[name] += 1
-        span_us = max(span_us, float(ev.get("ts", 0.0)) + dur)
+        t_lo, t_hi = min(t_lo, ts), max(t_hi, ts + dur)
     rows = tot.most_common(top)
     total = sum(tot.values())
-    lines = ["device ops by total time (%d lanes, %.1f ms device-op "
-             "time total):" % (len(device_pids), total / 1e3)]
+    span = (t_hi - t_lo) / 1e3 if t_hi > t_lo else 0.0
+    lines = ["device ops by total time (%d lanes, %.1f ms summed op "
+             "time, %.1f ms device-activity span):"
+             % (len(device_pids), total / 1e3, span)]
     for name, us in rows:
         lines.append("  %7.2f ms  %5.1f%%  x%-5d %s"
                      % (us / 1e3, 100.0 * us / total if total else 0.0,
                         cnt[name], name[:90]))
     return "\n".join(lines), {"total_device_op_ms": total / 1e3,
+                              "device_span_ms": span,
                               "top": [(n, round(u / 1e3, 3))
                                       for n, u in rows]}
 
@@ -113,8 +117,10 @@ def main():
     import jax
     print("devices:", jax.devices(), flush=True)
 
+    # the same rung order phase_lm_large walks (single source of truth)
+    from veles_tpu.ops.flops import LM_LARGE_LADDER
     wf = None
-    for remat, batch in (("dots", 16), (True, 16), (True, 8)):
+    for remat, batch in [(r, b) for r, b, _, _ in LM_LARGE_LADDER]:
         try:
             wf = build_flagship(remat=remat, batch=batch)
             # compile + warmup outside the trace window
@@ -122,7 +128,7 @@ def main():
                 wf.loader.run()
                 wf.trainer.run()
             wf.trainer.flush()
-            jax.block_until_ready(wf.trainer.class_stats[2]["loss"])
+            jax.device_get(wf.trainer.class_stats[2]["loss"])
             break
         except Exception as e:  # noqa: BLE001 — OOM ladder
             if "RESOURCE_EXHAUSTED" not in str(e) and \
@@ -144,7 +150,10 @@ def main():
             wf.loader.run()
             wf.trainer.run()
         wf.trainer.flush()
-        jax.block_until_ready(wf.trainer.class_stats[2]["loss"])
+        # fetch, not block: block_until_ready acks early on the tunnel
+        # backend (tools/diag_async.py) and would close the trace
+        # window before the device work ran
+        jax.device_get(wf.trainer.class_stats[2]["loss"])
     wall = time.perf_counter() - t0
     print("traced %d fused dispatches (4 train steps each) in %.1f ms"
           % (args.steps, wall * 1e3), flush=True)
