@@ -38,6 +38,7 @@ is the held-out rows no constant was fit to:
   lm-124M T=2048       pred 242   meas 215.5  (+12.3%)
   beam ms/pos          pred 0.115 meas 0.111  (+3.3%)
   flash T=8192 ms      pred 6.98  meas 8.16   (-14.5%)
+  serve bf16 d=1536    pred 1.48  meas 1.553  (-4.7%)
 (serve int8 is an ANCHOR — its 1.85 effective-B/param was fit to the
 int8 measurement itself, so it cannot count as a holdout.)
 
@@ -123,6 +124,11 @@ ANCHORS = {
     "flash_t8192_ms": 8.16,
     "serve_ms_per_tok_int8": 0.541,
     "serve_ms_per_tok_bf16": 0.558,
+    # d=1536 scaling check (.watcher/serve_d1536.log): int8 wins x1.80
+    # once weights dominate — effective ~1.0 B/param streaming there, vs
+    # 1.85 at d=768 where per-matmul quant bookkeeping eats the gain
+    "serve_d1536_ms_per_tok_bf16": 1.553,
+    "serve_d1536_ms_per_tok_int8": 0.862,
 }
 
 
@@ -485,6 +491,9 @@ def postdiction_table():
          ANCHORS["serve_ms_per_tok_int8"], "anchor"),
         ("flash T=8192 ms", fl["ms_long_t8192"],
          ANCHORS["flash_t8192_ms"], "postdict"),
+        ("serve bf16 d=1536 ms/tok",
+         predict_serve(d=1536)["ms_per_tok_bf16"],
+         ANCHORS["serve_d1536_ms_per_tok_bf16"], "postdict"),
     ]
     return [(n, p, m, p / m if m else 0.0, k) for n, p, m, k in rows]
 
