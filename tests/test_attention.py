@@ -287,17 +287,21 @@ def test_flash_window_validation():
 
 
 def test_flash_block_sizes_from_site_config():
-    """root.common.engine.flash.block_q/block_k set the kernel's default
-    tile sizes — a flashtune winner bakes in via site config, no code
-    edit (defaults stay 128 when unset)."""
+    """Site config sets the kernel's default tile sizes — a flashtune
+    winner bakes in with no code edit.  d <= 64 resolves the *_d64
+    keys (that regime fits — and wants — bigger blocks, measured
+    2026-08-01); d > 64 resolves block_q/block_k as before."""
     from veles_tpu.config import root
 
     from veles_tpu.ops.pallas import flash as flash_mod
 
     q, k, v = _qkv(t=64, d=16)
     ref = att.attention(q, k, v, causal=True)
-    root.common.engine.flash.block_q = 32
-    root.common.engine.flash.block_k = 16
+    root.common.engine.flash.block_q_d64 = 32
+    root.common.engine.flash.block_k_d64 = 16
+    # the d>64 keys must NOT leak into the small-d resolution
+    root.common.engine.flash.block_q = 64
+    root.common.engine.flash.block_k = 64
     flash_mod._flash_fn.cache_clear()
     try:
         out = att.flash_attention(q, k, v, causal=True, interpret=True)
@@ -313,8 +317,29 @@ def test_flash_block_sizes_from_site_config():
         np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
                                    rtol=0, atol=0)
     finally:
+        del root.common.engine.flash.block_q_d64
+        del root.common.engine.flash.block_k_d64
         del root.common.engine.flash.block_q
         del root.common.engine.flash.block_k
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_small_d_defaults_cap_at_padded_t():
+    """Unset *_d64 keys: the small-d default caps at min(1024,
+    padded T), so a T=64 call resolves 128-sized blocks — the lru
+    cache key it lands on must be the same one an explicit (128, 128)
+    call hits, and the output matches the reference."""
+    from veles_tpu.ops.pallas import flash as flash_mod
+
+    q, k, v = _qkv(t=64, d=16)
+    ref = att.attention(q, k, v, causal=True)
+    flash_mod._flash_fn.cache_clear()
+    out = att.flash_attention(q, k, v, causal=True, interpret=True)
+    assert flash_mod._flash_fn.cache_info().currsize == 1
+    flash_mod.flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=128, interpret=True)
+    assert flash_mod._flash_fn.cache_info().currsize == 1  # cache HIT
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 

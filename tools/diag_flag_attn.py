@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Flash block sweep at the FLAGSHIP's own attention shape.
+
+phase_flashtune sweeps (B,H,T,d) = (4,8,T,128); the 124M flagship runs
+(16,12,1024,64).  At d=64 the VMEM slabs are half the d=128 case, so
+blocks up to the full T=1024 fit — and at T=1024 the kernel is
+bookkeeping-bound (measured 3.1 TF/s vs 33 at T=8192), so fewer,
+larger blocks are the predicted win.  Sweeps fwd and fused bwd
+head-to-head with XLA-naive on the same shape, using bench.py's
+chained in-jit timing (single dispatch + block: the honest pattern
+per tools/diag_sync2.py).
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from veles_tpu.ops.attention import attention as naive
+    from veles_tpu.ops.flops import causal_attn_flops
+    from veles_tpu.ops.pallas.flash import flash_attention
+
+    print("devices:", jax.devices(), flush=True)
+    b, h, t, d = 16, 12, 1024, 64
+    key = jax.random.key(5)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16) * 0.1
+               for kk in jax.random.split(key, 3))
+    flops = causal_attn_flops(b, h, t, d)
+
+    def report(tag, ms, ms_bwd):
+        print("%-18s fwd %7.3f ms (%5.1f TF/s)  fwd+bwd %7.3f ms"
+              % (tag, ms, flops / (ms / 1e3) / 1e12, ms_bwd), flush=True)
+
+    ms = bench._chain_attn(
+        lambda q_, k_, v_: naive(q_, k_, v_, causal=True), q, k, v, 10)
+    ms_bwd = bench._chain_attn(
+        lambda q_, k_, v_: naive(q_, k_, v_, causal=True), q, k, v, 5,
+        grad=True)
+    report("xla-naive", ms, ms_bwd)
+
+    for bq, bk in ((1024, 1024), (1024, 512), (512, 1024), (512, 512),
+                   (512, 256), (256, 512), (256, 256)):
+        fn = lambda q_, k_, v_: flash_attention(   # noqa: E731
+            q_, k_, v_, causal=True, block_q=bq, block_k=bk)
+        try:
+            ms = bench._chain_attn(fn, q, k, v, 10)
+            ms_bwd = bench._chain_attn(fn, q, k, v, 5, grad=True)
+        except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+            print("bq=%d bk=%d failed: %s" % (bq, bk, str(e)[:100]),
+                  flush=True)
+            continue
+        report("flash %dx%d" % (bq, bk), ms, ms_bwd)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -267,10 +267,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
     default as every entry point in ops.attention).
 
-    ``block_q``/``block_k`` default from
-    ``root.common.engine.flash.block_q/block_k`` (else 128) — bake a
-    ``bench.py --phase flashtune`` winner into the site config without
-    touching code.
+    ``block_q``/``block_k`` defaults: for head dim > 64, from
+    ``root.common.engine.flash.block_q/block_k`` (else 128); for head
+    dim <= 64, from ``...flash.block_q_d64/block_k_d64`` (else
+    min(1024, padded T) per operand — the measured optimum for that
+    VMEM regime).  Bake a ``bench.py --phase flashtune`` winner into
+    the site config with ``tools/bake_flashtune.py`` (``--head-dim``
+    picks the key pair), no code edit.
 
     Differentiable both ways: ``backward="fused"`` (default) runs the
     Pallas dQ and dK/dV kernels against the forward's saved log-sum-exp
@@ -299,10 +302,31 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     if block_q is None or block_k is None:
         from veles_tpu.config import root
         fcfg = root.common.engine.flash
-        if block_q is None:
-            block_q = int(fcfg.get("block_q", 128))
-        if block_k is None:
-            block_k = int(fcfg.get("block_k", 128))
+        if q.shape[-1] <= 64:
+            # d<=64 halves the k/v/q VMEM slabs vs the d=128 the
+            # flashtune grid swept, so blocks up to 1024 fit — and win:
+            # at the 124M flagship's (16,12,1024,64) shape, 1024x1024
+            # measured fwd+bwd 16.57 ms vs 17.44 at the d=128-baked
+            # (512,512) and 20.77 XLA-naive (2026-08-01,
+            # .watcher/diag_flag_attn.log).  Site keys *_d64 override
+            # (bake with tools/bake_flashtune.py --head-dim 64).
+            # Caps follow each operand's OWN padded length — in
+            # non-causal cross-attention tk != tq, and a block_k cap
+            # from tq would pad K/V up to 8x for nothing.
+            def _cap(t):
+                return max(128, min(1024, -(-t // 128) * 128))
+
+            if block_q is None:
+                block_q = int(fcfg.get("block_q_d64",
+                                       _cap(q.shape[-2])))
+            if block_k is None:
+                block_k = int(fcfg.get("block_k_d64",
+                                       _cap(k.shape[-2])))
+        else:
+            if block_q is None:
+                block_q = int(fcfg.get("block_q", 128))
+            if block_k is None:
+                block_k = int(fcfg.get("block_k", 128))
     return _flash_fn(causal, float(scale), block_q, block_k,
                      autodetect_interpret(interpret), backward,
                      window)(q, k, v)
