@@ -102,6 +102,9 @@ FLASH_BWD_EFF = 0.13
 #: measured = eff 0.085 — B*H=8 underfills the grid vs the flagship's
 #: 192); calibrated on the flash T=8192 anchor
 FLASH_LONG_EFF = 0.085
+#: XLA-naive attention's long-context fusion cliff (see predict_flash.
+#: naive_ms): calibrated on the measured T=8192 XLA anchor, 237.49 ms
+XLA_NAIVE_LONG_FACTOR = 36.0
 T_KERNEL = 4.3e-6           # calibrated: kohonen step anchor (2026-08-01 final run: 0.050 ms)
 #: per-kernel floor INSIDE a lax.scan body (decode loops): XLA fuses
 #: scan-body kernels far tighter than dispatch-level ones — fit on the
@@ -130,6 +133,7 @@ ANCHORS = {
     "beam_ms_per_pos_t4096": 0.111,
     "kohonen_ms_per_step": 0.050,
     "flash_t8192_ms": 8.18,
+    "flash_t8192_xla_ms": 237.49,
     # run-to-run serve spread this window: bf16 0.526-0.637,
     # int8 0.541-0.562 — anchored at the mid-window pair
     "serve_ms_per_tok_int8": 0.541,
@@ -309,7 +313,14 @@ def predict_flash():
     def naive_ms(b, h, t, d):
         fl = _causal_attn_flops(b, h, t, d)
         mm = fl / (PEAK_BF16 * EFF_MXU)
-        return (mm + t_hbm(b * h * t * t * 2 * 4)) * 1e3
+        hbm = t_hbm(b * h * t * t * 2 * 4)
+        if t >= 4096:
+            # fusion cliff: XLA's materialized-T^2 path measured
+            # 237.49 ms at T=8192 vs the 8.1 ms a linear bytes model
+            # gives — multiple T^2 temporaries with transposes/reduces
+            # defeat streaming.  One calibrated factor on that anchor.
+            hbm *= XLA_NAIVE_LONG_FACTOR
+        return (mm + hbm) * 1e3
 
     # fwd+bwd: dq/dk/dv + in-kernel recompute ~= 2.5x fwd FLOPs on top
     return {
@@ -518,6 +529,8 @@ def postdiction_table():
          ANCHORS["serve_ms_per_tok_int8"], "anchor"),
         ("flash T=8192 ms", fl["ms_long_t8192"],
          ANCHORS["flash_t8192_ms"], "anchor"),
+        ("flash T=8192 XLA ms", fl["ms_long_t8192_xla"],
+         ANCHORS["flash_t8192_xla_ms"], "anchor"),
         ("serve bf16 d=1536 ms/tok",
          predict_serve(d=1536)["ms_per_tok_bf16"],
          ANCHORS["serve_d1536_ms_per_tok_bf16"], "postdict"),
