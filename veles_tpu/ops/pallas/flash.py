@@ -307,9 +307,11 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
             # flashtune grid swept, so blocks up to 1024 fit — and win:
             # at the 124M flagship's (16,12,1024,64) shape, 1024x1024
             # measured fwd+bwd 16.57 ms vs 17.44 at the d=128-baked
-            # (512,512) and 20.77 XLA-naive (2026-08-01,
-            # .watcher/diag_flag_attn.log).  Site keys *_d64 override
-            # (bake with tools/bake_flashtune.py --head-dim 64).
+            # (512,512) and 20.77 XLA-naive; at (2,8,8192,64) long
+            # context it wins 1.9x (fwd 6.30 vs 11.82 ms) — validated
+            # across the regime (2026-08-01, .watcher/
+            # diag_flag_attn.log, diag_d64_long.log).  Site keys
+            # *_d64 override (tools/bake_flashtune.py --head-dim 64).
             # Caps follow each operand's OWN padded length — in
             # non-causal cross-attention tk != tq, and a block_k cap
             # from tq would pad K/V up to 8x for nothing.
