@@ -109,6 +109,10 @@ def main():
             run_step([py, "bench.py"], "bench", timeout=3600)
             run_step([py, "bench.py", "--phase", "flashtune"],
                      "flashtune", timeout=1800)
+            # flagship-shape (d=64) attention sweep: keeps the d<=64
+            # block defaults (ops/pallas/flash.py) honest per window
+            run_step([py, os.path.join("tools", "diag_flag_attn.py")],
+                     "flag_attn", timeout=1200)
             run_step([py, "bench.py", "--phase", "gemmtune"],
                      "gemmtune", timeout=1800)
             # serving-plane phases (playbook step 5): dense pool vs
