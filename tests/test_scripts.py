@@ -93,3 +93,65 @@ class TestBboxer:
                             "1", "2", "3", "4"]) == 0
         assert bboxer.main(["list", store]) == 0
         assert "cat" in capsys.readouterr().out
+
+    def test_serve_gui_roundtrip(self, tmp_path):
+        """The browser annotator (`serve`) drives the SAME store
+        functions over HTTP: page loads, images list, add/remove
+        round-trip, traversal blocked (ref veles/scripts/bboxer.py —
+        the GUI counterpart with the CLI's artifact)."""
+        import threading
+        import urllib.request
+
+        store = str(tmp_path / "ann.json")
+        imgs = tmp_path / "imgs"
+        imgs.mkdir()
+        (imgs / "a.png").write_bytes(b"\x89PNG fake")
+        (imgs / "not_an_image.txt").write_text("no")
+        srv = bboxer.serve(store, str(imgs), port=0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = "http://127.0.0.1:%d" % srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.status, r.read()
+
+        def post(path, obj):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(obj).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        try:
+            status, page = get("/")
+            assert status == 200 and b"bboxer" in page
+            assert json.loads(get("/api/images")[1]) == ["a.png"]
+            assert get("/img/a.png")[1] == b"\x89PNG fake"
+            assert post("/api/add", {"image": "a.png", "label": "cat",
+                                     "x": 1, "y": 2, "w": 30,
+                                     "h": 40}) == {"ok": True,
+                                                   "boxes": 1}
+            boxes = json.loads(
+                get("/api/annotations?image=a.png")[1])
+            assert boxes[0]["label"] == "cat" and boxes[0]["w"] == 30
+            # the GUI writes the CLI's exact artifact
+            out = io.StringIO()
+            assert bboxer.list_boxes(store, out=out) == 1
+            assert post("/api/remove",
+                        {"image": "a.png", "index": 0}) == {"ok": True}
+            assert json.loads(
+                get("/api/annotations?image=a.png")[1]) == []
+            # path traversal is refused
+            import urllib.error
+            import pytest
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/img/..%2F..%2Fann.json")
+            assert ei.value.code == 404
+            # bad add surfaces as 400, not a server crash
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/api/add", {"image": "a.png", "label": "x",
+                                  "x": 0, "y": 0, "w": 0, "h": 0})
+            assert ei.value.code == 400
+        finally:
+            srv.shutdown()

@@ -1,13 +1,17 @@
-"""Bounding-box annotation tool, headless CLI (ref veles/scripts/bboxer.py
-— the reference ships a GUI annotator; this keeps the same artifact, a
-JSON annotations file consumable by the image loaders, drivable from
-scripts/CI).
+"""Bounding-box annotation tool (ref veles/scripts/bboxer.py).
+
+The reference ships an interactive GUI annotator; this provides BOTH a
+headless CLI (drivable from scripts/CI) and a browser-canvas annotator
+(``serve``) over the SAME artifact — a JSON annotations file consumable
+by the image loaders.  The GUI is a zero-dependency local web page:
+click-drag draws a box, a prompt labels it, click an entry deletes it.
 
 Commands:
   add <store.json> <image> <label> <x> <y> <w> <h>
   list <store.json> [image]
   export <store.json> <out.json>     # loader-friendly {image: [boxes]}
   remove <store.json> <image> <index>
+  serve <store.json> <images_dir> [--host H] [--port P]
 """
 
 import argparse
@@ -70,6 +74,137 @@ def export(store, out_path):
     return sum(len(v) for v in db["annotations"].values())
 
 
+_PAGE = """<!doctype html><meta charset="utf-8">
+<title>bboxer</title>
+<style>
+ body{font:14px sans-serif;margin:1em;background:#111;color:#ddd}
+ #imgs a{margin-right:.8em;color:#8cf} #imgs a.cur{color:#fc6}
+ #wrap{position:relative;display:inline-block;margin-top:.6em}
+ canvas{position:absolute;left:0;top:0;cursor:crosshair}
+ #boxes li{cursor:pointer} #boxes li:hover{color:#f66}
+</style>
+<div id=imgs></div>
+<div id=wrap><img id=im><canvas id=cv></canvas></div>
+<ol id=boxes></ol>
+<script>
+let cur=null, boxes=[], drag=null;
+const im=document.getElementById('im'), cv=document.getElementById('cv'),
+      ctx=cv.getContext('2d');
+async function j(u,opt){return (await fetch(u,opt)).json()}
+async function imgs(){
+  const names=await j('/api/images'); const d=document.getElementById('imgs');
+  d.innerHTML=''; for(const n of names){const a=document.createElement('a');
+    a.textContent=n; a.href='#'; a.className=n===cur?'cur':'';
+    a.onclick=e=>{e.preventDefault();pick(n)}; d.appendChild(a);}
+  if(!cur&&names.length)pick(names[0]);}
+async function pick(n){cur=n; im.src='/img/'+encodeURIComponent(n);
+  im.onload=()=>{cv.width=im.width; cv.height=im.height; refresh()}; imgs();}
+function draw(){
+  ctx.clearRect(0,0,cv.width,cv.height); ctx.lineWidth=2;
+  const ol=document.getElementById('boxes'); ol.innerHTML='';
+  boxes.forEach((b,i)=>{ctx.strokeStyle='#fc6';
+    ctx.strokeRect(b.x,b.y,b.w,b.h); ctx.fillStyle='#fc6';
+    ctx.fillText(b.label,b.x+3,b.y+12);
+    const li=document.createElement('li');
+    li.textContent=b.label+' ('+b.x+','+b.y+' '+b.w+'x'+b.h+') — click to delete';
+    li.onclick=async()=>{await j('/api/remove',{method:'POST',
+      body:JSON.stringify({image:cur,index:i})}); refresh()};
+    ol.appendChild(li);});}
+async function refresh(){
+  boxes=await j('/api/annotations?image='+encodeURIComponent(cur));
+  draw();}
+cv.onmousedown=e=>{drag=[e.offsetX,e.offsetY]};
+cv.onmousemove=e=>{if(!drag)return; draw();  // local redraw, no fetch
+  ctx.strokeStyle='#6f6';
+  ctx.strokeRect(drag[0],drag[1],e.offsetX-drag[0],e.offsetY-drag[1])};
+cv.onmouseup=async e=>{if(!drag)return;
+  const x=Math.min(drag[0],e.offsetX), y=Math.min(drag[1],e.offsetY),
+        w=Math.abs(e.offsetX-drag[0]), h=Math.abs(e.offsetY-drag[1]);
+  drag=null; if(w<3||h<3)return refresh();
+  const label=prompt('label for this box?','object'); if(!label)return refresh();
+  await j('/api/add',{method:'POST',
+    body:JSON.stringify({image:cur,label:label,x:x,y:y,w:w,h:h})});
+  refresh()};
+imgs();
+</script>"""
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".gif", ".bmp", ".webp")
+
+
+def serve(store, images_dir, host="127.0.0.1", port=8088,
+          server_cls=None):
+    """Browser-canvas annotator over the CLI's exact store functions —
+    the interactive counterpart of the reference's GUI (ref
+    veles/scripts/bboxer.py) with the same JSON artifact.  Returns the
+    server (caller calls serve_forever / shutdown; __main__ runs it)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    images_dir = os.path.abspath(images_dir)
+    store_lock = threading.Lock()   # load-modify-save must not interleave
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):     # quiet server
+            pass
+
+        def _send(self, code, body, ctype="application/json"):
+            data = body if isinstance(body, bytes) else \
+                json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            from urllib.parse import parse_qs, unquote, urlparse
+            u = urlparse(self.path)
+            if u.path == "/":
+                return self._send(200, _PAGE.encode(),
+                                  "text/html; charset=utf-8")
+            if u.path == "/api/images":
+                names = sorted(
+                    n for n in os.listdir(images_dir)
+                    if n.lower().endswith(_IMAGE_EXTS))
+                return self._send(200, names)
+            if u.path == "/api/annotations":
+                img = parse_qs(u.query).get("image", [""])[0]
+                db = _load(store)
+                return self._send(200, db["annotations"].get(img, []))
+            if u.path.startswith("/img/"):
+                name = unquote(u.path[len("/img/"):])
+                full = os.path.abspath(os.path.join(images_dir, name))
+                # no traversal: the resolved path must stay inside
+                if not full.startswith(images_dir + os.sep) or \
+                        not os.path.isfile(full):
+                    return self._send(404, {"error": "no such image"})
+                with open(full, "rb") as f:
+                    return self._send(200, f.read(),
+                                      "application/octet-stream")
+            return self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/api/add":
+                    with store_lock:
+                        cnt = add(store, req["image"],
+                                  str(req["label"]),
+                                  float(req["x"]), float(req["y"]),
+                                  float(req["w"]), float(req["h"]))
+                    return self._send(200, {"ok": True, "boxes": cnt})
+                if self.path == "/api/remove":
+                    with store_lock:
+                        remove(store, req["image"], int(req["index"]))
+                    return self._send(200, {"ok": True})
+            except (KeyError, ValueError, IndexError, TypeError) as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(404, {"error": "unknown path"})
+
+    return (server_cls or ThreadingHTTPServer)((host, port), Handler)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -88,6 +223,11 @@ def main(argv=None):
     pr.add_argument("store")
     pr.add_argument("image")
     pr.add_argument("index", type=int)
+    ps = sub.add_parser("serve")
+    ps.add_argument("store")
+    ps.add_argument("images_dir")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=8088)
     a = p.parse_args(argv)
     if a.cmd == "add":
         n = add(a.store, a.image, a.label, a.x, a.y, a.w, a.h)
@@ -99,6 +239,14 @@ def main(argv=None):
         print("exported %d boxes -> %s" % (n, a.output))
     elif a.cmd == "remove":
         remove(a.store, a.image, a.index)
+    elif a.cmd == "serve":
+        srv = serve(a.store, a.images_dir, a.host, a.port)
+        print("bboxer GUI on http://%s:%d (store: %s, images: %s)"
+              % (a.host, srv.server_address[1], a.store, a.images_dir))
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            srv.shutdown()
     return 0
 
 
