@@ -87,10 +87,15 @@ struct Unit {
   int nwin = 15;
   int off_y = 0, off_x = 0;
   int groups = 32;
+  // transformer family
+  int n_heads = 0, n_kv_heads = 0, window = 0;
+  bool causal = false, use_rope = false;
+  std::string tie_to, pool_mode = "mean";
+  const NpyArray* tied_table = nullptr;   // resolved after load
   // composite scratch, reused across calls (resize is a no-op at
   // steady batch — no per-inference heap churn).  Same thread-safety
   // contract as the workflow's shared arena: one infer at a time.
-  mutable std::vector<float> scratch_[4];
+  mutable std::vector<float> scratch_[8];
 
   void Execute(const float* x, float* y, int batch) const;
 };
@@ -109,7 +114,14 @@ static bool TypeSupported(const std::string& t) {
          t == "depooling" || t == "max_pooling" ||
          t == "avg_pooling" || t == "maxabs_pooling" || t == "norm" ||
          t == "cutter" || t == "dropout" ||
-         StartsWith(t, "zerofiller") || StartsWith(t, "activation_");
+         StartsWith(t, "zerofiller") || StartsWith(t, "activation_") ||
+         // transformer family (matches models/layers.py +
+         // ops/attention.py math; lora/moe configs are rejected at
+         // load with their own messages)
+         t == "embedding" || t == "positional_encoding" ||
+         t == "transformer_block" || t == "layer_norm" ||
+         t == "tied_lm_head" || t == "seq_pool" ||
+         StartsWith(t, "timestep_dense");
 }
 
 // shared by the conv/deconv unit types and the residual composite
@@ -182,6 +194,82 @@ static void GroupNormForward(const float* x, float* y, const Shape3& s,
         }
     }
   }
+}
+
+// ------------------------------------------------- transformer helpers
+// math mirrors the jit path exactly: ops/norm.py layer_norm (eps 1e-6,
+// biased variance), ops/attention.py rope/attention (scale d^-0.5,
+// f32 softmax), jax.nn.gelu approximate=True (tanh form).
+
+static void LayerNormRows(const float* x, float* y, int t, int d,
+                          const NpyArray* gamma, const NpyArray* beta) {
+  for (int r = 0; r < t; ++r) {
+    const float* xr = x + static_cast<size_t>(r) * d;
+    float* yr = y + static_cast<size_t>(r) * d;
+    double sum = 0.0, sq = 0.0;
+    for (int i = 0; i < d; ++i) {
+      sum += xr[i];
+      sq += static_cast<double>(xr[i]) * xr[i];
+    }
+    float mean = static_cast<float>(sum / d);
+    float var = static_cast<float>(sq / d - (sum / d) * (sum / d));
+    float inv = 1.f / std::sqrt(var + 1e-6f);
+    for (int i = 0; i < d; ++i) {
+      float v = (xr[i] - mean) * inv;
+      if (gamma) v *= gamma->data[i];
+      if (beta) v += beta->data[i];
+      yr[i] = v;
+    }
+  }
+}
+
+// [t, din] @ [din, dout] + bias -> [t, dout] (npy row-major weights)
+static void DenseRows(const float* x, float* y, int t, int din, int dout,
+                      const NpyArray& w, const NpyArray* b) {
+  for (int r = 0; r < t; ++r) {
+    const float* xr = x + static_cast<size_t>(r) * din;
+    float* yr = y + static_cast<size_t>(r) * dout;
+    for (int o = 0; o < dout; ++o) yr[o] = b ? b->data[o] : 0.f;
+    for (int i = 0; i < din; ++i) {
+      float xv = xr[i];
+      const float* wrow = &w.data[static_cast<size_t>(i) * dout];
+      for (int o = 0; o < dout; ++o) yr[o] += xv * wrow[o];
+    }
+  }
+}
+
+// rope angle table [t, half] interleaved (cos, sin) — the angles
+// depend only on (position, i), so compute the transcendentals once
+// per Execute instead of per (row, head)
+static void RopeTable(std::vector<float>& tab, int t, int dh) {
+  int half = dh / 2;
+  tab.resize(static_cast<size_t>(t) * half * 2);
+  for (int i = 0; i < half; ++i) {
+    float freq = std::pow(10000.f, -static_cast<float>(i) / half);
+    for (int pos = 0; pos < t; ++pos) {
+      float ang = static_cast<float>(pos) * freq;
+      tab[(static_cast<size_t>(pos) * half + i) * 2] = std::cos(ang);
+      tab[(static_cast<size_t>(pos) * half + i) * 2 + 1] =
+          std::sin(ang);
+    }
+  }
+}
+
+// rotate one head-row in place: consecutive (even, odd) pairs
+static void RopeRow(float* v, const float* tab_row, int dh) {
+  int half = dh / 2;
+  for (int i = 0; i < half; ++i) {
+    float c = tab_row[2 * i], s = tab_row[2 * i + 1];
+    float e = v[2 * i], o = v[2 * i + 1];
+    v[2 * i] = e * c - o * s;
+    v[2 * i + 1] = e * s + o * c;
+  }
+}
+
+static inline float GeluTanh(float v) {
+  return 0.5f * v *
+         (1.f + std::tanh(0.7978845608028654f *
+                          (v + 0.044715f * v * v * v)));
 }
 
 void Unit::Execute(const float* x, float* y, int batch) const {
@@ -365,6 +453,197 @@ void Unit::Execute(const float* x, float* y, int batch) const {
     Act a = ActOf(type);
     size_t n = in.elems() * batch;
     for (size_t i = 0; i < n; ++i) y[i] = Activate(x[i], a);
+  } else if (type == "embedding") {
+    // int tokens arrive as f32 values through the C ABI: round to index
+    const NpyArray& table = extra.at("table");
+    int t = static_cast<int>(in.elems()), d = out.c;
+    int vocab = static_cast<int>(table.data.size()) / d;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * t;
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int r = 0; r < t; ++r) {
+        long tok = std::lround(xb[r]);
+        if (tok < 0 || tok >= vocab)
+          throw std::runtime_error("embedding: token out of range");
+        std::memcpy(yb + static_cast<size_t>(r) * d,
+                    &table.data[static_cast<size_t>(tok) * d],
+                    sizeof(float) * d);
+      }
+    }
+  } else if (type == "positional_encoding") {
+    int t = in.w, d = in.c;
+    auto learned = extra.find("pos");
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * in.elems();
+      for (int r = 0; r < t; ++r)
+        for (int i = 0; i < d; ++i) {
+          float pe;
+          if (learned != extra.end()) {
+            pe = learned->second.data[static_cast<size_t>(r) * d + i];
+          } else {                      // fixed sinusoid (layers.py)
+            float ang = r / std::pow(
+                10000.f, static_cast<float>(2 * (i / 2)) / d);
+            pe = (i % 2 == 0) ? std::sin(ang) : std::cos(ang);
+          }
+          yb[static_cast<size_t>(r) * d + i] =
+              xb[static_cast<size_t>(r) * d + i] + pe;
+        }
+    }
+  } else if (type == "layer_norm") {
+    auto aff = [this](const char* n) -> const NpyArray* {
+      auto it = extra.find(n);
+      return it == extra.end() ? nullptr : &it->second;
+    };
+    for (int b = 0; b < batch; ++b)
+      LayerNormRows(x + static_cast<size_t>(b) * in.elems(),
+                    y + static_cast<size_t>(b) * in.elems(),
+                    in.w, in.c, aff("gamma"), aff("beta"));
+  } else if (StartsWith(type, "timestep_dense")) {
+    for (int b = 0; b < batch; ++b) {
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      DenseRows(x + static_cast<size_t>(b) * in.elems(), yb, in.w,
+                in.c, out.c, weights, has_bias ? &bias : nullptr);
+      for (size_t i = 0; i < out.elems(); ++i)
+        yb[i] = Activate(yb[i], act);
+    }
+  } else if (type == "seq_pool") {
+    int t = in.w, d = in.c;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * d;
+      for (int i = 0; i < d; ++i) {
+        if (pool_mode == "mean") {
+          double s = 0.0;
+          for (int r = 0; r < t; ++r)
+            s += xb[static_cast<size_t>(r) * d + i];
+          yb[i] = static_cast<float>(s / t);
+        } else if (pool_mode == "max") {
+          float m = xb[i];
+          for (int r = 1; r < t; ++r)
+            m = std::max(m, xb[static_cast<size_t>(r) * d + i]);
+          yb[i] = m;
+        } else {        // layers.py SeqPool: everything else = last
+          yb[i] = xb[static_cast<size_t>(t - 1) * d + i];
+        }
+      }
+    }
+  } else if (type == "tied_lm_head") {
+    // logits = h @ tableᵀ (layers.py TiedLMHead; table resolved to the
+    // tie_to unit's embedding array at load)
+    const NpyArray& table = *tied_table;
+    int t = in.w, d = in.c, vocab = out.c;
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * out.elems();
+      for (int r = 0; r < t; ++r) {
+        const float* hr = xb + static_cast<size_t>(r) * d;
+        for (int v = 0; v < vocab; ++v) {
+          const float* tv = &table.data[static_cast<size_t>(v) * d];
+          float acc = 0.f;
+          for (int i = 0; i < d; ++i) acc += hr[i] * tv[i];
+          yb[static_cast<size_t>(r) * vocab + v] = acc;
+        }
+      }
+    }
+  } else if (type == "transformer_block") {
+    // pre-LN block (layers.py TransformerBlock.apply):
+    // LN→MHA(+rope, causal/window, GQA)→residual, LN→gelu-MLP→residual
+    int t = in.w, d = in.c;
+    int dh = d / n_heads;
+    int d_kv = dh * n_kv_heads;
+    int rep = n_heads / n_kv_heads;
+    int d_ff = static_cast<int>(extra.at("w1").data.size()) / d;
+    auto arr = [this](const char* n) -> const NpyArray& {
+      return extra.at(n);
+    };
+    std::vector<float>& h = scratch_[0];    // normed input [t, d]
+    std::vector<float>& q = scratch_[1];    // [t, d]
+    std::vector<float>& k = scratch_[2];    // [t, d_kv]
+    std::vector<float>& v = scratch_[3];    // [t, d_kv]
+    std::vector<float>& att = scratch_[4];  // merged attn out [t, d]
+    std::vector<float>& prob = scratch_[5]; // one score row [t]
+    std::vector<float>& ff = scratch_[6];   // [t, d_ff]
+    h.resize(static_cast<size_t>(t) * d);
+    q.resize(static_cast<size_t>(t) * d);
+    k.resize(static_cast<size_t>(t) * d_kv);
+    v.resize(static_cast<size_t>(t) * d_kv);
+    att.resize(static_cast<size_t>(t) * d);
+    prob.resize(t);
+    ff.resize(static_cast<size_t>(t) * d_ff);
+    float scale = 1.f / std::sqrt(static_cast<float>(dh));
+    for (int b = 0; b < batch; ++b) {
+      const float* xb = x + static_cast<size_t>(b) * in.elems();
+      float* yb = y + static_cast<size_t>(b) * in.elems();
+      LayerNormRows(xb, h.data(), t, d, &arr("ln1/gamma"),
+                    &arr("ln1/beta"));
+      DenseRows(h.data(), q.data(), t, d, d, arr("mha/wq"),
+                &arr("mha/bq"));
+      DenseRows(h.data(), k.data(), t, d, d_kv, arr("mha/wk"),
+                &arr("mha/bk"));
+      DenseRows(h.data(), v.data(), t, d, d_kv, arr("mha/wv"),
+                &arr("mha/bv"));
+      if (use_rope) {
+        std::vector<float>& rtab = scratch_[7];
+        if (rtab.empty()) RopeTable(rtab, t, dh);
+        for (int r = 0; r < t; ++r) {
+          const float* row = &rtab[static_cast<size_t>(r) * dh];
+          for (int hh = 0; hh < n_heads; ++hh)
+            RopeRow(&q[static_cast<size_t>(r) * d + hh * dh], row, dh);
+          for (int hh = 0; hh < n_kv_heads; ++hh)
+            RopeRow(&k[static_cast<size_t>(r) * d_kv + hh * dh], row,
+                    dh);
+        }
+      }
+      // per query head: scores → f32 softmax → weighted V
+      for (int hh = 0; hh < n_heads; ++hh) {
+        int kv = hh / rep;
+        for (int r = 0; r < t; ++r) {
+          const float* qr = &q[static_cast<size_t>(r) * d + hh * dh];
+          int lo = 0, hi = t;                 // attended key range
+          if (causal) hi = r + 1;
+          if (window > 0 && causal) lo = std::max(0, r - window + 1);
+          float mx = -1e30f;
+          for (int c2 = lo; c2 < hi; ++c2) {
+            const float* kr =
+                &k[static_cast<size_t>(c2) * d_kv + kv * dh];
+            float s = 0.f;
+            for (int i = 0; i < dh; ++i) s += qr[i] * kr[i];
+            s *= scale;
+            prob[c2] = s;
+            mx = std::max(mx, s);
+          }
+          double denom = 0.0;
+          for (int c2 = lo; c2 < hi; ++c2) {
+            prob[c2] = std::exp(prob[c2] - mx);
+            denom += prob[c2];
+          }
+          float* ar = &att[static_cast<size_t>(r) * d + hh * dh];
+          for (int i = 0; i < dh; ++i) ar[i] = 0.f;
+          for (int c2 = lo; c2 < hi; ++c2) {
+            float p = static_cast<float>(prob[c2] / denom);
+            const float* vr =
+                &v[static_cast<size_t>(c2) * d_kv + kv * dh];
+            for (int i = 0; i < dh; ++i) ar[i] += p * vr[i];
+          }
+        }
+      }
+      // wo projection + residual (reuse h as the o-proj output)
+      DenseRows(att.data(), h.data(), t, d, d, arr("mha/wo"),
+                &arr("mha/bo"));
+      for (size_t i = 0; i < static_cast<size_t>(t) * d; ++i)
+        h[i] += xb[i];
+      // MLP branch on the residual stream (att reused as ln2 output)
+      LayerNormRows(h.data(), att.data(), t, d, &arr("ln2/gamma"),
+                    &arr("ln2/beta"));
+      DenseRows(att.data(), ff.data(), t, d, d_ff, arr("w1"),
+                &arr("b1"));
+      for (size_t i = 0; i < static_cast<size_t>(t) * d_ff; ++i)
+        ff[i] = GeluTanh(ff[i]);
+      DenseRows(ff.data(), yb, t, d_ff, d, arr("w2"), &arr("b2"));
+      for (size_t i = 0; i < static_cast<size_t>(t) * d; ++i)
+        yb[i] += h[i];
+    }
   } else {
     throw std::runtime_error("native runtime: unsupported unit type " +
                              type);
@@ -378,7 +657,17 @@ class Workflow {
     ZipReader zip(path);
     Json manifest = Json::Parse(zip.read("contents.json"));
     name_ = manifest.at("name").str();
-    softmax_output_ = manifest.at("loss").str() == "softmax";
+    // class-kind losses serve PROBABILITIES (trainer.forward_fn
+    // applies softmax over the last axis — ops/losses.py kind="class";
+    // regression losses like mse serve raw outputs).  New packages
+    // carry the kind explicitly; the name allowlist keeps old
+    // packages loading.
+    if (manifest.has("loss_kind")) {
+      softmax_output_ = manifest.at("loss_kind").str() == "class";
+    } else {
+      const std::string& loss = manifest.at("loss").str();
+      softmax_output_ = loss == "softmax" || loss == "lm";
+    }
     for (const Json& ju : manifest.at("units").arr_v) {
       Unit u;
       u.name = ju.at("name").str();
@@ -417,6 +706,34 @@ class Workflow {
         u.off_x = cfg.at("offset").arr_v[1].integer();
       }
       if (cfg.has("groups")) u.groups = cfg.at("groups").integer();
+      // transformer family config
+      if (cfg.has("n_heads")) u.n_heads = cfg.at("n_heads").integer();
+      u.n_kv_heads = cfg.has("n_kv_heads")
+                         ? cfg.at("n_kv_heads").integer() : u.n_heads;
+      if (cfg.has("causal")) u.causal = cfg.at("causal").bool_v;
+      if (cfg.has("rope")) u.use_rope = cfg.at("rope").bool_v;
+      if (cfg.has("window") && cfg.at("window").type == Json::kNumber)
+        u.window = cfg.at("window").integer();
+      if (cfg.has("tie_to")) u.tie_to = cfg.at("tie_to").str();
+      if (cfg.has("mode")) u.pool_mode = cfg.at("mode").str();
+      if (u.type == "transformer_block") {
+        if (u.n_heads <= 0) u.n_heads = 8;     // layers.py default
+        if (u.n_kv_heads <= 0) u.n_kv_heads = u.n_heads;
+        if (u.in.c % u.n_heads || u.n_heads % u.n_kv_heads)
+          throw std::runtime_error(
+              "native runtime: bad head config for unit " + u.name);
+        if (cfg.has("n_experts") && cfg.at("n_experts").integer() > 0)
+          throw std::runtime_error(
+              "native runtime: transformer_block with MoE experts is "
+              "not supported (unit " + u.name + ") — use the StableHLO "
+              "export for this model");
+        for (const auto& kv : ju.at("arrays").obj_v)
+          if (kv.first.rfind("mha/lora", 0) == 0)
+            throw std::runtime_error(
+                "native runtime: un-merged LoRA adapters are not "
+                "supported (unit " + u.name + ") — merge adapters at "
+                "export or use the StableHLO export");
+      }
       const Json& arrays = ju.at("arrays");
       if (arrays.has("weights")) {
         u.weights = ParseNpy(zip.read(arrays.at("weights").str()));
@@ -453,6 +770,29 @@ class Workflow {
       units_.push_back(std::move(u));
     }
     if (units_.empty()) throw std::runtime_error("empty workflow");
+    // resolve tied heads to their source unit's table (addresses into
+    // extra maps stay stable once the vector stops growing)
+    for (Unit& tu : units_) {
+      if (tu.tie_to.empty()) continue;
+      for (const Unit& src : units_)
+        if (src.name == tu.tie_to) {
+          auto it = src.extra.find("table");
+          if (it == src.extra.end())
+            throw std::runtime_error(
+                "tied_lm_head: tie_to unit " + tu.tie_to +
+                " carries no table");
+          if (it->second.data.size() !=
+              static_cast<size_t>(tu.out.c) * tu.in.c)
+            throw std::runtime_error(
+                "tied_lm_head: table shape does not match head "
+                "(unit " + tu.name + ")");
+          tu.tied_table = &it->second;
+          break;
+        }
+      if (!tu.tied_table)
+        throw std::runtime_error(
+            "tied_lm_head: tie_to unit not found: " + tu.tie_to);
+    }
   }
 
   size_t input_elems() const { return units_.front().in.elems(); }
@@ -490,16 +830,22 @@ class Workflow {
     size_t no = output_elems();
     std::memcpy(output, x, sizeof(float) * no * batch);
     if (softmax_output_) {
+      // softmax over the LAST axis of the final unit ([V] classifier
+      // row = one group; [T, V] per-position LM logits = T groups)
+      size_t width = static_cast<size_t>(units_.back().out.c);
       for (int b = 0; b < batch; ++b) {
         float* ob = output + static_cast<size_t>(b) * no;
-        float mx = ob[0];
-        for (size_t j = 1; j < no; ++j) mx = std::max(mx, ob[j]);
-        float sum = 0.f;
-        for (size_t j = 0; j < no; ++j) {
-          ob[j] = std::exp(ob[j] - mx);
-          sum += ob[j];
+        for (size_t r = 0; r < no; r += width) {
+          float* row = ob + r;
+          float mx = row[0];
+          for (size_t j = 1; j < width; ++j) mx = std::max(mx, row[j]);
+          float sum = 0.f;
+          for (size_t j = 0; j < width; ++j) {
+            row[j] = std::exp(row[j] - mx);
+            sum += row[j];
+          }
+          for (size_t j = 0; j < width; ++j) row[j] /= sum;
         }
-        for (size_t j = 0; j < no; ++j) ob[j] /= sum;
       }
     }
   }

@@ -97,11 +97,16 @@ def export_workflow(workflow, path, dtype="float32"):
             "output_shape": list(layer.output_shape or ()),
             "arrays": arrays,
         })
+    from veles_tpu.ops import losses as _losses
     manifest = {
         "name": workflow.name,
         "framework": "veles_tpu",
         "version": __version__,
         "loss": trainer.loss,
+        # class-kind losses serve probabilities (forward_fn applies
+        # softmax) — the native runtime branches on the KIND so plugin
+        # losses keep the contract without a name allowlist
+        "loss_kind": _losses.get_loss(trainer.loss)[1],
         "input_shape": list(trainer.layers[0].input_shape or ()),
         "units": units,
     }
