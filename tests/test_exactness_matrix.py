@@ -52,8 +52,8 @@ FAMILIES = [
      lambda: zoo.transformer_lm(vocab_size=17, d_model=16, n_heads=2,
                                 n_layers=1, dropout=0.0, pos="rope"),
      (8,), "lm", True),
-    # genuinely unsupported config: MoE experts — keeps the loud
-    # load-error contract exercised now that every plain family runs
+    # MoE: the StableHLO leg runs (symbolic-batch capacity math,
+    # ops/moe.py) — the native C++ leg stays a loud load rejection
     ("transformer_moe_rejected",
      lambda: zoo.transformer_lm(vocab_size=17, d_model=16, n_heads=2,
                                 n_layers=1, dropout=0.0,
@@ -103,11 +103,7 @@ _IDS = [f[0] for f in FAMILIES]
                          FAMILIES, ids=_IDS)
 def test_stablehlo_leg_exact(name, factory, in_shape, loss, native_ok,
                              tmp_path, f32_precision):
-    if name.endswith("_rejected"):
-        pytest.skip("fixture exists to exercise the native runtime's "
-                    "load rejection; MoE also hits a known "
-                    "ConcretizationTypeError under jax.export tracing "
-                    "(ops/moe.py capacity math)")
+
     """Leg 1, every family: StableHLO artifact == live forward to 1e-6
     (reports independently of the C++ toolchain's presence)."""
     wf, x = _build(name, factory(), in_shape, loss)

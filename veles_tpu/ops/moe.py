@@ -92,7 +92,17 @@ def moe_forward(params, x, top_k=2, capacity_factor=2.0, axis_name=None,
     n_experts = params["router"].shape[-1]
     x2d = x.reshape(b * t, d)
     n = b * t
-    capacity = max(1, int(capacity_factor * n * top_k / n_experts))
+    try:
+        capacity = max(1, int(capacity_factor * n * top_k / n_experts))
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        # jax.export symbolic batch: ``n`` is a dimension expression —
+        # float math on it concretizes.  Keep the capacity a dim expr
+        # via integer arithmetic (capacity_factor rationalized /1000)
+        # so one artifact still serves any batch size.
+        import jax.core as jcore
+        num = int(round(capacity_factor * 1000))
+        capacity = jcore.max_dim(
+            (n * top_k * num) // (1000 * n_experts), 1)
     cast = (lambda a: a) if policy is None else policy.cast_in
 
     dispatch, combine, aux = _routing(x2d, params["router"], n_experts,
