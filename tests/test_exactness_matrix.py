@@ -142,3 +142,28 @@ def test_native_leg_exact(name, factory, in_shape, loss, native_ok,
         with pytest.raises(Exception,
                            match="not supported|unsupported"):
             NativeWorkflow(pp)
+
+
+def test_int8_transformer_package_through_native(tmp_path,
+                                                 f32_precision):
+    """int8 transformer package → native runtime: the per-channel
+    scale folding covers the block's named sub-arrays (mha/wq,
+    w1/w2, embedding table) — outputs match the f32 forward within
+    quantization error, and the argmax token survives for most
+    positions."""
+    from veles_tpu.services.native import NativeWorkflow
+
+    name, factory, in_shape, loss, _ = [
+        f for f in FAMILIES if f[0] == "transformer_lm_gqa_win"][0]
+    wf, x = _build(name, factory(), in_shape, loss)
+    want = np.asarray(wf.forward_fn()(wf.trainer.params, x))
+    pp = str(tmp_path / "tlm8.zip")
+    export_workflow(wf, pp, dtype="int8")
+    native = NativeWorkflow(pp)
+    got = native(np.ascontiguousarray(
+        x.reshape(len(x), -1))).reshape(want.shape)
+    native.close()
+    # int8 tolerance: probabilities, so absolute error is meaningful
+    np.testing.assert_allclose(got, want, atol=0.08)
+    agree = (got.argmax(-1) == want.argmax(-1)).mean()
+    assert agree > 0.9, agree
