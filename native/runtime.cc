@@ -850,6 +850,51 @@ class Workflow {
     }
   }
 
+  // Greedy decode without Python: tokens <- argmax of the last live
+  // position, re-running the full forward per step.  EXACT because
+  // every attention block is causal — the zero-padded tail beyond
+  // ``cur`` cannot influence positions <= cur — at O(T^2) compute per
+  // token (the package's shapes are baked at export, so T is the
+  // context ceiling; a KV-cached step function is future work).
+  // Returns the total token count written to ``out``
+  // (prompt + generated, capped at the exported T).
+  int Generate(const int* prompt, int n_prompt, int max_new, int* out) {
+    int t_max = static_cast<int>(input_elems());
+    if (n_prompt < 1 || n_prompt > t_max)
+      throw std::runtime_error("generate: bad prompt length");
+    if (units_.front().type != "embedding")
+      throw std::runtime_error(
+          "generate: package must start with an embedding unit");
+    for (const Unit& u : units_) {
+      if (u.type == "transformer_block" && !u.causal)
+        throw std::runtime_error(
+            "generate: non-causal block " + u.name +
+            " — later positions would leak into earlier logits");
+      if (u.type == "seq_pool")
+        throw std::runtime_error(
+            "generate: seq_pool collapses the time axis");
+    }
+    int total = std::min(t_max, n_prompt + max_new);
+    int vocab = units_.back().out.c;
+    std::vector<float> buf(t_max, 0.f);   // token 0 pads the tail
+    std::vector<float> logits(output_elems());
+    for (int i = 0; i < n_prompt; ++i) {
+      buf[i] = static_cast<float>(prompt[i]);
+      out[i] = prompt[i];
+    }
+    for (int cur = n_prompt; cur < total; ++cur) {
+      Infer(buf.data(), 1, logits.data());
+      const float* row =
+          &logits[static_cast<size_t>(cur - 1) * vocab];
+      int best = 0;
+      for (int v = 1; v < vocab; ++v)
+        if (row[v] > row[best]) best = v;
+      out[cur] = best;
+      buf[cur] = static_cast<float>(best);
+    }
+    return total;
+  }
+
  private:
   std::string name_;
   bool softmax_output_ = false;
@@ -910,6 +955,24 @@ int veles_native_infer(void* h, const float* input, int batch,
     static_cast<veles_native::Workflow*>(h)->Infer(input, batch, output);
     return 0;
   } catch (const std::exception&) {
+    return -1;
+  }
+}
+
+// greedy decode (causal LM packages): returns total tokens written
+// (prompt + generated, capped at the exported context T), or -1 with
+// the reason in ``err``
+int veles_native_generate(void* h, const int* prompt, int n_prompt,
+                          int max_new, int* out, char* err,
+                          int errlen) {
+  try {
+    return static_cast<veles_native::Workflow*>(h)->Generate(
+        prompt, n_prompt, max_new, out);
+  } catch (const std::exception& e) {
+    if (err && errlen > 0) {
+      std::strncpy(err, e.what(), errlen - 1);
+      err[errlen - 1] = '\0';
+    }
     return -1;
   }
 }

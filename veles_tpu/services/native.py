@@ -47,6 +47,10 @@ def _load():
     lib.veles_native_infer.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
         ctypes.POINTER(ctypes.c_float)]
+    lib.veles_native_generate.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+        ctypes.c_int]
     lib.veles_native_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -88,6 +92,27 @@ class NativeWorkflow(object):
         if rc:
             raise RuntimeError("native inference failed")
         return out
+
+    def generate(self, prompt, max_new):
+        """Greedy decode entirely in C++ (causal LM packages): prompt
+        int tokens → np.int32 [prompt + generated], capped at the
+        package's exported context length.  Exact vs the Python greedy
+        path — the C++ re-runs the causal forward per step (O(T²) per
+        token; the exported shapes are the context ceiling)."""
+        prompt = np.ascontiguousarray(np.asarray(prompt).ravel(),
+                                      np.int32)
+        t_max = self.input_size
+        out = np.empty(t_max, np.int32)
+        err = ctypes.create_string_buffer(512)
+        n = self._lib.veles_native_generate(
+            self._h, prompt.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int)), len(prompt),
+            int(max_new), out.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int)), err, len(err))
+        if n < 0:
+            raise RuntimeError("native generate failed: %s"
+                               % err.value.decode())
+        return out[:n].copy()
 
     def close(self):
         if self._h:
