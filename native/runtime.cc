@@ -862,20 +862,35 @@ class Workflow {
     int t_max = static_cast<int>(input_elems());
     if (n_prompt < 1 || n_prompt > t_max)
       throw std::runtime_error("generate: bad prompt length");
+    if (max_new < 0)
+      throw std::runtime_error("generate: max_new must be >= 0");
     if (units_.front().type != "embedding")
       throw std::runtime_error(
           "generate: package must start with an embedding unit");
+    // the pad-tail-is-inert invariant holds only for causal attention
+    // plus strictly PER-POSITION units — whitelist, don't blacklist
+    // (a group_norm or conv would mix the time axis and silently
+    // corrupt the decode)
     for (const Unit& u : units_) {
-      if (u.type == "transformer_block" && !u.causal)
+      bool ok = u.type == "embedding" ||
+                u.type == "positional_encoding" ||
+                u.type == "layer_norm" || u.type == "tied_lm_head" ||
+                u.type == "dropout" ||
+                StartsWith(u.type, "timestep_dense") ||
+                StartsWith(u.type, "zerofiller") ||
+                StartsWith(u.type, "activation_") ||
+                (u.type == "transformer_block" && u.causal);
+      if (!ok)
         throw std::runtime_error(
-            "generate: non-causal block " + u.name +
-            " — later positions would leak into earlier logits");
-      if (u.type == "seq_pool")
-        throw std::runtime_error(
-            "generate: seq_pool collapses the time axis");
+            "generate: unit " + u.name + " (" + u.type +
+            ") is not per-position/causal — the padded-tail decode "
+            "would be wrong");
     }
     int total = std::min(t_max, n_prompt + max_new);
     int vocab = units_.back().out.c;
+    if (output_elems() != static_cast<size_t>(t_max) * vocab)
+      throw std::runtime_error(
+          "generate: package head is not per-position [T, V] logits");
     std::vector<float> buf(t_max, 0.f);   // token 0 pads the tail
     std::vector<float> logits(output_elems());
     for (int i = 0; i < n_prompt; ++i) {
