@@ -98,6 +98,8 @@ struct Unit {
   mutable std::vector<float> scratch_[8];
 
   void Execute(const float* x, float* y, int batch) const;
+  void StepDecode(const float* x_row, float* y_row, float* ck,
+                  float* cv, int pos) const;
 };
 
 static bool StartsWith(const std::string& s, const char* pre) {
@@ -270,6 +272,86 @@ static inline float GeluTanh(float v) {
   return 0.5f * v *
          (1.f + std::tanh(0.7978845608028654f *
                           (v + 0.044715f * v * v * v)));
+}
+
+// One decode step of a causal transformer_block: x_row [d] at
+// ``pos``, external k/v cache [t_max, d_kv] rows filled for [0, pos).
+// Bit-identical to the full forward restricted to this position: every
+// helper iterates rows independently in the same order.
+void Unit::StepDecode(const float* x_row, float* y_row, float* ck,
+                      float* cv, int pos) const {
+  int d = in.c;
+  int dh = d / n_heads;
+  int d_kv = dh * n_kv_heads;
+  int rep = n_heads / n_kv_heads;
+  int d_ff = static_cast<int>(extra.at("w1").data.size()) / d;
+  auto arr = [this](const char* n) -> const NpyArray& {
+    return extra.at(n);
+  };
+  std::vector<float>& h = scratch_[0];
+  std::vector<float>& q = scratch_[1];
+  std::vector<float>& att = scratch_[4];
+  std::vector<float>& prob = scratch_[5];
+  std::vector<float>& ff = scratch_[6];
+  h.resize(d);
+  q.resize(d);
+  att.resize(d);
+  prob.resize(pos + 1);
+  ff.resize(d_ff);
+  float* krow = ck + static_cast<size_t>(pos) * d_kv;
+  float* vrow = cv + static_cast<size_t>(pos) * d_kv;
+  float scale = 1.f / std::sqrt(static_cast<float>(dh));
+
+  LayerNormRows(x_row, h.data(), 1, d, &arr("ln1/gamma"),
+                &arr("ln1/beta"));
+  DenseRows(h.data(), q.data(), 1, d, d, arr("mha/wq"), &arr("mha/bq"));
+  DenseRows(h.data(), krow, 1, d, d_kv, arr("mha/wk"), &arr("mha/bk"));
+  DenseRows(h.data(), vrow, 1, d, d_kv, arr("mha/wv"), &arr("mha/bv"));
+  if (use_rope) {
+    std::vector<float>& rtab = scratch_[7];
+    if (rtab.empty()) RopeTable(rtab, static_cast<int>(out.w), dh);
+    const float* trow = &rtab[static_cast<size_t>(pos) * dh];
+    for (int hh = 0; hh < n_heads; ++hh)
+      RopeRow(&q[static_cast<size_t>(hh) * dh], trow, dh);
+    for (int hh = 0; hh < n_kv_heads; ++hh)
+      RopeRow(krow + static_cast<size_t>(hh) * dh, trow, dh);
+  }
+  int lo = 0, hi = pos + 1;
+  if (window > 0) lo = std::max(0, pos - window + 1);
+  for (int hh = 0; hh < n_heads; ++hh) {
+    int kv = hh / rep;
+    const float* qr = &q[static_cast<size_t>(hh) * dh];
+    float mx = -1e30f;
+    for (int c2 = lo; c2 < hi; ++c2) {
+      const float* kr = ck + static_cast<size_t>(c2) * d_kv + kv * dh;
+      float s = 0.f;
+      for (int i = 0; i < dh; ++i) s += qr[i] * kr[i];
+      s *= scale;
+      prob[c2] = s;
+      mx = std::max(mx, s);
+    }
+    double denom = 0.0;
+    for (int c2 = lo; c2 < hi; ++c2) {
+      prob[c2] = std::exp(prob[c2] - mx);
+      denom += prob[c2];
+    }
+    float* ar = &att[static_cast<size_t>(hh) * dh];
+    for (int i = 0; i < dh; ++i) ar[i] = 0.f;
+    for (int c2 = lo; c2 < hi; ++c2) {
+      float p = static_cast<float>(prob[c2] / denom);
+      const float* vr = cv + static_cast<size_t>(c2) * d_kv + kv * dh;
+      for (int i = 0; i < dh; ++i) ar[i] += p * vr[i];
+    }
+  }
+  DenseRows(att.data(), h.data(), 1, d, d, arr("mha/wo"),
+            &arr("mha/bo"));
+  for (int i = 0; i < d; ++i) h[i] += x_row[i];
+  LayerNormRows(h.data(), att.data(), 1, d, &arr("ln2/gamma"),
+                &arr("ln2/beta"));
+  DenseRows(att.data(), ff.data(), 1, d, d_ff, arr("w1"), &arr("b1"));
+  for (int i = 0; i < d_ff; ++i) ff[i] = GeluTanh(ff[i]);
+  DenseRows(ff.data(), y_row, 1, d_ff, d, arr("w2"), &arr("b2"));
+  for (int i = 0; i < d; ++i) y_row[i] += h[i];
 }
 
 void Unit::Execute(const float* x, float* y, int batch) const {
@@ -891,21 +973,91 @@ class Workflow {
     if (output_elems() != static_cast<size_t>(t_max) * vocab)
       throw std::runtime_error(
           "generate: package head is not per-position [T, V] logits");
-    std::vector<float> buf(t_max, 0.f);   // token 0 pads the tail
-    std::vector<float> logits(output_elems());
-    for (int i = 0; i < n_prompt; ++i) {
-      buf[i] = static_cast<float>(prompt[i]);
-      out[i] = prompt[i];
-    }
-    for (int cur = n_prompt; cur < total; ++cur) {
-      Infer(buf.data(), 1, logits.data());
-      const float* row =
-          &logits[static_cast<size_t>(cur - 1) * vocab];
-      int best = 0;
-      for (int v = 1; v < vocab; ++v)
-        if (row[v] > row[best]) best = v;
-      out[cur] = best;
-      buf[cur] = static_cast<float>(best);
+    // O(T) per token: every unit in the whitelist is per-position, so
+    // positions stream through once with per-block k/v caches — the
+    // helpers iterate rows independently in the same order as the full
+    // forward, so the decode is bit-identical to re-running it.
+    std::vector<std::vector<float>> cks(units_.size()),
+        cvs(units_.size());
+    for (size_t i = 0; i < units_.size(); ++i)
+      if (units_[i].type == "transformer_block") {
+        const Unit& u = units_[i];
+        int d_kv = (u.in.c / u.n_heads) * u.n_kv_heads;
+        cks[i].assign(static_cast<size_t>(t_max) * d_kv, 0.f);
+        cvs[i].assign(static_cast<size_t>(t_max) * d_kv, 0.f);
+      }
+    const NpyArray& table = units_.front().extra.at("table");
+    int d0 = units_.front().out.c;
+    int vocab_in = static_cast<int>(table.data.size()) / d0;
+    std::vector<float> a, b;
+    for (int i = 0; i < n_prompt; ++i) out[i] = prompt[i];
+    for (int pos = 0; pos < total; ++pos) {
+      int tok = out[pos];
+      if (tok < 0 || tok >= vocab_in)
+        throw std::runtime_error("generate: token out of range");
+      a.assign(&table.data[static_cast<size_t>(tok) * d0],
+               &table.data[static_cast<size_t>(tok) * d0] + d0);
+      for (size_t i = 1; i < units_.size(); ++i) {
+        const Unit& u = units_[i];
+        if (u.type == "transformer_block") {
+          b.resize(u.in.c);
+          u.StepDecode(a.data(), b.data(), cks[i].data(),
+                       cvs[i].data(), pos);
+          a.swap(b);
+        } else if (u.type == "positional_encoding") {
+          int d = u.in.c;
+          auto learned = u.extra.find("pos");
+          for (int j = 0; j < d; ++j) {
+            float pe;
+            if (learned != u.extra.end()) {
+              pe = learned->second.data[
+                  static_cast<size_t>(pos) * d + j];
+            } else {
+              float ang = pos / std::pow(
+                  10000.f, static_cast<float>(2 * (j / 2)) / d);
+              pe = (j % 2 == 0) ? std::sin(ang) : std::cos(ang);
+            }
+            a[j] += pe;
+          }
+        } else if (u.type == "layer_norm") {
+          auto aff = [&u](const char* n) -> const NpyArray* {
+            auto it = u.extra.find(n);
+            return it == u.extra.end() ? nullptr : &it->second;
+          };
+          b.resize(u.in.c);
+          LayerNormRows(a.data(), b.data(), 1, u.in.c,
+                        aff("gamma"), aff("beta"));
+          a.swap(b);
+        } else if (StartsWith(u.type, "timestep_dense")) {
+          b.resize(u.out.c);
+          DenseRows(a.data(), b.data(), 1, u.in.c, u.out.c,
+                    u.weights, u.has_bias ? &u.bias : nullptr);
+          for (int j = 0; j < u.out.c; ++j)
+            b[j] = Activate(b[j], u.act);
+          a.swap(b);
+        } else if (u.type == "tied_lm_head") {
+          int d = u.in.c;
+          b.resize(vocab);
+          for (int v = 0; v < vocab; ++v) {
+            const float* tv =
+                &u.tied_table->data[static_cast<size_t>(v) * d];
+            float acc = 0.f;
+            for (int j = 0; j < d; ++j) acc += a[j] * tv[j];
+            b[v] = acc;
+          }
+          a.swap(b);
+        } else if (StartsWith(u.type, "activation_")) {
+          for (float& v : a) v = Activate(v, u.act);
+        }
+        // dropout / zerofiller: inference no-ops, row passes through
+      }
+      int next = pos + 1;
+      if (next >= n_prompt && next < total) {
+        int best = 0;      // argmax over raw logits == over softmax
+        for (int v = 1; v < vocab; ++v)
+          if (a[v] > a[best]) best = v;
+        out[next] = best;
+      }
     }
     return total;
   }

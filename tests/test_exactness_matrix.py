@@ -169,19 +169,22 @@ def test_int8_transformer_package_through_native(tmp_path,
     assert agree > 0.9, agree
 
 
-def test_native_greedy_generate_matches_python(tmp_path,
+@pytest.mark.parametrize("family", ["transformer_lm",
+                                    "transformer_lm_gqa_win"])
+def test_native_greedy_generate_matches_python(family, tmp_path,
                                                f32_precision):
     """C++ greedy decode == LMGenerator greedy, token for token (int
-    equality).  The native path re-runs the causal forward per step;
-    the Python path decodes through its KV cache — agreeing integers
-    prove both the C++ block math and the cache bookkeeping."""
+    equality).  Both sides decode through k/v caches (the C++ one
+    streams positions through per-block caches, O(T) per token) —
+    agreeing integers prove the block math, the GQA/windowed cache
+    bookkeeping, and the rope position handling on both sides."""
     import jax.numpy as jnp
 
     from veles_tpu.models.generate import LMGenerator
     from veles_tpu.services.native import NativeWorkflow
 
     name, factory, in_shape, loss, _ = [
-        f for f in FAMILIES if f[0] == "transformer_lm"][0]
+        f for f in FAMILIES if f[0] == family][0]
     wf, x = _build(name, factory(), in_shape, loss)
     # a few training steps so greedy argmax is decisive, not tie-noise
     for _ in range(30):

@@ -96,9 +96,9 @@ class NativeWorkflow(object):
     def generate(self, prompt, max_new):
         """Greedy decode entirely in C++ (causal LM packages): prompt
         int tokens → np.int32 [prompt + generated], capped at the
-        package's exported context length.  Exact vs the Python greedy
-        path — the C++ re-runs the causal forward per step (O(T²) per
-        token; the exported shapes are the context ceiling)."""
+        package's exported context length.  Token-exact vs the Python
+        greedy path — positions stream through per-block k/v caches
+        (O(T) per token), bit-identical to the full causal forward."""
         prompt = np.ascontiguousarray(np.asarray(prompt).ravel(),
                                       np.int32)
         t_max = self.input_size
