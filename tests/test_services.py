@@ -845,3 +845,30 @@ class TestSqliteLogJournalMode:
             assert vl._network_fs_type("/database/logs.db") is None
         finally:
             builtins.open = orig
+
+
+class TestBenchPanel:
+    def test_api_bench_reports_measured_vs_predicted(self, tmp_path):
+        """/api/bench joins the bench cache (fetch-synced on-chip
+        numbers) with the roofline model's predictions — the
+        dashboard's measurement-confirms-model view."""
+        from veles_tpu.config import root
+
+        cache = tmp_path / "bench.json"
+        cache.write_text(json.dumps({
+            "lm_large_mfu": 0.369, "value": 10611.7,
+            "measured_at": "2026-08-01 10:30:54"}))
+        root.common.web.bench_cache = str(cache)
+        server = WebStatusServer(port=0)
+        server.start()
+        try:
+            base = "http://127.0.0.1:%d" % server.port
+            rep = json.loads(_get(base + "/api/bench"))
+            assert rep["measured"]["lm_large_mfu"] == 0.369
+            assert rep["measured_at"] == "2026-08-01 10:30:54"
+            # predictions ride along when the model imports
+            assert "lm_large_mfu" in rep.get("predicted", {})
+            assert b'id="bench"' in _get(base + "/")
+        finally:
+            server.stop()
+            del root.common.web.bench_cache

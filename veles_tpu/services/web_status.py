@@ -52,6 +52,34 @@ async function capProf(){
   document.getElementById('prof').textContent=JSON.stringify(s);},4000);
 }
 </script>
+<h3>bench <small>(last on-chip capture vs the roofline model's
+prediction — <a href="/api/bench">json</a>)</small></h3>
+<div id="bench"></div>
+<script>
+(async function(){
+ try{
+  const b=await (await fetch('/api/bench')).json();
+  const m=b.measured||{}, p=b.predicted||{};
+  const keys=['value','gemm_bf16_gflops','lm_large_tokens_per_sec',
+   'lm_large_mfu','lm_tokens_per_sec','alexnet_samples_per_sec',
+   'flash_ms_long_t8192','serve_ms_per_tok_int8','mlp_step_fused_ms',
+   'beam_ms_per_pos_t4096'];
+  let h='<table border=0 cellpadding=3><tr><th align=left>metric'+
+   '</th><th>measured</th><th>predicted</th><th>ratio</th></tr>';
+  for(const k of keys){
+   const mv=m[k], pv=p[k];
+   if(mv==null&&pv==null)continue;
+   const r=(mv&&pv)?(mv/pv).toFixed(2):'';
+   h+='<tr><td>'+k+'</td><td align=right>'+(mv??'')+
+    '</td><td align=right>'+(pv??'')+'</td><td align=right>'+r+
+    '</td></tr>';
+  }
+  h+='</table><small>measured_at '+(b.measured_at||'never')+
+   '</small>';
+  document.getElementById('bench').innerHTML=h;
+ }catch(e){document.getElementById('bench').textContent=String(e);}
+})();
+</script>
 <h3>recent events</h3><div id="events"></div>
 <h3>log browser <small>(cross-run, needs --log-db)</small></h3>
 <div><input id="logq" placeholder="substring" size="24">
@@ -402,6 +430,37 @@ class WebStatusServer(Logger):
         return {"logs": search_logs(db, session=session, q=q,
                                     level=level, limit=limit)}
 
+    def bench_report(self):
+        """Predicted-vs-measured perf panel data: the bench's
+        last-known-good cache (fetch-synced on-chip numbers, per-key
+        dated) next to the offline roofline model's predictions — the
+        dashboard view of the measurement-confirms-model loop
+        (tools/cost_model.py; ref: the autotune DB as the reference's
+        measurement store, veles/backends.py:672-731)."""
+        from veles_tpu.config import root
+        path = root.common.web.get("bench_cache", None)
+        if not path:
+            # default: the repo-root cache next to bench.py
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                ".bench_last_good.json")
+        measured = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    measured = json.load(f)
+            except (OSError, ValueError):
+                measured = {}
+        predicted = {}
+        try:
+            from tools.cost_model import predictions_for_bench
+            predicted = predictions_for_bench()
+        except Exception:   # noqa: BLE001 — model optional at runtime
+            predicted = {}
+        return {"measured": measured, "predicted": predicted,
+                "measured_at": measured.get("measured_at"),
+                "cache_path": path}
+
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
         with self._lock:
@@ -465,6 +524,9 @@ class WebStatusServer(Logger):
                         self._send(404, b'{"error": "no capture yet"}')
                     else:
                         self._send(200, body)
+                elif self.path == "/api/bench":
+                    self._send(200, json.dumps(server.bench_report(),
+                                               default=str).encode())
                 elif self.path.startswith("/api/logruns"):
                     self._send(200, json.dumps(
                         server.log_runs(), default=str).encode())
