@@ -52,6 +52,13 @@ FAMILIES = [
      lambda: zoo.transformer_lm(vocab_size=17, d_model=16, n_heads=2,
                                 n_layers=1, dropout=0.0, pos="rope"),
      (8,), "lm", True),
+    # learned positional table: the native runtime must read the
+    # exported "pos" array instead of synthesizing the sinusoid
+    ("transformer_lm_learnedpos",
+     lambda: zoo.transformer_lm(vocab_size=17, d_model=16, n_heads=2,
+                                n_layers=1, dropout=0.0,
+                                pos="learned"),
+     (8,), "lm", True),
     # MoE: the StableHLO leg runs (symbolic-batch capacity math,
     # ops/moe.py) — the native C++ leg stays a loud load rejection
     ("transformer_moe_rejected",
