@@ -941,6 +941,20 @@ class Workflow {
   // Returns the total token count written to ``out``
   // (prompt + generated, capped at the exported T).
   int Generate(const int* prompt, int n_prompt, int max_new, int* out) {
+    return GenerateSampled(prompt, n_prompt, max_new, 0.f, 0, 0, out);
+  }
+
+  // temperature <= 0: greedy argmax (the token-exact parity path vs
+  // the Python decoder).  temperature > 0: softmax sampling at that
+  // temperature, optionally truncated to the top_k best tokens, with
+  // a xorshift64* stream seeded from ``seed`` — deliberately NOT
+  // jax's threefry, so sampled streams differ from the Python
+  // sampler by design (documented; top_k=1 collapses to greedy and
+  // is cross-checked against it in the tests).
+  int GenerateSampled(const int* prompt, int n_prompt, int max_new,
+                      float temperature, int top_k,
+                      unsigned long long seed, int* out) {
+    rng_ = seed ? seed : 0x9E3779B97F4A7C15ULL;
     int t_max = static_cast<int>(input_elems());
     if (n_prompt < 1 || n_prompt > t_max)
       throw std::runtime_error("generate: bad prompt length");
@@ -1053,11 +1067,46 @@ class Workflow {
       }
       int next = pos + 1;
       if (next >= n_prompt && next < total) {
-        int best = 0;      // argmax over raw logits == over softmax
-        for (int v = 1; v < vocab; ++v)
-          if (a[v] > a[best]) best = v;
-        out[next] = best;
+        int pick;
+        if (temperature <= 0.f || top_k == 1) {
+          pick = 0;        // argmax over raw logits == over softmax
+          for (int v = 1; v < vocab; ++v)
+            if (a[v] > a[pick]) pick = v;
+        } else {
+          // softmax(logits / temperature), optionally top-k-truncated
+          std::vector<float> p(a.begin(), a.begin() + vocab);
+          if (top_k > 0 && top_k < vocab) {
+            std::vector<float> sorted(p);
+            std::nth_element(sorted.begin(),
+                             sorted.begin() + (top_k - 1),
+                             sorted.end(), std::greater<float>());
+            float cut = sorted[top_k - 1];
+            for (float& v : p)
+              if (v < cut) v = -1e30f;
+          }
+          float mx = *std::max_element(p.begin(), p.end());
+          double denom = 0.0;
+          for (float& v : p) {
+            v = std::exp((v - mx) / temperature);
+            denom += v;
+          }
+          // xorshift64* advance (never zero-seeded)
+          rng_ ^= rng_ << 13;
+          rng_ ^= rng_ >> 7;
+          rng_ ^= rng_ << 17;
+          double u = static_cast<double>(
+              rng_ * 2685821657736338717ULL >> 11) /
+              static_cast<double>(1ULL << 53);
+          double acc = 0.0;
+          pick = vocab - 1;
+          for (int v = 0; v < vocab; ++v) {
+            acc += p[v] / denom;
+            if (u < acc) { pick = v; break; }
+          }
+        }
+        out[next] = pick;
       }
+      (void)seed;
     }
     return total;
   }
@@ -1065,6 +1114,7 @@ class Workflow {
  private:
   std::string name_;
   bool softmax_output_ = false;
+  unsigned long long rng_ = 0x9E3779B97F4A7C15ULL;
   std::vector<Unit> units_;
   std::vector<MemoryBlock> blocks_;
   std::vector<float> arena_;
@@ -1135,6 +1185,27 @@ int veles_native_generate(void* h, const int* prompt, int n_prompt,
   try {
     return static_cast<veles_native::Workflow*>(h)->Generate(
         prompt, n_prompt, max_new, out);
+  } catch (const std::exception& e) {
+    if (err && errlen > 0) {
+      std::strncpy(err, e.what(), errlen - 1);
+      err[errlen - 1] = '\0';
+    }
+    return -1;
+  }
+}
+
+// sampled decode: temperature > 0 draws from softmax(logits/T)
+// (optionally top_k-truncated) with a seeded xorshift64* stream —
+// NOT bit-matched to the Python sampler's threefry; temperature <= 0
+// or top_k == 1 is exact greedy
+int veles_native_generate_sampled(void* h, const int* prompt,
+                                  int n_prompt, int max_new,
+                                  float temperature, int top_k,
+                                  unsigned long long seed, int* out,
+                                  char* err, int errlen) {
+  try {
+    return static_cast<veles_native::Workflow*>(h)->GenerateSampled(
+        prompt, n_prompt, max_new, temperature, top_k, seed, out);
   } catch (const std::exception& e) {
     if (err && errlen > 0) {
       std::strncpy(err, e.what(), errlen - 1);

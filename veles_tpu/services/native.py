@@ -51,6 +51,10 @@ def _load():
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
         ctypes.c_int]
+    lib.veles_native_generate_sampled.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_int, ctypes.c_float, ctypes.c_int, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int]
     lib.veles_native_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -93,21 +97,28 @@ class NativeWorkflow(object):
             raise RuntimeError("native inference failed")
         return out
 
-    def generate(self, prompt, max_new):
-        """Greedy decode entirely in C++ (causal LM packages): prompt
-        int tokens → np.int32 [prompt + generated], capped at the
-        package's exported context length.  Token-exact vs the Python
-        greedy path — positions stream through per-block k/v caches
-        (O(T) per token), bit-identical to the full causal forward."""
+    def generate(self, prompt, max_new, temperature=0.0, top_k=0,
+                 seed=0):
+        """Decode entirely in C++ (causal LM packages): prompt int
+        tokens → np.int32 [prompt + generated], capped at the
+        package's exported context length.  ``temperature=0`` (or
+        ``top_k=1``) is greedy — token-exact vs the Python decoder
+        (positions stream through per-block k/v caches, O(T) per
+        token, bit-identical to the full causal forward).
+        ``temperature>0`` samples softmax(logits/T), optionally
+        top_k-truncated, from a seeded xorshift64* stream — the
+        stream is deliberately NOT the Python sampler's threefry, so
+        sampled tokens differ across the two runtimes by design."""
         prompt = np.ascontiguousarray(np.asarray(prompt).ravel(),
                                       np.int32)
         t_max = self.input_size
         out = np.empty(t_max, np.int32)
         err = ctypes.create_string_buffer(512)
-        n = self._lib.veles_native_generate(
+        n = self._lib.veles_native_generate_sampled(
             self._h, prompt.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_int)), len(prompt),
-            int(max_new), out.ctypes.data_as(
+            int(max_new), float(temperature), int(top_k), int(seed),
+            out.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_int)), err, len(err))
         if n < 0:
             raise RuntimeError("native generate failed: %s"
