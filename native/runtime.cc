@@ -1106,7 +1106,6 @@ class Workflow {
         }
         out[next] = pick;
       }
-      (void)seed;
     }
     return total;
   }
