@@ -244,3 +244,31 @@ def test_native_sampled_generate(tmp_path, f32_precision):
         assert len(draws) > 1      # different seeds explore
     finally:
         native.close()
+
+
+def test_native_generate_from_int8_package(tmp_path, f32_precision):
+    """Generation from a quantized package: the dequantized-on-load
+    weights drive the same KV-cached decode; on a trained model the
+    token stream stays overwhelmingly equal to the f32 package's."""
+    from veles_tpu.services.native import NativeWorkflow
+
+    name, factory, in_shape, loss, _ = [
+        f for f in FAMILIES if f[0] == "transformer_lm"][0]
+    wf, x = _build(name, factory(), in_shape, loss)
+    for _ in range(30):       # decisive argmax, not tie noise
+        wf.loader.run()
+        wf.trainer.run()
+    wf.trainer.flush()
+    p32 = str(tmp_path / "g32.zip")
+    p8 = str(tmp_path / "g8.zip")
+    export_workflow(wf, p32)
+    export_workflow(wf, p8, dtype="int8")
+    prompt = np.asarray(x[0, :3])
+    n32 = NativeWorkflow(p32)
+    want = n32.generate(prompt, max_new=5)
+    n32.close()
+    n8 = NativeWorkflow(p8)
+    got = n8.generate(prompt, max_new=5)
+    n8.close()
+    agree = (got == want).mean()
+    assert agree >= 0.75, (got, want)
