@@ -1079,6 +1079,12 @@ def main():
     args = parser.parse_args()
 
     if args.phase:
+        # persistent XLA cache: phases run in fresh subprocesses, so
+        # without this every phase re-pays first-compile out of tunnel
+        # uptime; with it a window's second run (and the driver's
+        # end-of-round capture) skips straight to measurement
+        from veles_tpu import compile_cache
+        compile_cache.enable()
         result = globals()["phase_" + args.phase]()
         print(_RESULT_TAG + json.dumps(result), flush=True)
         return

@@ -224,6 +224,11 @@ class Main(object):
                 "to combine with restart-on-failure)")
         import logging
         setup_logging(logging.DEBUG if args.verbose else logging.INFO)
+        # persistent XLA compilation cache: re-runs of the same workflow
+        # (and supervisor restarts after preemption) skip recompilation
+        # — the TPU-era analogue of the reference's on-disk kernel cache
+        from veles_tpu import compile_cache
+        compile_cache.enable()
         if args.backend:
             import jax
             jax.config.update(

@@ -1,0 +1,110 @@
+"""Persistent XLA compilation cache — compile once per chip window.
+
+On the tunneled single-chip setup, first-compile latency (~20-40 s per
+jitted program) is paid out of the scarcest budget this repo has: TPU
+uptime.  ``bench.py`` runs every phase in its own subprocess, so without
+a persistent cache each phase recompiles its programs from scratch even
+inside one window, and the driver's end-of-round bench recompiles
+everything a previous window already compiled.  Pointing JAX's
+persistent compilation cache at an on-disk directory makes compiled
+executables survive process boundaries: the second run of any phase in
+a window — and the driver's end-of-round capture after a watcher-fired
+one — skips straight to measurement.
+
+This is the same economics as the reference's on-disk kernel cache
+(ref ``veles/accelerated_units.py`` caches built OpenCL/CUDA program
+binaries keyed by source+options so re-runs skip compilation); here the
+unit of caching is the whole XLA executable, keyed by JAX on
+(HLO, compile options, compiler version, device kind), so a cache
+written against one backend can never be served to another.
+
+Usage::
+
+    from veles_tpu import compile_cache
+    compile_cache.enable()            # default: <repo>/.xla_cache
+    compile_cache.enable("/fast/ssd") # explicit location
+
+Environment: ``VELES_COMPILE_CACHE`` overrides the default directory
+(relative paths are absolutized at read time); ``=1/on/true/yes``
+keeps the default directory; ``=0/off/false/no`` disables enable()
+entirely — the escape hatch for read-only filesystems.
+
+Known cosmetic noise: on CPU cache *hits*, XLA's AOT loader logs
+E-level "machine type ... doesn't match" lines because the compile-time
+feature list includes XLA-internal pseudo-features (prefer-no-scatter/
+-gather) that host detection never reports.  Same-host reloads are
+safe (verified end-to-end: a cached digits-MLP run reproduces the
+fresh-compile results exactly); the TPU executable path does not use
+that loader.
+"""
+
+import os
+
+#: min seconds of compile time before an executable is persisted.  0.0
+#: persists everything: on this setup even "cheap" compiles cost a
+#: tunnel round-trip to re-do, and the cache directory is repo-local
+#: scratch, so disk is cheaper than uptime.
+_MIN_COMPILE_SECS = 0.0
+
+_enabled_dir = None
+
+
+def default_dir():
+    """Repo-local scratch: survives process restarts within a round and
+    is visible to the driver's end-of-round ``bench.py`` run."""
+    env = os.environ.get("VELES_COMPILE_CACHE", "")
+    # boolean-intent values mean on/off, never a directory literally
+    # named "1"; explicit paths are absolutized so processes launched
+    # from different cwds (driver vs bench phase children) share ONE
+    # cache — the whole point of the module
+    if env and env.lower() not in ("0", "off", "false", "no",
+                                   "1", "on", "true", "yes"):
+        return os.path.abspath(env)
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".xla_cache")
+
+
+def enable(path=None):
+    """Point JAX's persistent compilation cache at *path* (created if
+    missing).  Idempotent; returns the directory in use, or None when
+    disabled via env / unsupported by this JAX build.
+
+    Safe to call before or after backend init — JAX reads the config at
+    compile time, not import time.  Never raises: a framework must not
+    fail to start because a cache knob moved between JAX versions, so
+    unknown option names are skipped individually.
+    """
+    global _enabled_dir
+    env = os.environ.get("VELES_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "false", "no"):
+        return None
+    if path is None:
+        path = default_dir()
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (AttributeError, ValueError):
+        return None          # core option gone: caching is NOT active
+    for opt, val in (
+            ("jax_persistent_cache_min_compile_time_secs",
+             _MIN_COMPILE_SECS),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+            # also persist XLA-level autotune/kernel caches where the
+            # backend supports it (no-op elsewhere)
+            ("jax_persistent_cache_enable_xla_caches", "all"),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except (AttributeError, ValueError):
+            pass
+    _enabled_dir = path
+    return path
+
+
+def enabled_dir():
+    """Directory the cache was enabled at this process, or None."""
+    return _enabled_dir
