@@ -822,13 +822,18 @@ def phase_servecont():
     # trainer's fused sweep.  BENCH_SERVE_PAGED=<block> swaps in the
     # block-table pool (budget = exactly the workload's tokens) so the
     # window prices the paged gather/scatter overhead vs dense.
+    # BENCH_SERVE_PAGED_FUSED=0 forces the gather tick so a window can
+    # price fused (pool read inside the Pallas kernel) vs gather
+    # (dense re-materialization per tick) on real HBM
     paged = int(os.environ.get("BENCH_SERVE_PAGED", 0))
+    fused = os.environ.get("BENCH_SERVE_PAGED_FUSED", "1") != "0"
     if paged:
         from veles_tpu.models.generate import PagedContinuousBatcher
         need = slots * -(-(prompt_len + max_new) // paged) * paged
         cb = PagedContinuousBatcher(gen, slots=slots,
                                     ticks_per_dispatch=tpd,
-                                    block=paged, pool_tokens=need)
+                                    block=paged, pool_tokens=need,
+                                    fused=fused)
     else:
         cb = ContinuousBatcher(gen, slots=slots, ticks_per_dispatch=tpd)
 
@@ -858,7 +863,8 @@ def phase_servecont():
     return {"pool_tokens_per_sec": pool_tps,
             "solo_tokens_per_sec": solo_tps,
             "slots": slots, "max_new": max_new, "d_model": d,
-            "paged_block": paged}
+            "paged_block": paged,
+            "paged_fused": bool(paged) and getattr(cb, "fused", False)}
 
 
 def phase_flashtune():

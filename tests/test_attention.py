@@ -365,3 +365,84 @@ def test_mha_window_validated_for_all_impls():
         with pytest.raises(ValueError, match=">= 1"):
             att.mha_forward(params, x, 2, causal=True, impl=impl,
                             window=0)
+
+
+# --------------------------------------------------------------------------
+# Paged-KV decode kernel (ops/pallas/paged.py)
+# --------------------------------------------------------------------------
+
+def _paged_setup(b=3, hkv=2, g=4, bs=16, nbm=4, hd=64, pool_blocks=None,
+                 dtype=jnp.float32, seed=0):
+    """Random pool + per-row tables with DISTINCT blocks per row (the
+    batcher's allocation invariant) and staggered per-row lengths."""
+    if pool_blocks is None:
+        pool_blocks = b * nbm + 1
+    r = np.random.RandomState(seed)
+    q = jnp.asarray(r.randn(b, hkv * g, hd), dtype)
+    pk = jnp.asarray(r.randn(1 + pool_blocks, hkv, bs, hd), dtype)
+    pv = jnp.asarray(r.randn(1 + pool_blocks, hkv, bs, hd), dtype)
+    ids = r.permutation(pool_blocks)[:b * nbm].reshape(b, nbm) + 1
+    table = np.zeros((b, nbm), np.int32)
+    # rows own a live prefix of blocks; dead entries stay 0 (dummy)
+    pos = np.asarray([0, (nbm // 2) * bs + 3, nbm * bs - 1], np.int32)[:b]
+    for i in range(b):
+        live = pos[i] // bs + 1
+        table[i, :live] = ids[i, :live]
+    return q, pk, pv, jnp.asarray(table), jnp.asarray(pos)
+
+
+@pytest.mark.parametrize("g,dtype,tol", [
+    (1, jnp.float32, 2e-6), (4, jnp.float32, 2e-6),
+    (4, jnp.bfloat16, 2e-2)])
+def test_paged_decode_matches_reference(g, dtype, tol):
+    from veles_tpu.ops.pallas.paged import (paged_attention_decode,
+                                            paged_attention_reference)
+    q, pk, pv, table, pos = _paged_setup(g=g, dtype=dtype)
+    ref = paged_attention_reference(q, pk, pv, table, pos)
+    out = paged_attention_decode(q, pk, pv, table, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def test_paged_decode_reference_matches_dense_softmax():
+    """The reference formulation itself against a hand-built dense
+    masked softmax — pins the exact semantics (live = pos inclusive)."""
+    from veles_tpu.ops.pallas.paged import paged_attention_reference
+    q, pk, pv, table, pos = _paged_setup(b=2, g=1, bs=4, nbm=3, hd=8,
+                                         pool_blocks=7)  # noqa: kept explicit
+    b, hq, hd = q.shape
+    out = np.asarray(paged_attention_reference(q, pk, pv, table, pos))
+    for i in range(b):
+        n = int(pos[i]) + 1
+        ks, vs = [], []
+        for t in range(n):
+            blk, off = int(table[i, t // 4]), t % 4
+            ks.append(np.asarray(pk)[blk, :, off])
+            vs.append(np.asarray(pv)[blk, :, off])
+        k = np.stack(ks, 1)                       # [hkv, n, hd]
+        v = np.stack(vs, 1)
+        s = np.einsum("hd,htd->ht", np.asarray(q)[i], k) * hd ** -0.5
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("ht,htd->hd", p, v)
+        np.testing.assert_allclose(out[i], want, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_dead_blocks_cannot_leak():
+    """Garbage in the dummy block and in allocated-but-beyond-pos
+    blocks must not change the output (masking, not data layout, is
+    what keeps dead keys out)."""
+    from veles_tpu.ops.pallas.paged import paged_attention_decode
+    q, pk, pv, table, pos = _paged_setup()
+    base = np.asarray(paged_attention_decode(q, pk, pv, table, pos,
+                                             interpret=True), np.float32)
+    poison = jnp.full(pk.shape[1:], 1e4, pk.dtype)
+    pk2 = pk.at[0].set(poison)                    # dummy block
+    pv2 = pv.at[0].set(poison)
+    # also poison a block allocated to row 1 beyond its position
+    live1 = int(pos[1]) // pk.shape[2] + 1
+    table2 = table.at[1, live1].set(int(table[2, 0]))
+    out = np.asarray(paged_attention_decode(q, pk2, pv2, table2, pos,
+                                            interpret=True), np.float32)
+    np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-6)

@@ -78,8 +78,12 @@ def test_servecont_pool_speedup_band():
     (diminishing) gains in slot count."""
     s = cm.predict_servecont()
     assert 1.5 < s["pool_vs_solo"] < 1.7
-    p = cm.predict_servecont(paged=True)
+    p = cm.predict_servecont(paged=True, fused=False)
     assert 1.15 < p["pool_vs_solo"] < 1.4
+    # fused paged (pre-registered, no anchor yet): the gather tax is
+    # the whole gap, so the prediction equals the dense tick
+    f = cm.predict_servecont(paged=True, fused=True)
+    assert f["pool_vs_solo"] == s["pool_vs_solo"]
     r4 = cm.predict_servecont(slots=4)["pool_vs_solo"]
     r16 = cm.predict_servecont(slots=16)["pool_vs_solo"]
     assert 1.0 < r4 < s["pool_vs_solo"] < r16

@@ -754,6 +754,67 @@ class TestPagedKV:
         with pytest.raises(ValueError, match="not pageable"):
             PagedContinuousBatcher(genw, block=4)
 
+    def test_fused_and_gather_ticks_agree(self, f32_precision):
+        """The fused tick (pool read through the block table inside the
+        Pallas kernel — no dense gather) must produce the gather tick's
+        exact token streams; both already match the dense batcher
+        above.  Covers both flavors explicitly so a default flip can
+        never silently drop one."""
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        fused_cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                          pool_tokens=48, fused=True)
+        gather_cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                           pool_tokens=48, fused=False)
+        assert fused_cb.fused and not gather_cb.fused
+        assert self._run(fused_cb, gen, toks) == \
+            self._run(gather_cb, gen, toks)
+
+    def test_fused_rope_gqa_model(self, f32_precision):
+        """Per-row rope rotation + GQA grouping through the fused
+        path: every slot decodes at its own depth, so a broadcast
+        position bug would corrupt exactly these streams."""
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        wf, toks = _lm_workflow(max_epochs=8, pos="rope",
+                                n_kv_heads=2)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        dense = self._run(ContinuousBatcher(gen, slots=3), gen, toks)
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=48)
+        assert cb.fused
+        assert self._run(cb, gen, toks) == dense
+
+    def test_window_ge_max_len_falls_back_to_gather(self,
+                                                    f32_precision):
+        """window >= max_len keeps a LINEAR cache (pageable) but the
+        fused kernel has no window mask — the batcher must auto-select
+        the gather tick, matching the dense batcher as before."""
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        wf, toks = _lm_workflow(max_epochs=8, window=16, impl="flash")
+        gen = LMGenerator(wf.trainer, max_len=16)
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=48, fused=True)
+        assert not cb.fused                   # auto-fallback
+        dense = self._run(ContinuousBatcher(gen, slots=3), gen, toks)
+        assert self._run(cb, gen, toks) == dense
+
+    def test_quant_pool_falls_back_to_gather(self, f32_precision):
+        """int8 KV pools (QuantCache leaves) are not kernel-readable —
+        the batcher must auto-select the gather tick and still match
+        the dense int8 batcher."""
+        from veles_tpu.models.generate import (ContinuousBatcher,
+                                               PagedContinuousBatcher)
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16, cache_dtype="int8")
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=48, fused=True)
+        assert not cb.fused                   # auto-fallback
+        dense = self._run(ContinuousBatcher(gen, slots=3), gen, toks)
+        assert self._run(cb, gen, toks) == dense
+
     def test_engine_metrics_expose_free_blocks(self, f32_precision):
         from veles_tpu.services.restful import ContinuousEngine
         wf, toks = _lm_workflow(max_epochs=0)

@@ -129,6 +129,13 @@ def main():
             run_step([py, "bench.py", "--phase", "servecont"],
                      "servecont_paged", timeout=1200,
                      env=dict(os.environ, BENCH_SERVE_PAGED="16"))
+            # three-way close: fused paged (kernel reads the pool via
+            # the block table) vs the gather tick above vs dense —
+            # prices exactly what the paged Pallas kernel buys back
+            run_step([py, "bench.py", "--phase", "servecont"],
+                     "servecont_paged_gather", timeout=1200,
+                     env=dict(os.environ, BENCH_SERVE_PAGED="16",
+                              BENCH_SERVE_PAGED_FUSED="0"))
             _log("bench sequence complete — exiting so the session wakes up")
             return 0
         _log("probe %d down: %s" % (attempt, detail))

@@ -434,16 +434,27 @@ def predict_kohonen():
 #: identical solo vs pooled) and b fit at the measured 8-slot tick.
 SERVECONT_SOLO_MS = 3.05          # anchor: 1e3/328
 SERVECONT_TICK8_MS = 15.35        # anchor: 8e3/521 (dense)
-SERVECONT_TICK8_PAGED_MS = 19.05  # anchor: 8e3/420 (paged, block 16)
+SERVECONT_TICK8_PAGED_MS = 19.05  # anchor: 8e3/420 (paged GATHER tick)
 
 
-def predict_servecont(slots=8, paged=False):
+def predict_servecont(slots=8, paged=False, fused=True):
     """Pool-vs-solo throughput ratio at ``slots`` concurrent streams,
     from the measured tick decomposition above.  At the measured
     8-slot point this reproduces the anchors by construction; other
-    slot counts are the prediction."""
+    slot counts are the prediction.
+
+    ``paged + fused`` is a PRE-REGISTERED prediction (no on-chip
+    anchor yet): the fused tick deletes the gather/scatter
+    re-materialization — the entire measured paged-vs-dense tick gap
+    (19.05 - 15.35 ms at 8 slots) is that copy traffic, and the fused
+    kernel's extra cost vs the dense einsum is only the table-indexed
+    DMA pattern over the SAME bytes, so the prediction is the dense
+    tick.  The first window's three-way servecont A/B
+    (.watcher playbook: dense / paged-fused / paged-gather) confirms
+    or refutes exactly this number."""
     a = SERVECONT_SOLO_MS
-    tick8 = SERVECONT_TICK8_PAGED_MS if paged else SERVECONT_TICK8_MS
+    tick8 = (SERVECONT_TICK8_MS if (not paged or fused)
+             else SERVECONT_TICK8_PAGED_MS)
     b = (tick8 - a) / 8.0
     tick = a + slots * b
     pool_tps = slots / tick * 1e3

@@ -245,6 +245,27 @@ class LMGenerator:
         logits = self._ln_head(params, x)
         return logits[:, 0].astype(jnp.float32), new_caches
 
+    def _step_paged(self, params, pool, tables, tok, pos):
+        """One decode step against the PAGED KV pool, batched over rows
+        at PER-ROW positions: tok [B] int32, pos [B] int32 →
+        (logits [B, V], pool).  The paged continuous batcher's fused
+        path — unlike _step (scalar pos, dense caches, vmappable per
+        row), the pool is SHARED across rows, so the whole step runs
+        batched and each layer scatters/reads through the block table
+        (layers.TransformerBlock.step_paged)."""
+        x = self._embed_rows(params, tok)[:, None, :]
+        ptab = self._pos_table(params)
+        if ptab is not None:
+            x = x + jnp.take(ptab, pos.astype(jnp.int32),
+                             axis=0)[:, None, :]
+        new_pool = []
+        for layer, (pk, pv) in zip(self._blocks, pool):
+            x, pk, pv = layer.step_paged(params[layer.name], x, pk, pv,
+                                         tables, pos)
+            new_pool.append((pk, pv))
+        logits = self._ln_head(params, x)
+        return logits[:, 0].astype(jnp.float32), new_pool
+
     def _ln_head(self, params, x):
         """Final LN + LM head (shared by every decode path — the
         needs_full_params head protocol lives in exactly one place)."""
@@ -1101,22 +1122,33 @@ class ContinuousBatcher:
          self._active, self._seeds, self._inv_temp, self._caches) = st
         self._slot_req[b] = rid
 
-    def _make_core(self):
-        """The per-tick body over the 8-tuple state (dense caches in
-        slot-major layout) — shared verbatim by the dense tick and the
-        paged tick (which wraps it between a block-table gather and a
-        position write-back), so the two admission models can never
-        diverge on decode semantics."""
+    def _make_core(self, step_all=None):
+        """The per-tick body over the 8-tuple state — shared verbatim
+        by the dense tick and BOTH paged ticks (gather and fused), so
+        the admission models can never diverge on decode semantics.
+
+        ``step_all(params, cache_state, cur, pos) -> (logits,
+        cache_state)`` abstracts how a tick runs the stack: the dense
+        default vmaps gen._step per row over slot-major caches; the
+        paged FUSED path substitutes the pool-batched gen._step_paged
+        (the pool is shared across rows, so it cannot vmap).  Token
+        selection, sampling, prompt forcing, and the freeze logic stay
+        this one function either way."""
         gen = self.gen
 
-        def row_step(params, caches, tok, pos):
-            # single-row view: add the batch dim the stack expects;
-            # under vmap the per-row ``pos`` scatter-writes each
-            # slot at its own depth
-            c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
-            logits, c1 = gen._step(params, c1, tok[None], pos)
-            return logits[0], jax.tree_util.tree_map(
-                lambda a: a[0], c1)
+        if step_all is None:
+            def row_step(params, caches, tok, pos):
+                # single-row view: add the batch dim the stack expects;
+                # under vmap the per-row ``pos`` scatter-writes each
+                # slot at its own depth
+                c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
+                logits, c1 = gen._step(params, c1, tok[None], pos)
+                return logits[0], jax.tree_util.tree_map(
+                    lambda a: a[0], c1)
+
+            def step_all(params, caches, cur, pos):
+                return jax.vmap(row_step, in_axes=(None, 0, 0, 0))(
+                    params, caches, cur, pos)
 
         def core(params, st):
             (tokens, pos, plen, total, active, seeds, inv_temp,
@@ -1124,9 +1156,7 @@ class ContinuousBatcher:
             B = tokens.shape[0]
             rows = jnp.arange(B)
             cur = tokens[rows, pos]
-            logits, caches = jax.vmap(
-                row_step, in_axes=(None, 0, 0, 0))(
-                    params, caches, cur, pos)
+            logits, caches = step_all(params, caches, cur, pos)
             greedy_tok = jnp.argmax(logits, axis=-1).astype(
                 jnp.int32)
 
@@ -1198,21 +1228,32 @@ class PagedContinuousBatcher(ContinuousBatcher):
     pool exhaustion exactly like on slot exhaustion (a queued request
     waits until both a slot and enough blocks free up).
 
-    The tick wraps the SAME decode core as the dense batcher: gather
-    each row's blocks into a dense [B, H, T, *] view, run the core,
-    scatter each row's newly written position back into its block.
-    The gather re-materializes the view every tick (~2x cache traffic
-    vs dense — the classic paged-attention overhead; fusing it into
-    the attention kernel is the Pallas follow-up), buying the memory
-    cap + backpressure.  Outputs are EXACTLY the dense batcher's:
-    same core, same per-row positions, same seeds.
+    Two tick flavors share the dense batcher's decode core
+    (sampling/forcing/freeze logic — _make_core):
+
+    * ``fused=True`` (default): attention reads the pool THROUGH the
+      block table inside a scalar-prefetch Pallas kernel
+      (ops.pallas.paged), and each layer scatters its new k/v straight
+      into its pool block — no dense re-materialization at all, and
+      reads stop at each row's own length instead of max_len.
+      QuantCache pools auto-fall back to the gather tick (the kernel
+      reads plain-dtype pools only).
+    * gather (``fused=False``): gather each row's blocks into a dense
+      [B, H, T, *] view, run the dense core verbatim, scatter the
+      newly written position back (~2x cache traffic — the classic
+      paged-attention overhead the fused path erases).  Outputs are
+      EXACTLY the dense batcher's: same core, same per-row positions,
+      same seeds.  The fused path differs from dense only at the
+      last-ulp level (online softmax + pool-dtype MXU inputs, same as
+      flash vs naive).
 
         cb = PagedContinuousBatcher(gen, slots=8, block=16,
                                     pool_tokens=512)
     """
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
-                 chunked_prefill=True, block=16, pool_tokens=None):
+                 chunked_prefill=True, block=16, pool_tokens=None,
+                 fused=True):
         super(PagedContinuousBatcher, self).__init__(
             gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
             chunked_prefill=chunked_prefill)
@@ -1250,6 +1291,19 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._tables = jnp.zeros((slots, self.max_blocks), jnp.int32)
         self._free = list(range(1, 1 + self.pool_blocks))
         self._slot_blocks = {}               # slot -> [block ids]
+        #: fused tick: attention reads the pool through the block table
+        #: (ops.pallas.paged scalar-prefetch kernel) — no per-tick
+        #: dense gather/scatter.  Auto-fallback to the gather tick for
+        #: QuantCache pools (the kernel reads plain-dtype pools only)
+        #: and for window >= max_len models (linear cache, so they
+        #: pass the pageability check, but the kernel has no window
+        #: mask — the gather tick served them before and still does).
+        quant_pool = any(
+            isinstance(c, attention.QuantCache)
+            for layer in cache_shapes for c in layer)
+        windowed = any(getattr(l, "cfg", {}).get("window")
+                       for l in gen._blocks)
+        self.fused = bool(fused) and not quant_pool and not windowed
 
     def _init_slot_caches(self):
         return None                          # the pool replaces them
@@ -1371,6 +1425,34 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     # ------------------------------------------------------------- tick
     def _tick(self, st):
+        if self._tick_fn is None and self.fused:
+            gen = self.gen
+
+            def paged_step_all(params, cache_state, cur, pos):
+                pool, tables = cache_state
+                logits, pool = gen._step_paged(params, pool, tables,
+                                               cur, pos)
+                return logits, (pool, tables)
+
+            core = self._make_core(step_all=paged_step_all)
+
+            def fused_tick(params, st):
+                (tokens, pos, plen, total, active, seeds, inv_temp,
+                 pool, tables) = st
+                (tokens, pos, plen, total, active, seeds, inv_temp,
+                 (pool, tables)) = core(
+                     params, (tokens, pos, plen, total, active, seeds,
+                              inv_temp, (pool, tables)))
+                return (tokens, pos, plen, total, active, seeds,
+                        inv_temp, pool, tables)
+
+            def fused(params, st):
+                def body(carry, _):
+                    return fused_tick(params, carry), None
+                return jax.lax.scan(body, st, None,
+                                    length=self.ticks_per_dispatch)[0]
+
+            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
         if self._tick_fn is None:
             core = self._make_core()
             bs, nbm = self.block, self.max_blocks

@@ -819,6 +819,26 @@ class TransformerBlock(Layer):
                 use_rope=bool(self.cfg.get("rope", False)),
                 window=self.cfg.get("window")))
 
+    def step_paged(self, params, x, pool_k, pool_v, table, pos):
+        """Incremental-decoding step against a PAGED KV pool: x
+        [B, 1, F], every row at its own position ``pos[b]`` (the
+        continuous batcher's fused path — attention.mha_step_paged
+        reads the shared block pool through the table instead of a
+        gathered dense view).  Same block body as step() via
+        _cached_attn_block, so the two can never diverge."""
+        from veles_tpu.ops import attention
+        if self.cfg.get("window"):
+            raise ValueError("step_paged does not support sliding-"
+                             "window attention (rolling caches are "
+                             "not pageable)")
+        return self._cached_attn_block(
+            params, x,
+            lambda h: attention.mha_step_paged(
+                params["mha"], h, pool_k, pool_v, table, pos,
+                self.n_heads, n_kv_heads=self.n_kv_heads,
+                policy=self.policy,
+                use_rope=bool(self.cfg.get("rope", False))))
+
     def prefill(self, params, x, cache_k, cache_v):
         """Chunked prefill: the whole prompt chunk x [B, Tp, F] in one
         parallel pass, k/v written into cache positions [0, Tp) —

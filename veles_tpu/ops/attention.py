@@ -574,6 +574,74 @@ def mha_step(params, x, cache_k, cache_v, pos, n_heads, n_kv_heads=None,
             cache_k, cache_v)
 
 
+def _rope_rows(x, pos):
+    """rope() at PER-ROW positions: x [B, H, 1, hd], pos [B] int32 —
+    the continuous batcher decodes every slot at its own depth, so the
+    rotation angle differs per batch row (rope() itself broadcasts one
+    [T] position vector over the batch)."""
+    return jax.vmap(lambda xb, pb: rope(xb[None], pb[None])[0])(
+        x, pos.astype(jnp.int32))
+
+
+def mha_step_paged(params, x, pool_k, pool_v, table, pos, n_heads,
+                   n_kv_heads=None, scale=None, policy=None,
+                   use_rope=False):
+    """One incremental-decoding step against a PAGED KV pool.
+
+    The paged continuous batcher's fused path: instead of gathering
+    each row's pool blocks into a dense [B, Hkv, T, hd] cache view and
+    calling mha_step, the new k/v scatter straight into their pool
+    block and the attention reads the pool through the block table
+    (ops.pallas.paged — scalar-prefetch kernel, no dense
+    re-materialization).
+
+    x: [B, 1, d_model] — every row decodes its OWN position ``pos[b]``
+    (a [B] vector, unlike mha_step's scalar: slots run at different
+    depths).  pool_k/pool_v: [1+P, Hkv, block, hd], block 0 reserved;
+    table: [B, nbm] int32 pool-block ids; row b's key at absolute
+    position t lives in pool block table[b, t // block], offset
+    t % block.
+
+    QuantCache pools and sliding windows are not supported here — the
+    batcher's gather path remains the fallback (and rolling windows are
+    already rejected at pool construction).
+    Returns (y [B, 1, d_model], pool_k, pool_v) with ``pos`` written.
+    """
+    from veles_tpu.ops.pallas.paged import paged_attention_decode
+    if n_kv_heads is None:
+        n_kv_heads = n_heads
+    if isinstance(pool_k, QuantCache) or isinstance(pool_v, QuantCache):
+        raise ValueError("mha_step_paged does not support QuantCache "
+                         "pools — use the gather tick (fused=False)")
+    pos = pos.astype(jnp.int32)
+    q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
+    k1 = k1.astype(pool_k.dtype)
+    v1 = v1.astype(pool_v.dtype)
+    if use_rope:
+        q = _rope_rows(q, pos)
+        k1 = _rope_rows(k1, pos).astype(pool_k.dtype)
+
+    bs = pool_k.shape[2]
+    rows = jnp.arange(x.shape[0])
+    blk = table[rows, pos // bs]
+    off = pos % bs
+    # each row owns its blocks exclusively (allocation is a host-side
+    # free-list pop), so the [B]-indexed scatter has no duplicate hazard
+    pool_k = pool_k.at[blk, :, off].set(k1[:, :, 0])
+    pool_v = pool_v.at[blk, :, off].set(v1[:, :, 0])
+
+    b, h, _, hd = q.shape
+    # the kernel runs the MXU in the pool dtype (bf16 serving); the
+    # dense einsum path mixes f32 q with the cache dtype instead —
+    # numerics differ at the last-ulp level, same as flash vs naive
+    o = paged_attention_decode(q[:, :, 0].astype(pool_k.dtype),
+                               pool_k, pool_v, table, pos,
+                               scale=_scale(hd, scale))
+    o = o.reshape(b, 1, h * hd).astype(x.dtype)
+    return (_proj(o, params["wo"], params["bo"], policy),
+            pool_k, pool_v)
+
+
 def rope(x, positions, base=10000.0):
     """Rotary position embedding (RoFormer).  x: [B, H, T, D] with D
     even; ``positions`` [T] int — rotates consecutive (even, odd) feature

@@ -1,0 +1,178 @@
+"""Paged-KV decode attention as a Pallas TPU kernel.
+
+The paged continuous batcher (models/generate.py PagedContinuousBatcher)
+keeps every slot's KV cache in a SHARED block pool addressed through
+per-slot block tables.  Its first implementation gathered each row's
+blocks into a dense [B, H, T, hd] view every tick, ran the dense decode
+core, and scattered one position back — ~2x cache traffic vs dense
+slots, measured as a ~20% serving-throughput tax on silicon
+(docs/perf.md).  This kernel erases the gather: the block table rides
+the grid as a SCALAR-PREFETCH argument, so each (batch, kv-head,
+block) grid step DMAs its K/V tile straight from the pool block the
+table names — the classic paged-attention move (Kwon et al. 2023)
+recast for the TPU: instead of pointer-chasing inside the kernel,
+Pallas's prefetched index_map picks the pool block per grid step and
+Mosaic pipelines the HBM→VMEM copies.
+
+Reads are exactly the live blocks (dead table entries all point at the
+reserved dummy block 0, so their copies collapse to one reusable tile
+and their scores are masked), and only up to each row's own length —
+dense decode by contrast streams every slot's full max_len.
+
+Layout contract (matches PagedContinuousBatcher):
+  q      [B, Hq, hd]        query at the position being decoded (rope
+                            already applied), Hq = G * Hkv
+  pool_k [1+P, Hkv, bs, hd] block 0 reserved as the dummy target
+  pool_v [1+P, Hkv, bs, hd]
+  table  [B, nbm] int32     per-row pool-block ids (0 = unallocated)
+  pos    [B] int32          per-row position just written; keys
+                            0..pos[b] inclusive are live
+  -> out [B, Hq, hd]
+
+Ground truth: ``paged_attention_reference`` (the gather formulation) —
+the tests pin kernel == reference; off-TPU the kernel runs in interpret
+mode like every kernel in this package.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles_tpu.ops.pallas import autodetect_interpret
+
+NEG_INF = -1e30
+_LANES = 128
+
+#: min sublane tile for the q block: bf16 wants 16 rows, f32 8 — 16
+#: covers both, and the padded rows cost nothing measurable at decode
+#: (the kernel is HBM-bound on the K/V stream, not the tiny q tile)
+_MIN_G = 16
+
+
+def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc, m, l, *, scale, bs, nbm):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    # a block whose first key is already past the row's position is
+    # fully dead: skip the whole update (its table entry is 0, so the
+    # DMA re-reads the one dummy tile — bandwidth-free after block 0)
+    @pl.when(i * bs <= pos_ref[b])
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kpos = i * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kpos <= pos_ref[b]
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(m_prev <= NEG_INF / 2, 0.0, corr)
+        l[:] = l[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l.shape)
+        m[:] = jnp.broadcast_to(m_new, m.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nbm - 1)
+    def _():
+        o_ref[0, 0] = (acc[:] / jnp.maximum(l[:, :1], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
+                           interpret=None):
+    """One decode step of attention over a paged KV pool (see module
+    docstring for the layout contract).  Returns [B, Hq, hd]."""
+    b, hq, hd = q.shape
+    npool, hkv, bs, _ = pool_k.shape
+    nbm = table.shape[1]
+    if hq % hkv:
+        raise ValueError("Hq %d %% Hkv %d != 0" % (hq, hkv))
+    g = hq // hkv
+    gp = max(g, _MIN_G)
+    scale = (hd ** -0.5) if scale is None else scale
+
+    # [B, Hq, hd] -> [B, Hkv, Gp, hd]: group queries under their kv
+    # head; pad the group dim up to the sublane tile (padded rows carry
+    # zeros — their softmax is uniform over live keys, finite, and the
+    # rows are sliced off below)
+    qg = q.reshape(b, hkv, g, hd)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    kernel = functools.partial(_decode_kernel, scale=scale, bs=bs,
+                               nbm=nbm)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, nbm),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, hd),
+                             lambda bi, h, i, tbl, ps: (bi, h, 0, 0)),
+                pl.BlockSpec((1, 1, bs, hd),
+                             lambda bi, h, i, tbl, ps: (tbl[bi, i], h,
+                                                        0, 0)),
+                pl.BlockSpec((1, 1, bs, hd),
+                             lambda bi, h, i, tbl, ps: (tbl[bi, i], h,
+                                                        0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, hd), lambda bi, h, i, tbl, ps: (bi, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, hd), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), q.dtype),
+        interpret=autodetect_interpret(interpret),
+    )(table.astype(jnp.int32), pos.astype(jnp.int32), qg, pool_k,
+      pool_v)
+    return out[:, :, :g].reshape(b, hq, hd)
+
+
+def paged_attention_reference(q, pool_k, pool_v, table, pos,
+                              scale=None):
+    """Gather-formulation ground truth (identical math to the dense
+    decode einsum in ops.attention.mha_step): materialize each row's
+    blocks densely, run a masked softmax.  Used by the tests and as
+    the documentation of the kernel's exact semantics."""
+    b, hq, hd = q.shape
+    _, hkv, bs, _ = pool_k.shape
+    nbm = table.shape[1]
+    g = hq // hkv
+    scale = (hd ** -0.5) if scale is None else scale
+
+    def dense(pool):
+        v = pool[table]                       # [B, nbm, Hkv, bs, hd]
+        v = jnp.moveaxis(v, 2, 1)             # [B, Hkv, nbm, bs, hd]
+        return v.reshape(b, hkv, nbm * bs, hd)
+
+    k = dense(pool_k)
+    v = dense(pool_v)
+    qg = q.reshape(b, hkv, g, hd)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    live = (jnp.arange(nbm * bs)[None, None, None, :]
+            <= pos[:, None, None, None])
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o.reshape(b, hq, hd).astype(q.dtype)
