@@ -829,6 +829,133 @@ class TestPagedKV:
             eng.stop()
 
 
+class TestPrefixCache:
+    """Copy-on-write prefix sharing in the paged pool: concurrent
+    requests with a common prompt prefix share its KV blocks.  The
+    bar: token streams stay EXACTLY the no-sharing batcher's, block
+    accounting reflects the sharing, and every block returns to the
+    free list when the last owner releases."""
+
+    def _mk(self, **kw):
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=48, prefix_cache=True,
+                                    **kw)
+        return cb, gen, toks
+
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_shared_prefix_tokens_and_accounting(self, f32_precision,
+                                                 fused):
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        cb, gen, toks = self._mk(fused=fused)
+        base = PagedContinuousBatcher(gen, slots=3, block=4,
+                                      pool_tokens=48, fused=fused)
+        # 9-token prompt, block 4: blocks 0-1 end before position
+        # plen-1=8 (the first decode write) -> 2 shareable blocks
+        prompt = toks[0, :9].tolist()
+        free0 = cb.free_blocks()
+        r1 = cb.submit(prompt, 4)             # 13 tokens -> 4 blocks
+        r2 = cb.submit(prompt, 4)
+        cb.tick()                             # both admitted
+        # 4 + 4 blocks without sharing; 2 shared -> 6 allocated
+        assert free0 - cb.free_blocks() == 6
+        cb.run_all()
+        b1 = base.submit(prompt, 4); b2 = base.submit(prompt, 4)
+        base.run_all()
+        assert cb.pop_result(r1) == base.pop_result(b1)
+        assert cb.pop_result(r2) == base.pop_result(b2)
+        assert cb.free_blocks() == free0      # all returned
+
+    def test_divergent_second_block_shares_first_only(self,
+                                                      f32_precision):
+        cb, gen, toks = self._mk()
+        p1 = toks[0, :9].tolist()
+        p2 = list(p1[:4]) + toks[1, 4:9].tolist()
+        assert p1[:4] == p2[:4] and p1[4:8] != p2[4:8]
+        free0 = cb.free_blocks()
+        r1 = cb.submit(p1, 4)
+        r2 = cb.submit(p2, 4)
+        cb.tick()
+        # 4 + 4 blocks; of the 2 shareable only block 0 matches (the
+        # prompts diverge inside block 1) -> 7 allocated
+        assert free0 - cb.free_blocks() == 7
+        cb.run_all()
+        # each stream matches its own solo decode
+        assert cb.pop_result(r1) == gen.generate(
+            np.asarray([p1], np.int32), 4)[0].tolist()
+        assert cb.pop_result(r2) == gen.generate(
+            np.asarray([p2], np.int32), 4)[0].tolist()
+        assert cb.free_blocks() == free0
+
+    def test_release_order_keeps_shared_blocks_alive(self,
+                                                     f32_precision):
+        """First sharer finishes while the second still decodes — the
+        shared blocks must survive until the LAST owner releases."""
+        cb, gen, toks = self._mk()
+        prompt = toks[0, :8].tolist()
+        free0 = cb.free_blocks()
+        r1 = cb.submit(prompt, 2)             # finishes first
+        r2 = cb.submit(prompt, 6)
+        cb.run_all()
+        assert cb.pop_result(r2) == gen.generate(
+            np.asarray([prompt], np.int32), 6)[0].tolist()
+        assert cb.pop_result(r1) == gen.generate(
+            np.asarray([prompt], np.int32), 2)[0].tolist()
+        assert cb.free_blocks() == free0
+        assert not cb._prefix_reg and not cb._prefix_ref
+
+    def test_shorter_sharer_never_writes_a_shared_block(self,
+                                                        f32_precision):
+        """Sharers with DIFFERENT prompt lengths: a 12-token owner
+        registers blocks 0-1, but an 8-token sharer's first decode
+        write lands at position 7 — inside block 1 — so it may match
+        block 0 ONLY.  (The regression: matching by coverage alone
+        would let it write into the shared block.)"""
+        cb, gen, toks = self._mk()
+        pa = toks[0, :12].tolist()
+        pb = pa[:8]
+        free0 = cb.free_blocks()
+        ra = cb.submit(pa, 3)                 # 15 tokens -> 4 blocks
+        rb = cb.submit(pb, 4)                 # 12 tokens -> 3 blocks
+        cb.tick()
+        # 4 + 3 minus exactly ONE shared (block 0) -> 6 allocated
+        assert free0 - cb.free_blocks() == 6
+        cb.run_all()
+        assert cb.pop_result(ra) == gen.generate(
+            np.asarray([pa], np.int32), 3)[0].tolist()
+        assert cb.pop_result(rb) == gen.generate(
+            np.asarray([pb], np.int32), 4)[0].tolist()
+        assert cb.free_blocks() == free0
+
+    def test_sharing_lets_requests_fit_a_tight_pool(self,
+                                                    f32_precision):
+        """Two same-prefix requests that canNOT fit independently admit
+        CONCURRENTLY once sharing is on — the memory win, observable
+        through admission."""
+        from veles_tpu.models.generate import PagedContinuousBatcher
+        wf, toks = _lm_workflow(max_epochs=8)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        prompt = toks[0, :9].tolist()         # 4 blocks per request
+        tight = PagedContinuousBatcher(gen, slots=2, block=4,
+                                       pool_tokens=24)  # 6 blocks
+        tight.submit(prompt, 4); tight.submit(prompt, 4)
+        tight.tick()
+        assert sum(r is not None for r in tight._slot_req) == 1
+        shared = PagedContinuousBatcher(gen, slots=2, block=4,
+                                        pool_tokens=24,
+                                        prefix_cache=True)
+        r1 = shared.submit(prompt, 4); r2 = shared.submit(prompt, 4)
+        shared.tick()
+        assert sum(r is not None for r in shared._slot_req) == 2
+        shared.run_all(); tight.run_all()
+        want = gen.generate(np.asarray([prompt], np.int32),
+                            4)[0].tolist()
+        assert shared.pop_result(r1) == want
+        assert shared.pop_result(r2) == want
+
+
 def test_paged_rejects_request_larger_than_pool(f32_precision):
     """A request needing more blocks than the whole pool must fail at
     submit — accepted-but-never-admittable would deadlock run_all()

@@ -115,10 +115,13 @@ def main():
                      "flag_attn", timeout=1200)
             run_step([py, "bench.py", "--phase", "gemmtune"],
                      "gemmtune", timeout=1800)
-            # serving-plane phases (playbook step 5): dense pool vs
-            # solo, then the paged pool — captured automatically so a
-            # short window the operator misses still prices the
-            # block-table gather/scatter on real HBM
+            # serving-plane phases (playbook step 5), three ways:
+            # dense pool vs solo, paged GATHER tick (pinned to the
+            # historical 'servecont_paged' name + the 420 tok/s anchor
+            # series via BENCH_SERVE_PAGED_FUSED=0), and the new paged
+            # FUSED tick (Pallas kernel reads the pool through the
+            # block table) — the fused-vs-gather delta prices exactly
+            # what the kernel buys back on real HBM.
             # the dense baseline must explicitly DROP any inherited
             # BENCH_SERVE_PAGED, or a leftover export would turn the
             # dense-vs-paged A/B into paged-vs-paged
@@ -128,14 +131,11 @@ def main():
                           if k != "BENCH_SERVE_PAGED"})
             run_step([py, "bench.py", "--phase", "servecont"],
                      "servecont_paged", timeout=1200,
-                     env=dict(os.environ, BENCH_SERVE_PAGED="16"))
-            # three-way close: fused paged (kernel reads the pool via
-            # the block table) vs the gather tick above vs dense —
-            # prices exactly what the paged Pallas kernel buys back
-            run_step([py, "bench.py", "--phase", "servecont"],
-                     "servecont_paged_gather", timeout=1200,
                      env=dict(os.environ, BENCH_SERVE_PAGED="16",
                               BENCH_SERVE_PAGED_FUSED="0"))
+            run_step([py, "bench.py", "--phase", "servecont"],
+                     "servecont_paged_fused", timeout=1200,
+                     env=dict(os.environ, BENCH_SERVE_PAGED="16"))
             _log("bench sequence complete — exiting so the session wakes up")
             return 0
         _log("probe %d down: %s" % (attempt, detail))

@@ -1065,7 +1065,12 @@ class Main(object):
                          paged_block=int(
                              root.common.serve.get("paged_block", 0)),
                          pool_tokens=root.common.serve.get(
-                             "pool_tokens", None))
+                             "pool_tokens", None),
+                         # prefix_cache: concurrent requests sharing a
+                         # prompt prefix share its KV blocks (the
+                         # system-prompt case pays for it once)
+                         prefix_cache=bool(root.common.serve.get(
+                             "prefix_cache", False)))
         api.start()
         if getattr(self, "_web", None) is not None:
             # the dashboard's serving panel shows the slot pool's SLO

@@ -1196,19 +1196,25 @@ class ContinuousBatcher:
 
         return core
 
+    def _jit_ticks(self, tick_fn):
+        """ticks_per_dispatch engine ticks fused into ONE jitted
+        dispatch (lax.scan over ``tick_fn(params, state) -> state``),
+        state donated: without aliasing, every per-token tick would
+        copy the whole slots×layers KV-cache pool.  One helper shared
+        by the dense tick and both paged flavors so the dispatch-fusion
+        contract can never diverge between them."""
+        def fused(params, st):
+            def body(carry, _):
+                return tick_fn(params, carry), None
+            return jax.lax.scan(body, st, None,
+                                length=self.ticks_per_dispatch)[0]
+
+        return jax.jit(fused, donate_argnums=(1,))
+
     def _tick(self, st):
         if self._tick_fn is None:
             core = self._make_core()
-
-            def fused(params, st):
-                def body(carry, _):
-                    return core(params, carry), None
-                return jax.lax.scan(body, st, None,
-                                    length=self.ticks_per_dispatch)[0]
-
-            # donate the state: without aliasing, every per-token tick
-            # would copy the whole slots×layers KV-cache pool
-            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
+            self._tick_fn = self._jit_ticks(core)
         return self._tick_fn(self.gen.params, st)
 
 
@@ -1253,7 +1259,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
                  chunked_prefill=True, block=16, pool_tokens=None,
-                 fused=True):
+                 fused=True, prefix_cache=False):
         super(PagedContinuousBatcher, self).__init__(
             gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
             chunked_prefill=chunked_prefill)
@@ -1291,6 +1297,23 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._tables = jnp.zeros((slots, self.max_blocks), jnp.int32)
         self._free = list(range(1, 1 + self.pool_blocks))
         self._slot_blocks = {}               # slot -> [block ids]
+        #: prefix caching (copy-on-write block sharing): concurrent
+        #: requests whose prompts share a prefix share the pool blocks
+        #: that hold it — the system-prompt serving case pays for the
+        #: prefix ONCE in KV memory.  Sharing is CORRECT because a
+        #: block's K/V is a deterministic function of (params, token
+        #: prefix, absolute positions): only blocks fully covered by
+        #: the prompt AND fully written at admission (chunked prefill
+        #: ran) are registered, later sharers skip the admit scatter
+        #: for matched blocks (diverted to the dummy block) so an
+        #: in-flight sharer's K/V is never rewritten with anything but
+        #: identical bytes, and generation never writes into a
+        #: registered block (those end before the first generated
+        #: position).  Blocks free when their last owner releases.
+        self.prefix_cache = bool(prefix_cache)
+        self._prefix_reg = {}                # token-prefix -> block id
+        self._prefix_ref = {}                # block id -> owner count
+        self._block_key = {}                 # block id -> its reg key
         #: fused tick: attention reads the pool through the block table
         #: (ops.pallas.paged scalar-prefetch kernel) — no per-tick
         #: dense gather/scatter.  Auto-fallback to the gather tick for
@@ -1328,12 +1351,45 @@ class PagedContinuousBatcher(ContinuousBatcher):
         return super(PagedContinuousBatcher, self).submit(
             prompt, max_new, temperature=temperature, seed=seed)
 
+    def _shareable_blocks(self, plen):
+        """Blocks of an admitted request that decode NEVER writes:
+        chunked-prefill admission starts ticking at pos0 = plen - 1
+        (the last prompt token re-enters the step), so only blocks
+        strictly before the one holding position plen - 1 are safe to
+        share — on BOTH sides (registration by the first owner, and
+        matching by later sharers, whose own writes start at their own
+        plen - 1).  The tick-by-tick admission path writes every
+        position from 0 and can share nothing."""
+        if not (self.chunked_prefill and plen >= 2):
+            return 0
+        return (plen - 1) // self.block
+
+    def _match_prefix(self, prompt):
+        """Longest run of registered blocks covering this prompt's
+        prefix, from block 0 — the block ids a new sharer reuses.
+        Keys chain per block — (parent block id, that block's own
+        tokens) — so matching is one O(plen) walk and registry memory
+        is O(plen), not O(plen^2) full-prefix tuples."""
+        if not self.prefix_cache:
+            return []
+        out, parent = [], 0
+        for i in range(self._shareable_blocks(len(prompt))):
+            blk = self._prefix_reg.get(
+                (parent,
+                 tuple(prompt[i * self.block:(i + 1) * self.block])))
+            if blk is None:
+                break
+            out.append(blk)
+            parent = blk
+        return out
+
     def _can_admit(self):
         if not self._queue or None not in self._slot_req:
             return False
         _, prompt, max_new, _, _ = self._queue[0]
-        return self._blocks_needed(len(prompt), max_new) <= \
-            len(self._free)
+        need = self._blocks_needed(len(prompt), max_new) \
+            - len(self._match_prefix(prompt))
+        return need <= len(self._free)
 
     def free_blocks(self):
         """Unallocated pool blocks — the serving plane's memory gauge."""
@@ -1341,7 +1397,15 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def _release_slot(self, b):
         super(PagedContinuousBatcher, self)._release_slot(b)
-        self._free.extend(self._slot_blocks.pop(b, ()))
+        for blk in self._slot_blocks.pop(b, ()):
+            if blk in self._prefix_ref:
+                self._prefix_ref[blk] -= 1
+                if self._prefix_ref[blk] == 0:
+                    del self._prefix_ref[blk]
+                    del self._prefix_reg[self._block_key.pop(blk)]
+                    self._free.append(blk)
+            else:
+                self._free.append(blk)
         self._tables = self._tables.at[b].set(0)
 
     def _state(self):
@@ -1359,22 +1423,54 @@ class PagedContinuousBatcher(ContinuousBatcher):
         rid, prompt, max_new, temperature, seed = self._queue.popleft()
         plen = len(prompt)
         nb = self._blocks_needed(plen, max_new)
-        ids = [self._free.pop() for _ in range(nb)]
+        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
+        matched = self._match_prefix(prompt)
+        # registerable = blocks the chunk prefill wrote COMPLETELY at
+        # admit and that decode never touches (_shareable_blocks); the
+        # tick-by-tick path (cache_row None) fills blocks progressively
+        # — a later sharer could attend positions nobody has written
+        registerable = self._shareable_blocks(plen) \
+            if cache_row is not None else 0
+        ids, scatter_row, parent = [], [], 0
+        for i in range(nb):
+            if i < len(matched):
+                blk = matched[i]
+                self._prefix_ref[blk] += 1
+                # skip the admit scatter for matched blocks (divert to
+                # the dummy block): they already hold the prefix K/V,
+                # and a fresh-init scatter would zero them under an
+                # in-flight sharer
+                scatter_row.append(0)
+            else:
+                blk = self._free.pop()
+                if self.prefix_cache and i < registerable:
+                    key = (parent, tuple(
+                        prompt[i * self.block:(i + 1) * self.block]))
+                    self._prefix_reg[key] = blk
+                    self._prefix_ref[blk] = 1
+                    self._block_key[blk] = key
+                scatter_row.append(blk)
+            parent = blk
+            ids.append(blk)
         self._slot_blocks[b] = ids
         table_row = np.zeros((self.max_blocks,), np.int32)
         table_row[:nb] = ids
-        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
+        srow = np.zeros((self.max_blocks,), np.int32)
+        srow[:nb] = scatter_row
         if self._admit_fn is None:
             gen = self.gen
             bs, nbm = self.block, self.max_blocks
 
             def admit_body(st, b, prow, plen_, total, seed_, inv_temp,
-                           trow, pos0_, crow):
+                           trow, srow, pos0_, crow):
                 # ONE fused dispatch, mirroring the dense admit_body
                 # (same scalar writes) + the table row and the prompt
                 # cache blocks scattered into the pool.  Dummy table
                 # entries (0) scatter into the dummy block — harmless,
-                # never read.
+                # never read.  ``srow`` is ``trow`` with prefix-shared
+                # blocks diverted to the dummy block: their K/V already
+                # lives in the pool and must not be rewritten under an
+                # in-flight sharer.
                 (tokens, pos, plens, totals, active, seeds, its,
                  pool, tables) = st
                 tokens = jax.lax.dynamic_update_slice(
@@ -1392,16 +1488,16 @@ class PagedContinuousBatcher(ContinuousBatcher):
                     blocks = jnp.moveaxis(
                         rw[0].reshape((rw.shape[1], nbm, bs)
                                       + rw.shape[3:]), 1, 0)
-                    return pl.at[trow].set(blocks.astype(pl.dtype))
+                    return pl.at[srow].set(blocks.astype(pl.dtype))
 
                 pool = jax.tree_util.tree_map(one, pool, crow)
                 return (tokens, pos, plens, totals, active, seeds,
                         its, pool, tables)
 
             def admit_fresh(st, b, prow, plen_, total, seed_,
-                            inv_temp, trow):
+                            inv_temp, trow, srow):
                 return admit_body(st, b, prow, plen_, total, seed_,
-                                  inv_temp, trow, jnp.int32(0),
+                                  inv_temp, trow, srow, jnp.int32(0),
                                   gen._init_caches(
                                       1, gen._model_dtype()))
 
@@ -1415,7 +1511,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 jnp.int32(seed),
                 jnp.float32(0.0 if temperature == 0.0
                             else 1.0 / temperature),
-                jnp.asarray(table_row))
+                jnp.asarray(table_row), jnp.asarray(srow))
         if cache_row is None:
             st = self._admit_fresh_fn(*args)
         else:
@@ -1446,13 +1542,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 return (tokens, pos, plen, total, active, seeds,
                         inv_temp, pool, tables)
 
-            def fused(params, st):
-                def body(carry, _):
-                    return fused_tick(params, carry), None
-                return jax.lax.scan(body, st, None,
-                                    length=self.ticks_per_dispatch)[0]
-
-            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
+            self._tick_fn = self._jit_ticks(fused_tick)
         if self._tick_fn is None:
             core = self._make_core()
             bs, nbm = self.block, self.max_blocks
@@ -1486,11 +1576,5 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 return (tokens, pos, plen, total, active, seeds,
                         inv_temp, pool, tables)
 
-            def fused(params, st):
-                def body(carry, _):
-                    return paged_tick(params, carry), None
-                return jax.lax.scan(body, st, None,
-                                    length=self.ticks_per_dispatch)[0]
-
-            self._tick_fn = jax.jit(fused, donate_argnums=(1,))
+            self._tick_fn = self._jit_ticks(paged_tick)
         return self._tick_fn(self.gen.params, st)

@@ -625,8 +625,11 @@ def mha_step_paged(params, x, pool_k, pool_v, table, pos, n_heads,
     rows = jnp.arange(x.shape[0])
     blk = table[rows, pos // bs]
     off = pos % bs
-    # each row owns its blocks exclusively (allocation is a host-side
-    # free-list pop), so the [B]-indexed scatter has no duplicate hazard
+    # write targets are exclusively-owned blocks: allocation is a
+    # host-side free-list pop, and prefix-SHARED blocks are never
+    # write targets (the batcher shares only blocks strictly before
+    # any owner's first written position, _shareable_blocks) — so the
+    # [B]-indexed scatter has no duplicate hazard
     pool_k = pool_k.at[blk, :, off].set(k1[:, :, 0])
     pool_v = pool_v.at[blk, :, off].set(v1[:, :, 0])
 

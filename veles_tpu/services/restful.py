@@ -129,17 +129,20 @@ class ContinuousEngine(Logger):
     status table, ref web_status.py:113-200, applied to serving)."""
 
     def __init__(self, generator, slots=8, history=512, paged_block=0,
-                 pool_tokens=None):
+                 pool_tokens=None, prefix_cache=False):
         super(ContinuousEngine, self).__init__()
         import collections
         from veles_tpu.models.generate import (ContinuousBatcher,
                                                PagedContinuousBatcher)
         #: paged_block > 0: block-table KV pool — slot memory scales
         #: with the pool_tokens budget, and admission backpressures on
-        #: pool exhaustion as well as slot exhaustion
+        #: pool exhaustion as well as slot exhaustion.  prefix_cache:
+        #: concurrent requests sharing a prompt prefix share its KV
+        #: blocks (copy-on-write — the system-prompt case)
         self.cb = (PagedContinuousBatcher(generator, slots=slots,
                                           block=paged_block,
-                                          pool_tokens=pool_tokens)
+                                          pool_tokens=pool_tokens,
+                                          prefix_cache=prefix_cache)
                    if paged_block else
                    ContinuousBatcher(generator, slots=slots))
         #: guards _ingress / _records / _history / counters — NEVER
@@ -340,7 +343,7 @@ class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
                  path="/service", generator=None, batch_window=0.0,
                  max_batch=8, continuous_slots=0, paged_block=0,
-                 pool_tokens=None):
+                 pool_tokens=None, prefix_cache=False):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -360,7 +363,8 @@ class RESTfulAPI(Logger):
         #: fall through to the other paths)
         self.engine = (ContinuousEngine(generator, continuous_slots,
                                         paged_block=paged_block,
-                                        pool_tokens=pool_tokens)
+                                        pool_tokens=pool_tokens,
+                                        prefix_cache=prefix_cache)
                        if generator is not None and continuous_slots > 0
                        else None)
         self._server = None
