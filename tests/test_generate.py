@@ -929,6 +929,22 @@ class TestPrefixCache:
             np.asarray([pb], np.int32), 4)[0].tolist()
         assert cb.free_blocks() == free0
 
+    def test_engine_exposes_prefix_gauges(self, f32_precision):
+        from veles_tpu.services.restful import ContinuousEngine
+        wf, toks = _lm_workflow(max_epochs=0)
+        gen = LMGenerator(wf.trainer, max_len=16)
+        eng = ContinuousEngine(gen, slots=2, paged_block=4,
+                               pool_tokens=48, prefix_cache=True)
+        try:
+            eng.submit(toks[0, :9].tolist(), 3)
+            m = eng.metrics()
+            # post-serve: all owners released, registry drained
+            assert m["prefix_shared_blocks"] == 0
+            assert m["prefix_block_refs"] == 0
+            assert m["free_kv_blocks"] == 12
+        finally:
+            eng.stop()
+
     def test_sharing_lets_requests_fit_a_tight_pool(self,
                                                     f32_precision):
         """Two same-prefix requests that canNOT fit independently admit

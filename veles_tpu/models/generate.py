@@ -1395,6 +1395,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
         """Unallocated pool blocks — the serving plane's memory gauge."""
         return len(self._free)
 
+    def prefix_stats(self):
+        """(registered shared blocks, total owner refs) — the prefix-
+        cache gauge; refs > blocks means live sharing.  Public
+        accessor: the engine reads gauges only through methods."""
+        return len(self._prefix_ref), sum(self._prefix_ref.values())
+
     def _release_slot(self, b):
         super(PagedContinuousBatcher, self)._release_slot(b)
         for blk in self._slot_blocks.pop(b, ()):

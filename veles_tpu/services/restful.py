@@ -157,6 +157,10 @@ class ContinuousEngine(Logger):
         #: batcher); None on the dense batcher
         self._kv_gauge = (self.cb.free_blocks()
                           if hasattr(self.cb, "free_blocks") else None)
+        #: prefix-cache gauge: (registered shared blocks, total owner
+        #: refs) — hit rate is visible as refs > blocks
+        self._prefix_gauge = ((0, 0) if getattr(self.cb, "prefix_cache",
+                                                False) else None)
         self._start_ts = time.monotonic()
         self._closed = False
         self._wake = threading.Event()
@@ -270,6 +274,8 @@ class ContinuousEngine(Logger):
             if self._kv_gauge is not None:
                 with self._lock:
                     self._kv_gauge = self.cb.free_blocks()
+                    if self._prefix_gauge is not None:
+                        self._prefix_gauge = self.cb.prefix_stats()
             for rec in done:          # wake waiters outside the lock
                 rec["event"].set()
 
@@ -291,6 +297,9 @@ class ContinuousEngine(Logger):
                "agg_tokens_per_sec": 0.0}
         if self._kv_gauge is not None:
             out["free_kv_blocks"] = self._kv_gauge
+        if self._prefix_gauge is not None:
+            out["prefix_shared_blocks"] = self._prefix_gauge[0]
+            out["prefix_block_refs"] = self._prefix_gauge[1]
 
         def pct(vals, q):
             if not vals:
