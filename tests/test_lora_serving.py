@@ -212,3 +212,158 @@ def test_serve_time_adapter_loading_via_config(adapted, tmp_path):
     np.testing.assert_array_equal(
         gen.generate(prompt[None], max_new=4),
         want.generate(prompt[None], max_new=4))
+
+
+# --------------------------------------------------------------------------
+# Multi-LoRA serving: one slot pool, per-request adapter routing
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_adapters(adapted):
+    """The module's base + its +2-ramp adapter, plus a SECOND adapter
+    fine-tuned on the +3 ramp — three behaviors one pool must route."""
+    base, wf2 = adapted
+    base_host = base.trainer.host_params()
+    wf3 = _train(zoo.transformer_lm(vocab_size=VOCAB, d_model=16,
+                                    n_heads=2, n_layers=1, lr=5e-2,
+                                    dropout=0.0, lora_rank=2),
+                 _tokens(3), "lora-adapted-3", warm=base_host)
+    return base, wf2, wf3
+
+
+def _bank_generator(base, adapters, max_len=12):
+    from veles_tpu.models.generate import LMGenerator
+    gen = LMGenerator(base.trainer, max_len=max_len)
+    n = gen.load_adapter_bank([wf.trainer.host_params()
+                               for wf in adapters])
+    assert n == len(adapters)
+    return gen
+
+
+@pytest.mark.parametrize("mode", ["dense", "paged", "paged_gather"])
+def test_pool_routes_adapters_per_request(two_adapters, mode,
+                                          f32_precision):
+    """One pool serving base + two adapters interleaved: every stream
+    must equal the SOLO generation of its own model (base wf / adapted
+    wf with the adapter's params) — adapter routing can neither leak
+    across slots nor drift from single-model decoding."""
+    from veles_tpu.models.generate import (ContinuousBatcher,
+                                           LMGenerator,
+                                           PagedContinuousBatcher)
+    base, wf2, wf3 = two_adapters
+    gen = _bank_generator(base, [wf2, wf3])
+    if mode == "dense":
+        cb = ContinuousBatcher(gen, slots=3)
+    else:
+        cb = PagedContinuousBatcher(gen, slots=3, block=4,
+                                    pool_tokens=48,
+                                    fused=(mode == "paged"))
+    prompt = _tokens(1)[0, :4].tolist()
+    rids = [cb.submit(prompt, 6, adapter=a) for a in (0, 1, 2)]
+    cb.run_all()
+    solo = {0: LMGenerator(base.trainer, max_len=12),
+            1: LMGenerator(wf2.trainer, max_len=12),
+            2: LMGenerator(wf3.trainer, max_len=12)}
+    for a, rid in zip((0, 1, 2), rids):
+        want = solo[a].generate(
+            np.asarray([prompt], np.int32), 6)[0].tolist()
+        assert cb.pop_result(rid) == want, "adapter %d (%s)" % (a,
+                                                                mode)
+    # adapters genuinely distinct behaviors, or routing proved nothing
+    outs = [solo[a].generate(np.asarray([prompt], np.int32),
+                             6)[0].tolist() for a in (0, 1, 2)]
+    assert len({tuple(o) for o in outs}) >= 2
+
+
+def test_adapter_id_validation(two_adapters, f32_precision):
+    from veles_tpu.models.generate import ContinuousBatcher, LMGenerator
+    base, wf2, _ = two_adapters
+    gen = _bank_generator(base, [wf2])
+    cb = ContinuousBatcher(gen, slots=2)
+    with pytest.raises(ValueError, match="outside the loaded bank"):
+        cb.submit([1, 2], 4, adapter=2)
+    bare = ContinuousBatcher(LMGenerator(base.trainer, max_len=12),
+                             slots=2)
+    with pytest.raises(ValueError, match="outside the loaded bank"):
+        bare.submit([1, 2], 4, adapter=1)
+
+
+def test_bank_rejects_single_lora_params(two_adapters, f32_precision):
+    """A generator whose params already carry a live 'lora' subtree
+    must not silently double-apply — banks demand explicit members."""
+    from veles_tpu.models.generate import LMGenerator
+    base, wf2, _ = two_adapters
+    gen = LMGenerator(wf2.trainer, max_len=12)    # adapted params
+    with pytest.raises(ValueError, match="single 'lora'"):
+        gen.load_adapter_bank([wf2.trainer.host_params()])
+
+
+def test_bank_load_is_atomic_on_bad_adapter(two_adapters,
+                                            f32_precision):
+    """A mid-list failure (adapter without lora) must leave params
+    untouched — never a half-banked generator."""
+    from veles_tpu.models.generate import LMGenerator
+    base, wf2, _ = two_adapters
+    gen = LMGenerator(base.trainer, max_len=12)
+    bad = {k: v for k, v in base.trainer.host_params().items()}
+    with pytest.raises(ValueError, match="no lora subtree"):
+        gen.load_adapter_bank([wf2.trainer.host_params(), bad])
+    assert not any("lora_bank" in gen.params[l.name].get("mha", {})
+                   for l in gen._blocks)
+    assert getattr(gen, "_n_adapters", 0) == 0
+
+
+def test_engine_blocking_submit_routes_adapter(two_adapters,
+                                               f32_precision):
+    """ContinuousEngine.submit(..., adapter=k) must actually route —
+    the silent-base-model regression."""
+    from veles_tpu.models.generate import LMGenerator
+    from veles_tpu.services.restful import ContinuousEngine
+    base, wf2, _ = two_adapters
+    gen = _bank_generator(base, [wf2])
+    eng = ContinuousEngine(gen, slots=2)
+    try:
+        prompt = _tokens(1)[0, :4].tolist()
+        got = list(map(int, eng.submit(prompt, 6, adapter=1)))
+        want = LMGenerator(wf2.trainer, max_len=12).generate(
+            np.asarray([prompt], np.int32), 6)[0].tolist()
+        assert got == want
+        with pytest.raises(ValueError, match="outside the loaded"):
+            eng.submit(prompt, 6, adapter=9)
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_keys_include_adapter(two_adapters,
+                                           f32_precision):
+    """Same prompt, different adapters -> different prefix K/V: the
+    prefix cache must NOT share blocks across adapters, and each
+    stream still matches its solo model."""
+    from veles_tpu.models.generate import (LMGenerator,
+                                           PagedContinuousBatcher)
+    base, wf2, _ = two_adapters
+    gen = _bank_generator(base, [wf2])
+    cb = PagedContinuousBatcher(gen, slots=2, block=4, pool_tokens=48,
+                                prefix_cache=True)
+    prompt = _tokens(1)[0, :9].tolist()           # 2 shareable blocks
+    free0 = cb.free_blocks()
+    r0 = cb.submit(prompt, 3, adapter=0)
+    r1 = cb.submit(prompt, 3, adapter=1)
+    cb.tick()
+    # 3 + 3 blocks (12 tokens each), ZERO shared across adapters
+    assert free0 - cb.free_blocks() == 6
+    cb.run_all()
+    assert cb.pop_result(r0) == LMGenerator(
+        base.trainer, max_len=12).generate(
+            np.asarray([prompt], np.int32), 3)[0].tolist()
+    assert cb.pop_result(r1) == LMGenerator(
+        wf2.trainer, max_len=12).generate(
+            np.asarray([prompt], np.int32), 3)[0].tolist()
+    # and WITHIN one adapter sharing still works
+    free1 = cb.free_blocks()
+    r2 = cb.submit(prompt, 3, adapter=1)
+    r3 = cb.submit(prompt, 3, adapter=1)
+    cb.tick()
+    assert free1 - cb.free_blocks() == 4          # 2 shared
+    cb.run_all()
+    assert cb.pop_result(r2) == cb.pop_result(r3)

@@ -245,6 +245,92 @@ class LMGenerator:
         logits = self._ln_head(params, x)
         return logits[:, 0].astype(jnp.float32), new_caches
 
+    def load_adapter_bank(self, adapters):
+        """Multi-LoRA serving (S-LoRA idea, Sheng et al. 2023): stack N
+        fine-tuned adapters into per-layer banks so ONE slot pool
+        serves base + any adapter, routed per request.
+
+        ``adapters`` — list of host param trees from LoRA fine-tunes of
+        THIS base model (each carries ``<layer>.mha.lora`` subtrees).
+        Bank slot 0 is the identity adapter (zeros — the b-factors
+        zero out the delta), so adapter id 0 == the base model and ids
+        1..N follow ``adapters``' order.  Returns N.
+
+        Banks live in ``params[layer]["mha"]["lora_bank"]``; the
+        serving tick gathers a request's adapter into the live
+        ``"lora"`` subtree (``_graft_adapters``) — the gathered leaves
+        keep a leading row dim under the batched paged step, which
+        ``_qkv_proj``'s jnp.matmul chain broadcasts natively.  Banks
+        are a serving-path artifact: training, solo generate() and
+        beam ignore them."""
+        if not adapters:
+            raise ValueError("adapters must be a non-empty list")
+        # validate + build EVERY layer's bank before touching
+        # self.params: a mid-list error (missing subtree, rank
+        # mismatch breaking the stack) must leave the generator
+        # exactly as it was, never half-banked
+        banks = {}
+        for layer in self._blocks:
+            lp = self.params.get(layer.name, {})
+            if "lora" in lp.get("mha", {}):
+                raise ValueError(
+                    "params already carry a single 'lora' subtree on "
+                    "%s — serve it as a bank member instead"
+                    % layer.name)
+            subs = []
+            for i, tree in enumerate(adapters):
+                sub = tree.get(layer.name, {}).get("mha", {}).get(
+                    "lora")
+                if sub is None:
+                    raise ValueError(
+                        "adapter %d has no lora subtree on layer %s"
+                        % (i, layer.name))
+                subs.append(sub)
+            try:
+                banks[layer.name] = jax.tree_util.tree_map(
+                    lambda *leaves: jnp.stack(
+                        (jnp.zeros_like(jnp.asarray(leaves[0])),)
+                        + tuple(jnp.asarray(l) for l in leaves)),
+                    *subs)
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    "adapters disagree on layer %s (rank/shape "
+                    "mismatch?): %s" % (layer.name, e)) from e
+        if not banks:
+            raise ValueError("model has no transformer blocks to bank")
+        # rebind a shallow copy: self.params may BE the trainer's live
+        # params dict (shared with training and other generators) —
+        # banks belong to THIS generator only
+        self.params = dict(self.params)
+        for name, bank in banks.items():
+            lp = self.params[name]
+            mha = dict(lp["mha"])
+            mha["lora_bank"] = bank
+            self.params[name] = dict(lp, mha=mha)
+        self._n_adapters = len(adapters)
+        return self._n_adapters
+
+    def _graft_adapters(self, params, aid):
+        """``params`` with each banked layer's adapters gathered at
+        ``aid`` (scalar for one row, [B] vector for the batched paged
+        step) into the live ``"lora"`` subtree ``_qkv_proj`` reads.
+        Identity (returns ``params`` itself) when no banks exist, so
+        bank-free models trace the exact same program as before."""
+        out = None
+        for layer in self._blocks:
+            lp = params.get(layer.name, {})
+            bank = lp.get("mha", {}).get("lora_bank")
+            if bank is None:
+                continue
+            if out is None:
+                out = dict(params)
+            mha = {k: v for k, v in lp["mha"].items()
+                   if k != "lora_bank"}
+            mha["lora"] = jax.tree_util.tree_map(
+                lambda b_: b_[aid], bank)
+            out[layer.name] = dict(lp, mha=mha)
+        return params if out is None else out
+
     def _step_paged(self, params, pool, tables, tok, pos):
         """One decode step against the PAGED KV pool, batched over rows
         at PER-ROW positions: tok [B] int32, pos [B] int32 →
@@ -951,6 +1037,11 @@ class ContinuousBatcher:
         self._active = jnp.zeros((B,), jnp.bool_)
         self._seeds = jnp.zeros((B,), jnp.int32)
         self._inv_temp = jnp.zeros((B,), jnp.float32)  # 0 = greedy
+        #: per-slot adapter id (multi-LoRA routing; 0 = base).  Host-
+        #: managed: changes only at admission, so it rides the tick as
+        #: a separate non-donated argument instead of growing the
+        #: state tuple every admit body must rebuild.
+        self._aids = jnp.zeros((B,), jnp.int32)
         self._caches = self._init_slot_caches()
         self._slot_req = [None] * B               # slot -> request id
         self._queue = collections.deque()
@@ -960,9 +1051,12 @@ class ContinuousBatcher:
         self._admit_fn = None
 
     # ------------------------------------------------------------ public
-    def submit(self, prompt, max_new, temperature=0.0, seed=0):
+    def submit(self, prompt, max_new, temperature=0.0, seed=0,
+               adapter=0):
         """Queue a request; returns a request id.  The request enters
-        the pool at the next tick with a free slot."""
+        the pool at the next tick with a free slot.  ``adapter``:
+        multi-LoRA routing — 0 = base model, 1..N = the bank loaded by
+        ``LMGenerator.load_adapter_bank``."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -973,10 +1067,15 @@ class ContinuousBatcher:
             raise ValueError("prompt+max_new %d exceeds max_len %d"
                              % (len(prompt) + int(max_new),
                                 self.gen.max_len))
+        n_bank = getattr(self.gen, "_n_adapters", 0)
+        if not 0 <= int(adapter) <= n_bank:
+            raise ValueError("adapter %d outside the loaded bank "
+                             "(0..%d)" % (int(adapter), n_bank))
         rid = self._next_id
         self._next_id += 1
         self._queue.append((rid, prompt, int(max_new),
-                            float(temperature), int(seed)))
+                            float(temperature), int(seed),
+                            int(adapter)))
         return rid
 
     def idle(self):
@@ -1049,25 +1148,31 @@ class ContinuousBatcher:
         return self._results
 
     # ----------------------------------------------------------- internal
-    def _prefill_row(self, prompt, plen, max_new):
+    def _prefill_row(self, prompt, plen, max_new, adapter=0):
         """Chunked-prefill admission: one parallel pass fills a [1, ...]
         cache row with the prompt and returns (cache_row, start_pos);
         the tick's prompt-forcing covers whatever the chunk didn't
         (rolling windows prefill a smaller chunk).  (None, 0) when the
-        request prefills token-by-token through the shared tick."""
+        request prefills token-by-token through the shared tick.
+        ``adapter``: the prompt's K/V must be computed under the SAME
+        adapter the decode will run (grafted params; id 0 = base)."""
         gen = self.gen
         if self.chunked_prefill and plen >= 2:
             tp, start, _ = gen._prefill_dispatch(plen, plen + max_new)
             chunk = np.zeros((tp,), np.int32)
             chunk[:min(plen, tp)] = prompt[:tp]
+            params = gen._graft_adapters(gen.params,
+                                         jnp.int32(adapter))
             return gen._prefill_fn(1, tp)(
-                gen.params, jnp.asarray(chunk[None])), start
+                params, jnp.asarray(chunk[None])), start
         return None, 0
 
     def _admit(self, b):
-        rid, prompt, max_new, temperature, seed = self._queue.popleft()
+        (rid, prompt, max_new, temperature, seed,
+         adapter) = self._queue.popleft()
         gen = self.gen
         plen = len(prompt)
+        self._aids = self._aids.at[b].set(adapter)
         if self._admit_fn is None:
             def admit_body(st, b, prow, plen, total, seed, inv_temp,
                            pos0, cache_row):
@@ -1105,7 +1210,8 @@ class ContinuousBatcher:
             self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
             self._admit_fresh_fn = jax.jit(admit_fresh,
                                            donate_argnums=(0,))
-        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
+        cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
+                                            adapter)
         prow = np.zeros((self.gen.max_len,), np.int32)
         prow[:plen] = prompt
         st = (self._tokens, self._pos, self._plen, self._total,
@@ -1137,26 +1243,29 @@ class ContinuousBatcher:
         gen = self.gen
 
         if step_all is None:
-            def row_step(params, caches, tok, pos):
+            def row_step(params, caches, tok, pos, aid):
                 # single-row view: add the batch dim the stack expects;
                 # under vmap the per-row ``pos`` scatter-writes each
-                # slot at its own depth
+                # slot at its own depth.  Adapter grafting happens per
+                # row (scalar aid) — identity without banks.
                 c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
-                logits, c1 = gen._step(params, c1, tok[None], pos)
+                logits, c1 = gen._step(
+                    gen._graft_adapters(params, aid), c1, tok[None],
+                    pos)
                 return logits[0], jax.tree_util.tree_map(
                     lambda a: a[0], c1)
 
-            def step_all(params, caches, cur, pos):
-                return jax.vmap(row_step, in_axes=(None, 0, 0, 0))(
-                    params, caches, cur, pos)
+            def step_all(params, caches, cur, pos, aids):
+                return jax.vmap(row_step, in_axes=(None, 0, 0, 0, 0))(
+                    params, caches, cur, pos, aids)
 
-        def core(params, st):
+        def core(params, st, aids):
             (tokens, pos, plen, total, active, seeds, inv_temp,
              caches) = st
             B = tokens.shape[0]
             rows = jnp.arange(B)
             cur = tokens[rows, pos]
-            logits, caches = step_all(params, caches, cur, pos)
+            logits, caches = step_all(params, caches, cur, pos, aids)
             greedy_tok = jnp.argmax(logits, axis=-1).astype(
                 jnp.int32)
 
@@ -1203,9 +1312,9 @@ class ContinuousBatcher:
         copy the whole slots×layers KV-cache pool.  One helper shared
         by the dense tick and both paged flavors so the dispatch-fusion
         contract can never diverge between them."""
-        def fused(params, st):
+        def fused(params, st, aids):
             def body(carry, _):
-                return tick_fn(params, carry), None
+                return tick_fn(params, carry, aids), None
             return jax.lax.scan(body, st, None,
                                 length=self.ticks_per_dispatch)[0]
 
@@ -1215,7 +1324,7 @@ class ContinuousBatcher:
         if self._tick_fn is None:
             core = self._make_core()
             self._tick_fn = self._jit_ticks(core)
-        return self._tick_fn(self.gen.params, st)
+        return self._tick_fn(self.gen.params, st, self._aids)
 
 
 class PagedContinuousBatcher(ContinuousBatcher):
@@ -1336,7 +1445,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         total = plen + max_new
         return -(-total // self.block)
 
-    def submit(self, prompt, max_new, temperature=0.0, seed=0):
+    def submit(self, prompt, max_new, temperature=0.0, seed=0,
+               adapter=0):
         """Reject a request larger than the ENTIRE pool up front — it
         could never be admitted, and a forever-queued request would
         deadlock run_all()/the serving engine."""
@@ -1349,7 +1459,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 % (nb, len(prompt), int(max_new), self.block,
                    self.pool_blocks))
         return super(PagedContinuousBatcher, self).submit(
-            prompt, max_new, temperature=temperature, seed=seed)
+            prompt, max_new, temperature=temperature, seed=seed,
+            adapter=adapter)
 
     def _shareable_blocks(self, plen):
         """Blocks of an admitted request that decode NEVER writes:
@@ -1364,18 +1475,20 @@ class PagedContinuousBatcher(ContinuousBatcher):
             return 0
         return (plen - 1) // self.block
 
-    def _match_prefix(self, prompt):
+    def _match_prefix(self, prompt, adapter=0):
         """Longest run of registered blocks covering this prompt's
         prefix, from block 0 — the block ids a new sharer reuses.
-        Keys chain per block — (parent block id, that block's own
-        tokens) — so matching is one O(plen) walk and registry memory
-        is O(plen), not O(plen^2) full-prefix tuples."""
+        Keys chain per block — (parent block id, adapter id, that
+        block's own tokens) — so matching is one O(plen) walk and
+        registry memory is O(plen), not O(plen^2) full-prefix tuples.
+        The adapter id is part of every link: adapters change the
+        prefix's K/V, so sharing is only valid within one adapter."""
         if not self.prefix_cache:
             return []
         out, parent = [], 0
         for i in range(self._shareable_blocks(len(prompt))):
             blk = self._prefix_reg.get(
-                (parent,
+                (parent, int(adapter),
                  tuple(prompt[i * self.block:(i + 1) * self.block])))
             if blk is None:
                 break
@@ -1386,9 +1499,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def _can_admit(self):
         if not self._queue or None not in self._slot_req:
             return False
-        _, prompt, max_new, _, _ = self._queue[0]
+        _, prompt, max_new, _, _, adapter = self._queue[0]
         need = self._blocks_needed(len(prompt), max_new) \
-            - len(self._match_prefix(prompt))
+            - len(self._match_prefix(prompt, adapter))
         return need <= len(self._free)
 
     def free_blocks(self):
@@ -1426,11 +1539,14 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     # -------------------------------------------------------- admission
     def _admit(self, b):
-        rid, prompt, max_new, temperature, seed = self._queue.popleft()
+        (rid, prompt, max_new, temperature, seed,
+         adapter) = self._queue.popleft()
         plen = len(prompt)
+        self._aids = self._aids.at[b].set(adapter)
         nb = self._blocks_needed(plen, max_new)
-        cache_row, pos0 = self._prefill_row(prompt, plen, max_new)
-        matched = self._match_prefix(prompt)
+        cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
+                                            adapter)
+        matched = self._match_prefix(prompt, adapter)
         # registerable = blocks the chunk prefill wrote COMPLETELY at
         # admit and that decode never touches (_shareable_blocks); the
         # tick-by-tick path (cache_row None) fills blocks progressively
@@ -1450,7 +1566,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
             else:
                 blk = self._free.pop()
                 if self.prefix_cache and i < registerable:
-                    key = (parent, tuple(
+                    key = (parent, int(adapter), tuple(
                         prompt[i * self.block:(i + 1) * self.block]))
                     self._prefix_reg[key] = blk
                     self._prefix_ref[blk] = 1
@@ -1530,21 +1646,25 @@ class PagedContinuousBatcher(ContinuousBatcher):
         if self._tick_fn is None and self.fused:
             gen = self.gen
 
-            def paged_step_all(params, cache_state, cur, pos):
+            def paged_step_all(params, cache_state, cur, pos,
+                               aids):
                 pool, tables = cache_state
-                logits, pool = gen._step_paged(params, pool, tables,
-                                               cur, pos)
+                # vector-aid graft: gathered lora leaves carry a
+                # leading [B] dim that _qkv_proj's matmul broadcasts
+                logits, pool = gen._step_paged(
+                    gen._graft_adapters(params, aids), pool, tables,
+                    cur, pos)
                 return logits, (pool, tables)
 
             core = self._make_core(step_all=paged_step_all)
 
-            def fused_tick(params, st):
+            def fused_tick(params, st, aids):
                 (tokens, pos, plen, total, active, seeds, inv_temp,
                  pool, tables) = st
                 (tokens, pos, plen, total, active, seeds, inv_temp,
                  (pool, tables)) = core(
                      params, (tokens, pos, plen, total, active, seeds,
-                              inv_temp, (pool, tables)))
+                              inv_temp, (pool, tables)), aids)
                 return (tokens, pos, plen, total, active, seeds,
                         inv_temp, pool, tables)
 
@@ -1561,7 +1681,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                                      + v.shape[4:])
                 return jax.tree_util.tree_map(one, pool)
 
-            def paged_tick(params, st):
+            def paged_tick(params, st, aids):
                 (tokens, pos, plen, total, active, seeds, inv_temp,
                  pool, tables) = st
                 views = gather(pool, tables)
@@ -1569,7 +1689,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 (tokens, pos, plen, total, active, seeds, inv_temp,
                  views) = core(params, (tokens, pos, plen, total,
                                         active, seeds, inv_temp,
-                                        views))
+                                        views), aids)
                 rows = jnp.arange(tokens.shape[0])
                 blk = tables[rows, pos0 // bs]
                 off = pos0 % bs
@@ -1583,4 +1703,4 @@ class PagedContinuousBatcher(ContinuousBatcher):
                         inv_temp, pool, tables)
 
             self._tick_fn = self._jit_ticks(paged_tick)
-        return self._tick_fn(self.gen.params, st)
+        return self._tick_fn(self.gen.params, st, self._aids)

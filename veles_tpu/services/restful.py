@@ -168,7 +168,7 @@ class ContinuousEngine(Logger):
         self._thread.start()
 
     def submit_async(self, prompt_row, max_new, temperature=0.0,
-                     seed=0):
+                     seed=0, adapter=0):
         """Enqueue one row; returns a handle for ``wait`` (submit every
         row of a request BEFORE waiting so they share the pool).
         Validates here so a bad request raises in the CALLER (one 400),
@@ -185,8 +185,13 @@ class ContinuousEngine(Logger):
         self.cb.gen.validate_request(
             len(prompt), {"max_new": int(max_new),
                           "temperature": float(temperature)})
+        n_bank = getattr(self.cb.gen, "_n_adapters", 0)
+        if not 0 <= int(adapter) <= n_bank:
+            raise ValueError("adapter %d outside the loaded bank "
+                             "(0..%d)" % (int(adapter), n_bank))
         rec = {"prompt": prompt, "max_new": int(max_new),
                "temperature": float(temperature), "seed": int(seed),
+               "adapter": int(adapter),
                "event": threading.Event(), "submit_ts": time.monotonic(),
                "admit_ts": None, "out": None, "error": None}
         with self._lock:
@@ -203,12 +208,13 @@ class ContinuousEngine(Logger):
             raise handle["error"]
         return np.asarray(handle["out"], np.int32)
 
-    def submit(self, prompt_row, max_new, temperature=0.0, seed=0):
+    def submit(self, prompt_row, max_new, temperature=0.0, seed=0,
+               adapter=0):
         """Block until this request's row finishes; returns the 1-D
         prompt+continuation array."""
         return self.wait(self.submit_async(prompt_row, max_new,
                                            temperature=temperature,
-                                           seed=seed))
+                                           seed=seed, adapter=adapter))
 
     def _loop(self):
         while True:
@@ -220,6 +226,7 @@ class ContinuousEngine(Logger):
             for rec in new:           # engine thread: sole cb caller
                 try:
                     rid = self.cb.submit(rec["prompt"], rec["max_new"],
+                                         adapter=rec.get("adapter", 0),
                                          temperature=rec["temperature"],
                                          seed=rec["seed"])
                 except Exception as e:  # noqa: BLE001 — deliver to waiter
@@ -475,6 +482,18 @@ class RESTfulAPI(Logger):
         prompt = np.asarray(req["input"], np.int32)
         if prompt.ndim == 1:
             prompt = prompt[None]
+        if int(opts.get("adapter", 0)) and (
+                self.engine is None or int(opts.get("beam", 0)) > 1
+                or int(opts.get("speculative", 0))
+                or int(opts.get("top_k", 0))
+                or float(opts.get("top_p", 1.0)) < 1.0
+                or int(opts.get("max_new", 16)) < 1):
+            # adapter routing lives in the slot pool's tick; every
+            # other path runs un-adapted params and would silently
+            # serve the base model
+            raise ValueError("\"adapter\" routing requires the "
+                             "continuous engine (continuous_slots>0) "
+                             "and a plain greedy/temperature request")
         beam = int(opts.get("beam", 0))
         if beam > 1:
             out, _ = self.generator.beam_search(
@@ -498,7 +517,8 @@ class RESTfulAPI(Logger):
             handles = [self.engine.submit_async(
                 row, int(opts.get("max_new", 16)),
                 temperature=float(opts.get("temperature", 0.0)),
-                seed=int(opts.get("seed", 0))) for row in prompt]
+                seed=int(opts.get("seed", 0)),
+                adapter=int(opts.get("adapter", 0))) for row in prompt]
             return np.stack([self.engine.wait(h) for h in handles])
         if self.batcher is not None:
             # validate THIS request up front — a bad one must 400 alone,
