@@ -829,6 +829,30 @@ class TestPagedKV:
             eng.stop()
 
 
+def test_stream_partials_progress_and_cleanup(f32_precision):
+    """stream_partials=True: partial(rid) grows monotonically tick by
+    tick along the final result's prefix, and is dropped at
+    completion (long-running servers must not accumulate)."""
+    from veles_tpu.models.generate import ContinuousBatcher
+    wf, toks = _lm_workflow(max_epochs=8)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    cb = ContinuousBatcher(gen, slots=2)
+    cb.stream_partials = True
+    rid = cb.submit(toks[0, :4].tolist(), 6)
+    seen = []
+    while not cb.idle():
+        cb.tick()
+        p = cb.partial(rid)
+        if p is not None:
+            assert not seen or p[:len(seen[-1])] == seen[-1]
+            seen.append(p)
+    want = gen.generate(toks[:1, :4], 6)[0].tolist()
+    assert cb.pop_result(rid) == want
+    assert seen and seen[-1] == want[:len(seen[-1])]
+    assert len(seen) >= 3                  # genuinely incremental
+    assert cb.partial(rid) is None         # dropped at completion
+
+
 class TestPrefixCache:
     """Copy-on-write prefix sharing in the paged pool: concurrent
     requests with a common prompt prefix share its KV blocks.  The

@@ -693,6 +693,73 @@ class TestContinuousServing:
             api.stop()
 
 
+    def test_rest_streaming_ndjson(self):
+        """{"stream": true}: the response is NDJSON — {"tokens": [...]}
+        lines whose concatenation equals the buffered result, then a
+        {"done": true, "result": [...]} terminal line matching the
+        solo decode.  Ineligible stream requests (beam, two rows, no
+        engine) must 400."""
+        import urllib.request
+
+        from veles_tpu.models import zoo
+        from veles_tpu.models.generate import LMGenerator
+
+        prng.seed_all(23)
+        r = np.random.RandomState(3)
+        n, t, vocab = 128, 12, 11
+        toks = ((np.arange(t)[None, :] + r.randint(0, 3, n)[:, None])
+                % vocab).astype(np.int32)
+        loader = FullBatchLoader(None, data=toks, labels=toks,
+                                 minibatch_size=32,
+                                 class_lengths=[0, 32, 96])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=16,
+                                      n_heads=2, n_layers=1, lr=5e-3,
+                                      dropout=0.0),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 8}, name="rest-stream")
+        wf.initialize()
+        wf.run()
+        gen = LMGenerator(wf.trainer, max_len=t)
+        api = RESTfulAPI(lambda xx: xx, (t,), port=0, generator=gen,
+                         continuous_slots=2)
+        api.start()
+        try:
+            url = "http://127.0.0.1:%d/service" % api.port
+            body = json.dumps({
+                "input": toks[0, :5].tolist(),
+                "generate": {"max_new": 5, "stream": True}}).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert resp.headers["Content-Type"] == \
+                    "application/x-ndjson"
+                lines = [json.loads(l)
+                         for l in resp.read().decode().splitlines()]
+            assert lines[-1]["done"] is True
+            streamed = [tok for l in lines[:-1] for tok in l["tokens"]]
+            want = gen.generate(toks[:1, :5], 5)[0].tolist()
+            assert lines[-1]["result"] == want
+            assert toks[0, :5].tolist() + streamed == want
+            assert len(lines) >= 3        # genuinely incremental
+            # ineligible: beam
+            bad = json.dumps({
+                "input": toks[0, :5].tolist(),
+                "generate": {"max_new": 4, "stream": True,
+                             "beam": 2}}).encode()
+            req = urllib.request.Request(
+                url, data=bad,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                assert False, "beam stream must 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            api.stop()
+
+
 @pytest.mark.slow
 class TestServingSLO:
     """Serving-plane observability + SLO (r4 verdict #4): N concurrent

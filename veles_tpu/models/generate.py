@@ -1046,6 +1046,10 @@ class ContinuousBatcher:
         self._slot_req = [None] * B               # slot -> request id
         self._queue = collections.deque()
         self._results = {}
+        #: opt-in per-tick partial-token snapshots (token streaming);
+        #: costs one [B, max_len] host fetch per dispatch when on
+        self.stream_partials = False
+        self._partials = {}
         self._next_id = 0
         self._tick_fn = None
         self._admit_fn = None
@@ -1112,13 +1116,33 @@ class ContinuousBatcher:
         total = np.asarray(self._total)
         occupied = np.array([r is not None for r in self._slot_req])
         done = occupied & (pos + 1 >= total)
+        stream = self.stream_partials and occupied.any()
+        # ONE [B, L] host fetch serves both the partial snapshots and
+        # the completion emission; non-streaming servers with nothing
+        # done still pay nothing
+        toks = (np.asarray(self._tokens)
+                if stream or done.any() else None)
+        if stream:
+            # per-tick partial snapshot for token streaming: tokens
+            # through index pos[b] are final (the tick wrote pos, then
+            # advanced)
+            for b in np.nonzero(occupied)[0]:
+                self._partials[self._slot_req[b]] = toks[
+                    b, :min(pos[b] + 1, total[b])].tolist()
         if done.any():
-            toks = np.asarray(self._tokens)
             for b in np.nonzero(done)[0]:
                 rid = self._slot_req[b]
                 self._results[rid] = toks[b, :total[b]].tolist()
+                self._partials.pop(rid, None)
                 self._release_slot(int(b))
         return int((np.asarray(self._active)).sum())
+
+    def partial(self, rid):
+        """Tokens decoded so far (prompt included) for an in-flight
+        request, or None before admission / after completion.  Only
+        populated while ``stream_partials`` is True; granularity is one
+        dispatch (``ticks_per_dispatch`` tokens per update)."""
+        return self._partials.get(rid)
 
     # --- subclass hooks (the paged batcher reshapes the cache state) ---
     def _init_slot_caches(self):

@@ -8,6 +8,7 @@ reference's Twisted resource — no reactor to manage."""
 
 import base64
 import json
+import queue as _queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -168,7 +169,7 @@ class ContinuousEngine(Logger):
         self._thread.start()
 
     def submit_async(self, prompt_row, max_new, temperature=0.0,
-                     seed=0, adapter=0):
+                     seed=0, adapter=0, stream=False):
         """Enqueue one row; returns a handle for ``wait`` (submit every
         row of a request BEFORE waiting so they share the pool).
         Validates here so a bad request raises in the CALLER (one 400),
@@ -193,7 +194,13 @@ class ContinuousEngine(Logger):
                "temperature": float(temperature), "seed": int(seed),
                "adapter": int(adapter),
                "event": threading.Event(), "submit_ts": time.monotonic(),
-               "admit_ts": None, "out": None, "error": None}
+               "admit_ts": None, "out": None, "error": None,
+               # streaming: the engine thread pushes ("tokens", [...])
+               # chunks of NEW tokens per dispatch, then ("done", out)
+               # / ("error", e); the HTTP worker drains until a
+               # terminal item.  _sent tracks the high-water mark.
+               "stream_q": _queue.Queue() if stream else None,
+               "_sent": 0}
         with self._lock:
             if self._closed:
                 raise RuntimeError("engine is stopped")
@@ -216,6 +223,24 @@ class ContinuousEngine(Logger):
                                            temperature=temperature,
                                            seed=seed, adapter=adapter))
 
+    def stream(self, prompt_row, max_new, temperature=0.0, seed=0,
+               adapter=0):
+        """Generator yielding lists of NEW tokens as they decode
+        (one chunk per engine dispatch — ``ticks_per_dispatch`` tokens
+        at a time), ending after the final chunk.  Raises the engine's
+        error if the request fails."""
+        rec = self.submit_async(prompt_row, max_new,
+                                temperature=temperature, seed=seed,
+                                adapter=adapter, stream=True)
+        while True:
+            kind, payload = rec["stream_q"].get()
+            if kind == "tokens":
+                yield payload
+            elif kind == "done":
+                return
+            else:
+                raise payload
+
     def _loop(self):
         while True:
             with self._lock:
@@ -231,12 +256,16 @@ class ContinuousEngine(Logger):
                                          seed=rec["seed"])
                 except Exception as e:  # noqa: BLE001 — deliver to waiter
                     rec["error"] = e
+                    if rec["stream_q"] is not None:
+                        rec["stream_q"].put(("error", e))
                     rec["event"].set()
                     continue
                 with self._lock:
                     if self._closed:   # stop() raced the hand-off —
                         rec["error"] = RuntimeError(  # release the waiter
                             "engine stopped before request completed")
+                        if rec["stream_q"] is not None:
+                            rec["stream_q"].put(("error", rec["error"]))
                         rec["event"].set()
                         continue
                     self._records[rid] = rec
@@ -244,6 +273,10 @@ class ContinuousEngine(Logger):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            with self._lock:
+                self.cb.stream_partials = any(
+                    rec["stream_q"] is not None
+                    for rec in self._records.values())
             tick_start = time.monotonic()
             self.cb.tick()            # device dispatch — NO lock held
             now = time.monotonic()
@@ -260,6 +293,16 @@ class ContinuousEngine(Logger):
                         # fused dispatch) records the tick's real
                         # duration as decode time, not a 1e-9 floor
                         rec["admit_ts"] = tick_start
+                for rid, rec in self._records.items():
+                    if rec["stream_q"] is None:
+                        continue
+                    part = self.cb.partial(rid)
+                    if part is None:
+                        continue
+                    fresh = part[len(rec["prompt"]) + rec["_sent"]:]
+                    if fresh:
+                        rec["_sent"] += len(fresh)
+                        rec["stream_q"].put(("tokens", fresh))
                 for rid in list(self._records):
                     out = self.cb.pop_result(rid)
                     if out is None:
@@ -284,6 +327,16 @@ class ContinuousEngine(Logger):
                     if self._prefix_gauge is not None:
                         self._prefix_gauge = self.cb.prefix_stats()
             for rec in done:          # wake waiters outside the lock
+                if rec["stream_q"] is not None:
+                    # the batcher drops its partial snapshot when the
+                    # row completes — flush whatever the last dispatch
+                    # decoded from the final result before the terminal
+                    tail = list(rec["out"])[len(rec["prompt"])
+                                            + rec["_sent"]:]
+                    if tail:
+                        rec["_sent"] += len(tail)
+                        rec["stream_q"].put(("tokens", tail))
+                    rec["stream_q"].put(("done", rec["out"]))
                 rec["event"].set()
 
     def metrics(self):
@@ -350,6 +403,10 @@ class ContinuousEngine(Logger):
             if rec["out"] is None and rec["error"] is None:
                 rec["error"] = RuntimeError(
                     "engine stopped before request completed")
+            if rec.get("stream_q") is not None and rec["out"] is None:
+                # a streaming consumer blocks in stream_q.get(), not on
+                # the event — it needs its own terminal or it hangs
+                rec["stream_q"].put(("error", rec["error"]))
             rec["event"].set()
         self._wake.set()
         self._thread.join(timeout=5)
@@ -409,6 +466,37 @@ class RESTfulAPI(Logger):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
+                    if isinstance(req.get("generate"), dict) and \
+                            req["generate"].get("stream"):
+                        # NDJSON streaming: one {"tokens": [...]} line
+                        # per engine dispatch, then {"done", "result"}.
+                        # HTTP/1.0 semantics — body is EOF-delimited,
+                        # so no Content-Length / chunking needed.
+                        prompt, chunks = api.run_generate_stream(req)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.end_headers()
+                        got = list(prompt)
+                        # headers are out: a mid-stream failure must
+                        # surface as a structured NDJSON error line,
+                        # never as a 400 status injected into the body
+                        try:
+                            for fresh in chunks:
+                                got.extend(fresh)
+                                self.wfile.write(
+                                    (json.dumps({"tokens": fresh})
+                                     + "\n").encode())
+                                self.wfile.flush()
+                            self.wfile.write(
+                                (json.dumps({"done": True,
+                                             "result": got})
+                                 + "\n").encode())
+                        except Exception as e:  # noqa: BLE001
+                            self.wfile.write(
+                                (json.dumps({"error": str(e)})
+                                 + "\n").encode())
+                        return
                     if "generate" in req:
                         out = api.run_generate(req)
                     else:
@@ -465,6 +553,50 @@ class RESTfulAPI(Logger):
         return out
 
     # ---------------------------------------------------------- generation
+    @staticmethod
+    def _plain_engine_request(opts):
+        """True iff this generate request can ride the slot pool:
+        plain greedy/temperature, at least one new token — the ONE
+        predicate the engine branch, the adapter gate, and the
+        streaming gate all share (three hand-copies drifted once
+        already)."""
+        return (int(opts.get("beam", 0)) <= 1
+                and not int(opts.get("speculative", 0))
+                and int(opts.get("top_k", 0)) == 0
+                and float(opts.get("top_p", 1.0)) >= 1.0
+                and int(opts.get("max_new", 16)) >= 1)
+
+    def run_generate_stream(self, req):
+        """NDJSON token streaming: validates a single-row greedy /
+        plain-temperature engine request and returns (prompt, iterator
+        over new-token chunks).  Everything else must use the buffered
+        endpoint — streaming has no batch to coalesce and no beam
+        state to surface incrementally."""
+        if self.generator is None:
+            raise ValueError("this endpoint serves a non-LM workflow: "
+                             "no generator is attached")
+        opts = req.get("generate")
+        if not isinstance(opts, dict):
+            raise ValueError("'generate' must be an options object")
+        if self.engine is None:
+            raise ValueError("\"stream\" requires the continuous "
+                             "engine (continuous_slots>0)")
+        prompt = np.asarray(req["input"], np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        if prompt.shape[0] != 1:
+            raise ValueError("\"stream\" serves ONE row per request")
+        if not self._plain_engine_request(opts):
+            raise ValueError("\"stream\" supports plain greedy/"
+                             "temperature requests only")
+        self.generator.validate_request(len(prompt[0]), opts)
+        it = self.engine.stream(
+            prompt[0], int(opts.get("max_new", 16)),
+            temperature=float(opts.get("temperature", 0.0)),
+            seed=int(opts.get("seed", 0)),
+            adapter=int(opts.get("adapter", 0)))
+        return prompt[0].tolist(), it
+
     def run_generate(self, req):
         """``{"input": [[tok, ...]], "generate": {"max_new": N,
         "temperature": T, "seed": S}}`` → generated token matrix (causal
@@ -483,11 +615,8 @@ class RESTfulAPI(Logger):
         if prompt.ndim == 1:
             prompt = prompt[None]
         if int(opts.get("adapter", 0)) and (
-                self.engine is None or int(opts.get("beam", 0)) > 1
-                or int(opts.get("speculative", 0))
-                or int(opts.get("top_k", 0))
-                or float(opts.get("top_p", 1.0)) < 1.0
-                or int(opts.get("max_new", 16)) < 1):
+                self.engine is None
+                or not self._plain_engine_request(opts)):
             # adapter routing lives in the slot pool's tick; every
             # other path runs un-adapted params and would silently
             # serve the base model
@@ -510,8 +639,12 @@ class RESTfulAPI(Logger):
         if self.engine is not None and int(opts.get("top_k", 0)) == 0 \
                 and float(opts.get("top_p", 1.0)) >= 1.0 \
                 and int(opts.get("max_new", 16)) >= 1:
-            # (max_new=0 echo/score requests fall through — the solo
-            # and coalescing paths serve them; the slot pool can't)
+            # (beam/speculative were dispatched above; a speculative
+            # request that fell through — batcher attached, sampled,
+            # or multi-row — rides the pool as plain decode, as
+            # before.  max_new=0 echo/score requests fall through —
+            # the solo and coalescing paths serve them; the slot pool
+            # can't)
             for row in prompt:
                 self.generator.validate_request(len(row), opts)
             handles = [self.engine.submit_async(
