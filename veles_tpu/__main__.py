@@ -1070,7 +1070,11 @@ class Main(object):
                          # prompt prefix share its KV blocks (the
                          # system-prompt case pays for it once)
                          prefix_cache=bool(root.common.serve.get(
-                             "prefix_cache", False)))
+                             "prefix_cache", False)),
+                         # speculative_k>0: n-gram speculative ticks in
+                         # the dense slot pool (exact decode semantics)
+                         speculative_k=int(root.common.serve.get(
+                             "speculative_k", 0)))
         api.start()
         if getattr(self, "_web", None) is not None:
             # the dashboard's serving panel shows the slot pool's SLO

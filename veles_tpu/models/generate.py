@@ -14,6 +14,7 @@ generation is the transformer-era equivalent and beyond-parity."""
 
 
 import collections
+import functools
 import threading
 
 import jax
@@ -69,6 +70,25 @@ def _sample(logits, pos, keys, top_k, top_p, inv_temp):
     subs = jax.vmap(jax.random.fold_in)(
         keys, jnp.broadcast_to(pos, (lg.shape[0],)))
     return jax.vmap(jax.random.categorical)(subs, lg).astype(jnp.int32)
+
+
+def _ngram_draft(row, cursor, kk, ll):
+    """Draft ``kk`` candidate tokens for positions cursor+1..cursor+kk:
+    copy the continuation of the most recent EARLIER occurrence of the
+    last known bigram (row[cursor-1], row[cursor]); fallback = repeat
+    from ``cursor``.  Shared by the solo speculative decode
+    (LMGenerator._spec_fn, whose loop cursor ``cur`` equals cursor+1)
+    and the batcher's speculative tick core — draft quality only
+    affects how many positions verify, never which tokens come out,
+    but the rule must not silently drift between the two."""
+    j = jnp.arange(ll - 1)
+    last2 = jax.lax.dynamic_slice(row, (jnp.maximum(cursor - 1, 0),),
+                                  (2,))
+    match = ((row[:-1] == last2[0]) & (row[1:] == last2[1])
+             & (j + 1 < cursor))
+    cand = jnp.max(jnp.where(match, j, -1))
+    src = jnp.clip(jnp.where(cand >= 0, cand + 2, cursor), 0, ll - kk)
+    return jax.lax.dynamic_slice(row, (src,), (kk,))
 
 
 class LMGenerator:
@@ -604,17 +624,7 @@ class LMGenerator:
             def body(state):
                 tokens, caches, cur = state
                 row = tokens[0]
-                # draft: copy the continuation of the most recent
-                # earlier occurrence of the last bigram; fallback =
-                # repeat from cur-1 (quality only affects speed)
-                j = jnp.arange(ll - 1)
-                last2 = jax.lax.dynamic_slice(row, (cur - 2,), (2,))
-                match = ((row[:-1] == last2[0]) & (row[1:] == last2[1])
-                         & (j + 1 < cur - 1))
-                cand = jnp.max(jnp.where(match, j, -1))
-                src = jnp.clip(jnp.where(cand >= 0, cand + 2, cur - 1),
-                               0, ll - kk)
-                draft = jax.lax.dynamic_slice(row, (src,), (kk,))
+                draft = _ngram_draft(row, cur - 1, kk, ll)
                 # prompt positions teacher-force their own tokens
                 in_prompt = (cur + idx) < prompt_len
                 cur_slice = jax.lax.dynamic_slice(row, (cur,), (kk,))
@@ -1010,9 +1020,27 @@ class ContinuousBatcher:
     """
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
-                 chunked_prefill=True):
+                 chunked_prefill=True, speculative_k=0):
         self.gen = gen
         self.slots = int(slots)
+        #: speculative_k > 0: n-gram speculative ticks — every active
+        #: row verifies up to k drafted tokens per tick instead of
+        #: decoding one (_make_core_spec; exact decode semantics).
+        #: Dense pools, linear caches only.
+        self.speculative_k = int(speculative_k)
+        if self.speculative_k:
+            if not 2 <= self.speculative_k <= 64:
+                raise ValueError("speculative_k must be in [2, 64], "
+                                 "got %d" % self.speculative_k)
+            if self.speculative_k + 2 > gen.max_len:
+                raise ValueError(
+                    "speculative_k %d leaves no room for any request "
+                    "at max_len %d (prompt+max_new+k must fit)"
+                    % (self.speculative_k, gen.max_len))
+            if gen._rolling:
+                raise ValueError("speculative ticks need linear KV "
+                                 "caches (rolling windows cannot "
+                                 "absorb the rejected-draft tail)")
         #: fuse K engine ticks into ONE device dispatch (lax.scan over
         #: the tick body) — the same host→device amortization as the
         #: trainer's fused sweep.  Admission then happens at K-token
@@ -1071,6 +1099,15 @@ class ContinuousBatcher:
             raise ValueError("prompt+max_new %d exceeds max_len %d"
                              % (len(prompt) + int(max_new),
                                 self.gen.max_len))
+        if self.speculative_k and (len(prompt) + int(max_new)
+                                   + self.speculative_k
+                                   > self.gen.max_len):
+            raise ValueError(
+                "speculative ticks draft %d positions past the "
+                "cursor: prompt+max_new+k %d exceeds max_len %d"
+                % (self.speculative_k,
+                   len(prompt) + int(max_new) + self.speculative_k,
+                   self.gen.max_len))
         n_bank = getattr(self.gen, "_n_adapters", 0)
         if not 0 <= int(adapter) <= n_bank:
             raise ValueError("adapter %d outside the loaded bank "
@@ -1329,6 +1366,105 @@ class ContinuousBatcher:
 
         return core
 
+    def _make_core_spec(self, draft_k):
+        """Speculative tick core (``speculative_k`` > 0, dense slot
+        pools): every active row drafts ``draft_k`` candidate tokens
+        from its own history (the n-gram rule of LMGenerator._spec_fn)
+        and verifies them in ONE chunk pass per tick, advancing by
+        1 + accepted instead of 1.
+
+        EXACT decode semantics, per row kind:
+        * greedy rows accept exactly the prefix of drafts that equal
+          the verify pass's own argmax — the accepted tokens ARE the
+          argmax chain, so outputs match the 1-token core token for
+          token;
+        * prompt positions auto-accept their own forced tokens (a
+          prefilling row fast-forwards through its prompt — same
+          tokens and cache writes, fewer ticks);
+        * sampled rows accept only forced prompt positions, then draw
+          their ONE new token from the chunk's logits at that position
+          with the identical (seed, position) key the 1-token core
+          would have used — bit-equal streams.
+
+        The chunk writes draft-conditioned K/V up to ``draft_k``
+        positions past a row's cursor; rejected-tail entries are
+        rewritten by a later chunk before any mask lets them be
+        attended (mha_chunk_step's contract).  submit() therefore
+        requires plen + max_new + draft_k <= max_len."""
+        gen = self.gen
+        kk = int(draft_k)
+        ll = gen.max_len
+        idx = jnp.arange(kk)
+
+        def row_spec(params, caches, row, pos, aid, seed, inv_temp,
+                     plen, total, active, *, do_draw):
+            params = gen._graft_adapters(params, aid)
+            c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
+            draft = _ngram_draft(row, pos, kk, ll)
+            # candidate positions are pos+1 .. pos+kk; submit()'s
+            # total + kk <= max_len bound keeps every slice in range
+            # (no clamping, so read/write windows always align)
+            in_prompt = (pos + 1 + idx) < plen
+            old = jax.lax.dynamic_slice(row, (pos + 1,), (kk,))
+            draft = jnp.where(in_prompt, old, draft)
+            cur_tok = jax.lax.dynamic_slice(row, (pos,), (1,))
+            chunk = jnp.concatenate([cur_tok, draft[:-1]])[None]
+            logits, c1 = gen._chunk_logits(params, c1, chunk, pos)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            sampled = inv_temp > 0.0
+            ok = in_prompt | (~sampled & (draft == g))
+            # first rejection = acceptance count; cap so the bonus
+            # position always has its own logits AND the row never
+            # writes past total - 1
+            a = jnp.minimum(jnp.argmin(jnp.concatenate(
+                [ok, jnp.zeros((1,), bool)])), kk - 1)
+            a = jnp.minimum(a, jnp.maximum(total - 2 - pos, 0))
+            if do_draw:
+                key = jax.random.fold_in(jax.random.key(seed),
+                                         pos + a)
+                draw = jax.random.categorical(
+                    key, logits[a] * inv_temp).astype(jnp.int32)
+                gen_tok = jnp.where(sampled, draw, jnp.take(g, a))
+            else:
+                gen_tok = jnp.take(g, a)
+            bonus = jnp.where(jnp.take(in_prompt, a),
+                              jnp.take(old, a), gen_tok)
+            newvec = jnp.where(idx < a, draft,
+                               jnp.where(idx == a, bonus, old))
+            # frozen rows write their own old values back (idempotent)
+            newvec = jnp.where(active & (idx <= a), newvec, old)
+            row = jax.lax.dynamic_update_slice(row, newvec, (pos + 1,))
+            adv = jnp.where(active, a + 1, 0)
+            return (row, jax.tree_util.tree_map(lambda x: x[0], c1),
+                    pos + adv)
+
+        axes = (None, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+        step_sampled = jax.vmap(functools.partial(row_spec,
+                                                  do_draw=True),
+                                in_axes=axes)
+        step_greedy = jax.vmap(functools.partial(row_spec,
+                                                 do_draw=False),
+                               in_axes=axes)
+
+        def core(params, st, aids):
+            (tokens, pos, plen, total, active, seeds, inv_temp,
+             caches) = st
+            args = (params, caches, tokens, pos, aids, seeds,
+                    inv_temp, plen, total, active)
+            # all-greedy pools (the serving default) skip the
+            # whole-vocab gumbel draws entirely — the 1-token core's
+            # own guard, kept here
+            tokens, caches, pos = jax.lax.cond(
+                jnp.any(inv_temp > 0.0),
+                lambda op: step_sampled(*op),
+                lambda op: step_greedy(*op), args)
+            active = active & (pos + 1 < total)
+            return (tokens, pos, plen, total, active, seeds,
+                    inv_temp, caches)
+
+        return core
+
     def _jit_ticks(self, tick_fn):
         """ticks_per_dispatch engine ticks fused into ONE jitted
         dispatch (lax.scan over ``tick_fn(params, state) -> state``),
@@ -1346,7 +1482,8 @@ class ContinuousBatcher:
 
     def _tick(self, st):
         if self._tick_fn is None:
-            core = self._make_core()
+            core = (self._make_core_spec(self.speculative_k)
+                    if self.speculative_k else self._make_core())
             self._tick_fn = self._jit_ticks(core)
         return self._tick_fn(self.gen.params, st, self._aids)
 
@@ -1392,7 +1529,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
                  chunked_prefill=True, block=16, pool_tokens=None,
-                 fused=True, prefix_cache=False):
+                 fused=True, prefix_cache=False, speculative_k=0):
+        if int(speculative_k):
+            raise ValueError(
+                "speculative ticks are dense-pool only (the chunk "
+                "verify would write draft K/V through the block "
+                "table) — use ContinuousBatcher(speculative_k=...)")
         super(PagedContinuousBatcher, self).__init__(
             gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
             chunked_prefill=chunked_prefill)

@@ -130,7 +130,7 @@ class ContinuousEngine(Logger):
     status table, ref web_status.py:113-200, applied to serving)."""
 
     def __init__(self, generator, slots=8, history=512, paged_block=0,
-                 pool_tokens=None, prefix_cache=False):
+                 pool_tokens=None, prefix_cache=False, speculative_k=0):
         super(ContinuousEngine, self).__init__()
         import collections
         from veles_tpu.models.generate import (ContinuousBatcher,
@@ -143,9 +143,11 @@ class ContinuousEngine(Logger):
         self.cb = (PagedContinuousBatcher(generator, slots=slots,
                                           block=paged_block,
                                           pool_tokens=pool_tokens,
-                                          prefix_cache=prefix_cache)
+                                          prefix_cache=prefix_cache,
+                                          speculative_k=speculative_k)
                    if paged_block else
-                   ContinuousBatcher(generator, slots=slots))
+                   ContinuousBatcher(generator, slots=slots,
+                                     speculative_k=speculative_k))
         #: guards _ingress / _records / _history / counters — NEVER
         #: held across a device dispatch
         self._lock = threading.Lock()
@@ -186,6 +188,14 @@ class ContinuousEngine(Logger):
         self.cb.gen.validate_request(
             len(prompt), {"max_new": int(max_new),
                           "temperature": float(temperature)})
+        spec_k = getattr(self.cb, "speculative_k", 0)
+        if spec_k and len(prompt) + int(max_new) + spec_k \
+                > self.cb.gen.max_len:
+            raise ValueError(
+                "speculative ticks draft %d positions past the "
+                "cursor: prompt+max_new+k %d exceeds max_len %d"
+                % (spec_k, len(prompt) + int(max_new) + spec_k,
+                   self.cb.gen.max_len))
         n_bank = getattr(self.cb.gen, "_n_adapters", 0)
         if not 0 <= int(adapter) <= n_bank:
             raise ValueError("adapter %d outside the loaded bank "
@@ -416,7 +426,8 @@ class RESTfulAPI(Logger):
     def __init__(self, forward, input_shape, host="127.0.0.1", port=8180,
                  path="/service", generator=None, batch_window=0.0,
                  max_batch=8, continuous_slots=0, paged_block=0,
-                 pool_tokens=None, prefix_cache=False):
+                 pool_tokens=None, prefix_cache=False,
+                 speculative_k=0):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -437,7 +448,8 @@ class RESTfulAPI(Logger):
         self.engine = (ContinuousEngine(generator, continuous_slots,
                                         paged_block=paged_block,
                                         pool_tokens=pool_tokens,
-                                        prefix_cache=prefix_cache)
+                                        prefix_cache=prefix_cache,
+                                        speculative_k=speculative_k)
                        if generator is not None and continuous_slots > 0
                        else None)
         self._server = None
