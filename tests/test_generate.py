@@ -1080,6 +1080,37 @@ class TestPrefixCache:
             np.asarray([pb], np.int32), 4)[0].tolist()
         assert cb.free_blocks() == free0
 
+    def test_matched_admission_skips_the_prefix_forward(
+            self, f32_precision):
+        """The compute-skip contract: a second same-prefix request must
+        admit through the RESUME path (chunk from the matched
+        boundary), never re-run the full prompt prefill — and still
+        produce the exact no-sharing stream (covered above; here we
+        pin WHICH path ran)."""
+        cb, gen, toks = self._mk()
+        prompt = toks[0, :9].tolist()
+        calls = {"full": 0, "resume": 0}
+        orig_full, orig_res = gen._prefill_fn, gen._prefill_resume_fn
+
+        def spy_full(*a, **k):
+            calls["full"] += 1
+            return orig_full(*a, **k)
+
+        def spy_res(*a, **k):
+            calls["resume"] += 1
+            return orig_res(*a, **k)
+
+        gen._prefill_fn, gen._prefill_resume_fn = spy_full, spy_res
+        try:
+            r1 = cb.submit(prompt, 3)
+            r2 = cb.submit(prompt, 3)
+            cb.run_all()
+        finally:
+            gen._prefill_fn, gen._prefill_resume_fn = (orig_full,
+                                                       orig_res)
+        assert calls == {"full": 1, "resume": 1}, calls
+        assert cb.pop_result(r1) == cb.pop_result(r2)
+
     def test_engine_exposes_prefix_gauges(self, f32_precision):
         from veles_tpu.services.restful import ContinuousEngine
         wf, toks = _lm_workflow(max_epochs=0)

@@ -585,9 +585,12 @@ class LMGenerator:
             row(greedy, jnp.bool_))
         return np.asarray(out)
 
-    def _chunk_logits(self, params, caches, toks, start):
-        """toks [1, K] at positions [start, start+K) → (logits [K, V]
-        f32, caches) — the speculative verify forward."""
+    def _chunk_forward(self, params, caches, toks, start):
+        """toks [1, K] at positions [start, start+K) through every
+        block's chunk_step against an existing cache → (x, caches).
+        THE one chunk-positioning contract — the speculative verify
+        (_chunk_logits) and the prefix-cache prefill resume
+        (_prefill_resume_fn) must never diverge on it."""
         x = self._embed_rows(params, toks)
         ptab = self._pos_table(params)
         if ptab is not None:
@@ -598,8 +601,33 @@ class LMGenerator:
             x, ck, cv = layer.chunk_step(params[layer.name], x, ck, cv,
                                          start)
             new_caches.append((ck, cv))
+        return x, new_caches
+
+    def _chunk_logits(self, params, caches, toks, start):
+        """toks [1, K] at positions [start, start+K) → (logits [K, V]
+        f32, caches) — the speculative verify forward."""
+        x, new_caches = self._chunk_forward(params, caches, toks,
+                                            start)
         return (self._ln_head(params, x)[0].astype(jnp.float32),
                 new_caches)
+
+    def _prefill_resume_fn(self, kb):
+        """ONE compile per resume-chunk bucket: positions
+        [start, start+kb) of a prompt run through every block's
+        chunk_step against an EXISTING cache (valid for [0, start)) —
+        chunked prefill that RESUMES from a prefix another request
+        already computed (the paged batcher's prefix-cache compute
+        skip).  Identical K/V math to a full prefill of the same
+        positions (chunk_step == K step() calls, the same contract the
+        speculative verify rides)."""
+        cached = self._cache_get(("presume", kb))
+        if cached is not None:
+            return cached
+
+        def run(params, caches, toks, start):
+            return self._chunk_forward(params, caches, toks, start)[1]
+
+        return self._cache_put(("presume", kb), jax.jit(run))
 
     def _spec_fn(self, draft_k):
         """ONE compile per draft width: the whole speculative greedy
@@ -1209,6 +1237,14 @@ class ContinuousBatcher:
         return self._results
 
     # ----------------------------------------------------------- internal
+    def _will_chunk(self, plen):
+        """Whether admission chunk-prefills this prompt — THE predicate
+        _prefill_row, _shareable_blocks, and the paged admit's
+        resume-vs-full decision all share (a drifted hand-copy would
+        let blocks register as shareable that the tick-by-tick path
+        fills progressively)."""
+        return self.chunked_prefill and plen >= 2
+
     def _prefill_row(self, prompt, plen, max_new, adapter=0):
         """Chunked-prefill admission: one parallel pass fills a [1, ...]
         cache row with the prompt and returns (cache_row, start_pos);
@@ -1218,7 +1254,7 @@ class ContinuousBatcher:
         ``adapter``: the prompt's K/V must be computed under the SAME
         adapter the decode will run (grafted params; id 0 = base)."""
         gen = self.gen
-        if self.chunked_prefill and plen >= 2:
+        if self._will_chunk(plen):
             tp, start, _ = gen._prefill_dispatch(plen, plen + max_new)
             chunk = np.zeros((tp,), np.int32)
             chunk[:min(plen, tp)] = prompt[:tp]
@@ -1589,6 +1625,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._prefix_reg = {}                # token-prefix -> block id
         self._prefix_ref = {}                # block id -> owner count
         self._block_key = {}                 # block id -> its reg key
+        self._resume_gather_fn = None        # jitted row gather (lazy)
         #: fused tick: attention reads the pool through the block table
         #: (ops.pallas.paged scalar-prefetch kernel) — no per-tick
         #: dense gather/scatter.  Auto-fallback to the gather tick for
@@ -1637,7 +1674,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         matching by later sharers, whose own writes start at their own
         plen - 1).  The tick-by-tick admission path writes every
         position from 0 and can share nothing."""
-        if not (self.chunked_prefill and plen >= 2):
+        if not self._will_chunk(plen):
             return 0
         return (plen - 1) // self.block
 
@@ -1710,15 +1747,14 @@ class PagedContinuousBatcher(ContinuousBatcher):
         plen = len(prompt)
         self._aids = self._aids.at[b].set(adapter)
         nb = self._blocks_needed(plen, max_new)
-        cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
-                                            adapter)
+        will_chunk = self._will_chunk(plen)
         matched = self._match_prefix(prompt, adapter)
-        # registerable = blocks the chunk prefill wrote COMPLETELY at
+        # registerable = blocks the chunk prefill writes COMPLETELY at
         # admit and that decode never touches (_shareable_blocks); the
-        # tick-by-tick path (cache_row None) fills blocks progressively
-        # — a later sharer could attend positions nobody has written
-        registerable = self._shareable_blocks(plen) \
-            if cache_row is not None else 0
+        # tick-by-tick path fills blocks progressively — a later
+        # sharer could attend positions nobody has written
+        registerable = self._shareable_blocks(plen) if will_chunk \
+            else 0
         ids, scatter_row, parent = [], [], 0
         for i in range(nb):
             if i < len(matched):
@@ -1745,6 +1781,20 @@ class PagedContinuousBatcher(ContinuousBatcher):
         table_row[:nb] = ids
         srow = np.zeros((self.max_blocks,), np.int32)
         srow[:nb] = scatter_row
+        if matched and will_chunk:
+            # prefix-cache COMPUTE skip: the matched blocks already
+            # hold positions [0, start) — resume the chunk prefill
+            # from there instead of re-running the whole prompt
+            # forward (the dominant admission cost for long shared
+            # system prompts).  The resume row gathers this row's
+            # table view (real prefix + dummies), chunk-steps
+            # [start, start+kb), and the admit scatter then stores
+            # only the NEW blocks (srow already diverts matched ones).
+            cache_row, pos0 = self._resume_row(prompt, plen, matched,
+                                               table_row, adapter)
+        else:
+            cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
+                                                adapter)
         if self._admit_fn is None:
             gen = self.gen
             bs, nbm = self.block, self.max_blocks
@@ -1806,6 +1856,39 @@ class PagedContinuousBatcher(ContinuousBatcher):
             st = self._admit_fn(*args, jnp.int32(pos0), cache_row)
         self._set_state(st)
         self._slot_req[b] = rid
+
+    def _resume_row(self, prompt, plen, matched, table_row, adapter):
+        """Build an admission cache row by RESUMING from the matched
+        prefix blocks: gather this row's table view into a dense
+        [1, ...] row (real K/V for positions [0, start), dummy-block
+        content elsewhere — rewritten below or masked until decode
+        overwrites it, the round-up-prefill argument), then chunk-step
+        positions [start, start+kb) under the request's adapter.
+        Returns (cache_row, plen - 1) — the same cursor the full
+        chunk prefill hands over at."""
+        gen = self.gen
+        bs, nbm = self.block, self.max_blocks
+        start = len(matched) * bs
+        kb = gen._bucket(plen - start, gen.max_len - start)
+        if self._resume_gather_fn is None:
+            def gather_row(pool, trow):
+                def one(pl):
+                    v = pl[trow]                 # [nbm, H, bs, *]
+                    v = jnp.moveaxis(v, 1, 0)    # [H, nbm, bs, *]
+                    return v.reshape(
+                        (1, v.shape[0], nbm * bs) + v.shape[3:])
+                return [tuple(jax.tree_util.tree_map(one, c)
+                              for c in layer)
+                        for layer in pool]
+            self._resume_gather_fn = jax.jit(gather_row)
+        caches = self._resume_gather_fn(self._pool,
+                                        jnp.asarray(table_row))
+        chunk = np.zeros((kb,), np.int32)
+        chunk[:min(plen - start, kb)] = prompt[start:start + kb]
+        params = gen._graft_adapters(gen.params, jnp.int32(adapter))
+        return gen._prefill_resume_fn(kb)(
+            params, caches, jnp.asarray(chunk[None]),
+            jnp.int32(start)), plen - 1
 
     # ------------------------------------------------------------- tick
     def _tick(self, st):
