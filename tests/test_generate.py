@@ -980,6 +980,31 @@ def test_stream_partials_progress_and_cleanup(f32_precision,
     assert cb.partial(rid) is None         # dropped at completion
 
 
+def test_engine_fused_dispatch_serves_identical_streams(f32_precision):
+    """ticks_per_dispatch>1 through the ENGINE (the remote-device
+    throughput knob), on BOTH batcher flavors: responses — buffered
+    AND streamed — must be identical to the per-token engine."""
+    from veles_tpu.services.restful import ContinuousEngine
+    wf, toks = _lm_workflow(max_epochs=8)
+    gen = LMGenerator(wf.trainer, max_len=16)
+    e1 = ContinuousEngine(gen, slots=2)
+    e4 = ContinuousEngine(gen, slots=2, ticks_per_dispatch=4)
+    e4p = ContinuousEngine(gen, slots=2, paged_block=4,
+                           pool_tokens=48, ticks_per_dispatch=4)
+    try:
+        p = toks[0, :4].tolist()
+        assert e4.cb.ticks_per_dispatch == 4      # dense wiring
+        assert e4p.cb.ticks_per_dispatch == 4     # paged wiring
+        a = list(map(int, e1.submit(p, 7)))
+        assert a == list(map(int, e4.submit(p, 7)))
+        assert a == list(map(int, e4p.submit(p, 7)))
+        sa = [c for ch in e1.stream(p, 7) for c in ch]
+        sb = [c for ch in e4.stream(p, 7) for c in ch]
+        assert sa == sb == a[len(p):]
+    finally:
+        e1.stop(); e4.stop(); e4p.stop()
+
+
 class TestPrefixCache:
     """Copy-on-write prefix sharing in the paged pool: concurrent
     requests with a common prompt prefix share its KV blocks.  The

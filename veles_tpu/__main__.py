@@ -1074,7 +1074,12 @@ class Main(object):
                          # speculative_k>0: n-gram speculative ticks in
                          # the dense slot pool (exact decode semantics)
                          speculative_k=int(root.common.serve.get(
-                             "speculative_k", 0)))
+                             "speculative_k", 0)),
+                         # K>1 fuses K engine ticks per device dispatch
+                         # (remote/tunneled devices: the round trip
+                         # dominates per-token cost)
+                         ticks_per_dispatch=int(root.common.serve.get(
+                             "ticks_per_dispatch", 1)))
         api.start()
         if getattr(self, "_web", None) is not None:
             # the dashboard's serving panel shows the slot pool's SLO

@@ -130,7 +130,8 @@ class ContinuousEngine(Logger):
     status table, ref web_status.py:113-200, applied to serving)."""
 
     def __init__(self, generator, slots=8, history=512, paged_block=0,
-                 pool_tokens=None, prefix_cache=False, speculative_k=0):
+                 pool_tokens=None, prefix_cache=False, speculative_k=0,
+                 ticks_per_dispatch=1):
         super(ContinuousEngine, self).__init__()
         import collections
         from veles_tpu.models.generate import (ContinuousBatcher,
@@ -140,14 +141,22 @@ class ContinuousEngine(Logger):
         #: pool exhaustion as well as slot exhaustion.  prefix_cache:
         #: concurrent requests sharing a prompt prefix share its KV
         #: blocks (copy-on-write — the system-prompt case)
-        self.cb = (PagedContinuousBatcher(generator, slots=slots,
-                                          block=paged_block,
-                                          pool_tokens=pool_tokens,
-                                          prefix_cache=prefix_cache,
-                                          speculative_k=speculative_k)
+        #: ticks_per_dispatch: fuse K engine ticks into one device
+        #: dispatch — on a remote/tunneled device the per-dispatch
+        #: round trip dominates per-token cost, so K ~ 8-32 multiplies
+        #: serving throughput (admission + streaming then happen at
+        #: K-token boundaries; token streams are unchanged)
+        self.cb = (PagedContinuousBatcher(
+                       generator, slots=slots, block=paged_block,
+                       pool_tokens=pool_tokens,
+                       prefix_cache=prefix_cache,
+                       speculative_k=speculative_k,
+                       ticks_per_dispatch=ticks_per_dispatch)
                    if paged_block else
-                   ContinuousBatcher(generator, slots=slots,
-                                     speculative_k=speculative_k))
+                   ContinuousBatcher(
+                       generator, slots=slots,
+                       speculative_k=speculative_k,
+                       ticks_per_dispatch=ticks_per_dispatch))
         #: guards _ingress / _records / _history / counters — NEVER
         #: held across a device dispatch
         self._lock = threading.Lock()
@@ -427,7 +436,7 @@ class RESTfulAPI(Logger):
                  path="/service", generator=None, batch_window=0.0,
                  max_batch=8, continuous_slots=0, paged_block=0,
                  pool_tokens=None, prefix_cache=False,
-                 speculative_k=0):
+                 speculative_k=0, ticks_per_dispatch=1):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -449,7 +458,9 @@ class RESTfulAPI(Logger):
                                         paged_block=paged_block,
                                         pool_tokens=pool_tokens,
                                         prefix_cache=prefix_cache,
-                                        speculative_k=speculative_k)
+                                        speculative_k=speculative_k,
+                                        ticks_per_dispatch=
+                                        ticks_per_dispatch)
                        if generator is not None and continuous_slots > 0
                        else None)
         self._server = None
