@@ -80,6 +80,12 @@ class Main(object):
         p.add_argument("--test", action="store_true",
                        help="skip training; run forward on the loader's "
                        "test/validation set")
+        p.add_argument("--lint", action="store_true",
+                       help="build the workflow, run the static "
+                       "analyzers (veles_tpu.analysis: graph linter + "
+                       "jit-staging auditor) and exit non-zero on "
+                       "error findings — no initialize(), no training, "
+                       "no XLA dispatch")
         p.add_argument("--result-file", default=None,
                        help="write gather_results() JSON here")
         p.add_argument("--export-dtype", default="float32",
@@ -234,6 +240,17 @@ class Main(object):
             jax.config.update(
                 "jax_platforms",
                 "cpu" if args.backend == "cpu" else args.backend)
+        elif args.lint:
+            # linting never needs an accelerator (same guard as the
+            # standalone veles-tpu-lint): module-level jax use in the
+            # workflow file must not lock chips on a shared host
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:  # noqa: BLE001 — backend already up
+                pass
         if args.random_seed is not None:
             prng.seed_all(args.random_seed)
         self._apply_config(args)
@@ -291,6 +308,15 @@ class Main(object):
                     kwargs.setdefault("snapshotter_config",
                                       {"interval": args.snapshot_every})
             self.workflow = cls(**kwargs)
+            if args.lint:
+                # static analysis never restores state: a snapshot
+                # import is heavy, side-effectful I/O (pickle executes
+                # code) that the --lint contract promises not to do
+                self._pending_snapshot = None
+                self._pending_warm_start = None
+                if web is not None:
+                    web.register(self.workflow)
+                return self.workflow
             snapshot = args.snapshot
             auto = snapshot == "auto"
             if auto:
@@ -336,6 +362,12 @@ class Main(object):
 
         def main(**kwargs):
             wf = self.workflow
+            if args.lint:
+                # static analysis only: skip initialize/run entirely (no
+                # XLA dispatch) — the lint itself happens after run()
+                # returns, so a workflow file that never calls main()
+                # still gets analyzed
+                return wf
             if args.death_probability:
                 wf.death_probability = args.death_probability
             launcher = self._make_launcher(args, wf)
@@ -432,6 +464,16 @@ class Main(object):
 
         wf_globals["run"](load, main)
         wf = self.workflow
+
+        if args.lint:
+            if wf is None:
+                raise SystemExit("%s never called load(WorkflowClass, "
+                                 "...) — nothing to lint" % args.workflow)
+            from veles_tpu.analysis import (format_findings, has_errors,
+                                            lint_workflow)
+            findings = lint_workflow(wf)
+            print(format_findings(findings))
+            return 1 if has_errors(findings) else 0
 
         if self._interactive_session is not None:
             try:

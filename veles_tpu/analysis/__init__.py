@@ -1,0 +1,39 @@
+"""veles_tpu.analysis — static workflow-graph linter + jit-staging auditor.
+
+Runs over a *constructed* (not initialized) Workflow: graph rules decide
+control/data-link correctness (graph_lint, VG...), the staging auditor
+abstractly traces staged step functions for host-sync and recompile
+hazards (staging, VJ...).  Surface: :func:`lint_workflow` in-process, the
+``veles-tpu-lint`` console script, and ``python -m veles_tpu ... --lint``.
+
+Rule catalog and severities: docs/static_analysis.md."""
+
+from veles_tpu.analysis.findings import (ERROR, INFO, SEVERITIES, WARNING,
+                                         Finding, format_findings,
+                                         has_errors, sort_findings)
+from veles_tpu.analysis.graph_lint import lint_graph
+from veles_tpu.analysis.staging import audit_step
+
+__all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
+           "format_findings", "has_errors", "sort_findings", "lint_graph",
+           "audit_step", "lint_workflow"]
+
+
+def lint_workflow(wf, staging=True):
+    """All analysis passes over ``wf``: every graph rule, plus the staging
+    audit of any unit exposing a ``lint_staging_spec()`` hook (e.g.
+    StagedTrainer after initialize()).  Returns sorted Findings."""
+    findings = lint_graph(wf)
+    if staging:
+        for unit in [wf] + list(wf.units):
+            hook = getattr(unit, "lint_staging_spec", None)
+            if not callable(hook):
+                continue
+            spec = hook()
+            if not spec:
+                continue  # unit has no staged step yet (pre-initialize)
+            findings.extend(audit_step(
+                spec["fn"], spec.get("args", ()),
+                carry_argnums=tuple(spec.get("carry_argnums", ())),
+                name=spec.get("name", getattr(unit, "name", "step"))))
+    return sort_findings(findings)

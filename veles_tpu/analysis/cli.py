@@ -1,0 +1,90 @@
+"""veles-tpu-lint — build a workflow file's graph and statically lint it.
+
+Honors the module contract (``run(load, main)``, ref __main__.py): the
+workflow file constructs its Workflow through ``load(...)``; ``main()``
+here is a no-op, so nothing is initialized, no XLA computation is
+dispatched, and no data is loaded beyond what construction itself does.
+Exit status: 0 = no error-severity findings, 1 = errors (2 = usage)."""
+
+import argparse
+import runpy
+import sys
+
+
+def build_workflow(workflow_path, config_path=None, config_list=()):
+    """Construct (but never initialize or run) the workflow a file
+    defines, applying config layering exactly like the training CLI."""
+    from veles_tpu.config import root
+    from veles_tpu.genetics.core import Range
+    if config_path:
+        scope = {"root": root, "Range": Range}
+        with open(config_path) as f:
+            exec(compile(f.read(), config_path, "exec"), scope)
+    for stmt in config_list:
+        exec(stmt, {"root": root, "Range": Range})
+
+    wf_globals = runpy.run_path(workflow_path, run_name="__veles__")
+    if "run" not in wf_globals:
+        raise SystemExit("%s does not define run(load, main)"
+                         % workflow_path)
+    built = {}
+
+    def load(cls, **kwargs):
+        built["wf"] = cls(**kwargs)
+        return built["wf"]
+
+    def main(**kwargs):
+        return built.get("wf")  # lint never initializes or runs
+
+    wf_globals["run"](load, main)
+    if "wf" not in built:
+        raise SystemExit("%s never called load(WorkflowClass, ...)"
+                         % workflow_path)
+    return built["wf"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-lint",
+        description="static workflow-graph linter + jit-staging auditor "
+                    "(rule catalog: docs/static_analysis.md)")
+    p.add_argument("workflow", help="workflow .py file defining "
+                   "run(load, main)")
+    p.add_argument("config", nargs="?", help="config .py file executed "
+                   "with `root` in scope")
+    p.add_argument("--config-list", nargs="*", default=[],
+                   help="inline config statements, e.g. "
+                   "'root.mnist.lr=0.1'")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-staging", action="store_true",
+                   help="graph rules only; skip the jit-staging audit "
+                   "hooks")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on warnings too")
+    args = p.parse_args(argv)
+
+    import os
+    # linting must never grab an accelerator: abstract tracing is
+    # backend-independent, and a lint in CI shares machines with jobs
+    # that do own the chips.  jax froze its env snapshot when this
+    # module's imports pulled it in, so set the live config too (the
+    # tests/conftest.py pattern); env covers any subprocesses
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized: too
+        pass           # late to repoint, construction won't dispatch
+
+    from veles_tpu.analysis import (WARNING, format_findings, has_errors,
+                                    lint_workflow)
+    wf = build_workflow(args.workflow, args.config, args.config_list)
+    findings = lint_workflow(wf, staging=not args.no_staging)
+    print(format_findings(findings, args.format))
+    failed = has_errors(findings) or (
+        args.strict and any(f.severity == WARNING for f in findings))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

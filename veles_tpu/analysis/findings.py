@@ -1,0 +1,61 @@
+"""Finding records emitted by the static analyzers.
+
+Every rule yields structured :class:`Finding` objects — rule id, severity,
+unit, message, fix hint — so the CLI can render text or JSON and CI can
+gate on severity without parsing prose.  Rule catalog: docs/static_analysis.md."""
+
+import dataclasses
+
+#: severities, most severe first (the order drives sorting and the
+#: exit-code gate: only ERROR findings fail `veles-tpu-lint` / `--lint`)
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, INFO)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str          #: catalog id, e.g. "VG001" (graph) / "VJ101" (jit)
+    severity: str      #: one of SEVERITIES
+    unit: str          #: offending unit's name, or "<workflow>" / "<step>"
+    message: str       #: one-line statement of the defect
+    hint: str = ""     #: how to fix it
+
+    def __str__(self):
+        s = "[%s %s] %s: %s" % (self.rule, self.severity, self.unit,
+                                self.message)
+        if self.hint:
+            s += "\n    hint: %s" % self.hint
+        return s
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+def sort_findings(findings):
+    """Most severe first, then by rule id, then unit — a stable order for
+    humans and golden tests alike."""
+    rank = {s: i for i, s in enumerate(SEVERITIES)}
+    return sorted(findings,
+                  key=lambda f: (rank.get(f.severity, len(SEVERITIES)),
+                                 f.rule, f.unit))
+
+
+def has_errors(findings):
+    return any(f.severity == ERROR for f in findings)
+
+
+def format_findings(findings, fmt="text"):
+    findings = sort_findings(findings)
+    if fmt == "json":
+        import json
+        return json.dumps([f.as_dict() for f in findings], indent=2)
+    if not findings:
+        return "no findings"
+    counts = {}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    tally = ", ".join("%d %s%s" % (n, s, "s" if n != 1 else "")
+                      for s in SEVERITIES for n in [counts.get(s, 0)] if n)
+    return "\n".join(str(f) for f in findings) + "\n-- %s" % tally

@@ -1,0 +1,465 @@
+"""Static rules over a constructed Workflow's control/data graph.
+
+The reference Veles guarded gate deadlocks and dangling links at *runtime*
+(locks + deadlock watchdog, SURVEY §5); our single-threaded queue scheduler
+deleted that surface, which moved the same failure modes to
+graph-construction time — where they are statically decidable.  Every rule
+here runs on a workflow that has been *constructed but not initialized*:
+no ``initialize()``, no XLA dispatch, no data touched.
+
+Rule catalog (docs/static_analysis.md):
+
+========  ========  =====================================================
+VG001     error     control cycle with no loop closer (Repeater /
+                    ``ignores_gate``) — the gates inside the cycle can
+                    never all fire for the first iteration
+VG002     warning   unit with control links but unreachable from
+                    ``start_point`` (info when the unit has no control
+                    links at all — a passive introspection handle)
+VG003     error     gate deadlock: a unit waits on an unreachable
+                    predecessor, or its ``gate_block`` is constant-true
+VG004     error     dangling data link: ``link_attrs`` source unit is not
+                    (or no longer) in the workflow
+VG005     error     one-way data-link write hazard: unit code assigns an
+                    attribute that is linked one-way (raises at runtime)
+VG006     error     unsatisfiable ``demand()``: not linked, unset, and no
+                    unit code in the workflow ever assigns it
+========  ========  =====================================================
+"""
+
+import ast
+import inspect
+import itertools
+import textwrap
+
+from veles_tpu.analysis.findings import ERROR, INFO, WARNING, Finding
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+#: gate slots excluded from the "named Bool" registry — a Bool that only
+#: ever appears as a gate is anonymous to the rest of the program and
+#: nothing can flip it at runtime
+_GATE_SLOTS = ("gate_block", "gate_skip", "ignores_gate")
+
+#: give up on truth-table enumeration beyond this many mutable leaves
+#: (2^10 evaluations) and conservatively assume the gate can open
+_MAX_ENUM_LEAVES = 10
+
+
+def lint_graph(wf):
+    """Run every graph rule over ``wf``; returns a list of Findings."""
+    findings = []
+    findings.extend(_rule_cycles(wf))
+    findings.extend(_rule_unreachable(wf))
+    findings.extend(_rule_gate_deadlock(wf))
+    findings.extend(_rule_dangling_links(wf))
+    findings.extend(_rule_one_way_writes(wf))
+    findings.extend(_rule_demands(wf))
+    return findings
+
+
+# --------------------------------------------------------------- gate truth
+def _bool_registry(wf):
+    """ids of every Bool the program can plausibly flip at runtime: Bools
+    held as non-gate attributes of the workflow or any unit, plus Bools
+    captured in closure cells of unit methods (the local completion-flag
+    idiom).  A gate whose leaves all fall OUTSIDE this registry is a
+    construction-time constant."""
+    reg = set()
+    objs = [wf] + list(wf.units)
+    for obj in objs:
+        for k, v in vars(obj).items():
+            if k in _GATE_SLOTS:
+                continue
+            if isinstance(v, Bool):
+                reg.add(id(v))
+    for obj in objs:
+        for cls in type(obj).__mro__:
+            for v in vars(cls).values():
+                fn = getattr(v, "__func__", v)
+                code = getattr(fn, "__code__", None)
+                if code is None:
+                    continue
+                for cell in getattr(fn, "__closure__", None) or ():
+                    try:
+                        if isinstance(cell.cell_contents, Bool):
+                            reg.add(id(cell.cell_contents))
+                    except ValueError:
+                        pass  # empty cell
+                # module-level flag idiom: a method flipping a Bool held
+                # as a global of its defining module
+                mod_globals = getattr(fn, "__globals__", None) or {}
+                for name in code.co_names:
+                    if isinstance(mod_globals.get(name), Bool):
+                        reg.add(id(mod_globals[name]))
+    # cross-unit gate surgery idiom: some unit's RUNTIME code (outside
+    # __init__) writes a gate slot (`x.gate_block <<= ...` /
+    # `x.gate_block.set(...)`).  The AST can't resolve WHICH unit's gate,
+    # so every gate Bool becomes flippable — conservative: the
+    # constant-true rule then stays silent rather than flag a gate the
+    # program provably manipulates at runtime.
+    if any(set(_GATE_SLOTS) & _scan_writes(obj).runtime_writes
+           for obj in objs):
+        for obj in objs:
+            for slot in _GATE_SLOTS:
+                gate = vars(obj).get(slot)
+                if isinstance(gate, Bool):
+                    for leaf in gate.leaves():
+                        reg.add(id(leaf))
+    return reg
+
+
+def _statically_true(gate, registry):
+    """True when ``gate`` evaluates True now and no runtime assignment can
+    make it False: a plain truthy non-Bool, an anonymous value Bool, or a
+    derived Bool that stays True under every assignment of its mutable
+    (registry-listed) leaves."""
+    if not isinstance(gate, Bool):
+        return bool(gate)
+    if not bool(gate):
+        return False
+    leaves = gate.leaves()
+    if gate.derived and not leaves and not gate.operands:
+        # bare-lambda Bool with no structural metadata: opaque — assume
+        # the program knows how to open it
+        return False
+    mutable = [l for l in leaves if id(l) in registry]
+    if not mutable:
+        return True
+    if len(mutable) > _MAX_ENUM_LEAVES:
+        return False
+    saved = [l._value for l in mutable]
+    try:
+        for assignment in itertools.product((False, True),
+                                            repeat=len(mutable)):
+            for leaf, value in zip(mutable, assignment):
+                leaf._value = value
+            if not bool(gate):
+                return False
+        return True  # tautology over every mutable leaf
+    finally:
+        for leaf, value in zip(mutable, saved):
+            leaf._value = value
+
+
+def _gate_expr(gate):
+    return gate.expression() if isinstance(gate, Bool) else repr(gate)
+
+
+# -------------------------------------------------------------- VG001 cycles
+def _rule_cycles(wf):
+    """Tarjan SCC over control links; a non-trivial SCC with no statically
+    open ``ignores_gate`` member deadlocks on its own first iteration."""
+    nodes = list(wf.units)
+    node_set = set(nodes)
+    index = {}
+    lowlink = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = itertools.count()
+
+    def strongconnect(v):
+        # iterative Tarjan: unit graphs can be deep chains
+        work = [(v, iter([d for d in v.links_to if d in node_set]))]
+        index[v] = lowlink[v] = next(counter)
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = lowlink[w] = next(counter)
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append(
+                        (w, iter([d for d in w.links_to if d in node_set])))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    lowlink[node] = min(lowlink[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w is node:
+                        break
+                sccs.append(scc)
+
+    for v in nodes:
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for scc in sccs:
+        trivial = len(scc) == 1 and scc[0] not in scc[0].links_to
+        if trivial:
+            continue
+        if any(bool(u.ignores_gate) for u in scc):
+            continue  # a Repeater-style closer re-enters the loop
+        names = ", ".join(sorted(u.name for u in scc))
+        findings.append(Finding(
+            "VG001", ERROR, names,
+            "control cycle with no loop closer — every unit in the cycle "
+            "waits for all predecessors, so none can fire first",
+            hint="route the loop through a plumbing.Repeater (or set "
+                 "ignores_gate on the unit that re-enters the cycle)"))
+    return findings
+
+
+# -------------------------------------------------------- VG002 reachability
+def _rule_unreachable(wf):
+    reachable = set(wf.control_reachable())
+    findings = []
+    for u in wf.units:
+        if u in reachable:
+            continue
+        if not u.links_from and not u.links_to:
+            findings.append(Finding(
+                "VG002", INFO, u.name,
+                "passive unit: no control links at all (never scheduled; "
+                "fine for introspection handles)",
+                hint="link_from(...) it if it was meant to run"))
+        else:
+            findings.append(Finding(
+                "VG002", WARNING, u.name,
+                "has control links but is unreachable from start_point — "
+                "it will never run",
+                hint="connect it (transitively) to "
+                     "workflow.start_point via link_from"))
+    return findings
+
+
+# ------------------------------------------------------- VG003 gate deadlock
+def _rule_gate_deadlock(wf):
+    reachable = set(wf.control_reachable())
+    registry = _bool_registry(wf)
+    findings = []
+    for u in wf.units:
+        if u in reachable and _statically_true(u.gate_block, registry):
+            findings.append(Finding(
+                "VG003", ERROR, u.name,
+                "gate_block is constant-true (%s): the unit never runs "
+                "and never propagates — every non-ignores_gate successor "
+                "deadlocks" % _gate_expr(u.gate_block),
+                hint="gate on a Bool some unit actually flips (e.g. "
+                     "decision.complete), or drop the gate"))
+        if u not in reachable or bool(u.ignores_gate):
+            continue
+        dead = sorted(p.name for p in u.links_from if p not in reachable)
+        if dead:
+            findings.append(Finding(
+                "VG003", ERROR, u.name,
+                "waits on unreachable predecessor(s) %s — its gate can "
+                "never fully open" % ", ".join(dead),
+                hint="make the predecessor reachable from start_point, "
+                     "unlink it, or set ignores_gate on this unit"))
+    return findings
+
+
+# ------------------------------------------------------ VG004 dangling links
+def _rule_dangling_links(wf):
+    members = set(wf.units) | {wf}
+    findings = []
+    for u in [wf] + list(wf.units):
+        for mine, (src, theirs, _two_way) in u.linked_attrs.items():
+            if src in members:
+                continue
+            findings.append(Finding(
+                "VG004", ERROR, u.name,
+                "data link %r reads %s.%s, but that unit is not in the "
+                "workflow (del_ref'd, unlinked, or never added)"
+                % (mine, getattr(src, "name", src), theirs),
+                hint="re-link the attribute to a live unit, or "
+                     "unlink_attrs(%r) if the link is obsolete" % mine))
+    return findings
+
+
+# ------------------------------------------------------------ source scanning
+#: mro scanning stops at the framework core: its only non-construction
+#: gate write is Workflow.change_unit's splice, which must not read as
+#: "user code flips gates at runtime"
+_FRAMEWORK_CORE = ("veles_tpu.units", "veles_tpu.workflow",
+                   "veles_tpu.plumbing")
+
+
+def _class_sources(unit):
+    """(class, dedented source) for every user class in the unit's mro —
+    framework bases (Unit/Container/Workflow and above) are trusted."""
+    out = []
+    for cls in type(unit).__mro__:
+        if cls in (Unit,) or issubclass(Unit, cls) \
+                or cls.__module__ in _FRAMEWORK_CORE:
+            break
+        try:
+            out.append((cls, textwrap.dedent(inspect.getsource(cls))))
+        except (OSError, TypeError):
+            pass  # dynamically created class: no source to scan
+    return out
+
+
+class _AttrWrites(ast.NodeVisitor):
+    """Collect attribute names assigned anywhere (``x.attr = ...`` and
+    augmented forms), the subset assigned specifically on ``self``
+    outside ``__init__``, attribute names written on ANY object outside
+    ``__init__`` (including ``x.attr <<= ...`` and ``x.attr.set(...)`` —
+    the runtime gate-flip idioms), and whether ``setattr`` is called."""
+
+    def __init__(self):
+        self.all_writes = set()
+        self.self_writes = {}  # attr -> (method, lineno)
+        self.runtime_writes = set()
+        self.uses_setattr = False
+        self._method = None
+
+    def visit_FunctionDef(self, node):
+        prev, self._method = self._method, node.name
+        self.generic_visit(node)
+        self._method = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @property
+    def _in_ctor(self):
+        return self._method in ("__init__", "__new__")
+
+    def _target(self, t):
+        if isinstance(t, ast.Attribute):
+            self.all_writes.add(t.attr)
+            if not self._in_ctor:
+                self.runtime_writes.add(t.attr)
+                if isinstance(t.value, ast.Name) and t.value.id == "self":
+                    self.self_writes.setdefault(
+                        t.attr, (self._method, t.lineno))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._target(item.optional_vars)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id == "setattr":
+            self.uses_setattr = True
+        if not self._in_ctor and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set" \
+                and isinstance(node.func.value, ast.Attribute):
+            # x.attr.set(...) — Bool mutation through an attribute
+            self.runtime_writes.add(node.func.value.attr)
+        self.generic_visit(node)
+
+
+def _scan_writes(unit, _cache={}):
+    """Merged _AttrWrites over every user class in the unit's mro."""
+    merged = _AttrWrites()
+    for cls, src in _class_sources(unit):
+        if cls not in _cache:
+            scanner = _AttrWrites()
+            try:
+                scanner.visit(ast.parse(src))
+            except SyntaxError:
+                pass
+            _cache[cls] = scanner
+        s = _cache[cls]
+        merged.all_writes |= s.all_writes
+        merged.runtime_writes |= s.runtime_writes
+        merged.uses_setattr |= s.uses_setattr
+        for attr, where in s.self_writes.items():
+            merged.self_writes.setdefault(attr, where)
+    return merged
+
+
+# ----------------------------------------------------- VG005 one-way writes
+def _rule_one_way_writes(wf):
+    findings = []
+    for u in wf.units:
+        one_way = [mine for mine, (_s, _t, two_way)
+                   in u.linked_attrs.items() if not two_way]
+        if not one_way:
+            continue
+        writes = _scan_writes(u).self_writes
+        for mine in one_way:
+            if mine not in writes:
+                continue
+            method, lineno = writes[mine]
+            src, theirs, _ = u.linked_attrs[mine]
+            findings.append(Finding(
+                "VG005", ERROR, u.name,
+                "%s.%s() assigns self.%s (line +%d), but the attribute "
+                "is linked ONE-WAY from %s.%s — the write raises "
+                "AttributeError at runtime"
+                % (type(u).__name__, method, mine, lineno,
+                   getattr(src, "name", src), theirs),
+                hint="link with two_way=True if the unit must write "
+                     "back, or write to a differently named attribute"))
+    return findings
+
+
+# ---------------------------------------------------------- VG006 demands
+def _rule_demands(wf):
+    findings = []
+    # the workflow is itself a Unit: its initialize() may assign demanded
+    # attributes (and it can hold demands of its own) — scan it too
+    all_units = [wf] + list(wf.units)
+    # union of attribute names any unit code in this workflow ever
+    # assigns — computed lazily, only when some demand is actually open
+    assigned = None
+    uses_setattr = False
+    for u in all_units:
+        open_demands = [n for n in u._demanded_
+                        if n not in u._linked_attrs_
+                        and getattr(u, n, None) is None]
+        if not open_demands:
+            continue
+        if assigned is None:
+            assigned = set()
+            for other in all_units:
+                scan = _scan_writes(other)
+                assigned |= scan.all_writes
+                uses_setattr |= scan.uses_setattr
+        if uses_setattr:
+            return findings  # dynamic assignment: cannot decide statically
+        for n in open_demands:
+            if n in assigned:
+                continue  # some unit's initialize()/run() may provide it
+            findings.append(Finding(
+                "VG006", ERROR, u.name,
+                "demand(%r) can never be satisfied: no data link, the "
+                "attribute is unset, and no unit code in the workflow "
+                "assigns it — initialize() would deadlock requeueing" % n,
+                hint="link_attrs the producer, set the attribute before "
+                     "initialize(), or drop the demand"))
+    return findings

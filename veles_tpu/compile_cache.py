@@ -101,6 +101,17 @@ def enable(path=None):
             jax.config.update(opt, val)
         except (AttributeError, ValueError):
             pass
+    # jax latches its cache singleton (and a cache-unused verdict) at the
+    # process's FIRST compile; enabling — or re-pointing — after any
+    # compile has happened would otherwise be a silent no-op.  Reset the
+    # latch so the next compile re-initializes against the new directory.
+    try:
+        from jax._src import compilation_cache as _jax_cc
+        if getattr(_jax_cc, "_cache_initialized", False) \
+                or getattr(_jax_cc, "_cache_checked", False):
+            _jax_cc.reset_cache()
+    except Exception:  # noqa: BLE001 — internals moved: stay best-effort
+        pass
     _enabled_dir = path
     return path
 

@@ -612,6 +612,34 @@ class StagedTrainer(Unit):
                 "count": int(st["count"])}
 
     # ---------------------------------------------------------- inspection
+    def lint_staging_spec(self):
+        """Staging spec for the jit auditor (veles_tpu.analysis.staging):
+        the jitted eval step traced over abstract ShapeDtypeStruct inputs
+        — no device compute, no allocation.  None before initialize()
+        has built the steps (the graph linter still runs construction-
+        time), and None under a mesh (the pjit sharding constraints
+        don't trace over bare abstract values)."""
+        step = getattr(self, "_eval_step", None)
+        if step is None or self.mesh_config is not None \
+                or self.loader.carries_data:
+            return None
+
+        def abstract(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.result_type(a)), tree)
+
+        mb = self.loader.minibatch_size
+        args = (abstract(self.params), abstract(self.class_stats[0]),
+                abstract(self._data_dev), abstract(self._labels_dev),
+                abstract(self._targets_dev),
+                jax.ShapeDtypeStruct((mb,), jnp.int32),
+                jax.ShapeDtypeStruct((mb,), jnp.float32))
+        # the accumulator (argnum 1) is the step's carry: its output
+        # avals must match or every scheduler iteration recompiles
+        return {"fn": step, "args": args, "carry_argnums": (1,),
+                "name": "%s.eval_step" % self.name}
+
     def host_params(self):
         """Full parameter pytree on the host.  Multi-host safe: tensors
         sharded across processes (non-addressable shards) are gathered

@@ -8,12 +8,17 @@ control, never traced into XLA."""
 
 
 class Bool(object):
-    __slots__ = ("_value", "_expr", "_name")
+    __slots__ = ("_value", "_expr", "_name", "_operands")
 
-    def __init__(self, value=False, _expr=None, _name=None):
+    def __init__(self, value=False, _expr=None, _name=None, _operands=()):
         self._value = bool(value)
         self._expr = _expr       # callable() -> bool, for derived Bools
-        self._name = _name
+        self._name = _name       # operator symbol for derived Bools
+        #: structural metadata: the source operands of a derived Bool
+        #: (Bools or plain truth values).  Lets static analysis (the
+        #: workflow linter, veles_tpu.analysis) see through derived
+        #: gates instead of hitting an opaque lambda.
+        self._operands = _operands
 
     # -- assignment ----------------------------------------------------------
     def __ilshift__(self, value):
@@ -36,20 +41,81 @@ class Bool(object):
 
     # -- lazy composition ----------------------------------------------------
     def __and__(self, other):
-        return Bool(_expr=lambda: bool(self) and bool(other), _name="&")
+        return Bool(_expr=lambda: bool(self) and bool(other), _name="&",
+                    _operands=(self, other))
 
     def __or__(self, other):
-        return Bool(_expr=lambda: bool(self) or bool(other), _name="|")
+        return Bool(_expr=lambda: bool(self) or bool(other), _name="|",
+                    _operands=(self, other))
 
     def __xor__(self, other):
-        return Bool(_expr=lambda: bool(self) != bool(other), _name="^")
+        return Bool(_expr=lambda: bool(self) != bool(other), _name="^",
+                    _operands=(self, other))
 
     def __invert__(self):
-        return Bool(_expr=lambda: not bool(self), _name="~")
+        return Bool(_expr=lambda: not bool(self), _name="~",
+                    _operands=(self,))
+
+    # -- structural inspection (consumed by veles_tpu.analysis) --------------
+    @property
+    def derived(self):
+        """True for expression Bools (``a & ~b``), False for value cells."""
+        return self._expr is not None
+
+    @property
+    def op(self):
+        """Operator symbol of a derived Bool (``&``/``|``/``^``/``~``),
+        None for value cells."""
+        return self._name if self._expr is not None else None
+
+    @property
+    def operands(self):
+        """Source operands of a derived Bool (empty for value cells)."""
+        return self._operands
+
+    def leaves(self):
+        """All distinct value-cell Bools this expression is rooted in (the
+        Bool itself for a value cell).  A leaf shared between operands
+        (``a | ~a``) appears once — it is one variable, not two.  Non-Bool
+        operands are skipped — they are immutable truth constants as far
+        as the expression goes."""
+        if self._expr is None:
+            return [self]
+        out = []
+        seen = set()
+        for op in self._operands:
+            if not isinstance(op, Bool):
+                continue
+            for leaf in op.leaves():
+                if id(leaf) not in seen:
+                    seen.add(id(leaf))
+                    out.append(leaf)
+        return out
+
+    def expression(self):
+        """Human-readable structural rendering of the gate expression,
+        e.g. ``(complete & ~epoch_ended)`` rendered with current leaf
+        truth values: ``(False & ~True)``."""
+        if self._expr is None:
+            return str(self._value)
+
+        def render(op):
+            return op.expression() if isinstance(op, Bool) \
+                else str(bool(op))
+
+        if self._name == "~" and len(self._operands) == 1:
+            return "~%s" % render(self._operands[0])
+        if len(self._operands) == 2:
+            return "(%s %s %s)" % (render(self._operands[0]), self._name,
+                                   render(self._operands[1]))
+        # derived Bool constructed directly with a bare _expr (no
+        # structural metadata) — all we can show is the operator tag
+        return "<%s>" % (self._name or "expr")
 
     def __repr__(self):
-        kind = "derived(%s)" % self._name if self._expr else "value"
-        return "<Bool %s = %s>" % (kind, bool(self))
+        if self._expr is not None:
+            return "<Bool %s = %s>" % (self.expression(), bool(self))
+        return "<Bool value = %s>" % bool(self)
 
 
 class LinkableAttribute(object):

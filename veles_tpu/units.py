@@ -74,6 +74,10 @@ class Unit(Logger, metaclass=UnitRegistry):
         return self
 
     def unlink_all(self):
+        # the pops/discards inside unlink_from are unconditional, so even
+        # one-sided entries left by direct links_from/links_to surgery
+        # come out — both tables are empty afterwards (the postcondition
+        # the analysis linter's dangling-link rule relies on)
         for u in list(self.links_from):
             self.unlink_from(u)
         for u in list(self.links_to):
@@ -96,6 +100,21 @@ class Unit(Logger, metaclass=UnitRegistry):
             self.links_from[u] = False
 
     # ----------------------------------------------------------- data links
+    @property
+    def linked_attrs(self):
+        """Read-only view of the data-link table:
+        ``{my_name: (source_unit, source_name, two_way)}``.  Introspection
+        surface for the static analyzer (veles_tpu.analysis) — mutate via
+        :meth:`link_attrs` / :meth:`unlink_attrs`, never through this."""
+        return dict(self._linked_attrs_)
+
+    def unlink_attrs(self, *names):
+        """Drop data links by local name (all of them when called with no
+        names).  The inverse of :meth:`link_attrs`."""
+        for name in (names or list(self._linked_attrs_)):
+            self._linked_attrs_.pop(name, None)
+        return self
+
     def link_attrs(self, other, *names, two_way=False):
         """Forward attributes from ``other`` (ref units.py:638).
 

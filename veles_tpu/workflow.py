@@ -14,6 +14,8 @@ loop contains."""
 
 import collections
 import json
+import os
+import random
 import time
 
 from veles_tpu.logger import Logger
@@ -70,7 +72,15 @@ class Workflow(Container):
     def del_ref(self, unit):
         if unit in self._units:
             self._units.remove(unit)
-            self._by_name[unit.name].remove(unit)
+            bucket = self._by_name.get(unit.name)
+            if bucket is not None and unit in bucket:
+                bucket.remove(unit)
+                if not bucket:
+                    # defaultdict: an empty leftover bucket would keep
+                    # the name visible to iteration/membership and make
+                    # the analyzer's dangling-link rule lie about what
+                    # is still in the workflow
+                    del self._by_name[unit.name]
 
     @property
     def units(self):
@@ -112,12 +122,15 @@ class Workflow(Container):
                 passes_without_progress += 1
         self._initialized = True
 
-    def _dependency_order(self):
-        """BFS from start_point over control links, then any unreached units
-        in insertion order."""
+    def control_reachable(self, start=None):
+        """Units reachable from ``start`` (default ``start_point``) over
+        control links, in BFS order.  Introspection hook shared by the
+        scheduler's dependency ordering and the static analyzer
+        (veles_tpu.analysis.graph_lint)."""
         seen = []
         seen_set = set()
-        queue = collections.deque([self.start_point])
+        queue = collections.deque(
+            [start if start is not None else self.start_point])
         while queue:
             unit = queue.popleft()
             if unit in seen_set:
@@ -127,6 +140,13 @@ class Workflow(Container):
             for dst in unit.links_to:
                 if dst not in seen_set:
                     queue.append(dst)
+        return seen
+
+    def _dependency_order(self):
+        """BFS from start_point over control links, then any unreached units
+        in insertion order."""
+        seen = self.control_reachable()
+        seen_set = set(seen)
         for unit in self._units:
             if unit not in seen_set:
                 seen.append(unit)
@@ -164,8 +184,6 @@ class Workflow(Container):
             unit = queue.popleft()
             queued.discard(unit)
             if self.death_probability:
-                import os
-                import random
                 if random.random() < self.death_probability:
                     self.warning("fault injection: simulated crash "
                                  "(death_probability=%.3f)",
