@@ -408,6 +408,27 @@ class TestStagingAuditor:
         fs = audit_step(step, (jnp.ones((2,)),))
         assert "VJ100" in rules(errors(fs))
 
+    def test_iter_primitives_recurses_into_dict_params(self):
+        """Satellite: a nested jaxpr stashed in a DICT-valued eqn.params
+        (keyed branch/function tables) must not hide from VJ101."""
+        import jax
+        import jax.numpy as jnp
+        from types import SimpleNamespace
+
+        from veles_tpu.analysis.staging import iter_primitives
+
+        def leaky(x):
+            jax.debug.print("x={}", x)
+            return x
+
+        inner = jax.make_jaxpr(leaky)(jnp.zeros(()))
+        fake_eqn = SimpleNamespace(
+            primitive=SimpleNamespace(name="fake_call"),
+            params={"funs": {"branch_a": inner}})
+        fake_jaxpr = SimpleNamespace(eqns=[fake_eqn])
+        names = {n for n, _ in iter_primitives(fake_jaxpr)}
+        assert "debug_callback" in names
+
     def test_lint_workflow_consumes_staging_hook(self):
         """lint_workflow pulls a unit's lint_staging_spec() and audits the
         staged step it describes (StagedTrainer exposes the same hook
@@ -531,6 +552,31 @@ class TestCLI:
         wf_file.write_text(CYCLIC_WF)
         assert main([str(wf_file)]) == 1
         assert "VG001" in capsys.readouterr().out
+
+    def test_fail_on_warning_threshold(self, tmp_path, capsys):
+        """Satellite: --fail-on warning exits non-zero on warning-only
+        findings (the CI gate knob); the default (error) stays 0."""
+        from veles_tpu.analysis.cli import main
+        wf_file = tmp_path / "warn_wf.py"
+        # an unreachable-but-linked unit: VG002 warning, no errors
+        wf_file.write_text('''
+from veles_tpu.units import TrivialUnit
+from veles_tpu.workflow import Workflow
+
+def run(load, main):
+    wf = load(Workflow, name="warny")
+    a = TrivialUnit(wf, name="a")
+    orphan = TrivialUnit(wf, name="orphan")
+    sink = TrivialUnit(wf, name="sink")
+    a.link_from(wf.start_point)
+    sink.link_from(orphan)
+    wf.end_point.link_from(a)
+    main()
+''')
+        assert main([str(wf_file)]) == 0
+        assert main([str(wf_file), "--fail-on", "warning"]) == 1
+        assert main([str(wf_file), "--strict"]) == 1   # legacy alias
+        assert "VG002" in capsys.readouterr().out
 
     def test_lint_clean_sample_digits_mlp(self, capsys):
         """Acceptance gate: `veles-tpu-lint samples/digits_mlp.py` exits 0

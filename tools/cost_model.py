@@ -63,8 +63,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # SAME objects bench.py uses — predicted-vs-measured stays comparable
 from veles_tpu.ops.flops import (  # noqa: E402
     LM_LARGE_LADDER as _BENCH_LADDER, causal_attn_flops as
-    _causal_attn_flops, lm_train_flops_per_token as
-    _lm_train_flops_per_token)
+    _causal_attn_flops, dtype_nbytes as _dtype_nbytes,
+    lm_train_flops_per_token as _lm_train_flops_per_token)
+
+# byte-per-element pricing rides the same table the sharding/memory
+# auditor (analysis/sharding_audit) uses — the two accountings cannot
+# silently diverge
+_BF16 = _dtype_nbytes("bfloat16")
+_F32 = _dtype_nbytes("float32")
 
 # ---------------------------------------------------------------------------
 # Device model (v5e unless overridden)
@@ -206,7 +212,7 @@ def predict_mlp():
     b, i, h, o = 100, 784, 100, 10
     compute = 3 * (t_matmul(b, i, h) + t_matmul(b, h, o))
     params = i * h + h * o + h + o
-    opt_bytes = params * 4 * 5        # w rd/wr, m rd/wr, grad rd (f32)
+    opt_bytes = params * _F32 * 5     # w rd/wr, m rd/wr, grad rd (f32)
     dev = max(compute, t_hbm(opt_bytes)) + 22 * T_KERNEL
     step = dev + H_STEP
     return {"step_ms": (step + T_DISPATCH) * 1e3,
@@ -240,10 +246,10 @@ def predict_alexnet(batch=256):
         t += 3 * t_matmul(batch, fi, fo)
     params = sum(cin * cout * k * k for _, _, cin, cout, k, _, _
                  in _ALEXNET_CONVS) + sum(a * b for a, b in _ALEXNET_FCS)
-    t += t_hbm(params * 20)                        # sgd-momentum f32
+    t += t_hbm(params * _F32 * 5)                  # sgd-momentum f32
     # LRN (2 sites, window-5 cross-channel) + pools + relu grads: ~6
     # passes over the big early activations, bf16
-    t += t_hbm(batch * act_elts * 2 * 6)
+    t += t_hbm(batch * act_elts * _BF16 * 6)
     t += 80 * T_KERNEL + H_STEP + T_DISPATCH / 10  # ~80 kernels/step
     return {"samples_per_sec": batch / t}
 
@@ -322,7 +328,7 @@ def predict_flash():
     def naive_ms(b, h, t, d):
         fl = _causal_attn_flops(b, h, t, d)
         mm = fl / (PEAK_BF16 * EFF_MXU)
-        hbm = t_hbm(b * h * t * t * 2 * 4)
+        hbm = t_hbm(b * h * t * t * _BF16 * 4)
         if t >= 4096:
             # fusion cliff: XLA's materialized-T^2 path measured
             # 237.49 ms at T=8192 vs the 8.1 ms a linear bytes model
@@ -371,12 +377,12 @@ def predict_beam(t_max=4096, beam=8, d_model=256, n_layers=2,
     K/V streams (~1.5 with causal masking) — plus weight streaming
     and ~20 in-scan kernels."""
     d_kv = d_model // n_heads * n_kv_heads
-    cache = n_layers * 2 * beam * t_max * d_kv * 2      # bf16 bytes
+    cache = n_layers * 2 * beam * t_max * d_kv * _BF16  # bf16 bytes
     params = n_layers * ((2 + 2 * n_kv_heads / n_heads) * d_model ** 2
                          + 8 * d_model ** 2) + 2 * vocab * d_model
     # ~3.5 cache passes/position: reorder gather read + write (2) plus
     # the attention's own K and V streams (~1.5 with causal masking)
-    step = t_hbm(cache * 3.5) + t_hbm(params * 2) \
+    step = t_hbm(cache * 3.5) + t_hbm(params * _BF16) \
         + 20 * T_KERNEL_SCAN
     return {"ms_per_pos_beam8": step * 1e3}
 
@@ -406,7 +412,7 @@ def predict_serve(d=768, n_layers=12, vocab=50304, t_max=512):
     embedding element (int8 rows + per-row scales)."""
     mm_params = n_layers * 12 * d * d
     emb = vocab * d                                  # tied head table
-    cache = n_layers * 2 * t_max * d * 2
+    cache = n_layers * 2 * t_max * d * _BF16
     floors = (n_layers * 12 + 10) * T_KERNEL_SCAN
     out = {}
     for name, wbytes, ebytes in (("f32", 2, 2), ("bf16", 2, 2),

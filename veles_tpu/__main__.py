@@ -83,9 +83,11 @@ class Main(object):
         p.add_argument("--lint", action="store_true",
                        help="build the workflow, run the static "
                        "analyzers (veles_tpu.analysis: graph linter + "
-                       "jit-staging auditor) and exit non-zero on "
-                       "error findings — no initialize(), no training, "
-                       "no XLA dispatch")
+                       "jit-staging auditor; with --mesh also the "
+                       "VS2xx/VM3xx sharding & memory audit, which "
+                       "initializes the workflow on a virtual CPU "
+                       "mesh) and exit non-zero on error findings — "
+                       "no training, no compute dispatch")
         p.add_argument("--result-file", default=None,
                        help="write gather_results() JSON here")
         p.add_argument("--export-dtype", default="float32",
@@ -243,14 +245,12 @@ class Main(object):
         elif args.lint:
             # linting never needs an accelerator (same guard as the
             # standalone veles-tpu-lint): module-level jax use in the
-            # workflow file must not lock chips on a shared host
-            import os
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            import jax
-            try:
-                jax.config.update("jax_platforms", "cpu")
-            except Exception:  # noqa: BLE001 — backend already up
-                pass
+            # workflow file must not lock chips on a shared host.  A
+            # --mesh lint additionally needs enough VIRTUAL cpu devices
+            # to build the mesh for the sharding/memory audit
+            from veles_tpu.analysis.cli import _force_cpu_devices
+            _force_cpu_devices(self._parse_mesh(args.mesh)
+                               if args.mesh else None)
         if args.random_seed is not None:
             prng.seed_all(args.random_seed)
         self._apply_config(args)
@@ -471,6 +471,13 @@ class Main(object):
                                  "...) — nothing to lint" % args.workflow)
             from veles_tpu.analysis import (format_findings, has_errors,
                                             lint_workflow)
+            if args.mesh:
+                # --lint --mesh: initialize under the virtual CPU mesh
+                # so the VS2xx/VM3xx sharding/memory audit can lower the
+                # real staged step (params allocate; no training step
+                # ever dispatches — same contract as veles-tpu-lint)
+                from veles_tpu.analysis.cli import _attach_mesh
+                _attach_mesh(wf, self._parse_mesh(args.mesh), args.fsdp)
             findings = lint_workflow(wf)
             print(format_findings(findings))
             return 1 if has_errors(findings) else 0
@@ -754,16 +761,12 @@ class Main(object):
     @staticmethod
     def _parse_mesh(spec):
         """'data=4,model=2' -> {'data': 4, 'model': 2} (ref device-spec
-        grammar backends.py:299-308 / launcher -n node specs)."""
+        grammar backends.py:299-308 / launcher -n node specs; 'DxM'
+        shorthand also accepted — one parser, analysis.cli.parse_mesh)."""
         if not spec:
             return None
-        axes = {}
-        for part in spec.split(","):
-            name, _, size = part.partition("=")
-            if not size:
-                raise SystemExit("--mesh wants axis=size, got %r" % part)
-            axes[name.strip()] = int(size)
-        return axes
+        from veles_tpu.analysis.cli import parse_mesh
+        return parse_mesh(spec)
 
     def _make_launcher(self, args, wf):
         from veles_tpu.launcher import Launcher

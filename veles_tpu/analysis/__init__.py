@@ -1,10 +1,15 @@
-"""veles_tpu.analysis — static workflow-graph linter + jit-staging auditor.
+"""veles_tpu.analysis — static workflow-graph linter + jit-staging +
+sharding/memory auditors.
 
 Runs over a *constructed* (not initialized) Workflow: graph rules decide
 control/data-link correctness (graph_lint, VG...), the staging auditor
 abstractly traces staged step functions for host-sync and recompile
-hazards (staging, VJ...).  Surface: :func:`lint_workflow` in-process, the
-``veles-tpu-lint`` console script, and ``python -m veles_tpu ... --lint``.
+hazards (staging, VJ...), and the sharding/memory auditor lowers the
+staged step under its device mesh and lints the collectives and the
+per-device HBM picture (sharding_audit, VS2xx/VM3xx — needs an
+initialized workflow with a mesh, e.g. ``veles-tpu-lint --mesh 2x2``).
+Surface: :func:`lint_workflow` in-process, the ``veles-tpu-lint``
+console script, and ``python -m veles_tpu ... --lint``.
 
 Rule catalog and severities: docs/static_analysis.md."""
 
@@ -16,24 +21,42 @@ from veles_tpu.analysis.staging import audit_step
 
 __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
            "format_findings", "has_errors", "sort_findings", "lint_graph",
-           "audit_step", "lint_workflow"]
+           "audit_step", "audit_sharded_step", "lint_workflow"]
 
 
-def lint_workflow(wf, staging=True):
-    """All analysis passes over ``wf``: every graph rule, plus the staging
-    audit of any unit exposing a ``lint_staging_spec()`` hook (e.g.
-    StagedTrainer after initialize()).  Returns sorted Findings."""
+def audit_sharded_step(spec, hbm_gib=None):
+    """Sharding/memory audit of one staged step (VS2xx/VM3xx) — see
+    :mod:`veles_tpu.analysis.sharding_audit` (imported lazily: the
+    graph rules must stay usable without lowering anything)."""
+    from veles_tpu.analysis import sharding_audit
+    return sharding_audit.audit_sharded_step(spec, hbm_gib=hbm_gib)
+
+
+def lint_workflow(wf, staging=True, sharding=True, hbm_gib=None):
+    """All analysis passes over ``wf``: every graph rule, the staging
+    audit of any unit exposing ``lint_staging_spec()``, and the
+    sharding/memory audit of any unit exposing ``lint_sharding_spec()``
+    (e.g. StagedTrainer after initialize() under a mesh — the two hooks
+    are complementary: the staging hook covers the single-device step,
+    the sharding hook the mesh step).  Returns sorted Findings."""
     findings = lint_graph(wf)
-    if staging:
-        for unit in [wf] + list(wf.units):
+    for unit in [wf] + list(wf.units):
+        if staging:
             hook = getattr(unit, "lint_staging_spec", None)
-            if not callable(hook):
-                continue
-            spec = hook()
-            if not spec:
-                continue  # unit has no staged step yet (pre-initialize)
-            findings.extend(audit_step(
-                spec["fn"], spec.get("args", ()),
-                carry_argnums=tuple(spec.get("carry_argnums", ())),
-                name=spec.get("name", getattr(unit, "name", "step"))))
+            if callable(hook):
+                spec = hook()
+                if spec:   # None: no staged step yet (pre-initialize)
+                    findings.extend(audit_step(
+                        spec["fn"], spec.get("args", ()),
+                        carry_argnums=tuple(spec.get("carry_argnums",
+                                                     ())),
+                        name=spec.get("name",
+                                      getattr(unit, "name", "step"))))
+        if sharding:
+            hook = getattr(unit, "lint_sharding_spec", None)
+            if callable(hook):
+                spec = hook()
+                if spec:   # None: no mesh, or not initialized yet
+                    findings.extend(audit_sharded_step(spec,
+                                                       hbm_gib=hbm_gib))
     return sort_findings(findings)

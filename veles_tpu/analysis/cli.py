@@ -4,9 +4,18 @@ Honors the module contract (``run(load, main)``, ref __main__.py): the
 workflow file constructs its Workflow through ``load(...)``; ``main()``
 here is a no-op, so nothing is initialized, no XLA computation is
 dispatched, and no data is loaded beyond what construction itself does.
-Exit status: 0 = no error-severity findings, 1 = errors (2 = usage)."""
+With ``--mesh`` the workflow IS additionally initialized (on a virtual
+CPU device mesh — parameters are allocated, but no training step ever
+runs) so the sharding/memory auditor can lower the real staged step
+under the mesh (VS2xx/VM3xx, docs/static_analysis.md).
+
+Exit status: 0 = no findings at or above the ``--fail-on`` severity
+threshold (default ``error``), 1 = threshold reached (``--fail-on
+warning`` lets CI gate on warnings too), 2 = usage."""
 
 import argparse
+import os
+import re
 import runpy
 import sys
 
@@ -43,10 +52,91 @@ def build_workflow(workflow_path, config_path=None, config_list=()):
     return built["wf"]
 
 
+def parse_mesh(spec):
+    """``'2x2'`` (data x model) or the training CLI's ``'data=2,model=2'``
+    axis grammar → ``{axis: size}`` — the ONE mesh-spec parser
+    (``__main__.Main._parse_mesh`` delegates here)."""
+    if "=" not in spec:
+        parts = spec.lower().replace("*", "x").split("x")
+        if len(parts) != 2:
+            raise SystemExit("--mesh wants DxM (e.g. 2x2) or "
+                             "axis=size[,axis=size...], got %r" % spec)
+        try:
+            return {"data": int(parts[0]), "model": int(parts[1])}
+        except ValueError:
+            raise SystemExit("--mesh: %r is not DxM" % spec)
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit("--mesh wants axis=size, got %r" % part)
+        try:
+            axes[name.strip()] = int(size)
+        except ValueError:
+            raise SystemExit("--mesh: size in %r is not an integer"
+                             % part)
+    return axes
+
+
+_DEVCOUNT_RE = re.compile(
+    r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def _force_cpu_devices(axes):
+    """Linting must never grab an accelerator, and a mesh lint needs
+    enough virtual CPU devices to build the mesh — both are env knobs
+    that only work before the jax backend initializes (the
+    tests/conftest.py pattern).  An XLA_FLAGS pin SMALLER than the mesh
+    is raised to fit; a larger one is left alone."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    n = 1
+    for size in (axes or {}).values():
+        if size > 0:
+            n *= size
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n > 1:
+        m = _DEVCOUNT_RE.search(flags)
+        if m is None:
+            flags = (flags + " --xla_force_host_platform_device_count"
+                     "=%d" % n).strip()
+        elif int(m.group(1)) < n:
+            flags = _DEVCOUNT_RE.sub(
+                "--xla_force_host_platform_device_count=%d" % n, flags)
+        os.environ["XLA_FLAGS"] = flags
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized: too
+        pass           # late to repoint, construction won't dispatch
+
+
+def _attach_mesh(wf, axes, fsdp):
+    """Build the MeshConfig and initialize the workflow under it (the
+    Launcher's --mesh wiring, minus services/distributed): params are
+    allocated on the virtual CPU mesh so the staged steps and their
+    shardings exist for the auditor — still no training dispatch."""
+    from veles_tpu.parallel import MeshConfig, make_mesh
+    mc = MeshConfig(make_mesh(axes), fsdp=fsdp)
+    for unit in [wf] + list(wf.units):
+        if hasattr(unit, "mesh_config") and \
+                getattr(unit, "mesh_config") is None:
+            unit.mesh_config = mc
+    trainer = getattr(wf, "trainer", None)
+    loader = getattr(wf, "loader", None)
+    if (trainer is not None and loader is not None
+            and getattr(trainer, "dataset_placement", None) == "shard"
+            and mc.data_size > 1
+            and getattr(loader, "on_device", None) is True):
+        loader.on_device = "defer"   # never materialize a full replica
+    wf.initialize()
+    return mc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="veles-tpu-lint",
         description="static workflow-graph linter + jit-staging auditor "
+                    "+ sharding/memory auditor "
                     "(rule catalog: docs/static_analysis.md)")
     p.add_argument("workflow", help="workflow .py file defining "
                    "run(load, main)")
@@ -59,30 +149,47 @@ def main(argv=None):
     p.add_argument("--no-staging", action="store_true",
                    help="graph rules only; skip the jit-staging audit "
                    "hooks")
+    p.add_argument("--mesh", default=None, metavar="DxM",
+                   help="initialize the workflow under a DATAxMODEL "
+                   "device mesh (virtual CPU devices) and run the "
+                   "VS2xx/VM3xx sharding & memory audit of the staged "
+                   "step; also accepts the training CLI's "
+                   "'data=2,model=2' axis grammar")
+    p.add_argument("--fsdp", action="store_true",
+                   help="audit with ZeRO-3 fully-sharded parameters "
+                   "over the data axis (pairs with --mesh)")
+    p.add_argument("--hbm-gib", type=float, default=None, metavar="GiB",
+                   help="per-device HBM capacity the VM300 peak "
+                   "estimate is judged against (default: "
+                   "sharding_audit.DEFAULT_HBM_GIB = 16, v5e)")
+    p.add_argument("--fail-on", choices=("error", "warning"),
+                   default="error", metavar="{error,warning}",
+                   help="severity threshold for the non-zero exit: "
+                   "'error' (default) fails only on error findings, "
+                   "'warning' fails on warnings too — the CI gate knob")
     p.add_argument("--strict", action="store_true",
-                   help="exit non-zero on warnings too")
+                   help="deprecated alias for --fail-on warning")
     args = p.parse_args(argv)
 
-    import os
-    # linting must never grab an accelerator: abstract tracing is
-    # backend-independent, and a lint in CI shares machines with jobs
-    # that do own the chips.  jax froze its env snapshot when this
-    # module's imports pulled it in, so set the live config too (the
-    # tests/conftest.py pattern); env covers any subprocesses
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — backend already initialized: too
-        pass           # late to repoint, construction won't dispatch
+    axes = parse_mesh(args.mesh) if args.mesh else None
+    if args.fsdp and not axes:
+        raise SystemExit("--fsdp needs --mesh (parameters shard over "
+                         "the mesh's data axis)")
+    # env knobs must land before anything touches a jax backend
+    _force_cpu_devices(axes)
 
     from veles_tpu.analysis import (WARNING, format_findings, has_errors,
                                     lint_workflow)
     wf = build_workflow(args.workflow, args.config, args.config_list)
-    findings = lint_workflow(wf, staging=not args.no_staging)
+    if axes:
+        _attach_mesh(wf, axes, args.fsdp)
+    findings = lint_workflow(wf, staging=not args.no_staging,
+                             hbm_gib=args.hbm_gib)
     print(format_findings(findings, args.format))
+    fail_on = ("warning" if args.strict else args.fail_on)
     failed = has_errors(findings) or (
-        args.strict and any(f.severity == WARNING for f in findings))
+        fail_on == "warning"
+        and any(f.severity == WARNING for f in findings))
     return 1 if failed else 0
 
 

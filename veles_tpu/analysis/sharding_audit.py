@@ -1,0 +1,479 @@
+"""Sharding & memory auditor: static collective/HBM lint of the staged
+step under a device mesh.
+
+The distribution story is "annotate shardings, let GSPMD insert
+collectives" (parallel/sharding.py) — which makes the expensive failure
+modes invisible until chip time: an accidental all-gather of full
+parameters every step, a tensor silently replicated because a dim didn't
+divide its axis, an OOM that only shows up on real silicon.  All of them
+are statically decidable BEFORE any chip is touched: the step is lowered
+under the mesh on abstract ``jax.ShapeDtypeStruct`` inputs
+(``jax.jit(...).lower(...)`` — no data, no dispatch, works on the
+CPU-device mesh lint runs on), the post-SPMD module text is scanned for
+the collectives XLA actually inserted, and the jaxpr is walked for
+upcasts and the activation high-water mark.  Bytes are priced with the
+SAME table the roofline cost model uses (``veles_tpu.ops.flops.
+DTYPE_BYTES`` — tools/cost_model.py), so lint, bench and prediction
+cannot silently diverge.  This is the "analyze before you run"
+discipline of TVM's static cost models and CLBlast's offline-tuned DB
+(PAPERS.md) applied to the sharded training step.
+
+Rule catalog (docs/static_analysis.md):
+
+========  ========  =====================================================
+VS200     warning   per-device all-gather/all-reduce volume per step
+                    exceeds the per-device minibatch bytes — the step
+                    ships ~the model over ICI every iteration
+VS201     warning   tensor silently replicated: a sharding rule wanted
+                    an axis but the dim didn't divide (recorded by
+                    parallel/sharding.py on MeshConfig.sharding_fallbacks)
+VS202     warning   FSDP mode but gradients ride a full ``psum``
+                    (all-reduce) instead of the expected reduce-scatter —
+                    full gradient materialized per device
+VS203     warning   bf16 parameter upcast to f32 inside the step —
+                    doubles the parameter HBM traffic
+VM300     info      static per-device peak-HBM estimate (params + opt
+          /error    slots + data + activation high-water) — error when
+                    it exceeds the per-device capacity (predicted OOM)
+VM301     warning   donation miss: a carry buffer (params/opt state) is
+                    not donated, or XLA dropped the declared donation —
+                    the carry lives twice
+========  ========  =====================================================
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.analysis.findings import ERROR, INFO, WARNING, Finding
+from veles_tpu.analysis.staging import _sub_jaxprs, iter_primitives
+from veles_tpu.ops.flops import dtype_nbytes, shape_nbytes
+
+#: default per-device HBM capacity, GiB (v5e — the same chip the
+#: tools/cost_model.py roofline is calibrated for)
+DEFAULT_HBM_GIB = 16.0
+
+#: result-shape token in HLO text, e.g. ``f32[128,256]``
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+#: a collective instruction definition: ``%x = <shapes> all-gather(...``
+#: (``-start`` async halves count once; ``-done`` carries no new bytes)
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(.+?)\s+(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute)(-start)?\(")
+
+
+def _token_bytes(dtype_tok, dims_tok):
+    n = 1
+    for d in dims_tok.split(","):
+        if d:
+            n *= int(d)
+    try:
+        return n * dtype_nbytes(dtype_tok)
+    except TypeError:
+        return 0          # opaque token (token/tuple glue) — no bytes
+
+
+def collective_stats(hlo_text):
+    """``{op: {"count", "bytes"}}`` parsed from post-SPMD optimized
+    module text — per-device result bytes of every collective XLA
+    inserted (all-gather counts the gathered size, i.e. received
+    traffic)."""
+    stats = {}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        shapes, op, is_start = m.group(1), m.group(2), m.group(3)
+        tokens = _SHAPE_RE.findall(shapes)
+        if is_start and len(tokens) > 1:
+            # async def lines yield a (operand, result) tuple shape —
+            # only the result carries the traffic
+            tokens = tokens[-1:]
+        nbytes = sum(_token_bytes(d, s) for d, s in tokens)
+        rec = stats.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+    return stats
+
+
+def _aval_bytes(aval):
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return shape_nbytes(shape, dtype)
+    except TypeError:
+        return 0
+
+
+def activation_highwater(jaxpr):
+    """Peak live *intermediate* bytes from a linear liveness walk of the
+    equations (global, unpartitioned sizes; the jaxpr's own outputs are
+    excluded — they are accounted as resident carries/outputs, not
+    transient activations).  Sub-jaxprs (pjit/scan/cond bodies) recurse:
+    an eqn's footprint is the larger of its own outputs and its body's
+    high-water."""
+    out_ids = {id(v) for v in jaxpr.outvars}
+    last_use = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            last_use[id(v)] = i
+    live = {}
+    cur = high = 0
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        for value in eqn.params.values():
+            for sub in _sub_jaxprs(value):
+                inner = max(inner, activation_highwater(sub))
+        for v in eqn.outvars:
+            if id(v) in out_ids or id(v) in live:
+                continue
+            b = _aval_bytes(getattr(v, "aval", None))
+            live[id(v)] = b
+            cur += b
+        high = max(high, cur + inner)
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if last_use.get(id(v), -1) <= i and id(v) in live:
+                cur -= live.pop(id(v))
+    return high
+
+
+def _leaf_device_bytes(x):
+    """Per-device resident bytes of one input leaf: its global bytes
+    divided by how its NamedSharding splits it (``shard_shape``)."""
+    shape = tuple(getattr(x, "shape", ()) or ())
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        return 0
+    sh = getattr(x, "sharding", None)
+    if sh is not None and hasattr(sh, "shard_shape"):
+        try:
+            shape = sh.shard_shape(shape)
+        except Exception:  # noqa: BLE001 — unsharded/abstract: global
+            pass
+    return shape_nbytes(shape, dtype)
+
+
+def _abstract_args(args, mesh_cfg):
+    """ShapeDtypeStruct mirror of ``args`` (concrete arrays or specs).
+    Mesh-sharded leaves keep their NamedSharding; everything else is
+    pinned replicated — an uncommitted single-device array mixed into a
+    mesh computation would otherwise fail the abstract lowering."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh_cfg.mesh, P())
+
+    def leaf(x):
+        sh = getattr(x, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            sh = repl
+        return jax.ShapeDtypeStruct(tuple(jnp.shape(x)),
+                                    jnp.result_type(x), sharding=sh)
+    return tuple(jax.tree_util.tree_map(leaf, a) for a in args)
+
+
+def _argnum_leaves(args, argnums):
+    leaves = []
+    for n in argnums:
+        leaves.extend(jax.tree_util.tree_leaves(args[n]))
+    return leaves
+
+
+def _mib(nbytes):
+    return nbytes / (1024.0 * 1024.0)
+
+
+def estimate_peak_hbm(spec, _args=None, _closed=None, act_bytes=None):
+    """VM300's static per-device peak-HBM estimate, as a component dict:
+    ``params`` + ``opt`` + ``other_args`` (HBM-resident step inputs,
+    each divided by its sharding; a buffer passed as several args — the
+    autoencoder's targets ARE its data — counts once) + ``activations``
+    + ``undonated`` (carry outputs that double because their input
+    buffer was not donated); ``peak`` is the sum.
+
+    ``activations``: ``act_bytes`` when given (the auditor passes XLA's
+    own per-device ``temp_size_in_bytes`` from the compiled module —
+    exact, includes data-parallel gradient replicas); otherwise the
+    jaxpr liveness high-water over the global program divided by the
+    data-axis size — a heuristic that treats every intermediate as
+    batch-sharded, which UNDERCOUNTS replicated param-sized gradients
+    under pure data parallelism.  Purely static either way: traces
+    abstractly, allocates nothing."""
+    mc = spec["mesh_config"]
+    args = _args if _args is not None else _abstract_args(spec["args"],
+                                                          mc)
+    closed = _closed
+    carry = tuple(spec.get("carry_argnums", ()))
+    donate = tuple(spec.get("donate_argnums", ()))
+    data_size = max(getattr(mc, "data_size", 1), 1)
+    params_bytes = sum(_leaf_device_bytes(x) for x in _argnum_leaves(
+        args, tuple(spec.get("params_argnums", ()))))
+    opt_bytes = sum(_leaf_device_bytes(x) for x in _argnum_leaves(
+        args, tuple(spec.get("opt_argnums", ()))))
+    # one physical buffer may arrive as several args (aliasing is only
+    # visible on the ORIGINAL leaves — the abstract mirrors are fresh
+    # objects, so pair them up positionally)
+    orig = [x for a in spec["args"]
+            for x in jax.tree_util.tree_leaves(a)]
+    mirror = [x for a in args for x in jax.tree_util.tree_leaves(a)]
+    seen = set()
+    args_bytes = 0
+    for o, m in zip(orig, mirror):
+        if id(o) in seen:
+            continue
+        seen.add(id(o))
+        args_bytes += _leaf_device_bytes(m)
+    if act_bytes is None:
+        if closed is None:
+            closed = jax.make_jaxpr(spec["fn"])(*args)
+        act_bytes = activation_highwater(closed.jaxpr) // data_size
+    undonated = sum(_leaf_device_bytes(x) for x in _argnum_leaves(
+        args, [n for n in carry if n not in donate]))
+    return {"peak": args_bytes + act_bytes + undonated,
+            "params": params_bytes, "opt": opt_bytes,
+            "other_args": args_bytes - params_bytes - opt_bytes,
+            "activations": act_bytes, "undonated": undonated}
+
+
+def audit_sharded_step(spec, hbm_gib=None):
+    """Audit one staged step under its mesh; returns VS2xx/VM3xx Findings.
+
+    ``spec`` (the shape ``StagedTrainer.lint_sharding_spec()`` returns):
+
+    ``fn``              the step — a ``jax.jit`` object or plain callable
+    ``args``            positional args: concrete arrays and/or
+                        ``jax.ShapeDtypeStruct`` specs (never executed)
+    ``mesh_config``     parallel.MeshConfig (axes + fsdp flag +
+                        recorded sharding fallbacks)
+    ``donate_argnums``  argnums the step donates
+    ``carry_argnums``   argnums whose outputs replace them next iteration
+    ``params_argnums``  argnums holding the parameter pytree
+    ``opt_argnums``     argnums holding the optimizer state pytree
+    ``minibatch_bytes`` global bytes one minibatch moves per step
+    ``name``            display name for findings
+
+    Everything here is static: the step is lowered and compiled for the
+    mesh on ABSTRACT inputs — no computation is dispatched and no device
+    array is created (asserted in tests/test_sharding_audit.py)."""
+    fn = spec["fn"]
+    mc = spec["mesh_config"]
+    name = spec.get("name", "step")
+    donate = tuple(spec.get("donate_argnums", ()))
+    carry = tuple(spec.get("carry_argnums", ()))
+    params_argnums = tuple(spec.get("params_argnums", ()))
+    opt_argnums = tuple(spec.get("opt_argnums", ()))
+    fsdp = bool(getattr(mc, "fsdp", False))
+    capacity = int((hbm_gib or spec.get("hbm_gib") or DEFAULT_HBM_GIB)
+                   * 1024 ** 3)
+    findings = []
+
+    args = _abstract_args(spec["args"], mc)
+
+    # ---- VS201: silent-replication fallbacks recorded by the sharding
+    # rules at shard_params time (parallel/sharding.py)
+    for fb in getattr(mc, "sharding_fallbacks", ()):
+        where = fb["layer"] or "<unnamed>"
+        if fb["param"]:
+            where += "." + fb["param"]
+        shape = ("%r" % (fb["shape"],) if fb["shape"] is not None
+                 else "")
+        if fb.get("replicated", True):
+            findings.append(Finding(
+                "VS201", WARNING, name,
+                "%s %s silently replicated: %s"
+                % (where, shape, fb["reason"]),
+                hint="pad the dim to a multiple of the axis, pick a "
+                     "mesh whose axis divides it, or override "
+                     "param_partition_specs for this layer"))
+        else:
+            # still sharded on another axis — only the extra axis was
+            # missed (every 1-D bias under fsdp): informational, so a
+            # clean fsdp config passes `--fail-on warning`
+            findings.append(Finding(
+                "VS201", INFO, name,
+                "%s %s kept its sharding but missed an extra axis: %s"
+                % (where, shape, fb["reason"])))
+
+    # ---- abstract lowering under the mesh (no devices touched)
+    try:
+        lowerable = fn if hasattr(fn, "lower") else jax.jit(
+            fn, donate_argnums=donate)
+        lowered = lowerable.lower(*args)
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any lowering failure is the finding
+        findings.append(Finding(
+            "VJ100", ERROR, name,
+            "staged step failed to lower under the mesh %s: %s: %s"
+            % (dict(mc.mesh.shape), type(e).__name__, e),
+            hint="the step must trace abstractly with its sharding "
+                 "annotations — no data-dependent python control flow"))
+        return findings
+
+    # ---- VS203: bf16 params upcast to f32 inside the step
+    bf16 = jnp.result_type(jnp.bfloat16)
+    f32 = jnp.result_type(jnp.float32)
+    bf16_param_shapes = set()
+    for leaf in _argnum_leaves(args, params_argnums):
+        if jnp.result_type(leaf.dtype) == bf16:
+            bf16_param_shapes.add(tuple(leaf.shape))
+    if bf16_param_shapes:
+        upcast = set()
+        for prim_name, eqn in iter_primitives(closed.jaxpr):
+            if prim_name != "convert_element_type":
+                continue
+            if jnp.result_type(eqn.params.get("new_dtype")) != f32:
+                continue
+            src = eqn.invars[0].aval
+            if (getattr(src, "dtype", None) == bf16
+                    and tuple(getattr(src, "shape", ())) in
+                    bf16_param_shapes):
+                upcast.add(tuple(src.shape))
+        for shape in sorted(upcast):
+            findings.append(Finding(
+                "VS203", WARNING, name,
+                "bf16 parameter-shaped tensor %r upcast to f32 inside "
+                "the step — the cast doubles its HBM traffic every "
+                "iteration" % (shape,),
+                hint="keep the compute dtype bf16 (ops/policy), or "
+                     "store the master copy in f32 and cast ONCE "
+                     "outside the step"))
+
+    # ---- VM301(a): carry args not donated — params + opt state live
+    # twice while the step runs
+    missing = [n for n in carry if n not in donate]
+    if missing:
+        names = {n: "params" for n in params_argnums}
+        names.update({n: "optimizer state" for n in opt_argnums})
+        what = ", ".join("arg %d (%s)" % (n, names.get(n, "carry"))
+                         for n in missing)
+        findings.append(Finding(
+            "VM301", WARNING, name,
+            "carry buffer not donated: %s — input and output copies "
+            "are both live across the step, doubling their HBM"
+            % what,
+            hint="jit with donate_argnums covering every carry arg "
+                 "(the output reuses the input buffer)"))
+
+    # ---- compile for the mesh: the post-SPMD module text holds the
+    # collectives GSPMD actually inserted, and XLA's own buffer stats
+    compiled = None
+    try:
+        compiled = lowered.compile()
+    except Exception as e:  # noqa: BLE001 — collective rules degrade gracefully
+        findings.append(Finding(
+            "VM300", INFO, name,
+            "could not compile the lowered step for collective/HBM "
+            "inspection (%s: %s) — VS200/VS202 skipped, VM300 is "
+            "jaxpr-only" % (type(e).__name__, e)))
+
+    data_size = max(getattr(mc, "data_size", 1), 1)
+    mb_bytes = int(spec.get("minibatch_bytes") or 0)
+    mb_per_device = mb_bytes // data_size if mb_bytes else 0
+    param_dev_bytes = sum(_leaf_device_bytes(x) for x in
+                          _argnum_leaves(args, params_argnums))
+
+    mem_stats = None
+    if compiled is not None:
+        try:
+            mem_stats = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 — backend without buffer stats
+            mem_stats = None
+        stats = collective_stats(compiled.as_text())
+        ag = stats.get("all-gather", {"count": 0, "bytes": 0})
+        ar = stats.get("all-reduce", {"count": 0, "bytes": 0})
+        rs = stats.get("reduce-scatter", {"count": 0, "bytes": 0})
+        heavy = ag["bytes"] + ar["bytes"]
+
+        # VS200: the step ships ~the model over ICI every iteration.
+        # Threshold: 2x the per-device minibatch (the in-step sharded
+        # gather legitimately moves one minibatch, parallel/sharding.py
+        # make_sharded_gather) AND at least half the per-device params
+        # (so tiny-model noise never fires).
+        threshold = max(2 * mb_per_device, param_dev_bytes // 2, 1)
+        if heavy > threshold:
+            findings.append(Finding(
+                "VS200", WARNING, name,
+                "heavy collectives per step: %.2f MiB/device "
+                "all-gather (%d) + %.2f MiB/device all-reduce (%d) "
+                "exceeds %.2f MiB/device minibatch — the step ships "
+                "~the model over ICI every iteration"
+                % (_mib(ag["bytes"]), ag["count"], _mib(ar["bytes"]),
+                   ar["count"], _mib(mb_per_device)),
+                hint="check for a tensor GSPMD gathers back because "
+                     "its sharding was lost (VS201 fallbacks), raise "
+                     "the per-step batch / grad accumulation, or "
+                     "shard the offender explicitly"))
+
+        # VS202: fsdp gradients should REDUCE-SCATTER into the param
+        # shards; a param-sized all-reduce means the full gradient is
+        # materialized (and summed) on every device — ZeRO-3's memory
+        # win silently lost.
+        if fsdp and param_dev_bytes and \
+                ar["bytes"] >= param_dev_bytes and \
+                rs["bytes"] < param_dev_bytes:
+            findings.append(Finding(
+                "VS202", WARNING, name,
+                "fsdp step all-reduces %.2f MiB/device of gradients "
+                "but reduce-scatters only %.2f MiB (param shard: "
+                "%.2f MiB) — expected reduce-scatter onto the "
+                "parameter shards (ZeRO-3), got a full psum"
+                % (_mib(ar["bytes"]), _mib(rs["bytes"]),
+                   _mib(param_dev_bytes)),
+                hint="pin the gradient/update out_shardings to the "
+                     "fsdp param specs so GSPMD scatters the "
+                     "reduction, and check VS201 for params whose "
+                     "fsdp sharding fell back to replication"))
+
+        # VM301(b): donation declared but dropped by XLA — the aliased
+        # bytes should cover the donated carries
+        if donate and mem_stats is not None:
+            donated_bytes = sum(_leaf_device_bytes(x) for x in
+                                _argnum_leaves(args, donate))
+            alias = getattr(mem_stats, "alias_size_in_bytes", None)
+            if alias is not None and donated_bytes and \
+                    alias < donated_bytes // 2:
+                findings.append(Finding(
+                    "VM301", WARNING, name,
+                    "donation declared for %.2f MiB/device of carry "
+                    "buffers but XLA aliased only %.2f MiB — the "
+                    "donated inputs are still copied"
+                    % (_mib(donated_bytes), _mib(alias)),
+                    hint="donated inputs must match their outputs in "
+                         "shape/dtype/sharding (out_shardings pinned "
+                         "to the input shardings)"))
+
+    # ---- VM300: static per-device peak-HBM estimate (estimate_peak_hbm
+    # for the accounting; XLA's own per-device temp bytes when the
+    # compile succeeded — exact, includes replicated DP gradients —
+    # else the jaxpr liveness heuristic)
+    act_override = getattr(mem_stats, "temp_size_in_bytes", None)
+    est = estimate_peak_hbm(spec, _args=args, _closed=closed,
+                            act_bytes=act_override)
+    peak = est["peak"]
+    detail = ("params %.2f + opt %.2f + other args %.2f + activations "
+              "%.2f + undonated carries %.2f MiB/device"
+              % (_mib(est["params"]), _mib(est["opt"]),
+                 _mib(est["other_args"]),
+                 _mib(est["activations"]), _mib(est["undonated"])))
+    if peak > capacity:
+        findings.append(Finding(
+            "VM300", ERROR, name,
+            "predicted OOM: estimated peak %.2f MiB/device exceeds the "
+            "%.1f GiB/device capacity (%s)"
+            % (_mib(peak), capacity / 1024 ** 3, detail),
+            hint="shard more (fsdp / bigger mesh), remat activations, "
+                 "adafactor the optimizer slots, or shrink the batch"))
+    elif peak > 0.9 * capacity:
+        findings.append(Finding(
+            "VM300", WARNING, name,
+            "estimated peak %.2f MiB/device is within 10%% of the "
+            "%.1f GiB/device capacity (%s)"
+            % (_mib(peak), capacity / 1024 ** 3, detail)))
+    else:
+        findings.append(Finding(
+            "VM300", INFO, name,
+            "estimated peak %.2f MiB/device of %.1f GiB/device "
+            "capacity (%s)" % (_mib(peak), capacity / 1024 ** 3,
+                               detail)))
+    return findings

@@ -34,11 +34,18 @@ _HOST_SYNC_PRIMS = ("outfeed", "infeed")
 
 def _sub_jaxprs(value):
     """Nested jaxprs hiding in an eqn's params (pjit/scan/while carry a
-    ClosedJaxpr under 'jaxpr', cond a list under 'branches', ...)."""
+    ClosedJaxpr under 'jaxpr', cond a list under 'branches', custom
+    primitives stash them in dicts — e.g. keyed branch/function tables),
+    so a container-valued param never hides a VJ101 host callback."""
     if hasattr(value, "jaxpr"):          # ClosedJaxpr
         return [value.jaxpr]
     if hasattr(value, "eqns"):           # bare Jaxpr
         return [value]
+    if isinstance(value, dict):
+        out = []
+        for v in value.values():
+            out.extend(_sub_jaxprs(v))
+        return out
     if isinstance(value, (list, tuple)):
         out = []
         for v in value:

@@ -640,6 +640,66 @@ class StagedTrainer(Unit):
         return {"fn": step, "args": args, "carry_argnums": (1,),
                 "name": "%s.eval_step" % self.name}
 
+    def lint_sharding_spec(self):
+        """Sharding/memory spec for the VS2xx/VM3xx auditor
+        (veles_tpu.analysis.sharding_audit): the REAL jitted train step
+        plus abstract ``ShapeDtypeStruct`` mirrors of its arguments,
+        each carrying the argument's live NamedSharding — the auditor
+        lowers and compiles for the mesh without touching data or
+        dispatching anything.  None before initialize(), without a mesh
+        (nothing to audit), or for data-carrying loaders (the minibatch
+        arrives from the host each step, so there is no HBM-resident
+        step state beyond the params the staging audit already
+        covers)."""
+        step = getattr(self, "_train_step", None)
+        if step is None or self.mesh_config is None \
+                or self.loader.carries_data:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mc = self.mesh_config
+        repl = NamedSharding(mc.mesh, P())
+        batch_sh = (NamedSharding(mc.mesh, P(mc.data_axis))
+                    if mc.data_axis in mc.mesh.shape else repl)
+
+        memo = {}   # one mirror per PHYSICAL buffer: the autoencoder's
+        # targets ARE its data, and VM300 must not count that twice
+
+        def abstract(x):
+            if id(x) in memo:
+                return memo[id(x)]
+            sh = getattr(x, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                sh = repl   # uncommitted single-device array: the step
+                # receives it replicated over the mesh at dispatch time
+            memo[id(x)] = jax.ShapeDtypeStruct(
+                tuple(jnp.shape(x)), jnp.result_type(x), sharding=sh)
+            return memo[id(x)]
+
+        tree_abs = lambda t: jax.tree_util.tree_map(abstract, t)  # noqa: E731
+        mb = self.loader.minibatch_size
+        args = (tree_abs(self.params), tree_abs(self.velocity),
+                tree_abs(self.class_stats[0]),
+                tree_abs(self._data_dev), tree_abs(self._labels_dev),
+                tree_abs(self._targets_dev),
+                jax.ShapeDtypeStruct((mb,), jnp.int32,
+                                     sharding=batch_sh),
+                jax.ShapeDtypeStruct((mb,), jnp.float32,
+                                     sharding=batch_sh),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+                jax.ShapeDtypeStruct((), jnp.float32, sharding=repl))
+        # bytes one minibatch moves per step: mb gathered samples (+
+        # labels + the f32 valid/int32 index vectors)
+        sample_bytes = int(np.prod(self._data_dev.shape[1:])
+                           * self._data_dev.dtype.itemsize)
+        mb_bytes = mb * (sample_bytes + self._labels_dev.dtype.itemsize
+                         + 8)
+        return {"fn": step, "args": args,
+                "mesh_config": mc,
+                "donate_argnums": (0, 1, 2), "carry_argnums": (0, 1, 2),
+                "params_argnums": (0,), "opt_argnums": (1,),
+                "minibatch_bytes": int(mb_bytes),
+                "name": "%s.train_step" % self.name}
+
     def host_params(self):
         """Full parameter pytree on the host.  Multi-host safe: tensors
         sharded across processes (non-addressable shards) are gathered

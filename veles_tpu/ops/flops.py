@@ -9,6 +9,42 @@ property: one methodology produced both the stored numbers and the
 runtime estimates, ref veles/backends.py:672-731.)"""
 
 
+#: bytes per element by dtype name — the ONE byte-pricing table shared by
+#: ``tools/cost_model.py`` (HBM-traffic terms) and the VS2xx/VM3xx
+#: sharding/memory auditor (``veles_tpu.analysis.sharding_audit``), keyed
+#: by both numpy/jax dtype names and the short HLO/StableHLO tokens that
+#: appear in compiled-module text.
+DTYPE_BYTES = {
+    "pred": 1, "bool": 1,
+    "s8": 1, "u8": 1, "int8": 1, "uint8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "s16": 2, "u16": 2, "int16": 2, "uint16": 2,
+    "f16": 2, "bf16": 2, "float16": 2, "bfloat16": 2,
+    "s32": 4, "u32": 4, "int32": 4, "uint32": 4, "f32": 4, "float32": 4,
+    "s64": 8, "u64": 8, "int64": 8, "uint64": 8, "f64": 8, "float64": 8,
+    "c64": 8, "complex64": 8, "c128": 16, "complex128": 16,
+}
+
+
+def dtype_nbytes(dtype):
+    """Bytes per element of ``dtype`` — accepts a numpy/jax dtype, a dtype
+    name, or an HLO shape token ("f32", "bf16", ...)."""
+    name = getattr(dtype, "name", None) or str(dtype)
+    try:
+        return DTYPE_BYTES[name]
+    except KeyError:
+        import numpy as np
+        return int(np.dtype(name).itemsize)
+
+
+def shape_nbytes(shape, dtype):
+    """Bytes of one dense tensor, priced with :data:`DTYPE_BYTES`."""
+    n = dtype_nbytes(dtype)
+    for d in shape:
+        n *= int(d)
+    return n
+
+
 def causal_attn_flops(b, h, t, d):
     """Matmul FLOPs of ONE causal attention call (qk + pv, each 2·b·h·
     t·(t/2)·d with the triangular mask halving effective keys)."""

@@ -150,8 +150,9 @@ def moe_forward_sharded(params, x, mesh, expert_axis="expert", top_k=2,
     experts), the batch shards over the same axis (tokens all_to_all to
     their experts and back), router/aux replicate.  Composes with
     jit/grad like every shard_map here."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel.smap import shard_map
 
     axis_size = mesh.shape[expert_axis]
     n_experts = params["w1"].shape[0]

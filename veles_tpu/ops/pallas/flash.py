@@ -385,7 +385,10 @@ def _blocks(q, k, v, block_q, block_k):
 #: bh and q/k-blocks carry no cross-iteration state (scratch resets at
 #: inner index 0) — declaring them parallel lets Mosaic re-order /
 #: parallelize them; only the innermost sweep is a sequential reduction
-_SEMANTICS = pltpu.CompilerParams(
+#: (CompilerParams is the current name; 0.4.x spells it
+#: TPUCompilerParams)
+_SEMANTICS = getattr(pltpu, "CompilerParams",
+                     getattr(pltpu, "TPUCompilerParams", None))(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

@@ -59,6 +59,30 @@ class MeshConfig:
     data_axis: str = "data"
     model_axis: str = "model"
     fsdp: bool = False
+    #: silent-replication log: every place the sharding rules *wanted* to
+    #: shard a tensor but fell back to replication records
+    #: ``{layer, param, dim, axis, reason, shape}`` here (sharding.py
+    #: param_spec/_safe_spec) — the VS201 lint rule reports these instead
+    #: of letting a non-dividing dim silently cost a full replica per
+    #: device.  Deduplicated; params and their optimizer slots collapse
+    #: to one entry.
+    sharding_fallbacks: list = dataclasses.field(default_factory=list,
+                                                 repr=False, compare=False)
+
+    def record_fallback(self, layer, param, dim, axis, reason,
+                        shape=None, replicated=True):
+        """``replicated=False`` marks a tensor that merely missed ONE
+        extra axis (e.g. a model-sharded bias fsdp could not also
+        shard) — still sharded, reported informationally, not as a
+        silent replication."""
+        entry = {"layer": layer, "param": param, "dim": dim, "axis": axis,
+                 "reason": reason, "replicated": bool(replicated),
+                 "shape": tuple(shape) if shape is not None else None}
+        if entry not in self.sharding_fallbacks:
+            self.sharding_fallbacks.append(entry)
+
+    def clear_fallbacks(self):
+        del self.sharding_fallbacks[:]
 
     @property
     def data_size(self):

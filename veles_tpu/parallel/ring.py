@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from veles_tpu.parallel.smap import shard_map
 
 from veles_tpu.ops import attention as att
 

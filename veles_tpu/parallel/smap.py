@@ -1,0 +1,24 @@
+"""``shard_map`` compatibility shim.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the
+top-level namespace and renamed its replication-check kwarg
+``check_rep`` → ``check_vma`` across the 0.4 → 0.6 line.  Every
+shard_map in this repo imports from HERE so the per-version spelling
+lives in exactly one place."""
+
+import inspect
+
+try:                                    # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:                     # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = ("check_vma" if "check_vma" in
+             inspect.signature(_shard_map).parameters else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
