@@ -159,6 +159,18 @@ ANCHORS = {
 }
 
 
+def device_constants():
+    """The calibrated device model as one dict — the contract
+    ``veles_tpu.telemetry.mfu`` consumes to price a live workflow's
+    staged step with the SAME constants this module's phase predictions
+    use (its baked-in fallback mirrors these values for installs
+    without tools/)."""
+    return {"name": "tpu-v5e", "peak_flops": PEAK_BF16,
+            "eff_mxu": EFF_MXU, "hbm_bw": HBM_BW, "eff_bw": EFF_BW,
+            "t_kernel": T_KERNEL, "h_step": H_STEP,
+            "t_dispatch": T_DISPATCH}
+
+
 def _pad(x, m=128):
     return int(math.ceil(x / m)) * m
 

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Synthesize MNIST-shaped idx files for smoke runs.
+
+CI boxes (and fresh checkouts) have no dataset mount, but the telemetry
+smoke job must run the REAL MNIST sample — same loader, same idx parser,
+same 784-100-10 workflow shape — so this writes structurally-valid
+``train/t10k`` idx images+labels full of deterministic noise into a
+directory that ``root.common.dirs.datasets`` can point at.  Nothing is
+downloaded; accuracy is meaningless by construction (the accuracy gates
+keep using the real data via tests/test_accuracy_gates.py).
+
+Usage::
+
+    python tools/make_synth_mnist.py ci-datasets/mnist --train 600 --test 200
+"""
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+
+def write_idx_images(path, n, rng):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))        # u8, 3-dim
+        f.write(struct.pack(">III", n, 28, 28))
+        f.write(rng.randint(0, 256, (n, 28, 28), np.uint8).tobytes())
+
+
+def write_idx_labels(path, n, rng):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))        # u8, 1-dim
+        f.write(struct.pack(">I", n))
+        f.write(rng.randint(0, 10, (n,), np.uint8).tobytes())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="write synthetic MNIST idx files for smoke runs")
+    p.add_argument("directory", help="target dir (the samples expect "
+                   "<datasets>/mnist — pass that path)")
+    p.add_argument("--train", type=int, default=600)
+    p.add_argument("--test", type=int, default=200)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    os.makedirs(args.directory, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    write_idx_images(os.path.join(args.directory,
+                                  "train-images-idx3-ubyte"),
+                     args.train, rng)
+    write_idx_labels(os.path.join(args.directory,
+                                  "train-labels-idx1-ubyte"),
+                     args.train, rng)
+    write_idx_images(os.path.join(args.directory,
+                                  "t10k-images-idx3-ubyte"),
+                     args.test, rng)
+    write_idx_labels(os.path.join(args.directory,
+                                  "t10k-labels-idx1-ubyte"),
+                     args.test, rng)
+    print("synthetic MNIST (%d train / %d test) -> %s"
+          % (args.train, args.test, args.directory))
+
+
+if __name__ == "__main__":
+    main()

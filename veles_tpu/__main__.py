@@ -193,6 +193,14 @@ class Main(object):
         p.add_argument("--event-log", default=None, metavar="PATH",
                        help="append structured trace events as JSONL "
                        "(ref the Mongo event timeline, logger.py:264-289)")
+        p.add_argument("--metrics-out", default=None,
+                       metavar="FILE.jsonl",
+                       help="stream telemetry records (workflow/unit/"
+                       "step spans, compile counters, device-memory "
+                       "gauges, predicted-vs-measured MFU) to this "
+                       "JSON-lines file, with the final metric state "
+                       "dumped at exit — summarize with "
+                       "veles-tpu-metrics (docs/services.md)")
         p.add_argument("--steps-per-dispatch", type=int, default=None,
                        metavar="K",
                        help="fuse K minibatch steps into one device "
@@ -237,6 +245,12 @@ class Main(object):
         # — the TPU-era analogue of the reference's on-disk kernel cache
         from veles_tpu import compile_cache
         compile_cache.enable()
+        if args.metrics_out:
+            # before any jit: the compile listeners installed by
+            # enable() must see the first compiles of the run
+            from veles_tpu import telemetry
+            telemetry.registry.open_sink(args.metrics_out,
+                                         dump_at_exit=True)
         if args.backend:
             import jax
             jax.config.update(

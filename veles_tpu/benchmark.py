@@ -74,7 +74,9 @@ class Watcher(object):
     @staticmethod
     def runtime_stats():
         """Backend-reported stats (bytes_in_use / peak_bytes_in_use on TPU;
-        absent on CPU) — the honest HBM number when available."""
+        absent on CPU) — the honest HBM number when available.  CPU
+        backends return None (or a dict missing those keys) from
+        ``memory_stats()``; callers must treat every key as optional."""
         import jax
         stats = {}
         for d in jax.devices():
@@ -88,4 +90,39 @@ class Watcher(object):
         per_device = self.live_bytes()
         total = sum(per_device.values())
         self.peak = max(self.peak, total)
+        return per_device
+
+    def record(self, registry=None):
+        """Set the device-memory gauges from a fresh census: live-array
+        bytes per device, the census high-water mark, and — only where
+        the backend actually reports them — the runtime's own
+        bytes_in_use / peak_bytes_in_use.  ``memory_stats()`` returning
+        None or a partial dict (CPU backends) degrades to the census
+        gauges instead of raising or printing."""
+        if registry is None:
+            from veles_tpu.telemetry import registry
+        per_device = self.snapshot()
+        g_live = registry.gauge("veles_device_live_bytes",
+                                "live jax-array bytes per device "
+                                "(per-shard census)", ("device",))
+        for dev, nbytes in per_device.items():
+            g_live.set(nbytes, device=dev)
+        registry.gauge("veles_device_peak_bytes",
+                       "census high-water mark across snapshots, "
+                       "all devices").set(self.peak)
+        g_used = registry.gauge("veles_hbm_bytes_in_use",
+                                "backend-reported bytes in use "
+                                "(absent on CPU)", ("device",))
+        g_peak = registry.gauge("veles_hbm_peak_bytes_in_use",
+                                "backend-reported peak bytes in use "
+                                "(absent on CPU)", ("device",))
+        for dev, st in self.runtime_stats().items():
+            if not isinstance(st, dict):
+                continue            # CPU backend: memory_stats() is None
+            used = st.get("bytes_in_use")
+            if isinstance(used, (int, float)):
+                g_used.set(used, device=dev)
+            peak = st.get("peak_bytes_in_use")
+            if isinstance(peak, (int, float)):
+                g_peak.set(peak, device=dev)
         return per_device

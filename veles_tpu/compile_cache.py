@@ -47,6 +47,64 @@ import os
 _MIN_COMPILE_SECS = 0.0
 
 _enabled_dir = None
+_metrics_installed = False
+
+
+def install_metrics():
+    """Subscribe compile count/time to the telemetry registry via jax's
+    monitoring hooks: every ``/jax/core/compile/*`` duration event feeds
+    ``veles_compile_events_total`` / ``veles_compile_seconds_total``
+    (labeled by the event's short name), and the compilation-cache
+    events (hits, cache-enabled requests) feed
+    ``veles_compile_cache_events_total`` — so a run's metrics JSONL
+    carries exactly how much wall time recompilation cost and how often
+    this module's persistent cache saved it.  Idempotent; returns False
+    when jax's monitoring internals moved (telemetry is best-effort,
+    the framework must still start)."""
+    global _metrics_installed
+    if _metrics_installed:
+        return True
+    try:
+        from jax._src import monitoring
+    except ImportError:
+        return False
+
+    def on_duration(event, duration, **kwargs):
+        if "/compile/" not in event and not event.endswith("compile"):
+            return
+        # listeners fire inside jax's compile path: never raise
+        try:
+            from veles_tpu import telemetry
+            key = event.rsplit("/", 1)[-1]
+            reg = telemetry.registry
+            reg.counter("veles_compile_events_total",
+                        "jax compile-phase events", ("event",)).inc(
+                event=key)
+            reg.counter("veles_compile_seconds_total",
+                        "seconds spent in jax compile phases",
+                        ("event",)).inc(duration, event=key)
+        except Exception:   # noqa: BLE001
+            pass
+
+    def on_event(event, **kwargs):
+        if "compilation_cache" not in event:
+            return
+        try:
+            from veles_tpu import telemetry
+            telemetry.registry.counter(
+                "veles_compile_cache_events_total",
+                "jax compilation-cache events (hits, cached requests)",
+                ("event",)).inc(event=event.rsplit("/", 1)[-1])
+        except Exception:   # noqa: BLE001
+            pass
+
+    try:
+        monitoring.register_event_duration_secs_listener(on_duration)
+        monitoring.register_event_listener(on_event)
+    except Exception:   # noqa: BLE001 — monitoring API moved
+        return False
+    _metrics_installed = True
+    return True
 
 
 def default_dir():
@@ -75,6 +133,9 @@ def enable(path=None):
     unknown option names are skipped individually.
     """
     global _enabled_dir
+    # compile telemetry is independent of the on-disk cache: count
+    # compiles even when the env disables persistence below
+    install_metrics()
     env = os.environ.get("VELES_COMPILE_CACHE", "")
     if env.lower() in ("0", "off", "false", "no"):
         return None

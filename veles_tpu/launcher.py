@@ -18,6 +18,7 @@ distributed runtime, build the mesh, start host-side services on process
 
 import os
 
+from veles_tpu import telemetry
 from veles_tpu.logger import Logger
 from veles_tpu.parallel import MeshConfig, make_mesh
 
@@ -133,7 +134,17 @@ class Launcher(Logger):
                     == "shard" and mc.data_size > 1
                     and getattr(loader, "on_device", None) is True):
                 loader.on_device = "defer"
-            self.workflow.initialize(**kwargs)
+            # initialization is where first-compiles land: span it so the
+            # metrics JSONL attributes that wall time correctly (and the
+            # TraceAnnotation names it in a device capture)
+            with telemetry.span("workflow.initialize", emit=True,
+                                workflow=self.workflow.name):
+                self.workflow.initialize(**kwargs)
+        telemetry.registry.gauge(
+            "veles_launcher_info",
+            "constant 1; run topology rides the labels",
+            ("mode", "processes")).set(
+            1, mode=self.mode, processes=self.num_processes)
         self._initialized = True
 
     def _verify_checksum(self):

@@ -13,13 +13,15 @@ Per-layer ``Forward`` units still exist as introspection/export handles
 (weights live in the trainer's param pytree; they expose views), keeping the
 reference's unit-graph UX without its dispatch cost."""
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veles_tpu import prng
+from veles_tpu import prng, telemetry
 from veles_tpu.config import root
-from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.base import CLASS_NAMES, TRAIN
 from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.models import optimizer
 from veles_tpu.ops import losses
@@ -121,6 +123,13 @@ class StagedTrainer(Unit):
         self.lr_scale = 1.0
         self.train_only_classes = (TRAIN,)
         self.view_group = "TRAINER"
+        #: step telemetry: per-class sweep accumulators
+        #: {cls: [t0, steps]} — opened by the first staged step of a
+        #: class sweep, closed (and emitted) at the read_class_stats
+        #: sync point, so sweep wall time includes the device work the
+        #: async dispatches deferred
+        self._sweep_ = {}
+        self._mem_watcher = None
 
     # ------------------------------------------------------------ building
     def initialize(self, **kwargs):
@@ -479,6 +488,7 @@ class StagedTrainer(Unit):
 
     def _run_step(self):
         loader = self.loader
+        self._note_step(loader.minibatch_class)
         if loader.carries_data:
             cls = loader.minibatch_class
             x, lbl, tgt = self._direct_batch(loader)
@@ -551,6 +561,16 @@ class StagedTrainer(Unit):
         epoch end) rides the per-step functions — both compiled once."""
         if not self._pending:
             return
+        # the fused dispatch is its own device-trace span: in an xplane
+        # capture the k-step scan shows up under the same name the host
+        # telemetry uses
+        ann = telemetry.trace_annotation()
+        if ann is None:
+            return self._flush_pending()
+        with ann("trainer.dispatch:%s" % self.name):
+            return self._flush_pending()
+
+    def _flush_pending(self):
         cls = self._pending_cls
         pending, self._pending = self._pending, []
         self._pending_cls = None
@@ -595,7 +615,84 @@ class StagedTrainer(Unit):
                     self.params, self.class_stats[cls], self._data_dev,
                     self._labels_dev, self._targets_dev, idx, valid)
 
+    def stop(self):
+        # a run stopped mid-sweep leaves an open accumulator whose t0
+        # would poison the NEXT run's first sweep (wall time spanning
+        # the idle gap → garbage examples/s and a spurious MFU
+        # shortfall); Workflow.run calls stop() on every unit at run
+        # end, so drop any un-emitted accumulator here
+        self._sweep_.clear()
+
     # ------------------------------------------------------------- metrics
+    def _note_step(self, cls):
+        """Open/advance the class sweep accumulator (host-side only —
+        no device sync; the wall clock closes at read_class_stats)."""
+        sw = self._sweep_.get(cls)
+        if sw is None:
+            self._sweep_[cls] = sw = [time.perf_counter(), 0]
+        sw[1] += 1
+
+    def _emit_step_telemetry(self, cls, stats):
+        """Close the class sweep at the read_class_stats sync point:
+        step counters, loss/examples-per-second gauges, the JSONL step
+        record, device-memory gauges, and (train classes) the
+        predicted-vs-measured MFU check.  Never raises — telemetry must
+        not kill the training loop it instruments."""
+        sw = self._sweep_.pop(cls, None)
+        if not sw or not sw[1]:
+            return
+        try:
+            self._emit_step_telemetry_inner(cls, stats, sw)
+        except Exception as e:   # noqa: BLE001 — observe, never abort
+            if not self.__dict__.get("_telemetry_error_warned_"):
+                self.__dict__["_telemetry_error_warned_"] = True
+                self.warning("step telemetry failed (%s: %s) — "
+                             "training continues, further telemetry "
+                             "errors are silenced", type(e).__name__, e)
+
+    def _emit_step_telemetry_inner(self, cls, stats, sw):
+        wall = time.perf_counter() - sw[0]
+        steps = sw[1]
+        name = CLASS_NAMES[cls]
+        examples = int(stats["count"])
+        loss_mean = stats["loss"] / max(examples, 1)
+        reg = telemetry.registry
+        lbl = {"class": name}
+        reg.counter("veles_steps_total", "staged steps dispatched",
+                    ("class",)).inc(steps, **lbl)
+        reg.counter("veles_examples_total", "examples processed",
+                    ("class",)).inc(examples, **lbl)
+        if wall > 0:
+            reg.gauge("veles_examples_per_sec",
+                      "examples/s over the last class sweep",
+                      ("class",)).set(examples / wall, **lbl)
+            reg.histogram("veles_step_wall_seconds",
+                          "mean per-step wall time per sweep "
+                          "(host dispatch + device, sync-point "
+                          "amortized)", ("class",)).observe(
+                wall / steps, **lbl)
+        reg.gauge("veles_loss", "mean per-example loss of the last "
+                  "class sweep", ("class",)).set(loss_mean, **lbl)
+        reg.emit("step", steps=steps, examples=examples, wall_s=wall,
+                 examples_per_sec=examples / wall if wall > 0 else 0.0,
+                 step_ms=wall / steps * 1e3, loss=loss_mean,
+                 loss_sum=stats["loss"], n_errors=stats["n_errors"],
+                 **lbl)
+        # the live-array census is the one per-sweep cost that scales
+        # with model size (O(arrays x shards) host walk): pay it only
+        # when something consumes it — an open --metrics-out sink or a
+        # started web-status /metrics scrape surface.  The MFU check
+        # stays unconditional: its pricing is computed once and cached,
+        # the per-sweep cost is a handful of float ops, and the
+        # shortfall warning is a log surface that must work bare.
+        if telemetry.collection_enabled():
+            if self._mem_watcher is None:
+                from veles_tpu.benchmark import Watcher
+                self._mem_watcher = Watcher()
+            self._mem_watcher.record(reg)
+        if cls in self.train_only_classes:
+            telemetry.mfu.check_step(self, steps, wall, registry=reg)
+
     def _zero_stats(self):
         return {"loss": jnp.zeros(()), "n_errors": jnp.zeros(()),
                 "count": jnp.zeros(())}
@@ -607,9 +704,14 @@ class StagedTrainer(Unit):
         """Device→host sync — called once per class sweep by Decision."""
         self.flush()
         st = jax.device_get(self.class_stats[cls])
-        return {"loss": float(st["loss"]),
-                "n_errors": int(st["n_errors"]),
-                "count": int(st["count"])}
+        stats = {"loss": float(st["loss"]),
+                 "n_errors": int(st["n_errors"]),
+                 "count": int(st["count"])}
+        # the sweep's wall clock closes HERE, after the device_get that
+        # drains every async dispatch — the only honest step-time sample
+        # the staged hot loop offers without adding sync points
+        self._emit_step_telemetry(cls, stats)
+        return stats
 
     # ---------------------------------------------------------- inspection
     def lint_staging_spec(self):

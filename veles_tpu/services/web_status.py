@@ -15,6 +15,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from veles_tpu import telemetry
 from veles_tpu.logger import Logger, events
 from veles_tpu.services.plotting import bus
 
@@ -29,6 +30,10 @@ td,th{border:1px solid #999;padding:4px 8px}
 .node{font-size:11px}.lane{font-size:10px;fill:#555}</style></head>
 <body><h2>veles_tpu status</h2>
 <div id="status"></div><h3>metrics</h3><div id="metrics"></div>
+<h3>telemetry <small>(process metrics registry —
+<a href="/metrics">prometheus</a> ·
+<a href="/api/telemetry">json</a>)</small></h3>
+<div id="mfu"></div><div id="telemetry"></div>
 <h3>serving <small>(ContinuousEngine slot pool: queue depth,
 p50/p99 queue-wait and per-stream decode rate)</small></h3>
 <div id="serving">(no serving endpoint registered)</div>
@@ -238,6 +243,24 @@ async function refresh(){
  document.getElementById('metrics').innerHTML =
   Object.entries(m).map(([k,pts])=>sparkSpan(k,pts)).join('')
   || '(no epoch metrics yet)';
+ const tl=await (await fetch('/api/telemetry')).json();
+ const mfu=(tl.records||[]).filter(r=>r.kind==='mfu').pop();
+ document.getElementById('mfu').innerHTML = mfu ?
+  '<b>MFU</b> predicted '+mfu.predicted.toPrecision(3)+
+  ' measured '+mfu.measured.toPrecision(3)+
+  ' ratio '+mfu.ratio.toPrecision(3)+
+  (mfu.warned?' <b style="color:#c00">SHORTFALL</b>':' ok')+
+  ' <small>('+esc(mfu.device)+' roofline)</small>' : '';
+ const trows=(tl.metrics||[]).filter(s=>s.kind!=='histogram')
+  .slice(0,60)
+  .map(s=>'<tr><td>'+esc(s.name)+'</td><td>'+
+   esc(Object.entries(s.labels).map(([k,v])=>k+'='+v).join(','))+
+   '</td><td align=right>'+
+   (typeof s.value==='number'?s.value.toPrecision(5):esc(s.value))+
+   '</td></tr>').join('');
+ document.getElementById('telemetry').innerHTML = trows ?
+  '<table><tr><th align=left>metric</th><th>labels</th>'+
+  '<th>value</th></tr>'+trows+'</table>' : '(no samples yet)';
  const g=await (await fetch('/api/graph')).json();
  document.getElementById('graph').innerHTML =
   Object.entries(g).map(([name,wf])=>
@@ -376,8 +399,14 @@ class WebStatusServer(Logger):
             import jax
             try:
                 jax.profiler.start_trace(d)
-                time.sleep(float(seconds))
-                jax.profiler.stop_trace()
+                try:
+                    time.sleep(float(seconds))
+                finally:
+                    # the profiler is a process-global singleton: an
+                    # exception mid-window (interrupted sleep, writer
+                    # error) must still stop the trace, or every later
+                    # capture fails with "profiler already running"
+                    jax.profiler.stop_trace()
                 state = {"running": False, "dir": d,
                          "done_at": time.time()}
             except Exception as e:   # noqa: BLE001 — surface via GET
@@ -524,6 +553,18 @@ class WebStatusServer(Logger):
                         self._send(404, b'{"error": "no capture yet"}')
                     else:
                         self._send(200, body)
+                elif self.path == "/metrics":
+                    # Prometheus scrape surface (text format 0.0.4)
+                    self._send(200,
+                               telemetry.registry.render_prometheus()
+                               .encode(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif self.path == "/api/telemetry":
+                    self._send(200, json.dumps(
+                        {"metrics": telemetry.registry.snapshot(),
+                         "records": telemetry.registry.records()[-60:]},
+                        default=str).encode())
                 elif self.path == "/api/bench":
                     self._send(200, json.dumps(server.bench_report(),
                                                default=str).encode())
@@ -589,6 +630,9 @@ class WebStatusServer(Logger):
             def log_message(self, fmt, *args):
                 server.debug("http: " + fmt, *args)
 
+        # /metrics is now scrapeable: turn on the costly collections
+        # (device-memory census) that are otherwise skipped
+        telemetry.enable_collection()
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,

@@ -1,0 +1,54 @@
+"""veles_tpu.telemetry — unified metrics registry, span tracing, and
+predicted-vs-measured MFU for every workflow run.
+
+The reference platform's operational story (master/slave status server,
+per-unit timing prints, device-memory accounting) lands here as one
+subsystem:
+
+* :mod:`~veles_tpu.telemetry.registry` — the process-global
+  :class:`MetricsRegistry` (counters/gauges/histograms, JSON-lines sink,
+  Prometheus text rendering; ``--metrics-out`` and the dashboard's
+  ``/metrics`` both read it);
+* :mod:`~veles_tpu.telemetry.spans` — host spans doubling as
+  ``jax.profiler.TraceAnnotation`` regions, with per-unit aggregation
+  replacing the ad-hoc ``Unit.run_time`` bookkeeping;
+* :mod:`~veles_tpu.telemetry.mfu` — roofline pricing of the staged step
+  (``tools/cost_model.py`` constants + ``ops/flops.py`` conventions) and
+  the measured-utilization tripwire;
+* :mod:`~veles_tpu.telemetry.cli` — the ``veles-tpu-metrics`` JSONL
+  summarizer.
+
+Import cost is stdlib-only; jax is touched lazily (first span under a
+live trace annotation), so platform pinning still works."""
+
+from veles_tpu.telemetry import mfu  # noqa: F401  (re-export)
+from veles_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
+from veles_tpu.telemetry.spans import (  # noqa: F401
+    SpanAggregate, emit_workflow_spans, span, trace_annotation)
+
+#: the process-global registry (the reference used one status-server
+#: session per run); everything instrument-shaped in the framework
+#: lands here unless an explicit registry is passed
+registry = MetricsRegistry()
+
+
+def get_registry():
+    return registry
+
+
+_collection = False
+
+
+def enable_collection():
+    """Mark that something will actually consume expensive collections
+    (the web-status ``/metrics`` scrape surface calls this on start;
+    an open JSONL sink implies it).  Cheap instruments update
+    regardless; only the costly sweeps — the ``Watcher`` live-array
+    census — key off this."""
+    global _collection
+    _collection = True
+
+
+def collection_enabled():
+    return _collection or registry.sink_path is not None

@@ -1,0 +1,168 @@
+"""``veles-tpu-metrics`` — summarize a ``--metrics-out`` JSON-lines file.
+
+A run's metrics JSONL interleaves live records (spans, step telemetry,
+MFU checks) with the end-of-run instrument dump.  This reads the whole
+file and prints the operator's view: run/step throughput, the per-unit
+time table, compile cost, device-memory high water, and the
+predicted-vs-measured MFU verdict.  ``--format json`` emits the same
+summary as one JSON object for scripting."""
+
+import argparse
+import json
+import sys
+
+
+def load_records(path):
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    return records, bad
+
+
+def summarize(records):
+    """The summary dict ``main`` renders.  Aggregates are cumulative in
+    the stream, so "last record wins" per key."""
+    by_kind = {}
+    for r in records:
+        by_kind.setdefault(r.get("kind", "?"), []).append(r)
+
+    spans = {}
+    for r in by_kind.get("span", []):
+        if r.get("name") == "unit.run":
+            spans[(r.get("workflow"), r.get("unit"))] = r
+    workflow_runs = [r for r in by_kind.get("span", [])
+                     if r.get("name") == "workflow.run"]
+
+    steps = {}
+    for r in by_kind.get("step", []):
+        cls = r.get("class", "?")
+        agg = steps.setdefault(cls, {"sweeps": 0, "steps": 0,
+                                     "examples": 0, "wall_s": 0.0,
+                                     "last_loss": None})
+        agg["sweeps"] += 1
+        agg["steps"] += int(r.get("steps", 0))
+        agg["examples"] += int(r.get("examples", 0))
+        agg["wall_s"] += float(r.get("wall_s", 0.0))
+        if r.get("loss") is not None:
+            agg["last_loss"] = r["loss"]
+    for agg in steps.values():
+        agg["examples_per_sec"] = (agg["examples"] / agg["wall_s"]
+                                   if agg["wall_s"] > 0 else 0.0)
+
+    counters, gauges = {}, {}
+    for r in by_kind.get("counter", []):
+        key = (r.get("name"), tuple(sorted((r.get("labels") or {})
+                                           .items())))
+        counters[key] = r.get("value")
+    for r in by_kind.get("gauge", []):
+        key = (r.get("name"), tuple(sorted((r.get("labels") or {})
+                                           .items())))
+        gauges[key] = r.get("value")
+
+    compile_secs = sum(v for (n, _), v in counters.items()
+                       if n == "veles_compile_seconds_total")
+    compile_events = sum(v for (n, _), v in counters.items()
+                         if n == "veles_compile_events_total")
+    live_bytes = {dict(l).get("device", "?"): v for (n, l), v
+                  in gauges.items() if n == "veles_device_live_bytes"}
+    peak = [v for (n, _), v in gauges.items()
+            if n == "veles_device_peak_bytes"]
+
+    mfu_records = by_kind.get("mfu", [])
+    return {
+        "records": len(records),
+        "kinds": {k: len(v) for k, v in sorted(by_kind.items())},
+        "workflow_runs": [
+            {"workflow": r.get("workflow"), "dur_s": r.get("dur_s")}
+            for r in workflow_runs],
+        "units": sorted(
+            ({"workflow": wf, "unit": u,
+              "count": r.get("count"), "total_s": r.get("total_s"),
+              "mean_s": r.get("mean_s")} for (wf, u), r in spans.items()),
+            key=lambda x: -(x["total_s"] or 0.0)),
+        "steps": steps,
+        "compile": {"events": compile_events, "seconds": compile_secs},
+        "device_live_bytes": live_bytes,
+        "device_peak_bytes": peak[0] if peak else None,
+        "mfu": mfu_records[-1] if mfu_records else None,
+    }
+
+
+def _render_text(path, summary, bad):
+    out = ["%s: %d records (%s)%s" % (
+        path, summary["records"],
+        ", ".join("%s=%d" % kv for kv in summary["kinds"].items()),
+        " [%d unparseable lines]" % bad if bad else "")]
+    for r in summary["workflow_runs"]:
+        out.append("workflow %-20s %8.3fs" % (r["workflow"],
+                                              r["dur_s"] or 0.0))
+    if summary["units"]:
+        out.append("-- unit spans (aggregated; gated/skipped excluded)")
+        for u in summary["units"][:12]:
+            out.append("  %-28s %6d runs %9.3fs (mean %.3f ms)"
+                       % (u["unit"], u["count"] or 0, u["total_s"] or 0,
+                          1e3 * (u["mean_s"] or 0)))
+    if summary["steps"]:
+        out.append("-- step telemetry")
+        for cls, agg in sorted(summary["steps"].items()):
+            out.append(
+                "  %-12s %6d steps %8d examples %9.1f ex/s"
+                "  last loss %s"
+                % (cls, agg["steps"], agg["examples"],
+                   agg["examples_per_sec"],
+                   "%.4f" % agg["last_loss"]
+                   if agg["last_loss"] is not None else "-"))
+    comp = summary["compile"]
+    if comp["events"]:
+        out.append("-- compile: %d events, %.2fs total"
+                   % (comp["events"], comp["seconds"]))
+    if summary["device_peak_bytes"] is not None:
+        out.append("-- device memory: peak %.1f MiB%s" % (
+            summary["device_peak_bytes"] / 2 ** 20,
+            "; live " + ", ".join(
+                "%s %.1f MiB" % (d, b / 2 ** 20) for d, b
+                in sorted(summary["device_live_bytes"].items()))
+            if summary["device_live_bytes"] else ""))
+    m = summary["mfu"]
+    if m:
+        out.append(
+            "-- MFU vs %s roofline: predicted %.3g  measured %.3g  "
+            "ratio %.3f%s" % (m.get("device", "?"),
+                              m.get("predicted", 0.0),
+                              m.get("measured", 0.0),
+                              m.get("ratio", 0.0),
+                              "  ** SHORTFALL **"
+                              if m.get("warned") else ""))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-metrics",
+        description="summarize a --metrics-out JSONL file")
+    p.add_argument("path", help="metrics .jsonl written by "
+                   "`python -m veles_tpu ... --metrics-out FILE`")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    args = p.parse_args(argv)
+    try:
+        records, bad = load_records(args.path)
+    except OSError as e:
+        print("veles-tpu-metrics: %s" % e, file=sys.stderr)
+        return 2
+    summary = summarize(records)
+    if args.format == "json":
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(_render_text(args.path, summary, bad))
+    return 0 if records else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

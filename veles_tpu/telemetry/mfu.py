@@ -1,0 +1,177 @@
+"""Predicted-vs-measured MFU / roofline check for the staged step.
+
+TVM's central lesson (PAPERS.md) applied to the training loop: a cost
+model is only trustworthy when fed measured runtimes.  PR 2's static
+model (``tools/cost_model.py``) prices bench phases offline; this module
+prices the *actual configured* staged step — the layers the trainer
+built, the minibatch the loader feeds — and, at every train-class sweep,
+compares the utilization the chip actually delivered against that
+prediction.  A measured/predicted ratio below a configurable fraction
+(``root.common.telemetry.mfu_warn_fraction``, default 0.5) raises a
+warning metric: the "your step is leaving the roofline" tripwire a
+production fleet scrapes.
+
+FLOP counting follows the repo's analytic conventions
+(:mod:`veles_tpu.ops.flops`: fwd+bwd = 3x fwd matmul FLOPs, no padding
+in the numerator); the *time* prediction pads to the MXU grid and uses
+the calibrated device constants from ``tools/cost_model.py`` when that
+module is importable (repo checkouts), else the baked-in v5e defaults —
+same numbers, so predictions agree either way."""
+
+import math
+
+#: v5e fallback constants — MUST mirror tools/cost_model.py (which is
+#: preferred at runtime when importable; this copy only covers installed
+#: packages without the repo's tools/ directory)
+_FALLBACK = {
+    "name": "tpu-v5e", "peak_flops": 197e12, "eff_mxu": 0.440,
+    "hbm_bw": 819e9, "eff_bw": 0.8, "t_kernel": 4.3e-6,
+    "h_step": 67e-6, "t_dispatch": 4.09e-3,
+}
+
+
+def device_model():
+    """Calibrated device constants: ``tools.cost_model.device_constants()``
+    when the repo's tools/ is importable, else the baked-in v5e table."""
+    try:
+        from tools.cost_model import device_constants
+        return device_constants()
+    except Exception:   # noqa: BLE001 — installed without tools/
+        return dict(_FALLBACK)
+
+
+def _pad(x, m=128):
+    return int(math.ceil(x / m)) * m
+
+
+def _tree_elems(tree):
+    n = 0
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif node is not None:
+            size = getattr(node, "size", None)
+            if size is not None:
+                n += int(size)
+    return n
+
+
+def _layer_matmuls(layer, batch):
+    """[(m, k, n)] for the forward matmuls of one layer, or None when
+    the layer has no recognized matmul shape."""
+    if hasattr(layer, "n_in") and layer.output_shape:   # dense family
+        n_out = 1
+        for d in layer.output_shape:
+            n_out *= int(d)
+        return [(batch, int(layer.n_in), n_out)]
+    if hasattr(layer, "kx") and hasattr(layer, "n_kernels") \
+            and layer.output_shape and len(layer.output_shape) == 3:
+        ho, wo, _ = layer.output_shape        # conv via im2col mapping
+        k = int(layer.n_channels) * int(layer.kx) * int(layer.ky)
+        return [(batch * int(ho) * int(wo), k, int(layer.n_kernels))]
+    return None
+
+
+def price_staged_step(trainer):
+    """Roofline pricing of ONE train step of ``trainer``'s staged chain:
+    analytic FLOPs (numerator), padded-MXU compute time, optimizer HBM
+    traffic, kernel/dispatch/host floors — the per-workflow analogue of
+    ``tools/cost_model.predict_mlp``."""
+    dm = device_model()
+    batch = int(trainer.loader.minibatch_size)
+    flops_fwd = 0.0          # analytic (MFU numerator convention)
+    padded_fwd = 0.0         # what the MXU actually grinds through
+    param_elems = 0
+    n_param_layers = 0
+    for layer in trainer.layers:
+        if getattr(layer, "has_params", False):
+            n_param_layers += 1
+            param_elems += _tree_elems(trainer.params.get(layer.name))
+        mms = _layer_matmuls(layer, batch)
+        if mms is None:
+            if getattr(layer, "has_params", False):
+                # unrecognized parameterized layer: dense-equivalent
+                # floor — every param participates in one MAC per sample
+                n = _tree_elems(trainer.params.get(layer.name))
+                flops_fwd += 2.0 * batch * n
+                padded_fwd += 2.0 * batch * n
+            continue
+        for m, k, n in mms:
+            flops_fwd += 2.0 * m * k * n
+            padded_fwd += 2.0 * _pad(m) * _pad(k) * _pad(n)
+    flops_step = 3.0 * flops_fwd            # fwd + bwd = 3x fwd
+    # optimizer traffic, f32 sgd-momentum floor: w rd/wr, m rd/wr,
+    # grad rd = 5 passes (adam adds 2 more; a floor, not a ceiling)
+    hbm_bytes = param_elems * 4 * 5
+    t_compute = 3.0 * padded_fwd / (dm["peak_flops"] * dm["eff_mxu"])
+    t_hbm = hbm_bytes / (dm["hbm_bw"] * dm["eff_bw"])
+    # fused-kernel floor: ~7 kernels per parameterized layer (fwd 2 +
+    # bwd 3 + update 2) + ~8 for loss/stats (cost_model.predict_mlp)
+    kernels = 7 * n_param_layers + 8
+    spd = max(int(getattr(trainer, "steps_per_dispatch", 1)), 1)
+    predicted = (max(t_compute, t_hbm) + kernels * dm["t_kernel"]
+                 + dm["h_step"] + dm["t_dispatch"] / spd)
+    return {
+        "device": dm["name"],
+        "peak_flops": dm["peak_flops"],
+        "flops_per_step": flops_step,
+        "hbm_bytes_per_step": hbm_bytes,
+        "param_elems": param_elems,
+        "predicted_step_s": predicted,
+        "predicted_mfu": flops_step / (predicted * dm["peak_flops"]),
+    }
+
+
+def check_step(trainer, steps, wall_s, registry=None):
+    """Compare a finished train-class sweep (``steps`` staged steps in
+    ``wall_s`` wall seconds) against :func:`price_staged_step`.  Updates
+    the ``veles_mfu_*`` gauges, emits a ``kind="mfu"`` record carrying
+    BOTH ``predicted`` and ``measured``, and fires the shortfall warning
+    metric when measured/predicted falls below the configured
+    fraction."""
+    if registry is None:
+        from veles_tpu.telemetry import registry
+    if not steps or wall_s <= 0.0:
+        return None
+    pricing = trainer.__dict__.get("_mfu_pricing_")
+    if pricing is None:
+        pricing = price_staged_step(trainer)
+        trainer.__dict__["_mfu_pricing_"] = pricing
+    measured_step_s = wall_s / steps
+    measured_mfu = (pricing["flops_per_step"]
+                    / (measured_step_s * pricing["peak_flops"]))
+    predicted_mfu = pricing["predicted_mfu"]
+    ratio = measured_mfu / predicted_mfu if predicted_mfu else 0.0
+    from veles_tpu.config import root
+    frac = float(root.common.telemetry.get("mfu_warn_fraction", 0.5))
+    warned = ratio < frac
+    registry.gauge("veles_mfu_predicted",
+                   "roofline-predicted MFU of the staged step").set(
+        predicted_mfu)
+    registry.gauge("veles_mfu_measured",
+                   "measured MFU of the staged step").set(measured_mfu)
+    registry.gauge("veles_mfu_ratio",
+                   "measured/predicted MFU").set(ratio)
+    if warned:
+        registry.counter(
+            "veles_mfu_shortfall_total",
+            "train sweeps whose measured MFU fell below "
+            "mfu_warn_fraction of the prediction").inc()
+        if not trainer.__dict__.get("_mfu_warned_"):
+            trainer.__dict__["_mfu_warned_"] = True
+            trainer.warning(
+                "measured MFU %.3g is %.2fx the %s roofline prediction "
+                "%.3g (threshold %.2f) — the step is off the modeled "
+                "roofline (root.common.telemetry.mfu_warn_fraction "
+                "tunes this tripwire)",
+                measured_mfu, ratio, pricing["device"], predicted_mfu,
+                frac)
+    return registry.emit(
+        "mfu", predicted=predicted_mfu, measured=measured_mfu,
+        ratio=ratio, warned=warned, warn_fraction=frac,
+        device=pricing["device"], peak_flops=pricing["peak_flops"],
+        flops_per_step=pricing["flops_per_step"],
+        predicted_step_ms=pricing["predicted_step_s"] * 1e3,
+        measured_step_ms=measured_step_s * 1e3, steps=steps)

@@ -1,0 +1,385 @@
+"""Process-global metrics registry (ref: the reference's master status
+server + per-unit timing tables, veles/web_status.py:113-314 and
+units.py:805-817 — redesigned as a pull/scrape surface).
+
+One :class:`MetricsRegistry` holds every instrument the process creates:
+``Counter`` (monotonic), ``Gauge`` (set/inc/dec), ``Histogram``
+(bucketed observations), each optionally labeled.  Two export surfaces:
+
+* **JSON-lines sink** (``open_sink``): structured records — spans, step
+  telemetry, MFU checks — stream out as they happen via :meth:`emit`,
+  and :meth:`dump_state` appends one record per live instrument sample
+  (registered ``atexit`` when ``dump_at_exit=True``), so a run's
+  ``.jsonl`` is self-contained: what happened AND where every counter
+  ended up.
+* **Prometheus text format** (``render_prometheus``): the current
+  instrument state as a ``/metrics`` scrape body (served by
+  services.web_status) — the production-fleet surface the reference's
+  POST-driven status server never had.
+
+Everything here is stdlib-only and thread-safe under one lock: records
+arrive from the scheduler thread, service threads, and jax's compile
+listeners alike."""
+
+import atexit
+import bisect
+import json
+import math
+import os
+import re
+import threading
+import time
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+#: default histogram buckets (seconds-flavored, same spread as the
+#: Prometheus client default)
+DEFAULT_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt(v):
+    """Prometheus sample-value formatting: integers bare, floats via
+    repr (shortest round-trip), infinities as +Inf/-Inf."""
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _esc_help(s):
+    return str(s).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _esc_label(s):
+    return (str(s).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+class _Instrument(object):
+    kind = None
+
+    def __init__(self, registry, name, help="", labelnames=()):
+        if not _NAME_RE.match(name):
+            raise ValueError("invalid metric name %r" % name)
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError("invalid label name %r" % ln)
+        self._registry = registry
+        self._lock = registry._lock
+        self.name = name
+        self.help = help
+        #: declared order is preserved for the sample KEY; rendering
+        #: sorts by label name so the text output is deterministic
+        #: regardless of declaration order
+        self.labelnames = tuple(labelnames)
+        self._samples = {}
+
+    def _key(self, labels):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                "%s expects labels %s, got %s"
+                % (self.name, sorted(self.labelnames), sorted(labels)))
+        return tuple(str(labels[ln]) for ln in self.labelnames)
+
+    def _label_dict(self, key):
+        return dict(zip(self.labelnames, key))
+
+    def samples(self):
+        """[(label_dict, value)] — value is a float for counter/gauge,
+        a state dict for histograms."""
+        with self._lock:
+            return [(self._label_dict(k), v)
+                    for k, v in sorted(self._samples.items())]
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, amount=1.0, **labels):
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease" % self.name)
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = self._samples.get(key, 0.0) + amount
+
+    def value(self, **labels):
+        with self._lock:
+            return self._samples.get(self._key(labels), 0.0)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = float(value)
+
+    def inc(self, amount=1.0, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = self._samples.get(key, 0.0) + amount
+
+    def dec(self, amount=1.0, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels):
+        with self._lock:
+            return self._samples.get(self._key(labels), 0.0)
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help="", labelnames=(),
+                 buckets=DEFAULT_BUCKETS):
+        for reserved in ("le", "quantile"):
+            if reserved in labelnames:
+                # the bucket's own le label would duplicate it and
+                # produce exposition text scrapers reject wholesale
+                raise ValueError(
+                    "histogram %s: label name %r is reserved"
+                    % (name, reserved))
+        super(Histogram, self).__init__(registry, name, help, labelnames)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError("histogram %s needs at least one bucket"
+                             % name)
+        self.buckets = tuple(b)
+
+    def observe(self, value, **labels):
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._samples.get(key)
+            if st is None:
+                st = self._samples[key] = {
+                    "counts": [0] * len(self.buckets),
+                    "sum": 0.0, "count": 0}
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(self.buckets):
+                st["counts"][i] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    def state(self, **labels):
+        with self._lock:
+            st = self._samples.get(self._key(labels))
+            return None if st is None else {
+                "counts": list(st["counts"]), "sum": st["sum"],
+                "count": st["count"]}
+
+
+class MetricsRegistry(object):
+    """Instrument factory + export surface.  ``counter``/``gauge``/
+    ``histogram`` are create-or-return by name: asking twice with the
+    same name yields the same instrument; asking with a different kind
+    or label set raises (one name, one meaning)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}
+        self._sink = None
+        self._sink_path = None
+        self._records = []          # small ring of recent emit()s
+        self._records_cap = 512
+        self._atexit_registered = False
+
+    # ------------------------------------------------------- instruments
+    def _get(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            inst = self._metrics.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls) \
+                        or set(inst.labelnames) != set(labelnames):
+                    raise ValueError(
+                        "metric %r already registered as %s%s"
+                        % (name, inst.kind, sorted(inst.labelnames)))
+                return inst
+            inst = cls(self, name, help, labelnames, **kwargs)
+            self._metrics[name] = inst
+            return inst
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        """``buckets=None`` means "don't care" (DEFAULT_BUCKETS when
+        creating, whatever exists when returning); explicit buckets
+        that disagree with an existing instrument raise — same
+        one-name-one-meaning rule as kind/label mismatches."""
+        inst = self._get(Histogram, name, help, labelnames,
+                         buckets=buckets or DEFAULT_BUCKETS)
+        if buckets is not None \
+                and tuple(sorted(float(b) for b in buckets)) \
+                != inst.buckets:
+            raise ValueError(
+                "histogram %r already registered with buckets %s"
+                % (name, inst.buckets))
+        return inst
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    # ------------------------------------------------------- JSONL sink
+    def open_sink(self, path, dump_at_exit=False):
+        """Append structured records to ``path`` (created along with its
+        directory).  With ``dump_at_exit`` the final instrument state is
+        dumped and the sink closed at interpreter exit."""
+        with self._lock:
+            self.close_sink()
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._sink = open(path, "a")
+            self._sink_path = path
+        if dump_at_exit and not self._atexit_registered:
+            self._atexit_registered = True
+            atexit.register(self._atexit_dump)
+        return path
+
+    def _atexit_dump(self):
+        self._atexit_registered = False
+        if self._sink is not None:
+            self.dump_state()
+            self.close_sink()
+
+    @property
+    def sink_path(self):
+        return self._sink_path
+
+    def close_sink(self):
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+                self._sink_path = None
+
+    def emit(self, kind, **fields):
+        """One structured record: ``{"ts": now, "kind": kind, **fields}``
+        — appended to the JSONL sink (if open) and a small in-memory
+        ring (the dashboard's recent-records view)."""
+        record = {"ts": time.time(), "kind": kind}
+        record.update(fields)
+        with self._lock:
+            self._records.append(record)
+            if len(self._records) > self._records_cap:
+                del self._records[:self._records_cap // 2]
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(record, default=str)
+                                     + "\n")
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    # telemetry must never kill the loop it instruments
+                    # (ENOSPC, closed fd, ...): drop the sink, keep the
+                    # in-memory ring and /metrics alive
+                    path = self._sink_path
+                    try:
+                        self._sink.close()
+                    except OSError:
+                        pass
+                    self._sink = None
+                    self._sink_path = None
+                    import logging
+                    logging.getLogger("MetricsRegistry").warning(
+                        "metrics sink %s failed — telemetry JSONL "
+                        "disabled for the rest of the run", path)
+        return record
+
+    def records(self, kind=None):
+        with self._lock:
+            recs = list(self._records)
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return recs
+
+    def dump_state(self):
+        """Append one record per live instrument sample — counters,
+        gauges, histograms — so the JSONL file carries the final state,
+        not just the event stream."""
+        for inst in self.metrics():
+            for labels, value in inst.samples():
+                if inst.kind == "histogram":
+                    cum, counts = 0, []
+                    for le, c in zip(inst.buckets, value["counts"]):
+                        cum += c
+                        counts.append([le, cum])
+                    self.emit("histogram", name=inst.name, labels=labels,
+                              count=value["count"], sum=value["sum"],
+                              buckets=counts)
+                else:
+                    self.emit(inst.kind, name=inst.name, labels=labels,
+                              value=value)
+
+    # ------------------------------------------------------- prometheus
+    def render_prometheus(self):
+        """The registry as Prometheus exposition text (format 0.0.4):
+        families sorted by name, label names sorted within a sample,
+        samples sorted by label values — deterministic output, with
+        HELP/label-value escaping per the spec."""
+        lines = []
+        for inst in self.metrics():
+            lines.append("# HELP %s %s" % (inst.name,
+                                           _esc_help(inst.help)))
+            lines.append("# TYPE %s %s" % (inst.name, inst.kind))
+            for labels, value in inst.samples():
+                if inst.kind == "histogram":
+                    cum = 0
+                    for le, c in zip(inst.buckets, value["counts"]):
+                        cum += c
+                        lines.append("%s_bucket%s %s" % (
+                            inst.name,
+                            self._label_str(labels, le=_fmt(le)), cum))
+                    lines.append("%s_bucket%s %s" % (
+                        inst.name, self._label_str(labels, le="+Inf"),
+                        value["count"]))
+                    lines.append("%s_sum%s %s" % (
+                        inst.name, self._label_str(labels),
+                        _fmt(value["sum"])))
+                    lines.append("%s_count%s %s" % (
+                        inst.name, self._label_str(labels),
+                        value["count"]))
+                else:
+                    lines.append("%s%s %s" % (
+                        inst.name, self._label_str(labels), _fmt(value)))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    @staticmethod
+    def _label_str(labels, **extra):
+        items = sorted(labels.items()) + sorted(extra.items())
+        if not items:
+            return ""
+        return "{%s}" % ",".join(
+            '%s="%s"' % (k, _esc_label(v)) for k, v in items)
+
+    def snapshot(self):
+        """JSON-able instrument state for ``/api/telemetry``."""
+        out = []
+        for inst in self.metrics():
+            for labels, value in inst.samples():
+                rec = {"name": inst.name, "kind": inst.kind,
+                       "labels": labels}
+                if inst.kind == "histogram":
+                    rec["count"] = value["count"]
+                    rec["sum"] = value["sum"]
+                else:
+                    rec["value"] = value
+                out.append(rec)
+        return out
+
+    def reset(self):
+        """Drop every instrument and record and close the sink (tests)."""
+        with self._lock:
+            self.close_sink()
+            self._metrics.clear()
+            self._records[:] = []

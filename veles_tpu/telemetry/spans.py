@@ -1,0 +1,138 @@
+"""Span tracing: host wall-time spans that double as
+``jax.profiler.TraceAnnotation`` regions (ref: the reference's per-unit
+timing prints, veles/units.py:144-149/805-817 — aggregated instead of
+printed, and named identically in the device trace).
+
+``SpanAggregate`` is the per-site accumulator (count/total/min/max/last)
+that replaces the ad-hoc ``Unit.run_time``/``run_count`` bookkeeping;
+the ``span`` context manager times a region, enters a TraceAnnotation of
+the same name (so an xplane capture shows the host span's name against
+the device timeline), and optionally feeds an aggregate and/or emits a
+JSONL record."""
+
+import time
+
+_trace_annotation = None
+
+
+def trace_annotation():
+    """The ``jax.profiler.TraceAnnotation`` class, resolved lazily (the
+    first unit run, not import time — conftest/CLI code must be able to
+    pin the platform before jax wakes up), or None without jax."""
+    global _trace_annotation
+    if _trace_annotation is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _trace_annotation = TraceAnnotation
+        except Exception:   # noqa: BLE001 — no jax: spans stay host-only
+            _trace_annotation = False
+    return _trace_annotation or None
+
+
+class SpanAggregate(object):
+    """count/total/min/max/last seconds for one span site."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "last")
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = 0.0
+        self.last = 0.0
+
+    def add(self, seconds):
+        self.count += 1
+        self.total += seconds
+        self.last = seconds
+        if self.min is None or seconds < self.min:
+            self.min = seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = 0.0
+        self.last = 0.0
+
+    def record(self, **extra):
+        """JSONL-shaped summary of this aggregate."""
+        rec = {"name": self.name, "count": self.count,
+               "total_s": self.total, "max_s": self.max,
+               "mean_s": self.total / self.count if self.count else 0.0}
+        rec.update(extra)
+        return rec
+
+
+class span(object):
+    """``with span("unit.run:loader")`` — wall-times the body, shares the
+    name with the device trace via TraceAnnotation, and on exit feeds
+    ``aggregate`` and/or emits a ``kind="span"`` record when
+    ``emit=True`` (extra kwargs become record fields)."""
+
+    def __init__(self, name, aggregate=None, emit=False, registry=None,
+                 **fields):
+        self.name = name
+        self.aggregate = aggregate
+        self.emit = emit
+        self.registry = registry
+        self.fields = fields
+        self.seconds = None
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        ann = trace_annotation()
+        if ann is not None:
+            self._ann = ann(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+            self._ann = None
+        if self.aggregate is not None:
+            self.aggregate.add(self.seconds)
+        if self.emit:
+            reg = self.registry
+            if reg is None:
+                from veles_tpu.telemetry import registry as _default
+                reg = _default
+            reg.emit("span", name=self.name, dur_s=self.seconds,
+                     **self.fields)
+        return False
+
+
+def emit_workflow_spans(workflow, wall_s, registry=None):
+    """End-of-run span export: one ``workflow.run`` record plus one
+    aggregated ``unit.run`` record per unit that actually ran (units a
+    gate blocked or skipped for the whole run have ``count == 0`` and
+    are excluded), mirrored into per-unit gauges for ``/metrics``."""
+    if registry is None:
+        from veles_tpu.telemetry import registry
+    registry.emit("span", name="workflow.run", workflow=workflow.name,
+                  dur_s=wall_s)
+    # gauges (set to the aggregate each run end), so no _total suffix:
+    # that's counter-reserved in prometheus naming and rate() over a
+    # set-once-per-run series would lie
+    g_time = registry.gauge(
+        "veles_unit_run_seconds",
+        "total seconds spent inside unit.run(), per unit "
+        "(set at each workflow run end)", ("workflow", "unit"))
+    g_runs = registry.gauge(
+        "veles_unit_runs", "unit.run() invocations, per unit "
+        "(set at each workflow run end)", ("workflow", "unit"))
+    for u in workflow.units:
+        agg = getattr(u, "span", None)
+        if agg is None or not agg.count:
+            continue
+        registry.emit("span", **agg.record(
+            workflow=workflow.name, unit=u.name, cls=type(u).__name__))
+        g_time.set(agg.total, workflow=workflow.name, unit=u.name)
+        g_runs.set(agg.count, workflow=workflow.name, unit=u.name)

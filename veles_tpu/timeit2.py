@@ -14,8 +14,13 @@ def timeit(fn, *args, **kwargs):
     t0 = time.perf_counter()
     result = fn(*args, **kwargs)
     if _jax is not None:
-        try:
-            _jax.block_until_ready(result)
-        except TypeError:
-            pass
+        # flatten and block ONLY on array leaves: a mixed pytree (arrays
+        # next to strings/None/ints) must still report device time — the
+        # old blanket block_until_ready raised TypeError on the first
+        # non-array leaf and a wholesale `except TypeError` silently
+        # timed dispatch instead of compute
+        leaves = [x for x in _jax.tree_util.tree_leaves(result)
+                  if isinstance(x, _jax.Array)]
+        if leaves:
+            _jax.block_until_ready(leaves)
     return result, time.perf_counter() - t0

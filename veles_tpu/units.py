@@ -18,6 +18,7 @@ SURVEY.md §5 "race detection")."""
 
 import time
 
+from veles_tpu import telemetry
 from veles_tpu.logger import Logger
 from veles_tpu.mutable import Bool
 from veles_tpu.registry import UnitRegistry
@@ -40,8 +41,11 @@ class Unit(Logger, metaclass=UnitRegistry):
         self._linked_attrs_ = {}
         self._demanded_ = set()
         self._initialized = False
-        self.run_count = 0
-        self.run_time = 0.0
+        #: per-unit span aggregate (telemetry): count/total/min/max/last
+        #: seconds inside run() — the structured replacement for the old
+        #: run_count/run_time counters, which remain as compatibility
+        #: properties over it
+        self.span = telemetry.SpanAggregate("unit.run")
         #: per-call duration prints: per-unit ``timings=True`` kwarg or
         #: the global ``root.common.timings`` (ref units.py:144-149)
         if "timings" in kwargs:
@@ -57,6 +61,25 @@ class Unit(Logger, metaclass=UnitRegistry):
     # ------------------------------------------------------------------ repr
     def __repr__(self):
         return "<%s %r>" % (type(self).__name__, self.name)
+
+    # -------------------------------------------------- span compatibility
+    @property
+    def run_count(self):
+        """Times run() has executed (compatibility view of ``span``)."""
+        return self.span.count
+
+    @run_count.setter
+    def run_count(self, value):
+        self.span.count = int(value)
+
+    @property
+    def run_time(self):
+        """Total seconds inside run() (compatibility view of ``span``)."""
+        return self.span.total
+
+    @run_time.setter
+    def run_time(self, value):
+        self.span.total = float(value)
 
     # -------------------------------------------------------- control links
     def link_from(self, *units):
@@ -187,11 +210,18 @@ class Unit(Logger, metaclass=UnitRegistry):
         return result
 
     def _run_wrapped(self):
+        # host span doubling as a device-trace annotation: an xplane
+        # capture shows "unit.run:<name>" against the TPU timeline under
+        # the same name the host-side aggregates use
+        ann = telemetry.trace_annotation()
         t0 = time.perf_counter()
-        self.run()
+        if ann is not None:
+            with ann("unit.run:%s" % self.name):
+                self.run()
+        else:
+            self.run()
         dt = time.perf_counter() - t0
-        self.run_count += 1
-        self.run_time += dt
+        self.span.add(dt)
         if self.timings:
             # per-call duration print (ref units.py:144-149: per-unit
             # timings=True kwarg or the global root.common.timings)

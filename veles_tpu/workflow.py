@@ -18,6 +18,7 @@ import os
 import random
 import time
 
+from veles_tpu import telemetry
 from veles_tpu.logger import Logger
 from veles_tpu.mutable import Bool
 from veles_tpu.plumbing import EndPoint, StartPoint
@@ -164,6 +165,29 @@ class Workflow(Container):
             unit.reset_gate()  # clear stale pulses from a stopped prior run
         t0 = time.perf_counter()
         self.event("workflow", "begin")
+        with telemetry.span("workflow.run:%s" % self.name):
+            self._drive()
+        wall = time.perf_counter() - t0
+        self._run_time_ += wall
+        self.event("workflow", "end")
+        # span export: the workflow.run record plus aggregated per-unit
+        # spans (units that never ran — gate-blocked/skipped throughout —
+        # are excluded) into the JSONL sink and the /metrics gauges.
+        # Guarded: a telemetry failure here must not skip the unit
+        # stop() cleanup or the result file below
+        try:
+            telemetry.emit_workflow_spans(self, wall)
+        except Exception as e:   # noqa: BLE001 — observe, never abort
+            self.warning("workflow span export failed (%s: %s)",
+                         type(e).__name__, e)
+        for unit in self._units:
+            unit.stop()
+        if self.result_file:
+            self.write_results(self.result_file)
+
+    def _drive(self):
+        """The scheduler loop proper: walk the control graph from
+        start_point until the queue drains or ``stopped`` rises."""
         queue = collections.deque([self.start_point])
         queued = {self.start_point}
         can_break = None      # no-snapshotter fallback, decided once
@@ -201,12 +225,6 @@ class Workflow(Container):
                 if dst.open_gate(unit) and dst not in queued:
                     queue.append(dst)
                     queued.add(dst)
-        self._run_time_ += time.perf_counter() - t0
-        self.event("workflow", "end")
-        for unit in self._units:
-            unit.stop()
-        if self.result_file:
-            self.write_results(self.result_file)
 
     def on_workflow_finished(self):
         """EndPoint callback (ref workflow.py:373)."""
