@@ -429,6 +429,55 @@ class TestStagingAuditor:
         names = {n for n, _ in iter_primitives(fake_jaxpr)}
         assert "debug_callback" in names
 
+    def test_iter_primitives_recurses_into_cond_branch_lists(self):
+        """Satellite: jaxprs nested in LIST/TUPLE-valued eqn.params —
+        cond/switch carry their branches as a tuple of ClosedJaxprs —
+        must not be skipped by any auditor built on iter_primitives."""
+        import jax
+        import jax.numpy as jnp
+
+        from veles_tpu.analysis.staging import iter_primitives
+
+        def leaky_branch(x):
+            jax.debug.print("x={}", x)
+            return x * 2.0
+
+        def cond_fn(p, x):
+            return jax.lax.cond(p, leaky_branch, lambda x: x, x)
+
+        closed = jax.make_jaxpr(cond_fn)(True, jnp.zeros(()))
+        names = {n for n, _ in iter_primitives(closed.jaxpr)}
+        assert "cond" in names
+        assert "debug_callback" in names     # inside a branch list
+
+        def switch_fn(i, x):
+            return jax.lax.switch(
+                i, [lambda x: x, leaky_branch, lambda x: -x], x)
+
+        closed = jax.make_jaxpr(switch_fn)(0, jnp.zeros(()))
+        names = {n for n, _ in iter_primitives(closed.jaxpr)}
+        assert "debug_callback" in names
+
+    def test_nested_containers_in_params_recurse(self):
+        """Dicts of lists of jaxprs (and vice versa) all unwrap."""
+        import jax
+        import jax.numpy as jnp
+        from types import SimpleNamespace
+
+        from veles_tpu.analysis.staging import iter_primitives
+
+        def leaky(x):
+            jax.debug.print("x={}", x)
+            return x
+
+        inner = jax.make_jaxpr(leaky)(jnp.zeros(()))
+        fake_eqn = SimpleNamespace(
+            primitive=SimpleNamespace(name="fake_call"),
+            params={"table": {"a": [inner], "b": ([inner],)}})
+        fake_jaxpr = SimpleNamespace(eqns=[fake_eqn])
+        names = {n for n, _ in iter_primitives(fake_jaxpr)}
+        assert "debug_callback" in names
+
     def test_lint_workflow_consumes_staging_hook(self):
         """lint_workflow pulls a unit's lint_staging_spec() and audits the
         staged step it describes (StagedTrainer exposes the same hook

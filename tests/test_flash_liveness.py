@@ -1,0 +1,125 @@
+"""Flash-kernel block-liveness helpers vs a dense reference mask
+(satellite of the numerics-auditor PR): ``_block_live`` must never skip
+a tile containing a valid (query, key) pair, and the shrunken
+``_k_span``/``_q_span`` windows must cover every live block — across
+odd sequence lengths, causal and sliding-window.  A liveness bug here
+is silent wrong attention output, so the reference is the dense mask
+itself, not another helper."""
+
+import numpy as np
+import pytest
+
+from veles_tpu.ops.pallas.flash import (_block_live, _k_lo, _k_span,
+                                        _q_lo, _q_span)
+
+
+def dense_mask(t, causal, window):
+    """valid[q, k] exactly as _masked_scores defines it (tq == tk)."""
+    q = np.arange(t)[:, None]
+    k = np.arange(t)[None, :]
+    valid = np.ones((t, t), bool)
+    if causal:
+        valid &= q >= k
+        if window is not None:
+            valid &= (q - k) < window
+    return valid
+
+
+def padded_blocks(t, block):
+    return -(-t // block)
+
+
+GEOMETRIES = [
+    # (t, block_q, block_k, causal, window)
+    (17, 8, 8, False, None),
+    (17, 8, 8, True, None),
+    (33, 16, 8, True, None),
+    (57, 16, 16, True, 9),
+    (57, 8, 16, True, 17),
+    (129, 32, 16, True, 32),
+    (65, 16, 32, True, 8),
+    (31, 32, 32, True, 5),     # single padded block
+]
+
+
+@pytest.mark.parametrize("t,block_q,block_k,causal,window", GEOMETRIES)
+def test_block_live_covers_every_valid_tile(t, block_q, block_k,
+                                            causal, window):
+    """Soundness: any tile holding >= 1 valid in-range (q, k) cell must
+    be live — a dead-but-needed tile silently zeroes attention."""
+    valid = dense_mask(t, causal, window)
+    nq, nk = padded_blocks(t, block_q), padded_blocks(t, block_k)
+    for qi in range(nq):
+        for ki in range(nk):
+            tile = valid[qi * block_q:(qi + 1) * block_q,
+                         ki * block_k:(ki + 1) * block_k]
+            if tile.any():
+                assert bool(_block_live(qi, ki, block_q, block_k,
+                                        causal, window)), \
+                    "tile (%d, %d) has valid cells but was skipped" \
+                    % (qi, ki)
+
+
+@pytest.mark.parametrize("t,block_q,block_k,causal,window", GEOMETRIES)
+def test_dead_tiles_have_no_valid_cells(t, block_q, block_k, causal,
+                                        window):
+    """Precision on in-range tiles: a tile _block_live declares dead
+    must contain zero valid cells (it is skipped entirely)."""
+    valid = dense_mask(t, causal, window)
+    nq, nk = padded_blocks(t, block_q), padded_blocks(t, block_k)
+    for qi in range(nq):
+        for ki in range(nk):
+            if not bool(_block_live(qi, ki, block_q, block_k, causal,
+                                    window)):
+                tile = valid[qi * block_q:(qi + 1) * block_q,
+                             ki * block_k:(ki + 1) * block_k]
+                assert not tile.any(), \
+                    "tile (%d, %d) was skipped but has valid cells" \
+                    % (qi, ki)
+
+
+@pytest.mark.parametrize("t,block_q,block_k,causal,window",
+                         [g for g in GEOMETRIES if g[4] is not None])
+def test_k_span_covers_live_blocks(t, block_q, block_k, causal,
+                                   window):
+    """The shrunken inner grid [k_lo, k_lo + span) must contain every
+    k block with a valid cell for its q block — an undersized span
+    drops contributions from in-window keys."""
+    valid = dense_mask(t, causal, window)
+    nq, nk = padded_blocks(t, block_q), padded_blocks(t, block_k)
+    span = _k_span(block_q, block_k, window, nk)
+    for qi in range(nq):
+        lo = int(_k_lo(qi, block_q, block_k, window))
+        live_ks = [ki for ki in range(nk)
+                   if valid[qi * block_q:(qi + 1) * block_q,
+                            ki * block_k:(ki + 1) * block_k].any()]
+        for ki in live_ks:
+            assert lo <= ki < lo + span, \
+                "q block %d: live k block %d outside span [%d, %d)" \
+                % (qi, ki, lo, lo + span)
+
+
+@pytest.mark.parametrize("t,block_q,block_k,causal,window",
+                         [g for g in GEOMETRIES if g[4] is not None])
+def test_q_span_covers_live_blocks(t, block_q, block_k, causal,
+                                   window):
+    """dK/dV walks q blocks per k block: [q_lo, q_lo + span) must
+    contain every q block attending to that k block."""
+    valid = dense_mask(t, causal, window)
+    nq, nk = padded_blocks(t, block_q), padded_blocks(t, block_k)
+    span = _q_span(block_q, block_k, window, nq)
+    for ki in range(nk):
+        lo = int(_q_lo(ki, block_q, block_k))
+        live_qs = [qi for qi in range(nq)
+                   if valid[qi * block_q:(qi + 1) * block_q,
+                            ki * block_k:(ki + 1) * block_k].any()]
+        for qi in live_qs:
+            assert lo <= qi < lo + span, \
+                "k block %d: live q block %d outside span [%d, %d)" \
+                % (ki, qi, lo, lo + span)
+
+
+def test_non_causal_is_all_live():
+    assert _block_live(0, 7, 8, 8, causal=False, window=None) is True
+    assert _k_span(8, 8, None, 9) == 9
+    assert _q_span(8, 8, None, 9) == 9

@@ -88,6 +88,23 @@ class Main(object):
                        "initializes the workflow on a virtual CPU "
                        "mesh) and exit non-zero on error findings — "
                        "no training, no compute dispatch")
+        p.add_argument("--numerics", action="store_true",
+                       help="with --lint: initialize the workflow "
+                       "(params allocate, no step dispatches) so the "
+                       "VN4xx/VR5xx numerics & determinism audit can "
+                       "trace the real staged train step; composes "
+                       "with --mesh")
+        p.add_argument("--vmem-kib", type=float, default=None,
+                       metavar="KiB",
+                       help="with --lint: per-core VMEM budget for the "
+                       "VP602 Pallas kernel-footprint rule (default "
+                       "16384, ~16 MiB)")
+        p.add_argument("--fail-on", choices=("error", "warning"),
+                       default="error", metavar="{error,warning}",
+                       help="with --lint: severity threshold for the "
+                       "non-zero exit (exit 0 = below threshold, 1 = "
+                       "reached — identical semantics to "
+                       "veles-tpu-lint)")
         p.add_argument("--result-file", default=None,
                        help="write gather_results() JSON here")
         p.add_argument("--export-dtype", default="float32",
@@ -483,8 +500,9 @@ class Main(object):
             if wf is None:
                 raise SystemExit("%s never called load(WorkflowClass, "
                                  "...) — nothing to lint" % args.workflow)
-            from veles_tpu.analysis import (format_findings, has_errors,
-                                            lint_workflow)
+            from veles_tpu.analysis import (format_findings,
+                                            lint_workflow,
+                                            threshold_reached)
             if args.mesh:
                 # --lint --mesh: initialize under the virtual CPU mesh
                 # so the VS2xx/VM3xx sharding/memory audit can lower the
@@ -492,9 +510,15 @@ class Main(object):
                 # ever dispatches — same contract as veles-tpu-lint)
                 from veles_tpu.analysis.cli import _attach_mesh
                 _attach_mesh(wf, self._parse_mesh(args.mesh), args.fsdp)
-            findings = lint_workflow(wf)
+            elif args.numerics:
+                # --lint --numerics: same contract, no mesh — the
+                # numerics auditor needs the real staged train step
+                from veles_tpu.analysis.cli import _initialize_plain
+                _initialize_plain(wf)
+            findings = lint_workflow(wf, vmem_kib=args.vmem_kib)
             print(format_findings(findings))
-            return 1 if has_errors(findings) else 0
+            return 1 if threshold_reached(findings,
+                                          args.fail_on) else 0
 
         if self._interactive_session is not None:
             try:

@@ -1,13 +1,17 @@
-"""veles_tpu.analysis — static workflow-graph linter + jit-staging +
-sharding/memory auditors.
+"""veles_tpu.analysis — static workflow-graph linter + jit-staging,
+sharding/memory and numerics/determinism auditors.
 
 Runs over a *constructed* (not initialized) Workflow: graph rules decide
 control/data-link correctness (graph_lint, VG...), the staging auditor
 abstractly traces staged step functions for host-sync and recompile
-hazards (staging, VJ...), and the sharding/memory auditor lowers the
+hazards (staging, VJ...), the sharding/memory auditor lowers the
 staged step under its device mesh and lints the collectives and the
 per-device HBM picture (sharding_audit, VS2xx/VM3xx — needs an
-initialized workflow with a mesh, e.g. ``veles-tpu-lint --mesh 2x2``).
+initialized workflow with a mesh, e.g. ``veles-tpu-lint --mesh 2x2``),
+and the numerics/determinism auditor walks the staged step's jaxpr for
+NaN/overflow/precision hazards, PRNG misuse, and Pallas-kernel
+tile/VMEM mis-sizing (numerics_audit, VN4xx/VR5xx/VP6xx — needs an
+initialized workflow, e.g. ``veles-tpu-lint --numerics``).
 Surface: :func:`lint_workflow` in-process, the ``veles-tpu-lint``
 console script, and ``python -m veles_tpu ... --lint``.
 
@@ -15,13 +19,15 @@ Rule catalog and severities: docs/static_analysis.md."""
 
 from veles_tpu.analysis.findings import (ERROR, INFO, SEVERITIES, WARNING,
                                          Finding, format_findings,
-                                         has_errors, sort_findings)
+                                         has_errors, sort_findings,
+                                         threshold_reached)
 from veles_tpu.analysis.graph_lint import lint_graph
 from veles_tpu.analysis.staging import audit_step
 
 __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
-           "format_findings", "has_errors", "sort_findings", "lint_graph",
-           "audit_step", "audit_sharded_step", "lint_workflow"]
+           "format_findings", "has_errors", "sort_findings",
+           "threshold_reached", "lint_graph", "audit_step",
+           "audit_sharded_step", "audit_numerics", "lint_workflow"]
 
 
 def audit_sharded_step(spec, hbm_gib=None):
@@ -32,13 +38,29 @@ def audit_sharded_step(spec, hbm_gib=None):
     return sharding_audit.audit_sharded_step(spec, hbm_gib=hbm_gib)
 
 
-def lint_workflow(wf, staging=True, sharding=True, hbm_gib=None):
+def audit_numerics(spec=None, launches=None, vmem_kib=None,
+                   prng_registry=True):
+    """Numerics/determinism/Pallas audit (VN4xx/VR5xx/VP6xx) — see
+    :mod:`veles_tpu.analysis.numerics_audit` (lazy for the same
+    reason)."""
+    from veles_tpu.analysis import numerics_audit
+    return numerics_audit.audit_numerics(
+        spec=spec, launches=launches, vmem_kib=vmem_kib,
+        prng_registry=prng_registry)
+
+
+def lint_workflow(wf, staging=True, sharding=True, numerics=True,
+                  hbm_gib=None, vmem_kib=None):
     """All analysis passes over ``wf``: every graph rule, the staging
-    audit of any unit exposing ``lint_staging_spec()``, and the
-    sharding/memory audit of any unit exposing ``lint_sharding_spec()``
-    (e.g. StagedTrainer after initialize() under a mesh — the two hooks
-    are complementary: the staging hook covers the single-device step,
-    the sharding hook the mesh step).  Returns sorted Findings."""
+    audit of any unit exposing ``lint_staging_spec()``, the
+    sharding/memory audit of any unit exposing ``lint_sharding_spec()``,
+    and the numerics audit of any unit exposing ``lint_numerics_spec()``
+    (StagedTrainer exposes all three after initialize(); the specs are
+    complementary — staging covers the single-device step, sharding the
+    mesh step, numerics the step's value ranges and randomness either
+    way).  The numerics pass also audits the global prng registry
+    (VR501) and every registered Pallas kernel's configured launch
+    geometry (VP6xx) exactly once.  Returns sorted Findings."""
     findings = lint_graph(wf)
     for unit in [wf] + list(wf.units):
         if staging:
@@ -59,4 +81,17 @@ def lint_workflow(wf, staging=True, sharding=True, hbm_gib=None):
                 if spec:   # None: no mesh, or not initialized yet
                     findings.extend(audit_sharded_step(spec,
                                                        hbm_gib=hbm_gib))
+        if numerics:
+            hook = getattr(unit, "lint_numerics_spec", None)
+            if callable(hook):
+                spec = hook()
+                if spec:   # None: not initialized yet
+                    from veles_tpu.analysis import numerics_audit
+                    findings.extend(
+                        numerics_audit.audit_numerics_step(spec))
+    if numerics:
+        # registry + kernel geometry are workflow-global: once, not
+        # per-unit (and still audited when no unit exposes a spec)
+        findings.extend(audit_numerics(
+            None, vmem_kib=vmem_kib, prng_registry=True))
     return sort_findings(findings)

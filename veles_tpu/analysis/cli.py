@@ -110,6 +110,15 @@ def _force_cpu_devices(axes):
         pass           # late to repoint, construction won't dispatch
 
 
+def _initialize_plain(wf):
+    """Initialize the workflow on the (forced-CPU) default device so
+    the staged steps exist for the numerics auditor — parameters are
+    allocated, no training step ever dispatches (the ``--mesh``
+    contract, minus the mesh)."""
+    if not getattr(wf, "_initialized", False):
+        wf.initialize()
+
+
 def _attach_mesh(wf, axes, fsdp):
     """Build the MeshConfig and initialize the workflow under it (the
     Launcher's --mesh wiring, minus services/distributed): params are
@@ -136,8 +145,17 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         prog="veles-tpu-lint",
         description="static workflow-graph linter + jit-staging auditor "
-                    "+ sharding/memory auditor "
-                    "(rule catalog: docs/static_analysis.md)")
+                    "+ sharding/memory auditor + numerics/determinism "
+                    "auditor (rule catalog: docs/static_analysis.md)",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog="exit codes (identical across graph/staging/sharding/"
+               "numerics runs —\nanalysis.findings.threshold_reached is "
+               "the one gate):\n"
+               "  0  no findings at or above the --fail-on severity\n"
+               "  1  threshold reached (default --fail-on error: any "
+               "error finding)\n"
+               "  2  usage error (bad arguments, workflow file without "
+               "run(load, main))")
     p.add_argument("workflow", help="workflow .py file defining "
                    "run(load, main)")
     p.add_argument("config", nargs="?", help="config .py file executed "
@@ -158,6 +176,17 @@ def main(argv=None):
     p.add_argument("--fsdp", action="store_true",
                    help="audit with ZeRO-3 fully-sharded parameters "
                    "over the data axis (pairs with --mesh)")
+    p.add_argument("--numerics", action="store_true",
+                   help="initialize the workflow (params allocate, no "
+                   "step dispatches — composes with --mesh) so the "
+                   "VN4xx/VR5xx numerics & determinism audit can trace "
+                   "the real staged train step; the prng-registry "
+                   "(VR501) and Pallas kernel-geometry (VP6xx) rules "
+                   "run even without this flag")
+    p.add_argument("--vmem-kib", type=float, default=None, metavar="KiB",
+                   help="per-core VMEM budget the VP602 Pallas kernel "
+                   "footprint is judged against (default: "
+                   "numerics_audit.DEFAULT_VMEM_KIB = 16384, ~16 MiB)")
     p.add_argument("--hbm-gib", type=float, default=None, metavar="GiB",
                    help="per-device HBM capacity the VM300 peak "
                    "estimate is judged against (default: "
@@ -178,19 +207,19 @@ def main(argv=None):
     # env knobs must land before anything touches a jax backend
     _force_cpu_devices(axes)
 
-    from veles_tpu.analysis import (WARNING, format_findings, has_errors,
-                                    lint_workflow)
+    from veles_tpu.analysis import (format_findings, lint_workflow,
+                                    threshold_reached)
     wf = build_workflow(args.workflow, args.config, args.config_list)
     if axes:
         _attach_mesh(wf, axes, args.fsdp)
+    elif args.numerics:
+        _initialize_plain(wf)
     findings = lint_workflow(wf, staging=not args.no_staging,
-                             hbm_gib=args.hbm_gib)
+                             hbm_gib=args.hbm_gib,
+                             vmem_kib=args.vmem_kib)
     print(format_findings(findings, args.format))
     fail_on = ("warning" if args.strict else args.fail_on)
-    failed = has_errors(findings) or (
-        fail_on == "warning"
-        and any(f.severity == WARNING for f in findings))
-    return 1 if failed else 0
+    return 1 if threshold_reached(findings, fail_on) else 0
 
 
 if __name__ == "__main__":

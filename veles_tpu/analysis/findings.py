@@ -46,6 +46,20 @@ def has_errors(findings):
     return any(f.severity == ERROR for f in findings)
 
 
+def threshold_reached(findings, fail_on=ERROR):
+    """The ONE exit-code gate every lint surface shares
+    (``veles-tpu-lint`` and ``python -m veles_tpu --lint``): True when
+    any finding is at or above the ``fail_on`` severity — so
+    ``--fail-on`` means the same thing whether the findings came from
+    the graph, staging, sharding, or numerics passes.  Exit codes:
+    0 = below threshold, 1 = threshold reached, 2 = usage error."""
+    if fail_on not in SEVERITIES:
+        raise ValueError("fail_on must be one of %r, got %r"
+                         % (SEVERITIES, fail_on))
+    allowed = SEVERITIES[:SEVERITIES.index(fail_on) + 1]
+    return any(f.severity in allowed for f in findings)
+
+
 def format_findings(findings, fmt="text"):
     findings = sort_findings(findings)
     if fmt == "json":

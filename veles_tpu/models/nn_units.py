@@ -742,6 +742,75 @@ class StagedTrainer(Unit):
         return {"fn": step, "args": args, "carry_argnums": (1,),
                 "name": "%s.eval_step" % self.name}
 
+    def lint_numerics_spec(self):
+        """Numerics/determinism spec for the VN4xx/VR5xx auditor
+        (veles_tpu.analysis.numerics_audit): the REAL jitted train step
+        — the one with the grad, the loss reductions, and the per-step
+        fold_in — over abstract ``ShapeDtypeStruct`` mirrors.  Under a
+        mesh it reuses the sharding spec's mirrors (make_jaxpr accepts
+        them unchanged); single-device it mirrors the step's true
+        signature.  None before initialize() or for data-carrying
+        loaders (their minibatch never lives in the staged state)."""
+        step = getattr(self, "_train_step", None)
+        if step is None or self.loader.carries_data:
+            return None
+        loss_fn, _ = losses.get_loss(self.loss)
+        suppress = tuple(getattr(loss_fn, "numerics_suppress", ()))
+        # the staged step fn is framework code — the user's host calls
+        # (VR502's numpy.random scan) live in its callees: the loss
+        # evaluator and any layer defined outside veles_tpu
+        host_scan = [loss_fn]
+        for layer in self.layers:
+            mod = type(layer).__module__ or ""
+            if not mod.startswith("veles_tpu"):
+                host_scan.append(layer.apply)
+
+        def step_leaf_flags(args):
+            # vouch for the counters the auditor cannot see: the step
+            # arg (argnum 8) increments BEFORE dispatch (_run_step), so
+            # it is >= 1 inside the step, and the optimizer's step/micro
+            # slots (velocity tree) only ever count up from 0 — that is
+            # what proves adam's 1 - beta**t bias correction positive
+            flags, idx = {}, 0
+            for ai, a in enumerate(args):
+                for path, _leaf in \
+                        jax.tree_util.tree_flatten_with_path(a)[0]:
+                    if ai == 8:
+                        flags[idx] = ("pos", "nonneg")
+                    elif path and getattr(path[-1], "key", None) in \
+                            ("step", "micro"):
+                        flags[idx] = ("nonneg",)
+                    idx += 1
+            return flags
+
+        if self.mesh_config is not None:
+            spec = self.lint_sharding_spec()
+            if spec is None:
+                return None
+            return {"fn": spec["fn"], "args": spec["args"],
+                    "suppress": suppress, "host_scan": tuple(host_scan),
+                    "input_flags": step_leaf_flags(spec["args"]),
+                    "name": "%s.train_step" % self.name}
+
+        def abstract(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.result_type(a)), tree)
+
+        mb = self.loader.minibatch_size
+        args = (abstract(self.params), abstract(self.velocity),
+                abstract(self.class_stats[0]),
+                abstract(self._data_dev), abstract(self._labels_dev),
+                abstract(self._targets_dev),
+                jax.ShapeDtypeStruct((mb,), jnp.int32),
+                jax.ShapeDtypeStruct((mb,), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32))
+        return {"fn": step, "args": args, "suppress": suppress,
+                "host_scan": tuple(host_scan),
+                "input_flags": step_leaf_flags(args),
+                "name": "%s.train_step" % self.name}
+
     def lint_sharding_spec(self):
         """Sharding/memory spec for the VS2xx/VM3xx auditor
         (veles_tpu.analysis.sharding_audit): the REAL jitted train step

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veles_tpu.ops.pallas import autodetect_interpret
+from veles_tpu.ops.pallas import autodetect_interpret, register_kernel_audit
 
 NEG_INF = -1e30
 _LANES = 128          # m/l scratch padded to a full lane tile
@@ -526,3 +526,81 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return (dq[:, :tq].reshape(b, h, tq, d),
             dk[:, :tk].reshape(b, h, tk, d),
             dv[:, :tk].reshape(b, h, tk, d))
+
+
+# --------------------------------------------------------------------------
+# VP6xx launch-audit hook (analysis.numerics_audit): the SAME geometry
+# the pallas_calls above launch — block tiles per in/out spec, VMEM
+# scratch per scratch_shapes, grid divisibility — described as data.
+# Pure arithmetic: nothing is traced, compiled, or dispatched.
+# --------------------------------------------------------------------------
+
+def audit_launch(tq, tk, d, dtype=jnp.bfloat16, causal=False,
+                 block_q=None, block_k=None, window=None, masked=True,
+                 checked=()):
+    """Launch descriptions for one flash configuration — forward, dQ
+    and dK/dV kernels.  ``masked=True`` reflects what the kernels
+    actually do (``_pad_to`` + validity mask — the VP601 escape hatch);
+    the tests pin a ``masked=False`` description to prove VP601 fires
+    when a kernel does not."""
+    if block_q is None:
+        block_q = 128
+    if block_k is None:
+        block_k = 128
+    block_q = min(block_q, max(tq, 8))
+    block_k = min(block_k, max(tk, 8))
+    # the lane dim of every head-dim tile IS the model's head dim —
+    # geometry, not a tunable block choice (full_lane exempts it from
+    # VP600; d=64 models are real and the kernel handles the half-tile)
+    hd = {"full_lane": True}
+    qkv = [("q", (1, block_q, d), dtype, hd),
+           ("k", (1, block_k, d), dtype, hd),
+           ("v", (1, block_k, d), dtype, hd)]
+    grid = [("q-blocks", tq, block_q), ("k-blocks", tk, block_k)]
+    fwd = {
+        "kernel": "flash.forward", "masked": masked, "checked": checked,
+        "blocks": qkv + [("o", (1, block_q, d), dtype, hd),
+                         ("lse", (1, block_q, _LANES), jnp.float32)],
+        "scratch": [("acc", (block_q, d), jnp.float32),
+                    ("m", (block_q, _LANES), jnp.float32),
+                    ("l", (block_q, _LANES), jnp.float32)],
+        "grid_axes": grid,
+    }
+    resid = [("do", (1, block_q, d), dtype, hd),
+             ("lse", (1, block_q, _LANES), jnp.float32),
+             ("delta", (1, block_q, _LANES), jnp.float32)]
+    bwd_dq = {
+        "kernel": "flash.bwd_dq", "masked": masked, "checked": checked,
+        "blocks": qkv + resid + [("dq", (1, block_q, d), dtype, hd)],
+        "scratch": [("dq_acc", (block_q, d), jnp.float32)],
+        "grid_axes": grid,
+    }
+    bwd_dkv = {
+        "kernel": "flash.bwd_dkv", "masked": masked, "checked": checked,
+        "blocks": qkv + resid + [("dk", (1, block_k, d), dtype, hd),
+                                 ("dv", (1, block_k, d), dtype, hd)],
+        "scratch": [("dk_acc", (block_k, d), jnp.float32),
+                    ("dv_acc", (block_k, d), jnp.float32)],
+        "grid_axes": grid,
+    }
+    return [fwd, bwd_dq, bwd_dkv]
+
+
+@register_kernel_audit("flash")
+def _configured_launches():
+    """The block sizes ``flash_attention`` would actually pick from the
+    site config, audited at both head-dim regimes (the d=128 flashtune
+    keys and the d<=64 ``*_d64`` keys) in the MXU-native bf16."""
+    from veles_tpu.config import root
+    fcfg = root.common.engine.flash
+    t = 1024
+    launches = audit_launch(
+        t, t, 128, causal=True,
+        block_q=int(fcfg.get("block_q", 128)),
+        block_k=int(fcfg.get("block_k", 128)))
+    cap = max(128, min(1024, -(-t // 128) * 128))
+    launches += audit_launch(
+        t, t, 64, causal=True,
+        block_q=int(fcfg.get("block_q_d64", cap)),
+        block_k=int(fcfg.get("block_k_d64", cap)))
+    return launches

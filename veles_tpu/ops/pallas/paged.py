@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veles_tpu.ops.pallas import autodetect_interpret
+from veles_tpu.ops.pallas import autodetect_interpret, register_kernel_audit
 
 NEG_INF = -1e30
 _LANES = 128
@@ -176,3 +176,41 @@ def paged_attention_reference(q, pool_k, pool_v, table, pos,
     o = jnp.einsum("bkgt,bktd->bkgd", p.astype(v.dtype), v,
                    preferred_element_type=jnp.float32)
     return o.reshape(b, hq, hd).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# VP6xx launch-audit hook (analysis.numerics_audit): the decode
+# kernel's launch geometry as data — pure arithmetic, nothing traced.
+# --------------------------------------------------------------------------
+
+def audit_launch(hd, bs, g=1, dtype=jnp.bfloat16, nbm=32, masked=True,
+                 checked=()):
+    """Launch description for one paged-decode configuration.  ``bs``
+    is the KV pool block (PagedContinuousBatcher ``block``), ``g`` the
+    query-group size (Hq/Hkv) — padded to the sublane tile exactly as
+    ``paged_attention_decode`` does."""
+    gp = max(g, _MIN_G)
+    return [{
+        "kernel": "paged.decode", "masked": masked, "checked": checked,
+        "blocks": [("q", (1, 1, gp, hd), dtype, {"full_lane": True}),
+                   ("k", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                   ("v", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                   ("o", (1, 1, gp, hd), dtype, {"full_lane": True})],
+        "scratch": [("acc", (gp, hd), jnp.float32),
+                    ("m", (gp, _LANES), jnp.float32),
+                    ("l", (gp, _LANES), jnp.float32)],
+        # every row reads up to its own length; dead blocks hit the
+        # reserved dummy block and their scores are masked
+        "grid_axes": [("pool-blocks", nbm * bs, bs)],
+    }]
+
+
+@register_kernel_audit("paged")
+def _configured_launches():
+    """The serving default (``PagedContinuousBatcher`` block=16) at the
+    flagship head dim, bf16 — what ``--serve`` with paged KV would
+    launch."""
+    from veles_tpu.config import root
+    serve = root.common.get("serve", {})
+    bs = int(serve.get("paged_block", 16) or 16)
+    return audit_launch(128, bs)

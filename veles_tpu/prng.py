@@ -18,11 +18,41 @@ Usage::
 """
 
 import hashlib
+import logging
 
 import jax
 import numpy as np
 
 from veles_tpu.config import root
+
+#: derived seed -> stream name, across every stream that auto-derived its
+#: seed.  The sha1 offset is 31 bits after the sign mask, so two names CAN
+#: collide (and genuinely do at the birthday rate, ~1% at 10k streams) —
+#: a collision means two "independent" streams replay each other draw for
+#: draw.  Detected here at derivation time and rehashed away
+#: deterministically; explicit seeds are the user's to collide (the
+#: VR501 numerics-audit rule reports those, analysis/numerics_audit.py).
+_derived_seeds = {}
+
+
+def _derive_seed(name, base):
+    """Per-name seed from the shared base: sha1 offset, then
+    deterministic rehash past any seed another name already derived.
+    Deterministic in (name, base, set of earlier derivations) — the
+    registry is populated in program order, which reproducible runs
+    replay exactly."""
+    salt = b""
+    while True:
+        h = int(hashlib.sha1(name.encode() + salt).hexdigest()[:8], 16)
+        seed = (int(base) ^ h) & 0x7FFFFFFF
+        owner = _derived_seeds.get(seed)
+        if owner is None or owner == name:
+            _derived_seeds[seed] = name
+            return seed
+        logging.getLogger("prng").warning(
+            "prng stream %r: derived seed %d collides with stream %r — "
+            "rehashing deterministically", name, seed, owner)
+        salt += b"#"
 
 
 class RandomGenerator(object):
@@ -35,9 +65,9 @@ class RandomGenerator(object):
     def seed(self, seed=None):
         if seed is None:
             base = root.common.get("random_seed", 1234)
-            # stable per-name offset so streams differ but derive from one seed
-            h = int(hashlib.sha1(self.name.encode()).hexdigest()[:8], 16)
-            seed = (int(base) ^ h) & 0x7FFFFFFF
+            # stable per-name offset so streams differ but derive from
+            # one seed; collisions after the 31-bit mask rehash away
+            seed = _derive_seed(self.name, base)
         self._seed = int(seed)
         self._counter = 0
 
@@ -103,10 +133,28 @@ def get(name="default"):
 
 def seed_all(seed):
     """Reset the base seed and re-seed every existing stream — the CLI
-    ``--random-seed`` entry point (ref __main__.py:483 _seed_random)."""
+    ``--random-seed`` entry point (ref __main__.py:483 _seed_random).
+    The derivation registry resets first so the rehash outcome is a
+    pure function of (base, stream creation order) — identical to a
+    fresh process that created the same streams."""
     root.common.random_seed = int(seed)
+    _derived_seeds.clear()
     for g in _streams.values():
         g.seed()
+
+
+def seed_collisions():
+    """Streams in the registry whose *effective* seeds collide, as
+    ``[(names, seed)]`` — the VR501 determinism rule's input
+    (analysis/numerics_audit.py).  Auto-derived seeds are rehashed
+    apart at creation, so anything here came from explicit seeding:
+    two streams with equal (seed, counter) words replay each other."""
+    by_seed = {}
+    for name, g in _streams.items():
+        by_seed.setdefault(g._seed, []).append(name)
+    return [(tuple(sorted(names)), seed)
+            for seed, names in sorted(by_seed.items())
+            if len(names) > 1]
 
 
 def states():
