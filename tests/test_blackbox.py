@@ -1,0 +1,505 @@
+"""Flight recorder, crash forensics & health watchdog
+(veles_tpu.telemetry.flight / .health / .blackbox): ring semantics under
+overflow and concurrency, atomic crashdump production (including from
+the fault-injection crash path), watchdog stall detection, multi-host
+desync detection, the /api/health surface, the Launcher service-leak
+fix, and the veles-tpu-blackbox merge CLI."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from veles_tpu.telemetry import blackbox, flight, health
+from veles_tpu.telemetry.flight import FlightRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def blackbox_dir(tmp_path):
+    """Point crashdumps at tmp and restore the config + any armed
+    watchdog afterwards (dumps must never land in the repo's
+    artifacts/ from a test)."""
+    from veles_tpu.config import root
+    prev = root.common.blackbox.get("dir", "artifacts")
+    root.common.blackbox.dir = str(tmp_path)
+    try:
+        yield tmp_path
+    finally:
+        root.common.blackbox.dir = prev
+        health.disarm_watchdog()
+
+
+class TestRing:
+    def test_overflow_keeps_newest_and_counts_dropped(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("e", i=i)
+        events = rec.snapshot()
+        assert len(events) == 8
+        assert [e["i"] for e in events] == list(range(12, 20))
+        assert rec.dropped == 12
+        assert rec.appended == 20
+
+    def test_concurrent_appends_no_corruption(self):
+        rec = FlightRecorder(capacity=100000)
+        n = 5000
+
+        def writer(tag):
+            for i in range(n):
+                rec.record("e", tag=tag, i=i)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = rec.snapshot()
+        assert len(events) == 2 * n and rec.appended == 2 * n
+        # per-thread order survives interleaving
+        for tag in ("a", "b"):
+            seq = [e["i"] for e in events if e["tag"] == tag]
+            assert seq == list(range(n))
+
+    def test_set_capacity_keeps_newest(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(16):
+            rec.record("e", i=i)
+        rec.set_capacity(4)
+        assert [e["i"] for e in rec.snapshot()] == [12, 13, 14, 15]
+
+    def test_record_reentrant_under_signal(self, blackbox_dir):
+        """A SIGTERM/SIGABRT handler records+dumps from the main thread
+        and may land while the interrupted frame is inside record()'s
+        critical section — the ring lock must be re-entrant or the
+        handler deadlocks its own thread."""
+        rec = FlightRecorder(capacity=8)
+        with rec._lock:             # simulate the interrupted section
+            rec.record("from-handler")
+            assert rec.dump(directory=str(blackbox_dir)) is not None
+        assert rec.snapshot()[-1]["kind"] == "from-handler"
+
+    def test_record_overhead_under_budget(self):
+        """Acceptance: ~2 µs/event budgeted; assert a generous CI bound
+        and print the measured number (documented in docs/services.md
+        next to the PR 3 span overhead)."""
+        rec = FlightRecorder(capacity=4096)
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            rec.record("bench", i=i)
+        per_event = (time.perf_counter() - t0) / n
+        print("flight.record overhead: %.2f us/event" % (per_event * 1e6))
+        assert per_event < 50e-6     # ~25x the 2 µs target: CI headroom
+
+
+class TestDump:
+    def test_dump_contents_and_atomicity(self, blackbox_dir):
+        rec = FlightRecorder(capacity=4)
+        for i in range(6):
+            rec.record("e", i=i)
+        d = rec.dump(directory=str(blackbox_dir), reason="unit-test",
+                     error=ValueError("boom"))
+        assert d and os.path.basename(d).startswith("crashdump-")
+        assert not [n for n in os.listdir(str(blackbox_dir))
+                    if n.endswith(".tmp-%d" % os.getpid())]
+        lines = [json.loads(l) for l in
+                 open(os.path.join(d, "events.jsonl"))]
+        assert lines[0]["kind"] == "flight.meta"
+        assert lines[0]["dropped"] == 2 and lines[0]["events"] == 4
+        assert [l["i"] for l in lines[1:]] == [2, 3, 4, 5]
+        meta = json.load(open(os.path.join(d, "meta.json")))
+        assert meta["reason"] == "unit-test"
+        assert meta["error"] == {"type": "ValueError",
+                                 "message": "boom"}
+        cfg = json.load(open(os.path.join(d, "config.json")))
+        assert "common" in cfg
+        metrics = json.load(open(os.path.join(d, "metrics.json")))
+        assert "metrics" in metrics and "records" in metrics
+        stacks = open(os.path.join(d, "stacks.txt")).read()
+        assert "MainThread" in stacks
+
+    def test_dump_reentrant_safe(self, blackbox_dir):
+        rec = FlightRecorder(capacity=4)
+        rec.record("e")
+        # a dump already in progress (watchdog racing an excepthook, or
+        # a crash inside the dump itself) degrades to None, no deadlock
+        assert rec._dump_lock.acquire(blocking=False)
+        try:
+            assert rec.dump(directory=str(blackbox_dir)) is None
+        finally:
+            rec._dump_lock.release()
+        assert rec.dump(directory=str(blackbox_dir)) is not None
+
+    def test_same_second_dumps_get_distinct_dirs(self, blackbox_dir):
+        rec = FlightRecorder(capacity=4)
+        rec.record("e")
+        d1 = rec.dump(directory=str(blackbox_dir))
+        d2 = rec.dump(directory=str(blackbox_dir))
+        assert d1 != d2 and os.path.isdir(d1) and os.path.isdir(d2)
+        assert rec.dump_count == 2 and rec.last_dump == d2
+
+    def test_dump_never_raises(self):
+        rec = FlightRecorder(capacity=4)
+        rec.record("e")
+        # unwritable target: black boxes fail soft, not loudly
+        assert rec.dump(directory="/proc/definitely-not-writable") \
+            is None
+
+
+class TestFaultInjectionCrashdump:
+    def test_fault_injected_run_writes_parseable_crashdump(
+            self, blackbox_dir, tmp_path):
+        """The existing simulated-crash path (death_probability →
+        os._exit(1)) must leave a black box behind — exercised end to
+        end in a subprocess, since the injected death takes the
+        interpreter with it."""
+        script = tmp_path / "crashy.py"
+        script.write_text(
+            "import sys\n"
+            "from veles_tpu.config import root\n"
+            "root.common.blackbox.dir = sys.argv[1]\n"
+            "from veles_tpu.workflow import Workflow\n"
+            "from veles_tpu.units import TrivialUnit\n"
+            "wf = Workflow(name='crashy', death_probability=1.0)\n"
+            "u = TrivialUnit(wf)\n"
+            "u.link_from(wf.start_point)\n"
+            "wf.initialize()\n"
+            "wf.run()\n")
+        out = tmp_path / "dumps"
+        out.mkdir()
+        r = subprocess.run(
+            [sys.executable, str(script), str(out)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     PYTHONPATH=REPO), cwd=REPO,
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1, r.stderr[-2000:]
+        assert "Traceback" not in r.stderr, r.stderr[-2000:]
+        dumps = [n for n in os.listdir(str(out))
+                 if n.startswith("crashdump-")]
+        assert len(dumps) == 1
+        d = blackbox.load_dump(str(out / dumps[0]))
+        assert d["meta"]["reason"] == "fault-injection"
+        kinds = [e["kind"] for e in d["events"]]
+        assert "fault.injected" in kinds and "workflow.start" in kinds
+        assert d["stacks"] and "MainThread" in d["stacks"]
+
+
+class TestWatchdog:
+    def test_stall_dumps_without_killing(self, blackbox_dir):
+        before = flight.recorder.dump_count
+        wd = health.arm_watchdog(0.25)
+        try:
+            deadline = time.time() + 5.0
+            while not wd.tripped and time.time() < deadline:
+                time.sleep(0.05)
+            assert wd.tripped, "watchdog never tripped on a stall"
+            assert flight.recorder.dump_count == before + 1
+            dumps = [n for n in os.listdir(str(blackbox_dir))
+                     if n.startswith("crashdump-")]
+            assert dumps, "no crashdump written by the watchdog"
+            meta = json.load(open(
+                str(blackbox_dir / dumps[0] / "meta.json")))
+            assert meta["reason"] == "watchdog"
+            # the run was not killed, and progress re-arms it
+            health.note_progress(step=123)
+            deadline = time.time() + 5.0
+            while wd.tripped and time.time() < deadline:
+                time.sleep(0.05)
+            assert not wd.tripped, "watchdog did not re-arm on progress"
+            # one dump per stall, not one per poll
+            assert flight.recorder.dump_count == before + 1
+        finally:
+            health.disarm_watchdog()
+
+    def test_disarmed_by_default_and_zero_window(self):
+        assert health.watchdog() is None
+        assert health.arm_watchdog(0) is None
+        assert health.watchdog() is None
+
+
+class TestMultihost:
+    def test_desync_detected_and_latched(self, blackbox_dir,
+                                         monkeypatch):
+        import numpy as np
+
+        import jax
+        from jax.experimental import multihost_utils
+        from veles_tpu.telemetry import MetricsRegistry
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda local: np.asarray([[0.0, 5.0, 0.1],
+                                      [1.0, 7.0, 0.4]]))
+        reg = MetricsRegistry()
+        before = flight.recorder.dump_count
+        health.enable_multihost()
+        try:
+            out = health.multihost_check(5, 0.1, registry=reg)
+            assert out["desync"] is True
+            assert out["skew_s"] == pytest.approx(0.3)
+            assert reg.gauge("veles_host_step", "", ("proc",)).value(
+                proc=1) == 7.0
+            assert reg.gauge(
+                "veles_step_wall_skew_seconds").value() \
+                == pytest.approx(0.3)
+            assert flight.recorder.dump_count == before + 1
+            kinds = [e["kind"] for e in flight.recorder.snapshot()]
+            assert "desync" in kinds
+            # latched: a second divergent heartbeat does not re-dump
+            health.multihost_check(6, 0.1, registry=reg)
+            assert flight.recorder.dump_count == before + 1
+        finally:
+            health.enable_multihost(False)
+
+    def test_agreeing_hosts_are_clean(self, monkeypatch):
+        import numpy as np
+
+        import jax
+        from jax.experimental import multihost_utils
+        from veles_tpu.telemetry import MetricsRegistry
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda local: np.asarray([[0.0, 5.0, 0.1],
+                                      [1.0, 5.0, 0.12]]))
+        health.enable_multihost()
+        try:
+            out = health.multihost_check(5, 0.1,
+                                         registry=MetricsRegistry())
+            assert out["desync"] is False
+        finally:
+            health.enable_multihost(False)
+
+    def test_disabled_is_free(self):
+        assert health.multihost_check(1, 0.1) is None
+
+
+class TestHealthEndpoint:
+    def test_api_health_and_503_on_trip(self, blackbox_dir):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+
+        from veles_tpu.services.web_status import WebStatusServer
+        server = WebStatusServer(port=0)
+        server.start()
+        try:
+            url = "http://127.0.0.1:%d/api/health" % server.port
+            state = json.load(urlopen(url))
+            assert state["pid"] == os.getpid()
+            assert state["watchdog"]["armed"] is False
+            assert "crashdumps" in state and "last_progress_age_s" \
+                in state
+            wd = health.arm_watchdog(0.2)
+            deadline = time.time() + 5.0
+            while not wd.tripped and time.time() < deadline:
+                time.sleep(0.05)
+            with pytest.raises(HTTPError) as err:
+                urlopen(url)
+            assert err.value.code == 503
+            body = json.load(err.value)
+            assert body["watchdog"]["tripped"] is True
+        finally:
+            health.disarm_watchdog()
+            server.stop()
+
+
+class TestLauncherIntegration:
+    def test_initialize_failure_stops_services(self, blackbox_dir):
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.workflow import Workflow
+
+        class Boom(Workflow):
+            def initialize(self, **kwargs):
+                raise RuntimeError("boom in initialize")
+
+        launcher = Launcher(workflow=Boom(name="boom"),
+                            web_status_port=0)
+        with pytest.raises(RuntimeError, match="boom in initialize"):
+            launcher.initialize()
+        # the satellite fix: web-status must not leak a live server
+        assert launcher.web_server is None
+        assert not launcher._initialized
+        kinds = [e["kind"] for e in flight.recorder.snapshot()]
+        assert "launcher.initialize_failed" in kinds
+
+    def test_boot_relies_on_initialize_cleanup(self, blackbox_dir):
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.workflow import Workflow
+
+        class Boom(Workflow):
+            def initialize(self, **kwargs):
+                raise RuntimeError("boot boom")
+
+        launcher = Launcher(workflow=Boom(name="boom2"),
+                            web_status_port=0)
+        with pytest.raises(RuntimeError, match="boot boom"):
+            launcher.boot()
+        assert launcher.web_server is None
+
+    def test_standalone_does_not_arm_watchdog(self, blackbox_dir):
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(name="quiet")
+        launcher = Launcher(workflow=wf)
+        launcher.initialize()
+        try:
+            assert health.watchdog() is None
+        finally:
+            launcher.stop()
+
+    def test_watchdog_config_arms_and_stop_disarms(self, blackbox_dir):
+        from veles_tpu.config import root
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.workflow import Workflow
+        root.common.blackbox.watchdog_seconds = 30
+        try:
+            launcher = Launcher(workflow=Workflow(name="wd"))
+            launcher.initialize()
+            wd = health.watchdog()
+            assert wd is not None and wd.window == 30
+            launcher.stop()
+            assert health.watchdog() is None
+        finally:
+            root.common.blackbox.watchdog_seconds = None
+
+    def test_spmd_auto_arms_and_explicit_zero_disarms(self,
+                                                      blackbox_dir):
+        from veles_tpu.config import root
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.workflow import Workflow
+        # unset → spmd arms at the spmd default window
+        launcher = Launcher(workflow=Workflow(name="spmd-wd"),
+                            mode="spmd")
+        launcher.initialize()
+        wd = health.watchdog()
+        assert wd is not None and wd.window == 300
+        launcher.stop()
+        # an EXPLICIT 0 (--watchdog 0) disarms even spmd
+        root.common.blackbox.watchdog_seconds = 0
+        try:
+            launcher = Launcher(workflow=Workflow(name="spmd-wd0"),
+                                mode="spmd")
+            launcher.initialize()
+            assert health.watchdog() is None
+            launcher.stop()
+        finally:
+            root.common.blackbox.watchdog_seconds = None
+
+
+class TestHealthInstall:
+    def test_install_uninstall_restores_hooks(self):
+        # an earlier Launcher test may have installed already — start
+        # from a known-clean state
+        health.uninstall()
+        prev_except = sys.excepthook
+        prev_thread = threading.excepthook
+        health.install(mode="test")
+        try:
+            assert sys.excepthook is not prev_except
+            assert threading.excepthook is not prev_thread
+            # idempotent: a second install only refreshes the mode
+            hook = sys.excepthook
+            health.install(mode="test2")
+            assert sys.excepthook is hook
+            assert health.status()["mode"] == "test2"
+        finally:
+            health.uninstall()
+        assert sys.excepthook is prev_except
+        assert threading.excepthook is prev_thread
+
+    def test_note_signal_records_and_dumps(self, blackbox_dir):
+        before = flight.recorder.dump_count
+        health.note_signal("SIGTERM")
+        assert flight.recorder.dump_count == before + 1
+        ev = [e for e in flight.recorder.snapshot()
+              if e["kind"] == "signal"][-1]
+        assert ev["signal"] == "SIGTERM"
+
+    def test_note_progress_and_age(self):
+        health.note_progress(step=42)
+        age = health.last_progress_age()
+        assert age is not None and age < 1.0
+        assert health.status()["last_step"] == 42
+
+
+class TestBlackboxCLI:
+    @staticmethod
+    def _make_dump(directory, proc, events):
+        rec = FlightRecorder(capacity=64)
+        for ts, kind, fields in events:
+            ev = rec.record(kind, **fields)
+            ev["ts"] = ts                   # deterministic timeline
+        d = rec.dump(directory=str(directory), reason="test")
+        meta_path = os.path.join(d, "meta.json")
+        meta = json.load(open(meta_path))
+        meta["process_index"] = proc
+        json.dump(meta, open(meta_path, "w"))
+        return d
+
+    def test_merge_two_process_dumps_one_timeline(self, tmp_path,
+                                                  capsys):
+        d0 = self._make_dump(tmp_path, 0,
+                             [(100.0, "step", {"step": 1}),
+                              (103.0, "step", {"step": 2})])
+        d1 = self._make_dump(tmp_path, 1,
+                             [(101.0, "step", {"step": 1}),
+                              (109.0, "hang", {"stalled_s": 6.0})])
+        dumps = [blackbox.load_dump(d0), blackbox.load_dump(d1)]
+        merged = blackbox.merge_timeline(dumps)
+        assert [(e["proc"], e["kind"]) for e in merged] == [
+            (0, "step"), (1, "step"), (0, "step"), (1, "hang")]
+        assert blackbox.main([d0, d1, "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["dumps"]) == 2 and len(out["events"]) == 4
+        assert blackbox.main([d0, d1]) == 0
+        text = capsys.readouterr().out
+        assert "[p0]" in text and "[p1]" in text and "hang" in text
+
+    def test_parent_dir_expansion_and_filters(self, tmp_path, capsys):
+        self._make_dump(tmp_path, 0, [(1.0, "step", {"step": 1}),
+                                      (2.0, "snapshot", {})])
+        assert blackbox.main([str(tmp_path), "--kind", "snapshot",
+                              "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert [e["kind"] for e in out["events"]] == ["snapshot"]
+
+    def test_not_a_dump_is_exit_2(self, tmp_path, capsys):
+        assert blackbox.main([str(tmp_path / "nope")]) == 2
+        assert blackbox.main([str(tmp_path)]) == 2
+
+
+class TestStepTelemetryIntegration:
+    def test_training_run_populates_flight_ring(self):
+        import numpy as np
+
+        from veles_tpu import prng
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models.standard_workflow import StandardWorkflow
+        prng.seed_all(7)
+        flight.recorder.clear()
+        x = np.random.RandomState(0).rand(48, 6).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 3, 48)
+        loader = FullBatchLoader(None, data=x, labels=y,
+                                 minibatch_size=16,
+                                 class_lengths=[0, 16, 32])
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 6},
+                    {"type": "softmax", "output_sample_shape": 3}],
+            loader=loader, decision_config={"max_epochs": 2},
+            name="bb-smoke")
+        wf.initialize()
+        wf.run()
+        kinds = {e["kind"] for e in flight.recorder.snapshot()}
+        assert {"workflow.start", "workflow.stop", "unit.start",
+                "unit.stop", "step"} <= kinds
+        steps = [e for e in flight.recorder.snapshot()
+                 if e["kind"] == "step"]
+        assert all("wall_s" in e and "class" in e for e in steps)
+        assert health.status()["last_step"] is not None

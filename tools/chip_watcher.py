@@ -31,6 +31,22 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOGDIR = os.path.join(ROOT, ".watcher")
 
+# flight-recorder hookup (fail-soft: the watcher must run on boxes
+# where veles_tpu cannot even import) — probes and bench steps join the
+# process flight ring, so a watcher crash dumps the probe/bench
+# timeline via the health excepthook
+sys.path.insert(0, ROOT)
+try:
+    from veles_tpu.telemetry import flight as _flight
+    from veles_tpu.telemetry import health as _health
+except Exception:   # noqa: BLE001 — observability is optional here
+    _flight = _health = None
+
+
+def _record(kind, **fields):
+    if _flight is not None:
+        _flight.record(kind, **fields)
+
 PROBE_CODE = ("import jax; d = jax.devices(); "
               "print('PROBE_OK', len(d), d[0].platform)")
 
@@ -65,6 +81,7 @@ def run_step(argv, tag, timeout, env=None):
         datetime.timezone.utc).strftime("%Y%m%d_%H%M%S")
     path = os.path.join(LOGDIR, "%s_%s.log" % (tag, stamp))
     _log("running %s -> %s" % (" ".join(argv), path))
+    _record("watcher.step.start", tag=tag, log=path)
     t0 = time.monotonic()
     try:
         proc = subprocess.run(argv, cwd=ROOT, capture_output=True,
@@ -79,6 +96,8 @@ def run_step(argv, tag, timeout, env=None):
     with open(path, "w") as f:
         f.write(out)
     _log("%s finished rc=%s in %.0fs" % (tag, rc, time.monotonic() - t0))
+    _record("watcher.step.stop", tag=tag, rc=rc,
+            dur_s=time.monotonic() - t0)
     return rc
 
 
@@ -94,6 +113,9 @@ def main():
                     help="give up after this many hours (0 = forever)")
     args = ap.parse_args()
     os.makedirs(LOGDIR, exist_ok=True)
+    if _health is not None:
+        # a watcher crash leaves the probe/bench timeline behind
+        _health.install(mode="watcher")
 
     deadline = (time.monotonic() + args.max_hours * 3600.0
                 if args.max_hours else None)
@@ -101,6 +123,8 @@ def main():
     while True:
         attempt += 1
         ok, detail = probe()
+        _record("watcher.probe", attempt=attempt, ok=ok,
+                detail=str(detail)[:200])
         if ok:
             _log("probe %d OK: %s" % (attempt, detail))
             if args.no_bench or args.once:

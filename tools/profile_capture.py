@@ -115,7 +115,17 @@ def main():
     import gc
 
     import jax
-    print("devices:", jax.devices(), flush=True)
+    try:
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — tunnel down / no accelerator
+        # fail soft: the capture tool runs on a cadence from watchers
+        # and CI boxes where the accelerator is usually absent — that is
+        # an expected outcome, not a traceback
+        print("no accelerator available (%s: %s) — nothing to profile"
+              % (type(e).__name__, e), flush=True)
+        return 0
+    print("devices:", devices, flush=True)
+    from veles_tpu.telemetry import flight
 
     # the same rung order phase_lm_large walks (single source of truth)
     from veles_tpu.ops.flops import LM_LARGE_LADDER
@@ -144,6 +154,10 @@ def main():
 
     tmpdir = os.path.join(ROOT, ".watcher", "profile_raw")
     shutil.rmtree(tmpdir, ignore_errors=True)
+    # the capture window joins the flight ring: a post-mortem of this
+    # process shows profiler-on/off bracketing the training steps
+    flight.record("profile.capture.start", outdir=args.outdir,
+                  steps=args.steps)
     t0 = time.perf_counter()
     with jax.profiler.trace(tmpdir):
         for _ in range(args.steps):
@@ -155,6 +169,8 @@ def main():
         # window before the device work ran
         jax.device_get(wf.trainer.class_stats[2]["loss"])
     wall = time.perf_counter() - t0
+    flight.record("profile.capture.stop", outdir=args.outdir,
+                  dur_s=wall)
     print("traced %d fused dispatches (4 train steps each) in %.1f ms"
           % (args.steps, wall * 1e3), flush=True)
 

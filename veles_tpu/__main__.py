@@ -232,6 +232,15 @@ class Main(object):
                        "(exit 1) — drills the checkpoint-restart "
                        "elasticity path under a restarting supervisor "
                        "(ref --slave-death-probability)")
+        p.add_argument("--watchdog", type=float, default=None,
+                       metavar="SECONDS",
+                       help="arm the hang watchdog: when no unit/step "
+                       "progress is observed for this many seconds, "
+                       "the flight record + all-thread stacks are "
+                       "dumped to an artifacts/crashdump-* directory "
+                       "(the run is NOT killed; read dumps with "
+                       "veles-tpu-blackbox).  Default: off standalone, "
+                       "300 s in spmd mode")
         p.add_argument("--sync-run", action="store_true",
                        help="block on the device after every trainer step "
                        "for honest per-unit timing (ref --sync-run, "
@@ -294,6 +303,8 @@ class Main(object):
             root.common.web.log_db = args.log_db
         if args.sync_run:
             root.common.engine.sync_run = True
+        if args.watchdog is not None:
+            root.common.blackbox.watchdog_seconds = args.watchdog
         if args.steps_per_dispatch is not None:
             root.common.engine.steps_per_dispatch = args.steps_per_dispatch
 
@@ -416,6 +427,13 @@ class Main(object):
                     # lands, and a reentrant-IO RuntimeError in print()
                     # must not lose the preemption request
                     wf.request_preempt()
+                    # this handler REPLACES the launcher-installed
+                    # health one — keep its black box: record + dump so
+                    # a pod eviction leaves the same forensics as a
+                    # crash (the run itself continues to the graceful
+                    # preemption checkpoint)
+                    from veles_tpu.telemetry import health as _health
+                    _health.note_signal("SIGTERM")
                     try:
                         print("SIGTERM: graceful preemption — "
                               "checkpointing at the next cycle, then "

@@ -83,6 +83,13 @@ def install_metrics():
             reg.counter("veles_compile_seconds_total",
                         "seconds spent in jax compile phases",
                         ("event",)).inc(duration, event=key)
+            # the black box wants compiles too: a post-mortem timeline
+            # where the last event is a 40 s backend_compile explains a
+            # "hang" that was really a recompile storm — and compiling
+            # IS progress, so the hang watchdog must not trip on it
+            telemetry.flight.record("compile", event=key,
+                                    dur_s=duration)
+            telemetry.health.note_progress()
         except Exception:   # noqa: BLE001
             pass
 

@@ -136,6 +136,14 @@ root.common.update({
     "trace": {"run": False},
     "snapshot": {"interval": 1, "min_interval_seconds": 0, "codec": "gz"},
     "web": {"host": "0.0.0.0", "port": 8090},
+    # the flight recorder / crash forensics / watchdog layer
+    # (veles_tpu.telemetry.flight + .health, docs/services.md "Black
+    # box").  watchdog_seconds: None = unset (standalone stays
+    # disarmed, spmd arms at spmd_watchdog_seconds); an EXPLICIT 0
+    # disarms even spmd runs.
+    "blackbox": {"capacity": 4096, "dir": "artifacts",
+                 "watchdog_seconds": None,
+                 "spmd_watchdog_seconds": 300},
 })
 
 

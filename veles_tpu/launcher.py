@@ -112,40 +112,86 @@ class Launcher(Logger):
             self._verify_checksum()
         if self.is_master:
             self._launch_services()
-        if self.workflow is not None:
-            trainer = getattr(self.workflow, "trainer", None)
-            # only trainers that understand meshes (StagedTrainer) —
-            # Kohonen/RBM trainers have no mesh_config attribute
-            if self.mesh_config is not None and trainer is not None:
-                if not hasattr(trainer, "mesh_config"):
-                    self.warning("--mesh ignored: %s does not support "
-                                 "SPMD meshes", type(trainer).__name__)
-                elif trainer.mesh_config is None:
-                    trainer.mesh_config = self.mesh_config
-            # the trainer will row-shard the dataset: the loader must not
-            # materialize a single-device replica first (the workflow
-            # constructor handles this when it got mesh_config directly;
-            # this covers the --mesh CLI path where the mesh is assigned
-            # here, before any unit initializes)
-            mc = getattr(trainer, "mesh_config", None)
-            loader = getattr(self.workflow, "loader", None)
-            if (mc is not None and loader is not None
-                    and getattr(trainer, "dataset_placement", None)
-                    == "shard" and mc.data_size > 1
-                    and getattr(loader, "on_device", None) is True):
-                loader.on_device = "defer"
-            # initialization is where first-compiles land: span it so the
-            # metrics JSONL attributes that wall time correctly (and the
-            # TraceAnnotation names it in a device capture)
-            with telemetry.span("workflow.initialize", emit=True,
-                                workflow=self.workflow.name):
-                self.workflow.initialize(**kwargs)
-        telemetry.registry.gauge(
-            "veles_launcher_info",
-            "constant 1; run topology rides the labels",
-            ("mode", "processes")).set(
-            1, mode=self.mode, processes=self.num_processes)
+        # services are live from here on: a failure anywhere below
+        # (crash-handler install, workflow.initialize raising, even the
+        # telemetry gauge) must tear them down before re-raising, or the
+        # web-status/graphics daemon threads leak — boot() and the CLI
+        # rely on this rather than wrapping initialize() themselves
+        try:
+            self._install_blackbox()
+            if self.workflow is not None:
+                trainer = getattr(self.workflow, "trainer", None)
+                # only trainers that understand meshes (StagedTrainer) —
+                # Kohonen/RBM trainers have no mesh_config attribute
+                if self.mesh_config is not None and trainer is not None:
+                    if not hasattr(trainer, "mesh_config"):
+                        self.warning("--mesh ignored: %s does not support "
+                                     "SPMD meshes", type(trainer).__name__)
+                    elif trainer.mesh_config is None:
+                        trainer.mesh_config = self.mesh_config
+                # the trainer will row-shard the dataset: the loader must
+                # not materialize a single-device replica first (the
+                # workflow constructor handles this when it got
+                # mesh_config directly; this covers the --mesh CLI path
+                # where the mesh is assigned here, before any unit
+                # initializes)
+                mc = getattr(trainer, "mesh_config", None)
+                loader = getattr(self.workflow, "loader", None)
+                if (mc is not None and loader is not None
+                        and getattr(trainer, "dataset_placement", None)
+                        == "shard" and mc.data_size > 1
+                        and getattr(loader, "on_device", None) is True):
+                    loader.on_device = "defer"
+                # initialization is where first-compiles land: span it so
+                # the metrics JSONL attributes that wall time correctly
+                # (and the TraceAnnotation names it in a device capture)
+                with telemetry.span("workflow.initialize", emit=True,
+                                    workflow=self.workflow.name):
+                    self.workflow.initialize(**kwargs)
+            telemetry.registry.gauge(
+                "veles_launcher_info",
+                "constant 1; run topology rides the labels",
+                ("mode", "processes")).set(
+                1, mode=self.mode, processes=self.num_processes)
+            # the watchdog arms only AFTER initialize returns: its
+            # progress clock must not start against first compiles,
+            # which routinely exceed any sane hang window (runtime
+            # recompiles keep feeding it via the compile listeners)
+            self._arm_health()
+        except BaseException as e:
+            telemetry.flight.record(
+                "launcher.initialize_failed",
+                error=type(e).__name__, message=str(e))
+            self.stop()
+            raise
         self._initialized = True
+
+    def _install_blackbox(self):
+        """Install the crash-forensics hooks (telemetry.health) — live
+        BEFORE workflow.initialize so a crash during initialization
+        still leaves a black box.  The watchdog/heartbeat arm later
+        (`_arm_health`), once initialization's first compiles are paid;
+        tests and plain standalone runs pay nothing (docs/services.md
+        "Black box")."""
+        from veles_tpu.telemetry import health
+        health.install(mode=self.mode, workflow=self.workflow)
+
+    def _arm_health(self):
+        """Arm the hang watchdog and the multi-host heartbeat/desync
+        check: spmd runs by default, standalone only when the config
+        asks explicitly."""
+        from veles_tpu.config import root
+        from veles_tpu.telemetry import health
+        # None = unset; an EXPLICIT watchdog_seconds=0 (--watchdog 0)
+        # disarms even spmd, where unset defaults to the spmd window
+        window = root.common.blackbox.get("watchdog_seconds", None)
+        if window is None and self.mode == "spmd":
+            window = root.common.blackbox.get("spmd_watchdog_seconds",
+                                              300)
+        if window:
+            health.arm_watchdog(window)
+        if self.mode == "spmd" and self.num_processes > 1:
+            health.enable_multihost()
 
     def _verify_checksum(self):
         """Every process must run the same workflow code (ref the per-file
@@ -191,6 +237,8 @@ class Launcher(Logger):
     def stop(self):
         """Idempotent — run() calls it in its finally and the CLI calls it
         again on the way out."""
+        from veles_tpu.telemetry import health
+        health.disarm_watchdog()
         if self.graphics_server is not None:
             self.graphics_server.stop()
             self.graphics_server = None

@@ -641,6 +641,17 @@ class StagedTrainer(Unit):
         sw = self._sweep_.pop(cls, None)
         if not sw or not sw[1]:
             return
+        # the multi-host heartbeat runs FIRST, outside the fail-soft
+        # guard below: sweep open/close is SPMD-lockstep on every host,
+        # but the guarded telemetry body can fail on host-LOCAL state
+        # (disk full, backend memory stats) — if that skipped the
+        # heartbeat's allgather on one host only, every later collective
+        # would be off by one and the pod would hang.  Only the
+        # collective itself rides this path; its reporting (gauges,
+        # desync dump) is exception-guarded inside multihost_check.
+        telemetry.health.multihost_check(
+            self._step_counter, time.perf_counter() - sw[0],
+            registry=telemetry.registry)
         try:
             self._emit_step_telemetry_inner(cls, stats, sw)
         except Exception as e:   # noqa: BLE001 — observe, never abort
@@ -678,6 +689,15 @@ class StagedTrainer(Unit):
                  step_ms=wall / steps * 1e3, loss=loss_mean,
                  loss_sum=stats["loss"], n_errors=stats["n_errors"],
                  **lbl)
+        # black-box surface: the sweep is the staged loop's one honest
+        # sync point, so this is where the flight record learns the
+        # step counter and the watchdog learns the run is alive (the
+        # spmd heartbeat allgather runs in _emit_step_telemetry, before
+        # this fail-soft body)
+        telemetry.flight.record(
+            "step", step=self._step_counter, steps=steps,
+            examples=examples, wall_s=wall, loss=loss_mean, **lbl)
+        telemetry.health.note_progress(step=self._step_counter)
         # the live-array census is the one per-sweep cost that scales
         # with model size (O(arrays x shards) host walk): pay it only
         # when something consumes it — an open --metrics-out sink or a

@@ -16,6 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from veles_tpu.logger import Logger
+from veles_tpu.telemetry import flight
 
 
 class GenerateBatcher(Logger):
@@ -174,6 +175,13 @@ class ContinuousEngine(Logger):
         self._prefix_gauge = ((0, 0) if getattr(self.cb, "prefix_cache",
                                                 False) else None)
         self._start_ts = time.monotonic()
+        #: queue-wait SLO (root.common.serve.slo_queue_wait_ms): a
+        #: completed request that waited longer records a flight-recorder
+        #: breach event, so serving SLO violations land in the same
+        #: post-mortem timeline as training stalls.  0 = no SLO.
+        from veles_tpu.config import root as _root
+        self._slo_queue_wait_ms = float(
+            _root.common.serve.get("slo_queue_wait_ms", 0) or 0)
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -225,6 +233,8 @@ class ContinuousEngine(Logger):
                 raise RuntimeError("engine is stopped")
             self._ingress.append(rec)
         self._wake.set()
+        flight.record("serve.submit", prompt_len=len(prompt),
+                      max_new=int(max_new), stream=bool(stream))
         return rec
 
     @staticmethod
@@ -312,6 +322,14 @@ class ContinuousEngine(Logger):
                         # fused dispatch) records the tick's real
                         # duration as decode time, not a 1e-9 floor
                         rec["admit_ts"] = tick_start
+                        # flight gets the REAL admission (serve.submit
+                        # marked the enqueue): the gap between the two
+                        # is the queue wait a post-mortem measures
+                        flight.record(
+                            "serve.admit",
+                            prompt_len=len(rec["prompt"]),
+                            queue_wait_ms=(tick_start
+                                           - rec["submit_ts"]) * 1e3)
                 for rid, rec in self._records.items():
                     if rec["stream_q"] is None:
                         continue
@@ -331,9 +349,11 @@ class ContinuousEngine(Logger):
                     done.append(rec)
                     dec = max(1e-9, now - (rec["admit_ts"] or now))
                     n_new = len(out) - len(rec["prompt"])
+                    qw_ms = ((rec["admit_ts"] or now)
+                             - rec["submit_ts"]) * 1e3
+                    rec["_queue_wait_ms"] = qw_ms
                     self._history.append({
-                        "queue_wait_ms": ((rec["admit_ts"] or now)
-                                          - rec["submit_ts"]) * 1e3,
+                        "queue_wait_ms": qw_ms,
                         "decode_ms": dec * 1e3,
                         "new_tokens": n_new,
                         "tokens_per_sec": n_new / dec,
@@ -346,6 +366,14 @@ class ContinuousEngine(Logger):
                     if self._prefix_gauge is not None:
                         self._prefix_gauge = self.cb.prefix_stats()
             for rec in done:          # wake waiters outside the lock
+                if self._slo_queue_wait_ms and \
+                        rec.get("_queue_wait_ms", 0.0) \
+                        > self._slo_queue_wait_ms:
+                    flight.record(
+                        "serve.slo_breach",
+                        queue_wait_ms=rec["_queue_wait_ms"],
+                        slo_ms=self._slo_queue_wait_ms,
+                        prompt_len=len(rec["prompt"]))
                 if rec["stream_q"] is not None:
                     # the batcher drops its partial snapshot when the
                     # row completes — flush whatever the last dispatch

@@ -211,6 +211,18 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self._flip_current(fname)
         self.destination = path   # only once the file is complete
         self.info("snapshot -> %s", path)
+        self._flight_commit(path)
+
+    def _flight_commit(self, destination):
+        """Snapshot commits join the flight record: in a post-mortem the
+        distance between the last commit and the crash IS the work
+        lost (never raises — shared by all backends; __dict__ reads so
+        a partially constructed unit can still export)."""
+        from veles_tpu.telemetry import flight
+        flight.record("snapshot",
+                      unit=self.__dict__.get("name"),
+                      destination=destination,
+                      epoch=self.__dict__.get("_epoch_counter"))
 
     def _flip_current(self, fname):
         """Point ``<prefix>_current`` at a COMPLETED checkpoint — the
@@ -469,6 +481,7 @@ class DBSnapshotter(TrainingSnapshotter):
             conn.close()
         self.destination = dest   # only once the row is committed
         self.info("snapshot -> %s", dest)
+        self._flight_commit(dest)
 
     @staticmethod
     def import_db(dsn, prefix=None):
@@ -653,6 +666,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
             self._flip_current(name)
         self.destination = path   # only once the commit is final
         self.info("snapshot -> %s", path)
+        self._flight_commit(path)
 
     def flush(self):
         if self._ckptr is not None and self.async_write:

@@ -28,7 +28,7 @@ td,th{border:1px solid #999;padding:4px 8px}
 .spark .v{color:#06c}
 #graph svg,#timeline svg{background:#fafafa;border:1px solid #ddd}
 .node{font-size:11px}.lane{font-size:10px;fill:#555}</style></head>
-<body><h2>veles_tpu status</h2>
+<body><h2>veles_tpu status <span id="health"></span></h2>
 <div id="status"></div><h3>metrics</h3><div id="metrics"></div>
 <h3>telemetry <small>(process metrics registry —
 <a href="/metrics">prometheus</a> ·
@@ -214,6 +214,20 @@ function drawTimeline(evs){
  return s+'</svg>';
 }
 async function refresh(){
+ try{   // health badge: green = alive, red = watchdog tripped (503)
+  const hr=await fetch('/api/health'); const h=await hr.json();
+  const bad=hr.status===503, wd=h.watchdog||{};
+  document.getElementById('health').innerHTML=
+   '<span style="font-size:13px;padding:2px 8px;border-radius:4px;'+
+   'color:#fff;background:'+(bad?'#c00':'#2a2')+'">'+
+   (bad?'WATCHDOG TRIPPED':'healthy')+'</span> <small>p'+
+   esc(h.process_index)+' '+esc(h.mode||'?')+
+   (h.last_progress_age_s!=null?
+    ' · last step '+h.last_progress_age_s.toFixed(0)+'s ago':'')+
+   (wd.armed?' · watchdog '+wd.window_s+'s':'')+
+   (h.crashdumps?' · <b>'+h.crashdumps+' crashdump(s)</b>':'')+
+   ' (<a href="/api/health">json</a>)</small>';
+ }catch(e){}
  const s=await (await fetch('/api/status')).json();
  document.getElementById('status').innerHTML =
   '<pre>'+JSON.stringify(s,null,2)+'</pre>';
@@ -490,6 +504,18 @@ class WebStatusServer(Logger):
                 "measured_at": measured.get("measured_at"),
                 "cache_path": path}
 
+    @staticmethod
+    def health_status():
+        """``/api/health`` payload: process id/mode, last-step age,
+        watchdog state, crashdump count (telemetry.health.status), plus
+        the dashboard registration count.  Never raises — a health
+        probe that 500s is worse than no probe."""
+        try:
+            from veles_tpu.telemetry import health
+            return health.status()
+        except Exception as e:   # noqa: BLE001
+            return {"error": str(e), "watchdog": {"tripped": False}}
+
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
         with self._lock:
@@ -565,6 +591,16 @@ class WebStatusServer(Logger):
                         {"metrics": telemetry.registry.snapshot(),
                          "records": telemetry.registry.records()[-60:]},
                         default=str).encode())
+                elif self.path == "/api/health":
+                    # liveness/forensics surface (telemetry.health):
+                    # 503 once the hang watchdog has tripped, so a
+                    # k8s-style probe (or a human's curl) distinguishes
+                    # "serving but stalled" from healthy
+                    state = server.health_status()
+                    self._send(
+                        503 if state.get("watchdog", {}).get("tripped")
+                        else 200,
+                        json.dumps(state, default=str).encode())
                 elif self.path == "/api/bench":
                     self._send(200, json.dumps(server.bench_report(),
                                                default=str).encode())

@@ -16,12 +16,18 @@ subsystem:
   (``tools/cost_model.py`` constants + ``ops/flops.py`` conventions) and
   the measured-utilization tripwire;
 * :mod:`~veles_tpu.telemetry.cli` — the ``veles-tpu-metrics`` JSONL
-  summarizer.
+  summarizer;
+* :mod:`~veles_tpu.telemetry.flight` — the bounded flight-recorder
+  ring + atomic ``crashdump-*`` post-mortem dumps (the unhappy-path
+  black box; read with ``veles-tpu-blackbox``);
+* :mod:`~veles_tpu.telemetry.health` — crash-forensics hooks
+  (excepthook/faulthandler/SIGTERM/SIGABRT), the hang watchdog, and
+  the multi-host heartbeat/desync layer.
 
 Import cost is stdlib-only; jax is touched lazily (first span under a
 live trace annotation), so platform pinning still works."""
 
-from veles_tpu.telemetry import mfu  # noqa: F401  (re-export)
+from veles_tpu.telemetry import flight, health, mfu  # noqa: F401
 from veles_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
 from veles_tpu.telemetry.spans import (  # noqa: F401

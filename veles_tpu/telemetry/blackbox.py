@@ -1,0 +1,204 @@
+"""``veles-tpu-blackbox`` — read, filter, and merge crashdump
+directories written by the flight recorder
+(:mod:`veles_tpu.telemetry.flight`).
+
+One dump renders as an operator timeline: the meta header (why, where,
+which process), then the recorded events with wall-clock offsets.
+Several dumps — one per process of a multi-host run — merge into a
+single cross-host timeline keyed by wall clock, each line tagged with
+its process index, so "host 2 stopped stepping 40 s before host 0
+hung" is one read instead of N files of archaeology.
+
+Stdlib-only, jax-free: runs anywhere the artifact landed, including
+hosts with no accelerator stack at all."""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def load_dump(path):
+    """Parse one crashdump directory -> {meta, header, events, stacks}.
+    Raises ValueError when ``path`` is not a readable dump."""
+    events_path = os.path.join(path, "events.jsonl")
+    if not os.path.isfile(events_path):
+        raise ValueError("%s: not a crashdump (no events.jsonl)" % path)
+    header, events, bad = None, [], 0
+    with open(events_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if rec.get("kind") == "flight.meta" and header is None:
+                header = rec
+            else:
+                events.append(rec)
+    meta = {}
+    meta_path = os.path.join(path, "meta.json")
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except ValueError:
+            pass
+    stacks = None
+    stacks_path = os.path.join(path, "stacks.txt")
+    if os.path.isfile(stacks_path):
+        with open(stacks_path) as f:
+            stacks = f.read()
+    return {"path": path, "meta": meta, "header": header or {},
+            "events": events, "stacks": stacks, "bad_lines": bad}
+
+
+def merge_timeline(dumps):
+    """One cross-host event list: every event tagged with its dump's
+    process index, sorted by wall-clock ts (stable within a host)."""
+    merged = []
+    for d in dumps:
+        proc = d["meta"].get("process_index", "?")
+        for ev in d["events"]:
+            ev = dict(ev)
+            ev["proc"] = proc
+            merged.append(ev)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return merged
+
+
+def _fmt_fields(ev, skip=("ts", "kind", "proc")):
+    parts = []
+    for k in sorted(ev):
+        if k in skip:
+            continue
+        v = ev[k]
+        if isinstance(v, float):
+            v = "%.6g" % v
+        parts.append("%s=%s" % (k, v))
+    return " ".join(parts)
+
+
+def render_text(dumps, events, last=None):
+    """The operator view: per-dump summary block, then the (merged)
+    timeline with offsets from the first event."""
+    out = []
+    for d in dumps:
+        meta, header = d["meta"], d["header"]
+        err = meta.get("error")
+        out.append("%s" % d["path"])
+        out.append(
+            "  reason=%s  proc=%s  pid=%s  events=%d  dropped=%s%s"
+            % (meta.get("reason", "?"),
+               meta.get("process_index", "?"), meta.get("pid", "?"),
+               len(d["events"]), header.get("dropped", "?"),
+               "  [%d unparseable lines]" % d["bad_lines"]
+               if d["bad_lines"] else ""))
+        if err:
+            out.append("  error: %s: %s" % (err.get("type"),
+                                            err.get("message")))
+        la = meta.get("live_arrays")
+        if isinstance(la, dict) and "total_bytes" in la:
+            out.append("  live arrays: %d (%.1f MiB)"
+                       % (la.get("count", 0),
+                          la["total_bytes"] / 2 ** 20))
+    if not events:
+        out.append("(no events matched)")
+        return "\n".join(out)
+    if last:
+        events = events[-last:]
+    t0 = events[0].get("ts", 0.0)
+    multi = len(dumps) > 1
+    out.append("-- timeline (%d events, t0=%s)"
+               % (len(events),
+                  time.strftime("%Y-%m-%d %H:%M:%S",
+                                time.localtime(t0))))
+    for ev in events:
+        line = "  %+10.3fs " % (ev.get("ts", 0.0) - t0)
+        if multi:
+            line += "[p%s] " % ev.get("proc", "?")
+        line += "%-16s %s" % (ev.get("kind", "?"), _fmt_fields(ev))
+        out.append(line.rstrip())
+    return "\n".join(out)
+
+
+def find_dumps(paths):
+    """Expand each argument: a dump dir itself, or a parent directory
+    holding ``crashdump-*`` children in chronological (oldest-first)
+    name order — ``veles-tpu-blackbox artifacts/`` reads a whole run's
+    dumps as one timeline."""
+    found = []
+    for p in paths:
+        if os.path.isfile(os.path.join(p, "events.jsonl")):
+            found.append(p)
+            continue
+        children = sorted(
+            os.path.join(p, n) for n in os.listdir(p)
+            if n.startswith("crashdump-")
+            and os.path.isfile(os.path.join(p, n, "events.jsonl")))
+        if not children:
+            raise ValueError(
+                "%s: neither a crashdump nor a directory containing "
+                "crashdump-*" % p)
+        found.extend(children)
+    return found
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-blackbox",
+        description="pretty-print, filter, and merge flight-recorder "
+        "crashdump directories (one per process) into a single "
+        "cross-host timeline")
+    p.add_argument("dumps", nargs="+", metavar="DUMP",
+                   help="crashdump-* directory, or a directory "
+                   "containing them (e.g. artifacts/)")
+    p.add_argument("--kind", default=None,
+                   help="only events of this kind (e.g. step, "
+                   "unit.stop, snapshot, hang)")
+    p.add_argument("--grep", default=None,
+                   help="only events whose JSON contains this "
+                   "substring")
+    p.add_argument("--last", type=int, default=None, metavar="N",
+                   help="only the newest N events of the (merged) "
+                   "timeline")
+    p.add_argument("--stacks", action="store_true",
+                   help="also print each dump's all-thread stacks")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json emits {dumps, events} for scripting")
+    args = p.parse_args(argv)
+
+    try:
+        paths = find_dumps(args.dumps)
+        dumps = [load_dump(d) for d in paths]
+    except (OSError, ValueError) as e:
+        print("veles-tpu-blackbox: %s" % e, file=sys.stderr)
+        return 2
+    events = merge_timeline(dumps)
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    if args.grep:
+        events = [e for e in events
+                  if args.grep in json.dumps(e, default=str)]
+    if args.format == "json":
+        out = {"dumps": [{"path": d["path"], "meta": d["meta"],
+                          "header": d["header"],
+                          "events": len(d["events"])} for d in dumps],
+               "events": events[-args.last:] if args.last else events}
+        print(json.dumps(out, indent=1, default=str))
+    else:
+        print(render_text(dumps, events, last=args.last))
+        if args.stacks:
+            for d in dumps:
+                if d["stacks"]:
+                    print("\n== stacks: %s ==\n%s"
+                          % (d["path"], d["stacks"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,290 @@
+"""Flight recorder — the run's black box (ref: the reference's operable
+master/slave story, docs/source/manualrst_veles_distributed_training.rst:
+a distributed run you can watch, diagnose, and resume).
+
+PR 3's telemetry plane covers the *happy* path: metrics and spans exist
+while the process is alive and something scrapes them.  This module is
+the *unhappy*-path complement: a bounded, thread-safe ring buffer of
+structured events (unit runs, staged steps, compiles, snapshot commits,
+serving admissions, fault injections, signals) whose ``append`` is O(1)
+and cheap enough for the scheduler hot loop (~0.76 µs measured on the
+CI box, budgeted < 2 µs — see docs/services.md "Black box"), plus a
+``dump()`` that serializes the last N events together with the config
+tree, mesh topology, a live-array census, the PR 3 metrics snapshot and
+all-thread stack traces into an **atomic** crashdump directory::
+
+    artifacts/crashdump-<ts>-p<proc>/
+        events.jsonl    last N flight events (+ meta header with the
+                        dropped-count)
+        stacks.txt      every thread's python stack
+        config.json     root.as_dict()
+        metrics.json    MetricsRegistry snapshot + recent records
+        meta.json       reason, pid, argv, process/mesh topology,
+                        live-array census
+
+Everything here is stdlib-only; jax is consulted only when it is
+already imported (``sys.modules``), so recording and dumping work from
+conftest-pinned CLIs and jax-free tools alike.  ``dump()`` never
+raises and is re-entrant-safe: a crash *inside* a dump (or a watchdog
+firing while an excepthook dump is mid-write) degrades to a no-op
+instead of recursing.  Read dumps with ``veles-tpu-blackbox``
+(:mod:`veles_tpu.telemetry.blackbox`), which also merges per-process
+dumps into one cross-host timeline."""
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+#: default ring capacity (events); root.common.blackbox.capacity
+#: overrides at first use
+DEFAULT_CAPACITY = 4096
+
+
+def _process_index():
+    """This process's index in the job — jax's answer when jax is
+    already awake (never import it: flight recording must not wake a
+    backend), else the launcher env, else 0."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:   # noqa: BLE001 — backend not initialized
+            pass
+    try:
+        return int(os.environ.get("VELES_TPU_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class FlightRecorder(object):
+    """Bounded, thread-safe event ring with atomic post-mortem dumps."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            from veles_tpu.config import root
+            capacity = int(root.common.blackbox.get(
+                "capacity", DEFAULT_CAPACITY))
+        self.capacity = int(capacity)
+        self._ring = collections.deque(maxlen=self.capacity)
+        # RLock, not Lock: the SIGTERM/SIGABRT handlers (telemetry.health)
+        # record+dump from the main thread, and the signal can land while
+        # the interrupted frame is INSIDE record()'s critical section — a
+        # non-reentrant lock would deadlock the handler against its own
+        # thread (same reasoning as MetricsRegistry's RLock)
+        self._lock = threading.RLock()
+        self._appended = 0
+        #: re-entrancy/concurrency guard for dump(): non-blocking, so a
+        #: crash inside a dump (excepthook firing mid-write) or a
+        #: watchdog racing an excepthook degrades to a no-op dump
+        self._dump_lock = threading.Lock()
+        self.dump_count = 0
+        self.last_dump = None
+
+    # ---------------------------------------------------------- recording
+    def record(self, kind, **fields):
+        """O(1) append of one structured event.  The hot-loop surface:
+        one dict build + one locked deque append, no I/O, no jax."""
+        ev = {"ts": time.time(), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+            self._appended += 1
+        return ev
+
+    def snapshot(self):
+        """The ring's current events, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self):
+        """Events currently in the ring — O(1), no copy (the health
+        endpoint polls this on every probe)."""
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def appended(self):
+        with self._lock:
+            return self._appended
+
+    @property
+    def dropped(self):
+        """Events the bounded ring has already forgotten."""
+        with self._lock:
+            return self._appended - len(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._appended = 0
+
+    def set_capacity(self, capacity):
+        """Re-bound the ring (config applied after import — the module
+        singleton is built before CLI config files run).  Keeps the
+        newest events when shrinking."""
+        capacity = int(capacity)
+        with self._lock:
+            if capacity == self.capacity:
+                return
+            self.capacity = capacity
+            self._ring = collections.deque(self._ring, maxlen=capacity)
+
+    # ------------------------------------------------------------- dumping
+    def dump(self, directory=None, reason="manual", error=None):
+        """Write an atomic ``crashdump-<ts>-p<proc>/`` directory and
+        return its path, or None when a dump is already in progress
+        (re-entrancy guard) or the write failed (a black box must never
+        crash the process it is recording)."""
+        if not self._dump_lock.acquire(blocking=False):
+            return None
+        try:
+            return self._dump_locked(directory, reason, error)
+        except Exception:   # noqa: BLE001 — forensics are best-effort
+            return None
+        finally:
+            self._dump_lock.release()
+
+    def _dump_locked(self, directory, reason, error):
+        if directory is None:
+            from veles_tpu.config import root
+            directory = root.common.blackbox.get("dir", "artifacts")
+        proc = _process_index()
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        final = os.path.join(
+            directory, "crashdump-%s-p%d" % (stamp, proc))
+        n = 1
+        while os.path.exists(final):      # same-second dumps: suffix
+            final = os.path.join(
+                directory, "crashdump-%s-p%d.%d" % (stamp, proc, n))
+            n += 1
+        # atomicity: everything lands in a tmp dir first; the rename is
+        # the commit, so a reader never sees a half-written dump and a
+        # crash mid-dump leaves only an ignorable *.tmp-<pid>
+        tmp = final + ".tmp-%d" % os.getpid()
+        os.makedirs(tmp, exist_ok=True)
+        events = self.snapshot()
+        with open(os.path.join(tmp, "events.jsonl"), "w") as f:
+            header = {"kind": "flight.meta", "ts": time.time(),
+                      "events": len(events), "dropped": self.dropped,
+                      "appended": self.appended,
+                      "capacity": self.capacity}
+            f.write(json.dumps(header, default=str) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        with open(os.path.join(tmp, "stacks.txt"), "w") as f:
+            f.write(format_all_stacks())
+        self._write_json(os.path.join(tmp, "config.json"),
+                         self._config_state)
+        self._write_json(os.path.join(tmp, "metrics.json"),
+                         self._metrics_state)
+        self._write_json(
+            os.path.join(tmp, "meta.json"),
+            lambda: self._meta_state(reason, error, proc))
+        os.rename(tmp, final)
+        self.dump_count += 1
+        self.last_dump = final
+        return final
+
+    @staticmethod
+    def _write_json(path, producer):
+        """One forensic section; a failing producer writes its error
+        instead of aborting the whole dump."""
+        try:
+            payload = producer()
+        except Exception as e:   # noqa: BLE001
+            payload = {"error": "%s: %s" % (type(e).__name__, e)}
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+
+    @staticmethod
+    def _config_state():
+        from veles_tpu.config import root
+        return root.as_dict()
+
+    @staticmethod
+    def _metrics_state():
+        from veles_tpu import telemetry
+        return {"metrics": telemetry.registry.snapshot(),
+                "records": telemetry.registry.records()}
+
+    @staticmethod
+    def _meta_state(reason, error, proc):
+        meta = {"reason": reason, "ts": time.time(), "pid": os.getpid(),
+                "process_index": proc, "argv": list(sys.argv)}
+        if error is not None:
+            meta["error"] = {"type": type(error).__name__,
+                             "message": str(error)}
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            # never wake a backend from a dump: topology and the
+            # live-array census only when jax already initialized one
+            try:
+                meta["process_count"] = jax.process_count()
+                devs = jax.devices()
+                meta["devices"] = {
+                    "count": len(devs),
+                    "platform": devs[0].platform if devs else None}
+            except Exception as e:   # noqa: BLE001
+                meta["devices"] = {"error": str(e)}
+            try:
+                meta["live_arrays"] = _live_array_census(jax)
+            except Exception as e:   # noqa: BLE001
+                meta["live_arrays"] = {"error": str(e)}
+        return meta
+
+
+def _live_array_census(jax):
+    """Count/bytes of live jax arrays + the top tenants by size — the
+    "what was resident when it died" HBM view."""
+    arrays = jax.live_arrays()
+    total = 0
+    top = []
+    for a in arrays:
+        try:
+            nbytes = int(a.size) * a.dtype.itemsize
+        except Exception:   # noqa: BLE001 — deleted/donated buffers
+            continue
+        total += nbytes
+        top.append((nbytes, str(a.shape), str(a.dtype)))
+    top.sort(reverse=True)
+    return {"count": len(arrays), "total_bytes": total,
+            "top": [{"bytes": b, "shape": s, "dtype": d}
+                    for b, s, d in top[:20]]}
+
+
+def format_all_stacks():
+    """Every thread's python stack, named — the dump's stacks.txt and
+    the watchdog's hang report."""
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append("Thread %s (%s):" % (tid, names.get(tid, "?")))
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+#: the process-global flight recorder (one black box per process, like
+#: the PR 3 metrics registry); ``record``/``dump`` below are the
+#: framework-facing surface
+recorder = FlightRecorder()
+
+
+def record(kind, **fields):
+    """Append one event to the process flight ring.  Never raises —
+    instrumentation must not kill the loop it observes."""
+    try:
+        return recorder.record(kind, **fields)
+    except Exception:   # noqa: BLE001
+        return None
+
+
+def dump(directory=None, reason="manual", error=None):
+    """Write a crashdump from the process recorder (see
+    :meth:`FlightRecorder.dump`)."""
+    return recorder.dump(directory=directory, reason=reason, error=error)

@@ -1,0 +1,405 @@
+"""Crash forensics & multi-host health layer (ref: the reference's
+operable distributed training — master/slave runs that could be watched,
+diagnosed, and resumed; this is the "what happened when it died/stalled"
+half that PR 3's live telemetry cannot answer).
+
+Three capabilities, all fail-soft and default-off until
+:func:`install` (called by ``Launcher.initialize``) arms them:
+
+* **crash forensics** — ``sys.excepthook`` / ``threading.excepthook``
+  wrappers, ``faulthandler`` for C-level faults, and SIGTERM/SIGABRT
+  handlers that append a flight event and write an atomic
+  ``crashdump-*`` directory (:mod:`veles_tpu.telemetry.flight`) before
+  chaining to whatever handler was installed first — the CLI's
+  preemption SIGTERM keeps working, it just leaves a black box behind.
+* **hang watchdog** — a daemon thread that dumps the flight record and
+  all-thread stacks when no unit/step progress is observed for a
+  configurable window (``root.common.blackbox.watchdog_seconds``;
+  default off — the Launcher arms it in spmd mode).  It observes and
+  dumps; it never kills the run.
+* **multi-host health** — a heartbeat + step-counter allgather at the
+  staged trainer's sync point: hosts on different steps raise a desync
+  error event + dump, and per-host step-wall gauges attribute
+  stragglers (``veles_host_step``/``veles_host_step_wall_seconds`` +
+  the ``veles_step_wall_skew_seconds`` spread).
+
+The module is import-cheap (stdlib only); jax is touched only inside
+the multihost check, which the Launcher enables exclusively for real
+multi-process runs."""
+
+import os
+import sys
+import threading
+import time
+
+from veles_tpu.telemetry import flight
+
+_state = {
+    "installed": False,
+    "mode": None,
+    "prev_excepthook": None,
+    "prev_threading_hook": None,
+    "prev_sigterm": None,
+    "prev_sigabrt": None,
+    "faulthandler_file": None,
+    "watchdog": None,
+    "multihost": False,
+    "desync_latched": False,
+    "last_progress": None,        # monotonic of the last step/unit
+    "last_step": None,
+}
+_lock = threading.Lock()
+
+
+# ------------------------------------------------------------- progress
+def note_progress(step=None):
+    """Record liveness — called per unit run by ``Workflow._drive`` and
+    per sweep by the staged trainer.  One float store: cheap enough for
+    the hot loop, signal-safe, never raises."""
+    _state["last_progress"] = time.monotonic()
+    if step is not None:
+        _state["last_step"] = step
+
+
+def last_progress_age():
+    """Seconds since the last observed progress, or None before any."""
+    t = _state["last_progress"]
+    return None if t is None else time.monotonic() - t
+
+
+# ---------------------------------------------------------------- install
+def install(mode=None, workflow=None):
+    """Install the crash-forensics hooks (idempotent).  Signal handlers
+    land only from the main thread; everything else works anywhere."""
+    with _lock:
+        if _state["installed"]:
+            _state["mode"] = mode or _state["mode"]
+            return
+        _state["installed"] = True
+        _state["mode"] = mode
+    _install_excepthooks()
+    _install_faulthandler()
+    _install_signal_handlers()
+    flight.record("health.install", mode=mode,
+                  workflow=getattr(workflow, "name", None))
+    try:
+        from veles_tpu.config import root
+        cap = root.common.blackbox.get("capacity", None)
+        if cap:
+            flight.recorder.set_capacity(cap)
+    except Exception:   # noqa: BLE001 — config is advisory here
+        pass
+
+
+def uninstall():
+    """Restore the pre-install hooks (tests)."""
+    with _lock:
+        if not _state["installed"]:
+            return
+        _state["installed"] = False
+    if _state["prev_excepthook"] is not None:
+        sys.excepthook = _state["prev_excepthook"]
+        _state["prev_excepthook"] = None
+    if _state["prev_threading_hook"] is not None:
+        threading.excepthook = _state["prev_threading_hook"]
+        _state["prev_threading_hook"] = None
+    import signal
+    if threading.current_thread() is threading.main_thread():
+        if _state["prev_sigterm"] is not None:
+            signal.signal(signal.SIGTERM, _state["prev_sigterm"])
+            _state["prev_sigterm"] = None
+        if _state["prev_sigabrt"] is not None:
+            signal.signal(signal.SIGABRT, _state["prev_sigabrt"])
+            _state["prev_sigabrt"] = None
+    f = _state["faulthandler_file"]
+    if f is not None:
+        _state["faulthandler_file"] = None
+        try:
+            import faulthandler
+            faulthandler.disable()
+            f.close()
+        except Exception:   # noqa: BLE001
+            pass
+    disarm_watchdog()
+    _state["multihost"] = False
+    _state["desync_latched"] = False
+
+
+def _install_excepthooks():
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        # record + dump FIRST: the chained hook may terminate printing
+        try:
+            flight.record("crash", error=exc_type.__name__,
+                          message=str(exc))
+            flight.dump(reason="excepthook", error=exc)
+        except Exception:   # noqa: BLE001 — forensics never mask the crash
+            pass
+        prev(exc_type, exc, tb)
+
+    _state["prev_excepthook"] = prev
+    sys.excepthook = hook
+
+    prev_t = threading.excepthook
+
+    def thook(args):
+        try:
+            if args.exc_type is not SystemExit:
+                flight.record(
+                    "crash", thread=getattr(args.thread, "name", "?"),
+                    error=args.exc_type.__name__,
+                    message=str(args.exc_value))
+                flight.dump(reason="thread-excepthook",
+                            error=args.exc_value)
+        except Exception:   # noqa: BLE001
+            pass
+        prev_t(args)
+
+    _state["prev_threading_hook"] = prev_t
+    threading.excepthook = thook
+
+
+def _install_faulthandler():
+    """C-level faults (SIGSEGV/SIGBUS/SIGFPE, real abort()) bypass
+    python excepthooks entirely — faulthandler writes the stacks to a
+    per-process file in the blackbox dir so even those leave evidence."""
+    try:
+        import faulthandler
+        from veles_tpu.config import root
+        d = root.common.blackbox.get("dir", "artifacts")
+        os.makedirs(d, exist_ok=True)
+        f = open(os.path.join(
+            d, "faulthandler-p%d.log" % flight._process_index()), "a")
+        faulthandler.enable(file=f, all_threads=True)
+        _state["faulthandler_file"] = f
+    except Exception:   # noqa: BLE001 — read-only fs: skip, don't fail boot
+        pass
+
+
+def _install_signal_handlers():
+    if threading.current_thread() is not threading.main_thread():
+        return
+    import signal
+
+    def on_sigterm(signum, frame):
+        note_signal("SIGTERM")
+        prev = _state["prev_sigterm"]
+        if callable(prev):
+            prev(signum, frame)
+        elif prev is None or prev == signal.SIG_DFL:
+            # no chainable python handler (SIG_DFL, or None when the
+            # prior handler came from C code): the black box must not
+            # change the signal's meaning — restore the default and
+            # re-deliver so the process still terminates honestly
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def on_sigabrt(signum, frame):
+        note_signal("SIGABRT")
+        # SIGABRT is not survivable: restore the default disposition
+        # and re-deliver so the exit status stays honest
+        signal.signal(signal.SIGABRT, _state["prev_sigabrt"]
+                      or signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGABRT)
+
+    try:
+        _state["prev_sigterm"] = signal.signal(signal.SIGTERM, on_sigterm)
+        _state["prev_sigabrt"] = signal.signal(signal.SIGABRT, on_sigabrt)
+    except (ValueError, OSError):
+        pass
+
+
+def note_signal(name):
+    """Record + dump for a delivered signal.  Also the hook the CLI's
+    own preemption SIGTERM handler calls (it replaces this module's
+    handler when installed later — both paths leave a black box)."""
+    try:
+        flight.record("signal", signal=name)
+        flight.dump(reason=name.lower())
+    except Exception:   # noqa: BLE001 — handlers must never raise
+        pass
+
+
+# --------------------------------------------------------------- watchdog
+class Watchdog(threading.Thread):
+    """Dump-on-stall: when no progress lands for ``window`` seconds the
+    flight record + stacks go to a crashdump and ``tripped`` rises (the
+    ``/api/health`` 503 surface).  Progress resuming re-arms it; the
+    run is never killed."""
+
+    def __init__(self, window):
+        super(Watchdog, self).__init__(name="VelesWatchdog", daemon=True)
+        self.window = float(window)
+        self.tripped = False
+        self.trip_count = 0
+        self._stop_evt = threading.Event()
+        # arming counts as progress: a run that stalls before its first
+        # step still trips after one full window
+        note_progress()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def run(self):
+        poll = max(min(self.window / 4.0, 5.0), 0.05)
+        while not self._stop_evt.wait(poll):
+            age = last_progress_age()
+            if age is None:
+                continue
+            if age < self.window:
+                if self.tripped:
+                    self.tripped = False
+                    flight.record("watchdog.recovered", stalled_s=age)
+                continue
+            if self.tripped:
+                continue              # one dump per stall, not per poll
+            self.trip_count += 1
+            flight.record("hang", stalled_s=age, window_s=self.window,
+                          last_step=_state["last_step"])
+            path = flight.dump(reason="watchdog")
+            # tripped rises only after the dump is on disk: readers of
+            # the /api/health 503 (and tests) may react immediately
+            self.tripped = True
+            try:
+                import logging
+                logging.getLogger("Watchdog").error(
+                    "no unit/step progress for %.1fs (window %.1fs) — "
+                    "flight record + stacks dumped to %s",
+                    age, self.window, path)
+            except Exception:   # noqa: BLE001
+                pass
+
+
+def arm_watchdog(seconds):
+    """Start (or retune) the hang watchdog.  ``seconds <= 0`` disarms."""
+    disarm_watchdog()
+    if not seconds or seconds <= 0:
+        return None
+    wd = Watchdog(seconds)
+    _state["watchdog"] = wd
+    wd.start()
+    flight.record("watchdog.armed", window_s=float(seconds))
+    return wd
+
+
+def disarm_watchdog():
+    wd = _state["watchdog"]
+    if wd is not None:
+        _state["watchdog"] = None
+        wd.stop()
+
+
+def watchdog():
+    return _state["watchdog"]
+
+
+# -------------------------------------------------------------- multihost
+def enable_multihost(enabled=True):
+    """Turn on the per-sweep heartbeat/desync allgather (Launcher, spmd
+    mode only — the collective would deadlock a single process that
+    merely *thinks* it has peers)."""
+    _state["multihost"] = enabled
+    _state["desync_latched"] = False
+
+
+def multihost_check(step, step_wall_s, registry=None):
+    """Heartbeat + step-counter allgather at the staged sync point:
+    every host contributes (step, sweep wall); disagreement on the step
+    counter is a desync — error event + dump, once.  The gathered walls
+    feed per-host gauges and the skew spread for straggler attribution.
+
+    Collective discipline: the trainer calls this OUTSIDE its fail-soft
+    telemetry guard (sweep close is SPMD-lockstep, so every host makes
+    the same allgather calls), only the allgather itself can raise
+    (symmetrically — a broken collective should fail the run loudly),
+    and everything after it is guarded here so a host-local reporting
+    failure can never skip a later host's collective.  A host that
+    stops calling entirely (crashed, wedged in device code) stalls the
+    peers inside the allgather until the DCN timeout — that is the
+    hang watchdog's case, not this check's: the peers' watchdogs fire
+    and dump while they wait."""
+    if not _state["multihost"]:
+        return None
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    import numpy as np
+    from jax.experimental import multihost_utils
+    local = np.asarray([float(jax.process_index()), float(step),
+                        float(step_wall_s)], np.float64)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    try:
+        return _report_heartbeat(gathered, step, registry)
+    except Exception:   # noqa: BLE001 — reporting is fail-soft
+        return None
+
+
+def _report_heartbeat(gathered, step, registry):
+    import numpy as np
+    gathered = np.asarray(gathered)
+    if gathered.ndim == 1:
+        gathered = gathered[None, :]
+    if registry is None:
+        from veles_tpu import telemetry
+        registry = telemetry.registry
+    g_step = registry.gauge(
+        "veles_host_step", "per-host staged step counter at the last "
+        "health heartbeat", ("proc",))
+    g_wall = registry.gauge(
+        "veles_host_step_wall_seconds",
+        "per-host wall seconds of the last class sweep (straggler "
+        "attribution)", ("proc",))
+    for proc, st, wall in gathered:
+        g_step.set(st, proc=int(proc))
+        g_wall.set(wall, proc=int(proc))
+    walls = gathered[:, 2]
+    skew = float(walls.max() - walls.min())
+    registry.gauge(
+        "veles_step_wall_skew_seconds",
+        "max-min spread of per-host sweep wall time (stragglers)").set(
+        skew)
+    steps = gathered[:, 1]
+    desync = bool(steps.max() != steps.min())
+    flight.record("heartbeat", step=int(step), skew_s=skew,
+                  hosts=int(gathered.shape[0]), desync=desync)
+    if desync and not _state["desync_latched"]:
+        _state["desync_latched"] = True
+        per_host = {int(p): int(s) for p, s, _ in gathered}
+        flight.record("desync", steps=per_host)
+        registry.emit("desync", steps=per_host)
+        flight.dump(reason="desync")
+        import logging
+        logging.getLogger("Health").error(
+            "multi-host DESYNC: hosts report different step counters "
+            "%s — flight record dumped", per_host)
+    return {"skew_s": skew, "desync": desync}
+
+
+# ----------------------------------------------------------------- status
+def status():
+    """The ``/api/health`` payload: liveness, watchdog state, and how
+    many black boxes this process has written."""
+    wd = _state["watchdog"]
+    age = last_progress_age()
+    return {
+        "pid": os.getpid(),
+        "process_index": flight._process_index(),
+        "mode": _state["mode"],
+        "installed": _state["installed"],
+        "last_progress_age_s": (round(age, 3)
+                                if age is not None else None),
+        "last_step": _state["last_step"],
+        "watchdog": {
+            "armed": wd is not None,
+            "window_s": wd.window if wd is not None else None,
+            "tripped": bool(wd is not None and wd.tripped),
+            "trips": wd.trip_count if wd is not None else 0,
+        },
+        "multihost": _state["multihost"],
+        "desync": _state["desync_latched"],
+        "crashdumps": flight.recorder.dump_count,
+        "last_dump": flight.recorder.last_dump,
+        "flight_events": len(flight.recorder),
+        "flight_dropped": flight.recorder.dropped,
+    }

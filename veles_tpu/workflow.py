@@ -21,6 +21,7 @@ import time
 from veles_tpu import telemetry
 from veles_tpu.logger import Logger
 from veles_tpu.mutable import Bool
+from veles_tpu.telemetry import flight, health
 from veles_tpu.plumbing import EndPoint, StartPoint
 from veles_tpu.units import Container, MissingDemands, Unit
 
@@ -165,11 +166,14 @@ class Workflow(Container):
             unit.reset_gate()  # clear stale pulses from a stopped prior run
         t0 = time.perf_counter()
         self.event("workflow", "begin")
+        flight.record("workflow.start", workflow=self.name)
         with telemetry.span("workflow.run:%s" % self.name):
             self._drive()
         wall = time.perf_counter() - t0
         self._run_time_ += wall
         self.event("workflow", "end")
+        flight.record("workflow.stop", workflow=self.name, dur_s=wall,
+                      preempted=self.preempted_)
         # span export: the workflow.run record plus aggregated per-unit
         # spans (units that never ran — gate-blocked/skipped throughout —
         # are excluded) into the JSONL sink and the /metrics gauges.
@@ -191,6 +195,9 @@ class Workflow(Container):
         queue = collections.deque([self.start_point])
         queued = {self.start_point}
         can_break = None      # no-snapshotter fallback, decided once
+        # hot-loop hoists: one attribute lookup per run, not per unit
+        fl_record = flight.record
+        note_progress = health.note_progress
         while queue and not bool(self.stopped):
             if bool(self.preempt_requested) and not self.preempted_:
                 if can_break is None:
@@ -212,12 +219,23 @@ class Workflow(Container):
                     self.warning("fault injection: simulated crash "
                                  "(death_probability=%.3f)",
                                  self.death_probability)
+                    # leave a black box behind: the simulated crash is
+                    # exactly the sudden-death case the flight recorder
+                    # exists for, and it doubles as the end-to-end
+                    # exercise of the crashdump path
+                    fl_record("fault.injected", unit=unit.name,
+                              workflow=self.name,
+                              death_probability=self.death_probability)
+                    flight.dump(reason="fault-injection")
                     os._exit(1)
             if bool(unit.gate_block):
                 unit.reset_gate()
                 continue
             if not bool(unit.gate_skip):
-                unit._run_wrapped()
+                fl_record("unit.start", unit=unit.name)
+                dt = unit._run_wrapped()
+                fl_record("unit.stop", unit=unit.name, dur_s=dt)
+                note_progress()
             unit.reset_gate()
             if bool(self.stopped):
                 break
