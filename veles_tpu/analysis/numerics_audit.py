@@ -1171,9 +1171,10 @@ def audit_prng_registry(name="<prng>"):
 # ---------------------------------------------------------------------------
 def _sublane_tile(dtype):
     """Native TPU sublane tile for a dtype: (8, 128) f32, (16, 128)
-    bf16/f16, (32, 128) int8/fp8 (pallas guide, 'Block shape
-    alignment')."""
-    return {4: 8, 2: 16, 1: 32}.get(np.dtype(dtype).itemsize, 8)
+    bf16/f16, (32, 128) int8/fp8 — single source of truth shared with
+    the paged-serving fallback (ops.pallas.mosaic_sublane_min)."""
+    from veles_tpu.ops import pallas as _pallas
+    return _pallas.mosaic_sublane_min(dtype)
 
 
 def audit_kernel_launch(launch, vmem_kib=None):

@@ -144,6 +144,29 @@ root.common.update({
     "blackbox": {"capacity": 4096, "dir": "artifacts",
                  "watchdog_seconds": None,
                  "spmd_watchdog_seconds": 300},
+    # serving survival layer (services.lifecycle + ContinuousEngine,
+    # docs/services.md "Serving robustness").  slo_queue_wait_ms > 0
+    # turns breaches from recorded (flight serve.slo_breach) into
+    # enforced: the closed-loop shedder rejects new work with 503 +
+    # Retry-After past the SLO and reopens below shed_close_fraction
+    # of it.  default_deadline_ms > 0 gives every request a deadline
+    # (per-request "deadline_ms" overrides); expired requests are
+    # cancelled — mid-decode if needed — instead of decoded uselessly.
+    # stream_queue_chunks bounds each streaming request's token
+    # channel; stream_overflow picks what happens when the consumer
+    # falls behind: 'drop_oldest' (default — the terminal line still
+    # carries the full result) or 'block' (per-request backpressure:
+    # chunks are held back until the consumer drains; a request that
+    # makes no progress for stream_stall_timeout_ms is cancelled as a
+    # slowloris).
+    "serve": {
+        "slo_queue_wait_ms": 0,
+        "default_deadline_ms": 0,
+        "stream_queue_chunks": 64,
+        "stream_overflow": "drop_oldest",
+        "stream_stall_timeout_ms": 10000,
+        "shed_close_fraction": 0.5,
+    },
 })
 
 

@@ -1166,6 +1166,58 @@ class ContinuousBatcher:
         servers must not accumulate every completed request."""
         return self._results.pop(rid, None)
 
+    def cancel(self, rid):
+        """Abort a request mid-flight: drop it from the queue, or —
+        if already admitted — deactivate its row and free its slot
+        (paged pools also free its KV blocks) WITHOUT waiting for the
+        decode to finish.  The serving engine's cancellation path
+        (client disconnect, deadline expiry, shutdown); single-caller
+        contract like ``tick`` — only the engine thread may call it.
+        Returns True if the request was queued or active; False if
+        unknown or already finished (a finished result is released
+        either way, so a cancelled request can never leak its
+        tokens)."""
+        for i, item in enumerate(self._queue):
+            if item[0] == rid:
+                del self._queue[i]
+                return True
+        if rid in self._slot_req:
+            b = self._slot_req.index(rid)
+            # the in-jit freeze flag: an inactive row neither writes
+            # tokens nor advances, so a fused multi-tick scan stops
+            # paying for it immediately; admission overwrites the
+            # whole slot (incl. caches) for the next occupant
+            self._active = self._active.at[b].set(False)
+            self._partials.pop(rid, None)
+            self._release_slot(b)
+            return True
+        self._partials.pop(rid, None)
+        self._results.pop(rid, None)
+        return False
+
+    def reset_pool(self):
+        """Hard reset after an engine fault: drop every queued and
+        active request and rebuild the device-side state from scratch.
+        A tick that raised mid-dispatch may have invalidated its
+        DONATED buffers (state is donated into ``_jit_ticks``), so the
+        arrays cannot be trusted — only their shapes/dtypes can.
+        Compiled tick/admit executables survive; callers own waking
+        any waiters for the dropped requests."""
+        self._queue.clear()
+        self._results.clear()
+        self._partials.clear()
+        self._slot_req = [None] * self.slots
+        B, L = self.slots, self.gen.max_len
+        self._tokens = jnp.zeros((B, L), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._plen = jnp.ones((B,), jnp.int32)
+        self._total = jnp.ones((B,), jnp.int32)
+        self._active = jnp.zeros((B,), jnp.bool_)
+        self._seeds = jnp.zeros((B,), jnp.int32)
+        self._inv_temp = jnp.zeros((B,), jnp.float32)
+        self._aids = jnp.zeros((B,), jnp.int32)
+        self._caches = self._init_slot_caches()
+
     def tick(self):
         """One engine step: admit queued requests into free slots, then
         advance EVERY slot one token; emit and free finished rows.
@@ -1638,7 +1690,19 @@ class PagedContinuousBatcher(ContinuousBatcher):
             for layer in cache_shapes for c in layer)
         windowed = any(getattr(l, "cfg", {}).get("window")
                        for l in gen._blocks)
-        self.fused = bool(fused) and not quant_pool and not windowed
+        # Mosaic sublane bound: a pool block is the fused kernel's K/V
+        # tile, so when the kernel would actually be Mosaic-compiled
+        # (a real TPU backend — interpret mode takes any size), blocks
+        # below the dtype's sublane minimum fall back to the gather
+        # tick exactly like quant/window pools do, instead of failing
+        # compilation at the first tick.
+        from veles_tpu.ops import pallas as _pallas
+        pool_dtype = jax.tree_util.tree_leaves(cache_shapes)[0].dtype
+        mosaic_ok = (_pallas.autodetect_interpret(None)
+                     or self.block
+                     >= _pallas.mosaic_sublane_min(pool_dtype))
+        self.fused = (bool(fused) and not quant_pool and not windowed
+                      and mosaic_ok)
 
     def _init_slot_caches(self):
         return None                          # the pool replaces them
@@ -1729,6 +1793,23 @@ class PagedContinuousBatcher(ContinuousBatcher):
             else:
                 self._free.append(blk)
         self._tables = self._tables.at[b].set(0)
+
+    def reset_pool(self):
+        """Fault reset, paged flavor: also rebuild the block pool, the
+        tables, the free list, and the prefix-cache registries —
+        every block returns to the free list (``cancel``/release paths
+        already keep per-request accounting exact; this is the big
+        hammer for a corrupted-pool fault)."""
+        ContinuousBatcher.reset_pool(self)
+        self._pool = jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), self._pool)
+        self._tables = jnp.zeros((self.slots, self.max_blocks),
+                                 jnp.int32)
+        self._free = list(range(1, 1 + self.pool_blocks))
+        self._slot_blocks = {}
+        self._prefix_reg = {}
+        self._prefix_ref = {}
+        self._block_key = {}
 
     def _state(self):
         return (self._tokens, self._pos, self._plen, self._total,

@@ -24,6 +24,17 @@ def autodetect_interpret(interpret):
     return interpret
 
 
+def mosaic_sublane_min(dtype):
+    """Mosaic's minimum second-to-last-dim tile for ``dtype`` on TPU:
+    8 rows for 4-byte types, 16 for bf16/f16, 32 for int8/fp8 (pallas
+    guide, 'Block shape alignment').  THE one copy of the table: the
+    paged-serving fused-tick fallback (models.generate) and the VP600
+    tile lint (analysis.numerics_audit) must agree on which blocks
+    compile."""
+    import numpy as np
+    return {4: 8, 2: 16, 1: 32}.get(np.dtype(dtype).itemsize, 8)
+
+
 #: kernel name -> callable() -> [launch dict] (the shape
 #: ``analysis.numerics_audit.audit_kernel_launch`` consumes)
 KERNEL_AUDITS = {}

@@ -1,0 +1,227 @@
+"""Request lifecycle for the serving plane (``services.restful``).
+
+The survival layer under ``ContinuousEngine``: every request carries an
+id, an optional deadline, and a cancel path, and the pieces that keep a
+loaded server alive live here —
+
+* :class:`BoundedStream` — the engine→HTTP-worker token channel.
+  Replaces the unbounded ``queue.Queue`` the streaming path used to
+  accumulate into when a client stopped reading: capacity is fixed and
+  overflow either drops the oldest chunk (``drop_oldest``, the default
+  — the terminal ``done`` line still carries the full result) or
+  applies per-request backpressure (``block``: ``push`` refuses while
+  full, the engine holds that request's chunks back and retries next
+  dispatch — NEVER sleeping on the engine thread, which other
+  requests' decodes share — and cancels the request once it has made
+  no progress for the stall timeout: the consumer is dead or a
+  slowloris).
+* :class:`SloShedder` — closed-loop admission control.  Watches the
+  MEASURED queue wait (the ``serve.submit`` → ``serve.admit`` gap the
+  flight recorder already records) plus the head-of-line wait of the
+  oldest still-queued request; past ``root.common.serve
+  .slo_queue_wait_ms`` new work is rejected with
+  :class:`ShedError` (HTTP 503 + ``Retry-After``) instead of queuing
+  into a breach, and admission reopens once the wait falls back under
+  ``close_fraction`` of the SLO (hysteresis, so the valve does not
+  chatter at the threshold).
+* the terminal exception types the REST layer maps to status codes:
+  :class:`ShedError` → 503, :class:`DeadlineExceeded` → 504,
+  :class:`RequestCancelled` → the stream's error line.
+
+Everything here is plain-Python and thread-safe by construction: the
+engine thread is the only producer, HTTP workers are the consumers,
+and the shedder is read lock-free on the submit path.
+"""
+
+import collections
+import threading
+import time
+
+
+class ShedError(RuntimeError):
+    """Raised at submit while the admission controller is shedding:
+    the measured queue wait exceeds the configured SLO, so queueing
+    this request would only widen the breach.  ``retry_after_s`` is
+    the client's backoff hint (HTTP ``Retry-After``)."""
+
+    def __init__(self, message, retry_after_s=1.0):
+        super(ShedError, self).__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it could complete: either
+    it was never admitted in time, or it was cancelled mid-decode —
+    decoding tokens nobody can use anymore wastes the pool."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled — explicit ``cancel(req_id)``, a
+    client disconnect detected on a failed stream write, or a stalled
+    stream consumer in ``block`` overflow mode."""
+
+
+class BoundedStream(object):
+    """Bounded chunk channel between the engine thread (producer) and
+    one HTTP worker (consumer).
+
+    ``push`` NEVER sleeps — the producer is the engine thread, whose
+    loop every request's decode shares.  It returns False only when a
+    ``block``-overflow channel is full (the caller keeps the chunk,
+    retries next dispatch, and gives up on the request once it has
+    made no progress for its stall budget); ``drop_oldest`` discards
+    the oldest un-read chunk instead and always accepts.
+    ``put_terminal`` ALWAYS succeeds regardless of capacity: a
+    terminal (`done`/`error`) must reach the consumer or it blocks in
+    ``get`` forever.  ``dropped`` counts chunks discarded by
+    ``drop_oldest``."""
+
+    OVERFLOW = ("drop_oldest", "block")
+
+    def __init__(self, capacity=64, overflow="drop_oldest"):
+        if overflow not in self.OVERFLOW:
+            raise ValueError("overflow must be one of %s, got %r"
+                             % (self.OVERFLOW, overflow))
+        self.capacity = max(1, int(capacity))
+        self.overflow = overflow
+        self.dropped = 0
+        self._items = collections.deque()
+        self._closed = False
+        self._cond = threading.Condition()
+
+    def push(self, item):
+        """Producer side, non-blocking.  Returns False iff a
+        ``block``-mode channel is full (retry next dispatch)."""
+        with self._cond:
+            if self._closed:
+                return True               # terminal already delivered
+            if len(self._items) >= self.capacity:
+                if self.overflow != "drop_oldest":
+                    return False
+                self._items.popleft()
+                self.dropped += 1
+            self._items.append(item)
+            self._cond.notify_all()
+        return True
+
+    def put_terminal(self, item):
+        """Deliver the terminal chunk unconditionally (never dropped,
+        never blocked) and close the channel; later pushes no-op."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def get(self, timeout=None):
+        """Consumer side: next chunk, blocking.  Raises ``TimeoutError``
+        if ``timeout`` elapses with nothing queued."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while not self._items:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    raise TimeoutError("BoundedStream.get timed out")
+                if not self._cond.wait(left):
+                    raise TimeoutError("BoundedStream.get timed out")
+            item = self._items.popleft()
+            self._cond.notify_all()       # wake a blocked producer
+        return item
+
+    def qsize(self):
+        with self._cond:
+            return len(self._items)
+
+
+class SloShedder(object):
+    """Closed-loop SLO admission controller.
+
+    The engine feeds it two signals: ``note_admit(queue_wait_ms)`` —
+    the measured wait of every request the pool just admitted (the
+    same number the ``serve.admit`` flight event carries) — and
+    ``update(head_wait_ms)`` once per engine loop with the
+    head-of-line wait of the oldest request still queued (a LOWER
+    bound on that request's eventual wait, which is what keeps the
+    valve responsive when the pool is so far behind that nothing is
+    being admitted at all).
+
+    Opens when either signal crosses ``slo_ms``; closes when both
+    fall back under ``close_fraction * slo_ms`` (hysteresis).  While
+    open, ``should_shed()`` is True and submit rejects with
+    :class:`ShedError`.  ``slo_ms <= 0`` disables the controller
+    entirely (``enabled`` False, never sheds)."""
+
+    def __init__(self, slo_ms, close_fraction=0.5):
+        self.slo_ms = float(slo_ms or 0)
+        self.close_fraction = min(1.0, max(0.0, float(close_fraction)))
+        self._fresh_admit_ms = None       # consumed by the next update
+        self._open = False
+        self.shed_total = 0
+        self.open_total = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self):
+        return self.slo_ms > 0
+
+    def should_shed(self):
+        """Lock-free read for the submit hot path."""
+        return self._open
+
+    def note_admit(self, queue_wait_ms):
+        with self._lock:
+            self._fresh_admit_ms = max(float(queue_wait_ms),
+                                       self._fresh_admit_ms or 0.0)
+
+    def update(self, head_wait_ms=0.0):
+        """One control-loop step.  Returns ``"open"`` / ``"close"`` on
+        a transition (the engine records the flight event), else
+        None.
+
+        Admit measurements influence exactly ONE control step (the
+        worst since the previous ``update`` call, then consumed):
+        a breach-sized wait must be able to open the valve even when
+        the head of the queue is empty again, but a STALE sample from
+        the overload's peak must not hold the valve open after the
+        queue has drained — head-of-line wait is the live signal on
+        the close side."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            fresh = self._fresh_admit_ms
+            self._fresh_admit_ms = None
+        measure = max(float(head_wait_ms), fresh or 0.0)
+        if not self._open and measure > self.slo_ms:
+            self._open = True
+            self.open_total += 1
+            return "open"
+        # <= so close_fraction=0 means "close once fully drained"
+        # (measure bottoms out at exactly 0.0) instead of latching
+        # the valve open forever
+        if self._open and measure <= self.close_fraction * self.slo_ms:
+            self._open = False
+            return "close"
+        return None
+
+    def shed(self):
+        """Account one rejected request; returns the backoff hint."""
+        with self._lock:
+            self.shed_total += 1
+        return self.retry_after_s()
+
+    def retry_after_s(self):
+        """Client backoff hint: one SLO window, at least a second —
+        by construction the breach needs at least that long to
+        drain below the close threshold."""
+        return max(1.0, self.slo_ms / 1000.0)
+
+    def status(self):
+        return {"enabled": self.enabled,
+                "state": ("open" if self._open else "closed")
+                if self.enabled else "disabled",
+                "slo_ms": self.slo_ms,
+                "shed_total": self.shed_total,
+                "open_total": self.open_total}

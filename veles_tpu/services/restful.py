@@ -8,7 +8,7 @@ reference's Twisted resource — no reactor to manage."""
 
 import base64
 import json
-import queue as _queue
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -16,6 +16,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from veles_tpu.logger import Logger
+from veles_tpu.services.lifecycle import (BoundedStream, DeadlineExceeded,
+                                          RequestCancelled, ShedError,
+                                          SloShedder)
 from veles_tpu.telemetry import flight
 
 
@@ -178,24 +181,68 @@ class ContinuousEngine(Logger):
         #: queue-wait SLO (root.common.serve.slo_queue_wait_ms): a
         #: completed request that waited longer records a flight-recorder
         #: breach event, so serving SLO violations land in the same
-        #: post-mortem timeline as training stalls.  0 = no SLO.
+        #: post-mortem timeline as training stalls — AND the same
+        #: threshold drives the closed-loop admission shedder
+        #: (services.lifecycle.SloShedder): past it, new work is
+        #: rejected with ShedError (503 + Retry-After) instead of
+        #: queued into a breach.  0 = no SLO, no shedding.
         from veles_tpu.config import root as _root
+        serve_cfg = _root.common.serve
         self._slo_queue_wait_ms = float(
-            _root.common.serve.get("slo_queue_wait_ms", 0) or 0)
+            serve_cfg.get("slo_queue_wait_ms", 0) or 0)
+        self._shed = SloShedder(
+            self._slo_queue_wait_ms,
+            close_fraction=float(
+                serve_cfg.get("shed_close_fraction", 0.5)))
+        #: request lifecycle (services.lifecycle): every request gets
+        #: an id, an optional deadline, and a cancel path
+        self._default_deadline_ms = float(
+            serve_cfg.get("default_deadline_ms", 0) or 0)
+        self._stream_capacity = int(
+            serve_cfg.get("stream_queue_chunks", 64))
+        self._stream_overflow = str(
+            serve_cfg.get("stream_overflow", "drop_oldest"))
+        self._stream_stall_s = float(
+            serve_cfg.get("stream_stall_timeout_ms", 10000)) / 1e3
+        self._next_req_id = 0
+        self._by_id = {}                   # req id -> rec (any state)
+        self._cancels = collections.deque()  # req ids to cancel
+        self._cancelled = 0
+        self._deadline_expired = 0
+        self._engine_faults = 0
+        self._stream_dropped = 0
+        self._spec_degraded = False
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def submit_async(self, prompt_row, max_new, temperature=0.0,
-                     seed=0, adapter=0, stream=False):
+                     seed=0, adapter=0, stream=False, deadline_ms=None):
         """Enqueue one row; returns a handle for ``wait`` (submit every
         row of a request BEFORE waiting so they share the pool).
         Validates here so a bad request raises in the CALLER (one 400),
         never on the engine thread.  The length checks delegate to the
         generator's canonical validate_request; only the engine-specific
         constraints (non-empty prompt, at least one new token — a slot
-        must decode something to ever free itself) live here."""
+        must decode something to ever free itself) live here.
+
+        ``deadline_ms``: wall budget from NOW for the whole request
+        (None/0 falls back to root.common.serve.default_deadline_ms;
+        0 there too = no deadline).  An expired request is cancelled —
+        before admission if possible, mid-decode otherwise — and its
+        waiter raises DeadlineExceeded.  Raises ShedError (the REST
+        layer's 503 + Retry-After) while the SLO shedder is open."""
+        if self._shed.should_shed():
+            ra = self._shed.shed()
+            flight.record("serve.shed", prompt_len=len(prompt_row),
+                          max_new=int(max_new),
+                          retry_after_s=ra)
+            raise ShedError(
+                "admission shedding: measured queue wait exceeds the "
+                "%.0f ms SLO (root.common.serve.slo_queue_wait_ms) — "
+                "retry after %.0f s" % (self._slo_queue_wait_ms, ra),
+                retry_after_s=ra)
         prompt = [int(t) for t in prompt_row]
         if not prompt:
             raise ValueError("empty prompt")
@@ -217,23 +264,64 @@ class ContinuousEngine(Logger):
         if not 0 <= int(adapter) <= n_bank:
             raise ValueError("adapter %d outside the loaded bank "
                              "(0..%d)" % (int(adapter), n_bank))
+        if getattr(self.cb, "speculative_k", 0) \
+                and float(temperature) != 0.0:
+            # one sampled request flips the WHOLE pool off the greedy
+            # speculative fast path (the pool-wide lax.cond in
+            # _make_core_spec) — correctness is unaffected, but the
+            # speculation win erodes until it drains.  One-shot flight
+            # event so operators can see the cliff (per-row routing is
+            # the ROADMAP follow-up); check-and-set under the lock so
+            # concurrent HTTP workers cannot double-emit it.
+            with self._lock:
+                first = not self._spec_degraded
+                self._spec_degraded = True
+            if first:
+                flight.record("serve.spec_degraded",
+                              speculative_k=int(self.cb.speculative_k))
+        now = time.monotonic()
+        eff_deadline_ms = (float(deadline_ms) if deadline_ms
+                           else self._default_deadline_ms)
+        if eff_deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0, got %r"
+                             % (deadline_ms,))
         rec = {"prompt": prompt, "max_new": int(max_new),
                "temperature": float(temperature), "seed": int(seed),
                "adapter": int(adapter),
-               "event": threading.Event(), "submit_ts": time.monotonic(),
+               "event": threading.Event(), "submit_ts": now,
                "admit_ts": None, "out": None, "error": None,
+               #: absolute monotonic deadline (None = unbounded)
+               "deadline": (now + eff_deadline_ms / 1e3
+                            if eff_deadline_ms else None),
+               #: batcher request id once cb-submitted (cancel needs it)
+               "_rid": None,
+               "_cancel_reason": None,
                # streaming: the engine thread pushes ("tokens", [...])
                # chunks of NEW tokens per dispatch, then ("done", out)
                # / ("error", e); the HTTP worker drains until a
                # terminal item.  _sent tracks the high-water mark.
-               "stream_q": _queue.Queue() if stream else None,
+               # BOUNDED (lifecycle.BoundedStream): a consumer that
+               # stops reading can no longer grow the queue without
+               # limit — chunks drop-oldest, or ('block') the engine
+               # holds this request's chunks back until the consumer
+               # drains, per root.common.serve.stream_overflow.
+               "stream_q": (BoundedStream(
+                   self._stream_capacity, self._stream_overflow)
+                   if stream else None),
+               #: first monotonic ts a 'block' push found the channel
+               #: full with no progress since (None = not stalled)
+               "_stall_since": None,
                "_sent": 0}
         with self._lock:
             if self._closed:
                 raise RuntimeError("engine is stopped")
+            rec["id"] = self._next_req_id
+            self._next_req_id += 1
+            self._by_id[rec["id"]] = rec
             self._ingress.append(rec)
         self._wake.set()
-        flight.record("serve.submit", prompt_len=len(prompt),
+        flight.record("serve.submit", req=rec["id"],
+                      prompt_len=len(prompt),
                       max_new=int(max_new), stream=bool(stream))
         return rec
 
@@ -252,23 +340,207 @@ class ContinuousEngine(Logger):
                                            temperature=temperature,
                                            seed=seed, adapter=adapter))
 
-    def stream(self, prompt_row, max_new, temperature=0.0, seed=0,
-               adapter=0):
-        """Generator yielding lists of NEW tokens as they decode
-        (one chunk per engine dispatch — ``ticks_per_dispatch`` tokens
-        at a time), ending after the final chunk.  Raises the engine's
-        error if the request fails."""
+    def cancel(self, req_id, reason="cancelled by client"):
+        """Request cancellation of an in-flight request by id (the
+        ``"id"`` field of a ``submit_async`` handle).  Safe from any
+        thread: the actual teardown — freeing the slot and, on paged
+        pools, its KV blocks, mid-decode if needed — happens on the
+        engine thread at the next loop iteration (the sole batcher
+        caller).  The waiter raises RequestCancelled; a streaming
+        consumer receives a terminal error chunk.  Returns True if the
+        request was still live, False if unknown/already finished."""
+        with self._lock:
+            rec = self._by_id.get(req_id)
+            if rec is None:
+                return False
+            if rec["_cancel_reason"] is None:
+                rec["_cancel_reason"] = str(reason)
+        self._cancels.append(req_id)
+        self._wake.set()
+        return True
+
+    def stream_open(self, prompt_row, max_new, temperature=0.0,
+                    seed=0, adapter=0, deadline_ms=None):
+        """Streaming submit: returns ``(handle, iterator)`` where the
+        iterator yields lists of NEW tokens per engine dispatch.  The
+        submit (and thus shed/validation errors) happens EAGERLY in
+        this call — the REST layer must learn about a 503/400 before
+        it commits response headers; ``handle["id"]`` is the cancel
+        token for a mid-stream disconnect, and ``handle["out"]`` holds
+        the full result after the final chunk (authoritative even if
+        drop-oldest overflow dropped mid-stream chunks)."""
         rec = self.submit_async(prompt_row, max_new,
                                 temperature=temperature, seed=seed,
-                                adapter=adapter, stream=True)
-        while True:
-            kind, payload = rec["stream_q"].get()
-            if kind == "tokens":
-                yield payload
-            elif kind == "done":
-                return
-            else:
-                raise payload
+                                adapter=adapter, stream=True,
+                                deadline_ms=deadline_ms)
+
+        def drain():
+            # chunks carry their start offset, and only CONTIGUOUS
+            # progress is yielded: drop_oldest removes chunks from the
+            # MIDDLE of the sequence, so anything after the first gap
+            # is held back and delivered by the terminal
+            # reconstruction below — concatenating the yielded chunks
+            # ALWAYS equals the complete continuation exactly;
+            # overflow costs incremental granularity, never tokens
+            expect = 0                # next new-token index to yield
+            while True:
+                kind, payload = rec["stream_q"].get()
+                if kind == "tokens":
+                    start, toks = payload
+                    if start <= expect < start + len(toks):
+                        fresh = toks[expect - start:]
+                        expect += len(fresh)
+                        yield fresh
+                elif kind == "done":
+                    tail = list(payload)[len(rec["prompt"]) + expect:]
+                    if tail:
+                        yield tail
+                    return
+                else:
+                    raise payload
+
+        return rec, drain()
+
+    def stream(self, prompt_row, max_new, temperature=0.0, seed=0,
+               adapter=0, deadline_ms=None):
+        """Iterator over lists of NEW tokens as they decode (one chunk
+        per engine dispatch — ``ticks_per_dispatch`` tokens at a
+        time), ending after the final chunk.  Raises the engine's
+        error if the request fails."""
+        return self.stream_open(prompt_row, max_new,
+                                temperature=temperature, seed=seed,
+                                adapter=adapter,
+                                deadline_ms=deadline_ms)[1]
+
+    def _finish_error(self, rec, err, kind=None, **fields):
+        """Terminal error delivery: waiter raises, streaming consumer
+        gets its terminal chunk, the lifecycle index forgets the id."""
+        rec["error"] = err
+        with self._lock:
+            self._by_id.pop(rec.get("id"), None)
+        if rec["stream_q"] is not None:
+            self._stream_dropped += rec["stream_q"].dropped
+            rec["stream_q"].put_terminal(("error", err))
+        rec["event"].set()
+        if kind is not None:
+            flight.record(kind, req=rec.get("id"),
+                          prompt_len=len(rec["prompt"]), **fields)
+
+    def _drain_cancels(self):
+        """Engine thread: act on queued ``cancel()`` requests — remove
+        the record wherever it currently lives (ingress, batcher
+        queue, or a live slot) and free its resources."""
+        while self._cancels:
+            req_id = self._cancels.popleft()
+            with self._lock:
+                rec = self._by_id.get(req_id)
+                if rec is None:
+                    continue
+                try:
+                    self._ingress.remove(rec)
+                except ValueError:
+                    pass
+                if rec["_rid"] is not None:
+                    self._records.pop(rec["_rid"], None)
+            if rec["_rid"] is not None:
+                # sole-caller contract: only this thread touches the
+                # batcher — frees the slot and (paged) its KV blocks
+                # mid-decode
+                self.cb.cancel(rec["_rid"])
+            self._cancelled += 1
+            admitted = rec["admit_ts"] is not None
+            self._finish_error(
+                rec, RequestCancelled(rec["_cancel_reason"]
+                                      or "cancelled"),
+                kind="serve.cancel", admitted=admitted,
+                reason=rec["_cancel_reason"] or "cancelled")
+
+    def _p50_ms_per_tok(self):
+        """Measured p50 decode rate over the history window (0.0 with
+        no history — never blocks admission before the first
+        completions).  One O(n log n) pass; callers processing a batch
+        compute it ONCE per drain, not per record."""
+        with self._lock:
+            vals = sorted(h["ms_per_tok"] for h in self._history)
+        if not vals:
+            return 0.0
+        return vals[len(vals) // 2]
+
+    @staticmethod
+    def _expired(rec, now, p50_ms_per_tok):
+        """Deadline verdict for a not-yet-admitted request: already
+        past, or provably unable to finish in the remaining budget
+        (measured p50 decode rate) — decoding it would burn pool time
+        nobody can use."""
+        if rec["deadline"] is None:
+            return False
+        return (now >= rec["deadline"]
+                or now + p50_ms_per_tok * rec["max_new"] / 1e3
+                > rec["deadline"])
+
+    def _sweep_deadlines(self, now):
+        """Cancel every tracked request whose deadline has passed:
+        queued ones before they waste a slot, admitted ones
+        mid-decode (the slot and its KV blocks free immediately)."""
+        doomed = []
+        with self._lock:
+            for rid, rec in self._records.items():
+                if rec["deadline"] is not None \
+                        and now >= rec["deadline"]:
+                    doomed.append((rid, rec))
+            for rid, _ in doomed:
+                self._records.pop(rid, None)
+        for rid, rec in doomed:
+            self.cb.cancel(rid)
+            self._deadline_expired += 1
+            admitted = rec["admit_ts"] is not None
+            self._finish_error(
+                rec, DeadlineExceeded(
+                    "deadline expired %s (deadline_ms budget spent "
+                    "%.0f ms after submit)"
+                    % ("mid-decode" if admitted
+                       else "before admission",
+                       (now - rec["submit_ts"]) * 1e3)),
+                kind="serve.deadline", admitted=admitted)
+
+    def _update_shedder(self, now):
+        """One control-loop step for the SLO shedder: the head-of-line
+        wait (oldest still-unadmitted request) complements the
+        per-admit measurements — it keeps the valve responsive when
+        the pool is so far behind nothing is admitted at all."""
+        if not self._shed.enabled:
+            return
+        with self._lock:
+            oldest = min(
+                (rec["submit_ts"] for rec in list(self._ingress)
+                 + list(self._records.values())
+                 if rec["admit_ts"] is None), default=None)
+        head_wait_ms = (now - oldest) * 1e3 if oldest is not None \
+            else 0.0
+        trans = self._shed.update(head_wait_ms)
+        if trans is not None:
+            flight.record("serve.shed_%s" % trans,
+                          head_wait_ms=round(head_wait_ms, 3),
+                          slo_ms=self._slo_queue_wait_ms,
+                          shed_total=self._shed.shed_total)
+
+    def _fault_recover(self, err):
+        """An engine tick raised: fail every in-flight request, hard-
+        reset the batcher pool (a failed DONATED dispatch may have
+        invalidated the state buffers), and keep serving — queued
+        ingress requests survive and admit into the fresh pool.  The
+        alternative (let the engine thread die) wedges every current
+        and future waiter forever."""
+        self._engine_faults += 1
+        with self._lock:
+            victims = list(self._records.values())
+            self._records.clear()
+        self.cb.reset_pool()
+        for rec in victims:
+            self._finish_error(
+                rec, RuntimeError("engine fault failed this request: "
+                                  "%r" % (err,)),
+                kind="serve.fault_evict")
 
     def _loop(self):
         while True:
@@ -277,27 +549,43 @@ class ContinuousEngine(Logger):
                     return
                 new = list(self._ingress)
                 self._ingress.clear()
+            now = time.monotonic()
+            p50_ms = self._p50_ms_per_tok() if new else 0.0
             for rec in new:           # engine thread: sole cb caller
+                if rec["_cancel_reason"] is not None:
+                    continue          # cancel arrived pre-submit —
+                                      # _drain_cancels below delivers
+                if self._expired(rec, now, p50_ms):
+                    with self._lock:
+                        self._by_id.pop(rec.get("id"), None)
+                    self._deadline_expired += 1
+                    self._finish_error(
+                        rec, DeadlineExceeded(
+                            "deadline expired before admission"),
+                        kind="serve.deadline", admitted=False)
+                    continue
                 try:
                     rid = self.cb.submit(rec["prompt"], rec["max_new"],
                                          adapter=rec.get("adapter", 0),
                                          temperature=rec["temperature"],
                                          seed=rec["seed"])
                 except Exception as e:  # noqa: BLE001 — deliver to waiter
-                    rec["error"] = e
-                    if rec["stream_q"] is not None:
-                        rec["stream_q"].put(("error", e))
-                    rec["event"].set()
+                    self._finish_error(rec, e)
                     continue
+                stopped = False
                 with self._lock:
-                    if self._closed:   # stop() raced the hand-off —
-                        rec["error"] = RuntimeError(  # release the waiter
-                            "engine stopped before request completed")
-                        if rec["stream_q"] is not None:
-                            rec["stream_q"].put(("error", rec["error"]))
-                        rec["event"].set()
-                        continue
-                    self._records[rid] = rec
+                    if self._closed:   # stop() raced the hand-off
+                        stopped = True
+                    else:
+                        rec["_rid"] = rid
+                        self._records[rid] = rec
+                if stopped:           # release the waiter
+                    self._finish_error(rec, RuntimeError(
+                        "engine stopped before request completed"))
+            self._drain_cancels()
+            now = time.monotonic()
+            self._sweep_deadlines(now)
+            self._update_shedder(now)
             if self.cb.idle():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -307,10 +595,16 @@ class ContinuousEngine(Logger):
                     rec["stream_q"] is not None
                     for rec in self._records.values())
             tick_start = time.monotonic()
-            self.cb.tick()            # device dispatch — NO lock held
+            try:
+                self.cb.tick()        # device dispatch — NO lock held
+            except Exception as e:    # noqa: BLE001 — survive the tick
+                flight.record("serve.engine_fault", error=repr(e))
+                self._fault_recover(e)
+                continue
             now = time.monotonic()
             active = self.cb.active_requests()
             done = []
+            pushes = []
             with self._lock:
                 for rid, rec in self._records.items():
                     admitted = rid in active or \
@@ -322,29 +616,36 @@ class ContinuousEngine(Logger):
                         # fused dispatch) records the tick's real
                         # duration as decode time, not a 1e-9 floor
                         rec["admit_ts"] = tick_start
+                        qw_ms = (tick_start - rec["submit_ts"]) * 1e3
+                        # the MEASURED queue wait: the flight event is
+                        # the post-mortem record, the shedder feed is
+                        # the closed loop acting on the same number
+                        self._shed.note_admit(qw_ms)
                         # flight gets the REAL admission (serve.submit
                         # marked the enqueue): the gap between the two
                         # is the queue wait a post-mortem measures
                         flight.record(
-                            "serve.admit",
+                            "serve.admit", req=rec.get("id"),
                             prompt_len=len(rec["prompt"]),
-                            queue_wait_ms=(tick_start
-                                           - rec["submit_ts"]) * 1e3)
+                            queue_wait_ms=qw_ms)
                 for rid, rec in self._records.items():
                     if rec["stream_q"] is None:
                         continue
                     part = self.cb.partial(rid)
                     if part is None:
                         continue
+                    # _sent advances only on DELIVERY below: a chunk a
+                    # full 'block' channel refuses is re-derived from
+                    # the next dispatch's partial instead of lost
                     fresh = part[len(rec["prompt"]) + rec["_sent"]:]
                     if fresh:
-                        rec["_sent"] += len(fresh)
-                        rec["stream_q"].put(("tokens", fresh))
+                        pushes.append((rec, fresh))
                 for rid in list(self._records):
                     out = self.cb.pop_result(rid)
                     if out is None:
                         continue
                     rec = self._records.pop(rid)
+                    self._by_id.pop(rec.get("id"), None)
                     rec["out"] = out
                     done.append(rec)
                     dec = max(1e-9, now - (rec["admit_ts"] or now))
@@ -360,6 +661,27 @@ class ContinuousEngine(Logger):
                         "ms_per_tok": dec * 1e3 / max(1, n_new),
                         "finish_ts": now})
                     self._served += 1
+            # stream delivery: push is NON-blocking (one slow consumer
+            # must never freeze the engine loop every other request's
+            # decode shares).  A full 'block' channel keeps this
+            # request's chunks back for the next dispatch; once it has
+            # made no progress for stream_stall_timeout_ms the
+            # consumer is dead or a slowloris — cancel the request
+            # instead of letting it pin its slot.
+            for rec, fresh in pushes:
+                if rec["stream_q"].push(
+                        ("tokens", (rec["_sent"], fresh))):
+                    rec["_sent"] += len(fresh)
+                    rec["_stall_since"] = None
+                elif rec["_stall_since"] is None:
+                    rec["_stall_since"] = now
+                elif now - rec["_stall_since"] > self._stream_stall_s:
+                    flight.record("serve.stream_stall",
+                                  req=rec.get("id"),
+                                  sent=rec["_sent"])
+                    self.cancel(rec["id"],
+                                reason="stream consumer stalled past "
+                                       "stream_stall_timeout_ms")
             if self._kv_gauge is not None:
                 with self._lock:
                     self._kv_gauge = self.cb.free_blocks()
@@ -375,15 +697,14 @@ class ContinuousEngine(Logger):
                         slo_ms=self._slo_queue_wait_ms,
                         prompt_len=len(rec["prompt"]))
                 if rec["stream_q"] is not None:
-                    # the batcher drops its partial snapshot when the
-                    # row completes — flush whatever the last dispatch
-                    # decoded from the final result before the terminal
-                    tail = list(rec["out"])[len(rec["prompt"])
-                                            + rec["_sent"]:]
-                    if tail:
-                        rec["_sent"] += len(tail)
-                        rec["stream_q"].put(("tokens", tail))
-                    rec["stream_q"].put(("done", rec["out"]))
+                    # no tail flush here: the terminal's payload IS the
+                    # full result, and the consumer-side drain yields
+                    # whatever the last dispatch decoded (or overflow
+                    # swallowed) as one final reconstructed chunk —
+                    # a full 'block' channel at completion can refuse
+                    # nothing it would lose
+                    self._stream_dropped += rec["stream_q"].dropped
+                    rec["stream_q"].put_terminal(("done", rec["out"]))
                 rec["event"].set()
 
     def metrics(self):
@@ -401,7 +722,16 @@ class ContinuousEngine(Logger):
         out = {"served": served, "queued": queued,
                "in_flight": in_flight, "slots": self.cb.slots,
                "uptime_s": round(time.monotonic() - self._start_ts, 1),
-               "agg_tokens_per_sec": 0.0}
+               "agg_tokens_per_sec": 0.0,
+               # lifecycle counters (docs/services.md "Serving
+               # robustness"): shed valve state + how many requests
+               # each enforcement path has taken out
+               "shed_state": self._shed.status()["state"],
+               "shed_total": self._shed.shed_total,
+               "cancelled_total": self._cancelled,
+               "deadline_expired_total": self._deadline_expired,
+               "engine_faults": self._engine_faults,
+               "stream_dropped_chunks": self._stream_dropped}
         if self._kv_gauge is not None:
             out["free_kv_blocks"] = self._kv_gauge
         if self._prefix_gauge is not None:
@@ -438,6 +768,40 @@ class ContinuousEngine(Logger):
             self._served = 0
             self._start_ts = time.monotonic()
 
+    def lifecycle_status(self):
+        """The ``/api/health`` serving block: shed valve state plus
+        the lifecycle counters — cheap and lock-light, safe for a
+        liveness probe."""
+        out = dict(self._shed.status())
+        with self._lock:
+            out.update({
+                "open_requests": len(self._by_id),
+                "cancelled_total": self._cancelled,
+                "deadline_expired_total": self._deadline_expired,
+                "engine_faults": self._engine_faults,
+                "stream_dropped_chunks": self._stream_dropped,
+            })
+        return out
+
+    def leak_check(self):
+        """Post-drain resource audit for the chaos harness and the
+        lifecycle tests: call AFTER the engine went idle (metrics()
+        queued == in_flight == 0) — it reads batcher state that only
+        the engine thread may touch while work is in flight.  Every
+        value should be 0 / True on a healthy drained engine."""
+        with self._lock:
+            out = {"ingress": len(self._ingress),
+                   "records": len(self._records),
+                   "open_requests": len(self._by_id),
+                   "pending_cancels": len(self._cancels)}
+        out["slots_busy"] = sum(
+            1 for r in self.cb._slot_req if r is not None)
+        if hasattr(self.cb, "free_blocks"):
+            out["kv_blocks_leaked"] = (self.cb.pool_blocks
+                                       - self.cb.free_blocks())
+        out["engine_thread_alive"] = self._thread.is_alive()
+        return out
+
     def stop(self):
         with self._lock:
             self._closed = True
@@ -446,6 +810,8 @@ class ContinuousEngine(Logger):
             pending = list(self._ingress) + list(self._records.values())
             self._ingress.clear()
             self._records.clear()
+            self._by_id.clear()
+        self._cancels.clear()
         for rec in pending:
             if rec["out"] is None and rec["error"] is None:
                 rec["error"] = RuntimeError(
@@ -453,7 +819,7 @@ class ContinuousEngine(Logger):
             if rec.get("stream_q") is not None and rec["out"] is None:
                 # a streaming consumer blocks in stream_q.get(), not on
                 # the event — it needs its own terminal or it hangs
-                rec["stream_q"].put(("error", rec["error"]))
+                rec["stream_q"].put_terminal(("error", rec["error"]))
             rec["event"].set()
         self._wake.set()
         self._thread.join(timeout=5)
@@ -510,6 +876,16 @@ class RESTfulAPI(Logger):
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, code, payload, headers=()):
+                msg = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(msg)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(msg)
+
             def do_POST(self):
                 if self.path != api.path:
                     self.send_error(404)
@@ -523,15 +899,22 @@ class RESTfulAPI(Logger):
                         # per engine dispatch, then {"done", "result"}.
                         # HTTP/1.0 semantics — body is EOF-delimited,
                         # so no Content-Length / chunking needed.
-                        prompt, chunks = api.run_generate_stream(req)
+                        # run_generate_stream submits EAGERLY, so
+                        # shed (503) / validation (400) surface before
+                        # the 200 header commits.
+                        prompt, chunks, handle = \
+                            api.run_generate_stream(req)
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/x-ndjson")
                         self.end_headers()
                         got = list(prompt)
-                        # headers are out: a mid-stream failure must
-                        # surface as a structured NDJSON error line,
-                        # never as a 400 status injected into the body
+                        # headers are out: a mid-stream ENGINE failure
+                        # surfaces as a structured NDJSON error line;
+                        # a failed WRITE means the client is gone —
+                        # cancel engine-side so the request frees its
+                        # slot (and KV blocks) instead of decoding to
+                        # completion for nobody.
                         try:
                             for fresh in chunks:
                                 got.extend(fresh)
@@ -539,32 +922,48 @@ class RESTfulAPI(Logger):
                                     (json.dumps({"tokens": fresh})
                                      + "\n").encode())
                                 self.wfile.flush()
+                            # the handle's final result is authoritative
+                            # even if drop-oldest overflow dropped
+                            # mid-stream chunks on a slow reader
+                            result = (list(handle["out"])
+                                      if handle["out"] is not None
+                                      else got)
+                            tail = {"done": True, "result": result}
+                            dropped = (handle["stream_q"].dropped
+                                       if handle["stream_q"] is not None
+                                       else 0)
+                            if dropped:
+                                tail["dropped_chunks"] = dropped
                             self.wfile.write(
-                                (json.dumps({"done": True,
-                                             "result": got})
-                                 + "\n").encode())
+                                (json.dumps(tail) + "\n").encode())
                         except Exception as e:  # noqa: BLE001
-                            self.wfile.write(
-                                (json.dumps({"error": str(e)})
-                                 + "\n").encode())
+                            api.engine.cancel(
+                                handle["id"],
+                                reason="stream write failed: %r" % e)
+                            try:
+                                self.wfile.write(
+                                    (json.dumps({"error": str(e)})
+                                     + "\n").encode())
+                            except Exception:  # noqa: BLE001 — dead pipe
+                                pass
                         return
                     if "generate" in req:
                         out = api.run_generate(req)
                     else:
                         out = np.asarray(api.forward(api.decode_input(req)))
-                    body = json.dumps({"result": out.tolist()}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_json(200, {"result": out.tolist()})
+                except ShedError as e:
+                    # SLO admission shedding: tell the client to back
+                    # off instead of queuing into a breach
+                    self._send_json(
+                        503, {"error": str(e),
+                              "retry_after_s": e.retry_after_s},
+                        headers=[("Retry-After", str(max(
+                            1, int(math.ceil(e.retry_after_s)))))])
+                except DeadlineExceeded as e:
+                    self._send_json(504, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report to client
-                    msg = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(msg)))
-                    self.end_headers()
-                    self.wfile.write(msg)
+                    self._send_json(400, {"error": str(e)})
 
             def log_message(self, fmt, *args):
                 api.debug("http: " + fmt, *args)
@@ -605,22 +1004,39 @@ class RESTfulAPI(Logger):
 
     # ---------------------------------------------------------- generation
     @staticmethod
-    def _plain_engine_request(opts):
-        """True iff this generate request can ride the slot pool:
-        plain greedy/temperature, at least one new token — the ONE
-        predicate the engine branch, the adapter gate, and the
-        streaming gate all share (three hand-copies drifted once
-        already)."""
-        return (int(opts.get("beam", 0)) <= 1
-                and not int(opts.get("speculative", 0))
-                and int(opts.get("top_k", 0)) == 0
+    def _engine_opts_subset(opts):
+        """The sampling-options subset EVERY slot-pool path requires:
+        no top-k/top-p truncation (the pool decodes greedy/plain-
+        temperature only) and at least one new token (a slot must
+        decode something to ever free itself).  The engine dispatch
+        branch checks exactly this — beam/speculative requests were
+        dispatched before it runs — while the adapter and streaming
+        gates layer the beam/speculative exclusions on top via
+        ``_plain_engine_request``."""
+        return (int(opts.get("top_k", 0)) == 0
                 and float(opts.get("top_p", 1.0)) >= 1.0
                 and int(opts.get("max_new", 16)) >= 1)
+
+    @staticmethod
+    def _plain_engine_request(opts):
+        """True iff this generate request can ride the slot pool from
+        a cold start: plain greedy/temperature, no beam, no
+        speculative — the predicate the adapter gate and the streaming
+        gate share (the engine dispatch branch needs only
+        ``_engine_opts_subset``; see there)."""
+        return (int(opts.get("beam", 0)) <= 1
+                and not int(opts.get("speculative", 0))
+                and RESTfulAPI._engine_opts_subset(opts))
 
     def run_generate_stream(self, req):
         """NDJSON token streaming: validates a single-row greedy /
         plain-temperature engine request and returns (prompt, iterator
-        over new-token chunks).  Everything else must use the buffered
+        over new-token chunks, engine handle).  The submit happens
+        EAGERLY — shed/validation errors raise here, before the
+        HTTP layer commits response headers — and the handle carries
+        the cancel token (``handle["id"]``) for a mid-stream
+        disconnect plus the authoritative final result
+        (``handle["out"]``).  Everything else must use the buffered
         endpoint — streaming has no batch to coalesce and no beam
         state to surface incrementally."""
         if self.generator is None:
@@ -641,12 +1057,13 @@ class RESTfulAPI(Logger):
             raise ValueError("\"stream\" supports plain greedy/"
                              "temperature requests only")
         self.generator.validate_request(len(prompt[0]), opts)
-        it = self.engine.stream(
+        handle, it = self.engine.stream_open(
             prompt[0], int(opts.get("max_new", 16)),
             temperature=float(opts.get("temperature", 0.0)),
             seed=int(opts.get("seed", 0)),
-            adapter=int(opts.get("adapter", 0)))
-        return prompt[0].tolist(), it
+            adapter=int(opts.get("adapter", 0)),
+            deadline_ms=opts.get("deadline_ms"))
+        return prompt[0].tolist(), it, handle
 
     def run_generate(self, req):
         """``{"input": [[tok, ...]], "generate": {"max_new": N,
@@ -687,9 +1104,7 @@ class RESTfulAPI(Logger):
             # falls back itself when speculation can't apply)
             return self.generator.generate_speculative(
                 prompt, int(opts.get("max_new", 16)), draft_k=spec)
-        if self.engine is not None and int(opts.get("top_k", 0)) == 0 \
-                and float(opts.get("top_p", 1.0)) >= 1.0 \
-                and int(opts.get("max_new", 16)) >= 1:
+        if self.engine is not None and self._engine_opts_subset(opts):
             # (beam/speculative were dispatched above; a speculative
             # request that fell through — batcher attached, sampled,
             # or multi-row — rides the pool as plain decode, as
@@ -698,11 +1113,22 @@ class RESTfulAPI(Logger):
             # can't)
             for row in prompt:
                 self.generator.validate_request(len(row), opts)
-            handles = [self.engine.submit_async(
-                row, int(opts.get("max_new", 16)),
-                temperature=float(opts.get("temperature", 0.0)),
-                seed=int(opts.get("seed", 0)),
-                adapter=int(opts.get("adapter", 0))) for row in prompt]
+            handles = []
+            try:
+                for row in prompt:
+                    handles.append(self.engine.submit_async(
+                        row, int(opts.get("max_new", 16)),
+                        temperature=float(opts.get("temperature", 0.0)),
+                        seed=int(opts.get("seed", 0)),
+                        adapter=int(opts.get("adapter", 0)),
+                        deadline_ms=opts.get("deadline_ms")))
+            except ShedError:
+                # the shedder opened mid-request: the rows already in
+                # must not decode for a client that gets a 503
+                for h in handles:
+                    self.engine.cancel(h["id"],
+                                       reason="sibling row shed")
+                raise
             return np.stack([self.engine.wait(h) for h in handles])
         if self.batcher is not None:
             # validate THIS request up front — a bad one must 400 alone,

@@ -504,17 +504,28 @@ class WebStatusServer(Logger):
                 "measured_at": measured.get("measured_at"),
                 "cache_path": path}
 
-    @staticmethod
-    def health_status():
+    def health_status(self):
         """``/api/health`` payload: process id/mode, last-step age,
         watchdog state, crashdump count (telemetry.health.status), plus
-        the dashboard registration count.  Never raises — a health
-        probe that 500s is worse than no probe."""
+        — when a serving endpoint is registered — the lifecycle block
+        (shed valve state, cancel/deadline/fault counters) under
+        ``"serving"``, so an operator's probe sees load shedding the
+        moment it starts.  Never raises — a health probe that 500s is
+        worse than no probe."""
         try:
             from veles_tpu.telemetry import health
-            return health.status()
+            state = health.status()
         except Exception as e:   # noqa: BLE001
-            return {"error": str(e), "watchdog": {"tripped": False}}
+            state = {"error": str(e), "watchdog": {"tripped": False}}
+        with self._lock:
+            serving = self._serving
+        engine = getattr(serving, "engine", None)
+        if engine is not None:
+            try:
+                state["serving"] = engine.lifecycle_status()
+            except Exception as e:   # noqa: BLE001
+                state["serving"] = {"error": str(e)}
+        return state
 
     def status(self):
         out = {"time": time.time(), "workflows": {}, "remote": self._updates[-20:]}
