@@ -158,6 +158,21 @@ class TestSloShedder:
         assert sh.shed_total == 1
         assert sh.status()["state"] == "closed"
 
+    def test_retry_after_scales_with_overshoot(self):
+        """A deeply overloaded replica pushes clients (and the fleet
+        router) away for longer: the Retry-After hint scales with the
+        measured queue-wait overshoot, capped."""
+        sh = SloShedder(1000.0, overshoot_cap=8.0)
+        assert sh.retry_after_s() == pytest.approx(1.0)  # no measure yet
+        sh.update(head_wait_ms=500.0)          # under the SLO: floor
+        assert sh.retry_after_s() == pytest.approx(1.0)
+        sh.update(head_wait_ms=3500.0)         # 3.5x the SLO
+        assert sh.retry_after_s() == pytest.approx(3.5)
+        sh.update(head_wait_ms=100000.0)       # pathological: capped
+        assert sh.retry_after_s() == pytest.approx(8.0)
+        sh.update(head_wait_ms=200.0)          # drained: back to floor
+        assert sh.retry_after_s() == pytest.approx(1.0)
+
 
 class TestCancel:
     def test_cancel_mid_decode_frees_slot_and_kv_blocks(self, gen,

@@ -26,6 +26,22 @@ iff every gate passes; ``--json`` writes the full report and
 
 Scaled-down flavors run inside tier-1 (`tests/test_lifecycle.py`); the
 CI `serve-chaos` job runs this CLI with a few hundred clients.
+
+**Fleet chaos mode** (`--fleet N`): spawn N replica subprocesses, put a
+`services.router.FleetRouter` in front, storm the ROUTER with streaming
+clients, then SIGKILL one replica and SIGTERM-drain another mid-storm.
+Gates: every non-shed request completes with the byte-exact full
+result (mid-stream failover splices are invisible), the router marks
+the killed replica down within one health-check interval, the drained
+replica exits 0, and `leak_check()` is clean on every survivor::
+
+    python tools/serve_loadtest.py --fleet 3 --clients 150 \
+        --slots 4 --paged-block 4 --pool-tokens 512 \
+        --json fleet-report.json --flight-dump fleet-dump
+
+(`--replica` is the internal subprocess entry the fleet mode spawns;
+it serves one engine replica on an OS-assigned port — announced via a
+`REPLICA_READY port=...` stdout line — and drains on SIGTERM.)
 """
 
 import argparse
@@ -33,8 +49,10 @@ import http.client
 import json
 import os
 import random
+import signal
 import socket
 import struct
+import subprocess
 import sys
 import threading
 import time
@@ -403,6 +421,390 @@ def gates(report, expect_shed=True, require_slo=False):
     return fails
 
 
+# --------------------------------------------------------------- fleet mode
+def replica_main(args):
+    """Subprocess entry for one fleet replica: build the tiny model,
+    serve it, print READY with the bound port, drain on SIGTERM (exit
+    0), die honestly on SIGKILL."""
+    from veles_tpu.services.restful import install_sigterm_drain
+    from veles_tpu.telemetry import flight
+
+    api = build_api(slots=args.slots, paged_block=args.paged_block,
+                    pool_tokens=args.pool_tokens, slo_ms=args.slo_ms,
+                    deadline_ms=0, seed=args.seed)
+    if getattr(args, "tick_delay_ms", 0):
+        # stretch decode so the fleet storm's mid-storm SIGKILL lands
+        # while streams are provably in flight (a tiny model on a fast
+        # box finishes 8 tokens in microseconds otherwise)
+        delay_s = float(args.tick_delay_ms) / 1e3
+        orig_tick = api.engine.cb.tick
+
+        def slow_tick():
+            time.sleep(delay_s)
+            return orig_tick()
+
+        api.engine.cb.tick = slow_tick
+    # leave a black box on graceful (drained) exit so the fleet
+    # timeline can be merged across processes — the SIGKILLed replica
+    # leaves none, which is the point.  The hook rides the drain
+    # waiter: os._exit skips atexit handlers.
+    install_sigterm_drain(
+        api,
+        on_drained=(lambda: flight.dump(args.dump_dir,
+                                        reason="replica-drain"))
+        if args.dump_dir else None)
+    # READY handshake: the parent reads the bound port off stdout
+    print("REPLICA_READY port=%d pid=%d" % (api.port, os.getpid()),
+          flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_replicas(n, args, dump_dir=None):
+    """Start n replica subprocesses; returns [(proc, port, url)].
+    Replicas inherit the environment (JAX_PLATFORMS etc.).  EVERY
+    replica builds from the SAME seed: identical weights are what make
+    greedy decode — and therefore mid-stream failover splices —
+    byte-identical across the fleet."""
+    procs = []
+    for i in range(n):
+        cmd = [sys.executable, os.path.abspath(__file__), "--replica",
+               "--slots", str(args.slots),
+               "--paged-block", str(args.paged_block),
+               "--slo-ms", str(args.slo_ms),
+               "--seed", str(args.seed),
+               "--tick-delay-ms",
+               str(getattr(args, "tick_delay_ms", 0))]
+        if args.pool_tokens:
+            cmd += ["--pool-tokens", str(args.pool_tokens)]
+        if dump_dir:
+            cmd += ["--dump-dir", dump_dir]
+        env = dict(os.environ)
+        env["VELES_TPU_PROCESS_ID"] = str(i + 1)   # distinct blackbox ids
+        procs.append([subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env), None, None])
+    import select
+    deadline = time.monotonic() + 300
+    for rec in procs:
+        proc = rec[0]
+        while rec[1] is None:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise RuntimeError("replica startup timed out")
+            # select before readline: a silently wedged replica (alive
+            # but never printing) must hit the deadline, not block the
+            # harness on the pipe forever
+            ready, _, _ = select.select([proc.stdout], [], [],
+                                        min(1.0, left))
+            if not ready:
+                continue
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("replica died during startup "
+                                   "(exit %r)" % proc.poll())
+            if line.startswith("REPLICA_READY"):
+                port = int(line.split("port=")[1].split()[0])
+                rec[1] = port
+                rec[2] = "http://127.0.0.1:%d/service" % port
+    return [tuple(rec) for rec in procs]
+
+
+def _fleet_client(router, prompt, max_new, expected, session, tally,
+                  lock, errors=None):
+    """One fleet storm client: stream through the ROUTER and verify
+    the full concatenated result — chunk lines must splice to exactly
+    the done line's result, and that result must equal the expected
+    uninterrupted output (failover must be invisible)."""
+    body = json.dumps({"input": prompt, "session": session,
+                       "generate": {"max_new": max_new,
+                                    "stream": True}})
+    outcome = "error"
+    try:
+        conn = http.client.HTTPConnection(router.host, router.port,
+                                          timeout=180)
+        conn.request("POST", router.path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 503:
+            resp.read()
+            outcome = "shed"
+        elif resp.status != 200:
+            resp.read()
+            outcome = "http_%d" % resp.status
+        else:
+            got, result, done = list(prompt), None, False
+            while True:
+                raw = resp.fp.readline()
+                if not raw:
+                    break
+                msg = json.loads(raw)
+                if "tokens" in msg:
+                    got.extend(msg["tokens"])
+                elif msg.get("done"):
+                    result, done = msg["result"], True
+                    break
+                elif "error" in msg:
+                    outcome = "stream_error"
+                    if errors is not None:
+                        with lock:
+                            errors.append(str(msg["error"])[:200])
+                    return
+            if not done:
+                outcome = "truncated"
+            elif list(result) != list(got):
+                outcome = "splice_mismatch"
+            elif expected is not None \
+                    and list(result) != list(expected):
+                outcome = "bad_result"
+            else:
+                outcome = "ok"
+        conn.close()
+    except Exception:  # noqa: BLE001 — chaos clients absorb anything
+        outcome = "error"
+    finally:
+        with lock:
+            tally[outcome] = tally.get(outcome, 0) + 1
+
+
+def _http_json(host, port, path, method="GET", body=None, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body,
+                     {"Content-Type": "application/json"}
+                     if body else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _wait_replica_idle(port, timeout=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            _, h = _http_json("127.0.0.1", port, "/service/health")
+            if h.get("queued", 0) == 0 and h.get("in_flight", 0) == 0:
+                return True
+        except OSError:
+            return False
+        time.sleep(0.05)
+    return False
+
+
+def run_fleet(replicas=3, clients=150, max_new=8, prompt_len=5,
+              slots=4, paged_block=0, pool_tokens=None, slo_ms=250,
+              kill_frac=0.15, drain_frac=0.35, seed=7,
+              health_interval_ms=100, sessions=16, tick_delay_ms=20,
+              flight_dump=None, args=None):
+    """The fleet chaos scenario (module docstring).  The SIGKILL fires
+    once ``kill_frac`` of the clients completed and the SIGTERM drain
+    at ``drain_frac`` — completion-triggered, not timed, so the chaos
+    provably lands MID-storm on any box speed.  Returns the report
+    dict for :func:`fleet_gates`."""
+    from veles_tpu.services.router import FleetRouter
+    from veles_tpu.telemetry import flight
+
+    if args is None:
+        args = argparse.Namespace(
+            slots=slots, paged_block=paged_block,
+            pool_tokens=pool_tokens, slo_ms=slo_ms, seed=seed,
+            tick_delay_ms=tick_delay_ms)
+    report = {"replicas": replicas, "clients": clients, "tally": {},
+              "phases": {}}
+    t0 = time.monotonic()
+    fleet = _spawn_replicas(replicas, args, dump_dir=flight_dump)
+    report["phases"]["spawn_s"] = round(time.monotonic() - t0, 2)
+    router = FleetRouter(port=0,
+                         health_interval_ms=health_interval_ms)
+    router.start()
+    for _, _, url in fleet:
+        router.register(url)
+    prompt = [int(1 + i % 7) for i in range(prompt_len)]
+    try:
+        # ---- warmup every replica directly (compiles happen OUTSIDE
+        # the storm) and capture the expected uninterrupted result —
+        # all replicas are built from the same seed'd tiny model, so
+        # greedy decode is identical everywhere
+        t0 = time.monotonic()
+        expected = None
+        for _, port, _ in fleet:
+            status, out = _http_json(
+                "127.0.0.1", port, "/service", method="POST",
+                body=json.dumps({"input": prompt,
+                                 "generate": {"max_new": max_new}}),
+                timeout=300)
+            assert status == 200, (status, out)
+            if expected is None:
+                expected = out["result"][0]
+            elif list(expected) != list(out["result"][0]):
+                report["replica_divergence"] = True
+        report["phases"]["warmup_s"] = round(time.monotonic() - t0, 2)
+        report["expected_len"] = len(expected)
+
+        # ---- storm through the router; mid-storm: SIGKILL one
+        # replica, SIGTERM-drain another
+        tally, lock = {}, threading.Lock()
+        stream_errors = []
+        threads = [threading.Thread(
+            target=_fleet_client,
+            args=(router, prompt, max_new, expected,
+                  "sess-%d" % (i % sessions), tally, lock,
+                  stream_errors),
+            daemon=True) for i in range(clients)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+
+        def completed():
+            with lock:
+                return sum(tally.values())
+
+        # completion-triggered chaos: SIGKILL once kill_frac of the
+        # clients finished (streams are provably still in flight),
+        # SIGTERM-drain another replica at drain_frac
+        kill_proc, kill_port, _ = fleet[0]
+        drain_proc, drain_port, _ = fleet[1]
+        deadline = time.monotonic() + 300
+        while completed() < kill_frac * clients \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        kill_ts = time.monotonic()
+        kill_proc.kill()                          # SIGKILL: no goodbye
+        report["sigkill_replica_port"] = kill_port
+        report["sigkill_at_completed"] = completed()
+        while completed() < drain_frac * clients \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        drain_proc.send_signal(signal.SIGTERM)    # graceful drain
+        report["sigterm_replica_port"] = drain_port
+        report["sigterm_at_completed"] = completed()
+        for th in threads:
+            th.join(timeout=300)
+        report["stuck_client_threads"] = sum(
+            1 for th in threads if th.is_alive())
+        report["phases"]["storm_s"] = round(time.monotonic() - t0, 2)
+        report["tally"] = tally
+        report["stream_errors"] = stream_errors[:20]
+
+        # ---- failover detection latency: the first replica_down
+        # flight event after the SIGKILL (request-path detection
+        # usually beats the health probe; one probe interval is the
+        # ceiling the acceptance criterion names)
+        down_ts = None
+        for ev in flight.recorder.snapshot():
+            if ev["kind"] == "serve.replica_down" \
+                    and ev["ts"] >= kill_ts + _MONO_TO_WALL:
+                down_ts = ev["ts"]
+                break
+        report["failover_detect_s"] = (
+            round(down_ts - (kill_ts + _MONO_TO_WALL), 3)
+            if down_ts is not None else None)
+
+        # ---- drained replica must exit 0 (stop admission → finish
+        # in-flight → exit 0), SIGKILLed one must be gone
+        try:
+            report["sigterm_exit"] = drain_proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            report["sigterm_exit"] = None
+        report["sigkill_exit"] = kill_proc.wait(timeout=30)
+
+        # ---- survivors: idle, then leak-audited
+        survivors = fleet[2:]
+        leaks = {}
+        for _, port, _ in survivors:
+            if not _wait_replica_idle(port):
+                leaks[port] = {"error": "never idled"}
+                continue
+            _, leaks[port] = _http_json("127.0.0.1", port,
+                                        "/service/leaks")
+        report["survivor_leaks"] = leaks
+        report["router_metrics"] = router.metrics()
+        kinds = [e["kind"] for e in flight.recorder.snapshot()]
+        report["flight_kinds"] = {
+            k: kinds.count(k)
+            for k in ("serve.replica_up", "serve.replica_down",
+                      "serve.failover", "serve.drain")}
+        if flight_dump:
+            report["flight_dump"] = flight.dump(flight_dump,
+                                                reason="fleet-loadtest")
+    finally:
+        router.stop()
+        for proc, _, _ in fleet:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    return report
+
+
+#: flight events stamp wall time; the harness measures monotonic —
+#: one offset sample converts between them (drift over a storm is
+#: far below the gate's slack)
+_MONO_TO_WALL = time.time() - time.monotonic()
+
+
+def fleet_gates(report, health_interval_ms=100):
+    """Pass/fail verdicts for the fleet chaos run (CI `serve-fleet`).
+    Returns failure strings (empty = pass)."""
+    fails = []
+    tally = report.get("tally", {})
+    # exhaustive accounting: EVERY client must end ok or shed — any
+    # other outcome (truncated, splice_mismatch, bad_result, error,
+    # stream_error, http_4xx/5xx, ...) is a lost/corrupt request, and
+    # a missing outcome is a client that never reported
+    unexpected = {k: v for k, v in tally.items()
+                  if k not in ("ok", "shed") and v}
+    if unexpected:
+        fails.append("lost/corrupt requests: %r" % (unexpected,))
+    total = sum(tally.values())
+    if total != report.get("clients", total):
+        fails.append("client accounting: %d outcomes for %d clients"
+                     % (total, report.get("clients")))
+    if not tally.get("ok"):
+        fails.append("no request completed (tally=%r)" % (tally,))
+    if report.get("stuck_client_threads"):
+        fails.append("stuck client threads: %d"
+                     % report["stuck_client_threads"])
+    if report.get("replica_divergence"):
+        fails.append("replicas disagreed on the warmup output")
+    det = report.get("failover_detect_s")
+    # ceiling: one health-check interval (+1 s slack for the flight
+    # ring scan and scheduler noise); request-path detection usually
+    # lands far earlier
+    if det is None:
+        fails.append("SIGKILL never produced a serve.replica_down")
+    elif det > health_interval_ms / 1e3 + 1.0:
+        fails.append("failover took %.3f s (> one %.0f ms health "
+                     "interval + slack)" % (det, health_interval_ms))
+    if report.get("sigterm_exit") != 0:
+        fails.append("SIGTERM replica exit %r != 0 (graceful drain "
+                     "failed)" % (report.get("sigterm_exit"),))
+    for port, leaks in report.get("survivor_leaks", {}).items():
+        if leaks.get("error"):
+            fails.append("survivor %s: %s" % (port, leaks["error"]))
+            continue
+        for key in ("ingress", "records", "open_requests",
+                    "pending_cancels", "slots_busy"):
+            if leaks.get(key, 0) != 0:
+                fails.append("survivor %s leak: %s=%r"
+                             % (port, key, leaks[key]))
+        if leaks.get("kv_blocks_leaked", 0) != 0:
+            fails.append("survivor %s leak: kv_blocks_leaked=%r"
+                         % (port, leaks["kv_blocks_leaked"]))
+    counters = report.get("router_metrics", {}).get("counters", {})
+    if not counters.get("failovers"):
+        fails.append("router recorded no failover")
+    kinds = report.get("flight_kinds", {})
+    for kind in ("serve.replica_up", "serve.replica_down",
+                 "serve.failover", "serve.drain"):
+        if not kinds.get(kind):
+            fails.append("missing flight event: %s" % kind)
+    return fails
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="chaos load test for the serving survival layer")
@@ -431,7 +833,64 @@ def main(argv=None):
                     help="write the full report as JSON")
     ap.add_argument("--flight-dump", metavar="DIR",
                     help="leave a flight-recorder dump (CI artifact)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet chaos mode: N replica subprocesses "
+                         "behind a FleetRouter; SIGKILL one and "
+                         "SIGTERM-drain another mid-storm")
+    ap.add_argument("--health-interval-ms", type=float, default=100.0,
+                    help="fleet router health-probe period")
+    ap.add_argument("--sessions", type=int, default=16,
+                    help="distinct affinity session keys in the "
+                         "fleet storm")
+    ap.add_argument("--kill-frac", type=float, default=0.15,
+                    help="completed-client fraction at which replica "
+                         "0 is SIGKILLed")
+    ap.add_argument("--drain-frac", type=float, default=0.35,
+                    help="completed-client fraction at which replica "
+                         "1 gets SIGTERM (graceful drain)")
+    ap.add_argument("--tick-delay-ms", type=float, default=20.0,
+                    help="per-tick decode delay on fleet replicas "
+                         "(stretches streams so the chaos lands "
+                         "mid-flight)")
+    ap.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)   # internal subprocess entry
+    ap.add_argument("--dump-dir", default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.replica:
+        return replica_main(args)
+
+    if args.fleet:
+        report = run_fleet(
+            replicas=args.fleet, clients=args.clients,
+            max_new=args.max_new, prompt_len=args.prompt_len,
+            slots=args.slots, paged_block=args.paged_block,
+            pool_tokens=args.pool_tokens, slo_ms=args.slo_ms,
+            kill_frac=args.kill_frac,
+            drain_frac=args.drain_frac, seed=args.seed,
+            health_interval_ms=args.health_interval_ms,
+            sessions=args.sessions,
+            tick_delay_ms=args.tick_delay_ms,
+            flight_dump=args.flight_dump)
+        fails = fleet_gates(report,
+                            health_interval_ms=args.health_interval_ms)
+        report["failures"] = fails
+        out = json.dumps(report, indent=2, default=str)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+        print(out)
+        if fails:
+            print("FAIL: " + "; ".join(fails), file=sys.stderr)
+            return 1
+        print("PASS: fleet survived SIGKILL + SIGTERM drain — "
+              "%d ok, %d shed, %d failovers, detect %.3fs"
+              % (report["tally"].get("ok", 0),
+                 report["tally"].get("shed", 0),
+                 report["router_metrics"]["counters"]["failovers"],
+                 report["failover_detect_s"]), file=sys.stderr)
+        return 0
 
     report = run(clients=args.clients, disconnect=args.disconnect,
                  slowloris=args.slowloris, buffered=args.buffered,

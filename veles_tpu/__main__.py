@@ -1186,7 +1186,15 @@ class Main(object):
             # the dashboard's serving panel shows the slot pool's SLO
             # surface (queue depth, p50/p99 latency) live
             self._web.register_serving(api)
-        print("REST serving on port %d; Ctrl-C to stop" % api.port)
+        # SIGTERM (preemption, `kill`, a fleet operator) drains
+        # gracefully: stop admission (503 + Retry-After), finish every
+        # in-flight request, exit 0 — the same lifecycle a fleet
+        # replica walks (docs/services.md "Fleet serving"), instead of
+        # the training path's crashdump-and-die
+        from veles_tpu.services.restful import install_sigterm_drain
+        install_sigterm_drain(api)
+        print("REST serving on port %d; Ctrl-C to stop, SIGTERM to "
+              "drain" % api.port)
         try:
             import time
             while True:

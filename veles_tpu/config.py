@@ -166,6 +166,42 @@ root.common.update({
         "stream_overflow": "drop_oldest",
         "stream_stall_timeout_ms": 10000,
         "shed_close_fraction": 0.5,
+        # retry_after_overshoot_cap bounds how far the 503 Retry-After
+        # hint scales with the measured queue-wait overshoot: a replica
+        # whose queue wait sits at 4x the SLO tells clients to back off
+        # 4 SLO windows (capped here) instead of the flat minimum.
+        "retry_after_overshoot_cap": 8.0,
+        # graceful drain (services.lifecycle.DrainState): a draining
+        # endpoint stops admitting (503 + Retry-After), finishes every
+        # in-flight request, then reports "drained" on {path}/health —
+        # standalone serve processes drain on SIGTERM and exit 0, fleet
+        # replicas drain and get deregistered by the router.
+        # drain_timeout_ms caps how long in-flight work may take before
+        # the drain is forced through anyway.
+        "drain_timeout_ms": 30000,
+        # replica fleet tier (services.router.FleetRouter,
+        # docs/services.md "Fleet serving"): a front-end router owns N
+        # engine replicas, health-checks them every health_interval_ms
+        # off each replica's {path}/health surface, and routes with
+        # session affinity ("session": same session key sticks to one
+        # replica so its prefix cache keeps hitting; "none": round-
+        # robin).  A dead replica is retried onto a survivor up to
+        # retry_max times with exponential backoff (backoff_base_ms
+        # doubling per attempt, capped at backoff_max_ms, jittered);
+        # stream_read_timeout_ms bounds one upstream read before the
+        # router treats the replica as stalled and fails over; a
+        # BUFFERED request produces no bytes until its whole decode
+        # finishes, so it gets its own request_timeout_ms budget
+        # (default 5 min) instead of the per-chunk one.
+        "fleet": {
+            "health_interval_ms": 100,
+            "retry_max": 3,
+            "backoff_base_ms": 20,
+            "backoff_max_ms": 2000,
+            "affinity": "session",
+            "stream_read_timeout_ms": 30000,
+            "request_timeout_ms": 300000,
+        },
     },
 })
 

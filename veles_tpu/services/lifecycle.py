@@ -24,6 +24,11 @@ loaded server alive live here —
   into a breach, and admission reopens once the wait falls back under
   ``close_fraction`` of the SLO (hysteresis, so the valve does not
   chatter at the threshold).
+* :class:`DrainState` — the graceful-shutdown state machine every
+  serving surface consults: ``serving`` → ``draining`` (stop
+  admission, finish in-flight) → ``drained`` (safe to exit /
+  deregister).  SIGTERM on a serve process and the fleet router's
+  ``/drain`` admin both drive it.
 * the terminal exception types the REST layer maps to status codes:
   :class:`ShedError` → 503, :class:`DeadlineExceeded` → 504,
   :class:`RequestCancelled` → the stream's error line.
@@ -59,6 +64,14 @@ class RequestCancelled(RuntimeError):
     """The request was cancelled — explicit ``cancel(req_id)``, a
     client disconnect detected on a failed stream write, or a stalled
     stream consumer in ``block`` overflow mode."""
+
+
+class EngineUnavailable(RuntimeError):
+    """The serving engine/batcher is not accepting work (stopped, or
+    stopping).  The REST layer maps this to **503** — it is service
+    unavailability, not a client error: a fleet router must route
+    around it (and retry), exactly like a shed valve, never propagate
+    it as a deterministic 400."""
 
 
 class BoundedStream(object):
@@ -154,10 +167,17 @@ class SloShedder(object):
     :class:`ShedError`.  ``slo_ms <= 0`` disables the controller
     entirely (``enabled`` False, never sheds)."""
 
-    def __init__(self, slo_ms, close_fraction=0.5):
+    def __init__(self, slo_ms, close_fraction=0.5,
+                 overshoot_cap=None):
         self.slo_ms = float(slo_ms or 0)
         self.close_fraction = min(1.0, max(0.0, float(close_fraction)))
+        if overshoot_cap is None:
+            from veles_tpu.config import root
+            overshoot_cap = root.common.serve.get(
+                "retry_after_overshoot_cap", 8.0)
+        self.overshoot_cap = max(1.0, float(overshoot_cap))
         self._fresh_admit_ms = None       # consumed by the next update
+        self._last_measure_ms = 0.0       # latest control-loop input
         self._open = False
         self.shed_total = 0
         self.open_total = 0
@@ -194,6 +214,7 @@ class SloShedder(object):
             fresh = self._fresh_admit_ms
             self._fresh_admit_ms = None
         measure = max(float(head_wait_ms), fresh or 0.0)
+        self._last_measure_ms = measure
         if not self._open and measure > self.slo_ms:
             self._open = True
             self.open_total += 1
@@ -213,10 +234,20 @@ class SloShedder(object):
         return self.retry_after_s()
 
     def retry_after_s(self):
-        """Client backoff hint: one SLO window, at least a second —
-        by construction the breach needs at least that long to
-        drain below the close threshold."""
-        return max(1.0, self.slo_ms / 1000.0)
+        """Client backoff hint, scaled with the measured overshoot: at
+        least one SLO window and at least a second — by construction
+        the breach needs at least that long to drain below the close
+        threshold — times how far the last measured queue wait sits
+        past the SLO (a replica at 4x the SLO pushes clients, and the
+        fleet router, away for ~4 windows), capped at
+        ``overshoot_cap`` windows so a pathological spike cannot send
+        clients away for hours."""
+        base = max(1.0, self.slo_ms / 1000.0)
+        if self.slo_ms <= 0:
+            return base
+        overshoot = min(self.overshoot_cap,
+                        max(1.0, self._last_measure_ms / self.slo_ms))
+        return base * overshoot
 
     def status(self):
         return {"enabled": self.enabled,
@@ -225,3 +256,72 @@ class SloShedder(object):
                 "slo_ms": self.slo_ms,
                 "shed_total": self.shed_total,
                 "open_total": self.open_total}
+
+
+class DrainState(object):
+    """Graceful-shutdown state machine for one serving endpoint:
+    ``serving`` → ``draining`` → ``drained``, monotonic.
+
+    ``begin()`` flips admission off (the REST layer rejects new work
+    with 503 + Retry-After while not ``serving``); whoever watches the
+    in-flight population calls ``finish()`` once it hits zero, and
+    ``wait()`` lets a SIGTERM handler or the fleet router block until
+    the endpoint is safe to kill/deregister.  Thread-safe; both
+    transitions are idempotent (False on a no-op)."""
+
+    ORDER = ("serving", "draining", "drained")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._state = "serving"
+        self.reason = None
+        self.since = None                 # monotonic of begin()
+
+    @property
+    def state(self):
+        return self._state
+
+    def is_serving(self):
+        return self._state == "serving"
+
+    def begin(self, reason="drain"):
+        """serving → draining.  Returns True on the transition."""
+        with self._cond:
+            if self._state != "serving":
+                return False
+            self._state = "draining"
+            self.reason = str(reason)
+            self.since = time.monotonic()
+            self._cond.notify_all()
+        return True
+
+    def finish(self):
+        """draining → drained.  Returns True on the transition."""
+        with self._cond:
+            if self._state != "draining":
+                return False
+            self._state = "drained"
+            self._cond.notify_all()
+        return True
+
+    def wait(self, state="drained", timeout=None):
+        """Block until the machine reaches (or has passed) ``state``;
+        True iff reached within ``timeout``."""
+        want = self.ORDER.index(state)
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while self.ORDER.index(self._state) < want:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(left)
+        return True
+
+    def status(self):
+        out = {"state": self._state}
+        if self.since is not None:
+            out["reason"] = self.reason
+            out["draining_s"] = round(time.monotonic() - self.since, 3)
+        return out

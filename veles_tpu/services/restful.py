@@ -9,6 +9,7 @@ reference's Twisted resource — no reactor to manage."""
 import base64
 import json
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -17,9 +18,25 @@ import numpy as np
 
 from veles_tpu.logger import Logger
 from veles_tpu.services.lifecycle import (BoundedStream, DeadlineExceeded,
+                                          DrainState, EngineUnavailable,
                                           RequestCancelled, ShedError,
                                           SloShedder)
 from veles_tpu.telemetry import flight
+
+
+def send_json(handler, code, payload, headers=()):
+    """Shared JSON-response helper for the stdlib serving handlers
+    (this endpoint's and the fleet router's) — ONE place for the
+    Content-Type / Content-Length / extra-headers dance so the two
+    surfaces cannot drift."""
+    msg = json.dumps(payload, default=str).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(msg)))
+    for k, v in headers:
+        handler.send_header(k, v)
+    handler.end_headers()
+    handler.wfile.write(msg)
 
 
 class GenerateBatcher(Logger):
@@ -50,7 +67,7 @@ class GenerateBatcher(Logger):
         slot = {"event": threading.Event()}
         with self._lock:
             if self._closed:
-                raise RuntimeError("batcher is stopped")
+                raise EngineUnavailable("batcher is stopped")
             self._pending.append((list(prompt_row), dict(opts), slot))
             self._lock.notify()
         return slot
@@ -66,6 +83,12 @@ class GenerateBatcher(Logger):
         """Blocks until the coalesced batch ran; returns the 1-D
         output."""
         return self.wait(self.submit_async(prompt_row, opts))
+
+    def pending(self):
+        """Requests waiting for a coalesced batch (the drain watcher's
+        in-flight signal for this path)."""
+        with self._lock:
+            return len(self._pending)
 
     def stop(self):
         with self._lock:
@@ -218,7 +241,8 @@ class ContinuousEngine(Logger):
         self._thread.start()
 
     def submit_async(self, prompt_row, max_new, temperature=0.0,
-                     seed=0, adapter=0, stream=False, deadline_ms=None):
+                     seed=0, adapter=0, stream=False, deadline_ms=None,
+                     shed_exempt=False):
         """Enqueue one row; returns a handle for ``wait`` (submit every
         row of a request BEFORE waiting so they share the pool).
         Validates here so a bad request raises in the CALLER (one 400),
@@ -232,8 +256,12 @@ class ContinuousEngine(Logger):
         0 there too = no deadline).  An expired request is cancelled —
         before admission if possible, mid-decode otherwise — and its
         waiter raises DeadlineExceeded.  Raises ShedError (the REST
-        layer's 503 + Retry-After) while the SLO shedder is open."""
-        if self._shed.should_shed():
+        layer's 503 + Retry-After) while the SLO shedder is open —
+        unless ``shed_exempt``: a fleet router's failover resume is
+        already-admitted work being RELOCATED off a dead replica, and
+        shedding it would turn one replica's death into lost requests
+        (plus waste every token the fleet already decoded for them)."""
+        if not shed_exempt and self._shed.should_shed():
             ra = self._shed.shed()
             flight.record("serve.shed", prompt_len=len(prompt_row),
                           max_new=int(max_new),
@@ -314,7 +342,7 @@ class ContinuousEngine(Logger):
                "_sent": 0}
         with self._lock:
             if self._closed:
-                raise RuntimeError("engine is stopped")
+                raise EngineUnavailable("engine is stopped")
             rec["id"] = self._next_req_id
             self._next_req_id += 1
             self._by_id[rec["id"]] = rec
@@ -360,7 +388,8 @@ class ContinuousEngine(Logger):
         return True
 
     def stream_open(self, prompt_row, max_new, temperature=0.0,
-                    seed=0, adapter=0, deadline_ms=None):
+                    seed=0, adapter=0, deadline_ms=None,
+                    shed_exempt=False):
         """Streaming submit: returns ``(handle, iterator)`` where the
         iterator yields lists of NEW tokens per engine dispatch.  The
         submit (and thus shed/validation errors) happens EAGERLY in
@@ -372,7 +401,8 @@ class ContinuousEngine(Logger):
         rec = self.submit_async(prompt_row, max_new,
                                 temperature=temperature, seed=seed,
                                 adapter=adapter, stream=True,
-                                deadline_ms=deadline_ms)
+                                deadline_ms=deadline_ms,
+                                shed_exempt=shed_exempt)
 
         def drain():
             # chunks carry their start offset, and only CONTIGUOUS
@@ -859,37 +889,80 @@ class RESTfulAPI(Logger):
                        else None)
         self._server = None
         self._thread = None
+        #: graceful-shutdown state machine (services.lifecycle): while
+        #: not "serving", the work endpoint rejects new requests with
+        #: 503 + Retry-After, in-flight ones finish, and {path}/health
+        #: reports the drain state so a fleet router stops routing here
+        self.drain_state = DrainState()
+        self._drain_thread = None
+        #: in-flight work-endpoint POSTs (admission through response
+        #: written) — the drain watcher's "finished in-flight" signal;
+        #: engine/batcher queue depths alone miss the tail between a
+        #: request leaving the pool and its response hitting the socket
+        self._http_inflight = 0
+        self._http_lock = threading.Lock()
 
     # ------------------------------------------------------------- server
     def start(self):
         api = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path != api.path + "/metrics":
-                    self.send_error(404)
-                    return
-                body = json.dumps(api.serving_metrics()).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
             def _send_json(self, code, payload, headers=()):
-                msg = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(msg)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(msg)
+                send_json(self, code, payload, headers)
+
+            def do_GET(self):
+                if self.path == api.path + "/metrics":
+                    self._send_json(200, api.serving_metrics())
+                elif self.path == api.path + "/health":
+                    # the fleet router's probe surface: drain state +
+                    # the PR 6 lifecycle block.  503 while not serving
+                    # so dumb LBs also stop sending traffic here.
+                    state = api.health_status()
+                    self._send_json(
+                        200 if state["state"] == "serving" else 503,
+                        state)
+                elif self.path == api.path + "/leaks":
+                    # post-drain resource audit (chaos harness; call
+                    # once idle — see ContinuousEngine.leak_check)
+                    self._send_json(200, api.engine.leak_check()
+                                    if api.engine is not None else {})
+                else:
+                    self.send_error(404)
 
             def do_POST(self):
+                if self.path == api.path + "/drain":
+                    # admin drain: stop admission, finish in-flight,
+                    # report "drained" on /health.  202: the drain is
+                    # accepted and proceeds in the background.
+                    api.drain(reason="admin /drain")
+                    self._send_json(202, api.drain_state.status())
+                    return
                 if self.path != api.path:
                     self.send_error(404)
                     return
+                # count FIRST, then check the drain gate: the drain
+                # watcher polls the counter, so a request that passed
+                # the gate is always visible to it (no slip-through
+                # between check and increment)
+                with api._http_lock:
+                    api._http_inflight += 1
+                try:
+                    if not api.drain_state.is_serving():
+                        ra = api.drain_retry_after_s()
+                        self._send_json(
+                            503,
+                            {"error": "draining: this endpoint is "
+                                      "not admitting new work",
+                             "draining": True, "retry_after_s": ra},
+                            headers=[("Retry-After",
+                                      str(max(1, int(math.ceil(ra)))))])
+                        return
+                    self._do_work_post()
+                finally:
+                    with api._http_lock:
+                        api._http_inflight -= 1
+
+            def _do_work_post(self):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
@@ -941,8 +1014,15 @@ class RESTfulAPI(Logger):
                                 handle["id"],
                                 reason="stream write failed: %r" % e)
                             try:
+                                # "kind" lets a fleet router tell a
+                                # REQUEST-scoped terminal (deadline,
+                                # cancel — relay to the client, the
+                                # replica is healthy) from an ENGINE-
+                                # scoped one (fail over)
                                 self.wfile.write(
-                                    (json.dumps({"error": str(e)})
+                                    (json.dumps(
+                                        {"error": str(e),
+                                         "kind": type(e).__name__})
                                      + "\n").encode())
                             except Exception:  # noqa: BLE001 — dead pipe
                                 pass
@@ -960,6 +1040,14 @@ class RESTfulAPI(Logger):
                               "retry_after_s": e.retry_after_s},
                         headers=[("Retry-After", str(max(
                             1, int(math.ceil(e.retry_after_s)))))])
+                except EngineUnavailable as e:
+                    # a stopped engine is service unavailability, not
+                    # a bad request: 503 so a fleet router routes
+                    # around this replica instead of failing the
+                    # client with a "deterministic" 400
+                    self._send_json(
+                        503, {"error": str(e), "retry_after_s": 1.0},
+                        headers=[("Retry-After", "1")])
                 except DeadlineExceeded as e:
                     self._send_json(504, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report to client
@@ -989,6 +1077,99 @@ class RESTfulAPI(Logger):
             self.batcher.stop()
         if self.engine is not None:
             self.engine.stop()
+
+    # -------------------------------------------------------------- drain
+    def drain(self, reason="drain"):
+        """Graceful shutdown, phase 1: stop admitting (the work
+        endpoint 503s with Retry-After), let every in-flight request
+        finish, then flip ``drain_state`` to ``drained`` (watched by
+        :meth:`wait_drained`, ``{path}/health``, and the fleet
+        router).  Idempotent; returns True on the serving→draining
+        transition.  The endpoint itself stays up — a drained replica
+        still answers health probes until its owner calls
+        :meth:`stop` / exits."""
+        if not self.drain_state.begin(reason):
+            return False
+        flight.record("serve.drain", pid=os.getpid(),
+                      reason=str(reason))
+        self._drain_thread = threading.Thread(
+            target=self._drain_watch, name="VelesDrain", daemon=True)
+        self._drain_thread.start()
+        return True
+
+    def wait_drained(self, timeout=None):
+        """Block until every in-flight request finished (True) or
+        ``timeout`` passed (False)."""
+        return self.drain_state.wait("drained", timeout=timeout)
+
+    def drain_retry_after_s(self):
+        """Retry-After hint for requests refused while draining: one
+        shedder window when an SLO is configured (same backoff the
+        overload path hands out), else one second."""
+        if self.engine is not None:
+            return self.engine._shed.retry_after_s()
+        return 1.0
+
+    def _idle(self):
+        """True iff no request is anywhere in the serving pipeline:
+        engine queue/pool empty, coalescer empty, and every work POST
+        has written its response."""
+        with self._http_lock:
+            if self._http_inflight:
+                return False
+        if self.engine is not None:
+            m = self.engine.metrics()
+            if m["queued"] or m["in_flight"]:
+                return False
+        if self.batcher is not None and self.batcher.pending():
+            return False
+        return True
+
+    def _drain_watch(self):
+        from veles_tpu.config import root
+        timeout_s = float(root.common.serve.get(
+            "drain_timeout_ms", 30000)) / 1e3
+        deadline = time.monotonic() + timeout_s
+        forced = False
+        while not self._idle():
+            if time.monotonic() >= deadline:
+                forced = True
+                break
+            time.sleep(0.02)
+        self.drain_state.finish()
+        flight.record("serve.drained", pid=os.getpid(),
+                      forced=forced)
+        if forced:
+            self.warning("drain forced through after %.1f s with "
+                         "requests still in flight "
+                         "(root.common.serve.drain_timeout_ms)",
+                         timeout_s)
+
+    def health_status(self):
+        """``{path}/health`` payload: drain state + the PR 6 lifecycle
+        block + queue-depth vitals — everything the fleet router's
+        probe needs in one cheap GET.  A dead ENGINE thread (stopped,
+        or killed by something the fault-recovery path could not
+        survive) reports ``"failed"`` even though HTTP still answers —
+        a router must not route work into a serving shell whose pool
+        no longer ticks."""
+        state = self.drain_state.state
+        if self.engine is not None and state == "serving" \
+                and not self.engine._thread.is_alive():
+            state = "failed"
+        out = {"state": state, "pid": os.getpid(),
+               "port": self.port}
+        if self.drain_state.since is not None:
+            out["drain"] = self.drain_state.status()
+        if self.engine is not None:
+            try:
+                out["serving"] = self.engine.lifecycle_status()
+                m = self.engine.metrics()
+                for key in ("queued", "in_flight", "served", "slots"):
+                    out[key] = m[key]
+            except Exception as e:  # noqa: BLE001 — probe never 500s
+                out["serving"] = {"error": str(e)}
+        return out
 
     def serving_metrics(self):
         """GET ``{path}/metrics``: the serving plane's SLO surface —
@@ -1062,7 +1243,11 @@ class RESTfulAPI(Logger):
             temperature=float(opts.get("temperature", 0.0)),
             seed=int(opts.get("seed", 0)),
             adapter=int(opts.get("adapter", 0)),
-            deadline_ms=opts.get("deadline_ms"))
+            deadline_ms=opts.get("deadline_ms"),
+            # {"resume": true}: a fleet router relocating an already-
+            # admitted stream off a dead replica — exempt from the
+            # shed valve (see submit_async), never from validation
+            shed_exempt=bool(req.get("resume")))
         return prompt[0].tolist(), it, handle
 
     def run_generate(self, req):
@@ -1171,3 +1356,47 @@ class RESTfulAPI(Logger):
             raise ValueError("input shape %s incompatible with %s"
                              % (expect, self.input_shape))
         return x.reshape((len(x),) + self.input_shape)
+
+
+def install_sigterm_drain(api, exit_code=0, grace_s=None,
+                          on_drained=None):
+    """SIGTERM → graceful drain for a standalone serve process: stop
+    admission, finish in-flight, exit ``exit_code`` — the same
+    lifecycle a fleet replica walks, instead of the bare PR 5
+    crashdump-and-die.  ``grace_s`` caps the wait (default: the
+    ``drain_timeout_ms`` knob plus slack).  Must run on the main
+    thread (signal API); returns the previous handler.
+
+    The handler itself only *starts* the drain (signal context must
+    stay tiny); a waiter thread watches for drained, runs
+    ``on_drained`` (e.g. a flight dump — atexit hooks do NOT survive
+    the ``os._exit``), stops the endpoint, and ``os._exit``\\ s so the
+    exit status is 0 no matter what non-daemon machinery the
+    embedding process runs."""
+    import signal
+
+    from veles_tpu.config import root
+    if grace_s is None:
+        grace_s = float(root.common.serve.get(
+            "drain_timeout_ms", 30000)) / 1e3 + 5.0
+
+    def _waiter():
+        api.wait_drained(timeout=grace_s)
+        if on_drained is not None:
+            try:
+                on_drained()
+            except Exception:   # noqa: BLE001 — exiting anyway
+                pass
+        try:
+            api.stop()
+        except Exception:   # noqa: BLE001 — exiting anyway
+            pass
+        os._exit(exit_code)
+
+    def on_sigterm(signum, frame):
+        flight.record("serve.sigterm_drain", pid=os.getpid())
+        api.drain(reason="SIGTERM")
+        threading.Thread(target=_waiter, name="VelesSigtermDrain",
+                         daemon=True).start()
+
+    return signal.signal(signal.SIGTERM, on_sigterm)

@@ -1,0 +1,922 @@
+"""Replica fleet tier — health-routed multi-engine serving (ref: the
+Veles master–slave triad, veles/server.py + veles/client.py: slaves die
+and respawn without losing the job; here the *serving* half, rebuilt
+for TPU engine replicas over plain HTTP).
+
+Topology::
+
+    clients ──HTTP──▶ FleetRouter ──HTTP──▶ replica RESTfulAPI #0
+                         │   ▲                 (ContinuousEngine)
+                         │   └── health probe  replica RESTfulAPI #1
+                         └────────────────────▶        ...
+
+The router owns a registry of N engine replicas — spawned in-process
+(:meth:`FleetRouter.spawn_local`, tests and single-host fleets) or
+registered by URL (:meth:`FleetRouter.register` / POST ``/register``,
+separate processes or hosts).  A health thread probes each replica's
+``{path}/health`` surface (PR 6 ``lifecycle_status()`` + the drain
+state) every ``root.common.serve.fleet.health_interval_ms``; the
+request path additionally marks a replica down the moment a connect or
+read fails, so failover usually beats the probe.
+
+Routing contract (docs/services.md "Fleet serving"):
+
+* **session affinity** — a request carrying ``{"session": key}`` pins
+  to one replica (``fleet.affinity='session'``) so that replica's
+  prefix cache keeps hitting; the pin moves (and a
+  ``serve.failover`` flight event records it) only when the replica
+  leaves the pool.
+* **retry with backoff + jitter** — a dead replica's requests retry
+  onto a survivor up to ``fleet.retry_max`` times, sleeping
+  ``backoff_base_ms * 2^attempt`` (capped at ``backoff_max_ms``,
+  jittered to [0.5, 1.0)x) between attempts.
+* **shed routing** — a replica's 503 (SLO shed valve open, or
+  draining) makes the router try the next replica immediately; only
+  when every live replica sheds does the client see a 503, carrying
+  the largest Retry-After any replica offered.
+* **mid-stream failover** — a replica dying mid-NDJSON-stream is
+  invisible to the client: the router resubmits the prompt plus the
+  already-delivered tokens as a prefix-resume continuation on a
+  survivor and splices the streams at the recorded offset, so the
+  client sees ONE uninterrupted stream whose concatenation is exactly
+  the uninterrupted result (greedy decode is deterministic across
+  replicas of the same model).
+* **graceful drain** — ``/drain`` (or SIGTERM on the replica, see
+  ``restful.install_sigterm_drain``) flips a replica to draining: the
+  router stops routing to it, its in-flight requests finish, and the
+  health loop deregisters it once drained.
+
+Fleet churn is observable: ``serve.replica_up`` / ``serve.replica_down``
+/ ``serve.failover`` / ``serve.drain`` flight events land in the same
+``veles-tpu-blackbox`` timeline as everything else."""
+
+import http.client
+import json
+import math
+import random
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
+
+from veles_tpu.logger import Logger
+from veles_tpu.telemetry import flight
+
+
+class NoReplicaError(RuntimeError):
+    """No live replica could take the request (all down, draining, or
+    shedding past the retry budget)."""
+
+    def __init__(self, message, retry_after_s=1.0):
+        super(NoReplicaError, self).__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class Replica(object):
+    """One registry entry.  State machine: ``up`` ⇄ ``down``,
+    ``up → draining → (deregistered)``; transitions happen on the
+    health thread or (down only) the request path."""
+
+    UP, DRAINING, DOWN = "up", "draining", "down"
+
+    __slots__ = ("rid", "url", "host", "port", "path", "state",
+                 "fails", "last_health", "api")
+
+    def __init__(self, rid, url, api=None):
+        parts = urlsplit(url)
+        self.rid = rid
+        self.url = url
+        self.host = parts.hostname
+        self.port = parts.port
+        self.path = parts.path.rstrip("/") or "/service"
+        self.state = Replica.UP
+        self.fails = 0            # consecutive health-probe failures
+        self.last_health = None
+        self.api = api            # in-process RESTfulAPI (spawn_local)
+
+    def describe(self):
+        return {"url": self.url, "state": self.state,
+                "fails": self.fails,
+                "health": self.last_health}
+
+
+class FleetRouter(Logger):
+    """Front-end HTTP tier over N engine replicas: health-checked
+    registry, session-affine routing, retry/backoff failover,
+    shed propagation, mid-stream prefix-resume splicing, and drain
+    orchestration.  Endpoints (all under ``path``, default
+    ``/fleet``)::
+
+        POST {path}             route one serving request (buffered or
+                                NDJSON streaming, same body contract
+                                as the replica RESTfulAPI — plus an
+                                optional top-level "session" key)
+        GET  {path}/metrics     router counters + per-replica states
+        GET  {path}/health      fleet health (503 iff no live replica)
+        POST {path}/register    {"url": "http://host:port/service"}
+        POST {path}/deregister  {"replica": rid} | {"url": ...}
+        POST {path}/drain       {"replica": rid} — graceful drain
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, path="/fleet",
+                 health_interval_ms=None, retry_max=None,
+                 backoff_base_ms=None, backoff_max_ms=None,
+                 affinity=None, stream_read_timeout_ms=None,
+                 rng_seed=None):
+        super(FleetRouter, self).__init__()
+        from veles_tpu.config import root
+        cfg = root.common.serve.fleet
+
+        def knob(arg, name, default):
+            return arg if arg is not None else cfg.get(name, default)
+
+        self.host, self.port, self.path = host, port, path
+        self.health_interval_s = float(
+            knob(health_interval_ms, "health_interval_ms", 100)) / 1e3
+        self.retry_max = int(knob(retry_max, "retry_max", 3))
+        self.backoff_base_s = float(
+            knob(backoff_base_ms, "backoff_base_ms", 20)) / 1e3
+        self.backoff_max_s = float(
+            knob(backoff_max_ms, "backoff_max_ms", 2000)) / 1e3
+        self.affinity = str(knob(affinity, "affinity", "session"))
+        self.read_timeout_s = float(
+            knob(stream_read_timeout_ms, "stream_read_timeout_ms",
+                 30000)) / 1e3
+        #: buffered requests yield no bytes until the decode is done —
+        #: their whole-request budget must scale with a real decode,
+        #: not with the per-chunk stream timeout
+        self.request_timeout_s = float(
+            cfg.get("request_timeout_ms", 300000)) / 1e3
+        self._lock = threading.Lock()
+        self._replicas = {}              # rid -> Replica
+        self._next_rid = 0
+        self._sessions = {}              # session key -> rid
+        self._rr = 0                     # round-robin cursor
+        self._rng = random.Random(rng_seed)
+        self._counters = {
+            "routed": 0,            # requests that got a 2xx/4xx answer
+            "retries": 0,           # extra attempts after a failure
+            "failovers": 0,         # requests rerouted off a dead replica
+            "resumed_streams": 0,   # mid-stream prefix-resume splices
+            "shed_rejects": 0,      # 503s the router itself returned
+            "session_moves": 0,     # affinity pins that had to move
+        }
+        self._local_apis = []            # spawn_local ownership
+        self._closed = False
+        self._server = None
+        self._thread = None
+        self._health_wake = threading.Event()
+        self._health_thread = None
+
+    # ----------------------------------------------------------- registry
+    def register(self, url, api=None):
+        """Add a replica by URL (its RESTfulAPI work path, e.g.
+        ``http://127.0.0.1:8180/service``).  Optimistically up — the
+        first health probe (≤ one interval away) corrects it.
+        Returns the replica id."""
+        rep = None
+        fresh = False
+        with self._lock:
+            for existing in self._replicas.values():
+                if existing.url == url:
+                    rep = existing
+                    break
+            if rep is None:
+                fresh = True
+                rep = Replica(self._next_rid, url, api=api)
+                self._next_rid += 1
+                self._replicas[rep.rid] = rep
+        if fresh:
+            flight.record("serve.replica_up", replica=rep.rid,
+                          url=url, registered=True)
+            self.info("replica %d registered: %s", rep.rid, url)
+        else:
+            # re-registration (e.g. a restarted replica announcing
+            # itself): bring a down entry back into rotation — with
+            # its own replica_up event — instead of logging a
+            # spurious one while the state stays down
+            self._mark_up(rep)
+        self._health_wake.set()
+        return rep.rid
+
+    def deregister(self, rid=None, url=None, reason="deregister"):
+        """Drop a replica from the registry (its pinned sessions re-pin
+        on their next request).  True iff something was removed."""
+        with self._lock:
+            if rid is None and url is not None:
+                for r in self._replicas.values():
+                    if r.url == url:
+                        rid = r.rid
+                        break
+            rep = self._replicas.pop(rid, None)
+            if rep is not None:
+                for key in [k for k, v in self._sessions.items()
+                            if v == rid]:
+                    del self._sessions[key]
+        if rep is None:
+            return False
+        flight.record("serve.replica_down", replica=rep.rid,
+                      url=rep.url, reason=reason)
+        self.info("replica %d deregistered (%s)", rep.rid, reason)
+        return True
+
+    def spawn_local(self, generator, n, input_shape=None, **engine_kw):
+        """Spawn ``n`` in-process replicas around one (read-only)
+        generator — each gets its own RESTfulAPI + ContinuousEngine on
+        a loopback port, registered here and owned by :meth:`stop`.
+        The single-host fleet: engine state is per-replica, weights
+        are shared.  Returns the replica ids."""
+        from veles_tpu.services.restful import RESTfulAPI
+        if input_shape is None:
+            input_shape = (generator.max_len,)
+        rids = []
+        for _ in range(n):
+            api = RESTfulAPI(lambda x: x, input_shape, port=0,
+                             generator=generator, **engine_kw)
+            api.start()
+            self._local_apis.append(api)
+            rids.append(self.register(
+                "http://127.0.0.1:%d%s" % (api.port, api.path),
+                api=api))
+        return rids
+
+    def replicas(self):
+        """Snapshot of the registry for metrics/health surfaces."""
+        with self._lock:
+            return {rid: rep.describe()
+                    for rid, rep in sorted(self._replicas.items())}
+
+    # ------------------------------------------------------------- health
+    def _probe(self, rep):
+        """One GET {path}/health against a replica.  Returns the
+        payload dict or raises."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port,
+            timeout=max(self.health_interval_s * 2, 1.0))
+        try:
+            conn.request("GET", rep.path + "/health")
+            resp = conn.getresponse()
+            return json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def _health_loop(self):
+        while not self._closed:
+            self._health_wake.wait(self.health_interval_s)
+            self._health_wake.clear()
+            if self._closed:
+                return
+            with self._lock:
+                reps = list(self._replicas.values())
+            # probe CONCURRENTLY: each probe is bounded by its socket
+            # timeout, so one black-holed replica delays this round by
+            # its own timeout at most — never head-of-line-blocking
+            # detection of the replicas behind it
+            threads = [threading.Thread(target=self._probe_one,
+                                        args=(rep,), daemon=True)
+                       for rep in reps]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    def _probe_one(self, rep):
+        try:
+            payload = self._probe(rep)
+        except Exception as e:  # noqa: BLE001 — probe failure
+            rep.fails += 1
+            # a DRAINING replica going unreachable has finished
+            # (or died) — either way it leaves the pool
+            if rep.state == Replica.DRAINING:
+                self.deregister(rep.rid,
+                                reason="drained (unreachable)")
+            else:
+                self._mark_down(rep, "health probe failed: %r"
+                                % (e,))
+            return
+        rep.fails = 0
+        rep.last_health = payload
+        state = payload.get("state", "serving")
+        if state == "serving":
+            self._mark_up(rep)
+        elif state == "draining":
+            self._mark_draining(rep, "replica reported draining")
+        elif state == "drained":
+            # a fast drain can skip the "draining" probe window
+            # entirely — still record the drain before the exit
+            self._mark_draining(rep, "replica reported drained")
+            self.deregister(rep.rid, reason="drained")
+        else:
+            # "failed" (dead engine behind a live HTTP shell) or
+            # anything unrecognized: not routable
+            self._mark_down(rep, "replica reported state %r"
+                            % (state,))
+
+    def _mark_down(self, rep, reason):
+        with self._lock:
+            if rep.rid not in self._replicas \
+                    or rep.state == Replica.DOWN:
+                return
+            rep.state = Replica.DOWN
+        flight.record("serve.replica_down", replica=rep.rid,
+                      url=rep.url, reason=str(reason)[:200])
+        self.warning("replica %d DOWN: %s", rep.rid, reason)
+
+    def _mark_up(self, rep):
+        with self._lock:
+            if rep.rid not in self._replicas \
+                    or rep.state == Replica.UP:
+                return
+            prev, rep.state = rep.state, Replica.UP
+        flight.record("serve.replica_up", replica=rep.rid,
+                      url=rep.url, was=prev)
+        self.info("replica %d UP (was %s)", rep.rid, prev)
+
+    def _mark_draining(self, rep, reason):
+        with self._lock:
+            if rep.rid not in self._replicas \
+                    or rep.state == Replica.DRAINING:
+                return
+            rep.state = Replica.DRAINING
+        flight.record("serve.drain", replica=rep.rid, url=rep.url,
+                      reason=str(reason))
+        self.info("replica %d draining: %s", rep.rid, reason)
+
+    def drain_replica(self, rid):
+        """Admin drain: tell the replica to stop admitting and finish
+        in-flight (POST its ``/drain``), mark it draining here so no
+        new request routes to it; the health loop deregisters it once
+        it reports drained.  True iff the replica was known."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            return False
+        self._mark_draining(rep, "admin drain")
+        try:
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=5.0)
+            try:
+                conn.request("POST", rep.path + "/drain", b"{}",
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            finally:
+                conn.close()
+        except Exception as e:  # noqa: BLE001 — it may already be dead
+            self._mark_down(rep, "drain POST failed: %r" % (e,))
+        return True
+
+    # ------------------------------------------------------------ routing
+    def backoff_delay(self, attempt):
+        """Failover backoff before retry ``attempt`` (0-based):
+        ``backoff_base * 2^attempt`` capped at ``backoff_max``, then
+        jittered to [0.5, 1.0)x so a burst of failovers does not
+        stampede the survivor in lockstep."""
+        d = min(self.backoff_max_s,
+                self.backoff_base_s * (2 ** attempt))
+        return d * (0.5 + 0.5 * self._rng.random())
+
+    def _pick(self, session=None, exclude=()):
+        """Choose a live replica: the session's pinned one when
+        affinity is on and it is still up, else a deterministic
+        hash-pick (new pin) or round-robin.  Returns None when no
+        up replica remains outside ``exclude``."""
+        with self._lock:
+            ups = [r for r in self._replicas.values()
+                   if r.state == Replica.UP and r.rid not in exclude]
+            if not ups:
+                return None
+            ups.sort(key=lambda r: r.rid)
+            if session is not None and self.affinity == "session":
+                pinned = self._sessions.get(session)
+                for r in ups:
+                    if r.rid == pinned:
+                        return r
+                pick = ups[zlib.crc32(str(session).encode())
+                           % len(ups)]
+                pin_rep = self._replicas.get(pinned) \
+                    if pinned is not None else None
+                if pin_rep is not None \
+                        and pin_rep.state == Replica.UP:
+                    # the pinned replica is alive but excluded for
+                    # THIS request only (shed 503 / already tried):
+                    # route around WITHOUT moving the pin — a
+                    # transient valve blip must not cost the session
+                    # its prefix cache
+                    return pick
+                # pin (first sight) or re-pin (pinned replica left
+                # the pool): stable hash so a cold router maps the
+                # same sessions to the same replicas
+                if pinned is not None and pinned != pick.rid:
+                    self._counters["session_moves"] += 1
+                self._sessions[session] = pick.rid
+                return pick
+            r = ups[self._rr % len(ups)]
+            self._rr += 1
+            return r
+
+    @staticmethod
+    def _retry_after_of(headers, body):
+        try:
+            ra = headers.get("Retry-After")
+            if ra is not None:
+                return float(ra)
+            return float(json.loads(body).get("retry_after_s", 1.0))
+        except (TypeError, ValueError):
+            return 1.0
+
+    def _forward_buffered(self, rep, body):
+        conn = http.client.HTTPConnection(rep.host, rep.port,
+                                          timeout=self.request_timeout_s)
+        try:
+            conn.request("POST", rep.path, body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        finally:
+            conn.close()
+
+    def route_buffered(self, body, session=None):
+        """Route one non-streaming request; returns (status, payload
+        bytes, extra headers).  Raises :class:`NoReplicaError` when
+        the retry budget is exhausted (the HTTP layer maps it to 503 +
+        Retry-After)."""
+        tried = set()
+        shed_ra = None
+        last_err = None
+        attempt = 0
+        while attempt <= self.retry_max:
+            rep = self._pick(session=session, exclude=tried)
+            if rep is None:
+                break
+            try:
+                status, headers, payload = self._forward_buffered(
+                    rep, body)
+            except (OSError, http.client.HTTPException) as e:
+                last_err = e
+                tried.add(rep.rid)
+                self._mark_down(rep, "request failed: %r" % (e,))
+                self._note_failover(rep, session, attempt,
+                                    stream=False)
+                with self._lock:
+                    self._counters["retries"] += 1
+                attempt += 1
+                time.sleep(self.backoff_delay(attempt - 1))
+                continue
+            if status == 503:
+                # shed valve open or draining: route around it —
+                # immediately, the next replica may be idle.  NOT an
+                # attempt: the retry budget is for failures, so a
+                # wide fleet with several shedding members still gets
+                # every live replica tried once
+                shed_ra = max(shed_ra or 0.0,
+                              self._retry_after_of(headers, payload))
+                tried.add(rep.rid)
+                continue
+            with self._lock:
+                self._counters["routed"] += 1
+            return status, payload, ()
+        with self._lock:
+            self._counters["shed_rejects"] += 1
+        ra = shed_ra if shed_ra is not None else 1.0
+        raise NoReplicaError(
+            "no replica could take the request (tried %d, last error "
+            "%r)%s" % (len(tried), last_err,
+                       "; every live replica is shedding"
+                       if shed_ra is not None else ""),
+            retry_after_s=ra)
+
+    def _note_failover(self, rep, session, attempt, stream,
+                       delivered=0):
+        with self._lock:
+            self._counters["failovers"] += 1
+        flight.record("serve.failover", replica=rep.rid,
+                      session=session, attempt=attempt,
+                      stream=bool(stream), delivered=int(delivered))
+
+    # ---------------------------------------------------------- streaming
+    @staticmethod
+    def _resume_body(parsed, delivered):
+        """The prefix-resume continuation request: prompt grows by the
+        already-delivered tokens, max_new shrinks by them — the
+        survivor decodes exactly the missing suffix (deterministic for
+        greedy decode, and for sampled rows too: the per-row key
+        stream is (seed, absolute position), which the longer prompt
+        preserves)."""
+        opts = dict(parsed["generate"])
+        row = parsed["input"]
+        if row and isinstance(row[0], list):
+            row = row[0]
+        opts["max_new"] = int(opts.get("max_new", 16)) - len(delivered)
+        body = dict(parsed)
+        body["input"] = list(row) + list(delivered)
+        body["generate"] = opts
+        # already-admitted work being relocated: the survivor must not
+        # shed it (the client's 200 is committed — a 503 here would
+        # turn the failover into a lost request)
+        body["resume"] = True
+        return json.dumps(body).encode()
+
+    def route_stream(self, parsed, body, session, send_headers,
+                     write_line):
+        """Route one NDJSON streaming request, splicing across replica
+        deaths.  ``send_headers()`` commits the client's 200 exactly
+        once; ``write_line(bytes)`` forwards one NDJSON line (raising
+        on a dead client aborts upstream too).  Raises
+        :class:`NoReplicaError` only BEFORE headers are committed;
+        after that, terminal failures surface as an ``{"error": ...}``
+        NDJSON line (the streaming contract — the status code is
+        gone)."""
+        max_new = int(parsed["generate"].get("max_new", 16))
+        delivered = []            # new tokens already sent to client
+        committed = False
+        # two exclusion tiers: a DEAD replica stays excluded for the
+        # request's lifetime, but a SHED 503 is transient — after a
+        # failover the resume is shed-exempt (already-admitted work),
+        # so previously-shedding replicas become eligible again
+        tried_dead = set()
+        tried_shed = set()
+        trace = []                # (rid, outcome) per attempt
+        shed_ra = None
+        attempt = 0
+        while attempt <= self.retry_max:
+            rep = self._pick(session=session,
+                             exclude=tried_dead | tried_shed)
+            if rep is None:
+                break
+            if delivered:
+                send_body = self._resume_body(parsed, delivered)
+            elif committed:
+                # headers are committed but no tokens flowed yet: a
+                # from-scratch retry that must still bypass the shed
+                # valve (the client can no longer be told 503)
+                resend = dict(parsed)
+                resend["resume"] = True
+                send_body = json.dumps(resend).encode()
+            else:
+                send_body = body
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.read_timeout_s)
+            try:
+                conn.request("POST", rep.path, send_body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status == 503:
+                    shed_ra = max(
+                        shed_ra or 0.0,
+                        self._retry_after_of(dict(resp.getheaders()),
+                                             resp.read()))
+                    tried_shed.add(rep.rid)
+                    trace.append((rep.rid, "503"))
+                    continue
+                if resp.status != 200:
+                    # validation error — deterministic, no point
+                    # retrying elsewhere
+                    payload = resp.read()
+                    if committed:
+                        write_line(json.dumps(
+                            {"error": "replica rejected resume: %s"
+                                      % payload.decode("utf-8",
+                                                       "replace")}
+                        ).encode() + b"\n")
+                        return
+                    raise _ReplicaReject(resp.status, payload)
+                if not committed:
+                    # the headers commit is a CLIENT-side write: a
+                    # client that died before its 200 must abort the
+                    # request (_ClientGone), never be misattributed
+                    # as a replica failure and cascade mark-downs
+                    # across the healthy fleet
+                    try:
+                        send_headers()
+                    except Exception as e:  # noqa: BLE001
+                        raise _ClientGone() from e
+                    committed = True
+                if self._pump_stream(resp, parsed, delivered,
+                                     write_line, bool(tried_dead)):
+                    with self._lock:
+                        self._counters["routed"] += 1
+                    return
+                # upstream died mid-stream (EOF / error line / reset):
+                # fall through to failover below
+                raise ConnectionError("replica stream ended before "
+                                      "the done line")
+            except _ClientGone:
+                # the CLIENT vanished: closing the upstream connection
+                # (finally below) fails the replica's next write, which
+                # cancels the request engine-side — nothing to retry
+                return
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                tried_dead.add(rep.rid)
+                # new failover round: shed exclusions reset — the
+                # shed-exempt resume may now land on a replica whose
+                # valve refused the ORIGINAL (pre-commit) submission
+                tried_shed.clear()
+                trace.append((rep.rid, repr(e)[:120]))
+                self._mark_down(rep, "stream failed: %r" % (e,))
+                self._note_failover(rep, session, attempt, stream=True,
+                                    delivered=len(delivered))
+                if delivered:
+                    with self._lock:
+                        self._counters["resumed_streams"] += 1
+                if len(delivered) >= max_new:
+                    # everything decoded and delivered — only the done
+                    # line was lost; synthesize it instead of burning a
+                    # replica on a zero-token resume
+                    row = parsed["input"]
+                    if row and isinstance(row[0], list):
+                        row = row[0]
+                    write_line(json.dumps(
+                        {"done": True,
+                         "result": [int(t) for t in row]
+                         + [int(t) for t in delivered],
+                         "resumed": True}).encode() + b"\n")
+                    with self._lock:
+                        self._counters["routed"] += 1
+                    return
+                attempt += 1
+                time.sleep(self.backoff_delay(attempt - 1))
+            finally:
+                conn.close()
+        # retry budget exhausted
+        ra = shed_ra if shed_ra is not None else 1.0
+        msg = ("no replica could complete the stream (attempts: %s)"
+               % (trace,))
+        with self._lock:
+            self._counters["shed_rejects"] += 1
+        if committed:
+            write_line(json.dumps(
+                {"error": msg, "retry_after_s": ra}).encode() + b"\n")
+            return
+        raise NoReplicaError(msg, retry_after_s=ra)
+
+    def _pump_stream(self, resp, parsed, delivered, write_line,
+                     resumed):
+        """Forward NDJSON lines replica→client until the done line
+        (True) or upstream failure (False).  Client write failures
+        raise :class:`_ClientGone`.  ``delivered`` accumulates the
+        new tokens the client has actually been sent — the splice
+        offset a failover resumes from."""
+        while True:
+            raw = resp.fp.readline()
+            if not raw:
+                return False              # EOF before done: upstream died
+            try:
+                msg = json.loads(raw)
+            except ValueError:
+                return False              # torn line: upstream died
+            if "tokens" in msg:
+                self._client_write(write_line, raw)
+                delivered.extend(msg["tokens"])
+            elif "error" in msg and msg.get("kind") in (
+                    "DeadlineExceeded", "RequestCancelled"):
+                # REQUEST-scoped terminal: the replica is healthy —
+                # one expired deadline or a cancelled slowloris must
+                # not flap the whole replica down, and certainly not
+                # resume an already-dead request on a survivor.
+                # Relay the verdict and end the stream.
+                self._client_write(write_line, raw)
+                return True
+            elif msg.get("done"):
+                # a resumed replica's terminal result is already the
+                # full concatenation (its prompt included the
+                # delivered prefix); tag splices for observability
+                if resumed:
+                    msg["resumed"] = True
+                    raw = json.dumps(msg).encode() + b"\n"
+                self._client_write(write_line, raw)
+                return True
+            elif "error" in msg:
+                return False              # engine-side failure: fail over
+            else:
+                self._client_write(write_line, raw)
+
+    @staticmethod
+    def _client_write(write_line, raw):
+        try:
+            write_line(raw)
+        except Exception as e:  # noqa: BLE001 — dead client socket
+            raise _ClientGone() from e
+
+    # ------------------------------------------------------------ metrics
+    def metrics(self):
+        with self._lock:
+            counters = dict(self._counters)
+            sessions = len(self._sessions)
+        reps = self.replicas()
+        states = {}
+        for rep in reps.values():
+            states[rep["state"]] = states.get(rep["state"], 0) + 1
+        return {"replicas": reps, "states": states,
+                "sessions": sessions, "counters": counters,
+                "affinity": self.affinity,
+                "retry_max": self.retry_max,
+                "health_interval_ms": self.health_interval_s * 1e3}
+
+    def fleet_health(self):
+        reps = self.replicas()
+        live = sum(1 for r in reps.values() if r["state"] == "up")
+        return {"state": "serving" if live else "unavailable",
+                "live_replicas": live, "replicas": reps}
+
+    # ------------------------------------------------------------- server
+    def start(self):
+        router = self
+
+        from veles_tpu.services.restful import send_json
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, code, payload, headers=()):
+                send_json(self, code, payload, headers)
+
+            def do_GET(self):
+                if self.path == router.path + "/metrics":
+                    self._send_json(200, router.metrics())
+                elif self.path == router.path + "/health":
+                    h = router.fleet_health()
+                    self._send_json(
+                        200 if h["state"] == "serving" else 503, h)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    if self.path == router.path + "/register":
+                        req = json.loads(body)
+                        rid = router.register(req["url"])
+                        self._send_json(200, {"replica": rid})
+                        return
+                    if self.path == router.path + "/deregister":
+                        req = json.loads(body)
+                        ok = router.deregister(
+                            rid=req.get("replica"),
+                            url=req.get("url"))
+                        self._send_json(200 if ok else 404,
+                                        {"removed": ok})
+                        return
+                    if self.path == router.path + "/drain":
+                        req = json.loads(body)
+                        ok = router.drain_replica(req.get("replica"))
+                        self._send_json(202 if ok else 404,
+                                        {"draining": ok})
+                        return
+                    if self.path != router.path:
+                        self.send_error(404)
+                        return
+                    self._route(body)
+                except NoReplicaError as e:
+                    self._send_json(
+                        503, {"error": str(e),
+                              "retry_after_s": e.retry_after_s},
+                        headers=[("Retry-After", str(max(
+                            1, int(math.ceil(e.retry_after_s)))))])
+                except _ReplicaReject as e:
+                    self.send_response(e.status)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(e.payload)))
+                    self.end_headers()
+                    self.wfile.write(e.payload)
+                except Exception as e:  # noqa: BLE001 — report to client
+                    try:
+                        self._send_json(400, {"error": str(e)})
+                    except Exception:  # noqa: BLE001 — dead pipe
+                        pass
+
+            def _route(self, body):
+                parsed = json.loads(body)
+                if isinstance(parsed, dict) \
+                        and parsed.pop("resume", None):
+                    # "resume" is the ROUTER-internal shed-exemption
+                    # flag for failover continuations — strip it from
+                    # client input so nobody rides past the fleet's
+                    # admission control by forging it
+                    body = json.dumps(parsed).encode()
+                session = parsed.get("session")
+                if isinstance(parsed.get("generate"), dict) \
+                        and parsed["generate"].get("stream"):
+                    def send_headers():
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.end_headers()
+
+                    def write_line(raw):
+                        self.wfile.write(raw)
+                        self.wfile.flush()
+
+                    router.route_stream(parsed, body, session,
+                                        send_headers, write_line)
+                    return
+                status, payload, headers = router.route_buffered(
+                    body, session=session)
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, fmt, *args):
+                router.debug("http: " + fmt, *args)
+
+        class Server(ThreadingHTTPServer):
+            # survive concurrent client bursts (same rationale as the
+            # replica endpoint)
+            request_queue_size = 128
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="VelesFleetRouter")
+        self._thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name="VelesFleetHealth")
+        self._health_thread.start()
+        self.info("fleet router on http://%s:%d%s", self.host,
+                  self.port, self.path)
+
+    def stop(self):
+        self._closed = True
+        self._health_wake.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+        for api in self._local_apis:
+            try:
+                api.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._local_apis = []
+
+
+class _ClientGone(Exception):
+    """The downstream client's socket died mid-stream — abort the
+    upstream leg (its write failure cancels the engine request) and
+    stop; never retried."""
+
+
+class _ReplicaReject(Exception):
+    """A replica answered with a deterministic non-200/503 (validation
+    400, deadline 504) — propagate its verdict verbatim instead of
+    burning retries on an error every replica will repeat."""
+
+    def __init__(self, status, payload):
+        super(_ReplicaReject, self).__init__(
+            "replica rejected the request (%d)" % status)
+        self.status = int(status)
+        self.payload = bytes(payload)
+
+
+def main(argv=None):
+    """``veles-tpu-router``: stand up a fleet router over replica
+    URLs.  Replicas can also register themselves later via POST
+    ``{path}/register``."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="health-routed fleet router over engine replicas "
+                    "(docs/services.md 'Fleet serving')")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8185)
+    ap.add_argument("--path", default="/fleet")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="URL",
+                    help="replica work URL (repeatable), e.g. "
+                         "http://127.0.0.1:8180/service")
+    ap.add_argument("--health-interval-ms", type=float, default=None)
+    ap.add_argument("--retry-max", type=int, default=None)
+    ap.add_argument("--affinity", choices=("session", "none"),
+                    default=None)
+    args = ap.parse_args(argv)
+    router = FleetRouter(host=args.host, port=args.port,
+                         path=args.path,
+                         health_interval_ms=args.health_interval_ms,
+                         retry_max=args.retry_max,
+                         affinity=args.affinity)
+    for url in args.replica:
+        router.register(url)
+    router.start()
+    print("fleet router on http://%s:%d%s (%d replicas)"
+          % (router.host, router.port, router.path,
+             len(args.replica)))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
