@@ -51,6 +51,10 @@ class TestDBSnapshotter:
         class FakeTrainer:
             velocity = {}
             _step_counter = 7
+            class_stats = [{}, {}, {}]   # device accumulators (empty)
+
+            def flush(self):
+                pass                     # no fused steps pending
 
             def host_params(self):
                 return {"l0": {"weights": np.ones((2, 2))}}
@@ -67,6 +71,10 @@ class TestDBSnapshotter:
         snap.prefix = "t"
         snap.async_write = False
         snap._writer = None
+        snap.keep_last = 0
+        snap.commit_retries = 1
+        snap.retry_backoff = 0.0
+        snap.manifest = True
         snap.trainer = FakeTrainer()
         snap.loader = FakeLoader()
         snap.decision = None

@@ -1,0 +1,594 @@
+"""Training survival layer (PR 8): crash-consistent snapshotter
+hardening (integrity manifests, torn-commit detection + quarantine,
+keep-last-N ring, transient-error retry), the respawn supervisor
+(classification via crashdumps, backoff, crash-loop + deterministic-bug
+valves), the --snapshot auto dangling/corrupt `_current` fallback, and
+the scaled-down train-chaos smoke (the CI `train-chaos` job runs the
+full gate)."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu.services.snapshotter import (MANIFEST_SUFFIX,
+                                            SnapshotIntegrityError,
+                                            SnapshotterBase,
+                                            iter_state_leaves,
+                                            state_manifest,
+                                            validate_state_manifest)
+from veles_tpu.services.supervisor import Supervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"params": {"l0": {"weights": rng.randn(4, 3),
+                              "bias": rng.randn(3)}},
+            "prng": {"loader": {"seed": 11, "counter": 5 + seed}},
+            "epoch": 2, "step_counter": 36,
+            "loader": {"epoch_number": 2, "minibatch_offset": 7,
+                       "order": np.arange(10, dtype=np.int32)}}
+
+
+class _StateSnap(SnapshotterBase):
+    """File-backend snapshotter over a fixed state dict — exercises the
+    commit path without a training workflow."""
+
+    def __init__(self, state, **kwargs):
+        super(_StateSnap, self).__init__(None, **kwargs)
+        self._state = state
+
+    def collect(self):
+        return self._state
+
+
+# --------------------------------------------------------------------
+# integrity manifest + torn-commit detection
+# --------------------------------------------------------------------
+class TestIntegrityManifest:
+    def test_manifest_written_and_validated_roundtrip(self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path),
+                          prefix="m", compression="gz")
+        path = snap.export()
+        assert os.path.exists(path + MANIFEST_SUFFIX)
+        man = json.load(open(path + MANIFEST_SUFFIX))
+        assert man["file_sha256"] and man["leaves"]
+        # weights leaf records shape+dtype next to its digest
+        wl = man["leaves"]["/params/l0/weights"]
+        assert wl["shape"] == [4, 3] and "float64" in wl["dtype"]
+        loaded = SnapshotterBase.import_(path)
+        np.testing.assert_array_equal(
+            loaded["params"]["l0"]["weights"],
+            _state()["params"]["l0"]["weights"])
+
+    def test_truncated_checkpoint_rejected_before_unpickle(
+            self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path),
+                          prefix="t", compression="gz")
+        path = snap.export()
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size * 3 // 5)
+        with pytest.raises(SnapshotIntegrityError, match="sha256"):
+            SnapshotterBase.import_(path)
+
+    def test_leaf_mutation_detected(self):
+        st = _state()
+        man = state_manifest(st)
+        validate_state_manifest(st, man)            # clean passes
+        st["params"]["l0"]["weights"][0, 0] += 1.0
+        with pytest.raises(SnapshotIntegrityError,
+                           match="/params/l0/weights"):
+            validate_state_manifest(st, man)
+        # scalar leaves are covered too
+        st2 = _state()
+        st2["step_counter"] = 37
+        with pytest.raises(SnapshotIntegrityError,
+                           match="step_counter"):
+            validate_state_manifest(st2, man)
+
+    def test_legacy_checkpoint_without_manifest_still_loads(
+            self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path),
+                          prefix="l", compression="", manifest=False)
+        path = snap.export()
+        assert not os.path.exists(path + MANIFEST_SUFFIX)
+        assert SnapshotterBase.import_(path)["epoch"] == 2
+
+    def test_quarantine_renames_data_and_manifest(self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path),
+                          prefix="q", compression="gz")
+        path = snap.export()
+        target = SnapshotterBase.quarantine(path)
+        assert target == path + ".corrupt"
+        assert os.path.exists(target)
+        assert os.path.exists(target + MANIFEST_SUFFIX)
+        assert not os.path.exists(path)
+        assert not os.path.exists(path + MANIFEST_SUFFIX)
+
+
+# --------------------------------------------------------------------
+# keep-last-N ring + commit retry
+# --------------------------------------------------------------------
+class TestCheckpointRing:
+    def _export_n(self, snap, n):
+        paths = []
+        for i in range(n):
+            snap._epoch_counter = i + 1
+            paths.append(snap.export())
+            # distinct mtimes on coarse-grained filesystems
+            t = time.time() + i - n
+            os.utime(paths[-1], (t, t))
+        return paths
+
+    def test_ring_prunes_beyond_keep_last(self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path), prefix="r",
+                          compression="gz", keep_last=3)
+        self._export_n(snap, 6)
+        data = [n for n in os.listdir(str(tmp_path))
+                if not n.endswith("_current")
+                and not n.endswith(MANIFEST_SUFFIX)]
+        assert sorted(data) == ["r_4.pickle.gz", "r_5.pickle.gz",
+                                "r_6.pickle.gz"]
+        # manifests pruned alongside their data files
+        manifests = [n for n in os.listdir(str(tmp_path))
+                     if n.endswith(MANIFEST_SUFFIX)]
+        assert len(manifests) == 3
+        # _current still resolves to a loadable checkpoint
+        cur = os.path.join(str(tmp_path), "r_current")
+        assert SnapshotterBase.import_(cur)["epoch"] == 2
+
+    def test_ring_never_deletes_current_anchor(self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path), prefix="a",
+                          compression="gz", keep_last=2)
+        paths = self._export_n(snap, 3)
+        # age the CURRENT target far past everything else: mtime says
+        # collect it, the anchor rule says never
+        cur = os.path.join(str(tmp_path), "a_current")
+        anchor = os.path.realpath(cur)
+        os.utime(anchor, (1.0, 1.0))
+        snap._epoch_counter = 9
+        snap.export()
+        assert os.path.exists(anchor) or \
+            os.path.realpath(cur) != anchor   # re-flipped is fine
+        assert SnapshotterBase.import_(cur)["epoch"] == 2
+        assert paths  # silence unused
+
+    def test_keep_last_zero_keeps_everything(self, tmp_path):
+        snap = _StateSnap(_state(), directory=str(tmp_path), prefix="k",
+                          compression="gz", keep_last=0)
+        self._export_n(snap, 6)
+        data = [n for n in os.listdir(str(tmp_path))
+                if not n.endswith("_current")
+                and not n.endswith(MANIFEST_SUFFIX)]
+        assert len(data) == 6
+
+
+class TestCommitRetry:
+    def test_transient_error_retried_and_recorded(self, tmp_path,
+                                                  monkeypatch):
+        from veles_tpu.telemetry import flight
+        real_replace = os.replace
+        fails = {"n": 2}
+
+        def flaky(src, dst):
+            if fails["n"] > 0 and dst.endswith(".gz"):
+                fails["n"] -= 1
+                raise OSError("transient EIO")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", flaky)
+        snap = _StateSnap(_state(), directory=str(tmp_path), prefix="f",
+                          compression="gz", commit_retries=3,
+                          retry_backoff_ms=1)
+        path = snap.export()
+        assert os.path.exists(path)
+        assert fails["n"] == 0
+        # filter by THIS test's destination: the bounded ring may have
+        # rotated arbitrary events from earlier tests
+        retries = [e for e in flight.recorder.snapshot()
+                   if e["kind"] == "snapshot.retry"
+                   and str(tmp_path) in str(e.get("destination"))]
+        assert len(retries) == 2
+        assert "transient EIO" in retries[0]["error"]
+
+    def test_exhausted_retries_surface(self, tmp_path, monkeypatch):
+        def always(src, dst):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(os, "replace", always)
+        snap = _StateSnap(_state(), directory=str(tmp_path), prefix="x",
+                          compression="gz", commit_retries=2,
+                          retry_backoff_ms=1)
+        with pytest.raises(OSError, match="disk on fire"):
+            snap.export()
+
+
+# --------------------------------------------------------------------
+# db backend integrity
+# --------------------------------------------------------------------
+class TestDBIntegrity:
+    def _write_rows(self, dsn, n):
+        from veles_tpu.services.snapshotter import DBSnapshotter
+        snap = DBSnapshotter(None, dsn=dsn)
+        for i in range(n):
+            snap._db_write(_state(seed=i), "s%d" % i,
+                           "%s#wf_s%d" % (dsn, i))
+        return snap
+
+    def test_corrupt_newest_row_falls_back_to_previous(self, tmp_path):
+        import sqlite3
+
+        from veles_tpu.services.snapshotter import DBSnapshotter
+        dsn = str(tmp_path / "s.sqlite")
+        self._write_rows(dsn, 3)
+        conn = sqlite3.connect(dsn)
+        with conn:
+            conn.execute("UPDATE snapshots SET state = ? WHERE id = "
+                         "(SELECT MAX(id) FROM snapshots)",
+                         (b"torn-garbage",))
+        conn.close()
+        snap = DBSnapshotter.import_db(dsn)
+        # newest (seed=2) skipped; previous valid row (seed=1) loads
+        assert snap["prng"]["loader"]["counter"] == 6
+        np.testing.assert_array_equal(
+            snap["params"]["l0"]["weights"],
+            _state(seed=1)["params"]["l0"]["weights"])
+
+    def test_all_rows_corrupt_raises_integrity_error(self, tmp_path):
+        import sqlite3
+        from veles_tpu.services.snapshotter import DBSnapshotter
+        dsn = str(tmp_path / "s.sqlite")
+        self._write_rows(dsn, 2)
+        conn = sqlite3.connect(dsn)
+        with conn:
+            conn.execute("UPDATE snapshots SET state = ?",
+                         (b"torn-garbage",))
+        conn.close()
+        with pytest.raises(SnapshotIntegrityError):
+            DBSnapshotter.import_db(dsn)
+
+    def test_db_ring_bounded_in_transaction(self, tmp_path):
+        import sqlite3
+        from veles_tpu.services.snapshotter import DBSnapshotter
+        dsn = str(tmp_path / "s.sqlite")
+        snap = DBSnapshotter(None, dsn=dsn, keep_last=2)
+        for i in range(5):
+            snap._db_write(_state(seed=i), "s%d" % i, "d")
+        conn = sqlite3.connect(dsn)
+        rows = conn.execute(
+            "SELECT suffix FROM snapshots ORDER BY id").fetchall()
+        conn.close()
+        assert [r[0] for r in rows] == ["s3", "s4"]
+        assert DBSnapshotter.import_db(dsn)["prng"]["loader"][
+            "counter"] == 9
+
+
+# --------------------------------------------------------------------
+# --snapshot auto fallback: torn current, dangling symlink
+# --------------------------------------------------------------------
+class TestAutoResumeFallback:
+    def _commit(self, tmp_path, prefix, suffix, seed):
+        snap = _StateSnap(_state(seed=seed), directory=str(tmp_path),
+                          prefix=prefix, compression="gz")
+        snap._epoch_counter = suffix
+        path = snap.export()
+        t = time.time() - 100 + suffix
+        os.utime(path, (t, t))
+        return path
+
+    def test_torn_current_steps_back_and_quarantines(self, tmp_path,
+                                                     capsys):
+        from veles_tpu.__main__ import Main
+        self._commit(tmp_path, "w", 1, seed=1)
+        newest = self._commit(tmp_path, "w", 2, seed=2)
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 2)
+        current = os.path.join(str(tmp_path), "w_current")
+        try:
+            SnapshotterBase.import_(current)
+            raise AssertionError("torn checkpoint loaded")
+        except SnapshotIntegrityError as e:
+            snap, src = Main._auto_snapshot_fallback(current, e)
+        assert snap is not None and src.endswith("w_1.pickle.gz")
+        assert snap["prng"]["loader"]["counter"] == 6   # seed=1 state
+        assert os.path.exists(newest + ".corrupt")
+        assert not os.path.exists(newest)
+        err = capsys.readouterr().err
+        assert "failed to load" in err and "recovered from" in err
+        assert "quarantined" in err
+
+    def test_dangling_current_falls_back_with_warning(self, tmp_path,
+                                                      capsys):
+        import types
+
+        from veles_tpu.__main__ import Main
+        self._commit(tmp_path, "d", 1, seed=3)
+        current = os.path.join(str(tmp_path), "d_current")
+        os.remove(current)
+        os.symlink("d_gone.pickle.gz", current)   # dangling
+        wf = types.SimpleNamespace(
+            name="d", snapshotter=types.SimpleNamespace(
+                directory=str(tmp_path), prefix="d"))
+        resolved = Main._resolve_auto_snapshot(wf)
+        assert resolved == current               # NOT a silent fresh start
+        err = capsys.readouterr().err
+        assert "dangles" in err
+        try:
+            SnapshotterBase.import_(resolved)
+            raise AssertionError("dangling symlink loaded")
+        except Exception as e:   # noqa: BLE001 — any load failure
+            snap, src = Main._auto_snapshot_fallback(resolved, e)
+        assert snap is not None and src.endswith("d_1.pickle.gz")
+
+    def test_no_candidates_fresh_start(self, tmp_path, capsys):
+        from veles_tpu.__main__ import Main
+        current = os.path.join(str(tmp_path), "n_current")
+        snap, src = Main._auto_snapshot_fallback(
+            current, FileNotFoundError("gone"))
+        assert snap is None and src is None
+        assert "fresh start" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------
+# the supervisor
+# --------------------------------------------------------------------
+_CHILD_PREEMPT_THEN_DONE = """\
+import os, sys
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(75)
+sys.exit(0)
+"""
+
+_CHILD_ALWAYS_CRASH = """\
+import sys
+sys.exit(3)
+"""
+
+_CHILD_CRASH_WITH_DUMP = """\
+import json, os, sys, time
+blackbox, kind, marker = sys.argv[1], sys.argv[2], sys.argv[3]
+if kind == "fault" and os.path.exists(marker):
+    sys.exit(0)                        # second life: drill recovered
+d = os.path.join(blackbox, "crashdump-%d" % int(time.time() * 1e6))
+os.makedirs(d)
+with open(os.path.join(d, "events.jsonl"), "w") as f:
+    if kind == "fault":
+        f.write(json.dumps({"kind": "fault.injected"}) + "\\n")
+meta = {"reason": "test"}
+if kind == "error":
+    meta["error"] = {"type": "ValueError", "message": "boom"}
+with open(os.path.join(d, "meta.json"), "w") as f:
+    json.dump(meta, f)
+open(marker, "w").write("x")
+sys.exit(1)
+"""
+
+_CHILD_SLEEP = """\
+import time
+time.sleep(60)
+"""
+
+
+def _script(tmp_path, body, name="child.py"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        f.write(body)
+    return path
+
+
+class TestSupervisor:
+    def test_backoff_delay_pinned(self):
+        sup = Supervisor(["true"], backoff_base_ms=100,
+                         backoff_max_ms=800, seed=5,
+                         install_signals=False)
+        for attempt, ceiling in ((1, 0.1), (2, 0.2), (3, 0.4),
+                                 (4, 0.8), (7, 0.8)):
+            for _ in range(20):
+                d = sup.backoff_delay(attempt)
+                assert 0.5 * ceiling <= d < ceiling
+
+    def test_preempt_respawns_immediately_unbounded(self, tmp_path):
+        child = _script(tmp_path, _CHILD_PREEMPT_THEN_DONE)
+        marker = str(tmp_path / "m")
+        sup = Supervisor([sys.executable, child, marker],
+                         max_restarts=1, window_seconds=600,
+                         blackbox_dir=str(tmp_path / "bb"),
+                         install_signals=False)
+        assert sup.run() == 0
+        kinds = [h["kind"] for h in sup.history]
+        assert kinds == ["preempt", "done"]
+        assert sup.restarts["preempt"] == 1
+        assert sup.spawn_count == 2
+
+    def test_crash_loop_valve_gives_up(self, tmp_path):
+        child = _script(tmp_path, _CHILD_ALWAYS_CRASH)
+        sup = Supervisor([sys.executable, child],
+                         max_restarts=2, window_seconds=600,
+                         backoff_base_ms=1, backoff_max_ms=2,
+                         blackbox_dir=str(tmp_path / "bb"),
+                         deterministic_limit=99,
+                         install_signals=False)
+        assert sup.run() == 3
+        # initial + 2 allowed respawns, then the valve
+        assert sup.spawn_count == 3
+        assert all(h["kind"] == "crash:rc3" for h in sup.history)
+
+    def test_deterministic_bug_gives_up_early(self, tmp_path):
+        bb = str(tmp_path / "bb")
+        os.makedirs(bb)
+        child = _script(tmp_path, _CHILD_CRASH_WITH_DUMP)
+        sup = Supervisor(
+            [sys.executable, child, bb, "error",
+             str(tmp_path / "m")],
+            max_restarts=50, window_seconds=600,
+            backoff_base_ms=1, backoff_max_ms=2,
+            deterministic_limit=2, blackbox_dir=bb,
+            install_signals=False)
+        assert sup.run() == 1
+        assert sup.spawn_count == 2       # identical signature twice
+        assert sup.history[-1]["kind"] == "crash:ValueError"
+        assert "boom" in sup.history[-1]["signature"]
+
+    def test_fault_injection_classified_from_crashdump(self, tmp_path):
+        bb = str(tmp_path / "bb")
+        os.makedirs(bb)
+        child = _script(tmp_path, _CHILD_CRASH_WITH_DUMP)
+        sup = Supervisor(
+            [sys.executable, child, bb, "fault",
+             str(tmp_path / "m")],
+            max_restarts=5, backoff_base_ms=1, backoff_max_ms=2,
+            deterministic_limit=2, blackbox_dir=bb,
+            install_signals=False)
+        assert sup.run() == 0
+        kinds = [h["kind"] for h in sup.history]
+        assert kinds == ["fault-injection", "done"]
+        assert sup.restarts["fault-injection"] == 1
+
+    def test_sigkill_classified_and_respawned(self, tmp_path):
+        import signal as _signal
+        import threading
+        marker = str(tmp_path / "m")
+        child = _script(tmp_path, """\
+import os, sys, time
+if os.path.exists(%r):
+    sys.exit(0)
+open(%r, "w").write("x")
+time.sleep(60)
+""" % (marker, marker))
+        sup = Supervisor([sys.executable, child],
+                         max_restarts=5, backoff_base_ms=1,
+                         backoff_max_ms=2,
+                         blackbox_dir=str(tmp_path / "bb"),
+                         install_signals=False)
+
+        def killer():
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if os.path.exists(marker):
+                    pid = sup.current_pid()
+                    if pid:
+                        os.kill(pid, _signal.SIGKILL)
+                        return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        assert sup.run() == 0
+        t.join(timeout=10)
+        kinds = [h["kind"] for h in sup.history]
+        assert kinds == ["killed:SIGKILL", "done"]
+        assert sup.restarts["killed"] == 1
+
+    def test_progress_resets_deterministic_counter(self, tmp_path):
+        """Crashes WITH checkpoint progress between them never trip the
+        deterministic-bug valve: the signature counter resets."""
+        bb = str(tmp_path / "bb")
+        progress = str(tmp_path / "snap")
+        os.makedirs(bb)
+        os.makedirs(progress)
+        child = _script(tmp_path, """\
+import json, os, sys, time
+bb, progress, counter = sys.argv[1], sys.argv[2], sys.argv[3]
+n = int(open(counter).read()) if os.path.exists(counter) else 0
+open(counter, "w").write(str(n + 1))
+if n >= 4:
+    sys.exit(0)
+open(os.path.join(progress, "ckpt-%d" % n), "w").write("x")  # progress
+d = os.path.join(bb, "crashdump-%d" % int(time.time() * 1e6))
+os.makedirs(d)
+open(os.path.join(d, "events.jsonl"), "w").write("")
+json.dump({"error": {"type": "ValueError", "message": "same"}},
+          open(os.path.join(d, "meta.json"), "w"))
+sys.exit(1)
+""")
+        sup = Supervisor(
+            [sys.executable, child, bb, progress,
+             str(tmp_path / "n")],
+            max_restarts=50, backoff_base_ms=1, backoff_max_ms=2,
+            deterministic_limit=2, blackbox_dir=bb,
+            progress_paths=[progress], install_signals=False)
+        # 4 identical-signature crashes, each WITH progress -> all
+        # respawned; a deterministic_limit of 2 would otherwise stop
+        # after the second
+        assert sup.run() == 0
+        assert sup.spawn_count == 5   # 4 crashes + the clean finish
+
+    def test_stop_prevents_respawn(self, tmp_path):
+        import threading
+        child = _script(tmp_path, _CHILD_SLEEP)
+        sup = Supervisor([sys.executable, child],
+                         blackbox_dir=str(tmp_path / "bb"),
+                         install_signals=False)
+
+        def stopper():
+            while sup.current_pid() is None:
+                time.sleep(0.01)
+            sup.stop()
+
+        t = threading.Thread(target=stopper, daemon=True)
+        t.start()
+        rc = sup.run()
+        t.join(timeout=10)
+        assert rc == -15                  # SIGTERM, default disposition
+        assert sup.spawn_count == 1       # no respawn after stop()
+
+
+# --------------------------------------------------------------------
+# CLI wiring
+# --------------------------------------------------------------------
+class TestSuperviseCLI:
+    def test_supervise_rejects_explicit_snapshot_path(self):
+        from veles_tpu.__main__ import Main
+        with pytest.raises(SystemExit, match="snapshot auto"):
+            Main(["wf.py", "--supervise",
+                  "--snapshot", "/some/file.pickle"]).run()
+
+    def test_supervise_parses_and_composes_with_auto(self):
+        from veles_tpu.__main__ import Main
+        args = Main(["wf.py", "--supervise", "--snapshot", "auto",
+                     "--snapshot-every", "1"]).parse()
+        assert args.supervise and args.snapshot == "auto"
+
+
+# --------------------------------------------------------------------
+# scaled-down chaos smoke (the CI train-chaos job runs the full gate)
+# --------------------------------------------------------------------
+class TestTrainChaosSmoke:
+    def test_chaos_gate_scaled_down(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        report_file = str(tmp_path / "report.json")
+        r = subprocess.run(
+            [sys.executable, "tools/train_chaos.py",
+             "--epochs", "6", "--kills", "2", "--seed", "23",
+             "--workdir", str(tmp_path / "work"),
+             "--json", report_file, "--timeout", "240"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=360)
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+        rep = json.load(open(report_file))
+        assert rep["gates_failed"] == []
+        assert rep["exactness"]["identical"]
+        assert rep["exactness"]["n_leaves"] > 20
+        sigs = {k["signal"] for k in rep["kills_delivered"]}
+        assert sigs == {"SIGKILL", "SIGTERM"}
+        assert rep["quarantined"]          # torn commit quarantined
+        assert rep["ring_invalid"] == []   # zero torn checkpoints left
+
+
+def test_iter_state_leaves_shared_flattener():
+    """The verifier and the manifest flatten identically (they import
+    the same function — pin the contract anyway)."""
+    st = {"b": [1, 2], "a": {"x": np.zeros(2)}}
+    paths = [p for p, _ in iter_state_leaves(st)]
+    assert paths == ["/a/x", "/b[0]", "/b[1]"]
+    assert pickle.loads(pickle.dumps(st))  # round-trips

@@ -500,6 +500,179 @@ def test_decision_watch_empty_split_rejected():
         wf.initialize()
 
 
+class TestRestoreExactness:
+    """PR 8 satellite: Snapshotter.restore exactness pinned at UNIT
+    level — PRNG counter position, loader position/shuffle order, and
+    optimizer-slot restoration each independently, plus the mid-sweep
+    preemption resume end to end (bit-identical to uninterrupted,
+    including the decision's epoch metrics)."""
+
+    def test_prng_counter_position_restored_exactly(self):
+        import jax
+
+        prng.seed_all(123)
+        g = prng.get("exactness-drill")
+        for _ in range(5):
+            g.key()
+        saved = prng.states()
+        expect_keys = [np.asarray(jax.random.key_data(g.key()))
+                       for _ in range(3)]
+        expect_perm = g.permutation(32)
+        # scrub: different base seed AND consumed counters
+        prng.seed_all(999)
+        g2 = prng.get("exactness-drill")
+        g2.key()
+        g2.key()
+        prng.restore_states(saved)
+        g3 = prng.get("exactness-drill")
+        assert g3._counter == 5            # counter position, not just seed
+        replay_keys = [np.asarray(jax.random.key_data(g3.key()))
+                       for _ in range(3)]
+        for a, b in zip(expect_keys, replay_keys):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(expect_perm, g3.permutation(32))
+        prng.seed_all(1234)                # leave the registry tidy
+
+    def test_loader_position_epoch_and_shuffle_restored_exactly(self):
+        def make_loader():
+            x, y = digits_data()
+            return FullBatchLoader(
+                None, data=x, labels=y, minibatch_size=100,
+                class_lengths=[0, 297, 1500])
+
+        prng.seed_all(7)
+        loader = make_loader()
+        loader.initialize()
+        for _ in range(25):                # into the train span, epoch 1
+            loader.run()
+        st = loader.state
+        assert st["minibatch_offset"] == loader.minibatch_offset
+        assert st["prng"]["counter"] > 0   # self-contained stream words
+        golden = []
+        for _ in range(40):                # crosses the epoch boundary
+            loader.run()
+            golden.append((loader.epoch_number, loader.minibatch_class,
+                           loader.minibatch_offset,
+                           loader.minibatch_indices.copy()))
+        # fresh loader under a DIFFERENT global seed: only the captured
+        # state may drive the replay (the reshuffle must come from the
+        # restored (seed, counter) words, not ambient registry state)
+        prng.seed_all(4242)
+        loader2 = make_loader()
+        loader2.initialize()
+        loader2.state = st
+        assert loader2.epoch_number == st["epoch_number"]
+        assert loader2.minibatch_offset == st["minibatch_offset"]
+        for epoch, cls, offset, idx in golden:
+            loader2.run()
+            assert (loader2.epoch_number, loader2.minibatch_class,
+                    loader2.minibatch_offset) == (epoch, cls, offset)
+            np.testing.assert_array_equal(loader2.minibatch_indices,
+                                          idx)
+        prng.seed_all(1234)
+
+    def test_optimizer_slots_restored_exactly(self, tmp_path):
+        cfg = {"directory": str(tmp_path), "interval": 1, "prefix": "os"}
+        wf = make_workflow(max_epochs=2, snapshotter_config=cfg)
+        wf.initialize()
+        wf.run()
+        snap = wf.snapshotter.collect()
+        # momentum slots are real (nonzero) at the capture point
+        import jax
+        vel_leaves = [np.asarray(v) for v in
+                      jax.tree_util.tree_leaves(snap["velocity"])]
+        assert any(np.abs(v).max() > 0 for v in vel_leaves)
+
+        wf2 = make_workflow(max_epochs=4, snapshotter_config=cfg)
+        wf2.initialize()
+        wf2.restore(snap)
+        # slot-by-slot bit equality immediately after restore
+        import jax
+        restored = jax.tree_util.tree_map(np.asarray,
+                                          wf2.trainer.velocity)
+        for (pa, va), (pb, vb) in zip(
+                sorted(jax.tree_util.tree_flatten_with_path(
+                    snap["velocity"])[0], key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_flatten_with_path(
+                    restored)[0], key=lambda kv: str(kv[0]))):
+            assert str(pa) == str(pb)
+            np.testing.assert_array_equal(np.asarray(va),
+                                          np.asarray(vb))
+        # and the continuation equals an uninterrupted run bit-for-bit
+        wf2.run()
+        wf3 = make_workflow(max_epochs=4)
+        wf3.initialize()
+        wf3.run()
+        np.testing.assert_array_equal(
+            np.asarray(wf2.trainer.host_params()[
+                wf2.trainer.layers[0].name]["weights"]),
+            np.asarray(wf3.trainer.host_params()[
+                wf3.trainer.layers[0].name]["weights"]))
+        for (pa, va), (pb, vb) in zip(
+                sorted(jax.tree_util.tree_flatten_with_path(
+                    wf2.trainer.host_velocity())[0],
+                    key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_flatten_with_path(
+                    wf3.trainer.host_velocity())[0],
+                    key=lambda kv: str(kv[0]))):
+            np.testing.assert_array_equal(np.asarray(va),
+                                          np.asarray(vb))
+
+    def test_midsweep_preempt_resume_bit_identical(self, tmp_path):
+        """SIGTERM-style preemption MID-SWEEP: the checkpoint lands at a
+        cycle boundary inside an epoch (loader offset > 0), and the
+        resumed run's final state — params, velocity, PRNG, loader,
+        decision metrics INCLUDING the interrupted epoch's — is
+        bit-identical to an uninterrupted golden run."""
+        from veles_tpu.services.snapshotter import (SnapshotterBase,
+                                                    iter_state_leaves)
+
+        cfg = {"directory": str(tmp_path / "c"), "interval": 1,
+               "prefix": "pre"}
+        wf = make_workflow(max_epochs=3, snapshotter_config=cfg)
+        wf.initialize()
+        runs = {"n": 0}
+        orig_run = wf.trainer.run
+
+        def hooked():
+            orig_run()
+            runs["n"] += 1
+            if runs["n"] == 25:       # inside epoch 1's train span
+                wf.request_preempt()
+
+        wf.trainer.run = hooked
+        wf.run()
+        assert wf.preempted_
+        snap = SnapshotterBase.import_(wf.snapshotter.destination)
+        assert snap["loader"]["minibatch_offset"] > 0   # truly mid-sweep
+        assert snap["epoch"] == 1
+        # the mid-sweep accumulators made it into the checkpoint
+        assert "trainer_stats" in snap
+        assert snap["decision"]["epoch_metrics"][1] is not None
+
+        wf2 = make_workflow(max_epochs=3, snapshotter_config={
+            "directory": str(tmp_path / "r"), "interval": 1,
+            "prefix": "pre"})
+        wf2.initialize()
+        wf2.restore(snap)
+        wf2.run()
+        golden = make_workflow(max_epochs=3, snapshotter_config={
+            "directory": str(tmp_path / "g"), "interval": 1,
+            "prefix": "pre"})
+        golden.initialize()
+        golden.run()
+        a = dict(iter_state_leaves(wf2.snapshotter.collect()))
+        b = dict(iter_state_leaves(golden.snapshotter.collect()))
+        assert set(a) == set(b)
+        for path in sorted(a):
+            va, vb = a[path], b[path]
+            if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+                np.testing.assert_array_equal(
+                    np.asarray(va), np.asarray(vb), err_msg=path)
+            else:
+                assert va == vb, "%s: %r != %r" % (path, va, vb)
+
+
 def test_db_snapshotter_async(tmp_path):
     from sklearn.datasets import load_digits
 

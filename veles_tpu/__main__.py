@@ -72,6 +72,15 @@ class Main(object):
                        "(injects a snapshotter into StandardWorkflow runs; "
                        "pairs with --snapshot auto for preemption-safe "
                        "training)")
+        p.add_argument("--supervise", action="store_true",
+                       help="run the training command under the respawn "
+                       "supervisor (services.supervisor): the run "
+                       "executes as a child process that is respawned "
+                       "after SIGKILL/SIGTERM/crashes with exponential "
+                       "backoff and crash-loop detection, resuming via "
+                       "--snapshot auto (added if absent) — the paper's "
+                       "Launcher role for single-host training "
+                       "(docs/distributed_training.md)")
         p.add_argument("--allow-remote-snapshot", action="store_true",
                        help="opt in to importing --snapshot from an "
                        "http(s) URL (pickle import runs code)")
@@ -266,6 +275,10 @@ class Main(object):
                 "to combine with restart-on-failure)")
         import logging
         setup_logging(logging.DEBUG if args.verbose else logging.INFO)
+        if args.supervise:
+            # the parent never touches jax/XLA: it only spawns, watches
+            # and respawns the real training command
+            return self._run_supervised(args)
         # persistent XLA compilation cache: re-runs of the same workflow
         # (and supervisor restarts after preemption) skip recompilation
         # — the TPU-era analogue of the reference's on-disk kernel cache
@@ -365,6 +378,7 @@ class Main(object):
                 snapshot = self._resolve_auto_snapshot(self.workflow)
             self._pending_warm_start = None
             self._pending_snapshot = None
+            resume_src, resume_reason = None, "fresh"
             if snapshot:
                 from veles_tpu.services.snapshotter import SnapshotterBase
                 # initialize first so staged steps exist, then restore.
@@ -377,6 +391,9 @@ class Main(object):
                         snapshot,
                         allow_remote=args.allow_remote_snapshot,
                         expected_sha256=args.snapshot_sha256)
+                    import os as _os
+                    resume_src = _os.path.realpath(snapshot)
+                    resume_reason = "current" if auto else "explicit"
                 except Exception as e:  # noqa: BLE001 — see below
                     if not auto or args.snapshot_sha256:
                         # an explicit path must fail loudly; and a
@@ -388,8 +405,21 @@ class Main(object):
                     # torn checkpoint (a kill can land inside a
                     # checkpoint commit): step back to the next-newest
                     # complete one, else start fresh
-                    self._pending_snapshot = \
+                    self._pending_snapshot, resume_src = \
                         self._auto_snapshot_fallback(snapshot, e)
+                    resume_reason = (
+                        "fallback: %s failed to load (%s: %s)"
+                        % (snapshot, type(e).__name__, e))
+            if args.snapshot:
+                # the resume decision joins the flight record: a
+                # post-mortem must show WHAT was restored and WHY (the
+                # crashdump distance to this event is the work lost)
+                from veles_tpu.telemetry import flight
+                flight.record(
+                    "train.resume", snapshot=resume_src,
+                    reason=resume_reason,
+                    epoch=None if self._pending_snapshot is None
+                    else self._pending_snapshot.get("epoch"))
             if self._pending_snapshot is None and args.warm_start:
                 # no (loadable) checkpoint anywhere — the fine-tuning
                 # initializer applies exactly as on a fresh start
@@ -756,20 +786,67 @@ class Main(object):
         for stmt in args.config_list:
             exec(stmt, {"root": root, "Range": Range})
 
+    def _run_supervised(self, args):
+        """``--supervise``: respawn the identical command (minus the
+        flag itself, plus ``--snapshot auto``) under the supervisor's
+        backoff/crash-loop policy — the Veles Launcher role collapsed
+        onto one host (docs/distributed_training.md "Preemption-safe
+        training")."""
+        if args.snapshot not in (None, "auto"):
+            raise SystemExit(
+                "--supervise drives restart-on-failure through "
+                "--snapshot auto; an explicit --snapshot path would "
+                "re-resume the SAME file after every respawn, losing "
+                "all progress between restarts")
+        # config must apply in the parent too: the supervisor reads its
+        # own knobs plus the snapshot/blackbox dirs from the tree
+        self._apply_config(args)
+        from veles_tpu.services.supervisor import Supervisor
+        child = [a for a in self.argv if a != "--supervise"]
+        if args.snapshot is None:
+            child += ["--snapshot", "auto"]
+        if args.snapshot_every is None:
+            print("[supervise] note: no --snapshot-every — unless the "
+                  "workflow wires its own snapshotter, respawns will "
+                  "restart from scratch", file=sys.stderr)
+        # progress is watched on the CONFIG-TREE snapshot dir: a
+        # workflow whose snapshotter_config names a different explicit
+        # 'directory' still restarts correctly, but checkpoint commits
+        # there won't reset the backoff/deterministic-bug counters —
+        # point root.common.dirs.snapshots at it to get both
+        sup = Supervisor(
+            [sys.executable, "-m", "veles_tpu"] + child,
+            blackbox_dir=root.common.blackbox.get("dir", "artifacts"),
+            progress_paths=[root.common.dirs.get("snapshots",
+                                                 "snapshots")])
+        return sup.run()
+
     @staticmethod
     def _auto_snapshot_fallback(current, error):
-        """--snapshot auto hit a torn/unloadable checkpoint: try the
-        other snapshots of the same prefix, newest first; None (fresh
-        start) when none load.  A supervisor restart loop must converge
-        to training, never to a crash loop."""
+        """--snapshot auto hit a torn/unloadable checkpoint: quarantine
+        it (rename to ``*.corrupt`` so restarts stop re-trying it),
+        then try the other snapshots of the same prefix, newest first;
+        ``(None, None)`` (fresh start) when none load.  A supervisor
+        restart loop must converge to training, never to a crash loop.
+
+        :returns: ``(snapshot_dict_or_None, loaded_path_or_None)``."""
         import os
 
-        from veles_tpu.services.snapshotter import SnapshotterBase
+        from veles_tpu.services.snapshotter import (MANIFEST_SUFFIX,
+                                                    SnapshotterBase)
         real = os.path.realpath(current)
         directory = os.path.dirname(real)
         prefix = os.path.basename(current).replace("_current", "")
         print("[auto-resume] %s failed to load (%s) — trying older "
               "checkpoints" % (real, error), file=sys.stderr)
+        # quarantine only CORRUPTION-class failures: a transient
+        # OSError (shared-storage hiccup) must not permanently demote
+        # the newest good checkpoint — the next restart retries it
+        if not isinstance(error, OSError) and os.path.exists(real):
+            q = SnapshotterBase.quarantine(real)
+            if q:
+                print("[auto-resume] quarantined torn checkpoint -> %s"
+                      % q, file=sys.stderr)
         candidates = sorted(
             (os.path.join(directory, n) for n in os.listdir(directory)
              # prefix + "_": the filename format is "<prefix>_<suffix>"
@@ -777,6 +854,12 @@ class Main(object):
              # workflow ("digits-mlp-big") sharing the snapshot dir
              if n.startswith(prefix + "_")
              and not n.endswith("_current")
+             and not n.endswith(MANIFEST_SUFFIX)
+             and not n.endswith(".corrupt")
+             # .tmp leftovers are UNCOMMITTED writes (a kill between
+             # dump and rename): a complete-looking one would resume
+             # manifest-less past the integrity gate
+             and ".tmp" not in n
              and os.path.join(directory, n) != real),
             key=os.path.getmtime, reverse=True)
         for cand in candidates:
@@ -785,13 +868,18 @@ class Main(object):
             except Exception as e:  # noqa: BLE001 — keep stepping back
                 print("[auto-resume] %s also failed (%s)" % (cand, e),
                       file=sys.stderr)
+                if not isinstance(e, OSError):
+                    q = SnapshotterBase.quarantine(cand)
+                    if q:
+                        print("[auto-resume] quarantined -> %s" % q,
+                              file=sys.stderr)
                 continue
             print("[auto-resume] recovered from %s" % cand,
                   file=sys.stderr)
-            return snap
+            return snap, cand
         print("[auto-resume] no loadable checkpoint — fresh start",
               file=sys.stderr)
-        return None
+        return None, None
 
     @staticmethod
     def _resolve_auto_snapshot(wf):
@@ -805,6 +893,19 @@ class Main(object):
                      else root.common.dirs.get("snapshots", "snapshots"))
         prefix = snap.prefix if snap is not None else wf.name
         current = os.path.join(directory, "%s_current" % prefix)
+        if os.path.islink(current) and not os.path.exists(current):
+            # dangling symlink (target deleted/renamed/never finalized):
+            # used to silently read as "no checkpoint" and fresh-start
+            # over real progress — surface it and let the fallback scan
+            # pick the newest valid checkpoint instead
+            try:
+                target = os.readlink(current)
+            except OSError:
+                target = "?"
+            print("[auto-resume] %s dangles (target %s is missing) — "
+                  "falling back to the newest valid checkpoint"
+                  % (current, target), file=sys.stderr)
+            return current   # import_ fails -> _auto_snapshot_fallback
         if os.path.exists(current):
             print("[auto-resume] %s" % os.path.realpath(current),
                   file=sys.stderr)

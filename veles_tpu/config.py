@@ -134,7 +134,30 @@ root.common.update({
     "random_seed": 1234,
     "timings": False,
     "trace": {"run": False},
-    "snapshot": {"interval": 1, "min_interval_seconds": 0, "codec": "gz"},
+    # crash-consistent checkpointing (services.snapshotter,
+    # docs/distributed_training.md "Preemption-safe training"):
+    # keep_last bounds the on-disk checkpoint ring per prefix (0 =
+    # unlimited); manifest=True writes a per-leaf checksum sidecar
+    # validated on restore so torn commits are detected and skipped;
+    # commit_retries/retry_backoff_ms retry transient filesystem
+    # errors during the commit write before surfacing.
+    "snapshot": {"interval": 1, "min_interval_seconds": 0, "codec": "gz",
+                 "keep_last": 5, "manifest": True,
+                 "commit_retries": 3, "retry_backoff_ms": 100},
+    # the training supervisor (services.supervisor, `--supervise`):
+    # respawn-on-failure with exponential backoff.  Graceful
+    # preemptions (exit 75) respawn immediately and unbounded;
+    # kills/fault-injections/crashes respawn with backoff and count
+    # against max_restarts per window_seconds (crash-loop valve);
+    # deterministic_limit consecutive IDENTICAL crashes with zero
+    # checkpoint progress give up early — restarting a deterministic
+    # bug only burns the restart budget.
+    "supervise": {"max_restarts": 8, "window_seconds": 600,
+                  "backoff_base_ms": 200, "backoff_max_ms": 30000,
+                  "deterministic_limit": 3},
+    # chaos/fault-drill knobs (tools/train_chaos.py): unit_delay_ms
+    # sleeps per scheduler unit-run so external kills land mid-sweep
+    "chaos": {"unit_delay_ms": 0},
     "web": {"host": "0.0.0.0", "port": 8090},
     # the flight recorder / crash forensics / watchdog layer
     # (veles_tpu.telemetry.flight + .health, docs/services.md "Black

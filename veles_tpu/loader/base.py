@@ -253,9 +253,18 @@ class Loader(Unit, metaclass=LoaderRegistry):
     # -- snapshot state (ref loader position pickled into snapshots) --------
     @property
     def state(self):
+        pool = getattr(self, "_train_pool", None)
         return {"epoch_number": self.epoch_number,
                 "minibatch_offset": self.minibatch_offset,
-                "order": None if self._order is None else self._order.copy()}
+                "order": None if self._order is None else self._order.copy(),
+                # self-contained exactness: the shuffle stream's
+                # (seed, counter) words and the ensemble subset pool
+                # ride along, so a restored loader replays the exact
+                # reshuffle sequence even when the global PRNG registry
+                # is restored separately (or not at all — unit-level
+                # restores, cross-process verifiers)
+                "prng": dict(self.prng.state),
+                "train_pool": None if pool is None else pool.copy()}
 
     @state.setter
     def state(self, st):
@@ -263,6 +272,10 @@ class Loader(Unit, metaclass=LoaderRegistry):
         self.minibatch_offset = st["minibatch_offset"]
         if st["order"] is not None:
             self._order = st["order"].copy()
+        if st.get("prng") is not None:      # absent in legacy snapshots
+            self.prng.state = st["prng"]
+        if st.get("train_pool") is not None:
+            self._train_pool = st["train_pool"].copy()
 
     def get_metric_values(self):
         out = {"epochs": self.epoch_number}

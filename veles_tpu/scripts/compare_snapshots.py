@@ -2,66 +2,86 @@
 veles/scripts/compare_snapshots.py — used with the reproducible-RNG
 guarantee to verify bit-identical reruns, SURVEY.md §4).
 
+Doubles as the exactness VERIFIER behind ``tools/train_chaos.py``: the
+chaos gate resumes a killed run and asserts its final checkpoint is
+bit-identical to an uninterrupted golden run — :func:`diff_report`
+returns the machine-readable verdict (``--format json`` on the CLI),
+``--ignore PREFIX`` masks leaf subtrees when a looser comparison is
+wanted.
+
 Usage: python -m veles_tpu.scripts.compare_snapshots A.pickle.gz B.pickle.gz
 Exit code 0 = identical within threshold, 1 = differs."""
 
 import argparse
+import json
 import sys
 
 import numpy as np
 
 from veles_tpu.numpy_ext import NumDiff
-from veles_tpu.services.snapshotter import SnapshotterBase
+from veles_tpu.services.snapshotter import (SnapshotterBase,
+                                            iter_state_leaves)
 
 
-def _leaves(obj, prefix=""):
-    """Flatten nested dict/list/tuple state into (path, leaf) pairs."""
-    if isinstance(obj, dict):
-        for k in sorted(obj, key=str):
-            yield from _leaves(obj[k], "%s/%s" % (prefix, k))
-    elif isinstance(obj, (list, tuple)):
-        for i, v in enumerate(obj):
-            yield from _leaves(v, "%s[%d]" % (prefix, i))
-    else:
-        yield prefix or "/", obj
-
-
-def compare(path_a, path_b, threshold=0.0, out=sys.stdout,
-            allow_remote=False):
-    a = dict(_leaves(SnapshotterBase.import_(path_a,
-                                             allow_remote=allow_remote)))
-    b = dict(_leaves(SnapshotterBase.import_(path_b,
-                                             allow_remote=allow_remote)))
-    differs = False
+def diff_report(path_a, path_b, threshold=0.0, ignore=(),
+                allow_remote=False):
+    """Leaf-by-leaf diff of two snapshots as a machine-readable dict:
+    ``{"identical": bool, "n_leaves": int, "diffs": [{"path", "kind",
+    "detail"}, ...]}``.  ``ignore`` is a sequence of leaf-path
+    prefixes (e.g. ``("/decision",)``) excluded from the verdict."""
+    a = dict(iter_state_leaves(SnapshotterBase.import_(
+        path_a, allow_remote=allow_remote)))
+    b = dict(iter_state_leaves(SnapshotterBase.import_(
+        path_b, allow_remote=allow_remote)))
+    diffs = []
+    n_compared = 0
     for path in sorted(set(a) | set(b)):
-        if path not in a or path not in b:
-            print("ONLY IN %s: %s" % ("B" if path not in a else "A", path),
-                  file=out)
-            differs = True
+        if any(path.startswith(pre) for pre in ignore):
             continue
+        if path not in a or path not in b:
+            diffs.append({"path": path, "kind": "only_in",
+                          "detail": "B" if path not in a else "A"})
+            continue
+        n_compared += 1
         va, vb = a[path], b[path]
         if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
             va, vb = np.asarray(va), np.asarray(vb)
             if va.shape != vb.shape:
-                print("SHAPE %s: %s vs %s" % (path, va.shape, vb.shape),
-                      file=out)
-                differs = True
+                diffs.append({"path": path, "kind": "shape",
+                              "detail": "%s vs %s" % (va.shape,
+                                                      vb.shape)})
                 continue
             if not np.issubdtype(va.dtype, np.number):
                 if not (va == vb).all():
-                    print("DIFF %s (non-numeric)" % path, file=out)
-                    differs = True
+                    diffs.append({"path": path, "kind": "diff",
+                                  "detail": "non-numeric"})
                 continue
             d = NumDiff(threshold=threshold).check(va, vb)
             if not d.ok:
-                print("DIFF %s: %s" % (path, d.report()), file=out)
-                differs = True
+                diffs.append({"path": path, "kind": "diff",
+                              "detail": d.report()})
         elif va != vb:
-            print("DIFF %s: %r vs %r" % (path, va, vb), file=out)
-            differs = True
-    if not differs:
-        print("snapshots match (threshold %g)" % threshold, file=out)
-    return 1 if differs else 0
+            diffs.append({"path": path, "kind": "diff",
+                          "detail": "%r vs %r" % (va, vb)})
+    return {"identical": not diffs, "n_leaves": n_compared,
+            "threshold": threshold, "diffs": diffs}
+
+
+def compare(path_a, path_b, threshold=0.0, out=sys.stdout,
+            allow_remote=False, ignore=()):
+    report = diff_report(path_a, path_b, threshold=threshold,
+                         ignore=ignore, allow_remote=allow_remote)
+    for d in report["diffs"]:
+        if d["kind"] == "only_in":
+            print("ONLY IN %s: %s" % (d["detail"], d["path"]), file=out)
+        elif d["kind"] == "shape":
+            print("SHAPE %s: %s" % (d["path"], d["detail"]), file=out)
+        else:
+            print("DIFF %s: %s" % (d["path"], d["detail"]), file=out)
+    if report["identical"]:
+        print("snapshots match (threshold %g, %d leaves)"
+              % (threshold, report["n_leaves"]), file=out)
+    return 0 if report["identical"] else 1
 
 
 def main(argv=None):
@@ -70,11 +90,26 @@ def main(argv=None):
     p.add_argument("snapshot_b")
     p.add_argument("--threshold", type=float, default=0.0,
                    help="max tolerated abs elementwise diff")
+    p.add_argument("--ignore", action="append", default=[],
+                   metavar="PREFIX",
+                   help="exclude leaf paths starting with PREFIX "
+                   "(repeatable), e.g. --ignore /decision")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json prints the full machine-readable report")
     p.add_argument("--allow-remote-snapshot", action="store_true",
                    help="opt in to comparing http(s) snapshot URLs "
                    "(pickle import runs code)")
     args = p.parse_args(argv)
+    if args.format == "json":
+        report = diff_report(args.snapshot_a, args.snapshot_b,
+                             threshold=args.threshold,
+                             ignore=tuple(args.ignore),
+                             allow_remote=args.allow_remote_snapshot)
+        json.dump(report, sys.stdout, indent=2)
+        print()
+        return 0 if report["identical"] else 1
     return compare(args.snapshot_a, args.snapshot_b, args.threshold,
+                   ignore=tuple(args.ignore),
                    allow_remote=args.allow_remote_snapshot)
 
 

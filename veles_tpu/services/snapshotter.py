@@ -11,6 +11,8 @@ codecs none/gz/bz2/xz (ref :365-380), and the ``_current`` symlink
 
 import bz2
 import gzip
+import hashlib
+import json
 import lzma
 import os
 import pickle
@@ -32,6 +34,95 @@ CODECS = {
     "xz": (lambda p: lzma.open(p, "wb"), lambda p: lzma.open(p, "rb"),
            ".xz"),
 }
+
+#: sidecar filename suffix for the per-checkpoint integrity manifest
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_FORMAT = 1
+
+
+class SnapshotIntegrityError(ValueError):
+    """A checkpoint failed its integrity manifest — torn commit,
+    truncation, or bit rot.  Restore paths treat it exactly like any
+    other load failure: quarantine and step back to the previous
+    commit (``--snapshot auto`` / the supervisor restart loop)."""
+
+
+def iter_state_leaves(obj, prefix=""):
+    """Flatten nested dict/list/tuple snapshot state into sorted
+    (path, leaf) pairs — shared by the integrity manifest below and
+    scripts.compare_snapshots' leaf-by-leaf diff, so "what the
+    verifier compares" and "what the manifest checksums" can never
+    drift apart."""
+    if isinstance(obj, dict):
+        for k in sorted(obj, key=str):
+            yield from iter_state_leaves(obj[k], "%s/%s" % (prefix, k))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from iter_state_leaves(v, "%s[%d]" % (prefix, i))
+    else:
+        yield prefix or "/", obj
+
+
+def _leaf_digest(value):
+    """Checksum one state leaf.  Arrays hash their raw bytes (plus
+    shape/dtype so a reinterpreted buffer can't pass); everything else
+    hashes its repr — exact for python scalars, which repr round-trips
+    bit-perfectly."""
+    import numpy as np
+    if isinstance(value, np.ndarray) or isinstance(value, np.generic):
+        a = np.ascontiguousarray(value)
+        return {"sha256": hashlib.sha256(a.tobytes()).hexdigest(),
+                "shape": list(a.shape), "dtype": str(a.dtype)}
+    return {"sha256": hashlib.sha256(repr(value).encode()).hexdigest()}
+
+
+def state_manifest(state):
+    """Per-leaf checksum manifest of a snapshot state dict."""
+    return {"format": MANIFEST_FORMAT,
+            "created": time.time(),
+            "leaves": {path: _leaf_digest(v)
+                       for path, v in iter_state_leaves(state)}}
+
+
+def validate_state_manifest(state, manifest, source="snapshot"):
+    """Recompute every leaf digest of a loaded state and compare with
+    its manifest; raises :class:`SnapshotIntegrityError` naming the
+    first few mismatches."""
+    recorded = manifest.get("leaves", {})
+    live = {path: _leaf_digest(v) for path, v in iter_state_leaves(state)}
+    bad = []
+    for path in sorted(set(recorded) | set(live)):
+        if recorded.get(path) != live.get(path):
+            bad.append(path)
+    if bad:
+        raise SnapshotIntegrityError(
+            "%s failed its integrity manifest: %d leaf mismatch(es), "
+            "first: %s" % (source, len(bad), ", ".join(bad[:5])))
+
+
+def _file_sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _load_manifest(path):
+    """The checkpoint's manifest sidecar, or None (legacy checkpoint,
+    unreadable sidecar — both degrade to unvalidated load)."""
+    try:
+        with open(path + MANIFEST_SUFFIX) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json_atomic(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
 
 
 class SnapshotterRegistry(UnitRegistry, MappedRegistry):
@@ -65,6 +156,24 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         #: atexit hook joins the in-flight write so process exit can
         #: never truncate a checkpoint.
         self.async_write = kwargs.get("async_write", False)
+        #: crash-consistency knobs (docs/distributed_training.md
+        #: "Preemption-safe training"): keep_last bounds the on-disk
+        #: checkpoint ring (0 = unlimited); commit_retries/
+        #: retry_backoff_ms retry the commit write on transient
+        #: filesystem errors (NFS hiccups, EBUSY on shared storage)
+        #: before surfacing; manifest=True writes a per-leaf checksum
+        #: sidecar validated on restore, so a torn or bit-rotted
+        #: checkpoint is DETECTED instead of silently resuming garbage.
+        self.keep_last = int(kwargs.get(
+            "keep_last", root.common.snapshot.get("keep_last", 5)))
+        self.commit_retries = max(1, int(kwargs.get(
+            "commit_retries",
+            root.common.snapshot.get("commit_retries", 3))))
+        self.retry_backoff = float(kwargs.get(
+            "retry_backoff_ms",
+            root.common.snapshot.get("retry_backoff_ms", 100.0))) / 1e3
+        self.manifest = bool(kwargs.get(
+            "manifest", root.common.snapshot.get("manifest", True)))
         #: optional run condition (a Bool or callable) checked INSIDE
         #: run() instead of via gate_skip: the unit must execute every
         #: cycle so the multi-host preemption agreement below runs
@@ -205,13 +314,130 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         # atomic: a crash mid-write leaves the previous snapshot intact
         # and _current never points at a partial file
         tmp = path + ".tmp"
-        with opener(tmp) as f:
-            pickle.dump(state, f, protocol=4)
-        os.replace(tmp, path)
+
+        def commit():
+            with opener(tmp) as f:
+                pickle.dump(state, f, protocol=4)
+            os.replace(tmp, path)
+
+        self._commit_with_retries(commit, path)
+        if self.manifest:
+            # manifest AFTER the data rename, BEFORE the _current flip:
+            # a checkpoint is only reachable once both exist, and a
+            # crash between the two leaves a manifest-less (legacy-
+            # validated) file that the next commit's flip supersedes
+            manifest = state_manifest(state)
+            manifest["file_sha256"] = _file_sha256(path)
+            self._commit_with_retries(
+                lambda: _write_json_atomic(path + MANIFEST_SUFFIX,
+                                           manifest),
+                path + MANIFEST_SUFFIX)
         self._flip_current(fname)
+        self._prune_ring()
         self.destination = path   # only once the file is complete
         self.info("snapshot -> %s", path)
         self._flight_commit(path)
+
+    def _commit_with_retries(self, fn, dest, exceptions=(OSError,)):
+        """Run one commit step, retrying transient filesystem errors
+        with exponential backoff — a shared-storage hiccup during a
+        checkpoint must cost a retry, not the checkpoint."""
+        delay = self.retry_backoff
+        for attempt in range(1, self.commit_retries + 1):
+            try:
+                return fn()
+            except exceptions as e:
+                if attempt == self.commit_retries:
+                    raise
+                from veles_tpu.telemetry import flight
+                flight.record("snapshot.retry", destination=dest,
+                              attempt=attempt,
+                              error="%s: %s" % (type(e).__name__, e))
+                self.warning(
+                    "transient error committing %s (attempt %d/%d): "
+                    "%s — retrying in %.2fs", dest, attempt,
+                    self.commit_retries, e, delay)
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+    # ------------------------------------------------- keep-last-N ring
+    def _ring_entries(self):
+        """This prefix's committed checkpoints (data files/dirs only —
+        no _current, manifests, quarantined .corrupt or .tmp leftovers),
+        newest first by mtime."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if not n.startswith(self.prefix + "_") \
+                    or n.endswith("_current") \
+                    or n.endswith(MANIFEST_SUFFIX) \
+                    or n.endswith(".corrupt") or ".tmp" in n:
+                continue
+            p = os.path.join(self.directory, n)
+            try:
+                out.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+        out.sort(reverse=True)
+        return [p for _, p in out]
+
+    def _prune_ring(self):
+        """Bound the on-disk checkpoint ring to the newest keep_last
+        commits (plus whatever _current points at — the resume anchor
+        is never collected, even if mtimes lie).  Best-effort: pruning
+        failures must never fail the commit that triggered them."""
+        if self.keep_last <= 0:
+            return
+        current = os.path.join(self.directory,
+                               "%s_current" % self.prefix)
+        try:
+            anchor = os.path.realpath(current) \
+                if os.path.islink(current) else None
+        except OSError:
+            anchor = None
+        for path in self._ring_entries()[self.keep_last:]:
+            if anchor and os.path.realpath(path) == anchor:
+                continue
+            try:
+                self._remove_checkpoint(path)
+                # info, not debug: the ring DELETES data — retention
+                # must be visible in every training log (keep_last=0
+                # disables the ring entirely)
+                self.info("pruned old checkpoint %s (keep_last=%d)",
+                          path, self.keep_last)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _remove_checkpoint(path):
+        if os.path.isdir(path):
+            import shutil
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+        manifest = path + MANIFEST_SUFFIX
+        if os.path.exists(manifest):
+            os.remove(manifest)
+
+    @staticmethod
+    def quarantine(path):
+        """Rename a checkpoint that failed to load/validate to
+        ``<name>.corrupt`` (manifest rides along) so restart loops stop
+        re-trying it and ring pruning/fallback scans skip it.  Returns
+        the new path, or None when the rename was impossible."""
+        real = os.path.realpath(path)
+        try:
+            target = real + ".corrupt"
+            os.replace(real, target)
+            if os.path.exists(real + MANIFEST_SUFFIX):
+                os.replace(real + MANIFEST_SUFFIX,
+                           target + MANIFEST_SUFFIX)
+            return target
+        except OSError:
+            return None
 
     def _flight_commit(self, destination):
         """Snapshot commits join the flight record: in a post-mortem the
@@ -305,13 +531,38 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                 os.path.exists(os.path.join(real, "state.pickle")):
             # an .orbax checkpoint DIRECTORY (sharded backend)
             return OrbaxSnapshotter.import_dir(real)
+        manifest = _load_manifest(real)
+        file_verified = False
+        if manifest is not None and "file_sha256" in manifest:
+            # file digest BEFORE any unpickling: a pickle import runs
+            # code, so a torn/corrupted checkpoint must be rejected
+            # without ever feeding its bytes to the unpickler
+            digest = _file_sha256(real)
+            if digest != manifest["file_sha256"]:
+                raise SnapshotIntegrityError(
+                    "checkpoint %s failed its integrity manifest: file "
+                    "sha256 %s != recorded %s (torn or corrupted "
+                    "commit)" % (real, digest[:16],
+                                 manifest["file_sha256"][:16]))
+            file_verified = True
+        state = None
         for codec, (_, opener, ext) in CODECS.items():
             if real.endswith(".pickle" + ext) and (ext or
                                                    real.endswith(".pickle")):
                 with opener(real) as f:
-                    return pickle.load(f)
-        with open(real, "rb") as f:   # best effort: plain pickle
-            return pickle.load(f)
+                    state = pickle.load(f)
+                break
+        if state is None:
+            with open(real, "rb") as f:   # best effort: plain pickle
+                state = pickle.load(f)
+        if manifest is not None and not file_verified:
+            # leaf-level validation only when the cheaper whole-file
+            # digest was unavailable (legacy manifest): the leaf
+            # digests were derived from exactly the bytes the file
+            # hash just covered, so re-hashing every array would
+            # double resume-time hashing for nothing
+            validate_state_manifest(state, manifest, source=real)
+        return state
 
     def get_metric_values(self):
         return {"snapshot": self.destination}
@@ -328,6 +579,11 @@ class TrainingSnapshotter(SnapshotterBase):
         self.decision = None
 
     def collect(self):
+        # drain queued fused-dispatch steps FIRST: with
+        # steps_per_dispatch > 1 the loader offset already covers the
+        # queued minibatches, so params gathered without a flush would
+        # lag the recorded position — an inexact (silently wrong) resume
+        self.trainer.flush()
         state = {
             "params": self.trainer.host_params(),
             "velocity": self.trainer.host_velocity(),
@@ -337,6 +593,12 @@ class TrainingSnapshotter(SnapshotterBase):
             # per-step RNG position: without it a resumed run would replay
             # already-consumed dropout/stochastic-pooling keys
             "step_counter": self.trainer._step_counter,
+            # mid-sweep class-stat accumulators: a preemption checkpoint
+            # lands at a cycle boundary INSIDE an epoch, and without
+            # these the resumed epoch's stats would only cover the
+            # post-resume minibatches — the decision's metric for that
+            # epoch would diverge from an uninterrupted run
+            "trainer_stats": jax.device_get(self.trainer.class_stats),
         }
         if self.decision is not None:
             state["decision"] = {
@@ -344,6 +606,9 @@ class TrainingSnapshotter(SnapshotterBase):
                 "best_epoch": self.decision.best_epoch,
                 "epochs_since_improvement":
                     self.decision.epochs_since_improvement,
+                # class sweeps already read this epoch (test/valid done,
+                # train in flight) — same mid-sweep exactness story
+                "epoch_metrics": list(self.decision.epoch_metrics),
             }
         return state
 
@@ -363,12 +628,24 @@ class TrainingSnapshotter(SnapshotterBase):
         trainer._step_counter = snapshot.get("step_counter", 0)
         loader.state = snapshot["loader"]
         prng.restore_states(snapshot["prng"])
+        if "trainer_stats" in snapshot and \
+                getattr(trainer, "mesh_config", None) is None:
+            # mid-sweep accumulators (see collect); under a mesh the
+            # replicated mirrors are re-placed by _shard_pins instead —
+            # skipped there, so a sharded mid-epoch resume restarts the
+            # interrupted sweep's stats (params/PRNG/loader stay exact)
+            import jax.numpy as jnp
+            trainer.class_stats = [
+                jax.tree_util.tree_map(jnp.asarray, s)
+                for s in snapshot["trainer_stats"]]
         dec = getattr(workflow, "decision", None)
         if dec is not None and "decision" in snapshot:
             d = snapshot["decision"]
             dec.best_metric = d["best_metric"]
             dec.best_epoch = d["best_epoch"]
             dec.epochs_since_improvement = d["epochs_since_improvement"]
+            if "epoch_metrics" in d:
+                dec.epoch_metrics = list(d["epoch_metrics"])
 
     @staticmethod
     def warm_start(workflow, snapshot):
@@ -458,7 +735,12 @@ class DBSnapshotter(TrainingSnapshotter):
         conn.execute(
             "CREATE TABLE IF NOT EXISTS snapshots ("
             " id INTEGER PRIMARY KEY AUTOINCREMENT,"
-            " prefix TEXT, suffix TEXT, created REAL, state BLOB)")
+            " prefix TEXT, suffix TEXT, created REAL, state BLOB,"
+            " sha256 TEXT)")
+        try:      # pre-integrity databases: widen in place
+            conn.execute("ALTER TABLE snapshots ADD COLUMN sha256 TEXT")
+        except sqlite3.OperationalError:
+            pass  # already has the column
         return conn
 
     def export(self):
@@ -469,38 +751,74 @@ class DBSnapshotter(TrainingSnapshotter):
         return dest
 
     def _db_write(self, state, suffix, dest):
+        import sqlite3
         blob = pickle.dumps(state, protocol=4)
-        conn = self._connect()
-        try:
-            with conn:
-                conn.execute(
-                    "INSERT INTO snapshots (prefix, suffix, created, state)"
-                    " VALUES (?, ?, ?, ?)",
-                    (self.prefix, suffix, time.time(), blob))
-        finally:
-            conn.close()
+        digest = hashlib.sha256(blob).hexdigest()
+
+        def commit():
+            conn = self._connect()
+            try:
+                with conn:
+                    conn.execute(
+                        "INSERT INTO snapshots"
+                        " (prefix, suffix, created, state, sha256)"
+                        " VALUES (?, ?, ?, ?, ?)",
+                        (self.prefix, suffix, time.time(), blob, digest))
+                    if self.keep_last > 0:
+                        # the ring, in-transaction: the insert and the
+                        # prune commit (or roll back) together
+                        conn.execute(
+                            "DELETE FROM snapshots WHERE prefix = ? AND"
+                            " id NOT IN (SELECT id FROM snapshots WHERE"
+                            " prefix = ? ORDER BY id DESC LIMIT ?)",
+                            (self.prefix, self.prefix, self.keep_last))
+            finally:
+                conn.close()
+
+        self._commit_with_retries(
+            commit, dest, exceptions=(OSError, sqlite3.OperationalError))
         self.destination = dest   # only once the row is committed
         self.info("snapshot -> %s", dest)
         self._flight_commit(dest)
 
     @staticmethod
     def import_db(dsn, prefix=None):
-        """Load the most recent snapshot (optionally for one prefix)."""
+        """Load the most recent VALID snapshot (optionally for one
+        prefix): a row whose blob fails its recorded sha256 — a torn
+        write the sqlite journal could not cover, or bit rot — is
+        skipped with a warning and the previous row is tried, the
+        db-backend twin of the file fallback."""
+        import logging
         import sqlite3
         conn = sqlite3.connect(dsn)
         try:
-            q = "SELECT state FROM snapshots"
+            q = "SELECT id, state, sha256 FROM snapshots"
             args = ()
             if prefix is not None:
                 q += " WHERE prefix = ?"
                 args = (prefix,)
-            q += " ORDER BY id DESC LIMIT 1"
-            row = conn.execute(q, args).fetchone()
+            q += " ORDER BY id DESC"
+            # iterate the cursor: only one blob resident at a time (a
+            # ring of multi-GB checkpoints must not all materialize
+            # just to validate the newest row)
+            seen = False
+            for row_id, blob, digest in conn.execute(q, args):
+                seen = True
+                if digest is not None and \
+                        hashlib.sha256(blob).hexdigest() != digest:
+                    logging.getLogger("Snapshotter").warning(
+                        "snapshot row %d in %s failed its sha256 — "
+                        "torn or corrupted; trying the previous row",
+                        row_id, dsn)
+                    continue
+                return pickle.loads(blob)
         finally:
             conn.close()
-        if row is None:
+        if not seen:
             raise KeyError("no snapshot in %s (prefix=%r)" % (dsn, prefix))
-        return pickle.loads(row[0])
+        raise SnapshotIntegrityError(
+            "every snapshot row in %s (prefix=%r) failed its sha256"
+            % (dsn, prefix))
 
 
 class OrbaxSnapshotter(TrainingSnapshotter):
@@ -586,6 +904,10 @@ class OrbaxSnapshotter(TrainingSnapshotter):
             "prng": prng.states(),
             "epoch": self.loader.epoch_number,
             "step_counter": t._step_counter,
+            # mid-sweep accumulators (see TrainingSnapshotter.collect);
+            # a handful of scalars — the no-gather contract is about
+            # the param/velocity trees
+            "trainer_stats": jax.device_get(t.class_stats),
         }
         if self.decision is not None:
             state["decision"] = {
@@ -593,6 +915,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
                 "best_epoch": self.decision.best_epoch,
                 "epochs_since_improvement":
                     self.decision.epochs_since_improvement,
+                "epoch_metrics": list(self.decision.epoch_metrics),
             }
         return state
 
@@ -613,6 +936,18 @@ class OrbaxSnapshotter(TrainingSnapshotter):
             # contract)
             with open(os.path.join(path, "state.pickle"), "wb") as f:
                 pickle.dump(state, f, protocol=4)
+            if self.manifest:
+                # integrity sidecar: per-leaf checksums for the pickle
+                # sidecar, STRUCTURE (paths/shapes/dtypes) for the
+                # array trees — checksumming the arrays would force the
+                # device→host gather this backend exists to avoid;
+                # torn array writes are orbax's own finalization gate
+                man = state_manifest(state)
+                man["arrays"] = {
+                    p: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                    for p, v in iter_state_leaves(arrays)}
+                _write_json_atomic(
+                    os.path.join(path, "manifest.json"), man)
         ckptr = self._checkpointer()
         # orbax finalizes arrays/ atomically (tmp dir + rename) and,
         # under multi-host, coordinates the commit across processes
@@ -664,6 +999,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
                 "points at the previous snapshot" % path)
         if jax.process_index() == 0:
             self._flip_current(name)
+            self._prune_ring()
         self.destination = path   # only once the commit is final
         self.info("snapshot -> %s", path)
         self._flight_commit(path)
@@ -706,8 +1042,13 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         import orbax.checkpoint as ocp
         arrays_path = os.path.join(path, "arrays")
         ckptr = ocp.PyTreeCheckpointer()
-        meta = ckptr.metadata(arrays_path).item_metadata
-        tree = getattr(meta, "tree", meta)
+        # metadata() API drift across pinned orbax versions: 0.7.x
+        # returns the bare metadata TREE (a dict of ArrayMetadata),
+        # later versions wrap it (.item_metadata, sometimes again in
+        # .tree) — unwrap whatever is there down to the tree
+        meta = ckptr.metadata(arrays_path)
+        tree = getattr(meta, "item_metadata", meta)
+        tree = getattr(tree, "tree", tree)
         restore_args = jax.tree_util.tree_map(
             lambda m: ocp.RestoreArgs(restore_type=np.ndarray), tree)
         arrays = ckptr.restore(
@@ -716,5 +1057,25 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         ckptr.close()
         with open(os.path.join(path, "state.pickle"), "rb") as f:
             state = pickle.load(f)
+        manifest = None
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            pass                      # legacy checkpoint: unvalidated
+        if manifest is not None:
+            validate_state_manifest(
+                state, manifest,
+                source=os.path.join(path, "state.pickle"))
+            recorded = manifest.get("arrays", {})
+            live = {p: {"shape": list(np.shape(v)),
+                        "dtype": str(np.asarray(v).dtype)}
+                    for p, v in iter_state_leaves(arrays)}
+            if recorded and recorded != live:
+                bad = [p for p in sorted(set(recorded) | set(live))
+                       if recorded.get(p) != live.get(p)]
+                raise SnapshotIntegrityError(
+                    "%s failed its array-structure manifest: %s"
+                    % (path, ", ".join(bad[:5])))
         state.update(arrays)
         return state

@@ -1,0 +1,404 @@
+"""Supervisor — the training plane's respawn loop (the Veles paper's
+*Launcher* role mapped onto checkpoint-restart).
+
+The serving plane survives kills because PRs 6–7 put a router and a
+drain state machine around every engine; the training plane's
+equivalent is this: one parent process that spawns the training
+command, watches how it dies, and respawns it so ``--snapshot auto``
+resumes from the last committed checkpoint.  The pieces it composes
+already exist — SIGTERM → graceful preemption checkpoint → exit 75
+(``__main__``), the ``_current`` symlink + torn-checkpoint fallback,
+and the flight-recorder crashdumps — this module is the policy that
+makes them a *survival loop* instead of a manual runbook:
+
+* **exit 0** — training finished; done.
+* **exit 75** (EX_TEMPFAIL, graceful preemption) — respawn
+  immediately, unbounded: preemptions are the *normal* lifecycle on
+  scheduled TPU pods, and each one left a fresh checkpoint.
+* **killed by signal** (SIGKILL — OOM killer, hard preemption) —
+  respawn with exponential backoff; counts against the crash-loop
+  window.
+* **nonzero exit** — consult the newest crashdump the child left
+  (``artifacts/crashdump-*``): a ``fault.injected`` event means the
+  chaos drill killed it (respawn); an excepthook error gives the crash
+  a *signature*, and ``deterministic_limit`` consecutive identical
+  signatures with **zero checkpoint progress** give up early — a
+  deterministic bug replays identically from the same checkpoint, and
+  restarting it only burns the restart budget.
+* **crash-loop valve** — more than ``max_restarts`` bounded respawns
+  (kills + faults + crashes; preemptions are exempt) inside
+  ``window_seconds`` give up with the child's exit code.
+
+Progress is measured on the snapshot directory: any respawn that
+advanced a checkpoint resets the backoff and the deterministic-bug
+counter — a run that keeps committing is *working*, however it keeps
+dying.  Config: ``root.common.supervise.*``; CLI: ``--supervise``;
+chaos gate: ``tools/train_chaos.py`` (docs/distributed_training.md
+"Preemption-safe training")."""
+
+import json
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from veles_tpu.config import root
+from veles_tpu.telemetry import flight
+
+#: EX_TEMPFAIL — the graceful-preemption exit code (__main__)
+EX_TEMPFAIL = 75
+
+
+class Supervisor(object):
+    """Spawn/respawn one training command under the policy above.
+
+    :param argv: the full child command line (e.g.
+        ``[sys.executable, "-m", "veles_tpu", "wf.py", "--snapshot",
+        "auto", ...]``).
+    :param progress_paths: files/directories whose newest mtime is the
+        checkpoint-progress signal (typically the snapshot directory).
+    :param log_dir: when set, each attempt's stdout+stderr goes to
+        ``attempt-NNN.log`` inside it (the chaos harness reads these);
+        default inherits the supervisor's own stdio.
+    :param install_signals: forward SIGTERM/SIGINT to the child and
+        stop respawning (pod preemption of the supervisor itself);
+        defaults to True on the main thread, forced off elsewhere.
+    """
+
+    def __init__(self, argv, max_restarts=None, window_seconds=None,
+                 backoff_base_ms=None, backoff_max_ms=None,
+                 deterministic_limit=None, blackbox_dir=None,
+                 progress_paths=(), log_dir=None, env=None,
+                 install_signals=True, seed=None):
+        def knob(value, key, default):
+            if value is not None:
+                return value
+            return root.common.supervise.get(key, default)
+
+        self.argv = list(argv)
+        self.max_restarts = int(knob(max_restarts, "max_restarts", 8))
+        self.window_seconds = float(
+            knob(window_seconds, "window_seconds", 600))
+        self.backoff_base = float(
+            knob(backoff_base_ms, "backoff_base_ms", 200)) / 1e3
+        self.backoff_max = float(
+            knob(backoff_max_ms, "backoff_max_ms", 30000)) / 1e3
+        self.deterministic_limit = int(
+            knob(deterministic_limit, "deterministic_limit", 3))
+        self.blackbox_dir = (blackbox_dir if blackbox_dir is not None
+                             else root.common.blackbox.get(
+                                 "dir", "artifacts"))
+        self.progress_paths = list(progress_paths)
+        self.log_dir = log_dir
+        self.env = env
+        self.install_signals = bool(install_signals)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._child = None
+        self._stopping = False
+        self._log = logging.getLogger("Supervisor")
+        #: one entry per completed attempt:
+        #: {"pid", "rc", "kind", "signature", "spawned", "ended"}
+        self.history = []
+        self.spawn_count = 0
+        self.last_spawn_ts = None
+        self.restarts = {"preempt": 0, "killed": 0,
+                         "fault-injection": 0, "crash": 0}
+
+    # ----------------------------------------------------------- surface
+    def current_pid(self):
+        """The live child's pid, or None — the chaos harness's kill
+        target."""
+        with self._lock:
+            if self._child is not None and self._child.poll() is None:
+                return self._child.pid
+        return None
+
+    def stop(self):
+        """Stop respawning and SIGTERM the live child (graceful: it
+        checkpoints and exits 75; run() then returns)."""
+        self._stopping = True
+        with self._lock:
+            child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    def run(self):
+        """Supervise until the child finishes, the crash-loop valve
+        trips, or stop()/SIGTERM; returns the final exit code."""
+        prev = {}
+        if self.install_signals and \
+                threading.current_thread() is threading.main_thread():
+            def forward(signum, frame):
+                # stop respawning FIRST, then relay: the child's own
+                # SIGTERM path checkpoints and exits 75
+                self.stop() if signum == signal.SIGTERM \
+                    else self._kill_child(signum)
+            for s in (signal.SIGTERM, signal.SIGINT):
+                prev[s] = signal.signal(s, forward)
+        try:
+            return self._loop()
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+    def _kill_child(self, signum):
+        self._stopping = True
+        with self._lock:
+            child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signum)
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- loop
+    def _loop(self):
+        consecutive = 0          # bounded respawns since last progress
+        last_signature = None
+        same_signature = 0
+        window = []              # timestamps of bounded respawns
+        while True:
+            marker = self._progress_marker()
+            spawned = time.time()
+            try:
+                child, attempt_log = self._spawn()
+            except OSError as e:
+                # fork/exec itself can fail transiently (ENOMEM/EAGAIN
+                # in the very OOM storms this loop exists to ride out)
+                # — that is one more bounded, backed-off respawn, not
+                # the end of supervision
+                self._error("spawn failed (%s: %s)",
+                            type(e).__name__, e)
+                flight.record("supervisor.spawn_error", error=str(e))
+                now = time.time()
+                window = [t for t in window
+                          if now - t < self.window_seconds]
+                window.append(now)
+                if len(window) > self.max_restarts or self._stopping:
+                    flight.record("supervisor.giveup",
+                                  reason="spawn-error")
+                    return 1
+                consecutive += 1
+                time.sleep(self.backoff_delay(consecutive))
+                continue
+            rc = child.wait()
+            if attempt_log is not None:
+                attempt_log.close()
+            kind, signature = self._classify(rc, spawned)
+            self.history.append({
+                "pid": child.pid, "rc": rc, "kind": kind,
+                "signature": signature, "spawned": spawned,
+                "ended": time.time()})
+            flight.record("supervisor.exit", pid=child.pid, rc=rc,
+                          cause=kind)
+            if kind == "done":
+                self._info("child pid %d finished cleanly", child.pid)
+                return 0
+            if self._stopping:
+                self._info("stopping — child pid %d exited %s (%s), "
+                           "not respawning", child.pid, rc, kind)
+                return rc
+            progressed = self._progress_marker() != marker
+            if progressed:
+                consecutive = 0
+                same_signature, last_signature = 0, None
+            if kind == "preempt":
+                # graceful preemption left a fresh checkpoint: the
+                # normal pod lifecycle — respawn now, never bounded
+                self.restarts["preempt"] += 1
+                flight.record("supervisor.respawn", cause=kind,
+                              delay_s=0.0)
+                self._info("child pid %d preempted (exit 75) — "
+                           "respawning immediately", child.pid)
+                continue
+            bucket = ("killed" if kind.startswith("killed")
+                      else kind if kind == "fault-injection"
+                      else "crash")
+            self.restarts[bucket] += 1
+            if bucket == "crash":
+                if signature is not None and \
+                        signature == last_signature:
+                    same_signature += 1
+                else:
+                    same_signature, last_signature = 1, signature
+                if same_signature >= self.deterministic_limit:
+                    self._error(
+                        "giving up: %d consecutive identical crashes "
+                        "(%s) with no checkpoint progress — a "
+                        "deterministic bug replays the same way from "
+                        "the same checkpoint; restarting will not help",
+                        same_signature, signature)
+                    flight.record("supervisor.giveup",
+                                  reason="deterministic-bug",
+                                  signature=signature, rc=rc)
+                    return rc or 1
+            now = time.time()
+            window = [t for t in window
+                      if now - t < self.window_seconds]
+            window.append(now)
+            if len(window) > self.max_restarts:
+                self._error(
+                    "giving up: %d bounded respawns within %.0fs "
+                    "(max %d) — crash loop", len(window),
+                    self.window_seconds, self.max_restarts)
+                flight.record("supervisor.giveup", reason="crash-loop",
+                              restarts=len(window), rc=rc)
+                return rc or 1
+            consecutive += 1
+            delay = self.backoff_delay(consecutive)
+            flight.record("supervisor.respawn", cause=kind,
+                          delay_s=delay)
+            self._info("child pid %d died (%s, rc=%s)%s — respawn "
+                       "#%d in %.2fs", child.pid, kind, rc,
+                       " after checkpoint progress" if progressed
+                       else "", consecutive, delay)
+            deadline = time.time() + delay
+            while time.time() < deadline and not self._stopping:
+                time.sleep(min(0.05, max(deadline - time.time(), 0)))
+            if self._stopping:
+                return rc
+
+    def backoff_delay(self, attempt):
+        """Exponential backoff with jitter: base·2^(n-1) capped at
+        backoff_max, scaled by [0.5, 1.0) — test-pinned (the same
+        shape as the fleet router's)."""
+        d = min(self.backoff_base * (2 ** max(attempt - 1, 0)),
+                self.backoff_max)
+        return d * (0.5 + 0.5 * self._rng.random())
+
+    # ------------------------------------------------------------- spawn
+    def _spawn(self):
+        self.spawn_count += 1
+        attempt_log = None
+        stdout = stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            attempt_log = open(
+                os.path.join(self.log_dir,
+                             "attempt-%03d.log" % self.spawn_count),
+                "wb")
+            stdout = stderr = attempt_log
+        try:
+            child = subprocess.Popen(self.argv, env=self.env,
+                                     stdout=stdout, stderr=stderr)
+        except OSError:
+            if attempt_log is not None:
+                attempt_log.close()
+            raise
+        with self._lock:
+            self._child = child
+        self.last_spawn_ts = time.time()
+        flight.record("supervisor.spawn", pid=child.pid,
+                      attempt=self.spawn_count)
+        self._info("spawned pid %d (attempt %d)", child.pid,
+                   self.spawn_count)
+        return child, attempt_log
+
+    # ---------------------------------------------------- classification
+    def _classify(self, rc, spawned):
+        """(kind, crash_signature) for one child exit — the crashdump
+        the child left behind distinguishes an injected/forced death
+        from a deterministic bug."""
+        if rc == 0:
+            return "done", None
+        if rc == EX_TEMPFAIL:
+            return "preempt", None
+        if rc < 0:
+            try:
+                name = signal.Signals(-rc).name
+            except ValueError:
+                name = "SIG%d" % -rc
+            return "killed:%s" % name, None
+        events, meta = self._read_crashdump(spawned)
+        for ev in events:
+            if ev.get("kind") == "fault.injected":
+                return "fault-injection", None
+        err = (meta or {}).get("error")
+        if err:
+            sig = "%s:%s" % (err.get("type"), err.get("message"))
+            return "crash:%s" % err.get("type"), sig
+        return "crash:rc%d" % rc, "rc%d" % rc
+
+    def _read_crashdump(self, since):
+        """events + meta of the newest crashdump written after
+        ``since``, or ([], None).  ``since`` is this attempt's spawn
+        time on the SAME clock that stamps the dump's mtime, so no
+        slop is needed — and none is allowed: a previous attempt's
+        dump lands between its exit and this spawn, and any slop
+        window shorter backoffs can fit into would attribute that
+        stale dump (and its signature) to the wrong death.  Never
+        raises — forensics inform the policy, they must not crash
+        it."""
+        try:
+            newest, newest_ts = None, since
+            for name in os.listdir(self.blackbox_dir):
+                if not name.startswith("crashdump-") \
+                        or ".tmp-" in name:
+                    continue
+                path = os.path.join(self.blackbox_dir, name)
+                ts = os.path.getmtime(path)
+                if ts >= newest_ts:
+                    newest, newest_ts = path, ts
+            if newest is None:
+                return [], None
+            events = []
+            with open(os.path.join(newest, "events.jsonl")) as f:
+                for line in f:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue
+            meta = None
+            try:
+                with open(os.path.join(newest, "meta.json")) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                pass
+            return events, meta
+        except OSError:
+            return [], None
+
+    # ----------------------------------------------------------- helpers
+    def _progress_marker(self):
+        """Newest mtime across the progress paths (shallow scan of
+        directories) — checkpoint commits move it forward."""
+        newest = None
+        for path in self.progress_paths:
+            try:
+                if os.path.isdir(path):
+                    with os.scandir(path) as entries:
+                        for e in entries:
+                            try:
+                                # no follow: quarantine leaves _current
+                                # DANGLING until the next commit, and
+                                # one bad symlink must not hide the
+                                # rest of the directory's mtimes
+                                ts = e.stat(
+                                    follow_symlinks=False).st_mtime
+                            except OSError:
+                                continue
+                            if newest is None or ts > newest:
+                                newest = ts
+                else:
+                    ts = os.path.getmtime(path)
+                    if newest is None or ts > newest:
+                        newest = ts
+            except OSError:
+                continue
+        return newest
+
+    def _info(self, msg, *args):
+        self._log.info(msg, *args)
+        print("[supervisor] " + msg % args, file=sys.stderr, flush=True)
+
+    def _error(self, msg, *args):
+        self._log.error(msg, *args)
+        print("[supervisor] " + msg % args, file=sys.stderr, flush=True)

@@ -198,6 +198,12 @@ class Workflow(Container):
         # hot-loop hoists: one attribute lookup per run, not per unit
         fl_record = flight.record
         note_progress = health.note_progress
+        # chaos knob (tools/train_chaos.py): a per-unit-run sleep that
+        # stretches the scheduler so external kills reliably land
+        # mid-sweep.  Zero (the default) costs one config read per run()
+        from veles_tpu.config import root as _root
+        unit_delay = float(
+            _root.common.chaos.get("unit_delay_ms", 0)) / 1e3
         while queue and not bool(self.stopped):
             if bool(self.preempt_requested) and not self.preempted_:
                 if can_break is None:
@@ -232,6 +238,8 @@ class Workflow(Container):
                 unit.reset_gate()
                 continue
             if not bool(unit.gate_skip):
+                if unit_delay:
+                    time.sleep(unit_delay)
                 fl_record("unit.start", unit=unit.name)
                 dt = unit._run_wrapped()
                 fl_record("unit.stop", unit=unit.name, dur_s=dt)
@@ -254,10 +262,12 @@ class Workflow(Container):
     def request_preempt(self):
         """Ask for a graceful preemption stop: checkpoint at the next
         consistent cycle boundary, then stop.  Signal-handler safe (one
-        Bool flip); the TPU-era mapping of the reference's slave
-        drop/respawn elasticity (server.py:637-655) onto
-        checkpoint-restart."""
+        Bool flip + an O(1) flight append, both reentrancy-proof); the
+        TPU-era mapping of the reference's slave drop/respawn
+        elasticity (server.py:637-655) onto checkpoint-restart."""
         self.preempt_requested.set(True)
+        # the flag flip comes FIRST — forensics must never delay it
+        flight.record("preempt.requested", workflow=self.name)
 
     def _graph_has_snapshotter(self):
         """A snapshotter anywhere in the unit graph — not just the
