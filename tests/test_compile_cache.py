@@ -63,6 +63,56 @@ def test_env_kill_switch(tmp_path, monkeypatch):
     assert not (tmp_path / "nope").exists()
 
 
+def test_cpu_backend_declines_the_automatic_default(monkeypatch):
+    """On the CPU backend the AUTOMATIC default stays off — XLA:CPU
+    executable deserialization can corrupt the heap in sandboxed
+    environments (the ROADMAP "environment flake", root-caused in
+    PR 9) — while an explicit path or env dir still opts in."""
+    monkeypatch.delenv("VELES_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    import jax
+    prev = getattr(jax.config, "jax_platforms", None)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        assert cc._cpu_backend()
+        assert cc.enable() is None
+    finally:
+        jax.config.update("jax_platforms", prev)
+
+
+def test_unpinned_run_resolves_backend_by_accelerator_evidence(
+        monkeypatch):
+    """Nothing pinned: jax auto-selects CPU on an accelerator-less
+    machine, so the decline must cover that case too — an unpinned
+    CPU-only run with the cache on is exactly the measured crash
+    configuration.  With accelerator evidence the old default (cache
+    on) stands."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    import jax
+    prev = getattr(jax.config, "jax_platforms", None)
+    try:
+        jax.config.update("jax_platforms", None)
+        monkeypatch.setattr(cc, "_accelerator_evidence", lambda: False)
+        assert cc._cpu_backend()
+        monkeypatch.setattr(cc, "_accelerator_evidence", lambda: True)
+        assert not cc._cpu_backend()
+    finally:
+        jax.config.update("jax_platforms", prev)
+
+
+def test_explicit_path_opts_in_even_on_cpu(tmp_path, monkeypatch,
+                                           restore_cache_config):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert cc.enable(str(tmp_path / "xla")) == str(tmp_path / "xla")
+
+
+def test_env_dir_opts_in_even_on_cpu(tmp_path, monkeypatch,
+                                     restore_cache_config):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("VELES_COMPILE_CACHE", str(tmp_path / "envdir"))
+    assert cc.enable() == str(tmp_path / "envdir")
+
+
 def test_env_overrides_default_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("VELES_COMPILE_CACHE", str(tmp_path / "envdir"))
     assert cc.default_dir() == str(tmp_path / "envdir")

@@ -11,6 +11,8 @@ import subprocess
 import sys
 import time
 
+from veles_tpu.services.supervisor import run_with_startup_retry
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -30,8 +32,8 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
 
     # reference: one uninterrupted run
     res_a = str(tmp_path / "a.json")
-    r = subprocess.run(_cmd(tmp_path / "snap_a", res_a), env=env, cwd=REPO,
-                       capture_output=True, text=True, timeout=420)
+    r = run_with_startup_retry(_cmd(tmp_path / "snap_a", res_a), env=env, cwd=REPO,
+                       timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     a = json.load(open(res_a))
     assert a["epochs"] == 20
@@ -54,9 +56,8 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
     assert not os.path.exists(res_b)   # it really died before finishing
 
     # leg 2: identical command line resumes from <prefix>_current
-    r2 = subprocess.run(_cmd(tmp_path / "snap_b", res_b), env=env,
-                        cwd=REPO, capture_output=True, text=True,
-                        timeout=420)
+    r2 = run_with_startup_retry(_cmd(tmp_path / "snap_b", res_b), env=env,
+                        cwd=REPO, timeout=420)
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "[auto-resume]" in r2.stderr and "fresh start" not in r2.stderr
     b = json.load(open(res_b))
@@ -72,9 +73,8 @@ def test_auto_snapshot_fresh_start(tmp_path):
     """--snapshot auto with no prior snapshot is a clean fresh start."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     res = str(tmp_path / "r.json")
-    r = subprocess.run(_cmd(tmp_path / "snap", res, max_epochs=1), env=env,
-                       cwd=REPO, capture_output=True, text=True,
-                       timeout=420)
+    r = run_with_startup_retry(_cmd(tmp_path / "snap", res, max_epochs=1), env=env,
+                       cwd=REPO, timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "fresh start" in r.stderr
     assert json.load(open(res))["epochs"] == 1
@@ -106,9 +106,9 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
 
     # reference: one uninterrupted run
     res_a = str(tmp_path / "a.json")
-    r = subprocess.run(_cmd(tmp_path / "snap_a", res_a, max_epochs=25,
+    r = run_with_startup_retry(_cmd(tmp_path / "snap_a", res_a, max_epochs=25,
                             snapshot_every=1000), env=env, cwd=REPO,
-                       capture_output=True, text=True, timeout=420)
+                       timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     a = json.load(open(res_a))
 
@@ -129,8 +129,7 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
     # supervisor-style restart of the identical command line; the
     # mid-epoch checkpoint (loader offset/order, step counter, PRNG)
     # makes the resumed run bit-identical to the uninterrupted one
-    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                       text=True, timeout=420)
+    r = run_with_startup_retry(cmd, env=env, cwd=REPO, timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "[auto-resume]" in r.stderr and "fresh start" not in r.stderr
     b = json.load(open(res))
@@ -171,9 +170,8 @@ def test_death_probability_fault_injection(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
 
     res_a = str(tmp_path / "a.json")
-    r = subprocess.run(_cmd(tmp_path / "snap_a", res_a, max_epochs=8),
-                       env=env, cwd=REPO, capture_output=True,
-                       text=True, timeout=420)
+    r = run_with_startup_retry(_cmd(tmp_path / "snap_a", res_a, max_epochs=8),
+                       env=env, cwd=REPO, timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     a = json.load(open(res_a))
 
@@ -188,11 +186,10 @@ def test_death_probability_fault_injection(tmp_path):
         res_b = str(tmp_path / ("b%d.json" % round_))
         crashes = 0
         for attempt in range(60):
-            r = subprocess.run(
+            r = run_with_startup_retry(
                 _cmd(snap, res_b, max_epochs=8)
                 + ["--death-probability", "%g" % p],
-                env=env, cwd=REPO, capture_output=True, text=True,
-                timeout=420)
+                env=env, cwd=REPO, timeout=420)
             if r.returncode == 0:
                 break
             assert r.returncode == 1, r.stderr[-1500:]
@@ -225,9 +222,9 @@ def test_kill_and_resume_with_orbax_backend(tmp_path):
         return c[:i] + ["root.common.snapshot.backend='orbax'"] + c[i:]
 
     res_a = str(tmp_path / "a.json")
-    r = subprocess.run(cmd(tmp_path / "snap_a", res_a),
+    r = run_with_startup_retry(cmd(tmp_path / "snap_a", res_a),
                        env=env, cwd=REPO,
-                       capture_output=True, text=True, timeout=420)
+                       timeout=420)
     assert r.returncode == 0, r.stderr[-2000:]
     a = json.load(open(res_a))
 
@@ -248,9 +245,9 @@ def test_kill_and_resume_with_orbax_backend(tmp_path):
     assert p.returncode != 0
     assert not os.path.exists(res_b)   # really died before finishing
 
-    r2 = subprocess.run(cmd(tmp_path / "snap_b", res_b),
+    r2 = run_with_startup_retry(cmd(tmp_path / "snap_b", res_b),
                         env=env, cwd=REPO,
-                        capture_output=True, text=True, timeout=420)
+                        timeout=420)
     assert r2.returncode == 0, r2.stderr[-2000:]
     # it must really resume from an .orbax checkpoint — the fresh-start
     # message also contains "[auto-resume]" and a fixed-seed from-

@@ -24,7 +24,9 @@ def _free_port():
     return port
 
 
-def _spawn_job(n_processes, extra=()):
+def _spawn_job(n_processes, extra=(), _retry=True):
+    from veles_tpu.services.supervisor import is_startup_flake
+
     coord = "127.0.0.1:%d" % _free_port()
     # the workers pin their own platform/devices; don't leak the parent's
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
@@ -33,7 +35,7 @@ def _spawn_job(n_processes, extra=()):
         + list(extra),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for i in range(n_processes)]
-    results = []
+    outcomes = []
     for i, p in enumerate(procs):
         try:
             out, err = p.communicate(timeout=300)
@@ -41,7 +43,16 @@ def _spawn_job(n_processes, extra=()):
             for q in procs:
                 q.kill()
             raise AssertionError("worker %d timed out" % i)
-        assert p.returncode == 0, "worker %d failed:\n%s" % (i, err[-3000:])
+        outcomes.append((p.returncode, out, err))
+    if _retry and any(is_startup_flake(*o) for o in outcomes):
+        # the documented sandbox XLA-startup abort (ROADMAP "Known
+        # environment flake"): one worker died inside backend init
+        # before any output — respawn the WHOLE job once (the peers
+        # exit nonzero too, stuck waiting on the dead coordinator)
+        return _spawn_job(n_processes, extra, _retry=False)
+    results = []
+    for i, (rc, out, err) in enumerate(outcomes):
+        assert rc == 0, "worker %d failed:\n%s" % (i, err[-3000:])
         line = next(ln for ln in out.splitlines()
                     if ln.startswith("METRICS "))
         results.append(json.loads(line[len("METRICS "):]))

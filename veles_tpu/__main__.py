@@ -279,6 +279,14 @@ class Main(object):
             # the parent never touches jax/XLA: it only spawns, watches
             # and respawns the real training command
             return self._run_supervised(args)
+        if args.backend:
+            # BEFORE compile_cache.enable(): its CPU-backend gate reads
+            # jax_platforms, and `--backend cpu` without JAX_PLATFORMS
+            # in the env would otherwise slip past it
+            import jax
+            jax.config.update(
+                "jax_platforms",
+                "cpu" if args.backend == "cpu" else args.backend)
         # persistent XLA compilation cache: re-runs of the same workflow
         # (and supervisor restarts after preemption) skip recompilation
         # — the TPU-era analogue of the reference's on-disk kernel cache
@@ -290,12 +298,7 @@ class Main(object):
             from veles_tpu import telemetry
             telemetry.registry.open_sink(args.metrics_out,
                                          dump_at_exit=True)
-        if args.backend:
-            import jax
-            jax.config.update(
-                "jax_platforms",
-                "cpu" if args.backend == "cpu" else args.backend)
-        elif args.lint:
+        if not args.backend and args.lint:
             # linting never needs an accelerator (same guard as the
             # standalone veles-tpu-lint): module-level jax use in the
             # workflow file must not lock chips on a shared host.  A

@@ -114,6 +114,38 @@ def install_metrics():
     return True
 
 
+def _accelerator_evidence():
+    """Cheap accelerator sniff WITHOUT initializing a jax backend:
+    TPU device nodes or the libtpu runtime, or NVIDIA device nodes.
+    Erring toward True only re-enables the old default (cache on)."""
+    import glob
+    import importlib.util
+    if glob.glob("/dev/accel*") or glob.glob("/dev/nvidia*"):
+        return True
+    try:
+        return importlib.util.find_spec("libtpu") is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def _cpu_backend():
+    """True when the run will land on the CPU backend: explicitly
+    pinned there (config flag or ``JAX_PLATFORMS``), or nothing pinned
+    and no accelerator evidence on the machine — jax auto-selects CPU
+    there, so an unpinned CPU-only run must decline the cache the same
+    way a pinned one does.  Read WITHOUT initializing the backend."""
+    import jax
+    try:
+        platforms = str(jax.config.jax_platforms
+                        or os.environ.get("JAX_PLATFORMS", ""))
+    except AttributeError:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+    first = platforms.split(",")[0].strip().lower()
+    if first:
+        return first == "cpu"
+    return not _accelerator_evidence()
+
+
 def default_dir():
     """Repo-local scratch: survives process restarts within a round and
     is visible to the driver's end-of-round ``bench.py`` run."""
@@ -145,6 +177,17 @@ def enable(path=None):
     install_metrics()
     env = os.environ.get("VELES_COMPILE_CACHE", "")
     if env.lower() in ("0", "off", "false", "no"):
+        return None
+    if path is None and not env and _cpu_backend():
+        # the automatic default stays OFF on the CPU backend: XLA:CPU
+        # executable DESERIALIZATION is unreliable in sandboxed/old-
+        # kernel environments (glibc heap corruption — measured ~40%
+        # of digits-MLP runs die by SIGSEGV/SIGABRT with the cache on,
+        # 0% with it off; this was ROADMAP's "known environment
+        # flake"), and a CPU compile costs seconds where a TPU
+        # recompile costs minutes.  An explicit ``path=`` argument or
+        # a VELES_COMPILE_CACHE directory still opts in on any
+        # backend.
         return None
     if path is None:
         path = default_dir()

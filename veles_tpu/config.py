@@ -141,8 +141,18 @@ root.common.update({
     # validated on restore so torn commits are detected and skipped;
     # commit_retries/retry_backoff_ms retry transient filesystem
     # errors during the commit write before surfacing.
+    # per_host=True (the pod tier): EVERY process writes its own full
+    # checkpoint copy into its own host-local snapshot directory
+    # instead of only process 0 — the substrate the pod master's
+    # cross-host checkpoint agreement runs over.
+    # reject_nonfinite: commit-time poison valve — a checkpoint whose
+    # params/velocity contain NaN/inf is REFUSED (loud death of this
+    # life) so the restart loops can never faithfully resume a
+    # poisoned state; disable for workloads that legitimately
+    # checkpoint non-finite leaves.
     "snapshot": {"interval": 1, "min_interval_seconds": 0, "codec": "gz",
-                 "keep_last": 5, "manifest": True,
+                 "keep_last": 5, "manifest": True, "per_host": False,
+                 "reject_nonfinite": True,
                  "commit_retries": 3, "retry_backoff_ms": 100},
     # the training supervisor (services.supervisor, `--supervise`):
     # respawn-on-failure with exponential backoff.  Graceful
@@ -155,9 +165,32 @@ root.common.update({
     "supervise": {"max_restarts": 8, "window_seconds": 600,
                   "backoff_base_ms": 200, "backoff_max_ms": 30000,
                   "deterministic_limit": 3},
-    # chaos/fault-drill knobs (tools/train_chaos.py): unit_delay_ms
-    # sleeps per scheduler unit-run so external kills land mid-sweep
-    "chaos": {"unit_delay_ms": 0},
+    # chaos/fault-drill knobs (tools/train_chaos.py, tools/pod_chaos.py):
+    # unit_delay_ms sleeps per scheduler unit-run so external kills land
+    # mid-sweep; with unit_delay_file set the sleep additionally
+    # requires that file to EXIST, letting a harness switch a long
+    # stall on mid-run (the pod chaos gate's forged collective hang)
+    "chaos": {"unit_delay_ms": 0, "unit_delay_file": None},
+    # the pod survival tier (services.podmaster, `veles-tpu-pod`):
+    # a pod master coordinates one per-host supervisor agent per host.
+    # Agents heartbeat every heartbeat_ms; an agent silent for
+    # stale_after_ms, a worker death on ANY host, or no step/commit
+    # progress pod-wide for hang_seconds (the collective-hang latch —
+    # survivors of a dead/stalled host don't crash, they hang in the
+    # next collective) all trigger ONE coordinated pod restart:
+    # every agent escalates SIGTERM -> (kill_grace_ms) -> SIGKILL on
+    # its worker, the restart checkpoint is computed by cross-host
+    # agreement over the per-host integrity manifests
+    # (snapshot.per_host), and workers respawn under a new fenced
+    # incarnation id (stale registrations are refused).  PR 8's valves
+    # lifted to pod scope: max_restarts bounded restarts per
+    # window_seconds, deterministic_limit identical pod-wide crash
+    # signatures with zero agreed-checkpoint progress give up early.
+    "pod": {"heartbeat_ms": 500, "stale_after_ms": 10000,
+            "hang_seconds": 300, "kill_grace_ms": 5000,
+            "max_restarts": 8, "window_seconds": 600,
+            "deterministic_limit": 3,
+            "backoff_base_ms": 200, "backoff_max_ms": 10000},
     "web": {"host": "0.0.0.0", "port": 8090},
     # the flight recorder / crash forensics / watchdog layer
     # (veles_tpu.telemetry.flight + .health, docs/services.md "Black

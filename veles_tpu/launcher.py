@@ -91,6 +91,20 @@ class Launcher(Logger):
     def initialize(self, **kwargs):
         import jax
         if self.mode == "spmd" and self.num_processes > 1:
+            from veles_tpu.compile_cache import _cpu_backend
+            if _cpu_backend():
+                # multi-process SPMD on the CPU backend (emulated pods,
+                # tests, the pod-chaos gate) needs an explicit CPU
+                # collectives implementation — without it every
+                # cross-process collective dies with "Multiprocess
+                # computations aren't implemented on the CPU backend".
+                # Must land before the backend initializes; harmless to
+                # set again on re-entry, no-op for TPU/GPU platforms.
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except (AttributeError, ValueError):
+                    pass   # older jax: no such knob (or no gloo build)
             self.info("jax.distributed.initialize(%s, %d, %d)",
                       self.coordinator_address, self.num_processes,
                       self.process_id)

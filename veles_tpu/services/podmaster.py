@@ -1,0 +1,1485 @@
+"""Pod master + per-host supervisor agents — the TPU-era multi-node
+Launcher (ref: veles/launcher.py + server.py/client.py, the Twisted/
+ZeroMQ master–slave control plane that respawned dead slaves and
+requeued their work; PAPER.md §L4).
+
+PR 8's :mod:`~veles_tpu.services.supervisor` survives anything on ONE
+host.  On a pod the failure mode is qualitatively different: in
+multi-controller SPMD a dead or stalled host does not crash the
+survivors — they **hang in the next collective**.  So restart must be
+detected pod-wide and executed pod-wide, from a checkpoint every host
+actually committed:
+
+* one **pod master** (this module's :class:`PodMaster`, ``veles-tpu-pod``)
+  owns the pod policy over a small line-JSON TCP control plane (no new
+  dependencies — the paper's Twisted protocol collapsed to sockets);
+* one **per-host agent** (:class:`PodAgent`, ``veles-tpu-pod --agent``)
+  per host spawns/kills the local worker (the training command, with the
+  ``jax.distributed`` coordinator/process-id threaded in via the
+  ``VELES_TPU_*`` env), classifies its deaths with the same
+  :func:`~veles_tpu.services.supervisor.classify_exit` taxonomy the
+  single-host supervisor uses, heartbeats liveness + step progress (the
+  ``VELES_TPU_PROGRESS_FILE`` bridge in :mod:`telemetry.health`), and
+  scans its host-local checkpoint directory for the agreement.
+
+**Pod-level death classification** (any one triggers ONE coordinated
+restart): a worker exit on ANY host; an agent silent past
+``stale_after_ms``; or the **collective-hang latch** — every worker
+alive and heartbeating but zero step/commit progress pod-wide for
+``hang_seconds``.
+
+**Coordinated restart**: every agent escalates SIGTERM →
+(``kill_grace_ms``) → SIGKILL on its worker; the master collects each
+host's manifest scan and computes the restart checkpoint by
+**cross-host agreement** (:func:`snapshotter.agree_commits` — the
+newest commit whose integrity manifest is valid on ALL hosts; a commit
+present on host 0 but torn/absent on host 1 is rolled back pod-wide);
+each agent rolls its directory back (:func:`snapshotter.
+rollback_to_commit`) and respawns its worker under a new **fenced
+incarnation id** on a fresh coordinator port — a zombie worker from a
+previous incarnation can neither re-register (refused:
+stale-incarnation) nor rejoin the collective (different coordinator).
+
+PR 8's valves are lifted to pod scope (:class:`PodValves`): bounded
+restarts per window, and identical pod-wide crash signatures with zero
+agreed-checkpoint progress give up early.  Gate:
+``tools/pod_chaos.py``; docs: docs/distributed_training.md
+"Pod orchestration"."""
+
+import argparse
+import json
+import logging
+import os
+import queue
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from veles_tpu.config import root
+from veles_tpu.services.supervisor import (STARTUP_FLAKE_OUTPUT_LIMIT,
+                                           STARTUP_FLAKE_SIGNALS,
+                                           backoff_delay, classify_exit,
+                                           newest_mtime)
+from veles_tpu.telemetry import flight
+
+
+def _free_port(host="127.0.0.1"):
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def merge_config_list(argv, statements):
+    """Insert config statements into an argv's existing ``--config-list``
+    (argparse ``nargs="*"`` keeps only the LAST flag instance, so a
+    second flag would silently drop the command's own overrides), or
+    append a fresh flag when there is none."""
+    argv = list(argv)
+    statements = list(statements)
+    if not statements:
+        return argv
+    if "--config-list" in argv:
+        i = argv.index("--config-list") + 1
+        while i < len(argv) and not argv[i].startswith("--"):
+            i += 1
+        return argv[:i] + statements + argv[i:]
+    return argv + ["--config-list"] + statements
+
+
+def merge_worker_env(inherited, spec_env):
+    """The worker's env: ``inherited`` (the agent's environment)
+    updated with the spawn spec's delta — except ``XLA_FLAGS``, where
+    the pod's device-count flag is APPENDED to the operator's own
+    flags instead of clobbering them (the pod's flag last, so it wins
+    a conflict)."""
+    env = dict(inherited)
+    spec_env = dict(spec_env)
+    if "XLA_FLAGS" in spec_env and env.get("XLA_FLAGS"):
+        spec_env["XLA_FLAGS"] = "%s %s" % (env["XLA_FLAGS"],
+                                           spec_env["XLA_FLAGS"])
+    env.update(spec_env)
+    return env
+
+
+def _proc_start_ticks(pid):
+    """Kernel start time (clock ticks since boot) of ``pid`` from
+    ``/proc/<pid>/stat``, or None where /proc is unavailable.  The
+    (pid, start-ticks) pair identifies one process LIFE: a recycled
+    pid gets a different start time."""
+    try:
+        with open("/proc/%d/stat" % pid, "rb") as f:
+            data = f.read()
+        # comm (field 2) may contain spaces/parens — field 22 counts
+        # from after the LAST closing paren
+        return int(data.rsplit(b")", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+# =====================================================================
+# the pure pod-policy core (no sockets, no processes — unit-tested
+# directly in tests/test_podmaster.py)
+# =====================================================================
+
+class IncarnationFence(object):
+    """Monotonic incarnation ids with registration fencing: a worker
+    (or a rejoining agent that still carries one) registering under any
+    incarnation other than the current one is refused — the zombie from
+    a previous life must not rejoin the pod."""
+
+    def __init__(self):
+        self.incarnation = 0
+        self.refusals = []
+
+    def bump(self):
+        self.incarnation += 1
+        return self.incarnation
+
+    def admit(self, host, incarnation, now=None):
+        """None = admitted; otherwise the refusal reason string
+        (recorded)."""
+        if incarnation is None or incarnation == self.incarnation:
+            return None
+        reason = ("stale-incarnation"
+                  if incarnation < self.incarnation
+                  else "future-incarnation")
+        self.refusals.append(
+            {"host": host, "incarnation": incarnation,
+             "current": self.incarnation, "reason": reason,
+             "ts": now if now is not None else time.time()})
+        return reason
+
+
+def classify_stall(now, hosts, hang_seconds, stale_after):
+    """Pod-level stall classification from heartbeat/progress inputs.
+
+    :param hosts: ``{host: {"heartbeat_ts", "progress_ts",
+        "worker_alive"}}`` — ``progress_ts`` starts at the worker's
+    spawn time (startup grace) and advances with the step/commit
+    progress the agent observes.
+    :returns: None, or ``{"cause": "stale-heartbeat"|"collective-hang",
+        "hosts": [...]}``.
+
+    A silent agent is its own cause.  The hang latch requires EVERY
+    worker alive (a dead worker is the worker-exit trigger's job) and
+    zero progress pod-wide: one stalled host is enough to freeze the
+    whole pod — the survivors block inside their next collective, so
+    per-host progress goes flat *everywhere at once*, which is exactly
+    the latch condition."""
+    if not hosts:
+        return None
+    stale = [h for h, s in sorted(hosts.items())
+             if s.get("heartbeat_ts") is None
+             or now - s["heartbeat_ts"] > stale_after]
+    if stale:
+        return {"cause": "stale-heartbeat", "hosts": stale}
+    if not all(s.get("worker_alive") for s in hosts.values()):
+        return None
+    newest = max(s.get("progress_ts") or 0.0 for s in hosts.values())
+    if now - newest > hang_seconds:
+        return {"cause": "collective-hang", "hosts": sorted(hosts)}
+    return None
+
+
+class PodValves(object):
+    """PR 8's crash-loop and deterministic-bug valves lifted to pod
+    scope: one decision per coordinated restart."""
+
+    def __init__(self, max_restarts, window_seconds,
+                 deterministic_limit):
+        self.max_restarts = int(max_restarts)
+        self.window_seconds = float(window_seconds)
+        self.deterministic_limit = int(deterministic_limit)
+        self._window = []
+        self._last_signature = None
+        self._same_signature = 0
+
+    def admit(self, now, signature=None, progressed=False,
+              counted=True):
+        """Decide one pod restart: ``"respawn"``, ``"crash-loop"`` or
+        ``"deterministic-bug"``.
+
+        :param signature: the pod-wide crash signature — a tuple of the
+            per-host crash signatures, or None when the round had none
+            (kills, hangs).
+        :param progressed: the agreed checkpoint advanced since the
+            previous restart — a pod that keeps committing is working,
+            however it keeps dying (resets the deterministic counter).
+        :param counted: False for restarts that must stay unbounded —
+            pod-wide graceful preemption and environment startup
+            flakes."""
+        if progressed:
+            self._same_signature, self._last_signature = 0, None
+        if not counted:
+            return "respawn"
+        if signature:
+            if signature == self._last_signature:
+                self._same_signature += 1
+            else:
+                self._last_signature = signature
+                self._same_signature = 1
+            if not progressed and \
+                    self._same_signature >= self.deterministic_limit:
+                return "deterministic-bug"
+        self._window = [t for t in self._window
+                        if now - t < self.window_seconds]
+        self._window.append(now)
+        if len(self._window) > self.max_restarts:
+            return "crash-loop"
+        return "respawn"
+
+
+# =====================================================================
+# line-JSON transport
+# =====================================================================
+
+class _Conn(object):
+    """One line-JSON peer: locked sends, file-buffered reads."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.rfile = sock.makefile("r", encoding="utf-8")
+        self._wlock = threading.Lock()
+        self.alive = True
+
+    def send(self, obj):
+        data = (json.dumps(obj) + "\n").encode("utf-8")
+        try:
+            with self._wlock:
+                self.sock.sendall(data)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+    def recv(self):
+        """One decoded message, or None on EOF/error."""
+        try:
+            line = self.rfile.readline()
+        except OSError:
+            return None
+        if not line:
+            return None
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            return {"type": "garbage", "line": line[:200]}
+        return msg if isinstance(msg, dict) else \
+            {"type": "garbage", "line": line[:200]}
+
+    def close(self):
+        self.alive = False
+        for closer in (self.rfile.close, self.sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+
+# =====================================================================
+# the pod master
+# =====================================================================
+
+class PodMaster(object):
+    """Coordinate ``n_hosts`` per-host agents around one training
+    command (see the module docstring for the policy).
+
+    :param argv: the worker command line (e.g. ``[sys.executable, "-m",
+        "veles_tpu", "wf.py", "--snapshot", "auto", ...]``); the master
+        threads per-host snapshot dirs + ``snapshot.per_host`` into its
+        ``--config-list`` and per-host/incarnation env on top.
+    :param snapshot_root: per-host snapshot dirs live at
+        ``<snapshot_root>/host<i>``.
+    :param prefix: the workflow's snapshot prefix (checkpoint names =
+        ``<prefix>_<suffix>``) — what the agreement scans for.
+    :param host_extras: ``{host: [config statements]}`` merged into that
+        host's worker ``--config-list`` (chaos harnesses inject per-host
+        stalls this way).
+    :param spawn_agents: launch the N agents as local subprocesses (the
+        single-machine pod used by tests/CI).  False prints the agent
+        command for each host instead — real pods run one agent per
+        machine.
+    """
+
+    def __init__(self, argv, n_hosts=2, snapshot_root=None, prefix=None,
+                 host_extras=None, workdir=None, port=0,
+                 bind_host="127.0.0.1", coordinator_host="127.0.0.1",
+                 devices_per_host=None, env=None, spawn_agents=True,
+                 heartbeat_ms=None, stale_after_ms=None,
+                 hang_seconds=None, kill_grace_ms=None,
+                 max_restarts=None, window_seconds=None,
+                 deterministic_limit=None, backoff_base_ms=None,
+                 backoff_max_ms=None, seed=None):
+        def knob(value, key, default):
+            if value is not None:
+                return value
+            return root.common.pod.get(key, default)
+
+        self.argv = list(argv)
+        self.n_hosts = int(n_hosts)
+        self.workdir = os.path.abspath(workdir or "pod-workdir")
+        self.snapshot_root = os.path.abspath(
+            snapshot_root or os.path.join(self.workdir, "snapshots"))
+        self.prefix = prefix or "wf"
+        self.host_extras = dict(host_extras or {})
+        self.port = int(port)
+        self.bind_host = bind_host
+        self.coordinator_host = coordinator_host
+        self.devices_per_host = devices_per_host
+        self.env = env
+        self.spawn_agents = bool(spawn_agents)
+        self.heartbeat_s = float(
+            knob(heartbeat_ms, "heartbeat_ms", 500)) / 1e3
+        self.stale_after_s = float(
+            knob(stale_after_ms, "stale_after_ms", 10000)) / 1e3
+        self.hang_seconds = float(knob(hang_seconds, "hang_seconds", 300))
+        self.kill_grace_s = float(
+            knob(kill_grace_ms, "kill_grace_ms", 5000)) / 1e3
+        self.backoff_base = float(
+            knob(backoff_base_ms, "backoff_base_ms", 200)) / 1e3
+        self.backoff_max = float(
+            knob(backoff_max_ms, "backoff_max_ms", 10000)) / 1e3
+        self.valves = PodValves(
+            knob(max_restarts, "max_restarts", 8),
+            knob(window_seconds, "window_seconds", 600),
+            knob(deterministic_limit, "deterministic_limit", 3))
+        self.fence = IncarnationFence()
+        self._rng = random.Random(seed)
+        self._log = logging.getLogger("PodMaster")
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._listener = None
+        self._threads = []
+        self._agent_procs = {}
+        self._agent_spawns = {}
+        self._stopping = False
+        self.phase = "gathering"
+        self.rc = None
+        #: per-host live state (the policy thread's view)
+        self.hosts = {h: self._fresh_host() for h in range(self.n_hosts)}
+        #: one record per coordinated restart
+        self.history = []
+        self.restart_causes = []
+        self._last_agreed = None
+        self._last_agreed_key = None
+        self._round_exits = {}
+        self._round_cause = None
+        self._round_started = None
+        self._consecutive = 0
+        #: consecutive env-flake rounds with zero checkpoint progress —
+        #: flakes respawn uncounted (they must not burn the crash-loop
+        #: budget), but an endless storm of them with the pod going
+        #: nowhere is its own giveup condition
+        self._flake_streak = 0
+        self.flake_streak_limit = 6
+
+    @staticmethod
+    def _fresh_host():
+        return {"conn": None, "registered_ts": None,
+                "heartbeat_ts": None, "progress_ts": None,
+                "worker_alive": False, "worker_pid": None,
+                "spawned_ts": None, "last_exit": None, "up_inc": None}
+
+    # ------------------------------------------------------------ layout
+    def host_snapshot_dir(self, host):
+        return os.path.join(self.snapshot_root, "host%d" % host)
+
+    def host_workdir(self, host):
+        return os.path.join(self.workdir, "agent%d" % host)
+
+    def agent_argv(self, host):
+        return [sys.executable, "-m", "veles_tpu.services.podmaster",
+                "--agent", "--master",
+                "%s:%d" % (self.bind_host, self.port),
+                "--host-id", str(host),
+                "--workdir", self.host_workdir(host)]
+
+    def worker_spec(self, host, incarnation, coordinator_port,
+                    agreed=None, rollback=False, quarantine=None):
+        """The spawn message for one host/incarnation — argv with the
+        per-host snapshot config merged in, plus the env delta that
+        threads the ``jax.distributed`` identity and the fenced
+        incarnation into the worker."""
+        statements = [
+            "root.common.dirs.snapshots=%r" % self.host_snapshot_dir(host),
+            "root.common.snapshot.per_host=True",
+            # the cross-host agreement verifies integrity manifests; a
+            # config with snapshot.manifest=False would leave every
+            # commit unverifiable and a single restart would quarantine
+            # the whole ring — force them on under the pod
+            "root.common.snapshot.manifest=True",
+            # agreement scans FILE commits (one pickle + manifest
+            # sidecar per commit); the orbax/db backends have no
+            # per-commit file sha to intersect, so a pod running them
+            # would find every commit unverifiable on the first
+            # restart — force the file backend under the pod
+            "root.common.snapshot.backend='file'",
+            "root.common.blackbox.dir=%r" % os.path.join(
+                self.workdir, "dumps"),
+        ] + list(self.host_extras.get(host, ()))
+        env = {
+            "VELES_TPU_COORDINATOR": "%s:%d" % (self.coordinator_host,
+                                                coordinator_port),
+            "VELES_TPU_NUM_PROCESSES": str(self.n_hosts),
+            "VELES_TPU_PROCESS_ID": str(host),
+            "VELES_TPU_INCARNATION": str(incarnation),
+        }
+        if self.devices_per_host:
+            env["XLA_FLAGS"] = \
+                "--xla_force_host_platform_device_count=%d" \
+                % self.devices_per_host
+        return {"type": "spawn", "incarnation": incarnation,
+                "argv": merge_config_list(self.argv, statements),
+                "env": env, "prefix": self.prefix,
+                "snapshot_dir": self.host_snapshot_dir(host),
+                "blackbox_dir": os.path.join(self.workdir, "dumps"),
+                "agreed": agreed, "rollback": bool(rollback),
+                "quarantine": quarantine}
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        os.makedirs(self.workdir, exist_ok=True)
+        os.makedirs(os.path.join(self.workdir, "dumps"), exist_ok=True)
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.bind_host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(self.n_hosts + 4)
+        t = threading.Thread(target=self._accept_loop,
+                             name="PodAccept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.spawn_agents:
+            for h in range(self.n_hosts):
+                self._spawn_agent(h)
+        else:
+            for h in range(self.n_hosts):
+                print("[pod] host %d agent command: %s"
+                      % (h, " ".join(self.agent_argv(h))), flush=True)
+        self._policy_thread = threading.Thread(
+            target=self._policy_loop, name="PodPolicy", daemon=True)
+        self._policy_thread.start()
+        self._info("pod master listening on %s:%d (%d hosts)",
+                   self.bind_host, self.port, self.n_hosts)
+        return self
+
+    def wait(self, timeout=None):
+        """Block until the pod finishes/gives up; the final exit code
+        (None on timeout)."""
+        self._policy_thread.join(timeout)
+        if self._policy_thread.is_alive():
+            return None
+        return self.rc
+
+    def run(self):
+        self.start()
+        return self.wait()
+
+    def stop(self, rc=1):
+        """External stop: shut every agent (and its worker) down."""
+        with self._lock:
+            if self.phase in ("done", "giveup"):
+                return
+            self._stopping = True
+        self._inbox.put(("stop", None, {"rc": rc}))
+
+    def status(self):
+        """One JSON-able snapshot — the chaos harness's observation
+        surface."""
+        with self._lock:
+            return {
+                "phase": self.phase,
+                "incarnation": self.fence.incarnation,
+                "rc": self.rc,
+                "restarts": len(self.history),
+                "restart_causes": list(self.restart_causes),
+                "agreed": self._last_agreed,
+                "fence_refusals": list(self.fence.refusals),
+                "hosts": {
+                    h: {"worker_alive": s["worker_alive"],
+                        "worker_pid": s["worker_pid"],
+                        "registered": s["conn"] is not None,
+                        "last_exit": s["last_exit"]}
+                    for h, s in self.hosts.items()},
+            }
+
+    # --------------------------------------------------- agent processes
+    def _spawn_agent(self, host):
+        os.makedirs(self.host_workdir(host), exist_ok=True)
+        env = dict(self.env if self.env is not None else os.environ)
+        # the agents (and through them the workers) must import
+        # veles_tpu from wherever THIS master imported it — the local
+        # pod emulation runs uninstalled from the repo checkout
+        import veles_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(veles_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        log = open(os.path.join(self.host_workdir(host), "agent.log"),
+                   "ab")
+        try:
+            proc = subprocess.Popen(self.agent_argv(host), env=env,
+                                    stdout=log, stderr=log)
+        finally:
+            log.close()
+        self._agent_procs[host] = proc
+        self._agent_spawns.setdefault(host, []).append(time.time())
+        flight.record("pod.agent_spawn", host=host, pid=proc.pid)
+
+    # ------------------------------------------------------ accept/reader
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn = _Conn(sock)
+            threading.Thread(target=self._reader, args=(conn,),
+                             name="PodReader", daemon=True).start()
+
+    def _reader(self, conn):
+        msg = conn.recv()
+        if not msg or msg.get("type") != "register":
+            conn.send({"type": "refused", "reason": "register-first"})
+            conn.close()
+            return
+        host = msg.get("host")
+        reason = None
+        with self._lock:
+            if not isinstance(host, int) or host not in self.hosts:
+                reason = "unknown-host"
+            else:
+                # FENCE FIRST: a registration carrying a previous
+                # incarnation is a zombie trying to rejoin — refuse it
+                # even when the slot looks free
+                reason = self.fence.admit(host, msg.get("incarnation"))
+            if reason is None and self.hosts[host]["conn"] is not None \
+                    and self.hosts[host]["conn"].alive:
+                reason = "duplicate-host"
+            if reason is None:
+                self.hosts[host]["conn"] = conn
+                self.hosts[host]["registered_ts"] = time.time()
+                self.hosts[host]["heartbeat_ts"] = time.time()
+        if reason is not None:
+            flight.record("pod.fence", host=host, reason=reason,
+                          incarnation=msg.get("incarnation"),
+                          current=self.fence.incarnation)
+            conn.send({"type": "refused", "reason": reason,
+                       "current": self.fence.incarnation})
+            conn.close()
+            return
+        conn.send({"type": "welcome",
+                   "incarnation": self.fence.incarnation,
+                   "heartbeat_ms": int(self.heartbeat_s * 1e3)})
+        flight.record("pod.agent_up", host=host)
+        self._inbox.put(("agent_up", host, msg))
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                break
+            self._inbox.put((msg.get("type", "garbage"), host, msg))
+        conn.close()
+        self._inbox.put(("agent_lost", host, {}))
+
+    def _send(self, host, obj):
+        conn = self.hosts[host]["conn"]
+        return conn is not None and conn.send(obj)
+
+    # -------------------------------------------------------- policy loop
+    def _policy_loop(self):
+        try:
+            self._policy_loop_inner()
+        except Exception as e:   # noqa: BLE001 — never die silently
+            self._error("pod policy loop crashed: %s: %s",
+                        type(e).__name__, e)
+            flight.record("pod.policy_error", error=str(e))
+            flight.dump(reason="pod-policy-error", error=e)
+            with self._lock:
+                self.phase = "giveup"
+                self.rc = 1
+        finally:
+            self._shutdown_agents()
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _policy_loop_inner(self):
+        while True:
+            try:
+                ev = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                ev = None
+            if ev is not None:
+                self._handle_event(*ev)
+            self._tick()
+            with self._lock:
+                if self.phase in ("done", "giveup"):
+                    return
+
+    def _handle_event(self, kind, host, msg):
+        now = time.time()
+        if kind == "stop":
+            with self._lock:
+                self.phase = "giveup"
+                self.rc = msg.get("rc", 1)
+            flight.record("pod.stopped")
+            return
+        if host is None:
+            return
+        with self._lock:
+            state = self.hosts[host]
+            if kind == "agent_up":
+                pass
+            elif kind == "agent_lost":
+                state["conn"] = None
+                state["heartbeat_ts"] = None
+                flight.record("pod.agent_lost", host=host)
+            elif kind == "heartbeat":
+                # heartbeats are NOT a fence point: between the
+                # master's incarnation bump and the agent receiving its
+                # spawn order, in-flight heartbeats legitimately carry
+                # the previous incarnation — fencing here would kill
+                # freshly spawned workers.  The fence points are
+                # registration and worker_up.
+                state["heartbeat_ts"] = now
+                state["worker_alive"] = bool(msg.get("worker_alive"))
+                age = msg.get("progress_age")
+                if age is not None:
+                    ts = now - float(age)
+                    if state["progress_ts"] is None \
+                            or ts > state["progress_ts"]:
+                        state["progress_ts"] = ts
+            elif kind == "worker_up":
+                reason = self.fence.admit(host, msg.get("incarnation"))
+                if reason is not None:
+                    flight.record("pod.fence", host=host, reason=reason,
+                                  incarnation=msg.get("incarnation"),
+                                  current=self.fence.incarnation)
+                    self._send(host, {"type": "fence", "reason": reason,
+                                      "current": self.fence.incarnation})
+                    return
+                state["worker_alive"] = True
+                state["worker_pid"] = msg.get("pid")
+                state["spawned_ts"] = now
+                state["progress_ts"] = now
+                state["up_inc"] = msg.get("incarnation")
+                state["last_exit"] = None
+                flight.record("pod.worker_up", host=host,
+                              pid=msg.get("pid"),
+                              incarnation=msg.get("incarnation"),
+                              quarantined=msg.get("quarantined"))
+                self._info("host %d worker up (pid %s, incarnation %s)",
+                           host, msg.get("pid"), msg.get("incarnation"))
+            elif kind == "worker_exit":
+                if state["up_inc"] is not None and \
+                        msg.get("incarnation") is not None and \
+                        msg.get("incarnation") != state["up_inc"]:
+                    # a late exit report from a PREVIOUS life (the
+                    # waiter thread can lag past kill->agree->respawn)
+                    # must not clobber the live worker's state
+                    flight.record("pod.stale_exit", host=host,
+                                  incarnation=msg.get("incarnation"),
+                                  current=state["up_inc"])
+                    return
+                state["worker_alive"] = False
+                state["worker_pid"] = None
+                exit_rec = {"rc": msg.get("rc"),
+                            "kind": msg.get("kind"),
+                            "signature": msg.get("signature"),
+                            "incarnation": msg.get("incarnation"),
+                            # a death during the coordinated kill is a
+                            # consequence of OUR SIGTERM/SIGKILL, not
+                            # an independent event — the round's valve
+                            # weighting must ignore it
+                            "during_kill": self.phase in
+                            ("killing", "agreeing", "respawning"),
+                            "ts": now}
+                state["last_exit"] = exit_rec
+                flight.record("pod.worker_exit", host=host,
+                              rc=exit_rec["rc"],
+                              exit_kind=exit_rec["kind"],
+                              signature=exit_rec["signature"],
+                              incarnation=exit_rec["incarnation"])
+                self._info("host %d worker exit rc=%s (%s)", host,
+                           msg.get("rc"), msg.get("kind"))
+                if self.phase in ("killing", "agreeing", "respawning"):
+                    self._round_exits.setdefault(host, exit_rec)
+            elif kind == "manifests":
+                state["manifests"] = msg.get("commits", {})
+
+    # -------------------------------------------------------------- tick
+    def _tick(self):
+        now = time.time()
+        with self._lock:
+            phase = self.phase
+        if self.spawn_agents:
+            self._respawn_dead_agents()
+        if phase == "gathering":
+            with self._lock:
+                ready = all(s["conn"] is not None
+                            for s in self.hosts.values())
+            if ready:
+                self._info("all %d agents registered — starting "
+                           "incarnation 0", self.n_hosts)
+                self._spawn_all(agreed=None, rollback=False)
+        elif phase == "running":
+            trigger = self._detect_trigger(now)
+            if trigger is not None:
+                self._begin_restart(trigger, now)
+        elif phase == "killing":
+            self._tick_killing(now)
+        elif phase == "agreeing":
+            self._tick_agreeing(now)
+        elif phase == "respawning":
+            self._tick_respawning(now)
+
+    def _respawn_dead_agents(self):
+        for host, proc in list(self._agent_procs.items()):
+            if proc.poll() is not None and not self._stopping:
+                with self._lock:
+                    if self.phase in ("done", "giveup"):
+                        return
+                # an agent that cannot even stay up (bad install,
+                # unreachable master port) must not respawn forever
+                recent = [t for t in self._agent_spawns.get(host, [])
+                          if time.time() - t < 60]
+                if len(recent) >= 5:
+                    self._error("host %d agent died %d times in 60s "
+                                "(rc=%s) — giving up the pod; see %s",
+                                host, len(recent), proc.returncode,
+                                os.path.join(self.host_workdir(host),
+                                             "agent.log"))
+                    flight.record("pod.giveup",
+                                  reason="agent-crash-loop", host=host)
+                    with self._lock:
+                        self.phase = "giveup"
+                        self.rc = 1
+                    return
+                self._info("host %d agent died (rc=%s) — respawning it",
+                           host, proc.returncode)
+                flight.record("pod.agent_died", host=host,
+                              rc=proc.returncode)
+                self._spawn_agent(host)
+
+    def _detect_trigger(self, now):
+        with self._lock:
+            # pod-wide completion: every host's CURRENT-incarnation
+            # worker exited 0
+            exits = {h: s["last_exit"] for h, s in self.hosts.items()}
+            if all(e is not None and e["kind"] == "done"
+                   and e.get("incarnation") == self.fence.incarnation
+                   for e in exits.values()):
+                self._info("all hosts finished cleanly — pod done")
+                flight.record("pod.done",
+                              incarnation=self.fence.incarnation)
+                self.phase = "done"
+                self.rc = 0
+                return None
+            for h, e in sorted(exits.items()):
+                if e is not None and e["kind"] != "done" and \
+                        e.get("incarnation") == self.fence.incarnation:
+                    return {"cause": "worker-exit", "host": h,
+                            "exit": e}
+            view = {h: {"heartbeat_ts": s["heartbeat_ts"],
+                        "progress_ts": s["progress_ts"],
+                        "worker_alive": s["worker_alive"]}
+                    for h, s in self.hosts.items()
+                    # a host whose worker finished is excluded from the
+                    # stall view (its progress legitimately stopped)
+                    if not (self.hosts[h]["last_exit"] is not None
+                            and self.hosts[h]["last_exit"]["kind"]
+                            == "done")}
+            stall = classify_stall(now, view, self.hang_seconds,
+                                   self.stale_after_s)
+        if stall is not None:
+            return {"cause": stall["cause"], "hosts": stall["hosts"]}
+        return None
+
+    # ------------------------------------------------- coordinated restart
+    def _begin_restart(self, trigger, now):
+        with self._lock:
+            self._round_cause = trigger
+            self._round_started = now
+            self._round_exits = {}
+            for h, s in self.hosts.items():
+                if trigger.get("host") == h and "exit" in trigger:
+                    self._round_exits[h] = trigger["exit"]
+                s.pop("manifests", None)
+            self.phase = "killing"
+        cause = trigger["cause"]
+        if "exit" in trigger:
+            cause = "%s:%s" % (cause, trigger["exit"]["kind"])
+        self._info("pod restart: %s — killing every worker "
+                   "(SIGTERM -> %.1fs -> SIGKILL)", cause,
+                   self.kill_grace_s)
+        flight.record("pod.stall" if "hosts" in trigger
+                      else "pod.trigger", **trigger)
+        flight.record("pod.kill", cause=cause)
+        with self._lock:
+            for h in self.hosts:
+                self._send(h, {"type": "kill_worker",
+                               "grace_ms": int(self.kill_grace_s * 1e3)})
+
+    def _tick_killing(self, now):
+        with self._lock:
+            alive = [h for h, s in self.hosts.items()
+                     if s["worker_alive"]]
+            timed_out = now - self._round_started > \
+                self.kill_grace_s * 3 + 30
+            if alive and not timed_out:
+                return
+            if alive:
+                self._info("killing timed out with %s still reported "
+                           "alive — proceeding (their agents will "
+                           "fence them)", alive)
+            self._round_started = now
+            self.phase = "agreeing"
+            for h in self.hosts:
+                self._send(h, {"type": "report_manifests",
+                               "prefix": self.prefix,
+                               "snapshot_dir":
+                                   self.host_snapshot_dir(h)})
+
+    def _tick_agreeing(self, now):
+        with self._lock:
+            missing = [h for h, s in self.hosts.items()
+                       if "manifests" not in s]
+            if missing and now - self._round_started < 60:
+                return
+            reports = {h: s["manifests"] for h, s in self.hosts.items()
+                       if "manifests" in s}
+        from veles_tpu.services.snapshotter import (_commit_order_key,
+                                                    agree_commits)
+        agreed, detail = agree_commits(reports)
+        forced = None
+        if missing:
+            # a host that never reported is UNKNOWN, not empty.
+            # Agreement over the survivors alone may pick a commit the
+            # silent host tore or lost — resuming from it would diverge
+            # the pod the moment the host returns — and treating the
+            # silent host as empty would drive agreed=None and
+            # quarantine EVERY valid checkpoint pod-wide off a
+            # transient partition.  Only a checkpoint that was
+            # pod-verified on every host at an earlier agreement is
+            # safe: fall back to it, or give up with the data intact.
+            self._error("no manifest report from host(s) %s — "
+                        "restricting agreement to pod-verified "
+                        "checkpoints", missing)
+            last = self._last_agreed
+            if last is not None and reports and all(
+                    r.get(last, {}).get("valid") is True
+                    for r in reports.values()):
+                agreed = last
+            else:
+                agreed = None
+                forced = "agreement-incomplete"
+        rejected = {n: d["rejected"] for n, d in detail.items()
+                    if d["rejected"]}
+        flight.record("pod.agree", agreed=agreed, rejected=rejected,
+                      missing=missing or None,
+                      incarnation=self.fence.incarnation)
+        self._info("checkpoint agreement: %s%s", agreed or "none",
+                   " (rejected: %s)" % rejected if rejected else "")
+        # valves: did the agreed checkpoint advance since last restart?
+        key = None
+        if agreed is not None:
+            entries = [r[agreed] for r in reports.values()
+                       if agreed in r]
+            key = _commit_order_key(agreed, entries)
+        # the explicit quarantine set, from the CROSS-host ordering:
+        # same-epoch commits tie-break on mtime and local clocks can
+        # disagree, so the master decides once and every host
+        # quarantines the same names (rollback_to_commit adds locally
+        # invalid commits on top)
+        if agreed is not None:
+            quarantine = sorted(
+                n for n in detail
+                if n != agreed and _commit_order_key(
+                    n, [r[n] for r in reports.values() if n in r]) > key)
+        else:
+            # no agreement: quarantine the rejected ring — EXCEPT
+            # commits that are unverifiable EVERYWHERE they exist
+            # (valid None on every host that has them: a manifestless
+            # or foreign-backend ring, e.g. a workflow hard-coding the
+            # orbax/db snapshotter past the forced file backend).
+            # Renaming data the agreement cannot judge to *.corrupt
+            # and resuming from scratch would silently destroy the
+            # run — give up with the data intact instead.
+            unverifiable = [
+                n for n, d in detail.items()
+                if all(reports[h][n].get("valid") is None
+                       for h in d["hosts"])]
+            quarantine = sorted(n for n in detail
+                                if n not in unverifiable)
+            if unverifiable and forced is None:
+                self._error(
+                    "no commit verifiable on any host (%s) — "
+                    "unverifiable ring left intact, giving up",
+                    sorted(unverifiable))
+                forced = "agreement-unverifiable"
+        progressed = key is not None and \
+            (self._last_agreed_key is None or key > self._last_agreed_key)
+        signatures = tuple(
+            "%s=%s" % (h, e.get("signature"))
+            for h, e in sorted(self._round_exits.items())
+            if e.get("signature"))
+        counted, flake = self._round_weight()
+        if flake and not progressed:
+            self._flake_streak += 1
+        else:
+            self._flake_streak = 0
+        verdict = forced or self.valves.admit(now, signatures or None,
+                                              progressed, counted)
+        if verdict == "respawn" and \
+                self._flake_streak >= self.flake_streak_limit:
+            verdict = "env-flake-storm"
+        cause = self._round_cause["cause"]
+        if "exit" in self._round_cause:
+            cause = "%s:%s" % (cause,
+                               self._round_cause["exit"]["kind"])
+        record = {"cause": cause, "trigger": self._round_cause,
+                  "exits": {h: dict(e) for h, e in
+                            self._round_exits.items()},
+                  "agreed": agreed, "rejected": rejected,
+                  "progressed": progressed, "counted": counted,
+                  "env_flake": flake, "verdict": verdict,
+                  "incarnation_before": self.fence.incarnation,
+                  "ts": now}
+        if verdict != "respawn":
+            self._error("pod giving up: %s (restarts=%d)", verdict,
+                        len(self.history))
+            flight.record("pod.giveup", reason=verdict, cause=cause)
+            flight.dump(directory=os.path.join(self.workdir, "dumps"),
+                        reason="pod-giveup")
+            with self._lock:
+                self.history.append(record)
+                self.restart_causes.append(cause)
+                self.phase = "giveup"
+                rcs = [e.get("rc") for e in
+                       self._round_exits.values() if e.get("rc")]
+                self.rc = rcs[0] if rcs else 1
+            return
+        if progressed:
+            self._consecutive = 0
+        self._consecutive += 1
+        delay = 0.0 if not counted else backoff_delay(
+            self._consecutive, self.backoff_base, self.backoff_max,
+            self._rng)
+        self._last_agreed = agreed
+        if key is not None:
+            self._last_agreed_key = key
+        with self._lock:
+            self.history.append(record)
+            self.restart_causes.append(cause)
+        if delay:
+            self._info("respawn backoff %.2fs", delay)
+            time.sleep(delay)
+        self._spawn_all(agreed=agreed, rollback=True,
+                        quarantine=quarantine)
+
+    def _round_weight(self):
+        """(counted, env_flake) for the round's valve decision: a pod
+        whose every INDEPENDENT death this round was a graceful
+        preemption — or the sandbox startup flake — respawns uncounted
+        (flakes bounded by the streak valve in ``_tick_agreeing``).
+        Exits from the coordinated kill itself (``during_kill``) are
+        consequences, not causes — excluded from the weighting."""
+        exits = [e for e in self._round_exits.values()
+                 if not e.get("during_kill")]
+        kinds = {e.get("kind") for e in exits}
+        flake = bool(exits) and kinds <= {"env-flake", "preempt", "done"}
+        preempt_only = bool(exits) and kinds <= {"preempt", "done"}
+        cause = self._round_cause.get("cause")
+        counted = not (cause == "worker-exit" and (flake or preempt_only))
+        return counted, flake and not preempt_only
+
+    def _spawn_all(self, agreed, rollback, quarantine=None):
+        # the first spawn keeps incarnation 0; every coordinated
+        # restart fences a new life
+        incarnation = self.fence.bump() if rollback \
+            else self.fence.incarnation
+        coord_port = _free_port(self.coordinator_host)
+        with self._lock:
+            self.phase = "respawning"
+            self._round_started = time.time()
+            for h, s in self.hosts.items():
+                s["last_exit"] = None
+                s["worker_alive"] = False
+                s["up_inc"] = None
+        flight.record("pod.respawn", incarnation=incarnation,
+                      agreed=agreed, coordinator_port=coord_port)
+        self._info("spawning incarnation %d (coordinator %s:%d%s)",
+                   incarnation, self.coordinator_host, coord_port,
+                   ", resume from %s" % agreed if agreed else "")
+        with self._lock:
+            for h in self.hosts:
+                self._send(h, self.worker_spec(
+                    h, incarnation, coord_port, agreed=agreed,
+                    rollback=rollback, quarantine=quarantine))
+
+    def _tick_respawning(self, now):
+        with self._lock:
+            pending = [h for h, s in self.hosts.items()
+                       if s["up_inc"] != self.fence.incarnation]
+            if not pending:
+                self.phase = "running"
+                return
+        if now - self._round_started > 300:
+            self._error("workers of incarnation %d never came up on "
+                        "host(s) %s — giving up",
+                        self.fence.incarnation, pending)
+            flight.record("pod.giveup", reason="respawn-timeout",
+                          hosts=pending)
+            with self._lock:
+                self.phase = "giveup"
+                self.rc = 1
+
+    # ----------------------------------------------------------- shutdown
+    def _shutdown_agents(self):
+        with self._lock:
+            for h in self.hosts:
+                self._send(h, {"type": "shutdown"})
+        deadline = time.time() + self.kill_grace_s + 10
+        for host, proc in self._agent_procs.items():
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+
+    def _info(self, msg, *args):
+        self._log.info(msg, *args)
+        print("[pod] " + msg % args, file=sys.stderr, flush=True)
+
+    def _error(self, msg, *args):
+        self._log.error(msg, *args)
+        print("[pod] " + msg % args, file=sys.stderr, flush=True)
+
+
+# =====================================================================
+# the per-host agent
+# =====================================================================
+
+class PodAgent(object):
+    """One host's supervisor agent: spawn/kill the local worker on the
+    master's orders, classify its deaths (shared taxonomy with the
+    single-host Supervisor), heartbeat liveness + step/commit progress,
+    scan the host-local checkpoint directory for the agreement, and
+    fence any zombie worker a previous agent life left behind."""
+
+    def __init__(self, master_addr, host_id, workdir,
+                 heartbeat_ms=None):
+        self.master_addr = master_addr
+        self.host = int(host_id)
+        self.workdir = os.path.abspath(workdir)
+        self.heartbeat_s = float(
+            heartbeat_ms if heartbeat_ms is not None
+            else root.common.pod.get("heartbeat_ms", 500)) / 1e3
+        self.progress_file = os.path.join(self.workdir, "progress")
+        self.pidfile = os.path.join(self.workdir, "worker.pid")
+        self._conn = None
+        self._child = None
+        self._spec = None
+        self._spawned_ts = None
+        #: (snapshot_dir, prefix, scan) from the last report_manifests
+        #: — the worker is dead for the whole agree->spawn round, so the
+        #: rollback can reuse it instead of re-hashing the ring
+        self._manifest_scan = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._log = logging.getLogger("PodAgent%d" % self.host)
+
+    # -------------------------------------------------------------- main
+    def run(self):
+        os.makedirs(self.workdir, exist_ok=True)
+        self._fence_orphan()
+        host, _, port = self.master_addr.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        # the connect timeout must NOT persist as a read timeout: the
+        # master is silent for the whole of normal training (heartbeats
+        # flow agent->master only), so a timed read would misread any
+        # quiet 30s as a lost master and kill a healthy worker.  A real
+        # master death closes the socket (EOF) and unblocks the read.
+        sock.settimeout(None)
+        self._conn = _Conn(sock)
+        self._conn.send({"type": "register", "host": self.host,
+                         "incarnation": None, "pid": os.getpid()})
+        hello = self._conn.recv()
+        if not hello or hello.get("type") != "welcome":
+            self._print("registration refused: %s", hello)
+            return 1
+        if "heartbeat_ms" in hello:
+            self.heartbeat_s = float(hello["heartbeat_ms"]) / 1e3
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name="AgentHeartbeat", daemon=True)
+        hb.start()
+        rc = 0
+        while not self._stop.is_set():
+            msg = self._conn.recv()
+            if msg is None:
+                # master gone: a headless worker would hang in its next
+                # collective anyway once peers restart — fail closed
+                self._print("master connection lost — killing worker")
+                self._kill_worker(grace_s=2.0)
+                rc = 1
+                break
+            t = msg.get("type")
+            if t == "spawn":
+                self._handle_spawn(msg)
+            elif t == "kill_worker":
+                grace = float(msg.get("grace_ms", 5000)) / 1e3
+                threading.Thread(target=self._kill_worker,
+                                 args=(grace,), name="AgentKiller",
+                                 daemon=True).start()
+            elif t == "report_manifests":
+                self._report_manifests(msg)
+            elif t == "fence":
+                self._print("fenced by master (%s) — killing worker",
+                            msg.get("reason"))
+                flight.record("pod.fenced", host=self.host,
+                              reason=msg.get("reason"))
+                self._kill_worker(grace_s=0.0)
+            elif t == "shutdown":
+                self._kill_worker(
+                    grace_s=float(msg.get("grace_ms", 5000)) / 1e3)
+                break
+        self._stop.set()
+        self._conn.close()
+        return rc
+
+    # ------------------------------------------------------------- fence
+    def _fence_orphan(self):
+        """Kill any worker a previous agent life left running (its pid
+        survives in the pidfile): a zombie from an old incarnation must
+        never reach the new collective."""
+        try:
+            fields = open(self.pidfile).read().split()
+            pid = int(fields[0])
+            ticks = int(fields[1]) if len(fields) > 1 else None
+        except (OSError, ValueError, IndexError):
+            return
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return
+        # the pid alone is not an identity — after a host reboot (or
+        # pid wraparound) it can belong to an innocent process.  Kill
+        # only a process whose kernel start time matches the one
+        # recorded at spawn; with no recorded ticks (no /proc), fall
+        # back to requiring a veles_tpu worker cmdline.
+        if ticks is not None:
+            if _proc_start_ticks(pid) != ticks:
+                self._print("stale pidfile pid %d was recycled — "
+                            "not fencing", pid)
+                try:
+                    os.remove(self.pidfile)
+                except OSError:
+                    pass
+                return
+        else:
+            try:
+                with open("/proc/%d/cmdline" % pid, "rb") as f:
+                    cmdline = f.read()
+            except OSError:
+                cmdline = None
+            if cmdline is not None and b"veles_tpu" not in cmdline:
+                self._print("stale pidfile pid %d is not a worker — "
+                            "not fencing", pid)
+                try:
+                    os.remove(self.pidfile)
+                except OSError:
+                    pass
+                return
+        self._print("fencing orphan worker pid %d from a previous "
+                    "agent life", pid)
+        flight.record("pod.orphan_fenced", host=self.host, pid=pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            os.remove(self.pidfile)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- spawn
+    def _handle_spawn(self, msg):
+        with self._lock:
+            if self._child is not None and self._child.poll() is None:
+                # a live worker across a spawn order is itself a zombie
+                # hazard — replace it
+                self._print("spawn with live worker pid %d — killing "
+                            "it first", self._child.pid)
+                self._kill_child_locked(0.0)
+        quarantined = []
+        if msg.get("rollback"):
+            from veles_tpu.services.snapshotter import rollback_to_commit
+            scan, self._manifest_scan = self._manifest_scan, None
+            if scan is not None and \
+                    scan[:2] != (msg["snapshot_dir"], msg["prefix"]):
+                scan = None
+            quarantined = rollback_to_commit(
+                msg["snapshot_dir"], msg["prefix"], msg.get("agreed"),
+                quarantine=msg.get("quarantine"),
+                scan=None if scan is None else scan[2])
+            flight.record("pod.rollback", host=self.host,
+                          agreed=msg.get("agreed"),
+                          quarantined=quarantined)
+            if quarantined:
+                self._print("rolled back to %s (quarantined: %s)",
+                            msg.get("agreed"), quarantined)
+        env = merge_worker_env(os.environ, msg.get("env", {}))
+        env["VELES_TPU_PROGRESS_FILE"] = self.progress_file
+        env["PYTHONUNBUFFERED"] = "1"
+        incarnation = msg.get("incarnation", 0)
+        log_path = os.path.join(self.workdir,
+                                "attempt-%03d.log" % incarnation)
+        try:
+            os.remove(self.progress_file)
+        except OSError:
+            pass
+        log = open(log_path, "wb")
+        try:
+            child = subprocess.Popen(msg["argv"], env=env, stdout=log,
+                                     stderr=log)
+        except OSError as e:
+            log.close()
+            self._print("worker spawn failed: %s", e)
+            self._send({"type": "worker_exit", "host": self.host,
+                        "incarnation": incarnation, "rc": 127,
+                        "kind": "crash:SpawnError", "signature": str(e)})
+            return
+        with self._lock:
+            self._child = child
+            self._spec = dict(msg, log_path=log_path)
+            self._spawned_ts = time.time()
+            try:
+                ticks = _proc_start_ticks(child.pid)
+                with open(self.pidfile, "w") as f:
+                    f.write(str(child.pid) if ticks is None
+                            else "%d %d" % (child.pid, ticks))
+            except OSError:
+                pass
+        self._send({"type": "worker_up", "host": self.host,
+                    "incarnation": incarnation, "pid": child.pid,
+                    "quarantined": quarantined})
+        threading.Thread(target=self._wait_worker,
+                         args=(child, log, dict(self._spec)),
+                         name="AgentWaiter", daemon=True).start()
+
+    def _wait_worker(self, child, log, spec):
+        rc = child.wait()
+        log.close()
+        spawned = self._spawned_ts or 0.0
+        kind, signature = classify_exit(
+            rc, spec.get("blackbox_dir"), spawned)
+        if kind.startswith("killed:"):
+            # the sandbox XLA/glibc abort (ROADMAP "Known environment
+            # flake"): an abort-class signal with a startup-shaped log
+            # (small, no traceback — a Python-level death always
+            # leaves one; the memory-corruption class kills the
+            # process from under the interpreter) is an environment
+            # fault, not a training death — the master respawns it
+            # uncounted.  A DETERMINISTIC abort is still bounded: with
+            # the agreed checkpoint not advancing, the master's
+            # flake-streak valve gives up (``env-flake-storm``).
+            sig_name = kind.split(":", 1)[1]
+            flaky = {signal.Signals(s).name
+                     for s in STARTUP_FLAKE_SIGNALS}
+            if sig_name in flaky and \
+                    self._startup_shaped_log(spec.get("log_path")):
+                kind = "env-flake"
+        # drop the pidfile only if it still records THIS child: a spawn
+        # order that replaced a live worker has already written the new
+        # worker's pid, and deleting it here would blind _fence_orphan
+        # to exactly the zombie the fence exists for
+        with self._lock:
+            try:
+                mine = open(self.pidfile).read().split()[0] \
+                    == str(child.pid)
+            except (OSError, ValueError, IndexError):
+                mine = False
+            if mine:
+                try:
+                    os.remove(self.pidfile)
+                except OSError:
+                    pass
+        self._send({"type": "worker_exit", "host": self.host,
+                    "incarnation": spec.get("incarnation"),
+                    "rc": rc, "kind": kind, "signature": signature})
+
+    @staticmethod
+    def _startup_shaped_log(log_path, limit=STARTUP_FLAKE_OUTPUT_LIMIT):
+        """True when the attempt log looks like it never got past
+        startup: small and free of a Python traceback.  A real
+        training death prints more (epoch lines, flight markers, or a
+        traceback) before dying."""
+        if log_path is None:
+            return False
+        try:
+            with open(log_path, "rb") as f:
+                data = f.read(limit + 1)
+        except OSError:
+            return False
+        return len(data) <= limit and b"Traceback" not in data
+
+    # -------------------------------------------------------------- kill
+    def _kill_worker(self, grace_s):
+        with self._lock:
+            self._kill_child_locked(grace_s)
+
+    def _kill_child_locked(self, grace_s):
+        child = self._child
+        if child is None or child.poll() is not None:
+            return
+        try:
+            child.send_signal(signal.SIGTERM)
+        except OSError:
+            return
+        deadline = time.time() + grace_s
+        while child.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if child.poll() is None:
+            # a worker blocked inside a collective (or a forged stall)
+            # never reaches its SIGTERM handler — escalate
+            try:
+                child.kill()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------- telemetry
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                child, spec = self._child, self._spec
+            alive = child is not None and child.poll() is None
+            age = None
+            if spec is not None:
+                paths = [self.progress_file, spec.get("snapshot_dir")]
+                newest = newest_mtime([p for p in paths if p])
+                if newest is not None:
+                    age = max(time.time() - newest, 0.0)
+            msg = {"type": "heartbeat", "host": self.host,
+                   "incarnation": None if spec is None
+                   else spec.get("incarnation"),
+                   "worker_alive": alive, "progress_age": age}
+            if not self._send(msg):
+                return
+            self._stop.wait(self.heartbeat_s)
+
+    def _report_manifests(self, msg):
+        from veles_tpu.services.snapshotter import scan_commits
+        commits = scan_commits(msg["snapshot_dir"], msg["prefix"])
+        self._manifest_scan = (msg["snapshot_dir"], msg["prefix"],
+                               commits)
+        # mtimes/paths are host-local; ship JSON-clean entries
+        self._send({"type": "manifests", "host": self.host,
+                    "commits": commits})
+
+    def _send(self, obj):
+        return self._conn is not None and self._conn.send(obj)
+
+    def _print(self, msg, *args):
+        self._log.info(msg, *args)
+        print("[agent%d] %s" % (self.host, msg % args),
+              file=sys.stderr, flush=True)
+
+
+# =====================================================================
+# CLI
+# =====================================================================
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-pod",
+        description="multi-host pod master / per-host supervisor agent "
+        "(docs/distributed_training.md \"Pod orchestration\").  Master: "
+        "veles-tpu-pod --hosts 2 --prefix wf -- python -m veles_tpu "
+        "wf.py --snapshot auto ...  Agent (one per host; spawned "
+        "automatically unless --no-agents): veles-tpu-pod --agent "
+        "--master HOST:PORT --host-id I --workdir DIR")
+    p.add_argument("--agent", action="store_true",
+                   help="run as a per-host agent instead of the master")
+    p.add_argument("--master", default=None, metavar="HOST:PORT",
+                   help="(agent) the master's control address")
+    p.add_argument("--host-id", type=int, default=None,
+                   help="(agent) this host's index")
+    p.add_argument("--workdir", default=None,
+                   help="state directory (agent logs/pidfile/progress; "
+                   "master layout root)")
+    p.add_argument("--hosts", type=int, default=2,
+                   help="(master) number of hosts in the pod")
+    p.add_argument("--port", type=int, default=0,
+                   help="(master) control-plane TCP port (0 = pick)")
+    p.add_argument("--bind-host", default="127.0.0.1")
+    p.add_argument("--coordinator-host", default="127.0.0.1",
+                   help="(master) host 0's address for "
+                   "jax.distributed coordinators (a fresh port per "
+                   "incarnation)")
+    p.add_argument("--prefix", required=False, default="wf",
+                   help="(master) the workflow's snapshot prefix — "
+                   "what the checkpoint agreement scans for")
+    p.add_argument("--snapshot-root", default=None,
+                   help="(master) per-host snapshot dirs live at "
+                   "SNAPSHOT_ROOT/host<i>")
+    p.add_argument("--devices-per-host", type=int, default=None,
+                   help="(master) force K virtual CPU devices per "
+                   "worker (XLA_FLAGS; local pod emulation)")
+    p.add_argument("--no-agents", action="store_true",
+                   help="(master) do not spawn local agents — print "
+                   "each host's agent command instead (real pods run "
+                   "one agent per machine)")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="(master) write the final status/history here")
+    p.add_argument("worker", nargs=argparse.REMAINDER,
+                   help="(master) the worker command, after `--`")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.agent:
+        if args.master is None or args.host_id is None \
+                or args.workdir is None:
+            p.error("--agent needs --master, --host-id and --workdir")
+        agent = PodAgent(args.master, args.host_id, args.workdir)
+        return agent.run()
+
+    worker = list(args.worker)
+    if worker and worker[0] == "--":
+        worker = worker[1:]
+    if not worker:
+        p.error("master mode needs the worker command after `--`")
+    master = PodMaster(
+        worker, n_hosts=args.hosts, snapshot_root=args.snapshot_root,
+        prefix=args.prefix, workdir=args.workdir, port=args.port,
+        bind_host=args.bind_host, coordinator_host=args.coordinator_host,
+        devices_per_host=args.devices_per_host,
+        spawn_agents=not args.no_agents)
+    rc = master.run()
+    report = master.status()
+    report["history"] = master.history
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+    print(json.dumps({k: report[k] for k in
+                      ("phase", "incarnation", "restarts",
+                       "restart_causes", "rc")}, default=str))
+    return rc if rc is not None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,20 @@ class SnapshotIntegrityError(ValueError):
     commit (``--snapshot auto`` / the supervisor restart loop)."""
 
 
+class SnapshotNonFiniteError(SnapshotIntegrityError):
+    """A commit was REFUSED because the params/velocity trees contain
+    NaN/inf.  Committing a poisoned state would poison every future
+    restart: the restart loops (supervisor, pod master) would faithfully
+    resume divergence forever.  Refusing the commit turns silent
+    corruption — a numerics bug, or the memory-corruption class of
+    environment fault observed on sandboxed CPU pods — into a loud
+    death of THIS life: the last committed checkpoint stays finite, the
+    restart machinery replays from it (exact when the fault was
+    transient), and the deterministic-bug valve bounds a real NaN bug.
+    Disable per-run with ``root.common.snapshot.reject_nonfinite=False``
+    for workloads that legitimately checkpoint non-finite leaves."""
+
+
 def iter_state_leaves(obj, prefix=""):
     """Flatten nested dict/list/tuple snapshot state into sorted
     (path, leaf) pairs — shared by the integrity manifest below and
@@ -76,12 +90,39 @@ def _leaf_digest(value):
     return {"sha256": hashlib.sha256(repr(value).encode()).hexdigest()}
 
 
+def commit_meta(state=None):
+    """Provenance of one checkpoint commit — which process, host, and
+    *life* (pod incarnation) wrote it.  Recorded in every backend's
+    manifest so the pod master's cross-host checkpoint agreement and
+    ``veles-tpu-blackbox`` timelines can attribute each commit
+    (``VELES_TPU_INCARNATION`` is threaded into workers by the pod
+    agents; standalone runs simply omit it)."""
+    import socket
+
+    from veles_tpu.telemetry.flight import _process_index
+    meta = {"process_index": _process_index(),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid()}
+    inc = os.environ.get("VELES_TPU_INCARNATION")
+    if inc is not None:
+        try:
+            meta["incarnation"] = int(inc)
+        except ValueError:
+            meta["incarnation"] = inc
+    if isinstance(state, dict) and "epoch" in state:
+        meta["epoch"] = state["epoch"]
+    return meta
+
+
 def state_manifest(state):
-    """Per-leaf checksum manifest of a snapshot state dict."""
-    return {"format": MANIFEST_FORMAT,
-            "created": time.time(),
-            "leaves": {path: _leaf_digest(v)
-                       for path, v in iter_state_leaves(state)}}
+    """Per-leaf checksum manifest of a snapshot state dict (plus the
+    :func:`commit_meta` provenance fields)."""
+    man = {"format": MANIFEST_FORMAT,
+           "created": time.time(),
+           "leaves": {path: _leaf_digest(v)
+                      for path, v in iter_state_leaves(state)}}
+    man.update(commit_meta(state))
+    return man
 
 
 def validate_state_manifest(state, manifest, source="snapshot"):
@@ -125,6 +166,171 @@ def _write_json_atomic(path, payload):
     os.replace(tmp, path)
 
 
+# ---------------------------------------------------------------------
+# cross-host checkpoint agreement (the pod tier, services.podmaster)
+#
+# In multi-controller SPMD every host commits its own checkpoint copy
+# (``per_host`` above); after a pod-wide death the restart point must be
+# a commit that is VALID ON EVERY HOST — a step-N commit present on host
+# 0 but torn or absent on host 1 would resume the pod from divergent
+# state (or crash-loop one host).  The helpers below are the pure core:
+# each host scans its own directory against the integrity manifests
+# (file sha only — no unpickling, a torn pickle is never fed to the
+# unpickler), the master intersects the reports, and each host rolls
+# back to the agreed commit before respawning.
+# ---------------------------------------------------------------------
+
+def scan_commits(directory, prefix):
+    """This prefix's committed checkpoints in ``directory``, validated
+    against their manifest sidecars WITHOUT unpickling: ``{name:
+    {"path", "mtime", "epoch", "incarnation", "process_index",
+    "valid", "error"}}``.  ``valid`` is True (manifest's file sha
+    matches), False (torn/corrupted, or unreadable), or None — a
+    legacy commit with no manifest, which agreement treats as
+    unverifiable (excluded) rather than trusted."""
+    out = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(prefix + "_") \
+                or name.endswith("_current") \
+                or name.endswith(MANIFEST_SUFFIX) \
+                or name.endswith(".corrupt") or ".tmp" in name:
+            continue
+        path = os.path.join(directory, name)
+        entry = {"path": path, "epoch": None, "incarnation": None,
+                 "process_index": None, "valid": None, "error": None}
+        try:
+            entry["mtime"] = os.path.getmtime(path)
+        except OSError:
+            continue
+        manifest = _load_manifest(path)
+        if manifest is not None:
+            entry["epoch"] = manifest.get("epoch")
+            entry["incarnation"] = manifest.get("incarnation")
+            entry["process_index"] = manifest.get("process_index")
+            recorded = manifest.get("file_sha256")
+            if recorded is None:
+                entry["valid"] = None
+                entry["error"] = "manifest without file sha"
+            elif os.path.isdir(path):
+                entry["valid"] = False
+                entry["error"] = "directory checkpoint with a file sha"
+            else:
+                try:
+                    entry["valid"] = _file_sha256(path) == recorded
+                    if not entry["valid"]:
+                        entry["error"] = "file sha mismatch (torn " \
+                            "or corrupted commit)"
+                except OSError as e:
+                    entry["valid"] = False
+                    entry["error"] = str(e)
+        out[name] = entry
+    return out
+
+
+def _commit_order_key(name, per_host_entries):
+    """Sort key for one commit name across hosts: epoch first (recorded
+    in the manifest, SPMD-lockstep so identical everywhere), then the
+    newest mtime any host saw — commit order is the same on every host,
+    so any host's mtime ordering is the pod's."""
+    epochs = [e.get("epoch") for e in per_host_entries
+              if e.get("epoch") is not None]
+    mtimes = [e.get("mtime", 0.0) for e in per_host_entries]
+    return (max(epochs) if epochs else -1, max(mtimes), name)
+
+
+def agree_commits(reports):
+    """The pod's restart checkpoint by cross-host agreement.
+
+    :param reports: ``{host: scan_commits(...)}`` — one report per
+        host, each over that host's OWN directory.
+    :returns: ``(agreed_name_or_None, detail)`` where detail maps every
+        candidate name to ``{"hosts": [...], "valid_on": [...],
+        "rejected": reason_or_None}`` — the newest name that is valid
+        on EVERY host wins; a name absent or torn anywhere is rejected
+        pod-wide (that is the point)."""
+    hosts = sorted(reports)
+    names = set()
+    for rep in reports.values():
+        names.update(rep)
+    detail = {}
+    candidates = []
+    for name in names:
+        entries = [reports[h][name] for h in hosts if name in reports[h]]
+        on = [h for h in hosts if name in reports[h]]
+        valid_on = [h for h in hosts
+                    if reports[h].get(name, {}).get("valid") is True]
+        if len(on) < len(hosts):
+            rejected = "absent on host(s) %s" % (
+                [h for h in hosts if h not in on],)
+        elif len(valid_on) < len(hosts):
+            bad = [h for h in hosts if h not in valid_on]
+            rejected = "invalid/unverified on host(s) %s" % (bad,)
+        else:
+            rejected = None
+            candidates.append((_commit_order_key(name, entries), name))
+        detail[name] = {"hosts": on, "valid_on": valid_on,
+                        "rejected": rejected}
+    if not candidates:
+        return None, detail
+    candidates.sort()
+    return candidates[-1][1], detail
+
+
+def rollback_to_commit(directory, prefix, agreed, quarantine=None,
+                       scan=None):
+    """Roll one host's checkpoint directory back to the agreed commit:
+    quarantine every commit NEWER than it (valid here but not
+    everywhere — resuming from it would diverge the pod) plus every
+    invalid one, and point ``<prefix>_current`` at the agreed name so
+    the respawned worker's ``--snapshot auto`` resumes from exactly the
+    pod-agreed state.  ``agreed=None`` (no commit valid everywhere)
+    quarantines everything — the pod starts fresh.  Returns the sorted
+    list of quarantined names; best-effort on I/O errors (the respawn
+    must proceed — ``--snapshot auto``'s own fallback covers leftovers).
+
+    :param quarantine: when given (the pod master's explicit
+        newer-than-agreed list, computed from the CROSS-host ordering),
+        it replaces the local "newer" test — same-epoch commits break
+        ties by mtime, and local clocks can disagree with the pod-wide
+        key, so every host must quarantine the SAME set.  Locally
+        invalid commits are quarantined either way.
+    :param scan: an existing ``scan_commits(directory, prefix)`` report
+        to reuse — the agent computed one for the agreement moments ago
+        over the same quiesced ring, and rescanning would sha256 every
+        checkpoint a second time on the restart path.
+    """
+    if scan is None:
+        scan = scan_commits(directory, prefix)
+    agreed_key = None
+    if agreed is not None and agreed in scan:
+        agreed_key = _commit_order_key(agreed, [scan[agreed]])
+    quarantined = []
+    for name, entry in scan.items():
+        if name == agreed:
+            continue
+        if quarantine is not None:
+            newer = name in quarantine
+        else:
+            newer = agreed_key is None or \
+                _commit_order_key(name, [entry]) > agreed_key
+        if newer or entry["valid"] is not True:
+            if SnapshotterBase.quarantine(entry["path"]):
+                quarantined.append(name)
+    current = os.path.join(directory, "%s_current" % prefix)
+    try:
+        if os.path.islink(current) or os.path.exists(current):
+            os.remove(current)
+        if agreed is not None:
+            os.symlink(agreed, current)
+    except OSError:
+        pass
+    return sorted(quarantined)
+
+
 class SnapshotterRegistry(UnitRegistry, MappedRegistry):
     """Name → snapshotter class (ref MappedUnitRegistry usage)."""
 
@@ -133,6 +339,11 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
     #: sharded backends whose save is itself a cross-process collective
     #: (every process writes its own shards) set this True
     all_processes_export = False
+    #: class-level default for the commit-time poison valve so
+    #: partially-constructed instances (tests build backends via
+    #: ``__new__``) still carry the valve; ``__init__`` overrides it
+    #: from config
+    reject_nonfinite = True
     mapping = {}
 
     def __init__(self, workflow, **kwargs):
@@ -174,6 +385,29 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             root.common.snapshot.get("retry_backoff_ms", 100.0))) / 1e3
         self.manifest = bool(kwargs.get(
             "manifest", root.common.snapshot.get("manifest", True)))
+        #: commit-time poison valve (:class:`SnapshotNonFiniteError`):
+        #: refuse to commit NaN/inf params/velocity so restart loops
+        #: can never resume a poisoned state
+        self.reject_nonfinite = bool(kwargs.get(
+            "reject_nonfinite",
+            root.common.snapshot.get("reject_nonfinite", True)))
+        #: per-host export (the pod tier, services.podmaster): every
+        #: process writes its own FULL checkpoint copy into its own
+        #: (host-local) ``directory`` instead of only process 0 — the
+        #: durability model for pods with host-local disks, and the
+        #: substrate the pod master's cross-host checkpoint agreement
+        #: runs over (a commit is only restartable if it is valid on
+        #: ALL hosts).  Ignored on sharded backends whose save already
+        #: is the collective (orbax writes one shared directory).
+        self.per_host = bool(kwargs.get(
+            "per_host", root.common.snapshot.get("per_host", False)))
+        if self.per_host and self.all_processes_export:
+            import logging
+            logging.getLogger("Snapshotter").warning(
+                "snapshot.per_host ignored: the %s backend already has "
+                "every process writing (its save is the collective)",
+                type(self).__name__)
+            self.per_host = False
         #: optional run condition (a Bool or callable) checked INSIDE
         #: run() instead of via gate_skip: the unit must execute every
         #: cycle so the multi-host preemption agreement below runs
@@ -262,12 +496,15 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                 return
         self._last_time = time.time()
         if multihost and jax.process_index() != 0 \
-                and not self.all_processes_export:
+                and not self.all_processes_export and not self.per_host:
             # every process participates in the collective gathers inside
             # collect(), but only process 0 writes (ref
             # only-master-snapshots, snapshotter.py:160).  Sharded
             # backends (orbax) set ``all_processes_export``: their save
             # IS the collective — every process writes its own shards.
+            # ``per_host`` instead has every process export a full copy
+            # into its own host-local directory (the pod tier's
+            # agreement substrate; collect() is symmetric either way).
             self.collect()
         else:
             self.export()
@@ -286,8 +523,41 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                                     CODECS[self.compression][2])
         path = os.path.join(self.directory, fname)
         state = self.collect()          # device→host gather happens HERE
+        self._check_finite(state)
         self._dispatch_write(self._write, state, fname, path)
         return path
+
+    def _check_finite(self, state, trees=("params", "velocity")):
+        """The ``reject_nonfinite`` poison valve (see
+        :class:`SnapshotNonFiniteError`): float leaves of the model
+        trees must be finite before any bytes hit storage."""
+        if not self.reject_nonfinite or not isinstance(state, dict):
+            return
+        import numpy as np
+        bad = []
+        for key in trees:
+            tree = state.get(key)
+            if tree is None:
+                continue
+            for path, leaf in iter_state_leaves(tree, "/" + key):
+                try:
+                    a = np.asarray(leaf)
+                except Exception:   # noqa: BLE001 — non-array leaf
+                    continue
+                if np.issubdtype(a.dtype, np.floating) and \
+                        not np.isfinite(a).all():
+                    bad.append(path)
+        if bad:
+            from veles_tpu.telemetry import flight
+            flight.record("snapshot.nonfinite", leaves=bad[:8],
+                          prefix=self.prefix)
+            raise SnapshotNonFiniteError(
+                "refusing to commit a poisoned checkpoint: %d "
+                "non-finite model leaf/leaves, first: %s — the last "
+                "committed checkpoint stays finite; restart loops "
+                "resume from it (root.common.snapshot."
+                "reject_nonfinite=False disables this valve)"
+                % (len(bad), ", ".join(bad[:5])))
 
     def _dispatch_write(self, write_fn, *args):
         """Run the (sync) write, or hand it to the single background
@@ -445,10 +715,13 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         lost (never raises — shared by all backends; __dict__ reads so
         a partially constructed unit can still export)."""
         from veles_tpu.telemetry import flight
+        meta = commit_meta()
         flight.record("snapshot",
                       unit=self.__dict__.get("name"),
                       destination=destination,
-                      epoch=self.__dict__.get("_epoch_counter"))
+                      epoch=self.__dict__.get("_epoch_counter"),
+                      process_index=meta.get("process_index"),
+                      incarnation=meta.get("incarnation"))
 
     def _flip_current(self, fname):
         """Point ``<prefix>_current`` at a COMPLETED checkpoint — the
@@ -628,15 +901,33 @@ class TrainingSnapshotter(SnapshotterBase):
         trainer._step_counter = snapshot.get("step_counter", 0)
         loader.state = snapshot["loader"]
         prng.restore_states(snapshot["prng"])
-        if "trainer_stats" in snapshot and \
-                getattr(trainer, "mesh_config", None) is None:
-            # mid-sweep accumulators (see collect); under a mesh the
-            # replicated mirrors are re-placed by _shard_pins instead —
-            # skipped there, so a sharded mid-epoch resume restarts the
-            # interrupted sweep's stats (params/PRNG/loader stay exact)
+        if "trainer_stats" in snapshot:
+            # mid-sweep accumulators (see collect).  Under a mesh the
+            # accumulators are REPLICATED scalars (_shard_pins), so the
+            # restore re-places them explicitly with the same sharding —
+            # multi-process safe via make_array_from_callback (every
+            # host restores the identical checkpointed value, so the
+            # replicas agree by construction).  This is what keeps a
+            # pod's graceful mid-epoch preemption bit-exact: without it
+            # a sharded resume would restart the interrupted sweep's
+            # stats and the epoch's decision metrics would diverge from
+            # an uninterrupted run.
             import jax.numpy as jnp
+            mc = getattr(trainer, "mesh_config", None)
+            if mc is None:
+                place = jnp.asarray
+            else:
+                import numpy as np
+
+                from veles_tpu.parallel import sharding
+                repl = sharding.replicated_sharding(mc)
+
+                def place(v, _repl=repl):
+                    a = np.asarray(v)
+                    return jax.make_array_from_callback(
+                        a.shape, _repl, lambda idx: a[idx])
             trainer.class_stats = [
-                jax.tree_util.tree_map(jnp.asarray, s)
+                jax.tree_util.tree_map(place, s)
                 for s in snapshot["trainer_stats"]]
         dec = getattr(workflow, "decision", None)
         if dec is not None and "decision" in snapshot:
@@ -736,15 +1027,18 @@ class DBSnapshotter(TrainingSnapshotter):
             "CREATE TABLE IF NOT EXISTS snapshots ("
             " id INTEGER PRIMARY KEY AUTOINCREMENT,"
             " prefix TEXT, suffix TEXT, created REAL, state BLOB,"
-            " sha256 TEXT)")
-        try:      # pre-integrity databases: widen in place
-            conn.execute("ALTER TABLE snapshots ADD COLUMN sha256 TEXT")
-        except sqlite3.OperationalError:
-            pass  # already has the column
+            " sha256 TEXT, meta TEXT)")
+        for clause in ("sha256 TEXT", "meta TEXT"):
+            try:  # pre-integrity / pre-provenance databases: widen
+                conn.execute("ALTER TABLE snapshots ADD COLUMN "
+                             + clause)
+            except sqlite3.OperationalError:
+                pass  # already has the column
         return conn
 
     def export(self):
         state = self.collect()          # device→host gather on the loop
+        self._check_finite(state)
         suffix = self.suffix()
         dest = "%s#%s_%s" % (self.dsn, self.prefix, suffix)
         self._dispatch_write(self._db_write, state, suffix, dest)
@@ -755,15 +1049,18 @@ class DBSnapshotter(TrainingSnapshotter):
         blob = pickle.dumps(state, protocol=4)
         digest = hashlib.sha256(blob).hexdigest()
 
+        meta = json.dumps(commit_meta(state))
+
         def commit():
             conn = self._connect()
             try:
                 with conn:
                     conn.execute(
                         "INSERT INTO snapshots"
-                        " (prefix, suffix, created, state, sha256)"
-                        " VALUES (?, ?, ?, ?, ?)",
-                        (self.prefix, suffix, time.time(), blob, digest))
+                        " (prefix, suffix, created, state, sha256, meta)"
+                        " VALUES (?, ?, ?, ?, ?, ?)",
+                        (self.prefix, suffix, time.time(), blob, digest,
+                         meta))
                     if self.keep_last > 0:
                         # the ring, in-transaction: the insert and the
                         # prune commit (or roll back) together
@@ -926,6 +1223,20 @@ class OrbaxSnapshotter(TrainingSnapshotter):
         state = self.collect()
         arrays = {"params": state.pop("params"),
                   "velocity": state.pop("velocity")}
+        if self.reject_nonfinite:
+            # device-side reduction (no gather — the backend's point):
+            # one scalar per leaf, synced with the sidecar write anyway
+            import jax.numpy as jnp
+            bad = [p for p, v in iter_state_leaves(arrays)
+                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                   and not bool(jnp.isfinite(v).all())]
+            if bad:
+                from veles_tpu.telemetry import flight
+                flight.record("snapshot.nonfinite", leaves=bad[:8],
+                              prefix=self.prefix)
+                raise SnapshotNonFiniteError(
+                    "refusing to commit a poisoned checkpoint: "
+                    "non-finite model leaves %s" % bad[:5])
         self.flush()                    # one in-flight commit at a time
         os.makedirs(path, exist_ok=True)
         if jax.process_index() == 0:

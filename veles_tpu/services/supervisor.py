@@ -52,6 +52,179 @@ from veles_tpu.telemetry import flight
 #: EX_TEMPFAIL — the graceful-preemption exit code (__main__)
 EX_TEMPFAIL = 75
 
+#: abort-class signals XLA startup dies with in sandboxed environments
+#: (ROADMAP "Known environment flake": the crash lands inside backend
+#: init, before the program's first print)
+STARTUP_FLAKE_SIGNALS = (signal.SIGSEGV, signal.SIGABRT, signal.SIGBUS,
+                         signal.SIGILL)
+
+
+# --------------------------------------------------------------- shared
+# The pod master's per-host agents (services.podmaster) supervise the
+# same training command with the same death taxonomy — the policy
+# differs (pod-coordinated restarts vs the local loop below), the
+# classification and backoff must not.  These module functions are that
+# shared core.
+
+def backoff_delay(attempt, base_s, max_s, rng):
+    """Exponential backoff with jitter: base·2^(n-1) capped at max_s,
+    scaled by [0.5, 1.0) — the fleet router's shape, shared by the
+    single-host Supervisor and the pod master (test-pinned)."""
+    d = min(base_s * (2 ** max(attempt - 1, 0)), max_s)
+    return d * (0.5 + 0.5 * rng.random())
+
+
+def read_crashdump(blackbox_dir, since):
+    """(events, meta) of the newest crashdump written after ``since``,
+    or ([], None).  ``since`` is the attempt's spawn time on the SAME
+    clock that stamps the dump's mtime, so no slop is needed — and none
+    is allowed: a previous attempt's dump lands between its exit and
+    this spawn, and any slop window shorter backoffs can fit into would
+    attribute that stale dump (and its signature) to the wrong death.
+    Never raises — forensics inform the policy, they must not crash
+    it."""
+    try:
+        newest, newest_ts = None, since
+        for name in os.listdir(blackbox_dir):
+            if not name.startswith("crashdump-") or ".tmp-" in name:
+                continue
+            path = os.path.join(blackbox_dir, name)
+            ts = os.path.getmtime(path)
+            if ts >= newest_ts:
+                newest, newest_ts = path, ts
+        if newest is None:
+            return [], None
+        events = []
+        with open(os.path.join(newest, "events.jsonl")) as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+        meta = None
+        try:
+            with open(os.path.join(newest, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            pass
+        return events, meta
+    except OSError:
+        return [], None
+
+
+def classify_exit(rc, blackbox_dir=None, since=0.0):
+    """(kind, crash_signature) for one child exit — the crashdump the
+    child left behind distinguishes an injected/forced death from a
+    deterministic bug.  Kinds: ``done``, ``preempt`` (exit 75),
+    ``killed:SIG*`` (negative rc), ``fault-injection`` (crashdump
+    carries a ``fault.injected`` event), ``crash:<Type>`` /
+    ``crash:rcN`` (signature set)."""
+    if rc == 0:
+        return "done", None
+    if rc == EX_TEMPFAIL:
+        return "preempt", None
+    if rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = "SIG%d" % -rc
+        return "killed:%s" % name, None
+    events, meta = ([], None) if blackbox_dir is None else \
+        read_crashdump(blackbox_dir, since)
+    for ev in events:
+        if ev.get("kind") == "fault.injected":
+            return "fault-injection", None
+    err = (meta or {}).get("error")
+    if err:
+        sig = "%s:%s" % (err.get("type"), err.get("message"))
+        return "crash:%s" % err.get("type"), sig
+    return "crash:rc%d" % rc, "rc%d" % rc
+
+
+#: the flake fingerprint's output bound — a real run prints epochs,
+#: flight markers, result lines; a crash inside init does not (the
+#: agent-side ``PodAgent._startup_shaped_log`` uses the same bound)
+STARTUP_FLAKE_OUTPUT_LIMIT = 16384
+
+
+def is_startup_flake(rc, out, err):
+    """True when a subprocess died by an abort-class signal with a
+    startup-shaped transcript — the documented sandbox XLA/glibc
+    abort (ROADMAP "Known environment flake").  The crash lands inside
+    backend/allocator initialization, usually before the program's
+    first print but sometimes just after it (the auto-resume banner,
+    glibc's own ``malloc(): invalid size`` / ``corrupted double-linked
+    list`` lines), so the fingerprint is: abort-class signal, little
+    output, and NO Python traceback — a Python-level death always
+    leaves one; the memory-corruption class kills the process from
+    under the interpreter.  A deterministic abort still fails after
+    the single retry, so real native bugs cannot hide behind this.
+    ``out``/``err`` must have been captured — uncaptured (None)
+    streams read as "unknown output", never as a flake."""
+    if out is None or err is None:
+        return False
+    codes = set()
+    for s in STARTUP_FLAKE_SIGNALS:
+        codes.add(-int(s))          # subprocess's negative-rc spelling
+        codes.add(128 + int(s))     # shell-style spelling
+    if rc not in codes:
+        return False
+    blob = out + err
+    return len(blob) <= STARTUP_FLAKE_OUTPUT_LIMIT \
+        and "Traceback" not in blob
+
+
+def newest_mtime(paths):
+    """Newest mtime across files/shallow directories, or None — THE
+    progress signal: the supervisor and the pod master's agents watch
+    it to tell a stuck worker from a slowly-advancing one."""
+    newest = None
+    for path in paths:
+        try:
+            if os.path.isdir(path):
+                with os.scandir(path) as entries:
+                    for e in entries:
+                        try:
+                            # no follow: quarantine leaves _current
+                            # DANGLING until the next commit, and one
+                            # bad symlink must not hide the rest of
+                            # the directory's mtimes
+                            ts = e.stat(follow_symlinks=False).st_mtime
+                        except OSError:
+                            continue
+                        if newest is None or ts > newest:
+                            newest = ts
+            else:
+                ts = os.path.getmtime(path)
+                if newest is None or ts > newest:
+                    newest = ts
+        except OSError:
+            continue
+    return newest
+
+
+def run_with_startup_retry(argv, retries=2, on_retry=None, **run_kw):
+    """``subprocess.run(argv, capture_output=True, ...)`` that retries
+    (twice by default — the abort rate comes in storms) when the child
+    hit the sandbox XLA-startup abort (:func:`is_startup_flake`) —
+    shared by the multi-process test suites and the chaos harnesses so
+    each stops hand-rolling its own tolerance for the environment
+    flake.  Only the flake fingerprint retries, so a deterministic
+    failure costs at most ``retries`` extra runs.  Output capture is
+    forced on: the flake test needs the streams."""
+    run_kw.setdefault("text", True)
+    run_kw["capture_output"] = True
+    for attempt in range(retries + 1):
+        r = subprocess.run(argv, **run_kw)
+        if attempt < retries and is_startup_flake(
+                r.returncode, r.stdout, r.stderr):
+            flight.record("spawn.startup_flake", rc=r.returncode,
+                          attempt=attempt + 1, argv=argv[:4])
+            if on_retry is not None:
+                on_retry(attempt + 1, r)
+            continue
+        return r
+
 
 class Supervisor(object):
     """Spawn/respawn one training command under the policy above.
@@ -267,12 +440,11 @@ class Supervisor(object):
                 return rc
 
     def backoff_delay(self, attempt):
-        """Exponential backoff with jitter: base·2^(n-1) capped at
-        backoff_max, scaled by [0.5, 1.0) — test-pinned (the same
-        shape as the fleet router's)."""
-        d = min(self.backoff_base * (2 ** max(attempt - 1, 0)),
-                self.backoff_max)
-        return d * (0.5 + 0.5 * self._rng.random())
+        """Exponential backoff with jitter (module-level
+        :func:`backoff_delay`, shared with the pod master) —
+        test-pinned."""
+        return backoff_delay(attempt, self.backoff_base,
+                             self.backoff_max, self._rng)
 
     # ------------------------------------------------------------- spawn
     def _spawn(self):
@@ -304,96 +476,15 @@ class Supervisor(object):
 
     # ---------------------------------------------------- classification
     def _classify(self, rc, spawned):
-        """(kind, crash_signature) for one child exit — the crashdump
-        the child left behind distinguishes an injected/forced death
-        from a deterministic bug."""
-        if rc == 0:
-            return "done", None
-        if rc == EX_TEMPFAIL:
-            return "preempt", None
-        if rc < 0:
-            try:
-                name = signal.Signals(-rc).name
-            except ValueError:
-                name = "SIG%d" % -rc
-            return "killed:%s" % name, None
-        events, meta = self._read_crashdump(spawned)
-        for ev in events:
-            if ev.get("kind") == "fault.injected":
-                return "fault-injection", None
-        err = (meta or {}).get("error")
-        if err:
-            sig = "%s:%s" % (err.get("type"), err.get("message"))
-            return "crash:%s" % err.get("type"), sig
-        return "crash:rc%d" % rc, "rc%d" % rc
-
-    def _read_crashdump(self, since):
-        """events + meta of the newest crashdump written after
-        ``since``, or ([], None).  ``since`` is this attempt's spawn
-        time on the SAME clock that stamps the dump's mtime, so no
-        slop is needed — and none is allowed: a previous attempt's
-        dump lands between its exit and this spawn, and any slop
-        window shorter backoffs can fit into would attribute that
-        stale dump (and its signature) to the wrong death.  Never
-        raises — forensics inform the policy, they must not crash
-        it."""
-        try:
-            newest, newest_ts = None, since
-            for name in os.listdir(self.blackbox_dir):
-                if not name.startswith("crashdump-") \
-                        or ".tmp-" in name:
-                    continue
-                path = os.path.join(self.blackbox_dir, name)
-                ts = os.path.getmtime(path)
-                if ts >= newest_ts:
-                    newest, newest_ts = path, ts
-            if newest is None:
-                return [], None
-            events = []
-            with open(os.path.join(newest, "events.jsonl")) as f:
-                for line in f:
-                    try:
-                        events.append(json.loads(line))
-                    except ValueError:
-                        continue
-            meta = None
-            try:
-                with open(os.path.join(newest, "meta.json")) as f:
-                    meta = json.load(f)
-            except (OSError, ValueError):
-                pass
-            return events, meta
-        except OSError:
-            return [], None
+        """Delegates to the shared :func:`classify_exit` (the pod
+        master's agents classify identically)."""
+        return classify_exit(rc, self.blackbox_dir, spawned)
 
     # ----------------------------------------------------------- helpers
     def _progress_marker(self):
-        """Newest mtime across the progress paths (shallow scan of
-        directories) — checkpoint commits move it forward."""
-        newest = None
-        for path in self.progress_paths:
-            try:
-                if os.path.isdir(path):
-                    with os.scandir(path) as entries:
-                        for e in entries:
-                            try:
-                                # no follow: quarantine leaves _current
-                                # DANGLING until the next commit, and
-                                # one bad symlink must not hide the
-                                # rest of the directory's mtimes
-                                ts = e.stat(
-                                    follow_symlinks=False).st_mtime
-                            except OSError:
-                                continue
-                            if newest is None or ts > newest:
-                                newest = ts
-                else:
-                    ts = os.path.getmtime(path)
-                    if newest is None or ts > newest:
-                        newest = ts
-            except OSError:
-                continue
-        return newest
+        """Newest mtime across the progress paths — checkpoint commits
+        move it forward (shared scan with the pod master's agents)."""
+        return newest_mtime(self.progress_paths)
 
     def _info(self, msg, *args):
         self._log.info(msg, *args)

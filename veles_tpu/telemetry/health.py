@@ -47,18 +47,55 @@ _state = {
     "desync_latched": False,
     "last_progress": None,        # monotonic of the last step/unit
     "last_step": None,
+    # the pod-agent progress bridge (services.podmaster): when
+    # VELES_TPU_PROGRESS_FILE is set, liveness is mirrored into that
+    # file (throttled) so the per-host agent can heartbeat real step
+    # progress to the pod master without parsing worker output —
+    # False = env not read yet, None = bridge disabled
+    "progress_file": False,
+    "progress_file_written": 0.0,
 }
 _lock = threading.Lock()
+
+#: minimum seconds between progress-file writes (the bridge is a
+#: liveness signal, not a metrics channel — its reader keys off mtime)
+PROGRESS_FILE_INTERVAL = 0.25
+
+
+def _progress_file():
+    lazy = _state["progress_file"]
+    if lazy is False:
+        lazy = os.environ.get("VELES_TPU_PROGRESS_FILE") or None
+        _state["progress_file"] = lazy
+    return lazy
 
 
 # ------------------------------------------------------------- progress
 def note_progress(step=None):
     """Record liveness — called per unit run by ``Workflow._drive`` and
     per sweep by the staged trainer.  One float store: cheap enough for
-    the hot loop, signal-safe, never raises."""
-    _state["last_progress"] = time.monotonic()
+    the hot loop, signal-safe, never raises.  With
+    ``VELES_TPU_PROGRESS_FILE`` set (pod agents set it on their
+    workers) the liveness also lands in that file, throttled to one
+    small write per :data:`PROGRESS_FILE_INTERVAL` — the collective-
+    hang detector's ground truth: a wedged pod stops moving this file
+    on EVERY host at once."""
+    now = time.monotonic()
+    _state["last_progress"] = now
     if step is not None:
         _state["last_step"] = step
+    path = _progress_file()
+    if path is not None and \
+            now - _state["progress_file_written"] >= \
+            PROGRESS_FILE_INTERVAL:
+        _state["progress_file_written"] = now
+        try:
+            with open(path, "w") as f:
+                f.write("%s\n" % (_state["last_step"]
+                                  if _state["last_step"] is not None
+                                  else ""))
+        except OSError:
+            _state["progress_file"] = None   # dead path: stop trying
 
 
 def last_progress_age():

@@ -201,9 +201,15 @@ class Workflow(Container):
         # chaos knob (tools/train_chaos.py): a per-unit-run sleep that
         # stretches the scheduler so external kills reliably land
         # mid-sweep.  Zero (the default) costs one config read per run()
+        # — and with chaos.unit_delay_file set the sleep is further
+        # gated on that file EXISTING, so a harness can switch a
+        # long stall on mid-run (tools/pod_chaos.py freezes one host's
+        # scheduler this way to forge a collective hang) and disarm it
+        # again for the respawn
         from veles_tpu.config import root as _root
         unit_delay = float(
             _root.common.chaos.get("unit_delay_ms", 0)) / 1e3
+        delay_file = _root.common.chaos.get("unit_delay_file", None)
         while queue and not bool(self.stopped):
             if bool(self.preempt_requested) and not self.preempted_:
                 if can_break is None:
@@ -238,7 +244,8 @@ class Workflow(Container):
                 unit.reset_gate()
                 continue
             if not bool(unit.gate_skip):
-                if unit_delay:
+                if unit_delay and (delay_file is None
+                                   or os.path.exists(delay_file)):
                     time.sleep(unit_delay)
                 fl_record("unit.start", unit=unit.name)
                 dt = unit._run_wrapped()
