@@ -309,6 +309,38 @@ class TestHealthEndpoint:
             health.disarm_watchdog()
             server.stop()
 
+    def test_api_health_carries_pod_size_block(self, blackbox_dir):
+        """The pod master threads root.common.pod.size/total/degraded/
+        lost_hosts into every worker — probing any survivor's
+        /api/health answers "how big is the pod, who is missing"."""
+        from urllib.request import urlopen
+
+        from veles_tpu.config import root
+        from veles_tpu.services.web_status import WebStatusServer
+        root.common.pod.update({"size": 1, "total": 2,
+                                "degraded": True, "lost_hosts": [1]})
+        server = WebStatusServer(port=0)
+        server.start()
+        try:
+            state = json.load(urlopen(
+                "http://127.0.0.1:%d/api/health" % server.port))
+            assert state["pod"] == {"size": 1, "total": 2,
+                                    "degraded": True,
+                                    "lost_hosts": [1]}
+        finally:
+            server.stop()
+            for key in ("size", "total", "degraded", "lost_hosts"):
+                delattr(root.common.pod, key)
+        # without the master's block, no pod key at all
+        server = WebStatusServer(port=0)
+        server.start()
+        try:
+            state = json.load(urlopen(
+                "http://127.0.0.1:%d/api/health" % server.port))
+            assert "pod" not in state
+        finally:
+            server.stop()
+
 
 class TestLauncherIntegration:
     def test_initialize_failure_stops_services(self, blackbox_dir):

@@ -17,8 +17,10 @@ from veles_tpu.services.podmaster import (IncarnationFence, PodMaster,
                                           merge_config_list,
                                           merge_worker_env)
 from veles_tpu.services.snapshotter import (MANIFEST_SUFFIX,
+                                            SnapshotReshardError,
                                             _commit_order_key,
                                             agree_commits,
+                                            reshard_state,
                                             rollback_to_commit,
                                             scan_commits)
 from veles_tpu.services.supervisor import is_startup_flake
@@ -529,8 +531,9 @@ class TestPodMasterPolicy:
         calls = {}
         monkeypatch.setattr(
             master, "_spawn_all",
-            lambda agreed, rollback, quarantine=None: calls.update(
-                agreed=agreed, rollback=rollback, quarantine=quarantine))
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(agreed=agreed, rollback=rollback,
+                         quarantine=quarantine))
         master._last_agreed = "wf_1.pickle.gz"
         master._last_agreed_key = (1, 100.0, "wf_1.pickle.gz")
         master._round_cause = {"cause": "stale-heartbeat", "hosts": [1]}
@@ -550,9 +553,15 @@ class TestPodMasterPolicy:
         assert master.history[-1]["verdict"] == "respawn"
 
     def test_missing_report_without_pod_verified_gives_up(
-            self, master, monkeypatch):
-        """No pod-verified fallback + an incomplete view: give up with
-        the data intact instead of quarantining every checkpoint."""
+            self, tmp_path, monkeypatch):
+        """No pod-verified fallback + an incomplete view: a NON-elastic
+        pod gives up with the data intact instead of quarantining every
+        checkpoint (the elastic recycle toward a loss verdict is the
+        test below)."""
+        master = PodMaster(
+            ["python", "-m", "veles_tpu", "wf.py", "--snapshot", "auto"],
+            n_hosts=2, workdir=str(tmp_path / "pod"), prefix="wf",
+            spawn_agents=False, seed=7, elastic=False)
         spawned = []
         monkeypatch.setattr(master, "_spawn_all",
                             lambda *a, **k: spawned.append(1))
@@ -568,6 +577,34 @@ class TestPodMasterPolicy:
         assert master.history[-1]["verdict"] == "agreement-incomplete"
         assert not spawned
 
+    def test_elastic_cold_start_recycles_toward_loss_not_giveup(
+            self, master, monkeypatch):
+        """The same incomplete view on an ELASTIC pod (agent-dead host,
+        no pod-verified fallback — the cold-start host death) must NOT
+        give up: it recycles the round so the absence strikes can
+        accumulate toward the permanent-loss verdict, data intact."""
+        spawned, restarted = [], []
+        monkeypatch.setattr(master, "_spawn_all",
+                            lambda *a, **k: spawned.append(1))
+        monkeypatch.setattr(
+            master, "_begin_restart",
+            lambda trigger, now: restarted.append(trigger))
+        master._round_cause = {"cause": "worker-exit",
+                               "exit": {"kind": "killed:SIGKILL"}}
+        master._round_exits = {0: {"kind": "killed:SIGKILL", "rc": -9}}
+        master._round_started = 0.0
+        master.hosts[0]["manifests"] = {
+            "wf_2.pickle.gz": {"epoch": 2, "mtime": 200.0,
+                               "valid": True}}
+        master._tick_agreeing(1000.0)
+        assert master.phase != "giveup"
+        assert not spawned
+        assert restarted == [{"cause": "host-absent-retry",
+                              "hosts": [1]}]
+        assert master.absence_strikes[1] == 1
+        # the struck host is not yet lost — one strike short
+        assert not master.lost_hosts
+
     def test_full_reports_fresh_start_quarantines_all(
             self, master, monkeypatch):
         """With EVERY host reporting and no commit valid everywhere,
@@ -576,8 +613,8 @@ class TestPodMasterPolicy:
         calls = {}
         monkeypatch.setattr(
             master, "_spawn_all",
-            lambda agreed, rollback, quarantine=None: calls.update(
-                agreed=agreed, quarantine=quarantine))
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(agreed=agreed, quarantine=quarantine))
         master._round_cause = {"cause": "worker-exit",
                                "exit": {"kind": "killed:SIGKILL"}}
         master._round_exits = {0: {"kind": "killed:SIGKILL", "rc": -9}}
@@ -704,3 +741,471 @@ class TestStartupFlakeFingerprint:
 
     def test_uncaptured_streams_never_read_as_flake(self):
         assert not is_startup_flake(-11, None, None)
+
+
+# =====================================================================
+# elastic tier: resize valve bucket, strike -> degrade -> re-expand
+# =====================================================================
+
+class TestResizeValveBucket:
+    def test_resize_rounds_never_consume_crash_loop_budget(self):
+        """A planned topology change (degrade/re-expand) lives in its
+        own bucket: ten resizes through a max_restarts=1 valve and the
+        crash-loop budget is still intact."""
+        v = PodValves(max_restarts=1, window_seconds=600.0,
+                      deterministic_limit=2)
+        for i in range(10):
+            assert v.admit(float(i), resize=True) == "respawn"
+        assert v.resize_restarts == 10
+        assert v.admit(20.0) == "respawn"     # budget untouched
+
+    def test_resize_rounds_never_feed_the_deterministic_counter(self):
+        v = PodValves(max_restarts=99, window_seconds=600.0,
+                      deterministic_limit=2)
+        sig = ("0=crash:X",)
+        assert v.admit(0.0, sig, progressed=False) == "respawn"
+        # a resize between two identical crashes must not advance the
+        # signature streak (it passes the same signature the round saw)
+        assert v.admit(1.0, sig, progressed=False,
+                       resize=True) == "respawn"
+        assert v.admit(2.0, sig, progressed=False) == \
+            "deterministic-bug"     # streak 2 -> trips, not earlier
+
+
+class TestElasticPolicy:
+    def _prime_round(self, master, cause=None):
+        master._round_cause = cause or {"cause": "stale-heartbeat",
+                                        "hosts": [1]}
+        master._round_exits = {}
+        master._round_started = 0.0
+
+    def test_strike_limit_classifies_loss_and_degrades(
+            self, master, monkeypatch):
+        """The final strike degrades the pod: one resize-bucketed
+        restart on the survivors from THEIR agreement, the lost host's
+        frozen ring no longer voting."""
+        calls = {}
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(agreed=agreed, quarantine=quarantine,
+                         hosts=hosts))
+        self._prime_round(master)
+        master.absence_strikes[1] = master.loss_strikes - 1
+        master.hosts[0]["manifests"] = {
+            "wf_3.pickle.gz": {"epoch": 3, "mtime": 300.0,
+                               "valid": True}}
+        master._tick_agreeing(1000.0)
+        assert master.lost_hosts == {1}
+        assert calls["hosts"] == [0]
+        assert calls["agreed"] == "wf_3.pickle.gz"
+        rec = master.history[-1]
+        assert rec["resize"] == "degrade"
+        assert rec["cause"] == "host-loss:1"
+        assert rec["counted"] is False
+        assert rec["verdict"] == "respawn"
+        assert master.valves.resize_restarts == 1
+        assert master.status()["degraded"] is True
+        assert master.status()["lost_hosts"] == [1]
+
+    def test_last_survivor_is_never_classified_lost(
+            self, tmp_path, monkeypatch):
+        """With every live host absent there is nowhere to degrade TO:
+        that is a master partition, not a host loss — the old
+        agreement-incomplete giveup holds, data intact."""
+        master = PodMaster(
+            ["python", "-m", "veles_tpu", "wf.py"], n_hosts=2,
+            workdir=str(tmp_path / "pod"), prefix="wf",
+            spawn_agents=False, seed=7)
+        spawned = []
+        monkeypatch.setattr(master, "_spawn_all",
+                            lambda *a, **k: spawned.append(1))
+        self._prime_round(master, {"cause": "stale-heartbeat",
+                                   "hosts": [0, 1]})
+        master.absence_strikes[0] = 99
+        master.absence_strikes[1] = 99
+        master._tick_agreeing(1000.0)
+        assert not master.lost_hosts
+        assert master.phase == "giveup"
+        assert not spawned
+
+    def test_returning_agent_triggers_capacity_restore(self, master):
+        class FakeConn:
+            alive = True
+        now = 1000.0
+        master.lost_hosts = {1}
+        master.phase = "running"
+        master.hosts[0].update(heartbeat_ts=now, progress_ts=now,
+                               worker_alive=True)
+        master.hosts[1]["conn"] = FakeConn()
+        trig = master._detect_trigger(now)
+        assert trig == {"cause": "capacity-restore", "hosts": [1]}
+        # a failed re-expansion blocks the trigger until the agent
+        # re-registers (agent_up clears the block)
+        master._reexpand_blocked = {1}
+        assert master._detect_trigger(now) is None
+
+    def test_blocked_reexpand_retries_after_cooldown(self, master):
+        """A block whose agent simply STAYS connected never sees a
+        fresh agent_up — the timestamped block expires after the
+        cooldown so the pod cannot run degraded forever on healthy
+        capacity."""
+        class FakeConn:
+            alive = True
+        now = 1000.0
+        master.lost_hosts = {1}
+        master.phase = "running"
+        master.hosts[0].update(heartbeat_ts=now, progress_ts=now,
+                               worker_alive=True)
+        master.hosts[1]["conn"] = FakeConn()
+        master._reexpand_blocked = {1}
+        master._reexpand_block_ts = {1: now}
+        assert master._detect_trigger(now) is None
+        cooldown = max(60.0, master.loss_window_s)
+        trig = master._detect_trigger(now + cooldown + 1.0)
+        assert trig == {"cause": "capacity-restore", "hosts": [1]}
+        assert not master._reexpand_blocked
+
+    def test_reexpand_waits_for_returned_report_then_skips_transfer(
+            self, master, monkeypatch):
+        """The returned host's manifest report decides whether the
+        agreed commit must be shipped: the agreement waits for it
+        (window-bounded) instead of replicating off a report still in
+        flight — a host that already holds the commit valid (shared
+        storage, short absence) re-expands with NO transfer."""
+        sent, calls = [], {}
+        monkeypatch.setattr(
+            master, "_send",
+            lambda host, obj: (sent.append((host, obj)), True)[1])
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(agreed=agreed, hosts=hosts))
+        master.lost_hosts = {1}
+        self._prime_round(master, {"cause": "capacity-restore",
+                                   "hosts": [1]})
+        master.hosts[0]["manifests"] = {
+            "wf_5.pickle.gz": {"epoch": 5, "mtime": 500.0,
+                               "valid": True}}
+        # the survivors have all reported; the returned host has not —
+        # the round WAITS (window-bounded) instead of deciding `need`
+        master._tick_agreeing(1.0)
+        assert master.phase != "replicating" and not calls
+        # ... the report lands: the host holds the agreed commit VALID,
+        # so re-expansion proceeds without any control-plane transfer
+        master.hosts[1]["manifests"] = {
+            "wf_5.pickle.gz": {"epoch": 5, "mtime": 500.0,
+                               "valid": True}}
+        master._tick_agreeing(2.0)
+        assert not [m for _h, m in sent
+                    if m["type"] == "fetch_commit"]
+        assert calls["hosts"] == [0, 1]
+        assert not master.lost_hosts
+
+    def test_reexpand_replicates_agreed_commit_then_spawns_full(
+            self, master, monkeypatch):
+        """The re-expand agreement round: survivors vote, the returned
+        host's stale ring does not hold the agreed commit, so the
+        master ships it source->returning host over the control plane
+        and only then spawns the full topology."""
+        sent, calls = [], {}
+        monkeypatch.setattr(
+            master, "_send",
+            lambda host, obj: (sent.append((host, obj)), True)[1])
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(agreed=agreed, hosts=hosts))
+        master.lost_hosts = {1}
+        self._prime_round(master, {"cause": "capacity-restore",
+                                   "hosts": [1]})
+        master.hosts[0]["manifests"] = {
+            "wf_5.pickle.gz": {"epoch": 5, "mtime": 500.0,
+                               "valid": True}}
+        master.hosts[1]["manifests"] = {
+            "wf_2.pickle.gz": {"epoch": 2, "mtime": 200.0,
+                               "valid": True}}   # frozen at the loss
+        master._tick_agreeing(1000.0)
+        assert master.phase == "replicating"
+        assert master.history[-1]["resize"] == "reexpand"
+        assert master.valves.resize_restarts == 1
+        fetches = [m for _h, m in sent if m["type"] == "fetch_commit"]
+        assert len(fetches) == 1 and \
+            fetches[0]["name"] == "wf_5.pickle.gz"
+        assert not calls    # no spawn before the transfer lands
+        # source agent answers with the commit bytes
+        master._handle_event("commit_data", 0, {
+            "ok": True, "files": {"wf_5.pickle.gz": "QUJD"}})
+        master._tick_replicating(1001.0)
+        pushes = [(h, m) for h, m in sent
+                  if m["type"] == "push_commit"]
+        assert [h for h, _m in pushes] == [1]
+        # returning host confirms the write -> re-expand completes
+        master._handle_event("commit_pushed", 1, {"ok": True})
+        master._tick_replicating(1002.0)
+        assert not master.lost_hosts
+        assert master.absence_strikes[1] == 0
+        assert calls["agreed"] == "wf_5.pickle.gz"
+        assert calls["hosts"] == [0, 1]
+        assert master.status()["degraded"] is False
+
+    def test_failed_replication_stays_degraded_not_down(
+            self, master, monkeypatch):
+        """A push failure must neither wedge the pod in `replicating`
+        nor take it down: it re-spawns the SURVIVORS (still degraded)
+        and blocks re-expansion until the agent re-registers."""
+        calls = {}
+        monkeypatch.setattr(master, "_send",
+                            lambda host, obj: True)
+        monkeypatch.setattr(
+            master, "_spawn_all",
+            lambda agreed, rollback, quarantine=None, hosts=None:
+            calls.update(hosts=hosts))
+        master.lost_hosts = {1}
+        master._replication = {
+            "source": 0, "need": [1], "returned": [1],
+            "agreed": "wf_5.pickle.gz", "quarantine": [],
+            "targets": [0, 1], "files": {"wf_5.pickle.gz": "QUJD"},
+            "sent": True, "pushed": set(), "failed": [],
+            "error": None}
+        master.phase = "replicating"
+        master._round_started = 0.0
+        master._handle_event("commit_pushed", 1,
+                             {"ok": False, "error": "disk full"})
+        master._tick_replicating(1.0)
+        assert master.lost_hosts == {1}          # still degraded
+        assert master._reexpand_blocked == {1}
+        assert master._reexpand_block_ts == {1: 1.0}   # cooldown armed
+        assert calls["hosts"] == [0]             # survivors respawned
+        # a fresh registration clears the block for a retry
+        master._handle_event("agent_up", 1, {})
+        assert not master._reexpand_blocked
+        assert not master._reexpand_block_ts
+
+    def test_degraded_worker_spec_remaps_identity_and_surfaces_size(
+            self, tmp_path):
+        """A degraded incarnation's workers get contiguous process ids
+        over the survivor set, a shrunken world size, and the pod-size
+        block threaded into config for /api/health."""
+        master = PodMaster(
+            ["python", "-m", "veles_tpu", "wf.py"], n_hosts=3,
+            workdir=str(tmp_path / "pod"), prefix="wf",
+            spawn_agents=False, seed=7)
+        master.lost_hosts = {1}
+        spec = master.worker_spec(2, incarnation=4,
+                                  coordinator_port=4321, live=[0, 2])
+        env = spec["env"]
+        assert env["VELES_TPU_NUM_PROCESSES"] == "2"
+        assert env["VELES_TPU_PROCESS_ID"] == "1"   # contiguous remap
+        joined = " ".join(spec["argv"])
+        assert "root.common.pod.elastic_mesh=True" in joined
+        assert "root.common.pod.size=2" in joined
+        assert "root.common.pod.total=3" in joined
+        assert "root.common.pod.degraded=True" in joined
+        assert "root.common.pod.lost_hosts=[1]" in joined
+
+    def test_full_size_worker_spec_is_not_degraded(self, master):
+        spec = master.worker_spec(1, incarnation=0,
+                                  coordinator_port=4321)
+        joined = " ".join(spec["argv"])
+        assert env_of(spec)["VELES_TPU_NUM_PROCESSES"] == "2"
+        assert "root.common.pod.degraded=False" in joined
+        assert "root.common.pod.size=2" in joined
+
+
+def env_of(spec):
+    return spec["env"]
+
+
+class TestAgentCommitReplication:
+    def _agent(self, tmp_path):
+        from veles_tpu.services.podmaster import PodAgent
+        agent = PodAgent("127.0.0.1:1", 0, str(tmp_path / "agent0"))
+
+        sent = []
+
+        class FakeConn:
+            @staticmethod
+            def send(obj):
+                sent.append(obj)
+                return True
+        agent._conn = FakeConn()
+        return agent, sent
+
+    def test_fetch_push_round_trip_is_byte_exact(self, tmp_path):
+        src = str(tmp_path / "src")
+        dst = str(tmp_path / "dst")
+        payload = os.urandom(2048)
+        _commit(src, "wf_5.pickle.gz", payload, epoch=5)
+        agent, sent = self._agent(tmp_path)
+        agent._fetch_commit({"name": "wf_5.pickle.gz",
+                             "snapshot_dir": src, "max_mb": 1})
+        reply = sent[-1]
+        assert reply["type"] == "commit_data" and reply["ok"]
+        assert set(reply["files"]) == {
+            "wf_5.pickle.gz", "wf_5.pickle.gz" + MANIFEST_SUFFIX}
+        agent._push_commit({"snapshot_dir": dst,
+                            "files": reply["files"]})
+        assert sent[-1]["type"] == "commit_pushed" and sent[-1]["ok"]
+        with open(os.path.join(dst, "wf_5.pickle.gz"), "rb") as f:
+            assert f.read() == payload
+        # the pushed commit scans VALID against its shipped manifest
+        assert scan_commits(dst, "wf")["wf_5.pickle.gz"]["valid"] \
+            is True
+        # no .tmp leftovers (tmp+rename)
+        assert not [n for n in os.listdir(dst) if n.endswith(".tmp")]
+
+    def test_fetch_refuses_past_the_replication_cap(self, tmp_path):
+        src = str(tmp_path / "src")
+        _commit(src, "wf_5.pickle.gz", os.urandom(4096), epoch=5)
+        agent, sent = self._agent(tmp_path)
+        agent._fetch_commit({"name": "wf_5.pickle.gz",
+                             "snapshot_dir": src,
+                             "max_mb": 0.001})     # ~1 KiB cap
+        reply = sent[-1]
+        assert not reply["ok"] and "cap" in reply["error"]
+        assert reply["files"] is None
+
+    def test_push_strips_path_traversal(self, tmp_path):
+        dst = str(tmp_path / "dst")
+        agent, sent = self._agent(tmp_path)
+        agent._push_commit({"snapshot_dir": dst,
+                            "files": {"../../evil.bin": "QUJD"}})
+        assert sent[-1]["ok"]
+        assert os.listdir(dst) == ["evil.bin"]
+        assert not os.path.exists(str(tmp_path / "evil.bin"))
+
+
+# =====================================================================
+# reshard-on-restore (snapshotter.reshard_state): the 4->2->4 matrix
+# =====================================================================
+
+def _topo(processes, data, fsdp=False, extra_axes=None):
+    axes = {"data": data}
+    axes.update(extra_axes or {})
+    return {"processes": processes, "devices": data,
+            "axes": axes, "fsdp": fsdp}
+
+
+def _state(topology, order=None, mb=64):
+    import numpy as np
+    rng = np.random.RandomState(7)
+    params = {"fc": {"weights": rng.randn(8, 4).astype("float32"),
+                     "bias": rng.randn(4).astype("float32")}}
+    velocity = {"fc": {"weights": rng.randn(8, 4).astype("float32"),
+                       "bias": rng.randn(4).astype("float32")}}
+    return {
+        "topology": topology,
+        "params": params,
+        "velocity": velocity,
+        "loader": {"epoch_number": 3, "minibatch_offset": 128,
+                   "minibatch_size": mb,
+                   "order": order, "prng": {"seed": 11, "counter": 5}},
+        "prng": {"train": {"seed": 1, "counter": 2},
+                 "dropout": {"seed": 3, "counter": 4}},
+    }
+
+
+class TestFitAxesToDevices:
+    """parallel.mesh.fit_axes_to_devices — the launcher's elastic-mesh
+    refit: only the data axis rescales to the survivors."""
+
+    def test_data_axis_rescales_to_survivors(self):
+        from veles_tpu.parallel import fit_axes_to_devices
+        assert fit_axes_to_devices({"data": 4}, 2) == {"data": 2}
+        assert fit_axes_to_devices({"data": 2}, 8) == {"data": 8}
+
+    def test_fixed_model_axis_is_preserved(self):
+        from veles_tpu.parallel import fit_axes_to_devices
+        assert fit_axes_to_devices({"data": 4, "model": 2}, 4) == \
+            {"data": 2, "model": 2}
+
+    def test_data_wildcard_passes_through(self):
+        from veles_tpu.parallel import fit_axes_to_devices
+        assert fit_axes_to_devices({"data": -1, "model": 2}, 6) == \
+            {"data": -1, "model": 2}
+        with pytest.raises(ValueError, match="fixed axes"):
+            fit_axes_to_devices({"data": -1, "model": 4}, 6)
+
+    def test_non_data_wildcard_is_refused(self):
+        """make_mesh would resolve a model=-1 against the LIVE device
+        count — a silent model re-layout at each pod size (2 -> 1 when
+        half the devices die).  Refused up front, at FULL size too, so
+        the operator learns at first spawn, not at degrade time."""
+        from veles_tpu.parallel import fit_axes_to_devices
+        with pytest.raises(ValueError, match="non-data"):
+            fit_axes_to_devices({"data": 4, "model": -1}, 8)
+
+    def test_illegal_resize_is_an_error_not_a_relayout(self):
+        from veles_tpu.parallel import fit_axes_to_devices
+        with pytest.raises(ValueError, match="data axis"):
+            fit_axes_to_devices({"data": 2, "model": 4}, 6)
+
+
+class TestReshardState:
+    @pytest.mark.parametrize("fsdp", [False, True],
+                             ids=["dp", "dp-fsdp"])
+    def test_4_2_4_round_trip_is_per_leaf_bit_exact(self, fsdp):
+        """The degrade->re-expand ladder of the chaos gate, at the
+        state level: 4 hosts -> 2 -> back, dp and dp x fsdp; params,
+        optimizer slots, loader words and PRNG words carry bit-exactly
+        and the checks prove the data order invariant."""
+        import numpy as np
+        from veles_tpu.services.snapshotter import iter_state_leaves
+        order = np.arange(1024, dtype=np.int64)
+        src = _state(_topo(4, 8, fsdp), order=order)
+        baseline = {p: leaf.copy() for p, leaf in
+                    iter_state_leaves(src) if hasattr(leaf, "copy")}
+        for target in (_topo(2, 4, fsdp), _topo(4, 8, fsdp)):
+            out, report = reshard_state(src, target)
+            assert out is src                  # never copied, never cast
+            assert report["changed"] == (target != src["topology"])
+            assert any("order invariant" in c
+                       for c in report["checks"])
+            assert any("prng streams are global words" in c
+                       for c in report["checks"])
+            assert any("dense on host" in c for c in report["checks"])
+        for path, leaf in iter_state_leaves(src):
+            if hasattr(leaf, "copy") and path in baseline:
+                assert np.array_equal(
+                    np.asarray(leaf), np.asarray(baseline[path])), path
+
+    def test_growing_past_the_source_size_is_legal(self):
+        out, report = reshard_state(_state(_topo(2, 4)), _topo(8, 16))
+        assert report["changed"]
+
+    def test_model_axis_change_is_refused(self):
+        src = _state(_topo(4, 8, extra_axes={"model": 2}))
+        with pytest.raises(SnapshotReshardError, match="model"):
+            reshard_state(src, _topo(2, 4, extra_axes={"model": 4}))
+
+    def test_indivisible_minibatch_is_refused_before_restore(self):
+        src = _state(_topo(4, 8), mb=6)
+        with pytest.raises(SnapshotReshardError, match="divide"):
+            reshard_state(src, _topo(4, 4))
+
+    def test_non_global_prng_words_are_refused(self):
+        src = _state(_topo(4, 8))
+        src["prng"]["train"] = {"per_host": [1, 2, 3, 4]}
+        with pytest.raises(SnapshotReshardError, match="global"):
+            reshard_state(src, _topo(2, 4))
+
+    def test_device_pinned_leaf_is_refused(self):
+        import jax.numpy as jnp
+        src = _state(_topo(4, 8))
+        src["params"]["fc"]["weights"] = jnp.ones((8, 4))
+        with pytest.raises(SnapshotReshardError, match="host array"):
+            reshard_state(src, _topo(2, 4))
+
+    def test_fsdp_flag_change_is_placement_only(self):
+        out, report = reshard_state(_state(_topo(4, 8, fsdp=True)),
+                                    _topo(4, 8, fsdp=False))
+        assert any("placement-only" in c for c in report["checks"])
+
+    def test_legacy_state_without_topology_tag_still_checks(self):
+        src = _state(None)
+        del src["topology"]
+        out, report = reshard_state(src, _topo(2, 4),
+                                    minibatch_size=64)
+        assert report["from"] is None and not report["changed"]
+        assert any("order invariant" in c for c in report["checks"])

@@ -186,11 +186,27 @@ root.common.update({
     # lifted to pod scope: max_restarts bounded restarts per
     # window_seconds, deterministic_limit identical pod-wide crash
     # signatures with zero agreed-checkpoint progress give up early.
+    # The ELASTIC tier: with elastic=True a host whose agent misses
+    # loss_strikes consecutive agreement windows (loss_window_s each)
+    # is classified permanently lost and the pod DEGRADES to the
+    # survivors (resized mesh, resharded checkpoint) instead of
+    # retrying the dead topology; reexpand=True folds the host back in
+    # with one re-expand restart when its agent re-registers, shipping
+    # the agreed commit to its frozen ring over the control plane
+    # (capped at replicate_max_mb — shared-storage pods never need the
+    # transfer).  Degrade/re-expand restarts count in their own valve
+    # bucket, never the crash-loop or deterministic budget.
+    # elastic_mesh is threaded into WORKERS by the master: the
+    # launcher then rebuilds a fixed --mesh from the live device set
+    # (parallel.mesh.fit_axes_to_devices).
     "pod": {"heartbeat_ms": 500, "stale_after_ms": 10000,
             "hang_seconds": 300, "kill_grace_ms": 5000,
             "max_restarts": 8, "window_seconds": 600,
             "deterministic_limit": 3,
-            "backoff_base_ms": 200, "backoff_max_ms": 10000},
+            "backoff_base_ms": 200, "backoff_max_ms": 10000,
+            "elastic": True, "loss_strikes": 2, "loss_window_s": 60,
+            "reexpand": True, "replicate_max_mb": 64,
+            "elastic_mesh": False},
     "web": {"host": "0.0.0.0", "port": 8090},
     # the flight recorder / crash forensics / watchdog layer
     # (veles_tpu.telemetry.flight + .health, docs/services.md "Black

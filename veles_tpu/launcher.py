@@ -113,7 +113,27 @@ class Launcher(Logger):
                 num_processes=self.num_processes,
                 process_id=self.process_id)
         if self.mesh_axes:
-            self.mesh_config = MeshConfig(make_mesh(self.mesh_axes),
+            axes = self.mesh_axes
+            from veles_tpu.config import root
+            if root.common.pod.get("elastic_mesh", False) or \
+                    os.environ.get("VELES_TPU_ELASTIC_MESH") == "1":
+                # elastic pods (services.podmaster) respawn workers on
+                # whatever hosts survive: the mesh must be built from
+                # the LIVE device set, not the configured topology — a
+                # fixed data axis rescales, model/seq/... axes must fit
+                from veles_tpu.parallel.mesh import fit_axes_to_devices
+                import jax as _jax
+                fitted = fit_axes_to_devices(axes,
+                                             _jax.device_count())
+                if fitted != dict(axes):
+                    self.info("elastic mesh: %s -> %s (%d live "
+                              "devices)", dict(axes), fitted,
+                              _jax.device_count())
+                    telemetry.flight.record(
+                        "mesh.refit", configured=dict(axes),
+                        live=fitted, devices=_jax.device_count())
+                axes = fitted
+            self.mesh_config = MeshConfig(make_mesh(axes),
                                           fsdp=self.fsdp)
             if self.fsdp and self.mesh_config.data_size <= 1:
                 self.warning("--fsdp has no effect: the mesh has no "

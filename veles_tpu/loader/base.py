@@ -256,6 +256,11 @@ class Loader(Unit, metaclass=LoaderRegistry):
         pool = getattr(self, "_train_pool", None)
         return {"epoch_number": self.epoch_number,
                 "minibatch_offset": self.minibatch_offset,
+                # the GLOBAL minibatch the offsets/order were walked
+                # with: reshard-on-restore (snapshotter.reshard_state)
+                # proves a resized mesh can serve the same data order
+                # by checking the new data axis still divides it
+                "minibatch_size": int(self.minibatch_size),
                 "order": None if self._order is None else self._order.copy(),
                 # self-contained exactness: the shuffle stream's
                 # (seed, counter) words and the ensemble subset pool

@@ -7,10 +7,15 @@ SPMD over a ``jax.sharding.Mesh``: the gradient exchange is a ``psum`` XLA
 inserts over ICI when the batch axis is sharded; the control plane is
 ``jax.distributed`` over DCN for multi-host.  The reference's SharedIO shm,
 pickle compression, computing-power balancing, and elastic join all
-dissolve: arrays are HBM-resident, the pod is homogeneous, and elasticity is
-checkpoint-restart (see services.snapshotter)."""
+dissolve: arrays are HBM-resident, the pod is homogeneous, and elasticity
+is checkpoint-restart *resized to the survivors*: the pod master
+(services.podmaster) rebuilds the mesh from the live host set
+(:func:`mesh.fit_axes_to_devices`) and the snapshotter reshards the
+topology-tagged checkpoint onto it (snapshotter.reshard_state), so a
+permanently lost host degrades the pod instead of ending it."""
 
-from veles_tpu.parallel.mesh import MeshConfig, make_mesh
+from veles_tpu.parallel.mesh import (MeshConfig, fit_axes_to_devices,
+                                     make_mesh)
 from veles_tpu.parallel import sharding
 
-__all__ = ["MeshConfig", "make_mesh", "sharding"]
+__all__ = ["MeshConfig", "fit_axes_to_devices", "make_mesh", "sharding"]

@@ -46,6 +46,62 @@ def make_mesh(axes=None, devices=None):
     return Mesh(devs, tuple(names))
 
 
+def fit_axes_to_devices(axes, n_devices, data_axis="data"):
+    """Rescale a configured ``{axis: size}`` spec onto the devices that
+    are actually alive — the elastic-pod path (services.podmaster): a
+    mesh configured for 4 hosts must rebuild on the 3 survivors instead
+    of retrying the dead topology forever.
+
+    Only the **data** axis resizes (including a ``data=-1`` wildcard,
+    which already adapts): data parallelism is the axis
+    checkpoint-restart can legally shrink/grow — the global minibatch is
+    resharded, the math is the same psum over fewer partials.
+    Model/seq/expert/pipe axes are woven into the parameters' layout and
+    must survive intact: when the live device count cannot hold them,
+    that is an error, not a silent re-layout — and a ``-1`` wildcard on
+    a NON-data axis is refused outright, because ``make_mesh`` would
+    resolve it against whatever device count is alive, silently
+    re-laying the model out at each pod size.
+
+    Returns a NEW axes dict whose product fits ``n_devices`` exactly
+    (for the non-wildcard case), or the input unchanged when it already
+    fits.  Raises ValueError when no legal resize exists."""
+    axes = dict(axes) if axes else {data_axis: -1}
+    n_devices = int(n_devices)
+    wild_nondata = sorted(name for name, size in axes.items()
+                          if size == -1 and name != data_axis)
+    if wild_nondata:
+        raise ValueError(
+            "mesh %r cannot be refitted: the -1 wildcard on non-data "
+            "axis(es) %s would resolve against the LIVE device count "
+            "and silently re-lay the model out at each pod size — pin "
+            "those axes (elastic pods rescale the data axis only, or "
+            "a data=-1 wildcard)" % (axes, wild_nondata))
+    fixed = 1
+    for name, size in axes.items():
+        if name != data_axis and size != -1:
+            fixed *= int(size)
+    if axes.get(data_axis) == -1:
+        # a data wildcard already absorbs whatever is alive — validate
+        # the fixed axes still fit and let make_mesh do the division
+        if n_devices % fixed:
+            raise ValueError(
+                "mesh %r cannot fit %d live devices: the fixed axes "
+                "need a multiple of %d" % (axes, n_devices, fixed))
+        return axes
+    want = fixed * int(axes.get(data_axis, 1))
+    if want == n_devices:
+        return axes
+    if n_devices % fixed:
+        raise ValueError(
+            "mesh %r cannot resize onto %d live devices: the non-data "
+            "axes need a multiple of %d devices (the data axis is the "
+            "only one elasticity may rescale)" % (axes, n_devices, fixed))
+    out = dict(axes)
+    out[data_axis] = n_devices // fixed
+    return out
+
+
 @dataclasses.dataclass
 class MeshConfig:
     """Axis naming convention shared by trainer/loader/sharding rules.

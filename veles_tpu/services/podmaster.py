@@ -42,9 +42,26 @@ stale-incarnation) nor rejoin the collective (different coordinator).
 
 PR 8's valves are lifted to pod scope (:class:`PodValves`): bounded
 restarts per window, and identical pod-wide crash signatures with zero
-agreed-checkpoint progress give up early.  Gate:
-``tools/pod_chaos.py``; docs: docs/distributed_training.md
-"Pod orchestration"."""
+agreed-checkpoint progress give up early.
+
+**Elastic tier** (the Veles reference's slaves-leave-and-join
+elasticity, server.py:637-655, mapped onto SPMD): a host whose agent
+misses ``pod.loss_strikes`` consecutive agreement windows is classified
+**permanently lost** — the pod *degrades* to the survivors instead of
+retrying the dead topology: one resize-bucketed coordinated restart
+respawns the workers under a mesh rebuilt from the live host set
+(process ids remapped contiguous, ``parallel.mesh.fit_axes_to_devices``
+rescales a fixed data axis) resuming from the survivors' agreed
+checkpoint, which the snapshotter reshards onto the smaller topology
+(``snapshotter.reshard_state`` — per-leaf bit-exact; global loader
+order and PRNG words proven invariant).  When the lost host's agent
+re-registers, one **re-expand** restart folds it back in: the agreed
+commit is replicated to its frozen ring over the control plane
+(``fetch_commit``/``push_commit``) unless it already holds it, and the
+pod returns to full size.  Planned resizes live in their own valve
+bucket — they can never consume the crash-loop or deterministic-bug
+budget.  Gate: ``tools/pod_chaos.py`` (``--host-loss`` flavor); docs:
+docs/distributed_training.md "Pod orchestration"."""
 
 import argparse
 import json
@@ -189,7 +206,12 @@ def classify_stall(now, hosts, hang_seconds, stale_after):
 
 class PodValves(object):
     """PR 8's crash-loop and deterministic-bug valves lifted to pod
-    scope: one decision per coordinated restart."""
+    scope: one decision per coordinated restart.  Planned topology
+    changes — the degraded restart after a permanent host loss and the
+    re-expand restart when capacity returns — are accounted in their
+    OWN bucket (``resize_restarts``): a resize is the pod doing its
+    job, and it must never consume the crash-loop window or feed the
+    deterministic-bug signature counter."""
 
     def __init__(self, max_restarts, window_seconds,
                  deterministic_limit):
@@ -199,9 +221,12 @@ class PodValves(object):
         self._window = []
         self._last_signature = None
         self._same_signature = 0
+        #: degraded/re-expand restarts — their own bucket, never the
+        #: crash-loop window
+        self.resize_restarts = 0
 
     def admit(self, now, signature=None, progressed=False,
-              counted=True):
+              counted=True, resize=False):
         """Decide one pod restart: ``"respawn"``, ``"crash-loop"`` or
         ``"deterministic-bug"``.
 
@@ -213,9 +238,16 @@ class PodValves(object):
             however it keeps dying (resets the deterministic counter).
         :param counted: False for restarts that must stay unbounded —
             pod-wide graceful preemption and environment startup
-            flakes."""
+            flakes.
+        :param resize: a PLANNED topology change (degrade after
+            permanent host loss, re-expand on capacity return): counts
+            only in ``resize_restarts`` — neither the crash-loop window
+            nor the deterministic counter moves."""
         if progressed:
             self._same_signature, self._last_signature = 0, None
+        if resize:
+            self.resize_restarts += 1
+            return "respawn"
         if not counted:
             return "respawn"
         if signature:
@@ -315,7 +347,9 @@ class PodMaster(object):
                  hang_seconds=None, kill_grace_ms=None,
                  max_restarts=None, window_seconds=None,
                  deterministic_limit=None, backoff_base_ms=None,
-                 backoff_max_ms=None, seed=None):
+                 backoff_max_ms=None, seed=None, elastic=None,
+                 loss_strikes=None, loss_window_s=None,
+                 reexpand=None, replicate_max_mb=None):
         def knob(value, key, default):
             if value is not None:
                 return value
@@ -349,6 +383,30 @@ class PodMaster(object):
             knob(max_restarts, "max_restarts", 8),
             knob(window_seconds, "window_seconds", 600),
             knob(deterministic_limit, "deterministic_limit", 3))
+        #: elastic pod: continue DEGRADED on the survivors after a
+        #: permanent host loss instead of retrying the dead topology
+        #: until the crash-loop valve gives up
+        self.elastic = bool(knob(elastic, "elastic", True))
+        #: consecutive coordinated restarts in which the same host's
+        #: agent never re-registered within its window before the loss
+        #: is classified PERMANENT (and, with ``elastic``, the pod
+        #: degrades to the survivors)
+        self.loss_strikes = int(knob(loss_strikes, "loss_strikes", 2))
+        #: how long each round's agreement waits for a silent host's
+        #: agent before striking it
+        self.loss_window_s = float(
+            knob(loss_window_s, "loss_window_s", 60))
+        #: trigger a re-expand restart back to full size when a lost
+        #: host's agent re-registers
+        self.reexpand = bool(knob(reexpand, "reexpand", True))
+        #: re-expand checkpoint replication cap: the agreed commit is
+        #: shipped to the returning host over the control plane (its
+        #: ring is stale); past this size, replication is refused and
+        #: the pod stays degraded (real pods with shared storage never
+        #: need the transfer — the returning host already sees the
+        #: commit)
+        self.replicate_max_mb = float(
+            knob(replicate_max_mb, "replicate_max_mb", 64))
         self.fence = IncarnationFence()
         self._rng = random.Random(seed)
         self._log = logging.getLogger("PodMaster")
@@ -378,6 +436,28 @@ class PodMaster(object):
         #: nowhere is its own giveup condition
         self._flake_streak = 0
         self.flake_streak_limit = 6
+        #: hosts classified as PERMANENTLY lost — the pod runs degraded
+        #: on the complement until their agents re-register
+        self.lost_hosts = set()
+        #: consecutive agreement windows each host's agent missed
+        self.absence_strikes = {h: 0 for h in range(self.n_hosts)}
+        #: specs queued for hosts whose agent was unregistered at spawn
+        #: time (delivered if/when the agent registers in the same
+        #: incarnation)
+        self._pending_specs = {}
+        #: the hosts the current incarnation was spawned on
+        self._spawn_targets = set(range(self.n_hosts))
+        #: the re-expand replication context (source/need/files/...)
+        self._replication = None
+        #: a failed re-expand (replication error) blocks re-triggering
+        #: until the lost host's agent re-registers
+        self._reexpand_blocked = set()
+        #: host -> wall ts of the failed transfer: a blocked host whose
+        #: agent stays connected (so no fresh ``agent_up`` ever clears
+        #: the block) re-probes after a cooldown instead of running
+        #: degraded forever
+        self._reexpand_block_ts = {}
+        self._gauges = None
 
     @staticmethod
     def _fresh_host():
@@ -393,6 +473,37 @@ class PodMaster(object):
     def host_workdir(self, host):
         return os.path.join(self.workdir, "agent%d" % host)
 
+    def host_down_file(self, host):
+        """Marker file that keeps the local agent emulation from
+        respawning this host's agent — how tests and the chaos harness
+        model a machine that is GONE (real pods simply have no agent
+        process to register).  Remove it to model capacity returning."""
+        return os.path.join(self.workdir, "host%d.down" % host)
+
+    def live_hosts(self):
+        return sorted(h for h in self.hosts if h not in self.lost_hosts)
+
+    # --------------------------------------------------------- telemetry
+    def _export_pod_size(self):
+        """``veles_pod_hosts`` / ``veles_pod_degraded`` gauges — the
+        operator's one-glance answer to "how big is the pod right now"
+        (fail-soft: telemetry must never take the pod down)."""
+        try:
+            from veles_tpu import telemetry
+            if self._gauges is None:
+                self._gauges = (
+                    telemetry.registry.gauge(
+                        "veles_pod_hosts",
+                        "hosts the pod is currently running on"),
+                    telemetry.registry.gauge(
+                        "veles_pod_degraded",
+                        "1 while the pod runs degraded after a "
+                        "permanent host loss"))
+            self._gauges[0].set(len(self.live_hosts()))
+            self._gauges[1].set(1 if self.lost_hosts else 0)
+        except Exception:   # noqa: BLE001 — fail-soft
+            pass
+
     def agent_argv(self, host):
         return [sys.executable, "-m", "veles_tpu.services.podmaster",
                 "--agent", "--master",
@@ -401,11 +512,24 @@ class PodMaster(object):
                 "--workdir", self.host_workdir(host)]
 
     def worker_spec(self, host, incarnation, coordinator_port,
-                    agreed=None, rollback=False, quarantine=None):
+                    agreed=None, rollback=False, quarantine=None,
+                    live=None):
         """The spawn message for one host/incarnation — argv with the
         per-host snapshot config merged in, plus the env delta that
         threads the ``jax.distributed`` identity and the fenced
-        incarnation into the worker."""
+        incarnation into the worker.
+
+        :param live: the hosts this incarnation spawns on (default: all
+            of them).  A degraded incarnation passes the survivor set:
+            process ids are remapped contiguous over it, the worker
+            count shrinks to it, and the workers' mesh is rebuilt from
+            the LIVE device set (``pod.elastic_mesh`` →
+            :func:`parallel.mesh.fit_axes_to_devices`) instead of the
+            configured topology."""
+        live = sorted(live) if live is not None else \
+            sorted(self.hosts)
+        process_id = live.index(host)
+        degraded = len(live) < self.n_hosts
         statements = [
             "root.common.dirs.snapshots=%r" % self.host_snapshot_dir(host),
             "root.common.snapshot.per_host=True",
@@ -422,12 +546,22 @@ class PodMaster(object):
             "root.common.snapshot.backend='file'",
             "root.common.blackbox.dir=%r" % os.path.join(
                 self.workdir, "dumps"),
+            # the worker builds its mesh from the LIVE device set: a
+            # fixed --mesh data axis rescales to the survivors instead
+            # of failing on a topology that no longer exists
+            "root.common.pod.elastic_mesh=True",
+            # surfaced through the worker's web_status /api/health so
+            # an operator probing any host sees the pod's true size
+            "root.common.pod.size=%d" % len(live),
+            "root.common.pod.total=%d" % self.n_hosts,
+            "root.common.pod.degraded=%r" % degraded,
+            "root.common.pod.lost_hosts=%r" % sorted(self.lost_hosts),
         ] + list(self.host_extras.get(host, ()))
         env = {
             "VELES_TPU_COORDINATOR": "%s:%d" % (self.coordinator_host,
                                                 coordinator_port),
-            "VELES_TPU_NUM_PROCESSES": str(self.n_hosts),
-            "VELES_TPU_PROCESS_ID": str(host),
+            "VELES_TPU_NUM_PROCESSES": str(len(live)),
+            "VELES_TPU_PROCESS_ID": str(process_id),
             "VELES_TPU_INCARNATION": str(incarnation),
         }
         if self.devices_per_host:
@@ -468,6 +602,7 @@ class PodMaster(object):
         self._policy_thread.start()
         self._info("pod master listening on %s:%d (%d hosts)",
                    self.bind_host, self.port, self.n_hosts)
+        self._export_pod_size()
         return self
 
     def wait(self, timeout=None):
@@ -502,10 +637,16 @@ class PodMaster(object):
                 "restart_causes": list(self.restart_causes),
                 "agreed": self._last_agreed,
                 "fence_refusals": list(self.fence.refusals),
+                "degraded": bool(self.lost_hosts),
+                "lost_hosts": sorted(self.lost_hosts),
+                "live_hosts": len(self.hosts) - len(self.lost_hosts),
+                "absence_strikes": dict(self.absence_strikes),
+                "resize_restarts": self.valves.resize_restarts,
                 "hosts": {
                     h: {"worker_alive": s["worker_alive"],
                         "worker_pid": s["worker_pid"],
                         "registered": s["conn"] is not None,
+                        "lost": h in self.lost_hosts,
                         "last_exit": s["last_exit"]}
                     for h, s in self.hosts.items()},
             }
@@ -638,7 +779,32 @@ class PodMaster(object):
         with self._lock:
             state = self.hosts[host]
             if kind == "agent_up":
-                pass
+                # a fresh registration retries a previously failed
+                # re-expansion, and — during a respawn round — receives
+                # the spec that was queued while its host was absent
+                self._reexpand_blocked.discard(host)
+                self._reexpand_block_ts.pop(host, None)
+                spec = self._pending_specs.pop(host, None)
+                if spec is not None and self.phase == "respawning" \
+                        and spec.get("incarnation") \
+                        == self.fence.incarnation:
+                    self._send(host, spec)
+            elif kind == "commit_data":
+                rep = self._replication
+                if rep is not None and host == rep.get("source"):
+                    if msg.get("ok") and msg.get("files"):
+                        rep["files"] = msg["files"]
+                    else:
+                        rep["error"] = msg.get("error",
+                                               "fetch_commit failed")
+            elif kind == "commit_pushed":
+                rep = self._replication
+                if rep is not None:
+                    if msg.get("ok"):
+                        rep["pushed"].add(host)
+                    else:
+                        rep["failed"].append(host)
+                        rep["error"] = msg.get("error", "push failed")
             elif kind == "agent_lost":
                 state["conn"] = None
                 state["heartbeat_ts"] = None
@@ -739,6 +905,8 @@ class PodMaster(object):
             self._tick_killing(now)
         elif phase == "agreeing":
             self._tick_agreeing(now)
+        elif phase == "replicating":
+            self._tick_replicating(now)
         elif phase == "respawning":
             self._tick_respawning(now)
 
@@ -748,6 +916,12 @@ class PodMaster(object):
                 with self._lock:
                     if self.phase in ("done", "giveup"):
                         return
+                if os.path.exists(self.host_down_file(host)):
+                    # the host is modeled GONE (chaos/tests): no agent
+                    # can run there until the marker clears — exactly a
+                    # dead machine's behavior on a real pod, where the
+                    # master never spawns agents at all
+                    continue
                 # an agent that cannot even stay up (bad install,
                 # unreachable master port) must not respawn forever
                 recent = [t for t in self._agent_spawns.get(host, [])
@@ -772,15 +946,43 @@ class PodMaster(object):
 
     def _detect_trigger(self, now):
         with self._lock:
-            # pod-wide completion: every host's CURRENT-incarnation
-            # worker exited 0
-            exits = {h: s["last_exit"] for h, s in self.hosts.items()}
+            live = self.live_hosts()
+            # capacity re-expansion: a LOST host's agent re-registered
+            # — one coordinated restart back to full size (checked
+            # first: the degraded pod is healthy, nothing else fires)
+            if self.reexpand:
+                # a block from a failed transfer expires after a
+                # cooldown (the agent may never re-register if it
+                # simply stayed connected) — a timestamped block
+                # re-probes, an untimestamped one waits for agent_up
+                cooldown = max(60.0, self.loss_window_s)
+                for h, ts in list(self._reexpand_block_ts.items()):
+                    if now - ts >= cooldown:
+                        self._reexpand_block_ts.pop(h, None)
+                        self._reexpand_blocked.discard(h)
+                returned = [h for h in sorted(self.lost_hosts)
+                            if h not in self._reexpand_blocked
+                            and self.hosts[h]["conn"] is not None
+                            and self.hosts[h]["conn"].alive]
+                if returned:
+                    return {"cause": "capacity-restore",
+                            "hosts": returned}
+            # pod-wide completion: every LIVE host's CURRENT-incarnation
+            # worker exited 0 (a degraded pod completes on the
+            # survivors — that is the point of continuing)
+            exits = {h: self.hosts[h]["last_exit"] for h in live}
             if all(e is not None and e["kind"] == "done"
                    and e.get("incarnation") == self.fence.incarnation
                    for e in exits.values()):
-                self._info("all hosts finished cleanly — pod done")
+                self._info("all %d live hosts finished cleanly — pod "
+                           "done%s", len(live),
+                           " (degraded, lost: %s)"
+                           % sorted(self.lost_hosts)
+                           if self.lost_hosts else "")
                 flight.record("pod.done",
-                              incarnation=self.fence.incarnation)
+                              incarnation=self.fence.incarnation,
+                              degraded=bool(self.lost_hosts),
+                              lost_hosts=sorted(self.lost_hosts))
                 self.phase = "done"
                 self.rc = 0
                 return None
@@ -789,15 +991,15 @@ class PodMaster(object):
                         e.get("incarnation") == self.fence.incarnation:
                     return {"cause": "worker-exit", "host": h,
                             "exit": e}
+            # lost hosts and hosts whose worker finished are excluded
+            # from the stall view (their progress legitimately stopped)
             view = {h: {"heartbeat_ts": s["heartbeat_ts"],
                         "progress_ts": s["progress_ts"],
                         "worker_alive": s["worker_alive"]}
-                    for h, s in self.hosts.items()
-                    # a host whose worker finished is excluded from the
-                    # stall view (its progress legitimately stopped)
-                    if not (self.hosts[h]["last_exit"] is not None
-                            and self.hosts[h]["last_exit"]["kind"]
-                            == "done")}
+                    for h in live
+                    for s in (self.hosts[h],)
+                    if not (s["last_exit"] is not None
+                            and s["last_exit"]["kind"] == "done")}
             stall = classify_stall(now, view, self.hang_seconds,
                                    self.stale_after_s)
         if stall is not None:
@@ -821,7 +1023,8 @@ class PodMaster(object):
         self._info("pod restart: %s — killing every worker "
                    "(SIGTERM -> %.1fs -> SIGKILL)", cause,
                    self.kill_grace_s)
-        flight.record("pod.stall" if "hosts" in trigger
+        flight.record("pod.stall" if trigger["cause"] in
+                      ("stale-heartbeat", "collective-hang")
                       else "pod.trigger", **trigger)
         flight.record("pod.kill", cause=cause)
         with self._lock:
@@ -831,8 +1034,13 @@ class PodMaster(object):
 
     def _tick_killing(self, now):
         with self._lock:
+            # only hosts with a LIVE agent can confirm the kill — a
+            # host whose agent is gone (permanent loss) would hold this
+            # phase at its last heartbeat's stale worker_alive forever;
+            # its orphan worker is the returning agent's fence problem
             alive = [h for h, s in self.hosts.items()
-                     if s["worker_alive"]]
+                     if s["worker_alive"] and s["conn"] is not None
+                     and s["conn"].alive]
             timed_out = now - self._round_started > \
                 self.kill_grace_s * 3 + 30
             if alive and not timed_out:
@@ -850,13 +1058,80 @@ class PodMaster(object):
                                    self.host_snapshot_dir(h)})
 
     def _tick_agreeing(self, now):
+        reexpanding = self._round_cause.get("cause") == \
+            "capacity-restore"
+        returned = sorted(self._round_cause.get("hosts", ())) \
+            if reexpanding else []
         with self._lock:
-            missing = [h for h, s in self.hosts.items()
-                       if "manifests" not in s]
-            if missing and now - self._round_started < 60:
+            live = self.live_hosts()
+            # only LIVE hosts gate the agreement; a returned (still
+            # formally lost) host's report is advisory — it decides
+            # whether the agreed commit must be replicated to it
+            missing = [h for h in live
+                       if "manifests" not in self.hosts[h]]
+            absent = [h for h in missing
+                      if self.hosts[h]["conn"] is None
+                      or not self.hosts[h]["conn"].alive]
+            # a host with NO agent is given the (shorter, configurable)
+            # loss window — it is a permanent-loss candidate; a host
+            # whose agent is merely slow keeps the full grace
+            window = (self.loss_window_s
+                      if absent and set(absent) == set(missing)
+                      else max(60.0, self.loss_window_s))
+            # the returned hosts' reports decide whether the agreed
+            # commit must be REPLICATED to them — computing `need` off
+            # a report that is merely in flight would ship (or cap-fail
+            # on) a commit the host already holds valid, so they join
+            # the window-bounded wait; they never gate the agreement
+            # vote itself
+            waiting = missing + [h for h in returned
+                                 if "manifests" not in self.hosts[h]]
+            if waiting and now - self._round_started < window:
                 return
             reports = {h: s["manifests"] for h, s in self.hosts.items()
                        if "manifests" in s}
+        # ---- permanent-loss strikes (the elastic tentpole) ----------
+        # one strike per coordinated round in which a live host's agent
+        # never re-registered within the window; ``loss_strikes``
+        # consecutive misses classify the loss PERMANENT and the pod
+        # degrades to the survivors instead of retrying the dead
+        # topology until a valve gives up
+        newly_lost = []
+        for h in live:
+            if h in absent:
+                self.absence_strikes[h] += 1
+                # a loss verdict needs somewhere to degrade TO: at
+                # least one live host that is NOT itself absent (an
+                # all-absent pod is a partition of the MASTER, not a
+                # host loss — that stays the agreement-incomplete
+                # giveup below, data intact)
+                if self.elastic and \
+                        self.absence_strikes[h] >= self.loss_strikes \
+                        and len(live) > len(absent):
+                    newly_lost.append(h)
+            else:
+                self.absence_strikes[h] = 0
+        if newly_lost:
+            with self._lock:
+                self.lost_hosts.update(newly_lost)
+                live = self.live_hosts()
+            missing = [h for h in missing if h not in newly_lost]
+            absent = [h for h in absent if h not in newly_lost]
+            self._error(
+                "host(s) %s classified PERMANENTLY lost (%d strike(s) "
+                "each) — degrading the pod to survivors %s",
+                newly_lost, self.loss_strikes, live)
+            flight.record("pod.degrade", lost=newly_lost,
+                          strikes=self.loss_strikes, live=live,
+                          incarnation=self.fence.incarnation)
+            self._export_pod_size()
+        resize = ("degrade" if newly_lost
+                  else "reexpand" if reexpanding else None)
+        # agreement over the LIVE hosts' reports only: the lost hosts
+        # no longer vote (their frozen rings must not veto the
+        # survivors' newer commits), and a returned host votes again
+        # only once it is re-expanded in
+        reports = {h: r for h, r in reports.items() if h in live}
         from veles_tpu.services.snapshotter import (_commit_order_key,
                                                     agree_commits)
         agreed, detail = agree_commits(reports)
@@ -879,6 +1154,28 @@ class PodMaster(object):
                     r.get(last, {}).get("valid") is True
                     for r in reports.values()):
                 agreed = last
+            elif self.elastic and absent \
+                    and set(absent) == set(missing) \
+                    and len(live) > len(absent):
+                # every silent host is agent-dead — a permanent-loss
+                # candidate mid-strike — and there is no commit the
+                # whole pod could provably restore.  A full-topology
+                # respawn would hand the absent host a survivor-only
+                # commit it may not hold (silent divergence when it
+                # returns), and giving up would end a pod whose
+                # survivors are healthy.  Recycle the round instead:
+                # each recycle strikes the absent hosts toward the
+                # permanent-loss verdict (degrade), or they return and
+                # report — either way the pod decides with data intact.
+                self._info("no pod-verified fallback while host(s) %s "
+                           "are agent-dead — recycling the round "
+                           "toward a permanent-loss verdict (strike "
+                           "%s/%d)", absent,
+                           {h: self.absence_strikes[h] for h in absent},
+                           self.loss_strikes)
+                self._begin_restart({"cause": "host-absent-retry",
+                                     "hosts": absent}, now)
+                return
             else:
                 agreed = None
                 forced = "agreement-incomplete"
@@ -933,12 +1230,18 @@ class PodMaster(object):
             for h, e in sorted(self._round_exits.items())
             if e.get("signature"))
         counted, flake = self._round_weight()
+        if resize:
+            # a planned topology change is the pod WORKING: its own
+            # valve bucket, never the crash-loop window or the
+            # deterministic-bug counter, and no backoff
+            counted = False
         if flake and not progressed:
             self._flake_streak += 1
         else:
             self._flake_streak = 0
         verdict = forced or self.valves.admit(now, signatures or None,
-                                              progressed, counted)
+                                              progressed, counted,
+                                              resize=bool(resize))
         if verdict == "respawn" and \
                 self._flake_streak >= self.flake_streak_limit:
             verdict = "env-flake-storm"
@@ -946,12 +1249,15 @@ class PodMaster(object):
         if "exit" in self._round_cause:
             cause = "%s:%s" % (cause,
                                self._round_cause["exit"]["kind"])
+        if newly_lost:
+            cause = "host-loss:%s" % ",".join(map(str, newly_lost))
         record = {"cause": cause, "trigger": self._round_cause,
                   "exits": {h: dict(e) for h, e in
                             self._round_exits.items()},
                   "agreed": agreed, "rejected": rejected,
                   "progressed": progressed, "counted": counted,
                   "env_flake": flake, "verdict": verdict,
+                  "resize": resize, "lost": sorted(self.lost_hosts),
                   "incarnation_before": self.fence.incarnation,
                   "ts": now}
         if verdict != "respawn":
@@ -980,11 +1286,133 @@ class PodMaster(object):
         with self._lock:
             self.history.append(record)
             self.restart_causes.append(cause)
+        targets = self.live_hosts()
+        if reexpanding:
+            targets = sorted(set(targets) | set(returned))
+            with self._lock:
+                # a returned host whose own ring already holds the
+                # agreed commit VALID (shared storage, short absences)
+                # needs no transfer; otherwise the commit is shipped
+                # over the control plane from a survivor that has it
+                need = [h for h in returned
+                        if agreed is not None and
+                        (self.hosts[h].get("manifests") or {})
+                        .get(agreed, {}).get("valid") is not True]
+                src = None
+                if agreed is not None:
+                    src = next(
+                        (h for h in self.live_hosts()
+                         if (self.hosts[h].get("manifests") or {})
+                         .get(agreed, {}).get("valid") is True), None)
+            if need and src is not None:
+                self._begin_replication(src, need, returned, agreed,
+                                        quarantine, targets, now)
+                return
+            if need:
+                # nothing to replicate FROM (agreed absent) — re-expand
+                # anyway; the returning host quarantines per the master
+                # list and ``--snapshot auto``'s fallback covers it
+                self._error("no survivor holds the agreed commit to "
+                            "replicate — re-expanding without transfer")
+            self._complete_reexpand(returned)
         if delay:
             self._info("respawn backoff %.2fs", delay)
             time.sleep(delay)
         self._spawn_all(agreed=agreed, rollback=True,
-                        quarantine=quarantine)
+                        quarantine=quarantine, hosts=targets)
+
+    # ------------------------------------------- re-expand & replication
+    def _complete_reexpand(self, returned):
+        """Fold the returned hosts back into the live set — capacity
+        restored, one re-expand restart (the caller spawns it)."""
+        with self._lock:
+            for h in returned:
+                self.lost_hosts.discard(h)
+                self.absence_strikes[h] = 0
+                self._reexpand_blocked.discard(h)
+                self._reexpand_block_ts.pop(h, None)
+            live = self.live_hosts()
+        flight.record("pod.restore", hosts=list(returned), live=live,
+                      incarnation=self.fence.incarnation)
+        self._info("capacity restored: host(s) %s rejoin — "
+                   "re-expanding the pod to %d host(s)",
+                   list(returned), len(live))
+        self._export_pod_size()
+
+    def _begin_replication(self, src, need, returned, agreed,
+                           quarantine, targets, now):
+        """Ship the agreed commit (data file + manifest sidecar) from a
+        survivor to the returning host(s) over the control plane — the
+        returning ring is frozen at the loss point and, on per-host
+        disks, has no other way to reach the degraded era's newer
+        commits."""
+        with self._lock:
+            self.phase = "replicating"
+            self._round_started = now
+            if self.history:
+                self.history[-1]["replicated"] = list(need)
+            self._replication = {
+                "source": src, "need": list(need),
+                "returned": list(returned), "agreed": agreed,
+                "quarantine": quarantine, "targets": targets,
+                "files": None, "sent": False, "pushed": set(),
+                "failed": [], "error": None}
+        flight.record("pod.replicate", source=src, to=list(need),
+                      name=agreed)
+        self._info("replicating agreed commit %s from host %d to "
+                   "host(s) %s for re-expansion", agreed, src, need)
+        with self._lock:
+            if not self._send(src, {
+                    "type": "fetch_commit", "name": agreed,
+                    "snapshot_dir": self.host_snapshot_dir(src),
+                    "max_mb": self.replicate_max_mb}):
+                self._replication["error"] = "source agent unreachable"
+
+    def _tick_replicating(self, now):
+        with self._lock:
+            rep = self._replication
+            if rep is None:            # defensive: lost context
+                self.phase = "running"
+                return
+            if rep["files"] is not None and not rep["sent"]:
+                rep["sent"] = True
+                for h in rep["need"]:
+                    if not self._send(h, {
+                            "type": "push_commit",
+                            "snapshot_dir": self.host_snapshot_dir(h),
+                            "files": rep["files"]}):
+                        rep["failed"].append(h)
+            done = set(rep["pushed"]) >= set(rep["need"])
+            trouble = rep["error"] or rep["failed"]
+            timed_out = now - self._round_started > \
+                max(120.0, self.kill_grace_s * 3)
+        if done and not trouble:
+            self._replication = None
+            self._complete_reexpand(rep["returned"])
+            self._spawn_all(agreed=rep["agreed"], rollback=True,
+                            quarantine=rep["quarantine"],
+                            hosts=rep["targets"])
+            return
+        if trouble or timed_out:
+            # a failed transfer must not take the pod down OR wedge it:
+            # stay degraded on the survivors and block re-expansion
+            # until the host's agent re-registers OR the cooldown in
+            # _detect_trigger expires (whichever comes first retries)
+            reason = rep["error"] or (
+                "push failed on %s" % rep["failed"]) if trouble \
+                else "replication timed out"
+            self._error("re-expansion aborted (%s) — staying degraded",
+                        reason)
+            flight.record("pod.reexpand_failed", reason=reason,
+                          hosts=rep["returned"])
+            with self._lock:
+                self._reexpand_blocked.update(rep["returned"])
+                for h in rep["returned"]:
+                    self._reexpand_block_ts[h] = now
+            self._replication = None
+            self._spawn_all(agreed=rep["agreed"], rollback=True,
+                            quarantine=rep["quarantine"],
+                            hosts=self.live_hosts())
 
     def _round_weight(self):
         """(counted, env_flake) for the round's valve decision: a pod
@@ -993,6 +1421,14 @@ class PodMaster(object):
         (flakes bounded by the streak valve in ``_tick_agreeing``).
         Exits from the coordinated kill itself (``during_kill``) are
         consequences, not causes — excluded from the weighting."""
+        if self._round_cause.get("cause") in ("capacity-restore",
+                                              "host-absent-retry"):
+            # planned resize probing: capacity return is healthy, and
+            # the absent-retry recycle is the strike accumulator for a
+            # dead host — neither is a failure of the POD, so neither
+            # may consume the crash-loop budget (the strike/loss valves
+            # bound them)
+            return False, False
         exits = [e for e in self._round_exits.values()
                  if not e.get("during_kill")]
         kinds = {e.get("kind") for e in exits}
@@ -1002,37 +1438,70 @@ class PodMaster(object):
         counted = not (cause == "worker-exit" and (flake or preempt_only))
         return counted, flake and not preempt_only
 
-    def _spawn_all(self, agreed, rollback, quarantine=None):
+    def _spawn_all(self, agreed, rollback, quarantine=None, hosts=None):
         # the first spawn keeps incarnation 0; every coordinated
         # restart fences a new life
+        hosts = sorted(hosts) if hosts is not None else \
+            self.live_hosts()
         incarnation = self.fence.bump() if rollback \
             else self.fence.incarnation
         coord_port = _free_port(self.coordinator_host)
         with self._lock:
             self.phase = "respawning"
             self._round_started = time.time()
+            self._spawn_targets = set(hosts)
+            self._pending_specs = {}
             for h, s in self.hosts.items():
                 s["last_exit"] = None
                 s["worker_alive"] = False
                 s["up_inc"] = None
         flight.record("pod.respawn", incarnation=incarnation,
-                      agreed=agreed, coordinator_port=coord_port)
-        self._info("spawning incarnation %d (coordinator %s:%d%s)",
-                   incarnation, self.coordinator_host, coord_port,
-                   ", resume from %s" % agreed if agreed else "")
+                      agreed=agreed, coordinator_port=coord_port,
+                      hosts=hosts, degraded=len(hosts) < self.n_hosts)
+        self._info("spawning incarnation %d on host(s) %s "
+                   "(coordinator %s:%d%s%s)",
+                   incarnation, hosts, self.coordinator_host,
+                   coord_port,
+                   ", resume from %s" % agreed if agreed else "",
+                   ", DEGRADED %d/%d" % (len(hosts), self.n_hosts)
+                   if len(hosts) < self.n_hosts else "")
         with self._lock:
-            for h in self.hosts:
-                self._send(h, self.worker_spec(
+            for h in hosts:
+                spec = self.worker_spec(
                     h, incarnation, coord_port, agreed=agreed,
-                    rollback=rollback, quarantine=quarantine))
+                    rollback=rollback, quarantine=quarantine,
+                    live=hosts)
+                if not self._send(h, spec):
+                    # agent not (yet) registered: deliver the spec if
+                    # it registers while this incarnation is current —
+                    # the full-topology retry rounds depend on it
+                    self._pending_specs[h] = spec
 
     def _tick_respawning(self, now):
         with self._lock:
-            pending = [h for h, s in self.hosts.items()
-                       if s["up_inc"] != self.fence.incarnation]
+            pending = [h for h in sorted(self._spawn_targets)
+                       if self.hosts[h]["up_inc"]
+                       != self.fence.incarnation]
             if not pending:
                 self.phase = "running"
                 return
+            absent = [h for h in pending
+                      if self.hosts[h]["conn"] is None
+                      or not self.hosts[h]["conn"].alive]
+        if self.elastic and absent \
+                and now - self._round_started > self.loss_window_s:
+            # the spawned survivors are blocked inside
+            # jax.distributed.initialize waiting for a host that never
+            # came back — recycle the round (uncounted) so the absence
+            # strikes accumulate toward the permanent-loss verdict
+            # instead of burning the 300 s respawn timeout into giveup
+            self._info("host(s) %s still absent %.0fs into the "
+                       "respawn — recycling the round toward a "
+                       "permanent-loss verdict", absent,
+                       now - self._round_started)
+            self._begin_restart({"cause": "host-absent-retry",
+                                 "hosts": absent}, now)
+            return
         if now - self._round_started > 300:
             self._error("workers of incarnation %d never came up on "
                         "host(s) %s — giving up",
@@ -1148,6 +1617,10 @@ class PodAgent(object):
                                  daemon=True).start()
             elif t == "report_manifests":
                 self._report_manifests(msg)
+            elif t == "fetch_commit":
+                self._fetch_commit(msg)
+            elif t == "push_commit":
+                self._push_commit(msg)
             elif t == "fence":
                 self._print("fenced by master (%s) — killing worker",
                             msg.get("reason"))
@@ -1391,6 +1864,62 @@ class PodAgent(object):
         self._send({"type": "manifests", "host": self.host,
                     "commits": commits})
 
+    # -------------------------------------------- commit replication
+    def _fetch_commit(self, msg):
+        """Read one commit (data file + manifest sidecar) and ship it
+        base64 over the control plane — the survivor's half of the
+        re-expansion transfer (the returning host's ring is frozen at
+        the loss point)."""
+        import base64
+        from veles_tpu.services.snapshotter import MANIFEST_SUFFIX
+        name, directory = msg["name"], msg["snapshot_dir"]
+        cap = float(msg.get("max_mb", 64)) * (1 << 20)
+        files, err = {}, None
+        for fname in (name, name + MANIFEST_SUFFIX):
+            path = os.path.join(directory, fname)
+            try:
+                if os.path.getsize(path) > cap:
+                    err = "%s exceeds the %.0f MiB replication cap " \
+                        "(pod.replicate_max_mb; use shared storage " \
+                        "for checkpoints this size)" \
+                        % (fname, cap / (1 << 20))
+                    break
+                with open(path, "rb") as f:
+                    files[fname] = base64.b64encode(
+                        f.read()).decode("ascii")
+            except OSError as e:
+                err = "%s: %s" % (fname, e)
+                break
+        self._send({"type": "commit_data", "host": self.host,
+                    "name": name, "ok": err is None,
+                    "files": files if err is None else None,
+                    "error": err})
+
+    def _push_commit(self, msg):
+        """Write a replicated commit into the local ring (tmp+rename,
+        so a crash mid-transfer never leaves a half-written commit the
+        next agreement could mistake for local state) — the returning
+        host's half of the transfer."""
+        import base64
+        directory, err = msg["snapshot_dir"], None
+        try:
+            os.makedirs(directory, exist_ok=True)
+            for fname, b64 in (msg.get("files") or {}).items():
+                fname = os.path.basename(fname)   # no path traversal
+                path = os.path.join(directory, fname)
+                tmp = path + ".tmp"   # scans skip ``.tmp`` leftovers
+                with open(tmp, "wb") as f:
+                    f.write(base64.b64decode(b64))
+                os.replace(tmp, path)
+            # the cached agreement scan predates the transfer
+            self._manifest_scan = None
+        except (OSError, ValueError) as e:
+            err = str(e)
+        flight.record("pod.commit_pushed", host=self.host,
+                      ok=err is None, error=err)
+        self._send({"type": "commit_pushed", "host": self.host,
+                    "ok": err is None, "error": err})
+
     def _send(self, obj):
         return self._conn is not None and self._conn.send(obj)
 
@@ -1477,7 +2006,8 @@ def main(argv=None):
             json.dump(report, f, indent=2, default=str)
     print(json.dumps({k: report[k] for k in
                       ("phase", "incarnation", "restarts",
-                       "restart_causes", "rc")}, default=str))
+                       "restart_causes", "degraded", "lost_hosts",
+                       "resize_restarts", "rc")}, default=str))
     return rc if rc is not None else 1
 
 

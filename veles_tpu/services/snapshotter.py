@@ -61,6 +61,139 @@ class SnapshotNonFiniteError(SnapshotIntegrityError):
     for workloads that legitimately checkpoint non-finite leaves."""
 
 
+class SnapshotReshardError(SnapshotIntegrityError):
+    """A checkpoint cannot legally restore onto the live mesh topology
+    (:func:`reshard_state`): e.g. the recorded global minibatch does
+    not divide the new data-axis size, or a model-parallel axis
+    changed.  Raised BEFORE any state is applied — the workflow stays
+    untouched."""
+
+
+def mesh_topology(mesh_config=None):
+    """The live run's checkpoint-topology tag: how many processes and
+    devices wrote it, and under which mesh axes.  Recorded in every
+    commit (state + manifest) so the elastic-pod restore path
+    (:func:`reshard_state`) can prove a cross-topology resume legal —
+    and so post-mortems can attribute a checkpoint to the pod size
+    that produced it."""
+    import jax
+    tag = {"processes": int(jax.process_count()),
+           "devices": int(jax.device_count())}
+    if mesh_config is not None:
+        tag["axes"] = {str(k): int(v)
+                       for k, v in dict(mesh_config.mesh.shape).items()}
+        tag["fsdp"] = bool(mesh_config.fsdp)
+    return tag
+
+
+def reshard_state(state, target, minibatch_size=None):
+    """Remap a checkpoint written under one mesh topology onto another
+    (the elastic-pod degrade/re-expand path, services.podmaster).
+
+    The file/db backends gather every array to the host before
+    committing (``host_params``/``host_velocity``) and the orbax import
+    restores to host numpy, so params and optimizer slots are **dense,
+    topology-free trees** — resharding them is re-placement under the
+    new mesh's shardings (``load_params``/``shard_params`` does that),
+    per-leaf bit-exact by construction.  What this function owns is
+    proving the *rest* of the state stays deterministic at the new size
+    and refusing the restore when it cannot:
+
+    * **loader offsets** — the loader serves GLOBAL minibatch indices
+      (one shared order/offset, sharded across the data axis inside the
+      step), so the global data order is invariant under a resize *iff*
+      the new data-axis size still divides the recorded global
+      minibatch.  Checked here; violation raises :class:`SnapshotReshardError`
+      instead of the trainer's later divisibility error mid-restore.
+    * **PRNG words** — every stream is a global ``(seed, counter)``
+      pair (veles_tpu.prng), never folded by process index, so the
+      words carry unchanged and replay identically on any topology.
+      Verified (a per-process word would be a dict keyed off hosts).
+    * **model-parallel axes** — parameters are dense in the checkpoint,
+      so even a model-axis change is *representable*; it is still
+      refused unless sizes match, because tensor-parallel layouts are
+      woven into kernels (same policy as
+      :func:`parallel.mesh.fit_axes_to_devices`).
+
+    :param state: the loaded snapshot dict (mutated only by dropping
+        nothing — returned as-is).
+    :param target: a :func:`mesh_topology`-shaped dict for the LIVE
+        run.
+    :param minibatch_size: the live loader's global minibatch when the
+        checkpoint predates the recorded one (legacy).
+    :returns: ``(state, report)`` — report carries ``from``/``to``,
+        ``changed`` and the list of executed ``checks``."""
+    source = state.get("topology")
+    report = {"from": source, "to": target, "checks": [],
+              "changed": bool(source) and source != target}
+    if source and target:
+        s_axes, t_axes = source.get("axes"), target.get("axes")
+        if s_axes and t_axes:
+            for name in sorted(set(s_axes) | set(t_axes)):
+                if name == "data":
+                    continue
+                if s_axes.get(name, 1) != t_axes.get(name, 1):
+                    raise SnapshotReshardError(
+                        "checkpoint written under %s=%d cannot restore "
+                        "onto %s=%d: only the data axis may resize "
+                        "(tensor/seq/expert layouts are woven into the "
+                        "kernels)" % (name, s_axes.get(name, 1), name,
+                                      t_axes.get(name, 1)))
+            report["checks"].append("non-data axes match")
+        if bool(source.get("fsdp")) != bool(target.get("fsdp")):
+            # legal: fsdp only changes array PLACEMENT, the dense host
+            # trees re-place under whatever the live mesh wants
+            report["checks"].append("fsdp changed (placement-only)")
+    loader = state.get("loader")
+    if isinstance(loader, dict):
+        mb = loader.get("minibatch_size", minibatch_size)
+        # only a MESHED run shards the batch across a data axis; a
+        # meshless restore serves the whole global minibatch from one
+        # process, so there is nothing to divide
+        data = (target or {}).get("axes", {}).get("data", 0)
+        if mb and data and int(mb) % int(data):
+            raise SnapshotReshardError(
+                "the new data-axis size %d does not divide the global "
+                "minibatch %d — the resized mesh cannot serve the "
+                "recorded data order deterministically (choose a pod "
+                "size whose data axis divides the minibatch)"
+                % (data, mb))
+        report["checks"].append(
+            "loader offset %s global (order invariant)"
+            % loader.get("minibatch_offset"))
+    prng_words = state.get("prng")
+    if isinstance(prng_words, dict):
+        bad = [name for name, st in prng_words.items()
+               if not (isinstance(st, dict) and "seed" in st
+                       and "counter" in st)]
+        if bad:
+            raise SnapshotReshardError(
+                "prng stream(s) %s are not global (seed, counter) "
+                "words — cannot prove their replay is topology-free"
+                % bad[:5])
+        report["checks"].append("%d prng streams are global words"
+                                % len(prng_words))
+    import numpy as np
+    n_arrays = 0
+    for key in ("params", "velocity"):
+        tree = state.get(key)
+        if tree is None:
+            continue
+        for path, leaf in iter_state_leaves(tree, "/" + key):
+            if hasattr(leaf, "shape"):
+                n_arrays += 1
+                if not isinstance(leaf, (np.ndarray, np.generic)):
+                    # a live jax.Array pinned to the WRITING mesh would
+                    # re-place wrong; every import path returns numpy
+                    raise SnapshotReshardError(
+                        "%s is not a host array (%s) — the checkpoint "
+                        "carries device placement from the old "
+                        "topology" % (path, type(leaf).__name__))
+    report["checks"].append("%d param/slot leaves dense on host"
+                            % n_arrays)
+    return state, report
+
+
 def iter_state_leaves(obj, prefix=""):
     """Flatten nested dict/list/tuple snapshot state into sorted
     (path, leaf) pairs — shared by the integrity manifest below and
@@ -111,6 +244,11 @@ def commit_meta(state=None):
             meta["incarnation"] = inc
     if isinstance(state, dict) and "epoch" in state:
         meta["epoch"] = state["epoch"]
+    if isinstance(state, dict) and "topology" in state:
+        # the mesh the commit was written under — the pod master's
+        # degraded/re-expand accounting and reshard-on-restore read it
+        # without unpickling (scan_commits)
+        meta["topology"] = state["topology"]
     return meta
 
 
@@ -201,7 +339,8 @@ def scan_commits(directory, prefix):
             continue
         path = os.path.join(directory, name)
         entry = {"path": path, "epoch": None, "incarnation": None,
-                 "process_index": None, "valid": None, "error": None}
+                 "process_index": None, "topology": None,
+                 "valid": None, "error": None}
         try:
             entry["mtime"] = os.path.getmtime(path)
         except OSError:
@@ -211,6 +350,7 @@ def scan_commits(directory, prefix):
             entry["epoch"] = manifest.get("epoch")
             entry["incarnation"] = manifest.get("incarnation")
             entry["process_index"] = manifest.get("process_index")
+            entry["topology"] = manifest.get("topology")
             recorded = manifest.get("file_sha256")
             if recorded is None:
                 entry["valid"] = None
@@ -872,6 +1012,10 @@ class TrainingSnapshotter(SnapshotterBase):
             # post-resume minibatches — the decision's metric for that
             # epoch would diverge from an uninterrupted run
             "trainer_stats": jax.device_get(self.trainer.class_stats),
+            # the mesh the commit is written under — reshard_state
+            # proves (or refuses) a cross-topology resume against it
+            "topology": mesh_topology(
+                getattr(self.trainer, "mesh_config", None)),
         }
         if self.decision is not None:
             state["decision"] = {
@@ -895,8 +1039,26 @@ class TrainingSnapshotter(SnapshotterBase):
     @staticmethod
     def restore(workflow, snapshot):
         """Apply a snapshot dict to an initialized workflow — training
-        continues mid-stream (ref §3.5 resume)."""
+        continues mid-stream (ref §3.5 resume).  A checkpoint written
+        under a different mesh topology first passes
+        :func:`reshard_state`: the resize is proven deterministic (or
+        refused) BEFORE any state is applied, and the cross-topology
+        resume joins the flight record."""
         trainer, loader = workflow.trainer, workflow.loader
+        live = mesh_topology(getattr(trainer, "mesh_config", None))
+        snapshot, reshard = reshard_state(
+            snapshot, live,
+            minibatch_size=getattr(loader, "minibatch_size", None))
+        if reshard["changed"]:
+            from veles_tpu.telemetry import flight
+            flight.record("snapshot.reshard",
+                          source=reshard["from"], target=reshard["to"],
+                          checks=reshard["checks"])
+            import logging
+            logging.getLogger("Snapshotter").info(
+                "resharding checkpoint written under %s onto %s (%s)",
+                reshard["from"], reshard["to"],
+                "; ".join(reshard["checks"]))
         trainer.load_params(snapshot["params"], snapshot.get("velocity"))
         trainer._step_counter = snapshot.get("step_counter", 0)
         loader.state = snapshot["loader"]
@@ -1205,6 +1367,8 @@ class OrbaxSnapshotter(TrainingSnapshotter):
             # a handful of scalars — the no-gather contract is about
             # the param/velocity trees
             "trainer_stats": jax.device_get(t.class_stats),
+            "topology": mesh_topology(
+                getattr(t, "mesh_config", None)),
         }
         if self.decision is not None:
             state["decision"] = {

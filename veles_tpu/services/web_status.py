@@ -525,6 +525,20 @@ class WebStatusServer(Logger):
                 state["serving"] = engine.lifecycle_status()
             except Exception as e:   # noqa: BLE001
                 state["serving"] = {"error": str(e)}
+        try:
+            # pod-size block (threaded into workers by the pod master,
+            # services.podmaster): probing ANY worker answers "how big
+            # is the pod right now, and who is missing"
+            from veles_tpu.config import root as _root
+            pod = _root.common.get("pod")
+            pod = pod.as_dict() if hasattr(pod, "as_dict") else None
+            if pod and "size" in pod:
+                state["pod"] = {
+                    "size": pod.get("size"), "total": pod.get("total"),
+                    "degraded": bool(pod.get("degraded")),
+                    "lost_hosts": pod.get("lost_hosts") or []}
+        except Exception:   # noqa: BLE001 — the probe must answer
+            pass
         return state
 
     def status(self):
