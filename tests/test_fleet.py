@@ -1,0 +1,415 @@
+"""Autoscaling serving plane — the PURE policy core of
+services.podmaster's ServeFleetMaster (no sockets, no subprocesses):
+the FleetAutoscaler's measured-feedback decisions (overshoot /
+serve.shed → scale-up, sustained idle → scale-down, cooldown, min/max
+clamps), the PodValves scale bucket (flap damping that can never
+consume the crash-loop budget), the plan_fleet reconciler
+(replacement-on-host-death as plain reconciliation, per-host caps,
+deterministic placement/drain order), the dead-replica classifier,
+the router's staggered health-probe phases (pinned), the shedder's
+overshoot surface, and the veles_fleet_* gauges."""
+
+import time
+
+import pytest
+
+from veles_tpu.services.lifecycle import SloShedder
+from veles_tpu.services.podmaster import (FleetAutoscaler, PodValves,
+                                          ServeFleetMaster,
+                                          dead_replica_verdicts,
+                                          plan_fleet)
+from veles_tpu.services.router import FleetRouter
+
+
+def _rep(host, state, ready_ts=None, rid=None):
+    return {"host": host, "state": state, "rid": rid, "port": None,
+            "pid": None, "spawn_ts": 0.0, "ready_ts": ready_ts,
+            "exit": None}
+
+
+# ===================================================================
+# FleetAutoscaler — the closed-loop decisions
+# ===================================================================
+
+def _sig(overshoot=0.0, shed_total=0, busy=False):
+    return {"overshoot": overshoot, "shed_total": shed_total,
+            "busy": busy}
+
+
+class TestFleetAutoscaler:
+    def test_overshoot_scales_up(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=5)
+        delta, reason = a.decide(0.0, 2, 1, 4, _sig(overshoot=1.5))
+        assert delta == +1
+        assert "overshoot" in reason
+
+    def test_under_slo_never_scales_up(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=5)
+        # busy but UNDER the SLO: capacity is adequate — no decision
+        delta, _ = a.decide(0.0, 2, 1, 4,
+                            _sig(overshoot=0.9, busy=True))
+        assert delta == 0
+
+    def test_fresh_sheds_scale_up(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=5)
+        a.decide(0.0, 2, 1, 4, _sig(shed_total=10, busy=True))
+        # shed_total is monotonic: only a DELTA means fresh rejections
+        delta, _ = a.decide(10.0, 2, 1, 4,
+                            _sig(shed_total=10, busy=True))
+        assert delta == 0
+        delta, reason = a.decide(20.0, 2, 1, 4,
+                                 _sig(shed_total=13, busy=True))
+        assert delta == +1
+        assert "shed_delta=3" in reason
+
+    def test_max_clamp(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=0)
+        delta, reason = a.decide(0.0, 4, 1, 4, _sig(overshoot=9.0))
+        assert delta == 0
+        assert "max" in reason
+
+    def test_sustained_idle_scales_down_after_idle_s(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=10, cooldown_s=0)
+        assert a.decide(0.0, 3, 1, 4, _sig())[0] == 0   # idle starts
+        assert a.decide(5.0, 3, 1, 4, _sig())[0] == 0   # not yet
+        delta, reason = a.decide(10.0, 3, 1, 4, _sig())
+        assert delta == -1
+        assert "idle" in reason
+
+    def test_busy_resets_the_idle_clock(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=10, cooldown_s=0)
+        a.decide(0.0, 3, 1, 4, _sig())
+        a.decide(9.0, 3, 1, 4, _sig(busy=True))    # work arrived
+        assert a.decide(12.0, 3, 1, 4, _sig())[0] == 0
+        assert a.decide(19.0, 3, 1, 4, _sig())[0] == 0
+        assert a.decide(22.0, 3, 1, 4, _sig())[0] == -1
+
+    def test_min_clamp(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=5, cooldown_s=0)
+        a.decide(0.0, 1, 1, 4, _sig())
+        delta, reason = a.decide(10.0, 1, 1, 4, _sig())
+        assert delta == 0
+        assert "min" in reason
+
+    def test_cooldown_spaces_decisions_but_idle_clock_runs(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=10,
+                            cooldown_s=60)
+        assert a.decide(0.0, 2, 1, 4, _sig(overshoot=2.0))[0] == +1
+        # still overloaded 5s later: cooldown holds the next step
+        delta, reason = a.decide(5.0, 3, 1, 4, _sig(overshoot=2.0))
+        assert (delta, reason) == (0, "cooldown")
+        # load vanished at t=10; idle accrued THROUGH the cooldown,
+        # so the first post-cooldown step may already scale down
+        a.decide(10.0, 3, 1, 4, _sig())
+        assert a.decide(70.0, 3, 1, 4, _sig())[0] == -1
+
+    def test_one_step_at_a_time(self):
+        # the controller is closed-loop: a 10x overshoot still adds
+        # ONE replica per decision (the effect must be measured
+        # before the next step)
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=0)
+        assert a.decide(0.0, 1, 1, 8, _sig(overshoot=10.0))[0] == +1
+
+
+# ===================================================================
+# PodValves scale bucket — flap damping, isolated budgets
+# ===================================================================
+
+class TestScaleValve:
+    def test_flap_damping_window(self):
+        v = PodValves(8, 600, 3, scale_max_per_window=2,
+                      scale_window_seconds=100.0)
+        assert v.admit_scale(0.0) == "scale"
+        assert v.admit_scale(10.0) == "scale"
+        assert v.admit_scale(20.0) == "damped"     # window full
+        assert v.admit_scale(110.1) == "scale"     # window slid
+        assert v.scale_events == 3
+        assert v.scale_damped == 1
+
+    def test_scale_never_consumes_the_crash_loop_budget(self):
+        v = PodValves(2, 600, 3, scale_max_per_window=100,
+                      scale_window_seconds=600)
+        for t in range(50):
+            assert v.admit_scale(float(t)) == "scale"
+        # the crash-loop window is untouched: two counted restarts
+        # still fit
+        assert v.admit(100.0) == "respawn"
+        assert v.admit(101.0) == "respawn"
+        assert v.admit(102.0) == "crash-loop"
+
+    def test_crashes_never_consume_the_scale_budget(self):
+        v = PodValves(100, 600, 99, scale_max_per_window=1,
+                      scale_window_seconds=600)
+        for t in range(10):
+            v.admit(float(t), ("sig",))
+        assert v.admit_scale(50.0) == "scale"
+
+    def test_default_construction_unchanged(self):
+        # PR 9/10 call sites pass three positionals — must keep working
+        v = PodValves(8, 600, 3)
+        assert v.admit(0.0) == "respawn"
+        assert v.scale_events == 0
+
+
+# ===================================================================
+# plan_fleet — declarative reconciliation
+# ===================================================================
+
+class TestPlanFleet:
+    def test_initial_spread_least_loaded_first(self):
+        spawns, drains = plan_fleet(3, [0, 1], 2, {})
+        assert spawns == [0, 1, 0]
+        assert drains == []
+
+    def test_per_host_cap(self):
+        spawns, _ = plan_fleet(5, [0, 1], 2, {})
+        assert len(spawns) == 4                  # 2 hosts x cap 2
+        assert sorted(spawns) == [0, 0, 1, 1]
+
+    def test_steady_state_no_actions(self):
+        spawns, drains = plan_fleet(2, [0, 1], 2, {0: 0, 1: 1})
+        assert (spawns, drains) == ([], [])
+
+    def test_replacement_on_host_death(self):
+        # host 1 died: its replica vanishes from the live-host view
+        # and reconciliation re-places it on the survivor — the
+        # replacement path IS the plain plan, no special case
+        spawns, drains = plan_fleet(2, [0], 2, {0: 0, 1: 1})
+        assert spawns == [0]
+        assert drains == []
+
+    def test_replacement_respects_survivor_cap(self):
+        # survivor already full: the spec is unsatisfiable — plan
+        # what fits, never overload the survivor
+        spawns, _ = plan_fleet(4, [0], 2, {0: 0, 1: 0, 2: 1, 3: 1})
+        assert spawns == []
+
+    def test_scale_down_drains_newest_on_most_loaded(self):
+        spawns, drains = plan_fleet(
+            2, [0, 1], 4, {0: 0, 1: 1, 2: 0, 3: 0})
+        assert spawns == []
+        assert drains == [3, 2]
+
+    def test_drainable_restriction(self):
+        # a replica still SPAWNING is not drainable (it serves
+        # nothing to drain); surplus waits for it to become ready
+        spawns, drains = plan_fleet(
+            1, [0], 4, {0: 0, 1: 0, 2: 0}, drainable=[0, 1])
+        assert spawns == []
+        assert drains == [1, 0]
+
+    def test_draining_still_occupies_its_slot(self):
+        # rep 1 is draining: it neither counts toward desired nor
+        # gets drained again, but its host slot stays occupied until
+        # it exits
+        spawns, drains = plan_fleet(
+            2, [0], 2, {0: 0, 1: 0}, draining=[1])
+        assert (spawns, drains) == ([], [])
+
+
+# ===================================================================
+# dead_replica_verdicts — host death vs sick process
+# ===================================================================
+
+class TestDeadReplicaVerdicts:
+    def test_host_death(self):
+        reps = {0: {"host": 0, "state": "ready", "rid": 7}}
+        assert dead_replica_verdicts(
+            reps, {7: "down"}, {0: False}) == [(0, "host-death")]
+
+    def test_sick_process_on_live_host(self):
+        reps = {0: {"host": 0, "state": "ready", "rid": 7}}
+        assert dead_replica_verdicts(
+            reps, {7: "down"}, {0: True}) == [(0, "down")]
+
+    def test_up_and_draining_are_not_dead(self):
+        reps = {0: {"host": 0, "state": "ready", "rid": 7},
+                1: {"host": 0, "state": "ready", "rid": 8}}
+        assert dead_replica_verdicts(
+            reps, {7: "up", 8: "draining"}, {0: False}) == []
+
+    def test_only_ready_replicas_classified(self):
+        # spawning/draining/dead manager states are someone else's
+        # problem (ready-timeout, drain completion)
+        reps = {0: {"host": 0, "state": "spawning", "rid": None},
+                1: {"host": 0, "state": "draining", "rid": 9}}
+        assert dead_replica_verdicts(
+            reps, {9: "down"}, {0: False}) == []
+
+
+# ===================================================================
+# ServeFleetMaster death handling (no sockets: unstarted master)
+# ===================================================================
+
+def _master(tmp_path, **kw):
+    kw.setdefault("spawn_agents", False)
+    kw.setdefault("min_uptime_s", 30.0)
+    return ServeFleetMaster(["true"], n_hosts=2, fleet_min=1,
+                            fleet_max=4, per_host=4,
+                            workdir=str(tmp_path), **kw)
+
+
+class TestReplicaExitPolicy:
+    def test_unplanned_clean_exit_loop_trips_the_valve(self, tmp_path):
+        # a misconfigured replica command that exits 0 instantly must
+        # NOT respawn unbudgeted forever: unplanned "done" counts,
+        # with a stable "clean-exit" signature, so the deterministic
+        # valve holds replacements
+        m = _master(tmp_path, deterministic_limit=3)
+        now = time.time()
+        for i in range(3):
+            m.reps[i] = _rep(0, "ready", ready_ts=now)
+            m._handle_replica_exit(
+                0, {"rep": i, "rc": 0, "kind": "done"}, now)
+        assert m.hold_replace == "deterministic-bug"
+        assert [h["verdict"] for h in m.history][-1] \
+            == "deterministic-bug"
+
+    def test_long_served_replica_exit_is_progress(self, tmp_path):
+        # a replica that served past min_uptime_s resets the
+        # deterministic counter — only instant-exit loops latch
+        m = _master(tmp_path, deterministic_limit=3, min_uptime_s=10)
+        now = time.time()
+        for i in range(5):
+            m.reps[i] = _rep(0, "ready", ready_ts=now - 60)
+            m._handle_replica_exit(
+                0, {"rep": i, "rc": 0, "kind": "done"}, now)
+        assert m.hold_replace is None
+        assert m.replaced_total == 5
+
+    def test_env_flake_uncounted(self, tmp_path):
+        m = _master(tmp_path, max_restarts=1, window_seconds=600)
+        now = time.time()
+        for i in range(6):
+            m.reps[i] = _rep(0, "ready", ready_ts=now)
+            m._handle_replica_exit(
+                0, {"rep": i, "rc": -11, "kind": "env-flake"}, now)
+        assert m.hold_replace is None          # never counted
+        assert m.replaced_total == 6
+
+    def test_lost_host_reaps_stranded_replicas(self, tmp_path):
+        # spawning/dying/draining replicas on a host the strike
+        # ladder declared LOST get no exit report ever — they must be
+        # reaped (replaced in the resize bucket / recorded as a dirty
+        # drain), not hold phantom slots forever
+        m = _master(tmp_path)
+        now = time.time()
+        m.lost_hosts.add(1)
+        m.reps[5] = _rep(1, "spawning")
+        m.reps[6] = _rep(1, "draining", ready_ts=now - 60)
+        m.reps[7] = _rep(0, "spawning")        # live host: untouched
+        m._reap_lost_host_replicas(now)
+        assert m.reps[5]["state"] == "dead"
+        assert m.reps[6]["state"] == "dead"
+        assert m.reps[7]["state"] == "spawning"
+        replaces = [h for h in m.history
+                    if h.get("action") == "replace"]
+        assert [(h["rep"], h["cause"], h["counted"])
+                for h in replaces] == [(5, "host-death", False)]
+        assert m.valves.resize_restarts == 1   # planned recovery
+        assert [(d["rep"], d["kind"], d["was_ready"])
+                for d in m.drained] == [(6, "host-death", True)]
+
+
+# ===================================================================
+# staggered health probes — the phase function, pinned
+# ===================================================================
+
+class TestProbePhase:
+    def test_deterministic_and_bounded(self):
+        for rid in range(64):
+            p = FleetRouter.probe_phase(rid, 0.1)
+            assert 0.0 <= p < 0.1
+            assert p == FleetRouter.probe_phase(rid, 0.1)
+
+    def test_pinned_spacing(self):
+        # golden-ratio spacing, pinned: these exact offsets are the
+        # contract (a change here changes every fleet's probe timing)
+        assert FleetRouter.probe_phase(0, 1.0) == pytest.approx(
+            0.6180339887498949)
+        assert FleetRouter.probe_phase(1, 1.0) == pytest.approx(
+            0.2360679774997898)
+        assert FleetRouter.probe_phase(2, 1.0) == pytest.approx(
+            0.8541019662496847)
+        assert FleetRouter.probe_phase(3, 1.0) == pytest.approx(
+            0.4721359549995796)
+
+    def test_first_probe_never_races_registration(self):
+        # strictly positive phase: no replica's FIRST probe fires at
+        # the registration instant (the optimistic-up window exists)
+        for rid in range(256):
+            assert FleetRouter.probe_phase(rid, 0.1) > 0.0
+
+    def test_no_lockstep_at_scale(self):
+        # any two of the first 32 replicas are at least interval/64
+        # apart — N probes never fire as one synchronized herd
+        interval = 0.1
+        phases = sorted(FleetRouter.probe_phase(r, interval)
+                        for r in range(32))
+        gaps = [b - a for a, b in zip(phases, phases[1:])]
+        assert min(gaps) > interval / 64
+
+    def test_scales_with_interval(self):
+        assert FleetRouter.probe_phase(5, 2.0) == pytest.approx(
+            2.0 * ((6 * 0.6180339887498949) % 1.0))
+
+
+# ===================================================================
+# shedder overshoot surface + fleet gauges
+# ===================================================================
+
+class TestFleetObservability:
+    def test_shedder_overshoot_in_status(self):
+        s = SloShedder(slo_ms=100.0)
+        s.update(head_wait_ms=250.0)
+        assert s.overshoot() == pytest.approx(2.5)
+        st = s.status()
+        assert st["overshoot"] == pytest.approx(2.5)
+        assert st["last_measure_ms"] == pytest.approx(250.0)
+
+    def test_disabled_shedder_overshoot_zero(self):
+        s = SloShedder(slo_ms=0)
+        assert s.overshoot() == 0.0
+        assert s.status()["overshoot"] == 0.0
+
+    def test_fleet_gauges_and_blocks(self):
+        from veles_tpu import telemetry
+        router = FleetRouter(port=0, rng_seed=3)
+        # never started: registry bookkeeping only
+        rid = router.register("http://127.0.0.1:1/service")
+        router.note_fleet(desired=3, hosts=2, replaced=0)
+        router.fleet_event("scale", "up")
+        router.fleet_event("replace")
+        reg = telemetry.registry
+        g = reg.gauge("veles_fleet_replicas",
+                      "registered serving replicas",
+                      labelnames=("state",))
+        assert g.value(state="up") == 1
+        assert reg.gauge("veles_fleet_desired", "").value() == 3
+        assert reg.counter(
+            "veles_fleet_scale_events_total", "",
+            labelnames=("direction",)).value(direction="up") >= 1
+        assert reg.counter(
+            "veles_fleet_replaced_total", "").value() >= 1
+        # the fleet block rides /metrics and /health
+        assert router.metrics()["fleet"]["desired"] == 3
+        assert router.fleet_health()["fleet"]["desired"] == 3
+        router.deregister(rid)
+        assert g.value(state="up") == 0
+
+    def test_fleet_signals_aggregation(self):
+        router = FleetRouter(port=0, rng_seed=3)
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        with router._lock:
+            router._replicas[r1].last_health = {
+                "serving": {"overshoot": 2.5, "shed_total": 4},
+                "queued": 0, "in_flight": 0}
+            router._replicas[r2].last_health = {
+                "serving": {"overshoot": 0.5, "shed_total": 1},
+                "queued": 3, "in_flight": 1}
+        sig = router.fleet_signals()
+        assert sig["overshoot"] == pytest.approx(2.5)   # the WORST
+        assert sig["shed_total"] == 5
+        assert sig["busy"] is True
+        assert sig["live"] == 2

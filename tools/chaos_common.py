@@ -1,0 +1,366 @@
+"""Shared plumbing for the chaos harnesses — tools/serve_loadtest.py,
+tools/train_chaos.py, tools/pod_chaos.py and tools/fleet_chaos.py all
+compose the same primitives (READY handshakes, completion-triggered
+chaos, startup-flake-tolerant spawns, gate accounting, checkpoint-ring
+audits); factoring them here means the four harnesses cannot drift
+apart on what "a replica is ready", "a request was lost" or "the ring
+is valid" mean.
+
+Nothing here decides POLICY — each harness keeps its own plan and its
+own gates; this module is the vocabulary they share.
+"""
+
+import http.client
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ===================================================================
+# READY handshake + startup-flake-tolerant replica spawning
+# ===================================================================
+
+def read_ready(proc, deadline, parse=None):
+    """Scan one subprocess's piped stdout for the fleet READY
+    handshake (``restful.READY_LINE``), select-bounded so a silently
+    wedged child hits the deadline instead of blocking the harness on
+    the pipe forever.  Returns the parsed dict ({"port", "pid"}), or
+    raises RuntimeError on death/timeout (message says which)."""
+    if parse is None:
+        from veles_tpu.services.restful import parse_ready_line
+        parse = parse_ready_line
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise RuntimeError("replica startup timed out")
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    min(1.0, left))
+        if not ready:
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("replica died during startup "
+                               "(exit %r)" % proc.poll())
+        parsed = parse(line)
+        if parsed is not None:
+            return parsed
+
+
+def spawn_ready(cmds, timeout=300.0, envs=None, flake_retries=2,
+                log_dir=None):
+    """Spawn N replica subprocesses and wait for each one's READY
+    handshake; returns ``[(proc, port, url)]`` (url =
+    ``http://127.0.0.1:<port>/service``).
+
+    A child that dies PRE-READY with the sandbox startup-flake
+    fingerprint (abort-class signal, startup-shaped stderr — see
+    ``supervisor.is_startup_flake``) is respawned up to
+    ``flake_retries`` times: the documented environment abort comes
+    in storms and must not fail a chaos run before the chaos even
+    starts.  stderr goes to ``log_dir/replica-<i>.log`` (or a discard
+    file) so the fingerprint has a transcript to judge.
+    """
+    from veles_tpu.services.supervisor import is_startup_flake
+    envs = envs or [None] * len(cmds)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    deadline = time.monotonic() + timeout
+
+    def launch(i):
+        log_path = os.path.join(log_dir, "replica-%d.log" % i) \
+            if log_dir else None
+        log = open(log_path, "wb") if log_path else \
+            open(os.devnull, "wb")
+        try:
+            return subprocess.Popen(cmds[i], stdout=subprocess.PIPE,
+                                    stderr=log, text=True,
+                                    env=envs[i]), log_path
+        finally:
+            log.close()
+
+    # launch ALL replicas first, THEN collect READY lines: N model
+    # builds overlap, so fleet-up costs ~max(t_i), not sum(t_i)
+    procs = [launch(i) for i in range(len(cmds))]
+    out = []
+    for i, (proc, log_path) in enumerate(procs):
+        for attempt in range(flake_retries + 1):
+            try:
+                ready = read_ready(proc, deadline)
+                break
+            except RuntimeError:
+                rc = proc.poll()
+                if rc is None:     # wedged, not dead: timeout
+                    proc.kill()
+                    raise
+                err = ""
+                if log_path:
+                    try:
+                        with open(log_path, "rb") as f:
+                            err = f.read(65536).decode("utf-8",
+                                                       "replace")
+                    except OSError:
+                        pass
+                if attempt < flake_retries and \
+                        is_startup_flake(rc, "", err):
+                    print("[chaos-common] replica %d startup flake "
+                          "(rc=%s) — respawning" % (i, rc),
+                          flush=True)
+                    proc, log_path = launch(i)
+                    continue
+                raise
+        out.append((proc, ready["port"],
+                    "http://127.0.0.1:%d/service" % ready["port"]))
+    return out
+
+
+# ===================================================================
+# completion-triggered chaos
+# ===================================================================
+
+def wait_fraction(completed, fraction, total, deadline,
+                  poll_s=0.005):
+    """Block until ``completed()`` (a callable) reaches ``fraction``
+    of ``total`` — the completion-TRIGGERED chaos primitive: a kill
+    gated on client progress provably lands mid-storm on any box
+    speed, where a timed kill races the storm.  Returns the observed
+    count (which may be short if ``deadline`` — a monotonic
+    timestamp — passed first)."""
+    target = fraction * total
+    while completed() < target and time.monotonic() < deadline:
+        time.sleep(poll_s)
+    return completed()
+
+
+# ===================================================================
+# HTTP + report helpers
+# ===================================================================
+
+def http_json(host, port, path, method="GET", body=None, timeout=30):
+    """One JSON request/response against a replica or router;
+    returns (status, payload dict)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body,
+                     {"Content-Type": "application/json"}
+                     if body else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+#: flight events stamp wall time; harnesses measure monotonic — one
+#: offset sample converts between them (drift over a storm is far
+#: below any gate's slack)
+MONO_TO_WALL = time.time() - time.monotonic()
+
+
+# ===================================================================
+# the fleet storm client
+# ===================================================================
+
+def fleet_stream_client(router_host, router_port, router_path,
+                        prompt, max_new, expected, session, tally,
+                        lock, errors=None, timeout=180):
+    """One fleet storm client: stream through the ROUTER and verify
+    the full concatenated result — chunk lines must splice to exactly
+    the done line's result, and that result must equal the expected
+    uninterrupted output (failover must be invisible).  Outcome lands
+    in ``tally`` under ``lock``."""
+    body = json.dumps({"input": prompt, "session": session,
+                       "generate": {"max_new": max_new,
+                                    "stream": True}})
+    outcome = "error"
+    try:
+        conn = http.client.HTTPConnection(router_host, router_port,
+                                          timeout=timeout)
+        conn.request("POST", router_path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 503:
+            resp.read()
+            outcome = "shed"
+        elif resp.status != 200:
+            resp.read()
+            outcome = "http_%d" % resp.status
+        else:
+            got, result, done = list(prompt), None, False
+            while True:
+                raw = resp.fp.readline()
+                if not raw:
+                    break
+                msg = json.loads(raw)
+                if "tokens" in msg:
+                    got.extend(msg["tokens"])
+                elif msg.get("done"):
+                    result, done = msg["result"], True
+                    break
+                elif "error" in msg:
+                    outcome = "stream_error"
+                    if errors is not None:
+                        with lock:
+                            errors.append(str(msg["error"])[:200])
+                    return
+            if not done:
+                outcome = "truncated"
+            elif list(result) != list(got):
+                outcome = "splice_mismatch"
+            elif expected is not None \
+                    and list(result) != list(expected):
+                outcome = "bad_result"
+            else:
+                outcome = "ok"
+        conn.close()
+    except Exception:  # noqa: BLE001 — chaos clients absorb anything
+        outcome = "error"
+    finally:
+        with lock:
+            tally[outcome] = tally.get(outcome, 0) + 1
+
+
+# ===================================================================
+# gate accounting
+# ===================================================================
+
+#: the engine-side resource-audit keys every serving gate checks —
+#: one list so a new leak class added to ``leak_check()`` only needs
+#: wiring here
+LEAK_KEYS = ("ingress", "records", "open_requests",
+             "pending_cancels", "slots_busy")
+
+
+def leak_gate(leaks, fails, label=""):
+    """Append one failure string per nonzero leak counter (and the
+    paged-KV audit) to ``fails``; the shared spelling of "zero leaked
+    slots/blocks"."""
+    prefix = ("%s " % label) if label else ""
+    for key in LEAK_KEYS:
+        if leaks.get(key, 0) != 0:
+            fails.append("%sleak: %s=%r" % (prefix, key,
+                                            leaks.get(key)))
+    if leaks.get("kv_blocks_leaked", 0) != 0:
+        fails.append("%sleak: kv_blocks_leaked=%r"
+                     % (prefix, leaks["kv_blocks_leaked"]))
+    return fails
+
+
+def tally_gate(tally, clients, fails, allowed=("ok", "shed")):
+    """Exhaustive client accounting: EVERY client must end in an
+    ``allowed`` outcome (any other — truncated, splice_mismatch,
+    bad_result, error, http_5xx... — is a lost/corrupt request) and
+    the outcome count must equal the client count (a missing outcome
+    is a client that never reported)."""
+    unexpected = {k: v for k, v in tally.items()
+                  if k not in allowed and v}
+    if unexpected:
+        fails.append("lost/corrupt requests: %r" % (unexpected,))
+    total = sum(tally.values())
+    if total != clients:
+        fails.append("client accounting: %d outcomes for %d clients"
+                     % (total, clients))
+    return fails
+
+
+# ===================================================================
+# checkpoint-ring primitives (train_chaos / pod_chaos)
+# ===================================================================
+
+def current_target(snap_dir, prefix):
+    """(realpath, mtime) of the directory's ``<prefix>_current``
+    symlink target, or (None, None)."""
+    cur = os.path.join(snap_dir, "%s_current" % prefix)
+    try:
+        real = os.path.realpath(cur)
+        if os.path.islink(cur) and os.path.exists(real):
+            return real, os.path.getmtime(real)
+    except OSError:
+        pass
+    return None, None
+
+
+def truncate_commit(path, keep_num=3, keep_den=5):
+    """Tear one committed checkpoint in place (truncate to
+    keep_num/keep_den of its size) — exactly what a kill inside a
+    storage write leaves behind.  Raises OSError on failure."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size * keep_num // keep_den, 1))
+
+
+def validate_ring(snap_dir, prefix):
+    """Import every remaining (non-quarantined) checkpoint of the
+    prefix — what counts as a commit is ``scan_commits``' call (one
+    source of truth with the snapshotter/agreement); returns
+    (n_valid, [invalid path strings])."""
+    from veles_tpu.services.snapshotter import (SnapshotterBase,
+                                                scan_commits)
+    if not os.path.isdir(snap_dir):
+        return 0, ["unreadable snapshot dir %s" % snap_dir]
+    invalid, n_valid = [], 0
+    scan = scan_commits(snap_dir, prefix)
+    for name in sorted(scan):
+        path = scan[name]["path"]
+        try:
+            SnapshotterBase.import_(path)
+            n_valid += 1
+        except Exception as e:   # noqa: BLE001 — the audit itself
+            invalid.append("%s (%s)" % (path, e))
+    return n_valid, invalid
+
+
+# ===================================================================
+# the self-contained digits workload (train_chaos / pod_chaos)
+# ===================================================================
+
+_DIGITS_TEMPLATE = '''\
+"""Generated by a veles_tpu chaos harness — tiny digits MLP whose
+epoch count comes from root.__NS__ (the harness's --epochs)."""
+import numpy as np
+from sklearn.datasets import load_digits
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+
+
+def run(load, main):
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    loader = FullBatchLoader(
+        None, data=x, labels=y,
+        minibatch_size=root.__NS__.get("minibatch_size", 64),
+        class_lengths=[0, 297, 1500])
+    load(StandardWorkflow,
+         layers=[
+             {"type": "all2all_tanh", "output_sample_shape": 32,
+              "learning_rate": 0.1, "gradient_moment": 0.9},
+             {"type": "softmax", "output_sample_shape": 10,
+              "learning_rate": 0.1, "gradient_moment": 0.9},
+         ],
+         loader=loader,
+         decision_config={"max_epochs":
+                          root.__NS__.get("max_epochs", __EPOCHS__)},
+         name="__NAME__")
+    main()
+'''
+
+
+def write_digits_workflow(path, ns, name, default_epochs):
+    """Write the shared self-contained digits-MLP workload (sklearn's
+    bundled set — no dataset mount) under the given config namespace;
+    returns ``path``."""
+    text = (_DIGITS_TEMPLATE
+            .replace("__NS__", ns)
+            .replace("__NAME__", name)
+            .replace("__EPOCHS__", str(int(default_epochs))))
+    with open(path, "w") as f:
+        f.write(text)
+    return path

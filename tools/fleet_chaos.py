@@ -1,0 +1,504 @@
+#!/usr/bin/env python
+"""Chaos gate for the AUTOSCALING SERVING PLANE — the merge of the
+old `serve-fleet` harness (tools/serve_loadtest.py --fleet) and the
+serving side of `pod-chaos`: the pod master now OWNS the fleet
+(`services.podmaster.ServeFleetMaster`, docs/services.md "Autoscaling
+fleet"), so the chaos must hit the whole stack at once — router,
+replicas, agents, autoscaler and replacement policy — not each tier
+in isolation.
+
+The scenario:
+
+1. a ServeFleetMaster over ``--hosts`` per-host agents brings up the
+   declarative fleet spec (min replicas, same-seed tiny transformers
+   — greedy decode identical everywhere, so splices are checkable);
+2. a ``--clients`` streaming storm hits the master's ROUTER; the
+   overload drives the SLO shedder's measured queue-wait overshoot
+   past 1.0, and the AUTOSCALER must scale the fleet up (the
+   measured-feedback loop under test);
+3. while the fleet is RESIZING (the scale-up spawn still in flight),
+   one whole host is SIGKILLed — agent and every replica process on
+   it, machine-is-gone semantics (down marker, no agent respawn);
+4. the router must mark the dead replicas down within ONE health
+   interval, mid-stream clients must fail over with byte-identical
+   splices, and the master must replace the lost capacity on the
+   surviving host (``fleet.replace`` cause=host-death, resize
+   bucket — planned recovery, never the crash-loop budget);
+5. the storm ends; sustained idle must scale the fleet back down to
+   min — every scale-down drain of a serving replica must exit 0
+   (SIGTERM drain: lossless by construction);
+6. audits: ok+shed == clients with byte-identical results, zero
+   leaked slots/KV-blocks/threads on every survivor, no crash-loop /
+   deterministic-bug valve fired, replacement serving the exact
+   expected output.
+
+Exit 0 iff every gate passes; ``--json`` writes the report and
+``--flight-dump`` leaves the merged flight/blackbox artifacts.
+
+    python tools/fleet_chaos.py --clients 250 --json fleet-chaos.json \
+        --flight-dump fleet-chaos-dump
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import chaos_common as cc     # noqa: E402 — path set above
+from tools import serve_loadtest as lt   # noqa: E402
+
+
+def _wait(cond, what, timeout, errors, poll=0.05):
+    """Poll ``cond()`` until truthy; records a timeout error and
+    returns None otherwise."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = cond()
+        if out:
+            return out
+        time.sleep(poll)
+    errors.append("timed out waiting for %s (%.0fs)" % (what, timeout))
+    return None
+
+
+def _ready_ports(status, host=None):
+    return {rep: r["port"] for rep, r in status["replicas"].items()
+            if r["state"] == "ready" and r["port"]
+            and (host is None or r["host"] == host)}
+
+
+def _kill_host(master, victim, errors):
+    """Machine-is-gone: down marker (no agent respawn), SIGKILL the
+    agent, then every replica process recorded for that host — both
+    the master's pids and the agent's replica pidfiles (a spawn still
+    in flight has announced no pid to the master yet)."""
+    with open(master.host_down_file(victim), "w") as f:
+        f.write("fleet_chaos host kill\n")
+    pids = set()
+    st = master.status()
+    for rep, r in st["replicas"].items():
+        if r["host"] == victim and r["pid"]:
+            pids.add(r["pid"])
+    wd = master.host_workdir(victim)
+    try:
+        for name in os.listdir(wd):
+            if name.startswith("replica-") and name.endswith(".pid"):
+                try:
+                    pids.add(int(open(os.path.join(wd, name))
+                                 .read().split()[0]))
+                except (OSError, ValueError, IndexError):
+                    pass
+    except OSError:
+        pass
+    agent = master._agent_procs.get(victim)
+    if agent is None:
+        errors.append("no agent process for host %d" % victim)
+        return None
+    try:
+        agent.kill()
+    except OSError:
+        pass
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    return {"agent_pid": agent.pid, "replica_pids": sorted(pids)}
+
+
+def run_chaos(args):
+    from veles_tpu.services.podmaster import ServeFleetMaster
+    from veles_tpu.telemetry import flight
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    report = {"workdir": workdir, "clients": args.clients,
+              "hosts": args.hosts, "seed": args.seed,
+              "spec": {"min": args.fleet_min, "max": args.fleet_max,
+                       "per_host": args.per_host}}
+    errors = []
+    victim = args.hosts - 1
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    replica_argv = lt.replica_cmd(args, 0, dump_dir=args.flight_dump)
+    master = ServeFleetMaster(
+        replica_argv, n_hosts=args.hosts, workdir=workdir,
+        fleet_min=args.fleet_min, fleet_max=args.fleet_max,
+        per_host=args.per_host, env=env,
+        health_interval_ms=args.health_interval_ms,
+        # harness-tempo autoscaler: decide fast, damp generously (the
+        # PLANNED resizes under test must never hit the flap valve)
+        scale_up_overshoot=1.0, scale_idle_s=args.scale_idle_s,
+        scale_cooldown_s=args.scale_cooldown_s,
+        scale_window_s=60.0, scale_max_per_window=16,
+        autoscale_interval_s=0.2,
+        loss_window_s=3.0, loss_strikes=2,
+        min_uptime_s=5.0, seed=args.seed)
+    prompt = [int(1 + i % 7) for i in range(args.prompt_len)]
+    t_all = time.monotonic()
+    try:
+        master.start()
+        # ---- fleet up at spec minimum ------------------------------
+        t0 = time.monotonic()
+        st = _wait(lambda: (lambda s:
+                            s if s["live_replicas"] >= args.fleet_min
+                            else None)(master.status()),
+                   "fleet at min=%d" % args.fleet_min,
+                   args.timeout / 2, errors)
+        if st is None:
+            report["errors"] = errors
+            return report
+        report["phases"] = {"fleet_up_s":
+                            round(time.monotonic() - t0, 2)}
+
+        # ---- warmup every replica directly; capture the expected
+        # uninterrupted result (same seed everywhere)
+        expected = None
+        for rep, port in sorted(_ready_ports(st).items()):
+            status, out = cc.http_json(
+                "127.0.0.1", port, "/service", method="POST",
+                body=json.dumps({"input": prompt,
+                                 "generate":
+                                     {"max_new": args.max_new}}),
+                timeout=300)
+            if status != 200:
+                errors.append("warmup of replica %s failed: %s %s"
+                              % (rep, status, out))
+                report["errors"] = errors
+                return report
+            if expected is None:
+                expected = out["result"][0]
+            elif list(expected) != list(out["result"][0]):
+                report["replica_divergence"] = True
+        report["expected_len"] = len(expected or [])
+
+        # ---- the storm through the ROUTER --------------------------
+        router = master.router
+        tally, lock = {}, threading.Lock()
+        stream_errors = []
+        threads = [threading.Thread(
+            target=cc.fleet_stream_client,
+            args=(router.host, router.port, router.path, prompt,
+                  args.max_new, expected,
+                  "sess-%d" % (i % args.sessions), tally, lock),
+            kwargs={"errors": stream_errors}, daemon=True)
+            for i in range(args.clients)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+
+        def completed():
+            with lock:
+                return sum(tally.values())
+
+        # ---- the autoscaler must scale UP under the overload -------
+        scaled = _wait(
+            lambda: (lambda s: s if s["desired"] > args.fleet_min
+                     else None)(master.status()),
+            "autoscale-up under the storm", args.timeout / 4, errors)
+        report["scale_up_s"] = (round(time.monotonic() - t0, 3)
+                                if scaled is not None else None)
+        if scaled is None:
+            for th in threads:
+                th.join(timeout=60)
+            report["tally"] = tally
+            report["errors"] = errors
+            return report
+
+        # ---- SIGKILL a whole host WHILE the fleet is resizing ------
+        cc.wait_fraction(completed, args.kill_frac, args.clients,
+                         time.monotonic() + args.timeout / 4)
+        st = master.status()
+        report["resizing_at_kill"] = any(
+            r["state"] == "spawning"
+            for r in st["replicas"].values())
+        report["victim_replicas"] = sorted(
+            rep for rep, r in st["replicas"].items()
+            if r["host"] == victim)
+        kill_ts = time.monotonic()
+        killed = _kill_host(master, victim, errors)
+        report["host_killed"] = killed
+        report["sigkill_at_completed"] = completed()
+
+        # ---- storm completes across the failover -------------------
+        for th in threads:
+            th.join(timeout=300)
+        report["stuck_client_threads"] = sum(
+            1 for th in threads if th.is_alive())
+        report["phases"]["storm_s"] = round(time.monotonic() - t0, 2)
+        report["tally"] = tally
+        report["stream_errors"] = stream_errors[:20]
+
+        # ---- detection latency: first replica_down after the kill --
+        down_ts = None
+        for ev in flight.recorder.snapshot():
+            if ev["kind"] == "serve.replica_down" \
+                    and ev["ts"] >= kill_ts + cc.MONO_TO_WALL:
+                down_ts = ev["ts"]
+                break
+        report["failover_detect_s"] = (
+            round(down_ts - (kill_ts + cc.MONO_TO_WALL), 3)
+            if down_ts is not None else None)
+
+        # ---- the replacement must land on a survivor and SERVE -----
+        def replaced():
+            evs = [e for e in flight.recorder.snapshot()
+                   if e["kind"] == "fleet.replace"
+                   and e.get("cause") == "host-death"]
+            return evs or None
+        replace_evs = _wait(replaced, "fleet.replace (host-death)",
+                            args.timeout / 4, errors)
+        if replace_evs is not None:
+            report["replace_detect_s"] = round(
+                replace_evs[0]["ts"] - (kill_ts + cc.MONO_TO_WALL), 3)
+            report["replaced_reps"] = [e.get("rep")
+                                       for e in replace_evs]
+
+        def replacement_ready():
+            s = master.status()
+            fresh = {rep: r for rep, r in s["replicas"].items()
+                     if r["state"] == "ready" and r["host"] != victim
+                     and rep not in report.get("victim_replicas", ())}
+            return fresh if len(fresh) >= args.fleet_min else None
+        fresh = _wait(replacement_ready, "replacement replica ready",
+                      args.timeout / 2, errors)
+        if fresh is not None:
+            report["replacement_ready_s"] = round(
+                time.monotonic() - kill_ts, 2)
+            # the replacement serves the EXACT expected output
+            rep, r = sorted(fresh.items())[-1]
+            status, out = cc.http_json(
+                "127.0.0.1", r["port"], "/service", method="POST",
+                body=json.dumps({"input": prompt,
+                                 "generate":
+                                     {"max_new": args.max_new}}),
+                timeout=300)
+            report["replacement_serves"] = bool(
+                status == 200
+                and list(out.get("result", [[]])[0]) == list(expected))
+
+        # ---- sustained idle must scale back DOWN to min ------------
+        t0 = time.monotonic()
+        st = _wait(
+            lambda: (lambda s:
+                     s if s["desired"] == args.fleet_min
+                     and s["live_replicas"] == args.fleet_min
+                     and not any(r["state"] in ("spawning", "dying",
+                                                "draining")
+                                 for r in s["replicas"].values())
+                     else None)(master.status()),
+            "scale-down back to min", args.timeout / 2, errors)
+        report["scale_down_s"] = (round(time.monotonic() - t0, 2)
+                                  if st is not None else None)
+
+        # ---- survivor audits ---------------------------------------
+        final = master.status()
+        report["final"] = final
+        leaks = {}
+        for rep, port in sorted(_ready_ports(final).items()):
+            ok = _wait(lambda p=port: cc.http_json(
+                "127.0.0.1", p, "/service/health")[1]
+                .get("queued", 1) == 0 or None,
+                "replica %s idle" % rep, 60, errors)
+            if ok is None:
+                leaks[rep] = {"error": "never idled"}
+                continue
+            _, leaks[rep] = cc.http_json("127.0.0.1", port,
+                                         "/service/leaks")
+        report["survivor_leaks"] = leaks
+        report["router_metrics"] = master.router.metrics()
+        report["history"] = master.history
+        report["drained"] = master.drained
+        kinds = [e["kind"] for e in flight.recorder.snapshot()]
+        report["flight_kinds"] = {
+            k: kinds.count(k)
+            for k in ("fleet.scale", "fleet.replace", "fleet.drain",
+                      "fleet.drained", "serve.replica_up",
+                      "serve.replica_down", "serve.failover")}
+        if args.flight_dump:
+            report["flight_dump"] = flight.dump(
+                args.flight_dump, reason="fleet-chaos")
+    finally:
+        master.stop()
+        master.wait(120)
+        report["wall_s"] = round(time.monotonic() - t_all, 2)
+    report["errors"] = errors
+    return report
+
+
+def gates(report, health_interval_ms=100.0):
+    """Pass/fail verdicts (CI `fleet-chaos`); failure strings, empty
+    = pass."""
+    fails = []
+    fails.extend(report.get("errors") or [])
+    tally = report.get("tally", {})
+    # zero lost/corrupt requests: ok+shed==clients, splices
+    # byte-identical (any mismatch shows up as its own outcome)
+    cc.tally_gate(tally, report.get("clients", 0), fails)
+    if not tally.get("ok"):
+        fails.append("no request completed (tally=%r)" % (tally,))
+    if report.get("stuck_client_threads"):
+        fails.append("stuck client threads: %d"
+                     % report["stuck_client_threads"])
+    if report.get("replica_divergence"):
+        fails.append("replicas disagreed on the warmup output")
+    # the autoscaler closed the loop, and the kill landed mid-resize
+    if report.get("scale_up_s") is None:
+        fails.append("the storm never drove an autoscale-up")
+    if not report.get("resizing_at_kill"):
+        fails.append("the host kill did not land while the fleet was "
+                     "resizing (no spawn in flight)")
+    # detection <= one health interval (+1s slack for ring scan and
+    # scheduler noise)
+    det = report.get("failover_detect_s")
+    if det is None:
+        fails.append("host SIGKILL never produced a "
+                     "serve.replica_down")
+    elif det > health_interval_ms / 1e3 + 1.0:
+        fails.append("failover took %.3f s (> one %.0f ms health "
+                     "interval + slack)" % (det, health_interval_ms))
+    # replacement: detected fast, landed on a survivor, serves the
+    # exact expected bytes ("registered <= one health interval +
+    # spawn": replace_detect_s is the detection half,
+    # replacement_ready_s includes the spawn)
+    rdet = report.get("replace_detect_s")
+    if rdet is None:
+        fails.append("no fleet.replace (host-death) was recorded")
+    elif rdet > health_interval_ms / 1e3 + 2.0:
+        fails.append("replacement decision took %.3f s (> one health "
+                     "interval + slack)" % rdet)
+    if report.get("replacement_ready_s") is None:
+        fails.append("no replacement replica became ready on a "
+                     "survivor")
+    if not report.get("replacement_serves"):
+        fails.append("the replacement replica did not serve the "
+                     "expected output")
+    # lossless scale-down: back at min, every drained SERVING replica
+    # exited 0 through the SIGTERM drain
+    if report.get("scale_down_s") is None:
+        fails.append("the fleet never scaled back down to min on "
+                     "sustained idle")
+    drained = report.get("drained") or []
+    ready_drains = [d for d in drained if d.get("was_ready")]
+    if not ready_drains:
+        fails.append("no serving replica was ever drained (scale-"
+                     "down/shutdown never exercised the SIGTERM "
+                     "path)")
+    for d in ready_drains:
+        if d.get("rc") != 0 or d.get("kind") != "done":
+            fails.append("drained replica %s exited %r (%s) — drain "
+                         "was not lossless"
+                         % (d.get("rep"), d.get("rc"), d.get("kind")))
+    # valves: planned resizes must never consume the crash budget
+    final = report.get("final") or {}
+    if final.get("hold_replace"):
+        fails.append("a valve held replacements: %r"
+                     % final["hold_replace"])
+    for h in report.get("history") or []:
+        if h.get("action") == "replace" \
+                and h.get("verdict") not in (None, "respawn"):
+            fails.append("replacement valve fired: %r" % (h,))
+        if h.get("action") == "replace" \
+                and h.get("cause") == "host-death" \
+                and h.get("counted"):
+            fails.append("a host-death replacement consumed the "
+                         "crash-loop budget: %r" % (h,))
+    # survivors leak-free
+    for rep, leaks in (report.get("survivor_leaks") or {}).items():
+        if leaks.get("error"):
+            fails.append("survivor %s: %s" % (rep, leaks["error"]))
+            continue
+        cc.leak_gate(leaks, fails, label="survivor %s" % rep)
+    kinds = report.get("flight_kinds", {})
+    for kind in ("fleet.scale", "fleet.replace", "fleet.drain",
+                 "fleet.drained", "serve.replica_down",
+                 "serve.failover"):
+        if not kinds.get(kind):
+            fails.append("missing flight event: %s" % kind)
+    return fails
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="chaos gate for the autoscaling serving plane "
+        "(docs/services.md 'Autoscaling fleet')")
+    ap.add_argument("--clients", type=int, default=250)
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--fleet-min", type=int, default=2)
+    ap.add_argument("--fleet-max", type=int, default=4)
+    ap.add_argument("--per-host", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--paged-block", type=int, default=4)
+    ap.add_argument("--pool-tokens", type=int, default=512)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=5)
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="replica queue-wait SLO — the storm must "
+                    "overshoot it to trip both the shedder and the "
+                    "autoscaler")
+    ap.add_argument("--tick-delay-ms", type=float, default=20.0,
+                    help="per-tick decode delay on replicas "
+                    "(stretches streams so the chaos lands "
+                    "mid-flight)")
+    ap.add_argument("--sessions", type=int, default=16)
+    ap.add_argument("--health-interval-ms", type=float, default=100.0)
+    ap.add_argument("--kill-frac", type=float, default=0.1,
+                    help="completed-client fraction at which the "
+                    "victim host is SIGKILLed (after the scale-up "
+                    "fired)")
+    ap.add_argument("--scale-idle-s", type=float, default=3.0)
+    ap.add_argument("--scale-cooldown-s", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--workdir", default=None,
+                    help="working directory (default: fresh tempdir; "
+                    "kept on failure, removed on success unless "
+                    "given)")
+    ap.add_argument("--json", default=None, metavar="FILE")
+    ap.add_argument("--flight-dump", default=None, metavar="DIR",
+                    help="merged flight/blackbox artifacts (CI "
+                    "upload)")
+    args = ap.parse_args(argv)
+
+    report = run_chaos(args)
+    fails = gates(report,
+                  health_interval_ms=args.health_interval_ms)
+    report["gates_failed"] = fails
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print("[fleet-chaos] report -> %s" % args.json)
+    print(json.dumps({k: report.get(k) for k in
+                      ("tally", "scale_up_s", "resizing_at_kill",
+                       "failover_detect_s", "replace_detect_s",
+                       "replacement_ready_s", "replacement_serves",
+                       "scale_down_s", "wall_s")}, default=str))
+    if fails:
+        print("[fleet-chaos] GATES FAILED:", flush=True)
+        for f in fails:
+            print("  - %s" % f)
+        print("[fleet-chaos] workdir kept: %s"
+              % report.get("workdir"))
+        return 1
+    print("[fleet-chaos] ALL GATES PASSED: storm of %d clients "
+          "(%d ok / %d shed), autoscale-up in %.1fs, host SIGKILL "
+          "mid-resize detected in %.3fs, replacement serving in "
+          "%.1fs, scale-down drained lossless back to min"
+          % (report["clients"], report["tally"].get("ok", 0),
+             report["tally"].get("shed", 0), report["scale_up_s"],
+             report["failover_detect_s"],
+             report["replacement_ready_s"]))
+    if args.workdir is None:
+        shutil.rmtree(report["workdir"], ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

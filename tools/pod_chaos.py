@@ -73,41 +73,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-#: the self-contained default workload: a tiny digits MLP trained
-#: data-parallel over the cross-process mesh (written into the workdir)
-_DIGITS_WORKFLOW = '''\
-"""Generated by tools/pod_chaos.py — tiny digits MLP trained over the
-cross-process data mesh; epochs come from root.chaos_pod."""
-import numpy as np
-from sklearn.datasets import load_digits
-
-from veles_tpu.config import root
-from veles_tpu.loader.fullbatch import FullBatchLoader
-from veles_tpu.models.standard_workflow import StandardWorkflow
-
-
-def run(load, main):
-    d = load_digits()
-    x = (d.data / 16.0).astype(np.float32)
-    y = d.target.astype(np.int32)
-    loader = FullBatchLoader(
-        None, data=x, labels=y,
-        minibatch_size=root.chaos_pod.get("minibatch_size", 64),
-        class_lengths=[0, 297, 1500])
-    load(StandardWorkflow,
-         layers=[
-             {"type": "all2all_tanh", "output_sample_shape": 32,
-              "learning_rate": 0.1, "gradient_moment": 0.9},
-             {"type": "softmax", "output_sample_shape": 10,
-              "learning_rate": 0.1, "gradient_moment": 0.9},
-         ],
-         loader=loader,
-         decision_config={"max_epochs":
-                          root.chaos_pod.get("max_epochs", 10)},
-         name="chaos-pod")
-    main()
-'''
-
+from tools import chaos_common as cc   # noqa: E402 — path set above
 
 def build_argv(workflow, config, seed, extra_config=(), mesh="data=-1"):
     """The worker command — per-host snapshot dirs / per_host mode /
@@ -129,17 +95,8 @@ def build_argv(workflow, config, seed, extra_config=(), mesh="data=-1"):
     return argv
 
 
-def _current_target(snap_dir, prefix):
-    """(realpath, mtime) of the host dir's ``_current`` target, or
-    (None, None)."""
-    cur = os.path.join(snap_dir, "%s_current" % prefix)
-    try:
-        real = os.path.realpath(cur)
-        if os.path.islink(cur) and os.path.exists(real):
-            return real, os.path.getmtime(real)
-    except OSError:
-        pass
-    return None, None
+#: shared ``_current`` resolution (chaos_common)
+_current_target = cc.current_target
 
 
 class _DriverBase(threading.Thread):
@@ -245,9 +202,7 @@ class ChaosDriver(_DriverBase):
     def _tear(self, host, target):
         """Truncate ``target`` in place; records it as the torn commit."""
         try:
-            size = os.path.getsize(target)
-            with open(target, "r+b") as f:
-                f.truncate(max(size * 3 // 5, 1))
+            cc.truncate_commit(target)
         except OSError as e:
             self.errors.append("torn-commit injection failed: %s" % e)
             return False
@@ -536,25 +491,9 @@ class HostLossDriver(_DriverBase):
                    replicated=rec.get("replicated"))
 
 
-def _validate_ring(snap_dir, prefix):
-    """Import every remaining (non-quarantined) checkpoint — what
-    counts as a commit is ``scan_commits``' call (one source of truth
-    with the snapshotter/agreement); returns (n_valid, [invalid
-    paths])."""
-    from veles_tpu.services.snapshotter import (SnapshotterBase,
-                                                scan_commits)
-    if not os.path.isdir(snap_dir):
-        return 0, ["unreadable snapshot dir %s" % snap_dir]
-    invalid, n_valid = [], 0
-    scan = scan_commits(snap_dir, prefix)
-    for name in sorted(scan):
-        path = scan[name]["path"]
-        try:
-            SnapshotterBase.import_(path)
-            n_valid += 1
-        except Exception as e:   # noqa: BLE001 — the audit itself
-            invalid.append("%s (%s)" % (path, e))
-    return n_valid, invalid
+#: shared ring audit (chaos_common — scan_commits is the one source
+#: of truth for what counts as a commit)
+_validate_ring = cc.validate_ring
 
 
 def _run_pod(argv, workdir, prefix, args, host_extras=None,
@@ -584,9 +523,9 @@ def _setup_workload(args, tmp_prefix):
     workflow, config, prefix = args.workflow, args.config, args.prefix
     extra = list(args.config_list)
     if workflow is None:
-        workflow = os.path.join(workdir, "pod_workflow.py")
-        with open(workflow, "w") as f:
-            f.write(_DIGITS_WORKFLOW)
+        workflow = cc.write_digits_workflow(
+            os.path.join(workdir, "pod_workflow.py"),
+            ns="chaos_pod", name="chaos-pod", default_epochs=10)
         extra += ["root.chaos_pod.max_epochs=%d" % args.epochs]
         prefix = "chaos-pod"
     return workdir, workflow, config, prefix, extra

@@ -60,6 +60,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from tools import chaos_common as cc   # noqa: E402 — path set above
+
 
 def build_api(slots=4, paged_block=0, pool_tokens=None, slo_ms=0,
               deadline_ms=0, max_len=24, vocab=11, seed=7,
@@ -394,13 +396,7 @@ def gates(report, expect_shed=True, require_slo=False):
             "p99=%s ms over %d completed)"
             % (bp.get("p99_queue_wait_ms"), bp.get("completed", 0)))
     leaks = report.get("leaks", {})
-    for key in ("ingress", "records", "open_requests",
-                "pending_cancels", "slots_busy"):
-        if leaks.get(key, 0) != 0:
-            fails.append("leak: %s=%r" % (key, leaks.get(key)))
-    if leaks.get("kv_blocks_leaked", 0) != 0:
-        fails.append("leak: kv_blocks_leaked=%r"
-                     % leaks["kv_blocks_leaked"])
+    cc.leak_gate(leaks, fails)
     if not leaks.get("engine_thread_alive", False):
         fails.append("engine thread died")
     if report.get("stuck_client_threads"):
@@ -426,7 +422,8 @@ def replica_main(args):
     """Subprocess entry for one fleet replica: build the tiny model,
     serve it, print READY with the bound port, drain on SIGTERM (exit
     0), die honestly on SIGKILL."""
-    from veles_tpu.services.restful import install_sigterm_drain
+    from veles_tpu.services.restful import (announce_ready,
+                                            install_sigterm_drain)
     from veles_tpu.telemetry import flight
 
     api = build_api(slots=args.slots, paged_block=args.paged_block,
@@ -454,129 +451,56 @@ def replica_main(args):
                                         reason="replica-drain"))
         if args.dump_dir else None)
     # READY handshake: the parent reads the bound port off stdout
-    print("REPLICA_READY port=%d pid=%d" % (api.port, os.getpid()),
-          flush=True)
+    # (the shared handshake every fleet spawner understands —
+    # tools/chaos_common.spawn_ready and the pod agent)
+    announce_ready(api, force=True)
     while True:
         time.sleep(3600)
 
 
-def _spawn_replicas(n, args, dump_dir=None):
-    """Start n replica subprocesses; returns [(proc, port, url)].
-    Replicas inherit the environment (JAX_PLATFORMS etc.).  EVERY
-    replica builds from the SAME seed: identical weights are what make
-    greedy decode — and therefore mid-stream failover splices —
+def replica_cmd(args, i, dump_dir=None):
+    """The replica subprocess command line for fleet chaos — EVERY
+    replica builds from the SAME seed: identical weights are what
+    make greedy decode — and therefore mid-stream failover splices —
     byte-identical across the fleet."""
-    procs = []
+    cmd = [sys.executable, os.path.abspath(__file__), "--replica",
+           "--slots", str(args.slots),
+           "--paged-block", str(args.paged_block),
+           "--slo-ms", str(args.slo_ms),
+           "--seed", str(args.seed),
+           "--tick-delay-ms",
+           str(getattr(args, "tick_delay_ms", 0))]
+    if args.pool_tokens:
+        cmd += ["--pool-tokens", str(args.pool_tokens)]
+    if dump_dir:
+        cmd += ["--dump-dir", dump_dir]
+    return cmd
+
+
+def _spawn_replicas(n, args, dump_dir=None):
+    """Start n replica subprocesses via the shared READY handshake
+    (chaos_common.spawn_ready — select-bounded, startup-flake
+    retried); returns [(proc, port, url)]."""
+    cmds, envs = [], []
     for i in range(n):
-        cmd = [sys.executable, os.path.abspath(__file__), "--replica",
-               "--slots", str(args.slots),
-               "--paged-block", str(args.paged_block),
-               "--slo-ms", str(args.slo_ms),
-               "--seed", str(args.seed),
-               "--tick-delay-ms",
-               str(getattr(args, "tick_delay_ms", 0))]
-        if args.pool_tokens:
-            cmd += ["--pool-tokens", str(args.pool_tokens)]
-        if dump_dir:
-            cmd += ["--dump-dir", dump_dir]
+        cmds.append(replica_cmd(args, i, dump_dir=dump_dir))
         env = dict(os.environ)
         env["VELES_TPU_PROCESS_ID"] = str(i + 1)   # distinct blackbox ids
-        procs.append([subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=env), None, None])
-    import select
-    deadline = time.monotonic() + 300
-    for rec in procs:
-        proc = rec[0]
-        while rec[1] is None:
-            left = deadline - time.monotonic()
-            if left <= 0:
-                raise RuntimeError("replica startup timed out")
-            # select before readline: a silently wedged replica (alive
-            # but never printing) must hit the deadline, not block the
-            # harness on the pipe forever
-            ready, _, _ = select.select([proc.stdout], [], [],
-                                        min(1.0, left))
-            if not ready:
-                continue
-            line = proc.stdout.readline()
-            if not line:
-                raise RuntimeError("replica died during startup "
-                                   "(exit %r)" % proc.poll())
-            if line.startswith("REPLICA_READY"):
-                port = int(line.split("port=")[1].split()[0])
-                rec[1] = port
-                rec[2] = "http://127.0.0.1:%d/service" % port
-    return [tuple(rec) for rec in procs]
+        envs.append(env)
+    return cc.spawn_ready(cmds, timeout=300.0, envs=envs,
+                          log_dir=dump_dir)
 
 
 def _fleet_client(router, prompt, max_new, expected, session, tally,
                   lock, errors=None):
-    """One fleet storm client: stream through the ROUTER and verify
-    the full concatenated result — chunk lines must splice to exactly
-    the done line's result, and that result must equal the expected
-    uninterrupted output (failover must be invisible)."""
-    body = json.dumps({"input": prompt, "session": session,
-                       "generate": {"max_new": max_new,
-                                    "stream": True}})
-    outcome = "error"
-    try:
-        conn = http.client.HTTPConnection(router.host, router.port,
-                                          timeout=180)
-        conn.request("POST", router.path, body,
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        if resp.status == 503:
-            resp.read()
-            outcome = "shed"
-        elif resp.status != 200:
-            resp.read()
-            outcome = "http_%d" % resp.status
-        else:
-            got, result, done = list(prompt), None, False
-            while True:
-                raw = resp.fp.readline()
-                if not raw:
-                    break
-                msg = json.loads(raw)
-                if "tokens" in msg:
-                    got.extend(msg["tokens"])
-                elif msg.get("done"):
-                    result, done = msg["result"], True
-                    break
-                elif "error" in msg:
-                    outcome = "stream_error"
-                    if errors is not None:
-                        with lock:
-                            errors.append(str(msg["error"])[:200])
-                    return
-            if not done:
-                outcome = "truncated"
-            elif list(result) != list(got):
-                outcome = "splice_mismatch"
-            elif expected is not None \
-                    and list(result) != list(expected):
-                outcome = "bad_result"
-            else:
-                outcome = "ok"
-        conn.close()
-    except Exception:  # noqa: BLE001 — chaos clients absorb anything
-        outcome = "error"
-    finally:
-        with lock:
-            tally[outcome] = tally.get(outcome, 0) + 1
+    """One fleet storm client (shared verification core:
+    chaos_common.fleet_stream_client)."""
+    cc.fleet_stream_client(router.host, router.port, router.path,
+                           prompt, max_new, expected, session, tally,
+                           lock, errors=errors)
 
 
-def _http_json(host, port, path, method="GET", body=None, timeout=30):
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
-    try:
-        conn.request(method, path, body,
-                     {"Content-Type": "application/json"}
-                     if body else {})
-        resp = conn.getresponse()
-        return resp.status, json.loads(resp.read() or b"{}")
-    finally:
-        conn.close()
+_http_json = cc.http_json
 
 
 def _wait_replica_idle(port, timeout=120.0):
@@ -666,16 +590,12 @@ def run_fleet(replicas=3, clients=150, max_new=8, prompt_len=5,
         kill_proc, kill_port, _ = fleet[0]
         drain_proc, drain_port, _ = fleet[1]
         deadline = time.monotonic() + 300
-        while completed() < kill_frac * clients \
-                and time.monotonic() < deadline:
-            time.sleep(0.005)
+        cc.wait_fraction(completed, kill_frac, clients, deadline)
         kill_ts = time.monotonic()
         kill_proc.kill()                          # SIGKILL: no goodbye
         report["sigkill_replica_port"] = kill_port
         report["sigkill_at_completed"] = completed()
-        while completed() < drain_frac * clients \
-                and time.monotonic() < deadline:
-            time.sleep(0.005)
+        cc.wait_fraction(completed, drain_frac, clients, deadline)
         drain_proc.send_signal(signal.SIGTERM)    # graceful drain
         report["sigterm_replica_port"] = drain_port
         report["sigterm_at_completed"] = completed()
@@ -740,10 +660,8 @@ def run_fleet(replicas=3, clients=150, max_new=8, prompt_len=5,
     return report
 
 
-#: flight events stamp wall time; the harness measures monotonic —
-#: one offset sample converts between them (drift over a storm is
-#: far below the gate's slack)
-_MONO_TO_WALL = time.time() - time.monotonic()
+#: shared wall/monotonic offset (chaos_common)
+_MONO_TO_WALL = cc.MONO_TO_WALL
 
 
 def fleet_gates(report, health_interval_ms=100):
@@ -751,18 +669,11 @@ def fleet_gates(report, health_interval_ms=100):
     Returns failure strings (empty = pass)."""
     fails = []
     tally = report.get("tally", {})
-    # exhaustive accounting: EVERY client must end ok or shed — any
-    # other outcome (truncated, splice_mismatch, bad_result, error,
-    # stream_error, http_4xx/5xx, ...) is a lost/corrupt request, and
-    # a missing outcome is a client that never reported
-    unexpected = {k: v for k, v in tally.items()
-                  if k not in ("ok", "shed") and v}
-    if unexpected:
-        fails.append("lost/corrupt requests: %r" % (unexpected,))
-    total = sum(tally.values())
-    if total != report.get("clients", total):
-        fails.append("client accounting: %d outcomes for %d clients"
-                     % (total, report.get("clients")))
+    # exhaustive accounting (chaos_common.tally_gate): EVERY client
+    # must end ok or shed — anything else is a lost/corrupt request,
+    # and a missing outcome is a client that never reported
+    cc.tally_gate(tally, report.get("clients", sum(tally.values())),
+                  fails)
     if not tally.get("ok"):
         fails.append("no request completed (tally=%r)" % (tally,))
     if report.get("stuck_client_threads"):
@@ -786,14 +697,7 @@ def fleet_gates(report, health_interval_ms=100):
         if leaks.get("error"):
             fails.append("survivor %s: %s" % (port, leaks["error"]))
             continue
-        for key in ("ingress", "records", "open_requests",
-                    "pending_cancels", "slots_busy"):
-            if leaks.get(key, 0) != 0:
-                fails.append("survivor %s leak: %s=%r"
-                             % (port, key, leaks[key]))
-        if leaks.get("kv_blocks_leaked", 0) != 0:
-            fails.append("survivor %s leak: kv_blocks_leaked=%r"
-                         % (port, leaks["kv_blocks_leaked"]))
+        cc.leak_gate(leaks, fails, label="survivor %s" % port)
     counters = report.get("router_metrics", {}).get("counters", {})
     if not counters.get("failovers"):
         fails.append("router recorded no failover")

@@ -56,40 +56,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-#: the self-contained default workload (written into the workdir)
-_DIGITS_WORKFLOW = '''\
-"""Generated by tools/train_chaos.py — tiny digits MLP whose epoch
-count comes from root.chaos_train (the harness's --epochs)."""
-import numpy as np
-from sklearn.datasets import load_digits
-
-from veles_tpu.config import root
-from veles_tpu.loader.fullbatch import FullBatchLoader
-from veles_tpu.models.standard_workflow import StandardWorkflow
-
-
-def run(load, main):
-    d = load_digits()
-    x = (d.data / 16.0).astype(np.float32)
-    y = d.target.astype(np.int32)
-    loader = FullBatchLoader(
-        None, data=x, labels=y,
-        minibatch_size=root.chaos_train.get("minibatch_size", 64),
-        class_lengths=[0, 297, 1500])
-    load(StandardWorkflow,
-         layers=[
-             {"type": "all2all_tanh", "output_sample_shape": 32,
-              "learning_rate": 0.1, "gradient_moment": 0.9},
-             {"type": "softmax", "output_sample_shape": 10,
-              "learning_rate": 0.1, "gradient_moment": 0.9},
-         ],
-         loader=loader,
-         decision_config={"max_epochs":
-                          root.chaos_train.get("max_epochs", 12)},
-         name="chaos-train")
-    main()
-'''
-
+from tools import chaos_common as cc   # noqa: E402 — path set above
 
 def build_argv(workflow, config, snap_dir, seed, extra_config=(),
                chaos_config=()):
@@ -104,20 +71,8 @@ def build_argv(workflow, config, snap_dir, seed, extra_config=(),
     return argv
 
 
-def _current_path(snap_dir, prefix):
-    return os.path.join(snap_dir, "%s_current" % prefix)
-
-
-def _current_target(snap_dir, prefix):
-    """(realpath, mtime) of the _current target, or (None, None)."""
-    cur = _current_path(snap_dir, prefix)
-    try:
-        real = os.path.realpath(cur)
-        if os.path.islink(cur) and os.path.exists(real):
-            return real, os.path.getmtime(real)
-    except OSError:
-        pass
-    return None, None
+#: shared ``_current`` resolution (chaos_common)
+_current_target = cc.current_target
 
 
 class Killer(threading.Thread):
@@ -195,9 +150,7 @@ class Killer(threading.Thread):
             if target is None:
                 return
             try:
-                size = os.path.getsize(target)
-                with open(target, "r+b") as f:
-                    f.truncate(max(size * 3 // 5, 1))
+                cc.truncate_commit(target)
             except OSError as e:
                 self.errors.append("torn-commit injection failed: %s"
                                    % e)
@@ -213,25 +166,9 @@ class Killer(threading.Thread):
                   % os.path.basename(target), flush=True)
 
 
-def _validate_ring(snap_dir, prefix):
-    """Import every remaining (non-quarantined) checkpoint of the
-    prefix; returns (n_valid, [invalid paths])."""
-    from veles_tpu.services.snapshotter import (MANIFEST_SUFFIX,
-                                                SnapshotterBase)
-    invalid, n_valid = [], 0
-    for name in sorted(os.listdir(snap_dir)):
-        if not name.startswith(prefix + "_") \
-                or name.endswith("_current") \
-                or name.endswith(MANIFEST_SUFFIX) \
-                or name.endswith(".corrupt") or ".tmp" in name:
-            continue
-        path = os.path.join(snap_dir, name)
-        try:
-            SnapshotterBase.import_(path)
-            n_valid += 1
-        except Exception as e:   # noqa: BLE001 — the audit itself
-            invalid.append("%s (%s)" % (path, e))
-    return n_valid, invalid
+#: shared ring audit (chaos_common — scan_commits is the one source
+#: of truth for what counts as a commit, same as the agreement's)
+_validate_ring = cc.validate_ring
 
 
 def run_chaos(args):
@@ -250,9 +187,9 @@ def run_chaos(args):
     workflow, config, prefix = args.workflow, args.config, args.prefix
     extra = list(args.config_list)
     if workflow is None:
-        workflow = os.path.join(workdir, "chaos_workflow.py")
-        with open(workflow, "w") as f:
-            f.write(_DIGITS_WORKFLOW)
+        workflow = cc.write_digits_workflow(
+            os.path.join(workdir, "chaos_workflow.py"),
+            ns="chaos_train", name="chaos-train", default_epochs=12)
         extra += ["root.chaos_train.max_epochs=%d" % args.epochs]
         prefix = "chaos-train"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")

@@ -1295,8 +1295,14 @@ class Main(object):
         # in-flight request, exit 0 — the same lifecycle a fleet
         # replica walks (docs/services.md "Fleet serving"), instead of
         # the training path's crashdump-and-die
-        from veles_tpu.services.restful import install_sigterm_drain
+        from veles_tpu.services.restful import (announce_ready,
+                                                install_sigterm_drain)
         install_sigterm_drain(api)
+        # under a pod agent (VELES_TPU_REPLICA_ANNOUNCE set) this
+        # prints the fleet READY handshake so the agent can register
+        # the bound port with the router — any --serve command is a
+        # fleet replica (docs/services.md "Autoscaling fleet")
+        announce_ready(api)
         print("REST serving on port %d; Ctrl-C to stop, SIGTERM to "
               "drain" % api.port)
         try:

@@ -273,6 +273,42 @@ root.common.update({
             "affinity": "session",
             "stream_read_timeout_ms": 30000,
             "request_timeout_ms": 300000,
+            # --- the autoscaling fleet spec (services.podmaster
+            # ServeFleetMaster, `veles-tpu-pod --serve`, docs/
+            # services.md "Autoscaling fleet"): the pod master owns
+            # the serving replicas declaratively — min..max engine
+            # replicas fleet-wide, at most per_host on any one host;
+            # agents spawn/drain them and the master auto-registers/
+            # deregisters each with its FleetRouter.
+            "min": 1,
+            "max": 8,
+            "per_host": 2,
+            # --- the autoscaler loop: scale UP when any replica's
+            # measured queue-wait overshoot (SloShedder.overshoot,
+            # read off /health) reaches scale_up_overshoot or fresh
+            # serve.shed rejections arrive; scale DOWN after
+            # scale_idle_s of fleet-wide idle (always through the
+            # SIGTERM drain, so scale-down is lossless by
+            # construction).  scale_cooldown_s spaces consecutive
+            # decisions; on top of that every decision is budgeted in
+            # its own PodValves bucket (scale_max_per_window per
+            # scale_window_s — flap damping: a scale oscillation can
+            # never consume the crash-loop budget).
+            "scale_up_overshoot": 1.0,
+            "scale_idle_s": 30.0,
+            "scale_cooldown_s": 10.0,
+            "scale_window_s": 120.0,
+            "scale_max_per_window": 4,
+            # a spawned replica must announce READY (bound port)
+            # within this budget or the spawn is classified a crash
+            # and replaced — a wedged replica must not hold a fleet
+            # slot forever
+            "ready_timeout_ms": 180000,
+            # a replica must stay up this long (or serve a request)
+            # before its next crash counts as "progressed" for the
+            # deterministic-bug valve — mirrors the training
+            # supervisor's checkpoint-progress reset
+            "min_uptime_s": 30.0,
         },
     },
 })

@@ -249,11 +249,24 @@ class SloShedder(object):
                         max(1.0, self._last_measure_ms / self.slo_ms))
         return base * overshoot
 
+    def overshoot(self):
+        """The last measured queue wait as a fraction of the SLO (1.0
+        = exactly at it, 0.0 while disabled or idle) — the serving
+        fleet's scale-up signal: the autoscaler reads it off every
+        replica's ``/health`` (through :meth:`status`) and adds
+        capacity when the measured wait sits past the SLO instead of
+        letting the shed valve turn traffic away forever."""
+        if not self.enabled:
+            return 0.0
+        return self._last_measure_ms / self.slo_ms
+
     def status(self):
         return {"enabled": self.enabled,
                 "state": ("open" if self._open else "closed")
                 if self.enabled else "disabled",
                 "slo_ms": self.slo_ms,
+                "last_measure_ms": round(self._last_measure_ms, 3),
+                "overshoot": round(self.overshoot(), 4),
                 "shed_total": self.shed_total,
                 "open_total": self.open_total}
 

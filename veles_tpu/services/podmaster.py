@@ -214,7 +214,8 @@ class PodValves(object):
     deterministic-bug signature counter."""
 
     def __init__(self, max_restarts, window_seconds,
-                 deterministic_limit):
+                 deterministic_limit, scale_max_per_window=4,
+                 scale_window_seconds=120.0):
         self.max_restarts = int(max_restarts)
         self.window_seconds = float(window_seconds)
         self.deterministic_limit = int(deterministic_limit)
@@ -224,6 +225,14 @@ class PodValves(object):
         #: degraded/re-expand restarts — their own bucket, never the
         #: crash-loop window
         self.resize_restarts = 0
+        #: serving-fleet autoscale decisions — a THIRD bucket (see
+        #: :meth:`admit_scale`): bounded per window for flap damping,
+        #: and like resizes never the crash-loop window
+        self.scale_max_per_window = int(scale_max_per_window)
+        self.scale_window_seconds = float(scale_window_seconds)
+        self.scale_events = 0
+        self.scale_damped = 0
+        self._scale_window = []
 
     def admit(self, now, signature=None, progressed=False,
               counted=True, resize=False):
@@ -265,6 +274,189 @@ class PodValves(object):
         if len(self._window) > self.max_restarts:
             return "crash-loop"
         return "respawn"
+
+    def admit_scale(self, now):
+        """Decide one serving-fleet AUTOSCALE step: ``"scale"`` or
+        ``"damped"``.  Scale decisions live in their own budget
+        (``scale_max_per_window`` per ``scale_window_seconds``) — flap
+        damping: an oscillating load signal is throttled here, and a
+        scale storm can never consume the crash-loop window or feed
+        the deterministic-bug counter (those guard replica CRASHES,
+        which are a different failure)."""
+        self._scale_window = [t for t in self._scale_window
+                              if now - t < self.scale_window_seconds]
+        if len(self._scale_window) >= self.scale_max_per_window:
+            self.scale_damped += 1
+            return "damped"
+        self._scale_window.append(now)
+        self.scale_events += 1
+        return "scale"
+
+
+# =====================================================================
+# the serving-fleet policy core (pure — unit-tested in
+# tests/test_fleet.py without sockets or subprocesses)
+# =====================================================================
+
+class FleetAutoscaler(object):
+    """The closed-loop capacity controller for the serving fleet.
+
+    The scale-UP signal is the one the platform already measures: the
+    SLO shedder's queue-wait overshoot (``SloShedder.overshoot``, read
+    off every replica's ``/health`` by the router's probes) and fresh
+    ``serve.shed`` rejections — both mean the fleet is turning real
+    traffic away, so capacity should follow the load instead
+    (PAPERS.md's TVM/CLBlast thesis: measured feedback drives
+    configuration).  Scale-DOWN needs ``idle_s`` of sustained
+    fleet-wide idle (no queued/in-flight work, zero overshoot) — and
+    the caller always routes it through the SIGTERM drain, so
+    shrinking never loses a request.  ``cooldown_s`` spaces
+    consecutive decisions; the caller additionally budgets every
+    decision through :meth:`PodValves.admit_scale` (flap damping).
+
+    Pure: :meth:`decide` takes the clock and the signals as arguments
+    and returns ``(delta, reason)`` with ``delta`` in ``(+1, -1, 0)``
+    — one replica per decision, because each decision's effect has to
+    be measured before the next (the controller is closed-loop, not
+    predictive)."""
+
+    def __init__(self, up_overshoot=1.0, idle_s=30.0, cooldown_s=10.0):
+        self.up_overshoot = float(up_overshoot)
+        self.idle_s = float(idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self._idle_since = None
+        self._last_scale_ts = None
+        self._last_shed_total = None
+
+    def decide(self, now, desired, minimum, maximum, signals):
+        """One control step.  ``signals``: ``{"overshoot": float,
+        "shed_total": int (monotonic), "busy": bool}`` — the shape
+        :meth:`FleetRouter.fleet_signals` returns."""
+        overshoot = float(signals.get("overshoot") or 0.0)
+        shed_total = int(signals.get("shed_total") or 0)
+        busy = bool(signals.get("busy"))
+        if self._last_shed_total is None:
+            self._last_shed_total = shed_total
+        shed_delta = max(shed_total - self._last_shed_total, 0)
+        self._last_shed_total = shed_total
+        overloaded = (overshoot >= self.up_overshoot > 0) \
+            or shed_delta > 0
+        # idle tracking runs on EVERY step (including cooldown ones):
+        # the idle clock must not reset just because a decision was
+        # recently made
+        if overloaded or busy or overshoot > 0:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = now
+        if self._last_scale_ts is not None \
+                and now - self._last_scale_ts < self.cooldown_s:
+            return 0, "cooldown"
+        if overloaded:
+            if desired >= maximum:
+                return 0, "overloaded at max=%d" % maximum
+            self._last_scale_ts = now
+            return (+1, "overshoot=%.2f shed_delta=%d"
+                    % (overshoot, shed_delta))
+        if self._idle_since is not None \
+                and now - self._idle_since >= self.idle_s:
+            if desired <= minimum:
+                return 0, "idle at min=%d" % minimum
+            self._last_scale_ts = now
+            return -1, "idle %.0fs" % (now - self._idle_since)
+        return 0, None
+
+
+def plan_fleet(desired, live_hosts, per_host, placements,
+               draining=(), drainable=None):
+    """Reconcile the declarative fleet spec against what is live:
+    returns ``(spawn_hosts, drain_reps)``.
+
+    :param desired: target replica count (already min/max-clamped).
+    :param live_hosts: hosts with a LIVE agent (sorted ids) — the
+        only legal spawn targets; a lost host's replicas simply stop
+        appearing in ``placements`` and this planner re-places them
+        on the survivors (replacement-on-host-death is reconciliation,
+        not a special case).
+    :param per_host: max replicas on any one host (the fleet spec).
+    :param placements: ``{rep_id: host}`` of replicas that are
+        spawning or ready.
+    :param draining: rep_ids already draining (they still occupy
+        their host slot until gone, but count toward neither desired
+        nor further drains).
+    :param drainable: rep_ids eligible for a scale-down drain
+        (default: all of ``placements``).  The master passes the
+        READY set — a replica still spawning is not serving anything,
+        so "draining" it is meaningless; it is left to finish and
+        gets drained on a later round if still surplus.
+
+    Deterministic: spawns fill the least-loaded live host first (ties
+    to the lowest id); drains shed the NEWEST replica on the
+    most-loaded host first (the oldest replicas keep their warmed
+    prefix caches)."""
+    draining = set(draining)
+    active = {r: h for r, h in placements.items() if r not in draining}
+    load = {h: 0 for h in live_hosts}
+    for rep, host in active.items():
+        if host in load:
+            load[host] += 1
+    for rep, host in placements.items():
+        if rep in draining and host in load:
+            load[host] += 1     # a draining replica still holds a slot
+    live_count = sum(1 for h in active.values() if h in load)
+    spawns = []
+    for _ in range(max(desired - live_count, 0)):
+        free = [h for h in live_hosts if load[h] < per_host]
+        if not free:
+            break               # spec unsatisfiable on the live hosts
+        host = min(free, key=lambda h: (load[h], h))
+        load[host] += 1
+        spawns.append(host)
+    drains = []
+    eligible = set(placements) if drainable is None else set(drainable)
+    for _ in range(max(live_count - desired, 0)):
+        candidates = [(r, h) for r, h in active.items()
+                      if h in load and r not in drains
+                      and r in eligible]
+        if not candidates:
+            break
+        rep, host = max(candidates,
+                        key=lambda rh: (load[rh[1]], rh[0]))
+        load[host] -= 1
+        del active[rep]
+        drains.append(rep)
+    return spawns, drains
+
+
+def dead_replica_verdicts(reps, router_states, agent_alive):
+    """Classify which replicas are DEAD and why — pure, fed by the
+    master's tick.  ``reps``: ``{rep_id: {"host", "state", "rid"}}``
+    (manager view), ``router_states``: ``{router rid: "up"|"down"|
+    "draining"}`` (the router's health verdicts), ``agent_alive``:
+    ``{host: bool}``.
+
+    Returns ``[(rep_id, cause)]``.  Two causes:
+
+    * ``"host-death"`` — the router marked the replica down AND its
+      host's agent connection is gone: the machine died.  Detection
+      rides the router's health probe (≤ one interval) instead of the
+      slower host-loss strike ladder — the strikes decide where new
+      work may be PLACED, not how fast a dead replica is replaced.
+    * ``"down"`` — the router marked it down while the agent is still
+      there: the replica process itself is sick/unreachable; the
+      agent's ``replica_exit`` (with the supervisor taxonomy) usually
+      lands first, this is the belt-and-braces path for a wedged-but-
+      alive process."""
+    out = []
+    for rep_id, rec in sorted(reps.items()):
+        if rec.get("state") != "ready":
+            continue
+        if router_states.get(rec.get("rid")) != "down":
+            continue
+        cause = ("host-death"
+                 if not agent_alive.get(rec.get("host"), False)
+                 else "down")
+        out.append((rep_id, cause))
+    return out
 
 
 # =====================================================================
@@ -1541,6 +1733,906 @@ class PodMaster(object):
 
 
 # =====================================================================
+# the serving-fleet master (the pod master owning the SERVING plane)
+# =====================================================================
+
+class ServeFleetMaster(object):
+    """The pod master's serving plane: own ``min..max`` engine
+    replicas across ``n_hosts`` per-host agents, behind an in-process
+    :class:`~veles_tpu.services.router.FleetRouter`
+    (docs/services.md "Autoscaling fleet"; ``veles-tpu-pod --serve``).
+
+    The declarative fleet spec (``root.common.serve.fleet.{min,max,
+    per_host}``) drives per-host spawn/drain over the same line-JSON
+    control plane the training pod uses: agents spawn the replica
+    command (any process that prints the ``REPLICA_READY port=...``
+    handshake — ``--serve`` workflows do under an agent), the master
+    auto-registers each announced port with its router and
+    deregisters it on death/drain.  A replica lost to host death or
+    process crash is classified with the shared supervisor taxonomy
+    (``classify_exit`` / the env-flake fingerprint) and replaced on a
+    surviving host within the PR 10 strike-ladder semantics: the
+    router's health probe detects the death within one interval, the
+    ``fleet.replace`` flight event records the verdict, host-death
+    replacements ride the resize valve bucket (planned recovery,
+    never the crash-loop budget), and replica ids are fenced —
+    monotonic, never reused, so a zombie replica's late READY cannot
+    re-register (it is ordered killed instead).
+
+    The autoscaler loop closes the measured feedback loop: the SLO
+    shedder's queue-wait overshoot and fresh ``serve.shed``
+    rejections (aggregated by :meth:`FleetRouter.fleet_signals` off
+    the health probes) scale the fleet up; sustained idle scales it
+    down — always through the SIGTERM drain, so scale-down is
+    lossless by construction.  Every decision passes
+    :meth:`PodValves.admit_scale` (flap damping in its own bucket).
+    Gate: ``tools/fleet_chaos.py``."""
+
+    def __init__(self, replica_argv, n_hosts=1, fleet_min=None,
+                 fleet_max=None, per_host=None, workdir=None, port=0,
+                 bind_host="127.0.0.1", router_port=0,
+                 replica_path="/service", env=None, spawn_agents=True,
+                 heartbeat_ms=None, stale_after_ms=None,
+                 health_interval_ms=None, kill_grace_ms=None,
+                 max_restarts=None, window_seconds=None,
+                 deterministic_limit=None, loss_strikes=None,
+                 loss_window_s=None, scale_up_overshoot=None,
+                 scale_idle_s=None, scale_cooldown_s=None,
+                 scale_window_s=None, scale_max_per_window=None,
+                 ready_timeout_ms=None, min_uptime_s=None,
+                 autoscale=True, autoscale_interval_s=0.5,
+                 host_extras=None, seed=None):
+        from veles_tpu.services.router import FleetRouter
+
+        def fknob(value, key, default):
+            if value is not None:
+                return value
+            return root.common.serve.fleet.get(key, default)
+
+        def pknob(value, key, default):
+            if value is not None:
+                return value
+            return root.common.pod.get(key, default)
+
+        self.replica_argv = list(replica_argv)
+        self.n_hosts = int(n_hosts)
+        self.workdir = os.path.abspath(workdir or "fleet-workdir")
+        self.fleet_min = int(fknob(fleet_min, "min", 1))
+        self.fleet_max = max(int(fknob(fleet_max, "max", 8)),
+                             self.fleet_min)
+        self.per_host = int(fknob(per_host, "per_host", 2))
+        self.replica_path = replica_path
+        self.port = int(port)
+        self.bind_host = bind_host
+        self.env = env
+        self.spawn_agents = bool(spawn_agents)
+        self.host_extras = dict(host_extras or {})
+        self.heartbeat_s = float(
+            pknob(heartbeat_ms, "heartbeat_ms", 500)) / 1e3
+        self.stale_after_s = float(
+            pknob(stale_after_ms, "stale_after_ms", 10000)) / 1e3
+        self.kill_grace_s = float(
+            pknob(kill_grace_ms, "kill_grace_ms", 5000)) / 1e3
+        self.loss_strikes = int(pknob(loss_strikes, "loss_strikes", 2))
+        self.loss_window_s = float(
+            pknob(loss_window_s, "loss_window_s", 60))
+        self.ready_timeout_s = float(
+            fknob(ready_timeout_ms, "ready_timeout_ms", 180000)) / 1e3
+        self.min_uptime_s = float(
+            fknob(min_uptime_s, "min_uptime_s", 30.0))
+        self.autoscale = bool(autoscale)
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self.valves = PodValves(
+            pknob(max_restarts, "max_restarts", 8),
+            pknob(window_seconds, "window_seconds", 600),
+            pknob(deterministic_limit, "deterministic_limit", 3),
+            scale_max_per_window=fknob(scale_max_per_window,
+                                       "scale_max_per_window", 4),
+            scale_window_seconds=fknob(scale_window_s,
+                                       "scale_window_s", 120.0))
+        self.autoscaler = FleetAutoscaler(
+            up_overshoot=fknob(scale_up_overshoot,
+                               "scale_up_overshoot", 1.0),
+            idle_s=fknob(scale_idle_s, "scale_idle_s", 30.0),
+            cooldown_s=fknob(scale_cooldown_s, "scale_cooldown_s",
+                             10.0))
+        self.router = FleetRouter(
+            port=router_port,
+            health_interval_ms=health_interval_ms)
+        self._rng = random.Random(seed)
+        self._log = logging.getLogger("ServeFleet")
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._listener = None
+        self._threads = []
+        self._agent_procs = {}
+        self._agent_spawns = {}
+        self._stopping = False
+        self.phase = "gathering"
+        self.rc = None
+        self.desired = self.fleet_min
+        self.hosts = {h: {"conn": None, "addr": "127.0.0.1",
+                          "registered_ts": None, "heartbeat_ts": None,
+                          "down_since": time.time()}
+                      for h in range(self.n_hosts)}
+        self.lost_hosts = set()
+        #: rep_id -> {"host", "state": spawning|ready|dying|draining|
+        #: dead, "rid", "port", "pid", "spawn_ts", "ready_ts",
+        #: "exit"} — rep ids are MONOTONIC and never reused (the
+        #: replica fence: a late READY under a retired id is refused)
+        self.reps = {}
+        self._next_rep = 0
+        self.replaced_total = 0
+        #: one record per scale decision / replacement / drain
+        self.history = []
+        self.drained = []
+        #: a crash-loop / deterministic-bug valve verdict holds all
+        #: further REPLACEMENT spawns (the fleet keeps serving on what
+        #: is left — a crashing replica binary must not respawn
+        #: forever, but taking the survivors down would be worse)
+        self.hold_replace = None
+        self._last_autoscale = 0.0
+        self._last_note = 0.0
+        self._started_ts = None
+        #: set AFTER the policy loop's teardown (agents shut down,
+        #: router stopped) — wait() blocks on this instead of joining
+        #: the thread: a KeyboardInterrupt-interrupted join can poison
+        #: the thread's tstate lock in CPython, making a later
+        #: join/is_alive misreport a live thread as finished
+        self._finished = threading.Event()
+
+    # ------------------------------------------------------------ layout
+    def host_workdir(self, host):
+        return os.path.join(self.workdir, "agent%d" % host)
+
+    def host_down_file(self, host):
+        """Same GONE-machine marker the training pod master uses (see
+        :meth:`PodMaster.host_down_file`) — the chaos harness's model
+        of a dead host."""
+        return os.path.join(self.workdir, "host%d.down" % host)
+
+    def agent_argv(self, host):
+        return [sys.executable, "-m", "veles_tpu.services.podmaster",
+                "--agent", "--master",
+                "%s:%d" % (self.bind_host, self.port),
+                "--host-id", str(host),
+                "--workdir", self.host_workdir(host)]
+
+    def live_hosts(self):
+        """Hosts a replica may be PLACED on right now: agent
+        connected, heartbeat fresh, not classified lost."""
+        now = time.time()
+        out = []
+        for h, s in sorted(self.hosts.items()):
+            if h in self.lost_hosts:
+                continue
+            if s["conn"] is None or not s["conn"].alive:
+                continue
+            if s["heartbeat_ts"] is not None \
+                    and now - s["heartbeat_ts"] > self.stale_after_s:
+                continue
+            out.append(h)
+        return out
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        os.makedirs(self.workdir, exist_ok=True)
+        self._started_ts = time.time()
+        self.router.start()
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.bind_host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(self.n_hosts + 4)
+        t = threading.Thread(target=self._accept_loop,
+                             name="FleetAccept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.spawn_agents:
+            for h in range(self.n_hosts):
+                self._spawn_agent(h)
+        else:
+            for h in range(self.n_hosts):
+                print("[fleet] host %d agent command: %s"
+                      % (h, " ".join(self.agent_argv(h))), flush=True)
+        self._policy_thread = threading.Thread(
+            target=self._policy_loop, name="FleetPolicy", daemon=True)
+        self._policy_thread.start()
+        self._info("fleet master on %s:%d — router http://%s:%d%s, "
+                   "spec min=%d max=%d per_host=%d over %d host(s)",
+                   self.bind_host, self.port, self.router.host,
+                   self.router.port, self.router.path, self.fleet_min,
+                   self.fleet_max, self.per_host, self.n_hosts)
+        return self
+
+    def wait(self, timeout=None):
+        """Block until the fleet finishes/gives up (the final rc), or
+        ``timeout`` passes (None)."""
+        if not self._finished.wait(timeout):
+            return None
+        return self.rc
+
+    def run(self):
+        self.start()
+        return self.wait()
+
+    def stop(self, rc=0):
+        """Graceful shutdown: drain every replica (agents SIGTERM
+        them), stop the agents and the router."""
+        with self._lock:
+            if self.phase in ("done", "giveup"):
+                return
+            self._stopping = True
+        self._inbox.put(("stop", None, {"rc": rc}))
+
+    def status(self):
+        with self._lock:
+            live = [r for r in self.reps.values()
+                    if r["state"] == "ready"]
+            return {
+                "phase": self.phase,
+                "desired": self.desired,
+                "spec": {"min": self.fleet_min, "max": self.fleet_max,
+                         "per_host": self.per_host},
+                "live_replicas": len(live),
+                "replicas": {
+                    rep: {"host": r["host"], "state": r["state"],
+                          "port": r["port"], "pid": r["pid"],
+                          "rid": r["rid"]}
+                    for rep, r in sorted(self.reps.items())
+                    if r["state"] != "dead"},
+                "hosts": {
+                    h: {"registered": s["conn"] is not None
+                        and s["conn"].alive,
+                        "lost": h in self.lost_hosts}
+                    for h, s in self.hosts.items()},
+                "lost_hosts": sorted(self.lost_hosts),
+                "replaced_total": self.replaced_total,
+                "scale_events": self.valves.scale_events,
+                "scale_damped": self.valves.scale_damped,
+                "resize_restarts": self.valves.resize_restarts,
+                "hold_replace": self.hold_replace,
+                "router": {"host": self.router.host,
+                           "port": self.router.port,
+                           "path": self.router.path},
+                "drained": list(self.drained),
+            }
+
+    # --------------------------------------------------- agent processes
+    def _spawn_agent(self, host):
+        os.makedirs(self.host_workdir(host), exist_ok=True)
+        env = dict(self.env if self.env is not None else os.environ)
+        import veles_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(veles_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        log = open(os.path.join(self.host_workdir(host), "agent.log"),
+                   "ab")
+        try:
+            proc = subprocess.Popen(self.agent_argv(host), env=env,
+                                    stdout=log, stderr=log)
+        finally:
+            log.close()
+        self._agent_procs[host] = proc
+        self._agent_spawns.setdefault(host, []).append(time.time())
+        flight.record("fleet.agent_spawn", host=host, pid=proc.pid)
+
+    def _respawn_dead_agents(self):
+        for host, proc in list(self._agent_procs.items()):
+            if proc.poll() is not None and not self._stopping:
+                with self._lock:
+                    if self.phase in ("done", "giveup"):
+                        return
+                if os.path.exists(self.host_down_file(host)):
+                    continue        # machine modeled GONE (chaos)
+                recent = [t for t in self._agent_spawns.get(host, [])
+                          if time.time() - t < 60]
+                if len(recent) >= 5:
+                    self._error("host %d agent died %d times in 60s — "
+                                "marking the host lost", host,
+                                len(recent))
+                    flight.record("fleet.host_lost", host=host,
+                                  reason="agent-crash-loop")
+                    with self._lock:
+                        self.lost_hosts.add(host)
+                    continue
+                flight.record("fleet.agent_died", host=host,
+                              rc=proc.returncode)
+                self._spawn_agent(host)
+
+    # ------------------------------------------------------ accept/reader
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn = _Conn(sock)
+            threading.Thread(target=self._reader, args=(conn,),
+                             name="FleetReader", daemon=True).start()
+
+    def _reader(self, conn):
+        msg = conn.recv()
+        if not msg or msg.get("type") != "register":
+            conn.send({"type": "refused", "reason": "register-first"})
+            conn.close()
+            return
+        host = msg.get("host")
+        reason = None
+        with self._lock:
+            if not isinstance(host, int) or host not in self.hosts:
+                reason = "unknown-host"
+            elif self.hosts[host]["conn"] is not None \
+                    and self.hosts[host]["conn"].alive:
+                reason = "duplicate-host"
+            else:
+                self.hosts[host]["conn"] = conn
+                self.hosts[host]["registered_ts"] = time.time()
+                self.hosts[host]["heartbeat_ts"] = time.time()
+                self.hosts[host]["down_since"] = None
+                try:
+                    self.hosts[host]["addr"] = \
+                        conn.sock.getpeername()[0]
+                except OSError:
+                    pass
+        if reason is not None:
+            conn.send({"type": "refused", "reason": reason})
+            conn.close()
+            return
+        conn.send({"type": "welcome",
+                   "heartbeat_ms": int(self.heartbeat_s * 1e3)})
+        flight.record("fleet.agent_up", host=host)
+        self._inbox.put(("agent_up", host, msg))
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                break
+            self._inbox.put((msg.get("type", "garbage"), host, msg))
+        conn.close()
+        self._inbox.put(("agent_lost", host, {}))
+
+    def _send(self, host, obj):
+        conn = self.hosts[host]["conn"]
+        return conn is not None and conn.send(obj)
+
+    # -------------------------------------------------------- policy loop
+    def _policy_loop(self):
+        try:
+            self._policy_loop_inner()
+        except Exception as e:   # noqa: BLE001 — never die silently
+            self._error("fleet policy loop crashed: %s: %s",
+                        type(e).__name__, e)
+            flight.record("fleet.policy_error", error=str(e))
+            flight.dump(reason="fleet-policy-error", error=e)
+            with self._lock:
+                self.phase = "giveup"
+                self.rc = 1
+        finally:
+            self._shutdown()
+            self._finished.set()
+
+    def _policy_loop_inner(self):
+        while True:
+            try:
+                ev = self._inbox.get(timeout=0.05)
+            except queue.Empty:
+                ev = None
+            if ev is not None:
+                self._handle_event(*ev)
+            self._tick(time.time())
+            with self._lock:
+                if self.phase in ("done", "giveup"):
+                    return
+
+    def _handle_event(self, kind, host, msg):
+        now = time.time()
+        if kind == "stop":
+            with self._lock:
+                self.phase = "stopping"
+                self.rc = msg.get("rc", 0)
+            self._begin_shutdown_drain()
+            return
+        if host is None:
+            return
+        with self._lock:
+            state = self.hosts[host]
+            if kind == "agent_up":
+                if host in self.lost_hosts:
+                    self.lost_hosts.discard(host)
+                    flight.record("fleet.host_restored", host=host)
+                    self._info("host %d agent re-registered — back "
+                               "in the placement pool", host)
+            elif kind == "agent_lost":
+                state["conn"] = None
+                state["heartbeat_ts"] = None
+                state["down_since"] = now
+                flight.record("fleet.agent_lost", host=host)
+            elif kind == "heartbeat":
+                state["heartbeat_ts"] = now
+        if kind == "replica_up":
+            self._handle_replica_up(host, msg, now)
+        elif kind == "replica_exit":
+            self._handle_replica_exit(host, msg, now)
+
+    def _handle_replica_up(self, host, msg, now):
+        rep = msg.get("rep")
+        with self._lock:
+            rec = self.reps.get(rep)
+            fenced = (rec is None or rec["host"] != host
+                      or rec["state"] != "spawning")
+            if not fenced:
+                rec["state"] = "ready"
+                rec["ready_ts"] = now
+                rec["port"] = msg.get("port")
+                rec["pid"] = msg.get("pid")
+                addr = self.hosts[host]["addr"]
+        if fenced:
+            # the replica fence: rep ids are never reused, so a READY
+            # from a replaced/retired/unknown id is a zombie — it must
+            # not (re-)register with the router; order it killed
+            flight.record("fleet.fence", host=host, rep=rep,
+                          state=None if rec is None else rec["state"])
+            self._info("fencing zombie replica %s on host %d", rep,
+                       host)
+            with self._lock:
+                self._send(host, {"type": "kill_replica", "rep": rep})
+            return
+        url = "http://%s:%d%s" % (addr, msg["port"], self.replica_path)
+        rid = self.router.register(url)
+        with self._lock:
+            rec = self.reps.get(rep)
+            if rec is not None:
+                rec["rid"] = rid
+        flight.record("fleet.replica_ready", host=host, rep=rep,
+                      rid=rid, url=url)
+        self._info("replica %d ready on host %d (%s) — registered as "
+                   "router replica %d", rep, host, url, rid)
+
+    def _handle_replica_exit(self, host, msg, now):
+        rep = msg.get("rep")
+        with self._lock:
+            rec = self.reps.get(rep)
+            if rec is None or rec["state"] == "dead":
+                return           # late report for a handled death
+            prev_state = rec["state"]
+            rec["state"] = "dead"
+            rec["exit"] = {"rc": msg.get("rc"),
+                           "kind": msg.get("kind"),
+                           "signature": msg.get("signature")}
+            rid = rec["rid"]
+        if rid is not None:
+            self.router.deregister(rid, reason="replica exit (%s)"
+                                   % msg.get("kind"))
+        if prev_state == "draining":
+            # a planned scale-down (or shutdown) drain completing —
+            # exit 0 (kind "done") is the lossless-by-construction
+            # proof the chaos gate checks.  was_ready distinguishes a
+            # drained SERVING replica (must exit 0) from a surplus
+            # spawn stopped before it ever served (nothing to lose)
+            entry = {"rep": rep, "host": host, "rc": msg.get("rc"),
+                     "kind": msg.get("kind"),
+                     "was_ready":
+                         self.reps[rep].get("ready_ts") is not None,
+                     "ts": now}
+            with self._lock:
+                self.drained.append(entry)
+            flight.record("fleet.drained", rep=rep, host=host,
+                          rc=entry["rc"], exit_kind=entry["kind"],
+                          was_ready=entry["was_ready"])
+            self._info("replica %d drained (rc=%s)", rep,
+                       msg.get("rc"))
+            return
+        if self._stopping:
+            return
+        # unplanned death: the supervisor taxonomy decides the
+        # replacement budget — env flakes/preempts respawn uncounted,
+        # crashes are bounded by the crash-loop and deterministic-bug
+        # valves (a replica binary that dies identically over and
+        # over must not burn the fleet's budget forever)
+        kind = msg.get("kind") or "crash:unknown"
+        # an UNPLANNED clean exit is not clean for a serving replica
+        # (they serve until drained): it counts like a crash, with a
+        # stable signature, so a misconfigured replica command that
+        # prints usage and exits 0 trips the deterministic-bug valve
+        # instead of respawning unbudgeted forever
+        counted = kind not in ("env-flake", "preempt")
+        ready_ts = self.reps[rep].get("ready_ts")
+        progressed = (prev_state in ("ready", "dying")
+                      and ready_ts is not None
+                      and now - ready_ts >= self.min_uptime_s)
+        signature = msg.get("signature")
+        if kind == "done" and signature is None:
+            signature = "clean-exit"
+        verdict = self.valves.admit(
+            now, (str(signature),) if signature else None,
+            progressed=progressed, counted=counted)
+        record = {"action": "replace", "rep": rep, "host": host,
+                  "cause": kind, "counted": counted,
+                  "verdict": verdict, "ts": now}
+        with self._lock:
+            self.history.append(record)
+        if verdict != "respawn":
+            self._error("replica replacement held: %s (replica %d "
+                        "died %s) — serving on the survivors",
+                        verdict, rep, kind)
+            flight.record("fleet.giveup", reason=verdict, rep=rep,
+                          cause=kind)
+            with self._lock:
+                self.hold_replace = verdict
+            return
+        self.replaced_total += 1
+        self.router.fleet_event("replace")
+        flight.record("fleet.replace", rep=rep, host=host, cause=kind,
+                      counted=counted)
+        self._info("replica %d died (%s) — replacing", rep, kind)
+        # the reconcile tick performs the actual replacement spawn
+
+    def _handle_host_death_replicas(self, now):
+        """Replicas whose router probe says DOWN while their agent is
+        gone died with their machine: no ``replica_exit`` will ever
+        arrive — deregister and replace them NOW (detection ≤ one
+        health interval), in the resize bucket (planned recovery,
+        PR 10 semantics: a host death is the pod doing its job, not a
+        crash-looping binary)."""
+        router_states = {rid: d["state"] for rid, d
+                         in self.router.replicas().items()}
+        with self._lock:
+            agent_alive = {h: bool(s["conn"] is not None
+                                   and s["conn"].alive
+                                   and (s["heartbeat_ts"] is None
+                                        or now - s["heartbeat_ts"]
+                                        <= self.stale_after_s))
+                           for h, s in self.hosts.items()}
+            view = {rep: {"host": r["host"], "state": r["state"],
+                          "rid": r["rid"]}
+                    for rep, r in self.reps.items()}
+        for rep, cause in dead_replica_verdicts(view, router_states,
+                                                agent_alive):
+            if cause == "down":
+                # the agent is alive: kill the wedged process — its
+                # replica_exit does the (counted) accounting
+                with self._lock:
+                    rec = self.reps.get(rep)
+                    if rec is not None and rec["state"] == "ready":
+                        rec["state"] = "dying"
+                        self._send(rec["host"],
+                                   {"type": "kill_replica",
+                                    "rep": rep})
+                continue
+            with self._lock:
+                rec = self.reps.get(rep)
+                if rec is None or rec["state"] == "dead":
+                    continue
+                rec["state"] = "dead"
+                rec["exit"] = {"rc": None, "kind": "host-death",
+                               "signature": None}
+                rid = rec["rid"]
+                host = rec["host"]
+            if rid is not None:
+                self.router.deregister(rid, reason="host death")
+            self.valves.admit(now, resize=True)
+            self.replaced_total += 1
+            self.router.fleet_event("replace")
+            record = {"action": "replace", "rep": rep, "host": host,
+                      "cause": "host-death", "counted": False,
+                      "verdict": "respawn", "ts": now}
+            with self._lock:
+                self.history.append(record)
+            flight.record("fleet.replace", rep=rep, host=host,
+                          cause="host-death", counted=False)
+            self._error("replica %d lost with host %d — replacing on "
+                        "a survivor", rep, host)
+        self._reap_lost_host_replicas(now)
+
+    def _reap_lost_host_replicas(self, now):
+        """Non-READY replicas stranded on a LOST host (spawning /
+        dying / draining when the machine died) get no router-down
+        verdict and no ``replica_exit`` ever — once the strike ladder
+        declares the host lost, reap them here so they cannot hold a
+        phantom slot (or block shutdown/scale-down waits) forever."""
+        with self._lock:
+            stranded = [(rep, r) for rep, r in self.reps.items()
+                        if r["state"] in ("spawning", "dying",
+                                          "draining")
+                        and r["host"] in self.lost_hosts]
+            for rep, r in stranded:
+                prev, r["state"] = r["state"], "dead"
+                r["exit"] = {"rc": None, "kind": "host-death",
+                             "signature": None}
+                r["prev_state"] = prev
+        for rep, r in stranded:
+            if r["rid"] is not None:
+                self.router.deregister(r["rid"], reason="host death")
+            prev = r.pop("prev_state")
+            if prev == "draining":
+                # the drain's outcome died with the machine — record
+                # it honestly (kind host-death, no rc) rather than as
+                # a clean drain
+                entry = {"rep": rep, "host": r["host"], "rc": None,
+                         "kind": "host-death",
+                         "was_ready": r.get("ready_ts") is not None,
+                         "ts": now}
+                with self._lock:
+                    self.drained.append(entry)
+                flight.record("fleet.drained", rep=rep,
+                              host=r["host"], rc=None,
+                              exit_kind="host-death",
+                              was_ready=entry["was_ready"])
+                continue
+            # wanted capacity that died with its machine: replace on
+            # a survivor, resize bucket (same as the ready case)
+            self.valves.admit(now, resize=True)
+            self.replaced_total += 1
+            self.router.fleet_event("replace")
+            record = {"action": "replace", "rep": rep,
+                      "host": r["host"], "cause": "host-death",
+                      "counted": False, "verdict": "respawn",
+                      "ts": now}
+            with self._lock:
+                self.history.append(record)
+            flight.record("fleet.replace", rep=rep, host=r["host"],
+                          cause="host-death", counted=False)
+            self._error("replica %d (%s) stranded on lost host %d — "
+                        "reaped and replaced", rep, prev, r["host"])
+
+    def _strike_lost_hosts(self, now):
+        """The strike ladder at fleet scope: a host whose agent has
+        been gone for ``loss_strikes`` windows is LOST — new
+        placements avoid it until its agent re-registers (which
+        restores it; replicas flow back via reconciliation when the
+        autoscaler next needs the room)."""
+        with self._lock:
+            for h, s in self.hosts.items():
+                if h in self.lost_hosts:
+                    continue
+                gone = (s["conn"] is None or not s["conn"].alive)
+                if not gone or s["down_since"] is None:
+                    continue
+                if now - s["down_since"] >= \
+                        self.loss_strikes * self.loss_window_s:
+                    self.lost_hosts.add(h)
+                    lost = sorted(self.lost_hosts)
+                    flight.record("fleet.host_lost", host=h,
+                                  strikes=self.loss_strikes,
+                                  lost=lost)
+                    self._error("host %d classified LOST (%d "
+                                "windows silent) — placements avoid "
+                                "it until its agent returns", h,
+                                self.loss_strikes)
+
+    # -------------------------------------------------------------- tick
+    def _tick(self, now):
+        with self._lock:
+            phase = self.phase
+        if phase in ("done", "giveup"):
+            return
+        if self.spawn_agents:
+            self._respawn_dead_agents()
+        if phase == "stopping":
+            self._tick_stopping(now)
+            return
+        if phase == "gathering":
+            # no placements until every agent registered (bounded by
+            # a grace window): the first reconcile run against a
+            # partial host set would pile the whole minimum onto
+            # whichever agent connected first, concentrating exactly
+            # the capacity a host kill is supposed to only dent
+            with self._lock:
+                all_up = all(s["conn"] is not None and s["conn"].alive
+                             for s in self.hosts.values())
+            grace = max(self.loss_strikes * self.loss_window_s, 10.0)
+            if all_up or (self._started_ts is not None
+                          and now - self._started_ts > grace):
+                with self._lock:
+                    self.phase = "running"
+                self._info("placement opens on host(s) %s",
+                           self.live_hosts() or "<none>")
+            else:
+                return
+        self._strike_lost_hosts(now)
+        self._handle_host_death_replicas(now)
+        self._expire_stuck_spawns(now)
+        if self.autoscale and \
+                now - self._last_autoscale >= self.autoscale_interval_s:
+            self._last_autoscale = now
+            self._autoscale_step(now)
+        self._reconcile(now)
+        if now - self._last_note >= 1.0:
+            self._last_note = now
+            with self._lock:
+                self.router.note_fleet(
+                    desired=self.desired,
+                    hosts=len(self.live_hosts()),
+                    lost_hosts=sorted(self.lost_hosts),
+                    scale_events=self.valves.scale_events,
+                    scale_damped=self.valves.scale_damped,
+                    replaced=self.replaced_total,
+                    hold_replace=self.hold_replace)
+
+    def _expire_stuck_spawns(self, now):
+        """A replica that never announced READY within the budget is
+        a wedged spawn: kill it (its exit report does the counted
+        accounting) — it must not hold a fleet slot forever."""
+        with self._lock:
+            stuck = [(rep, r) for rep, r in self.reps.items()
+                     if r["state"] == "spawning"
+                     and now - r["spawn_ts"] > self.ready_timeout_s]
+            for rep, r in stuck:
+                r["state"] = "dying"
+                self._send(r["host"], {"type": "kill_replica",
+                                       "rep": rep})
+        for rep, r in stuck:
+            flight.record("fleet.ready_timeout", rep=rep,
+                          host=r["host"])
+            self._error("replica %d never announced READY in %.0fs — "
+                        "killing the spawn", rep, self.ready_timeout_s)
+
+    def _autoscale_step(self, now):
+        signals = self.router.fleet_signals()
+        with self._lock:
+            desired = self.desired
+        delta, reason = self.autoscaler.decide(
+            now, desired, self.fleet_min, self.fleet_max, signals)
+        if not delta:
+            return
+        verdict = self.valves.admit_scale(now)
+        direction = "up" if delta > 0 else "down"
+        if verdict == "damped":
+            flight.record("fleet.scale_damped", direction=direction,
+                          reason=reason)
+            self._info("autoscale %s damped (flap valve): %s",
+                       direction, reason)
+            return
+        with self._lock:
+            self.desired = min(self.fleet_max,
+                               max(self.fleet_min, desired + delta))
+            new = self.desired
+            self.history.append({"action": "scale",
+                                 "direction": direction,
+                                 "from": desired, "to": new,
+                                 "reason": reason, "ts": now})
+        self.router.fleet_event("scale", direction)
+        flight.record("fleet.scale", direction=direction,
+                      desired=new, was=desired, reason=reason,
+                      signals=signals)
+        self._info("autoscale %s: desired %d -> %d (%s)", direction,
+                   desired, new, reason)
+
+    def _reconcile(self, now):
+        with self._lock:
+            live = self.live_hosts()
+            placements = {rep: r["host"]
+                          for rep, r in self.reps.items()
+                          if r["state"] in ("spawning", "ready",
+                                            "dying")}
+            draining = [rep for rep, r in self.reps.items()
+                        if r["state"] == "draining"]
+            drainable = [rep for rep, r in self.reps.items()
+                         if r["state"] == "ready"]
+            desired = self.desired
+            if self.hold_replace is not None:
+                # a valve verdict holds the fleet at what is live —
+                # no replacement/growth spawns until an operator
+                # intervenes (scale-down drains still allowed)
+                desired = min(desired, len(placements))
+        spawns, drains = plan_fleet(desired, live, self.per_host,
+                                    placements, draining,
+                                    drainable=drainable)
+        for host in spawns:
+            self._spawn_replica_on(host, now)
+        for rep in drains:
+            self._drain_rep(rep, now)
+
+    def _spawn_replica_on(self, host, now):
+        with self._lock:
+            rep = self._next_rep
+            self._next_rep += 1
+            argv = list(self.replica_argv) + \
+                list(self.host_extras.get(host, ()))
+            self.reps[rep] = {"host": host, "state": "spawning",
+                              "rid": None, "port": None, "pid": None,
+                              "spawn_ts": now, "ready_ts": None,
+                              "exit": None}
+            sent = self._send(host, {"type": "spawn_replica",
+                                     "rep": rep, "argv": argv,
+                                     "env": {}})
+            if not sent:
+                # the agent died between planning and send: the next
+                # tick re-plans over the live hosts
+                self.reps[rep]["state"] = "dead"
+                self.reps[rep]["exit"] = {"rc": None,
+                                          "kind": "agent-unreachable",
+                                          "signature": None}
+                return
+        flight.record("fleet.spawn", rep=rep, host=host)
+        self._info("spawning replica %d on host %d", rep, host)
+
+    def _drain_rep(self, rep, now):
+        with self._lock:
+            rec = self.reps.get(rep)
+            if rec is None or rec["state"] not in ("spawning",
+                                                   "ready"):
+                return
+            rec["state"] = "draining"
+            rid, host = rec["rid"], rec["host"]
+        flight.record("fleet.drain", rep=rep, host=host, rid=rid)
+        self._info("scale-down: draining replica %d on host %d", rep,
+                   host)
+        if rid is not None:
+            # stop routing to it immediately; its in-flight requests
+            # finish (the router marks it draining and POSTs /drain)
+            self.router.drain_replica(rid)
+        with self._lock:
+            self._send(host, {"type": "drain_replica", "rep": rep})
+
+    # ----------------------------------------------------------- shutdown
+    def _begin_shutdown_drain(self):
+        with self._lock:
+            reps = [(rep, r) for rep, r in self.reps.items()
+                    if r["state"] in ("spawning", "ready")]
+        for rep, r in reps:
+            self._drain_rep(rep, time.time())
+        with self._lock:
+            self._shutdown_deadline = time.time() + \
+                max(self.kill_grace_s * 2, 10.0)
+
+    def _tick_stopping(self, now):
+        with self._lock:
+            # a replica whose host's agent is gone can never report
+            # its exit — waiting on it only burns the deadline
+            live = [rep for rep, r in self.reps.items()
+                    if r["state"] in ("spawning", "ready", "dying",
+                                      "draining")
+                    and self.hosts[r["host"]]["conn"] is not None
+                    and self.hosts[r["host"]]["conn"].alive]
+            done = not live or now >= self._shutdown_deadline
+            if done:
+                self.phase = "done" if self.rc in (None, 0) \
+                    else "giveup"
+                if self.rc is None:
+                    self.rc = 0
+
+    def _shutdown(self):
+        with self._lock:
+            for h in self.hosts:
+                self._send(h, {"type": "shutdown",
+                               "grace_ms":
+                                   int(self.kill_grace_s * 1e3)})
+        deadline = time.time() + self.kill_grace_s + 10
+        for host, proc in self._agent_procs.items():
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            self.router.stop()
+        except Exception:   # noqa: BLE001 — best-effort teardown
+            pass
+
+    def _info(self, msg, *args):
+        self._log.info(msg, *args)
+        print("[fleet] " + msg % args, file=sys.stderr, flush=True)
+
+    def _error(self, msg, *args):
+        self._log.error(msg, *args)
+        print("[fleet] " + msg % args, file=sys.stderr, flush=True)
+
+
+# =====================================================================
 # the per-host agent
 # =====================================================================
 
@@ -1565,6 +2657,9 @@ class PodAgent(object):
         self._child = None
         self._spec = None
         self._spawned_ts = None
+        #: serving replicas this agent runs for a ServeFleetMaster:
+        #: rep_id -> {"proc", "port", "spec", "log_path"}
+        self._replicas = {}
         #: (snapshot_dir, prefix, scan) from the last report_manifests
         #: — the worker is dead for the whole agree->spawn round, so the
         #: rollback can reuse it instead of re-hashing the ring
@@ -1621,6 +2716,17 @@ class PodAgent(object):
                 self._fetch_commit(msg)
             elif t == "push_commit":
                 self._push_commit(msg)
+            elif t == "spawn_replica":
+                self._spawn_replica(msg)
+            elif t == "drain_replica":
+                # lossless scale-down: SIGTERM → the replica's
+                # install_sigterm_drain stops admission, finishes
+                # in-flight, exits 0 (reported as replica_exit done)
+                self._signal_replica(msg.get("rep"), signal.SIGTERM,
+                                     "drain")
+            elif t == "kill_replica":
+                self._signal_replica(msg.get("rep"), signal.SIGKILL,
+                                     "kill")
             elif t == "fence":
                 self._print("fenced by master (%s) — killing worker",
                             msg.get("reason"))
@@ -1628,8 +2734,9 @@ class PodAgent(object):
                               reason=msg.get("reason"))
                 self._kill_worker(grace_s=0.0)
             elif t == "shutdown":
-                self._kill_worker(
-                    grace_s=float(msg.get("grace_ms", 5000)) / 1e3)
+                grace = float(msg.get("grace_ms", 5000)) / 1e3
+                self._shutdown_replicas(grace)
+                self._kill_worker(grace_s=grace)
                 break
         self._stop.set()
         self._conn.close()
@@ -1637,11 +2744,25 @@ class PodAgent(object):
 
     # ------------------------------------------------------------- fence
     def _fence_orphan(self):
-        """Kill any worker a previous agent life left running (its pid
-        survives in the pidfile): a zombie from an old incarnation must
-        never reach the new collective."""
+        """Kill any worker OR serving replica a previous agent life
+        left running (their pids survive in pidfiles): a zombie from
+        an old incarnation must never reach the new collective — and
+        a zombie replica must never keep serving (or re-register)
+        after the fleet already replaced it."""
+        pidfiles = [self.pidfile]
         try:
-            fields = open(self.pidfile).read().split()
+            pidfiles += sorted(
+                os.path.join(self.workdir, n)
+                for n in os.listdir(self.workdir)
+                if n.startswith("replica-") and n.endswith(".pid"))
+        except OSError:
+            pass
+        for path in pidfiles:
+            self._fence_pidfile(path)
+
+    def _fence_pidfile(self, pidfile):
+        try:
+            fields = open(pidfile).read().split()
             pid = int(fields[0])
             ticks = int(fields[1]) if len(fields) > 1 else None
         except (OSError, ValueError, IndexError):
@@ -1660,7 +2781,7 @@ class PodAgent(object):
                 self._print("stale pidfile pid %d was recycled — "
                             "not fencing", pid)
                 try:
-                    os.remove(self.pidfile)
+                    os.remove(pidfile)
                 except OSError:
                     pass
                 return
@@ -1674,19 +2795,20 @@ class PodAgent(object):
                 self._print("stale pidfile pid %d is not a worker — "
                             "not fencing", pid)
                 try:
-                    os.remove(self.pidfile)
+                    os.remove(pidfile)
                 except OSError:
                     pass
                 return
-        self._print("fencing orphan worker pid %d from a previous "
-                    "agent life", pid)
-        flight.record("pod.orphan_fenced", host=self.host, pid=pid)
+        self._print("fencing orphan pid %d from a previous agent "
+                    "life (%s)", pid, os.path.basename(pidfile))
+        flight.record("pod.orphan_fenced", host=self.host, pid=pid,
+                      pidfile=os.path.basename(pidfile))
         try:
             os.kill(pid, signal.SIGKILL)
         except OSError:
             pass
         try:
-            os.remove(self.pidfile)
+            os.remove(pidfile)
         except OSError:
             pass
 
@@ -1835,6 +2957,163 @@ class PodAgent(object):
             except OSError:
                 pass
 
+    # ----------------------------------------------- serving replicas
+    def _replica_pidfile(self, rep):
+        return os.path.join(self.workdir, "replica-%03d.pid" % rep)
+
+    def _spawn_replica(self, msg):
+        """Spawn one serving replica on the master's order: start the
+        replica command with stdout piped, tee it into
+        ``replica-NNN.log`` while scanning for the READY handshake
+        (``restful.READY_LINE``), report ``replica_up`` with the bound
+        port, and report ``replica_exit`` — classified with the shared
+        supervisor taxonomy — when it dies."""
+        rep = int(msg["rep"])
+        old = self._replicas.get(rep)
+        if old is not None and old["proc"].poll() is None:
+            # a live process under a reused id is a zombie hazard —
+            # the master never reuses rep ids, so this is defensive
+            self._print("spawn_replica %d with live process pid %d — "
+                        "killing it first", rep, old["proc"].pid)
+            try:
+                old["proc"].kill()
+            except OSError:
+                pass
+        env = merge_worker_env(os.environ, msg.get("env", {}))
+        env["PYTHONUNBUFFERED"] = "1"
+        # any `--serve` command announces READY under an agent
+        env["VELES_TPU_REPLICA_ANNOUNCE"] = "1"
+        # fleet membership, surfaced on the replica's own
+        # web_status /api/health
+        env["VELES_TPU_FLEET_HOST"] = str(self.host)
+        env["VELES_TPU_FLEET_REP"] = str(rep)
+        log_path = os.path.join(self.workdir,
+                                "replica-%03d.log" % rep)
+        try:
+            log = open(log_path, "ab")
+        except OSError as e:
+            self._send({"type": "replica_exit", "host": self.host,
+                        "rep": rep, "rc": 127,
+                        "kind": "crash:SpawnError",
+                        "signature": str(e)})
+            return
+        try:
+            proc = subprocess.Popen(msg["argv"], env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=log)
+        except OSError as e:
+            log.close()
+            self._print("replica %d spawn failed: %s", rep, e)
+            self._send({"type": "replica_exit", "host": self.host,
+                        "rep": rep, "rc": 127,
+                        "kind": "crash:SpawnError",
+                        "signature": str(e)})
+            return
+        self._replicas[rep] = {"proc": proc, "port": None,
+                               "spec": dict(msg),
+                               "log_path": log_path}
+        try:
+            ticks = _proc_start_ticks(proc.pid)
+            with open(self._replica_pidfile(rep), "w") as f:
+                f.write(str(proc.pid) if ticks is None
+                        else "%d %d" % (proc.pid, ticks))
+        except OSError:
+            pass
+        flight.record("fleet.replica_spawn", host=self.host, rep=rep,
+                      pid=proc.pid)
+        threading.Thread(target=self._replica_pump,
+                         args=(rep, proc, log),
+                         name="AgentReplica%d" % rep,
+                         daemon=True).start()
+
+    def _replica_pump(self, rep, proc, log):
+        """Read the replica's stdout line by line (teeing into its
+        log — the pipe must keep draining or the replica blocks on a
+        full buffer), announce ``replica_up`` at the READY line, and
+        report the classified exit when the stream ends."""
+        from veles_tpu.services.restful import parse_ready_line
+        announced = False
+        try:
+            for raw in proc.stdout:
+                try:
+                    log.write(raw)
+                    log.flush()
+                except OSError:
+                    pass
+                if not announced:
+                    ready = parse_ready_line(
+                        raw.decode("utf-8", "replace"))
+                    if ready is not None:
+                        announced = True
+                        self._replicas[rep]["port"] = ready["port"]
+                        self._send({"type": "replica_up",
+                                    "host": self.host, "rep": rep,
+                                    "port": ready["port"],
+                                    "pid": proc.pid})
+        except (OSError, ValueError):
+            pass
+        rc = proc.wait()
+        log.close()
+        kind, signature = classify_exit(rc)
+        if kind.startswith("killed:"):
+            # same env-flake fingerprint as the training worker: an
+            # abort-class death with a startup-shaped log is the
+            # sandbox environment, not the replica binary — the
+            # master replaces it uncounted
+            sig_name = kind.split(":", 1)[1]
+            flaky = {signal.Signals(s).name
+                     for s in STARTUP_FLAKE_SIGNALS}
+            if sig_name in flaky and not announced and \
+                    self._startup_shaped_log(
+                        self._replicas[rep]["log_path"]):
+                kind = "env-flake"
+        with self._lock:
+            try:
+                mine = open(self._replica_pidfile(rep)).read().split()
+                mine = mine and mine[0] == str(proc.pid)
+            except (OSError, ValueError, IndexError):
+                mine = False
+            if mine:
+                try:
+                    os.remove(self._replica_pidfile(rep))
+                except OSError:
+                    pass
+        self._send({"type": "replica_exit", "host": self.host,
+                    "rep": rep, "rc": rc, "kind": kind,
+                    "signature": signature,
+                    "announced": announced})
+
+    def _signal_replica(self, rep, sig, what):
+        rec = self._replicas.get(rep)
+        if rec is None or rec["proc"].poll() is not None:
+            return
+        self._print("%s replica %d (pid %d)", what, rep,
+                    rec["proc"].pid)
+        try:
+            rec["proc"].send_signal(sig)
+        except OSError:
+            pass
+
+    def _shutdown_replicas(self, grace_s):
+        """Agent shutdown: SIGTERM every replica (they drain and exit
+        0), escalate to SIGKILL past the grace."""
+        live = [rec["proc"] for rec in self._replicas.values()
+                if rec["proc"].poll() is None]
+        for proc in live:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + grace_s
+        for proc in live:
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
     # --------------------------------------------------------- telemetry
     def _heartbeat_loop(self):
         while not self._stop.is_set():
@@ -1975,8 +3254,32 @@ def main(argv=None):
                    "one agent per machine)")
     p.add_argument("--json", default=None, metavar="FILE",
                    help="(master) write the final status/history here")
+    p.add_argument("--serve", action="store_true",
+                   help="run the SERVING fleet master instead of the "
+                   "training pod master: the command after `--` is "
+                   "the replica command (it must print the "
+                   "REPLICA_READY handshake — any `python -m "
+                   "veles_tpu ... --serve 0` does under an agent); "
+                   "the fleet spec comes from root.common.serve."
+                   "fleet.{min,max,per_host} unless overridden "
+                   "(docs/services.md 'Autoscaling fleet')")
+    p.add_argument("--fleet-min", type=int, default=None,
+                   help="(--serve) minimum replicas fleet-wide")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="(--serve) maximum replicas fleet-wide")
+    p.add_argument("--per-host", type=int, default=None,
+                   help="(--serve) max replicas on any one host")
+    p.add_argument("--router-port", type=int, default=0,
+                   help="(--serve) the fleet router's HTTP port "
+                   "(0 = pick)")
+    p.add_argument("--health-interval-ms", type=float, default=None,
+                   help="(--serve) the router's health-probe period")
+    p.add_argument("--no-autoscale", action="store_true",
+                   help="(--serve) hold the fleet at --fleet-min "
+                   "instead of following the measured load")
     p.add_argument("worker", nargs=argparse.REMAINDER,
-                   help="(master) the worker command, after `--`")
+                   help="(master) the worker command, after `--` "
+                   "(the replica command with --serve)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -1991,7 +3294,32 @@ def main(argv=None):
     if worker and worker[0] == "--":
         worker = worker[1:]
     if not worker:
-        p.error("master mode needs the worker command after `--`")
+        p.error("master mode needs the %s command after `--`"
+                % ("replica" if args.serve else "worker"))
+    if args.serve:
+        master = ServeFleetMaster(
+            worker, n_hosts=args.hosts, workdir=args.workdir,
+            port=args.port, bind_host=args.bind_host,
+            fleet_min=args.fleet_min, fleet_max=args.fleet_max,
+            per_host=args.per_host, router_port=args.router_port,
+            health_interval_ms=args.health_interval_ms,
+            autoscale=not args.no_autoscale,
+            spawn_agents=not args.no_agents)
+        try:
+            rc = master.run()
+        except KeyboardInterrupt:
+            master.stop()
+            rc = master.wait(60)
+        report = master.status()
+        report["history"] = master.history
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+        print(json.dumps({k: report[k] for k in
+                          ("phase", "desired", "live_replicas",
+                           "replaced_total", "scale_events",
+                           "lost_hosts")}, default=str))
+        return rc if rc is not None else 1
     master = PodMaster(
         worker, n_hosts=args.hosts, snapshot_root=args.snapshot_root,
         prefix=args.prefix, workdir=args.workdir, port=args.port,

@@ -10,6 +10,7 @@ import base64
 import json
 import math
 import os
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -1356,6 +1357,48 @@ class RESTfulAPI(Logger):
             raise ValueError("input shape %s incompatible with %s"
                              % (expect, self.input_shape))
         return x.reshape((len(x),) + self.input_shape)
+
+
+#: the fleet READY handshake: a replica process announces its bound
+#: port on stdout with this prefix, and whoever spawned it (a pod
+#: agent, tools/chaos_common.spawn_ready) reads the line to learn
+#: where to register it.  One spelling everywhere — the agent, the
+#: chaos harnesses and `--serve` must not drift.
+READY_LINE = "REPLICA_READY"
+
+
+def announce_ready(api, force=False, stream=None):
+    """Print the fleet READY handshake line for a started
+    :class:`RESTfulAPI` (``REPLICA_READY port=<p> pid=<pid>``).  By
+    default it only fires when ``VELES_TPU_REPLICA_ANNOUNCE`` is set
+    in the environment — the pod agent sets it on every replica it
+    spawns, so any serving command (``python -m veles_tpu ...
+    --serve 0``) becomes a fleet replica without a dedicated entry
+    point; pass ``force=True`` for dedicated replica entries.
+    Returns True iff the line was printed."""
+    if not force and not os.environ.get("VELES_TPU_REPLICA_ANNOUNCE"):
+        return False
+    print("%s port=%d pid=%d" % (READY_LINE, api.port, os.getpid()),
+          file=stream if stream is not None else sys.stdout,
+          flush=True)
+    return True
+
+
+def parse_ready_line(line):
+    """``{"port": int, "pid": int|None}`` for a READY handshake line,
+    or None when the line is not one (startup chatter is expected —
+    callers scan until the first match)."""
+    if not line or not line.lstrip().startswith(READY_LINE):
+        return None
+    out = {"port": None, "pid": None}
+    for tok in line.split():
+        for key in ("port", "pid"):
+            if tok.startswith(key + "="):
+                try:
+                    out[key] = int(tok.split("=", 1)[1])
+                except ValueError:
+                    pass
+    return out if out["port"] is not None else None
 
 
 def install_sigterm_drain(api, exit_code=0, grace_s=None,

@@ -168,6 +168,12 @@ class FleetRouter(Logger):
         self._thread = None
         self._health_wake = threading.Event()
         self._health_thread = None
+        self._next_probe = {}            # rid -> monotonic next-due ts
+        #: the fleet-manager block (ServeFleetMaster.note_fleet):
+        #: desired count, scale/replace totals — surfaced on /metrics
+        #: and /health next to the live registry
+        self._fleet = None
+        self._gauges = None
 
     # ----------------------------------------------------------- registry
     def register(self, url, api=None):
@@ -191,6 +197,7 @@ class FleetRouter(Logger):
             flight.record("serve.replica_up", replica=rep.rid,
                           url=url, registered=True)
             self.info("replica %d registered: %s", rep.rid, url)
+            self._export_fleet_gauges()
         else:
             # re-registration (e.g. a restarted replica announcing
             # itself): bring a down entry back into rotation — with
@@ -210,6 +217,7 @@ class FleetRouter(Logger):
                         rid = r.rid
                         break
             rep = self._replicas.pop(rid, None)
+            self._next_probe.pop(rid, None)
             if rep is not None:
                 for key in [k for k, v in self._sessions.items()
                             if v == rid]:
@@ -219,6 +227,7 @@ class FleetRouter(Logger):
         flight.record("serve.replica_down", replica=rep.rid,
                       url=rep.url, reason=reason)
         self.info("replica %d deregistered (%s)", rep.rid, reason)
+        self._export_fleet_gauges()
         return True
 
     def spawn_local(self, generator, n, input_shape=None, **engine_kw):
@@ -261,21 +270,61 @@ class FleetRouter(Logger):
         finally:
             conn.close()
 
+    #: golden-ratio fraction — consecutive rids land maximally spread
+    #: over the interval (low-discrepancy), and the offset never
+    #: depends on registration order or wall time (deterministic)
+    _PHASE_GOLDEN = 0.6180339887498949
+
+    @classmethod
+    def probe_phase(cls, rid, interval_s):
+        """Deterministic per-replica phase offset in ``(0,
+        interval_s)``: replica ``rid``'s health probes fire at
+        ``register + phase + k*interval`` instead of every replica
+        being probed in lockstep — at large N a synchronized probe
+        round is a thundering herd against the very replicas the
+        probes are supposed to protect.  Golden-ratio spacing keeps
+        any two rids' phases far apart, and the ``rid + 1`` shift
+        keeps every phase strictly positive — no replica's first
+        probe races its own registration (test-pinned in
+        tests/test_fleet.py)."""
+        return float(interval_s) * (((rid + 1) * cls._PHASE_GOLDEN)
+                                    % 1.0)
+
     def _health_loop(self):
+        # fine-grained scheduler tick: each replica keeps its OWN
+        # probe period (one probe per health interval, phase-offset by
+        # probe_phase), so detection latency stays <= one interval
+        # while N replicas are never probed in lockstep
+        tick = max(self.health_interval_s / 8.0, 0.002)
         while not self._closed:
-            self._health_wake.wait(self.health_interval_s)
+            self._health_wake.wait(tick)
             self._health_wake.clear()
             if self._closed:
                 return
+            now = time.monotonic()
+            due = []
             with self._lock:
-                reps = list(self._replicas.values())
+                for rep in self._replicas.values():
+                    nxt = self._next_probe.get(rep.rid)
+                    if nxt is None:
+                        # first probe lands within one phase (< one
+                        # interval) of registration
+                        nxt = now + self.probe_phase(
+                            rep.rid, self.health_interval_s)
+                        self._next_probe[rep.rid] = nxt
+                    if now >= nxt:
+                        self._next_probe[rep.rid] = \
+                            now + self.health_interval_s
+                        due.append(rep)
+            if not due:
+                continue
             # probe CONCURRENTLY: each probe is bounded by its socket
             # timeout, so one black-holed replica delays this round by
             # its own timeout at most — never head-of-line-blocking
             # detection of the replicas behind it
             threads = [threading.Thread(target=self._probe_one,
                                         args=(rep,), daemon=True)
-                       for rep in reps]
+                       for rep in due]
             for t in threads:
                 t.start()
             for t in threads:
@@ -322,6 +371,7 @@ class FleetRouter(Logger):
         flight.record("serve.replica_down", replica=rep.rid,
                       url=rep.url, reason=str(reason)[:200])
         self.warning("replica %d DOWN: %s", rep.rid, reason)
+        self._export_fleet_gauges()
 
     def _mark_up(self, rep):
         with self._lock:
@@ -332,6 +382,7 @@ class FleetRouter(Logger):
         flight.record("serve.replica_up", replica=rep.rid,
                       url=rep.url, was=prev)
         self.info("replica %d UP (was %s)", rep.rid, prev)
+        self._export_fleet_gauges()
 
     def _mark_draining(self, rep, reason):
         with self._lock:
@@ -342,6 +393,7 @@ class FleetRouter(Logger):
         flight.record("serve.drain", replica=rep.rid, url=rep.url,
                       reason=str(reason))
         self.info("replica %d draining: %s", rep.rid, reason)
+        self._export_fleet_gauges()
 
     def drain_replica(self, rid):
         """Admin drain: tell the replica to stop admitting and finish
@@ -699,26 +751,130 @@ class FleetRouter(Logger):
         except Exception as e:  # noqa: BLE001 — dead client socket
             raise _ClientGone() from e
 
+    # -------------------------------------------------- fleet observability
+    def _export_fleet_gauges(self):
+        """The PR 3 MetricsRegistry surface of the fleet: replica
+        count, the manager's desired count, and scale/replace totals
+        (``veles_fleet_*``) — rendered on every ``/metrics``-style
+        Prometheus endpoint process-wide.  Fail-soft: telemetry must
+        never take the router down."""
+        try:
+            from veles_tpu import telemetry
+            if self._gauges is None:
+                self._gauges = {
+                    "replicas": telemetry.registry.gauge(
+                        "veles_fleet_replicas",
+                        "registered serving replicas",
+                        labelnames=("state",)),
+                    "desired": telemetry.registry.gauge(
+                        "veles_fleet_desired",
+                        "fleet manager's desired replica count"),
+                    "scaled": telemetry.registry.counter(
+                        "veles_fleet_scale_events_total",
+                        "autoscaler decisions executed",
+                        labelnames=("direction",)),
+                    "replaced": telemetry.registry.counter(
+                        "veles_fleet_replaced_total",
+                        "replicas replaced after a crash or host "
+                        "death"),
+                }
+            states = {s: 0 for s in (Replica.UP, Replica.DRAINING,
+                                     Replica.DOWN)}
+            with self._lock:
+                fleet = dict(self._fleet or {})
+                for rep in self._replicas.values():
+                    states[rep.state] = states.get(rep.state, 0) + 1
+            for state, n in states.items():
+                self._gauges["replicas"].set(n, state=state)
+            if fleet.get("desired") is not None:
+                self._gauges["desired"].set(fleet["desired"])
+        except Exception:   # noqa: BLE001 — fail-soft
+            pass
+
+    def note_fleet(self, **fields):
+        """The fleet manager's status block (desired count, hosts,
+        lost hosts, scale/replace counters...) — merged into
+        ``/metrics`` and ``/health`` so one probe of the router
+        answers "what does the manager WANT vs what is live"."""
+        with self._lock:
+            self._fleet = dict(self._fleet or {}, **fields)
+        self._export_fleet_gauges()
+
+    def fleet_event(self, kind, direction=None):
+        """Account one manager action on the fleet counters:
+        ``kind`` is ``"scale"`` (with direction ``"up"``/``"down"``)
+        or ``"replace"``."""
+        self._export_fleet_gauges()   # ensure instruments exist
+        try:
+            if self._gauges is None:
+                return
+            if kind == "scale":
+                self._gauges["scaled"].inc(
+                    direction=direction or "up")
+            elif kind == "replace":
+                self._gauges["replaced"].inc()
+        except Exception:   # noqa: BLE001 — fail-soft
+            pass
+
+    def fleet_signals(self):
+        """The autoscaler's input, aggregated from the health probes
+        already flowing: the WORST measured queue-wait overshoot any
+        replica reports (``SloShedder.overshoot`` via ``/health``),
+        the fleet-wide shed total (replica ``serve.shed`` rejections
+        plus the router's own all-shed 503s), and whether any replica
+        still holds queued/in-flight work (the idle signal for
+        scale-down)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+            shed_total = int(self._counters["shed_rejects"])
+        overshoot, busy, live = 0.0, False, 0
+        for rep in reps:
+            if rep.state == Replica.UP:
+                live += 1
+            h = rep.last_health or {}
+            serving = h.get("serving") or {}
+            try:
+                overshoot = max(overshoot,
+                                float(serving.get("overshoot") or 0.0))
+            except (TypeError, ValueError):
+                pass
+            try:
+                shed_total += int(serving.get("shed_total") or 0)
+            except (TypeError, ValueError):
+                pass
+            if h.get("queued") or h.get("in_flight"):
+                busy = True
+        return {"overshoot": overshoot, "shed_total": shed_total,
+                "busy": busy, "live": live}
+
     # ------------------------------------------------------------ metrics
     def metrics(self):
         with self._lock:
             counters = dict(self._counters)
             sessions = len(self._sessions)
+            fleet = dict(self._fleet) if self._fleet else None
         reps = self.replicas()
         states = {}
         for rep in reps.values():
             states[rep["state"]] = states.get(rep["state"], 0) + 1
-        return {"replicas": reps, "states": states,
-                "sessions": sessions, "counters": counters,
-                "affinity": self.affinity,
-                "retry_max": self.retry_max,
-                "health_interval_ms": self.health_interval_s * 1e3}
+        out = {"replicas": reps, "states": states,
+               "sessions": sessions, "counters": counters,
+               "affinity": self.affinity,
+               "retry_max": self.retry_max,
+               "health_interval_ms": self.health_interval_s * 1e3}
+        if fleet is not None:
+            out["fleet"] = fleet
+        return out
 
     def fleet_health(self):
         reps = self.replicas()
         live = sum(1 for r in reps.values() if r["state"] == "up")
-        return {"state": "serving" if live else "unavailable",
-                "live_replicas": live, "replicas": reps}
+        out = {"state": "serving" if live else "unavailable",
+               "live_replicas": live, "replicas": reps}
+        with self._lock:
+            if self._fleet:
+                out["fleet"] = dict(self._fleet)
+        return out
 
     # ------------------------------------------------------------- server
     def start(self):

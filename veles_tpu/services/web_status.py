@@ -539,6 +539,18 @@ class WebStatusServer(Logger):
                     "lost_hosts": pod.get("lost_hosts") or []}
         except Exception:   # noqa: BLE001 — the probe must answer
             pass
+        try:
+            # fleet-membership block (env threaded in by the pod
+            # agent, services.podmaster ServeFleetMaster): probing a
+            # replica's dashboard answers "which fleet slot is this"
+            host = os.environ.get("VELES_TPU_FLEET_HOST")
+            rep = os.environ.get("VELES_TPU_FLEET_REP")
+            if host is not None or rep is not None:
+                state["fleet"] = {
+                    "host": None if host is None else int(host),
+                    "replica": None if rep is None else int(rep)}
+        except Exception:   # noqa: BLE001 — the probe must answer
+            pass
         return state
 
     def status(self):
