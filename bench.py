@@ -868,52 +868,72 @@ def phase_servecont():
 
 
 def phase_flashtune():
-    """Block-size sweep for the flash kernel with the chained in-jit
-    harness — NOT in the default phase list; run manually on hardware
-    (``python bench.py --phase flashtune``) and bake the winner into
-    flash_attention's defaults."""
-    import jax
-    import jax.numpy as jnp
-    from veles_tpu.ops.pallas.flash import flash_attention
+    """Block-size sweep for the flash kernels — DELEGATED to the kernel
+    autotuner (veles_tpu.tuner): the forward and the SPLIT dq/dkv
+    backward grids are swept independently (the backward used to be
+    yoked to the forward's geometry — BENCH_r05's 1.7x-slower-than-XLA
+    backward was exactly that), every candidate passes the VP6xx
+    tile/VMEM audit before it may win, and winners persist in the tuner
+    cache — the next TPU window's launches pick them up at
+    ``tuner.lookup`` time with no bake step.  NOT in the default phase
+    list; run manually on hardware (``python bench.py --phase
+    flashtune``).  The legacy ``t{T}_q{bq}_k{bk}`` grid keys are still
+    emitted (now with per-config dq/dkv backward timings alongside the
+    forward) for watcher logs and tools/bake_flashtune.py."""
+    from veles_tpu import tuner as tn
+    from veles_tpu.tuner import sweeps
 
-    # model-ranked order (tools/cost_model.py), OUTER loop — both T
-    # shapes of the predicted-best config are measured before the
-    # ranking descends, so a tunnel that dies mid-sweep costs the
-    # predicted-worst configs, not a whole T shape
-    try:
-        from tools.cost_model import predict_flashtune_order
-        order = [tuple(c) for c in predict_flashtune_order()]
-    except Exception:  # noqa: BLE001 — ranking is advisory
-        order = [(bq, bk) for bq in (512, 256, 128)
-                 for bk in (512, 256, 128)]
+    tuner = tn.get_tuner()
+    results = sweeps.sweep_flash(
+        tuner, ts=(1024, 8192), d=128, kinds=sweeps.FLASH_KINDS,
+        iters=8, repeats=3, warmup=1, log=_log,
+        source="bench-flashtune")
 
-    key = jax.random.key(0)
-    inputs = {}
-    for t in (1024, 8192):
-        b, h, d = (4, 8, 128) if t == 1024 else (1, 8, 128)
-        inputs[t] = tuple(
-            jax.random.normal(kk, (b, h, t, d), jnp.bfloat16) * 0.1
-            for kk in jax.random.split(key, 3)) + (
-                _causal_attn_flops(b, h, t, d),)
-    grid = {}
-    for bq, bk in order:
-        for t in (1024, 8192):
-            q, k, v, flops = inputs[t]
-            fn = lambda q_, k_, v_: flash_attention(  # noqa: E731
-                q_, k_, v_, causal=True, block_q=bq, block_k=bk)
-            try:
-                ms = _chain_attn(fn, q, k, v, iters=10)
-                ms_bwd = _chain_attn(fn, q, k, v, iters=5, grad=True)
-            except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
-                _log("T=%d bq=%d bk=%d: failed (%s)"
-                     % (t, bq, bk, type(e).__name__))
+    # flatten the per-kernel sweeps back into the legacy grid: one
+    # entry per (T, bq, bk) carrying fwd ms + the isolated dq/dkv
+    # kernel timings.  Each backward measurement runs its forward at
+    # the PINNED geometry (flash_measure passes only the candidate's
+    # bwd blocks — constant across candidates, that is the isolation),
+    # so the reconstructed fwd+bwd at this row is
+    #   ms + (ms_dq - F_pin) + (ms_dkv - F_pin)
+    # with F_pin = the measured forward at the pinned geometry;
+    # ms_bwd is omitted when that row failed (no honest number exists)
+    from veles_tpu.ops.pallas.flash import _resolve_blocks
+    per = {}
+    for (kind, t), res in results.items():
+        for row in res.candidates:
+            if row.get("ms") is None:
                 continue
-            grid["t%d_q%d_k%d" % (t, bq, bk)] = {
-                "ms": round(ms, 3), "ms_bwd": round(ms_bwd, 3),
-                "tf": round(flops / (ms / 1e3) / 1e12, 1)}
-            _log("T=%d bq=%-3d bk=%-3d: fwd %.3f ms (%.1f TF/s) "
-                 "fwd+bwd %.3f ms"
-                 % (t, bq, bk, ms, flops / (ms / 1e3) / 1e12, ms_bwd))
+            cfg = row["config"]
+            per.setdefault((t, cfg["block_q"], cfg["block_k"]),
+                           {})[kind] = row["ms"]
+    grid = {}
+    for (t, bq, bk), kinds in sorted(per.items()):
+        if "fwd" not in kinds:
+            continue
+        b, h, d = (4, 8, 128) if t == 1024 else (1, 8, 128)
+        flops = _causal_attn_flops(b, h, t, d)
+        ms = kinds["fwd"]
+        entry = {"ms": round(ms, 3),
+                 "tf": round(flops / (ms / 1e3) / 1e12, 1)}
+        pin_q, pin_k = _resolve_blocks(t, t, d, "bfloat16")[:2]
+        f_pin = per.get((t, min(pin_q, -(-t // 128) * 128),
+                         min(pin_k, -(-t // 128) * 128)),
+                        {}).get("fwd")
+        if "bwd_dq" in kinds and "bwd_dkv" in kinds:
+            entry["ms_dq"] = round(kinds["bwd_dq"], 3)
+            entry["ms_dkv"] = round(kinds["bwd_dkv"], 3)
+            if f_pin is not None:
+                entry["ms_bwd"] = round(
+                    max(ms, ms + (kinds["bwd_dq"] - f_pin)
+                        + (kinds["bwd_dkv"] - f_pin)), 3)
+        grid["t%d_q%d_k%d" % (t, bq, bk)] = entry
+    for (kind, t), res in sorted(results.items()):
+        if res.winner:
+            grid["winner_%s_t%d" % (kind, t)] = {
+                "config": res.winner["config"],
+                "ms": round(res.winner["ms"], 3),
+                "audit_rejected": len(res.audit_rejected)}
     return grid
 
 

@@ -39,6 +39,14 @@ GEOMETRIES = [
     (129, 32, 16, True, 32),
     (65, 16, 32, True, 8),
     (31, 32, 32, True, 5),     # single padded block
+    # the tuner's split backward grids: dq and dkv run the SAME
+    # helpers at their own (block_q, block_k) pairs, decoupled from
+    # the forward — strongly asymmetric pairs over odd T must still
+    # cover the band (a liveness bug here is a silently wrong dq/dkv)
+    (67, 8, 64, True, None),   # dq-style: wide k per q tile
+    (67, 64, 8, True, None),   # dkv-style: wide q per k tile
+    (193, 16, 128, True, 24),
+    (193, 128, 16, True, 24),
 ]
 
 

@@ -1,0 +1,628 @@
+"""Kernel autotuner (veles_tpu/tuner): winner cache robustness, the
+VP6xx audit gate, shape-bucket/mesh keying, launch-path resolution, and
+the split dq/dkv backward geometries the tuner exists to search.
+
+The acceptance-pinned contracts:
+
+* an over-VMEM candidate can NEVER win, even with the best measured
+  time (the audit gate runs before timing can matter);
+* winners persist across processes keyed by (kernel, shape-bucket,
+  dtype, mesh);
+* ``mesh.refit`` invalidates mesh-keyed winners so degraded pods
+  re-tune instead of inheriting full-size configs;
+* flash fwd / the split dq/dkv backward kernels / fused paged decode
+  all resolve blocks through ``tuner.lookup`` at launch, with config
+  overrides still winning.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu import tuner as tn
+from veles_tpu.tuner import sweeps
+from veles_tpu.tuner.cache import WinnerCache, validate_entry
+
+
+def _mk(tmp_path, **kwargs):
+    return tn.KernelTuner(path=str(tmp_path / "winners.json"), **kwargs)
+
+
+@pytest.fixture
+def global_tuner(tmp_path, monkeypatch):
+    """Point the process-global tuner (the launch paths' lookup) at a
+    fresh tmp cache; restore the pristine global afterwards."""
+    monkeypatch.setenv("VELES_TUNE_CACHE",
+                       str(tmp_path / "global" / "winners.json"))
+    tn.reset()
+    tn.set_ambient_mesh(None)
+    yield tn.get_tuner()
+    tn.reset()
+    tn.set_ambient_mesh(None)
+
+
+# --------------------------------------------------------------------------
+# winner cache
+# --------------------------------------------------------------------------
+
+class TestWinnerCache:
+    def test_roundtrip_across_instances(self, tmp_path):
+        """Winners persist across processes: a second tuner on the same
+        path (a fresh process, as far as the cache can tell) serves the
+        first one's winner."""
+        t1 = _mk(tmp_path)
+        t1.record("flash.fwd", "t1024_d128", "bfloat16",
+                  {"block_q": 256, "block_k": 128}, 1.5, mesh="tpu:4")
+        t2 = _mk(tmp_path)
+        got = t2.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                        mesh="tpu:4")
+        assert got == {"block_q": 256, "block_k": 128}
+        # a different mesh key is a different winner slot
+        assert t2.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                         mesh="tpu:8") is None
+
+    def test_corrupt_entry_quarantined_never_served(self, tmp_path):
+        path = tmp_path / "winners.json"
+        good = {"config": {"block_q": 128}, "ms": 1.0,
+                "kernel": "flash.fwd"}
+        path.write_text(json.dumps({"version": 1, "winners": {
+            "flash.fwd|t128_d64|bfloat16|cpu:1": good,
+            "flash.fwd|t256_d64|bfloat16|cpu:1":
+                {"config": {"block_q": "not-an-int"}, "ms": 1.0},
+            "flash.fwd|t512_d64|bfloat16|cpu:1":
+                {"config": {}, "ms": float("nan")},
+            "flash.fwd|t999_d64|bfloat16|cpu:1": "just a string",
+        }}))
+        cache = WinnerCache(str(path))
+        assert cache.get("flash.fwd|t128_d64|bfloat16|cpu:1") == good
+        for bad in ("t256", "t512", "t999"):
+            key = [k for k in cache.quarantined() if bad in k]
+            assert key, "corrupt %s entry not quarantined" % bad
+            assert cache.get(key[0]) is None
+        # quarantine survives a save (forensics, still never served)
+        cache.put("new|k|bf16|cpu", {"config": {"b": 1}, "ms": 2.0})
+        reloaded = WinnerCache(str(path))
+        assert len(reloaded.quarantined()) == 3
+        assert reloaded.get("flash.fwd|t256_d64|bfloat16|cpu:1") is None
+
+    def test_corrupt_file_moved_aside(self, tmp_path):
+        path = tmp_path / "winners.json"
+        path.write_text("{ this is not json")
+        cache = WinnerCache(str(path))
+        assert len(cache) == 0
+        assert os.path.exists(str(path) + ".corrupt")
+        # and the cache is usable again
+        cache.put("k|s|d|m", {"config": {"b": 8}, "ms": 3.0})
+        assert WinnerCache(str(path)).get("k|s|d|m")["ms"] == 3.0
+
+    def test_validate_entry(self):
+        assert validate_entry({"config": {"block_q": 128}, "ms": 1.0})
+        assert validate_entry({"config": {"block_q": "128"}, "ms": 1})
+        assert not validate_entry({"config": {}, "ms": 1.0})
+        assert not validate_entry({"ms": 1.0})
+        assert not validate_entry({"config": {"b": 1}, "ms": "fast"})
+        assert not validate_entry({"config": {"b": 1},
+                                   "ms": float("inf")})
+        assert not validate_entry([1, 2, 3])
+
+    def test_memory_only_mode(self):
+        cache = WinnerCache(None)
+        cache.put("k|s|d|m", {"config": {"b": 8}, "ms": 3.0})
+        assert cache.get("k|s|d|m")["config"] == {"b": 8}
+
+    def test_concurrent_writers_merge_not_clobber(self, tmp_path):
+        """Two tuner processes sharing the cache (e.g. a flash sweep
+        and a paged sweep on the same TPU window): each loads once,
+        then whole-file saves must MERGE the other's recordings, not
+        clobber them — and a deliberate removal must stay removed."""
+        path = str(tmp_path / "winners.json")
+        a, b = WinnerCache(path), WinnerCache(path)
+        a.put("flash|t1|bf16|m", {"config": {"block_q": 1}, "ms": 1.0})
+        b.put("paged|h1|bf16|m", {"config": {"block": 8}, "ms": 2.0})
+        a.put("flash|t2|bf16|m", {"config": {"block_q": 2}, "ms": 3.0})
+        fresh = WinnerCache(path)
+        assert set(fresh.items()) == {"flash|t1|bf16|m",
+                                      "paged|h1|bf16|m",
+                                      "flash|t2|bf16|m"}
+        # a removal in one instance survives its later saves even
+        # though the other instance's file still holds the key
+        a.remove(lambda k, e: k.startswith("paged|"))
+        a.put("flash|t3|bf16|m", {"config": {"block_q": 4}, "ms": 4.0})
+        assert "paged|h1|bf16|m" not in WinnerCache(path).items()
+
+
+# --------------------------------------------------------------------------
+# the audit gate
+# --------------------------------------------------------------------------
+
+def _flash_launches(block_q, block_k, t=8192):
+    from veles_tpu.ops.pallas import flash
+    return flash.audit_launch(t, t, 128, causal=True, block_q=block_q,
+                              block_k=block_k, kernels=("forward",))
+
+
+class TestAuditGate:
+    def test_overvmem_candidate_with_best_time_never_wins(self,
+                                                          tmp_path):
+        """THE acceptance pin: a candidate whose launch blows the VMEM
+        budget is rejected by the VP6xx audit before measurement can
+        crown it — plant it with a measured time 100x better than the
+        legal candidate and it still loses."""
+        tuner = _mk(tmp_path)
+        times = {4096: 0.001, 128: 0.1}   # over-VMEM "measures" 100x faster
+
+        cands = [
+            {"config": {"block_q": 4096, "block_k": 4096},
+             "launches": _flash_launches(4096, 4096)},
+            {"config": {"block_q": 128, "block_k": 128},
+             "launches": _flash_launches(128, 128)},
+        ]
+        res = tuner.sweep("flash.fwd", "t8192_d128", "bfloat16", cands,
+                          lambda cfg: times[cfg["block_q"]],
+                          repeats=2, warmup=1)
+        assert res.winner["config"] == {"block_q": 128, "block_k": 128}
+        verdicts = {c["config"]["block_q"]: c["verdict"]
+                    for c in res.candidates}
+        assert verdicts[4096] == "audit_rejected"
+        assert any("VP602" in f for c in res.audit_rejected
+                   for f in c["findings"])
+        # and the persisted winner is the audited one
+        assert _mk(tmp_path).lookup(
+            "flash.fwd", "t8192_d128", "bfloat16")["block_q"] == 128
+
+    def test_record_refuses_unaudited_config(self, tmp_path):
+        tuner = _mk(tmp_path)
+        with pytest.raises(ValueError, match="VP6xx"):
+            tuner.record("flash.fwd", "t8192_d128", "bfloat16",
+                         {"block_q": 4096, "block_k": 4096}, 0.001,
+                         launches=_flash_launches(4096, 4096))
+        assert tuner.lookup("flash.fwd", "t8192_d128",
+                            "bfloat16") is None
+
+    def test_all_rejected_means_no_winner(self, tmp_path):
+        tuner = _mk(tmp_path, vmem_kib=1)   # nothing fits 1 KiB
+        res = tuner.sweep(
+            "flash.fwd", "t128_d64", "bfloat16",
+            [{"config": {"block_q": 128, "block_k": 128},
+              "launches": _flash_launches(128, 128, t=128)}],
+            lambda cfg: 0.001, repeats=1, warmup=0)
+        assert res.winner is None
+        assert len(res.audit_rejected) == 1
+
+    def test_repeats_clamped_to_one(self, tmp_path):
+        """--repeats 0 must not crash median([]) after the warm-ups
+        already ran — it clamps to one sample."""
+        tuner = _mk(tmp_path)
+        res = tuner.sweep(
+            "flash.fwd", "t128_d64", "bfloat16",
+            [{"config": {"block_q": 128, "block_k": 128},
+              "launches": _flash_launches(128, 128, t=128)}],
+            lambda cfg: 0.002, repeats=0, warmup=0)
+        assert res.winner is not None
+
+    def test_failed_measurement_is_not_a_winner(self, tmp_path):
+        tuner = _mk(tmp_path)
+
+        def measure(cfg):
+            if cfg["block_q"] == 256:
+                raise RuntimeError("VMEM overflow on chip")
+            return 0.01
+        res = tuner.sweep(
+            "flash.fwd", "t128_d64", "bfloat16",
+            [{"config": {"block_q": 256, "block_k": 128},
+              "launches": _flash_launches(256, 128, t=256)},
+             {"config": {"block_q": 128, "block_k": 128},
+              "launches": _flash_launches(128, 128, t=256)}],
+            measure, repeats=1, warmup=0)
+        assert res.winner["config"]["block_q"] == 128
+        verdicts = {c["config"]["block_q"]: c["verdict"]
+                    for c in res.candidates}
+        assert verdicts[256] == "failed"
+
+
+# --------------------------------------------------------------------------
+# keying: shape buckets + mesh
+# --------------------------------------------------------------------------
+
+class TestKeying:
+    def test_shape_bucket_pow2(self):
+        assert tn.flash_shape_key(1000, 128) == "t1024_d128"
+        assert tn.flash_shape_key(1024, 128) == "t1024_d128"
+        assert tn.flash_shape_key(1025, 64) == "t2048_d64"
+        assert tn.flash_shape_key(7, 64) == "t128_d64"   # floor
+
+    def test_bucketed_lookup_shares_winner(self, tmp_path):
+        tuner = _mk(tmp_path)
+        tuner.record("flash.fwd", tn.flash_shape_key(1024, 128),
+                     "bfloat16", {"block_q": 256, "block_k": 256}, 2.0)
+        # a ragged T in the same bucket hits ...
+        assert tuner.lookup("flash.fwd", tn.flash_shape_key(1000, 128),
+                            "bfloat16")["block_q"] == 256
+        # ... the next bucket (and another dtype) miss
+        assert tuner.lookup("flash.fwd", tn.flash_shape_key(2048, 128),
+                            "bfloat16") is None
+        assert tuner.lookup("flash.fwd", tn.flash_shape_key(1024, 128),
+                            "float32") is None
+
+    def test_mesh_descriptor_axes(self):
+        assert tn.mesh_descriptor("tpu:v4:8") == "tpu:v4:8"
+        # explicit axes key by the TOPOLOGY's device total + axes
+        d = tn.mesh_descriptor({"data": 4, "model": 2})
+        assert d.endswith(":8/data4xmodel2")
+        # the default (launch-time AND sweep-time) key carries no axes
+        # even while the launcher has an ambient mesh registered — a
+        # CLI-swept winner must be reachable from a launcher run
+        tn.set_ambient_mesh({"data": 4})
+        try:
+            assert "/" not in tn.mesh_descriptor()
+        finally:
+            tn.set_ambient_mesh(None)
+
+    def test_mesh_refit_invalidates_configured_entries(self, tmp_path):
+        """PR 10's elastic resize: winners tuned at the configured
+        (full) topology are dropped on refit, and subsequent ambient
+        lookups key to the fitted topology — a degraded pod re-tunes
+        instead of inheriting full-size configs."""
+        tuner = _mk(tmp_path)
+        full, degraded = {"data": 4}, {"data": 3}
+        tuner.record("flash.fwd", "t1024_d128", "bfloat16",
+                     {"block_q": 512, "block_k": 512}, 1.0, mesh=full)
+        tuner.record("flash.fwd", "t1024_d128", "bfloat16",
+                     {"block_q": 128, "block_k": 128}, 9.9,
+                     mesh="other:topology")
+        assert tuner.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                            mesh=full) is not None
+
+        gone = tuner.invalidate_mesh(full)
+        assert len(gone) == 1
+        assert tuner.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                            mesh=full) is None
+        assert tuner.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                            mesh=degraded) is None
+        # the unrelated topology's winner survives
+        assert tuner.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                            mesh="other:topology") is not None
+
+    def test_on_mesh_refit_invalidates_both_key_forms(self,
+                                                      global_tuner):
+        full, degraded = {"data": 4}, {"data": 3}
+        # explicit (axes-form) recording, e.g. a pod tool's
+        global_tuner.record("flash.fwd", "t1024_d128", "bfloat16",
+                            {"block_q": 512, "block_k": 512}, 1.0,
+                            mesh=full)
+        # launch-time recordings carry the bare backend:count form at
+        # the CONFIGURED (full) device total — simulate one
+        bare_full = tn.mesh_descriptor(full).split("/", 1)[0]
+        global_tuner.record("flash.fwd", "t1024_d128", "bfloat16",
+                            {"block_q": 256, "block_k": 256}, 1.0,
+                            mesh=bare_full)
+        tn.on_mesh_refit(full, degraded)
+        # BOTH full-size entries are gone (the live device count has
+        # already shrunk when the hook fires, so the invalidation must
+        # key off the configured topology, not the live backend)
+        assert global_tuner.lookup("flash.fwd", "t1024_d128",
+                                   "bfloat16", mesh=full) is None
+        assert global_tuner.lookup("flash.fwd", "t1024_d128",
+                                   "bfloat16", mesh=bare_full) is None
+        assert tn.ambient_axes() == degraded
+        # a wildcard configured topology has no knowable pre-refit
+        # device total: nothing is invalidated (the launcher never
+        # refits one — fitted == configured there), ambient re-keys
+        global_tuner.record("flash.fwd", "t1024_d128", "bfloat16",
+                            {"block_q": 128, "block_k": 128}, 1.0,
+                            mesh="cpu:8")
+        assert tn.on_mesh_refit({"data": -1}, {"data": 2}) == []
+        assert global_tuner.lookup("flash.fwd", "t1024_d128",
+                                   "bfloat16", mesh="cpu:8") is not None
+
+
+# --------------------------------------------------------------------------
+# launch-path resolution (flash + paged)
+# --------------------------------------------------------------------------
+
+class TestLaunchResolution:
+    def test_flash_bwd_blocks_resolve_tuner_winner(self, global_tuner):
+        from veles_tpu.ops.pallas import flash
+        key = tn.flash_shape_key(256, 128)
+        global_tuner.record("flash.bwd_dq", key, "bfloat16",
+                            {"block_q": 64, "block_k": 128}, 1.0)
+        global_tuner.record("flash.bwd_dkv", key, "bfloat16",
+                            {"block_q": 128, "block_k": 64}, 1.0)
+        blocks = flash._resolve_blocks(256, 256, 128, jnp.bfloat16)
+        assert blocks[2:] == (64, 128, 128, 64)
+        # deterministic under interpret mode: same key, same answer
+        assert flash._resolve_blocks(256, 256, 128,
+                                     jnp.bfloat16) == blocks
+
+    def test_cross_attention_dkv_keys_by_tk(self, global_tuner):
+        """In cross-attention (tq != tk) the dkv grid walks the KEY
+        axis, so its winner comes from the tk bucket while fwd/dq key
+        by tq."""
+        from veles_tpu.ops.pallas import flash
+        global_tuner.record("flash.bwd_dq",
+                            tn.flash_shape_key(128, 128), "bfloat16",
+                            {"block_q": 32, "block_k": 64}, 1.0)
+        global_tuner.record("flash.bwd_dkv",
+                            tn.flash_shape_key(8192, 128), "bfloat16",
+                            {"block_q": 64, "block_k": 512}, 1.0)
+        blocks = flash._resolve_blocks(128, 8192, 128, jnp.bfloat16)
+        assert blocks[2:4] == (32, 64)       # dq: tq bucket
+        assert blocks[4:6] == (64, 512)      # dkv: tk bucket
+
+    def test_block_g_config_grammar_never_raises(self, global_tuner):
+        """serve.paged_block_g with a non-int value (natural by
+        analogy with paged_block="auto") falls through to the tuner —
+        the audit hook and every decode trace reach this."""
+        from veles_tpu.config import root
+        from veles_tpu.ops.pallas import paged
+        global_tuner.record("paged.decode", tn.paged_shape_key(64, 1),
+                            "float32", {"block": 16, "block_g": 32},
+                            1.0)
+        for val in ("auto", "", "off"):
+            root.common.serve.paged_block_g = val
+            try:
+                assert paged._resolve_block_g(
+                    1, 64, jnp.float32) == 32, val
+            finally:
+                del root.common.serve.paged_block_g
+
+    def test_explicit_and_config_beat_tuner(self, global_tuner):
+        from veles_tpu.config import root
+        from veles_tpu.ops.pallas import flash
+        key = tn.flash_shape_key(256, 128)
+        global_tuner.record("flash.bwd_dq", key, "bfloat16",
+                            {"block_q": 64, "block_k": 64}, 1.0)
+        # explicit argument
+        blocks = flash._resolve_blocks(256, 256, 128, jnp.bfloat16,
+                                       block_q_dq=32)
+        assert blocks[2] == 32
+        # site config
+        root.common.engine.flash.block_q_dq = 16
+        try:
+            blocks = flash._resolve_blocks(256, 256, 128, jnp.bfloat16)
+            assert blocks[2] == 16
+        finally:
+            del root.common.engine.flash.block_q_dq
+
+    def test_paged_pool_block_and_group_resolve(self, global_tuner):
+        from veles_tpu.config import root
+        from veles_tpu.ops.pallas import paged
+        global_tuner.record("paged.decode", tn.paged_shape_key(64, 1),
+                            "float32", {"block": 32, "block_g": 32},
+                            1.0)
+        assert paged.preferred_pool_block(64, 1, jnp.float32) == 32
+        # the serve grammar's non-pinning values ("auto"/-1, dense
+        # markers, garbage) must fall through to the tuner, never pin
+        # (or crash the audit hook) — ONE grammar with the engine
+        for val in ("auto", -1, "", "off", -2, "fast"):
+            root.common.serve.paged_block = val
+            try:
+                assert paged.preferred_pool_block(
+                    64, 1, jnp.float32) == 32, val
+            finally:
+                del root.common.serve.paged_block
+        root.common.serve.paged_block = 8
+        try:
+            assert paged.preferred_pool_block(64, 1, jnp.float32) == 8
+        finally:
+            del root.common.serve.paged_block
+        assert paged._resolve_block_g(1, 64, jnp.float32) == 32
+        # untuned shapes fall back to the current defaults
+        assert paged.preferred_pool_block(96, 1, jnp.float32) == 16
+        assert paged._resolve_block_g(1, 96, jnp.float32) == \
+            paged._MIN_G
+        # a tuned pad can never shrink below the real group / sublane
+        global_tuner.record("paged.decode", tn.paged_shape_key(64, 24),
+                            "float32", {"block": 16, "block_g": 8},
+                            1.0)
+        assert paged._resolve_block_g(24, 64, jnp.float32) == 24
+
+    def test_parse_paged_block_grammar(self):
+        """serve.paged_block: off / explicit block / "auto" (paged,
+        block through config > tuner > default) — the grammar that
+        makes a tuned pool block reachable from `--serve`."""
+        from veles_tpu.models.generate import parse_paged_block
+        assert parse_paged_block(0) == (False, None)
+        assert parse_paged_block("") == (False, None)
+        assert parse_paged_block(None) == (False, None)
+        assert parse_paged_block("off") == (False, None)
+        assert parse_paged_block(16) == (True, 16)
+        assert parse_paged_block("8") == (True, 8)
+        assert parse_paged_block("auto") == (True, None)
+        assert parse_paged_block(-1) == (True, None)
+
+    def test_flash_runs_with_tuned_bwd_winner(self, global_tuner):
+        """End to end under interpret mode: plant asymmetric dq/dkv
+        winners, run the fused backward through the normal launch
+        path, pin the gradients to the recompute oracle."""
+        from veles_tpu.ops.pallas import flash
+        key = tn.flash_shape_key(96, 32)
+        global_tuner.record("flash.bwd_dq", key, "float32",
+                            {"block_q": 64, "block_k": 16}, 1.0)
+        global_tuner.record("flash.bwd_dkv", key, "float32",
+                            {"block_q": 16, "block_k": 64}, 1.0)
+        k0 = jax.random.key(0)
+        q, k, v = (jax.random.normal(kk, (1, 2, 96, 32)) * 0.5
+                   for kk in jax.random.split(k0, 3))
+
+        def loss(fn):
+            return lambda *a: jnp.sum(fn(*a) ** 2)
+        g_tuned = jax.grad(loss(lambda q, k, v: flash.flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32,
+            interpret=True)), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(lambda q, k, v: flash.flash_attention(
+            q, k, v, causal=True, backward="recompute",
+            interpret=True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_tuned, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+
+# --------------------------------------------------------------------------
+# split dq/dkv geometry regression (odd T, blocks straddling the tail)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("t", [67, 129, 193])
+@pytest.mark.parametrize("bwd_blocks", [
+    (32, 64, 64, 32),      # dq wide-k, dkv wide-q
+    (64, 16, 16, 64),      # extreme asymmetry
+])
+def test_split_bwd_geometry_odd_t(t, bwd_blocks):
+    """The new independent dq/dkv grids over ragged T: every (block_q,
+    block_k) pairing must mask its tail exactly — fused gradients match
+    the recompute oracle bit-for-tolerance, the same `_block_live`
+    contract the forward liveness suite pins, now per backward grid."""
+    from veles_tpu.ops import attention as att
+    bq_dq, bk_dq, bq_dkv, bk_dkv = bwd_blocks
+    k0 = jax.random.key(t)
+    q, k, v = (jax.random.normal(kk, (1, 2, t, 16)) * 0.5
+               for kk in jax.random.split(k0, 3))
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a) ** 2)
+    g_split = jax.grad(loss(lambda q, k, v: att.flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32,
+        block_q_dq=bq_dq, block_k_dq=bk_dq, block_q_dkv=bq_dkv,
+        block_k_dkv=bk_dkv, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(lambda q, k, v: att.flash_attention(
+        q, k, v, causal=True, backward="recompute", interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_split, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4)
+
+
+# --------------------------------------------------------------------------
+# sweeps + telemetry + CLI (in-process)
+# --------------------------------------------------------------------------
+
+class TestSweepsAndCli:
+    def test_interpret_sweep_populates_cache_deterministically(
+            self, tmp_path):
+        """The CI tune-smoke contract in miniature: an interpret-mode
+        sweep on tiny shapes produces an audited winner, persists it,
+        and lookups serve it deterministically."""
+        tuner = _mk(tmp_path)
+        res = sweeps.sweep_flash(tuner, ts=(128,), d=64,
+                                 kinds=("fwd",), iters=1, repeats=2,
+                                 warmup=1, interpret=True)
+        r = res[("fwd", 128)]
+        assert r.winner is not None
+        assert not r.audit_rejected
+        got = [_mk(tmp_path).lookup("flash.fwd",
+                                    tn.flash_shape_key(128, 64),
+                                    "bfloat16") for _ in range(2)]
+        assert got[0] == got[1] == r.winner["config"]
+
+    def test_candidate_grids(self):
+        # d=128: the flashtune grid; d<=64 widens to 1024 blocks
+        c128 = sweeps.flash_candidates("fwd", 8192, 128)
+        assert {tuple(sorted(c["config"].values())) for c in c128} == {
+            (128, 128), (128, 256), (128, 512), (256, 256),
+            (256, 512), (512, 512)}
+        c64 = sweeps.flash_candidates("bwd_dq", 8192, 64)
+        assert any(c["config"]["block_q"] == 1024 for c in c64)
+        # every candidate audits the kernel it tunes, nothing else
+        assert all(len(c["launches"]) == 1
+                   and c["launches"][0]["kernel"] == "flash.bwd_dq"
+                   for c in c64)
+        # blocks never exceed the padded sequence length
+        tiny = sweeps.flash_candidates("fwd", 128, 64)
+        assert all(max(c["config"].values()) <= 128 for c in tiny)
+
+    def test_lookup_flight_events_and_gauge(self, tmp_path):
+        from veles_tpu import telemetry
+        tuner = _mk(tmp_path)
+        # unique shape keys: the bounded flight ring may be full of
+        # other suites' events, so match OURS by key, not by position
+        shape = "t128_d64_tunertest%d" % os.getpid()
+        tuner.record("flash.fwd", shape, "bfloat16",
+                     {"block_q": 128, "block_k": 128}, 1.0)
+        tuner.lookup("flash.fwd", shape, "bfloat16")
+        tuner.lookup("flash.fwd", shape + "_absent", "bfloat16")
+        kinds = {e["kind"] for e in telemetry.flight.recorder.snapshot()
+                 if shape in str(e.get("key", ""))}
+        assert "tune.hit" in kinds and "tune.miss" in kinds
+        gauges = {m.name: m for m in telemetry.registry.metrics()}
+        assert "veles_tune_winners" in gauges
+        assert "veles_tune_lookups_total" in gauges
+
+    def test_cli_sweep_list_clear(self, tmp_path, capsys):
+        from veles_tpu.tuner import cli
+        cache = str(tmp_path / "winners.json")
+        report = str(tmp_path / "report.json")
+        rc = cli.main(["--cache", cache, "sweep", "--tiny",
+                       "--kernels", "flash.fwd", "--json", report])
+        assert rc == 0
+        rep = json.load(open(report))
+        assert rep["sweeps"] and all(
+            s["winner"] and s["audit_rejected"] == 0
+            for s in rep["sweeps"])
+        rc = cli.main(["--cache", cache, "list", "--require-winners"])
+        assert rc == 0
+        assert "flash.fwd" in capsys.readouterr().out
+        assert cli.main(["--cache", cache, "clear"]) == 0
+        assert cli.main(["--cache", cache, "list",
+                         "--require-winners"]) == 1
+
+    def test_cli_dry_run_prints_verdicts(self, tmp_path, capsys):
+        from veles_tpu.tuner import cli
+        cache = str(tmp_path / "winners.json")
+        rc = cli.main(["--cache", cache, "sweep", "--dry-run",
+                       "--kernels", "flash.fwd", "--t", "256"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ok" in out
+        # nothing persisted
+        assert not os.path.exists(cache)
+
+    def test_bake_tool_imports_into_cache(self, tmp_path, monkeypatch,
+                                          capsys):
+        """tools/bake_flashtune.py re-pointed at the tuner cache: a
+        legacy grid imports per-kernel winners; an over-VMEM winner in
+        the log is REFUSED by the audit gate."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bake_flashtune", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "tools", "bake_flashtune.py"))
+        bake = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bake)
+
+        grid = {"t1024_q128_k128": {"ms": 2.0, "ms_dq": 3.0,
+                                    "ms_dkv": 4.5},
+                "t1024_q256_k128": {"ms": 1.8, "ms_dq": 3.5,
+                                    "ms_dkv": 4.0}}
+        sweep_file = tmp_path / "sweep.json"
+        sweep_file.write_text(json.dumps(grid))
+        cache = str(tmp_path / "winners.json")
+        monkeypatch.setattr(
+            "sys.argv", ["bake_flashtune.py", str(sweep_file),
+                         "--cache", cache, "--mesh", "tpu:test"])
+        bake.main()
+        tuner = tn.KernelTuner(path=cache)
+        assert tuner.lookup("flash.fwd", "t1024_d128", "bfloat16",
+                            mesh="tpu:test") == {"block_q": 256,
+                                                 "block_k": 128}
+        assert tuner.lookup("flash.bwd_dq", "t1024_d128", "bfloat16",
+                            mesh="tpu:test") == {"block_q": 128,
+                                                 "block_k": 128}
+        # over-VMEM "winner" (fastest in the grid) is refused
+        bad = {"t8192_q4096_k4096": {"ms": 0.1, "ms_dq": 0.1,
+                                     "ms_dkv": 0.1}}
+        bad_file = tmp_path / "bad.json"
+        bad_file.write_text(json.dumps(bad))
+        monkeypatch.setattr(
+            "sys.argv", ["bake_flashtune.py", str(bad_file),
+                         "--cache", cache, "--mesh", "tpu:test"])
+        with pytest.raises(SystemExit):
+            bake.main()
+        assert "REFUSED" in capsys.readouterr().out
+        assert tuner.lookup("flash.fwd", "t8192_d128", "bfloat16",
+                            mesh="tpu:test") is None

@@ -1266,9 +1266,12 @@ class Main(object):
                          # root.common.serve.paged_block>0: block-table
                          # KV pool of root.common.serve.pool_tokens —
                          # memory scales with active tokens, admission
-                         # backpressures on pool exhaustion
-                         paged_block=int(
-                             root.common.serve.get("paged_block", 0)),
+                         # backpressures on pool exhaustion; "auto"/-1
+                         # = paged with the pool block resolved through
+                         # config > the kernel autotuner > default
+                         # (generate.parse_paged_block grammar)
+                         paged_block=root.common.serve.get(
+                             "paged_block", 0),
                          pool_tokens=root.common.serve.get(
                              "pool_tokens", None),
                          # prefix_cache: concurrent requests sharing a
@@ -1314,6 +1317,13 @@ class Main(object):
 
 
 def __run__():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--tune":
+        # kernel-autotuner surface (no workflow involved):
+        # `python -m veles_tpu --tune sweep ...` == `veles-tpu-tune
+        # sweep ...` — sweep/list/clear the winner cache (docs/cli.md)
+        from veles_tpu.tuner.cli import main as tune_main
+        sys.exit(tune_main(argv[1:]))
     sys.exit(Main().run())
 
 

@@ -132,7 +132,22 @@ class Launcher(Logger):
                     telemetry.flight.record(
                         "mesh.refit", configured=dict(axes),
                         live=fitted, devices=_jax.device_count())
+                    # kernel-autotuner winners are keyed by mesh
+                    # topology: the configured (full-size) entries are
+                    # invalidated so the degraded pod RE-TUNES for its
+                    # survivor mesh instead of inheriting block sizes
+                    # measured at full size (docs/perf.md "Autotuning")
+                    try:
+                        from veles_tpu import tuner as _tuner
+                        _tuner.on_mesh_refit(dict(axes), fitted)
+                    except Exception:  # noqa: BLE001 — advisory
+                        pass
                 axes = fitted
+            try:
+                from veles_tpu import tuner as _tuner
+                _tuner.set_ambient_mesh(axes)
+            except Exception:  # noqa: BLE001 — advisory
+                pass
             self.mesh_config = MeshConfig(make_mesh(axes),
                                           fsdp=self.fsdp)
             if self.fsdp and self.mesh_config.data_size <= 1:

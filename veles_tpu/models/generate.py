@@ -1576,6 +1576,24 @@ class ContinuousBatcher:
         return self._tick_fn(self.gen.params, st, self._aids)
 
 
+def parse_paged_block(value):
+    """The ``serve.paged_block`` grammar, shared by the engine and the
+    CLI: ``0``/``''``/``None``/``"off"`` → dense slot pool; a positive
+    int → paged KV with that pool block; ``"auto"``/``-1`` → paged KV
+    with the block resolved at admission through config > the kernel
+    autotuner > default (``PagedContinuousBatcher(block=None)``, see
+    ops.pallas.paged.preferred_pool_block).  Returns
+    ``(paged, block_or_None)``."""
+    if value in (None, "", 0, "0", False, "off"):
+        return False, None
+    if value in ("auto", -1, "-1"):
+        return True, None
+    n = int(value)
+    if n <= 0:
+        return False, None
+    return True, n
+
+
 class PagedContinuousBatcher(ContinuousBatcher):
     """Paged-KV continuous batching: slot caches live in a SHARED block
     pool addressed through per-slot block tables, so KV memory scales
@@ -1616,7 +1634,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
     """
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
-                 chunked_prefill=True, block=16, pool_tokens=None,
+                 chunked_prefill=True, block=None, pool_tokens=None,
                  fused=True, prefix_cache=False, speculative_k=0):
         if int(speculative_k):
             raise ValueError(
@@ -1627,6 +1645,33 @@ class PagedContinuousBatcher(ContinuousBatcher):
             gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
             chunked_prefill=chunked_prefill)
         L = gen.max_len
+        # shapes WITHOUT allocating the dense caches (eval_shape): the
+        # whole point of paging is that dense slots x max_len may not
+        # fit, so construction must never spike to dense + pool; ONE
+        # abstract trace serves both the auto-block probe below and
+        # the pool layout/pageability checks
+        cache_shapes = jax.eval_shape(
+            lambda: gen._init_caches(slots, gen._model_dtype()))
+        if block is None:
+            # unpinned pool block: config > tuned paged.decode winner >
+            # 16 (ops.pallas.paged.preferred_pool_block) — the pool
+            # layout is THE launch geometry of the fused decode kernel,
+            # and admission is the only point it can be chosen
+            from veles_tpu.ops.pallas import paged as _paged
+            try:
+                leaf = next(s for s in
+                            jax.tree_util.tree_leaves(cache_shapes)
+                            if len(s.shape) == 4)
+                hkv, hd = leaf.shape[1], leaf.shape[-1]
+                g = max(1, int(getattr(gen._blocks[0], "n_heads", hkv))
+                        // int(hkv))
+                block = _paged.preferred_pool_block(hd, g, leaf.dtype)
+            except Exception:  # noqa: BLE001 — odd cache pytrees
+                block = 16
+            # a tuned block must still divide max_len; config/explicit
+            # blocks keep the hard error below instead
+            if L % int(block):
+                block = 16
         if L % int(block):
             raise ValueError("max_len %d %% block %d != 0"
                              % (L, int(block)))
@@ -1634,11 +1679,6 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self.max_blocks = L // self.block
         pool_tokens = int(pool_tokens or slots * L)
         self.pool_blocks = max(1, pool_tokens // self.block)
-        # shapes WITHOUT allocating the dense caches (eval_shape): the
-        # whole point of paging is that dense slots x max_len may not
-        # fit, so construction must never spike to dense + pool
-        cache_shapes = jax.eval_shape(
-            lambda: gen._init_caches(slots, gen._model_dtype()))
         for leaf in jax.tree_util.tree_leaves(cache_shapes):
             if leaf.shape[2] != L:
                 raise ValueError(

@@ -173,18 +173,23 @@ def finalize_attention(carry):
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     block_k=None, interpret=None, backward="fused",
-                    window=None):
+                    window=None, block_q_dq=None, block_k_dq=None,
+                    block_q_dkv=None, block_k_dkv=None):
     """Pallas TPU flash attention (ops.pallas.flash); [B, H, T, D].
     ``window`` = sliding-window causal attention (blocks outside the
-    band are skipped entirely — O(T·window) compute).  ``block_q``/
-    ``block_k`` default from ``root.common.engine.flash.*`` (else 128)
-    — None forwards so the kernel-side config lookup decides."""
+    band are skipped entirely — O(T·window) compute).  Block sizes
+    (forward and the independent dq/dkv backward grids) default from
+    ``root.common.engine.flash.*``, then the kernel autotuner's winner
+    cache — None forwards so the kernel-side resolution decides."""
     from veles_tpu.ops.pallas import flash
     return flash.flash_attention(q, k, v, causal=causal,
                                  scale=_scale(q.shape[-1], scale),
                                  block_q=block_q, block_k=block_k,
                                  interpret=interpret, backward=backward,
-                                 window=window)
+                                 window=window, block_q_dq=block_q_dq,
+                                 block_k_dq=block_k_dq,
+                                 block_q_dkv=block_q_dkv,
+                                 block_k_dkv=block_k_dkv)
 
 
 # ---------------------------------------------------------------------------

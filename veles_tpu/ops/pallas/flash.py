@@ -261,19 +261,100 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
+def _d64_cap(t):
+    """Default block cap for the d<=64 VMEM regime: up to 1024, rounded
+    to the operand's own padded length (caps follow each operand — in
+    non-causal cross-attention tk != tq, and a block_k cap from tq
+    would pad K/V up to 8x for nothing)."""
+    return max(128, min(1024, -(-t // 128) * 128))
+
+
+def _resolve_blocks(tq, tk, d, dtype, block_q=None, block_k=None,
+                    block_q_dq=None, block_k_dq=None, block_q_dkv=None,
+                    block_k_dkv=None):
+    """Resolve all six block choices for one flash launch.
+
+    Per knob, first hit wins: explicit argument > site-config key
+    (``root.common.engine.flash.*``, with ``*_d64`` variants for head
+    dim <= 64) > autotuner winner (``veles_tpu.tuner``, keyed by
+    kernel/shape-bucket/dtype/mesh) > built-in default.  The built-in
+    forward default is 128 (d>64) or the d64 cap; the backward kernels
+    default to the *forward's resolved* geometry — the pre-split
+    behavior — so an untuned, unconfigured launch is unchanged.
+
+    d<=64 halves the k/v/q VMEM slabs vs the d=128 the flashtune grid
+    swept, so blocks up to 1024 fit — and win: at the 124M flagship's
+    (16,12,1024,64) shape, 1024x1024 measured fwd+bwd 16.57 ms vs
+    17.44 at the d=128-baked (512,512) and 20.77 XLA-naive; at
+    (2,8,8192,64) long context it wins 1.9x (fwd 6.30 vs 11.82 ms) —
+    validated across the regime (2026-08-01, .watcher/
+    diag_flag_attn.log, diag_d64_long.log)."""
+    from veles_tpu.config import root
+    fcfg = root.common.engine.flash
+    small = d <= 64
+    sfx = "_d64" if small else ""
+
+    def cfg(key):
+        v = fcfg.get(key + sfx)
+        return None if v is None else int(v)
+
+    tuned = {}
+    need_tuner = any(b is None and cfg(key) is None for b, key in (
+        (block_q, "block_q"), (block_k, "block_k"),
+        (block_q_dq, "block_q_dq"), (block_k_dq, "block_k_dq"),
+        (block_q_dkv, "block_q_dkv"), (block_k_dkv, "block_k_dkv")))
+    if need_tuner:
+        try:
+            from veles_tpu import tuner
+            # fwd/dq grids are q-major (their sweep sizes with tq);
+            # the dkv grid walks the KEY axis, so in cross-attention
+            # (tq != tk) its winner comes from the tk bucket
+            for kern, alias, t in (("flash.fwd", "", tq),
+                                   ("flash.bwd_dq", "_dq", tq),
+                                   ("flash.bwd_dkv", "_dkv", tk)):
+                win = tuner.lookup(kern, tuner.flash_shape_key(t, d),
+                                   dtype)
+                if win:
+                    for wk in ("block_q", "block_k"):
+                        if wk in win:
+                            tuned[wk + alias] = int(win[wk])
+        except Exception:  # noqa: BLE001 — tuning is advisory, never fatal
+            pass
+
+    def pick(explicit, key, default):
+        if explicit is not None:
+            return int(explicit)
+        v = cfg(key)
+        if v is not None:
+            return v
+        return int(tuned.get(key, default))
+
+    block_q = pick(block_q, "block_q",
+                   _d64_cap(tq) if small else 128)
+    block_k = pick(block_k, "block_k",
+                   _d64_cap(tk) if small else 128)
+    return (block_q, block_k,
+            pick(block_q_dq, "block_q_dq", block_q),
+            pick(block_k_dq, "block_k_dq", block_k),
+            pick(block_q_dkv, "block_q_dkv", block_q),
+            pick(block_k_dkv, "block_k_dkv", block_k))
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     block_k=None, interpret=None, backward="fused",
-                    window=None):
+                    window=None, block_q_dq=None, block_k_dq=None,
+                    block_q_dkv=None, block_k_dkv=None):
     """q, k, v: [B, H, T, D] → [B, H, T, D].  ``scale=None`` → 1/√D (same
     default as every entry point in ops.attention).
 
-    ``block_q``/``block_k`` defaults: for head dim > 64, from
-    ``root.common.engine.flash.block_q/block_k`` (else 128); for head
-    dim <= 64, from ``...flash.block_q_d64/block_k_d64`` (else
-    min(1024, padded T) per operand — the measured optimum for that
-    VMEM regime).  Bake a ``bench.py --phase flashtune`` winner into
-    the site config with ``tools/bake_flashtune.py`` (``--head-dim``
-    picks the key pair), no code edit.
+    Block sizes resolve per kernel — forward (``block_q``/``block_k``),
+    dQ (``block_q_dq``/``block_k_dq``) and dK/dV (``block_q_dkv``/
+    ``block_k_dkv``) grids are independent: explicit argument > site
+    config (``root.common.engine.flash.*``, ``*_d64`` keys for head
+    dim <= 64) > autotuner winner (``veles_tpu.tuner``; populate with
+    ``veles-tpu-tune sweep`` or ``bench.py --phase flashtune``) >
+    built-in default (see :func:`_resolve_blocks`).  Unset backward
+    blocks inherit the forward's resolved geometry.
 
     Differentiable both ways: ``backward="fused"`` (default) runs the
     Pallas dQ and dK/dV kernels against the forward's saved log-sum-exp
@@ -299,45 +380,22 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
         raise ValueError("backward must be 'fused' or 'recompute'")
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if block_q is None or block_k is None:
-        from veles_tpu.config import root
-        fcfg = root.common.engine.flash
-        if q.shape[-1] <= 64:
-            # d<=64 halves the k/v/q VMEM slabs vs the d=128 the
-            # flashtune grid swept, so blocks up to 1024 fit — and win:
-            # at the 124M flagship's (16,12,1024,64) shape, 1024x1024
-            # measured fwd+bwd 16.57 ms vs 17.44 at the d=128-baked
-            # (512,512) and 20.77 XLA-naive; at (2,8,8192,64) long
-            # context it wins 1.9x (fwd 6.30 vs 11.82 ms) — validated
-            # across the regime (2026-08-01, .watcher/
-            # diag_flag_attn.log, diag_d64_long.log).  Site keys
-            # *_d64 override (tools/bake_flashtune.py --head-dim 64).
-            # Caps follow each operand's OWN padded length — in
-            # non-causal cross-attention tk != tq, and a block_k cap
-            # from tq would pad K/V up to 8x for nothing.
-            def _cap(t):
-                return max(128, min(1024, -(-t // 128) * 128))
-
-            if block_q is None:
-                block_q = int(fcfg.get("block_q_d64",
-                                       _cap(q.shape[-2])))
-            if block_k is None:
-                block_k = int(fcfg.get("block_k_d64",
-                                       _cap(k.shape[-2])))
-        else:
-            if block_q is None:
-                block_q = int(fcfg.get("block_q", 128))
-            if block_k is None:
-                block_k = int(fcfg.get("block_k", 128))
-    return _flash_fn(causal, float(scale), block_q, block_k,
+    blocks = _resolve_blocks(
+        q.shape[-2], k.shape[-2], q.shape[-1], q.dtype,
+        block_q=block_q, block_k=block_k,
+        block_q_dq=block_q_dq, block_k_dq=block_k_dq,
+        block_q_dkv=block_q_dkv, block_k_dkv=block_k_dkv)
+    return _flash_fn(causal, float(scale), blocks,
                      autodetect_interpret(interpret), backward,
                      window)(q, k, v)
 
 
 @functools.lru_cache(maxsize=None)
-def _flash_fn(causal, scale, block_q, block_k, interpret, backward,
+def _flash_fn(causal, scale, blocks, interpret, backward,
               window=None):
     from veles_tpu.ops import attention as att
+    block_q, block_k = blocks[:2]
+    bwd_blocks = blocks[2:]
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -358,7 +416,7 @@ def _flash_fn(causal, scale, block_q, block_k, interpret, backward,
         if backward == "fused":
             q, k, v, out, lse = res
             return _backward(q, k, v, out, lse, g, causal, scale,
-                             block_q, block_k, interpret, window)
+                             bwd_blocks, interpret, window)
         q, k, v = res
         _, vjp = jax.vjp(
             lambda q_, k_, v_: att.blockwise_attention(
@@ -450,27 +508,38 @@ def _forward(q, k, v, causal, scale, block_q, block_k, interpret,
     return out[:, :tq].reshape(b, h, tq, d), lse[:, :, 0]
 
 
-def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-              interpret, window=None):
+def _backward(q, k, v, out, lse, g, causal, scale, blocks, interpret,
+              window=None):
     """FlashAttention-2 backward: Δ = rowsum(dO⊙O) in plain XLA (one
     fused elementwise+reduce), then the dQ kernel (k innermost) and the
-    dK/dV kernel (q innermost).  Gradients come back in the inputs'
-    dtype; all accumulation is f32."""
+    dK/dV kernel (q innermost).  ``blocks`` carries each kernel's OWN
+    (block_q, block_k) pair — the dq and dkv grids are independent of
+    the forward's geometry and of each other (dq streams K/V per q
+    tile, dkv streams Q/dO per k tile; their optimal tile trade-offs
+    differ, see veles_tpu/tuner).  Each launch pads its operands to its
+    own block multiple.  Gradients come back in the inputs' dtype; all
+    accumulation is f32."""
+    bq_dq, bk_dq, bq_dkv, bk_dkv = blocks
     b, h, tq, d = q.shape
     tk = k.shape[-2]
-    qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, block_q,
-                                                   block_k)
-    dop = _pad_to(g.reshape(b * h, tq, d).astype(q.dtype), 1, block_q)
-    delta = jnp.sum(dop.astype(jnp.float32)
-                    * _pad_to(out.reshape(b * h, tq, d), 1,
-                              block_q).astype(jnp.float32), axis=-1)
-    # per-row residuals enter the kernels lane-broadcast — Mosaic wants
+    # residuals at full resolution, padded per launch below.  Per-row
+    # residuals enter the kernels lane-broadcast — Mosaic wants
     # (sublane % 8, lane % 128) block minors, which (1, block_q) row
     # tiles violate; one fused XLA broadcast each, tiny next to the
     # kernels' K/V traffic
+    do = g.reshape(b * h, tq, d).astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32)
+                    * out.reshape(b * h, tq, d).astype(jnp.float32),
+                    axis=-1)
     lse = jnp.broadcast_to(lse[:, :, None], lse.shape + (_LANES,))
     delta = jnp.broadcast_to(delta[:, :, None], delta.shape + (_LANES,))
 
+    # ---------------------------------------------------- dQ launch
+    qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, bq_dq,
+                                                   bk_dq)
+    dop = _pad_to(do, 1, block_q)
+    lse_p = _pad_to(lse, 1, block_q)
+    delta_p = _pad_to(delta, 1, block_q)
     nk_grid = _k_span(block_q, block_k, window, nk) if causal else nk
     kv_map = _kv_index_map(block_q, block_k, causal, window, nk)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, i: (bh, a, 0))
@@ -489,12 +558,18 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(qp, kp, vp, dop, lse, delta)
+    )(qp, kp, vp, dop, lse_p, delta_p)
 
+    # --------------------------------------------------- dK/dV launch
     # q innermost: swap the roles of the two block axes in the specs;
     # the q/do/residual index map mirrors _kv_index_map (window span
     # shrink + clamp of the dead below-diagonal tiles onto the first
     # live q block for copy elision)
+    qp, kp, vp, block_q, block_k, nq, nk = _blocks(q, k, v, bq_dkv,
+                                                   bk_dkv)
+    dop = _pad_to(do, 1, block_q)
+    lse_p = _pad_to(lse, 1, block_q)
+    delta_p = _pad_to(delta, 1, block_q)
     nq_grid = _q_span(block_q, block_k, window, nq) if causal else nq
 
     def q_map3(bh, ki, qj):
@@ -521,7 +596,7 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(qp, kp, vp, dop, lse, delta)
+    )(qp, kp, vp, dop, lse_p, delta_p)
 
     return (dq[:, :tq].reshape(b, h, tq, d),
             dk[:, :tk].reshape(b, h, tk, d),
@@ -537,70 +612,94 @@ def _backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
 def audit_launch(tq, tk, d, dtype=jnp.bfloat16, causal=False,
                  block_q=None, block_k=None, window=None, masked=True,
-                 checked=()):
+                 checked=(), block_q_dq=None, block_k_dq=None,
+                 block_q_dkv=None, block_k_dkv=None, kernels=None):
     """Launch descriptions for one flash configuration — forward, dQ
-    and dK/dV kernels.  ``masked=True`` reflects what the kernels
-    actually do (``_pad_to`` + validity mask — the VP601 escape hatch);
-    the tests pin a ``masked=False`` description to prove VP601 fires
-    when a kernel does not."""
+    and dK/dV kernels, each at its OWN (block_q, block_k) geometry
+    (unset backward blocks inherit the forward's, the same rule
+    ``_resolve_blocks`` applies).  ``kernels`` optionally restricts the
+    output to a subset of ``{"forward", "bwd_dq", "bwd_dkv"}`` — the
+    autotuner audits one candidate kernel at a time.  ``masked=True``
+    reflects what the kernels actually do (``_pad_to`` + validity mask
+    — the VP601 escape hatch); the tests pin a ``masked=False``
+    description to prove VP601 fires when a kernel does not."""
     if block_q is None:
         block_q = 128
     if block_k is None:
         block_k = 128
-    block_q = min(block_q, max(tq, 8))
-    block_k = min(block_k, max(tk, 8))
     # the lane dim of every head-dim tile IS the model's head dim —
     # geometry, not a tunable block choice (full_lane exempts it from
     # VP600; d=64 models are real and the kernel handles the half-tile)
     hd = {"full_lane": True}
-    qkv = [("q", (1, block_q, d), dtype, hd),
-           ("k", (1, block_k, d), dtype, hd),
-           ("v", (1, block_k, d), dtype, hd)]
-    grid = [("q-blocks", tq, block_q), ("k-blocks", tk, block_k)]
-    fwd = {
-        "kernel": "flash.forward", "masked": masked, "checked": checked,
-        "blocks": qkv + [("o", (1, block_q, d), dtype, hd),
-                         ("lse", (1, block_q, _LANES), jnp.float32)],
-        "scratch": [("acc", (block_q, d), jnp.float32),
-                    ("m", (block_q, _LANES), jnp.float32),
-                    ("l", (block_q, _LANES), jnp.float32)],
-        "grid_axes": grid,
-    }
-    resid = [("do", (1, block_q, d), dtype, hd),
-             ("lse", (1, block_q, _LANES), jnp.float32),
-             ("delta", (1, block_q, _LANES), jnp.float32)]
-    bwd_dq = {
-        "kernel": "flash.bwd_dq", "masked": masked, "checked": checked,
-        "blocks": qkv + resid + [("dq", (1, block_q, d), dtype, hd)],
-        "scratch": [("dq_acc", (block_q, d), jnp.float32)],
-        "grid_axes": grid,
-    }
-    bwd_dkv = {
-        "kernel": "flash.bwd_dkv", "masked": masked, "checked": checked,
-        "blocks": qkv + resid + [("dk", (1, block_k, d), dtype, hd),
-                                 ("dv", (1, block_k, d), dtype, hd)],
-        "scratch": [("dk_acc", (block_k, d), jnp.float32),
-                    ("dv_acc", (block_k, d), jnp.float32)],
-        "grid_axes": grid,
-    }
-    return [fwd, bwd_dq, bwd_dkv]
+
+    def geom(bq, bk):
+        bq = min(int(bq), max(tq, 8))
+        bk = min(int(bk), max(tk, 8))
+        qkv = [("q", (1, bq, d), dtype, hd),
+               ("k", (1, bk, d), dtype, hd),
+               ("v", (1, bk, d), dtype, hd)]
+        grid = [("q-blocks", tq, bq), ("k-blocks", tk, bk)]
+        resid = [("do", (1, bq, d), dtype, hd),
+                 ("lse", (1, bq, _LANES), jnp.float32),
+                 ("delta", (1, bq, _LANES), jnp.float32)]
+        return bq, bk, qkv, grid, resid
+
+    launches = []
+    if kernels is None or "forward" in kernels:
+        bq, bk, qkv, grid, _ = geom(block_q, block_k)
+        launches.append({
+            "kernel": "flash.forward", "masked": masked,
+            "checked": checked,
+            "blocks": qkv + [("o", (1, bq, d), dtype, hd),
+                             ("lse", (1, bq, _LANES), jnp.float32)],
+            "scratch": [("acc", (bq, d), jnp.float32),
+                        ("m", (bq, _LANES), jnp.float32),
+                        ("l", (bq, _LANES), jnp.float32)],
+            "grid_axes": grid,
+        })
+    if kernels is None or "bwd_dq" in kernels:
+        bq, bk, qkv, grid, resid = geom(
+            block_q if block_q_dq is None else block_q_dq,
+            block_k if block_k_dq is None else block_k_dq)
+        launches.append({
+            "kernel": "flash.bwd_dq", "masked": masked,
+            "checked": checked,
+            "blocks": qkv + resid + [("dq", (1, bq, d), dtype, hd)],
+            "scratch": [("dq_acc", (bq, d), jnp.float32)],
+            "grid_axes": grid,
+        })
+    if kernels is None or "bwd_dkv" in kernels:
+        bq, bk, qkv, grid, resid = geom(
+            block_q if block_q_dkv is None else block_q_dkv,
+            block_k if block_k_dkv is None else block_k_dkv)
+        launches.append({
+            "kernel": "flash.bwd_dkv", "masked": masked,
+            "checked": checked,
+            "blocks": qkv + resid + [("dk", (1, bk, d), dtype, hd),
+                                     ("dv", (1, bk, d), dtype, hd)],
+            "scratch": [("dk_acc", (bk, d), jnp.float32),
+                        ("dv_acc", (bk, d), jnp.float32)],
+            "grid_axes": grid,
+        })
+    return launches
 
 
 @register_kernel_audit("flash")
 def _configured_launches():
-    """The block sizes ``flash_attention`` would actually pick from the
-    site config, audited at both head-dim regimes (the d=128 flashtune
-    keys and the d<=64 ``*_d64`` keys) in the MXU-native bf16."""
-    from veles_tpu.config import root
-    fcfg = root.common.engine.flash
+    """The block sizes ``flash_attention`` would actually pick — the
+    full resolution chain (site config > tuner winners > defaults,
+    exactly ``_resolve_blocks``), audited at both head-dim regimes (the
+    d=128 flashtune keys and the d<=64 ``*_d64`` keys) in the
+    MXU-native bf16.  A tuned-but-over-budget winner therefore fails
+    ``veles-tpu-lint --numerics`` the same way a hand-misconfigured
+    site key always has."""
+    launches = []
     t = 1024
-    launches = audit_launch(
-        t, t, 128, causal=True,
-        block_q=int(fcfg.get("block_q", 128)),
-        block_k=int(fcfg.get("block_k", 128)))
-    cap = max(128, min(1024, -(-t // 128) * 128))
-    launches += audit_launch(
-        t, t, 64, causal=True,
-        block_q=int(fcfg.get("block_q_d64", cap)),
-        block_k=int(fcfg.get("block_k_d64", cap)))
+    for d in (128, 64):
+        bq, bk, bq_dq, bk_dq, bq_dkv, bk_dkv = _resolve_blocks(
+            t, t, d, jnp.bfloat16)
+        launches += audit_launch(
+            t, t, d, causal=True, block_q=bq, block_k=bk,
+            block_q_dq=bq_dq, block_k_dq=bk_dq,
+            block_q_dkv=bq_dkv, block_k_dkv=bk_dkv)
     return launches

@@ -94,17 +94,84 @@ def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
+def _resolve_block_g(g, hd, dtype, block_g=None):
+    """Resolve the q-group sublane pad: explicit argument > site config
+    (``root.common.serve.paged_block_g``) > autotuner winner
+    (``veles_tpu.tuner``, kernel ``paged.decode``) > ``_MIN_G``.  Always
+    clamped to hold the real group (>= g) and the sublane tile.
+    Reachable from the audit hook and every decode trace, so a
+    non-integer config value falls through to the tuner instead of
+    raising (same contract as :func:`preferred_pool_block`)."""
+    if block_g is None:
+        from veles_tpu.config import root
+        cfg = root.common.get("serve", {})
+        block_g = (cfg or {}).get("paged_block_g") if cfg else None
+    try:
+        block_g = int(block_g or 0)
+    except (TypeError, ValueError):      # "auto", garbage
+        block_g = 0
+    if not block_g:
+        try:
+            from veles_tpu import tuner
+            win = tuner.lookup("paged.decode",
+                               tuner.paged_shape_key(hd, g), dtype)
+            if win:
+                block_g = int(win.get("block_g") or 0)
+        except Exception:  # noqa: BLE001 — tuning is advisory
+            pass
+    return max(int(block_g or 0), g, _MIN_G)
+
+
+def preferred_pool_block(hd, g=1, dtype=jnp.bfloat16, default=16):
+    """The KV pool block size serving should allocate when the caller
+    did not pin one: site config (``root.common.serve.paged_block``) >
+    autotuner winner > ``default``.  The pool layout is decided at
+    admission time by PagedContinuousBatcher — the kernel then simply
+    follows whatever block the pool was built with, so THIS is the
+    point where a tuned ``paged.decode`` block takes effect.  The
+    config value goes through the ONE ``serve.paged_block`` grammar
+    (``models.generate.parse_paged_block`` — shared with the engine,
+    so the audit hook and serving can never disagree): only an
+    explicit positive block pins; ``"auto"``/``-1``/off-values/garbage
+    fall through to the tuner — this is reachable from the lint's
+    audit hook, so it must never raise on any config value."""
+    from veles_tpu.config import root
+    cfg = root.common.get("serve", {})
+    pinned = (cfg or {}).get("paged_block") if cfg else None
+    if pinned is not None:
+        try:
+            from veles_tpu.models.generate import parse_paged_block
+            _, block = parse_paged_block(pinned)
+        except (TypeError, ValueError):  # garbage ("fast", [1], ...)
+            block = None
+        if block:
+            return int(block)
+    try:
+        from veles_tpu import tuner
+        win = tuner.lookup("paged.decode", tuner.paged_shape_key(hd, g),
+                           dtype)
+        if win and win.get("block"):
+            return int(win["block"])
+    except Exception:  # noqa: BLE001 — tuning is advisory
+        pass
+    return int(default)
+
+
 def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
-                           interpret=None):
+                           interpret=None, block_g=None):
     """One decode step of attention over a paged KV pool (see module
-    docstring for the layout contract).  Returns [B, Hq, hd]."""
+    docstring for the layout contract).  Returns [B, Hq, hd].
+
+    ``block_g`` — the q-group sublane pad (rows per grid step); unset,
+    it resolves through config > autotuner > ``_MIN_G``
+    (:func:`_resolve_block_g`)."""
     b, hq, hd = q.shape
     npool, hkv, bs, _ = pool_k.shape
     nbm = table.shape[1]
     if hq % hkv:
         raise ValueError("Hq %d %% Hkv %d != 0" % (hq, hkv))
     g = hq // hkv
-    gp = max(g, _MIN_G)
+    gp = _resolve_block_g(g, hd, q.dtype, block_g)
     scale = (hd ** -0.5) if scale is None else scale
 
     # [B, Hq, hd] -> [B, Hkv, Gp, hd]: group queries under their kv
@@ -207,10 +274,12 @@ def audit_launch(hd, bs, g=1, dtype=jnp.bfloat16, nbm=32, masked=True,
 
 @register_kernel_audit("paged")
 def _configured_launches():
-    """The serving default (``PagedContinuousBatcher`` block=16) at the
-    flagship head dim, bf16 — what ``--serve`` with paged KV would
-    launch."""
-    from veles_tpu.config import root
-    serve = root.common.get("serve", {})
-    bs = int(serve.get("paged_block", 16) or 16)
-    return audit_launch(128, bs)
+    """What ``--serve`` with paged KV would actually launch at the
+    flagship head dim in bf16: the pool block through the same config >
+    tuner > default chain the batcher uses
+    (:func:`preferred_pool_block`), the q-group pad through
+    :func:`_resolve_block_g` — so an over-budget tuned winner fails the
+    lint exactly like a hand-misconfigured ``paged_block``."""
+    hd, g = 128, 1
+    bs = preferred_pool_block(hd, g)
+    return audit_launch(hd, bs, g=_resolve_block_g(g, hd, jnp.bfloat16))

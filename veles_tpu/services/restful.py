@@ -163,24 +163,28 @@ class ContinuousEngine(Logger):
         super(ContinuousEngine, self).__init__()
         import collections
         from veles_tpu.models.generate import (ContinuousBatcher,
-                                               PagedContinuousBatcher)
+                                               PagedContinuousBatcher,
+                                               parse_paged_block)
         #: paged_block > 0: block-table KV pool — slot memory scales
         #: with the pool_tokens budget, and admission backpressures on
-        #: pool exhaustion as well as slot exhaustion.  prefix_cache:
-        #: concurrent requests sharing a prompt prefix share its KV
-        #: blocks (copy-on-write — the system-prompt case)
+        #: pool exhaustion as well as slot exhaustion; "auto"/-1 keeps
+        #: paged KV but lets the pool block resolve through config >
+        #: the kernel autotuner > default (docs/perf.md "Autotuning").
+        #: prefix_cache: concurrent requests sharing a prompt prefix
+        #: share its KV blocks (copy-on-write — the system-prompt case)
         #: ticks_per_dispatch: fuse K engine ticks into one device
         #: dispatch — on a remote/tunneled device the per-dispatch
         #: round trip dominates per-token cost, so K ~ 8-32 multiplies
         #: serving throughput (admission + streaming then happen at
         #: K-token boundaries; token streams are unchanged)
+        paged, block = parse_paged_block(paged_block)
         self.cb = (PagedContinuousBatcher(
-                       generator, slots=slots, block=paged_block,
+                       generator, slots=slots, block=block,
                        pool_tokens=pool_tokens,
                        prefix_cache=prefix_cache,
                        speculative_k=speculative_k,
                        ticks_per_dispatch=ticks_per_dispatch)
-                   if paged_block else
+                   if paged else
                    ContinuousBatcher(
                        generator, slots=slots,
                        speculative_k=speculative_k,

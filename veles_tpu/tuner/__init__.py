@@ -1,0 +1,398 @@
+"""veles_tpu.tuner — persistent on-device kernel autotuner.
+
+BENCH_r05's headline gap motivated this subsystem: the hand-picked
+Pallas flash *backward* ran 6.95 ms where plain XLA managed 3.99 — the
+custom kernel was 1.7x slower than the compiler because its dq/dkv
+grids were yoked to the forward's block choice.  The fix is the
+TVM / CLBlast move (PAPERS.md): don't hand-pick block sizes, *search*
+the config space per (shape, dtype, mesh) and persist the winners.
+
+The pieces:
+
+* :class:`KernelTuner` — measures candidate configs on-device
+  (median-of-k, warm-up discarded), validates every candidate through
+  the VP6xx tile/VMEM launch audit (``analysis.numerics_audit``)
+  *before* it may win, and persists winners in a JSON cache
+  (:mod:`veles_tpu.tuner.cache`) keyed by
+  ``kernel|shape-bucket|dtype|mesh`` next to ``compile_cache``'s
+  directory;
+* :mod:`veles_tpu.tuner.sweeps` — candidate enumeration + chained
+  measurement harnesses for the flash forward, the split dq/dkv
+  backward kernels, and the fused paged decode kernel;
+* :mod:`veles_tpu.tuner.cli` — ``veles-tpu-tune`` (also reachable as
+  ``python -m veles_tpu --tune``): sweep/list/clear the winner cache.
+
+Launch-time contract: kernels call :func:`lookup` when neither an
+explicit argument nor a site-config key pinned their blocks — config
+override always wins over a tuned winner (ops/pallas/flash.py
+``_resolve_blocks``, ops/pallas/paged.py).  With no accelerator and no
+cache entry the lookup misses and the kernel falls back to its current
+defaults: tuning never happens implicitly, only ``veles-tpu-tune
+sweep`` / ``bench.py --phase flashtune`` measure.
+
+Mesh elasticity: winners are keyed by mesh topology, and
+``on_mesh_refit`` (called from the launcher's elastic-mesh path, PR 10)
+*invalidates* the configured-topology entries so a degraded pod
+re-tunes for its survivor mesh instead of inheriting full-size
+configs.
+
+Telemetry: every lookup records a ``tune.hit``/``tune.miss`` flight
+event and bumps ``veles_tune_lookups_total``; sweeps record
+``tune.sweep`` and ``veles_tune_sweeps_total``; the winner count is
+the ``veles_tune_winners`` gauge.  See docs/perf.md "Autotuning".
+"""
+
+import os
+import statistics
+import threading
+
+from veles_tpu.tuner.cache import WinnerCache
+
+__all__ = [
+    "KernelTuner", "SweepResult", "default_cache_path", "flash_shape_key",
+    "get_tuner", "lookup", "mesh_descriptor", "on_mesh_refit",
+    "paged_shape_key", "reset", "set_ambient_mesh",
+]
+
+
+# --------------------------------------------------------------------------
+# Cache location + keying
+# --------------------------------------------------------------------------
+
+def default_cache_path():
+    """``<repo>/.veles_tune/winners.json`` — a sibling of
+    ``compile_cache``'s ``.xla_cache`` directory (same repo-local
+    scratch economics: survives process restarts within a TPU window,
+    visible to the driver's end-of-round bench).  Overridden by
+    ``VELES_TUNE_CACHE`` (a path; ``0/off/false/no`` disables
+    persistence entirely — the memory-only mode)."""
+    env = os.environ.get("VELES_TUNE_CACHE", "")
+    if env.lower() in ("0", "off", "false", "no"):
+        return None
+    if env and env.lower() not in ("1", "on", "true", "yes"):
+        return os.path.abspath(env)
+    from veles_tpu import compile_cache
+    return os.path.join(os.path.dirname(compile_cache.default_dir()),
+                        ".veles_tune", "winners.json")
+
+
+def _bucket(n, floor=128):
+    """Shape-bucket a sequence length: next power of two >= n (floored)
+    — a T=1000 launch shares the T=1024 winner instead of missing the
+    cache for every ragged sequence length."""
+    n = max(int(n), 1)
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def flash_shape_key(t, d):
+    """Bucketed shape key for the flash kernels: sequence length to the
+    next power of two, head dim exact (it IS the lane geometry)."""
+    return "t%d_d%d" % (_bucket(t), int(d))
+
+
+def paged_shape_key(hd, g):
+    """Shape key for the fused paged decode kernel: head dim + query
+    group size (Hq/Hkv).  The pool block size is part of the *config*
+    (it is what gets tuned), not the key."""
+    return "hd%d_g%d" % (int(hd), int(g))
+
+
+#: axes topology the launcher last built/refitted a mesh for —
+#: introspection + refit bookkeeping only.  Deliberately NOT folded
+#: into default lookup keys: CLI/bench sweeps run with no launcher at
+#: all, so an axes-qualified launch key would never match a
+#: sweep-recorded winner (populate-then-launch must round-trip).
+_ambient_axes = None
+
+
+def set_ambient_mesh(axes):
+    """Record the live mesh topology (an ``{axis: size}`` dict, or
+    None to clear) — see ``_ambient_axes``."""
+    global _ambient_axes
+    _ambient_axes = dict(axes) if axes else None
+
+
+def ambient_axes():
+    return dict(_ambient_axes) if _ambient_axes else None
+
+
+def mesh_descriptor(mesh=None):
+    """Mesh-topology key component.  A ready-made string passes
+    through; ``None`` — the launch paths and the sweeps alike — keys
+    by the live ``backend:device_count`` (a degraded pod has fewer
+    devices, so its lookups naturally miss the full-size winners); an
+    explicit ``{axis: size}`` dict keys by the *topology's own* device
+    total plus the axes string (per-topology recordings — tests, pod
+    tooling, refit invalidation)."""
+    if isinstance(mesh, str):
+        return mesh
+    try:
+        import jax
+        backend, live = jax.default_backend(), jax.device_count()
+    except Exception:  # noqa: BLE001 — no backend: still a usable key
+        backend, live = "none", 0
+    if isinstance(mesh, dict) and mesh:
+        n, wild = 1, False
+        for size in mesh.values():
+            if int(size) == -1:
+                wild = True
+            else:
+                n *= int(size)
+        if wild:
+            n = live
+        return "%s:%d/%s" % (backend, n,
+                             "x".join("%s%d" % (name, int(size))
+                                      for name, size
+                                      in sorted(mesh.items())))
+    return "%s:%d" % (backend, live)
+
+
+def make_key(kernel, shape_key, dtype, mesh=None):
+    import numpy as np
+    return "|".join((str(kernel), str(shape_key),
+                     np.dtype(dtype).name, mesh_descriptor(mesh)))
+
+
+# --------------------------------------------------------------------------
+# The tuner core
+# --------------------------------------------------------------------------
+
+class SweepResult(object):
+    """Outcome of one sweep: the winning (config, ms) — or None when
+    nothing survived — plus the per-candidate ledger the CLI prints
+    (``verdict`` is ``won``/``eligible``/``audit_rejected``/
+    ``failed``)."""
+
+    def __init__(self, key, winner, candidates):
+        self.key = key
+        self.winner = winner            # {"config":…, "ms":…} or None
+        self.candidates = candidates    # [{"config","verdict","ms","findings"}]
+
+    @property
+    def audit_rejected(self):
+        return [c for c in self.candidates
+                if c["verdict"] == "audit_rejected"]
+
+
+class KernelTuner(object):
+    """Measure-validate-persist core (module docstring has the story).
+
+    ``path`` defaults to :func:`default_cache_path`; ``vmem_kib``
+    overrides the VP602 VMEM budget the audit gate applies."""
+
+    def __init__(self, path=None, vmem_kib=None):
+        # thread safety lives in WinnerCache's lock (every mutation is
+        # one cache op); sweeps themselves are single-threaded drivers
+        self.cache = WinnerCache(default_cache_path()
+                                 if path is None else (path or None))
+        self.vmem_kib = vmem_kib
+
+    # ------------------------------------------------------------ telemetry
+    def _telemetry(self, flight_kind, counter, labels, **fields):
+        try:
+            from veles_tpu import telemetry
+            if flight_kind:
+                telemetry.flight.record(flight_kind, **fields)
+            if counter:
+                telemetry.registry.counter(
+                    "veles_tune_%s_total" % counter,
+                    "kernel-autotuner %s events" % counter,
+                    tuple(labels)).inc(**labels)
+            telemetry.registry.gauge(
+                "veles_tune_winners",
+                "tuned winners currently cached").set(len(self.cache))
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+
+    # --------------------------------------------------------------- lookup
+    def lookup(self, kernel, shape_key, dtype, mesh=None, default=None):
+        """The launch-time read: a cached winner's config dict, or
+        ``default`` on miss.  Deterministic — same key, same cache,
+        same answer (pinned under interpret mode in the tests)."""
+        key = make_key(kernel, shape_key, dtype, mesh)
+        entry = self.cache.get(key)
+        result = "hit" if entry else "miss"
+        self._telemetry("tune.%s" % result, "lookups",
+                        {"kernel": str(kernel), "result": result},
+                        key=key)
+        if entry is None:
+            return default
+        return dict(entry["config"])
+
+    # ---------------------------------------------------------- audit gate
+    def audit_candidate(self, launches):
+        """Run the VP6xx tile/VMEM audit over a candidate's launch
+        descriptions.  Returns (ok, findings): any ERROR-severity
+        finding (VP602 over-VMEM above all) rejects the candidate —
+        it may never win, no matter what it measured."""
+        from veles_tpu.analysis.numerics_audit import (
+            ERROR, audit_kernel_launch)
+        findings = []
+        for launch in launches:
+            findings.extend(
+                audit_kernel_launch(launch, vmem_kib=self.vmem_kib))
+        ok = not any(f.severity == ERROR for f in findings)
+        return ok, findings
+
+    # --------------------------------------------------------------- record
+    def record(self, kernel, shape_key, dtype, config, ms, mesh=None,
+               source="sweep", launches=None):
+        """Persist one winner.  When ``launches`` are given the VP6xx
+        gate applies here too — a caller (bench, bake tool) cannot
+        slip an unaudited config into the cache."""
+        if launches is not None:
+            ok, findings = self.audit_candidate(launches)
+            if not ok:
+                raise ValueError(
+                    "config %r for %s failed the VP6xx launch audit: %s"
+                    % (config, kernel,
+                       "; ".join(f.message for f in findings
+                                 if f.severity == "error")))
+        key = make_key(kernel, shape_key, dtype, mesh)
+        entry = {"config": {str(n): int(v) for n, v in config.items()},
+                 "ms": float(ms), "kernel": str(kernel),
+                 "shape": str(shape_key), "mesh": mesh_descriptor(mesh),
+                 "source": str(source), "audit": "clean"}
+        self.cache.put(key, entry)
+        self._telemetry("tune.record", None, {}, key=key,
+                        config=entry["config"], ms=entry["ms"],
+                        source=entry["source"])
+        return entry
+
+    # ---------------------------------------------------------------- sweep
+    def sweep(self, kernel, shape_key, dtype, candidates, measure,
+              mesh=None, repeats=5, warmup=2, dry_run=False,
+              source="sweep"):
+        """Measure ``candidates`` and persist the winner.
+
+        ``candidates``: iterable of ``{"config": {...}, "launches":
+        [...]}`` — launch descriptions feed the VP6xx gate.
+        ``measure(config)`` returns seconds for ONE timed iteration
+        (compile/warm-up cost lands in the ``warmup`` calls, which are
+        discarded); the score is the median of the remaining
+        ``repeats``.  ``dry_run`` audits and ranks without measuring
+        or persisting — the CLI's candidate listing."""
+        repeats = max(1, int(repeats))   # median needs >= 1 sample
+        warmup = max(0, int(warmup))
+        ledger = []
+        best = None
+        for cand in candidates:
+            config = dict(cand["config"])
+            ok, findings = self.audit_candidate(cand.get("launches", ()))
+            row = {"config": config, "ms": None,
+                   "findings": [str(f) for f in findings]}
+            if not ok:
+                row["verdict"] = "audit_rejected"
+                ledger.append(row)
+                continue
+            if dry_run:
+                row["verdict"] = "eligible"
+                ledger.append(row)
+                continue
+            try:
+                times = [float(measure(config))
+                         for _ in range(warmup + repeats)]
+            except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+                row["verdict"] = "failed"
+                row["error"] = "%s: %s" % (type(e).__name__, e)
+                ledger.append(row)
+                continue
+            ms = statistics.median(times[warmup:]) * 1e3
+            row["verdict"] = "eligible"
+            row["ms"] = ms
+            ledger.append(row)
+            if best is None or ms < best["ms"]:
+                best = {"config": config, "ms": ms, "row": row}
+        winner = None
+        if best is not None:
+            best["row"]["verdict"] = "won"
+            winner = self.record(kernel, shape_key, dtype,
+                                 best["config"], best["ms"], mesh=mesh,
+                                 source=source)
+        self._telemetry(
+            "tune.sweep", "sweeps", {"kernel": str(kernel)},
+            key=make_key(kernel, shape_key, dtype, mesh),
+            candidates=len(ledger),
+            rejected=sum(1 for c in ledger
+                         if c["verdict"] == "audit_rejected"),
+            winner=(winner or {}).get("config"), dry_run=dry_run)
+        return SweepResult(make_key(kernel, shape_key, dtype, mesh),
+                           winner, ledger)
+
+    # --------------------------------------------------------- invalidation
+    def invalidate_mesh(self, mesh=None):
+        """Drop every winner recorded under ``mesh``'s descriptor —
+        the ``mesh.refit`` hook: a pod degraded onto fewer hosts must
+        re-tune, not inherit the full-size winners."""
+        desc = mesh_descriptor(mesh)
+        gone = self.cache.remove(
+            lambda key, entry: entry.get("mesh") == desc)
+        self._telemetry("tune.invalidate", "invalidations",
+                        {"reason": "mesh-refit"}, mesh=desc,
+                        removed=len(gone))
+        return gone
+
+    def clear(self, kernel=None):
+        if kernel is None:
+            return self.cache.clear()
+        gone = self.cache.remove(
+            lambda key, entry: entry.get("kernel") == kernel)
+        return len(gone)
+
+
+# --------------------------------------------------------------------------
+# Process-global tuner (what the kernels' launch-time lookups use)
+# --------------------------------------------------------------------------
+
+_tuner = None
+_tuner_lock = threading.Lock()
+
+
+def get_tuner():
+    global _tuner
+    with _tuner_lock:
+        if _tuner is None:
+            _tuner = KernelTuner()
+        return _tuner
+
+
+def reset():
+    """Forget the process-global tuner (tests; also the way to pick up
+    a changed ``VELES_TUNE_CACHE``)."""
+    global _tuner
+    with _tuner_lock:
+        _tuner = None
+
+
+def lookup(kernel, shape_key, dtype, mesh=None, default=None):
+    """Module-level convenience over ``get_tuner().lookup`` — the
+    one-liner the kernel launch paths call."""
+    return get_tuner().lookup(kernel, shape_key, dtype, mesh=mesh,
+                              default=default)
+
+
+def on_mesh_refit(configured, fitted):
+    """The launcher's elastic-mesh hook: a configured topology was
+    refitted onto the live device set.  Invalidate winners tuned for
+    the configured (pre-refit) topology under BOTH key forms — the
+    axes-qualified form explicit recordings use, and the bare
+    ``backend:count`` form the launch-time lookups use, with the count
+    taken from the CONFIGURED topology's device total (the live count
+    has already shrunk by the time this hook fires, so keying the
+    invalidation off it would match nothing).  A wildcard (``-1``)
+    configured topology has no knowable pre-refit device total — and
+    the launcher never refits one (a data wildcard already absorbs the
+    live count, so ``fitted == configured`` and the hook does not
+    fire); if called anyway, only the ambient re-key happens."""
+    set_ambient_mesh(fitted)
+    if any(int(size) == -1 for size in (configured or {}).values()):
+        return []
+    tuner = get_tuner()
+    gone = tuner.invalidate_mesh(configured)
+    desc = mesh_descriptor(configured)
+    if "/" in desc:
+        gone += tuner.invalidate_mesh(desc.split("/", 1)[0])
+    return gone

@@ -1,0 +1,202 @@
+"""On-disk winner cache for the kernel autotuner.
+
+One JSON file holds every tuned winner, keyed by
+``kernel|shape-bucket|dtype|mesh`` (see :mod:`veles_tpu.tuner` for the
+key grammar).  The file lives next to :mod:`veles_tpu.compile_cache`'s
+directory by default — the two caches share economics: both persist
+per-(program, topology) compilation/measurement work across process
+boundaries so a scarce TPU window is spent measuring NEW configs, not
+re-deriving old ones.
+
+Robustness contract (pinned in tests/test_tuner.py):
+
+* **atomic writes** — tmp file + ``os.replace``; a SIGKILL mid-save
+  leaves the previous cache intact, never a torn file;
+* **corrupt-entry quarantine** — an entry that fails validation (not a
+  dict, config values not int-able, non-numeric ms) is moved to the
+  file's ``quarantined`` section on load: it can never be served as a
+  winner again, but stays on disk for forensics instead of being
+  silently dropped;
+* **corrupt-file quarantine** — a file that does not parse at all is
+  moved aside to ``<path>.corrupt`` and the cache starts empty (same
+  taxonomy as the snapshotter's torn-checkpoint quarantine).
+"""
+
+import json
+import os
+import threading
+
+VERSION = 1
+
+
+def validate_entry(entry):
+    """True when ``entry`` is a servable winner: a dict whose
+    ``config`` maps names to int-able values and whose ``ms`` is a
+    finite number.  Everything else is quarantine fodder."""
+    if not isinstance(entry, dict):
+        return False
+    config = entry.get("config")
+    if not isinstance(config, dict) or not config:
+        return False
+    try:
+        for name, value in config.items():
+            str(name)
+            int(value)
+        ms = float(entry.get("ms", 0.0))
+    except (TypeError, ValueError):
+        return False
+    return ms == ms and ms != float("inf")  # NaN/inf are not timings
+
+
+class WinnerCache(object):
+    """Thread-safe load/get/put/save over the winner JSON file.
+
+    ``path=None`` keeps the cache memory-only (the
+    ``VELES_TUNE_CACHE=off`` escape hatch for read-only filesystems);
+    every mutation still works, nothing persists."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._winners = {}
+        self._quarantined = {}
+        #: keys this instance deliberately removed (invalidation,
+        #: clear) — the save-time cross-process merge must not
+        #: resurrect them from another process's file
+        self._removed = set()
+        self._loaded = False
+
+    # ------------------------------------------------------------ load/save
+    def _read_file(self, quarantine_corrupt):
+        """Parse the on-disk file → (winners, quarantined); corrupt
+        entries land in quarantined.  An unparseable FILE returns
+        empty — moved aside to ``*.corrupt`` only on the initial load
+        (``quarantine_corrupt``), ignored during save-time merges (the
+        initial-load path owns that forensics step)."""
+        if not self.path or not os.path.exists(self.path):
+            return {}, {}
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError("cache root is not an object")
+        except (OSError, ValueError) as e:
+            if quarantine_corrupt:
+                corrupt = "%s.corrupt" % self.path
+                try:
+                    os.replace(self.path, corrupt)
+                except OSError:
+                    corrupt = "<unlinkable>"
+                try:
+                    from veles_tpu import telemetry
+                    telemetry.flight.record(
+                        "tune.cache_corrupt", path=self.path,
+                        moved=corrupt, error=str(e)[:200])
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            return {}, {}
+        winners, quarantined = {}, {}
+        q = data.get("quarantined")
+        if isinstance(q, dict):
+            quarantined.update(q)
+        w = data.get("winners")
+        for key, entry in (w.items() if isinstance(w, dict) else ()):
+            if validate_entry(entry):
+                winners[key] = entry
+            else:
+                quarantined[key] = entry
+        return winners, quarantined
+
+    def _load_locked(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        winners, quarantined = self._read_file(quarantine_corrupt=True)
+        self._winners.update(winners)
+        self._quarantined.update(quarantined)
+
+    def _merge_disk_locked(self):
+        """Fold in winners another PROCESS recorded since our load —
+        a concurrent sweep of a different kernel must not be clobbered
+        by our whole-file rewrite.  Our own writes win per key; our
+        deliberate removals stay removed."""
+        disk_w, disk_q = self._read_file(quarantine_corrupt=False)
+        for key, entry in disk_w.items():
+            if key not in self._winners and key not in self._removed:
+                self._winners[key] = entry
+        for key, entry in disk_q.items():
+            if key not in self._removed:
+                self._quarantined.setdefault(key, entry)
+
+    def _save_locked(self):
+        if not self.path:
+            return
+        self._merge_disk_locked()
+        data = {"version": VERSION, "winners": self._winners}
+        if self._quarantined:
+            data["quarantined"] = self._quarantined
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = "%s.tmp.%d" % (self.path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    # --------------------------------------------------------------- access
+    def get(self, key):
+        with self._lock:
+            self._load_locked()
+            return self._winners.get(key)
+
+    def put(self, key, entry):
+        if not validate_entry(entry):
+            raise ValueError("refusing to cache invalid winner %r"
+                             % (entry,))
+        with self._lock:
+            self._load_locked()
+            self._winners[key] = entry
+            self._save_locked()
+
+    def items(self):
+        with self._lock:
+            self._load_locked()
+            return dict(self._winners)
+
+    def quarantined(self):
+        with self._lock:
+            self._load_locked()
+            return dict(self._quarantined)
+
+    def __len__(self):
+        with self._lock:
+            self._load_locked()
+            return len(self._winners)
+
+    def remove(self, predicate):
+        """Drop every winner whose (key, entry) satisfies ``predicate``;
+        returns the removed keys (persisted immediately).  Applies to
+        other processes' entries too — merge first, then drop."""
+        with self._lock:
+            self._load_locked()
+            self._merge_disk_locked()
+            gone = [k for k, e in self._winners.items()
+                    if predicate(k, e)]
+            for k in gone:
+                del self._winners[k]
+                self._removed.add(k)
+            if gone:
+                self._save_locked()
+            return gone
+
+    def clear(self):
+        with self._lock:
+            self._load_locked()
+            self._merge_disk_locked()
+            n = len(self._winners)
+            self._removed.update(self._winners)
+            self._removed.update(self._quarantined)
+            self._winners.clear()
+            self._quarantined.clear()
+            self._save_locked()
+            return n

@@ -1,0 +1,219 @@
+"""``veles-tpu-tune`` — sweep / list / clear the kernel-autotuner
+winner cache (docs/perf.md "Autotuning", docs/cli.md).
+
+Also reachable as ``python -m veles_tpu --tune <subcommand> ...``.
+
+Subcommands:
+
+``sweep``
+    Measure candidate block configs for the flash forward, the split
+    dq/dkv backward kernels, and the fused paged decode kernel, on
+    whatever accelerator is present (interpret mode off-TPU — the
+    machinery is identical, only the numbers are meaningless off
+    silicon).  Every candidate passes the VP6xx tile/VMEM launch
+    audit before it may win; winners persist in the cache the launch
+    paths read.  ``--dry-run`` prints each candidate with its VP6xx
+    verdict and persists nothing.
+``list``
+    Print cached winners (and quarantined entries, which are never
+    served).
+``clear``
+    Drop winners — all of them, or ``--kernel``'s.
+
+Exit codes: 0 = success (sweep: every requested kernel produced an
+audited winner; dry-run: candidates printed); 1 = at least one
+requested sweep produced NO eligible winner (all candidates failed or
+were audit-rejected), or ``list --require-winners`` found an empty
+cache; 2 = usage error (argparse).
+"""
+
+import argparse
+import json
+import sys
+
+
+def _parse_kernels(spec):
+    names = []
+    for part in (spec or "all").split(","):
+        part = part.strip()
+        if part in ("all", ""):
+            names += ["flash.fwd", "flash.bwd_dq", "flash.bwd_dkv",
+                      "paged.decode"]
+        elif part == "flash":
+            names += ["flash.fwd", "flash.bwd_dq", "flash.bwd_dkv"]
+        elif part == "paged":
+            names += ["paged.decode"]
+        elif part in ("flash.fwd", "flash.bwd_dq", "flash.bwd_dkv",
+                      "paged.decode"):
+            names.append(part)
+        else:
+            raise argparse.ArgumentTypeError(
+                "unknown kernel %r (flash.fwd, flash.bwd_dq, "
+                "flash.bwd_dkv, paged.decode, flash, paged, all)"
+                % part)
+    out = []
+    for n in names:        # dedup, order-preserving
+        if n not in out:
+            out.append(n)
+    return out
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-tune",
+        description="kernel-autotuner winner cache: sweep, list, clear",
+        epilog="exit codes: 0 = success; 1 = a requested sweep "
+               "produced no eligible winner (all candidates failed "
+               "or were VP6xx audit-rejected) or --require-winners "
+               "found none; 2 = usage error")
+    p.add_argument("--cache", default=None,
+                   help="winner-cache JSON path (default: repo-local "
+                   ".veles_tune/winners.json next to the compile "
+                   "cache; VELES_TUNE_CACHE overrides)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sw = sub.add_parser(
+        "sweep", help="measure candidates, persist audited winners")
+    sw.add_argument("--kernels", type=_parse_kernels,
+                    default=_parse_kernels("all"),
+                    help="comma list: flash.fwd, flash.bwd_dq, "
+                    "flash.bwd_dkv, paged.decode, or the groups "
+                    "flash / paged / all (default all)")
+    sw.add_argument("--t", type=int, nargs="+", default=[1024],
+                    help="sequence lengths for the flash sweeps")
+    sw.add_argument("--d", type=int, default=128,
+                    help="flash head dim (<= 64 widens the candidate "
+                    "grid to 1024 blocks)")
+    sw.add_argument("--paged-hd", type=int, default=128,
+                    help="paged decode head dim")
+    sw.add_argument("--paged-g", type=int, default=1,
+                    help="paged decode query-group size (Hq/Hkv)")
+    sw.add_argument("--dtype", default="bfloat16")
+    sw.add_argument("--iters", type=int, default=4,
+                    help="kernel calls chained per timed dispatch")
+    sw.add_argument("--repeats", type=int, default=3,
+                    help="timed dispatches per candidate (median "
+                    "scores)")
+    sw.add_argument("--warmup", type=int, default=1,
+                    help="discarded warm-up dispatches (compile cost "
+                    "lands here)")
+    sw.add_argument("--tiny", action="store_true",
+                    help="CI preset: tiny shapes + minimal iterations "
+                    "(proves the machinery in interpret mode, "
+                    "numbers are not meaningful)")
+    sw.add_argument("--dry-run", action="store_true",
+                    help="print candidates with their VP6xx verdicts; "
+                    "measure and persist nothing")
+    sw.add_argument("--json", default=None, metavar="PATH",
+                    help="write a machine-readable sweep report")
+
+    ls = sub.add_parser("list", help="print cached winners")
+    ls.add_argument("--json", action="store_true",
+                    help="dump the cache as JSON to stdout")
+    ls.add_argument("--require-winners", action="store_true",
+                    help="exit 1 when the cache holds no winners "
+                    "(CI gate)")
+
+    cl = sub.add_parser("clear", help="drop cached winners")
+    cl.add_argument("--kernel", default=None,
+                    help="only this kernel's winners (default: all, "
+                    "including quarantined entries)")
+    return p
+
+
+def _cmd_sweep(tuner, args):
+    from veles_tpu.tuner import sweeps
+    if args.tiny:
+        args.t = [128]
+        args.d = min(args.d, 64)
+        args.paged_hd = min(args.paged_hd, 64)
+        args.iters, args.repeats, args.warmup = 1, 2, 1
+
+    results = {}
+    flash_kinds = [k[6:] for k in args.kernels if k.startswith("flash.")]
+    if flash_kinds:
+        results.update(sweeps.sweep_flash(
+            tuner, ts=tuple(args.t), d=args.d, dtype=args.dtype,
+            kinds=tuple(flash_kinds), iters=args.iters,
+            repeats=args.repeats, warmup=args.warmup,
+            dry_run=args.dry_run, log=print, source="cli-sweep"))
+    if "paged.decode" in args.kernels:
+        results.update(sweeps.sweep_paged(
+            tuner, hd=args.paged_hd, g=args.paged_g, dtype=args.dtype,
+            iters=max(args.iters, 2), repeats=args.repeats,
+            warmup=args.warmup, dry_run=args.dry_run, log=print,
+            source="cli-sweep"))
+
+    report = {"cache": tuner.cache.path, "dry_run": args.dry_run,
+              "sweeps": []}
+    failed = 0
+    for ident, res in sorted(results.items(), key=str):
+        rep = {"key": res.key,
+               "winner": res.winner,
+               "candidates": len(res.candidates),
+               "audit_rejected": len(res.audit_rejected)}
+        report["sweeps"].append(rep)
+        for cand in res.candidates:
+            marks = {"won": "WINNER", "eligible": "ok",
+                     "audit_rejected": "VP6xx-REJECTED",
+                     "failed": "FAILED"}
+            line = "  %-28s %-15s" % (cand["config"],
+                                      marks[cand["verdict"]])
+            if cand.get("ms") is not None:
+                line += " %9.3f ms" % cand["ms"]
+            if cand["verdict"] == "audit_rejected":
+                line += "  " + "; ".join(
+                    f.splitlines()[0] for f in cand["findings"])
+            if cand.get("error"):
+                line += "  " + cand["error"]
+            print(line)
+        if not args.dry_run and res.winner is None:
+            failed += 1
+            print("  -> NO eligible winner for %s" % res.key)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print("report -> %s" % args.json)
+    return 1 if failed else 0
+
+
+def _cmd_list(tuner, args):
+    winners = tuner.cache.items()
+    quarantined = tuner.cache.quarantined()
+    if args.json:
+        print(json.dumps({"winners": winners,
+                          "quarantined": quarantined},
+                         indent=1, sort_keys=True))
+    else:
+        if not winners:
+            print("winner cache is empty (%s)"
+                  % (tuner.cache.path or "<memory-only>"))
+        for key, entry in sorted(winners.items()):
+            print("%-52s %-32s %9.3f ms  [%s]"
+                  % (key, entry["config"], entry["ms"],
+                     entry.get("source", "?")))
+        for key in sorted(quarantined):
+            print("%-52s QUARANTINED (never served)" % key)
+    if args.require_winners and not winners:
+        return 1
+    return 0
+
+
+def _cmd_clear(tuner, args):
+    n = tuner.clear(kernel=args.kernel)
+    print("cleared %d winner(s)%s" % (
+        n, " for %s" % args.kernel if args.kernel else ""))
+    return 0
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    from veles_tpu import tuner as tn
+    tuner = tn.KernelTuner(path=args.cache) if args.cache \
+        else tn.get_tuner()
+    return {"sweep": _cmd_sweep, "list": _cmd_list,
+            "clear": _cmd_clear}[args.cmd](tuner, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
