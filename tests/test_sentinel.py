@@ -1,0 +1,661 @@
+"""Numeric-fault survival tier (services.sentinel, PR 13): the in-jit
+health probes fused into the staged train step, the skip-update /
+rollback-and-replay / escalate response ladder, the commit health
+stamps + healthy-preferring agreement, the supervisor/pod numerics
+valves, and the reject_nonfinite surfacing — the in-process flavors of
+the tools/numerics_chaos.py gate (the CI ``numerics-chaos`` job runs
+the full subprocess version)."""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.datasets import load_digits
+
+from veles_tpu import prng, telemetry
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.services import sentinel
+from veles_tpu.services.sentinel import (NumericFaultError, apply_probes,
+                                         init_health, skip_steps_array)
+from veles_tpu.services.snapshotter import (SnapshotNonFiniteError,
+                                            SnapshotterBase,
+                                            agree_commits, commit_meta,
+                                            scan_commits, state_manifest)
+from veles_tpu.services.supervisor import Supervisor, classify_exit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cfg_guard():
+    """Snapshot + restore the sentinel/chaos config namespaces — every
+    test here may retune the ladder."""
+    saved = {ns: getattr(root.common, ns).as_dict()
+             for ns in ("sentinel", "chaos")}
+    yield root.common
+    for ns, vals in saved.items():
+        node = getattr(root.common, ns)
+        for k in [k for k in node.__dict__ if k != "_path_"]:
+            delattr(node, k)
+        node.update(vals)
+
+
+def _probe_cfg(**over):
+    cfg = {"enabled": True, "spike_zscore": 6.0, "spike_warmup": 8,
+           "update_norm_limit": 1e6, "ewma_decay": 0.9,
+           "max_skip_steps": 8, "force_skip_steps": ()}
+    cfg.update(over)
+    return cfg
+
+
+def _trees(g_val=0.01, upd=0.001):
+    params = {"l": {"weights": jnp.ones((4, 3), jnp.float32)}}
+    grads = {"l": {"weights": jnp.full((4, 3), g_val, jnp.float32)}}
+    new_params = {"l": {"weights": jnp.full((4, 3), 1.0 - upd,
+                                            jnp.float32)}}
+    return params, grads, new_params
+
+
+def _run_probe(health, loss, step=5, skips=(), cfg=None, **tree_kw):
+    params, grads, new_params = _trees(**tree_kw)
+    return apply_probes(
+        health, jnp.float32(loss), grads, new_params, params,
+        jnp.int32(step), jnp.asarray(skip_steps_array(skips, 8)),
+        cfg or _probe_cfg())
+
+
+def _counts(health):
+    return {k: float(health[k])
+            for k in sentinel._COUNTER_KEYS}
+
+
+# =====================================================================
+# the anomaly-taxonomy matrix: each probe kind fires exactly once on a
+# seeded hazard, and nothing else fires with it
+# =====================================================================
+class TestProbeTaxonomy:
+    def _warm(self, n=10, loss=1.0):
+        h = init_health()
+        for i in range(n):
+            h, ok = _run_probe(h, loss, step=i + 1)
+            assert bool(ok)
+        return h
+
+    def test_clean_step_updates_ewma_and_applies(self):
+        h = self._warm()
+        assert float(h["obs"]) == 10
+        assert float(h["anomalies"]) == 0
+        # geometric approach toward the constant loss: 1 - d^n
+        assert abs(float(h["ewma_mean"]) - (1.0 - 0.9 ** 10)) < 1e-5
+
+    @pytest.mark.parametrize("kind,kw", [
+        ("nonfinite_loss", {"loss": np.nan}),
+        ("nonfinite_grad", {"loss": 1.0, "g_val": np.nan}),
+        ("update_explosion", {"loss": 1.0, "upd": 1e5}),
+        ("loss_spike", {"loss": 1e6}),
+    ])
+    def test_kind_fires_exactly_once(self, kind, kw):
+        cfg = _probe_cfg(update_norm_limit=10.0)
+        h = self._warm()
+        mean_before = float(h["ewma_mean"])
+        loss = kw.pop("loss")
+        h, ok = _run_probe(h, loss, step=99, cfg=cfg, **kw)
+        assert not bool(ok)
+        counts = _counts(h)
+        assert counts[kind] == 1, counts
+        assert counts["anomalies"] == 1
+        assert counts["skipped"] == 1
+        assert counts["policy_skips"] == 0
+        for other in sentinel.ANOMALY_KINDS:
+            if other != kind:
+                assert counts[other] == 0, (other, counts)
+        assert int(h["first_bad_step"]) == 99
+        assert int(h["last_bad_step"]) == 99
+        # the poisoned observation must NOT advance the EWMA baseline
+        assert float(h["ewma_mean"]) == mean_before
+
+    def test_policy_skip_is_never_an_anomaly(self):
+        """A step on the skip list gates its update but counts zero
+        anomalies even when its numerics ARE poisoned — otherwise a
+        step-keyed fault would re-strike on every replay and the
+        ladder could never converge."""
+        h = self._warm()
+        h, ok = _run_probe(h, 1.0, step=42, skips=(42,), g_val=np.nan)
+        assert not bool(ok)
+        counts = _counts(h)
+        assert counts["policy_skips"] == 1
+        assert counts["anomalies"] == 0
+        assert counts["nonfinite_grad"] == 0
+        assert int(h["first_bad_step"]) == sentinel.NO_BAD_STEP
+
+    def test_spike_needs_warmup(self):
+        h = init_health()
+        h, ok = _run_probe(h, 1e9, step=1)   # cold stats: no spike
+        assert bool(ok)
+        assert _counts(h)["loss_spike"] == 0
+
+    def test_dominant_kind_priority(self):
+        assert sentinel.dominant_kind(
+            {"loss_spike": 1, "nonfinite_grad": 2}) == "nonfinite_grad"
+        assert sentinel.dominant_kind({"loss_spike": 3}) == "loss_spike"
+        assert sentinel.dominant_kind({}) is None
+
+
+# =====================================================================
+# workload fixtures (digits MLP = the MNIST proxy, tiny conv = the
+# CIFAR proxy, tiny transformer LM)
+# =====================================================================
+def _digits():
+    d = load_digits()
+    return ((d.data / 16.0).astype(np.float32),
+            d.target.astype(np.int32))
+
+
+def _mlp_wf(snap_dir=None, epochs=4, seed=7, name="sent-mlp",
+            interval=1):
+    prng.seed_all(seed)
+    x, y = _digits()
+    loader = FullBatchLoader(None, data=x, labels=y, minibatch_size=64,
+                             class_lengths=[0, 297, 1500])
+    snap = None if snap_dir is None else {"directory": str(snap_dir),
+                                          "interval": interval}
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.1, "gradient_moment": 0.9}],
+        loader=loader, decision_config={"max_epochs": epochs},
+        snapshotter_config=snap, name=name)
+
+
+# =====================================================================
+# rung 2: rollback + replay bit-identical to the golden skip-batch run
+# =====================================================================
+class TestRollbackReplayExactness:
+    NAN_STEP = 30   # epoch 2 of the digits MLP (24 train steps/epoch)
+
+    def _run(self, tmp_path, leg, force_skip=None, nan_step=None):
+        root.common.sentinel.force_skip_steps = tuple(force_skip or ())
+        root.common.chaos.nan_grads_step = nan_step
+        wf = _mlp_wf(tmp_path / leg, epochs=4, name="sent-exact")
+        wf.initialize()
+        wf.run()
+        final = os.path.realpath(
+            str(tmp_path / leg / "sent-exact_current"))
+        return wf, final
+
+    def test_transient_nan_recovers_bit_exact(self, tmp_path,
+                                              cfg_guard):
+        golden_wf, golden = self._run(tmp_path, "golden",
+                                      force_skip=(self.NAN_STEP,))
+        assert golden_wf.sentinel.rollbacks == 0
+        assert float(
+            golden_wf.trainer._health_host["policy_skips"]) == 1
+
+        chaos_wf, chaos = self._run(tmp_path, "chaos",
+                                    nan_step=self.NAN_STEP)
+        # exactly ONE rollback, to a commit stamped healthy, with the
+        # poisoned step armed on the skip list
+        assert chaos_wf.sentinel.rollbacks == 1
+        rec = chaos_wf.sentinel.history[0]
+        assert rec["anomaly"] == "nonfinite_grad"
+        assert rec["skip_step"] == self.NAN_STEP
+        assert rec["quarantined"]   # the unhealthy commit left the ring
+        assert any(n.endswith(".corrupt")
+                   for n in os.listdir(tmp_path / "chaos"))
+        # THE guarantee: params + optimizer slots + PRNG counters +
+        # loader order + decision bookkeeping all bit-identical to the
+        # golden run that skipped that batch (threshold 0)
+        from veles_tpu.scripts.compare_snapshots import diff_report
+        rep = diff_report(golden, chaos, threshold=0.0)
+        assert rep["identical"], rep["diffs"][:5]
+        # the replayed run's final commit is healthy again
+        scan = scan_commits(str(tmp_path / "chaos"), "sent-exact")
+        final_name = os.path.basename(chaos)
+        assert scan[final_name]["health"] == "healthy"
+
+    def test_persistent_nan_escalates_with_diagnosis(self, tmp_path,
+                                                     cfg_guard):
+        root.common.chaos.nan_grads_from = self.NAN_STEP
+        root.common.sentinel.rollbacks_to_escalate = 1
+        wf = _mlp_wf(tmp_path, epochs=4, name="sent-esc")
+        wf.initialize()
+        with pytest.raises(NumericFaultError) as exc:
+            wf.run()
+        assert exc.value.kind == "nonfinite_grad"
+        assert "first bad step" in str(exc.value)
+        assert wf.sentinel.rollbacks == 1
+        # params stayed finite throughout (rung 1 protected them)
+        for leaf in jax.tree_util.tree_leaves(
+                wf.trainer.host_params()):
+            assert np.isfinite(leaf).all()
+
+    def test_final_epoch_rollback_still_replays(self, tmp_path,
+                                                cfg_guard):
+        """An anomaly in the LAST epoch must not end the run on the
+        poisoned timeline's latched stop condition — the rollback
+        clears it and the replay still converges bit-exact."""
+        step = 80   # epoch 4 of 4 (24 train steps/epoch)
+        _, golden = self._run(tmp_path, "golden", force_skip=(step,))
+        chaos_wf, chaos = self._run(tmp_path, "chaos", nan_step=step)
+        assert chaos_wf.sentinel.rollbacks == 1
+        from veles_tpu.scripts.compare_snapshots import diff_report
+        rep = diff_report(golden, chaos, threshold=0.0)
+        assert rep["identical"], rep["diffs"][:5]
+
+    def test_noncommitting_epoch_anomaly_next_commit_healthy(
+            self, tmp_path, cfg_guard):
+        """With snapshot interval > 1 the anomalous epoch may never
+        commit; the rollback must drain the commit-verdict delta so
+        the first CLEAN post-replay commit is not stamped unhealthy
+        (which would make later rollbacks skip perfectly good
+        state)."""
+        root.common.chaos.nan_grads_step = 54   # epoch 3: no commit
+        wf = _mlp_wf(tmp_path, epochs=4, name="sent-int2", interval=2)
+        wf.initialize()
+        wf.run()
+        assert wf.sentinel.rollbacks == 1
+        scan = scan_commits(str(tmp_path), "sent-int2")
+        healths = {n: e["health"] for n, e in scan.items()}
+        assert healths and all(h == "healthy"
+                               for h in healths.values()), healths
+
+    def test_transient_without_snapshotter_is_contained(self,
+                                                        cfg_guard):
+        """Rung 1 already protected the state, so a run that CANNOT
+        roll back (no snapshotter) keeps training on a transient
+        anomaly instead of dying — only persistence escalates."""
+        root.common.chaos.nan_grads_step = self.NAN_STEP
+        wf = _mlp_wf(epochs=3, name="sent-contain")   # no snapshotter
+        wf.initialize()
+        wf.run()                                      # completes
+        assert wf.sentinel.rollbacks == 0
+        assert wf.sentinel.history and \
+            wf.sentinel.history[0].get("contained") is True
+        for leaf in jax.tree_util.tree_leaves(
+                wf.trainer.host_params()):
+            assert np.isfinite(leaf).all()
+
+    def test_persistent_without_snapshotter_still_escalates(
+            self, cfg_guard):
+        root.common.chaos.nan_grads_from = self.NAN_STEP
+        root.common.sentinel.rollbacks_to_escalate = 1
+        wf = _mlp_wf(epochs=4, name="sent-contain-esc")
+        wf.initialize()
+        with pytest.raises(NumericFaultError):
+            wf.run()
+        assert wf.sentinel.rollbacks == 0
+        assert all(r.get("contained") for r in wf.sentinel.history)
+
+    def test_skip_list_overflow_refuses_inexact_replay(self,
+                                                       cfg_guard):
+        wf = _mlp_wf(epochs=1, name="sent-ovf")
+        wf.initialize()
+        with pytest.raises(ValueError, match="skip list overflow"):
+            wf.trainer.add_skip_steps(range(100, 200))
+
+
+# =====================================================================
+# the model sweep stays silent: no false positives on healthy training
+# =====================================================================
+class TestModelSweepSilent:
+    def _assert_silent(self, wf):
+        wf.initialize()
+        wf.run()
+        h = {k: float(v) for k, v in
+             jax.device_get(wf.trainer.health).items()}
+        assert h["anomalies"] == 0, h
+        assert h["skipped"] == 0, h
+        assert wf.sentinel is not None and wf.sentinel.rollbacks == 0
+
+    def test_digits_mlp_silent(self, cfg_guard):
+        self._assert_silent(_mlp_wf(epochs=3, name="silent-mlp"))
+
+    def test_conv_stack_silent(self, cfg_guard):
+        prng.seed_all(9)
+        x, y = _digits()
+        loader = FullBatchLoader(
+            None, data=x.reshape(-1, 8, 8, 1), labels=y,
+            minibatch_size=64, class_lengths=[0, 297, 1500])
+        wf = StandardWorkflow(
+            layers=[{"type": "conv_relu", "n_kernels": 8, "kx": 3,
+                     "ky": 3, "learning_rate": 0.03},
+                    {"type": "max_pooling", "kx": 2, "ky": 2},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.03}],
+            loader=loader, decision_config={"max_epochs": 2},
+            name="silent-conv")
+        self._assert_silent(wf)
+
+    @pytest.mark.slow
+    def test_transformer_lm_silent(self, cfg_guard):
+        prng.seed_all(43)
+        from veles_tpu.models import zoo
+        r = np.random.RandomState(1)
+        n, t, vocab = 256, 16, 17
+        phase = r.randint(0, 5, n)
+        tokens = ((np.arange(t)[None, :] * 3 + phase[:, None]) % vocab
+                  ).astype(np.int32)
+        loader = FullBatchLoader(None, data=tokens, labels=tokens,
+                                 minibatch_size=64,
+                                 class_lengths=[0, 64, 192])
+        wf = StandardWorkflow(
+            layers=zoo.transformer_lm(vocab_size=vocab, d_model=32,
+                                      n_heads=4, n_layers=1, lr=0.005),
+            loader=loader, loss="lm",
+            decision_config={"max_epochs": 2}, name="silent-lm")
+        self._assert_silent(wf)
+
+
+# =====================================================================
+# commit health stamps + healthy-preferring agreement
+# =====================================================================
+class TestHealthStamp:
+    def test_commit_meta_and_manifest_carry_health(self):
+        state = {"epoch": 3, "health": "unhealthy:nonfinite_grad",
+                 "params": {"l": {"w": np.zeros(2)}}}
+        assert commit_meta(state)["health"] == \
+            "unhealthy:nonfinite_grad"
+        assert state_manifest(state)["health"] == \
+            "unhealthy:nonfinite_grad"
+        assert "health" not in commit_meta({"epoch": 1})
+
+    def test_scan_commits_surfaces_health_without_unpickling(
+            self, tmp_path):
+        from test_supervisor import _StateSnap, _state
+        st = dict(_state(), health="unhealthy:loss_spike")
+        snap = _StateSnap(st, directory=str(tmp_path), prefix="h",
+                          compression="gz")
+        snap.export()
+        scan = scan_commits(str(tmp_path), "h")
+        assert len(scan) == 1
+        entry = next(iter(scan.values()))
+        assert entry["health"] == "unhealthy:loss_spike"
+        assert entry["valid"] is True
+
+    def _reports(self, health_new):
+        def entry(name, health, mtime):
+            return {"path": name, "epoch": int(name[-1]),
+                    "mtime": mtime, "valid": True, "health": health}
+        reports = {}
+        for host in (0, 1):
+            reports[host] = {
+                "wf_1": entry("wf_1", "healthy", 100.0),
+                "wf_2": entry("wf_2", health_new, 200.0),
+            }
+        return reports
+
+    def test_agreement_prefers_older_healthy_over_newer_unhealthy(
+            self):
+        agreed, detail = agree_commits(
+            self._reports("unhealthy:nonfinite_grad"))
+        assert agreed == "wf_1"
+        assert detail["wf_2"]["healthy"] is False
+
+    def test_agreement_takes_newest_when_all_healthy(self):
+        agreed, _ = agree_commits(self._reports("healthy"))
+        assert agreed == "wf_2"
+
+    def test_agreement_falls_back_to_unhealthy_when_nothing_else(self):
+        reports = self._reports("unhealthy:loss_spike")
+        for rep in reports.values():
+            del rep["wf_1"]
+        agreed, _ = agree_commits(reports)
+        assert agreed == "wf_2"   # better a suspect commit than none
+
+    def test_newest_healthy_skips_unhealthy_and_invalid(self):
+        from veles_tpu.services.sentinel import HealthSentinel
+        scan = {
+            "wf_1": {"epoch": 1, "mtime": 1.0, "valid": True,
+                     "health": "healthy"},
+            "wf_2": {"epoch": 2, "mtime": 2.0, "valid": True,
+                     "health": None},          # legacy: trusted
+            "wf_3": {"epoch": 3, "mtime": 3.0, "valid": True,
+                     "health": "unhealthy:nonfinite_grad"},
+            "wf_4": {"epoch": 4, "mtime": 4.0, "valid": False,
+                     "health": "healthy"},
+        }
+        assert HealthSentinel._newest_healthy(scan) == "wf_2"
+
+
+# =====================================================================
+# classification + the supervisor / pod valves
+# =====================================================================
+_CHILD_NUMERICS_CRASH = """\
+import json, os, sys, time
+blackbox, progress = sys.argv[1], sys.argv[2]
+d = os.path.join(blackbox, "crashdump-%d" % int(time.time() * 1e6))
+os.makedirs(d)
+with open(os.path.join(d, "events.jsonl"), "w") as f:
+    f.write(json.dumps({"kind": "sentinel.giveup",
+                        "anomaly": "nonfinite_grad",
+                        "signature": "nonfinite_grad"}) + "\\n")
+with open(os.path.join(d, "meta.json"), "w") as f:
+    json.dump({"reason": "excepthook",
+               "error": {"type": "NumericFaultError",
+                         "message": "numeric fault"}}, f)
+# every life ADVANCES a checkpoint-progress marker: the numerics valve
+# must give up anyway (replay commits do not excuse divergence)
+open(os.path.join(progress, "c-%d" % time.time_ns()), "w").write("x")
+sys.exit(1)
+"""
+
+
+class TestNumericsClassification:
+    def _dump(self, tmp_path, events, meta=None):
+        d = tmp_path / ("crashdump-%d" % time.time_ns())
+        os.makedirs(d)
+        with open(d / "events.jsonl", "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        if meta is not None:
+            with open(d / "meta.json", "w") as f:
+                json.dump(meta, f)
+
+    def test_classify_exit_reads_sentinel_giveup(self, tmp_path):
+        self._dump(tmp_path,
+                   [{"kind": "step"},
+                    {"kind": "sentinel.giveup",
+                     "anomaly": "loss_spike",
+                     "signature": "loss_spike"}],
+                   meta={"error": {"type": "NumericFaultError",
+                                   "message": "boom"}})
+        kind, sig = classify_exit(1, str(tmp_path), since=0.0)
+        assert kind == "numerics:loss_spike"
+        assert sig == "numerics:loss_spike"
+
+    def test_fault_injection_still_wins(self, tmp_path):
+        self._dump(tmp_path, [{"kind": "fault.injected"},
+                              {"kind": "sentinel.giveup",
+                               "anomaly": "loss_spike"}])
+        kind, _ = classify_exit(1, str(tmp_path), since=0.0)
+        assert kind == "fault-injection"
+
+    def test_supervisor_numerics_valve_ignores_progress(self,
+                                                        tmp_path):
+        """deterministic_limit identical numerics give-ups end the run
+        even though every life advanced a checkpoint — replay commits
+        must not excuse identical divergence."""
+        from test_supervisor import _script
+        bb = tmp_path / "bb"
+        progress = tmp_path / "snaps"
+        os.makedirs(bb)
+        os.makedirs(progress)
+        child = _script(tmp_path, _CHILD_NUMERICS_CRASH)
+        sup = Supervisor(
+            [sys.executable, child, str(bb), str(progress)],
+            max_restarts=50, window_seconds=600,
+            backoff_base_ms=1, backoff_max_ms=2,
+            deterministic_limit=2, blackbox_dir=str(bb),
+            progress_paths=[str(progress)], install_signals=False)
+        assert sup.run() == 1
+        assert sup.spawn_count == 2
+        assert sup.giveup_reason == "numerics"
+        assert "deterministically" in sup.giveup_diagnosis
+        assert sup.restarts["numerics"] == 2
+        assert all(h["kind"] == "numerics:nonfinite_grad"
+                   for h in sup.history)
+
+    def test_pod_valves_sticky_signature(self):
+        from veles_tpu.services.podmaster import PodValves
+        valves = PodValves(max_restarts=50, window_seconds=600,
+                           deterministic_limit=2)
+        sig = ("0=numerics:nonfinite_grad",)
+        # progressed rounds normally RESET the deterministic counter...
+        assert valves.admit(1.0, sig, progressed=True) == "respawn"
+        assert valves.admit(2.0, sig, progressed=True) == "respawn"
+        assert valves.admit(3.0, sig, progressed=True) == "respawn"
+        # ...but numerics rounds judge the signature regardless
+        valves = PodValves(max_restarts=50, window_seconds=600,
+                           deterministic_limit=2)
+        assert valves.admit(1.0, sig, progressed=True,
+                            sticky_signature=True) == "respawn"
+        assert valves.admit(2.0, sig, progressed=True,
+                            sticky_signature=True) == \
+            "deterministic-bug"
+
+
+# =====================================================================
+# satellite 1: the reject_nonfinite valve is SURFACED, not just thrown
+# =====================================================================
+class TestNonfiniteSurfacing:
+    def test_refused_commit_counts_and_degrades_health(self, tmp_path):
+        from veles_tpu.telemetry import health as health_mod
+        from test_supervisor import _StateSnap, _state
+        saved = (health_mod._state["nonfinite_commits"],
+                 health_mod._state["nonfinite_last"])
+        try:
+            health_mod._state["nonfinite_commits"] = 0
+            health_mod._state["nonfinite_last"] = None
+            st = _state()
+            st["params"]["l0"]["weights"] = np.array([1.0, np.nan])
+            snap = _StateSnap(st, directory=str(tmp_path), prefix="nf")
+            counter = telemetry.registry.counter(
+                "veles_snapshot_nonfinite_total",
+                "checkpoint commits refused by the "
+                "reject_nonfinite poison valve")
+            before = counter.value()
+            with pytest.raises(SnapshotNonFiniteError):
+                snap.export()
+            assert counter.value() == before + 1
+            status = health_mod.status()
+            assert status["degraded"] is True
+            assert status["snapshot_nonfinite"]["count"] == 1
+            assert status["snapshot_nonfinite"]["last"]["prefix"] == \
+                "nf"
+            # the /api/health payload carries it end to end
+            from veles_tpu.services.web_status import WebStatusServer
+            web = WebStatusServer.__new__(WebStatusServer)
+            import threading
+            web._lock = threading.Lock()
+            web._serving = None
+            assert web.health_status()["degraded"] is True
+        finally:
+            (health_mod._state["nonfinite_commits"],
+             health_mod._state["nonfinite_last"]) = saved
+
+    def test_healthy_process_not_degraded(self):
+        from veles_tpu.telemetry import health as health_mod
+        saved = health_mod._state["nonfinite_commits"]
+        try:
+            health_mod._state["nonfinite_commits"] = 0
+            assert health_mod.status()["degraded"] in (False,)
+        finally:
+            health_mod._state["nonfinite_commits"] = saved
+
+
+# =====================================================================
+# satellite 2: rollback/replay reads as PROGRESS, never as a hang
+# =====================================================================
+class TestRollbackIsProgress:
+    def test_rollback_notes_progress_for_watchdog_and_pod_latch(
+            self, tmp_path, cfg_guard):
+        from veles_tpu.services.podmaster import classify_stall
+        from veles_tpu.telemetry import health as health_mod
+        # commit a healthy ring first
+        wf = _mlp_wf(tmp_path, epochs=2, name="sent-prog")
+        wf.initialize()
+        wf.run()
+        # stale the liveness clock, then roll back directly
+        health_mod._state["last_progress"] = \
+            time.monotonic() - 10_000.0
+        pending = {"anomaly": "nonfinite_grad", "class": 2,
+                   "deltas": {"nonfinite_grad": 1, "anomalies": 1},
+                   "first_bad_step": 30, "last_bad_step": 30}
+        wf.sentinel._rollback(pending)
+        age = health_mod.last_progress_age()
+        assert age is not None and age < 5.0, \
+            "rollback did not note progress — a hang watchdog would " \
+            "have tripped"
+        # the pod master's collective-hang latch sees the same signal:
+        # fresh progress_ts on every host -> no hang verdict
+        now = time.time()
+        hosts = {h: {"heartbeat_ts": now, "progress_ts": now,
+                     "worker_alive": True} for h in (0, 1)}
+        assert classify_stall(now, hosts, hang_seconds=300,
+                              stale_after=10.0) is None
+        assert wf.sentinel.rollbacks == 1
+        assert wf.trainer._skip_steps[0] == 30
+
+
+# =====================================================================
+# the ladder's strike/escalation accounting (host side, no training)
+# =====================================================================
+class TestLadderAccounting:
+    def _sentinel(self, strikes=2, escalate=3):
+        from veles_tpu.services.sentinel import HealthSentinel
+        s = HealthSentinel.__new__(HealthSentinel)
+        s.strikes_to_rollback = strikes
+        s.rollbacks_to_escalate = escalate
+        s.rollback_enabled = True
+        s.strikes = 0
+        s.rollbacks = 0
+        s.same_signature_rollbacks = 0
+        s.last_signature = None
+        s._seen = {k: 0.0 for k in sentinel._COUNTER_KEYS}
+        s._pending = None
+        s.history = []
+        s.snapshotter = object()   # rollback branch reachable
+        return s
+
+    def test_observe_sweep_deltas_and_latch(self):
+        s = self._sentinel()
+
+        class _T:
+            def reset_health_marks(self):
+                pass
+
+        s.trainer = _T()
+        h = {k: 0.0 for k in sentinel._COUNTER_KEYS}
+        h.update(first_bad_step=float(sentinel.NO_BAD_STEP),
+                 last_bad_step=-1.0)
+        assert s.observe_sweep(2, {}, h) is None
+        h2 = dict(h, anomalies=2.0, nonfinite_grad=2.0,
+                  first_bad_step=31.0, last_bad_step=33.0)
+        pending = s.observe_sweep(2, {}, h2)
+        assert pending["anomaly"] == "nonfinite_grad"
+        assert pending["first_bad_step"] == 31
+        # same cumulative counts again: no NEW anomalies, no latch
+        s._pending = None
+        assert s.observe_sweep(2, {}, h2) is None
+
+    def test_strikes_to_rollback_threshold(self, monkeypatch):
+        s = self._sentinel(strikes=2)
+        rolled = []
+        monkeypatch.setattr(
+            type(s), "_rollback", lambda self, p: rolled.append(p))
+        s._pending = {"anomaly": "loss_spike", "first_bad_step": 5,
+                      "deltas": {}}
+        s.run()
+        assert not rolled and s.strikes == 1
+        s._pending = {"anomaly": "loss_spike", "first_bad_step": 6,
+                      "deltas": {}}
+        s.run()
+        assert len(rolled) == 1 and s.strikes == 0

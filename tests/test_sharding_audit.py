@@ -373,7 +373,7 @@ class TestEstimateAccounting:
             mesh_config=mc22(), name="digits-ae")
         wf.initialize()
         spec = wf.trainer.lint_sharding_spec()
-        assert spec["args"][3] is spec["args"][5]   # data IS targets
+        assert spec["args"][4] is spec["args"][6]   # data IS targets
 
     def test_act_bytes_override_wins_over_heuristic(self):
         """The auditor feeds XLA's per-device temp bytes in as the
@@ -391,8 +391,9 @@ class TestStagedTrainerAudit:
     def test_hook_exposes_sharded_spec(self, digits_wf):
         spec = digits_wf.trainer.lint_sharding_spec()
         assert spec is not None
-        assert spec["carry_argnums"] == (0, 1, 2)
-        assert spec["donate_argnums"] == (0, 1, 2)
+        # params, velocity, class-stat acc, sentinel health
+        assert spec["carry_argnums"] == (0, 1, 2, 3)
+        assert spec["donate_argnums"] == (0, 1, 2, 3)
         assert spec["minibatch_bytes"] > 0
         for leaf in jax.tree_util.tree_leaves(spec["args"]):
             assert isinstance(leaf, jax.ShapeDtypeStruct)

@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""Chaos gate for the NUMERIC-fault survival tier (services.sentinel,
+docs/distributed_training.md "Numeric-fault survival") — the numerics
+twin of tools/train_chaos.py.
+
+Three legs over the same seeded workload (default: the self-contained
+digits MLP), all supervised (the sandbox startup flake must cost a
+respawn, not the gate):
+
+* **golden-skip** — an un-chaosed run whose sentinel is told to
+  policy-skip the target step (``root.common.sentinel.force_skip_steps``):
+  the reference trajectory for "that batch's update never applied".
+* **transient injection** — the same seed with NaN injected into the
+  gradient tree at exactly that step
+  (``root.common.chaos.nan_grads_step``).  The in-jit probes must
+  catch it (rung 1 skip keeps params finite), the sentinel must roll
+  back to the last HEALTHY commit **exactly once** and replay with the
+  poisoned minibatch skipped, and the final checkpoint must be
+  **bit-identical** (threshold 0) to the golden-skip run — rollback
+  and replay proven an exactness-preserving recovery, not a lossy one.
+* **persistent injection** — NaN on every step from the target onward
+  (``root.common.chaos.nan_grads_from``): the rollback ladder cannot
+  outrun it, so the run must escalate with a ``numerics:<kind>`` crash
+  class and the supervisor must trip its numerics give-up valve WITH a
+  diagnosis — bounded lives, checkpoints intact, no crash loop.
+
+Exit 0 iff every gate passes; ``--json`` writes the report,
+``--artifacts`` collects crashdumps + per-attempt logs for CI (the
+``numerics-chaos`` job runs this on synthetic MNIST).
+
+    python tools/numerics_chaos.py --epochs 6 --json report.json
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import chaos_common as cc   # noqa: E402 — path set above
+
+#: the sentinel's rollback log marker (HealthSentinel._rollback) — one
+#: line per rollback in the owning attempt's log
+ROLLBACK_RE = re.compile(r"sentinel rollback #(\d+):")
+
+
+def build_argv(workflow, config, snap_dir, seed, extra_config=()):
+    argv = [sys.executable, "-m", "veles_tpu", workflow]
+    if config:
+        argv.append(config)
+    cl = ["root.common.dirs.snapshots=%r" % str(snap_dir)]
+    cl += list(extra_config)
+    argv += ["--config-list"] + cl
+    argv += ["--backend", "cpu", "--random-seed", str(seed),
+             "--snapshot-every", "1", "--snapshot", "auto"]
+    return argv
+
+
+def run_supervised(argv, env, snap_dir, logs_dir, dumps_dir, seed,
+                   timeout, max_restarts=6, deterministic_limit=3):
+    """One leg under the respawn Supervisor; returns (rc, sup)."""
+    from veles_tpu.services.supervisor import Supervisor
+    sup = Supervisor(argv, env=env, max_restarts=max_restarts,
+                     window_seconds=max(timeout, 600),
+                     backoff_base_ms=50, backoff_max_ms=1000,
+                     deterministic_limit=deterministic_limit,
+                     blackbox_dir=dumps_dir, progress_paths=[snap_dir],
+                     log_dir=logs_dir, install_signals=False, seed=seed)
+    result = {}
+    runner = threading.Thread(
+        target=lambda: result.update(rc=sup.run()), daemon=True)
+    runner.start()
+    runner.join(timeout=timeout)
+    if runner.is_alive():
+        sup.stop()
+        runner.join(timeout=60)
+    return result.get("rc"), sup
+
+
+def count_rollbacks(logs_dir):
+    """Rollback markers across every attempt log of one leg."""
+    total, per_attempt = 0, {}
+    try:
+        names = sorted(os.listdir(logs_dir))
+    except OSError:
+        return 0, {}
+    for name in names:
+        if not name.startswith("attempt-"):
+            continue
+        try:
+            with open(os.path.join(logs_dir, name), "rb") as f:
+                text = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        n = len(ROLLBACK_RE.findall(text))
+        if n:
+            per_attempt[name] = n
+            total += n
+    return total, per_attempt
+
+
+def run_chaos(args):
+    workdir = args.workdir or tempfile.mkdtemp(prefix="numerics_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    dirs = {}
+    for leg in ("golden", "transient", "persistent"):
+        dirs[leg] = {
+            "snap": os.path.join(workdir, leg, "snapshots"),
+            "logs": os.path.join(workdir, leg, "logs"),
+        }
+        for d in dirs[leg].values():
+            os.makedirs(d, exist_ok=True)
+    dumps_dir = os.path.join(workdir, "dumps")
+    os.makedirs(dumps_dir, exist_ok=True)
+
+    workflow, config, prefix = args.workflow, args.config, args.prefix
+    extra = list(args.config_list)
+    if workflow is None:
+        workflow = cc.write_digits_workflow(
+            os.path.join(workdir, "chaos_workflow.py"),
+            ns="numerics_chaos", name="numerics-chaos",
+            default_epochs=args.epochs)
+        extra += ["root.numerics_chaos.max_epochs=%d" % args.epochs]
+        prefix = "numerics-chaos"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    common = extra + [
+        "root.common.snapshot.keep_last=%d" % args.keep_last,
+        "root.common.blackbox.dir=%r" % dumps_dir,
+        # the gate pins the LADDER's shape, so the knobs are explicit
+        # instead of riding defaults
+        "root.common.sentinel.strikes_to_rollback=1",
+        "root.common.sentinel.rollbacks_to_escalate=%d"
+        % args.rollbacks_to_escalate,
+    ]
+    step = args.nan_step
+    report = {"workdir": workdir, "prefix": prefix, "seed": args.seed,
+              "nan_step": step, "epochs": args.epochs}
+
+    # ---- leg 1: golden-skip ----------------------------------------
+    t0 = time.time()
+    golden_argv = build_argv(
+        workflow, config, dirs["golden"]["snap"], args.seed,
+        common + ["root.common.sentinel.force_skip_steps=(%d,)" % step])
+    print("[numerics-chaos] golden-skip run: %s" % " ".join(golden_argv),
+          flush=True)
+    rc, sup = run_supervised(golden_argv, env, dirs["golden"]["snap"],
+                             dirs["golden"]["logs"], dumps_dir,
+                             args.seed, args.timeout)
+    report["golden"] = {"rc": rc, "spawns": sup.spawn_count,
+                        "wall_s": round(time.time() - t0, 2)}
+    golden_final, _ = cc.current_target(dirs["golden"]["snap"], prefix)
+    report["golden"]["final"] = golden_final
+    if rc != 0 or golden_final is None:
+        report["error"] = "golden-skip run failed — see golden/logs/"
+        return report
+
+    # ---- leg 2: transient injection --------------------------------
+    t0 = time.time()
+    transient_argv = build_argv(
+        workflow, config, dirs["transient"]["snap"], args.seed,
+        common + ["root.common.chaos.nan_grads_step=%d" % step])
+    print("[numerics-chaos] transient run: %s"
+          % " ".join(transient_argv), flush=True)
+    rc, sup = run_supervised(
+        transient_argv, env, dirs["transient"]["snap"],
+        dirs["transient"]["logs"], dumps_dir, args.seed, args.timeout)
+    rollbacks, per_attempt = count_rollbacks(dirs["transient"]["logs"])
+    transient_final, _ = cc.current_target(dirs["transient"]["snap"],
+                                           prefix)
+    n_valid, invalid = cc.validate_ring(dirs["transient"]["snap"],
+                                        prefix)
+    report["transient"] = {
+        "rc": rc, "spawns": sup.spawn_count,
+        "wall_s": round(time.time() - t0, 2),
+        "rollbacks": rollbacks, "rollbacks_per_attempt": per_attempt,
+        "final": transient_final,
+        "quarantined": sorted(
+            n for n in os.listdir(dirs["transient"]["snap"])
+            if n.endswith(".corrupt")),
+        "ring_valid": n_valid, "ring_invalid": invalid,
+    }
+    if transient_final and golden_final:
+        from veles_tpu.scripts.compare_snapshots import diff_report
+        try:
+            report["transient"]["exactness"] = diff_report(
+                golden_final, transient_final, threshold=0.0)
+        except Exception as e:   # noqa: BLE001 — report; gate fails
+            report["transient"]["exactness"] = {"identical": False,
+                                                "error": str(e)}
+
+    # ---- leg 3: persistent injection -------------------------------
+    t0 = time.time()
+    persistent_argv = build_argv(
+        workflow, config, dirs["persistent"]["snap"], args.seed,
+        common + ["root.common.chaos.nan_grads_from=%d" % step])
+    print("[numerics-chaos] persistent run: %s"
+          % " ".join(persistent_argv), flush=True)
+    rc, sup = run_supervised(
+        persistent_argv, env, dirs["persistent"]["snap"],
+        dirs["persistent"]["logs"], dumps_dir, args.seed, args.timeout,
+        max_restarts=args.deterministic_limit + 6,
+        deterministic_limit=args.deterministic_limit)
+    n_valid, invalid = cc.validate_ring(dirs["persistent"]["snap"],
+                                        prefix)
+    persistent_final, _ = cc.current_target(dirs["persistent"]["snap"],
+                                            prefix)
+    current_imports = None
+    if persistent_final:
+        from veles_tpu.services.snapshotter import SnapshotterBase
+        try:
+            SnapshotterBase.import_(persistent_final)
+            current_imports = True
+        except Exception as e:   # noqa: BLE001 — the audit itself
+            current_imports = False
+            report.setdefault("errors", []).append(
+                "persistent _current unimportable: %s" % e)
+    report["persistent"] = {
+        "rc": rc, "spawns": sup.spawn_count,
+        "wall_s": round(time.time() - t0, 2),
+        "giveup_reason": sup.giveup_reason,
+        "giveup_diagnosis": sup.giveup_diagnosis,
+        "history_kinds": [h["kind"] for h in sup.history],
+        "final": persistent_final, "current_imports": current_imports,
+        "ring_valid": n_valid, "ring_invalid": invalid,
+    }
+    return report
+
+
+def gates(report, args):
+    fails = []
+    if report.get("error"):
+        fails.append(report["error"])
+        return fails
+    if report.get("golden", {}).get("rc") != 0:
+        fails.append("golden-skip rc=%s" % report["golden"].get("rc"))
+
+    t = report.get("transient", {})
+    if t.get("rc") != 0:
+        fails.append("transient run rc=%s (must recover and finish)"
+                     % t.get("rc"))
+    if t.get("rollbacks") != 1:
+        fails.append("transient injection cost %s rollbacks, expected "
+                     "exactly 1" % t.get("rollbacks"))
+    if not t.get("quarantined"):
+        fails.append("the poisoned (unhealthy) commit was never "
+                     "quarantined on rollback")
+    if t.get("ring_invalid"):
+        fails.append("transient ring has invalid commits: %s"
+                     % t["ring_invalid"])
+    exact = t.get("exactness")
+    if not exact:
+        fails.append("no exactness verdict (missing final checkpoint)")
+    elif not exact.get("identical"):
+        detail = exact.get("error") or exact.get("diffs", [])[:5]
+        fails.append("rollback+replay final state NOT bit-identical "
+                     "to the golden skip-batch run: %s" % (detail,))
+
+    p = report.get("persistent", {})
+    if not p.get("rc"):
+        fails.append("persistent injection exited rc=%s — it must "
+                     "give up, not succeed" % p.get("rc"))
+    if p.get("giveup_reason") != "numerics":
+        fails.append("supervisor give-up reason %r, expected "
+                     "'numerics' (the deterministic numeric-fault "
+                     "valve)" % p.get("giveup_reason"))
+    if not p.get("giveup_diagnosis"):
+        fails.append("numerics give-up carried no diagnosis")
+    kinds = p.get("history_kinds", [])
+    if not any(str(k).startswith("numerics:") for k in kinds):
+        fails.append("no numerics:<kind> exit classified (history: %s)"
+                     % kinds)
+    if p.get("spawns", 0) > args.deterministic_limit + 4:
+        fails.append("persistent injection crash-looped: %d spawns "
+                     "for deterministic_limit=%d"
+                     % (p.get("spawns", 0), args.deterministic_limit))
+    if p.get("ring_invalid"):
+        fails.append("persistent ring has invalid commits (data NOT "
+                     "intact): %s" % p["ring_invalid"])
+    if p.get("ring_valid", 0) < 1:
+        fails.append("persistent give-up left no valid checkpoint")
+    if p.get("current_imports") is False:
+        fails.append("persistent _current does not import")
+    return fails
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="chaos gate for the numeric-fault survival tier "
+        "(docs/distributed_training.md)")
+    p.add_argument("--workflow", default=None,
+                   help="workflow .py (default: self-contained digits "
+                   "MLP)")
+    p.add_argument("--config", default=None, help="config .py")
+    p.add_argument("--config-list", nargs="*", default=[],
+                   help="extra inline config statements for ALL legs")
+    p.add_argument("--prefix", default=None,
+                   help="snapshot prefix (required with --workflow)")
+    p.add_argument("--epochs", type=int, default=6,
+                   help="epochs for the default digits workload")
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--nan-step", type=int, default=30,
+                   help="staged train step to poison (must land after "
+                   "the first epoch's commit so a healthy rollback "
+                   "target exists)")
+    p.add_argument("--rollbacks-to-escalate", type=int, default=1,
+                   help="sentinel rollbacks before rung-3 escalation "
+                   "(per life)")
+    p.add_argument("--deterministic-limit", type=int, default=2,
+                   help="supervisor numerics valve: identical "
+                   "numeric-fault give-ups before giving up for good")
+    p.add_argument("--keep-last", type=int, default=6,
+                   help="checkpoint ring size for all legs")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--workdir", default=None,
+                   help="working directory (default: fresh tempdir; "
+                   "kept on failure, removed on success unless given)")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="write the full report here")
+    p.add_argument("--artifacts", default=None, metavar="DIR",
+                   help="collect crashdumps + attempt logs + a flight "
+                   "dump here (CI upload)")
+    args = p.parse_args(argv)
+    if args.workflow is not None and args.prefix is None:
+        p.error("--workflow needs --prefix")
+
+    report = run_chaos(args)
+    fails = gates(report, args)
+    report["gates_failed"] = fails
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print("[numerics-chaos] report -> %s" % args.json)
+    if args.artifacts:
+        os.makedirs(args.artifacts, exist_ok=True)
+        workdir = report.get("workdir")
+        for leg in ("golden", "transient", "persistent"):
+            src = os.path.join(workdir, leg, "logs")
+            if os.path.isdir(src):
+                shutil.copytree(
+                    src, os.path.join(args.artifacts, leg + "-logs"),
+                    dirs_exist_ok=True)
+        src = os.path.join(workdir, "dumps")
+        if os.path.isdir(src):
+            shutil.copytree(src, os.path.join(args.artifacts, "dumps"),
+                            dirs_exist_ok=True)
+        from veles_tpu.telemetry import flight
+        flight.dump(directory=args.artifacts, reason="numerics-chaos")
+        print("[numerics-chaos] artifacts -> %s" % args.artifacts)
+
+    summary = {
+        "golden_rc": report.get("golden", {}).get("rc"),
+        "transient_rc": report.get("transient", {}).get("rc"),
+        "transient_rollbacks": report.get("transient",
+                                          {}).get("rollbacks"),
+        "persistent_rc": report.get("persistent", {}).get("rc"),
+        "persistent_giveup": report.get("persistent",
+                                        {}).get("giveup_reason"),
+    }
+    print(json.dumps(summary, default=str))
+    if fails:
+        print("[numerics-chaos] GATES FAILED:", flush=True)
+        for f in fails:
+            print("  - %s" % f)
+        print("[numerics-chaos] workdir kept: %s"
+              % report.get("workdir"))
+        return 1
+    exact = report.get("transient", {}).get("exactness", {})
+    print("[numerics-chaos] ALL GATES PASSED: transient NaN recovered "
+          "with exactly one rollback, final state bit-identical to the "
+          "golden skip-batch run (%d leaves); persistent NaN tripped "
+          "the numerics give-up valve with checkpoints intact"
+          % exact.get("n_leaves", 0))
+    if args.workdir is None:
+        shutil.rmtree(report["workdir"], ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -250,6 +250,18 @@ class Main(object):
                        "(the run is NOT killed; read dumps with "
                        "veles-tpu-blackbox).  Default: off standalone, "
                        "300 s in spmd mode")
+        p.add_argument("--sentinel", choices=("on", "off"), default=None,
+                       help="the numeric-fault sentinel "
+                       "(services.sentinel; default on): in-jit health "
+                       "probes on every staged train step — "
+                       "loss/grad-norm finiteness, EWMA loss-spike "
+                       "z-score, update-norm explosion — with "
+                       "skip-update, automatic rollback to the last "
+                       "healthy commit + exact replay, and a "
+                       "numerics:<kind> give-up class; 'off' sets "
+                       "root.common.sentinel.enabled=False "
+                       "(docs/distributed_training.md \"Numeric-fault "
+                       "survival\")")
         p.add_argument("--sync-run", action="store_true",
                        help="block on the device after every trainer step "
                        "for honest per-unit timing (ref --sync-run, "
@@ -321,6 +333,8 @@ class Main(object):
             root.common.engine.sync_run = True
         if args.watchdog is not None:
             root.common.blackbox.watchdog_seconds = args.watchdog
+        if args.sentinel is not None:
+            root.common.sentinel.enabled = args.sentinel == "on"
         if args.steps_per_dispatch is not None:
             root.common.engine.steps_per_dispatch = args.steps_per_dispatch
 

@@ -165,12 +165,46 @@ root.common.update({
     "supervise": {"max_restarts": 8, "window_seconds": 600,
                   "backoff_base_ms": 200, "backoff_max_ms": 30000,
                   "deterministic_limit": 3},
-    # chaos/fault-drill knobs (tools/train_chaos.py, tools/pod_chaos.py):
+    # chaos/fault-drill knobs (tools/train_chaos.py, tools/pod_chaos.py,
+    # tools/numerics_chaos.py):
     # unit_delay_ms sleeps per scheduler unit-run so external kills land
     # mid-sweep; with unit_delay_file set the sleep additionally
     # requires that file to EXIST, letting a harness switch a long
-    # stall on mid-run (the pod chaos gate's forged collective hang)
-    "chaos": {"unit_delay_ms": 0, "unit_delay_file": None},
+    # stall on mid-run (the pod chaos gate's forged collective hang).
+    # nan_grads_step poisons the gradient tree with NaN at exactly that
+    # staged train step (transient numeric fault); nan_grads_from
+    # poisons every step >= that counter (persistent divergence) — both
+    # are build-time gates inside the jitted step, zero cost when unset
+    # (the numerics-chaos gate's injection hooks).
+    "chaos": {"unit_delay_ms": 0, "unit_delay_file": None,
+              "nan_grads_step": None, "nan_grads_from": None},
+    # the numeric-fault survival tier (services.sentinel,
+    # docs/distributed_training.md "Numeric-fault survival"): cheap
+    # in-jit health probes fused into the staged train step —
+    # loss/grad-norm finiteness, EWMA loss-spike z-score, update-norm
+    # explosion — read back at the existing read_class_stats sync
+    # point (no extra device sync per step), driving a three-rung
+    # response ladder: (1) in-jit skip-update of a poisoned step via
+    # select (bit-deterministic), (2) after strikes_to_rollback
+    # anomalous sweeps, automatic rollback to the last HEALTHY commit
+    # plus deterministic replay that skips the poisoned global
+    # minibatch (the skip list rides max_skip_steps traced slots, so
+    # growing it never recompiles), (3) after rollbacks_to_escalate
+    # rollbacks with an identical anomaly signature, escalate with a
+    # numerics:<kind> crash class the supervisor/pod master classify
+    # under the deterministic-bug valve instead of crash-looping.
+    # spike_zscore/spike_warmup tune the EWMA loss-spike probe (the
+    # z threshold only fires after warmup observations);
+    # update_norm_limit bounds the global update L2 norm (explosion);
+    # force_skip_steps pre-loads the skip list (the numerics-chaos
+    # golden-skip leg); rollback=False degrades rung 2 to escalation
+    # (pods always escalate: pod-scope rollback rides the coordinated
+    # restart, whose checkpoint agreement prefers healthy commits).
+    "sentinel": {"enabled": True, "strikes_to_rollback": 1,
+                 "rollbacks_to_escalate": 3, "spike_zscore": 12.0,
+                 "spike_warmup": 64, "update_norm_limit": 1e6,
+                 "ewma_decay": 0.99, "max_skip_steps": 8,
+                 "force_skip_steps": (), "rollback": True},
     # the pod survival tier (services.podmaster, `veles-tpu-pod`):
     # a pod master coordinates one per-host supervisor agent per host.
     # Agents heartbeat every heartbeat_ms; an agent silent for

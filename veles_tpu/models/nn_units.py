@@ -123,6 +123,21 @@ class StagedTrainer(Unit):
         self.lr_scale = 1.0
         self.train_only_classes = (TRAIN,)
         self.view_group = "TRAINER"
+        #: the numeric-fault sentinel (services.sentinel): build-time
+        #: probe knobs, the device-resident health accumulator carried
+        #: through every train step, the traced replay skip list, and
+        #: the HealthSentinel unit observing the sync point (set by
+        #: StandardWorkflow wiring; None = probes report to nobody)
+        from veles_tpu.services import sentinel as _sentinel
+        self._sentinel_cfg = _sentinel.probe_config()
+        self.sentinel = None
+        self.health = None
+        self._health_host = None
+        self._health_committed = {}
+        self._skip_steps = _sentinel.skip_steps_array(
+            self._sentinel_cfg["force_skip_steps"],
+            self._sentinel_cfg["max_skip_steps"])
+        self._skip_dev = None
         #: step telemetry: per-class sweep accumulators
         #: {cls: [t0, steps]} — opened by the first staged step of a
         #: class sweep, closed (and emitted) at the read_class_stats
@@ -208,7 +223,108 @@ class StagedTrainer(Unit):
             self.velocity = sharding.shard_params(self.velocity, mc,
                                                   self._param_overrides)
         self.reset_epoch_stats()
+        from veles_tpu.services import sentinel as _sentinel
+        self.health = _sentinel.init_health()
+        self._skip_dev = jnp.asarray(self._skip_steps)
         self._build_steps()
+
+    # ----------------------------------------------------- numeric fault
+    def add_skip_steps(self, steps):
+        """Arm the replay skip list (services.sentinel rung 2): these
+        staged-step counters' updates are gated off inside the jitted
+        step.  Values change without a recompile (the list's CAPACITY
+        is the static shape); overflowing the capacity raises — a
+        replay that cannot represent its skip set is not exact."""
+        from veles_tpu.services import sentinel as _sentinel
+        cap = self._sentinel_cfg["max_skip_steps"]
+        merged = sorted(
+            {int(s) for s in self._skip_steps if int(s) >= 0}
+            | {int(s) for s in steps})
+        if len(merged) > cap:
+            raise ValueError(
+                "skip list overflow: %d poisoned steps exceed "
+                "root.common.sentinel.max_skip_steps=%d — the replay "
+                "could not stay exact" % (len(merged), cap))
+        self._skip_steps = _sentinel.skip_steps_array(merged, cap)
+        self._skip_dev = jnp.asarray(self._skip_steps)
+
+    def reset_health_marks(self):
+        """Clear the per-incident first/last-bad-step marks (the
+        sentinel calls this after latching an incident, so the NEXT
+        sweep's marks identify freshly poisoned steps instead of
+        re-reporting the all-time minimum).  Host-side leaf swap, no
+        device sync; the counters stay cumulative."""
+        if self.health is None:
+            return
+        from veles_tpu.services import sentinel as _sentinel
+        self.health = dict(
+            self.health,
+            first_bad_step=jnp.full((), _sentinel.NO_BAD_STEP,
+                                    jnp.int32),
+            last_bad_step=jnp.full((), -1, jnp.int32))
+
+    def _chaos_poison(self, grads, step):
+        """The numerics-chaos injection hooks
+        (``root.common.chaos.nan_grads_step`` / ``nan_grads_from``,
+        tools/numerics_chaos.py): poison the whole gradient tree with
+        NaN at the configured staged step(s).  A build-time gate —
+        identity (zero ops traced) when unarmed."""
+        from veles_tpu.config import root as _root
+        nan_step = _root.common.chaos.get("nan_grads_step", None)
+        nan_from = _root.common.chaos.get("nan_grads_from", None)
+        if nan_step is None and nan_from is None:
+            return grads
+        hit = jnp.zeros((), bool)
+        if nan_step is not None:
+            hit = hit | (step == jnp.int32(int(nan_step)))
+        if nan_from is not None:
+            hit = hit | (step >= jnp.int32(int(nan_from)))
+        return jax.tree_util.tree_map(
+            lambda g: jnp.where(hit, jnp.full_like(g, jnp.nan), g),
+            grads)
+
+    def _sentinel_gate(self, params, velocity, new_params, new_velocity,
+                       health, loss, grads, step, skip_steps):
+        """In-jit rung 1 (services.sentinel): run the health probes and
+        select the pre-step params/velocity when the step is poisoned
+        or policy-skipped — a ``where`` with a scalar predicate, so the
+        applied branch is bit-exact either way.  Disabled sentinel
+        passes everything through untouched (same traced signature, no
+        extra ops)."""
+        if not self._sentinel_cfg["enabled"]:
+            return new_params, new_velocity, health
+        from veles_tpu.services import sentinel as _sentinel
+        health, ok = _sentinel.apply_probes(
+            health, loss, grads, new_params, params, step, skip_steps,
+            self._sentinel_cfg)
+
+        def sel(new, old):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+
+        return sel(new_params, params), sel(new_velocity, velocity), \
+            health
+
+    def health_verdict(self):
+        """Commit-time health stamp for the snapshotter: ``"healthy"``
+        when no anomaly landed since the previous verdict,
+        ``"unhealthy:<kind>"`` otherwise (consumes the delta).  Reads
+        the device accumulator directly — the commit path already
+        gathers the whole model, one more scalar fetch is noise."""
+        if self.health is None:
+            return None
+        from veles_tpu.services import sentinel as _sentinel
+        h = jax.device_get(self.health)
+        keys = _sentinel.ANOMALY_KINDS + ("anomalies",)
+        deltas = {}
+        for k in keys:
+            cur = float(h.get(k, 0.0))
+            deltas[k] = cur - self._health_committed.get(k, 0.0)
+            self._health_committed[k] = cur
+        if deltas.get("anomalies", 0) > 0:
+            kind = _sentinel.dominant_kind(deltas) or "unknown"
+            return "unhealthy:%s" % kind
+        return "healthy"
 
     def _forward(self, params, x, train, key):
         for i, layer in enumerate(self.layers):
@@ -296,8 +412,8 @@ class StagedTrainer(Unit):
             targets = loader.data   # autoencoder: reconstruct the input
         hypers = self._hypers
 
-        def train_step(params, velocity, acc, data, labels, targets, idx,
-                       valid, step, lr_scale):
+        def train_step(params, velocity, acc, health, data, labels,
+                       targets, idx, valid, step, lr_scale, skip_steps):
             key = jax.random.fold_in(self._base_key, step)
 
             def loss_fn(p):
@@ -305,13 +421,18 @@ class StagedTrainer(Unit):
                     p, data, labels, targets, idx, valid, True, key)
                 return loss, stats
 
-            grads, stats = jax.grad(loss_fn, has_aux=True)(params)
-            params, velocity = optimizer.update(
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = self._chaos_poison(grads, step)
+            new_params, new_velocity = optimizer.update(
                 params, grads, velocity, hypers, lr_scale=lr_scale,
                 clip_norm=self.clip_norm, grad_accum=self.grad_accum,
                 ema_decay=self.ema_decay)
+            params, velocity, health = self._sentinel_gate(
+                params, velocity, new_params, new_velocity, health,
+                loss, grads, step, skip_steps)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
-            return params, velocity, acc
+            return params, velocity, acc, health
 
         def eval_step(params, acc, data, labels, targets, idx, valid):
             _, stats = self._loss_and_stats(
@@ -354,17 +475,18 @@ class StagedTrainer(Unit):
         if self.steps_per_dispatch <= 1:
             return
 
-        def train_sweep(params, velocity, acc, data, labels, targets,
-                        idxs, valids, steps, lr_scales):
+        def train_sweep(params, velocity, acc, health, data, labels,
+                        targets, idxs, valids, steps, lr_scales,
+                        skip_steps):
             def body(carry, inp):
                 idx, valid, step, lr_s = inp
                 return train_step(*carry, data, labels, targets, idx,
-                                  valid, step, lr_s), None
+                                  valid, step, lr_s, skip_steps), None
 
-            (params, velocity, acc), _ = jax.lax.scan(
-                body, (params, velocity, acc),
+            (params, velocity, acc, health), _ = jax.lax.scan(
+                body, (params, velocity, acc, health),
                 (idxs, valids, steps, lr_scales))
-            return params, velocity, acc
+            return params, velocity, acc, health
 
         def eval_sweep(params, acc, data, labels, targets, idxs, valids):
             def body(a, inp):
@@ -377,29 +499,35 @@ class StagedTrainer(Unit):
         pins = self._shard_pins()
         if pins is None:
             self._sweeps = (
-                jax.jit(train_sweep, donate_argnums=(0, 1, 2)),
+                jax.jit(train_sweep, donate_argnums=(0, 1, 2, 3)),
                 jax.jit(eval_sweep, donate_argnums=(1,)))
             return
-        p_sh, v_sh, acc_sh = pins
+        p_sh, v_sh, acc_sh, health_sh = pins
         self._sweeps = (
-            jax.jit(train_sweep, donate_argnums=(0, 1, 2),
-                    out_shardings=(p_sh, v_sh, acc_sh)),
+            jax.jit(train_sweep, donate_argnums=(0, 1, 2, 3),
+                    out_shardings=(p_sh, v_sh, acc_sh, health_sh)),
             jax.jit(eval_sweep, donate_argnums=(1,),
                     out_shardings=acc_sh))
 
     def _shard_pins(self):
-        """(params, velocity, acc) output shardings under a mesh (params/
-        velocity per the partition rules, stat accumulators replicated);
-        None on a single device."""
+        """(params, velocity, acc, health) output shardings under a
+        mesh (params/velocity per the partition rules, stat and
+        sentinel-health accumulators replicated); None on a single
+        device."""
         if self.mesh_config is None:
             return None
         from veles_tpu.parallel import sharding
         mc = self.mesh_config
         repl = sharding.replicated_sharding(mc)
         overrides = getattr(self, "_param_overrides", None)
+        from veles_tpu.services import sentinel as _sentinel
+        health_struct = (self.health if self.health is not None
+                         else _sentinel.init_health())
         return (sharding.param_shardings(self.params, mc, overrides),
                 sharding.param_shardings(self.velocity, mc, overrides),
-                jax.tree_util.tree_map(lambda _: repl, self._zero_stats()))
+                jax.tree_util.tree_map(lambda _: repl,
+                                       self._zero_stats()),
+                jax.tree_util.tree_map(lambda _: repl, health_struct))
 
     def _jit_steps(self, train_step, eval_step):
         """jit the pair with donation; under a mesh, pin the output
@@ -407,12 +535,14 @@ class StagedTrainer(Unit):
         the fused sweeps) so the paths cannot diverge."""
         pins = self._shard_pins()
         if pins is None:
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            self._train_step = jax.jit(train_step,
+                                       donate_argnums=(0, 1, 2, 3))
             self._eval_step = jax.jit(eval_step, donate_argnums=(1,))
             return
-        p_sh, v_sh, acc_sh = pins
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2),
-                                   out_shardings=(p_sh, v_sh, acc_sh))
+        p_sh, v_sh, acc_sh, health_sh = pins
+        self._train_step = jax.jit(
+            train_step, donate_argnums=(0, 1, 2, 3),
+            out_shardings=(p_sh, v_sh, acc_sh, health_sh))
         self._eval_step = jax.jit(eval_step, donate_argnums=(1,),
                                   out_shardings=acc_sh)
 
@@ -427,21 +557,26 @@ class StagedTrainer(Unit):
         minibatch_targets when present, else reconstructs the input."""
         hypers = self._hypers
 
-        def train_step(params, velocity, acc, x, lbl, tgt, valid, step,
-                       lr_scale):
+        def train_step(params, velocity, acc, health, x, lbl, tgt,
+                       valid, step, lr_scale, skip_steps):
             key = jax.random.fold_in(self._base_key, step)
 
             def loss_fn(p):
                 return self._loss_from_batch(p, x, lbl, tgt, valid, True,
                                              key)
 
-            grads, stats = jax.grad(loss_fn, has_aux=True)(params)
-            params, velocity = optimizer.update(
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = self._chaos_poison(grads, step)
+            new_params, new_velocity = optimizer.update(
                 params, grads, velocity, hypers, lr_scale=lr_scale,
                 clip_norm=self.clip_norm, grad_accum=self.grad_accum,
                 ema_decay=self.ema_decay)
+            params, velocity, health = self._sentinel_gate(
+                params, velocity, new_params, new_velocity, health,
+                loss, grads, step, skip_steps)
             acc = jax.tree_util.tree_map(jnp.add, acc, stats)
-            return params, velocity, acc
+            return params, velocity, acc, health
 
         def eval_step(params, acc, x, lbl, tgt, valid):
             _, stats = self._loss_from_batch(params, x, lbl, tgt, valid,
@@ -500,11 +635,11 @@ class StagedTrainer(Unit):
                 valid = jnp.asarray(loader.minibatch_valid)
             if cls in self.train_only_classes:
                 self._step_counter += 1
-                self.params, self.velocity, self.class_stats[cls] = \
-                    self._train_step(self.params, self.velocity,
-                                     self.class_stats[cls], x, lbl, tgt,
-                                     valid, self._step_counter,
-                                     jnp.float32(self.lr_scale))
+                (self.params, self.velocity, self.class_stats[cls],
+                 self.health) = self._train_step(
+                    self.params, self.velocity, self.class_stats[cls],
+                    self.health, x, lbl, tgt, valid, self._step_counter,
+                    jnp.float32(self.lr_scale), self._skip_dev)
             else:
                 self.class_stats[cls] = self._eval_step(
                     self.params, self.class_stats[cls], x, lbl, tgt, valid)
@@ -535,12 +670,12 @@ class StagedTrainer(Unit):
             valid = jnp.asarray(loader.minibatch_valid)
         if cls in self.train_only_classes:
             self._step_counter += 1
-            self.params, self.velocity, self.class_stats[cls] = \
-                self._train_step(self.params, self.velocity,
-                                 self.class_stats[cls], self._data_dev,
-                                 self._labels_dev, self._targets_dev, idx,
-                                 valid, self._step_counter,
-                                 jnp.float32(self.lr_scale))
+            (self.params, self.velocity, self.class_stats[cls],
+             self.health) = self._train_step(
+                self.params, self.velocity, self.class_stats[cls],
+                self.health, self._data_dev, self._labels_dev,
+                self._targets_dev, idx, valid, self._step_counter,
+                jnp.float32(self.lr_scale), self._skip_dev)
         else:
             self.class_stats[cls] = self._eval_step(
                 self.params, self.class_stats[cls], self._data_dev,
@@ -586,11 +721,12 @@ class StagedTrainer(Unit):
             if train:
                 steps = jnp.asarray([g[2] for g in group], jnp.int32)
                 lrs = jnp.asarray([g[3] for g in group], jnp.float32)
-                self.params, self.velocity, self.class_stats[cls] = \
-                    train_sweep(self.params, self.velocity,
-                                self.class_stats[cls], self._data_dev,
-                                self._labels_dev, self._targets_dev,
-                                idxs, valids, steps, lrs)
+                (self.params, self.velocity, self.class_stats[cls],
+                 self.health) = train_sweep(
+                    self.params, self.velocity, self.class_stats[cls],
+                    self.health, self._data_dev, self._labels_dev,
+                    self._targets_dev, idxs, valids, steps, lrs,
+                    self._skip_dev)
             else:
                 self.class_stats[cls] = eval_sweep(
                     self.params, self.class_stats[cls], self._data_dev,
@@ -605,11 +741,12 @@ class StagedTrainer(Unit):
             else:
                 idx, valid = jnp.asarray(idx), jnp.asarray(valid)
             if train:
-                self.params, self.velocity, self.class_stats[cls] = \
-                    self._train_step(self.params, self.velocity,
-                                     self.class_stats[cls], self._data_dev,
-                                     self._labels_dev, self._targets_dev,
-                                     idx, valid, step, jnp.float32(lr))
+                (self.params, self.velocity, self.class_stats[cls],
+                 self.health) = self._train_step(
+                    self.params, self.velocity, self.class_stats[cls],
+                    self.health, self._data_dev, self._labels_dev,
+                    self._targets_dev, idx, valid, step,
+                    jnp.float32(lr), self._skip_dev)
             else:
                 self.class_stats[cls] = self._eval_step(
                     self.params, self.class_stats[cls], self._data_dev,
@@ -698,6 +835,19 @@ class StagedTrainer(Unit):
             "step", step=self._step_counter, steps=steps,
             examples=examples, wall_s=wall, loss=loss_mean, **lbl)
         telemetry.health.note_progress(step=self._step_counter)
+        if self._health_host is not None:
+            # sentinel health (services.sentinel), read off the SAME
+            # device_get as the class stats — cumulative counters as
+            # gauges (the anomaly/rollback counters live in the
+            # sentinel unit; these are the raw in-jit probe tallies)
+            reg.gauge("veles_sentinel_skipped_updates",
+                      "cumulative staged updates zeroed by the in-jit "
+                      "sentinel (anomaly skips)").set(
+                float(self._health_host.get("skipped", 0.0)))
+            reg.gauge("veles_sentinel_policy_skips",
+                      "cumulative policy-skipped updates (replay skip "
+                      "list / force_skip_steps)").set(
+                float(self._health_host.get("policy_skips", 0.0)))
         # the live-array census is the one per-sweep cost that scales
         # with model size (O(arrays x shards) host walk): pay it only
         # when something consumes it — an open --metrics-out sink or a
@@ -721,12 +871,22 @@ class StagedTrainer(Unit):
         self.class_stats = [self._zero_stats() for _ in range(3)]
 
     def read_class_stats(self, cls):
-        """Device→host sync — called once per class sweep by Decision."""
+        """Device→host sync — called once per class sweep by Decision.
+        The sentinel's health accumulator rides the SAME device_get as
+        the class stats: the probe results cost zero extra sync points
+        (the PR 3 telemetry budget the numerics-chaos gate pins)."""
         self.flush()
-        st = jax.device_get(self.class_stats[cls])
+        st, health = jax.device_get((self.class_stats[cls],
+                                     self.health))
+        self._health_host = health
         stats = {"loss": float(st["loss"]),
                  "n_errors": int(st["n_errors"]),
                  "count": int(st["count"])}
+        if self.sentinel is not None and health is not None:
+            # strike accounting is CONTROL, not telemetry — it runs
+            # outside the fail-soft guard (the ladder acts at the
+            # sentinel unit's own slot in the cycle, never mid-read)
+            self.sentinel.observe_sweep(cls, stats, health)
         # the sweep's wall clock closes HERE, after the device_get that
         # drains every async dispatch — the only honest step-time sample
         # the staged hot loop offers without adding sync points
@@ -785,20 +945,32 @@ class StagedTrainer(Unit):
             if not mod.startswith("veles_tpu"):
                 host_scan.append(layer.apply)
 
+        #: sentinel-health leaves that are nonnegative by construction
+        #: (counters, the EWM variance, the +inf-seeded first-bad-step)
+        _health_nonneg = frozenset(
+            ("ewma_var", "obs", "first_bad_step", "anomalies",
+             "skipped", "policy_skips", "nonfinite_loss",
+             "nonfinite_grad", "update_explosion", "loss_spike"))
+
         def step_leaf_flags(args):
             # vouch for the counters the auditor cannot see: the step
-            # arg (argnum 8) increments BEFORE dispatch (_run_step), so
-            # it is >= 1 inside the step, and the optimizer's step/micro
+            # arg (argnum 9) increments BEFORE dispatch (_run_step), so
+            # it is >= 1 inside the step, the optimizer's step/micro
             # slots (velocity tree) only ever count up from 0 — that is
-            # what proves adam's 1 - beta**t bias correction positive
+            # what proves adam's 1 - beta**t bias correction positive —
+            # and the sentinel health accumulator (argnum 3) carries
+            # nonnegative counters/variance
             flags, idx = {}, 0
             for ai, a in enumerate(args):
                 for path, _leaf in \
                         jax.tree_util.tree_flatten_with_path(a)[0]:
-                    if ai == 8:
+                    key = (getattr(path[-1], "key", None)
+                           if path else None)
+                    if ai == 9:
                         flags[idx] = ("pos", "nonneg")
-                    elif path and getattr(path[-1], "key", None) in \
-                            ("step", "micro"):
+                    elif key in ("step", "micro"):
+                        flags[idx] = ("nonneg",)
+                    elif ai == 3 and key in _health_nonneg:
                         flags[idx] = ("nonneg",)
                     idx += 1
             return flags
@@ -819,13 +991,14 @@ class StagedTrainer(Unit):
 
         mb = self.loader.minibatch_size
         args = (abstract(self.params), abstract(self.velocity),
-                abstract(self.class_stats[0]),
+                abstract(self.class_stats[0]), abstract(self.health),
                 abstract(self._data_dev), abstract(self._labels_dev),
                 abstract(self._targets_dev),
                 jax.ShapeDtypeStruct((mb,), jnp.int32),
                 jax.ShapeDtypeStruct((mb,), jnp.float32),
                 jax.ShapeDtypeStruct((), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.float32))
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct(self._skip_steps.shape, jnp.int32))
         return {"fn": step, "args": args, "suppress": suppress,
                 "host_scan": tuple(host_scan),
                 "input_flags": step_leaf_flags(args),
@@ -869,7 +1042,7 @@ class StagedTrainer(Unit):
         tree_abs = lambda t: jax.tree_util.tree_map(abstract, t)  # noqa: E731
         mb = self.loader.minibatch_size
         args = (tree_abs(self.params), tree_abs(self.velocity),
-                tree_abs(self.class_stats[0]),
+                tree_abs(self.class_stats[0]), tree_abs(self.health),
                 tree_abs(self._data_dev), tree_abs(self._labels_dev),
                 tree_abs(self._targets_dev),
                 jax.ShapeDtypeStruct((mb,), jnp.int32,
@@ -877,7 +1050,9 @@ class StagedTrainer(Unit):
                 jax.ShapeDtypeStruct((mb,), jnp.float32,
                                      sharding=batch_sh),
                 jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
-                jax.ShapeDtypeStruct((), jnp.float32, sharding=repl))
+                jax.ShapeDtypeStruct((), jnp.float32, sharding=repl),
+                jax.ShapeDtypeStruct(self._skip_steps.shape, jnp.int32,
+                                     sharding=repl))
         # bytes one minibatch moves per step: mb gathered samples (+
         # labels + the f32 valid/int32 index vectors)
         sample_bytes = int(np.prod(self._data_dev.shape[1:])
@@ -886,7 +1061,8 @@ class StagedTrainer(Unit):
                          + 8)
         return {"fn": step, "args": args,
                 "mesh_config": mc,
-                "donate_argnums": (0, 1, 2), "carry_argnums": (0, 1, 2),
+                "donate_argnums": (0, 1, 2, 3),
+                "carry_argnums": (0, 1, 2, 3),
                 "params_argnums": (0,), "opt_argnums": (1,),
                 "minibatch_bytes": int(mb_bytes),
                 "name": "%s.train_step" % self.name}

@@ -27,7 +27,7 @@ class StandardWorkflow(Workflow):
                  loss="softmax", decision_config=None, snapshotter_config=None,
                  gd_defaults=None, mesh_config=None, lr_adjuster_config=None,
                  dataset_placement="shard", steps_per_dispatch=None,
-                 **kwargs):
+                 sentinel_config=None, **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("StandardWorkflow needs layers=[{...}, ...]")
@@ -109,6 +109,27 @@ class StandardWorkflow(Workflow):
             tail = self.snapshotter
         else:
             self.snapshotter = None
+        # the numeric-fault sentinel (services.sentinel): strike
+        # accounting at the trainer's sync point, rollback-and-replay
+        # after the snapshotter's commit (so the poisoned epoch's
+        # commit exists — stamped unhealthy — before the rollback
+        # decision quarantines it), escalation under a numerics:<kind>
+        # crash class.  Linked at the tail; disabled per-run with
+        # root.common.sentinel.enabled=False (the in-jit probes follow
+        # the same switch inside the trainer).
+        from veles_tpu.config import root as _root
+        if _root.common.sentinel.get("enabled", True):
+            from veles_tpu.services.sentinel import HealthSentinel
+            self.sentinel = HealthSentinel(self,
+                                           **(sentinel_config or {}))
+            self.sentinel.trainer = self.trainer
+            self.sentinel.loader = self.loader
+            self.sentinel.snapshotter = self.snapshotter
+            self.trainer.sentinel = self.sentinel
+            self.sentinel.link_from(tail)
+            tail = self.sentinel
+        else:
+            self.sentinel = None
         self.repeater.link_from(tail)
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(tail)

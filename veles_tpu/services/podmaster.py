@@ -235,7 +235,7 @@ class PodValves(object):
         self._scale_window = []
 
     def admit(self, now, signature=None, progressed=False,
-              counted=True, resize=False):
+              counted=True, resize=False, sticky_signature=False):
         """Decide one pod restart: ``"respawn"``, ``"crash-loop"`` or
         ``"deterministic-bug"``.
 
@@ -251,8 +251,14 @@ class PodValves(object):
         :param resize: a PLANNED topology change (degrade after
             permanent host loss, re-expand on capacity return): counts
             only in ``resize_restarts`` — neither the crash-loop window
-            nor the deterministic counter moves."""
-        if progressed:
+            nor the deterministic counter moves.
+        :param sticky_signature: judge the signature REGARDLESS of
+            checkpoint progress — the numeric-fault class
+            (``numerics:<kind>`` exits, services.sentinel): a
+            diverging run commits plenty while it replays, but
+            identical divergence across restarts is deterministic all
+            the same."""
+        if progressed and not sticky_signature:
             self._same_signature, self._last_signature = 0, None
         if resize:
             self.resize_restarts += 1
@@ -265,7 +271,7 @@ class PodValves(object):
             else:
                 self._last_signature = signature
                 self._same_signature = 1
-            if not progressed and \
+            if (not progressed or sticky_signature) and \
                     self._same_signature >= self.deterministic_limit:
                 return "deterministic-bug"
         self._window = [t for t in self._window
@@ -1431,9 +1437,18 @@ class PodMaster(object):
             self._flake_streak += 1
         else:
             self._flake_streak = 0
+        # numeric-fault exits (the sentinel's rung-3 escalation) judge
+        # their signature regardless of checkpoint progress: the
+        # rollback replays COMMIT while diverging identically, and a
+        # progressed-reset would crash-loop the pod on a deterministic
+        # numeric bug forever
+        sticky = any(
+            str(e.get("kind") or "").startswith("numerics:")
+            for e in self._round_exits.values())
         verdict = forced or self.valves.admit(now, signatures or None,
                                               progressed, counted,
-                                              resize=bool(resize))
+                                              resize=bool(resize),
+                                              sticky_signature=sticky)
         if verdict == "respawn" and \
                 self._flake_streak >= self.flake_streak_limit:
             verdict = "env-flake-storm"

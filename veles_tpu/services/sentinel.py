@@ -1,0 +1,511 @@
+"""Numeric-fault sentinel — the survival tier for *numerical* death
+(docs/distributed_training.md "Numeric-fault survival").
+
+PRs 8-11 made process death, host death, torn commits and collective
+hangs survivable, every recovery gated bit-exact — but a NaN'd run kept
+burning TPU steps while the ``reject_nonfinite`` commit valve silently
+refused every checkpoint: detection at commit time, recovery never.
+This module closes that gap with a three-rung response ladder over
+cheap **in-jit health probes** fused into the staged train step
+(:meth:`~veles_tpu.models.nn_units.StagedTrainer` calls
+:func:`apply_probes` inside its jitted step; the results ride a
+device-resident health accumulator read back at the existing
+``read_class_stats`` sync point — **no extra device sync per step**):
+
+* **probes** — loss finiteness, gradient global-norm finiteness, an
+  EWMA loss-spike z-score (armed only after ``spike_warmup``
+  observations), and update-norm explosion.  All f32 scalar math, all
+  guarded (``maximum`` + eps before every division) so the VN4xx
+  numerics audit stays clean on the step that carries them.
+* **rung 1: in-jit skip-update** — a poisoned step's update is zeroed
+  via ``where`` select (params/velocity keep their pre-step values,
+  bit-deterministically), counted, and its step number recorded; the
+  run never dispatches host work mid-step.
+* **rung 2: rollback-and-replay** — after ``strikes_to_rollback``
+  anomalous sweeps the sentinel rolls the run back to the last
+  **healthy** commit (commits carry a health stamp in their manifest,
+  surfaced by ``scan_commits`` without unpickling), quarantines the
+  newer/unhealthy ring tail (the shared ``rollback_to_commit``), and
+  replays with the poisoned global minibatch on the trainer's traced
+  **skip list** — the Loader serves global indices, so the replayed
+  trajectory is bit-identical to a run that skipped that batch from
+  the start (the ``tools/numerics_chaos.py`` gate, threshold 0).
+* **rung 3: escalation** — ``rollbacks_to_escalate`` rollback (or
+  containment) rounds with an identical anomaly signature raise
+  :class:`NumericFaultError`: the crashdump carries a
+  ``sentinel.giveup`` event, ``classify_exit`` turns it into a
+  ``numerics:<kind>`` crash class, and the Supervisor / PodMaster
+  deterministic-bug valves bound it with a diagnosis instead of
+  crash-looping.
+
+Where rung 2 is impossible — a multi-host pod (pod-scope rollback
+rides the existing coordinated restart, whose cross-host checkpoint
+agreement prefers healthy-stamped commits), rollback disabled, no
+snapshotter, or no healthy commit yet — the incident is **contained**:
+rung 1 already kept the live state clean, so training continues and
+only persistence (the same-signature counter) escalates.
+
+Rollback and replay are **progress**, not a hang: every rung-2 step
+calls ``telemetry.health.note_progress()`` so the hang watchdog and the
+pod master's collective-hang latch can never mistake a rollback window
+for a wedged pod.  Config: ``root.common.sentinel.*``."""
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.telemetry import flight
+from veles_tpu.units import Unit
+
+#: anomaly kinds, in diagnosis priority order — when a sweep carries
+#: several, the signature is the highest-priority one (a nonfinite
+#: gradient usually CAUSES the downstream loss spike)
+ANOMALY_KINDS = ("nonfinite_grad", "nonfinite_loss", "update_explosion",
+                 "loss_spike")
+
+#: health-accumulator counter keys, one per anomaly kind plus the
+#: aggregate/skip bookkeeping — every leaf is an f32 scalar so the
+#: device tree stays uniform (replicated under a mesh like the class
+#: stats)
+_COUNTER_KEYS = ANOMALY_KINDS + ("anomalies", "skipped", "policy_skips")
+
+
+class NumericFaultError(RuntimeError):
+    """Rung 3: persistent numerical divergence the rollback ladder
+    could not outrun.  The message IS the diagnosis; the paired
+    ``sentinel.giveup`` flight event gives the crash its
+    ``numerics:<kind>`` class and stable signature so restart loops
+    stop instead of faithfully replaying divergence forever."""
+
+    def __init__(self, kind, diagnosis):
+        super(NumericFaultError, self).__init__(diagnosis)
+        self.kind = kind
+
+
+def probe_config():
+    """The sentinel's build-time knobs as a plain dict (static floats —
+    they are baked into the jitted step, never traced)."""
+    ns = root.common.get("sentinel")
+    cfg = ns.as_dict() if hasattr(ns, "as_dict") else dict(ns or {})
+    out = {
+        "enabled": bool(cfg.get("enabled", True)),
+        "spike_zscore": float(cfg.get("spike_zscore", 12.0)),
+        "spike_warmup": float(cfg.get("spike_warmup", 64)),
+        "update_norm_limit": cfg.get("update_norm_limit", 1e6),
+        "ewma_decay": float(cfg.get("ewma_decay", 0.99)),
+        "max_skip_steps": max(1, int(cfg.get("max_skip_steps", 8))),
+        "force_skip_steps": tuple(
+            int(s) for s in (cfg.get("force_skip_steps") or ())),
+    }
+    return out
+
+
+#: "no poisoned step recorded yet" sentinel value for the int32 step
+#: marks (int32 so a step counter past 2^24 — where f32 loses integer
+#: exactness — still arms the replay skip list with the RIGHT step)
+NO_BAD_STEP = np.int32(np.iinfo(np.int32).max)
+
+
+def init_health():
+    """Fresh device-resident health accumulator (f32 scalars, plus
+    int32 step marks).  NOT checkpointed: health state only influences
+    params through skip decisions, and keeping it out of the snapshot
+    is what lets the rollback-replay final state compare bit-identical
+    to a golden skip-batch run (whose sentinel never struck)."""
+    import jax.numpy as jnp
+    tree = {"ewma_mean": jnp.zeros((), jnp.float32),
+            "ewma_var": jnp.zeros((), jnp.float32),
+            "obs": jnp.zeros((), jnp.float32),
+            "first_bad_step": jnp.full((), NO_BAD_STEP, jnp.int32),
+            "last_bad_step": jnp.full((), -1, jnp.int32)}
+    for k in _COUNTER_KEYS:
+        tree[k] = jnp.zeros((), jnp.float32)
+    return tree
+
+
+def skip_steps_array(steps, capacity):
+    """The trainer's traced skip list: int32 ``[capacity]`` padded with
+    -1 (no real step counter is ever -1 — ``_run_step`` increments
+    before dispatch).  Values change between dispatches without a
+    recompile; the CAPACITY is the static shape."""
+    arr = np.full((int(capacity),), -1, np.int32)
+    steps = sorted(set(int(s) for s in steps))[: int(capacity)]
+    arr[: len(steps)] = steps
+    return arr
+
+
+def _tree_sumsq_f32(tree):
+    import jax
+    import jax.numpy as jnp
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+            total = total + jnp.sum(
+                jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
+def apply_probes(health, loss, grads, new_params, params, step,
+                 skip_steps, cfg):
+    """The in-jit probe + rung-1 select gate.  Traced inside the staged
+    train step; returns ``(health', ok)`` where ``ok`` (scalar bool)
+    decides whether this step's update applies — the caller selects
+    ``where(ok, new, old)`` per leaf, which is bit-exact in both
+    directions.
+
+    Probes (all f32, all anomaly flags sticky into the counters):
+
+    * ``nonfinite_loss`` — the optimized mean loss is NaN/inf;
+    * ``nonfinite_grad`` — the gradient tree's global sum of squares is
+      NaN/inf (NaN propagates through the reduction; an overflowed-to-
+      inf but elementwise-finite gradient lands here too — it is just
+      as fatal to the update);
+    * ``update_explosion`` — the applied update's global L2 norm
+      exceeds ``update_norm_limit`` (finite-but-divergent steps);
+    * ``loss_spike`` — EWMA z-score of the loss above ``spike_zscore``,
+      armed only after ``spike_warmup`` observations (cold statistics
+      must not fire on normal early-training descent).
+
+    The EWMA advances only on finite, non-anomalous, non-skipped steps,
+    so one NaN cannot poison the baseline it is judged against.  A
+    **policy skip** (``step`` present in ``skip_steps`` — the replay
+    list, or the golden run's ``force_skip_steps``) gates the update
+    identically but is NEVER counted as an anomaly, whatever its
+    numerics: the step was already adjudicated, its update cannot
+    apply, and re-striking on it would turn one step-keyed fault into
+    an endless rollback loop.  Golden-skip and rollback-replay
+    trajectories therefore stay bit-identical: both take exactly this
+    code path with the same update gate and the same EWMA gate."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    loss_f = jnp.asarray(loss, f32)
+    step_i = jnp.asarray(step, jnp.int32)
+    finite_loss = jnp.isfinite(loss_f)
+    grad_ss = _tree_sumsq_f32(grads)
+    finite_grad = jnp.isfinite(grad_ss)
+    upd_ss = _tree_sumsq_f32(
+        jax_tree_sub(new_params, params))
+    limit = cfg.get("update_norm_limit")
+    if limit:
+        # compare squared norms: no sqrt, and a NaN upd_ss compares
+        # False (it is already caught by nonfinite_grad)
+        exploded = upd_ss > f32(float(limit)) ** 2
+    else:
+        exploded = jnp.zeros((), bool)
+    mean, var, obs = health["ewma_mean"], health["ewma_var"], health["obs"]
+    warm = obs >= f32(cfg["spike_warmup"])
+    # guarded std: maximum with a positive literal keeps the divisor
+    # provably positive (VN400-clean)
+    std = jnp.sqrt(jnp.maximum(var, f32(1e-12)))
+    z = (loss_f - mean) / std
+    spiked = warm & finite_loss & (z > f32(cfg["spike_zscore"]))
+    raw_bad = (~finite_loss) | (~finite_grad) | exploded | spiked
+    policy = jnp.any(step == skip_steps)
+    ok = ~(raw_bad | policy)
+    not_pol = ~policy
+    bad = raw_bad & not_pol
+
+    d = f32(cfg["ewma_decay"])
+    track = finite_loss & ~raw_bad & not_pol
+    delta = jnp.where(finite_loss, loss_f - mean, f32(0.0))
+    health = dict(health)
+    health["ewma_mean"] = jnp.where(track, mean + (1.0 - d) * delta,
+                                    mean)
+    health["ewma_var"] = jnp.where(
+        track, d * var + (1.0 - d) * jnp.square(delta), var)
+    health["obs"] = obs + jnp.where(track, f32(1.0), f32(0.0))
+    flags = {"nonfinite_loss": ~finite_loss & not_pol,
+             "nonfinite_grad": ~finite_grad & not_pol,
+             "update_explosion": exploded & not_pol,
+             "loss_spike": spiked & not_pol,
+             "anomalies": bad, "skipped": bad, "policy_skips": policy}
+    for k, flag in flags.items():
+        health[k] = health[k] + jnp.where(flag, f32(1.0), f32(0.0))
+    health["first_bad_step"] = jnp.where(
+        bad, jnp.minimum(health["first_bad_step"], step_i),
+        health["first_bad_step"])
+    health["last_bad_step"] = jnp.where(bad, step_i,
+                                        health["last_bad_step"])
+    return health, ok
+
+
+def jax_tree_sub(a, b):
+    """Leafwise ``a - b`` in f32 (the update tree for the explosion
+    probe) — non-float leaves pass through as zeros-shaped floats so
+    the sumsq above simply ignores them."""
+    import jax
+    import jax.numpy as jnp
+
+    def sub(x, y):
+        if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x.astype(jnp.float32) - y.astype(jnp.float32)
+        return jnp.zeros((), jnp.float32)
+
+    return jax.tree_util.tree_map(sub, a, b)
+
+
+def dominant_kind(deltas):
+    """The sweep's anomaly signature: the highest-priority kind with a
+    nonzero delta (:data:`ANOMALY_KINDS` order)."""
+    for kind in ANOMALY_KINDS:
+        if deltas.get(kind, 0) > 0:
+            return kind
+    return None
+
+
+class HealthSentinel(Unit):
+    """The host-side half of the ladder: strike accounting at the sync
+    point, rollback-and-replay, escalation.  Linked at the workflow
+    tail (after the snapshotter) so the poisoned sweep's commit — stamped
+    unhealthy — exists before the rollback decision quarantines it.
+
+    Demands: ``trainer``, ``loader``; ``snapshotter`` is optional (no
+    commits to roll back to degrades rung 2 to escalation)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(HealthSentinel, self).__init__(workflow, **kwargs)
+
+        def knob(key, default):
+            if key in kwargs:
+                return kwargs[key]
+            return root.common.sentinel.get(key, default)
+
+        self.strikes_to_rollback = max(1, int(
+            knob("strikes_to_rollback", 1)))
+        self.rollbacks_to_escalate = max(1, int(
+            knob("rollbacks_to_escalate", 3)))
+        self.rollback_enabled = bool(knob("rollback", True))
+        self.demand("trainer", "loader")
+        self.snapshotter = None
+        self.view_group = "SERVICE"
+        self.strikes = 0
+        self.rollbacks = 0
+        #: consecutive rollbacks with the same anomaly signature — the
+        #: escalation counter (rung 3)
+        self.same_signature_rollbacks = 0
+        self.last_signature = None
+        #: cumulative device-counter values at the last observed sweep
+        self._seen = {k: 0.0 for k in _COUNTER_KEYS}
+        #: the unhealthy sweep waiting for run() to act on, or None
+        self._pending = None
+        self.history = []
+
+    # ------------------------------------------------------ observation
+    def observe_sweep(self, cls, stats, health_host):
+        """Called by the trainer at the ``read_class_stats`` sync point
+        with the freshly fetched health scalars.  Pure bookkeeping —
+        computes counter deltas since the last sweep and latches an
+        unhealthy sweep for run() to act on at the next cycle boundary
+        (rolling back MID-cycle would yank state out from under the
+        decision unit)."""
+        deltas = {}
+        for k in _COUNTER_KEYS:
+            cur = float(health_host.get(k, 0.0))
+            deltas[k] = cur - self._seen.get(k, 0.0)
+            self._seen[k] = cur
+        if deltas.get("anomalies", 0) <= 0:
+            return None
+        kind = dominant_kind(deltas) or "unknown"
+        first_bad = int(health_host.get("first_bad_step", NO_BAD_STEP))
+        pending = {
+            "anomaly": kind,
+            "class": int(cls),
+            "deltas": {k: int(v) for k, v in deltas.items() if v},
+            "first_bad_step": None if first_bad == NO_BAD_STEP
+            else first_bad,
+            "last_bad_step": int(health_host.get("last_bad_step", -1)),
+        }
+        self._pending = pending
+        reset = getattr(self.trainer, "reset_health_marks", None)
+        if callable(reset):
+            reset()
+        self._telemetry("sentinel.anomaly", pending)
+        return pending
+
+    def _telemetry(self, event, payload):
+        """Anomaly observability — fail-soft per the telemetry rules
+        (the LADDER itself never rides this path)."""
+        try:
+            from veles_tpu import telemetry
+            flight.record(event, **payload)
+            telemetry.registry.counter(
+                "veles_sentinel_anomalies_total",
+                "anomalous staged steps detected by the in-jit health "
+                "probes", ("kind",)).inc(
+                payload["deltas"].get(payload["anomaly"], 1) or 1,
+                kind=payload["anomaly"])
+            self.warning(
+                "numeric anomaly in sweep: %s (deltas %s, first bad "
+                "step %s)", payload["anomaly"], payload["deltas"],
+                payload["first_bad_step"])
+        except Exception:   # noqa: BLE001 — observe, never abort
+            pass
+
+    # ------------------------------------------------------- the ladder
+    def run(self):
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        self.strikes += 1
+        if self.strikes < self.strikes_to_rollback:
+            return
+        self.strikes = 0
+        sig = pending["anomaly"]
+        if sig == self.last_signature:
+            self.same_signature_rollbacks += 1
+        else:
+            self.last_signature = sig
+            self.same_signature_rollbacks = 1
+        if self.same_signature_rollbacks > self.rollbacks_to_escalate:
+            self._escalate(
+                pending, "persistent %s after %d rollback/containment "
+                "rounds" % (sig, self.same_signature_rollbacks - 1))
+        import jax
+        pod = jax.process_count() > 1
+        if pod or not self.rollback_enabled or self.snapshotter is None:
+            # rung 1 already contained the poisoned updates in-jit, so
+            # a run that CANNOT roll back locally — a pod (every host
+            # computes this identical decision from replicated health
+            # values; recovery for a persistent fault rides the
+            # coordinated restart, whose agreement prefers healthy
+            # commits), rollback disabled, or simply no snapshotter —
+            # keeps training on its still-clean state.  Persistence
+            # escalates through the same-signature counter above.
+            self._contain(
+                pending,
+                "pod-scope (recovery rides the coordinated restart)"
+                if pod else "in-process rollback disabled "
+                "(root.common.sentinel.rollback=False)"
+                if not self.rollback_enabled else
+                "no snapshotter configured")
+            return
+        self._rollback(pending)
+
+    def _drain_commit_verdict(self):
+        """Consume the trainer's commit-verdict delta so the incident
+        just adjudicated cannot leak into the NEXT commit's health
+        stamp.  Matters when the anomalous epoch itself did not commit
+        (snapshot interval > 1, wall-clock gating): without this the
+        first clean post-rollback/containment commit would compute a
+        nonzero anomaly delta and be stamped unhealthy — and then be
+        skipped by every later rollback and ranked down by the pod
+        agreement, despite holding perfectly clean state."""
+        verdict = getattr(self.trainer, "health_verdict", None)
+        if callable(verdict):
+            verdict()
+
+    def _contain(self, pending, why):
+        """Rung 1 was the whole response: count the adjudicated
+        incident (it still feeds the escalation counter) and let the
+        run continue on its protected state."""
+        self._drain_commit_verdict()
+        record = {"anomaly": pending["anomaly"], "reason": why,
+                  "round": self.same_signature_rollbacks,
+                  "first_bad_step": pending["first_bad_step"]}
+        self.history.append(dict(record, contained=True))
+        flight.record("sentinel.contained", **record)
+        self.warning(
+            "numeric anomaly contained in-jit (%s): %s — round %d/%d "
+            "before escalation", pending["anomaly"], why,
+            self.same_signature_rollbacks,
+            self.rollbacks_to_escalate + 1)
+
+    def _escalate(self, pending, why):
+        diagnosis = (
+            "numeric fault (%s): %s; first bad step %s, anomaly "
+            "deltas %s — giving up so the restart ladder classifies "
+            "this as numerics:%s instead of crash-looping"
+            % (pending["anomaly"], why, pending["first_bad_step"],
+               pending["deltas"], pending["anomaly"]))
+        flight.record("sentinel.giveup", anomaly=pending["anomaly"],
+                      signature=pending["anomaly"],
+                      first_bad_step=pending["first_bad_step"],
+                      rollbacks=self.rollbacks, diagnosis=diagnosis)
+        self.error("sentinel giving up: %s", diagnosis)
+        raise NumericFaultError(pending["anomaly"], diagnosis)
+
+    def _rollback(self, pending):
+        """Rung 2: restore the last healthy commit and arm the replay
+        skip list with the poisoned step.  Every stage notes progress —
+        a rollback window must read as the run WORKING to the hang
+        watchdog and the pod master's collective-hang latch."""
+        from veles_tpu.services.snapshotter import (
+            SnapshotterBase, rollback_to_commit, scan_commits)
+        from veles_tpu.telemetry import health as health_mod
+        health_mod.note_progress()
+        snap = self.snapshotter
+        scan = scan_commits(snap.directory, snap.prefix)
+        target = self._newest_healthy(scan)
+        if target is None:
+            # nothing committed yet (or everything stamped unhealthy):
+            # rung 1 kept the live state clean, so containment beats
+            # both an impossible rollback and a premature death
+            self._contain(pending, "no healthy commit in %s"
+                          % snap.directory)
+            return
+        self.rollbacks += 1
+        quarantined = rollback_to_commit(snap.directory, snap.prefix,
+                                         target, scan=scan)
+        state = SnapshotterBase.import_(scan[target]["path"])
+        health_mod.note_progress()
+        self.workflow.restore(state)
+        dec = getattr(self.workflow, "decision", None)
+        if dec is not None:
+            # a rollback in the FINAL epoch would otherwise leave the
+            # stop condition latched from the poisoned timeline and end
+            # the run before the replay; the decision recomputes it at
+            # every epoch boundary from the restored counters
+            dec.complete <<= False
+        bad_step = pending["first_bad_step"]
+        if bad_step is not None:
+            self.trainer.add_skip_steps([bad_step])
+        self._drain_commit_verdict()
+        health_mod.note_progress()
+        record = {"commit": target,
+                  "epoch": scan[target].get("epoch"),
+                  "anomaly": pending["anomaly"], "skip_step": bad_step,
+                  "quarantined": quarantined,
+                  "rollback": self.rollbacks}
+        self.history.append(record)
+        flight.record("sentinel.rollback", **record)
+        try:
+            from veles_tpu import telemetry
+            telemetry.registry.counter(
+                "veles_sentinel_rollbacks_total",
+                "automatic rollbacks to the last healthy commit",
+                ("kind",)).inc(kind=pending["anomaly"])
+        except Exception:   # noqa: BLE001
+            pass
+        # the loud, parseable marker the numerics-chaos gate counts
+        self.info(
+            "sentinel rollback #%d: %s at step %s -> restored healthy "
+            "commit %s (epoch %s), replaying with the poisoned "
+            "minibatch skipped (quarantined: %s)",
+            self.rollbacks, pending["anomaly"], bad_step, target,
+            scan[target].get("epoch"), quarantined)
+
+    @staticmethod
+    def _newest_healthy(scan):
+        """The newest commit that is valid AND not stamped unhealthy —
+        legacy commits without a health stamp count as healthy (same
+        benefit-of-the-doubt the agreement gives them)."""
+        from veles_tpu.services.snapshotter import _commit_order_key
+        best_key, best = None, None
+        for name, entry in scan.items():
+            if entry.get("valid") is not True:
+                continue
+            if str(entry.get("health") or "").startswith("unhealthy"):
+                continue
+            key = _commit_order_key(name, [entry])
+            if best_key is None or key > best_key:
+                best_key, best = key, name
+        return best
+
+    def get_metric_values(self):
+        return {"sentinel": {
+            "rollbacks": self.rollbacks,
+            "strikes": self.strikes,
+            "last_signature": self.last_signature,
+            "anomalies_seen": int(self._seen.get("anomalies", 0)),
+            "policy_skips_seen": int(self._seen.get("policy_skips", 0)),
+        }}

@@ -249,6 +249,12 @@ def commit_meta(state=None):
         # degraded/re-expand accounting and reshard-on-restore read it
         # without unpickling (scan_commits)
         meta["topology"] = state["topology"]
+    if isinstance(state, dict) and state.get("health") is not None:
+        # the sentinel's health stamp (services.sentinel): "healthy"
+        # or "unhealthy:<kind>" — surfaced by scan_commits without
+        # unpickling, so the in-process rollback and the pod-wide
+        # agreement can prefer healthy restart points
+        meta["health"] = state["health"]
     return meta
 
 
@@ -277,6 +283,25 @@ def validate_state_manifest(state, manifest, source="snapshot"):
         raise SnapshotIntegrityError(
             "%s failed its integrity manifest: %d leaf mismatch(es), "
             "first: %s" % (source, len(bad), ", ".join(bad[:5])))
+
+
+def _surface_nonfinite(prefix, bad):
+    """Shared surfacing for BOTH reject_nonfinite valves (file/db base
+    path and the orbax device-side check): flight event, registry
+    counter, and the /api/health degraded flag.  Fail-soft — the VALVE
+    fires regardless of telemetry state."""
+    from veles_tpu.telemetry import flight
+    flight.record("snapshot.nonfinite", leaves=bad[:8], prefix=prefix)
+    try:
+        from veles_tpu import telemetry
+        telemetry.registry.counter(
+            "veles_snapshot_nonfinite_total",
+            "checkpoint commits refused by the reject_nonfinite "
+            "poison valve").inc()
+        telemetry.health.note_nonfinite_commit(prefix=prefix,
+                                               leaves=bad[:5])
+    except Exception:   # noqa: BLE001
+        pass
 
 
 def _file_sha256(path):
@@ -340,7 +365,7 @@ def scan_commits(directory, prefix):
         path = os.path.join(directory, name)
         entry = {"path": path, "epoch": None, "incarnation": None,
                  "process_index": None, "topology": None,
-                 "valid": None, "error": None}
+                 "health": None, "valid": None, "error": None}
         try:
             entry["mtime"] = os.path.getmtime(path)
         except OSError:
@@ -351,6 +376,7 @@ def scan_commits(directory, prefix):
             entry["incarnation"] = manifest.get("incarnation")
             entry["process_index"] = manifest.get("process_index")
             entry["topology"] = manifest.get("topology")
+            entry["health"] = manifest.get("health")
             recorded = manifest.get("file_sha256")
             if recorded is None:
                 entry["valid"] = None
@@ -389,9 +415,16 @@ def agree_commits(reports):
         host, each over that host's OWN directory.
     :returns: ``(agreed_name_or_None, detail)`` where detail maps every
         candidate name to ``{"hosts": [...], "valid_on": [...],
-        "rejected": reason_or_None}`` — the newest name that is valid
-        on EVERY host wins; a name absent or torn anywhere is rejected
-        pod-wide (that is the point)."""
+        "healthy": bool, "rejected": reason_or_None}`` — the newest
+        name that is valid on EVERY host wins; a name absent or torn
+        anywhere is rejected pod-wide (that is the point).  Commits
+        stamped ``unhealthy:*`` by the numeric-fault sentinel on ANY
+        host rank below every healthy candidate: a pod restarting
+        after numerical death prefers the last commit whose sweep
+        carried no anomaly, falling back to an unhealthy one only when
+        nothing healthy survives (better a suspect checkpoint than
+        none — the sentinel's own ladder bounds the replayed
+        divergence)."""
     hosts = sorted(reports)
     names = set()
     for rep in reports.values():
@@ -403,6 +436,9 @@ def agree_commits(reports):
         on = [h for h in hosts if name in reports[h]]
         valid_on = [h for h in hosts
                     if reports[h].get(name, {}).get("valid") is True]
+        healthy = not any(
+            str(e.get("health") or "").startswith("unhealthy")
+            for e in entries)
         if len(on) < len(hosts):
             rejected = "absent on host(s) %s" % (
                 [h for h in hosts if h not in on],)
@@ -411,9 +447,11 @@ def agree_commits(reports):
             rejected = "invalid/unverified on host(s) %s" % (bad,)
         else:
             rejected = None
-            candidates.append((_commit_order_key(name, entries), name))
+            candidates.append(
+                ((1 if healthy else 0,)
+                 + _commit_order_key(name, entries), name))
         detail[name] = {"hosts": on, "valid_on": valid_on,
-                        "rejected": rejected}
+                        "healthy": healthy, "rejected": rejected}
     if not candidates:
         return None, detail
     candidates.sort()
@@ -688,9 +726,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                         not np.isfinite(a).all():
                     bad.append(path)
         if bad:
-            from veles_tpu.telemetry import flight
-            flight.record("snapshot.nonfinite", leaves=bad[:8],
-                          prefix=self.prefix)
+            _surface_nonfinite(self.__dict__.get("prefix"), bad)
             raise SnapshotNonFiniteError(
                 "refusing to commit a poisoned checkpoint: %d "
                 "non-finite model leaf/leaves, first: %s — the last "
@@ -1017,6 +1053,15 @@ class TrainingSnapshotter(SnapshotterBase):
             "topology": mesh_topology(
                 getattr(self.trainer, "mesh_config", None)),
         }
+        verdict = getattr(self.trainer, "health_verdict", None)
+        if callable(verdict):
+            # the sentinel's health stamp: "healthy" when no numeric
+            # anomaly landed since the previous commit, else
+            # "unhealthy:<kind>" — rides commit_meta into the manifest
+            # so rollback/agreement read it without unpickling
+            health = verdict()
+            if health is not None:
+                state["health"] = health
         if self.decision is not None:
             state["decision"] = {
                 "best_metric": self.decision.best_metric,
@@ -1378,6 +1423,13 @@ class OrbaxSnapshotter(TrainingSnapshotter):
                     self.decision.epochs_since_improvement,
                 "epoch_metrics": list(self.decision.epoch_metrics),
             }
+        verdict = getattr(t, "health_verdict", None)
+        if callable(verdict):
+            # sentinel health stamp (rides the pickle sidecar +
+            # manifest.json, same contract as the file backend)
+            health = verdict()
+            if health is not None:
+                state["health"] = health
         return state
 
     def export(self):
@@ -1395,9 +1447,7 @@ class OrbaxSnapshotter(TrainingSnapshotter):
                    if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
                    and not bool(jnp.isfinite(v).all())]
             if bad:
-                from veles_tpu.telemetry import flight
-                flight.record("snapshot.nonfinite", leaves=bad[:8],
-                              prefix=self.prefix)
+                _surface_nonfinite(self.__dict__.get("prefix"), bad)
                 raise SnapshotNonFiniteError(
                     "refusing to commit a poisoned checkpoint: "
                     "non-finite model leaves %s" % bad[:5])

@@ -25,6 +25,13 @@ makes them a *survival loop* instead of a manual runbook:
   signatures with **zero checkpoint progress** give up early — a
   deterministic bug replays identically from the same checkpoint, and
   restarting it only burns the restart budget.
+* **numerics valve** — a ``sentinel.giveup`` event in the crashdump
+  (the numeric-fault sentinel's rung-3 escalation, services.sentinel)
+  classifies the exit ``numerics:<kind>``: ``deterministic_limit``
+  identical anomaly signatures give up with a diagnosis **regardless
+  of checkpoint progress** — a diverging run commits plenty while its
+  rollbacks replay, but identical divergence across lives is
+  deterministic all the same.
 * **crash-loop valve** — more than ``max_restarts`` bounded respawns
   (kills + faults + crashes; preemptions are exempt) inside
   ``window_seconds`` give up with the child's exit code.
@@ -117,7 +124,9 @@ def classify_exit(rc, blackbox_dir=None, since=0.0):
     child left behind distinguishes an injected/forced death from a
     deterministic bug.  Kinds: ``done``, ``preempt`` (exit 75),
     ``killed:SIG*`` (negative rc), ``fault-injection`` (crashdump
-    carries a ``fault.injected`` event), ``crash:<Type>`` /
+    carries a ``fault.injected`` event), ``numerics:<kind>`` (the
+    sentinel's rung-3 escalation — a ``sentinel.giveup`` event with a
+    stable anomaly signature, services.sentinel), ``crash:<Type>`` /
     ``crash:rcN`` (signature set)."""
     if rc == 0:
         return "done", None
@@ -134,6 +143,11 @@ def classify_exit(rc, blackbox_dir=None, since=0.0):
     for ev in events:
         if ev.get("kind") == "fault.injected":
             return "fault-injection", None
+    for ev in reversed(events):
+        if ev.get("kind") == "sentinel.giveup":
+            anomaly = str(ev.get("anomaly") or "unknown")
+            sig = "numerics:%s" % (ev.get("signature") or anomaly)
+            return "numerics:%s" % anomaly, sig
     err = (meta or {}).get("error")
     if err:
         sig = "%s:%s" % (err.get("type"), err.get("message"))
@@ -280,7 +294,13 @@ class Supervisor(object):
         self.spawn_count = 0
         self.last_spawn_ts = None
         self.restarts = {"preempt": 0, "killed": 0,
-                         "fault-injection": 0, "crash": 0}
+                         "fault-injection": 0, "crash": 0,
+                         "numerics": 0}
+        #: the reason a give-up verdict fired, or None (the chaos
+        #: harnesses assert on it; mirrors the supervisor.giveup
+        #: flight event)
+        self.giveup_reason = None
+        self.giveup_diagnosis = None
 
     # ----------------------------------------------------------- surface
     def current_pid(self):
@@ -337,6 +357,13 @@ class Supervisor(object):
         consecutive = 0          # bounded respawns since last progress
         last_signature = None
         same_signature = 0
+        # the numerics valve's own counters: a replaying run COMMITS
+        # (rollback/replay advances checkpoints), so unlike the crash
+        # counter these never reset on checkpoint progress — identical
+        # numeric divergence across lives is deterministic however
+        # much the replay commits in between
+        numerics_signature = None
+        same_numerics = 0
         window = []              # timestamps of bounded respawns
         while True:
             marker = self._progress_marker()
@@ -356,6 +383,7 @@ class Supervisor(object):
                           if now - t < self.window_seconds]
                 window.append(now)
                 if len(window) > self.max_restarts or self._stopping:
+                    self.giveup_reason = "spawn-error"
                     flight.record("supervisor.giveup",
                                   reason="spawn-error")
                     return 1
@@ -394,8 +422,37 @@ class Supervisor(object):
                 continue
             bucket = ("killed" if kind.startswith("killed")
                       else kind if kind == "fault-injection"
+                      else "numerics" if kind.startswith("numerics:")
                       else "crash")
             self.restarts[bucket] += 1
+            if bucket == "numerics":
+                # the sentinel's rung-3 escalation (services.sentinel):
+                # same deterministic-bug shape, but judged on the
+                # anomaly signature ALONE — checkpoint progress from
+                # the replays does not excuse identical divergence
+                if signature is not None and \
+                        signature == numerics_signature:
+                    same_numerics += 1
+                else:
+                    same_numerics, numerics_signature = 1, signature
+                if same_numerics >= self.deterministic_limit:
+                    diagnosis = (
+                        "%d consecutive identical numeric-fault "
+                        "give-ups (%s) — the sentinel's rollback "
+                        "ladder could not outrun the divergence; the "
+                        "fault replays deterministically, restarting "
+                        "will not help (checkpoints are intact; see "
+                        "the sentinel.giveup crashdump for the "
+                        "anomaly detail)"
+                        % (same_numerics, signature))
+                    self._error("giving up: %s", diagnosis)
+                    self.giveup_reason = "numerics"
+                    self.giveup_diagnosis = diagnosis
+                    flight.record("supervisor.giveup",
+                                  reason="numerics",
+                                  signature=signature,
+                                  diagnosis=diagnosis, rc=rc)
+                    return rc or 1
             if bucket == "crash":
                 if signature is not None and \
                         signature == last_signature:
@@ -409,6 +466,8 @@ class Supervisor(object):
                         "deterministic bug replays the same way from "
                         "the same checkpoint; restarting will not help",
                         same_signature, signature)
+                    self.giveup_reason = "deterministic-bug"
+                    self.giveup_diagnosis = signature
                     flight.record("supervisor.giveup",
                                   reason="deterministic-bug",
                                   signature=signature, rc=rc)
@@ -422,6 +481,7 @@ class Supervisor(object):
                     "giving up: %d bounded respawns within %.0fs "
                     "(max %d) — crash loop", len(window),
                     self.window_seconds, self.max_restarts)
+                self.giveup_reason = "crash-loop"
                 flight.record("supervisor.giveup", reason="crash-loop",
                               restarts=len(window), rc=rc)
                 return rc or 1

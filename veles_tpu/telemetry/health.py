@@ -54,6 +54,12 @@ _state = {
     # False = env not read yet, None = bridge disabled
     "progress_file": False,
     "progress_file_written": 0.0,
+    # the snapshotter's reject_nonfinite poison valve: how many
+    # commits this process refused, and the last refusal's detail —
+    # the /api/health "degraded" surface (a run that can no longer
+    # commit must stop probing healthy)
+    "nonfinite_commits": 0,
+    "nonfinite_last": None,
 }
 _lock = threading.Lock()
 
@@ -102,6 +108,21 @@ def last_progress_age():
     """Seconds since the last observed progress, or None before any."""
     t = _state["last_progress"]
     return None if t is None else time.monotonic() - t
+
+
+def note_nonfinite_commit(prefix=None, leaves=None):
+    """Record one commit refused by the snapshotter's
+    ``reject_nonfinite`` poison valve — flips the ``/api/health``
+    payload to ``degraded`` so a poisoned run stops reporting healthy
+    while silently never committing.  Never raises (the valve must
+    fire regardless)."""
+    try:
+        _state["nonfinite_commits"] += 1
+        _state["nonfinite_last"] = {"ts": time.time(),
+                                    "prefix": prefix,
+                                    "leaves": list(leaves or [])[:5]}
+    except Exception:   # noqa: BLE001 — observability only
+        pass
 
 
 # ---------------------------------------------------------------- install
@@ -160,6 +181,8 @@ def uninstall():
     disarm_watchdog()
     _state["multihost"] = False
     _state["desync_latched"] = False
+    _state["nonfinite_commits"] = 0
+    _state["nonfinite_last"] = None
 
 
 def _install_excepthooks():
@@ -435,6 +458,15 @@ def status():
         },
         "multihost": _state["multihost"],
         "desync": _state["desync_latched"],
+        # the numeric-fault surfaces: refused (non-finite) commits and
+        # the aggregate degraded verdict — a run that cannot commit or
+        # has desynced is NOT healthy, even while it keeps stepping
+        "snapshot_nonfinite": {
+            "count": _state["nonfinite_commits"],
+            "last": _state["nonfinite_last"],
+        },
+        "degraded": bool(_state["nonfinite_commits"]
+                         or _state["desync_latched"]),
         "crashdumps": flight.recorder.dump_count,
         "last_dump": flight.recorder.last_dump,
         "flight_events": len(flight.recorder),
