@@ -568,18 +568,48 @@ class TestInt8ServingWeights:
             gen_h.generate(toks[:4, :8], max_new=6),
             gen_f.generate(toks[:4, :8], max_new=6))
 
-    def test_int8_rejects_tensor_parallel_and_moe(self):
+    def test_int8_tensor_parallel_decode(self, f32_precision):
+        """int8 serving under a model-axis mesh (the lifted
+        restriction): the int8 payload is re-placed with the sharding
+        of the float weight it replaces, scales replicated — and the
+        sharded decode must produce the single-device int8 decode's
+        tokens."""
+        from veles_tpu.parallel import MeshConfig, make_mesh
+        mc = MeshConfig(make_mesh({"model": 2}, jax.devices()[:2]))
+        wf, toks = _lm_workflow(max_epochs=10, mesh_config=mc,
+                                n_kv_heads=2)
+        gen_tp = LMGenerator(wf.trainer, max_len=16, weights="int8")
+        assert gen_tp.mesh_cfg is mc
+        # payload sharded like the original weight, scales replicated
+        from veles_tpu.ops import quant
+        qw = gen_tp.params["l02_transformer_block"]["mha"]["wq"]
+        assert isinstance(qw, quant.QuantWeight)
+        orig = wf.trainer.params["l02_transformer_block"]["mha"]["wq"]
+        assert qw.q.sharding == orig.sharding
+        assert qw.scale.sharding.is_fully_replicated
+        wf1, _ = _lm_workflow(max_epochs=10, n_kv_heads=2)
+        gen1 = LMGenerator(wf1.trainer, max_len=16, weights="int8")
+        prompt = toks[:4, :8]
+        np.testing.assert_array_equal(gen_tp.generate(prompt, max_new=6),
+                                      gen1.generate(prompt, max_new=6))
+
+    def test_quant_weight_guards(self):
         from veles_tpu.parallel import MeshConfig, make_mesh
         wf, _ = _lm_workflow(max_epochs=0, n_kv_heads=2)
-        mc = MeshConfig(make_mesh({"model": 2}, jax.devices()[:2]))
-        with pytest.raises(ValueError, match="single-device"):
-            LMGenerator(wf.trainer, max_len=16, mesh_cfg=mc,
-                        weights="int8")
         with pytest.raises(ValueError, match="int8"):
             LMGenerator(wf.trainer, max_len=16, weights="int4")
+        mc = MeshConfig(make_mesh({"model": 2}, jax.devices()[:2]))
+        # w4a8 keeps the single-device restriction (the nibble-packed
+        # payload halves the contraction axis — training specs don't
+        # describe it)
+        with pytest.raises(ValueError, match="single-device"):
+            LMGenerator(wf.trainer, max_len=16, mesh_cfg=mc,
+                        weights="w4a8")
         wf_moe, _ = _lm_workflow(max_epochs=0, n_experts=2)
         with pytest.raises(ValueError, match="MoE"):
             LMGenerator(wf_moe.trainer, max_len=16, weights="int8")
+        with pytest.raises(ValueError, match="MoE"):
+            LMGenerator(wf_moe.trainer, max_len=16, weights="w4a8")
 
 
 class TestContinuousBatching:
@@ -801,19 +831,26 @@ class TestPagedKV:
         dense = self._run(ContinuousBatcher(gen, slots=3), gen, toks)
         assert self._run(cb, gen, toks) == dense
 
-    def test_quant_pool_falls_back_to_gather(self, f32_precision):
-        """int8 KV pools (QuantCache leaves) are not kernel-readable —
-        the batcher must auto-select the gather tick and still match
-        the dense int8 batcher."""
+    def test_quant_pool_runs_fused_kernel(self, f32_precision):
+        """int8 KV pools (QuantCache leaves) now run the fused
+        kernel's QUANTIZED variant — int8 tiles streamed from HBM,
+        dequantized in kernel with f32 accumulation — and the token
+        streams must still match the dense int8 batcher (same math,
+        narrower wire).  The gather tick stays reachable via
+        fused=False and must agree too."""
         from veles_tpu.models.generate import (ContinuousBatcher,
                                                PagedContinuousBatcher)
         wf, toks = _lm_workflow(max_epochs=8)
         gen = LMGenerator(wf.trainer, max_len=16, cache_dtype="int8")
         cb = PagedContinuousBatcher(gen, slots=3, block=4,
                                     pool_tokens=48, fused=True)
-        assert not cb.fused                   # auto-fallback
+        assert cb.fused                       # quantized kernel path
         dense = self._run(ContinuousBatcher(gen, slots=3), gen, toks)
         assert self._run(cb, gen, toks) == dense
+        gather = PagedContinuousBatcher(gen, slots=3, block=4,
+                                        pool_tokens=48, fused=False)
+        assert not gather.fused
+        assert self._run(gather, gen, toks) == dense
 
     def test_engine_metrics_expose_free_blocks(self, f32_precision):
         from veles_tpu.services.restful import ContinuousEngine

@@ -497,22 +497,29 @@ class TestShedOverRest:
             api.stop()
 
 
-class TestSpecDegradedEvent:
-    def test_one_shot_flight_event_on_sampled_request(self, gen,
-                                                      serve_cfg):
+class TestSpecMixedEvent:
+    def test_one_shot_informational_event_on_sampled_request(
+            self, gen, serve_cfg):
+        """The pool-wide `serve.spec_degraded` cliff event is RETIRED
+        (speculation routes per row now); a sampled request entering
+        a speculative pool emits the downgraded one-shot
+        `serve.spec_mixed` informational event instead — and never
+        the old degraded one."""
         from veles_tpu.telemetry import flight
         eng = _engine(gen, slots=2, speculative_k=2)
         try:
             eng.cb.tick = lambda: 0        # no decode needed: the
             # event fires at submit, and compiling the spec tick here
             # would buy the test nothing
-            before = sum(1 for e in flight.recorder.snapshot()
-                         if e["kind"] == "serve.spec_degraded")
+            def count(kind):
+                return sum(1 for e in flight.recorder.snapshot()
+                           if e["kind"] == kind)
+            before = count("serve.spec_mixed")
+            degraded = count("serve.spec_degraded")
             eng.submit_async(PROMPT, 2, temperature=0.7)
             eng.submit_async(PROMPT, 2, temperature=0.9)
-            after = sum(1 for e in flight.recorder.snapshot()
-                        if e["kind"] == "serve.spec_degraded")
-            assert after - before == 1     # one-shot
+            assert count("serve.spec_mixed") - before == 1  # one-shot
+            assert count("serve.spec_degraded") == degraded  # retired
         finally:
             eng.stop()
 

@@ -25,7 +25,14 @@ iff every gate passes; ``--json`` writes the full report and
         --slo-ms 250 --json report.json --flight-dump chaos-dump
 
 Scaled-down flavors run inside tier-1 (`tests/test_lifecycle.py`); the
-CI `serve-chaos` job runs this CLI with a few hundred clients.
+CI `serve-chaos` job runs this CLI with a few hundred clients, plus a
+QUANTIZED leg (``--weights int8 --cache-dtype int8``) that drives the
+same storm through the int8 weight matmuls and the fused quantized-
+pool paged decode kernel.  The report's ``storm_ms_per_tok``
+(completed-request token throughput under the storm — not admission
+p50) is what the ``--weights {f32,bf16,int8,w4a8}`` legs compare; on
+silicon it carries the pre-registered >= 1.5x int8-vs-bf16 target
+(docs/perf.md "Quantized serving").
 
 **Fleet chaos mode** (`--fleet N`): spawn N replica subprocesses, put a
 `services.router.FleetRouter` in front, storm the ROUTER with streaming
@@ -65,11 +72,15 @@ from tools import chaos_common as cc   # noqa: E402 — path set above
 
 def build_api(slots=4, paged_block=0, pool_tokens=None, slo_ms=0,
               deadline_ms=0, max_len=24, vocab=11, seed=7,
-              generator=None):
+              generator=None, weights=None, cache_dtype=None):
     """A serving endpoint around a tiny UNTRAINED transformer (the
     harness tests the lifecycle, not the language model).  Config
     knobs are set process-globally (root.common.serve) exactly as an
-    operator would."""
+    operator would.  ``weights``: None (f32) / "bf16" / "int8" /
+    "w4a8" — the serving weight scheme (``--weights``); the quantized
+    legs prove the lifecycle machinery over the quantized decode path
+    (payload-in-dot matmuls, QuantCache pools with
+    ``cache_dtype="int8"``)."""
     from veles_tpu import prng
     from veles_tpu.config import root
     from veles_tpu.services.restful import RESTfulAPI
@@ -97,7 +108,11 @@ def build_api(slots=4, paged_block=0, pool_tokens=None, slo_ms=0,
             loss="lm", decision_config={"max_epochs": 1},
             name="chaos-serve")
         wf.initialize()
-        generator = LMGenerator(wf.trainer, max_len=max_len)
+        generator = LMGenerator(
+            wf.trainer, max_len=max_len,
+            weights=(None if weights in (None, "", "f32")
+                     else str(weights)),
+            cache_dtype=cache_dtype)
     api = RESTfulAPI(lambda xx: xx, (generator.max_len,), port=0,
                      generator=generator, continuous_slots=slots,
                      paged_block=paged_block, pool_tokens=pool_tokens)
@@ -212,10 +227,13 @@ def _wait_idle(engine, timeout=120.0):
 def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         fault_rate=0.02, slots=4, paged_block=0, pool_tokens=None,
         max_new=8, prompt_len=5, slo_ms=250, deadline_ms=0,
-        slow_delay=0.4, seed=7, api=None, flight_dump=None):
+        slow_delay=0.4, seed=7, api=None, flight_dump=None,
+        weights=None, cache_dtype=None):
     """Run the chaos scenario; returns the report dict (see gates()).
     Pass ``api`` to reuse a prebuilt endpoint (the tier-1 tests do,
-    to share one compiled model across tests)."""
+    to share one compiled model across tests).  ``weights`` picks the
+    serving weight scheme (f32/bf16/int8/w4a8) for the endpoint this
+    harness builds."""
     own_api = api is None
     if own_api:
         # the storm itself runs WITHOUT a default deadline (deadlines
@@ -223,11 +241,13 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         # open); deadline_ms drives the separate bounded phase below
         api = build_api(slots=slots, paged_block=paged_block,
                         pool_tokens=pool_tokens, slo_ms=slo_ms,
-                        deadline_ms=0, seed=seed)
+                        deadline_ms=0, seed=seed, weights=weights,
+                        cache_dtype=cache_dtype)
     eng = api.engine
     rng = random.Random(seed)
     prompt = [int(1 + i % 7) for i in range(prompt_len)]
-    report = {"clients": clients, "tally": {}, "phases": {}}
+    report = {"clients": clients, "tally": {}, "phases": {},
+              "weights": weights or "f32"}
     try:
         # ---- warmup: compile every shape OUTSIDE the measured storm
         # (and outside any default deadline — first-dispatch compiles
@@ -268,9 +288,21 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         for th in threads:
             th.join(timeout=300)
         stuck_clients = sum(1 for th in threads if th.is_alive())
-        report["phases"]["storm_s"] = round(time.monotonic() - t0, 2)
+        storm_s = time.monotonic() - t0
+        report["phases"]["storm_s"] = round(storm_s, 2)
         report["tally"] = tally
         report["stuck_client_threads"] = stuck_clients
+        # storm-phase ms/tok off COMPLETED requests (ok = fully
+        # decoded + delivered): the token throughput the pool actually
+        # sustained under the storm, not the admission p50 — the
+        # number the quantized-weights legs compare (the pre-
+        # registered >= 1.5x int8-vs-bf16 target reads this on
+        # silicon; shed/deadline culls don't count, they decoded
+        # nothing)
+        done_toks = tally.get("ok", 0) * max_new
+        report["storm_completed_tokens"] = done_toks
+        report["storm_ms_per_tok"] = (round(storm_s * 1e3 / done_toks,
+                                            4) if done_toks else None)
 
         # ---- recovery: chaos off, drain, the valve must close and
         # fresh requests must succeed
@@ -722,6 +754,15 @@ def main(argv=None):
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--paged-block", type=int, default=0)
     ap.add_argument("--pool-tokens", type=int, default=None)
+    ap.add_argument("--weights", default=None,
+                    choices=["f32", "bf16", "int8", "w4a8"],
+                    help="serving weight scheme for the endpoint "
+                         "(default f32 = as-trained); the report's "
+                         "storm_ms_per_tok compares schemes")
+    ap.add_argument("--cache-dtype", default=None,
+                    choices=["bfloat16", "int8"],
+                    help="KV-cache dtype (int8 + --paged-block runs "
+                         "the fused quantized-pool decode kernel)")
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=5)
     ap.add_argument("--slo-ms", type=float, default=250.0)
@@ -804,7 +845,8 @@ def main(argv=None):
                  prompt_len=args.prompt_len, slo_ms=args.slo_ms,
                  deadline_ms=args.deadline_ms,
                  slow_delay=args.slow_delay, seed=args.seed,
-                 flight_dump=args.flight_dump)
+                 flight_dump=args.flight_dump, weights=args.weights,
+                 cache_dtype=args.cache_dtype)
     fails = gates(report, expect_shed=not args.no_expect_shed,
                   require_slo=args.require_slo)
     report["failures"] = fails

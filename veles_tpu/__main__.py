@@ -1259,9 +1259,11 @@ class Main(object):
         params = wf.trainer.serve_params(
             root.common.serve.get("use_ema", False))
         # root.common.serve.cache_dtype='bfloat16' halves the serve-time
-        # KV-cache memory ('int8' quarters it);
+        # KV-cache memory ('int8' quarters it — and feeds the fused
+        # paged decode kernel's quantized-pool variant);
         # root.common.serve.weights='int8' quantizes the serving weights
-        # (W8A8-dynamic, ops.quant) for ~half the decode HBM traffic;
+        # (W8A8-dynamic, ops.quant) for ~half the decode HBM traffic,
+        # 'w4a8' nibble-packs them to int4 payloads (quarter bytes);
         # root.common.serve.batch_window_ms>0 coalesces concurrent
         # generate requests into shared device calls;
         # root.common.serve.continuous_slots>0 runs the in-flight

@@ -14,7 +14,6 @@ generation is the transformer-era equivalent and beyond-parity."""
 
 
 import collections
-import functools
 import threading
 
 import jax
@@ -185,9 +184,10 @@ class LMGenerator:
                         "divisible by the model axis size (%d)"
                         % (layer.n_kv_heads, m))
         if self.weight_dtype is not None:
-            if self.weight_dtype not in ("bf16", "int8"):
-                raise ValueError("weights must be None, 'bf16' or "
-                                 "'int8', got %r" % (self.weight_dtype,))
+            if self.weight_dtype not in ("bf16", "int8", "w4a8"):
+                raise ValueError("weights must be None, 'bf16', 'int8' "
+                                 "or 'w4a8', got %r"
+                                 % (self.weight_dtype,))
             # weight compression must never shift cache/compute
             # precision — that stays an explicit cache_dtype opt-in
             self._float_dtype = \
@@ -203,30 +203,68 @@ class LMGenerator:
                                if hasattr(a, "dtype")
                                and jnp.issubdtype(a.dtype, jnp.floating)
                                else a), self.params)
-            else:                       # int8
-                if self.mesh_cfg is not None and \
-                        self.mesh_cfg.model_size > 1:
-                    # quantized copies are rebuilt host-side and would
-                    # lose the training shardings the TP decode path
-                    # relies on
-                    raise ValueError(
-                        "int8 serving weights are single-device for "
-                        "now — drop the model-axis mesh or serve in "
-                        "bf16")
+            else:                       # int8 / w4a8
                 if any(layer.cfg.get("n_experts")
                        for layer in self._blocks):
                     raise ValueError(
-                        "int8 serving weights do not cover MoE experts "
-                        "yet")
+                        "%s serving weights do not cover MoE experts "
+                        "yet" % self.weight_dtype)
+                if self.weight_dtype == "w4a8" and \
+                        self.mesh_cfg is not None and \
+                        self.mesh_cfg.model_size > 1:
+                    # the nibble-packed payload halves the contraction
+                    # axis, so the training partition specs no longer
+                    # describe it — int8 carries the shardings, w4a8
+                    # stays single-device for now
+                    raise ValueError(
+                        "w4a8 serving weights are single-device for "
+                        "now — serve int8 under a model-axis mesh, or "
+                        "drop the mesh")
+                orig = self.params
                 self.params = quant.quantize_lm_params(
-                    self.params, embed_name=self._embed.name)
+                    self.params, embed_name=self._embed.name,
+                    scheme=self.weight_dtype)
+                if self.mesh_cfg is not None and \
+                        self.mesh_cfg.model_size > 1:
+                    # tensor-parallel int8: re-place every quantized
+                    # leaf explicitly — the int8 payload sharded like
+                    # the float weight it replaces (the eager
+                    # quantization already computed under that
+                    # sharding), the per-channel scales replicated so
+                    # the rescale never inserts a collective
+                    self.params = self._shard_quant_params(orig,
+                                                           self.params)
 
     # ------------------------------------------------------------------
+    def _shard_quant_params(self, orig, qparams):
+        """Re-place quantized leaves under the tensor-parallel mesh:
+        the payload gets the ORIGINAL weight's sharding (so the int8
+        bytes stream exactly where the bf16 bytes did), the scales are
+        replicated.  Walks the quantized tree against the pre-quant
+        tree — a QuantWeight node's partner is the array it replaced."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh_cfg.mesh, P())
+
+        def place(qv, ov):
+            # int8 only: the w4a8 constructor path refuses a model-axis
+            # mesh outright (the packed contraction axis invalidates
+            # the training specs), so QuantWeight4 never reaches here
+            if not isinstance(qv, quant.QuantWeight):
+                return qv
+            sh = getattr(ov, "sharding", None)
+            payload = (jax.device_put(qv.q, sh) if sh is not None
+                       else qv.q)
+            return quant.QuantWeight(payload,
+                                     jax.device_put(qv.scale, repl))
+
+        return jax.tree_util.tree_map(place, qparams, orig,
+                                      is_leaf=quant.is_quant)
+
     def _embed_rows(self, params, idx):
-        """Embedding lookup — int8 serving tables (QuantWeight) gather
-        int8 rows and dequantize only those (ops.quant.take_rows)."""
+        """Embedding lookup — quantized serving tables gather payload
+        rows and dequantize only those (ops.quant.take_rows)."""
         table = params[self._embed.name]["table"]
-        if isinstance(table, quant.QuantWeight):
+        if quant.is_quant(table):
             return quant.take_rows(table, idx.astype(jnp.int32))
         return jnp.take(table, idx.astype(jnp.int32), axis=0)
 
@@ -1461,7 +1499,7 @@ class ContinuousBatcher:
         and verifies them in ONE chunk pass per tick, advancing by
         1 + accepted instead of 1.
 
-        EXACT decode semantics, per row kind:
+        EXACT decode semantics, PER ROW:
         * greedy rows accept exactly the prefix of drafts that equal
           the verify pass's own argmax — the accepted tokens ARE the
           argmax chain, so outputs match the 1-token core token for
@@ -1474,6 +1512,17 @@ class ContinuousBatcher:
           with the identical (seed, position) key the 1-token core
           would have used — bit-equal streams.
 
+        Routing is PER ROW: the draft/verify/acceptance math runs
+        identically for every row regardless of what it shares the
+        pool with, and each row's ``sampled = inv_temp > 0`` flag
+        selects its own token in a ``where``.  The only pool-wide
+        ``lax.cond`` left gates the PRICE of the gumbel draws (the
+        1-token core's own all-greedy guard) — never the speculation
+        semantics, so one sampled request cannot strip speculation
+        from (or perturb by one bit) the greedy rows around it.  The
+        old pool-wide branch between a sampled and a greedy step
+        function — the `serve.spec_degraded` cliff — is gone.
+
         The chunk writes draft-conditioned K/V up to ``draft_k``
         positions past a row's cursor; rejected-tail entries are
         rewritten by a later chunk before any mask lets them be
@@ -1484,8 +1533,12 @@ class ContinuousBatcher:
         ll = gen.max_len
         idx = jnp.arange(kk)
 
-        def row_spec(params, caches, row, pos, aid, seed, inv_temp,
-                     plen, total, active, *, do_draw):
+        def row_verify(params, caches, row, pos, aid, inv_temp, plen,
+                       total):
+            """Per-row draft + K-wide verify + acceptance count — NO
+            sampling in here; the draw routes per row outside the
+            vmap, so the verify math is one program for every pool
+            mix."""
             params = gen._graft_adapters(params, aid)
             c1 = jax.tree_util.tree_map(lambda a: a[None], caches)
             draft = _ngram_draft(row, pos, kk, ll)
@@ -1508,45 +1561,55 @@ class ContinuousBatcher:
             a = jnp.minimum(jnp.argmin(jnp.concatenate(
                 [ok, jnp.zeros((1,), bool)])), kk - 1)
             a = jnp.minimum(a, jnp.maximum(total - 2 - pos, 0))
-            if do_draw:
-                key = jax.random.fold_in(jax.random.key(seed),
-                                         pos + a)
-                draw = jax.random.categorical(
-                    key, logits[a] * inv_temp).astype(jnp.int32)
-                gen_tok = jnp.where(sampled, draw, jnp.take(g, a))
-            else:
-                gen_tok = jnp.take(g, a)
-            bonus = jnp.where(jnp.take(in_prompt, a),
-                              jnp.take(old, a), gen_tok)
-            newvec = jnp.where(idx < a, draft,
-                               jnp.where(idx == a, bonus, old))
-            # frozen rows write their own old values back (idempotent)
-            newvec = jnp.where(active & (idx <= a), newvec, old)
-            row = jax.lax.dynamic_update_slice(row, newvec, (pos + 1,))
-            adv = jnp.where(active, a + 1, 0)
-            return (row, jax.tree_util.tree_map(lambda x: x[0], c1),
-                    pos + adv)
+            return (jax.tree_util.tree_map(lambda x: x[0], c1),
+                    draft, old, in_prompt, a, jnp.take(g, a),
+                    logits[a])
 
-        axes = (None, 0, 0, 0, 0, 0, 0, 0, 0, 0)
-        step_sampled = jax.vmap(functools.partial(row_spec,
-                                                  do_draw=True),
-                                in_axes=axes)
-        step_greedy = jax.vmap(functools.partial(row_spec,
-                                                 do_draw=False),
-                               in_axes=axes)
+        verify_all = jax.vmap(row_verify,
+                              in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
 
         def core(params, st, aids):
             (tokens, pos, plen, total, active, seeds, inv_temp,
              caches) = st
-            args = (params, caches, tokens, pos, aids, seeds,
-                    inv_temp, plen, total, active)
+            (caches, draft, old, in_prompt, a, g_a, logits_a) = \
+                verify_all(params, caches, tokens, pos, aids,
+                           inv_temp, plen, total)
+            sampled = inv_temp > 0.0
+
+            def draw(_):
+                keys = jax.vmap(
+                    lambda s, p: jax.random.fold_in(
+                        jax.random.key(s), p))(seeds, pos + a)
+                smp = jax.vmap(
+                    lambda lg, k, it: jax.random.categorical(
+                        k, lg * it))(logits_a, keys,
+                                     inv_temp).astype(jnp.int32)
+                return jnp.where(sampled, smp, g_a)
+
             # all-greedy pools (the serving default) skip the
-            # whole-vocab gumbel draws entirely — the 1-token core's
-            # own guard, kept here
-            tokens, caches, pos = jax.lax.cond(
-                jnp.any(inv_temp > 0.0),
-                lambda op: step_sampled(*op),
-                lambda op: step_greedy(*op), args)
+            # whole-vocab gumbel draws entirely — same cost guard as
+            # the 1-token core's lax.cond; greedy rows select g_a on
+            # BOTH sides of it, so the branch can never change a
+            # greedy row's bytes
+            gen_tok = jax.lax.cond(jnp.any(sampled), draw,
+                                   lambda _: g_a, None)
+            old_a = jnp.take_along_axis(old, a[:, None], 1)[:, 0]
+            prompt_a = jnp.take_along_axis(in_prompt, a[:, None],
+                                           1)[:, 0]
+            # the bonus position must never overwrite a teacher-forced
+            # prompt token
+            bonus = jnp.where(prompt_a, old_a, gen_tok)
+            newvec = jnp.where(idx[None, :] < a[:, None], draft,
+                               jnp.where(idx[None, :] == a[:, None],
+                                         bonus[:, None], old))
+            # frozen rows write their own old values back (idempotent)
+            newvec = jnp.where(active[:, None]
+                               & (idx[None, :] <= a[:, None]),
+                               newvec, old)
+            tokens = jax.vmap(
+                lambda r, nv, p: jax.lax.dynamic_update_slice(
+                    r, nv, (p + 1,)))(tokens, newvec, pos)
+            pos = pos + jnp.where(active, a + 1, 0)
             active = active & (pos + 1 < total)
             return (tokens, pos, plen, total, active, seeds,
                     inv_temp, caches)
@@ -1720,29 +1783,28 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._resume_gather_fn = None        # jitted row gather (lazy)
         #: fused tick: attention reads the pool through the block table
         #: (ops.pallas.paged scalar-prefetch kernel) — no per-tick
-        #: dense gather/scatter.  Auto-fallback to the gather tick for
-        #: QuantCache pools (the kernel reads plain-dtype pools only)
-        #: and for window >= max_len models (linear cache, so they
-        #: pass the pageability check, but the kernel has no window
-        #: mask — the gather tick served them before and still does).
-        quant_pool = any(
-            isinstance(c, attention.QuantCache)
-            for layer in cache_shapes for c in layer)
+        #: dense gather/scatter.  QuantCache pools run the kernel's
+        #: quantized variant (int8 K/V streamed from HBM, dequantized
+        #: in VMEM with f32 accumulation — the int8 payload stays
+        #: narrow all the way into the decode dots).  Auto-fallback to
+        #: the gather tick only for window >= max_len models (linear
+        #: cache, so they pass the pageability check, but the kernel
+        #: has no window mask — the gather tick served them before and
+        #: still does).
         windowed = any(getattr(l, "cfg", {}).get("window")
                        for l in gen._blocks)
         # Mosaic sublane bound: a pool block is the fused kernel's K/V
         # tile, so when the kernel would actually be Mosaic-compiled
         # (a real TPU backend — interpret mode takes any size), blocks
-        # below the dtype's sublane minimum fall back to the gather
-        # tick exactly like quant/window pools do, instead of failing
-        # compilation at the first tick.
+        # below the dtype's sublane minimum (32 rows for int8 pools)
+        # fall back to the gather tick exactly like window pools do,
+        # instead of failing compilation at the first tick.
         from veles_tpu.ops import pallas as _pallas
         pool_dtype = jax.tree_util.tree_leaves(cache_shapes)[0].dtype
         mosaic_ok = (_pallas.autodetect_interpret(None)
                      or self.block
                      >= _pallas.mosaic_sublane_min(pool_dtype))
-        self.fused = (bool(fused) and not quant_pool and not windowed
-                      and mosaic_ok)
+        self.fused = (bool(fused) and not windowed and mosaic_ok)
 
     def _init_slot_caches(self):
         return None                          # the pool replaces them

@@ -964,16 +964,23 @@ class TiedLMHead(Layer):
     def apply(self, params, x, train=False, key=None):
         # ``params`` is the FULL tree (needs_full_params)
         table = params[self.tie_to]["table"]        # [vocab, d_model]
-        from veles_tpu.ops.quant import QuantWeight, int8_matmul_t
-        shape = table.q.shape if isinstance(table, QuantWeight) \
-            else table.shape
+        from veles_tpu.ops.quant import (QuantWeight4, is_quant,
+                                         quant_matmul_t)
+        if isinstance(table, QuantWeight4):
+            # nibble-packed: the payload's packed axis is d/2, so the
+            # logical shape is (vocab, table.n)
+            shape = (table.q.shape[0], table.n)
+        elif is_quant(table):
+            shape = table.q.shape
+        else:
+            shape = table.shape
         if shape != (self.n_out, self.n_in):
             raise ValueError("tied table %s does not match head (%d, %d)"
                              % (shape, self.n_out, self.n_in))
-        if isinstance(table, QuantWeight):
-            # int8 serving: the per-ROW table scales are exactly the
-            # head's per-output-channel scales (ops.quant)
-            return int8_matmul_t(x, table)
+        if is_quant(table):
+            # quantized serving: the per-ROW table scales are exactly
+            # the head's per-output-channel scales (ops.quant)
+            return quant_matmul_t(x, table)
         return linear.matmul(x, table.T, self.policy)
 
 

@@ -232,11 +232,11 @@ def merge_heads(x):
 
 
 def _proj(x, w, b, policy):
-    from veles_tpu.ops.quant import QuantWeight, int8_matmul
-    if isinstance(w, QuantWeight):
-        # int8 serving weights: W8A8-dynamic dot (ops.quant) — the
-        # weight stays int8 into the MXU, halving decode HBM traffic
-        return int8_matmul(x, w) + b.astype(jnp.float32)
+    from veles_tpu.ops.quant import is_quant, quant_matmul
+    if is_quant(w):
+        # quantized serving weights (int8 W8A8 / w4a8): the payload
+        # stays narrow into the dot, cutting decode HBM traffic
+        return quant_matmul(x, w) + b.astype(jnp.float32)
     if policy is None:
         return x @ w + b
     y = jnp.matmul(policy.cast_in(x), policy.cast_in(w),
@@ -602,48 +602,60 @@ def mha_step_paged(params, x, pool_k, pool_v, table, pos, n_heads,
 
     x: [B, 1, d_model] — every row decodes its OWN position ``pos[b]``
     (a [B] vector, unlike mha_step's scalar: slots run at different
-    depths).  pool_k/pool_v: [1+P, Hkv, block, hd], block 0 reserved;
-    table: [B, nbm] int32 pool-block ids; row b's key at absolute
-    position t lives in pool block table[b, t // block], offset
-    t % block.
+    depths).  pool_k/pool_v: [1+P, Hkv, block, hd], block 0 reserved —
+    or QuantCache pairs (int8 data + f32 per-position scales): the new
+    k/v quantize at the write exactly like mha_step's dense int8
+    cache, and the kernel streams the int8 pool from HBM and
+    dequantizes in VMEM with f32 accumulation.  table: [B, nbm] int32
+    pool-block ids; row b's key at absolute position t lives in pool
+    block table[b, t // block], offset t % block.
 
-    QuantCache pools and sliding windows are not supported here — the
-    batcher's gather path remains the fallback (and rolling windows are
-    already rejected at pool construction).
+    Sliding windows are not supported here — the batcher's gather path
+    remains the fallback (and rolling windows are already rejected at
+    pool construction).
     Returns (y [B, 1, d_model], pool_k, pool_v) with ``pos`` written.
     """
     from veles_tpu.ops.pallas.paged import paged_attention_decode
     if n_kv_heads is None:
         n_kv_heads = n_heads
-    if isinstance(pool_k, QuantCache) or isinstance(pool_v, QuantCache):
-        raise ValueError("mha_step_paged does not support QuantCache "
-                         "pools — use the gather tick (fused=False)")
+    quant = isinstance(pool_k, QuantCache)
     pos = pos.astype(jnp.int32)
     q, k1, v1 = _qkv_proj(params, x, n_heads, n_kv_heads, policy)
-    k1 = k1.astype(pool_k.dtype)
-    v1 = v1.astype(pool_v.dtype)
+    if not quant:
+        k1 = k1.astype(pool_k.dtype)
+        v1 = v1.astype(pool_v.dtype)
     if use_rope:
         q = _rope_rows(q, pos)
-        k1 = _rope_rows(k1, pos).astype(pool_k.dtype)
+        k1 = (_rope_rows(k1, pos) if quant
+              else _rope_rows(k1, pos).astype(pool_k.dtype))
 
-    bs = pool_k.shape[2]
+    bs = (pool_k.data if quant else pool_k).shape[2]
     rows = jnp.arange(x.shape[0])
     blk = table[rows, pos // bs]
     off = pos % bs
+
     # write targets are exclusively-owned blocks: allocation is a
     # host-side free-list pop, and prefix-SHARED blocks are never
     # write targets (the batcher shares only blocks strictly before
     # any owner's first written position, _shareable_blocks) — so the
     # [B]-indexed scatter has no duplicate hazard
-    pool_k = pool_k.at[blk, :, off].set(k1[:, :, 0])
-    pool_v = pool_v.at[blk, :, off].set(v1[:, :, 0])
+    def write(pool, val):
+        if not quant:
+            return pool.at[blk, :, off].set(val[:, :, 0])
+        d, s = quantize_kv(val)              # [B, Hkv, 1, hd]/[..., 1]
+        return QuantCache(pool.data.at[blk, :, off].set(d[:, :, 0]),
+                          pool.scale.at[blk, :, off].set(s[:, :, 0]))
+
+    pool_k = write(pool_k, k1)
+    pool_v = write(pool_v, v1)
 
     b, h, _, hd = q.shape
-    # the kernel runs the MXU in the pool dtype (bf16 serving); the
-    # dense einsum path mixes f32 q with the cache dtype instead —
-    # numerics differ at the last-ulp level, same as flash vs naive
-    o = paged_attention_decode(q[:, :, 0].astype(pool_k.dtype),
-                               pool_k, pool_v, table, pos,
+    # the kernel runs the MXU in the pool dtype (bf16 serving; int8
+    # pools dequantize in kernel to f32); the dense einsum path mixes
+    # f32 q with the cache dtype instead — numerics differ at the
+    # last-ulp level, same as flash vs naive
+    qk = q[:, :, 0] if quant else q[:, :, 0].astype(pool_k.dtype)
+    o = paged_attention_decode(qk, pool_k, pool_v, table, pos,
                                scale=_scale(hd, scale))
     o = o.reshape(b, 1, h * hd).astype(x.dtype)
     return (_proj(o, params["wo"], params["bo"], policy),

@@ -14,11 +14,12 @@ from veles_tpu.ops.policy import Policy
 def matmul(a, b, policy=Policy()):
     """MXU matmul with compute-dtype inputs and accum-dtype output — the
     gemm primitive (ref ocl/gemm.cl signature αAB+βC collapses to XLA).
-    An int8 serving weight (ops.quant.QuantWeight) routes to the
-    W8A8-dynamic integer-MXU dot instead."""
-    from veles_tpu.ops.quant import QuantWeight, int8_matmul
-    if isinstance(b, QuantWeight):
-        return int8_matmul(a, b)
+    A quantized serving weight (ops.quant QuantWeight/QuantWeight4)
+    routes to the scheme's quantized dot instead — the payload reaches
+    the dot itself, never a dequantized copy."""
+    from veles_tpu.ops.quant import is_quant, quant_matmul
+    if is_quant(b):
+        return quant_matmul(a, b)
     return jnp.dot(policy.cast_in(a), policy.cast_in(b),
                    preferred_element_type=policy.accum)
 

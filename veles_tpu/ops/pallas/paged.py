@@ -94,6 +94,60 @@ def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
+def _decode_kernel_quant(table_ref, pos_ref, q_ref, k_ref, ks_ref,
+                         v_ref, vs_ref, o_ref, acc, m, l, *, scale, bs,
+                         nbm):
+    """Quantized-pool flavor: the K/V tiles arrive int8 (HBM streams
+    one byte per element — the whole point) with per-position f32
+    scales, and are dequantized IN KERNEL, in VMEM, with f32
+    accumulation throughout.  The scales fold in after the dots
+    exactly like the dense QuantCache einsums in ops.attention
+    (q·(k·s) == (q·k)·s per position), so the math is the gather
+    tick's, just narrower on the wire."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    @pl.when(i * bs <= pos_ref[b])
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0, 0].astype(jnp.float32),
+            k_ref[0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # per-position k scales, then the 1/sqrt(hd) logit scale
+        s = s * ks_ref[0, 0][:, 0][None, :] * scale
+        kpos = i * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kpos <= pos_ref[b]
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(m_prev <= NEG_INF / 2, 0.0, corr)
+        l[:] = l[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l.shape)
+        m[:] = jnp.broadcast_to(m_new, m.shape)
+        # fold the per-position v scales into the probabilities (the
+        # QuantCache move), keep the accumulate f32
+        pv = p * vs_ref[0, 0][:, 0][None, :]
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            pv, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nbm - 1)
+    def _():
+        o_ref[0, 0] = (acc[:] / jnp.maximum(l[:, :1], 1e-30)).astype(
+            o_ref.dtype)
+
+
 def _resolve_block_g(g, hd, dtype, block_g=None):
     """Resolve the q-group sublane pad: explicit argument > site config
     (``root.common.serve.paged_block_g``) > autotuner winner
@@ -154,7 +208,10 @@ def preferred_pool_block(hd, g=1, dtype=jnp.bfloat16, default=16):
             return int(win["block"])
     except Exception:  # noqa: BLE001 — tuning is advisory
         pass
-    return int(default)
+    # untuned fallback is sublane-aware: int8 pools (QuantCache) need
+    # 32-row tiles on real silicon, bf16/f32 keep the historical 16
+    from veles_tpu.ops.pallas import mosaic_sublane_min
+    return max(int(default), mosaic_sublane_min(dtype))
 
 
 def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
@@ -162,16 +219,27 @@ def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
     """One decode step of attention over a paged KV pool (see module
     docstring for the layout contract).  Returns [B, Hq, hd].
 
+    ``pool_k``/``pool_v`` may be plain arrays OR
+    ``ops.attention.QuantCache`` pairs (int8 data [1+P, Hkv, bs, hd] +
+    f32 per-position scales [1+P, Hkv, bs, 1]) — the quantized pool
+    streams one byte per KV element from HBM and dequantizes in
+    kernel with f32 accumulation (``_decode_kernel_quant``).
+
     ``block_g`` — the q-group sublane pad (rows per grid step); unset,
-    it resolves through config > autotuner > ``_MIN_G``
-    (:func:`_resolve_block_g`)."""
+    it resolves through config > autotuner > ``_MIN_G``; quantized
+    pools key the tuner lookup by the POOL dtype (int8), matching how
+    ``tuner.sweeps.sweep_paged(dtype="int8")`` records winners."""
+    from veles_tpu.ops.attention import QuantCache
+    quant = isinstance(pool_k, QuantCache)
+    kd = pool_k.data if quant else pool_k
     b, hq, hd = q.shape
-    npool, hkv, bs, _ = pool_k.shape
+    npool, hkv, bs, _ = kd.shape
     nbm = table.shape[1]
     if hq % hkv:
         raise ValueError("Hq %d %% Hkv %d != 0" % (hq, hkv))
     g = hq // hkv
-    gp = _resolve_block_g(g, hd, q.dtype, block_g)
+    gp = _resolve_block_g(g, hd, kd.dtype if quant else q.dtype,
+                          block_g)
     scale = (hd ** -0.5) if scale is None else scale
 
     # [B, Hq, hd] -> [B, Hkv, Gp, hd]: group queries under their kv
@@ -182,25 +250,41 @@ def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
     if gp != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
 
-    kernel = functools.partial(_decode_kernel, scale=scale, bs=bs,
-                               nbm=nbm)
+    def at_q(bi, h, i, tbl, ps):
+        return (bi, h, 0, 0)
+
+    def at_pool(bi, h, i, tbl, ps):
+        return (tbl[bi, i], h, 0, 0)
+
+    if quant:
+        kernel = functools.partial(_decode_kernel_quant, scale=scale,
+                                   bs=bs, nbm=nbm)
+        in_specs = [
+            pl.BlockSpec((1, 1, gp, hd), at_q),
+            pl.BlockSpec((1, 1, bs, hd), at_pool),   # k int8
+            pl.BlockSpec((1, 1, bs, 1), at_pool),    # k scales
+            pl.BlockSpec((1, 1, bs, hd), at_pool),   # v int8
+            pl.BlockSpec((1, 1, bs, 1), at_pool),    # v scales
+        ]
+        operands = (qg, pool_k.data, pool_k.scale, pool_v.data,
+                    pool_v.scale)
+    else:
+        kernel = functools.partial(_decode_kernel, scale=scale, bs=bs,
+                                   nbm=nbm)
+        in_specs = [
+            pl.BlockSpec((1, 1, gp, hd), at_q),
+            pl.BlockSpec((1, 1, bs, hd), at_pool),
+            pl.BlockSpec((1, 1, bs, hd), at_pool),
+        ]
+        operands = (qg, pool_k, pool_v)
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, hkv, nbm),
-            in_specs=[
-                pl.BlockSpec((1, 1, gp, hd),
-                             lambda bi, h, i, tbl, ps: (bi, h, 0, 0)),
-                pl.BlockSpec((1, 1, bs, hd),
-                             lambda bi, h, i, tbl, ps: (tbl[bi, i], h,
-                                                        0, 0)),
-                pl.BlockSpec((1, 1, bs, hd),
-                             lambda bi, h, i, tbl, ps: (tbl[bi, i], h,
-                                                        0, 0)),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1, gp, hd), lambda bi, h, i, tbl, ps: (bi, h, 0, 0)),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, gp, hd), at_q),
             scratch_shapes=[
                 pltpu.VMEM((gp, hd), jnp.float32),
                 pltpu.VMEM((gp, _LANES), jnp.float32),
@@ -209,8 +293,7 @@ def paged_attention_decode(q, pool_k, pool_v, table, pos, scale=None,
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), q.dtype),
         interpret=autodetect_interpret(interpret),
-    )(table.astype(jnp.int32), pos.astype(jnp.int32), qg, pool_k,
-      pool_v)
+    )(table.astype(jnp.int32), pos.astype(jnp.int32), *operands)
     return out[:, :, :g].reshape(b, hq, hd)
 
 
@@ -218,16 +301,24 @@ def paged_attention_reference(q, pool_k, pool_v, table, pos,
                               scale=None):
     """Gather-formulation ground truth (identical math to the dense
     decode einsum in ops.attention.mha_step): materialize each row's
-    blocks densely, run a masked softmax.  Used by the tests and as
-    the documentation of the kernel's exact semantics."""
+    blocks densely, run a masked softmax.  QuantCache pools
+    dequantize the gathered view (data × per-position scale — exactly
+    what the in-kernel fold computes).  Used by the tests and as the
+    documentation of the kernel's exact semantics."""
+    from veles_tpu.ops.attention import QuantCache
     b, hq, hd = q.shape
-    _, hkv, bs, _ = pool_k.shape
+    kd = pool_k.data if isinstance(pool_k, QuantCache) else pool_k
+    _, hkv, bs, _ = kd.shape
     nbm = table.shape[1]
     g = hq // hkv
     scale = (hd ** -0.5) if scale is None else scale
 
     def dense(pool):
-        v = pool[table]                       # [B, nbm, Hkv, bs, hd]
+        if isinstance(pool, QuantCache):
+            v = (pool.data[table].astype(jnp.float32)
+                 * pool.scale[table])         # [B, nbm, Hkv, bs, hd]
+        else:
+            v = pool[table]                   # [B, nbm, Hkv, bs, hd]
         v = jnp.moveaxis(v, 2, 1)             # [B, Hkv, nbm, bs, hd]
         return v.reshape(b, hkv, nbm * bs, hd)
 
@@ -251,18 +342,34 @@ def paged_attention_reference(q, pool_k, pool_v, table, pos,
 # --------------------------------------------------------------------------
 
 def audit_launch(hd, bs, g=1, dtype=jnp.bfloat16, nbm=32, masked=True,
-                 checked=()):
+                 checked=(), q_dtype=jnp.bfloat16):
     """Launch description for one paged-decode configuration.  ``bs``
     is the KV pool block (PagedContinuousBatcher ``block``), ``g`` the
     query-group size (Hq/Hkv) — padded to the sublane tile exactly as
-    ``paged_attention_decode`` does."""
+    ``paged_attention_decode`` does.  ``dtype`` is the POOL dtype:
+    int8 describes the quantized-pool kernel variant (int8 K/V tiles +
+    f32 per-position scale tiles, float q/out in ``q_dtype``)."""
+    import numpy as np
     gp = max(g, _MIN_G)
+    quant = np.dtype(dtype) == np.dtype(np.int8)
+    if quant:
+        blocks = [("q", (1, 1, gp, hd), q_dtype, {"full_lane": True}),
+                  ("k", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                  ("k_scale", (1, 1, bs, 1), jnp.float32,
+                   {"full_lane": True}),
+                  ("v", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                  ("v_scale", (1, 1, bs, 1), jnp.float32,
+                   {"full_lane": True}),
+                  ("o", (1, 1, gp, hd), q_dtype, {"full_lane": True})]
+    else:
+        blocks = [("q", (1, 1, gp, hd), dtype, {"full_lane": True}),
+                  ("k", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                  ("v", (1, 1, bs, hd), dtype, {"full_lane": True}),
+                  ("o", (1, 1, gp, hd), dtype, {"full_lane": True})]
     return [{
-        "kernel": "paged.decode", "masked": masked, "checked": checked,
-        "blocks": [("q", (1, 1, gp, hd), dtype, {"full_lane": True}),
-                   ("k", (1, 1, bs, hd), dtype, {"full_lane": True}),
-                   ("v", (1, 1, bs, hd), dtype, {"full_lane": True}),
-                   ("o", (1, 1, gp, hd), dtype, {"full_lane": True})],
+        "kernel": "paged.decode.q8" if quant else "paged.decode",
+        "masked": masked, "checked": checked,
+        "blocks": blocks,
         "scratch": [("acc", (gp, hd), jnp.float32),
                     ("m", (gp, _LANES), jnp.float32),
                     ("l", (gp, _LANES), jnp.float32)],
@@ -275,11 +382,17 @@ def audit_launch(hd, bs, g=1, dtype=jnp.bfloat16, nbm=32, masked=True,
 @register_kernel_audit("paged")
 def _configured_launches():
     """What ``--serve`` with paged KV would actually launch at the
-    flagship head dim in bf16: the pool block through the same config >
-    tuner > default chain the batcher uses
-    (:func:`preferred_pool_block`), the q-group pad through
-    :func:`_resolve_block_g` — so an over-budget tuned winner fails the
-    lint exactly like a hand-misconfigured ``paged_block``."""
+    flagship head dim: the pool block through the same config > tuner >
+    default chain the batcher uses (:func:`preferred_pool_block`), the
+    q-group pad through :func:`_resolve_block_g` — so an over-budget
+    tuned winner fails the lint exactly like a hand-misconfigured
+    ``paged_block``.  BOTH pool flavors are audited: the bf16 pool and
+    the int8 (``cache_dtype="int8"``) QuantCache pool, each resolved
+    at its own dtype key."""
     hd, g = 128, 1
-    bs = preferred_pool_block(hd, g)
-    return audit_launch(hd, bs, g=_resolve_block_g(g, hd, jnp.bfloat16))
+    launches = []
+    for dtype in (jnp.bfloat16, jnp.int8):
+        bs = preferred_pool_block(hd, g, dtype)
+        launches.extend(audit_launch(
+            hd, bs, g=_resolve_block_g(g, hd, dtype), dtype=dtype))
+    return launches

@@ -1,4 +1,4 @@
-"""Weight-only int8 serving quantization (W8A8-dynamic).
+"""Weight-only int8/w4a8 serving quantization.
 
 Decode is weight-bandwidth-bound: at batch ≤ ~32 every step streams the
 whole parameter set from HBM while the MXU idles, so halving the weight
@@ -19,6 +19,23 @@ scales as output-channel scales.  (KV-cache int8 lives in
 ops.attention.QuantCache; the reference has no serving quantization at
 all — its closest analog is the fp16→fp32 load transform in
 libVeles/src/numpy_array_loader.cc.)
+
+w4a8 (``QuantWeight4``) halves the payload again: symmetric int4
+weights nibble-packed two-per-byte along the contraction axis, int8
+dynamic activations, and the dot accumulates in f32 (there is no
+native int4×int8 MXU path; the operands are integer-valued so the
+float dot is exact, and the win is the 0.5 B/param payload).  One
+honest caveat, measured-not-assumed on the next TPU window: XLA may
+hoist the loop-invariant nibble unpack out of the decode scan, in
+which case the per-step stream falls back to int8-equivalent bytes
+while resident memory stays halved — the jaxpr audit below pins only
+that no dequantized FLOAT copy leaves the dots.
+
+``stray_dequant_sites`` is the audit that keeps all of this true: it
+scans a decode step's jaxpr for payload-sized int8→float conversions
+that do not feed a ``dot_general``, i.e. the exact hoistable
+dense-dequant bug class the int8 path was built to avoid.  The serving
+tests trace the real decode/tick functions through it.
 """
 
 from typing import NamedTuple
@@ -32,6 +49,39 @@ class QuantWeight(NamedTuple):
     flows through jit/scan/device_put untouched."""
     q: jnp.ndarray        # int8  [n_in, n_out]   (tables: [V, d])
     scale: jnp.ndarray    # f32   [n_out]         (tables: [V])
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantWeight4(object):
+    """Nibble-packed int4 payload + f32 per-channel scales (w4a8).
+
+    ``q`` packs two int4 values per int8 byte along ``axis`` (the
+    contraction axis — 0 for an [in, out] weight, 1 for a [V, d]
+    table): byte ``i`` holds logical entries ``2i`` (low nibble) and
+    ``2i + 1`` (high nibble), two's complement.  ``n`` is the logical
+    length of the packed axis (odd lengths pad one zero nibble).
+    Registered as a pytree with (q, scale) as leaves and (n, axis)
+    static, so it flows through jit/scan/device_put like QuantWeight.
+    """
+
+    def __init__(self, q, scale, n, axis):
+        self.q = q
+        self.scale = scale
+        self.n = int(n)
+        self.axis = int(axis)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.n, self.axis)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    def __repr__(self):
+        return ("QuantWeight4(q=%r, scale=%r, n=%d, axis=%d)"
+                % (getattr(self.q, "shape", None),
+                   getattr(self.scale, "shape", None), self.n,
+                   self.axis))
 
 
 def symmetric_int8(x, axis=-1, keepdims=True, eps=1e-8):
@@ -53,6 +103,41 @@ def quantize_weight(w, axis=0):
     giving per-row scales)."""
     q, s = symmetric_int8(jnp.asarray(w), axis=axis, keepdims=False)
     return QuantWeight(q, s)
+
+
+def _pack_nibbles(q, axis):
+    """int8 values in [-8, 7] → one int8 byte per PAIR along ``axis``
+    (low nibble = even index, high nibble = odd; two's complement).
+    Odd lengths pad a zero nibble."""
+    q = jnp.moveaxis(q, axis, 0)
+    if q.shape[0] % 2:
+        q = jnp.concatenate([q, jnp.zeros((1,) + q.shape[1:], q.dtype)])
+    lo, hi = q[0::2], q[1::2]
+    packed = (lo & jnp.int8(0x0F)) | jnp.left_shift(hi, 4)
+    return jnp.moveaxis(packed.astype(jnp.int8), 0, axis)
+
+
+def _unpack_nibbles(p, n, axis):
+    """Inverse of ``_pack_nibbles``: int8 bytes → int8 values (sign-
+    extended nibbles), trimmed to the logical length ``n``."""
+    p = jnp.moveaxis(p, axis, 0)
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)    # arithmetic >>
+    hi = jnp.right_shift(p, 4)
+    q = jnp.stack([lo, hi], axis=1).reshape((-1,) + p.shape[1:])[:n]
+    return jnp.moveaxis(q, 0, axis)
+
+
+def quantize_weight4(w, axis=0):
+    """Symmetric per-channel int4 (w4a8's weight half): scales over
+    ``axis`` at max|w|/7, round-to-nearest, clip ±7, nibble-packed
+    along ``axis``."""
+    w = jnp.asarray(w)
+    xf = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=axis, keepdims=True),
+                        1e-8) / 7.0
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int8)
+    return QuantWeight4(_pack_nibbles(q, axis),
+                        jnp.squeeze(scale, axis), w.shape[axis], axis)
 
 
 def _quant_acts(x):
@@ -78,10 +163,64 @@ def int8_matmul_t(x, qw):
     return y.astype(jnp.float32) * xs * qw.scale
 
 
+def w4a8_matmul(x, qw):
+    """``x @ W`` for an [in, out] QuantWeight4: int8 dynamic
+    activations × unpacked int4 weights, f32 accumulation (both
+    operands are integer-valued, so the float dot is exact).  The
+    nibble unpack and the f32 convert feed the dot DIRECTLY — no
+    dequantized weight copy is ever built outside it (pinned by
+    ``stray_dequant_sites``)."""
+    xq, xs = _quant_acts(x)
+    w = _unpack_nibbles(qw.q, qw.n, 0).astype(jnp.float32)
+    y = jax.lax.dot_general(xq.astype(jnp.float32), w,
+                            (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y * xs * qw.scale
+
+
+def w4a8_matmul_t(x, qw):
+    """``x @ Wᵀ`` for a per-row-quantized [V, d] QuantWeight4 table
+    (packed along d) — the tied-LM-head direction."""
+    xq, xs = _quant_acts(x)
+    w = _unpack_nibbles(qw.q, qw.n, 1).astype(jnp.float32)
+    y = jax.lax.dot_general(xq.astype(jnp.float32), w,
+                            (((x.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y * xs * qw.scale
+
+
+def is_quant(w):
+    """True for any serving-quantized weight leaf (int8 or w4a8)."""
+    return isinstance(w, (QuantWeight, QuantWeight4))
+
+
+def quant_matmul(x, qw):
+    """``x @ W`` routed by scheme — THE funnel the serve-path matmul
+    sites (attention._proj, linear.matmul) call so int8 and w4a8 can
+    never diverge per call site."""
+    if isinstance(qw, QuantWeight4):
+        return w4a8_matmul(x, qw)
+    return int8_matmul(x, qw)
+
+
+def quant_matmul_t(x, qw):
+    """``x @ Wᵀ`` routed by scheme (the tied-LM-head funnel)."""
+    if isinstance(qw, QuantWeight4):
+        return w4a8_matmul_t(x, qw)
+    return int8_matmul_t(x, qw)
+
+
 def take_rows(qw, idx):
-    """Embedding lookup on a per-row-quantized table: gather int8 rows,
-    dequantize only what was gathered (exact — one scalar per row)."""
-    rows = jnp.take(qw.q, idx, axis=0).astype(jnp.float32)
+    """Embedding lookup on a per-row-quantized table: gather the
+    payload rows, dequantize only what was gathered (exact — one
+    scalar per row; w4a8 tables gather packed rows and unpack the
+    gathered bytes only)."""
+    if isinstance(qw, QuantWeight4):
+        packed = jnp.take(qw.q, idx, axis=0)
+        rows = _unpack_nibbles(packed, qw.n, packed.ndim - 1).astype(
+            jnp.float32)
+    else:
+        rows = jnp.take(qw.q, idx, axis=0).astype(jnp.float32)
     return rows * jnp.take(qw.scale, idx)[..., None]
 
 
@@ -93,12 +232,17 @@ _MHA_KEYS = ("wq", "wk", "wv", "wo")
 _DENSE_KEYS = ("w1", "w2", "weights")
 
 
-def quantize_lm_params(params, embed_name=None):
-    """Map a trained transformer-LM param tree to the int8 serving
+def quantize_lm_params(params, embed_name=None, scheme="int8"):
+    """Map a trained transformer-LM param tree to the quantized serving
     layout: attention projections and FFN/head matrices per-output-
     channel, the embedding table (``embed_name``) per row; biases,
     layer norms, positional tables and anything unrecognized stay
-    untouched."""
+    untouched.  ``scheme``: ``"int8"`` (W8A8-dynamic, QuantWeight) or
+    ``"w4a8"`` (nibble-packed int4 payload, QuantWeight4)."""
+    if scheme not in ("int8", "w4a8"):
+        raise ValueError("scheme must be 'int8' or 'w4a8', got %r"
+                         % (scheme,))
+    qfn = quantize_weight if scheme == "int8" else quantize_weight4
     out = {}
     for lname, sub in params.items():
         if not isinstance(sub, dict):
@@ -107,14 +251,121 @@ def quantize_lm_params(params, embed_name=None):
         new = {}
         for k, v in sub.items():
             if k == "mha" and isinstance(v, dict):
-                new[k] = {mk: (quantize_weight(mv)
+                new[k] = {mk: (qfn(mv)
                                if mk in _MHA_KEYS else mv)
                           for mk, mv in v.items()}
             elif k == "table" and lname == embed_name:
-                new[k] = quantize_weight(v, axis=1)
+                new[k] = qfn(v, axis=1)
             elif k in _DENSE_KEYS and getattr(v, "ndim", 0) == 2:
-                new[k] = quantize_weight(v)
+                new[k] = qfn(v)
             else:
                 new[k] = v
         out[lname] = new
     return out
+
+
+# --------------------------------------------------------------------------
+# Stray-dequant jaxpr audit: no quantized payload may be dequantized
+# outside a dot on the decode hot path.
+# --------------------------------------------------------------------------
+
+#: primitives a payload-sized float convert may flow through on its way
+#: into a dot operand — pure layout moves XLA fuses into the MXU load
+#: path.  Anything else (a scale multiply, an add, a scatter) means a
+#: dense dequantized copy was materialized outside the dot.
+_LAYOUT_PRIMS = frozenset(("reshape", "transpose", "broadcast_in_dim",
+                           "squeeze", "copy"))
+
+_SUB_JAXPR_PARAMS = ("jaxpr", "call_jaxpr", "body_jaxpr", "cond_jaxpr",
+                     "branches")
+
+
+def _sub_jaxprs(eqn):
+    for key in _SUB_JAXPR_PARAMS:
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        for item in (v if isinstance(v, (tuple, list)) else (v,)):
+            yield getattr(item, "jaxpr", item)
+
+
+def stray_dequant_sites(closed_jaxpr, min_elems):
+    """Scan a (closed) jaxpr — recursively through scan/cond/while/pjit
+    bodies — for int8→float ``convert_element_type`` ops of size >=
+    ``min_elems`` whose result does NOT feed a ``dot_general``
+    (directly or through pure layout primitives).
+
+    That is exactly the hoistable dense-dequant bug class: a
+    payload-sized float copy of a quantized weight materialized outside
+    the dot gets hoisted out of the decode scan by XLA, and the loop
+    streams floats again.  Gathered-row dequants (embedding lookups)
+    fall under ``min_elems`` and pass.  Returns a list of description
+    strings (empty = clean); the serving tests assert it empty over the
+    traced int8/w4a8 decode step."""
+    sites = []
+
+    def feeds_dot_only(var, consumers, depth=0):
+        eqns = consumers.get(id(var), ())
+        if not eqns:
+            # unused converts don't stream anything; XLA dead-codes them
+            return True
+        for e in eqns:
+            if e.primitive.name == "dot_general":
+                continue
+            if e.primitive.name in _LAYOUT_PRIMS and depth < 4:
+                if all(feeds_dot_only(o, consumers, depth + 1)
+                       for o in e.outvars):
+                    continue
+            return False
+        return True
+
+    def walk(jaxpr):
+        consumers = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if hasattr(v, "aval") and not hasattr(v, "val"):
+                    consumers.setdefault(id(v), []).append(eqn)
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                iv = eqn.invars[0]
+                aval = getattr(iv, "aval", None)
+                out = eqn.outvars[0].aval
+                if (aval is not None
+                        and aval.dtype == jnp.int8
+                        and jnp.issubdtype(out.dtype, jnp.floating)
+                        and aval.size >= min_elems
+                        and not feeds_dot_only(eqn.outvars[0],
+                                               consumers)):
+                    sites.append(
+                        "int8%s -> %s%s convert of %d elems does not "
+                        "feed a dot_general"
+                        % (tuple(aval.shape), out.dtype.name,
+                           tuple(out.shape), aval.size))
+            for sub in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(getattr(closed_jaxpr, "jaxpr", closed_jaxpr))
+    return sites
+
+
+def min_payload_elems(params):
+    """Smallest quantized-payload element count in a param tree — the
+    ``min_elems`` threshold for ``stray_dequant_sites`` (anything at or
+    above it is a whole-weight dequant; gathered rows sit far below)."""
+    sizes = []
+
+    def visit(leaf):
+        if isinstance(leaf, QuantWeight):
+            sizes.append(int(leaf.q.size))
+        elif isinstance(leaf, QuantWeight4):
+            # LOGICAL int4 count, not bytes*2: an odd packed axis pads
+            # one nibble, and a dense dequant of the weight is exactly
+            # n * channels elements — the threshold must not sit above
+            # the very convert it exists to catch
+            packed = -(-leaf.n // 2)
+            sizes.append(int(leaf.q.size) // packed * leaf.n)
+
+    jax.tree_util.tree_map(visit, params, is_leaf=is_quant)
+    if not sizes:
+        raise ValueError("param tree holds no quantized weights")
+    return min(sizes)

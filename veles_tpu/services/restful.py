@@ -239,7 +239,7 @@ class ContinuousEngine(Logger):
         self._deadline_expired = 0
         self._engine_faults = 0
         self._stream_dropped = 0
-        self._spec_degraded = False
+        self._spec_mixed = False
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -299,18 +299,20 @@ class ContinuousEngine(Logger):
                              "(0..%d)" % (int(adapter), n_bank))
         if getattr(self.cb, "speculative_k", 0) \
                 and float(temperature) != 0.0:
-            # one sampled request flips the WHOLE pool off the greedy
-            # speculative fast path (the pool-wide lax.cond in
-            # _make_core_spec) — correctness is unaffected, but the
-            # speculation win erodes until it drains.  One-shot flight
-            # event so operators can see the cliff (per-row routing is
-            # the ROADMAP follow-up); check-and-set under the lock so
-            # concurrent HTTP workers cannot double-emit it.
+            # speculation routes PER ROW (_make_core_spec): a sampled
+            # request advances one token per tick itself, but the
+            # greedy rows around it keep their full speculation —
+            # byte-identical to an all-greedy pool (test-pinned).  The
+            # old pool-wide `serve.spec_degraded` cliff event is
+            # retired; this informational one-shot only notes that the
+            # pool is mixed (the sampled ROW pays the K-wide verify
+            # for single-token progress).  Check-and-set under the
+            # lock so concurrent HTTP workers cannot double-emit it.
             with self._lock:
-                first = not self._spec_degraded
-                self._spec_degraded = True
+                first = not self._spec_mixed
+                self._spec_mixed = True
             if first:
-                flight.record("serve.spec_degraded",
+                flight.record("serve.spec_mixed",
                               speculative_k=int(self.cb.speculative_k))
         now = time.monotonic()
         eff_deadline_ms = (float(deadline_ms) if deadline_ms

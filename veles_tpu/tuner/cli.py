@@ -38,18 +38,19 @@ def _parse_kernels(spec):
         part = part.strip()
         if part in ("all", ""):
             names += ["flash.fwd", "flash.bwd_dq", "flash.bwd_dkv",
-                      "paged.decode"]
+                      "paged.decode", "paged.decode.q8"]
         elif part == "flash":
             names += ["flash.fwd", "flash.bwd_dq", "flash.bwd_dkv"]
         elif part == "paged":
-            names += ["paged.decode"]
+            names += ["paged.decode", "paged.decode.q8"]
         elif part in ("flash.fwd", "flash.bwd_dq", "flash.bwd_dkv",
-                      "paged.decode"):
+                      "paged.decode", "paged.decode.q8"):
             names.append(part)
         else:
             raise argparse.ArgumentTypeError(
                 "unknown kernel %r (flash.fwd, flash.bwd_dq, "
-                "flash.bwd_dkv, paged.decode, flash, paged, all)"
+                "flash.bwd_dkv, paged.decode, paged.decode.q8, "
+                "flash, paged, all)"
                 % part)
     out = []
     for n in names:        # dedup, order-preserving
@@ -140,6 +141,15 @@ def _cmd_sweep(tuner, args):
     if "paged.decode" in args.kernels:
         results.update(sweeps.sweep_paged(
             tuner, hd=args.paged_hd, g=args.paged_g, dtype=args.dtype,
+            iters=max(args.iters, 2), repeats=args.repeats,
+            warmup=args.warmup, dry_run=args.dry_run, log=print,
+            source="cli-sweep"))
+    if "paged.decode.q8" in args.kernels:
+        # the quantized-pool variant (int8 QuantCache KV): same kernel
+        # family, winners keyed at dtype int8 — exactly what a
+        # cache_dtype="int8" serving launch looks up
+        results.update(sweeps.sweep_paged(
+            tuner, hd=args.paged_hd, g=args.paged_g, dtype="int8",
             iters=max(args.iters, 2), repeats=args.repeats,
             warmup=args.warmup, dry_run=args.dry_run, log=print,
             source="cli-sweep"))

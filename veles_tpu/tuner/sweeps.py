@@ -72,11 +72,21 @@ def flash_candidates(kind, t, d, dtype="bfloat16", causal=True,
 def paged_candidates(hd, g=1, dtype="bfloat16", nbm=32):
     """Candidates for the fused paged decode kernel: the KV pool block
     size (how many keys one grid step streams — the vLLM block) and
-    the q-group sublane pad."""
+    the q-group sublane pad.  ``dtype`` is the POOL dtype — ``int8``
+    enumerates the quantized-pool variant (QuantCache: int8 K/V tiles
+    + per-position scale tiles; q stays bf16), whose audit launches
+    carry the extra scale blocks."""
+    import numpy as np
+
     from veles_tpu.ops.pallas import paged
 
+    quant = np.dtype(dtype) == np.dtype(np.int8)
+    # int8 tiles want 32 sublanes on silicon; smaller blocks stay in
+    # the grid (VP600 warns, never rejects) so interpret-mode CI still
+    # proves the ranking machinery over the same budget trade-off
+    sizes = (64, 32, 16) if quant else (32, 16, 8)
     out = []
-    for bs in (32, 16, 8):
+    for bs in sizes:
         for gp in sorted({max(int(g), paged._MIN_G), 32}):
             out.append({
                 "config": {"block": bs, "block_g": gp},
@@ -177,11 +187,18 @@ def paged_measure(hd, g=1, dtype="bfloat16", slots=8, pool_blocks=32,
     """Measure-thunk factory for the fused paged decode kernel.  The
     pool layout depends on the candidate's block size, so inputs are
     built per config (pool token budget held constant — the real
-    serving trade-off: more, smaller blocks vs fewer, larger ones)."""
+    serving trade-off: more, smaller blocks vs fewer, larger ones).
+    ``dtype="int8"`` measures the quantized-pool variant: the pools
+    are QuantCache pairs (random f32 K/V quantized through the real
+    ``quantize_kv``), q stays bf16."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
+
+    from veles_tpu.ops.attention import QuantCache, quantize_kv
     from veles_tpu.ops.pallas.paged import paged_attention_decode
 
+    quant = np.dtype(dtype) == np.dtype(np.int8)
     tokens = pool_blocks * 16     # constant budget across candidates
     hq = hkv * g
     thunks = {}   # config -> (jitted fn, inputs) built once per config
@@ -195,12 +212,18 @@ def paged_measure(hd, g=1, dtype="bfloat16", slots=8, pool_blocks=32,
             nbm = max(2, tokens // bs)
             key = jax.random.key(seed)
             kq, kk, kv = jax.random.split(key, 3)
-            q = jax.random.normal(
-                kq, (slots, hq, hd)).astype(dtype) * 0.1
+            fdt = jnp.bfloat16 if quant else dtype
+            q = jax.random.normal(kq, (slots, hq, hd)).astype(fdt) * 0.1
             pool_k = jax.random.normal(
-                kk, (npool, hkv, bs, hd)).astype(dtype) * 0.1
+                kk, (npool, hkv, bs, hd)).astype(jnp.float32) * 0.1
             pool_v = jax.random.normal(
-                kv, (npool, hkv, bs, hd)).astype(dtype) * 0.1
+                kv, (npool, hkv, bs, hd)).astype(jnp.float32) * 0.1
+            if quant:
+                pool_k = QuantCache(*quantize_kv(pool_k))
+                pool_v = QuantCache(*quantize_kv(pool_v))
+            else:
+                pool_k = pool_k.astype(dtype)
+                pool_v = pool_v.astype(dtype)
             table = (1 + (jnp.arange(slots * nbm)
                           % (npool - 1))).reshape(
                 slots, nbm).astype(jnp.int32)
@@ -263,7 +286,13 @@ def sweep_flash(tuner, ts=(1024,), d=128, dtype="bfloat16", kinds=None,
 def sweep_paged(tuner, hd=128, g=1, dtype="bfloat16", iters=8,
                 repeats=3, warmup=1, interpret=None, dry_run=False,
                 mesh=None, log=None, source="sweep"):
-    """Sweep the fused paged decode kernel's pool block + q-group pad."""
+    """Sweep the fused paged decode kernel's pool block + q-group pad.
+    Winners key by (kernel ``paged.decode``, shape, POOL dtype) — the
+    int8 QuantCache flavor is the same kernel family at dtype
+    ``int8``, swept via ``dtype="int8"`` (the launch path's
+    ``preferred_pool_block``/``_resolve_block_g`` look up with the
+    pool's own dtype, so serving finds the right regime's winner
+    automatically)."""
     from veles_tpu.tuner import paged_shape_key
     cands = paged_candidates(hd, g=g, dtype=dtype)
     measure = (None if dry_run else
@@ -274,9 +303,10 @@ def sweep_paged(tuner, hd=128, g=1, dtype="bfloat16", iters=8,
                       warmup=warmup, dry_run=dry_run, source=source)
     if log:
         w = res.winner
-        log("paged.decode hd=%d g=%d: %s (candidates %d, "
+        log("paged.decode[%s] hd=%d g=%d: %s (candidates %d, "
             "audit-rejected %d)"
-            % (hd, g, "winner %r %.3f ms" % (w["config"], w["ms"])
+            % (dtype, hd, g,
+               "winner %r %.3f ms" % (w["config"], w["ms"])
                if w else ("dry run" if dry_run else "no winner"),
                len(res.candidates), len(res.audit_rejected)))
-    return {("paged", hd): res}
+    return {("paged", dtype, hd): res}
