@@ -861,6 +861,123 @@ def phase_serve():
          "keeps greedy rows speculating)"
          % (slots, out["ms_per_tok_spec_all_greedy"],
             out["ms_per_tok_spec_mixed"], cliff))
+
+    # ---- decode-tick stall under long-prompt admission: segmented
+    # vs whole-prompt prefill at 3 prompt lengths.  One in-flight
+    # decode stream; a long prompt admits mid-stream; the inter-tick
+    # gap p50/p99 is what the stream's client feels.  PRE-REGISTERED
+    # target: segmented p99 stays within 4x the no-admission cadence
+    # while unsegmented scales with the whole prompt.
+    from veles_tpu.models.generate import ContinuousBatcher as _CB
+    gen_st = LMGenerator(wf.trainer, max_len=t_max)
+    seg = max(8, t_max // 32)
+
+    def stall_row(plen, segment):
+        cb = _CB(gen_st, slots=2, prefill_segment=segment)
+        long_prompt = toks[1 % toks.shape[0], :16].tolist() \
+            * (plen // 16 + 1)
+        long_prompt = [int(t) for t in long_prompt[:plen]]
+        short = [int(t) for t in toks[0, :8]]
+        # warm every shape (short decode, prefill buckets)
+        cb.submit(short, 4)
+        cb.submit(long_prompt, 2)
+        cb.run_all()
+        cb.submit(short, max(16, t_max // 8))
+        cb.tick()
+        gaps = []
+        cb.submit(long_prompt, 2)
+        last = time.perf_counter()
+        while not cb.idle():
+            cb.tick()
+            now = time.perf_counter()
+            gaps.append((now - last) * 1e3)
+            last = now
+        gaps.sort()
+        return (gaps[len(gaps) // 2],
+                gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))])
+
+    out["prefill_stall"] = {}
+    for plen in (t_max // 4, t_max // 2, 3 * t_max // 4):
+        p50_u, p99_u = stall_row(plen, 0)
+        p50_s, p99_s = stall_row(plen, seg)
+        out["prefill_stall"][str(plen)] = {
+            "segment": seg,
+            "unseg_p50_ms": round(p50_u, 4),
+            "unseg_p99_ms": round(p99_u, 4),
+            "seg_p50_ms": round(p50_s, 4),
+            "seg_p99_ms": round(p99_s, 4)}
+        _log("decode stall @ prompt %d: unsegmented p99 %.3f ms vs "
+             "segmented(%d) p99 %.3f ms (p50 %.3f/%.3f)"
+             % (plen, p99_u, seg, p99_s, p50_u, p50_s))
+    out["target_seg_stall_x"] = 4.0   # seg p99 <= 4x base cadence
+
+    # ---- cost-weighted vs least-loaded routing under a skewed-
+    # length storm: 2 in-process replicas behind a FleetRouter,
+    # 75/25 short/long buffered clients; completed wall per token.
+    # PRE-REGISTERED: cost-weighted <= round-robin (pricing keeps
+    # long prompts off the replica already holding one).
+    import json as _json
+    import http.client as _http
+    import threading as _threading
+    from veles_tpu.services.router import FleetRouter as _FR
+
+    def routing_storm(placement):
+        router = _FR(port=0, placement=placement,
+                     prefill_prompt_min=0, rng_seed=3,
+                     health_interval_ms=200)
+        router.start()
+        router.spawn_local(gen_st, 2, continuous_slots=4)
+        short = [int(t) for t in toks[0, :8]]
+        longp = [int(t) for t in toks[0, :8]] * (t_max // 16)
+        longp = longp[:t_max // 2]
+        n_short, n_long = 18, 6
+        new_s, new_l = max(8, t_max // 16), 2
+
+        def client(prompt, max_new):
+            try:
+                conn = _http.HTTPConnection(router.host, router.port,
+                                            timeout=600)
+                conn.request("POST", router.path, _json.dumps(
+                    {"input": prompt,
+                     "generate": {"max_new": max_new}}),
+                    {"Content-Type": "application/json"})
+                conn.getresponse().read()
+                conn.close()
+            except Exception:  # noqa: BLE001 — bench storm
+                pass
+
+        try:
+            # warmup both replicas and shapes
+            for api in router._local_apis:
+                api.engine.wait(api.engine.submit_async(short, new_s))
+                api.engine.wait(api.engine.submit_async(longp, new_l))
+            jobs = ([(short, new_s)] * n_short
+                    + [(longp, new_l)] * n_long)
+            threads = [_threading.Thread(target=client, args=(p, n),
+                                         daemon=True)
+                       for p, n in jobs]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+            wall = time.perf_counter() - t0
+            toks_done = n_short * new_s + n_long * new_l
+            return wall * 1e3 / toks_done
+        finally:
+            router.stop()
+
+    out["routing_rr_ms_per_tok"] = round(
+        routing_storm("round_robin"), 4)
+    out["routing_cost_ms_per_tok"] = round(routing_storm("cost"), 4)
+    out["target_cost_vs_rr"] = 1.0    # cost-weighted must not lose
+    _log("skewed-length routing storm (2 replicas): round-robin "
+         "%.3f ms/tok vs cost-weighted %.3f ms/tok (x%.2f)"
+         % (out["routing_rr_ms_per_tok"],
+            out["routing_cost_ms_per_tok"],
+            out["routing_rr_ms_per_tok"]
+            / out["routing_cost_ms_per_tok"]
+            if out["routing_cost_ms_per_tok"] else 0.0))
     return out
 
 

@@ -413,3 +413,232 @@ class TestFleetObservability:
         assert sig["shed_total"] == 5
         assert sig["busy"] is True
         assert sig["live"] == 2
+
+
+# ===================================================================
+# Cost-weighted placement + prefill/decode roles (stall-free serving)
+# ===================================================================
+
+class TestRequestCost:
+    def test_price_shape(self):
+        from veles_tpu.services.costing import RequestCost
+        rc = RequestCost(prefill_ms_per_tok=0.01,
+                         decode_ms_per_tok=1.0)
+        assert rc.price(100, 8) == pytest.approx(100 * 0.01 + 8 * 1.0)
+        assert rc.price(0, 0) == 0.0
+
+    def test_calibration_tracks_measured(self):
+        from veles_tpu.services.costing import RequestCost
+        rc = RequestCost(prefill_ms_per_tok=0.01,
+                         decode_ms_per_tok=1.0)
+        rc.calibrate(2.0)
+        # first sample snaps; prefill rescales by the same drift
+        assert rc.decode_ms_per_tok == pytest.approx(2.0)
+        assert rc.prefill_ms_per_tok == pytest.approx(0.02)
+        assert rc.calibration == pytest.approx(2.0)
+        # a measured prefill rate pins the prefill constant directly
+        rc.calibrate(2.0, measured_prefill_ms_per_tok=0.5)
+        assert rc.prefill_ms_per_tok > 0.02
+        assert rc.status()["calibration"] is not None
+
+    def test_zero_measure_ignored(self):
+        from veles_tpu.services.costing import RequestCost
+        rc = RequestCost(prefill_ms_per_tok=0.01,
+                         decode_ms_per_tok=1.0)
+        rc.calibrate(0.0)
+        assert rc.calibration is None
+
+
+class TestCostPlacement:
+    def _router(self, **kw):
+        kw.setdefault("rng_seed", 3)
+        kw.setdefault("placement", "cost")
+        return FleetRouter(port=0, **kw)
+
+    def test_picks_least_loaded_by_predicted_cost(self):
+        router = self._router()
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        with router._lock:
+            router._replicas[r1].pending_cost_ms = 500.0
+            router._replicas[r2].pending_cost_ms = 10.0
+        assert router._pick().rid == r2
+        with router._lock:
+            router._replicas[r2].pending_cost_ms = 900.0
+        assert router._pick().rid == r1
+
+    def test_health_backlog_feeds_the_pick(self):
+        router = self._router()
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        with router._lock:
+            # equal router-tracked cost, but r1 reports a big queued
+            # prefill backlog on /health — work routed around us
+            router._replicas[r1].last_health = {
+                "queued_prefill_tokens": 100000}
+            router._replicas[r2].last_health = {
+                "queued_prefill_tokens": 0}
+        assert router._pick().rid == r2
+
+    def test_idle_ties_rotate(self):
+        router = self._router()
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        picks = {router._pick().rid for _ in range(4)}
+        assert picks == {r1, r2}
+
+    def test_round_robin_placement_knob(self):
+        router = self._router(placement="round_robin")
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        with router._lock:
+            router._replicas[r1].pending_cost_ms = 500.0
+        picks = [router._pick().rid for _ in range(4)]
+        assert sorted(set(picks)) == [r1, r2]
+
+    def test_placement_validated(self):
+        with pytest.raises(ValueError):
+            FleetRouter(port=0, placement="magic")
+
+    def test_probe_calibrates_cost_model(self):
+        router = self._router()
+        rid = router.register("http://127.0.0.1:1/service")
+        rep = router._replicas[rid]
+        rep.last_health = {}
+        # feed the probe handler's calibration path directly
+        router.cost.calibrate(3.0, 0.25)
+        assert router.cost.decode_ms_per_tok == pytest.approx(3.0)
+        assert router.metrics()["cost"]["decode_ms_per_tok"] == \
+            pytest.approx(3.0)
+
+
+class TestFleetRoles:
+    def _router(self, **kw):
+        kw.setdefault("rng_seed", 3)
+        kw.setdefault("prefill_prompt_min", 16)
+        kw.setdefault("prefill_handoff_new", 4)
+        return FleetRouter(port=0, **kw)
+
+    def test_role_validation_and_describe(self):
+        router = self._router()
+        rid = router.register("http://127.0.0.1:1/service",
+                              role="prefill")
+        assert router.replicas()[rid]["role"] == "prefill"
+        with pytest.raises(ValueError):
+            router.register("http://127.0.0.2:1/service", role="bogus")
+        # re-registration validates too (a typo'd role must be LOUD,
+        # not silently keep the old tier)
+        with pytest.raises(ValueError):
+            router.register("http://127.0.0.1:1/service", role="bogus")
+        # re-registration with a VALID role updates the tier
+        router.register("http://127.0.0.1:1/service", role="decode")
+        assert router.replicas()[rid]["role"] == "decode"
+
+    def test_pick_prefers_role_tier_and_falls_back(self):
+        router = self._router()
+        rp = router.register("http://127.0.0.1:1/service",
+                             role="prefill")
+        rd = router.register("http://127.0.0.2:1/service",
+                             role="decode")
+        assert router._pick(role="prefill").rid == rp
+        # non-prefill picks keep the prefill tier clear
+        assert all(router._pick().rid == rd for _ in range(3))
+        # tier empty -> falls back to the whole up set (never strand)
+        from veles_tpu.services.router import Replica
+        with router._lock:
+            router._replicas[rp].state = Replica.DOWN
+        assert router._pick(role="prefill").rid == rd
+
+    def test_handoff_plan(self):
+        router = self._router()
+        router.register("http://127.0.0.1:1/service", role="prefill")
+        long_req = {"input": [list(range(20))],
+                    "generate": {"max_new": 16}}
+        role, cap = router._handoff_plan(long_req)
+        assert role == "prefill" and cap == 4
+        # short prompt: no role routing
+        assert router._handoff_plan(
+            {"input": [list(range(4))],
+             "generate": {"max_new": 16}}) == (None, 0)
+        # short DECODE: whole request on the prefill tier, no splice
+        role, cap = router._handoff_plan(
+            {"input": [list(range(20))], "generate": {"max_new": 3}})
+        assert role == "prefill" and cap == 0
+        # a resume continuation must never re-enter the plan
+        assert router._handoff_plan(
+            dict(long_req, resume=True)) == (None, 0)
+        # multi-row requests are not handoff-eligible
+        assert router._handoff_plan(
+            {"input": [list(range(20))] * 2,
+             "generate": {"max_new": 16}}) == (None, 0)
+
+    def test_no_prefill_replica_disables_plan(self):
+        router = self._router()
+        router.register("http://127.0.0.1:1/service", role="decode")
+        assert router._handoff_plan(
+            {"input": [list(range(20))],
+             "generate": {"max_new": 16}}) == (None, 0)
+
+
+class TestAutoscalerPrefillBacklog:
+    def test_backlog_scales_up(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=0,
+                            up_prefill_backlog=1024)
+        d, reason = a.decide(0.0, 2, 1, 4, dict(
+            _sig(), prefill_backlog=2048))
+        assert d == +1 and "backlog=2048" in reason
+
+    def test_backlog_below_threshold_ignored(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=0,
+                            up_prefill_backlog=1024)
+        d, _ = a.decide(0.0, 2, 1, 4, dict(
+            _sig(), prefill_backlog=10))
+        assert d == 0
+
+    def test_backlog_zero_knob_disables(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=30, cooldown_s=0,
+                            up_prefill_backlog=0)
+        d, _ = a.decide(0.0, 2, 1, 4, dict(
+            _sig(), prefill_backlog=10 ** 9))
+        assert d == 0
+
+    def test_backlog_resets_idle_clock(self):
+        a = FleetAutoscaler(up_overshoot=1.0, idle_s=10, cooldown_s=0,
+                            up_prefill_backlog=0)
+        a.decide(0.0, 2, 1, 4, dict(_sig(), prefill_backlog=5))
+        # backlog kept the fleet non-idle at t=0; the idle clock only
+        # starts at the first backlog-free step (t=12)
+        d, _ = a.decide(12.0, 2, 1, 4, dict(_sig(), prefill_backlog=0))
+        assert d == 0
+        d, _ = a.decide(16.0, 2, 1, 4, dict(_sig(), prefill_backlog=0))
+        assert d == 0
+        d, _ = a.decide(23.0, 2, 1, 4, dict(_sig(), prefill_backlog=0))
+        assert d == -1
+
+    def test_router_signals_carry_backlog(self):
+        router = FleetRouter(port=0, rng_seed=3)
+        r1 = router.register("http://127.0.0.1:1/service")
+        r2 = router.register("http://127.0.0.2:1/service")
+        with router._lock:
+            router._replicas[r1].last_health = {
+                "queued_prefill_tokens": 700}
+            router._replicas[r2].last_health = {
+                "queued_prefill_tokens": 41}
+        assert router.fleet_signals()["prefill_backlog"] == 741
+
+
+class TestMasterRoles:
+    def test_want_role_fills_prefill_tier_first(self, tmp_path):
+        m = _master(tmp_path, prefill_replicas=1)
+        with m._lock:
+            assert m._want_role() == "prefill"
+            m.reps[0] = dict(_rep(0, "ready"), role="prefill")
+            assert m._want_role() == "decode"
+            # a dead prefill replica's replacement inherits the role
+            m.reps[0]["state"] = "dead"
+            assert m._want_role() == "prefill"
+
+    def test_no_roles_when_disabled(self, tmp_path):
+        m = _master(tmp_path)
+        with m._lock:
+            assert m._want_role() is None

@@ -418,3 +418,93 @@ class TestShedRouting:
             c.close()
         finally:
             router.stop()
+
+
+class TestDisaggregatedPrefill:
+    """Prefill/decode roles: a long prompt's first leg runs on the
+    prefill-role replica, the decode continues on the decode replica
+    via the prefix-resume splice — ONE byte-identical client stream,
+    and the handoff is observable (counter + serve.prefill_handoff)."""
+
+    def _fleet(self, gen, **kw):
+        kw.setdefault("health_interval_ms", 50)
+        kw.setdefault("prefill_prompt_min", 8)
+        kw.setdefault("prefill_handoff_new", 2)
+        router = FleetRouter(port=0, rng_seed=3, **kw)
+        router.start()
+        router.spawn_local(gen, 2, continuous_slots=2,
+                           roles=["prefill", "decode"])
+        return router
+
+    def test_stream_handoff_splice_byte_identical(self, gen,
+                                                  f32_precision):
+        t0 = time.time()
+        long_prompt = list(range(1, 11))           # >= prompt_min 8
+        expected = gen.generate(
+            np.asarray([long_prompt], np.int32), 5)[0].tolist()
+        router = self._fleet(gen)
+        try:
+            resp, conn = _post(router, {
+                "input": long_prompt,
+                "generate": {"max_new": 5, "stream": True}})
+            assert resp.status == 200
+            got = list(long_prompt)
+            done = None
+            while True:
+                raw = resp.fp.readline()
+                if not raw:
+                    break
+                msg = json.loads(raw)
+                if "tokens" in msg:
+                    got.extend(msg["tokens"])
+                if msg.get("done"):
+                    done = msg
+                    break
+            conn.close()
+            assert got == expected
+            assert done is not None and done["result"] == expected
+            m = router.metrics()
+            assert m["counters"]["prefill_handoffs"] >= 1
+            assert _flight_count("serve.prefill_handoff", t0) >= 1
+            # both tiers actually served: the prefill replica decoded
+            # the handoff tokens, the decode replica the rest
+            served = [a.engine.metrics()["served"]
+                      for a in router._local_apis]
+            assert all(s >= 1 for s in served), served
+        finally:
+            router.stop()
+
+    def test_buffered_handoff_byte_identical(self, gen,
+                                             f32_precision):
+        long_prompt = list(range(1, 11))
+        expected = gen.generate(
+            np.asarray([long_prompt], np.int32), 6)[0].tolist()
+        router = self._fleet(gen)
+        try:
+            resp, conn = _post(router, {
+                "input": long_prompt, "generate": {"max_new": 6}})
+            assert resp.status == 200
+            out = json.loads(resp.read())
+            conn.close()
+            assert out["result"][0] == expected
+            assert router.metrics()["counters"][
+                "prefill_handoffs"] >= 1
+        finally:
+            router.stop()
+
+    def test_short_prompt_skips_the_prefill_tier(self, gen,
+                                                 f32_precision):
+        router = self._fleet(gen)
+        try:
+            resp, conn = _post(router, {
+                "input": [1, 2, 3], "generate": {"max_new": 4}})
+            assert resp.status == 200
+            resp.read()
+            conn.close()
+            served = [a.engine.metrics()["served"]
+                      for a in router._local_apis]
+            # replica 0 is the prefill tier: a short prompt must not
+            # land there while the decode tier is up
+            assert served[0] == 0 and served[1] == 1, served
+        finally:
+            router.stop()

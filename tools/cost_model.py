@@ -434,6 +434,34 @@ def predict_serve(d=768, n_layers=12, vocab=50304, t_max=512):
     return out
 
 
+def serve_request_costs(d=768, n_layers=12, vocab=50304, t_max=512):
+    """Per-token request pricing for the fleet router's cost-weighted
+    placement (``services.costing``): a serving request is predicted
+    as  prompt_len x prefill_ms_per_tok + max_new x decode_ms_per_tok.
+
+    * prefill is COMPUTE-bound — the prompt chunk rides one MXU-fed
+      parallel pass, so a token costs its matmul flops at the
+      calibrated efficiency (plus a share of the per-pass kernel
+      floors);
+    * decode is WEIGHT-STREAMING-bound — per-token cost is
+      ``predict_serve``'s bf16 ms/tok, anchored by the measured
+      ``serve_ms_per_tok_bf16`` last-known-good.
+
+    The router CALIBRATES both against the fleet's live measured
+    decode ms/tok (the same ratio rescales prefill — the two share
+    the device).  The absolute numbers only matter relative to each
+    other: placement ranks replicas by predicted outstanding work."""
+    mm_params = n_layers * 12 * d * d
+    prefill_ms = (2.0 * mm_params / (PEAK_BF16 * EFF_MXU)
+                  + (n_layers * 12 + 10) * T_KERNEL_SCAN / 128.0) * 1e3
+    decode_ms = predict_serve(d, n_layers, vocab, t_max)[
+        "ms_per_tok_bf16"]
+    return {"prefill_ms_per_tok": prefill_ms,
+            "decode_ms_per_tok": decode_ms,
+            "measured_decode_ms_per_tok":
+                ANCHORS["serve_ms_per_tok_bf16"]}
+
+
 def predict_kohonen():
     """512x784 @ 784x256 distance matmul + argmax + weight update."""
     comp = t_matmul(512, 784, 256)

@@ -425,6 +425,196 @@ def gates(report, health_interval_ms=100.0):
     return fails
 
 
+def run_prefill_chaos(args):
+    """The disaggregated-prefill death gate (docs/services.md
+    "Disaggregated prefill" failure matrix): a fleet with ONE
+    prefill-role replica serves a storm of LONG prompts (every
+    request's first leg lands there), the prefill replica is
+    SIGKILLed mid-storm — with tick-delay-stretched segmented
+    prefills, provably while admission prefill work is in flight —
+    and the gates demand zero lost requests (every stream fails over
+    byte-identically) plus a replacement PREFILL-role replica."""
+    from veles_tpu.services.podmaster import ServeFleetMaster
+    from veles_tpu.telemetry import flight
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="prefill_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    long_len = args.long_prompt_len
+    rargs = argparse.Namespace(
+        slots=args.slots, paged_block=0, pool_tokens=None,
+        slo_ms=0, seed=args.seed, tick_delay_ms=args.tick_delay_ms,
+        max_len=long_len + args.max_new + 4,
+        prefill_segment=args.prefill_segment)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    replica_argv = lt.replica_cmd(rargs, 0, dump_dir=args.flight_dump)
+    master = ServeFleetMaster(
+        replica_argv, n_hosts=1, workdir=workdir,
+        fleet_min=2, fleet_max=2, per_host=4, env=env,
+        prefill_replicas=1, prefill_prompt_min=16,
+        prefill_handoff_new=2,
+        health_interval_ms=args.health_interval_ms,
+        autoscale=False, min_uptime_s=1.0, seed=args.seed)
+    report = {"mode": "prefill-kill", "workdir": workdir,
+              "clients": args.clients, "long_prompt_len": long_len,
+              "prefill_segment": args.prefill_segment}
+    errors = []
+    prompt = [int(1 + i % 7) for i in range(long_len)]
+    t_all = time.monotonic()
+
+    def prefill_rep():
+        s = master.status()
+        for rep, r in sorted(s["replicas"].items()):
+            if r.get("role") == "prefill" and r["state"] == "ready":
+                return rep, r
+        return None
+
+    try:
+        master.start()
+        st = _wait(lambda: (lambda s:
+                            s if s["live_replicas"] >= 2 else None)(
+                                master.status()),
+                   "fleet up (1 prefill + 1 decode)",
+                   args.timeout / 2, errors)
+        if st is None:
+            report["errors"] = errors
+            return report
+        pr = prefill_rep()
+        if pr is None:
+            errors.append("no ready prefill-role replica")
+            report["errors"] = errors
+            return report
+        report["prefill_rep"] = pr[0]
+        # warmup every replica + capture the expected result (same
+        # seed everywhere — splices must be byte-identical to it)
+        expected = None
+        for rep, port in sorted(_ready_ports(master.status()).items()):
+            status, out = cc.http_json(
+                "127.0.0.1", port, "/service", method="POST",
+                body=json.dumps({"input": prompt,
+                                 "generate":
+                                     {"max_new": args.max_new}}),
+                timeout=600)
+            if status != 200:
+                errors.append("warmup of replica %s failed: %s %s"
+                              % (rep, status, out))
+                report["errors"] = errors
+                return report
+            if expected is None:
+                expected = out["result"][0]
+            elif list(expected) != list(out["result"][0]):
+                report["replica_divergence"] = True
+        report["expected_len"] = len(expected)
+
+        # ---- the long-prompt storm through the router --------------
+        router = master.router
+        tally, lock = {}, threading.Lock()
+        stream_errors = []
+        threads = [threading.Thread(
+            target=cc.fleet_stream_client,
+            args=(router.host, router.port, router.path, prompt,
+                  args.max_new, expected,
+                  "sess-%d" % (i % args.sessions), tally, lock),
+            kwargs={"errors": stream_errors, "timeout": 600},
+            daemon=True) for i in range(args.clients)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+
+        def completed():
+            with lock:
+                return sum(tally.values())
+
+        # ---- SIGKILL the prefill replica MID-storm -----------------
+        cc.wait_fraction(completed, args.kill_frac, args.clients,
+                         time.monotonic() + args.timeout / 2)
+        kill_ts = time.monotonic()
+        victim = prefill_rep()
+        if victim is None:
+            errors.append("prefill replica already gone before the "
+                          "kill")
+        else:
+            report["victim"] = victim[0]
+            report["sigkill_at_completed"] = completed()
+            try:
+                os.kill(victim[1]["pid"], signal.SIGKILL)
+            except OSError as e:
+                errors.append("SIGKILL failed: %r" % (e,))
+
+        for th in threads:
+            th.join(timeout=600)
+        report["stuck_client_threads"] = sum(
+            1 for th in threads if th.is_alive())
+        report["phases"] = {"storm_s": round(time.monotonic() - t0, 2)}
+        report["tally"] = tally
+        report["stream_errors"] = stream_errors[:20]
+
+        # ---- the replacement must be PREFILL-role and ready --------
+        def replacement():
+            s = master.status()
+            fresh = {rep: r for rep, r in s["replicas"].items()
+                     if r["state"] == "ready"
+                     and r.get("role") == "prefill"
+                     and rep != report.get("victim")}
+            return fresh or None
+        fresh = _wait(replacement, "replacement prefill replica",
+                      args.timeout / 2, errors)
+        if fresh is not None:
+            report["replacement_ready_s"] = round(
+                time.monotonic() - kill_ts, 2)
+        report["router_metrics"] = master.router.metrics()
+        report["final"] = master.status()
+        kinds = [e["kind"] for e in flight.recorder.snapshot()]
+        report["flight_kinds"] = {
+            k: kinds.count(k)
+            for k in ("fleet.replace", "serve.replica_down",
+                      "serve.failover", "serve.prefill_handoff")}
+        if args.flight_dump:
+            report["flight_dump"] = flight.dump(
+                args.flight_dump, reason="prefill-chaos")
+    finally:
+        master.stop()
+        master.wait(120)
+        report["wall_s"] = round(time.monotonic() - t_all, 2)
+    report["errors"] = errors
+    return report
+
+
+def prefill_gates(report):
+    """Pass/fail for the prefill-kill leg: zero lost requests across
+    the prefill replica's death, the handoff path actually routed,
+    and a prefill-role replacement came back."""
+    fails = list(report.get("errors") or [])
+    tally = report.get("tally", {})
+    cc.tally_gate(tally, report.get("clients", 0), fails)
+    if not tally.get("ok"):
+        fails.append("no request completed (tally=%r)" % (tally,))
+    if report.get("stuck_client_threads"):
+        fails.append("stuck client threads: %d"
+                     % report["stuck_client_threads"])
+    if report.get("replica_divergence"):
+        fails.append("replicas disagreed on the warmup output")
+    counters = report.get("router_metrics", {}).get("counters", {})
+    if not counters.get("prefill_handoffs"):
+        fails.append("no prefill handoff was ever routed (roles not "
+                     "reaching the router?)")
+    if not counters.get("failovers"):
+        fails.append("the SIGKILL produced no failover — it cannot "
+                     "have landed mid-prefill")
+    if report.get("replacement_ready_s") is None:
+        fails.append("no replacement prefill-role replica became "
+                     "ready")
+    final = report.get("final") or {}
+    if final.get("hold_replace"):
+        fails.append("a valve held replacements: %r"
+                     % final["hold_replace"])
+    kinds = report.get("flight_kinds", {})
+    for kind in ("fleet.replace", "serve.replica_down",
+                 "serve.failover"):
+        if not kinds.get(kind):
+            fails.append("missing flight event: %s" % kind)
+    return fails
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="chaos gate for the autoscaling serving plane "
@@ -455,6 +645,18 @@ def main(argv=None):
                     "fired)")
     ap.add_argument("--scale-idle-s", type=float, default=3.0)
     ap.add_argument("--scale-cooldown-s", type=float, default=1.0)
+    ap.add_argument("--prefill-kill", action="store_true",
+                    help="run the disaggregated-prefill death gate "
+                    "instead: 1 prefill + 1 decode replica, long-"
+                    "prompt storm, SIGKILL the prefill replica "
+                    "mid-prefill, gate zero lost requests + a "
+                    "prefill-role replacement")
+    ap.add_argument("--long-prompt-len", type=int, default=64,
+                    help="(--prefill-kill) long-prompt length")
+    ap.add_argument("--prefill-segment", type=int, default=8,
+                    help="(--prefill-kill) replica prefill segment "
+                    "(tick-delay-stretched so the kill lands "
+                    "mid-prefill)")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--workdir", default=None,
@@ -466,6 +668,37 @@ def main(argv=None):
                     help="merged flight/blackbox artifacts (CI "
                     "upload)")
     args = ap.parse_args(argv)
+
+    if args.prefill_kill:
+        report = run_prefill_chaos(args)
+        fails = prefill_gates(report)
+        report["gates_failed"] = fails
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            print("[prefill-chaos] report -> %s" % args.json)
+        print(json.dumps({k: report.get(k) for k in
+                          ("tally", "victim", "sigkill_at_completed",
+                           "replacement_ready_s", "wall_s")},
+                         default=str))
+        if fails:
+            print("[prefill-chaos] GATES FAILED:", flush=True)
+            for f in fails:
+                print("  - %s" % f)
+            print("[prefill-chaos] workdir kept: %s"
+                  % report.get("workdir"))
+            return 1
+        print("[prefill-chaos] ALL GATES PASSED: %d clients "
+              "(%d ok / %d shed), prefill replica SIGKILLed "
+              "mid-prefill at %s completed, zero lost, prefill-role "
+              "replacement ready in %.1fs"
+              % (report["clients"], report["tally"].get("ok", 0),
+                 report["tally"].get("shed", 0),
+                 report.get("sigkill_at_completed"),
+                 report["replacement_ready_s"]))
+        if args.workdir is None:
+            shutil.rmtree(report["workdir"], ignore_errors=True)
+        return 0
 
     report = run_chaos(args)
     fails = gates(report,

@@ -72,7 +72,8 @@ from tools import chaos_common as cc   # noqa: E402 — path set above
 
 def build_api(slots=4, paged_block=0, pool_tokens=None, slo_ms=0,
               deadline_ms=0, max_len=24, vocab=11, seed=7,
-              generator=None, weights=None, cache_dtype=None):
+              generator=None, weights=None, cache_dtype=None,
+              prefill_segment=0):
     """A serving endpoint around a tiny UNTRAINED transformer (the
     harness tests the lifecycle, not the language model).  Config
     knobs are set process-globally (root.common.serve) exactly as an
@@ -115,7 +116,8 @@ def build_api(slots=4, paged_block=0, pool_tokens=None, slo_ms=0,
             cache_dtype=cache_dtype)
     api = RESTfulAPI(lambda xx: xx, (generator.max_len,), port=0,
                      generator=generator, continuous_slots=slots,
-                     paged_block=paged_block, pool_tokens=pool_tokens)
+                     paged_block=paged_block, pool_tokens=pool_tokens,
+                     prefill_segment=prefill_segment)
     api.start()
     return api
 
@@ -449,6 +451,213 @@ def gates(report, expect_shed=True, require_slo=False):
     return fails
 
 
+# ------------------------------------------------------- mixed-prompt mode
+def _gap_stream_client(api, prompt, max_new, gaps, tally, lock):
+    """One streaming client that records the wall gap between
+    consecutive token lines — the client-observed inter-chunk decode
+    gap the segmented-prefill gate bounds."""
+    body = json.dumps({"input": prompt,
+                       "generate": {"max_new": max_new,
+                                    "stream": True}})
+    outcome = "error"
+    try:
+        conn = http.client.HTTPConnection(api.host, api.port,
+                                          timeout=300)
+        conn.request("POST", api.path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            outcome = "http_%d" % resp.status
+            return
+        last = None
+        done = False
+        while True:
+            raw = resp.fp.readline()
+            if not raw:
+                break
+            msg = json.loads(raw)
+            if "tokens" in msg:
+                now = time.monotonic()
+                if last is not None:
+                    with lock:
+                        gaps.append((now - last) * 1e3)
+                last = now
+            if msg.get("done"):
+                done = True
+                break
+            if "error" in msg:
+                outcome = "stream_error"
+                return
+        outcome = "ok" if done else "truncated"
+        conn.close()
+    except Exception:  # noqa: BLE001 — chaos clients absorb anything
+        outcome = "error"
+    finally:
+        with lock:
+            tally[outcome] = tally.get(outcome, 0) + 1
+
+
+def _mixed_generator(max_len, seed=7, vocab=11, d_model=64,
+                     n_layers=2):
+    """A BEEFIER tiny model for the stall gate: the whole point is
+    that a long prompt's one-pass prefill visibly stalls decode
+    ticks, so the prefill must cost real milliseconds — the default
+    d=16 single-layer harness model prefills 100 tokens in ~6 ms,
+    under scheduler noise."""
+    import numpy as np
+
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models import zoo
+    from veles_tpu.models.generate import LMGenerator
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    prng.seed_all(seed)
+    toks = np.random.RandomState(seed).randint(
+        0, vocab, (8, 16)).astype(np.int32)
+    wf = StandardWorkflow(
+        layers=zoo.transformer_lm(vocab_size=vocab, d_model=d_model,
+                                  n_heads=max(2, d_model // 32),
+                                  n_layers=n_layers, dropout=0.0,
+                                  pos="rope"),
+        loader=FullBatchLoader(None, data=toks, labels=toks,
+                               minibatch_size=4,
+                               class_lengths=[0, 4, 4]),
+        loss="lm", decision_config={"max_epochs": 1},
+        name="chaos-serve-mixed")
+    wf.initialize()
+    return LMGenerator(wf.trainer, max_len=max_len)
+
+
+def _run_mixed_once(prefill_segment, streamers=6, stream_new=48,
+                    long_clients=6, long_len=256, long_new=4,
+                    short_len=5, slots=4, seed=7, generator=None):
+    """One mixed long/short storm against a fresh endpoint with the
+    given segmentation; returns the report half (engine decode-stall
+    percentiles + client-observed inter-chunk gaps)."""
+    api = build_api(slots=slots, slo_ms=0, seed=seed,
+                    max_len=long_len + long_new + stream_new,
+                    generator=generator,
+                    prefill_segment=prefill_segment)
+    eng = api.engine
+    short = [int(1 + i % 7) for i in range(short_len)]
+    longp = [int(1 + i % 7) for i in range(long_len)]
+    try:
+        # warm every shape OUTSIDE the measurement (prefill buckets,
+        # decode scan)
+        eng.wait(eng.submit_async(short, stream_new))
+        eng.wait(eng.submit_async(longp, long_new))
+        eng.reset_metrics()
+        gaps, tally, lock = [], {}, threading.Lock()
+        threads = [threading.Thread(
+            target=_gap_stream_client,
+            args=(api, short, stream_new, gaps, tally, lock),
+            daemon=True) for _ in range(streamers)]
+        for th in threads:
+            th.start()
+        # long-prompt admissions land WHILE the short streams decode —
+        # the head-of-line stall under test
+        time.sleep(0.05)
+        handles = []
+        for _ in range(long_clients):
+            handles.append(eng.submit_async(longp, long_new))
+            time.sleep(0.02)
+        for h in handles:
+            eng.wait(h)
+        for th in threads:
+            th.join(timeout=300)
+        m = eng.metrics()
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return round(vals[min(len(vals) - 1,
+                                  int(q / 100.0 * len(vals)))], 3)
+
+        return {"prefill_segment": prefill_segment,
+                "tally": tally,
+                "stuck_streamers": sum(1 for th in threads
+                                       if th.is_alive()),
+                "p50_decode_stall_ms": m["p50_decode_stall_ms"],
+                "p99_decode_stall_ms": m["p99_decode_stall_ms"],
+                "prefill_ms_per_tok": m["prefill_ms_per_tok"],
+                "prefill_segments_total": m["prefill_segments_total"],
+                "client_gap_p50_ms": pct(gaps, 50),
+                "client_gap_p99_ms": pct(gaps, 99),
+                "client_gaps": len(gaps),
+                "leaks": eng.leak_check()}
+    finally:
+        api.stop()
+
+
+def run_mixed(prefill_segment=16, long_len=256, stream_new=48,
+              long_new=4, seed=7, **kw):
+    """The segmented-prefill stall gate: the SAME mixed long/short
+    storm twice — segmented vs unsegmented admission — so the bound
+    and the strictly-better comparison are measured in one run on one
+    box (docs/perf.md "Stall-free serving").  One shared generator:
+    both runs decode the same weights through the same compiled
+    executables, so the ONLY difference is the admission policy."""
+    gen = _mixed_generator(long_len + long_new + stream_new,
+                           seed=seed)
+    kw.update(long_len=long_len, stream_new=stream_new,
+              long_new=long_new, seed=seed, generator=gen)
+    report = {"segmented": _run_mixed_once(prefill_segment, **kw),
+              "unsegmented": _run_mixed_once(0, **kw),
+              "prefill_segment": prefill_segment}
+    return report
+
+
+def _bucket(n):
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def mixed_gates(report):
+    """Pass/fail for the mixed-prompt leg: the segmented run's p99
+    inter-dispatch decode gap must be (a) bounded by the per-tick
+    prefill budget — budget-bucket tokens at the run's own measured
+    prefill rate, plus the run's baseline cadence and scheduler
+    slack — and (b) STRICTLY better than the unsegmented baseline
+    measured in the same run.  Plus the usual hygiene."""
+    fails = []
+    seg = report.get("segmented") or {}
+    unseg = report.get("unsegmented") or {}
+    for name, half in (("segmented", seg), ("unsegmented", unseg)):
+        tally = half.get("tally") or {}
+        bad = {k: v for k, v in tally.items() if k != "ok"}
+        if bad:
+            fails.append("%s run lost requests: %r" % (name, tally))
+        if half.get("stuck_streamers"):
+            fails.append("%s run stuck streamers: %d"
+                         % (name, half["stuck_streamers"]))
+        leaks = half.get("leaks") or {}
+        cc.leak_gate(leaks, fails, label=name)
+    if not seg.get("prefill_segments_total"):
+        fails.append("the segmented run never staged a prefill "
+                     "segment (knob not reaching the engine?)")
+    p99_seg = seg.get("p99_decode_stall_ms")
+    p99_unseg = unseg.get("p99_decode_stall_ms")
+    if p99_seg is None or p99_unseg is None:
+        fails.append("missing decode-stall percentiles")
+        return fails
+    # budget-derived bound: one tick may prefill up to the budget
+    # (pow2-bucketed) at the measured rate; 4x headroom for dispatch
+    # overlap + 25 ms scheduler slack on a shared CI box
+    budget = _bucket(report.get("prefill_segment") or 1)
+    bound = (4.0 * budget * (seg.get("prefill_ms_per_tok") or 0.0)
+             + 4.0 * (seg.get("p50_decode_stall_ms") or 0.0) + 25.0)
+    if p99_seg > bound:
+        fails.append("segmented p99 decode stall %.3f ms exceeds the "
+                     "budget-derived bound %.3f ms" % (p99_seg, bound))
+    if not p99_seg < p99_unseg:
+        fails.append("segmented p99 decode stall %.3f ms is not "
+                     "strictly better than the unsegmented baseline "
+                     "%.3f ms" % (p99_seg, p99_unseg))
+    return fails
+
+
 # --------------------------------------------------------------- fleet mode
 def replica_main(args):
     """Subprocess entry for one fleet replica: build the tiny model,
@@ -460,7 +669,10 @@ def replica_main(args):
 
     api = build_api(slots=args.slots, paged_block=args.paged_block,
                     pool_tokens=args.pool_tokens, slo_ms=args.slo_ms,
-                    deadline_ms=0, seed=args.seed)
+                    deadline_ms=0, seed=args.seed,
+                    max_len=getattr(args, "max_len", 24),
+                    prefill_segment=getattr(args, "prefill_segment",
+                                            0))
     if getattr(args, "tick_delay_ms", 0):
         # stretch decode so the fleet storm's mid-storm SIGKILL lands
         # while streams are provably in flight (a tiny model on a fast
@@ -500,6 +712,9 @@ def replica_cmd(args, i, dump_dir=None):
            "--paged-block", str(args.paged_block),
            "--slo-ms", str(args.slo_ms),
            "--seed", str(args.seed),
+           "--max-len", str(getattr(args, "max_len", 24)),
+           "--prefill-segment",
+           str(getattr(args, "prefill_segment", 0)),
            "--tick-delay-ms",
            str(getattr(args, "tick_delay_ms", 0))]
     if args.pool_tokens:
@@ -765,6 +980,29 @@ def main(argv=None):
                          "the fused quantized-pool decode kernel)")
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=5)
+    ap.add_argument("--max-len", type=int, default=24,
+                    help="model max_len for the endpoint this "
+                         "harness builds (raise it for long-prompt "
+                         "legs)")
+    ap.add_argument("--prefill-segment", type=int, default=0,
+                    help="segmented prefill admission: bound each "
+                         "admission prefill pass to this many tokens "
+                         "(0 = whole-prompt; docs/services.md "
+                         "'Disaggregated prefill')")
+    ap.add_argument("--mixed", action="store_true",
+                    help="mixed long/short-prompt stall gate: run the "
+                         "same storm segmented (--prefill-segment) "
+                         "and unsegmented, gate the p99 decode gap "
+                         "against the budget bound AND the "
+                         "unsegmented baseline")
+    ap.add_argument("--long-prompt-len", type=int, default=256,
+                    help="(--mixed) long-prompt length")
+    ap.add_argument("--long-clients", type=int, default=6,
+                    help="(--mixed) long-prompt admissions during "
+                         "the storm")
+    ap.add_argument("--streamers", type=int, default=6,
+                    help="(--mixed) short streaming clients whose "
+                         "inter-chunk gaps are measured")
     ap.add_argument("--slo-ms", type=float, default=250.0)
     ap.add_argument("--deadline-ms", type=float, default=0.0)
     ap.add_argument("--slow-delay", type=float, default=0.4)
@@ -805,6 +1043,31 @@ def main(argv=None):
 
     if args.replica:
         return replica_main(args)
+
+    if args.mixed:
+        report = run_mixed(
+            prefill_segment=args.prefill_segment or 16,
+            streamers=args.streamers,
+            long_clients=args.long_clients,
+            long_len=args.long_prompt_len,
+            short_len=args.prompt_len, slots=args.slots,
+            seed=args.seed)
+        fails = mixed_gates(report)
+        report["failures"] = fails
+        out = json.dumps(report, indent=2, default=str)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+        print(out)
+        if fails:
+            print("FAIL: " + "; ".join(fails), file=sys.stderr)
+            return 1
+        print("PASS: segmented p99 decode stall %.3f ms vs "
+              "unsegmented %.3f ms (budget %d tok)"
+              % (report["segmented"]["p99_decode_stall_ms"],
+                 report["unsegmented"]["p99_decode_stall_ms"],
+                 args.prefill_segment or 16), file=sys.stderr)
+        return 0
 
     if args.fleet:
         report = run_fleet(

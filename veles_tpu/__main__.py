@@ -1304,6 +1304,11 @@ class Main(object):
                          # dominates per-token cost)
                          ticks_per_dispatch=int(root.common.serve.get(
                              "ticks_per_dispatch", 1)))
+        # root.common.serve.prefill_segment>0: segmented prefill
+        # admission — long prompts prefill in bounded chunk passes
+        # interleaved with decode ticks, so one admission can't stall
+        # every in-flight stream (the engine reads the knob itself;
+        # docs/services.md "Disaggregated prefill")
         api.start()
         if getattr(self, "_web", None) is not None:
             # the dashboard's serving panel shows the slot pool's SLO

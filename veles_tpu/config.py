@@ -268,6 +268,18 @@ root.common.update({
     "serve": {
         "slo_queue_wait_ms": 0,
         "default_deadline_ms": 0,
+        # segmented prefill admission (docs/services.md "Disaggregated
+        # prefill"): prefill_segment > 0 splits a long prompt's
+        # admission prefill into bounded chunk passes of at most this
+        # many tokens, interleaved with decode ticks, so one long
+        # admission can no longer stall every in-flight decode stream
+        # for its whole prompt.  Outputs are byte-identical to the
+        # unsegmented path (the chunk resume math is the prefix-cache
+        # resume's).  0 = off (whole-prompt prefill at admission).
+        # prefill_tick_budget caps the prefill tokens advanced per
+        # engine tick across ALL staging admissions (0 = one segment).
+        "prefill_segment": 0,
+        "prefill_tick_budget": 0,
         "stream_queue_chunks": 64,
         "stream_overflow": "drop_oldest",
         "stream_stall_timeout_ms": 10000,
@@ -317,6 +329,27 @@ root.common.update({
             "min": 1,
             "max": 8,
             "per_host": 2,
+            # --- prefill/decode fleet roles (docs/services.md
+            # "Disaggregated prefill"): prefill_replicas > 0 reserves
+            # that many of the desired replicas as PREFILL-role —
+            # requests whose prompt length >= prefill_prompt_min are
+            # routed there first for the heavy admission prefill plus
+            # the first prefill_handoff_new tokens, then continue on a
+            # decode-role replica via the same prefix-resume splice
+            # the failover path uses (the client sees ONE
+            # byte-identical stream).  0 = no role split.
+            "prefill_replicas": 0,
+            "prefill_prompt_min": 64,
+            "prefill_handoff_new": 4,
+            # --- placement: "cost" prices every request as predicted
+            # prefill work (prompt_len x per-token prefill cost, from
+            # tools/cost_model device constants calibrated against the
+            # fleet's measured ms/tok) plus predicted decode residency
+            # (max_new x measured ms/tok) and routes to the replica
+            # with the least outstanding predicted work;
+            # "round_robin" keeps the PR 7 rotation.  Session
+            # affinity still wins over either.
+            "placement": "cost",
             # --- the autoscaler loop: scale UP when any replica's
             # measured queue-wait overshoot (SloShedder.overshoot,
             # read off /health) reaches scale_up_overshoot or fresh
@@ -329,6 +362,12 @@ root.common.update({
             # scale_window_s — flap damping: a scale oscillation can
             # never consume the crash-loop budget).
             "scale_up_overshoot": 1.0,
+            # scale UP early when the fleet-wide queued-but-unprefilled
+            # prompt backlog (replica queued_prefill_tokens, summed by
+            # FleetRouter.fleet_signals) reaches this many tokens —
+            # prefill backlog predicts the queue-wait breach before
+            # the shedder can measure it.  0 disables the signal.
+            "scale_up_prefill_backlog": 4096,
             "scale_idle_s": 30.0,
             "scale_cooldown_s": 10.0,
             "scale_window_s": 120.0,

@@ -15,6 +15,7 @@ generation is the transformer-era equivalent and beyond-parity."""
 
 import collections
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1086,7 +1087,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
-                 chunked_prefill=True, speculative_k=0):
+                 chunked_prefill=True, speculative_k=0,
+                 prefill_segment=0, prefill_tick_budget=0):
         self.gen = gen
         self.slots = int(slots)
         #: speculative_k > 0: n-gram speculative ticks — every active
@@ -1123,6 +1125,30 @@ class ContinuousBatcher:
         #: still covers whatever the prefill chunk didn't (rolling
         #: windows round the chunk DOWN).
         self.chunked_prefill = bool(chunked_prefill)
+        #: segmented prefill admission (docs/services.md "Disaggregated
+        #: prefill"): prefill_segment > 0 splits a long prompt's
+        #: admission prefill into bounded chunk passes of at most
+        #: ``prefill_segment`` tokens each, INTERLEAVED with decode
+        #: ticks — one long admission can no longer stall every
+        #: in-flight decode stream for its whole prompt.  The staged
+        #: passes run _prefill_resume_fn's resume-from-cursor math
+        #: (the prefix-cache resume contract: chunk_step == K step()
+        #: calls), so the finished cache row and pos0 = plen - 1 are
+        #: byte-identical to the unsegmented admission.  Per tick, at
+        #: most ``prefill_tick_budget`` prefill tokens advance across
+        #: ALL staged admissions (0 = one segment's worth; chunk
+        #: passes are pow2-bucketed, so a tick may overshoot the
+        #: budget by < 2x, never by a whole prompt).  0 = off.
+        self.prefill_segment = max(0, int(prefill_segment or 0))
+        self.prefill_tick_budget = max(0, int(prefill_tick_budget or 0))
+        #: slot -> staged-admission record (a reserved slot whose
+        #: prompt is still prefilling in segments; its row stays
+        #: inactive so decode ticks skip it)
+        self._staging = {}
+        #: optional callable({"kind": "begin"|"segment"|"admit", ...})
+        #: the serving engine hooks to surface serve.prefill flight
+        #: events and gauges; runs on the tick() caller's thread
+        self.prefill_observer = None
         B, L = self.slots, gen.max_len
         self._tokens = jnp.zeros((B, L), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
@@ -1244,6 +1270,7 @@ class ContinuousBatcher:
         self._queue.clear()
         self._results.clear()
         self._partials.clear()
+        self._staging = {}
         self._slot_req = [None] * self.slots
         B, L = self.slots, self.gen.max_len
         self._tokens = jnp.zeros((B, L), jnp.int32)
@@ -1257,19 +1284,33 @@ class ContinuousBatcher:
         self._caches = self._init_slot_caches()
 
     def tick(self):
-        """One engine step: admit queued requests into free slots, then
-        advance EVERY slot one token; emit and free finished rows.
-        Returns the number of active slots after the tick."""
+        """One engine step: admit queued requests into free slots
+        (long prompts under segmented prefill only RESERVE their slot
+        and stage — their prefill advances in bounded chunk passes
+        below, never in one whole-prompt pass), advance staged
+        prefills within the per-tick budget, then advance EVERY slot
+        one token; emit and free finished rows.  Returns the number of
+        active slots after the tick."""
         while self._can_admit():
-            self._admit(self._slot_req.index(None))
+            b = self._slot_req.index(None)
+            if self._will_segment(len(self._queue[0][1])):
+                self._begin_staged(b)
+            else:
+                self._admit(b)
+        if self._staging:
+            self._advance_staged(
+                self.prefill_tick_budget or self.prefill_segment)
         self._set_state(self._tick(self._state()))
         # emission: completion is re-derived from slot OCCUPANCY + pos
         # (the in-jit freeze already cleared ``active`` for rows that
         # hit their budget mid-scan, possibly several per fused
-        # dispatch)
+        # dispatch).  Staged slots are reserved but not yet decoding —
+        # their device-side pos/total still belong to the previous
+        # occupant, so they must not look done.
         pos = np.asarray(self._pos)
         total = np.asarray(self._total)
-        occupied = np.array([r is not None for r in self._slot_req])
+        occupied = np.array([r is not None and b not in self._staging
+                             for b, r in enumerate(self._slot_req)])
         done = occupied & (pos + 1 >= total)
         stream = self.stream_partials and occupied.any()
         # ONE [B, L] host fetch serves both the partial snapshots and
@@ -1311,6 +1352,9 @@ class ContinuousBatcher:
 
     def _release_slot(self, b):
         self._slot_req[b] = None
+        # a cancelled staged admission drops its partial prefill row
+        # (paged: the subclass's block free path runs either way)
+        self._staging.pop(b, None)
 
     def _state(self):
         return (self._tokens, self._pos, self._plen, self._total,
@@ -1354,65 +1398,196 @@ class ContinuousBatcher:
                 params, jnp.asarray(chunk[None])), start
         return None, 0
 
+    # ------------------------------------------- segmented admission
+    def _will_segment(self, plen):
+        """Whether admission STAGES this prompt (segmented prefill):
+        the knob is on, the prompt chunk-prefills at all, the cache is
+        linear (a rolling ring must round its one prefill chunk DOWN —
+        generate._prefill_dispatch — so it keeps the unsegmented
+        path), and the prefill work [0, plen-1) exceeds one segment
+        (otherwise one pass IS the bound)."""
+        return (self.prefill_segment > 0 and self._will_chunk(plen)
+                and not self.gen._rolling
+                and plen - 1 > self.prefill_segment)
+
+    def _staged_setup(self, b, prompt, plen, max_new, adapter):
+        """Subclass hook: reserve admission resources and return the
+        (cache_row, cursor, extras) a staged prefill starts from.
+        Dense pools start from a fresh [1, ...] row at cursor 0; the
+        paged subclass claims KV blocks and may resume mid-prompt
+        from a matched prefix."""
+        return (self.gen._init_caches(1, self.gen._model_dtype()), 0,
+                {})
+
+    def _begin_staged(self, b):
+        """Reserve slot ``b`` for the queue head and stage its
+        segmented prefill — cheap (allocation only): the chunk passes
+        run in _advance_staged under the per-tick budget, so beginning
+        never stalls the tick and the requests queued behind a long
+        prompt admit without waiting for its prefill."""
+        (rid, prompt, max_new, temperature, seed,
+         adapter) = self._queue.popleft()
+        plen = len(prompt)
+        self._aids = self._aids.at[b].set(adapter)
+        caches, cursor, extras = self._staged_setup(
+            b, prompt, plen, max_new, adapter)
+        rec = {"rid": rid, "prompt": prompt, "plen": plen,
+               "max_new": int(max_new), "temperature": temperature,
+               "seed": seed, "adapter": adapter, "caches": caches,
+               # the adapter graft is fixed for the whole admission:
+               # build it ONCE here, not once per segment pass
+               "params": self.gen._graft_adapters(
+                   self.gen.params, jnp.int32(adapter)),
+               "cursor": int(cursor)}
+        rec.update(extras)
+        self._slot_req[b] = rid
+        self._staging[b] = rec
+        if self.prefill_observer is not None:
+            self.prefill_observer({"kind": "begin", "rid": rid,
+                                   "slot": b, "plen": plen,
+                                   "cursor": rec["cursor"]})
+
+    def _advance_staged(self, budget):
+        """Advance staged prefills by bounded chunk passes, spending
+        at most ``budget`` prompt tokens this tick (pow2 bucketing may
+        overshoot by < 2x); an admission whose cursor reaches
+        plen - 1 finishes into its reserved slot — with the exact
+        cache row and start position the unsegmented admission hands
+        over.  Returns the budget left."""
+        gen = self.gen
+        for b in sorted(self._staging):
+            rec = self._staging[b]
+            while budget > 0 and rec["cursor"] < rec["plen"] - 1:
+                start = rec["cursor"]
+                want = min(self.prefill_segment,
+                           rec["plen"] - 1 - start, budget)
+                kb = gen._bucket(max(1, want), gen.max_len - start)
+                chunk = np.zeros((kb,), np.int32)
+                n_real = min(rec["plen"] - start, kb)
+                chunk[:n_real] = rec["prompt"][start:start + n_real]
+                t0 = time.perf_counter()
+                rec["caches"] = gen._prefill_resume_fn(kb)(
+                    rec["params"], rec["caches"],
+                    jnp.asarray(chunk[None]), jnp.int32(start))
+                # block: the per-tick stall bound is only honest if
+                # the segment's device work is DONE before the decode
+                # dispatch below (one device queue serializes them
+                # anyway) — and it makes the observer's seconds a real
+                # prefill-rate measurement, not a dispatch time
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(rec["caches"])[0])
+                dt = time.perf_counter() - t0
+                rec["cursor"] = min(start + kb, rec["plen"] - 1)
+                budget -= kb
+                if self.prefill_observer is not None:
+                    self.prefill_observer(
+                        {"kind": "segment", "rid": rec["rid"],
+                         "slot": b, "start": start, "tokens": kb,
+                         "cursor": rec["cursor"], "plen": rec["plen"],
+                         "seconds": dt})
+            if rec["cursor"] >= rec["plen"] - 1:
+                del self._staging[b]
+                self._finish_staged(b, rec)
+                if self.prefill_observer is not None:
+                    self.prefill_observer(
+                        {"kind": "admit", "rid": rec["rid"],
+                         "slot": b, "plen": rec["plen"]})
+        return budget
+
+    def _finish_staged(self, b, rec):
+        """Staged prefill complete: run the normal admission scatter
+        with the accumulated cache row at pos0 = plen - 1 (the same
+        cursor _prefill_row's full chunk hands over at)."""
+        self._ensure_admit_fns()
+        st = self._admit_fn(*self._admit_args(b, rec),
+                            jnp.int32(rec["plen"] - 1), rec["caches"])
+        self._set_state(st)
+
+    def _admit_args(self, b, rec):
+        """The shared positional prefix of _admit_fn/_admit_fresh_fn
+        (state + scalar slot writes) for one request record."""
+        prow = np.zeros((self.gen.max_len,), np.int32)
+        prow[:rec["plen"]] = rec["prompt"]
+        return (self._state(), jnp.int32(b), jnp.asarray(prow),
+                jnp.int32(rec["plen"]),
+                jnp.int32(rec["plen"] + rec["max_new"]),
+                jnp.int32(rec["seed"]),
+                jnp.float32(0.0 if rec["temperature"] == 0.0
+                            else 1.0 / rec["temperature"]))
+
+    def prefill_backlog_tokens(self):
+        """Queued-but-unprefilled prompt tokens: whole prompts still
+        in the queue plus the unprefilled remainder of staged
+        admissions — the serving plane's prefill-backlog gauge (the
+        fleet autoscaler's early scale-up signal)."""
+        queued = sum(len(item[1]) for item in self._queue)
+        staged = sum(max(0, rec["plen"] - 1 - rec["cursor"])
+                     for rec in self._staging.values())
+        return queued + staged
+
+    def staging_slots(self):
+        """Slots currently mid-staged-prefill (reserved, not yet
+        decoding)."""
+        return len(self._staging)
+
+    def _ensure_admit_fns(self):
+        if self._admit_fn is not None:
+            return
+        gen = self.gen
+
+        def admit_body(st, b, prow, plen, total, seed, inv_temp,
+                       pos0, cache_row):
+            (tokens, pos, plens, totals, active, seeds, its,
+             caches) = st
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, prow[None], (b, 0))
+            pos = pos.at[b].set(pos0)
+            plens = plens.at[b].set(plen)
+            totals = totals.at[b].set(total)
+            active = active.at[b].set(True)
+            seeds = seeds.at[b].set(seed)
+            its = its.at[b].set(inv_temp)
+            # the [1, ...] row replaces the slot's ENTIRE cache —
+            # either freshly initialized (stale K/V from the
+            # previous occupant must not leak) or chunk-prefilled
+            # with the new prompt
+            caches = jax.tree_util.tree_map(
+                lambda pool, one: jax.lax.dynamic_update_slice(
+                    pool, one.astype(pool.dtype),
+                    (b,) + (0,) * (pool.ndim - 1)),
+                caches, cache_row)
+            return (tokens, pos, plens, totals, active, seeds, its,
+                    caches)
+
+        def admit_fresh(st, b, prow, plen, total, seed, inv_temp):
+            # fresh values built INSIDE the jit (zeros, QuantCache
+            # scale ones) — the non-prefill path pays no extra
+            # dispatch and no host-built zero tree
+            return admit_body(st, b, prow, plen, total, seed,
+                              inv_temp, jnp.int32(0),
+                              gen._init_caches(1,
+                                               gen._model_dtype()))
+
+        self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
+        self._admit_fresh_fn = jax.jit(admit_fresh,
+                                       donate_argnums=(0,))
+
     def _admit(self, b):
         (rid, prompt, max_new, temperature, seed,
          adapter) = self._queue.popleft()
-        gen = self.gen
         plen = len(prompt)
         self._aids = self._aids.at[b].set(adapter)
-        if self._admit_fn is None:
-            def admit_body(st, b, prow, plen, total, seed, inv_temp,
-                           pos0, cache_row):
-                (tokens, pos, plens, totals, active, seeds, its,
-                 caches) = st
-                tokens = jax.lax.dynamic_update_slice(
-                    tokens, prow[None], (b, 0))
-                pos = pos.at[b].set(pos0)
-                plens = plens.at[b].set(plen)
-                totals = totals.at[b].set(total)
-                active = active.at[b].set(True)
-                seeds = seeds.at[b].set(seed)
-                its = its.at[b].set(inv_temp)
-                # the [1, ...] row replaces the slot's ENTIRE cache —
-                # either freshly initialized (stale K/V from the
-                # previous occupant must not leak) or chunk-prefilled
-                # with the new prompt
-                caches = jax.tree_util.tree_map(
-                    lambda pool, one: jax.lax.dynamic_update_slice(
-                        pool, one.astype(pool.dtype),
-                        (b,) + (0,) * (pool.ndim - 1)),
-                    caches, cache_row)
-                return (tokens, pos, plens, totals, active, seeds, its,
-                        caches)
-
-            def admit_fresh(st, b, prow, plen, total, seed, inv_temp):
-                # fresh values built INSIDE the jit (zeros, QuantCache
-                # scale ones) — the non-prefill path pays no extra
-                # dispatch and no host-built zero tree
-                return admit_body(st, b, prow, plen, total, seed,
-                                  inv_temp, jnp.int32(0),
-                                  gen._init_caches(1,
-                                                   gen._model_dtype()))
-
-            self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
-            self._admit_fresh_fn = jax.jit(admit_fresh,
-                                           donate_argnums=(0,))
+        self._ensure_admit_fns()
         cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
                                             adapter)
-        prow = np.zeros((self.gen.max_len,), np.int32)
-        prow[:plen] = prompt
-        st = (self._tokens, self._pos, self._plen, self._total,
-              self._active, self._seeds, self._inv_temp, self._caches)
-        args = (st, jnp.int32(b), jnp.asarray(prow), jnp.int32(plen),
-                jnp.int32(plen + max_new), jnp.int32(seed),
-                jnp.float32(0.0 if temperature == 0.0
-                            else 1.0 / temperature))
+        rec = {"prompt": prompt, "plen": plen, "max_new": int(max_new),
+               "temperature": temperature, "seed": seed}
+        args = self._admit_args(b, rec)
         if cache_row is None:
             st = self._admit_fresh_fn(*args)
         else:
             st = self._admit_fn(*args, jnp.int32(pos0), cache_row)
-        (self._tokens, self._pos, self._plen, self._total,
-         self._active, self._seeds, self._inv_temp, self._caches) = st
+        self._set_state(st)
         self._slot_req[b] = rid
 
     def _make_core(self, step_all=None):
@@ -1698,7 +1873,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def __init__(self, gen, slots=8, ticks_per_dispatch=1,
                  chunked_prefill=True, block=None, pool_tokens=None,
-                 fused=True, prefix_cache=False, speculative_k=0):
+                 fused=True, prefix_cache=False, speculative_k=0,
+                 prefill_segment=0, prefill_tick_budget=0):
         if int(speculative_k):
             raise ValueError(
                 "speculative ticks are dense-pool only (the chunk "
@@ -1706,7 +1882,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 "table) — use ContinuousBatcher(speculative_k=...)")
         super(PagedContinuousBatcher, self).__init__(
             gen, slots=slots, ticks_per_dispatch=ticks_per_dispatch,
-            chunked_prefill=chunked_prefill)
+            chunked_prefill=chunked_prefill,
+            prefill_segment=prefill_segment,
+            prefill_tick_budget=prefill_tick_budget)
         L = gen.max_len
         # shapes WITHOUT allocating the dense caches (eval_shape): the
         # whole point of paging is that dense slots x max_len may not
@@ -1924,11 +2102,16 @@ class PagedContinuousBatcher(ContinuousBatcher):
          self._pool, self._tables) = st
 
     # -------------------------------------------------------- admission
-    def _admit(self, b):
-        (rid, prompt, max_new, temperature, seed,
-         adapter) = self._queue.popleft()
+    def _claim_blocks(self, b, prompt, max_new, adapter,
+                      register=True):
+        """Allocate slot ``b``'s KV blocks (reusing matched prefix
+        blocks, ref-counted) and return ``(matched, will_chunk,
+        table_row, srow)``.  ``register=False`` defers prefix-cache
+        REGISTRATION: a staged (segmented) admission's new blocks hold
+        no K/V until the finish scatter runs, so they must not be
+        matchable by another admission in between —
+        _register_staged_blocks publishes them at finish instead."""
         plen = len(prompt)
-        self._aids = self._aids.at[b].set(adapter)
         nb = self._blocks_needed(plen, max_new)
         will_chunk = self._will_chunk(plen)
         matched = self._match_prefix(prompt, adapter)
@@ -1950,7 +2133,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 scatter_row.append(0)
             else:
                 blk = self._free.pop()
-                if self.prefix_cache and i < registerable:
+                if register and self.prefix_cache \
+                        and i < registerable:
                     key = (parent, int(adapter), tuple(
                         prompt[i * self.block:(i + 1) * self.block]))
                     self._prefix_reg[key] = blk
@@ -1964,6 +2148,71 @@ class PagedContinuousBatcher(ContinuousBatcher):
         table_row[:nb] = ids
         srow = np.zeros((self.max_blocks,), np.int32)
         srow[:nb] = scatter_row
+        return matched, will_chunk, table_row, srow
+
+    def _register_staged_blocks(self, prompt, adapter, ids,
+                                registerable, matched):
+        """Publish a finished staged admission's shareable blocks in
+        the prefix registry (deferred from _claim_blocks: their K/V
+        exists only after the finish scatter).  A key another request
+        registered meanwhile keeps ITS block — ours stays a private
+        allocation and frees normally on release."""
+        if not self.prefix_cache:
+            return
+        parent = 0
+        for i, blk in enumerate(ids):
+            if i >= registerable:
+                break
+            if i < len(matched):
+                parent = blk
+                continue
+            key = (parent, int(adapter), tuple(
+                prompt[i * self.block:(i + 1) * self.block]))
+            if key not in self._prefix_reg \
+                    and blk not in self._prefix_ref:
+                self._prefix_reg[key] = blk
+                self._prefix_ref[blk] = 1
+                self._block_key[blk] = key
+            parent = blk
+
+    def _staged_setup(self, b, prompt, plen, max_new, adapter):
+        """Paged staging: claim the blocks now (admission
+        backpressure accounting stays exact — _can_admit already
+        checked them against the free list) and start the cache row
+        from the matched prefix when there is one."""
+        matched, will_chunk, table_row, srow = self._claim_blocks(
+            b, prompt, max_new, adapter, register=False)
+        extras = {"trow": table_row, "srow": srow, "matched": matched,
+                  "registerable": (self._shareable_blocks(plen)
+                                   if will_chunk else 0)}
+        if matched:
+            # resume from the shared prefix blocks: gather this row's
+            # table view (real K/V for [0, start), dummy elsewhere)
+            caches = self._gather_row_view(table_row)
+            cursor = len(matched) * self.block
+        else:
+            caches = self.gen._init_caches(1, self.gen._model_dtype())
+            cursor = 0
+        return caches, cursor, extras
+
+    def _finish_staged(self, b, rec):
+        self._ensure_admit_fns()
+        self._register_staged_blocks(
+            rec["prompt"], rec["adapter"], self._slot_blocks.get(b, ()),
+            rec["registerable"], rec["matched"])
+        st = self._admit_fn(*self._admit_args(b, rec),
+                            jnp.asarray(rec["trow"]),
+                            jnp.asarray(rec["srow"]),
+                            jnp.int32(rec["plen"] - 1), rec["caches"])
+        self._set_state(st)
+
+    def _admit(self, b):
+        (rid, prompt, max_new, temperature, seed,
+         adapter) = self._queue.popleft()
+        plen = len(prompt)
+        self._aids = self._aids.at[b].set(adapter)
+        matched, will_chunk, table_row, srow = self._claim_blocks(
+            b, prompt, max_new, adapter)
         if matched and will_chunk:
             # prefix-cache COMPUTE skip: the matched blocks already
             # hold positions [0, start) — resume the chunk prefill
@@ -1978,61 +2227,11 @@ class PagedContinuousBatcher(ContinuousBatcher):
         else:
             cache_row, pos0 = self._prefill_row(prompt, plen, max_new,
                                                 adapter)
-        if self._admit_fn is None:
-            gen = self.gen
-            bs, nbm = self.block, self.max_blocks
-
-            def admit_body(st, b, prow, plen_, total, seed_, inv_temp,
-                           trow, srow, pos0_, crow):
-                # ONE fused dispatch, mirroring the dense admit_body
-                # (same scalar writes) + the table row and the prompt
-                # cache blocks scattered into the pool.  Dummy table
-                # entries (0) scatter into the dummy block — harmless,
-                # never read.  ``srow`` is ``trow`` with prefix-shared
-                # blocks diverted to the dummy block: their K/V already
-                # lives in the pool and must not be rewritten under an
-                # in-flight sharer.
-                (tokens, pos, plens, totals, active, seeds, its,
-                 pool, tables) = st
-                tokens = jax.lax.dynamic_update_slice(
-                    tokens, prow[None], (b, 0))
-                pos = pos.at[b].set(pos0_)
-                plens = plens.at[b].set(plen_)
-                totals = totals.at[b].set(total)
-                active = active.at[b].set(True)
-                seeds = seeds.at[b].set(seed_)
-                its = its.at[b].set(inv_temp)
-                tables = jax.lax.dynamic_update_slice(
-                    tables, trow[None], (b, 0))
-
-                def one(pl, rw):
-                    blocks = jnp.moveaxis(
-                        rw[0].reshape((rw.shape[1], nbm, bs)
-                                      + rw.shape[3:]), 1, 0)
-                    return pl.at[srow].set(blocks.astype(pl.dtype))
-
-                pool = jax.tree_util.tree_map(one, pool, crow)
-                return (tokens, pos, plens, totals, active, seeds,
-                        its, pool, tables)
-
-            def admit_fresh(st, b, prow, plen_, total, seed_,
-                            inv_temp, trow, srow):
-                return admit_body(st, b, prow, plen_, total, seed_,
-                                  inv_temp, trow, srow, jnp.int32(0),
-                                  gen._init_caches(
-                                      1, gen._model_dtype()))
-
-            self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
-            self._admit_fresh_fn = jax.jit(admit_fresh,
-                                           donate_argnums=(0,))
-        prow = np.zeros((self.gen.max_len,), np.int32)
-        prow[:plen] = prompt
-        args = (self._state(), jnp.int32(b), jnp.asarray(prow),
-                jnp.int32(plen), jnp.int32(plen + max_new),
-                jnp.int32(seed),
-                jnp.float32(0.0 if temperature == 0.0
-                            else 1.0 / temperature),
-                jnp.asarray(table_row), jnp.asarray(srow))
+        self._ensure_admit_fns()
+        rec = {"prompt": prompt, "plen": plen, "max_new": int(max_new),
+               "temperature": temperature, "seed": seed}
+        args = self._admit_args(b, rec) + (jnp.asarray(table_row),
+                                           jnp.asarray(srow))
         if cache_row is None:
             st = self._admit_fresh_fn(*args)
         else:
@@ -2040,19 +2239,63 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._set_state(st)
         self._slot_req[b] = rid
 
-    def _resume_row(self, prompt, plen, matched, table_row, adapter):
-        """Build an admission cache row by RESUMING from the matched
-        prefix blocks: gather this row's table view into a dense
-        [1, ...] row (real K/V for positions [0, start), dummy-block
-        content elsewhere — rewritten below or masked until decode
-        overwrites it, the round-up-prefill argument), then chunk-step
-        positions [start, start+kb) under the request's adapter.
-        Returns (cache_row, plen - 1) — the same cursor the full
-        chunk prefill hands over at."""
+    def _ensure_admit_fns(self):
+        if self._admit_fn is not None:
+            return
         gen = self.gen
         bs, nbm = self.block, self.max_blocks
-        start = len(matched) * bs
-        kb = gen._bucket(plen - start, gen.max_len - start)
+
+        def admit_body(st, b, prow, plen_, total, seed_, inv_temp,
+                       trow, srow, pos0_, crow):
+            # ONE fused dispatch, mirroring the dense admit_body
+            # (same scalar writes) + the table row and the prompt
+            # cache blocks scattered into the pool.  Dummy table
+            # entries (0) scatter into the dummy block — harmless,
+            # never read.  ``srow`` is ``trow`` with prefix-shared
+            # blocks diverted to the dummy block: their K/V already
+            # lives in the pool and must not be rewritten under an
+            # in-flight sharer.
+            (tokens, pos, plens, totals, active, seeds, its,
+             pool, tables) = st
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, prow[None], (b, 0))
+            pos = pos.at[b].set(pos0_)
+            plens = plens.at[b].set(plen_)
+            totals = totals.at[b].set(total)
+            active = active.at[b].set(True)
+            seeds = seeds.at[b].set(seed_)
+            its = its.at[b].set(inv_temp)
+            tables = jax.lax.dynamic_update_slice(
+                tables, trow[None], (b, 0))
+
+            def one(pl, rw):
+                blocks = jnp.moveaxis(
+                    rw[0].reshape((rw.shape[1], nbm, bs)
+                                  + rw.shape[3:]), 1, 0)
+                return pl.at[srow].set(blocks.astype(pl.dtype))
+
+            pool = jax.tree_util.tree_map(one, pool, crow)
+            return (tokens, pos, plens, totals, active, seeds,
+                    its, pool, tables)
+
+        def admit_fresh(st, b, prow, plen_, total, seed_,
+                        inv_temp, trow, srow):
+            return admit_body(st, b, prow, plen_, total, seed_,
+                              inv_temp, trow, srow, jnp.int32(0),
+                              gen._init_caches(
+                                  1, gen._model_dtype()))
+
+        self._admit_fn = jax.jit(admit_body, donate_argnums=(0,))
+        self._admit_fresh_fn = jax.jit(admit_fresh,
+                                       donate_argnums=(0,))
+
+    def _gather_row_view(self, table_row):
+        """Gather ONE slot's table view from the pool into a dense
+        [1, ...] cache row: real K/V for every allocated block, dummy-
+        block content elsewhere (rewritten or masked before any read —
+        the round-up-prefill argument).  Shared by the prefix-resume
+        admission and segmented staging."""
+        bs, nbm = self.block, self.max_blocks
         if self._resume_gather_fn is None:
             def gather_row(pool, trow):
                 def one(pl):
@@ -2064,8 +2307,22 @@ class PagedContinuousBatcher(ContinuousBatcher):
                               for c in layer)
                         for layer in pool]
             self._resume_gather_fn = jax.jit(gather_row)
-        caches = self._resume_gather_fn(self._pool,
-                                        jnp.asarray(table_row))
+        return self._resume_gather_fn(self._pool,
+                                      jnp.asarray(table_row))
+
+    def _resume_row(self, prompt, plen, matched, table_row, adapter):
+        """Build an admission cache row by RESUMING from the matched
+        prefix blocks: gather this row's table view into a dense
+        [1, ...] row (real K/V for positions [0, start), dummy-block
+        content elsewhere — rewritten below or masked until decode
+        overwrites it, the round-up-prefill argument), then chunk-step
+        positions [start, start+kb) under the request's adapter.
+        Returns (cache_row, plen - 1) — the same cursor the full
+        chunk prefill hands over at."""
+        gen = self.gen
+        start = len(matched) * self.block
+        kb = gen._bucket(plen - start, gen.max_len - start)
+        caches = self._gather_row_view(table_row)
         chunk = np.zeros((kb,), np.int32)
         chunk[:min(plen - start, kb)] = prompt[start:start + kb]
         params = gen._graft_adapters(gen.params, jnp.int32(adapter))

@@ -1,0 +1,107 @@
+"""Request cost pricing for the serving fleet (the TVM/CLBlast move
+from PAPERS.md applied to placement: don't guess with raw request
+counts, PREDICT the cost from a calibrated model and route on it).
+
+A serving request's device residency is priced in milliseconds as::
+
+    cost_ms = prompt_len * prefill_ms_per_tok
+              + max_new  * decode_ms_per_tok
+
+with the per-token constants seeded from ``tools.cost_model.
+serve_request_costs()`` (the same calibrated device model the MFU
+check and the bench predictions use; a baked-in v5e mirror covers
+installs without ``tools/``) and CALIBRATED at runtime against the
+fleet's MEASURED decode ms/tok — the router feeds every replica's
+``p50_ms_per_tok`` health reading through :meth:`RequestCost.
+calibrate`, which rescales BOTH constants by the measured/predicted
+ratio (prefill and decode share the device, so one drift factor
+covers both until a measured prefill rate arrives; replicas that
+report ``prefill_ms_per_tok`` pin the prefill constant directly).
+
+Only RELATIVE accuracy matters for placement: the router ranks
+replicas by their predicted outstanding work, so a fleet-wide scale
+error cancels.  Absolute accuracy matters for the deadline check and
+the autoscaler's backlog estimate — which is why the measured
+feedback loop exists."""
+
+import threading
+
+#: baked v5e mirror of tools.cost_model.serve_request_costs() at the
+#: flagship serving config (d=768, 12 layers) — used when tools/ is
+#: not importable (installed package without the repo checkout)
+_FALLBACK = {
+    "prefill_ms_per_tok": 0.0012,
+    "decode_ms_per_tok": 0.558,
+}
+
+
+def predicted_request_costs():
+    """``{"prefill_ms_per_tok", "decode_ms_per_tok"}`` from the
+    calibrated cost model when the repo's tools/ is importable, else
+    the baked-in v5e mirror."""
+    try:
+        from tools.cost_model import serve_request_costs
+        out = serve_request_costs()
+        return {"prefill_ms_per_tok": float(out["prefill_ms_per_tok"]),
+                "decode_ms_per_tok": float(out["decode_ms_per_tok"])}
+    except Exception:   # noqa: BLE001 — installed without tools/
+        return dict(_FALLBACK)
+
+
+class RequestCost(object):
+    """The fleet router's request pricer: predicted prefill work plus
+    predicted decode residency, with closed-loop calibration off the
+    fleet's measured rates.  Thread-safe (the health thread calibrates
+    while request threads price)."""
+
+    def __init__(self, prefill_ms_per_tok=None, decode_ms_per_tok=None):
+        seed = predicted_request_costs()
+        #: the model's uncalibrated decode prediction — the divisor of
+        #: the measured/predicted drift factor
+        self._decode_predicted = float(
+            decode_ms_per_tok or seed["decode_ms_per_tok"])
+        self._prefill_predicted = float(
+            prefill_ms_per_tok or seed["prefill_ms_per_tok"])
+        self.decode_ms_per_tok = self._decode_predicted
+        self.prefill_ms_per_tok = self._prefill_predicted
+        #: None until the first measured sample lands
+        self.calibration = None
+        self._measured_prefill = False
+        self._lock = threading.Lock()
+
+    def price(self, prompt_len, max_new):
+        """Predicted device residency (ms) of one request."""
+        return (max(0, int(prompt_len)) * self.prefill_ms_per_tok
+                + max(0, int(max_new)) * self.decode_ms_per_tok)
+
+    def calibrate(self, measured_decode_ms_per_tok,
+                  measured_prefill_ms_per_tok=None):
+        """Fold one measured sample in (EWMA so one noisy probe cannot
+        swing placement): the decode constant tracks the measurement,
+        and the prefill constant rescales by the same drift factor
+        until a replica reports a measured prefill rate of its own."""
+        m = float(measured_decode_ms_per_tok or 0.0)
+        if m <= 0:
+            return
+        with self._lock:
+            self.decode_ms_per_tok = (
+                m if self.calibration is None
+                else 0.8 * self.decode_ms_per_tok + 0.2 * m)
+            self.calibration = (self.decode_ms_per_tok
+                                / self._decode_predicted)
+            mp = float(measured_prefill_ms_per_tok or 0.0)
+            if mp > 0:
+                self._measured_prefill = True
+                self.prefill_ms_per_tok = (
+                    0.8 * self.prefill_ms_per_tok + 0.2 * mp
+                    if self.prefill_ms_per_tok else mp)
+            elif not self._measured_prefill:
+                self.prefill_ms_per_tok = (self._prefill_predicted
+                                           * self.calibration)
+
+    def status(self):
+        return {"prefill_ms_per_tok": round(self.prefill_ms_per_tok, 6),
+                "decode_ms_per_tok": round(self.decode_ms_per_tok, 6),
+                "calibration": (round(self.calibration, 4)
+                                if self.calibration is not None
+                                else None)}

@@ -326,31 +326,40 @@ class FleetAutoscaler(object):
     be measured before the next (the controller is closed-loop, not
     predictive)."""
 
-    def __init__(self, up_overshoot=1.0, idle_s=30.0, cooldown_s=10.0):
+    def __init__(self, up_overshoot=1.0, idle_s=30.0, cooldown_s=10.0,
+                 up_prefill_backlog=0):
         self.up_overshoot = float(up_overshoot)
         self.idle_s = float(idle_s)
         self.cooldown_s = float(cooldown_s)
+        #: fleet-wide queued-but-unprefilled prompt tokens that count
+        #: as overload on their own (0 = off): a prefill backlog
+        #: PREDICTS the queue-wait breach, so capacity can arrive
+        #: before the shedder ever has to measure one
+        self.up_prefill_backlog = int(up_prefill_backlog or 0)
         self._idle_since = None
         self._last_scale_ts = None
         self._last_shed_total = None
 
     def decide(self, now, desired, minimum, maximum, signals):
         """One control step.  ``signals``: ``{"overshoot": float,
-        "shed_total": int (monotonic), "busy": bool}`` — the shape
+        "shed_total": int (monotonic), "prefill_backlog": int,
+        "busy": bool}`` — the shape
         :meth:`FleetRouter.fleet_signals` returns."""
         overshoot = float(signals.get("overshoot") or 0.0)
         shed_total = int(signals.get("shed_total") or 0)
+        backlog = int(signals.get("prefill_backlog") or 0)
         busy = bool(signals.get("busy"))
         if self._last_shed_total is None:
             self._last_shed_total = shed_total
         shed_delta = max(shed_total - self._last_shed_total, 0)
         self._last_shed_total = shed_total
         overloaded = (overshoot >= self.up_overshoot > 0) \
-            or shed_delta > 0
+            or shed_delta > 0 \
+            or (backlog >= self.up_prefill_backlog > 0)
         # idle tracking runs on EVERY step (including cooldown ones):
         # the idle clock must not reset just because a decision was
         # recently made
-        if overloaded or busy or overshoot > 0:
+        if overloaded or busy or overshoot > 0 or backlog > 0:
             self._idle_since = None
         elif self._idle_since is None:
             self._idle_since = now
@@ -361,8 +370,8 @@ class FleetAutoscaler(object):
             if desired >= maximum:
                 return 0, "overloaded at max=%d" % maximum
             self._last_scale_ts = now
-            return (+1, "overshoot=%.2f shed_delta=%d"
-                    % (overshoot, shed_delta))
+            return (+1, "overshoot=%.2f shed_delta=%d backlog=%d"
+                    % (overshoot, shed_delta, backlog))
         if self._idle_since is not None \
                 and now - self._idle_since >= self.idle_s:
             if desired <= minimum:
@@ -1796,7 +1805,9 @@ class ServeFleetMaster(object):
                  scale_window_s=None, scale_max_per_window=None,
                  ready_timeout_ms=None, min_uptime_s=None,
                  autoscale=True, autoscale_interval_s=0.5,
-                 host_extras=None, seed=None):
+                 host_extras=None, seed=None, prefill_replicas=None,
+                 prefill_prompt_min=None, prefill_handoff_new=None,
+                 scale_up_prefill_backlog=None, placement=None):
         from veles_tpu.services.router import FleetRouter
 
         def fknob(value, key, default):
@@ -1816,6 +1827,14 @@ class ServeFleetMaster(object):
         self.fleet_max = max(int(fknob(fleet_max, "max", 8)),
                              self.fleet_min)
         self.per_host = int(fknob(per_host, "per_host", 2))
+        #: prefill/decode fleet roles (docs/services.md "Disaggregated
+        #: prefill"): this many of the desired replicas run as
+        #: PREFILL-role — the router sends long prompts' admission
+        #: prefill there first and splices the decode onto a
+        #: decode-role replica.  A dead prefill replica's replacement
+        #: inherits the deficit (role rebalance is reconciliation).
+        self.prefill_replicas = max(0, int(
+            fknob(prefill_replicas, "prefill_replicas", 0)))
         self.replica_path = replica_path
         self.port = int(port)
         self.bind_host = bind_host
@@ -1850,10 +1869,16 @@ class ServeFleetMaster(object):
                                "scale_up_overshoot", 1.0),
             idle_s=fknob(scale_idle_s, "scale_idle_s", 30.0),
             cooldown_s=fknob(scale_cooldown_s, "scale_cooldown_s",
-                             10.0))
+                             10.0),
+            up_prefill_backlog=fknob(scale_up_prefill_backlog,
+                                     "scale_up_prefill_backlog",
+                                     4096))
         self.router = FleetRouter(
             port=router_port,
-            health_interval_ms=health_interval_ms)
+            health_interval_ms=health_interval_ms,
+            placement=placement,
+            prefill_prompt_min=prefill_prompt_min,
+            prefill_handoff_new=prefill_handoff_new)
         self._rng = random.Random(seed)
         self._log = logging.getLogger("ServeFleet")
         self._lock = threading.Lock()
@@ -1994,9 +2019,10 @@ class ServeFleetMaster(object):
                 "replicas": {
                     rep: {"host": r["host"], "state": r["state"],
                           "port": r["port"], "pid": r["pid"],
-                          "rid": r["rid"]}
+                          "rid": r["rid"], "role": r.get("role")}
                     for rep, r in sorted(self.reps.items())
                     if r["state"] != "dead"},
+                "prefill_replicas": self.prefill_replicas,
                 "hosts": {
                     h: {"registered": s["conn"] is not None
                         and s["conn"].alive,
@@ -2183,6 +2209,7 @@ class ServeFleetMaster(object):
                 rec["ready_ts"] = now
                 rec["port"] = msg.get("port")
                 rec["pid"] = msg.get("pid")
+                role = rec.get("role")
                 addr = self.hosts[host]["addr"]
         if fenced:
             # the replica fence: rep ids are never reused, so a READY
@@ -2196,7 +2223,7 @@ class ServeFleetMaster(object):
                 self._send(host, {"type": "kill_replica", "rep": rep})
             return
         url = "http://%s:%d%s" % (addr, msg["port"], self.replica_path)
-        rid = self.router.register(url)
+        rid = self.router.register(url, role=role)
         with self._lock:
             rec = self.reps.get(rep)
             if rec is not None:
@@ -2541,19 +2568,35 @@ class ServeFleetMaster(object):
         for rep in drains:
             self._drain_rep(rep, now)
 
+    def _want_role(self):
+        """Role for the NEXT spawn (lock held): fill the prefill tier
+        up to ``prefill_replicas``, then decode — so a dead prefill
+        replica's replacement automatically inherits the deficit, and
+        role balance is plain reconciliation, not a special case."""
+        if self.prefill_replicas <= 0:
+            return None
+        live_prefill = sum(
+            1 for r in self.reps.values()
+            if r["state"] in ("spawning", "ready")
+            and r.get("role") == "prefill")
+        return ("prefill" if live_prefill < self.prefill_replicas
+                else "decode")
+
     def _spawn_replica_on(self, host, now):
         with self._lock:
             rep = self._next_rep
             self._next_rep += 1
             argv = list(self.replica_argv) + \
                 list(self.host_extras.get(host, ()))
+            role = self._want_role()
             self.reps[rep] = {"host": host, "state": "spawning",
                               "rid": None, "port": None, "pid": None,
                               "spawn_ts": now, "ready_ts": None,
-                              "exit": None}
+                              "exit": None, "role": role}
+            env = {"VELES_TPU_REPLICA_ROLE": role} if role else {}
             sent = self._send(host, {"type": "spawn_replica",
                                      "rep": rep, "argv": argv,
-                                     "env": {}})
+                                     "env": env})
             if not sent:
                 # the agent died between planning and send: the next
                 # tick re-plans over the live hosts
@@ -3284,6 +3327,12 @@ def main(argv=None):
                    help="(--serve) maximum replicas fleet-wide")
     p.add_argument("--per-host", type=int, default=None,
                    help="(--serve) max replicas on any one host")
+    p.add_argument("--prefill-replicas", type=int, default=None,
+                   help="(--serve) run this many replicas as the "
+                   "PREFILL tier: long prompts' admission prefill "
+                   "routes there, the decode continues on a decode "
+                   "replica via the prefix-resume splice "
+                   "(docs/services.md 'Disaggregated prefill')")
     p.add_argument("--router-port", type=int, default=0,
                    help="(--serve) the fleet router's HTTP port "
                    "(0 = pick)")
@@ -3319,6 +3368,7 @@ def main(argv=None):
             per_host=args.per_host, router_port=args.router_port,
             health_interval_ms=args.health_interval_ms,
             autoscale=not args.no_autoscale,
+            prefill_replicas=args.prefill_replicas,
             spawn_agents=not args.no_agents)
         try:
             rc = master.run()

@@ -159,12 +159,27 @@ class ContinuousEngine(Logger):
 
     def __init__(self, generator, slots=8, history=512, paged_block=0,
                  pool_tokens=None, prefix_cache=False, speculative_k=0,
-                 ticks_per_dispatch=1):
+                 ticks_per_dispatch=1, prefill_segment=None,
+                 prefill_tick_budget=None):
         super(ContinuousEngine, self).__init__()
         import collections
         from veles_tpu.models.generate import (ContinuousBatcher,
                                                PagedContinuousBatcher,
                                                parse_paged_block)
+        from veles_tpu.config import root as _root
+        serve_cfg = _root.common.serve
+        #: segmented prefill admission (docs/services.md
+        #: "Disaggregated prefill"): root.common.serve.prefill_segment
+        #: > 0 bounds how many prompt tokens one admission may prefill
+        #: per device pass — long prompts stage and interleave with
+        #: decode ticks, so in-flight streams keep their cadence.
+        #: None = the config knob; explicit 0 turns it off.
+        if prefill_segment is None:
+            prefill_segment = int(serve_cfg.get("prefill_segment", 0)
+                                  or 0)
+        if prefill_tick_budget is None:
+            prefill_tick_budget = int(
+                serve_cfg.get("prefill_tick_budget", 0) or 0)
         #: paged_block > 0: block-table KV pool — slot memory scales
         #: with the pool_tokens budget, and admission backpressures on
         #: pool exhaustion as well as slot exhaustion; "auto"/-1 keeps
@@ -183,12 +198,21 @@ class ContinuousEngine(Logger):
                        pool_tokens=pool_tokens,
                        prefix_cache=prefix_cache,
                        speculative_k=speculative_k,
-                       ticks_per_dispatch=ticks_per_dispatch)
+                       ticks_per_dispatch=ticks_per_dispatch,
+                       prefill_segment=prefill_segment,
+                       prefill_tick_budget=prefill_tick_budget)
                    if paged else
                    ContinuousBatcher(
                        generator, slots=slots,
                        speculative_k=speculative_k,
-                       ticks_per_dispatch=ticks_per_dispatch))
+                       ticks_per_dispatch=ticks_per_dispatch,
+                       prefill_segment=prefill_segment,
+                       prefill_tick_budget=prefill_tick_budget))
+        #: the batcher reports every staged prefill pass here (engine
+        #: thread — the sole tick caller): serve.prefill flight events,
+        #: the measured prefill rate the predictive deadline check
+        #: uses, and the prefill gauges all feed off it
+        self.cb.prefill_observer = self._note_prefill
         #: guards _ingress / _records / _history / counters — NEVER
         #: held across a device dispatch
         self._lock = threading.Lock()
@@ -214,8 +238,6 @@ class ContinuousEngine(Logger):
         #: (services.lifecycle.SloShedder): past it, new work is
         #: rejected with ShedError (503 + Retry-After) instead of
         #: queued into a breach.  0 = no SLO, no shedding.
-        from veles_tpu.config import root as _root
-        serve_cfg = _root.common.serve
         self._slo_queue_wait_ms = float(
             serve_cfg.get("slo_queue_wait_ms", 0) or 0)
         self._shed = SloShedder(
@@ -240,6 +262,23 @@ class ContinuousEngine(Logger):
         self._engine_faults = 0
         self._stream_dropped = 0
         self._spec_mixed = False
+        #: segmented-prefill surface: total prefill tokens/segments
+        #: the engine has advanced, the measured prefill rate (EWMA
+        #: over staged chunk passes — feeds the predictive deadline
+        #: check), and the prefill backlog gauge (snapshotted by the
+        #: engine thread after each tick, like _kv_gauge)
+        self._prefill_tokens = 0
+        self._prefill_segments = 0
+        self._prefill_ms_per_tok = 0.0
+        self._prefill_backlog = 0
+        #: decode-tick stall: wall gap between the END of one decode
+        #: dispatch and the START of the next while rows were decoding
+        #: — the time admissions/prefill stole from in-flight streams.
+        #: THE number segmented prefill exists to bound.
+        self._stall_hist = collections.deque(maxlen=int(history))
+        self._last_tick_end = None
+        self._had_active = False
+        self._gauges = None
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -503,17 +542,21 @@ class ContinuousEngine(Logger):
             return 0.0
         return vals[len(vals) // 2]
 
-    @staticmethod
-    def _expired(rec, now, p50_ms_per_tok):
+    def _expired(self, rec, now, p50_ms_per_tok):
         """Deadline verdict for a not-yet-admitted request: already
-        past, or provably unable to finish in the remaining budget
-        (measured p50 decode rate) — decoding it would burn pool time
-        nobody can use."""
+        past, or provably unable to finish in the remaining budget —
+        predicted as the prompt's PREFILL time (measured per-token
+        prefill rate x prompt length; a long prompt with a tight
+        deadline 504s at submit instead of after burning its whole
+        prefill) plus the decode residency (measured p50 decode rate
+        x max_new).  Either estimate is 0.0 before its first
+        measurement — the check never blocks a cold engine."""
         if rec["deadline"] is None:
             return False
-        return (now >= rec["deadline"]
-                or now + p50_ms_per_tok * rec["max_new"] / 1e3
-                > rec["deadline"])
+        est_s = (p50_ms_per_tok * rec["max_new"]
+                 + self._prefill_ms_per_tok
+                 * len(rec["prompt"])) / 1e3
+        return now >= rec["deadline"] or now + est_s > rec["deadline"]
 
     def _sweep_deadlines(self, now):
         """Cancel every tracked request whose deadline has passed:
@@ -579,6 +622,79 @@ class ContinuousEngine(Logger):
                                   "%r" % (err,)),
                 kind="serve.fault_evict")
 
+    def _note_prefill(self, ev):
+        """Batcher prefill-observer hook (runs on the engine thread —
+        the sole tick caller): one ``serve.prefill`` flight event per
+        bounded chunk pass makes the admission stall visible segment
+        by segment, and the measured per-token prefill rate (EWMA)
+        feeds the predictive deadline check and the router's cost
+        calibration surface."""
+        kind = ev.get("kind")
+        with self._lock:
+            rec = self._records.get(ev.get("rid"))
+        req = rec.get("id") if rec is not None else None
+        if kind == "segment":
+            toks = int(ev.get("tokens") or 0)
+            dt = float(ev.get("seconds") or 0.0)
+            self._prefill_tokens += toks
+            self._prefill_segments += 1
+            if toks and dt > 0:
+                ms_tok = dt * 1e3 / toks
+                self._prefill_ms_per_tok = (
+                    ms_tok if not self._prefill_ms_per_tok
+                    else 0.8 * self._prefill_ms_per_tok
+                    + 0.2 * ms_tok)
+            flight.record("serve.prefill", req=req, phase="segment",
+                          start=ev.get("start"), tokens=toks,
+                          cursor=ev.get("cursor"),
+                          plen=ev.get("plen"),
+                          ms=round(dt * 1e3, 3))
+        elif kind in ("begin", "admit"):
+            flight.record("serve.prefill", req=req, phase=kind,
+                          plen=ev.get("plen"))
+
+    def _export_serve_gauges(self, stall_ms=None):
+        """Segmented-prefill registry surface (PR 3 MetricsRegistry;
+        fail-soft — telemetry must never take the engine down):
+        ``veles_serve_prefill_tokens_total`` /
+        ``veles_serve_prefill_segments_total`` counters, the prefill
+        backlog gauge, and ``veles_serve_decode_stall_ms`` — the last
+        measured inter-decode-dispatch gap with rows in flight."""
+        try:
+            from veles_tpu import telemetry
+            if self._gauges is None:
+                self._gauges = {
+                    "tokens": telemetry.registry.counter(
+                        "veles_serve_prefill_tokens_total",
+                        "prompt tokens prefilled by segmented "
+                        "admission chunk passes"),
+                    "segments": telemetry.registry.counter(
+                        "veles_serve_prefill_segments_total",
+                        "bounded admission prefill chunk passes"),
+                    "backlog": telemetry.registry.gauge(
+                        "veles_serve_prefill_backlog_tokens",
+                        "queued-but-unprefilled prompt tokens"),
+                    "stall": telemetry.registry.gauge(
+                        "veles_serve_decode_stall_ms",
+                        "inter-decode-dispatch gap with streams in "
+                        "flight (the admission stall)"),
+                    "_tokens_seen": 0, "_segments_seen": 0,
+                }
+            d_tok = self._prefill_tokens - self._gauges["_tokens_seen"]
+            if d_tok > 0:
+                self._gauges["tokens"].inc(d_tok)
+                self._gauges["_tokens_seen"] = self._prefill_tokens
+            d_seg = (self._prefill_segments
+                     - self._gauges["_segments_seen"])
+            if d_seg > 0:
+                self._gauges["segments"].inc(d_seg)
+                self._gauges["_segments_seen"] = self._prefill_segments
+            self._gauges["backlog"].set(self._prefill_backlog)
+            if stall_ms is not None:
+                self._gauges["stall"].set(round(stall_ms, 3))
+        except Exception:   # noqa: BLE001 — fail-soft
+            pass
+
     def _loop(self):
         while True:
             with self._lock:
@@ -633,12 +749,24 @@ class ContinuousEngine(Logger):
                     for rec in self._records.values())
             tick_start = time.monotonic()
             try:
-                self.cb.tick()        # device dispatch — NO lock held
+                n_active = self.cb.tick()   # device dispatch — NO lock
             except Exception as e:    # noqa: BLE001 — survive the tick
                 flight.record("serve.engine_fault", error=repr(e))
                 self._fault_recover(e)
+                self._had_active = False
                 continue
             now = time.monotonic()
+            # decode-tick cadence: the gap between consecutive
+            # dispatch completions while rows were decoding across the
+            # boundary — the inter-chunk gap a streaming client sees.
+            # Whole-prompt admissions inflate its p99; the segmented
+            # prefill budget bounds it (metrics p50/p99_decode_stall).
+            stall_ms = None
+            if self._had_active and self._last_tick_end is not None:
+                stall_ms = (now - self._last_tick_end) * 1e3
+                self._stall_hist.append(stall_ms)
+            self._last_tick_end = now
+            self._had_active = bool(n_active)
             active = self.cb.active_requests()
             done = []
             pushes = []
@@ -724,6 +852,10 @@ class ContinuousEngine(Logger):
                     self._kv_gauge = self.cb.free_blocks()
                     if self._prefix_gauge is not None:
                         self._prefix_gauge = self.cb.prefix_stats()
+            # prefill-backlog snapshot (engine thread — the batcher's
+            # queue/staging are tick-caller state) + registry gauges
+            self._prefill_backlog = self.cb.prefill_backlog_tokens()
+            self._export_serve_gauges(stall_ms)
             for rec in done:          # wake waiters outside the lock
                 if self._slo_queue_wait_ms and \
                         rec.get("_queue_wait_ms", 0.0) \
@@ -756,6 +888,10 @@ class ContinuousEngine(Logger):
             in_flight = sum(1 for r in self._records.values()
                             if r["admit_ts"] is not None)
             served = self._served
+            # prompts still in the HTTP ingress have not reached the
+            # batcher's queue — they are prefill backlog too
+            ingress_toks = sum(len(r["prompt"]) for r in self._ingress)
+            stalls = list(self._stall_hist)
         out = {"served": served, "queued": queued,
                "in_flight": in_flight, "slots": self.cb.slots,
                "uptime_s": round(time.monotonic() - self._start_ts, 1),
@@ -768,7 +904,16 @@ class ContinuousEngine(Logger):
                "cancelled_total": self._cancelled,
                "deadline_expired_total": self._deadline_expired,
                "engine_faults": self._engine_faults,
-               "stream_dropped_chunks": self._stream_dropped}
+               "stream_dropped_chunks": self._stream_dropped,
+               # segmented-prefill surface (docs/services.md
+               # "Disaggregated prefill"): backlog in TOKENS (the
+               # autoscaler's early signal), work done, measured rate
+               "queued_prefill_tokens": (ingress_toks
+                                         + self._prefill_backlog),
+               "prefill_tokens_total": self._prefill_tokens,
+               "prefill_segments_total": self._prefill_segments,
+               "prefill_ms_per_tok": round(self._prefill_ms_per_tok,
+                                           4)}
         if self._kv_gauge is not None:
             out["free_kv_blocks"] = self._kv_gauge
         if self._prefix_gauge is not None:
@@ -786,6 +931,11 @@ class ContinuousEngine(Logger):
             vals = [h[key] for h in hist]
             out["p50_" + key] = pct(vals, 50)
             out["p99_" + key] = pct(vals, 99)
+        # the decode-tick cadence: inter-dispatch gap with streams in
+        # flight — whole-prompt admissions inflate its p99, segmented
+        # prefill bounds it (the stall-free serving gate's number)
+        out["p50_decode_stall_ms"] = pct(stalls, 50)
+        out["p99_decode_stall_ms"] = pct(stalls, 99)
         if len(hist) >= 2:
             # pool-level throughput: all new tokens in the history
             # window over the window's wall span (concurrent streams
@@ -802,6 +952,7 @@ class ContinuousEngine(Logger):
         the percentiles)."""
         with self._lock:
             self._history.clear()
+            self._stall_hist.clear()
             self._served = 0
             self._start_ts = time.monotonic()
 
@@ -867,7 +1018,8 @@ class RESTfulAPI(Logger):
                  path="/service", generator=None, batch_window=0.0,
                  max_batch=8, continuous_slots=0, paged_block=0,
                  pool_tokens=None, prefix_cache=False,
-                 speculative_k=0, ticks_per_dispatch=1):
+                 speculative_k=0, ticks_per_dispatch=1,
+                 prefill_segment=None):
         super(RESTfulAPI, self).__init__()
         self.forward = forward            # callable(np.ndarray) -> ndarray
         self.input_shape = tuple(input_shape)
@@ -891,7 +1043,8 @@ class RESTfulAPI(Logger):
                                         prefix_cache=prefix_cache,
                                         speculative_k=speculative_k,
                                         ticks_per_dispatch=
-                                        ticks_per_dispatch)
+                                        ticks_per_dispatch,
+                                        prefill_segment=prefill_segment)
                        if generator is not None and continuous_slots > 0
                        else None)
         self._server = None
@@ -1172,7 +1325,13 @@ class RESTfulAPI(Logger):
             try:
                 out["serving"] = self.engine.lifecycle_status()
                 m = self.engine.metrics()
-                for key in ("queued", "in_flight", "served", "slots"):
+                # queued_prefill_tokens: the fleet autoscaler's early
+                # scale-up signal (prefill backlog predicts the queue-
+                # wait breach); the measured rates feed the router's
+                # cost-weighted placement calibration
+                for key in ("queued", "in_flight", "served", "slots",
+                            "queued_prefill_tokens", "p50_ms_per_tok",
+                            "prefill_ms_per_tok"):
                     out[key] = m[key]
             except Exception as e:  # noqa: BLE001 — probe never 500s
                 out["serving"] = {"error": str(e)}

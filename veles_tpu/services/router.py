@@ -76,14 +76,27 @@ class NoReplicaError(RuntimeError):
 class Replica(object):
     """One registry entry.  State machine: ``up`` ⇄ ``down``,
     ``up → draining → (deregistered)``; transitions happen on the
-    health thread or (down only) the request path."""
+    health thread or (down only) the request path.
+
+    ``role``: None (any work) / ``"prefill"`` / ``"decode"`` — the
+    disaggregated-prefill fleet roles (docs/services.md): long
+    prompts' admission prefill routes to prefill-role replicas first.
+    ``pending_cost_ms``: predicted device residency (ms) of the
+    requests this router currently has in flight on the replica — the
+    cost-weighted placement signal.  Mutated ONLY through
+    FleetRouter._charge (under the router lock): += / -= from
+    concurrent handler threads would lose updates and drift the gauge
+    permanently, and min-cost placement would then favor the drifted
+    replica forever."""
 
     UP, DRAINING, DOWN = "up", "draining", "down"
+    ROLES = (None, "prefill", "decode")
 
     __slots__ = ("rid", "url", "host", "port", "path", "state",
-                 "fails", "last_health", "api")
+                 "fails", "last_health", "api", "role",
+                 "pending_cost_ms")
 
-    def __init__(self, rid, url, api=None):
+    def __init__(self, rid, url, api=None, role=None):
         parts = urlsplit(url)
         self.rid = rid
         self.url = url
@@ -94,10 +107,16 @@ class Replica(object):
         self.fails = 0            # consecutive health-probe failures
         self.last_health = None
         self.api = api            # in-process RESTfulAPI (spawn_local)
+        if role not in Replica.ROLES:
+            raise ValueError("role must be one of %s, got %r"
+                             % (Replica.ROLES, role))
+        self.role = role
+        self.pending_cost_ms = 0.0
 
     def describe(self):
         return {"url": self.url, "state": self.state,
-                "fails": self.fails,
+                "fails": self.fails, "role": self.role,
+                "pending_cost_ms": round(self.pending_cost_ms, 3),
                 "health": self.last_health}
 
 
@@ -123,9 +142,11 @@ class FleetRouter(Logger):
                  health_interval_ms=None, retry_max=None,
                  backoff_base_ms=None, backoff_max_ms=None,
                  affinity=None, stream_read_timeout_ms=None,
-                 rng_seed=None):
+                 rng_seed=None, placement=None,
+                 prefill_prompt_min=None, prefill_handoff_new=None):
         super(FleetRouter, self).__init__()
         from veles_tpu.config import root
+        from veles_tpu.services.costing import RequestCost
         cfg = root.common.serve.fleet
 
         def knob(arg, name, default):
@@ -140,6 +161,27 @@ class FleetRouter(Logger):
         self.backoff_max_s = float(
             knob(backoff_max_ms, "backoff_max_ms", 2000)) / 1e3
         self.affinity = str(knob(affinity, "affinity", "session"))
+        #: "cost": price each request (prompt_len x prefill cost +
+        #: max_new x measured decode ms/tok) and route to the replica
+        #: with the least predicted outstanding work; "round_robin":
+        #: the PR 7 rotation.  Session affinity wins over either.
+        self.placement = str(knob(placement, "placement", "cost"))
+        if self.placement not in ("cost", "round_robin"):
+            raise ValueError("fleet.placement must be 'cost' or "
+                             "'round_robin', got %r" % self.placement)
+        #: disaggregated-prefill routing: prompts at least this long
+        #: go to a prefill-role replica first (0 disables); the
+        #: prefill replica decodes the first prefill_handoff_new
+        #: tokens, then the stream continues on a decode replica via
+        #: the prefix-resume splice (PR 7 failover machinery)
+        self.prefill_prompt_min = int(
+            knob(prefill_prompt_min, "prefill_prompt_min", 64))
+        self.prefill_handoff_new = max(1, int(
+            knob(prefill_handoff_new, "prefill_handoff_new", 4)))
+        #: the calibrated request pricer (services.costing): seeded
+        #: from tools/cost_model device constants, calibrated against
+        #: the fleet's measured ms/tok off the health probes
+        self.cost = RequestCost()
         self.read_timeout_s = float(
             knob(stream_read_timeout_ms, "stream_read_timeout_ms",
                  30000)) / 1e3
@@ -161,6 +203,7 @@ class FleetRouter(Logger):
             "resumed_streams": 0,   # mid-stream prefix-resume splices
             "shed_rejects": 0,      # 503s the router itself returned
             "session_moves": 0,     # affinity pins that had to move
+            "prefill_handoffs": 0,  # prefill-replica -> decode splices
         }
         self._local_apis = []            # spawn_local ownership
         self._closed = False
@@ -176,11 +219,20 @@ class FleetRouter(Logger):
         self._gauges = None
 
     # ----------------------------------------------------------- registry
-    def register(self, url, api=None):
+    def register(self, url, api=None, role=None):
         """Add a replica by URL (its RESTfulAPI work path, e.g.
         ``http://127.0.0.1:8180/service``).  Optimistically up — the
         first health probe (≤ one interval away) corrects it.
-        Returns the replica id."""
+        ``role``: None / "prefill" / "decode" (disaggregated-prefill
+        routing); re-registration may update it (a replaced replica's
+        successor can carry a different role).  Returns the replica
+        id."""
+        if role not in Replica.ROLES:
+            # validate up front so a typo'd role is LOUD on both the
+            # fresh and the re-registration path (silently keeping
+            # the old role would misroute long prompts forever)
+            raise ValueError("role must be one of %s, got %r"
+                             % (Replica.ROLES, role))
         rep = None
         fresh = False
         with self._lock:
@@ -190,13 +242,16 @@ class FleetRouter(Logger):
                     break
             if rep is None:
                 fresh = True
-                rep = Replica(self._next_rid, url, api=api)
+                rep = Replica(self._next_rid, url, api=api, role=role)
                 self._next_rid += 1
                 self._replicas[rep.rid] = rep
+            elif role is not None:
+                rep.role = role
         if fresh:
             flight.record("serve.replica_up", replica=rep.rid,
-                          url=url, registered=True)
-            self.info("replica %d registered: %s", rep.rid, url)
+                          url=url, registered=True, role=role)
+            self.info("replica %d registered: %s%s", rep.rid, url,
+                      " (role=%s)" % role if role else "")
             self._export_fleet_gauges()
         else:
             # re-registration (e.g. a restarted replica announcing
@@ -230,24 +285,26 @@ class FleetRouter(Logger):
         self._export_fleet_gauges()
         return True
 
-    def spawn_local(self, generator, n, input_shape=None, **engine_kw):
+    def spawn_local(self, generator, n, input_shape=None, roles=None,
+                    **engine_kw):
         """Spawn ``n`` in-process replicas around one (read-only)
         generator — each gets its own RESTfulAPI + ContinuousEngine on
         a loopback port, registered here and owned by :meth:`stop`.
         The single-host fleet: engine state is per-replica, weights
-        are shared.  Returns the replica ids."""
+        are shared.  ``roles``: optional per-replica role list
+        (None / "prefill" / "decode").  Returns the replica ids."""
         from veles_tpu.services.restful import RESTfulAPI
         if input_shape is None:
             input_shape = (generator.max_len,)
         rids = []
-        for _ in range(n):
+        for i in range(n):
             api = RESTfulAPI(lambda x: x, input_shape, port=0,
                              generator=generator, **engine_kw)
             api.start()
             self._local_apis.append(api)
             rids.append(self.register(
                 "http://127.0.0.1:%d%s" % (api.port, api.path),
-                api=api))
+                api=api, role=roles[i] if roles else None))
         return rids
 
     def replicas(self):
@@ -346,6 +403,17 @@ class FleetRouter(Logger):
             return
         rep.fails = 0
         rep.last_health = payload
+        # cost-model calibration: every health probe carries the
+        # replica's measured decode p50 ms/tok (and, with segmented
+        # prefill on, its measured prefill rate) — the predicted
+        # request costs track the fleet's live reality
+        try:
+            m = float(payload.get("p50_ms_per_tok") or 0.0)
+            mp = float(payload.get("prefill_ms_per_tok") or 0.0)
+            if m > 0:
+                self.cost.calibrate(m, mp if mp > 0 else None)
+        except (TypeError, ValueError):
+            pass
         state = payload.get("state", "serving")
         if state == "serving":
             self._mark_up(rep)
@@ -428,33 +496,65 @@ class FleetRouter(Logger):
                 self.backoff_base_s * (2 ** attempt))
         return d * (0.5 + 0.5 * self._rng.random())
 
-    def _pick(self, session=None, exclude=()):
+    def _charge(self, rep, delta_ms):
+        """Adjust a replica's outstanding predicted work — the ONLY
+        writer of ``pending_cost_ms`` (handler threads race; an
+        unlocked += would lose updates and drift the gauge
+        permanently)."""
+        with self._lock:
+            rep.pending_cost_ms += delta_ms
+
+    def _backlog_ms(self, rep):
+        """Predicted outstanding work on a replica: what THIS router
+        has in flight there (pending_cost_ms) plus the prefill
+        backlog its last health probe reported (work routed around
+        us, or queued before a restart), priced by the calibrated
+        prefill cost."""
+        out = rep.pending_cost_ms
+        h = rep.last_health or {}
+        try:
+            out += (float(h.get("queued_prefill_tokens") or 0)
+                    * self.cost.prefill_ms_per_tok)
+        except (TypeError, ValueError):
+            pass
+        return out
+
+    def _pick(self, session=None, exclude=(), role=None):
         """Choose a live replica: the session's pinned one when
-        affinity is on and it is still up, else a deterministic
-        hash-pick (new pin) or round-robin.  Returns None when no
-        up replica remains outside ``exclude``."""
+        affinity is on and it is still up, else cost-weighted (least
+        predicted outstanding work — ``placement='cost'``) or
+        round-robin.  ``role='prefill'`` prefers prefill-role
+        replicas; any other pick prefers NON-prefill ones (the
+        prefill tier must stay clear for the next long prompt) —
+        either falls back to the whole up set when its preferred tier
+        is empty, so roles can never strand a request.  Returns None
+        when no up replica remains outside ``exclude``."""
         with self._lock:
             ups = [r for r in self._replicas.values()
                    if r.state == Replica.UP and r.rid not in exclude]
             if not ups:
                 return None
+            if role == "prefill":
+                tier = [r for r in ups if r.role == "prefill"]
+            else:
+                tier = [r for r in ups if r.role != "prefill"]
+            ups = tier or ups
             ups.sort(key=lambda r: r.rid)
             if session is not None and self.affinity == "session":
                 pinned = self._sessions.get(session)
                 for r in ups:
                     if r.rid == pinned:
                         return r
-                pick = ups[zlib.crc32(str(session).encode())
-                           % len(ups)]
+                pick = self._placement_pick(ups, session)
                 pin_rep = self._replicas.get(pinned) \
                     if pinned is not None else None
                 if pin_rep is not None \
                         and pin_rep.state == Replica.UP:
                     # the pinned replica is alive but excluded for
-                    # THIS request only (shed 503 / already tried):
-                    # route around WITHOUT moving the pin — a
-                    # transient valve blip must not cost the session
-                    # its prefix cache
+                    # THIS request only (shed 503 / already tried /
+                    # wrong role tier): route around WITHOUT moving
+                    # the pin — a transient valve blip must not cost
+                    # the session its prefix cache
                     return pick
                 # pin (first sight) or re-pin (pinned replica left
                 # the pool): stable hash so a cold router maps the
@@ -463,9 +563,103 @@ class FleetRouter(Logger):
                     self._counters["session_moves"] += 1
                 self._sessions[session] = pick.rid
                 return pick
-            r = ups[self._rr % len(ups)]
+            return self._placement_pick(ups, None)
+
+    def _placement_pick(self, ups, session):
+        """Placement policy over an already-filtered up set (lock
+        held).  Sessions keep the stable crc32 hash — affinity is
+        about prefix-cache reuse, and a cold router must map the same
+        sessions to the same replicas regardless of load."""
+        if session is not None:
+            return ups[zlib.crc32(str(session).encode()) % len(ups)]
+        if self.placement == "cost":
+            costs = [(self._backlog_ms(r), r) for r in ups]
+            best = min(c for c, _ in costs)
+            # ties (an idle fleet prices every replica 0) rotate —
+            # cost must degrade to round-robin, never hammer the
+            # lowest rid with every small request
+            cands = [r for c, r in costs if c <= best + 1e-9]
+            r = cands[self._rr % len(cands)]
             self._rr += 1
             return r
+        r = ups[self._rr % len(ups)]
+        self._rr += 1
+        return r
+
+    # --------------------------------------------- pricing & roles
+    @staticmethod
+    def _gen_opts(parsed):
+        opts = (parsed or {}).get("generate") \
+            if isinstance(parsed, dict) else None
+        return opts if isinstance(opts, dict) else None
+
+    @staticmethod
+    def _prompt_rows(parsed):
+        """The request's prompt rows as a list of lists (or None for
+        non-generate / malformed bodies — priced nominally)."""
+        row = (parsed or {}).get("input") \
+            if isinstance(parsed, dict) else None
+        if not isinstance(row, list) or not row:
+            return None
+        if isinstance(row[0], list):
+            return row
+        return [row]
+
+    def _price(self, parsed):
+        """Predicted device residency (ms) of one request — the
+        cost-weighted placement weight.  Non-generate forwards price
+        one decode token (nominal: they are single forward passes)."""
+        opts = self._gen_opts(parsed)
+        rows = self._prompt_rows(parsed)
+        if opts is None or rows is None:
+            return self.cost.decode_ms_per_tok
+        max_new = int(opts.get("max_new", 16))
+        return sum(self.cost.price(len(r), max_new) for r in rows)
+
+    def _handoff_plan(self, parsed):
+        """Disaggregated-prefill verdict for one request: ``(role,
+        cap)``.  ``role`` is "prefill" when the prompt is long enough
+        to route to the prefill tier (None otherwise); ``cap`` > 0
+        means two-phase — the prefill replica serves the admission
+        prefill plus the first ``cap`` tokens, then the stream
+        continues on a decode replica via the prefix-resume splice.
+        cap == 0 with role "prefill" = the whole (short-decode)
+        request runs on the prefill replica."""
+        if self.prefill_prompt_min <= 0:
+            return None, 0
+        if not isinstance(parsed, dict) or parsed.get("resume"):
+            # a resume continuation is already-admitted work being
+            # relocated (failover, or OUR OWN decode leg) — it must
+            # never re-enter the handoff plan, or a long resumed
+            # prompt would ping-pong between the tiers forever
+            return None, 0
+        opts = self._gen_opts(parsed)
+        rows = self._prompt_rows(parsed)
+        if opts is None or rows is None or len(rows) != 1:
+            return None, 0
+        if len(rows[0]) < self.prefill_prompt_min:
+            return None, 0
+        with self._lock:
+            has_prefill = any(r.state == Replica.UP
+                              and r.role == "prefill"
+                              for r in self._replicas.values())
+        if not has_prefill:
+            return None, 0
+        max_new = int(opts.get("max_new", 16))
+        cap = min(self.prefill_handoff_new, max_new)
+        return "prefill", (cap if cap < max_new else 0)
+
+    @staticmethod
+    def _capped_body(parsed, cap, resume=False):
+        """The prefill-leg request: same prompt, max_new capped to the
+        handoff budget (the decode leg resumes from there)."""
+        body = dict(parsed)
+        opts = dict(body["generate"])
+        opts["max_new"] = int(cap)
+        body["generate"] = opts
+        if resume:
+            body["resume"] = True
+        return json.dumps(body).encode()
 
     @staticmethod
     def _retry_after_of(headers, body):
@@ -488,46 +682,70 @@ class FleetRouter(Logger):
         finally:
             conn.close()
 
-    def route_buffered(self, body, session=None):
+    def route_buffered(self, body, session=None, parsed=None):
         """Route one non-streaming request; returns (status, payload
-        bytes, extra headers).  Raises :class:`NoReplicaError` when
-        the retry budget is exhausted (the HTTP layer maps it to 503 +
-        Retry-After)."""
+        bytes, extra headers).  Long prompts route to the prefill
+        tier — two-phase when the decode residency exceeds the
+        handoff budget (prefill leg capped, decode continuation via
+        the resume body on a decode replica; the second leg's result
+        is already the full concatenation).  Raises
+        :class:`NoReplicaError` when the retry budget is exhausted
+        (the HTTP layer maps it to 503 + Retry-After)."""
+        if parsed is None:
+            try:
+                parsed = json.loads(body)
+            except ValueError:
+                parsed = None
+        role, cap = self._handoff_plan(parsed)
+        if role is not None and cap:
+            out = self._route_buffered_handoff(parsed, session, cap)
+            if out is not None:
+                return out
+            # the two-phase path could not run (prefill tier emptied,
+            # torn first leg): fall through single-phase — the
+            # request must never be lost to an optimization
+        cost = self._price(parsed)
         tried = set()
         shed_ra = None
         last_err = None
         attempt = 0
         while attempt <= self.retry_max:
-            rep = self._pick(session=session, exclude=tried)
+            rep = self._pick(session=session, exclude=tried,
+                             role=role)
             if rep is None:
                 break
+            self._charge(rep, cost)
             try:
-                status, headers, payload = self._forward_buffered(
-                    rep, body)
-            except (OSError, http.client.HTTPException) as e:
-                last_err = e
-                tried.add(rep.rid)
-                self._mark_down(rep, "request failed: %r" % (e,))
-                self._note_failover(rep, session, attempt,
-                                    stream=False)
+                try:
+                    status, headers, payload = self._forward_buffered(
+                        rep, body)
+                except (OSError, http.client.HTTPException) as e:
+                    last_err = e
+                    tried.add(rep.rid)
+                    self._mark_down(rep, "request failed: %r" % (e,))
+                    self._note_failover(rep, session, attempt,
+                                        stream=False)
+                    with self._lock:
+                        self._counters["retries"] += 1
+                    attempt += 1
+                    time.sleep(self.backoff_delay(attempt - 1))
+                    continue
+                if status == 503:
+                    # shed valve open or draining: route around it —
+                    # immediately, the next replica may be idle.  NOT
+                    # an attempt: the retry budget is for failures, so
+                    # a wide fleet with several shedding members still
+                    # gets every live replica tried once
+                    shed_ra = max(shed_ra or 0.0,
+                                  self._retry_after_of(headers,
+                                                       payload))
+                    tried.add(rep.rid)
+                    continue
                 with self._lock:
-                    self._counters["retries"] += 1
-                attempt += 1
-                time.sleep(self.backoff_delay(attempt - 1))
-                continue
-            if status == 503:
-                # shed valve open or draining: route around it —
-                # immediately, the next replica may be idle.  NOT an
-                # attempt: the retry budget is for failures, so a
-                # wide fleet with several shedding members still gets
-                # every live replica tried once
-                shed_ra = max(shed_ra or 0.0,
-                              self._retry_after_of(headers, payload))
-                tried.add(rep.rid)
-                continue
-            with self._lock:
-                self._counters["routed"] += 1
-            return status, payload, ()
+                    self._counters["routed"] += 1
+                return status, payload, ()
+            finally:
+                self._charge(rep, -cost)
         with self._lock:
             self._counters["shed_rejects"] += 1
         ra = shed_ra if shed_ra is not None else 1.0
@@ -537,6 +755,55 @@ class FleetRouter(Logger):
                        "; every live replica is shedding"
                        if shed_ra is not None else ""),
             retry_after_s=ra)
+
+    def _route_buffered_handoff(self, parsed, session, cap):
+        """Two-phase buffered request: prefill leg (capped max_new)
+        on the prefill tier, then the decode continuation — the same
+        prefix-resume body the failover path uses — on a decode
+        replica.  Returns (status, payload, headers), a deterministic
+        replica verdict, or None to fall back single-phase."""
+        rows = self._prompt_rows(parsed)
+        body1 = self._capped_body(parsed, cap)
+        cost1 = self.cost.price(len(rows[0]), cap)
+        tried = set()
+        for _ in range(self.retry_max + 1):
+            rep = self._pick(session=session, exclude=tried,
+                             role="prefill")
+            if rep is None:
+                return None
+            self._charge(rep, cost1)
+            try:
+                status, headers, payload = self._forward_buffered(
+                    rep, body1)
+            except (OSError, http.client.HTTPException) as e:
+                tried.add(rep.rid)
+                self._mark_down(rep, "request failed: %r" % (e,))
+                self._note_failover(rep, session, 0, stream=False)
+                with self._lock:
+                    self._counters["retries"] += 1
+                continue
+            finally:
+                self._charge(rep, -cost1)
+            if status == 503:
+                tried.add(rep.rid)
+                continue
+            if status != 200:
+                # deterministic verdict (validation 400 / deadline
+                # 504): every replica would repeat it
+                return status, payload, ()
+            try:
+                first = json.loads(payload)["result"][0]
+            except (ValueError, KeyError, IndexError, TypeError):
+                return None
+            delivered = [int(t) for t in first[len(rows[0]):]]
+            with self._lock:
+                self._counters["prefill_handoffs"] += 1
+            flight.record("serve.prefill_handoff", replica=rep.rid,
+                          session=session, prompt_len=len(rows[0]),
+                          handoff=len(delivered), stream=False)
+            resume = self._resume_body(parsed, delivered)
+            return self.route_buffered(resume, session=session)
+        return None
 
     def _note_failover(self, rep, session, attempt, stream,
                        delivered=0):
@@ -548,18 +815,22 @@ class FleetRouter(Logger):
 
     # ---------------------------------------------------------- streaming
     @staticmethod
-    def _resume_body(parsed, delivered):
+    def _resume_body(parsed, delivered, cap=0):
         """The prefix-resume continuation request: prompt grows by the
         already-delivered tokens, max_new shrinks by them — the
         survivor decodes exactly the missing suffix (deterministic for
         greedy decode, and for sampled rows too: the per-row key
         stream is (seed, absolute position), which the longer prompt
-        preserves)."""
+        preserves).  ``cap`` > 0 bounds the continuation at the
+        prefill-handoff budget instead of the request's full max_new
+        (a failover WITHIN the prefill leg must not decode the whole
+        request on the prefill tier)."""
         opts = dict(parsed["generate"])
         row = parsed["input"]
         if row and isinstance(row[0], list):
             row = row[0]
-        opts["max_new"] = int(opts.get("max_new", 16)) - len(delivered)
+        total = int(cap) if cap else int(opts.get("max_new", 16))
+        opts["max_new"] = total - len(delivered)
         body = dict(parsed)
         body["input"] = list(row) + list(delivered)
         body["generate"] = opts
@@ -578,8 +849,18 @@ class FleetRouter(Logger):
         :class:`NoReplicaError` only BEFORE headers are committed;
         after that, terminal failures surface as an ``{"error": ...}``
         NDJSON line (the streaming contract — the status code is
-        gone)."""
+        gone).
+
+        Disaggregated prefill rides the SAME loop: a long prompt's
+        first leg goes to a prefill-role replica with max_new capped
+        at the handoff budget; its (swallowed) done line flips the
+        loop into the decode phase, where the continuation is exactly
+        the failover machinery's prefix-resume body — one
+        byte-identical client stream either way, and a prefill
+        replica dying MID-prefill is just a failover."""
         max_new = int(parsed["generate"].get("max_new", 16))
+        plan_role, cap = self._handoff_plan(parsed)
+        cost = self._price(parsed)
         delivered = []            # new tokens already sent to client
         committed = False
         # two exclusion tiers: a DEAD replica stays excluded for the
@@ -592,23 +873,37 @@ class FleetRouter(Logger):
         shed_ra = None
         attempt = 0
         while attempt <= self.retry_max:
+            # handoff phase: still inside the prefill leg?
+            in_handoff = bool(cap) and len(delivered) < cap
+            role = None
+            if plan_role is not None:
+                role = "prefill" if (in_handoff or not cap) \
+                    else "decode"
             rep = self._pick(session=session,
-                             exclude=tried_dead | tried_shed)
+                             exclude=tried_dead | tried_shed,
+                             role=role)
             if rep is None:
                 break
             if delivered:
-                send_body = self._resume_body(parsed, delivered)
+                send_body = self._resume_body(
+                    parsed, delivered, cap=cap if in_handoff else 0)
             elif committed:
                 # headers are committed but no tokens flowed yet: a
                 # from-scratch retry that must still bypass the shed
                 # valve (the client can no longer be told 503)
                 resend = dict(parsed)
+                if in_handoff:
+                    resend = json.loads(
+                        self._capped_body(parsed, cap).decode())
                 resend["resume"] = True
                 send_body = json.dumps(resend).encode()
+            elif in_handoff:
+                send_body = self._capped_body(parsed, cap)
             else:
                 send_body = body
             conn = http.client.HTTPConnection(
                 rep.host, rep.port, timeout=self.read_timeout_s)
+            self._charge(rep, cost)
             try:
                 conn.request("POST", rep.path, send_body,
                              {"Content-Type": "application/json"})
@@ -644,8 +939,28 @@ class FleetRouter(Logger):
                     except Exception as e:  # noqa: BLE001
                         raise _ClientGone() from e
                     committed = True
-                if self._pump_stream(resp, parsed, delivered,
-                                     write_line, bool(tried_dead)):
+                out = self._pump_stream(resp, parsed, delivered,
+                                        write_line, bool(tried_dead),
+                                        swallow_done=in_handoff)
+                if out == "handoff":
+                    # prefill leg complete: the loop continues in the
+                    # decode phase with the delivered prefix — the
+                    # exact failover splice, minus the failure
+                    with self._lock:
+                        self._counters["prefill_handoffs"] += 1
+                    flight.record("serve.prefill_handoff",
+                                  replica=rep.rid, session=session,
+                                  prompt_len=len(parsed["input"][0]
+                                                 if isinstance(
+                                                     parsed["input"][0],
+                                                     list)
+                                                 else parsed["input"]),
+                                  handoff=len(delivered), stream=True)
+                    # the decode leg is a shed-exempt resume: replicas
+                    # that shed the ORIGINAL submission are eligible
+                    tried_shed.clear()
+                    continue
+                if out:
                     with self._lock:
                         self._counters["routed"] += 1
                     return
@@ -690,6 +1005,7 @@ class FleetRouter(Logger):
                 attempt += 1
                 time.sleep(self.backoff_delay(attempt - 1))
             finally:
+                self._charge(rep, -cost)
                 conn.close()
         # retry budget exhausted
         ra = shed_ra if shed_ra is not None else 1.0
@@ -704,12 +1020,19 @@ class FleetRouter(Logger):
         raise NoReplicaError(msg, retry_after_s=ra)
 
     def _pump_stream(self, resp, parsed, delivered, write_line,
-                     resumed):
+                     resumed, swallow_done=False):
         """Forward NDJSON lines replica→client until the done line
         (True) or upstream failure (False).  Client write failures
         raise :class:`_ClientGone`.  ``delivered`` accumulates the
         new tokens the client has actually been sent — the splice
-        offset a failover resumes from."""
+        offset a failover resumes from.
+
+        ``swallow_done``: the prefill-handoff leg — the capped
+        request's done line is NOT the client's terminal (the decode
+        continuation follows on another replica): any tokens it
+        carries beyond what token lines delivered are forwarded as
+        one more token line, and ``"handoff"`` is returned instead of
+        True."""
         while True:
             raw = resp.fp.readline()
             if not raw:
@@ -731,6 +1054,23 @@ class FleetRouter(Logger):
                 self._client_write(write_line, raw)
                 return True
             elif msg.get("done"):
+                if swallow_done:
+                    # the leg's authoritative result covers overflow-
+                    # dropped chunks too: hand the client whatever the
+                    # token lines didn't, then flip to the decode leg
+                    row = parsed["input"]
+                    if row and isinstance(row[0], list):
+                        row = row[0]
+                    tail = [int(t) for t in
+                            list(msg.get("result") or [])[
+                                len(row) + len(delivered):]]
+                    if tail:
+                        self._client_write(
+                            write_line,
+                            json.dumps({"tokens": tail}).encode()
+                            + b"\n")
+                        delivered.extend(tail)
+                    return "handoff"
                 # a resumed replica's terminal result is already the
                 # full concatenation (its prompt included the
                 # delivered prefix); tag splices for observability
@@ -821,13 +1161,16 @@ class FleetRouter(Logger):
         already flowing: the WORST measured queue-wait overshoot any
         replica reports (``SloShedder.overshoot`` via ``/health``),
         the fleet-wide shed total (replica ``serve.shed`` rejections
-        plus the router's own all-shed 503s), and whether any replica
-        still holds queued/in-flight work (the idle signal for
-        scale-down)."""
+        plus the router's own all-shed 503s), the summed
+        queued-but-unprefilled prompt-token backlog (each replica's
+        ``queued_prefill_tokens`` — the EARLY scale-up signal: a
+        prefill backlog predicts the queue-wait breach before the
+        shedder can measure it), and whether any replica still holds
+        queued/in-flight work (the idle signal for scale-down)."""
         with self._lock:
             reps = list(self._replicas.values())
             shed_total = int(self._counters["shed_rejects"])
-        overshoot, busy, live = 0.0, False, 0
+        overshoot, busy, live, backlog = 0.0, False, 0, 0
         for rep in reps:
             if rep.state == Replica.UP:
                 live += 1
@@ -842,10 +1185,15 @@ class FleetRouter(Logger):
                 shed_total += int(serving.get("shed_total") or 0)
             except (TypeError, ValueError):
                 pass
+            try:
+                backlog += int(h.get("queued_prefill_tokens") or 0)
+            except (TypeError, ValueError):
+                pass
             if h.get("queued") or h.get("in_flight"):
                 busy = True
         return {"overshoot": overshoot, "shed_total": shed_total,
-                "busy": busy, "live": live}
+                "prefill_backlog": backlog, "busy": busy,
+                "live": live}
 
     # ------------------------------------------------------------ metrics
     def metrics(self):
@@ -861,7 +1209,9 @@ class FleetRouter(Logger):
                "sessions": sessions, "counters": counters,
                "affinity": self.affinity,
                "retry_max": self.retry_max,
-               "health_interval_ms": self.health_interval_s * 1e3}
+               "health_interval_ms": self.health_interval_s * 1e3,
+               "placement": self.placement,
+               "cost": self.cost.status()}
         if fleet is not None:
             out["fleet"] = fleet
         return out
@@ -902,7 +1252,8 @@ class FleetRouter(Logger):
                     body = self.rfile.read(length)
                     if self.path == router.path + "/register":
                         req = json.loads(body)
-                        rid = router.register(req["url"])
+                        rid = router.register(req["url"],
+                                              role=req.get("role"))
                         self._send_json(200, {"replica": rid})
                         return
                     if self.path == router.path + "/deregister":
@@ -969,7 +1320,7 @@ class FleetRouter(Logger):
                                         send_headers, write_line)
                     return
                 status, payload, headers = router.route_buffered(
-                    body, session=session)
+                    body, session=session, parsed=parsed)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))

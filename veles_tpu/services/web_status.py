@@ -545,10 +545,12 @@ class WebStatusServer(Logger):
             # replica's dashboard answers "which fleet slot is this"
             host = os.environ.get("VELES_TPU_FLEET_HOST")
             rep = os.environ.get("VELES_TPU_FLEET_REP")
+            role = os.environ.get("VELES_TPU_REPLICA_ROLE")
             if host is not None or rep is not None:
                 state["fleet"] = {
                     "host": None if host is None else int(host),
-                    "replica": None if rep is None else int(rep)}
+                    "replica": None if rep is None else int(rep),
+                    "role": role}
         except Exception:   # noqa: BLE001 — the probe must answer
             pass
         return state
