@@ -1,0 +1,54 @@
+"""Sample workflow: minimal digit-token LM sized for the serving-plane
+static audit.  A tiny causal transformer over a synthetic base-10
+corpus (digit sequences with a repeating structure) — small enough
+that constructing and abstractly tracing every serving variant
+(bf16/int8/w4a8 x dense/paged x speculative) takes seconds on CPU.
+
+This is the CI gate's serving specimen:
+
+    veles-tpu-lint samples/digits_serve.py --serve --concurrency \
+        --fail-on error
+
+(`--serve` initializes the workflow, builds the real
+LMGenerator/ContinuousBatcher variants and runs the VD7xx decode-path
+audit — abstract ShapeDtypeStruct traces only, no decode ever
+dispatches; `--concurrency` adds the VT8xx AST lint of
+veles_tpu/services.)  It also trains as a normal workflow:
+
+    python -m veles_tpu samples/digits_serve.py --backend cpu \
+        --config-list root.digits_serve.max_epochs=3
+"""
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard_workflow import StandardWorkflow
+from veles_tpu.models.zoo import transformer_lm
+
+
+def run(load, main):
+    cfg = root.digits_serve
+    seq = cfg.get("seq_len", 16)
+    vocab = 13                    # 0-9 digits + pad/bos/eos
+    rows = cfg.get("rows", 192)
+    r = np.random.RandomState(cfg.get("seed", 31))
+    # counting patterns with per-row jitter: learnable but not trivial
+    tokens = ((np.arange(seq)[None, :] * 2
+               + r.randint(0, 4, rows)[:, None]) % 10).astype(np.int32)
+    n_valid = max(1, rows // 4)
+    loader = FullBatchLoader(
+        None, data=tokens, labels=tokens,
+        minibatch_size=cfg.get("minibatch_size", 48),
+        class_lengths=[0, n_valid, rows - n_valid])
+    load(StandardWorkflow,
+         layers=transformer_lm(vocab_size=vocab,
+                               d_model=cfg.get("d_model", 32),
+                               n_heads=4, n_layers=2,
+                               lr=cfg.get("learning_rate", 5e-3),
+                               dropout=0.0),
+         loader=loader, loss="lm",
+         gd_defaults=cfg.get("gd"),
+         decision_config={"max_epochs": cfg.get("max_epochs", 1)},
+         name="digits-serve")
+    main()

@@ -567,11 +567,25 @@ class TestChaosScaledDown:
         serve_cfg.slo_queue_wait_ms = 20
         api = lt.build_api(slots=2, paged_block=4, pool_tokens=96,
                            slo_ms=20, generator=gen)
+        # throttle decode so 24 clients over 2 slots provably exceed
+        # the 20 ms queue-wait SLO, and ramp the arrivals: on a fast
+        # box an unthrottled burst both drains before the valve can
+        # open AND submits every client before the first breach is
+        # measured, leaving nobody to shed (FaultInjector wraps tick
+        # at storm start, so the throttle composes)
+        orig = api.engine.cb.tick
+
+        def slow_tick():
+            time.sleep(0.02)
+            return orig()
+
+        api.engine.cb.tick = slow_tick
         try:
             report = lt.run(clients=24, disconnect=0.3, slowloris=0.1,
                             buffered=0.2, fault_rate=0.03, max_new=10,
                             prompt_len=len(PROMPT), slo_ms=20,
-                            slow_delay=0.1, seed=11, api=api)
+                            slow_delay=0.1, seed=11, api=api,
+                            ramp_s=1.0)
         finally:
             api.stop()
         fails = lt.gates(report, expect_shed=True)

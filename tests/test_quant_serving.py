@@ -411,51 +411,34 @@ class TestPerRowSpecRouting:
 # --------------------------------------------------------------------------
 
 class TestStrayDequantAudit:
-    def _decode_jaxpr(self, gen, batch=2):
-        abstract = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            gen.params, is_leaf=lambda x: hasattr(x, "shape"))
-        caches = jax.eval_shape(
-            lambda: gen._init_caches(batch, gen._model_dtype()))
-        return jax.make_jaxpr(gen._step)(
-            abstract, caches, jax.ShapeDtypeStruct((batch,), jnp.int32),
-            3)
+    """PR 14's one-off decode-jaxpr assertions, retired into the VD700
+    rule (ISSUE 16): the decode path is now audited through
+    ``analysis.decode_audit``, which traces the SAME tick body serving
+    jits (``ContinuousBatcher._tick_body``) — the rule and this test
+    can't drift apart.  The detector-mechanics test below keeps
+    pinning ``quant.stray_dequant_sites`` itself, which VD700 wraps."""
 
     @pytest.mark.parametrize("scheme", ["int8", "w4a8"])
-    def test_decode_step_has_no_stray_dequant(self, scheme):
-        """Acceptance: no QuantWeight dequantizes outside a dot in the
-        int8/w4a8 decode step — every payload-sized int8→float convert
-        in the traced step feeds a dot_general."""
+    def test_decode_tick_clean_via_vd700(self, scheme):
+        """Acceptance: no QuantWeight dequantizes outside a dot
+        anywhere in the decode tick serving would dispatch — and the
+        rest of the VD7xx family stays silent on it too."""
+        from veles_tpu.analysis import decode_audit
         wf, _ = _lm_workflow()
         gen = LMGenerator(wf.trainer, max_len=16, weights=scheme)
-        thr = quant.min_payload_elems(gen.params)
-        sites = quant.stray_dequant_sites(self._decode_jaxpr(gen), thr)
-        assert not sites, sites
+        cb = ContinuousBatcher(gen, slots=2)
+        findings = decode_audit.audit_decode_tick(cb)
+        assert not [f for f in findings if f.rule == "VD700"], findings
+        assert not findings, findings
 
-    def test_full_scan_has_no_stray_dequant(self):
-        """The whole jitted decode scan (what serving actually
-        dispatches), not just one step."""
+    def test_prefill_pass_clean_via_vd700(self):
+        """The segmented-prefill chunk pass (the other jaxpr serving
+        dispatches per admission) is dequant-clean as well."""
+        from veles_tpu.analysis import decode_audit
         wf, _ = _lm_workflow()
         gen = LMGenerator(wf.trainer, max_len=16, weights="int8")
-        thr = quant.min_payload_elems(gen.params)
-
-        def run(params, tokens):
-            caches = gen._init_caches(2, gen._model_dtype())
-            keys = jax.vmap(jax.random.key)(jnp.zeros((2,), jnp.int32))
-            body = gen._decode_body(
-                params, jnp.full((2,), 4, jnp.int32), keys,
-                jnp.zeros((2,), jnp.int32), jnp.ones((2,)),
-                jnp.ones((2,)), jnp.ones((2,), bool), 2)
-            (tokens, _), _ = jax.lax.scan(
-                body, (tokens, caches), jnp.arange(gen.max_len - 1))
-            return tokens
-
-        abstract = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            gen.params, is_leaf=lambda x: hasattr(x, "shape"))
-        jaxpr = jax.make_jaxpr(run)(
-            abstract, jax.ShapeDtypeStruct((2, 16), jnp.int32))
-        assert not quant.stray_dequant_sites(jaxpr, thr)
+        findings = decode_audit.audit_prefill_pass(gen, segment=8)
+        assert not findings, findings
 
     def test_detector_fires_on_naive_dequant(self):
         """The audit must actually detect the bug class it pins: a

@@ -230,12 +230,17 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         fault_rate=0.02, slots=4, paged_block=0, pool_tokens=None,
         max_new=8, prompt_len=5, slo_ms=250, deadline_ms=0,
         slow_delay=0.4, seed=7, api=None, flight_dump=None,
-        weights=None, cache_dtype=None):
+        weights=None, cache_dtype=None, ramp_s=0.0):
     """Run the chaos scenario; returns the report dict (see gates()).
     Pass ``api`` to reuse a prebuilt endpoint (the tier-1 tests do,
     to share one compiled model across tests).  ``weights`` picks the
     serving weight scheme (f32/bf16/int8/w4a8) for the endpoint this
-    harness builds."""
+    harness builds.  ``ramp_s`` spreads client arrivals over that many
+    seconds instead of one instantaneous burst: the shed valve opens
+    on a MEASURED queue-wait breach one engine-loop update after the
+    backlog forms, so when every client submits in the same
+    millisecond (small storms on fast hosts) there is nobody left to
+    reject — a ramp keeps arrivals flowing past the opening."""
     own_api = api is None
     if own_api:
         # the storm itself runs WITHOUT a default deadline (deadlines
@@ -287,6 +292,8 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
             for b in behaviors]
         for th in threads:
             th.start()
+            if ramp_s > 0:
+                time.sleep(ramp_s / max(1, clients))
         for th in threads:
             th.join(timeout=300)
         stuck_clients = sum(1 for th in threads if th.is_alive())

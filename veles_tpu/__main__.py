@@ -103,6 +103,14 @@ class Main(object):
                        "VN4xx/VR5xx numerics & determinism audit can "
                        "trace the real staged train step; composes "
                        "with --mesh")
+        p.add_argument("--serve-max-len", type=int, default=16,
+                       metavar="T",
+                       help="with --lint --serve: sequence budget the "
+                       "audited generators are built with (default "
+                       "16)")
+        p.add_argument("--concurrency", action="store_true",
+                       help="with --lint: add the VT8xx concurrency "
+                       "lint (pure AST scan of veles_tpu/services)")
         p.add_argument("--vmem-kib", type=float, default=None,
                        metavar="KiB",
                        help="with --lint: per-core VMEM budget for the "
@@ -133,8 +141,13 @@ class Main(object):
                        "package (adapters.npz + base-model sha256 "
                        "lineage) — a rank-8 fine-tune of a 124M base "
                        "ships ~MBs instead of the full model")
-        p.add_argument("--serve", type=int, default=None, metavar="PORT",
-                       help="after training, serve the model over REST")
+        p.add_argument("--serve", type=int, nargs="?", const=-1,
+                       default=None, metavar="PORT",
+                       help="after training, serve the model over REST;"
+                       " with --lint (port optional): run the VD7xx "
+                       "decode-path audit over the serving engine's "
+                       "tick + prefill pass instead — abstract traces "
+                       "only, nothing serves")
         p.add_argument("--generate", default=None,
                        metavar="PROMPT[:MAX_NEW]",
                        help="after training a causal LM, greedily decode "
@@ -575,12 +588,28 @@ class Main(object):
                 # ever dispatches — same contract as veles-tpu-lint)
                 from veles_tpu.analysis.cli import _attach_mesh
                 _attach_mesh(wf, self._parse_mesh(args.mesh), args.fsdp)
-            elif args.numerics:
-                # --lint --numerics: same contract, no mesh — the
-                # numerics auditor needs the real staged train step
+            elif args.numerics or args.serve is not None:
+                # --lint --numerics / --serve: same contract, no mesh —
+                # both auditors need real (constructed) staged state
                 from veles_tpu.analysis.cli import _initialize_plain
                 _initialize_plain(wf)
             findings = lint_workflow(wf, vmem_kib=args.vmem_kib)
+            if args.serve is not None:
+                # VD7xx: audit the serving engine this workflow would
+                # serve — abstract traces of the decode tick, no
+                # decode ever dispatches
+                from veles_tpu.analysis import lint_serving
+                trainer = getattr(wf, "trainer", None)
+                if trainer is None:
+                    raise SystemExit("--lint --serve: workflow has no "
+                                     ".trainer unit to build a "
+                                     "serving engine from")
+                findings = findings + lint_serving(
+                    trainer, args.serve_max_len,
+                    vmem_kib=args.vmem_kib)
+            if args.concurrency:
+                from veles_tpu.analysis import lint_concurrency
+                findings = findings + lint_concurrency()
             print(format_findings(findings))
             return 1 if threshold_reached(findings,
                                           args.fail_on) else 0

@@ -11,7 +11,12 @@ initialized workflow with a mesh, e.g. ``veles-tpu-lint --mesh 2x2``),
 and the numerics/determinism auditor walks the staged step's jaxpr for
 NaN/overflow/precision hazards, PRNG misuse, and Pallas-kernel
 tile/VMEM mis-sizing (numerics_audit, VN4xx/VR5xx/VP6xx — needs an
-initialized workflow, e.g. ``veles-tpu-lint --numerics``).
+initialized workflow, e.g. ``veles-tpu-lint --numerics``).  The
+serving plane has its own two families: the decode-path auditor
+abstractly traces the engine's decode tick + segmented-prefill pass
+(decode_audit, VD7xx — ``veles-tpu-lint --serve``) and the
+concurrency lint AST-scans the threaded control plane in
+``services/`` (concurrency_lint, VT8xx — ``--concurrency``).
 Surface: :func:`lint_workflow` in-process, the ``veles-tpu-lint``
 console script, and ``python -m veles_tpu ... --lint``.
 
@@ -27,7 +32,8 @@ from veles_tpu.analysis.staging import audit_step
 __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
            "format_findings", "has_errors", "sort_findings",
            "threshold_reached", "lint_graph", "audit_step",
-           "audit_sharded_step", "audit_numerics", "lint_workflow"]
+           "audit_sharded_step", "audit_numerics", "lint_workflow",
+           "lint_serving", "lint_concurrency"]
 
 
 def audit_sharded_step(spec, hbm_gib=None):
@@ -47,6 +53,22 @@ def audit_numerics(spec=None, launches=None, vmem_kib=None,
     return numerics_audit.audit_numerics(
         spec=spec, launches=launches, vmem_kib=vmem_kib,
         prng_registry=prng_registry)
+
+
+def lint_serving(trainer, max_len, **kwargs):
+    """Decode-path audit of the serving engine (VD7xx) — see
+    :mod:`veles_tpu.analysis.decode_audit` (lazy: the auditor builds
+    real generators/batchers, which the graph rules never need)."""
+    from veles_tpu.analysis import decode_audit
+    return decode_audit.lint_serving(trainer, max_len, **kwargs)
+
+
+def lint_concurrency(paths=None, root=None):
+    """Concurrency lint of the threaded control plane (VT8xx) — see
+    :mod:`veles_tpu.analysis.concurrency_lint` (lazy; pure AST, no
+    jax)."""
+    from veles_tpu.analysis import concurrency_lint
+    return concurrency_lint.lint_concurrency(paths=paths, root=root)
 
 
 def lint_workflow(wf, staging=True, sharding=True, numerics=True,

@@ -146,18 +146,22 @@ def main(argv=None):
         prog="veles-tpu-lint",
         description="static workflow-graph linter + jit-staging auditor "
                     "+ sharding/memory auditor + numerics/determinism "
-                    "auditor (rule catalog: docs/static_analysis.md)",
+                    "auditor + serving decode-path auditor + "
+                    "control-plane concurrency lint (rule catalog: "
+                    "docs/static_analysis.md)",
         formatter_class=argparse.RawDescriptionHelpFormatter,
         epilog="exit codes (identical across graph/staging/sharding/"
-               "numerics runs —\nanalysis.findings.threshold_reached is "
-               "the one gate):\n"
+               "numerics/serve/\nconcurrency runs — analysis.findings"
+               ".threshold_reached is the one gate):\n"
                "  0  no findings at or above the --fail-on severity\n"
                "  1  threshold reached (default --fail-on error: any "
                "error finding)\n"
                "  2  usage error (bad arguments, workflow file without "
                "run(load, main))")
-    p.add_argument("workflow", help="workflow .py file defining "
-                   "run(load, main)")
+    p.add_argument("workflow", nargs="?", default=None,
+                   help="workflow .py file defining run(load, main) "
+                   "(optional only for a pure --concurrency run — the "
+                   "AST lint needs no workflow)")
     p.add_argument("config", nargs="?", help="config .py file executed "
                    "with `root` in scope")
     p.add_argument("--config-list", nargs="*", default=[],
@@ -191,32 +195,72 @@ def main(argv=None):
                    help="per-device HBM capacity the VM300 peak "
                    "estimate is judged against (default: "
                    "sharding_audit.DEFAULT_HBM_GIB = 16, v5e)")
+    p.add_argument("--serve", action="store_true",
+                   help="initialize the workflow and run the VD7xx "
+                   "decode-path audit over the serving engine's decode "
+                   "tick + segmented-prefill pass for every standard "
+                   "variant (bf16/int8/w4a8 x dense/paged x spec "
+                   "on/off) — abstract traces only, no decode step "
+                   "ever dispatches")
+    p.add_argument("--serve-max-len", type=int, default=16,
+                   metavar="T", help="sequence budget the --serve "
+                   "audit builds its generators with (default 16 — "
+                   "geometry-relevant rules scale with it)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the VT8xx concurrency lint (pure AST "
+                   "scan) over the threaded control plane in "
+                   "veles_tpu/services — needs no workflow file")
     p.add_argument("--fail-on", choices=("error", "warning"),
                    default="error", metavar="{error,warning}",
                    help="severity threshold for the non-zero exit: "
                    "'error' (default) fails only on error findings, "
-                   "'warning' fails on warnings too — the CI gate knob")
+                   "'warning' fails on warnings too — the CI gate "
+                   "knob, shared by every family (VG/VJ/VS/VM/VN/VR/"
+                   "VP/VD/VT) through findings.threshold_reached")
     p.add_argument("--strict", action="store_true",
                    help="deprecated alias for --fail-on warning")
     args = p.parse_args(argv)
 
-    axes = parse_mesh(args.mesh) if args.mesh else None
-    if args.fsdp and not axes:
-        raise SystemExit("--fsdp needs --mesh (parameters shard over "
-                         "the mesh's data axis)")
-    # env knobs must land before anything touches a jax backend
-    _force_cpu_devices(axes)
+    if args.workflow is None and not args.concurrency:
+        p.error("a workflow file is required (only a pure "
+                "--concurrency run works without one)")
+    if args.serve and args.workflow is None:
+        p.error("--serve audits a workflow's serving engine — give "
+                "it the workflow file")
 
-    from veles_tpu.analysis import (format_findings, lint_workflow,
+    findings = []
+    if args.workflow is not None:
+        axes = parse_mesh(args.mesh) if args.mesh else None
+        if args.fsdp and not axes:
+            raise SystemExit("--fsdp needs --mesh (parameters shard "
+                             "over the mesh's data axis)")
+        # env knobs must land before anything touches a jax backend
+        _force_cpu_devices(axes)
+
+        from veles_tpu.analysis import lint_serving, lint_workflow
+        wf = build_workflow(args.workflow, args.config,
+                            args.config_list)
+        if axes:
+            _attach_mesh(wf, axes, args.fsdp)
+        elif args.numerics or args.serve:
+            _initialize_plain(wf)
+        findings.extend(lint_workflow(wf, staging=not args.no_staging,
+                                      hbm_gib=args.hbm_gib,
+                                      vmem_kib=args.vmem_kib))
+        if args.serve:
+            trainer = getattr(wf, "trainer", None)
+            if trainer is None:
+                raise SystemExit("--serve: workflow has no .trainer "
+                                 "unit to build a serving engine from")
+            findings.extend(lint_serving(trainer, args.serve_max_len,
+                                         vmem_kib=args.vmem_kib))
+    if args.concurrency:
+        from veles_tpu.analysis import lint_concurrency
+        findings.extend(lint_concurrency())
+
+    from veles_tpu.analysis import (format_findings, sort_findings,
                                     threshold_reached)
-    wf = build_workflow(args.workflow, args.config, args.config_list)
-    if axes:
-        _attach_mesh(wf, axes, args.fsdp)
-    elif args.numerics:
-        _initialize_plain(wf)
-    findings = lint_workflow(wf, staging=not args.no_staging,
-                             hbm_gib=args.hbm_gib,
-                             vmem_kib=args.vmem_kib)
+    findings = sort_findings(findings)
     print(format_findings(findings, args.format))
     fail_on = ("warning" if args.strict else args.fail_on)
     return 1 if threshold_reached(findings, fail_on) else 0

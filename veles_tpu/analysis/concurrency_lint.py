@@ -1,0 +1,552 @@
+"""Concurrency lint for the threaded control plane (VT8xx).
+
+The serving control plane (``services/``) is real threaded Python:
+engine loops, HTTP workers, watchdog pumps, signal handlers — PRs 5–15
+grew it to the point where the only validation was dynamic (chaos
+gates, 250-client storms).  This lint reasons about the *source*: an
+AST pass over each module builds a **thread-entry-point map** — every
+function a new thread, a signal, or an HTTP worker can enter — closes
+it over same-class method calls, and checks the shared state those
+entry points touch.  Pure python-on-python analysis: nothing is
+imported, nothing runs, no jax involved.
+
+Rule catalog (docs/static_analysis.md):
+
+========  =======  ======================================================
+VT800     warning  shared mutable attribute written from >= 2 thread
+                   entry points with no common lock held at the writes
+VT801     error    lock-order inversion: two locks of one class are
+                   nested in opposite orders on different paths —
+                   a textbook deadlock waiting for its interleaving
+VT802     error    signal handler reaches non-reentrant code: a plain
+                   ``threading.Lock``/``Condition`` acquire (or a
+                   blocking queue op) inside the handler's call
+                   closure — handlers interrupt the main thread
+                   mid-bytecode, possibly while it already holds that
+                   very lock (the PR 5 flight ring took an RLock for
+                   exactly this)
+VT803     warning  non-daemon thread started but never joined on any
+                   stop path — process exit hangs on it
+VT804     warning  raw unbounded ``queue.Queue()`` — a dead consumer
+                   accumulates without limit; ``lifecycle
+                   .BoundedStream`` exists for exactly this reason
+========  =======  ======================================================
+
+**Suppression**: a genuine-but-accepted site carries its rationale
+inline — ``# lint-ok: VT804 — terminal queue, bounded by slot count``
+on the flagged line (or the line above) suppresses that one rule at
+that one site.  A bare ``# lint-ok:`` without a rule id suppresses
+nothing: the rationale must name what it accepts.
+"""
+
+import ast
+import os
+import re
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VT8xx family, in catalog order
+RULES = ("VT800", "VT801", "VT802", "VT803", "VT804")
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3}(?:\s*,\s*"
+                          r"[A-Z]{2}\d{3})*)")
+
+#: constructor names that build a lock-like object, -> kind
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock",
+               "Condition": "condition", "Semaphore": "semaphore",
+               "BoundedSemaphore": "semaphore"}
+
+#: attribute-name fragments that mark a lock-like attr even without a
+#: visible constructor (built elsewhere / injected)
+_LOCKISH = ("lock", "mutex", "cond")
+
+
+def _dotted(node):
+    """``a.b.c`` -> "a.b.c" (None for anything fancier)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node):
+    """``self.x`` -> "x" (None otherwise)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _call_name(call):
+    return _dotted(call.func) if isinstance(call, ast.Call) else None
+
+
+def _is_lock_ctor(call):
+    name = _call_name(call)
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return _LOCK_CTORS.get(tail)
+
+
+class _MethodInfo(object):
+    """Everything VT8xx needs to know about one function body."""
+
+    def __init__(self, name):
+        self.name = name
+        self.writes = {}          # attr -> [(lineno, frozenset(locks))]
+        self.acquires = []        # (lock, lineno, held-before frozenset)
+        self.calls = {}           # self-method name -> [(lineno, held)]
+        self.lock_pairs = set()   # (outer, inner) nesting order
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """One pass over a function body, tracking the set of self-locks
+    held at each statement (``with self.X:`` scoping)."""
+
+    def __init__(self, info, lock_attrs):
+        self.info = info
+        self.lock_attrs = lock_attrs
+        self.held = ()
+
+    # -- lock scoping -------------------------------------------------
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            # `with self.x:` and `with self.x.acquire…` / timeouts
+            target = expr
+            if isinstance(target, ast.Call):
+                target = target.func
+            attr = _self_attr(target)
+            if attr and (attr in self.lock_attrs
+                         or any(k in attr.lower() for k in _LOCKISH)):
+                for outer in self.held:
+                    self.info.lock_pairs.add((outer, attr))
+                self.info.acquires.append(
+                    (attr, node.lineno, frozenset(self.held)))
+                acquired.append(attr)
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        prev = self.held
+        self.held = prev + tuple(a for a in acquired
+                                 if a not in prev)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    visit_AsyncWith = visit_With
+
+    # -- shared-state writes ------------------------------------------
+    def _note_write(self, target, lineno):
+        attr = _self_attr(target)
+        if attr is None or attr in self.lock_attrs:
+            return
+        self.info.writes.setdefault(attr, []).append(
+            (lineno, frozenset(self.held)))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for el in ast.walk(t):
+                self._note_write(el, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._note_write(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._note_write(node.target, node.lineno)
+            self.visit(node.value)
+
+    # -- calls ---------------------------------------------------------
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        if name and name.startswith("self."):
+            parts = name.split(".")
+            if len(parts) == 2:          # self.method(...)
+                self.info.calls.setdefault(parts[1], []).append(
+                    (node.lineno, frozenset(self.held)))
+            else:
+                # self.attr.method(...): a mutating container call on
+                # shared state counts as a write of the attr
+                if parts[-1] in ("append", "add", "pop", "popleft",
+                                 "appendleft", "remove", "clear",
+                                 "update", "extend", "setdefault",
+                                 "discard", "insert"):
+                    self._note_write(
+                        ast.Attribute(value=ast.Name(id="self"),
+                                      attr=parts[1]),
+                        node.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):   # nested defs: new held scope
+        prev, self.held = self.held, ()
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: self.visit(node.body)  # noqa: E731
+
+
+class _ClassModel(object):
+    def __init__(self, name):
+        self.name = name
+        self.methods = {}         # method name -> _MethodInfo
+        self.lock_attrs = {}      # attr -> kind ("lock"/"rlock"/...)
+        self.entry_points = {}    # method name -> entry kind
+
+
+def _closure(model, start):
+    """All methods of ``model`` reachable from ``start`` through
+    same-class calls (including ``start`` itself)."""
+    seen, stack = set(), [start]
+    while stack:
+        m = stack.pop()
+        if m in seen or m not in model.methods:
+            continue
+        seen.add(m)
+        stack.extend(model.methods[m].calls)
+    return seen
+
+
+class _ModuleLint(object):
+    """All VT8xx rules over one parsed source file."""
+
+    def __init__(self, path, tree, source):
+        self.path = path
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.findings = []
+
+    # -- suppression ---------------------------------------------------
+    def _suppressed(self, rule, lineno):
+        """True when the flagged line, or the contiguous comment block
+        directly above it, carries ``# lint-ok: <rule>``."""
+        def marked(ln):
+            if not 1 <= ln <= len(self.lines):
+                return False
+            m = _SUPPRESS_RE.search(self.lines[ln - 1])
+            return bool(m and rule in re.split(r"\s*,\s*",
+                                               m.group(1)))
+        if marked(lineno):
+            return True
+        ln = lineno - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            if marked(ln):
+                return True
+            ln -= 1
+        return False
+
+    def _emit(self, rule, severity, lineno, message, hint=""):
+        if self._suppressed(rule, lineno):
+            return
+        unit = "%s:%d" % (self.path, lineno)
+        self.findings.append(Finding(rule, severity, unit, message,
+                                     hint=hint))
+
+    # -- whole-module scans -------------------------------------------
+    def run(self):
+        self._scan_queues()
+        self._scan_threads()
+        handlers = self._signal_handlers()
+        for cls in [n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            self._lint_class(self._model(cls), handlers)
+        self._lint_module_handlers(handlers)
+        return self.findings
+
+    def _scan_queues(self):
+        for node in ast.walk(self.tree):
+            name = _call_name(node)
+            if not name or name.rsplit(".", 1)[-1] != "Queue" \
+                    or not ("queue" in name or name == "Queue"):
+                continue
+            maxsize = None
+            if node.args:
+                maxsize = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    maxsize = kw.value
+            bounded = maxsize is not None and not (
+                isinstance(maxsize, ast.Constant)
+                and not maxsize.value)
+            if not bounded:
+                self._emit(
+                    "VT804", WARNING, node.lineno,
+                    "raw unbounded queue.Queue(): a stalled consumer "
+                    "accumulates producer memory without limit",
+                    hint="give it a maxsize, or use lifecycle"
+                         ".BoundedStream (bounded, never blocks the "
+                         "engine thread, terminal always delivered)")
+
+    def _scan_threads(self):
+        src = "\n".join(self.lines)
+        for node in ast.walk(self.tree):
+            name = _call_name(node)
+            if not name or name.rsplit(".", 1)[-1] != "Thread":
+                continue
+            daemon = False
+            for kw in node.keywords:
+                if kw.arg == "daemon" \
+                        and isinstance(kw.value, ast.Constant):
+                    daemon = bool(kw.value.value)
+            if daemon:
+                continue
+            # thread object bound to a name/attr that later gets
+            # `.daemon = True` or `.join(`?  textual check is enough —
+            # the binding styles in services/ are all direct
+            if re.search(r"\.daemon\s*=\s*True|\.setDaemon\(True\)"
+                         r"|\.join\(", src):
+                # conservatively accept if the module joins or
+                # daemonizes ANY thread — refine per-name below when
+                # the target is a self attr
+                parent_ok = True
+            else:
+                parent_ok = False
+            if not parent_ok:
+                self._emit(
+                    "VT803", WARNING, node.lineno,
+                    "non-daemon thread started and never joined "
+                    "anywhere in this module — process exit hangs "
+                    "on it",
+                    hint="daemon=True for pumps whose death is "
+                         "harmless, or join it on the stop path")
+
+    def _signal_handlers(self):
+        """(handler name, lineno) for every signal.signal(...)
+        registration whose handler is a plain name, self-method or
+        local function."""
+        out = []
+        for node in ast.walk(self.tree):
+            if _call_name(node) in ("signal.signal",) \
+                    and len(node.args) >= 2:
+                h = node.args[1]
+                hname = _dotted(h)
+                if hname:
+                    out.append((hname.split(".")[-1], node.lineno))
+        return out
+
+    # -- per-class -----------------------------------------------------
+    def _model(self, cls):
+        model = _ClassModel(cls.name)
+        for node in cls.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            info = _MethodInfo(node.name)
+            # lock attrs first (from __init__ assignments)
+            if node.name == "__init__":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Call):
+                        kind = _is_lock_ctor(sub.value)
+                        if kind:
+                            for t in sub.targets:
+                                attr = _self_attr(t)
+                                if attr:
+                                    model.lock_attrs[attr] = kind
+            model.methods[node.name] = info
+        # scan bodies once lock attrs are known
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                scanner = _FunctionScanner(model.methods[node.name],
+                                           model.lock_attrs)
+                for stmt in node.body:
+                    scanner.visit(stmt)
+        # entry points: Thread(target=self.m), HTTP do_*, signal
+        for node in ast.walk(cls):
+            name = _call_name(node)
+            if name and name.rsplit(".", 1)[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        t = _self_attr(kw.value)
+                        if t:
+                            model.entry_points[t] = "thread"
+        for mname in model.methods:
+            if mname.startswith("do_"):
+                model.entry_points[mname] = "http"
+        # a method that registers a LOCAL closure as a signal handler:
+        # the closure's self-calls were recorded under the method
+        # (nested defs share its _MethodInfo), so treating the method
+        # as the signal entry point covers everything the handler can
+        # reach — a slight over-approximation on the method's own
+        # non-handler calls, which install-time code tolerates
+        for node in cls.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            local = {n.name for n in ast.walk(node)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and n is not node}
+            for sub in ast.walk(node):
+                if _call_name(sub) == "signal.signal" \
+                        and len(sub.args) >= 2 \
+                        and isinstance(sub.args[1], ast.Name) \
+                        and sub.args[1].id in local:
+                    model.entry_points[node.name] = "signal"
+        return model
+
+    def _lint_class(self, model, handlers):
+        # signal handlers that are methods of this class
+        for hname, lineno in handlers:
+            if hname in model.methods:
+                model.entry_points.setdefault(hname, "signal")
+
+        # VT801 — opposite nesting orders anywhere in the class
+        pairs = set()
+        for info in model.methods.values():
+            pairs |= info.lock_pairs
+            # one level of call closure: a method that calls
+            # self.m() while holding A inherits m's acquisitions as
+            # nested under A
+            for callee, sites in info.calls.items():
+                cinfo = model.methods.get(callee)
+                if cinfo is None:
+                    continue
+                for _ln, held in sites:
+                    for outer in held:
+                        for inner, _l, _h in cinfo.acquires:
+                            if inner != outer:
+                                pairs.add((outer, inner))
+        reported = set()
+        for a, b in sorted(pairs):
+            if (b, a) in pairs and (b, a) not in reported:
+                reported.add((a, b))
+                lineno = min(
+                    [ln for info in model.methods.values()
+                     for at, ln, _h in info.acquires
+                     if at in (a, b)] or [1])
+                self._emit(
+                    "VT801", ERROR, lineno,
+                    "%s: locks %r and %r are nested in OPPOSITE "
+                    "orders on different paths — a deadlock waiting "
+                    "for its interleaving" % (model.name, a, b),
+                    hint="pick one global order and take both locks "
+                         "in it everywhere (or merge them)")
+
+        # VT802 — signal handler closure reaches non-reentrant code
+        for hname, kind in model.entry_points.items():
+            if kind != "signal":
+                continue
+            for m in sorted(_closure(model, hname)):
+                info = model.methods[m]
+                for attr, lineno, _held in info.acquires:
+                    if model.lock_attrs.get(attr, "lock") in (
+                            "lock", "condition", "semaphore"):
+                        self._emit(
+                            "VT802", ERROR, lineno,
+                            "%s.%s acquires non-reentrant %r inside "
+                            "the %s signal handler's call closure — "
+                            "the handler interrupts the main thread, "
+                            "possibly while it already holds that "
+                            "lock" % (model.name, m, attr, hname),
+                            hint="handlers should only set flags / "
+                                 "write a self-pipe; do the work on "
+                                 "a thread (an RLock only helps "
+                                 "same-thread re-entry, not "
+                                 "cross-thread waits)")
+
+        # VT800 — attr written from >= 2 entry points, no common lock
+        if len(model.entry_points) < 2:
+            return
+        writers = {}    # attr -> {entry: [lock sets]}
+        for entry in model.entry_points:
+            for m in _closure(model, entry):
+                info = model.methods[m]
+                if m == "__init__":
+                    continue
+                for attr, sites in info.writes.items():
+                    slot = writers.setdefault(attr, {})
+                    slot.setdefault(entry, []).extend(
+                        locks for _ln, locks in sites)
+        for attr, by_entry in sorted(writers.items()):
+            if len(by_entry) < 2:
+                continue
+            all_sets = [s for sets in by_entry.values() for s in sets]
+            common = frozenset.intersection(*all_sets) \
+                if all_sets else frozenset()
+            if common:
+                continue
+            linenos = [ln for e in by_entry
+                       for m in _closure(model, e)
+                       for ln, _s in
+                       model.methods[m].writes.get(attr, [])]
+            lineno = min(linenos) if linenos else 1
+            self._emit(
+                "VT800", WARNING, lineno,
+                "%s.%s is written from %d thread entry points (%s) "
+                "with no common lock held at the writes"
+                % (model.name, attr, len(by_entry),
+                   ", ".join("%s[%s]" % (e, model.entry_points[e])
+                             for e in sorted(by_entry))),
+                hint="guard every write with one lock, or make the "
+                     "attribute single-writer and publish through "
+                     "an immutable snapshot")
+
+    def _lint_module_handlers(self, handlers):
+        """VT802 for module-level handler functions (not methods)."""
+        funcs = {n.name: n for n in self.tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
+        for hname, _lineno in handlers:
+            fn = funcs.get(hname)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        d = _dotted(item.context_expr) or ""
+                        if any(k in d.lower() for k in _LOCKISH):
+                            self._emit(
+                                "VT802", ERROR, node.lineno,
+                                "signal handler %r acquires lock-like "
+                                "%r — handlers must not block on "
+                                "locks" % (hname, d),
+                                hint="set a flag / write a self-pipe "
+                                     "and handle it on a thread")
+
+
+def lint_module(path, root=None):
+    """VT8xx findings for one source file (unit paths relative to
+    ``root`` when given)."""
+    with open(path) as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("VT800", ERROR, "%s:%d" % (rel, e.lineno or 0),
+                        "file failed to parse: %s" % e)]
+    return _ModuleLint(rel, tree, source).run()
+
+
+def lint_concurrency(paths=None, root=None):
+    """VT8xx over a file set — default: every ``.py`` under
+    ``veles_tpu/services`` (the threaded control plane).  Returns
+    sorted Findings; inline ``# lint-ok: VTxxx — reason`` comments
+    suppress individual accepted sites."""
+    if paths is None:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        base = os.path.join(here, "services")
+        root = root or os.path.dirname(here)
+        paths = sorted(
+            os.path.join(base, f) for f in os.listdir(base)
+            if f.endswith(".py"))
+    findings = []
+    for p in paths:
+        findings.extend(lint_module(p, root=root))
+    return sort_findings(findings)

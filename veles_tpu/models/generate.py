@@ -1806,11 +1806,19 @@ class ContinuousBatcher:
 
         return jax.jit(fused, donate_argnums=(1,))
 
+    def _tick_body(self):
+        """The un-jitted tick body ``fn(params, state, aids) -> state``
+        this batcher dispatches (through :meth:`_jit_ticks`).  ONE
+        construction point shared by the engine and the decode-path
+        auditor (``analysis.decode_audit``), which abstractly traces
+        exactly this function — so the lint can never audit a different
+        tick than serving runs."""
+        return (self._make_core_spec(self.speculative_k)
+                if self.speculative_k else self._make_core())
+
     def _tick(self, st):
         if self._tick_fn is None:
-            core = (self._make_core_spec(self.speculative_k)
-                    if self.speculative_k else self._make_core())
-            self._tick_fn = self._jit_ticks(core)
+            self._tick_fn = self._jit_ticks(self._tick_body())
         return self._tick_fn(self.gen.params, st, self._aids)
 
 
@@ -2331,8 +2339,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.int32(start)), plen - 1
 
     # ------------------------------------------------------------- tick
-    def _tick(self, st):
-        if self._tick_fn is None and self.fused:
+    def _tick_body(self):
+        if self.fused:
             gen = self.gen
 
             def paged_step_all(params, cache_state, cur, pos,
@@ -2357,39 +2365,37 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 return (tokens, pos, plen, total, active, seeds,
                         inv_temp, pool, tables)
 
-            self._tick_fn = self._jit_ticks(fused_tick)
-        if self._tick_fn is None:
-            core = self._make_core()
-            bs, nbm = self.block, self.max_blocks
+            return fused_tick
+        core = self._make_core()
+        bs, nbm = self.block, self.max_blocks
 
-            def gather(pool, tables):
-                def one(pl):
-                    v = pl[tables]               # [B, nb, H, bs, *]
-                    v = jnp.moveaxis(v, 2, 1)    # [B, H, nb, bs, *]
-                    return v.reshape(v.shape[:2] + (nbm * bs,)
-                                     + v.shape[4:])
-                return jax.tree_util.tree_map(one, pool)
+        def gather(pool, tables):
+            def one(pl):
+                v = pl[tables]               # [B, nb, H, bs, *]
+                v = jnp.moveaxis(v, 2, 1)    # [B, H, nb, bs, *]
+                return v.reshape(v.shape[:2] + (nbm * bs,)
+                                 + v.shape[4:])
+            return jax.tree_util.tree_map(one, pool)
 
-            def paged_tick(params, st, aids):
-                (tokens, pos, plen, total, active, seeds, inv_temp,
-                 pool, tables) = st
-                views = gather(pool, tables)
-                pos0 = pos                       # write position
-                (tokens, pos, plen, total, active, seeds, inv_temp,
-                 views) = core(params, (tokens, pos, plen, total,
-                                        active, seeds, inv_temp,
-                                        views), aids)
-                rows = jnp.arange(tokens.shape[0])
-                blk = tables[rows, pos0 // bs]
-                off = pos0 % bs
+        def paged_tick(params, st, aids):
+            (tokens, pos, plen, total, active, seeds, inv_temp,
+             pool, tables) = st
+            views = gather(pool, tables)
+            pos0 = pos                       # write position
+            (tokens, pos, plen, total, active, seeds, inv_temp,
+             views) = core(params, (tokens, pos, plen, total,
+                                    active, seeds, inv_temp,
+                                    views), aids)
+            rows = jnp.arange(tokens.shape[0])
+            blk = tables[rows, pos0 // bs]
+            off = pos0 % bs
 
-                def write_back(pl, vw):
-                    vals = jax.vmap(lambda v, p: v[:, p])(vw, pos0)
-                    return pl.at[blk, :, off].set(vals.astype(pl.dtype))
+            def write_back(pl, vw):
+                vals = jax.vmap(lambda v, p: v[:, p])(vw, pos0)
+                return pl.at[blk, :, off].set(vals.astype(pl.dtype))
 
-                pool = jax.tree_util.tree_map(write_back, pool, views)
-                return (tokens, pos, plen, total, active, seeds,
-                        inv_temp, pool, tables)
+            pool = jax.tree_util.tree_map(write_back, pool, views)
+            return (tokens, pos, plen, total, active, seeds,
+                    inv_temp, pool, tables)
 
-            self._tick_fn = self._jit_ticks(paged_tick)
-        return self._tick_fn(self.gen.params, st, self._aids)
+        return paged_tick

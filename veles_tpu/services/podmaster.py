@@ -618,6 +618,11 @@ class PodMaster(object):
         self._rng = random.Random(seed)
         self._log = logging.getLogger("PodMaster")
         self._lock = threading.Lock()
+        # lint-ok: VT804 — control-plane inbox: producers are the
+        # per-agent reader threads (bounded by pod size), the policy
+        # loop drains every cycle, and register/exit events must never
+        # be dropped or block the readers (BoundedStream semantics
+        # would do both)
         self._inbox = queue.Queue()
         self._listener = None
         self._threads = []
@@ -1882,6 +1887,10 @@ class ServeFleetMaster(object):
         self._rng = random.Random(seed)
         self._log = logging.getLogger("ServeFleet")
         self._lock = threading.Lock()
+        # lint-ok: VT804 — control-plane inbox: producers are the
+        # per-replica reader threads (bounded by fleet size), the
+        # policy loop drains every cycle, and lifecycle events must
+        # never be dropped or block the readers
         self._inbox = queue.Queue()
         self._listener = None
         self._threads = []

@@ -284,7 +284,11 @@ class Supervisor(object):
         self.env = env
         self.install_signals = bool(install_signals)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        # RLock, not Lock: the SIGTERM/SIGINT forward handler (run())
+        # interrupts the main thread — possibly inside _spawn's
+        # critical section — and re-enters this lock via stop() /
+        # _kill_child on that same thread (VT802)
+        self._lock = threading.RLock()
         self._child = None
         self._stopping = False
         self._log = logging.getLogger("Supervisor")
