@@ -1,0 +1,512 @@
+"""Control-plane contract auditors (ISSUE 17): the VW9xx wire-protocol
+lint and the VC95x config/telemetry contract audit.
+
+PR 16 test pattern: per-rule seeded-hazard fixtures where each rule
+fires exactly once, clean sweeps over the real tree (both lints ship at
+zero findings), the suppression contract, the generated
+docs/config_reference.md pin, and the CLI gates in-process."""
+
+import os
+import textwrap
+
+import pytest
+
+from veles_tpu.analysis import config_audit, protocol_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------
+# VW9xx — seeded hazards, each rule fires exactly once
+# --------------------------------------------------------------------------
+
+VW_SEEDS = {
+    "VW900": """
+        class Master:
+            def announce(self, conn):
+                conn.send({"type": "orphan", "host": "h"})
+        """,
+    "VW901": """
+        class Peer:
+            def send_hello(self, conn):
+                conn.send({"type": "hello"})
+
+            def handle(self, msg):
+                if msg.get("type") == "hello":
+                    return msg["nonce"]
+        """,
+    "VW902": """
+        class Registry:
+            def handle(self, msg):
+                if msg.get("type") == "fetch_slices":
+                    self.slices = msg.get("want")
+        """,
+    "VW903": """
+        class Master:
+            def __init__(self):
+                self.fence = IncarnationFence()
+                self.hosts = {}
+
+            def handle(self, msg):
+                if msg.get("type") == "attach":
+                    self.hosts["h"] = msg.get("incarnation")
+        """,
+    "VW904": """
+        def attach(sock):
+            sock.settimeout(None)
+        """,
+    "VW905": """
+        import json
+
+        def pump(sock):
+            line = sock.recv(65536)
+            return json.loads(line)
+        """,
+}
+
+
+def _protocol(tmp_path, *sources):
+    paths = []
+    for i, src in enumerate(sources):
+        p = tmp_path / ("mod%d.py" % i)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return protocol_audit.lint_protocol(paths=paths)
+
+
+class TestSeededVW:
+    @pytest.mark.parametrize("rule", sorted(VW_SEEDS))
+    def test_rule_fires_exactly_once(self, rule, tmp_path):
+        findings = _protocol(tmp_path, VW_SEEDS[rule])
+        assert _rules(findings) == [rule], findings
+
+    def test_all_vw_rules_covered(self):
+        assert tuple(sorted(VW_SEEDS)) == protocol_audit.RULES
+
+    def test_vw900_handler_in_other_module_clears(self, tmp_path):
+        """The scanned files are ONE protocol universe — a kind sent
+        here and handled there is matched across modules."""
+        handler = """
+            class Agent:
+                def handle(self, msg):
+                    if msg.get("type") == "orphan":
+                        return msg.get("host")
+            """
+        findings = _protocol(tmp_path, VW_SEEDS["VW900"], handler)
+        assert findings == [], findings
+
+    def test_vw901_sender_setting_the_field_clears(self, tmp_path):
+        findings = _protocol(tmp_path, """
+            class Peer:
+                def send_hello(self, conn):
+                    conn.send({"type": "hello", "nonce": 7})
+
+                def handle(self, msg):
+                    if msg.get("type") == "hello":
+                        return msg["nonce"]
+            """)
+        assert findings == [], findings
+
+    def test_vw902_response_through_closure(self, tmp_path):
+        """The handler branch closes over same-class methods the
+        message flows into — a reply sent there counts."""
+        findings = _protocol(tmp_path, """
+            class Registry:
+                def handle(self, msg):
+                    if msg.get("type") == "fetch_slices":
+                        self._reply(msg)
+
+                def _reply(self, msg):
+                    self.conn.send({"type": "slices", "data": []})
+
+                def pump(self, msg):
+                    if msg.get("type") == "slices":
+                        return msg.get("data")
+            """)
+        assert findings == [], findings
+
+    def test_vw903_fence_consult_clears(self, tmp_path):
+        findings = _protocol(tmp_path, """
+            class Master:
+                def __init__(self):
+                    self.fence = IncarnationFence()
+                    self.hosts = {}
+
+                def handle(self, msg):
+                    if msg.get("type") == "attach":
+                        if msg.get("incarnation") != self.fence.current:
+                            return
+                        self.hosts["h"] = msg.get("incarnation")
+            """)
+        assert findings == [], findings
+
+    def test_vw903_guard_idiom_branch(self, tmp_path):
+        """`if msg.get("type") != "attach": ... return` — the REST of
+        the block is the handler branch."""
+        findings = _protocol(tmp_path, """
+            class Master:
+                def __init__(self):
+                    self.fence = IncarnationFence()
+                    self.hosts = {}
+
+                def run(self, msg):
+                    if msg.get("type") != "attach":
+                        return
+                    self.hosts["h"] = msg.get("incarnation")
+            """)
+        assert _rules(findings) == ["VW903"], findings
+
+    def test_vw905_guarded_callers_clear(self, tmp_path):
+        """An unguarded helper is fine when every call site sits in a
+        try/except ValueError (one-level caller propagation)."""
+        findings = _protocol(tmp_path, """
+            import json
+
+            def parse(sock):
+                return json.loads(sock.recv(65536))
+
+            def pump(sock):
+                try:
+                    return parse(sock)
+                except ValueError:
+                    return None
+            """)
+        assert findings == [], findings
+
+    def test_get_default_registers_kind(self, tmp_path):
+        """msg.get("type", "garbage") is the inbox pump's torn-line
+        classification — "garbage" becomes a handled kind."""
+        findings = _protocol(tmp_path, """
+            def classify(msg):
+                return msg.get("type", "garbage")
+
+            def synthesize(conn):
+                conn.send({"type": "garbage"})
+            """)
+        assert findings == [], findings
+
+
+# --------------------------------------------------------------------------
+# VC95x — seeded hazards, each rule fires exactly once
+# --------------------------------------------------------------------------
+
+VC_SEEDS = {
+    "VC950": {
+        "config": """
+            root.common.update({
+                "pod": {"heartbeat_ms": 500},
+            })
+            """,
+        "code": """
+            from veles_tpu.config import root
+
+            def tick():
+                return root.common.pod.get("heartbeat_ms", 500)
+
+            def poll():
+                return root.common.pod.get("heartbeat_mss", 500)
+            """,
+    },
+    "VC951": {
+        "config": """
+            root.common.update({
+                "pod": {"alive": True, "dead": 7},
+            })
+            """,
+        "code": """
+            from veles_tpu.config import root
+
+            def tick():
+                return root.common.pod.get("alive", True)
+            """,
+    },
+    "VC952": {
+        "config": """
+            root.common.update({
+                "pod": {"retry_ms": 100},
+            })
+            """,
+        "code": """
+            from veles_tpu.config import root
+
+            def fast():
+                return root.common.pod.get("retry_ms", 100)
+
+            def slow():
+                return root.common.pod.get("retry_ms", 250)
+            """,
+    },
+    "VC953": {
+        "config": """
+            root.common.update({
+                "pod": {"alive": True},
+            })
+            """,
+        "code": """
+            from veles_tpu.config import root
+
+            def tick():
+                return root.common.pod.get("alive", True)
+
+            def probe():
+                return root.common.pod.get("brand_new_knob", 8)
+            """,
+    },
+    "VC954": {
+        "config": """
+            root.common.update({})
+            """,
+        "code": """
+            def boot(flight):
+                flight.record("pod.spawn", host="h")
+            """,
+        "test": """
+            def test_gate(count):
+                assert count("pod.spawn") >= 1
+                assert count("pod.fence") == 0
+            """,
+    },
+}
+
+
+def _config_registry(tmp_path, seed):
+    cfg = tmp_path / "config.py"
+    cfg.write_text(textwrap.dedent(seed["config"]))
+    code = tmp_path / "code.py"
+    code.write_text(textwrap.dedent(seed["code"]))
+    tst = tmp_path / "test_seed.py"
+    tst.write_text(textwrap.dedent(seed.get("test", "")))
+    doc = tmp_path / "doc.md"
+    doc.write_text(seed.get("docs", ""))
+    return config_audit.build_registry(
+        code_paths=[str(code)], config_path=str(cfg),
+        doc_paths=[str(doc)], test_paths=[str(tst)],
+        root=str(tmp_path))
+
+
+class TestSeededVC:
+    @pytest.mark.parametrize("rule", sorted(VC_SEEDS))
+    def test_rule_fires_exactly_once(self, rule, tmp_path):
+        reg = _config_registry(tmp_path, VC_SEEDS[rule])
+        findings = config_audit.lint_config(registry=reg)
+        assert _rules(findings) == [rule], findings
+
+    def test_all_vc_rules_covered(self):
+        assert tuple(sorted(VC_SEEDS)) == config_audit.RULES
+
+    def test_vc954_forward_needs_a_surface(self, tmp_path):
+        """An emitted event on no test/tool/docs surface is the
+        forward warning; putting it in the generated reference (any
+        docs page) clears it."""
+        seed = dict(VC_SEEDS["VC954"], test="")
+        reg = _config_registry(tmp_path, seed)
+        findings = config_audit.lint_config(registry=reg)
+        assert _rules(findings) == ["VC954"], findings
+        assert findings[0].severity == "warning"
+        seed = dict(seed, docs="the `pod.spawn` flight event\n")
+        reg = _config_registry(tmp_path, seed)
+        assert config_audit.lint_config(registry=reg) == []
+
+    def test_knob_helper_reads_resolve(self, tmp_path):
+        """The `def knob(value, key, default): return
+        root.common.pod.get(key, default)` idiom resolves at call
+        sites — declared keys read only through it are not dead."""
+        reg = _config_registry(tmp_path, {
+            "config": """
+                root.common.update({
+                    "pod": {"alive": True},
+                })
+                """,
+            "code": """
+                from veles_tpu.config import root
+
+                def tune(value):
+                    def knob(key, default):
+                        return root.common.pod.get(key, default)
+                    return knob("alive", True)
+                """,
+        })
+        assert config_audit.lint_config(registry=reg) == []
+
+    def test_dynamic_key_read_covers_the_node(self, tmp_path):
+        """root.common.pod.get(var) makes the whole node dynamic — its
+        declared children are neither dead nor undeclared."""
+        reg = _config_registry(tmp_path, {
+            "config": """
+                root.common.update({
+                    "pod": {"alive": True, "spare": 1},
+                })
+                """,
+            "code": """
+                from veles_tpu.config import root
+
+                def probe(which):
+                    return root.common.pod.get(which)
+                """,
+        })
+        assert config_audit.lint_config(registry=reg) == []
+
+    def test_write_string_threads_the_key(self, tmp_path):
+        """A config-list thread string ("root.common.pod.size=%d")
+        registers the write — the key is neither a typo nor dead."""
+        reg = _config_registry(tmp_path, {
+            "config": """
+                root.common.update({})
+                """,
+            "code": """
+                from veles_tpu.config import root
+
+                def spawn(n):
+                    arg = "root.common.pod.size=%d" % n
+                    return root.common.pod.get("size", 0), arg
+                """,
+        })
+        assert config_audit.lint_config(registry=reg) == []
+
+    def test_stale_doc_key_is_vc951(self, tmp_path):
+        reg = _config_registry(tmp_path, {
+            "config": """
+                root.common.update({})
+                """,
+            "code": "",
+            "docs": "set `root.common.pod.vanished` to tune it\n",
+        })
+        findings = config_audit.lint_config(registry=reg)
+        assert _rules(findings) == ["VC951"], findings
+
+
+# --------------------------------------------------------------------------
+# suppression — the lint-ok contract, shared with VT8xx
+# --------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_rationale_suppresses_vw(self, tmp_path):
+        findings = _protocol(tmp_path, """
+            def attach(sock):
+                # lint-ok: VW904 — EOF is the liveness signal here
+                sock.settimeout(None)
+            """)
+        assert findings == [], findings
+
+    def test_bare_lint_ok_suppresses_nothing(self, tmp_path):
+        findings = _protocol(tmp_path, """
+            def attach(sock):
+                # lint-ok:
+                sock.settimeout(None)
+            """)
+        assert _rules(findings) == ["VW904"], findings
+
+    def test_rationale_suppresses_vc(self, tmp_path):
+        seed = VC_SEEDS["VC953"]
+        reg = _config_registry(tmp_path, {
+            "config": seed["config"],
+            "code": """
+                from veles_tpu.config import root
+
+                def tick():
+                    return root.common.pod.get("alive", True)
+
+                def probe():
+                    # lint-ok: VC953 — staged knob, declared next PR
+                    return root.common.pod.get("brand_new_knob", 8)
+                """,
+        })
+        assert config_audit.lint_config(registry=reg) == []
+
+
+# --------------------------------------------------------------------------
+# the shipped tree — both contracts hold at zero findings
+# --------------------------------------------------------------------------
+
+class TestRealTree:
+    def test_services_protocol_is_clean(self):
+        findings = protocol_audit.lint_protocol()
+        assert findings == [], findings
+
+    def test_config_contract_is_clean(self):
+        findings = config_audit.lint_config(root=REPO)
+        assert findings == [], findings
+
+    def test_reference_doc_is_fresh(self):
+        """docs/config_reference.md is generated — regenerating it
+        must reproduce the checked-in file byte for byte (the CI
+        staleness gate)."""
+        with open(os.path.join(REPO, "docs",
+                               "config_reference.md")) as fh:
+            checked_in = fh.read()
+        assert config_audit.build_reference(root=REPO) == checked_in
+
+    def test_reference_is_deterministic(self):
+        reg = config_audit.build_registry(root=REPO)
+        assert config_audit.build_reference(registry=reg) == \
+            config_audit.build_reference(registry=reg)
+
+    def test_lints_never_import_services(self):
+        """Pure AST: auditing the control plane must not execute it."""
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "from veles_tpu.analysis import protocol_audit, "
+            "config_audit\n"
+            "protocol_audit.lint_protocol()\n"
+            "config_audit.lint_config()\n"
+            "poisoned = [m for m in sys.modules\n"
+            "            if m.startswith('veles_tpu.services')]\n"
+            "print('POISONED', poisoned)\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, check=True)
+        assert "POISONED []" in out.stdout, out.stdout + out.stderr
+
+
+# --------------------------------------------------------------------------
+# CLI — exit codes 0/1/2 through the shared findings gate
+# --------------------------------------------------------------------------
+
+class TestCLI:
+    def test_protocol_and_config_audit_clean(self, capsys):
+        from veles_tpu.analysis.cli import main
+        rc = main(["--protocol", "--config-audit"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no findings" in out
+
+    def test_markdown_prints_the_reference(self, capsys):
+        from veles_tpu.analysis.cli import main
+        rc = main(["--config-audit", "--format", "markdown"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("# Config & telemetry contract")
+
+    def test_markdown_pairs_with_config_audit_alone(self, capsys):
+        from veles_tpu.analysis.cli import main
+        with pytest.raises(SystemExit) as e:
+            main(["--protocol", "--format", "markdown"])
+        assert e.value.code == 2
+
+    def test_workflow_required_without_ast_lints(self):
+        from veles_tpu.analysis.cli import main
+        with pytest.raises(SystemExit) as e:
+            main([])
+        assert e.value.code == 2
+
+    def test_fail_on_unifies_contract_findings(self, capsys,
+                                               monkeypatch):
+        """A VC954 forward warning flips the exit only under
+        --fail-on warning — threshold_reached is the one gate."""
+        import veles_tpu.analysis as analysis
+        from veles_tpu.analysis.cli import main
+        from veles_tpu.analysis.findings import WARNING, Finding
+        monkeypatch.setattr(
+            analysis, "lint_config",
+            lambda registry=None, root=None: [Finding(
+                "VC954", WARNING, "x.py:1", "seeded")])
+        assert main(["--config-audit"]) == 0
+        assert main(["--config-audit", "--fail-on", "warning"]) == 1
+        out = capsys.readouterr().out
+        assert "VC954" in out

@@ -304,14 +304,22 @@ class Main(object):
             # the parent never touches jax/XLA: it only spawns, watches
             # and respawns the real training command
             return self._run_supervised(args)
-        if args.backend:
+        backend = args.backend
+        if not backend:
+            # --backend wins; root.common.engine.backend (seeded from
+            # VELES_TPU_BACKEND) is the config-side fallback, "auto"
+            # meaning "leave platform selection to jax"
+            from veles_tpu.config import root
+            knob = str(root.common.engine.get("backend", "auto"))
+            backend = None if knob == "auto" else knob
+        if backend:
             # BEFORE compile_cache.enable(): its CPU-backend gate reads
             # jax_platforms, and `--backend cpu` without JAX_PLATFORMS
             # in the env would otherwise slip past it
             import jax
             jax.config.update(
                 "jax_platforms",
-                "cpu" if args.backend == "cpu" else args.backend)
+                "cpu" if backend == "cpu" else backend)
         # persistent XLA compilation cache: re-runs of the same workflow
         # (and supervisor restarts after preemption) skip recompilation
         # — the TPU-era analogue of the reference's on-disk kernel cache

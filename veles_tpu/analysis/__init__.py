@@ -16,9 +16,17 @@ serving plane has its own two families: the decode-path auditor
 abstractly traces the engine's decode tick + segmented-prefill pass
 (decode_audit, VD7xx — ``veles-tpu-lint --serve``) and the
 concurrency lint AST-scans the threaded control plane in
-``services/`` (concurrency_lint, VT8xx — ``--concurrency``).
-Surface: :func:`lint_workflow` in-process, the ``veles-tpu-lint``
-console script, and ``python -m veles_tpu ... --lint``.
+``services/`` (concurrency_lint, VT8xx — ``--concurrency``).  Two
+contract auditors close the loop: the wire-protocol lint checks the
+control-plane line-JSON message grammar sender-vs-handler
+(protocol_audit, VW9xx — ``--protocol``) and the config/telemetry
+contract audit checks every ``root.common`` knob read against the
+``config.py`` declarations and every flight-event/metric emit against
+the test/tool/docs surface (config_audit, VC95x — ``--config-audit``,
+which also generates docs/config_reference.md via ``--format
+markdown``).  Surface: :func:`lint_workflow` in-process, the
+``veles-tpu-lint`` console script, and ``python -m veles_tpu ...
+--lint``.
 
 Rule catalog and severities: docs/static_analysis.md."""
 
@@ -33,7 +41,8 @@ __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
            "format_findings", "has_errors", "sort_findings",
            "threshold_reached", "lint_graph", "audit_step",
            "audit_sharded_step", "audit_numerics", "lint_workflow",
-           "lint_serving", "lint_concurrency"]
+           "lint_serving", "lint_concurrency", "lint_protocol",
+           "lint_config", "build_config_reference"]
 
 
 def audit_sharded_step(spec, hbm_gib=None):
@@ -69,6 +78,28 @@ def lint_concurrency(paths=None, root=None):
     jax)."""
     from veles_tpu.analysis import concurrency_lint
     return concurrency_lint.lint_concurrency(paths=paths, root=root)
+
+
+def lint_protocol(paths=None, root=None):
+    """Wire-protocol contract lint of the control-plane line-JSON
+    grammar (VW9xx) — see :mod:`veles_tpu.analysis.protocol_audit`
+    (lazy; pure AST, no jax)."""
+    from veles_tpu.analysis import protocol_audit
+    return protocol_audit.lint_protocol(paths=paths, root=root)
+
+
+def lint_config(registry=None, root=None):
+    """Config/telemetry contract audit (VC95x) — see
+    :mod:`veles_tpu.analysis.config_audit` (lazy; pure AST, no jax)."""
+    from veles_tpu.analysis import config_audit
+    return config_audit.lint_config(registry=registry, root=root)
+
+
+def build_config_reference(registry=None, root=None):
+    """The generated docs/config_reference.md contract reference —
+    see :func:`veles_tpu.analysis.config_audit.build_reference`."""
+    from veles_tpu.analysis import config_audit
+    return config_audit.build_reference(registry=registry, root=root)
 
 
 def lint_workflow(wf, staging=True, sharding=True, numerics=True,

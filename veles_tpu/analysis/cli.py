@@ -147,8 +147,9 @@ def main(argv=None):
         description="static workflow-graph linter + jit-staging auditor "
                     "+ sharding/memory auditor + numerics/determinism "
                     "auditor + serving decode-path auditor + "
-                    "control-plane concurrency lint (rule catalog: "
-                    "docs/static_analysis.md)",
+                    "control-plane concurrency lint + wire-protocol "
+                    "contract lint + config/telemetry contract audit "
+                    "(rule catalog: docs/static_analysis.md)",
         formatter_class=argparse.RawDescriptionHelpFormatter,
         epilog="exit codes (identical across graph/staging/sharding/"
                "numerics/serve/\nconcurrency runs — analysis.findings"
@@ -160,14 +161,20 @@ def main(argv=None):
                "run(load, main))")
     p.add_argument("workflow", nargs="?", default=None,
                    help="workflow .py file defining run(load, main) "
-                   "(optional only for a pure --concurrency run — the "
-                   "AST lint needs no workflow)")
+                   "(optional only for a pure --concurrency / "
+                   "--protocol / --config-audit run — the AST lints "
+                   "need no workflow)")
     p.add_argument("config", nargs="?", help="config .py file executed "
                    "with `root` in scope")
     p.add_argument("--config-list", nargs="*", default=[],
                    help="inline config statements, e.g. "
                    "'root.mnist.lr=0.1'")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "markdown"),
+                   default="text",
+                   help="'text'/'json' render findings; 'markdown' "
+                   "(only with --config-audit, no other audit) prints "
+                   "the docs/config_reference.md contract reference "
+                   "instead and always exits 0")
     p.add_argument("--no-staging", action="store_true",
                    help="graph rules only; skip the jit-staging audit "
                    "hooks")
@@ -210,23 +217,49 @@ def main(argv=None):
                    help="run the VT8xx concurrency lint (pure AST "
                    "scan) over the threaded control plane in "
                    "veles_tpu/services — needs no workflow file")
+    p.add_argument("--protocol", action="store_true",
+                   help="run the VW9xx wire-protocol contract lint "
+                   "(pure AST scan) over the control-plane line-JSON "
+                   "protocol in veles_tpu/services — every message "
+                   "kind needs a sender AND a handler, state-mutating "
+                   "handlers must consult the incarnation fence, "
+                   "socket reads need timeout bounds; needs no "
+                   "workflow file")
+    p.add_argument("--config-audit", action="store_true",
+                   dest="config_audit",
+                   help="run the VC95x config/telemetry contract audit "
+                   "(pure AST scan) over the whole tree — root.common "
+                   "knob reads vs the config.py declarations (typos, "
+                   "dead knobs, conflicting defaults) and flight-event"
+                   "/metric emits vs the test/tool/docs surface; "
+                   "needs no workflow file")
     p.add_argument("--fail-on", choices=("error", "warning"),
                    default="error", metavar="{error,warning}",
                    help="severity threshold for the non-zero exit: "
                    "'error' (default) fails only on error findings, "
                    "'warning' fails on warnings too — the CI gate "
                    "knob, shared by every family (VG/VJ/VS/VM/VN/VR/"
-                   "VP/VD/VT) through findings.threshold_reached")
+                   "VP/VD/VT/VW/VC) through findings.threshold_reached")
     p.add_argument("--strict", action="store_true",
                    help="deprecated alias for --fail-on warning")
     args = p.parse_args(argv)
 
-    if args.workflow is None and not args.concurrency:
-        p.error("a workflow file is required (only a pure "
-                "--concurrency run works without one)")
+    ast_only = args.concurrency or args.protocol or args.config_audit
+    if args.workflow is None and not ast_only:
+        p.error("a workflow file is required (only pure --concurrency/"
+                "--protocol/--config-audit runs work without one)")
     if args.serve and args.workflow is None:
         p.error("--serve audits a workflow's serving engine — give "
                 "it the workflow file")
+    if args.format == "markdown":
+        if not args.config_audit or args.workflow is not None \
+                or args.concurrency or args.protocol:
+            p.error("--format markdown prints the config/telemetry "
+                    "contract reference — it pairs with --config-audit "
+                    "alone")
+        from veles_tpu.analysis.config_audit import build_reference
+        sys.stdout.write(build_reference())
+        return 0
 
     findings = []
     if args.workflow is not None:
@@ -257,6 +290,12 @@ def main(argv=None):
     if args.concurrency:
         from veles_tpu.analysis import lint_concurrency
         findings.extend(lint_concurrency())
+    if args.protocol:
+        from veles_tpu.analysis import lint_protocol
+        findings.extend(lint_protocol())
+    if args.config_audit:
+        from veles_tpu.analysis import lint_config
+        findings.extend(lint_config())
 
     from veles_tpu.analysis import (format_findings, sort_findings,
                                     threshold_reached)

@@ -1,0 +1,821 @@
+"""Config & telemetry contract audit (VC95x) + reference generator.
+
+``root.common`` is an auto-vivifying tree: reading a key nobody ever
+declared silently returns an empty node, so a typo'd path is not an
+error — it is a default, forever.  The flight-recorder and metrics
+namespaces are stringly-typed the same way: a chaos gate asserting an
+event the code renamed passes vacuously (it asserts "count == 0" by
+accident).  This audit builds a whole-tree registry from source (pure
+AST — nothing is imported, nothing runs) and lints the contract:
+
+* **declared** keys: the ``root.common.update({...})`` defaults block
+  in ``veles_tpu/config.py`` (declaration is the documentation home —
+  ``docs/config_reference.md`` is generated from it, see
+  :func:`build_reference`);
+* **read** sites: attribute chains (``root.common.serve.weights``),
+  ``node.get("key", default)`` with inline default, whole-node reads
+  (``root.common.get("pod")``), per-scope aliases (``serve_cfg =
+  _root.common.serve``), and local knob helpers (``def knob(value,
+  key, default): return root.common.pod.get(key, default)``) resolved
+  at their call sites; a ``.get`` with a non-constant key marks the
+  node dynamically read;
+* **runtime-threaded** writes: assignment statements and the
+  ``"root.common.pod.size=%d"`` config-list strings the master threads
+  into workers;
+* **emitted** flight events (``flight.record("pod.fence", ...)`` and
+  ``kind="serve.deadline"`` keyword sites) and ``veles_*`` metrics;
+* **referenced** event/metric names in tests/, tools/ and docs/.
+
+Rule catalog (docs/static_analysis.md):
+
+========  =======  ======================================================
+VC950     error    undeclared key read in exactly one place whose
+                   dotted path is edit-distance 1 from a declared (or
+                   multiply-read) key — the silent-default typo class
+VC951     warning  dead knob: declared in config.py (or documented in
+                   docs/) but read by no code
+VC952     error    one key, conflicting constant defaults: two read
+                   sites disagree, or an inline default contradicts
+                   the declared default (which silently wins)
+VC953     warning  knob read by code but never declared in config.py —
+                   invisible to docs/config_reference.md and to every
+                   other reader
+VC954     error    test/tool references a flight event or metric
+                   nothing emits (a gate asserting a renamed event
+                   passes vacuously); **warning** for the converse —
+                   an emitted dotted event / metric on no test, tool
+                   or docs surface
+========  =======  ======================================================
+
+**Suppression**: ``# lint-ok: VC954 — reason`` on the flagged line (or
+the contiguous comment block above it) in whichever file the finding
+points at — same contract as VT8xx/VW9xx.
+"""
+
+import ast
+import os
+import re
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VC95x family, in catalog order
+RULES = ("VC950", "VC951", "VC952", "VC953", "VC954")
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3}(?:\s*,\s*"
+                          r"[A-Z]{2}\d{3})*)")
+
+#: sentinel defaults for read sites
+_MISSING = "<none>"      # bare attr-chain read: vivifies, no default
+_DYNAMIC = "<dynamic>"   # non-constant default expression
+
+_CONFIG_ROOTS = ("root", "_root")
+_WRITE_STR_RE = re.compile(r"root\.common\.([A-Za-z_][\w.]*)\s*=")
+_DOC_KEY_RE = re.compile(r"root\.common\.([A-Za-z_][\w.]*[\w])")
+_EVENT_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+_METRIC_RE = re.compile(r"^veles_[a-z0-9_]+$")
+_FILE_EXTS = ("json", "jsonl", "py", "md", "txt", "log", "csv", "html",
+              "yaml", "yml", "gz", "zip", "pkl", "npz", "npy", "pb",
+              "ckpt", "png", "svg", "db", "sock", "mdb", "lst", "h5",
+              "hdf5", "wav")
+_METRIC_TAILS = ("gauge", "counter", "histogram")
+_NODE_TAILS = ("as_dict", "print_", "keys", "items", "values")
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _default_repr(node):
+    if node is None:
+        return _MISSING
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    return _DYNAMIC
+
+
+def _edit_distance(a, b):
+    """Plain Levenshtein — the near-miss metric for VC950."""
+    if abs(len(a) - len(b)) > 1:
+        return 2                     # capped: only 0/1 matter
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+class _Site(object):
+    def __init__(self, path, lineno, default):
+        self.path = path
+        self.lineno = lineno
+        self.default = default
+
+
+class Registry(object):
+    """Everything the VC95x rules and the reference generator need."""
+
+    def __init__(self):
+        self.reads = {}          # key -> [_Site]
+        self.node_reads = set()  # node paths read whole
+        self.dynamic_nodes = set()   # node paths read with var keys
+        self.declared = {}       # key -> default repr
+        self.declared_lines = {}     # key -> config.py lineno
+        self.declared_nodes = set()
+        self.writes = {}         # key -> [(file, lineno)]
+        self.doc_keys = {}       # key -> [(docfile, lineno)]
+        self.events = {}         # emitted name -> [(file, lineno)]
+        self.event_prefixes = set()  # constant prefixes of dynamic
+        self.metrics = {}        # emitted metric -> [(file, lineno)]
+        self.metric_prefixes = set()
+        self.refs = {}           # referenced name -> [(file, lineno)]
+        self.doc_tokens = set()  # event/metric-ish tokens in docs
+
+    # -- derived -------------------------------------------------------
+    def covered_by_node(self, key):
+        """True when a whole-node or dynamic read covers ``key``."""
+        parts = key.split(".")
+        for i in range(len(parts)):
+            prefix = ".".join(parts[:i + 1])
+            if prefix in self.node_reads or \
+                    prefix in self.dynamic_nodes:
+                return True
+        return False
+
+    def is_read(self, key):
+        if key in self.reads or self.covered_by_node(key):
+            return True
+        # a computed node declared as one leaf (`"dirs":
+        # _default_dirs()`) is read through its children
+        prefix = key + "."
+        return any(k.startswith(prefix) for k in self.reads) or \
+            any(n.startswith(prefix) or n == key
+                for n in self.node_reads | self.dynamic_nodes)
+
+    def declared_ancestor(self, key):
+        """A strict ancestor of ``key`` declared as a LEAF (a computed
+        dict whose children the AST cannot see)."""
+        parts = key.split(".")
+        return any(".".join(parts[:i]) in self.declared
+                   for i in range(1, len(parts)))
+
+    def config_key_like(self, token):
+        """``token`` collides with the config-key namespace (so it is
+        not an event reference)."""
+        return (token in self.declared or token in self.reads
+                or token in self.writes
+                or token in self.declared_nodes
+                or any(k.startswith(token + ".")
+                       for k in self.declared))
+
+
+class _CodeScan(ast.NodeVisitor):
+    """One module: config reads/writes + event/metric emits."""
+
+    def __init__(self, reg, relpath):
+        self.reg = reg
+        self.relpath = relpath
+        self.scopes = [{}]       # alias name -> config path tuple
+        self.helpers = [{}]      # helper name -> (path, key_i, dflt_i)
+        self.wrappers = {}       # flight-wrapper name -> kind arg index
+
+    def prescan_wrappers(self, tree):
+        """Functions that forward a parameter into ``flight.record``
+        (tuner's ``_telemetry``) — their call sites name the events."""
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in fn.args.args]
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            for call in ast.walk(fn):
+                if not (isinstance(call, ast.Call) and call.args
+                        and isinstance(call.args[0], ast.Name)
+                        and call.args[0].id in params):
+                    continue
+                chain = ".".join(_dotted(call.func) or [])
+                if chain.rsplit(".", 1)[-1] == "record" \
+                        and "flight" in chain.lower():
+                    self.wrappers[fn.name] = \
+                        params.index(call.args[0].id)
+
+    # -- chain resolution ---------------------------------------------
+    def _resolve(self, node):
+        """Config path (tuple, may be empty == the common root) for an
+        attribute chain / aliased name, else None."""
+        parts = _dotted(node)
+        if parts is None:
+            if isinstance(node, ast.BoolOp):    # (cfg or {}).get(...)
+                for v in node.values:
+                    r = self._resolve(v)
+                    if r is not None:
+                        return r
+            return None
+        for i in range(len(parts), 0, -1):
+            head = parts[:i]
+            if len(head) >= 2 and head[0] in _CONFIG_ROOTS \
+                    and head[1] == "common":
+                return tuple(parts[2:])
+            if len(head) >= 3 and head[1] == "root" \
+                    and head[2] == "common":    # config.root.common
+                return tuple(parts[3:])
+            if i == 1:
+                for scope in reversed(self.scopes):
+                    if parts[0] in scope:
+                        return scope[parts[0]] + tuple(parts[1:])
+        return None
+
+    def _read(self, path, lineno, default):
+        if not path:
+            return
+        self.reg.reads.setdefault(".".join(path), []).append(
+            _Site(self.relpath, lineno, default))
+
+    # -- scoping -------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.scopes.append({})
+        self.helpers.append({})
+        self._register_helpers(node)
+        self.generic_visit(node)
+        self.scopes.pop()
+        self.helpers.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _register_helpers(self, fn):
+        """``def knob(value, key, default): ... return
+        root.common.pod.get(key, default)`` -> resolvable call sites."""
+        for sub in fn.body:
+            if not isinstance(sub, ast.FunctionDef):
+                continue
+            params = [a.arg for a in sub.args.args]
+            for ret in [n for n in ast.walk(sub)
+                        if isinstance(n, ast.Return)]:
+                call = ret.value
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "get"
+                        and len(call.args) == 2
+                        and isinstance(call.args[0], ast.Name)
+                        and call.args[0].id in params):
+                    continue
+                base = self._resolve(call.func.value)
+                if base is None:
+                    continue
+                key_i = params.index(call.args[0].id)
+                dflt_i = params.index(call.args[1].id) \
+                    if isinstance(call.args[1], ast.Name) \
+                    and call.args[1].id in params else None
+                self.helpers[-1][sub.name] = (base, key_i, dflt_i)
+
+    # -- reads / writes ------------------------------------------------
+    def visit_Assign(self, node):
+        for t in node.targets:
+            path = self._resolve(t) if isinstance(t, ast.Attribute) \
+                else None
+            if path:
+                self.reg.writes.setdefault(".".join(path), []).append(
+                    (self.relpath, node.lineno))
+        if len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            path = self._resolve(node.value)
+            if path is not None:
+                self.scopes[-1][node.targets[0].id] = path
+                if path:
+                    self.reg.node_reads.add(".".join(path))
+                self.visit(node.value)   # chains under the alias value
+                return
+        self.visit(node.value)
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            base = self._resolve(func.value)
+            if base is not None:
+                if func.attr == "get" and node.args:
+                    key = _const_str(node.args[0])
+                    dflt = node.args[1] if len(node.args) > 1 else None
+                    if key is not None:
+                        if not base and key not in self.reg.declared:
+                            # root.common.get("node"): a whole-node
+                            # presence probe unless the key is a
+                            # declared leaf
+                            self.reg.node_reads.add(key)
+                        else:
+                            self._read(base + (key,), node.lineno,
+                                       _default_repr(dflt))
+                    else:
+                        if base:
+                            self.reg.dynamic_nodes.add(".".join(base))
+                    for a in node.args[1:]:
+                        self.visit(a)
+                    return
+                if func.attr in _NODE_TAILS and base:
+                    self.reg.node_reads.add(".".join(base))
+                    return
+                if func.attr == "update" and base:
+                    # runtime re-declaration: the dict keys are writes
+                    for a in node.args:
+                        if isinstance(a, ast.Dict):
+                            for k in a.keys:
+                                s = _const_str(k) if k else None
+                                if s:
+                                    self.reg.writes.setdefault(
+                                        ".".join(base + (s,)),
+                                        []).append((self.relpath,
+                                                    node.lineno))
+                    self.generic_visit(node)
+                    return
+        if isinstance(func, ast.Name):
+            # config.get(chain, default) helper / local knob helpers
+            if func.id == "get" and node.args:
+                path = self._resolve(node.args[0])
+                if path:
+                    dflt = node.args[1] if len(node.args) > 1 else None
+                    self._read(path, node.lineno, _default_repr(dflt))
+                    for a in node.args[1:]:
+                        self.visit(a)
+                    return
+            for frame in reversed(self.helpers):
+                if func.id in frame:
+                    base, key_i, dflt_i = frame[func.id]
+                    key = _const_str(node.args[key_i]) \
+                        if key_i < len(node.args) else None
+                    if key is None:
+                        if base:
+                            self.reg.dynamic_nodes.add(".".join(base))
+                    else:
+                        dflt = node.args[dflt_i] \
+                            if dflt_i is not None \
+                            and dflt_i < len(node.args) else None
+                        self._read(base + (key,), node.lineno,
+                                   _default_repr(dflt))
+                    break
+        self._maybe_emit(node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        path = self._resolve(node)
+        if path is not None:
+            if path:
+                self._read(path, node.lineno, _MISSING)
+            return                     # chain consumed whole
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        # "root.common.pod.size=%d" config-list thread strings
+        if isinstance(node.value, str):
+            for m in _WRITE_STR_RE.finditer(node.value):
+                self.reg.writes.setdefault(m.group(1), []).append(
+                    (self.relpath, node.lineno))
+
+    # -- event / metric emits -----------------------------------------
+    def _maybe_emit(self, node):
+        chain = ".".join(_dotted(node.func) or [])
+        tail = chain.rsplit(".", 1)[-1]
+        if tail == "record" and "flight" in chain.lower() \
+                and node.args:
+            self._note_name(self.reg.events, self.reg.event_prefixes,
+                            node.args[0], node.lineno)
+        idx = self.wrappers.get(tail)
+        if idx is not None and len(node.args) > idx:
+            self._note_name(self.reg.events, self.reg.event_prefixes,
+                            node.args[idx], node.lineno)
+        if tail in _METRIC_TAILS and node.args:
+            name = _const_str(node.args[0])
+            if name is not None and name.startswith("veles_"):
+                self.reg.metrics.setdefault(name, []).append(
+                    (self.relpath, node.lineno))
+            elif name is None:
+                pre = self._const_prefix(node.args[0])
+                if pre and pre.startswith("veles_"):
+                    self.reg.metric_prefixes.add(pre)
+        for kw in node.keywords:
+            if kw.arg == "kind" or (kw.arg == "name"
+                                    and tail == "emit"):
+                s = _const_str(kw.value)
+                if s is not None and "." in s:
+                    self.reg.events.setdefault(s, []).append(
+                        (self.relpath, node.lineno))
+
+    def _note_name(self, table, prefixes, arg, lineno):
+        if isinstance(arg, ast.IfExp):      # "a.b" if cond else "a.c"
+            self._note_name(table, prefixes, arg.body, lineno)
+            self._note_name(table, prefixes, arg.orelse, lineno)
+            return
+        s = _const_str(arg)
+        if s is not None:
+            table.setdefault(s, []).append((self.relpath, lineno))
+            return
+        pre = self._const_prefix(arg)
+        if pre:
+            prefixes.add(pre)
+
+    @staticmethod
+    def _const_prefix(arg):
+        """Constant left part of ``"pod.%s" % x`` / f-strings /
+        ``"pod." + x`` — the dynamic-emit family marker."""
+        if isinstance(arg, ast.BinOp):
+            s = _const_str(arg.left)
+        elif isinstance(arg, ast.JoinedStr) and arg.values:
+            s = _const_str(arg.values[0])
+        else:
+            s = None
+        return s.split("%", 1)[0] if s else None
+
+
+def _flatten_defaults(d, prefix, reg):
+    for k, v in zip(d.keys, d.values):
+        name = _const_str(k) if k is not None else None
+        if name is None:
+            continue
+        key = prefix + (name,)
+        if isinstance(v, ast.Dict):
+            reg.declared_nodes.add(".".join(key))
+            _flatten_defaults(v, key, reg)
+        else:
+            reg.declared[".".join(key)] = (
+                repr(v.value) if isinstance(v, ast.Constant)
+                else "(computed)")
+            reg.declared_lines[".".join(key)] = k.lineno
+
+
+def _scan_declared(config_path, reg):
+    with open(config_path) as fh:
+        tree = ast.parse(fh.read(), filename=config_path)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update" and node.args
+                and isinstance(node.args[0], ast.Dict)):
+            continue
+        parts = _dotted(node.func.value)
+        if parts and parts[0] in _CONFIG_ROOTS and len(parts) >= 2 \
+                and parts[1] == "common":
+            _flatten_defaults(node.args[0], tuple(parts[2:]), reg)
+
+
+def _scan_docs(doc_paths, reg):
+    for p, rel in doc_paths:
+        with open(p) as fh:
+            for lineno, line in enumerate(fh, 1):
+                for m in _DOC_KEY_RE.finditer(line):
+                    # `root.common.update({...})` / `.get(...)` in
+                    # docs are API mentions, not keys
+                    key = m.group(1)
+                    parts = key.split(".")
+                    while parts and parts[-1] in ("update", "get"):
+                        parts.pop()
+                    if not parts or parts[0] in ("update", "get"):
+                        continue
+                    reg.doc_keys.setdefault(".".join(parts), []).append(
+                        (rel, lineno))
+                for tok in re.findall(r"[A-Za-z_][\w.]*", line):
+                    if _EVENT_RE.match(tok) or _METRIC_RE.match(tok):
+                        reg.doc_tokens.add(tok)
+
+
+def _scan_refs(test_paths, reg):
+    """Event/metric-shaped string constants in tests/tools."""
+    for p, rel in test_paths:
+        with open(p) as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=p)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            s = _const_str(node)
+            if s is None or "/" in s or s.startswith(
+                    ("root.", "veles_tpu", "jax.", "numpy.")):
+                continue
+            if not (_EVENT_RE.match(s) or _METRIC_RE.match(s)):
+                continue
+            if s.rsplit(".", 1)[-1] in _FILE_EXTS or s.endswith("_"):
+                continue
+            reg.refs.setdefault(s, []).append((rel, node.lineno))
+
+
+def _iter_py(base):
+    for dirpath, _dirs, files in os.walk(base):
+        if "__pycache__" in dirpath:
+            continue
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _default_tree(root=None):
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = root or os.path.dirname(here)
+    config_path = os.path.join(repo, "veles_tpu", "config.py")
+    # the analyzers' own docstrings hold rule examples — not contracts
+    skip_dir = os.path.join(repo, "veles_tpu", "analysis")
+    code, tests, docs = [], [], []
+    for sub in ("veles_tpu", "tools", "samples"):
+        base = os.path.join(repo, sub)
+        if os.path.isdir(base):
+            code.extend(
+                p for p in _iter_py(base)
+                if os.path.abspath(p) != os.path.abspath(config_path)
+                and not os.path.abspath(p).startswith(
+                    os.path.abspath(skip_dir) + os.sep))
+    for sub in ("tests", "tools"):
+        base = os.path.join(repo, sub)
+        if os.path.isdir(base):
+            tests.extend(_iter_py(base))
+    docs_dir = os.path.join(repo, "docs")
+    if os.path.isdir(docs_dir):
+        docs = [os.path.join(docs_dir, f)
+                for f in sorted(os.listdir(docs_dir))
+                if f.endswith(".md")]
+    return repo, code, config_path, docs, tests
+
+
+def build_registry(code_paths=None, config_path=None, doc_paths=None,
+                   test_paths=None, root=None):
+    """Whole-tree contract registry.  Defaults: code = ``veles_tpu/``
+    (minus ``config.py``) + ``tools/`` + ``samples/``; declarations =
+    ``veles_tpu/config.py``; docs = ``docs/*.md``; references =
+    ``tests/`` + ``tools/``."""
+    repo, dcode, dconfig, ddocs, dtests = _default_tree(root)
+    code_paths = dcode if code_paths is None else code_paths
+    config_path = dconfig if config_path is None else config_path
+    doc_paths = ddocs if doc_paths is None else doc_paths
+    test_paths = dtests if test_paths is None else test_paths
+    rel = lambda p: os.path.relpath(p, repo).replace(os.sep, "/")  # noqa: E731
+    reg = Registry()
+    if config_path and os.path.exists(config_path):
+        _scan_declared(config_path, reg)
+    for p in code_paths:
+        with open(p) as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=p)
+        except SyntaxError:
+            continue          # the VW/VT lints report parse failures
+        scan = _CodeScan(reg, rel(p))
+        scan.prescan_wrappers(tree)
+        scan.visit(tree)
+    _scan_docs([(p, rel(p)) for p in doc_paths], reg)
+    _scan_refs([(p, rel(p)) for p in test_paths], reg)
+    reg.config_rel = rel(config_path) if config_path else "config.py"
+    reg.repo = repo
+    return reg
+
+
+class _Suppressor(object):
+    """lint-ok lookup over arbitrary files (findings span the tree)."""
+
+    def __init__(self, repo):
+        self.repo = repo
+        self.cache = {}
+
+    def __call__(self, rule, relpath, lineno):
+        lines = self.cache.get(relpath)
+        if lines is None:
+            try:
+                with open(os.path.join(self.repo, relpath)) as fh:
+                    lines = fh.read().splitlines()
+            except OSError:
+                lines = []
+            self.cache[relpath] = lines
+
+        def marked(ln):
+            if not 1 <= ln <= len(lines):
+                return False
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            return bool(m and rule in re.split(r"\s*,\s*",
+                                               m.group(1)))
+        if marked(lineno):
+            return True
+        ln = lineno - 1
+        while 1 <= ln <= len(lines) \
+                and lines[ln - 1].lstrip().startswith("#"):
+            if marked(ln):
+                return True
+            ln -= 1
+        return False
+
+
+def lint_config(registry=None, root=None):
+    """VC95x findings over the contract registry (built from the repo
+    tree when not given).  Returns sorted Findings."""
+    reg = registry if registry is not None \
+        else build_registry(root=root)
+    suppressed = _Suppressor(getattr(reg, "repo", root or "."))
+    findings = []
+
+    def emit(rule, severity, relpath, lineno, message, hint=""):
+        if suppressed(rule, relpath, lineno):
+            return
+        findings.append(Finding(rule, severity,
+                                "%s:%d" % (relpath, lineno), message,
+                                hint=hint))
+
+    known = set(reg.declared) | set(reg.writes) | \
+        {k for k, sites in reg.reads.items() if len(sites) > 1}
+
+    # VC950 / VC953 — undeclared reads: typo near-miss vs new knob
+    for key in sorted(reg.reads):
+        if key in reg.declared or key in reg.writes \
+                or key in reg.declared_nodes \
+                or reg.covered_by_node(key):
+            continue
+        sites = reg.reads[key]
+        near = None
+        if len(sites) == 1:
+            near = next((c for c in sorted(known)
+                         if c != key
+                         and _edit_distance(key, c) <= 1), None)
+        s = sites[0]
+        if reg.declared_ancestor(key):
+            continue      # child of a computed dict (e.g. dirs.*)
+        if near is not None:
+            emit("VC950", ERROR, s.path, s.lineno,
+                 "root.common.%s is read exactly once and is edit-"
+                 "distance 1 from %r — the silent-default typo class"
+                 % (key, near),
+                 hint="fix the path (a misspelled key vivifies an "
+                      "empty node and returns the default forever)")
+        else:
+            emit("VC953", WARNING, s.path, s.lineno,
+                 "root.common.%s is read by code but never declared "
+                 "in config.py — invisible to docs/config_reference"
+                 ".md and to every other reader" % key,
+                 hint="declare it (with its default) in the "
+                      "config.py defaults block")
+
+    # VC951 — dead knobs: declared/documented but read by nothing
+    for key in sorted(reg.declared):
+        if reg.is_read(key):
+            continue
+        emit("VC951", WARNING, reg.config_rel,
+             reg.declared_lines.get(key, 1),
+             "root.common.%s is declared but no code reads it — a "
+             "dead knob (setting it does nothing)" % key,
+             hint="delete the declaration, or wire the knob into the "
+                  "code that was supposed to honor it")
+    for key in sorted(reg.doc_keys):
+        if key in reg.declared or key in reg.declared_nodes \
+                or key in reg.reads or key in reg.writes \
+                or reg.covered_by_node(key) \
+                or any(k.startswith(key + ".") for k in reg.declared):
+            continue
+        f, ln = reg.doc_keys[key][0]
+        emit("VC951", WARNING, f, ln,
+             "docs mention root.common.%s but the key is neither "
+             "declared nor read anywhere — stale documentation" % key,
+             hint="update the docs (or declare/wire the knob)")
+
+    # VC952 — conflicting constant defaults for one key
+    for key in sorted(reg.reads):
+        sites = [s for s in reg.reads[key]
+                 if s.default not in (_MISSING, _DYNAMIC)]
+        values = {}
+        for s in sites:
+            values.setdefault(s.default, []).append(s)
+        declared = reg.declared.get(key)
+        if declared is not None and declared != "(computed)":
+            values.setdefault(declared, [])
+        if len(values) > 1:
+            s = sites[0]
+            desc = ", ".join(
+                "%s (%s)" % (v,
+                             "declared" if not sts else
+                             "; ".join("%s:%d" % (x.path, x.lineno)
+                                       for x in sts))
+                for v, sts in sorted(values.items()))
+            emit("VC952", ERROR, s.path, s.lineno,
+                 "root.common.%s has conflicting defaults: %s — the "
+                 "declared default silently wins over every inline "
+                 "one" % (key, desc),
+                 hint="unify on the config.py declaration (inline "
+                      "defaults must match it exactly)")
+
+    # VC954 — event/metric contract, both directions
+    families = {e.split(".", 1)[0] for e in reg.events if "." in e}
+    surface = set(reg.refs) | reg.doc_tokens
+
+    def emitted(name):
+        if name in reg.events or name in reg.metrics:
+            return True
+        prefixes = reg.event_prefixes | reg.metric_prefixes
+        return any(name.startswith(p) for p in prefixes)
+
+    for name in sorted(reg.refs):
+        if emitted(name) or reg.config_key_like(name):
+            continue
+        if _METRIC_RE.match(name) or (
+                "." in name and name.split(".", 1)[0] in families):
+            f, ln = reg.refs[name][0]
+            emit("VC954", ERROR, f, ln,
+                 "references %r, a flight event / metric nothing "
+                 "emits — the gate passes vacuously" % name,
+                 hint="rename the reference to the emitted name (or "
+                      "restore the emit this gate was written for)")
+    for name in sorted(reg.events):
+        if "." in name and name not in surface:
+            f, ln = reg.events[name][0]
+            emit("VC954", WARNING, f, ln,
+                 "flight event %r is emitted but appears on no test, "
+                 "tool or docs surface" % name,
+                 hint="regenerate docs/config_reference.md (the "
+                      "generated catalog is the blackbox operator "
+                      "surface)")
+    for name in sorted(reg.metrics):
+        if name not in surface:
+            f, ln = reg.metrics[name][0]
+            emit("VC954", WARNING, f, ln,
+                 "metric %r is emitted but appears on no test, tool "
+                 "or docs surface" % name,
+                 hint="regenerate docs/config_reference.md")
+    return sort_findings(findings)
+
+
+# ---------------------------------------------------------------- docs
+def build_reference(registry=None, root=None):
+    """``docs/config_reference.md`` content from the registry —
+    deterministic (sorted keys, file paths without line numbers) so CI
+    can diff the checked-in file against a fresh generation."""
+    reg = registry if registry is not None \
+        else build_registry(root=root)
+    out = []
+    w = out.append
+    w("# Config & telemetry contract reference")
+    w("")
+    w("Generated by `veles-tpu-lint --config-audit --format markdown`"
+      " — do not edit")
+    w("by hand.  The `contract-audit` CI job regenerates it and fails"
+      " when this")
+    w("file is stale.  Rule catalog: docs/static_analysis.md"
+      " (VC95x).")
+    w("")
+    w("## Config keys (`root.common.*`)")
+    w("")
+    w("| key | default | read by | docs |")
+    w("| --- | --- | --- | --- |")
+    keys = sorted(set(reg.declared) | set(reg.reads))
+    for key in keys:
+        if key in reg.declared_nodes:
+            continue
+        files = sorted({s.path for s in reg.reads.get(key, ())})
+        docs = sorted({f for f, _ln in reg.doc_keys.get(key, ())})
+        w("| `%s` | `%s` | %s | %s |"
+          % (key, reg.declared.get(key, "—"),
+             ", ".join("`%s`" % f for f in files) or "—",
+             ", ".join(docs) or "—"))
+    w("")
+    w("## Runtime-threaded keys")
+    w("")
+    w("Written by code (config-list threading / live reconfiguration),"
+      " read")
+    w("through whole-node reads — not knobs a user sets.")
+    w("")
+    w("| key | written by |")
+    w("| --- | --- |")
+    for key in sorted(reg.writes):
+        files = sorted({f for f, _ln in reg.writes[key]})
+        w("| `%s` | %s |"
+          % (key, ", ".join("`%s`" % f for f in files)))
+    w("")
+    w("## Flight events")
+    w("")
+    w("| event | emitted by |")
+    w("| --- | --- |")
+    for name in sorted(reg.events):
+        files = sorted({f for f, _ln in reg.events[name]})
+        w("| `%s` | %s |"
+          % (name, ", ".join("`%s`" % f for f in files)))
+    for pre in sorted(reg.event_prefixes):
+        w("| `%s*` | (dynamic family) |" % pre)
+    w("")
+    w("## Metrics")
+    w("")
+    w("| metric | emitted by |")
+    w("| --- | --- |")
+    for name in sorted(reg.metrics):
+        files = sorted({f for f, _ln in reg.metrics[name]})
+        w("| `%s` | %s |"
+          % (name, ", ".join("`%s`" % f for f in files)))
+    for pre in sorted(reg.metric_prefixes):
+        w("| `%s*` | (dynamic family) |" % pre)
+    w("")
+    return "\n".join(out)

@@ -1,0 +1,759 @@
+"""Wire-protocol contract audit for the line-JSON control plane (VW9xx).
+
+The pod master, fleet master, agents, router and supervisor speak a
+stringly-typed protocol: newline-delimited JSON dicts whose ``"type"``
+key names the message.  Nothing checks that contract — a kind sent
+with no handler is silently dropped by the inbox pump, a renamed field
+is a ``KeyError`` in a survivor mid-restart, a handler added without
+the incarnation fence re-admits exactly the zombies PR 9 fenced out.
+This audit extracts the whole message space from source (pure AST —
+nothing is imported, nothing runs) and checks both sides of the wire
+against each other.
+
+**Extraction model.**  A *message site* is a dict literal with a
+constant ``"type"`` key and a constant string value (the protocol's
+construction idiom — ``conn.send({"type": "welcome", ...})``,
+``return {"type": "spawn", ...}``); dicts using a ``"kind"``/``"cmd"``
+discriminator count only when passed directly to a ``send``/``_send``
+helper.  A *handler* is a string compared (``==``/``!=``/``in``)
+against a type-expression: ``msg.get("type")`` / ``msg["type"]``, a
+variable assigned from one, or the kind-parameter of a dispatch
+function (``_handle_event(self, kind, host, msg)``).  The default of
+``msg.get("type", "garbage")`` also registers a handled kind (the
+inbox pump's classification).  Handler *branches* close over
+same-class method calls the message flows into (``self._handle_spawn
+(msg)``), so field/response/fence checks see the real handler body.
+
+Rule catalog (docs/static_analysis.md):
+
+========  =======  ======================================================
+VW900     error    message kind emitted (a message site constructs it)
+                   with no registered handler anywhere in the scanned
+                   tree — the send is a silent no-op on the peer
+VW901     error    handler branch subscripts a field (``msg["x"]``) no
+                   sender of that kind ever sets — a KeyError waiting
+                   for that message
+VW902     error    request-shaped kind (``fetch_*``/``report_*``/
+                   ``push_*``/``query_*``/``get_*``/``request*``) whose
+                   handler closure never sends a response — the
+                   requester waits forever
+VW903     error    in a class owning an incarnation fence, a handler
+                   branch reads the message's ``incarnation`` and
+                   mutates state without consulting the fence (no
+                   fence-attr use, no incarnation comparison) — the
+                   PR 9 zombie-readmission class, machine-checked
+VW904     warning  unbounded control-plane socket: ``settimeout(None)``,
+                   ``create_connection`` without a timeout, or a bare
+                   ``accept()`` outside a ``try/except OSError`` —
+                   a dead peer parks the thread forever
+VW905     error    ``json.loads`` of wire input (socket/HTTP read or a
+                   wire-named parameter) with no ``ValueError``-
+                   catching guard at the site or around every caller —
+                   one torn line kills the owning thread
+========  =======  ======================================================
+
+**Suppression**: ``# lint-ok: VW904 — reason`` on the flagged line or
+the contiguous comment block above it, exactly as for VT8xx; a bare
+``# lint-ok:`` suppresses nothing.
+"""
+
+import ast
+import os
+import re
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VW9xx family, in catalog order
+RULES = ("VW900", "VW901", "VW902", "VW903", "VW904", "VW905")
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3}(?:\s*,\s*"
+                          r"[A-Z]{2}\d{3})*)")
+
+#: message discriminator keys, strongest first — "type" is the line-JSON
+#: protocol's key; "kind"/"cmd" only count at direct send-helper calls
+_DISCRIMINATORS = ("type", "kind", "cmd")
+_SEND_TAILS = ("send", "_send")
+_REQUEST_RE = re.compile(r"^(fetch|report|push|query|get|request)_"
+                         r"|request")
+_KIND_PARAMS = ("kind", "type", "cmd", "mtype", "msg_type")
+_MSG_PARAMS = ("msg", "message", "payload", "ev", "event")
+_WIRE_PARAMS = ("body", "line", "raw", "payload", "wire")
+_WIRE_READ_TAILS = ("readline", "recv", "recv_into")
+_WIRE_READ_ROOTS = ("rfile", "sock", "conn", "resp", "response", "wfile")
+_JSON_GUARDS = ("ValueError", "JSONDecodeError", "Exception",
+                "BaseException")
+_SOCKET_GUARDS = ("OSError", "error", "Exception", "BaseException")
+_MUTATORS = ("append", "add", "pop", "popleft", "appendleft", "remove",
+             "clear", "update", "extend", "setdefault", "discard",
+             "insert")
+
+
+def _dotted(node):
+    """``a.b.c`` -> "a.b.c" (None for anything fancier)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_strs(node):
+    """Constant string, or tuple/list of them, -> list (else None)."""
+    s = _const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = [_const_str(el) for el in node.elts]
+        if all(v is not None for v in out):
+            return out
+    return None
+
+
+def _terminates(stmts):
+    """Last statement unconditionally leaves the enclosing block."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _MsgSite(object):
+    """One message-construction site: a literal protocol dict."""
+
+    def __init__(self, kind, fields, open_, lineno):
+        self.kind = kind
+        self.fields = set(fields)
+        self.open = open_          # non-literal keys: field set unknown
+        self.lineno = lineno
+
+
+class _Branch(object):
+    """One handler branch: the statements that run for one kind."""
+
+    def __init__(self, kind, body, msgvar, klass, func, lineno):
+        self.kind = kind
+        self.body = body
+        self.msgvar = msgvar       # name the message flows in under
+        self.klass = klass         # _ClassInfo or None
+        self.func = func           # enclosing function name
+        self.lineno = lineno
+
+
+class _ClassInfo(object):
+    def __init__(self, name):
+        self.name = name
+        self.methods = {}          # method name -> FunctionDef
+        self.fence_attr = None     # e.g. "fence" (IncarnationFence)
+
+
+def _type_expr_target(node, typevars):
+    """The message variable a type-expression reads, or ``None`` when
+    ``node`` is not a type-expression.  Returns ``""`` for a
+    type-expression over a non-Name message (still a dispatch site,
+    but field checks are skipped)."""
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args and \
+            _const_str(node.args[0]) in _DISCRIMINATORS:
+        base = node.func.value
+        return base.id if isinstance(base, ast.Name) else ""
+    if isinstance(node, ast.Subscript) and \
+            _const_str(node.slice) in _DISCRIMINATORS:
+        base = node.value
+        return base.id if isinstance(base, ast.Name) else ""
+    if isinstance(node, ast.Name) and node.id in typevars:
+        return typevars[node.id]
+    return None
+
+
+class _FuncScan(object):
+    """Handler-branch extraction over one function body."""
+
+    def __init__(self, module, func, klass):
+        self.module = module
+        self.func = func
+        self.klass = klass
+        self.typevars = {}     # var assigned from a type-expr -> msgvar
+        if re.search(r"handle|dispatch|event", func.name):
+            args = func.args.args
+            names = [a.arg for a in args if a.arg != "self"]
+            kindp = next((n for n in names if n in _KIND_PARAMS), None)
+            if kindp is not None:
+                msgp = next((n for n in names if n in _MSG_PARAMS), "")
+                self.typevars[kindp] = msgp
+
+    def run(self):
+        self._collect_typevars()
+        self._scan(self.func.body)
+
+    def _collect_typevars(self):
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                tgt = _type_expr_target(node.value, {})
+                if tgt is not None:
+                    self.typevars[node.targets[0].id] = tgt
+
+    def _classify(self, test):
+        """(eq, neq): [(kind, msgvar)] for every discriminator compare
+        anywhere in ``test`` (BoolOp/Not included)."""
+        eq, neq = [], []
+        for cmp_ in [n for n in ast.walk(test)
+                     if isinstance(n, ast.Compare)]:
+            if len(cmp_.ops) != 1:
+                continue
+            sides = (cmp_.left, cmp_.comparators[0])
+            for expr, other in (sides, sides[::-1]):
+                msgvar = _type_expr_target(expr, self.typevars)
+                kinds = _const_strs(other)
+                if msgvar is None or kinds is None:
+                    continue
+                op = cmp_.ops[0]
+                self.module.handled.update(kinds)
+                if isinstance(op, (ast.Eq, ast.In)):
+                    eq.extend((k, msgvar) for k in kinds)
+                elif isinstance(op, (ast.NotEq, ast.NotIn)):
+                    neq.extend((k, msgvar) for k in kinds)
+                break
+        return eq, neq
+
+    def _scan(self, stmts):
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If):
+                eq, neq = self._classify(stmt.test)
+                for kind, msgvar in eq:
+                    self.module.branches.append(_Branch(
+                        kind, stmt.body, msgvar, self.klass,
+                        self.func.name, stmt.lineno))
+                if neq and _terminates(stmt.body):
+                    # guard idiom: `if msg.get("type") != "register":
+                    # ... return` — the REST of the block is the branch
+                    for kind, msgvar in neq:
+                        self.module.branches.append(_Branch(
+                            kind, stmts[i + 1:], msgvar, self.klass,
+                            self.func.name, stmt.lineno))
+                self._scan(stmt.body)
+                self._scan(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.While, ast.With)):
+                self._scan(stmt.body)
+                self._scan(getattr(stmt, "orelse", []) or [])
+            elif isinstance(stmt, ast.Try):
+                self._scan(stmt.body)
+                for h in stmt.handlers:
+                    self._scan(h.body)
+                self._scan(stmt.orelse)
+                self._scan(stmt.finalbody)
+            # nested defs are scanned as their own functions
+
+
+class _ModuleAudit(object):
+    """All VW9xx extraction + local rules over one parsed file."""
+
+    def __init__(self, path, tree, source):
+        self.path = path
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.findings = []
+        self.sites = []        # [_MsgSite]
+        self.handled = set()   # kinds with any handler/compare/default
+        self.branches = []     # [_Branch]
+        self.classes = {}      # name -> _ClassInfo
+
+    # -- suppression (the VT8xx contract) -----------------------------
+    def _suppressed(self, rule, lineno):
+        def marked(ln):
+            if not 1 <= ln <= len(self.lines):
+                return False
+            m = _SUPPRESS_RE.search(self.lines[ln - 1])
+            return bool(m and rule in re.split(r"\s*,\s*",
+                                               m.group(1)))
+        if marked(lineno):
+            return True
+        ln = lineno - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            if marked(ln):
+                return True
+            ln -= 1
+        return False
+
+    def _emit(self, rule, severity, lineno, message, hint=""):
+        if self._suppressed(rule, lineno):
+            return
+        unit = "%s:%d" % (self.path, lineno)
+        self.findings.append(Finding(rule, severity, unit, message,
+                                     hint=hint))
+
+    # -- extraction ----------------------------------------------------
+    def extract(self):
+        self._extract_classes()
+        self._extract_sites()
+        self._extract_handlers()
+        self._extract_get_defaults()
+
+    def _extract_classes(self):
+        for cls in [n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            info = _ClassInfo(cls.name)
+            for node in cls.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    info.methods[node.name] = node
+            init = info.methods.get("__init__")
+            for sub in ast.walk(init) if init else ():
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1):
+                    continue
+                attr = self._self_attr(sub.targets[0])
+                if attr is None:
+                    continue
+                ctor = _dotted(sub.value.func) \
+                    if isinstance(sub.value, ast.Call) else None
+                if "fence" in attr.lower() or \
+                        (ctor and "fence" in ctor.lower()):
+                    info.fence_attr = attr
+            self.classes[cls.name] = info
+
+    @staticmethod
+    def _self_attr(node):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _site_of(self, d, lineno):
+        """Dict literal -> _MsgSite (or None): constant "type" value,
+        or constant "kind"/"cmd" when flowing straight into a send."""
+        fields, open_, kind, disc = [], False, None, None
+        for k, v in zip(d.keys, d.values):
+            name = _const_str(k) if k is not None else None
+            if name is None:
+                open_ = True
+                continue
+            fields.append(name)
+            if name in _DISCRIMINATORS and disc is None:
+                s = _const_str(v)
+                if s is not None:
+                    kind, disc = s, name
+        if kind is None:
+            return None
+        site = _MsgSite(kind, fields, open_, lineno)
+        site.disc = disc
+        return site
+
+    def _extract_sites(self):
+        # direct send-helper args qualify for any discriminator; a
+        # bare literal qualifies only on "type" (the protocol's key)
+        send_args = set()
+        for call in [n for n in ast.walk(self.tree)
+                     if isinstance(n, ast.Call)]:
+            name = _dotted(call.func) or ""
+            if name.rsplit(".", 1)[-1] in _SEND_TAILS:
+                for a in call.args:
+                    send_args.add(id(a))
+        sites_by_var = {}
+        for fn in [n for n in ast.walk(self.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Dict):
+                    site = self._site_of(node.value, node.lineno)
+                    if site is not None:
+                        sites_by_var[(fn, node.targets[0].id)] = site
+                # `spec["x"] = ...` after the literal adds a field
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Subscript) and \
+                        isinstance(node.targets[0].value, ast.Name):
+                    key = _const_str(node.targets[0].slice)
+                    site = sites_by_var.get(
+                        (fn, node.targets[0].value.id))
+                    if site is not None and key is not None:
+                        site.fields.add(key)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            site = self._site_of(node, node.lineno)
+            if site is None:
+                continue
+            if site.disc == "type" or id(node) in send_args:
+                self.sites.append(site)
+
+    def _extract_handlers(self):
+        for fn in [n for n in ast.walk(self.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            klass = None
+            for cls in self.classes.values():
+                if cls.methods.get(fn.name) is fn:
+                    klass = cls
+                    break
+            _FuncScan(self, fn, klass).run()
+
+    def _extract_get_defaults(self):
+        # msg.get("type", "garbage"): the default is a handled kind
+        # (the inbox pump's classification of torn lines)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and len(node.args) == 2 \
+                    and _const_str(node.args[0]) in _DISCRIMINATORS:
+                s = _const_str(node.args[1])
+                if s is not None:
+                    self.handled.add(s)
+
+    # -- branch closure ------------------------------------------------
+    def _closure_scopes(self, branch):
+        """[(stmts, msgvar)]: the branch body plus every same-class
+        method the message variable is passed into (depth <= 3)."""
+        scopes, seen = [], set()
+
+        def expand(stmts, msgvar, klass, depth):
+            scopes.append((stmts, msgvar))
+            if depth >= 3 or klass is None or not msgvar:
+                return
+            for node in ast.walk(ast.Module(body=list(stmts),
+                                            type_ignores=[])):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func) or ""
+                parts = name.split(".")
+                if len(parts) != 2 or parts[0] != "self":
+                    continue
+                callee = klass.methods.get(parts[1])
+                if callee is None:
+                    continue
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and \
+                            arg.id == msgvar:
+                        params = [a.arg for a in callee.args.args
+                                  if a.arg != "self"]
+                        if pos < len(params) and \
+                                (parts[1], params[pos]) not in seen:
+                            seen.add((parts[1], params[pos]))
+                            expand(callee.body, params[pos], klass,
+                                   depth + 1)
+        expand(branch.body, branch.msgvar, branch.klass, 0)
+        return scopes
+
+    @staticmethod
+    def _walk_scopes(scopes):
+        for stmts, msgvar in scopes:
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    yield node, msgvar
+
+    # -- rules ---------------------------------------------------------
+    def check_branches(self, senders, handled):
+        """VW901/VW902/VW903 over this module's handler branches, with
+        the cross-module sender/handled registries."""
+        for br in self.branches:
+            scopes = self._closure_scopes(br)
+            self._vw901(br, scopes, senders)
+            self._vw902(br, scopes)
+            self._vw903(br, scopes)
+
+    def _vw901(self, br, scopes, senders):
+        sites = senders.get(br.kind)
+        if not sites or any(s.open for s in sites) or not br.msgvar:
+            return
+        fields = set().union(*(s.fields for s in sites))
+        flagged = set()
+        for node, msgvar in self._walk_scopes(scopes):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == msgvar:
+                f = _const_str(node.slice)
+                if f is not None and f not in fields \
+                        and f not in flagged:
+                    flagged.add(f)
+                    self._emit(
+                        "VW901", ERROR, node.lineno,
+                        "handler for %r subscripts %s[%r], a field no "
+                        "sender of that kind sets (senders set: %s)"
+                        % (br.kind, msgvar, f,
+                           ", ".join(sorted(fields))),
+                        hint="set the field at every sender, or read "
+                             "it with .get() and handle the miss")
+
+    def _vw902(self, br, scopes):
+        if not _REQUEST_RE.search(br.kind):
+            return
+        for node, _mv in self._walk_scopes(scopes):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func) or ""
+                if name.rsplit(".", 1)[-1] in _SEND_TAILS:
+                    return
+        self._emit(
+            "VW902", ERROR, br.lineno,
+            "request-shaped kind %r is handled without ever sending a "
+            "response — the requester waits forever" % br.kind,
+            hint="send an ack/response message from the handler (or "
+                 "rename the kind if it is fire-and-forget)")
+
+    def _vw903(self, br, scopes):
+        if br.klass is None or br.klass.fence_attr is None \
+                or not br.msgvar:
+            return
+        fence = "self." + br.klass.fence_attr
+        reads_inc = mutates = consults = False
+        for node, msgvar in self._walk_scopes(scopes):
+            if self._is_incarnation_read(node, msgvar):
+                reads_inc = True
+            d = _dotted(node) if isinstance(node, ast.Attribute) \
+                else None
+            if d and (d == fence or d.startswith(fence + ".")):
+                consults = True
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + node.comparators:
+                    for sub in ast.walk(side):
+                        if self._is_incarnation_read(sub, None):
+                            consults = True
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    for el in ast.walk(t):
+                        if self._self_attr(el) or (
+                                isinstance(el, ast.Subscript)
+                                and self._self_attr(el.value)):
+                            mutates = True
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    self._self_attr(node.func.value):
+                mutates = True
+        if reads_inc and mutates and not consults:
+            self._emit(
+                "VW903", ERROR, br.lineno,
+                "%s handler for %r reads the message's incarnation and "
+                "mutates state without consulting the incarnation "
+                "fence — a zombie from a fenced life is re-admitted"
+                % (br.klass.name, br.kind),
+                hint="admit through the fence (fence.admit / compare "
+                     "against the current incarnation) before "
+                     "touching state")
+
+    @staticmethod
+    def _is_incarnation_read(node, msgvar):
+        """``X.get("incarnation")`` or ``X["incarnation"]`` — when
+        ``msgvar`` is given, only on that name."""
+        def base_ok(base):
+            return msgvar is None or (
+                isinstance(base, ast.Name) and base.id == msgvar)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                _const_str(node.args[0]) == "incarnation":
+            return base_ok(node.func.value)
+        if isinstance(node, ast.Subscript) and \
+                _const_str(node.slice) == "incarnation":
+            return base_ok(node.value)
+        return False
+
+    # -- module-local rules -------------------------------------------
+    def _guard_regions(self, guard_tails):
+        regions = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            ok = False
+            for h in node.handlers:
+                if h.type is None:
+                    ok = True
+                    continue
+                types = h.type.elts \
+                    if isinstance(h.type, ast.Tuple) else [h.type]
+                for t in types:
+                    d = _dotted(t) or ""
+                    if d.rsplit(".", 1)[-1] in guard_tails:
+                        ok = True
+            if ok and node.body:
+                end = max(getattr(s, "end_lineno", s.lineno) or
+                          s.lineno for s in node.body)
+                regions.append((node.body[0].lineno, end))
+        return regions
+
+    @staticmethod
+    def _in_regions(lineno, regions):
+        return any(a <= lineno <= b for a, b in regions)
+
+    def check_sockets(self):
+        regions = self._guard_regions(_SOCKET_GUARDS)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "settimeout" and len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value is None:
+                self._emit(
+                    "VW904", WARNING, node.lineno,
+                    "settimeout(None): the read blocks forever on a "
+                    "silent peer",
+                    hint="bound the read (or keep the unbounded read "
+                         "with a lint-ok rationale for why EOF is the "
+                         "liveness signal)")
+            elif tail == "create_connection":
+                has_timeout = len(node.args) >= 2 or any(
+                    kw.arg == "timeout" for kw in node.keywords)
+                if not has_timeout:
+                    self._emit(
+                        "VW904", WARNING, node.lineno,
+                        "socket.create_connection without a timeout: "
+                        "a black-holed master address hangs the "
+                        "connect forever",
+                        hint="pass timeout=...")
+            elif tail == "accept" and not node.args and \
+                    not self._in_regions(node.lineno, regions):
+                self._emit(
+                    "VW904", WARNING, node.lineno,
+                    "accept() outside try/except OSError: closing the "
+                    "listener from the stop path raises in the accept "
+                    "thread instead of unblocking it",
+                    hint="wrap the accept in try/except OSError: "
+                         "return (the close-unblocks idiom)")
+
+    def check_json_loads(self):
+        regions = self._guard_regions(_JSON_GUARDS)
+        funcs = {}    # name -> FunctionDef (innermost wins is fine)
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                funcs[node.name] = node
+        for fn in funcs.values():
+            assigns = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    assigns[node.targets[0].id] = node.value
+            params = {a.arg for a in fn.args.args}
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and (_dotted(node.func) or "")
+                        .rsplit(".", 1)[-1] == "loads"
+                        and "json" in (_dotted(node.func) or "")
+                        and node.args):
+                    continue
+                if not self._wire_derived(node.args[0], assigns,
+                                          params):
+                    continue
+                if self._in_regions(node.lineno, regions):
+                    continue
+                if self._callers_guarded(fn.name, regions):
+                    continue
+                self._emit(
+                    "VW905", ERROR, node.lineno,
+                    "json.loads of wire input with no ValueError "
+                    "guard here or around its callers — one torn "
+                    "line kills the owning thread",
+                    hint="wrap in try/except ValueError and classify "
+                         "the garbage (the _Conn.recv idiom)")
+
+    def _wire_derived(self, expr, assigns, params):
+        def expr_is_wire(e, depth=0):
+            for node in ast.walk(e):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    tail = node.func.attr
+                    chain = _dotted(node.func) or ""
+                    roots = chain.lower().split(".")
+                    if tail in _WIRE_READ_TAILS:
+                        return True
+                    if tail == "read" and any(
+                            r in roots for r in _WIRE_READ_ROOTS):
+                        return True
+                if isinstance(node, ast.Name) and depth < 2:
+                    if node.id in params and \
+                            node.id in _WIRE_PARAMS:
+                        return True
+                    if node.id in assigns and \
+                            assigns[node.id] is not e and \
+                            expr_is_wire(assigns[node.id], depth + 1):
+                        return True
+            return False
+        return expr_is_wire(expr)
+
+    def _callers_guarded(self, fname, regions):
+        sites = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    (_dotted(node.func) or "") \
+                    .rsplit(".", 1)[-1] == fname:
+                sites.append(node.lineno)
+        return bool(sites) and all(
+            self._in_regions(ln, regions) for ln in sites)
+
+
+def _audit_module(path, root=None):
+    with open(path) as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        mod = None
+        finding = Finding("VW900", ERROR, "%s:%d" % (rel, e.lineno or 0),
+                          "file failed to parse: %s" % e)
+        return mod, [finding]
+    return _ModuleAudit(rel, tree, source), []
+
+
+def lint_protocol(paths=None, root=None):
+    """VW9xx over a file set — default: every ``.py`` under
+    ``veles_tpu/services`` (the control plane).  The scanned files form
+    ONE protocol universe: a kind sent in one module and handled in
+    another is matched across them.  Returns sorted Findings; inline
+    ``# lint-ok: VWxxx — reason`` comments suppress accepted sites."""
+    if paths is None:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        base = os.path.join(here, "services")
+        root = root or os.path.dirname(here)
+        paths = sorted(
+            os.path.join(base, f) for f in os.listdir(base)
+            if f.endswith(".py"))
+    findings, modules = [], []
+    for p in paths:
+        mod, errs = _audit_module(p, root=root)
+        findings.extend(errs)
+        if mod is not None:
+            mod.extract()
+            modules.append(mod)
+    handled = set().union(*(m.handled for m in modules)) \
+        if modules else set()
+    senders = {}
+    for m in modules:
+        for s in m.sites:
+            senders.setdefault(s.kind, []).append(s)
+    for m in modules:
+        for s in m.sites:
+            if s.kind not in handled:
+                m._emit(
+                    "VW900", ERROR, s.lineno,
+                    "message kind %r is constructed here but handled "
+                    "nowhere in the scanned tree — the send is a "
+                    "silent no-op on the peer" % s.kind,
+                    hint="add a handler branch for it (or delete the "
+                         "dead send)")
+        m.check_branches(senders, handled)
+        m.check_sockets()
+        m.check_json_loads()
+        findings.extend(m.findings)
+    return sort_findings(findings)

@@ -133,7 +133,6 @@ root.common.update({
     },
     "random_seed": 1234,
     "timings": False,
-    "trace": {"run": False},
     # crash-consistent checkpointing (services.snapshotter,
     # docs/distributed_training.md "Preemption-safe training"):
     # keep_last bounds the on-disk checkpoint ring per prefix (0 =
@@ -241,7 +240,14 @@ root.common.update({
             "elastic": True, "loss_strikes": 2, "loss_window_s": 60,
             "reexpand": True, "replicate_max_mb": 64,
             "elastic_mesh": False},
-    "web": {"host": "0.0.0.0", "port": 8090},
+    # status/benchmark web UI (services.web_status): host/port are the
+    # WebStatusServer defaults (--web-status PORT overrides the port);
+    # bench_cache points the benchmark page at a measurement store
+    # (None = the repo-root cache next to bench.py)
+    "web": {"host": "127.0.0.1", "port": 8090, "bench_cache": None},
+    # telemetry thresholds (telemetry.mfu): warn when measured MFU
+    # falls below this fraction of the roofline prediction
+    "telemetry": {"mfu_warn_fraction": 0.5},
     # the flight recorder / crash forensics / watchdog layer
     # (veles_tpu.telemetry.flight + .health, docs/services.md "Black
     # box").  watchdog_seconds: None = unset (standalone stays

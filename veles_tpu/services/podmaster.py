@@ -2746,11 +2746,18 @@ class PodAgent(object):
         # flow agent->master only), so a timed read would misread any
         # quiet 30s as a lost master and kill a healthy worker.  A real
         # master death closes the socket (EOF) and unblocks the read.
+        # lint-ok: VW904 — EOF is the liveness signal on this socket
         sock.settimeout(None)
         self._conn = _Conn(sock)
         self._conn.send({"type": "register", "host": self.host,
                          "incarnation": None, "pid": os.getpid()})
         hello = self._conn.recv()
+        if hello and hello.get("type") == "refused":
+            # the master names why (duplicate host, register-first,
+            # fenced incarnation) — surface it instead of the raw dict
+            self._print("registration refused: %s",
+                        hello.get("reason", "unspecified"))
+            return 1
         if not hello or hello.get("type") != "welcome":
             self._print("registration refused: %s", hello)
             return 1

@@ -560,7 +560,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             root.common.snapshot.get("commit_retries", 3))))
         self.retry_backoff = float(kwargs.get(
             "retry_backoff_ms",
-            root.common.snapshot.get("retry_backoff_ms", 100.0))) / 1e3
+            root.common.snapshot.get("retry_backoff_ms", 100))) / 1e3
         self.manifest = bool(kwargs.get(
             "manifest", root.common.snapshot.get("manifest", True)))
         #: commit-time poison valve (:class:`SnapshotNonFiniteError`):

@@ -289,8 +289,15 @@ refresh(); setInterval(refresh, 3000);
 
 
 class WebStatusServer(Logger):
-    def __init__(self, host="127.0.0.1", port=8090):
+    def __init__(self, host=None, port=None):
         super(WebStatusServer, self).__init__()
+        # explicit args win; the root.common.web knobs are the defaults
+        # (--web-status PORT passes the port explicitly)
+        from veles_tpu.config import root
+        if host is None:
+            host = str(root.common.web.get("host", "127.0.0.1"))
+        if port is None:
+            port = int(root.common.web.get("port", 8090))
         self.host, self.port = host, port
         self._workflows = {}
         self._serving = None

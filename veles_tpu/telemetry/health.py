@@ -142,7 +142,7 @@ def install(mode=None, workflow=None):
                   workflow=getattr(workflow, "name", None))
     try:
         from veles_tpu.config import root
-        cap = root.common.blackbox.get("capacity", None)
+        cap = root.common.blackbox.get("capacity", 4096)
         if cap:
             flight.recorder.set_capacity(cap)
     except Exception:   # noqa: BLE001 — config is advisory here
