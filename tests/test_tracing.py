@@ -1,0 +1,328 @@
+"""Request tracing tier (telemetry.tracing + the serving plane's span
+points): the span store is a bounded ring whose overflow drops oldest
+with a counted gauge, span adds stay within the flight recorder's
+~2 µs/event budget, forged X-Veles-Trace headers are stripped at the
+router edge (it always mints), and ONE trace id yields a gapless
+single-terminal timeline across a mid-stream failover and across a
+two-phase prefill handoff — byte-identical result included (the trace
+must never perturb the splice).  Mirrors test_router.py's fleet
+idioms; one tiny untrained transformer is shared module-wide."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.services.router import FleetRouter
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.tracing import SpanStore
+
+T, VOCAB = 16, 11
+PROMPT = [1, 2, 3, 4, 5]
+
+
+@pytest.fixture(scope="module")
+def gen():
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models import zoo
+    from veles_tpu.models.generate import LMGenerator
+    from veles_tpu.models.standard_workflow import StandardWorkflow
+
+    prng.seed_all(31)
+    toks = np.random.RandomState(5).randint(
+        0, VOCAB, (8, T)).astype(np.int32)
+    wf = StandardWorkflow(
+        layers=zoo.transformer_lm(vocab_size=VOCAB, d_model=16,
+                                  n_heads=2, n_layers=1, dropout=0.0),
+        loader=FullBatchLoader(None, data=toks, labels=toks,
+                               minibatch_size=4,
+                               class_lengths=[0, 4, 4]),
+        loss="lm", decision_config={"max_epochs": 1},
+        name="tracing-serve")
+    wf.initialize()
+    return LMGenerator(wf.trainer, max_len=T)
+
+
+def _post(router, body, headers=None, timeout=120):
+    conn = http.client.HTTPConnection(router.host, router.port,
+                                      timeout=timeout)
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    conn.request("POST", router.path, json.dumps(body), h)
+    return conn.getresponse(), conn
+
+
+def _settled_timeline(router, tid, timeout=5.0):
+    """The trace's timeline once its terminal span landed — the edge
+    records it in the handler's ``finally`` AFTER the done line is
+    written, so a client acting on ``done`` can be a beat early."""
+    deadline = time.monotonic() + timeout
+    timeline = None
+    while time.monotonic() < deadline:
+        timeline = router.trace_timeline(tid)
+        if timeline is not None and timeline["gapless"]:
+            break
+        time.sleep(0.02)
+    return timeline
+
+
+# ------------------------------------------------------------- ids/headers
+class TestIdsAndHeader:
+    def test_ids_are_valid_and_unique(self):
+        tids = {tracing.new_trace_id() for _ in range(64)}
+        sids = {tracing.new_span_id() for _ in range(64)}
+        assert len(tids) == 64 and len(sids) == 64
+        assert all(tracing.valid_id(t) for t in tids | sids)
+
+    def test_header_round_trip_and_forgeries_rejected(self):
+        t, s = tracing.new_trace_id(), tracing.new_span_id()
+        assert tracing.parse_header(
+            tracing.format_header(t, s)) == (t, s)
+        assert tracing.parse_header(tracing.format_header(t)) == (t,
+                                                                  None)
+        for forged in (None, "", "xyz", "UPPER0123456789",
+                       "/deadbeef", "a" * 33,
+                       "deadbeef;rm -rf", "..", "0x12"):
+            assert tracing.parse_header(forged) is None, forged
+        # junk PARENT only: the valid trace id survives, the parent is
+        # dropped (a mid-chain hop still joins the right trace)
+        for lenient in ("deadbeef/", "deadbeef/XYZ",
+                        "deadbeef/deadbeef/deadbeef"):
+            assert tracing.parse_header(lenient) == ("deadbeef", None)
+
+
+# --------------------------------------------------------------- span store
+class TestSpanStore:
+    def test_ring_overflow_drops_oldest_with_counted_gauge(self):
+        store = SpanStore(capacity=4, max_spans=8)
+        tids = ["%016x" % i for i in range(1, 7)]
+        for tid in tids:
+            store.add(tid, "request")
+        # oldest two traces evicted, newest four resident, each
+        # eviction counted on the gauge
+        assert store.dropped == 2
+        assert store.spans(tids[0]) == [] and store.spans(tids[1]) == []
+        assert all(store.spans(t) for t in tids[2:])
+
+    def test_per_trace_span_cap_drops_excess(self):
+        store = SpanStore(capacity=4, max_spans=3)
+        tid = "%016x" % 7
+        for i in range(5):
+            store.add(tid, "s%d" % i)
+        spans = store.spans(tid)
+        # ring discipline: the OLDEST spans go first, each counted
+        assert [s["name"] for s in spans] == ["s2", "s3", "s4"]
+        assert store.dropped == 2
+
+    def test_disabled_store_records_nothing(self):
+        store = SpanStore(capacity=4, max_spans=8)
+        store.enabled = False
+        store.add("%016x" % 9, "request")
+        assert store.spans("%016x" % 9) == []
+        assert store.dropped == 0
+
+    def test_span_add_overhead_under_budget(self):
+        """Acceptance: span adds share the flight recorder's ~2 µs
+        budget; assert the same generous CI bound and print the
+        measured number (documented in docs/services.md "Request
+        tracing").  The NON-evicting path is the budgeted one —
+        eviction is rare by construction (capacity >> live traces)."""
+        store = SpanStore(capacity=8, max_spans=50000)
+        tid = tracing.new_trace_id()
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            store.add(tid, "bench", i=i)
+        per_span = (time.perf_counter() - t0) / n
+        print("tracing span add overhead: %.2f us/span"
+              % (per_span * 1e6))
+        assert per_span < 50e-6      # ~25x the 2 µs target: CI headroom
+
+
+# ---------------------------------------------------------------- validate
+class TestValidate:
+    def _chain(self):
+        tid = tracing.new_trace_id()
+        root = {"trace": tid, "span": "a" * 8, "parent": None,
+                "name": "request", "ts": 1.0}
+        child = {"trace": tid, "span": "b" * 8, "parent": "a" * 8,
+                 "name": "router.leg", "ts": 1.1}
+        term = {"trace": tid, "span": "c" * 8, "parent": "a" * 8,
+                "name": "request.done", "ts": 1.2, "terminal": True}
+        return [root, child, term]
+
+    def test_gapless_chain_passes(self):
+        v = tracing.validate(self._chain())
+        assert v["ok"] and not v["problems"]
+
+    def test_dangling_parent_multi_root_multi_terminal_dup_fail(self):
+        chain = self._chain()
+        assert not tracing.validate(chain[1:])["ok"]       # no root
+        forged = dict(chain[1], parent="f" * 8)
+        assert not tracing.validate(
+            [chain[0], forged, chain[2]])["ok"]            # dangling
+        dup = dict(chain[1])
+        assert not tracing.validate(chain + [dup])["ok"]   # dup span id
+        term2 = dict(chain[2], span="d" * 8)
+        assert not tracing.validate(chain + [term2])["ok"]  # 2 terminals
+        assert not tracing.validate(chain[:2])["ok"]       # no terminal
+
+
+# ------------------------------------------------------------- edge minting
+class TestForgedHeaderStrippedAtEdge:
+    def test_router_ignores_incoming_trace_header(self, gen):
+        """The router is the trust boundary: an incoming X-Veles-Trace
+        is a forgery there (same rule as the resume-field strip) — the
+        response must carry a freshly minted id, and the forged id
+        must own no spans."""
+        router = FleetRouter(port=0, health_interval_ms=10000)
+        router.spawn_local(gen, 1, continuous_slots=2)
+        router.start()
+        try:
+            forged = "deadbeefdeadbeef"
+            resp, conn = _post(
+                router, {"input": PROMPT, "generate": {"max_new": 2}},
+                headers={tracing.TRACE_HEADER:
+                         forged + "/0011223344556677"})
+            assert resp.status == 200
+            out = json.loads(resp.read())
+            conn.close()
+            minted = out.get("trace")
+            assert minted and minted != forged
+            assert tracing.store.spans(forged) == []
+            spans = tracing.store.spans(minted)
+            roots = [s for s in spans if s.get("parent") is None]
+            assert len(roots) == 1 and roots[0].get("edge") == "router"
+        finally:
+            router.stop()
+
+
+# ----------------------------------------------------- cross-hop timelines
+class TestTraceAcrossFailover:
+    def test_failover_timeline_gapless_one_terminal(self, gen,
+                                                    f32_precision):
+        """Mid-stream SIGKILL-equivalent (engine stop) on the pinned
+        replica: the client still sees ONE byte-identical stream, and
+        its ONE trace id reconstructs a gapless timeline — the
+        failover span chain stays connected and exactly one terminal
+        span closes it."""
+        router = FleetRouter(port=0, health_interval_ms=10000,
+                             affinity="session")
+        rids = router.spawn_local(gen, 2, continuous_slots=2)
+        router.start()
+        try:
+            resp, conn = _post(router, {"input": PROMPT,
+                                        "session": "tfo",
+                                        "generate": {"max_new": 8}})
+            assert resp.status == 200
+            expected = json.loads(resp.read())["result"][0]
+            conn.close()
+            for a in router._local_apis:
+                a.engine.wait(a.engine.submit_async(PROMPT, 8))
+            pinned = router._sessions["tfo"]
+            victim = router._local_apis[rids.index(pinned)]
+            orig = victim.engine.cb.tick
+
+            def slow_tick():
+                time.sleep(0.05)
+                return orig()
+
+            victim.engine.cb.tick = slow_tick
+            resp, conn = _post(router, {
+                "input": PROMPT, "session": "tfo",
+                "generate": {"max_new": 8, "stream": True}})
+            assert resp.status == 200
+            got, done, killed = list(PROMPT), None, False
+            while True:
+                raw = resp.fp.readline()
+                if not raw:
+                    break
+                msg = json.loads(raw)
+                if "tokens" in msg:
+                    got.extend(msg["tokens"])
+                    if not killed:
+                        killed = True
+                        threading.Thread(target=victim.engine.stop,
+                                         daemon=True).start()
+                else:
+                    assert msg.get("done"), msg
+                    done = msg
+                    break
+            conn.close()
+            assert killed and done and done.get("resumed")
+            assert got == expected and list(done["result"]) == expected
+            # the done line carries the trace id the edge minted
+            tid = done.get("trace")
+            assert tid and tracing.valid_id(tid)
+            timeline = _settled_timeline(router, tid)
+            assert timeline is not None
+            assert timeline["gapless"], timeline["problems"]
+            spans = timeline["spans"]
+            names = [s["name"] for s in spans]
+            assert "router.failover" in names
+            assert names.count("router.leg") >= 2      # both attempts
+            assert sum(1 for s in spans
+                       if s.get("terminal")) == 1
+            # phase decomposition survived the splice
+            assert set(timeline["phases"]) >= {"queue", "prefill",
+                                               "decode", "stream"}
+        finally:
+            router.stop()
+
+
+class TestTraceAcrossPrefillHandoff:
+    def test_handoff_timeline_gapless_one_terminal(self, gen,
+                                                   f32_precision):
+        """Two-phase prefill handoff (prefill tier -> decode tier via
+        prefix-resume): byte-identical stream, ONE trace id, gapless
+        chain through router.handoff, exactly one terminal span."""
+        router = FleetRouter(port=0, rng_seed=3,
+                             health_interval_ms=50,
+                             prefill_prompt_min=8,
+                             prefill_handoff_new=2)
+        router.start()
+        router.spawn_local(gen, 2, continuous_slots=2,
+                           roles=["prefill", "decode"])
+        try:
+            long_prompt = list(range(1, 11))
+            expected = gen.generate(
+                np.asarray([long_prompt], np.int32), 5)[0].tolist()
+            resp, conn = _post(router, {
+                "input": long_prompt,
+                "generate": {"max_new": 5, "stream": True}})
+            assert resp.status == 200
+            got, done = list(long_prompt), None
+            while True:
+                raw = resp.fp.readline()
+                if not raw:
+                    break
+                msg = json.loads(raw)
+                if "tokens" in msg:
+                    got.extend(msg["tokens"])
+                if msg.get("done"):
+                    done = msg
+                    break
+            conn.close()
+            assert got == expected
+            assert done is not None and done["result"] == expected
+            tid = done.get("trace")
+            assert tid and tracing.valid_id(tid)
+            timeline = _settled_timeline(router, tid)
+            assert timeline is not None
+            assert timeline["gapless"], timeline["problems"]
+            spans = timeline["spans"]
+            names = [s["name"] for s in spans]
+            assert "router.handoff" in names
+            assert names.count("router.leg") >= 2      # both tiers
+            assert sum(1 for s in spans if s.get("terminal")) == 1
+            # both tiers' spans share the ONE trace id
+            assert {s["trace"] for s in spans} == {tid}
+            # a rendered timeline ends with the gapless verdict
+            text = tracing.render_timeline(spans)
+            assert "gapless: yes" in text
+        finally:
+            router.stop()

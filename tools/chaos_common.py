@@ -168,12 +168,14 @@ MONO_TO_WALL = time.time() - time.monotonic()
 
 def fleet_stream_client(router_host, router_port, router_path,
                         prompt, max_new, expected, session, tally,
-                        lock, errors=None, timeout=180):
+                        lock, errors=None, timeout=180, traces=None):
     """One fleet storm client: stream through the ROUTER and verify
     the full concatenated result — chunk lines must splice to exactly
     the done line's result, and that result must equal the expected
     uninterrupted output (failover must be invisible).  Outcome lands
-    in ``tally`` under ``lock``."""
+    in ``tally`` under ``lock``.  ``traces`` (a list): ok requests
+    append their done-line trace id — the trace-completeness gate's
+    input."""
     body = json.dumps({"input": prompt, "session": session,
                        "generate": {"max_new": max_new,
                                     "stream": True}})
@@ -192,6 +194,7 @@ def fleet_stream_client(router_host, router_port, router_path,
             outcome = "http_%d" % resp.status
         else:
             got, result, done = list(prompt), None, False
+            trace_id = None
             while True:
                 raw = resp.fp.readline()
                 if not raw:
@@ -201,6 +204,7 @@ def fleet_stream_client(router_host, router_port, router_path,
                     got.extend(msg["tokens"])
                 elif msg.get("done"):
                     result, done = msg["result"], True
+                    trace_id = msg.get("trace")
                     break
                 elif "error" in msg:
                     outcome = "stream_error"
@@ -217,12 +221,71 @@ def fleet_stream_client(router_host, router_port, router_path,
                 outcome = "bad_result"
             else:
                 outcome = "ok"
+                if traces is not None and trace_id:
+                    with lock:
+                        traces.append(trace_id)
         conn.close()
     except Exception:  # noqa: BLE001 — chaos clients absorb anything
         outcome = "error"
     finally:
         with lock:
             tally[outcome] = tally.get(outcome, 0) + 1
+
+
+def trace_gate(router_host, router_port, router_path, traces, fails,
+               label="", sample_path=None):
+    """The trace-completeness gate: EVERY ok-accounted storm request
+    must reconstruct a gapless cross-process timeline from the
+    router's ``/trace/<id>`` aggregation — through kills, failovers
+    and prefill handoffs (docs/services.md "Request tracing").
+    ``sample_path``: write one rendered timeline as the CI artifact,
+    preferring a trace that CROSSED a failover or handoff (the
+    interesting kind).  Returns (fails, n_gapless, sample) where
+    sample is ``{"trace": id, "crossed": bool}`` or None."""
+    from veles_tpu.telemetry import tracing
+    prefix = ("%s " % label) if label else ""
+    if not traces:
+        fails.append("%strace gate: no trace ids captured" % prefix)
+        return fails, 0, None
+    n_gapless, sample = 0, None
+    for tid in traces:
+        try:
+            status, payload = http_json(
+                router_host, router_port,
+                "%s/trace/%s" % (router_path, tid))
+        except Exception as e:  # noqa: BLE001 — the audit itself
+            fails.append("%strace %s: fetch failed (%r)"
+                         % (prefix, tid, e))
+            continue
+        if status != 200:
+            fails.append("%strace %s: HTTP %d" % (prefix, tid, status))
+            continue
+        if not payload.get("gapless"):
+            fails.append("%strace %s: not gapless: %s"
+                         % (prefix, tid,
+                            "; ".join(payload.get("problems") or
+                                      ["?"])))
+            continue
+        n_gapless += 1
+        crossed = any(s.get("name") in ("router.failover",
+                                        "router.handoff")
+                      for s in payload.get("spans") or [])
+        if sample is None or (crossed and not sample[1]):
+            sample = (tid, crossed, payload["spans"])
+    if sample is not None and sample_path:
+        try:
+            with open(sample_path, "w") as f:
+                f.write(tracing.render_timeline(
+                    sample[2],
+                    title="trace %s (%d spans%s)"
+                    % (sample[0], len(sample[2]),
+                       ", crossed a failover/handoff"
+                       if sample[1] else "")) + "\n")
+        except OSError:
+            pass
+    return fails, n_gapless, (
+        {"trace": sample[0], "crossed": sample[1]}
+        if sample else None)
 
 
 # ===================================================================

@@ -114,6 +114,29 @@ def _kill_host(master, victim, errors):
     return {"agent_pid": agent.pid, "replica_pids": sorted(pids)}
 
 
+def _trace_cli(router, tid, sample_path):
+    """The acceptance-path ``veles-tpu-trace <id>`` invocation
+    against the LIVE fleet: its rendered timeline (gapless verdict +
+    phase footer included) becomes the CI sample artifact.  Returns
+    the CLI's exit code."""
+    import contextlib
+    import io
+
+    from veles_tpu.telemetry import tracecli
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tracecli.main(
+            [tid, "--url", "http://%s:%d%s"
+             % (router.host, router.port, router.path)])
+    if sample_path and buf.getvalue():
+        try:
+            with open(sample_path, "w") as f:
+                f.write(buf.getvalue())
+        except OSError:
+            pass
+    return rc
+
+
 def run_chaos(args):
     from veles_tpu.services.podmaster import ServeFleetMaster
     from veles_tpu.telemetry import flight
@@ -182,13 +205,14 @@ def run_chaos(args):
         # ---- the storm through the ROUTER --------------------------
         router = master.router
         tally, lock = {}, threading.Lock()
-        stream_errors = []
+        stream_errors, traces = [], []
         threads = [threading.Thread(
             target=cc.fleet_stream_client,
             args=(router.host, router.port, router.path, prompt,
                   args.max_new, expected,
                   "sess-%d" % (i % args.sessions), tally, lock),
-            kwargs={"errors": stream_errors}, daemon=True)
+            kwargs={"errors": stream_errors, "traces": traces},
+            daemon=True)
             for i in range(args.clients)]
         t0 = time.monotonic()
         for th in threads:
@@ -235,6 +259,21 @@ def run_chaos(args):
         report["phases"]["storm_s"] = round(time.monotonic() - t0, 2)
         report["tally"] = tally
         report["stream_errors"] = stream_errors[:20]
+
+        # ---- trace completeness: every ok request reconstructs a
+        # gapless timeline from the live router (survivor spans still
+        # resident — must run BEFORE scale-down drains them)
+        tfails = []
+        tfails, n_gapless, sample = cc.trace_gate(
+            router.host, router.port, router.path, traces, tfails,
+            label="fleet", sample_path=args.trace_sample)
+        report["trace_ids"] = len(traces)
+        report["trace_gapless"] = n_gapless
+        report["trace_sample"] = sample
+        report["trace_fails"] = tfails[:20]
+        if sample is not None:
+            report["trace_cli_rc"] = _trace_cli(
+                router, sample["trace"], args.trace_sample)
 
         # ---- detection latency: first replica_down after the kill --
         down_ts = None
@@ -410,6 +449,24 @@ def gates(report, health_interval_ms=100.0):
                 and h.get("counted"):
             fails.append("a host-death replacement consumed the "
                          "crash-loop budget: %r" % (h,))
+    # trace completeness: 100 % of ok-accounted requests reconstruct
+    # gapless through the host kill, and the router rollup carries
+    # the per-phase decomposition
+    fails.extend(report.get("trace_fails") or [])
+    if report.get("trace_gapless") != tally.get("ok", 0):
+        fails.append("trace completeness: %r gapless timelines for "
+                     "%r ok requests"
+                     % (report.get("trace_gapless"),
+                        tally.get("ok", 0)))
+    sample = report.get("trace_sample") or {}
+    if not sample.get("crossed"):
+        fails.append("no gapless trace crossed the host SIGKILL "
+                     "(no router.failover span in any ok timeline)")
+    if report.get("trace_cli_rc") != 0:
+        fails.append("veles-tpu-trace against the live fleet exited "
+                     "%r" % report.get("trace_cli_rc"))
+    if not (report.get("router_metrics") or {}).get("phases"):
+        fails.append("router /metrics carried no per-phase rollup")
     # survivors leak-free
     for rep, leaks in (report.get("survivor_leaks") or {}).items():
         if leaks.get("error"):
@@ -508,13 +565,14 @@ def run_prefill_chaos(args):
         # ---- the long-prompt storm through the router --------------
         router = master.router
         tally, lock = {}, threading.Lock()
-        stream_errors = []
+        stream_errors, traces = [], []
         threads = [threading.Thread(
             target=cc.fleet_stream_client,
             args=(router.host, router.port, router.path, prompt,
                   args.max_new, expected,
                   "sess-%d" % (i % args.sessions), tally, lock),
-            kwargs={"errors": stream_errors, "timeout": 600},
+            kwargs={"errors": stream_errors, "timeout": 600,
+                    "traces": traces},
             daemon=True) for i in range(args.clients)]
         t0 = time.monotonic()
         for th in threads:
@@ -547,6 +605,19 @@ def run_prefill_chaos(args):
         report["phases"] = {"storm_s": round(time.monotonic() - t0, 2)}
         report["tally"] = tally
         report["stream_errors"] = stream_errors[:20]
+
+        # ---- trace completeness through the prefill kill + handoff -
+        tfails = []
+        tfails, n_gapless, sample = cc.trace_gate(
+            router.host, router.port, router.path, traces, tfails,
+            label="prefill", sample_path=args.trace_sample)
+        report["trace_ids"] = len(traces)
+        report["trace_gapless"] = n_gapless
+        report["trace_sample"] = sample
+        report["trace_fails"] = tfails[:20]
+        if sample is not None:
+            report["trace_cli_rc"] = _trace_cli(
+                router, sample["trace"], args.trace_sample)
 
         # ---- the replacement must be PREFILL-role and ready --------
         def replacement():
@@ -603,6 +674,15 @@ def prefill_gates(report):
     if report.get("replacement_ready_s") is None:
         fails.append("no replacement prefill-role replica became "
                      "ready")
+    fails.extend(report.get("trace_fails") or [])
+    if report.get("trace_gapless") != tally.get("ok", 0):
+        fails.append("trace completeness: %r gapless timelines for "
+                     "%r ok requests"
+                     % (report.get("trace_gapless"),
+                        tally.get("ok", 0)))
+    if report.get("trace_cli_rc") != 0:
+        fails.append("veles-tpu-trace against the live fleet exited "
+                     "%r" % report.get("trace_cli_rc"))
     final = report.get("final") or {}
     if final.get("hold_replace"):
         fails.append("a valve held replacements: %r"
@@ -667,6 +747,10 @@ def main(argv=None):
     ap.add_argument("--flight-dump", default=None, metavar="DIR",
                     help="merged flight/blackbox artifacts (CI "
                     "upload)")
+    ap.add_argument("--trace-sample", default=None, metavar="FILE",
+                    help="write one rendered request timeline "
+                    "(preferring a failover/handoff survivor) — the "
+                    "CI trace artifact")
     args = ap.parse_args(argv)
 
     if args.prefill_kill:

@@ -157,14 +157,15 @@ def _rst_close(sock):
 
 
 def _client(api, prompt, max_new, behavior, tally, lock,
-            slow_delay=0.4, deadline_ms=None):
+            slow_delay=0.4, deadline_ms=None, traces=None):
     """One load-test client.  behavior: 'normal' | 'disconnect' |
-    'slowloris' | 'buffered'."""
+    'slowloris' | 'buffered'.  ``traces``: ok requests append their
+    trace id (the trace-completeness gate's input)."""
     opts = {"max_new": max_new, "stream": behavior != "buffered"}
     if deadline_ms:
         opts["deadline_ms"] = deadline_ms
     body = json.dumps({"input": prompt, "generate": opts})
-    outcome = "error"
+    outcome, tid = "error", None
     try:
         conn = http.client.HTTPConnection(api.host, api.port,
                                           timeout=120)
@@ -185,7 +186,7 @@ def _client(api, prompt, max_new, behavior, tally, lock,
             resp.read()
             outcome = "http_%d" % resp.status
         elif behavior == "buffered":
-            json.loads(resp.read())
+            tid = json.loads(resp.read()).get("trace")
             outcome = "ok"
         else:
             lines, done = 0, False
@@ -203,6 +204,7 @@ def _client(api, prompt, max_new, behavior, tally, lock,
                 msg = json.loads(raw)
                 if msg.get("done"):
                     done = True
+                    tid = msg.get("trace")
                     break
                 if "error" in msg:
                     outcome = "stream_error"
@@ -214,6 +216,8 @@ def _client(api, prompt, max_new, behavior, tally, lock,
     finally:
         with lock:
             tally[outcome] = tally.get(outcome, 0) + 1
+            if outcome == "ok" and traces is not None and tid:
+                traces.append(tid)
 
 
 def _wait_idle(engine, timeout=120.0):
@@ -285,10 +289,12 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
             else:
                 behaviors.append("normal")
         t0 = time.monotonic()
+        traces = []
         threads = [threading.Thread(
             target=_client,
             args=(api, prompt, max_new, b, tally, lock),
-            kwargs={"slow_delay": slow_delay}, daemon=True)
+            kwargs={"slow_delay": slow_delay, "traces": traces},
+            daemon=True)
             for b in behaviors]
         for th in threads:
             th.start()
@@ -312,6 +318,39 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         report["storm_completed_tokens"] = done_toks
         report["storm_ms_per_tok"] = (round(storm_s * 1e3 / done_toks,
                                             4) if done_toks else None)
+
+        # ---- trace completeness: every ok request reconstructs a
+        # gapless timeline from the replica's own span store (the
+        # replica is the edge here, so it minted and terminated each
+        # trace; docs/services.md "Request tracing")
+        from veles_tpu.telemetry import tracing
+        time.sleep(0.2)      # let the last handlers' terminal spans land
+        tfails, n_gapless, sample_spans = [], 0, None
+        for tid in traces:
+            try:
+                status, payload = cc.http_json(
+                    api.host, api.port, api.path + "/trace/" + tid)
+            except Exception as e:  # noqa: BLE001 — the audit itself
+                tfails.append("trace %s: fetch failed (%r)"
+                              % (tid, e))
+                continue
+            spans = payload.get("spans") or []
+            verdict = tracing.validate(spans)
+            if status != 200 or not verdict["ok"]:
+                tfails.append("trace %s: HTTP %d: %s"
+                              % (tid, status,
+                                 "; ".join(verdict["problems"])))
+                continue
+            n_gapless += 1
+            if sample_spans is None:
+                sample_spans = (tid, spans)
+        report["trace_ids"] = len(traces)
+        report["trace_gapless"] = n_gapless
+        report["trace_fails"] = tfails[:20]
+        if sample_spans is not None:
+            report["trace_sample"] = sample_spans[0]
+            report["trace_sample_timeline"] = tracing.render_timeline(
+                sample_spans[1], title="trace %s" % sample_spans[0])
 
         # ---- recovery: chaos off, drain, the valve must close and
         # fresh requests must succeed
@@ -338,6 +377,16 @@ def run(clients=200, disconnect=0.25, slowloris=0.10, buffered=0.15,
         _wait_idle(eng)
         metrics = eng.metrics()
         report["metrics"] = metrics
+        # per-phase latency decomposition (docs/services.md "Request
+        # tracing"): where a completed request's time actually went —
+        # the same queue/prefill/decode split the router rolls up
+        # fleet-wide on /metrics
+        report["phase_ms"] = {
+            phase: {"p50": metrics.get("p50_" + key),
+                    "p99": metrics.get("p99_" + key)}
+            for phase, key in (("queue", "queue_wait_ms"),
+                               ("prefill", "prefill_ms"),
+                               ("decode", "pure_decode_ms"))}
         report["leaks"] = eng.leak_check()
         report["shed_cycle"] = bool(
             metrics["shed_total"] > 0
@@ -455,6 +504,20 @@ def gates(report, expect_shed=True, require_slo=False):
         fails.append("no shed+recover cycle (shed_total=%r, state=%r)"
                      % (report.get("metrics", {}).get("shed_total"),
                         report.get("metrics", {}).get("shed_state")))
+    # trace completeness: every ok-accounted storm request must have
+    # yielded a trace id on its done line AND reconstruct a gapless
+    # timeline from the replica span store
+    fails.extend(report.get("trace_fails", []))
+    n_ids = report.get("trace_ids", 0)
+    n_ok = report.get("tally", {}).get("ok", 0)
+    if n_ids != n_ok:
+        fails.append("trace ids captured (%d) != ok requests (%d)"
+                     % (n_ids, n_ok))
+    if n_ids and report.get("trace_gapless", 0) != n_ids:
+        fails.append("only %d/%d traces reconstruct gapless"
+                     % (report.get("trace_gapless", 0), n_ids))
+    if not n_ids:
+        fails.append("storm captured no trace ids")
     return fails
 
 
@@ -583,6 +646,20 @@ def _run_mixed_once(prefill_segment, streamers=6, stream_new=48,
             return round(vals[min(len(vals) - 1,
                                   int(q / 100.0 * len(vals)))], 3)
 
+        # phase-attribution audit: every completed request's
+        # prefill/decode split must partition its admitted→finished
+        # span exactly (non-overlapping by construction — a drifted
+        # decode-start stamp would show up as residual here)
+        hist = [h for h in list(eng._history) if "prefill_ms" in h]
+        attr = {
+            "n": len(hist),
+            "negative": sum(1 for h in hist
+                            if h["prefill_ms"] < 0
+                            or h["pure_decode_ms"] < 0),
+            "max_residual_ms": (round(max(
+                abs(h["prefill_ms"] + h["pure_decode_ms"]
+                    - h["decode_ms"]) for h in hist), 6)
+                if hist else None)}
         return {"prefill_segment": prefill_segment,
                 "tally": tally,
                 "stuck_streamers": sum(1 for th in threads
@@ -591,6 +668,11 @@ def _run_mixed_once(prefill_segment, streamers=6, stream_new=48,
                 "p99_decode_stall_ms": m["p99_decode_stall_ms"],
                 "prefill_ms_per_tok": m["prefill_ms_per_tok"],
                 "prefill_segments_total": m["prefill_segments_total"],
+                "p50_prefill_ms": m.get("p50_prefill_ms"),
+                "p99_prefill_ms": m.get("p99_prefill_ms"),
+                "p50_pure_decode_ms": m.get("p50_pure_decode_ms"),
+                "p99_pure_decode_ms": m.get("p99_pure_decode_ms"),
+                "phase_attr": attr,
                 "client_gap_p50_ms": pct(gaps, 50),
                 "client_gap_p99_ms": pct(gaps, 99),
                 "client_gaps": len(gaps),
@@ -641,6 +723,23 @@ def mixed_gates(report):
                          % (name, half["stuck_streamers"]))
         leaks = half.get("leaks") or {}
         cc.leak_gate(leaks, fails, label=name)
+        # prefill-vs-decode attribution must be non-overlapping:
+        # the two phases partition each request's admitted→finished
+        # span, so their sum can never drift off it and neither
+        # share can go negative
+        attr = half.get("phase_attr") or {}
+        if not attr.get("n"):
+            fails.append("%s run recorded no phase attribution"
+                         % name)
+        else:
+            if attr.get("negative"):
+                fails.append("%s run: %d requests with a negative "
+                             "phase share" % (name, attr["negative"]))
+            resid = attr.get("max_residual_ms")
+            if resid is not None and resid > 0.05:
+                fails.append("%s run: prefill+decode attribution "
+                             "overlaps/undershoots its span by "
+                             "%.3f ms" % (name, resid))
     if not seg.get("prefill_segments_total"):
         fails.append("the segmented run never staged a prefill "
                      "segment (knob not reaching the engine?)")
@@ -1023,6 +1122,9 @@ def main(argv=None):
                     help="write the full report as JSON")
     ap.add_argument("--flight-dump", metavar="DIR",
                     help="leave a flight-recorder dump (CI artifact)")
+    ap.add_argument("--trace-sample", metavar="FILE",
+                    help="write one reconstructed request timeline "
+                         "(CI artifact; see veles-tpu-trace)")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="fleet chaos mode: N replica subprocesses "
                          "behind a FleetRouter; SIGKILL one and "
@@ -1120,6 +1222,9 @@ def main(argv=None):
     fails = gates(report, expect_shed=not args.no_expect_shed,
                   require_slo=args.require_slo)
     report["failures"] = fails
+    if args.trace_sample and report.get("trace_sample_timeline"):
+        with open(args.trace_sample, "w") as f:
+            f.write(report["trace_sample_timeline"] + "\n")
     out = json.dumps(report, indent=2, default=str)
     if args.json:
         with open(args.json, "w") as f:

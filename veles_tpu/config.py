@@ -256,6 +256,15 @@ root.common.update({
     "blackbox": {"capacity": 4096, "dir": "artifacts",
                  "watchdog_seconds": None,
                  "spmd_watchdog_seconds": 300},
+    # request tracing (veles_tpu.telemetry.tracing, docs/services.md
+    # "Request tracing"): the per-process bounded span store behind
+    # /api/trace/<id> and the veles-tpu-trace CLI.  capacity bounds
+    # distinct traces held (oldest trace evicted past it), max_spans
+    # bounds spans per trace; both evictions are counted
+    # (veles_trace_dropped_total).  enabled=False stops span recording
+    # entirely (trace ids still propagate on headers/flight events, so
+    # post-mortem reconstruction keeps working).
+    "trace": {"enabled": True, "capacity": 1024, "max_spans": 128},
     # serving survival layer (services.lifecycle + ContinuousEngine,
     # docs/services.md "Serving robustness").  slo_queue_wait_ms > 0
     # turns breaches from recorded (flight serve.slo_breach) into

@@ -1166,6 +1166,12 @@ class ContinuousBatcher:
         self._slot_req = [None] * B               # slot -> request id
         self._queue = collections.deque()
         self._results = {}
+        #: rid -> monotonic timestamp of the request's FIRST decode
+        #: tick (admission prefill complete, row active) — the
+        #: prefill/decode boundary of the serving plane's per-request
+        #: phase decomposition.  Survives slot release so the engine
+        #: can read it at completion; pop_decode_start releases it.
+        self._decode_start = {}
         #: opt-in per-tick partial-token snapshots (token streaming);
         #: costs one [B, max_len] host fetch per dispatch when on
         self.stream_partials = False
@@ -1230,6 +1236,13 @@ class ContinuousBatcher:
         servers must not accumulate every completed request."""
         return self._results.pop(rid, None)
 
+    def pop_decode_start(self, rid):
+        """Monotonic timestamp of the request's first decode tick (the
+        admit→decode phase boundary), releasing it — or None if the
+        request never reached decode.  The serving engine reads it at
+        completion to split queue/prefill/decode latency."""
+        return self._decode_start.pop(rid, None)
+
     def cancel(self, rid):
         """Abort a request mid-flight: drop it from the queue, or —
         if already admitted — deactivate its row and free its slot
@@ -1253,10 +1266,12 @@ class ContinuousBatcher:
             # whole slot (incl. caches) for the next occupant
             self._active = self._active.at[b].set(False)
             self._partials.pop(rid, None)
+            self._decode_start.pop(rid, None)
             self._release_slot(b)
             return True
         self._partials.pop(rid, None)
         self._results.pop(rid, None)
+        self._decode_start.pop(rid, None)
         return False
 
     def reset_pool(self):
@@ -1270,6 +1285,7 @@ class ContinuousBatcher:
         self._queue.clear()
         self._results.clear()
         self._partials.clear()
+        self._decode_start.clear()
         self._staging = {}
         self._slot_req = [None] * self.slots
         B, L = self.slots, self.gen.max_len
@@ -1300,6 +1316,14 @@ class ContinuousBatcher:
         if self._staging:
             self._advance_staged(
                 self.prefill_tick_budget or self.prefill_segment)
+        # decode-start stamps: a slot that is occupied and NOT staging
+        # is about to take its first decode step this tick (staged
+        # admissions land here the tick their last segment finishes)
+        now = time.monotonic()
+        for b, rid in enumerate(self._slot_req):
+            if rid is not None and b not in self._staging \
+                    and rid not in self._decode_start:
+                self._decode_start[rid] = now
         self._set_state(self._tick(self._state()))
         # emission: completion is re-derived from slot OCCUPANCY + pos
         # (the in-jit freeze already cleared ``active`` for rows that

@@ -22,7 +22,7 @@ from veles_tpu.services.lifecycle import (BoundedStream, DeadlineExceeded,
                                           DrainState, EngineUnavailable,
                                           RequestCancelled, ShedError,
                                           SloShedder)
-from veles_tpu.telemetry import flight
+from veles_tpu.telemetry import flight, tracing
 
 
 def send_json(handler, code, payload, headers=()):
@@ -279,6 +279,17 @@ class ContinuousEngine(Logger):
         self._last_tick_end = None
         self._had_active = False
         self._gauges = None
+        #: request tracing (telemetry.tracing, docs/services.md
+        #: "Request tracing"): apply the trace knobs to the process
+        #: span store here — the engine starts after CLI config files
+        #: ran, the store's module singleton may not have
+        trace_cfg = _root.common.trace
+        tracing.store.enabled = bool(trace_cfg.get("enabled", True))
+        tracing.store.set_capacity(
+            trace_cfg.get("capacity", tracing.DEFAULT_CAPACITY),
+            trace_cfg.get("max_spans", tracing.DEFAULT_MAX_SPANS))
+        #: per-phase completed-request histogram (lazy, fail-soft)
+        self._phase_hist = None
         self._closed = False
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -286,7 +297,7 @@ class ContinuousEngine(Logger):
 
     def submit_async(self, prompt_row, max_new, temperature=0.0,
                      seed=0, adapter=0, stream=False, deadline_ms=None,
-                     shed_exempt=False):
+                     shed_exempt=False, trace=None, parent_span=None):
         """Enqueue one row; returns a handle for ``wait`` (submit every
         row of a request BEFORE waiting so they share the pool).
         Validates here so a bad request raises in the CALLER (one 400),
@@ -304,12 +315,17 @@ class ContinuousEngine(Logger):
         unless ``shed_exempt``: a fleet router's failover resume is
         already-admitted work being RELOCATED off a dead replica, and
         shedding it would turn one replica's death into lost requests
-        (plus waste every token the fleet already decoded for them)."""
+        (plus waste every token the fleet already decoded for them).
+
+        ``trace``/``parent_span``: the request's trace context
+        (telemetry.tracing) — every flight event and span this request
+        produces keys on it, so one cross-process timeline
+        reconstructs end to end."""
         if not shed_exempt and self._shed.should_shed():
             ra = self._shed.shed()
             flight.record("serve.shed", prompt_len=len(prompt_row),
                           max_new=int(max_new),
-                          retry_after_s=ra)
+                          retry_after_s=ra, trace=trace)
             raise ShedError(
                 "admission shedding: measured queue wait exceeds the "
                 "%.0f ms SLO (root.common.serve.slo_queue_wait_ms) — "
@@ -385,7 +401,12 @@ class ContinuousEngine(Logger):
                #: first monotonic ts a 'block' push found the channel
                #: full with no progress since (None = not stalled)
                "_stall_since": None,
-               "_sent": 0}
+               "_sent": 0,
+               #: trace context: every flight event / span this
+               #: request produces keys on "trace"; "_span" is the
+               #: replica-side span children parent onto; "_phases"
+               #: is the completed queue/prefill/decode decomposition
+               "trace": trace, "_span": None, "_phases": None}
         with self._lock:
             if self._closed:
                 raise EngineUnavailable("engine is stopped")
@@ -394,9 +415,14 @@ class ContinuousEngine(Logger):
             self._by_id[rec["id"]] = rec
             self._ingress.append(rec)
         self._wake.set()
+        if trace:
+            rec["_span"] = tracing.span_add(
+                trace, "replica.recv", parent=parent_span,
+                req=rec["id"], prompt_len=len(prompt))
         flight.record("serve.submit", req=rec["id"],
                       prompt_len=len(prompt),
-                      max_new=int(max_new), stream=bool(stream))
+                      max_new=int(max_new), stream=bool(stream),
+                      trace=trace)
         return rec
 
     @staticmethod
@@ -435,7 +461,7 @@ class ContinuousEngine(Logger):
 
     def stream_open(self, prompt_row, max_new, temperature=0.0,
                     seed=0, adapter=0, deadline_ms=None,
-                    shed_exempt=False):
+                    shed_exempt=False, trace=None, parent_span=None):
         """Streaming submit: returns ``(handle, iterator)`` where the
         iterator yields lists of NEW tokens per engine dispatch.  The
         submit (and thus shed/validation errors) happens EAGERLY in
@@ -448,7 +474,8 @@ class ContinuousEngine(Logger):
                                 temperature=temperature, seed=seed,
                                 adapter=adapter, stream=True,
                                 deadline_ms=deadline_ms,
-                                shed_exempt=shed_exempt)
+                                shed_exempt=shed_exempt,
+                                trace=trace, parent_span=parent_span)
 
         def drain():
             # chunks carry their start offset, and only CONTIGUOUS
@@ -500,7 +527,13 @@ class ContinuousEngine(Logger):
         rec["event"].set()
         if kind is not None:
             flight.record(kind, req=rec.get("id"),
-                          prompt_len=len(rec["prompt"]), **fields)
+                          prompt_len=len(rec["prompt"]),
+                          trace=rec.get("trace"), **fields)
+        if rec.get("trace"):
+            tracing.span_add(rec["trace"], "replica.error",
+                             parent=rec.get("_span"),
+                             req=rec.get("id"),
+                             error=type(err).__name__)
 
     def _drain_cancels(self):
         """Engine thread: act on queued ``cancel()`` requests — remove
@@ -633,6 +666,7 @@ class ContinuousEngine(Logger):
         with self._lock:
             rec = self._records.get(ev.get("rid"))
         req = rec.get("id") if rec is not None else None
+        trace = rec.get("trace") if rec is not None else None
         if kind == "segment":
             toks = int(ev.get("tokens") or 0)
             dt = float(ev.get("seconds") or 0.0)
@@ -648,10 +682,49 @@ class ContinuousEngine(Logger):
                           start=ev.get("start"), tokens=toks,
                           cursor=ev.get("cursor"),
                           plen=ev.get("plen"),
-                          ms=round(dt * 1e3, 3))
+                          ms=round(dt * 1e3, 3), trace=trace)
         elif kind in ("begin", "admit"):
             flight.record("serve.prefill", req=req, phase=kind,
-                          plen=ev.get("plen"))
+                          plen=ev.get("plen"), trace=trace)
+
+    def _note_done(self, rec, now):
+        """Completion telemetry for one request (engine thread,
+        outside the lock): the ``serve.done`` flight event, the
+        replica-side phase spans (queue/prefill/decode partition the
+        submit→finish wall span exactly — the timeline a trace
+        aggregator renders), and the per-phase latency histogram."""
+        phases = rec.get("_phases") or {}
+        trace = rec.get("trace")
+        flight.record("serve.done", req=rec.get("id"), trace=trace,
+                      new_tokens=len(rec["out"]) - len(rec["prompt"]),
+                      **{"%s_ms" % k: v for k, v in phases.items()})
+        if trace:
+            parent = rec.get("_span")
+            t = tracing.mono_to_wall(rec["submit_ts"])
+            for phase in ("queue", "prefill", "decode"):
+                ms = phases.get(phase)
+                if ms is None:
+                    continue
+                tracing.span_add(trace, "phase." + phase, ts=t,
+                                 dur_ms=ms, parent=parent,
+                                 req=rec.get("id"))
+                t += ms / 1e3
+            tracing.span_add(trace, "replica.done", parent=parent,
+                             ts=tracing.mono_to_wall(now),
+                             req=rec.get("id"))
+        try:
+            from veles_tpu import telemetry
+            if self._phase_hist is None:
+                self._phase_hist = telemetry.registry.histogram(
+                    "veles_request_phase_ms",
+                    "completed-request latency decomposition "
+                    "(queue/prefill/decode) in milliseconds",
+                    labelnames=("phase",),
+                    buckets=tracing.PHASE_BUCKETS_MS)
+            for phase, ms in phases.items():
+                self._phase_hist.observe(ms, phase=phase)
+        except Exception:   # noqa: BLE001 — fail-soft telemetry
+            pass
 
     def _export_serve_gauges(self, stall_ms=None):
         """Segmented-prefill registry surface (PR 3 MetricsRegistry;
@@ -792,7 +865,8 @@ class ContinuousEngine(Logger):
                         flight.record(
                             "serve.admit", req=rec.get("id"),
                             prompt_len=len(rec["prompt"]),
-                            queue_wait_ms=qw_ms)
+                            queue_wait_ms=qw_ms,
+                            trace=rec.get("trace"))
                 for rid, rec in self._records.items():
                     if rec["stream_q"] is None:
                         continue
@@ -813,14 +887,31 @@ class ContinuousEngine(Logger):
                     self._by_id.pop(rec.get("id"), None)
                     rec["out"] = out
                     done.append(rec)
-                    dec = max(1e-9, now - (rec["admit_ts"] or now))
+                    admit = rec["admit_ts"] or now
+                    dec = max(1e-9, now - admit)
                     n_new = len(out) - len(rec["prompt"])
-                    qw_ms = ((rec["admit_ts"] or now)
-                             - rec["submit_ts"]) * 1e3
+                    qw_ms = (admit - rec["submit_ts"]) * 1e3
                     rec["_queue_wait_ms"] = qw_ms
+                    # phase decomposition: the batcher stamped when
+                    # this row's FIRST decode dispatch went out, so
+                    # the admitted→finished residency splits into the
+                    # prefill share (admission chunk passes) and the
+                    # pure decode share — non-overlapping by
+                    # construction (they partition [admit, now])
+                    ds = self.cb.pop_decode_start(rid)
+                    if ds is None or not admit <= ds <= now:
+                        ds = admit
+                    prefill_ms = (ds - admit) * 1e3
+                    pure_ms = max(0.0, (now - ds) * 1e3)
+                    rec["_phases"] = {
+                        "queue": round(qw_ms, 3),
+                        "prefill": round(prefill_ms, 3),
+                        "decode": round(pure_ms, 3)}
                     self._history.append({
                         "queue_wait_ms": qw_ms,
                         "decode_ms": dec * 1e3,
+                        "prefill_ms": prefill_ms,
+                        "pure_decode_ms": pure_ms,
                         "new_tokens": n_new,
                         "tokens_per_sec": n_new / dec,
                         "ms_per_tok": dec * 1e3 / max(1, n_new),
@@ -857,14 +948,16 @@ class ContinuousEngine(Logger):
             self._prefill_backlog = self.cb.prefill_backlog_tokens()
             self._export_serve_gauges(stall_ms)
             for rec in done:          # wake waiters outside the lock
+                self._note_done(rec, now)
                 if self._slo_queue_wait_ms and \
                         rec.get("_queue_wait_ms", 0.0) \
                         > self._slo_queue_wait_ms:
                     flight.record(
-                        "serve.slo_breach",
+                        "serve.slo_breach", req=rec.get("id"),
                         queue_wait_ms=rec["_queue_wait_ms"],
                         slo_ms=self._slo_queue_wait_ms,
-                        prompt_len=len(rec["prompt"]))
+                        prompt_len=len(rec["prompt"]),
+                        trace=rec.get("trace"))
                 if rec["stream_q"] is not None:
                     # no tail flush here: the terminal's payload IS the
                     # full result, and the consumer-side drain yields
@@ -927,10 +1020,18 @@ class ContinuousEngine(Logger):
             return round(vals[min(len(vals) - 1,
                                   int(q / 100.0 * len(vals)))], 3)
 
-        for key in ("queue_wait_ms", "ms_per_tok", "tokens_per_sec"):
-            vals = [h[key] for h in hist]
+        # per-phase decomposition percentiles (docs/services.md
+        # "Request tracing"): queue_wait/prefill/pure_decode partition
+        # each request's submit→finish span, so the phase a latency
+        # miss lives in reads straight off the metrics
+        for key in ("queue_wait_ms", "ms_per_tok", "tokens_per_sec",
+                    "prefill_ms", "pure_decode_ms"):
+            vals = [h[key] for h in hist if key in h]
             out["p50_" + key] = pct(vals, 50)
             out["p99_" + key] = pct(vals, 99)
+        # span-store eviction count (the bounded trace ring's
+        # "counted gauge"; also veles_trace_dropped_total)
+        out["trace_dropped_total"] = tracing.store.dropped
         # the decode-tick cadence: inter-dispatch gap with streams in
         # flight — whole-prompt admissions inflate its p99, segmented
         # prefill bounds it (the stall-free serving gate's number)
@@ -1086,6 +1187,16 @@ class RESTfulAPI(Logger):
                     # once idle — see ContinuousEngine.leak_check)
                     self._send_json(200, api.engine.leak_check()
                                     if api.engine is not None else {})
+                elif self.path.startswith(api.path + "/trace/"):
+                    # this replica's spans for one trace id (the
+                    # router's /trace/<id> aggregation fans out here;
+                    # 404 = unknown or already ring-evicted)
+                    tid = self.path[len(api.path + "/trace/"):]
+                    spans = tracing.store.spans(tid)
+                    self._send_json(
+                        200 if spans else 404,
+                        {"trace": tid, "spans": spans,
+                         "phases": tracing.phases_of(spans)})
                 else:
                     self.send_error(404)
 
@@ -1123,6 +1234,21 @@ class RESTfulAPI(Logger):
                         api._http_inflight -= 1
 
             def _do_work_post(self):
+                # trace context (telemetry.tracing): a fleet router
+                # upstream supplies it on the X-Veles-Trace header;
+                # serving bare, THIS replica is the edge and mints —
+                # an absent/forged header value mints fresh too, so a
+                # client can never pick its own id here either
+                ctx = tracing.parse_header(
+                    self.headers.get(tracing.TRACE_HEADER))
+                minted = ctx is None
+                if minted:
+                    trace = tracing.new_trace_id()
+                    parent = tracing.span_add(trace, "request",
+                                              edge="replica")
+                else:
+                    trace, parent = ctx
+                t_edge = time.monotonic()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
@@ -1136,7 +1262,8 @@ class RESTfulAPI(Logger):
                         # shed (503) / validation (400) surface before
                         # the 200 header commits.
                         prompt, chunks, handle = \
-                            api.run_generate_stream(req)
+                            api.run_generate_stream(
+                                req, trace=trace, parent_span=parent)
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/x-ndjson")
@@ -1155,13 +1282,21 @@ class RESTfulAPI(Logger):
                                     (json.dumps({"tokens": fresh})
                                      + "\n").encode())
                                 self.wfile.flush()
+                            t_done = time.monotonic()
                             # the handle's final result is authoritative
                             # even if drop-oldest overflow dropped
                             # mid-stream chunks on a slow reader
                             result = (list(handle["out"])
                                       if handle["out"] is not None
                                       else got)
-                            tail = {"done": True, "result": result}
+                            # the terminal line carries the trace id
+                            # (the client's reconstruction key) and
+                            # the engine's phase decomposition (the
+                            # router's fleet rollup reads it here)
+                            tail = {"done": True, "result": result,
+                                    "trace": trace}
+                            if handle.get("_phases"):
+                                tail["phases"] = handle["_phases"]
                             dropped = (handle["stream_q"].dropped
                                        if handle["stream_q"] is not None
                                        else 0)
@@ -1169,6 +1304,21 @@ class RESTfulAPI(Logger):
                                 tail["dropped_chunks"] = dropped
                             self.wfile.write(
                                 (json.dumps(tail) + "\n").encode())
+                            # stream phase: engine completion → final
+                            # line written (the delivery tail the
+                            # engine phases cannot see).  Only when
+                            # THIS replica is the edge — behind a
+                            # router the stream remainder is the
+                            # router's to attribute, and recording it
+                            # twice would double-count the phase
+                            if minted:
+                                tracing.span_add(
+                                    trace, "phase.stream",
+                                    parent=(handle.get("_span")
+                                            or parent),
+                                    dur_ms=round((time.monotonic()
+                                                  - t_done) * 1e3, 3),
+                                    req=handle.get("id"))
                         except Exception as e:  # noqa: BLE001
                             api.engine.cancel(
                                 handle["id"],
@@ -1187,11 +1337,17 @@ class RESTfulAPI(Logger):
                             except Exception:  # noqa: BLE001 — dead pipe
                                 pass
                         return
+                    meta = {}
                     if "generate" in req:
-                        out = api.run_generate(req)
+                        out = api.run_generate(req, trace=trace,
+                                               parent_span=parent,
+                                               meta=meta)
                     else:
                         out = np.asarray(api.forward(api.decode_input(req)))
-                    self._send_json(200, {"result": out.tolist()})
+                    payload = {"result": out.tolist(), "trace": trace}
+                    if meta.get("phases"):
+                        payload["phases"] = meta["phases"]
+                    self._send_json(200, payload)
                 except ShedError as e:
                     # SLO admission shedding: tell the client to back
                     # off instead of queuing into a breach
@@ -1212,6 +1368,16 @@ class RESTfulAPI(Logger):
                     self._send_json(504, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report to client
                     self._send_json(400, {"error": str(e)})
+                finally:
+                    if minted:
+                        # terminal-span rule: the edge that MINTED the
+                        # id terminates the trace — exactly once, on
+                        # every exit path (success, error, dead pipe)
+                        tracing.span_add(
+                            trace, "request.done", parent=parent,
+                            terminal=True,
+                            dur_ms=round((time.monotonic()
+                                          - t_edge) * 1e3, 3))
 
             def log_message(self, fmt, *args):
                 api.debug("http: " + fmt, *args)
@@ -1375,7 +1541,7 @@ class RESTfulAPI(Logger):
                 and not int(opts.get("speculative", 0))
                 and RESTfulAPI._engine_opts_subset(opts))
 
-    def run_generate_stream(self, req):
+    def run_generate_stream(self, req, trace=None, parent_span=None):
         """NDJSON token streaming: validates a single-row greedy /
         plain-temperature engine request and returns (prompt, iterator
         over new-token chunks, engine handle).  The submit happens
@@ -1413,13 +1579,18 @@ class RESTfulAPI(Logger):
             # {"resume": true}: a fleet router relocating an already-
             # admitted stream off a dead replica — exempt from the
             # shed valve (see submit_async), never from validation
-            shed_exempt=bool(req.get("resume")))
+            shed_exempt=bool(req.get("resume")),
+            trace=trace, parent_span=parent_span)
         return prompt[0].tolist(), it, handle
 
-    def run_generate(self, req):
+    def run_generate(self, req, trace=None, parent_span=None,
+                     meta=None):
         """``{"input": [[tok, ...]], "generate": {"max_new": N,
         "temperature": T, "seed": S}}`` → generated token matrix (causal
-        LM serving; needs ``generator=``)."""
+        LM serving; needs ``generator=``).  ``trace``/``parent_span``
+        thread the request's trace context into the slot pool;
+        ``meta`` (a dict, mutated) receives the engine's phase
+        decomposition for the HTTP layer to embed in the response."""
         if self.generator is None:
             raise ValueError("this endpoint serves a non-LM workflow: "
                              "no generator is attached")
@@ -1472,7 +1643,13 @@ class RESTfulAPI(Logger):
                         temperature=float(opts.get("temperature", 0.0)),
                         seed=int(opts.get("seed", 0)),
                         adapter=int(opts.get("adapter", 0)),
-                        deadline_ms=opts.get("deadline_ms")))
+                        deadline_ms=opts.get("deadline_ms"),
+                        # a resume relocation keeps its exemption on
+                        # the buffered path too (prefill handoffs ride
+                        # it); the trace context rides along so the
+                        # relocated leg joins the original timeline
+                        shed_exempt=bool(req.get("resume")),
+                        trace=trace, parent_span=parent_span))
             except ShedError:
                 # the shedder opened mid-request: the rows already in
                 # must not decode for a client that gets a 503
@@ -1480,7 +1657,10 @@ class RESTfulAPI(Logger):
                     self.engine.cancel(h["id"],
                                        reason="sibling row shed")
                 raise
-            return np.stack([self.engine.wait(h) for h in handles])
+            out = np.stack([self.engine.wait(h) for h in handles])
+            if meta is not None and handles[0].get("_phases"):
+                meta["phases"] = handles[0]["_phases"]
+            return out
         if self.batcher is not None:
             # validate THIS request up front — a bad one must 400 alone,
             # never poison the batch it would have coalesced into

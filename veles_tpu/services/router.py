@@ -50,6 +50,7 @@ Fleet churn is observable: ``serve.replica_up`` / ``serve.replica_down``
 / ``serve.failover`` / ``serve.drain`` flight events land in the same
 ``veles-tpu-blackbox`` timeline as everything else."""
 
+import collections
 import http.client
 import json
 import math
@@ -61,7 +62,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit
 
 from veles_tpu.logger import Logger
-from veles_tpu.telemetry import flight
+from veles_tpu.telemetry import flight, tracing
 
 
 class NoReplicaError(RuntimeError):
@@ -217,6 +218,12 @@ class FleetRouter(Logger):
         #: and /health next to the live registry
         self._fleet = None
         self._gauges = None
+        #: fleet-wide per-phase latency rollup (docs/services.md
+        #: "Request tracing"): per-(replica, phase) windows feeding
+        #: metrics()["phases"] p50/p99, plus the registry histogram
+        #: veles_fleet_phase_ms{phase, replica} (lazy, fail-soft)
+        self._phase_stats = {}           # (rid, phase) -> deque of ms
+        self._phase_hist = None
 
     # ----------------------------------------------------------- registry
     def register(self, url, api=None, role=None):
@@ -671,18 +678,77 @@ class FleetRouter(Logger):
         except (TypeError, ValueError):
             return 1.0
 
-    def _forward_buffered(self, rep, body):
+    def _request_headers(self, trace, tspan, rep):
+        """The replica-hop headers: content type plus the trace
+        context — each attempt gets its own ``router.leg`` span (the
+        replica's spans parent onto it), so failover attempts stay
+        distinguishable in the reconstructed timeline."""
+        headers = {"Content-Type": "application/json"}
+        if trace:
+            leg = tracing.span_add(trace, "router.leg", parent=tspan,
+                                   replica=rep.rid)
+            headers[tracing.TRACE_HEADER] = tracing.format_header(
+                trace, leg)
+        return headers
+
+    def _forward_buffered(self, rep, body, trace=None, tspan=None):
         conn = http.client.HTTPConnection(rep.host, rep.port,
                                           timeout=self.request_timeout_s)
         try:
             conn.request("POST", rep.path, body,
-                         {"Content-Type": "application/json"})
+                         self._request_headers(trace, tspan, rep))
             resp = conn.getresponse()
             return resp.status, dict(resp.getheaders()), resp.read()
         finally:
             conn.close()
 
-    def route_buffered(self, body, session=None, parsed=None):
+    def _note_phases(self, rep, phases, total_ms=None, trace=None,
+                     tspan=None):
+        """Fleet rollup of one completed leg's phase decomposition
+        (the replica reported queue/prefill/decode on its terminal
+        payload).  ``total_ms`` (router-observed wall latency) turns
+        the remainder into the ``stream`` phase — delivery + network
+        overhead the replica cannot see — keeping the four phases a
+        non-overlapping partition of what the router measured."""
+        phases = dict(phases) if isinstance(phases, dict) else {}
+        if not phases:
+            return
+        if total_ms is not None:
+            known = sum(float(phases.get(p, 0.0))
+                        for p in ("queue", "prefill", "decode"))
+            phases["stream"] = round(max(0.0, total_ms - known), 3)
+            if trace:
+                tracing.span_add(trace, "phase.stream", parent=tspan,
+                                 dur_ms=phases["stream"],
+                                 replica=rep.rid)
+        try:
+            from veles_tpu import telemetry
+            if self._phase_hist is None:
+                self._phase_hist = telemetry.registry.histogram(
+                    "veles_fleet_phase_ms",
+                    "fleet-wide per-phase request latency by serving "
+                    "replica",
+                    labelnames=("phase", "replica"),
+                    buckets=tracing.PHASE_BUCKETS_MS)
+            for phase, ms in phases.items():
+                self._phase_hist.observe(float(ms), phase=phase,
+                                         replica=str(rep.rid))
+        except Exception:   # noqa: BLE001 — fail-soft telemetry
+            pass
+        with self._lock:
+            for phase, ms in phases.items():
+                key = (str(rep.rid), phase)
+                window = self._phase_stats.get(key)
+                if window is None:
+                    window = self._phase_stats[key] = \
+                        collections.deque(maxlen=512)
+                try:
+                    window.append(float(ms))
+                except (TypeError, ValueError):
+                    pass
+
+    def route_buffered(self, body, session=None, parsed=None,
+                       trace=None, tspan=None):
         """Route one non-streaming request; returns (status, payload
         bytes, extra headers).  Long prompts route to the prefill
         tier — two-phase when the decode residency exceeds the
@@ -696,9 +762,12 @@ class FleetRouter(Logger):
                 parsed = json.loads(body)
             except ValueError:
                 parsed = None
+        t0 = time.monotonic()
         role, cap = self._handoff_plan(parsed)
         if role is not None and cap:
-            out = self._route_buffered_handoff(parsed, session, cap)
+            out = self._route_buffered_handoff(parsed, session, cap,
+                                               trace=trace,
+                                               tspan=tspan)
             if out is not None:
                 return out
             # the two-phase path could not run (prefill tier emptied,
@@ -718,13 +787,14 @@ class FleetRouter(Logger):
             try:
                 try:
                     status, headers, payload = self._forward_buffered(
-                        rep, body)
+                        rep, body, trace=trace, tspan=tspan)
                 except (OSError, http.client.HTTPException) as e:
                     last_err = e
                     tried.add(rep.rid)
                     self._mark_down(rep, "request failed: %r" % (e,))
                     self._note_failover(rep, session, attempt,
-                                        stream=False)
+                                        stream=False, trace=trace,
+                                        tspan=tspan)
                     with self._lock:
                         self._counters["retries"] += 1
                     attempt += 1
@@ -743,6 +813,14 @@ class FleetRouter(Logger):
                     continue
                 with self._lock:
                     self._counters["routed"] += 1
+                if status == 200:
+                    try:
+                        self._note_phases(
+                            rep, json.loads(payload).get("phases"),
+                            total_ms=(time.monotonic() - t0) * 1e3,
+                            trace=trace, tspan=tspan)
+                    except ValueError:
+                        pass
                 return status, payload, ()
             finally:
                 self._charge(rep, -cost)
@@ -756,7 +834,8 @@ class FleetRouter(Logger):
                        if shed_ra is not None else ""),
             retry_after_s=ra)
 
-    def _route_buffered_handoff(self, parsed, session, cap):
+    def _route_buffered_handoff(self, parsed, session, cap,
+                                trace=None, tspan=None):
         """Two-phase buffered request: prefill leg (capped max_new)
         on the prefill tier, then the decode continuation — the same
         prefix-resume body the failover path uses — on a decode
@@ -774,11 +853,12 @@ class FleetRouter(Logger):
             self._charge(rep, cost1)
             try:
                 status, headers, payload = self._forward_buffered(
-                    rep, body1)
+                    rep, body1, trace=trace, tspan=tspan)
             except (OSError, http.client.HTTPException) as e:
                 tried.add(rep.rid)
                 self._mark_down(rep, "request failed: %r" % (e,))
-                self._note_failover(rep, session, 0, stream=False)
+                self._note_failover(rep, session, 0, stream=False,
+                                    trace=trace, tspan=tspan)
                 with self._lock:
                     self._counters["retries"] += 1
                 continue
@@ -792,7 +872,8 @@ class FleetRouter(Logger):
                 # 504): every replica would repeat it
                 return status, payload, ()
             try:
-                first = json.loads(payload)["result"][0]
+                decoded = json.loads(payload)
+                first = decoded["result"][0]
             except (ValueError, KeyError, IndexError, TypeError):
                 return None
             delivered = [int(t) for t in first[len(rows[0]):]]
@@ -800,18 +881,33 @@ class FleetRouter(Logger):
                 self._counters["prefill_handoffs"] += 1
             flight.record("serve.prefill_handoff", replica=rep.rid,
                           session=session, prompt_len=len(rows[0]),
-                          handoff=len(delivered), stream=False)
+                          handoff=len(delivered), stream=False,
+                          trace=trace)
+            if trace:
+                tracing.span_add(trace, "router.handoff",
+                                 parent=tspan, replica=rep.rid,
+                                 handoff=len(delivered))
+            # the prefill leg's phase share rolls up under the
+            # PREFILL replica; the decode continuation reports its
+            # own under the survivor
+            self._note_phases(rep, decoded.get("phases"))
             resume = self._resume_body(parsed, delivered)
-            return self.route_buffered(resume, session=session)
+            return self.route_buffered(resume, session=session,
+                                       trace=trace, tspan=tspan)
         return None
 
     def _note_failover(self, rep, session, attempt, stream,
-                       delivered=0):
+                       delivered=0, trace=None, tspan=None):
         with self._lock:
             self._counters["failovers"] += 1
         flight.record("serve.failover", replica=rep.rid,
                       session=session, attempt=attempt,
-                      stream=bool(stream), delivered=int(delivered))
+                      stream=bool(stream), delivered=int(delivered),
+                      trace=trace)
+        if trace:
+            tracing.span_add(trace, "router.failover", parent=tspan,
+                             replica=rep.rid, attempt=attempt,
+                             delivered=int(delivered))
 
     # ---------------------------------------------------------- streaming
     @staticmethod
@@ -841,7 +937,7 @@ class FleetRouter(Logger):
         return json.dumps(body).encode()
 
     def route_stream(self, parsed, body, session, send_headers,
-                     write_line):
+                     write_line, trace=None, tspan=None):
         """Route one NDJSON streaming request, splicing across replica
         deaths.  ``send_headers()`` commits the client's 200 exactly
         once; ``write_line(bytes)`` forwards one NDJSON line (raising
@@ -859,6 +955,7 @@ class FleetRouter(Logger):
         byte-identical client stream either way, and a prefill
         replica dying MID-prefill is just a failover."""
         max_new = int(parsed["generate"].get("max_new", 16))
+        t0 = time.monotonic()
         plan_role, cap = self._handoff_plan(parsed)
         cost = self._price(parsed)
         delivered = []            # new tokens already sent to client
@@ -869,7 +966,7 @@ class FleetRouter(Logger):
         # so previously-shedding replicas become eligible again
         tried_dead = set()
         tried_shed = set()
-        trace = []                # (rid, outcome) per attempt
+        attempts = []             # (rid, outcome) per attempt
         shed_ra = None
         attempt = 0
         while attempt <= self.retry_max:
@@ -906,7 +1003,7 @@ class FleetRouter(Logger):
             self._charge(rep, cost)
             try:
                 conn.request("POST", rep.path, send_body,
-                             {"Content-Type": "application/json"})
+                             self._request_headers(trace, tspan, rep))
                 resp = conn.getresponse()
                 if resp.status == 503:
                     shed_ra = max(
@@ -914,7 +1011,7 @@ class FleetRouter(Logger):
                         self._retry_after_of(dict(resp.getheaders()),
                                              resp.read()))
                     tried_shed.add(rep.rid)
-                    trace.append((rep.rid, "503"))
+                    attempts.append((rep.rid, "503"))
                     continue
                 if resp.status != 200:
                     # validation error — deterministic, no point
@@ -939,9 +1036,11 @@ class FleetRouter(Logger):
                     except Exception as e:  # noqa: BLE001
                         raise _ClientGone() from e
                     committed = True
+                sink = {}
                 out = self._pump_stream(resp, parsed, delivered,
                                         write_line, bool(tried_dead),
-                                        swallow_done=in_handoff)
+                                        swallow_done=in_handoff,
+                                        sink=sink)
                 if out == "handoff":
                     # prefill leg complete: the loop continues in the
                     # decode phase with the delivered prefix — the
@@ -955,7 +1054,17 @@ class FleetRouter(Logger):
                                                      parsed["input"][0],
                                                      list)
                                                  else parsed["input"]),
-                                  handoff=len(delivered), stream=True)
+                                  handoff=len(delivered), stream=True,
+                                  trace=trace)
+                    if trace:
+                        tracing.span_add(trace, "router.handoff",
+                                         parent=tspan,
+                                         replica=rep.rid,
+                                         handoff=len(delivered))
+                    # the prefill leg's phases roll up under the
+                    # prefill replica; the decode leg owns the stream
+                    # remainder
+                    self._note_phases(rep, sink.get("phases"))
                     # the decode leg is a shed-exempt resume: replicas
                     # that shed the ORIGINAL submission are eligible
                     tried_shed.clear()
@@ -963,6 +1072,10 @@ class FleetRouter(Logger):
                 if out:
                     with self._lock:
                         self._counters["routed"] += 1
+                    self._note_phases(
+                        rep, sink.get("phases"),
+                        total_ms=(time.monotonic() - t0) * 1e3,
+                        trace=trace, tspan=tspan)
                     return
                 # upstream died mid-stream (EOF / error line / reset):
                 # fall through to failover below
@@ -980,10 +1093,11 @@ class FleetRouter(Logger):
                 # shed-exempt resume may now land on a replica whose
                 # valve refused the ORIGINAL (pre-commit) submission
                 tried_shed.clear()
-                trace.append((rep.rid, repr(e)[:120]))
+                attempts.append((rep.rid, repr(e)[:120]))
                 self._mark_down(rep, "stream failed: %r" % (e,))
                 self._note_failover(rep, session, attempt, stream=True,
-                                    delivered=len(delivered))
+                                    delivered=len(delivered),
+                                    trace=trace, tspan=tspan)
                 if delivered:
                     with self._lock:
                         self._counters["resumed_streams"] += 1
@@ -994,11 +1108,13 @@ class FleetRouter(Logger):
                     row = parsed["input"]
                     if row and isinstance(row[0], list):
                         row = row[0]
-                    write_line(json.dumps(
-                        {"done": True,
-                         "result": [int(t) for t in row]
-                         + [int(t) for t in delivered],
-                         "resumed": True}).encode() + b"\n")
+                    synth = {"done": True,
+                             "result": [int(t) for t in row]
+                             + [int(t) for t in delivered],
+                             "resumed": True}
+                    if trace:
+                        synth["trace"] = trace
+                    write_line(json.dumps(synth).encode() + b"\n")
                     with self._lock:
                         self._counters["routed"] += 1
                     return
@@ -1010,7 +1126,7 @@ class FleetRouter(Logger):
         # retry budget exhausted
         ra = shed_ra if shed_ra is not None else 1.0
         msg = ("no replica could complete the stream (attempts: %s)"
-               % (trace,))
+               % (attempts,))
         with self._lock:
             self._counters["shed_rejects"] += 1
         if committed:
@@ -1020,7 +1136,7 @@ class FleetRouter(Logger):
         raise NoReplicaError(msg, retry_after_s=ra)
 
     def _pump_stream(self, resp, parsed, delivered, write_line,
-                     resumed, swallow_done=False):
+                     resumed, swallow_done=False, sink=None):
         """Forward NDJSON lines replica→client until the done line
         (True) or upstream failure (False).  Client write failures
         raise :class:`_ClientGone`.  ``delivered`` accumulates the
@@ -1054,6 +1170,12 @@ class FleetRouter(Logger):
                 self._client_write(write_line, raw)
                 return True
             elif msg.get("done"):
+                if sink is not None and isinstance(
+                        msg.get("phases"), dict):
+                    # the replica's queue/prefill/decode decomposition
+                    # rides the done line; harvested for the fleet
+                    # rollup even when the line itself is swallowed
+                    sink["phases"] = msg["phases"]
                 if swallow_done:
                     # the leg's authoritative result covers overflow-
                     # dropped chunks too: hand the client whatever the
@@ -1212,9 +1334,80 @@ class FleetRouter(Logger):
                "health_interval_ms": self.health_interval_s * 1e3,
                "placement": self.placement,
                "cost": self.cost.status()}
+        phases = self._phase_rollup()
+        if phases:
+            out["phases"] = phases
         if fleet is not None:
             out["fleet"] = fleet
         return out
+
+    def _phase_rollup(self):
+        """Fleet-wide per-phase latency quantiles, keyed
+        ``replica -> phase -> {p50, p99, n}`` — the JSON face of the
+        ``veles_fleet_phase_ms`` histograms, assembled from the
+        ``phases`` decomposition each replica reports on its done
+        lines (plus the router-computed ``stream`` remainder)."""
+        with self._lock:
+            stats = {k: list(v) for k, v in self._phase_stats.items()}
+        out = {}
+        for (rid, phase), vals in sorted(stats.items()):
+            if not vals:
+                continue
+            vals.sort()
+            rep = out.setdefault(rid, {})
+            rep[phase] = {
+                "p50": round(vals[len(vals) // 2], 3),
+                "p99": round(vals[min(len(vals) - 1,
+                                      int(len(vals) * 0.99))], 3),
+                "n": len(vals),
+            }
+        return out
+
+    def trace_timeline(self, tid):
+        """Aggregate one request's spans across the fleet: the
+        router's own span store (root/leg/failover/handoff spans)
+        merged with every live replica's ``/trace/<id>`` answer.
+        A dead replica simply contributes nothing — absence is not a
+        gap, the router-side chain stays connected (that is what
+        makes post-SIGKILL timelines reconstructable live).
+        Fail-soft per replica: one unreachable endpoint must not
+        block the reconstruction."""
+        if not tracing.valid_id(tid):
+            return None
+        spans = list(tracing.store.spans(tid))
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.state in (Replica.UP, Replica.DRAINING)]
+        seen = {s.get("span") for s in spans}
+        for rep in reps:
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.read_timeout_s)
+                try:
+                    conn.request("GET",
+                                 rep.path + "/trace/" + tid)
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        continue
+                    payload = json.loads(resp.read())
+                finally:
+                    conn.close()
+            except (OSError, ValueError,
+                    http.client.HTTPException):
+                continue
+            for span in payload.get("spans") or []:
+                if span.get("span") in seen:
+                    continue
+                seen.add(span.get("span"))
+                spans.append(span)
+        if not spans:
+            return None
+        spans.sort(key=lambda s: s.get("ts") or 0.0)
+        verdict = tracing.validate(spans)
+        return {"trace": tid, "spans": spans,
+                "phases": tracing.phases_of(spans),
+                "gapless": verdict["ok"],
+                "problems": verdict["problems"]}
 
     def fleet_health(self):
         reps = self.replicas()
@@ -1243,6 +1436,16 @@ class FleetRouter(Logger):
                     h = router.fleet_health()
                     self._send_json(
                         200 if h["state"] == "serving" else 503, h)
+                elif self.path.startswith(
+                        router.path + "/trace/"):
+                    tid = self.path[len(router.path + "/trace/"):]
+                    tl = router.trace_timeline(tid)
+                    if tl is None:
+                        self._send_json(
+                            404, {"error": "unknown trace",
+                                  "trace": tid})
+                    else:
+                        self._send_json(200, tl)
                 else:
                     self.send_error(404)
 
@@ -1304,6 +1507,30 @@ class FleetRouter(Logger):
                     # admission control by forging it
                     body = json.dumps(parsed).encode()
                 session = parsed.get("session")
+                # the router is the trace EDGE: it always mints — an
+                # incoming X-Veles-Trace header is a forgery here
+                # (only replica hops are mid-chain) and is ignored,
+                # the same trust boundary as the resume strip above
+                trace = tracing.new_trace_id()
+                tspan = tracing.span_add(
+                    trace, "request", edge="router",
+                    session=session)
+                t_edge = time.monotonic()
+                try:
+                    self._route_traced(parsed, body, session,
+                                       trace, tspan)
+                finally:
+                    # the minter owns the request's ONE terminal
+                    # span, on every exit path (success, failover
+                    # exhaustion, dead client)
+                    tracing.span_add(
+                        trace, "request.done", parent=tspan,
+                        terminal=True,
+                        dur_ms=round(
+                            (time.monotonic() - t_edge) * 1e3, 3))
+
+            def _route_traced(self, parsed, body, session, trace,
+                              tspan):
                 if isinstance(parsed.get("generate"), dict) \
                         and parsed["generate"].get("stream"):
                     def send_headers():
@@ -1317,10 +1544,12 @@ class FleetRouter(Logger):
                         self.wfile.flush()
 
                     router.route_stream(parsed, body, session,
-                                        send_headers, write_line)
+                                        send_headers, write_line,
+                                        trace=trace, tspan=tspan)
                     return
                 status, payload, headers = router.route_buffered(
-                    body, session=session, parsed=parsed)
+                    body, session=session, parsed=parsed,
+                    trace=trace, tspan=tspan)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))

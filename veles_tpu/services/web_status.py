@@ -611,6 +611,19 @@ class WebStatusServer(Logger):
                 elif self.path == "/api/trace":
                     self._send(200, json.dumps(
                         server.chrome_trace()).encode())
+                elif self.path.startswith("/api/trace/"):
+                    # per-request span store (telemetry.tracing):
+                    # this process's leg of a serving request's
+                    # cross-process timeline, keyed by trace id
+                    from veles_tpu.telemetry import tracing
+                    tid = self.path[len("/api/trace/"):]
+                    spans = tracing.store.spans(tid)
+                    self._send(
+                        200 if spans else 404,
+                        json.dumps(
+                            {"trace": tid, "spans": spans,
+                             "phases": tracing.phases_of(spans)}
+                        ).encode())
                 elif self.path == "/api/plots":
                     self._send(200, json.dumps(bus.snapshot()[-20:],
                                                default=str).encode())

@@ -160,6 +160,12 @@ def main(argv=None):
     p.add_argument("--kind", default=None,
                    help="only events of this kind (e.g. step, "
                    "unit.stop, snapshot, hang)")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="only events of this request trace id — the "
+                   "post-mortem reconstruction of one serving "
+                   "request's cross-process timeline (works with "
+                   "every replica dead: the ids ride the serve.* "
+                   "flight events into each process's crashdump)")
     p.add_argument("--grep", default=None,
                    help="only events whose JSON contains this "
                    "substring")
@@ -179,6 +185,8 @@ def main(argv=None):
         print("veles-tpu-blackbox: %s" % e, file=sys.stderr)
         return 2
     events = merge_timeline(dumps)
+    if args.trace:
+        events = [e for e in events if e.get("trace") == args.trace]
     if args.kind:
         events = [e for e in events if e.get("kind") == args.kind]
     if args.grep:

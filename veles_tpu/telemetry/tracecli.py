@@ -1,0 +1,119 @@
+"""``veles-tpu-trace`` — reconstruct one serving request's
+cross-process timeline by trace id.
+
+Two sources, one render:
+
+* **live** (``--url``): GET the span-store endpoint of a fleet router
+  (``{path}/trace/<id>`` — the router merges its own spans with every
+  live replica's) or of a single replica/web-status process
+  (``/api/trace/<id>``).  A replica the chaos monkey SIGKILLed simply
+  contributes nothing; the router-side chain stays connected, so the
+  timeline still validates gapless.
+* **post-mortem** (``--dumps``): merge flight-recorder crashdump
+  directories (the :mod:`veles_tpu.telemetry.blackbox` loader) and
+  synthesize pseudo-spans from the ``serve.*`` events carrying the
+  trace id — works with every process dead.
+
+Stdlib-only, jax-free, like the blackbox CLI: runs wherever the
+artifact or the endpoint is reachable."""
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+from veles_tpu.telemetry import blackbox, tracing
+
+
+def fetch_timeline(url, tid, timeout=10.0):
+    """GET ``{url}/trace/{tid}`` -> the endpoint's JSON payload
+    (router: merged + validated; replica: its local leg).  Raises
+    OSError/ValueError on unreachable endpoints or non-JSON bodies."""
+    target = "%s/trace/%s" % (url.rstrip("/"), tid)
+    try:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:  # endpoint is fine, trace just unknown
+            return {"spans": []}
+        raise
+
+
+def dump_timeline(dump_paths, tid):
+    """Post-mortem reconstruction: pseudo-spans from every crashdump
+    event carrying the trace id, merged across processes."""
+    paths = blackbox.find_dumps(dump_paths)
+    dumps = [blackbox.load_dump(d) for d in paths]
+    events = blackbox.merge_timeline(dumps)
+    return tracing.spans_from_flight(events, tid)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-trace",
+        description="reconstruct one serving request's cross-process "
+        "timeline by trace id, from live span-store endpoints or "
+        "merged crashdumps")
+    p.add_argument("trace", metavar="TRACE_ID",
+                   help="the request's trace id (done-line/flight-"
+                   "event 'trace' field)")
+    p.add_argument("--url", default=None, metavar="URL",
+                   help="live mode: base URL of a fleet router "
+                   "(e.g. http://host:port/fleet) or replica "
+                   "(http://host:port/api) — the CLI appends "
+                   "/trace/<id>")
+    p.add_argument("--dumps", nargs="+", default=None, metavar="DUMP",
+                   help="post-mortem mode: crashdump-* directories "
+                   "(or directories containing them); spans are "
+                   "synthesized from the serve.* flight events")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text",
+                   help="json emits {trace, spans, phases, gapless, "
+                   "problems} for scripting (the chaos gates)")
+    args = p.parse_args(argv)
+
+    if not tracing.valid_id(args.trace):
+        print("veles-tpu-trace: %r is not a trace id" % args.trace,
+              file=sys.stderr)
+        return 2
+    if bool(args.url) == bool(args.dumps):
+        print("veles-tpu-trace: exactly one of --url / --dumps",
+              file=sys.stderr)
+        return 2
+
+    if args.url:
+        try:
+            payload = fetch_timeline(args.url, args.trace)
+        except (OSError, ValueError) as e:
+            print("veles-tpu-trace: %s" % e, file=sys.stderr)
+            return 2
+        spans = payload.get("spans") or []
+    else:
+        try:
+            spans = dump_timeline(args.dumps, args.trace)
+        except (OSError, ValueError) as e:
+            print("veles-tpu-trace: %s" % e, file=sys.stderr)
+            return 2
+
+    if not spans:
+        print("veles-tpu-trace: no spans for %s" % args.trace,
+              file=sys.stderr)
+        return 1
+    verdict = tracing.validate(spans)
+    if args.format == "json":
+        print(json.dumps(
+            {"trace": args.trace, "spans": spans,
+             "phases": tracing.phases_of(spans),
+             "gapless": verdict["ok"],
+             "problems": verdict["problems"]},
+            indent=1, default=str))
+    else:
+        print(tracing.render_timeline(
+            spans, title="trace %s (%d spans)"
+            % (args.trace, len(spans))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

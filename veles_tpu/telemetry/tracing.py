@@ -1,0 +1,386 @@
+"""Request tracing — one gapless cross-process timeline per serving
+request (ref: the reference platform's master/slave web status story:
+cross-node visibility is a platform capability, not an add-on).
+
+PR 5's flight recorder answers "what happened in THIS process"; a
+serving request now lives across processes — router -> prefill replica
+-> handoff splice -> decode replica -> failover survivor — and this
+module is the cross-process complement.  A **trace context**
+(``trace_id`` + parent span id) is minted at the serving edge (the
+fleet router, or a bare replica), travels on the ``X-Veles-Trace``
+HTTP header between hops, and keys every span and ``serve.*`` flight
+event a request touches.  Client-supplied ids are forged-id-stripped
+at the router exactly like ``resume`` payloads: the edge always mints.
+
+Each process keeps a bounded :class:`SpanStore` — same ring discipline
+and per-event overhead budget (< 2 µs) as the flight recorder: an
+``add`` is one dict build + one locked append, no I/O, no syscalls
+(span ids come from a per-process seed + counter, not urandom).  On
+overflow the OLDEST trace is evicted and counted (surfaced as the
+``veles_trace_dropped_total`` counter).  Replicas expose their store
+via ``GET /api/trace/<id>``; the router aggregates its own spans with
+every live replica's and decomposes completed requests into
+queue/prefill/decode/stream phases.  Post-mortem, ``veles-tpu-trace``
+rebuilds the same timeline from merged crashdumps (flight events carry
+the trace id), so a request that crossed a SIGKILL still reconstructs.
+
+The terminal-span rule: **the process that minted the trace id records
+the one terminal span** (the router for routed requests, the replica
+when serving bare).  A replica that received its context on the header
+never terminates the trace — that is what keeps "exactly one terminal
+span" an invariant worth gating on.
+
+Stdlib-only; jax-free; every public mutator is fail-soft."""
+
+import collections
+import itertools
+import os
+import re
+import threading
+import time
+
+#: HTTP header carrying the trace context between serving hops:
+#: ``X-Veles-Trace: <trace_id>`` or ``<trace_id>/<parent_span_id>``
+TRACE_HEADER = "X-Veles-Trace"
+
+#: default bound on distinct traces held per process;
+#: root.common.trace.capacity overrides at first use
+DEFAULT_CAPACITY = 1024
+
+#: default bound on spans held per trace;
+#: root.common.trace.max_spans overrides at first use
+DEFAULT_MAX_SPANS = 128
+
+#: the four phases a completed request decomposes into
+PHASES = ("queue", "prefill", "decode", "stream")
+
+#: histogram buckets for the per-phase latency histograms — phase
+#: durations are MILLISECOND-valued, so the registry's second-flavored
+#: DEFAULT_BUCKETS would collapse everything into the top bucket
+PHASE_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                    500.0, 1000.0, 2000.0, 5000.0, 10000.0, 30000.0)
+
+#: ids are lowercase hex — anything else on the wire is forged/garbage
+_ID_RE = re.compile(r"^[0-9a-f]{4,32}$")
+
+#: wall = monotonic + MONO_TO_WALL: engine stamps are monotonic (they
+#: must survive NTP steps), but spans and flight events merge across
+#: processes on wall clock, so converted stamps key consistently
+MONO_TO_WALL = time.time() - time.monotonic()
+
+#: span ids must be unique across processes but their generation sits
+#: on the admission hot path — a random per-process seed + a counter
+#: costs ~0.1 µs where urandom-per-span would blow the 2 µs budget
+_SPAN_SEED = os.urandom(3).hex()
+_SPAN_COUNTER = itertools.count(1)
+
+
+def mono_to_wall(ts):
+    """A monotonic stamp as wall-clock time (cross-process mergeable)."""
+    return ts + MONO_TO_WALL
+
+
+def new_trace_id():
+    """A fresh 16-hex-char trace id (minted once per request, at the
+    edge — urandom here is off the hot path)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    """A fresh span id: per-process seed + counter, syscall-free."""
+    return "%s%06x" % (_SPAN_SEED, next(_SPAN_COUNTER))
+
+
+def valid_id(value):
+    """True when ``value`` looks like an id WE minted (lowercase hex,
+    bounded length) — the forged-id filter's yardstick."""
+    return isinstance(value, str) and bool(_ID_RE.match(value))
+
+
+def parse_header(value):
+    """``(trace_id, parent_span_id_or_None)`` from an ``X-Veles-Trace``
+    header value, or None when the header is absent or forged (a
+    non-hex id is somebody else's idea — mint fresh instead)."""
+    if not value:
+        return None
+    parts = str(value).strip().split("/", 1)
+    trace = parts[0]
+    if not valid_id(trace):
+        return None
+    parent = parts[1] if len(parts) > 1 else None
+    if parent is not None and not valid_id(parent):
+        parent = None
+    return trace, parent
+
+
+def format_header(trace, parent=None):
+    """The header value for the next hop: the trace id, plus the span
+    the receiver should parent onto."""
+    return "%s/%s" % (trace, parent) if parent else str(trace)
+
+
+def proc_label():
+    """Which process a span came from: the fleet agent's
+    ``VELES_TPU_FLEET_HOST``/``VELES_TPU_FLEET_REP`` env when running
+    as a fleet replica (podmaster threads these at spawn), else the
+    launcher's process index."""
+    host = os.environ.get("VELES_TPU_FLEET_HOST")
+    rep = os.environ.get("VELES_TPU_FLEET_REP")
+    if host is not None and rep is not None:
+        return "%s/r%s" % (host, rep)
+    try:
+        return "p%d" % int(os.environ.get("VELES_TPU_PROCESS_ID", "0"))
+    except ValueError:
+        return "p0"
+
+
+class SpanStore(object):
+    """Bounded per-request span store — the flight recorder's ring
+    discipline applied per-trace: an OrderedDict of trace_id -> span
+    list, evicting the OLDEST trace past ``capacity`` and the oldest
+    span past ``max_spans``, every eviction counted."""
+
+    def __init__(self, capacity=None, max_spans=None, enabled=None):
+        if capacity is None or max_spans is None or enabled is None:
+            from veles_tpu.config import root
+            trace_cfg = root.common.trace
+            if capacity is None:
+                capacity = int(trace_cfg.get(
+                    "capacity", DEFAULT_CAPACITY))
+            if max_spans is None:
+                max_spans = int(trace_cfg.get(
+                    "max_spans", DEFAULT_MAX_SPANS))
+            if enabled is None:
+                enabled = bool(trace_cfg.get("enabled", True))
+        self.capacity = int(capacity)
+        self.max_spans = int(max_spans)
+        self.enabled = bool(enabled)
+        self._traces = collections.OrderedDict()
+        # RLock for the same reason as the flight ring: signal handlers
+        # may record from a frame already inside the critical section
+        self._lock = threading.RLock()
+        self._added = 0
+        self.dropped_traces = 0
+        self.dropped_spans = 0
+        self._proc = proc_label()
+        self._drop_counter = None
+
+    # ---------------------------------------------------------- recording
+    def add(self, trace, name, ts=None, dur_ms=None, parent=None,
+            span=None, terminal=False, **attrs):
+        """O(1) append of one span; returns its id (the caller threads
+        it to the next hop as the parent).  The hot-path surface: one
+        dict build + one locked append, budgeted like flight.record."""
+        if not self.enabled or not trace:
+            return None
+        sp = {"trace": trace, "span": span or new_span_id(),
+              "parent": parent, "name": name,
+              "ts": time.time() if ts is None else ts,
+              "proc": self._proc}
+        if dur_ms is not None:
+            sp["dur_ms"] = dur_ms
+        if terminal:
+            sp["terminal"] = True
+        if attrs:
+            sp.update(attrs)
+        with self._lock:
+            spans = self._traces.get(trace)
+            if spans is None:
+                if len(self._traces) >= self.capacity:
+                    self._traces.popitem(last=False)
+                    self.dropped_traces += 1
+                    self._count_drop("trace")
+                spans = self._traces[trace] = []
+            else:
+                self._traces.move_to_end(trace)
+                if len(spans) >= self.max_spans:
+                    del spans[0]
+                    self.dropped_spans += 1
+                    self._count_drop("span")
+            spans.append(sp)
+            self._added += 1
+        return sp["span"]
+
+    def _count_drop(self, kind):
+        """Evictions (only) touch the metrics registry — fail-soft, so
+        a broken registry never stalls admission."""
+        try:
+            if self._drop_counter is None:
+                from veles_tpu import telemetry
+                self._drop_counter = telemetry.registry.counter(
+                    "veles_trace_dropped_total",
+                    "traces/spans evicted from the bounded span store",
+                    labelnames=("kind",))
+            self._drop_counter.inc(kind=kind)
+        except Exception:   # noqa: BLE001 — instrumentation never kills
+            self._drop_counter = None
+
+    # ------------------------------------------------------------ reading
+    def spans(self, trace):
+        """This trace's spans, oldest first ([] when unknown/evicted)."""
+        with self._lock:
+            return list(self._traces.get(trace, ()))
+
+    def traces(self):
+        """Known trace ids, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._traces)
+
+    @property
+    def added(self):
+        with self._lock:
+            return self._added
+
+    @property
+    def dropped(self):
+        """Total evictions (traces + spans) — the counted-gauge read."""
+        with self._lock:
+            return self.dropped_traces + self.dropped_spans
+
+    def clear(self):
+        with self._lock:
+            self._traces.clear()
+            self._added = 0
+            self.dropped_traces = 0
+            self.dropped_spans = 0
+
+    def set_capacity(self, capacity=None, max_spans=None):
+        """Re-bound the store (config applied after import, like the
+        flight ring).  Keeps the newest traces when shrinking."""
+        with self._lock:
+            if capacity is not None:
+                self.capacity = int(capacity)
+                while len(self._traces) > self.capacity:
+                    self._traces.popitem(last=False)
+                    self.dropped_traces += 1
+            if max_spans is not None:
+                self.max_spans = int(max_spans)
+
+
+# ----------------------------------------------------------- timeline math
+def phases_of(spans):
+    """{phase: dur_ms} summed from ``phase.<name>`` spans — several
+    legs (failover resubmit, prefill handoff) each contribute their
+    share of the same phase."""
+    out = {}
+    for sp in spans:
+        name = sp.get("name", "")
+        if name.startswith("phase.") and sp.get("dur_ms") is not None:
+            phase = name[len("phase."):]
+            out[phase] = out.get(phase, 0.0) + float(sp["dur_ms"])
+    return out
+
+
+def validate(spans):
+    """The gaplessness check the chaos gates pin: every parent id
+    resolves inside the trace, exactly one root, exactly one terminal
+    span.  -> ``{"ok": bool, "problems": [str, ...]}``.
+
+    A replica SIGKILL loses that replica's spans entirely — which
+    stays gapless (the router's own leg/failover spans form a
+    connected chain); what it can never produce is a DANGLING parent
+    or a second terminal."""
+    problems = []
+    if not spans:
+        return {"ok": False, "problems": ["no spans"]}
+    ids = set()
+    for sp in spans:
+        sid = sp.get("span")
+        if sid in ids:
+            problems.append("duplicate span id %s" % sid)
+        ids.add(sid)
+    roots, terminals = 0, 0
+    for sp in spans:
+        parent = sp.get("parent")
+        if parent is None:
+            roots += 1
+        elif parent not in ids:
+            problems.append(
+                "span %s (%s) has unresolved parent %s"
+                % (sp.get("span"), sp.get("name"), parent))
+        if sp.get("terminal"):
+            terminals += 1
+    if roots != 1:
+        problems.append("%d root spans (want exactly 1)" % roots)
+    if terminals != 1:
+        problems.append("%d terminal spans (want exactly 1)" % terminals)
+    return {"ok": not problems, "problems": problems}
+
+
+def render_timeline(spans, title=None):
+    """The operator view of one trace — the blackbox timeline format
+    (offsets from the first span, ``[proc]`` tags), plus the phase
+    decomposition footer."""
+    out = []
+    if title:
+        out.append(title)
+    if not spans:
+        out.append("(no spans)")
+        return "\n".join(out)
+    ordered = sorted(spans, key=lambda s: (s.get("ts", 0.0),
+                                           s.get("span") or ""))
+    t0 = ordered[0].get("ts", 0.0)
+    for sp in ordered:
+        line = "  %+10.3fs [%s] %-18s" % (
+            sp.get("ts", 0.0) - t0, sp.get("proc", "?"),
+            sp.get("name", "?"))
+        extra = []
+        if sp.get("dur_ms") is not None:
+            extra.append("dur_ms=%.3f" % float(sp["dur_ms"]))
+        for k in sorted(sp):
+            if k in ("trace", "span", "parent", "name", "ts", "proc",
+                     "dur_ms", "terminal"):
+                continue
+            extra.append("%s=%s" % (k, sp[k]))
+        if sp.get("terminal"):
+            extra.append("TERMINAL")
+        if extra:
+            line += " " + " ".join(extra)
+        out.append(line.rstrip())
+    phases = phases_of(ordered)
+    if phases:
+        out.append("  phases: " + "  ".join(
+            "%s=%.3fms" % (p, phases[p])
+            for p in PHASES if p in phases))
+    verdict = validate(spans)
+    out.append("  gapless: %s%s"
+               % ("yes" if verdict["ok"] else "NO",
+                  "" if verdict["ok"]
+                  else "  (" + "; ".join(verdict["problems"]) + ")"))
+    return "\n".join(out)
+
+
+def spans_from_flight(events, trace):
+    """Pseudo-spans synthesized from flight events carrying this trace
+    id — the post-mortem path (``veles-tpu-trace --dumps``): every
+    process's crashdump events become one timeline even when every
+    span store died with its process."""
+    out = []
+    for ev in events:
+        if ev.get("trace") != trace:
+            continue
+        sp = dict(ev)
+        sp.setdefault("name", ev.get("kind", "?"))
+        sp.setdefault("span", None)
+        sp.setdefault("parent", None)
+        sp.setdefault("proc", ev.get("proc", "?"))
+        out.append(sp)
+    return out
+
+
+#: the process-global span store (one per process, like the flight
+#: recorder); ``span_add`` below is the framework-facing surface
+store = SpanStore()
+
+
+def span_add(trace, name, **fields):
+    """Append one span to the process store.  Never raises —
+    instrumentation must not kill the request it observes."""
+    try:
+        return store.add(trace, name, **fields)
+    except Exception:   # noqa: BLE001
+        return None
