@@ -1,0 +1,523 @@
+"""State-plane contract auditors (ISSUE 19): the VK10xx serialized-
+state contract audit and the VB11xx host-determinism lint.
+
+The PR 16/17 test pattern: per-rule seeded-hazard fixtures where each
+rule fires exactly once, cross-module writer/reader matching, the
+clean-path idioms (.get default, membership probe, version guard,
+exempted metadata), the suppression contract, real-tree zero-findings
+gates, the generated docs/state_reference.md pin, the never-imports-
+what-it-scans purity pin, and the CLI gates in-process."""
+
+import os
+import textwrap
+
+import pytest
+
+from veles_tpu.analysis import determinism_audit, state_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _state(tmp_path, *sources):
+    paths = []
+    for i, src in enumerate(sources):
+        p = tmp_path / ("mod%d.py" % i)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return state_audit.lint_state(paths=paths)
+
+
+def _determinism(tmp_path, *sources):
+    paths = []
+    for i, src in enumerate(sources):
+        p = tmp_path / ("det%d.py" % i)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return determinism_audit.lint_determinism(paths=paths)
+
+
+# --------------------------------------------------------------------------
+# VK10xx — seeded hazards, each rule fires exactly once
+# --------------------------------------------------------------------------
+
+VK_SEEDS = {
+    "VK1000": """
+        class Snapshotter:
+            def collect(self):
+                return {"params": 1, "debug_blob": 2}
+
+            def restore(self, workflow, snapshot):
+                return snapshot["params"]
+        """,
+    "VK1001": """
+        class Snapshotter:
+            def collect(self):
+                return {"params": 1}
+
+            def restore(self, workflow, snapshot):
+                return snapshot.get("params"), snapshot.get("momentum")
+        """,
+    "VK1002": """
+        class Snapshotter:
+            def collect(self):
+                snap = {"params": 1}
+                if self.extended:
+                    snap["extra"] = 2
+                return snap
+
+            def restore(self, workflow, snapshot):
+                return snapshot["params"], snapshot["extra"]
+        """,
+    "VK1003": """
+        import hashlib
+        import json
+
+        def tree_digest(tree):
+            return hashlib.sha256(
+                json.dumps(tree).encode()).hexdigest()
+        """,
+    "VK1004": """
+        import threading
+
+        class Snapshotter:
+            def collect(self):
+                return {"params": 1, "guard": threading.Lock()}
+        """,
+}
+
+
+class TestSeededVK:
+    @pytest.mark.parametrize("rule", sorted(VK_SEEDS))
+    def test_rule_fires_exactly_once(self, rule, tmp_path):
+        findings = _state(tmp_path, VK_SEEDS[rule])
+        assert _rules(findings) == [rule], findings
+
+    def test_all_vk_rules_covered(self):
+        assert tuple(sorted(VK_SEEDS)) == state_audit.RULES
+
+    def test_vk1000_reader_in_other_module_clears(self, tmp_path):
+        """The scanned files are ONE state universe — a key written
+        here and restored there is matched across modules."""
+        reader = """
+            class Trainer:
+                def restore(self, workflow, snapshot):
+                    return snapshot["params"], snapshot["debug_blob"]
+            """
+        findings = _state(tmp_path, VK_SEEDS["VK1000"], reader)
+        assert findings == [], findings
+
+    def test_vk1001_writer_in_other_module_clears(self, tmp_path):
+        writer = """
+            class Momentum:
+                def collect(self):
+                    return {"momentum": 0.9}
+            """
+        findings = _state(tmp_path, VK_SEEDS["VK1001"], writer)
+        assert findings == [], findings
+
+    def test_vk1002_get_default_clears(self, tmp_path):
+        findings = _state(tmp_path, """
+            class Snapshotter:
+                def collect(self):
+                    snap = {"params": 1}
+                    if self.extended:
+                        snap["extra"] = 2
+                    return snap
+
+                def restore(self, workflow, snapshot):
+                    return snapshot["params"], snapshot.get("extra")
+            """)
+        assert findings == [], findings
+
+    def test_vk1002_membership_probe_clears(self, tmp_path):
+        findings = _state(tmp_path, """
+            class Snapshotter:
+                def collect(self):
+                    snap = {"params": 1}
+                    if self.extended:
+                        snap["extra"] = 2
+                    return snap
+
+                def restore(self, workflow, snapshot):
+                    out = snapshot["params"]
+                    if "extra" in snapshot:
+                        out += snapshot["extra"]
+                    return out
+            """)
+        assert findings == [], findings
+
+    def test_vk1002_version_guard_clears(self, tmp_path):
+        """A reader comparing the contract's version key is guarded:
+        old payloads take the version branch, not the KeyError."""
+        findings = _state(tmp_path, """
+            class Snapshotter:
+                def state_manifest(self):
+                    man = {"format": 2}
+                    if self.arrays:
+                        man["arrays"] = list(self.arrays)
+                    return man
+
+                def validate_state_manifest(self, manifest):
+                    if manifest.get("format") != 2:
+                        return None
+                    return manifest["arrays"]
+            """)
+        assert findings == [], findings
+
+    def test_vk1003_sort_keys_is_canonical(self, tmp_path):
+        findings = _state(tmp_path, """
+            import hashlib
+            import json
+
+            def tree_digest(tree):
+                return hashlib.sha256(json.dumps(
+                    tree, sort_keys=True).encode()).hexdigest()
+            """)
+        assert findings == [], findings
+
+    def test_vk1003_dict_order_into_digest_update(self, tmp_path):
+        findings = _state(tmp_path, """
+            import hashlib
+
+            def tree_digest(leaves):
+                h = hashlib.sha256()
+                for name, blob in leaves.items():
+                    h.update(blob)
+                return h.hexdigest()
+            """)
+        assert _rules(findings) == ["VK1003"], findings
+
+    def test_meta_keys_are_not_dead_freight(self, tmp_path):
+        """Wall-clock provenance keys (META_KEYS) are written for
+        operators, read by no restore path — and exempt by design."""
+        findings = _state(tmp_path, """
+            import time
+
+            class Snapshotter:
+                def collect(self):
+                    return {"params": 1, "created": time.time()}
+
+                def restore(self, workflow, snapshot):
+                    return snapshot["params"]
+            """)
+        assert findings == [], findings
+
+    def test_reader_side_augmentation_registers_key(self, tmp_path):
+        """``msg["resumed"] = True`` in a reader is a (reader-side)
+        writer: a later strict read of it is VK1002, not VK1001."""
+        findings = _state(tmp_path, """
+            class Router:
+                def _do_work_post(self, wfile):
+                    wfile.write(json.dumps({"done": True}) + "\\n")
+
+                def _pump_stream(self, resp, msg):
+                    msg["resumed"] = True
+                    return msg["resumed"], msg.get("done")
+            """)
+        assert _rules(findings) == ["VK1002"], findings
+
+
+# --------------------------------------------------------------------------
+# VB11xx — seeded hazards, each rule fires exactly once
+# --------------------------------------------------------------------------
+
+VB_SEEDS = {
+    "VB1100": """
+        import time
+
+        class Snapshotter:
+            def collect(self):
+                return {"params": 1, "stamp": time.time()}
+        """,
+    "VB1101": """
+        import os
+
+        def newest(directory):
+            return os.listdir(directory)[0]
+        """,
+    "VB1102": """
+        def dedupe(names):
+            out = []
+            for name in set(names):
+                out.append(name)
+            return out
+        """,
+    "VB1103": """
+        import uuid
+
+        def commit_tag():
+            return uuid.uuid4().hex
+        """,
+    "VB1104": """
+        import json
+        import threading
+
+        def gather(hosts):
+            results = []
+
+            def probe():
+                results.append(1)
+
+            for host in hosts:
+                threading.Thread(target=probe).start()
+            return json.dumps(results)
+        """,
+}
+
+
+class TestSeededVB:
+    @pytest.mark.parametrize("rule", sorted(VB_SEEDS))
+    def test_rule_fires_exactly_once(self, rule, tmp_path):
+        findings = _determinism(tmp_path, VB_SEEDS[rule])
+        assert _rules(findings) == [rule], findings
+
+    def test_all_vb_rules_covered(self):
+        assert tuple(sorted(VB_SEEDS)) == determinism_audit.RULES
+
+    def test_vb1100_exempt_metadata_key(self, tmp_path):
+        """"created"-style provenance is the sanctioned wall-clock in
+        a payload — allowlisted with a rationale, not suppressed."""
+        findings = _determinism(tmp_path, """
+            import time
+
+            class Snapshotter:
+                def collect(self):
+                    return {"params": 1, "created": time.time()}
+            """)
+        assert findings == [], findings
+        assert "created" in determinism_audit.EXEMPT_WALLCLOCK_KEYS
+
+    def test_vb1101_sorted_wrap_clears(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import os
+
+            def newest(directory):
+                return sorted(os.listdir(directory))[0]
+            """)
+        assert findings == [], findings
+
+    def test_vb1101_sorted_genexp_clears(self, tmp_path):
+        """The podmaster idiom: enumeration inside a genexp that is
+        itself the sorted() argument is ordered."""
+        findings = _determinism(tmp_path, """
+            import os
+
+            def logs(directory):
+                return sorted(n for n in os.listdir(directory)
+                              if n.endswith(".log"))
+            """)
+        assert findings == [], findings
+
+    def test_vb1102_sorted_set_clears(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            def dedupe(names):
+                out = []
+                for name in sorted(set(names)):
+                    out.append(name)
+                return out
+            """)
+        assert findings == [], findings
+
+    def test_vb1103_seeded_instance_is_sanctioned(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import random
+
+            def shuffled(names, seed):
+                rng = random.Random(seed)
+                rng.shuffle(names)
+                return names
+            """)
+        assert findings == [], findings
+
+    def test_vb1103_unseeded_ctor_flagged(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import random
+
+            def shuffled(names):
+                rng = random.Random()
+                rng.shuffle(names)
+                return names
+            """)
+        assert _rules(findings) == ["VB1103"], findings
+
+    def test_vb1104_sort_before_escape_clears(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import json
+            import threading
+
+            def gather(hosts):
+                results = []
+
+                def probe():
+                    results.append(1)
+
+                for host in hosts:
+                    threading.Thread(target=probe).start()
+                results.sort()
+                return json.dumps(results)
+            """)
+        assert findings == [], findings
+
+
+# --------------------------------------------------------------------------
+# suppression — the lint-ok contract, shared with VT/VW/VC
+# --------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_rationale_suppresses_vk(self, tmp_path):
+        findings = _state(tmp_path, """
+            class Snapshotter:
+                def collect(self):
+                    # lint-ok: VK1000 — staged key; the reader lands
+                    # with the registry PR
+                    return {"params": 1, "debug_blob": 2}
+
+                def restore(self, workflow, snapshot):
+                    return snapshot["params"]
+            """)
+        assert findings == [], findings
+
+    def test_rationale_suppresses_vb(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import os
+
+            def count(directory):
+                # lint-ok: VB1101 — only the COUNT is used; order
+                # never escapes this function
+                return len(os.listdir(directory))
+            """)
+        assert findings == [], findings
+
+    def test_bare_lint_ok_suppresses_nothing(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import os
+
+            def newest(directory):
+                # lint-ok:
+                return os.listdir(directory)[0]
+            """)
+        assert _rules(findings) == ["VB1101"], findings
+
+    def test_wrong_rule_tag_suppresses_nothing(self, tmp_path):
+        findings = _determinism(tmp_path, """
+            import os
+
+            def newest(directory):
+                # lint-ok: VB1103 — wrong family member
+                return os.listdir(directory)[0]
+            """)
+        assert _rules(findings) == ["VB1101"], findings
+
+
+# --------------------------------------------------------------------------
+# the shipped tree — both contracts hold at zero findings
+# --------------------------------------------------------------------------
+
+class TestRealTree:
+    def test_state_contracts_are_clean(self):
+        findings = state_audit.lint_state()
+        assert findings == [], findings
+
+    def test_determinism_is_clean(self):
+        findings = determinism_audit.lint_determinism()
+        assert findings == [], findings
+
+    def test_reference_doc_is_fresh(self):
+        """docs/state_reference.md is generated — regenerating it must
+        reproduce the checked-in file byte for byte (the CI staleness
+        gate)."""
+        with open(os.path.join(REPO, "docs",
+                               "state_reference.md")) as fh:
+            checked_in = fh.read()
+        assert state_audit.build_reference() == checked_in
+
+    def test_reference_is_deterministic(self):
+        assert state_audit.build_reference() == \
+            state_audit.build_reference()
+
+    def test_exemption_maps_stay_in_lockstep(self):
+        """Every VB1100 wall-clock allowlist key is also a VK1000
+        metadata exemption — one rationale, two rules."""
+        for key in determinism_audit.EXEMPT_WALLCLOCK_KEYS:
+            assert key in state_audit.META_KEYS
+
+    def test_audits_never_import_what_they_scan(self):
+        """Pure AST: auditing the state plane must not execute it —
+        the lints and the reference builder import NOTHING beyond what
+        loading the analyzers themselves already did."""
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "from veles_tpu.analysis import (determinism_audit,\n"
+            "                                state_audit)\n"
+            "before = set(sys.modules)\n"
+            "state_audit.lint_state()\n"
+            "determinism_audit.lint_determinism()\n"
+            "state_audit.build_reference()\n"
+            "grew = sorted(m for m in set(sys.modules) - before\n"
+            "              if m.startswith('veles_tpu'))\n"
+            "scanned = [m for m in sys.modules if m.startswith((\n"
+            "    'veles_tpu.services', 'veles_tpu.loader',\n"
+            "    'veles_tpu.models', 'veles_tpu.tuner'))]\n"
+            "print('GREW', grew, 'SCANNED', scanned)\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, check=True)
+        assert "GREW [] SCANNED []" in out.stdout, \
+            out.stdout + out.stderr
+
+
+# --------------------------------------------------------------------------
+# CLI — exit codes 0/1/2 through the shared findings gate
+# --------------------------------------------------------------------------
+
+class TestCLI:
+    def test_state_and_determinism_clean(self, capsys):
+        from veles_tpu.analysis.cli import main
+        rc = main(["--state", "--determinism"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no findings" in out
+
+    def test_all_runs_every_ast_family(self, capsys):
+        from veles_tpu.analysis.cli import main
+        rc = main(["--all"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no findings" in out
+
+    def test_markdown_prints_the_state_reference(self, capsys):
+        from veles_tpu.analysis.cli import main
+        rc = main(["--state", "--format", "markdown"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("# Serialized-state contract reference")
+
+    def test_markdown_pairs_with_one_reference_family(self):
+        from veles_tpu.analysis.cli import main
+        with pytest.raises(SystemExit) as e:
+            main(["--state", "--determinism", "--format", "markdown"])
+        assert e.value.code == 2
+        with pytest.raises(SystemExit) as e:
+            main(["--state", "--config-audit", "--format", "markdown"])
+        assert e.value.code == 2
+
+    def test_fail_on_unifies_state_findings(self, capsys, monkeypatch):
+        """A VK1000 dead-freight warning flips the exit only under
+        --fail-on warning — threshold_reached is the one gate."""
+        import veles_tpu.analysis as analysis
+        from veles_tpu.analysis.cli import main
+        from veles_tpu.analysis.findings import WARNING, Finding
+        monkeypatch.setattr(
+            analysis, "lint_state",
+            lambda paths=None, root=None: [Finding(
+                "VK1000", WARNING, "x.py:1", "seeded")])
+        assert main(["--state"]) == 0
+        assert main(["--state", "--fail-on", "warning"]) == 1
+        out = capsys.readouterr().out
+        assert "VK1000" in out

@@ -111,6 +111,13 @@ class Main(object):
         p.add_argument("--concurrency", action="store_true",
                        help="with --lint: add the VT8xx concurrency "
                        "lint (pure AST scan of veles_tpu/services)")
+        p.add_argument("--all", action="store_true", dest="lint_all",
+                       help="with --lint: add every registered AST "
+                       "family (VT8xx concurrency, VW9xx protocol, "
+                       "VC95x config/telemetry, VK10xx serialized "
+                       "state, VB11xx host determinism) to the "
+                       "workflow families — one merged report, one "
+                       "exit gate (identical to veles-tpu-lint --all)")
         p.add_argument("--vmem-kib", type=float, default=None,
                        metavar="KiB",
                        help="with --lint: per-core VMEM budget for the "
@@ -615,9 +622,19 @@ class Main(object):
                 findings = findings + lint_serving(
                     trainer, args.serve_max_len,
                     vmem_kib=args.vmem_kib)
-            if args.concurrency:
+            if args.concurrency or args.lint_all:
                 from veles_tpu.analysis import lint_concurrency
                 findings = findings + lint_concurrency()
+            if args.lint_all:
+                # --lint --all: every registered AST family joins the
+                # workflow families in one merged report/exit gate
+                # (veles-tpu-lint --all parity)
+                from veles_tpu.analysis import (lint_config,
+                                                lint_determinism,
+                                                lint_protocol,
+                                                lint_state)
+                findings = (findings + lint_protocol() + lint_config()
+                            + lint_state() + lint_determinism())
             print(format_findings(findings))
             return 1 if threshold_reached(findings,
                                           args.fail_on) else 0

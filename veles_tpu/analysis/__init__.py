@@ -24,7 +24,16 @@ contract audit checks every ``root.common`` knob read against the
 ``config.py`` declarations and every flight-event/metric emit against
 the test/tool/docs surface (config_audit, VC95x — ``--config-audit``,
 which also generates docs/config_reference.md via ``--format
-markdown``).  Surface: :func:`lint_workflow` in-process, the
+markdown``).  The state plane closes the bit-exactness loop: the
+serialized-state contract auditor checks every snapshot/manifest/
+winners/crashdump/spec/NDJSON key writer-vs-reader plus canonical-
+serialization and picklability (state_audit, VK10xx — ``--state``,
+which also generates docs/state_reference.md via ``--format
+markdown``), and the host-determinism lint scans the bit-compared
+modules for wall-clock, unsorted enumeration, set-order iteration,
+host RNG and unordered threaded accumulation (determinism_audit,
+VB11xx — ``--determinism``).  ``--all`` runs every registered family
+in one pass.  Surface: :func:`lint_workflow` in-process, the
 ``veles-tpu-lint`` console script, and ``python -m veles_tpu ...
 --lint``.
 
@@ -42,7 +51,8 @@ __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
            "threshold_reached", "lint_graph", "audit_step",
            "audit_sharded_step", "audit_numerics", "lint_workflow",
            "lint_serving", "lint_concurrency", "lint_protocol",
-           "lint_config", "build_config_reference"]
+           "lint_config", "build_config_reference", "lint_state",
+           "lint_determinism", "build_state_reference"]
 
 
 def audit_sharded_step(spec, hbm_gib=None):
@@ -100,6 +110,28 @@ def build_config_reference(registry=None, root=None):
     see :func:`veles_tpu.analysis.config_audit.build_reference`."""
     from veles_tpu.analysis import config_audit
     return config_audit.build_reference(registry=registry, root=root)
+
+
+def lint_state(paths=None, root=None):
+    """Serialized-state contract audit (VK10xx) — see
+    :mod:`veles_tpu.analysis.state_audit` (lazy; pure AST, no jax)."""
+    from veles_tpu.analysis import state_audit
+    return state_audit.lint_state(paths=paths, root=root)
+
+
+def lint_determinism(paths=None, root=None):
+    """Host-determinism lint of the bit-compared modules (VB11xx) —
+    see :mod:`veles_tpu.analysis.determinism_audit` (lazy; pure AST,
+    no jax)."""
+    from veles_tpu.analysis import determinism_audit
+    return determinism_audit.lint_determinism(paths=paths, root=root)
+
+
+def build_state_reference(root=None):
+    """The generated docs/state_reference.md serialized-state catalog —
+    see :func:`veles_tpu.analysis.state_audit.build_reference`."""
+    from veles_tpu.analysis import state_audit
+    return state_audit.build_reference(root=root)
 
 
 def lint_workflow(wf, staging=True, sharding=True, numerics=True,

@@ -149,11 +149,13 @@ def main(argv=None):
                     "auditor + serving decode-path auditor + "
                     "control-plane concurrency lint + wire-protocol "
                     "contract lint + config/telemetry contract audit "
+                    "+ serialized-state contract audit + "
+                    "host-determinism lint "
                     "(rule catalog: docs/static_analysis.md)",
         formatter_class=argparse.RawDescriptionHelpFormatter,
-        epilog="exit codes (identical across graph/staging/sharding/"
-               "numerics/serve/\nconcurrency runs — analysis.findings"
-               ".threshold_reached is the one gate):\n"
+        epilog="exit codes (identical across every family, VG...VB — "
+               "analysis.findings\n.threshold_reached is the one "
+               "gate):\n"
                "  0  no findings at or above the --fail-on severity\n"
                "  1  threshold reached (default --fail-on error: any "
                "error finding)\n"
@@ -162,8 +164,9 @@ def main(argv=None):
     p.add_argument("workflow", nargs="?", default=None,
                    help="workflow .py file defining run(load, main) "
                    "(optional only for a pure --concurrency / "
-                   "--protocol / --config-audit run — the AST lints "
-                   "need no workflow)")
+                   "--protocol / --config-audit / --state / "
+                   "--determinism / --all run — the AST lints need no "
+                   "workflow)")
     p.add_argument("config", nargs="?", help="config .py file executed "
                    "with `root` in scope")
     p.add_argument("--config-list", nargs="*", default=[],
@@ -172,9 +175,10 @@ def main(argv=None):
     p.add_argument("--format", choices=("text", "json", "markdown"),
                    default="text",
                    help="'text'/'json' render findings; 'markdown' "
-                   "(only with --config-audit, no other audit) prints "
-                   "the docs/config_reference.md contract reference "
-                   "instead and always exits 0")
+                   "(only with --config-audit alone or --state alone) "
+                   "prints the generated contract reference "
+                   "(docs/config_reference.md or docs/"
+                   "state_reference.md) instead and always exits 0")
     p.add_argument("--no-staging", action="store_true",
                    help="graph rules only; skip the jit-staging audit "
                    "hooks")
@@ -233,6 +237,29 @@ def main(argv=None):
                    "dead knobs, conflicting defaults) and flight-event"
                    "/metric emits vs the test/tool/docs surface; "
                    "needs no workflow file")
+    p.add_argument("--state", action="store_true",
+                   help="run the VK10xx serialized-state contract "
+                   "audit (pure AST scan) over the snapshot/manifest/"
+                   "winners/crashdump/fleet-spec/NDJSON state plane — "
+                   "every serialized key needs a reader, every read "
+                   "key a writer, optional keys a .get default or "
+                   "version guard, digests canonical serialization, "
+                   "pickled payloads picklable leaves; needs no "
+                   "workflow file")
+    p.add_argument("--determinism", action="store_true",
+                   help="run the VB11xx host-determinism lint (pure "
+                   "AST scan) over the modules the chaos gates "
+                   "bit-compare (snapshotter/sentinel/podmaster/prng/"
+                   "generate/loaders) — wall-clock into payloads or "
+                   "digests, unsorted filesystem enumeration, "
+                   "set-order iteration, host random/uuid, unordered "
+                   "threaded accumulation; needs no workflow file")
+    p.add_argument("--all", action="store_true",
+                   help="run every registered AST family in one pass "
+                   "(--concurrency --protocol --config-audit --state "
+                   "--determinism) with one merged findings report "
+                   "and one exit gate; with a workflow file the "
+                   "graph/staging families run too")
     p.add_argument("--fail-on", choices=("error", "warning"),
                    default="error", metavar="{error,warning}",
                    help="severity threshold for the non-zero exit: "
@@ -244,20 +271,32 @@ def main(argv=None):
                    help="deprecated alias for --fail-on warning")
     args = p.parse_args(argv)
 
-    ast_only = args.concurrency or args.protocol or args.config_audit
+    if args.all:
+        args.concurrency = args.protocol = args.config_audit = True
+        args.state = args.determinism = True
+    ast_only = (args.concurrency or args.protocol or args.config_audit
+                or args.state or args.determinism)
     if args.workflow is None and not ast_only:
         p.error("a workflow file is required (only pure --concurrency/"
-                "--protocol/--config-audit runs work without one)")
+                "--protocol/--config-audit/--state/--determinism/--all "
+                "runs work without one)")
     if args.serve and args.workflow is None:
         p.error("--serve audits a workflow's serving engine — give "
                 "it the workflow file")
     if args.format == "markdown":
-        if not args.config_audit or args.workflow is not None \
-                or args.concurrency or args.protocol:
-            p.error("--format markdown prints the config/telemetry "
-                    "contract reference — it pairs with --config-audit "
-                    "alone")
-        from veles_tpu.analysis.config_audit import build_reference
+        only_config = (args.config_audit and not args.state)
+        only_state = (args.state and not args.config_audit)
+        if args.workflow is not None or args.concurrency \
+                or args.protocol or args.determinism \
+                or not (only_config or only_state):
+            p.error("--format markdown prints a generated contract "
+                    "reference — it pairs with --config-audit alone "
+                    "(docs/config_reference.md) or --state alone "
+                    "(docs/state_reference.md)")
+        if only_state:
+            from veles_tpu.analysis.state_audit import build_reference
+        else:
+            from veles_tpu.analysis.config_audit import build_reference
         sys.stdout.write(build_reference())
         return 0
 
@@ -296,6 +335,12 @@ def main(argv=None):
     if args.config_audit:
         from veles_tpu.analysis import lint_config
         findings.extend(lint_config())
+    if args.state:
+        from veles_tpu.analysis import lint_state
+        findings.extend(lint_state())
+    if args.determinism:
+        from veles_tpu.analysis import lint_determinism
+        findings.extend(lint_determinism())
 
     from veles_tpu.analysis import (format_findings, sort_findings,
                                     threshold_reached)

@@ -1,0 +1,468 @@
+"""Host-determinism audit (VB11xx).
+
+Every chaos gate in this tree (train/pod/numerics/fleet) rests on
+bit-identical restore/replay/splice at threshold 0 — and that
+guarantee is only as strong as the HOST code on the compared paths:
+one unsorted ``os.listdir`` feeding commit agreement, one wall-clock
+value folded into a digest, one ``uuid4`` in a replayed path, and two
+healthy hosts disagree about identical state.  This audit scans the
+modules the gates compare bit-identically (snapshotter, loader, prng,
+sentinel replay, generate splice, podmaster agreement — pure AST,
+nothing is imported, nothing runs) for the host-side nondeterminism
+classes.
+
+**Scope discipline.**  The file set IS the rule's sink: these modules
+produce the compared artifacts, so within them filesystem-enumeration
+order, set-iteration order, host RNG, and wall-clock-into-payload are
+flagged at the call site rather than through whole-program flow
+tracking.  Wall-clock provenance keys every snapshot legitimately
+carries (``"created"``-style) are exempted by
+:data:`EXEMPT_WALLCLOCK_KEYS` — each with its rationale, rendered into
+``docs/state_reference.md`` by the VK10xx reference builder's shared
+:data:`~veles_tpu.analysis.state_audit.META_KEYS` table.
+
+Rule catalog (docs/static_analysis.md):
+
+========  =======  ======================================================
+VB1100    error    wall-clock (``time.time``/``datetime.now``/
+                   ``getmtime``) flowing into a serialized contract
+                   payload key or a digest — equal states stamp
+                   unequal (metadata keys on the exemption allowlist
+                   are fine)
+VB1101    error    unsorted filesystem enumeration (``os.listdir``/
+                   ``glob``/``iterdir``/``scandir``/``os.walk``) —
+                   directory order is filesystem-dependent, so
+                   checkpoint selection/agreement built on it diverges
+                   across hosts
+VB1102    error    iteration over a set in the compared modules —
+                   set order varies per process (hash randomization),
+                   so anything built from it is host-dependent
+VB1103    error    host RNG in a replayed path: module-level
+                   ``random.*``, ``uuid.uuid*``, unseeded
+                   ``Random()``/``RandomState()``/``default_rng()``
+                   (seeded instances and ``jax.random`` are the
+                   sanctioned sources)
+VB1104    warning  threads spawned in a loop append into a container
+                   that is then serialized/returned without an
+                   ordering discipline — completion order is the
+                   scheduler's, not the program's
+========  =======  ======================================================
+
+**Suppression**: ``# lint-ok: VB1101 — reason`` on the flagged line or
+the contiguous comment block above it; a bare ``# lint-ok:``
+suppresses nothing.
+"""
+
+import ast
+import os
+import re
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VB11xx family, in catalog order
+RULES = ("VB1100", "VB1101", "VB1102", "VB1103", "VB1104")
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3,4}(?:\s*,\s*"
+                          r"[A-Z]{2}\d{3,4})*)")
+
+#: wall-clock payload keys that are sanctioned metadata, with the
+#: rationale the reference doc renders (kept in lockstep with
+#: state_audit.META_KEYS — the VK1000 exemptions for the same keys)
+EXEMPT_WALLCLOCK_KEYS = {
+    "created": "commit wall-time provenance for operators; never read "
+               "back by any restore path",
+    "mtime": "host-local commit mtime used only for same-host ordering "
+             "(SPMD-lockstep ties are broken by name)",
+    "ts": "crash wall-time provenance for the post-mortem timeline",
+}
+
+#: functions whose dict payloads are serialized contract state (the
+#: VK10xx writer surface) — VB1100's sink set
+WRITER_FUNCS = ("collect", "state_manifest", "commit_meta",
+                "scan_commits", "worker_spec", "_meta_state",
+                "_save_locked")
+
+_WALLCLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",
+                    "time.monotonic_ns", "datetime.now",
+                    "datetime.utcnow", "datetime.datetime.now",
+                    "datetime.datetime.utcnow", "os.path.getmtime",
+                    "getmtime")
+
+_ENUM_CALLS = ("os.listdir", "listdir", "os.scandir", "scandir",
+               "glob.glob", "glob.iglob", "os.walk")
+_ENUM_METHOD_TAILS = ("iterdir", "glob", "rglob")
+
+#: module-level random functions (the shared-global-state API);
+#: seeded instances (random.Random(seed), np.random.RandomState(seed),
+#: np.random.default_rng(seed)) are the sanctioned host-side source
+_RANDOM_MODULE_FNS = ("random", "randrange", "randint", "choice",
+                      "choices", "shuffle", "sample", "uniform",
+                      "gauss", "normalvariate", "getrandbits",
+                      "betavariate", "expovariate", "seed")
+_UUID_FNS = ("uuid1", "uuid3", "uuid4", "uuid5")
+_NP_RANDOM_FNS = ("rand", "randn", "randint", "random", "choice",
+                  "shuffle", "permutation", "normal", "uniform",
+                  "seed", "random_sample")
+_SEEDED_CTORS = ("Random", "RandomState", "default_rng", "Generator",
+                 "PCG64")
+
+#: files (relative to the package root) the chaos gates compare
+#: bit-identically — the default scan set
+DEFAULT_FILES = (
+    "services/snapshotter.py",
+    "services/sentinel.py",
+    "services/podmaster.py",
+    "prng.py",
+    "models/generate.py",
+    "loader/base.py",
+    "loader/fullbatch.py",
+    "loader/streaming.py",
+    "loader/image.py",
+)
+
+
+def _dotted(node):
+    """``a.b.c`` -> "a.b.c" (None for anything fancier)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Suppressor(object):
+    """Line -> suppressed-rule lookup (the VT/VW/VC/VK semantics)."""
+
+    def __init__(self, source):
+        lines = source.splitlines()
+        self._by_line = {}
+        for i, line in enumerate(lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            self._by_line.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(lines) and \
+                        lines[j - 1].lstrip().startswith("#"):
+                    j += 1
+                if j <= len(lines):
+                    self._by_line.setdefault(j, set()).update(rules)
+
+    def __call__(self, rule, lineno):
+        return rule in self._by_line.get(lineno, ())
+
+
+class _Module(object):
+
+    def __init__(self, rel, tree, source):
+        self.rel = rel
+        self.tree = tree
+        self.suppressed = _Suppressor(source)
+        self.findings = []
+        self.parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def _emit(self, rule, severity, lineno, message, hint=None):
+        if self.suppressed(rule, lineno):
+            return
+        self.findings.append(Finding(
+            rule, severity, "%s:%d" % (self.rel, lineno), message,
+            hint=hint))
+
+    def _functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield node
+
+    def _in_sorted(self, node):
+        """True when ``node`` sits inside a sorted()/list.sort() wrap
+        (any ancestor call to sorted — covers genexp arguments)."""
+        cur = node
+        while cur is not None:
+            if isinstance(cur, ast.Call) and \
+                    isinstance(cur.func, ast.Name) and \
+                    cur.func.id == "sorted" and cur is not node:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _is_wallclock(node):
+        return isinstance(node, ast.Call) and \
+            (_dotted(node.func) or "") in _WALLCLOCK_CALLS
+
+    # ------------------------------------------------------- VB1100
+    def check_wallclock_payloads(self):
+        hashes = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    (_dotted(node.value.func) or "") \
+                    .startswith("hashlib."):
+                hashes.add(node.targets[0].id)
+        for func in self._functions():
+            if func.name in WRITER_FUNCS:
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Dict):
+                        for k, v in zip(node.keys, node.values):
+                            self._check_wallclock_value(
+                                _const_str(k), v)
+                    elif isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0],
+                                       ast.Subscript):
+                        self._check_wallclock_value(
+                            _const_str(node.targets[0].slice),
+                            node.value)
+        # wall-clock into any digest, writer function or not
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func) or ""
+                is_digest = chain.startswith("hashlib.") or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "update"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in hashes)
+                if is_digest and any(
+                        self._is_wallclock(n) for a in node.args
+                        for n in ast.walk(a)):
+                    self._emit(
+                        "VB1100", ERROR, node.lineno,
+                        "wall-clock value folded into a digest — "
+                        "equal states hash unequal across hosts/runs",
+                        hint="digest only the state; keep timestamps "
+                             "in exempted metadata keys")
+
+    def _check_wallclock_value(self, key, value):
+        if key is None:
+            return
+        if not any(self._is_wallclock(n) for n in ast.walk(value)):
+            return
+        if key in EXEMPT_WALLCLOCK_KEYS:
+            return
+        self._emit(
+            "VB1100", ERROR, value.lineno,
+            "wall-clock value written into serialized contract key "
+            "%r — bit-compared payloads from identical state differ "
+            "per run" % key,
+            hint="move it to an exempted metadata key "
+                 "(EXEMPT_WALLCLOCK_KEYS, with a rationale) or drop "
+                 "it from the payload")
+
+    # ------------------------------------------------------- VB1101
+    def check_fs_enumeration(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func) or ""
+            tail = chain.rsplit(".", 1)[-1]
+            is_enum = chain in _ENUM_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and tail in _ENUM_METHOD_TAILS)
+            if not is_enum:
+                continue
+            if self._in_sorted(node):
+                continue
+            self._emit(
+                "VB1101", ERROR, node.lineno,
+                "unsorted filesystem enumeration (%s) in a module the "
+                "chaos gates compare bit-identically — directory "
+                "order is filesystem-dependent, so selection/"
+                "agreement built on it diverges across hosts" % tail,
+                hint="wrap the call in sorted(...)")
+
+    # ------------------------------------------------------- VB1102
+    def check_set_iteration(self):
+        for func in self._functions():
+            set_vars = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    val = node.value
+                    is_set = isinstance(val, (ast.Set, ast.SetComp)) \
+                        or (isinstance(val, ast.Call)
+                            and (_dotted(val.func) or "")
+                            in ("set", "frozenset"))
+                    if is_set:
+                        set_vars.add(node.targets[0].id)
+                    elif isinstance(val, ast.Call) or \
+                            isinstance(val, (ast.List, ast.ListComp)):
+                        set_vars.discard(node.targets[0].id)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.For):
+                    continue
+                it = node.iter
+                direct_set = isinstance(it, (ast.Set, ast.SetComp)) \
+                    or (isinstance(it, ast.Call)
+                        and (_dotted(it.func) or "")
+                        in ("set", "frozenset")) \
+                    or (isinstance(it, ast.Name)
+                        and it.id in set_vars)
+                if direct_set and not self._in_sorted(it):
+                    self._emit(
+                        "VB1102", ERROR, node.lineno,
+                        "iteration over a set in a bit-compared "
+                        "module — set order varies per process (hash "
+                        "randomization), so anything built from this "
+                        "loop is host-dependent",
+                        hint="iterate sorted(the_set)")
+
+    # ------------------------------------------------------- VB1103
+    def check_host_rng(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func) or ""
+            parts = chain.split(".")
+            msg = None
+            if len(parts) == 2 and parts[0] == "random" and \
+                    parts[1] in _RANDOM_MODULE_FNS:
+                msg = "module-level random.%s() shares global, " \
+                      "per-process RNG state" % parts[1]
+            elif len(parts) == 2 and parts[0] == "uuid" and \
+                    parts[1] in _UUID_FNS:
+                msg = "uuid.%s() is host/clock-derived" % parts[1]
+            elif len(parts) >= 2 and parts[-2:-1] == ["random"] and \
+                    parts[-1] in _NP_RANDOM_FNS and \
+                    parts[0] in ("np", "numpy"):
+                msg = "module-level %s() shares global RNG state" \
+                    % chain
+            elif parts[-1] in _SEEDED_CTORS and not node.args and \
+                    not node.keywords and \
+                    parts[0] in ("random", "np", "numpy"):
+                msg = "unseeded %s() draws OS entropy" % chain
+            if msg is None:
+                continue
+            self._emit(
+                "VB1103", ERROR, node.lineno,
+                "host RNG in a replayed path: %s — replay/splice "
+                "cannot reproduce it" % msg,
+                hint="thread a seeded instance (random.Random(seed), "
+                     "np.random.default_rng(seed)) or jax.random keys")
+
+    # ------------------------------------------------------- VB1104
+    def check_threaded_accumulation(self):
+        for func in self._functions():
+            self._check_threads_in(func)
+
+    def _check_threads_in(self, func):
+        # targets of threads spawned inside a For loop
+        loop_targets = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.For):
+                continue
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) and \
+                        (_dotted(inner.func) or "") \
+                        .rsplit(".", 1)[-1] == "Thread":
+                    for kw in inner.keywords:
+                        if kw.arg == "target" and \
+                                isinstance(kw.value, ast.Name):
+                            loop_targets.add(kw.value.id)
+        if not loop_targets:
+            return
+        # containers the thread targets append into
+        appended = set()
+        for node in ast.walk(func):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name in loop_targets:
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Call) and \
+                            isinstance(inner.func, ast.Attribute) and \
+                            inner.func.attr in ("append", "extend",
+                                                "add") and \
+                            isinstance(inner.func.value, ast.Name):
+                        appended.add(inner.func.value.id)
+        if not appended:
+            return
+        # is the shared container ordered before it escapes?
+        ordered = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func) or ""
+                if chain == "sorted" and node.args and \
+                        isinstance(node.args[0], ast.Name):
+                    ordered.add(node.args[0].id)
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "sort" and \
+                        isinstance(node.func.value, ast.Name):
+                    ordered.add(node.func.value.id)
+        for node in ast.walk(func):
+            sink_var, lineno = None, None
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in appended:
+                sink_var, lineno = node.value.id, node.lineno
+            elif isinstance(node, ast.Call):
+                tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+                if tail in ("dump", "dumps", "update"):
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and \
+                                a.id in appended:
+                            sink_var, lineno = a.id, node.lineno
+            if sink_var is not None and sink_var not in ordered:
+                self._emit(
+                    "VB1104", WARNING, lineno,
+                    "%r accumulates from threads spawned in a loop "
+                    "and escapes into a compared/serialized result "
+                    "without an ordering discipline — its order is "
+                    "the scheduler's" % sink_var,
+                    hint="sort it ('.sort()' / sorted(...)) before "
+                         "serializing, or key results by input index")
+
+
+def _parse(path, root=None):
+    with open(path) as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, [Finding(
+            "VB1101", ERROR, "%s:%d" % (rel, e.lineno or 0),
+            "file failed to parse: %s" % e)]
+    return _Module(rel, tree, source), []
+
+
+def lint_determinism(paths=None, root=None):
+    """VB11xx over a file set — default :data:`DEFAULT_FILES` under
+    the package root (the modules the chaos gates compare
+    bit-identically).  Returns sorted Findings; inline ``# lint-ok:
+    VBxxxx — reason`` comments suppress accepted sites."""
+    if paths is None:
+        here = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        root = root or os.path.dirname(here)
+        paths = [os.path.join(here, f) for f in DEFAULT_FILES]
+    findings = []
+    for p in paths:
+        mod, errs = _parse(p, root=root)
+        findings.extend(errs)
+        if mod is None:
+            continue
+        mod.check_wallclock_payloads()
+        mod.check_fs_enumeration()
+        mod.check_set_iteration()
+        mod.check_host_rng()
+        mod.check_threaded_accumulation()
+        findings.extend(mod.findings)
+    return sort_findings(findings)

@@ -1,0 +1,915 @@
+"""Serialized-state contract audit (VK10xx).
+
+Every durability and agreement guarantee in this tree bottoms out in a
+plain dict that crosses a process/time boundary: the training snapshot
+(``collect`` -> pickle -> ``restore``/``reshard_state``), the commit
+manifest sidecar (``state_manifest``/``commit_meta`` -> json ->
+``scan_commits``/``validate_state_manifest``), the scan-report entries
+the pod master's cross-host agreement ranks, the tuner's
+``winners.json``, the flight recorder's crashdump ``meta.json``, the
+fleet spawn spec, and the serving plane's NDJSON stream lines.  Nothing
+checks those contracts: a key written that no restore path reads is
+dead freight shipped in every checkpoint; a key read that no writer
+sets resumes from a silent default; a strict subscript of an
+optionally-written key KeyErrors on every pre-upgrade checkpoint.
+
+This audit extracts the whole serialized-state universe from source
+(pure AST — nothing is imported, nothing runs) and checks writers and
+readers against each other.  The same extraction renders the
+checked-in catalog ``docs/state_reference.md`` (``veles-tpu-lint
+--state --format markdown``).
+
+**Extraction model.**  Each *contract* (:data:`CONTRACTS`) names its
+writer functions (dict literals that are returned, ``json.dump``-ed,
+or NDJSON wire lines ``json.dumps(d) + "\\n"``; ``d["k"] = ...``
+augmentation and ``dict(d, k=...)`` keywords add optional keys — as
+does any ``d = <writer_func>(...)`` augmentation site anywhere in the
+scanned files) and its reader functions (a named parameter or local
+var: ``d["k"]`` strict reads, ``d.get("k")``, ``"k" in d`` probes;
+*loose* readers contribute coverage only).  A key written under
+``if``/``for``/``try`` is *optional*; strict subscripts of optional
+keys need a probe (``"k" in d``), a prior ``.get``, or a version guard
+(a comparison against the contract's version key) in the same
+function.  Wall-clock provenance keys (:data:`META_KEYS`) and
+contract-declared *external* keys (read by clients/operators outside
+this tree) are exempt from the dead-freight rule, with their rationale
+carried into the reference doc.
+
+Rule catalog (docs/static_analysis.md):
+
+========  =======  ======================================================
+VK1000    warning  key written into a contract payload but read by no
+                   restore/consumer path in the scanned tree — dead
+                   freight that still costs wire/checkpoint bytes
+VK1001    error    restore/consumer path reads a key no writer of that
+                   contract ever sets — the silent-default resume-drift
+                   class (``.get`` returns None forever)
+VK1002    error    strict subscript of an optionally-written key with
+                   no ``.get`` default, membership probe, or version
+                   guard — KeyError on every old checkpoint (legacy-
+                   compat break)
+VK1003    error    non-canonical serialization feeding a digest or
+                   compared artifact: ``json.dumps`` without
+                   ``sort_keys=True`` flowing into ``hashlib``, or
+                   dict-order iteration into a digest update
+VK1004    error    pickled contract payload carries an unpicklable or
+                   environment-bound value (lock/socket/thread/file
+                   handles, lambdas) — the export dies, or worse,
+                   resumes against a dead resource
+========  =======  ======================================================
+
+**Suppression**: ``# lint-ok: VK1002 — reason`` on the flagged line or
+the contiguous comment block above it, exactly as for VT/VW/VC; a bare
+``# lint-ok:`` suppresses nothing.
+"""
+
+import ast
+import os
+import re
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VK10xx family, in catalog order
+RULES = ("VK1000", "VK1001", "VK1002", "VK1003", "VK1004")
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3,4}(?:\s*,\s*"
+                          r"[A-Z]{2}\d{3,4})*)")
+
+#: wall-clock / provenance metadata keys every contract may carry:
+#: written for operators and post-mortems, read by no restore path —
+#: exempt from VK1000, with the rationale rendered into
+#: docs/state_reference.md.
+META_KEYS = {
+    "created": "commit wall-time provenance for operators; never read "
+               "back by any restore path",
+    "mtime": "host-local commit mtime used only for same-host ordering "
+             "(SPMD-lockstep ties are broken by name)",
+    "ts": "crash wall-time provenance for the post-mortem timeline",
+    "hostname": "which host wrote the commit — operator forensics",
+    "pid": "writer pid — operator forensics",
+}
+
+#: per-contract discriminator keys (the wire dispatch tag — VW9xx's
+#: domain, not dead freight)
+_TAG_KEYS = ("type",)
+
+_WALLCLOCK = ("time.time", "time.time_ns", "time.monotonic",
+              "datetime.now", "datetime.utcnow",
+              "datetime.datetime.now", "datetime.datetime.utcnow")
+
+#: value shapes that must never ride a pickled contract payload
+_UNPICKLABLE_NAME_RE = re.compile(
+    r"(?:^|_)(lock|mutex|cond(?:ition)?|sock(?:et)?|conn(?:ection)?|"
+    r"thread|pool|executor|server|queue|fh|file_?handle)s?$",
+    re.IGNORECASE)
+_UNPICKLABLE_CTORS = (
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.Thread",
+    "socket.socket", "open")
+
+#: serialized-state contracts: writer/reader function names are matched
+#: by NAME across the scanned file set (cross-module contracts — e.g.
+#: crashdump meta is written by telemetry/flight.py and read by
+#: telemetry/blackbox.py).  Spec forms:
+#:   writer {"func", "kind": "return"|"var"|"dump"|"wire"[, "var"]}
+#:   reader {"func", "param": name} | {"func", "var": name}
+#:          | {"func", "loose": True}   (coverage only — no VK1001/1002)
+#: ``external`` maps client/operator-consumed keys (no in-tree reader)
+#: to their rationale; ``version_key`` names the format-version tag
+#: whose comparison counts as a guard.
+CONTRACTS = (
+    {"name": "snapshot.state",
+     "doc": "pickled training state: collect() -> restore()/"
+            "warm_start()/reshard_state()",
+     "pickled": True,
+     "version_key": None,
+     "writers": ({"func": "collect", "kind": "return"},),
+     "readers": ({"func": "restore", "param": "snapshot"},
+                 {"func": "warm_start", "param": "snapshot"},
+                 {"func": "reshard_state", "param": "state"},
+                 {"func": "commit_meta", "param": "state"},
+                 {"func": "validate_state_manifest", "param": "state"}),
+     "external": {}},
+    {"name": "commit.manifest",
+     "doc": "json manifest sidecar: state_manifest()/commit_meta() -> "
+            "scan_commits()/validate_state_manifest()/import paths",
+     "pickled": False,
+     "version_key": "format",
+     "writers": ({"func": "state_manifest", "kind": "return"},
+                 {"func": "commit_meta", "kind": "return"}),
+     "readers": ({"func": "scan_commits", "var": "manifest"},
+                 {"func": "validate_state_manifest",
+                  "param": "manifest"},
+                 {"func": "_import_file", "var": "manifest"},
+                 {"func": "import_dir", "var": "manifest"},
+                 {"func": "_flight_commit", "var": "meta"}),
+     "external": {}},
+    {"name": "commit.scan",
+     "doc": "scan_commits() report entries ranked by cross-host "
+            "agreement and rollback",
+     "pickled": False,
+     "version_key": None,
+     "writers": ({"func": "scan_commits", "kind": "var",
+                  "var": "entry"},),
+     "readers": ({"func": "rollback_to_commit", "var": "entry"},
+                 {"func": "agree_commits", "loose": True},
+                 {"func": "_commit_order_key", "loose": True},
+                 {"func": "_newest_healthy", "loose": True},
+                 {"func": "_rollback_replay", "loose": True}),
+     "external": {
+         "incarnation": "which fenced incarnation committed — rendered "
+                        "by the pod-master status surface",
+         "process_index": "writer process — status surface / operators",
+         "topology": "mesh shape of the committing run — the degraded-"
+                     "resume accounting on the status surface",
+         "error": "why a commit failed validation — operator "
+                  "diagnostics in the status surface"}},
+    {"name": "tuner.winners",
+     "doc": "winners.json: Cache._save_locked() -> Cache._read_file()",
+     "pickled": False,
+     "version_key": "version",
+     "writers": ({"func": "_save_locked", "kind": "dump"},),
+     "readers": ({"func": "_read_file", "var": "data"},),
+     "external": {}},
+    {"name": "crashdump.meta",
+     "doc": "crashdump meta.json: flight._meta_state() -> blackbox/"
+            "supervisor post-mortem readers",
+     "pickled": False,
+     "version_key": None,
+     "writers": ({"func": "_meta_state", "kind": "return"},),
+     "readers": ({"func": "render_text", "var": "meta"},
+                 {"func": "merge_timeline", "loose": True},
+                 {"func": "_crashdump_error", "loose": True}),
+     "external": {}},
+    {"name": "fleet.spec",
+     "doc": "worker spawn spec: PodMaster.worker_spec() -> agent "
+            "_handle_spawn()/_wait_worker()/_heartbeat_loop()",
+     "pickled": False,
+     "version_key": None,
+     "writers": ({"func": "worker_spec", "kind": "return"},),
+     "readers": ({"func": "_handle_spawn", "param": "msg"},
+                 {"func": "_wait_worker", "param": "spec"},
+                 {"func": "_heartbeat_loop", "var": "spec"}),
+     "external": {}},
+    {"name": "serve.ndjson",
+     "doc": "NDJSON stream lines: replica _do_work_post() -> router "
+            "_pump_stream() -> client",
+     "pickled": False,
+     "version_key": None,
+     "writers": ({"func": "_do_work_post", "kind": "wire"},
+                 {"func": "_route_stream", "kind": "wire"},
+                 {"func": "_pump_stream", "kind": "wire"}),
+     "readers": ({"func": "_pump_stream", "var": "msg"},),
+     "external": {
+         "trace": "the client's cross-process reconstruction key "
+                  "(veles-tpu-blackbox --trace)",
+         "resumed": "client-visible failover-splice tag",
+         "retry_after_s": "client backoff hint on the terminal error "
+                          "line",
+         "dropped_chunks": "client-visible drop-oldest overflow count "
+                           "(the done line's result is authoritative)"}},
+)
+
+#: files (relative to the package root) that form the default
+#: serialized-state universe
+DEFAULT_FILES = (
+    "services/snapshotter.py",
+    "services/sentinel.py",
+    "services/podmaster.py",
+    "services/restful.py",
+    "services/router.py",
+    "services/supervisor.py",
+    "tuner/cache.py",
+    "telemetry/flight.py",
+    "telemetry/blackbox.py",
+)
+
+
+def _dotted(node):
+    """``a.b.c`` -> "a.b.c" (None for anything fancier)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Key(object):
+    """One written contract key: where, and on which paths."""
+
+    __slots__ = ("name", "rel", "lineno", "optional", "writer")
+
+    def __init__(self, name, rel, lineno, optional, writer):
+        self.name = name
+        self.rel = rel
+        self.lineno = lineno
+        self.optional = optional
+        self.writer = writer
+
+
+class _Read(object):
+    """One reader access: strict subscript, .get, or membership probe."""
+
+    __slots__ = ("name", "rel", "lineno", "kind", "has_default",
+                 "reader", "loose")
+
+    def __init__(self, name, rel, lineno, kind, has_default, reader,
+                 loose=False):
+        self.name = name
+        self.rel = rel
+        self.lineno = lineno
+        self.kind = kind              # "subscript" | "get" | "probe"
+        self.has_default = has_default
+        self.reader = reader
+        self.loose = loose
+
+
+class _Suppressor(object):
+    """Line -> suppressed-rule lookup: a tag suppresses findings on its
+    own line and on the first code line below a contiguous comment
+    block (the VT/VW/VC semantics; a bare ``# lint-ok:`` is inert)."""
+
+    def __init__(self, source):
+        lines = source.splitlines()
+        self._by_line = {}
+        for i, line in enumerate(lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            self._by_line.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(lines) and \
+                        lines[j - 1].lstrip().startswith("#"):
+                    j += 1
+                if j <= len(lines):
+                    self._by_line.setdefault(j, set()).update(rules)
+
+    def __call__(self, rule, lineno):
+        return rule in self._by_line.get(lineno, ())
+
+
+def _conditional_depth(func, target):
+    """True when ``target`` executes only on some paths through
+    ``func`` (nested under If/For/While/Try/With-in-If...)."""
+    conditional = {}
+
+    def walk(node, cond):
+        for child in ast.iter_child_nodes(node):
+            c = cond or isinstance(
+                node, (ast.If, ast.For, ast.While, ast.Try,
+                       ast.ExceptHandler))
+            conditional[child] = c
+            walk(child, c)
+
+    walk(func, False)
+    return conditional.get(target, False)
+
+
+class _Module(object):
+    """One parsed file: extraction + per-module rule checks."""
+
+    def __init__(self, rel, tree, source):
+        self.rel = rel
+        self.tree = tree
+        self.source = source
+        self.suppressed = _Suppressor(source)
+        self.findings = []
+        #: every FunctionDef/AsyncFunctionDef in the file, by name
+        #: (methods of any class included — names may repeat)
+        self.functions = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+
+    def _emit(self, rule, severity, lineno, message, hint=None):
+        if self.suppressed(rule, lineno):
+            return
+        self.findings.append(Finding(
+            rule, severity, "%s:%d" % (self.rel, lineno), message,
+            hint=hint))
+
+    # ------------------------------------------------------ writers
+    def writer_keys(self, spec):
+        """Extract the keys a writer function contributes, as _Key
+        records (empty when the function is absent from this file)."""
+        out = []
+        for func in self.functions.get(spec["func"], ()):
+            out.extend(self._keys_in(func, spec))
+        return out
+
+    def _keys_in(self, func, spec):
+        kind = spec["kind"]
+        dict_vars = {}        # name -> {key: (lineno, optional)}
+        marked = set()        # vars that ARE the contract payload
+        direct = []           # (keys, lineno) from anonymous literals
+
+        def literal_keys(d):
+            # literal keys are REQUIRED wherever the dict exists —
+            # presence is judged relative to the dict's creation, not
+            # the function entry (a literal built inside a loop still
+            # always carries its keys); only augmentation
+            # (``d["k"] = ...``, ``dict(d, k=...)``) is conditional
+            keys = {}
+            for k in d.keys:
+                name = _const_str(k)
+                if name is not None:
+                    keys[name] = (k.lineno, False)
+            return keys
+
+        for node in ast.walk(func):
+            optional = _conditional_depth(func, node)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if isinstance(node.value, ast.Dict):
+                        dict_vars.setdefault(tgt.id, {}).update(
+                            literal_keys(node.value))
+                        if kind == "var" and tgt.id == spec.get("var"):
+                            marked.add(tgt.id)
+                    elif isinstance(node.value, ast.Call) and \
+                            _dotted(node.value.func) == "dict":
+                        ks = {kw.arg: (node.lineno, True)
+                              for kw in node.value.keywords
+                              if kw.arg}
+                        dict_vars.setdefault(tgt.id, {}).update(ks)
+                        if kind == "var" and tgt.id == spec.get("var"):
+                            marked.add(tgt.id)
+                elif isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name):
+                    key = _const_str(tgt.slice)
+                    if key is not None:
+                        dict_vars.setdefault(tgt.value.id, {}) \
+                            .setdefault(key, (node.lineno, optional))
+            elif isinstance(node, ast.Return) and kind == "return":
+                if isinstance(node.value, ast.Name):
+                    marked.add(node.value.id)
+                elif isinstance(node.value, ast.Dict):
+                    direct.append(literal_keys(node.value))
+            elif isinstance(node, ast.Call):
+                tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+                if kind == "dump" and tail in ("dump", "dumps") \
+                        and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        marked.add(arg.id)
+                    elif isinstance(arg, ast.Dict):
+                        direct.append(literal_keys(arg))
+                elif kind == "wire" and tail == "dumps":
+                    if self._is_wire_line(node):
+                        arg = node.args[0] if node.args else None
+                        if isinstance(arg, ast.Name):
+                            marked.add(arg.id)
+                        elif isinstance(arg, ast.Dict):
+                            direct.append(literal_keys(arg))
+        keys = []
+        for var in marked:
+            for name, (lineno, optional) in \
+                    dict_vars.get(var, {}).items():
+                keys.append(_Key(name, self.rel, lineno, optional,
+                                 spec["func"]))
+        for lk in direct:
+            for name, (lineno, optional) in lk.items():
+                keys.append(_Key(name, self.rel, lineno, optional,
+                                 spec["func"]))
+        return keys
+
+    def _is_wire_line(self, dumps_call):
+        """True when this json.dumps call feeds an NDJSON line: it sits
+        (possibly under ``.encode()``) in a BinOp with a newline
+        constant."""
+        parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        node = dumps_call
+        for _ in range(4):
+            node = parents.get(node)
+            if node is None:
+                return False
+            if isinstance(node, ast.BinOp):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Constant) and \
+                            side.value in ("\n", b"\n"):
+                        return True
+        return False
+
+    def augmented_keys(self, writer_funcs):
+        """``v = <writer_func>(...)`` anywhere, then ``v["k"] = ...``
+        in the same function -> optional contract keys (the
+        ``manifest["file_sha256"]`` / ``man["arrays"]`` idiom)."""
+        keys = []
+        for funcs in self.functions.values():
+            for func in funcs:
+                aliased = set()
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name) and \
+                            isinstance(node.value, ast.Call):
+                        callee = (_dotted(node.value.func) or "") \
+                            .rsplit(".", 1)[-1]
+                        if callee in writer_funcs:
+                            aliased.add(node.targets[0].id)
+                if not aliased:
+                    continue
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Subscript):
+                        tgt = node.targets[0]
+                        if isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id in aliased:
+                            key = _const_str(tgt.slice)
+                            if key is not None:
+                                keys.append(_Key(
+                                    key, self.rel, node.lineno, True,
+                                    func.name))
+        return keys
+
+    # ------------------------------------------------------ readers
+    def reader_accesses(self, spec):
+        """All contract-key accesses a reader function performs, plus
+        the keys it WRITES into the payload (reader-side augmentation
+        like ``msg["resumed"] = True`` and ``dict(msg, k=...)``)."""
+        reads, aug = [], []
+        for func in self.functions.get(spec["func"], ()):
+            r, a = self._accesses_in(func, spec)
+            reads.extend(r)
+            aug.extend(a)
+        return reads, aug
+
+    def _accesses_in(self, func, spec):
+        loose = spec.get("loose", False)
+        targets = set()
+        if "param" in spec:
+            targets.add(spec["param"])
+        if "var" in spec:
+            targets.add(spec["var"])
+
+        def is_target(node):
+            if loose:
+                return True
+            return isinstance(node, ast.Name) and node.id in targets
+
+        reads, aug = [], []
+        for node in ast.walk(func):
+            if isinstance(node, ast.Subscript) and \
+                    is_target(node.value):
+                key = _const_str(node.slice)
+                if key is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    if not loose:
+                        aug.append(_Key(key, self.rel, node.lineno,
+                                        True, spec["func"]))
+                else:
+                    reads.append(_Read(
+                        key, self.rel, node.lineno, "subscript",
+                        False, spec["func"], loose))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    is_target(node.func.value):
+                key = _const_str(node.args[0])
+                if key is not None:
+                    reads.append(_Read(
+                        key, self.rel, node.lineno, "get",
+                        len(node.args) > 1, spec["func"], loose))
+            elif isinstance(node, ast.Compare) and \
+                    len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    is_target(node.comparators[0]):
+                key = _const_str(node.left)
+                if key is not None:
+                    reads.append(_Read(
+                        key, self.rel, node.lineno, "probe",
+                        False, spec["func"], loose))
+            elif isinstance(node, ast.Call) and \
+                    _dotted(node.func) == "dict" and node.args and \
+                    is_target(node.args[0]) and not loose:
+                for kw in node.keywords:
+                    if kw.arg:
+                        aug.append(_Key(kw.arg, self.rel, node.lineno,
+                                        True, spec["func"]))
+        return reads, aug
+
+    def version_guarded(self, spec, version_key):
+        """True when the reader function compares the contract's
+        version key — every strict subscript in it is then guarded by
+        the format check."""
+        if version_key is None:
+            return False
+        for func in self.functions.get(spec["func"], ()):
+            for node in ast.walk(func):
+                if isinstance(node, ast.Compare):
+                    for side in [node.left] + node.comparators:
+                        if isinstance(side, ast.Subscript) and \
+                                _const_str(side.slice) == version_key:
+                            return True
+                        if isinstance(side, ast.Call) and \
+                                isinstance(side.func, ast.Attribute) \
+                                and side.func.attr == "get" and \
+                                side.args and \
+                                _const_str(side.args[0]) == version_key:
+                            return True
+        return False
+
+    # ---------------------------------------------- VK1003 / VK1004
+    def check_canonical_digests(self):
+        """VK1003: json.dumps without sort_keys feeding hashlib, and
+        dict-order iteration into a digest update."""
+        for funcs in self.functions.values():
+            for func in funcs:
+                self._check_digests_in(func)
+
+    @staticmethod
+    def _noncanonical_dumps(node):
+        return (isinstance(node, ast.Call)
+                and (_dotted(node.func) or "")
+                .rsplit(".", 1)[-1] in ("dumps", "dump")
+                and "json" in (_dotted(node.func) or "")
+                and not any(kw.arg == "sort_keys"
+                            for kw in node.keywords))
+
+    def _check_digests_in(self, func):
+        tainted = set()     # vars holding non-canonical json text
+        hashes = set()      # vars holding hashlib objects
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                name, val = node.targets[0].id, node.value
+                if any(self._noncanonical_dumps(n)
+                       for n in ast.walk(val)):
+                    tainted.add(name)
+                elif isinstance(val, ast.Call) and \
+                        (_dotted(val.func) or "") \
+                        .startswith("hashlib."):
+                    hashes.add(name)
+                elif isinstance(val, ast.Call) and \
+                        isinstance(val.func, ast.Attribute) and \
+                        val.func.attr == "encode" and \
+                        isinstance(val.func.value, ast.Name) and \
+                        val.func.value.id in tainted:
+                    tainted.add(name)
+
+        def arg_tainted(arg):
+            for n in ast.walk(arg):
+                if self._noncanonical_dumps(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+            return False
+
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func) or ""
+            is_digest_ctor = chain.startswith("hashlib.") or \
+                chain == "hmac.new"
+            is_update = isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "update" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in hashes
+            if (is_digest_ctor or is_update) and \
+                    any(arg_tainted(a) for a in node.args):
+                self._emit(
+                    "VK1003", ERROR, node.lineno,
+                    "non-canonical json.dumps feeds this digest — "
+                    "dict insertion order varies across writers, so "
+                    "equal states hash unequal",
+                    hint="json.dumps(..., sort_keys=True) (canonical "
+                         "form) before hashing")
+        # dict-order iteration into a digest update
+        for node in ast.walk(func):
+            if not isinstance(node, ast.For):
+                continue
+            it = node.iter
+            unordered = isinstance(it, (ast.Name, ast.Attribute)) or (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Attribute)
+                and it.func.attr in ("items", "keys", "values"))
+            if not unordered:
+                continue
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) and \
+                        isinstance(inner.func, ast.Attribute) and \
+                        inner.func.attr == "update" and \
+                        isinstance(inner.func.value, ast.Name) and \
+                        inner.func.value.id in hashes:
+                    self._emit(
+                        "VK1003", ERROR, inner.lineno,
+                        "digest updated inside an insertion-order "
+                        "dict iteration — equal states hash unequal "
+                        "when written in a different order",
+                        hint="iterate sorted(...) into the digest")
+                    break
+
+    def check_pickled_values(self, spec):
+        """VK1004 over one pickled contract's writer functions."""
+        for func in self.functions.get(spec["func"], ()):
+            for node in ast.walk(func):
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        key = _const_str(k)
+                        if key is not None:
+                            self._check_pickle_value(key, v)
+                elif isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Subscript):
+                    key = _const_str(node.targets[0].slice)
+                    if key is not None:
+                        self._check_pickle_value(key, node.value)
+
+    def _check_pickle_value(self, key, value):
+        bad = None
+        if isinstance(value, ast.Lambda):
+            bad = "a lambda (unpicklable closure)"
+        elif isinstance(value, ast.Call):
+            chain = _dotted(value.func) or ""
+            if chain in _UNPICKLABLE_CTORS:
+                bad = "a %s() instance" % chain
+        elif isinstance(value, (ast.Name, ast.Attribute)):
+            tail = value.id if isinstance(value, ast.Name) \
+                else value.attr
+            if _UNPICKLABLE_NAME_RE.search(tail):
+                bad = "%r (an environment-bound handle by name)" % tail
+        if bad is not None:
+            self._emit(
+                "VK1004", ERROR, value.lineno,
+                "pickled state key %r carries %s — the export dies "
+                "serializing it, or the restore resumes against a "
+                "dead resource" % (key, bad),
+                hint="keep runtime handles out of the payload; "
+                     "reconstruct them in restore()")
+
+
+def _parse(path, root=None):
+    with open(path) as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, [Finding(
+            "VK1001", ERROR, "%s:%d" % (rel, e.lineno or 0),
+            "file failed to parse: %s" % e)]
+    return _Module(rel, tree, source), []
+
+
+def _default_paths():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(here)
+    return [os.path.join(here, f) for f in DEFAULT_FILES], root
+
+
+class _ContractView(object):
+    """One contract's extracted universe across the scanned modules."""
+
+    def __init__(self, contract, modules):
+        self.contract = contract
+        self.keys = []          # _Key writers
+        self.reads = []         # _Read accesses
+        self.guard_keys = {}    # reader func -> probed/gotten keys
+        self.version_guards = set()   # reader funcs with a format check
+        self.has_reader = False
+        self.has_writer = False
+        writer_funcs = {w["func"] for w in contract["writers"]}
+        for m in modules:
+            for w in contract["writers"]:
+                ks = m.writer_keys(w)
+                if ks or m.functions.get(w["func"]):
+                    self.has_writer = self.has_writer or \
+                        bool(m.functions.get(w["func"]))
+                self.keys.extend(ks)
+            self.keys.extend(m.augmented_keys(writer_funcs))
+            for r in contract["readers"]:
+                if m.functions.get(r["func"]):
+                    self.has_reader = True
+                reads, aug = m.reader_accesses(r)
+                self.reads.extend(reads)
+                self.keys.extend(aug)
+                if m.version_guarded(r, contract["version_key"]):
+                    self.version_guards.add(r["func"])
+        for read in self.reads:
+            if read.kind in ("get", "probe"):
+                self.guard_keys.setdefault(read.reader, set()) \
+                    .add(read.name)
+
+    @property
+    def written(self):
+        """key -> _Key (first writer site; optional iff EVERY site is
+        optional — a key any writer always sets is required)."""
+        out = {}
+        for k in self.keys:
+            prev = out.get(k.name)
+            if prev is None:
+                out[k.name] = k
+            elif prev.optional and not k.optional:
+                out[k.name] = k
+        return out
+
+    @property
+    def read_names(self):
+        return {r.name for r in self.reads}
+
+
+def lint_state(paths=None, root=None):
+    """VK10xx over a file set — default :data:`DEFAULT_FILES` under the
+    package root.  The scanned files form ONE serialized-state
+    universe: a contract written in one module and read in another is
+    matched across them.  Returns sorted Findings; inline ``# lint-ok:
+    VKxxxx — reason`` comments suppress accepted sites."""
+    if paths is None:
+        paths, droot = _default_paths()
+        root = root or droot
+    findings, modules = [], []
+    for p in paths:
+        mod, errs = _parse(p, root=root)
+        findings.extend(errs)
+        if mod is not None:
+            modules.append(mod)
+
+    for contract in CONTRACTS:
+        view = _ContractView(contract, modules)
+        written = view.written
+        read_names = view.read_names
+        exempt = set(META_KEYS) | set(contract["external"]) \
+            | set(_TAG_KEYS)
+        if contract["version_key"]:
+            exempt.add(contract["version_key"])
+        by_rel = {m.rel: m for m in modules}
+        # VK1000: dead freight (only when the universe includes at
+        # least one reader — a partial view cannot judge deadness)
+        if view.has_reader:
+            for name in sorted(written):
+                if name in read_names or name in exempt:
+                    continue
+                k = written[name]
+                by_rel[k.rel]._emit(
+                    "VK1000", WARNING, k.lineno,
+                    "contract %s: key %r is written here but no "
+                    "restore/consumer path in the scanned tree reads "
+                    "it — dead freight in every %s payload"
+                    % (contract["name"], name,
+                       "pickle" if contract["pickled"] else "wire/"
+                       "json"),
+                    hint="drop the key, add the missing reader, or "
+                         "declare it in the contract's external/"
+                         "META_KEYS exemptions with a rationale")
+        # VK1001 / VK1002: reader-side checks need at least one writer
+        if view.has_writer:
+            for read in view.reads:
+                if read.loose:
+                    continue
+                mod = by_rel[read.rel]
+                if read.name not in written:
+                    mod._emit(
+                        "VK1001", ERROR, read.lineno,
+                        "contract %s: %r is read here but no writer "
+                        "of the contract ever sets it — this path "
+                        "resumes from a silent default forever"
+                        % (contract["name"], read.name),
+                        hint="set the key at every writer, or delete "
+                             "the stale read")
+                    continue
+                key = written[read.name]
+                if read.kind == "subscript" and key.optional and \
+                        read.name not in view.guard_keys.get(
+                            read.reader, ()) and \
+                        read.reader not in view.version_guards:
+                    mod._emit(
+                        "VK1002", ERROR, read.lineno,
+                        "contract %s: strict subscript of optionally-"
+                        "written key %r with no .get default, "
+                        "membership probe, or version guard — "
+                        "KeyError on every payload from before the "
+                        "key existed" % (contract["name"], read.name),
+                        hint="use .get(%r, default), probe with "
+                             "'%s in ...', or gate on the contract's "
+                             "version key" % (read.name, read.name))
+        # VK1004 over pickled contracts' writer payloads
+        if contract["pickled"]:
+            for m in modules:
+                for w in contract["writers"]:
+                    m.check_pickled_values(w)
+
+    for m in modules:
+        m.check_canonical_digests()
+        findings.extend(m.findings)
+    return sort_findings(findings)
+
+
+def build_reference(root=None):
+    """Render ``docs/state_reference.md``: every serialized contract
+    key with its writers, readers, presence, and version notes —
+    byte-deterministic (the CI freshness diff depends on it)."""
+    paths, droot = _default_paths()
+    modules = []
+    for p in paths:
+        mod, _ = _parse(p, root=root or droot)
+        if mod is not None:
+            modules.append(mod)
+    out = [
+        "# Serialized-state contract reference",
+        "",
+        "Generated by `veles-tpu-lint --state --format markdown` "
+        "(analysis/state_audit.py) — do not edit by hand; CI diffs "
+        "this file against a fresh render.  Every key that crosses a "
+        "process or time boundary: who writes it, who reads it back, "
+        "and why the unread ones are not dead freight.  The VK10xx "
+        "rule catalog lives in docs/static_analysis.md.",
+        "",
+    ]
+    for contract in CONTRACTS:
+        view = _ContractView(contract, modules)
+        written = view.written
+        readers_by_key = {}
+        for r in view.reads:
+            readers_by_key.setdefault(r.name, set()).add(
+                "%s:%s" % (os.path.basename(r.rel), r.reader))
+        out.append("## %s" % contract["name"])
+        out.append("")
+        out.append("%s.  Serialization: %s." % (
+            contract["doc"],
+            "pickle" if contract["pickled"] else "json"))
+        if contract["version_key"]:
+            out.append("Version key: `%s` — readers comparing it are "
+                       "version-guarded (VK1002)."
+                       % contract["version_key"])
+        out.append("")
+        out.append("| key | presence | writers | readers | notes |")
+        out.append("|---|---|---|---|---|")
+        for name in sorted(written):
+            k = written[name]
+            readers = sorted(readers_by_key.get(name, ()))
+            notes = ""
+            if name in contract["external"]:
+                notes = "external: %s" % contract["external"][name]
+            elif name in META_KEYS:
+                notes = "metadata: %s" % META_KEYS[name]
+            elif name == contract["version_key"]:
+                notes = "format-version tag"
+            elif name in _TAG_KEYS:
+                notes = "wire dispatch tag (VW9xx's domain)"
+            out.append("| `%s` | %s | %s | %s | %s |" % (
+                name,
+                "optional" if k.optional else "required",
+                "%s:%s" % (os.path.basename(k.rel), k.writer),
+                ", ".join(readers) if readers else "—",
+                notes))
+        out.append("")
+    return "\n".join(out) + "\n"

@@ -50,7 +50,9 @@ def scan_files(patterns, extensions=(".png", ".jpg", ".jpeg", ".bmp",
     for pat in ([patterns] if isinstance(patterns, str) else patterns):
         if os.path.isdir(pat):
             pat = os.path.join(pat, "**", "*")
-        for f in glob.glob(pat, recursive=True):
+        # sorted: glob order is filesystem-dependent, and sample
+        # order must be bit-identical across hosts/runs (VB1101)
+        for f in sorted(glob.glob(pat, recursive=True)):
             if os.path.isfile(f) and f.lower().endswith(extensions):
                 files.append(f)
     return sorted(files)

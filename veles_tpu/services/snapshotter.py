@@ -353,7 +353,7 @@ def scan_commits(directory, prefix):
     unverifiable (excluded) rather than trusted."""
     out = {}
     try:
-        names = os.listdir(directory)
+        names = sorted(os.listdir(directory))
     except OSError:
         return out
     for name in names:
@@ -431,7 +431,7 @@ def agree_commits(reports):
         names.update(rep)
     detail = {}
     candidates = []
-    for name in names:
+    for name in sorted(names):
         entries = [reports[h][name] for h in hosts if name in reports[h]]
         on = [h for h in hosts if name in reports[h]]
         valid_on = [h for h in hosts
@@ -812,7 +812,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         no _current, manifests, quarantined .corrupt or .tmp leftovers),
         newest first by mtime."""
         try:
-            names = os.listdir(self.directory)
+            names = sorted(os.listdir(self.directory))
         except OSError:
             return []
         out = []

@@ -213,7 +213,11 @@ class FlightRecorder(object):
     @staticmethod
     def _meta_state(reason, error, proc):
         meta = {"reason": reason, "ts": time.time(), "pid": os.getpid(),
-                "process_index": proc, "argv": list(sys.argv)}
+                "process_index": proc,
+                # lint-ok: VK1000 — forensic payload: the exact command
+                # line is what operators reproduce a crash with; it is
+                # rendered raw from meta.json, never read back by code
+                "argv": list(sys.argv)}
         if error is not None:
             meta["error"] = {"type": type(error).__name__,
                              "message": str(error)}
@@ -222,8 +226,12 @@ class FlightRecorder(object):
             # never wake a backend from a dump: topology and the
             # live-array census only when jax already initialized one
             try:
+                # lint-ok: VK1000 — forensic payload: pod size at the
+                # moment of death, rendered raw by operators
                 meta["process_count"] = jax.process_count()
                 devs = jax.devices()
+                # lint-ok: VK1000 — forensic payload: accelerator
+                # census at the moment of death, rendered raw
                 meta["devices"] = {
                     "count": len(devs),
                     "platform": devs[0].platform if devs else None}
