@@ -332,6 +332,41 @@ def tally_gate(tally, clients, fails, allowed=("ok", "shed")):
 
 
 # ===================================================================
+# performance-ledger banking
+# ===================================================================
+
+def bank_gates(source, values, workload="-", **extra):
+    """Bank a harness's gate numbers into the persistent performance
+    ledger (``veles_tpu.telemetry.ledger``): every storm that reached
+    a gate verdict leaves its measured numbers in history, so the
+    regression sentinel bands them run-over-run instead of each run
+    judging itself in isolation.  ``values`` maps metric name to
+    either a bare number or ``(value, unit, better)``.  Fail-soft by
+    contract — ledger I/O must never fail a chaos run.  Returns the
+    number of rows banked."""
+    n = 0
+    try:
+        from veles_tpu.telemetry import ledger
+        for metric, spec in sorted(values.items()):
+            unit, better, value = "", None, spec
+            if isinstance(spec, (tuple, list)):
+                value = spec[0] if spec else None
+                unit = spec[1] if len(spec) > 1 else ""
+                better = spec[2] if len(spec) > 2 else None
+            if isinstance(value, bool) \
+                    or not isinstance(value, (int, float)):
+                continue
+            if ledger.record_value(metric, float(value),
+                                   workload=workload, unit=unit,
+                                   better=better, source=source,
+                                   **extra) is not None:
+                n += 1
+    except Exception:  # noqa: BLE001 — fail-soft by contract
+        pass
+    return n
+
+
+# ===================================================================
 # checkpoint-ring primitives (train_chaos / pod_chaos)
 # ===================================================================
 

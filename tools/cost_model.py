@@ -171,6 +171,24 @@ def device_constants():
             "t_dispatch": T_DISPATCH}
 
 
+def anatomy_floors(steps_per_dispatch=1, kernels=8):
+    """Per-component predicted floors (ms) of one staged step — the
+    pricing side of the step-anatomy attribution
+    (``veles_tpu.telemetry.anatomy``): each measured component of a
+    regressed step is judged against ITS floor here, so ledger drift
+    is attributed to a component instead of "step got slower".
+    ``compile``/``collective`` floor at 0 (steady-state single host
+    pays neither); ``compute`` here is only the kernel-launch floor —
+    workload compute rides on top and is priced per-phase by the
+    ``predict_*`` family."""
+    spd = max(int(steps_per_dispatch), 1)
+    return {"compile_ms": 0.0,
+            "host_ms": H_STEP * 1e3,
+            "dispatch_ms": T_DISPATCH / spd * 1e3,
+            "collective_ms": 0.0,
+            "compute_ms": kernels * T_KERNEL * 1e3}
+
+
 def _pad(x, m=128):
     return int(math.ceil(x / m)) * m
 

@@ -788,6 +788,17 @@ def main(argv=None):
     fails = gates(report,
                   health_interval_ms=args.health_interval_ms)
     report["gates_failed"] = fails
+    # bank the gate numbers into the performance ledger — detection
+    # and replacement latencies band run-over-run (fail-soft)
+    cc.bank_gates(
+        "fleet_chaos",
+        {"fleet_failover_detect_s": (report.get("failover_detect_s"),
+                                     "s", "lower"),
+         "fleet_replace_detect_s": (report.get("replace_detect_s"),
+                                    "s", "lower"),
+         "fleet_replacement_ready_s": (
+             report.get("replacement_ready_s"), "s", "lower")},
+        workload="autoscale-storm", gate_failures=len(fails))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(report, f, indent=2, default=str)

@@ -1163,6 +1163,19 @@ def main(argv=None):
             seed=args.seed)
         fails = mixed_gates(report)
         report["failures"] = fails
+        # bank the gate numbers: the sentinel bands them run-over-run
+        seg_p99 = (report["segmented"] or {}).get(
+            "p99_decode_stall_ms")
+        unseg_p99 = (report["unsegmented"] or {}).get(
+            "p99_decode_stall_ms")
+        cc.bank_gates(
+            "serve_loadtest.mixed",
+            {"serve_p99_stall_seg_ms": (seg_p99, "ms", "lower"),
+             "serve_p99_stall_unseg_ms": (unseg_p99, "ms", "lower"),
+             "serve_stall_seg_vs_unseg_x": (
+                 round(seg_p99 / unseg_p99, 3)
+                 if seg_p99 and unseg_p99 else None, "x", "lower")},
+            workload="mixed-storm", gate_failures=len(fails))
         out = json.dumps(report, indent=2, default=str)
         if args.json:
             with open(args.json, "w") as f:
@@ -1193,6 +1206,14 @@ def main(argv=None):
         fails = fleet_gates(report,
                             health_interval_ms=args.health_interval_ms)
         report["failures"] = fails
+        cc.bank_gates(
+            "serve_loadtest.fleet",
+            {"fleet_failover_detect_s": (
+                report.get("failover_detect_s"), "s", "lower"),
+             "storm_ms_per_tok": (report.get("storm_ms_per_tok"),
+                                  "ms", "lower")},
+            workload="fleet-%d" % args.fleet,
+            gate_failures=len(fails))
         out = json.dumps(report, indent=2, default=str)
         if args.json:
             with open(args.json, "w") as f:
@@ -1222,6 +1243,14 @@ def main(argv=None):
     fails = gates(report, expect_shed=not args.no_expect_shed,
                   require_slo=args.require_slo)
     report["failures"] = fails
+    cc.bank_gates(
+        "serve_loadtest.storm",
+        {"storm_ms_per_tok": (report.get("storm_ms_per_tok"), "ms",
+                              "lower"),
+         "p99_decode_stall_ms": (
+             report.get("metrics", {}).get("p99_decode_stall_ms"),
+             "ms", "lower")},
+        workload=args.weights or "f32", gate_failures=len(fails))
     if args.trace_sample and report.get("trace_sample_timeline"):
         with open(args.trace_sample, "w") as f:
             f.write(report["trace_sample_timeline"] + "\n")

@@ -405,6 +405,19 @@ def main(argv=None):
     report = run_chaos(args)
     fails = gates(report)
     report["gates_failed"] = fails
+    # bank the gate numbers into the performance ledger — the chaos
+    # overhead (chaos wall vs golden wall) bands run-over-run
+    golden, chaos = (report.get("golden_wall_s"),
+                     report.get("chaos_wall_s"))
+    cc.bank_gates(
+        "train_chaos",
+        {"train_golden_wall_s": (golden, "s", "lower"),
+         "train_chaos_wall_s": (chaos, "s", "lower"),
+         "train_chaos_overhead_x": (
+             round(chaos / golden, 3) if golden and chaos else None,
+             "x", "lower")},
+        workload="kill-storm",
+        kills=len(report.get("kills_delivered", []) or []))
 
     if args.json:
         with open(args.json, "w") as f:

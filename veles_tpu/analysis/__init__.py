@@ -32,8 +32,13 @@ which also generates docs/state_reference.md via ``--format
 markdown``), and the host-determinism lint scans the bit-compared
 modules for wall-clock, unsorted enumeration, set-order iteration,
 host RNG and unordered threaded accumulation (determinism_audit,
-VB11xx — ``--determinism``).  ``--all`` runs every registered family
-in one pass.  Surface: :func:`lint_workflow` in-process, the
+VB11xx — ``--determinism``).  The performance plane gets the same
+treatment: the target-contract lint cross-checks the declared target
+registry (``telemetry.ledger.TARGETS``) against the performance
+ledger's measurements both ways (perf_lint, VL12xx — ``--perf``, a
+data audit of the ledger file; the runtime regression verdicts live
+in ``veles-tpu-perf gate``).  ``--all`` runs every registered AST
+family in one pass.  Surface: :func:`lint_workflow` in-process, the
 ``veles-tpu-lint`` console script, and ``python -m veles_tpu ...
 --lint``.
 
@@ -52,7 +57,7 @@ __all__ = ["ERROR", "WARNING", "INFO", "SEVERITIES", "Finding",
            "audit_sharded_step", "audit_numerics", "lint_workflow",
            "lint_serving", "lint_concurrency", "lint_protocol",
            "lint_config", "build_config_reference", "lint_state",
-           "lint_determinism", "build_state_reference"]
+           "lint_determinism", "build_state_reference", "lint_perf"]
 
 
 def audit_sharded_step(spec, hbm_gib=None):
@@ -125,6 +130,15 @@ def lint_determinism(paths=None, root=None):
     no jax)."""
     from veles_tpu.analysis import determinism_audit
     return determinism_audit.lint_determinism(paths=paths, root=root)
+
+
+def lint_perf(ledger_path=None, targets=None, records=None):
+    """Performance target-contract lint (VL12xx) — see
+    :mod:`veles_tpu.analysis.perf_lint` (lazy; pure data audit of the
+    ledger file, no AST, no jax)."""
+    from veles_tpu.analysis import perf_lint
+    return perf_lint.lint_perf(ledger_path=ledger_path,
+                               targets=targets, records=records)
 
 
 def build_state_reference(root=None):

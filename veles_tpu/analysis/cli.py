@@ -254,6 +254,23 @@ def main(argv=None):
                    "digests, unsorted filesystem enumeration, "
                    "set-order iteration, host random/uuid, unordered "
                    "threaded accumulation; needs no workflow file")
+    p.add_argument("--perf", action="store_true",
+                   help="run the VL12xx performance target-contract "
+                   "lint over the performance ledger (telemetry."
+                   "ledger): targets declared but never measured, "
+                   "measurements referencing unknown targets, "
+                   "duplicate/conflicting declarations — a data "
+                   "audit of the ledger file, not an AST scan; "
+                   "needs no workflow file (--ledger picks the "
+                   "file; sentinel verdicts live in veles-tpu-perf "
+                   "gate)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="ledger JSONL the --perf lint reads "
+                   "(default: the checked-in PERF_LEDGER.jsonl at "
+                   "the repo root when present, else "
+                   "root.common.perf.ledger > "
+                   "VELES_TPU_PERF_LEDGER > <dirs.cache>/"
+                   "perf_ledger.jsonl)")
     p.add_argument("--all", action="store_true",
                    help="run every registered AST family in one pass "
                    "(--concurrency --protocol --config-audit --state "
@@ -275,7 +292,7 @@ def main(argv=None):
         args.concurrency = args.protocol = args.config_audit = True
         args.state = args.determinism = True
     ast_only = (args.concurrency or args.protocol or args.config_audit
-                or args.state or args.determinism)
+                or args.state or args.determinism or args.perf)
     if args.workflow is None and not ast_only:
         p.error("a workflow file is required (only pure --concurrency/"
                 "--protocol/--config-audit/--state/--determinism/--all "
@@ -341,6 +358,18 @@ def main(argv=None):
     if args.determinism:
         from veles_tpu.analysis import lint_determinism
         findings.extend(lint_determinism())
+    if args.perf:
+        from veles_tpu.analysis import lint_perf
+        ledger_path = args.ledger
+        if ledger_path is None:
+            # the tree-level contract judges the checked-in silicon
+            # history, not whatever this box's process ledger holds
+            seed = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "PERF_LEDGER.jsonl")
+            if os.path.exists(seed):
+                ledger_path = seed
+        findings.extend(lint_perf(ledger_path=ledger_path))
 
     from veles_tpu.analysis import (format_findings, sort_findings,
                                     threshold_reached)

@@ -1,0 +1,110 @@
+"""Performance target-contract lint (VL12xx).
+
+The VC954 lesson applied to performance numbers: a target that nothing
+measures is a contract violation exactly like a metric that nothing
+emits.  The ledger (telemetry.ledger) made targets declarative — the
+:data:`~veles_tpu.telemetry.ledger.TARGETS` registry is the
+declaration, ledger records are the measurements — so the two sides
+are cross-checkable:
+
+========  =======  ==========================================
+rule      severity  meaning
+========  =======  ==========================================
+VL1200    warning  target declared in the registry but never
+                   measured: no ledger record answers it (the
+                   "pre-registered but the TPU never answered"
+                   state — ROADMAP item 1's failure mode)
+VL1201    error    measurement references an unknown target: a
+                   ledger record's ``target.id`` names no
+                   registry entry (stale rename, or a target
+                   deleted without migrating its history)
+VL1202    error    conflicting target declaration: a registry
+                   metric declared twice (the tuple is the one
+                   source of truth — duplicates mean two bars
+                   for one number)
+VL1203    warning  polarity conflict: a record's ``better``
+                   disagrees with its declared target's — the
+                   sentinel would band one side while the gate
+                   judges the other
+========  =======  ==========================================
+
+Pure data audit (no AST, no jax): it reads one ledger file and the
+in-process registry, so it runs under ``veles-tpu-lint --perf``, the
+``veles-tpu-perf gate``, and CI's perf-ledger job alike.  Rule
+catalog: docs/static_analysis.md."""
+
+from veles_tpu.analysis.findings import (ERROR, WARNING, Finding,
+                                         sort_findings)
+
+#: the full VL12xx contract family, in catalog order (the sentinel's
+#: runtime verdicts VL1210/VL1211 live in telemetry.perfcli)
+RULES = ("VL1200", "VL1201", "VL1202", "VL1203")
+
+
+def lint_perf(ledger_path=None, targets=None, records=None):
+    """Audit the declared-target vs measured-record contract.  Reads
+    the process-default ledger unless ``ledger_path`` (or an explicit
+    ``records`` list, for tests) is given.  Returns sorted
+    Findings."""
+    from veles_tpu.telemetry import ledger as led
+    if targets is None:
+        targets = led.TARGETS
+    if records is None:
+        book = (led.PerfLedger(ledger_path) if ledger_path
+                else led.default())
+        records = book.records()
+    findings = []
+    seen = {}
+    for t in targets:
+        if t.metric in seen and seen[t.metric] != t:
+            findings.append(Finding(
+                "VL1202", ERROR, t.metric,
+                "target %r declared twice with conflicting goals "
+                "(%r vs %r)" % (t.metric, seen[t.metric].goal,
+                                t.goal),
+                "keep exactly one Target per metric in "
+                "telemetry.ledger.TARGETS"))
+        seen[t.metric] = t
+    by_metric = {t.metric: t for t in targets}
+    measured = set()
+    flagged_orphans = set()
+    flagged_polarity = set()
+    for rec in records:
+        metric = rec.get("metric")
+        measured.add(metric)
+        tgt = rec.get("target") or None
+        if isinstance(tgt, dict):
+            tid = tgt.get("id", metric)
+            if tid not in by_metric and tid not in flagged_orphans:
+                flagged_orphans.add(tid)
+                findings.append(Finding(
+                    "VL1201", ERROR, str(metric),
+                    "measurement carries target id %r that no "
+                    "registry entry declares" % (tid,),
+                    "register the target in "
+                    "telemetry.ledger.TARGETS or drop the stale "
+                    "reference when migrating history"))
+            decl = by_metric.get(tid)
+            if decl is not None and rec.get("better") \
+                    and rec["better"] != decl.better \
+                    and metric not in flagged_polarity:
+                flagged_polarity.add(metric)
+                findings.append(Finding(
+                    "VL1203", WARNING, str(metric),
+                    "record polarity %r disagrees with its "
+                    "declared target's %r"
+                    % (rec["better"], decl.better),
+                    "fix the appender's better= (the sentinel "
+                    "bands the record's polarity, the gate "
+                    "judges the target's)"))
+    for t in targets:
+        if t.metric not in measured:
+            findings.append(Finding(
+                "VL1200", WARNING, t.metric,
+                "target declared (%s %s %s, %s) but never "
+                "measured: no ledger record answers it"
+                % (t.metric, "<=" if t.better == "lower" else ">=",
+                   t.goal, t.source),
+                "run the measuring phase (%s) on the next TPU "
+                "window — ROADMAP item 1" % (t.source,)))
+    return sort_findings(findings)

@@ -248,6 +248,17 @@ root.common.update({
     # telemetry thresholds (telemetry.mfu): warn when measured MFU
     # falls below this fraction of the roofline prediction
     "telemetry": {"mfu_warn_fraction": 0.5},
+    # the persistent performance ledger + regression sentinel
+    # (telemetry.ledger, docs/perf.md "Performance ledger & regression
+    # sentinel").  ledger: explicit JSONL path (None = the
+    # VELES_TPU_PERF_LEDGER env var, else <dirs.cache>/
+    # perf_ledger.jsonl); enabled gates the automatic trainer/MFU/
+    # harness appends; min_history is the fewest prior records before
+    # the sentinel bands a key; the band is
+    # band_mads x 1.4826 x MAD, floored at min_rel_band of the
+    # median; history caps the records read back per key.
+    "perf": {"ledger": None, "enabled": True, "min_history": 3,
+             "band_mads": 4.0, "min_rel_band": 0.05, "history": 64},
     # the flight recorder / crash forensics / watchdog layer
     # (veles_tpu.telemetry.flight + .health, docs/services.md "Black
     # box").  watchdog_seconds: None = unset (standalone stays

@@ -834,6 +834,15 @@ class StagedTrainer(Unit):
         telemetry.flight.record(
             "step", step=self._step_counter, steps=steps,
             examples=examples, wall_s=wall, loss=loss_mean, **lbl)
+        if wall > 0:
+            # bank the sweep throughput in the performance ledger
+            # (telemetry.ledger, fail-soft): per-class history the
+            # regression sentinel bands — the train-class step_ms /
+            # MFU rows ride the MFU check below
+            telemetry.ledger.record_value(
+                "sweep_examples_per_sec", examples / wall,
+                workload="%s/%s" % (self.name, name), unit="ex/s",
+                better="higher", source="trainer.sweep", steps=steps)
         telemetry.health.note_progress(step=self._step_counter)
         if self._health_host is not None:
             # sentinel health (services.sentinel), read off the SAME

@@ -85,6 +85,42 @@ prediction — <a href="/api/bench">json</a>)</small></h3>
  }catch(e){document.getElementById('bench').textContent=String(e);}
 })();
 </script>
+<h3>perf ledger <small>(persistent per-key history + regression
+sentinel — <a href="/api/perf">json</a>; drift rides
+<a href="/metrics">/metrics</a> as veles_perf_drift /
+veles_perf_regressions_total)</small></h3>
+<div id="perf"></div>
+<script>
+(async function(){
+ try{
+  const p=await (await fetch('/api/perf')).json();
+  const ks=p.keys||[];
+  if(!ks.length){document.getElementById('perf').textContent=
+   '(empty ledger: '+(p.ledger||p.error||'?')+')';return;}
+  let h='<table><tr><th align=left>key</th><th>trend</th>'+
+   '<th>last</th><th>median</th><th>drift</th><th>target</th>'+
+   '<th>verdict</th></tr>';
+  for(const k of ks.slice(0,40)){
+   const v=k.verdict||{};
+   const pts=(k.trend||[]).map((y,i)=>[i,y]);
+   const badge=v.status==='regression'?
+    '<b style="color:#c00">regression</b>':
+    v.status==='improved'?'<b style="color:#2a2">improved</b>':
+    esc(v.status||'?');
+   h+='<tr><td>'+esc(k.key)+'</td><td>'+
+    (pts.length>1?sparkline(pts):'')+'</td><td align=right>'+
+    esc(k.last??'')+'</td><td align=right>'+
+    (v.median==null?'':Number(v.median).toPrecision(4))+
+    '</td><td align=right>'+
+    (v.drift==null?'':(100*v.drift).toFixed(1)+'%')+
+    '</td><td align=right>'+esc(v.target??'')+'</td><td>'+badge+
+    (v.target_met===false?
+     ' <b style="color:#c60">target missed</b>':'')+'</td></tr>';
+  }
+  document.getElementById('perf').innerHTML=h+'</table>';
+ }catch(e){document.getElementById('perf').textContent=String(e);}
+})();
+</script>
 <h3>recent events</h3><div id="events"></div>
 <h3>log browser <small>(cross-run, needs --log-db)</small></h3>
 <div><input id="logq" placeholder="substring" size="24">
@@ -511,6 +547,37 @@ class WebStatusServer(Logger):
                 "measured_at": measured.get("measured_at"),
                 "cache_path": path}
 
+    def perf_report(self):
+        """``/api/perf`` payload: the persistent performance ledger
+        (telemetry.ledger) grouped per key — trend values, latest
+        sample, declared target, and the regression sentinel's verdict
+        on that latest sample.  The sentinel's live gauges
+        (``veles_perf_drift{metric}``,
+        ``veles_perf_regressions_total``) ride the normal ``/metrics``
+        Prometheus surface; this endpoint is the history view behind
+        them.  Never raises — a perf panel that 500s hides the
+        regression it exists to show."""
+        try:
+            from veles_tpu.telemetry import ledger
+            book = ledger.default()
+            keys = []
+            for key, recs in sorted(book.by_key().items()):
+                latest, prior = recs[-1], recs[:-1]
+                verdict = book.assess(latest, prior)
+                trend = [r.get("value") for r in recs[-32:]
+                         if isinstance(r.get("value"), (int, float))]
+                keys.append({"key": key,
+                             "metric": latest.get("metric"),
+                             "unit": latest.get("unit", ""),
+                             "n": len(recs),
+                             "last": latest.get("value"),
+                             "ts": latest.get("ts"),
+                             "trend": trend,
+                             "verdict": verdict})
+            return {"ledger": book.path, "keys": keys}
+        except Exception as e:   # noqa: BLE001 — the panel must answer
+            return {"error": str(e), "keys": []}
+
     def health_status(self):
         """``/api/health`` payload: process id/mode, last-step age,
         watchdog state, crashdump count (telemetry.health.status), plus
@@ -662,6 +729,9 @@ class WebStatusServer(Logger):
                         json.dumps(state, default=str).encode())
                 elif self.path == "/api/bench":
                     self._send(200, json.dumps(server.bench_report(),
+                                               default=str).encode())
+                elif self.path == "/api/perf":
+                    self._send(200, json.dumps(server.perf_report(),
                                                default=str).encode())
                 elif self.path.startswith("/api/logruns"):
                     self._send(200, json.dumps(

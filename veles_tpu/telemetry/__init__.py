@@ -22,12 +22,20 @@ subsystem:
   black box; read with ``veles-tpu-blackbox``);
 * :mod:`~veles_tpu.telemetry.health` — crash-forensics hooks
   (excepthook/faulthandler/SIGTERM/SIGABRT), the hang watchdog, and
-  the multi-host heartbeat/desync layer.
+  the multi-host heartbeat/desync layer;
+* :mod:`~veles_tpu.telemetry.ledger` — the persistent performance
+  ledger (append-only JSONL keyed the tuner's way), the pre-registered
+  target registry, and the median/MAD regression sentinel (read with
+  ``veles-tpu-perf``);
+* :mod:`~veles_tpu.telemetry.anatomy` — step-anatomy attribution:
+  compile/host/dispatch/collective/compute decomposition of the
+  training step, priced against ``tools/cost_model``.
 
 Import cost is stdlib-only; jax is touched lazily (first span under a
 live trace annotation), so platform pinning still works."""
 
-from veles_tpu.telemetry import flight, health, mfu  # noqa: F401
+from veles_tpu.telemetry import (anatomy, flight, health,  # noqa: F401
+                                 ledger, mfu)
 from veles_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry)
 from veles_tpu.telemetry.spans import (  # noqa: F401

@@ -128,9 +128,14 @@ def check_step(trainer, steps, wall_s, registry=None):
     """Compare a finished train-class sweep (``steps`` staged steps in
     ``wall_s`` wall seconds) against :func:`price_staged_step`.  Updates
     the ``veles_mfu_*`` gauges, emits a ``kind="mfu"`` record carrying
-    BOTH ``predicted`` and ``measured``, and fires the shortfall warning
-    metric when measured/predicted falls below the configured
-    fraction."""
+    BOTH ``predicted`` and ``measured``, banks predicted & measured in
+    the performance ledger (telemetry.ledger) with the step-anatomy
+    decomposition attached, and fires the shortfall warning.  The
+    one-shot warning routes through the sentinel's drift band: with
+    ledger history, "shortfall" means the measured MFU fell outside
+    its own MAD noise band on the worse side (noise-aware); only a
+    history-less first run falls back to the bare
+    ``mfu_warn_fraction`` compare."""
     if registry is None:
         from veles_tpu.telemetry import registry
     if not steps or wall_s <= 0.0:
@@ -146,7 +151,26 @@ def check_step(trainer, steps, wall_s, registry=None):
     ratio = measured_mfu / predicted_mfu if predicted_mfu else 0.0
     from veles_tpu.config import root
     frac = float(root.common.telemetry.get("mfu_warn_fraction", 0.5))
-    warned = ratio < frac
+    # bank the sweep: measured MFU (with the anatomy components) and
+    # the step time, each assessed against their ledger history — the
+    # drift band below reads the returned verdict
+    from veles_tpu.telemetry import anatomy, ledger
+    comps = anatomy.step_components(trainer, steps, wall_s, registry)
+    wl = str(getattr(trainer, "name", "trainer"))
+    banked = ledger.record_value(
+        "train_mfu", measured_mfu, workload=wl, unit="MFU",
+        better="higher", source="mfu.check_step",
+        predicted=predicted_mfu, ratio=ratio)
+    ledger.record_value(
+        "train_step_ms", measured_step_s * 1e3, workload=wl,
+        unit="ms", source="mfu.check_step", components=comps,
+        predicted=pricing["predicted_step_s"] * 1e3)
+    verdict = (banked or {}).get("verdict") or {}
+    if verdict.get("status") in ("regression", "improved", "ok"):
+        # history exists: the band verdict IS the shortfall call
+        warned = verdict["status"] == "regression"
+    else:
+        warned = ratio < frac
     registry.gauge("veles_mfu_predicted",
                    "roofline-predicted MFU of the staged step").set(
         predicted_mfu)
@@ -161,13 +185,25 @@ def check_step(trainer, steps, wall_s, registry=None):
             "mfu_warn_fraction of the prediction").inc()
         if not trainer.__dict__.get("_mfu_warned_"):
             trainer.__dict__["_mfu_warned_"] = True
-            trainer.warning(
-                "measured MFU %.3g is %.2fx the %s roofline prediction "
-                "%.3g (threshold %.2f) — the step is off the modeled "
-                "roofline (root.common.telemetry.mfu_warn_fraction "
-                "tunes this tripwire)",
-                measured_mfu, ratio, pricing["device"], predicted_mfu,
-                frac)
+            if verdict.get("status") == "regression":
+                trainer.warning(
+                    "measured MFU %.3g fell %.1f%% below its ledger "
+                    "history median %.3g — outside the MAD noise band "
+                    "(%s roofline predicted %.3g; "
+                    "root.common.perf.band_mads tunes the band)",
+                    measured_mfu,
+                    -100.0 * (verdict.get("drift") or 0.0),
+                    verdict.get("median") or 0.0, pricing["device"],
+                    predicted_mfu)
+            else:
+                trainer.warning(
+                    "measured MFU %.3g is %.2fx the %s roofline "
+                    "prediction %.3g (threshold %.2f) — the step is "
+                    "off the modeled roofline "
+                    "(root.common.telemetry.mfu_warn_fraction tunes "
+                    "this tripwire)",
+                    measured_mfu, ratio, pricing["device"],
+                    predicted_mfu, frac)
     return registry.emit(
         "mfu", predicted=predicted_mfu, measured=measured_mfu,
         ratio=ratio, warned=warned, warn_fraction=frac,

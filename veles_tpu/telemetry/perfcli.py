@@ -1,0 +1,230 @@
+"""veles-tpu-perf — read, diff and gate the performance ledger.
+
+The machine-checked replacement for eyeballing BENCH_r0x.json: every
+banked number (bench phases, chaos-harness gates, MFU checks, trainer
+sweeps — telemetry.ledger) is reported per key with its median/MAD
+band, declared target, and last sentinel verdict.
+
+Subcommands::
+
+    report   per-key history summary: n, last, median, MAD band,
+             drift, target, verdict
+    diff     latest value per key vs a baseline ledger (or, without
+             --baseline, vs the key's own prior median)
+    gate     the CI verdict: fresh regressions (VL1210, error) +
+             missed targets (VL1211, warning) + the VL12xx
+             target-contract lint, through the ONE shared exit gate
+             (analysis.findings.threshold_reached)
+    targets  the declared registry vs what the ledger has measured
+
+Exit status (identical to every lint surface): 0 = no findings at or
+above ``--fail-on``, 1 = threshold reached, 2 = usage error."""
+
+import argparse
+import json
+import sys
+
+from veles_tpu.telemetry import ledger as led
+
+
+def _book(args):
+    return led.PerfLedger(args.ledger) if args.ledger else led.default()
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return ("%%.%dg" % nd) % v
+    return str(v)
+
+
+def _assess_keys(book):
+    """[(key, latest record, verdict)] for every key in the ledger —
+    the freshest record judged against everything before it."""
+    out = []
+    for key, recs in sorted(book.by_key().items()):
+        latest, prior = recs[-1], recs[:-1]
+        out.append((key, latest, book.assess(latest, prior)))
+    return out
+
+
+def cmd_report(args):
+    book = _book(args)
+    rows = [(k, r, v) for k, r, v in _assess_keys(book)
+            if not args.key or args.key in k]
+    if args.format == "json":
+        print(json.dumps([{"key": k, "record": r, "verdict": v}
+                          for k, r, v in rows], indent=2,
+                         default=str))
+        return 0
+    if not rows:
+        print("ledger %s: no records" % book.path)
+        return 0
+    print("ledger %s: %d keys" % (book.path, len(rows)))
+    hdr = ("%-44s %5s %10s %10s %10s %8s %10s %s"
+           % ("key", "n", "last", "median", "band", "drift",
+              "target", "verdict"))
+    print(hdr)
+    print("-" * len(hdr))
+    for k, r, v in rows:
+        print("%-44s %5d %10s %10s %10s %8s %10s %s"
+              % (k[:44], v["n"] + 1, _fmt(r.get("value")),
+                 _fmt(v["median"]), _fmt(v["band"]),
+                 ("%+.1f%%" % (100 * v["drift"])
+                  if v["drift"] is not None else "-"),
+                 _fmt(v["target"]), v["status"]
+                 + ("" if v.get("target_met") is None
+                    else " target_met" if v["target_met"]
+                    else " target_MISSED")))
+    return 0
+
+
+def cmd_diff(args):
+    book = _book(args)
+    base = led.PerfLedger(args.baseline) if args.baseline else None
+    rows = []
+    for key, latest, verdict in _assess_keys(book):
+        if base is not None:
+            brecs = base.records(key=key)
+            ref = brecs[-1].get("value") if brecs else None
+        else:
+            ref = verdict["median"]
+        val = latest.get("value")
+        delta = (None if ref in (None, 0)
+                 or not isinstance(val, (int, float))
+                 else (val - ref) / ref)
+        rows.append((key, val, ref, delta))
+    if args.format == "json":
+        print(json.dumps([{"key": k, "value": v, "baseline": r,
+                           "delta": d} for k, v, r, d in rows],
+                         indent=2, default=str))
+        return 0
+    ref_name = args.baseline or "prior median"
+    print("diff vs %s" % ref_name)
+    for k, v, r, d in rows:
+        print("%-44s %10s -> %10s  %s"
+              % (k[:44], _fmt(r), _fmt(v),
+                 "%+.1f%%" % (100 * d) if d is not None else "-"))
+    return 0
+
+
+def cmd_targets(args):
+    book = _book(args)
+    measured = {}
+    for rec in book.records():
+        m = rec.get("metric")
+        if m in led.TARGETS_BY_METRIC:
+            measured.setdefault(m, []).append(rec)
+    if args.format == "json":
+        print(json.dumps(
+            [{"metric": t.metric, "goal": t.goal, "better": t.better,
+              "unit": t.unit, "source": t.source, "note": t.note,
+              "measured": len(measured.get(t.metric, [])),
+              "last": (measured[t.metric][-1].get("value")
+                       if t.metric in measured else None),
+              "met": (t.met(measured[t.metric][-1]["value"])
+                      if t.metric in measured and isinstance(
+                          measured[t.metric][-1].get("value"),
+                          (int, float)) else None)}
+             for t in led.TARGETS], indent=2, default=str))
+        return 0
+    for t in led.TARGETS:
+        recs = measured.get(t.metric, [])
+        last = recs[-1].get("value") if recs else None
+        status = ("NEVER MEASURED" if not recs
+                  else "met" if isinstance(last, (int, float))
+                  and t.met(last) else "MISSED")
+        print("%-24s %s %-8s [%s]  n=%d last=%s  %s  (%s)"
+              % (t.metric, "<=" if t.better == "lower" else ">=",
+                 _fmt(t.goal), t.unit, len(recs), _fmt(last),
+                 status, t.source))
+    return 0
+
+
+def gate_findings(book):
+    """The gate's finding list: fresh sentinel verdicts (VL1210
+    regression = error, VL1211 missed target = warning — component
+    named when the anatomy knows it) + the VL12xx target-contract
+    lint."""
+    from veles_tpu.analysis.findings import ERROR, WARNING, Finding
+    from veles_tpu.analysis.perf_lint import lint_perf
+    findings = []
+    records = book.records()
+    for key, latest, v in _assess_keys(book):
+        metric = str(latest.get("metric", key))
+        if v["status"] == "regression":
+            comp = v.get("component")
+            findings.append(Finding(
+                "VL1210", ERROR, key,
+                "regression: %s drifted %+.1f%% off its history "
+                "median %s (band %s)%s"
+                % (metric, 100 * (v["drift"] or 0.0),
+                   _fmt(v["median"]), _fmt(v["band"]),
+                   " — drifted component: %s" % comp if comp
+                   else ""),
+                "bisect the drifted component"
+                + (" (%s)" % comp if comp else "")
+                + "; veles-tpu-perf report shows the key's history"))
+        if v.get("target_met") is False:
+            findings.append(Finding(
+                "VL1211", WARNING, key,
+                "declared target missed: %s=%s vs goal %s %s"
+                % (metric, _fmt(latest.get("value")),
+                   "<=" if v["better"] == "lower" else ">=",
+                   _fmt(v["target"])),
+                "the pre-registered bar (telemetry.ledger.TARGETS) "
+                "— fix-and-remeasure on the next TPU window"))
+    findings.extend(lint_perf(records=records))
+    return findings
+
+
+def cmd_gate(args):
+    from veles_tpu.analysis.findings import (format_findings,
+                                             sort_findings,
+                                             threshold_reached)
+    book = _book(args)
+    findings = sort_findings(gate_findings(book))
+    print(format_findings(findings,
+                          "json" if args.format == "json" else "text"))
+    return 1 if threshold_reached(findings, args.fail_on) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-perf",
+        description="performance-ledger reporter + regression gate "
+                    "(telemetry.ledger; docs/perf.md)",
+        epilog="exit codes: 0 below --fail-on threshold, 1 threshold "
+               "reached, 2 usage (the shared findings gate)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("report", cmd_report), ("diff", cmd_diff),
+                     ("gate", cmd_gate), ("targets", cmd_targets)):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+        sp.add_argument("--ledger", default=None, metavar="PATH",
+                        help="ledger JSONL (default: root.common."
+                        "perf.ledger > VELES_TPU_PERF_LEDGER > "
+                        "<dirs.cache>/perf_ledger.jsonl)")
+        sp.add_argument("--format", choices=("text", "json"),
+                        default="text")
+        if name == "report":
+            sp.add_argument("--key", default=None,
+                            help="substring filter on the full "
+                            "metric|workload|backend|mesh|dtype key")
+        if name == "diff":
+            sp.add_argument("--baseline", default=None, metavar="PATH",
+                            help="baseline ledger to diff against "
+                            "(default: each key's own prior median)")
+        if name == "gate":
+            sp.add_argument("--fail-on", choices=("error", "warning"),
+                            default="error",
+                            metavar="{error,warning}",
+                            help="severity threshold for exit 1 "
+                            "(the shared findings gate)")
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
